@@ -1,0 +1,267 @@
+"""Lightweight Kubernetes-shaped object model.
+
+The reference consumes real Kubernetes API objects via client-go listers
+(reference: cluster-autoscaler/utils/kubernetes/listers.go:38). This framework
+is cluster-API-agnostic: the host control plane works on these plain
+dataclasses, and the snapshot packer flattens them into dense tensors for the
+TPU simulation engine. Only the fields the autoscaling decision path actually
+reads are modeled (resource requests/allocatable, labels, selectors, taints/
+tolerations, affinity, owner refs, priority, PDB linkage).
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# Resource axis indices inside all dense resource vectors. Mirrors the resource
+# kinds the reference's scheduler predicates evaluate (noderesources fit over
+# cpu/memory/ephemeral-storage/extended resources, plus the pods-count capacity;
+# reference: cluster-autoscaler/simulator/predicatechecker/schedulerbased.go:152).
+CPU = 0        # millicores
+MEMORY = 1     # bytes
+EPHEMERAL = 2  # bytes
+GPU = 3        # count
+TPU = 4        # count (device-plugin style extended resource)
+PODS = 5       # pod-count capacity (always 1 per pod)
+NUM_RESOURCES = 6
+
+RESOURCE_NAMES = ("cpu", "memory", "ephemeral-storage", "gpu", "tpu", "pods")
+
+# Taint effects (reference: k8s core/v1 taint effects used by
+# cluster-autoscaler/utils/taints/taints.go).
+NO_SCHEDULE = "NoSchedule"
+PREFER_NO_SCHEDULE = "PreferNoSchedule"
+NO_EXECUTE = "NoExecute"
+
+# Well-known taints the autoscaler itself manages (reference:
+# cluster-autoscaler/utils/taints/taints.go ToBeDeletedTaint /
+# DeletionCandidateTaint).
+TO_BE_DELETED_TAINT = "ToBeDeletedByClusterAutoscaler"
+DELETION_CANDIDATE_TAINT = "DeletionCandidateOfClusterAutoscaler"
+
+# Annotations (reference: cluster-autoscaler/utils/drain/drain.go:33-43 and
+# core/scaledown/eligibility/eligibility.go:66).
+SAFE_TO_EVICT_ANNOTATION = "cluster-autoscaler.kubernetes.io/safe-to-evict"
+SCALE_DOWN_DISABLED_ANNOTATION = "cluster-autoscaler.kubernetes.io/scale-down-disabled"
+SAFE_TO_EVICT_LOCAL_VOLUMES_ANNOTATION = (
+    "cluster-autoscaler.kubernetes.io/safe-to-evict-local-volumes"
+)
+
+
+@dataclass(frozen=True)
+class Resources:
+    """A dense resource vector with named accessors.
+
+    cpu is in millicores, memory/ephemeral in bytes, gpu/tpu in device counts.
+    """
+
+    cpu_m: float = 0.0
+    memory: float = 0.0
+    ephemeral: float = 0.0
+    gpu: float = 0.0
+    tpu: float = 0.0
+    pods: float = 0.0
+
+    def as_tuple(self) -> Tuple[float, ...]:
+        return (self.cpu_m, self.memory, self.ephemeral, self.gpu, self.tpu, self.pods)
+
+    def __add__(self, other: "Resources") -> "Resources":
+        return Resources(*[a + b for a, b in zip(self.as_tuple(), other.as_tuple())])
+
+    def __sub__(self, other: "Resources") -> "Resources":
+        return Resources(*[a - b for a, b in zip(self.as_tuple(), other.as_tuple())])
+
+    @staticmethod
+    def from_tuple(t) -> "Resources":
+        return Resources(*[float(x) for x in t])
+
+
+@dataclass(frozen=True)
+class Toleration:
+    """Pod toleration (key/operator/value/effect).
+
+    operator: "Equal" (default) or "Exists". Empty key + Exists tolerates all.
+    Empty effect matches all effects.
+    """
+
+    key: str = ""
+    operator: str = "Equal"
+    value: str = ""
+    effect: str = ""
+
+    def tolerates(self, taint: "Taint") -> bool:
+        if self.operator == "Exists":
+            key_ok = self.key == "" or self.key == taint.key
+            value_ok = True
+        else:
+            key_ok = self.key == taint.key
+            value_ok = self.value == taint.value
+        effect_ok = self.effect == "" or self.effect == taint.effect
+        return key_ok and value_ok and effect_ok
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = NO_SCHEDULE
+
+
+@dataclass(frozen=True)
+class LabelSelectorRequirement:
+    """One matchExpressions entry: key op values, op in {In, NotIn, Exists,
+    DoesNotExist, Gt, Lt}."""
+
+    key: str
+    operator: str
+    values: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class LabelSelector:
+    match_labels: Tuple[Tuple[str, str], ...] = ()
+    match_expressions: Tuple[LabelSelectorRequirement, ...] = ()
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, str]]) -> "LabelSelector":
+        return LabelSelector(match_labels=tuple(sorted((d or {}).items())))
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        for k, v in self.match_labels:
+            if labels.get(k) != v:
+                return False
+        for req in self.match_expressions:
+            val = labels.get(req.key)
+            if req.operator == "In":
+                if val is None or val not in req.values:
+                    return False
+            elif req.operator == "NotIn":
+                if val is not None and val in req.values:
+                    return False
+            elif req.operator == "Exists":
+                if val is None:
+                    return False
+            elif req.operator == "DoesNotExist":
+                if val is not None:
+                    return False
+            elif req.operator == "Gt":
+                if val is None or not _num_cmp(val, req.values, lambda a, b: a > b):
+                    return False
+            elif req.operator == "Lt":
+                if val is None or not _num_cmp(val, req.values, lambda a, b: a < b):
+                    return False
+            else:
+                return False
+        return True
+
+
+def _num_cmp(val: str, values: Tuple[str, ...], op) -> bool:
+    try:
+        return bool(values) and op(int(val), int(values[0]))
+    except ValueError:
+        return False
+
+
+@dataclass(frozen=True)
+class PodAffinityTerm:
+    """One required pod (anti-)affinity term: the pod must (not) co-locate in
+    the same topology domain as pods matching the selector."""
+
+    selector: LabelSelector
+    topology_key: str
+    namespaces: Tuple[str, ...] = ()  # empty = pod's own namespace
+
+
+@dataclass(frozen=True)
+class Affinity:
+    """Required scheduling constraints (the predicate-relevant subset; the
+    reference evaluates these via the scheduler framework's InterPodAffinity
+    and NodeAffinity filter plugins, which are the documented 1000x cost
+    outlier — reference: cluster-autoscaler/FAQ.md:151-153)."""
+
+    node_selector_terms: Tuple[LabelSelector, ...] = ()  # ORed terms
+    pod_affinity: Tuple[PodAffinityTerm, ...] = ()       # ANDed
+    pod_anti_affinity: Tuple[PodAffinityTerm, ...] = ()  # ANDed
+
+
+@dataclass(frozen=True)
+class OwnerRef:
+    kind: str = ""
+    name: str = ""
+    controller: bool = True
+
+
+@dataclass
+class Pod:
+    name: str
+    namespace: str = "default"
+    requests: Resources = field(default_factory=Resources)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[Toleration] = field(default_factory=list)
+    affinity: Optional[Affinity] = None
+    owner_ref: Optional[OwnerRef] = None
+    priority: int = 0
+    node_name: str = ""          # "" = unscheduled/pending
+    host_ports: Tuple[int, ...] = ()
+    mirror: bool = False          # static/mirror pod
+    daemonset: bool = False
+    restartable: bool = True      # has a controller that will recreate it
+    local_storage: bool = False   # uses emptyDir/hostPath
+    creation_ts: float = 0.0
+    deletion_ts: Optional[float] = None
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def effective_requests(self) -> Resources:
+        r = self.requests
+        return dataclasses.replace(r, pods=1.0)
+
+
+@dataclass
+class Node:
+    name: str
+    allocatable: Resources = field(default_factory=Resources)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    ready: bool = True
+    unschedulable: bool = False
+    creation_ts: float = 0.0
+    # provider-assigned id; "" for template (hypothetical) nodes
+    provider_id: str = ""
+
+
+@dataclass
+class PodDisruptionBudget:
+    name: str
+    namespace: str = "default"
+    selector: LabelSelector = field(default_factory=LabelSelector)
+    disruptions_allowed: int = 0
+
+
+def pod_tolerates_taints(pod: Pod, taints: List[Taint]) -> bool:
+    """NoSchedule/NoExecute taints block scheduling unless tolerated
+    (PreferNoSchedule is soft and never blocks; reference behavior of the
+    TaintToleration filter plugin exercised via
+    cluster-autoscaler/simulator/predicatechecker/schedulerbased.go:152)."""
+    for taint in taints:
+        if taint.effect == PREFER_NO_SCHEDULE:
+            continue
+        if not any(tol.tolerates(taint) for tol in pod.tolerations):
+            return False
+    return True
+
+
+def node_matches_selector(pod: Pod, node: Node) -> bool:
+    """nodeSelector + required node affinity (NodeAffinity filter plugin)."""
+    for k, v in pod.node_selector.items():
+        if node.labels.get(k) != v:
+            return False
+    if pod.affinity and pod.affinity.node_selector_terms:
+        if not any(t.matches(node.labels) for t in pod.affinity.node_selector_terms):
+            return False
+    return True

@@ -1,0 +1,219 @@
+"""Host-side cluster snapshot with O(1) fork / O(1) revert / O(delta) commit.
+
+Mirrors the contract of the reference's ClusterSnapshot interface
+(cluster-autoscaler/simulator/clustersnapshot/clustersnapshot.go:29:
+AddNode/AddPod/RemovePod/RemoveNode/Fork/Revert/Commit/Clear) and the
+complexity profile of its DeltaClusterSnapshot (delta.go:43,448-469), but as a
+stack of operation layers over plain dataclasses instead of layered NodeInfo
+caches. This object-level snapshot drives host decisions (drain rules,
+template-node injection); `tensors()` materializes it into the padded
+SnapshotTensors pytree consumed by the device kernels, cached per version.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from autoscaler_tpu.kube.objects import Node, Pod
+from autoscaler_tpu.snapshot.packer import SnapshotMeta, pack
+from autoscaler_tpu.snapshot.tensors import SnapshotTensors
+
+
+class SnapshotError(Exception):
+    pass
+
+
+@dataclass
+class _Layer:
+    added_nodes: Dict[str, Node] = field(default_factory=dict)
+    removed_nodes: Set[str] = field(default_factory=set)
+    added_pods: Dict[str, Pod] = field(default_factory=dict)
+    removed_pods: Set[str] = field(default_factory=set)
+    # pod key -> node name ("" = unassign)
+    assignments: Dict[str, str] = field(default_factory=dict)
+
+
+class ClusterSnapshot:
+    def __init__(self) -> None:
+        self._layers: List[_Layer] = [_Layer()]
+        self._version = 0
+        self._cache: Optional[Tuple[int, SnapshotTensors, SnapshotMeta]] = None
+        self._cached_group_map: Optional[Dict[str, str]] = None
+
+    # -- mutation -----------------------------------------------------------
+    def _top(self) -> _Layer:
+        return self._layers[-1]
+
+    def _bump(self) -> None:
+        self._version += 1
+
+    def add_node(self, node: Node) -> None:
+        if self._find_node(node.name) is not None:
+            raise SnapshotError(f"node {node.name} already in snapshot")
+        self._top().added_nodes[node.name] = node
+        self._top().removed_nodes.discard(node.name)
+        self._bump()
+
+    def remove_node(self, name: str) -> None:
+        if self._find_node(name) is None:
+            raise SnapshotError(f"node {name} not in snapshot")
+        for pod in self.pods_on_node(name):
+            self.remove_pod(pod.key())
+        top = self._top()
+        top.added_nodes.pop(name, None)
+        top.removed_nodes.add(name)
+        self._bump()
+
+    def add_pod(self, pod: Pod, node_name: str = "") -> None:
+        if self._find_pod(pod.key()) is not None:
+            raise SnapshotError(f"pod {pod.key()} already in snapshot")
+        if node_name and self._find_node(node_name) is None:
+            raise SnapshotError(f"node {node_name} not in snapshot")
+        top = self._top()
+        top.added_pods[pod.key()] = pod
+        top.removed_pods.discard(pod.key())
+        if node_name or pod.node_name:
+            top.assignments[pod.key()] = node_name or pod.node_name
+        self._bump()
+
+    def remove_pod(self, pod_key: str) -> None:
+        if self._find_pod(pod_key) is None:
+            raise SnapshotError(f"pod {pod_key} not in snapshot")
+        top = self._top()
+        top.added_pods.pop(pod_key, None)
+        top.removed_pods.add(pod_key)
+        top.assignments.pop(pod_key, None)
+        self._bump()
+
+    def schedule_pod(self, pod_key: str, node_name: str) -> None:
+        if self._find_pod(pod_key) is None:
+            raise SnapshotError(f"pod {pod_key} not in snapshot")
+        if self._find_node(node_name) is None:
+            raise SnapshotError(f"node {node_name} not in snapshot")
+        self._top().assignments[pod_key] = node_name
+        self._bump()
+
+    def clear(self) -> None:
+        self._layers = [_Layer()]
+        self._bump()
+
+    # -- fork/revert/commit (reference: delta.go:448,454,462) ---------------
+    def fork(self) -> None:
+        self._layers.append(_Layer())
+
+    def revert(self) -> None:
+        if len(self._layers) == 1:
+            raise SnapshotError("revert with no fork")
+        self._layers.pop()
+        self._bump()
+
+    def commit(self) -> None:
+        if len(self._layers) == 1:
+            return
+        top = self._layers.pop()
+        parent = self._layers[-1]
+        for name in top.removed_nodes:
+            parent.added_nodes.pop(name, None)
+            parent.removed_nodes.add(name)
+        parent.added_nodes.update(top.added_nodes)
+        for name in top.added_nodes:
+            parent.removed_nodes.discard(name)
+        for key in top.removed_pods:
+            parent.added_pods.pop(key, None)
+            parent.removed_pods.add(key)
+            parent.assignments.pop(key, None)
+        parent.added_pods.update(top.added_pods)
+        for key in top.added_pods:
+            parent.removed_pods.discard(key)
+        parent.assignments.update(top.assignments)
+        self._bump()
+
+    @property
+    def fork_depth(self) -> int:
+        return len(self._layers) - 1
+
+    # -- reads --------------------------------------------------------------
+    def _find_node(self, name: str) -> Optional[Node]:
+        for layer in reversed(self._layers):
+            if name in layer.removed_nodes:
+                return None
+            if name in layer.added_nodes:
+                return layer.added_nodes[name]
+        return None
+
+    def _find_pod(self, key: str) -> Optional[Pod]:
+        for layer in reversed(self._layers):
+            if key in layer.removed_pods:
+                return None
+            if key in layer.added_pods:
+                return layer.added_pods[key]
+        return None
+
+    def get_node(self, name: str) -> Optional[Node]:
+        return self._find_node(name)
+
+    def get_pod(self, key: str) -> Optional[Pod]:
+        return self._find_pod(key)
+
+    def nodes(self) -> List[Node]:
+        out: List[Node] = []
+        emitted: Set[str] = set()
+        for layer in self._layers:
+            for name, node in layer.added_nodes.items():
+                if name in emitted:
+                    continue
+                if self._find_node(name) is node:
+                    out.append(node)
+                    emitted.add(name)
+        return out
+
+    def pods(self) -> List[Pod]:
+        out: List[Pod] = []
+        emitted: Set[str] = set()
+        for layer in self._layers:
+            for key, pod in layer.added_pods.items():
+                if key in emitted:
+                    continue
+                if self._find_pod(key) is pod:
+                    out.append(pod)
+                    emitted.add(key)
+        return out
+
+    def assignment(self, pod_key: str) -> str:
+        for layer in reversed(self._layers):
+            if pod_key in layer.assignments:
+                return layer.assignments[pod_key]
+            if pod_key in layer.removed_pods:
+                return ""
+        pod = self._find_pod(pod_key)
+        return pod.node_name if pod else ""
+
+    def pods_on_node(self, node_name: str) -> List[Pod]:
+        return [p for p in self.pods() if self.assignment(p.key()) == node_name]
+
+    def pending_pods(self) -> List[Pod]:
+        return [p for p in self.pods() if not self.assignment(p.key())]
+
+    # -- tensor materialization --------------------------------------------
+    def tensors(
+        self, group_of_node: Optional[Dict[str, str]] = None
+    ) -> Tuple[SnapshotTensors, SnapshotMeta]:
+        """Materialize the effective object state into padded device tensors.
+        Cached per (version, group map) — one pack per mutation generation."""
+        if (
+            self._cache is not None
+            and self._cache[0] == self._version
+            and self._cached_group_map == (group_of_node or {})
+        ):
+            return self._cache[1], self._cache[2]
+        pods = []
+        for pod in self.pods():
+            assigned = self.assignment(pod.key())
+            if assigned != pod.node_name:
+                pod = dataclasses.replace(pod, node_name=assigned)
+            pods.append(pod)
+        tensors, meta = pack(self.nodes(), pods, group_of_node)
+        self._cache = (self._version, tensors, meta)
+        self._cached_group_map = dict(group_of_node or {})
+        return tensors, meta

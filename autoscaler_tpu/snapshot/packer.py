@@ -1,0 +1,257 @@
+"""Object-model → dense-tensor flattener ("the packer").
+
+This is the host-side boundary of the TPU simulation engine: lists of
+Pod/Node dataclasses become one SnapshotTensors pytree per reconcile loop.
+The reference instead rebuilds a pointer-graph snapshot every loop
+(cluster-autoscaler/core/static_autoscaler.go:250 initializeClusterSnapshot);
+we rebuild a padded struct-of-arrays, amortizing one host→device transfer per
+loop instead of per predicate call.
+
+Non-resource scheduler predicates are *precomputed* here into a boolean
+[P, N] mask: taints/tolerations, nodeSelector, required node affinity,
+unschedulable flag, host-port conflicts, and required inter-pod
+(anti-)affinity evaluated against already-placed pods. That replaces the
+per-(pod,node) filter-plugin walk of the reference
+(simulator/predicatechecker/schedulerbased.go:109-163). The resource-fit
+predicate stays in the device kernel because node_used evolves during
+simulation.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from autoscaler_tpu.kube import objects as k8s
+from autoscaler_tpu.kube.objects import NUM_RESOURCES, Node, Pod
+from autoscaler_tpu.snapshot.tensors import SnapshotTensors, bucket_size
+
+import jax.numpy as jnp
+
+
+@dataclass
+class SnapshotMeta:
+    """Host-side companion to SnapshotTensors: names, objects, index maps.
+    Not a pytree — never crosses into traced code."""
+
+    nodes: List[Node] = field(default_factory=list)
+    pods: List[Pod] = field(default_factory=list)
+    node_index: Dict[str, int] = field(default_factory=dict)
+    pod_index: Dict[str, int] = field(default_factory=dict)
+    group_names: List[str] = field(default_factory=list)
+    group_index: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.nodes)
+
+    @property
+    def num_pods(self) -> int:
+        return len(self.pods)
+
+
+def resources_row(r: k8s.Resources, pods_count: float) -> np.ndarray:
+    row = np.array(r.as_tuple(), dtype=np.float32)
+    row[k8s.PODS] = pods_count
+    return row
+
+
+def _topology_domains(
+    nodes: Sequence[Node], topology_key: str
+) -> Tuple[np.ndarray, Dict[str, int]]:
+    """Map each node to an integer domain id for a topology key; -1 when the
+    node lacks the label (such nodes never satisfy the term)."""
+    domains: Dict[str, int] = {}
+    ids = np.full(len(nodes), -1, dtype=np.int64)
+    for i, node in enumerate(nodes):
+        val = node.labels.get(topology_key)
+        if val is None:
+            continue
+        ids[i] = domains.setdefault(val, len(domains))
+    return ids, domains
+
+
+def _term_matches_pod(term: k8s.PodAffinityTerm, pod: Pod, self_ns: str) -> bool:
+    namespaces = term.namespaces or (self_ns,)
+    return pod.namespace in namespaces and term.selector.matches(pod.labels)
+
+
+def compute_sched_mask(
+    nodes: Sequence[Node], pods: Sequence[Pod], node_of_pod: Sequence[int]
+) -> np.ndarray:
+    """[P, N] boolean precomputed predicate mask. node_of_pod[i] is the index
+    of the node pod i is placed on, -1 if pending."""
+    P, N = len(pods), len(nodes)
+    mask = np.ones((P, N), dtype=bool)
+
+    for j, node in enumerate(nodes):
+        if node.unschedulable:
+            mask[:, j] = False
+
+    # Taints/tolerations + nodeSelector + required node affinity.
+    for i, pod in enumerate(pods):
+        for j, node in enumerate(nodes):
+            if not mask[i, j]:
+                continue
+            if not k8s.pod_tolerates_taints(pod, node.taints):
+                mask[i, j] = False
+            elif not k8s.node_matches_selector(pod, node):
+                mask[i, j] = False
+
+    # Host-port conflicts (NodePorts filter plugin analog). Rows are computed
+    # for placed pods too so drain/rescheduling simulation sees conflicts; a
+    # pod never conflicts with its own port on its own node.
+    port_count: Dict[int, Dict[int, int]] = {}
+    for i, pod in enumerate(pods):
+        j = node_of_pod[i]
+        if j >= 0:
+            counts = port_count.setdefault(j, {})
+            for p in pod.host_ports:
+                counts[p] = counts.get(p, 0) + 1
+    for i, pod in enumerate(pods):
+        if not pod.host_ports:
+            continue
+        own = node_of_pod[i]
+        for j in range(N):
+            counts = port_count.get(j)
+            if not counts:
+                continue
+            self_contrib = 1 if j == own else 0
+            if any(counts.get(p, 0) > self_contrib for p in pod.host_ports):
+                mask[i, j] = False
+
+    # Required inter-pod (anti-)affinity vs already-placed pods, including the
+    # symmetric anti-affinity rule (an existing pod's anti-affinity keeps
+    # matching incomers out of its topology domain). Evaluated per topology
+    # key over integer domain ids — the reference pays a per-(pod,node) plugin
+    # walk here, its documented 1000x outlier (FAQ.md:151-153).
+    placed = [
+        (i, pods[i], node_of_pod[i]) for i in range(P) if node_of_pod[i] >= 0
+    ]
+    domain_cache: Dict[str, Tuple[np.ndarray, Dict[str, int]]] = {}
+
+    def domains_for(key: str):
+        if key not in domain_cache:
+            domain_cache[key] = _topology_domains(nodes, key)
+        return domain_cache[key]
+
+    for i, pod in enumerate(pods):
+        aff = pod.affinity
+        if aff is None:
+            continue
+        for term in aff.pod_affinity:
+            node_dom, _ = domains_for(term.topology_key)
+            ok_domains = {
+                node_dom[j]
+                for (_, q, j) in placed
+                if node_dom[j] >= 0 and _term_matches_pod(term, q, pod.namespace)
+            }
+            if _term_matches_pod(term, pod, pod.namespace):
+                # Kubernetes self-match rule: a pod may satisfy its own
+                # required affinity term, so the first pod of a self-affine
+                # group can land on any node with the topology label.
+                allowed = node_dom >= 0
+            else:
+                allowed = np.isin(node_dom, list(ok_domains)) & (node_dom >= 0)
+            mask[i] &= allowed
+        for term in aff.pod_anti_affinity:
+            node_dom, _ = domains_for(term.topology_key)
+            bad_domains = {
+                node_dom[j]
+                for (qi, q, j) in placed
+                if qi != i and node_dom[j] >= 0
+                and _term_matches_pod(term, q, pod.namespace)
+            }
+            if bad_domains:
+                mask[i] &= ~np.isin(node_dom, list(bad_domains))
+
+    # Symmetric anti-affinity from placed pods onto everyone (except the
+    # declaring pod itself — its own term must not evict it from the node it
+    # validly runs on).
+    for (qi, q, j) in placed:
+        if q.affinity is None:
+            continue
+        for term in q.affinity.pod_anti_affinity:
+            node_dom, _ = domains_for(term.topology_key)
+            if node_dom[j] < 0:
+                continue
+            in_domain = node_dom == node_dom[j]
+            for i, pod in enumerate(pods):
+                if i != qi and _term_matches_pod(term, pod, q.namespace):
+                    mask[i] &= ~in_domain
+    return mask
+
+
+def pack(
+    nodes: Sequence[Node],
+    pods: Sequence[Pod],
+    group_of_node: Optional[Dict[str, str]] = None,
+    pad_pods: Optional[int] = None,
+    pad_nodes: Optional[int] = None,
+) -> Tuple[SnapshotTensors, SnapshotMeta]:
+    """Flatten objects into a padded SnapshotTensors + host-side meta.
+
+    group_of_node: node name → node-group name (from the cloud provider's
+    NodeGroupForNode mapping, reference cloudprovider/cloud_provider.go:112).
+    """
+    meta = SnapshotMeta(nodes=list(nodes), pods=list(pods))
+    for i, node in enumerate(meta.nodes):
+        meta.node_index[node.name] = i
+    for i, pod in enumerate(meta.pods):
+        meta.pod_index[pod.key()] = i
+
+    group_of_node = group_of_node or {}
+    for g in group_of_node.values():
+        if g not in meta.group_index:
+            meta.group_index[g] = len(meta.group_names)
+            meta.group_names.append(g)
+
+    P, N = len(meta.pods), len(meta.nodes)
+    PP = pad_pods if pad_pods is not None else bucket_size(P)
+    NN = pad_nodes if pad_nodes is not None else bucket_size(N)
+    assert PP >= P and NN >= N, "padding must not truncate"
+    R = NUM_RESOURCES
+
+    node_alloc = np.zeros((NN, R), np.float32)
+    node_used = np.zeros((NN, R), np.float32)
+    node_valid = np.zeros((NN,), bool)
+    node_group = np.full((NN,), -1, np.int32)
+    pod_req = np.zeros((PP, R), np.float32)
+    pod_valid = np.zeros((PP,), bool)
+    pod_node = np.full((PP,), -1, np.int32)
+    sched_mask = np.zeros((PP, NN), bool)
+
+    node_of_pod = []
+    for i, pod in enumerate(meta.pods):
+        node_of_pod.append(meta.node_index.get(pod.node_name, -1) if pod.node_name else -1)
+
+    for j, node in enumerate(meta.nodes):
+        node_alloc[j] = resources_row(node.allocatable, node.allocatable.pods)
+        node_valid[j] = True
+        g = group_of_node.get(node.name)
+        if g is not None:
+            node_group[j] = meta.group_index[g]
+
+    for i, pod in enumerate(meta.pods):
+        pod_req[i] = resources_row(pod.requests, 1.0)
+        pod_valid[i] = True
+        j = node_of_pod[i]
+        pod_node[i] = j
+        if j >= 0:
+            node_used[j] += pod_req[i]
+
+    if P and N:
+        sched_mask[:P, :N] = compute_sched_mask(meta.nodes, meta.pods, node_of_pod)
+
+    tensors = SnapshotTensors(
+        node_alloc=jnp.asarray(node_alloc),
+        node_used=jnp.asarray(node_used),
+        node_valid=jnp.asarray(node_valid),
+        node_group=jnp.asarray(node_group),
+        pod_req=jnp.asarray(pod_req),
+        pod_valid=jnp.asarray(pod_valid),
+        pod_node=jnp.asarray(pod_node),
+        sched_mask=jnp.asarray(sched_mask),
+    )
+    return tensors, meta

@@ -1,0 +1,116 @@
+"""Dense-tensor cluster state — the device-side replacement for the
+reference's ClusterSnapshot graph of NodeInfo pointers.
+
+Reference: cluster-autoscaler/simulator/clustersnapshot/clustersnapshot.go:29
+defines AddNode/AddPod/Fork/Revert/Commit over a pointer graph; the delta
+implementation (delta.go:43) exists to make Fork O(1) and Commit O(delta).
+Here cluster state is a struct of immutable dense arrays (a JAX pytree), so
+"fork" is passing the same arrays into another traced call and "commit" is
+using the returned arrays — the O(1) fork falls out of functional purity
+instead of a layered-cache design.
+
+Shapes are bucketed (padded) so jit does not recompile per cluster size:
+`pod_valid` / `node_valid` mask out padding rows.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from autoscaler_tpu.kube.objects import NUM_RESOURCES
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class SnapshotTensors:
+    """Struct-of-arrays cluster snapshot.
+
+    P = padded pod count, N = padded node count, R = NUM_RESOURCES.
+
+    - node_alloc:  [N, R] f32 — allocatable capacity per node
+    - node_used:   [N, R] f32 — sum of requests of pods assigned to the node
+    - node_valid:  [N]    bool — real row (not padding)
+    - node_group:  [N]    i32  — node-group id, -1 if none
+    - pod_req:     [P, R] f32 — per-pod resource requests (pods axis == 1)
+    - pod_valid:   [P]    bool
+    - pod_node:    [P]    i32  — node index the pod is scheduled on, -1 pending
+    - sched_mask:  [P, N] bool — precomputed non-resource predicates
+      (taints/tolerations, nodeSelector, required node affinity, static
+      inter-pod (anti-)affinity vs. already-placed pods, unschedulable flag);
+      replaces the reference's RunPreFilterPlugins/RunFilterPlugins walk
+      (cluster-autoscaler/simulator/predicatechecker/schedulerbased.go:152-163)
+      for everything except the resource-fit arithmetic, which stays dynamic in
+      the fit kernel because node_used changes during simulation.
+    """
+
+    node_alloc: jax.Array
+    node_used: jax.Array
+    node_valid: jax.Array
+    node_group: jax.Array
+    pod_req: jax.Array
+    pod_valid: jax.Array
+    pod_node: jax.Array
+    sched_mask: jax.Array
+
+    @property
+    def num_nodes(self) -> int:
+        return self.node_alloc.shape[0]
+
+    @property
+    def num_pods(self) -> int:
+        return self.pod_req.shape[0]
+
+    def free(self) -> jax.Array:
+        """[N, R] remaining capacity (alloc - used), zero on padding rows."""
+        return jnp.where(
+            self.node_valid[:, None], self.node_alloc - self.node_used, 0.0
+        )
+
+    def schedule_pod(self, pod_idx: jax.Array, node_idx: jax.Array) -> "SnapshotTensors":
+        """Functionally assign pod→node, updating node_used. Traceable."""
+        req = self.pod_req[pod_idx]
+        return dataclasses.replace(
+            self,
+            node_used=self.node_used.at[node_idx].add(req),
+            pod_node=self.pod_node.at[pod_idx].set(node_idx),
+        )
+
+    def unschedule_pod(self, pod_idx: jax.Array) -> "SnapshotTensors":
+        node_idx = self.pod_node[pod_idx]
+        req = self.pod_req[pod_idx]
+        valid = node_idx >= 0
+        safe = jnp.where(valid, node_idx, 0)
+        new_used = self.node_used.at[safe].add(
+            jnp.where(valid, -req, jnp.zeros_like(req))
+        )
+        return dataclasses.replace(
+            self,
+            node_used=new_used,
+            pod_node=self.pod_node.at[pod_idx].set(-1),
+        )
+
+
+def bucket_size(n: int, minimum: int = 8) -> int:
+    """Round n up to the next power of two (>= minimum) so traced shapes come
+    from a small set and jit caches stay warm across cluster-size drift."""
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+def empty_snapshot(num_pods: int, num_nodes: int) -> SnapshotTensors:
+    P, N, R = num_pods, num_nodes, NUM_RESOURCES
+    return SnapshotTensors(
+        node_alloc=jnp.zeros((N, R), jnp.float32),
+        node_used=jnp.zeros((N, R), jnp.float32),
+        node_valid=jnp.zeros((N,), bool),
+        node_group=jnp.full((N,), -1, jnp.int32),
+        pod_req=jnp.zeros((P, R), jnp.float32),
+        pod_valid=jnp.zeros((P,), bool),
+        pod_node=jnp.full((P,), -1, jnp.int32),
+        sched_mask=jnp.zeros((P, N), bool),
+    )

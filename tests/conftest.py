@@ -1,0 +1,11 @@
+"""Test harness config: force an 8-device virtual CPU platform so sharding
+tests exercise a real Mesh without TPU hardware (multi-chip is validated by
+the driver via __graft_entry__.dryrun_multichip the same way)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

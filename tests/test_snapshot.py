@@ -1,0 +1,242 @@
+"""Snapshot tests: fork/revert/commit semantics (modeled on the reference's
+cluster-autoscaler/simulator/clustersnapshot/clustersnapshot_test.go) plus
+packer/mask correctness for taints, selectors, and (anti-)affinity."""
+import numpy as np
+import pytest
+
+from autoscaler_tpu.kube.objects import (
+    CPU,
+    MEMORY,
+    PODS,
+    Taint,
+    Toleration,
+)
+from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot, SnapshotError
+from autoscaler_tpu.snapshot.packer import pack
+from autoscaler_tpu.utils.test_utils import (
+    MB,
+    anti_affinity,
+    build_test_node,
+    build_test_pod,
+    pod_affinity,
+)
+
+
+def test_pack_shapes_and_padding():
+    nodes = [build_test_node(f"n{i}") for i in range(3)]
+    pods = [build_test_pod(f"p{i}") for i in range(5)]
+    t, meta = pack(nodes, pods)
+    assert t.num_nodes >= 3 and t.num_pods >= 5
+    assert int(t.node_valid.sum()) == 3
+    assert int(t.pod_valid.sum()) == 5
+    # power-of-two bucketing
+    assert t.num_nodes == 8 and t.num_pods == 8
+
+
+def test_pack_used_accounting():
+    nodes = [build_test_node("n0", cpu_m=1000)]
+    pods = [
+        build_test_pod("p0", cpu_m=300, node_name="n0"),
+        build_test_pod("p1", cpu_m=200, node_name="n0"),
+        build_test_pod("p2", cpu_m=100),  # pending
+    ]
+    t, meta = pack(nodes, pods)
+    j = meta.node_index["n0"]
+    assert t.node_used[j, CPU] == pytest.approx(500)
+    assert t.node_used[j, PODS] == pytest.approx(2)
+    free = np.asarray(t.free())
+    assert free[j, CPU] == pytest.approx(500)
+    assert int(t.pod_node[meta.pod_index["default/p2"]]) == -1
+
+
+def test_mask_taints_and_tolerations():
+    tainted = build_test_node("tainted", taints=[Taint("dedicated", "gpu")])
+    clean = build_test_node("clean")
+    tol = build_test_pod("tol", tolerations=[Toleration(key="dedicated", value="gpu")])
+    plain = build_test_pod("plain")
+    t, meta = pack([tainted, clean], [tol, plain])
+    m = np.asarray(t.sched_mask)
+    ti, ci = meta.node_index["tainted"], meta.node_index["clean"]
+    assert m[meta.pod_index["default/tol"], ti]
+    assert not m[meta.pod_index["default/plain"], ti]
+    assert m[meta.pod_index["default/plain"], ci]
+
+
+def test_mask_node_selector():
+    gpu_node = build_test_node("gpu", labels={"accel": "tpu"})
+    cpu_node = build_test_node("cpu")
+    pod = build_test_pod("p", node_selector={"accel": "tpu"})
+    t, meta = pack([gpu_node, cpu_node], [pod])
+    m = np.asarray(t.sched_mask)
+    assert m[0, meta.node_index["gpu"]]
+    assert not m[0, meta.node_index["cpu"]]
+
+
+def test_mask_anti_affinity_against_placed():
+    n0, n1 = build_test_node("n0"), build_test_node("n1")
+    placed = build_test_pod("placed", labels={"app": "db"}, node_name="n0")
+    incoming = build_test_pod("in", affinity=anti_affinity({"app": "db"}))
+    t, meta = pack([n0, n1], [placed, incoming])
+    m = np.asarray(t.sched_mask)
+    i = meta.pod_index["default/in"]
+    assert not m[i, meta.node_index["n0"]]
+    assert m[i, meta.node_index["n1"]]
+
+
+def test_mask_symmetric_anti_affinity():
+    # the *placed* pod declares anti-affinity; the incoming pod matches it
+    n0, n1 = build_test_node("n0"), build_test_node("n1")
+    placed = build_test_pod(
+        "placed", node_name="n0", affinity=anti_affinity({"app": "web"})
+    )
+    incoming = build_test_pod("in", labels={"app": "web"})
+    t, meta = pack([n0, n1], [placed, incoming])
+    m = np.asarray(t.sched_mask)
+    i = meta.pod_index["default/in"]
+    assert not m[i, meta.node_index["n0"]]
+    assert m[i, meta.node_index["n1"]]
+
+
+def test_mask_pod_affinity():
+    n0, n1 = build_test_node("n0"), build_test_node("n1")
+    placed = build_test_pod("placed", labels={"app": "cache"}, node_name="n1")
+    incoming = build_test_pod("in", affinity=pod_affinity({"app": "cache"}))
+    t, meta = pack([n0, n1], [placed, incoming])
+    m = np.asarray(t.sched_mask)
+    i = meta.pod_index["default/in"]
+    assert m[i, meta.node_index["n1"]]
+    assert not m[i, meta.node_index["n0"]]
+
+
+def test_mask_unschedulable_node():
+    n = build_test_node("n0")
+    n.unschedulable = True
+    t, meta = pack([n], [build_test_pod("p")])
+    assert not np.asarray(t.sched_mask)[0, 0]
+
+
+class TestClusterSnapshot:
+    def test_add_and_list(self):
+        s = ClusterSnapshot()
+        s.add_node(build_test_node("n0"))
+        s.add_pod(build_test_pod("p0"), "n0")
+        assert [n.name for n in s.nodes()] == ["n0"]
+        assert s.assignment("default/p0") == "n0"
+
+    def test_fork_revert(self):
+        s = ClusterSnapshot()
+        s.add_node(build_test_node("n0"))
+        s.fork()
+        s.add_node(build_test_node("n1"))
+        s.add_pod(build_test_pod("p0"), "n1")
+        assert len(s.nodes()) == 2
+        s.revert()
+        assert [n.name for n in s.nodes()] == ["n0"]
+        assert s.pods() == []
+
+    def test_fork_commit(self):
+        s = ClusterSnapshot()
+        s.add_node(build_test_node("n0"))
+        s.fork()
+        s.add_node(build_test_node("n1"))
+        s.commit()
+        assert len(s.nodes()) == 2
+        assert s.fork_depth == 0
+
+    def test_nested_forks(self):
+        s = ClusterSnapshot()
+        s.add_node(build_test_node("n0"))
+        s.fork()
+        s.add_node(build_test_node("n1"))
+        s.fork()
+        s.add_node(build_test_node("n2"))
+        assert len(s.nodes()) == 3
+        s.revert()
+        assert len(s.nodes()) == 2
+        s.commit()
+        assert len(s.nodes()) == 2
+        assert s.get_node("n1") is not None
+
+    def test_remove_in_fork_then_revert(self):
+        s = ClusterSnapshot()
+        s.add_node(build_test_node("n0"))
+        s.add_pod(build_test_pod("p0"), "n0")
+        s.fork()
+        s.remove_node("n0")
+        assert s.nodes() == [] and s.pods() == []
+        s.revert()
+        assert len(s.nodes()) == 1 and len(s.pods()) == 1
+
+    def test_duplicate_add_raises(self):
+        s = ClusterSnapshot()
+        s.add_node(build_test_node("n0"))
+        with pytest.raises(SnapshotError):
+            s.add_node(build_test_node("n0"))
+
+    def test_schedule_pending_pod(self):
+        s = ClusterSnapshot()
+        s.add_node(build_test_node("n0"))
+        s.add_pod(build_test_pod("p0"))
+        assert len(s.pending_pods()) == 1
+        s.schedule_pod("default/p0", "n0")
+        assert s.pending_pods() == []
+        assert s.pods_on_node("n0")[0].name == "p0"
+
+    def test_tensor_cache_invalidation(self):
+        s = ClusterSnapshot()
+        s.add_node(build_test_node("n0", cpu_m=1000))
+        t1, m1 = s.tensors()
+        t2, m2 = s.tensors()
+        assert t1 is t2  # cached
+        s.add_pod(build_test_pod("p0", cpu_m=100), "n0")
+        t3, m3 = s.tensors()
+        assert t3 is not t1
+        assert float(t3.node_used[m3.node_index["n0"], CPU]) == pytest.approx(100)
+
+    def test_tensors_reflect_fork_assignment(self):
+        s = ClusterSnapshot()
+        s.add_node(build_test_node("n0", cpu_m=1000))
+        s.add_pod(build_test_pod("p0", cpu_m=250))
+        s.fork()
+        s.schedule_pod("default/p0", "n0")
+        t, meta = s.tensors()
+        assert float(t.node_used[meta.node_index["n0"], CPU]) == pytest.approx(250)
+        s.revert()
+        t, meta = s.tensors()
+        assert float(t.node_used[meta.node_index["n0"], CPU]) == pytest.approx(0)
+
+
+def test_mask_host_port_conflict_for_placed_pod():
+    # a placed hostPort pod must see conflicts on OTHER nodes (drain refit
+    # path) but never conflict with itself on its own node
+    n0, n1 = build_test_node("n0"), build_test_node("n1")
+    p1 = build_test_pod("p1", node_name="n0")
+    p1.host_ports = (80,)
+    p2 = build_test_pod("p2", node_name="n1")
+    p2.host_ports = (80,)
+    t, meta = pack([n0, n1], [p1, p2])
+    m = np.asarray(t.sched_mask)
+    i1, i2 = meta.pod_index["default/p1"], meta.pod_index["default/p2"]
+    j0, j1 = meta.node_index["n0"], meta.node_index["n1"]
+    assert m[i1, j0] and m[i2, j1]      # each fine where it runs
+    assert not m[i1, j1] and not m[i2, j0]  # conflict across
+
+
+def test_mask_pod_affinity_self_match():
+    # first pod of a self-affine group must be schedulable (k8s self-match rule)
+    n0 = build_test_node("n0")
+    p = build_test_pod("p", labels={"app": "db"}, affinity=pod_affinity({"app": "db"}))
+    t, meta = pack([n0], [p])
+    assert np.asarray(t.sched_mask)[0, meta.node_index["n0"]]
+
+
+def test_mask_symmetric_anti_affinity_not_self():
+    # a placed pod whose anti-affinity matches its own labels stays valid on
+    # its own node
+    n0 = build_test_node("n0")
+    p = build_test_pod(
+        "p", labels={"app": "web"}, node_name="n0",
+        affinity=anti_affinity({"app": "web"}),
+    )
+    t, meta = pack([n0], [p])
+    assert np.asarray(t.sched_mask)[0, meta.node_index["n0"]]
