@@ -1,0 +1,27 @@
+"""Estimation limiter — caps how much a single estimate may explore.
+
+Reference: cluster-autoscaler/estimator/estimator.go:63 (EstimationLimiter
+interface) and threshold_based_limiter.go (max node count + max duration per
+node group; the 10s/group budget of main.go:216). In the TPU design the node
+cap becomes the static `max_nodes` shape of the scan carry, and the duration
+budget bounds the *host-side* dispatch, not an inner loop — one batched
+dispatch covers all groups, so the per-group time budget is naturally met.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ThresholdBasedEstimationLimiter:
+    max_nodes: int = 1000        # reference default --max-nodes-per-scaleup
+    max_duration_s: float = 10.0  # reference default --max-nodegroup-binpacking-duration
+
+    def node_cap(self, group_max_size_headroom: int) -> int:
+        """Effective static cap for the scan: min of the limiter threshold and
+        the group's remaining size headroom; never below 1 so shapes stay
+        valid (a 0-headroom group is filtered before estimation)."""
+        cap = self.max_nodes
+        if group_max_size_headroom > 0:
+            cap = min(cap, group_max_size_headroom)
+        return max(cap, 1)

@@ -1,0 +1,72 @@
+"""Serial host-side FFD — the parity oracle and bench baseline.
+
+This mirrors the *algorithmic structure* of the reference's Go
+BinpackingNodeEstimator (cluster-autoscaler/estimator/binpacking_estimator.go:
+65-141: score-sort, first-fit over open template nodes, open-on-miss) in
+plain numpy, serving two jobs:
+
+1. Parity tests: the TPU scan in ops/binpack.py must agree with this oracle
+   exactly (same counts, same scheduled sets) on identical inputs.
+2. bench.py baseline: a faithful stand-in for the reference's serial
+   per-pod × per-node × per-group hot loop when measuring TPU speedup
+   (the reference itself is Go and not runnable in this environment).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from autoscaler_tpu.kube.objects import CPU, MEMORY
+
+
+def ffd_binpack_reference(
+    pod_req: np.ndarray,         # [P, R]
+    pod_mask: np.ndarray,        # [P] bool
+    template_alloc: np.ndarray,  # [R]
+    max_nodes: int,
+) -> Tuple[int, np.ndarray]:
+    """Returns (node_count, scheduled[P] bool)."""
+    P = pod_req.shape[0]
+    cpu_cap = template_alloc[CPU]
+    mem_cap = template_alloc[MEMORY]
+    score = np.zeros(P, np.float32)
+    if cpu_cap > 0:
+        score += pod_req[:, CPU] / cpu_cap
+    if mem_cap > 0:
+        score += pod_req[:, MEMORY] / mem_cap
+    order = np.argsort(-score, kind="stable")
+
+    used: list = []  # per-open-node usage vectors, in open order
+    scheduled = np.zeros(P, bool)
+    for i in order:
+        if not pod_mask[i]:
+            continue
+        req = pod_req[i]
+        placed = False
+        for u in used:  # first-fit in open order
+            if np.all(req <= template_alloc - u):
+                u += req
+                placed = True
+                break
+        if not placed and len(used) < max_nodes and np.all(req <= template_alloc):
+            used.append(req.astype(np.float64).copy())
+            placed = True
+        scheduled[i] = placed
+    return len(used), scheduled
+
+
+def ffd_binpack_reference_groups(
+    pod_req: np.ndarray,          # [P, R]
+    pod_masks: np.ndarray,        # [G, P]
+    template_allocs: np.ndarray,  # [G, R]
+    max_nodes: int,
+):
+    """The serial outer loop over node groups, as the reference runs it
+    (core/scaleup/orchestrator/orchestrator.go:139-179)."""
+    counts, scheds = [], []
+    for g in range(template_allocs.shape[0]):
+        c, s = ffd_binpack_reference(pod_req, pod_masks[g], template_allocs[g], max_nodes)
+        counts.append(c)
+        scheds.append(s)
+    return np.array(counts), np.stack(scheds)

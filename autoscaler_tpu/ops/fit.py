@@ -1,0 +1,53 @@
+"""The predicate-fit kernel: the batched replacement for the reference's
+per-(pod,node) scheduler-framework walk.
+
+Reference: cluster-autoscaler/simulator/predicatechecker/schedulerbased.go:109-163
+runs RunPreFilterPlugins + RunFilterPlugins serially per pod per candidate
+node (the [HOT HOT HOT] loop of SURVEY.md §3.3), with a round-robin start
+index to spread load. Here the entire (pod × node) space is one fused
+elementwise reduction on the VPU:
+
+    fits[P, N] = all_r(pod_req[P, r] <= free[N, r]) & sched_mask[P, N]
+
+Non-resource predicates were precomputed into sched_mask by the packer; the
+resource comparison stays dynamic because node_used evolves during simulation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from autoscaler_tpu.snapshot.tensors import SnapshotTensors
+
+
+def fit_matrix(snap: SnapshotTensors) -> jax.Array:
+    """[P, N] bool — pod i fits node j right now (capacity + predicates).
+    Padding rows/cols are False."""
+    free = snap.free()  # [N, R], 0 on invalid rows
+    fits = jnp.all(snap.pod_req[:, None, :] <= free[None, :, :], axis=-1)
+    return (
+        fits
+        & snap.sched_mask
+        & snap.pod_valid[:, None]
+        & snap.node_valid[None, :]
+    )
+
+
+def fits_any_node(snap: SnapshotTensors) -> jax.Array:
+    """[P] bool — the FitsAnyNodeMatching analog
+    (reference: simulator/predicatechecker/schedulerbased.go:90)."""
+    return fit_matrix(snap).any(axis=1)
+
+
+def first_fit_node(snap: SnapshotTensors) -> jax.Array:
+    """[P] i32 — lowest-index node each pod fits on, -1 if none. This is the
+    deterministic analog of CheckPredicates over a candidate list; callers
+    that place pods must re-fit after each placement (see ops/binpack.py for
+    the sequential-correct scan)."""
+    fits = fit_matrix(snap)
+    idx = jnp.argmax(fits, axis=1).astype(jnp.int32)
+    return jnp.where(fits.any(axis=1), idx, -1)
+
+
+fit_matrix_jit = jax.jit(fit_matrix)
+fits_any_node_jit = jax.jit(fits_any_node)

@@ -51,8 +51,17 @@ class SnapshotMeta:
         return len(self.pods)
 
 
+_MIB = float(1024 * 1024)
+
+
 def resources_row(r: k8s.Resources, pods_count: float) -> np.ndarray:
+    """Resources → dense f32 row. Memory/ephemeral are stored in MiB inside
+    tensors (object model keeps bytes): byte counts up to tens of GiB exceed
+    f32's 24-bit mantissa, and accumulated rounding could make a pod falsely
+    fit by a few KiB; MiB keeps sums exact for any realistic cluster."""
     row = np.array(r.as_tuple(), dtype=np.float32)
+    row[k8s.MEMORY] = r.memory / _MIB
+    row[k8s.EPHEMERAL] = r.ephemeral / _MIB
     row[k8s.PODS] = pods_count
     return row
 

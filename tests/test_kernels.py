@@ -1,0 +1,175 @@
+"""Kernel parity tests: the TPU fit/binpack kernels must agree exactly with
+the serial numpy oracle (which mirrors the reference Go algorithm's
+structure — see autoscaler_tpu/estimator/reference_impl.py). Modeled on the
+reference's estimator/binpacking_estimator_test.go fixtures."""
+import numpy as np
+import pytest
+
+from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+from autoscaler_tpu.estimator.limiter import ThresholdBasedEstimationLimiter
+from autoscaler_tpu.estimator.reference_impl import (
+    ffd_binpack_reference,
+    ffd_binpack_reference_groups,
+)
+from autoscaler_tpu.kube.objects import CPU, MEMORY, PODS, Taint, Toleration
+from autoscaler_tpu.ops.binpack import ffd_binpack, ffd_binpack_groups
+from autoscaler_tpu.ops.fit import fit_matrix, fits_any_node
+from autoscaler_tpu.snapshot.packer import pack
+from autoscaler_tpu.utils.test_utils import MB, build_test_node, build_test_pod
+
+import jax.numpy as jnp
+
+
+def rand_workload(rng, P, R=6, cpu_cap=4000.0, mem_cap=8192.0):
+    req = np.zeros((P, R), np.float32)
+    req[:, CPU] = rng.integers(50, 1500, P)
+    req[:, MEMORY] = rng.integers(64, 4096, P)
+    req[:, PODS] = 1.0
+    alloc = np.zeros(R, np.float32)
+    alloc[CPU] = cpu_cap
+    alloc[MEMORY] = mem_cap
+    alloc[PODS] = 110.0
+    return req, alloc
+
+
+class TestFitKernel:
+    def test_fit_matrix_basic(self):
+        nodes = [build_test_node("big", cpu_m=4000), build_test_node("small", cpu_m=200)]
+        pods = [build_test_pod("p", cpu_m=1000)]
+        t, meta = pack(nodes, pods)
+        m = np.asarray(fit_matrix(t))
+        assert m[0, meta.node_index["big"]]
+        assert not m[0, meta.node_index["small"]]
+        # padding rows all False
+        assert not m[1:].any()
+
+    def test_fit_respects_usage(self):
+        nodes = [build_test_node("n", cpu_m=1000)]
+        pods = [
+            build_test_pod("placed", cpu_m=800, node_name="n"),
+            build_test_pod("pending", cpu_m=300),
+        ]
+        t, meta = pack(nodes, pods)
+        assert not bool(fits_any_node(t)[meta.pod_index["default/pending"]])
+
+    def test_fit_respects_mask(self):
+        nodes = [build_test_node("n", cpu_m=4000, taints=[Taint("key", "v")])]
+        pods = [build_test_pod("p", cpu_m=100)]
+        t, _ = pack(nodes, pods)
+        assert not bool(fits_any_node(t)[0])
+
+
+class TestBinpackParity:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    @pytest.mark.parametrize("P", [16, 64, 256])
+    def test_random_parity(self, seed, P):
+        rng = np.random.default_rng(seed)
+        req, alloc = rand_workload(rng, P)
+        mask = rng.random(P) > 0.1
+        ref_count, ref_sched = ffd_binpack_reference(req, mask, alloc, max_nodes=64)
+        res = ffd_binpack(jnp.asarray(req), jnp.asarray(mask), jnp.asarray(alloc), max_nodes=64)
+        assert int(res.node_count) == ref_count
+        np.testing.assert_array_equal(np.asarray(res.scheduled), ref_sched)
+
+    def test_node_cap_limits(self):
+        rng = np.random.default_rng(7)
+        req, alloc = rand_workload(rng, 128)
+        mask = np.ones(128, bool)
+        ref_count, ref_sched = ffd_binpack_reference(req, mask, alloc, max_nodes=5)
+        res = ffd_binpack(
+            jnp.asarray(req), jnp.asarray(mask), jnp.asarray(alloc),
+            max_nodes=64, node_cap=jnp.int32(5),
+        )
+        assert int(res.node_count) == ref_count == 5
+        np.testing.assert_array_equal(np.asarray(res.scheduled), ref_sched)
+
+    def test_oversized_pod_skipped(self):
+        req = np.zeros((2, 6), np.float32)
+        req[0, CPU] = 99999  # bigger than any template node
+        req[1, CPU] = 100
+        alloc = np.zeros(6, np.float32)
+        alloc[CPU] = 1000
+        alloc[PODS] = 10
+        req[:, PODS] = 1
+        mask = np.ones(2, bool)
+        res = ffd_binpack(jnp.asarray(req), jnp.asarray(mask), jnp.asarray(alloc), max_nodes=8)
+        assert int(res.node_count) == 1
+        assert list(np.asarray(res.scheduled)) == [False, True]
+
+    def test_groups_parity(self):
+        rng = np.random.default_rng(11)
+        P, G = 128, 7
+        req, _ = rand_workload(rng, P)
+        allocs = np.zeros((G, 6), np.float32)
+        allocs[:, CPU] = rng.integers(2000, 16000, G)
+        allocs[:, MEMORY] = rng.integers(4096, 32768, G)
+        allocs[:, PODS] = 110
+        masks = rng.random((G, P)) > 0.2
+        ref_counts, ref_scheds = ffd_binpack_reference_groups(req, masks, allocs, max_nodes=32)
+        res = ffd_binpack_groups(
+            jnp.asarray(req), jnp.asarray(masks), jnp.asarray(allocs), max_nodes=32
+        )
+        np.testing.assert_array_equal(np.asarray(res.node_count), ref_counts)
+        np.testing.assert_array_equal(np.asarray(res.scheduled), ref_scheds)
+
+    def test_per_group_caps(self):
+        rng = np.random.default_rng(13)
+        P, G = 64, 3
+        req, alloc = rand_workload(rng, P)
+        allocs = np.tile(alloc, (G, 1))
+        masks = np.ones((G, P), bool)
+        caps = np.array([2, 8, 32], np.int32)
+        res = ffd_binpack_groups(
+            jnp.asarray(req), jnp.asarray(masks), jnp.asarray(allocs),
+            max_nodes=32, node_caps=jnp.asarray(caps),
+        )
+        counts = np.asarray(res.node_count)
+        for g in range(G):
+            ref_c, ref_s = ffd_binpack_reference(req, masks[g], allocs[g], max_nodes=int(caps[g]))
+            assert counts[g] == ref_c
+            np.testing.assert_array_equal(np.asarray(res.scheduled)[g], ref_s)
+
+
+class TestEstimatorAPI:
+    def test_estimate_fixture(self):
+        # the reference's canonical fixture shape: identical nginx-ish pods
+        # onto one group (estimator/binpacking_estimator_test.go)
+        pods = [build_test_pod(f"p{i}", cpu_m=350, mem=700 * MB) for i in range(10)]
+        template = build_test_node("template", cpu_m=1000, mem=2000 * MB)
+        est = BinpackingNodeEstimator()
+        count, scheduled = est.estimate(pods, template)
+        # 2 per node by cpu (350*2=700<=1000, *3=1050>1000) → 5 nodes
+        assert count == 5
+        assert len(scheduled) == 10
+
+    def test_estimate_respects_taints(self):
+        pods = [build_test_pod("p", cpu_m=100)]
+        template = build_test_node("t", taints=[Taint("dedicated", "x")])
+        count, scheduled = est_count = BinpackingNodeEstimator().estimate(pods, template)
+        assert count == 0 and scheduled == []
+
+    def test_estimate_many(self):
+        pods = [build_test_pod(f"p{i}", cpu_m=500, mem=500 * MB) for i in range(8)]
+        templates = {
+            "small": build_test_node("small-t", cpu_m=1000, mem=2000 * MB),
+            "big": build_test_node("big-t", cpu_m=4000, mem=8000 * MB),
+        }
+        est = BinpackingNodeEstimator()
+        out = est.estimate_many(pods, templates)
+        assert out["small"][0] == 4   # 2 pods per small node
+        assert out["big"][0] == 1     # all 8 fit one big node
+        assert len(out["big"][1]) == 8
+
+    def test_estimate_many_headroom(self):
+        pods = [build_test_pod(f"p{i}", cpu_m=900) for i in range(6)]
+        templates = {"g": build_test_node("t", cpu_m=1000)}
+        est = BinpackingNodeEstimator(ThresholdBasedEstimationLimiter(max_nodes=1000))
+        out = est.estimate_many(pods, templates, headrooms={"g": 2})
+        count, scheduled = out["g"]
+        assert count == 2 and len(scheduled) == 2
+
+    def test_limiter_default_cap(self):
+        lim = ThresholdBasedEstimationLimiter(max_nodes=10)
+        assert lim.node_cap(0) == 10
+        assert lim.node_cap(3) == 3
+        assert lim.node_cap(50) == 10
