@@ -1,0 +1,127 @@
+"""Device-mesh parallelism for multi-scenario what-if evaluation.
+
+The reference evaluates one snapshot at a time (Fork/Revert on a single
+in-memory snapshot, cluster-autoscaler/simulator/clustersnapshot/delta.go:
+448-469) and loops serially over node groups. Here the two embarrassingly
+parallel axes of the decision problem become mesh axes:
+
+- ``scenario`` — independent what-if worlds (spot-pricing scenarios, candidate
+  futures; BASELINE config #5's 8-scenario pmap) — the data-parallel axis.
+- ``group`` — node groups whose expansion options are independent until the
+  final expander reduction — the model-parallel axis; the cross-group argmin
+  (the expander's BestOption, reference expander/expander.go:52) is the one
+  collective, an all_gather over ICI.
+
+shard_map + jax.sharding.Mesh so the same code runs on 1 chip, a v5e-8 ICI
+mesh, or multi-host DCN meshes — XLA inserts the collectives.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from autoscaler_tpu.kube.objects import PODS
+from autoscaler_tpu.ops.binpack import ffd_binpack_groups
+
+UNSCHEDULED_PENALTY = 1.0e6  # cost per pod left pending, dominates node price
+
+
+def factor_mesh(n: int) -> tuple[int, int]:
+    """Split n devices into (scenario, group) dims, group dim = largest
+    divisor <= sqrt(n) so both axes get parallelism when possible."""
+    g = 1
+    for d in range(int(n**0.5), 0, -1):
+        if n % d == 0:
+            g = d
+            break
+    return n // g, g
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    s, g = factor_mesh(len(devices))
+    return Mesh(np.asarray(devices).reshape(s, g), ("scenario", "group"))
+
+
+class WhatIfResult(NamedTuple):
+    node_counts: jax.Array   # [S, G] i32 — nodes needed per scenario × group
+    total_costs: jax.Array   # [S, G] f32 — price·count + penalty·unscheduled
+    best_group: jax.Array    # [S] i32 — expander argmin per scenario
+    best_cost: jax.Array     # [S] f32
+
+
+def _whatif_local(pod_req, pod_masks, allocs, prices, caps, *, max_nodes, group_axis):
+    """Per-shard body: batched FFD over the local (scenario, group) block,
+    then the expander reduction with an all_gather across the group axis."""
+    S_loc = allocs.shape[0]
+
+    def per_scenario(alloc_s, price_s):
+        res = ffd_binpack_groups(pod_req, pod_masks, alloc_s, max_nodes=max_nodes, node_caps=caps)
+        valid = pod_req[:, PODS] > 0  # real pods carry a pods-count of 1
+        pending = jnp.sum(valid) - jnp.sum(res.scheduled & valid[None, :], axis=1)
+        cost = price_s * res.node_count.astype(jnp.float32) + UNSCHEDULED_PENALTY * pending.astype(
+            jnp.float32
+        )
+        return res.node_count, cost
+
+    counts, costs = jax.vmap(per_scenario)(allocs, prices)  # [S_loc, G_loc]
+
+    if group_axis is None:
+        all_costs = costs
+        base = 0
+    else:
+        gathered = jax.lax.all_gather(costs, group_axis)      # [g_dim, S_loc, G_loc]
+        all_costs = jnp.transpose(gathered, (1, 0, 2)).reshape(S_loc, -1)
+        base = 0  # indices in all_costs are already global (block-ordered)
+    best = jnp.argmin(all_costs, axis=1).astype(jnp.int32) + base
+    best_cost = jnp.min(all_costs, axis=1)
+    return counts, costs, best, best_cost
+
+
+def whatif_best_options(
+    mesh: Mesh,
+    pod_req: jax.Array,      # [P, R] shared pending pods
+    pod_masks: jax.Array,    # [G, P] per-group predicate masks (shared across scenarios)
+    allocs: jax.Array,       # [S, G, R] per-scenario template capacities
+    prices: jax.Array,       # [S, G] per-scenario per-group node price
+    caps: jax.Array,         # [G] i32 per-group node caps
+    max_nodes: int,
+) -> WhatIfResult:
+    """Full multi-scenario scale-up evaluation, sharded over the mesh.
+
+    S must divide by mesh['scenario'], G by mesh['group'] (pad upstream).
+    """
+    s_dim = mesh.shape["scenario"]
+    g_dim = mesh.shape["group"]
+    S, G = allocs.shape[0], allocs.shape[1]
+    assert S % s_dim == 0, f"S={S} not divisible by scenario dim {s_dim}"
+    assert G % g_dim == 0, f"G={G} not divisible by group dim {g_dim}"
+
+    fn = functools.partial(
+        _whatif_local, max_nodes=max_nodes, group_axis="group" if g_dim > 1 else None
+    )
+    mapped = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(
+            P(None, None),            # pod_req replicated
+            P("group", None),         # masks split over groups
+            P("scenario", "group", None),
+            P("scenario", "group"),
+            P("group",),
+        ),
+        out_specs=(
+            P("scenario", "group"),   # counts
+            P("scenario", "group"),   # costs
+            P("scenario"),            # best group (global index)
+            P("scenario"),            # best cost
+        ),
+        check_vma=False,
+    )
+    counts, costs, best, best_cost = mapped(pod_req, pod_masks, allocs, prices, caps)
+    return WhatIfResult(counts, costs, best, best_cost)

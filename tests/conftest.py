@@ -1,11 +1,21 @@
 """Test harness config: force an 8-device virtual CPU platform so sharding
 tests exercise a real Mesh without TPU hardware (multi-chip is validated by
-the driver via __graft_entry__.dryrun_multichip the same way)."""
+the driver via __graft_entry__.dryrun_multichip the same way).
+
+The environment pins JAX_PLATFORMS=axon (the real-TPU tunnel) and pytest
+plugins (jaxtyping) import jax before this conftest runs, so mutating
+os.environ alone is too late — jax.config.update still works because
+backends initialize lazily on first device query.
+"""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,84 @@
+"""Sharded what-if tests on the 8-device virtual CPU mesh (conftest forces
+--xla_force_host_platform_device_count=8), mirroring how the driver validates
+multi-chip via __graft_entry__.dryrun_multichip."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from autoscaler_tpu.estimator.reference_impl import ffd_binpack_reference_groups
+from autoscaler_tpu.kube.objects import CPU, MEMORY, PODS
+from autoscaler_tpu.parallel.mesh import (
+    UNSCHEDULED_PENALTY,
+    factor_mesh,
+    make_mesh,
+    whatif_best_options,
+)
+
+
+def test_factor_mesh():
+    assert factor_mesh(8) == (4, 2)
+    assert factor_mesh(4) == (2, 2)
+    assert factor_mesh(1) == (1, 1)
+    assert factor_mesh(6) == (3, 2)
+    assert factor_mesh(7) == (7, 1)
+
+
+def build_whatif(S, G, P_, seed=0):
+    rng = np.random.default_rng(seed)
+    pod_req = np.zeros((P_, 6), np.float32)
+    pod_req[:, CPU] = rng.integers(100, 900, P_)
+    pod_req[:, MEMORY] = rng.integers(128, 1024, P_)
+    pod_req[:, PODS] = 1
+    masks = np.ones((G, P_), bool)
+    allocs = np.zeros((S, G, 6), np.float32)
+    allocs[:, :, CPU] = rng.integers(2000, 8000, (S, G))
+    allocs[:, :, MEMORY] = rng.integers(4096, 16384, (S, G))
+    allocs[:, :, PODS] = 110
+    prices = rng.uniform(0.5, 3.0, (S, G)).astype(np.float32)
+    caps = np.full(G, 32, np.int32)
+    return pod_req, masks, allocs, prices, caps
+
+
+def test_whatif_multidevice_matches_reference():
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    mesh = make_mesh()
+    S, G, P_ = 8, 4, 64
+    pod_req, masks, allocs, prices, caps = build_whatif(S, G, P_)
+    res = whatif_best_options(
+        mesh,
+        jnp.asarray(pod_req),
+        jnp.asarray(masks),
+        jnp.asarray(allocs),
+        jnp.asarray(prices),
+        jnp.asarray(caps),
+        max_nodes=32,
+    )
+    counts = np.asarray(res.node_counts)
+    # serial oracle per scenario
+    for s in range(S):
+        ref_counts, ref_scheds = ffd_binpack_reference_groups(
+            pod_req, masks, allocs[s], max_nodes=32
+        )
+        np.testing.assert_array_equal(counts[s], ref_counts)
+        pending = P_ - ref_scheds.sum(axis=1)
+        ref_cost = prices[s] * ref_counts + UNSCHEDULED_PENALTY * pending
+        assert int(res.best_group[s]) == int(np.argmin(ref_cost))
+        assert float(res.best_cost[s]) == pytest.approx(float(ref_cost.min()), rel=1e-5)
+
+
+def test_whatif_single_device_mesh():
+    mesh = make_mesh(jax.devices()[:1])
+    S, G, P_ = 2, 3, 32
+    pod_req, masks, allocs, prices, caps = build_whatif(S, G, P_, seed=5)
+    res = whatif_best_options(
+        mesh,
+        jnp.asarray(pod_req),
+        jnp.asarray(masks),
+        jnp.asarray(allocs),
+        jnp.asarray(prices),
+        jnp.asarray(caps),
+        max_nodes=16,
+    )
+    assert res.node_counts.shape == (S, G)
+    assert res.best_group.shape == (S,)
