@@ -1,0 +1,217 @@
+"""Cluster state registry: readiness accounting, scale-up request tracking,
+health gates, upcoming nodes, unregistered-node detection.
+
+Reference: cluster-autoscaler/clusterstate/clusterstate.go — struct :112,
+UpdateNodes :290, readiness/acceptable-range accounting :479-613,
+GetUpcomingNodes :921, IsClusterHealthy :353, IsNodeGroupHealthy :368,
+IsNodeGroupSafeToScaleUp :419, scale-up expiry → RegisterFailedScaleUp
+:232-288, instance-error handling :1015-1099.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from autoscaler_tpu.cloudprovider.interface import (
+    CloudProvider,
+    Instance,
+    InstanceState,
+)
+from autoscaler_tpu.clusterstate.backoff import ExponentialBackoff
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.kube.objects import Node
+
+
+@dataclass
+class ScaleUpRequest:
+    group_id: str
+    start_ts: float
+    expected_delta: int
+    expected_target: int
+
+
+@dataclass
+class ScaleUpFailure:
+    group_id: str
+    reason: str
+    ts: float
+
+
+@dataclass
+class Readiness:
+    ready: int = 0
+    unready: int = 0
+    not_started: int = 0
+    deleted: int = 0
+    registered: int = 0
+
+    @property
+    def total(self) -> int:
+        return self.registered
+
+
+class ClusterStateRegistry:
+    def __init__(
+        self,
+        provider: CloudProvider,
+        options: AutoscalingOptions,
+        backoff: Optional[ExponentialBackoff] = None,
+    ):
+        self.provider = provider
+        self.options = options
+        self.backoff = backoff or ExponentialBackoff()
+        self.scale_up_requests: Dict[str, ScaleUpRequest] = {}
+        self.scale_up_failures: List[ScaleUpFailure] = []
+        self.last_scale_down_ts: float = 0.0
+        self._readiness: Dict[str, Readiness] = {}
+        self._total: Readiness = Readiness()
+        self._nodes: List[Node] = []
+        self._last_update_ts: float = 0.0
+
+    # -- scale-up lifecycle (reference clusterstate.go:232-288) --------------
+    def register_or_update_scale_up(self, group_id: str, delta: int, now_ts: float) -> None:
+        group = self._group(group_id)
+        target = group.target_size() if group else delta
+        req = self.scale_up_requests.get(group_id)
+        if req is None:
+            self.scale_up_requests[group_id] = ScaleUpRequest(
+                group_id=group_id,
+                start_ts=now_ts,
+                expected_delta=delta,
+                expected_target=target,
+            )
+        else:
+            req.expected_delta += delta
+            req.expected_target = target
+            req.start_ts = now_ts
+
+    def register_failed_scale_up(self, group_id: str, reason: str, now_ts: float) -> None:
+        self.scale_up_failures.append(ScaleUpFailure(group_id, reason, now_ts))
+        self.backoff.backoff(group_id, now_ts)
+        self.scale_up_requests.pop(group_id, None)
+
+    def register_scale_down(self, now_ts: float) -> None:
+        self.last_scale_down_ts = now_ts
+
+    # -- per-loop state update (reference clusterstate.go:290) ---------------
+    def update_nodes(self, nodes: Sequence[Node], now_ts: float) -> None:
+        self._nodes = list(nodes)
+        self._last_update_ts = now_ts
+        self._recalculate_readiness(now_ts)
+        self._expire_scale_up_requests(now_ts)
+
+    def _recalculate_readiness(self, now_ts: float) -> None:
+        per_group: Dict[str, Readiness] = {}
+        total = Readiness()
+        for node in self._nodes:
+            group = self.provider.node_group_for_node(node)
+            gid = group.id() if group else ""
+            r = per_group.setdefault(gid, Readiness())
+            r.registered += 1
+            total.registered += 1
+            if node.ready:
+                r.ready += 1
+                total.ready += 1
+            elif now_ts - node.creation_ts < 120.0:
+                r.not_started += 1
+                total.not_started += 1
+            else:
+                r.unready += 1
+                total.unready += 1
+        self._readiness = per_group
+        self._total = total
+
+    def _expire_scale_up_requests(self, now_ts: float) -> None:
+        provision_timeout = self.options.max_node_provision_time_s
+        for gid, req in list(self.scale_up_requests.items()):
+            group = self._group(gid)
+            ready = self._readiness.get(gid, Readiness()).ready
+            if group is not None and ready >= req.expected_target:
+                # fulfilled
+                del self.scale_up_requests[gid]
+                self.backoff.remove_backoff(gid)
+            elif now_ts - req.start_ts > provision_timeout:
+                self.register_failed_scale_up(gid, "timeout", now_ts)
+
+    # -- health gates --------------------------------------------------------
+    def is_cluster_healthy(self) -> bool:
+        """reference clusterstate.go:353 — too many unready nodes halts
+        autoscaling."""
+        t = self._total
+        unready = t.unready
+        if unready <= self.options.ok_total_unready_count:
+            return True
+        if t.registered == 0:
+            return True
+        return unready * 100.0 / t.registered <= self.options.max_total_unready_percentage
+
+    def is_node_group_healthy(self, group_id: str) -> bool:
+        """reference clusterstate.go:368."""
+        r = self._readiness.get(group_id, Readiness())
+        unready = r.unready
+        if unready <= self.options.ok_total_unready_count:
+            return True
+        if r.registered == 0:
+            return True
+        return unready * 100.0 / r.registered <= self.options.max_total_unready_percentage
+
+    def is_node_group_safe_to_scale_up(self, group_id: str, now_ts: float) -> bool:
+        """healthy + not backed off (reference clusterstate.go:419)."""
+        return self.is_node_group_healthy(group_id) and not self.backoff.is_backed_off(
+            group_id, now_ts
+        )
+
+    # -- upcoming / unregistered (reference :921, :479) ----------------------
+    def get_upcoming_nodes(self) -> Dict[str, int]:
+        """Per group: nodes requested/being created but not yet ready —
+        injected as virtual nodes during simulation
+        (reference static_autoscaler.go:484-519)."""
+        upcoming: Dict[str, int] = {}
+        for group in self.provider.node_groups():
+            gid = group.id()
+            r = self._readiness.get(gid, Readiness())
+            ahead = group.target_size() - r.registered
+            if ahead > 0:
+                upcoming[gid] = ahead
+        return upcoming
+
+    def unregistered_instances(self) -> Dict[str, List[Instance]]:
+        """Cloud instances with no matching registered Node (candidates for
+        removeOldUnregisteredNodes, reference static_autoscaler.go:732)."""
+        registered_ids = {n.provider_id for n in self._nodes if n.provider_id}
+        registered_names = {n.name for n in self._nodes}
+        out: Dict[str, List[Instance]] = {}
+        for group in self.provider.node_groups():
+            missing = [
+                inst
+                for inst in group.nodes()
+                if inst.id not in registered_ids
+                and inst.id not in registered_names
+                and inst.state != InstanceState.DELETING
+            ]
+            if missing:
+                out[group.id()] = missing
+        return out
+
+    def instances_with_errors(self) -> Dict[str, List[Instance]]:
+        """Creating instances that reported a cloud error — to be deleted and
+        re-tried (reference deleteCreatedNodesWithErrors,
+        static_autoscaler.go:773 + clusterstate.go:1015-1099)."""
+        out: Dict[str, List[Instance]] = {}
+        for group in self.provider.node_groups():
+            errored = [i for i in group.nodes() if i.error_info is not None]
+            if errored:
+                out[group.id()] = errored
+        return out
+
+    def readiness(self, group_id: str) -> Readiness:
+        return self._readiness.get(group_id, Readiness())
+
+    def total_readiness(self) -> Readiness:
+        return self._total
+
+    def _group(self, group_id: str):
+        for g in self.provider.node_groups():
+            if g.id() == group_id:
+                return g
+        return None

@@ -1,0 +1,120 @@
+"""Autoscaling configuration.
+
+Reference: cluster-autoscaler/config/autoscaling_options.go:78 (the ~80-field
+AutoscalingOptions struct every layer reads) and the flag defaults of
+cluster-autoscaler/main.go:92-227. Field names are pythonized; defaults match
+the reference's flag defaults. Per-node-group overrides mirror
+NodeGroupAutoscalingOptions (autoscaling_options.go:37-66), resolved through
+the NodeGroupConfigProcessor pattern (processors/nodegroupconfig/).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class NodeGroupAutoscalingOptions:
+    """Per-node-group overridable knobs
+    (reference: config/autoscaling_options.go:37-66)."""
+
+    scale_down_utilization_threshold: float = 0.5
+    scale_down_gpu_utilization_threshold: float = 0.5
+    scale_down_unneeded_time_s: float = 600.0     # 10m
+    scale_down_unready_time_s: float = 1200.0     # 20m
+    max_node_provision_time_s: float = 900.0      # 15m
+
+
+@dataclass
+class NodeGroupDifferenceRatios:
+    """Similarity tolerances for balancing similar node groups
+    (reference: config/autoscaling_options.go:49-66 and
+    processors/nodegroupset/compare_nodegroups.go:84,103)."""
+
+    max_allocatable_difference_ratio: float = 0.05
+    max_capacity_memory_difference_ratio: float = 0.015
+    max_free_difference_ratio: float = 0.05
+
+
+@dataclass
+class AutoscalingOptions:
+    # -- global node-group defaults -----------------------------------------
+    node_group_defaults: NodeGroupAutoscalingOptions = field(
+        default_factory=NodeGroupAutoscalingOptions
+    )
+    node_group_overrides: Dict[str, NodeGroupAutoscalingOptions] = field(
+        default_factory=dict
+    )
+
+    # -- loop / process ------------------------------------------------------
+    scan_interval_s: float = 10.0
+    max_inactivity_s: float = 600.0               # health-check auto-restart
+    max_failing_time_s: float = 900.0
+
+    # -- cluster-wide resource limits (main.go:113-118) ----------------------
+    max_nodes_total: int = 0                      # 0 = unlimited
+    min_cores_total: float = 0.0
+    max_cores_total: float = 320_000.0 * 1000     # millicores
+    min_memory_total: float = 0.0
+    max_memory_total_mib: float = 6_400_000.0 * 1024
+    gpu_total: Dict[str, tuple] = field(default_factory=dict)  # name -> (min,max)
+
+    # -- scale-up ------------------------------------------------------------
+    estimator: str = "binpacking"
+    expander: str = "random"                      # reference default (main.go:145)
+    max_nodes_per_scaleup: int = 1000             # main.go:215
+    max_nodegroup_binpacking_duration_s: float = 10.0  # main.go:216
+    balance_similar_node_groups: bool = False
+    balancing_label_keys: List[str] = field(default_factory=list)
+    node_group_difference_ratios: NodeGroupDifferenceRatios = field(
+        default_factory=NodeGroupDifferenceRatios
+    )
+    scale_up_from_zero: bool = True
+    enforce_node_group_min_size: bool = False
+    max_node_provision_time_s: float = 900.0
+    new_pod_scale_up_delay_s: float = 0.0         # young-pod filter (main.go:204)
+    expendable_pods_priority_cutoff: int = -10
+
+    # -- cluster health (clusterstate gates) ---------------------------------
+    max_total_unready_percentage: float = 45.0    # main.go:148
+    ok_total_unready_count: int = 3               # main.go:149
+
+    # -- scale-down ----------------------------------------------------------
+    scale_down_enabled: bool = True
+    scale_down_delay_after_add_s: float = 600.0   # 10m
+    scale_down_delay_after_delete_s: float = 0.0  # defaults to scan interval
+    scale_down_delay_after_failure_s: float = 180.0  # 3m
+    scale_down_unneeded_time_s: float = 600.0
+    scale_down_unready_time_s: float = 1200.0
+    scale_down_utilization_threshold: float = 0.5
+    scale_down_non_empty_candidates_count: int = 30   # main.go:119
+    scale_down_candidates_pool_ratio: float = 0.1     # main.go:124
+    scale_down_candidates_pool_min_count: int = 50    # main.go:129
+    scale_down_simulation_timeout_s: float = 30.0
+    max_scale_down_parallelism: int = 10
+    max_drain_parallelism: int = 1
+    max_empty_bulk_delete: int = 10
+    max_graceful_termination_s: float = 600.0
+    max_bulk_soft_taint_count: int = 10
+    max_bulk_soft_taint_time_s: float = 3.0
+    unremovable_node_recheck_timeout_s: float = 300.0
+    node_deletion_batcher_interval_s: float = 0.0
+    skip_nodes_with_system_pods: bool = True
+    skip_nodes_with_local_storage: bool = True
+    skip_nodes_with_custom_controller_pods: bool = True
+    min_replica_count: int = 0
+
+    # -- misc ---------------------------------------------------------------
+    cloud_provider: str = "test"
+    write_status_configmap: bool = True
+    node_autoprovisioning_enabled: bool = False
+    max_autoprovisioned_node_group_count: int = 15
+    cordon_node_before_terminating: bool = False
+    ignore_daemonsets_utilization: bool = False
+    ignore_mirror_pods_utilization: bool = False
+
+    def group_options(self, group_name: str) -> NodeGroupAutoscalingOptions:
+        """Resolve per-group options with fallback to defaults (the
+        NodeGroupConfigProcessor / NodeGroup.GetOptions path,
+        reference cloud_provider.go:230)."""
+        return self.node_group_overrides.get(group_name, self.node_group_defaults)

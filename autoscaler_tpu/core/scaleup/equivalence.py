@@ -1,0 +1,60 @@
+"""Pod equivalence groups — dedup pods by controller + scheduling-relevant
+spec so one predicate evaluation covers many identical pods.
+
+Reference: cluster-autoscaler/core/scaleup/equivalence/groups.go:32,39,61
+(PodGroup, BuildPodGroups, groupPodsBySchedulingProperties: same controller
+owner-ref + equivalent spec → one group). In the TPU design this shrinks the
+host-side mask computation (one mask row per exemplar, broadcast to members);
+the device kernels are indifferent (they take per-pod rows either way).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence, Tuple
+
+from autoscaler_tpu.kube.objects import Pod
+
+
+@dataclass
+class PodEquivalenceGroup:
+    pods: List[Pod] = field(default_factory=list)
+
+    @property
+    def exemplar(self) -> Pod:
+        return self.pods[0]
+
+
+def _spec_fingerprint(pod: Pod) -> Tuple:
+    aff = pod.affinity
+    return (
+        pod.namespace,
+        pod.requests.as_tuple(),
+        tuple(sorted(pod.node_selector.items())),
+        tuple(pod.tolerations),
+        tuple(sorted(pod.labels.items())),
+        pod.host_ports,
+        (aff.node_selector_terms, aff.pod_affinity, aff.pod_anti_affinity)
+        if aff
+        else None,
+        pod.priority,
+    )
+
+
+def build_pod_groups(pods: Sequence[Pod]) -> List[PodEquivalenceGroup]:
+    """Pods with a controller owner and identical scheduling spec share a
+    group; controller-less pods get singleton groups (reference groups.go:61)."""
+    groups: Dict[Tuple, PodEquivalenceGroup] = {}
+    out: List[PodEquivalenceGroup] = []
+    for pod in pods:
+        if pod.owner_ref is None or not pod.owner_ref.controller:
+            g = PodEquivalenceGroup([pod])
+            out.append(g)
+            continue
+        key = (pod.owner_ref.kind, pod.owner_ref.name) + _spec_fingerprint(pod)
+        if key in groups:
+            groups[key].pods.append(pod)
+        else:
+            g = PodEquivalenceGroup([pod])
+            groups[key] = g
+            out.append(g)
+    return out

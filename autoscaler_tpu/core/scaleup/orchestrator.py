@@ -1,0 +1,202 @@
+"""Scale-up orchestration: from pending pods to cloud IncreaseSize calls,
+with all per-group estimation in one batched device dispatch.
+
+Reference: cluster-autoscaler/core/scaleup/orchestrator/orchestrator.go —
+ScaleUp :81, ComputeExpansionOption :444, ExecuteScaleUps :550,
+GetCappedNewNodeCount :536, ScaleUpToNodeGroupMinSize :348. The reference
+iterates node groups serially, forking the snapshot per group
+(:139-179 + :455-484); here every viable group's (predicate mask, FFD
+estimate) is computed in a single ffd_binpack_groups dispatch via
+BinpackingNodeEstimator.estimate_many, and only the chosen option crosses
+back into the (host-side, cloud-API) actuation boundary.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from autoscaler_tpu.cloudprovider.interface import CloudProvider, NodeGroup
+from autoscaler_tpu.clusterstate.registry import ClusterStateRegistry
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.core.scaleup.equivalence import build_pod_groups
+from autoscaler_tpu.core.scaleup.resource_manager import ScaleUpResourceManager
+from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+from autoscaler_tpu.expander.core import Option, Strategy
+from autoscaler_tpu.kube.objects import Node, Pod
+
+
+@dataclass
+class ScaleUpResult:
+    """reference: processors/status ScaleUpStatus."""
+
+    scaled_up: bool = False
+    chosen_group: Optional[str] = None
+    new_nodes: int = 0
+    extra_scale_ups: List[tuple] = field(default_factory=list)  # balancing
+    pods_triggered: List[Pod] = field(default_factory=list)
+    pods_remain_unschedulable: List[Pod] = field(default_factory=list)
+    skipped_groups: Dict[str, str] = field(default_factory=dict)
+    options_considered: int = 0
+    error: Optional[str] = None
+
+
+class ScaleUpOrchestrator:
+    def __init__(
+        self,
+        provider: CloudProvider,
+        options: AutoscalingOptions,
+        csr: ClusterStateRegistry,
+        estimator: Optional[BinpackingNodeEstimator] = None,
+        expander: Optional[Strategy] = None,
+        balancing_processor=None,
+    ):
+        from autoscaler_tpu.expander.core import build_strategy
+
+        self.provider = provider
+        self.options = options
+        self.csr = csr
+        self.estimator = estimator or BinpackingNodeEstimator()
+        self.expander = expander or build_strategy([options.expander])
+        self.resource_manager = ScaleUpResourceManager(provider.get_resource_limiter())
+        self.balancing_processor = balancing_processor
+
+    # -- main entry (reference orchestrator.go:81) ---------------------------
+    def scale_up(
+        self,
+        pending_pods: Sequence[Pod],
+        cluster_nodes: Sequence[Node],
+        now_ts: float,
+    ) -> ScaleUpResult:
+        if not pending_pods:
+            return ScaleUpResult()
+
+        # Equivalence groups shrink reporting/mask work (orchestrator.go:103).
+        pod_groups = build_pod_groups(pending_pods)
+
+        viable: Dict[str, NodeGroup] = {}
+        templates: Dict[str, Node] = {}
+        headrooms: Dict[str, int] = {}
+        skipped: Dict[str, str] = {}
+        for group in self.provider.node_groups():
+            gid = group.id()
+            if not self.csr.is_node_group_safe_to_scale_up(gid, now_ts):
+                skipped[gid] = "unhealthy or backed off"
+                continue
+            headroom = group.max_size() - group.target_size()
+            if headroom <= 0:
+                skipped[gid] = "max size reached"
+                continue
+            try:
+                template = group.template_node_info()
+            except Exception as e:  # no template → skip (orchestrator.go:157)
+                skipped[gid] = f"no template: {e}"
+                continue
+            viable[gid] = group
+            templates[gid] = template
+            headrooms[gid] = min(headroom, self.options.max_nodes_per_scaleup)
+
+        if not viable:
+            return ScaleUpResult(
+                pods_remain_unschedulable=list(pending_pods), skipped_groups=skipped
+            )
+
+        # ONE batched device dispatch for every group's expansion option
+        # (replaces the serial ComputeExpansionOption loop).
+        estimates = self.estimator.estimate_many(
+            list(pending_pods), templates, headrooms
+        )
+
+        options: List[Option] = []
+        for gid, (count, scheduled) in estimates.items():
+            if count <= 0 or not scheduled:
+                continue
+            options.append(Option(node_group=viable[gid], node_count=count, pods=scheduled))
+
+        if not options:
+            return ScaleUpResult(
+                pods_remain_unschedulable=list(pending_pods),
+                skipped_groups=skipped,
+            )
+
+        best = self.expander.best_option(options)
+        if best is None:
+            return ScaleUpResult(
+                pods_remain_unschedulable=list(pending_pods), skipped_groups=skipped
+            )
+
+        # Cap: group headroom, cluster node total, cluster resource limits
+        # (GetCappedNewNodeCount :536 + ApplyLimits path :277).
+        new_count = min(best.node_count, headrooms[best.node_group.id()])
+        if self.options.max_nodes_total > 0:
+            room = self.options.max_nodes_total - len(cluster_nodes)
+            new_count = min(new_count, max(room, 0))
+        left = self.resource_manager.resources_left(cluster_nodes)
+        new_count = self.resource_manager.apply_limits(
+            new_count, left, templates[best.node_group.id()]
+        )
+        if new_count <= 0:
+            return ScaleUpResult(
+                pods_remain_unschedulable=list(pending_pods),
+                skipped_groups=skipped,
+                options_considered=len(options),
+            )
+
+        # Balance across similar groups (orchestrator.go:277-318) when enabled.
+        scale_ups: List[tuple] = [(best.node_group, new_count)]
+        if self.balancing_processor is not None and self.options.balance_similar_node_groups:
+            similar = self.balancing_processor.find_similar_node_groups(
+                best.node_group, templates, list(viable.values())
+            )
+            if similar:
+                scale_ups = self.balancing_processor.balance_scale_up(
+                    [best.node_group] + similar, new_count
+                )
+
+        # ExecuteScaleUps (orchestrator.go:550) — the cloud-API boundary.
+        executed: List[tuple] = []
+        for group, delta in scale_ups:
+            if delta <= 0:
+                continue
+            try:
+                group.increase_size(delta)
+                self.csr.register_or_update_scale_up(group.id(), delta, now_ts)
+                executed.append((group.id(), delta))
+            except Exception as e:
+                self.csr.register_failed_scale_up(group.id(), str(e), now_ts)
+                return ScaleUpResult(
+                    error=f"scale-up of {group.id()} failed: {e}",
+                    skipped_groups=skipped,
+                    options_considered=len(options),
+                )
+
+        helped = {p.key() for p in best.pods}
+        return ScaleUpResult(
+            scaled_up=True,
+            chosen_group=best.node_group.id(),
+            new_nodes=sum(d for _, d in executed),
+            extra_scale_ups=executed[1:],
+            pods_triggered=best.pods,
+            pods_remain_unschedulable=[
+                p for p in pending_pods if p.key() not in helped
+            ],
+            skipped_groups=skipped,
+            options_considered=len(options),
+        )
+
+    # -- min-size enforcement (reference orchestrator.go:348) ----------------
+    def scale_up_to_node_group_min_size(self, now_ts: float) -> List[tuple]:
+        """Raise any group below its min size (--enforce-node-group-min-size)."""
+        executed = []
+        if not self.options.enforce_node_group_min_size:
+            return executed
+        for group in self.provider.node_groups():
+            delta = group.min_size() - group.target_size()
+            if delta > 0 and self.csr.is_node_group_safe_to_scale_up(group.id(), now_ts):
+                try:
+                    group.increase_size(delta)
+                    self.csr.register_or_update_scale_up(group.id(), delta, now_ts)
+                    executed.append((group.id(), delta))
+                except Exception as e:
+                    self.csr.register_failed_scale_up(group.id(), str(e), now_ts)
+        return executed

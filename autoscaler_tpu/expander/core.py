@@ -1,0 +1,140 @@
+"""Expander framework: choose which expansion option to act on.
+
+Reference: cluster-autoscaler/expander/expander.go — Option :44, Strategy :52,
+Filter :57, strategy names :25-42; chain composition
+expander/factory/chain.go:25 (filters applied in order, final strategy picks
+one). Strategies here are host-side reductions over the option list; the
+option tensor variants (vectorized scoring) live with the what-if kernels.
+"""
+from __future__ import annotations
+
+import random as _random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from autoscaler_tpu.cloudprovider.interface import NodeGroup
+from autoscaler_tpu.kube.objects import Node, Pod
+
+RANDOM = "random"
+MOST_PODS = "most-pods"
+LEAST_WASTE = "least-waste"
+PRICE = "price"
+PRIORITY = "priority"
+GRPC = "grpc"
+
+
+@dataclass
+class Option:
+    """reference expander.go:44."""
+
+    node_group: NodeGroup
+    node_count: int
+    pods: List[Pod] = field(default_factory=list)
+    similar_node_groups: List[NodeGroup] = field(default_factory=list)
+
+    @property
+    def debug(self) -> str:
+        return f"{self.node_group.id()}(+{self.node_count}, {len(self.pods)} pods)"
+
+
+class Filter:
+    """Narrows the option list; chained before the final strategy."""
+
+    def best_options(self, options: List[Option]) -> List[Option]:
+        raise NotImplementedError
+
+
+class Strategy:
+    """Picks exactly one option (or None)."""
+
+    def best_option(self, options: List[Option]) -> Optional[Option]:
+        raise NotImplementedError
+
+
+class RandomStrategy(Strategy):
+    """reference expander/random/."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = _random.Random(seed)
+
+    def best_option(self, options: List[Option]) -> Optional[Option]:
+        return self._rng.choice(options) if options else None
+
+
+class MostPodsFilter(Filter):
+    """reference expander/mostpods/ — maximize pods helped."""
+
+    def best_options(self, options: List[Option]) -> List[Option]:
+        if not options:
+            return []
+        best = max(len(o.pods) for o in options)
+        return [o for o in options if len(o.pods) == best]
+
+
+class LeastWasteFilter(Filter):
+    """reference expander/waste/ — minimize wasted cpu+mem fraction of the
+    added capacity."""
+
+    def best_options(self, options: List[Option]) -> List[Option]:
+        if not options:
+            return []
+        scored = [(self._wasted_fraction(o), o) for o in options]
+        best = min(s for s, _ in scored)
+        return [o for s, o in scored if s <= best + 1e-9]
+
+    @staticmethod
+    def _wasted_fraction(option: Option) -> float:
+        template = option.node_group.template_node_info()
+        cap_cpu = template.allocatable.cpu_m * option.node_count
+        cap_mem = template.allocatable.memory * option.node_count
+        req_cpu = sum(p.requests.cpu_m for p in option.pods)
+        req_mem = sum(p.requests.memory for p in option.pods)
+        wasted = 0.0
+        if cap_cpu > 0:
+            wasted += 1.0 - min(req_cpu / cap_cpu, 1.0)
+        if cap_mem > 0:
+            wasted += 1.0 - min(req_mem / cap_mem, 1.0)
+        return wasted
+
+
+class ChainStrategy(Strategy):
+    """reference expander/factory/chain.go:25 — filters in order, fallback
+    strategy decides among survivors."""
+
+    def __init__(self, filters: Sequence[Filter], fallback: Strategy):
+        self.filters = list(filters)
+        self.fallback = fallback
+
+    def best_option(self, options: List[Option]) -> Optional[Option]:
+        survivors = list(options)
+        for f in self.filters:
+            filtered = f.best_options(survivors)
+            if len(filtered) == 1:
+                return filtered[0]
+            if filtered:
+                survivors = filtered
+        return self.fallback.best_option(survivors)
+
+
+def build_strategy(names: Sequence[str], seed: Optional[int] = None, **kwargs) -> Strategy:
+    """Build a chained strategy from expander names, as the reference's
+    expander factory does from the --expander flag (factory/chain.go)."""
+    filters: List[Filter] = []
+    for name in names:
+        if name == RANDOM:
+            break
+        elif name == MOST_PODS:
+            filters.append(MostPodsFilter())
+        elif name == LEAST_WASTE:
+            filters.append(LeastWasteFilter())
+        elif name == PRICE:
+            from autoscaler_tpu.expander.price import PriceFilter
+
+            filters.append(PriceFilter(kwargs["pricing"]))
+        elif name == PRIORITY:
+            from autoscaler_tpu.expander.priority import PriorityFilter
+
+            filters.append(PriorityFilter(kwargs["priorities"]))
+        else:
+            raise ValueError(f"unknown expander {name!r}")
+    return ChainStrategy(filters, RandomStrategy(seed))

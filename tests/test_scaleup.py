@@ -1,0 +1,314 @@
+"""Scale-up pipeline tests: clusterstate registry, backoff, equivalence,
+resource limits, expanders, and the orchestrator end-to-end against the fake
+cloud provider (modeled on the reference's orchestrator_test.go and
+clusterstate_test.go scenarios)."""
+import numpy as np
+import pytest
+
+from autoscaler_tpu.cloudprovider.interface import (
+    Instance,
+    InstanceErrorClass,
+    InstanceErrorInfo,
+    InstanceState,
+    NodeGroupError,
+    ResourceLimiter,
+)
+from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+from autoscaler_tpu.clusterstate.backoff import ExponentialBackoff
+from autoscaler_tpu.clusterstate.registry import ClusterStateRegistry
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.core.scaleup.equivalence import build_pod_groups
+from autoscaler_tpu.core.scaleup.orchestrator import ScaleUpOrchestrator
+from autoscaler_tpu.core.scaleup.resource_manager import (
+    ResourceDelta,
+    ScaleUpResourceManager,
+)
+from autoscaler_tpu.expander.core import (
+    ChainStrategy,
+    LeastWasteFilter,
+    MostPodsFilter,
+    Option,
+    RandomStrategy,
+    build_strategy,
+)
+from autoscaler_tpu.utils.test_utils import GB, MB, build_test_node, build_test_pod
+
+
+def make_provider(groups=None):
+    p = TestCloudProvider()
+    for name, lo, hi, target, cpu, mem in groups or []:
+        p.add_node_group(name, lo, hi, target, build_test_node(f"{name}-tmpl", cpu_m=cpu, mem=mem))
+    return p
+
+
+class TestBackoff:
+    def test_exponential_growth(self):
+        b = ExponentialBackoff(initial_s=100, max_s=400)
+        b.backoff("g", 0.0)
+        assert b.is_backed_off("g", 50.0)
+        assert not b.is_backed_off("g", 150.0)
+        b.backoff("g", 150.0)   # second failure → 200s
+        assert b.is_backed_off("g", 300.0)
+        b.backoff("g", 400.0)   # → 400s (capped)
+        b.backoff("g", 900.0)   # → still capped at 400
+        assert b.is_backed_off("g", 1250.0)
+        assert not b.is_backed_off("g", 1350.0)
+
+    def test_reset_after_idle(self):
+        b = ExponentialBackoff(initial_s=100, max_s=400, reset_timeout_s=1000)
+        b.backoff("g", 0.0)
+        b.backoff("g", 200.0)  # 200s
+        # long quiet period → duration resets to initial
+        b.backoff("g", 5000.0)
+        assert not b.is_backed_off("g", 5150.0)
+
+
+class TestClusterStateRegistry:
+    def test_readiness_and_health(self):
+        p = make_provider([("g1", 0, 10, 3, 1000, 2 * GB)])
+        nodes = [build_test_node(f"n{i}") for i in range(3)]
+        for n in nodes:
+            p.add_node("g1", n)
+        nodes[2].ready = False
+        nodes[2].creation_ts = -10_000  # long unready
+        csr = ClusterStateRegistry(p, AutoscalingOptions(ok_total_unready_count=0))
+        csr.update_nodes(nodes, now_ts=1000.0)
+        r = csr.readiness("g1")
+        assert (r.ready, r.unready, r.registered) == (2, 1, 3)
+        # 33% unready < 45% → healthy
+        assert csr.is_cluster_healthy()
+        assert csr.is_node_group_healthy("g1")
+
+    def test_unhealthy_cluster(self):
+        p = make_provider([("g1", 0, 10, 3, 1000, 2 * GB)])
+        nodes = [build_test_node(f"n{i}", ready=False) for i in range(3)]
+        for n in nodes:
+            n.creation_ts = -10_000
+            p.add_node("g1", n)
+        csr = ClusterStateRegistry(p, AutoscalingOptions(ok_total_unready_count=0))
+        csr.update_nodes(nodes, now_ts=1000.0)
+        assert not csr.is_cluster_healthy()
+
+    def test_scale_up_expiry_triggers_backoff(self):
+        p = make_provider([("g1", 0, 10, 5, 1000, 2 * GB)])
+        opts = AutoscalingOptions(max_node_provision_time_s=900)
+        csr = ClusterStateRegistry(p, opts)
+        csr.register_or_update_scale_up("g1", 5, now_ts=0.0)
+        csr.update_nodes([], now_ts=100.0)
+        assert csr.is_node_group_safe_to_scale_up("g1", 100.0)
+        csr.update_nodes([], now_ts=1000.0)  # past provision timeout
+        assert len(csr.scale_up_failures) == 1
+        assert not csr.is_node_group_safe_to_scale_up("g1", 1000.0)
+
+    def test_scale_up_fulfilled_clears_request(self):
+        p = make_provider([("g1", 0, 10, 2, 1000, 2 * GB)])
+        csr = ClusterStateRegistry(p, AutoscalingOptions())
+        csr.register_or_update_scale_up("g1", 2, now_ts=0.0)
+        nodes = [build_test_node(f"n{i}") for i in range(2)]
+        for n in nodes:
+            p.add_node("g1", n)
+        csr.update_nodes(nodes, now_ts=100.0)
+        assert csr.scale_up_requests == {}
+        assert not csr.scale_up_failures
+
+    def test_upcoming_nodes(self):
+        p = make_provider([("g1", 0, 10, 5, 1000, 2 * GB)])
+        nodes = [build_test_node(f"n{i}") for i in range(2)]
+        for n in nodes:
+            p.add_node("g1", n)
+        csr = ClusterStateRegistry(p, AutoscalingOptions())
+        csr.update_nodes(nodes, now_ts=0.0)
+        assert csr.get_upcoming_nodes() == {"g1": 3}
+
+    def test_unregistered_instances(self):
+        p = make_provider([("g1", 0, 10, 2, 1000, 2 * GB)])
+        n0 = build_test_node("n0")
+        p.add_node("g1", n0)
+        p.add_instance("g1", Instance(id="ghost-1"))
+        csr = ClusterStateRegistry(p, AutoscalingOptions())
+        csr.update_nodes([n0], now_ts=0.0)
+        unreg = csr.unregistered_instances()
+        assert [i.id for i in unreg["g1"]] == ["ghost-1"]
+
+    def test_instances_with_errors(self):
+        p = make_provider([("g1", 0, 10, 2, 1000, 2 * GB)])
+        p.add_instance(
+            "g1",
+            Instance(
+                id="bad-1",
+                state=InstanceState.CREATING,
+                error_info=InstanceErrorInfo(InstanceErrorClass.QUOTA_EXCEEDED),
+            ),
+        )
+        csr = ClusterStateRegistry(p, AutoscalingOptions())
+        assert [i.id for i in csr.instances_with_errors()["g1"]] == ["bad-1"]
+
+
+class TestEquivalence:
+    def test_grouping(self):
+        from autoscaler_tpu.kube.objects import OwnerRef
+
+        pods = [build_test_pod(f"p{i}") for i in range(5)]
+        # same owner+spec (builder gives each a distinct owner name by default)
+        for p in pods:
+            p.owner_ref = OwnerRef(kind="ReplicaSet", name="rs-1")
+        singleton = build_test_pod("one", owner_kind="")
+        different = build_test_pod("big", cpu_m=999)
+        different.owner_ref = OwnerRef(kind="ReplicaSet", name="rs-1")
+        groups = build_pod_groups(pods + [singleton, different])
+        sizes = sorted(len(g.pods) for g in groups)
+        assert sizes == [1, 1, 5]
+
+
+class TestResourceManager:
+    def test_limits(self):
+        limiter = ResourceLimiter(max_limits={"cpu": 10_000, "memory": 100 * 1024})
+        mgr = ScaleUpResourceManager(limiter)
+        nodes = [build_test_node("n0", cpu_m=4000, mem=8 * GB)]
+        left = mgr.resources_left(nodes)
+        assert left.left["cpu"] == pytest.approx(6000)
+        template = build_test_node("t", cpu_m=2000, mem=4 * GB)
+        assert mgr.apply_limits(10, left, template) == 3  # cpu-capped
+
+    def test_exceeded(self):
+        limiter = ResourceLimiter(max_limits={"cpu": 1000})
+        mgr = ScaleUpResourceManager(limiter)
+        left = mgr.resources_left([build_test_node("n0", cpu_m=900)])
+        delta = ResourceDelta.for_node(build_test_node("t", cpu_m=500))
+        assert left.exceeded_by(delta) == ["cpu"]
+
+
+class TestExpanders:
+    def _options(self):
+        p = make_provider(
+            [("small", 0, 10, 0, 1000, 2 * GB), ("big", 0, 10, 0, 8000, 16 * GB)]
+        )
+        gs = {g.id(): g for g in p.node_groups()}
+        pods4 = [build_test_pod(f"p{i}", cpu_m=900, mem=1800 * MB) for i in range(4)]
+        return [
+            Option(gs["small"], node_count=4, pods=pods4),
+            Option(gs["big"], node_count=1, pods=pods4[:2]),
+        ]
+
+    def test_most_pods(self):
+        opts = self._options()
+        best = ChainStrategy([MostPodsFilter()], RandomStrategy(0)).best_option(opts)
+        assert best.node_group.id() == "small"
+
+    def test_least_waste(self):
+        opts = self._options()
+        # small: 3600/4000 cpu used (waste .1) + 7200/8192 mem; big: 1800/8000
+        best = ChainStrategy([LeastWasteFilter()], RandomStrategy(0)).best_option(opts)
+        assert best.node_group.id() == "small"
+
+    def test_random_deterministic_seed(self):
+        opts = self._options()
+        assert RandomStrategy(42).best_option(opts) is not None
+
+    def test_build_strategy(self):
+        s = build_strategy(["least-waste"])
+        assert s.best_option(self._options()).node_group.id() == "small"
+
+
+class TestOrchestrator:
+    def _setup(self, **opt_kw):
+        provider = make_provider(
+            [
+                ("small", 0, 20, 1, 1000, 2 * GB),
+                ("big", 0, 20, 1, 8000, 16 * GB),
+            ]
+        )
+        n_small = build_test_node("small-1", cpu_m=1000, mem=2 * GB)
+        n_big = build_test_node("big-1", cpu_m=8000, mem=16 * GB)
+        provider.add_node("small", n_small)
+        provider.add_node("big", n_big)
+        opts = AutoscalingOptions(expander="least-waste", **opt_kw)
+        csr = ClusterStateRegistry(provider, opts)
+        cluster_nodes = [n_small, n_big]
+        csr.update_nodes(cluster_nodes, now_ts=0.0)
+        from autoscaler_tpu.expander.core import build_strategy as bs
+
+        orch = ScaleUpOrchestrator(provider, opts, csr, expander=bs(["least-waste"]))
+        return provider, csr, orch, cluster_nodes
+
+    def test_scale_up_end_to_end(self):
+        provider, csr, orch, nodes = self._setup()
+        pods = [build_test_pod(f"p{i}", cpu_m=900, mem=1800 * MB) for i in range(6)]
+        result = orch.scale_up(pods, nodes, now_ts=10.0)
+        assert result.scaled_up
+        assert result.new_nodes > 0
+        assert provider.scale_up_calls  # cloud API hit
+        group, delta = provider.scale_up_calls[0]
+        assert group == result.chosen_group
+        assert delta == result.new_nodes
+        assert csr.scale_up_requests  # tracked
+        assert not result.pods_remain_unschedulable
+
+    def test_no_pending_pods_noop(self):
+        provider, csr, orch, nodes = self._setup()
+        result = orch.scale_up([], nodes, now_ts=0.0)
+        assert not result.scaled_up
+        assert provider.scale_up_calls == []
+
+    def test_backed_off_group_skipped(self):
+        provider, csr, orch, nodes = self._setup()
+        csr.backoff.backoff("small", 0.0)
+        csr.backoff.backoff("big", 0.0)
+        pods = [build_test_pod("p", cpu_m=500)]
+        result = orch.scale_up(pods, nodes, now_ts=10.0)
+        assert not result.scaled_up
+        assert "backed off" in result.skipped_groups["small"]
+
+    def test_max_size_respected(self):
+        provider = make_provider([("g", 0, 3, 1, 1000, 2 * GB)])
+        node = build_test_node("g-1", cpu_m=1000, mem=2 * GB)
+        provider.add_node("g", node)
+        opts = AutoscalingOptions()
+        csr = ClusterStateRegistry(provider, opts)
+        csr.update_nodes([node], now_ts=0.0)
+        orch = ScaleUpOrchestrator(provider, opts, csr)
+        pods = [build_test_pod(f"p{i}", cpu_m=900) for i in range(10)]
+        result = orch.scale_up(pods, [node], now_ts=0.0)
+        assert result.scaled_up
+        assert result.new_nodes == 2  # headroom = 3-1
+        assert result.pods_remain_unschedulable  # some pods didn't fit
+
+    def test_max_nodes_total_cap(self):
+        provider, csr, orch, nodes = self._setup(max_nodes_total=3)
+        pods = [build_test_pod(f"p{i}", cpu_m=900, mem=1800 * MB) for i in range(6)]
+        result = orch.scale_up(pods, nodes, now_ts=0.0)
+        assert result.new_nodes <= 1  # 2 existing + 1 = 3
+
+    def test_resource_limit_cap(self):
+        provider = make_provider([("g", 0, 20, 0, 4000, 8 * GB)])
+        provider._limiter = ResourceLimiter(max_limits={"cpu": 8000})
+        opts = AutoscalingOptions()
+        csr = ClusterStateRegistry(provider, opts)
+        csr.update_nodes([], now_ts=0.0)
+        orch = ScaleUpOrchestrator(provider, opts, csr)
+        pods = [build_test_pod(f"p{i}", cpu_m=3500) for i in range(8)]
+        result = orch.scale_up(pods, [], now_ts=0.0)
+        assert result.new_nodes == 2  # cpu cap 8000 / 4000 per node
+
+    def test_failed_increase_registers_backoff(self):
+        provider, csr, orch, nodes = self._setup()
+
+        def boom(group, delta):
+            raise NodeGroupError("cloud says no")
+
+        provider.on_scale_up = boom
+        pods = [build_test_pod("p", cpu_m=900, mem=1800 * MB)]
+        result = orch.scale_up(pods, nodes, now_ts=0.0)
+        assert result.error is not None
+        assert len(csr.scale_up_failures) == 1
+        failed_group = csr.scale_up_failures[0].group_id
+        assert not csr.is_node_group_safe_to_scale_up(failed_group, 1.0)
+
+    def test_min_size_enforcement(self):
+        provider = make_provider([("g", 2, 10, 0, 1000, 2 * GB)])
+        opts = AutoscalingOptions(enforce_node_group_min_size=True)
+        csr = ClusterStateRegistry(provider, opts)
+        csr.update_nodes([], now_ts=0.0)
+        orch = ScaleUpOrchestrator(provider, opts, csr)
+        executed = orch.scale_up_to_node_group_min_size(0.0)
+        assert executed == [("g", 2)]
