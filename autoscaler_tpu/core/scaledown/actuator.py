@@ -1,0 +1,213 @@
+"""Scale-down actuation: taint → evict → delete, with budgets and batching.
+
+Reference: cluster-autoscaler/core/scaledown/actuation/ —
+Actuator.StartDeletion actuator.go:80 (budget crop :126 → sync taint :187 →
+empty :156 / drain :206 → per-node scheduleDeletion :356 → batcher),
+Evictor drain.go:83,90 (retry loop, eviction headroom, DaemonSet best-effort
+eviction :178), NodeDeletionBatcher delete_in_batch.go:71,115 (per-group
+batched DeleteNodes), soft taints softtaint.go:31,77 (bulk PreferNoSchedule
+budget). The reference runs deletions on goroutines; this host runs them
+synchronously per loop iteration (the cloud call is the bottleneck either
+way) while preserving ordering, budgets, and failure bookkeeping.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from autoscaler_tpu.cloudprovider.interface import CloudProvider, NodeGroup
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.core.scaledown.planner import ScaleDownPlan
+from autoscaler_tpu.core.scaledown.tracking import NodeDeletionTracker
+from autoscaler_tpu.kube.api import (
+    ClusterAPI,
+    EvictionError,
+    deletion_candidate_taint,
+    to_be_deleted_taint,
+)
+from autoscaler_tpu.kube.objects import (
+    DELETION_CANDIDATE_TAINT,
+    TO_BE_DELETED_TAINT,
+    Node,
+    Pod,
+)
+from autoscaler_tpu.simulator.removal import NodeToRemove
+
+
+@dataclass
+class ActuationResult:
+    deleted_empty: List[str] = field(default_factory=list)
+    deleted_drain: List[str] = field(default_factory=list)
+    failed: Dict[str, str] = field(default_factory=dict)
+    evicted_pods: List[str] = field(default_factory=list)
+
+
+class Evictor:
+    """reference actuation/drain.go:83 DrainNodeWithPods."""
+
+    def __init__(self, api: ClusterAPI, max_retries: int = 3):
+        self.api = api
+        self.max_retries = max_retries
+
+    def drain_node(
+        self, node: Node, pods: Sequence[Pod], tracker: NodeDeletionTracker, now_ts: float
+    ) -> Tuple[bool, List[str]]:
+        evicted: List[str] = []
+        for pod in pods:
+            ok = False
+            last_err = ""
+            for _ in range(self.max_retries):
+                try:
+                    self.api.evict_pod(pod)
+                    tracker.register_eviction(pod.key(), now_ts)
+                    evicted.append(pod.key())
+                    ok = True
+                    break
+                except EvictionError as e:
+                    last_err = str(e)
+            if not ok:
+                return False, evicted
+        return True, evicted
+
+
+class NodeDeletionBatcher:
+    """reference actuation/delete_in_batch.go:71 — collect nodes per group,
+    flush as one DeleteNodes cloud call."""
+
+    def __init__(self, provider: CloudProvider):
+        self.provider = provider
+        self._pending: Dict[str, List[Node]] = {}
+
+    def add_node(self, group: NodeGroup, node: Node) -> None:
+        self._pending.setdefault(group.id(), []).append(node)
+
+    def flush(self) -> Dict[str, Optional[str]]:
+        """→ group id → error (None on success)."""
+        results: Dict[str, Optional[str]] = {}
+        groups = {g.id(): g for g in self.provider.node_groups()}
+        for gid, nodes in self._pending.items():
+            group = groups.get(gid)
+            if group is None:
+                results[gid] = f"group {gid} no longer exists"
+                continue
+            try:
+                group.delete_nodes(nodes)
+                results[gid] = None
+            except Exception as e:
+                results[gid] = str(e)
+        self._pending.clear()
+        return results
+
+
+class ScaleDownActuator:
+    def __init__(
+        self,
+        provider: CloudProvider,
+        options: AutoscalingOptions,
+        api: ClusterAPI,
+        tracker: Optional[NodeDeletionTracker] = None,
+    ):
+        self.provider = provider
+        self.options = options
+        self.api = api
+        self.tracker = tracker or NodeDeletionTracker()
+        self.evictor = Evictor(api)
+
+    # -- reference actuator.go:80 -------------------------------------------
+    def start_deletion(self, plan: ScaleDownPlan, now_ts: float) -> ActuationResult:
+        result = ActuationResult()
+        empty = plan.empty[: self.options.max_empty_bulk_delete]
+        drain = plan.drain[: self.options.max_drain_parallelism]
+
+        # 1. taint everything up front, atomically-ish (actuator.go:95,111);
+        # roll back taints on nodes we end up not deleting.
+        tainted: List[str] = []
+        for r in empty + drain:
+            try:
+                self.api.add_taint(r.node.name, to_be_deleted_taint())
+                tainted.append(r.node.name)
+            except Exception as e:
+                result.failed[r.node.name] = f"taint failed: {e}"
+        empty = [r for r in empty if r.node.name not in result.failed]
+        drain = [r for r in drain if r.node.name not in result.failed]
+
+        batcher = NodeDeletionBatcher(self.provider)
+        staged: List[Tuple[NodeToRemove, bool]] = []  # (node, was_drain)
+
+        for r in empty:
+            group = self.provider.node_group_for_node(r.node)
+            if group is None:
+                result.failed[r.node.name] = "no node group"
+                continue
+            self.tracker.start_deletion(group.id(), r.node.name, drain=False)
+            batcher.add_node(group, r.node)
+            staged.append((r, False))
+
+        for r in drain:
+            group = self.provider.node_group_for_node(r.node)
+            if group is None:
+                result.failed[r.node.name] = "no node group"
+                continue
+            self.tracker.start_deletion(group.id(), r.node.name, drain=True)
+            ok, evicted = self.evictor.drain_node(r.node, r.pods_to_reschedule, self.tracker, now_ts)
+            result.evicted_pods.extend(evicted)
+            if not ok:
+                self.tracker.end_deletion(group.id(), r.node.name, ok=False, error="eviction failed", ts=now_ts)
+                result.failed[r.node.name] = "eviction failed"
+                self.api.remove_taint(r.node.name, TO_BE_DELETED_TAINT)
+                continue
+            batcher.add_node(group, r.node)
+            staged.append((r, True))
+
+        # 2. one batched cloud delete per group (delete_in_batch.go:115).
+        errors = batcher.flush()
+        for r, was_drain in staged:
+            group = self.provider.node_group_for_node(r.node)
+            gid = group.id() if group else ""
+            err = errors.get(gid)
+            if err:
+                self.tracker.end_deletion(gid, r.node.name, ok=False, error=err, ts=now_ts)
+                result.failed[r.node.name] = err
+                self.api.remove_taint(r.node.name, TO_BE_DELETED_TAINT)
+                continue
+            self.api.delete_node_object(r.node.name)
+            self.tracker.end_deletion(gid, r.node.name, ok=True, ts=now_ts)
+            (result.deleted_drain if was_drain else result.deleted_empty).append(
+                r.node.name
+            )
+            self.api.record_event(
+                "Node", r.node.name, "ScaleDown", "node removed by autoscaler"
+            )
+        return result
+
+    # -- soft taints (reference softtaint.go:31,77) --------------------------
+    def update_soft_deletion_taints(
+        self, all_nodes: Sequence[Node], unneeded_names: Sequence[str]
+    ) -> int:
+        """Keep DeletionCandidate (PreferNoSchedule) taints in sync with the
+        current unneeded set, bounded by the bulk budget."""
+        budget = self.options.max_bulk_soft_taint_count
+        changed = 0
+        unneeded = set(unneeded_names)
+        for node in all_nodes:
+            if changed >= budget:
+                break
+            has = any(t.key == DELETION_CANDIDATE_TAINT for t in node.taints)
+            if node.name in unneeded and not has:
+                self.api.add_taint(node.name, deletion_candidate_taint())
+                changed += 1
+            elif node.name not in unneeded and has:
+                self.api.remove_taint(node.name, DELETION_CANDIDATE_TAINT)
+                changed += 1
+        return changed
+
+    def clean_up_to_be_deleted_taints(self, nodes: Sequence[Node]) -> int:
+        """Startup cleanup of leftover ToBeDeleted taints from a crashed
+        predecessor (reference static_autoscaler.go:230-248)."""
+        removed = 0
+        for node in nodes:
+            if any(t.key == TO_BE_DELETED_TAINT for t in node.taints):
+                if not self.tracker.is_being_deleted(node.name):
+                    self.api.remove_taint(node.name, TO_BE_DELETED_TAINT)
+                    removed += 1
+        return removed

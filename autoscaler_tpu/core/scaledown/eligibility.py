@@ -1,0 +1,90 @@
+"""Scale-down eligibility: which nodes are even candidates.
+
+Reference: cluster-autoscaler/core/scaledown/eligibility/eligibility.go:66
+(FilterOutUnremovable: scale-down-disabled annotation, unready policy,
+per-nodegroup utilization threshold :164, GPU-aware threshold) — with the
+utilization pass vectorized into one device reduction (ops/utilization.py)
+instead of a per-node loop.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from autoscaler_tpu.cloudprovider.interface import CloudProvider
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.kube.objects import (
+    GPU,
+    SCALE_DOWN_DISABLED_ANNOTATION,
+    Node,
+)
+from autoscaler_tpu.ops.utilization import node_utilization
+from autoscaler_tpu.simulator.removal import UnremovableNode, UnremovableReason
+from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+
+
+@dataclass
+class EligibilityChecker:
+    options: AutoscalingOptions
+    provider: Optional[CloudProvider] = None
+
+    def filter_out_unremovable(
+        self,
+        snapshot: ClusterSnapshot,
+        candidates: Sequence[Node],
+        now_ts: float,
+        unremovable_cache=None,
+    ) -> Tuple[List[str], Dict[str, float], List[UnremovableNode]]:
+        """→ (eligible node names, utilization by name, unremovable). One
+        utilization kernel call covers all nodes."""
+        tensors, meta = snapshot.tensors()
+        util = np.asarray(node_utilization(tensors))
+        alloc_gpu = np.asarray(tensors.node_alloc[:, GPU])
+
+        eligible: List[str] = []
+        utilization: Dict[str, float] = {}
+        unremovable: List[UnremovableNode] = []
+        for node in candidates:
+            if unremovable_cache is not None and unremovable_cache.is_recently_unremovable(
+                node.name, now_ts
+            ):
+                unremovable.append(
+                    UnremovableNode(node, UnremovableReason.RECENTLY_UNREMOVABLE)
+                )
+                continue
+            if node.annotations.get(SCALE_DOWN_DISABLED_ANNOTATION, "").lower() == "true":
+                unremovable.append(
+                    UnremovableNode(node, UnremovableReason.SCALE_DOWN_DISABLED_ANNOTATION)
+                )
+                continue
+            j = meta.node_index.get(node.name)
+            if j is None:
+                continue
+            u = float(util[j])
+            utilization[node.name] = u
+            group_opts = self._group_options(node)
+            threshold = (
+                group_opts.scale_down_gpu_utilization_threshold
+                if alloc_gpu[j] > 0
+                else group_opts.scale_down_utilization_threshold
+            )
+            if not node.ready:
+                # unready nodes are scale-down candidates regardless of
+                # utilization (reference eligibility.go: unready path)
+                eligible.append(node.name)
+            elif u >= threshold:
+                unremovable.append(
+                    UnremovableNode(node, UnremovableReason.NOT_UTILIZED_ENOUGH)
+                )
+            else:
+                eligible.append(node.name)
+        return eligible, utilization, unremovable
+
+    def _group_options(self, node: Node):
+        if self.provider is not None:
+            group = self.provider.node_group_for_node(node)
+            if group is not None:
+                return self.options.group_options(group.id())
+        return self.options.node_group_defaults

@@ -1,0 +1,152 @@
+"""Scale-down planner: decide which nodes are unneeded and which to delete.
+
+Reference: cluster-autoscaler/core/scaledown/planner/planner.go — Planner :62,
+UpdateClusterState :103 (fork → inject recently-evicted pods → categorize),
+categorizeNodes :252 (eligibility filter then per-node SimulateNodeRemoval
+under ScaleDownSimulationTimeout), NodesToDelete :134 (limits + unneeded-time
+gates + parallelism caps), and the candidate-pool bounds of the legacy path
+(legacy.go:152-180: 30 non-empty candidates, pool ratio 0.1, pool min 50).
+The per-node removal simulation is batched into one device dispatch
+(simulator/removal.py), so the simulation-timeout knob bounds one call, not a
+loop.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from autoscaler_tpu.cloudprovider.interface import CloudProvider
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.core.scaledown.eligibility import EligibilityChecker
+from autoscaler_tpu.core.scaledown.tracking import (
+    NodeDeletionTracker,
+    RemainingPdbTracker,
+    UnneededNodes,
+    UnremovableNodesCache,
+)
+from autoscaler_tpu.kube.objects import Node, PodDisruptionBudget
+from autoscaler_tpu.simulator.removal import (
+    NodeToRemove,
+    RemovalSimulator,
+    UnremovableNode,
+    UnremovableReason,
+)
+from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+
+
+@dataclass
+class ScaleDownPlan:
+    empty: List[NodeToRemove] = field(default_factory=list)
+    drain: List[NodeToRemove] = field(default_factory=list)
+    unremovable: List[UnremovableNode] = field(default_factory=list)
+
+
+class ScaleDownPlanner:
+    def __init__(
+        self,
+        provider: CloudProvider,
+        options: AutoscalingOptions,
+        deletion_tracker: Optional[NodeDeletionTracker] = None,
+        removal_simulator: Optional[RemovalSimulator] = None,
+    ):
+        self.provider = provider
+        self.options = options
+        self.eligibility = EligibilityChecker(options, provider)
+        self.unneeded = UnneededNodes()
+        self.unremovable_cache = UnremovableNodesCache(
+            options.unremovable_node_recheck_timeout_s
+        )
+        self.deletion_tracker = deletion_tracker or NodeDeletionTracker()
+        self.simulator = removal_simulator or RemovalSimulator()
+        self._last_unremovable: List[UnremovableNode] = []
+        self._utilization: Dict[str, float] = {}
+
+    # -- per-loop update (reference planner.go:103) --------------------------
+    def update_cluster_state(
+        self,
+        snapshot: ClusterSnapshot,
+        scale_down_candidates: Sequence[Node],
+        pdbs: Sequence[PodDisruptionBudget],
+        now_ts: float,
+    ) -> None:
+        eligible, utilization, unremovable = self.eligibility.filter_out_unremovable(
+            snapshot, scale_down_candidates, now_ts, self.unremovable_cache
+        )
+        self._utilization = utilization
+
+        # candidate-pool bounds (legacy.go:152-180)
+        pool = self._bound_candidates(eligible)
+
+        empty_names = set(self.simulator.find_empty_nodes(snapshot, pool))
+        non_empty = [n for n in pool if n not in empty_names]
+        limit = self.options.scale_down_non_empty_candidates_count
+        if limit > 0:
+            non_empty = non_empty[:limit]
+
+        to_remove, not_removable = self.simulator.find_nodes_to_remove(
+            snapshot, non_empty, pdbs
+        )
+        for u in not_removable:
+            if u.node is not None:
+                self.unremovable_cache.add(u.node.name, now_ts)
+        unremovable.extend(not_removable)
+        self._last_unremovable = unremovable
+
+        unneeded_nodes = [snapshot.get_node(n) for n in empty_names]
+        unneeded_nodes += [r.node for r in to_remove]
+        self.unneeded.update([n for n in unneeded_nodes if n is not None], now_ts)
+        self._empty_names = empty_names
+        self._drainable = {r.node.name: r for r in to_remove}
+
+    def _bound_candidates(self, eligible: List[str]) -> List[str]:
+        ratio = self.options.scale_down_candidates_pool_ratio
+        min_count = self.options.scale_down_candidates_pool_min_count
+        if ratio >= 1.0:
+            return eligible
+        pool_size = max(int(len(eligible) * ratio), min_count)
+        return eligible[:pool_size]
+
+    # -- decision (reference planner.go:134) ---------------------------------
+    def nodes_to_delete(self, snapshot: ClusterSnapshot, now_ts: float) -> ScaleDownPlan:
+        plan = ScaleDownPlan(unremovable=list(self._last_unremovable))
+        deletions_per_group: Dict[str, int] = {}
+
+        def group_of(node: Node):
+            g = self.provider.node_group_for_node(node)
+            return g.id() if g else None
+
+        for name in self.unneeded.names():
+            node = snapshot.get_node(name)
+            if node is None or self.deletion_tracker.is_being_deleted(name):
+                continue
+            gid = group_of(node)
+            if gid is None:
+                continue  # node outside any group is never deleted by us
+            in_group = self.deletion_tracker.deletions_in_group(
+                gid
+            ) + deletions_per_group.get(gid, 0)
+            if not self.unneeded.removable_at(
+                node, now_ts, self.options, self.provider, in_group
+            ):
+                continue
+            if name in self._empty_names:
+                if len(plan.empty) < self.options.max_empty_bulk_delete:
+                    plan.empty.append(NodeToRemove(node))
+                    deletions_per_group[gid] = deletions_per_group.get(gid, 0) + 1
+            elif name in self._drainable:
+                if len(plan.drain) < self.options.max_drain_parallelism:
+                    plan.drain.append(self._drainable[name])
+                    deletions_per_group[gid] = deletions_per_group.get(gid, 0) + 1
+        cap = self.options.max_scale_down_parallelism
+        total = len(plan.empty) + len(plan.drain)
+        if cap > 0 and total > cap:
+            keep_empty = min(len(plan.empty), cap)
+            plan.empty = plan.empty[:keep_empty]
+            plan.drain = plan.drain[: max(0, cap - keep_empty)]
+        return plan
+
+    def utilization_of(self, node_name: str) -> Optional[float]:
+        return self._utilization.get(node_name)
+
+    def unneeded_names(self) -> List[str]:
+        return self.unneeded.names()

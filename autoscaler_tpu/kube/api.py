@@ -1,0 +1,121 @@
+"""Cluster API boundary — the host's write path to the orchestration plane.
+
+The reference talks to the Kubernetes API server via client-go typed clients
+and the eviction API (cluster-autoscaler/core/scaledown/actuation/drain.go:83,
+utils/taints/taints.go, utils/kubernetes/listers.go:38). This framework keeps
+that boundary behind a small interface so the control loop is testable
+in-process (FakeClusterAPI) and bindable to any real control plane.
+"""
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from autoscaler_tpu.kube.objects import (
+    DELETION_CANDIDATE_TAINT,
+    NO_SCHEDULE,
+    PREFER_NO_SCHEDULE,
+    TO_BE_DELETED_TAINT,
+    Node,
+    Pod,
+    PodDisruptionBudget,
+    Taint,
+)
+
+
+class EvictionError(Exception):
+    pass
+
+
+class ClusterAPI(abc.ABC):
+    """List/watch + write operations the autoscaler needs."""
+
+    @abc.abstractmethod
+    def list_nodes(self) -> List[Node]: ...
+
+    @abc.abstractmethod
+    def list_pods(self) -> List[Pod]: ...
+
+    def list_pdbs(self) -> List[PodDisruptionBudget]:
+        return []
+
+    @abc.abstractmethod
+    def evict_pod(self, pod: Pod) -> None:
+        """Eviction-API analog; raises EvictionError on PDB rejection."""
+
+    @abc.abstractmethod
+    def add_taint(self, node_name: str, taint: Taint) -> None: ...
+
+    @abc.abstractmethod
+    def remove_taint(self, node_name: str, taint_key: str) -> None: ...
+
+    @abc.abstractmethod
+    def delete_node_object(self, node_name: str) -> None:
+        """Remove the Node object after cloud deletion."""
+
+    def record_event(self, kind: str, name: str, reason: str, message: str) -> None:
+        pass
+
+
+@dataclass
+class FakeClusterAPI(ClusterAPI):
+    """In-memory control plane for tests and local simulation."""
+
+    nodes: Dict[str, Node] = field(default_factory=dict)
+    pods: Dict[str, Pod] = field(default_factory=dict)
+    pdbs: List[PodDisruptionBudget] = field(default_factory=list)
+    evicted: List[str] = field(default_factory=list)
+    events: List[Tuple[str, str, str, str]] = field(default_factory=list)
+    fail_evictions_for: set = field(default_factory=set)
+
+    def add_node(self, node: Node) -> None:
+        self.nodes[node.name] = node
+
+    def add_pod(self, pod: Pod) -> None:
+        self.pods[pod.key()] = pod
+
+    def list_nodes(self) -> List[Node]:
+        return list(self.nodes.values())
+
+    def list_pods(self) -> List[Pod]:
+        return list(self.pods.values())
+
+    def list_pdbs(self) -> List[PodDisruptionBudget]:
+        return list(self.pdbs)
+
+    def evict_pod(self, pod: Pod) -> None:
+        if pod.key() in self.fail_evictions_for:
+            raise EvictionError(f"eviction of {pod.key()} rejected")
+        self.evicted.append(pod.key())
+        self.pods.pop(pod.key(), None)
+
+    def add_taint(self, node_name: str, taint: Taint) -> None:
+        node = self.nodes[node_name]
+        if not any(t.key == taint.key for t in node.taints):
+            node.taints.append(taint)
+
+    def remove_taint(self, node_name: str, taint_key: str) -> None:
+        node = self.nodes.get(node_name)
+        if node:
+            node.taints = [t for t in node.taints if t.key != taint_key]
+
+    def delete_node_object(self, node_name: str) -> None:
+        self.nodes.pop(node_name, None)
+        for key, pod in list(self.pods.items()):
+            if pod.node_name == node_name:
+                del self.pods[key]
+
+    def record_event(self, kind: str, name: str, reason: str, message: str) -> None:
+        self.events.append((kind, name, reason, message))
+
+
+def to_be_deleted_taint() -> Taint:
+    """reference utils/taints: ToBeDeletedByClusterAutoscaler NoSchedule."""
+    return Taint(key=TO_BE_DELETED_TAINT, value="", effect=NO_SCHEDULE)
+
+
+def deletion_candidate_taint() -> Taint:
+    """reference utils/taints: DeletionCandidateOfClusterAutoscaler
+    PreferNoSchedule (soft taint)."""
+    return Taint(key=DELETION_CANDIDATE_TAINT, value="", effect=PREFER_NO_SCHEDULE)

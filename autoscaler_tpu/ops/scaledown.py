@@ -1,0 +1,94 @@
+"""Scale-down device kernels: empty-node detection and batched
+node-removal (drain) feasibility — the masked refit over the fit tensor.
+
+Reference: cluster-autoscaler/simulator/cluster.go — FindNodesToRemove :116,
+SimulateNodeRemoval :145 (GetPodsToMove → fork → findPlaceFor :220), and
+FindEmptyNodesToRemove :187. The reference simulates one candidate at a time
+on a forked snapshot; here every candidate's refit runs as an independent
+vmap lane: lane j masks node j out of the fit tensor and greedily re-places
+j's movable pods onto the remaining capacity (a short scan over the node's
+pod slots). Independence across lanes matches the *categorization* semantics
+(planner.go:252 categorizeNodes evaluates each candidate against the same
+base state plus previously-moved pods; the final deletion set is re-validated
+sequentially host-side, as NodesToDelete does).
+
+BASELINE config #4: reschedule-feasibility over 5k nodes.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from autoscaler_tpu.kube.objects import PODS
+from autoscaler_tpu.snapshot.tensors import SnapshotTensors
+
+
+def empty_nodes(snap: SnapshotTensors, movable: jax.Array) -> jax.Array:
+    """[N] bool — nodes whose only pods are unmovable-but-ignorable
+    (daemonset/mirror), i.e. removable without any rescheduling
+    (reference FindEmptyNodesToRemove, cluster.go:187). `movable` is the
+    host-computed [P] drain-rules verdict: True = pod must be re-placed."""
+    pod_on_node = jax.nn.one_hot(
+        snap.pod_node, snap.num_nodes, dtype=jnp.float32
+    )  # [P, N]; pod_node=-1 rows are all-zero
+    movable_count = jnp.einsum(
+        "pn,p->n", pod_on_node, (movable & snap.pod_valid).astype(jnp.float32)
+    )
+    return snap.node_valid & (movable_count == 0)
+
+
+class RemovalFeasibility(NamedTuple):
+    feasible: jax.Array      # [C] bool — all movable pods of the candidate re-place
+    destinations: jax.Array  # [C, S] i32 — target node per pod slot, -1 if none
+    moved_counts: jax.Array  # [C] i32 — pods that found a new home
+
+
+@functools.partial(jax.jit, static_argnames=())
+def removal_feasibility(
+    snap: SnapshotTensors,
+    candidate_nodes: jax.Array,   # [C] i32 node indices to evaluate
+    pod_slots: jax.Array,         # [C, S] i32 pod indices on each candidate (-1 pad),
+                                  #   already filtered to movable pods by drain rules
+    blocked: jax.Array,           # [C] bool — drain rules forbid removal outright
+) -> RemovalFeasibility:
+    """Batched single-node removal refit. Each lane answers: if node j were
+    drained, could each of its movable pods be placed on some other node
+    (respecting current free capacity and the precomputed predicate mask),
+    greedily in slot order with capacity updates between placements — the
+    findPlaceFor semantics (cluster.go:220)."""
+    free0 = snap.free()  # [N, R]
+
+    def lane(j, slots, lane_blocked):
+        exclude = jnp.arange(snap.num_nodes) == j
+
+        def step(carry, pod_idx):
+            free = carry
+            valid_pod = pod_idx >= 0
+            safe_idx = jnp.maximum(pod_idx, 0)
+            req = snap.pod_req[safe_idx]
+            ok = (
+                jnp.all(req[None, :] <= free, axis=-1)
+                & snap.sched_mask[safe_idx]
+                & snap.node_valid
+                & ~exclude
+            )
+            has = ok.any()
+            dest = jnp.where(has, jnp.argmax(ok).astype(jnp.int32), -1)
+            place = valid_pod & has
+            target = jnp.maximum(dest, 0)
+            free = free.at[target].add(
+                jnp.where(place, -req, jnp.zeros_like(req))
+            )
+            placed_needed = jnp.where(valid_pod, place, True)
+            return free, (jnp.where(valid_pod, dest, -1), placed_needed, place)
+
+        # The drained node's capacity is not a destination: zero its free row.
+        free_start = jnp.where(exclude[:, None], 0.0, free0)
+        _, (dests, placed_ok, placed) = jax.lax.scan(step, free_start, slots)
+        feasible = placed_ok.all() & ~lane_blocked
+        return feasible, dests, placed.sum().astype(jnp.int32)
+
+    return RemovalFeasibility(*jax.vmap(lane)(candidate_nodes, pod_slots, blocked))

@@ -1,0 +1,38 @@
+"""Node utilization — vectorized over the whole cluster in one op.
+
+Reference: cluster-autoscaler/simulator/utilization/info.go:35,49,83 —
+utilization of a node is max(cpu, mem) of (requested / allocatable), except
+GPU nodes where the GPU fraction alone decides (GPU-dominant rule); DaemonSet
+and mirror pods can be excluded from the numerator via config. The reference
+computes this per node inside the eligibility loop; here it is one [N]
+reduction.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from autoscaler_tpu.kube.objects import CPU, GPU, MEMORY
+from autoscaler_tpu.snapshot.tensors import SnapshotTensors
+
+
+def node_utilization(
+    snap: SnapshotTensors,
+    exclude_used: jax.Array | None = None,  # [N, R] usage to subtract (daemonset/mirror)
+) -> jax.Array:
+    """[N] f32 — per-node utilization under the reference's dominant-resource
+    rule. Padding rows are 0."""
+    used = snap.node_used if exclude_used is None else snap.node_used - exclude_used
+    alloc = snap.node_alloc
+
+    def frac(axis):
+        return jnp.where(alloc[:, axis] > 0, used[:, axis] / alloc[:, axis], 0.0)
+
+    cpu_mem = jnp.maximum(frac(CPU), frac(MEMORY))
+    gpu_util = frac(GPU)
+    is_gpu_node = alloc[:, GPU] > 0
+    util = jnp.where(is_gpu_node, gpu_util, cpu_mem)
+    return jnp.where(snap.node_valid, util, 0.0)
+
+
+node_utilization_jit = jax.jit(node_utilization)

@@ -1,0 +1,148 @@
+"""RemovalSimulator: object-level orchestration of the scale-down kernels.
+
+Reference: cluster-autoscaler/simulator/cluster.go — RemovalSimulator,
+FindNodesToRemove :116, SimulateNodeRemoval :145, FindEmptyNodesToRemove
+:187, UnremovableReason enum :56-90. Candidates are batched into one
+removal_feasibility dispatch instead of per-node fork/refit/revert.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from autoscaler_tpu.kube.objects import Node, Pod, PodDisruptionBudget
+from autoscaler_tpu.ops.scaledown import empty_nodes as empty_nodes_kernel
+from autoscaler_tpu.ops.scaledown import removal_feasibility
+from autoscaler_tpu.simulator.drain import (
+    BlockingPod,
+    DrainabilityRules,
+    get_pods_to_move,
+)
+from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+
+import jax.numpy as jnp
+
+
+class UnremovableReason(enum.Enum):
+    """reference: simulator/cluster.go:56-90 (subset exercised here)."""
+
+    NO_REASON = "NoReason"
+    BLOCKED_BY_POD = "BlockedByPod"
+    NO_PLACE_TO_MOVE_PODS = "NoPlaceToMovePods"
+    NOT_UNNEEDED_LONG_ENOUGH = "NotUnneededLongEnough"
+    NOT_UNREADY_LONG_ENOUGH = "NotUnreadyLongEnough"
+    NODE_GROUP_MIN_SIZE_REACHED = "NodeGroupMinSizeReached"
+    MINIMAL_RESOURCE_LIMIT_EXCEEDED = "MinimalResourceLimitExceeded"
+    SCALE_DOWN_DISABLED_ANNOTATION = "ScaleDownDisabledAnnotation"
+    NOT_UTILIZED_ENOUGH = "NotUnderutilized"
+    UNREADY_NOT_ALLOWED = "UnreadyNotAllowed"
+    RECENTLY_UNREMOVABLE = "RecentlyUnremovable"
+
+
+@dataclass
+class NodeToRemove:
+    node: Node
+    pods_to_reschedule: List[Pod] = field(default_factory=list)
+    destinations: Dict[str, str] = field(default_factory=dict)  # pod key → node name
+
+
+@dataclass
+class UnremovableNode:
+    node: Node
+    reason: UnremovableReason
+    blocking_pod: Optional[BlockingPod] = None
+
+
+class RemovalSimulator:
+    def __init__(self, rules: Optional[DrainabilityRules] = None):
+        self.rules = rules or DrainabilityRules()
+
+    def find_empty_nodes(
+        self, snapshot: ClusterSnapshot, candidates: Sequence[str]
+    ) -> List[str]:
+        """Nodes among candidates with no pods needing rescheduling
+        (reference cluster.go:187)."""
+        tensors, meta = snapshot.tensors()
+        movable = np.zeros(tensors.num_pods, bool)
+        for i, pod in enumerate(meta.pods):
+            movable[i] = not (pod.mirror or pod.daemonset)
+        empty = np.asarray(empty_nodes_kernel(tensors, jnp.asarray(movable)))
+        out = []
+        for name in candidates:
+            j = meta.node_index.get(name)
+            if j is not None and empty[j]:
+                out.append(name)
+        return out
+
+    def find_nodes_to_remove(
+        self,
+        snapshot: ClusterSnapshot,
+        candidates: Sequence[str],
+        pdbs: Sequence[PodDisruptionBudget] = (),
+        max_pods_per_node: int = 128,
+    ) -> Tuple[List[NodeToRemove], List[UnremovableNode]]:
+        """Batched FindNodesToRemove (reference cluster.go:116): drain rules
+        per candidate on host, then ONE removal_feasibility dispatch for all
+        candidates."""
+        tensors, meta = snapshot.tensors()
+        cand_names = [c for c in candidates if c in meta.node_index]
+        if not cand_names:
+            return [], []
+
+        C = len(cand_names)
+        S = max_pods_per_node
+        cand_idx = np.zeros(C, np.int32)
+        pod_slots = np.full((C, S), -1, np.int32)
+        blocked = np.zeros(C, bool)
+        blocking: Dict[str, BlockingPod] = {}
+        movable_pods: Dict[str, List[Pod]] = {}
+
+        for ci, name in enumerate(cand_names):
+            cand_idx[ci] = meta.node_index[name]
+            pods_on = snapshot.pods_on_node(name)
+            to_move, block = get_pods_to_move(pods_on, self.rules, pdbs)
+            if block is not None:
+                blocked[ci] = True
+                blocking[name] = block
+                continue
+            movable_pods[name] = to_move
+            for si, pod in enumerate(to_move[:S]):
+                pod_slots[ci, si] = meta.pod_index[pod.key()]
+            if len(to_move) > S:
+                blocked[ci] = True  # too many pods to evaluate — conservative
+
+        res = removal_feasibility(
+            tensors,
+            jnp.asarray(cand_idx),
+            jnp.asarray(pod_slots),
+            jnp.asarray(blocked),
+        )
+        feasible = np.asarray(res.feasible)
+        dests = np.asarray(res.destinations)
+
+        to_remove: List[NodeToRemove] = []
+        unremovable: List[UnremovableNode] = []
+        for ci, name in enumerate(cand_names):
+            node = snapshot.get_node(name)
+            if blocked[ci]:
+                unremovable.append(
+                    UnremovableNode(
+                        node, UnremovableReason.BLOCKED_BY_POD, blocking.get(name)
+                    )
+                )
+            elif feasible[ci]:
+                moves = movable_pods.get(name, [])
+                destinations = {
+                    pod.key(): meta.nodes[dests[ci, si]].name
+                    for si, pod in enumerate(moves[:S])
+                    if dests[ci, si] >= 0
+                }
+                to_remove.append(NodeToRemove(node, moves, destinations))
+            else:
+                unremovable.append(
+                    UnremovableNode(node, UnremovableReason.NO_PLACE_TO_MOVE_PODS)
+                )
+        return to_remove, unremovable

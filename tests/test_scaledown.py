@@ -1,0 +1,379 @@
+"""Scale-down tests: utilization kernel, empty-node detection, removal
+feasibility refit, drain rules, planner categorization + unneeded-time gates,
+actuator taint/evict/delete flow (modeled on the reference's eligibility,
+cluster.go RemovalSimulator, and actuator tests)."""
+import numpy as np
+import pytest
+
+from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.core.scaledown.actuator import ScaleDownActuator
+from autoscaler_tpu.core.scaledown.eligibility import EligibilityChecker
+from autoscaler_tpu.core.scaledown.planner import ScaleDownPlanner
+from autoscaler_tpu.core.scaledown.tracking import (
+    NodeDeletionTracker,
+    RemainingPdbTracker,
+    UnneededNodes,
+    UnremovableNodesCache,
+)
+from autoscaler_tpu.kube.api import FakeClusterAPI
+from autoscaler_tpu.kube.objects import (
+    SAFE_TO_EVICT_ANNOTATION,
+    SCALE_DOWN_DISABLED_ANNOTATION,
+    TO_BE_DELETED_TAINT,
+    LabelSelector,
+    OwnerRef,
+    PodDisruptionBudget,
+)
+from autoscaler_tpu.ops.utilization import node_utilization
+from autoscaler_tpu.simulator.drain import (
+    BlockingReason,
+    DrainabilityRules,
+    get_pods_to_move,
+)
+from autoscaler_tpu.simulator.removal import RemovalSimulator, UnremovableReason
+from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+from autoscaler_tpu.utils.test_utils import GB, MB, build_test_node, build_test_pod
+
+
+def snapshot_with(nodes, pods_with_nodes):
+    s = ClusterSnapshot()
+    for n in nodes:
+        s.add_node(n)
+    for pod, node_name in pods_with_nodes:
+        s.add_pod(pod, node_name)
+    return s
+
+
+class TestUtilization:
+    def test_dominant_resource(self):
+        s = snapshot_with(
+            [build_test_node("n0", cpu_m=1000, mem=1000 * MB)],
+            [(build_test_pod("p", cpu_m=800, mem=200 * MB), "n0")],
+        )
+        t, meta = s.tensors()
+        u = np.asarray(node_utilization(t))
+        assert u[meta.node_index["n0"]] == pytest.approx(0.8)  # cpu dominates
+
+    def test_gpu_dominant(self):
+        n = build_test_node("g", cpu_m=1000, gpu=4)
+        pod = build_test_pod("p", cpu_m=900)
+        pod.requests = pod.requests.__class__(cpu_m=900, gpu=1)
+        s = snapshot_with([n], [(pod, "g")])
+        t, meta = s.tensors()
+        u = np.asarray(node_utilization(t))
+        assert u[meta.node_index["g"]] == pytest.approx(0.25)  # gpu rule
+
+
+class TestDrainRules:
+    def test_replicated_pod_moves(self):
+        pods = [build_test_pod("p")]
+        to_move, block = get_pods_to_move(pods, DrainabilityRules())
+        assert block is None and len(to_move) == 1
+
+    def test_unreplicated_blocks(self):
+        pod = build_test_pod("naked", owner_kind="")
+        to_move, block = get_pods_to_move([pod], DrainabilityRules())
+        assert block is not None and block.reason == BlockingReason.NOT_REPLICATED
+
+    def test_safe_to_evict_annotation_overrides(self):
+        pod = build_test_pod("naked", owner_kind="")
+        pod.annotations[SAFE_TO_EVICT_ANNOTATION] = "true"
+        to_move, block = get_pods_to_move([pod], DrainabilityRules())
+        assert block is None and len(to_move) == 1
+
+    def test_not_safe_to_evict_blocks(self):
+        pod = build_test_pod("p")
+        pod.annotations[SAFE_TO_EVICT_ANNOTATION] = "false"
+        _, block = get_pods_to_move([pod], DrainabilityRules())
+        assert block.reason == BlockingReason.NOT_SAFE_TO_EVICT_ANNOTATION
+
+    def test_local_storage_blocks(self):
+        pod = build_test_pod("p")
+        pod.local_storage = True
+        _, block = get_pods_to_move([pod], DrainabilityRules())
+        assert block.reason == BlockingReason.LOCAL_STORAGE_REQUESTED
+
+    def test_kube_system_without_pdb_blocks(self):
+        pod = build_test_pod("sys", namespace="kube-system")
+        _, block = get_pods_to_move([pod], DrainabilityRules())
+        assert block.reason == BlockingReason.UNMOVABLE_KUBE_SYSTEM_POD
+
+    def test_kube_system_with_pdb_moves(self):
+        pod = build_test_pod("sys", namespace="kube-system", labels={"k": "v"})
+        pdb = PodDisruptionBudget(
+            "pdb", "kube-system", LabelSelector.from_dict({"k": "v"}), disruptions_allowed=1
+        )
+        to_move, block = get_pods_to_move([pod], DrainabilityRules(), [pdb])
+        assert block is None and len(to_move) == 1
+
+    def test_pdb_exhausted_blocks(self):
+        pods = [build_test_pod(f"p{i}", labels={"app": "x"}) for i in range(3)]
+        pdb = PodDisruptionBudget(
+            "pdb", "default", LabelSelector.from_dict({"app": "x"}), disruptions_allowed=2
+        )
+        _, block = get_pods_to_move(pods, DrainabilityRules(), [pdb])
+        assert block.reason == BlockingReason.NOT_ENOUGH_PDB
+
+    def test_mirror_and_daemonset_ignored(self):
+        mirror = build_test_pod("m", owner_kind="")
+        mirror.mirror = True
+        ds = build_test_pod("d")
+        ds.daemonset = True
+        to_move, block = get_pods_to_move([mirror, ds], DrainabilityRules())
+        assert block is None and to_move == []
+
+
+class TestRemovalSimulator:
+    def test_find_empty_nodes(self):
+        ds = build_test_pod("ds")
+        ds.daemonset = True
+        s = snapshot_with(
+            [build_test_node("empty"), build_test_node("ds-only"), build_test_node("busy")],
+            [(ds, "ds-only"), (build_test_pod("p"), "busy")],
+        )
+        sim = RemovalSimulator()
+        empty = sim.find_empty_nodes(s, ["empty", "ds-only", "busy"])
+        assert set(empty) == {"empty", "ds-only"}
+
+    def test_feasible_removal(self):
+        # n0's pod fits on n1
+        s = snapshot_with(
+            [build_test_node("n0", cpu_m=1000), build_test_node("n1", cpu_m=2000)],
+            [(build_test_pod("p", cpu_m=500), "n0")],
+        )
+        sim = RemovalSimulator()
+        to_remove, unremovable = sim.find_nodes_to_remove(s, ["n0"])
+        assert len(to_remove) == 1
+        assert to_remove[0].node.name == "n0"
+        assert to_remove[0].destinations == {"default/p": "n1"}
+
+    def test_infeasible_removal(self):
+        s = snapshot_with(
+            [build_test_node("n0", cpu_m=1000), build_test_node("n1", cpu_m=600)],
+            [
+                (build_test_pod("p", cpu_m=800), "n0"),
+                (build_test_pod("q", cpu_m=500), "n1"),
+            ],
+        )
+        sim = RemovalSimulator()
+        to_remove, unremovable = sim.find_nodes_to_remove(s, ["n0"])
+        assert to_remove == []
+        assert unremovable[0].reason == UnremovableReason.NO_PLACE_TO_MOVE_PODS
+
+    def test_blocking_pod(self):
+        naked = build_test_pod("naked", owner_kind="")
+        s = snapshot_with(
+            [build_test_node("n0"), build_test_node("n1")], [(naked, "n0")]
+        )
+        sim = RemovalSimulator()
+        to_remove, unremovable = sim.find_nodes_to_remove(s, ["n0"])
+        assert to_remove == []
+        assert unremovable[0].reason == UnremovableReason.BLOCKED_BY_POD
+
+    def test_capacity_accounting_across_moves(self):
+        # two pods on n0; n1 fits only one — must be infeasible
+        s = snapshot_with(
+            [build_test_node("n0", cpu_m=2000), build_test_node("n1", cpu_m=1000)],
+            [
+                (build_test_pod("a", cpu_m=600), "n0"),
+                (build_test_pod("b", cpu_m=600), "n0"),
+            ],
+        )
+        sim = RemovalSimulator()
+        to_remove, unremovable = sim.find_nodes_to_remove(s, ["n0"])
+        assert to_remove == []
+        assert unremovable[0].reason == UnremovableReason.NO_PLACE_TO_MOVE_PODS
+
+
+class TestEligibility:
+    def _snap(self):
+        nodes = [
+            build_test_node("low", cpu_m=1000),
+            build_test_node("high", cpu_m=1000),
+        ]
+        return snapshot_with(
+            nodes,
+            [
+                (build_test_pod("l", cpu_m=200), "low"),
+                (build_test_pod("h", cpu_m=900), "high"),
+            ],
+        ), nodes
+
+    def test_utilization_threshold(self):
+        s, nodes = self._snap()
+        checker = EligibilityChecker(AutoscalingOptions())
+        eligible, util, unremovable = checker.filter_out_unremovable(s, nodes, 0.0)
+        assert eligible == ["low"]
+        assert util["high"] == pytest.approx(0.9)
+        assert unremovable[0].reason == UnremovableReason.NOT_UTILIZED_ENOUGH
+
+    def test_disabled_annotation(self):
+        s, nodes = self._snap()
+        nodes[0].annotations[SCALE_DOWN_DISABLED_ANNOTATION] = "true"
+        checker = EligibilityChecker(AutoscalingOptions())
+        eligible, _, unremovable = checker.filter_out_unremovable(s, nodes, 0.0)
+        assert eligible == []
+        reasons = {u.reason for u in unremovable}
+        assert UnremovableReason.SCALE_DOWN_DISABLED_ANNOTATION in reasons
+
+    def test_unremovable_cache_skips(self):
+        s, nodes = self._snap()
+        cache = UnremovableNodesCache(ttl_s=100)
+        cache.add("low", now_ts=0.0)
+        checker = EligibilityChecker(AutoscalingOptions())
+        eligible, _, unremovable = checker.filter_out_unremovable(s, nodes, 10.0, cache)
+        assert "low" not in eligible
+        assert any(
+            u.reason == UnremovableReason.RECENTLY_UNREMOVABLE for u in unremovable
+        )
+
+
+class TestUnneededTracking:
+    def test_unneeded_time_gate(self):
+        p = TestCloudProvider()
+        p.add_node_group("g", 0, 10, 2, build_test_node("t"))
+        node = build_test_node("n0")
+        p.add_node("g", node)
+        opts = AutoscalingOptions()
+        opts.node_group_defaults.scale_down_unneeded_time_s = 600
+        tracker = UnneededNodes()
+        tracker.update([node], now_ts=0.0)
+        assert not tracker.removable_at(node, 100.0, opts, p)
+        assert tracker.removable_at(node, 700.0, opts, p)
+
+    def test_min_size_gate(self):
+        p = TestCloudProvider()
+        p.add_node_group("g", 2, 10, 2, build_test_node("t"))
+        node = build_test_node("n0")
+        p.add_node("g", node)
+        opts = AutoscalingOptions()
+        opts.node_group_defaults.scale_down_unneeded_time_s = 0
+        tracker = UnneededNodes()
+        tracker.update([node], now_ts=0.0)
+        assert not tracker.removable_at(node, 10.0, opts, p)  # would go below min
+
+    def test_interrupted_unneeded_resets(self):
+        node = build_test_node("n0")
+        opts = AutoscalingOptions()
+        opts.node_group_defaults.scale_down_unneeded_time_s = 100
+        tracker = UnneededNodes()
+        tracker.update([node], now_ts=0.0)
+        tracker.update([], now_ts=50.0)      # became needed again
+        tracker.update([node], now_ts=60.0)  # unneeded anew
+        assert not tracker.removable_at(node, 120.0, opts)
+
+
+class TestPdbTracker:
+    def test_budget_accounting(self):
+        pdb = PodDisruptionBudget(
+            "pdb", "default", LabelSelector.from_dict({"a": "b"}), disruptions_allowed=1
+        )
+        t = RemainingPdbTracker([pdb])
+        p1 = build_test_pod("p1", labels={"a": "b"})
+        p2 = build_test_pod("p2", labels={"a": "b"})
+        assert t.can_remove_pods([p1])
+        t.remove_pods([p1])
+        assert not t.can_remove_pods([p2])
+
+
+class TestPlannerAndActuator:
+    def _world(self):
+        provider = TestCloudProvider()
+        template = build_test_node("tmpl", cpu_m=1000, mem=2 * GB)
+        provider.add_node_group("g", 0, 10, 3, template)
+        api = FakeClusterAPI()
+        nodes = []
+        for i in range(3):
+            n = build_test_node(f"n{i}", cpu_m=1000, mem=2 * GB)
+            provider.add_node("g", n)
+            api.add_node(n)
+            nodes.append(n)
+        # n0 empty; n1 lightly used (pod fits n2); n2 moderately used
+        p1 = build_test_pod("p1", cpu_m=200, mem=100 * MB)
+        p1.node_name = "n1"
+        p2 = build_test_pod("p2", cpu_m=400, mem=100 * MB)
+        p2.node_name = "n2"
+        api.add_pod(p1)
+        api.add_pod(p2)
+        snapshot = snapshot_with(nodes, [(p1, "n1"), (p2, "n2")])
+        opts = AutoscalingOptions()
+        opts.node_group_defaults.scale_down_unneeded_time_s = 100
+        return provider, api, snapshot, nodes, opts
+
+    def test_planner_categorizes(self):
+        provider, api, snapshot, nodes, opts = self._world()
+        planner = ScaleDownPlanner(provider, opts)
+        planner.update_cluster_state(snapshot, nodes, [], now_ts=0.0)
+        assert set(planner.unneeded_names()) == {"n0", "n1", "n2"}
+
+    def test_planner_unneeded_time_then_delete(self):
+        provider, api, snapshot, nodes, opts = self._world()
+        planner = ScaleDownPlanner(provider, opts)
+        planner.update_cluster_state(snapshot, nodes, [], now_ts=0.0)
+        plan0 = planner.nodes_to_delete(snapshot, now_ts=0.0)
+        assert plan0.empty == [] and plan0.drain == []  # not unneeded long enough
+        planner.update_cluster_state(snapshot, nodes, [], now_ts=150.0)
+        plan = planner.nodes_to_delete(snapshot, now_ts=150.0)
+        empty_names = [r.node.name for r in plan.empty]
+        drain_names = [r.node.name for r in plan.drain]
+        assert "n0" in empty_names
+        assert len(drain_names) <= opts.max_drain_parallelism
+
+    def test_actuator_end_to_end(self):
+        provider, api, snapshot, nodes, opts = self._world()
+        planner = ScaleDownPlanner(provider, opts)
+        planner.update_cluster_state(snapshot, nodes, [], now_ts=0.0)
+        planner.update_cluster_state(snapshot, nodes, [], now_ts=150.0)
+        plan = planner.nodes_to_delete(snapshot, now_ts=150.0)
+        actuator = ScaleDownActuator(provider, opts, api, planner.deletion_tracker)
+        result = actuator.start_deletion(plan, now_ts=150.0)
+        assert "n0" in result.deleted_empty
+        assert provider.scale_down_calls  # cloud API hit
+        deleted = {name for _, name in provider.scale_down_calls}
+        assert "n0" in deleted
+        assert "n0" not in api.nodes  # node object removed
+        # drained node's pods were evicted first
+        for name in result.deleted_drain:
+            assert name not in api.nodes
+        if result.deleted_drain:
+            assert api.evicted
+
+    def test_actuator_failed_eviction_rolls_back_taint(self):
+        provider, api, snapshot, nodes, opts = self._world()
+        api.fail_evictions_for = {"default/p1"}
+        planner = ScaleDownPlanner(provider, opts)
+        planner.update_cluster_state(snapshot, nodes, [], now_ts=0.0)
+        planner.update_cluster_state(snapshot, nodes, [], now_ts=150.0)
+        plan = planner.nodes_to_delete(snapshot, now_ts=150.0)
+        drain_names = [r.node.name for r in plan.drain]
+        actuator = ScaleDownActuator(provider, opts, api, planner.deletion_tracker)
+        result = actuator.start_deletion(plan, now_ts=150.0)
+        if "n1" in drain_names:
+            assert "n1" in result.failed
+            n1 = api.nodes["n1"]
+            assert not any(t.key == TO_BE_DELETED_TAINT for t in n1.taints)
+
+    def test_soft_taints(self):
+        provider, api, snapshot, nodes, opts = self._world()
+        planner = ScaleDownPlanner(provider, opts)
+        planner.update_cluster_state(snapshot, nodes, [], now_ts=0.0)
+        actuator = ScaleDownActuator(provider, opts, api, planner.deletion_tracker)
+        changed = actuator.update_soft_deletion_taints(nodes, planner.unneeded_names())
+        assert changed == 3
+        from autoscaler_tpu.kube.objects import DELETION_CANDIDATE_TAINT
+
+        assert any(t.key == DELETION_CANDIDATE_TAINT for t in api.nodes["n0"].taints)
+        # node becomes needed again → taint removed
+        changed2 = actuator.update_soft_deletion_taints(nodes, [])
+        assert changed2 == 3
+
+    def test_cleanup_leftover_taints(self):
+        provider, api, snapshot, nodes, opts = self._world()
+        from autoscaler_tpu.kube.api import to_be_deleted_taint
+
+        api.add_taint("n0", to_be_deleted_taint())
+        actuator = ScaleDownActuator(provider, opts, api)
+        removed = actuator.clean_up_to_be_deleted_taints(api.list_nodes())
+        assert removed == 1
+        assert not api.nodes["n0"].taints
