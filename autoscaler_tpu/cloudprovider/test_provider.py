@@ -69,11 +69,19 @@ class TestNodeGroup(NodeGroup):
         self._provider._on_scale_up(self._name, delta)
 
     def delete_nodes(self, nodes: Sequence[Node]) -> None:
+        ids = {i.id for i in self._provider._instances.get(self._name, [])}
         for node in nodes:
-            if self._provider.node_group_for_node(node) is not self:
+            group = self._provider.node_group_for_node(node)
+            if group is not None:
+                if group is not self:
+                    raise NodeGroupError(f"{node.name} belongs to {group.id()}")
+            elif node.name not in ids and node.provider_id not in ids:
+                # unregistered instance (e.g. stuck provisioning) — accept only
+                # if it is one of this group's cloud instances
                 raise NodeGroupError(f"{node.name} does not belong to {self._name}")
         self._target -= len(nodes)
         for node in nodes:
+            self._provider._remove_instance(self._name, node)
             self._provider._on_scale_down(self._name, node.name)
 
     def decrease_target_size(self, delta: int) -> None:
@@ -158,6 +166,17 @@ class TestCloudProvider(CloudProvider):
         self.scale_up_calls.append((group, delta))
         if self.on_scale_up:
             self.on_scale_up(group, delta)
+
+    def _remove_instance(self, group: str, node: Node) -> None:
+        """Remove at most one instance per deleted node (prefer provider_id)."""
+        instances = self._instances.get(group, [])
+        for key in (node.provider_id, node.name):
+            if not key:
+                continue
+            for i, inst in enumerate(instances):
+                if inst.id == key:
+                    del instances[i]
+                    return
 
     def _on_scale_down(self, group: str, node_name: str) -> None:
         self.scale_down_calls.append((group, node_name))

@@ -1,0 +1,36 @@
+"""Pod list processing before scale-up: drop pods that already fit existing
+capacity.
+
+Reference: cluster-autoscaler/core/podlistprocessor/ — the default pipeline
+is currently-drained-nodes injection + filter-out-schedulable
+(filter_out_schedulable.go:46,95: priority-sorted hinted packing of pending
+pods onto existing free capacity; whatever fits is removed from the scale-up
+trigger list). The packing runs as one greedy-schedule dispatch on device.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from autoscaler_tpu.kube.objects import Pod
+from autoscaler_tpu.simulator.hinting import HintingSimulator
+from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+
+
+class FilterOutSchedulablePodListProcessor:
+    def __init__(self, hinting: HintingSimulator | None = None):
+        self.hinting = hinting or HintingSimulator()
+
+    def process(
+        self, snapshot: ClusterSnapshot, pending: Sequence[Pod]
+    ) -> Tuple[List[Pod], List[Pod]]:
+        """→ (still_pending, filtered_as_schedulable). Pods are packed in
+        priority order, highest first (filter_out_schedulable.go:95), onto a
+        fork of the snapshot; placements are committed to the fork so later
+        pods see the consumed capacity."""
+        if not pending:
+            return [], []
+        ordered = sorted(pending, key=lambda p: -p.priority)
+        scheduled, _ = self.hinting.try_schedule_pods(snapshot, ordered, commit=True)
+        scheduled_keys = {p.key() for p in scheduled}
+        still_pending = [p for p in pending if p.key() not in scheduled_keys]
+        return still_pending, scheduled

@@ -1,0 +1,290 @@
+"""StaticAutoscaler: one reconcile iteration (RunOnce) per scan interval.
+
+Reference: cluster-autoscaler/core/static_autoscaler.go — RunOnce :288
+(see SURVEY.md §3.2 for the full stack): leftover-taint cleanup :230,
+node/pod listing :304, provider refresh :333, snapshot init :250, cluster
+state update :376, unregistered-node cleanup / fixNodeGroupSize :413-455
+:707-773, expendable filter + upcoming-node injection :471-519,
+filter-out-schedulable :528, ScaleUp branch :560-580, ScaleDown branch
+:582-691 with cooldown gates :628-640, soft taints :676.
+
+The decision hot paths (predicate fit, binpacking, utilization, removal
+refit, greedy packing) all run as batched device kernels; this loop is the
+thin host shell around them.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from autoscaler_tpu.cloudprovider.interface import CloudProvider, InstanceState
+from autoscaler_tpu.clusterstate.registry import ClusterStateRegistry
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.core.podlistprocessor import FilterOutSchedulablePodListProcessor
+from autoscaler_tpu.core.scaledown.actuator import ActuationResult, ScaleDownActuator
+from autoscaler_tpu.core.scaledown.planner import ScaleDownPlanner
+from autoscaler_tpu.core.scaleup.orchestrator import ScaleUpOrchestrator, ScaleUpResult
+from autoscaler_tpu.kube.api import ClusterAPI
+from autoscaler_tpu.kube.objects import Node, Pod
+from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+
+
+@dataclass
+class RunOnceResult:
+    scale_up: Optional[ScaleUpResult] = None
+    scale_down: Optional[ActuationResult] = None
+    scale_down_in_cooldown: bool = False
+    cluster_healthy: bool = True
+    pending_pods: int = 0
+    filtered_schedulable: int = 0
+    unneeded_nodes: int = 0
+    removed_unregistered: int = 0
+    errors: List[str] = field(default_factory=list)
+
+
+class StaticAutoscaler:
+    def __init__(
+        self,
+        provider: CloudProvider,
+        api: ClusterAPI,
+        options: Optional[AutoscalingOptions] = None,
+        csr: Optional[ClusterStateRegistry] = None,
+        scale_up_orchestrator: Optional[ScaleUpOrchestrator] = None,
+        scale_down_planner: Optional[ScaleDownPlanner] = None,
+        scale_down_actuator: Optional[ScaleDownActuator] = None,
+        pod_list_processor: Optional[FilterOutSchedulablePodListProcessor] = None,
+    ):
+        self.provider = provider
+        self.api = api
+        self.options = options or AutoscalingOptions()
+        self.csr = csr or ClusterStateRegistry(provider, self.options)
+        self.scale_up_orchestrator = scale_up_orchestrator or ScaleUpOrchestrator(
+            provider, self.options, self.csr
+        )
+        self.scale_down_planner = scale_down_planner or ScaleDownPlanner(
+            provider, self.options
+        )
+        self.scale_down_actuator = scale_down_actuator or ScaleDownActuator(
+            provider,
+            self.options,
+            api,
+            self.scale_down_planner.deletion_tracker,
+        )
+        self.pod_list_processor = pod_list_processor or FilterOutSchedulablePodListProcessor()
+        self.last_scale_up_ts: Optional[float] = None
+        self.last_scale_down_delete_ts: Optional[float] = None
+        self.last_scale_down_fail_ts: Optional[float] = None
+        self._initialized = False
+
+    # -- one reconcile iteration (reference :288) ----------------------------
+    def run_once(self, now_ts: float) -> RunOnceResult:
+        result = RunOnceResult()
+
+        # startup: clean leftover taints from a crashed predecessor (:230)
+        if not self._initialized:
+            self.scale_down_actuator.clean_up_to_be_deleted_taints(self.api.list_nodes())
+            self._initialized = True
+
+        # 1. observe the world (:304) and refresh cloud caches (:333)
+        try:
+            self.provider.refresh()
+        except Exception as e:
+            result.errors.append(f"provider refresh failed: {e}")
+            return result
+        all_nodes = self.api.list_nodes()
+        all_pods = self.api.list_pods()
+        pdbs = self.api.list_pdbs()
+
+        # 2. cluster state accounting (:376)
+        self.csr.update_nodes(all_nodes, now_ts)
+        result.cluster_healthy = self.csr.is_cluster_healthy()
+        if not result.cluster_healthy:
+            result.errors.append("cluster unhealthy: too many unready nodes")
+            return result
+
+        # 3. stuck-provision recovery (:413-455, :707-773)
+        result.removed_unregistered = self._remove_old_unregistered(now_ts)
+        self._delete_created_nodes_with_errors()
+
+        # 4. build the snapshot (:250-354)
+        snapshot = ClusterSnapshot()
+        scheduled, pending = self._split_pods(all_pods)
+        for node in all_nodes:
+            snapshot.add_node(node)
+        for pod in scheduled:
+            if snapshot.get_node(pod.node_name) is not None:
+                snapshot.add_pod(pod, pod.node_name)
+        for pod in pending:
+            snapshot.add_pod(pod)
+
+        # expendable filter (:471) + young-pod filter (:832)
+        pending = [
+            p
+            for p in pending
+            if p.priority >= self.options.expendable_pods_priority_cutoff
+        ]
+        if self.options.new_pod_scale_up_delay_s > 0:
+            pending = [
+                p
+                for p in pending
+                if now_ts - p.creation_ts >= self.options.new_pod_scale_up_delay_s
+            ]
+
+        # upcoming (requested-not-yet-registered) nodes join the simulation as
+        # virtual template nodes (:484-519)
+        upcoming_names = self._inject_upcoming_nodes(snapshot)
+
+        # 5. filter-out-schedulable (:528) — device-packed onto a fork
+        snapshot.fork()
+        pending, filtered = self.pod_list_processor.process(snapshot, pending)
+        snapshot.revert()
+        result.filtered_schedulable = len(filtered)
+        result.pending_pods = len(pending)
+
+        # 6. scale-up (:560-580)
+        if pending:
+            up = self.scale_up_orchestrator.scale_up(pending, all_nodes, now_ts)
+            result.scale_up = up
+            if up.scaled_up:
+                self.last_scale_up_ts = now_ts
+        min_size_ups = self.scale_up_orchestrator.scale_up_to_node_group_min_size(now_ts)
+        if min_size_ups:
+            self.last_scale_up_ts = now_ts
+
+        # 7. scale-down branch (:582-691)
+        if self.options.scale_down_enabled:
+            candidates = self._scale_down_candidates(all_nodes, upcoming_names)
+            self.scale_down_planner.update_cluster_state(
+                snapshot, candidates, pdbs, now_ts
+            )
+            result.unneeded_nodes = len(self.scale_down_planner.unneeded_names())
+            in_cooldown = self._scale_down_in_cooldown(now_ts)
+            result.scale_down_in_cooldown = in_cooldown
+            if not in_cooldown:
+                plan = self.scale_down_planner.nodes_to_delete(snapshot, now_ts)
+                if plan.empty or plan.drain:
+                    down = self.scale_down_actuator.start_deletion(plan, now_ts)
+                    result.scale_down = down
+                    if down.deleted_empty or down.deleted_drain:
+                        self.last_scale_down_delete_ts = now_ts
+                        self.csr.register_scale_down(now_ts)
+                    if down.failed:
+                        self.last_scale_down_fail_ts = now_ts
+            # keep soft taints in sync either way (:676)
+            self.scale_down_actuator.update_soft_deletion_taints(
+                self.api.list_nodes(), self.scale_down_planner.unneeded_names()
+            )
+        return result
+
+    # -- helpers -------------------------------------------------------------
+    def _split_pods(self, pods: Sequence[Pod]) -> Tuple[List[Pod], List[Pod]]:
+        scheduled, pending = [], []
+        for pod in pods:
+            (scheduled if pod.node_name else pending).append(pod)
+        return scheduled, pending
+
+    def _inject_upcoming_nodes(self, snapshot: ClusterSnapshot) -> List[str]:
+        """Virtual nodes for capacity that was requested but hasn't
+        registered (:484-519) so we don't double scale-up."""
+        injected: List[str] = []
+        upcoming = self.csr.get_upcoming_nodes()
+        groups = {g.id(): g for g in self.provider.node_groups()}
+        for gid, count in upcoming.items():
+            group = groups.get(gid)
+            if group is None:
+                continue
+            try:
+                template = group.template_node_info()
+            except Exception:
+                continue
+            for i in range(count):
+                virtual = dataclasses.replace(
+                    template,
+                    name=f"upcoming-{gid}-{i}",
+                    taints=list(template.taints),
+                    labels=dict(template.labels),
+                )
+                snapshot.add_node(virtual)
+                injected.append(virtual.name)
+        return injected
+
+    def _scale_down_candidates(
+        self, all_nodes: Sequence[Node], upcoming_names: Sequence[str]
+    ) -> List[Node]:
+        upcoming = set(upcoming_names)
+        out = []
+        for node in all_nodes:
+            if node.name in upcoming:
+                continue
+            if self.scale_down_planner.deletion_tracker.is_being_deleted(node.name):
+                continue
+            out.append(node)
+        return out
+
+    def _scale_down_in_cooldown(self, now_ts: float) -> bool:
+        """reference :628-640."""
+        o = self.options
+        if (
+            self.last_scale_up_ts is not None
+            and now_ts - self.last_scale_up_ts < o.scale_down_delay_after_add_s
+        ):
+            return True
+        delay_after_delete = o.scale_down_delay_after_delete_s or o.scan_interval_s
+        if (
+            self.last_scale_down_delete_ts is not None
+            and now_ts - self.last_scale_down_delete_ts < delay_after_delete
+        ):
+            return True
+        if (
+            self.last_scale_down_fail_ts is not None
+            and now_ts - self.last_scale_down_fail_ts < o.scale_down_delay_after_failure_s
+        ):
+            return True
+        return False
+
+    def _remove_old_unregistered(self, now_ts: float) -> int:
+        """Instances stuck creating past the provision timeout are deleted
+        (:732)."""
+        removed = 0
+        unregistered = self.csr.unregistered_instances()
+        groups = {g.id(): g for g in self.provider.node_groups()}
+        for gid, instances in unregistered.items():
+            group = groups.get(gid)
+            if group is None:
+                continue
+            req = self.csr.scale_up_requests.get(gid)
+            if req is not None and now_ts - req.start_ts <= self.options.max_node_provision_time_s:
+                continue  # still within provision budget
+            if req is None and not self._provision_expired(gid, now_ts):
+                continue
+            stuck = [Node(name=i.id, provider_id=i.id) for i in instances]
+            try:
+                group.delete_nodes(stuck)
+                removed += len(stuck)
+            except Exception:
+                pass
+        return removed
+
+    def _provision_expired(self, gid: str, now_ts: float) -> bool:
+        # no live request: any unregistered instance is already stale
+        return True
+
+    def _delete_created_nodes_with_errors(self) -> None:
+        """Instances that failed creation are deleted so the target shrinks
+        and a different group can be tried (:773)."""
+        errored = self.csr.instances_with_errors()
+        groups = {g.id(): g for g in self.provider.node_groups()}
+        for gid, instances in errored.items():
+            group = groups.get(gid)
+            if group is None:
+                continue
+            try:
+                group.delete_nodes(
+                    [Node(name=i.id, provider_id=i.id) for i in instances]
+                )
+            except Exception:
+                try:
+                    group.decrease_target_size(len(instances))
+                except Exception:
+                    pass

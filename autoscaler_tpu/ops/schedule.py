@@ -1,0 +1,55 @@
+"""Greedy pod scheduling onto existing capacity — the hinting simulator's
+device kernel.
+
+Reference: cluster-autoscaler/simulator/scheduling/hinting_simulator.go:58
+(TrySchedulePods: per pod, try the hinted node first, then a full
+FitsAnyNodeMatching scan) — the engine behind the filter-out-schedulable
+pod-list processor (core/podlistprocessor/filter_out_schedulable.go:46,95).
+One scan over the pod list with capacity carried between placements; the
+hint becomes a preferred-index fast path inside each step.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from autoscaler_tpu.snapshot.tensors import SnapshotTensors
+
+
+class ScheduleResult(NamedTuple):
+    placed: jax.Array   # [K] bool
+    dest: jax.Array     # [K] i32 node index, -1 when not placed
+
+
+@jax.jit
+def greedy_schedule(
+    snap: SnapshotTensors,
+    pod_slots: jax.Array,  # [K] i32 pod indices to place, in priority order (-1 pad)
+    hints: jax.Array,      # [K] i32 hinted node index per pod, -1 = no hint
+) -> ScheduleResult:
+    """Place pods onto existing nodes greedily, honoring hints. Capacity is
+    carried across placements; predicate mask comes from the snapshot."""
+    free0 = snap.free()
+
+    def step(free, inp):
+        pod_idx, hint = inp
+        valid = pod_idx >= 0
+        safe = jnp.maximum(pod_idx, 0)
+        req = snap.pod_req[safe]
+        ok = (
+            jnp.all(req[None, :] <= free, axis=-1)
+            & snap.sched_mask[safe]
+            & snap.node_valid
+        )
+        hint_ok = (hint >= 0) & ok[jnp.maximum(hint, 0)]
+        first = jnp.argmax(ok).astype(jnp.int32)
+        dest = jnp.where(hint_ok, hint, jnp.where(ok.any(), first, -1))
+        place = valid & (dest >= 0)
+        target = jnp.maximum(dest, 0)
+        free = free.at[target].add(jnp.where(place, -req, jnp.zeros_like(req)))
+        return free, (place, jnp.where(place, dest, -1))
+
+    _, (placed, dest) = jax.lax.scan(step, free0, (pod_slots, hints))
+    return ScheduleResult(placed=placed, dest=dest)

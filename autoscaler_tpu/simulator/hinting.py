@@ -1,0 +1,83 @@
+"""Hinting scheduling simulator — host wrapper over the greedy kernel with a
+generational hint map.
+
+Reference: cluster-autoscaler/simulator/scheduling/ — hinting_simulator.go:58
+(TrySchedulePods), hints.go:39,68 (generational hint map: successful
+placements remembered across loops, stale entries dropped by generation GC),
+similar_pods.go (memoized verdicts for equivalent pods — subsumed here
+because the whole batch is one dispatch).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from autoscaler_tpu.kube.objects import Pod
+from autoscaler_tpu.ops.schedule import greedy_schedule
+from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+
+import jax.numpy as jnp
+
+
+class Hints:
+    """pod key → node name, with generation-based eviction (hints.go:39)."""
+
+    def __init__(self, max_generations: int = 2):
+        self._store: Dict[str, Tuple[str, int]] = {}
+        self._generation = 0
+        self.max_generations = max_generations
+
+    def get(self, pod_key: str) -> Optional[str]:
+        entry = self._store.get(pod_key)
+        return entry[0] if entry else None
+
+    def set(self, pod_key: str, node_name: str) -> None:
+        self._store[pod_key] = (node_name, self._generation)
+
+    def next_generation(self) -> None:
+        self._generation += 1
+        cutoff = self._generation - self.max_generations
+        self._store = {k: v for k, v in self._store.items() if v[1] > cutoff}
+
+
+class HintingSimulator:
+    def __init__(self) -> None:
+        self.hints = Hints()
+
+    def try_schedule_pods(
+        self,
+        snapshot: ClusterSnapshot,
+        pods: Sequence[Pod],
+        commit: bool = True,
+    ) -> Tuple[List[Pod], Dict[str, str]]:
+        """→ (scheduled_pods, assignments pod key → node name). When commit,
+        the placements are applied to the snapshot (as TrySchedulePods does on
+        its working snapshot)."""
+        if not pods:
+            return [], {}
+        tensors, meta = snapshot.tensors()
+        K = len(pods)
+        slots = np.full(K, -1, np.int32)
+        hint_idx = np.full(K, -1, np.int32)
+        for i, pod in enumerate(pods):
+            slots[i] = meta.pod_index[pod.key()]
+            hinted = self.hints.get(pod.key())
+            if hinted is not None and hinted in meta.node_index:
+                hint_idx[i] = meta.node_index[hinted]
+        res = greedy_schedule(tensors, jnp.asarray(slots), jnp.asarray(hint_idx))
+        placed = np.asarray(res.placed)
+        dest = np.asarray(res.dest)
+
+        scheduled: List[Pod] = []
+        assignments: Dict[str, str] = {}
+        for i, pod in enumerate(pods):
+            if placed[i]:
+                node_name = meta.nodes[dest[i]].name
+                scheduled.append(pod)
+                assignments[pod.key()] = node_name
+                self.hints.set(pod.key(), node_name)
+                if commit:
+                    snapshot.schedule_pod(pod.key(), node_name)
+        self.hints.next_generation()
+        return scheduled, assignments

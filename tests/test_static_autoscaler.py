@@ -1,0 +1,274 @@
+"""Integration tests of the full RunOnce loop against the fake provider and
+fake cluster API — the analog of the reference's core/static_autoscaler_test.go
+scenario tests (scale-up/scale-down event sequences across loop iterations)."""
+import numpy as np
+import pytest
+
+from autoscaler_tpu.cloudprovider.interface import (
+    Instance,
+    InstanceErrorClass,
+    InstanceErrorInfo,
+    InstanceState,
+)
+from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.core.podlistprocessor import FilterOutSchedulablePodListProcessor
+from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from autoscaler_tpu.kube.api import FakeClusterAPI
+from autoscaler_tpu.simulator.hinting import HintingSimulator
+from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+from autoscaler_tpu.utils.test_utils import GB, MB, build_test_node, build_test_pod
+
+
+class TestHintingSimulator:
+    def test_schedule_and_hints(self):
+        s = ClusterSnapshot()
+        s.add_node(build_test_node("n0", cpu_m=1000))
+        s.add_node(build_test_node("n1", cpu_m=1000))
+        pods = [build_test_pod(f"p{i}", cpu_m=400) for i in range(3)]
+        for p in pods:
+            s.add_pod(p)
+        sim = HintingSimulator()
+        scheduled, assignments = sim.try_schedule_pods(s, pods, commit=True)
+        assert len(scheduled) == 3
+        # capacity respected: max 2 per 1000m node with 400m pods
+        per_node = {}
+        for key, node in assignments.items():
+            per_node[node] = per_node.get(node, 0) + 1
+        assert all(v <= 2 for v in per_node.values())
+        # hints recorded
+        assert sim.hints.get("default/p0") is not None
+
+    def test_hint_preferred(self):
+        s = ClusterSnapshot()
+        s.add_node(build_test_node("n0", cpu_m=2000))
+        s.add_node(build_test_node("n1", cpu_m=2000))
+        pod = build_test_pod("p", cpu_m=100)
+        s.add_pod(pod)
+        sim = HintingSimulator()
+        sim.hints.set("default/p", "n1")
+        _, assignments = sim.try_schedule_pods(s, [pod], commit=False)
+        assert assignments["default/p"] == "n1"
+
+    def test_no_capacity(self):
+        s = ClusterSnapshot()
+        s.add_node(build_test_node("n0", cpu_m=100))
+        pod = build_test_pod("p", cpu_m=500)
+        s.add_pod(pod)
+        sim = HintingSimulator()
+        scheduled, _ = sim.try_schedule_pods(s, [pod])
+        assert scheduled == []
+
+
+class TestPodListProcessor:
+    def test_filters_schedulable(self):
+        s = ClusterSnapshot()
+        s.add_node(build_test_node("n0", cpu_m=1000))
+        fits = build_test_pod("fits", cpu_m=300)
+        too_big = build_test_pod("big", cpu_m=5000)
+        s.add_pod(fits)
+        s.add_pod(too_big)
+        proc = FilterOutSchedulablePodListProcessor()
+        still, filtered = proc.process(s, [fits, too_big])
+        assert [p.name for p in filtered] == ["fits"]
+        assert [p.name for p in still] == ["big"]
+
+    def test_priority_order(self):
+        # only one slot: higher priority pod wins it
+        s = ClusterSnapshot()
+        s.add_node(build_test_node("n0", cpu_m=500))
+        low = build_test_pod("low", cpu_m=400, priority=0)
+        high = build_test_pod("high", cpu_m=400, priority=10)
+        s.add_pod(low)
+        s.add_pod(high)
+        proc = FilterOutSchedulablePodListProcessor()
+        still, filtered = proc.process(s, [low, high])
+        assert [p.name for p in filtered] == ["high"]
+        assert [p.name for p in still] == ["low"]
+
+
+def build_world(groups, nodes_per_group, pods=(), **opt_kw):
+    provider = TestCloudProvider()
+    api = FakeClusterAPI()
+    for name, lo, hi, cpu, mem in groups:
+        n = nodes_per_group.get(name, 0)
+        provider.add_node_group(
+            name, lo, hi, n, build_test_node(f"{name}-tmpl", cpu_m=cpu, mem=mem)
+        )
+        for i in range(n):
+            node = build_test_node(f"{name}-{i}", cpu_m=cpu, mem=mem)
+            provider.add_node(name, node)
+            api.add_node(node)
+    for pod in pods:
+        api.add_pod(pod)
+    opts = AutoscalingOptions(expander="least-waste", **opt_kw)
+    autoscaler = StaticAutoscaler(provider, api, opts)
+    return provider, api, autoscaler
+
+
+class TestRunOnce:
+    def test_scale_up_on_pending_pods(self):
+        pods = [build_test_pod(f"p{i}", cpu_m=900, mem=1 * GB) for i in range(4)]
+        provider, api, autoscaler = build_world(
+            [("g", 0, 10, 1000, 2 * GB)], {"g": 1}, pods
+        )
+        result = autoscaler.run_once(now_ts=100.0)
+        assert result.scale_up is not None and result.scale_up.scaled_up
+        assert provider.scale_up_calls == [("g", result.scale_up.new_nodes)]
+        assert result.scale_up.new_nodes >= 3
+
+    def test_no_scale_up_when_pods_fit(self):
+        pods = [build_test_pod("p", cpu_m=100)]
+        provider, api, autoscaler = build_world(
+            [("g", 0, 10, 1000, 2 * GB)], {"g": 1}, pods
+        )
+        result = autoscaler.run_once(now_ts=100.0)
+        assert result.filtered_schedulable == 1
+        assert result.pending_pods == 0
+        assert result.scale_up is None
+        assert provider.scale_up_calls == []
+
+    def test_upcoming_nodes_prevent_double_scale_up(self):
+        pods = [build_test_pod(f"p{i}", cpu_m=900, mem=1 * GB) for i in range(2)]
+        provider, api, autoscaler = build_world(
+            [("g", 0, 10, 1000, 2 * GB)], {"g": 0}, pods
+        )
+        r1 = autoscaler.run_once(now_ts=100.0)
+        assert r1.scale_up.scaled_up
+        first_calls = len(provider.scale_up_calls)
+        # next loop: target raised but nodes not registered yet → upcoming
+        # virtual nodes absorb the pods, no second scale-up
+        r2 = autoscaler.run_once(now_ts=110.0)
+        assert len(provider.scale_up_calls) == first_calls
+        assert r2.filtered_schedulable == 2
+
+    def test_scale_down_empty_node_after_unneeded_time(self):
+        provider, api, autoscaler = build_world(
+            [("g", 0, 10, 1000, 2 * GB)],
+            {"g": 3},
+            [build_test_pod("p", cpu_m=300, node_name="g-0")],
+        )
+        autoscaler.options.node_group_defaults.scale_down_unneeded_time_s = 50
+        autoscaler.options.scale_down_delay_after_add_s = 0
+        r1 = autoscaler.run_once(now_ts=0.0)
+        assert r1.unneeded_nodes >= 2  # g-1, g-2 empty
+        assert r1.scale_down is None  # unneeded-time not yet reached
+        r2 = autoscaler.run_once(now_ts=100.0)
+        assert r2.scale_down is not None
+        deleted = set(r2.scale_down.deleted_empty)
+        assert deleted and deleted <= {"g-1", "g-2"}
+        for name in deleted:
+            assert name not in api.nodes
+        assert provider.scale_down_calls
+
+    def test_scale_down_cooldown_after_scale_up(self):
+        pods = [
+            build_test_pod("blk0", cpu_m=800, node_name="g-0"),
+            build_test_pod("blk1", cpu_m=800, node_name="g-1"),
+            build_test_pod("p", cpu_m=900, mem=1 * GB),  # fits no existing node
+        ]
+        provider, api, autoscaler = build_world(
+            [("g", 0, 10, 1000, 2 * GB)], {"g": 2}, pods
+        )
+        autoscaler.options.node_group_defaults.scale_down_unneeded_time_s = 0
+        r1 = autoscaler.run_once(now_ts=0.0)
+        assert r1.scale_up.scaled_up
+        r2 = autoscaler.run_once(now_ts=10.0)  # within delay_after_add (600s)
+        assert r2.scale_down_in_cooldown
+        assert r2.scale_down is None
+
+    def test_drain_scale_down_evicts_pods(self):
+        provider, api, autoscaler = build_world(
+            [("g", 0, 10, 1000, 2 * GB)],
+            {"g": 3},
+            [build_test_pod("p", cpu_m=100, node_name="g-0")],
+        )
+        autoscaler.options.node_group_defaults.scale_down_unneeded_time_s = 50
+        autoscaler.options.scale_down_delay_after_add_s = 0
+        autoscaler.options.max_empty_bulk_delete = 2  # let the drain slot open
+        autoscaler.run_once(now_ts=0.0)
+        r2 = autoscaler.run_once(now_ts=100.0)
+        assert r2.scale_down is not None
+        if r2.scale_down.deleted_drain:
+            assert "default/p" in api.evicted
+
+    def test_unhealthy_cluster_halts(self):
+        provider, api, autoscaler = build_world(
+            [("g", 0, 10, 1000, 2 * GB)], {"g": 3}
+        )
+        for node in api.list_nodes():
+            node.ready = False
+            node.creation_ts = -10_000
+        autoscaler.options.ok_total_unready_count = 0
+        result = autoscaler.run_once(now_ts=1000.0)
+        assert not result.cluster_healthy
+        assert result.scale_up is None and result.scale_down is None
+
+    def test_unregistered_instance_cleanup(self):
+        provider, api, autoscaler = build_world(
+            [("g", 0, 10, 1000, 2 * GB)], {"g": 1}
+        )
+        provider.add_instance("g", Instance(id="ghost"))
+        g = provider.node_groups()[0]
+        g.set_target_size(2)
+        result = autoscaler.run_once(now_ts=10_000.0)
+        assert result.removed_unregistered == 1
+        assert ("g", "ghost") in provider.scale_down_calls
+
+    def test_errored_instances_deleted(self):
+        provider, api, autoscaler = build_world(
+            [("g", 0, 10, 1000, 2 * GB)], {"g": 1}
+        )
+        provider.add_instance(
+            "g",
+            Instance(
+                id="bad",
+                state=InstanceState.CREATING,
+                error_info=InstanceErrorInfo(InstanceErrorClass.OUT_OF_RESOURCES),
+            ),
+        )
+        autoscaler.run_once(now_ts=10.0)
+        assert ("g", "bad") in provider.scale_down_calls
+
+    def test_expendable_pods_ignored(self):
+        pods = [build_test_pod("exp", cpu_m=900, mem=1 * GB, priority=-100)]
+        provider, api, autoscaler = build_world(
+            [("g", 0, 10, 1000, 2 * GB)], {"g": 0}, pods
+        )
+        result = autoscaler.run_once(now_ts=0.0)
+        assert result.scale_up is None
+        assert provider.scale_up_calls == []
+
+    def test_multi_loop_convergence(self):
+        # burst of pods → scale up; "cloud" registers nodes; pods get
+        # scheduled; extra node scales back down
+        pods = [build_test_pod(f"p{i}", cpu_m=800, mem=1 * GB) for i in range(4)]
+        provider, api, autoscaler = build_world(
+            [("g", 0, 10, 1000, 2 * GB)], {"g": 0}, pods
+        )
+        autoscaler.options.node_group_defaults.scale_down_unneeded_time_s = 60
+        autoscaler.options.scale_down_delay_after_add_s = 120
+
+        r1 = autoscaler.run_once(now_ts=0.0)
+        assert r1.scale_up.scaled_up
+        n_new = r1.scale_up.new_nodes
+        assert n_new == 4  # one 800m pod per 1000m node
+
+        # cloud materializes the nodes, scheduler places the pods
+        for i in range(n_new):
+            node = build_test_node(f"g-{i}", cpu_m=1000, mem=2 * GB)
+            provider.add_node("g", node)
+            api.add_node(node)
+        for i, pod in enumerate(pods):
+            api.pods[pod.key()].node_name = f"g-{i}"
+
+        r2 = autoscaler.run_once(now_ts=30.0)
+        assert r2.scale_up is None or not r2.scale_up.scaled_up
+        assert len(provider.scale_up_calls) == 1
+
+        # one pod finishes → its node empties → scaled down after unneeded time
+        del api.pods["default/p3"]
+        r3 = autoscaler.run_once(now_ts=60.0)
+        r4 = autoscaler.run_once(now_ts=200.0)  # past cooldown + unneeded time
+        deleted = (r4.scale_down.deleted_empty if r4.scale_down else [])
+        assert "g-3" in deleted
