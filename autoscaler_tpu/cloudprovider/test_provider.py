@@ -107,6 +107,10 @@ class TestPricingModel(PricingModel):
 
     def node_price(self, node: Node, start_s: float, end_s: float) -> float:
         group = self._provider.node_group_for_node(node)
+        if group is None and node.name.startswith("template-"):
+            # template nodes are named template-<group>-<seq> (TestNodeGroup)
+            gid = node.name[len("template-"):].rsplit("-", 1)[0]
+            group = self._provider._groups.get(gid)
         rate = group.price_per_hour if isinstance(group, TestNodeGroup) else 1.0
         return rate * (end_s - start_s) / 3600.0
 

@@ -1,0 +1,41 @@
+"""Priority expander: operator-defined group preference tiers.
+
+Reference: cluster-autoscaler/expander/priority/priority.go — a live ConfigMap
+maps integer priorities to lists of node-group-name regexes; the expander
+keeps only options whose group matches the highest priority tier present.
+Here the config is a plain dict (the host embedding decides where it comes
+from — file, CRD, or API), hot-swappable via set_priorities.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Sequence
+
+from autoscaler_tpu.expander.core import Filter, Option
+
+
+class PriorityFilter(Filter):
+    def __init__(self, priorities: Dict[int, Sequence[str]]):
+        self._compiled: Dict[int, List[re.Pattern]] = {}
+        self.set_priorities(priorities)
+
+    def set_priorities(self, priorities: Dict[int, Sequence[str]]) -> None:
+        self._compiled = {
+            prio: [re.compile(p) for p in patterns]
+            for prio, patterns in priorities.items()
+        }
+
+    def _priority_of(self, group_id: str) -> int:
+        best = None
+        for prio, patterns in self._compiled.items():
+            if any(p.search(group_id) for p in patterns):
+                if best is None or prio > best:
+                    best = prio
+        return best if best is not None else -(10**9)
+
+    def best_options(self, options: List[Option]) -> List[Option]:
+        if not options:
+            return []
+        prios = [(self._priority_of(o.node_group.id()), o) for o in options]
+        top = max(p for p, _ in prios)
+        return [o for p, o in prios if p == top]

@@ -1,0 +1,108 @@
+"""Scenario-robust expander: pick the group that wins across what-if worlds.
+
+The reference evaluates exactly one present-state snapshot per loop; spot
+markets and preemptions make that choice fragile. This strategy prices every
+expansion option under S perturbed pricing scenarios and picks the modal
+winner — the full (scenario × group) FFD + cost evaluation runs as ONE
+shard_map'd dispatch over the device mesh (parallel/mesh.py; BASELINE
+config #5: 8 spot-pricing scenarios across v5e-8). There is no reference
+equivalent; the seam it plugs into is expander.Strategy
+(cluster-autoscaler/expander/expander.go:52).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from autoscaler_tpu.expander.core import Option, Strategy
+from autoscaler_tpu.kube.objects import Node
+from autoscaler_tpu.parallel.mesh import make_mesh, whatif_best_options
+from autoscaler_tpu.snapshot.packer import resources_row
+from autoscaler_tpu.snapshot.tensors import bucket_size
+
+import jax.numpy as jnp
+
+
+class ScenarioStrategy(Strategy):
+    def __init__(
+        self,
+        base_prices: Dict[str, float],       # group id → on-demand node price
+        num_scenarios: int = 8,
+        spot_discount: float = 0.7,          # spot price = base × discount
+        preemption_prob: float = 0.3,        # chance a group's spot is revoked
+        seed: int = 0,
+        mesh=None,
+        max_nodes: int = 128,
+    ):
+        self.base_prices = base_prices
+        self.num_scenarios = num_scenarios
+        self.spot_discount = spot_discount
+        self.preemption_prob = preemption_prob
+        self.seed = seed
+        self.mesh = mesh
+        self.max_nodes = max_nodes
+
+    def best_option(self, options: List[Option]) -> Optional[Option]:
+        if not options:
+            return None
+        if len(options) == 1:
+            return options[0]
+        mesh = self.mesh or make_mesh()
+        s_dim = mesh.shape["scenario"]
+        g_dim = mesh.shape["group"]
+
+        # pad S, G to mesh divisibility
+        S = max(self.num_scenarios, s_dim)
+        S += (-S) % s_dim
+        G = len(options)
+        G_pad = G + (-G) % g_dim
+
+        # shared pod matrix = union of pods across options (each option's mask
+        # selects its own schedulable set)
+        all_pods: Dict[str, int] = {}
+        pods_list = []
+        for o in options:
+            for p in o.pods:
+                if p.key() not in all_pods:
+                    all_pods[p.key()] = len(pods_list)
+                    pods_list.append(p)
+        P = bucket_size(len(pods_list))
+        pod_req = np.zeros((P, 6), np.float32)
+        for i, p in enumerate(pods_list):
+            pod_req[i] = resources_row(p.requests, 1.0)
+
+        masks = np.zeros((G_pad, P), bool)
+        allocs = np.zeros((S, G_pad, 6), np.float32)
+        prices = np.full((S, G_pad), 1e9, np.float32)  # padded groups: huge price
+        caps = np.ones(G_pad, np.int32)
+        rng = np.random.default_rng(self.seed)
+        for gi, o in enumerate(options):
+            for p in o.pods:
+                masks[gi, all_pods[p.key()]] = True
+            template = o.node_group.template_node_info()
+            row = resources_row(template.allocatable, template.allocatable.pods)
+            base = self.base_prices.get(o.node_group.id(), 1.0)
+            caps[gi] = max(
+                1, min(self.max_nodes, o.node_group.max_size() - o.node_group.target_size())
+            )
+            for s in range(S):
+                allocs[s, gi] = row
+                spot_available = rng.random() > self.preemption_prob
+                prices[s, gi] = base * (self.spot_discount if spot_available else 1.0)
+
+        res = whatif_best_options(
+            mesh,
+            jnp.asarray(pod_req),
+            jnp.asarray(masks),
+            jnp.asarray(allocs),
+            jnp.asarray(prices),
+            jnp.asarray(caps),
+            max_nodes=self.max_nodes,
+        )
+        best = np.asarray(res.best_group)
+        best = best[best < G]  # drop padded winners (shouldn't happen)
+        if best.size == 0:
+            return options[0]
+        modal = int(np.bincount(best, minlength=G).argmax())
+        return options[modal]

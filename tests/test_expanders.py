@@ -1,0 +1,120 @@
+"""Expander strategy tests: price, priority, scenario (what-if), chain
+composition (modeled on the reference's expander/*/ *_test.go suites)."""
+import numpy as np
+import pytest
+
+from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+from autoscaler_tpu.expander.core import (
+    ChainStrategy,
+    Option,
+    RandomStrategy,
+    build_strategy,
+)
+from autoscaler_tpu.expander.price import PriceFilter
+from autoscaler_tpu.expander.priority import PriorityFilter
+from autoscaler_tpu.expander.scenario import ScenarioStrategy
+from autoscaler_tpu.utils.test_utils import GB, MB, build_test_node, build_test_pod
+
+
+def provider_with_groups():
+    p = TestCloudProvider()
+    p.add_node_group(
+        "cheap-pool", 0, 10, 0, build_test_node("c", cpu_m=4000, mem=8 * GB), price_per_hour=0.5
+    )
+    p.add_node_group(
+        "pricey-pool", 0, 10, 0, build_test_node("e", cpu_m=4000, mem=8 * GB), price_per_hour=5.0
+    )
+    return p
+
+
+def options_for(p, counts=(2, 2)):
+    gs = {g.id(): g for g in p.node_groups()}
+    pods = [build_test_pod(f"p{i}", cpu_m=1000, mem=1 * GB) for i in range(4)]
+    return [
+        Option(gs["cheap-pool"], counts[0], pods),
+        Option(gs["pricey-pool"], counts[1], pods),
+    ]
+
+
+class TestPriceExpander:
+    def test_picks_cheaper(self):
+        p = provider_with_groups()
+        f = PriceFilter(p.pricing())
+        best = f.best_options(options_for(p))
+        assert [o.node_group.id() for o in best] == ["cheap-pool"]
+
+    def test_pod_value_matters(self):
+        # a modestly pricier group that schedules far more pod-value per node
+        # wins (score = node cost / pod value, price.go:90)
+        p = provider_with_groups()
+        gs = {g.id(): g for g in p.node_groups()}
+        gs["pricey-pool"].price_per_hour = 1.0  # 2x cheap, but 4x pod coverage
+        few = [build_test_pod("a", cpu_m=3000, mem=1 * GB)]
+        many = [build_test_pod(f"b{i}", cpu_m=3000, mem=1 * GB) for i in range(40)]
+        opts = [
+            Option(gs["cheap-pool"], 1, few),
+            Option(gs["pricey-pool"], 10, many),
+        ]
+        f = PriceFilter(p.pricing())
+        best = f.best_options(opts)
+        assert [o.node_group.id() for o in best] == ["pricey-pool"]
+
+
+class TestPriorityExpander:
+    def test_highest_tier_wins(self):
+        p = provider_with_groups()
+        f = PriorityFilter({10: [".*cheap.*"], 50: [".*pricey.*"]})
+        best = f.best_options(options_for(p))
+        assert [o.node_group.id() for o in best] == ["pricey-pool"]
+
+    def test_unmatched_groups_lose(self):
+        p = provider_with_groups()
+        f = PriorityFilter({10: ["cheap-pool"]})
+        best = f.best_options(options_for(p))
+        assert [o.node_group.id() for o in best] == ["cheap-pool"]
+
+    def test_hot_swap(self):
+        p = provider_with_groups()
+        f = PriorityFilter({10: ["cheap-pool"]})
+        f.set_priorities({10: ["pricey-pool"]})
+        best = f.best_options(options_for(p))
+        assert [o.node_group.id() for o in best] == ["pricey-pool"]
+
+    def test_in_chain(self):
+        p = provider_with_groups()
+        strat = build_strategy(["priority"], priorities={5: ["pricey-pool"]})
+        assert strat.best_option(options_for(p)).node_group.id() == "pricey-pool"
+
+
+class TestScenarioStrategy:
+    def test_prefers_cheap_across_scenarios(self):
+        p = provider_with_groups()
+        opts = options_for(p)
+        strat = ScenarioStrategy(
+            base_prices={"cheap-pool": 0.5, "pricey-pool": 5.0},
+            num_scenarios=8,
+            seed=3,
+        )
+        best = strat.best_option(opts)
+        assert best.node_group.id() == "cheap-pool"
+
+    def test_single_option_short_circuit(self):
+        p = provider_with_groups()
+        opts = options_for(p)[:1]
+        strat = ScenarioStrategy(base_prices={})
+        assert strat.best_option(opts) is opts[0]
+
+    def test_handles_unequal_pod_sets(self):
+        p = provider_with_groups()
+        gs = {g.id(): g for g in p.node_groups()}
+        pods_a = [build_test_pod(f"a{i}", cpu_m=500, mem=512 * MB) for i in range(6)]
+        pods_b = pods_a[:2]
+        opts = [
+            Option(gs["cheap-pool"], 1, pods_a),
+            Option(gs["pricey-pool"], 1, pods_b),
+        ]
+        strat = ScenarioStrategy(
+            base_prices={"cheap-pool": 1.0, "pricey-pool": 1.0}, num_scenarios=4, seed=0
+        )
+        # cheap-pool schedules all pods → fewer unscheduled-penalties → wins
+        assert strat.best_option(opts).node_group.id() == "cheap-pool"
