@@ -1,0 +1,201 @@
+"""Pallas-tiled predicate fit with online reduction — the huge-cluster path.
+
+Reference scale target: the in-tree snapshot benchmark grid runs to 100k
+nodes (cluster-autoscaler/simulator/clustersnapshot/clustersnapshot_benchmark
+_test.go:71), and the documented worst predicate (inter-pod affinity) is the
+1000x outlier (FAQ.md:151-153). At 100k pods x 15k nodes the dense [P, N]
+fit matrix is ~1.5G elements — too big to materialize in HBM per loop. This
+kernel tiles the (pod x node) space and reduces *inside* each tile pass
+(structurally the same blockwise-online trick as flash/ring attention,
+SURVEY.md §5 "long-context analog"), emitting only [P]-sized outputs:
+
+    any_fit[p], fit_count[p], first_fit[p]
+
+Non-resource predicates enter as an equivalence-class factorization:
+pod_class[P] x node_class[N] -> class_mask[CP, CN]. The [TP, TN] tile of the
+mask is reconstructed on the MXU as onehot(pod_class) @ class_mask @
+onehot(node_class)^T — two small matmuls instead of a 1.5GB boolean tensor.
+(Taints/selectors/zones are class-structured; per-pod exceptions like
+inter-pod affinity stay on the exact dense path, ops/fit.py, which handles
+every cluster the reference's SLOs cover.)
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BIG_I32 = np.int32(2**31 - 1)
+
+
+class FitReduction(NamedTuple):
+    any_fit: jax.Array    # [P] bool
+    fit_count: jax.Array  # [P] i32
+    first_fit: jax.Array  # [P] i32 node index, -1 if none
+
+
+def _kernel(
+    req_ref,        # [TP, R_pad] f32
+    free_t_ref,     # [R_pad, TN] f32 (transposed so rows are resources)
+    pclass_ref,     # [TP, 1] i32
+    nclass_ref,     # [1, TN] i32
+    cmask_ref,      # [CP, CN] f32 (whole, small)
+    nvalid_ref,     # [1, TN] f32 (1.0 = real node)
+    any_ref,        # [TP, 1] i32 out
+    count_ref,      # [TP, 1] i32 out
+    first_ref,      # [TP, 1] i32 out
+    *,
+    num_resources: int,
+    tn: int,
+):
+    j = pl.program_id(1)
+
+    req = req_ref[:]            # [TP, R_pad]
+    free_t = free_t_ref[:]      # [R_pad, TN]
+
+    # resource fit: AND over the real resource rows
+    fits = jnp.ones((req.shape[0], tn), dtype=jnp.bool_)
+    for r in range(num_resources):
+        req_col = req[:, r][:, None]          # [TP, 1]
+        free_row = free_t[r][None, :]         # [1, TN]
+        fits &= req_col <= free_row
+
+    # class mask tile via two MXU matmuls
+    cp = cmask_ref.shape[0]
+    cn = cmask_ref.shape[1]
+    pclass = pclass_ref[:]                      # [TP, 1]
+    nclass = nclass_ref[:]                      # [1, TN]
+    onehot_p = (
+        pclass == jax.lax.broadcasted_iota(jnp.int32, (1, cp), 1)
+    ).astype(jnp.float32)                       # [TP, CP]
+    onehot_n = (
+        nclass == jax.lax.broadcasted_iota(jnp.int32, (cn, 1), 0)
+    ).astype(jnp.float32)                       # [CN, TN]
+    allowed = jax.lax.dot(
+        jax.lax.dot(onehot_p, cmask_ref[:], precision=jax.lax.Precision.HIGHEST),
+        onehot_n,
+        precision=jax.lax.Precision.HIGHEST,
+    )                                           # [TP, TN]
+    fits &= allowed > 0.5
+    fits &= nvalid_ref[:] > 0.5
+
+    # online reduction over this node tile
+    tile_count = jnp.sum(fits, axis=1, dtype=jnp.int32)[:, None]     # [TP, 1]
+    col = jax.lax.broadcasted_iota(jnp.int32, fits.shape, 1)
+    global_col = col + j * tn
+    first_here = jnp.min(
+        jnp.where(fits, global_col, BIG_I32), axis=1
+    )[:, None]                                                       # [TP, 1]
+
+    @pl.when(j == 0)
+    def _init():
+        any_ref[:] = jnp.zeros_like(any_ref)
+        count_ref[:] = jnp.zeros_like(count_ref)
+        first_ref[:] = jnp.full_like(first_ref, BIG_I32)
+
+    any_ref[:] = any_ref[:] | (tile_count > 0).astype(jnp.int32)
+    count_ref[:] = count_ref[:] + tile_count
+    first_ref[:] = jnp.minimum(first_ref[:], first_here)
+
+
+@functools.partial(jax.jit, static_argnames=("tp", "tn", "interpret"))
+def pallas_fit_reduce(
+    pod_req: jax.Array,     # [P, R] f32
+    free: jax.Array,        # [N, R] f32 (alloc - used; 0 rows for invalid)
+    pod_class: jax.Array,   # [P] i32 (-1 = never schedulable)
+    node_class: jax.Array,  # [N] i32 (-1 = invalid node)
+    class_mask: jax.Array,  # [CP, CN] bool
+    node_valid: jax.Array,  # [N] bool
+    tp: int = 256,
+    tn: int = 512,
+    interpret: bool | None = None,  # None = interpret off-TPU (CPU tests)
+) -> FitReduction:
+    """Blockwise-tiled fit over (P x N) without materializing the matrix."""
+    P, R = pod_req.shape
+    N = free.shape[0]
+    R_pad = 8
+    P_pad = P + (-P) % tp
+    N_pad = N + (-N) % tn
+    CP, CN = class_mask.shape
+    CP_pad = CP + (-CP) % 8
+    CN_pad = CN + (-CN) % 128
+
+    req = jnp.zeros((P_pad, R_pad), jnp.float32).at[:P, :R].set(pod_req)
+    # padded pods: impossible request so they never fit
+    if P_pad > P:
+        req = req.at[P:, 0].set(jnp.inf)
+    free_t = jnp.zeros((R_pad, N_pad), jnp.float32).at[:R, :N].set(free.T)
+    pclass = jnp.full((P_pad, 1), -1, jnp.int32).at[:P, 0].set(pod_class)
+    nclass = jnp.full((1, N_pad), -1, jnp.int32).at[0, :N].set(node_class)
+    cmask = (
+        jnp.zeros((CP_pad, CN_pad), jnp.float32)
+        .at[:CP, :CN]
+        .set(class_mask.astype(jnp.float32))
+    )
+    nvalid = (
+        jnp.zeros((1, N_pad), jnp.float32)
+        .at[0, :N]
+        .set(node_valid.astype(jnp.float32))
+    )
+
+    grid = (P_pad // tp, N_pad // tn)
+    kernel = functools.partial(_kernel, num_resources=R, tn=tn)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    any_o, count_o, first_o = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tp, R_pad), lambda i, j: (i, 0)),
+            pl.BlockSpec((R_pad, tn), lambda i, j: (0, j)),
+            pl.BlockSpec((tp, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, tn), lambda i, j: (0, j)),
+            pl.BlockSpec((CP_pad, CN_pad), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, tn), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tp, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((tp, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((tp, 1), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((P_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((P_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((P_pad, 1), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(req, free_t, pclass, nclass, cmask, nvalid)
+
+    any_fit = any_o[:P, 0] > 0
+    first = first_o[:P, 0]
+    return FitReduction(
+        any_fit=any_fit,
+        fit_count=count_o[:P, 0],
+        first_fit=jnp.where(any_fit, first, -1),
+    )
+
+
+def reference_fit_reduce(pod_req, free, pod_class, node_class, class_mask, node_valid):
+    """Dense XLA/numpy oracle for parity tests."""
+    P, N = pod_req.shape[0], free.shape[0]
+    fits = np.all(pod_req[:, None, :] <= free[None, :, :], axis=-1)
+    pc = np.asarray(pod_class)
+    nc = np.asarray(node_class)
+    cm = np.asarray(class_mask)
+    ok_class = np.zeros((P, N), bool)
+    valid_p = pc >= 0
+    valid_n = (nc >= 0) & np.asarray(node_valid)
+    ok_class[np.ix_(valid_p, valid_n)] = cm[np.ix_(pc[valid_p], nc[valid_n])]
+    fits = fits & ok_class
+    any_fit = fits.any(axis=1)
+    count = fits.sum(axis=1).astype(np.int32)
+    first = np.where(any_fit, fits.argmax(axis=1), -1).astype(np.int32)
+    return any_fit, count, first

@@ -1,0 +1,143 @@
+"""Benchmark grid over cluster sizes — the analog of the reference's
+cluster-autoscaler/simulator/clustersnapshot/clustersnapshot_benchmark_test.go
+:70-215 (AddNodes / AddPods / ListNodeInfos / ForkAddRevert across
+{1,10,100,1k,5k,15k,100k} nodes for Basic vs Delta snapshots).
+
+Measures, per cluster size:
+- pack:      object→tensor flatten + host→device transfer (per-loop cost)
+- fork:      snapshot fork+revert (host delta layers; reference ForkAddRevert)
+- fit_dense: dense fit_matrix + any reduction (ops/fit.py)
+- fit_pallas: tiled online-reduction fit (ops/pallas_fit.py)
+- binpack:   one batched 50-group FFD estimate
+
+Run: python benchmarks/grid.py [--sizes 1,10,100,1000] [--pods-per-node 3]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def time_it(fn, repeats=3):
+    fn()  # warm/compile
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="10,100,1000,5000,15000")
+    ap.add_argument("--pods-per-node", type=int, default=3)
+    ap.add_argument("--skip-pack-above", type=int, default=5000,
+                    help="object-level pack is host-bound; skip at huge sizes")
+    args = ap.parse_args()
+    sizes = [int(s) for s in args.sizes.split(",")]
+
+    import jax
+    import jax.numpy as jnp
+
+    from autoscaler_tpu.kube.objects import CPU, MEMORY, PODS
+    from autoscaler_tpu.ops.binpack import ffd_binpack_groups
+    from autoscaler_tpu.ops.fit import fit_matrix
+    from autoscaler_tpu.ops.pallas_fit import pallas_fit_reduce
+    from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+    from autoscaler_tpu.utils.test_utils import MB, build_test_node, build_test_pod
+
+    results = []
+    for N in sizes:
+        P = N * args.pods_per_node
+        rng = np.random.default_rng(N)
+        row = {"nodes": N, "pods": P}
+
+        # --- tensor-level data (device path, scales to 100k) ---
+        pod_req = np.zeros((P, 6), np.float32)
+        pod_req[:, CPU] = rng.integers(50, 2000, P)
+        pod_req[:, MEMORY] = rng.integers(64, 4096, P)
+        pod_req[:, PODS] = 1
+        free = np.zeros((N, 6), np.float32)
+        free[:, CPU] = rng.integers(500, 4000, N)
+        free[:, MEMORY] = rng.integers(1024, 8192, N)
+        free[:, PODS] = 110
+        pod_class = rng.integers(0, 8, P).astype(np.int32)
+        node_class = rng.integers(0, 8, N).astype(np.int32)
+        class_mask = rng.random((8, 8)) > 0.2
+        node_valid = np.ones(N, bool)
+
+        jreq, jfree = jnp.asarray(pod_req), jnp.asarray(free)
+        jpc, jnc = jnp.asarray(pod_class), jnp.asarray(node_class)
+        jcm, jnv = jnp.asarray(class_mask), jnp.asarray(node_valid)
+
+        row["fit_pallas_s"] = time_it(
+            lambda: np.asarray(
+                pallas_fit_reduce(jreq, jfree, jpc, jnc, jcm, jnv).any_fit
+            )
+        )
+
+        if N <= 15000:
+            # dense [P, N] path (memory-bound beyond ~15k nodes)
+            mask_dense = jnp.asarray(
+                class_mask[np.clip(pod_class, 0, None)][:, np.clip(node_class, 0, None)]
+            )
+
+            @jax.jit
+            def dense_any():
+                fits = jnp.all(jreq[:, None, :] <= jfree[None, :, :], axis=-1)
+                return (fits & mask_dense).any(axis=1)
+
+            row["fit_dense_s"] = time_it(lambda: np.asarray(dense_any()))
+
+        G = 50
+        templates = np.zeros((G, 6), np.float32)
+        templates[:, CPU] = rng.choice([4000, 8000, 16000], G)
+        templates[:, MEMORY] = rng.choice([8192, 16384, 32768], G)
+        templates[:, PODS] = 110
+        masks = rng.random((G, P)) > 0.1
+        jt, jm = jnp.asarray(templates), jnp.asarray(masks)
+        row["binpack_50g_s"] = time_it(
+            lambda: np.asarray(
+                ffd_binpack_groups(jreq, jm, jt, max_nodes=128).node_count
+            )
+        )
+
+        # --- object-level snapshot ops (host path) ---
+        if N <= args.skip_pack_above:
+            snap = ClusterSnapshot()
+            for i in range(N):
+                snap.add_node(build_test_node(f"n{i}", cpu_m=4000, mem=8192 * MB))
+            for i in range(min(P, N * args.pods_per_node)):
+                snap.add_pod(
+                    build_test_pod(f"p{i}", cpu_m=100, mem=200 * MB), f"n{i % N}"
+                )
+
+            def pack():
+                snap._cache = None  # force re-pack
+                snap.tensors()
+
+            row["pack_s"] = time_it(pack, repeats=1)
+
+            def fork_add_revert():
+                snap.fork()
+                snap.add_node(build_test_node("fork-n", cpu_m=4000))
+                snap.revert()
+
+            row["fork_s"] = time_it(fork_add_revert)
+
+        results.append(row)
+        print(json.dumps(row))
+
+    return results
+
+
+if __name__ == "__main__":
+    main()

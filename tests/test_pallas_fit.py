@@ -1,0 +1,59 @@
+"""Pallas tiled-fit parity tests (interpret mode on CPU; the real-TPU path is
+exercised by benchmarks/grid.py) against the dense oracle."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from autoscaler_tpu.ops.pallas_fit import (
+    pallas_fit_reduce,
+    reference_fit_reduce,
+)
+
+
+def build_case(P, N, CP=4, CN=3, seed=0):
+    rng = np.random.default_rng(seed)
+    pod_req = np.zeros((P, 6), np.float32)
+    pod_req[:, 0] = rng.integers(50, 2000, P)
+    pod_req[:, 1] = rng.integers(64, 4096, P)
+    pod_req[:, 5] = 1
+    free = np.zeros((N, 6), np.float32)
+    free[:, 0] = rng.integers(0, 4000, N)
+    free[:, 1] = rng.integers(0, 8192, N)
+    free[:, 5] = rng.integers(0, 110, N)
+    pod_class = rng.integers(0, CP, P).astype(np.int32)
+    node_class = rng.integers(0, CN, N).astype(np.int32)
+    class_mask = rng.random((CP, CN)) > 0.3
+    node_valid = rng.random(N) > 0.05
+    free[~node_valid] = 0
+    return pod_req, free, pod_class, node_class, class_mask, node_valid
+
+
+@pytest.mark.parametrize("P,N", [(64, 64), (300, 700), (1000, 1500)])
+def test_parity_vs_dense(P, N):
+    case = build_case(P, N, seed=P + N)
+    ref_any, ref_count, ref_first = reference_fit_reduce(*case)
+    res = pallas_fit_reduce(
+        *(jnp.asarray(x) for x in case), tp=64, tn=128
+    )
+    np.testing.assert_array_equal(np.asarray(res.any_fit), ref_any)
+    np.testing.assert_array_equal(np.asarray(res.fit_count), ref_count)
+    np.testing.assert_array_equal(np.asarray(res.first_fit), ref_first)
+
+
+def test_invalid_classes_never_fit():
+    case = list(build_case(32, 32, seed=1))
+    case[2] = np.full(32, -1, np.int32)  # all pods classless
+    res = pallas_fit_reduce(*(jnp.asarray(x) for x in case), tp=32, tn=128)
+    assert not np.asarray(res.any_fit).any()
+    assert (np.asarray(res.first_fit) == -1).all()
+
+
+def test_ragged_sizes_padded():
+    # sizes not divisible by tiles
+    case = build_case(70, 130, seed=2)
+    ref_any, ref_count, ref_first = reference_fit_reduce(*case)
+    res = pallas_fit_reduce(*(jnp.asarray(x) for x in case), tp=64, tn=128)
+    np.testing.assert_array_equal(np.asarray(res.any_fit), ref_any)
+    np.testing.assert_array_equal(np.asarray(res.fit_count), ref_count)
