@@ -1,0 +1,84 @@
+"""Human-readable autoscaler status — the status-ConfigMap payload.
+
+Reference: cluster-autoscaler/clusterstate/clusterstate.go:701 (GetStatus →
+api/ ClusterAutoscalerStatus written to a ConfigMap every loop,
+static_autoscaler.go:389-393): cluster-wide and per-node-group Health /
+ScaleUp / ScaleDown conditions with readiness counts and timestamps.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from autoscaler_tpu.clusterstate.registry import ClusterStateRegistry
+
+
+@dataclass
+class GroupStatus:
+    group_id: str
+    health: str
+    ready: int
+    unready: int
+    registered: int
+    target: int
+    min_size: int
+    max_size: int
+    scale_up_status: str
+
+
+@dataclass
+class ClusterStatus:
+    time_ts: float
+    cluster_health: str
+    total_ready: int
+    total_registered: int
+    groups: List[GroupStatus] = field(default_factory=list)
+
+    def render(self) -> str:
+        lines = [
+            f"Cluster-autoscaler status at {time.strftime('%Y-%m-%d %H:%M:%S', time.gmtime(self.time_ts))}:",
+            f"Cluster-wide: Health: {self.cluster_health} "
+            f"(ready={self.total_ready} registered={self.total_registered})",
+        ]
+        for g in self.groups:
+            lines.append(
+                f"  NodeGroup {g.group_id}: Health: {g.health} "
+                f"(ready={g.ready}/{g.registered} target={g.target} "
+                f"minSize={g.min_size} maxSize={g.max_size}) "
+                f"ScaleUp: {g.scale_up_status}"
+            )
+        return "\n".join(lines)
+
+
+def build_status(csr: ClusterStateRegistry, now_ts: float) -> ClusterStatus:
+    total = csr.total_readiness()
+    status = ClusterStatus(
+        time_ts=now_ts,
+        cluster_health="Healthy" if csr.is_cluster_healthy() else "Unhealthy",
+        total_ready=total.ready,
+        total_registered=total.registered,
+    )
+    for group in csr.provider.node_groups():
+        gid = group.id()
+        r = csr.readiness(gid)
+        if gid in csr.scale_up_requests:
+            up = "InProgress"
+        elif csr.backoff.is_backed_off(gid, now_ts):
+            up = "Backoff"
+        else:
+            up = "NoActivity"
+        status.groups.append(
+            GroupStatus(
+                group_id=gid,
+                health="Healthy" if csr.is_node_group_healthy(gid) else "Unhealthy",
+                ready=r.ready,
+                unready=r.unready,
+                registered=r.registered,
+                target=group.target_size(),
+                min_size=group.min_size(),
+                max_size=group.max_size(),
+                scale_up_status=up,
+            )
+        )
+    return status

@@ -27,6 +27,8 @@ from autoscaler_tpu.core.scaledown.planner import ScaleDownPlanner
 from autoscaler_tpu.core.scaleup.orchestrator import ScaleUpOrchestrator, ScaleUpResult
 from autoscaler_tpu.kube.api import ClusterAPI
 from autoscaler_tpu.kube.objects import Node, Pod
+from autoscaler_tpu.metrics import metrics as metrics_mod
+from autoscaler_tpu.metrics.healthcheck import HealthCheck
 from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
 
 
@@ -54,6 +56,9 @@ class StaticAutoscaler:
         scale_down_planner: Optional[ScaleDownPlanner] = None,
         scale_down_actuator: Optional[ScaleDownActuator] = None,
         pod_list_processor: Optional[FilterOutSchedulablePodListProcessor] = None,
+        metrics: Optional[metrics_mod.AutoscalerMetrics] = None,
+        health_check: Optional[HealthCheck] = None,
+        debugger=None,
     ):
         self.provider = provider
         self.api = api
@@ -72,6 +77,11 @@ class StaticAutoscaler:
             self.scale_down_planner.deletion_tracker,
         )
         self.pod_list_processor = pod_list_processor or FilterOutSchedulablePodListProcessor()
+        self.metrics = metrics or metrics_mod.AutoscalerMetrics()
+        self.health_check = health_check or HealthCheck(
+            self.options.max_inactivity_s, self.options.max_failing_time_s
+        )
+        self.debugger = debugger
         self.last_scale_up_ts: Optional[float] = None
         self.last_scale_down_delete_ts: Optional[float] = None
         self.last_scale_down_fail_ts: Optional[float] = None
@@ -79,6 +89,40 @@ class StaticAutoscaler:
 
     # -- one reconcile iteration (reference :288) ----------------------------
     def run_once(self, now_ts: float) -> RunOnceResult:
+        """Instrumented wrapper: per-phase durations, counters, liveness, and
+        the on-demand debugging capture (reference metrics.go:399 +
+        static_autoscaler.go:334,380,540,626,661)."""
+        import time as _time
+
+        m = self.metrics
+        start = _time.monotonic()
+        result = self._run_once_inner(now_ts)
+        m.observe_duration(metrics_mod.MAIN, start)
+        m.unschedulable_pods_count.set(result.pending_pods)
+        m.unneeded_nodes_count.set(result.unneeded_nodes)
+        m.node_groups_count.set(len(self.provider.node_groups()))
+        m.cluster_safe_to_autoscale.set(1.0 if result.cluster_healthy else 0.0)
+        if result.scale_up is not None and result.scale_up.scaled_up:
+            m.scaled_up_nodes_total.inc(result.scale_up.new_nodes)
+        if result.scale_up is not None and result.scale_up.error:
+            m.failed_scale_ups_total.inc()
+        if result.scale_down is not None:
+            m.scaled_down_nodes_total.inc(
+                len(result.scale_down.deleted_empty), reason="empty"
+            )
+            m.scaled_down_nodes_total.inc(
+                len(result.scale_down.deleted_drain), reason="underutilized"
+            )
+            m.evicted_pods_total.inc(len(result.scale_down.evicted_pods))
+        for err in result.errors:
+            m.errors_total.inc(type="internal")
+        if result.errors:
+            self.health_check.update_last_activity()
+        else:
+            self.health_check.update_last_success()
+        return result
+
+    def _run_once_inner(self, now_ts: float) -> RunOnceResult:
         result = RunOnceResult()
 
         # startup: clean leftover taints from a crashed predecessor (:230)
@@ -108,6 +152,9 @@ class StaticAutoscaler:
         self._delete_created_nodes_with_errors()
 
         # 4. build the snapshot (:250-354)
+        import time as _time
+
+        t_snap = _time.monotonic()
         snapshot = ClusterSnapshot()
         scheduled, pending = self._split_pods(all_pods)
         for node in all_nodes:
@@ -135,16 +182,22 @@ class StaticAutoscaler:
         # virtual template nodes (:484-519)
         upcoming_names = self._inject_upcoming_nodes(snapshot)
 
+        self.metrics.observe_duration(metrics_mod.SNAPSHOT_BUILD, t_snap)
+
         # 5. filter-out-schedulable (:528) — device-packed onto a fork
+        t_filter = _time.monotonic()
         snapshot.fork()
         pending, filtered = self.pod_list_processor.process(snapshot, pending)
         snapshot.revert()
+        self.metrics.observe_duration(metrics_mod.FILTER_OUT_SCHEDULABLE, t_filter)
         result.filtered_schedulable = len(filtered)
         result.pending_pods = len(pending)
 
         # 6. scale-up (:560-580)
         if pending:
+            t_up = _time.monotonic()
             up = self.scale_up_orchestrator.scale_up(pending, all_nodes, now_ts)
+            self.metrics.observe_duration(metrics_mod.SCALE_UP, t_up)
             result.scale_up = up
             if up.scaled_up:
                 self.last_scale_up_ts = now_ts
@@ -154,10 +207,12 @@ class StaticAutoscaler:
 
         # 7. scale-down branch (:582-691)
         if self.options.scale_down_enabled:
+            t_unneeded = _time.monotonic()
             candidates = self._scale_down_candidates(all_nodes, upcoming_names)
             self.scale_down_planner.update_cluster_state(
                 snapshot, candidates, pdbs, now_ts
             )
+            self.metrics.observe_duration(metrics_mod.FIND_UNNEEDED, t_unneeded)
             result.unneeded_nodes = len(self.scale_down_planner.unneeded_names())
             in_cooldown = self._scale_down_in_cooldown(now_ts)
             result.scale_down_in_cooldown = in_cooldown
@@ -175,6 +230,8 @@ class StaticAutoscaler:
             self.scale_down_actuator.update_soft_deletion_taints(
                 self.api.list_nodes(), self.scale_down_planner.unneeded_names()
             )
+        if self.debugger is not None and self.debugger.is_data_collection_allowed():
+            self.debugger.capture(self, snapshot, pending, result)
         return result
 
     # -- helpers -------------------------------------------------------------

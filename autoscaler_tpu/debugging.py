@@ -1,0 +1,79 @@
+"""Debugging snapshot: capture the autoscaler's working state on demand.
+
+Reference: cluster-autoscaler/debuggingsnapshot/ — DebuggingSnapshotter
+state machine :56,72, the /snapshotz HTTP trigger :113, captured payload
+(NodeInfos, template nodes, "unscheduled pods that could schedule")
+debugging_snapshot.go:36-135. Here the capture additionally dumps the packed
+tensor shapes/stats, since the tensors ARE the decision state.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class DebuggingSnapshotter:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._requested = False
+        self._payload: Optional[Dict[str, Any]] = None
+
+    def request(self) -> None:
+        """Arm capture for the next loop iteration (the /snapshotz trigger)."""
+        with self._lock:
+            self._requested = True
+
+    def is_data_collection_allowed(self) -> bool:
+        with self._lock:
+            return self._requested
+
+    def capture(self, autoscaler, snapshot, pending_pods, result) -> None:
+        """Called at the end of a loop iteration when armed."""
+        with self._lock:
+            if not self._requested:
+                return
+            self._requested = False
+            tensors, meta = snapshot.tensors()
+            free = np.asarray(tensors.free())
+            nodes = []
+            for node in snapshot.nodes():
+                j = meta.node_index[node.name]
+                nodes.append(
+                    {
+                        "name": node.name,
+                        "ready": node.ready,
+                        "pods": len(snapshot.pods_on_node(node.name)),
+                        "free_cpu_m": float(free[j, 0]),
+                        "free_mem_mib": float(free[j, 1]),
+                        "taints": [t.key for t in node.taints],
+                    }
+                )
+            self._payload = {
+                "captured_at": time.time(),
+                "node_count": len(nodes),
+                "pod_count": len(snapshot.pods()),
+                "pending_pods": [p.key() for p in pending_pods],
+                "tensor_shapes": {
+                    "pods": list(tensors.pod_req.shape),
+                    "nodes": list(tensors.node_alloc.shape),
+                    "mask": list(tensors.sched_mask.shape),
+                },
+                "nodes": nodes,
+                "templates": [
+                    {"group": g.id(), "template": g.template_node_info().name}
+                    for g in autoscaler.provider.node_groups()
+                ],
+                "last_result": {
+                    "scaled_up": bool(result.scale_up and result.scale_up.scaled_up),
+                    "pending": result.pending_pods,
+                    "unneeded": result.unneeded_nodes,
+                },
+            }
+
+    def get(self) -> Optional[str]:
+        with self._lock:
+            return json.dumps(self._payload, indent=2) if self._payload else None

@@ -1,0 +1,216 @@
+"""CLI process entrypoint: flags → options, HTTP observability server, and
+the reconcile loop.
+
+Reference: cluster-autoscaler/main.go — flag surface :92-227,
+createAutoscalingOptions :229-337, metrics/health-check/snapshotz HTTP
+server :508-523, the scan-interval loop :471-489. Leader election (:525-573)
+is delegated to the orchestration platform (a Lease or equivalent); the
+process is stateless so active/passive failover needs no handover logic —
+pass --leader-elect-hook with a command that blocks until leadership if you
+need it.
+
+Usage:
+    python -m autoscaler_tpu.main --provider=test --scan-interval=10 \
+        --expander=least-waste --max-nodes-total=100 --address=:8085
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from autoscaler_tpu.config.options import AutoscalingOptions
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpu-autoscaler", description=__doc__)
+    # the reference's most-used flags (main.go:92-227), same semantics
+    p.add_argument("--scan-interval", type=float, default=10.0)
+    p.add_argument("--max-nodes-total", type=int, default=0)
+    p.add_argument("--cores-total", default="0:320000")
+    p.add_argument("--memory-total", default="0:6400000")
+    p.add_argument("--estimator", default="binpacking")
+    p.add_argument("--expander", default="random",
+                   help="comma-separated chain, e.g. priority,least-waste")
+    p.add_argument("--max-nodes-per-scaleup", type=int, default=1000)
+    p.add_argument("--balance-similar-node-groups", action="store_true")
+    p.add_argument("--scale-down-enabled", type=lambda s: s.lower() != "false", default=True)
+    p.add_argument("--scale-down-delay-after-add", type=float, default=600.0)
+    p.add_argument("--scale-down-delay-after-delete", type=float, default=0.0)
+    p.add_argument("--scale-down-delay-after-failure", type=float, default=180.0)
+    p.add_argument("--scale-down-unneeded-time", type=float, default=600.0)
+    p.add_argument("--scale-down-unready-time", type=float, default=1200.0)
+    p.add_argument("--scale-down-utilization-threshold", type=float, default=0.5)
+    p.add_argument("--scale-down-non-empty-candidates-count", type=int, default=30)
+    p.add_argument("--scale-down-candidates-pool-ratio", type=float, default=0.1)
+    p.add_argument("--scale-down-candidates-pool-min-count", type=int, default=50)
+    p.add_argument("--max-empty-bulk-delete", type=int, default=10)
+    p.add_argument("--max-graceful-termination-sec", type=float, default=600.0)
+    p.add_argument("--max-total-unready-percentage", type=float, default=45.0)
+    p.add_argument("--ok-total-unready-count", type=int, default=3)
+    p.add_argument("--max-node-provision-time", type=float, default=900.0)
+    p.add_argument("--enforce-node-group-min-size", action="store_true")
+    p.add_argument("--new-pod-scale-up-delay", type=float, default=0.0)
+    p.add_argument("--expendable-pods-priority-cutoff", type=int, default=-10)
+    p.add_argument("--provider", default="test")
+    p.add_argument("--address", default=":8085", help="observability HTTP bind")
+    p.add_argument("--health-check-max-inactivity", type=float, default=600.0)
+    p.add_argument("--health-check-max-failing-time", type=float, default=900.0)
+    p.add_argument("--max-iterations", type=int, default=0,
+                   help="stop after N loops (0 = forever); for testing")
+    return p
+
+
+def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
+    """createAutoscalingOptions analog (main.go:229)."""
+    cores_min, cores_max = (float(x) for x in args.cores_total.split(":"))
+    mem_min, mem_max = (float(x) for x in args.memory_total.split(":"))
+    opts = AutoscalingOptions(
+        scan_interval_s=args.scan_interval,
+        max_nodes_total=args.max_nodes_total,
+        min_cores_total=cores_min * 1000,
+        max_cores_total=cores_max * 1000,
+        min_memory_total=mem_min * 1024,
+        max_memory_total_mib=mem_max * 1024,
+        estimator=args.estimator,
+        expander=args.expander.split(",")[0],
+        max_nodes_per_scaleup=args.max_nodes_per_scaleup,
+        balance_similar_node_groups=args.balance_similar_node_groups,
+        scale_down_enabled=args.scale_down_enabled,
+        scale_down_delay_after_add_s=args.scale_down_delay_after_add,
+        scale_down_delay_after_delete_s=args.scale_down_delay_after_delete,
+        scale_down_delay_after_failure_s=args.scale_down_delay_after_failure,
+        scale_down_utilization_threshold=args.scale_down_utilization_threshold,
+        scale_down_non_empty_candidates_count=args.scale_down_non_empty_candidates_count,
+        scale_down_candidates_pool_ratio=args.scale_down_candidates_pool_ratio,
+        scale_down_candidates_pool_min_count=args.scale_down_candidates_pool_min_count,
+        max_empty_bulk_delete=args.max_empty_bulk_delete,
+        max_graceful_termination_s=args.max_graceful_termination_sec,
+        max_total_unready_percentage=args.max_total_unready_percentage,
+        ok_total_unready_count=args.ok_total_unready_count,
+        max_node_provision_time_s=args.max_node_provision_time,
+        enforce_node_group_min_size=args.enforce_node_group_min_size,
+        new_pod_scale_up_delay_s=args.new_pod_scale_up_delay,
+        expendable_pods_priority_cutoff=args.expendable_pods_priority_cutoff,
+        cloud_provider=args.provider,
+        max_inactivity_s=args.health_check_max_inactivity,
+        max_failing_time_s=args.health_check_max_failing_time,
+    )
+    opts.node_group_defaults.scale_down_unneeded_time_s = args.scale_down_unneeded_time
+    opts.node_group_defaults.scale_down_unready_time_s = args.scale_down_unready_time
+    opts.node_group_defaults.scale_down_utilization_threshold = (
+        args.scale_down_utilization_threshold
+    )
+    return opts
+
+
+class ObservabilityServer:
+    """/metrics, /health-check, /snapshotz, /status (main.go:508-523)."""
+
+    def __init__(self, autoscaler, address: str = ":8085"):
+        host, _, port = address.rpartition(":")
+        self.host = host or "0.0.0.0"
+        self.port = int(port)
+        self.autoscaler = autoscaler
+        self._server: Optional[ThreadingHTTPServer] = None
+
+    def start(self) -> int:
+        autoscaler = self.autoscaler
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: str, ctype="text/plain"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._send(200, autoscaler.metrics.registry.expose())
+                elif self.path == "/health-check":
+                    ok, msg = autoscaler.health_check.healthy()
+                    self._send(200 if ok else 500, msg)
+                elif self.path == "/snapshotz":
+                    if autoscaler.debugger is None:
+                        self._send(404, "debugging snapshotter disabled")
+                        return
+                    autoscaler.debugger.request()
+                    payload = autoscaler.debugger.get()
+                    self._send(
+                        200,
+                        payload or json.dumps({"status": "armed for next loop"}),
+                        "application/json",
+                    )
+                elif self.path == "/status":
+                    from autoscaler_tpu.clusterstate.status import build_status
+
+                    self._send(200, build_status(autoscaler.csr, time.time()).render())
+                else:
+                    self._send(404, "not found")
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        t = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.shutdown()
+
+
+def run_loop(autoscaler, scan_interval_s: float, max_iterations: int = 0) -> None:
+    """The steady loop (main.go:471-489)."""
+    iterations = 0
+    while True:
+        loop_start = time.monotonic()
+        autoscaler.run_once(now_ts=time.time())
+        iterations += 1
+        if max_iterations and iterations >= max_iterations:
+            return
+        elapsed = time.monotonic() - loop_start
+        time.sleep(max(scan_interval_s - elapsed, 0.0))
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    opts = options_from_args(args)
+
+    if args.provider != "test":
+        print(f"unknown cloud provider {args.provider!r} (available: test)", file=sys.stderr)
+        return 2
+    # the in-memory provider/API pair; real deployments construct their own
+    # provider adapter and cluster API binding and call run_loop directly
+    from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+    from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+    from autoscaler_tpu.debugging import DebuggingSnapshotter
+    from autoscaler_tpu.kube.api import FakeClusterAPI
+
+    provider = TestCloudProvider()
+    api = FakeClusterAPI()
+    autoscaler = StaticAutoscaler(
+        provider, api, opts, debugger=DebuggingSnapshotter()
+    )
+    server = ObservabilityServer(autoscaler, args.address)
+    port = server.start()
+    print(f"tpu-autoscaler: observability on :{port}, scan interval {opts.scan_interval_s}s")
+    try:
+        run_loop(autoscaler, opts.scan_interval_s, args.max_iterations)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

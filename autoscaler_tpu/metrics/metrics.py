@@ -1,0 +1,199 @@
+"""Metrics registry: counters, gauges, duration summaries, Prometheus text
+exposition — dependency-free.
+
+Reference: cluster-autoscaler/metrics/metrics.go — ~40 series :112-358, the
+FunctionLabel step taxonomy :42,94-107, UpdateDurationFromStart :399 wrapping
+every RunOnce phase, RegisterAll :361. Series names keep the reference's
+`cluster_autoscaler_` prefix so dashboards port over.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# FunctionLabel taxonomy (metrics.go:94-107)
+MAIN = "main"
+POLL = "poll"
+RECONFIGURE = "reconfigure"
+AUTOSCALING = "autoscaling"
+SCALE_UP = "scaleUp"
+SCALE_DOWN = "scaleDown"
+FIND_UNNEEDED = "findUnneeded"
+UPDATE_STATE = "updateClusterState"
+FILTER_OUT_SCHEDULABLE = "filterOutSchedulable"
+SNAPSHOT_BUILD = "buildSnapshot"
+DEVICE_DISPATCH = "deviceDispatch"  # TPU-specific: kernel round trips
+
+
+class _Series:
+    def __init__(self, name: str, help_: str, kind: str):
+        self.name = name
+        self.help = help_
+        self.kind = kind
+        self.values: Dict[Tuple[Tuple[str, str], ...], float] = defaultdict(float)
+
+    def _key(self, labels: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+        return tuple(sorted((labels or {}).items()))
+
+
+class Counter(_Series):
+    def inc(self, value: float = 1.0, **labels: str) -> None:
+        self.values[self._key(labels)] += value
+
+    def get(self, **labels: str) -> float:
+        return self.values.get(self._key(labels), 0.0)
+
+
+class Gauge(_Series):
+    def set(self, value: float, **labels: str) -> None:
+        self.values[self._key(labels)] = value
+
+    def get(self, **labels: str) -> float:
+        return self.values.get(self._key(labels), 0.0)
+
+
+@dataclass
+class _SummaryState:
+    count: int = 0
+    total: float = 0.0
+    maximum: float = 0.0
+    recent: List[float] = field(default_factory=list)  # sliding window for quantiles
+
+
+class Summary(_Series):
+    """Duration summary with approximate quantiles over a sliding window."""
+
+    WINDOW = 512
+
+    def __init__(self, name: str, help_: str):
+        super().__init__(name, help_, "summary")
+        self.states: Dict[Tuple[Tuple[str, str], ...], _SummaryState] = defaultdict(
+            _SummaryState
+        )
+
+    def observe(self, value: float, **labels: str) -> None:
+        s = self.states[self._key(labels)]
+        s.count += 1
+        s.total += value
+        s.maximum = max(s.maximum, value)
+        s.recent.append(value)
+        if len(s.recent) > self.WINDOW:
+            s.recent.pop(0)
+
+    def quantile(self, q: float, **labels: str) -> float:
+        s = self.states.get(self._key(labels))
+        if not s or not s.recent:
+            return 0.0
+        data = sorted(s.recent)
+        idx = min(int(q * len(data)), len(data) - 1)
+        return data[idx]
+
+    def count(self, **labels: str) -> int:
+        s = self.states.get(self._key(labels))
+        return s.count if s else 0
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Series] = {}
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = Counter(name, help_, "counter")
+            return self._metrics[name]  # type: ignore[return-value]
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = Gauge(name, help_, "gauge")
+            return self._metrics[name]  # type: ignore[return-value]
+
+    def summary(self, name: str, help_: str = "") -> Summary:
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = Summary(name, help_)
+            return self._metrics[name]  # type: ignore[return-value]
+
+    def expose(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+        with self._lock:
+            for m in self._metrics.values():
+                lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# TYPE {m.name} {m.kind if m.kind != 'summary' else 'summary'}")
+                if isinstance(m, Summary):
+                    for key, s in m.states.items():
+                        lbl = _fmt_labels(dict(key))
+                        lines.append(f"{m.name}_count{lbl} {s.count}")
+                        lines.append(f"{m.name}_sum{lbl} {s.total:.9g}")
+                        for q in (0.5, 0.9, 0.99):
+                            ql = _fmt_labels({**dict(key), "quantile": str(q)})
+                            lines.append(f"{m.name}{ql} {m.quantile(q, **dict(key)):.9g}")
+                else:
+                    for key, v in m.values.items():
+                        lines.append(f"{m.name}{_fmt_labels(dict(key))} {v:.9g}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class AutoscalerMetrics:
+    """The reference's series set (metrics.go:112-358), wired for RunOnce."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry or MetricsRegistry()
+        self.registry = r
+        p = "cluster_autoscaler_"
+        self.errors_total = r.counter(p + "errors_total", "autoscaler errors")
+        self.scaled_up_nodes_total = r.counter(
+            p + "scaled_up_nodes_total", "nodes added"
+        )
+        self.scaled_down_nodes_total = r.counter(
+            p + "scaled_down_nodes_total", "nodes removed"
+        )
+        self.evicted_pods_total = r.counter(p + "evicted_pods_total", "pods evicted")
+        self.failed_scale_ups_total = r.counter(
+            p + "failed_scale_ups_total", "failed scale-ups"
+        )
+        self.unschedulable_pods_count = r.gauge(
+            p + "unschedulable_pods_count", "pending pods"
+        )
+        self.nodes_count = r.gauge(p + "nodes_count", "nodes by state")
+        self.unneeded_nodes_count = r.gauge(
+            p + "unneeded_nodes_count", "scale-down candidates"
+        )
+        self.node_groups_count = r.gauge(p + "node_groups_count", "node groups")
+        self.cluster_safe_to_autoscale = r.gauge(
+            p + "cluster_safe_to_autoscale", "health gate"
+        )
+        self.last_activity = r.gauge(p + "last_activity", "ts of last loop by activity")
+        self.function_duration = r.summary(
+            p + "function_duration_seconds", "per-step durations"
+        )
+        self.device_dispatches_total = r.counter(
+            p + "device_dispatches_total", "TPU kernel dispatches"
+        )
+
+    def observe_duration(self, label: str, start_ts: float) -> float:
+        """UpdateDurationFromStart analog (metrics.go:399)."""
+        elapsed = time.monotonic() - start_ts
+        self.function_duration.observe(elapsed, function=label)
+        return elapsed
+
+
+_default = AutoscalerMetrics()
+
+
+def default_metrics() -> AutoscalerMetrics:
+    return _default

@@ -1,0 +1,163 @@
+"""Metrics/healthcheck/status/debugging/CLI tests (reference: metrics.go,
+healthcheck, clusterstate.go:701 GetStatus, debuggingsnapshot, main.go)."""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from autoscaler_tpu.clusterstate.status import build_status
+from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from autoscaler_tpu.debugging import DebuggingSnapshotter
+from autoscaler_tpu.kube.api import FakeClusterAPI
+from autoscaler_tpu.main import (
+    ObservabilityServer,
+    build_arg_parser,
+    options_from_args,
+    run_loop,
+)
+from autoscaler_tpu.metrics.healthcheck import HealthCheck
+from autoscaler_tpu.metrics.metrics import AutoscalerMetrics, MetricsRegistry
+from autoscaler_tpu.utils.test_utils import GB, build_test_node, build_test_pod
+
+
+class TestMetrics:
+    def test_counter_gauge_summary(self):
+        r = MetricsRegistry()
+        c = r.counter("test_total", "help")
+        c.inc(2, kind="x")
+        c.inc(3, kind="x")
+        assert c.get(kind="x") == 5
+        g = r.gauge("test_gauge")
+        g.set(7)
+        assert g.get() == 7
+        s = r.summary("test_duration_seconds")
+        for v in (0.1, 0.2, 0.3):
+            s.observe(v, function="main")
+        assert s.count(function="main") == 3
+        assert s.quantile(0.5, function="main") == pytest.approx(0.2)
+
+    def test_exposition_format(self):
+        r = MetricsRegistry()
+        r.counter("foo_total", "a counter").inc(1, label="a")
+        r.summary("bar_seconds").observe(0.5)
+        text = r.expose()
+        assert '# TYPE foo_total counter' in text
+        assert 'foo_total{label="a"} 1' in text
+        assert "bar_seconds_count 1" in text
+        assert 'quantile="0.5"' in text
+
+    def test_autoscaler_metrics_wiring(self):
+        m = AutoscalerMetrics(MetricsRegistry())
+        t0 = time.monotonic()
+        elapsed = m.observe_duration("main", t0)
+        assert elapsed >= 0
+        assert m.function_duration.count(function="main") == 1
+
+
+class TestHealthCheck:
+    def test_inactivity(self):
+        h = HealthCheck(max_inactivity_s=10, max_failing_s=100)
+        h.update_last_success(now=0.0)
+        assert h.healthy(now=5.0)[0]
+        assert not h.healthy(now=20.0)[0]
+
+    def test_failing_time(self):
+        h = HealthCheck(max_inactivity_s=1000, max_failing_s=30)
+        h.update_last_success(now=0.0)
+        for t in range(0, 40, 10):
+            h.update_last_activity(now=float(t))
+        ok, msg = h.healthy(now=35.0)
+        assert not ok and "failing" in msg
+
+
+def make_autoscaler(pods=()):
+    provider = TestCloudProvider()
+    api = FakeClusterAPI()
+    provider.add_node_group("g", 0, 10, 1, build_test_node("t", cpu_m=1000, mem=2 * GB))
+    node = build_test_node("g-0", cpu_m=1000, mem=2 * GB)
+    provider.add_node("g", node)
+    api.add_node(node)
+    for p in pods:
+        api.add_pod(p)
+    return StaticAutoscaler(
+        provider, api, AutoscalingOptions(), debugger=DebuggingSnapshotter()
+    )
+
+
+class TestStatusAndDebugging:
+    def test_status_render(self):
+        a = make_autoscaler()
+        a.run_once(now_ts=0.0)
+        status = build_status(a.csr, now_ts=0.0)
+        text = status.render()
+        assert "Cluster-wide: Health: Healthy" in text
+        assert "NodeGroup g:" in text
+        assert "target=1" in text
+
+    def test_metrics_updated_by_loop(self):
+        a = make_autoscaler(
+            [
+                build_test_pod("blocker", cpu_m=800, node_name="g-0"),
+                build_test_pod("p", cpu_m=900, mem=1 * GB),
+            ]
+        )
+        a.run_once(now_ts=0.0)
+        assert a.metrics.scaled_up_nodes_total.get() >= 1
+        assert a.metrics.function_duration.count(function="main") == 1
+        assert a.metrics.function_duration.count(function="scaleUp") == 1
+
+    def test_debugging_capture(self):
+        a = make_autoscaler()
+        a.debugger.request()
+        a.run_once(now_ts=0.0)
+        payload = a.debugger.get()
+        assert payload is not None
+        data = json.loads(payload)
+        assert data["node_count"] == 1
+        assert data["templates"][0]["group"] == "g"
+
+
+class TestCLI:
+    def test_options_from_args(self):
+        args = build_arg_parser().parse_args(
+            ["--scan-interval", "5", "--expander", "priority,least-waste",
+             "--max-nodes-total", "50", "--cores-total", "4:100"]
+        )
+        opts = options_from_args(args)
+        assert opts.scan_interval_s == 5
+        assert opts.expander == "priority"
+        assert opts.max_nodes_total == 50
+        assert opts.min_cores_total == 4000
+        assert opts.max_cores_total == 100_000
+
+    def test_observability_server(self):
+        a = make_autoscaler()
+        a.run_once(now_ts=0.0)
+        server = ObservabilityServer(a, "127.0.0.1:0")
+        port = server.start()
+        try:
+            def get(path):
+                with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+                    return r.status, r.read().decode()
+
+            code, body = get("/metrics")
+            assert code == 200 and "cluster_autoscaler_nodes" in body or "cluster_autoscaler" in body
+            code, body = get("/health-check")
+            assert code == 200 and body == "ok"
+            code, body = get("/status")
+            assert code == 200 and "NodeGroup g:" in body
+            code, body = get("/snapshotz")
+            assert code == 200  # armed
+            a.run_once(now_ts=1.0)
+            code, body = get("/snapshotz")
+            assert code == 200 and json.loads(body)["node_count"] == 1
+        finally:
+            server.stop()
+
+    def test_run_loop_bounded(self):
+        a = make_autoscaler()
+        run_loop(a, scan_interval_s=0.0, max_iterations=3)
+        assert a.metrics.function_duration.count(function="main") == 3
