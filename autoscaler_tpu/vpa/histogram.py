@@ -1,0 +1,157 @@
+"""Decaying-exponential histograms, vectorized across all containers.
+
+Reference: vertical-pod-autoscaler/pkg/recommender/util/histogram.go:34,159
+(exponential buckets: first bucket 0.01 cores / 10MB, ratio 1.05; weighted
+percentile) and decaying_histogram.go:53,108 (half-life decay 24h: new
+samples are scaled by 2^((t-ref)/half_life) and the bank is periodically
+re-referenced to keep weights in float range), plus the checkpoint
+(de)serialization at util/histogram.go:224,249.
+
+The reference keeps one Go histogram object per (VPA, container, resource);
+here a HistogramBank holds ALL of them as one [C, B] weight matrix, so a
+whole cluster's sample ingestion is one scatter-add and every percentile is
+one cumsum — the embarrassingly-vectorizable path SURVEY.md §7 stage 8
+calls out.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# reference constants (histogram_options for cpu / memory)
+CPU_FIRST_BUCKET = 0.01      # cores
+MEMORY_FIRST_BUCKET = 1e7    # bytes (10MB)
+BUCKET_RATIO = 1.05
+NUM_BUCKETS = 176            # covers ~0.01..~50 cores / 10MB..~50TB
+DEFAULT_HALF_LIFE_S = 24 * 3600.0
+EPSILON = 1e-15
+
+
+@dataclass(frozen=True)
+class HistogramSpec:
+    first_bucket: float
+    ratio: float = BUCKET_RATIO
+    num_buckets: int = NUM_BUCKETS
+
+    def bucket_of(self, values: np.ndarray) -> np.ndarray:
+        v = np.maximum(np.asarray(values, np.float64), EPSILON)
+        idx = np.floor(np.log(v / self.first_bucket) / np.log(self.ratio)) + 1.0
+        # values below first_bucket land in bucket 0
+        return np.clip(idx, 0, self.num_buckets - 1).astype(np.int32)
+
+    def bucket_start(self, idx) -> np.ndarray:
+        i = np.asarray(idx, np.float64)
+        return np.where(i <= 0, 0.0, self.first_bucket * self.ratio ** (i - 1.0))
+
+
+CPU_SPEC = HistogramSpec(CPU_FIRST_BUCKET)
+MEMORY_SPEC = HistogramSpec(MEMORY_FIRST_BUCKET)
+
+
+class HistogramBank:
+    """[C, B] decaying histogram bank for C containers."""
+
+    def __init__(
+        self,
+        num_series: int,
+        spec: HistogramSpec,
+        half_life_s: float = DEFAULT_HALF_LIFE_S,
+    ):
+        self.spec = spec
+        self.half_life_s = half_life_s
+        self.ref_ts = 0.0
+        self.weights = jnp.zeros((num_series, spec.num_buckets), jnp.float32)
+        self.total = jnp.zeros((num_series,), jnp.float32)
+
+    @property
+    def num_series(self) -> int:
+        return self.weights.shape[0]
+
+    def grow_to(self, num_series: int) -> None:
+        if num_series <= self.num_series:
+            return
+        pad = num_series - self.num_series
+        self.weights = jnp.pad(self.weights, ((0, pad), (0, 0)))
+        self.total = jnp.pad(self.total, (0, pad))
+
+    def _decay_factor(self, ts: np.ndarray) -> np.ndarray:
+        return np.power(2.0, (np.asarray(ts) - self.ref_ts) / self.half_life_s)
+
+    def add_samples(
+        self,
+        series_idx: np.ndarray,   # [K] i32
+        values: np.ndarray,       # [K]
+        weights: np.ndarray,      # [K]
+        timestamps: np.ndarray,   # [K] epoch seconds
+    ) -> None:
+        """One batched scatter-add for any number of samples across any
+        number of containers (decaying_histogram.go:AddSample, vectorized)."""
+        if len(series_idx) == 0:
+            return
+        buckets = self.spec.bucket_of(values)
+        w = np.asarray(weights, np.float64) * self._decay_factor(timestamps)
+        flat = np.asarray(series_idx, np.int64) * self.spec.num_buckets + buckets
+        self.weights = (
+            self.weights.ravel()
+            .at[jnp.asarray(flat)]
+            .add(jnp.asarray(w, jnp.float32))
+            .reshape(self.weights.shape)
+        )
+        self.total = self.total.at[jnp.asarray(series_idx)].add(
+            jnp.asarray(w, jnp.float32)
+        )
+        # re-reference when decayed weights threaten float32 range
+        max_ts = float(np.max(timestamps))
+        if max_ts - self.ref_ts > 10 * self.half_life_s:
+            shift = max_ts - self.ref_ts
+            factor = 0.5 ** (shift / self.half_life_s)
+            self.weights = self.weights * factor
+            self.total = self.total * factor
+            self.ref_ts = max_ts
+
+    def percentile(self, p: float) -> jax.Array:
+        """[C] — weighted percentile per series in one cumsum
+        (histogram.go:159 Percentile). Empty series → 0."""
+        cum = jnp.cumsum(self.weights, axis=1)
+        total = self.total[:, None]
+        target = p * total
+        idx = jnp.argmax(cum >= target - 1e-9, axis=1)
+        # reference returns the bucket END value (start of next bucket) so the
+        # recommendation covers the observed sample
+        ends = jnp.asarray(
+            self.spec.bucket_start(np.arange(1, self.spec.num_buckets + 1)),
+            jnp.float32,
+        )
+        out = ends[idx]
+        return jnp.where(self.total > 0, out, 0.0)
+
+    # -- checkpoints (histogram.go:224,249 SaveToChekpoint/LoadFromCheckpoint)
+    def checkpoint(self, series: int) -> Dict:
+        w = np.asarray(self.weights[series], np.float64)
+        total = float(w.sum())
+        if total <= 0:
+            return {"total_weight": 0.0, "bucket_weights": {}, "ref_ts": self.ref_ts}
+        maxw = w.max()
+        # reference normalizes to ints in 0..10000 relative to max bucket
+        norm = {
+            int(i): int(round(x / maxw * 10000))
+            for i, x in enumerate(w)
+            if round(x / maxw * 10000) > 0
+        }
+        return {"total_weight": total, "bucket_weights": norm, "ref_ts": self.ref_ts}
+
+    def restore(self, series: int, ckpt: Dict) -> None:
+        bw = ckpt.get("bucket_weights", {})
+        w = np.zeros(self.spec.num_buckets, np.float32)
+        norm_sum = sum(bw.values())
+        if norm_sum > 0:
+            for i, x in bw.items():
+                w[int(i)] = x
+            w = w / w.sum() * ckpt["total_weight"]
+        self.weights = self.weights.at[series].set(jnp.asarray(w))
+        self.total = self.total.at[series].set(float(ckpt.get("total_weight", 0.0)))

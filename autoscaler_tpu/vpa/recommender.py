@@ -1,0 +1,227 @@
+"""Vertical pod autoscaling: aggregate container state + percentile
+recommender, all containers evaluated in batched array ops.
+
+Reference: vertical-pod-autoscaler/pkg/recommender/ —
+- model: ClusterState pkg/recommender/model/cluster.go:41,
+  AggregateContainerState model/aggregate_container_state.go:91 (cpu usage
+  histogram + memory *peaks* histogram, first/last sample time, counts)
+- logic: percentile estimator chain logic/estimator.go:43,70,87 +
+  recommender.go:59,104-114 — target p90, lower bound p50, upper bound p95,
+  confidence-interval scaling by observation age, safety margin (+15%),
+  min-resources floor
+- loop: routines/recommender.go:160 RunOnce (feed → update VPAs → maintain
+  checkpoints → GC)
+"""
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from autoscaler_tpu.vpa.histogram import (
+    CPU_SPEC,
+    MEMORY_SPEC,
+    HistogramBank,
+    HistogramSpec,
+)
+
+# estimator constants (logic/recommender.go:104-114 and estimator.go)
+TARGET_PERCENTILE = 0.9
+LOWER_PERCENTILE = 0.5
+UPPER_PERCENTILE = 0.95
+SAFETY_MARGIN = 1.15
+MIN_CPU_CORES = 0.025
+MIN_MEMORY_BYTES = 250 * 1024 * 1024
+CONFIDENCE_EXPONENT = 1.0
+
+
+@dataclass
+class ContainerKey:
+    vpa: str
+    container: str
+
+    def __hash__(self):
+        return hash((self.vpa, self.container))
+
+    def __eq__(self, other):
+        return (self.vpa, self.container) == (other.vpa, other.container)
+
+
+@dataclass
+class Recommendation:
+    target_cpu: float        # cores
+    target_memory: float     # bytes
+    lower_cpu: float
+    lower_memory: float
+    upper_cpu: float
+    upper_memory: float
+
+
+@dataclass
+class _AggregateMeta:
+    first_sample_ts: float = math.inf
+    last_sample_ts: float = -math.inf
+    sample_count: int = 0
+    oom_observed_ts: Optional[float] = None
+
+
+class ClusterStateModel:
+    """All AggregateContainerStates backed by two HistogramBanks."""
+
+    def __init__(self, capacity: int = 64, half_life_s: float = 24 * 3600.0):
+        self.cpu = HistogramBank(capacity, CPU_SPEC, half_life_s)
+        self.memory = HistogramBank(capacity, MEMORY_SPEC, half_life_s)
+        self._index: Dict[ContainerKey, int] = {}
+        self._meta: List[_AggregateMeta] = []
+
+    def series(self, key: ContainerKey) -> int:
+        if key not in self._index:
+            idx = len(self._index)
+            self._index[key] = idx
+            self._meta.append(_AggregateMeta())
+            if idx >= self.cpu.num_series:
+                self.cpu.grow_to(self.cpu.num_series * 2)
+                self.memory.grow_to(self.memory.num_series * 2)
+        return self._index[key]
+
+    def add_cpu_samples(
+        self, keys: Sequence[ContainerKey], cores: Sequence[float], ts: Sequence[float]
+    ) -> None:
+        idx = np.array([self.series(k) for k in keys], np.int64)
+        # reference weights cpu samples by max(request, usage) — simplified to
+        # usage weighting: heavier samples count more
+        weights = np.maximum(np.asarray(cores, np.float64), MIN_CPU_CORES)
+        self.cpu.add_samples(idx, np.asarray(cores), weights, np.asarray(ts))
+        self._touch(idx, ts)
+
+    def add_memory_peaks(
+        self, keys: Sequence[ContainerKey], peaks: Sequence[float], ts: Sequence[float]
+    ) -> None:
+        idx = np.array([self.series(k) for k in keys], np.int64)
+        self.memory.add_samples(
+            idx, np.asarray(peaks), np.ones(len(idx)), np.asarray(ts)
+        )
+        self._touch(idx, ts)
+
+    def observe_oom(self, key: ContainerKey, memory_at_oom: float, ts: float) -> None:
+        """OOM bumps the memory histogram by a 20%-padded sample (reference
+        input/oom/observer.go via model)."""
+        idx = self.series(key)
+        self.memory.add_samples(
+            np.array([idx]), np.array([memory_at_oom * 1.2]), np.array([1.0]), np.array([ts])
+        )
+        self._meta[idx].oom_observed_ts = ts
+        self._touch(np.array([idx]), [ts])
+
+    def _touch(self, idx: np.ndarray, ts: Sequence[float]) -> None:
+        for i, t in zip(idx, ts):
+            m = self._meta[int(i)]
+            m.first_sample_ts = min(m.first_sample_ts, float(t))
+            m.last_sample_ts = max(m.last_sample_ts, float(t))
+            m.sample_count += 1
+
+    def meta(self, key: ContainerKey) -> _AggregateMeta:
+        return self._meta[self.series(key)]
+
+    def keys(self) -> List[ContainerKey]:
+        return list(self._index)
+
+
+class PercentileRecommender:
+    """The estimator chain: percentile → confidence scaling → margin → min
+    floor (logic/estimator.go:43,70,87)."""
+
+    def __init__(self, model: ClusterStateModel):
+        self.model = model
+
+    def recommend(self, now_ts: Optional[float] = None) -> Dict[ContainerKey, Recommendation]:
+        now_ts = now_ts if now_ts is not None else time.time()
+        keys = self.model.keys()
+        if not keys:
+            return {}
+        # all percentiles across all containers: six cumsum passes total
+        cpu_t = np.asarray(self.model.cpu.percentile(TARGET_PERCENTILE))
+        cpu_l = np.asarray(self.model.cpu.percentile(LOWER_PERCENTILE))
+        cpu_u = np.asarray(self.model.cpu.percentile(UPPER_PERCENTILE))
+        mem_t = np.asarray(self.model.memory.percentile(TARGET_PERCENTILE))
+        mem_l = np.asarray(self.model.memory.percentile(LOWER_PERCENTILE))
+        mem_u = np.asarray(self.model.memory.percentile(UPPER_PERCENTILE))
+
+        out: Dict[ContainerKey, Recommendation] = {}
+        for key in keys:
+            i = self.model.series(key)
+            meta = self.model.meta(key)
+            if meta.sample_count == 0:
+                continue
+            days = max((now_ts - meta.first_sample_ts) / 86400.0, 1e-3)
+            # confidence multipliers (estimator.go:70 confidenceMultiplier):
+            # upper shrinks toward target as history grows, lower grows toward it
+            upper_mult = (1.0 + 1.0 / days) ** CONFIDENCE_EXPONENT
+            lower_mult = (1.0 + 0.001 / days) ** -2.0
+            rec = Recommendation(
+                target_cpu=self._floor_cpu(cpu_t[i] * SAFETY_MARGIN),
+                target_memory=self._floor_mem(mem_t[i] * SAFETY_MARGIN),
+                lower_cpu=self._floor_cpu(cpu_l[i] * SAFETY_MARGIN * lower_mult),
+                lower_memory=self._floor_mem(mem_l[i] * SAFETY_MARGIN * lower_mult),
+                upper_cpu=self._floor_cpu(cpu_u[i] * SAFETY_MARGIN * upper_mult),
+                upper_memory=self._floor_mem(mem_u[i] * SAFETY_MARGIN * upper_mult),
+            )
+            out[key] = rec
+        return out
+
+    @staticmethod
+    def _floor_cpu(v: float) -> float:
+        return max(float(v), MIN_CPU_CORES)
+
+    @staticmethod
+    def _floor_mem(v: float) -> float:
+        return max(float(v), float(MIN_MEMORY_BYTES))
+
+
+@dataclass
+class Checkpoint:
+    """VerticalPodAutoscalerCheckpoint analog
+    (checkpoint/checkpoint_writer.go:36,78)."""
+
+    vpa: str
+    container: str
+    cpu: Dict = field(default_factory=dict)
+    memory: Dict = field(default_factory=dict)
+    sample_count: int = 0
+    first_sample_ts: float = 0.0
+
+
+class CheckpointManager:
+    def __init__(self, model: ClusterStateModel):
+        self.model = model
+
+    def store(self) -> List[Checkpoint]:
+        out = []
+        for key in self.model.keys():
+            i = self.model.series(key)
+            meta = self.model.meta(key)
+            out.append(
+                Checkpoint(
+                    vpa=key.vpa,
+                    container=key.container,
+                    cpu=self.model.cpu.checkpoint(i),
+                    memory=self.model.memory.checkpoint(i),
+                    sample_count=meta.sample_count,
+                    first_sample_ts=meta.first_sample_ts,
+                )
+            )
+        return out
+
+    def load(self, checkpoints: Sequence[Checkpoint]) -> None:
+        for ckpt in checkpoints:
+            key = ContainerKey(ckpt.vpa, ckpt.container)
+            i = self.model.series(key)
+            self.model.cpu.restore(i, ckpt.cpu)
+            self.model.memory.restore(i, ckpt.memory)
+            meta = self.model.meta(key)
+            meta.sample_count = ckpt.sample_count
+            meta.first_sample_ts = ckpt.first_sample_ts
+            meta.last_sample_ts = max(meta.last_sample_ts, ckpt.first_sample_ts)

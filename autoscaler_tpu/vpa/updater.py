@@ -1,0 +1,159 @@
+"""VPA updater: evict pods whose requests drift from the recommendation.
+
+Reference: vertical-pod-autoscaler/pkg/updater/ — logic/updater.go:109
+RunOnce, update_priority_calculator.go:47,81 (evict when any container's
+request is off by >10% either way, quick path for recent OOMs, and
+long-persisting (12h+) significant changes), eviction rate limiter :235,
+PDB-aware eviction via pkg/updater/eviction (here: RemainingPdbTracker).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from autoscaler_tpu.core.scaledown.tracking import RemainingPdbTracker
+from autoscaler_tpu.kube.objects import Pod
+from autoscaler_tpu.vpa.recommender import ContainerKey, Recommendation
+
+DEFAULT_DRIFT_THRESHOLD = 0.10         # updatePriorityCalculator 10%
+SIGNIFICANT_CHANGE_AFTER_S = 12 * 3600.0
+OOM_QUICK_PATH_WINDOW_S = 10 * 60.0
+
+
+@dataclass
+class PodUpdatePriority:
+    pod: Pod
+    priority: float
+    outside_recommended_range: bool
+    oom_quick_path: bool
+
+
+class UpdatePriorityCalculator:
+    def __init__(self, drift_threshold: float = DEFAULT_DRIFT_THRESHOLD):
+        self.drift_threshold = drift_threshold
+
+    def priority_of(
+        self,
+        pod: Pod,
+        recommendation: Recommendation,
+        now_ts: float,
+        last_oom_ts: Optional[float] = None,
+        recommendation_age_s: float = 0.0,
+    ) -> Optional[PodUpdatePriority]:
+        """→ update priority, or None when no update is warranted
+        (update_priority_calculator.go:47 AddPod / :81 getUpdatePriority)."""
+        req_cpu = pod.requests.cpu_m / 1000.0
+        req_mem = pod.requests.memory
+        drift = 0.0
+        outside = False
+        if req_cpu > 0:
+            drift += abs(recommendation.target_cpu - req_cpu) / req_cpu
+            if not (recommendation.lower_cpu <= req_cpu <= recommendation.upper_cpu):
+                outside = True
+        if req_mem > 0:
+            drift += abs(recommendation.target_memory - req_mem) / req_mem
+            if not (
+                recommendation.lower_memory <= req_mem <= recommendation.upper_memory
+            ):
+                outside = True
+
+        oom_quick = (
+            last_oom_ts is not None and now_ts - last_oom_ts < OOM_QUICK_PATH_WINDOW_S
+        )
+        significant = drift > self.drift_threshold and (
+            outside or recommendation_age_s >= SIGNIFICANT_CHANGE_AFTER_S
+        )
+        if not (oom_quick or significant):
+            return None
+        return PodUpdatePriority(
+            pod=pod,
+            priority=drift + (10.0 if oom_quick else 0.0),
+            outside_recommended_range=outside,
+            oom_quick_path=oom_quick,
+        )
+
+
+class EvictionRateLimiter:
+    """At most a fraction of a workload's replicas may be disrupted per pass
+    (updater.go:235 + eviction tolerance)."""
+
+    def __init__(self, eviction_tolerance: float = 0.5, min_replicas: int = 2):
+        self.eviction_tolerance = eviction_tolerance
+        self.min_replicas = min_replicas
+
+    def budget_for(self, replica_count: int) -> int:
+        if replica_count < self.min_replicas:
+            return 0
+        return max(1, int(replica_count * self.eviction_tolerance))
+
+
+class Updater:
+    def __init__(
+        self,
+        calculator: Optional[UpdatePriorityCalculator] = None,
+        rate_limiter: Optional[EvictionRateLimiter] = None,
+    ):
+        self.calculator = calculator or UpdatePriorityCalculator()
+        self.rate_limiter = rate_limiter or EvictionRateLimiter()
+
+    def run_once(
+        self,
+        pods_by_workload: Dict[str, List[Pod]],
+        recommendations: Dict[ContainerKey, Recommendation],
+        vpa_of_workload: Dict[str, str],
+        now_ts: float,
+        pdb_tracker: Optional[RemainingPdbTracker] = None,
+        evict_fn=None,
+        oom_ts: Optional[Dict[str, float]] = None,
+        recommendation_age_s: float = SIGNIFICANT_CHANGE_AFTER_S,
+    ) -> List[Pod]:
+        """→ pods evicted, highest priority first, PDB- and rate-limited."""
+        evicted: List[Pod] = []
+        oom_ts = oom_ts or {}
+        for workload, pods in pods_by_workload.items():
+            vpa = vpa_of_workload.get(workload)
+            if vpa is None:
+                continue
+            budget = self.rate_limiter.budget_for(len(pods))
+            candidates: List[PodUpdatePriority] = []
+            for pod in pods:
+                key = ContainerKey(vpa, pod.name.rsplit("-", 1)[0])
+                rec = recommendations.get(key) or next(
+                    (r for k, r in recommendations.items() if k.vpa == vpa), None
+                )
+                if rec is None:
+                    continue
+                p = self.calculator.priority_of(
+                    pod,
+                    rec,
+                    now_ts,
+                    last_oom_ts=oom_ts.get(pod.key()),
+                    recommendation_age_s=recommendation_age_s,
+                )
+                if p is not None:
+                    candidates.append(p)
+            candidates.sort(key=lambda c: -c.priority)
+            for cand in candidates[:budget]:
+                if pdb_tracker is not None and not pdb_tracker.can_remove_pods([cand.pod]):
+                    continue
+                if pdb_tracker is not None:
+                    pdb_tracker.remove_pods([cand.pod])
+                if evict_fn is not None:
+                    evict_fn(cand.pod)
+                evicted.append(cand.pod)
+        return evicted
+
+
+def apply_recommendation(pod: Pod, rec: Recommendation) -> Pod:
+    """Admission-controller analog: patch a (new) pod's requests to the
+    recommended target (reference pkg/admission-controller/logic/server.go:37
+    — the mutating webhook patches at create time; embed this at your pod
+    creation path)."""
+    import dataclasses
+
+    new_requests = dataclasses.replace(
+        pod.requests,
+        cpu_m=rec.target_cpu * 1000.0,
+        memory=rec.target_memory,
+    )
+    return dataclasses.replace(pod, requests=new_requests)

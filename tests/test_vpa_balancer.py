@@ -1,0 +1,272 @@
+"""VPA (histograms, recommender, updater, checkpoints), balancer, and
+addon-resizer tests — modeled on the reference's
+vertical-pod-autoscaler/pkg/recommender/util/histogram_test.go,
+logic/estimator_test.go, updater tests, and balancer/pkg/policy tests."""
+import numpy as np
+import pytest
+
+from autoscaler_tpu.addonresizer.nanny import LinearEstimator, Nanny
+from autoscaler_tpu.balancer.policy import (
+    Target,
+    distribute_by_priority,
+    distribute_by_proportions,
+    get_placement,
+)
+from autoscaler_tpu.core.scaledown.tracking import RemainingPdbTracker
+from autoscaler_tpu.kube.objects import (
+    LabelSelector,
+    PodDisruptionBudget,
+    Resources,
+)
+from autoscaler_tpu.utils.test_utils import GB, MB, build_test_pod
+from autoscaler_tpu.vpa.histogram import (
+    CPU_SPEC,
+    HistogramBank,
+    HistogramSpec,
+)
+from autoscaler_tpu.vpa.recommender import (
+    CheckpointManager,
+    ClusterStateModel,
+    ContainerKey,
+    PercentileRecommender,
+)
+from autoscaler_tpu.vpa.updater import (
+    Updater,
+    UpdatePriorityCalculator,
+    apply_recommendation,
+)
+
+DAY = 86400.0
+
+
+class TestHistogram:
+    def test_bucket_mapping(self):
+        spec = HistogramSpec(first_bucket=0.01, ratio=1.05, num_buckets=176)
+        assert spec.bucket_of([0.001])[0] == 0   # below first bucket
+        assert spec.bucket_of([0.01])[0] == 1
+        b = spec.bucket_of([1.0])[0]
+        assert spec.bucket_start(b) <= 1.0 <= spec.bucket_start(b + 1)
+
+    def test_percentile_batched(self):
+        bank = HistogramBank(3, CPU_SPEC)
+        # series 0: constant 0.5 cores; series 1: constant 2.0; series 2: empty
+        n = 100
+        bank.add_samples(
+            np.zeros(n, np.int64), np.full(n, 0.5), np.ones(n), np.zeros(n)
+        )
+        bank.add_samples(
+            np.ones(n, np.int64), np.full(n, 2.0), np.ones(n), np.zeros(n)
+        )
+        p = np.asarray(bank.percentile(0.9))
+        assert 0.5 <= p[0] <= 0.58   # bucket end covering 0.5
+        assert 2.0 <= p[1] <= 2.2
+        assert p[2] == 0.0
+
+    def test_decay_halves_old_weight(self):
+        bank = HistogramBank(1, CPU_SPEC, half_life_s=DAY)
+        # old heavy samples at 0.1 cores, then fresh samples at 1.0 one
+        # half-life later with half the count — equal effective weight
+        bank.add_samples(np.zeros(4, np.int64), np.full(4, 0.1), np.ones(4), np.zeros(4))
+        bank.add_samples(
+            np.zeros(2, np.int64), np.full(2, 1.0), np.ones(2), np.full(2, DAY)
+        )
+        p50 = float(np.asarray(bank.percentile(0.5))[0])
+        # effective: old 4*0.5=2, new 2*1=2 → p50 sits at the boundary (old bucket)
+        assert p50 <= 0.2
+        p75 = float(np.asarray(bank.percentile(0.9))[0])
+        assert p75 >= 1.0
+
+    def test_checkpoint_roundtrip(self):
+        bank = HistogramBank(2, CPU_SPEC)
+        bank.add_samples(
+            np.zeros(50, np.int64),
+            np.random.default_rng(0).uniform(0.1, 2.0, 50),
+            np.ones(50),
+            np.zeros(50),
+        )
+        before = float(np.asarray(bank.percentile(0.9))[0])
+        ckpt = bank.checkpoint(0)
+        bank2 = HistogramBank(2, CPU_SPEC)
+        bank2.restore(0, ckpt)
+        after = float(np.asarray(bank2.percentile(0.9))[0])
+        # normalization quantizes; within a bucket or two
+        assert after == pytest.approx(before, rel=0.15)
+
+
+class TestRecommender:
+    def test_end_to_end_recommendation(self):
+        model = ClusterStateModel()
+        key = ContainerKey("my-vpa", "app")
+        rng = np.random.default_rng(1)
+        ts = np.linspace(0, 8 * DAY, 500)
+        model.add_cpu_samples([key] * 500, rng.normal(0.5, 0.05, 500).clip(0.01), ts)
+        model.add_memory_peaks(
+            [key] * 500, rng.normal(1e9, 5e7, 500).clip(1e8), ts
+        )
+        recs = PercentileRecommender(model).recommend(now_ts=8 * DAY)
+        rec = recs[key]
+        # target ≈ p90 * 1.15 margin
+        assert 0.5 <= rec.target_cpu <= 0.8
+        assert 1e9 <= rec.target_memory <= 1.5e9
+        assert rec.lower_cpu <= rec.target_cpu <= rec.upper_cpu
+        assert rec.lower_memory <= rec.target_memory <= rec.upper_memory
+
+    def test_min_floor(self):
+        model = ClusterStateModel()
+        key = ContainerKey("v", "tiny")
+        model.add_cpu_samples([key], [0.001], [0.0])
+        model.add_memory_peaks([key], [1e6], [0.0])
+        rec = PercentileRecommender(model).recommend(now_ts=DAY)[key]
+        assert rec.target_cpu >= 0.025
+        assert rec.target_memory >= 250 * 1024 * 1024
+
+    def test_oom_bumps_memory_upper_bound(self):
+        # one OOM among ten normal peaks moves the p95 upper bound (the
+        # eviction quick-path is the updater's job, not the histogram's —
+        # matching the reference's RecordOOM behavior)
+        model = ClusterStateModel()
+        key = ContainerKey("v", "app")
+        model.add_memory_peaks([key] * 10, [5e8] * 10, list(range(10)))
+        before = PercentileRecommender(model).recommend(now_ts=DAY)[key].upper_memory
+        model.observe_oom(key, memory_at_oom=2e9, ts=11.0)
+        after = PercentileRecommender(model).recommend(now_ts=DAY)[key].upper_memory
+        assert after > before
+        assert after >= 2e9  # covers the padded OOM sample
+
+    def test_checkpoint_manager_roundtrip(self):
+        model = ClusterStateModel()
+        key = ContainerKey("v", "app")
+        model.add_cpu_samples([key] * 20, [0.7] * 20, list(range(20)))
+        model.add_memory_peaks([key] * 20, [8e8] * 20, list(range(20)))
+        ckpts = CheckpointManager(model).store()
+        model2 = ClusterStateModel()
+        CheckpointManager(model2).load(ckpts)
+        rec2 = PercentileRecommender(model2).recommend(now_ts=DAY)[key]
+        rec1 = PercentileRecommender(model).recommend(now_ts=DAY)[key]
+        assert rec2.target_cpu == pytest.approx(rec1.target_cpu, rel=0.15)
+
+
+class TestUpdater:
+    def _rec(self):
+        from autoscaler_tpu.vpa.recommender import Recommendation
+
+        return Recommendation(
+            target_cpu=1.0, target_memory=1e9,
+            lower_cpu=0.8, lower_memory=8e8,
+            upper_cpu=1.3, upper_memory=1.3e9,
+        )
+
+    def test_no_evict_within_band(self):
+        calc = UpdatePriorityCalculator()
+        pod = build_test_pod("app-1", cpu_m=1000, mem=1e9)
+        assert calc.priority_of(pod, self._rec(), now_ts=0.0) is None
+
+    def test_evict_on_drift(self):
+        calc = UpdatePriorityCalculator()
+        pod = build_test_pod("app-1", cpu_m=300, mem=1e9)  # way under target
+        p = calc.priority_of(pod, self._rec(), now_ts=0.0)
+        assert p is not None and p.outside_recommended_range
+
+    def test_oom_quick_path(self):
+        calc = UpdatePriorityCalculator()
+        pod = build_test_pod("app-1", cpu_m=950, mem=0.95e9)  # tiny drift
+        p = calc.priority_of(pod, self._rec(), now_ts=100.0, last_oom_ts=50.0)
+        assert p is not None and p.oom_quick_path
+
+    def test_updater_respects_pdb_and_budget(self):
+        pods = [build_test_pod(f"app-{i}", cpu_m=300, labels={"app": "x"}) for i in range(4)]
+        pdb = PodDisruptionBudget(
+            "pdb", "default", LabelSelector.from_dict({"app": "x"}), disruptions_allowed=1
+        )
+        tracker = RemainingPdbTracker([pdb])
+        evicted_names = []
+        updater = Updater()
+        evicted = updater.run_once(
+            pods_by_workload={"w": pods},
+            recommendations={ContainerKey("v", "app"): self._rec()},
+            vpa_of_workload={"w": "v"},
+            now_ts=0.0,
+            pdb_tracker=tracker,
+            evict_fn=lambda p: evicted_names.append(p.name),
+        )
+        assert len(evicted) == 1  # PDB allows only one disruption
+        assert evicted_names == [evicted[0].name]
+
+    def test_apply_recommendation(self):
+        pod = build_test_pod("app-1", cpu_m=100, mem=100 * MB)
+        patched = apply_recommendation(pod, self._rec())
+        assert patched.requests.cpu_m == pytest.approx(1000)
+        assert patched.requests.memory == pytest.approx(1e9)
+        assert pod.requests.cpu_m == 100  # original untouched
+
+
+class TestBalancer:
+    def test_priority_fill_order(self):
+        targets = [
+            Target("a", priority=0, max_replicas=3),
+            Target("b", priority=1, max_replicas=10),
+        ]
+        p = distribute_by_priority(10, targets)
+        assert p.assignments == {"a": 3, "b": 7}
+        assert p.unassigned == 0
+
+    def test_priority_minimums(self):
+        targets = [
+            Target("a", priority=0, max_replicas=10),
+            Target("b", priority=1, min_replicas=2, max_replicas=10),
+        ]
+        p = distribute_by_priority(5, targets)
+        assert p.assignments["b"] >= 2
+
+    def test_proportional_split(self):
+        targets = [
+            Target("a", proportion=3.0),
+            Target("b", proportion=1.0),
+        ]
+        p = distribute_by_proportions(8, targets)
+        assert p.assignments == {"a": 6, "b": 2}
+
+    def test_proportional_respects_max(self):
+        targets = [
+            Target("a", proportion=3.0, max_replicas=2),
+            Target("b", proportion=1.0, max_replicas=10),
+        ]
+        p = distribute_by_proportions(8, targets)
+        assert p.assignments["a"] == 2
+        assert p.assignments["b"] == 6
+
+    def test_failing_target_skipped(self):
+        targets = [
+            Target("a", priority=0, failing=True),
+            Target("b", priority=1, max_replicas=10),
+        ]
+        p = get_placement(4, targets, "priority")
+        assert p.assignments.get("a", 0) == 0
+        assert p.assignments["b"] == 4
+
+    def test_overflow_unassigned(self):
+        p = get_placement(10, [Target("a", max_replicas=4)], "priority")
+        assert p.unassigned == 6
+
+
+class TestNanny:
+    def test_linear_estimate_and_deadband(self):
+        est = LinearEstimator(
+            base_cpu_m=100, cpu_per_node_m=10, base_memory=100 * MB, memory_per_node=5 * MB
+        )
+        want = est.estimate(100)
+        assert want.cpu_m == 1100
+        # within deadband → no update
+        close = Resources(cpu_m=1050, memory=want.memory)
+        assert est.needs_update(close, 100) is None
+        far = Resources(cpu_m=500, memory=want.memory)
+        assert est.needs_update(far, 100) is not None
+
+    def test_nanny_applies_update(self):
+        est = LinearEstimator(100, 10, 100 * MB, 5 * MB)
+        applied = []
+        nanny = Nanny(est, applied.append)
+        assert nanny.poll(Resources(cpu_m=100, memory=100 * MB), 200)
+        assert applied and applied[0].cpu_m == 2100
+        # second poll with correct resources: no-op
+        assert not nanny.poll(applied[0], 200)
