@@ -1,0 +1,133 @@
+"""ctypes bridge to the native C++ components (native/ffd_serial.cpp).
+
+Builds the shared library on first use with g++ (cached beside the source,
+rebuilt when the source is newer). Falls back cleanly when no compiler is
+available — callers check `available()`.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_ROOT, "native", "ffd_serial.cpp")
+_LIB = os.path.join(_ROOT, "native", "libffd_serial.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _ensure_built() -> Optional[ctypes.CDLL]:
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        try:
+            if (
+                not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+            ):
+                subprocess.run(
+                    [
+                        "g++", "-O3", "-march=native", "-shared", "-fPIC",
+                        "-std=c++17", _SRC, "-o", _LIB,
+                    ],
+                    check=True,
+                    capture_output=True,
+                    text=True,
+                )
+            lib = ctypes.CDLL(_LIB)
+            lib.ffd_binpack_serial.restype = ctypes.c_int32
+            lib.ffd_binpack_serial.argtypes = [
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_uint8),
+            ]
+            lib.first_fit_serial.restype = None
+            lib.first_fit_serial.argtypes = [
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            _lib = lib
+        except Exception as e:  # compiler missing / build failure
+            _build_error = str(e)
+    return _lib
+
+
+def available() -> bool:
+    return _ensure_built() is not None
+
+
+def build_error() -> Optional[str]:
+    _ensure_built()
+    return _build_error
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def ffd_binpack_native(
+    pod_req: np.ndarray,        # [P, R] f32
+    pod_mask: np.ndarray,       # [P] bool
+    template_alloc: np.ndarray,  # [R] f32
+    max_nodes: int,
+    cpu_axis: int = 0,
+    mem_axis: int = 1,
+) -> Tuple[int, np.ndarray]:
+    """→ (node_count, scheduled[P] bool). Same contract as
+    estimator.reference_impl.ffd_binpack_reference."""
+    lib = _ensure_built()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_build_error}")
+    req = np.ascontiguousarray(pod_req, np.float32)
+    mask = np.ascontiguousarray(pod_mask, np.uint8)
+    alloc = np.ascontiguousarray(template_alloc, np.float32)
+    P, R = req.shape
+    out = np.zeros(P, np.uint8)
+    count = lib.ffd_binpack_serial(
+        _fptr(req),
+        mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        _fptr(alloc),
+        P, R, max_nodes, cpu_axis, mem_axis,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    if count < 0:
+        raise RuntimeError("ffd_binpack_serial failed")
+    return int(count), out.astype(bool)
+
+
+def first_fit_native(
+    pod_req: np.ndarray,  # [P, R] f32
+    free: np.ndarray,     # [N, R] f32
+    mask: np.ndarray,     # [P, N] bool
+) -> np.ndarray:
+    """→ first-fit node index per pod, -1 when none."""
+    lib = _ensure_built()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_build_error}")
+    req = np.ascontiguousarray(pod_req, np.float32)
+    fr = np.ascontiguousarray(free, np.float32)
+    m = np.ascontiguousarray(mask, np.uint8)
+    P, R = req.shape
+    N = fr.shape[0]
+    out = np.zeros(P, np.int32)
+    lib.first_fit_serial(
+        _fptr(req), _fptr(fr),
+        m.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        P, N, R,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return out

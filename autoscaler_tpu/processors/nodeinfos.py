@@ -1,0 +1,91 @@
+"""Template NodeInfo provider: what would a new node in each group look like.
+
+Reference: cluster-autoscaler/processors/nodeinfosprovider/
+mixed_nodeinfos_processor.go:46,75 (MixedTemplateNodeInfoProvider): prefer a
+sanitized copy of a real ready node from the group (it reflects true
+allocatable + daemonsets), fall back to the cloud provider's synthetic
+TemplateNodeInfo, and cache results with a TTL so template computation
+doesn't hit the cloud API every loop.
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from autoscaler_tpu.cloudprovider.interface import CloudProvider, NodeGroup
+from autoscaler_tpu.kube.objects import DELETION_CANDIDATE_TAINT, TO_BE_DELETED_TAINT, Node
+
+
+@dataclass
+class _CacheEntry:
+    template: Node
+    ts: float
+
+
+class MixedTemplateNodeInfoProvider:
+    def __init__(self, ttl_s: float = 60.0):
+        self.ttl_s = ttl_s
+        self._cache: Dict[str, _CacheEntry] = {}
+
+    def template_for(
+        self,
+        group: NodeGroup,
+        real_nodes: Sequence[Node],
+        now_ts: float,
+    ) -> Optional[Node]:
+        gid = group.id()
+        cached = self._cache.get(gid)
+        if cached is not None and now_ts - cached.ts < self.ttl_s:
+            return cached.template
+
+        template: Optional[Node] = None
+        ready = [n for n in real_nodes if n.ready and not n.unschedulable]
+        if ready:
+            template = self._sanitize(ready[0], gid)
+        else:
+            try:
+                template = group.template_node_info()
+            except Exception:
+                template = None
+        if template is not None:
+            self._cache[gid] = _CacheEntry(template, now_ts)
+        return template
+
+    def process(
+        self,
+        provider: CloudProvider,
+        nodes_by_group: Dict[str, List[Node]],
+        now_ts: float,
+    ) -> Dict[str, Node]:
+        """→ group id → template (TemplateNodeInfoProvider.Process analog)."""
+        out: Dict[str, Node] = {}
+        for group in provider.node_groups():
+            tmpl = self.template_for(group, nodes_by_group.get(group.id(), []), now_ts)
+            if tmpl is not None:
+                out[group.id()] = tmpl
+        return out
+
+    @staticmethod
+    def _sanitize(node: Node, gid: str) -> Node:
+        """DeepCopyTemplateNode analog (utils/scheduler/scheduler.go:73):
+        fresh name, autoscaler-managed taints stripped."""
+        fresh = copy.deepcopy(node)
+        fresh = dataclasses.replace(
+            fresh,
+            name=f"template-{gid}-from-{node.name}",
+            provider_id="",
+            taints=[
+                t
+                for t in fresh.taints
+                if t.key not in (TO_BE_DELETED_TAINT, DELETION_CANDIDATE_TAINT)
+            ],
+        )
+        return fresh
+
+    def invalidate(self, group_id: Optional[str] = None) -> None:
+        if group_id is None:
+            self._cache.clear()
+        else:
+            self._cache.pop(group_id, None)

@@ -1,0 +1,149 @@
+"""The processors container — the reference's 18 extension points, with
+defaults.
+
+Reference: cluster-autoscaler/processors/processors.go:36
+(AutoscalingProcessors struct) and DefaultProcessors. Interfaces without a
+TPU-specific twist are small Protocols with default implementations;
+heavyweight ones live in sibling modules (nodegroupset.py, nodeinfos.py,
+core/podlistprocessor.py). Provider-specific overrides replace fields on the
+container, exactly like main.go:406-440 does.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Protocol, Sequence, Tuple
+
+from autoscaler_tpu.cloudprovider.interface import CloudProvider, NodeGroup
+from autoscaler_tpu.core.podlistprocessor import FilterOutSchedulablePodListProcessor
+from autoscaler_tpu.kube.objects import Node, Pod
+from autoscaler_tpu.processors.nodegroupset import BalancingNodeGroupSetProcessor
+from autoscaler_tpu.processors.nodeinfos import MixedTemplateNodeInfoProvider
+
+
+class ScaleUpStatusProcessor(Protocol):
+    def process(self, result) -> None: ...
+
+
+class ScaleDownStatusProcessor(Protocol):
+    def process(self, result) -> None: ...
+
+
+@dataclass
+class EventingScaleUpStatusProcessor:
+    """Default: surface scale-up outcomes as events/log lines (reference
+    processors/status/eventing_scale_up_processor.go)."""
+
+    sink: Callable[[str, str], None] = lambda reason, msg: None
+
+    def process(self, result) -> None:
+        if result is None:
+            return
+        if result.scaled_up:
+            self.sink(
+                "TriggeredScaleUp",
+                f"scale-up: group {result.chosen_group} +{result.new_nodes} "
+                f"for {len(result.pods_triggered)} pods",
+            )
+        for pod in result.pods_remain_unschedulable:
+            self.sink("NotTriggerScaleUp", f"pod {pod.key()} can't be helped")
+
+
+@dataclass
+class NoOpScaleDownStatusProcessor:
+    def process(self, result) -> None:
+        return
+
+
+class CustomResourcesProcessor:
+    """GPU/TPU readiness: a node advertising an accelerator label but 0
+    allocatable devices is still initializing — treat as unready so
+    utilization/scale-down logic doesn't misread it (reference
+    processors/customresources/gpu_processor.go)."""
+
+    def __init__(self, gpu_label: str = "cloud.google.com/gke-accelerator"):
+        self.gpu_label = gpu_label
+
+    def filter_out_nodes_with_unready_resources(
+        self, nodes: Sequence[Node]
+    ) -> Tuple[List[Node], List[Node]]:
+        ready, not_ready = [], []
+        for node in nodes:
+            if (
+                self.gpu_label in node.labels
+                and node.allocatable.gpu == 0
+                and node.allocatable.tpu == 0
+            ):
+                not_ready.append(node)
+            else:
+                ready.append(node)
+        return ready, not_ready
+
+
+class ScaleDownCandidatesSortingProcessor:
+    """Order scale-down candidates: previously-unneeded first so decisions
+    stabilize across loops (reference processors/scaledowncandidates/
+    previous_candidates.go + sorting)."""
+
+    def __init__(self) -> None:
+        self._previous: set = set()
+
+    def sort(self, candidates: Sequence[Node]) -> List[Node]:
+        prev = [n for n in candidates if n.name in self._previous]
+        rest = [n for n in candidates if n.name not in self._previous]
+        return prev + rest
+
+    def update(self, unneeded_names: Sequence[str]) -> None:
+        self._previous = set(unneeded_names)
+
+
+class NodeGroupManager:
+    """Node-group autoprovisioning lifecycle (reference processors/nodegroups/
+    — NAP creates groups for pods no existing group fits and deletes empty
+    autoprovisioned groups). The default implementation is a no-op unless the
+    provider supports group creation."""
+
+    def __init__(self, max_autoprovisioned: int = 15):
+        self.max_autoprovisioned = max_autoprovisioned
+
+    def remove_unneeded_node_groups(self, provider: CloudProvider) -> List[str]:
+        removed = []
+        for group in provider.node_groups():
+            if group.autoprovisioned() and group.target_size() == 0:
+                try:
+                    group.delete()
+                    removed.append(group.id())
+                except Exception:
+                    pass
+        return removed
+
+
+@dataclass
+class AutoscalingProcessors:
+    """processors.go:36 — one container wired through the control loop."""
+
+    pod_list_processor: FilterOutSchedulablePodListProcessor = field(
+        default_factory=FilterOutSchedulablePodListProcessor
+    )
+    node_group_set: BalancingNodeGroupSetProcessor = field(
+        default_factory=BalancingNodeGroupSetProcessor
+    )
+    template_node_info_provider: MixedTemplateNodeInfoProvider = field(
+        default_factory=MixedTemplateNodeInfoProvider
+    )
+    scale_up_status: EventingScaleUpStatusProcessor = field(
+        default_factory=EventingScaleUpStatusProcessor
+    )
+    scale_down_status: NoOpScaleDownStatusProcessor = field(
+        default_factory=NoOpScaleDownStatusProcessor
+    )
+    custom_resources: CustomResourcesProcessor = field(
+        default_factory=CustomResourcesProcessor
+    )
+    scale_down_candidates_sorting: ScaleDownCandidatesSortingProcessor = field(
+        default_factory=ScaleDownCandidatesSortingProcessor
+    )
+    node_group_manager: NodeGroupManager = field(default_factory=NodeGroupManager)
+
+
+def default_processors() -> AutoscalingProcessors:
+    return AutoscalingProcessors()

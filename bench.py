@@ -1,18 +1,24 @@
-"""Headline benchmark: batched TPU scale-up estimation vs the serial
-reference algorithm.
+"""Headline benchmark: batched TPU scale-up estimation at the north-star
+scale vs a compiled serial baseline.
 
-Workload is BASELINE config #2: 10k heterogeneous pods (cpu/mem/GPU requests)
-x 50 node groups, estimated in ONE batched device dispatch
-(ops/binpack.ffd_binpack_groups), versus the serial per-group x per-pod x
-per-node loop the reference runs (cluster-autoscaler/estimator/
-binpacking_estimator.go:65-141 inside core/scaleup/orchestrator/
-orchestrator.go:139-179). The baseline is the numpy serial oracle
-(autoscaler_tpu/estimator/reference_impl.py) that mirrors the Go algorithm's
-structure, timed on a group subsample and scaled linearly in group count
-(each group's estimate is independent and identically sized, so the
-extrapolation is exact in expectation).
+Workload: the BASELINE.json north-star — 100k pending heterogeneous pods
+(cpu/mem/GPU requests) x 500 node groups, max 1000 nodes per group
+(the reference's --max-nodes-per-scaleup default, main.go:215), estimated in
+ONE batched device dispatch (ops/binpack.ffd_binpack_groups).
+
+Baseline: the C++ serial FFD (native/ffd_serial.cpp), which mirrors the Go
+BinpackingNodeEstimator's algorithm (binpacking_estimator.go:65-141) as the
+reference's serial per-group loop would run it — a deliberately STRONG
+stand-in: it strips the scheduler-framework plugin overhead the real
+reference pays per (pod, node) check (its binpacking budget is 10s/group,
+main.go:216; the compiled loop here does ~0.1s/group). Sampled on 3 groups
+and scaled linearly in group count (groups are independent and identically
+distributed). Falls back to the numpy oracle if no C++ toolchain exists.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = serial_baseline_time / tpu_time on identical work (single
+chip; the group axis additionally shards across chips via shard_map —
+see __graft_entry__.dryrun_multichip).
 """
 from __future__ import annotations
 
@@ -22,7 +28,7 @@ import time
 import numpy as np
 
 
-def build_workload(P=10_000, G=50, seed=0):
+def build_workload(P=100_000, G=500, seed=0):
     from autoscaler_tpu.kube.objects import CPU, GPU, MEMORY, PODS
 
     rng = np.random.default_rng(seed)
@@ -44,7 +50,7 @@ def build_workload(P=10_000, G=50, seed=0):
     masks = rng.random((G, P)) > 0.05
     # gpu pods only schedulable on gpu groups
     masks[np.ix_(~gpu_groups, gpu_pods)] = False
-    caps = np.full(G, 128, np.int32)
+    caps = np.full(G, 1000, np.int32)
     return pod_req, masks, allocs, caps
 
 
@@ -52,10 +58,9 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    from autoscaler_tpu.estimator.reference_impl import ffd_binpack_reference
     from autoscaler_tpu.ops.binpack import ffd_binpack_groups
 
-    P, G, MAX_NODES = 10_000, 50, 128
+    P, G, MAX_NODES = 100_000, 500, 1000
     pod_req, masks, allocs, caps = build_workload(P, G)
 
     jreq = jnp.asarray(pod_req)
@@ -67,35 +72,49 @@ def main():
         out = ffd_binpack_groups(
             jreq, jmasks, jallocs, max_nodes=MAX_NODES, node_caps=jcaps
         )
-        # Force completion with a host fetch of everything the control plane
-        # actually consumes (block_until_ready alone under-reports through
-        # the axon relay: dispatch is async and buffers resolve lazily).
+        # Host fetch forces completion (async dispatch through the axon relay
+        # under-reports otherwise) and is what the control plane consumes.
         return np.asarray(out.node_count), np.asarray(out.scheduled)
 
     res_counts, res_sched = run()  # compile + warm
     times = []
-    for _ in range(5):
+    for _ in range(3):
         t0 = time.perf_counter()
         run()
         times.append(time.perf_counter() - t0)
     t_tpu = float(np.median(times))
 
-    # Serial baseline on a subsample of groups, scaled to G.
-    SAMPLE = 2
-    t0 = time.perf_counter()
+    # Serial compiled baseline on a 3-group sample, scaled to G.
+    try:
+        from autoscaler_tpu.native_bridge import ffd_binpack_native as baseline_ffd
+
+        baseline = "cpp"
+    except Exception:
+        baseline = "numpy"
+    SAMPLE = 3
+    sample_times = []
     for g in range(SAMPLE):
-        ref_count, ref_sched = ffd_binpack_reference(pod_req, masks[g], allocs[g], MAX_NODES)
+        t0 = time.perf_counter()
+        if baseline == "cpp":
+            ref_count, ref_sched = baseline_ffd(pod_req, masks[g], allocs[g], MAX_NODES)
+        else:
+            from autoscaler_tpu.estimator.reference_impl import ffd_binpack_reference
+
+            ref_count, ref_sched = ffd_binpack_reference(
+                pod_req, masks[g], allocs[g], MAX_NODES
+            )
+        sample_times.append(time.perf_counter() - t0)
         assert ref_count == int(res_counts[g]), (
             f"parity violation on group {g}: ref={ref_count} tpu={int(res_counts[g])}"
         )
         np.testing.assert_array_equal(res_sched[g], ref_sched)
-    t_ref = (time.perf_counter() - t0) / SAMPLE * G
+    t_ref = float(np.median(sample_times)) * G
 
     value = P * G / t_tpu
     print(
         json.dumps(
             {
-                "metric": "scaleup_estimator_throughput_10kpods_50groups",
+                "metric": "scaleup_estimator_throughput_100kpods_500groups",
                 "value": round(value, 1),
                 "unit": "pod-group-evals/sec",
                 "vs_baseline": round(t_ref / t_tpu, 2),

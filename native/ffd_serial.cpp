@@ -1,0 +1,108 @@
+// Serial first-fit-decreasing binpacking — the compiled host baseline.
+//
+// Mirrors the algorithmic structure of the reference's Go
+// BinpackingNodeEstimator (cluster-autoscaler/estimator/binpacking_estimator.go
+// :65-141: score-sort descending, first-fit over open template nodes in open
+// order, open-on-miss, skip pods that cannot fit an empty node) as a compiled
+// serial implementation. Two jobs:
+//   1. bench.py baseline: a fair stand-in for the reference's compiled Go
+//      hot loop (the numpy oracle under-represents it by ~an order of
+//      magnitude of interpreter overhead).
+//   2. host-side fallback when no accelerator is present.
+//
+// C ABI for ctypes: see autoscaler_tpu/native_bridge.py.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+extern "C" {
+
+// pod_req: P x R row-major; pod_mask: P (0/1); template_alloc: R
+// out_scheduled: P (0/1). Returns the number of nodes opened, or -1 on error.
+int32_t ffd_binpack_serial(const float* pod_req, const uint8_t* pod_mask,
+                           const float* template_alloc, int32_t P, int32_t R,
+                           int32_t max_nodes, int32_t cpu_axis,
+                           int32_t mem_axis, uint8_t* out_scheduled) {
+  if (P < 0 || R <= 0 || max_nodes < 0) return -1;
+  const float cpu_cap = template_alloc[cpu_axis];
+  const float mem_cap = template_alloc[mem_axis];
+
+  std::vector<float> score(P, 0.0f);
+  for (int32_t i = 0; i < P; ++i) {
+    const float* req = pod_req + (size_t)i * R;
+    if (cpu_cap > 0) score[i] += req[cpu_axis] / cpu_cap;
+    if (mem_cap > 0) score[i] += req[mem_axis] / mem_cap;
+  }
+  std::vector<int32_t> order(P);
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(),
+                   [&](int32_t a, int32_t b) { return score[a] > score[b]; });
+
+  // open-node usage, flat [n][r]
+  std::vector<float> used;
+  used.reserve((size_t)std::min(max_nodes, P) * R);
+  int32_t opened = 0;
+  std::memset(out_scheduled, 0, P);
+
+  for (int32_t oi = 0; oi < P; ++oi) {
+    const int32_t i = order[oi];
+    if (!pod_mask[i]) continue;
+    const float* req = pod_req + (size_t)i * R;
+    bool placed = false;
+    for (int32_t n = 0; n < opened && !placed; ++n) {
+      float* u = used.data() + (size_t)n * R;
+      bool fits = true;
+      for (int32_t r = 0; r < R; ++r) {
+        if (req[r] > template_alloc[r] - u[r]) { fits = false; break; }
+      }
+      if (fits) {
+        for (int32_t r = 0; r < R; ++r) u[r] += req[r];
+        placed = true;
+      }
+    }
+    if (!placed && opened < max_nodes) {
+      bool fits_empty = true;
+      for (int32_t r = 0; r < R; ++r) {
+        if (req[r] > template_alloc[r]) { fits_empty = false; break; }
+      }
+      if (fits_empty) {
+        used.resize((size_t)(opened + 1) * R, 0.0f);
+        float* u = used.data() + (size_t)opened * R;
+        for (int32_t r = 0; r < R; ++r) u[r] = req[r];
+        ++opened;
+        placed = true;
+      }
+    }
+    out_scheduled[i] = placed ? 1 : 0;
+  }
+  return opened;
+}
+
+// Serial per-(pod,node) first-fit predicate scan — the schedulerbased.go:90
+// FitsAnyNodeMatching shape, for baseline comparisons of the fit kernel.
+// free: N x R row-major; mask: P x N row-major (0/1).
+// out_first: P (node index or -1).
+void first_fit_serial(const float* pod_req, const float* free,
+                      const uint8_t* mask, int32_t P, int32_t N, int32_t R,
+                      int32_t* out_first) {
+  for (int32_t i = 0; i < P; ++i) {
+    const float* req = pod_req + (size_t)i * R;
+    int32_t hit = -1;
+    const uint8_t* mrow = mask + (size_t)i * N;
+    for (int32_t n = 0; n < N && hit < 0; ++n) {
+      if (!mrow[n]) continue;
+      const float* f = free + (size_t)n * R;
+      bool fits = true;
+      for (int32_t r = 0; r < R; ++r) {
+        if (req[r] > f[r]) { fits = false; break; }
+      }
+      if (fits) hit = n;
+    }
+    out_first[i] = hit;
+  }
+}
+
+}  // extern "C"
