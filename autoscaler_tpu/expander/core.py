@@ -135,6 +135,10 @@ def build_strategy(names: Sequence[str], seed: Optional[int] = None, **kwargs) -
             from autoscaler_tpu.expander.priority import PriorityFilter
 
             filters.append(PriorityFilter(kwargs["priorities"]))
+        elif name == GRPC:
+            from autoscaler_tpu.expander.grpc_ import GRPCFilter
+
+            filters.append(GRPCFilter(kwargs["grpc_target"]))
         else:
             raise ValueError(f"unknown expander {name!r}")
     return ChainStrategy(filters, RandomStrategy(seed))

@@ -1,0 +1,42 @@
+"""External gRPC expander — delegate the BestOptions choice to an
+out-of-process service.
+
+Reference: cluster-autoscaler/expander/grpcplugin/ (grpc_client.go, proto
+expander/grpcplugin/protos/expander.proto:10): CA ships pending options to an
+operator-owned gRPC service and acts on its pick. Here the wire type is our
+Option message (rpc/protos/autoscaler.proto).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from autoscaler_tpu.expander.core import Filter, Option
+
+
+class GRPCFilter(Filter):
+    def __init__(self, target: str, timeout_s: float = 5.0):
+        from autoscaler_tpu.rpc.service import TpuSimulationClient
+
+        self.client = TpuSimulationClient(target)
+        self.timeout_s = timeout_s
+
+    def best_options(self, options: List[Option]) -> List[Option]:
+        from autoscaler_tpu.rpc import autoscaler_pb2 as pb
+
+        if not options:
+            return []
+        by_id = {o.node_group.id(): o for o in options}
+        wire = [
+            pb.Option(
+                group_id=o.node_group.id(),
+                node_count=o.node_count,
+                pod_keys=[p.key() for p in o.pods],
+            )
+            for o in options
+        ]
+        try:
+            best = self.client.best_options(wire)
+        except Exception:
+            return list(options)  # fail open: let the next filter decide
+        picked = [by_id[b.group_id] for b in best if b.group_id in by_id]
+        return picked or list(options)

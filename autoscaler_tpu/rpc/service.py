@@ -1,0 +1,226 @@
+"""gRPC sidecar service + host-side client.
+
+The process split mirrors the BASELINE north-star: a host control plane
+(Go/Python, owns cluster watch + actuation) flattens cluster state to dense
+tensors and calls a device-owning sidecar over gRPC; the sidecar runs the
+batched kernels. The protocol (protos/autoscaler.proto) is modeled on the
+reference's in-tree gRPC plugin seams (expander/grpcplugin/protos/
+expander.proto:10, cloudprovider/externalgrpc/protos/externalgrpc.proto:29).
+
+Service handlers are registered via grpc's generic-handler API (no
+grpc_tools codegen needed; messages come from protoc --python_out).
+"""
+from __future__ import annotations
+
+from concurrent import futures
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import grpc
+
+from autoscaler_tpu.rpc import autoscaler_pb2 as pb
+
+SERVICE_NAME = "autoscaler_tpu.TpuSimulation"
+
+
+def _f32(blob: bytes, *shape: int) -> np.ndarray:
+    return np.frombuffer(blob, np.dtype("<f4")).reshape(shape).copy()
+
+
+def _i32(blob: bytes, *shape: int) -> np.ndarray:
+    return np.frombuffer(blob, np.dtype("<i4")).reshape(shape).copy()
+
+
+def _u8(blob: bytes, *shape: int) -> np.ndarray:
+    return np.frombuffer(blob, np.uint8).reshape(shape).astype(bool)
+
+
+class TpuSimulationServicer:
+    """Device-side implementation: each RPC is one batched kernel dispatch."""
+
+    def Estimate(self, request: pb.EstimateRequest, context) -> pb.EstimateResponse:
+        import jax.numpy as jnp
+
+        from autoscaler_tpu.ops.binpack import ffd_binpack_groups
+
+        P = request.pods.num_pods
+        R = request.pods.num_resources
+        G = len(request.group_ids)
+        pod_req = _f32(request.pods.requests, P, R)
+        masks = _u8(request.pod_masks, G, P)
+        allocs = _f32(request.template_allocs, G, R)
+        caps = _i32(request.node_caps, G)
+        res = ffd_binpack_groups(
+            jnp.asarray(pod_req),
+            jnp.asarray(masks),
+            jnp.asarray(allocs),
+            max_nodes=int(request.max_nodes),
+            node_caps=jnp.asarray(caps),
+        )
+        return pb.EstimateResponse(
+            node_counts=np.asarray(res.node_count, np.dtype("<i4")).tobytes(),
+            scheduled=np.asarray(res.scheduled, np.uint8).tobytes(),
+        )
+
+    def TrySchedule(self, request: pb.TryScheduleRequest, context) -> pb.TryScheduleResponse:
+        import jax.numpy as jnp
+
+        from autoscaler_tpu.ops.schedule import greedy_schedule
+        from autoscaler_tpu.snapshot.tensors import SnapshotTensors
+
+        P = request.pods.num_pods
+        R = request.pods.num_resources
+        N = request.num_nodes
+        pod_req = _f32(request.pods.requests, P, R)
+        free = _f32(request.node_free, N, R)
+        mask = _u8(request.sched_mask, P, N)
+        slots = _i32(request.pod_slots, -1)
+        hints = _i32(request.hints, -1)
+        snap = SnapshotTensors(
+            node_alloc=jnp.asarray(free),
+            node_used=jnp.zeros((N, R), jnp.float32),
+            node_valid=jnp.ones((N,), bool),
+            node_group=jnp.full((N,), -1, jnp.int32),
+            pod_req=jnp.asarray(pod_req),
+            pod_valid=jnp.ones((P,), bool),
+            pod_node=jnp.full((P,), -1, jnp.int32),
+            sched_mask=jnp.asarray(mask),
+        )
+        res = greedy_schedule(snap, jnp.asarray(slots), jnp.asarray(hints))
+        return pb.TryScheduleResponse(
+            placed=np.asarray(res.placed, np.uint8).tobytes(),
+            dest=np.asarray(res.dest, np.dtype("<i4")).tobytes(),
+        )
+
+    def FindNodesToRemove(
+        self, request: pb.FindNodesToRemoveRequest, context
+    ) -> pb.FindNodesToRemoveResponse:
+        import jax.numpy as jnp
+
+        from autoscaler_tpu.ops.scaledown import removal_feasibility
+        from autoscaler_tpu.snapshot.tensors import SnapshotTensors
+
+        P = request.pods.num_pods
+        R = request.pods.num_resources
+        N = request.num_nodes
+        S = request.slots_per_node
+        pod_req = _f32(request.pods.requests, P, R)
+        alloc = _f32(request.node_alloc, N, R)
+        used = _f32(request.node_used, N, R)
+        mask = _u8(request.sched_mask, P, N)
+        cands = _i32(request.candidate_nodes, -1)
+        slots = _i32(request.pod_slots, len(cands), S)
+        blocked = _u8(request.blocked, len(cands))
+        snap = SnapshotTensors(
+            node_alloc=jnp.asarray(alloc),
+            node_used=jnp.asarray(used),
+            node_valid=jnp.ones((N,), bool),
+            node_group=jnp.full((N,), -1, jnp.int32),
+            pod_req=jnp.asarray(pod_req),
+            pod_valid=jnp.ones((P,), bool),
+            pod_node=jnp.full((P,), -1, jnp.int32),
+            sched_mask=jnp.asarray(mask),
+        )
+        res = removal_feasibility(
+            snap, jnp.asarray(cands), jnp.asarray(slots), jnp.asarray(blocked)
+        )
+        return pb.FindNodesToRemoveResponse(
+            feasible=np.asarray(res.feasible, np.uint8).tobytes(),
+            destinations=np.asarray(res.destinations, np.dtype("<i4")).tobytes(),
+        )
+
+    def BestOptions(self, request: pb.BestOptionsRequest, context) -> pb.BestOptionsResponse:
+        """Least-waste-style reduction over the option list (the expander
+        gRPC seam; host embeddings can point the reference's own
+        --grpc-expander-url at this)."""
+        if not request.options:
+            return pb.BestOptionsResponse()
+        scored = sorted(
+            request.options,
+            key=lambda o: (o.score_hint if o.score_hint else -len(o.pod_keys)),
+        )
+        return pb.BestOptionsResponse(best=[scored[0]])
+
+
+_METHODS = {
+    "Estimate": (pb.EstimateRequest, pb.EstimateResponse),
+    "TrySchedule": (pb.TryScheduleRequest, pb.TryScheduleResponse),
+    "FindNodesToRemove": (pb.FindNodesToRemoveRequest, pb.FindNodesToRemoveResponse),
+    "BestOptions": (pb.BestOptionsRequest, pb.BestOptionsResponse),
+}
+
+
+def _generic_handler(servicer: TpuSimulationServicer) -> grpc.GenericRpcHandler:
+    handlers = {}
+    for name, (req_cls, _resp_cls) in _METHODS.items():
+        handlers[name] = grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=lambda msg: msg.SerializeToString(),
+        )
+    return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
+
+
+def serve(address: str = "127.0.0.1:0", max_workers: int = 4):
+    """→ (server, bound_port). The sidecar process entrypoint."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((_generic_handler(TpuSimulationServicer()),))
+    port = server.add_insecure_port(address)
+    server.start()
+    return server, port
+
+
+class TpuSimulationClient:
+    """Host-side stub."""
+
+    def __init__(self, target: str):
+        self._channel = grpc.insecure_channel(target)
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def _call(self, method: str, request):
+        req_cls, resp_cls = _METHODS[method]
+        rpc = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/{method}",
+            request_serializer=lambda msg: msg.SerializeToString(),
+            response_deserializer=resp_cls.FromString,
+        )
+        return rpc(request)
+
+    def estimate(
+        self,
+        pod_req: np.ndarray,
+        pod_masks: np.ndarray,
+        template_allocs: np.ndarray,
+        group_ids: Sequence[str],
+        node_caps: np.ndarray,
+        max_nodes: int,
+    ):
+        P, R = pod_req.shape
+        resp = self._call(
+            "Estimate",
+            pb.EstimateRequest(
+                pods=pb.PackedPods(
+                    requests=np.ascontiguousarray(pod_req, "<f4").tobytes(),
+                    num_pods=P,
+                    num_resources=R,
+                ),
+                pod_masks=np.ascontiguousarray(pod_masks, np.uint8).tobytes(),
+                template_allocs=np.ascontiguousarray(template_allocs, "<f4").tobytes(),
+                group_ids=list(group_ids),
+                node_caps=np.ascontiguousarray(node_caps, "<i4").tobytes(),
+                max_nodes=max_nodes,
+            ),
+        )
+        G = len(group_ids)
+        counts = np.frombuffer(resp.node_counts, "<i4")
+        scheduled = (
+            np.frombuffer(resp.scheduled, np.uint8).reshape(G, -1).astype(bool)
+        )
+        return counts, scheduled
+
+    def best_options(self, options: Sequence[pb.Option]) -> List[pb.Option]:
+        resp = self._call("BestOptions", pb.BestOptionsRequest(options=list(options)))
+        return list(resp.best)

@@ -1,0 +1,241 @@
+"""Processors, native bridge, and gRPC sidecar tests."""
+import numpy as np
+import pytest
+
+from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.estimator.reference_impl import ffd_binpack_reference
+from autoscaler_tpu.processors.nodegroupset import BalancingNodeGroupSetProcessor
+from autoscaler_tpu.processors.nodeinfos import MixedTemplateNodeInfoProvider
+from autoscaler_tpu.processors.pipeline import (
+    AutoscalingProcessors,
+    CustomResourcesProcessor,
+    EventingScaleUpStatusProcessor,
+    ScaleDownCandidatesSortingProcessor,
+    default_processors,
+)
+from autoscaler_tpu.utils.test_utils import GB, MB, build_test_node, build_test_pod
+
+
+class TestBalancingProcessor:
+    def _groups(self):
+        p = TestCloudProvider()
+        t1 = build_test_node("t1", cpu_m=4000, mem=8 * GB)
+        t2 = build_test_node("t2", cpu_m=4000, mem=8 * GB)
+        t3 = build_test_node("t3", cpu_m=16000, mem=64 * GB)
+        p.add_node_group("a", 0, 10, 2, t1)
+        p.add_node_group("b", 0, 10, 5, t2)
+        p.add_node_group("c", 0, 10, 0, t3)
+        gs = {g.id(): g for g in p.node_groups()}
+        templates = {"a": t1, "b": t2, "c": t3}
+        return p, gs, templates
+
+    def test_find_similar(self):
+        p, gs, templates = self._groups()
+        proc = BalancingNodeGroupSetProcessor()
+        similar = proc.find_similar_node_groups(gs["a"], templates, list(gs.values()))
+        assert [g.id() for g in similar] == ["b"]  # c differs in shape
+
+    def test_zone_labels_ignored(self):
+        proc = BalancingNodeGroupSetProcessor()
+        a = build_test_node("a", labels={"topology.kubernetes.io/zone": "us-a"})
+        b = build_test_node("b", labels={"topology.kubernetes.io/zone": "us-b"})
+        assert proc.is_similar(a, b)
+        c = build_test_node("c", labels={"disk": "ssd"})
+        assert not proc.is_similar(a, c)
+
+    def test_balance_evens_targets(self):
+        p, gs, templates = self._groups()
+        proc = BalancingNodeGroupSetProcessor()
+        # a=2, b=5; add 5 → a should catch up first
+        out = dict(
+            (g.id(), n) for g, n in proc.balance_scale_up([gs["a"], gs["b"]], 5)
+        )
+        assert out["a"] == 4 and out.get("b", 0) == 1  # a:2→6? no: evens to 6/6
+
+    def test_balance_respects_max(self):
+        p = TestCloudProvider()
+        p.add_node_group("a", 0, 3, 2, build_test_node("t"))
+        p.add_node_group("b", 0, 10, 2, build_test_node("t2"))
+        gs = {g.id(): g for g in p.node_groups()}
+        proc = BalancingNodeGroupSetProcessor()
+        out = dict((g.id(), n) for g, n in proc.balance_scale_up(list(gs.values()), 6))
+        assert out["a"] <= 1  # capped at max 3
+        assert sum(out.values()) <= 6
+
+
+class TestTemplateProvider:
+    def test_prefers_real_node_and_sanitizes(self):
+        from autoscaler_tpu.kube.api import to_be_deleted_taint
+
+        p = TestCloudProvider()
+        p.add_node_group("g", 0, 10, 1, build_test_node("synthetic", cpu_m=9999))
+        real = build_test_node("real-1", cpu_m=4000)
+        real.taints.append(to_be_deleted_taint())
+        prov = MixedTemplateNodeInfoProvider()
+        tmpl = prov.template_for(p.node_groups()[0], [real], now_ts=0.0)
+        assert tmpl.allocatable.cpu_m == 4000  # from the real node
+        assert tmpl.taints == []               # autoscaler taints stripped
+        assert tmpl.name != "real-1"
+
+    def test_falls_back_to_cloud_template(self):
+        p = TestCloudProvider()
+        p.add_node_group("g", 0, 10, 0, build_test_node("synthetic", cpu_m=1234))
+        prov = MixedTemplateNodeInfoProvider()
+        tmpl = prov.template_for(p.node_groups()[0], [], now_ts=0.0)
+        assert tmpl.allocatable.cpu_m == 1234
+
+    def test_ttl_cache(self):
+        p = TestCloudProvider()
+        p.add_node_group("g", 0, 10, 0, build_test_node("synthetic", cpu_m=1))
+        prov = MixedTemplateNodeInfoProvider(ttl_s=100)
+        t1 = prov.template_for(p.node_groups()[0], [], now_ts=0.0)
+        real = build_test_node("real", cpu_m=5000)
+        t2 = prov.template_for(p.node_groups()[0], [real], now_ts=50.0)
+        assert t2 is t1  # cached
+        t3 = prov.template_for(p.node_groups()[0], [real], now_ts=200.0)
+        assert t3.allocatable.cpu_m == 5000
+
+
+class TestOtherProcessors:
+    def test_custom_resources_readiness(self):
+        proc = CustomResourcesProcessor()
+        pending_gpu = build_test_node("gpu-init", labels={proc.gpu_label: "a100"})
+        ready_gpu = build_test_node("gpu-ok", gpu=8, labels={proc.gpu_label: "a100"})
+        plain = build_test_node("cpu")
+        ready, not_ready = proc.filter_out_nodes_with_unready_resources(
+            [pending_gpu, ready_gpu, plain]
+        )
+        assert [n.name for n in not_ready] == ["gpu-init"]
+        assert len(ready) == 2
+
+    def test_candidate_sorting(self):
+        proc = ScaleDownCandidatesSortingProcessor()
+        a, b, c = (build_test_node(x) for x in "abc")
+        proc.update(["c"])
+        assert [n.name for n in proc.sort([a, b, c])] == ["c", "a", "b"]
+
+    def test_eventing_status_processor(self):
+        events = []
+        proc = EventingScaleUpStatusProcessor(sink=lambda r, m: events.append((r, m)))
+        from autoscaler_tpu.core.scaleup.orchestrator import ScaleUpResult
+
+        proc.process(
+            ScaleUpResult(
+                scaled_up=True,
+                chosen_group="g",
+                new_nodes=2,
+                pods_triggered=[build_test_pod("p")],
+                pods_remain_unschedulable=[build_test_pod("q")],
+            )
+        )
+        reasons = [r for r, _ in events]
+        assert "TriggeredScaleUp" in reasons and "NotTriggerScaleUp" in reasons
+
+    def test_default_container(self):
+        procs = default_processors()
+        assert procs.node_group_set is not None
+        assert procs.template_node_info_provider is not None
+
+
+class TestNativeBridge:
+    def test_parity_and_availability(self):
+        from autoscaler_tpu.native_bridge import available, ffd_binpack_native
+
+        assert available()
+        rng = np.random.default_rng(0)
+        P = 500
+        req = np.zeros((P, 6), np.float32)
+        req[:, 0] = rng.integers(50, 1500, P)
+        req[:, 1] = rng.integers(64, 4096, P)
+        req[:, 5] = 1
+        alloc = np.array([4000, 8192, 0, 0, 0, 110], np.float32)
+        mask = rng.random(P) > 0.1
+        c1, s1 = ffd_binpack_native(req, mask, alloc, 64)
+        c2, s2 = ffd_binpack_reference(req, mask, alloc, 64)
+        assert c1 == c2
+        np.testing.assert_array_equal(s1, s2)
+
+    def test_first_fit_native(self):
+        from autoscaler_tpu.native_bridge import first_fit_native
+
+        req = np.array([[100, 0, 0, 0, 0, 1], [9999, 0, 0, 0, 0, 1]], np.float32)
+        free = np.array([[50, 0, 0, 0, 0, 10], [500, 0, 0, 0, 0, 10]], np.float32)
+        mask = np.ones((2, 2), bool)
+        out = first_fit_native(req, free, mask)
+        assert list(out) == [1, -1]
+
+
+class TestGrpcSidecar:
+    @pytest.fixture()
+    def server(self):
+        from autoscaler_tpu.rpc.service import serve
+
+        server, port = serve("127.0.0.1:0")
+        yield port
+        server.stop(grace=None)
+
+    def test_estimate_rpc(self, server):
+        from autoscaler_tpu.rpc.service import TpuSimulationClient
+
+        rng = np.random.default_rng(1)
+        P, G = 64, 3
+        req = np.zeros((P, 6), np.float32)
+        req[:, 0] = rng.integers(100, 1500, P)
+        req[:, 1] = rng.integers(64, 2048, P)
+        req[:, 5] = 1
+        masks = np.ones((G, P), bool)
+        allocs = np.tile(np.array([4000, 8192, 0, 0, 0, 110], np.float32), (G, 1))
+        caps = np.full(G, 32, np.int32)
+        client = TpuSimulationClient(f"127.0.0.1:{server}")
+        try:
+            counts, scheduled = client.estimate(
+                req, masks, allocs, ["a", "b", "c"], caps, max_nodes=32
+            )
+            ref_c, ref_s = ffd_binpack_reference(req, masks[0], allocs[0], 32)
+            assert counts[0] == ref_c
+            np.testing.assert_array_equal(scheduled[0], ref_s)
+        finally:
+            client.close()
+
+    def test_best_options_rpc(self, server):
+        from autoscaler_tpu.rpc import autoscaler_pb2 as pb
+        from autoscaler_tpu.rpc.service import TpuSimulationClient
+
+        client = TpuSimulationClient(f"127.0.0.1:{server}")
+        try:
+            best = client.best_options(
+                [
+                    pb.Option(group_id="few", node_count=1, pod_keys=["a"]),
+                    pb.Option(group_id="many", node_count=2, pod_keys=["a", "b", "c"]),
+                ]
+            )
+            assert [b.group_id for b in best] == ["many"]
+        finally:
+            client.close()
+
+    def test_grpc_expander_filter(self, server):
+        from autoscaler_tpu.expander.core import Option
+        from autoscaler_tpu.expander.grpc_ import GRPCFilter
+
+        p = TestCloudProvider()
+        p.add_node_group("few", 0, 10, 0, build_test_node("t1"))
+        p.add_node_group("many", 0, 10, 0, build_test_node("t2"))
+        gs = {g.id(): g for g in p.node_groups()}
+        options = [
+            Option(gs["few"], 1, [build_test_pod("a")]),
+            Option(gs["many"], 2, [build_test_pod(f"x{i}") for i in range(3)]),
+        ]
+        f = GRPCFilter(f"127.0.0.1:{server}")
+        best = f.best_options(options)
+        assert [o.node_group.id() for o in best] == ["many"]
+
+    def test_grpc_expander_fails_open(self):
+        from autoscaler_tpu.expander.core import Option
+        from autoscaler_tpu.expander.grpc_ import GRPCFilter
+
+        p = TestCloudProvider()
+        p.add_node_group("g", 0, 10, 0, build_test_node("t"))
+        options = [Option(p.node_groups()[0], 1, [build_test_pod("a")])]
+        f = GRPCFilter("127.0.0.1:1")  # nothing listening
+        assert f.best_options(options) == options
