@@ -1,0 +1,269 @@
+"""External gRPC cloud provider — run any provider out of process.
+
+Reference: cluster-autoscaler/cloudprovider/externalgrpc/ (4.8k LoC): a
+generic client-side CloudProvider speaking the
+protos/externalgrpc.proto:29 RPC surface, so operators implement their cloud
+integration in any language without forking the autoscaler. Here:
+
+- ExternalGrpcCloudProvider: the client side, plugging into the host control
+  plane behind the normal CloudProvider interface, with per-refresh caching
+  of the group list (the reference caches similarly to bound RPC chatter).
+- serve_cloud_provider(provider): wraps ANY in-process CloudProvider as the
+  server side — used for tests and as the adapter harness for real clouds.
+"""
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import grpc
+
+from autoscaler_tpu.cloudprovider.interface import (
+    CloudProvider,
+    Instance,
+    InstanceErrorClass,
+    InstanceErrorInfo,
+    InstanceState,
+    NodeGroup,
+    NodeGroupError,
+    ResourceLimiter,
+)
+from autoscaler_tpu.kube.objects import NUM_RESOURCES, Node, Resources, Taint
+from autoscaler_tpu.rpc import autoscaler_pb2 as pb
+
+PROVIDER_SERVICE = "autoscaler_tpu.CloudProviderService"
+
+_PROVIDER_METHODS = {
+    "NodeGroups": (pb.Empty, pb.NodeGroupsResponse),
+    "NodeGroupForNode": (pb.NodeGroupForNodeRequest, pb.NodeGroupForNodeResponse),
+    "IncreaseSize": (pb.IncreaseSizeRequest, pb.Empty),
+    "DeleteNodes": (pb.DeleteNodesRequest, pb.Empty),
+    "DecreaseTargetSize": (pb.DecreaseTargetSizeRequest, pb.Empty),
+    "TemplateNodeInfo": (pb.TemplateRequest, pb.TemplateResponse),
+    "Instances": (pb.InstancesRequest, pb.InstancesResponse),
+    "Refresh": (pb.Empty, pb.Empty),
+}
+
+
+# ---------------------------------------------------------------------------
+# server side: expose an in-process provider over the wire
+class _ProviderServicer:
+    def __init__(self, provider: CloudProvider):
+        self.provider = provider
+
+    def _group(self, gid: str) -> NodeGroup:
+        for g in self.provider.node_groups():
+            if g.id() == gid:
+                return g
+        raise NodeGroupError(f"unknown group {gid}")
+
+    def NodeGroups(self, request, context):
+        return pb.NodeGroupsResponse(
+            groups=[
+                pb.NodeGroupSpec(
+                    id=g.id(),
+                    min_size=g.min_size(),
+                    max_size=g.max_size(),
+                    target_size=g.target_size(),
+                )
+                for g in self.provider.node_groups()
+            ]
+        )
+
+    def NodeGroupForNode(self, request, context):
+        node = Node(name=request.node_name, provider_id=request.provider_id)
+        group = self.provider.node_group_for_node(node)
+        return pb.NodeGroupForNodeResponse(group_id=group.id() if group else "")
+
+    def IncreaseSize(self, request, context):
+        self._group(request.group_id).increase_size(request.delta)
+        return pb.Empty()
+
+    def DeleteNodes(self, request, context):
+        nodes = [Node(name=n, provider_id=n) for n in request.node_names]
+        self._group(request.group_id).delete_nodes(nodes)
+        return pb.Empty()
+
+    def DecreaseTargetSize(self, request, context):
+        self._group(request.group_id).decrease_target_size(request.delta)
+        return pb.Empty()
+
+    def TemplateNodeInfo(self, request, context):
+        tmpl = self._group(request.group_id).template_node_info()
+        alloc = np.array(tmpl.allocatable.as_tuple(), "<f4")
+        return pb.TemplateResponse(
+            allocatable=alloc.tobytes(),
+            labels=dict(tmpl.labels),
+            taints=[
+                pb.TaintMsg(key=t.key, value=t.value, effect=t.effect)
+                for t in tmpl.taints
+            ],
+        )
+
+    def Instances(self, request, context):
+        out = []
+        for inst in self._group(request.group_id).nodes():
+            out.append(
+                pb.InstanceMsg(
+                    id=inst.id,
+                    state=inst.state.value,
+                    error_class=(
+                        inst.error_info.error_class.value if inst.error_info else ""
+                    ),
+                    error_message=(
+                        inst.error_info.error_message if inst.error_info else ""
+                    ),
+                )
+            )
+        return pb.InstancesResponse(instances=out)
+
+    def Refresh(self, request, context):
+        self.provider.refresh()
+        return pb.Empty()
+
+
+def serve_cloud_provider(provider: CloudProvider, address: str = "127.0.0.1:0"):
+    """→ (server, port)."""
+    servicer = _ProviderServicer(provider)
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req.FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        )
+        for name, (req, _resp) in _PROVIDER_METHODS.items()
+    }
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(PROVIDER_SERVICE, handlers),)
+    )
+    port = server.add_insecure_port(address)
+    server.start()
+    return server, port
+
+
+# ---------------------------------------------------------------------------
+# client side: the provider the host control plane uses
+class _RemoteNodeGroup(NodeGroup):
+    def __init__(self, provider: "ExternalGrpcCloudProvider", spec: pb.NodeGroupSpec):
+        self._provider = provider
+        self._spec = spec
+
+    def id(self) -> str:
+        return self._spec.id
+
+    def min_size(self) -> int:
+        return self._spec.min_size
+
+    def max_size(self) -> int:
+        return self._spec.max_size
+
+    def target_size(self) -> int:
+        return self._spec.target_size
+
+    def increase_size(self, delta: int) -> None:
+        self._provider._call(
+            "IncreaseSize", pb.IncreaseSizeRequest(group_id=self._spec.id, delta=delta)
+        )
+        self._spec.target_size += delta
+
+    def delete_nodes(self, nodes: Sequence[Node]) -> None:
+        self._provider._call(
+            "DeleteNodes",
+            pb.DeleteNodesRequest(
+                group_id=self._spec.id, node_names=[n.name for n in nodes]
+            ),
+        )
+        self._spec.target_size -= len(nodes)
+
+    def decrease_target_size(self, delta: int) -> None:
+        self._provider._call(
+            "DecreaseTargetSize",
+            pb.DecreaseTargetSizeRequest(group_id=self._spec.id, delta=delta),
+        )
+        self._spec.target_size -= delta
+
+    def nodes(self) -> List[Instance]:
+        resp = self._provider._call(
+            "Instances", pb.InstancesRequest(group_id=self._spec.id)
+        )
+        out = []
+        for m in resp.instances:
+            error = None
+            if m.error_class:
+                error = InstanceErrorInfo(
+                    InstanceErrorClass(m.error_class), error_message=m.error_message
+                )
+            out.append(
+                Instance(id=m.id, state=InstanceState(m.state), error_info=error)
+            )
+        return out
+
+    def template_node_info(self) -> Node:
+        resp = self._provider._call(
+            "TemplateNodeInfo", pb.TemplateRequest(group_id=self._spec.id)
+        )
+        alloc = np.frombuffer(resp.allocatable, "<f4")
+        return Node(
+            name=f"template-{self._spec.id}",
+            allocatable=Resources.from_tuple(alloc[:NUM_RESOURCES]),
+            labels=dict(resp.labels),
+            taints=[Taint(t.key, t.value, t.effect) for t in resp.taints],
+        )
+
+
+class ExternalGrpcCloudProvider(CloudProvider):
+    def __init__(self, target: str, resource_limiter: Optional[ResourceLimiter] = None):
+        self._channel = grpc.insecure_channel(target)
+        self._limiter = resource_limiter or ResourceLimiter()
+        self._groups: List[_RemoteNodeGroup] = []
+        self._node_group_cache: Dict[str, str] = {}
+
+    def _call(self, method: str, request):
+        req_cls, resp_cls = _PROVIDER_METHODS[method]
+        rpc = self._channel.unary_unary(
+            f"/{PROVIDER_SERVICE}/{method}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString,
+        )
+        return rpc(request)
+
+    def name(self) -> str:
+        return "externalgrpc"
+
+    def refresh(self) -> None:
+        self._call("Refresh", pb.Empty())
+        resp = self._call("NodeGroups", pb.Empty())
+        self._groups = [_RemoteNodeGroup(self, spec) for spec in resp.groups]
+        self._node_group_cache.clear()
+
+    def node_groups(self) -> List[NodeGroup]:
+        if not self._groups:
+            self.refresh()
+        return list(self._groups)
+
+    def node_group_for_node(self, node: Node) -> Optional[NodeGroup]:
+        gid = self._node_group_cache.get(node.name)
+        if gid is None:
+            resp = self._call(
+                "NodeGroupForNode",
+                pb.NodeGroupForNodeRequest(
+                    node_name=node.name, provider_id=node.provider_id
+                ),
+            )
+            gid = resp.group_id
+            self._node_group_cache[node.name] = gid
+        if not gid:
+            return None
+        for g in self.node_groups():
+            if g.id() == gid:
+                return g
+        return None
+
+    def get_resource_limiter(self) -> ResourceLimiter:
+        return self._limiter
+
+    def cleanup(self) -> None:
+        self._channel.close()

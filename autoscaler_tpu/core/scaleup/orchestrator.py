@@ -50,6 +50,7 @@ class ScaleUpOrchestrator:
         estimator: Optional[BinpackingNodeEstimator] = None,
         expander: Optional[Strategy] = None,
         balancing_processor=None,
+        template_provider=None,
     ):
         from autoscaler_tpu.expander.core import build_strategy
 
@@ -60,6 +61,9 @@ class ScaleUpOrchestrator:
         self.expander = expander or build_strategy([options.expander])
         self.resource_manager = ScaleUpResourceManager(provider.get_resource_limiter())
         self.balancing_processor = balancing_processor
+        # TemplateNodeInfoProvider (processors/nodeinfos.py): prefer a
+        # sanitized real node over the cloud's synthetic template
+        self.template_provider = template_provider
 
     # -- main entry (reference orchestrator.go:81) ---------------------------
     def scale_up(
@@ -74,6 +78,13 @@ class ScaleUpOrchestrator:
         # Equivalence groups shrink reporting/mask work (orchestrator.go:103).
         pod_groups = build_pod_groups(pending_pods)
 
+        nodes_by_group: Dict[str, List[Node]] = {}
+        if self.template_provider is not None:
+            for node in cluster_nodes:
+                g = self.provider.node_group_for_node(node)
+                if g is not None:
+                    nodes_by_group.setdefault(g.id(), []).append(node)
+
         viable: Dict[str, NodeGroup] = {}
         templates: Dict[str, Node] = {}
         headrooms: Dict[str, int] = {}
@@ -87,10 +98,19 @@ class ScaleUpOrchestrator:
             if headroom <= 0:
                 skipped[gid] = "max size reached"
                 continue
-            try:
-                template = group.template_node_info()
-            except Exception as e:  # no template → skip (orchestrator.go:157)
-                skipped[gid] = f"no template: {e}"
+            template: Optional[Node] = None
+            if self.template_provider is not None:
+                template = self.template_provider.template_for(
+                    group, nodes_by_group.get(gid, []), now_ts
+                )
+            else:
+                try:
+                    template = group.template_node_info()
+                except Exception as e:  # no template → skip (orchestrator.go:157)
+                    skipped[gid] = f"no template: {e}"
+                    continue
+            if template is None:
+                skipped[gid] = "no template"
                 continue
             viable[gid] = group
             templates[gid] = template

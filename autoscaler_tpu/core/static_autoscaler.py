@@ -59,13 +59,21 @@ class StaticAutoscaler:
         metrics: Optional[metrics_mod.AutoscalerMetrics] = None,
         health_check: Optional[HealthCheck] = None,
         debugger=None,
+        processors=None,
     ):
+        from autoscaler_tpu.processors.pipeline import default_processors
+
         self.provider = provider
         self.api = api
         self.options = options or AutoscalingOptions()
+        self.processors = processors or default_processors()
         self.csr = csr or ClusterStateRegistry(provider, self.options)
         self.scale_up_orchestrator = scale_up_orchestrator or ScaleUpOrchestrator(
-            provider, self.options, self.csr
+            provider,
+            self.options,
+            self.csr,
+            balancing_processor=self.processors.node_group_set,
+            template_provider=self.processors.template_node_info_provider,
         )
         self.scale_down_planner = scale_down_planner or ScaleDownPlanner(
             provider, self.options
@@ -76,7 +84,9 @@ class StaticAutoscaler:
             api,
             self.scale_down_planner.deletion_tracker,
         )
-        self.pod_list_processor = pod_list_processor or FilterOutSchedulablePodListProcessor()
+        self.pod_list_processor = (
+            pod_list_processor or self.processors.pod_list_processor
+        )
         self.metrics = metrics or metrics_mod.AutoscalerMetrics()
         self.health_check = health_check or HealthCheck(
             self.options.max_inactivity_s, self.options.max_failing_time_s
@@ -140,6 +150,18 @@ class StaticAutoscaler:
         all_pods = self.api.list_pods()
         pdbs = self.api.list_pdbs()
 
+        # accelerator nodes still attaching devices count as unready
+        # (processors/customresources, reference gpu_processor.go)
+        _, accel_not_ready = self.processors.custom_resources.filter_out_nodes_with_unready_resources(
+            all_nodes
+        )
+        if accel_not_ready:
+            initializing = {n.name for n in accel_not_ready}
+            all_nodes = [
+                dataclasses.replace(n, ready=False) if n.name in initializing else n
+                for n in all_nodes
+            ]
+
         # 2. cluster state accounting (:376)
         self.csr.update_nodes(all_nodes, now_ts)
         result.cluster_healthy = self.csr.is_cluster_healthy()
@@ -164,6 +186,11 @@ class StaticAutoscaler:
                 snapshot.add_pod(pod, pod.node_name)
         for pod in pending:
             snapshot.add_pod(pod)
+
+        # legacy TPU-request sanitizer (:459-466, utils/tpu/tpu.go:57)
+        from autoscaler_tpu.utils.tpu import clear_tpu_requests
+
+        pending = clear_tpu_requests(pending)
 
         # expendable filter (:471) + young-pod filter (:832)
         pending = [
@@ -199,6 +226,7 @@ class StaticAutoscaler:
             up = self.scale_up_orchestrator.scale_up(pending, all_nodes, now_ts)
             self.metrics.observe_duration(metrics_mod.SCALE_UP, t_up)
             result.scale_up = up
+            self.processors.scale_up_status.process(up)
             if up.scaled_up:
                 self.last_scale_up_ts = now_ts
         min_size_ups = self.scale_up_orchestrator.scale_up_to_node_group_min_size(now_ts)
@@ -208,12 +236,17 @@ class StaticAutoscaler:
         # 7. scale-down branch (:582-691)
         if self.options.scale_down_enabled:
             t_unneeded = _time.monotonic()
-            candidates = self._scale_down_candidates(all_nodes, upcoming_names)
+            candidates = self.processors.scale_down_candidates_sorting.sort(
+                self._scale_down_candidates(all_nodes, upcoming_names)
+            )
             self.scale_down_planner.update_cluster_state(
                 snapshot, candidates, pdbs, now_ts
             )
             self.metrics.observe_duration(metrics_mod.FIND_UNNEEDED, t_unneeded)
             result.unneeded_nodes = len(self.scale_down_planner.unneeded_names())
+            self.processors.scale_down_candidates_sorting.update(
+                self.scale_down_planner.unneeded_names()
+            )
             in_cooldown = self._scale_down_in_cooldown(now_ts)
             result.scale_down_in_cooldown = in_cooldown
             if not in_cooldown:

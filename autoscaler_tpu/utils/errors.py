@@ -1,0 +1,38 @@
+"""Typed autoscaler errors.
+
+Reference: cluster-autoscaler/utils/errors/ (AutoscalerError with error
+types: ApiCallError, InternalError, TransientError, ConfigurationError,
+NodeGroupDoesNotExistError) — the type drives retry/backoff decisions and
+metrics labels.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+
+class ErrorType(enum.Enum):
+    API_CALL = "apiCallError"
+    INTERNAL = "internalError"
+    TRANSIENT = "transientError"
+    CONFIGURATION = "configurationError"
+    NODE_GROUP_DOES_NOT_EXIST = "nodeGroupDoesNotExistError"
+
+
+class AutoscalerError(Exception):
+    def __init__(self, error_type: ErrorType, message: str):
+        super().__init__(message)
+        self.error_type = error_type
+
+    @property
+    def retriable(self) -> bool:
+        return self.error_type in (ErrorType.TRANSIENT, ErrorType.API_CALL)
+
+    def prefixed(self, prefix: str) -> "AutoscalerError":
+        return AutoscalerError(self.error_type, f"{prefix}{self}")
+
+
+def to_autoscaler_error(err: Exception) -> AutoscalerError:
+    if isinstance(err, AutoscalerError):
+        return err
+    return AutoscalerError(ErrorType.INTERNAL, str(err))

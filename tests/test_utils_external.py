@@ -1,0 +1,144 @@
+"""Utils (tpu sanitizer, errors, cache, klogx, leader election) and the
+external gRPC cloud provider — including the full autoscaler loop running
+against an out-of-process provider."""
+import threading
+
+import numpy as np
+import pytest
+
+from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.kube.objects import Resources
+from autoscaler_tpu.utils.cache import ExpiringCache, QuotaLogger
+from autoscaler_tpu.utils.errors import AutoscalerError, ErrorType, to_autoscaler_error
+from autoscaler_tpu.utils.leaderelection import FileLease, LeaderElector
+from autoscaler_tpu.utils.test_utils import GB, build_test_node, build_test_pod
+from autoscaler_tpu.utils.tpu import LEGACY_TPU_PREFIX, clear_tpu_requests
+
+
+class TestTpuSanitizer:
+    def test_legacy_requests_stripped(self):
+        pod = build_test_pod("p")
+        pod.annotations[LEGACY_TPU_PREFIX + "v5e"] = "8"
+        pod.requests = Resources(cpu_m=100, tpu=8)
+        out = clear_tpu_requests([pod])
+        assert out[0].requests.tpu == 0
+        assert not any(k.startswith(LEGACY_TPU_PREFIX) for k in out[0].annotations)
+
+    def test_native_requests_kept(self):
+        pod = build_test_pod("p")
+        pod.requests = Resources(cpu_m=100, tpu=4)
+        out = clear_tpu_requests([pod])
+        assert out[0] is pod  # identity: untouched
+        assert out[0].requests.tpu == 4
+
+
+class TestErrors:
+    def test_types_and_retriability(self):
+        e = AutoscalerError(ErrorType.TRANSIENT, "cloud hiccup")
+        assert e.retriable
+        assert not AutoscalerError(ErrorType.CONFIGURATION, "bad flag").retriable
+        wrapped = to_autoscaler_error(ValueError("boom"))
+        assert wrapped.error_type == ErrorType.INTERNAL
+        assert "prefix: " in str(wrapped.prefixed("prefix: "))
+
+
+class TestCaches:
+    def test_expiring_cache(self):
+        clock = [0.0]
+        c = ExpiringCache(ttl_s=10, clock=lambda: clock[0])
+        c.put("k", 42)
+        assert c.get("k") == 42
+        clock[0] = 11.0
+        assert c.get("k") is None
+
+    def test_quota_logger(self):
+        q = QuotaLogger(quota=2)
+        for i in range(5):
+            q.log("msg %d", i)
+        assert q.dropped == 3
+        q.reset()
+        assert q.dropped == 0
+
+
+class TestLeaderElection:
+    def test_single_holder(self, tmp_path):
+        lease = FileLease(str(tmp_path / "lease"), ttl_s=100)
+        assert lease.try_acquire("a", now_ts=0.0)
+        assert not lease.try_acquire("b", now_ts=10.0)   # a holds
+        assert lease.try_acquire("a", now_ts=10.0)       # renew
+        assert lease.try_acquire("b", now_ts=200.0)      # expired → steal
+
+    def test_release(self, tmp_path):
+        lease = FileLease(str(tmp_path / "lease"), ttl_s=100)
+        lease.try_acquire("a", 0.0)
+        lease.release("a")
+        assert lease.try_acquire("b", 1.0)
+
+    def test_elector_runs_leader(self, tmp_path):
+        lease = FileLease(str(tmp_path / "lease"), ttl_s=100)
+        ran = []
+        elector = LeaderElector(lease, identity="me", sleep=lambda s: None)
+        elector.run(lambda still: ran.append(still()))
+        assert ran == [True]
+        # lease released on exit
+        assert lease.try_acquire("other", 0.0)
+
+
+@pytest.fixture()
+def remote_provider():
+    from autoscaler_tpu.cloudprovider.external_grpc import (
+        ExternalGrpcCloudProvider,
+        serve_cloud_provider,
+    )
+
+    backend = TestCloudProvider()
+    backend.add_node_group(
+        "pool", 0, 10, 1, build_test_node("tmpl", cpu_m=2000, mem=4 * GB)
+    )
+    node = build_test_node("pool-0", cpu_m=2000, mem=4 * GB)
+    backend.add_node("pool", node)
+    server, port = serve_cloud_provider(backend)
+    client = ExternalGrpcCloudProvider(f"127.0.0.1:{port}")
+    yield backend, client, node
+    client.cleanup()
+    server.stop(grace=None)
+
+
+class TestExternalGrpcProvider:
+    def test_node_groups_roundtrip(self, remote_provider):
+        backend, client, node = remote_provider
+        client.refresh()
+        groups = client.node_groups()
+        assert [g.id() for g in groups] == ["pool"]
+        g = groups[0]
+        assert (g.min_size(), g.max_size(), g.target_size()) == (0, 10, 1)
+        tmpl = g.template_node_info()
+        assert tmpl.allocatable.cpu_m == 2000
+
+    def test_node_group_for_node(self, remote_provider):
+        backend, client, node = remote_provider
+        assert client.node_group_for_node(node).id() == "pool"
+        ghost = build_test_node("ghost")
+        assert client.node_group_for_node(ghost) is None
+
+    def test_scale_up_via_rpc(self, remote_provider):
+        backend, client, node = remote_provider
+        g = client.node_groups()[0]
+        g.increase_size(3)
+        assert backend.scale_up_calls == [("pool", 3)]
+        assert g.target_size() == 4
+
+    def test_full_loop_against_remote_provider(self, remote_provider):
+        from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+        from autoscaler_tpu.kube.api import FakeClusterAPI
+
+        backend, client, node = remote_provider
+        api = FakeClusterAPI()
+        api.add_node(node)
+        api.add_pod(build_test_pod("blocker", cpu_m=1800, node_name="pool-0"))
+        api.add_pod(build_test_pod("pending", cpu_m=1500, mem=1 * GB))
+        autoscaler = StaticAutoscaler(client, api, AutoscalingOptions())
+        result = autoscaler.run_once(now_ts=0.0)
+        assert result.scale_up is not None and result.scale_up.scaled_up
+        assert backend.scale_up_calls  # the RPC crossed the boundary
