@@ -1,0 +1,24 @@
+# Build/deploy image for the tpu-autoscaler process.
+# Equivalent of the reference's builder/Dockerfile (Go build image) +
+# charts/cluster-autoscaler packaging: one image runs the control plane; the
+# same image with TPU-enabled jax runs the device sidecar.
+FROM python:3.12-slim AS base
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ protobuf-compiler && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY pyproject.toml README.md ./
+COPY autoscaler_tpu ./autoscaler_tpu
+COPY native ./native
+
+# host control plane needs cpu jax; the sidecar image layers libtpu on top
+RUN pip install --no-cache-dir .[rpc] && \
+    python -c "import autoscaler_tpu"
+
+# prebuild the native baseline/fallback library
+RUN g++ -O3 -shared -fPIC -std=c++17 native/ffd_serial.cpp -o native/libffd_serial.so
+
+EXPOSE 8085
+ENTRYPOINT ["tpu-autoscaler"]
+CMD ["--address=:8085"]

@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# CI gate — the analog of the reference's hack/verify-all.sh +
+# hack/for-go-proj.sh test pipeline: static checks, unit tests, compile
+# checks of the driver entry points.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== python syntax/compile check =="
+python -m compileall -q autoscaler_tpu bench.py __graft_entry__.py
+
+echo "== proto freshness check =="
+tmp=$(mktemp -d)
+protoc --python_out="$tmp" --proto_path=autoscaler_tpu/rpc/protos \
+    autoscaler_tpu/rpc/protos/autoscaler.proto
+if ! diff -q "$tmp/autoscaler_pb2.py" autoscaler_tpu/rpc/autoscaler_pb2.py >/dev/null; then
+    echo "ERROR: autoscaler_pb2.py is stale — re-run protoc" >&2
+    exit 1
+fi
+rm -rf "$tmp"
+
+echo "== native build check =="
+python -c "
+from autoscaler_tpu.native_bridge import available, build_error
+assert available(), f'native build failed: {build_error()}'
+print('native ok')
+"
+
+echo "== unit tests (8-device virtual CPU mesh) =="
+python -m pytest tests/ -q -x
+
+echo "== graft entry compile check =="
+XLA_FLAGS=--xla_force_host_platform_device_count=8 python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import __graft_entry__ as ge
+fn, args = ge.entry()
+jax.block_until_ready(jax.jit(fn)(*args))
+ge.dryrun_multichip(8)
+print("graft entry ok")
+EOF
+
+echo "ALL CHECKS PASSED"
