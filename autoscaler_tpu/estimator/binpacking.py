@@ -20,7 +20,13 @@ import numpy as np
 
 from autoscaler_tpu.estimator.limiter import ThresholdBasedEstimationLimiter
 from autoscaler_tpu.kube.objects import Node, Pod
-from autoscaler_tpu.ops.binpack import BinpackResult, ffd_binpack, ffd_binpack_groups
+from autoscaler_tpu.ops.binpack import (
+    BinpackResult,
+    ffd_binpack,
+    ffd_binpack_groups,
+    ffd_binpack_groups_affinity,
+)
+from autoscaler_tpu.snapshot.affinity import build_affinity_terms, has_interpod_affinity
 from autoscaler_tpu.snapshot.packer import compute_sched_mask, resources_row
 from autoscaler_tpu.snapshot.tensors import bucket_size
 
@@ -32,14 +38,19 @@ def _pack_pods(pods: Sequence[Pod], padded: int) -> np.ndarray:
     return req
 
 
-def template_mask(pods: Sequence[Pod], template: Node, padded: int) -> np.ndarray:
+def template_mask(
+    pods: Sequence[Pod], template: Node, padded: int, interpod: bool = True
+) -> np.ndarray:
     """[padded] bool — which pods pass the template node's non-resource
     predicates (taints/tolerations, selectors, node affinity, self-affinity
     rule). Mirrors the CheckPredicates-per-equivalence-group step of
-    ComputeExpansionOption (orchestrator.go:470)."""
+    ComputeExpansionOption (orchestrator.go:470). interpod=False leaves
+    inter-pod affinity to the dynamic scan kernel."""
     mask = np.zeros((padded,), bool)
     if pods:
-        m = compute_sched_mask([template], list(pods), [-1] * len(pods))
+        m = compute_sched_mask(
+            [template], list(pods), [-1] * len(pods), interpod=interpod
+        )
         mask[: len(pods)] = m[:, 0]
     return mask
 
@@ -61,19 +72,38 @@ class BinpackingNodeEstimator:
             return 0, []
         P = bucket_size(len(pods))
         req = _pack_pods(pods, P)
-        mask = template_mask(pods, template, P)
+        dynamic_affinity = has_interpod_affinity(pods)
+        mask = template_mask(pods, template, P, interpod=not dynamic_affinity)
         alloc = resources_row(template.allocatable, template.allocatable.pods)
         cap = self.limiter.node_cap(max_size_headroom)
-        res = ffd_binpack(
-            jnp.asarray(req),
-            jnp.asarray(mask),
-            jnp.asarray(alloc),
-            max_nodes=bucket_size(cap, minimum=8),
-            node_cap=jnp.int32(cap),
-        )
-        scheduled_mask = np.asarray(res.scheduled)
+        if dynamic_affinity:
+            terms = build_affinity_terms(pods, [template], pad_pods=P, bucket_terms=True)
+            res = ffd_binpack_groups_affinity(
+                jnp.asarray(req),
+                jnp.asarray(mask[None, :]),
+                jnp.asarray(alloc[None, :]),
+                max_nodes=bucket_size(cap, minimum=8),
+                match=jnp.asarray(terms.match),
+                aff_of=jnp.asarray(terms.aff_of),
+                anti_of=jnp.asarray(terms.anti_of),
+                node_level=jnp.asarray(terms.node_level),
+                has_label=jnp.asarray(terms.has_label),
+                node_caps=jnp.asarray(np.array([cap], np.int32)),
+            )
+            scheduled_mask = np.asarray(res.scheduled)[0]
+            count = int(np.asarray(res.node_count)[0])
+        else:
+            r = ffd_binpack(
+                jnp.asarray(req),
+                jnp.asarray(mask),
+                jnp.asarray(alloc),
+                max_nodes=bucket_size(cap, minimum=8),
+                node_cap=jnp.int32(cap),
+            )
+            scheduled_mask = np.asarray(r.scheduled)
+            count = int(r.node_count)
         scheduled = [p for i, p in enumerate(pods) if scheduled_mask[i]]
-        return int(res.node_count), scheduled
+        return count, scheduled
 
     def estimate_many(
         self,
@@ -92,7 +122,13 @@ class BinpackingNodeEstimator:
         names = sorted(templates)
         P = bucket_size(len(pods))
         req = _pack_pods(pods, P)
-        masks = np.stack([template_mask(pods, templates[g], P) for g in names])
+        dynamic_affinity = has_interpod_affinity(pods)
+        masks = np.stack(
+            [
+                template_mask(pods, templates[g], P, interpod=not dynamic_affinity)
+                for g in names
+            ]
+        )
         allocs = np.stack(
             [
                 resources_row(templates[g].allocatable, templates[g].allocatable.pods)
@@ -104,13 +140,30 @@ class BinpackingNodeEstimator:
             [self.limiter.node_cap(headrooms.get(g, 0)) for g in names], np.int32
         )
         scan_cap = bucket_size(int(caps.max()), minimum=8)
-        res: BinpackResult = ffd_binpack_groups(
-            jnp.asarray(req),
-            jnp.asarray(masks),
-            jnp.asarray(allocs),
-            max_nodes=scan_cap,
-            node_caps=jnp.asarray(caps),
-        )
+        if dynamic_affinity:
+            terms = build_affinity_terms(
+                pods, [templates[g] for g in names], pad_pods=P, bucket_terms=True
+            )
+            res: BinpackResult = ffd_binpack_groups_affinity(
+                jnp.asarray(req),
+                jnp.asarray(masks),
+                jnp.asarray(allocs),
+                max_nodes=scan_cap,
+                match=jnp.asarray(terms.match),
+                aff_of=jnp.asarray(terms.aff_of),
+                anti_of=jnp.asarray(terms.anti_of),
+                node_level=jnp.asarray(terms.node_level),
+                has_label=jnp.asarray(terms.has_label),
+                node_caps=jnp.asarray(caps),
+            )
+        else:
+            res = ffd_binpack_groups(
+                jnp.asarray(req),
+                jnp.asarray(masks),
+                jnp.asarray(allocs),
+                max_nodes=scan_cap,
+                node_caps=jnp.asarray(caps),
+            )
         counts = np.asarray(res.node_count)
         scheds = np.asarray(res.scheduled)
         out: Dict[str, Tuple[int, List[Pod]]] = {}

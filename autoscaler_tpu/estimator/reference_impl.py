@@ -56,6 +56,103 @@ def ffd_binpack_reference(
     return len(used), scheduled
 
 
+def ffd_binpack_reference_affinity(
+    pod_req: np.ndarray,         # [P, R]
+    pod_mask: np.ndarray,        # [P] bool
+    template_alloc: np.ndarray,  # [R]
+    max_nodes: int,
+    match: np.ndarray,           # [T, P] bool
+    aff_of: np.ndarray,          # [T, P] bool
+    anti_of: np.ndarray,         # [T, P] bool
+    node_level: np.ndarray,      # [T] bool
+    has_label: np.ndarray,       # [T] bool (this group's template)
+) -> Tuple[int, np.ndarray]:
+    """Serial FFD with dynamic inter-pod (anti-)affinity — the oracle for
+    ops/binpack.ffd_binpack_groups_affinity. Mirrors the reference's
+    re-run-the-filter-after-every-placement behavior
+    (binpacking_estimator.go:119-141) over the term factorization."""
+    P = pod_req.shape[0]
+    T = match.shape[0]
+    cpu_cap = template_alloc[CPU]
+    mem_cap = template_alloc[MEMORY]
+    score = np.zeros(P, np.float32)
+    if cpu_cap > 0:
+        score += pod_req[:, CPU] / cpu_cap
+    if mem_cap > 0:
+        score += pod_req[:, MEMORY] / mem_cap
+    order = np.argsort(-score, kind="stable")
+
+    used: list = []
+    pm = []        # per-open-node matching count per term [T]
+    ha = []        # per-open-node anti-holder count per term [T]
+    pm_tot = np.zeros(T, np.int64)
+    ha_tot = np.zeros(T, np.int64)
+    scheduled = np.zeros(P, bool)
+
+    def node_allowed(i: int, m: int) -> bool:
+        for t in range(T):
+            dom_pm = pm[m][t] if node_level[t] else pm_tot[t]
+            dom_ha = ha[m][t] if node_level[t] else ha_tot[t]
+            if aff_of[t, i]:
+                seed = match[t, i] and pm_tot[t] == 0
+                if not (has_label[t] and (dom_pm > 0 or seed)):
+                    return False
+            # no topology label → no domain → an anti term cannot be violated
+            if has_label[t] and anti_of[t, i] and dom_pm > 0:
+                return False
+            if has_label[t] and match[t, i] and dom_ha > 0:
+                return False
+        return True
+
+    def new_node_allowed(i: int) -> bool:
+        for t in range(T):
+            if aff_of[t, i]:
+                seed = match[t, i] and pm_tot[t] == 0
+                if node_level[t]:
+                    if not seed:
+                        return False
+                elif not (has_label[t] and (pm_tot[t] > 0 or seed)):
+                    return False
+            if not node_level[t] and has_label[t]:
+                if anti_of[t, i] and pm_tot[t] > 0:
+                    return False
+                if match[t, i] and ha_tot[t] > 0:
+                    return False
+        return True
+
+    def commit(i: int, m: int) -> None:
+        nonlocal pm_tot, ha_tot
+        used[m] += pod_req[i]
+        pm[m] += match[:, i]
+        ha[m] += anti_of[:, i]
+        pm_tot += match[:, i]
+        ha_tot += anti_of[:, i]
+
+    for i in order:
+        if not pod_mask[i]:
+            continue
+        req = pod_req[i]
+        placed = False
+        for m, u in enumerate(used):
+            if np.all(req <= template_alloc - u) and node_allowed(i, m):
+                commit(i, m)
+                placed = True
+                break
+        if (
+            not placed
+            and len(used) < max_nodes
+            and np.all(req <= template_alloc)
+            and new_node_allowed(i)
+        ):
+            used.append(np.zeros_like(req, np.float64))
+            pm.append(np.zeros(T, np.int64))
+            ha.append(np.zeros(T, np.int64))
+            commit(i, len(used) - 1)
+            placed = True
+        scheduled[i] = placed
+    return len(used), scheduled
+
+
 def ffd_binpack_reference_groups(
     pod_req: np.ndarray,          # [P, R]
     pod_masks: np.ndarray,        # [G, P]

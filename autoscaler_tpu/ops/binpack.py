@@ -168,3 +168,138 @@ def ffd_binpack_groups(
         scheduled=scheduled,
         node_used=jnp.swapaxes(used_t, 1, 2),                         # [G, M, R]
     )
+
+
+@functools.partial(jax.jit, static_argnames=("max_nodes",))
+def ffd_binpack_groups_affinity(
+    pod_req: jax.Array,         # [P, R] shared pending-pod matrix
+    pod_masks: jax.Array,       # [G, P] per-group schedulability (static mask)
+    template_allocs: jax.Array,  # [G, R]
+    max_nodes: int,
+    match: jax.Array,           # [T, P] bool — term selector matches pod
+    aff_of: jax.Array,          # [T, P] bool — pod requires affinity term
+    anti_of: jax.Array,         # [T, P] bool — pod requires anti term
+    node_level: jax.Array,      # [T] bool — hostname-level topology
+    has_label: jax.Array,       # [G, T] bool — group template has topology label
+    node_caps: jax.Array | None = None,  # [G] i32
+) -> BinpackResult:
+    """FFD scan with *dynamic* inter-pod (anti-)affinity: pods placed during
+    the scan constrain later pods, as the reference's per-placement filter
+    re-run does (binpacking_estimator.go:119-141 → InterPodAffinity plugin).
+
+    The carry adds per-term placement counts — `pm[G,T,M]` (pods matching
+    term t on new node m) and `ha[G,T,M]` (pods *holding* anti-term t on m,
+    for the symmetric anti-affinity rule) plus group totals — and each step
+    gates candidate nodes on them. A hostname-level term's domain is the
+    single node; any other key's domain is the whole group (all new nodes of
+    a group share non-hostname topology labels — snapshot/affinity.py).
+
+    Affinity-term satisfaction composes with the static mask: the mask
+    handles terms vs pods already in the cluster (packer), this kernel
+    handles terms vs scan-placed pods, including the Kubernetes self-match
+    seeding rule (a pod matching its own required affinity term may open a
+    fresh domain when no scan-placed pod matches the term yet).
+    """
+    P, R = pod_req.shape
+    G = pod_masks.shape[0]
+    T = match.shape[0]
+    if node_caps is None:
+        node_caps = jnp.full((G,), max_nodes, jnp.int32)
+    caps = jnp.minimum(node_caps.astype(jnp.int32), max_nodes)
+
+    scores = jax.vmap(lambda alloc: ffd_scores(pod_req, alloc))(template_allocs)  # [G, P]
+    order = jnp.argsort(-scores, axis=1, stable=True)                 # [G, P]
+    sorted_mask = jnp.take_along_axis(pod_masks, order, axis=1)       # [G, P]
+
+    alloc_t = template_allocs[:, :, None]                             # [G, R, 1]
+    node_ids = jnp.arange(max_nodes)
+    garange = jnp.arange(G)
+    match_t = match.T.astype(bool)                                    # [P, T]
+    aff_t = aff_of.T.astype(bool)
+    anti_t = anti_of.T.astype(bool)
+    nl = node_level.astype(bool)                                      # [T]
+
+    def step(carry, xs):
+        used_t, opened, pm, pm_tot, ha, ha_tot = carry
+        # used_t [G,R,M]; opened [G]; pm/ha [G,T,M] i32; *_tot [G,T] i32
+        idx, active = xs                  # [G] i32, [G] bool
+        req = pod_req[idx]                # [G, R]
+        m_p = match_t[idx]                # [G, T]
+        a_p = aff_t[idx]                  # [G, T]
+        x_p = anti_t[idx]                 # [G, T]
+
+        free_t = alloc_t - used_t
+        fits_n = jnp.all(req[:, :, None] <= free_t, axis=1)           # [G, M]
+        fits_n &= node_ids[None, :] < opened[:, None]
+
+        # Per-term domain counts seen from node m: own node for hostname-level
+        # terms, the whole group otherwise.
+        dom_pm = jnp.where(nl[None, :, None], pm, pm_tot[:, :, None])  # [G,T,M]
+        dom_ha = jnp.where(nl[None, :, None], ha, ha_tot[:, :, None])
+        self_seed = m_p & (pm_tot == 0)                               # [G, T]
+        ok_t = (
+            ~a_p[:, :, None]
+            | (
+                has_label[:, :, None]
+                & ((dom_pm > 0) | self_seed[:, :, None])
+            )
+        )                                                             # [G,T,M]
+        aff_ok = ok_t.all(axis=1)                                     # [G, M]
+        # A node without the term's topology label has no domain there, so an
+        # anti term over it can never be violated (Kubernetes: the term simply
+        # doesn't match) — hence the has_label gate on both anti directions.
+        hl = has_label[:, :, None]
+        anti_blocked = (x_p[:, :, None] & (dom_pm > 0) & hl).any(axis=1)
+        sym_blocked = (m_p[:, :, None] & (dom_ha > 0) & hl).any(axis=1)
+        fits_n &= aff_ok & ~anti_blocked & ~sym_blocked
+
+        has_fit = fits_n.any(axis=1)
+        first = jnp.argmax(fits_n, axis=1).astype(jnp.int32)
+
+        # Can this pod seed a fresh node? Hostname-level terms see an empty
+        # domain there; group-level terms see the group totals.
+        ok_new_t = ~a_p | jnp.where(
+            nl[None, :],
+            self_seed,
+            has_label & ((pm_tot > 0) | self_seed),
+        )                                                             # [G, T]
+        new_ok = ok_new_t.all(axis=1)
+        new_ok &= ~(x_p & ~nl[None, :] & (pm_tot > 0) & has_label).any(axis=1)
+        new_ok &= ~(m_p & ~nl[None, :] & (ha_tot > 0) & has_label).any(axis=1)
+        fits_empty = jnp.all(req <= template_allocs, axis=1)
+        can_open = (opened < caps) & fits_empty & new_ok
+
+        place = active & (has_fit | can_open)
+        target = jnp.where(has_fit, first, opened)                    # [G]
+        onehot_b = (node_ids[None, :] == target[:, None]) & place[:, None]  # [G, M]
+        onehot = onehot_b.astype(pod_req.dtype)
+        used_t = used_t + req[:, :, None] * onehot[:, None, :]
+        opened = opened + (place & ~has_fit).astype(jnp.int32)
+
+        inc = onehot_b[:, None, :]                                    # [G,1,M]
+        pm = pm + (m_p[:, :, None] & inc).astype(jnp.int32)
+        ha = ha + (x_p[:, :, None] & inc).astype(jnp.int32)
+        pm_tot = pm_tot + (m_p & place[:, None]).astype(jnp.int32)
+        ha_tot = ha_tot + (x_p & place[:, None]).astype(jnp.int32)
+        return (used_t, opened, pm, pm_tot, ha, ha_tot), place
+
+    init = (
+        jnp.zeros((G, R, max_nodes), pod_req.dtype),
+        jnp.zeros((G,), jnp.int32),
+        jnp.zeros((G, T, max_nodes), jnp.int32),
+        jnp.zeros((G, T), jnp.int32),
+        jnp.zeros((G, T, max_nodes), jnp.int32),
+        jnp.zeros((G, T), jnp.int32),
+    )
+    (used_t, opened, *_), placed = jax.lax.scan(
+        step, init, (order.T, sorted_mask.T)
+    )                                                                 # placed [P, G]
+
+    scheduled = (
+        jnp.zeros((G, P), bool).at[garange[:, None], order].set(placed.T)
+    )
+    return BinpackResult(
+        node_count=opened,
+        scheduled=scheduled,
+        node_used=jnp.swapaxes(used_t, 1, 2),
+    )

@@ -1,0 +1,155 @@
+"""Inter-pod (anti-)affinity factored into term tensors for the FFD scan.
+
+The reference re-runs the InterPodAffinity filter plugin after every
+simulated placement inside the binpacking loop (cluster-autoscaler/estimator/
+binpacking_estimator.go:119-141 calling CheckPredicates → the scheduler
+framework's filters, simulator/predicatechecker/schedulerbased.go:152-163) —
+its documented 1000x cost outlier (FAQ.md:151-153). Here the dynamic part of
+that plugin (pods placed *during* the current scan constraining later pods)
+is factored once on the host into small dense tensors over the distinct
+required terms, and the scan kernel (ops/binpack.ffd_binpack_groups_affinity)
+carries per-term placement counts instead of re-walking objects.
+
+Topology model for scale-up template nodes: a `kubernetes.io/hostname` term
+is node-level (every new template node is its own domain); any other
+topology key is group-level — all new nodes of one node group share the
+non-hostname topology labels of the group's template (true for zonal node
+groups, which is also the reference's assumption behind balancing "similar"
+node groups, processors/nodegroupset/compare_nodegroups.go:84). A group
+whose template lacks the topology label can never satisfy a required
+affinity term over it (and trivially never violates an anti term), matching
+the packer's `node_dom >= 0` rule.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from autoscaler_tpu.kube.objects import Node, Pod, PodAffinityTerm
+from autoscaler_tpu.snapshot.tensors import bucket_size
+
+HOSTNAME_KEY = "kubernetes.io/hostname"
+
+
+@dataclass
+class AffinityTermTensors:
+    """Dense factorization of all required (anti-)affinity terms across a
+    pending-pod set. T = number of distinct terms; empty T means the plain
+    (affinity-free) kernel can run."""
+
+    match: np.ndarray        # [T, P] bool — term t's selector+namespace matches pod p
+    aff_of: np.ndarray       # [T, P] bool — pod p requires affinity term t
+    anti_of: np.ndarray      # [T, P] bool — pod p requires anti-affinity term t
+    node_level: np.ndarray   # [T] bool — hostname topology (per-node domain)
+    has_label: np.ndarray    # [G, T] bool — group template carries the topology label
+    terms: List[PodAffinityTerm]
+
+    @property
+    def num_terms(self) -> int:
+        """Real (unpadded) term count."""
+        return len(self.terms)
+
+
+def build_affinity_terms(
+    pods: Sequence[Pod],
+    templates: Sequence[Node],
+    pad_pods: int | None = None,
+    bucket_terms: bool = False,
+) -> AffinityTermTensors:
+    """Collect the distinct required terms over `pods` and evaluate their
+    selectors once per (term, pod-label-profile). Term deduplication means k
+    identical deployments' anti-affinity terms cost one tensor row, not k;
+    profile factorization means selector matching is O(T x distinct pod
+    profiles), not O(T x P) — pods of one deployment share labels, so real
+    clusters have few profiles (same trick as packer.compute_sched_mask).
+
+    bucket_terms=True pads the term axis to a power-of-two bucket (all-False
+    rows constrain nothing) so the jitted scan kernel's traced shape stays
+    stable as deployments with affinity come and go between loops."""
+    term_index: Dict[Tuple, int] = {}
+    terms: List[PodAffinityTerm] = []
+    decls: List[Tuple[int, int, bool]] = []  # (pod_idx, term_idx, is_anti)
+
+    def intern(term: PodAffinityTerm, ns: str) -> int:
+        # Namespace-resolve before interning: an empty namespaces tuple means
+        # "the declaring pod's namespace", so the same literal term from pods
+        # in different namespaces is a different constraint.
+        namespaces = term.namespaces or (ns,)
+        key = (term.selector, term.topology_key, tuple(sorted(namespaces)))
+        if key not in term_index:
+            term_index[key] = len(terms)
+            terms.append(
+                PodAffinityTerm(
+                    selector=term.selector,
+                    topology_key=term.topology_key,
+                    namespaces=tuple(sorted(namespaces)),
+                )
+            )
+        return term_index[key]
+
+    for i, pod in enumerate(pods):
+        if pod.affinity is None:
+            continue
+        for term in pod.affinity.pod_affinity:
+            decls.append((i, intern(term, pod.namespace), False))
+        for term in pod.affinity.pod_anti_affinity:
+            decls.append((i, intern(term, pod.namespace), True))
+
+    T = len(terms)
+    TT = bucket_size(T, minimum=4) if bucket_terms else T
+    P = pad_pods if pad_pods is not None else len(pods)
+    G = len(templates)
+    match = np.zeros((TT, P), bool)
+    aff_of = np.zeros((TT, P), bool)
+    anti_of = np.zeros((TT, P), bool)
+    node_level = np.zeros((TT,), bool)
+    has_label = np.zeros((G, TT), bool)
+
+    # pod label profiles: selector verdicts depend only on (namespace, labels)
+    profile_index: Dict[Tuple, int] = {}
+    pod_prof = np.empty(len(pods), np.int64)
+    profiles: List[Tuple[str, Dict[str, str]]] = []
+    for i, pod in enumerate(pods):
+        key = (pod.namespace, tuple(sorted(pod.labels.items())))
+        pid = profile_index.setdefault(key, len(profile_index))
+        pod_prof[i] = pid
+        if pid == len(profiles):
+            profiles.append((pod.namespace, pod.labels))
+
+    for t, term in enumerate(terms):
+        node_level[t] = term.topology_key == HOSTNAME_KEY
+        prof_match = np.fromiter(
+            (
+                ns in term.namespaces and term.selector.matches(labels)
+                for ns, labels in profiles
+            ),
+            bool,
+            count=len(profiles),
+        )
+        if len(pods):
+            match[t, : len(pods)] = prof_match[pod_prof]
+        for g, tmpl in enumerate(templates):
+            # hostname is implicit on every (template) node
+            has_label[g, t] = node_level[t] or term.topology_key in tmpl.labels
+
+    for i, t, is_anti in decls:
+        (anti_of if is_anti else aff_of)[t, i] = True
+
+    return AffinityTermTensors(
+        match=match,
+        aff_of=aff_of,
+        anti_of=anti_of,
+        node_level=node_level,
+        has_label=has_label,
+        terms=terms,
+    )
+
+
+def has_interpod_affinity(pods: Sequence[Pod]) -> bool:
+    return any(
+        p.affinity is not None
+        and (p.affinity.pod_affinity or p.affinity.pod_anti_affinity)
+        for p in pods
+    )

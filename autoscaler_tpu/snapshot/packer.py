@@ -103,10 +103,17 @@ def _pod_profile_key(pod: Pod) -> tuple:
 
 
 def compute_sched_mask(
-    nodes: Sequence[Node], pods: Sequence[Pod], node_of_pod: Sequence[int]
+    nodes: Sequence[Node],
+    pods: Sequence[Pod],
+    node_of_pod: Sequence[int],
+    interpod: bool = True,
 ) -> np.ndarray:
     """[P, N] boolean precomputed predicate mask. node_of_pod[i] is the index
-    of the node pod i is placed on, -1 if pending.
+    of the node pod i is placed on, -1 if pending. interpod=False skips the
+    inter-pod (anti-)affinity rules — used when the caller runs the *dynamic*
+    affinity scan (ops/binpack.ffd_binpack_groups_affinity), which evaluates
+    those terms against scan-placed pods; statically pre-blocking them here
+    would wrongly veto a pod whose affinity partner is placed mid-scan.
 
     The taints/selector/node-affinity part is evaluated per (pod-profile ×
     node-profile) equivalence class and scattered, not per (pod, node): real
@@ -180,6 +187,9 @@ def compute_sched_mask(
             self_contrib = 1 if j == own else 0
             if any(counts.get(p, 0) > self_contrib for p in pod.host_ports):
                 mask[i, j] = False
+
+    if not interpod:
+        return mask
 
     # Required inter-pod (anti-)affinity vs already-placed pods, including the
     # symmetric anti-affinity rule (an existing pod's anti-affinity keeps

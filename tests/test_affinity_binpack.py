@@ -1,0 +1,386 @@
+"""Dynamic inter-pod (anti-)affinity during FFD binpacking.
+
+The reference re-runs the InterPodAffinity filter plugin after every
+simulated placement (cluster-autoscaler/estimator/binpacking_estimator.go:
+119-141); these tests pin the TPU scan kernel to a serial oracle with the
+same semantics, plus targeted scenario tests for the Kubernetes rules that
+matter: anti-affinity spreading, affinity co-location, self-match seeding,
+the symmetric anti-affinity rule, and zone-level (group-domain) terms.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+from autoscaler_tpu.estimator.reference_impl import ffd_binpack_reference_affinity
+from autoscaler_tpu.kube.objects import (
+    CPU,
+    MEMORY,
+    PODS,
+    Affinity,
+    LabelSelector,
+    PodAffinityTerm,
+    Resources,
+)
+from autoscaler_tpu.ops.binpack import ffd_binpack_groups_affinity
+from autoscaler_tpu.snapshot.affinity import build_affinity_terms
+from autoscaler_tpu.utils.test_utils import (
+    anti_affinity,
+    build_test_node,
+    build_test_pod,
+    pod_affinity,
+)
+
+
+def run_both(pod_req, pod_masks, allocs, max_nodes, match, aff_of, anti_of,
+             node_level, has_label, caps=None):
+    """Run kernel + oracle on identical inputs; assert exact agreement."""
+    G = pod_masks.shape[0]
+    res = ffd_binpack_groups_affinity(
+        jnp.asarray(pod_req),
+        jnp.asarray(pod_masks),
+        jnp.asarray(allocs),
+        max_nodes=max_nodes,
+        match=jnp.asarray(match),
+        aff_of=jnp.asarray(aff_of),
+        anti_of=jnp.asarray(anti_of),
+        node_level=jnp.asarray(node_level),
+        has_label=jnp.asarray(has_label),
+        node_caps=None if caps is None else jnp.asarray(caps),
+    )
+    counts = np.asarray(res.node_count)
+    scheds = np.asarray(res.scheduled)
+    for g in range(G):
+        mn = max_nodes if caps is None else min(int(caps[g]), max_nodes)
+        c, s = ffd_binpack_reference_affinity(
+            pod_req, pod_masks[g], allocs[g], mn,
+            match, aff_of, anti_of, node_level, has_label[g],
+        )
+        assert counts[g] == c, f"group {g}: count {counts[g]} != oracle {c}"
+        np.testing.assert_array_equal(scheds[g], s, err_msg=f"group {g}")
+    return counts, scheds
+
+
+def simple_workload(P, R=6, cpu=1000, mem=1024, cap_cpu=4000, cap_mem=8192, G=1):
+    pod_req = np.zeros((P, R), np.float32)
+    pod_req[:, CPU] = cpu
+    pod_req[:, MEMORY] = mem
+    pod_req[:, PODS] = 1
+    allocs = np.zeros((G, R), np.float32)
+    allocs[:, CPU] = cap_cpu
+    allocs[:, MEMORY] = cap_mem
+    allocs[:, PODS] = 110
+    masks = np.ones((G, P), bool)
+    return pod_req, masks, allocs
+
+
+class TestHostnameAntiAffinity:
+    def test_anti_affinity_forces_one_pod_per_node(self):
+        # 4 pods that all match each other's hostname anti-term: each needs
+        # its own node even though 4 would fit one node resource-wise.
+        P, T = 4, 1
+        pod_req, masks, allocs = simple_workload(P)
+        match = np.ones((T, P), bool)
+        anti_of = np.ones((T, P), bool)
+        aff_of = np.zeros((T, P), bool)
+        node_level = np.array([True])
+        has_label = np.ones((1, T), bool)
+        counts, scheds = run_both(
+            pod_req, masks, allocs, 8, match, aff_of, anti_of, node_level, has_label
+        )
+        assert counts[0] == 4
+        assert scheds[0].all()
+
+    def test_anti_affinity_capped_nodes_leaves_pods_pending(self):
+        P, T = 4, 1
+        pod_req, masks, allocs = simple_workload(P)
+        match = np.ones((T, P), bool)
+        anti_of = np.ones((T, P), bool)
+        aff_of = np.zeros((T, P), bool)
+        counts, scheds = run_both(
+            pod_req, masks, allocs, 8,
+            match, aff_of, anti_of, np.array([True]), np.ones((1, T), bool),
+            caps=np.array([2], np.int32),
+        )
+        assert counts[0] == 2
+        assert scheds[0].sum() == 2
+
+    def test_symmetric_rule_blocks_non_declaring_pods(self):
+        # Pod 0 declares anti-affinity against label app=web; pods 1..3 carry
+        # app=web but declare nothing. Once pod 0 (biggest, placed first) is
+        # on a node, the web pods must avoid that node — the symmetric rule.
+        P, T = 4, 1
+        pod_req, masks, allocs = simple_workload(P, cpu=500)
+        pod_req[0, CPU] = 3900  # pod 0 sorts first and nearly fills its node
+        match = np.array([[False, True, True, True]])  # selector: app=web
+        anti_of = np.array([[True, False, False, False]])
+        aff_of = np.zeros((T, P), bool)
+        counts, scheds = run_both(
+            pod_req, masks, allocs, 8,
+            match, aff_of, anti_of, np.array([True]), np.ones((1, T), bool),
+        )
+        # web pods all fit one fresh node; declarer sits alone.
+        assert counts[0] == 2
+        assert scheds[0].all()
+
+
+class TestHostnameAffinity:
+    def test_affinity_coschedules_on_seed_node(self):
+        # Pod 0 carries app=db and self-matching affinity is absent; pods 1-3
+        # require affinity to app=db on hostname: they must land with pod 0.
+        P, T = 4, 1
+        pod_req, masks, allocs = simple_workload(P, cpu=900)
+        pod_req[0, CPU] = 1000  # sorts first
+        match = np.array([[True, False, False, False]])
+        aff_of = np.array([[False, True, True, True]])
+        anti_of = np.zeros((T, P), bool)
+        counts, scheds = run_both(
+            pod_req, masks, allocs, 8,
+            match, aff_of, anti_of, np.array([True]), np.ones((1, T), bool),
+        )
+        assert counts[0] == 1
+        assert scheds[0].all()
+
+    def test_affinity_overflow_stays_pending(self):
+        # Seed node fills up; affine pods that no longer fit the seed node
+        # cannot open a fresh node (their partner is pinned elsewhere).
+        P, T = 5, 1
+        pod_req, masks, allocs = simple_workload(P, cpu=1500)
+        pod_req[0, CPU] = 2000
+        match = np.array([[True, False, False, False, False]])
+        aff_of = np.array([[False, True, True, True, True]])
+        anti_of = np.zeros((T, P), bool)
+        counts, scheds = run_both(
+            pod_req, masks, allocs, 8,
+            match, aff_of, anti_of, np.array([True]), np.ones((1, T), bool),
+        )
+        # node: 4000 cpu; pod0=2000, then affine pods 1500 each → only one fits
+        assert counts[0] == 1
+        assert scheds[0].sum() == 2
+
+    def test_self_match_seeding_allows_first_pod(self):
+        # All pods both carry and require app=db affinity: first pod seeds a
+        # node, the rest co-locate until full (the Kubernetes self-match rule).
+        P, T = 3, 1
+        pod_req, masks, allocs = simple_workload(P, cpu=1000)
+        match = np.ones((T, P), bool)
+        aff_of = np.ones((T, P), bool)
+        anti_of = np.zeros((T, P), bool)
+        counts, scheds = run_both(
+            pod_req, masks, allocs, 8,
+            match, aff_of, anti_of, np.array([True]), np.ones((1, T), bool),
+        )
+        assert counts[0] == 1
+        assert scheds[0].all()
+
+    def test_self_affine_group_overflow_blocked(self):
+        # Self-affine group larger than one node: overflow pods cannot seed a
+        # second node (their affinity pins them to the first domain). Matches
+        # the reference's behavior for required hostname affinity.
+        P, T = 6, 1
+        pod_req, masks, allocs = simple_workload(P, cpu=1000)
+        match = np.ones((T, P), bool)
+        aff_of = np.ones((T, P), bool)
+        anti_of = np.zeros((T, P), bool)
+        counts, scheds = run_both(
+            pod_req, masks, allocs, 8,
+            match, aff_of, anti_of, np.array([True]), np.ones((1, T), bool),
+        )
+        assert counts[0] == 1
+        assert scheds[0].sum() == 4  # 4x1000 fills the 4000-cpu node
+
+
+class TestGroupLevelTerms:
+    def test_zone_anti_affinity_allows_one_per_group(self):
+        # Zone-level anti-affinity: all new nodes of a group share the zone,
+        # so only ONE matching pod can be placed in the whole group.
+        P, T = 3, 1
+        pod_req, masks, allocs = simple_workload(P)
+        match = np.ones((T, P), bool)
+        anti_of = np.ones((T, P), bool)
+        aff_of = np.zeros((T, P), bool)
+        counts, scheds = run_both(
+            pod_req, masks, allocs, 8,
+            match, aff_of, anti_of, np.array([False]), np.ones((1, T), bool),
+        )
+        assert counts[0] == 1
+        assert scheds[0].sum() == 1
+
+    def test_zone_affinity_coschedules_across_nodes(self):
+        # Zone-level affinity: pods co-locate in the group's zone but may
+        # spread over multiple new nodes.
+        P, T = 5, 1
+        pod_req, masks, allocs = simple_workload(P, cpu=1500)
+        match = np.ones((T, P), bool)
+        aff_of = np.ones((T, P), bool)
+        anti_of = np.zeros((T, P), bool)
+        counts, scheds = run_both(
+            pod_req, masks, allocs, 8,
+            match, aff_of, anti_of, np.array([False]), np.ones((1, T), bool),
+        )
+        assert scheds[0].all()
+        assert counts[0] == 3  # 2+2+1 pods across 3 nodes (4000/1500)
+
+    def test_group_without_topology_label_cannot_violate_anti(self):
+        # Template lacks the zone label → no zone domain exists on its nodes,
+        # so a required zone anti-affinity term can never be violated there
+        # (Kubernetes: an unlabeled node simply doesn't match the term). All
+        # three pods pack normally.
+        P, T = 3, 1
+        pod_req, masks, allocs = simple_workload(P)
+        match = np.ones((T, P), bool)
+        anti_of = np.ones((T, P), bool)
+        aff_of = np.zeros((T, P), bool)
+        counts, scheds = run_both(
+            pod_req, masks, allocs, 8,
+            match, aff_of, anti_of, np.array([False]), np.zeros((1, T), bool),
+        )
+        assert counts[0] == 1
+        assert scheds[0].all()
+
+    def test_group_without_topology_label_blocks_affinity(self):
+        # Template lacks the zone label → required zone affinity unsatisfiable.
+        P, T = 2, 1
+        pod_req, masks, allocs = simple_workload(P)
+        match = np.ones((T, P), bool)
+        aff_of = np.ones((T, P), bool)
+        anti_of = np.zeros((T, P), bool)
+        counts, scheds = run_both(
+            pod_req, masks, allocs, 8,
+            match, aff_of, anti_of, np.array([False]), np.zeros((1, T), bool),
+        )
+        assert counts[0] == 0
+        assert not scheds[0].any()
+
+
+class TestRandomizedParity:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_random_terms_match_oracle(self, seed):
+        rng = np.random.default_rng(seed)
+        P, G, T = 24, 3, 4
+        pod_req = np.zeros((P, 6), np.float32)
+        pod_req[:, CPU] = rng.integers(200, 2500, P)
+        pod_req[:, MEMORY] = rng.integers(128, 4096, P)
+        pod_req[:, PODS] = 1
+        allocs = np.zeros((G, 6), np.float32)
+        allocs[:, CPU] = rng.integers(3000, 9000, G)
+        allocs[:, MEMORY] = rng.integers(6000, 16000, G)
+        allocs[:, PODS] = 32
+        masks = rng.random((G, P)) > 0.1
+        match = rng.random((T, P)) < 0.4
+        aff_of = (rng.random((T, P)) < 0.15)
+        anti_of = (rng.random((T, P)) < 0.15) & ~aff_of
+        node_level = rng.random(T) < 0.5
+        has_label = rng.random((G, T)) < 0.8
+        caps = rng.integers(2, 16, G).astype(np.int32)
+        run_both(
+            pod_req, masks, allocs, 16,
+            match, aff_of, anti_of, node_level, has_label, caps=caps,
+        )
+
+    def test_no_terms_degenerates_to_plain_ffd(self):
+        from autoscaler_tpu.ops.binpack import ffd_binpack_groups
+
+        rng = np.random.default_rng(7)
+        P, G = 32, 4
+        pod_req = np.zeros((P, 6), np.float32)
+        pod_req[:, CPU] = rng.integers(100, 2000, P)
+        pod_req[:, PODS] = 1
+        allocs = np.zeros((G, 6), np.float32)
+        allocs[:, CPU] = rng.integers(2000, 8000, G)
+        allocs[:, PODS] = 110
+        masks = np.ones((G, P), bool)
+        T = 0
+        res_a = ffd_binpack_groups_affinity(
+            jnp.asarray(pod_req), jnp.asarray(masks), jnp.asarray(allocs),
+            max_nodes=16,
+            match=jnp.zeros((T, P), bool), aff_of=jnp.zeros((T, P), bool),
+            anti_of=jnp.zeros((T, P), bool), node_level=jnp.zeros((T,), bool),
+            has_label=jnp.zeros((G, T), bool),
+        )
+        res_p = ffd_binpack_groups(
+            jnp.asarray(pod_req), jnp.asarray(masks), jnp.asarray(allocs),
+            max_nodes=16,
+        )
+        np.testing.assert_array_equal(res_a.node_count, res_p.node_count)
+        np.testing.assert_array_equal(res_a.scheduled, res_p.scheduled)
+
+
+class TestEstimatorIntegration:
+    def test_estimator_routes_affinity_pods_through_dynamic_kernel(self):
+        # An app=web deployment with hostname anti-affinity: each replica
+        # needs its own node.
+        est = BinpackingNodeEstimator()
+        template = build_test_node("tmpl", cpu_m=4000, mem=16 << 30)
+        pods = [
+            build_test_pod(
+                f"web-{i}", cpu_m=500, mem=1 << 30,
+                labels={"app": "web"},
+                affinity=anti_affinity({"app": "web"}),
+            )
+            for i in range(3)
+        ]
+        count, scheduled = est.estimate(pods, template)
+        assert count == 3
+        assert len(scheduled) == 3
+
+    def test_estimator_affinity_pair_coschedules(self):
+        est = BinpackingNodeEstimator()
+        template = build_test_node("tmpl", cpu_m=4000, mem=16 << 30)
+        db = build_test_pod("db", cpu_m=2000, mem=2 << 30, labels={"app": "db"})
+        web = [
+            build_test_pod(
+                f"web-{i}", cpu_m=500, mem=1 << 30,
+                affinity=pod_affinity({"app": "db"}),
+            )
+            for i in range(2)
+        ]
+        count, scheduled = est.estimate([db] + web, template)
+        assert count == 1
+        assert len(scheduled) == 3
+
+    def test_estimate_many_with_zone_terms(self):
+        est = BinpackingNodeEstimator()
+        t_zoned = build_test_node(
+            "tmpl-a", cpu_m=4000, mem=16 << 30,
+            labels={"topology.kubernetes.io/zone": "us-a"},
+        )
+        t_bare = build_test_node("tmpl-b", cpu_m=4000, mem=16 << 30)
+        pods = [
+            build_test_pod(
+                f"p-{i}", cpu_m=1000, mem=1 << 30, labels={"app": "x"},
+                affinity=pod_affinity(
+                    {"app": "x"}, topology_key="topology.kubernetes.io/zone"
+                ),
+            )
+            for i in range(3)
+        ]
+        out = est.estimate_many(pods, {"a": t_zoned, "b": t_bare})
+        assert out["a"][0] == 1 and len(out["a"][1]) == 3
+        # bare template lacks the zone label: required term unsatisfiable
+        assert out["b"][0] == 0 and len(out["b"][1]) == 0
+
+
+class TestBuildAffinityTerms:
+    def test_terms_deduplicate_across_pods(self):
+        aff = anti_affinity({"app": "web"})
+        pods = [
+            build_test_pod(f"w{i}", labels={"app": "web"}, affinity=aff)
+            for i in range(5)
+        ]
+        terms = build_affinity_terms(pods, [build_test_node("t")])
+        assert terms.num_terms == 1
+        assert terms.anti_of.all()
+        assert terms.match.all()
+
+    def test_namespace_scoping_splits_terms(self):
+        sel = LabelSelector(match_labels=(("app", "web"),))
+        term = PodAffinityTerm(selector=sel, topology_key="kubernetes.io/hostname")
+        a = build_test_pod("a", labels={"app": "web"}, affinity=Affinity(pod_anti_affinity=(term,)))
+        b = build_test_pod("b", labels={"app": "web"}, affinity=Affinity(pod_anti_affinity=(term,)))
+        b.namespace = "other"
+        terms = build_affinity_terms([a, b], [build_test_node("t")])
+        # same literal term, different declaring namespaces → two constraints
+        assert terms.num_terms == 2
+        # a's term only matches pods in namespace default; b only in `other`
+        assert terms.match.sum() == 2
