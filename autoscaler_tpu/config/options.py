@@ -112,6 +112,12 @@ class AutoscalingOptions:
     cordon_node_before_terminating: bool = False
     ignore_daemonsets_utilization: bool = False
     ignore_mirror_pods_utilization: bool = False
+    # DaemonSet pods are gracefully evicted (best-effort, never PDB-simulated
+    # — the eviction API enforces PDBs server-side) from nodes being removed.
+    # Defaults mirror the reference flags (main.go:198-199): opt-in for empty
+    # nodes, on for drained ones.
+    daemonset_eviction_for_empty_nodes: bool = False
+    daemonset_eviction_for_occupied_nodes: bool = True
 
     def group_options(self, group_name: str) -> NodeGroupAutoscalingOptions:
         """Resolve per-group options with fallback to defaults (the

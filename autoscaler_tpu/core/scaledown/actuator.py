@@ -69,6 +69,20 @@ class Evictor:
                 return False, evicted
         return True, evicted
 
+    def evict_daemonset_pods(self, pods: Sequence[Pod]) -> List[str]:
+        """Best-effort DaemonSet eviction (reference actuation/drain.go:177):
+        failures never block the node deletion, and PDBs are not simulated —
+        the eviction API enforces them server-side (the reference has the
+        same behavior; see ROADMAP #3 note)."""
+        evicted: List[str] = []
+        for pod in pods:
+            try:
+                self.api.evict_pod(pod)
+                evicted.append(pod.key())
+            except EvictionError:
+                pass
+        return evicted
+
 
 class NodeDeletionBatcher:
     """reference actuation/delete_in_batch.go:71 — collect nodes per group,
@@ -140,6 +154,10 @@ class ScaleDownActuator:
                 result.failed[r.node.name] = "no node group"
                 continue
             self.tracker.start_deletion(group.id(), r.node.name, drain=False)
+            if self.options.daemonset_eviction_for_empty_nodes:
+                result.evicted_pods.extend(
+                    self.evictor.evict_daemonset_pods(r.daemonset_pods)
+                )
             batcher.add_node(group, r.node)
             staged.append((r, False))
 
@@ -151,6 +169,10 @@ class ScaleDownActuator:
             self.tracker.start_deletion(group.id(), r.node.name, drain=True)
             ok, evicted = self.evictor.drain_node(r.node, r.pods_to_reschedule, self.tracker, now_ts)
             result.evicted_pods.extend(evicted)
+            if ok and self.options.daemonset_eviction_for_occupied_nodes:
+                result.evicted_pods.extend(
+                    self.evictor.evict_daemonset_pods(r.daemonset_pods)
+                )
             if not ok:
                 self.tracker.end_deletion(group.id(), r.node.name, ok=False, error="eviction failed", ts=now_ts)
                 result.failed[r.node.name] = "eviction failed"

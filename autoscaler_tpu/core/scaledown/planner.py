@@ -25,6 +25,7 @@ from autoscaler_tpu.core.scaledown.tracking import (
     UnremovableNodesCache,
 )
 from autoscaler_tpu.kube.objects import Node, PodDisruptionBudget
+from autoscaler_tpu.simulator.drain import daemonset_pods_of
 from autoscaler_tpu.simulator.removal import (
     NodeToRemove,
     RemovalSimulator,
@@ -131,7 +132,8 @@ class ScaleDownPlanner:
                 continue
             if name in self._empty_names:
                 if len(plan.empty) < self.options.max_empty_bulk_delete:
-                    plan.empty.append(NodeToRemove(node))
+                    ds = daemonset_pods_of(snapshot.pods_on_node(name))
+                    plan.empty.append(NodeToRemove(node, daemonset_pods=ds))
                     deletions_per_group[gid] = deletions_per_group.get(gid, 0) + 1
             elif name in self._drainable:
                 if len(plan.drain) < self.options.max_drain_parallelism:
@@ -143,6 +145,18 @@ class ScaleDownPlanner:
             keep_empty = min(len(plan.empty), cap)
             plan.empty = plan.empty[:keep_empty]
             plan.drain = plan.drain[: max(0, cap - keep_empty)]
+        # Joint re-validation: the per-candidate simulation above evaluated
+        # each drain against the same base state; the picked set must also
+        # hold *together* (no double-booked capacity, no destinations on
+        # nodes that are themselves leaving). Mirrors the reference's
+        # fresh-snapshot re-check during actuation (actuator.go:371).
+        if plan.drain:
+            empty_names = [r.node.name for r in plan.empty]
+            valid, rejected = self.simulator.validate_removal_set(
+                snapshot, plan.drain, also_removed=empty_names
+            )
+            plan.drain = valid
+            plan.unremovable.extend(rejected)
         return plan
 
     def utilization_of(self, node_name: str) -> Optional[float]:

@@ -46,6 +46,33 @@ class RemovalFeasibility(NamedTuple):
     moved_counts: jax.Array  # [C] i32 — pods that found a new home
 
 
+def _place_pod_step(snap: SnapshotTensors, excluded: jax.Array):
+    """Shared greedy-placement scan step: place one movable pod onto the
+    first allowed node (capacity + static mask + validity − excluded),
+    updating the free-capacity carry. Used by both the per-candidate and the
+    joint feasibility kernels so their placement semantics cannot drift."""
+
+    def step(free, pod_idx):
+        valid_pod = pod_idx >= 0
+        safe_idx = jnp.maximum(pod_idx, 0)
+        req = snap.pod_req[safe_idx]
+        ok = (
+            jnp.all(req[None, :] <= free, axis=-1)
+            & snap.sched_mask[safe_idx]
+            & snap.node_valid
+            & ~excluded
+        )
+        has = ok.any()
+        dest = jnp.where(has, jnp.argmax(ok).astype(jnp.int32), -1)
+        place = valid_pod & has
+        target = jnp.maximum(dest, 0)
+        free = free.at[target].add(jnp.where(place, -req, jnp.zeros_like(req)))
+        placed_needed = jnp.where(valid_pod, place, True)
+        return free, (jnp.where(valid_pod, dest, -1), placed_needed, place)
+
+    return step
+
+
 @functools.partial(jax.jit, static_argnames=())
 def removal_feasibility(
     snap: SnapshotTensors,
@@ -63,32 +90,55 @@ def removal_feasibility(
 
     def lane(j, slots, lane_blocked):
         exclude = jnp.arange(snap.num_nodes) == j
-
-        def step(carry, pod_idx):
-            free = carry
-            valid_pod = pod_idx >= 0
-            safe_idx = jnp.maximum(pod_idx, 0)
-            req = snap.pod_req[safe_idx]
-            ok = (
-                jnp.all(req[None, :] <= free, axis=-1)
-                & snap.sched_mask[safe_idx]
-                & snap.node_valid
-                & ~exclude
-            )
-            has = ok.any()
-            dest = jnp.where(has, jnp.argmax(ok).astype(jnp.int32), -1)
-            place = valid_pod & has
-            target = jnp.maximum(dest, 0)
-            free = free.at[target].add(
-                jnp.where(place, -req, jnp.zeros_like(req))
-            )
-            placed_needed = jnp.where(valid_pod, place, True)
-            return free, (jnp.where(valid_pod, dest, -1), placed_needed, place)
-
         # The drained node's capacity is not a destination: zero its free row.
         free_start = jnp.where(exclude[:, None], 0.0, free0)
-        _, (dests, placed_ok, placed) = jax.lax.scan(step, free_start, slots)
+        _, (dests, placed_ok, placed) = jax.lax.scan(
+            _place_pod_step(snap, exclude), free_start, slots
+        )
         feasible = placed_ok.all() & ~lane_blocked
         return feasible, dests, placed.sum().astype(jnp.int32)
 
     return RemovalFeasibility(*jax.vmap(lane)(candidate_nodes, pod_slots, blocked))
+
+
+@functools.partial(jax.jit, static_argnames=())
+def joint_removal_feasibility(
+    snap: SnapshotTensors,
+    candidate_nodes: jax.Array,   # [C] i32 node indices, in planner pick order
+    pod_slots: jax.Array,         # [C, S] i32 movable-pod indices (-1 pad)
+    excluded: jax.Array,          # [N] bool — every node leaving the cluster
+                                  #   in this plan (all drains + empty deletes)
+) -> RemovalFeasibility:
+    """Sequential re-validation of a *set* of removals before actuation.
+
+    removal_feasibility answers each candidate independently against the same
+    base state — the reference's categorizeNodes semantics (planner.go:252).
+    But the picked deletion set acts jointly: two drained nodes cannot both
+    re-place pods into the same free capacity, and nothing may re-place onto
+    a node that is itself being deleted (the reference re-simulates the set
+    under a fresh snapshot inside NodesToDelete/actuation, actuator.go:371).
+    Here candidates are scanned in pick order with a shared free-capacity
+    carry; a candidate that no longer fits is reported infeasible and its
+    trial placements are rolled back (later candidates see the state as if
+    it stayed)."""
+    free0 = snap.free()  # [N, R]
+
+    def cand_step(free, slots):
+        trial_free, (dests, placed_ok, placed) = jax.lax.scan(
+            _place_pod_step(snap, excluded), free, slots
+        )
+        feasible = placed_ok.all()
+        # commit this candidate's placements only if the whole node drains
+        free = jnp.where(feasible, trial_free, free)
+        moved = jnp.where(feasible, placed.sum(), 0).astype(jnp.int32)
+        return free, (feasible, jnp.where(feasible, dests, -1), moved)
+
+    # zero the free rows of every to-be-deleted node so nothing lands there;
+    # candidate_nodes fixes the row order of pod_slots (each candidate's own
+    # row is already in `excluded`, set by the caller)
+    del candidate_nodes
+    free_start = jnp.where(excluded[:, None], 0.0, free0)
+    _, (feasible, dests, moved) = jax.lax.scan(cand_step, free_start, pod_slots)
+    return RemovalFeasibility(
+        feasible=feasible, destinations=dests, moved_counts=moved
+    )

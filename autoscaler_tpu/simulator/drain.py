@@ -125,3 +125,11 @@ def get_pods_to_move(
     if pdb_block is not None:
         return [], pdb_block
     return to_move, None
+
+
+def daemonset_pods_of(pods: Sequence[Pod]) -> List[Pod]:
+    """DaemonSet pods eligible for best-effort eviction when their node is
+    removed (reference actuation/drain.go:177-188). Mirror pods are managed
+    by the kubelet and never evicted. Shared by the empty-node and drained-
+    node paths so their eviction sets cannot drift."""
+    return [p for p in pods if p.daemonset and not p.mirror]

@@ -15,10 +15,11 @@ import numpy as np
 
 from autoscaler_tpu.kube.objects import Node, Pod, PodDisruptionBudget
 from autoscaler_tpu.ops.scaledown import empty_nodes as empty_nodes_kernel
-from autoscaler_tpu.ops.scaledown import removal_feasibility
+from autoscaler_tpu.ops.scaledown import joint_removal_feasibility, removal_feasibility
 from autoscaler_tpu.simulator.drain import (
     BlockingPod,
     DrainabilityRules,
+    daemonset_pods_of,
     get_pods_to_move,
 )
 from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
@@ -47,6 +48,10 @@ class NodeToRemove:
     node: Node
     pods_to_reschedule: List[Pod] = field(default_factory=list)
     destinations: Dict[str, str] = field(default_factory=dict)  # pod key → node name
+    # DaemonSet pods riding on the node: never simulated for rescheduling
+    # (the controller recreates them elsewhere), optionally evicted
+    # best-effort at actuation (reference actuation/drain.go:177-188).
+    daemonset_pods: List[Pod] = field(default_factory=list)
 
 
 @dataclass
@@ -99,10 +104,12 @@ class RemovalSimulator:
         blocked = np.zeros(C, bool)
         blocking: Dict[str, BlockingPod] = {}
         movable_pods: Dict[str, List[Pod]] = {}
+        ds_pods: Dict[str, List[Pod]] = {}
 
         for ci, name in enumerate(cand_names):
             cand_idx[ci] = meta.node_index[name]
             pods_on = snapshot.pods_on_node(name)
+            ds_pods[name] = daemonset_pods_of(pods_on)
             to_move, block = get_pods_to_move(pods_on, self.rules, pdbs)
             if block is not None:
                 blocked[ci] = True
@@ -140,9 +147,90 @@ class RemovalSimulator:
                     for si, pod in enumerate(moves[:S])
                     if dests[ci, si] >= 0
                 }
-                to_remove.append(NodeToRemove(node, moves, destinations))
+                to_remove.append(
+                    NodeToRemove(node, moves, destinations, ds_pods.get(name, []))
+                )
             else:
                 unremovable.append(
                     UnremovableNode(node, UnremovableReason.NO_PLACE_TO_MOVE_PODS)
                 )
         return to_remove, unremovable
+
+    def validate_removal_set(
+        self,
+        snapshot: ClusterSnapshot,
+        drains: Sequence[NodeToRemove],
+        also_removed: Sequence[str] = (),
+        max_pods_per_node: int = 128,
+    ) -> Tuple[List[NodeToRemove], List[UnremovableNode]]:
+        """Joint re-simulation of the picked deletion set, in pick order.
+
+        Per-candidate feasibility (find_nodes_to_remove) evaluates every
+        candidate against the same base state; this pass replays the chosen
+        drains sequentially over ONE shared capacity state, with every node
+        leaving the cluster (the drains themselves plus `also_removed`, e.g.
+        empty nodes picked for deletion) excluded as a destination — the
+        joint check the reference gets from re-simulating against a fresh
+        snapshot during actuation (actuator.go:371, cluster.go:145). Returns
+        (validated drains with updated destinations, rejected)."""
+        tensors, meta = snapshot.tensors()
+        # Guard against drains computed from an older snapshot: a drain whose
+        # node or pods have since vanished cannot be validated — reject it
+        # rather than crash (find_nodes_to_remove filters the same way).
+        rejected: List[UnremovableNode] = []
+        current: List[NodeToRemove] = []
+        for r in drains:
+            known = r.node.name in meta.node_index and all(
+                p.key() in meta.pod_index for p in r.pods_to_reschedule
+            )
+            if known:
+                current.append(r)
+            else:
+                rejected.append(
+                    UnremovableNode(r.node, UnremovableReason.NO_PLACE_TO_MOVE_PODS)
+                )
+        drains = current
+        if not drains:
+            return [], rejected
+        C, S = len(drains), max_pods_per_node
+        cand_idx = np.zeros(C, np.int32)
+        pod_slots = np.full((C, S), -1, np.int32)
+        excluded = np.zeros(tensors.num_nodes, bool)
+        for name in also_removed:
+            j = meta.node_index.get(name)
+            if j is not None:
+                excluded[j] = True
+        for ci, r in enumerate(drains):
+            j = meta.node_index[r.node.name]
+            cand_idx[ci] = j
+            excluded[j] = True
+            for si, pod in enumerate(r.pods_to_reschedule[:S]):
+                pod_slots[ci, si] = meta.pod_index[pod.key()]
+
+        res = joint_removal_feasibility(
+            tensors,
+            jnp.asarray(cand_idx),
+            jnp.asarray(pod_slots),
+            jnp.asarray(excluded),
+        )
+        feasible = np.asarray(res.feasible)
+        dests = np.asarray(res.destinations)
+
+        valid: List[NodeToRemove] = []
+        for ci, r in enumerate(drains):
+            if feasible[ci]:
+                destinations = {
+                    pod.key(): meta.nodes[dests[ci, si]].name
+                    for si, pod in enumerate(r.pods_to_reschedule[:S])
+                    if dests[ci, si] >= 0
+                }
+                valid.append(
+                    NodeToRemove(
+                        r.node, r.pods_to_reschedule, destinations, r.daemonset_pods
+                    )
+                )
+            else:
+                rejected.append(
+                    UnremovableNode(r.node, UnremovableReason.NO_PLACE_TO_MOVE_PODS)
+                )
+        return valid, rejected

@@ -377,3 +377,169 @@ class TestPlannerAndActuator:
         removed = actuator.clean_up_to_be_deleted_taints(api.list_nodes())
         assert removed == 1
         assert not api.nodes["n0"].taints
+
+
+class TestJointSetValidation:
+    """validate_removal_set: the picked deletion set must hold *jointly* —
+    shared capacity, no destinations on nodes that are themselves leaving
+    (reference re-simulates under a fresh snapshot, actuator.go:371)."""
+
+    def _drainable_snapshot(self):
+        # d0, d1 each hold one movable 600m pod; spare has 800m free:
+        # either drain alone is feasible, both together are not.
+        d0 = build_test_node("d0", cpu_m=1000)
+        d1 = build_test_node("d1", cpu_m=1000)
+        spare = build_test_node("spare", cpu_m=1000)
+        filler = build_test_pod("filler", cpu_m=200)
+        p0 = build_test_pod("p0", cpu_m=600)
+        p1 = build_test_pod("p1", cpu_m=600)
+        snap = snapshot_with(
+            [d0, d1, spare], [(p0, "d0"), (p1, "d1"), (filler, "spare")]
+        )
+        return snap
+
+    def test_double_booked_capacity_rejects_second_drain(self):
+        snap = self._drainable_snapshot()
+        sim = RemovalSimulator()
+        to_remove, unremovable = sim.find_nodes_to_remove(snap, ["d0", "d1"])
+        # independently both look feasible (each sees spare's full headroom)
+        assert {r.node.name for r in to_remove} == {"d0", "d1"}
+        valid, rejected = sim.validate_removal_set(snap, to_remove)
+        assert [r.node.name for r in valid] == ["d0"]
+        assert [u.node.name for u in rejected] == ["d1"]
+        assert rejected[0].reason == UnremovableReason.NO_PLACE_TO_MOVE_PODS
+
+    def test_destination_on_deleted_empty_node_rejected(self):
+        # d0's pod can only move to "empty" — but empty is being deleted too.
+        d0 = build_test_node("d0", cpu_m=1000)
+        empty = build_test_node("empty", cpu_m=1000)
+        full = build_test_node("full", cpu_m=1000)
+        p0 = build_test_pod("p0", cpu_m=600)
+        big = build_test_pod("big", cpu_m=900)
+        snap = snapshot_with([d0, empty, full], [(p0, "d0"), (big, "full")])
+        sim = RemovalSimulator()
+        to_remove, _ = sim.find_nodes_to_remove(snap, ["d0"])
+        assert [r.node.name for r in to_remove] == ["d0"]
+        valid, rejected = sim.validate_removal_set(
+            snap, to_remove, also_removed=["empty"]
+        )
+        assert valid == []
+        assert [u.node.name for u in rejected] == ["d0"]
+
+    def test_joint_destinations_updated(self):
+        # Both drains feasible jointly, but d1's pod must pick the second
+        # spare once d0's pod takes the first.
+        d0 = build_test_node("d0", cpu_m=1000)
+        d1 = build_test_node("d1", cpu_m=1000)
+        s0 = build_test_node("s0", cpu_m=1000)
+        s1 = build_test_node("s1", cpu_m=1000)
+        p0 = build_test_pod("p0", cpu_m=700)
+        p1 = build_test_pod("p1", cpu_m=700)
+        snap = snapshot_with([d0, d1, s0, s1], [(p0, "d0"), (p1, "d1")])
+        sim = RemovalSimulator()
+        to_remove, _ = sim.find_nodes_to_remove(snap, ["d0", "d1"])
+        valid, rejected = sim.validate_removal_set(snap, to_remove)
+        assert rejected == []
+        dests = {r.node.name: r.destinations for r in valid}
+        targets = {dests["d0"]["default/p0"], dests["d1"]["default/p1"]}
+        assert targets == {"s0", "s1"}  # not double-booked onto one spare
+
+    def test_planner_applies_joint_validation(self):
+        snap = self._drainable_snapshot()
+        # keep the spare out of the candidate set so the scenario stays
+        # "two drains competing for one spare"
+        snap.get_node("spare").annotations[SCALE_DOWN_DISABLED_ANNOTATION] = "true"
+        provider = TestCloudProvider()
+        provider.add_node_group("g", 0, 10, 3, build_test_node("t", cpu_m=1000))
+        for name in ("d0", "d1", "spare"):
+            provider.add_node("g", snap.get_node(name))
+        opts = AutoscalingOptions(max_drain_parallelism=5, max_scale_down_parallelism=10)
+        opts.node_group_defaults.scale_down_unneeded_time_s = 0.0
+        opts.node_group_defaults.scale_down_utilization_threshold = 0.9
+        planner = ScaleDownPlanner(provider, opts)
+        planner.update_cluster_state(snap, list(snap.nodes()), [], now_ts=100.0)
+        plan = planner.nodes_to_delete(snap, now_ts=200.0)
+        drained = [r.node.name for r in plan.drain]
+        assert drained == ["d0"]  # d1 rejected by the joint pass
+        assert any(
+            u.node.name == "d1"
+            and u.reason == UnremovableReason.NO_PLACE_TO_MOVE_PODS
+            for u in plan.unremovable
+        )
+
+
+class TestDaemonSetEviction:
+    """Best-effort DaemonSet eviction at actuation (reference
+    actuation/drain.go:177-188, flags main.go:198-199): default ON for
+    drained nodes, opt-in for empty nodes, failures never block deletion,
+    PDBs not simulated (the eviction API enforces them server-side)."""
+
+    def _world(self, **opt_kw):
+        provider = TestCloudProvider()
+        provider.add_node_group("g", 0, 10, 2, build_test_node("t", cpu_m=1000))
+        d0 = build_test_node("d0", cpu_m=1000)
+        e0 = build_test_node("e0", cpu_m=1000)
+        spare = build_test_node("spare", cpu_m=1000)
+        for n in (d0, e0, spare):
+            provider.add_node("g", n)
+        p0 = build_test_pod("p0", cpu_m=100)
+        ds_d = build_test_pod("ds-d", cpu_m=50)
+        ds_d.daemonset = True
+        ds_e = build_test_pod("ds-e", cpu_m=50)
+        ds_e.daemonset = True
+        snap = snapshot_with(
+            [d0, e0, spare], [(p0, "d0"), (ds_d, "d0"), (ds_e, "e0")]
+        )
+        api = FakeClusterAPI()
+        for n in (d0, e0, spare):
+            api.add_node(n)
+        for p in (p0, ds_d, ds_e):
+            api.add_pod(p)
+        opts = AutoscalingOptions(**opt_kw)
+        opts.node_group_defaults.scale_down_unneeded_time_s = 0.0
+        opts.node_group_defaults.scale_down_utilization_threshold = 0.9
+        return provider, api, snap, opts
+
+    def _plan(self, provider, snap, opts):
+        planner = ScaleDownPlanner(provider, opts)
+        cands = [snap.get_node(n) for n in ("d0", "e0")]
+        planner.update_cluster_state(snap, cands, [], now_ts=100.0)
+        return planner.nodes_to_delete(snap, now_ts=200.0)
+
+    def test_drained_node_ds_pods_evicted_by_default(self):
+        provider, api, snap, opts = self._world()
+        plan = self._plan(provider, snap, opts)
+        assert [r.node.name for r in plan.drain] == ["d0"]
+        assert [p.key() for p in plan.drain[0].daemonset_pods] == ["default/ds-d"]
+        act = ScaleDownActuator(provider, opts, api)
+        res = act.start_deletion(plan, now_ts=300.0)
+        assert "d0" in res.deleted_drain
+        assert "default/ds-d" in res.evicted_pods
+
+    def test_empty_node_ds_pods_not_evicted_by_default(self):
+        provider, api, snap, opts = self._world()
+        plan = self._plan(provider, snap, opts)
+        assert [r.node.name for r in plan.empty] == ["e0"]
+        act = ScaleDownActuator(provider, opts, api)
+        res = act.start_deletion(plan, now_ts=300.0)
+        assert "e0" in res.deleted_empty
+        assert "default/ds-e" not in res.evicted_pods
+
+    def test_empty_node_ds_eviction_opt_in(self):
+        provider, api, snap, opts = self._world(
+            daemonset_eviction_for_empty_nodes=True
+        )
+        plan = self._plan(provider, snap, opts)
+        act = ScaleDownActuator(provider, opts, api)
+        res = act.start_deletion(plan, now_ts=300.0)
+        assert "e0" in res.deleted_empty
+        assert "default/ds-e" in res.evicted_pods
+
+    def test_ds_eviction_failure_does_not_block_deletion(self):
+        provider, api, snap, opts = self._world()
+        api.fail_evictions_for = {"default/ds-d"}
+        plan = self._plan(provider, snap, opts)
+        act = ScaleDownActuator(provider, opts, api)
+        res = act.start_deletion(plan, now_ts=300.0)
+        assert "d0" in res.deleted_drain  # best-effort: failure ignored
+        assert "default/ds-d" not in res.evicted_pods
