@@ -60,7 +60,17 @@ class DebuggingSnapshotter:
                 "tensor_shapes": {
                     "pods": list(tensors.pod_req.shape),
                     "nodes": list(tensors.node_alloc.shape),
-                    "mask": list(tensors.sched_mask.shape),
+                    # stable schema across mask modes: always an object
+                    "mask": (
+                        {"form": "dense", "shape": list(tensors.sched_mask.shape)}
+                        if tensors.sched_mask is not None
+                        else {
+                            "form": "factored",
+                            "class_mask": list(tensors.class_mask.shape),
+                            "exc_rows": list(tensors.exc_rows.shape),
+                            "cell_overrides": int(tensors.cell_pod.shape[0]),
+                        }
+                    ),
                 },
                 "nodes": nodes,
                 "templates": [

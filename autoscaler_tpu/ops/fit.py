@@ -22,12 +22,26 @@ from autoscaler_tpu.snapshot.tensors import SnapshotTensors
 
 def fit_matrix(snap: SnapshotTensors) -> jax.Array:
     """[P, N] bool — pod i fits node j right now (capacity + predicates).
-    Padding rows/cols are False."""
+    Padding rows/cols are False.
+
+    Materializes [P, N]: on factored-mask snapshots beyond the packer's
+    dense-cell limit this is refused — the whole point of the factored form
+    is to never allocate that array; use the tiled ops/pallas_fit.py path
+    (which consumes the class factors directly) for huge worlds."""
+    from autoscaler_tpu.snapshot.packer import DENSE_MASK_CELL_LIMIT
+
+    cells = snap.num_pods * snap.num_nodes
+    if snap.sched_mask is None and cells > DENSE_MASK_CELL_LIMIT:
+        raise ValueError(
+            f"fit_matrix would materialize {cells} cells from a factored-mask "
+            "snapshot; use ops.pallas_fit.pallas_fit_reduce on the class "
+            "factors instead"
+        )
     free = snap.free()  # [N, R], 0 on invalid rows
     fits = jnp.all(snap.pod_req[:, None, :] <= free[None, :, :], axis=-1)
     return (
         fits
-        & snap.sched_mask
+        & snap.dense_sched()  # guarded above: small worlds only when factored
         & snap.pod_valid[:, None]
         & snap.node_valid[None, :]
     )

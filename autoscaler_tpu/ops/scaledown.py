@@ -58,7 +58,7 @@ def _place_pod_step(snap: SnapshotTensors, excluded: jax.Array):
         req = snap.pod_req[safe_idx]
         ok = (
             jnp.all(req[None, :] <= free, axis=-1)
-            & snap.sched_mask[safe_idx]
+            & snap.sched_row(safe_idx)
             & snap.node_valid
             & ~excluded
         )

@@ -40,7 +40,7 @@ def greedy_schedule(
         req = snap.pod_req[safe]
         ok = (
             jnp.all(req[None, :] <= free, axis=-1)
-            & snap.sched_mask[safe]
+            & snap.sched_row(safe)
             & snap.node_valid
         )
         hint_ok = (hint >= 0) & ok[jnp.maximum(hint, 0)]

@@ -102,6 +102,243 @@ def _pod_profile_key(pod: Pod) -> tuple:
     )
 
 
+def _node_port_counts(
+    pods: Sequence[Pod], node_of_pod: Sequence[int]
+) -> Dict[int, Dict[int, int]]:
+    """node index → {host port → count of placed pods occupying it}."""
+    port_count: Dict[int, Dict[int, int]] = {}
+    for i, pod in enumerate(pods):
+        j = node_of_pod[i]
+        if j >= 0:
+            counts = port_count.setdefault(j, {})
+            for p in pod.host_ports:
+                counts[p] = counts.get(p, 0) + 1
+    return port_count
+
+
+def _profile_factorization(
+    nodes: Sequence[Node],
+    pods: Sequence[Pod],
+    node_of_pod: Sequence[int],
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """→ (pod_prof_id [P], node_prof_id [N], prof_mask [CP, CN]) for the
+    class-structured predicates: unschedulable, taints/tolerations,
+    nodeSelector + required node affinity, AND host-port conflicts (the
+    NodePorts filter analog) — a pod's port set × a node's occupied-port
+    profile is class data too, so a 100k-pod host-port DaemonSet costs one
+    profile, not 100k dense rows. The one non-class cell — a placed pod
+    never conflicts with its *own* port on its *own* node — is emitted as a
+    sparse cell override by the callers (_self_port_cell_overrides). Real
+    clusters have a handful of node shapes and pod specs, so this is
+    O(profiles²) host work."""
+    P, N = len(pods), len(nodes)
+    port_count = _node_port_counts(pods, node_of_pod)
+
+    # label keys that can influence any pod's selector/affinity verdict
+    relevant: set = set()
+    for pod in pods:
+        relevant.update(pod.node_selector.keys())
+        if pod.affinity:
+            for term in pod.affinity.node_selector_terms:
+                relevant.update(k for k, _ in term.match_labels)
+                relevant.update(r.key for r in term.match_expressions)
+    relevant_keys = frozenset(relevant)
+
+    node_profiles: Dict[tuple, int] = {}
+    node_prof_id = np.zeros(N, np.int64)
+    node_exemplar: List[Tuple[Node, Dict[int, int]]] = []
+    for j, node in enumerate(nodes):
+        ports = port_count.get(j, {})
+        key = (_node_profile_key(node, relevant_keys), tuple(sorted(ports.items())))
+        pid = node_profiles.setdefault(key, len(node_profiles))
+        node_prof_id[j] = pid
+        if pid == len(node_exemplar):
+            node_exemplar.append((node, ports))
+
+    pod_profiles: Dict[tuple, int] = {}
+    pod_prof_id = np.zeros(P, np.int64)
+    pod_exemplar: List[Pod] = []
+    for i, pod in enumerate(pods):
+        key = (_pod_profile_key(pod), tuple(sorted(pod.host_ports)))
+        pid = pod_profiles.setdefault(key, len(pod_profiles))
+        pod_prof_id[i] = pid
+        if pid == len(pod_exemplar):
+            pod_exemplar.append(pod)
+
+    prof_mask = np.ones((max(len(pod_exemplar), 1), max(len(node_exemplar), 1)), bool)
+    for pi, pod in enumerate(pod_exemplar):
+        for nj, (node, ports) in enumerate(node_exemplar):
+            if node.unschedulable:
+                prof_mask[pi, nj] = False
+            elif not k8s.pod_tolerates_taints(pod, node.taints):
+                prof_mask[pi, nj] = False
+            elif not k8s.node_matches_selector(pod, node):
+                prof_mask[pi, nj] = False
+            elif any(ports.get(p, 0) > 0 for p in pod.host_ports):
+                prof_mask[pi, nj] = False
+    return pod_prof_id, node_prof_id, prof_mask
+
+
+def _class_verdict_no_ports(pod: Pod, node: Node) -> bool:
+    """The class predicates minus the port factor, for one (pod, node)."""
+    return (
+        not node.unschedulable
+        and k8s.pod_tolerates_taints(pod, node.taints)
+        and k8s.node_matches_selector(pod, node)
+    )
+
+
+def _self_port_cell_overrides(
+    nodes: Sequence[Node], pods: Sequence[Pod], node_of_pod: Sequence[int]
+) -> List[Tuple[int, int, bool]]:
+    """→ [(pod_idx, node_idx, value)] corrections for the one cell the port
+    class factor gets wrong: a placed pod's verdict on its OWN node must not
+    count its own port contribution. value = class-verdict-without-ports AND
+    no port on the node is occupied more than once (i.e. by anyone else)."""
+    out: List[Tuple[int, int, bool]] = []
+    port_count = _node_port_counts(pods, node_of_pod)
+    for i, pod in enumerate(pods):
+        j = node_of_pod[i]
+        if j < 0 or not pod.host_ports:
+            continue
+        counts = port_count.get(j, {})
+        conflict = any(counts.get(p, 0) > 1 for p in pod.host_ports)
+        value = _class_verdict_no_ports(pod, nodes[j]) and not conflict
+        out.append((i, j, value))
+    return out
+
+
+class _RowView:
+    """Write-through view over per-pod mask rows. Dense mode wraps the full
+    [P, N] array; factored mode wraps the [E, N] exception-row block with a
+    pod-index → row map, so the same rule code serves both paths."""
+
+    def __init__(self, arr: np.ndarray, row_of: Optional[Dict[int, int]] = None):
+        self.arr = arr
+        self.row_of = row_of
+
+    def has(self, i: int) -> bool:
+        return self.row_of is None or i in self.row_of
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        return self.arr[i if self.row_of is None else self.row_of[i]]
+
+    def __setitem__(self, i: int, v) -> None:
+        self.arr[i if self.row_of is None else self.row_of[i]] = v
+
+
+def _exception_pods(
+    pods: Sequence[Pod], node_of_pod: Sequence[int], interpod: bool
+) -> List[int]:
+    """Pod indices whose mask rows the affinity rules below may modify: pods
+    with inter-pod (anti-)affinity and pods matching a placed pod's
+    anti-affinity term (the symmetric rule). Host ports are NOT here — they
+    are class-structured (see _profile_factorization) apart from sparse
+    self-cell overrides, so a host-port DaemonSet on every node costs O(N)
+    cells, not O(N) dense rows."""
+    exc: set = set()
+    placed_anti: List[Tuple[int, Pod, k8s.PodAffinityTerm]] = []
+    for i, pod in enumerate(pods):
+        if interpod and pod.affinity and (
+            pod.affinity.pod_affinity or pod.affinity.pod_anti_affinity
+        ):
+            exc.add(i)
+        if (
+            interpod
+            and node_of_pod[i] >= 0
+            and pod.affinity is not None
+        ):
+            for term in pod.affinity.pod_anti_affinity:
+                placed_anti.append((i, pod, term))
+    if placed_anti:
+        for i, pod in enumerate(pods):
+            if i in exc:
+                continue
+            for qi, q, term in placed_anti:
+                if i != qi and _term_matches_pod(term, pod, q.namespace):
+                    exc.add(i)
+                    break
+    return sorted(exc)
+
+
+def _apply_row_rules(
+    view: _RowView,
+    nodes: Sequence[Node],
+    pods: Sequence[Pod],
+    node_of_pod: Sequence[int],
+    interpod: bool,
+) -> None:
+    """Apply the inter-pod (anti-)affinity rules vs placed pods to the rows
+    exposed by `view`, in place. Rows not present in the view are skipped —
+    the factored path only materializes exception rows. (Host ports are
+    handled by the class factorization + sparse self-cell overrides, not
+    here.)"""
+    P, N = len(pods), len(nodes)
+
+    if not interpod:
+        return
+
+    # Required inter-pod (anti-)affinity vs already-placed pods, including the
+    # symmetric anti-affinity rule (an existing pod's anti-affinity keeps
+    # matching incomers out of its topology domain). Evaluated per topology
+    # key over integer domain ids — the reference pays a per-(pod,node) plugin
+    # walk here, its documented 1000x outlier (FAQ.md:151-153).
+    placed = [
+        (i, pods[i], node_of_pod[i]) for i in range(P) if node_of_pod[i] >= 0
+    ]
+    domain_cache: Dict[str, Tuple[np.ndarray, Dict[str, int]]] = {}
+
+    def domains_for(key: str):
+        if key not in domain_cache:
+            domain_cache[key] = _topology_domains(nodes, key)
+        return domain_cache[key]
+
+    for i, pod in enumerate(pods):
+        aff = pod.affinity
+        if aff is None or not view.has(i):
+            continue
+        for term in aff.pod_affinity:
+            node_dom, _ = domains_for(term.topology_key)
+            ok_domains = {
+                node_dom[j]
+                for (_, q, j) in placed
+                if node_dom[j] >= 0 and _term_matches_pod(term, q, pod.namespace)
+            }
+            if _term_matches_pod(term, pod, pod.namespace):
+                # Kubernetes self-match rule: a pod may satisfy its own
+                # required affinity term, so the first pod of a self-affine
+                # group can land on any node with the topology label.
+                allowed = node_dom >= 0
+            else:
+                allowed = np.isin(node_dom, list(ok_domains)) & (node_dom >= 0)
+            view[i] = view[i] & allowed
+        for term in aff.pod_anti_affinity:
+            node_dom, _ = domains_for(term.topology_key)
+            bad_domains = {
+                node_dom[j]
+                for (qi, q, j) in placed
+                if qi != i and node_dom[j] >= 0
+                and _term_matches_pod(term, q, pod.namespace)
+            }
+            if bad_domains:
+                view[i] = view[i] & ~np.isin(node_dom, list(bad_domains))
+
+    # Symmetric anti-affinity from placed pods onto everyone (except the
+    # declaring pod itself — its own term must not evict it from the node it
+    # validly runs on).
+    for (qi, q, j) in placed:
+        if q.affinity is None:
+            continue
+        for term in q.affinity.pod_anti_affinity:
+            node_dom, _ = domains_for(term.topology_key)
+            if node_dom[j] < 0:
+                continue
+            in_domain = node_dom == node_dom[j]
+            for i, pod in enumerate(pods):
+                if i != qi and view.has(i) and _term_matches_pod(term, pod, q.namespace):
+                    view[i] = view[i] & ~in_domain
+
+
 def compute_sched_mask(
     nodes: Sequence[Node],
     pods: Sequence[Pod],
@@ -123,134 +360,91 @@ def compute_sched_mask(
     device (ops/pallas_fit.py)."""
     P, N = len(pods), len(nodes)
     mask = np.ones((P, N), dtype=bool)
-
-    # label keys that can influence any pod's selector/affinity verdict
-    relevant: set = set()
-    for pod in pods:
-        relevant.update(pod.node_selector.keys())
-        if pod.affinity:
-            for term in pod.affinity.node_selector_terms:
-                relevant.update(k for k, _ in term.match_labels)
-                relevant.update(r.key for r in term.match_expressions)
-    relevant_keys = frozenset(relevant)
-
-    node_profiles: Dict[tuple, int] = {}
-    node_prof_id = np.zeros(N, np.int64)
-    node_exemplar: List[Node] = []
-    for j, node in enumerate(nodes):
-        key = _node_profile_key(node, relevant_keys)
-        pid = node_profiles.setdefault(key, len(node_profiles))
-        node_prof_id[j] = pid
-        if pid == len(node_exemplar):
-            node_exemplar.append(node)
-
-    pod_profiles: Dict[tuple, int] = {}
-    pod_prof_id = np.zeros(P, np.int64)
-    pod_exemplar: List[Pod] = []
-    for i, pod in enumerate(pods):
-        key = _pod_profile_key(pod)
-        pid = pod_profiles.setdefault(key, len(pod_profiles))
-        pod_prof_id[i] = pid
-        if pid == len(pod_exemplar):
-            pod_exemplar.append(pod)
-
-    prof_mask = np.ones((len(pod_exemplar), len(node_exemplar)), bool)
-    for pi, pod in enumerate(pod_exemplar):
-        for nj, node in enumerate(node_exemplar):
-            if node.unschedulable:
-                prof_mask[pi, nj] = False
-            elif not k8s.pod_tolerates_taints(pod, node.taints):
-                prof_mask[pi, nj] = False
-            elif not k8s.node_matches_selector(pod, node):
-                prof_mask[pi, nj] = False
+    pod_prof_id, node_prof_id, prof_mask = _profile_factorization(
+        nodes, pods, node_of_pod
+    )
     if P and N:
         mask = prof_mask[pod_prof_id][:, node_prof_id]
-
-    # Host-port conflicts (NodePorts filter plugin analog). Rows are computed
-    # for placed pods too so drain/rescheduling simulation sees conflicts; a
-    # pod never conflicts with its own port on its own node.
-    port_count: Dict[int, Dict[int, int]] = {}
-    for i, pod in enumerate(pods):
-        j = node_of_pod[i]
-        if j >= 0:
-            counts = port_count.setdefault(j, {})
-            for p in pod.host_ports:
-                counts[p] = counts.get(p, 0) + 1
-    for i, pod in enumerate(pods):
-        if not pod.host_ports:
-            continue
-        own = node_of_pod[i]
-        for j in range(N):
-            counts = port_count.get(j)
-            if not counts:
-                continue
-            self_contrib = 1 if j == own else 0
-            if any(counts.get(p, 0) > self_contrib for p in pod.host_ports):
-                mask[i, j] = False
-
-    if not interpod:
-        return mask
-
-    # Required inter-pod (anti-)affinity vs already-placed pods, including the
-    # symmetric anti-affinity rule (an existing pod's anti-affinity keeps
-    # matching incomers out of its topology domain). Evaluated per topology
-    # key over integer domain ids — the reference pays a per-(pod,node) plugin
-    # walk here, its documented 1000x outlier (FAQ.md:151-153).
-    placed = [
-        (i, pods[i], node_of_pod[i]) for i in range(P) if node_of_pod[i] >= 0
-    ]
-    domain_cache: Dict[str, Tuple[np.ndarray, Dict[str, int]]] = {}
-
-    def domains_for(key: str):
-        if key not in domain_cache:
-            domain_cache[key] = _topology_domains(nodes, key)
-        return domain_cache[key]
-
-    for i, pod in enumerate(pods):
-        aff = pod.affinity
-        if aff is None:
-            continue
-        for term in aff.pod_affinity:
-            node_dom, _ = domains_for(term.topology_key)
-            ok_domains = {
-                node_dom[j]
-                for (_, q, j) in placed
-                if node_dom[j] >= 0 and _term_matches_pod(term, q, pod.namespace)
-            }
-            if _term_matches_pod(term, pod, pod.namespace):
-                # Kubernetes self-match rule: a pod may satisfy its own
-                # required affinity term, so the first pod of a self-affine
-                # group can land on any node with the topology label.
-                allowed = node_dom >= 0
-            else:
-                allowed = np.isin(node_dom, list(ok_domains)) & (node_dom >= 0)
-            mask[i] &= allowed
-        for term in aff.pod_anti_affinity:
-            node_dom, _ = domains_for(term.topology_key)
-            bad_domains = {
-                node_dom[j]
-                for (qi, q, j) in placed
-                if qi != i and node_dom[j] >= 0
-                and _term_matches_pod(term, q, pod.namespace)
-            }
-            if bad_domains:
-                mask[i] &= ~np.isin(node_dom, list(bad_domains))
-
-    # Symmetric anti-affinity from placed pods onto everyone (except the
-    # declaring pod itself — its own term must not evict it from the node it
-    # validly runs on).
-    for (qi, q, j) in placed:
-        if q.affinity is None:
-            continue
-        for term in q.affinity.pod_anti_affinity:
-            node_dom, _ = domains_for(term.topology_key)
-            if node_dom[j] < 0:
-                continue
-            in_domain = node_dom == node_dom[j]
-            for i, pod in enumerate(pods):
-                if i != qi and _term_matches_pod(term, pod, q.namespace):
-                    mask[i] &= ~in_domain
+    for i, j, value in _self_port_cell_overrides(nodes, pods, node_of_pod):
+        mask[i, j] = value
+    _apply_row_rules(_RowView(mask), nodes, pods, node_of_pod, interpod)
     return mask
+
+
+@dataclass
+class FactoredMask:
+    """Class-factorized predicate mask: the scalable alternative to the dense
+    [P, N] array (SnapshotTensors docstring). Exact — affinity exception
+    pods carry full dense rows; placed host-port pods carry one-cell
+    overrides (their own-node self-contribution correction)."""
+
+    pod_class: np.ndarray   # [P] i64
+    node_class: np.ndarray  # [N] i64
+    class_mask: np.ndarray  # [CP, CN] bool
+    exc_rows: np.ndarray    # [E, N] bool
+    pod_exc: np.ndarray     # [P] i32, -1 = class-only
+    cell_pod: np.ndarray    # [K] i32 — COO overrides (pod, node) → value
+    cell_node: np.ndarray   # [K] i32
+    cell_val: np.ndarray    # [K] bool
+
+
+def compute_factored_mask(
+    nodes: Sequence[Node],
+    pods: Sequence[Pod],
+    node_of_pod: Sequence[int],
+    interpod: bool = True,
+) -> FactoredMask:
+    """Same semantics as compute_sched_mask without materializing [P, N]:
+    class verdicts per (pod-profile × node-profile), dense rows only for the
+    affinity exception pods (_exception_pods), sparse cell overrides for
+    placed host-port pods. Host cost is O(profiles² + E·N + K)."""
+    P, N = len(pods), len(nodes)
+    pod_prof_id, node_prof_id, prof_mask = _profile_factorization(
+        nodes, pods, node_of_pod
+    )
+    overrides = _self_port_cell_overrides(nodes, pods, node_of_pod)
+    exc = _exception_pods(pods, node_of_pod, interpod)
+    E = len(exc)
+    exc_rows = np.zeros((max(E, 1), N), bool)
+    row_of = {i: e for e, i in enumerate(exc)}
+    for i, e in row_of.items():
+        exc_rows[e] = prof_mask[pod_prof_id[i]][node_prof_id]
+    # overrides for pods that have full exception rows bake into the row
+    # (before the &=-only affinity rules); the rest stay sparse
+    coo: List[Tuple[int, int, bool]] = []
+    for i, j, value in overrides:
+        if i in row_of:
+            exc_rows[row_of[i], j] = value
+        else:
+            coo.append((i, j, value))
+    _apply_row_rules(
+        _RowView(exc_rows, row_of), nodes, pods, node_of_pod, interpod
+    )
+    pod_exc = np.full(P, -1, np.int32)
+    for i, e in row_of.items():
+        pod_exc[i] = e
+    K = len(coo)
+    cell_pod = np.full(max(K, 1), -1, np.int32)
+    cell_node = np.zeros(max(K, 1), np.int32)
+    cell_val = np.zeros(max(K, 1), bool)
+    for k, (i, j, value) in enumerate(coo):
+        cell_pod[k], cell_node[k], cell_val[k] = i, j, value
+    return FactoredMask(
+        pod_class=pod_prof_id,
+        node_class=node_prof_id,
+        class_mask=prof_mask,
+        exc_rows=exc_rows,
+        pod_exc=pod_exc,
+        cell_pod=cell_pod,
+        cell_node=cell_node,
+        cell_val=cell_val,
+    )
+
+
+# Above this many (padded pods × padded nodes) cells the packer switches to
+# the factored mask: 2^24 cells = 16MB of bool, well under one fit-kernel
+# tile pass; a 100k × 15k world (1.5G cells) never materializes.
+DENSE_MASK_CELL_LIMIT = 1 << 24
 
 
 def pack(
@@ -259,11 +453,15 @@ def pack(
     group_of_node: Optional[Dict[str, str]] = None,
     pad_pods: Optional[int] = None,
     pad_nodes: Optional[int] = None,
+    dense_mask: Optional[bool] = None,
 ) -> Tuple[SnapshotTensors, SnapshotMeta]:
     """Flatten objects into a padded SnapshotTensors + host-side meta.
 
     group_of_node: node name → node-group name (from the cloud provider's
     NodeGroupForNode mapping, reference cloudprovider/cloud_provider.go:112).
+    dense_mask: True → always emit the dense [P, N] sched_mask; False →
+    always emit the factored form; None (default) → dense up to
+    DENSE_MASK_CELL_LIMIT cells, factored beyond.
     """
     meta = SnapshotMeta(nodes=list(nodes), pods=list(pods))
     for i, node in enumerate(meta.nodes):
@@ -283,6 +481,9 @@ def pack(
     assert PP >= P and NN >= N, "padding must not truncate"
     R = NUM_RESOURCES
 
+    if dense_mask is None:
+        dense_mask = PP * NN <= DENSE_MASK_CELL_LIMIT
+
     node_alloc = np.zeros((NN, R), np.float32)
     node_used = np.zeros((NN, R), np.float32)
     node_valid = np.zeros((NN,), bool)
@@ -290,7 +491,6 @@ def pack(
     pod_req = np.zeros((PP, R), np.float32)
     pod_valid = np.zeros((PP,), bool)
     pod_node = np.full((PP,), -1, np.int32)
-    sched_mask = np.zeros((PP, NN), bool)
 
     node_of_pod = []
     for i, pod in enumerate(meta.pods):
@@ -311,10 +511,7 @@ def pack(
         if j >= 0:
             node_used[j] += pod_req[i]
 
-    if P and N:
-        sched_mask[:P, :N] = compute_sched_mask(meta.nodes, meta.pods, node_of_pod)
-
-    tensors = SnapshotTensors(
+    common = dict(
         node_alloc=jnp.asarray(node_alloc),
         node_used=jnp.asarray(node_used),
         node_valid=jnp.asarray(node_valid),
@@ -322,6 +519,46 @@ def pack(
         pod_req=jnp.asarray(pod_req),
         pod_valid=jnp.asarray(pod_valid),
         pod_node=jnp.asarray(pod_node),
-        sched_mask=jnp.asarray(sched_mask),
     )
+    if dense_mask:
+        sched_mask = np.zeros((PP, NN), bool)
+        if P and N:
+            sched_mask[:P, :N] = compute_sched_mask(meta.nodes, meta.pods, node_of_pod)
+        tensors = SnapshotTensors(sched_mask=jnp.asarray(sched_mask), **common)
+    else:
+        fm = compute_factored_mask(meta.nodes, meta.pods, node_of_pod)
+        CP, CN = fm.class_mask.shape
+        CPP, CNN = bucket_size(CP, minimum=8), bucket_size(CN, minimum=8)
+        E = fm.exc_rows.shape[0]
+        EE = bucket_size(E, minimum=1)
+        class_mask = np.zeros((CPP, CNN), bool)
+        class_mask[:CP, :CN] = fm.class_mask
+        exc_rows = np.zeros((EE, NN), bool)
+        exc_rows[:E, :N] = fm.exc_rows
+        pod_class = np.full((PP,), -1, np.int64)
+        pod_class[:P] = fm.pod_class
+        node_class = np.full((NN,), -1, np.int64)
+        node_class[:N] = fm.node_class
+        pod_exc = np.full((PP,), -1, np.int32)
+        pod_exc[:P] = fm.pod_exc
+        K = fm.cell_pod.shape[0]
+        KK = bucket_size(K, minimum=1)
+        cell_pod = np.full((KK,), -1, np.int32)
+        cell_pod[:K] = fm.cell_pod
+        cell_node = np.zeros((KK,), np.int32)
+        cell_node[:K] = fm.cell_node
+        cell_val = np.zeros((KK,), bool)
+        cell_val[:K] = fm.cell_val
+        tensors = SnapshotTensors(
+            sched_mask=None,
+            pod_class=jnp.asarray(pod_class.astype(np.int32)),
+            node_class=jnp.asarray(node_class.astype(np.int32)),
+            class_mask=jnp.asarray(class_mask),
+            exc_rows=jnp.asarray(exc_rows),
+            pod_exc=jnp.asarray(pod_exc),
+            cell_pod=jnp.asarray(cell_pod),
+            cell_node=jnp.asarray(cell_node),
+            cell_val=jnp.asarray(cell_val),
+            **common,
+        )
     return tensors, meta

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -37,13 +38,35 @@ class SnapshotTensors:
     - pod_req:     [P, R] f32 — per-pod resource requests (pods axis == 1)
     - pod_valid:   [P]    bool
     - pod_node:    [P]    i32  — node index the pod is scheduled on, -1 pending
-    - sched_mask:  [P, N] bool — precomputed non-resource predicates
+    - sched_mask:  [P, N] bool | None — precomputed non-resource predicates
       (taints/tolerations, nodeSelector, required node affinity, static
       inter-pod (anti-)affinity vs. already-placed pods, unschedulable flag);
       replaces the reference's RunPreFilterPlugins/RunFilterPlugins walk
       (cluster-autoscaler/simulator/predicatechecker/schedulerbased.go:152-163)
       for everything except the resource-fit arithmetic, which stays dynamic in
       the fit kernel because node_used changes during simulation.
+
+    Above the dense-mask scale limit (the reference benchmarks snapshots to
+    100k nodes, clustersnapshot_benchmark_test.go:71; a [100k, 15k] bool is
+    ~1.5GB), the packer emits the *factored* form instead and sched_mask is
+    None:
+
+    - pod_class:   [P] i32 — pod predicate-profile id (-1 padding)
+    - node_class:  [N] i32 — node profile id (-1 padding)
+    - class_mask:  [CP, CN] bool — verdict per (pod-profile, node-profile);
+      real clusters have a handful of profiles, so this is tiny
+    - exc_rows:    [E, N] bool — full dense rows for the few "exception" pods
+      whose verdict is not class-structured (inter-pod affinity holders and
+      targets of placed pods' anti-affinity)
+    - pod_exc:     [P] i32 — exception-row index per pod, -1 = class-only
+    - cell_pod/cell_node/cell_val: [K] COO single-cell overrides — a placed
+      host-port pod's verdict on its OWN node ignores its own port
+      contribution, which the port class factor cannot express; one cell per
+      placed port-pod (cell_pod = -1 on padding entries)
+
+    Access the mask through sched_row()/dense_sched(), which handle both
+    forms; kernels that tile (pod x node) without materializing use the
+    factors directly (ops/pallas_fit.py).
     """
 
     node_alloc: jax.Array
@@ -53,7 +76,15 @@ class SnapshotTensors:
     pod_req: jax.Array
     pod_valid: jax.Array
     pod_node: jax.Array
-    sched_mask: jax.Array
+    sched_mask: Optional[jax.Array] = None
+    pod_class: Optional[jax.Array] = None
+    node_class: Optional[jax.Array] = None
+    class_mask: Optional[jax.Array] = None
+    exc_rows: Optional[jax.Array] = None
+    pod_exc: Optional[jax.Array] = None
+    cell_pod: Optional[jax.Array] = None
+    cell_node: Optional[jax.Array] = None
+    cell_val: Optional[jax.Array] = None
 
     @property
     def num_nodes(self) -> int:
@@ -68,6 +99,44 @@ class SnapshotTensors:
         return jnp.where(
             self.node_valid[:, None], self.node_alloc - self.node_used, 0.0
         )
+
+    def sched_row(self, pod_idx: jax.Array) -> jax.Array:
+        """[N] bool — one pod's non-resource predicate verdicts. Traceable;
+        works for both the dense and the factored mask form."""
+        if self.sched_mask is not None:
+            return self.sched_mask[pod_idx]
+        pc = self.pod_class[pod_idx]
+        row_c = self.class_mask[jnp.maximum(pc, 0)]            # [CN]
+        nc = self.node_class
+        base = row_c[jnp.maximum(nc, 0)] & (nc >= 0) & (pc >= 0)
+        # sparse single-cell overrides targeting this pod (dropped otherwise)
+        sel = self.cell_pod == pod_idx
+        base = base.at[jnp.where(sel, self.cell_node, self.num_nodes)].set(
+            jnp.where(sel, self.cell_val, False), mode="drop"
+        )
+        e = self.pod_exc[pod_idx]
+        exc = self.exc_rows[jnp.maximum(e, 0)]
+        return jnp.where(e >= 0, exc, base)
+
+    def dense_sched(self) -> jax.Array:
+        """[P, N] bool — materialize the full mask. Cheap passthrough in
+        dense form; in factored form this expands classes + exception rows
+        and should only be used on worlds small enough to hold [P, N] (the
+        tiled kernels consume the factors instead)."""
+        if self.sched_mask is not None:
+            return self.sched_mask
+        base = self.class_mask[jnp.maximum(self.pod_class, 0)][
+            :, jnp.maximum(self.node_class, 0)
+        ]
+        base &= (self.pod_class >= 0)[:, None] & (self.node_class >= 0)[None, :]
+        ok = self.cell_pod >= 0
+        base = base.at[
+            jnp.where(ok, self.cell_pod, self.num_pods),
+            jnp.where(ok, self.cell_node, self.num_nodes),
+        ].set(jnp.where(ok, self.cell_val, False), mode="drop")
+        has_exc = self.pod_exc >= 0
+        exc = self.exc_rows[jnp.maximum(self.pod_exc, 0)]
+        return jnp.where(has_exc[:, None], exc, base)
 
     def schedule_pod(self, pod_idx: jax.Array, node_idx: jax.Array) -> "SnapshotTensors":
         """Functionally assign pod→node, updating node_used. Traceable."""

@@ -1,0 +1,208 @@
+"""Factored predicate mask (pod_class x node_class -> class_mask + exception
+rows) must agree exactly with the dense [P, N] mask on every fixture — this
+is the packer path that scales past the reference's 100k-node benchmark grid
+(clustersnapshot_benchmark_test.go:71) without materializing ~GB of bool.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from autoscaler_tpu.kube.objects import Taint, Toleration
+from autoscaler_tpu.ops.schedule import greedy_schedule
+from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+from autoscaler_tpu.snapshot.packer import (
+    DENSE_MASK_CELL_LIMIT,
+    compute_factored_mask,
+    compute_sched_mask,
+    pack,
+)
+from autoscaler_tpu.utils.test_utils import (
+    anti_affinity,
+    build_test_node,
+    build_test_pod,
+    pod_affinity,
+)
+
+
+def expand(fm, P, N):
+    """Densify a FactoredMask for comparison."""
+    mask = fm.class_mask[fm.pod_class][:, fm.node_class]
+    for k in range(fm.cell_pod.shape[0]):
+        if fm.cell_pod[k] >= 0:
+            mask[fm.cell_pod[k], fm.cell_node[k]] = fm.cell_val[k]
+    for i in range(P):
+        if fm.pod_exc[i] >= 0:
+            mask[i] = fm.exc_rows[fm.pod_exc[i]]
+    return mask
+
+
+def world(seed, P=40, N=12):
+    """Random fixture exercising every rule family: taints, selectors,
+    unschedulable, host ports, placed + pending affinity."""
+    rng = np.random.default_rng(seed)
+    nodes = []
+    for j in range(N):
+        labels = {"zone": f"z{j % 3}", "pool": f"p{j % 2}"}
+        taints = [Taint("dedicated", "a", "NoSchedule")] if j % 4 == 0 else []
+        n = build_test_node(f"n{j}", cpu_m=4000, labels=labels, taints=taints)
+        n.unschedulable = j % 7 == 6
+        nodes.append(n)
+    pods = []
+    node_of_pod = []
+    for i in range(P):
+        kw = {}
+        if i % 5 == 0:
+            kw["node_selector"] = {"pool": f"p{i % 2}"}
+        if i % 4 == 0:
+            kw["tolerations"] = [Toleration(key="dedicated", value="a")]
+        if i % 6 == 3:
+            kw["affinity"] = anti_affinity({"app": f"a{i % 3}"})
+        if i % 6 == 5:
+            kw["affinity"] = pod_affinity({"app": f"a{i % 3}"}, topology_key="zone")
+        pod = build_test_pod(
+            f"pod{i}", cpu_m=100, labels={"app": f"a{i % 3}"}, **kw
+        )
+        if i % 9 == 1:
+            pod.host_ports = (8080,)
+        placed = rng.random() < 0.5
+        node_of_pod.append(int(rng.integers(0, N)) if placed else -1)
+        pods.append(pod)
+    return nodes, pods, node_of_pod
+
+
+class TestFactoredParity:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_factored_equals_dense(self, seed):
+        nodes, pods, node_of_pod = world(seed)
+        dense = compute_sched_mask(nodes, pods, node_of_pod)
+        fm = compute_factored_mask(nodes, pods, node_of_pod)
+        np.testing.assert_array_equal(
+            expand(fm, len(pods), len(nodes)), dense, err_msg=f"seed {seed}"
+        )
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_factored_parity_without_interpod(self, seed):
+        nodes, pods, node_of_pod = world(seed)
+        dense = compute_sched_mask(nodes, pods, node_of_pod, interpod=False)
+        fm = compute_factored_mask(nodes, pods, node_of_pod, interpod=False)
+        np.testing.assert_array_equal(expand(fm, len(pods), len(nodes)), dense)
+
+    def test_exception_rows_are_sparse(self):
+        # plain pods (no ports/affinity) should produce zero exceptions
+        nodes = [build_test_node(f"n{j}") for j in range(4)]
+        pods = [build_test_pod(f"p{i}") for i in range(16)]
+        fm = compute_factored_mask(nodes, pods, [-1] * 16)
+        assert (fm.pod_exc == -1).all()
+
+
+class TestPackModes:
+    def test_pack_auto_switches_to_factored(self):
+        nodes = [build_test_node(f"n{j}") for j in range(3)]
+        pods = [build_test_pod(f"p{i}") for i in range(5)]
+        t_dense, _ = pack(nodes, pods, dense_mask=True)
+        t_fact, _ = pack(nodes, pods, dense_mask=False)
+        assert t_dense.sched_mask is not None
+        assert t_fact.sched_mask is None
+        np.testing.assert_array_equal(
+            np.asarray(t_fact.dense_sched()), np.asarray(t_dense.sched_mask)
+        )
+
+    def test_dense_sched_matches_across_modes_with_rules(self):
+        nodes, pods, node_of_pod = world(11, P=30, N=10)
+        for i, pod in enumerate(pods):
+            pod.node_name = nodes[node_of_pod[i]].name if node_of_pod[i] >= 0 else ""
+        t_dense, _ = pack(nodes, pods, dense_mask=True)
+        t_fact, _ = pack(nodes, pods, dense_mask=False)
+        np.testing.assert_array_equal(
+            np.asarray(t_fact.dense_sched()), np.asarray(t_dense.sched_mask)
+        )
+
+    def test_sched_row_gather(self):
+        nodes, pods, node_of_pod = world(3, P=20, N=8)
+        for i, pod in enumerate(pods):
+            pod.node_name = nodes[node_of_pod[i]].name if node_of_pod[i] >= 0 else ""
+        t_fact, meta = pack(nodes, pods, dense_mask=False)
+        dense = np.asarray(t_fact.dense_sched())
+        for i in (0, 3, 7, 19):
+            np.testing.assert_array_equal(
+                np.asarray(t_fact.sched_row(jnp.int32(i))), dense[i]
+            )
+
+    def test_kernels_run_in_factored_mode(self):
+        # greedy_schedule via sched_row must behave identically in both modes
+        nodes = [build_test_node(f"n{j}", cpu_m=1000) for j in range(4)]
+        nodes[0].taints = [Taint("dedicated", "x", "NoSchedule")]
+        pods = [build_test_pod(f"p{i}", cpu_m=400) for i in range(6)]
+        for mode in (True, False):
+            t, meta = pack(nodes, pods, dense_mask=mode)
+            slots = jnp.arange(6, dtype=jnp.int32)
+            hints = jnp.full((6,), -1, jnp.int32)
+            res = greedy_schedule(t, slots, hints)
+            placed = np.asarray(res.placed)
+            dest = np.asarray(res.dest)
+            # node 0 is tainted: 3 untainted nodes x 2 pods each = 6 placed
+            assert placed.sum() == 6
+            assert 0 not in dest[placed]
+
+    def test_auto_threshold(self):
+        # tiny world stays dense by default
+        nodes = [build_test_node("n0")]
+        pods = [build_test_pod("p0")]
+        t, _ = pack(nodes, pods)
+        assert t.sched_mask is not None
+        assert DENSE_MASK_CELL_LIMIT == 1 << 24
+
+
+class TestHostPortScaling:
+    def test_hostport_daemonset_stays_class_structured(self):
+        # A host-port DaemonSet pod on EVERY node (the node-exporter pattern)
+        # must not explode into per-pod dense exception rows: port verdicts
+        # are class data; only the self-cell corrections are per-pod (COO).
+        N = 50
+        nodes = [build_test_node(f"n{j}", cpu_m=4000) for j in range(N)]
+        pods = []
+        node_of_pod = []
+        for j in range(N):
+            ds = build_test_pod(f"ds-{j}", cpu_m=50)
+            ds.host_ports = (9100,)
+            ds.daemonset = True
+            pods.append(ds)
+            node_of_pod.append(j)
+        pending = build_test_pod("web", cpu_m=100)
+        pending.host_ports = (9100,)
+        pods.append(pending)
+        node_of_pod.append(-1)
+        fm = compute_factored_mask(nodes, pods, node_of_pod)
+        assert (fm.pod_exc == -1).all()          # zero dense rows
+        assert (fm.cell_pod >= 0).sum() == N     # one override per placed pod
+        dense = compute_sched_mask(nodes, pods, node_of_pod)
+        np.testing.assert_array_equal(expand(fm, len(pods), N), dense)
+        # semantics: the pending port pod fits nowhere; each DS pod still
+        # "fits" its own node (self-contribution ignored)
+        assert not dense[N].any()
+        for j in range(N):
+            assert dense[j, j]
+            assert not dense[j, (j + 1) % N]
+
+
+class TestFactoredScale:
+    def test_large_world_packs_without_dense_mask(self):
+        # 20k pods x 2k nodes = 40M cells: over the dense limit. The pack
+        # must stay factored and fast (no [P, N] materialization).
+        import time
+
+        P, N = 20_000, 2_000
+        nodes = [
+            build_test_node(f"n{j}", cpu_m=4000, labels={"zone": f"z{j % 3}"})
+            for j in range(N)
+        ]
+        pods = [
+            build_test_pod(f"p{i}", cpu_m=100, labels={"app": f"a{i % 5}"})
+            for i in range(P)
+        ]
+        t0 = time.monotonic()
+        t, meta = pack(nodes, pods)
+        dt = time.monotonic() - t0
+        assert t.sched_mask is None
+        assert t.class_mask.shape[0] <= 8  # handful of profiles
+        assert dt < 30.0, f"pack took {dt:.1f}s"
