@@ -20,22 +20,28 @@ import jax.numpy as jnp
 from autoscaler_tpu.snapshot.tensors import SnapshotTensors
 
 
+def _factored_too_big(snap: SnapshotTensors) -> bool:
+    from autoscaler_tpu.snapshot.packer import DENSE_MASK_CELL_LIMIT
+
+    return (
+        snap.sched_mask is None
+        and snap.num_pods * snap.num_nodes > DENSE_MASK_CELL_LIMIT
+    )
+
+
 def fit_matrix(snap: SnapshotTensors) -> jax.Array:
     """[P, N] bool — pod i fits node j right now (capacity + predicates).
     Padding rows/cols are False.
 
     Materializes [P, N]: on factored-mask snapshots beyond the packer's
     dense-cell limit this is refused — the whole point of the factored form
-    is to never allocate that array; use the tiled ops/pallas_fit.py path
-    (which consumes the class factors directly) for huge worlds."""
-    from autoscaler_tpu.snapshot.packer import DENSE_MASK_CELL_LIMIT
-
-    cells = snap.num_pods * snap.num_nodes
-    if snap.sched_mask is None and cells > DENSE_MASK_CELL_LIMIT:
+    is to never allocate that array; use ops.pallas_fit.fit_reduce_exact
+    (tiled, full mask semantics) for huge worlds."""
+    if _factored_too_big(snap):
         raise ValueError(
-            f"fit_matrix would materialize {cells} cells from a factored-mask "
-            "snapshot; use ops.pallas_fit.pallas_fit_reduce on the class "
-            "factors instead"
+            f"fit_matrix would materialize {snap.num_pods * snap.num_nodes} "
+            "cells from a factored-mask snapshot; use "
+            "ops.pallas_fit.fit_reduce_exact on the snapshot instead"
         )
     free = snap.free()  # [N, R], 0 on invalid rows
     fits = jnp.all(snap.pod_req[:, None, :] <= free[None, :, :], axis=-1)
@@ -49,7 +55,12 @@ def fit_matrix(snap: SnapshotTensors) -> jax.Array:
 
 def fits_any_node(snap: SnapshotTensors) -> jax.Array:
     """[P] bool — the FitsAnyNodeMatching analog
-    (reference: simulator/predicatechecker/schedulerbased.go:90)."""
+    (reference: simulator/predicatechecker/schedulerbased.go:90). Huge
+    factored-mask worlds route through the tiled kernel automatically."""
+    if _factored_too_big(snap):
+        from autoscaler_tpu.ops.pallas_fit import fit_reduce_exact
+
+        return fit_reduce_exact(snap).any_fit
     return fit_matrix(snap).any(axis=1)
 
 
@@ -58,6 +69,10 @@ def first_fit_node(snap: SnapshotTensors) -> jax.Array:
     deterministic analog of CheckPredicates over a candidate list; callers
     that place pods must re-fit after each placement (see ops/binpack.py for
     the sequential-correct scan)."""
+    if _factored_too_big(snap):
+        from autoscaler_tpu.ops.pallas_fit import fit_reduce_exact
+
+        return fit_reduce_exact(snap).first_fit
     fits = fit_matrix(snap)
     idx = jnp.argmax(fits, axis=1).astype(jnp.int32)
     return jnp.where(fits.any(axis=1), idx, -1)

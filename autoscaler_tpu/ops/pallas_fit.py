@@ -15,9 +15,10 @@ Non-resource predicates enter as an equivalence-class factorization:
 pod_class[P] x node_class[N] -> class_mask[CP, CN]. The [TP, TN] tile of the
 mask is reconstructed on the MXU as onehot(pod_class) @ class_mask @
 onehot(node_class)^T — two small matmuls instead of a 1.5GB boolean tensor.
-(Taints/selectors/zones are class-structured; per-pod exceptions like
-inter-pod affinity stay on the exact dense path, ops/fit.py, which handles
-every cluster the reference's SLOs cover.)
+(Taints/selectors/zones are class-structured; the few per-pod exceptions —
+inter-pod affinity rows, placed host-port self-cells — are patched exactly
+on top of the kernel output by fit_reduce_exact, so the tiled path keeps
+full mask semantics at any scale.)
 """
 from __future__ import annotations
 
@@ -180,6 +181,73 @@ def pallas_fit_reduce(
         any_fit=any_fit,
         fit_count=count_o[:P, 0],
         first_fit=jnp.where(any_fit, first, -1),
+    )
+
+
+def fit_reduce_exact(snap, tp: int = 256, tn: int = 512, interpret=None) -> FitReduction:
+    """Tiled (P × N) fit reduction over a SnapshotTensors with EXACT mask
+    semantics. The Pallas kernel reduces the class-structured bulk; the few
+    pods whose true rows deviate from the pure class factorization — affinity
+    exception pods (exc_rows) and placed host-port pods carrying COO
+    self-cell overrides — are re-reduced exactly from sched_row() and patched
+    into the outputs. This is the huge-world entry point fit_matrix's guard
+    points at: same verdicts as the dense path, never materializing [P, N].
+
+    Dense-mask snapshots are handled too (direct XLA reduction — worlds small
+    enough for a dense mask don't need the tiled kernel)."""
+    free = snap.free()
+    if snap.sched_mask is not None:
+        fits = jnp.all(snap.pod_req[:, None, :] <= free[None, :, :], axis=-1)
+        fits &= snap.sched_mask & snap.pod_valid[:, None] & snap.node_valid[None, :]
+        any_fit = fits.any(axis=1)
+        first = jnp.argmax(fits, axis=1).astype(jnp.int32)
+        return FitReduction(
+            any_fit=any_fit,
+            fit_count=jnp.sum(fits, axis=1, dtype=jnp.int32),
+            first_fit=jnp.where(any_fit, first, -1),
+        )
+
+    base = pallas_fit_reduce(
+        snap.pod_req,
+        free,
+        snap.pod_class.astype(jnp.int32),
+        snap.node_class.astype(jnp.int32),
+        snap.class_mask,
+        snap.node_valid,
+        tp=tp,
+        tn=tn,
+        interpret=interpret,
+    )
+
+    # Pods the class factors get wrong: exception-row holders + COO override
+    # targets. Both sets have static bounds (E rows, K cells), so the patch
+    # is a fixed-size vmap + scatter, traceable under jit.
+    E = snap.exc_rows.shape[0]
+    exc_idx = jnp.nonzero(snap.pod_exc >= 0, size=E, fill_value=-1)[0]
+    special = jnp.concatenate(
+        [exc_idx.astype(jnp.int32), snap.cell_pod.astype(jnp.int32)]
+    )
+    node_ids = jnp.arange(snap.num_nodes, dtype=jnp.int32)
+
+    def row_reduce(p):
+        safe = jnp.maximum(p, 0)
+        row = (
+            snap.sched_row(safe)
+            & snap.node_valid
+            & (p >= 0)
+            & snap.pod_valid[safe]
+        )
+        fitr = jnp.all(snap.pod_req[safe][None, :] <= free, axis=-1) & row
+        cnt = jnp.sum(fitr, dtype=jnp.int32)
+        first = jnp.min(jnp.where(fitr, node_ids, BIG_I32))
+        return cnt > 0, cnt, jnp.where(cnt > 0, first, -1)
+
+    s_any, s_cnt, s_first = jax.vmap(row_reduce)(special)
+    idx = jnp.where(special >= 0, special, snap.num_pods)
+    return FitReduction(
+        any_fit=base.any_fit.at[idx].set(s_any, mode="drop"),
+        fit_count=base.fit_count.at[idx].set(s_cnt, mode="drop"),
+        first_fit=base.first_fit.at[idx].set(s_first, mode="drop"),
     )
 
 

@@ -120,6 +120,7 @@ def _profile_factorization(
     nodes: Sequence[Node],
     pods: Sequence[Pod],
     node_of_pod: Sequence[int],
+    port_count: Optional[Dict[int, Dict[int, int]]] = None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """→ (pod_prof_id [P], node_prof_id [N], prof_mask [CP, CN]) for the
     class-structured predicates: unschedulable, taints/tolerations,
@@ -132,7 +133,8 @@ def _profile_factorization(
     clusters have a handful of node shapes and pod specs, so this is
     O(profiles²) host work."""
     P, N = len(pods), len(nodes)
-    port_count = _node_port_counts(pods, node_of_pod)
+    if port_count is None:
+        port_count = _node_port_counts(pods, node_of_pod)
 
     # label keys that can influence any pod's selector/affinity verdict
     relevant: set = set()
@@ -189,14 +191,18 @@ def _class_verdict_no_ports(pod: Pod, node: Node) -> bool:
 
 
 def _self_port_cell_overrides(
-    nodes: Sequence[Node], pods: Sequence[Pod], node_of_pod: Sequence[int]
+    nodes: Sequence[Node],
+    pods: Sequence[Pod],
+    node_of_pod: Sequence[int],
+    port_count: Optional[Dict[int, Dict[int, int]]] = None,
 ) -> List[Tuple[int, int, bool]]:
     """→ [(pod_idx, node_idx, value)] corrections for the one cell the port
     class factor gets wrong: a placed pod's verdict on its OWN node must not
     count its own port contribution. value = class-verdict-without-ports AND
     no port on the node is occupied more than once (i.e. by anyone else)."""
     out: List[Tuple[int, int, bool]] = []
-    port_count = _node_port_counts(pods, node_of_pod)
+    if port_count is None:
+        port_count = _node_port_counts(pods, node_of_pod)
     for i, pod in enumerate(pods):
         j = node_of_pod[i]
         if j < 0 or not pod.host_ports:
@@ -360,12 +366,13 @@ def compute_sched_mask(
     device (ops/pallas_fit.py)."""
     P, N = len(pods), len(nodes)
     mask = np.ones((P, N), dtype=bool)
+    port_count = _node_port_counts(pods, node_of_pod)
     pod_prof_id, node_prof_id, prof_mask = _profile_factorization(
-        nodes, pods, node_of_pod
+        nodes, pods, node_of_pod, port_count
     )
     if P and N:
         mask = prof_mask[pod_prof_id][:, node_prof_id]
-    for i, j, value in _self_port_cell_overrides(nodes, pods, node_of_pod):
+    for i, j, value in _self_port_cell_overrides(nodes, pods, node_of_pod, port_count):
         mask[i, j] = value
     _apply_row_rules(_RowView(mask), nodes, pods, node_of_pod, interpod)
     return mask
@@ -399,10 +406,11 @@ def compute_factored_mask(
     affinity exception pods (_exception_pods), sparse cell overrides for
     placed host-port pods. Host cost is O(profiles² + E·N + K)."""
     P, N = len(pods), len(nodes)
+    port_count = _node_port_counts(pods, node_of_pod)
     pod_prof_id, node_prof_id, prof_mask = _profile_factorization(
-        nodes, pods, node_of_pod
+        nodes, pods, node_of_pod, port_count
     )
-    overrides = _self_port_cell_overrides(nodes, pods, node_of_pod)
+    overrides = _self_port_cell_overrides(nodes, pods, node_of_pod, port_count)
     exc = _exception_pods(pods, node_of_pod, interpod)
     E = len(exc)
     exc_rows = np.zeros((max(E, 1), N), bool)

@@ -57,3 +57,67 @@ def test_ragged_sizes_padded():
     res = pallas_fit_reduce(*(jnp.asarray(x) for x in case), tp=64, tn=128)
     np.testing.assert_array_equal(np.asarray(res.any_fit), ref_any)
     np.testing.assert_array_equal(np.asarray(res.fit_count), ref_count)
+
+
+class TestFitReduceExact:
+    """fit_reduce_exact must reproduce the dense-path verdicts on worlds with
+    affinity exception rows AND placed host-port COO overrides — the two mask
+    features the raw class-factor kernel cannot see."""
+
+    def _world(self, seed):
+        from test_factored_mask import world
+
+        nodes, pods, node_of_pod = world(seed, P=40, N=12)
+        for i, pod in enumerate(pods):
+            pod.node_name = nodes[node_of_pod[i]].name if node_of_pod[i] >= 0 else ""
+        return nodes, pods
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_parity_with_dense_path(self, seed):
+        from autoscaler_tpu.ops.fit import fit_matrix
+        from autoscaler_tpu.ops.pallas_fit import fit_reduce_exact
+        from autoscaler_tpu.snapshot.packer import pack
+
+        nodes, pods = self._world(seed)
+        t_dense, _ = pack(nodes, pods, dense_mask=True)
+        t_fact, _ = pack(nodes, pods, dense_mask=False)
+        # the fixture must actually exercise both exception mechanisms
+        assert (np.asarray(t_fact.pod_exc) >= 0).any()
+        if seed == 0:
+            assert (np.asarray(t_fact.cell_pod) >= 0).any()
+
+        fits = np.asarray(fit_matrix(t_dense))
+        ref_any = fits.any(axis=1)
+        ref_count = fits.sum(axis=1)
+        ref_first = np.where(ref_any, fits.argmax(axis=1), -1)
+
+        res = fit_reduce_exact(t_fact, tp=32, tn=128)
+        np.testing.assert_array_equal(np.asarray(res.any_fit), ref_any)
+        np.testing.assert_array_equal(np.asarray(res.fit_count), ref_count)
+        np.testing.assert_array_equal(np.asarray(res.first_fit), ref_first)
+
+        # the dense branch of fit_reduce_exact agrees too
+        res_d = fit_reduce_exact(t_dense)
+        np.testing.assert_array_equal(np.asarray(res_d.any_fit), ref_any)
+        np.testing.assert_array_equal(np.asarray(res_d.first_fit), ref_first)
+
+    def test_fits_any_node_routes_factored_huge(self, monkeypatch):
+        import autoscaler_tpu.ops.fit as fit_mod
+        from autoscaler_tpu.snapshot.packer import pack
+
+        nodes, pods = self._world(1)
+        t_fact, _ = pack(nodes, pods, dense_mask=False)
+        t_dense, _ = pack(nodes, pods, dense_mask=True)
+        ref = np.asarray(fit_mod.fits_any_node(t_dense))
+        # shrink the limit so this world counts as "huge" and must route
+        # through the tiled path instead of raising
+        import autoscaler_tpu.snapshot.packer as packer_mod
+
+        monkeypatch.setattr(packer_mod, "DENSE_MASK_CELL_LIMIT", 1)
+        np.testing.assert_array_equal(
+            np.asarray(fit_mod.fits_any_node(t_fact)), ref
+        )
+        first_ref = np.asarray(fit_mod.first_fit_node(t_dense))
+        np.testing.assert_array_equal(
+            np.asarray(fit_mod.first_fit_node(t_fact)), first_ref
+        )
