@@ -32,6 +32,7 @@ from autoscaler_tpu.simulator.removal import (
     UnremovableNode,
     UnremovableReason,
 )
+from autoscaler_tpu.simulator.tracker import UsageTracker
 from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
 
 
@@ -59,6 +60,7 @@ class ScaleDownPlanner:
         )
         self.deletion_tracker = deletion_tracker or NodeDeletionTracker()
         self.simulator = removal_simulator or RemovalSimulator()
+        self.usage_tracker = UsageTracker()
         self._last_unremovable: List[UnremovableNode] = []
         self._utilization: Dict[str, float] = {}
 
@@ -86,6 +88,14 @@ class ScaleDownPlanner:
 
         to_remove, not_removable = self.simulator.find_nodes_to_remove(
             snapshot, non_empty, pdbs
+        )
+        # remember the simulated moves so an actual deletion later can reset
+        # the unneeded clocks of its destination nodes (simulator/tracker.go)
+        for r in to_remove:
+            for dest in set(r.destinations.values()):
+                self.usage_tracker.register_usage(r.node.name, dest, now_ts)
+        self.usage_tracker.cleanup(
+            now_ts - max(2 * self.options.node_group_defaults.scale_down_unneeded_time_s, 600.0)
         )
         for u in not_removable:
             if u.node is not None:
@@ -158,6 +168,15 @@ class ScaleDownPlanner:
             plan.drain = valid
             plan.unremovable.extend(rejected)
         return plan
+
+    def node_deleted(self, node_name: str, now_ts: float) -> List[str]:
+        """A node was actually removed: reset the unneeded clocks of the
+        nodes its drain simulation used as destinations (their utilization is
+        about to rise when the real evictions land). Returns the reset names."""
+        destinations = self.usage_tracker.remove_node(node_name)
+        for dest in destinations:
+            self.unneeded.reset_since(dest, now_ts)
+        return destinations
 
     def utilization_of(self, node_name: str) -> Optional[float]:
         return self._utilization.get(node_name)

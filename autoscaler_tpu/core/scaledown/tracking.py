@@ -51,6 +51,14 @@ class UnneededNodes:
         e = self._entries.get(name)
         return e.since_ts if e else None
 
+    def reset_since(self, name: str, now_ts: float) -> None:
+        """Restart a node's continuously-unneeded clock — used when pods from
+        a just-deleted node were simulated onto it (UsageTracker), since its
+        utilization is about to rise."""
+        e = self._entries.get(name)
+        if e is not None:
+            e.since_ts = now_ts
+
     def removable_at(
         self,
         node: Node,

@@ -257,6 +257,10 @@ class StaticAutoscaler:
                     if down.deleted_empty or down.deleted_drain:
                         self.last_scale_down_delete_ts = now_ts
                         self.csr.register_scale_down(now_ts)
+                        # destinations of the deleted nodes' simulated pods
+                        # restart their unneeded clocks (simulator/tracker.go)
+                        for name in down.deleted_empty + down.deleted_drain:
+                            self.scale_down_planner.node_deleted(name, now_ts)
                     if down.failed:
                         self.last_scale_down_fail_ts = now_ts
             # keep soft taints in sync either way (:676)

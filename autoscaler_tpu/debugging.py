@@ -87,3 +87,35 @@ class DebuggingSnapshotter:
     def get(self) -> Optional[str]:
         with self._lock:
             return json.dumps(self._payload, indent=2) if self._payload else None
+
+    @staticmethod
+    def dump_tensors(snapshot, path: str) -> List[str]:
+        """Write the packed decision tensors to a compressed .npz — the exact
+        arrays the kernels consumed, for offline replay of a decision. The
+        reference's /snapshotz captures NodeInfos; here the tensors ARE the
+        state. Returns the saved array names."""
+        tensors, _meta = snapshot.tensors()
+        arrays: Dict[str, np.ndarray] = {}
+        for name in (
+            "node_alloc",
+            "node_used",
+            "node_valid",
+            "node_group",
+            "pod_req",
+            "pod_valid",
+            "pod_node",
+            "sched_mask",
+            "pod_class",
+            "node_class",
+            "class_mask",
+            "exc_rows",
+            "pod_exc",
+            "cell_pod",
+            "cell_node",
+            "cell_val",
+        ):
+            value = getattr(tensors, name)
+            if value is not None:
+                arrays[name] = np.asarray(value)
+        np.savez_compressed(path, **arrays)
+        return sorted(arrays)

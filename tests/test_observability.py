@@ -119,6 +119,22 @@ class TestStatusAndDebugging:
         assert data["node_count"] == 1
         assert data["templates"][0]["group"] == "g"
 
+    def test_debugging_tensor_dump(self, tmp_path):
+        import numpy as np
+
+        from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+
+        s = ClusterSnapshot()
+        s.add_node(build_test_node("n0", cpu_m=1000, mem=2 * GB))
+        s.add_pod(build_test_pod("p0", cpu_m=100, node_name="n0"), "n0")
+        path = str(tmp_path / "snap.npz")
+        names = DebuggingSnapshotter.dump_tensors(s, path)
+        assert "pod_req" in names and "node_alloc" in names
+        loaded = np.load(path)
+        tensors, meta = s.tensors()
+        np.testing.assert_array_equal(loaded["pod_req"], np.asarray(tensors.pod_req))
+        assert loaded["node_valid"].sum() == 1
+
 
 class TestCLI:
     def test_options_from_args(self):

@@ -354,6 +354,37 @@ class TestPlannerAndActuator:
             n1 = api.nodes["n1"]
             assert not any(t.key == TO_BE_DELETED_TAINT for t in n1.taints)
 
+    def test_usage_tracker_resets_destination_clocks(self):
+        # n1's drain simulation places p1 somewhere (n2 or n0); deleting n1
+        # must restart the destination's unneeded clock so it is not removed
+        # immediately while the real eviction is still landing.
+        provider, api, snapshot, nodes, opts = self._world()
+        planner = ScaleDownPlanner(provider, opts)
+        planner.update_cluster_state(snapshot, nodes, [], now_ts=0.0)
+        rec = planner.usage_tracker.get("n1")
+        assert rec.using, "n1's simulated move should be recorded"
+        dest = next(iter(rec.using))
+        assert planner.usage_tracker.get(dest).used_by.get("n1") == 0.0
+        planner.update_cluster_state(snapshot, nodes, [], now_ts=150.0)
+        assert planner.unneeded.since(dest) == 0.0
+        reset = planner.node_deleted("n1", now_ts=150.0)
+        assert dest in reset
+        assert planner.unneeded.since(dest) == 150.0
+        # records for n1 are gone, reverse edges cleaned
+        assert not planner.usage_tracker.get("n1").using
+        assert "n1" not in planner.usage_tracker.get(dest).used_by
+
+    def test_usage_tracker_cleanup_expires(self):
+        from autoscaler_tpu.simulator.tracker import UsageTracker
+
+        t = UsageTracker()
+        t.register_usage("a", "b", now_ts=0.0)
+        t.register_usage("a", "c", now_ts=100.0)
+        t.cleanup(cutoff_ts=50.0)
+        assert list(t.get("a").using) == ["c"]
+        assert not t.get("b").used_by
+        assert t.get("c").used_by == {"a": 100.0}
+
     def test_soft_taints(self):
         provider, api, snapshot, nodes, opts = self._world()
         planner = ScaleDownPlanner(provider, opts)
