@@ -204,6 +204,21 @@ class InMemoryGceApi(GceApi):
             else:
                 m["instances"].append(MigInstance(name, InstanceState.CREATING))
             m["target"] += 1
+        if size < m["target"]:
+            # shrink: cancel CREATING instances first (newest first), then
+            # drop RUNNING ones — mirrors a MIG resize-down deleting VMs
+            surplus = m["target"] - size
+            keep: List[MigInstance] = []
+            for inst in reversed(m["instances"]):
+                if surplus > 0 and inst.state == InstanceState.CREATING:
+                    surplus -= 1
+                else:
+                    keep.append(inst)
+            keep.reverse()
+            while surplus > 0 and keep:
+                keep.pop()
+                surplus -= 1
+            m["instances"] = keep
         m["target"] = size
 
     def delete_instances(
@@ -212,8 +227,10 @@ class InMemoryGceApi(GceApi):
         self.calls.append(("delete", mig, tuple(names)))
         m = self._mig(project, zone, mig)
         doomed = set(names)
+        before = len(m["instances"])
         m["instances"] = [i for i in m["instances"] if i.name not in doomed]
-        m["target"] = max(0, m["target"] - len(doomed))
+        removed = before - len(m["instances"])  # unknown names don't shrink target
+        m["target"] = max(0, m["target"] - removed)
 
     def list_instances(self, project: str, zone: str, mig: str) -> List[MigInstance]:
         return list(self._mig(project, zone, mig)["instances"])

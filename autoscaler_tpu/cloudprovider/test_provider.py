@@ -37,6 +37,7 @@ class TestNodeGroup(NodeGroup):
         template: Node,
         provider: "TestCloudProvider",
         price_per_hour: float = 1.0,
+        autoprovisioned: bool = False,
     ):
         self._name = name
         self._min = min_size
@@ -45,6 +46,17 @@ class TestNodeGroup(NodeGroup):
         self._template = template
         self._provider = provider
         self.price_per_hour = price_per_hour
+        self._autoprovisioned = autoprovisioned
+
+    def autoprovisioned(self) -> bool:
+        return self._autoprovisioned
+
+    def delete(self) -> None:
+        if not self._autoprovisioned:
+            raise NodeGroupError("only autoprovisioned groups can be deleted")
+        if self._target > 0 or self._provider._instances.get(self._name):
+            raise NodeGroupError("group not empty")
+        self._provider.remove_node_group(self._name)
 
     def id(self) -> str:
         return self._name
@@ -149,13 +161,28 @@ class TestCloudProvider(CloudProvider):
         target_size: int,
         template: Node,
         price_per_hour: float = 1.0,
+        autoprovisioned: bool = False,
     ) -> TestNodeGroup:
         group = TestNodeGroup(
-            name, min_size, max_size, target_size, template, self, price_per_hour
+            name,
+            min_size,
+            max_size,
+            target_size,
+            template,
+            self,
+            price_per_hour,
+            autoprovisioned,
         )
         self._groups[name] = group
         self._instances.setdefault(name, [])
         return group
+
+    def remove_node_group(self, name: str) -> None:
+        self._groups.pop(name, None)
+        self._instances.pop(name, None)
+        self._node_to_group = {
+            k: v for k, v in self._node_to_group.items() if v != name
+        }
 
     def add_node(self, group_name: str, node: Node) -> None:
         if group_name not in self._groups:

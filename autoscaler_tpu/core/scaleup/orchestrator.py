@@ -51,6 +51,7 @@ class ScaleUpOrchestrator:
         expander: Optional[Strategy] = None,
         balancing_processor=None,
         template_provider=None,
+        node_group_list_processor=None,
     ):
         from autoscaler_tpu.expander.core import build_strategy
 
@@ -64,6 +65,9 @@ class ScaleUpOrchestrator:
         # TemplateNodeInfoProvider (processors/nodeinfos.py): prefer a
         # sanitized real node over the cloud's synthetic template
         self.template_provider = template_provider
+        # NAP (reference orchestrator.go:124): may extend the candidate list
+        # with not-yet-existing autoprovisioned groups
+        self.node_group_list_processor = node_group_list_processor
 
     # -- main entry (reference orchestrator.go:81) ---------------------------
     def scale_up(
@@ -85,12 +89,22 @@ class ScaleUpOrchestrator:
                 if g is not None:
                     nodes_by_group.setdefault(g.id(), []).append(node)
 
+        all_groups: List[NodeGroup] = list(self.provider.node_groups())
+        if self.node_group_list_processor is not None:
+            all_groups += self.node_group_list_processor.process(
+                self.provider, list(pending_pods), all_groups
+            )
+
         viable: Dict[str, NodeGroup] = {}
         templates: Dict[str, Node] = {}
         headrooms: Dict[str, int] = {}
         skipped: Dict[str, str] = {}
-        for group in self.provider.node_groups():
+        for group in all_groups:
             gid = group.id()
+            # NAP candidates go through the same gate: they are healthy by
+            # default (no readiness history) but a failed create()/increase
+            # registered under their deterministic id backs them off too,
+            # preventing a per-loop retry storm against the cloud API.
             if not self.csr.is_node_group_safe_to_scale_up(gid, now_ts):
                 skipped[gid] = "unhealthy or backed off"
                 continue
@@ -179,6 +193,10 @@ class ScaleUpOrchestrator:
             if delta <= 0:
                 continue
             try:
+                if not group.exist():
+                    # a NAP candidate won: create the group for real
+                    # (orchestrator.go:217 CreateNodeGroup)
+                    group = group.create()
                 group.increase_size(delta)
                 self.csr.register_or_update_scale_up(group.id(), delta, now_ts)
                 executed.append((group.id(), delta))

@@ -1,0 +1,174 @@
+"""VPA admission controller: mutating webhook that patches pod requests at
+create time.
+
+Reference: vertical-pod-autoscaler/pkg/admission-controller/logic/server.go
+:37,59 — the webhook server receives an AdmissionReview for pod CREATE,
+matches a VPA by target selector, and returns a base64 JSONPatch setting each
+container's resource requests to the (policy-clamped) recommendation; pods
+are never rejected, only patched (failurePolicy Ignore). Certificate
+provisioning (certs.go) is left to the deploy site — terminate TLS in front
+of this server.
+
+The patch computation is a pure function (`review_pod`) so it is testable
+without sockets; `AdmissionServer` wraps it in a stdlib HTTP server.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from autoscaler_tpu.vpa.api import UpdateMode, Vpa, match_vpa
+from autoscaler_tpu.vpa.recommender import ContainerKey, Recommendation
+
+
+def _cpu_str(cores: float) -> str:
+    return f"{int(round(cores * 1000))}m"
+
+
+def _mem_str(b: float) -> str:
+    return f"{int(round(b))}"
+
+
+def review_pod(
+    review: Dict,
+    vpas: List[Vpa],
+    recommendations: Dict[ContainerKey, Recommendation],
+) -> Dict:
+    """AdmissionReview request dict → AdmissionReview response dict with a
+    JSONPatch over /spec/containers/N/resources/requests. Always allowed;
+    patch only when a matching VPA (mode != Off) has a recommendation."""
+    request = review.get("request", {})
+    uid = request.get("uid", "")
+    pod = request.get("object", {}) or {}
+    meta = pod.get("metadata", {}) or {}
+    namespace = request.get("namespace") or meta.get("namespace", "default")
+    labels = meta.get("labels", {}) or {}
+
+    response: Dict = {"uid": uid, "allowed": True}
+    out = {
+        "apiVersion": review.get("apiVersion", "admission.k8s.io/v1"),
+        "kind": "AdmissionReview",
+        "response": response,
+    }
+
+    vpa = match_vpa(vpas, namespace, labels)
+    if vpa is None or vpa.update_mode == UpdateMode.OFF:
+        return out
+
+    patches: List[Dict] = []
+    containers = (pod.get("spec", {}) or {}).get("containers", []) or []
+    for i, container in enumerate(containers):
+        name = container.get("name", "")
+        rec = recommendations.get(ContainerKey(vpa.name, name))
+        if rec is None:
+            continue
+        clamped = vpa.clamp(name, rec)
+        if clamped is None:  # container scaling Off
+            continue
+        resources = container.get("resources") or {}
+        if "resources" not in container:
+            patches.append({"op": "add", "path": f"/spec/containers/{i}/resources", "value": {}})
+        if "requests" not in resources:
+            patches.append(
+                {"op": "add", "path": f"/spec/containers/{i}/resources/requests", "value": {}}
+            )
+        patches.append(
+            {
+                "op": "add",
+                "path": f"/spec/containers/{i}/resources/requests/cpu",
+                "value": _cpu_str(clamped.target_cpu),
+            }
+        )
+        patches.append(
+            {
+                "op": "add",
+                "path": f"/spec/containers/{i}/resources/requests/memory",
+                "value": _mem_str(clamped.target_memory),
+            }
+        )
+    if patches:
+        # one breadcrumb per pod (reference vpaUpdates annotation); adding the
+        # single key preserves existing annotations — an "add" of the whole
+        # map would wipe them (RFC 6902: add on an existing member replaces)
+        if meta.get("annotations") is None:
+            patches.append({"op": "add", "path": "/metadata/annotations", "value": {}})
+        patches.append(
+            {
+                "op": "add",
+                "path": "/metadata/annotations/vpaUpdates",
+                "value": f"Pod resources updated by {vpa.name}",
+            }
+        )
+    if patches:
+        response["patchType"] = "JSONPatch"
+        response["patch"] = base64.b64encode(
+            json.dumps(patches).encode()
+        ).decode()
+    return out
+
+
+class AdmissionServer:
+    """Stdlib HTTP wrapper: POST /mutate with an AdmissionReview body."""
+
+    def __init__(
+        self,
+        vpas: List[Vpa],
+        recommendations: Dict[ContainerKey, Recommendation],
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 (stdlib API)
+                if self.path != "/mutate":
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                try:
+                    review = json.loads(self.rfile.read(length) or b"{}")
+                    body = json.dumps(
+                        review_pod(review, outer.vpas, outer.recommendations)
+                    ).encode()
+                except (ValueError, KeyError) as e:
+                    self.send_error(400, str(e))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/health-check":
+                    self.send_response(200)
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(b"ok")
+                else:
+                    self.send_error(404)
+
+            def log_message(self, *args):
+                pass
+
+        self.vpas = vpas
+        self.recommendations = recommendations
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.server_address[:2]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -118,6 +118,23 @@ class TestMigOperations:
         mig.increase_size(1)
         assert mig.target_size() == 2
 
+    def test_resize_down_cancels_creating_instances(self):
+        api, provider = make_provider(target=2)
+        (mig,) = provider.node_groups()
+        mig.increase_size(1)  # CREATING tpu-pool-2
+        mig.decrease_target_size(1)
+        instances = api.list_instances("proj", "us-central2-b", "tpu-pool")
+        assert len(instances) == 2
+        assert all(i.state == InstanceState.RUNNING for i in instances)
+        api.settle()  # must not resurrect the canceled instance
+        assert len(api.list_instances("proj", "us-central2-b", "tpu-pool")) == 2
+
+    def test_delete_unknown_name_does_not_shrink_target(self):
+        api, provider = make_provider(target=2)
+        api.delete_instances("proj", "us-central2-b", "tpu-pool", ["ghost"])
+        assert api.get_target_size("proj", "us-central2-b", "tpu-pool") == 2
+        assert len(api.list_instances("proj", "us-central2-b", "tpu-pool")) == 2
+
     def test_stockout_surfaces_error_instances(self):
         api, provider = make_provider(quota=1)
         (mig,) = provider.node_groups()

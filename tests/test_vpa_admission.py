@@ -1,0 +1,232 @@
+"""VPA admission controller + input pipeline tests: JSONPatch construction
+with policy clamping and update modes, the HTTP webhook round trip, the
+metrics feeder, and history replay (modeled on the reference's
+admission-controller logic/server_test.go and input/cluster_feeder_test.go)."""
+import base64
+import http.client
+import json
+
+import pytest
+
+from autoscaler_tpu.kube.objects import LabelSelector
+from autoscaler_tpu.vpa.admission import AdmissionServer, review_pod
+from autoscaler_tpu.vpa.api import (
+    ContainerResourcePolicy,
+    ContainerScalingMode,
+    UpdateMode,
+    Vpa,
+    match_vpa,
+)
+from autoscaler_tpu.vpa.feeder import (
+    ClusterStateFeeder,
+    ContainerUsage,
+    InMemoryMetrics,
+)
+from autoscaler_tpu.vpa.recommender import (
+    ClusterStateModel,
+    ContainerKey,
+    PercentileRecommender,
+    Recommendation,
+)
+
+GB = 1024**3
+DAY = 86400.0
+
+
+def make_vpa(**kw):
+    return Vpa(
+        name="my-vpa",
+        target_selector=LabelSelector.from_dict({"app": "web"}),
+        **kw,
+    )
+
+
+def make_review(labels=None, containers=None):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": "uid-1",
+            "namespace": "default",
+            "object": {
+                "metadata": {"labels": labels or {"app": "web"}},
+                "spec": {
+                    "containers": containers
+                    or [{"name": "main", "resources": {"requests": {"cpu": "100m"}}}]
+                },
+            },
+        },
+    }
+
+
+REC = Recommendation(
+    target_cpu=0.5,
+    target_memory=1 * GB,
+    lower_cpu=0.25,
+    lower_memory=0.5 * GB,
+    upper_cpu=1.0,
+    upper_memory=2 * GB,
+)
+
+
+def decode_patch(resp):
+    return json.loads(base64.b64decode(resp["response"]["patch"]))
+
+
+class TestReviewPod:
+    def test_patches_requests(self):
+        out = review_pod(
+            make_review(), [make_vpa()], {ContainerKey("my-vpa", "main"): REC}
+        )
+        assert out["response"]["allowed"] is True
+        patch = decode_patch(out)
+        cpu = [p for p in patch if p["path"].endswith("/cpu")]
+        mem = [p for p in patch if p["path"].endswith("/memory")]
+        assert cpu[0]["value"] == "500m"
+        assert mem[0]["value"] == str(1 * GB)
+
+    def test_no_matching_vpa_allows_unpatched(self):
+        out = review_pod(
+            make_review(labels={"app": "db"}),
+            [make_vpa()],
+            {ContainerKey("my-vpa", "main"): REC},
+        )
+        assert out["response"]["allowed"] is True
+        assert "patch" not in out["response"]
+
+    def test_update_mode_off_never_patches(self):
+        out = review_pod(
+            make_review(),
+            [make_vpa(update_mode=UpdateMode.OFF)],
+            {ContainerKey("my-vpa", "main"): REC},
+        )
+        assert "patch" not in out["response"]
+
+    def test_policy_clamps_target(self):
+        vpa = make_vpa(
+            resource_policies=[
+                ContainerResourcePolicy(container_name="main", max_cpu=0.3)
+            ]
+        )
+        out = review_pod(make_review(), [vpa], {ContainerKey("my-vpa", "main"): REC})
+        patch = decode_patch(out)
+        cpu = [p for p in patch if p["path"].endswith("/cpu")]
+        assert cpu[0]["value"] == "300m"
+
+    def test_container_scaling_off_skips_container(self):
+        vpa = make_vpa(
+            resource_policies=[
+                ContainerResourcePolicy(
+                    container_name="main", mode=ContainerScalingMode.OFF
+                )
+            ]
+        )
+        out = review_pod(make_review(), [vpa], {ContainerKey("my-vpa", "main"): REC})
+        assert "patch" not in out["response"]
+
+    def test_container_without_resources_section(self):
+        out = review_pod(
+            make_review(containers=[{"name": "main"}]),
+            [make_vpa()],
+            {ContainerKey("my-vpa", "main"): REC},
+        )
+        patch = decode_patch(out)
+        paths = [p["path"] for p in patch]
+        assert "/spec/containers/0/resources" in paths
+        assert "/spec/containers/0/resources/requests" in paths
+
+    def test_existing_annotations_preserved(self):
+        review = make_review()
+        review["request"]["object"]["metadata"]["annotations"] = {
+            "prometheus.io/scrape": "true"
+        }
+        out = review_pod(review, [make_vpa()], {ContainerKey("my-vpa", "main"): REC})
+        patch = decode_patch(out)
+        # the breadcrumb targets the single key, never the whole map
+        assert not any(p["path"] == "/metadata/annotations" for p in patch)
+        assert any(p["path"] == "/metadata/annotations/vpaUpdates" for p in patch)
+
+    def test_single_breadcrumb_for_multiple_containers(self):
+        containers = [
+            {"name": "main", "resources": {"requests": {}}},
+            {"name": "sidecar", "resources": {"requests": {}}},
+        ]
+        recs = {
+            ContainerKey("my-vpa", "main"): REC,
+            ContainerKey("my-vpa", "sidecar"): REC,
+        }
+        out = review_pod(make_review(containers=containers), [make_vpa()], recs)
+        patch = decode_patch(out)
+        crumbs = [p for p in patch if "vpaUpdates" in p["path"]]
+        assert len(crumbs) == 1
+        # no annotations on the pod → the empty map is added exactly once
+        assert [p["path"] for p in patch].count("/metadata/annotations") == 1
+
+    def test_match_vpa_namespace_scoped(self):
+        vpa = make_vpa()
+        assert match_vpa([vpa], "default", {"app": "web"}) is vpa
+        assert match_vpa([vpa], "other", {"app": "web"}) is None
+
+
+class TestAdmissionServer:
+    def test_http_round_trip(self):
+        server = AdmissionServer(
+            [make_vpa()], {ContainerKey("my-vpa", "main"): REC}
+        )
+        server.start()
+        try:
+            host, port = server.address
+            conn = http.client.HTTPConnection(host, port, timeout=5)
+            body = json.dumps(make_review())
+            conn.request(
+                "POST", "/mutate", body, {"Content-Type": "application/json"}
+            )
+            resp = conn.getresponse()
+            assert resp.status == 200
+            data = json.loads(resp.read())
+            assert data["response"]["allowed"] is True
+            assert data["response"]["patchType"] == "JSONPatch"
+            conn.request("GET", "/health-check")
+            assert conn.getresponse().read() == b"ok"
+        finally:
+            server.stop()
+
+
+class TestFeederAndHistory:
+    def test_feed_once_batches_into_model(self):
+        model = ClusterStateModel()
+        feeder = ClusterStateFeeder(model, [make_vpa()])
+        metrics = InMemoryMetrics()
+        metrics.set_usage(
+            [
+                ContainerUsage(
+                    "default", "web-1", "main", {"app": "web"}, 0.4, 1 * GB
+                ),
+                ContainerUsage(
+                    "default", "web-2", "main", {"app": "web"}, 0.6, 1.2 * GB
+                ),
+                # unmatched pod: ignored
+                ContainerUsage("default", "db-1", "pg", {"app": "db"}, 2.0, 4 * GB),
+            ]
+        )
+        n = feeder.feed_once(metrics, now_ts=0.0)
+        assert n == 2
+        key = ContainerKey("my-vpa", "main")
+        assert model.meta(key).sample_count == 4  # 2 cpu + 2 memory
+
+    def test_history_replay_warms_recommendations(self):
+        model = ClusterStateModel()
+        feeder = ClusterStateFeeder(model, [make_vpa()])
+        metrics = InMemoryMetrics()
+        cpu_series = [(i * 60.0, 0.5) for i in range(100)]
+        mem_series = [(i * 60.0, 1 * GB) for i in range(100)]
+        metrics.add_history(
+            "default", "web-1", "main", {"app": "web"}, cpu_series, mem_series
+        )
+        n = feeder.replay_history(metrics)
+        assert n == 200
+        recs = PercentileRecommender(model).recommend(now_ts=100 * 60.0)
+        rec = recs[ContainerKey("my-vpa", "main")]
+        # p90 of constant 0.5-core usage, +15% margin → ~0.575
+        assert rec.target_cpu == pytest.approx(0.575, rel=0.2)
+        assert rec.target_memory >= 1 * GB
