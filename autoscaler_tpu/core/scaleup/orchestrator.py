@@ -138,7 +138,7 @@ class ScaleUpOrchestrator:
         # ONE batched device dispatch for every group's expansion option
         # (replaces the serial ComputeExpansionOption loop).
         estimates = self.estimator.estimate_many(
-            list(pending_pods), templates, headrooms
+            list(pending_pods), templates, headrooms, pod_groups=pod_groups
         )
 
         options: List[Option] = []

@@ -18,6 +18,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from autoscaler_tpu.core.scaleup.equivalence import build_pod_groups
 from autoscaler_tpu.estimator.limiter import ThresholdBasedEstimationLimiter
 from autoscaler_tpu.kube.objects import Node, Pod
 from autoscaler_tpu.ops.binpack import (
@@ -25,6 +26,7 @@ from autoscaler_tpu.ops.binpack import (
     ffd_binpack,
     ffd_binpack_groups,
     ffd_binpack_groups_affinity,
+    ffd_binpack_groups_runs,
 )
 from autoscaler_tpu.snapshot.affinity import build_affinity_terms, has_interpod_affinity
 from autoscaler_tpu.snapshot.packer import compute_sched_mask, resources_row
@@ -110,6 +112,7 @@ class BinpackingNodeEstimator:
         pods: Sequence[Pod],
         templates: Dict[str, Node],
         headrooms: Optional[Dict[str, int]] = None,
+        pod_groups=None,
     ) -> Dict[str, Tuple[int, List[Pod]]]:
         """All node groups in one device dispatch (vmap over the group axis).
         headrooms[g] is the group's remaining size budget (max-size − target);
@@ -120,9 +123,16 @@ class BinpackingNodeEstimator:
         if not pods or not templates:
             return {g: (0, []) for g in templates}
         names = sorted(templates)
+        dynamic_affinity = has_interpod_affinity(pods)
+        if not dynamic_affinity:
+            groups = pod_groups if pod_groups is not None else build_pod_groups(pods)
+            # Equivalence dedup pays when it actually compresses: scan steps
+            # drop from P to U (one per unique pod type), the big win at the
+            # 100k-pending-pods scale where U is in the hundreds.
+            if len(groups) * 2 <= len(pods):
+                return self._estimate_many_runs(pods, groups, names, templates, headrooms)
         P = bucket_size(len(pods))
         req = _pack_pods(pods, P)
-        dynamic_affinity = has_interpod_affinity(pods)
         masks = np.stack(
             [
                 template_mask(pods, templates[g], P, interpod=not dynamic_affinity)
@@ -169,4 +179,52 @@ class BinpackingNodeEstimator:
         out: Dict[str, Tuple[int, List[Pod]]] = {}
         for gi, g in enumerate(names):
             out[g] = (int(counts[gi]), [p for i, p in enumerate(pods) if scheds[gi, i]])
+        return out
+
+    def _estimate_many_runs(
+        self,
+        pods: Sequence[Pod],
+        groups,
+        names: List[str],
+        templates: Dict[str, Node],
+        headrooms: Optional[Dict[str, int]],
+    ) -> Dict[str, Tuple[int, List[Pod]]]:
+        """Equivalence-run path: one scan step per unique pod type
+        (ffd_binpack_groups_runs). Members of a run are interchangeable by
+        construction (same controller + scheduling spec, groups.go:61), so
+        'schedule k of this run' expands to its first k member pods."""
+        U = bucket_size(len(groups))
+        exemplars = [g.exemplar for g in groups]
+        run_req = _pack_pods(exemplars, U)
+        run_counts = np.zeros((U,), np.int32)
+        run_counts[: len(groups)] = [len(g.pods) for g in groups]
+        masks = np.stack(
+            [template_mask(exemplars, templates[g], U, interpod=True) for g in names]
+        )
+        allocs = np.stack(
+            [
+                resources_row(templates[g].allocatable, templates[g].allocatable.pods)
+                for g in names
+            ]
+        )
+        headrooms = headrooms or {}
+        caps = np.array(
+            [self.limiter.node_cap(headrooms.get(g, 0)) for g in names], np.int32
+        )
+        res = ffd_binpack_groups_runs(
+            jnp.asarray(run_req),
+            jnp.asarray(run_counts),
+            jnp.asarray(masks),
+            jnp.asarray(allocs),
+            max_nodes=bucket_size(int(caps.max()), minimum=8),
+            node_caps=jnp.asarray(caps),
+        )
+        counts = np.asarray(res.node_count)
+        placed = np.asarray(res.placed_counts)
+        out: Dict[str, Tuple[int, List[Pod]]] = {}
+        for gi, g in enumerate(names):
+            sched: List[Pod] = []
+            for ui, grp in enumerate(groups):
+                sched.extend(grp.pods[: placed[gi, ui]])
+            out[g] = (int(counts[gi]), sched)
         return out

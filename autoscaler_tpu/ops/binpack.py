@@ -170,6 +170,118 @@ def ffd_binpack_groups(
     )
 
 
+class RunBinpackResult(NamedTuple):
+    node_count: jax.Array     # [G] i32 — template nodes opened
+    placed_counts: jax.Array  # [G, U] i32 — pods of run u placed in group g
+    node_used: jax.Array      # [G, max_nodes, R]
+
+
+@functools.partial(jax.jit, static_argnames=("max_nodes",))
+def ffd_binpack_groups_runs(
+    run_req: jax.Array,         # [U, R] unique pod-requirement rows
+    run_counts: jax.Array,      # [U] i32 — identical pods per run
+    run_masks: jax.Array,       # [G, U] bool — run passes group's predicates
+    template_allocs: jax.Array,  # [G, R]
+    max_nodes: int,
+    node_caps: jax.Array | None = None,  # [G] i32
+) -> RunBinpackResult:
+    """FFD over *equivalence runs*: one scan step per unique pod type instead
+    of one per pod — the device-side twin of the reference's pod equivalence
+    groups (core/scaleup/equivalence/groups.go:61), which dedups identical
+    pods so one predicate evaluation covers many.
+
+    Why a whole run collapses into one step: for identical pods the first-fit
+    index is monotone within the run (nodes earlier than pod i's destination
+    stay too full for pod i+1), so run placement ≡ greedy fill of nodes in
+    open order. Each step therefore computes per-node capacity counts
+    (floor(free/req), min over resources), a single cumulative sum in node
+    order, and a clip against the remaining run count — no inner loop. New
+    nodes continue the same cumsum with the empty-template capacity, bounded
+    by the group cap, exactly reproducing the open-on-miss rule.
+
+    Count arithmetic is float32 with a ±1-ulp correction pass so that
+    `cnt = max k : k*req <= free` holds under f32 multiply — bit-parity with
+    the per-pod kernel for the integer-valued requests the packer produces.
+    Semantics match ffd_binpack_groups on the expanded pod list whenever
+    distinct runs have distinct scores (ties across runs may interleave
+    per-pod; any FFD tie-break is valid — parity-tested in
+    tests/test_kernels.py).
+    """
+    U, R = run_req.shape
+    G = run_masks.shape[0]
+    if node_caps is None:
+        node_caps = jnp.full((G,), max_nodes, jnp.int32)
+    caps = jnp.minimum(node_caps.astype(jnp.int32), max_nodes)
+
+    scores = jax.vmap(lambda alloc: ffd_scores(run_req, alloc))(template_allocs)  # [G, U]
+    order = jnp.argsort(-scores, axis=1, stable=True)                # [G, U]
+    sorted_mask = jnp.take_along_axis(run_masks, order, axis=1)      # [G, U]
+
+    alloc_t = template_allocs[:, :, None]                            # [G, R, 1]
+    node_ids = jnp.arange(max_nodes)
+    garange = jnp.arange(G)
+    counts_f = run_counts.astype(jnp.float32)
+
+    def max_fit(q, free):
+        # [G, M] f32 — max k with k*q <= free elementwise over resources,
+        # exact under f32 multiply via floor-division + ±1 correction.
+        pos = q > 0                                                  # [G, R]
+        safe_q = jnp.where(pos, q, 1.0)
+        per = jnp.where(
+            pos[:, :, None], jnp.floor(free / safe_q[:, :, None]), jnp.float32(2**30)
+        )
+        cnt = jnp.maximum(per.min(axis=1), 0.0)                      # [G, M]
+
+        def fits_k(k):
+            return jnp.all(k[:, None, :] * q[:, :, None] <= free, axis=1)
+
+        cnt = jnp.where(fits_k(cnt), cnt, jnp.maximum(cnt - 1, 0.0))
+        return jnp.where(fits_k(cnt + 1), cnt + 1, cnt)
+
+    def step(carry, xs):
+        used_t, opened = carry            # [G, R, M], [G]
+        idx, active = xs                  # [G] i32, [G] bool
+        q = run_req[idx]                  # [G, R]
+        c = jnp.where(active, counts_f[idx], 0.0)                    # [G]
+        free_t = alloc_t - used_t
+        cnt_open = max_fit(q, free_t)                                # [G, M]
+        per_new = max_fit(q, alloc_t)[:, 0]                          # [G]
+        fits_empty = jnp.all(q <= template_allocs, axis=1)
+        open_mask = node_ids[None, :] < opened[:, None]
+        new_mask = ~open_mask & (node_ids[None, :] < caps[:, None])
+        capvec = jnp.where(open_mask, cnt_open, 0.0) + jnp.where(
+            new_mask & fits_empty[:, None], per_new[:, None], 0.0
+        )                                                            # [G, M]
+        prefix = jnp.cumsum(capvec, axis=1)
+        take = jnp.clip(c[:, None] - (prefix - capvec), 0.0, capvec)  # [G, M]
+        used_t = used_t + q[:, :, None] * take[:, None, :]
+        newly = (take > 0) & new_mask
+        high = jnp.max(
+            jnp.where(newly, node_ids[None, :] + 1, 0), axis=1
+        ).astype(jnp.int32)
+        opened = jnp.maximum(opened, high)
+        return (used_t, opened), take.sum(axis=1)
+
+    init = (
+        jnp.zeros((G, R, max_nodes), run_req.dtype),
+        jnp.zeros((G,), jnp.int32),
+    )
+    (used_t, opened), placed = jax.lax.scan(
+        step, init, (order.T, sorted_mask.T)
+    )                                                                # placed [U, G]
+
+    placed_counts = (
+        jnp.zeros((G, U), jnp.int32)
+        .at[garange[:, None], order]
+        .set(placed.T.astype(jnp.int32))
+    )
+    return RunBinpackResult(
+        node_count=opened,
+        placed_counts=placed_counts,
+        node_used=jnp.swapaxes(used_t, 1, 2),
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("max_nodes",))
 def ffd_binpack_groups_affinity(
     pod_req: jax.Array,         # [P, R] shared pending-pod matrix

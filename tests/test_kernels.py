@@ -173,3 +173,111 @@ class TestEstimatorAPI:
         assert lim.node_cap(0) == 10
         assert lim.node_cap(3) == 3
         assert lim.node_cap(50) == 10
+
+
+class TestRunKernel:
+    """ffd_binpack_groups_runs (one scan step per equivalence run) must agree
+    with the per-pod groups kernel on the expanded pod list."""
+
+    def _expand(self, run_req, run_counts, run_masks):
+        per_req = np.repeat(run_req, run_counts, axis=0)
+        per_masks = np.repeat(run_masks, run_counts, axis=1)
+        run_of = np.repeat(np.arange(len(run_counts)), run_counts)
+        return per_req, per_masks, run_of
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_parity_with_per_pod_kernel(self, seed):
+        from autoscaler_tpu.ops.binpack import ffd_binpack_groups_runs
+
+        rng = np.random.default_rng(seed)
+        U, G, R, M = 12, 5, 6, 32
+        run_req = np.zeros((U, R), np.float32)
+        # Distinct cpu per run => distinct FFD scores (tie order across runs is
+        # the one legitimate divergence between the two kernels).
+        run_req[:, CPU] = rng.permutation(np.arange(1, U + 1)) * 97.0
+        run_req[:, MEMORY] = rng.integers(64, 2048, U)
+        run_req[:, PODS] = 1.0
+        run_counts = rng.integers(1, 20, U).astype(np.int32)
+        run_masks = rng.random((G, U)) > 0.15
+        allocs = np.zeros((G, R), np.float32)
+        allocs[:, CPU] = rng.integers(1000, 6000, G)
+        allocs[:, MEMORY] = rng.integers(2048, 8192, G)
+        allocs[:, PODS] = 32.0
+        caps = rng.integers(2, M, G).astype(np.int32)
+
+        res = ffd_binpack_groups_runs(
+            jnp.asarray(run_req),
+            jnp.asarray(run_counts),
+            jnp.asarray(run_masks),
+            jnp.asarray(allocs),
+            max_nodes=M,
+            node_caps=jnp.asarray(caps),
+        )
+        per_req, per_masks, run_of = self._expand(run_req, run_counts, run_masks)
+        ref = ffd_binpack_groups(
+            jnp.asarray(per_req),
+            jnp.asarray(per_masks),
+            jnp.asarray(allocs),
+            max_nodes=M,
+            node_caps=jnp.asarray(caps),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res.node_count), np.asarray(ref.node_count)
+        )
+        # Per-run placement counts match.
+        sched = np.asarray(ref.scheduled)  # [G, Pexp]
+        for g in range(G):
+            per_run = np.bincount(run_of[sched[g]], minlength=U)
+            np.testing.assert_array_equal(np.asarray(res.placed_counts)[g], per_run)
+        np.testing.assert_allclose(
+            np.asarray(res.node_used), np.asarray(ref.node_used), rtol=0, atol=0
+        )
+
+    def test_oversized_run_skipped(self):
+        from autoscaler_tpu.ops.binpack import ffd_binpack_groups_runs
+
+        run_req = np.zeros((2, 6), np.float32)
+        run_req[0, CPU] = 500.0
+        run_req[1, CPU] = 9000.0  # never fits an empty template
+        run_req[:, PODS] = 1.0
+        allocs = np.zeros((1, 6), np.float32)
+        allocs[0, CPU] = 1000.0
+        allocs[0, PODS] = 10.0
+        res = ffd_binpack_groups_runs(
+            jnp.asarray(run_req),
+            jnp.asarray(np.array([4, 3], np.int32)),
+            jnp.asarray(np.ones((1, 2), bool)),
+            jnp.asarray(allocs),
+            max_nodes=8,
+        )
+        assert int(res.node_count[0]) == 2  # 4 x 500m, 2 per node
+        np.testing.assert_array_equal(np.asarray(res.placed_counts)[0], [4, 0])
+
+    def test_estimate_many_dedup_path(self):
+        """40 identical controller pods trigger the run path; result matches
+        the dense per-pod result."""
+        from autoscaler_tpu.kube.objects import OwnerRef
+
+        pods = [build_test_pod(f"p{i}", cpu_m=500, mem=500 * MB) for i in range(40)]
+        for p in pods:
+            p.owner_ref = OwnerRef(kind="ReplicaSet", name="rs-1")
+        templates = {
+            "small": build_test_node("small-t", cpu_m=1000, mem=2000 * MB),
+            "big": build_test_node("big-t", cpu_m=4000, mem=8000 * MB),
+        }
+        out = BinpackingNodeEstimator().estimate_many(pods, templates)
+        assert out["small"][0] == 20
+        assert out["big"][0] == 5
+        assert len(out["big"][1]) == 40
+
+    def test_estimate_many_dedup_respects_headroom(self):
+        from autoscaler_tpu.kube.objects import OwnerRef
+
+        pods = [build_test_pod(f"p{i}", cpu_m=900) for i in range(10)]
+        for p in pods:
+            p.owner_ref = OwnerRef(kind="ReplicaSet", name="rs-2")
+        templates = {"g": build_test_node("t", cpu_m=1000)}
+        est = BinpackingNodeEstimator(ThresholdBasedEstimationLimiter(max_nodes=1000))
+        out = est.estimate_many(pods, templates, headrooms={"g": 3})
+        count, scheduled = out["g"]
+        assert count == 3 and len(scheduled) == 3
