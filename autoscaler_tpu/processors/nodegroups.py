@@ -104,6 +104,32 @@ class CandidateNodeGroup(NodeGroup):
         return self._template
 
 
+def _affinity_label_candidates(pod: Pod):
+    """Yield label dicts that could satisfy the pod's required node affinity,
+    one per ORed node-selector term (synthesizable expressions only:
+    matchLabels, In → first value, Exists → marker). A pod that places itself
+    via affinity instead of nodeSelector must still get a candidate template
+    carrying those labels, or its own candidate group rejects it forever."""
+    if not (pod.affinity and pod.affinity.node_selector_terms):
+        yield {}
+        return
+    for term in pod.affinity.node_selector_terms:
+        labels = {k: v for k, v in term.match_labels}
+        ok = True
+        for req in term.match_expressions:
+            if req.operator == "In" and req.values:
+                labels[req.key] = req.values[0]
+            elif req.operator == "Exists":
+                labels.setdefault(req.key, "true")
+            elif req.operator in ("NotIn", "DoesNotExist"):
+                continue  # absence satisfies
+            else:
+                ok = False  # Gt/Lt: don't guess numeric label values
+                break
+        if ok:
+            yield labels
+
+
 def _pod_fits_template(pod: Pod, template: Node) -> bool:
     req, alloc = pod.requests, template.allocatable
     if (
@@ -160,25 +186,33 @@ class AutoprovisioningNodeGroupListProcessor:
             shape = self._cheapest_shape_for(pod)
             if shape is None:
                 continue
-            name = self._group_name(shape, pod)
+            template = None
+            name = ""
+            for aff_labels in _affinity_label_candidates(pod):
+                labels = {**aff_labels, **pod.node_selector}
+                name = self._group_name(shape, pod, labels)
+                cand = Node(
+                    name=f"{name}-template",
+                    allocatable=Resources(
+                        cpu_m=shape.cpu_m,
+                        memory=shape.memory,
+                        gpu=shape.gpu,
+                        tpu=shape.tpu,
+                        pods=shape.pods,
+                    ),
+                    labels={"kubernetes.io/hostname": f"{name}-template", **labels},
+                )
+                # the pod must accept its own candidate, or the group would be
+                # rebuilt (dead) every loop while the pod stays pending
+                if _pod_fits_template(pod, cand):
+                    template = cand
+                    break
+            if template is None:
+                continue
             # a name collision with a live group (e.g. its template fetch
             # failed this loop) must not re-create/overwrite that group
             if name in candidates or name in existing_ids:
                 continue
-            template = Node(
-                name=f"{name}-template",
-                allocatable=Resources(
-                    cpu_m=shape.cpu_m,
-                    memory=shape.memory,
-                    gpu=shape.gpu,
-                    tpu=shape.tpu,
-                    pods=shape.pods,
-                ),
-                labels={
-                    "kubernetes.io/hostname": f"{name}-template",
-                    **pod.node_selector,
-                },
-            )
             candidates[name] = CandidateNodeGroup(
                 name,
                 template,
@@ -203,8 +237,9 @@ class AutoprovisioningNodeGroupListProcessor:
         return None
 
     @staticmethod
-    def _group_name(shape: MachineShape, pod: Pod) -> str:
-        sel = hashlib.sha1(
-            repr(sorted(pod.node_selector.items())).encode()
-        ).hexdigest()[:6]
+    def _group_name(shape: MachineShape, pod: Pod, labels=None) -> str:
+        key = sorted(labels.items()) if labels is not None else sorted(
+            pod.node_selector.items()
+        )
+        sel = hashlib.sha1(repr(key).encode()).hexdigest()[:6]
         return f"nap-{shape.name}-{sel}"

@@ -32,6 +32,48 @@ def _mem_str(b: float) -> str:
     return f"{int(round(b))}"
 
 
+_SUFFIX = {
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
+    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15,
+}
+
+
+def _parse_qty(s) -> Optional[float]:
+    """Kubernetes quantity string → float (cores for cpu incl. 'm' suffix,
+    bytes for memory incl. binary/decimal suffixes). None if unparseable."""
+    if s is None:
+        return None
+    s = str(s).strip()
+    try:
+        if s.endswith("m"):
+            return float(s[:-1]) / 1000.0
+        for suf, mult in _SUFFIX.items():
+            if s.endswith(suf):
+                return float(s[: -len(suf)]) * mult
+        return float(s)
+    except ValueError:
+        return None
+
+
+def _proportional_limit(
+    limits: Dict, requests: Dict, resource: str, new_request: float
+) -> Optional[float]:
+    """Scale the container's declared limit by the request change, keeping the
+    original request:limit ratio — the reference's GetProportionalLimit
+    (admission-controller/resource/pod/patch/resource_updates.go). Without
+    this, raising a request above a declared limit yields a pod the apiserver
+    rejects at validation (requests must be <= limits). When no original
+    request was declared, Kubernetes defaults it to the limit, so the ratio is
+    1 and the new limit equals the new request."""
+    lim = _parse_qty(limits.get(resource))
+    if lim is None or lim <= 0:
+        return None
+    orig = _parse_qty(requests.get(resource))
+    if orig is None or orig <= 0:
+        orig = lim
+    return new_request * lim / orig
+
+
 def review_pod(
     review: Dict,
     vpas: List[Vpa],
@@ -62,7 +104,7 @@ def review_pod(
     containers = (pod.get("spec", {}) or {}).get("containers", []) or []
     for i, container in enumerate(containers):
         name = container.get("name", "")
-        rec = recommendations.get(ContainerKey(vpa.name, name))
+        rec = recommendations.get(ContainerKey(vpa.name, name, vpa.namespace))
         if rec is None:
             continue
         clamped = vpa.clamp(name, rec)
@@ -89,6 +131,26 @@ def review_pod(
                 "value": _mem_str(clamped.target_memory),
             }
         )
+        limits = resources.get("limits") or {}
+        requests = resources.get("requests") or {}
+        cpu_lim = _proportional_limit(limits, requests, "cpu", clamped.target_cpu)
+        if cpu_lim is not None:
+            patches.append(
+                {
+                    "op": "add",
+                    "path": f"/spec/containers/{i}/resources/limits/cpu",
+                    "value": _cpu_str(cpu_lim),
+                }
+            )
+        mem_lim = _proportional_limit(limits, requests, "memory", clamped.target_memory)
+        if mem_lim is not None:
+            patches.append(
+                {
+                    "op": "add",
+                    "path": f"/spec/containers/{i}/resources/limits/memory",
+                    "value": _mem_str(mem_lim),
+                }
+            )
     if patches:
         # one breadcrumb per pod (reference vpaUpdates annotation); adding the
         # single key preserves existing annotations — an "add" of the whole

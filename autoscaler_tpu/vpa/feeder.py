@@ -111,7 +111,7 @@ class ClusterStateFeeder:
         vpa = match_vpa(self.vpas, namespace, labels)
         if vpa is None:
             return None
-        return ContainerKey(vpa.name, container)
+        return ContainerKey(vpa.name, container, vpa.namespace)
 
     def feed_once(self, source: MetricsSource, now_ts: float) -> int:
         """One live scrape → model. Returns samples ingested."""

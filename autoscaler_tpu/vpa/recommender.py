@@ -42,12 +42,20 @@ CONFIDENCE_EXPONENT = 1.0
 class ContainerKey:
     vpa: str
     container: str
+    # VPA names are only unique per namespace (a same-named VPA in another
+    # namespace is a distinct object) — without this, two teams' histograms
+    # blend into one recommendation.
+    namespace: str = "default"
 
     def __hash__(self):
-        return hash((self.vpa, self.container))
+        return hash((self.vpa, self.container, self.namespace))
 
     def __eq__(self, other):
-        return (self.vpa, self.container) == (other.vpa, other.container)
+        return (self.vpa, self.container, self.namespace) == (
+            other.vpa,
+            other.container,
+            other.namespace,
+        )
 
 
 @dataclass
@@ -192,6 +200,7 @@ class Checkpoint:
     memory: Dict = field(default_factory=dict)
     sample_count: int = 0
     first_sample_ts: float = 0.0
+    namespace: str = "default"
 
 
 class CheckpointManager:
@@ -207,6 +216,7 @@ class CheckpointManager:
                 Checkpoint(
                     vpa=key.vpa,
                     container=key.container,
+                    namespace=key.namespace,
                     cpu=self.model.cpu.checkpoint(i),
                     memory=self.model.memory.checkpoint(i),
                     sample_count=meta.sample_count,
@@ -217,7 +227,7 @@ class CheckpointManager:
 
     def load(self, checkpoints: Sequence[Checkpoint]) -> None:
         for ckpt in checkpoints:
-            key = ContainerKey(ckpt.vpa, ckpt.container)
+            key = ContainerKey(ckpt.vpa, ckpt.container, ckpt.namespace)
             i = self.model.series(key)
             self.model.cpu.restore(i, ckpt.cpu)
             self.model.memory.restore(i, ckpt.memory)

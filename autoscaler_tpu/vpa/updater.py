@@ -117,9 +117,14 @@ class Updater:
             budget = self.rate_limiter.budget_for(len(pods))
             candidates: List[PodUpdatePriority] = []
             for pod in pods:
-                key = ContainerKey(vpa, pod.name.rsplit("-", 1)[0])
+                key = ContainerKey(vpa, pod.name.rsplit("-", 1)[0], pod.namespace)
                 rec = recommendations.get(key) or next(
-                    (r for k, r in recommendations.items() if k.vpa == vpa), None
+                    (
+                        r
+                        for k, r in recommendations.items()
+                        if k.vpa == vpa and k.namespace == pod.namespace
+                    ),
+                    None,
                 )
                 if rec is None:
                     continue

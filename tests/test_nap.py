@@ -187,3 +187,86 @@ class TestCleanup:
         removed = NodeGroupManager().remove_unneeded_node_groups(provider)
         assert removed == ["nap-x"]
         assert [g.id() for g in provider.node_groups()] == ["keep"]
+
+
+class TestAffinityCandidates:
+    def test_affinity_only_pod_gets_labeled_candidate(self):
+        """A pod placing itself via required node affinity (no nodeSelector)
+        must get a candidate template carrying the affinity labels, and the
+        pod must fit its own candidate."""
+        from autoscaler_tpu.kube.objects import (
+            Affinity,
+            LabelSelector,
+            LabelSelectorRequirement,
+        )
+        from autoscaler_tpu.processors.nodegroups import _pod_fits_template
+
+        provider = TestCloudProvider()
+        proc = processor_for(provider)
+        aff = Affinity(
+            node_selector_terms=(
+                LabelSelector(
+                    match_expressions=(
+                        LabelSelectorRequirement("pool", "In", ("train",)),
+                    )
+                ),
+            )
+        )
+        pod = build_test_pod("p", cpu_m=1000, affinity=aff)
+        cands = proc.process(provider, [pod], [])
+        assert len(cands) == 1
+        template = cands[0].template_node_info()
+        assert template.labels.get("pool") == "train"
+        assert _pod_fits_template(pod, template)
+
+    def test_unsynthesizable_affinity_skipped(self):
+        """Gt/Lt expressions can't be satisfied by a guessed label — no dead
+        candidate should be produced."""
+        from autoscaler_tpu.kube.objects import (
+            Affinity,
+            LabelSelector,
+            LabelSelectorRequirement,
+        )
+
+        provider = TestCloudProvider()
+        proc = processor_for(provider)
+        aff = Affinity(
+            node_selector_terms=(
+                LabelSelector(
+                    match_expressions=(
+                        LabelSelectorRequirement("zone-rank", "Gt", ("5",)),
+                    )
+                ),
+            )
+        )
+        pod = build_test_pod("p", cpu_m=1000, affinity=aff)
+        assert proc.process(provider, [pod], []) == []
+
+    def test_distinct_affinity_distinct_groups(self):
+        from autoscaler_tpu.kube.objects import (
+            Affinity,
+            LabelSelector,
+            LabelSelectorRequirement,
+        )
+
+        provider = TestCloudProvider()
+        proc = processor_for(provider)
+
+        def aff(v):
+            return Affinity(
+                node_selector_terms=(
+                    LabelSelector(
+                        match_expressions=(
+                            LabelSelectorRequirement("pool", "In", (v,)),
+                        )
+                    ),
+                )
+            )
+
+        pods = [
+            build_test_pod("a", cpu_m=1000, affinity=aff("train")),
+            build_test_pod("b", cpu_m=1000, affinity=aff("serve")),
+        ]
+        cands = proc.process(provider, pods, [])
+        assert len(cands) == 2
+        assert cands[0].id() != cands[1].id()

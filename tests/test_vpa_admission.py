@@ -230,3 +230,86 @@ class TestFeederAndHistory:
         # p90 of constant 0.5-core usage, +15% margin → ~0.575
         assert rec.target_cpu == pytest.approx(0.575, rel=0.2)
         assert rec.target_memory >= 1 * GB
+
+
+class TestProportionalLimits:
+    def test_limit_scaled_with_request(self):
+        """Raising a 100m request to 500m must scale a 200m limit to 1000m
+        (ratio preserved) — otherwise the apiserver rejects the pod."""
+        containers = [
+            {
+                "name": "main",
+                "resources": {
+                    "requests": {"cpu": "100m", "memory": "256Mi"},
+                    "limits": {"cpu": "200m", "memory": "512Mi"},
+                },
+            }
+        ]
+        out = review_pod(
+            make_review(containers=containers),
+            [make_vpa()],
+            {ContainerKey("my-vpa", "main"): REC},
+        )
+        patch = decode_patch(out)
+        by_path = {p["path"]: p["value"] for p in patch}
+        assert by_path["/spec/containers/0/resources/requests/cpu"] == "500m"
+        assert by_path["/spec/containers/0/resources/limits/cpu"] == "1000m"
+        # memory: request 256Mi -> 1GB, limit 512Mi -> 2GB (ratio 2)
+        assert by_path["/spec/containers/0/resources/limits/memory"] == str(2 * GB)
+
+    def test_limit_without_request_tracks_new_request(self):
+        """K8s defaults request := limit, so ratio is 1 and the new limit
+        equals the new request."""
+        containers = [
+            {"name": "main", "resources": {"limits": {"cpu": "200m"}}}
+        ]
+        out = review_pod(
+            make_review(containers=containers),
+            [make_vpa()],
+            {ContainerKey("my-vpa", "main"): REC},
+        )
+        by_path = {p["path"]: p["value"] for p in decode_patch(out)}
+        assert by_path["/spec/containers/0/resources/limits/cpu"] == "500m"
+        assert by_path["/spec/containers/0/resources/requests/cpu"] == "500m"
+
+    def test_no_limits_no_limit_patch(self):
+        out = review_pod(
+            make_review(), [make_vpa()], {ContainerKey("my-vpa", "main"): REC}
+        )
+        paths = [p["path"] for p in decode_patch(out)]
+        assert not any("limits" in p for p in paths)
+
+
+class TestNamespaceScoping:
+    def test_same_named_vpas_isolated_by_namespace(self):
+        """Two VPAs named 'my-vpa' in different namespaces must not share
+        recommendations (ContainerKey carries the namespace)."""
+        vpa_a = make_vpa()  # namespace default
+        vpa_b = Vpa(
+            name="my-vpa",
+            namespace="team-b",
+            target_selector=LabelSelector.from_dict({"app": "web"}),
+        )
+        recs = {ContainerKey("my-vpa", "main", "team-b"): REC}
+        # pod in "default": its VPA has no recommendation -> no patch
+        out = review_pod(make_review(), [vpa_a, vpa_b], recs)
+        assert "patch" not in out["response"]
+        # pod in team-b gets the patch
+        review = make_review()
+        review["request"]["namespace"] = "team-b"
+        out = review_pod(review, [vpa_b], recs)
+        assert "patch" in out["response"]
+
+    def test_feeder_keys_namespaced(self):
+        model = ClusterStateModel()
+        vpa_a = make_vpa()
+        vpa_b = Vpa(
+            name="my-vpa",
+            namespace="team-b",
+            target_selector=LabelSelector.from_dict({"app": "web"}),
+        )
+        feeder = ClusterStateFeeder(model, [vpa_a, vpa_b])
+        key_a = feeder._key_for("default", {"app": "web"}, "main")
+        key_b = feeder._key_for("team-b", {"app": "web"}, "main")
+        assert key_a is not None and key_b is not None
+        assert key_a != key_b
