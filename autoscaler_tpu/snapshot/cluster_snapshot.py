@@ -1,19 +1,28 @@
-"""Host-side cluster snapshot with O(1) fork / O(1) revert / O(delta) commit.
+"""Host-side cluster snapshot with O(1) fork / O(delta) revert / O(1) commit.
 
 Mirrors the contract of the reference's ClusterSnapshot interface
 (cluster-autoscaler/simulator/clustersnapshot/clustersnapshot.go:29:
 AddNode/AddPod/RemovePod/RemoveNode/Fork/Revert/Commit/Clear) and the
-complexity profile of its DeltaClusterSnapshot (delta.go:43,448-469), but as a
-stack of operation layers over plain dataclasses instead of layered NodeInfo
-caches. This object-level snapshot drives host decisions (drain rules,
+complexity profile of its DeltaClusterSnapshot (delta.go:43,448-469).
+
+Representation: a *live effective index* (nodes, pods, assignments, and a
+node→pod-keys index) mutated in place, plus a per-fork undo log of inverse
+operations. Fork pushes an empty log; revert replays the top log backwards;
+commit splices the top log into the parent's (so reverting the parent still
+undoes both). This makes every read O(result) — `pods_on_node` is an index
+lookup, not a scan — where the reference's delta snapshot pays a layered
+cache walk (delta.go:97-135). The old layer-walk design here cost O(pods)
+per `assignment`/`pods_on_node` call, which dominated scale-down candidate
+simulation on big snapshots.
+
+This object-level snapshot drives host decisions (drain rules,
 template-node injection); `tensors()` materializes it into the padded
 SnapshotTensors pytree consumed by the device kernels, cached per version.
 """
 from __future__ import annotations
 
 import dataclasses
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from autoscaler_tpu.kube.objects import Node, Pod
 from autoscaler_tpu.snapshot.packer import SnapshotMeta, pack
@@ -24,176 +33,171 @@ class SnapshotError(Exception):
     pass
 
 
-@dataclass
-class _Layer:
-    added_nodes: Dict[str, Node] = field(default_factory=dict)
-    removed_nodes: Set[str] = field(default_factory=set)
-    added_pods: Dict[str, Pod] = field(default_factory=dict)
-    removed_pods: Set[str] = field(default_factory=set)
-    # pod key -> node name ("" = unassign)
-    assignments: Dict[str, str] = field(default_factory=dict)
+# Undo opcodes (op, *payload) — applied in reverse order on revert.
+_DEL_NODE = 0   # (name,)                — undo of add_node
+_PUT_NODE = 1   # (name, node)           — undo of remove_node
+_DEL_POD = 2    # (key,)                 — undo of add_pod
+_PUT_POD = 3    # (key, pod, assign)     — undo of remove_pod
+_ASSIGN = 4     # (key, old_assign)      — undo of schedule_pod
 
 
 class ClusterSnapshot:
     def __init__(self) -> None:
-        self._layers: List[_Layer] = [_Layer()]
+        self._nodes: Dict[str, Node] = {}
+        self._pods: Dict[str, Pod] = {}
+        self._assign: Dict[str, str] = {}          # pod key -> node name
+        self._by_node: Dict[str, Dict[str, None]] = {}  # node -> ordered pod keys
+        self._undo: List[List[Tuple]] = [[]]       # one log per fork level
         self._version = 0
         self._cache: Optional[Tuple[int, SnapshotTensors, SnapshotMeta]] = None
         self._cached_group_map: Optional[Dict[str, str]] = None
 
     # -- mutation -----------------------------------------------------------
-    def _top(self) -> _Layer:
-        return self._layers[-1]
-
     def _bump(self) -> None:
         self._version += 1
 
+    def _log(self, entry: Tuple) -> None:
+        # The base level can never be reverted (revert at depth 0 raises), so
+        # logging there would only pin dead objects — the every-loop snapshot
+        # rebuild adds O(nodes+pods) entries that nothing could ever replay.
+        if len(self._undo) > 1:
+            self._undo[-1].append(entry)
+
+    def _set_assign(self, key: str, node_name: str) -> None:
+        old = self._assign.get(key, "")
+        if old:
+            self._by_node.get(old, {}).pop(key, None)
+        if node_name:
+            self._assign[key] = node_name
+            self._by_node.setdefault(node_name, {})[key] = None
+        else:
+            self._assign.pop(key, None)
+
     def add_node(self, node: Node) -> None:
-        if self._find_node(node.name) is not None:
+        if node.name in self._nodes:
             raise SnapshotError(f"node {node.name} already in snapshot")
-        self._top().added_nodes[node.name] = node
-        self._top().removed_nodes.discard(node.name)
+        self._nodes[node.name] = node
+        self._by_node.setdefault(node.name, {})
+        self._log((_DEL_NODE, node.name))
         self._bump()
 
     def remove_node(self, name: str) -> None:
-        if self._find_node(name) is None:
+        node = self._nodes.get(name)
+        if node is None:
             raise SnapshotError(f"node {name} not in snapshot")
-        for pod in self.pods_on_node(name):
-            self.remove_pod(pod.key())
-        top = self._top()
-        top.added_nodes.pop(name, None)
-        top.removed_nodes.add(name)
+        for key in list(self._by_node.get(name, ())):
+            self.remove_pod(key)
+        del self._nodes[name]
+        self._log((_PUT_NODE, name, node))
         self._bump()
 
     def add_pod(self, pod: Pod, node_name: str = "") -> None:
-        if self._find_pod(pod.key()) is not None:
-            raise SnapshotError(f"pod {pod.key()} already in snapshot")
-        if node_name and self._find_node(node_name) is None:
+        key = pod.key()
+        if key in self._pods:
+            raise SnapshotError(f"pod {key} already in snapshot")
+        if node_name and node_name not in self._nodes:
             raise SnapshotError(f"node {node_name} not in snapshot")
-        top = self._top()
-        top.added_pods[pod.key()] = pod
-        top.removed_pods.discard(pod.key())
-        if node_name or pod.node_name:
-            top.assignments[pod.key()] = node_name or pod.node_name
+        assign = node_name or pod.node_name
+        self._pods[key] = pod
+        self._set_assign(key, assign)
+        self._log((_DEL_POD, key))
         self._bump()
 
     def remove_pod(self, pod_key: str) -> None:
-        if self._find_pod(pod_key) is None:
+        pod = self._pods.get(pod_key)
+        if pod is None:
             raise SnapshotError(f"pod {pod_key} not in snapshot")
-        top = self._top()
-        top.added_pods.pop(pod_key, None)
-        top.removed_pods.add(pod_key)
-        top.assignments.pop(pod_key, None)
+        assign = self._assign.get(pod_key, "")
+        del self._pods[pod_key]
+        self._set_assign(pod_key, "")
+        self._log((_PUT_POD, pod_key, pod, assign))
         self._bump()
 
     def schedule_pod(self, pod_key: str, node_name: str) -> None:
-        if self._find_pod(pod_key) is None:
+        if pod_key not in self._pods:
             raise SnapshotError(f"pod {pod_key} not in snapshot")
-        if self._find_node(node_name) is None:
+        if node_name not in self._nodes:
             raise SnapshotError(f"node {node_name} not in snapshot")
-        self._top().assignments[pod_key] = node_name
+        old = self._assign.get(pod_key, "")
+        self._set_assign(pod_key, node_name)
+        self._log((_ASSIGN, pod_key, old))
         self._bump()
 
     def clear(self) -> None:
-        self._layers = [_Layer()]
+        self._nodes.clear()
+        self._pods.clear()
+        self._assign.clear()
+        self._by_node.clear()
+        self._undo = [[]]
         self._bump()
 
     # -- fork/revert/commit (reference: delta.go:448,454,462) ---------------
     def fork(self) -> None:
-        self._layers.append(_Layer())
+        self._undo.append([])
 
     def revert(self) -> None:
-        if len(self._layers) == 1:
+        if len(self._undo) == 1:
             raise SnapshotError("revert with no fork")
-        self._layers.pop()
+        for entry in reversed(self._undo.pop()):
+            op = entry[0]
+            if op == _DEL_NODE:
+                _, name = entry
+                self._nodes.pop(name, None)
+                # Keep a non-empty bucket: pods added before the fork with a
+                # node_name referencing this (then-absent) node still belong
+                # to it — the pre-fork index state had that ghost membership.
+                if not self._by_node.get(name):
+                    self._by_node.pop(name, None)
+            elif op == _PUT_NODE:
+                _, name, node = entry
+                self._nodes[name] = node
+                self._by_node.setdefault(name, {})
+            elif op == _DEL_POD:
+                _, key = entry
+                del self._pods[key]
+                self._set_assign(key, "")
+            elif op == _PUT_POD:
+                _, key, pod, assign = entry
+                self._pods[key] = pod
+                self._set_assign(key, assign)
+            else:  # _ASSIGN
+                _, key, old = entry
+                self._set_assign(key, old)
         self._bump()
 
     def commit(self) -> None:
-        if len(self._layers) == 1:
+        if len(self._undo) == 1:
             return
-        top = self._layers.pop()
-        parent = self._layers[-1]
-        for name in top.removed_nodes:
-            parent.added_nodes.pop(name, None)
-            parent.removed_nodes.add(name)
-        parent.added_nodes.update(top.added_nodes)
-        for name in top.added_nodes:
-            parent.removed_nodes.discard(name)
-        for key in top.removed_pods:
-            parent.added_pods.pop(key, None)
-            parent.removed_pods.add(key)
-            parent.assignments.pop(key, None)
-        parent.added_pods.update(top.added_pods)
-        for key in top.added_pods:
-            parent.removed_pods.discard(key)
-        parent.assignments.update(top.assignments)
+        top = self._undo.pop()
+        if len(self._undo) > 1:
+            self._undo[-1].extend(top)
         self._bump()
 
     @property
     def fork_depth(self) -> int:
-        return len(self._layers) - 1
+        return len(self._undo) - 1
 
     # -- reads --------------------------------------------------------------
-    def _find_node(self, name: str) -> Optional[Node]:
-        for layer in reversed(self._layers):
-            if name in layer.removed_nodes:
-                return None
-            if name in layer.added_nodes:
-                return layer.added_nodes[name]
-        return None
-
-    def _find_pod(self, key: str) -> Optional[Pod]:
-        for layer in reversed(self._layers):
-            if key in layer.removed_pods:
-                return None
-            if key in layer.added_pods:
-                return layer.added_pods[key]
-        return None
-
     def get_node(self, name: str) -> Optional[Node]:
-        return self._find_node(name)
+        return self._nodes.get(name)
 
     def get_pod(self, key: str) -> Optional[Pod]:
-        return self._find_pod(key)
+        return self._pods.get(key)
 
     def nodes(self) -> List[Node]:
-        out: List[Node] = []
-        emitted: Set[str] = set()
-        for layer in self._layers:
-            for name, node in layer.added_nodes.items():
-                if name in emitted:
-                    continue
-                if self._find_node(name) is node:
-                    out.append(node)
-                    emitted.add(name)
-        return out
+        return list(self._nodes.values())
 
     def pods(self) -> List[Pod]:
-        out: List[Pod] = []
-        emitted: Set[str] = set()
-        for layer in self._layers:
-            for key, pod in layer.added_pods.items():
-                if key in emitted:
-                    continue
-                if self._find_pod(key) is pod:
-                    out.append(pod)
-                    emitted.add(key)
-        return out
+        return list(self._pods.values())
 
     def assignment(self, pod_key: str) -> str:
-        for layer in reversed(self._layers):
-            if pod_key in layer.assignments:
-                return layer.assignments[pod_key]
-            if pod_key in layer.removed_pods:
-                return ""
-        pod = self._find_pod(pod_key)
-        return pod.node_name if pod else ""
+        return self._assign.get(pod_key, "")
 
     def pods_on_node(self, node_name: str) -> List[Pod]:
-        return [p for p in self.pods() if self.assignment(p.key()) == node_name]
+        return [self._pods[k] for k in self._by_node.get(node_name, ())]
 
     def pending_pods(self) -> List[Pod]:
-        return [p for p in self.pods() if not self.assignment(p.key())]
+        return [p for k, p in self._pods.items() if k not in self._assign]
 
     # -- tensor materialization --------------------------------------------
     def tensors(
@@ -208,8 +212,8 @@ class ClusterSnapshot:
         ):
             return self._cache[1], self._cache[2]
         pods = []
-        for pod in self.pods():
-            assigned = self.assignment(pod.key())
+        for key, pod in self._pods.items():
+            assigned = self._assign.get(key, "")
             if assigned != pod.node_name:
                 pod = dataclasses.replace(pod, node_name=assigned)
             pods.append(pod)

@@ -240,3 +240,160 @@ def test_mask_symmetric_anti_affinity_not_self():
     )
     t, meta = pack([n0], [p])
     assert np.asarray(t.sched_mask)[0, meta.node_index["n0"]]
+
+
+class TestUndoLogDifferential:
+    """Randomized differential test: the undo-log snapshot must match a naive
+    copy-on-fork model over arbitrary op sequences (the contract the
+    reference locks in clustersnapshot_test.go's fork/revert/commit grid)."""
+
+    class _Naive:
+        def __init__(self):
+            self.stack = [({}, {}, {})]  # (nodes, pods, assign)
+
+        def _top(self):
+            return self.stack[-1]
+
+        def fork(self):
+            n, p, a = self.stack[-1]
+            self.stack.append((dict(n), dict(p), dict(a)))
+
+        def revert(self):
+            self.stack.pop()
+
+        def commit(self):
+            top = self.stack.pop()
+            self.stack[-1] = top
+
+        def add_node(self, node):
+            self._top()[0][node.name] = node
+
+        def remove_node(self, name):
+            n, p, a = self._top()
+            del n[name]
+            for k in [k for k, v in a.items() if v == name]:
+                del p[k]
+                del a[k]
+
+        def add_pod(self, pod, node_name=""):
+            n, p, a = self._top()
+            p[pod.key()] = pod
+            assign = node_name or pod.node_name
+            if assign:
+                a[pod.key()] = assign
+
+        def remove_pod(self, key):
+            n, p, a = self._top()
+            del p[key]
+            a.pop(key, None)
+
+        def schedule_pod(self, key, node):
+            self._top()[2][key] = node
+
+        def state(self):
+            n, p, a = self._top()
+            return (
+                sorted(n),
+                sorted(p),
+                sorted(a.items()),
+            )
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_ops(self, seed):
+        import random
+
+        rng = random.Random(seed)
+        snap = ClusterSnapshot()
+        naive = self._Naive()
+        node_names = [f"n{i}" for i in range(12)]
+        pod_names = [f"p{i}" for i in range(30)]
+
+        for _ in range(400):
+            op = rng.random()
+            if True:
+                if op < 0.2:
+                    name = rng.choice(node_names)
+                    if snap.get_node(name) is None:
+                        snap.add_node(build_test_node(name))
+                        naive.add_node(build_test_node(name))
+                elif op < 0.3:
+                    live = snap.nodes()
+                    if live:
+                        name = rng.choice(live).name
+                        snap.remove_node(name)
+                        naive.remove_node(name)
+                elif op < 0.5:
+                    pn = rng.choice(pod_names)
+                    pod = build_test_pod(pn)
+                    if snap.get_pod(pod.key()) is None:
+                        live = snap.nodes()
+                        target = rng.choice(live).name if live and rng.random() < 0.5 else ""
+                        snap.add_pod(pod, target)
+                        naive.add_pod(pod, target)
+                elif op < 0.6:
+                    live = snap.pods()
+                    if live:
+                        key = rng.choice(live).key()
+                        snap.remove_pod(key)
+                        naive.remove_pod(key)
+                elif op < 0.7:
+                    livep, liven = snap.pods(), snap.nodes()
+                    if livep and liven:
+                        key = rng.choice(livep).key()
+                        node = rng.choice(liven).name
+                        snap.schedule_pod(key, node)
+                        naive.schedule_pod(key, node)
+                elif op < 0.8:
+                    snap.fork()
+                    naive.fork()
+                elif op < 0.9:
+                    if snap.fork_depth > 0:
+                        snap.revert()
+                        naive.revert()
+                else:
+                    if snap.fork_depth > 0:
+                        snap.commit()
+                        naive.commit()
+
+            n, p, a = naive.state()
+            assert sorted(x.name for x in snap.nodes()) == n
+            assert sorted(x.key() for x in snap.pods()) == p
+            got_assign = sorted(
+                (x.key(), snap.assignment(x.key()))
+                for x in snap.pods()
+                if snap.assignment(x.key())
+            )
+            assert got_assign == a
+            # index consistency
+            for node in snap.nodes():
+                for pod in snap.pods_on_node(node.name):
+                    assert snap.assignment(pod.key()) == node.name
+
+
+def test_ghost_assignment_survives_add_node_revert():
+    """A pod whose node_name references a not-yet-present node keeps its
+    index membership when an add_node of that node is reverted (the bucket
+    must not be destroyed with the node)."""
+    snap = ClusterSnapshot()
+    snap.add_pod(build_test_pod("p", node_name="n1"))
+    snap.fork()
+    snap.add_node(build_test_node("n1"))
+    assert [p.name for p in snap.pods_on_node("n1")] == ["p"]
+    snap.revert()
+    assert snap.assignment("default/p") == "n1"
+    assert [p.name for p in snap.pods_on_node("n1")] == ["p"]
+    snap.add_node(build_test_node("n1"))
+    assert [p.name for p in snap.pods_on_node("n1")] == ["p"]
+
+
+def test_base_level_mutations_not_logged():
+    snap = ClusterSnapshot()
+    snap.add_node(build_test_node("n"))
+    snap.add_pod(build_test_pod("p", node_name="n"))
+    snap.remove_pod("default/p")
+    assert snap._undo == [[]]
+    snap.fork()
+    snap.add_node(build_test_node("m"))
+    assert len(snap._undo[1]) == 1
+    snap.commit()  # splice into base -> dropped
+    assert snap._undo == [[]]
