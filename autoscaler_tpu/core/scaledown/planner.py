@@ -77,11 +77,14 @@ class ScaleDownPlanner:
         )
         self._utilization = utilization
 
-        # candidate-pool bounds (legacy.go:152-180)
-        pool = self._bound_candidates(eligible)
-
-        empty_names = set(self.simulator.find_empty_nodes(snapshot, pool))
-        non_empty = [n for n in pool if n not in empty_names]
+        # Empty nodes are detected over ALL eligible nodes — they need no
+        # drain simulation, and the reference finds them before the pool
+        # heuristics kick in (legacy.go:101 phase order: utilization filter →
+        # empty nodes → candidate pools). The pool bounds (legacy.go:152-180)
+        # only cap the expensive non-empty (drain-simulation) candidates.
+        empty_names = set(self.simulator.find_empty_nodes(snapshot, eligible))
+        pool = self._bound_candidates([n for n in eligible if n not in empty_names])
+        non_empty = pool
         limit = self.options.scale_down_non_empty_candidates_count
         if limit > 0:
             non_empty = non_empty[:limit]

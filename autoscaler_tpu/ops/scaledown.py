@@ -31,12 +31,11 @@ def empty_nodes(snap: SnapshotTensors, movable: jax.Array) -> jax.Array:
     (daemonset/mirror), i.e. removable without any rescheduling
     (reference FindEmptyNodesToRemove, cluster.go:187). `movable` is the
     host-computed [P] drain-rules verdict: True = pod must be re-placed."""
-    pod_on_node = jax.nn.one_hot(
-        snap.pod_node, snap.num_nodes, dtype=jnp.float32
-    )  # [P, N]; pod_node=-1 rows are all-zero
-    movable_count = jnp.einsum(
-        "pn,p->n", pod_on_node, (movable & snap.pod_valid).astype(jnp.float32)
-    )
+    # Segment-sum over pod→node assignment: O(P), vs the [P, N] one-hot
+    # matmul this replaced (~6GB of HBM at 100k pods × 15k nodes).
+    w = (movable & snap.pod_valid & (snap.pod_node >= 0)).astype(jnp.float32)
+    seg = jnp.clip(snap.pod_node, 0, snap.num_nodes - 1)
+    movable_count = jax.ops.segment_sum(w, seg, num_segments=snap.num_nodes)
     return snap.node_valid & (movable_count == 0)
 
 

@@ -48,6 +48,7 @@ class ClusterSnapshot:
         self._assign: Dict[str, str] = {}          # pod key -> node name
         self._by_node: Dict[str, Dict[str, None]] = {}  # node -> ordered pod keys
         self._undo: List[List[Tuple]] = [[]]       # one log per fork level
+        self._fork_versions: List[int] = []        # version at each fork()
         self._version = 0
         self._cache: Optional[Tuple[int, SnapshotTensors, SnapshotMeta]] = None
         self._cached_group_map: Optional[Dict[str, str]] = None
@@ -88,6 +89,9 @@ class ClusterSnapshot:
         for key in list(self._by_node.get(name, ())):
             self.remove_pod(key)
         del self._nodes[name]
+        # the bucket is empty now (every member was just removed) — pop it so
+        # node-name churn doesn't accumulate dead buckets
+        self._by_node.pop(name, None)
         self._log((_PUT_NODE, name, node))
         self._bump()
 
@@ -129,11 +133,13 @@ class ClusterSnapshot:
         self._assign.clear()
         self._by_node.clear()
         self._undo = [[]]
+        self._fork_versions = []
         self._bump()
 
     # -- fork/revert/commit (reference: delta.go:448,454,462) ---------------
     def fork(self) -> None:
         self._undo.append([])
+        self._fork_versions.append(self._version)
 
     def revert(self) -> None:
         if len(self._undo) == 1:
@@ -163,12 +169,21 @@ class ClusterSnapshot:
             else:  # _ASSIGN
                 _, key, old = entry
                 self._set_assign(key, old)
-        self._bump()
+        # Revert restores the exact fork-time state, so restore the fork-time
+        # version too: a tensors() cache built before the fork stays valid
+        # (saves one full re-pack per loop in the fork→filter→revert pattern).
+        # A cache built *inside* the fork holds now-dead state whose version
+        # numbers are about to be reused — drop it.
+        saved = self._fork_versions.pop()
+        if self._cache is not None and self._cache[0] > saved:
+            self._cache = None
+        self._version = saved
 
     def commit(self) -> None:
         if len(self._undo) == 1:
             return
         top = self._undo.pop()
+        self._fork_versions.pop()
         if len(self._undo) > 1:
             self._undo[-1].extend(top)
         self._bump()

@@ -1,0 +1,147 @@
+"""Kubemark-style hollow scale test: the reference's scalability procedure
+run in-process against the fake provider/API.
+
+Reference: cluster-autoscaler/proposals/scalability_tests.md — the GA scale
+claim is 1000 nodes × 30 pods/node (:6), with a loop-duration bound of <30s
+target / <10s measured (:14,70), a 30k-pod scale-up burst filling to 1000
+nodes (:30-34), and a scale-down scenario removing 300 empty of 1000 nodes
+(:44-48). The reference runs this against kubemark hollow nodes on 17 VMs;
+here the cluster is simulated in-process (nodes/pods are plain objects, the
+decisions run on the device kernels), which is exactly what the reference's
+own simulation-first design enables.
+
+These run in CI on the 8-virtual-device CPU platform, so the asserted loop
+bound is the reference's *target* (30s) rather than its measured 10s on
+dedicated hardware; bench.py tracks the real-TPU numbers.
+"""
+import time
+
+import pytest
+
+from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from autoscaler_tpu.kube.api import FakeClusterAPI
+from autoscaler_tpu.kube.objects import OwnerRef
+from autoscaler_tpu.utils.test_utils import GB, MB, build_test_node, build_test_pod
+
+NODES = 1000
+PODS_PER_NODE = 30
+# node shape: 8 cores / 32GB, 110-pod kubelet default — 30 × (250m, 1GB)
+# pods fill 7.5 cores / 30GB, the kubemark-ish "full node"
+NODE_CPU = 8000
+NODE_MEM = 32 * GB
+POD_CPU = 250
+POD_MEM = 1 * GB
+
+
+def burst_pods(n, start=0):
+    pods = []
+    for i in range(start, start + n):
+        p = build_test_pod(f"burst-{i}", cpu_m=POD_CPU, mem=POD_MEM)
+        # one controller → one equivalence run → one scan step on device
+        p.owner_ref = OwnerRef(kind="ReplicaSet", name="burst-rs")
+        pods.append(p)
+    return pods
+
+
+def build_world(started_nodes, pods=()):
+    provider = TestCloudProvider()
+    api = FakeClusterAPI()
+    provider.add_node_group(
+        "g", 0, NODES, started_nodes,
+        build_test_node("g-tmpl", cpu_m=NODE_CPU, mem=NODE_MEM),
+    )
+    for i in range(started_nodes):
+        node = build_test_node(f"g-{i}", cpu_m=NODE_CPU, mem=NODE_MEM)
+        provider.add_node("g", node)
+        api.add_node(node)
+    for pod in pods:
+        api.add_pod(pod)
+    opts = AutoscalingOptions(expander="least-waste")
+    return provider, api, StaticAutoscaler(provider, api, opts)
+
+
+class TestScaleUpBurst:
+    def test_30k_pod_burst_fills_1000_nodes(self):
+        """scalability_tests.md:30-34 — 30k pending pods on an empty group
+        must produce one scale-up request to (max) 1000 nodes, within the
+        reference's 30s loop target."""
+        pods = burst_pods(NODES * PODS_PER_NODE)
+        provider, api, autoscaler = build_world(started_nodes=1, pods=pods)
+        t0 = time.perf_counter()
+        result = autoscaler.run_once(now_ts=100.0)
+        loop_s = time.perf_counter() - t0
+        assert result.scale_up is not None and result.scale_up.scaled_up
+        # 30k pods × 250m = 7500 cores → needs ~938 full nodes; the group
+        # fills to its 1000-node max or the exact estimate, whichever is less
+        assert result.scale_up.new_nodes >= 900
+        assert result.scale_up.new_nodes <= NODES
+        assert provider.scale_up_calls and provider.scale_up_calls[0][0] == "g"
+        assert loop_s < 30.0, f"loop took {loop_s:.1f}s (reference target 30s)"
+
+    def test_second_loop_no_double_request(self):
+        """Upcoming (requested-but-unregistered) nodes must absorb the pending
+        pods — the next loop may not re-request the same capacity
+        (static_autoscaler.go:484-519 upcoming-node injection)."""
+        pods = burst_pods(5000)
+        provider, api, autoscaler = build_world(started_nodes=1, pods=pods)
+        r1 = autoscaler.run_once(now_ts=100.0)
+        assert r1.scale_up is not None and r1.scale_up.scaled_up
+        first = r1.scale_up.new_nodes
+        r2 = autoscaler.run_once(now_ts=110.0)
+        second = r2.scale_up.new_nodes if (r2.scale_up and r2.scale_up.scaled_up) else 0
+        assert second <= first * 0.1, (
+            f"second loop re-requested {second} nodes on top of {first}"
+        )
+
+
+class TestScaleDown300:
+    def test_300_empty_of_1000_removed(self):
+        """scalability_tests.md:44-48 — 300 empty nodes among 1000 are found
+        unneeded and deleted after the unneeded-time, bounded per loop by the
+        empty-bulk-delete budget."""
+        pods = []
+        for i in range(300, NODES):  # nodes 300..999 carry load, 0..299 empty
+            for j in range(3):
+                pods.append(
+                    build_test_pod(
+                        f"w-{i}-{j}", cpu_m=2000, mem=8 * GB, node_name=f"g-{i}"
+                    )
+                )
+        provider, api, autoscaler = build_world(started_nodes=NODES, pods=pods)
+        autoscaler.options.node_group_defaults.scale_down_unneeded_time_s = 60
+        autoscaler.options.scale_down_delay_after_add_s = 0
+        # raise the per-loop deletion budgets like the reference's scale test
+        # config does (both default to 10, actuator budget-crop)
+        autoscaler.options.max_empty_bulk_delete = 300
+        autoscaler.options.max_scale_down_parallelism = 300
+
+        t0 = time.perf_counter()
+        r1 = autoscaler.run_once(now_ts=100.0)
+        loop_s = time.perf_counter() - t0
+        assert r1.unneeded_nodes >= 300
+        assert r1.scale_down is None  # unneeded-time not yet reached
+        assert loop_s < 30.0, f"loop took {loop_s:.1f}s (reference target 30s)"
+
+        r2 = autoscaler.run_once(now_ts=200.0)
+        assert r2.scale_down is not None
+        deleted = set(r2.scale_down.deleted_empty)
+        assert len(deleted) == 300
+        assert deleted == {f"g-{i}" for i in range(300)}
+
+    def test_loaded_nodes_stay(self):
+        pods = []
+        for i in range(NODES):
+            for j in range(6):
+                pods.append(
+                    build_test_pod(
+                        f"w-{i}-{j}", cpu_m=1200, mem=5 * GB, node_name=f"g-{i}"
+                    )
+                )
+        provider, api, autoscaler = build_world(started_nodes=NODES, pods=pods)
+        autoscaler.options.node_group_defaults.scale_down_unneeded_time_s = 0
+        autoscaler.options.scale_down_delay_after_add_s = 0
+        r = autoscaler.run_once(now_ts=100.0)
+        assert r.unneeded_nodes == 0
+        assert r.scale_down is None or not r.scale_down.deleted_empty

@@ -310,50 +310,49 @@ class TestUndoLogDifferential:
 
         for _ in range(400):
             op = rng.random()
-            if True:
-                if op < 0.2:
-                    name = rng.choice(node_names)
-                    if snap.get_node(name) is None:
-                        snap.add_node(build_test_node(name))
-                        naive.add_node(build_test_node(name))
-                elif op < 0.3:
+            if op < 0.2:
+                name = rng.choice(node_names)
+                if snap.get_node(name) is None:
+                    snap.add_node(build_test_node(name))
+                    naive.add_node(build_test_node(name))
+            elif op < 0.3:
+                live = snap.nodes()
+                if live:
+                    name = rng.choice(live).name
+                    snap.remove_node(name)
+                    naive.remove_node(name)
+            elif op < 0.5:
+                pn = rng.choice(pod_names)
+                pod = build_test_pod(pn)
+                if snap.get_pod(pod.key()) is None:
                     live = snap.nodes()
-                    if live:
-                        name = rng.choice(live).name
-                        snap.remove_node(name)
-                        naive.remove_node(name)
-                elif op < 0.5:
-                    pn = rng.choice(pod_names)
-                    pod = build_test_pod(pn)
-                    if snap.get_pod(pod.key()) is None:
-                        live = snap.nodes()
-                        target = rng.choice(live).name if live and rng.random() < 0.5 else ""
-                        snap.add_pod(pod, target)
-                        naive.add_pod(pod, target)
-                elif op < 0.6:
-                    live = snap.pods()
-                    if live:
-                        key = rng.choice(live).key()
-                        snap.remove_pod(key)
-                        naive.remove_pod(key)
-                elif op < 0.7:
-                    livep, liven = snap.pods(), snap.nodes()
-                    if livep and liven:
-                        key = rng.choice(livep).key()
-                        node = rng.choice(liven).name
-                        snap.schedule_pod(key, node)
-                        naive.schedule_pod(key, node)
-                elif op < 0.8:
-                    snap.fork()
-                    naive.fork()
-                elif op < 0.9:
-                    if snap.fork_depth > 0:
-                        snap.revert()
-                        naive.revert()
-                else:
-                    if snap.fork_depth > 0:
-                        snap.commit()
-                        naive.commit()
+                    target = rng.choice(live).name if live and rng.random() < 0.5 else ""
+                    snap.add_pod(pod, target)
+                    naive.add_pod(pod, target)
+            elif op < 0.6:
+                live = snap.pods()
+                if live:
+                    key = rng.choice(live).key()
+                    snap.remove_pod(key)
+                    naive.remove_pod(key)
+            elif op < 0.7:
+                livep, liven = snap.pods(), snap.nodes()
+                if livep and liven:
+                    key = rng.choice(livep).key()
+                    node = rng.choice(liven).name
+                    snap.schedule_pod(key, node)
+                    naive.schedule_pod(key, node)
+            elif op < 0.8:
+                snap.fork()
+                naive.fork()
+            elif op < 0.9:
+                if snap.fork_depth > 0:
+                    snap.revert()
+                    naive.revert()
+            else:
+                if snap.fork_depth > 0:
+                    snap.commit()
+                    naive.commit()
 
             n, p, a = naive.state()
             assert sorted(x.name for x in snap.nodes()) == n
@@ -397,3 +396,35 @@ def test_base_level_mutations_not_logged():
     assert len(snap._undo[1]) == 1
     snap.commit()  # splice into base -> dropped
     assert snap._undo == [[]]
+
+
+def test_tensors_cache_survives_fork_revert():
+    """The fork→mutate→revert pattern restores the exact pre-fork state, so a
+    tensors() cache built before the fork must still be served after revert
+    (no re-pack), while a cache built inside the fork must not leak out."""
+    snap = ClusterSnapshot()
+    snap.add_node(build_test_node("n"))
+    snap.add_pod(build_test_pod("p", node_name="n"))
+    t0, _ = snap.tensors()
+    snap.fork()
+    snap.add_pod(build_test_pod("q"))
+    snap.revert()
+    t1, _ = snap.tensors()
+    assert t1 is t0  # same cached object, no re-pack
+
+    snap.fork()
+    snap.add_pod(build_test_pod("q2"))
+    t_fork, _ = snap.tensors()
+    snap.revert()
+    snap.add_pod(build_test_pod("r"))
+    t2, _ = snap.tensors()
+    assert t2 is not t_fork
+    assert int(t2.pod_valid.sum()) == 2  # p + r, not the reverted q2
+
+
+def test_no_bucket_leak_on_node_churn():
+    snap = ClusterSnapshot()
+    for i in range(50):
+        snap.add_node(build_test_node(f"churn-{i}"))
+        snap.remove_node(f"churn-{i}")
+    assert len(snap._by_node) == 0
