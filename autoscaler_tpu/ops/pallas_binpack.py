@@ -217,6 +217,10 @@ def ffd_binpack_groups_pallas(
     The scan over pod chunks runs inside one jit (lax.scan), each iteration
     gathering the chunk's score-sorted requests and advancing the
     VMEM-resident usage carry via the kernel."""
+    if chunk % _STEP_TILE != 0:
+        raise ValueError(
+            f"chunk must be a multiple of {_STEP_TILE} (sublane tile); got {chunk}"
+        )
     pod_req = jnp.asarray(pod_req, jnp.float32)
     pod_masks = jnp.asarray(pod_masks)
     template_allocs = jnp.asarray(template_allocs, jnp.float32)
@@ -242,10 +246,14 @@ def ffd_binpack_groups_pallas(
     order = jnp.argsort(-scores, axis=1, stable=True)               # [G_pad, P]
     sorted_mask = jnp.take_along_axis(pod_masks, order, axis=1)
 
-    # pad the pod axis to a chunk multiple with inactive slots
+    # Pad the pod axis to a chunk multiple with inactive slots. The pad value
+    # must be an index outside [0, P): the final scheduled scatter writes at
+    # `order`, and zero-padding would send every padded (inactive, False)
+    # slot to column 0, clobbering pod 0's real placement bit. P_pad-1 >= P
+    # here, so padded writes land in columns sliced away by [:, :P].
     P_pad = P + (-P) % chunk
     if P_pad != P:
-        order = jnp.pad(order, ((0, 0), (0, P_pad - P)))
+        order = jnp.pad(order, ((0, 0), (0, P_pad - P)), constant_values=P_pad - 1)
         sorted_mask = jnp.pad(sorted_mask, ((0, 0), (0, P_pad - P)))
 
     used, opened, placed_sorted = _pallas_scan_all(

@@ -19,13 +19,33 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline = serial_baseline_time / tpu_time on identical work (single
 chip; the group axis additionally shards across chips via shard_map —
 see __graft_entry__.dryrun_multichip).
+
+Capture is defensive (round-1 lesson: a hung axon backend init produced
+rc=1 and no JSON): the parent process runs the measured bench in a child
+subprocess with bounded timeouts, retries a wedged TPU backend init once,
+then falls back to a CPU run with "platform" labeled honestly in the JSON.
+Whatever happens, exactly one parseable JSON line lands on stdout.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+_CHILD_ENV = "AUTOSCALER_TPU_BENCH_CHILD"
+_PLATFORM_ENV = "AUTOSCALER_TPU_BENCH_PLATFORM"
+# generous: first TPU compile ~20-40s, the tunnel adds latency; a CPU run
+# of the full 100k x 500 scan needs the larger budget
+_ATTEMPTS = (
+    # (platform intent, timeout_s); "default" = whatever the env pins (axon)
+    ("default", 600),
+    ("default", 600),   # one retry for a transiently wedged tunnel/backend
+    ("cpu", 1800),
+)
 
 
 def build_workload(P=100_000, G=500, seed=0):
@@ -54,13 +74,23 @@ def build_workload(P=100_000, G=500, seed=0):
     return pod_req, masks, allocs, caps
 
 
-def main():
+def _bench_main():
     import jax
+
+    if os.environ.get(_PLATFORM_ENV) == "cpu":
+        # env JAX_PLATFORMS alone is not enough here: the axon site hook
+        # re-pins the platform at import, so override via config like
+        # tests/conftest.py does
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from autoscaler_tpu.ops.binpack import ffd_binpack_groups
 
-    P, G, MAX_NODES = 100_000, 500, 1000
+    # env knobs exist for smoke-testing the capture pipeline only; the
+    # driver-run bench always uses the north-star 100k x 500 defaults
+    P = int(os.environ.get("AUTOSCALER_TPU_BENCH_P", 100_000))
+    G = int(os.environ.get("AUTOSCALER_TPU_BENCH_G", 500))
+    MAX_NODES = 1000
     pod_req, masks, allocs, caps = build_workload(P, G)
 
     jreq = jnp.asarray(pod_req)
@@ -114,13 +144,116 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "scaleup_estimator_throughput_100kpods_500groups",
+                # derived from the actual workload so a knob-shrunk smoke
+                # run can never masquerade as the north-star capture
+                "metric": f"scaleup_estimator_throughput_{P // 1000}kpods_{G}groups",
                 "value": round(value, 1),
                 "unit": "pod-group-evals/sec",
                 "vs_baseline": round(t_ref / t_tpu, 2),
+                "platform": jax.default_backend(),
+                "p": P,
+                "g": G,
+                "device_time_s": round(t_tpu, 4),
+                "baseline_time_s": round(t_ref, 2),
+                "baseline_kind": baseline,
             }
         )
     )
+
+
+def _run_child(platform: str, timeout_s: int):
+    """Run the measured bench in a subprocess.
+
+    Returns (parsed_json | None, note, kind) with kind in
+    {"ok", "timeout", "error"} — a deterministic child error (e.g. a parity
+    assertion) must not be retried through the whole attempt chain."""
+    env = dict(os.environ)
+    env[_CHILD_ENV] = "1"
+    if platform != "default":
+        env[_PLATFORM_ENV] = platform
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout_s}s (platform={platform})", "timeout"
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), "ok", "ok"
+            except json.JSONDecodeError:
+                continue
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-3:]
+    note = f"rc={proc.returncode} (platform={platform}): " + " | ".join(tail)
+    return None, note, "error"
+
+
+def _probe_backend(timeout_s: int = 150) -> str | None:
+    """Cheap subprocess check that the default (TPU) backend initializes at
+    all, so a wedged tunnel costs one short probe instead of full bench
+    timeouts. Returns None if healthy, else a note."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices())"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return f"backend init probe hung >{timeout_s}s"
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-2:]
+        return f"backend init probe rc={proc.returncode}: " + " | ".join(tail)
+    return None
+
+
+def main():
+    if os.environ.get(_CHILD_ENV) == "1":
+        _bench_main()
+        return
+    notes = []
+    skip = set()
+    for platform, timeout_s in _ATTEMPTS:
+        if platform in skip:
+            continue
+        if platform == "default":
+            note = _probe_backend()
+            if note is not None:
+                print(f"bench: {note}", file=sys.stderr)
+                # one more probe before writing the backend off
+                note = _probe_backend()
+            if note is not None:
+                notes.append(note)
+                skip.add(platform)
+                print(f"bench: {note} — falling back", file=sys.stderr)
+                continue
+        result, note, kind = _run_child(platform, timeout_s)
+        if result is not None:
+            print(json.dumps(result))
+            return
+        notes.append(note)
+        print(f"bench attempt failed: {note}", file=sys.stderr)
+        if kind == "error":
+            # deterministic failure — retrying the same platform is waste
+            skip.add(platform)
+    # Total failure still yields one parseable JSON line for the driver.
+    print(
+        json.dumps(
+            {
+                "metric": "scaleup_estimator_throughput_100kpods_500groups",
+                "value": 0,
+                "unit": "pod-group-evals/sec",
+                "vs_baseline": 0,
+                "error": "; ".join(notes),
+            }
+        )
+    )
+    sys.exit(1)
 
 
 if __name__ == "__main__":
