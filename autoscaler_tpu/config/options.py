@@ -62,6 +62,10 @@ class AutoscalingOptions:
     # -- scale-up ------------------------------------------------------------
     estimator: str = "binpacking"
     expander: str = "random"                      # reference default (main.go:145)
+    # priority-expander tiers: static dict, and/or a hot-reloaded config file
+    # (the reference's live ConfigMap, expander/priority/priority.go)
+    expander_priorities: Dict[int, List[str]] = field(default_factory=dict)
+    priority_config_file: str = ""
     max_nodes_per_scaleup: int = 1000             # main.go:215
     max_nodegroup_binpacking_duration_s: float = 10.0  # main.go:216
     balance_similar_node_groups: bool = False
@@ -95,6 +99,11 @@ class AutoscalingOptions:
     max_drain_parallelism: int = 1
     max_empty_bulk_delete: int = 10
     max_graceful_termination_s: float = 600.0
+    # eviction pacing (reference actuation/drain.go constants: EvictionRetryTime,
+    # MaxPodEvictionTime, PodEvictionHeadroom)
+    eviction_retry_time_s: float = 10.0
+    max_pod_eviction_time_s: float = 120.0
+    pod_eviction_headroom_s: float = 30.0
     max_bulk_soft_taint_count: int = 10
     max_bulk_soft_taint_time_s: float = 3.0
     unremovable_node_recheck_timeout_s: float = 300.0

@@ -1,19 +1,29 @@
-"""Scale-down actuation: taint → evict → delete, with budgets and batching.
+"""Scale-down actuation: taint → evict → delete, concurrent with budgets,
+pacing, and batching.
 
 Reference: cluster-autoscaler/core/scaledown/actuation/ —
 Actuator.StartDeletion actuator.go:80 (budget crop :126 → sync taint :187 →
-empty :156 / drain :206 → per-node scheduleDeletion :356 → batcher),
-Evictor drain.go:83,90 (retry loop, eviction headroom, DaemonSet best-effort
+async empty :156 / drain :206 → per-node scheduleDeletion goroutine :356 →
+batcher), Evictor drain.go:83,90 (time-budgeted retry loop: EvictionRetryTime
+between attempts, MaxPodEvictionTime per pod, then a wait for actual pod
+termination bounded by grace + PodEvictionHeadroom; DaemonSet best-effort
 eviction :178), NodeDeletionBatcher delete_in_batch.go:71,115 (per-group
-batched DeleteNodes), soft taints softtaint.go:31,77 (bulk PreferNoSchedule
-budget). The reference runs deletions on goroutines; this host runs them
-synchronously per loop iteration (the cloud call is the bottleneck either
-way) while preserving ordering, budgets, and failure bookkeeping.
+batched DeleteNodes on a timer), soft taints softtaint.go:31,77 (bulk
+PreferNoSchedule budget).
+
+Like the reference's goroutines, node drains here run on a thread pool
+bounded by max_scale_down_parallelism (the cloud/API calls are IO-bound, so
+threads are the right host-side concurrency primitive). start_deletion joins
+the wave by default so the control loop keeps its synchronous contract; the
+NodeDeletionTracker stays the cross-loop source of truth either way.
 """
 from __future__ import annotations
 
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from autoscaler_tpu.cloudprovider.interface import CloudProvider, NodeGroup
 from autoscaler_tpu.config.options import AutoscalingOptions
@@ -43,31 +53,60 @@ class ActuationResult:
 
 
 class Evictor:
-    """reference actuation/drain.go:83 DrainNodeWithPods."""
+    """reference actuation/drain.go:83 DrainNodeWithPods — per-pod eviction
+    with a time-budgeted retry loop, then a bounded wait for the evicted
+    pods to actually disappear. clock/sleep are injectable for tests."""
 
-    def __init__(self, api: ClusterAPI, max_retries: int = 3):
+    def __init__(
+        self,
+        api: ClusterAPI,
+        options: AutoscalingOptions,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
         self.api = api
-        self.max_retries = max_retries
+        self.options = options
+        self.clock = clock
+        self.sleep = sleep
 
     def drain_node(
         self, node: Node, pods: Sequence[Pod], tracker: NodeDeletionTracker, now_ts: float
     ) -> Tuple[bool, List[str]]:
         evicted: List[str] = []
         for pod in pods:
-            ok = False
-            last_err = ""
-            for _ in range(self.max_retries):
-                try:
-                    self.api.evict_pod(pod)
-                    tracker.register_eviction(pod.key(), now_ts)
-                    evicted.append(pod.key())
-                    ok = True
-                    break
-                except EvictionError as e:
-                    last_err = str(e)
-            if not ok:
+            if not self._evict_with_retry(pod):
                 return False, evicted
+            tracker.register_eviction(pod.key(), now_ts)
+            evicted.append(pod.key())
+        self._wait_pods_gone(pods)
         return True, evicted
+
+    def _evict_with_retry(self, pod: Pod) -> bool:
+        """Retry until MaxPodEvictionTime elapses, pausing EvictionRetryTime
+        between attempts (drain.go:90). Always makes at least one attempt."""
+        deadline = self.clock() + self.options.max_pod_eviction_time_s
+        while True:
+            try:
+                self.api.evict_pod(pod)
+                return True
+            except EvictionError:
+                if self.clock() >= deadline:
+                    return False
+                self.sleep(self.options.eviction_retry_time_s)
+
+    def _wait_pods_gone(self, pods: Sequence[Pod]) -> None:
+        """Bounded confirmation that evicted pods terminated: grace period
+        plus PodEvictionHeadroom (drain.go:123-140)."""
+        budget = (
+            self.options.max_graceful_termination_s
+            + self.options.pod_eviction_headroom_s
+        )
+        deadline = self.clock() + budget
+        remaining = [p.key() for p in pods]
+        while remaining and self.clock() < deadline:
+            remaining = [k for k in remaining if self.api.pod_exists(k)]
+            if remaining:
+                self.sleep(0.5)
 
     def evict_daemonset_pods(self, pods: Sequence[Pod]) -> List[str]:
         """Best-effort DaemonSet eviction (reference actuation/drain.go:177):
@@ -85,31 +124,96 @@ class Evictor:
 
 
 class NodeDeletionBatcher:
-    """reference actuation/delete_in_batch.go:71 — collect nodes per group,
-    flush as one DeleteNodes cloud call."""
+    """reference actuation/delete_in_batch.go:71 — collect nodes per group;
+    with a positive interval the FIRST add for a group arms a timer that
+    flushes that group's batch as one DeleteNodes call (:115); interval 0
+    means flush-per-add. Thread-safe: drain workers add concurrently.
 
-    def __init__(self, provider: CloudProvider):
+    on_result(node, group_id, error_or_None) fires once per node when its
+    batch flushes."""
+
+    def __init__(
+        self,
+        provider: CloudProvider,
+        interval_s: float = 0.0,
+        on_result: Optional[Callable[[Node, str, Optional[str]], None]] = None,
+    ):
         self.provider = provider
+        self.interval_s = interval_s
+        self.on_result = on_result
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._inflight = 0
         self._pending: Dict[str, List[Node]] = {}
+        self._timers: Dict[str, threading.Timer] = {}
 
     def add_node(self, group: NodeGroup, node: Node) -> None:
-        self._pending.setdefault(group.id(), []).append(node)
+        gid = group.id()
+        with self._lock:
+            self._pending.setdefault(gid, []).append(node)
+            if self.interval_s <= 0:
+                pass  # flushed below, outside the lock
+            elif gid not in self._timers:
+                t = threading.Timer(self.interval_s, self._flush_group, args=(gid,))
+                t.daemon = True
+                self._timers[gid] = t
+                t.start()
+        if self.interval_s <= 0:
+            self._flush_group(gid)
 
-    def flush(self) -> Dict[str, Optional[str]]:
-        """→ group id → error (None on success)."""
-        results: Dict[str, Optional[str]] = {}
-        groups = {g.id(): g for g in self.provider.node_groups()}
-        for gid, nodes in self._pending.items():
+    def _take_group(self, gid: str) -> List[Node]:
+        """Pop a group's batch; a non-empty take marks a flush in flight so
+        flush() can join timer flushes that already popped their nodes."""
+        with self._lock:
+            timer = self._timers.pop(gid, None)
+            if timer is not None:
+                timer.cancel()
+            nodes = self._pending.pop(gid, [])
+            if nodes:
+                self._inflight += 1
+            return nodes
+
+    def _flush_group(
+        self, gid: str, groups: Optional[Dict[str, NodeGroup]] = None
+    ) -> Dict[str, Optional[str]]:
+        nodes = self._take_group(gid)
+        if not nodes:
+            return {}
+        try:
+            if groups is None:
+                groups = {g.id(): g for g in self.provider.node_groups()}
             group = groups.get(gid)
             if group is None:
-                results[gid] = f"group {gid} no longer exists"
-                continue
-            try:
-                group.delete_nodes(nodes)
-                results[gid] = None
-            except Exception as e:
-                results[gid] = str(e)
-        self._pending.clear()
+                err: Optional[str] = f"group {gid} no longer exists"
+            else:
+                try:
+                    group.delete_nodes(nodes)
+                    err = None
+                except Exception as e:
+                    err = str(e)
+            if self.on_result is not None:
+                for node in nodes:
+                    self.on_result(node, gid, err)
+            return {gid: err}
+        finally:
+            with self._idle:
+                self._inflight -= 1
+                self._idle.notify_all()
+
+    def flush(self) -> Dict[str, Optional[str]]:
+        """Force-flush everything now (cancels pending timers) and JOIN any
+        timer flush already mid-delete, so callers get the full wave's
+        results before returning. The control loop uses this to close a
+        deletion wave synchronously."""
+        with self._lock:
+            gids = list(self._pending.keys())
+        results: Dict[str, Optional[str]] = {}
+        groups = {g.id(): g for g in self.provider.node_groups()} if gids else {}
+        for gid in gids:
+            results.update(self._flush_group(gid, groups))
+        with self._idle:
+            while self._inflight > 0:
+                self._idle.wait()
         return results
 
 
@@ -120,86 +224,128 @@ class ScaleDownActuator:
         options: AutoscalingOptions,
         api: ClusterAPI,
         tracker: Optional[NodeDeletionTracker] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
     ):
         self.provider = provider
         self.options = options
         self.api = api
         self.tracker = tracker or NodeDeletionTracker()
-        self.evictor = Evictor(api)
+        self.evictor = Evictor(api, options, clock=clock, sleep=sleep)
 
     # -- reference actuator.go:80 -------------------------------------------
     def start_deletion(self, plan: ScaleDownPlan, now_ts: float) -> ActuationResult:
         result = ActuationResult()
+        result_lock = threading.Lock()
         empty = plan.empty[: self.options.max_empty_bulk_delete]
         drain = plan.drain[: self.options.max_drain_parallelism]
 
         # 1. taint everything up front, atomically-ish (actuator.go:95,111);
         # roll back taints on nodes we end up not deleting.
-        tainted: List[str] = []
         for r in empty + drain:
             try:
                 self.api.add_taint(r.node.name, to_be_deleted_taint())
-                tainted.append(r.node.name)
             except Exception as e:
                 result.failed[r.node.name] = f"taint failed: {e}"
         empty = [r for r in empty if r.node.name not in result.failed]
         drain = [r for r in drain if r.node.name not in result.failed]
 
-        batcher = NodeDeletionBatcher(self.provider)
-        staged: List[Tuple[NodeToRemove, bool]] = []  # (node, was_drain)
+        was_drain: Dict[str, bool] = {}
 
-        for r in empty:
-            group = self.provider.node_group_for_node(r.node)
-            if group is None:
-                result.failed[r.node.name] = "no node group"
-                continue
-            self.tracker.start_deletion(group.id(), r.node.name, drain=False)
-            if self.options.daemonset_eviction_for_empty_nodes:
-                result.evicted_pods.extend(
-                    self.evictor.evict_daemonset_pods(r.daemonset_pods)
-                )
-            batcher.add_node(group, r.node)
-            staged.append((r, False))
-
-        for r in drain:
-            group = self.provider.node_group_for_node(r.node)
-            if group is None:
-                result.failed[r.node.name] = "no node group"
-                continue
-            self.tracker.start_deletion(group.id(), r.node.name, drain=True)
-            ok, evicted = self.evictor.drain_node(r.node, r.pods_to_reschedule, self.tracker, now_ts)
-            result.evicted_pods.extend(evicted)
-            if ok and self.options.daemonset_eviction_for_occupied_nodes:
-                result.evicted_pods.extend(
-                    self.evictor.evict_daemonset_pods(r.daemonset_pods)
-                )
-            if not ok:
-                self.tracker.end_deletion(group.id(), r.node.name, ok=False, error="eviction failed", ts=now_ts)
-                result.failed[r.node.name] = "eviction failed"
-                self.api.remove_taint(r.node.name, TO_BE_DELETED_TAINT)
-                continue
-            batcher.add_node(group, r.node)
-            staged.append((r, True))
-
-        # 2. one batched cloud delete per group (delete_in_batch.go:115).
-        errors = batcher.flush()
-        for r, was_drain in staged:
-            group = self.provider.node_group_for_node(r.node)
-            gid = group.id() if group else ""
-            err = errors.get(gid)
+        def on_batch_result(node: Node, gid: str, err: Optional[str]) -> None:
             if err:
-                self.tracker.end_deletion(gid, r.node.name, ok=False, error=err, ts=now_ts)
-                result.failed[r.node.name] = err
-                self.api.remove_taint(r.node.name, TO_BE_DELETED_TAINT)
-                continue
-            self.api.delete_node_object(r.node.name)
-            self.tracker.end_deletion(gid, r.node.name, ok=True, ts=now_ts)
-            (result.deleted_drain if was_drain else result.deleted_empty).append(
-                r.node.name
-            )
+                self.tracker.end_deletion(gid, node.name, ok=False, error=err, ts=now_ts)
+                with result_lock:
+                    result.failed[node.name] = err
+                self.api.remove_taint(node.name, TO_BE_DELETED_TAINT)
+                return
+            self.api.delete_node_object(node.name)
+            self.tracker.end_deletion(gid, node.name, ok=True, ts=now_ts)
+            with result_lock:
+                (
+                    result.deleted_drain if was_drain[node.name] else result.deleted_empty
+                ).append(node.name)
             self.api.record_event(
-                "Node", r.node.name, "ScaleDown", "node removed by autoscaler"
+                "Node", node.name, "ScaleDown", "node removed by autoscaler"
             )
+
+        batcher = NodeDeletionBatcher(
+            self.provider,
+            interval_s=self.options.node_deletion_batcher_interval_s,
+            on_result=on_batch_result,
+        )
+
+        def delete_empty(r: NodeToRemove, group: NodeGroup) -> None:
+            """actuator.go:156 deleteAsyncEmpty — no drain simulation, just
+            optional best-effort DS eviction then the batched cloud delete."""
+            if self.options.daemonset_eviction_for_empty_nodes:
+                evicted = self.evictor.evict_daemonset_pods(r.daemonset_pods)
+                with result_lock:
+                    result.evicted_pods.extend(evicted)
+            batcher.add_node(group, r.node)
+
+        def delete_drain(r: NodeToRemove, group: NodeGroup) -> None:
+            """actuator.go:206,356 scheduleDeletion — evict (paced), then
+            hand the node to the batcher; eviction failure rolls the taint
+            back and never reaches the cloud call."""
+            ok, evicted = self.evictor.drain_node(
+                r.node, r.pods_to_reschedule, self.tracker, now_ts
+            )
+            with result_lock:
+                result.evicted_pods.extend(evicted)
+            if ok and self.options.daemonset_eviction_for_occupied_nodes:
+                ds_evicted = self.evictor.evict_daemonset_pods(r.daemonset_pods)
+                with result_lock:
+                    result.evicted_pods.extend(ds_evicted)
+            if not ok:
+                self.tracker.end_deletion(
+                    group.id(), r.node.name, ok=False, error="eviction failed", ts=now_ts
+                )
+                with result_lock:
+                    result.failed[r.node.name] = "eviction failed"
+                self.api.remove_taint(r.node.name, TO_BE_DELETED_TAINT)
+                return
+            batcher.add_node(group, r.node)
+
+        def run_guarded(fn, r: NodeToRemove, group: NodeGroup) -> None:
+            """An unexpected error in a worker must still close out the
+            node's deletion (end_deletion + taint rollback) — an unretrieved
+            future exception would otherwise leak the node in the tracker as
+            being-deleted forever."""
+            try:
+                fn(r, group)
+            except Exception as e:
+                self.tracker.end_deletion(
+                    group.id(), r.node.name, ok=False, error=str(e), ts=now_ts
+                )
+                with result_lock:
+                    result.failed[r.node.name] = str(e)
+                try:
+                    self.api.remove_taint(r.node.name, TO_BE_DELETED_TAINT)
+                except Exception:
+                    pass
+
+        # 2. fan the wave out on a bounded worker pool (the goroutine analog).
+        workers = max(1, self.options.max_scale_down_parallelism)
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            for r, is_drain, fn in [(r, False, delete_empty) for r in empty] + [
+                (r, True, delete_drain) for r in drain
+            ]:
+                group = self.provider.node_group_for_node(r.node)
+                if group is None:
+                    result.failed[r.node.name] = "no node group"
+                    # the up-front taint must not outlive the aborted deletion
+                    try:
+                        self.api.remove_taint(r.node.name, TO_BE_DELETED_TAINT)
+                    except Exception:
+                        pass
+                    continue
+                was_drain[r.node.name] = is_drain
+                self.tracker.start_deletion(group.id(), r.node.name, drain=is_drain)
+                pool.submit(run_guarded, fn, r, group)
+        # 3. close the wave: one batched cloud delete per group
+        # (delete_in_batch.go:115), even if the batch timer hasn't fired.
+        batcher.flush()
         return result
 
     # -- soft taints (reference softtaint.go:31,77) --------------------------

@@ -18,6 +18,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from autoscaler_tpu.cloudprovider.interface import CloudProvider
 from autoscaler_tpu.config.options import AutoscalingOptions
 from autoscaler_tpu.core.scaledown.eligibility import EligibilityChecker
+from autoscaler_tpu.core.scaledown.limits import LimitsFinder, build_resource_limiter
+from autoscaler_tpu.core.scaleup.resource_manager import ResourceDelta
 from autoscaler_tpu.core.scaledown.tracking import (
     NodeDeletionTracker,
     RemainingPdbTracker,
@@ -60,6 +62,7 @@ class ScaleDownPlanner:
         )
         self.deletion_tracker = deletion_tracker or NodeDeletionTracker()
         self.simulator = removal_simulator or RemovalSimulator()
+        self.limits_finder = LimitsFinder(build_resource_limiter(options, provider))
         self.usage_tracker = UsageTracker()
         self._last_unremovable: List[UnremovableNode] = []
         self._utilization: Dict[str, float] = {}
@@ -124,6 +127,12 @@ class ScaleDownPlanner:
     def nodes_to_delete(self, snapshot: ClusterSnapshot, now_ts: float) -> ScaleDownPlan:
         plan = ScaleDownPlan(unremovable=list(self._last_unremovable))
         deletions_per_group: Dict[str, int] = {}
+        # Cluster-wide floors (planner.go:145 LimitsFinder.LimitsLeft): how
+        # much cores/memory/gpu scale-down may still remove before breaching
+        # min_*_total. Nodes already mid-deletion don't count toward totals.
+        limits_left = self.limits_finder.limits_left(
+            snapshot.nodes(), self.deletion_tracker.is_being_deleted
+        )
 
         def group_of(node: Node):
             g = self.provider.node_group_for_node(node)
@@ -145,11 +154,25 @@ class ScaleDownPlanner:
                 continue
             if name in self._empty_names:
                 if len(plan.empty) < self.options.max_empty_bulk_delete:
+                    if limits_left.try_decrement(ResourceDelta.for_node(node)):
+                        plan.unremovable.append(
+                            UnremovableNode(
+                                node, UnremovableReason.MINIMAL_RESOURCE_LIMIT_EXCEEDED
+                            )
+                        )
+                        continue
                     ds = daemonset_pods_of(snapshot.pods_on_node(name))
                     plan.empty.append(NodeToRemove(node, daemonset_pods=ds))
                     deletions_per_group[gid] = deletions_per_group.get(gid, 0) + 1
             elif name in self._drainable:
                 if len(plan.drain) < self.options.max_drain_parallelism:
+                    if limits_left.try_decrement(ResourceDelta.for_node(node)):
+                        plan.unremovable.append(
+                            UnremovableNode(
+                                node, UnremovableReason.MINIMAL_RESOURCE_LIMIT_EXCEEDED
+                            )
+                        )
+                        continue
                     plan.drain.append(self._drainable[name])
                     deletions_per_group[gid] = deletions_per_group.get(gid, 0) + 1
         cap = self.options.max_scale_down_parallelism

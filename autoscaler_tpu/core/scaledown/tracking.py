@@ -12,6 +12,7 @@ Reference:
 """
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
@@ -121,41 +122,52 @@ class NodeDeletionTracker:
     deletiontracker/nodedeletiontracker.go:32,70-173)."""
 
     def __init__(self) -> None:
+        # deletions run on worker threads (actuator.py) — guard all mutation
+        self._lock = threading.Lock()
         self._empty: Dict[str, str] = {}   # node → group
         self._drained: Dict[str, str] = {}
         self._results: List[DeletionResult] = []
         self._evictions: Dict[str, float] = {}  # pod key → ts
 
     def start_deletion(self, group_id: str, node_name: str, drain: bool) -> None:
-        (self._drained if drain else self._empty)[node_name] = group_id
+        with self._lock:
+            (self._drained if drain else self._empty)[node_name] = group_id
 
     def end_deletion(self, group_id: str, node_name: str, ok: bool, error: str = "", ts: float = 0.0) -> None:
-        self._empty.pop(node_name, None)
-        self._drained.pop(node_name, None)
-        self._results.append(DeletionResult(node_name, group_id, ok, error, ts))
+        with self._lock:
+            self._empty.pop(node_name, None)
+            self._drained.pop(node_name, None)
+            self._results.append(DeletionResult(node_name, group_id, ok, error, ts))
 
     def is_being_deleted(self, node_name: str) -> bool:
-        return node_name in self._empty or node_name in self._drained
+        with self._lock:
+            return node_name in self._empty or node_name in self._drained
 
     def deletions_in_group(self, group_id: str) -> int:
-        return sum(1 for g in self._empty.values() if g == group_id) + sum(
-            1 for g in self._drained.values() if g == group_id
-        )
+        with self._lock:
+            return sum(1 for g in self._empty.values() if g == group_id) + sum(
+                1 for g in self._drained.values() if g == group_id
+            )
 
     def deletions_count(self, drain: bool) -> int:
-        return len(self._drained) if drain else len(self._empty)
+        with self._lock:
+            return len(self._drained) if drain else len(self._empty)
 
     def register_eviction(self, pod_key: str, ts: float) -> None:
-        self._evictions[pod_key] = ts
+        with self._lock:
+            self._evictions[pod_key] = ts
 
     def recent_evictions(self, since_ts: float) -> List[str]:
-        return [k for k, t in self._evictions.items() if t >= since_ts]
+        with self._lock:
+            return [k for k, t in self._evictions.items() if t >= since_ts]
 
     def drain_results(self) -> List[DeletionResult]:
-        return list(self._results)
+        with self._lock:
+            return list(self._results)
 
     def clear_results(self) -> None:
-        self._results.clear()
+        with self._lock:
+            self._results.clear()
 
 
 class RemainingPdbTracker:

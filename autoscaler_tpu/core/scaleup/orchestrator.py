@@ -59,7 +59,11 @@ class ScaleUpOrchestrator:
         self.options = options
         self.csr = csr
         self.estimator = estimator or BinpackingNodeEstimator()
-        self.expander = expander or build_strategy([options.expander])
+        self.expander = expander or build_strategy(
+            [n.strip() for n in options.expander.split(",") if n.strip()],
+            priorities=options.expander_priorities,
+            priorities_path=options.priority_config_file or None,
+        )
         self.resource_manager = ScaleUpResourceManager(provider.get_resource_limiter())
         self.balancing_processor = balancing_processor
         # TemplateNodeInfoProvider (processors/nodeinfos.py): prefer a

@@ -132,9 +132,19 @@ def build_strategy(names: Sequence[str], seed: Optional[int] = None, **kwargs) -
 
             filters.append(PriceFilter(kwargs["pricing"]))
         elif name == PRIORITY:
-            from autoscaler_tpu.expander.priority import PriorityFilter
+            if kwargs.get("priorities_path"):
+                from autoscaler_tpu.expander.priority import FileWatchingPriorityFilter
 
-            filters.append(PriorityFilter(kwargs["priorities"]))
+                filters.append(
+                    FileWatchingPriorityFilter(
+                        kwargs["priorities_path"],
+                        fallback=kwargs.get("priorities"),
+                    )
+                )
+            else:
+                from autoscaler_tpu.expander.priority import PriorityFilter
+
+                filters.append(PriorityFilter(kwargs.get("priorities") or {}))
         elif name == GRPC:
             from autoscaler_tpu.expander.grpc_ import GRPCFilter
 

@@ -3,15 +3,36 @@
 Reference: cluster-autoscaler/expander/priority/priority.go — a live ConfigMap
 maps integer priorities to lists of node-group-name regexes; the expander
 keeps only options whose group matches the highest priority tier present.
-Here the config is a plain dict (the host embedding decides where it comes
-from — file, CRD, or API), hot-swappable via set_priorities.
+Here the config is a plain dict, hot-swappable via set_priorities; the
+reference's live-ConfigMap reload is covered by FileWatchingPriorityFilter
+(mtime-checked on every decision, like the informer-backed fetch the
+reference does per BestOptions call) — the host embedding points it at a
+file, a projected ConfigMap volume, or any path a sidecar keeps fresh.
 """
 from __future__ import annotations
 
+import json
+import os
 import re
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from autoscaler_tpu.expander.core import Filter, Option
+
+
+def parse_priorities(text: str) -> Dict[int, List[str]]:
+    """Config format: a JSON object mapping priority (int or numeric string,
+    higher wins) to a list of node-group-id regexes. The reference's YAML
+    ConfigMap payload (priority.go) carries the same shape."""
+    raw = json.loads(text)
+    if not isinstance(raw, dict):
+        raise ValueError("priority config must be an object of prio -> [regex]")
+    out: Dict[int, List[str]] = {}
+    for k, v in raw.items():
+        patterns = [str(p) for p in v]
+        for p in patterns:
+            re.compile(p)  # surface bad regexes at parse time
+        out[int(k)] = patterns
+    return out
 
 
 class PriorityFilter(Filter):
@@ -39,3 +60,46 @@ class PriorityFilter(Filter):
         prios = [(self._priority_of(o.node_group.id()), o) for o in options]
         top = max(p for p, _ in prios)
         return [o for p, o in prios if p == top]
+
+
+class FileWatchingPriorityFilter(PriorityFilter):
+    """Hot-reloading priority filter (reference priority/priority.go: the
+    expander re-fetches the ConfigMap on every BestOptions call). The config
+    file's mtime is checked before each decision; on change the file is
+    re-parsed and the tiers swapped in without a restart. A broken edit
+    keeps the last good config (the reference logs and keeps serving too)."""
+
+    def __init__(self, path: str, fallback: Optional[Dict[int, Sequence[str]]] = None):
+        self.path = path
+        self._sig: Optional[tuple] = None
+        self.last_error: Optional[str] = None
+        super().__init__(fallback or {})
+        self.maybe_reload()
+
+    def maybe_reload(self) -> bool:
+        """Re-parse the config if the file changed; True if tiers swapped.
+        The change signature is (mtime_ns, size) — plain mtime misses
+        rewrites landing within the filesystem's timestamp granularity."""
+        try:
+            st = os.stat(self.path)
+            sig = (st.st_mtime_ns, st.st_size)
+        except OSError as e:
+            self.last_error = f"stat {self.path}: {e}"
+            return False
+        if sig == self._sig:
+            return False
+        try:
+            with open(self.path) as f:
+                parsed = parse_priorities(f.read())
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            self.last_error = f"parse {self.path}: {e}"
+            self._sig = sig  # don't re-parse a bad file every call
+            return False
+        self.set_priorities(parsed)
+        self._sig = sig
+        self.last_error = None
+        return True
+
+    def best_options(self, options: List[Option]) -> List[Option]:
+        self.maybe_reload()
+        return super().best_options(options)

@@ -9,6 +9,7 @@ in-process (FakeClusterAPI) and bindable to any real control plane.
 from __future__ import annotations
 
 import abc
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -44,6 +45,13 @@ class ClusterAPI(abc.ABC):
     def evict_pod(self, pod: Pod) -> None:
         """Eviction-API analog; raises EvictionError on PDB rejection."""
 
+    def pod_exists(self, pod_key: str) -> bool:
+        """Whether the pod object is still present — the drain path polls
+        this (bounded by termination grace + eviction headroom) to confirm
+        evicted pods actually terminated (reference actuation/drain.go:83).
+        Implementations without cheap lookups may return False (skip wait)."""
+        return False
+
     @abc.abstractmethod
     def add_taint(self, node_name: str, taint: Taint) -> None: ...
 
@@ -60,7 +68,8 @@ class ClusterAPI(abc.ABC):
 
 @dataclass
 class FakeClusterAPI(ClusterAPI):
-    """In-memory control plane for tests and local simulation."""
+    """In-memory control plane for tests and local simulation. Thread-safe:
+    the actuator drains nodes from a worker pool."""
 
     nodes: Dict[str, Node] = field(default_factory=dict)
     pods: Dict[str, Pod] = field(default_factory=dict)
@@ -68,46 +77,69 @@ class FakeClusterAPI(ClusterAPI):
     evicted: List[str] = field(default_factory=list)
     events: List[Tuple[str, str, str, str]] = field(default_factory=list)
     fail_evictions_for: set = field(default_factory=set)
+    # pod key → number of times eviction fails before succeeding (transient
+    # failure injection for retry pacing tests)
+    eviction_failures: Dict[str, int] = field(default_factory=dict)
+    _lock: threading.RLock = field(default_factory=threading.RLock, repr=False)
 
     def add_node(self, node: Node) -> None:
-        self.nodes[node.name] = node
+        with self._lock:
+            self.nodes[node.name] = node
 
     def add_pod(self, pod: Pod) -> None:
-        self.pods[pod.key()] = pod
+        with self._lock:
+            self.pods[pod.key()] = pod
 
     def list_nodes(self) -> List[Node]:
-        return list(self.nodes.values())
+        with self._lock:
+            return list(self.nodes.values())
 
     def list_pods(self) -> List[Pod]:
-        return list(self.pods.values())
+        with self._lock:
+            return list(self.pods.values())
 
     def list_pdbs(self) -> List[PodDisruptionBudget]:
-        return list(self.pdbs)
+        with self._lock:
+            return list(self.pdbs)
 
     def evict_pod(self, pod: Pod) -> None:
-        if pod.key() in self.fail_evictions_for:
-            raise EvictionError(f"eviction of {pod.key()} rejected")
-        self.evicted.append(pod.key())
-        self.pods.pop(pod.key(), None)
+        with self._lock:
+            key = pod.key()
+            if key in self.fail_evictions_for:
+                raise EvictionError(f"eviction of {key} rejected")
+            remaining = self.eviction_failures.get(key, 0)
+            if remaining > 0:
+                self.eviction_failures[key] = remaining - 1
+                raise EvictionError(f"eviction of {key} transiently rejected")
+            self.evicted.append(key)
+            self.pods.pop(key, None)
+
+    def pod_exists(self, pod_key: str) -> bool:
+        with self._lock:
+            return pod_key in self.pods
 
     def add_taint(self, node_name: str, taint: Taint) -> None:
-        node = self.nodes[node_name]
-        if not any(t.key == taint.key for t in node.taints):
-            node.taints.append(taint)
+        with self._lock:
+            node = self.nodes[node_name]
+            if not any(t.key == taint.key for t in node.taints):
+                node.taints.append(taint)
 
     def remove_taint(self, node_name: str, taint_key: str) -> None:
-        node = self.nodes.get(node_name)
-        if node:
-            node.taints = [t for t in node.taints if t.key != taint_key]
+        with self._lock:
+            node = self.nodes.get(node_name)
+            if node:
+                node.taints = [t for t in node.taints if t.key != taint_key]
 
     def delete_node_object(self, node_name: str) -> None:
-        self.nodes.pop(node_name, None)
-        for key, pod in list(self.pods.items()):
-            if pod.node_name == node_name:
-                del self.pods[key]
+        with self._lock:
+            self.nodes.pop(node_name, None)
+            for key, pod in list(self.pods.items()):
+                if pod.node_name == node_name:
+                    del self.pods[key]
 
     def record_event(self, kind: str, name: str, reason: str, message: str) -> None:
-        self.events.append((kind, name, reason, message))
+        with self._lock:
+            self.events.append((kind, name, reason, message))
 
 
 def to_be_deleted_taint() -> Taint:

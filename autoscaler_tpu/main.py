@@ -36,6 +36,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--estimator", default="binpacking")
     p.add_argument("--expander", default="random",
                    help="comma-separated chain, e.g. priority,least-waste")
+    p.add_argument("--expander-priority-config-file", default="",
+                   help="hot-reloaded JSON {priority: [group regexes]} for the "
+                        "priority expander (the reference's live ConfigMap)")
     p.add_argument("--max-nodes-per-scaleup", type=int, default=1000)
     p.add_argument("--balance-similar-node-groups", action="store_true")
     p.add_argument("--scale-down-enabled", type=lambda s: s.lower() != "false", default=True)
@@ -77,7 +80,8 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         min_memory_total=mem_min * 1024,
         max_memory_total_mib=mem_max * 1024,
         estimator=args.estimator,
-        expander=args.expander.split(",")[0],
+        expander=args.expander,
+        priority_config_file=args.expander_priority_config_file,
         max_nodes_per_scaleup=args.max_nodes_per_scaleup,
         balance_similar_node_groups=args.balance_similar_node_groups,
         scale_down_enabled=args.scale_down_enabled,

@@ -118,3 +118,61 @@ class TestScenarioStrategy:
         )
         # cheap-pool schedules all pods → fewer unscheduled-penalties → wins
         assert strat.best_option(opts).node_group.id() == "cheap-pool"
+
+
+class TestFileWatchingPriority:
+    """Hot reload without restart (reference expander/priority/priority.go:
+    the ConfigMap is re-read on every BestOptions)."""
+
+    def _write(self, path, content, mtime):
+        import os
+
+        path.write_text(content)
+        os.utime(path, (mtime, mtime))  # mtime granularity-proof
+
+    def test_reload_mid_run(self, tmp_path):
+        from autoscaler_tpu.expander.priority import FileWatchingPriorityFilter
+
+        cfg = tmp_path / "priorities.json"
+        self._write(cfg, '{"10": ["cheap-pool"]}', 1000)
+        p = provider_with_groups()
+        f = FileWatchingPriorityFilter(str(cfg))
+        assert [o.node_group.id() for o in f.best_options(options_for(p))] == [
+            "cheap-pool"
+        ]
+        # operator flips the preference mid-run — no restart
+        self._write(cfg, '{"10": ["pricey-pool"]}', 2000)
+        assert [o.node_group.id() for o in f.best_options(options_for(p))] == [
+            "pricey-pool"
+        ]
+
+    def test_broken_edit_keeps_last_good_config(self, tmp_path):
+        from autoscaler_tpu.expander.priority import FileWatchingPriorityFilter
+
+        cfg = tmp_path / "priorities.json"
+        self._write(cfg, '{"10": ["cheap-pool"]}', 1000)
+        p = provider_with_groups()
+        f = FileWatchingPriorityFilter(str(cfg))
+        self._write(cfg, '{"10": [unbalanced', 2000)
+        assert [o.node_group.id() for o in f.best_options(options_for(p))] == [
+            "cheap-pool"
+        ]
+        assert f.last_error is not None
+
+    def test_missing_file_uses_fallback(self, tmp_path):
+        from autoscaler_tpu.expander.priority import FileWatchingPriorityFilter
+
+        p = provider_with_groups()
+        f = FileWatchingPriorityFilter(
+            str(tmp_path / "absent.json"), fallback={5: ["pricey-pool"]}
+        )
+        assert [o.node_group.id() for o in f.best_options(options_for(p))] == [
+            "pricey-pool"
+        ]
+
+    def test_build_strategy_with_path(self, tmp_path):
+        cfg = tmp_path / "priorities.json"
+        cfg.write_text('{"7": ["cheap-pool"]}')
+        p = provider_with_groups()
+        strat = build_strategy(["priority"], priorities_path=str(cfg))
+        assert strat.best_option(options_for(p)).node_group.id() == "cheap-pool"

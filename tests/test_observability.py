@@ -144,7 +144,8 @@ class TestCLI:
         )
         opts = options_from_args(args)
         assert opts.scan_interval_s == 5
-        assert opts.expander == "priority"
+        # the whole chain reaches the orchestrator (factory/chain.go analog)
+        assert opts.expander == "priority,least-waste"
         assert opts.max_nodes_total == 50
         assert opts.min_cores_total == 4000
         assert opts.max_cores_total == 100_000

@@ -342,6 +342,7 @@ class TestPlannerAndActuator:
     def test_actuator_failed_eviction_rolls_back_taint(self):
         provider, api, snapshot, nodes, opts = self._world()
         api.fail_evictions_for = {"default/p1"}
+        opts.max_pod_eviction_time_s = 0.0  # permanent failure: don't pace retries
         planner = ScaleDownPlanner(provider, opts)
         planner.update_cluster_state(snapshot, nodes, [], now_ts=0.0)
         planner.update_cluster_state(snapshot, nodes, [], now_ts=150.0)
@@ -574,3 +575,263 @@ class TestDaemonSetEviction:
         res = act.start_deletion(plan, now_ts=300.0)
         assert "d0" in res.deleted_drain  # best-effort: failure ignored
         assert "default/ds-d" not in res.evicted_pods
+
+
+class TestScaleDownResourceLimits:
+    """Cluster-wide floors (reference core/scaledown/resource/limits.go:64,224):
+    deletion must stop before pushing total cores/memory under min_*_total."""
+
+    def _world(self, n_nodes=5, n_empty=3, **opt_overrides):
+        provider = TestCloudProvider()
+        template = build_test_node("tmpl", cpu_m=1000, mem=2 * GB)
+        provider.add_node_group("g", 0, 10, n_nodes, template)
+        api = FakeClusterAPI()
+        nodes, pods = [], []
+        for i in range(n_nodes):
+            n = build_test_node(f"n{i}", cpu_m=1000, mem=2 * GB)
+            provider.add_node("g", n)
+            api.add_node(n)
+            nodes.append(n)
+            if i >= n_empty:  # keep the tail nodes loaded past the threshold
+                p = build_test_pod(f"w{i}", cpu_m=800, mem=1 * GB)
+                p.node_name = n.name
+                api.add_pod(p)
+                pods.append((p, n.name))
+        snapshot = snapshot_with(nodes, pods)
+        opts = AutoscalingOptions(**opt_overrides)
+        opts.node_group_defaults.scale_down_unneeded_time_s = 100
+        return provider, api, snapshot, nodes, opts
+
+    def _plan(self, provider, snapshot, nodes, opts):
+        planner = ScaleDownPlanner(provider, opts)
+        planner.update_cluster_state(snapshot, nodes, [], now_ts=0.0)
+        planner.update_cluster_state(snapshot, nodes, [], now_ts=150.0)
+        return planner.nodes_to_delete(snapshot, now_ts=150.0)
+
+    def test_min_cores_floor_stops_deletion(self):
+        # 5 nodes x 1000m = 5000m total; floor 3000m -> only 2 deletable
+        provider, api, snapshot, nodes, opts = self._world(
+            min_cores_total=3000.0
+        )
+        plan = self._plan(provider, snapshot, nodes, opts)
+        assert len(plan.empty) == 2
+        limited = [
+            u
+            for u in plan.unremovable
+            if u.reason == UnremovableReason.MINIMAL_RESOURCE_LIMIT_EXCEEDED
+        ]
+        assert len(limited) == 1  # the third empty node hit the floor
+
+    def test_min_memory_floor_stops_deletion(self):
+        # 5 nodes x 2048 MiB = 10240 MiB; floor 8192 MiB -> only 1 deletable
+        provider, api, snapshot, nodes, opts = self._world(
+            min_memory_total=8192.0
+        )
+        plan = self._plan(provider, snapshot, nodes, opts)
+        assert len(plan.empty) == 1
+        limited = [
+            u
+            for u in plan.unremovable
+            if u.reason == UnremovableReason.MINIMAL_RESOURCE_LIMIT_EXCEEDED
+        ]
+        assert len(limited) == 2
+
+    def test_no_floor_deletes_all_empty(self):
+        provider, api, snapshot, nodes, opts = self._world()
+        plan = self._plan(provider, snapshot, nodes, opts)
+        assert len(plan.empty) == 3
+
+    def test_try_decrement_is_all_or_nothing(self):
+        from autoscaler_tpu.core.scaledown.limits import ScaleDownLimits
+        from autoscaler_tpu.core.scaleup.resource_manager import ResourceDelta
+
+        limits = ScaleDownLimits({"cpu": 1500.0, "memory": 4096.0})
+        delta = ResourceDelta({"cpu": 1000.0, "memory": 8192.0})
+        assert limits.try_decrement(delta) == ["memory"]
+        # the failed attempt must not have consumed any cpu headroom
+        assert limits.left["cpu"] == 1500.0
+        ok = ResourceDelta({"cpu": 1000.0, "memory": 2048.0})
+        assert limits.try_decrement(ok) == []
+        assert limits.left == {"cpu": 500.0, "memory": 2048.0}
+
+
+class TestConcurrentActuation:
+    """Threaded deletion wave (reference actuator.go:234 deleteNodesAsync,
+    :356 per-node scheduleDeletion goroutine, drain.go:83 paced evictions,
+    delete_in_batch.go:71 timer-driven batching)."""
+
+    def _drain_plan(self, n_nodes, pods_per_node=1):
+        from autoscaler_tpu.simulator.removal import NodeToRemove
+
+        provider = TestCloudProvider()
+        template = build_test_node("tmpl", cpu_m=4000, mem=8 * GB)
+        provider.add_node_group("g", 0, 200, n_nodes, template)
+        api = FakeClusterAPI()
+        plan_drain = []
+        for i in range(n_nodes):
+            n = build_test_node(f"d{i}", cpu_m=4000, mem=8 * GB)
+            provider.add_node("g", n)
+            api.add_node(n)
+            pods = []
+            for j in range(pods_per_node):
+                p = build_test_pod(f"p{i}-{j}", cpu_m=100, mem=100 * MB)
+                p.node_name = n.name
+                api.add_pod(p)
+                pods.append(p)
+            plan_drain.append(NodeToRemove(n, pods_to_reschedule=pods))
+        from autoscaler_tpu.core.scaledown.planner import ScaleDownPlan
+
+        return provider, api, ScaleDownPlan(drain=plan_drain)
+
+    def test_50_node_drain_wave_bounded_concurrency(self):
+        import threading as _threading
+
+        provider, api, plan = self._drain_plan(50)
+        opts = AutoscalingOptions()
+        opts.max_drain_parallelism = 50
+        opts.max_scale_down_parallelism = 8
+
+        gauge_lock = _threading.Lock()
+        live = {"now": 0, "max": 0}
+        orig_evict = api.evict_pod
+
+        def slow_evict(pod):
+            import time as _time
+
+            with gauge_lock:
+                live["now"] += 1
+                live["max"] = max(live["max"], live["now"])
+            _time.sleep(0.01)
+            try:
+                orig_evict(pod)
+            finally:
+                with gauge_lock:
+                    live["now"] -= 1
+
+        api.evict_pod = slow_evict
+        actuator = ScaleDownActuator(provider, opts, api)
+        result = actuator.start_deletion(plan, now_ts=100.0)
+
+        assert sorted(result.deleted_drain) == sorted(f"d{i}" for i in range(50))
+        assert not result.failed
+        # bounded by the worker pool, but genuinely parallel
+        assert live["max"] <= 8
+        assert live["max"] >= 2
+        # per-node results tracked for the next loop's CheckStatus read
+        results = {r.node_name: r.ok for r in actuator.tracker.drain_results()}
+        assert len(results) == 50 and all(results.values())
+        assert len(api.evicted) == 50
+
+    def test_eviction_retry_pacing(self):
+        from autoscaler_tpu.core.scaledown.actuator import Evictor
+        from autoscaler_tpu.core.scaledown.tracking import NodeDeletionTracker
+
+        api = FakeClusterAPI()
+        node = build_test_node("n", cpu_m=1000)
+        api.add_node(node)
+        pod = build_test_pod("flaky", cpu_m=100)
+        pod.node_name = "n"
+        api.add_pod(pod)
+        api.eviction_failures = {pod.key(): 2}  # two transient rejections
+
+        opts = AutoscalingOptions()
+        opts.eviction_retry_time_s = 10.0
+        opts.max_pod_eviction_time_s = 120.0
+        t = {"now": 0.0}
+        sleeps = []
+
+        def clock():
+            return t["now"]
+
+        def sleep(s):
+            sleeps.append(s)
+            t["now"] += s
+
+        ev = Evictor(api, opts, clock=clock, sleep=sleep)
+        ok, evicted = ev.drain_node(node, [pod], NodeDeletionTracker(), now_ts=0.0)
+        assert ok and evicted == [pod.key()]
+        assert sleeps == [10.0, 10.0]  # EvictionRetryTime between attempts
+
+    def test_eviction_gives_up_after_time_budget(self):
+        from autoscaler_tpu.core.scaledown.actuator import Evictor
+        from autoscaler_tpu.core.scaledown.tracking import NodeDeletionTracker
+
+        api = FakeClusterAPI()
+        node = build_test_node("n", cpu_m=1000)
+        api.add_node(node)
+        pod = build_test_pod("stuck", cpu_m=100)
+        pod.node_name = "n"
+        api.add_pod(pod)
+        api.eviction_failures = {pod.key(): 1000}
+
+        opts = AutoscalingOptions()
+        opts.eviction_retry_time_s = 10.0
+        opts.max_pod_eviction_time_s = 25.0
+        t = {"now": 0.0}
+        attempts = []
+        orig = api.evict_pod
+
+        def counting_evict(p):
+            attempts.append(t["now"])
+            orig(p)
+
+        api.evict_pod = counting_evict
+        ev = Evictor(
+            api, opts, clock=lambda: t["now"],
+            sleep=lambda s: t.__setitem__("now", t["now"] + s),
+        )
+        ok, _ = ev.drain_node(node, [pod], NodeDeletionTracker(), now_ts=0.0)
+        assert not ok
+        # attempts at t=0,10,20,30; the t=30 one is past the 25s budget cutoff
+        assert attempts == [0.0, 10.0, 20.0, 30.0]
+
+    def test_timer_driven_batcher(self):
+        import time as _time
+
+        from autoscaler_tpu.core.scaledown.actuator import NodeDeletionBatcher
+
+        provider = TestCloudProvider()
+        template = build_test_node("tmpl", cpu_m=1000)
+        provider.add_node_group("g", 0, 10, 3, template)
+        nodes = []
+        for i in range(3):
+            n = build_test_node(f"b{i}", cpu_m=1000)
+            provider.add_node("g", n)
+            nodes.append(n)
+        group = {g.id(): g for g in provider.node_groups()}["g"]
+
+        flushed = []
+        batcher = NodeDeletionBatcher(
+            provider, interval_s=0.15,
+            on_result=lambda node, gid, err: flushed.append((node.name, err)),
+        )
+        for n in nodes:
+            batcher.add_node(group, n)
+        # timer armed but not fired: nothing deleted yet
+        assert provider.scale_down_calls == []
+        deadline = _time.monotonic() + 3.0
+        while len(flushed) < 3 and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+        # one timer flush deleted the whole batch in a single wave
+        assert sorted(name for name, _ in flushed) == ["b0", "b1", "b2"]
+        assert all(err is None for _, err in flushed)
+        assert {name for _, name in provider.scale_down_calls} == {"b0", "b1", "b2"}
+
+    def test_flush_cancels_pending_timer(self):
+        from autoscaler_tpu.core.scaledown.actuator import NodeDeletionBatcher
+
+        provider = TestCloudProvider()
+        template = build_test_node("tmpl", cpu_m=1000)
+        provider.add_node_group("g", 0, 10, 1, template)
+        n = build_test_node("b0", cpu_m=1000)
+        provider.add_node("g", n)
+        group = {g.id(): g for g in provider.node_groups()}["g"]
+
+        flushed = []
+        batcher = NodeDeletionBatcher(
+            provider, interval_s=30.0,
+            on_result=lambda node, gid, err: flushed.append(node.name),
+        )
+        batcher.add_node(group, n)
+        batcher.flush()  # control loop closes the wave without waiting 30s
+        assert flushed == ["b0"]
