@@ -116,6 +116,9 @@ class AutoscalingOptions:
     # -- misc ---------------------------------------------------------------
     cloud_provider: str = "test"
     write_status_configmap: bool = True
+    # per-nodegroup gauges are opt-in for cardinality, like the reference's
+    # --record-node-group-metrics flag (main.go:201)
+    record_per_node_group_metrics: bool = False
     node_autoprovisioning_enabled: bool = False
     max_autoprovisioned_node_group_count: int = 15
     cordon_node_before_terminating: bool = False

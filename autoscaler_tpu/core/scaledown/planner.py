@@ -52,6 +52,7 @@ class ScaleDownPlanner:
         options: AutoscalingOptions,
         deletion_tracker: Optional[NodeDeletionTracker] = None,
         removal_simulator: Optional[RemovalSimulator] = None,
+        set_processor=None,
     ):
         self.provider = provider
         self.options = options
@@ -63,6 +64,7 @@ class ScaleDownPlanner:
         self.deletion_tracker = deletion_tracker or NodeDeletionTracker()
         self.simulator = removal_simulator or RemovalSimulator()
         self.limits_finder = LimitsFinder(build_resource_limiter(options, provider))
+        self.set_processor = set_processor
         self.usage_tracker = UsageTracker()
         self._last_unremovable: List[UnremovableNode] = []
         self._utilization: Dict[str, float] = {}
@@ -175,12 +177,23 @@ class ScaleDownPlanner:
                         continue
                     plan.drain.append(self._drainable[name])
                     deletions_per_group[gid] = deletions_per_group.get(gid, 0) + 1
+        # Final-selection seam (reference planner.go:151
+        # ScaleDownSetProcessor.GetNodesToRemove); the default processor
+        # crops to max_scale_down_parallelism, empty nodes first.
         cap = self.options.max_scale_down_parallelism
-        total = len(plan.empty) + len(plan.drain)
-        if cap > 0 and total > cap:
-            keep_empty = min(len(plan.empty), cap)
-            plan.empty = plan.empty[:keep_empty]
-            plan.drain = plan.drain[: max(0, cap - keep_empty)]
+        if self.set_processor is not None:
+            picked = self.set_processor.get_nodes_to_remove(
+                plan.empty + plan.drain, cap
+            )
+            picked_set = {id(r) for r in picked}
+            plan.empty = [r for r in plan.empty if id(r) in picked_set]
+            plan.drain = [r for r in plan.drain if id(r) in picked_set]
+        else:
+            total = len(plan.empty) + len(plan.drain)
+            if cap > 0 and total > cap:
+                keep_empty = min(len(plan.empty), cap)
+                plan.empty = plan.empty[:keep_empty]
+                plan.drain = plan.drain[: max(0, cap - keep_empty)]
         # Joint re-validation: the per-candidate simulation above evaluated
         # each drain against the same base state; the picked set must also
         # hold *together* (no double-booked capacity, no destinations on
@@ -209,3 +222,7 @@ class ScaleDownPlanner:
 
     def unneeded_names(self) -> List[str]:
         return self.unneeded.names()
+
+    def last_unremovable(self) -> List[UnremovableNode]:
+        """The previous update's rejection list (metrics + status surface)."""
+        return list(self._last_unremovable)

@@ -153,6 +153,10 @@ class NodeDeletionTracker:
         with self._lock:
             return len(self._drained) if drain else len(self._empty)
 
+    def in_flight_names(self) -> List[str]:
+        with self._lock:
+            return list(self._empty) + list(self._drained)
+
     def register_eviction(self, pod_key: str, ts: float) -> None:
         with self._lock:
             self._evictions[pod_key] = ts

@@ -52,6 +52,9 @@ class ScaleUpOrchestrator:
         balancing_processor=None,
         template_provider=None,
         node_group_list_processor=None,
+        node_info_processor=None,
+        binpacking_limiter=None,
+        metrics=None,
     ):
         from autoscaler_tpu.expander.core import build_strategy
 
@@ -72,6 +75,9 @@ class ScaleUpOrchestrator:
         # NAP (reference orchestrator.go:124): may extend the candidate list
         # with not-yet-existing autoprovisioned groups
         self.node_group_list_processor = node_group_list_processor
+        self.node_info_processor = node_info_processor
+        self.binpacking_limiter = binpacking_limiter
+        self.metrics = metrics
 
     # -- main entry (reference orchestrator.go:81) ---------------------------
     def scale_up(
@@ -139,6 +145,23 @@ class ScaleUpOrchestrator:
                 pods_remain_unschedulable=list(pending_pods), skipped_groups=skipped
             )
 
+        # NodeInfoProcessor seam (reference processors/nodeinfos): last-touch
+        # transform of the template set before estimation.
+        if self.node_info_processor is not None:
+            templates = self.node_info_processor.process(templates)
+        # BinpackingLimiter seam: pre-bound the batched dispatch (the
+        # reference's serial StopBinpacking early-exit, adapted to one-shot
+        # estimation — see processors/pipeline.py BinpackingLimiter).
+        if self.binpacking_limiter is not None:
+            viable, templates, headrooms = self.binpacking_limiter.limit_groups(
+                viable, templates, headrooms, pending_pods
+            )
+            if not viable:
+                return ScaleUpResult(
+                    pods_remain_unschedulable=list(pending_pods),
+                    skipped_groups=skipped,
+                )
+
         # ONE batched device dispatch for every group's expansion option
         # (replaces the serial ComputeExpansionOption loop).
         estimates = self.estimator.estimate_many(
@@ -201,6 +224,8 @@ class ScaleUpOrchestrator:
                     # a NAP candidate won: create the group for real
                     # (orchestrator.go:217 CreateNodeGroup)
                     group = group.create()
+                    if self.metrics is not None:
+                        self.metrics.created_node_groups_total.inc()
                 group.increase_size(delta)
                 self.csr.register_or_update_scale_up(group.id(), delta, now_ts)
                 executed.append((group.id(), delta))

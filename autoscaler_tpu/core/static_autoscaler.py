@@ -29,6 +29,7 @@ from autoscaler_tpu.kube.api import ClusterAPI
 from autoscaler_tpu.kube.objects import Node, Pod
 from autoscaler_tpu.metrics import metrics as metrics_mod
 from autoscaler_tpu.metrics.healthcheck import HealthCheck
+from autoscaler_tpu.simulator.removal import UnremovableReason
 from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
 
 
@@ -68,15 +69,20 @@ class StaticAutoscaler:
         self.options = options or AutoscalingOptions()
         self.processors = processors or default_processors()
         self.csr = csr or ClusterStateRegistry(provider, self.options)
+        self.metrics = metrics or metrics_mod.AutoscalerMetrics()
         self.scale_up_orchestrator = scale_up_orchestrator or ScaleUpOrchestrator(
             provider,
             self.options,
             self.csr,
             balancing_processor=self.processors.node_group_set,
             template_provider=self.processors.template_node_info_provider,
+            node_group_list_processor=self.processors.node_group_list,
+            node_info_processor=self.processors.node_info,
+            binpacking_limiter=self.processors.binpacking_limiter,
+            metrics=self.metrics,
         )
         self.scale_down_planner = scale_down_planner or ScaleDownPlanner(
-            provider, self.options
+            provider, self.options, set_processor=self.processors.scale_down_set
         )
         self.scale_down_actuator = scale_down_actuator or ScaleDownActuator(
             provider,
@@ -87,7 +93,6 @@ class StaticAutoscaler:
         self.pod_list_processor = (
             pod_list_processor or self.processors.pod_list_processor
         )
-        self.metrics = metrics or metrics_mod.AutoscalerMetrics()
         self.health_check = health_check or HealthCheck(
             self.options.max_inactivity_s, self.options.max_failing_time_s
         )
@@ -112,8 +117,42 @@ class StaticAutoscaler:
         m.unneeded_nodes_count.set(result.unneeded_nodes)
         m.node_groups_count.set(len(self.provider.node_groups()))
         m.cluster_safe_to_autoscale.set(1.0 if result.cluster_healthy else 0.0)
+
+        # cluster-size gauges (metrics.go:112-200)
+        t = self.csr.total_readiness()
+        m.nodes_count.set(t.ready, state="ready")
+        m.nodes_count.set(t.unready, state="unready")
+        m.nodes_count.set(t.not_started, state="notStarted")
+        m.nodes_count.set(t.long_unregistered, state="longUnregistered")
+        m.nodes_count.set(t.unregistered, state="unregistered")
+        m.max_nodes_count.set(self.options.max_nodes_total)
+        # the registry holds the node list this iteration ran against — no
+        # extra LIST against the control plane just for gauges
+        nodes_now = self.csr.registered_nodes()
+        m.cluster_cpu_current_cores.set(
+            sum(n.allocatable.cpu_m for n in nodes_now) / 1000.0
+        )
+        m.cluster_memory_current_bytes.set(
+            sum(n.allocatable.memory for n in nodes_now)
+        )
+        m.cpu_limits_cores.set(self.options.min_cores_total / 1000.0, direction="minimum")
+        m.cpu_limits_cores.set(self.options.max_cores_total / 1000.0, direction="maximum")
+        m.memory_limits_bytes.set(
+            self.options.min_memory_total * 1024 * 1024, direction="minimum"
+        )
+        m.memory_limits_bytes.set(
+            self.options.max_memory_total_mib * 1024 * 1024, direction="maximum"
+        )
+        if self.options.record_per_node_group_metrics:
+            for g in self.provider.node_groups():
+                m.node_group_min_count.set(g.min_size(), node_group=g.id())
+                m.node_group_max_count.set(g.max_size(), node_group=g.id())
+        m.nap_enabled.set(1.0 if self.options.node_autoprovisioning_enabled else 0.0)
+
         if result.scale_up is not None and result.scale_up.scaled_up:
             m.scaled_up_nodes_total.inc(result.scale_up.new_nodes)
+            if self._group_has_accelerator(result.scale_up.chosen_group):
+                m.scaled_up_gpu_nodes_total.inc(result.scale_up.new_nodes)
         if result.scale_up is not None and result.scale_up.error:
             m.failed_scale_ups_total.inc()
         if result.scale_down is not None:
@@ -124,12 +163,28 @@ class StaticAutoscaler:
                 len(result.scale_down.deleted_drain), reason="underutilized"
             )
             m.evicted_pods_total.inc(len(result.scale_down.evicted_pods))
+        m.scale_down_in_cooldown.set(1.0 if result.scale_down_in_cooldown else 0.0)
+        # reset every reason each loop so a reason that stops occurring
+        # reports 0 instead of its last nonzero value
+        by_reason: Dict[str, int] = {r.value: 0 for r in UnremovableReason}
+        for u in self.scale_down_planner.last_unremovable():
+            by_reason[u.reason.value] = by_reason.get(u.reason.value, 0) + 1
+        for reason, count in by_reason.items():
+            m.unremovable_nodes_count.set(count, reason=reason)
+        if result.removed_unregistered:
+            m.old_unregistered_nodes_removed_count.inc(result.removed_unregistered)
+        tracker = self.scale_down_planner.deletion_tracker
+        m.pending_node_deletions.set(
+            tracker.deletions_count(drain=True) + tracker.deletions_count(drain=False)
+        )
         for err in result.errors:
             m.errors_total.inc(type="internal")
         if result.errors:
             self.health_check.update_last_activity()
         else:
             self.health_check.update_last_success()
+        self.processors.scale_down_status.process(result.scale_down)
+        self.processors.autoscaling_status.process(result, now_ts)
         return result
 
     def _run_once_inner(self, now_ts: float) -> RunOnceResult:
@@ -150,6 +205,11 @@ class StaticAutoscaler:
         all_pods = self.api.list_pods()
         pdbs = self.api.list_pdbs()
 
+        # actionable-cluster gate (reference processors/actionablecluster)
+        if not self.processors.actionable_cluster.should_autoscale(all_nodes, now_ts):
+            result.errors.append("cluster not actionable this iteration")
+            return result
+
         # accelerator nodes still attaching devices count as unready
         # (processors/customresources, reference gpu_processor.go)
         _, accel_not_ready = self.processors.custom_resources.filter_out_nodes_with_unready_resources(
@@ -162,7 +222,11 @@ class StaticAutoscaler:
                 for n in all_nodes
             ]
 
-        # 2. cluster state accounting (:376)
+        # 2. cluster state accounting (:376); nodes mid-deletion count in the
+        # `deleted` readiness bucket, not as ready capacity
+        self.csr.register_deleted_nodes(
+            self.scale_down_planner.deletion_tracker.in_flight_names()
+        )
         self.csr.update_nodes(all_nodes, now_ts)
         result.cluster_healthy = self.csr.is_cluster_healthy()
         if not result.cluster_healthy:
@@ -234,17 +298,24 @@ class StaticAutoscaler:
             self.last_scale_up_ts = now_ts
 
         # 7. scale-down branch (:582-691)
+        if self.options.node_autoprovisioning_enabled:
+            # NAP cleanup: drop empty autoprovisioned groups (:650)
+            self.processors.node_group_manager.remove_unneeded_node_groups(
+                self.provider, self.metrics
+            )
         if self.options.scale_down_enabled:
             t_unneeded = _time.monotonic()
             candidates = self.processors.scale_down_candidates_sorting.sort(
-                self._scale_down_candidates(all_nodes, upcoming_names)
+                self.processors.scale_down_node.get_scale_down_candidates(
+                    self._scale_down_candidates(all_nodes, upcoming_names), all_nodes
+                )
             )
             self.scale_down_planner.update_cluster_state(
                 snapshot, candidates, pdbs, now_ts
             )
             self.metrics.observe_duration(metrics_mod.FIND_UNNEEDED, t_unneeded)
             result.unneeded_nodes = len(self.scale_down_planner.unneeded_names())
-            self.processors.scale_down_candidates_sorting.update(
+            self.processors.notify_scale_down_candidates(
                 self.scale_down_planner.unneeded_names()
             )
             in_cooldown = self._scale_down_in_cooldown(now_ts)
@@ -256,11 +327,32 @@ class StaticAutoscaler:
                     result.scale_down = down
                     if down.deleted_empty or down.deleted_drain:
                         self.last_scale_down_delete_ts = now_ts
-                        self.csr.register_scale_down(now_ts)
+                        # per-node registration widens the group's acceptable
+                        # range while the cloud deletion is in flight
+                        # (clusterstate.go RegisterScaleDown)
+                        deleted = set(down.deleted_empty + down.deleted_drain)
+                        registered_any = False
+                        for r in plan.empty + plan.drain:
+                            if r.node.name in deleted:
+                                g = self.provider.node_group_for_node(r.node)
+                                self.csr.register_scale_down(
+                                    now_ts, g.id() if g else "", r.node.name
+                                )
+                                registered_any = True
+                        if not registered_any:
+                            self.csr.register_scale_down(now_ts)
                         # destinations of the deleted nodes' simulated pods
                         # restart their unneeded clocks (simulator/tracker.go)
                         for name in down.deleted_empty + down.deleted_drain:
                             self.scale_down_planner.node_deleted(name, now_ts)
+                        gpu_deleted = sum(
+                            1
+                            for r in plan.empty + plan.drain
+                            if r.node.name in deleted
+                            and (r.node.allocatable.gpu > 0 or r.node.allocatable.tpu > 0)
+                        )
+                        if gpu_deleted:
+                            self.metrics.scaled_down_gpu_nodes_total.inc(gpu_deleted)
                     if down.failed:
                         self.last_scale_down_fail_ts = now_ts
             # keep soft taints in sync either way (:676)
@@ -272,6 +364,18 @@ class StaticAutoscaler:
         return result
 
     # -- helpers -------------------------------------------------------------
+    def _group_has_accelerator(self, group_id: Optional[str]) -> bool:
+        if not group_id:
+            return False
+        for g in self.provider.node_groups():
+            if g.id() == group_id:
+                try:
+                    tmpl = g.template_node_info()
+                except Exception:
+                    return False
+                return tmpl.allocatable.gpu > 0 or tmpl.allocatable.tpu > 0
+        return False
+
     def _split_pods(self, pods: Sequence[Pod]) -> Tuple[List[Pod], List[Pod]]:
         scheduled, pending = [], []
         for pod in pods:
@@ -339,18 +443,14 @@ class StaticAutoscaler:
 
     def _remove_old_unregistered(self, now_ts: float) -> int:
         """Instances stuck creating past the provision timeout are deleted
-        (:732)."""
+        (:732). The registry tracks per-instance first-seen timestamps, so a
+        freshly booting instance survives an autoscaler restart — only
+        long-unregistered ones (past max_node_provision_time) are removed."""
         removed = 0
-        unregistered = self.csr.unregistered_instances()
         groups = {g.id(): g for g in self.provider.node_groups()}
-        for gid, instances in unregistered.items():
+        for gid, instances in self.csr.long_unregistered_instances().items():
             group = groups.get(gid)
             if group is None:
-                continue
-            req = self.csr.scale_up_requests.get(gid)
-            if req is not None and now_ts - req.start_ts <= self.options.max_node_provision_time_s:
-                continue  # still within provision budget
-            if req is None and not self._provision_expired(gid, now_ts):
                 continue
             stuck = [Node(name=i.id, provider_id=i.id) for i in instances]
             try:
@@ -359,10 +459,6 @@ class StaticAutoscaler:
             except Exception:
                 pass
         return removed
-
-    def _provision_expired(self, gid: str, now_ts: float) -> bool:
-        # no live request: any unregistered instance is already stale
-        return True
 
     def _delete_created_nodes_with_errors(self) -> None:
         """Instances that failed creation are deleted so the target shrinks

@@ -186,6 +186,21 @@ class Affinity:
 
 
 @dataclass(frozen=True)
+class TopologySpreadConstraint:
+    """PodTopologySpread filter (the reference evaluates it via the scheduler
+    framework's PodTopologySpread plugin, schedulerbased.go:129): placing the
+    pod in a topology domain must keep
+    count(domain) + 1 - min(count over eligible domains) <= max_skew.
+    Only when_unsatisfiable="DoNotSchedule" is a hard predicate;
+    "ScheduleAnyway" is a scoring hint and is ignored here (PREDICATES.md)."""
+
+    max_skew: int
+    topology_key: str
+    selector: LabelSelector
+    when_unsatisfiable: str = "DoNotSchedule"
+
+
+@dataclass(frozen=True)
 class OwnerRef:
     kind: str = ""
     name: str = ""
@@ -202,6 +217,7 @@ class Pod:
     node_selector: Dict[str, str] = field(default_factory=dict)
     tolerations: List[Toleration] = field(default_factory=list)
     affinity: Optional[Affinity] = None
+    topology_spread: Tuple["TopologySpreadConstraint", ...] = ()
     owner_ref: Optional[OwnerRef] = None
     priority: int = 0
     node_name: str = ""          # "" = unscheduled/pending

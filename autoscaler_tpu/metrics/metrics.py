@@ -184,6 +184,60 @@ class AutoscalerMetrics:
         self.device_dispatches_total = r.counter(
             p + "device_dispatches_total", "TPU kernel dispatches"
         )
+        # -- remaining reference catalog (metrics.go:112-358) -----------------
+        self.max_nodes_count = r.gauge(p + "max_nodes_count", "configured node cap")
+        self.cluster_cpu_current_cores = r.gauge(
+            p + "cluster_cpu_current_cores", "sum of node allocatable cores"
+        )
+        self.cluster_memory_current_bytes = r.gauge(
+            p + "cluster_memory_current_bytes", "sum of node allocatable memory"
+        )
+        self.cpu_limits_cores = r.gauge(
+            p + "cpu_limits_cores", "cluster cpu floor/cap (label direction)"
+        )
+        self.memory_limits_bytes = r.gauge(
+            p + "memory_limits_bytes", "cluster memory floor/cap (label direction)"
+        )
+        self.node_group_min_count = r.gauge(
+            p + "node_group_min_count", "per-group min size (opt-in)"
+        )
+        self.node_group_max_count = r.gauge(
+            p + "node_group_max_count", "per-group max size (opt-in)"
+        )
+        self.scaled_up_gpu_nodes_total = r.counter(
+            p + "scaled_up_gpu_nodes_total", "accelerator nodes added"
+        )
+        self.scaled_down_gpu_nodes_total = r.counter(
+            p + "scaled_down_gpu_nodes_total", "accelerator nodes removed"
+        )
+        self.unremovable_nodes_count = r.gauge(
+            p + "unremovable_nodes_count", "scale-down rejections by reason"
+        )
+        self.scale_down_in_cooldown = r.gauge(
+            p + "scale_down_in_cooldown", "1 while scale-down is in cooldown"
+        )
+        self.old_unregistered_nodes_removed_count = r.counter(
+            p + "old_unregistered_nodes_removed_count",
+            "stuck unregistered instances deleted",
+        )
+        self.overflowing_controllers_count = r.gauge(
+            p + "overflowing_controllers_count",
+            "controllers with too many pods for equivalence grouping",
+        )
+        self.skipped_scale_events_count = r.counter(
+            p + "skipped_scale_events_count",
+            "scale events skipped (labels direction, reason)",
+        )
+        self.nap_enabled = r.gauge(p + "nap_enabled", "node autoprovisioning on")
+        self.created_node_groups_total = r.counter(
+            p + "created_node_groups_total", "NAP groups created"
+        )
+        self.deleted_node_groups_total = r.counter(
+            p + "deleted_node_groups_total", "NAP groups deleted"
+        )
+        self.pending_node_deletions = r.gauge(
+            p + "pending_node_deletions", "deletions currently in flight"
+        )
 
     def observe_duration(self, label: str, start_ts: float) -> float:
         """UpdateDurationFromStart analog (metrics.go:399)."""

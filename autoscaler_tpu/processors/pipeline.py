@@ -96,6 +96,96 @@ class ScaleDownCandidatesSortingProcessor:
         self._previous = set(unneeded_names)
 
 
+class NodeGroupListProcessor(Protocol):
+    """reference processors/nodegroups/NodeGroupListProcessor — may add
+    (e.g. NAP candidate) groups to the scale-up consideration set."""
+
+    def process(self, provider, pending_pods, groups) -> List[NodeGroup]: ...
+
+
+class PassthroughNodeGroupListProcessor:
+    def process(self, provider, pending_pods, groups) -> List[NodeGroup]:
+        return []
+
+
+class ScaleDownNodeProcessor:
+    """reference processors/nodes/ScaleDownNodeProcessor — pre-filter the
+    scale-down candidate list before the planner sees it. Default: pass
+    everything through."""
+
+    def get_scale_down_candidates(
+        self, nodes: Sequence[Node], all_nodes: Sequence[Node]
+    ) -> List[Node]:
+        return list(nodes)
+
+
+class ScaleDownSetProcessor:
+    """reference processors/nodes/ScaleDownSetProcessor — final selection of
+    the deletion set from the removable candidates. Default mirrors the
+    reference's max-parallelism crop (post_filtering_processor.go)."""
+
+    def get_nodes_to_remove(self, candidates: List, max_count: int) -> List:
+        if max_count <= 0:
+            return list(candidates)
+        return list(candidates)[:max_count]
+
+
+class AutoscalingStatusProcessor:
+    """reference processors/status/AutoscalingStatusProcessor — observe the
+    cluster state after every iteration. Default: no-op."""
+
+    def process(self, result, now_ts: float) -> None:
+        return
+
+
+class ActionableClusterProcessor:
+    """reference processors/actionablecluster — whether the autoscaler should
+    act on the cluster at all this iteration. Default: always actionable."""
+
+    def should_autoscale(self, nodes: Sequence[Node], now_ts: float) -> bool:
+        return True
+
+
+class NodeInfoProcessor:
+    """reference processors/nodeinfos/NodeInfoProcessor — post-process the
+    template NodeInfos before estimation. Default: identity."""
+
+    def process(self, node_infos: Dict[str, Node]) -> Dict[str, Node]:
+        return node_infos
+
+
+class NodeGroupConfigProcessor:
+    """reference processors/nodegroupconfig — resolve per-group autoscaling
+    options. Default delegates to AutoscalingOptions.group_options (the
+    NodeGroup.GetOptions fallback chain, cloud_provider.go:230)."""
+
+    def options_for(self, options, group_id: str):
+        return options.group_options(group_id)
+
+
+class BinpackingLimiter:
+    """reference processors/binpacking/binpacking_limiter.go (InitBinpacking/
+    StopBinpacking). The reference stops the serial per-group estimate loop
+    early; here every group is estimated in ONE batched device dispatch, so
+    the seam pre-bounds the group set (and per-group headrooms) before that
+    dispatch. Default: no limiting."""
+
+    def limit_groups(
+        self,
+        viable: Dict[str, NodeGroup],
+        templates: Dict[str, Node],
+        headrooms: Dict[str, int],
+        pending_pods: Sequence[Pod],
+    ) -> Tuple[Dict[str, NodeGroup], Dict[str, Node], Dict[str, int]]:
+        return viable, templates, headrooms
+
+
+class ScaleDownCandidatesObserver(Protocol):
+    """reference processors/scaledowncandidates/ObserversList entry."""
+
+    def update(self, unneeded_names: Sequence[str]) -> None: ...
+
+
 class NodeGroupManager:
     """Node-group autoprovisioning lifecycle (reference processors/nodegroups/
     — NAP creates groups for pods no existing group fits and deletes empty
@@ -105,13 +195,17 @@ class NodeGroupManager:
     def __init__(self, max_autoprovisioned: int = 15):
         self.max_autoprovisioned = max_autoprovisioned
 
-    def remove_unneeded_node_groups(self, provider: CloudProvider) -> List[str]:
+    def remove_unneeded_node_groups(
+        self, provider: CloudProvider, metrics=None
+    ) -> List[str]:
         removed = []
         for group in provider.node_groups():
             if group.autoprovisioned() and group.target_size() == 0:
                 try:
                     group.delete()
                     removed.append(group.id())
+                    if metrics is not None:
+                        metrics.deleted_node_groups_total.inc()
                 except Exception:
                     pass
         return removed
@@ -119,10 +213,17 @@ class NodeGroupManager:
 
 @dataclass
 class AutoscalingProcessors:
-    """processors.go:36 — one container wired through the control loop."""
+    """processors.go:36 — one container wired through the control loop.
+    16 of the reference's 18 seams; absent: DebuggingSnapshotter lives in
+    debugging.py outside the container (same function), and the reference's
+    pod-injection PodListProcessor chain is folded into
+    FilterOutSchedulablePodListProcessor's currently-drained-nodes input."""
 
     pod_list_processor: FilterOutSchedulablePodListProcessor = field(
         default_factory=FilterOutSchedulablePodListProcessor
+    )
+    node_group_list: PassthroughNodeGroupListProcessor = field(
+        default_factory=PassthroughNodeGroupListProcessor
     )
     node_group_set: BalancingNodeGroupSetProcessor = field(
         default_factory=BalancingNodeGroupSetProcessor
@@ -130,11 +231,28 @@ class AutoscalingProcessors:
     template_node_info_provider: MixedTemplateNodeInfoProvider = field(
         default_factory=MixedTemplateNodeInfoProvider
     )
+    node_info: NodeInfoProcessor = field(default_factory=NodeInfoProcessor)
+    node_group_config: NodeGroupConfigProcessor = field(
+        default_factory=NodeGroupConfigProcessor
+    )
+    binpacking_limiter: BinpackingLimiter = field(default_factory=BinpackingLimiter)
     scale_up_status: EventingScaleUpStatusProcessor = field(
         default_factory=EventingScaleUpStatusProcessor
     )
+    scale_down_node: ScaleDownNodeProcessor = field(
+        default_factory=ScaleDownNodeProcessor
+    )
+    scale_down_set: ScaleDownSetProcessor = field(
+        default_factory=ScaleDownSetProcessor
+    )
     scale_down_status: NoOpScaleDownStatusProcessor = field(
         default_factory=NoOpScaleDownStatusProcessor
+    )
+    autoscaling_status: AutoscalingStatusProcessor = field(
+        default_factory=AutoscalingStatusProcessor
+    )
+    actionable_cluster: ActionableClusterProcessor = field(
+        default_factory=ActionableClusterProcessor
     )
     custom_resources: CustomResourcesProcessor = field(
         default_factory=CustomResourcesProcessor
@@ -142,7 +260,21 @@ class AutoscalingProcessors:
     scale_down_candidates_sorting: ScaleDownCandidatesSortingProcessor = field(
         default_factory=ScaleDownCandidatesSortingProcessor
     )
+    # ObserversList analog: every observer hears the new unneeded set
+    scale_down_candidates_observers: List[ScaleDownCandidatesObserver] = field(
+        default_factory=list
+    )
     node_group_manager: NodeGroupManager = field(default_factory=NodeGroupManager)
+
+    def __post_init__(self) -> None:
+        if self.scale_down_candidates_sorting not in self.scale_down_candidates_observers:
+            self.scale_down_candidates_observers.append(
+                self.scale_down_candidates_sorting
+            )
+
+    def notify_scale_down_candidates(self, unneeded_names: Sequence[str]) -> None:
+        for obs in self.scale_down_candidates_observers:
+            obs.update(unneeded_names)
 
 
 def default_processors() -> AutoscalingProcessors:

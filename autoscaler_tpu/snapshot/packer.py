@@ -249,6 +249,11 @@ def _exception_pods(
             pod.affinity.pod_affinity or pod.affinity.pod_anti_affinity
         ):
             exc.add(i)
+        # Hard topology-spread rows depend on placed-pod counts, so they are
+        # pod-specific regardless of the interpod flag (the dynamic affinity
+        # scan does not re-evaluate spread, so the static rule must hold).
+        if any(c.when_unsatisfiable == "DoNotSchedule" for c in pod.topology_spread):
+            exc.add(i)
         if (
             interpod
             and node_of_pod[i] >= 0
@@ -281,14 +286,6 @@ def _apply_row_rules(
     here.)"""
     P, N = len(pods), len(nodes)
 
-    if not interpod:
-        return
-
-    # Required inter-pod (anti-)affinity vs already-placed pods, including the
-    # symmetric anti-affinity rule (an existing pod's anti-affinity keeps
-    # matching incomers out of its topology domain). Evaluated per topology
-    # key over integer domain ids — the reference pays a per-(pod,node) plugin
-    # walk here, its documented 1000x outlier (FAQ.md:151-153).
     placed = [
         (i, pods[i], node_of_pod[i]) for i in range(P) if node_of_pod[i] >= 0
     ]
@@ -298,6 +295,45 @@ def _apply_row_rules(
         if key not in domain_cache:
             domain_cache[key] = _topology_domains(nodes, key)
         return domain_cache[key]
+
+    # PodTopologySpread hard filter (reference: scheduler framework's
+    # PodTopologySpread plugin behind schedulerbased.go:129): placing pod i
+    # in domain d must keep count(d) + 1 - min(counts over domains) within
+    # max_skew. Counts are of placed pods in the pod's namespace matching
+    # the constraint selector; nodes without the topology label can never
+    # satisfy the constraint. Applied regardless of `interpod` — the dynamic
+    # affinity scan does not re-evaluate spread (see PREDICATES.md).
+    for i, pod in enumerate(pods):
+        if not pod.topology_spread or not view.has(i):
+            continue
+        for c in pod.topology_spread:
+            if c.when_unsatisfiable != "DoNotSchedule":
+                continue  # ScheduleAnyway is a scoring hint, not a predicate
+            node_dom, domains = domains_for(c.topology_key)
+            counts = np.zeros(max(len(domains), 1), np.int64)
+            for (qi, q, j) in placed:
+                if (
+                    qi != i
+                    and node_dom[j] >= 0
+                    and q.namespace == pod.namespace
+                    and c.selector.matches(q.labels)
+                ):
+                    counts[node_dom[j]] += 1
+            min_count = int(counts.min()) if len(domains) else 0
+            allowed = node_dom >= 0
+            if len(domains):
+                dom_ok = (counts + 1 - min_count) <= c.max_skew
+                allowed = allowed & dom_ok[np.clip(node_dom, 0, None)]
+            view[i] = view[i] & allowed
+
+    if not interpod:
+        return
+
+    # Required inter-pod (anti-)affinity vs already-placed pods, including the
+    # symmetric anti-affinity rule (an existing pod's anti-affinity keeps
+    # matching incomers out of its topology domain). Evaluated per topology
+    # key over integer domain ids — the reference pays a per-(pod,node) plugin
+    # walk here, its documented 1000x outlier (FAQ.md:151-153).
 
     for i, pod in enumerate(pods):
         aff = pod.affinity

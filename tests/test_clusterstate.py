@@ -1,0 +1,205 @@
+"""ClusterStateRegistry accounting: acceptable ranges, readiness buckets,
+unregistered tracking, overlapping scale-up bursts with partial failure.
+
+Reference: cluster-autoscaler/clusterstate/clusterstate.go —
+updateAcceptableRanges :493, updateReadinessStats :543,
+updateIncorrectNodeGroupSizes :616, updateScaleRequests :232,
+GetUpcomingNodes :921.
+"""
+import pytest
+
+from autoscaler_tpu.cloudprovider.interface import Instance, InstanceState
+from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+from autoscaler_tpu.clusterstate.registry import (
+    AcceptableRange,
+    ClusterStateRegistry,
+    MAX_NODE_STARTUP_TIME_S,
+)
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.utils.test_utils import build_test_node
+
+
+def world(groups=(("g1", 5),), provision_timeout=900.0):
+    provider = TestCloudProvider()
+    opts = AutoscalingOptions(max_node_provision_time_s=provision_timeout)
+    nodes = []
+    for gid, count in groups:
+        provider.add_node_group(gid, 0, 100, count, build_test_node(f"{gid}-tmpl"))
+        for i in range(count):
+            n = build_test_node(f"{gid}-{i}")
+            provider.add_node(gid, n)
+            nodes.append(n)
+    csr = ClusterStateRegistry(provider, opts)
+    return provider, csr, nodes, opts
+
+
+class TestAcceptableRanges:
+    def test_steady_state_range_is_target(self):
+        provider, csr, nodes, _ = world()
+        csr.update_nodes(nodes, now_ts=100.0)
+        ar = csr.acceptable_range("g1")
+        assert ar == AcceptableRange(min_nodes=5, max_nodes=5, current_target=5)
+        assert csr.incorrect_node_group_size("g1") is None
+
+    def test_scale_up_widens_range_down(self):
+        provider, csr, nodes, _ = world()
+        group = provider.node_groups()[0]
+        group.increase_size(3)  # target 8, only 5 registered
+        csr.register_or_update_scale_up("g1", 3, now_ts=100.0)
+        csr.update_nodes(nodes, now_ts=100.0)
+        ar = csr.acceptable_range("g1")
+        assert (ar.min_nodes, ar.max_nodes, ar.current_target) == (5, 8, 8)
+        # 5 registered is inside [5, 8]: not an incorrect size
+        assert csr.incorrect_node_group_size("g1") is None
+        assert csr.are_there_upcoming_nodes("g1")
+        assert csr.is_node_group_scaling_up("g1")
+
+    def test_scale_down_widens_range_up(self):
+        provider, csr, nodes, _ = world()
+        csr.register_scale_down(100.0, group_id="g1", node_name="g1-0")
+        csr.update_nodes(nodes, now_ts=100.0)
+        ar = csr.acceptable_range("g1")
+        assert (ar.min_nodes, ar.max_nodes) == (5, 6)
+
+    def test_incorrect_size_first_observed_stable(self):
+        provider, csr, nodes, _ = world()
+        # drop the target below the registered count with no deletion in
+        # flight: 5 registered vs target 3 -> incorrect
+        provider.node_groups()[0].set_target_size(3)
+        csr.update_nodes(nodes, now_ts=100.0)
+        inc = csr.incorrect_node_group_size("g1")
+        assert inc is not None
+        assert (inc.current_size, inc.expected_size) == (5, 3)
+        assert inc.first_observed == 100.0
+        csr.update_nodes(nodes, now_ts=250.0)
+        assert csr.incorrect_node_group_size("g1").first_observed == 100.0
+        # discrepancy resolves -> record cleared
+        provider.node_groups()[0].set_target_size(5)
+        csr.update_nodes(nodes, now_ts=300.0)
+        assert csr.incorrect_node_group_size("g1") is None
+
+
+class TestUnregisteredTracking:
+    def test_unregistered_becomes_long_unregistered(self):
+        provider, csr, nodes, opts = world(provision_timeout=300.0)
+        provider.node_groups()[0].set_target_size(6)
+        provider.add_instance("g1", Instance(id="ghost-1"))
+        csr.update_nodes(nodes, now_ts=100.0)
+        r = csr.readiness("g1")
+        assert (r.unregistered, r.long_unregistered) == (1, 0)
+        # still within timeout at +200s
+        csr.update_nodes(nodes, now_ts=300.0)
+        r = csr.readiness("g1")
+        assert (r.unregistered, r.long_unregistered) == (1, 0)
+        # past timeout: long-unregistered, shrinking min_nodes
+        csr.update_nodes(nodes, now_ts=500.0)
+        r = csr.readiness("g1")
+        assert (r.unregistered, r.long_unregistered) == (0, 1)
+        ar = csr.acceptable_range("g1")
+        assert ar.min_nodes == 5  # target 6 - 1 long-unregistered
+        assert csr.long_unregistered_instances() == {
+            "g1": [Instance(id="ghost-1")]
+        }
+        # upcoming excludes the hopeless instance (clusterstate.go:931)
+        assert csr.get_upcoming_nodes() == {}
+
+    def test_not_started_bucket_uses_startup_grace(self):
+        provider, csr, nodes, _ = world()
+        young = build_test_node("g1-young")
+        young.ready = False
+        young.creation_ts = 1000.0
+        provider.add_node("g1", young)
+        provider.node_groups()[0].set_target_size(6)
+        csr.update_nodes(nodes + [young], now_ts=1000.0 + MAX_NODE_STARTUP_TIME_S / 2)
+        r = csr.readiness("g1")
+        assert (r.ready, r.not_started, r.unready) == (5, 1, 0)
+        csr.update_nodes(nodes + [young], now_ts=1000.0 + MAX_NODE_STARTUP_TIME_S + 1)
+        r = csr.readiness("g1")
+        assert (r.ready, r.not_started, r.unready) == (5, 0, 1)
+
+
+class TestOverlappingScaleUps:
+    def test_partial_failure_two_groups(self):
+        """Two concurrent scale-ups: g1's instances never register (timeout →
+        failure + backoff), g2's register and fulfill. clusterstate.go:232."""
+        provider, csr, nodes, opts = world(
+            groups=(("g1", 2), ("g2", 2)), provision_timeout=300.0
+        )
+        g1, g2 = provider.node_groups()
+        g1.increase_size(2)
+        g2.increase_size(1)
+        csr.register_or_update_scale_up("g1", 2, now_ts=100.0)
+        csr.register_or_update_scale_up("g2", 1, now_ts=100.0)
+        csr.update_nodes(nodes, now_ts=100.0)
+        assert csr.is_node_group_scaling_up("g1")
+        assert csr.is_node_group_scaling_up("g2")
+
+        # g2's node registers and is ready at t=200
+        new_node = build_test_node("g2-new")
+        provider.add_node("g2", new_node)
+        csr.update_nodes(nodes + [new_node], now_ts=200.0)
+        assert "g2" not in csr.scale_up_requests  # fulfilled
+        assert csr.is_node_group_safe_to_scale_up("g2", 200.0)
+        assert "g1" in csr.scale_up_requests      # still waiting
+
+        # g1 times out at t=500
+        csr.update_nodes(nodes + [new_node], now_ts=500.0)
+        assert "g1" not in csr.scale_up_requests
+        assert any(f.group_id == "g1" and f.reason == "timeout" for f in csr.scale_up_failures)
+        assert not csr.is_node_group_safe_to_scale_up("g1", 500.0)
+        assert csr.is_node_group_safe_to_scale_up("g2", 500.0)
+
+    def test_merged_requests_same_group_restart_clock(self):
+        provider, csr, nodes, opts = world(provision_timeout=300.0)
+        g = provider.node_groups()[0]
+        g.increase_size(2)
+        csr.register_or_update_scale_up("g1", 2, now_ts=100.0)
+        g.increase_size(3)
+        csr.register_or_update_scale_up("g1", 3, now_ts=250.0)
+        req = csr.scale_up_requests["g1"]
+        assert req.expected_delta == 5
+        assert req.start_ts == 250.0  # adding nodes restarts the clock
+        # at t=420 the (restarted) clock has not expired
+        csr.update_nodes(nodes, now_ts=420.0)
+        assert "g1" in csr.scale_up_requests
+        # at t=600 it has
+        csr.update_nodes(nodes, now_ts=600.0)
+        assert "g1" not in csr.scale_up_requests
+        assert csr.scale_up_failures
+
+    def test_negative_delta_cancels_request(self):
+        provider, csr, nodes, _ = world()
+        csr.register_or_update_scale_up("g1", 2, now_ts=100.0)
+        csr.register_or_update_scale_up("g1", -2, now_ts=150.0)
+        assert "g1" not in csr.scale_up_requests
+
+    def test_fulfillment_clears_backoff(self):
+        provider, csr, nodes, opts = world(provision_timeout=300.0)
+        g = provider.node_groups()[0]
+        csr.register_failed_scale_up("g1", "cloud error", now_ts=100.0)
+        assert not csr.is_node_group_safe_to_scale_up("g1", 110.0)
+        # a later successful scale-up round registers and fulfills
+        g.increase_size(1)
+        csr.register_or_update_scale_up("g1", 1, now_ts=200.0)
+        n = build_test_node("g1-new")
+        provider.add_node("g1", n)
+        csr.update_nodes(nodes + [n], now_ts=260.0)
+        assert "g1" not in csr.scale_up_requests
+        assert csr.is_node_group_safe_to_scale_up("g1", 260.0)
+
+    def test_scale_down_requests_age_out(self):
+        provider, csr, nodes, _ = world()
+        csr.register_scale_down(100.0, group_id="g1", node_name="g1-0")
+        csr.update_nodes(nodes, now_ts=150.0)
+        assert csr.acceptable_range("g1").max_nodes == 6
+        csr.update_nodes(nodes, now_ts=100.0 + 301.0)  # past deletion budget
+        assert csr.acceptable_range("g1").max_nodes == 5
+
+
+class TestDeletedBucket:
+    def test_nodes_mid_deletion_not_ready(self):
+        provider, csr, nodes, _ = world()
+        csr.register_deleted_nodes(["g1-0", "g1-1"])
+        csr.update_nodes(nodes, now_ts=100.0)
+        r = csr.readiness("g1")
+        assert (r.ready, r.deleted, r.registered) == (3, 2, 5)

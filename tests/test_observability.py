@@ -136,6 +136,48 @@ class TestStatusAndDebugging:
         assert loaded["node_valid"].sum() == 1
 
 
+
+class TestExpandedCatalog:
+    """Series parity with the reference catalog (metrics.go:112-358)."""
+
+    CATALOG = [
+        "cluster_safe_to_autoscale", "nodes_count", "node_groups_count",
+        "unschedulable_pods_count", "max_nodes_count",
+        "cluster_cpu_current_cores", "cpu_limits_cores",
+        "cluster_memory_current_bytes", "memory_limits_bytes",
+        "node_group_min_count", "node_group_max_count", "last_activity",
+        "function_duration_seconds", "errors_total", "scaled_up_nodes_total",
+        "scaled_up_gpu_nodes_total", "failed_scale_ups_total",
+        "scaled_down_nodes_total", "scaled_down_gpu_nodes_total",
+        "evicted_pods_total", "unneeded_nodes_count",
+        "unremovable_nodes_count", "scale_down_in_cooldown",
+        "old_unregistered_nodes_removed_count",
+        "overflowing_controllers_count", "skipped_scale_events_count",
+        "nap_enabled", "created_node_groups_total",
+        "deleted_node_groups_total", "pending_node_deletions",
+    ]
+
+    def test_all_reference_series_registered(self):
+        from autoscaler_tpu.metrics.metrics import AutoscalerMetrics
+
+        m = AutoscalerMetrics()
+        text = m.registry.expose()
+        for series in self.CATALOG:
+            assert f"cluster_autoscaler_{series}" in text, series
+
+    def test_loop_updates_cluster_gauges(self):
+        a = make_autoscaler([build_test_pod("p", cpu_m=900, mem=1 * GB)])
+        a.options.record_per_node_group_metrics = True
+        a.run_once(now_ts=0.0)
+        m = a.metrics
+        assert m.nodes_count.get(state="ready") >= 1
+        assert m.cluster_cpu_current_cores.get() > 0
+        assert m.cluster_memory_current_bytes.get() > 0
+        assert m.node_group_min_count.get(node_group="g") == 0
+        assert m.node_group_max_count.get(node_group="g") >= 1
+        assert m.cpu_limits_cores.get(direction="maximum") > 0
+        assert m.scale_down_in_cooldown.get() in (0.0, 1.0)
+
 class TestCLI:
     def test_options_from_args(self):
         args = build_arg_parser().parse_args(

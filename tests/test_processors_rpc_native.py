@@ -239,3 +239,126 @@ class TestGrpcSidecar:
         options = [Option(p.node_groups()[0], 1, [build_test_pod("a")])]
         f = GRPCFilter("127.0.0.1:1")  # nothing listening
         assert f.best_options(options) == options
+
+
+class TestNewProcessorSeams:
+    """The round-2 seams (reference processors.go:36): actionable-cluster
+    gate, scale-down node/set processors, autoscaling status, binpacking
+    limiter, candidates observers."""
+
+    def _autoscaler(self, pods=(), procs=None):
+        from autoscaler_tpu.config.options import AutoscalingOptions
+        from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+        from autoscaler_tpu.kube.api import FakeClusterAPI
+        from autoscaler_tpu.utils.test_utils import GB
+
+        provider = TestCloudProvider()
+        api = FakeClusterAPI()
+        provider.add_node_group(
+            "g", 0, 10, 2, build_test_node("t", cpu_m=2000, mem=4 * GB)
+        )
+        for i in range(2):
+            n = build_test_node(f"g-{i}", cpu_m=2000, mem=4 * GB)
+            provider.add_node("g", n)
+            api.add_node(n)
+        for p in pods:
+            api.add_pod(p)
+        opts = AutoscalingOptions()
+        opts.node_group_defaults.scale_down_unneeded_time_s = 0
+        opts.scale_down_delay_after_add_s = 0
+        return StaticAutoscaler(provider, api, opts, processors=procs), provider
+
+    def test_actionable_cluster_gate_blocks_loop(self):
+        from autoscaler_tpu.processors.pipeline import default_processors
+        from autoscaler_tpu.utils.test_utils import GB
+
+        procs = default_processors()
+
+        class Frozen:
+            def should_autoscale(self, nodes, now_ts):
+                return False
+
+        procs.actionable_cluster = Frozen()
+        a, provider = self._autoscaler(
+            [build_test_pod("p", cpu_m=1500, mem=1 * GB)], procs
+        )
+        r = a.run_once(now_ts=0.0)
+        assert r.scale_up is None
+        assert provider.scale_up_calls == []
+        assert any("not actionable" in e for e in r.errors)
+
+    def test_autoscaling_status_processor_sees_every_loop(self):
+        from autoscaler_tpu.processors.pipeline import default_processors
+
+        procs = default_processors()
+        seen = []
+        procs.autoscaling_status = type(
+            "Obs", (), {"process": lambda self, result, now_ts: seen.append(now_ts)}
+        )()
+        a, _ = self._autoscaler(procs=procs)
+        a.run_once(now_ts=1.0)
+        a.run_once(now_ts=2.0)
+        assert seen == [1.0, 2.0]
+
+    def test_scale_down_set_processor_picks_final_set(self):
+        from autoscaler_tpu.processors.pipeline import default_processors
+
+        procs = default_processors()
+
+        class OnlyOne:
+            def get_nodes_to_remove(self, candidates, max_count):
+                return candidates[:1]
+
+        procs.scale_down_set = OnlyOne()
+        a, _ = self._autoscaler(procs=procs)  # both nodes empty → removable
+        r = a.run_once(now_ts=100.0)
+        deleted = r.scale_down.deleted_empty if r.scale_down else []
+        assert len(deleted) == 1
+
+    def test_scale_down_node_processor_prefilters(self):
+        from autoscaler_tpu.processors.pipeline import default_processors
+
+        procs = default_processors()
+
+        class DropAll:
+            def get_scale_down_candidates(self, nodes, all_nodes):
+                return []
+
+        procs.scale_down_node = DropAll()
+        a, _ = self._autoscaler(procs=procs)
+        r = a.run_once(now_ts=100.0)
+        assert r.unneeded_nodes == 0 and r.scale_down is None
+
+    def test_binpacking_limiter_bounds_dispatch(self):
+        from autoscaler_tpu.processors.pipeline import default_processors
+        from autoscaler_tpu.utils.test_utils import GB
+
+        procs = default_processors()
+
+        class NoGroups:
+            def limit_groups(self, viable, templates, headrooms, pending):
+                return {}, {}, {}
+
+        procs.binpacking_limiter = NoGroups()
+        blockers = [
+            build_test_pod(f"blocker-{i}", cpu_m=1800, node_name=f"g-{i}")
+            for i in range(2)
+        ]
+        a, provider = self._autoscaler(
+            blockers + [build_test_pod("p", cpu_m=1500, mem=1 * GB)], procs
+        )
+        r = a.run_once(now_ts=0.0)
+        assert provider.scale_up_calls == []
+        assert r.scale_up is not None and not r.scale_up.scaled_up
+
+    def test_candidates_observers_notified(self):
+        from autoscaler_tpu.processors.pipeline import default_processors
+
+        procs = default_processors()
+        heard = []
+        procs.scale_down_candidates_observers.append(
+            type("O", (), {"update": lambda self, names: heard.append(list(names))})()
+        )
+        a, _ = self._autoscaler(procs=procs)
+        a.run_once(now_ts=100.0)
+        assert heard and len(heard[-1]) >= 1  # empty nodes became unneeded

@@ -211,7 +211,13 @@ class TestRunOnce:
         provider.add_instance("g", Instance(id="ghost"))
         g = provider.node_groups()[0]
         g.set_target_size(2)
-        result = autoscaler.run_once(now_ts=10_000.0)
+        # first sighting starts the per-instance provision clock — a booting
+        # instance must NOT be deleted immediately (even across restarts)
+        r0 = autoscaler.run_once(now_ts=10_000.0)
+        assert r0.removed_unregistered == 0
+        # still unregistered past max_node_provision_time → removed
+        timeout = autoscaler.options.max_node_provision_time_s
+        result = autoscaler.run_once(now_ts=10_000.0 + timeout + 1)
         assert result.removed_unregistered == 1
         assert ("g", "ghost") in provider.scale_down_calls
 

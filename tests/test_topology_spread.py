@@ -1,0 +1,195 @@
+"""PodTopologySpread hard-filter parity.
+
+Reference: the scheduler framework's PodTopologySpread filter plugin, run by
+cluster-autoscaler/simulator/predicatechecker/schedulerbased.go:129 per
+(pod, node). Coverage and divergences are documented in PREDICATES.md; the
+oracle below implements the filter rule directly (count per domain of
+matching placed pods; placing must keep count+1-min <= maxSkew; nodes
+without the topology label never satisfy the constraint).
+"""
+import numpy as np
+import pytest
+
+from autoscaler_tpu.kube.objects import LabelSelector, TopologySpreadConstraint
+from autoscaler_tpu.snapshot.packer import (
+    compute_factored_mask,
+    compute_sched_mask,
+)
+from autoscaler_tpu.utils.test_utils import build_test_node, build_test_pod
+from tests.test_factored_mask import expand
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def spread(max_skew=1, key=ZONE, match=None, when="DoNotSchedule"):
+    return TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=key,
+        selector=LabelSelector.from_dict(match or {"app": "web"}),
+        when_unsatisfiable=when,
+    )
+
+
+def zone_world(placed_per_zone=(1, 1, 0)):
+    """One node per zone a/b/c; `placed_per_zone` app=web pods pinned on each."""
+    nodes, pods, node_of = [], [], []
+    for z, count in zip("abc", placed_per_zone):
+        node = build_test_node(f"n-{z}", cpu_m=10_000)
+        node.labels[ZONE] = f"zone-{z}"
+        nodes.append(node)
+        for k in range(count):
+            p = build_test_pod(f"placed-{z}-{k}", cpu_m=100, labels={"app": "web"})
+            pods.append(p)
+            node_of.append(len(nodes) - 1)
+    return nodes, pods, node_of
+
+
+class TestSpreadFilter:
+    def test_skew_forces_empty_zone(self):
+        nodes, pods, node_of = zone_world((1, 1, 0))
+        new = build_test_pod("new", cpu_m=100, labels={"app": "web"})
+        new.topology_spread = (spread(max_skew=1),)
+        pods.append(new)
+        node_of.append(-1)
+        mask = compute_sched_mask(nodes, pods, node_of)
+        # counts a=1 b=1 c=0, min=0: only zone-c keeps skew <= 1
+        assert list(mask[-1]) == [False, False, True]
+
+    def test_larger_skew_allows_all(self):
+        nodes, pods, node_of = zone_world((1, 1, 0))
+        new = build_test_pod("new", cpu_m=100, labels={"app": "web"})
+        new.topology_spread = (spread(max_skew=2),)
+        pods.append(new)
+        node_of.append(-1)
+        mask = compute_sched_mask(nodes, pods, node_of)
+        assert list(mask[-1]) == [True, True, True]
+
+    def test_node_without_label_excluded(self):
+        nodes, pods, node_of = zone_world((0, 0, 0))
+        bare = build_test_node("bare", cpu_m=10_000)  # no zone label
+        nodes.append(bare)
+        new = build_test_pod("new", cpu_m=100, labels={"app": "web"})
+        new.topology_spread = (spread(max_skew=1),)
+        pods.append(new)
+        node_of.append(-1)
+        mask = compute_sched_mask(nodes, pods, node_of)
+        assert list(mask[-1]) == [True, True, True, False]
+
+    def test_schedule_anyway_is_soft(self):
+        nodes, pods, node_of = zone_world((3, 0, 0))
+        new = build_test_pod("new", cpu_m=100, labels={"app": "web"})
+        new.topology_spread = (spread(max_skew=1, when="ScheduleAnyway"),)
+        pods.append(new)
+        node_of.append(-1)
+        mask = compute_sched_mask(nodes, pods, node_of)
+        assert mask[-1].all()
+
+    def test_namespace_isolation(self):
+        nodes, pods, node_of = zone_world((0, 0, 0))
+        other = build_test_pod("other-ns", cpu_m=100, labels={"app": "web"},
+                               namespace="prod")
+        pods.append(other)
+        node_of.append(0)  # zone-a, but different namespace
+        new = build_test_pod("new", cpu_m=100, labels={"app": "web"})
+        new.topology_spread = (spread(max_skew=1),)
+        pods.append(new)
+        node_of.append(-1)
+        mask = compute_sched_mask(nodes, pods, node_of)
+        assert mask[-1].all()  # prod pod never counts toward default/ skew
+
+    def test_selector_mismatch_ignored(self):
+        nodes, pods, node_of = zone_world((2, 0, 0))
+        new = build_test_pod("new", cpu_m=100, labels={"app": "db"})
+        new.topology_spread = (spread(max_skew=1, match={"app": "db"}),)
+        pods.append(new)
+        node_of.append(-1)
+        mask = compute_sched_mask(nodes, pods, node_of)
+        assert mask[-1].all()  # the web pods don't match app=db
+
+    def test_hostname_spread(self):
+        # kubernetes.io/hostname: every node its own domain — one web pod per
+        # node max at skew 1 once any node has one
+        nodes, pods, node_of = [], [], []
+        for i in range(3):
+            n = build_test_node(f"h{i}", cpu_m=10_000)
+            n.labels["kubernetes.io/hostname"] = f"h{i}"
+            nodes.append(n)
+        placed = build_test_pod("placed", cpu_m=100, labels={"app": "web"})
+        pods.append(placed)
+        node_of.append(0)
+        new = build_test_pod("new", cpu_m=100, labels={"app": "web"})
+        new.topology_spread = (spread(max_skew=1, key="kubernetes.io/hostname"),)
+        pods.append(new)
+        node_of.append(-1)
+        mask = compute_sched_mask(nodes, pods, node_of)
+        # h0 would give counts (2,0,0): skew 2 > 1; h1/h2 give (1,1,0)
+        assert list(mask[-1]) == [False, True, True]
+
+
+def oracle_row(nodes, pods, node_of, i):
+    """Direct implementation of the filter rule for pod i (the serial
+    oracle the kernels are parity-locked against, SURVEY.md §7 #2)."""
+    pod = pods[i]
+    allowed = np.ones(len(nodes), bool)
+    for c in pod.topology_spread:
+        if c.when_unsatisfiable != "DoNotSchedule":
+            continue
+        values = {}
+        for n in nodes:
+            v = n.labels.get(c.topology_key)
+            if v is not None:
+                values.setdefault(v, 0)
+        for q, j in zip(pods, node_of):
+            if q is pod or j < 0:
+                continue
+            v = nodes[j].labels.get(c.topology_key)
+            if (
+                v is not None
+                and q.namespace == pod.namespace
+                and c.selector.matches(q.labels)
+            ):
+                values[v] += 1
+        min_count = min(values.values()) if values else 0
+        for j, n in enumerate(nodes):
+            v = n.labels.get(c.topology_key)
+            if v is None:
+                allowed[j] = False
+            elif values[v] + 1 - min_count > c.max_skew:
+                allowed[j] = False
+    return allowed
+
+
+class TestOracleParity:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_worlds(self, seed):
+        rng = np.random.default_rng(seed)
+        zones = [f"zone-{z}" for z in "abcd"[: rng.integers(2, 5)]]
+        nodes = []
+        for j in range(int(rng.integers(4, 10))):
+            n = build_test_node(f"n{j}", cpu_m=100_000)
+            if rng.random() < 0.85:
+                n.labels[ZONE] = str(rng.choice(zones))
+            nodes.append(n)
+        pods, node_of = [], []
+        apps = ["web", "db", "cache"]
+        for i in range(int(rng.integers(8, 25))):
+            app = str(rng.choice(apps))
+            p = build_test_pod(f"p{i}", cpu_m=10, labels={"app": app})
+            if rng.random() < 0.5:
+                p.topology_spread = (
+                    spread(
+                        max_skew=int(rng.integers(1, 3)),
+                        match={"app": app},
+                    ),
+                )
+            pods.append(p)
+            node_of.append(int(rng.integers(0, len(nodes))) if rng.random() < 0.6 else -1)
+
+        mask = compute_sched_mask(nodes, pods, node_of)
+        fm = expand(compute_factored_mask(nodes, pods, node_of), len(pods), len(nodes))
+        for i, p in enumerate(pods):
+            if not p.topology_spread or node_of[i] >= 0:
+                continue
+            expected = oracle_row(nodes, pods, node_of, i)
+            np.testing.assert_array_equal(mask[i], expected, err_msg=f"pod {i} dense")
+            np.testing.assert_array_equal(fm[i], expected, err_msg=f"pod {i} factored")
