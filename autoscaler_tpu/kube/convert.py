@@ -1,0 +1,255 @@
+"""Kubernetes-API JSON ↔ framework object converters.
+
+The reference consumes typed client-go objects (utils/kubernetes/listers.go:38
+hands apiv1.Node/apiv1.Pod straight to the simulator); this framework's
+objects are the dense-tensor-friendly dataclasses in kube/objects.py, so the
+real control-plane binding needs one honest translation layer. Quantity
+grammar follows apimachinery's resource.Quantity (suffix table) for the
+subset CA reads: cpu, memory, ephemeral-storage, pods, and the gpu/tpu
+extended resources.
+"""
+from __future__ import annotations
+
+import datetime
+from typing import Any, Dict, List, Optional, Tuple
+
+from autoscaler_tpu.kube import objects as k8s
+
+# extended-resource names mapped onto the dense gpu/tpu columns
+GPU_RESOURCE = "nvidia.com/gpu"
+TPU_RESOURCE = "google.com/tpu"
+MIRROR_ANNOTATION = "kubernetes.io/config.mirror"
+
+_SUFFIX = {
+    "": 1,
+    "k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12, "P": 10**15, "E": 10**18,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60,
+}
+
+
+def parse_quantity(s: Any) -> float:
+    """resource.Quantity string → float in base units ('100m' → 0.1)."""
+    if isinstance(s, (int, float)):
+        return float(s)
+    s = str(s).strip()
+    if not s:
+        return 0.0
+    if s.endswith("m"):
+        return float(s[:-1]) / 1000.0
+    for suffix in sorted(_SUFFIX, key=len, reverse=True):
+        if suffix and s.endswith(suffix):
+            return float(s[: -len(suffix)]) * _SUFFIX[suffix]
+    return float(s)
+
+
+def parse_cpu_millis(s: Any) -> float:
+    return parse_quantity(s) * 1000.0
+
+
+def parse_timestamp(s: Optional[str]) -> float:
+    """RFC3339 → epoch seconds (0.0 when absent)."""
+    if not s:
+        return 0.0
+    try:
+        return datetime.datetime.fromisoformat(s.replace("Z", "+00:00")).timestamp()
+    except ValueError:
+        return 0.0
+
+
+def format_timestamp(ts: float) -> str:
+    return (
+        datetime.datetime.fromtimestamp(ts, tz=datetime.timezone.utc)
+        .strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+    )
+
+
+def resources_from_map(m: Optional[Dict[str, Any]]) -> k8s.Resources:
+    m = m or {}
+    return k8s.Resources(
+        cpu_m=parse_cpu_millis(m.get("cpu", 0)),
+        memory=parse_quantity(m.get("memory", 0)),
+        ephemeral=parse_quantity(m.get("ephemeral-storage", 0)),
+        gpu=parse_quantity(m.get(GPU_RESOURCE, 0)),
+        tpu=parse_quantity(m.get(TPU_RESOURCE, 0)),
+        pods=parse_quantity(m.get("pods", 0)),
+    )
+
+
+def _label_selector(sel: Optional[Dict[str, Any]]) -> k8s.LabelSelector:
+    sel = sel or {}
+    exprs = tuple(
+        k8s.LabelSelectorRequirement(
+            key=e.get("key", ""),
+            operator=e.get("operator", "In"),
+            values=tuple(e.get("values") or ()),
+        )
+        for e in sel.get("matchExpressions") or ()
+    )
+    return k8s.LabelSelector(
+        match_labels=tuple(sorted((sel.get("matchLabels") or {}).items())),
+        match_expressions=exprs,
+    )
+
+
+def _node_selector_terms(affinity: Dict[str, Any]) -> Tuple[k8s.LabelSelector, ...]:
+    na = (affinity.get("nodeAffinity") or {}).get(
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    ) or {}
+    terms = []
+    for term in na.get("nodeSelectorTerms") or ():
+        exprs = tuple(
+            k8s.LabelSelectorRequirement(
+                key=e.get("key", ""),
+                operator=e.get("operator", "In"),
+                values=tuple(e.get("values") or ()),
+            )
+            for e in term.get("matchExpressions") or ()
+        )
+        terms.append(k8s.LabelSelector(match_expressions=exprs))
+    return tuple(terms)
+
+
+def _pod_affinity_terms(section: Optional[Dict[str, Any]]) -> Tuple[k8s.PodAffinityTerm, ...]:
+    out = []
+    for term in (section or {}).get(
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    ) or ():
+        out.append(
+            k8s.PodAffinityTerm(
+                selector=_label_selector(term.get("labelSelector")),
+                topology_key=term.get("topologyKey", ""),
+                namespaces=tuple(term.get("namespaces") or ()),
+            )
+        )
+    return tuple(out)
+
+
+def node_from_json(obj: Dict[str, Any]) -> k8s.Node:
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    ready = False
+    for cond in status.get("conditions") or ():
+        if cond.get("type") == "Ready":
+            ready = cond.get("status") == "True"
+    taints = [
+        k8s.Taint(
+            key=t.get("key", ""),
+            value=t.get("value", ""),
+            effect=t.get("effect", k8s.NO_SCHEDULE),
+        )
+        for t in spec.get("taints") or ()
+    ]
+    return k8s.Node(
+        name=meta.get("name", ""),
+        allocatable=resources_from_map(status.get("allocatable")),
+        labels=dict(meta.get("labels") or {}),
+        annotations=dict(meta.get("annotations") or {}),
+        taints=taints,
+        ready=ready,
+        unschedulable=bool(spec.get("unschedulable", False)),
+        creation_ts=parse_timestamp(meta.get("creationTimestamp")),
+        provider_id=spec.get("providerID", ""),
+    )
+
+
+def pod_from_json(obj: Dict[str, Any]) -> k8s.Pod:
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    annotations = dict(meta.get("annotations") or {})
+
+    requests = k8s.Resources()
+    host_ports: List[int] = []
+    local_storage = False
+    for c in spec.get("containers") or ():
+        requests = requests + resources_from_map(
+            (c.get("resources") or {}).get("requests")
+        )
+        for port in c.get("ports") or ():
+            if port.get("hostPort"):
+                host_ports.append(int(port["hostPort"]))
+    for v in spec.get("volumes") or ():
+        if "emptyDir" in v or "hostPath" in v:
+            local_storage = True
+
+    owner = None
+    for ref in meta.get("ownerReferences") or ():
+        if ref.get("controller"):
+            owner = k8s.OwnerRef(
+                kind=ref.get("kind", ""), name=ref.get("name", ""), controller=True
+            )
+            break
+
+    affinity_json = spec.get("affinity") or {}
+    node_terms = _node_selector_terms(affinity_json)
+    pod_aff = _pod_affinity_terms(affinity_json.get("podAffinity"))
+    pod_anti = _pod_affinity_terms(affinity_json.get("podAntiAffinity"))
+    affinity = None
+    if node_terms or pod_aff or pod_anti:
+        affinity = k8s.Affinity(
+            node_selector_terms=node_terms,
+            pod_affinity=pod_aff,
+            pod_anti_affinity=pod_anti,
+        )
+
+    spread = tuple(
+        k8s.TopologySpreadConstraint(
+            max_skew=int(c.get("maxSkew", 1)),
+            topology_key=c.get("topologyKey", ""),
+            selector=_label_selector(c.get("labelSelector")),
+            when_unsatisfiable=c.get("whenUnsatisfiable", "DoNotSchedule"),
+        )
+        for c in spec.get("topologySpreadConstraints") or ()
+    )
+
+    tolerations = [
+        k8s.Toleration(
+            key=t.get("key", ""),
+            operator=t.get("operator", "Equal"),
+            value=t.get("value", ""),
+            effect=t.get("effect", ""),
+        )
+        for t in spec.get("tolerations") or ()
+    ]
+
+    return k8s.Pod(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        requests=requests,
+        labels=dict(meta.get("labels") or {}),
+        annotations=annotations,
+        node_selector=dict(spec.get("nodeSelector") or {}),
+        tolerations=tolerations,
+        affinity=affinity,
+        topology_spread=spread,
+        owner_ref=owner,
+        priority=int(spec.get("priority") or 0),
+        node_name=spec.get("nodeName", ""),
+        host_ports=tuple(host_ports),
+        mirror=MIRROR_ANNOTATION in annotations,
+        daemonset=bool(owner and owner.kind == "DaemonSet"),
+        restartable=owner is not None,
+        local_storage=local_storage,
+        creation_ts=parse_timestamp(meta.get("creationTimestamp")),
+        deletion_ts=(
+            parse_timestamp(meta["deletionTimestamp"])
+            if meta.get("deletionTimestamp")
+            else None
+        ),
+    )
+
+
+def pdb_from_json(obj: Dict[str, Any]) -> k8s.PodDisruptionBudget:
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    return k8s.PodDisruptionBudget(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        selector=_label_selector(spec.get("selector")),
+        disruptions_allowed=int(status.get("disruptionsAllowed") or 0),
+    )
+
+
+def taints_to_json(taints: List[k8s.Taint]) -> List[Dict[str, str]]:
+    return [{"key": t.key, "value": t.value, "effect": t.effect} for t in taints]
