@@ -89,6 +89,7 @@ class FakeApiServer:
         self.writes = []          # (method, path) log
         self.reject_evictions = set()  # "ns/name" -> 429
         self.watch_queues = []    # live watch streams get events pushed
+        self.events = []          # (rv, event) log replayed on watch connect
         server = ThreadingHTTPServer(("127.0.0.1", 0), self._handler())
         self.server = server
         self.port = server.server_address[1]
@@ -102,9 +103,12 @@ class FakeApiServer:
         self.server.shutdown()
 
     def push_watch_event(self, kind, obj):
+        event = {"type": kind, "object": obj}
+        rv = int((obj.get("metadata") or {}).get("resourceVersion") or 0)
         with self.lock:
+            self.events.append((rv, event))
             for q in self.watch_queues:
-                q.put({"type": kind, "object": obj})
+                q.put(event)
 
     def _handler(outer_self):
         outer = outer_self
@@ -125,9 +129,20 @@ class FakeApiServer:
                 length = int(self.headers.get("Content-Length") or 0)
                 return json.loads(self.rfile.read(length)) if length else {}
 
-            def _stream_watch(self):
+            def _stream_watch(self, query):
+                # Real API-server semantics: replay logged events newer than
+                # the client's resourceVersion, then stream live ones. The
+                # lock makes replay-vs-queue registration atomic so no event
+                # is dropped or duplicated across the handoff.
+                since = 0
+                for part in query.split("&"):
+                    if part.startswith("resourceVersion="):
+                        since = int(part.split("=", 1)[1] or 0)
                 q = queue.Queue()
                 with outer.lock:
+                    for rv, event in outer.events:
+                        if rv > since:
+                            q.put(event)
                     outer.watch_queues.append(q)
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -150,7 +165,7 @@ class FakeApiServer:
             def do_GET(self):
                 path, _, query = self.path.partition("?")
                 if "watch=1" in query:
-                    return self._stream_watch()
+                    return self._stream_watch(query)
                 with outer.lock:
                     if path == "/api/v1/nodes":
                         return self._send(
