@@ -6,8 +6,8 @@ Reference: vertical-pod-autoscaler/pkg/admission-controller/logic/server.go
 matches a VPA by target selector, and returns a base64 JSONPatch setting each
 container's resource requests to the (policy-clamped) recommendation; pods
 are never rejected, only patched (failurePolicy Ignore). Certificate
-provisioning (certs.go) is left to the deploy site — terminate TLS in front
-of this server.
+provisioning (certs.go / gencerts.sh) lives in vpa/certs.py — pass a
+CertBundle to serve HTTPS in-process, or omit it to terminate TLS in front.
 
 The patch computation is a pure function (`review_pod`) so it is testable
 without sockets; `AdmissionServer` wraps it in a stdlib HTTP server.
@@ -181,6 +181,7 @@ class AdmissionServer:
         recommendations: Dict[ContainerKey, Recommendation],
         host: str = "127.0.0.1",
         port: int = 0,
+        tls: Optional["CertBundle"] = None,
     ):
         outer = self
 
@@ -218,7 +219,12 @@ class AdmissionServer:
 
         self.vpas = vpas
         self.recommendations = recommendations
+        self.tls = tls
         self._server = ThreadingHTTPServer((host, port), Handler)
+        if tls is not None:
+            self._server.socket = tls.server_ssl_context().wrap_socket(
+                self._server.socket, server_side=True
+            )
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -21,7 +21,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from autoscaler_tpu.vpa.api import Vpa, match_vpa
-from autoscaler_tpu.vpa.recommender import ClusterStateModel, ContainerKey
+from autoscaler_tpu.vpa.recommender import ClusterStateModel, ContainerKey, instance_key
 
 
 @dataclass
@@ -118,6 +118,7 @@ class ClusterStateFeeder:
         keys: List[ContainerKey] = []
         cpu: List[float] = []
         mem: List[float] = []
+        pods: List[str] = []
         for u in source.container_usage(now_ts):
             key = self._key_for(u.namespace, u.pod_labels, u.container)
             if key is None:
@@ -125,11 +126,12 @@ class ClusterStateFeeder:
             keys.append(key)
             cpu.append(u.cpu_cores)
             mem.append(u.memory_bytes)
+            pods.append(instance_key(u.namespace, u.pod_name))
         if not keys:
             return 0
         ts = [now_ts] * len(keys)
         self.model.add_cpu_samples(keys, cpu, ts)
-        self.model.add_memory_peaks(keys, mem, ts)
+        self.model.add_memory_peaks(keys, mem, ts, pods)
         return len(keys)
 
     def replay_history(self, source: HistorySource) -> int:
@@ -152,7 +154,7 @@ class ClusterStateFeeder:
         if keys:
             self.model.add_cpu_samples(keys, values, ts)
             count += len(keys)
-        keys, values, ts = [], [], []
+        keys, values, ts, pods = [], [], [], []
         for (ns, pod, container), series in source.memory_series().items():
             key = self._key_for(ns, labels_of.get((ns, pod), {}), container)
             if key is None:
@@ -161,7 +163,8 @@ class ClusterStateFeeder:
                 keys.append(key)
                 values.append(v)
                 ts.append(t)
+                pods.append(instance_key(ns, pod))
         if keys:
-            self.model.add_memory_peaks(keys, values, ts)
+            self.model.add_memory_peaks(keys, values, ts, pods)
             count += len(keys)
         return count

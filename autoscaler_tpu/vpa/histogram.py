@@ -94,6 +94,18 @@ class HistogramBank:
         if len(series_idx) == 0:
             return
         buckets = self.spec.bucket_of(values)
+        # Re-reference BEFORE weighting: with real wall-clock epochs the very
+        # first sample sits ~1.7e9s past the initial ref_ts=0 and
+        # 2^(dt/half_life) overflows float64, poisoning every weight. Decay
+        # the existing mass to the new reference first (0.5^(shift/hl) — 0.0
+        # for anything 10+ half-lives stale, which is exact enough), then
+        # weight this batch against the fresh reference.
+        max_ts = float(np.max(timestamps))
+        if max_ts - self.ref_ts > 10 * self.half_life_s:
+            factor = np.float32(0.5 ** ((max_ts - self.ref_ts) / self.half_life_s))
+            self.weights = self.weights * factor
+            self.total = self.total * factor
+            self.ref_ts = max_ts
         w = np.asarray(weights, np.float64) * self._decay_factor(timestamps)
         flat = np.asarray(series_idx, np.int64) * self.spec.num_buckets + buckets
         self.weights = (
@@ -105,14 +117,6 @@ class HistogramBank:
         self.total = self.total.at[jnp.asarray(series_idx)].add(
             jnp.asarray(w, jnp.float32)
         )
-        # re-reference when decayed weights threaten float32 range
-        max_ts = float(np.max(timestamps))
-        if max_ts - self.ref_ts > 10 * self.half_life_s:
-            shift = max_ts - self.ref_ts
-            factor = 0.5 ** (shift / self.half_life_s)
-            self.weights = self.weights * factor
-            self.total = self.total * factor
-            self.ref_ts = max_ts
 
     def percentile(self, p: float) -> jax.Array:
         """[C] — weighted percentile per series in one cumsum
@@ -149,9 +153,25 @@ class HistogramBank:
         bw = ckpt.get("bucket_weights", {})
         w = np.zeros(self.spec.num_buckets, np.float32)
         norm_sum = sum(bw.values())
+        total = float(ckpt.get("total_weight", 0.0))
         if norm_sum > 0:
             for i, x in bw.items():
                 w[int(i)] = x
-            w = w / w.sum() * ckpt["total_weight"]
+            w = w / w.sum() * total
+        # Stored weights are relative to the checkpoint's decay reference.
+        # Adopt it (a fresh bank has ref_ts=0; without this, the first live
+        # sample at a real epoch would trip the re-reference branch and
+        # multiply the restored mass by ~0). If the bank already carries a
+        # newer reference, re-base the restored mass onto it instead.
+        saved_ref = float(ckpt.get("ref_ts", 0.0))
+        if saved_ref > self.ref_ts:
+            factor = np.float32(0.5 ** ((saved_ref - self.ref_ts) / self.half_life_s))
+            self.weights = self.weights * factor
+            self.total = self.total * factor
+            self.ref_ts = saved_ref
+        elif saved_ref < self.ref_ts:
+            rebase = float(0.5 ** ((self.ref_ts - saved_ref) / self.half_life_s))
+            w = w * rebase
+            total = total * rebase
         self.weights = self.weights.at[series].set(jnp.asarray(w))
-        self.total = self.total.at[series].set(float(ckpt.get("total_weight", 0.0)))
+        self.total = self.total.at[series].set(total)

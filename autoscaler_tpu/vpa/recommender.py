@@ -36,6 +36,13 @@ SAFETY_MARGIN = 1.15
 MIN_CPU_CORES = 0.025
 MIN_MEMORY_BYTES = 250 * 1024 * 1024
 CONFIDENCE_EXPONENT = 1.0
+MEM_AGGREGATION_WINDOW_S = 24 * 3600.0  # MemoryAggregationInterval
+
+
+def instance_key(namespace: str, pod_name: str) -> str:
+    """Canonical container-instance identity for memory-window tracking.
+    Feeder, OOM observers, and tests must all build it through here."""
+    return f"{namespace}/{pod_name}"
 
 
 @dataclass
@@ -84,6 +91,15 @@ class ClusterStateModel:
         self.memory = HistogramBank(capacity, MEMORY_SPEC, half_life_s)
         self._index: Dict[ContainerKey, int] = {}
         self._meta: List[_AggregateMeta] = []
+        # (series, pod) → (window_idx, peak_bytes, peak_ts): the current
+        # memory-aggregation window's running peak per container instance
+        self._mem_window: Dict[tuple, tuple] = {}
+        # MemoryAggregationInterval — deliberately its own knob, NOT aliased
+        # to the decay half-life (both default 24h in the reference but are
+        # independently configurable; aliasing them would make a faster
+        # decay silently shrink the peak window)
+        self.mem_window_s = MEM_AGGREGATION_WINDOW_S
+        self._mem_window_seen = 0  # high-water window index, drives GC
 
     def series(self, key: ContainerKey) -> int:
         if key not in self._index:
@@ -106,23 +122,63 @@ class ClusterStateModel:
         self._touch(idx, ts)
 
     def add_memory_peaks(
-        self, keys: Sequence[ContainerKey], peaks: Sequence[float], ts: Sequence[float]
+        self,
+        keys: Sequence[ContainerKey],
+        peaks: Sequence[float],
+        ts: Sequence[float],
+        pods: Optional[Sequence[str]] = None,
     ) -> None:
-        idx = np.array([self.series(k) for k in keys], np.int64)
-        self.memory.add_samples(
-            idx, np.asarray(peaks), np.ones(len(idx)), np.asarray(ts)
-        )
-        self._touch(idx, ts)
+        """Window-peak aggregation (aggregate_container_state.go
+        AddMemoryPeak): each container instance contributes exactly ONE
+        sample per 24h window — its running peak. A higher observation
+        within the window subtracts the previous peak sample and adds the
+        new one, so a single spike (e.g. OOM) carries a full sample's
+        weight instead of drowning among per-scrape samples."""
+        pods = pods if pods is not None else [""] * len(keys)
+        add_idx: List[int] = []
+        add_val: List[float] = []
+        add_w: List[float] = []
+        add_ts: List[float] = []
+        touch_idx: List[int] = []
+        max_widx = self._mem_window_seen
+        for key, peak, t, pod in zip(keys, peaks, ts, pods):
+            i = self.series(key)
+            touch_idx.append(i)
+            widx = int(t // self.mem_window_s)
+            max_widx = max(max_widx, widx)
+            prev = self._mem_window.get((i, pod))
+            if prev is not None and prev[0] == widx:
+                if peak <= prev[1]:
+                    continue
+                # replace: subtract the old peak at its original timestamp
+                add_idx.append(i); add_val.append(prev[1])
+                add_w.append(-1.0); add_ts.append(prev[2])
+            add_idx.append(i); add_val.append(float(peak))
+            add_w.append(1.0); add_ts.append(float(t))
+            self._mem_window[(i, pod)] = (widx, float(peak), float(t))
+        if add_idx:
+            self.memory.add_samples(
+                np.asarray(add_idx, np.int64), np.asarray(add_val),
+                np.asarray(add_w), np.asarray(add_ts),
+            )
+        self._touch(np.asarray(touch_idx, np.int64), ts)
+        # GC once per new window: entries whose window has passed can never
+        # be replaced again, and dead pods would otherwise accumulate
+        # forever under churn (the reference GCs container states similarly)
+        if max_widx > self._mem_window_seen:
+            self._mem_window_seen = max_widx
+            self._mem_window = {
+                k: v for k, v in self._mem_window.items() if v[0] >= max_widx - 1
+            }
 
-    def observe_oom(self, key: ContainerKey, memory_at_oom: float, ts: float) -> None:
-        """OOM bumps the memory histogram by a 20%-padded sample (reference
-        input/oom/observer.go via model)."""
+    def observe_oom(
+        self, key: ContainerKey, memory_at_oom: float, ts: float, pod: str = ""
+    ) -> None:
+        """OOM bumps the container's current window peak to a 20%-padded
+        sample (reference input/oom/observer.go via model)."""
         idx = self.series(key)
-        self.memory.add_samples(
-            np.array([idx]), np.array([memory_at_oom * 1.2]), np.array([1.0]), np.array([ts])
-        )
+        self.add_memory_peaks([key], [memory_at_oom * 1.2], [ts], [pod])
         self._meta[idx].oom_observed_ts = ts
-        self._touch(np.array([idx]), [ts])
 
     def _touch(self, idx: np.ndarray, ts: Sequence[float]) -> None:
         for i, t in zip(idx, ts):

@@ -106,14 +106,29 @@ class Updater:
         evict_fn=None,
         oom_ts: Optional[Dict[str, float]] = None,
         recommendation_age_s: float = SIGNIFICANT_CHANGE_AFTER_S,
+        vpas: Optional[Dict[str, "object"]] = None,
     ) -> List[Pod]:
-        """→ pods evicted, highest priority first, PDB- and rate-limited."""
+        """→ pods evicted, highest priority first, PDB- and rate-limited.
+
+        `vpas` maps VPA name → Vpa; when given, only Recreate/Auto VPAs
+        evict (updater.go:109 skips Off/Initial — Initial applies at
+        admission only)."""
+        from autoscaler_tpu.vpa.api import UpdateMode
+
         evicted: List[Pod] = []
         oom_ts = oom_ts or {}
         for workload, pods in pods_by_workload.items():
             vpa = vpa_of_workload.get(workload)
             if vpa is None:
                 continue
+            if vpas is not None:
+                # fail CLOSED: an unresolvable VPA (cache lag, rename) or one
+                # without a readable mode must not evict — Off mode exists
+                # precisely to prevent disruption (updater.go resolves the
+                # VPA first and skips when it can't)
+                mode = getattr(vpas.get(vpa), "update_mode", None)
+                if mode not in (UpdateMode.RECREATE, UpdateMode.AUTO):
+                    continue
             budget = self.rate_limiter.budget_for(len(pods))
             candidates: List[PodUpdatePriority] = []
             for pod in pods:

@@ -191,6 +191,71 @@ class TestAdmissionServer:
         finally:
             server.stop()
 
+    def test_https_with_generated_certs(self):
+        """In-process TLS end to end (certs.go/gencerts.sh analog): the
+        server serves the mutate endpoint over HTTPS with a CA-signed cert,
+        and a client trusting only the generated caBundle verifies it."""
+        from autoscaler_tpu.vpa.certs import generate_certs
+
+        bundle = generate_certs()
+        server = AdmissionServer(
+            [make_vpa()], {ContainerKey("my-vpa", "main"): REC}, tls=bundle
+        )
+        server.start()
+        try:
+            host, port = server.address
+            conn = http.client.HTTPSConnection(
+                host, port, timeout=5, context=bundle.client_ssl_context()
+            )
+            body = json.dumps(make_review())
+            conn.request(
+                "POST", "/mutate", body, {"Content-Type": "application/json"}
+            )
+            resp = conn.getresponse()
+            assert resp.status == 200
+            data = json.loads(resp.read())
+            assert data["response"]["patchType"] == "JSONPatch"
+        finally:
+            server.stop()
+
+    def test_untrusting_client_rejects_cert(self):
+        import ssl
+
+        from autoscaler_tpu.vpa.certs import generate_certs
+
+        bundle = generate_certs()
+        other = generate_certs()  # a different CA must NOT be trusted
+        server = AdmissionServer([make_vpa()], {}, tls=bundle)
+        server.start()
+        try:
+            host, port = server.address
+            conn = http.client.HTTPSConnection(
+                host, port, timeout=5, context=other.client_ssl_context()
+            )
+            with pytest.raises(ssl.SSLError):
+                conn.request("GET", "/health-check")
+        finally:
+            server.stop()
+
+    def test_webhook_configuration_shape(self):
+        """config.go:67-99 MutatingWebhookConfiguration parity."""
+        from autoscaler_tpu.vpa.certs import generate_certs, webhook_configuration
+
+        bundle = generate_certs()
+        cfg = webhook_configuration(bundle)
+        hook = cfg["webhooks"][0]
+        assert hook["failurePolicy"] == "Ignore"
+        assert hook["sideEffects"] == "None"
+        assert hook["rules"][0]["operations"] == ["CREATE"]
+        assert hook["rules"][0]["resources"] == ["pods"]
+        assert base64.b64decode(hook["clientConfig"]["caBundle"]) == bundle.ca_cert_pem
+        assert hook["clientConfig"]["service"] == {
+            "namespace": "kube-system",
+            "name": "vpa-webhook",
+        }
+        by_url = webhook_configuration(bundle, url="https://127.0.0.1:8443/mutate")
+        assert by_url["webhooks"][0]["clientConfig"]["url"].endswith("/mutate")
+
 
 class TestFeederAndHistory:
     def test_feed_once_batches_into_model(self):

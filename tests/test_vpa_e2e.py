@@ -1,0 +1,328 @@
+"""Full-VPA e2e-style scenarios: feeder → recommender → updater → admission
+driven together over simulated days, hermetically.
+
+Models vertical-pod-autoscaler/e2e/v1/full_vpa.go ("Pods under VPA": cpu and
+memory requests grow with usage through the full evict-and-readmit loop) and
+e2e/v1/{recommender,updater,admission_controller}.go scenario outlines, minus
+the live cluster: pods live in-memory, metrics come from InMemoryMetrics,
+eviction is the Updater's decision, and re-admission runs through the real
+AdmissionServer over HTTPS with in-process generated certs (gencerts.sh
+analog) — so the patch path exercised is byte-for-byte the webhook one.
+"""
+from __future__ import annotations
+
+import base64
+import http.client
+import json
+import ssl
+
+import pytest
+
+from autoscaler_tpu.kube.objects import LabelSelector
+from autoscaler_tpu.vpa.admission import AdmissionServer
+from autoscaler_tpu.vpa.api import (
+    ContainerResourcePolicy,
+    UpdateMode,
+    Vpa,
+)
+from autoscaler_tpu.vpa.certs import generate_certs
+from autoscaler_tpu.vpa.feeder import (
+    ClusterStateFeeder,
+    ContainerUsage,
+    InMemoryMetrics,
+)
+from autoscaler_tpu.vpa.recommender import (
+    CheckpointManager,
+    ClusterStateModel,
+    ContainerKey,
+    PercentileRecommender,
+    instance_key,
+)
+from autoscaler_tpu.vpa.updater import Updater
+
+MB = 1024**2
+GB = 1024**3
+DAY = 86400.0
+T0 = 1_700_000_000.0  # fixed epoch so runs are deterministic
+
+CONTAINER = "hamster"
+WORKLOAD = "hamster"
+VPA_NAME = "hamster-vpa"
+LABELS = {"app": "hamster"}
+
+
+def apply_json_patch(doc: dict, patch_ops: list) -> dict:
+    """Minimal RFC 6902 'add' applier — the only op the webhook emits."""
+    import copy
+
+    doc = copy.deepcopy(doc)
+    for op in patch_ops:
+        assert op["op"] == "add"
+        parts = [p.replace("~1", "/").replace("~0", "~") for p in op["path"].strip("/").split("/")]
+        target = doc
+        for part in parts[:-1]:
+            target = target[int(part)] if isinstance(target, list) else target[part]
+        last = parts[-1]
+        if isinstance(target, list):
+            target.insert(int(last), op["value"])
+        else:
+            target[last] = op["value"]
+    return doc
+
+
+def parse_cpu(s: str) -> float:
+    return float(s[:-1]) / 1000.0 if s.endswith("m") else float(s)
+
+
+class HamsterCluster:
+    """The e2e harness: a replicated workload under one VPA, with live pod
+    requests as the observable state (what full_vpa.go polls on the real
+    deployment)."""
+
+    def __init__(self, replicas=4, update_mode=UpdateMode.AUTO, policies=()):
+        self.vpa = Vpa(
+            name=VPA_NAME,
+            target_selector=LabelSelector.from_dict(LABELS),
+            update_mode=update_mode,
+            resource_policies=list(policies),
+        )
+        self.model = ClusterStateModel()
+        self.feeder = ClusterStateFeeder(self.model, [self.vpa])
+        self.recommender = PercentileRecommender(self.model)
+        self.updater = Updater()
+        self.metrics = InMemoryMetrics()
+        self.recommendations = {}
+        self.oom_ts = {}
+        # pod state: name -> {"cpu": cores, "memory": bytes}
+        self.requests = {
+            f"{WORKLOAD}-{i}": {"cpu": 0.1, "memory": 200 * MB}
+            for i in range(replicas)
+        }
+        self.evictions = []
+        bundle = generate_certs()
+        self._client_ctx = bundle.client_ssl_context()
+        self.server = AdmissionServer([self.vpa], self.recommendations, tls=bundle)
+        self.server.start()
+
+    def close(self):
+        self.server.stop()
+
+    # -- one simulated control-loop pass ------------------------------------
+    def scrape(self, now, cpu_cores, memory_bytes):
+        self.metrics.set_usage(
+            [
+                ContainerUsage(
+                    namespace="default",
+                    pod_name=name,
+                    container=CONTAINER,
+                    pod_labels=LABELS,
+                    cpu_cores=cpu_cores,
+                    memory_bytes=memory_bytes,
+                )
+                for name in self.requests
+            ]
+        )
+        self.feeder.feed_once(self.metrics, now)
+
+    def recommend(self, now):
+        # keep the dict identity the admission server reads from
+        self.recommendations.clear()
+        self.recommendations.update(self.recommender.recommend(now))
+
+    def update_and_readmit(self, now):
+        """Updater evicts drifted pods; each eviction is followed by the
+        replacement pod going through the webhook (the Recreate loop)."""
+        from autoscaler_tpu.utils.test_utils import build_test_pod
+
+        pods = [
+            build_test_pod(
+                name,
+                cpu_m=req["cpu"] * 1000.0,
+                mem=req["memory"],
+                labels=LABELS,
+            )
+            for name, req in self.requests.items()
+        ]
+        evicted = self.updater.run_once(
+            {WORKLOAD: pods},
+            self.recommendations,
+            {WORKLOAD: VPA_NAME},
+            now,
+            oom_ts=self.oom_ts,
+            recommendation_age_s=0.0,
+            vpas={VPA_NAME: self.vpa},
+        )
+        for pod in evicted:
+            self.evictions.append((now, pod.name))
+            self.requests[pod.name] = self._admit_replacement(pod.name)
+        return evicted
+
+    def _admit_replacement(self, name):
+        """POST the replacement pod's AdmissionReview to the HTTPS webhook
+        and return the patched requests."""
+        pod_json = {
+            "metadata": {"name": name, "labels": dict(LABELS)},
+            "spec": {
+                "containers": [
+                    {
+                        "name": CONTAINER,
+                        "resources": {"requests": {"cpu": "100m", "memory": str(200 * MB)}},
+                    }
+                ]
+            },
+        }
+        review = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {"uid": "u", "namespace": "default", "object": pod_json},
+        }
+        host, port = self.server.address
+        conn = http.client.HTTPSConnection(host, port, timeout=5, context=self._client_ctx)
+        try:
+            conn.request(
+                "POST", "/mutate", json.dumps(review), {"Content-Type": "application/json"}
+            )
+            resp = json.loads(conn.getresponse().read())
+        finally:
+            conn.close()
+        assert resp["response"]["allowed"] is True
+        if "patch" not in resp["response"]:
+            return {"cpu": 0.1, "memory": 200 * MB}
+        ops = json.loads(base64.b64decode(resp["response"]["patch"]))
+        patched = apply_json_patch(pod_json, ops)
+        reqs = patched["spec"]["containers"][0]["resources"]["requests"]
+        return {"cpu": parse_cpu(reqs["cpu"]), "memory": float(reqs["memory"])}
+
+    def run_days(self, days, cpu_cores, memory_bytes, scrape_every_s=1200.0):
+        now = getattr(self, "_now", T0)
+        end = now + days * DAY
+        while now < end:
+            self.scrape(now, cpu_cores, memory_bytes)
+            if int(now) % 3600 < scrape_every_s:  # hourly decision pass
+                self.recommend(now)
+                self.update_and_readmit(now)
+            now += scrape_every_s
+        self._now = now
+        return now
+
+
+@pytest.fixture
+def cluster():
+    c = HamsterCluster()
+    yield c
+    c.close()
+
+
+class TestFullVpa:
+    def test_cpu_requests_grow_with_usage(self, cluster):
+        """full_vpa.go:96 — steady 350m usage vs 100m initial requests: every
+        pod converges up through evict+readmit, close to target (p90 * 1.15
+        margin ~ 0.40 cores)."""
+        cluster.run_days(3, cpu_cores=0.35, memory_bytes=250 * MB)
+        for req in cluster.requests.values():
+            assert 0.30 <= req["cpu"] <= 0.60, cluster.requests
+        assert len(cluster.evictions) >= len(cluster.requests)
+
+    def test_memory_requests_grow_with_usage(self, cluster):
+        """full_vpa.go:111 — memory working set 1GB vs 200MB initial."""
+        cluster.run_days(3, cpu_cores=0.1, memory_bytes=1 * GB)
+        for req in cluster.requests.values():
+            assert req["memory"] >= 0.9 * GB, cluster.requests
+
+    def test_requests_shrink_after_usage_drops(self, cluster):
+        """Decaying histograms let recommendations follow usage down — the
+        recommender side of e2e 'recommendations respect usage decrease'."""
+        cluster.run_days(2, cpu_cores=1.0, memory_bytes=400 * MB)
+        high = {k: dict(v) for k, v in cluster.requests.items()}
+        cluster.run_days(8, cpu_cores=0.15, memory_bytes=400 * MB)
+        for name, req in cluster.requests.items():
+            assert req["cpu"] < high[name]["cpu"] * 0.7, (req, high[name])
+
+    def test_oom_quick_path_bumps_memory(self, cluster):
+        """updater.go OOM quick path + recommender OOM bump: after an OOM
+        observation the pod is evicted promptly and readmitted with memory
+        at least the OOM level."""
+        now = cluster.run_days(1, cpu_cores=0.2, memory_bytes=300 * MB)
+        key = ContainerKey(VPA_NAME, CONTAINER, "default")
+        victim = next(iter(cluster.requests))
+        cluster.model.observe_oom(key, 800 * MB, now, pod=instance_key("default", victim))
+        cluster.oom_ts[f"default/{victim}"] = now
+        cluster.recommend(now)
+        evicted = cluster.update_and_readmit(now + 60.0)
+        assert victim in {p.name for p in evicted}
+        assert cluster.requests[victim]["memory"] >= 800 * MB
+
+    def test_update_mode_off_only_recommends(self):
+        """e2e admission/updater 'Off' mode: recommendations exist but no pod
+        is ever evicted or patched."""
+        c = HamsterCluster(update_mode=UpdateMode.OFF)
+        try:
+            c.run_days(2, cpu_cores=0.5, memory_bytes=600 * MB)
+            assert c.evictions == []
+            key = ContainerKey(VPA_NAME, CONTAINER, "default")
+            assert key in c.recommendations  # recommender still works
+            for req in c.requests.values():
+                assert req["cpu"] == 0.1 and req["memory"] == 200 * MB
+        finally:
+            c.close()
+
+    def test_resource_policy_caps_admitted_requests(self):
+        """e2e admission 'caps to max allowed': maxAllowed clamps what the
+        webhook writes even when usage wants more."""
+        cap = ContainerResourcePolicy(
+            container_name=CONTAINER, max_cpu=0.25, max_memory=400 * MB
+        )
+        c = HamsterCluster(policies=[cap])
+        try:
+            c.run_days(3, cpu_cores=1.5, memory_bytes=2 * GB)
+            for req in c.requests.values():
+                assert req["cpu"] <= 0.25 + 1e-9
+                assert req["memory"] <= 400 * MB + 1
+        finally:
+            c.close()
+
+    def test_eviction_rate_limited_per_pass(self, cluster):
+        """No pass evicts every replica at once (updater.go eviction
+        tolerance): with 4 replicas and default 0.5 tolerance, each decision
+        pass evicts at most 2."""
+        cluster.run_days(2, cpu_cores=0.6, memory_bytes=500 * MB)
+        by_pass = {}
+        for ts, name in cluster.evictions:
+            by_pass.setdefault(ts, []).append(name)
+        assert by_pass, "expected evictions"
+        assert max(len(v) for v in by_pass.values()) <= 2
+
+    def test_checkpoint_restart_preserves_recommendations(self, cluster):
+        """recommender e2e checkpoint scenario: serialize mid-run, rebuild a
+        fresh model from checkpoints, recommendations survive the restart."""
+        now = cluster.run_days(2, cpu_cores=0.4, memory_bytes=700 * MB)
+        cluster.recommend(now)
+        key = ContainerKey(VPA_NAME, CONTAINER, "default")
+        before = cluster.recommendations[key]
+
+        checkpoints = CheckpointManager(cluster.model).store()
+        fresh = ClusterStateModel()
+        CheckpointManager(fresh).load(checkpoints)
+        after = PercentileRecommender(fresh).recommend(now)[key]
+        assert after.target_cpu == pytest.approx(before.target_cpu, rel=0.05)
+        assert after.target_memory == pytest.approx(before.target_memory, rel=0.05)
+
+        # Restored history must SURVIVE subsequent live feeding: the bank
+        # adopts the checkpoint's decay reference, so the first post-restart
+        # sample at a real epoch must not trip a re-reference that zeroes
+        # the restored mass.
+        feeder = ClusterStateFeeder(fresh, [cluster.vpa])
+        metrics = InMemoryMetrics()
+        metrics.set_usage(
+            [
+                ContainerUsage(
+                    "default", "hamster-9", CONTAINER, LABELS,
+                    cpu_cores=0.05, memory_bytes=100 * MB,
+                )
+            ]
+        )
+        feeder.feed_once(metrics, now + 600.0)
+        still = PercentileRecommender(fresh).recommend(now + 600.0)[key]
+        # one tiny sample against two days of history must barely move it
+        assert still.target_cpu == pytest.approx(before.target_cpu, rel=0.10)
+        assert still.target_memory == pytest.approx(before.target_memory, rel=0.10)
