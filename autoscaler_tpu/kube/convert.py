@@ -168,9 +168,18 @@ def pod_from_json(obj: Dict[str, Any]) -> k8s.Pod:
         for port in c.get("ports") or ():
             if port.get("hostPort"):
                 host_ports.append(int(port["hostPort"]))
+    csi_volumes: List[tuple] = []
+    pod_key = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
     for v in spec.get("volumes") or ():
         if "emptyDir" in v or "hostPath" in v:
             local_storage = True
+        csi = v.get("csi")
+        if csi and csi.get("driver"):
+            # inline ephemeral CSI volume: unique to this pod, so its handle
+            # is synthesized from the pod identity + volume name. PVC-backed
+            # volumes need the PV's csi source resolved by the caller (a
+            # PV/PVC lister); set Pod.csi_volumes directly in that case.
+            csi_volumes.append((csi["driver"], f"{pod_key}/{v.get('name', '')}"))
 
     owner = None
     for ref in meta.get("ownerReferences") or ():
@@ -226,6 +235,7 @@ def pod_from_json(obj: Dict[str, Any]) -> k8s.Pod:
         priority=int(spec.get("priority") or 0),
         node_name=spec.get("nodeName", ""),
         host_ports=tuple(host_ports),
+        csi_volumes=tuple(csi_volumes),
         mirror=MIRROR_ANNOTATION in annotations,
         daemonset=bool(owner and owner.kind == "DaemonSet"),
         restartable=owner is not None,

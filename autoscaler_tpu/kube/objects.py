@@ -222,6 +222,10 @@ class Pod:
     priority: int = 0
     node_name: str = ""          # "" = unscheduled/pending
     host_ports: Tuple[int, ...] = ()
+    # (csi driver, volume handle) pairs the pod mounts — PVC-backed volumes
+    # resolved to their PV's CSI source, or inline ephemeral CSI volumes
+    # (NodeVolumeLimits filter input)
+    csi_volumes: Tuple[Tuple[str, str], ...] = ()
     mirror: bool = False          # static/mirror pod
     daemonset: bool = False
     restartable: bool = True      # has a controller that will recreate it
@@ -249,6 +253,10 @@ class Node:
     creation_ts: float = 0.0
     # provider-assigned id; "" for template (hypothetical) nodes
     provider_id: str = ""
+    # CSI driver → max attachable volumes (CSINode spec.drivers[].allocatable
+    # .count); drivers absent here are unlimited, matching the scheduler's
+    # NodeVolumeLimits behavior when CSINode reports no limit
+    csi_attach_limits: Dict[str, int] = field(default_factory=dict)
 
 
 @dataclass

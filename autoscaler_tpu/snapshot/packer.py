@@ -116,11 +116,51 @@ def _node_port_counts(
     return port_count
 
 
+def _node_csi_attached(
+    pods: Sequence[Pod], node_of_pod: Sequence[int]
+) -> Dict[int, Dict[str, set]]:
+    """node index → {csi driver → set of attached volume handles}. Handles
+    are deduped per node: two placed pods sharing a PVC count once, exactly
+    like the scheduler's NodeVolumeLimits accounting."""
+    attached: Dict[int, Dict[str, set]] = {}
+    for i, pod in enumerate(pods):
+        j = node_of_pod[i]
+        if j >= 0 and pod.csi_volumes:
+            per_driver = attached.setdefault(j, {})
+            for driver, handle in pod.csi_volumes:
+                per_driver.setdefault(driver, set()).add(handle)
+    return attached
+
+
+def _pod_csi_counts(pod: Pod) -> Tuple[Tuple[str, int], ...]:
+    """Per-driver count of the pod's unique volume handles, sorted."""
+    counts: Dict[str, set] = {}
+    for driver, handle in pod.csi_volumes:
+        counts.setdefault(driver, set()).add(handle)
+    return tuple(sorted((d, len(h)) for d, h in counts.items()))
+
+
+def _csi_fits(
+    pod_counts: Tuple[Tuple[str, int], ...],
+    node_attached: Dict[str, set],
+    limits: Dict[str, int],
+) -> bool:
+    """NodeVolumeLimits verdict treating all the pod's volumes as new on the
+    node (the class factor's pessimistic stance; the exact already-attached
+    case is a sparse self-cell override)."""
+    for driver, n_new in pod_counts:
+        limit = limits.get(driver)
+        if limit is not None and len(node_attached.get(driver, ())) + n_new > limit:
+            return False
+    return True
+
+
 def _profile_factorization(
     nodes: Sequence[Node],
     pods: Sequence[Pod],
     node_of_pod: Sequence[int],
     port_count: Optional[Dict[int, Dict[int, int]]] = None,
+    csi_attached: Optional[Dict[int, Dict[str, set]]] = None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """→ (pod_prof_id [P], node_prof_id [N], prof_mask [CP, CN]) for the
     class-structured predicates: unschedulable, taints/tolerations,
@@ -129,12 +169,16 @@ def _profile_factorization(
     profile is class data too, so a 100k-pod host-port DaemonSet costs one
     profile, not 100k dense rows. The one non-class cell — a placed pod
     never conflicts with its *own* port on its *own* node — is emitted as a
-    sparse cell override by the callers (_self_port_cell_overrides). Real
+    sparse cell override by the callers (_self_cell_overrides). Real
     clusters have a handful of node shapes and pod specs, so this is
     O(profiles²) host work."""
     P, N = len(pods), len(nodes)
     if port_count is None:
         port_count = _node_port_counts(pods, node_of_pod)
+    if csi_attached is None:
+        csi_attached = _node_csi_attached(pods, node_of_pod)
+    # drivers any pod actually mounts — only these can affect a verdict
+    csi_relevant = {d for pod in pods for d, _ in pod.csi_volumes}
 
     # label keys that can influence any pod's selector/affinity verdict
     relevant: set = set()
@@ -148,20 +192,35 @@ def _profile_factorization(
 
     node_profiles: Dict[tuple, int] = {}
     node_prof_id = np.zeros(N, np.int64)
-    node_exemplar: List[Tuple[Node, Dict[int, int]]] = []
+    node_exemplar: List[Tuple[Node, Dict[int, int], Dict[str, set]]] = []
     for j, node in enumerate(nodes):
         ports = port_count.get(j, {})
-        key = (_node_profile_key(node, relevant_keys), tuple(sorted(ports.items())))
+        attached = csi_attached.get(j, {})
+        csi_key = tuple(
+            sorted(
+                (d, len(attached.get(d, ())), node.csi_attach_limits.get(d, -1))
+                for d in csi_relevant
+            )
+        )
+        key = (
+            _node_profile_key(node, relevant_keys),
+            tuple(sorted(ports.items())),
+            csi_key,
+        )
         pid = node_profiles.setdefault(key, len(node_profiles))
         node_prof_id[j] = pid
         if pid == len(node_exemplar):
-            node_exemplar.append((node, ports))
+            node_exemplar.append((node, ports, attached))
 
     pod_profiles: Dict[tuple, int] = {}
     pod_prof_id = np.zeros(P, np.int64)
     pod_exemplar: List[Pod] = []
     for i, pod in enumerate(pods):
-        key = (_pod_profile_key(pod), tuple(sorted(pod.host_ports)))
+        key = (
+            _pod_profile_key(pod),
+            tuple(sorted(pod.host_ports)),
+            _pod_csi_counts(pod),
+        )
         pid = pod_profiles.setdefault(key, len(pod_profiles))
         pod_prof_id[i] = pid
         if pid == len(pod_exemplar):
@@ -169,7 +228,8 @@ def _profile_factorization(
 
     prof_mask = np.ones((max(len(pod_exemplar), 1), max(len(node_exemplar), 1)), bool)
     for pi, pod in enumerate(pod_exemplar):
-        for nj, (node, ports) in enumerate(node_exemplar):
+        pod_csi = _pod_csi_counts(pod)
+        for nj, (node, ports, attached) in enumerate(node_exemplar):
             if node.unschedulable:
                 prof_mask[pi, nj] = False
             elif not k8s.pod_tolerates_taints(pod, node.taints):
@@ -177,6 +237,8 @@ def _profile_factorization(
             elif not k8s.node_matches_selector(pod, node):
                 prof_mask[pi, nj] = False
             elif any(ports.get(p, 0) > 0 for p in pod.host_ports):
+                prof_mask[pi, nj] = False
+            elif not _csi_fits(pod_csi, attached, node.csi_attach_limits):
                 prof_mask[pi, nj] = False
     return pod_prof_id, node_prof_id, prof_mask
 
@@ -190,26 +252,40 @@ def _class_verdict_no_ports(pod: Pod, node: Node) -> bool:
     )
 
 
-def _self_port_cell_overrides(
+def _self_cell_overrides(
     nodes: Sequence[Node],
     pods: Sequence[Pod],
     node_of_pod: Sequence[int],
     port_count: Optional[Dict[int, Dict[int, int]]] = None,
+    csi_attached: Optional[Dict[int, Dict[str, set]]] = None,
 ) -> List[Tuple[int, int, bool]]:
-    """→ [(pod_idx, node_idx, value)] corrections for the one cell the port
-    class factor gets wrong: a placed pod's verdict on its OWN node must not
-    count its own port contribution. value = class-verdict-without-ports AND
-    no port on the node is occupied more than once (i.e. by anyone else)."""
+    """→ [(pod_idx, node_idx, value)] corrections for the cells the port and
+    CSI class factors get wrong: a placed pod's verdict on its OWN node must
+    not count its own port or attached-volume contribution. Ports: no port
+    occupied more than once (i.e. by anyone else). CSI: the node's attached
+    set already includes this pod's volumes, so staying put adds nothing —
+    fits iff the attached count is within the limit, judged only for the
+    drivers THIS pod mounts (NodeVolumeLimits never blocks a pod on another
+    pod's over-limit driver)."""
     out: List[Tuple[int, int, bool]] = []
     if port_count is None:
         port_count = _node_port_counts(pods, node_of_pod)
+    if csi_attached is None:
+        csi_attached = _node_csi_attached(pods, node_of_pod)
     for i, pod in enumerate(pods):
         j = node_of_pod[i]
-        if j < 0 or not pod.host_ports:
+        if j < 0 or not (pod.host_ports or pod.csi_volumes):
             continue
         counts = port_count.get(j, {})
         conflict = any(counts.get(p, 0) > 1 for p in pod.host_ports)
-        value = _class_verdict_no_ports(pod, nodes[j]) and not conflict
+        attached = csi_attached.get(j, {})
+        pod_drivers = {d for d, _ in pod.csi_volumes}
+        csi_ok = all(
+            len(attached.get(d, ())) <= limit
+            for d, limit in nodes[j].csi_attach_limits.items()
+            if d in pod_drivers
+        )
+        value = _class_verdict_no_ports(pod, nodes[j]) and not conflict and csi_ok
         out.append((i, j, value))
     return out
 
@@ -403,12 +479,15 @@ def compute_sched_mask(
     P, N = len(pods), len(nodes)
     mask = np.ones((P, N), dtype=bool)
     port_count = _node_port_counts(pods, node_of_pod)
+    csi_attached = _node_csi_attached(pods, node_of_pod)
     pod_prof_id, node_prof_id, prof_mask = _profile_factorization(
-        nodes, pods, node_of_pod, port_count
+        nodes, pods, node_of_pod, port_count, csi_attached
     )
     if P and N:
         mask = prof_mask[pod_prof_id][:, node_prof_id]
-    for i, j, value in _self_port_cell_overrides(nodes, pods, node_of_pod, port_count):
+    for i, j, value in _self_cell_overrides(
+        nodes, pods, node_of_pod, port_count, csi_attached
+    ):
         mask[i, j] = value
     _apply_row_rules(_RowView(mask), nodes, pods, node_of_pod, interpod)
     return mask
@@ -443,10 +522,13 @@ def compute_factored_mask(
     placed host-port pods. Host cost is O(profiles² + E·N + K)."""
     P, N = len(pods), len(nodes)
     port_count = _node_port_counts(pods, node_of_pod)
+    csi_attached = _node_csi_attached(pods, node_of_pod)
     pod_prof_id, node_prof_id, prof_mask = _profile_factorization(
-        nodes, pods, node_of_pod, port_count
+        nodes, pods, node_of_pod, port_count, csi_attached
     )
-    overrides = _self_port_cell_overrides(nodes, pods, node_of_pod, port_count)
+    overrides = _self_cell_overrides(
+        nodes, pods, node_of_pod, port_count, csi_attached
+    )
     exc = _exception_pods(pods, node_of_pod, interpod)
     E = len(exc)
     exc_rows = np.zeros((max(E, 1), N), bool)

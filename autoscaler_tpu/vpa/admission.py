@@ -220,11 +220,34 @@ class AdmissionServer:
         self.vpas = vpas
         self.recommendations = recommendations
         self.tls = tls
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        if tls is not None:
-            self._server.socket = tls.server_ssl_context().wrap_socket(
-                self._server.socket, server_side=True
-            )
+        if tls is None:
+            self._server = ThreadingHTTPServer((host, port), Handler)
+        else:
+            # Handshake must NOT run in the accept loop: wrapping the
+            # listening socket makes accept() perform the full handshake in
+            # the serve_forever thread, so one stalled client (half-open
+            # connection, port scan) would block every subsequent webhook
+            # request — and with failurePolicy Ignore, pods would silently
+            # admit unpatched. Wrap per-connection with a lazy handshake (it
+            # then happens in the per-request handler thread) plus a socket
+            # timeout so dead clients release their thread.
+            ssl_ctx = tls.server_ssl_context()
+
+            class TlsServer(ThreadingHTTPServer):
+                def get_request(self):
+                    sock, addr = self.socket.accept()
+                    sock.settimeout(30.0)
+                    return (
+                        ssl_ctx.wrap_socket(
+                            sock, server_side=True, do_handshake_on_connect=False
+                        ),
+                        addr,
+                    )
+
+                def handle_error(self, request, client_address):
+                    pass  # failed handshakes/timeouts are the client's problem
+
+            self._server = TlsServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -218,6 +218,36 @@ class TestAdmissionServer:
         finally:
             server.stop()
 
+    def test_stalled_client_does_not_block_webhook(self):
+        """A half-open TCP connection that never speaks TLS must not park
+        the accept loop: a well-behaved HTTPS client is served while the
+        stalled one is still connected."""
+        import socket
+
+        from autoscaler_tpu.vpa.certs import generate_certs
+
+        bundle = generate_certs()
+        server = AdmissionServer(
+            [make_vpa()], {ContainerKey("my-vpa", "main"): REC}, tls=bundle
+        )
+        server.start()
+        try:
+            host, port = server.address
+            stalled = socket.create_connection((host, port))  # sends nothing
+            try:
+                conn = http.client.HTTPSConnection(
+                    host, port, timeout=5, context=bundle.client_ssl_context()
+                )
+                conn.request(
+                    "POST", "/mutate", json.dumps(make_review()),
+                    {"Content-Type": "application/json"},
+                )
+                assert conn.getresponse().status == 200
+            finally:
+                stalled.close()
+        finally:
+            server.stop()
+
     def test_untrusting_client_rejects_cert(self):
         import ssl
 
