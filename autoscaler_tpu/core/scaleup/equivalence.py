@@ -26,6 +26,13 @@ class PodEquivalenceGroup:
 
 def _spec_fingerprint(pod: Pod) -> Tuple:
     aff = pod.affinity
+    # CSI volumes enter as per-driver unique-handle COUNTS, not handles: the
+    # NodeVolumeLimits verdict (packer._csi_fits) depends only on counts, and
+    # counts keep StatefulSet replicas (same shape, distinct PVC handles) in
+    # one equivalence group while splitting pods with different volume shapes.
+    csi_counts: dict = {}
+    for driver, handle in pod.csi_volumes:
+        csi_counts.setdefault(driver, set()).add(handle)
     return (
         pod.namespace,
         pod.requests.as_tuple(),
@@ -33,6 +40,7 @@ def _spec_fingerprint(pod: Pod) -> Tuple:
         tuple(pod.tolerations),
         tuple(sorted(pod.labels.items())),
         pod.host_ports,
+        tuple(sorted((d, len(h)) for d, h in csi_counts.items())),
         (aff.node_selector_terms, aff.pod_affinity, aff.pod_anti_affinity)
         if aff
         else None,

@@ -27,6 +27,7 @@ from autoscaler_tpu.ops.binpack import (
     ffd_binpack_groups,
     ffd_binpack_groups_affinity,
     ffd_binpack_groups_runs,
+    ffd_binpack_groups_runs_affinity,
 )
 from autoscaler_tpu.snapshot.affinity import build_affinity_terms, has_interpod_affinity
 from autoscaler_tpu.snapshot.packer import compute_sched_mask, resources_row
@@ -124,13 +125,24 @@ class BinpackingNodeEstimator:
             return {g: (0, []) for g in templates}
         names = sorted(templates)
         dynamic_affinity = has_interpod_affinity(pods)
+        groups = pod_groups if pod_groups is not None else build_pod_groups(pods)
         if not dynamic_affinity:
-            groups = pod_groups if pod_groups is not None else build_pod_groups(pods)
             # Equivalence dedup pays when it actually compresses: scan steps
             # drop from P to U (one per unique pod type), the big win at the
             # 100k-pending-pods scale where U is in the hundreds.
             if len(groups) * 2 <= len(pods):
                 return self._estimate_many_runs(pods, groups, names, templates, headrooms)
+        else:
+            # Run-aware affinity path: runs touching any term step per-pod,
+            # the rest collapse — dedup still pays when affinity pods are a
+            # minority of the pending set (the realistic shape).
+            runs, group_terms, group_of_run = self._expand_affinity_runs(
+                pods, groups, templates, names
+            )
+            if len(runs) * 2 <= len(pods):
+                return self._estimate_many_runs_affinity(
+                    pods, runs, group_terms, group_of_run, names, templates, headrooms
+                )
         P = bucket_size(len(pods))
         req = _pack_pods(pods, P)
         masks = np.stack(
@@ -179,6 +191,108 @@ class BinpackingNodeEstimator:
         out: Dict[str, Tuple[int, List[Pod]]] = {}
         for gi, g in enumerate(names):
             out[g] = (int(counts[gi]), [p for i, p in enumerate(pods) if scheds[gi, i]])
+        return out
+
+    @staticmethod
+    def _expand_affinity_runs(
+        pods: Sequence[Pod],
+        groups,
+        templates: Dict[str, Node],
+        names: List[str],
+    ) -> Tuple[List[Tuple[Pod, List[Pod]]], "AffinityTermTensors", np.ndarray]:
+        """→ (runs, group_terms, group_of_run): equivalence runs with
+        affinity-involved groups expanded into singletons, the term tensors
+        built ONCE over the group exemplars, and each run's source-group
+        index (so the run-axis term columns are a gather, not a rebuild).
+
+        A group is involved iff its exemplar matches any term's selector or
+        holds any required (anti-)affinity term — the cases where placement
+        order changes per-term counts mid-run. Exemplars are representative
+        because the equivalence fingerprint includes labels and affinity
+        (core/scaleup/equivalence.py _spec_fingerprint)."""
+        exemplars = [g.exemplar for g in groups]
+        terms = build_affinity_terms(
+            exemplars, [templates[g] for g in names], bucket_terms=True
+        )
+        inv = (terms.match | terms.aff_of | terms.anti_of).any(axis=0)
+        runs: List[Tuple[Pod, List[Pod]]] = []
+        group_of_run: List[int] = []
+        for gi, grp in enumerate(groups):
+            if inv[gi]:
+                runs.extend((p, [p]) for p in grp.pods)
+                group_of_run.extend([gi] * len(grp.pods))
+            else:
+                runs.append((grp.exemplar, grp.pods))
+                group_of_run.append(gi)
+        return runs, terms, np.asarray(group_of_run, np.int64)
+
+    def _estimate_many_runs_affinity(
+        self,
+        pods: Sequence[Pod],
+        runs: List[Tuple[Pod, List[Pod]]],
+        group_terms,
+        group_of_run: np.ndarray,
+        names: List[str],
+        templates: Dict[str, Node],
+        headrooms: Optional[Dict[str, int]],
+    ) -> Dict[str, Tuple[int, List[Pod]]]:
+        """Run-aware affinity path: ffd_binpack_groups_runs_affinity with
+        involved runs pre-expanded to singletons (count 1). Term columns are
+        gathered from the group-exemplar tensors via group_of_run."""
+        U = bucket_size(len(runs))
+        run_exemplars = [ex for ex, _ in runs]
+        run_req = _pack_pods(run_exemplars, U)
+        run_counts = np.zeros((U,), np.int32)
+        run_counts[: len(runs)] = [len(members) for _, members in runs]
+        masks = np.stack(
+            [
+                template_mask(run_exemplars, templates[g], U, interpod=False)
+                for g in names
+            ]
+        )
+        allocs = np.stack(
+            [
+                resources_row(templates[g].allocatable, templates[g].allocatable.pods)
+                for g in names
+            ]
+        )
+        headrooms = headrooms or {}
+        caps = np.array(
+            [self.limiter.node_cap(headrooms.get(g, 0)) for g in names], np.int32
+        )
+        T = group_terms.match.shape[0]
+
+        def to_runs(col_mat: np.ndarray) -> np.ndarray:
+            out = np.zeros((T, U), bool)
+            out[:, : len(runs)] = col_mat[:, group_of_run]
+            return out
+
+        terms_match = to_runs(np.asarray(group_terms.match))
+        terms_aff = to_runs(np.asarray(group_terms.aff_of))
+        terms_anti = to_runs(np.asarray(group_terms.anti_of))
+        involved = (terms_match | terms_aff | terms_anti).any(axis=0)
+        res = ffd_binpack_groups_runs_affinity(
+            jnp.asarray(run_req),
+            jnp.asarray(run_counts),
+            jnp.asarray(masks),
+            jnp.asarray(allocs),
+            max_nodes=bucket_size(int(caps.max()), minimum=8),
+            involved=jnp.asarray(involved),
+            match=jnp.asarray(terms_match),
+            aff_of=jnp.asarray(terms_aff),
+            anti_of=jnp.asarray(terms_anti),
+            node_level=jnp.asarray(group_terms.node_level),
+            has_label=jnp.asarray(group_terms.has_label),
+            node_caps=jnp.asarray(caps),
+        )
+        counts = np.asarray(res.node_count)
+        placed = np.asarray(res.placed_counts)
+        out: Dict[str, Tuple[int, List[Pod]]] = {}
+        for gi, g in enumerate(names):
+            sched: List[Pod] = []
+            for ui, (_, members) in enumerate(runs):
+                sched.extend(members[: placed[gi, ui]])
+            out[g] = (int(counts[gi]), sched)
         return out
 
     def _estimate_many_runs(

@@ -170,6 +170,51 @@ def ffd_binpack_groups(
     )
 
 
+def _max_fit(q, free):
+    """[G, M] f32 — max k with k*q <= free elementwise over resources, exact
+    under f32 multiply via floor-division + a ±1-ulp correction pass (shared
+    by the run-fill kernels; parity-locked to the per-pod scan)."""
+    pos = q > 0                                                  # [G, R]
+    safe_q = jnp.where(pos, q, 1.0)
+    per = jnp.where(
+        pos[:, :, None], jnp.floor(free / safe_q[:, :, None]), jnp.float32(2**30)
+    )
+    cnt = jnp.maximum(per.min(axis=1), 0.0)                      # [G, M]
+
+    def fits_k(k):
+        return jnp.all(k[:, None, :] * q[:, :, None] <= free, axis=1)
+
+    cnt = jnp.where(fits_k(cnt), cnt, jnp.maximum(cnt - 1, 0.0))
+    return jnp.where(fits_k(cnt + 1), cnt + 1, cnt)
+
+
+def _affinity_node_gates(m_p, a_p, x_p, pm, pm_tot, ha, ha_tot, nl, has_label):
+    """Shared dynamic-affinity gating (see ffd_binpack_groups_affinity's
+    docstring for the rules) → (gate_open [G, M], new_ok [G]): which open
+    nodes admit the candidate pod term-wise, and whether it may seed a fresh
+    node. A node without the term's topology label has no domain there, so
+    an anti term over it can never be violated (Kubernetes: the term simply
+    does not match) — hence the has_label gate on both anti directions."""
+    dom_pm = jnp.where(nl[None, :, None], pm, pm_tot[:, :, None])  # [G,T,M]
+    dom_ha = jnp.where(nl[None, :, None], ha, ha_tot[:, :, None])
+    self_seed = m_p & (pm_tot == 0)                              # [G, T]
+    ok_t = ~a_p[:, :, None] | (
+        has_label[:, :, None] & ((dom_pm > 0) | self_seed[:, :, None])
+    )
+    aff_ok = ok_t.all(axis=1)                                    # [G, M]
+    hl = has_label[:, :, None]
+    anti_blocked = (x_p[:, :, None] & (dom_pm > 0) & hl).any(axis=1)
+    sym_blocked = (m_p[:, :, None] & (dom_ha > 0) & hl).any(axis=1)
+    gate_open = aff_ok & ~anti_blocked & ~sym_blocked
+    ok_new_t = ~a_p | jnp.where(
+        nl[None, :], self_seed, has_label & ((pm_tot > 0) | self_seed)
+    )
+    new_ok = ok_new_t.all(axis=1)
+    new_ok &= ~(x_p & ~nl[None, :] & (pm_tot > 0) & has_label).any(axis=1)
+    new_ok &= ~(m_p & ~nl[None, :] & (ha_tot > 0) & has_label).any(axis=1)
+    return gate_open, new_ok
+
+
 class RunBinpackResult(NamedTuple):
     node_count: jax.Array     # [G] i32 — template nodes opened
     placed_counts: jax.Array  # [G, U] i32 — pods of run u placed in group g
@@ -222,30 +267,14 @@ def ffd_binpack_groups_runs(
     garange = jnp.arange(G)
     counts_f = run_counts.astype(jnp.float32)
 
-    def max_fit(q, free):
-        # [G, M] f32 — max k with k*q <= free elementwise over resources,
-        # exact under f32 multiply via floor-division + ±1 correction.
-        pos = q > 0                                                  # [G, R]
-        safe_q = jnp.where(pos, q, 1.0)
-        per = jnp.where(
-            pos[:, :, None], jnp.floor(free / safe_q[:, :, None]), jnp.float32(2**30)
-        )
-        cnt = jnp.maximum(per.min(axis=1), 0.0)                      # [G, M]
-
-        def fits_k(k):
-            return jnp.all(k[:, None, :] * q[:, :, None] <= free, axis=1)
-
-        cnt = jnp.where(fits_k(cnt), cnt, jnp.maximum(cnt - 1, 0.0))
-        return jnp.where(fits_k(cnt + 1), cnt + 1, cnt)
-
     def step(carry, xs):
         used_t, opened = carry            # [G, R, M], [G]
         idx, active = xs                  # [G] i32, [G] bool
         q = run_req[idx]                  # [G, R]
         c = jnp.where(active, counts_f[idx], 0.0)                    # [G]
         free_t = alloc_t - used_t
-        cnt_open = max_fit(q, free_t)                                # [G, M]
-        per_new = max_fit(q, alloc_t)[:, 0]                          # [G]
+        cnt_open = _max_fit(q, free_t)                                # [G, M]
+        per_new = _max_fit(q, alloc_t)[:, 0]                          # [G]
         fits_empty = jnp.all(q <= template_allocs, axis=1)
         open_mask = node_ids[None, :] < opened[:, None]
         new_mask = ~open_mask & (node_ids[None, :] < caps[:, None])
@@ -267,6 +296,139 @@ def ffd_binpack_groups_runs(
         jnp.zeros((G,), jnp.int32),
     )
     (used_t, opened), placed = jax.lax.scan(
+        step, init, (order.T, sorted_mask.T)
+    )                                                                # placed [U, G]
+
+    placed_counts = (
+        jnp.zeros((G, U), jnp.int32)
+        .at[garange[:, None], order]
+        .set(placed.T.astype(jnp.int32))
+    )
+    return RunBinpackResult(
+        node_count=opened,
+        placed_counts=placed_counts,
+        node_used=jnp.swapaxes(used_t, 1, 2),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("max_nodes",))
+def ffd_binpack_groups_runs_affinity(
+    run_req: jax.Array,         # [U, R] unique pod-requirement rows
+    run_counts: jax.Array,      # [U] i32 — identical pods per run
+    run_masks: jax.Array,       # [G, U] bool — run passes group's predicates
+    template_allocs: jax.Array,  # [G, R]
+    max_nodes: int,
+    involved: jax.Array,        # [U] bool — run touches any affinity term
+    match: jax.Array,           # [T, U] bool — term selector matches run
+    aff_of: jax.Array,          # [T, U] bool — run requires affinity term
+    anti_of: jax.Array,         # [T, U] bool — run requires anti term
+    node_level: jax.Array,      # [T] bool — hostname-level topology
+    has_label: jax.Array,       # [G, T] bool — group template has topology label
+    node_caps: jax.Array | None = None,  # [G] i32
+) -> RunBinpackResult:
+    """Equivalence-run FFD that coexists with dynamic inter-pod affinity —
+    the ROADMAP 'run-aware affinity kernel'. Hybrid step semantics:
+
+    - A run with NO term involvement (matches no selector, holds no
+      affinity/anti term) collapses into one greedy-fill step exactly like
+      ffd_binpack_groups_runs: affinity state cannot change while it
+      places, and nothing gates it (the symmetric rule only bites pods that
+      match a held term).
+    - An involved run is pre-expanded by the caller into singleton runs
+      (count 1) and steps through the full affinity-gated placement of
+      ffd_binpack_groups_affinity, carrying per-term counts (pm/ha).
+
+    Both paths are computed vectorized each step and selected per group by
+    `involved[idx]` (groups sort runs independently, so one step can be a
+    plain fill for group A and an affinity placement for group B). Parity
+    with ffd_binpack_groups_affinity on the expanded pod list is locked in
+    tests/test_affinity_binpack.py. Reference semantics:
+    estimator/binpacking_estimator.go:65 + equivalence groups.go:61.
+    """
+    U, R = run_req.shape
+    G = run_masks.shape[0]
+    T = match.shape[0]
+    if node_caps is None:
+        node_caps = jnp.full((G,), max_nodes, jnp.int32)
+    caps = jnp.minimum(node_caps.astype(jnp.int32), max_nodes)
+
+    scores = jax.vmap(lambda alloc: ffd_scores(run_req, alloc))(template_allocs)  # [G, U]
+    order = jnp.argsort(-scores, axis=1, stable=True)                # [G, U]
+    sorted_mask = jnp.take_along_axis(run_masks, order, axis=1)      # [G, U]
+
+    alloc_t = template_allocs[:, :, None]                            # [G, R, 1]
+    node_ids = jnp.arange(max_nodes)
+    garange = jnp.arange(G)
+    counts_f = run_counts.astype(jnp.float32)
+    inv_u = involved.astype(bool)
+    match_t = match.T.astype(bool)                                   # [U, T]
+    aff_t = aff_of.T.astype(bool)
+    anti_t = anti_of.T.astype(bool)
+    nl = node_level.astype(bool)                                     # [T]
+
+    def step(carry, xs):
+        used_t, opened, pm, pm_tot, ha, ha_tot = carry
+        idx, active = xs                  # [G] i32, [G] bool
+        q = run_req[idx]                  # [G, R]
+        inv = inv_u[idx]                  # [G]
+        c = jnp.where(active, counts_f[idx], 0.0)                    # [G]
+        m_p = match_t[idx]                # [G, T]
+        a_p = aff_t[idx]
+        x_p = anti_t[idx]
+
+        free_t = alloc_t - used_t
+        fits_empty = jnp.all(q <= template_allocs, axis=1)           # [G]
+        open_mask = node_ids[None, :] < opened[:, None]              # [G, M]
+
+        # -- path A: plain greedy run fill (inv groups contribute zero) -----
+        cnt_open = _max_fit(q, free_t)                                # [G, M]
+        per_new = _max_fit(q, alloc_t)[:, 0]                          # [G]
+        new_mask = ~open_mask & (node_ids[None, :] < caps[:, None])
+        capvec = jnp.where(open_mask, cnt_open, 0.0) + jnp.where(
+            new_mask & fits_empty[:, None], per_new[:, None], 0.0
+        )
+        prefix = jnp.cumsum(capvec, axis=1)
+        c_a = jnp.where(inv, 0.0, c)
+        take_a = jnp.clip(c_a[:, None] - (prefix - capvec), 0.0, capvec)  # [G, M]
+        high_a = jnp.max(
+            jnp.where((take_a > 0) & new_mask, node_ids[None, :] + 1, 0), axis=1
+        ).astype(jnp.int32)
+
+        # -- path B: affinity-gated single placement (non-inv contribute 0) -
+        fits_n = jnp.all(q[:, :, None] <= free_t, axis=1) & open_mask
+        gate_open, new_ok = _affinity_node_gates(
+            m_p, a_p, x_p, pm, pm_tot, ha, ha_tot, nl, has_label
+        )
+        fits_b = fits_n & gate_open
+        has_fit = fits_b.any(axis=1)
+        first = jnp.argmax(fits_b, axis=1).astype(jnp.int32)
+        can_open = (opened < caps) & fits_empty & new_ok
+        place_b = active & inv & (c > 0) & (has_fit | can_open)
+        target = jnp.where(has_fit, first, opened)
+        onehot_b = (node_ids[None, :] == target[:, None]) & place_b[:, None]  # [G, M]
+
+        # -- combine (A and B are disjoint per group via the inv gate) ------
+        take = take_a + onehot_b.astype(jnp.float32)
+        used_t = used_t + q[:, :, None] * take[:, None, :]
+        opened_b = opened + (place_b & ~has_fit).astype(jnp.int32)
+        opened = jnp.maximum(opened_b, high_a)
+
+        inc = onehot_b[:, None, :]
+        pm = pm + (m_p[:, :, None] & inc).astype(jnp.int32)
+        ha = ha + (x_p[:, :, None] & inc).astype(jnp.int32)
+        pm_tot = pm_tot + (m_p & place_b[:, None]).astype(jnp.int32)
+        ha_tot = ha_tot + (x_p & place_b[:, None]).astype(jnp.int32)
+        return (used_t, opened, pm, pm_tot, ha, ha_tot), take.sum(axis=1)
+
+    init = (
+        jnp.zeros((G, R, max_nodes), run_req.dtype),
+        jnp.zeros((G,), jnp.int32),
+        jnp.zeros((G, T, max_nodes), jnp.int32),
+        jnp.zeros((G, T), jnp.int32),
+        jnp.zeros((G, T, max_nodes), jnp.int32),
+        jnp.zeros((G, T), jnp.int32),
+    )
+    (used_t, opened, *_), placed = jax.lax.scan(
         step, init, (order.T, sorted_mask.T)
     )                                                                # placed [U, G]
 
@@ -345,39 +507,14 @@ def ffd_binpack_groups_affinity(
         fits_n &= node_ids[None, :] < opened[:, None]
 
         # Per-term domain counts seen from node m: own node for hostname-level
-        # terms, the whole group otherwise.
-        dom_pm = jnp.where(nl[None, :, None], pm, pm_tot[:, :, None])  # [G,T,M]
-        dom_ha = jnp.where(nl[None, :, None], ha, ha_tot[:, :, None])
-        self_seed = m_p & (pm_tot == 0)                               # [G, T]
-        ok_t = (
-            ~a_p[:, :, None]
-            | (
-                has_label[:, :, None]
-                & ((dom_pm > 0) | self_seed[:, :, None])
-            )
-        )                                                             # [G,T,M]
-        aff_ok = ok_t.all(axis=1)                                     # [G, M]
-        # A node without the term's topology label has no domain there, so an
-        # anti term over it can never be violated (Kubernetes: the term simply
-        # doesn't match) — hence the has_label gate on both anti directions.
-        hl = has_label[:, :, None]
-        anti_blocked = (x_p[:, :, None] & (dom_pm > 0) & hl).any(axis=1)
-        sym_blocked = (m_p[:, :, None] & (dom_ha > 0) & hl).any(axis=1)
-        fits_n &= aff_ok & ~anti_blocked & ~sym_blocked
+        # terms, the whole group otherwise (_affinity_node_gates).
+        gate_open, new_ok = _affinity_node_gates(
+            m_p, a_p, x_p, pm, pm_tot, ha, ha_tot, nl, has_label
+        )
+        fits_n &= gate_open
 
         has_fit = fits_n.any(axis=1)
         first = jnp.argmax(fits_n, axis=1).astype(jnp.int32)
-
-        # Can this pod seed a fresh node? Hostname-level terms see an empty
-        # domain there; group-level terms see the group totals.
-        ok_new_t = ~a_p | jnp.where(
-            nl[None, :],
-            self_seed,
-            has_label & ((pm_tot > 0) | self_seed),
-        )                                                             # [G, T]
-        new_ok = ok_new_t.all(axis=1)
-        new_ok &= ~(x_p & ~nl[None, :] & (pm_tot > 0) & has_label).any(axis=1)
-        new_ok &= ~(m_p & ~nl[None, :] & (ha_tot > 0) & has_label).any(axis=1)
         fits_empty = jnp.all(req <= template_allocs, axis=1)
         can_open = (opened < caps) & fits_empty & new_ok
 

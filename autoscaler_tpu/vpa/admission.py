@@ -245,7 +245,20 @@ class AdmissionServer:
                     )
 
                 def handle_error(self, request, client_address):
-                    pass  # failed handshakes/timeouts are the client's problem
+                    # failed handshakes/dead clients are the client's
+                    # problem; anything else (a handler bug) must keep the
+                    # stdlib traceback — with failurePolicy Ignore a silent
+                    # failure means pods admit unpatched with no trail
+                    import socket
+                    import ssl as _ssl
+                    import sys
+
+                    exc = sys.exception()
+                    if isinstance(
+                        exc, (_ssl.SSLError, socket.timeout, ConnectionError)
+                    ):
+                        return
+                    super().handle_error(request, client_address)
 
             self._server = TlsServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None

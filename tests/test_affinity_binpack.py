@@ -384,3 +384,204 @@ class TestBuildAffinityTerms:
         assert terms.num_terms == 2
         # a's term only matches pods in namespace default; b only in `other`
         assert terms.match.sum() == 2
+
+
+class TestRunsAffinityHybrid:
+    """ffd_binpack_groups_runs_affinity: plain runs collapse to one step,
+    involved pods step per-pod — must match the per-pod affinity kernel on
+    the expanded pod list exactly (ROADMAP 'run-aware affinity kernel')."""
+
+    @staticmethod
+    def _run_hybrid(run_req, run_counts, run_masks, allocs, max_nodes,
+                    involved, match_r, aff_r, anti_r, node_level, has_label,
+                    caps=None):
+        from autoscaler_tpu.ops.binpack import ffd_binpack_groups_runs_affinity
+
+        return ffd_binpack_groups_runs_affinity(
+            jnp.asarray(run_req), jnp.asarray(run_counts),
+            jnp.asarray(run_masks), jnp.asarray(allocs),
+            max_nodes=max_nodes,
+            involved=jnp.asarray(involved),
+            match=jnp.asarray(match_r), aff_of=jnp.asarray(aff_r),
+            anti_of=jnp.asarray(anti_r), node_level=jnp.asarray(node_level),
+            has_label=jnp.asarray(has_label),
+            node_caps=None if caps is None else jnp.asarray(caps),
+        )
+
+    @staticmethod
+    def _expand(run_req, run_counts, run_masks, match_r, aff_r, anti_r,
+                involved):
+        """Expand runs into the equivalent per-pod arrays. Involved runs must
+        already be singletons (count 1), mirroring the estimator contract."""
+        reps = run_counts.astype(int)
+        pod_req = np.repeat(run_req, reps, axis=0)
+        pod_masks = np.repeat(run_masks, reps, axis=1)
+        match_p = np.repeat(match_r, reps, axis=1)
+        aff_p = np.repeat(aff_r, reps, axis=1)
+        anti_p = np.repeat(anti_r, reps, axis=1)
+        run_of_pod = np.repeat(np.arange(len(reps)), reps)
+        return pod_req, pod_masks, match_p, aff_p, anti_p, run_of_pod
+
+    def _check(self, run_req, run_counts, run_masks, allocs, max_nodes,
+               involved, match_r, aff_r, anti_r, node_level, has_label,
+               caps=None):
+        assert not (involved & (run_counts > 1)).any(), "test bug: expand involved first"
+        res_r = self._run_hybrid(
+            run_req, run_counts, run_masks, allocs, max_nodes, involved,
+            match_r, aff_r, anti_r, node_level, has_label, caps,
+        )
+        pod_req, pod_masks, match_p, aff_p, anti_p, run_of_pod = self._expand(
+            run_req, run_counts, run_masks, match_r, aff_r, anti_r, involved
+        )
+        res_p = ffd_binpack_groups_affinity(
+            jnp.asarray(pod_req), jnp.asarray(pod_masks), jnp.asarray(allocs),
+            max_nodes=max_nodes,
+            match=jnp.asarray(match_p), aff_of=jnp.asarray(aff_p),
+            anti_of=jnp.asarray(anti_p), node_level=jnp.asarray(node_level),
+            has_label=jnp.asarray(has_label),
+            node_caps=None if caps is None else jnp.asarray(caps),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_r.node_count), np.asarray(res_p.node_count)
+        )
+        # per-run placed counts must match the expanded kernel's schedule
+        sched = np.asarray(res_p.scheduled)          # [G, P_expanded]
+        G, U = np.asarray(res_r.placed_counts).shape
+        want = np.zeros((G, U), np.int64)
+        for g in range(G):
+            np.add.at(want[g], run_of_pod[sched[g]], 1)
+        np.testing.assert_array_equal(np.asarray(res_r.placed_counts), want)
+        return res_r
+
+    def _world(self, seed, U_plain=6, n_aff=4, G=3, T=3):
+        """Mixed world: U_plain plain runs (distinct scores, counts 1..9)
+        plus n_aff involved singleton runs with random terms."""
+        rng = np.random.default_rng(seed)
+        U = U_plain + n_aff
+        run_req = np.zeros((U, 6), np.float32)
+        run_req[:, CPU] = rng.choice(
+            np.arange(100, 3100, 100), U, replace=False
+        )
+        run_req[:, MEMORY] = rng.integers(64, 4096, U)
+        run_req[:, PODS] = 1
+        run_counts = np.ones(U, np.int32)
+        run_counts[:U_plain] = rng.integers(1, 10, U_plain)
+        involved = np.zeros(U, bool)
+        involved[U_plain:] = True
+        match_r = np.zeros((T, U), bool)
+        aff_r = np.zeros((T, U), bool)
+        anti_r = np.zeros((T, U), bool)
+        match_r[:, U_plain:] = rng.random((T, n_aff)) < 0.5
+        aff_r[:, U_plain:] = rng.random((T, n_aff)) < 0.3
+        anti_r[:, U_plain:] = (rng.random((T, n_aff)) < 0.3) & ~aff_r[:, U_plain:]
+        # the involvement invariant: flagged runs actually touch a term
+        involved[U_plain:] = (
+            match_r[:, U_plain:] | aff_r[:, U_plain:] | anti_r[:, U_plain:]
+        ).any(axis=0)
+        node_level = rng.random(T) < 0.5
+        has_label = rng.random((G, T)) < 0.8
+        allocs = np.zeros((G, 6), np.float32)
+        allocs[:, CPU] = rng.integers(4000, 12000, G)
+        allocs[:, MEMORY] = rng.integers(8192, 16384, G)
+        allocs[:, PODS] = 32
+        run_masks = rng.random((G, U)) > 0.1
+        caps = rng.integers(3, 16, G).astype(np.int32)
+        return (run_req, run_counts, run_masks, allocs, 16, involved,
+                match_r, aff_r, anti_r, node_level, has_label, caps)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_mixed_world_parity(self, seed):
+        self._check(*self._world(seed))
+
+    def test_all_plain_degenerates_to_runs_kernel(self):
+        from autoscaler_tpu.ops.binpack import ffd_binpack_groups_runs
+
+        rng = np.random.default_rng(3)
+        U, G, T = 5, 2, 2
+        run_req = np.zeros((U, 6), np.float32)
+        run_req[:, CPU] = rng.choice(np.arange(200, 2200, 200), U, replace=False)
+        run_req[:, PODS] = 1
+        run_counts = rng.integers(1, 8, U).astype(np.int32)
+        allocs = np.zeros((G, 6), np.float32)
+        allocs[:, CPU] = [4000, 6000]
+        allocs[:, PODS] = 110
+        run_masks = np.ones((G, U), bool)
+        res_h = self._run_hybrid(
+            run_req, run_counts, run_masks, allocs, 16,
+            np.zeros(U, bool), np.zeros((T, U), bool), np.zeros((T, U), bool),
+            np.zeros((T, U), bool), np.zeros(T, bool), np.zeros((G, T), bool),
+        )
+        res_r = ffd_binpack_groups_runs(
+            jnp.asarray(run_req), jnp.asarray(run_counts),
+            jnp.asarray(run_masks), jnp.asarray(allocs), max_nodes=16,
+        )
+        np.testing.assert_array_equal(res_h.node_count, res_r.node_count)
+        np.testing.assert_array_equal(res_h.placed_counts, res_r.placed_counts)
+
+    def test_anti_affinity_pods_spread_while_plain_runs_fill(self):
+        """3 anti-affine pods need 3 nodes; a 10-pod plain run fills the
+        remaining capacity of those same nodes without extra opens."""
+        U, G, T = 4, 1, 1
+        run_req = np.zeros((U, 6), np.float32)
+        run_req[:, PODS] = 1
+        run_req[0, CPU] = 500          # plain run, low score
+        run_req[1:, CPU] = 2000        # three anti-affine singletons
+        run_counts = np.array([10, 1, 1, 1], np.int32)
+        involved = np.array([False, True, True, True])
+        match_r = np.array([[False, True, True, True]])
+        anti_r = np.array([[False, True, True, True]])
+        aff_r = np.zeros((T, U), bool)
+        node_level = np.array([True])
+        has_label = np.ones((G, T), bool)
+        allocs = np.zeros((G, 6), np.float32)
+        allocs[:, CPU] = 4000
+        allocs[:, PODS] = 110
+        run_masks = np.ones((G, U), bool)
+        res = self._check(
+            run_req, run_counts, run_masks, allocs, 8, involved,
+            match_r, aff_r, anti_r, node_level, has_label,
+        )
+        assert int(np.asarray(res.node_count)[0]) == 3
+        assert int(np.asarray(res.placed_counts)[0].sum()) == 13
+
+
+class TestEstimatorRunsAffinity:
+    def test_estimate_many_dedup_matches_per_pod_path(self):
+        """The estimator's run-aware affinity path must produce the same
+        counts and schedule as the per-pod affinity path on a realistic
+        mixed workload (two plain deployments + an anti-affine one)."""
+        est = BinpackingNodeEstimator()
+        pods = []
+        for i in range(12):
+            pods.append(build_test_pod(
+                f"web-{i}", cpu_m=500, mem=1 << 30, labels={"app": "web"},
+                owner_kind="ReplicaSet",
+            ))
+        for i in range(8):
+            pods.append(build_test_pod(
+                f"api-{i}", cpu_m=900, mem=2 << 30, labels={"app": "api"},
+                owner_kind="ReplicaSet",
+            ))
+        for i in range(3):
+            pods.append(build_test_pod(
+                f"db-{i}", cpu_m=1500, mem=4 << 30, labels={"app": "db"},
+                owner_kind="StatefulSet",
+                affinity=anti_affinity({"app": "db"}),
+            ))
+        templates = {
+            "small": build_test_node("t-small", cpu_m=4000, mem=16 << 30),
+            "big": build_test_node("t-big", cpu_m=16000, mem=64 << 30),
+        }
+        out_runs = est.estimate_many(pods, templates)
+
+        est2 = BinpackingNodeEstimator()
+        est2._expand_affinity_runs = lambda p, g, t, n: (
+            [(x, [x]) for x in p], None, None
+        )
+        out_pods = est2.estimate_many(pods, templates)
+
+        for g in templates:
+            assert out_runs[g][0] == out_pods[g][0], g
+            assert {p.name for p in out_runs[g][1]} == {
+                p.name for p in out_pods[g][1]
+            }, g
