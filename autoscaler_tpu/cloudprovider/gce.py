@@ -144,6 +144,13 @@ class GceApi(abc.ABC):
     @abc.abstractmethod
     def get_template(self, project: str, zone: str, mig: str) -> MigTemplate: ...
 
+    def list_migs(self) -> List[Tuple[str, str, str]]:
+        """(project, zone, name) of every MIG visible to the credentials —
+        the discovery surface behind --node-group-auto-discovery
+        (reference cloudprovider/gce MIG auto-discovery by name prefix).
+        Default empty: transports without list permission discover nothing."""
+        return []
+
 
 class InMemoryGceApi(GceApi):
     """Hermetic GCE: resize creates CREATING instances that become RUNNING on
@@ -153,6 +160,9 @@ class InMemoryGceApi(GceApi):
     def __init__(self) -> None:
         self._migs: Dict[Tuple[str, str, str], Dict] = {}
         self.calls: List[Tuple] = []
+
+    def list_migs(self) -> List[Tuple[str, str, str]]:
+        return list(self._migs.keys())
 
     def add_mig(
         self,
@@ -500,15 +510,41 @@ class GceCloudProvider(CloudProvider):
                 self._node_to_mig[inst.name] = mig
 
 
+def parse_auto_discovery_spec(spec: str) -> Dict[str, object]:
+    """'mig:namePrefix=<pfx>,min=<m>,max=<M>' → {"prefix", "min", "max"} —
+    the reference's GCE auto-discovery spec format
+    (--node-group-auto-discovery, cloudprovider/gce MIG auto-discovery)."""
+    kind, _, rest = spec.partition(":")
+    if kind != "mig" or not rest:
+        raise ValueError(f"bad auto-discovery spec {spec!r} (want mig:namePrefix=...)")
+    out: Dict[str, object] = {"prefix": "", "min": 0, "max": 1000}
+    for part in rest.split(","):
+        k, _, v = part.partition("=")
+        if k == "namePrefix":
+            out["prefix"] = v
+        elif k == "min":
+            out["min"] = int(v)
+        elif k == "max":
+            out["max"] = int(v)
+        else:
+            raise ValueError(f"unknown auto-discovery key {k!r} in {spec!r}")
+    if not out["prefix"]:
+        raise ValueError(f"auto-discovery spec {spec!r} needs namePrefix")
+    return out
+
+
 def build_gce_provider(
     specs: Sequence[str],
     api: GceApi,
     resource_limiter: Optional[ResourceLimiter] = None,
     cache_ttl_s: float = 60.0,
+    auto_discovery: Sequence[str] = (),
 ) -> GceCloudProvider:
     """specs: 'min:max:projects/P/zones/Z/instanceGroups/NAME' — the
     reference's --nodes flag format (main.go --nodes, spec parsing in
-    cloudprovider/gce)."""
+    cloudprovider/gce). auto_discovery: 'mig:namePrefix=...,min=...,max=...'
+    specs (--node-group-auto-discovery); MIGs matching a prefix and not
+    already explicitly configured are added with the spec's size bounds."""
     manager = GceManager(api, cache_ttl_s)
     migs = []
     for spec in specs:
@@ -518,4 +554,15 @@ def build_gce_provider(
         lo, hi, url = int(parts[0]), int(parts[1]), parts[2]
         project, zone, name = parse_mig_url(url)
         migs.append(GceMig(manager, project, zone, name, lo, hi))
+    explicit = {(m.project, m.zone, m.name) for m in migs}
+    for disc_spec in auto_discovery:
+        disc = parse_auto_discovery_spec(disc_spec)
+        for project, zone, name in api.list_migs():
+            key = (project, zone, name)
+            if key in explicit or not name.startswith(str(disc["prefix"])):
+                continue
+            explicit.add(key)
+            migs.append(
+                GceMig(manager, project, zone, name, int(disc["min"]), int(disc["max"]))
+            )
     return GceCloudProvider(manager, migs, resource_limiter)

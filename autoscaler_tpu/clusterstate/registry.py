@@ -104,7 +104,11 @@ class ClusterStateRegistry:
     ):
         self.provider = provider
         self.options = options
-        self.backoff = backoff or ExponentialBackoff()
+        self.backoff = backoff or ExponentialBackoff(
+            initial_s=options.initial_node_group_backoff_duration_s,
+            max_s=options.max_node_group_backoff_duration_s,
+            reset_timeout_s=options.node_group_backoff_reset_timeout_s,
+        )
         self.scale_up_requests: Dict[str, ScaleUpRequest] = {}
         self.scale_down_requests: List[ScaleDownRequest] = []
         self.scale_up_failures: List[ScaleUpFailure] = []

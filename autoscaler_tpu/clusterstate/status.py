@@ -34,10 +34,12 @@ class ClusterStatus:
     total_ready: int
     total_registered: int
     groups: List[GroupStatus] = field(default_factory=list)
+    cluster_name: str = ""  # --cluster-name, shown in the header when set
 
     def render(self) -> str:
+        name = f" [{self.cluster_name}]" if self.cluster_name else ""
         lines = [
-            f"Cluster-autoscaler status at {time.strftime('%Y-%m-%d %H:%M:%S', time.gmtime(self.time_ts))}:",
+            f"Cluster-autoscaler status{name} at {time.strftime('%Y-%m-%d %H:%M:%S', time.gmtime(self.time_ts))}:",
             f"Cluster-wide: Health: {self.cluster_health} "
             f"(ready={self.total_ready} registered={self.total_registered})",
         ]
@@ -51,13 +53,16 @@ class ClusterStatus:
         return "\n".join(lines)
 
 
-def build_status(csr: ClusterStateRegistry, now_ts: float) -> ClusterStatus:
+def build_status(
+    csr: ClusterStateRegistry, now_ts: float, cluster_name: str = ""
+) -> ClusterStatus:
     total = csr.total_readiness()
     status = ClusterStatus(
         time_ts=now_ts,
         cluster_health="Healthy" if csr.is_cluster_healthy() else "Unhealthy",
         total_ready=total.ready,
         total_registered=total.registered,
+        cluster_name=cluster_name,
     )
     for group in csr.provider.node_groups():
         gid = group.id()

@@ -83,6 +83,11 @@ class AutoscalingOptions:
     max_total_unready_percentage: float = 45.0    # main.go:148
     ok_total_unready_count: int = 3               # main.go:149
 
+    # -- per-nodegroup backoff (utils/backoff/exponential_backoff.go) --------
+    initial_node_group_backoff_duration_s: float = 300.0   # 5m
+    max_node_group_backoff_duration_s: float = 1800.0      # 30m
+    node_group_backoff_reset_timeout_s: float = 10800.0    # 3h
+
     # -- scale-down ----------------------------------------------------------
     scale_down_enabled: bool = True
     scale_down_delay_after_add_s: float = 600.0   # 10m
@@ -112,10 +117,41 @@ class AutoscalingOptions:
     skip_nodes_with_local_storage: bool = True
     skip_nodes_with_custom_controller_pods: bool = True
     min_replica_count: int = 0
+    # unready nodes may be scale-down candidates (ScaleDownUnreadyEnabled,
+    # --scale-down-unready-enabled, default true)
+    scale_down_unready_enabled: bool = True
+    # pacing between tainting a node and deleting it, and the overall
+    # deletion-confirmation timeout (NodeDeleteDelayAfterTaint,
+    # NodeDeletionDelayTimeout). DIVERGENCE: the reference defaults the
+    # taint delay to 5s *inside its async deletion goroutine*
+    # (actuator.go:234); this framework's actuation wave is synchronous by
+    # design (the loop joins it), so a nonzero delay extends the control
+    # loop directly — default off, opt in if your scheduler lags taint
+    # observation. The pause is paid inside the per-node workers, so drain
+    # waves overlap it with eviction work.
+    node_delete_delay_after_taint_s: float = 0.0
+    node_deletion_delay_timeout_s: float = 120.0
 
     # -- misc ---------------------------------------------------------------
     cloud_provider: str = "test"
+    cluster_name: str = ""                        # --cluster-name (status header)
+    # HTTP User-Agent; consumed by KubeRestClient — deploy sites pass it when
+    # constructing their client (no CLI flag: main.py's test provider makes
+    # no API calls)
+    user_agent: str = "tpu-autoscaler"
+    config_namespace: str = "kube-system"         # --namespace
+    status_config_map_name: str = "cluster-autoscaler-status"
     write_status_configmap: bool = True
+    # startup/ignored taints stripped from templates before comparison and
+    # simulation (--ignore-taint; taints.go ignored-taints handling)
+    ignored_taints: List[str] = field(default_factory=list)
+    # extra labels excluded from node-group similarity comparison, on top of
+    # the built-in ignore list (--balancing-ignore-label)
+    balancing_extra_ignored_labels: List[str] = field(default_factory=list)
+    # node-group auto-discovery specs, parsed by the cloud provider
+    # (--node-group-auto-discovery, e.g. "label:k1=v1,k2=v2" or provider
+    # MIG/ASG prefix specs)
+    node_group_auto_discovery: List[str] = field(default_factory=list)
     # per-nodegroup gauges are opt-in for cardinality, like the reference's
     # --record-node-group-metrics flag (main.go:201)
     record_per_node_group_metrics: bool = False

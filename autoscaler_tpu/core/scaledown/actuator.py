@@ -231,6 +231,7 @@ class ScaleDownActuator:
         self.options = options
         self.api = api
         self.tracker = tracker or NodeDeletionTracker()
+        self.sleep = sleep
         self.evictor = Evictor(api, options, clock=clock, sleep=sleep)
 
     # -- reference actuator.go:80 -------------------------------------------
@@ -245,6 +246,8 @@ class ScaleDownActuator:
         for r in empty + drain:
             try:
                 self.api.add_taint(r.node.name, to_be_deleted_taint())
+                if self.options.cordon_node_before_terminating:
+                    self.api.cordon_node(r.node.name)
             except Exception as e:
                 result.failed[r.node.name] = f"taint failed: {e}"
         empty = [r for r in empty if r.node.name not in result.failed]
@@ -278,6 +281,11 @@ class ScaleDownActuator:
         def delete_empty(r: NodeToRemove, group: NodeGroup) -> None:
             """actuator.go:156 deleteAsyncEmpty — no drain simulation, just
             optional best-effort DS eviction then the batched cloud delete."""
+            if self.options.node_delete_delay_after_taint_s > 0:
+                # scheduler gets time to observe the ToBeDeleted taint
+                # (actuator.go NodeDeleteDelayAfterTaint); paid inside the
+                # worker so parallel waves overlap the pause
+                self.sleep(self.options.node_delete_delay_after_taint_s)
             if self.options.daemonset_eviction_for_empty_nodes:
                 evicted = self.evictor.evict_daemonset_pods(r.daemonset_pods)
                 with result_lock:
@@ -288,6 +296,8 @@ class ScaleDownActuator:
             """actuator.go:206,356 scheduleDeletion — evict (paced), then
             hand the node to the batcher; eviction failure rolls the taint
             back and never reaches the cloud call."""
+            if self.options.node_delete_delay_after_taint_s > 0:
+                self.sleep(self.options.node_delete_delay_after_taint_s)
             ok, evicted = self.evictor.drain_node(
                 r.node, r.pods_to_reschedule, self.tracker, now_ts
             )

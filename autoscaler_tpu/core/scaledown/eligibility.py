@@ -23,6 +23,7 @@ from autoscaler_tpu.kube.objects import (
 from autoscaler_tpu.ops.utilization import node_utilization
 from autoscaler_tpu.simulator.removal import UnremovableNode, UnremovableReason
 from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+from autoscaler_tpu.utils import klogx
 
 
 @dataclass
@@ -40,12 +41,15 @@ class EligibilityChecker:
         """→ (eligible node names, utilization by name, unremovable). One
         utilization kernel call covers all nodes."""
         tensors, meta = snapshot.tensors()
-        util = np.asarray(node_utilization(tensors))
+        exclude = self._excluded_usage(tensors, meta)
+        util = np.asarray(node_utilization(tensors, exclude_used=exclude))
         alloc_gpu = np.asarray(tensors.node_alloc[:, GPU])
 
         eligible: List[str] = []
         utilization: Dict[str, float] = {}
         unremovable: List[UnremovableNode] = []
+        # per-loop quota for per-node lines (eligibility.go:71)
+        util_quota = klogx.new_logging_quota(20)
         for node in candidates:
             if unremovable_cache is not None and unremovable_cache.is_recently_unremovable(
                 node.name, now_ts
@@ -64,6 +68,9 @@ class EligibilityChecker:
                 continue
             u = float(util[j])
             utilization[node.name] = u
+            klogx.v(4).up_to(util_quota).info(
+                "Node %s utilization %.3f", node.name, u
+            )
             group_opts = self._group_options(node)
             threshold = (
                 group_opts.scale_down_gpu_utilization_threshold
@@ -72,15 +79,44 @@ class EligibilityChecker:
             )
             if not node.ready:
                 # unready nodes are scale-down candidates regardless of
-                # utilization (reference eligibility.go: unready path)
-                eligible.append(node.name)
+                # utilization (reference eligibility.go: unready path) —
+                # unless the operator disabled it (ScaleDownUnreadyEnabled)
+                if self.options.scale_down_unready_enabled:
+                    eligible.append(node.name)
+                else:
+                    unremovable.append(
+                        UnremovableNode(node, UnremovableReason.UNREADY_NOT_ALLOWED)
+                    )
             elif u >= threshold:
                 unremovable.append(
                     UnremovableNode(node, UnremovableReason.NOT_UTILIZED_ENOUGH)
                 )
             else:
                 eligible.append(node.name)
+        klogx.v(4).over(util_quota).info(
+            "Skipped logging utilization for %d other nodes", -util_quota.left
+        )
         return eligible, utilization, unremovable
+
+    def _excluded_usage(self, tensors, meta):
+        """[N, R] usage to subtract from the utilization numerator when
+        DaemonSet/mirror pods are configured as free (info.go:49
+        CalculateUtilization's skipDaemonSetPods/skipMirrorPods)."""
+        skip_ds = self.options.ignore_daemonsets_utilization
+        skip_mirror = self.options.ignore_mirror_pods_utilization
+        if not (skip_ds or skip_mirror):
+            return None
+        from autoscaler_tpu.snapshot.packer import resources_row
+
+        exclude = np.zeros(tensors.node_alloc.shape, np.float32)
+        for pod in meta.pods:
+            if not pod.node_name:
+                continue
+            if (skip_ds and pod.daemonset) or (skip_mirror and pod.mirror):
+                j = meta.node_index.get(pod.node_name)
+                if j is not None:
+                    exclude[j] += resources_row(pod.requests, 1.0)
+        return exclude
 
     def _group_options(self, node: Node):
         if self.provider is not None:

@@ -31,6 +31,7 @@ from autoscaler_tpu.metrics import metrics as metrics_mod
 from autoscaler_tpu.metrics.healthcheck import HealthCheck
 from autoscaler_tpu.simulator.removal import UnremovableReason
 from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+from autoscaler_tpu.utils import klogx
 
 
 @dataclass
@@ -67,7 +68,7 @@ class StaticAutoscaler:
         self.provider = provider
         self.api = api
         self.options = options or AutoscalingOptions()
-        self.processors = processors or default_processors()
+        self.processors = processors or default_processors(self.options)
         self.csr = csr or ClusterStateRegistry(provider, self.options)
         self.metrics = metrics or metrics_mod.AutoscalerMetrics()
         self.scale_up_orchestrator = scale_up_orchestrator or ScaleUpOrchestrator(
@@ -111,7 +112,29 @@ class StaticAutoscaler:
 
         m = self.metrics
         start = _time.monotonic()
-        result = self._run_once_inner(now_ts)
+        try:
+            result = self._run_once_inner(now_ts)
+        finally:
+            # status ConfigMap write mirrors the reference's defer
+            # (static_autoscaler.go:387-393 + clusterstate.go:701): it must
+            # run on EVERY exit path — unhealthy-cluster and error returns
+            # included — or operators would read a stale 'Healthy' status
+            # exactly while the autoscaler is degraded.
+            if self.options.write_status_configmap:
+                try:
+                    from autoscaler_tpu.clusterstate.status import build_status
+
+                    self.api.write_configmap(
+                        self.options.config_namespace,
+                        self.options.status_config_map_name,
+                        {
+                            "status": build_status(
+                                self.csr, now_ts, self.options.cluster_name
+                            ).render()
+                        },
+                    )
+                except Exception:
+                    pass  # best-effort observability, never loop-fatal
         m.observe_duration(metrics_mod.MAIN, start)
         m.unschedulable_pods_count.set(result.pending_pods)
         m.unneeded_nodes_count.set(result.unneeded_nodes)
@@ -280,6 +303,14 @@ class StaticAutoscaler:
         snapshot.fork()
         pending, filtered = self.pod_list_processor.process(snapshot, pending)
         snapshot.revert()
+        # quota-bounded per-pod verbosity (static_autoscaler.go:528 area +
+        # utils/klogx defaults: 20 lines, 1000 at -v>=5)
+        pod_quota = klogx.pods_logging_quota()
+        for pod in pending:
+            klogx.v(4).up_to(pod_quota).info("Pod %s is unschedulable", pod.key())
+        klogx.v(4).over(pod_quota).info(
+            "%d other unschedulable pods not logged", -pod_quota.left
+        )
         self.metrics.observe_duration(metrics_mod.FILTER_OUT_SCHEDULABLE, t_filter)
         result.filtered_schedulable = len(filtered)
         result.pending_pods = len(pending)

@@ -132,16 +132,19 @@ class BinpackingNodeEstimator:
             # 100k-pending-pods scale where U is in the hundreds.
             if len(groups) * 2 <= len(pods):
                 return self._estimate_many_runs(pods, groups, names, templates, headrooms)
-        else:
+        elif len(groups) * 2 <= len(pods):
             # Run-aware affinity path: runs touching any term step per-pod,
             # the rest collapse — dedup still pays when affinity pods are a
-            # minority of the pending set (the realistic shape).
-            runs, group_terms, group_of_run = self._expand_affinity_runs(
+            # minority of the pending set (the realistic shape). The group
+            # count lower-bounds the run count (expansion only grows it), so
+            # worlds that can never compress skip the term build entirely.
+            runs, group_terms, group_of_run, run_inv = self._expand_affinity_runs(
                 pods, groups, templates, names
             )
             if len(runs) * 2 <= len(pods):
                 return self._estimate_many_runs_affinity(
-                    pods, runs, group_terms, group_of_run, names, templates, headrooms
+                    pods, runs, group_terms, group_of_run, run_inv,
+                    names, templates, headrooms,
                 )
         P = bucket_size(len(pods))
         req = _pack_pods(pods, P)
@@ -199,7 +202,7 @@ class BinpackingNodeEstimator:
         groups,
         templates: Dict[str, Node],
         names: List[str],
-    ) -> Tuple[List[Tuple[Pod, List[Pod]]], "AffinityTermTensors", np.ndarray]:
+    ) -> Tuple[List[Tuple[Pod, List[Pod]]], "AffinityTermTensors", np.ndarray, np.ndarray]:
         """→ (runs, group_terms, group_of_run): equivalence runs with
         affinity-involved groups expanded into singletons, the term tensors
         built ONCE over the group exemplars, and each run's source-group
@@ -224,7 +227,8 @@ class BinpackingNodeEstimator:
             else:
                 runs.append((grp.exemplar, grp.pods))
                 group_of_run.append(gi)
-        return runs, terms, np.asarray(group_of_run, np.int64)
+        group_of_run_arr = np.asarray(group_of_run, np.int64)
+        return runs, terms, group_of_run_arr, inv[group_of_run_arr]
 
     def _estimate_many_runs_affinity(
         self,
@@ -232,6 +236,7 @@ class BinpackingNodeEstimator:
         runs: List[Tuple[Pod, List[Pod]]],
         group_terms,
         group_of_run: np.ndarray,
+        run_inv: np.ndarray,
         names: List[str],
         templates: Dict[str, Node],
         headrooms: Optional[Dict[str, int]],
@@ -270,7 +275,8 @@ class BinpackingNodeEstimator:
         terms_match = to_runs(np.asarray(group_terms.match))
         terms_aff = to_runs(np.asarray(group_terms.aff_of))
         terms_anti = to_runs(np.asarray(group_terms.anti_of))
-        involved = (terms_match | terms_aff | terms_anti).any(axis=0)
+        involved = np.zeros((U,), bool)
+        involved[: len(runs)] = run_inv
         res = ffd_binpack_groups_runs_affinity(
             jnp.asarray(run_req),
             jnp.asarray(run_counts),

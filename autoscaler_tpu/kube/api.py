@@ -62,8 +62,18 @@ class ClusterAPI(abc.ABC):
     def delete_node_object(self, node_name: str) -> None:
         """Remove the Node object after cloud deletion."""
 
+    def cordon_node(self, node_name: str) -> None:
+        """Mark the node unschedulable (kubectl cordon) — used when
+        --cordon-node-before-terminating is set (reference
+        utils/taints + actuator cordon path). Default: no-op."""
+
     def record_event(self, kind: str, name: str, reason: str, message: str) -> None:
         pass
+
+    def write_configmap(self, namespace: str, name: str, data: dict) -> None:
+        """Create-or-update a ConfigMap (the status ConfigMap write,
+        reference clusterstate.go:701 WriteStatusConfigMap). Default no-op
+        for implementations without a config store."""
 
 
 @dataclass
@@ -76,6 +86,7 @@ class FakeClusterAPI(ClusterAPI):
     pdbs: List[PodDisruptionBudget] = field(default_factory=list)
     evicted: List[str] = field(default_factory=list)
     events: List[Tuple[str, str, str, str]] = field(default_factory=list)
+    configmaps: Dict[Tuple[str, str], Dict] = field(default_factory=dict)
     fail_evictions_for: set = field(default_factory=set)
     # pod key → number of times eviction fails before succeeding (transient
     # failure injection for retry pacing tests)
@@ -130,6 +141,12 @@ class FakeClusterAPI(ClusterAPI):
             if node:
                 node.taints = [t for t in node.taints if t.key != taint_key]
 
+    def cordon_node(self, node_name: str) -> None:
+        with self._lock:
+            node = self.nodes.get(node_name)
+            if node:
+                node.unschedulable = True
+
     def delete_node_object(self, node_name: str) -> None:
         with self._lock:
             self.nodes.pop(node_name, None)
@@ -140,6 +157,10 @@ class FakeClusterAPI(ClusterAPI):
     def record_event(self, kind: str, name: str, reason: str, message: str) -> None:
         with self._lock:
             self.events.append((kind, name, reason, message))
+
+    def write_configmap(self, namespace: str, name: str, data: dict) -> None:
+        with self._lock:
+            self.configmaps[(namespace, name)] = dict(data)
 
 
 def to_be_deleted_taint() -> Taint:

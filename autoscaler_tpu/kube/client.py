@@ -47,10 +47,12 @@ class KubeRestClient:
         ca_file: Optional[str] = None,
         verify: bool = True,
         timeout_s: float = 30.0,
+        user_agent: str = "tpu-autoscaler",
     ):
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout_s = timeout_s
+        self.user_agent = user_agent
         if self.base_url.startswith("https"):
             ctx = ssl.create_default_context(cafile=ca_file)
             if not verify:
@@ -61,7 +63,7 @@ class KubeRestClient:
             self._ctx = None
 
     @staticmethod
-    def in_cluster() -> "KubeRestClient":
+    def in_cluster(user_agent: str = "tpu-autoscaler") -> "KubeRestClient":
         """Service-account config, like rest.InClusterConfig."""
         import os
 
@@ -69,7 +71,10 @@ class KubeRestClient:
         port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
         with open(SA_TOKEN_PATH) as f:
             token = f.read().strip()
-        return KubeRestClient(f"https://{host}:{port}", token=token, ca_file=SA_CA_PATH)
+        return KubeRestClient(
+            f"https://{host}:{port}", token=token, ca_file=SA_CA_PATH,
+            user_agent=user_agent,
+        )
 
     def _request(
         self,
@@ -84,6 +89,7 @@ class KubeRestClient:
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
         req.add_header("Accept", "application/json")
+        req.add_header("User-Agent", self.user_agent)
         if data is not None:
             req.add_header("Content-Type", content_type)
         if self.token:
@@ -310,6 +316,26 @@ class KubeClusterAPI(ClusterAPI):
         self._patch_taints(
             node_name, lambda taints: [t for t in taints if t.key != taint_key]
         )
+
+    def cordon_node(self, node_name: str) -> None:
+        self.client.patch(
+            f"/api/v1/nodes/{node_name}", {"spec": {"unschedulable": True}}
+        )
+
+    def write_configmap(self, namespace: str, name: str, data: dict) -> None:
+        body = {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": namespace},
+            "data": {k: str(v) for k, v in data.items()},
+        }
+        path = f"/api/v1/namespaces/{namespace}/configmaps/{name}"
+        try:
+            self.client.put(path, body)
+        except ApiError as e:
+            if e.status != 404:
+                raise
+            self.client.post(f"/api/v1/namespaces/{namespace}/configmaps", body)
 
     def delete_node_object(self, node_name: str) -> None:
         try:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import logging
 import sys
 import threading
 import time
@@ -30,6 +31,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpu-autoscaler", description=__doc__)
     # the reference's most-used flags (main.go:92-227), same semantics
     p.add_argument("--scan-interval", type=float, default=10.0)
+    p.add_argument("--v", type=int, default=0, help="log verbosity (klog -v)")
     p.add_argument("--max-nodes-total", type=int, default=0)
     p.add_argument("--cores-total", default="0:320000")
     p.add_argument("--memory-total", default="0:6400000")
@@ -65,6 +67,27 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--health-check-max-failing-time", type=float, default=900.0)
     p.add_argument("--max-iterations", type=int, default=0,
                    help="stop after N loops (0 = forever); for testing")
+    p.add_argument("--initial-node-group-backoff-duration", type=float, default=300.0)
+    p.add_argument("--max-node-group-backoff-duration", type=float, default=1800.0)
+    p.add_argument("--node-group-backoff-reset-timeout", type=float, default=10800.0)
+    p.add_argument("--scale-down-unready-enabled",
+                   type=lambda s: s.lower() != "false", default=True)
+    p.add_argument("--node-delete-delay-after-taint", type=float, default=0.0,
+                   help="pause between taint and delete; 0 (default) because "
+                        "the actuation wave is synchronous here (see options.py)")
+    p.add_argument("--cordon-node-before-terminating", action="store_true")
+    p.add_argument("--ignore-daemonsets-utilization", action="store_true")
+    p.add_argument("--ignore-taint", action="append", default=[],
+                   help="startup taint key ignored in templates (repeatable)")
+    p.add_argument("--balancing-ignore-label", action="append", default=[],
+                   help="extra label excluded from group similarity (repeatable)")
+    p.add_argument("--node-group-auto-discovery", action="append", default=[],
+                   help="provider auto-discovery spec (repeatable)")
+    p.add_argument("--cluster-name", default="")
+    p.add_argument("--namespace", default="kube-system")
+    p.add_argument("--status-config-map-name", default="cluster-autoscaler-status")
+    p.add_argument("--write-status-configmap",
+                   type=lambda s: s.lower() != "false", default=True)
     return p
 
 
@@ -103,6 +126,20 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         cloud_provider=args.provider,
         max_inactivity_s=args.health_check_max_inactivity,
         max_failing_time_s=args.health_check_max_failing_time,
+        initial_node_group_backoff_duration_s=args.initial_node_group_backoff_duration,
+        max_node_group_backoff_duration_s=args.max_node_group_backoff_duration,
+        node_group_backoff_reset_timeout_s=args.node_group_backoff_reset_timeout,
+        scale_down_unready_enabled=args.scale_down_unready_enabled,
+        node_delete_delay_after_taint_s=args.node_delete_delay_after_taint,
+        cordon_node_before_terminating=args.cordon_node_before_terminating,
+        ignore_daemonsets_utilization=args.ignore_daemonsets_utilization,
+        ignored_taints=list(args.ignore_taint),
+        balancing_extra_ignored_labels=list(args.balancing_ignore_label),
+        node_group_auto_discovery=list(args.node_group_auto_discovery),
+        cluster_name=args.cluster_name,
+        config_namespace=args.namespace,
+        status_config_map_name=args.status_config_map_name,
+        write_status_configmap=args.write_status_configmap,
     )
     opts.node_group_defaults.scale_down_unneeded_time_s = args.scale_down_unneeded_time
     opts.node_group_defaults.scale_down_unready_time_s = args.scale_down_unready_time
@@ -188,6 +225,10 @@ def run_loop(autoscaler, scan_interval_s: float, max_iterations: int = 0) -> Non
 def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
     opts = options_from_args(args)
+    from autoscaler_tpu.utils import klogx
+
+    klogx.set_verbosity(args.v)
+    logging.basicConfig(level=logging.INFO)
 
     if args.provider != "test":
         print(f"unknown cloud provider {args.provider!r} (available: test)", file=sys.stderr)

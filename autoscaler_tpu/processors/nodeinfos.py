@@ -25,8 +25,11 @@ class _CacheEntry:
 
 
 class MixedTemplateNodeInfoProvider:
-    def __init__(self, ttl_s: float = 60.0):
+    def __init__(self, ttl_s: float = 60.0, ignored_taints: Sequence[str] = ()):
         self.ttl_s = ttl_s
+        # --ignore-taint keys (startup taints) also stripped from templates
+        # so simulation doesn't block pods on transient node-init taints
+        self.ignored_taints = set(ignored_taints)
         self._cache: Dict[str, _CacheEntry] = {}
 
     def template_for(
@@ -47,6 +50,8 @@ class MixedTemplateNodeInfoProvider:
         else:
             try:
                 template = group.template_node_info()
+                if template is not None:
+                    template = self._sanitize(template, gid)
             except Exception:
                 template = None
         if template is not None:
@@ -67,10 +72,9 @@ class MixedTemplateNodeInfoProvider:
                 out[group.id()] = tmpl
         return out
 
-    @staticmethod
-    def _sanitize(node: Node, gid: str) -> Node:
+    def _sanitize(self, node: Node, gid: str) -> Node:
         """DeepCopyTemplateNode analog (utils/scheduler/scheduler.go:73):
-        fresh name, autoscaler-managed taints stripped."""
+        fresh name, autoscaler-managed + operator-ignored taints stripped."""
         fresh = copy.deepcopy(node)
         fresh = dataclasses.replace(
             fresh,
@@ -80,6 +84,7 @@ class MixedTemplateNodeInfoProvider:
                 t
                 for t in fresh.taints
                 if t.key not in (TO_BE_DELETED_TAINT, DELETION_CANDIDATE_TAINT)
+                and t.key not in self.ignored_taints
             ],
         )
         return fresh

@@ -277,5 +277,20 @@ class AutoscalingProcessors:
             obs.update(unneeded_names)
 
 
-def default_processors() -> AutoscalingProcessors:
-    return AutoscalingProcessors()
+def default_processors(options=None) -> AutoscalingProcessors:
+    """Default wiring; with options, knob-driven processors pick up their
+    config (balancing ratios + extra ignored labels, like the reference's
+    NewDefaultProcessors(opts))."""
+    procs = AutoscalingProcessors()
+    if options is not None:
+        from autoscaler_tpu.processors.nodegroupset import DEFAULT_IGNORED_LABELS
+
+        procs.node_group_set = BalancingNodeGroupSetProcessor(
+            ratios=options.node_group_difference_ratios,
+            ignored_labels=set(DEFAULT_IGNORED_LABELS)
+            | set(options.balancing_extra_ignored_labels),
+        )
+        procs.template_node_info_provider = MixedTemplateNodeInfoProvider(
+            ignored_taints=options.ignored_taints
+        )
+    return procs

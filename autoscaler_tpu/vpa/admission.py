@@ -253,7 +253,7 @@ class AdmissionServer:
                     import ssl as _ssl
                     import sys
 
-                    exc = sys.exception()
+                    exc = sys.exc_info()[1]  # sys.exception() needs 3.11+
                     if isinstance(
                         exc, (_ssl.SSLError, socket.timeout, ConnectionError)
                     ):

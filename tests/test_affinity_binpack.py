@@ -576,7 +576,7 @@ class TestEstimatorRunsAffinity:
 
         est2 = BinpackingNodeEstimator()
         est2._expand_affinity_runs = lambda p, g, t, n: (
-            [(x, [x]) for x in p], None, None
+            [(x, [x]) for x in p], None, None, None
         )
         out_pods = est2.estimate_many(pods, templates)
 

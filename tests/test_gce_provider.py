@@ -208,3 +208,46 @@ class TestControlLoopIntegration:
         assert result.scale_up is None or not result.scale_up.scaled_up
         (mig,) = provider.node_groups()
         assert mig.target_size() == 0
+
+
+class TestAutoDiscovery:
+    """--node-group-auto-discovery (reference GCE MIG auto-discovery by
+    name prefix): MIGs matching a prefix join the provider with the spec's
+    bounds; explicit specs win on overlap."""
+
+    def test_prefix_discovery(self):
+        from autoscaler_tpu.cloudprovider.gce import (
+            MigTemplate,
+            build_gce_provider,
+            parse_auto_discovery_spec,
+        )
+
+        spec = parse_auto_discovery_spec("mig:namePrefix=tpu-,min=1,max=7")
+        assert spec == {"prefix": "tpu-", "min": 1, "max": 7}
+
+        api = InMemoryGceApi()
+        tmpl = MigTemplate(machine_type="ct5lp-hightpu-4t", tpu_topology="2x2")
+        api.add_mig("proj", "z", "tpu-a", tmpl, target_size=1)
+        api.add_mig("proj", "z", "tpu-b", tmpl, target_size=2)
+        api.add_mig("proj", "z", "cpu-pool", tmpl, target_size=1)
+        provider = build_gce_provider(
+            ["0:10:projects/proj/zones/z/instanceGroups/tpu-a"],
+            api,
+            auto_discovery=["mig:namePrefix=tpu-,min=1,max=7"],
+        )
+        by_name = {g.name: g for g in provider.node_groups()}
+        assert set(by_name) == {"tpu-a", "tpu-b"}     # cpu-pool not matched
+        assert by_name["tpu-a"].min_size() == 0        # explicit spec wins
+        assert by_name["tpu-a"].max_size() == 10
+        assert by_name["tpu-b"].min_size() == 1        # discovered bounds
+        assert by_name["tpu-b"].max_size() == 7
+
+    def test_bad_specs_rejected(self):
+        from autoscaler_tpu.cloudprovider.gce import parse_auto_discovery_spec
+
+        with pytest.raises(ValueError):
+            parse_auto_discovery_spec("asg:namePrefix=x")
+        with pytest.raises(ValueError):
+            parse_auto_discovery_spec("mig:min=1")
+        with pytest.raises(ValueError):
+            parse_auto_discovery_spec("mig:namePrefix=x,bogus=1")

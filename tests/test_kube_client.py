@@ -90,6 +90,7 @@ class FakeApiServer:
         self.reject_evictions = set()  # "ns/name" -> 429
         self.watch_queues = []    # live watch streams get events pushed
         self.events = []          # (rv, event) log replayed on watch connect
+        self.configmaps = {}
         server = ThreadingHTTPServer(("127.0.0.1", 0), self._handler())
         self.server = server
         self.port = server.server_address[1]
@@ -216,6 +217,10 @@ class FakeApiServer:
                         return self._send(201, body)
                     if path.endswith("/events"):
                         return self._send(201, {})
+                    if path.endswith("/configmaps"):
+                        name = (body.get("metadata") or {}).get("name", "")
+                        outer.configmaps[name] = body
+                        return self._send(201, body)
                 return self._send(404)
 
             def do_PATCH(self):
@@ -241,6 +246,12 @@ class FakeApiServer:
                     outer.writes.append(("PUT", path))
                     if "/leases/" in path:
                         outer.leases[path.rsplit("/", 1)[1]] = body
+                        return self._send(200, body)
+                    if "/configmaps/" in path:
+                        name = path.rsplit("/", 1)[1]
+                        if name not in outer.configmaps:
+                            return self._send(404)
+                        outer.configmaps[name] = body
                         return self._send(200, body)
                 return self._send(404)
 
@@ -354,6 +365,15 @@ class TestKubeClusterAPI:
         assert len(api_server.nodes["n1"]["spec"]["taints"]) == 1
         api.remove_taint("n1", TO_BE_DELETED_TAINT)
         assert api_server.nodes["n1"]["spec"]["taints"] == []
+
+    def test_write_configmap_create_then_update(self, api_server):
+        api = KubeClusterAPI(KubeRestClient(api_server.url))
+        api.write_configmap("kube-system", "ca-status", {"status": "v1"})
+        assert api_server.configmaps["ca-status"]["data"]["status"] == "v1"
+        api.write_configmap("kube-system", "ca-status", {"status": "v2"})
+        assert api_server.configmaps["ca-status"]["data"]["status"] == "v2"
+        methods = [m for m, p in api_server.writes if "configmap" in p]
+        assert methods == ["PUT", "POST", "PUT"]  # 404 -> create, then update
 
     def test_delete_node(self, api_server):
         api_server.nodes["n1"] = node_json("n1")

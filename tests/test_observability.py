@@ -73,7 +73,7 @@ class TestHealthCheck:
         assert not ok and "failing" in msg
 
 
-def make_autoscaler(pods=()):
+def make_autoscaler(pods=(), **opt_kw):
     provider = TestCloudProvider()
     api = FakeClusterAPI()
     provider.add_node_group("g", 0, 10, 1, build_test_node("t", cpu_m=1000, mem=2 * GB))
@@ -83,7 +83,7 @@ def make_autoscaler(pods=()):
     for p in pods:
         api.add_pod(p)
     return StaticAutoscaler(
-        provider, api, AutoscalingOptions(), debugger=DebuggingSnapshotter()
+        provider, api, AutoscalingOptions(**opt_kw), debugger=DebuggingSnapshotter()
     )
 
 
@@ -192,6 +192,116 @@ class TestCLI:
         assert opts.min_cores_total == 4000
         assert opts.max_cores_total == 100_000
 
+    def test_new_knob_flags_round_trip(self):
+        args = build_arg_parser().parse_args(
+            [
+                "--initial-node-group-backoff-duration", "60",
+                "--max-node-group-backoff-duration", "600",
+                "--node-group-backoff-reset-timeout", "3600",
+                "--scale-down-unready-enabled", "false",
+                "--node-delete-delay-after-taint", "2.5",
+                "--cordon-node-before-terminating",
+                "--ignore-daemonsets-utilization",
+                "--ignore-taint", "node.startup/init",
+                "--ignore-taint", "vendor/agent-not-ready",
+                "--balancing-ignore-label", "custom/pool-id",
+                "--node-group-auto-discovery", "label:team=ml",
+                "--cluster-name", "prod-west",
+                "--namespace", "autoscaler",
+                "--status-config-map-name", "my-status",
+            ]
+        )
+        opts = options_from_args(args)
+        assert opts.initial_node_group_backoff_duration_s == 60
+        assert opts.max_node_group_backoff_duration_s == 600
+        assert opts.node_group_backoff_reset_timeout_s == 3600
+        assert opts.scale_down_unready_enabled is False
+        assert opts.node_delete_delay_after_taint_s == 2.5
+        assert opts.cordon_node_before_terminating
+        assert opts.ignore_daemonsets_utilization
+        assert opts.ignored_taints == ["node.startup/init", "vendor/agent-not-ready"]
+        assert opts.balancing_extra_ignored_labels == ["custom/pool-id"]
+        assert opts.node_group_auto_discovery == ["label:team=ml"]
+        assert opts.cluster_name == "prod-west"
+        assert opts.config_namespace == "autoscaler"
+        assert opts.status_config_map_name == "my-status"
+
+    def test_backoff_built_from_options(self):
+        from autoscaler_tpu.clusterstate.registry import ClusterStateRegistry
+        from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+
+        opts = AutoscalingOptions(
+            initial_node_group_backoff_duration_s=60.0,
+            max_node_group_backoff_duration_s=120.0,
+            node_group_backoff_reset_timeout_s=900.0,
+        )
+        csr = ClusterStateRegistry(TestCloudProvider(), opts)
+        assert csr.backoff.initial_s == 60.0
+        assert csr.backoff.max_s == 120.0
+        assert csr.backoff.reset_timeout_s == 900.0
+
+    def test_ignored_taints_stripped_from_templates(self):
+        from autoscaler_tpu.kube.objects import Taint
+        from autoscaler_tpu.processors.nodeinfos import MixedTemplateNodeInfoProvider
+        from autoscaler_tpu.utils.test_utils import build_test_node
+
+        node = build_test_node(
+            "n0",
+            taints=[
+                Taint("node.startup/init", "", "NoSchedule"),
+                Taint("dedicated", "a", "NoSchedule"),
+            ],
+        )
+        prov = MixedTemplateNodeInfoProvider(ignored_taints=["node.startup/init"])
+        tmpl = prov._sanitize(node, "g")
+        assert [t.key for t in tmpl.taints] == ["dedicated"]
+
+    def test_unready_scale_down_gate(self):
+        from autoscaler_tpu.core.scaledown.eligibility import EligibilityChecker
+        from autoscaler_tpu.simulator.removal import UnremovableReason
+        from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+        from autoscaler_tpu.utils.test_utils import build_test_node
+
+        snap = ClusterSnapshot()
+        unready = build_test_node("u0", cpu_m=1000)
+        unready.ready = False
+        snap.add_node(unready)
+
+        on = EligibilityChecker(AutoscalingOptions(scale_down_unready_enabled=True))
+        eligible, _, _ = on.filter_out_unremovable(snap, [unready], now_ts=0.0)
+        assert eligible == ["u0"]
+
+        off = EligibilityChecker(AutoscalingOptions(scale_down_unready_enabled=False))
+        eligible, _, unremovable = off.filter_out_unremovable(snap, [unready], now_ts=0.0)
+        assert eligible == []
+        assert unremovable[0].reason == UnremovableReason.UNREADY_NOT_ALLOWED
+
+    def test_daemonset_utilization_excluded(self):
+        from autoscaler_tpu.core.scaledown.eligibility import EligibilityChecker
+        from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+        from autoscaler_tpu.utils.test_utils import build_test_node, build_test_pod
+
+        def world():
+            snap = ClusterSnapshot()
+            n = build_test_node("n0", cpu_m=1000)
+            snap.add_node(n)
+            ds = build_test_pod("ds0", cpu_m=800, node_name="n0")
+            ds.daemonset = True
+            snap.add_pod(ds, "n0")
+            return snap, n
+
+        snap, n = world()
+        counted = EligibilityChecker(AutoscalingOptions())
+        _, util, _ = counted.filter_out_unremovable(snap, [n], now_ts=0.0)
+        assert util["n0"] >= 0.8
+
+        snap, n = world()
+        ignored = EligibilityChecker(
+            AutoscalingOptions(ignore_daemonsets_utilization=True)
+        )
+        _, util, _ = ignored.filter_out_unremovable(snap, [n], now_ts=0.0)
+        assert util["n0"] < 0.1
+
     def test_observability_server(self):
         a = make_autoscaler()
         a.run_once(now_ts=0.0)
@@ -220,3 +330,54 @@ class TestCLI:
         a = make_autoscaler()
         run_loop(a, scan_interval_s=0.0, max_iterations=3)
         assert a.metrics.function_duration.count(function="main") == 3
+
+
+class TestStatusConfigMap:
+    def test_runonce_writes_status_configmap(self):
+        a = make_autoscaler()
+        a.run_once(now_ts=0.0)
+        key = ("kube-system", "cluster-autoscaler-status")
+        assert key in a.api.configmaps
+        assert "Cluster-autoscaler status" in a.api.configmaps[key]["status"]
+
+    def test_write_disabled(self):
+        a = make_autoscaler(write_status_configmap=False)
+        a.run_once(now_ts=0.0)
+        assert a.api.configmaps == {}
+
+    def test_custom_name_and_namespace(self):
+        a = make_autoscaler(
+            status_config_map_name="my-status", config_namespace="asns"
+        )
+        a.run_once(now_ts=0.0)
+        assert ("asns", "my-status") in a.api.configmaps
+
+
+class TestStatusOnDegradedPaths:
+    def test_status_written_when_cluster_unhealthy(self):
+        """The defer semantics: even when RunOnce bails early on an
+        unhealthy cluster, the ConfigMap must say Unhealthy — not retain
+        the last healthy status (static_autoscaler.go:387-393)."""
+        provider = TestCloudProvider()
+        api = FakeClusterAPI()
+        provider.add_node_group(
+            "g", 0, 20, 10, build_test_node("t", cpu_m=1000, mem=2 * GB)
+        )
+        for i in range(10):
+            n = build_test_node(f"g-{i}", cpu_m=1000, mem=2 * GB)
+            # 8 of 10 unready: over both the 45% threshold and the
+            # ok_total_unready_count=3 floor -> cluster unhealthy
+            n.ready = i < 2
+            provider.add_node("g", n)
+            api.add_node(n)
+        a = StaticAutoscaler(provider, api, AutoscalingOptions())
+        result = a.run_once(now_ts=10000.0)
+        assert not result.cluster_healthy
+        status = api.configmaps[("kube-system", "cluster-autoscaler-status")]["status"]
+        assert "Unhealthy" in status
+
+    def test_cluster_name_in_status(self):
+        a = make_autoscaler(cluster_name="prod-west")
+        a.run_once(now_ts=0.0)
+        status = a.api.configmaps[("kube-system", "cluster-autoscaler-status")]["status"]
+        assert "[prod-west]" in status

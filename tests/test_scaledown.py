@@ -9,7 +9,7 @@ from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
 from autoscaler_tpu.config.options import AutoscalingOptions
 from autoscaler_tpu.core.scaledown.actuator import ScaleDownActuator
 from autoscaler_tpu.core.scaledown.eligibility import EligibilityChecker
-from autoscaler_tpu.core.scaledown.planner import ScaleDownPlanner
+from autoscaler_tpu.core.scaledown.planner import ScaleDownPlan, ScaleDownPlanner
 from autoscaler_tpu.core.scaledown.tracking import (
     NodeDeletionTracker,
     RemainingPdbTracker,
@@ -31,7 +31,7 @@ from autoscaler_tpu.simulator.drain import (
     DrainabilityRules,
     get_pods_to_move,
 )
-from autoscaler_tpu.simulator.removal import RemovalSimulator, UnremovableReason
+from autoscaler_tpu.simulator.removal import RemovalSimulator, UnremovableReason, NodeToRemove
 from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
 from autoscaler_tpu.utils.test_utils import GB, MB, build_test_node, build_test_pod
 
@@ -835,3 +835,45 @@ class TestConcurrentActuation:
         batcher.add_node(group, n)
         batcher.flush()  # control loop closes the wave without waiting 30s
         assert flushed == ["b0"]
+
+
+class TestNodeDeleteDelayAfterTaint:
+    def test_wave_pauses_between_taint_and_delete(self):
+        """actuator.go NodeDeleteDelayAfterTaint: after the sync taint pass
+        the actuator waits the configured delay before deletions start."""
+        provider, api, _snap, nodes, opts = TestPlannerAndActuator._world(self)
+        opts.node_delete_delay_after_taint_s = 5.0
+        sleeps = []
+        actuator = ScaleDownActuator(
+            provider, opts, api, sleep=sleeps.append
+        )
+        plan = ScaleDownPlan(
+            empty=[NodeToRemove(node=nodes[0], pods_to_reschedule=[], daemonset_pods=[])]
+        )
+        actuator.start_deletion(plan, now_ts=0.0)
+        assert 5.0 in sleeps
+
+    def test_zero_delay_never_sleeps(self):
+        provider, api, _snap, nodes, opts = TestPlannerAndActuator._world(self)
+        opts.node_delete_delay_after_taint_s = 0.0
+        sleeps = []
+        actuator = ScaleDownActuator(provider, opts, api, sleep=sleeps.append)
+        plan = ScaleDownPlan(
+            empty=[NodeToRemove(node=nodes[0], pods_to_reschedule=[], daemonset_pods=[])]
+        )
+        actuator.start_deletion(plan, now_ts=0.0)
+        assert sleeps == []
+
+    def test_cordon_before_terminating(self):
+        provider, api, _snap, nodes, opts = TestPlannerAndActuator._world(self)
+        opts.cordon_node_before_terminating = True
+        actuator = ScaleDownActuator(provider, opts, api, sleep=lambda s: None)
+        plan = ScaleDownPlan(
+            empty=[NodeToRemove(node=nodes[0], pods_to_reschedule=[], daemonset_pods=[])]
+        )
+        # capture cordon before the node object is deleted post-batch
+        cordoned = []
+        orig = api.cordon_node
+        api.cordon_node = lambda name: (cordoned.append(name), orig(name))
+        actuator.start_deletion(plan, now_ts=0.0)
+        assert cordoned == [nodes[0].name]

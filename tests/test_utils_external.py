@@ -142,3 +142,123 @@ class TestExternalGrpcProvider:
         result = autoscaler.run_once(now_ts=0.0)
         assert result.scale_up is not None and result.scale_up.scaled_up
         assert backend.scale_up_calls  # the RPC crossed the boundary
+
+
+class TestKlogx:
+    """Quota-limited logging (reference utils/klogx/klogx_test.go)."""
+
+    def setup_method(self):
+        from autoscaler_tpu.utils import klogx
+
+        klogx.set_verbosity(0)
+
+    def teardown_method(self):
+        from autoscaler_tpu.utils import klogx
+
+        klogx.set_verbosity(0)
+
+    def _capture(self, caplog_records, fn):
+        import logging
+
+        from autoscaler_tpu.utils import klogx
+
+        records = []
+        handler = logging.Handler()
+        handler.emit = lambda r: records.append(r.getMessage())
+        klogx.logger.addHandler(handler)
+        klogx.logger.setLevel(logging.INFO)
+        try:
+            fn()
+        finally:
+            klogx.logger.removeHandler(handler)
+        return records
+
+    def test_up_to_quota_caps_lines(self):
+        from autoscaler_tpu.utils import klogx
+
+        klogx.set_verbosity(4)
+        quota = klogx.new_logging_quota(3)
+
+        def run():
+            for i in range(10):
+                klogx.v(4).up_to(quota).info("line %d", i)
+            klogx.v(4).over(quota).info("%d skipped", -quota.left)
+
+        records = self._capture(None, run)
+        assert records == ["line 0", "line 1", "line 2", "7 skipped"]
+        assert quota.left == -7
+
+    def test_below_verbosity_consumes_no_quota(self):
+        from autoscaler_tpu.utils import klogx
+
+        klogx.set_verbosity(2)
+        quota = klogx.new_logging_quota(3)
+
+        def run():
+            for i in range(10):
+                klogx.v(4).up_to(quota).info("line %d", i)
+            klogx.v(4).over(quota).info("skipped")
+
+        records = self._capture(None, run)
+        assert records == []
+        # disabled Verbose never decrements the quota (klogx.go UpTo)
+        assert quota.left == 3
+
+    def test_over_silent_when_under_quota(self):
+        from autoscaler_tpu.utils import klogx
+
+        klogx.set_verbosity(4)
+        quota = klogx.new_logging_quota(5)
+
+        def run():
+            for i in range(3):
+                klogx.v(4).up_to(quota).info("line %d", i)
+            klogx.v(4).over(quota).info("skipped")
+
+        records = self._capture(None, run)
+        assert records == ["line 0", "line 1", "line 2"]
+
+    def test_pods_quota_scales_with_verbosity(self):
+        from autoscaler_tpu.utils import klogx
+
+        klogx.set_verbosity(4)
+        assert klogx.pods_logging_quota().limit == klogx.MAX_PODS_LOGGED
+        klogx.set_verbosity(5)
+        assert klogx.pods_logging_quota().limit == klogx.MAX_PODS_LOGGED_V5
+
+    def test_reset(self):
+        from autoscaler_tpu.utils import klogx
+
+        quota = klogx.new_logging_quota(2)
+        quota.left = -5
+        quota.reset()
+        assert quota.left == 2
+
+    def test_eligibility_emits_quota_bounded_lines(self):
+        """30 candidate nodes at -v4: exactly 20 utilization lines + one
+        summary for the other 10 (eligibility.go:71,100 semantics)."""
+        from autoscaler_tpu.config.options import AutoscalingOptions
+        from autoscaler_tpu.core.scaledown.eligibility import EligibilityChecker
+        from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+        from autoscaler_tpu.utils import klogx
+        from autoscaler_tpu.utils.test_utils import build_test_node, build_test_pod
+
+        klogx.set_verbosity(4)
+        snap = ClusterSnapshot()
+        nodes = []
+        for j in range(30):
+            n = build_test_node(f"n{j}", cpu_m=4000)
+            snap.add_node(n)
+            nodes.append(n)
+            p = build_test_pod(f"p{j}", cpu_m=200, node_name=n.name)
+            snap.add_pod(p, n.name)
+        checker = EligibilityChecker(AutoscalingOptions())
+
+        def run():
+            checker.filter_out_unremovable(snap, nodes, now_ts=0.0)
+
+        records = self._capture(None, run)
+        util_lines = [r for r in records if "utilization" in r and "Skipped" not in r]
+        summaries = [r for r in records if "Skipped" in r]
+        assert len(util_lines) == 20
+        assert summaries == ["Skipped logging utilization for 10 other nodes"]
