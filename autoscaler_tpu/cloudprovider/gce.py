@@ -555,9 +555,10 @@ def build_gce_provider(
         project, zone, name = parse_mig_url(url)
         migs.append(GceMig(manager, project, zone, name, lo, hi))
     explicit = {(m.project, m.zone, m.name) for m in migs}
+    listed = api.list_migs() if auto_discovery else []  # one cloud call
     for disc_spec in auto_discovery:
         disc = parse_auto_discovery_spec(disc_spec)
-        for project, zone, name in api.list_migs():
+        for project, zone, name in listed:
             key = (project, zone, name)
             if key in explicit or not name.startswith(str(disc["prefix"])):
                 continue

@@ -120,17 +120,17 @@ class AutoscalingOptions:
     # unready nodes may be scale-down candidates (ScaleDownUnreadyEnabled,
     # --scale-down-unready-enabled, default true)
     scale_down_unready_enabled: bool = True
-    # pacing between tainting a node and deleting it, and the overall
-    # deletion-confirmation timeout (NodeDeleteDelayAfterTaint,
-    # NodeDeletionDelayTimeout). DIVERGENCE: the reference defaults the
-    # taint delay to 5s *inside its async deletion goroutine*
-    # (actuator.go:234); this framework's actuation wave is synchronous by
-    # design (the loop joins it), so a nonzero delay extends the control
-    # loop directly — default off, opt in if your scheduler lags taint
-    # observation. The pause is paid inside the per-node workers, so drain
-    # waves overlap it with eviction work.
+    # pacing between tainting a node and deleting it
+    # (NodeDeleteDelayAfterTaint). DIVERGENCE: the reference defaults this
+    # to 5s *inside its async deletion goroutine* (actuator.go:234); this
+    # framework's actuation wave is synchronous by design (the loop joins
+    # it), so a nonzero delay extends the control loop directly — default
+    # off, opt in if your scheduler lags taint observation. The pause is
+    # paid inside the per-node workers, so drain waves overlap it with
+    # eviction work. (The reference's NodeDeletionDelayTimeout is not
+    # modeled: deletion confirmation here is the synchronous batcher
+    # result, not a polled wait.)
     node_delete_delay_after_taint_s: float = 0.0
-    node_deletion_delay_timeout_s: float = 120.0
 
     # -- misc ---------------------------------------------------------------
     cloud_provider: str = "test"

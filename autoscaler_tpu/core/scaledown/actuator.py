@@ -255,12 +255,24 @@ class ScaleDownActuator:
 
         was_drain: Dict[str, bool] = {}
 
+        def rollback_node(name: str) -> None:
+            """A node that survives a failed deletion must return to
+            service: taint off, and cordon off if we cordoned it — else it
+            stays unschedulable forever (reference CleanToBeDeleted
+            uncordons when the flag is set)."""
+            try:
+                self.api.remove_taint(name, TO_BE_DELETED_TAINT)
+                if self.options.cordon_node_before_terminating:
+                    self.api.uncordon_node(name)
+            except Exception:
+                pass
+
         def on_batch_result(node: Node, gid: str, err: Optional[str]) -> None:
             if err:
                 self.tracker.end_deletion(gid, node.name, ok=False, error=err, ts=now_ts)
                 with result_lock:
                     result.failed[node.name] = err
-                self.api.remove_taint(node.name, TO_BE_DELETED_TAINT)
+                rollback_node(node.name)
                 return
             self.api.delete_node_object(node.name)
             self.tracker.end_deletion(gid, node.name, ok=True, ts=now_ts)
@@ -313,7 +325,7 @@ class ScaleDownActuator:
                 )
                 with result_lock:
                     result.failed[r.node.name] = "eviction failed"
-                self.api.remove_taint(r.node.name, TO_BE_DELETED_TAINT)
+                rollback_node(r.node.name)
                 return
             batcher.add_node(group, r.node)
 
@@ -330,10 +342,7 @@ class ScaleDownActuator:
                 )
                 with result_lock:
                     result.failed[r.node.name] = str(e)
-                try:
-                    self.api.remove_taint(r.node.name, TO_BE_DELETED_TAINT)
-                except Exception:
-                    pass
+                rollback_node(r.node.name)
 
         # 2. fan the wave out on a bounded worker pool (the goroutine analog).
         workers = max(1, self.options.max_scale_down_parallelism)
@@ -344,11 +353,8 @@ class ScaleDownActuator:
                 group = self.provider.node_group_for_node(r.node)
                 if group is None:
                     result.failed[r.node.name] = "no node group"
-                    # the up-front taint must not outlive the aborted deletion
-                    try:
-                        self.api.remove_taint(r.node.name, TO_BE_DELETED_TAINT)
-                    except Exception:
-                        pass
+                    # the up-front taint/cordon must not outlive the abort
+                    rollback_node(r.node.name)
                     continue
                 was_drain[r.node.name] = is_drain
                 self.tracker.start_deletion(group.id(), r.node.name, drain=is_drain)

@@ -203,10 +203,11 @@ class BinpackingNodeEstimator:
         templates: Dict[str, Node],
         names: List[str],
     ) -> Tuple[List[Tuple[Pod, List[Pod]]], "AffinityTermTensors", np.ndarray, np.ndarray]:
-        """→ (runs, group_terms, group_of_run): equivalence runs with
-        affinity-involved groups expanded into singletons, the term tensors
-        built ONCE over the group exemplars, and each run's source-group
-        index (so the run-axis term columns are a gather, not a rebuild).
+        """→ (runs, group_terms, group_of_run, run_inv): equivalence runs
+        with affinity-involved groups expanded into singletons, the term
+        tensors built ONCE over the group exemplars, each run's source-group
+        index (so the run-axis term columns are a gather, not a rebuild),
+        and the per-run involvement mask.
 
         A group is involved iff its exemplar matches any term's selector or
         holds any required (anti-)affinity term — the cases where placement

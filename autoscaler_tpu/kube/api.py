@@ -67,6 +67,11 @@ class ClusterAPI(abc.ABC):
         --cordon-node-before-terminating is set (reference
         utils/taints + actuator cordon path). Default: no-op."""
 
+    def uncordon_node(self, node_name: str) -> None:
+        """Undo cordon_node on a node whose deletion failed — without the
+        rollback a surviving node would stay unschedulable forever.
+        Default: no-op."""
+
     def record_event(self, kind: str, name: str, reason: str, message: str) -> None:
         pass
 
@@ -146,6 +151,12 @@ class FakeClusterAPI(ClusterAPI):
             node = self.nodes.get(node_name)
             if node:
                 node.unschedulable = True
+
+    def uncordon_node(self, node_name: str) -> None:
+        with self._lock:
+            node = self.nodes.get(node_name)
+            if node:
+                node.unschedulable = False
 
     def delete_node_object(self, node_name: str) -> None:
         with self._lock:

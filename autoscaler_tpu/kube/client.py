@@ -322,6 +322,11 @@ class KubeClusterAPI(ClusterAPI):
             f"/api/v1/nodes/{node_name}", {"spec": {"unschedulable": True}}
         )
 
+    def uncordon_node(self, node_name: str) -> None:
+        self.client.patch(
+            f"/api/v1/nodes/{node_name}", {"spec": {"unschedulable": False}}
+        )
+
     def write_configmap(self, namespace: str, name: str, data: dict) -> None:
         body = {
             "apiVersion": "v1",

@@ -27,6 +27,17 @@ from typing import Optional
 from autoscaler_tpu.config.options import AutoscalingOptions
 
 
+def _bool_flag(s: str) -> bool:
+    """Accept the usual spellings; reject typos instead of silently
+    defaulting (an operator's '--x=0' must not read as True)."""
+    v = s.strip().lower()
+    if v in ("true", "1", "yes", "on"):
+        return True
+    if v in ("false", "0", "no", "off"):
+        return False
+    raise argparse.ArgumentTypeError(f"expected a boolean, got {s!r}")
+
+
 def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpu-autoscaler", description=__doc__)
     # the reference's most-used flags (main.go:92-227), same semantics
@@ -43,7 +54,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "priority expander (the reference's live ConfigMap)")
     p.add_argument("--max-nodes-per-scaleup", type=int, default=1000)
     p.add_argument("--balance-similar-node-groups", action="store_true")
-    p.add_argument("--scale-down-enabled", type=lambda s: s.lower() != "false", default=True)
+    p.add_argument("--scale-down-enabled", type=_bool_flag, default=True)
     p.add_argument("--scale-down-delay-after-add", type=float, default=600.0)
     p.add_argument("--scale-down-delay-after-delete", type=float, default=0.0)
     p.add_argument("--scale-down-delay-after-failure", type=float, default=180.0)
@@ -71,7 +82,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-node-group-backoff-duration", type=float, default=1800.0)
     p.add_argument("--node-group-backoff-reset-timeout", type=float, default=10800.0)
     p.add_argument("--scale-down-unready-enabled",
-                   type=lambda s: s.lower() != "false", default=True)
+                   type=_bool_flag, default=True)
     p.add_argument("--node-delete-delay-after-taint", type=float, default=0.0,
                    help="pause between taint and delete; 0 (default) because "
                         "the actuation wave is synchronous here (see options.py)")
@@ -87,7 +98,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--namespace", default="kube-system")
     p.add_argument("--status-config-map-name", default="cluster-autoscaler-status")
     p.add_argument("--write-status-configmap",
-                   type=lambda s: s.lower() != "false", default=True)
+                   type=_bool_flag, default=True)
     return p
 
 
@@ -194,7 +205,13 @@ class ObservabilityServer:
                 elif self.path == "/status":
                     from autoscaler_tpu.clusterstate.status import build_status
 
-                    self._send(200, build_status(autoscaler.csr, time.time()).render())
+                    self._send(
+                        200,
+                        build_status(
+                            autoscaler.csr, time.time(),
+                            autoscaler.options.cluster_name,
+                        ).render(),
+                    )
                 else:
                     self._send(404, "not found")
 

@@ -208,7 +208,7 @@ def ffd_binpack_groups_pallas(
     template_allocs,  # [G, R]
     max_nodes: int,
     node_caps=None,   # [G] i32
-    chunk: int = 1024,
+    chunk: int = 512,
     group_block: int = 0,   # 0 = auto
     interpret: bool | None = None,
 ) -> BinpackResult:
@@ -221,6 +221,13 @@ def ffd_binpack_groups_pallas(
         raise ValueError(
             f"chunk must be a multiple of {_STEP_TILE} (sublane tile); got {chunk}"
         )
+    # VMEM budget: XLA keeps the [G_pad, R, M] usage carry resident in VMEM
+    # across the chunk scan (that residency IS the speedup), plus the chunk's
+    # request/placement streams. At the north-star shape (G_pad=512, R=6,
+    # M=1000→1024 lanes) the carry alone is ~12.6MB of the 16MB budget;
+    # chunk=1024 overflowed it on a real v5e by 728KB (observed Mosaic
+    # scoped-vmem OOM), chunk=512 fits. Callers raising chunk must leave
+    # room for carry + chunk*(R+2)*G_pad*4 bytes.
     pod_req = jnp.asarray(pod_req, jnp.float32)
     pod_masks = jnp.asarray(pod_masks)
     template_allocs = jnp.asarray(template_allocs, jnp.float32)

@@ -864,6 +864,32 @@ class TestNodeDeleteDelayAfterTaint:
         actuator.start_deletion(plan, now_ts=0.0)
         assert sleeps == []
 
+    def test_failed_deletion_uncordons(self):
+        """A cordoned node whose eviction fails must return to service
+        schedulable — taint AND cordon rolled back."""
+        provider, api, _snap, nodes, opts = TestPlannerAndActuator._world(self)
+        opts.cordon_node_before_terminating = True
+        api.fail_evictions_for.add("default/p1")
+        clock_now = [0.0]
+
+        def clock():
+            clock_now[0] += 100.0  # each check pushes past the retry deadline
+            return clock_now[0]
+
+        actuator = ScaleDownActuator(
+            provider, opts, api, clock=clock, sleep=lambda s: None
+        )
+        victim = nodes[1]  # carries p1
+        pod = api.pods["default/p1"]
+        plan = ScaleDownPlan(
+            drain=[NodeToRemove(node=victim, pods_to_reschedule=[pod], daemonset_pods=[])]
+        )
+        result = actuator.start_deletion(plan, now_ts=0.0)
+        assert victim.name in result.failed
+        survivor = api.nodes[victim.name]
+        assert not survivor.unschedulable
+        assert not any(t.key == TO_BE_DELETED_TAINT for t in survivor.taints)
+
     def test_cordon_before_terminating(self):
         provider, api, _snap, nodes, opts = TestPlannerAndActuator._world(self)
         opts.cordon_node_before_terminating = True
