@@ -250,6 +250,13 @@ class ScaleDownActuator:
                     self.api.cordon_node(r.node.name)
             except Exception as e:
                 result.failed[r.node.name] = f"taint failed: {e}"
+                # a taint that landed before the failure must not outlive
+                # the aborted deletion (same invariant as rollback_node,
+                # which is defined below this loop)
+                try:
+                    self.api.remove_taint(r.node.name, TO_BE_DELETED_TAINT)
+                except Exception:
+                    pass
         empty = [r for r in empty if r.node.name not in result.failed]
         drain = [r for r in drain if r.node.name not in result.failed]
 
@@ -259,13 +266,17 @@ class ScaleDownActuator:
             """A node that survives a failed deletion must return to
             service: taint off, and cordon off if we cordoned it — else it
             stays unschedulable forever (reference CleanToBeDeleted
-            uncordons when the flag is set)."""
+            uncordons when the flag is set). Independent attempts: a
+            failed taint removal must not skip the uncordon."""
             try:
                 self.api.remove_taint(name, TO_BE_DELETED_TAINT)
-                if self.options.cordon_node_before_terminating:
-                    self.api.uncordon_node(name)
             except Exception:
                 pass
+            if self.options.cordon_node_before_terminating:
+                try:
+                    self.api.uncordon_node(name)
+                except Exception:
+                    pass
 
         def on_batch_result(node: Node, gid: str, err: Optional[str]) -> None:
             if err:

@@ -91,3 +91,32 @@ def test_multi_chunk_carry():
     np.testing.assert_array_equal(
         np.asarray(ref.scheduled), np.asarray(out.scheduled)
     )
+
+
+def test_north_star_group_padding_shape():
+    """G=500 pads to 512 with the TPU group_block=128 (the exact padding
+    the bench shape takes; the padded groups carry zero caps/allocs and
+    must place nothing). Interpret mode validates the blocking/padding
+    logic; real-TPU parity is tracked separately (ROADMAP Scale #1)."""
+    rng = np.random.default_rng(11)
+    P, G, M = 96, 500, 32
+    pod_req = np.zeros((P, 6), np.float32)
+    pod_req[:, CPU] = rng.integers(100, 2000, P)
+    pod_req[:, PODS] = 1
+    allocs = np.zeros((G, 6), np.float32)
+    allocs[:, CPU] = rng.integers(2000, 8000, G)
+    allocs[:, PODS] = 110
+    masks = rng.random((G, P)) > 0.05
+    caps = rng.integers(2, M, G).astype(np.int32)
+
+    ref = ffd_binpack_groups(
+        jnp.asarray(pod_req), jnp.asarray(masks), jnp.asarray(allocs),
+        max_nodes=M, node_caps=jnp.asarray(caps),
+    )
+    out = ffd_binpack_groups_pallas(
+        jnp.asarray(pod_req), jnp.asarray(masks), jnp.asarray(allocs),
+        max_nodes=M, node_caps=jnp.asarray(caps),
+        chunk=16, group_block=128,  # forces G_pad=512, 4 grid programs
+    )
+    np.testing.assert_array_equal(np.asarray(out.node_count), np.asarray(ref.node_count))
+    np.testing.assert_array_equal(np.asarray(out.scheduled), np.asarray(ref.scheduled))

@@ -903,3 +903,51 @@ class TestNodeDeleteDelayAfterTaint:
         api.cordon_node = lambda name: (cordoned.append(name), orig(name))
         actuator.start_deletion(plan, now_ts=0.0)
         assert cordoned == [nodes[0].name]
+
+    def test_uncordon_attempted_even_if_taint_removal_fails(self):
+        provider, api, _snap, nodes, opts = TestPlannerAndActuator._world(self)
+        opts.cordon_node_before_terminating = True
+        api.fail_evictions_for.add("default/p1")
+        tick = [0.0]
+
+        def clock():
+            tick[0] += 100.0
+            return tick[0]
+
+        actuator = ScaleDownActuator(
+            provider, opts, api, clock=clock, sleep=lambda s: None
+        )
+        orig_remove = api.remove_taint
+
+        def flaky_remove(name, key):
+            raise RuntimeError("api blip")
+
+        api.remove_taint = flaky_remove
+        victim = nodes[1]
+        pod = api.pods["default/p1"]
+        plan = ScaleDownPlan(
+            drain=[NodeToRemove(node=victim, pods_to_reschedule=[pod], daemonset_pods=[])]
+        )
+        actuator.start_deletion(plan, now_ts=0.0)
+        api.remove_taint = orig_remove
+        # uncordon must have happened despite the taint-removal failure
+        assert not api.nodes[victim.name].unschedulable
+
+    def test_taint_rolled_back_when_cordon_fails(self):
+        provider, api, _snap, nodes, opts = TestPlannerAndActuator._world(self)
+        opts.cordon_node_before_terminating = True
+
+        def broken_cordon(name):
+            raise RuntimeError("cordon blip")
+
+        api.cordon_node = broken_cordon
+        actuator = ScaleDownActuator(provider, opts, api, sleep=lambda s: None)
+        victim = nodes[0]
+        plan = ScaleDownPlan(
+            empty=[NodeToRemove(node=victim, pods_to_reschedule=[], daemonset_pods=[])]
+        )
+        result = actuator.start_deletion(plan, now_ts=0.0)
+        assert victim.name in result.failed
+        assert not any(
+            t.key == TO_BE_DELETED_TAINT for t in api.nodes[victim.name].taints
+        )
