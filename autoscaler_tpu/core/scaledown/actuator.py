@@ -241,33 +241,13 @@ class ScaleDownActuator:
         empty = plan.empty[: self.options.max_empty_bulk_delete]
         drain = plan.drain[: self.options.max_drain_parallelism]
 
-        # 1. taint everything up front, atomically-ish (actuator.go:95,111);
-        # roll back taints on nodes we end up not deleting.
-        for r in empty + drain:
-            try:
-                self.api.add_taint(r.node.name, to_be_deleted_taint())
-                if self.options.cordon_node_before_terminating:
-                    self.api.cordon_node(r.node.name)
-            except Exception as e:
-                result.failed[r.node.name] = f"taint failed: {e}"
-                # a taint that landed before the failure must not outlive
-                # the aborted deletion (same invariant as rollback_node,
-                # which is defined below this loop)
-                try:
-                    self.api.remove_taint(r.node.name, TO_BE_DELETED_TAINT)
-                except Exception:
-                    pass
-        empty = [r for r in empty if r.node.name not in result.failed]
-        drain = [r for r in drain if r.node.name not in result.failed]
-
-        was_drain: Dict[str, bool] = {}
-
         def rollback_node(name: str) -> None:
-            """A node that survives a failed deletion must return to
-            service: taint off, and cordon off if we cordoned it — else it
-            stays unschedulable forever (reference CleanToBeDeleted
-            uncordons when the flag is set). Independent attempts: a
-            failed taint removal must not skip the uncordon."""
+            """A node that survives a failed/aborted deletion must return
+            to service: taint off, and cordon off if we cordoned it — else
+            it stays unschedulable forever (reference CleanToBeDeleted
+            uncordons when the flag is set). Independent attempts: a failed
+            taint removal must not skip the uncordon, and a cordon that
+            landed server-side before its call raised must still be undone."""
             try:
                 self.api.remove_taint(name, TO_BE_DELETED_TAINT)
             except Exception:
@@ -277,6 +257,21 @@ class ScaleDownActuator:
                     self.api.uncordon_node(name)
                 except Exception:
                     pass
+
+        # 1. taint everything up front, atomically-ish (actuator.go:95,111);
+        # roll back taints on nodes we end up not deleting.
+        for r in empty + drain:
+            try:
+                self.api.add_taint(r.node.name, to_be_deleted_taint())
+                if self.options.cordon_node_before_terminating:
+                    self.api.cordon_node(r.node.name)
+            except Exception as e:
+                result.failed[r.node.name] = f"taint failed: {e}"
+                rollback_node(r.node.name)
+        empty = [r for r in empty if r.node.name not in result.failed]
+        drain = [r for r in drain if r.node.name not in result.failed]
+
+        was_drain: Dict[str, bool] = {}
 
         def on_batch_result(node: Node, gid: str, err: Optional[str]) -> None:
             if err:

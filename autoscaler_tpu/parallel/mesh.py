@@ -48,6 +48,59 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return Mesh(np.asarray(devices).reshape(s, g), ("scenario", "group"))
 
 
+def arrange_devices_for_hosts(devices: Sequence) -> np.ndarray:
+    """[scenario, group] device grid for a (possibly multi-host) fleet.
+
+    Collective-placement rationale (the scaling-book recipe: put the axis
+    that carries collectives on the fastest interconnect):
+    - the ``group`` axis carries the ONLY collective in the decision step
+      (the expander's cross-group argmin all_gather) → it must stay INSIDE
+      a host so the gather rides ICI;
+    - the ``scenario`` axis is embarrassingly parallel (independent what-if
+      worlds, zero collectives) → it is free to span hosts over DCN.
+
+    So: group axis = devices of one process (ICI), scenario axis = host
+    index × per-host scenario splits (DCN × ICI). Falls back to the flat
+    single-host factorization when every device shares a process.
+
+    Duck-typed on ``.process_index`` so the layout logic is testable
+    without a real multi-host fleet; requires a homogeneous fleet (same
+    device count per host).
+    """
+    by_host: dict = {}
+    for d in devices:
+        by_host.setdefault(d.process_index, []).append(d)
+    hosts = [by_host[k] for k in sorted(by_host)]
+    n_hosts = len(hosts)
+    per_host = len(hosts[0])
+    if any(len(h) != per_host for h in hosts):
+        raise ValueError(
+            f"heterogeneous fleet: {[len(h) for h in hosts]} devices per host"
+        )
+    if n_hosts == 1:
+        s, g = factor_mesh(per_host)
+        return np.asarray(hosts[0]).reshape(s, g)
+    # groups get the WHOLE ICI domain: with n_hosts > 1 the scenario axis
+    # already has host-level parallelism, so nothing justifies splitting a
+    # host's ICI between the axes (and a split would shrink the all_gather's
+    # interconnect share)
+    s_local, g = 1, per_host
+    grid = np.empty((n_hosts * s_local, g), dtype=object)
+    for hi, host_devs in enumerate(hosts):
+        grid[hi * s_local : (hi + 1) * s_local, :] = np.asarray(
+            host_devs
+        ).reshape(s_local, g)
+    return grid
+
+
+def make_multihost_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Mesh for a multi-host fleet: scenario axis spans hosts (DCN),
+    group axis stays within each host (ICI). On one host this equals
+    make_mesh. Call jax.distributed.initialize() first on real fleets."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(arrange_devices_for_hosts(devices), ("scenario", "group"))
+
+
 class WhatIfResult(NamedTuple):
     node_counts: jax.Array   # [S, G] i32 — nodes needed per scenario × group
     total_costs: jax.Array   # [S, G] f32 — price·count + penalty·unscheduled

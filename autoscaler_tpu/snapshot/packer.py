@@ -134,6 +134,8 @@ def _node_csi_attached(
 
 def _pod_csi_counts(pod: Pod) -> Tuple[Tuple[str, int], ...]:
     """Per-driver count of the pod's unique volume handles, sorted."""
+    if not pod.csi_volumes:  # the overwhelmingly common case — stay O(1)
+        return ()
     counts: Dict[str, set] = {}
     for driver, handle in pod.csi_volumes:
         counts.setdefault(driver, set()).add(handle)

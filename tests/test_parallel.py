@@ -82,3 +82,86 @@ def test_whatif_single_device_mesh():
     )
     assert res.node_counts.shape == (S, G)
     assert res.best_group.shape == (S,)
+
+
+class _StubDevice:
+    def __init__(self, pid, did):
+        self.process_index = pid
+        self.id = did
+
+    def __repr__(self):
+        return f"d{self.process_index}.{self.id}"
+
+
+class TestMultihostLayout:
+    """arrange_devices_for_hosts: the group axis (the only collective) must
+    stay within one host's ICI domain; scenarios span hosts over DCN."""
+
+    def test_single_host_matches_flat_factorization(self):
+        from autoscaler_tpu.parallel.mesh import (
+            arrange_devices_for_hosts,
+            factor_mesh,
+        )
+
+        devs = [_StubDevice(0, i) for i in range(8)]
+        grid = arrange_devices_for_hosts(devs)
+        assert grid.shape == factor_mesh(8)
+
+    def test_group_axis_never_crosses_hosts(self):
+        from autoscaler_tpu.parallel.mesh import arrange_devices_for_hosts
+
+        for n_hosts, per_host in ((2, 4), (4, 8), (3, 4)):
+            devs = [
+                _StubDevice(h, h * per_host + i)
+                for h in range(n_hosts)
+                for i in range(per_host)
+            ]
+            grid = arrange_devices_for_hosts(devs)
+            assert grid.size == n_hosts * per_host
+            # group axis spans the WHOLE ICI domain of a host
+            assert grid.shape == (n_hosts, per_host)
+            # every row of the grid (one scenario slice) holds devices of
+            # exactly one host: the group all_gather stays on ICI
+            for row in grid:
+                hosts_in_row = {d.process_index for d in row}
+                assert len(hosts_in_row) == 1, (n_hosts, per_host, row)
+            # and all hosts participate in the scenario axis
+            assert {d.process_index for d in grid[:, 0]} == set(range(n_hosts))
+
+    def test_heterogeneous_fleet_rejected(self):
+        from autoscaler_tpu.parallel.mesh import arrange_devices_for_hosts
+
+        devs = [_StubDevice(0, 0), _StubDevice(0, 1), _StubDevice(1, 2)]
+        with pytest.raises(ValueError):
+            arrange_devices_for_hosts(devs)
+
+    def test_multihost_mesh_runs_whatif_on_virtual_devices(self):
+        """All 8 virtual CPU devices share process 0, so this exercises the
+        single-host degenerate path end-to-end through a real Mesh."""
+        import jax
+
+        from autoscaler_tpu.parallel.mesh import (
+            make_multihost_mesh,
+            whatif_best_options,
+        )
+
+        devices = jax.devices()[:8]
+        mesh = make_multihost_mesh(devices)
+        rng = np.random.default_rng(5)
+        s_dim, g_dim = mesh.shape["scenario"], mesh.shape["group"]
+        S, G, P_, M = 2 * s_dim, 2 * g_dim, 16, 8
+        pod_req = np.zeros((P_, 6), np.float32)
+        pod_req[:, CPU] = rng.integers(100, 1500, P_)
+        pod_req[:, PODS] = 1
+        allocs = np.zeros((S, G, 6), np.float32)
+        allocs[:, :, CPU] = rng.integers(2000, 8000, (S, G))
+        allocs[:, :, PODS] = 110
+        prices = rng.uniform(0.5, 3.0, (S, G)).astype(np.float32)
+        masks = np.ones((G, P_), bool)
+        caps = np.full(G, M, np.int32)
+        res = whatif_best_options(
+            mesh, jnp.asarray(pod_req), jnp.asarray(masks), jnp.asarray(allocs),
+            jnp.asarray(prices), jnp.asarray(caps), max_nodes=M,
+        )
+        assert res.best_group.shape == (S,)
+        assert (np.asarray(res.node_counts) >= 1).all()
