@@ -1,0 +1,342 @@
+"""RestGceApi against a recorded compute-API server — the httptest pattern
+(same as tests/test_kube_client.py's FakeApiServer) for the GCE transport.
+
+Reference URL/JSON shapes:
+cluster-autoscaler/cloudprovider/gce/autoscaling_gce_client.go (Resize :198,
+DeleteInstances :264, ListManagedInstances :282) and templates.go.
+"""
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from autoscaler_tpu.cloudprovider.gce import build_gce_provider
+from autoscaler_tpu.cloudprovider.gce_rest import RestGceApi
+from autoscaler_tpu.cloudprovider.interface import (
+    InstanceErrorClass,
+    InstanceState,
+    NodeGroupError,
+)
+
+PROJECT, ZONE, MIG = "proj", "us-central2-b", "tpu-pool"
+
+
+class FakeComputeServer:
+    """Just enough of the compute v1 REST surface. Records every request
+    (method, path, body, auth header)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.requests = []
+        self.target_size = 3
+        self.instances = [
+            {
+                "instance": f"https://compute.googleapis.com/compute/v1/projects/{PROJECT}/zones/{ZONE}/instances/{MIG}-{i}",
+                "currentAction": "NONE",
+                "instanceStatus": "RUNNING",
+            }
+            for i in range(3)
+        ]
+        self.template = {
+            "properties": {
+                "machineType": f"zones/{ZONE}/machineTypes/ct5lp-hightpu-4t",
+                "labels": {
+                    "cloud.google.com/gke-tpu-topology": "2x2",
+                    "pool": "tpu",
+                },
+                "scheduling": {"provisioningModel": "SPOT"},
+            }
+        }
+        self.template_scope = "global"   # or "regions/us-central2"
+        self.page_size = 0               # >0: paginate list responses
+        self.pending_ops = 0             # ops to answer RUNNING before DONE
+        self.op_error = None             # operation-level error payload
+        server = ThreadingHTTPServer(("127.0.0.1", 0), self._handler())
+        self.server = server
+        self.port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def close(self):
+        self.server.shutdown()
+
+    def _handler(outer_self):
+        outer = outer_self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, payload=None):
+                body = json.dumps(payload or {}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _record(self, method):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length)) if length else None
+                with outer.lock:
+                    outer.requests.append(
+                        (method, self.path, body, self.headers.get("Authorization"))
+                    )
+                return body
+
+            def do_GET(self):
+                self._record("GET")
+                path = self.path
+                if path.endswith(f"/instanceGroupManagers/{MIG}"):
+                    return self._send(
+                        200,
+                        {
+                            "name": MIG,
+                            "targetSize": outer.target_size,
+                            "instanceTemplate": (
+                                f"{outer.template_scope}/instanceTemplates/{MIG}-tmpl"
+                            ),
+                        },
+                    )
+                if f"/{outer.template_scope}/instanceTemplates/{MIG}-tmpl" in path:
+                    return self._send(200, outer.template)
+                if "/operations/" in path:
+                    with outer.lock:
+                        if outer.pending_ops > 0:
+                            outer.pending_ops -= 1
+                            return self._send(200, {"name": "op-1", "status": "RUNNING"})
+                    op = {"name": "op-1", "status": "DONE"}
+                    if outer.op_error:
+                        op["error"] = outer.op_error
+                    return self._send(200, op)
+                if path.endswith("/aggregated/instanceGroupManagers"):
+                    return self._send(
+                        200,
+                        {
+                            "items": {
+                                f"zones/{ZONE}": {
+                                    "instanceGroupManagers": [
+                                        {"name": MIG},
+                                        {"name": "tpu-b"},
+                                    ]
+                                },
+                                "zones/empty-zone": {"warning": {"code": "NO_RESULTS"}},
+                            }
+                        },
+                    )
+                return self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                body = self._record("POST")
+                path = self.path
+                if "/resize" in path:
+                    outer.target_size = int(path.partition("size=")[2].partition("&")[0])
+                    done = outer.pending_ops == 0 and not outer.op_error
+                    return self._send(
+                        200,
+                        {"name": "op-1", "status": "DONE" if done else "PENDING"},
+                    )
+                if "/deleteInstances" in path:
+                    doomed = {u.rsplit("/", 1)[-1] for u in body["instances"]}
+                    with outer.lock:
+                        outer.instances = [
+                            i
+                            for i in outer.instances
+                            if i["instance"].rsplit("/", 1)[-1] not in doomed
+                        ]
+                        outer.target_size -= len(doomed)
+                    done = outer.pending_ops == 0 and not outer.op_error
+                    return self._send(
+                        200,
+                        {"name": "op-1", "status": "DONE" if done else "PENDING"},
+                    )
+                if "/listManagedInstances" in path:
+                    insts = list(outer.instances)
+                    if outer.page_size > 0:
+                        token = path.partition("pageToken=")[2]
+                        start = int(token) if token else 0
+                        page = insts[start : start + outer.page_size]
+                        payload = {"managedInstances": page}
+                        if start + outer.page_size < len(insts):
+                            payload["nextPageToken"] = str(start + outer.page_size)
+                        return self._send(200, payload)
+                    return self._send(200, {"managedInstances": insts})
+                return self._send(404, {"error": "not found"})
+
+        return Handler
+
+
+@pytest.fixture
+def compute():
+    s = FakeComputeServer()
+    yield s
+    s.close()
+
+
+def make_api(server, **kw):
+    return RestGceApi(
+        token_fn=lambda: "tok-123", base_url=server.url, project=PROJECT, **kw
+    )
+
+
+class TestRestGceApi:
+    def test_target_size_and_auth_header(self, compute):
+        api = make_api(compute)
+        assert api.get_target_size(PROJECT, ZONE, MIG) == 3
+        method, path, _, auth = compute.requests[-1]
+        assert (method, auth) == ("GET", "Bearer tok-123")
+        assert path == f"/projects/{PROJECT}/zones/{ZONE}/instanceGroupManagers/{MIG}"
+
+    def test_resize(self, compute):
+        api = make_api(compute)
+        api.resize(PROJECT, ZONE, MIG, 7)
+        assert compute.target_size == 7
+        assert any("/resize?size=7" in p for _, p, _, _ in compute.requests)
+
+    def test_delete_instances(self, compute):
+        api = make_api(compute)
+        api.delete_instances(PROJECT, ZONE, MIG, [f"{MIG}-1"])
+        names = [i["instance"].rsplit("/", 1)[-1] for i in compute.instances]
+        assert names == [f"{MIG}-0", f"{MIG}-2"]
+        _, _, body, _ = compute.requests[-1]
+        assert body["instances"] == [
+            f"projects/{PROJECT}/zones/{ZONE}/instances/{MIG}-1"
+        ]
+
+    def test_list_instances_state_and_error_mapping(self, compute):
+        compute.instances.append(
+            {
+                "instance": f".../instances/{MIG}-stockout",
+                "currentAction": "CREATING",
+                "lastAttempt": {
+                    "errors": {
+                        "errors": [
+                            {
+                                "code": "ZONE_RESOURCE_POOL_EXHAUSTED",
+                                "message": "no capacity",
+                            }
+                        ]
+                    }
+                },
+            }
+        )
+        compute.instances.append(
+            {"instance": ".../instances/tpu-pool-going", "currentAction": "DELETING"}
+        )
+        api = make_api(compute)
+        insts = {i.name: i for i in api.list_instances(PROJECT, ZONE, MIG)}
+        assert insts[f"{MIG}-0"].state == InstanceState.RUNNING
+        stockout = insts[f"{MIG}-stockout"]
+        assert stockout.state == InstanceState.CREATING
+        assert stockout.error.error_class == InstanceErrorClass.OUT_OF_RESOURCES
+        assert stockout.error.error_code == "ZONE_RESOURCE_POOL_EXHAUSTED"
+        assert insts["tpu-pool-going"].state == InstanceState.DELETING
+
+    def test_template_parsing(self, compute):
+        api = make_api(compute)
+        tmpl = api.get_template(PROJECT, ZONE, MIG)
+        assert tmpl.machine_type == "ct5lp-hightpu-4t"
+        assert tmpl.spot is True
+        assert tmpl.tpu_topology == "2x2"
+        assert tmpl.labels["pool"] == "tpu"
+
+    def test_list_migs_aggregated(self, compute):
+        api = make_api(compute)
+        assert api.list_migs() == [(PROJECT, ZONE, MIG), (PROJECT, ZONE, "tpu-b")]
+        assert RestGceApi(lambda: "t", base_url=compute.url).list_migs() == []
+
+    def test_http_error_becomes_node_group_error(self, compute):
+        api = make_api(compute)
+        with pytest.raises(NodeGroupError, match="HTTP 404"):
+            api.get_target_size(PROJECT, ZONE, "ghost")
+
+    def test_full_provider_over_rest(self, compute):
+        """The whole provider stack over the REST transport: template →
+        Node (TPU shape), scale-up resize, instance listing."""
+        api = make_api(compute)
+        provider = build_gce_provider(
+            [f"0:10:projects/{PROJECT}/zones/{ZONE}/instanceGroups/{MIG}"], api
+        )
+        (group,) = provider.node_groups()
+        assert group.target_size() == 3
+        node = group.template_node_info()
+        assert node.allocatable.tpu == 4
+        assert node.labels["cloud.google.com/gke-tpu-topology"] == "2x2"
+        group.increase_size(2)
+        assert compute.target_size == 5
+
+    def test_pagination_walks_all_pages(self, compute):
+        compute.page_size = 2  # 3 instances -> 2 pages
+        api = make_api(compute)
+        insts = api.list_instances(PROJECT, ZONE, MIG)
+        assert len(insts) == 3
+        list_paths = [p for _, p, _, _ in compute.requests if "listManaged" in p]
+        assert len(list_paths) == 2 and "pageToken=2" in list_paths[1]
+
+    def test_regional_template_scope_honored(self, compute):
+        compute.template_scope = "regions/us-central2"
+        api = make_api(compute)
+        tmpl = api.get_template(PROJECT, ZONE, MIG)
+        assert tmpl.machine_type == "ct5lp-hightpu-4t"
+        assert any(
+            f"/projects/{PROJECT}/regions/us-central2/instanceTemplates/" in p
+            for _, p, _, _ in compute.requests
+        )
+
+    def test_stopped_instance_not_counted_running(self, compute):
+        compute.instances.append(
+            {
+                "instance": ".../instances/tpu-pool-preempted",
+                "currentAction": "NONE",
+                "instanceStatus": "TERMINATED",
+            }
+        )
+        api = make_api(compute)
+        insts = {i.name: i for i in api.list_instances(PROJECT, ZONE, MIG)}
+        dead = insts["tpu-pool-preempted"]
+        assert dead.state == InstanceState.CREATING  # unavailable capacity
+        assert dead.error is not None and dead.error.error_code == "TERMINATED"
+
+    def test_operation_polled_until_done(self, compute):
+        compute.pending_ops = 2
+        api = make_api(compute)
+        api.resize(PROJECT, ZONE, MIG, 4)  # returns PENDING, polls to DONE
+        polls = [p for _, p, _, _ in compute.requests if "/operations/" in p]
+        assert len(polls) == 3  # two RUNNING answers, then DONE
+
+    def test_operation_error_raises(self, compute):
+        compute.pending_ops = 1
+        compute.op_error = {
+            "errors": [{"code": "QUOTA_EXCEEDED", "message": "out of quota"}]
+        }
+        api = make_api(compute)
+        with pytest.raises(NodeGroupError, match="QUOTA_EXCEEDED"):
+            api.resize(PROJECT, ZONE, MIG, 9)
+
+    def test_non_json_response_is_node_group_error(self):
+        import threading as _t
+        from http.server import BaseHTTPRequestHandler as _H, ThreadingHTTPServer as _S
+
+        class HtmlHandler(_H):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = b"<html>proxy error</html>"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        srv = _S(("127.0.0.1", 0), HtmlHandler)
+        _t.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            api = RestGceApi(lambda: "t", base_url=f"http://127.0.0.1:{srv.server_address[1]}")
+            with pytest.raises(NodeGroupError, match="non-JSON"):
+                api.get_target_size(PROJECT, ZONE, MIG)
+        finally:
+            srv.shutdown()
