@@ -23,9 +23,9 @@ writing transport code.
 from __future__ import annotations
 
 import json
-import urllib.error
-import urllib.request
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from autoscaler_tpu.utils.http import json_request
 
 from autoscaler_tpu.cloudprovider.gce import GceApi, MigInstance, MigTemplate
 from autoscaler_tpu.cloudprovider.interface import (
@@ -61,41 +61,35 @@ class RestGceApi(GceApi):
         timeout_s: float = 30.0,
         user_agent: str = "tpu-autoscaler",
         project: Optional[str] = None,  # required for list_migs discovery
+        op_timeout_s: float = 300.0,    # whole-operation deadline — NOT the
+                                        # per-request timeout: TPU/VM slice
+                                        # creation legitimately takes minutes
+        op_poll_s: float = 5.0,         # reference waitForOp polls every 5s
     ):
         self.token_fn = token_fn
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
         self.user_agent = user_agent
         self.project = project
+        self.op_timeout_s = op_timeout_s
+        self.op_poll_s = op_poll_s
 
     # -- transport -----------------------------------------------------------
     def _request(self, method: str, path: str, body: Optional[dict] = None) -> dict:
-        url = self.base_url + path
-        data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Accept", "application/json")
-        req.add_header("Authorization", f"Bearer {self.token_fn()}")
-        req.add_header("User-Agent", self.user_agent)
-        if data is not None:
-            req.add_header("Content-Type", "application/json")
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-                payload = resp.read()
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")[:500]
-            raise NodeGroupError(f"GCE API {method} {path}: HTTP {e.code} {detail}")
-        except OSError as e:
-            raise NodeGroupError(f"GCE API {method} {path}: {e}")
-        if not payload:
-            return {}
-        try:
-            return json.loads(payload)
-        except json.JSONDecodeError as e:
-            # a proxy/LB returning HTML-with-200 must surface as the same
-            # error class callers already handle, not crash the loop
-            raise NodeGroupError(
-                f"GCE API {method} {path}: non-JSON response ({e})"
-            )
+        return json_request(
+            self.base_url + path,
+            method=method,
+            body=body,
+            headers={
+                "Authorization": f"Bearer {self.token_fn()}",
+                "User-Agent": self.user_agent,
+            },
+            timeout_s=self.timeout_s,
+            on_error=lambda status, detail: NodeGroupError(
+                f"GCE API {method} {path}: "
+                + (f"HTTP {status} {detail}" if status else detail)
+            ),
+        )
 
     def _mig_path(self, project: str, zone: str, mig: str) -> str:
         return f"/projects/{project}/zones/{zone}/instanceGroupManagers/{mig}"
@@ -122,15 +116,15 @@ class RestGceApi(GceApi):
         successes."""
         import time as _time
 
-        deadline = _time.monotonic() + self.timeout_s
+        deadline = _time.monotonic() + self.op_timeout_s
         name = op.get("name", "")
         while op.get("status") != "DONE":
             if not name or _time.monotonic() >= deadline:
                 raise NodeGroupError(
                     f"GCE operation {name or '<unnamed>'} not DONE within "
-                    f"{self.timeout_s}s (status={op.get('status')})"
+                    f"{self.op_timeout_s}s (status={op.get('status')})"
                 )
-            _time.sleep(min(0.5, self.timeout_s / 10))
+            _time.sleep(self.op_poll_s)
             op = self._request(
                 "GET", f"/projects/{project}/zones/{zone}/operations/{name}"
             )
@@ -144,7 +138,14 @@ class RestGceApi(GceApi):
 
     # -- GceApi surface ------------------------------------------------------
     def get_target_size(self, project: str, zone: str, mig: str) -> int:
-        return int(self._request("GET", self._mig_path(project, zone, mig))["targetSize"])
+        payload = self._request("GET", self._mig_path(project, zone, mig))
+        size = payload.get("targetSize")
+        if size is None:  # keep the NodeGroupError contract on odd payloads
+            raise NodeGroupError(
+                f"MIG {project}/{zone}/{mig}: response lacks targetSize "
+                f"(keys: {sorted(payload)})"
+            )
+        return int(size)
 
     def resize(self, project: str, zone: str, mig: str, size: int) -> None:
         op = self._request(

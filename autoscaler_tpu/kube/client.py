@@ -24,6 +24,7 @@ import urllib.request
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from autoscaler_tpu.kube import convert
+from autoscaler_tpu.utils.http import json_request
 from autoscaler_tpu.kube.api import ClusterAPI, EvictionError
 from autoscaler_tpu.kube.objects import Node, Pod, PodDisruptionBudget, Taint
 
@@ -85,29 +86,21 @@ class KubeRestClient:
         stream: bool = False,
         timeout_s: Optional[float] = None,
     ):
-        url = self.base_url + path
-        data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Accept", "application/json")
-        req.add_header("User-Agent", self.user_agent)
-        if data is not None:
-            req.add_header("Content-Type", content_type)
+        headers = {"User-Agent": self.user_agent}
+        if body is not None:
+            headers["Content-Type"] = content_type
         if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
-        try:
-            resp = urllib.request.urlopen(
-                req, timeout=timeout_s or self.timeout_s, context=self._ctx
-            )
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")[:512]
-            raise ApiError(e.code, detail) from None
-        except urllib.error.URLError as e:
-            raise ApiError(0, str(e.reason)) from None
-        if stream:
-            return resp
-        payload = resp.read()
-        resp.close()
-        return json.loads(payload) if payload else {}
+            headers["Authorization"] = f"Bearer {self.token}"
+        return json_request(
+            self.base_url + path,
+            method=method,
+            body=body,
+            headers=headers,
+            timeout_s=timeout_s or self.timeout_s,
+            context=self._ctx,
+            on_error=ApiError,
+            stream=stream,
+        )
 
     def get(self, path: str) -> dict:
         return self._request("GET", path)

@@ -1,0 +1,60 @@
+"""Shared stdlib JSON-over-HTTP request helper for the REST transports
+(kube/client.py and cloudprovider/gce_rest.py) so the request/auth/error
+pattern cannot drift between them.
+
+Error mapping is the caller's via `on_error(status, detail) -> Exception`:
+HTTP errors pass their status code; transport-level failures (DNS, refused,
+timeout, non-JSON 2xx body) pass status 0.
+"""
+from __future__ import annotations
+
+import json
+import ssl
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, Optional
+
+
+def json_request(
+    url: str,
+    method: str = "GET",
+    body: Optional[dict] = None,
+    headers: Optional[Dict[str, str]] = None,
+    timeout_s: float = 30.0,
+    context: Optional[ssl.SSLContext] = None,
+    on_error: Callable[[int, str], Exception] = lambda s, d: RuntimeError(
+        f"HTTP {s}: {d}"
+    ),
+    stream: bool = False,
+):
+    """One JSON request. Returns the decoded dict ({} on empty body), or the
+    raw response object when stream=True (caller closes it)."""
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Accept", "application/json")
+    if data is not None and not any(
+        k.lower() == "content-type" for k in (headers or {})
+    ):
+        req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout_s, context=context)
+    except urllib.error.HTTPError as e:
+        raise on_error(e.code, e.read().decode(errors="replace")[:512]) from None
+    except urllib.error.URLError as e:
+        raise on_error(0, str(e.reason)) from None
+    except OSError as e:  # bare socket timeouts etc.
+        raise on_error(0, str(e)) from None
+    if stream:
+        return resp
+    payload = resp.read()
+    resp.close()
+    if not payload:
+        return {}
+    try:
+        return json.loads(payload)
+    except json.JSONDecodeError as e:
+        # a proxy/LB returning HTML-with-200 must surface through the same
+        # error contract as any other transport failure
+        raise on_error(0, f"non-JSON response ({e})") from None

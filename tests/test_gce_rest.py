@@ -178,6 +178,8 @@ def compute():
 
 
 def make_api(server, **kw):
+    kw.setdefault("op_poll_s", 0.01)
+    kw.setdefault("op_timeout_s", 5.0)
     return RestGceApi(
         token_fn=lambda: "tok-123", base_url=server.url, project=PROJECT, **kw
     )

