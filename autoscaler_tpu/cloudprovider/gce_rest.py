@@ -22,7 +22,6 @@ writing transport code.
 """
 from __future__ import annotations
 
-import json
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from autoscaler_tpu.utils.http import json_request

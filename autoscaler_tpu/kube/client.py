@@ -19,8 +19,6 @@ from __future__ import annotations
 import json
 import ssl
 import threading
-import urllib.error
-import urllib.request
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from autoscaler_tpu.kube import convert
@@ -311,12 +309,12 @@ class KubeClusterAPI(ClusterAPI):
         )
 
     def cordon_node(self, node_name: str) -> None:
-        self.client.patch(
+        self.client.merge_patch(
             f"/api/v1/nodes/{node_name}", {"spec": {"unschedulable": True}}
         )
 
     def uncordon_node(self, node_name: str) -> None:
-        self.client.patch(
+        self.client.merge_patch(
             f"/api/v1/nodes/{node_name}", {"spec": {"unschedulable": False}}
         )
 

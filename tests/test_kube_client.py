@@ -233,9 +233,14 @@ class FakeApiServer:
                         node = outer.nodes.get(name)
                         if node is None:
                             return self._send(404)
-                        taints = (body.get("spec") or {}).get("taints")
+                        spec = body.get("spec") or {}
+                        taints = spec.get("taints")
                         if taints is not None:
                             node.setdefault("spec", {})["taints"] = taints
+                        if "unschedulable" in spec:
+                            node.setdefault("spec", {})["unschedulable"] = spec[
+                                "unschedulable"
+                            ]
                         return self._send(200, node)
                 return self._send(404)
 
@@ -374,6 +379,14 @@ class TestKubeClusterAPI:
         assert api_server.configmaps["ca-status"]["data"]["status"] == "v2"
         methods = [m for m, p in api_server.writes if "configmap" in p]
         assert methods == ["PUT", "POST", "PUT"]  # 404 -> create, then update
+
+    def test_cordon_uncordon_roundtrip(self, api_server):
+        api_server.nodes["n1"] = node_json("n1")
+        api = KubeClusterAPI(KubeRestClient(api_server.url))
+        api.cordon_node("n1")
+        assert api_server.nodes["n1"]["spec"]["unschedulable"] is True
+        api.uncordon_node("n1")
+        assert api_server.nodes["n1"]["spec"]["unschedulable"] is False
 
     def test_delete_node(self, api_server):
         api_server.nodes["n1"] = node_json("n1")

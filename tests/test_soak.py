@@ -8,10 +8,13 @@ invariants asserted after every loop:
 
   I1  every group's target stays within [min, max]
   I2  no surviving node keeps a ToBeDeleted taint after a loop
-  I3  cluster never scales below the operator resource floors
-  I4  pods evicted by scale-down were actually movable (restartable,
+  I3  a cluster that starts at/above the operator resource floors never
+      scales below them (the floors gate scale-down; they cannot create
+      capacity a world never had)
+  I4  every pod evicted by scale-down was movable (restartable,
       non-mirror) — drain policy held
-  I5  the API node set and the provider node set stay consistent
+  I5  the API node set and the provider node set stay consistent (both
+      directions, checked after the world settles)
   I6  a healthy world with pending pods that fit a template eventually
       schedules them (progress, not just safety)
 """
@@ -108,7 +111,7 @@ def settle(provider, api, rng):
                 break
 
 
-def check_invariants(provider, api, seed, loop):
+def check_invariants(provider, api, seed, loop, started_above_floor):
     ctx = f"seed={seed} loop={loop}"
     for g in provider.node_groups():
         assert g.min_size() <= g.target_size() <= g.max_size(), (
@@ -119,26 +122,46 @@ def check_invariants(provider, api, seed, loop):
         assert not any(t.key == TO_BE_DELETED_TAINT for t in node.taints), (
             f"{ctx}: surviving node {node.name} still carries ToBeDeleted"
         )
-    cores = sum(n.allocatable.cpu_m for n in api.list_nodes()) / 1000.0
-    mem_gib = sum(n.allocatable.memory for n in api.list_nodes()) / GB
-    assert cores >= 2.0, f"{ctx}: cores {cores} under the floor"
-    assert mem_gib >= 4.0, f"{ctx}: memory {mem_gib}GiB under the floor"
-    # API nodes must be a subset of provider-known nodes (no orphans)
+    if started_above_floor:
+        cores = sum(n.allocatable.cpu_m for n in api.list_nodes()) / 1000.0
+        mem_gib = sum(n.allocatable.memory for n in api.list_nodes()) / GB
+        assert cores >= 2.0, f"{ctx}: cores {cores} under the floor"
+        assert mem_gib >= 4.0, f"{ctx}: memory {mem_gib}GiB under the floor"
+    # drain policy: only movable pods get evicted (all pods in these worlds
+    # are restartable ReplicaSet pods — a regression evicting mirror or
+    # controller-less pods would surface here if the generator grows them)
+    pods_ever = api.pods
+    for key in api.evicted:
+        pod = pods_ever.get(key)
+        if pod is not None:
+            assert pod.restartable and not pod.mirror, (
+                f"{ctx}: unmovable pod {key} was evicted"
+            )
+    # node-set consistency, both directions (post-settle the sets agree)
     provider_nodes = set(provider.group_of_node_map())
-    for node in api.list_nodes():
-        assert node.name in provider_nodes, f"{ctx}: orphan node {node.name}"
+    api_nodes = {n.name for n in api.list_nodes()}
+    assert api_nodes <= provider_nodes, (
+        f"{ctx}: orphan API nodes {api_nodes - provider_nodes}"
+    )
+    assert provider_nodes <= api_nodes, (
+        f"{ctx}: provider nodes missing from API {provider_nodes - api_nodes}"
+    )
 
 
 @pytest.mark.parametrize("seed", range(8))
 def test_soak_random_worlds(seed):
     rng = np.random.default_rng(seed)
     provider, api, autoscaler = build_world(rng)
+    started_above_floor = (
+        sum(n.allocatable.cpu_m for n in api.list_nodes()) >= 2000.0
+        and sum(n.allocatable.memory for n in api.list_nodes()) >= 4 * GB
+    )
     now = 0.0
     for loop in range(6):
         autoscaler.run_once(now_ts=now)
         # world settles: requested instances boot and register
         settle(provider, api, rng)
-        check_invariants(provider, api, seed, loop)
+        check_invariants(provider, api, seed, loop, started_above_floor)
         now += 30.0
     # progress: pending pods that fit somewhere must eventually schedule
     # (groups may cap out; only assert when headroom remained)
