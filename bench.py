@@ -234,6 +234,25 @@ def main():
                 continue
         result, note, kind = _run_child(platform, timeout_s)
         if result is not None:
+            # Persist TPU captures; on a CPU fallback attach the last real
+            # TPU capture (clearly labeled, with its own timestamp) so a
+            # wedged tunnel degrades the round's evidence instead of
+            # erasing it. The headline value/vs_baseline stay the honest
+            # numbers of THIS run's platform.
+            cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "bench_last_tpu.json")
+            if result.get("platform") == "tpu":
+                try:
+                    with open(cache, "w") as f:
+                        json.dump({**result, "captured_at": time.time()}, f)
+                except OSError:
+                    pass
+            elif os.path.exists(cache):
+                try:
+                    with open(cache) as f:
+                        result["last_tpu_capture"] = json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    pass
             print(json.dumps(result))
             return
         notes.append(note)

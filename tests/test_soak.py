@@ -111,7 +111,7 @@ def settle(provider, api, rng):
                 break
 
 
-def check_invariants(provider, api, seed, loop, started_above_floor):
+def check_invariants(provider, api, seed, loop, started_above_floor, pod_specs):
     ctx = f"seed={seed} loop={loop}"
     for g in provider.node_groups():
         assert g.min_size() <= g.target_size() <= g.max_size(), (
@@ -129,14 +129,14 @@ def check_invariants(provider, api, seed, loop, started_above_floor):
         assert mem_gib >= 4.0, f"{ctx}: memory {mem_gib}GiB under the floor"
     # drain policy: only movable pods get evicted (all pods in these worlds
     # are restartable ReplicaSet pods — a regression evicting mirror or
-    # controller-less pods would surface here if the generator grows them)
-    pods_ever = api.pods
+    # controller-less pods would surface here if the generator grows them).
+    # pod_specs snapshots attributes BEFORE eviction: FakeClusterAPI pops
+    # evicted pods, so api.pods can no longer answer for them.
     for key in api.evicted:
-        pod = pods_ever.get(key)
-        if pod is not None:
-            assert pod.restartable and not pod.mirror, (
-                f"{ctx}: unmovable pod {key} was evicted"
-            )
+        restartable, mirror = pod_specs.get(key, (True, False))
+        assert restartable and not mirror, (
+            f"{ctx}: unmovable pod {key} was evicted"
+        )
     # node-set consistency, both directions (post-settle the sets agree)
     provider_nodes = set(provider.group_of_node_map())
     api_nodes = {n.name for n in api.list_nodes()}
@@ -157,11 +157,16 @@ def test_soak_random_worlds(seed):
         and sum(n.allocatable.memory for n in api.list_nodes()) >= 4 * GB
     )
     now = 0.0
+    pod_specs = {}
     for loop in range(6):
+        # snapshot movability before the loop may evict anything
+        pod_specs.update(
+            {p.key(): (p.restartable, p.mirror) for p in api.list_pods()}
+        )
         autoscaler.run_once(now_ts=now)
         # world settles: requested instances boot and register
         settle(provider, api, rng)
-        check_invariants(provider, api, seed, loop, started_above_floor)
+        check_invariants(provider, api, seed, loop, started_above_floor, pod_specs)
         now += 30.0
     # progress: pending pods that fit somewhere must eventually schedule
     # (groups may cap out; only assert when headroom remained)
