@@ -94,6 +94,22 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="extra label excluded from group similarity (repeatable)")
     p.add_argument("--node-group-auto-discovery", action="append", default=[],
                    help="provider auto-discovery spec (repeatable)")
+    p.add_argument("--nodes", action="append", default=[],
+                   help="node group spec min:max:<MIG url> (repeatable; "
+                        "gce provider, reference --nodes)")
+    p.add_argument("--gce-project", default="",
+                   help="GCP project for the gce provider's auto-discovery")
+    p.add_argument("--gce-api-url", default="",
+                   help="compute API base URL override (tests/proxies); "
+                        "empty = https://compute.googleapis.com/compute/v1")
+    p.add_argument("--gce-token-file", default="",
+                   help="file holding a bearer token for the compute API, "
+                        "re-read per request (refresher-friendly); empty = "
+                        "GCE metadata-server token fetch at the deploy site")
+    p.add_argument("--kube-api", default="",
+                   help="control plane binding: 'in-cluster', or an API "
+                        "server URL (empty with --provider=test uses the "
+                        "in-memory fake)")
     p.add_argument("--cluster-name", default="")
     p.add_argument("--namespace", default="kube-system")
     p.add_argument("--status-config-map-name", default="cluster-autoscaler-status")
@@ -247,18 +263,76 @@ def main(argv=None) -> int:
     klogx.set_verbosity(args.v)
     logging.basicConfig(level=logging.INFO)
 
-    if args.provider != "test":
-        print(f"unknown cloud provider {args.provider!r} (available: test)", file=sys.stderr)
-        return 2
-    # the in-memory provider/API pair; real deployments construct their own
-    # provider adapter and cluster API binding and call run_loop directly
-    from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
     from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
     from autoscaler_tpu.debugging import DebuggingSnapshotter
-    from autoscaler_tpu.kube.api import FakeClusterAPI
 
-    provider = TestCloudProvider()
-    api = FakeClusterAPI()
+    if args.provider == "test":
+        from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+
+        provider = TestCloudProvider()
+    elif args.provider == "gce":
+        from autoscaler_tpu.cloudprovider.gce import build_gce_provider
+        from autoscaler_tpu.cloudprovider.gce_rest import (
+            DEFAULT_BASE_URL,
+            RestGceApi,
+        )
+
+        if args.gce_token_file:
+            token_path = args.gce_token_file
+
+            def token_fn() -> str:
+                # re-read per request so an external refresher (sidecar
+                # writing a fresh token) just works
+                with open(token_path) as f:
+                    return f.read().strip()
+        else:
+            print(
+                "gce provider needs --gce-token-file (metadata-server "
+                "fetch is the deploy site's refresher)",
+                file=sys.stderr,
+            )
+            return 2
+        if opts.node_group_auto_discovery and not args.gce_project:
+            print(
+                "--node-group-auto-discovery needs --gce-project (the "
+                "aggregated MIG listing is project-scoped; without it "
+                "discovery silently finds nothing)",
+                file=sys.stderr,
+            )
+            return 2
+        gce_api = RestGceApi(
+            token_fn,
+            base_url=args.gce_api_url or DEFAULT_BASE_URL,
+            user_agent=opts.user_agent,
+            project=args.gce_project or None,
+        )
+        try:
+            provider = build_gce_provider(
+                args.nodes, gce_api, auto_discovery=opts.node_group_auto_discovery
+            )
+        except ValueError as e:  # malformed --nodes/discovery spec
+            print(str(e), file=sys.stderr)
+            return 2
+    else:
+        print(
+            f"unknown cloud provider {args.provider!r} (available: test, gce)",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.kube_api:
+        from autoscaler_tpu.kube.client import KubeClusterAPI, KubeRestClient
+
+        if args.kube_api == "in-cluster":
+            client = KubeRestClient.in_cluster(user_agent=opts.user_agent)
+        else:
+            client = KubeRestClient(args.kube_api, user_agent=opts.user_agent)
+        api = KubeClusterAPI(client, watch=True)
+    else:
+        from autoscaler_tpu.kube.api import FakeClusterAPI
+
+        api = FakeClusterAPI()
+
     autoscaler = StaticAutoscaler(
         provider, api, opts, debugger=DebuggingSnapshotter()
     )
@@ -271,6 +345,9 @@ def main(argv=None) -> int:
         pass
     finally:
         server.stop()
+        close = getattr(api, "close", None)
+        if close is not None:  # stop KubeClusterAPI watch threads
+            close()
     return 0
 
 

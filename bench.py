@@ -250,7 +250,9 @@ def main():
             elif os.path.exists(cache):
                 try:
                     with open(cache) as f:
-                        result["last_tpu_capture"] = json.load(f)
+                        cap = json.load(f)
+                    cap["age_s"] = round(time.time() - cap.get("captured_at", 0))
+                    result["last_tpu_capture"] = cap
                 except (OSError, json.JSONDecodeError):
                     pass
             print(json.dumps(result))

@@ -342,3 +342,51 @@ class TestRestGceApi:
                 api.get_target_size(PROJECT, ZONE, MIG)
         finally:
             srv.shutdown()
+
+
+class TestCliRealBindings:
+    def test_main_runs_gce_provider_against_recorded_servers(self, compute, tmp_path):
+        """The CLI entrypoint wired for a real deployment — gce provider over
+        the REST transport + KubeClusterAPI over HTTP — runs reconcile loops
+        end to end against the two recorded servers and scales the MIG."""
+        from test_kube_client import FakeApiServer, node_json, pod_json
+
+        from autoscaler_tpu.main import main
+
+        kube = FakeApiServer()
+        token = tmp_path / "token"
+        token.write_text("tok-cli")
+        try:
+            # one registered node busy with a pod, plus pending pods that
+            # need a scale-up of the TPU MIG
+            kube.nodes[f"{MIG}-0"] = node_json(
+                f"{MIG}-0", cpu="112", mem="192Gi",
+                provider_id=f"gce://{PROJECT}/{ZONE}/{MIG}-0",
+            )
+            for i in range(3):
+                kube.pods[f"default/p{i}"] = pod_json(f"p{i}", cpu="50", mem="64Gi")
+            rc = main([
+                "--provider", "gce",
+                "--gce-api-url", compute.url,
+                "--gce-token-file", str(token),
+                "--nodes", f"0:10:projects/{PROJECT}/zones/{ZONE}/instanceGroups/{MIG}",
+                "--kube-api", kube.url,
+                "--scan-interval", "0.1",
+                "--max-iterations", "2",
+                "--address", "127.0.0.1:0",
+            ])
+            assert rc == 0
+            # the pending pods forced a resize on the recorded compute server
+            assert compute.target_size > 3
+            assert any("/resize" in p for _, p, _, _ in compute.requests)
+            # and the loop authenticated with the token file
+            assert any(a == "Bearer tok-cli" for _, _, _, a in compute.requests)
+        finally:
+            kube.close()
+
+    def test_main_rejects_gce_without_token(self, compute):
+        from autoscaler_tpu.main import main
+
+        rc = main(["--provider", "gce", "--gce-api-url", compute.url,
+                   "--max-iterations", "1", "--address", "127.0.0.1:0"])
+        assert rc == 2
