@@ -104,8 +104,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "empty = https://compute.googleapis.com/compute/v1")
     p.add_argument("--gce-token-file", default="",
                    help="file holding a bearer token for the compute API, "
-                        "re-read per request (refresher-friendly); empty = "
-                        "GCE metadata-server token fetch at the deploy site")
+                        "re-read per request so an external refresher "
+                        "(e.g. a sidecar fetching metadata-server tokens) "
+                        "just works; REQUIRED with --provider=gce")
     p.add_argument("--kube-api", default="",
                    help="control plane binding: 'in-cluster', or an API "
                         "server URL (empty with --provider=test uses the "
@@ -312,6 +313,17 @@ def main(argv=None) -> int:
             )
         except ValueError as e:  # malformed --nodes/discovery spec
             print(str(e), file=sys.stderr)
+            return 2
+        if not args.kube_api:
+            # pairing real MIG mutations with the empty in-memory fake would
+            # mark every healthy instance unregistered and, after
+            # max-node-provision-time, DELETE real VMs — fail closed
+            print(
+                "--provider=gce requires --kube-api (in-cluster or URL): "
+                "without a real control-plane binding every MIG instance "
+                "looks unregistered and would be cleaned up",
+                file=sys.stderr,
+            )
             return 2
     else:
         print(

@@ -251,8 +251,11 @@ def main():
                 try:
                     with open(cache) as f:
                         cap = json.load(f)
-                    cap["age_s"] = round(time.time() - cap.get("captured_at", 0))
-                    result["last_tpu_capture"] = cap
+                    if isinstance(cap, dict):
+                        cap["age_s"] = round(
+                            time.time() - cap.get("captured_at", 0)
+                        )
+                        result["last_tpu_capture"] = cap
                 except (OSError, json.JSONDecodeError):
                     pass
             print(json.dumps(result))

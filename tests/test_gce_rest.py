@@ -390,3 +390,19 @@ class TestCliRealBindings:
         rc = main(["--provider", "gce", "--gce-api-url", compute.url,
                    "--max-iterations", "1", "--address", "127.0.0.1:0"])
         assert rc == 2
+
+    def test_main_rejects_gce_without_kube_api(self, compute, tmp_path):
+        """gce + the in-memory fake control plane would mark every real
+        instance unregistered and eventually delete the VMs — must fail
+        closed, not fall through."""
+        from autoscaler_tpu.main import main
+
+        token = tmp_path / "token"
+        token.write_text("t")
+        rc = main([
+            "--provider", "gce", "--gce-api-url", compute.url,
+            "--gce-token-file", str(token),
+            "--nodes", f"0:10:projects/{PROJECT}/zones/{ZONE}/instanceGroups/{MIG}",
+            "--max-iterations", "1", "--address", "127.0.0.1:0",
+        ])
+        assert rc == 2

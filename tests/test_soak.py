@@ -61,12 +61,26 @@ def build_world(rng):
             )
             api.add_pod(p)
             pi += 1
-    # pending burst, each pod fits at least the largest template
+    # pending burst, each pod fits at least the largest template; a slice
+    # of the burst carries the harder predicates (anti-affinity spread,
+    # CSI volumes, host ports) so scale-up exercises the full mask + the
+    # dynamic affinity kernel under churn
+    from autoscaler_tpu.utils.test_utils import anti_affinity
+
     for j in range(int(rng.integers(0, 40))):
-        api.add_pod(
-            build_test_pod(f"pend-{j}", cpu_m=int(rng.integers(100, 1800)),
-                           mem=int(rng.integers(1, 6)) * GB)
+        p = build_test_pod(
+            f"pend-{j}", cpu_m=int(rng.integers(100, 1800)),
+            mem=int(rng.integers(1, 6)) * GB,
+            labels={"app": f"a{j % 5}"},
         )
+        flavor = rng.random()
+        if flavor < 0.1:
+            p.affinity = anti_affinity({"app": p.labels["app"]})
+        elif flavor < 0.2:
+            p.csi_volumes = (("pd.csi.storage.gke.io", f"vol-{j}"),)
+        elif flavor < 0.25:
+            p.host_ports = (9000 + j % 3,)
+        api.add_pod(p)
     opts = AutoscalingOptions(
         min_cores_total=2 * 1000.0,     # floor: 2 cores
         min_memory_total=4.0 * 1024,    # floor: 4 GiB in MiB
