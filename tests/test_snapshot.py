@@ -428,3 +428,33 @@ def test_no_bucket_leak_on_node_churn():
         snap.add_node(build_test_node(f"churn-{i}"))
         snap.remove_node(f"churn-{i}")
     assert len(snap._by_node) == 0
+
+
+def test_resources_rows_matches_resources_row():
+    """The vectorized flatten must stay bit-identical to the scalar one —
+    the MiB-scaling invariant lives in both (packer.resources_row docstring)."""
+    import numpy as np
+
+    from autoscaler_tpu.kube.objects import Resources
+    from autoscaler_tpu.snapshot.packer import resources_row, resources_rows
+
+    rng = np.random.default_rng(3)
+    items = [
+        Resources(
+            cpu_m=float(rng.integers(0, 10**5)),
+            memory=float(rng.integers(0, 2**38)),     # incl. non-MiB-aligned
+            ephemeral=float(rng.integers(0, 2**33)),
+            gpu=float(rng.integers(0, 8)),
+            tpu=float(rng.integers(0, 8)),
+            pods=float(rng.integers(0, 256)),
+        )
+        for _ in range(64)
+    ]
+    out = np.zeros((64, 6), np.float32)
+    resources_rows(items, 1.0, out)
+    for i, r in enumerate(items):
+        np.testing.assert_array_equal(out[i], resources_row(r, 1.0))
+    out2 = np.zeros((64, 6), np.float32)
+    resources_rows(items, None, out2)
+    for i, r in enumerate(items):
+        np.testing.assert_array_equal(out2[i], resources_row(r, r.pods))
