@@ -74,6 +74,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--expendable-pods-priority-cutoff", type=int, default=-10)
     p.add_argument("--provider", default="test")
     p.add_argument("--address", default=":8085", help="observability HTTP bind")
+    p.add_argument("--profiling", action="store_true",
+                   help="expose /debug/pprof/* (main.go:518-520)")
     p.add_argument("--health-check-max-inactivity", type=float, default=600.0)
     p.add_argument("--health-check-max-failing-time", type=float, default=900.0)
     p.add_argument("--max-iterations", type=int, default=0,
@@ -178,17 +180,21 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
 
 
 class ObservabilityServer:
-    """/metrics, /health-check, /snapshotz, /status (main.go:508-523)."""
+    """/metrics, /health-check, /snapshotz, /status (main.go:508-523),
+    plus /debug/pprof/* when profiling is enabled (main.go:518-520)."""
 
-    def __init__(self, autoscaler, address: str = ":8085"):
+    def __init__(self, autoscaler, address: str = ":8085", profiling: bool = False):
         host, _, port = address.rpartition(":")
         self.host = host or "0.0.0.0"
         self.port = int(port)
         self.autoscaler = autoscaler
+        self.profiling = profiling
         self._server: Optional[ThreadingHTTPServer] = None
+        self._started_tracemalloc = False
 
     def start(self) -> int:
         autoscaler = self.autoscaler
+        profiling = self.profiling
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
@@ -229,10 +235,44 @@ class ObservabilityServer:
                             autoscaler.options.cluster_name,
                         ).render(),
                     )
+                elif self.path.startswith("/debug/pprof"):
+                    if not profiling:
+                        self._send(404, "profiling disabled (--profiling)")
+                        return
+                    from urllib.parse import parse_qs, urlparse
+
+                    from autoscaler_tpu.utils import pprof
+
+                    url = urlparse(self.path)
+                    if url.path.rstrip("/") == "/debug/pprof":
+                        self._send(200, pprof.PPROF_INDEX)
+                    elif url.path == "/debug/pprof/profile":
+                        q = parse_qs(url.query)
+                        try:
+                            secs = float(q.get("seconds", ["5"])[0])
+                        except ValueError:
+                            self._send(400, "bad seconds parameter")
+                            return
+                        if not (0 < secs <= 60):
+                            self._send(400, "seconds must be in (0, 60]")
+                            return
+                        self._send(200, pprof.SamplingProfiler().run(secs))
+                    elif url.path == "/debug/pprof/heap":
+                        self._send(200, pprof.heap_profile())
+                    elif url.path == "/debug/pprof/threadz":
+                        self._send(200, pprof.thread_dump())
+                    else:
+                        self._send(404, "unknown pprof endpoint")
                 else:
                     self._send(404, "not found")
 
         self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        if profiling:
+            import tracemalloc
+
+            if not tracemalloc.is_tracing():
+                tracemalloc.start()
+                self._started_tracemalloc = True
         self.port = self._server.server_address[1]
         t = threading.Thread(target=self._server.serve_forever, daemon=True)
         t.start()
@@ -241,6 +281,12 @@ class ObservabilityServer:
     def stop(self) -> None:
         if self._server:
             self._server.shutdown()
+            self._server.server_close()
+        if self._started_tracemalloc:
+            import tracemalloc
+
+            tracemalloc.stop()
+            self._started_tracemalloc = False
 
 
 def run_loop(autoscaler, scan_interval_s: float, max_iterations: int = 0) -> None:
@@ -348,7 +394,7 @@ def main(argv=None) -> int:
     autoscaler = StaticAutoscaler(
         provider, api, opts, debugger=DebuggingSnapshotter()
     )
-    server = ObservabilityServer(autoscaler, args.address)
+    server = ObservabilityServer(autoscaler, args.address, profiling=args.profiling)
     port = server.start()
     print(f"tpu-autoscaler: observability on :{port}, scan interval {opts.scan_interval_s}s")
     try:

@@ -326,6 +326,46 @@ class TestCLI:
         finally:
             server.stop()
 
+    def test_pprof_endpoints(self):
+        a = make_autoscaler()
+        a.run_once(now_ts=0.0)
+        server = ObservabilityServer(a, "127.0.0.1:0", profiling=True)
+        port = server.start()
+        try:
+            def get(path):
+                with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+                    return r.status, r.read().decode()
+
+            code, body = get("/debug/pprof/")
+            assert code == 200 and "profiling index" in body
+            code, body = get("/debug/pprof/profile?seconds=0.2")
+            assert code == 200 and "wall-clock samples" in body
+            # the server thread itself must show up in the collapsed stacks
+            assert "serve_forever" in body or "select" in body
+            code, body = get("/debug/pprof/heap")
+            assert code == 200 and "heap:" in body
+            code, body = get("/debug/pprof/threadz")
+            assert code == 200 and "thread" in body
+        finally:
+            server.stop()
+
+    def test_pprof_disabled_by_default(self):
+        a = make_autoscaler()
+        server = ObservabilityServer(a, "127.0.0.1:0")
+        port = server.start()
+        try:
+            import urllib.error
+
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/pprof/profile"
+                )
+                raise AssertionError("expected 404 when profiling disabled")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            server.stop()
+
     def test_run_loop_bounded(self):
         a = make_autoscaler()
         run_loop(a, scan_interval_s=0.0, max_iterations=3)
