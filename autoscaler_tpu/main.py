@@ -256,7 +256,14 @@ class ObservabilityServer:
                         if not (0 < secs <= 60):
                             self._send(400, "seconds must be in (0, 60]")
                             return
-                        self._send(200, pprof.SamplingProfiler().run(secs))
+                        if not pprof.PROFILE_LOCK.acquire(blocking=False):
+                            self._send(429, "a profile is already running")
+                            return
+                        try:
+                            body = pprof.SamplingProfiler().run(secs)
+                        finally:
+                            pprof.PROFILE_LOCK.release()
+                        self._send(200, body)
                     elif url.path == "/debug/pprof/heap":
                         self._send(200, pprof.heap_profile())
                     elif url.path == "/debug/pprof/threadz":

@@ -26,6 +26,11 @@ import traceback
 from collections import Counter
 
 
+# Serializes /debug/pprof/profile requests, like Go's net/http/pprof CPU
+# profile (a second concurrent request is rejected): N parallel 100 Hz
+# samplers would multiply overhead on the live control loop.
+PROFILE_LOCK = threading.Lock()
+
 # thread ids currently running a SamplingProfiler: concurrent profile
 # requests must not sample each other's profiling loops
 _ACTIVE_PROFILER_THREADS: set = set()
@@ -85,7 +90,11 @@ def heap_profile(limit: int = 50) -> str:
 
     if not tracemalloc.is_tracing():
         return "# tracemalloc not tracing; start with --profiling\n"
-    snap = tracemalloc.take_snapshot()
+    try:
+        snap = tracemalloc.take_snapshot()
+    except RuntimeError:
+        # races server stop(): tracing ended between the check and snapshot
+        return "# tracemalloc not tracing; start with --profiling\n"
     stats = snap.statistics("lineno")
     total = sum(s.size for s in stats)
     lines = [f"# heap: {total / 1024:.1f} KiB tracked in {len(stats)} sites"]

@@ -112,7 +112,43 @@ def _bench_main():
         t0 = time.perf_counter()
         run()
         times.append(time.perf_counter() - t0)
-    t_tpu = float(np.median(times))
+    t_xla = float(np.median(times))
+
+    # Pallas VMEM fast path, gated on exact same-run parity with the XLA
+    # scan on the full workload: the headline number never comes from an
+    # unvalidated kernel (ROADMAP Scale #1). TPU only — interpret mode on
+    # CPU is orders of magnitude slower and validated separately in CI.
+    kernel = "xla_scan"
+    t_tpu = t_xla
+    pallas_parity = None
+    if jax.default_backend() == "tpu":
+        try:
+            from autoscaler_tpu.ops.pallas_binpack import ffd_binpack_groups_pallas
+
+            def run_pallas():
+                out = ffd_binpack_groups_pallas(
+                    jreq, jmasks, jallocs, max_nodes=MAX_NODES, node_caps=jcaps
+                )
+                return np.asarray(out.node_count), np.asarray(out.scheduled)
+
+            p_counts, p_sched = run_pallas()  # compile + warm
+            if (p_counts == res_counts).all() and (p_sched == res_sched).all():
+                ptimes = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    run_pallas()
+                    ptimes.append(time.perf_counter() - t0)
+                t_tpu = float(np.median(ptimes))
+                kernel = "pallas"
+                pallas_parity = "ok"
+            else:
+                diff = int((p_sched != res_sched).sum())
+                pallas_parity = (
+                    f"FAILED: {int((p_counts != res_counts).sum())} group "
+                    f"counts and {diff} scheduled bits diverge — using xla_scan"
+                )
+        except Exception as e:  # noqa: BLE001 — any kernel failure → xla path
+            pallas_parity = f"pallas path error: {type(e).__name__}: {e}"
 
     # Serial compiled baseline on a 3-group sample, scaled to G.
     try:
@@ -154,6 +190,9 @@ def _bench_main():
                 "p": P,
                 "g": G,
                 "device_time_s": round(t_tpu, 4),
+                "xla_scan_time_s": round(t_xla, 4),
+                "kernel": kernel,
+                **({"pallas_parity": pallas_parity} if pallas_parity else {}),
                 "baseline_time_s": round(t_ref, 2),
                 "baseline_kind": baseline,
             }
