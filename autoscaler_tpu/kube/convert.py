@@ -11,7 +11,7 @@ extended resources.
 from __future__ import annotations
 
 import datetime
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from autoscaler_tpu.kube import objects as k8s
 
@@ -153,7 +153,54 @@ def node_from_json(obj: Dict[str, Any]) -> k8s.Node:
     )
 
 
-def pod_from_json(obj: Dict[str, Any]) -> k8s.Pod:
+def csinode_limits_from_json(obj: Dict[str, Any]) -> Tuple[str, Dict[str, int]]:
+    """CSINode → (node_name, {driver: allocatable_count}).
+
+    The scheduler's NodeVolumeLimits plugin reads
+    CSINode.spec.drivers[].allocatable.count; this feeds
+    Node.csi_attach_limits (see PREDICATES.md, NodeVolumeLimits row)."""
+    name = (obj.get("metadata") or {}).get("name", "")
+    limits: Dict[str, int] = {}
+    for d in (obj.get("spec") or {}).get("drivers") or ():
+        count = (d.get("allocatable") or {}).get("count")
+        if d.get("name") and count is not None:
+            limits[d["name"]] = int(count)
+    return name, limits
+
+
+def pvc_csi_index(
+    pvcs: Sequence[Dict[str, Any]], pvs: Sequence[Dict[str, Any]]
+) -> Dict[Tuple[str, str], Tuple[str, str]]:
+    """→ {(namespace, claimName): (csi_driver, volumeHandle)} for claims bound
+    to CSI-backed PersistentVolumes.
+
+    This is the PVC→driver resolution that closes PREDICATES.md divergence 3:
+    two pods sharing one RWX claim map to the SAME volumeHandle, so the
+    packer's unique-handle attach counting sees one attachment per node, not
+    two. Non-CSI PVs (hostPath, NFS, ...) resolve to nothing — they don't
+    consume CSI attach slots."""
+    pv_by_name: Dict[str, Tuple[str, str]] = {}
+    for pv in pvs:
+        csi = ((pv.get("spec") or {}).get("csi")) or {}
+        if csi.get("driver"):
+            name = (pv.get("metadata") or {}).get("name", "")
+            pv_by_name[name] = (csi["driver"], csi.get("volumeHandle", name))
+    out: Dict[Tuple[str, str], Tuple[str, str]] = {}
+    for pvc in pvcs:
+        meta = pvc.get("metadata") or {}
+        vol = (pvc.get("spec") or {}).get("volumeName") or ""
+        hit = pv_by_name.get(vol)
+        if hit is not None:
+            out[(meta.get("namespace", "default"), meta.get("name", ""))] = hit
+    return out
+
+
+def pod_from_json(
+    obj: Dict[str, Any],
+    pvc_resolver: Optional[
+        Callable[[str, str], Optional[Tuple[str, str]]]
+    ] = None,
+) -> k8s.Pod:
     meta = obj.get("metadata") or {}
     spec = obj.get("spec") or {}
     annotations = dict(meta.get("annotations") or {})
@@ -176,10 +223,18 @@ def pod_from_json(obj: Dict[str, Any]) -> k8s.Pod:
         csi = v.get("csi")
         if csi and csi.get("driver"):
             # inline ephemeral CSI volume: unique to this pod, so its handle
-            # is synthesized from the pod identity + volume name. PVC-backed
-            # volumes need the PV's csi source resolved by the caller (a
-            # PV/PVC lister); set Pod.csi_volumes directly in that case.
+            # is synthesized from the pod identity + volume name.
             csi_volumes.append((csi["driver"], f"{pod_key}/{v.get('name', '')}"))
+        pvc = v.get("persistentVolumeClaim")
+        if pvc and pvc.get("claimName") and pvc_resolver is not None:
+            # PVC-backed volume: resolve claim → bound PV → csi source via
+            # the caller's PV/PVC listers (pvc_csi_index). Unresolved claims
+            # (unbound, or non-CSI PVs) consume no attach slots.
+            resolved = pvc_resolver(
+                meta.get("namespace", "default"), pvc["claimName"]
+            )
+            if resolved is not None:
+                csi_volumes.append(resolved)
 
     owner = None
     for ref in meta.get("ownerReferences") or ():
