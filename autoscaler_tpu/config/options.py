@@ -66,6 +66,9 @@ class AutoscalingOptions:
     # (the reference's live ConfigMap, expander/priority/priority.go)
     expander_priorities: Dict[int, List[str]] = field(default_factory=dict)
     priority_config_file: str = ""
+    # name of the live priority ConfigMap in config_namespace ("" = off);
+    # the reference's default is cluster-autoscaler-priority-expander
+    priority_config_map: str = ""
     max_nodes_per_scaleup: int = 1000             # main.go:215
     max_nodegroup_binpacking_duration_s: float = 10.0  # main.go:216
     balance_similar_node_groups: bool = False

@@ -55,6 +55,7 @@ class ScaleUpOrchestrator:
         node_info_processor=None,
         binpacking_limiter=None,
         metrics=None,
+        priorities_fetch=None,
     ):
         from autoscaler_tpu.expander.core import build_strategy
 
@@ -66,6 +67,7 @@ class ScaleUpOrchestrator:
             [n.strip() for n in options.expander.split(",") if n.strip()],
             priorities=options.expander_priorities,
             priorities_path=options.priority_config_file or None,
+            priorities_fetch=priorities_fetch,
         )
         self.resource_manager = ScaleUpResourceManager(provider.get_resource_limiter())
         self.balancing_processor = balancing_processor

@@ -81,6 +81,14 @@ class StaticAutoscaler:
             node_info_processor=self.processors.node_info,
             binpacking_limiter=self.processors.binpacking_limiter,
             metrics=self.metrics,
+            # live priority-ConfigMap read (expander/priority/priority.go)
+            priorities_fetch=(
+                (lambda: api.read_configmap(
+                    self.options.config_namespace, self.options.priority_config_map
+                ))
+                if self.options.priority_config_map
+                else None
+            ),
         )
         self.scale_down_planner = scale_down_planner or ScaleDownPlanner(
             provider, self.options, set_processor=self.processors.scale_down_set

@@ -132,7 +132,18 @@ def build_strategy(names: Sequence[str], seed: Optional[int] = None, **kwargs) -
 
             filters.append(PriceFilter(kwargs["pricing"]))
         elif name == PRIORITY:
-            if kwargs.get("priorities_path"):
+            if kwargs.get("priorities_fetch"):
+                # live ConfigMap read per decision, the reference's actual
+                # mechanism (expander/priority/priority.go)
+                from autoscaler_tpu.expander.priority import ConfigMapPriorityFilter
+
+                filters.append(
+                    ConfigMapPriorityFilter(
+                        kwargs["priorities_fetch"],
+                        fallback=kwargs.get("priorities"),
+                    )
+                )
+            elif kwargs.get("priorities_path"):
                 from autoscaler_tpu.expander.priority import FileWatchingPriorityFilter
 
                 filters.append(

@@ -3,35 +3,67 @@
 Reference: cluster-autoscaler/expander/priority/priority.go — a live ConfigMap
 maps integer priorities to lists of node-group-name regexes; the expander
 keeps only options whose group matches the highest priority tier present.
-Here the config is a plain dict, hot-swappable via set_priorities; the
-reference's live-ConfigMap reload is covered by FileWatchingPriorityFilter
-(mtime-checked on every decision, like the informer-backed fetch the
-reference does per BestOptions call) — the host embedding points it at a
-file, a projected ConfigMap volume, or any path a sidecar keeps fresh.
+Three tiers of config source, all hot-swappable without restart:
+PriorityFilter holds a plain dict (set_priorities); ConfigMapPriorityFilter
+re-reads the live ConfigMap per BestOptions call — the reference's actual
+mechanism, wired through ClusterAPI.read_configmap; and
+FileWatchingPriorityFilter mtime-watches a file (a projected ConfigMap
+volume, or any path a sidecar keeps fresh) for hosts without an API binding.
 """
 from __future__ import annotations
 
 import json
 import os
 import re
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 from autoscaler_tpu.expander.core import Filter, Option
 
 
 def parse_priorities(text: str) -> Dict[int, List[str]]:
-    """Config format: a JSON object mapping priority (int or numeric string,
-    higher wins) to a list of node-group-id regexes. The reference's YAML
-    ConfigMap payload (priority.go) carries the same shape."""
-    raw = json.loads(text)
+    """Config format: a mapping of priority (int or numeric string, higher
+    wins) to a list of node-group-id regexes — parsed as YAML, which also
+    accepts JSON. This is the exact payload shape of the reference's
+    `priorities` ConfigMap key (expander/priority/priority.go).
+
+    EVERY malformed input raises ValueError (never re.error/TypeError):
+    both hot-reload filters catch ValueError to keep serving the last good
+    tiers, so no payload shape may crash a scale-up decision."""
+    try:
+        import yaml
+
+        try:
+            raw = yaml.safe_load(text)
+        except yaml.YAMLError as e:
+            raise ValueError(f"priority config is not valid YAML/JSON: {e}") from None
+    except ImportError:
+        # PyYAML missing (minimal install): JSON remains fully supported
+        try:
+            raw = json.loads(text)
+        except json.JSONDecodeError as e:
+            raise ValueError(
+                f"priority config is not valid JSON (and PyYAML is not "
+                f"installed for YAML payloads): {e}"
+            ) from None
     if not isinstance(raw, dict):
         raise ValueError("priority config must be an object of prio -> [regex]")
     out: Dict[int, List[str]] = {}
     for k, v in raw.items():
+        if not isinstance(v, (list, tuple)):
+            raise ValueError(
+                f"priority {k!r}: expected a list of regexes, got {type(v).__name__}"
+            )
         patterns = [str(p) for p in v]
         for p in patterns:
-            re.compile(p)  # surface bad regexes at parse time
-        out[int(k)] = patterns
+            try:
+                re.compile(p)  # surface bad regexes at parse time
+            except re.error as e:
+                raise ValueError(f"priority {k!r}: bad regex {p!r}: {e}") from None
+        try:
+            prio = int(k)
+        except (TypeError, ValueError):
+            raise ValueError(f"priority key {k!r} is not an integer") from None
+        out[prio] = patterns
     return out
 
 
@@ -97,6 +129,67 @@ class FileWatchingPriorityFilter(PriorityFilter):
             return False
         self.set_priorities(parsed)
         self._sig = sig
+        self.last_error = None
+        return True
+
+    def best_options(self, options: List[Option]) -> List[Option]:
+        self.maybe_reload()
+        return super().best_options(options)
+
+
+# The reference's well-known ConfigMap (priority.go).
+PRIORITY_CONFIGMAP_NAME = "cluster-autoscaler-priority-expander"
+PRIORITY_CONFIGMAP_KEY = "priorities"
+
+
+class ConfigMapPriorityFilter(PriorityFilter):
+    """Live-ConfigMap priority tiers, the reference's actual mechanism
+    (expander/priority/priority.go re-reads the ConfigMap on every
+    BestOptions call through an informer-backed lister).
+
+    ``fetch`` returns the ConfigMap's data dict (or None if absent) — a
+    bound ClusterAPI.read_configmap in production, any callable in tests.
+    The payload under ``key`` is re-parsed only when its text changes; a
+    broken edit keeps the last good tiers (the reference logs and keeps
+    serving too), surfaced via ``last_error``."""
+
+    def __init__(
+        self,
+        fetch: Callable[[], Optional[Dict[str, str]]],
+        key: str = PRIORITY_CONFIGMAP_KEY,
+        fallback: Optional[Dict[int, Sequence[str]]] = None,
+    ):
+        self._fetch = fetch
+        self._key = key
+        self._last_text: Optional[str] = None
+        self.last_error: Optional[str] = None
+        super().__init__(fallback or {})
+        self.maybe_reload()
+
+    def maybe_reload(self) -> bool:
+        try:
+            data = self._fetch()
+        except Exception as e:  # noqa: BLE001 — a flaky API read must not
+            # fail the scale-up decision; keep the last good tiers
+            self.last_error = f"fetch: {e}"
+            return False
+        if data is None:
+            self.last_error = "configmap absent"
+            return False
+        text = data.get(self._key)
+        if text is None:
+            self.last_error = f"configmap has no {self._key!r} key"
+            return False
+        if text == self._last_text:
+            return False
+        try:
+            parsed = parse_priorities(text)
+        except ValueError as e:
+            self.last_error = str(e)
+            self._last_text = text  # don't re-parse a bad payload every call
+            return False
+        self.set_priorities(parsed)
+        self._last_text = text
         self.last_error = None
         return True
 

@@ -80,6 +80,12 @@ class ClusterAPI(abc.ABC):
         reference clusterstate.go:701 WriteStatusConfigMap). Default no-op
         for implementations without a config store."""
 
+    def read_configmap(self, namespace: str, name: str) -> Optional[dict]:
+        """ConfigMap data dict, or None if absent (the priority expander's
+        live config read, reference expander/priority/priority.go). Default
+        None for implementations without a config store."""
+        return None
+
 
 @dataclass
 class FakeClusterAPI(ClusterAPI):
@@ -172,6 +178,11 @@ class FakeClusterAPI(ClusterAPI):
     def write_configmap(self, namespace: str, name: str, data: dict) -> None:
         with self._lock:
             self.configmaps[(namespace, name)] = dict(data)
+
+    def read_configmap(self, namespace: str, name: str) -> Optional[dict]:
+        with self._lock:
+            data = self.configmaps.get((namespace, name))
+            return dict(data) if data is not None else None
 
 
 def to_be_deleted_taint() -> Taint:

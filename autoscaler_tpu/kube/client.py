@@ -171,6 +171,11 @@ class WatchCache:
     def wait_synced(self, timeout_s: float = 10.0) -> bool:
         return self._synced.wait(timeout_s)
 
+    @property
+    def synced(self) -> bool:
+        """True once the seed LIST has completed at least once."""
+        return self._synced.is_set()
+
     def list(self) -> List[dict]:
         with self._lock:
             return list(self._items.values())
@@ -302,6 +307,12 @@ class KubeClusterAPI(ClusterAPI):
     def _list_storage(self, kind: str) -> List[dict]:
         cache = self._storage_caches.get(kind)
         if cache is not None:
+            if not cache.synced:
+                # The seed LIST hasn't succeeded yet (e.g. a 503 outlasting
+                # the probe's retries): an empty answer here would silently
+                # erase attach limits, so fail the loop like the non-watch
+                # path; the cache's relist loop keeps retrying behind us.
+                raise ApiError(0, f"{kind} informer cache not yet synced")
             return cache.list()
         if kind in self._storage_absent:
             return []
@@ -341,11 +352,17 @@ class KubeClusterAPI(ClusterAPI):
             items = self.client.get("/api/v1/pods").get("items") or []
         resolver = None
         if self._resolve_csi:
-            index = convert.pvc_csi_index(
-                self._list_storage("pvc"), self._list_storage("pv")
-            )
-            if index:
-                resolver = lambda ns, claim: index.get((ns, claim))  # noqa: E731
+            # Lazy: the PVC/PV LISTs only happen if some pod actually mounts
+            # a claim — a PVC-free cluster pays zero extra requests per loop.
+            memo: List[Optional[dict]] = [None]
+
+            def resolver(ns: str, claim: str):
+                if memo[0] is None:
+                    memo[0] = convert.pvc_csi_index(
+                        self._list_storage("pvc"), self._list_storage("pv")
+                    )
+                return memo[0].get((ns, claim))
+
         return [convert.pod_from_json(o, pvc_resolver=resolver) for o in items]
 
     def list_pdbs(self) -> List[PodDisruptionBudget]:
@@ -425,6 +442,17 @@ class KubeClusterAPI(ClusterAPI):
             if e.status != 404:
                 raise
             self.client.post(f"/api/v1/namespaces/{namespace}/configmaps", body)
+
+    def read_configmap(self, namespace: str, name: str) -> Optional[dict]:
+        try:
+            obj = self.client.get(
+                f"/api/v1/namespaces/{namespace}/configmaps/{name}"
+            )
+        except ApiError as e:
+            if e.status == 404:
+                return None
+            raise
+        return obj.get("data") or {}
 
     def delete_node_object(self, node_name: str) -> None:
         try:

@@ -50,8 +50,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--expander", default="random",
                    help="comma-separated chain, e.g. priority,least-waste")
     p.add_argument("--expander-priority-config-file", default="",
-                   help="hot-reloaded JSON {priority: [group regexes]} for the "
-                        "priority expander (the reference's live ConfigMap)")
+                   help="hot-reloaded YAML/JSON {priority: [group regexes]} "
+                        "file for the priority expander")
+    p.add_argument("--expander-priority-config-map", default="",
+                   help="live ConfigMap (in --namespace) with a 'priorities' "
+                        "key for the priority expander; the reference's "
+                        "cluster-autoscaler-priority-expander. Needs "
+                        "--kube-api. Takes precedence over the file.")
     p.add_argument("--max-nodes-per-scaleup", type=int, default=1000)
     p.add_argument("--balance-similar-node-groups", action="store_true")
     p.add_argument("--scale-down-enabled", type=_bool_flag, default=True)
@@ -135,6 +140,7 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         estimator=args.estimator,
         expander=args.expander,
         priority_config_file=args.expander_priority_config_file,
+        priority_config_map=args.expander_priority_config_map,
         max_nodes_per_scaleup=args.max_nodes_per_scaleup,
         balance_similar_node_groups=args.balance_similar_node_groups,
         scale_down_enabled=args.scale_down_enabled,
@@ -381,6 +387,18 @@ def main(argv=None) -> int:
     else:
         print(
             f"unknown cloud provider {args.provider!r} (available: test, gce)",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.expander_priority_config_map and not args.kube_api:
+        # fail closed, like --provider=gce: without a control-plane binding
+        # the ConfigMap can never be read and the priority expander would
+        # silently behave as unconfigured
+        print(
+            "--expander-priority-config-map requires --kube-api "
+            "(the ConfigMap is read from the live control plane); use "
+            "--expander-priority-config-file for a mounted config",
             file=sys.stderr,
         )
         return 2

@@ -176,3 +176,150 @@ class TestFileWatchingPriority:
         p = provider_with_groups()
         strat = build_strategy(["priority"], priorities_path=str(cfg))
         assert strat.best_option(options_for(p)).node_group.id() == "cheap-pool"
+
+
+class TestConfigMapPriority:
+    """Live-ConfigMap tiers — the reference's actual mechanism
+    (expander/priority/priority.go re-reads the ConfigMap per BestOptions)."""
+
+    def _api_with(self, payload):
+        from autoscaler_tpu.kube.api import FakeClusterAPI
+
+        api = FakeClusterAPI()
+        api.write_configmap(
+            "kube-system", "cluster-autoscaler-priority-expander",
+            {"priorities": payload},
+        )
+        return api
+
+    def _filter(self, api):
+        from autoscaler_tpu.expander.priority import ConfigMapPriorityFilter
+
+        return ConfigMapPriorityFilter(
+            lambda: api.read_configmap(
+                "kube-system", "cluster-autoscaler-priority-expander"
+            )
+        )
+
+    def test_reference_yaml_payload(self):
+        """The reference's ConfigMap carries YAML (priority.go) — exactly
+        that shape must parse."""
+        api = self._api_with("10:\n  - cheap-.*\n50:\n  - pricey-.*\n")
+        p = provider_with_groups()
+        f = self._filter(api)
+        assert [o.node_group.id() for o in f.best_options(options_for(p))] == [
+            "pricey-pool"
+        ]
+
+    def test_update_applies_without_restart(self):
+        api = self._api_with('{"10": ["cheap-pool"]}')
+        p = provider_with_groups()
+        f = self._filter(api)
+        assert [o.node_group.id() for o in f.best_options(options_for(p))] == [
+            "cheap-pool"
+        ]
+        api.write_configmap(
+            "kube-system", "cluster-autoscaler-priority-expander",
+            {"priorities": '{"10": ["pricey-pool"]}'},
+        )
+        assert [o.node_group.id() for o in f.best_options(options_for(p))] == [
+            "pricey-pool"
+        ]
+
+    def test_broken_payload_keeps_last_good(self):
+        api = self._api_with('{"10": ["cheap-pool"]}')
+        p = provider_with_groups()
+        f = self._filter(api)
+        f.best_options(options_for(p))
+        api.write_configmap(
+            "kube-system", "cluster-autoscaler-priority-expander",
+            {"priorities": "{10: [unbalanced"},
+        )
+        assert [o.node_group.id() for o in f.best_options(options_for(p))] == [
+            "cheap-pool"
+        ]
+        assert f.last_error is not None
+
+    def test_bad_regex_payload_keeps_last_good(self):
+        """re.error/TypeError shapes must surface as ValueError inside
+        parse_priorities so a broken ConfigMap edit can never crash a
+        scale-up decision."""
+        api = self._api_with('{"10": ["cheap-pool"]}')
+        p = provider_with_groups()
+        f = self._filter(api)
+        f.best_options(options_for(p))
+        for broken in (
+            "10:\n  - '['\n",      # invalid regex → re.error path
+            "10: 5\n",              # scalar tier → TypeError path
+            "10: cheap-.*\n",       # scalar string tier (not a list)
+            "notanint:\n  - a\n",  # non-integer key
+        ):
+            api.write_configmap(
+                "kube-system", "cluster-autoscaler-priority-expander",
+                {"priorities": broken},
+            )
+            assert [o.node_group.id() for o in f.best_options(options_for(p))] == [
+                "cheap-pool"
+            ], broken
+            assert f.last_error is not None
+
+    def test_configmap_flag_requires_kube_api(self):
+        from autoscaler_tpu.main import main
+
+        rc = main([
+            "--expander", "priority",
+            "--expander-priority-config-map", "cluster-autoscaler-priority-expander",
+            "--max-iterations", "1",
+        ])
+        assert rc == 2
+
+    def test_absent_configmap_uses_fallback(self):
+        from autoscaler_tpu.expander.priority import ConfigMapPriorityFilter
+        from autoscaler_tpu.kube.api import FakeClusterAPI
+
+        api = FakeClusterAPI()
+        p = provider_with_groups()
+        f = ConfigMapPriorityFilter(
+            lambda: api.read_configmap("kube-system", "nope"),
+            fallback={5: ["pricey-pool"]},
+        )
+        assert [o.node_group.id() for o in f.best_options(options_for(p))] == [
+            "pricey-pool"
+        ]
+        assert f.last_error == "configmap absent"
+
+    def test_wired_through_autoscaler(self):
+        """options.priority_config_map → orchestrator → decision flips when
+        the operator edits the ConfigMap mid-run, no restart."""
+        from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+        from autoscaler_tpu.config.options import AutoscalingOptions
+        from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+        from autoscaler_tpu.kube.api import FakeClusterAPI
+        from autoscaler_tpu.utils.test_utils import GB, build_test_node, build_test_pod
+
+        provider = TestCloudProvider()
+        api = FakeClusterAPI()
+        for gid in ("alpha", "beta"):
+            provider.add_node_group(
+                gid, 0, 10, 0, build_test_node(f"{gid}-tmpl", cpu_m=4000, mem=8 * GB)
+            )
+        api.write_configmap(
+            "kube-system", "cluster-autoscaler-priority-expander",
+            {"priorities": "10:\n  - alpha\n"},
+        )
+        opts = AutoscalingOptions(
+            expander="priority",
+            priority_config_map="cluster-autoscaler-priority-expander",
+        )
+        a = StaticAutoscaler(provider, api, opts)
+        api.add_pod(build_test_pod("p0", cpu_m=3000, mem=GB))
+        a.run_once(now_ts=0.0)
+        assert provider._groups["alpha"].target_size() == 1
+        # operator flips the tier — next loop scales the other group
+        api.write_configmap(
+            "kube-system", "cluster-autoscaler-priority-expander",
+            {"priorities": "10:\n  - beta\n"},
+        )
+        api.add_pod(build_test_pod("p1", cpu_m=3000, mem=GB))
+        a.run_once(now_ts=700.0)
+        assert provider._groups["beta"].target_size() >= 1

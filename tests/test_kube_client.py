@@ -215,6 +215,9 @@ class FakeApiServer:
                     if "/leases/" in path:
                         lease = outer.leases.get(parts[-1])
                         return self._send(200, lease) if lease else self._send(404)
+                    if "/configmaps/" in path:
+                        cm = outer.configmaps.get(parts[-1])
+                        return self._send(200, cm) if cm else self._send(404)
                 return self._send(404)
 
             def do_POST(self):
@@ -439,9 +442,15 @@ class TestKubeClusterAPI:
 
     def test_storage_transient_error_fails_loop(self, api_server):
         """A transient storage LIST failure must propagate (failing the loop
-        like any lister error) rather than silently stripping attach limits."""
+        like any lister error) rather than silently stripping attach limits.
+        The PVC/PV index is lazy, so the failure only fires when some pod
+        actually mounts a claim — a PVC-free cluster is unaffected."""
         api_server.nodes["n1"] = node_json("n1")
-        api_server.pods["default/a"] = pod_json("a")
+        pod = pod_json("a")
+        pod["spec"]["volumes"] = [
+            {"name": "d", "persistentVolumeClaim": {"claimName": "claim"}}
+        ]
+        api_server.pods["default/a"] = pod
         api_server.storage_error = 503
         api = KubeClusterAPI(KubeRestClient(api_server.url))
         with pytest.raises(ApiError):
@@ -497,6 +506,14 @@ class TestKubeClusterAPI:
         assert len(api_server.nodes["n1"]["spec"]["taints"]) == 1
         api.remove_taint("n1", TO_BE_DELETED_TAINT)
         assert api_server.nodes["n1"]["spec"]["taints"] == []
+
+    def test_read_configmap_roundtrip(self, api_server):
+        api = KubeClusterAPI(KubeRestClient(api_server.url))
+        assert api.read_configmap("kube-system", "absent") is None
+        api.write_configmap("kube-system", "prio", {"priorities": "10:\n  - a\n"})
+        assert api.read_configmap("kube-system", "prio") == {
+            "priorities": "10:\n  - a\n"
+        }
 
     def test_write_configmap_create_then_update(self, api_server):
         api = KubeClusterAPI(KubeRestClient(api_server.url))
