@@ -181,6 +181,13 @@ class AutoscalerMetrics:
         self.function_duration = r.summary(
             p + "function_duration_seconds", "per-step durations"
         )
+        # the reference registers the durations twice — a histogram and a
+        # quantile summary (metrics.go:209-226); both names exist here so
+        # dashboards keyed on either port over
+        self.function_duration_quantile = r.summary(
+            p + "function_duration_quantile_seconds",
+            "per-step duration quantiles",
+        )
         self.device_dispatches_total = r.counter(
             p + "device_dispatches_total", "TPU kernel dispatches"
         )
@@ -243,6 +250,7 @@ class AutoscalerMetrics:
         """UpdateDurationFromStart analog (metrics.go:399)."""
         elapsed = time.monotonic() - start_ts
         self.function_duration.observe(elapsed, function=label)
+        self.function_duration_quantile.observe(elapsed, function=label)
         return elapsed
 
 

@@ -21,7 +21,7 @@ SnapshotTensors pytree consumed by the device kernels, cached per version.
 """
 from __future__ import annotations
 
-import dataclasses
+import copy
 from typing import Dict, List, Optional, Tuple
 
 from autoscaler_tpu.kube.objects import Node, Pod
@@ -230,7 +230,11 @@ class ClusterSnapshot:
         for key, pod in self._pods.items():
             assigned = self._assign.get(key, "")
             if assigned != pod.node_name:
-                pod = dataclasses.replace(pod, node_name=assigned)
+                # shallow copy + setattr, not dataclasses.replace: replace()
+                # re-runs __init__ over every field (~2x the per-pod cost,
+                # ~0.1s of a 100k-pod pack)
+                pod = copy.copy(pod)
+                pod.node_name = assigned
             pods.append(pod)
         tensors, meta = pack(self.nodes(), pods, group_of_node)
         self._cache = (self._version, tensors, meta)

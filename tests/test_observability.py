@@ -146,7 +146,8 @@ class TestExpandedCatalog:
         "cluster_cpu_current_cores", "cpu_limits_cores",
         "cluster_memory_current_bytes", "memory_limits_bytes",
         "node_group_min_count", "node_group_max_count", "last_activity",
-        "function_duration_seconds", "errors_total", "scaled_up_nodes_total",
+        "function_duration_seconds", "function_duration_quantile_seconds",
+        "errors_total", "scaled_up_nodes_total",
         "scaled_up_gpu_nodes_total", "failed_scale_ups_total",
         "scaled_down_nodes_total", "scaled_down_gpu_nodes_total",
         "evicted_pods_total", "unneeded_nodes_count",
