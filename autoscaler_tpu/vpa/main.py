@@ -1,0 +1,253 @@
+"""VPA process entry point: recommender + updater (+ admission webhook) as
+one runnable binary against a live control plane.
+
+The reference ships three binaries (vertical-pod-autoscaler/pkg/
+{recommender,updater,admission-controller}); their control loops are thin —
+recommender.RunOnce (routines/recommender.go:160: feed → update VPAs →
+checkpoints → GC), updater.RunOnce (logic/updater.go:109), and a webhook
+server. Here one process hosts all three on one histogram model (no
+CRD-checkpoint round-trip between them), each gated by --components; the
+cadence flags keep the reference's defaults (recommender 1m, updater 1m).
+
+Checkpoints persist to a local JSON file (--checkpoint-file) rather than the
+VerticalPodAutoscalerCheckpoint CRD: same serialized histogram payload
+(histogram.py:138 mirrors checkpoint_writer.go's normalized buckets), one
+file instead of one CRD per (vpa, container).
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+from autoscaler_tpu.vpa.api import UpdateMode, Vpa
+from autoscaler_tpu.vpa.feeder import ClusterStateFeeder, MetricsSource
+from autoscaler_tpu.vpa.recommender import (
+    Checkpoint,
+    CheckpointManager,
+    ClusterStateModel,
+    ContainerKey,
+    PercentileRecommender,
+    Recommendation,
+)
+from autoscaler_tpu.vpa.updater import Updater
+
+log = logging.getLogger("vpa")
+
+
+class VpaRunner:
+    """One reconcile pass over all three components' responsibilities."""
+
+    def __init__(
+        self,
+        binding,                      # VpaKubeBinding-shaped: list_vpas/write_status
+        cluster_api,                  # ClusterAPI: list_pods/evict_pod
+        metrics_source: MetricsSource,
+        checkpoint_path: str = "",
+        components: tuple = ("recommender", "updater"),
+        half_life_s: float = 24 * 3600.0,
+    ):
+        self.binding = binding
+        self.cluster_api = cluster_api
+        self.metrics_source = metrics_source
+        self.checkpoint_path = checkpoint_path
+        self.components = components
+        self.model = ClusterStateModel(half_life_s=half_life_s)
+        self.recommender = PercentileRecommender(self.model)
+        self.updater = Updater()
+        # both containers keep their identity across passes: the admission
+        # server holds references to them (test_vpa_e2e.py does the same)
+        self.recommendations: Dict[ContainerKey, Recommendation] = {}
+        self.vpas: List[Vpa] = []
+        # (ns, pod) → labels from this pass's single pod LIST; the metrics
+        # source joins against this instead of re-listing
+        self.last_pod_labels: Dict = {}
+        if checkpoint_path and os.path.exists(checkpoint_path):
+            self.load_checkpoints()
+
+    # -- checkpoints (local-file CRD analog) -------------------------------
+    def load_checkpoints(self) -> int:
+        with open(self.checkpoint_path) as f:
+            raw = json.load(f)
+        ckpts = [Checkpoint(**c) for c in raw]
+        CheckpointManager(self.model).load(ckpts)
+        log.info("restored %d checkpoints from %s", len(ckpts), self.checkpoint_path)
+        return len(ckpts)
+
+    def save_checkpoints(self) -> None:
+        if not self.checkpoint_path:
+            return
+        ckpts = [dataclasses.asdict(c) for c in CheckpointManager(self.model).store()]
+        tmp = self.checkpoint_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(ckpts, f)
+        os.replace(tmp, self.checkpoint_path)  # crash-safe swap
+
+    # -- one pass ----------------------------------------------------------
+    def run_once(self, now_ts: Optional[float] = None) -> Dict[str, int]:
+        now_ts = time.time() if now_ts is None else now_ts
+        with_status = self.binding.list_vpas_with_status()
+        self.vpas[:] = [vpa for vpa, _ in with_status]
+        vpas = self.vpas
+        stats = {"vpas": len(vpas), "samples": 0, "statuses": 0, "evicted": 0}
+        if not vpas:
+            return stats
+        pods = self.cluster_api.list_pods()
+        # one LIST feeds everything this pass — the metrics source's label
+        # join reads this map instead of re-listing (see main()'s wiring)
+        self.last_pod_labels = {(p.namespace, p.name): p.labels for p in pods}
+
+        # The updater must compare against what pods will actually be
+        # re-admitted at: the policy-CLAMPED recommendation (raw bounds
+        # would evict forever when a resourcePolicy caps the target), with
+        # ScalingMode.OFF containers absent entirely.
+        clamped: Dict[ContainerKey, Recommendation] = {}
+
+        # recommender.RunOnce: feed → recommend → write status → checkpoint
+        if "recommender" in self.components:
+            feeder = ClusterStateFeeder(self.model, vpas)
+            stats["samples"] = feeder.feed_once(self.metrics_source, now_ts)
+            self.recommendations.clear()
+            self.recommendations.update(self.recommender.recommend(now_ts))
+            for vpa in vpas:
+                per_container: Dict[str, Recommendation] = {}
+                for key, rec in self.recommendations.items():
+                    if key.vpa == vpa.name and key.namespace == vpa.namespace:
+                        c = vpa.clamp(key.container, rec)
+                        if c is not None:
+                            per_container[key.container] = c
+                            clamped[key] = c
+                if per_container:
+                    self.binding.write_status(vpa, per_container, now_ts)
+                    stats["statuses"] += 1
+            self.save_checkpoints()
+        else:
+            # updater-only process: work from the status a separate
+            # recommender wrote, like the reference updater reads the CRD
+            for vpa, status_recs in with_status:
+                for container, rec in status_recs.items():
+                    c = vpa.clamp(container, rec)
+                    if c is not None:
+                        clamped[
+                            ContainerKey(vpa.name, container, vpa.namespace)
+                        ] = c
+
+        # updater.RunOnce: evict drifted pods of Auto/Recreate VPAs
+        if "updater" in self.components and clamped:
+            by_workload: Dict[str, List] = {}
+            vpa_of: Dict[str, str] = {}
+            vpa_by_workload: Dict[str, Vpa] = {}
+            for vpa in vpas:
+                wl = f"{vpa.namespace}/{vpa.name}"
+                matched = [
+                    p
+                    for p in pods
+                    if p.namespace == vpa.namespace
+                    and vpa.target_selector.matches(p.labels)
+                ]
+                if matched:
+                    by_workload[wl] = matched
+                    vpa_of[wl] = vpa.name
+                    # keyed by workload (ns/name): same-named VPAs in two
+                    # namespaces must not collide on the eviction mode gate
+                    vpa_by_workload[wl] = vpa
+            evicted = self.updater.run_once(
+                by_workload,
+                clamped,
+                vpa_of,
+                now_ts,
+                evict_fn=self.cluster_api.evict_pod,
+                vpas=vpa_by_workload,
+            )
+            stats["evicted"] = len(evicted)
+        return stats
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("tpu-autoscaler-vpa")
+    p.add_argument("--kube-api", required=True,
+                   help="API server URL, or 'in-cluster'")
+    p.add_argument("--components", default="recommender,updater",
+                   help="comma list of recommender,updater,admission")
+    p.add_argument("--scrape-interval", type=float, default=60.0,
+                   help="pass cadence (reference recommender/updater: 1m)")
+    p.add_argument("--checkpoint-file", default="",
+                   help="local JSON checkpoint path ('' = stateless)")
+    p.add_argument("--memory-half-life", type=float, default=24 * 3600.0,
+                   help="histogram decay half-life seconds (default 24h)")
+    p.add_argument("--admission-port", type=int, default=8443)
+    p.add_argument("--max-iterations", type=int, default=0,
+                   help="stop after N passes (0 = forever); for testing")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    components = tuple(c.strip() for c in args.components.split(",") if c.strip())
+
+    from autoscaler_tpu.kube.client import KubeClusterAPI, KubeRestClient
+    from autoscaler_tpu.vpa.kube_io import KubeMetricsSource, VpaKubeBinding
+
+    if args.kube_api == "in-cluster":
+        client = KubeRestClient.in_cluster(user_agent="tpu-autoscaler-vpa")
+    else:
+        client = KubeRestClient(args.kube_api, user_agent="tpu-autoscaler-vpa")
+    api = KubeClusterAPI(client)
+    binding = VpaKubeBinding(client)
+
+    runner = VpaRunner(
+        binding,
+        api,
+        # labels come from run_once's own pod LIST — no second LIST per pass
+        KubeMetricsSource(client, lambda: runner.last_pod_labels),
+        checkpoint_path=args.checkpoint_file,
+        components=components,
+        half_life_s=args.memory_half_life,
+    )
+
+    admission = None
+    if "admission" in components:
+        from autoscaler_tpu.vpa.admission import AdmissionServer
+        from autoscaler_tpu.vpa.certs import generate_certs
+
+        admission = AdmissionServer(
+            runner.vpas,                 # live references, refreshed per pass
+            runner.recommendations,
+            host="0.0.0.0",
+            port=args.admission_port,
+            tls=generate_certs(),
+        )
+        admission.start()
+        print(f"vpa admission webhook on :{args.admission_port} (TLS)")
+
+    print(f"tpu-autoscaler-vpa: components={components}, "
+          f"interval {args.scrape_interval}s")
+    iterations = 0
+    try:
+        while True:
+            start = time.monotonic()
+            try:
+                stats = runner.run_once()
+                log.info("pass: %s", stats)
+            except Exception:  # noqa: BLE001 — reference RunOnce logs and
+                # continues; a transient 503 must not lose histogram state
+                log.exception("pass failed; continuing next tick")
+            iterations += 1
+            if args.max_iterations and iterations >= args.max_iterations:
+                return 0
+            time.sleep(max(args.scrape_interval - (time.monotonic() - start), 0.0))
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if admission is not None:
+            admission.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

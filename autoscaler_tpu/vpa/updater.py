@@ -125,8 +125,12 @@ class Updater:
                 # fail CLOSED: an unresolvable VPA (cache lag, rename) or one
                 # without a readable mode must not evict — Off mode exists
                 # precisely to prevent disruption (updater.go resolves the
-                # VPA first and skips when it can't)
-                mode = getattr(vpas.get(vpa), "update_mode", None)
+                # VPA first and skips when it can't). Lookup tries the
+                # workload key first (unique: callers key it by ns/name so
+                # same-named VPAs in two namespaces can't collide), then the
+                # bare VPA name for callers with a flat map.
+                resolved = vpas.get(workload, vpas.get(vpa))
+                mode = getattr(resolved, "update_mode", None)
                 if mode not in (UpdateMode.RECREATE, UpdateMode.AUTO):
                     continue
             budget = self.rate_limiter.budget_for(len(pods))

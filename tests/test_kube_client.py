@@ -88,6 +88,9 @@ class FakeApiServer:
         self.pvcs = []
         self.pvs = []
         self.csinodes = []
+        self.vpas = {}            # "ns/name" -> VPA CRD object
+        self.deployments = {}     # "ns/name" -> apps/v1 Deployment object
+        self.pod_metrics = []     # metrics.k8s.io PodMetrics items
         self.serve_storage = True  # False simulates a server without storage APIs
         self.storage_error = None  # e.g. 503: storage endpoints fail transiently
         self.leases = {}
@@ -202,6 +205,14 @@ class FakeApiServer:
                         if not outer.serve_storage:
                             return self._send(404)
                         return self._send(200, {"items": storage_items[path]})
+                    if path == "/apis/autoscaling.k8s.io/v1/verticalpodautoscalers":
+                        return self._send(200, {"items": list(outer.vpas.values())})
+                    if path == "/apis/metrics.k8s.io/v1beta1/pods":
+                        return self._send(200, {"items": outer.pod_metrics})
+                    if "/apis/apps/v1/" in path and "/deployments/" in path:
+                        seg = path.strip("/").split("/")
+                        dep = outer.deployments.get(f"{seg[4]}/{seg[-1]}")
+                        return self._send(200, dep) if dep else self._send(404)
                     parts = path.strip("/").split("/")
                     if path.startswith("/api/v1/nodes/"):
                         node = outer.nodes.get(parts[-1])
@@ -265,6 +276,19 @@ class FakeApiServer:
                                 "unschedulable"
                             ]
                         return self._send(200, node)
+                    if "/verticalpodautoscalers/" in path:
+                        # .../namespaces/{ns}/verticalpodautoscalers/{name}[/status]
+                        parts = path.strip("/").split("/")
+                        if parts[-1] == "status":
+                            name, ns = parts[-2], parts[-4]
+                        else:
+                            name, ns = parts[-1], parts[-3]
+                        vpa = outer.vpas.get(f"{ns}/{name}")
+                        if vpa is None:
+                            return self._send(404)
+                        if "status" in body:
+                            vpa["status"] = body["status"]
+                        return self._send(200, vpa)
                 return self._send(404)
 
             def do_PUT(self):
