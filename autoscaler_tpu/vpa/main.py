@@ -35,6 +35,7 @@ from autoscaler_tpu.vpa.recommender import (
     PercentileRecommender,
     Recommendation,
 )
+from autoscaler_tpu.utils.poll import poll_loop
 from autoscaler_tpu.vpa.updater import Updater
 
 log = logging.getLogger("vpa")
@@ -228,22 +229,12 @@ def main(argv=None) -> int:
 
     print(f"tpu-autoscaler-vpa: components={components}, "
           f"interval {args.scrape_interval}s")
-    iterations = 0
+
+    def tick():
+        log.info("pass: %s", runner.run_once())
+
     try:
-        while True:
-            start = time.monotonic()
-            try:
-                stats = runner.run_once()
-                log.info("pass: %s", stats)
-            except Exception:  # noqa: BLE001 — reference RunOnce logs and
-                # continues; a transient 503 must not lose histogram state
-                log.exception("pass failed; continuing next tick")
-            iterations += 1
-            if args.max_iterations and iterations >= args.max_iterations:
-                return 0
-            time.sleep(max(args.scrape_interval - (time.monotonic() - start), 0.0))
-    except KeyboardInterrupt:
-        return 0
+        return poll_loop(tick, args.scrape_interval, args.max_iterations, logger=log)
     finally:
         if admission is not None:
             admission.stop()

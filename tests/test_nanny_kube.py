@@ -1,0 +1,97 @@
+"""Addon-resizer binary against the recorded HTTP API server.
+
+Reference: addon-resizer/nanny/nanny_lib.go:103 (PollAPIServer) — count
+nodes, read the dependent container, resize when outside the deadband.
+"""
+import pytest
+
+from test_kube_client import FakeApiServer, node_json
+
+from autoscaler_tpu.addonresizer.main import NannyRunner, main
+from autoscaler_tpu.addonresizer.nanny import LinearEstimator
+from autoscaler_tpu.kube.client import KubeRestClient
+
+MB = 1024 * 1024
+
+
+def dep_json(name="metrics-server", ns="kube-system", cpu="300m", mem="200Mi"):
+    return {
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "template": {
+                "spec": {
+                    "containers": [
+                        {
+                            "name": name,
+                            "resources": {
+                                "requests": {"cpu": cpu, "memory": mem}
+                            },
+                        }
+                    ]
+                }
+            }
+        },
+    }
+
+
+@pytest.fixture()
+def srv():
+    s = FakeApiServer()
+    yield s
+    s.close()
+
+
+def make_runner(srv):
+    return NannyRunner(
+        KubeRestClient(srv.url),
+        "kube-system",
+        "metrics-server",
+        "metrics-server",
+        LinearEstimator(
+            base_cpu_m=300.0, cpu_per_node_m=2.0,
+            base_memory=200 * MB, memory_per_node=1 * MB,
+        ),
+    )
+
+
+class TestNannyRunner:
+    def test_resizes_on_node_count_growth(self, srv):
+        srv.deployments["kube-system/metrics-server"] = dep_json()
+        for i in range(200):
+            srv.nodes[f"n{i}"] = node_json(f"n{i}")
+        runner = make_runner(srv)
+        assert runner.run_once() is True  # 300m base → 700m at 200 nodes
+        req = srv.deployments["kube-system/metrics-server"]["spec"]["template"][
+            "spec"
+        ]["containers"][0]["resources"]
+        assert req["requests"]["cpu"] == "700m"
+        assert req["requests"] == req["limits"]  # nanny writes both
+        # steady state: within deadband → no further writes
+        writes_before = len(srv.writes)
+        assert runner.run_once() is False
+        assert len(srv.writes) == writes_before
+
+    def test_deadband_swallows_small_changes(self, srv):
+        srv.deployments["kube-system/metrics-server"] = dep_json(
+            cpu="320m", mem="210Mi"
+        )
+        for i in range(5):
+            srv.nodes[f"n{i}"] = node_json(f"n{i}")
+        # want 310m vs current 320m: ~3% < 10% deadband
+        assert make_runner(srv).run_once() is False
+
+    def test_cli_binary(self, srv):
+        srv.deployments["kube-system/metrics-server"] = dep_json()
+        for i in range(100):
+            srv.nodes[f"n{i}"] = node_json(f"n{i}")
+        rc = main([
+            "--kube-api", srv.url,
+            "--deployment", "metrics-server",
+            "--poll-period", "0",
+            "--max-iterations", "2",
+        ])
+        assert rc == 0
+        req = srv.deployments["kube-system/metrics-server"]["spec"]["template"][
+            "spec"
+        ]["containers"][0]["resources"]["requests"]
+        assert req["cpu"] == "500m"  # 300m + 2m * 100 nodes
