@@ -14,7 +14,11 @@ from typing import Optional
 
 from autoscaler_tpu.addonresizer.nanny import LinearEstimator, Nanny
 from autoscaler_tpu.kube.client import ApiError, KubeRestClient
-from autoscaler_tpu.kube.convert import parse_cpu_millis, parse_quantity
+from autoscaler_tpu.kube.convert import (
+    parse_cpu_millis,
+    parse_quantity,
+    resources_from_map,
+)
 from autoscaler_tpu.kube.objects import Resources
 from autoscaler_tpu.utils.poll import poll_loop
 
@@ -81,12 +85,18 @@ class NannyRunner:
             raise ApiError(
                 0, f"container {self.container!r} not in {self.deployment}"
             )
-        req = (self._target.get("resources") or {}).get("requests") or {}
-        current = Resources(
-            cpu_m=parse_cpu_millis(req.get("cpu", 0)),
-            memory=parse_quantity(req.get("memory", 0)),
-        )
-        return self.nanny.poll(current, len(nodes))
+        resources = self._target.get("resources") or {}
+        current = resources_from_map(resources.get("requests"))
+        if self.nanny.poll(current, len(nodes)):
+            return True
+        # requests are in-band, but the reference's checkResource compares
+        # limits too (nanny_lib.go:125 enforces requests == limits): a
+        # drifted or missing limit is reconciled even when requests hold
+        limits = resources_from_map(resources.get("limits"))
+        if (limits.cpu_m, limits.memory) != (current.cpu_m, current.memory):
+            self._apply(self.nanny.estimator.estimate(len(nodes)))
+            return True
+        return False
 
 
 def build_arg_parser() -> argparse.ArgumentParser:

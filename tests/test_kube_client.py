@@ -276,26 +276,6 @@ class FakeApiServer:
                                 "unschedulable"
                             ]
                         return self._send(200, node)
-                    if "/apis/apps/v1/" in path and "/deployments/" in path:
-                        # honest RFC 7386 merge-patch: lists REPLACE — the
-                        # real apiserver would strip image/env from a
-                        # one-element containers patch, so the fake must too
-                        seg = path.strip("/").split("/")
-                        dep = outer.deployments.get(f"{seg[4]}/{seg[-1]}")
-                        if dep is None:
-                            return self._send(404)
-
-                        def merge(dst, src):
-                            for k, v in src.items():
-                                if isinstance(v, dict) and isinstance(
-                                    dst.get(k), dict
-                                ):
-                                    merge(dst[k], v)
-                                else:
-                                    dst[k] = v
-
-                        merge(dep, body)
-                        return self._send(200, dep)
                     if "/verticalpodautoscalers/" in path:
                         # .../namespaces/{ns}/verticalpodautoscalers/{name}[/status]
                         parts = path.strip("/").split("/")

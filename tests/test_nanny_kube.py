@@ -14,19 +14,19 @@ from autoscaler_tpu.kube.client import KubeRestClient
 MB = 1024 * 1024
 
 
-def dep_json(name="metrics-server", ns="kube-system", cpu="300m", mem="200Mi"):
+def dep_json(name="metrics-server", ns="kube-system", cpu="300m", mem="200Mi",
+             limits=True):
+    qty = {"cpu": cpu, "memory": mem}
+    resources = {"requests": dict(qty)}
+    if limits:
+        resources["limits"] = dict(qty)
     return {
         "metadata": {"name": name, "namespace": ns},
         "spec": {
             "template": {
                 "spec": {
                     "containers": [
-                        {
-                            "name": name,
-                            "resources": {
-                                "requests": {"cpu": cpu, "memory": mem}
-                            },
-                        }
+                        {"name": name, "resources": resources}
                     ]
                 }
             }
@@ -79,6 +79,23 @@ class TestNannyRunner:
             srv.nodes[f"n{i}"] = node_json(f"n{i}")
         # want 310m vs current 320m: ~3% < 10% deadband
         assert make_runner(srv).run_once() is False
+
+    def test_drifted_limits_reconciled(self, srv):
+        """checkResource compares limits too (nanny_lib.go:125): in-band
+        requests with missing or drifted limits still get reconciled to
+        requests == limits."""
+        srv.deployments["kube-system/metrics-server"] = dep_json(
+            cpu="310m", mem="205Mi", limits=False
+        )
+        for i in range(5):
+            srv.nodes[f"n{i}"] = node_json(f"n{i}")
+        runner = make_runner(srv)
+        assert runner.run_once() is True  # requests in band, limits absent
+        req = srv.deployments["kube-system/metrics-server"]["spec"]["template"][
+            "spec"
+        ]["containers"][0]["resources"]
+        assert req["requests"] == req["limits"]
+        assert runner.run_once() is False  # now fully converged
 
     def test_cli_binary(self, srv):
         srv.deployments["kube-system/metrics-server"] = dep_json()
