@@ -295,6 +295,7 @@ def pod_from_json(
         daemonset=bool(owner and owner.kind == "DaemonSet"),
         restartable=owner is not None,
         local_storage=local_storage,
+        phase=(obj.get("status") or {}).get("phase") or "",
         creation_ts=parse_timestamp(meta.get("creationTimestamp")),
         deletion_ts=(
             parse_timestamp(meta["deletionTimestamp"])

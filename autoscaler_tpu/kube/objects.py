@@ -232,6 +232,9 @@ class Pod:
     local_storage: bool = False   # uses emptyDir/hostPath
     creation_ts: float = 0.0
     deletion_ts: Optional[float] = None
+    # status.phase ("Running"/"Pending"/...); "" when unknown — consumers
+    # fall back to node_name-based heuristics (balancer pod summaries)
+    phase: str = ""
 
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"

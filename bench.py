@@ -38,14 +38,21 @@ import numpy as np
 
 _CHILD_ENV = "AUTOSCALER_TPU_BENCH_CHILD"
 _PLATFORM_ENV = "AUTOSCALER_TPU_BENCH_PLATFORM"
-# generous: first TPU compile ~20-40s, the tunnel adds latency; a CPU run
-# of the full 100k x 500 scan needs the larger budget
+# generous: first TPU compile ~20-40s, the tunnel adds latency
 _ATTEMPTS = (
     # (platform intent, timeout_s); "default" = whatever the env pins (axon)
     ("default", 600),
     ("default", 600),   # one retry for a transiently wedged tunnel/backend
     ("cpu", 1800),
 )
+
+# The CPU fallback runs a SMALLER workload: the full 100k×500 scan measured
+# >40min on this host's CPU — past any sane attempt budget — and a CPU
+# number is only a liveness signal, not the round's evidence. The shape is
+# embedded in the metric name and the JSON's p/g fields, so a fallback can
+# never masquerade as the north-star capture (which requires platform=tpu).
+_CPU_FALLBACK_SHAPE = {"AUTOSCALER_TPU_BENCH_P": "20000",
+                       "AUTOSCALER_TPU_BENCH_G": "100"}
 
 
 def build_workload(P=100_000, G=500, seed=0):
@@ -210,6 +217,9 @@ def _run_child(platform: str, timeout_s: int):
     env[_CHILD_ENV] = "1"
     if platform != "default":
         env[_PLATFORM_ENV] = platform
+    if platform == "cpu":
+        for k, v in _CPU_FALLBACK_SHAPE.items():
+            env.setdefault(k, v)  # explicit operator knobs still win
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],

@@ -244,6 +244,72 @@ class TestBalancer:
         assert p.assignments.get("a", 0) == 0
         assert p.assignments["b"] == 4
 
+    def test_pod_summary(self):
+        """summary.go CalculateSummary: running counts, pending counts, and
+        pending-past-deadline trips the fallback trigger."""
+        from autoscaler_tpu.balancer.summary import (
+            calculate_summary,
+            target_failing,
+        )
+        from autoscaler_tpu.utils.test_utils import GB, build_test_pod
+
+        def pod(name, phase, created, node=""):
+            p = build_test_pod(name, cpu_m=100, mem=GB, node_name=node)
+            p.phase = phase
+            p.creation_ts = created
+            return p
+
+        pods = [
+            pod("r1", "Running", 0.0, node="n1"),
+            pod("r2", "Running", 0.0, node="n1"),
+            pod("young", "Pending", 95.0),     # within 60s tolerance
+            pod("stuck", "Pending", 10.0),     # pending for 90s > 60s
+            pod("done", "Succeeded", 0.0),     # terminal: not counted
+            pod("dead", "Failed", 0.0),
+        ]
+        s = calculate_summary(pods, now_ts=100.0, startup_timeout_s=60.0)
+        assert (s.total, s.running, s.not_started_within_deadline) == (4, 2, 1)
+        assert target_failing(s)
+        healthy = calculate_summary(pods[:3], now_ts=100.0, startup_timeout_s=60.0)
+        assert not target_failing(healthy)
+
+    def test_summary_phase_heuristic(self):
+        """Objects without status.phase fall back to node_name: scheduled ≈
+        Running, unscheduled ≈ Pending."""
+        from autoscaler_tpu.balancer.summary import calculate_summary
+        from autoscaler_tpu.utils.test_utils import GB, build_test_pod
+
+        scheduled = build_test_pod("a", cpu_m=100, mem=GB, node_name="n1")
+        pending = build_test_pod("b", cpu_m=100, mem=GB)
+        pending.creation_ts = 0.0
+        s = calculate_summary([scheduled, pending], now_ts=600.0,
+                              startup_timeout_s=60.0)
+        assert (s.total, s.running, s.not_started_within_deadline) == (2, 1, 1)
+
+    def test_summary_feeds_placement_fallback(self):
+        """A target whose pods missed the startup deadline is skipped by
+        get_placement, wiring summary → Target.failing → fallback."""
+        from autoscaler_tpu.balancer.policy import Target, get_placement
+        from autoscaler_tpu.balancer.summary import (
+            calculate_summary,
+            target_failing,
+        )
+        from autoscaler_tpu.utils.test_utils import GB, build_test_pod
+
+        stuck = build_test_pod("s", cpu_m=100, mem=GB)
+        stuck.phase, stuck.creation_ts = "Pending", 0.0
+        summaries = {
+            "a": calculate_summary([stuck], now_ts=600.0, startup_timeout_s=60.0),
+            "b": calculate_summary([], now_ts=600.0, startup_timeout_s=60.0),
+        }
+        targets = [
+            Target(name=n, priority=i, failing=target_failing(s))
+            for i, (n, s) in enumerate(sorted(summaries.items()))
+        ]
+        placement = get_placement(10, targets, policy="priority")
+        assert placement.assignments.get("b") == 10
+        assert "a" not in placement.assignments
+
     def test_overflow_unassigned(self):
         p = get_placement(10, [Target("a", max_replicas=4)], "priority")
         assert p.unassigned == 6
