@@ -1,8 +1,14 @@
 """Snapshot tests: fork/revert/commit semantics (modeled on the reference's
 cluster-autoscaler/simulator/clustersnapshot/clustersnapshot_test.go) plus
 packer/mask correctness for taints, selectors, and (anti-)affinity."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
+
+from autoscaler_tpu.snapshot.tensors import empty_snapshot
 
 from autoscaler_tpu.kube.objects import (
     CPU,
@@ -458,3 +464,43 @@ def test_resources_rows_matches_resources_row():
     resources_rows(items, None, out2)
     for i, r in enumerate(items):
         np.testing.assert_array_equal(out2[i], resources_row(r, r.pods))
+
+
+class TestTensorScheduleOps:
+    """The device twin of ClusterSnapshot AddPod/RemovePod: schedule_pod /
+    unschedule_pod as traceable updates (clustersnapshot.go:29 surface)."""
+
+    def test_schedule_unschedule_roundtrip(self):
+        t = empty_snapshot(num_pods=8, num_nodes=4)
+        t = dataclasses.replace(
+            t,
+            pod_req=t.pod_req.at[0].set(jnp.ones(t.pod_req.shape[1])),
+            pod_valid=t.pod_valid.at[0].set(True),
+            node_valid=t.node_valid.at[:2].set(True),
+        )
+
+        @jax.jit
+        def roundtrip(t):
+            t1 = t.schedule_pod(0, 1)
+            t2 = t1.unschedule_pod(0)
+            return t1, t2
+
+        t1, t2 = roundtrip(t)
+        assert int(t1.pod_node[0]) == 1
+        assert float(t1.node_used[1].sum()) > 0
+        # unschedule restores exactly
+        assert int(t2.pod_node[0]) == -1
+        np.testing.assert_array_equal(
+            np.asarray(t2.node_used), np.asarray(t.node_used)
+        )
+
+    def test_unschedule_unassigned_is_noop(self):
+        t = empty_snapshot(num_pods=4, num_nodes=2)
+        t = dataclasses.replace(
+            t, pod_req=t.pod_req.at[0].set(jnp.ones(t.pod_req.shape[1]))
+        )
+        t2 = t.unschedule_pod(0)  # pod 0 was never scheduled
+        np.testing.assert_array_equal(
+            np.asarray(t2.node_used), np.asarray(t.node_used)
+        )
+        assert int(t2.pod_node[0]) == -1
