@@ -1,0 +1,109 @@
+"""Differential fuzz: randomized worlds through all three FFD binpack
+implementations — the XLA scan (production), the serial numpy oracle
+(mirrors the reference algorithm, binpacking_estimator.go:65-141), and the
+Pallas kernel (interpret mode on CPU; Mosaic on TPU) — asserting exact
+agreement on node counts and scheduled sets.
+
+This widens the fixed-seed parity tests with varied shapes: degenerate
+resources (zero-request pods, pods-count-only binding), tight caps, all-
+masked groups, single-pod groups, huge pods that never fit, non-multiple-
+of-chunk pod counts, and duplicate pod specs (the equivalence-dedup path).
+"""
+import numpy as np
+import pytest
+
+from autoscaler_tpu.estimator.reference_impl import ffd_binpack_reference
+from autoscaler_tpu.kube.objects import CPU, GPU, MEMORY, PODS
+from autoscaler_tpu.ops.binpack import ffd_binpack_groups, ffd_binpack_groups_runs
+from autoscaler_tpu.ops.pallas_binpack import ffd_binpack_groups_pallas
+
+import jax.numpy as jnp
+
+
+def random_world(rng, P, G):
+    pod_req = np.zeros((P, 6), np.float32)
+    pod_req[:, CPU] = rng.integers(0, 2000, P)        # incl. zero-cpu pods
+    pod_req[:, MEMORY] = rng.integers(0, 8192, P)
+    if rng.random() < 0.3:
+        gpu_pods = rng.random(P) < 0.2
+        pod_req[gpu_pods, GPU] = rng.integers(1, 4, int(gpu_pods.sum()))
+    pod_req[:, PODS] = 1
+    if rng.random() < 0.2:
+        # duplicate specs: the dedup path must agree with per-pod scans
+        idx = rng.integers(0, P, P)
+        pod_req = pod_req[idx]
+
+    allocs = np.zeros((G, 6), np.float32)
+    allocs[:, CPU] = rng.choice([1000, 4000, 16000], G)
+    allocs[:, MEMORY] = rng.choice([2048, 8192, 65536], G)
+    if rng.random() < 0.3:
+        allocs[rng.random(G) < 0.3, GPU] = 8
+    # tiny pods-per-node caps sometimes dominate
+    allocs[:, PODS] = rng.choice([2, 16, 110], G)
+
+    masks = rng.random((G, P)) > rng.uniform(0.0, 0.4)
+    if rng.random() < 0.2:
+        masks[rng.integers(0, G)] = False              # fully-masked group
+    caps = rng.integers(1, 40, G).astype(np.int32)
+    return pod_req, masks, allocs, caps
+
+
+@pytest.mark.parametrize("case", range(24))
+def test_differential_fuzz(case):
+    rng = np.random.default_rng(1000 + case)
+    P = int(rng.choice([1, 7, 33, 96, 200, 517]))     # incl. non-tile sizes
+    G = int(rng.choice([1, 3, 8, 17]))
+    pod_req, masks, allocs, caps = random_world(rng, P, G)
+    max_nodes = int(caps.max())
+
+    out = ffd_binpack_groups(
+        jnp.asarray(pod_req), jnp.asarray(masks), jnp.asarray(allocs),
+        max_nodes=max_nodes, node_caps=jnp.asarray(caps),
+    )
+    counts = np.asarray(out.node_count)
+    sched = np.asarray(out.scheduled)
+
+    # serial oracle, group by group (caps clamp like the kernel)
+    for g in range(G):
+        ref_count, ref_sched = ffd_binpack_reference(
+            pod_req, masks[g], allocs[g], int(min(caps[g], max_nodes))
+        )
+        assert ref_count == int(counts[g]), f"case {case} group {g} count"
+        np.testing.assert_array_equal(
+            sched[g], ref_sched, err_msg=f"case {case} group {g} scheduled"
+        )
+
+    # equivalence-runs dedup twin: collapse identical (requests, mask-column)
+    # pods into runs (the host equivalence grouping, groups.go:61), then the
+    # per-run placed counts must match the per-pod kernel's scheduled sets.
+    key = np.concatenate([pod_req, masks.T.astype(np.float32)], axis=1)
+    uniq, inverse, counts_u = np.unique(
+        key, axis=0, return_inverse=True, return_counts=True
+    )
+    run_req = np.ascontiguousarray(uniq[:, :6], dtype=np.float32)
+    run_masks = np.ascontiguousarray(uniq[:, 6:].astype(bool).T)  # [G, U]
+    runs = ffd_binpack_groups_runs(
+        jnp.asarray(run_req), jnp.asarray(counts_u.astype(np.int32)),
+        jnp.asarray(run_masks), jnp.asarray(allocs),
+        max_nodes=max_nodes, node_caps=jnp.asarray(caps),
+    )
+    np.testing.assert_array_equal(np.asarray(runs.node_count), counts,
+                                  err_msg=f"case {case} runs count")
+    placed = np.asarray(runs.placed_counts)                       # [G, U]
+    for g in range(G):
+        per_run_sched = np.bincount(
+            inverse[sched[g]], minlength=len(uniq)
+        )
+        np.testing.assert_array_equal(
+            placed[g], per_run_sched, err_msg=f"case {case} group {g} run counts"
+        )
+
+    # Pallas twin (interpret mode on CPU; exercises pad/chunk edges)
+    pal = ffd_binpack_groups_pallas(
+        jnp.asarray(pod_req), jnp.asarray(masks), jnp.asarray(allocs),
+        max_nodes=max_nodes, node_caps=jnp.asarray(caps), chunk=64,
+    )
+    np.testing.assert_array_equal(np.asarray(pal.node_count), counts,
+                                  err_msg=f"case {case} pallas count")
+    np.testing.assert_array_equal(np.asarray(pal.scheduled), sched,
+                                  err_msg=f"case {case} pallas scheduled")

@@ -487,7 +487,13 @@ class TestTensorScheduleOps:
 
         t1, t2 = roundtrip(t)
         assert int(t1.pod_node[0]) == 1
-        assert float(t1.node_used[1].sum()) > 0
+        # exact accounting: node 1 carries exactly the pod's request
+        np.testing.assert_array_equal(
+            np.asarray(t1.node_used[1]), np.ones(t.pod_req.shape[1])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(t1.node_used[0]), np.zeros(t.pod_req.shape[1])
+        )
         # unschedule restores exactly
         assert int(t2.pod_node[0]) == -1
         np.testing.assert_array_equal(
