@@ -260,6 +260,25 @@ class VpaKubeBinding:
             self.client.merge_patch(path, body)
 
 
+WEBHOOK_PATH = (
+    "/apis/admissionregistration.k8s.io/v1/mutatingwebhookconfigurations"
+)
+
+
+def register_webhook(client: KubeRestClient, config: dict) -> None:
+    """Create-or-update the MutatingWebhookConfiguration — the reference's
+    selfRegistration (admission-controller config.go:67-99). Must run every
+    process start: generate_certs mints a fresh CA per process, so a stale
+    caBundle from the previous pod would fail TLS against this one."""
+    name = (config.get("metadata") or {}).get("name", "")
+    try:
+        client.put(f"{WEBHOOK_PATH}/{name}", config)
+    except ApiError as e:
+        if e.status != 404:
+            raise
+        client.post(WEBHOOK_PATH, config)
+
+
 class KubeMetricsSource(MetricsSource):
     """metrics.k8s.io scrape → ContainerUsage rows.
 

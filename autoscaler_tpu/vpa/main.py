@@ -182,6 +182,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--memory-half-life", type=float, default=24 * 3600.0,
                    help="histogram decay half-life seconds (default 24h)")
     p.add_argument("--admission-port", type=int, default=8443)
+    p.add_argument("--webhook-service", default="vpa-webhook",
+                   help="Service name the webhook registration points at")
+    p.add_argument("--webhook-namespace", default="kube-system")
     p.add_argument("--max-iterations", type=int, default=0,
                    help="stop after N passes (0 = forever); for testing")
     return p
@@ -215,17 +218,33 @@ def main(argv=None) -> int:
     admission = None
     if "admission" in components:
         from autoscaler_tpu.vpa.admission import AdmissionServer
-        from autoscaler_tpu.vpa.certs import generate_certs
+        from autoscaler_tpu.vpa.certs import generate_certs, webhook_configuration
+        from autoscaler_tpu.vpa.kube_io import register_webhook
 
+        bundle = generate_certs(
+            service_name=args.webhook_service, namespace=args.webhook_namespace
+        )
         admission = AdmissionServer(
             runner.vpas,                 # live references, refreshed per pass
             runner.recommendations,
             host="0.0.0.0",
             port=args.admission_port,
-            tls=generate_certs(),
+            tls=bundle,
         )
         admission.start()
-        print(f"vpa admission webhook on :{args.admission_port} (TLS)")
+        # selfRegistration (config.go:67-99): the fresh CA must be pushed
+        # into the MutatingWebhookConfiguration every start, else the
+        # webhook exists but never fires (failurePolicy Ignore)
+        register_webhook(
+            client,
+            webhook_configuration(
+                bundle,
+                service_name=args.webhook_service,
+                namespace=args.webhook_namespace,
+            ),
+        )
+        print(f"vpa admission webhook on :{args.admission_port} (TLS), "
+              f"registered as {args.webhook_service}.{args.webhook_namespace}.svc")
 
     print(f"tpu-autoscaler-vpa: components={components}, "
           f"interval {args.scrape_interval}s")

@@ -91,6 +91,7 @@ class FakeApiServer:
         self.vpas = {}            # "ns/name" -> VPA CRD object
         self.deployments = {}     # "ns/name" -> apps/v1 Deployment object
         self.pod_metrics = []     # metrics.k8s.io PodMetrics items
+        self.webhooks = {}        # name -> MutatingWebhookConfiguration
         self.serve_storage = True  # False simulates a server without storage APIs
         self.storage_error = None  # e.g. 503: storage endpoints fail transiently
         self.leases = {}
@@ -255,6 +256,10 @@ class FakeApiServer:
                         name = (body.get("metadata") or {}).get("name", "")
                         outer.configmaps[name] = body
                         return self._send(201, body)
+                    if path.endswith("/mutatingwebhookconfigurations"):
+                        name = (body.get("metadata") or {}).get("name", "")
+                        outer.webhooks[name] = body
+                        return self._send(201, body)
                 return self._send(404)
 
             def do_PATCH(self):
@@ -311,6 +316,12 @@ class FakeApiServer:
                         if name not in outer.configmaps:
                             return self._send(404)
                         outer.configmaps[name] = body
+                        return self._send(200, body)
+                    if "/mutatingwebhookconfigurations/" in path:
+                        name = path.rsplit("/", 1)[1]
+                        if name not in outer.webhooks:
+                            return self._send(404)
+                        outer.webhooks[name] = body
                         return self._send(200, body)
                 return self._send(404)
 

@@ -248,6 +248,28 @@ class TestVpaRunnerOverHttp:
         # main.py routes evictions via /api/v1/namespaces/{ns}/pods/...
         assert "dev" in evicted_ns and "prod" not in evicted_ns
 
+    def test_webhook_self_registration(self, srv):
+        """selfRegistration (config.go:67-99): create-then-update of the
+        MutatingWebhookConfiguration with the process's fresh caBundle."""
+        import base64
+
+        from autoscaler_tpu.vpa.certs import generate_certs, webhook_configuration
+        from autoscaler_tpu.vpa.kube_io import register_webhook
+
+        client = KubeRestClient(srv.url)
+        b1 = generate_certs()
+        register_webhook(client, webhook_configuration(b1))
+        stored = srv.webhooks["vpa-webhook-config"]
+        ca1 = stored["webhooks"][0]["clientConfig"]["caBundle"]
+        assert base64.b64decode(ca1) == b1.ca_cert_pem
+        # a restarted process mints a new CA; re-registration must replace it
+        b2 = generate_certs()
+        register_webhook(client, webhook_configuration(b2))
+        ca2 = srv.webhooks["vpa-webhook-config"]["webhooks"][0]["clientConfig"][
+            "caBundle"
+        ]
+        assert base64.b64decode(ca2) == b2.ca_cert_pem
+
     def test_unknown_update_mode_fails_closed(self, srv):
         srv.vpas["default/v"] = vpa_json(name="v", mode="InPlaceOrRecreate")
         srv.deployments["default/hamster"] = deployment_json()
