@@ -145,10 +145,19 @@ def webhook_configuration(
     client_config: Dict = {
         "caBundle": base64.b64encode(bundle.ca_cert_pem).decode()
     }
+    # the server only mutates on /mutate (admission.py do_POST); without an
+    # explicit path the apiserver would POST to "/" and, under failurePolicy
+    # Ignore, every pod would silently admit unpatched
     if url is not None:
-        client_config["url"] = url
+        client_config["url"] = url.rstrip("/") + (
+            "" if url.rstrip("/").endswith("/mutate") else "/mutate"
+        )
     else:
-        client_config["service"] = {"namespace": namespace, "name": service_name}
+        client_config["service"] = {
+            "namespace": namespace,
+            "name": service_name,
+            "path": "/mutate",
+        }
     return {
         "apiVersion": "admissionregistration.k8s.io/v1",
         "kind": "MutatingWebhookConfiguration",

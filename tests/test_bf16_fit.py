@@ -1,0 +1,104 @@
+"""bf16 fit-compare experiments (ROADMAP Scale #3): the one-sided rounding
+guard must make the bf16 verdict conservative — never admitting a pod the
+exact f32 compare would reject — and exact on bf16-representable inputs.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from autoscaler_tpu.ops.fit import (
+    _bf16_ceil,
+    _bf16_floor,
+    bf16_compare_operands,
+    fit_matrix,
+)
+from autoscaler_tpu.snapshot.packer import pack
+from autoscaler_tpu.utils.test_utils import GB, MB, build_test_node, build_test_pod
+
+
+class TestRoundingPrimitives:
+    def test_ceil_floor_bracket_the_value(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(
+            rng.uniform(0, 1e9, 4096).astype(np.float32)
+        )
+        ceil = np.asarray(_bf16_ceil(x), np.float32)
+        floor = np.asarray(_bf16_floor(x), np.float32)
+        xs = np.asarray(x)
+        assert (ceil >= xs).all()
+        assert (floor <= xs).all()
+        # within one bf16 ulp (relative 2^-7 at bf16 precision)
+        assert (ceil - xs <= np.maximum(xs, 1.0) * 2**-7 + 1e-30).all()
+        assert (xs - floor <= np.maximum(xs, 1.0) * 2**-7 + 1e-30).all()
+
+    def test_exact_values_pass_through(self):
+        # bf16-representable values: small ints and power-of-two scales
+        exact = jnp.asarray(
+            [0.0, 1.0, 2.0, 100.0, 128.0, 250.0, 256.0, 4096.0, 2.0**20],
+            jnp.float32,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(_bf16_ceil(exact), np.float32), np.asarray(exact)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(_bf16_floor(exact), np.float32), np.asarray(exact)
+        )
+
+
+class TestOneSidedVerdict:
+    def test_never_over_admits(self):
+        """Property: bf16 fit ⟹ f32 fit, across random request/free pairs
+        engineered to straddle rounding boundaries."""
+        rng = np.random.default_rng(1)
+        req = rng.uniform(0, 10000, (512, 6)).astype(np.float32)
+        free = req * rng.uniform(0.98, 1.02, (512, 6)).astype(np.float32)
+        req_b, free_b = bf16_compare_operands(
+            jnp.asarray(req), jnp.asarray(free)
+        )
+        bf16_fits = np.asarray((req_b <= free_b).all(axis=-1))
+        f32_fits = (req <= free).all(axis=-1)
+        assert (~bf16_fits | f32_fits).all()  # bf16 ⟹ f32
+
+    def test_useful_on_realistic_margins(self):
+        """Fits with ≥1% headroom (the normal case — schedulers rarely pack
+        to the last byte) all survive bf16 quantization (ulp = 2^-8 rel)."""
+        rng = np.random.default_rng(2)
+        req = rng.uniform(0, 10000, (512, 6)).astype(np.float32)
+        free = req * 1.01
+        req_b, free_b = bf16_compare_operands(
+            jnp.asarray(req), jnp.asarray(free)
+        )
+        assert np.asarray((req_b <= free_b).all(axis=-1)).all()
+
+    def test_fit_matrix_parity_on_typical_shapes(self):
+        """Typical cluster quantities (power-of-two memory, round
+        millicores) are bf16-exact → identical verdicts."""
+        nodes = [
+            build_test_node(f"n{i}", cpu_m=8000, mem=32 * GB) for i in range(4)
+        ]
+        pods = [
+            build_test_pod(f"p{i}", cpu_m=250 * (1 + i % 3), mem=512 * MB)
+            for i in range(16)
+        ]
+        t, _ = pack(nodes, pods)
+        f32 = np.asarray(fit_matrix(t, precision="f32"))
+        b16 = np.asarray(fit_matrix(t, precision="bf16"))
+        np.testing.assert_array_equal(b16, f32)
+
+    def test_fit_matrix_bf16_is_subset_on_adversarial_shapes(self):
+        """Odd quantities (non-representable) may under-admit but never
+        over-admit."""
+        nodes = [build_test_node(f"n{i}", cpu_m=7777, mem=31 * GB + 123457)
+                 for i in range(3)]
+        pods = [build_test_pod(f"p{i}", cpu_m=7777 - i, mem=3 * GB + i * 7)
+                for i in range(32)]
+        t, _ = pack(nodes, pods)
+        f32 = np.asarray(fit_matrix(t, precision="f32"))
+        b16 = np.asarray(fit_matrix(t, precision="bf16"))
+        assert (~b16 | f32).all()
+
+    def test_unknown_precision_rejected(self):
+        t, _ = pack([build_test_node("n")], [build_test_pod("p")])
+        with pytest.raises(ValueError):
+            fit_matrix(t, precision="f16")

@@ -282,6 +282,7 @@ class TestAdmissionServer:
         assert hook["clientConfig"]["service"] == {
             "namespace": "kube-system",
             "name": "vpa-webhook",
+            "path": "/mutate",
         }
         by_url = webhook_configuration(bundle, url="https://127.0.0.1:8443/mutate")
         assert by_url["webhooks"][0]["clientConfig"]["url"].endswith("/mutate")

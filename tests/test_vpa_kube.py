@@ -262,6 +262,8 @@ class TestVpaRunnerOverHttp:
         stored = srv.webhooks["vpa-webhook-config"]
         ca1 = stored["webhooks"][0]["clientConfig"]["caBundle"]
         assert base64.b64decode(ca1) == b1.ca_cert_pem
+        # the apiserver must dispatch to the path the server mutates on
+        assert stored["webhooks"][0]["clientConfig"]["service"]["path"] == "/mutate"
         # a restarted process mints a new CA; re-registration must replace it
         b2 = generate_certs()
         register_webhook(client, webhook_configuration(b2))
