@@ -77,8 +77,13 @@ class TestNodeGroup(NodeGroup):
             raise NodeGroupError(
                 f"size increase too large: {self._target}+{delta} > max {self._max}"
             )
-        self._target += delta
+        # callback FIRST: a raising on_scale_up simulates the cloud rejecting
+        # the request, and a rejected IncreaseSize must not advance the
+        # target — otherwise fault-injection tests "deny" capacity that the
+        # fake then quietly provisions anyway (reference OnScaleUpFunc,
+        # test_cloud_provider.go:34-46, runs before the size bump too)
         self._provider._on_scale_up(self._name, delta)
+        self._target += delta
 
     def delete_nodes(self, nodes: Sequence[Node]) -> None:
         ids = {i.id for i in self._provider._instances.get(self._name, [])}

@@ -162,6 +162,24 @@ def check_invariants(provider, api, seed, loop, started_above_floor, pod_specs):
     )
 
 
+def assert_progress(provider, api, ctx):
+    """Pending pods that fit a template of a group with headroom must have
+    scheduled by now (progress, not just safety). Groups at max are excused."""
+    for p in api.list_pods():
+        if p.node_name or not p.name.startswith("pend"):
+            continue
+        fits = any(
+            p.requests.cpu_m <= g.template_node_info().allocatable.cpu_m
+            and p.requests.memory <= g.template_node_info().allocatable.memory
+            and g.target_size() < g.max_size()
+            for g in provider.node_groups()
+        )
+        assert not fits, (
+            f"{ctx}: pod {p.key()} fits a template with headroom "
+            "but never scheduled"
+        )
+
+
 @pytest.mark.parametrize("seed", range(8))
 def test_soak_random_worlds(seed):
     rng = np.random.default_rng(seed)
@@ -183,21 +201,75 @@ def test_soak_random_worlds(seed):
         check_invariants(provider, api, seed, loop, started_above_floor, pod_specs)
         now += 30.0
     # progress: pending pods that fit somewhere must eventually schedule
-    # (groups may cap out; only assert when headroom remained)
-    headroom = any(
-        g.target_size() < g.max_size() for g in provider.node_groups()
+    assert_progress(provider, api, f"seed={seed}")
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_soak_with_chaos(seed):
+    """The same worlds under fault injection: flaky cloud scale-ups (the
+    provider rejects IncreaseSize without advancing its target), transient
+    eviction failures, and a node flipping unready for a loop. Invariants
+    must hold THROUGH the chaos, the failing groups must be marked unsafe
+    (backoff engaged, clusterstate.go:268-288), and once the faults stop
+    the system must resume making progress (faults injected via
+    TestCloudProvider callbacks exactly like test_cloud_provider.go:34-46)."""
+    from autoscaler_tpu.cloudprovider.interface import NodeGroupError
+
+    rng = np.random.default_rng(1000 + seed)
+    provider, api, autoscaler = build_world(rng)
+    started_above_floor = (
+        sum(n.allocatable.cpu_m for n in api.list_nodes()) >= 2000.0
+        and sum(n.allocatable.memory for n in api.list_nodes()) >= 4 * GB
     )
-    still_pending = [
-        p for p in api.list_pods() if not p.node_name and p.name.startswith("pend")
-    ]
-    if headroom:
-        # every remaining pending pod must be bigger than every template
-        for p in still_pending:
-            fits_somewhere = any(
-                p.requests.cpu_m <= g.template_node_info().allocatable.cpu_m
-                and p.requests.memory <= g.template_node_info().allocatable.memory
-                for g in provider.node_groups()
-            )
-            assert not fits_somewhere, (
-                f"seed={seed}: pod {p.name} fits a template but never scheduled"
-            )
+
+    chaos_on = True
+    failed_gids = set()
+
+    def flaky_scale_up(gid, delta):
+        if chaos_on and rng.random() < 0.6:
+            failed_gids.add(gid)
+            raise NodeGroupError(f"cloud rejects +{delta} for {gid}")
+
+    provider.on_scale_up = flaky_scale_up
+    pod_specs = {}
+    unready_node = None
+    now = 0.0
+    for loop in range(10):
+        if loop == 5:
+            chaos_on = False  # faults stop; backoff must recover
+        pod_specs.update(
+            {p.key(): (p.restartable, p.mirror) for p in api.list_pods()}
+        )
+        if unready_node is not None and unready_node in api.nodes:
+            api.nodes[unready_node].ready = True  # recovered this loop
+            unready_node = None
+        if chaos_on:
+            # transient eviction failures on a random slice of running pods
+            for p in api.list_pods():
+                if p.node_name and rng.random() < 0.1:
+                    api.eviction_failures[p.key()] = 1
+            # one node flips unready for a loop (kubelet hiccup)
+            names = [n.name for n in api.list_nodes()]
+            if names and rng.random() < 0.5:
+                unready_node = names[int(rng.integers(0, len(names)))]
+                api.nodes[unready_node].ready = False
+        autoscaler.run_once(now_ts=now)
+        settle(provider, api, rng)
+        check_invariants(provider, api, seed, loop, started_above_floor, pod_specs)
+        # a failed scale-up marks its group unsafe until backoff expires —
+        # the meaningful "backoff engaged" check (registry.py:354)
+        if chaos_on:
+            for gid in failed_gids:
+                assert not autoscaler.csr.is_node_group_safe_to_scale_up(
+                    gid, now_ts=now
+                ), f"seed={seed} loop={loop}: {gid} failed but not backed off"
+        now += 30.0
+    if failed_gids:
+        assert autoscaler.csr.scale_up_failures  # bookkeeping recorded
+    # recovery: with chaos off and backoff windows expired, pending pods
+    # that fit a template and have group headroom eventually schedule
+    for _ in range(4):
+        now += 400.0  # jump past backoff windows
+        autoscaler.run_once(now_ts=now)
+        settle(provider, api, rng)
+    assert_progress(provider, api, f"seed={seed} post-chaos")
