@@ -69,6 +69,9 @@ class AutoscalingOptions:
     # name of the live priority ConfigMap in config_namespace ("" = off);
     # the reference's default is cluster-autoscaler-priority-expander
     priority_config_map: str = ""
+    # external gRPC expander target (reference --grpc-expander-url) for the
+    # "grpc" entry of the expander chain
+    grpc_expander_url: str = ""
     max_nodes_per_scaleup: int = 1000             # main.go:215
     max_nodegroup_binpacking_duration_s: float = 10.0  # main.go:216
     balance_similar_node_groups: bool = False

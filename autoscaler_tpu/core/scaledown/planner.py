@@ -12,6 +12,7 @@ loop.
 """
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -62,7 +63,24 @@ class ScaleDownPlanner:
             options.unremovable_node_recheck_timeout_s
         )
         self.deletion_tracker = deletion_tracker or NodeDeletionTracker()
-        self.simulator = removal_simulator or RemovalSimulator()
+        if removal_simulator is None:
+            from autoscaler_tpu.simulator.drain import DrainabilityRules
+
+            # drain policy knobs flow from options (they were silently
+            # defaulted before — --skip-nodes-with-* and --min-replica-count
+            # had no effect on the default path)
+            removal_simulator = RemovalSimulator(
+                rules=DrainabilityRules(
+                    skip_nodes_with_system_pods=options.skip_nodes_with_system_pods,
+                    skip_nodes_with_local_storage=options.skip_nodes_with_local_storage,
+                    skip_nodes_with_custom_controller_pods=(
+                        options.skip_nodes_with_custom_controller_pods
+                    ),
+                    min_replica_count=options.min_replica_count,
+                )
+            )
+        self.simulator = removal_simulator
+        self._adaptive_candidate_limit: Optional[int] = None
         self.limits_finder = LimitsFinder(build_resource_limiter(options, provider))
         self.set_processor = set_processor
         self.usage_tracker = UsageTracker()
@@ -93,10 +111,33 @@ class ScaleDownPlanner:
         limit = self.options.scale_down_non_empty_candidates_count
         if limit > 0:
             non_empty = non_empty[:limit]
+        # ScaleDownSimulationTimeout (planner.go:262-272) adapted to the
+        # batched dispatch: one device call can't stop mid-way, so the bound
+        # is enforced across loops — a dispatch that blows the budget halves
+        # the next loop's candidate width (AIMD), growing back while under
+        # half-budget. 0 disables.
+        if self._adaptive_candidate_limit is not None:
+            non_empty = non_empty[: self._adaptive_candidate_limit]
 
+        sim_start = time.monotonic()
         to_remove, not_removable = self.simulator.find_nodes_to_remove(
             snapshot, non_empty, pdbs
         )
+        sim_s = time.monotonic() - sim_start
+        budget = self.options.scale_down_simulation_timeout_s
+        if budget > 0:
+            if non_empty and sim_s > budget and len(non_empty) > 1:
+                self._adaptive_candidate_limit = max(1, len(non_empty) // 2)
+            elif self._adaptive_candidate_limit is not None and (
+                not non_empty or sim_s < budget / 2
+            ):
+                # decay the clamp on fast dispatches AND on loops with no
+                # non-empty candidates — a clamp from one past slow dispatch
+                # must not throttle scale-down indefinitely
+                widened = self._adaptive_candidate_limit * 2
+                self._adaptive_candidate_limit = (
+                    None if widened >= max(len(pool), 1) else widened
+                )
         # remember the simulated moves so an actual deletion later can reset
         # the unneeded clocks of its destination nodes (simulator/tracker.go)
         for r in to_remove:

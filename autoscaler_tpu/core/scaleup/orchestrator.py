@@ -68,6 +68,7 @@ class ScaleUpOrchestrator:
             priorities=options.expander_priorities,
             priorities_path=options.priority_config_file or None,
             priorities_fetch=priorities_fetch,
+            grpc_target=options.grpc_expander_url or None,
         )
         self.resource_manager = ScaleUpResourceManager(provider.get_resource_limiter())
         self.balancing_processor = balancing_processor

@@ -159,6 +159,10 @@ def build_strategy(names: Sequence[str], seed: Optional[int] = None, **kwargs) -
         elif name == GRPC:
             from autoscaler_tpu.expander.grpc_ import GRPCFilter
 
+            if not kwargs.get("grpc_target"):
+                raise ValueError(
+                    "expander 'grpc' needs a target (--grpc-expander-url)"
+                )
             filters.append(GRPCFilter(kwargs["grpc_target"]))
         else:
             raise ValueError(f"unknown expander {name!r}")

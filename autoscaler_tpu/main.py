@@ -118,6 +118,29 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="control plane binding: 'in-cluster', or an API "
                         "server URL (empty with --provider=test uses the "
                         "in-memory fake)")
+    p.add_argument("--max-drain-parallelism", type=int, default=1,
+                   help="concurrent node drains (actuator worker pool)")
+    p.add_argument("--max-scale-down-parallelism", type=int, default=10)
+    p.add_argument("--scale-down-simulation-timeout", type=float, default=30.0)
+    p.add_argument("--max-pod-eviction-time", type=float, default=120.0)
+    p.add_argument("--max-bulk-soft-taint-count", type=int, default=10)
+    p.add_argument("--max-bulk-soft-taint-time", type=float, default=3.0)
+    p.add_argument("--unremovable-node-recheck-timeout", type=float, default=300.0)
+    p.add_argument("--node-deletion-batcher-interval", type=float, default=0.0,
+                   help="0 = flush per add (reference default)")
+    p.add_argument("--skip-nodes-with-system-pods", type=_bool_flag, default=True)
+    p.add_argument("--skip-nodes-with-local-storage", type=_bool_flag, default=True)
+    p.add_argument("--skip-nodes-with-custom-controller-pods",
+                   type=_bool_flag, default=True)
+    p.add_argument("--min-replica-count", type=int, default=0)
+    p.add_argument("--ignore-mirror-pods-utilization", action="store_true")
+    p.add_argument("--scale-up-from-zero", type=_bool_flag, default=True)
+    p.add_argument("--node-autoprovisioning-enabled", action="store_true")
+    p.add_argument("--max-autoprovisioned-node-group-count", type=int, default=15)
+    p.add_argument("--emit-per-nodegroup-metrics", action="store_true")
+    p.add_argument("--user-agent", default="tpu-autoscaler")
+    p.add_argument("--grpc-expander-url", default="",
+                   help="external gRPC expander target (expander grpc in chain)")
     p.add_argument("--cluster-name", default="")
     p.add_argument("--namespace", default="kube-system")
     p.add_argument("--status-config-map-name", default="cluster-autoscaler-status")
@@ -176,6 +199,29 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         config_namespace=args.namespace,
         status_config_map_name=args.status_config_map_name,
         write_status_configmap=args.write_status_configmap,
+        max_drain_parallelism=args.max_drain_parallelism,
+        max_scale_down_parallelism=args.max_scale_down_parallelism,
+        scale_down_simulation_timeout_s=args.scale_down_simulation_timeout,
+        max_pod_eviction_time_s=args.max_pod_eviction_time,
+        max_bulk_soft_taint_count=args.max_bulk_soft_taint_count,
+        max_bulk_soft_taint_time_s=args.max_bulk_soft_taint_time,
+        unremovable_node_recheck_timeout_s=args.unremovable_node_recheck_timeout,
+        node_deletion_batcher_interval_s=args.node_deletion_batcher_interval,
+        skip_nodes_with_system_pods=args.skip_nodes_with_system_pods,
+        skip_nodes_with_local_storage=args.skip_nodes_with_local_storage,
+        skip_nodes_with_custom_controller_pods=(
+            args.skip_nodes_with_custom_controller_pods
+        ),
+        min_replica_count=args.min_replica_count,
+        ignore_mirror_pods_utilization=args.ignore_mirror_pods_utilization,
+        scale_up_from_zero=args.scale_up_from_zero,
+        node_autoprovisioning_enabled=args.node_autoprovisioning_enabled,
+        max_autoprovisioned_node_group_count=(
+            args.max_autoprovisioned_node_group_count
+        ),
+        record_per_node_group_metrics=args.emit_per_nodegroup_metrics,
+        user_agent=args.user_agent,
+        grpc_expander_url=args.grpc_expander_url,
     )
     opts.node_group_defaults.scale_down_unneeded_time_s = args.scale_down_unneeded_time
     opts.node_group_defaults.scale_down_unready_time_s = args.scale_down_unready_time

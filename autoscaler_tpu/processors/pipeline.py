@@ -146,6 +146,23 @@ class ActionableClusterProcessor:
         return True
 
 
+class EmptyClusterProcessor(ActionableClusterProcessor):
+    """The reference's EmptyClusterProcessor
+    (actionablecluster/actionable_cluster_processor.go:40): with
+    scale-up-from-zero disabled, a cluster with no nodes — or none ready —
+    is not actionable, so the autoscaler must not scale it from nothing."""
+
+    def __init__(self, scale_up_from_zero: bool = True):
+        self.scale_up_from_zero = scale_up_from_zero
+
+    def should_autoscale(self, nodes: Sequence[Node], now_ts: float) -> bool:
+        if self.scale_up_from_zero:
+            return True
+        if not nodes:
+            return False
+        return any(n.ready for n in nodes)
+
+
 class NodeInfoProcessor:
     """reference processors/nodeinfos/NodeInfoProcessor — post-process the
     template NodeInfos before estimation. Default: identity."""
@@ -293,4 +310,14 @@ def default_processors(options=None) -> AutoscalingProcessors:
         procs.template_node_info_provider = MixedTemplateNodeInfoProvider(
             ignored_taints=options.ignored_taints
         )
+        procs.actionable_cluster = EmptyClusterProcessor(
+            scale_up_from_zero=options.scale_up_from_zero
+        )
+        procs.node_group_manager = NodeGroupManager(
+            max_autoprovisioned=options.max_autoprovisioned_node_group_count
+        )
+        # NOTE: AutoprovisioningNodeGroupListProcessor needs a provider-
+        # specific group factory, so embedders construct it themselves —
+        # pass options.max_autoprovisioned_node_group_count as its
+        # max_autoprovisioned_groups to keep the two caps consistent.
     return procs

@@ -30,6 +30,7 @@ class BlockingReason(enum.Enum):
     NOT_SAFE_TO_EVICT_ANNOTATION = "NotSafeToEvictAnnotation"
     UNMOVABLE_KUBE_SYSTEM_POD = "UnmovableKubeSystemPod"
     NOT_ENOUGH_PDB = "NotEnoughPdb"
+    MIN_REPLICAS_REACHED = "MinReplicasReached"
 
 
 @dataclass
@@ -45,6 +46,11 @@ class DrainabilityRules:
     skip_nodes_with_system_pods: bool = True
     skip_nodes_with_local_storage: bool = True
     skip_nodes_with_custom_controller_pods: bool = True
+    # a replicated pod whose controller runs fewer than this many replicas
+    # blocks drain (reference drain.go:131 MinReplicasReached; replica count
+    # approximated by the controller's live pod count, supplied by the
+    # caller via owner_replica_counts)
+    min_replica_count: int = 0
 
 
 def _safe_to_evict(pod: Pod) -> Optional[bool]:
@@ -54,10 +60,29 @@ def _safe_to_evict(pod: Pod) -> Optional[bool]:
     return v.lower() == "true"
 
 
+def owner_key(pod: Pod) -> Optional[Tuple[str, str, str]]:
+    """(namespace, kind, name) of the pod's controller, or None."""
+    if pod.owner_ref is None:
+        return None
+    return (pod.namespace, pod.owner_ref.kind, pod.owner_ref.name)
+
+
+def count_owner_replicas(all_pods: Sequence[Pod]) -> dict:
+    """controller → live pod count, the replica proxy for the MinReplicas
+    drain rule (built once per loop from the full pod list)."""
+    counts: dict = {}
+    for p in all_pods:
+        k = owner_key(p)
+        if k is not None:
+            counts[k] = counts.get(k, 0) + 1
+    return counts
+
+
 def get_pods_for_deletion_on_node_drain(
     pods: Sequence[Pod],
     rules: DrainabilityRules,
     pdbs: Sequence[PodDisruptionBudget] = (),
+    owner_replica_counts: Optional[dict] = None,
 ) -> Tuple[List[Pod], Optional[BlockingPod]]:
     """→ (pods_to_move, first_blocking_pod). Mirror pods are ignored entirely;
     DaemonSet pods are not "moved" (they are evicted best-effort at the end of
@@ -79,6 +104,15 @@ def get_pods_for_deletion_on_node_drain(
                     return [], BlockingPod(pod, BlockingReason.NOT_REPLICATED)
             if not pod.restartable:
                 return [], BlockingPod(pod, BlockingReason.CONTROLLER_NOT_FOUND)
+            if rules.min_replica_count > 0 and owner_replica_counts is not None:
+                k = owner_key(pod)
+                if (
+                    k is not None
+                    and owner_replica_counts.get(k, 0) < rules.min_replica_count
+                ):
+                    return [], BlockingPod(
+                        pod, BlockingReason.MIN_REPLICAS_REACHED
+                    )
             if rules.skip_nodes_with_local_storage and pod.local_storage:
                 return [], BlockingPod(pod, BlockingReason.LOCAL_STORAGE_REQUESTED)
             if rules.skip_nodes_with_system_pods and pod.namespace == "kube-system":
@@ -116,9 +150,12 @@ def get_pods_to_move(
     pods_on_node: Sequence[Pod],
     rules: DrainabilityRules,
     pdbs: Sequence[PodDisruptionBudget] = (),
+    owner_replica_counts: Optional[dict] = None,
 ) -> Tuple[List[Pod], Optional[BlockingPod]]:
     """Full GetPodsToMove: drain policy then PDB check (simulator/drain.go:50)."""
-    to_move, blocking = get_pods_for_deletion_on_node_drain(pods_on_node, rules, pdbs)
+    to_move, blocking = get_pods_for_deletion_on_node_drain(
+        pods_on_node, rules, pdbs, owner_replica_counts
+    )
     if blocking is not None:
         return [], blocking
     pdb_block = check_pdbs(to_move, pdbs)

@@ -106,11 +106,20 @@ class RemovalSimulator:
         movable_pods: Dict[str, List[Pod]] = {}
         ds_pods: Dict[str, List[Pod]] = {}
 
+        # controller → live replica count, the MinReplicas drain-rule input
+        # (built once per dispatch; None disables the check)
+        owner_counts = None
+        if self.rules.min_replica_count > 0:
+            from autoscaler_tpu.simulator.drain import count_owner_replicas
+
+            owner_counts = count_owner_replicas(snapshot.pods())
         for ci, name in enumerate(cand_names):
             cand_idx[ci] = meta.node_index[name]
             pods_on = snapshot.pods_on_node(name)
             ds_pods[name] = daemonset_pods_of(pods_on)
-            to_move, block = get_pods_to_move(pods_on, self.rules, pdbs)
+            to_move, block = get_pods_to_move(
+                pods_on, self.rules, pdbs, owner_counts
+            )
             if block is not None:
                 blocked[ci] = True
                 blocking[name] = block

@@ -951,3 +951,112 @@ class TestNodeDeleteDelayAfterTaint:
         assert not any(
             t.key == TO_BE_DELETED_TAINT for t in api.nodes[victim.name].taints
         )
+
+
+class TestNewlyWiredKnobs:
+    """--min-replica-count, --scale-down-simulation-timeout, and
+    --scale-up-from-zero were parsed but dead; these pin their behavior."""
+
+    def test_min_replica_count_blocks_drain(self):
+        from autoscaler_tpu.simulator.drain import (
+            BlockingReason,
+            DrainabilityRules,
+            count_owner_replicas,
+            get_pods_to_move,
+        )
+        from autoscaler_tpu.kube.objects import OwnerRef
+
+        pods = []
+        for i in range(2):  # controller with only 2 live replicas
+            p = build_test_pod(f"small-{i}", cpu_m=100, mem=256 * 1024 * 1024,
+                               node_name="n0")
+            p.owner_ref = OwnerRef(kind="ReplicaSet", name="small-rs")
+            pods.append(p)
+        counts = count_owner_replicas(pods)
+        rules = DrainabilityRules(min_replica_count=3)
+        moved, block = get_pods_to_move(pods[:1], rules, (), counts)
+        assert moved == [] and block.reason == BlockingReason.MIN_REPLICAS_REACHED
+        # with enough replicas the same pod drains
+        rules_ok = DrainabilityRules(min_replica_count=2)
+        moved, block = get_pods_to_move(pods[:1], rules_ok, (), counts)
+        assert block is None and len(moved) == 1
+
+    def test_min_replica_count_flows_from_options(self):
+        opts = AutoscalingOptions(min_replica_count=5)
+        planner = ScaleDownPlanner(TestCloudProvider(), opts)
+        assert planner.simulator.rules.min_replica_count == 5
+        assert planner.simulator.rules.skip_nodes_with_local_storage
+
+    def test_simulation_timeout_halves_candidates(self, monkeypatch):
+        provider = TestCloudProvider()
+        provider.add_node_group("g", 0, 20, 8,
+                                build_test_node("t", cpu_m=4000, mem=8 * GB))
+        snap = ClusterSnapshot()
+        names = []
+        for i in range(8):
+            n = build_test_node(f"n{i}", cpu_m=4000, mem=8 * GB)
+            provider.add_node("g", n)
+            snap.add_node(n)
+            p = build_test_pod(f"p{i}", cpu_m=100, mem=256 * 1024 * 1024)
+            p.owner_ref = OwnerRef(kind="ReplicaSet", name="rs")
+            snap.add_pod(p, n.name)
+            names.append(n.name)
+        opts = AutoscalingOptions(scale_down_simulation_timeout_s=0.001)
+        opts.node_group_defaults.scale_down_utilization_threshold = 0.9
+        planner = ScaleDownPlanner(provider, opts)
+
+        slow = planner.simulator.find_nodes_to_remove
+
+        def slow_sim(*a, **k):
+            import time as _t
+
+            _t.sleep(0.01)  # blow the 1ms budget
+            return slow(*a, **k)
+
+        monkeypatch.setattr(planner.simulator, "find_nodes_to_remove", slow_sim)
+        nodes = snap.nodes()
+        planner.update_cluster_state(snap, nodes, [], now_ts=0.0)
+        first_limit = planner._adaptive_candidate_limit
+        assert first_limit is not None  # budget blown → clamp engaged
+        planner.update_cluster_state(snap, nodes, [], now_ts=30.0)
+        assert planner._adaptive_candidate_limit <= first_limit
+
+    def test_scale_up_from_zero_gate(self):
+        from autoscaler_tpu.processors.pipeline import EmptyClusterProcessor
+
+        gate_on = EmptyClusterProcessor(scale_up_from_zero=True)
+        gate_off = EmptyClusterProcessor(scale_up_from_zero=False)
+        ready = build_test_node("r", cpu_m=1000, mem=GB)
+        unready = build_test_node("u", cpu_m=1000, mem=GB)
+        unready.ready = False
+        assert gate_on.should_autoscale([], now_ts=0.0)
+        assert not gate_off.should_autoscale([], now_ts=0.0)
+        assert not gate_off.should_autoscale([unready], now_ts=0.0)
+        assert gate_off.should_autoscale([ready, unready], now_ts=0.0)
+
+    def test_empty_cluster_gate_blocks_runonce(self):
+        """End to end: scale_up_from_zero=False + empty cluster → the loop
+        aborts before any scale-up despite pending pods."""
+        provider = TestCloudProvider()
+        api = FakeClusterAPI()
+        provider.add_node_group("g", 0, 10, 0,
+                                build_test_node("t", cpu_m=4000, mem=8 * GB))
+        api.add_pod(build_test_pod("p", cpu_m=500, mem=GB))
+        from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+
+        opts = AutoscalingOptions(scale_up_from_zero=False)
+        a = StaticAutoscaler(provider, api, opts)
+        a.run_once(now_ts=0.0)
+        assert provider.scale_up_calls == []
+        # flipping the knob on scales as usual
+        opts2 = AutoscalingOptions(scale_up_from_zero=True)
+        a2 = StaticAutoscaler(provider, api, opts2)
+        a2.run_once(now_ts=0.0)
+        assert provider.scale_up_calls
+
+    def test_nap_cap_flows_from_options(self):
+        from autoscaler_tpu.processors.pipeline import default_processors
+
+        opts = AutoscalingOptions(max_autoprovisioned_node_group_count=3)
+        procs = default_processors(opts)
+        assert procs.node_group_manager.max_autoprovisioned == 3
