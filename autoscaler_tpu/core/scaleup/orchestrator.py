@@ -22,6 +22,7 @@ from autoscaler_tpu.config.options import AutoscalingOptions
 from autoscaler_tpu.core.scaleup.equivalence import build_pod_groups
 from autoscaler_tpu.core.scaleup.resource_manager import ScaleUpResourceManager
 from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+from autoscaler_tpu.estimator.limiter import ThresholdBasedEstimationLimiter
 from autoscaler_tpu.expander.core import Option, Strategy
 from autoscaler_tpu.kube.objects import Node, Pod
 
@@ -62,7 +63,14 @@ class ScaleUpOrchestrator:
         self.provider = provider
         self.options = options
         self.csr = csr
-        self.estimator = estimator or BinpackingNodeEstimator()
+        if estimator is None:
+            estimator = BinpackingNodeEstimator(
+                limiter=ThresholdBasedEstimationLimiter(
+                    max_nodes=options.max_nodes_per_scaleup,
+                    max_duration_s=options.max_nodegroup_binpacking_duration_s,
+                )
+            )
+        self.estimator = estimator
         self.expander = expander or build_strategy(
             [n.strip() for n in options.expander.split(",") if n.strip()],
             priorities=options.expander_priorities,

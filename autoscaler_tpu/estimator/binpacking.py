@@ -123,6 +123,34 @@ class BinpackingNodeEstimator:
         """
         if not pods or not templates:
             return {g: (0, []) for g in templates}
+        import time as _time
+
+        t0 = _time.monotonic()
+        result = self._estimate_many_inner(pods, templates, headrooms, pod_groups)
+        elapsed = _time.monotonic() - t0
+        # the reference budgets max_duration_s PER GROUP (threshold_based_
+        # limiter.go); the batched dispatch covers every group at once, so
+        # the comparable budget is per-group × groups. Exceeding it is a
+        # loud signal (likely interpret-mode or a pathological shape), not
+        # an abort — the dispatch already ran.
+        budget = self.limiter.max_duration_s * max(len(templates), 1)
+        if self.limiter.max_duration_s > 0 and elapsed > budget:
+            import logging
+
+            logging.getLogger("estimator").warning(
+                "binpacking dispatch took %.2fs for %d groups — over the "
+                "%.1fs budget (--max-nodegroup-binpacking-duration)",
+                elapsed, len(templates), budget,
+            )
+        return result
+
+    def _estimate_many_inner(
+        self,
+        pods: Sequence[Pod],
+        templates: Dict[str, Node],
+        headrooms: Optional[Dict[str, int]] = None,
+        pod_groups=None,
+    ) -> Dict[str, Tuple[int, List[Pod]]]:
         names = sorted(templates)
         dynamic_affinity = has_interpod_affinity(pods)
         groups = pod_groups if pod_groups is not None else build_pod_groups(pods)

@@ -139,6 +139,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-autoprovisioned-node-group-count", type=int, default=15)
     p.add_argument("--emit-per-nodegroup-metrics", action="store_true")
     p.add_argument("--user-agent", default="tpu-autoscaler")
+    p.add_argument("--daemonset-eviction-for-empty-nodes",
+                   type=_bool_flag, default=False)
+    p.add_argument("--daemonset-eviction-for-occupied-nodes",
+                   type=_bool_flag, default=True)
+    p.add_argument("--max-nodegroup-binpacking-duration", type=float,
+                   default=10.0, help="per-group estimate budget (main.go:216)")
+    p.add_argument("--node-info-cache-expire-time", type=float, default=60.0,
+                   help="template NodeInfo cache TTL seconds")
+    p.add_argument("--debugging-snapshot-enabled", type=_bool_flag, default=True,
+                   help="serve /snapshotz captures")
     p.add_argument("--grpc-expander-url", default="",
                    help="external gRPC expander target (expander grpc in chain)")
     p.add_argument("--cluster-name", default="")
@@ -222,6 +232,17 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         record_per_node_group_metrics=args.emit_per_nodegroup_metrics,
         user_agent=args.user_agent,
         grpc_expander_url=args.grpc_expander_url,
+        daemonset_eviction_for_empty_nodes=(
+            args.daemonset_eviction_for_empty_nodes
+        ),
+        daemonset_eviction_for_occupied_nodes=(
+            args.daemonset_eviction_for_occupied_nodes
+        ),
+        max_nodegroup_binpacking_duration_s=(
+            args.max_nodegroup_binpacking_duration
+        ),
+        node_info_cache_expire_time_s=args.node_info_cache_expire_time,
+        debugging_snapshot_enabled=args.debugging_snapshot_enabled,
     )
     opts.node_group_defaults.scale_down_unneeded_time_s = args.scale_down_unneeded_time
     opts.node_group_defaults.scale_down_unready_time_s = args.scale_down_unready_time
@@ -463,7 +484,8 @@ def main(argv=None) -> int:
         api = FakeClusterAPI()
 
     autoscaler = StaticAutoscaler(
-        provider, api, opts, debugger=DebuggingSnapshotter()
+        provider, api, opts,
+        debugger=DebuggingSnapshotter() if opts.debugging_snapshot_enabled else None,
     )
     server = ObservabilityServer(autoscaler, args.address, profiling=args.profiling)
     port = server.start()

@@ -308,7 +308,8 @@ def default_processors(options=None) -> AutoscalingProcessors:
             | set(options.balancing_extra_ignored_labels),
         )
         procs.template_node_info_provider = MixedTemplateNodeInfoProvider(
-            ignored_taints=options.ignored_taints
+            ttl_s=options.node_info_cache_expire_time_s,
+            ignored_taints=options.ignored_taints,
         )
         procs.actionable_cluster = EmptyClusterProcessor(
             scale_up_from_zero=options.scale_up_from_zero

@@ -422,3 +422,31 @@ class TestStatusOnDegradedPaths:
         a.run_once(now_ts=0.0)
         status = a.api.configmaps[("kube-system", "cluster-autoscaler-status")]["status"]
         assert "[prod-west]" in status
+
+
+class TestRound2KnobWiring:
+    def test_remaining_flags_reach_components(self):
+        from autoscaler_tpu.core.scaleup.orchestrator import ScaleUpOrchestrator
+        from autoscaler_tpu.clusterstate.registry import ClusterStateRegistry
+        from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+        from autoscaler_tpu.main import build_arg_parser, options_from_args
+        from autoscaler_tpu.processors.pipeline import default_processors
+
+        args = build_arg_parser().parse_args([
+            "--max-nodegroup-binpacking-duration", "5",
+            "--max-nodes-per-scaleup", "77",
+            "--node-info-cache-expire-time", "123",
+            "--debugging-snapshot-enabled", "false",
+            "--daemonset-eviction-for-empty-nodes", "true",
+        ])
+        opts = options_from_args(args)
+        assert opts.daemonset_eviction_for_empty_nodes is True
+        assert opts.debugging_snapshot_enabled is False
+        provider = TestCloudProvider()
+        orch = ScaleUpOrchestrator(
+            provider, opts, ClusterStateRegistry(provider, opts)
+        )
+        assert orch.estimator.limiter.max_nodes == 77
+        assert orch.estimator.limiter.max_duration_s == 5.0
+        procs = default_processors(opts)
+        assert procs.template_node_info_provider.ttl_s == 123.0
