@@ -13,6 +13,9 @@ every node group, replacing the reference's serial group loop.
 """
 from __future__ import annotations
 
+import logging
+import time
+
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -123,20 +126,16 @@ class BinpackingNodeEstimator:
         """
         if not pods or not templates:
             return {g: (0, []) for g in templates}
-        import time as _time
-
-        t0 = _time.monotonic()
+        t0 = time.monotonic()
         result = self._estimate_many_inner(pods, templates, headrooms, pod_groups)
-        elapsed = _time.monotonic() - t0
+        elapsed = time.monotonic() - t0
         # the reference budgets max_duration_s PER GROUP (threshold_based_
         # limiter.go); the batched dispatch covers every group at once, so
         # the comparable budget is per-group × groups. Exceeding it is a
         # loud signal (likely interpret-mode or a pathological shape), not
         # an abort — the dispatch already ran.
-        budget = self.limiter.max_duration_s * max(len(templates), 1)
+        budget = self.limiter.max_duration_s * len(templates)
         if self.limiter.max_duration_s > 0 and elapsed > budget:
-            import logging
-
             logging.getLogger("estimator").warning(
                 "binpacking dispatch took %.2fs for %d groups — over the "
                 "%.1fs budget (--max-nodegroup-binpacking-duration)",

@@ -37,6 +37,35 @@ class ApiError(Exception):
         self.status = status
 
 
+class _TokenBucket:
+    """client-go-style flow control (reference --kube-api-qps/--kube-client-
+    burst): up to `burst` requests instantly, refilled at `qps`; callers
+    block until a token is available. qps <= 0 disables."""
+
+    def __init__(self, qps: float, burst: int):
+        self.qps = qps
+        self.burst = max(burst, 1)
+        self._tokens = float(self.burst)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def acquire(self) -> None:
+        if self.qps <= 0:
+            return
+        while True:
+            with self._lock:
+                now = time.monotonic()
+                self._tokens = min(
+                    self.burst, self._tokens + (now - self._last) * self.qps
+                )
+                self._last = now
+                if self._tokens >= 1.0:
+                    self._tokens -= 1.0
+                    return
+                wait = (1.0 - self._tokens) / self.qps
+            time.sleep(wait)
+
+
 class KubeRestClient:
     """Minimal Kubernetes REST transport (GET/POST/PATCH/PUT/DELETE + watch)."""
 
@@ -48,11 +77,14 @@ class KubeRestClient:
         verify: bool = True,
         timeout_s: float = 30.0,
         user_agent: str = "tpu-autoscaler",
+        qps: float = 0.0,
+        burst: int = 10,
     ):
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout_s = timeout_s
         self.user_agent = user_agent
+        self._limiter = _TokenBucket(qps, burst)
         if self.base_url.startswith("https"):
             ctx = ssl.create_default_context(cafile=ca_file)
             if not verify:
@@ -63,7 +95,9 @@ class KubeRestClient:
             self._ctx = None
 
     @staticmethod
-    def in_cluster(user_agent: str = "tpu-autoscaler") -> "KubeRestClient":
+    def in_cluster(
+        user_agent: str = "tpu-autoscaler", qps: float = 0.0, burst: int = 10
+    ) -> "KubeRestClient":
         """Service-account config, like rest.InClusterConfig."""
         import os
 
@@ -73,7 +107,7 @@ class KubeRestClient:
             token = f.read().strip()
         return KubeRestClient(
             f"https://{host}:{port}", token=token, ca_file=SA_CA_PATH,
-            user_agent=user_agent,
+            user_agent=user_agent, qps=qps, burst=burst,
         )
 
     def _request(
@@ -85,6 +119,7 @@ class KubeRestClient:
         stream: bool = False,
         timeout_s: Optional[float] = None,
     ):
+        self._limiter.acquire()
         headers = {"User-Agent": self.user_agent}
         if body is not None:
             headers["Content-Type"] = content_type

@@ -139,6 +139,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-autoprovisioned-node-group-count", type=int, default=15)
     p.add_argument("--emit-per-nodegroup-metrics", action="store_true")
     p.add_argument("--user-agent", default="tpu-autoscaler")
+    p.add_argument("--kube-client-qps", type=float, default=5.0,
+                   help="client-side request rate limit (0 disables)")
+    p.add_argument("--kube-client-burst", type=int, default=10)
     p.add_argument("--daemonset-eviction-for-empty-nodes",
                    type=_bool_flag, default=False)
     p.add_argument("--daemonset-eviction-for-occupied-nodes",
@@ -474,9 +477,15 @@ def main(argv=None) -> int:
         from autoscaler_tpu.kube.client import KubeClusterAPI, KubeRestClient
 
         if args.kube_api == "in-cluster":
-            client = KubeRestClient.in_cluster(user_agent=opts.user_agent)
+            client = KubeRestClient.in_cluster(
+                user_agent=opts.user_agent,
+                qps=args.kube_client_qps, burst=args.kube_client_burst,
+            )
         else:
-            client = KubeRestClient(args.kube_api, user_agent=opts.user_agent)
+            client = KubeRestClient(
+                args.kube_api, user_agent=opts.user_agent,
+                qps=args.kube_client_qps, burst=args.kube_client_burst,
+            )
         api = KubeClusterAPI(client, watch=True)
     else:
         from autoscaler_tpu.kube.api import FakeClusterAPI

@@ -549,6 +549,39 @@ class TestKubeClusterAPI:
         api.remove_taint("n1", TO_BE_DELETED_TAINT)
         assert api_server.nodes["n1"]["spec"]["taints"] == []
 
+    def test_client_side_rate_limit(self, api_server):
+        """--kube-client-qps/--kube-client-burst: burst tokens pass
+        instantly, the next acquire blocks ~1/qps (client-go flow control).
+        The bucket is timed directly — HTTP roundtrip latency would race
+        the refill on slow workers — plus one wiring check that requests
+        actually pass through the limiter."""
+        import time as _t
+
+        from autoscaler_tpu.kube.client import _TokenBucket
+
+        bucket = _TokenBucket(qps=50.0, burst=2)
+        t0 = _t.monotonic()
+        bucket.acquire()
+        bucket.acquire()              # burst
+        assert _t.monotonic() - t0 < 0.015
+        t0 = _t.monotonic()
+        bucket.acquire()              # must wait ~20ms for a refill
+        assert _t.monotonic() - t0 >= 0.01
+        # disabled limiter never blocks
+        free = _TokenBucket(qps=0.0, burst=1)
+        t0 = _t.monotonic()
+        for _ in range(100):
+            free.acquire()
+        assert _t.monotonic() - t0 < 0.5
+        # wiring: the client consults its limiter on every request
+        api_server.nodes["n1"] = node_json("n1")
+        client = KubeRestClient(api_server.url, qps=50.0, burst=2)
+        acquires = []
+        orig = client._limiter.acquire
+        client._limiter.acquire = lambda: acquires.append(1) or orig()
+        client.get("/api/v1/nodes")
+        assert acquires == [1]
+
     def test_read_configmap_roundtrip(self, api_server):
         api = KubeClusterAPI(KubeRestClient(api_server.url))
         assert api.read_configmap("kube-system", "absent") is None
