@@ -38,8 +38,8 @@ class ApiError(Exception):
 
 
 class _TokenBucket:
-    """client-go-style flow control (reference --kube-api-qps/--kube-client-
-    burst): up to `burst` requests instantly, refilled at `qps`; callers
+    """client-go-style flow control (reference --kube-client-qps/
+    --kube-client-burst): up to `burst` requests instantly, refilled at `qps`; callers
     block until a token is available. qps <= 0 disables."""
 
     def __init__(self, qps: float, burst: int):

@@ -77,12 +77,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--enforce-node-group-min-size", action="store_true")
     p.add_argument("--new-pod-scale-up-delay", type=float, default=0.0)
     p.add_argument("--expendable-pods-priority-cutoff", type=int, default=-10)
-    p.add_argument("--provider", default="test")
+    p.add_argument("--provider", "--cloud-provider", default="test",
+                   help="cloud provider (reference --cloud-provider)")
     p.add_argument("--address", default=":8085", help="observability HTTP bind")
     p.add_argument("--profiling", action="store_true",
                    help="expose /debug/pprof/* (main.go:518-520)")
-    p.add_argument("--health-check-max-inactivity", type=float, default=600.0)
-    p.add_argument("--health-check-max-failing-time", type=float, default=900.0)
+    p.add_argument("--health-check-max-inactivity", "--max-inactivity",
+                   type=float, default=600.0)
+    p.add_argument("--health-check-max-failing-time", "--max-failing-time",
+                   type=float, default=900.0)
     p.add_argument("--max-iterations", type=int, default=0,
                    help="stop after N loops (0 = forever); for testing")
     p.add_argument("--initial-node-group-backoff-duration", type=float, default=300.0)
@@ -114,7 +117,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "re-read per request so an external refresher "
                         "(e.g. a sidecar fetching metadata-server tokens) "
                         "just works; REQUIRED with --provider=gce")
-    p.add_argument("--kube-api", default="",
+    p.add_argument("--kube-api", "--kubernetes", default="",
                    help="control plane binding: 'in-cluster', or an API "
                         "server URL (empty with --provider=test uses the "
                         "in-memory fake)")
@@ -142,6 +145,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--kube-client-qps", type=float, default=5.0,
                    help="client-side request rate limit (0 disables)")
     p.add_argument("--kube-client-burst", type=int, default=10)
+    p.add_argument("--parallel-drain", type=_bool_flag, default=True,
+                   help="accepted for compatibility: the planner here IS "
+                        "the reference's parallel-drain path (no legacy mode)")
     p.add_argument("--daemonset-eviction-for-empty-nodes",
                    type=_bool_flag, default=False)
     p.add_argument("--daemonset-eviction-for-occupied-nodes",
@@ -492,6 +498,12 @@ def main(argv=None) -> int:
 
         api = FakeClusterAPI()
 
+    if not args.parallel_drain:
+        # accepted for reference-command-line compatibility only: the
+        # planner here IS the parallel-drain path; there is no legacy
+        # serial mode to fall back to
+        print("WARNING: --parallel-drain=false is a no-op (the planner is "
+              "always the parallel-drain path)", file=sys.stderr)
     autoscaler = StaticAutoscaler(
         provider, api, opts,
         debugger=DebuggingSnapshotter() if opts.debugging_snapshot_enabled else None,
