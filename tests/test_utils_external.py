@@ -262,3 +262,58 @@ class TestKlogx:
         summaries = [r for r in records if "Skipped" in r]
         assert len(util_lines) == 20
         assert summaries == ["Skipped logging utilization for 10 other nodes"]
+
+
+class TestPollLoop:
+    def test_errors_do_not_kill_the_loop(self):
+        from autoscaler_tpu.utils.poll import poll_loop
+
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("transient")
+
+        rc = poll_loop(flaky, interval_s=0.0, max_iterations=5)
+        assert rc == 0
+        assert len(calls) == 5  # errors logged, loop continued
+
+    def test_keyboard_interrupt_exits_cleanly(self):
+        from autoscaler_tpu.utils.poll import poll_loop
+
+        calls = []
+
+        def interrupt():
+            calls.append(1)
+            if len(calls) > 1:
+                # regression guard: if poll_loop ever swallowed the first
+                # KeyboardInterrupt, fail fast instead of spinning forever
+                pytest.fail("poll_loop swallowed KeyboardInterrupt")
+            raise KeyboardInterrupt
+
+        assert poll_loop(interrupt, interval_s=0.0, max_iterations=3) == 0
+        assert calls == [1]
+
+    def test_drift_compensated_sleep(self, monkeypatch):
+        """A slow tick eats into the sleep instead of stacking on top."""
+        from autoscaler_tpu.utils import poll
+
+        sleeps = []
+        clock = [0.0]
+
+        def fake_monotonic():
+            return clock[0]
+
+        def fake_sleep(s):
+            sleeps.append(s)
+            clock[0] += s
+
+        monkeypatch.setattr(poll.time, "monotonic", fake_monotonic)
+        monkeypatch.setattr(poll.time, "sleep", fake_sleep)
+
+        def tick():
+            clock[0] += 0.3  # fn takes 0.3s of the 1.0s interval
+
+        poll.poll_loop(tick, interval_s=1.0, max_iterations=2)
+        assert sleeps and abs(sleeps[0] - 0.7) < 1e-9
