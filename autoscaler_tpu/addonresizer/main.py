@@ -15,6 +15,8 @@ from typing import Optional
 from autoscaler_tpu.addonresizer.nanny import LinearEstimator, Nanny
 from autoscaler_tpu.kube.client import ApiError, KubeRestClient
 from autoscaler_tpu.kube.convert import (
+    format_cpu_millis,
+    format_memory_quantity,
     parse_cpu_millis,
     parse_quantity,
     resources_from_map,
@@ -23,14 +25,6 @@ from autoscaler_tpu.kube.objects import Resources
 from autoscaler_tpu.utils.poll import poll_loop
 
 log = logging.getLogger("nanny")
-
-
-def _qty_cpu(cpu_m: float) -> str:
-    return f"{max(int(round(cpu_m)), 1)}m"
-
-
-def _qty_mem(b: float) -> str:
-    return str(max(int(b), 1))
 
 
 class NannyRunner:
@@ -63,7 +57,10 @@ class NannyRunner:
         )
 
     def _apply(self, new: Resources) -> None:
-        qty = {"cpu": _qty_cpu(new.cpu_m), "memory": _qty_mem(new.memory)}
+        qty = {
+            "cpu": format_cpu_millis(new.cpu_m),
+            "memory": format_memory_quantity(new.memory),
+        }
         # nanny writes requests == limits
         self._target["resources"] = {"requests": dict(qty), "limits": dict(qty)}
         # PUT carries the GET's resourceVersion: a concurrent writer makes

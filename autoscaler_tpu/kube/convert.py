@@ -46,6 +46,21 @@ def parse_cpu_millis(s: Any) -> float:
     return parse_quantity(s) * 1000.0
 
 
+def format_cpu_quantity(cores: float, minimum_m: int = 1) -> str:
+    """cores → resource.Quantity millicores string ('0.25' → '250m')."""
+    return format_cpu_millis(cores * 1000, minimum_m)
+
+
+def format_cpu_millis(cpu_m: float, minimum_m: int = 1) -> str:
+    """millicores → resource.Quantity string, no lossy unit round-trip."""
+    return f"{max(int(round(cpu_m)), minimum_m)}m"
+
+
+def format_memory_quantity(b: float, minimum: int = 1) -> str:
+    """bytes → plain-integer resource.Quantity string."""
+    return str(max(int(round(b)), minimum))
+
+
 def parse_timestamp(s: Optional[str]) -> float:
     """RFC3339 → epoch seconds (0.0 when absent)."""
     if not s:

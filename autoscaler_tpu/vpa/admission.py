@@ -20,16 +20,20 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
+from autoscaler_tpu.kube.convert import (
+    format_cpu_quantity,
+    format_memory_quantity,
+)
 from autoscaler_tpu.vpa.api import UpdateMode, Vpa, match_vpa
 from autoscaler_tpu.vpa.recommender import ContainerKey, Recommendation
 
 
 def _cpu_str(cores: float) -> str:
-    return f"{int(round(cores * 1000))}m"
+    return format_cpu_quantity(cores, minimum_m=0)
 
 
 def _mem_str(b: float) -> str:
-    return f"{int(round(b))}"
+    return format_memory_quantity(b, minimum=0)
 
 
 _SUFFIX = {

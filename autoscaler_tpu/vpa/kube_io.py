@@ -16,7 +16,12 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from autoscaler_tpu.kube.client import ApiError, KubeRestClient
-from autoscaler_tpu.kube.convert import format_timestamp, parse_quantity
+from autoscaler_tpu.kube.convert import (
+    format_cpu_quantity,
+    format_memory_quantity,
+    format_timestamp,
+    parse_quantity,
+)
 from autoscaler_tpu.kube.objects import LabelSelector, LabelSelectorRequirement
 from autoscaler_tpu.vpa.api import (
     ContainerResourcePolicy,
@@ -134,14 +139,6 @@ def recommendations_from_status(obj: dict) -> Dict[str, Recommendation]:
     return out
 
 
-def _cpu_qty(cores: float) -> str:
-    return f"{max(int(round(cores * 1000)), 1)}m"
-
-
-def _mem_qty(b: float) -> str:
-    return str(max(int(b), 1))
-
-
 class VpaKubeBinding:
     """LIST VPAs (resolving each targetRef to a selector) and write their
     status.recommendation, over the REST client."""
@@ -223,16 +220,16 @@ class VpaKubeBinding:
                 {
                     "containerName": container,
                     "target": {
-                        "cpu": _cpu_qty(rec.target_cpu),
-                        "memory": _mem_qty(rec.target_memory),
+                        "cpu": format_cpu_quantity(rec.target_cpu),
+                        "memory": format_memory_quantity(rec.target_memory),
                     },
                     "lowerBound": {
-                        "cpu": _cpu_qty(rec.lower_cpu),
-                        "memory": _mem_qty(rec.lower_memory),
+                        "cpu": format_cpu_quantity(rec.lower_cpu),
+                        "memory": format_memory_quantity(rec.lower_memory),
                     },
                     "upperBound": {
-                        "cpu": _cpu_qty(rec.upper_cpu),
-                        "memory": _mem_qty(rec.upper_memory),
+                        "cpu": format_cpu_quantity(rec.upper_cpu),
+                        "memory": format_memory_quantity(rec.upper_memory),
                     },
                 }
             )
