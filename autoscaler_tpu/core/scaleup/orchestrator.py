@@ -96,6 +96,7 @@ class ScaleUpOrchestrator:
         pending_pods: Sequence[Pod],
         cluster_nodes: Sequence[Node],
         now_ts: float,
+        pods_of_node=None,
     ) -> ScaleUpResult:
         if not pending_pods:
             return ScaleUpResult()
@@ -136,7 +137,8 @@ class ScaleUpOrchestrator:
             template: Optional[Node] = None
             if self.template_provider is not None:
                 template = self.template_provider.template_for(
-                    group, nodes_by_group.get(gid, []), now_ts
+                    group, nodes_by_group.get(gid, []), now_ts,
+                    pods_of_node=pods_of_node,
                 )
             else:
                 try:

@@ -302,7 +302,7 @@ class StaticAutoscaler:
 
         # upcoming (requested-not-yet-registered) nodes join the simulation as
         # virtual template nodes (:484-519)
-        upcoming_names = self._inject_upcoming_nodes(snapshot)
+        upcoming_names = self._inject_upcoming_nodes(snapshot, now_ts)
 
         self.metrics.observe_duration(metrics_mod.SNAPSHOT_BUILD, t_snap)
 
@@ -326,7 +326,13 @@ class StaticAutoscaler:
         # 6. scale-up (:560-580)
         if pending:
             t_up = _time.monotonic()
-            up = self.scale_up_orchestrator.scale_up(pending, all_nodes, now_ts)
+            up = self.scale_up_orchestrator.scale_up(
+                pending, all_nodes, now_ts,
+                # new nodes boot the group's daemonsets: their observed
+                # overhead on the template's source node is charged against
+                # template capacity (simulator/nodes.go:38)
+                pods_of_node=snapshot.pods_on_node,
+            )
             self.metrics.observe_duration(metrics_mod.SCALE_UP, t_up)
             result.scale_up = up
             self.processors.scale_up_status.process(up)
@@ -421,24 +427,54 @@ class StaticAutoscaler:
             (scheduled if pod.node_name else pending).append(pod)
         return scheduled, pending
 
-    def _inject_upcoming_nodes(self, snapshot: ClusterSnapshot) -> List[str]:
+    def _inject_upcoming_nodes(
+        self, snapshot: ClusterSnapshot, now_ts: float
+    ) -> List[str]:
         """Virtual nodes for capacity that was requested but hasn't
-        registered (:484-519) so we don't double scale-up."""
+        registered (:484-519) so we don't double scale-up.
+
+        Routed through the template provider so the virtual node carries the
+        group's daemon overhead: an upcoming node boots its daemonsets, and
+        crediting it with full allocatable would let filter-out-schedulable
+        over-absorb pending pods, under-provisioning by one boot cycle per
+        loop. The virtual allocatable IS the packing capacity; resource
+        limits are unaffected (they count real provider nodes)."""
         injected: List[str] = []
         upcoming = self.csr.get_upcoming_nodes()
         groups = {g.id(): g for g in self.provider.node_groups()}
+        tmpl_provider = self.processors.template_node_info_provider
+        nodes_by_group: Dict[str, List[Node]] = {}
+        if tmpl_provider is not None and upcoming:
+            for node in snapshot.nodes():
+                g = self.provider.node_group_for_node(node)
+                if g is not None:
+                    nodes_by_group.setdefault(g.id(), []).append(node)
         for gid, count in upcoming.items():
             group = groups.get(gid)
             if group is None:
                 continue
-            try:
-                template = group.template_node_info()
-            except Exception:
+            template = None
+            if tmpl_provider is not None:
+                template = tmpl_provider.template_for(
+                    group, nodes_by_group.get(gid, []), now_ts,
+                    pods_of_node=snapshot.pods_on_node,
+                )
+            if template is None:
+                try:
+                    template = group.template_node_info()
+                except Exception:
+                    continue
+            if template is None:
                 continue
+            from autoscaler_tpu.kube.objects import Resources
+
+            cap = template.packing_capacity()
             for i in range(count):
                 virtual = dataclasses.replace(
                     template,
                     name=f"upcoming-{gid}-{i}",
+                    allocatable=cap,
+                    daemon_overhead=Resources(),
                     taints=list(template.taints),
                     labels=dict(template.labels),
                 )

@@ -61,6 +61,13 @@ def template_mask(
     return mask
 
 
+def _template_capacity_row(template: Node) -> np.ndarray:
+    """Pack-capacity row of a template node: allocatable minus daemon
+    overhead, with the pods column from the same reduced view."""
+    cap = template.packing_capacity()
+    return resources_row(cap, cap.pods)
+
+
 class BinpackingNodeEstimator:
     """TPU-backed node-count estimator with the reference's Estimate contract."""
 
@@ -80,7 +87,7 @@ class BinpackingNodeEstimator:
         req = _pack_pods(pods, P)
         dynamic_affinity = has_interpod_affinity(pods)
         mask = template_mask(pods, template, P, interpod=not dynamic_affinity)
-        alloc = resources_row(template.allocatable, template.allocatable.pods)
+        alloc = _template_capacity_row(template)
         cap = self.limiter.node_cap(max_size_headroom)
         if dynamic_affinity:
             terms = build_affinity_terms(pods, [template], pad_pods=P, bucket_terms=True)
@@ -183,7 +190,7 @@ class BinpackingNodeEstimator:
         )
         allocs = np.stack(
             [
-                resources_row(templates[g].allocatable, templates[g].allocatable.pods)
+                _template_capacity_row(templates[g])
                 for g in names
             ]
         )
@@ -285,7 +292,7 @@ class BinpackingNodeEstimator:
         )
         allocs = np.stack(
             [
-                resources_row(templates[g].allocatable, templates[g].allocatable.pods)
+                _template_capacity_row(templates[g])
                 for g in names
             ]
         )
@@ -351,7 +358,7 @@ class BinpackingNodeEstimator:
         )
         allocs = np.stack(
             [
-                resources_row(templates[g].allocatable, templates[g].allocatable.pods)
+                _template_capacity_row(templates[g])
                 for g in names
             ]
         )

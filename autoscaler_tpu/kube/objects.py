@@ -260,6 +260,18 @@ class Node:
     # .count); drivers absent here are unlimited, matching the scheduler's
     # NodeVolumeLimits behavior when CSINode reports no limit
     csi_attach_limits: Dict[str, int] = field(default_factory=dict)
+    # Template nodes only: DaemonSet/mirror overhead a NEW node of this shape
+    # boots with (the reference's template NodeInfo carries those pods,
+    # simulator/nodes.go:38). Kept separate from allocatable so resource
+    # limits and group-similarity comparisons still see the node's true
+    # size; only the estimator's packing capacity subtracts it.
+    daemon_overhead: Resources = field(default_factory=Resources)
+
+    def packing_capacity(self) -> Resources:
+        """allocatable minus daemon overhead, floored at zero — what pending
+        pods may actually claim on a fresh node of this shape."""
+        reduced = self.allocatable - self.daemon_overhead
+        return Resources(*[max(v, 0.0) for v in reduced.as_tuple()])
 
 
 @dataclass

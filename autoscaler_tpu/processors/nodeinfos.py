@@ -15,13 +15,23 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from autoscaler_tpu.cloudprovider.interface import CloudProvider, NodeGroup
-from autoscaler_tpu.kube.objects import DELETION_CANDIDATE_TAINT, TO_BE_DELETED_TAINT, Node
+from autoscaler_tpu.kube.objects import (
+    DELETION_CANDIDATE_TAINT,
+    TO_BE_DELETED_TAINT,
+    Node,
+    Resources,
+)
 
 
 @dataclass
 class _CacheEntry:
     template: Node
     ts: float
+    # name of the real node the template was sanitized from ("" when it came
+    # from the cloud's synthetic TemplateNodeInfo) — daemon overhead is
+    # re-derived per call from this node's live pods, so the cache never
+    # pins a charged-vs-uncharged variant
+    source_node: str = ""
 
 
 class MixedTemplateNodeInfoProvider:
@@ -37,26 +47,50 @@ class MixedTemplateNodeInfoProvider:
         group: NodeGroup,
         real_nodes: Sequence[Node],
         now_ts: float,
+        pods_of_node=None,
     ) -> Optional[Node]:
+        """pods_of_node: optional node-name → pods lookup. When the template
+        comes from a real node, that node's DaemonSet/mirror pods become the
+        template's daemon_overhead — a new node in the group boots the same
+        daemonsets, so the estimator must not hand their capacity to pending
+        pods (reference simulator/nodes.go:38 addExpectedPods puts those
+        pods INTO the template NodeInfo). allocatable stays the node's true
+        size: resource limits and group-similarity comparisons are
+        unaffected (Node.packing_capacity is the estimator's view). Pending
+        daemonsets (--force-ds) are unmodeled: no DaemonSet object store."""
         gid = group.id()
         cached = self._cache.get(gid)
-        if cached is not None and now_ts - cached.ts < self.ttl_s:
-            return cached.template
-
-        template: Optional[Node] = None
-        ready = [n for n in real_nodes if n.ready and not n.unschedulable]
-        if ready:
-            template = self._sanitize(ready[0], gid)
-        else:
-            try:
-                template = group.template_node_info()
-                if template is not None:
-                    template = self._sanitize(template, gid)
-            except Exception:
-                template = None
-        if template is not None:
-            self._cache[gid] = _CacheEntry(template, now_ts)
-        return template
+        if cached is None or now_ts - cached.ts >= self.ttl_s:
+            template: Optional[Node] = None
+            source = ""
+            ready = [n for n in real_nodes if n.ready and not n.unschedulable]
+            if ready:
+                template = self._sanitize(ready[0], gid)
+                source = ready[0].name
+            else:
+                try:
+                    template = group.template_node_info()
+                    if template is not None:
+                        template = self._sanitize(template, gid)
+                except Exception:
+                    template = None
+            if template is None:
+                return None
+            cached = _CacheEntry(template, now_ts, source)
+            self._cache[gid] = cached
+        # overhead is derived per CALL from the source node's live pods, so
+        # callers with and without pods_of_node share one cached base and
+        # results don't depend on which caller populated the cache
+        if pods_of_node is not None and cached.source_node:
+            overhead = Resources()
+            for p in pods_of_node(cached.source_node) or ():
+                if p.daemonset or p.mirror:
+                    overhead = overhead + p.effective_requests()
+            if overhead != Resources():
+                return dataclasses.replace(
+                    cached.template, daemon_overhead=overhead
+                )
+        return cached.template
 
     def process(
         self,

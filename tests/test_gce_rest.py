@@ -363,7 +363,12 @@ class TestCliRealBindings:
                 f"{MIG}-0", cpu="112", mem="192Gi",
                 provider_id=f"gce://{PROJECT}/{ZONE}/{MIG}-0",
             )
-            for i in range(3):
+            # 8 × 50-core pods. Upcoming capacity is derived from the REAL
+            # registered node's shape (the Mixed provider prefers it, and
+            # this cluster's booted nodes carry no TPU taint): the real node
+            # absorbs 2, the two upcoming 112-core instances absorb 4, and
+            # the remaining two force an actual MIG resize.
+            for i in range(8):
                 kube.pods[f"default/p{i}"] = pod_json(f"p{i}", cpu="50", mem="64Gi")
             rc = main([
                 "--provider", "gce",

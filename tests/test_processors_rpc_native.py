@@ -362,3 +362,83 @@ class TestNewProcessorSeams:
         a, _ = self._autoscaler(procs=procs)
         a.run_once(now_ts=100.0)
         assert heard and len(heard[-1]) >= 1  # empty nodes became unneeded
+
+
+class TestDaemonOverheadTemplates:
+    """A new node boots the group's daemonsets, so templates built from a
+    real node charge its DS/mirror pods against capacity (the reference puts
+    those pods INTO the template NodeInfo, simulator/nodes.go:38)."""
+
+    def _group_with_node(self):
+        provider = TestCloudProvider()
+        provider.add_node_group(
+            "g", 0, 10, 1, build_test_node("tmpl", cpu_m=4000, mem=8 * GB)
+        )
+        node = build_test_node("g-0", cpu_m=4000, mem=8 * GB)
+        provider.add_node("g", node)
+        return provider, node
+
+    def test_ds_overhead_reduces_template_capacity(self):
+        provider, node = self._group_with_node()
+        ds = build_test_pod("kube-proxy-x", cpu_m=300, mem=512 * MB,
+                            node_name="g-0")
+        ds.daemonset = True
+        mirror = build_test_pod("static-x", cpu_m=200, mem=256 * MB,
+                                node_name="g-0")
+        mirror.mirror = True
+        plain = build_test_pod("app-x", cpu_m=1000, mem=GB, node_name="g-0")
+        pods = {"g-0": [ds, mirror, plain]}
+        prov = MixedTemplateNodeInfoProvider()
+        (group,) = provider.node_groups()
+        tmpl = prov.template_for(group, [node], 0.0, pods_of_node=pods.get)
+        # DS + mirror become daemon_overhead; the plain workload pod is NOT
+        # charged (it reschedules). allocatable keeps the node's true size so
+        # resource limits and group similarity stay correct; only the
+        # estimator's packing_capacity shrinks.
+        assert tmpl.allocatable.cpu_m == pytest.approx(4000)
+        assert tmpl.daemon_overhead.cpu_m == pytest.approx(300 + 200)
+        cap = tmpl.packing_capacity()
+        assert cap.cpu_m == pytest.approx(4000 - 500)
+        assert cap.memory == pytest.approx(8 * GB - 768 * MB)
+        assert cap.pods == pytest.approx(110 - 2)
+        # cache order-independence: a caller without pods_of_node gets the
+        # uncharged base even after the charged call populated the cache
+        bare = prov.template_for(group, [node], 0.0)
+        assert bare.daemon_overhead.cpu_m == 0.0
+
+    def test_no_lookup_keeps_full_capacity(self):
+        provider, node = self._group_with_node()
+        prov = MixedTemplateNodeInfoProvider()
+        (group,) = provider.node_groups()
+        tmpl = prov.template_for(group, [node], 0.0)
+        assert tmpl.allocatable.cpu_m == pytest.approx(4000)
+
+    def test_estimator_sees_reduced_capacity_end_to_end(self):
+        """RunOnce: with a fat daemonset on the group's node, fewer pending
+        pods fit per new node, so the scale-up asks for more nodes."""
+        from autoscaler_tpu.config.options import AutoscalingOptions
+        from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+        from autoscaler_tpu.kube.api import FakeClusterAPI
+
+        def world(with_ds):
+            provider = TestCloudProvider()
+            api = FakeClusterAPI()
+            provider.add_node_group(
+                "g", 0, 20, 1, build_test_node("t", cpu_m=4000, mem=8 * GB)
+            )
+            node = build_test_node("g-0", cpu_m=4000, mem=8 * GB)
+            provider.add_node("g", node)
+            api.add_node(node)
+            if with_ds:
+                ds = build_test_pod("ds-0", cpu_m=2200, mem=GB, node_name="g-0")
+                ds.daemonset = True
+                api.add_pod(ds)
+            for i in range(8):
+                api.add_pod(build_test_pod(f"p{i}", cpu_m=1500, mem=GB))
+            a = StaticAutoscaler(provider, api, AutoscalingOptions())
+            a.run_once(now_ts=0.0)
+            return provider._groups["g"].target_size()
+
+        lean = world(with_ds=False)   # 2 × 1500m per 4000m node
+        fat = world(with_ds=True)     # DS leaves 1800m → 1 pod per node
+        assert fat > lean
