@@ -75,6 +75,8 @@ class AutoscalingOptions:
     max_nodes_per_scaleup: int = 1000             # main.go:215
     max_nodegroup_binpacking_duration_s: float = 10.0  # main.go:216
     node_info_cache_expire_time_s: float = 60.0  # template NodeInfo TTL
+    # --force-ds: charge suitable pending DaemonSets onto new-node capacity
+    force_daemonsets: bool = False
     debugging_snapshot_enabled: bool = True      # serve /snapshotz
     balance_similar_node_groups: bool = False
     balancing_label_keys: List[str] = field(default_factory=list)

@@ -97,6 +97,7 @@ class ScaleUpOrchestrator:
         cluster_nodes: Sequence[Node],
         now_ts: float,
         pods_of_node=None,
+        pending_daemonsets=(),
     ) -> ScaleUpResult:
         if not pending_pods:
             return ScaleUpResult()
@@ -139,6 +140,7 @@ class ScaleUpOrchestrator:
                 template = self.template_provider.template_for(
                     group, nodes_by_group.get(gid, []), now_ts,
                     pods_of_node=pods_of_node,
+                    pending_daemonsets=pending_daemonsets,
                 )
             else:
                 try:

@@ -332,6 +332,13 @@ class StaticAutoscaler:
                 # overhead on the template's source node is charged against
                 # template capacity (simulator/nodes.go:38)
                 pods_of_node=snapshot.pods_on_node,
+                # --force-ds additionally charges suitable-but-not-yet-
+                # running DaemonSets (simulator/nodes.go:56)
+                pending_daemonsets=(
+                    self.api.list_daemonsets()
+                    if self.options.force_daemonsets
+                    else ()
+                ),
             )
             self.metrics.observe_duration(metrics_mod.SCALE_UP, t_up)
             result.scale_up = up
@@ -444,11 +451,16 @@ class StaticAutoscaler:
         groups = {g.id(): g for g in self.provider.node_groups()}
         tmpl_provider = self.processors.template_node_info_provider
         nodes_by_group: Dict[str, List[Node]] = {}
+        pending_ds = ()
         if tmpl_provider is not None and upcoming:
             for node in snapshot.nodes():
                 g = self.provider.node_group_for_node(node)
                 if g is not None:
                     nodes_by_group.setdefault(g.id(), []).append(node)
+            if self.options.force_daemonsets:
+                # the same pending-DS charge as the scale-up path — an
+                # upcoming node boots those daemonsets too
+                pending_ds = self.api.list_daemonsets()
         for gid, count in upcoming.items():
             group = groups.get(gid)
             if group is None:
@@ -458,6 +470,7 @@ class StaticAutoscaler:
                 template = tmpl_provider.template_for(
                     group, nodes_by_group.get(gid, []), now_ts,
                     pods_of_node=snapshot.pods_on_node,
+                    pending_daemonsets=pending_ds,
                 )
             if template is None:
                 try:

@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from autoscaler_tpu.kube.objects import (
+    DaemonSet,
     DELETION_CANDIDATE_TAINT,
     NO_SCHEDULE,
     PREFER_NO_SCHEDULE,
@@ -39,6 +40,11 @@ class ClusterAPI(abc.ABC):
     def list_pods(self) -> List[Pod]: ...
 
     def list_pdbs(self) -> List[PodDisruptionBudget]:
+        return []
+
+    def list_daemonsets(self) -> List[DaemonSet]:
+        """apps/v1 DaemonSets for --force-ds template charging; default
+        empty for implementations without an apps store."""
         return []
 
     @abc.abstractmethod
@@ -95,6 +101,7 @@ class FakeClusterAPI(ClusterAPI):
     nodes: Dict[str, Node] = field(default_factory=dict)
     pods: Dict[str, Pod] = field(default_factory=dict)
     pdbs: List[PodDisruptionBudget] = field(default_factory=list)
+    daemonsets: List[DaemonSet] = field(default_factory=list)
     evicted: List[str] = field(default_factory=list)
     events: List[Tuple[str, str, str, str]] = field(default_factory=list)
     configmaps: Dict[Tuple[str, str], Dict] = field(default_factory=dict)
@@ -123,6 +130,10 @@ class FakeClusterAPI(ClusterAPI):
     def list_pdbs(self) -> List[PodDisruptionBudget]:
         with self._lock:
             return list(self.pdbs)
+
+    def list_daemonsets(self) -> List[DaemonSet]:
+        with self._lock:
+            return list(self.daemonsets)
 
     def evict_pod(self, pod: Pod) -> None:
         with self._lock:

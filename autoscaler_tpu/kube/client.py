@@ -478,6 +478,18 @@ class KubeClusterAPI(ClusterAPI):
                 raise
             self.client.post(f"/api/v1/namespaces/{namespace}/configmaps", body)
 
+    def list_daemonsets(self) -> List:
+        """apps/v1 DaemonSets for --force-ds template charging; servers
+        without the apps group (unlikely, but symmetric with the storage
+        probes) degrade to none."""
+        try:
+            items = self.client.get("/apis/apps/v1/daemonsets").get("items") or []
+        except ApiError as e:
+            if e.status == 404:
+                return []
+            raise
+        return [convert.daemonset_from_json(o) for o in items]
+
     def read_configmap(self, namespace: str, name: str) -> Optional[dict]:
         try:
             obj = self.client.get(

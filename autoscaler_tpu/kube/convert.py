@@ -168,6 +168,37 @@ def node_from_json(obj: Dict[str, Any]) -> k8s.Node:
     )
 
 
+def daemonset_from_json(obj: Dict[str, Any]) -> k8s.DaemonSet:
+    """apps/v1 DaemonSet → the autoscaler's slice (identity, nodeSelector,
+    tolerations, summed per-pod container requests). Feeds --force-ds
+    template charging (reference simulator/nodes.go:56)."""
+    meta = obj.get("metadata") or {}
+    tmpl_spec = (
+        ((obj.get("spec") or {}).get("template") or {}).get("spec") or {}
+    )
+    requests = k8s.Resources()
+    for c in tmpl_spec.get("containers") or ():
+        requests = requests + resources_from_map(
+            (c.get("resources") or {}).get("requests")
+        )
+    tolerations = [
+        k8s.Toleration(
+            key=t.get("key", ""),
+            operator=t.get("operator", "Equal"),
+            value=t.get("value", ""),
+            effect=t.get("effect", ""),
+        )
+        for t in tmpl_spec.get("tolerations") or ()
+    ]
+    return k8s.DaemonSet(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        node_selector=dict(tmpl_spec.get("nodeSelector") or {}),
+        tolerations=tolerations,
+        requests=requests,
+    )
+
+
 def csinode_limits_from_json(obj: Dict[str, Any]) -> Tuple[str, Dict[str, int]]:
     """CSINode → (node_name, {driver: allocatable_count}).
 

@@ -275,6 +275,39 @@ class Node:
 
 
 @dataclass
+class DaemonSet:
+    """The slice of an apps/v1 DaemonSet the autoscaler needs: identity for
+    is-it-running-here checks, scheduling constraints for is-it-suitable
+    checks, and per-pod requests for capacity charging (--force-ds,
+    reference simulator/nodes.go:56 GetDaemonSetPodsForNode)."""
+
+    name: str
+    namespace: str = "default"
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[Toleration] = field(default_factory=list)
+    requests: Resources = field(default_factory=Resources)
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def suitable_for(self, node: "Node") -> bool:
+        """nodeSelector subset-match + taint toleration — the predicate
+        subset of the reference's per-DS scheduling simulation (documented
+        approximation; affinity-based DS targeting is not modeled). Shares
+        the scheduler predicates via a pod proxy so taint/selector
+        semantics can't drift from the filter plugins."""
+        proxy = Pod(
+            name=self.name,
+            namespace=self.namespace,
+            node_selector=dict(self.node_selector),
+            tolerations=list(self.tolerations),
+        )
+        return node_matches_selector(proxy, node) and pod_tolerates_taints(
+            proxy, node.taints
+        )
+
+
+@dataclass
 class PodDisruptionBudget:
     name: str
     namespace: str = "default"

@@ -158,6 +158,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="template NodeInfo cache TTL seconds")
     p.add_argument("--debugging-snapshot-enabled", type=_bool_flag, default=True,
                    help="serve /snapshotz captures")
+    p.add_argument("--force-ds", type=_bool_flag, default=False,
+                   help="charge suitable pending DaemonSets onto new-node "
+                        "capacity (reference --force-ds)")
     p.add_argument("--grpc-expander-url", default="",
                    help="external gRPC expander target (expander grpc in chain)")
     p.add_argument("--cluster-name", default="")
@@ -252,6 +255,7 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         ),
         node_info_cache_expire_time_s=args.node_info_cache_expire_time,
         debugging_snapshot_enabled=args.debugging_snapshot_enabled,
+        force_daemonsets=args.force_ds,
     )
     opts.node_group_defaults.scale_down_unneeded_time_s = args.scale_down_unneeded_time
     opts.node_group_defaults.scale_down_unready_time_s = args.scale_down_unready_time

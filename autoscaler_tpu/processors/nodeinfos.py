@@ -48,6 +48,7 @@ class MixedTemplateNodeInfoProvider:
         real_nodes: Sequence[Node],
         now_ts: float,
         pods_of_node=None,
+        pending_daemonsets: Sequence = (),
     ) -> Optional[Node]:
         """pods_of_node: optional node-name → pods lookup. When the template
         comes from a real node, that node's DaemonSet/mirror pods become the
@@ -56,8 +57,11 @@ class MixedTemplateNodeInfoProvider:
         pods (reference simulator/nodes.go:38 addExpectedPods puts those
         pods INTO the template NodeInfo). allocatable stays the node's true
         size: resource limits and group-similarity comparisons are
-        unaffected (Node.packing_capacity is the estimator's view). Pending
-        daemonsets (--force-ds) are unmodeled: no DaemonSet object store."""
+        unaffected (Node.packing_capacity is the estimator's view).
+        pending_daemonsets (--force-ds): DaemonSet objects whose suitable-
+        but-not-yet-running members are charged on top (simulator/
+        nodes.go:56); pass them at EVERY call site that wants the charge —
+        the scale-up path and upcoming-node injection both do."""
         gid = group.id()
         cached = self._cache.get(gid)
         if cached is None or now_ts - cached.ts >= self.ttl_s:
@@ -81,15 +85,27 @@ class MixedTemplateNodeInfoProvider:
         # overhead is derived per CALL from the source node's live pods, so
         # callers with and without pods_of_node share one cached base and
         # results don't depend on which caller populated the cache
+        overhead = Resources()
+        running_ds_names = set()
         if pods_of_node is not None and cached.source_node:
-            overhead = Resources()
             for p in pods_of_node(cached.source_node) or ():
                 if p.daemonset or p.mirror:
                     overhead = overhead + p.effective_requests()
-            if overhead != Resources():
-                return dataclasses.replace(
-                    cached.template, daemon_overhead=overhead
-                )
+                    if p.daemonset and p.owner_ref is not None:
+                        running_ds_names.add(
+                            f"{p.namespace}/{p.owner_ref.name}"
+                        )
+        # --force-ds (simulator/nodes.go:56): DaemonSets suitable for this
+        # template but not yet running on its source node will ALSO land on
+        # a new node — charge their requests too
+        for ds in pending_daemonsets:
+            if ds.key() in running_ds_names:
+                continue
+            if ds.suitable_for(cached.template):
+                r = dataclasses.replace(ds.requests, pods=1.0)
+                overhead = overhead + r
+        if overhead != Resources():
+            return dataclasses.replace(cached.template, daemon_overhead=overhead)
         return cached.template
 
     def process(

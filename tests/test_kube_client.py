@@ -88,6 +88,7 @@ class FakeApiServer:
         self.pvcs = []
         self.pvs = []
         self.csinodes = []
+        self.daemonsets = []      # apps/v1 DaemonSet objects
         self.vpas = {}            # "ns/name" -> VPA CRD object
         self.deployments = {}     # "ns/name" -> apps/v1 Deployment object
         self.pod_metrics = []     # metrics.k8s.io PodMetrics items
@@ -206,6 +207,8 @@ class FakeApiServer:
                         if not outer.serve_storage:
                             return self._send(404)
                         return self._send(200, {"items": storage_items[path]})
+                    if path == "/apis/apps/v1/daemonsets":
+                        return self._send(200, {"items": outer.daemonsets})
                     if path == "/apis/autoscaling.k8s.io/v1/verticalpodautoscalers":
                         return self._send(200, {"items": list(outer.vpas.values())})
                     if path == "/apis/metrics.k8s.io/v1beta1/pods":

@@ -442,3 +442,128 @@ class TestDaemonOverheadTemplates:
         lean = world(with_ds=False)   # 2 × 1500m per 4000m node
         fat = world(with_ds=True)     # DS leaves 1800m → 1 pod per node
         assert fat > lean
+
+
+class TestForceDaemonSets:
+    """--force-ds (simulator/nodes.go:56): DaemonSets suitable for the
+    template but not yet running on its source node charge new-node
+    capacity too."""
+
+    def _pending_ds(self, name="logging-agent", cpu_m=500, selector=None,
+                    tolerations=()):
+        from autoscaler_tpu.kube.objects import DaemonSet, Resources
+
+        return DaemonSet(
+            name=name, namespace="kube-system",
+            node_selector=dict(selector or {}),
+            tolerations=list(tolerations),
+            requests=Resources(cpu_m=cpu_m, memory=256 * MB),
+        )
+
+    def test_pending_ds_charged(self):
+        provider = TestCloudProvider()
+        provider.add_node_group(
+            "g", 0, 10, 1, build_test_node("tmpl", cpu_m=4000, mem=8 * GB)
+        )
+        node = build_test_node("g-0", cpu_m=4000, mem=8 * GB)
+        provider.add_node("g", node)
+        prov = MixedTemplateNodeInfoProvider()
+        (group,) = provider.node_groups()
+        tmpl = prov.template_for(
+            group, [node], 0.0, pods_of_node=lambda n: [],
+            pending_daemonsets=[self._pending_ds()],
+        )
+        assert tmpl.daemon_overhead.cpu_m == pytest.approx(500)
+        assert tmpl.daemon_overhead.pods == pytest.approx(1)
+
+    def test_running_ds_not_double_charged(self):
+        from autoscaler_tpu.kube.objects import OwnerRef
+
+        provider = TestCloudProvider()
+        provider.add_node_group(
+            "g", 0, 10, 1, build_test_node("tmpl", cpu_m=4000, mem=8 * GB)
+        )
+        node = build_test_node("g-0", cpu_m=4000, mem=8 * GB)
+        provider.add_node("g", node)
+        running = build_test_pod("logging-agent-x", cpu_m=500,
+                                 mem=256 * MB, node_name="g-0",
+                                 namespace="kube-system")
+        running.daemonset = True
+        running.owner_ref = OwnerRef(kind="DaemonSet", name="logging-agent")
+        prov = MixedTemplateNodeInfoProvider()
+        (group,) = provider.node_groups()
+        tmpl = prov.template_for(
+            group, [node], 0.0,
+            pods_of_node={"g-0": [running]}.get,
+            pending_daemonsets=[self._pending_ds()],
+        )
+        # charged ONCE via the running pod, not again as pending
+        assert tmpl.daemon_overhead.cpu_m == pytest.approx(500)
+
+    def test_unsuitable_ds_not_charged(self):
+        provider = TestCloudProvider()
+        provider.add_node_group(
+            "g", 0, 10, 1, build_test_node("tmpl", cpu_m=4000, mem=8 * GB)
+        )
+        node = build_test_node("g-0", cpu_m=4000, mem=8 * GB)
+        provider.add_node("g", node)
+        prov = MixedTemplateNodeInfoProvider()
+        (group,) = provider.node_groups()
+        tmpl = prov.template_for(
+            group, [node], 0.0, pods_of_node=lambda n: [],
+            pending_daemonsets=[
+                self._pending_ds(selector={"accel": "gpu"})  # label absent
+            ],
+        )
+        assert tmpl.daemon_overhead.cpu_m == pytest.approx(0)
+
+    def test_tainted_template_needs_toleration(self):
+        from autoscaler_tpu.kube.objects import Taint, Toleration
+
+        provider = TestCloudProvider()
+        tainted_tmpl = build_test_node("tmpl", cpu_m=4000, mem=8 * GB)
+        tainted_tmpl.taints.append(Taint("dedicated", "tpu"))
+        provider.add_node_group("g", 0, 10, 0, tainted_tmpl)
+        prov = MixedTemplateNodeInfoProvider()
+        (group,) = provider.node_groups()
+        no_tol = prov.template_for(
+            group, [], 0.0, pods_of_node=lambda n: [],
+            pending_daemonsets=[self._pending_ds()],
+        )
+        # synthetic templates keep their taints; intolerant DS is unsuitable
+        assert no_tol.daemon_overhead.cpu_m == pytest.approx(0)
+        prov.invalidate()
+        tol = prov.template_for(
+            group, [], 0.0, pods_of_node=lambda n: [],
+            pending_daemonsets=[
+                self._pending_ds(tolerations=[Toleration(operator="Exists")])
+            ],
+        )
+        assert tol.daemon_overhead.cpu_m == pytest.approx(500)
+
+    def test_kube_client_lists_daemonsets(self):
+        import sys
+        sys.path.insert(0, "tests")
+        from test_kube_client import FakeApiServer
+
+        from autoscaler_tpu.kube.client import KubeClusterAPI, KubeRestClient
+
+        srv = FakeApiServer()
+        try:
+            srv.daemonsets = [{
+                "metadata": {"name": "fluentd", "namespace": "kube-system"},
+                "spec": {"template": {"spec": {
+                    "nodeSelector": {"pool": "logs"},
+                    "tolerations": [{"operator": "Exists"}],
+                    "containers": [{"name": "c", "resources": {
+                        "requests": {"cpu": "150m", "memory": "200Mi"}}}],
+                }}},
+            }]
+            api = KubeClusterAPI(KubeRestClient(srv.url))
+            (ds,) = api.list_daemonsets()
+            assert ds.key() == "kube-system/fluentd"
+            assert ds.node_selector == {"pool": "logs"}
+            assert ds.requests.cpu_m == pytest.approx(150)
+            assert ds.tolerations[0].operator == "Exists"
+        finally:
+            srv.close()
