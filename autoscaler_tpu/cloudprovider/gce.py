@@ -125,7 +125,14 @@ class MigInstance:
 
 class GceApi(abc.ABC):
     """The injectable transport: exactly the instance-group API calls the
-    provider needs (reference gce/autoscaling_gce_client.go surface)."""
+    provider needs (reference gce/autoscaling_gce_client.go surface).
+
+    CONCURRENCY CONTRACT: `list_instances` is called from a small thread
+    pool during refresh (the --gce-concurrent-refreshes analog), so
+    implementations must tolerate concurrent read calls — use a stateless
+    request function or per-call connections (RestGceApi does), not one
+    shared non-thread-safe HTTP client. Mutations (resize/delete) are only
+    ever issued from the actuation path, one at a time per group."""
 
     @abc.abstractmethod
     def get_target_size(self, project: str, zone: str, mig: str) -> int: ...
@@ -500,14 +507,29 @@ class GceCloudProvider(CloudProvider):
     def gpu_label(self) -> str:
         return GPU_LABEL
 
+    # reference --gce-concurrent-refreshes default (gce main.go flag): MIG
+    # instance listings are independent HTTP calls, fetched in parallel
+    CONCURRENT_REFRESHES = 4
+
     def refresh(self) -> None:
         self._manager.invalidate()
-        self._node_to_mig = {}
-        for mig in self._migs:
-            for inst in self._manager.instances(mig):
+        node_to_mig: Dict[str, GceMig] = {}
+        migs = list(self._migs)
+        if len(migs) > 1 and self.CONCURRENT_REFRESHES > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            workers = min(self.CONCURRENT_REFRESHES, len(migs))
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                listings = list(pool.map(self._manager.instances, migs))
+        else:
+            listings = [self._manager.instances(mig) for mig in migs]
+        for mig, instances in zip(migs, listings):
+            for inst in instances:
                 pid = f"gce://{mig.project}/{mig.zone}/{inst.name}"
-                self._node_to_mig[pid] = mig
-                self._node_to_mig[inst.name] = mig
+                node_to_mig[pid] = mig
+                node_to_mig[inst.name] = mig
+        # swap atomically: concurrent readers never see a half-built map
+        self._node_to_mig = node_to_mig
 
 
 def parse_auto_discovery_spec(spec: str) -> Dict[str, object]:
