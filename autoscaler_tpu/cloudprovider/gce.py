@@ -507,18 +507,19 @@ class GceCloudProvider(CloudProvider):
     def gpu_label(self) -> str:
         return GPU_LABEL
 
-    # reference --gce-concurrent-refreshes default (gce main.go flag): MIG
-    # instance listings are independent HTTP calls, fetched in parallel
-    CONCURRENT_REFRESHES = 4
+    # --gce-concurrent-refreshes (reference main.go:194, default 1 —
+    # serial): MIG instance listings are independent HTTP calls; raising
+    # this fetches them on a worker pool. Set via build_gce_provider.
+    concurrent_refreshes = 1
 
     def refresh(self) -> None:
         self._manager.invalidate()
         node_to_mig: Dict[str, GceMig] = {}
         migs = list(self._migs)
-        if len(migs) > 1 and self.CONCURRENT_REFRESHES > 1:
+        if len(migs) > 1 and self.concurrent_refreshes > 1:
             from concurrent.futures import ThreadPoolExecutor
 
-            workers = min(self.CONCURRENT_REFRESHES, len(migs))
+            workers = min(self.concurrent_refreshes, len(migs))
             with ThreadPoolExecutor(max_workers=workers) as pool:
                 listings = list(pool.map(self._manager.instances, migs))
         else:
@@ -561,6 +562,7 @@ def build_gce_provider(
     resource_limiter: Optional[ResourceLimiter] = None,
     cache_ttl_s: float = 60.0,
     auto_discovery: Sequence[str] = (),
+    concurrent_refreshes: int = 1,
 ) -> GceCloudProvider:
     """specs: 'min:max:projects/P/zones/Z/instanceGroups/NAME' — the
     reference's --nodes flag format (main.go --nodes, spec parsing in
@@ -588,4 +590,6 @@ def build_gce_provider(
             migs.append(
                 GceMig(manager, project, zone, name, int(disc["min"]), int(disc["max"]))
             )
-    return GceCloudProvider(manager, migs, resource_limiter)
+    provider = GceCloudProvider(manager, migs, resource_limiter)
+    provider.concurrent_refreshes = max(int(concurrent_refreshes), 1)
+    return provider

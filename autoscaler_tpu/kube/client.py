@@ -281,10 +281,16 @@ class KubeClusterAPI(ClusterAPI):
         client: KubeRestClient,
         watch: bool = False,
         resolve_csi: bool = True,
+        record_duplicated_events: bool = False,
     ):
         self.client = client
         self._watching = watch
         self._resolve_csi = resolve_csi
+        # client-go's EventCorrelator aggregates repeats; the analog here
+        # suppresses identical (kind, name, reason) posts within a window
+        # unless --record-duplicated-events asks for every one
+        self._record_duplicated_events = record_duplicated_events
+        self._recent_events: Dict[Tuple[str, str, str], float] = {}
         self._node_cache: Optional[WatchCache] = None
         self._pod_cache: Optional[WatchCache] = None
         self._storage_caches: Dict[str, WatchCache] = {}
@@ -508,7 +514,15 @@ class KubeClusterAPI(ClusterAPI):
             if e.status != 404:
                 raise
 
+    EVENT_DEDUP_WINDOW_S = 600.0
+
     def record_event(self, kind: str, name: str, reason: str, message: str) -> None:
+        key = (kind, name, reason)
+        if not self._record_duplicated_events:
+            now = time.monotonic()
+            last = self._recent_events.get(key)
+            if last is not None and now - last < self.EVENT_DEDUP_WINDOW_S:
+                return  # correlator-suppressed repeat
         body = {
             "metadata": {"generateName": f"{name}.", "namespace": "default"},
             "involvedObject": {"kind": kind, "name": name},
@@ -520,7 +534,16 @@ class KubeClusterAPI(ClusterAPI):
         try:
             self.client.post("/api/v1/namespaces/default/events", body)
         except ApiError:
-            pass  # events are best-effort
+            return  # best-effort: a failed post must NOT start the dedup
+            # window, or retries of a never-landed event get suppressed
+        if not self._record_duplicated_events:
+            now = time.monotonic()
+            self._recent_events[key] = now
+            if len(self._recent_events) > 4096:  # bound the window store
+                cutoff = now - self.EVENT_DEDUP_WINDOW_S
+                self._recent_events = {
+                    k: t for k, t in self._recent_events.items() if t >= cutoff
+                }
 
 
 class KubeLease:

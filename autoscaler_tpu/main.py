@@ -158,6 +158,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="template NodeInfo cache TTL seconds")
     p.add_argument("--debugging-snapshot-enabled", type=_bool_flag, default=True,
                    help="serve /snapshotz captures")
+    p.add_argument("--record-duplicated-events", type=_bool_flag, default=False,
+                   help="post every event instead of suppressing repeats "
+                        "within the correlator window")
+    p.add_argument("--gce-concurrent-refreshes", type=int, default=1,
+                   help="concurrent MIG listings per refresh (main.go:194)")
     p.add_argument("--force-ds", type=_bool_flag, default=False,
                    help="charge suitable pending DaemonSets onto new-node "
                         "capacity (reference --force-ds)")
@@ -448,7 +453,9 @@ def main(argv=None) -> int:
         )
         try:
             provider = build_gce_provider(
-                args.nodes, gce_api, auto_discovery=opts.node_group_auto_discovery
+                args.nodes, gce_api,
+                auto_discovery=opts.node_group_auto_discovery,
+                concurrent_refreshes=args.gce_concurrent_refreshes,
             )
         except ValueError as e:  # malformed --nodes/discovery spec
             print(str(e), file=sys.stderr)
@@ -496,7 +503,10 @@ def main(argv=None) -> int:
                 args.kube_api, user_agent=opts.user_agent,
                 qps=args.kube_client_qps, burst=args.kube_client_burst,
             )
-        api = KubeClusterAPI(client, watch=True)
+        api = KubeClusterAPI(
+            client, watch=True,
+            record_duplicated_events=args.record_duplicated_events,
+        )
     else:
         from autoscaler_tpu.kube.api import FakeClusterAPI
 

@@ -271,7 +271,7 @@ class TestConcurrentRefresh:
             urls.append(
                 f"0:10:projects/proj/zones/us-central2-b/instanceGroups/pool-{i}"
             )
-        provider = build_gce_provider(urls, api)
+        provider = build_gce_provider(urls, api, concurrent_refreshes=4)
         threads = set()
         orig = provider._manager.instances
 

@@ -698,3 +698,25 @@ class TestKubeLease:
         elector.run(lambda still_leader: ran.append(still_leader()))
         assert ran == [True]
         assert "autoscaler-tpu" not in api_server.leases  # released on exit
+
+
+class TestEventCorrelation:
+    def test_repeats_suppressed_within_window(self, api_server):
+        api = KubeClusterAPI(KubeRestClient(api_server.url))
+        for _ in range(5):
+            api.record_event("Node", "n1", "ScaleDown", "removing n1")
+        posts = [p for m, p in api_server.writes if p.endswith("/events")]
+        assert len(posts) == 1  # correlator suppressed 4 repeats
+        # a different reason is its own series
+        api.record_event("Node", "n1", "ScaleUp", "adding capacity")
+        posts = [p for m, p in api_server.writes if p.endswith("/events")]
+        assert len(posts) == 2
+
+    def test_record_duplicated_events_posts_all(self, api_server):
+        api = KubeClusterAPI(
+            KubeRestClient(api_server.url), record_duplicated_events=True
+        )
+        for _ in range(3):
+            api.record_event("Node", "n1", "ScaleDown", "removing n1")
+        posts = [p for m, p in api_server.writes if p.endswith("/events")]
+        assert len(posts) == 3
