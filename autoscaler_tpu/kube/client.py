@@ -95,6 +95,93 @@ class KubeRestClient:
             self._ctx = None
 
     @staticmethod
+    def from_kubeconfig(
+        path: str,
+        context: str = "",
+        user_agent: str = "tpu-autoscaler",
+        qps: float = 0.0,
+        burst: int = 10,
+    ) -> "KubeRestClient":
+        """Minimal kubeconfig loader (--kubeconfig): current-context (or the
+        named one) → cluster server + CA + bearer token / client cert.
+        Covers token- and cert-based kubeconfigs; exec/auth-provider plugins
+        are not run — use a token-type credential for those clusters."""
+        import base64
+        import os
+        import tempfile
+
+        import yaml
+
+        with open(path) as f:
+            cfg = yaml.safe_load(f) or {}
+        # kubectl/client-go resolve relative credential paths against the
+        # kubeconfig's own directory, not CWD
+        base_dir = os.path.dirname(os.path.abspath(path))
+
+        def resolve(p: Optional[str]) -> Optional[str]:
+            if not p:
+                return p
+            return p if os.path.isabs(p) else os.path.join(base_dir, p)
+
+        def by_name(section, name):
+            for item in cfg.get(section) or ():
+                if item.get("name") == name:
+                    return item
+            raise ValueError(f"kubeconfig: no {section} entry named {name!r}")
+
+        ctx_name = context or cfg.get("current-context") or ""
+        if not ctx_name:
+            raise ValueError("kubeconfig: no current-context and none given")
+        ctx = by_name("contexts", ctx_name).get("context") or {}
+        cluster = by_name("clusters", ctx.get("cluster", "")).get("cluster") or {}
+        user = by_name("users", ctx.get("user", "")).get("user") or {}
+
+        server = cluster.get("server", "")
+        if not server:
+            raise ValueError("kubeconfig: cluster has no server")
+
+        temp_files: List[str] = []
+
+        def materialize(data_key: str, file_key: str, suffix: str):
+            """inline base64 data wins over a file path; data lands in a
+            private tempfile that is unlinked as soon as the SSL context has
+            loaded it (never left on disk)."""
+            data = cluster.get(data_key) or user.get(data_key)
+            if data:
+                fd, fname = tempfile.mkstemp(prefix="kubeconfig-", suffix=suffix)
+                with os.fdopen(fd, "wb") as out:
+                    out.write(base64.b64decode(data))
+                temp_files.append(fname)
+                return fname
+            return resolve(cluster.get(file_key) or user.get(file_key))
+
+        try:
+            ca_file = materialize("certificate-authority-data",
+                                  "certificate-authority", ".ca.crt")
+            token = user.get("token", "")
+            if not token and user.get("tokenFile"):
+                with open(resolve(user["tokenFile"])) as f:
+                    token = f.read().strip()
+            client = KubeRestClient(
+                server, token=token or None, ca_file=ca_file,
+                verify=not cluster.get("insecure-skip-tls-verify", False),
+                user_agent=user_agent, qps=qps, burst=burst,
+            )
+            cert = materialize(
+                "client-certificate-data", "client-certificate", ".crt"
+            )
+            key = materialize("client-key-data", "client-key", ".key")
+            if cert and key and client._ctx is not None:
+                client._ctx.load_cert_chain(cert, key)
+        finally:
+            for fname in temp_files:  # decoded keys must not persist on disk
+                try:
+                    os.unlink(fname)
+                except OSError:
+                    pass
+        return client
+
+    @staticmethod
     def in_cluster(
         user_agent: str = "tpu-autoscaler", qps: float = 0.0, burst: int = 10
     ) -> "KubeRestClient":
@@ -291,6 +378,8 @@ class KubeClusterAPI(ClusterAPI):
         # unless --record-duplicated-events asks for every one
         self._record_duplicated_events = record_duplicated_events
         self._recent_events: Dict[Tuple[str, str, str], float] = {}
+        # record_event is called from drain workers and batcher timers
+        self._events_lock = threading.Lock()
         self._node_cache: Optional[WatchCache] = None
         self._pod_cache: Optional[WatchCache] = None
         self._storage_caches: Dict[str, WatchCache] = {}
@@ -520,7 +609,8 @@ class KubeClusterAPI(ClusterAPI):
         key = (kind, name, reason)
         if not self._record_duplicated_events:
             now = time.monotonic()
-            last = self._recent_events.get(key)
+            with self._events_lock:
+                last = self._recent_events.get(key)
             if last is not None and now - last < self.EVENT_DEDUP_WINDOW_S:
                 return  # correlator-suppressed repeat
         body = {
@@ -538,12 +628,15 @@ class KubeClusterAPI(ClusterAPI):
             # window, or retries of a never-landed event get suppressed
         if not self._record_duplicated_events:
             now = time.monotonic()
-            self._recent_events[key] = now
-            if len(self._recent_events) > 4096:  # bound the window store
-                cutoff = now - self.EVENT_DEDUP_WINDOW_S
-                self._recent_events = {
-                    k: t for k, t in self._recent_events.items() if t >= cutoff
-                }
+            with self._events_lock:
+                self._recent_events[key] = now
+                if len(self._recent_events) > 4096:  # bound the window store
+                    cutoff = now - self.EVENT_DEDUP_WINDOW_S
+                    self._recent_events = {
+                        k: t
+                        for k, t in self._recent_events.items()
+                        if t >= cutoff
+                    }
 
 
 class KubeLease:

@@ -121,6 +121,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="control plane binding: 'in-cluster', or an API "
                         "server URL (empty with --provider=test uses the "
                         "in-memory fake)")
+    p.add_argument("--kubeconfig", default="",
+                   help="kubeconfig file for out-of-cluster runs (token- or "
+                        "cert-based credentials; exec plugins are not run). "
+                        "Mutually exclusive with a --kube-api URL.")
     p.add_argument("--max-drain-parallelism", type=int, default=1,
                    help="concurrent node drains (actuator worker pool)")
     p.add_argument("--max-scale-down-parallelism", type=int, default=10)
@@ -411,6 +415,12 @@ def main(argv=None) -> int:
     from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
     from autoscaler_tpu.debugging import DebuggingSnapshotter
 
+    if args.kube_api and args.kubeconfig:
+        # pure argv validation comes before any cloud I/O
+        print("--kube-api and --kubeconfig are mutually exclusive",
+              file=sys.stderr)
+        return 2
+
     if args.provider == "test":
         from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
 
@@ -460,7 +470,7 @@ def main(argv=None) -> int:
         except ValueError as e:  # malformed --nodes/discovery spec
             print(str(e), file=sys.stderr)
             return 2
-        if not args.kube_api:
+        if not (args.kube_api or args.kubeconfig):
             # pairing real MIG mutations with the empty in-memory fake would
             # mark every healthy instance unregistered and, after
             # max-node-provision-time, DELETE real VMs — fail closed
@@ -478,7 +488,9 @@ def main(argv=None) -> int:
         )
         return 2
 
-    if args.expander_priority_config_map and not args.kube_api:
+    if args.expander_priority_config_map and not (
+        args.kube_api or args.kubeconfig
+    ):
         # fail closed, like --provider=gce: without a control-plane binding
         # the ConfigMap can never be read and the priority expander would
         # silently behave as unconfigured
@@ -490,10 +502,19 @@ def main(argv=None) -> int:
         )
         return 2
 
-    if args.kube_api:
+    if args.kube_api or args.kubeconfig:
         from autoscaler_tpu.kube.client import KubeClusterAPI, KubeRestClient
 
-        if args.kube_api == "in-cluster":
+        if args.kubeconfig:
+            try:
+                client = KubeRestClient.from_kubeconfig(
+                    args.kubeconfig, user_agent=opts.user_agent,
+                    qps=args.kube_client_qps, burst=args.kube_client_burst,
+                )
+            except (OSError, ValueError) as e:
+                print(f"--kubeconfig {args.kubeconfig}: {e}", file=sys.stderr)
+                return 2
+        elif args.kube_api == "in-cluster":
             client = KubeRestClient.in_cluster(
                 user_agent=opts.user_agent,
                 qps=args.kube_client_qps, burst=args.kube_client_burst,

@@ -720,3 +720,64 @@ class TestEventCorrelation:
             api.record_event("Node", "n1", "ScaleDown", "removing n1")
         posts = [p for m, p in api_server.writes if p.endswith("/events")]
         assert len(posts) == 3
+
+
+class TestKubeconfig:
+    def _write_kubeconfig(self, tmp_path, server, token="tok-abc",
+                          ca_pem=None, insecure=False):
+        import base64
+
+        cluster = {"server": server}
+        if ca_pem:
+            cluster["certificate-authority-data"] = base64.b64encode(
+                ca_pem
+            ).decode()
+        if insecure:
+            cluster["insecure-skip-tls-verify"] = True
+        cfg = {
+            "apiVersion": "v1",
+            "kind": "Config",
+            "current-context": "dev",
+            "contexts": [{"name": "dev",
+                          "context": {"cluster": "c1", "user": "u1"}}],
+            "clusters": [{"name": "c1", "cluster": cluster}],
+            "users": [{"name": "u1", "user": {"token": token}}],
+        }
+        import yaml
+
+        path = tmp_path / "kubeconfig"
+        path.write_text(yaml.safe_dump(cfg))
+        return str(path)
+
+    def test_token_kubeconfig_against_live_server(self, api_server, tmp_path):
+        api_server.nodes["n1"] = node_json("n1")
+        path = self._write_kubeconfig(tmp_path, api_server.url)
+        client = KubeRestClient.from_kubeconfig(path)
+        assert client.token == "tok-abc"
+        api = KubeClusterAPI(client)
+        assert [n.name for n in api.list_nodes()] == ["n1"]
+
+    def test_named_context_and_errors(self, api_server, tmp_path):
+        path = self._write_kubeconfig(tmp_path, api_server.url)
+        # the named context works like current-context
+        client = KubeRestClient.from_kubeconfig(path, context="dev")
+        assert client.base_url == api_server.url
+        with pytest.raises(ValueError):
+            KubeRestClient.from_kubeconfig(path, context="nope")
+
+    def test_token_file_credential(self, api_server, tmp_path):
+        import yaml
+
+        tok = tmp_path / "t"
+        tok.write_text("from-file\n")
+        cfg = {
+            "current-context": "dev",
+            "contexts": [{"name": "dev",
+                          "context": {"cluster": "c1", "user": "u1"}}],
+            "clusters": [{"name": "c1", "cluster": {"server": api_server.url}}],
+            "users": [{"name": "u1", "user": {"tokenFile": str(tok)}}],
+        }
+        path = tmp_path / "kc"
+        path.write_text(yaml.safe_dump(cfg))
+        client = KubeRestClient.from_kubeconfig(str(path))
+        assert client.token == "from-file"
