@@ -113,7 +113,10 @@ class KubeRestClient:
         import yaml
 
         with open(path) as f:
-            cfg = yaml.safe_load(f) or {}
+            try:
+                cfg = yaml.safe_load(f) or {}
+            except yaml.YAMLError as e:
+                raise ValueError(f"not valid kubeconfig YAML: {e}") from None
         # kubectl/client-go resolve relative credential paths against the
         # kubeconfig's own directory, not CWD
         base_dir = os.path.dirname(os.path.abspath(path))
@@ -162,6 +165,22 @@ class KubeRestClient:
             if not token and user.get("tokenFile"):
                 with open(resolve(user["tokenFile"])) as f:
                     token = f.read().strip()
+            has_client_cert = bool(
+                user.get("client-certificate-data")
+                or user.get("client-certificate")
+            )
+            if not token and not has_client_cert:
+                # fail CLOSED rather than 401 at runtime — except for plain
+                # http servers (kubectl proxy), which legitimately carry no
+                # credentials
+                if user.get("exec") or user.get("auth-provider"):
+                    raise ValueError(
+                        "kubeconfig user has an exec/auth-provider "
+                        "credential (not supported — use a token or "
+                        "client certificate)"
+                    )
+                if server.startswith("https"):
+                    raise ValueError("kubeconfig user has no usable credential")
             client = KubeRestClient(
                 server, token=token or None, ca_file=ca_file,
                 verify=not cluster.get("insecure-skip-tls-verify", False),

@@ -52,14 +52,21 @@ class VpaRunner:
         checkpoint_path: str = "",
         components: tuple = ("recommender", "updater"),
         half_life_s: float = 24 * 3600.0,
+        recommender: "PercentileRecommender" = None,
     ):
         self.binding = binding
         self.cluster_api = cluster_api
         self.metrics_source = metrics_source
         self.checkpoint_path = checkpoint_path
         self.components = components
-        self.model = ClusterStateModel(half_life_s=half_life_s)
-        self.recommender = PercentileRecommender(self.model)
+        # a supplied recommender brings its model: the feeder must feed the
+        # SAME model the recommender reads
+        if recommender is not None:
+            self.model = recommender.model
+            self.recommender = recommender
+        else:
+            self.model = ClusterStateModel(half_life_s=half_life_s)
+            self.recommender = PercentileRecommender(self.model)
         self.updater = Updater()
         # both containers keep their identity across passes: the admission
         # server holds references to them (test_vpa_e2e.py does the same)
@@ -181,6 +188,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="local JSON checkpoint path ('' = stateless)")
     p.add_argument("--memory-half-life", type=float, default=24 * 3600.0,
                    help="histogram decay half-life seconds (default 24h)")
+    p.add_argument("--recommendation-margin-fraction", type=float, default=0.15,
+                   help="safety margin added to recommendations")
+    p.add_argument("--target-cpu-percentile", type=float, default=0.9)
+    p.add_argument("--pod-recommendation-min-cpu-millicores", type=float,
+                   default=25.0)
+    p.add_argument("--pod-recommendation-min-memory-mb", type=float,
+                   default=250.0)
     p.add_argument("--admission-port", type=int, default=8443)
     p.add_argument("--webhook-service", default="vpa-webhook",
                    help="Service name the webhook registration points at")
@@ -205,6 +219,7 @@ def main(argv=None) -> int:
     api = KubeClusterAPI(client)
     binding = VpaKubeBinding(client)
 
+    model = ClusterStateModel(half_life_s=args.memory_half_life)
     runner = VpaRunner(
         binding,
         api,
@@ -212,7 +227,14 @@ def main(argv=None) -> int:
         KubeMetricsSource(client, lambda: runner.last_pod_labels),
         checkpoint_path=args.checkpoint_file,
         components=components,
-        half_life_s=args.memory_half_life,
+        # half-life lives in the model the recommender brings
+        recommender=PercentileRecommender(
+            model,
+            target_cpu_percentile=args.target_cpu_percentile,
+            safety_margin=1.0 + args.recommendation_margin_fraction,
+            min_cpu_cores=args.pod_recommendation_min_cpu_millicores / 1000.0,
+            min_memory_bytes=args.pod_recommendation_min_memory_mb * 1024 * 1024,
+        ),
     )
 
     admission = None

@@ -198,8 +198,24 @@ class PercentileRecommender:
     """The estimator chain: percentile → confidence scaling → margin → min
     floor (logic/estimator.go:43,70,87)."""
 
-    def __init__(self, model: ClusterStateModel):
+    def __init__(
+        self,
+        model: ClusterStateModel,
+        target_cpu_percentile: float = TARGET_PERCENTILE,
+        safety_margin: float = SAFETY_MARGIN,
+        min_cpu_cores: float = MIN_CPU_CORES,
+        min_memory_bytes: float = MIN_MEMORY_BYTES,
+    ):
+        """Knobs mirror the reference recommender flags
+        (logic/recommender.go:28-36: --recommendation-margin-fraction,
+        --target-cpu-percentile, --pod-recommendation-min-cpu-millicores,
+        --pod-recommendation-min-memory-mb). target_cpu_percentile affects
+        the CPU target only, exactly like the reference."""
         self.model = model
+        self.target_cpu_percentile = target_cpu_percentile
+        self.safety_margin = safety_margin
+        self.min_cpu_cores = min_cpu_cores
+        self.min_memory_bytes = min_memory_bytes
 
     def recommend(self, now_ts: Optional[float] = None) -> Dict[ContainerKey, Recommendation]:
         now_ts = now_ts if now_ts is not None else time.time()
@@ -207,7 +223,9 @@ class PercentileRecommender:
         if not keys:
             return {}
         # all percentiles across all containers: six cumsum passes total
-        cpu_t = np.asarray(self.model.cpu.percentile(TARGET_PERCENTILE))
+        cpu_t = np.asarray(
+            self.model.cpu.percentile(self.target_cpu_percentile)
+        )
         cpu_l = np.asarray(self.model.cpu.percentile(LOWER_PERCENTILE))
         cpu_u = np.asarray(self.model.cpu.percentile(UPPER_PERCENTILE))
         mem_t = np.asarray(self.model.memory.percentile(TARGET_PERCENTILE))
@@ -226,23 +244,21 @@ class PercentileRecommender:
             upper_mult = (1.0 + 1.0 / days) ** CONFIDENCE_EXPONENT
             lower_mult = (1.0 + 0.001 / days) ** -2.0
             rec = Recommendation(
-                target_cpu=self._floor_cpu(cpu_t[i] * SAFETY_MARGIN),
-                target_memory=self._floor_mem(mem_t[i] * SAFETY_MARGIN),
-                lower_cpu=self._floor_cpu(cpu_l[i] * SAFETY_MARGIN * lower_mult),
-                lower_memory=self._floor_mem(mem_l[i] * SAFETY_MARGIN * lower_mult),
-                upper_cpu=self._floor_cpu(cpu_u[i] * SAFETY_MARGIN * upper_mult),
-                upper_memory=self._floor_mem(mem_u[i] * SAFETY_MARGIN * upper_mult),
+                target_cpu=self._floor_cpu(cpu_t[i] * self.safety_margin),
+                target_memory=self._floor_mem(mem_t[i] * self.safety_margin),
+                lower_cpu=self._floor_cpu(cpu_l[i] * self.safety_margin * lower_mult),
+                lower_memory=self._floor_mem(mem_l[i] * self.safety_margin * lower_mult),
+                upper_cpu=self._floor_cpu(cpu_u[i] * self.safety_margin * upper_mult),
+                upper_memory=self._floor_mem(mem_u[i] * self.safety_margin * upper_mult),
             )
             out[key] = rec
         return out
 
-    @staticmethod
-    def _floor_cpu(v: float) -> float:
-        return max(float(v), MIN_CPU_CORES)
+    def _floor_cpu(self, v: float) -> float:
+        return max(float(v), self.min_cpu_cores)
 
-    @staticmethod
-    def _floor_mem(v: float) -> float:
-        return max(float(v), float(MIN_MEMORY_BYTES))
+    def _floor_mem(self, v: float) -> float:
+        return max(float(v), float(self.min_memory_bytes))
 
 
 @dataclass

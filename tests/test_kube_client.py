@@ -781,3 +781,61 @@ class TestKubeconfig:
         path.write_text(yaml.safe_dump(cfg))
         client = KubeRestClient.from_kubeconfig(str(path))
         assert client.token == "from-file"
+
+
+class TestKubeconfigFailClosed:
+    def test_exec_credential_rejected(self, api_server, tmp_path):
+        import yaml
+
+        cfg = {
+            "current-context": "dev",
+            "contexts": [{"name": "dev",
+                          "context": {"cluster": "c1", "user": "u1"}}],
+            "clusters": [{"name": "c1",
+                          "cluster": {"server": "https://example.invalid"}}],
+            "users": [{"name": "u1",
+                       "user": {"exec": {"command": "gke-gcloud-auth-plugin"}}}],
+        }
+        path = tmp_path / "kc"
+        path.write_text(yaml.safe_dump(cfg))
+        with pytest.raises(ValueError, match="exec/auth-provider"):
+            KubeRestClient.from_kubeconfig(str(path))
+
+    def test_https_without_credentials_rejected(self, tmp_path):
+        import yaml
+
+        cfg = {
+            "current-context": "dev",
+            "contexts": [{"name": "dev",
+                          "context": {"cluster": "c1", "user": "u1"}}],
+            "clusters": [{"name": "c1",
+                          "cluster": {"server": "https://example.invalid"}}],
+            "users": [{"name": "u1", "user": {}}],
+        }
+        path = tmp_path / "kc"
+        path.write_text(yaml.safe_dump(cfg))
+        with pytest.raises(ValueError, match="no usable credential"):
+            KubeRestClient.from_kubeconfig(str(path))
+
+    def test_http_proxy_without_credentials_ok(self, api_server, tmp_path):
+        """kubectl-proxy kubeconfigs (plain http, no user creds) work."""
+        import yaml
+
+        api_server.nodes["n1"] = node_json("n1")
+        cfg = {
+            "current-context": "dev",
+            "contexts": [{"name": "dev",
+                          "context": {"cluster": "c1", "user": "u1"}}],
+            "clusters": [{"name": "c1", "cluster": {"server": api_server.url}}],
+            "users": [{"name": "u1", "user": {}}],
+        }
+        path = tmp_path / "kc"
+        path.write_text(yaml.safe_dump(cfg))
+        client = KubeRestClient.from_kubeconfig(str(path))
+        assert [n.name for n in KubeClusterAPI(client).list_nodes()] == ["n1"]
+
+    def test_bad_yaml_is_value_error(self, tmp_path):
+        path = tmp_path / "kc"
+        path.write_text("{unclosed: [")
+        with pytest.raises(ValueError, match="not valid kubeconfig YAML"):
+            KubeRestClient.from_kubeconfig(str(path))

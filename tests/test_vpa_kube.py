@@ -289,3 +289,65 @@ class TestVpaRunnerOverHttp:
             stats = runner.run_once(now_ts=1000.0 + i * 60.0)
         assert stats["evicted"] == 0
         assert not any("/eviction" in path for _, path in srv.writes)
+
+
+class TestRecommenderKnobs:
+    def test_flags_reach_the_estimator_chain(self, srv):
+        """--recommendation-margin-fraction / --target-cpu-percentile /
+        --pod-recommendation-min-* flow into the chain, and the runner feeds
+        the SAME model the supplied recommender reads."""
+        from autoscaler_tpu.vpa.main import VpaRunner, build_arg_parser
+        from autoscaler_tpu.vpa.recommender import (
+            ClusterStateModel,
+            PercentileRecommender,
+        )
+
+        args = build_arg_parser().parse_args([
+            "--kube-api", "http://ignored",
+            "--recommendation-margin-fraction", "0.5",
+            "--target-cpu-percentile", "0.5",
+            "--pod-recommendation-min-cpu-millicores", "100",
+            "--pod-recommendation-min-memory-mb", "64",
+        ])
+        model = ClusterStateModel()
+        rec = PercentileRecommender(
+            model,
+            target_cpu_percentile=args.target_cpu_percentile,
+            safety_margin=1.0 + args.recommendation_margin_fraction,
+            min_cpu_cores=args.pod_recommendation_min_cpu_millicores / 1000.0,
+            min_memory_bytes=args.pod_recommendation_min_memory_mb * 1024 * 1024,
+        )
+        assert rec.safety_margin == pytest.approx(1.5)
+        assert rec.min_cpu_cores == pytest.approx(0.1)
+        client = KubeRestClient(srv.url)
+        runner = VpaRunner(
+            VpaKubeBinding(client),
+            KubeClusterAPI(client),
+            KubeMetricsSource(client, lambda: {}),
+            recommender=rec,
+        )
+        assert runner.model is model  # feeder and recommender share state
+
+    def test_custom_margin_changes_recommendation(self, srv):
+        client, api, pod_labels = TestVpaRunnerOverHttp()._world(srv)
+        from autoscaler_tpu.vpa.main import VpaRunner
+        from autoscaler_tpu.vpa.recommender import (
+            ClusterStateModel,
+            PercentileRecommender,
+        )
+
+        def run_with_margin(margin):
+            model = ClusterStateModel()
+            runner = VpaRunner(
+                VpaKubeBinding(client), api,
+                KubeMetricsSource(client, pod_labels),
+                recommender=PercentileRecommender(model, safety_margin=margin),
+            )
+            runner.run_once(now_ts=1000.0)
+            (rec,) = srv.vpas["default/hamster-vpa"]["status"][
+                "recommendation"]["containerRecommendations"]
+            return int(rec["target"]["cpu"].rstrip("m"))
+
+        lean = run_with_margin(1.0)
+        fat = run_with_margin(2.0)
+        assert fat == pytest.approx(lean * 2, rel=0.05)
