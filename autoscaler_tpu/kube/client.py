@@ -165,10 +165,23 @@ class KubeRestClient:
             if not token and user.get("tokenFile"):
                 with open(resolve(user["tokenFile"])) as f:
                     token = f.read().strip()
-            has_client_cert = bool(
+            has_cert = bool(
                 user.get("client-certificate-data")
                 or user.get("client-certificate")
             )
+            has_key = bool(
+                user.get("client-key-data") or user.get("client-key")
+            )
+            if has_cert != has_key:
+                raise ValueError(
+                    "kubeconfig user has a client certificate without its "
+                    "key (or vice versa)"
+                )
+            if has_cert and not server.startswith("https"):
+                raise ValueError(
+                    "kubeconfig client certificates need an https server"
+                )
+            has_client_cert = has_cert and has_key
             if not token and not has_client_cert:
                 # fail CLOSED rather than 401 at runtime — except for plain
                 # http servers (kubectl proxy), which legitimately carry no

@@ -36,7 +36,7 @@ from autoscaler_tpu.vpa.recommender import (
     Recommendation,
 )
 from autoscaler_tpu.utils.poll import poll_loop
-from autoscaler_tpu.vpa.updater import Updater
+from autoscaler_tpu.vpa.updater import EvictionRateLimiter, Updater
 
 log = logging.getLogger("vpa")
 
@@ -53,6 +53,7 @@ class VpaRunner:
         components: tuple = ("recommender", "updater"),
         half_life_s: float = 24 * 3600.0,
         recommender: "PercentileRecommender" = None,
+        updater: Optional[Updater] = None,
     ):
         self.binding = binding
         self.cluster_api = cluster_api
@@ -67,7 +68,7 @@ class VpaRunner:
         else:
             self.model = ClusterStateModel(half_life_s=half_life_s)
             self.recommender = PercentileRecommender(self.model)
-        self.updater = Updater()
+        self.updater = updater or Updater()
         # both containers keep their identity across passes: the admission
         # server holds references to them (test_vpa_e2e.py does the same)
         self.recommendations: Dict[ContainerKey, Recommendation] = {}
@@ -176,6 +177,15 @@ class VpaRunner:
         return stats
 
 
+def _fraction(s: str) -> float:
+    v = float(s)
+    if not (0.0 < v <= 1.0):
+        raise argparse.ArgumentTypeError(
+            f"expected a fraction in (0, 1], got {s}"
+        )
+    return v
+
+
 def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("tpu-autoscaler-vpa")
     p.add_argument("--kube-api", required=True,
@@ -190,11 +200,19 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="histogram decay half-life seconds (default 24h)")
     p.add_argument("--recommendation-margin-fraction", type=float, default=0.15,
                    help="safety margin added to recommendations")
-    p.add_argument("--target-cpu-percentile", type=float, default=0.9)
+    p.add_argument("--target-cpu-percentile", type=_fraction, default=0.9,
+                   help="in (0, 1]")
     p.add_argument("--pod-recommendation-min-cpu-millicores", type=float,
                    default=25.0)
     p.add_argument("--pod-recommendation-min-memory-mb", type=float,
                    default=250.0)
+    p.add_argument("--eviction-tolerance", type=float, default=0.5,
+                   help="fraction of a workload's replicas the updater may "
+                        "disrupt per pass")
+    p.add_argument("--updater-min-replicas", type=int, default=2,
+                   help="workloads below this replica count are never "
+                        "evicted by the updater")
+    p.add_argument("--webhook-timeout-seconds", type=int, default=30)
     p.add_argument("--admission-port", type=int, default=8443)
     p.add_argument("--webhook-service", default="vpa-webhook",
                    help="Service name the webhook registration points at")
@@ -235,6 +253,12 @@ def main(argv=None) -> int:
             min_cpu_cores=args.pod_recommendation_min_cpu_millicores / 1000.0,
             min_memory_bytes=args.pod_recommendation_min_memory_mb * 1024 * 1024,
         ),
+        updater=Updater(
+            rate_limiter=EvictionRateLimiter(
+                eviction_tolerance=args.eviction_tolerance,
+                min_replicas=args.updater_min_replicas,
+            )
+        ),
     )
 
     admission = None
@@ -263,6 +287,7 @@ def main(argv=None) -> int:
                 bundle,
                 service_name=args.webhook_service,
                 namespace=args.webhook_namespace,
+                timeout_seconds=args.webhook_timeout_seconds,
             ),
         )
         print(f"vpa admission webhook on :{args.admission_port} (TLS), "

@@ -84,6 +84,9 @@ class EvictionRateLimiter:
     def budget_for(self, replica_count: int) -> int:
         if replica_count < self.min_replicas:
             return 0
+        if self.eviction_tolerance <= 0:
+            # tolerance 0 means "never disrupt", not "one per pass"
+            return 0
         return max(1, int(replica_count * self.eviction_tolerance))
 
 

@@ -351,3 +351,19 @@ class TestRecommenderKnobs:
         lean = run_with_margin(1.0)
         fat = run_with_margin(2.0)
         assert fat == pytest.approx(lean * 2, rel=0.05)
+
+    def test_updater_knobs_reach_rate_limiter(self, srv):
+        from autoscaler_tpu.vpa.main import VpaRunner
+        from autoscaler_tpu.vpa.updater import EvictionRateLimiter, Updater
+
+        client = KubeRestClient(srv.url)
+        runner = VpaRunner(
+            VpaKubeBinding(client), KubeClusterAPI(client),
+            KubeMetricsSource(client, lambda: {}),
+            updater=Updater(rate_limiter=EvictionRateLimiter(
+                eviction_tolerance=0.25, min_replicas=4)),
+        )
+        assert runner.updater.rate_limiter.min_replicas == 4
+        # a 3-replica workload is untouchable at min_replicas=4
+        assert runner.updater.rate_limiter.budget_for(3) == 0
+        assert runner.updater.rate_limiter.budget_for(8) == 2
