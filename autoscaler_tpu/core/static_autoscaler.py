@@ -412,7 +412,9 @@ class StaticAutoscaler:
                 self.api.list_nodes(), self.scale_down_planner.unneeded_names()
             )
         if self.debugger is not None and self.debugger.is_data_collection_allowed():
-            self.debugger.capture(self, snapshot, pending, result)
+            self.debugger.capture(
+                self, snapshot, pending, result, filtered_pods=filtered
+            )
         return result
 
     # -- helpers -------------------------------------------------------------

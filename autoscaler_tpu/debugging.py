@@ -31,8 +31,12 @@ class DebuggingSnapshotter:
         with self._lock:
             return self._requested
 
-    def capture(self, autoscaler, snapshot, pending_pods, result) -> None:
-        """Called at the end of a loop iteration when armed."""
+    def capture(
+        self, autoscaler, snapshot, pending_pods, result, filtered_pods=()
+    ) -> None:
+        """Called at the end of a loop iteration when armed. filtered_pods:
+        the pods filter-out-schedulable absorbed this loop — the reference's
+        'unscheduled pods that could be scheduled' population."""
         with self._lock:
             if not self._requested:
                 return
@@ -52,11 +56,29 @@ class DebuggingSnapshotter:
                         "taints": [t.key for t in node.taints],
                     }
                 )
+            # "unscheduled pods that could be scheduled" — the reference's
+            # debugging_snapshot.go:36-135 headline field IS the set filter-
+            # out-schedulable absorbed this loop (filter_out_schedulable.go
+            # feeds it). Additionally report still-pending pods that fit raw
+            # free capacity individually but lost the greedy packing race —
+            # the "why is this pod pending" answer an operator wants next.
+            could_schedule = [p.key() for p in filtered_pods]
+            lost_packing_race = []
+            if pending_pods:
+                from autoscaler_tpu.ops.fit import fits_any_node
+
+                any_fit = np.asarray(fits_any_node(tensors))
+                for p in pending_pods:
+                    i = meta.pod_index.get(p.key())
+                    if i is not None and any_fit[i]:
+                        lost_packing_race.append(p.key())
             self._payload = {
                 "captured_at": time.time(),
                 "node_count": len(nodes),
                 "pod_count": len(snapshot.pods()),
                 "pending_pods": [p.key() for p in pending_pods],
+                "unscheduled_pods_can_be_scheduled": could_schedule,
+                "pending_pods_fitting_free_capacity": lost_packing_race,
                 "tensor_shapes": {
                     "pods": list(tensors.pod_req.shape),
                     "nodes": list(tensors.node_alloc.shape),

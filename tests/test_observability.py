@@ -450,3 +450,30 @@ class TestRound2KnobWiring:
         assert orch.estimator.limiter.max_duration_s == 5.0
         procs = default_processors(opts)
         assert procs.template_node_info_provider.ttl_s == 123.0
+
+
+class TestDebuggingCouldSchedule:
+    def test_unscheduled_pods_can_be_scheduled_field(self):
+        """debugging_snapshot.go:36-135 — a pending pod with room on an
+        existing node is reported as schedulable; an oversized one is not."""
+        provider = TestCloudProvider()
+        api = FakeClusterAPI()
+        provider.add_node_group(
+            "g", 0, 10, 1, build_test_node("t", cpu_m=2000, mem=4 * GB)
+        )
+        node = build_test_node("g-0", cpu_m=2000, mem=4 * GB)
+        provider.add_node("g", node)
+        api.add_node(node)
+        api.add_pod(build_test_pod("fits", cpu_m=500, mem=GB))
+        api.add_pod(build_test_pod("huge", cpu_m=9000, mem=GB))
+        a = StaticAutoscaler(
+            provider, api, AutoscalingOptions(), debugger=DebuggingSnapshotter()
+        )
+        a.debugger.request()
+        a.run_once(now_ts=0.0)
+        data = json.loads(a.debugger.get())
+        # the absorbed pod IS the reference's headline field (positive path)
+        assert data["unscheduled_pods_can_be_scheduled"] == ["default/fits"]
+        assert "default/huge" not in data["unscheduled_pods_can_be_scheduled"]
+        assert "default/huge" in data["pending_pods"]
+        assert "default/huge" not in data["pending_pods_fitting_free_capacity"]
