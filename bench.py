@@ -283,6 +283,9 @@ def main():
                 continue
         result, note, kind = _run_child(platform, timeout_s)
         if result is not None:
+            if notes and result.get("platform") != "tpu":
+                # a fallback capture must say WHY the TPU attempt failed
+                result["fallback_reason"] = "; ".join(notes)
             # Persist TPU captures; on a CPU fallback attach the last real
             # TPU capture (clearly labeled, with its own timestamp) so a
             # wedged tunnel degrades the round's evidence instead of
