@@ -34,6 +34,12 @@ class UnneededNodes:
     def __init__(self) -> None:
         self._entries: Dict[str, _UnneededEntry] = {}
 
+    def reset(self) -> None:
+        """Drop all unneeded clocks (the reference's ResetUnneededNodes
+        callback, fired when the cluster becomes non-actionable so stale
+        timers can't trigger deletions when it resumes)."""
+        self._entries.clear()
+
     def update(self, unneeded: Sequence[Node], now_ts: float) -> None:
         names = {n.name for n in unneeded}
         for name in list(self._entries):

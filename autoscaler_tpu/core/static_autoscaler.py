@@ -239,6 +239,10 @@ class StaticAutoscaler:
         # actionable-cluster gate (reference processors/actionablecluster)
         if not self.processors.actionable_cluster.should_autoscale(all_nodes, now_ts):
             result.errors.append("cluster not actionable this iteration")
+            # OnEmptyCluster → ResetUnneededNodes (actionable_cluster_
+            # processor.go:68 via processors/callbacks): stale unneeded
+            # clocks must not fire deletions the moment the cluster resumes
+            self.scale_down_planner.unneeded.reset()
             return result
 
         # accelerator nodes still attaching devices count as unready

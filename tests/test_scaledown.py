@@ -1060,3 +1060,33 @@ class TestNewlyWiredKnobs:
         opts = AutoscalingOptions(max_autoprovisioned_node_group_count=3)
         procs = default_processors(opts)
         assert procs.node_group_manager.max_autoprovisioned == 3
+
+    def test_non_actionable_cluster_resets_unneeded_clocks(self):
+        """ResetUnneededNodes (actionable_cluster_processor.go:68): a loop
+        that aborts on the gate clears unneeded timers, so nodes can't be
+        deleted on resume using clocks accumulated while not actionable."""
+        from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+
+        provider = TestCloudProvider()
+        api = FakeClusterAPI()
+        provider.add_node_group("g", 0, 10, 2,
+                                build_test_node("t", cpu_m=4000, mem=8 * GB))
+        for i in range(2):
+            n = build_test_node(f"g-{i}", cpu_m=4000, mem=8 * GB)
+            provider.add_node("g", n)
+            api.add_node(n)
+        opts = AutoscalingOptions(scale_down_delay_after_add_s=0.0)
+        opts.node_group_defaults.scale_down_unneeded_time_s = 100.0
+        a = StaticAutoscaler(provider, api, opts)
+        a.run_once(now_ts=0.0)       # both nodes empty → unneeded clocks start
+        assert a.scale_down_planner.unneeded.names()
+        # the cluster goes non-actionable (all nodes unready + from-zero off)
+        from autoscaler_tpu.processors.pipeline import EmptyClusterProcessor
+
+        a.processors.actionable_cluster = EmptyClusterProcessor(
+            scale_up_from_zero=False
+        )
+        for n in api.list_nodes():
+            n.ready = False
+        a.run_once(now_ts=50.0)      # gate aborts → clocks reset
+        assert a.scale_down_planner.unneeded.names() == []
