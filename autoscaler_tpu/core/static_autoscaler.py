@@ -26,7 +26,7 @@ from autoscaler_tpu.core.scaledown.actuator import ActuationResult, ScaleDownAct
 from autoscaler_tpu.core.scaledown.planner import ScaleDownPlanner
 from autoscaler_tpu.core.scaleup.orchestrator import ScaleUpOrchestrator, ScaleUpResult
 from autoscaler_tpu.kube.api import ClusterAPI
-from autoscaler_tpu.kube.objects import Node, Pod
+from autoscaler_tpu.kube.objects import Node, Pod, Resources
 from autoscaler_tpu.metrics import metrics as metrics_mod
 from autoscaler_tpu.metrics.healthcheck import HealthCheck
 from autoscaler_tpu.simulator.removal import UnremovableReason
@@ -304,9 +304,15 @@ class StaticAutoscaler:
                 if now_ts - p.creation_ts >= self.options.new_pod_scale_up_delay_s
             ]
 
+        # pending-DaemonSet charge shared by upcoming-node injection and the
+        # scale-up templates (--force-ds): one LIST per loop
+        pending_ds = (
+            self.api.list_daemonsets() if self.options.force_daemonsets else ()
+        )
+
         # upcoming (requested-not-yet-registered) nodes join the simulation as
         # virtual template nodes (:484-519)
-        upcoming_names = self._inject_upcoming_nodes(snapshot, now_ts)
+        upcoming_names = self._inject_upcoming_nodes(snapshot, now_ts, pending_ds)
 
         self.metrics.observe_duration(metrics_mod.SNAPSHOT_BUILD, t_snap)
 
@@ -338,11 +344,7 @@ class StaticAutoscaler:
                 pods_of_node=snapshot.pods_on_node,
                 # --force-ds additionally charges suitable-but-not-yet-
                 # running DaemonSets (simulator/nodes.go:56)
-                pending_daemonsets=(
-                    self.api.list_daemonsets()
-                    if self.options.force_daemonsets
-                    else ()
-                ),
+                pending_daemonsets=pending_ds,
             )
             self.metrics.observe_duration(metrics_mod.SCALE_UP, t_up)
             result.scale_up = up
@@ -441,7 +443,7 @@ class StaticAutoscaler:
         return scheduled, pending
 
     def _inject_upcoming_nodes(
-        self, snapshot: ClusterSnapshot, now_ts: float
+        self, snapshot: ClusterSnapshot, now_ts: float, pending_ds=()
     ) -> List[str]:
         """Virtual nodes for capacity that was requested but hasn't
         registered (:484-519) so we don't double scale-up.
@@ -457,16 +459,11 @@ class StaticAutoscaler:
         groups = {g.id(): g for g in self.provider.node_groups()}
         tmpl_provider = self.processors.template_node_info_provider
         nodes_by_group: Dict[str, List[Node]] = {}
-        pending_ds = ()
         if tmpl_provider is not None and upcoming:
             for node in snapshot.nodes():
                 g = self.provider.node_group_for_node(node)
                 if g is not None:
                     nodes_by_group.setdefault(g.id(), []).append(node)
-            if self.options.force_daemonsets:
-                # the same pending-DS charge as the scale-up path — an
-                # upcoming node boots those daemonsets too
-                pending_ds = self.api.list_daemonsets()
         for gid, count in upcoming.items():
             group = groups.get(gid)
             if group is None:
@@ -485,8 +482,6 @@ class StaticAutoscaler:
                     continue
             if template is None:
                 continue
-            from autoscaler_tpu.kube.objects import Resources
-
             cap = template.packing_capacity()
             for i in range(count):
                 virtual = dataclasses.replace(
