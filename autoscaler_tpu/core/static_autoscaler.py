@@ -305,14 +305,23 @@ class StaticAutoscaler:
             ]
 
         # pending-DaemonSet charge shared by upcoming-node injection and the
-        # scale-up templates (--force-ds): one LIST per loop
-        pending_ds = (
-            self.api.list_daemonsets() if self.options.force_daemonsets else ()
-        )
+        # scale-up templates (--force-ds): lazily fetched at most once per
+        # loop — idle iterations (nothing pending, nothing upcoming) issue
+        # no LIST at all
+        ds_memo: List = []
+
+        def pending_ds():
+            if not self.options.force_daemonsets:
+                return ()
+            if not ds_memo:
+                ds_memo.append(self.api.list_daemonsets())
+            return ds_memo[0]
 
         # upcoming (requested-not-yet-registered) nodes join the simulation as
         # virtual template nodes (:484-519)
-        upcoming_names = self._inject_upcoming_nodes(snapshot, now_ts, pending_ds)
+        upcoming_names = self._inject_upcoming_nodes(
+            snapshot, now_ts, pending_ds
+        )
 
         self.metrics.observe_duration(metrics_mod.SNAPSHOT_BUILD, t_snap)
 
@@ -344,7 +353,7 @@ class StaticAutoscaler:
                 pods_of_node=snapshot.pods_on_node,
                 # --force-ds additionally charges suitable-but-not-yet-
                 # running DaemonSets (simulator/nodes.go:56)
-                pending_daemonsets=pending_ds,
+                pending_daemonsets=pending_ds(),
             )
             self.metrics.observe_duration(metrics_mod.SCALE_UP, t_up)
             result.scale_up = up
@@ -443,7 +452,7 @@ class StaticAutoscaler:
         return scheduled, pending
 
     def _inject_upcoming_nodes(
-        self, snapshot: ClusterSnapshot, now_ts: float, pending_ds=()
+        self, snapshot: ClusterSnapshot, now_ts: float, pending_ds=lambda: ()
     ) -> List[str]:
         """Virtual nodes for capacity that was requested but hasn't
         registered (:484-519) so we don't double scale-up.
@@ -473,7 +482,7 @@ class StaticAutoscaler:
                 template = tmpl_provider.template_for(
                     group, nodes_by_group.get(gid, []), now_ts,
                     pods_of_node=snapshot.pods_on_node,
-                    pending_daemonsets=pending_ds,
+                    pending_daemonsets=pending_ds(),
                 )
             if template is None:
                 try:

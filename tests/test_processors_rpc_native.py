@@ -567,3 +567,34 @@ class TestForceDaemonSets:
             assert ds.tolerations[0].operator == "Exists"
         finally:
             srv.close()
+
+    def test_idle_loop_issues_no_daemonset_list(self):
+        """--force-ds on an idle cluster (nothing pending, nothing upcoming)
+        must not LIST daemonsets every scan interval."""
+        from autoscaler_tpu.config.options import AutoscalingOptions
+        from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+        from autoscaler_tpu.kube.api import FakeClusterAPI
+
+        calls = []
+
+        class CountingApi(FakeClusterAPI):
+            def list_daemonsets(self):
+                calls.append(1)
+                return super().list_daemonsets()
+
+        provider = TestCloudProvider()
+        api = CountingApi()
+        provider.add_node_group("g", 0, 10, 1,
+                                build_test_node("t", cpu_m=4000, mem=8 * GB))
+        node = build_test_node("g-0", cpu_m=4000, mem=8 * GB)
+        provider.add_node("g", node)
+        api.add_node(node)
+        a = StaticAutoscaler(provider, api,
+                             AutoscalingOptions(force_daemonsets=True))
+        a.run_once(now_ts=0.0)   # idle: no pending pods, no upcoming nodes
+        assert calls == []
+        # demand appears (pod too big for existing free capacity, so it
+        # stays pending into scale-up) → exactly one LIST this loop
+        api.add_pod(build_test_pod("p", cpu_m=4500, mem=GB))
+        a.run_once(now_ts=700.0)
+        assert len(calls) == 1
