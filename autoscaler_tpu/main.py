@@ -4,10 +4,10 @@ the reconcile loop.
 Reference: cluster-autoscaler/main.go — flag surface :92-227,
 createAutoscalingOptions :229-337, metrics/health-check/snapshotz HTTP
 server :508-523, the scan-interval loop :471-489. Leader election (:525-573)
-is delegated to the orchestration platform (a Lease or equivalent); the
-process is stateless so active/passive failover needs no handover logic —
-pass --leader-elect-hook with a command that blocks until leadership if you
-need it.
+runs under --leader-elect: a coordination.k8s.io Lease elects one active
+replica (utils/leaderelection.LeaderElector + KubeLease); the process is
+stateless so failover needs no handover — a follower simply waits for the
+lease and rebuilds its world from the next LIST.
 
 Usage:
     python -m autoscaler_tpu.main --provider=test --scan-interval=10 \
@@ -149,6 +149,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--kube-client-qps", type=float, default=5.0,
                    help="client-side request rate limit (0 disables)")
     p.add_argument("--kube-client-burst", type=int, default=10)
+    p.add_argument("--leader-elect", type=_bool_flag, default=False,
+                   help="run under Lease-based leader election (needs a "
+                        "control-plane binding); the reference defaults "
+                        "this ON in-cluster")
+    p.add_argument("--leader-elect-lease-name", default="tpu-autoscaler")
     p.add_argument("--parallel-drain", type=_bool_flag, default=True,
                    help="accepted for compatibility: the planner here IS "
                         "the reference's parallel-drain path (no legacy mode)")
@@ -391,15 +396,26 @@ class ObservabilityServer:
             self._started_tracemalloc = False
 
 
-def run_loop(autoscaler, scan_interval_s: float, max_iterations: int = 0) -> None:
-    """The steady loop (main.go:471-489)."""
+def run_loop(
+    autoscaler,
+    scan_interval_s: float,
+    max_iterations: int = 0,
+    still_leader=None,
+) -> bool:
+    """The steady loop (main.go:471-489). still_leader: optional callback
+    consulted between iterations under leader election — returning False
+    stops the loop so the process can exit and be restarted as a follower
+    (main.go:568 OnStoppedLeading)."""
     iterations = 0
     while True:
         loop_start = time.monotonic()
         autoscaler.run_once(now_ts=time.time())
         iterations += 1
         if max_iterations and iterations >= max_iterations:
-            return
+            return True
+        if still_leader is not None and not still_leader():
+            print("lost leadership; exiting loop", file=sys.stderr)
+            return False
         elapsed = time.monotonic() - loop_start
         time.sleep(max(scan_interval_s - elapsed, 0.0))
 
@@ -419,6 +435,10 @@ def main(argv=None) -> int:
         # pure argv validation comes before any cloud I/O
         print("--kube-api and --kubeconfig are mutually exclusive",
               file=sys.stderr)
+        return 2
+    if args.leader_elect and not (args.kube_api or args.kubeconfig):
+        print("--leader-elect requires a control-plane binding "
+              "(--kube-api or --kubeconfig)", file=sys.stderr)
         return 2
 
     if args.provider == "test":
@@ -547,7 +567,30 @@ def main(argv=None) -> int:
     port = server.start()
     print(f"tpu-autoscaler: observability on :{port}, scan interval {opts.scan_interval_s}s")
     try:
-        run_loop(autoscaler, opts.scan_interval_s, args.max_iterations)
+        if args.leader_elect:
+            from autoscaler_tpu.kube.client import KubeLease
+            from autoscaler_tpu.utils.leaderelection import LeaderElector
+
+            elector = LeaderElector(
+                KubeLease(client, args.leader_elect_lease_name,
+                          opts.config_namespace)
+            )
+            print(f"waiting for leadership as {elector.identity}")
+            outcome = {"clean": True}
+
+            def lead(still_leader):
+                outcome["clean"] = run_loop(
+                    autoscaler, opts.scan_interval_s, args.max_iterations,
+                    still_leader=still_leader,
+                )
+
+            elector.run(lead)
+            if not outcome["clean"]:
+                # abnormal exit so supervisors restart the replica
+                # (main.go:568 OnStoppedLeading is a Fatalf)
+                return 1
+        else:
+            run_loop(autoscaler, opts.scan_interval_s, args.max_iterations)
     except KeyboardInterrupt:
         pass
     finally:

@@ -76,25 +76,59 @@ class LeaderElector:
         lease: Lease,
         identity: Optional[str] = None,
         renew_period_s: float = 2.0,
+        renew_deadline_s: float = 10.0,
         clock: Callable[[], float] = time.time,
         sleep: Callable[[float], None] = time.sleep,
     ):
         self.lease = lease
         self.identity = identity or f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}"
         self.renew_period_s = renew_period_s
+        self.renew_deadline_s = renew_deadline_s
         self.clock = clock
         self.sleep = sleep
 
     def run(self, on_started_leading: Callable[[Callable[[], bool]], None]) -> None:
         """on_started_leading receives a `still_leader()` callback it must
-        consult between loop iterations."""
+        consult between loop iterations.
+
+        While leading, the lease is renewed on a BACKGROUND thread every
+        renew_period_s (the reference's 2s renew goroutine) — a long loop
+        iteration can't let the lease expire mid-iteration and split-brain
+        a second replica in. Renewal failures are tolerated for
+        renew_deadline_s (reference: 10s) before leadership is considered
+        lost, so one transient apiserver error doesn't dethrone a healthy
+        leader."""
         while not self.lease.try_acquire(self.identity, self.clock()):
             self.sleep(self.renew_period_s)
 
+        import threading
+
+        stop = threading.Event()
+        state = {"leading": True, "last_renew": self.clock()}
+
+        def renewer() -> None:
+            while not stop.wait(self.renew_period_s):
+                ok = False
+                try:
+                    ok = self.lease.try_acquire(self.identity, self.clock())
+                except Exception:  # noqa: BLE001 — network lease errors count
+                    ok = False     # toward the renew deadline, not a crash
+                now = self.clock()
+                if ok:
+                    state["last_renew"] = now
+                elif now - state["last_renew"] > self.renew_deadline_s:
+                    state["leading"] = False
+                    return
+
+        renew_thread = threading.Thread(target=renewer, daemon=True)
+        renew_thread.start()
+
         def still_leader() -> bool:
-            return self.lease.try_acquire(self.identity, self.clock())
+            return state["leading"]
 
         try:
             on_started_leading(still_leader)
         finally:
+            stop.set()
+            renew_thread.join(timeout=self.renew_period_s * 2)
             self.lease.release(self.identity)

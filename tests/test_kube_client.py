@@ -839,3 +839,70 @@ class TestKubeconfigFailClosed:
         path.write_text("{unclosed: [")
         with pytest.raises(ValueError, match="not valid kubeconfig YAML"):
             KubeRestClient.from_kubeconfig(str(path))
+
+
+class TestLeaderElectedCli:
+    def test_leader_elect_runs_loop_under_lease(self, api_server, tmp_path):
+        """--leader-elect: the CLI acquires the Lease, runs its iterations,
+        and releases on exit (main.go:525-573 analog over live HTTP)."""
+        import subprocess
+        import sys as _sys
+
+        api_server.nodes["n1"] = node_json("n1")
+        proc = subprocess.run(
+            [_sys.executable, "-m", "autoscaler_tpu.main",
+             "--provider", "test", "--kube-api", api_server.url,
+             "--leader-elect", "true", "--scan-interval", "0",
+             "--max-iterations", "2", "--address", "127.0.0.1:0"],
+            env={"PYTHONPATH": "/root/repo", "PATH": "/usr/bin:/bin",
+                 "JAX_PLATFORMS": "cpu", "HOME": "/root"},
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr[-500:]
+        assert "waiting for leadership" in proc.stdout
+        lease_writes = [p for m, p in api_server.writes if "/leases" in p]
+        assert lease_writes  # lease created/renewed over HTTP
+
+    def test_leader_elect_requires_binding(self):
+        import subprocess
+        import sys as _sys
+
+        proc = subprocess.run(
+            [_sys.executable, "-m", "autoscaler_tpu.main",
+             "--provider", "test", "--leader-elect", "true",
+             "--max-iterations", "1", "--address", "127.0.0.1:0"],
+            env={"PYTHONPATH": "/root/repo", "PATH": "/usr/bin:/bin",
+                 "JAX_PLATFORMS": "cpu", "HOME": "/root"},
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 2
+        assert "control-plane binding" in proc.stderr
+
+    def test_follower_waits_while_leader_holds_lease(self, api_server):
+        """A second instance must not run loops while the Lease is held."""
+        from autoscaler_tpu.kube.client import KubeLease
+        from autoscaler_tpu.utils.leaderelection import LeaderElector
+
+        client = KubeRestClient(api_server.url)
+        holder = KubeLease(client, "tpu-autoscaler", "kube-system")
+        assert holder.try_acquire("incumbent", time.time())
+        ticks = []
+
+        def counting_sleep(seconds):
+            ticks.append(seconds)
+            if len(ticks) > 3:
+                raise TimeoutError("still blocked")
+
+        follower = LeaderElector(
+            KubeLease(client, "tpu-autoscaler", "kube-system"),
+            identity="challenger",
+            renew_period_s=0.01,
+            sleep=counting_sleep,
+        )
+
+        def must_not_lead(still):
+            raise AssertionError("follower must not lead")
+
+        with pytest.raises(TimeoutError):
+            follower.run(must_not_lead)
+        assert len(ticks) > 3  # kept waiting, never led

@@ -317,3 +317,82 @@ class TestPollLoop:
 
         poll.poll_loop(tick, interval_s=1.0, max_iterations=2)
         assert sleeps and abs(sleeps[0] - 0.7) < 1e-9
+
+
+class TestBackgroundRenewal:
+    def test_long_iteration_keeps_lease_renewed(self, tmp_path):
+        """The renewal thread keeps the lease fresh while the leading
+        callback blocks longer than the TTL — a second replica must not be
+        able to take the lease mid-iteration (split-brain guard)."""
+        import time as _t
+
+        from autoscaler_tpu.utils.leaderelection import FileLease, LeaderElector
+
+        lease = FileLease(str(tmp_path / "lease"), ttl_s=0.3)
+        challenger = FileLease(str(tmp_path / "lease"), ttl_s=0.3)
+        stolen = []
+
+        def long_iteration(still_leader):
+            _t.sleep(1.0)  # 3x the TTL: without renewal the lease expires
+            stolen.append(challenger.try_acquire("challenger", _t.time()))
+            assert still_leader()
+
+        elector = LeaderElector(lease, identity="leader", renew_period_s=0.05)
+        elector.run(long_iteration)
+        assert stolen == [False]  # renewals held the challenger off
+
+    def test_transient_renew_failure_tolerated(self, tmp_path):
+        """One failed renewal inside the deadline must not dethrone the
+        leader; sustained failure past the deadline must."""
+        import time as _t
+
+        from autoscaler_tpu.utils.leaderelection import FileLease, LeaderElector
+
+        class FlakyLease(FileLease):
+            def __init__(self, path, fail_from, fail_until, **kw):
+                super().__init__(path, **kw)
+                self.fail_from = fail_from
+                self.fail_until = fail_until
+
+            def try_acquire(self, holder, now_ts):
+                if self.fail_from < _t.monotonic() < self.fail_until:
+                    raise OSError("apiserver hiccup")
+                return super().try_acquire(holder, now_ts)
+
+        t0 = _t.monotonic()
+        lease = FlakyLease(str(tmp_path / "l"), t0 + 0.1, t0 + 0.25, ttl_s=100)
+        seen = []
+
+        def iteration(still_leader):
+            _t.sleep(0.5)           # failures happen inside here
+            seen.append(still_leader())
+
+        elector = LeaderElector(
+            lease, identity="leader", renew_period_s=0.05, renew_deadline_s=5.0
+        )
+        elector.run(iteration)
+        assert seen == [True]       # hiccup < deadline → still leading
+
+        # sustained failure past the deadline loses leadership
+        acquired_once = []
+
+        class FailAfterAcquire(FileLease):
+            def try_acquire(self, holder, now_ts):
+                if acquired_once:
+                    raise OSError("down")
+                acquired_once.append(1)
+                return super().try_acquire(holder, now_ts)
+
+        lease3 = FailAfterAcquire(str(tmp_path / "l3"), ttl_s=100)
+        seen2 = []
+
+        def iteration2(still_leader):
+            _t.sleep(0.6)
+            seen2.append(still_leader())
+
+        elector2 = LeaderElector(
+            lease3, identity="leader", renew_period_s=0.05,
+            renew_deadline_s=0.2,
+        )
+        elector2.run(iteration2)
+        assert seen2 == [False]     # renewals failing past deadline
