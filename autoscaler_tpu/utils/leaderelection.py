@@ -124,11 +124,22 @@ class LeaderElector:
         renew_thread.start()
 
         def still_leader() -> bool:
-            return state["leading"]
+            # freshness matters as much as the flag: a renewal hung in a
+            # blackholed request must not keep an expired leader active
+            return (
+                state["leading"]
+                and self.clock() - state["last_renew"] <= self.renew_deadline_s
+            )
 
         try:
             on_started_leading(still_leader)
         finally:
             stop.set()
             renew_thread.join(timeout=self.renew_period_s * 2)
-            self.lease.release(self.identity)
+            if renew_thread.is_alive():
+                # a renewal is still in flight; releasing now could race its
+                # completing PUT and re-create the lease under our dead
+                # identity — let the TTL expire it instead
+                pass
+            else:
+                self.lease.release(self.identity)

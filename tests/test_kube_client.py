@@ -841,6 +841,15 @@ class TestKubeconfigFailClosed:
             KubeRestClient.from_kubeconfig(str(path))
 
 
+def _subproc_env():
+    import os
+    import pathlib
+
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    return {"PYTHONPATH": repo, "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+            "JAX_PLATFORMS": "cpu", "HOME": os.environ.get("HOME", "/root")}
+
+
 class TestLeaderElectedCli:
     def test_leader_elect_runs_loop_under_lease(self, api_server, tmp_path):
         """--leader-elect: the CLI acquires the Lease, runs its iterations,
@@ -854,8 +863,7 @@ class TestLeaderElectedCli:
              "--provider", "test", "--kube-api", api_server.url,
              "--leader-elect", "true", "--scan-interval", "0",
              "--max-iterations", "2", "--address", "127.0.0.1:0"],
-            env={"PYTHONPATH": "/root/repo", "PATH": "/usr/bin:/bin",
-                 "JAX_PLATFORMS": "cpu", "HOME": "/root"},
+            env=_subproc_env(),
             capture_output=True, text=True, timeout=120,
         )
         assert proc.returncode == 0, proc.stderr[-500:]
@@ -871,8 +879,7 @@ class TestLeaderElectedCli:
             [_sys.executable, "-m", "autoscaler_tpu.main",
              "--provider", "test", "--leader-elect", "true",
              "--max-iterations", "1", "--address", "127.0.0.1:0"],
-            env={"PYTHONPATH": "/root/repo", "PATH": "/usr/bin:/bin",
-                 "JAX_PLATFORMS": "cpu", "HOME": "/root"},
+            env=_subproc_env(),
             capture_output=True, text=True, timeout=120,
         )
         assert proc.returncode == 2
