@@ -15,9 +15,12 @@ from __future__ import annotations
 import json
 import os
 import re
+import logging
 from typing import Callable, Dict, List, Optional, Sequence
 
 from autoscaler_tpu.expander.core import Filter, Option
+
+logger = logging.getLogger(__name__)
 
 
 def parse_priorities(text: str) -> Dict[int, List[str]]:
@@ -149,9 +152,18 @@ class ConfigMapPriorityFilter(PriorityFilter):
 
     ``fetch`` returns the ConfigMap's data dict (or None if absent) — a
     bound ClusterAPI.read_configmap in production, any callable in tests.
-    The payload under ``key`` is re-parsed only when its text changes; a
-    broken edit keeps the last good tiers (the reference logs and keeps
-    serving too), surfaced via ``last_error``."""
+    The payload under ``key`` is re-parsed only when its text changes.
+
+    Error behavior mirrors priority.go's BestOptions (reload error → return
+    every option unfiltered) for a *gone* config source: ConfigMap deleted
+    or missing the key disables prioritization rather than pinning
+    decisions to tiers read from an object that no longer exists — unless
+    the operator passed explicit ``fallback`` tiers, which exist precisely
+    for the no-ConfigMap case and stay in force. Divergence kept on
+    purpose: a present-but-malformed payload serves the last GOOD tiers (a
+    fat-fingered edit shouldn't instantly disable prioritization); the
+    reference disables there too. Both states are logged on transition and
+    surfaced via ``last_error``."""
 
     def __init__(
         self,
@@ -163,7 +175,9 @@ class ConfigMapPriorityFilter(PriorityFilter):
         self._key = key
         self._last_text: Optional[str] = None
         self.last_error: Optional[str] = None
-        super().__init__(fallback or {})
+        self._source_gone = False
+        self._fallback: Dict[int, Sequence[str]] = dict(fallback or {})
+        super().__init__(self._fallback)
         self.maybe_reload()
 
     def maybe_reload(self) -> bool:
@@ -174,18 +188,23 @@ class ConfigMapPriorityFilter(PriorityFilter):
             self.last_error = f"fetch: {e}"
             return False
         if data is None:
-            self.last_error = "configmap absent"
+            self._note_source_gone("configmap absent")
             return False
         text = data.get(self._key)
         if text is None:
-            self.last_error = f"configmap has no {self._key!r} key"
+            self._note_source_gone(f"configmap has no {self._key!r} key")
             return False
+        if self._source_gone:
+            logger.info("priority expander config source restored")
+            self._source_gone = False
+            self._last_text = None  # force a re-parse of the restored text
         if text == self._last_text:
             return False
         try:
             parsed = parse_priorities(text)
         except ValueError as e:
             self.last_error = str(e)
+            logger.warning("priority expander configmap invalid: %s", e)
             self._last_text = text  # don't re-parse a bad payload every call
             return False
         self.set_priorities(parsed)
@@ -193,6 +212,26 @@ class ConfigMapPriorityFilter(PriorityFilter):
         self.last_error = None
         return True
 
+    def _note_source_gone(self, why: str) -> None:
+        self.last_error = why
+        if not self._source_gone:
+            if self._fallback:
+                logger.warning(
+                    "priority expander config source gone (%s): "
+                    "reverting to the operator-provided fallback tiers",
+                    why,
+                )
+                self.set_priorities(self._fallback)
+            else:
+                logger.warning(
+                    "priority expander config source gone (%s): "
+                    "prioritization disabled, options pass through unfiltered",
+                    why,
+                )
+            self._source_gone = True
+
     def best_options(self, options: List[Option]) -> List[Option]:
         self.maybe_reload()
+        if self._source_gone and not self._fallback:
+            return list(options)
         return super().best_options(options)

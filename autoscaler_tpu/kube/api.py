@@ -195,6 +195,10 @@ class FakeClusterAPI(ClusterAPI):
             data = self.configmaps.get((namespace, name))
             return dict(data) if data is not None else None
 
+    def delete_configmap(self, namespace: str, name: str) -> None:
+        with self._lock:
+            self.configmaps.pop((namespace, name), None)
+
 
 def to_be_deleted_taint() -> Taint:
     """reference utils/taints: ToBeDeletedByClusterAutoscaler NoSchedule."""

@@ -269,8 +269,9 @@ class KubeRestClient:
             "PATCH", path, body, content_type="application/merge-patch+json"
         )
 
-    def delete(self, path: str) -> dict:
-        return self._request("DELETE", path)
+    def delete(self, path: str, body: Optional[dict] = None) -> dict:
+        # body carries DeleteOptions (e.g. resourceVersion preconditions)
+        return self._request("DELETE", path, body)
 
     def watch(
         self, path: str, resource_version: str = "", timeout_s: float = 300.0
@@ -409,7 +410,9 @@ class KubeClusterAPI(ClusterAPI):
         # suppresses identical (kind, name, reason) posts within a window
         # unless --record-duplicated-events asks for every one
         self._record_duplicated_events = record_duplicated_events
-        self._recent_events: Dict[Tuple[str, str, str], float] = {}
+        self._recent_events: Dict[Tuple[str, str, str, str], float] = {}
+        # (kind, name, reason) → (window start, distinct messages posted)
+        self._event_series: Dict[Tuple[str, str, str], Tuple[float, int]] = {}
         # record_event is called from drain workers and batcher timers
         self._events_lock = threading.Lock()
         self._node_cache: Optional[WatchCache] = None
@@ -636,15 +639,52 @@ class KubeClusterAPI(ClusterAPI):
                 raise
 
     EVENT_DEDUP_WINDOW_S = 600.0
+    # max distinct messages per (kind, name, reason) per window — the
+    # reference EventAggregator's similar-event spike threshold
+    EVENT_SERIES_CAP = 10
 
     def record_event(self, kind: str, name: str, reason: str, message: str) -> None:
-        key = (kind, name, reason)
+        # message is part of the dedup key: successive DISTINCT failure
+        # messages under one reason (e.g. different eviction errors) each
+        # land once per window, while repeats stay suppressed. EVERY
+        # message novel *in this window* (first-seen or recurring after
+        # expiry) also counts against a per-(kind, name, reason) cap of
+        # EVENT_SERIES_CAP per window, so a message embedding a changing
+        # detail (timestamps, retry-after) can't flood the apiserver — the
+        # same spike guard as the reference EventAggregator's
+        # 10-similar-events threshold. The decision + slot reservation is
+        # one atomic lock hold (drain workers post concurrently); a failed
+        # POST rolls the reservation back so a never-landed event isn't
+        # suppressed on retry.
+        key = (kind, name, reason, message)
+        series = (kind, name, reason)
         if not self._record_duplicated_events:
             now = time.monotonic()
             with self._events_lock:
                 last = self._recent_events.get(key)
-            if last is not None and now - last < self.EVENT_DEDUP_WINDOW_S:
-                return  # correlator-suppressed repeat
+                if last is not None and now - last < self.EVENT_DEDUP_WINDOW_S:
+                    return  # correlator-suppressed repeat
+                start, count = self._event_series.get(series, (now, 0))
+                if now - start >= self.EVENT_DEDUP_WINDOW_S:
+                    start, count = now, 0  # window rolled over
+                if count >= self.EVENT_SERIES_CAP:
+                    return  # aggregator-suppressed spike
+                # reserve before the POST: concurrent callers at count
+                # CAP-1 must not all pass the check and overshoot
+                self._event_series[series] = (start, count + 1)
+                self._recent_events[key] = now
+                if len(self._recent_events) > 4096:  # bound the window store
+                    cutoff = now - self.EVENT_DEDUP_WINDOW_S
+                    self._recent_events = {
+                        k: t
+                        for k, t in self._recent_events.items()
+                        if t >= cutoff
+                    }
+                    self._event_series = {
+                        s: (st, c)
+                        for s, (st, c) in self._event_series.items()
+                        if now - st < self.EVENT_DEDUP_WINDOW_S
+                    }
         body = {
             "metadata": {"generateName": f"{name}.", "namespace": "default"},
             "involvedObject": {"kind": kind, "name": name},
@@ -656,19 +696,13 @@ class KubeClusterAPI(ClusterAPI):
         try:
             self.client.post("/api/v1/namespaces/default/events", body)
         except ApiError:
-            return  # best-effort: a failed post must NOT start the dedup
-            # window, or retries of a never-landed event get suppressed
-        if not self._record_duplicated_events:
-            now = time.monotonic()
-            with self._events_lock:
-                self._recent_events[key] = now
-                if len(self._recent_events) > 4096:  # bound the window store
-                    cutoff = now - self.EVENT_DEDUP_WINDOW_S
-                    self._recent_events = {
-                        k: t
-                        for k, t in self._recent_events.items()
-                        if t >= cutoff
-                    }
+            if not self._record_duplicated_events:
+                with self._events_lock:
+                    if self._recent_events.get(key) == now:
+                        del self._recent_events[key]
+                    st, c = self._event_series.get(series, (now, 0))
+                    if st == start and c > 0:
+                        self._event_series[series] = (st, c - 1)
 
 
 class KubeLease:
@@ -693,11 +727,21 @@ class KubeLease:
             f"/apis/coordination.k8s.io/v1/namespaces/{self.namespace}/leases/{self.name}"
         )
 
-    def _body(self, holder: str, now_ts: float) -> dict:
+    def _body(
+        self, holder: str, now_ts: float, resource_version: Optional[str] = None
+    ) -> dict:
+        meta: dict = {"name": self.name, "namespace": self.namespace}
+        if resource_version:
+            # optimistic-concurrency guard: the apiserver rejects the PUT
+            # with 409 if anyone wrote the Lease since our GET — the same
+            # contract client-go's resourcelock relies on. Without it two
+            # replicas observing an expired lease could both PUT and both
+            # believe they acquired (split brain for up to renew_deadline).
+            meta["resourceVersion"] = resource_version
         return {
             "apiVersion": "coordination.k8s.io/v1",
             "kind": "Lease",
-            "metadata": {"name": self.name, "namespace": self.namespace},
+            "metadata": meta,
             "spec": {
                 "holderIdentity": holder,
                 "leaseDurationSeconds": int(self.ttl_s),
@@ -718,16 +762,19 @@ class KubeLease:
                 )
                 return True
             except ApiError:
+                # 409 here = another replica created it first: lost the race
                 return False
         spec = current.get("spec") or {}
         other = spec.get("holderIdentity")
         renewed = convert.parse_timestamp(spec.get("renewTime"))
         if other and other != holder and now_ts - renewed < self.ttl_s:
             return False
+        rv = (current.get("metadata") or {}).get("resourceVersion")
         try:
-            self.client.put(self._path, self._body(holder, now_ts))
+            self.client.put(self._path, self._body(holder, now_ts, rv))
             return True
         except ApiError:
+            # 409 = a concurrent writer took the lease between GET and PUT
             return False
 
     def release(self, holder: str) -> None:
@@ -736,7 +783,11 @@ class KubeLease:
         except ApiError:
             return
         if (current.get("spec") or {}).get("holderIdentity") == holder:
+            rv = (current.get("metadata") or {}).get("resourceVersion")
             try:
-                self.client.delete(self._path)
+                self.client.delete(
+                    self._path,
+                    {"preconditions": {"resourceVersion": rv}} if rv else None,
+                )
             except ApiError:
                 pass

@@ -89,6 +89,13 @@ class MixedTemplateNodeInfoProvider:
         running_ds_names = set()
         if pods_of_node is not None and cached.source_node:
             for p in pods_of_node(cached.source_node) or ():
+                # a terminating DS/mirror pod won't exist on a NEW node:
+                # charging it would double-count mid-replacement pods and
+                # its presence in running_ds_names would suppress the
+                # --force-ds recharge (reference skips DeletionTimestamp
+                # pods, simulator/nodes.go:41)
+                if p.deletion_ts is not None:
+                    continue
                 if p.daemonset or p.mirror:
                     overhead = overhead + p.effective_requests()
                     if p.daemonset and p.owner_ref is not None:

@@ -263,6 +263,51 @@ class TestConfigMapPriority:
             ], broken
             assert f.last_error is not None
 
+    def test_deleted_configmap_disables_filtering(self):
+        """ConfigMap deleted after a good load → options pass through
+        unfiltered (priority.go returns everything on reload error) instead
+        of pinning decisions to stale tiers forever; restore re-enables."""
+        api = self._api_with('{"10": ["cheap-pool"]}')
+        p = provider_with_groups()
+        f = self._filter(api)
+        assert [o.node_group.id() for o in f.best_options(options_for(p))] == [
+            "cheap-pool"
+        ]
+        api.delete_configmap("kube-system", "cluster-autoscaler-priority-expander")
+        got = {o.node_group.id() for o in f.best_options(options_for(p))}
+        assert got == {o.node_group.id() for o in options_for(p)}  # unfiltered
+        assert f.last_error == "configmap absent"
+        # operator recreates it → tiers apply again, no restart
+        api.write_configmap(
+            "kube-system", "cluster-autoscaler-priority-expander",
+            {"priorities": '{"10": ["pricey-pool"]}'},
+        )
+        assert [o.node_group.id() for o in f.best_options(options_for(p))] == [
+            "pricey-pool"
+        ]
+        assert f.last_error is None
+
+    def test_deleted_configmap_reverts_to_fallback(self):
+        """With operator-provided fallback tiers, source-gone reverts to the
+        fallback rather than disabling prioritization."""
+        from autoscaler_tpu.expander.priority import ConfigMapPriorityFilter
+
+        api = self._api_with('{"10": ["cheap-pool"]}')
+        p = provider_with_groups()
+        f = ConfigMapPriorityFilter(
+            lambda: api.read_configmap(
+                "kube-system", "cluster-autoscaler-priority-expander"
+            ),
+            fallback={5: ["pricey-pool"]},
+        )
+        assert [o.node_group.id() for o in f.best_options(options_for(p))] == [
+            "cheap-pool"
+        ]
+        api.delete_configmap("kube-system", "cluster-autoscaler-priority-expander")
+        assert [o.node_group.id() for o in f.best_options(options_for(p))] == [
+            "pricey-pool"
+        ]
+
     def test_configmap_flag_requires_kube_api(self):
         from autoscaler_tpu.main import main
 

@@ -96,6 +96,7 @@ class FakeApiServer:
         self.serve_storage = True  # False simulates a server without storage APIs
         self.storage_error = None  # e.g. 503: storage endpoints fail transiently
         self.leases = {}
+        self.lease_rv = 0         # monotonic resourceVersion for leases
         self.writes = []          # (method, path) log
         self.reads = []           # GET path log (storage endpoints)
         self.reject_evictions = set()  # "ns/name" -> 429
@@ -251,6 +252,10 @@ class FakeApiServer:
                         name = (body.get("metadata") or {}).get("name", "")
                         if name in outer.leases:
                             return self._send(409)
+                        outer.lease_rv += 1
+                        body.setdefault("metadata", {})["resourceVersion"] = str(
+                            outer.lease_rv
+                        )
                         outer.leases[name] = body
                         return self._send(201, body)
                     if path.endswith("/events"):
@@ -312,7 +317,26 @@ class FakeApiServer:
                         outer.deployments[key] = body
                         return self._send(200, body)
                     if "/leases/" in path:
-                        outer.leases[path.rsplit("/", 1)[1]] = body
+                        # real-apiserver optimistic concurrency: a PUT whose
+                        # resourceVersion mismatches the stored object is a
+                        # 409 Conflict (what KubeLease's split-brain guard
+                        # relies on)
+                        name = path.rsplit("/", 1)[1]
+                        current = outer.leases.get(name)
+                        sent_rv = (body.get("metadata") or {}).get(
+                            "resourceVersion"
+                        )
+                        if current is not None and sent_rv is not None:
+                            cur_rv = (current.get("metadata") or {}).get(
+                                "resourceVersion"
+                            )
+                            if sent_rv != cur_rv:
+                                return self._send(409)
+                        outer.lease_rv += 1
+                        body.setdefault("metadata", {})["resourceVersion"] = str(
+                            outer.lease_rv
+                        )
+                        outer.leases[name] = body
                         return self._send(200, body)
                     if "/configmaps/" in path:
                         name = path.rsplit("/", 1)[1]
@@ -337,7 +361,20 @@ class FakeApiServer:
                         existed = outer.nodes.pop(name, None)
                         return self._send(200 if existed else 404)
                     if "/leases/" in path:
-                        outer.leases.pop(path.rsplit("/", 1)[1], None)
+                        name = path.rsplit("/", 1)[1]
+                        current = outer.leases.get(name)
+                        pre = ((self._body() or {}).get("preconditions") or {})
+                        want_rv = pre.get("resourceVersion")
+                        if (
+                            current is not None
+                            and want_rv is not None
+                            and want_rv
+                            != (current.get("metadata") or {}).get(
+                                "resourceVersion"
+                            )
+                        ):
+                            return self._send(409)
+                        outer.leases.pop(name, None)
                         return self._send(200)
                 return self._send(404)
 
@@ -684,6 +721,52 @@ class TestKubeLease:
         lease_b.release("holder-b")
         assert lease_a.try_acquire("holder-a", now_ts=131.0)      # released → free
 
+    def test_expired_lease_race_single_winner(self, api_server):
+        """Two replicas both observe an expired lease; the writes interleave
+        GET(b) → PUT(a) → PUT(b). Without the resourceVersion guard both
+        PUTs land and both replicas believe they lead (the round-2 split
+        brain); with it b's stale-RV PUT gets 409 and exactly one wins."""
+        client_a = KubeRestClient(api_server.url)
+        client_b = KubeRestClient(api_server.url)
+        lease_a = KubeLease(client_a, ttl_s=15.0)
+        lease_b = KubeLease(client_b, ttl_s=15.0)
+        assert lease_a.try_acquire("holder-a", now_ts=100.0)
+        # at t=130 the lease is expired for both; a sneaks its PUT in
+        # between b's GET and b's PUT
+        orig_get = client_b.get
+
+        def racing_get(path):
+            current = orig_get(path)
+            assert lease_a.try_acquire("holder-a", now_ts=130.0)
+            return current
+
+        client_b.get = racing_get
+        assert not lease_b.try_acquire("holder-b", now_ts=130.0)
+        holder = (api_server.leases["autoscaler-tpu"]["spec"])["holderIdentity"]
+        assert holder == "holder-a"
+
+    def test_release_respects_concurrent_takeover(self, api_server):
+        """release() must not delete a lease another replica just took: the
+        precondition-guarded DELETE 409s when the RV moved after our GET."""
+        client_a = KubeRestClient(api_server.url)
+        client_b = KubeRestClient(api_server.url)
+        lease_a = KubeLease(client_a, ttl_s=15.0)
+        lease_b = KubeLease(client_b, ttl_s=15.0)
+        assert lease_a.try_acquire("holder-a", now_ts=100.0)
+        orig_get = client_a.get
+
+        def racing_get(path):
+            current = orig_get(path)
+            # a's record is expired; b steals between a's GET and DELETE
+            assert lease_b.try_acquire("holder-b", now_ts=120.0)
+            return current
+
+        client_a.get = racing_get
+        lease_a.release("holder-a")
+        lease = api_server.leases.get("autoscaler-tpu")
+        assert lease is not None  # b's lease survived a's stale delete
+        assert lease["spec"]["holderIdentity"] == "holder-b"
+
     def test_leader_elector_over_kube_lease(self, api_server):
         from autoscaler_tpu.utils.leaderelection import LeaderElector
 
@@ -711,6 +794,56 @@ class TestEventCorrelation:
         api.record_event("Node", "n1", "ScaleUp", "adding capacity")
         posts = [p for m, p in api_server.writes if p.endswith("/events")]
         assert len(posts) == 2
+
+    def test_distinct_messages_not_suppressed(self, api_server):
+        """Successive DISTINCT failure messages under one reason each land
+        (the round-2 correlator dropped them for 600s); true repeats of each
+        message stay suppressed."""
+        api = KubeClusterAPI(KubeRestClient(api_server.url))
+        api.record_event("Node", "n1", "ScaleDownFailed", "disk pressure")
+        api.record_event("Node", "n1", "ScaleDownFailed", "pdb blocked")
+        api.record_event("Node", "n1", "ScaleDownFailed", "disk pressure")
+        api.record_event("Node", "n1", "ScaleDownFailed", "pdb blocked")
+        posts = [p for m, p in api_server.writes if p.endswith("/events")]
+        assert len(posts) == 2  # one per novel message, repeats suppressed
+
+    def test_varying_message_spike_capped(self, api_server):
+        """A message embedding a changing detail (timestamp, retry-after)
+        must not flood the apiserver: at most EVENT_SERIES_CAP distinct
+        messages per (kind, name, reason) land per window."""
+        api = KubeClusterAPI(KubeRestClient(api_server.url))
+        for i in range(50):
+            api.record_event("Node", "n1", "EvictionFailed",
+                             f"retry after {i}s")
+        posts = [p for m, p in api_server.writes if p.endswith("/events")]
+        assert len(posts) == KubeClusterAPI.EVENT_SERIES_CAP
+        # a different series is unaffected by the saturated one
+        api.record_event("Node", "n2", "EvictionFailed", "retry after 0s")
+        posts = [p for m, p in api_server.writes if p.endswith("/events")]
+        assert len(posts) == KubeClusterAPI.EVENT_SERIES_CAP + 1
+
+    def test_recurring_distinct_messages_capped_per_window(
+        self, api_server, monkeypatch
+    ):
+        """Messages recurring across windows (a node drained repeatedly,
+        each error naming the blocking pod) count against the cap in EVERY
+        window — steady state stays at CAP/window, not at the number of
+        distinct recurring messages. Clock is injected so window rollover
+        is exact regardless of machine load."""
+        from autoscaler_tpu.kube import client as client_mod
+
+        fake_now = [0.0]
+        monkeypatch.setattr(
+            client_mod.time, "monotonic", lambda: fake_now[0]
+        )
+        api = KubeClusterAPI(KubeRestClient(api_server.url))
+        for w in range(3):  # 3 windows
+            fake_now[0] = w * (KubeClusterAPI.EVENT_DEDUP_WINDOW_S + 1)
+            for i in range(30):  # same 30 messages recur every window
+                api.record_event("Node", "n1", "EvictionFailed",
+                                 f"blocked by pod-{i}")
+        posts = [p for m, p in api_server.writes if p.endswith("/events")]
+        assert len(posts) == 3 * KubeClusterAPI.EVENT_SERIES_CAP
 
     def test_record_duplicated_events_posts_all(self, api_server):
         api = KubeClusterAPI(

@@ -406,6 +406,37 @@ class TestDaemonOverheadTemplates:
         bare = prov.template_for(group, [node], 0.0)
         assert bare.daemon_overhead.cpu_m == 0.0
 
+    def test_terminating_ds_pod_not_charged(self):
+        """A DS/mirror pod with a DeletionTimestamp won't exist on a NEW
+        node: charging it double-counts mid-replacement daemons, and its
+        membership in running_ds_names would suppress the --force-ds
+        recharge (reference skips deleted pods, simulator/nodes.go:41)."""
+        from autoscaler_tpu.kube.objects import DaemonSet, OwnerRef, Resources
+
+        provider, node = self._group_with_node()
+        dying = build_test_pod("logging-agent-old", cpu_m=300, mem=256 * MB,
+                               node_name="g-0", namespace="kube-system")
+        dying.daemonset = True
+        dying.owner_ref = OwnerRef(kind="DaemonSet", name="logging-agent")
+        dying.deletion_ts = 10.0
+        live = build_test_pod("kube-proxy-x", cpu_m=200, mem=128 * MB,
+                              node_name="g-0")
+        live.daemonset = True
+        prov = MixedTemplateNodeInfoProvider()
+        (group,) = provider.node_groups()
+        pending = DaemonSet(
+            name="logging-agent", namespace="kube-system",
+            requests=Resources(cpu_m=400, memory=256 * MB),
+        )
+        tmpl = prov.template_for(
+            group, [node], 0.0,
+            pods_of_node={"g-0": [dying, live]}.get,
+            pending_daemonsets=[pending],
+        )
+        # the dying replica is NOT charged, and it does NOT mask the
+        # --force-ds recharge of its own DaemonSet (charged at 400m)
+        assert tmpl.daemon_overhead.cpu_m == pytest.approx(200 + 400)
+
     def test_no_lookup_keeps_full_capacity(self):
         provider, node = self._group_with_node()
         prov = MixedTemplateNodeInfoProvider()
