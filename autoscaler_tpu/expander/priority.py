@@ -195,8 +195,6 @@ class ConfigMapPriorityFilter(PriorityFilter):
             self._note_source_gone(f"configmap has no {self._key!r} key")
             return False
         if self._source_gone:
-            logger.info("priority expander config source restored")
-            self._source_gone = False
             self._last_text = None  # force a re-parse of the restored text
         if text == self._last_text:
             return False
@@ -206,10 +204,16 @@ class ConfigMapPriorityFilter(PriorityFilter):
             self.last_error = str(e)
             logger.warning("priority expander configmap invalid: %s", e)
             self._last_text = text  # don't re-parse a bad payload every call
+            # NOTE: _source_gone stays set on a malformed restoration — a
+            # recreated-with-a-typo ConfigMap must not resurrect the
+            # pre-deletion tiers; passthrough holds until valid config
             return False
         self.set_priorities(parsed)
         self._last_text = text
         self.last_error = None
+        if self._source_gone:
+            logger.info("priority expander config source restored")
+            self._source_gone = False
         return True
 
     def _note_source_gone(self, why: str) -> None:

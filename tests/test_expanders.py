@@ -287,6 +287,32 @@ class TestConfigMapPriority:
         ]
         assert f.last_error is None
 
+    def test_malformed_restoration_does_not_resurrect_stale_tiers(self):
+        """ConfigMap deleted then recreated with a typo'd payload: the
+        passthrough must HOLD (not resurrect pre-deletion tiers) until the
+        payload actually parses."""
+        api = self._api_with('{"10": ["cheap-pool"]}')
+        p = provider_with_groups()
+        f = self._filter(api)
+        f.best_options(options_for(p))
+        api.delete_configmap("kube-system", "cluster-autoscaler-priority-expander")
+        f.best_options(options_for(p))
+        api.write_configmap(
+            "kube-system", "cluster-autoscaler-priority-expander",
+            {"priorities": "{10: [unbalanced"},
+        )
+        got = {o.node_group.id() for o in f.best_options(options_for(p))}
+        assert got == {"cheap-pool", "pricey-pool"}  # still unfiltered
+        assert f.last_error is not None
+        # operator fixes the payload → the NEW tiers apply
+        api.write_configmap(
+            "kube-system", "cluster-autoscaler-priority-expander",
+            {"priorities": '{"10": ["pricey-pool"]}'},
+        )
+        assert [o.node_group.id() for o in f.best_options(options_for(p))] == [
+            "pricey-pool"
+        ]
+
     def test_deleted_configmap_reverts_to_fallback(self):
         """With operator-provided fallback tiers, source-gone reverts to the
         fallback rather than disabling prioritization."""
