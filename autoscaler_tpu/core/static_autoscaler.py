@@ -110,6 +110,12 @@ class StaticAutoscaler:
         self.last_scale_down_delete_ts: Optional[float] = None
         self.last_scale_down_fail_ts: Optional[float] = None
         self._initialized = False
+        # Packed tensors persist across loops: each loop's fresh snapshot
+        # shares this packer, so tensors() costs O(listing delta), not
+        # O(world) — the DeltaClusterSnapshot intent (delta.go:26-42)
+        from autoscaler_tpu.snapshot.incremental import IncrementalPacker
+
+        self._packer = IncrementalPacker()
 
     # -- one reconcile iteration (reference :288) ----------------------------
     def run_once(self, now_ts: float) -> RunOnceResult:
@@ -276,7 +282,7 @@ class StaticAutoscaler:
         import time as _time
 
         t_snap = _time.monotonic()
-        snapshot = ClusterSnapshot()
+        snapshot = ClusterSnapshot(packer=self._packer)
         scheduled, pending = self._split_pods(all_pods)
         for node in all_nodes:
             snapshot.add_node(node)

@@ -176,6 +176,7 @@ class ConfigMapPriorityFilter(PriorityFilter):
         self._last_text: Optional[str] = None
         self.last_error: Optional[str] = None
         self._source_gone = False
+        self._restored = False  # one-shot: last call saw the source absent
         self._fallback: Dict[int, Sequence[str]] = dict(fallback or {})
         super().__init__(self._fallback)
         self.maybe_reload()
@@ -194,8 +195,13 @@ class ConfigMapPriorityFilter(PriorityFilter):
         if text is None:
             self._note_source_gone(f"configmap has no {self._key!r} key")
             return False
-        if self._source_gone:
-            self._last_text = None  # force a re-parse of the restored text
+        if self._restored:
+            # one-shot: the gone→present transition forces a re-parse even
+            # of text identical to the pre-deletion payload; a *persistently
+            # malformed* restoration must NOT re-parse (and re-warn) every
+            # call, so this keys off the transition, not off _source_gone
+            self._restored = False
+            self._last_text = None
         if text == self._last_text:
             return False
         try:
@@ -218,6 +224,7 @@ class ConfigMapPriorityFilter(PriorityFilter):
 
     def _note_source_gone(self, why: str) -> None:
         self.last_error = why
+        self._restored = True  # next present payload re-parses once
         if not self._source_gone:
             if self._fallback:
                 logger.warning(

@@ -42,7 +42,7 @@ _ASSIGN = 4     # (key, old_assign)      — undo of schedule_pod
 
 
 class ClusterSnapshot:
-    def __init__(self) -> None:
+    def __init__(self, packer=None) -> None:
         self._nodes: Dict[str, Node] = {}
         self._pods: Dict[str, Pod] = {}
         self._assign: Dict[str, str] = {}          # pod key -> node name
@@ -52,6 +52,11 @@ class ClusterSnapshot:
         self._version = 0
         self._cache: Optional[Tuple[int, SnapshotTensors, SnapshotMeta]] = None
         self._cached_group_map: Optional[Dict[str, str]] = None
+        # An IncrementalPacker carried across loops (snapshot/incremental.py)
+        # turns every materialization into an O(delta) diff against its
+        # previous state instead of an O(world) re-flatten — the tensor-side
+        # analog of the reference's DeltaClusterSnapshot (delta.go:26-42).
+        self._packer = packer
 
     # -- mutation -----------------------------------------------------------
     def _bump(self) -> None:
@@ -226,6 +231,16 @@ class ClusterSnapshot:
             and self._cached_group_map == (group_of_node or {})
         ):
             return self._cache[1], self._cache[2]
+        if self._packer is not None:
+            tensors, meta = self._packer.update(
+                list(self._nodes.values()),
+                self._pods.items(),
+                self._assign,
+                group_of_node,
+            )
+            self._cache = (self._version, tensors, meta)
+            self._cached_group_map = dict(group_of_node or {})
+            return tensors, meta
         pods = []
         for key, pod in self._pods.items():
             assigned = self._assign.get(key, "")

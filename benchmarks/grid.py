@@ -126,6 +126,43 @@ def main():
 
             row["pack_s"] = time_it(pack, repeats=1)
 
+            # steady-state incremental pack: a persistent IncrementalPacker
+            # absorbs a small per-loop delta (10 pod adds, 5 removes, 5
+            # reschedules, 1 node add+remove) instead of re-flattening the
+            # world — the DeltaClusterSnapshot intent (delta.go:26-42)
+            from autoscaler_tpu.snapshot.incremental import IncrementalPacker
+
+            isnap = ClusterSnapshot(packer=IncrementalPacker())
+            for i in range(N):
+                isnap.add_node(build_test_node(f"n{i}", cpu_m=4000, mem=8192 * MB))
+            live = []
+            for i in range(P):
+                pod = build_test_pod(f"p{i}", cpu_m=100, mem=200 * MB)
+                isnap.add_pod(pod, f"n{i % N}")
+                live.append(pod.key())
+            isnap.tensors()  # seed the persistent packed state
+            tick = [0]
+
+            def incr_loop():
+                t = tick[0] = tick[0] + 1
+                for i in range(10):
+                    pod = build_test_pod(f"fresh{t}-{i}", cpu_m=120, mem=256 * MB)
+                    isnap.add_pod(pod, f"n{(t + i) % N}")
+                    live.append(pod.key())
+                for key in [live.pop(0) for _ in range(5)]:
+                    isnap.remove_pod(key)
+                for key in live[5:10]:
+                    isnap.schedule_pod(key, f"n{(t * 7) % N}")
+                isnap.add_node(
+                    build_test_node(f"extra{t}", cpu_m=4000, mem=8192 * MB)
+                )
+                if t > 1:
+                    isnap.remove_node(f"extra{t - 1}")
+                isnap.tensors()
+
+            row["pack_incr_s"] = time_it(incr_loop)
+            row["pack_speedup"] = round(row["pack_s"] / row["pack_incr_s"], 1)
+
             def fork_add_revert():
                 snap.fork()
                 snap.add_node(build_test_node("fork-n", cpu_m=4000))

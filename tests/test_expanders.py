@@ -313,6 +313,32 @@ class TestConfigMapPriority:
             "pricey-pool"
         ]
 
+    def test_persistently_malformed_restoration_parses_once(self, monkeypatch):
+        """A recreated-but-malformed ConfigMap re-parses ONCE on the
+        gone→present transition, then hits the bad-payload cache — no
+        per-call re-parse/warn storm while the typo persists."""
+        from autoscaler_tpu.expander import priority as priority_mod
+
+        api = self._api_with('{"10": ["cheap-pool"]}')
+        p = provider_with_groups()
+        f = self._filter(api)
+        f.best_options(options_for(p))
+        api.delete_configmap("kube-system", "cluster-autoscaler-priority-expander")
+        f.best_options(options_for(p))
+        api.write_configmap(
+            "kube-system", "cluster-autoscaler-priority-expander",
+            {"priorities": "{10: [unbalanced"},
+        )
+        calls = []
+        real_parse = priority_mod.parse_priorities
+        monkeypatch.setattr(
+            priority_mod, "parse_priorities",
+            lambda text: (calls.append(text), real_parse(text))[1],
+        )
+        for _ in range(4):
+            f.best_options(options_for(p))
+        assert len(calls) == 1  # one transition parse, then cached
+
     def test_deleted_configmap_reverts_to_fallback(self):
         """With operator-provided fallback tiers, source-gone reverts to the
         fallback rather than disabling prioritization."""

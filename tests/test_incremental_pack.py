@@ -1,0 +1,500 @@
+"""IncrementalPacker parity: every update() must be semantically identical
+to a full pack() of the same objects — per-(pod key, node name) mask
+verdicts, requests, allocatables, used, assignments — across arbitrary
+mutation sequences (adds, removes, relists, reassignments, ports/CSI,
+affinity/spread), in both dense and factored mask modes.
+
+Reference intent: clustersnapshot/delta.go:26-42 (delta snapshots avoid
+O(world) per-loop work); parity discipline mirrors the repo-wide rule that
+every kernel/packing variant is pinned to the serial/full oracle.
+"""
+import numpy as np
+import pytest
+
+from autoscaler_tpu.kube.objects import (
+    Affinity,
+    LabelSelector,
+    PodAffinityTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+from autoscaler_tpu.snapshot.incremental import IncrementalPacker
+from autoscaler_tpu.snapshot.packer import pack
+from autoscaler_tpu.utils.test_utils import GB, MB, build_test_node, build_test_pod
+
+
+def assert_parity(packer_out, nodes, pods_eff, group_of_node=None, dense=None):
+    """Incremental output == full pack of the same (order-free) world."""
+    tensors_i, meta_i = packer_out
+    tensors_f, meta_f = pack(nodes, pods_eff, group_of_node, dense_mask=dense)
+    assert set(meta_i.pod_index) == set(meta_f.pod_index)
+    assert set(meta_i.node_index) == set(meta_f.node_index)
+
+    dense_i = np.asarray(tensors_i.dense_sched())
+    dense_f = np.asarray(tensors_f.dense_sched())
+    alloc_i = np.asarray(tensors_i.node_alloc)
+    alloc_f = np.asarray(tensors_f.node_alloc)
+    used_i = np.asarray(tensors_i.node_used)
+    used_f = np.asarray(tensors_f.node_used)
+    group_i = np.asarray(tensors_i.node_group)
+    group_f = np.asarray(tensors_f.node_group)
+    req_i = np.asarray(tensors_i.pod_req)
+    req_f = np.asarray(tensors_f.pod_req)
+    pn_i = np.asarray(tensors_i.pod_node)
+    pn_f = np.asarray(tensors_f.pod_node)
+    pv_i = np.asarray(tensors_i.pod_valid)
+    nv_i = np.asarray(tensors_i.node_valid)
+
+    for name, jf in meta_f.node_index.items():
+        ji = meta_i.node_index[name]
+        assert nv_i[ji], name
+        np.testing.assert_array_equal(alloc_i[ji], alloc_f[jf], err_msg=name)
+        np.testing.assert_array_equal(used_i[ji], used_f[jf], err_msg=name)
+        gi = group_i[ji]
+        gf = group_f[jf]
+        gname_i = meta_i.group_names[gi] if gi >= 0 else None
+        gname_f = meta_f.group_names[gf] if gf >= 0 else None
+        assert gname_i == gname_f, name
+    # padding rows invalid
+    assert nv_i.sum() == len(meta_f.node_index)
+    assert pv_i.sum() == len(meta_f.pod_index)
+
+    for key, fi in meta_f.pod_index.items():
+        ii = meta_i.pod_index[key]
+        assert pv_i[ii], key
+        np.testing.assert_array_equal(req_i[ii], req_f[fi], err_msg=key)
+        # assignment maps to the same node NAME (row numbering differs)
+        name_i = meta_i.nodes[pn_i[ii]].name if pn_i[ii] >= 0 else None
+        name_f = meta_f.nodes[pn_f[fi]].name if pn_f[fi] >= 0 else None
+        assert name_i == name_f, key
+        # effective pod carries the assignment (consumers read node_name)
+        assert meta_i.pods[ii].node_name == meta_f.pods[fi].node_name, key
+        for name, jf in meta_f.node_index.items():
+            ji = meta_i.node_index[name]
+            assert dense_i[ii, ji] == dense_f[fi, jf], (key, name)
+
+
+class World:
+    """Twin driver: applies mutations to an object world, feeds the
+    IncrementalPacker through a ClusterSnapshot, and checks parity against
+    a fresh full pack after every step."""
+
+    def __init__(self, dense=None):
+        self.packer = IncrementalPacker(dense_mask=dense)
+        self.dense = dense
+        self.nodes = {}
+        self.pods = {}   # key -> (pod, assign)
+        self.groups = {}
+
+    def check(self):
+        snap = ClusterSnapshot(packer=self.packer)
+        for node in self.nodes.values():
+            snap.add_node(node)
+        for key, (pod, assign) in self.pods.items():
+            if assign and assign in self.nodes:
+                snap.add_pod(pod, assign)
+            else:
+                snap.add_pod(pod)
+        out = snap.tensors(self.groups or None)
+        # the full-pack oracle wants effective pods (node_name = assignment)
+        import copy as _copy
+
+        eff = []
+        for key, (pod, assign) in self.pods.items():
+            effective = assign if assign in self.nodes else ""
+            if pod.node_name != effective:
+                pod = _copy.copy(pod)
+                pod.node_name = effective
+            eff.append(pod)
+        assert_parity(out, list(self.nodes.values()), eff, self.groups or None,
+                      dense=self.dense)
+        return out
+
+
+def test_steady_state_no_deltas_is_cached_upload_free():
+    w = World()
+    for i in range(6):
+        w.nodes[f"n{i}"] = build_test_node(f"n{i}", cpu_m=4000, mem=8 * GB)
+    for i in range(20):
+        p = build_test_pod(f"p{i}", cpu_m=100, mem=128 * MB)
+        w.pods[p.key()] = (p, f"n{i % 6}")
+    t1, _ = w.check()
+    full_packs = w.packer.full_packs
+    t2, _ = w.check()
+    assert w.packer.full_packs == full_packs  # no re-pack
+    # unchanged fields reuse the SAME device buffers (no re-upload)
+    assert t2.pod_req is t1.pod_req
+    assert t2.node_alloc is t1.node_alloc
+
+
+def test_add_remove_change_pods_and_nodes():
+    w = World()
+    for i in range(4):
+        w.nodes[f"n{i}"] = build_test_node(f"n{i}", cpu_m=4000, mem=8 * GB)
+    for i in range(12):
+        p = build_test_pod(f"p{i}", cpu_m=200, mem=256 * MB)
+        w.pods[p.key()] = (p, f"n{i % 4}" if i % 3 else "")
+    w.check()
+    # add a node + pods
+    w.nodes["n9"] = build_test_node("n9", cpu_m=16000, mem=32 * GB)
+    p = build_test_pod("fresh", cpu_m=500, mem=GB)
+    w.pods[p.key()] = (p, "n9")
+    w.check()
+    # remove a middle node (its pods go pending) — exercises column swap
+    del w.nodes["n1"]
+    w.check()
+    # remove some pods — row swaps
+    for key in list(w.pods)[2:6]:
+        del w.pods[key]
+    w.check()
+    # "relist": same keys, new objects with different requests
+    for key in list(w.pods)[:3]:
+        pod, assign = w.pods[key]
+        newp = build_test_pod(pod.name, cpu_m=999, mem=333 * MB,
+                              namespace=pod.namespace)
+        w.pods[key] = (newp, assign)
+    w.check()
+    # reassign a pod
+    key = next(iter(w.pods))
+    pod, _ = w.pods[key]
+    w.pods[key] = (pod, "n2")
+    w.check()
+
+
+def test_node_relist_with_new_taints_and_labels():
+    w = World()
+    w.nodes["a"] = build_test_node("a", cpu_m=4000, mem=8 * GB)
+    w.nodes["b"] = build_test_node("b", cpu_m=4000, mem=8 * GB)
+    tolerant = build_test_pod("tol", cpu_m=100, mem=128 * MB)
+    tolerant.tolerations = [Toleration(key="dedicated", value="gpu", effect="NoSchedule")]
+    plain = build_test_pod("plain", cpu_m=100, mem=128 * MB)
+    sel = build_test_pod("sel", cpu_m=100, mem=128 * MB)
+    sel.node_selector = {"zone": "z1"}
+    # tolerates the taint node b will grow, so only the selector gates it
+    sel.tolerations = [
+        Toleration(key="dedicated", value="gpu", effect="NoSchedule")
+    ]
+    for p in (tolerant, plain, sel):
+        w.pods[p.key()] = (p, "")
+    w.check()
+    # node b gets tainted + labeled (a new object, as a watch would deliver)
+    b2 = build_test_node("b", cpu_m=4000, mem=8 * GB)
+    b2.taints = [Taint(key="dedicated", value="gpu", effect="NoSchedule")]
+    b2.labels = dict(b2.labels, zone="z1")
+    w.nodes["b"] = b2
+    out = w.check()
+    tensors, meta = out
+    dense = np.asarray(tensors.dense_sched())
+    jb = meta.node_index["b"]
+    assert not dense[meta.pod_index[plain.key()], jb]   # blocked by taint
+    assert dense[meta.pod_index[tolerant.key()], jb]    # tolerates
+    assert not dense[meta.pod_index[sel.key()], meta.node_index["a"]]
+    assert dense[meta.pod_index[sel.key()], jb]          # selector satisfied
+    # selector pod deleted → 'zone' leaves the relevant key set; parity holds
+    del w.pods[sel.key()]
+    w.check()
+
+
+def test_host_ports_and_csi_across_updates():
+    w = World()
+    for i in range(3):
+        w.nodes[f"n{i}"] = build_test_node(f"n{i}", cpu_m=4000, mem=8 * GB)
+    port_pod = build_test_pod("portly", cpu_m=100, mem=128 * MB)
+    port_pod.host_ports = (8080,)
+    incoming = build_test_pod("incoming", cpu_m=100, mem=128 * MB)
+    incoming.host_ports = (8080,)
+    w.pods[port_pod.key()] = (port_pod, "n0")
+    w.pods[incoming.key()] = (incoming, "")
+    out = w.check()
+    tensors, meta = out
+    dense = np.asarray(tensors.dense_sched())
+    assert not dense[meta.pod_index[incoming.key()], meta.node_index["n0"]]
+    assert dense[meta.pod_index[incoming.key()], meta.node_index["n1"]]
+    # the placed pod keeps its own node (self-cell override)
+    assert dense[meta.pod_index[port_pod.key()], meta.node_index["n0"]]
+    # move the port pod → occupancy follows
+    w.pods[port_pod.key()] = (port_pod, "n2")
+    out = w.check()
+    tensors, meta = out
+    dense = np.asarray(tensors.dense_sched())
+    assert dense[meta.pod_index[incoming.key()], meta.node_index["n0"]]
+    assert not dense[meta.pod_index[incoming.key()], meta.node_index["n2"]]
+    # CSI: node with a 1-volume limit fills up, then drains
+    limited = build_test_node("lim", cpu_m=4000, mem=8 * GB)
+    limited.csi_attach_limits = {"ebs": 1}
+    w.nodes["lim"] = limited
+    vol1 = build_test_pod("vol1", cpu_m=50, mem=64 * MB)
+    vol1.csi_volumes = (("ebs", "h1"),)
+    vol2 = build_test_pod("vol2", cpu_m=50, mem=64 * MB)
+    vol2.csi_volumes = (("ebs", "h2"),)
+    w.pods[vol1.key()] = (vol1, "lim")
+    w.pods[vol2.key()] = (vol2, "")
+    out = w.check()
+    tensors, meta = out
+    dense = np.asarray(tensors.dense_sched())
+    assert not dense[meta.pod_index[vol2.key()], meta.node_index["lim"]]
+    del w.pods[vol1.key()]
+    out = w.check()
+    tensors, meta = out
+    dense = np.asarray(tensors.dense_sched())
+    assert dense[meta.pod_index[vol2.key()], meta.node_index["lim"]]
+
+
+def test_affinity_and_spread_exceptions_across_updates():
+    w = World()
+    for i, zone in enumerate(("z1", "z1", "z2")):
+        node = build_test_node(f"n{i}", cpu_m=4000, mem=8 * GB)
+        node.labels = dict(node.labels, zone=zone)
+        w.nodes[f"n{i}"] = node
+    anchor = build_test_pod("anchor", cpu_m=100, mem=128 * MB,
+                            labels={"app": "db"})
+    anti = build_test_pod("anti", cpu_m=100, mem=128 * MB)
+    anti.affinity = Affinity(
+        pod_anti_affinity=(
+            PodAffinityTerm(
+                selector=LabelSelector(match_labels=(("app", "db"),)),
+                topology_key="zone",
+            ),
+        )
+    )
+    w.pods[anchor.key()] = (anchor, "n0")
+    w.pods[anti.key()] = (anti, "")
+    out = w.check()
+    tensors, meta = out
+    dense = np.asarray(tensors.dense_sched())
+    # anti-affine pod blocked from the anchor's whole zone
+    assert not dense[meta.pod_index[anti.key()], meta.node_index["n0"]]
+    assert not dense[meta.pod_index[anti.key()], meta.node_index["n1"]]
+    assert dense[meta.pod_index[anti.key()], meta.node_index["n2"]]
+    # anchor moves to z2 → verdicts flip on the next loop
+    w.pods[anchor.key()] = (anchor, "n2")
+    out = w.check()
+    tensors, meta = out
+    dense = np.asarray(tensors.dense_sched())
+    assert dense[meta.pod_index[anti.key()], meta.node_index["n0"]]
+    assert not dense[meta.pod_index[anti.key()], meta.node_index["n2"]]
+    # anchor deleted → no constraint at all
+    del w.pods[anchor.key()]
+    out = w.check()
+    tensors, meta = out
+    dense = np.asarray(tensors.dense_sched())
+    assert dense[meta.pod_index[anti.key()]][
+        [meta.node_index[f"n{i}"] for i in range(3)]
+    ].all()
+
+    # hard topology spread joins mid-run
+    spready = build_test_pod("spready", cpu_m=100, mem=128 * MB,
+                             labels={"app": "web"})
+    spready.topology_spread = [
+        TopologySpreadConstraint(
+            max_skew=1,
+            topology_key="zone",
+            when_unsatisfiable="DoNotSchedule",
+            selector=LabelSelector(match_labels=(("app", "web"),)),
+        )
+    ]
+    placed_web = build_test_pod("web0", cpu_m=100, mem=128 * MB,
+                                labels={"app": "web"})
+    w.pods[placed_web.key()] = (placed_web, "n0")
+    w.pods[spready.key()] = (spready, "")
+    w.check()
+
+
+def test_symmetric_anti_affinity_targets_recomputed():
+    """A pod MATCHED by a placed pod's anti-affinity is an exception row;
+    when the placed holder vanishes the row must revert to class-only."""
+    w = World()
+    n0 = build_test_node("n0", cpu_m=4000, mem=8 * GB)
+    n0.labels = dict(n0.labels, zone="z1")
+    w.nodes["n0"] = n0
+    holder = build_test_pod("holder", cpu_m=100, mem=128 * MB)
+    holder.affinity = Affinity(
+        pod_anti_affinity=(
+            PodAffinityTerm(
+                selector=LabelSelector(match_labels=(("app", "victim"),)),
+                topology_key="zone",
+            ),
+        )
+    )
+    victim = build_test_pod("victim", cpu_m=100, mem=128 * MB,
+                            labels={"app": "victim"})
+    w.pods[holder.key()] = (holder, "n0")
+    w.pods[victim.key()] = (victim, "")
+    out = w.check()
+    tensors, meta = out
+    dense = np.asarray(tensors.dense_sched())
+    assert not dense[meta.pod_index[victim.key()], meta.node_index["n0"]]
+    del w.pods[holder.key()]
+    out = w.check()
+    tensors, meta = out
+    dense = np.asarray(tensors.dense_sched())
+    assert dense[meta.pod_index[victim.key()], meta.node_index["n0"]]
+
+
+@pytest.mark.parametrize("dense", [True, False])
+def test_randomized_churn_parity(dense):
+    """Property test: random op soup, parity after every step, both mask
+    modes (the factored form is what the north-star scale uses)."""
+    rng = np.random.default_rng(7)
+    w = World(dense=dense)
+    zones = ("z1", "z2", "z3")
+    serial = [0]
+
+    def new_node():
+        name = f"n{serial[0]}"
+        serial[0] += 1
+        node = build_test_node(name, cpu_m=int(rng.integers(2000, 16000)),
+                               mem=8 * GB)
+        node.labels = dict(node.labels, zone=str(rng.choice(zones)))
+        if rng.random() < 0.2:
+            node.taints = [Taint(key="dedicated", value="x",
+                                 effect="NoSchedule")]
+        w.nodes[name] = node
+
+    def new_pod():
+        name = f"p{serial[0]}"
+        serial[0] += 1
+        pod = build_test_pod(name, cpu_m=int(rng.integers(50, 900)),
+                             mem=256 * MB, labels={"app": str(rng.choice(("a", "b")))})
+        if rng.random() < 0.2:
+            pod.tolerations = [Toleration(key="dedicated", value="x", effect="NoSchedule")]
+        if rng.random() < 0.15:
+            pod.host_ports = (int(rng.choice((80, 443))),)
+        if rng.random() < 0.15:
+            pod.affinity = Affinity(
+                pod_anti_affinity=(
+                    PodAffinityTerm(
+                        selector=LabelSelector(
+                            match_labels=(("app", str(rng.choice(("a", "b")))),)
+                        ),
+                        topology_key="zone",
+                    ),
+                )
+            )
+        assign = ""
+        if w.nodes and rng.random() < 0.6:
+            assign = str(rng.choice(list(w.nodes)))
+        w.pods[pod.key()] = (pod, assign)
+
+    for _ in range(4):
+        new_node()
+    for _ in range(10):
+        new_pod()
+    w.check()
+
+    for step in range(12):
+        op = rng.random()
+        if op < 0.25:
+            new_pod()
+        elif op < 0.4 and len(w.pods) > 3:
+            del w.pods[str(rng.choice(list(w.pods)))]
+        elif op < 0.5:
+            new_node()
+        elif op < 0.6 and len(w.nodes) > 2:
+            del w.nodes[str(rng.choice(list(w.nodes)))]
+        elif op < 0.75 and w.pods:
+            key = str(rng.choice(list(w.pods)))
+            pod, _ = w.pods[key]
+            assign = str(rng.choice(list(w.nodes))) if (
+                w.nodes and rng.random() < 0.7
+            ) else ""
+            w.pods[key] = (pod, assign)
+        elif op < 0.9 and w.pods:
+            # relist: same key, new object
+            key = str(rng.choice(list(w.pods)))
+            pod, assign = w.pods[key]
+            newp = build_test_pod(
+                pod.name, cpu_m=int(rng.integers(50, 900)), mem=256 * MB,
+                namespace=pod.namespace, labels=dict(pod.labels),
+            )
+            newp.tolerations = list(pod.tolerations)
+            newp.host_ports = tuple(pod.host_ports)
+            newp.affinity = pod.affinity
+            w.pods[key] = (newp, assign)
+        else:
+            # group map churn
+            w.groups = {
+                name: f"g{int(rng.integers(0, 3))}" for name in w.nodes
+            }
+        w.check()
+
+
+def test_removal_only_delta_refreshes_device_mask():
+    """A loop whose ONLY delta is deletions must re-upload the dense mask:
+    the swap-fill rewrites host rows/columns in place, and pods/nodes of
+    DIFFERENT predicate classes would otherwise inherit each other's
+    verdicts on device (round-3 review finding)."""
+    w = World(dense=True)
+    tainted = build_test_node("tainted", cpu_m=4000, mem=8 * GB)
+    tainted.taints = [Taint(key="dedicated", value="x", effect="NoSchedule")]
+    w.nodes["tainted"] = tainted
+    w.nodes["open"] = build_test_node("open", cpu_m=4000, mem=8 * GB)
+    intolerant = build_test_pod("intolerant", cpu_m=100, mem=128 * MB)
+    tolerant = build_test_pod("tolerant", cpu_m=100, mem=128 * MB)
+    tolerant.tolerations = [
+        Toleration(key="dedicated", value="x", effect="NoSchedule")
+    ]
+    w.pods[intolerant.key()] = (intolerant, "")
+    w.pods[tolerant.key()] = (tolerant, "")
+    w.check()
+    # pod-removal-only delta: the tolerant pod (added last) swaps into the
+    # freed first row — device must show its verdicts, not the intolerant's
+    del w.pods[intolerant.key()]
+    tensors, meta = w.check()  # assert_parity compares the DEVICE mask
+    dense = np.asarray(tensors.dense_sched())
+    assert dense[meta.pod_index[tolerant.key()], meta.node_index["tainted"]]
+    # node-removal-only delta: removing the FIRST node swaps the open
+    # column into its slot; re-add the intolerant pod first so the two
+    # columns differ observably
+    w.pods[intolerant.key()] = (intolerant, "")
+    w.check()
+    del w.nodes["tainted"]
+    tensors, meta = w.check()
+    dense = np.asarray(tensors.dense_sched())
+    assert dense[meta.pod_index[intolerant.key()], meta.node_index["open"]]
+
+
+def test_bucket_growth_triggers_full_rebuild():
+    w = World()
+    w.nodes["n0"] = build_test_node("n0", cpu_m=4000, mem=8 * GB)
+    for i in range(4):
+        p = build_test_pod(f"p{i}", cpu_m=100, mem=128 * MB)
+        w.pods[p.key()] = (p, "n0")
+    w.check()
+    before = w.packer.full_packs
+    for i in range(4, 40):  # cross the pod bucket
+        p = build_test_pod(f"p{i}", cpu_m=100, mem=128 * MB)
+        w.pods[p.key()] = (p, "")
+    w.check()
+    assert w.packer.full_packs == before + 1
+
+
+def test_autoscaler_shares_packer_across_loops():
+    """End-to-end: the StaticAutoscaler's persistent packer sees successive
+    loops as deltas (full pack only once), and decisions stay correct."""
+    from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+    from autoscaler_tpu.config.options import AutoscalingOptions
+    from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+    from autoscaler_tpu.kube.api import FakeClusterAPI
+
+    provider = TestCloudProvider()
+    api = FakeClusterAPI()
+    provider.add_node_group(
+        "g", 0, 10, 1, build_test_node("tmpl", cpu_m=4000, mem=8 * GB)
+    )
+    node = build_test_node("g-0", cpu_m=4000, mem=8 * GB)
+    provider.add_node("g", node)
+    api.add_node(node)
+    a = StaticAutoscaler(provider, api, AutoscalingOptions())
+    a.run_once(now_ts=0.0)
+    packs_after_first = a._packer.full_packs
+    # two pending 3000m pods: one fits the live empty node, the second
+    # needs a new one — the delta loop must still decide the scale-up
+    api.add_pod(build_test_pod("p0", cpu_m=3000, mem=GB))
+    api.add_pod(build_test_pod("p1", cpu_m=3000, mem=GB))
+    a.run_once(now_ts=10.0)
+    assert provider._groups["g"].target_size() == 2  # scale-up still works
+    assert a._packer.full_packs == packs_after_first  # loop 2 was a delta
+    assert a._packer.incremental_updates > 0
