@@ -28,9 +28,11 @@ from autoscaler_tpu.cloudprovider.interface import (
     InstanceState,
     NodeGroup,
     NodeGroupError,
+    PricingModel,
     ResourceLimiter,
 )
-from autoscaler_tpu.kube.objects import NUM_RESOURCES, Node, Resources, Taint
+from autoscaler_tpu.config.options import NodeGroupAutoscalingOptions
+from autoscaler_tpu.kube.objects import NUM_RESOURCES, Node, Pod, Resources, Taint
 from autoscaler_tpu.rpc import autoscaler_pb2 as pb
 
 PROVIDER_SERVICE = "autoscaler_tpu.CloudProviderService"
@@ -44,7 +46,27 @@ _PROVIDER_METHODS = {
     "TemplateNodeInfo": (pb.TemplateRequest, pb.TemplateResponse),
     "Instances": (pb.InstancesRequest, pb.InstancesResponse),
     "Refresh": (pb.Empty, pb.Empty),
+    "PricingNodePrice": (pb.NodePriceRequest, pb.PriceResponse),
+    "PricingPodPrice": (pb.PodPriceRequest, pb.PriceResponse),
+    "GPULabel": (pb.Empty, pb.GpuLabelResponse),
+    "GetAvailableGPUTypes": (pb.Empty, pb.GpuTypesResponse),
+    "GetResourceLimits": (pb.Empty, pb.ResourceLimitsResponse),
+    "NodeGroupCreate": (pb.NodeGroupCreateRequest, pb.NodeGroupCreateResponse),
+    "NodeGroupDelete": (pb.NodeGroupIdRequest, pb.Empty),
+    "NodeGroupGetOptions": (pb.GroupOptionsRequest, pb.GroupOptionsResponse),
+    "Cleanup": (pb.Empty, pb.Empty),
 }
+
+
+def _spec_for(g: NodeGroup) -> "pb.NodeGroupSpec":
+    return pb.NodeGroupSpec(
+        id=g.id(),
+        min_size=g.min_size(),
+        max_size=g.max_size(),
+        target_size=g.target_size(),
+        exist=g.exist(),
+        autoprovisioned=g.autoprovisioned(),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -61,15 +83,7 @@ class _ProviderServicer:
 
     def NodeGroups(self, request, context):
         return pb.NodeGroupsResponse(
-            groups=[
-                pb.NodeGroupSpec(
-                    id=g.id(),
-                    min_size=g.min_size(),
-                    max_size=g.max_size(),
-                    target_size=g.target_size(),
-                )
-                for g in self.provider.node_groups()
-            ]
+            groups=[_spec_for(g) for g in self.provider.node_groups()]
         )
 
     def NodeGroupForNode(self, request, context):
@@ -121,6 +135,114 @@ class _ProviderServicer:
 
     def Refresh(self, request, context):
         self.provider.refresh()
+        return pb.Empty()
+
+    def PricingNodePrice(self, request, context):
+        model = self.provider.pricing()
+        if model is None:
+            return pb.PriceResponse(error="pricing not implemented")
+        alloc = np.frombuffer(request.allocatable, "<f4")
+        node = Node(
+            name=request.node_name,
+            provider_id=request.provider_id,
+            labels=dict(request.labels),
+            allocatable=Resources.from_tuple(alloc[:NUM_RESOURCES])
+            if len(alloc)
+            else Resources(),
+        )
+        try:
+            return pb.PriceResponse(
+                price=model.node_price(node, request.start_s, request.end_s)
+            )
+        except Exception as e:  # noqa: BLE001 — price errors travel as data
+            return pb.PriceResponse(error=str(e) or type(e).__name__)
+
+    def PricingPodPrice(self, request, context):
+        model = self.provider.pricing()
+        if model is None:
+            return pb.PriceResponse(error="pricing not implemented")
+        req = np.frombuffer(request.requests, "<f4")
+        pod = Pod(
+            name=request.pod_name,
+            requests=Resources.from_tuple(req[:NUM_RESOURCES])
+            if len(req)
+            else Resources(),
+        )
+        try:
+            return pb.PriceResponse(
+                price=model.pod_price(pod, request.start_s, request.end_s)
+            )
+        except Exception as e:  # noqa: BLE001
+            return pb.PriceResponse(error=str(e) or type(e).__name__)
+
+    def GPULabel(self, request, context):
+        return pb.GpuLabelResponse(label=self.provider.gpu_label())
+
+    def GetAvailableGPUTypes(self, request, context):
+        return pb.GpuTypesResponse(types=list(self.provider.get_available_gpu_types()))
+
+    def GetResourceLimits(self, request, context):
+        lim = self.provider.get_resource_limiter()
+        return pb.ResourceLimitsResponse(
+            min_limits=dict(lim.min_limits), max_limits=dict(lim.max_limits)
+        )
+
+    def NodeGroupCreate(self, request, context):
+        creator = getattr(self.provider, "create_node_group", None)
+        if creator is None:
+            context.abort(
+                grpc.StatusCode.UNIMPLEMENTED, "provider does not support NAP"
+            )
+        alloc = np.frombuffer(request.template_allocatable, "<f4")
+        template = Node(
+            name=f"{request.spec.id}-template",
+            allocatable=Resources.from_tuple(alloc[:NUM_RESOURCES]),
+            labels=dict(request.template_labels),
+            taints=[
+                Taint(t.key, t.value, t.effect) for t in request.template_taints
+            ],
+        )
+        group = creator(
+            request.spec.id,
+            template,
+            min_size=request.spec.min_size,
+            max_size=request.spec.max_size,
+            price_per_hour=request.price_per_hour,
+        )
+        return pb.NodeGroupCreateResponse(created=_spec_for(group))
+
+    def NodeGroupDelete(self, request, context):
+        self._group(request.group_id).delete()
+        return pb.Empty()
+
+    def NodeGroupGetOptions(self, request, context):
+        defaults = NodeGroupAutoscalingOptions(
+            scale_down_utilization_threshold=(
+                request.default_scale_down_utilization_threshold
+            ),
+            scale_down_gpu_utilization_threshold=(
+                request.default_scale_down_gpu_utilization_threshold
+            ),
+            scale_down_unneeded_time_s=request.default_scale_down_unneeded_time_s,
+            scale_down_unready_time_s=request.default_scale_down_unready_time_s,
+            max_node_provision_time_s=request.default_max_node_provision_time_s,
+        )
+        opts = self._group(request.group_id).get_options(defaults)
+        if opts is None:
+            return pb.GroupOptionsResponse(has=False)
+        return pb.GroupOptionsResponse(
+            has=True,
+            scale_down_utilization_threshold=opts.scale_down_utilization_threshold,
+            scale_down_gpu_utilization_threshold=(
+                opts.scale_down_gpu_utilization_threshold
+            ),
+            scale_down_unneeded_time_s=opts.scale_down_unneeded_time_s,
+            scale_down_unready_time_s=opts.scale_down_unready_time_s,
+            max_node_provision_time_s=opts.max_node_provision_time_s,
+        )
+
+    def Cleanup(self, request, context):
+        self.provider.cleanup()
         return pb.Empty()
 
 
@@ -213,13 +335,113 @@ class _RemoteNodeGroup(NodeGroup):
             taints=[Taint(t.key, t.value, t.effect) for t in resp.taints],
         )
 
+    def exist(self) -> bool:
+        # absent field (legacy server predating `exist`) = the group exists
+        return self._spec.exist if self._spec.HasField("exist") else True
+
+    def autoprovisioned(self) -> bool:
+        return self._spec.autoprovisioned
+
+    def create(self) -> NodeGroup:
+        """Materialize a server-advertised NAP placeholder (exist=false) via
+        NodeGroupCreate — the remote half of NodeGroup.Create
+        (cloud_provider.go:219)."""
+        return self._provider.group_factory(self)
+
+    def delete(self) -> None:
+        self._provider._call(
+            "NodeGroupDelete", pb.NodeGroupIdRequest(group_id=self._spec.id)
+        )
+        self._provider._groups = [
+            g for g in self._provider._groups if g.id() != self._spec.id
+        ]
+
+    def get_options(self, defaults):
+        try:
+            resp = self._provider._call(
+                "NodeGroupGetOptions",
+                pb.GroupOptionsRequest(
+                    group_id=self._spec.id,
+                    default_scale_down_utilization_threshold=(
+                        defaults.scale_down_utilization_threshold
+                    ),
+                    default_scale_down_gpu_utilization_threshold=(
+                        defaults.scale_down_gpu_utilization_threshold
+                    ),
+                    default_scale_down_unneeded_time_s=(
+                        defaults.scale_down_unneeded_time_s
+                    ),
+                    default_scale_down_unready_time_s=(
+                        defaults.scale_down_unready_time_s
+                    ),
+                    default_max_node_provision_time_s=(
+                        defaults.max_node_provision_time_s
+                    ),
+                ),
+            )
+        except grpc.RpcError:
+            # reference semantics: an RPC error means "use defaults"
+            # (externalgrpc.proto:111)
+            return None
+        if not resp.has:
+            return None
+        return NodeGroupAutoscalingOptions(
+            scale_down_utilization_threshold=resp.scale_down_utilization_threshold,
+            scale_down_gpu_utilization_threshold=(
+                resp.scale_down_gpu_utilization_threshold
+            ),
+            scale_down_unneeded_time_s=resp.scale_down_unneeded_time_s,
+            scale_down_unready_time_s=resp.scale_down_unready_time_s,
+            max_node_provision_time_s=resp.max_node_provision_time_s,
+        )
+
+
+class _RemotePricingModel(PricingModel):
+    """Client-side PricingModel delegating to the server's
+    (externalgrpc.proto:45-51). A server without pricing returns an error
+    field; that surfaces as NodeGroupError like the reference's ErrNotImplemented."""
+
+    def __init__(self, provider: "ExternalGrpcCloudProvider"):
+        self._provider = provider
+
+    def node_price(self, node: Node, start_s: float, end_s: float) -> float:
+        resp = self._provider._call(
+            "PricingNodePrice",
+            pb.NodePriceRequest(
+                node_name=node.name,
+                provider_id=node.provider_id,
+                labels=dict(node.labels),
+                allocatable=np.array(node.allocatable.as_tuple(), "<f4").tobytes(),
+                start_s=start_s,
+                end_s=end_s,
+            ),
+        )
+        if resp.error:
+            raise NodeGroupError(resp.error)
+        return resp.price
+
+    def pod_price(self, pod: Pod, start_s: float, end_s: float) -> float:
+        resp = self._provider._call(
+            "PricingPodPrice",
+            pb.PodPriceRequest(
+                pod_name=pod.name,
+                requests=np.array(pod.requests.as_tuple(), "<f4").tobytes(),
+                start_s=start_s,
+                end_s=end_s,
+            ),
+        )
+        if resp.error:
+            raise NodeGroupError(resp.error)
+        return resp.price
+
 
 class ExternalGrpcCloudProvider(CloudProvider):
     def __init__(self, target: str, resource_limiter: Optional[ResourceLimiter] = None):
         self._channel = grpc.insecure_channel(target)
-        self._limiter = resource_limiter or ResourceLimiter()
+        self._limiter = resource_limiter
         self._groups: List[_RemoteNodeGroup] = []
         self._node_group_cache: Dict[str, str] = {}
+        self._gpu_label: Optional[str] = None
 
     def _call(self, method: str, request):
         req_cls, resp_cls = _PROVIDER_METHODS[method]
@@ -238,6 +460,65 @@ class ExternalGrpcCloudProvider(CloudProvider):
         resp = self._call("NodeGroups", pb.Empty())
         self._groups = [_RemoteNodeGroup(self, spec) for spec in resp.groups]
         self._node_group_cache.clear()
+
+    def pricing(self) -> Optional[PricingModel]:
+        return _RemotePricingModel(self)
+
+    def gpu_label(self) -> str:
+        if self._gpu_label is None:
+            self._gpu_label = self._call("GPULabel", pb.Empty()).label
+        return self._gpu_label
+
+    def get_available_gpu_types(self) -> List[str]:
+        return list(self._call("GetAvailableGPUTypes", pb.Empty()).types)
+
+    def group_factory(self, candidate: NodeGroup) -> NodeGroup:
+        """NAP factory: materialize a host-side candidate group on the remote
+        provider (plug as AutoprovisioningNodeGroupListProcessor's
+        group_factory). reference: orchestrator.go:217 CreateNodeGroup."""
+        return self.create_node_group(
+            candidate.id(),
+            candidate.template_node_info(),
+            min_size=candidate.min_size(),
+            max_size=candidate.max_size(),
+            price_per_hour=getattr(candidate, "price_per_hour", 0.0),
+        )
+
+    def create_node_group(
+        self,
+        name: str,
+        template: Node,
+        min_size: int = 0,
+        max_size: int = 100,
+        price_per_hour: float = 0.0,
+    ) -> NodeGroup:
+        """Same keyword contract as the server-side provider hook, so
+        serve_cloud_provider(ExternalGrpcCloudProvider(...)) chains — the
+        servicer's NodeGroupCreate can call straight through this proxy."""
+        resp = self._call(
+            "NodeGroupCreate",
+            pb.NodeGroupCreateRequest(
+                spec=pb.NodeGroupSpec(
+                    id=name,
+                    min_size=min_size,
+                    max_size=max_size,
+                    target_size=0,
+                    autoprovisioned=True,
+                ),
+                template_allocatable=np.array(
+                    template.allocatable.as_tuple(), "<f4"
+                ).tobytes(),
+                template_labels=dict(template.labels),
+                template_taints=[
+                    pb.TaintMsg(key=t.key, value=t.value, effect=t.effect)
+                    for t in template.taints
+                ],
+                price_per_hour=price_per_hour,
+            ),
+        )
+        group = _RemoteNodeGroup(self, resp.created)
+        self._groups = [g for g in self._groups if g.id() != name] + [group]
+        return group
 
     def node_groups(self) -> List[NodeGroup]:
         if not self._groups:
@@ -263,7 +544,25 @@ class ExternalGrpcCloudProvider(CloudProvider):
         return None
 
     def get_resource_limiter(self) -> ResourceLimiter:
+        # explicit host-side limits win; otherwise ask the server
+        # (externalgrpc analog of cloud_provider.go:127 GetResourceLimiter)
+        if self._limiter is not None:
+            return self._limiter
+        try:
+            resp = self._call("GetResourceLimits", pb.Empty())
+        except grpc.RpcError:
+            # transient server failure: return unlimited for THIS call but do
+            # not cache it — the next loop retries instead of silently running
+            # without the operator's caps forever
+            return ResourceLimiter()
+        self._limiter = ResourceLimiter(
+            min_limits=dict(resp.min_limits), max_limits=dict(resp.max_limits)
+        )
         return self._limiter
 
     def cleanup(self) -> None:
+        try:
+            self._call("Cleanup", pb.Empty())
+        except grpc.RpcError:
+            pass  # server already gone — closing the channel is the point
         self._channel.close()

@@ -161,6 +161,11 @@ class CloudProvider(abc.ABC):
     def gpu_label(self) -> str:
         return "cloud.google.com/gke-accelerator"
 
+    def get_available_gpu_types(self) -> List[str]:
+        """GPU types this cloud offers (reference GetAvailableGPUTypes,
+        cloud_provider.go:130)."""
+        return []
+
     def refresh(self) -> None:
         """Called once per loop before decisions
         (reference static_autoscaler.go:333)."""

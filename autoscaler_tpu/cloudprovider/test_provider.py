@@ -117,6 +117,11 @@ class TestNodeGroup(NodeGroup):
     def set_target_size(self, target: int) -> None:
         self._target = target
 
+    def get_options(self, defaults):
+        """Per-group overrides when set via `options` (reference
+        TestNodeGroup.GetOptions); None = defaults."""
+        return getattr(self, "options", None)
+
 
 class TestPricingModel(PricingModel):
     def __init__(self, provider: "TestCloudProvider"):
@@ -156,6 +161,7 @@ class TestCloudProvider(CloudProvider):
         self._template_seq = itertools.count()
         self.scale_up_calls: List[tuple] = []
         self.scale_down_calls: List[tuple] = []
+        self.gpu_types: List[str] = []
 
     # -- test wiring ---------------------------------------------------------
     def add_node_group(
@@ -181,6 +187,20 @@ class TestCloudProvider(CloudProvider):
         self._groups[name] = group
         self._instances.setdefault(name, [])
         return group
+
+    def create_node_group(
+        self,
+        name: str,
+        template: Node,
+        min_size: int = 0,
+        max_size: int = 100,
+        price_per_hour: float = 1.0,
+    ) -> TestNodeGroup:
+        """NAP materialization seam (NodeGroup.Create analog) — also the
+        server-side hook for NodeGroupCreate over external gRPC."""
+        return self.add_node_group(
+            name, min_size, max_size, 0, template, price_per_hour, autoprovisioned=True
+        )
 
     def remove_node_group(self, name: str) -> None:
         self._groups.pop(name, None)
@@ -237,6 +257,9 @@ class TestCloudProvider(CloudProvider):
 
     def pricing(self) -> PricingModel:
         return TestPricingModel(self)
+
+    def get_available_gpu_types(self) -> List[str]:
+        return list(self.gpu_types)
 
     def get_resource_limiter(self) -> ResourceLimiter:
         return self._limiter

@@ -102,6 +102,13 @@ class ScaleUpOrchestrator:
         if not pending_pods:
             return ScaleUpResult()
 
+        # Re-read the limiter every pass: providers may fetch it remotely
+        # (external gRPC) and a limiter captured once at construction would
+        # pin a transient startup failure's unlimited fallback for the
+        # process lifetime (reference reads it per loop via
+        # context.NewResourceLimiterFromAutoscalingOptions / Refresh).
+        self.resource_manager.limiter = self.provider.get_resource_limiter()
+
         # Equivalence groups shrink reporting/mask work (orchestrator.go:103).
         pod_groups = build_pod_groups(pending_pods)
 

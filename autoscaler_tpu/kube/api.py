@@ -9,6 +9,7 @@ in-process (FakeClusterAPI) and bindable to any real control plane.
 from __future__ import annotations
 
 import abc
+import copy
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -151,29 +152,42 @@ class FakeClusterAPI(ClusterAPI):
         with self._lock:
             return pod_key in self.pods
 
+    # Node writes REPLACE the stored object (copy-on-write) rather than
+    # mutating in place: listings must behave like the real client, where
+    # every watch event parses a fresh object — IncrementalPacker diffs
+    # listings by object identity (snapshot/incremental.py), so an in-place
+    # mutation would be invisible to the persistent packed tensors.
     def add_taint(self, node_name: str, taint: Taint) -> None:
         with self._lock:
             node = self.nodes[node_name]
             if not any(t.key == taint.key for t in node.taints):
-                node.taints.append(taint)
+                updated = copy.copy(node)
+                updated.taints = list(node.taints) + [taint]
+                self.nodes[node_name] = updated
 
     def remove_taint(self, node_name: str, taint_key: str) -> None:
         with self._lock:
             node = self.nodes.get(node_name)
-            if node:
-                node.taints = [t for t in node.taints if t.key != taint_key]
+            if node and any(t.key == taint_key for t in node.taints):
+                updated = copy.copy(node)
+                updated.taints = [t for t in node.taints if t.key != taint_key]
+                self.nodes[node_name] = updated
 
     def cordon_node(self, node_name: str) -> None:
         with self._lock:
             node = self.nodes.get(node_name)
-            if node:
-                node.unschedulable = True
+            if node and not node.unschedulable:
+                updated = copy.copy(node)
+                updated.unschedulable = True
+                self.nodes[node_name] = updated
 
     def uncordon_node(self, node_name: str) -> None:
         with self._lock:
             node = self.nodes.get(node_name)
-            if node:
-                node.unschedulable = False
+            if node and node.unschedulable:
+                updated = copy.copy(node)
+                updated.unschedulable = False
+                self.nodes[node_name] = updated
 
     def delete_node_object(self, node_name: str) -> None:
         with self._lock:

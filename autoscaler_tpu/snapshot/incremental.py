@@ -45,17 +45,17 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from autoscaler_tpu.kube import objects as k8s
 from autoscaler_tpu.kube.objects import NUM_RESOURCES, Node, Pod
 from autoscaler_tpu.snapshot.packer import (
     DENSE_MASK_CELL_LIMIT,
     SnapshotMeta,
     _apply_row_rules,
-    _csi_fits,
+    _class_verdict,
     _node_profile_key,
     _pod_csi_counts,
     _pod_profile_key,
     _RowView,
+    _self_cell_value,
     _term_matches_pod,
     resources_row,
 )
@@ -113,8 +113,24 @@ class _PodSlot:
             self.eff = eff
 
 
+def _node_mut_fp(node: Node):
+    """Fingerprint of the fields the autoscaler itself mutates between loops
+    (taint/cordon via the cluster API) — cheap O(#taints) defense against an
+    API implementation that mutates listed Node objects in place instead of
+    replacing them (the real client always parses fresh objects; FakeClusterAPI
+    copies on write). Identity diffing alone would miss such mutations and
+    serve a stale schedulability verdict for the node."""
+    return (
+        node.unschedulable,
+        node.ready,
+        tuple((t.key, t.value, t.effect) for t in node.taints),
+    )
+
+
 class _NodeSlot:
-    __slots__ = ("name", "obj", "static_key", "full_key", "class_id", "stamp")
+    __slots__ = (
+        "name", "obj", "static_key", "full_key", "class_id", "stamp", "mut_fp",
+    )
 
     def __init__(self, node: Node, stamp: int):
         self.name = node.name
@@ -123,18 +139,7 @@ class _NodeSlot:
         self.full_key = None
         self.class_id = -1
         self.stamp = stamp
-
-
-def _class_verdict(pod: Pod, node: Node, ports: Dict, attached: Dict) -> bool:
-    """One (pod-profile, node-profile) cell: the class-structured predicates
-    (same chain as packer._profile_factorization's exemplar loop)."""
-    return (
-        not node.unschedulable
-        and k8s.pod_tolerates_taints(pod, node.taints)
-        and k8s.node_matches_selector(pod, node)
-        and not any(ports.get(p, 0) > 0 for p in pod.host_ports)
-        and _csi_fits(_pod_csi_counts(pod), attached, node.csi_attach_limits)
-    )
+        self.mut_fp = _node_mut_fp(node)
 
 
 _EMPTY: Dict = {}
@@ -201,8 +206,10 @@ class IncrementalPacker:
         self._pod_req = np.zeros((PP, R), np.float32)
         self._pod_valid = np.zeros((PP,), bool)
         self._pod_node = np.full((PP,), -1, np.int32)
-        self._pod_class = np.full((PP,), -1, np.int64)
-        self._node_class = np.full((NN,), -1, np.int64)
+        # int32 natively: _assemble hands these straight to _upload, and a
+        # per-loop astype would be an O(world) copy even on idle loops
+        self._pod_class = np.full((PP,), -1, np.int32)
+        self._node_class = np.full((NN,), -1, np.int32)
         self._mask = np.zeros((PP, NN), bool) if self._dense else None
         self._group_map: Dict[str, str] = {}
         self._group_names: List[str] = []
@@ -253,7 +260,7 @@ class IncrementalPacker:
             else:
                 slot = node_slots[row]
                 slot.stamp = gen
-                if node is not slot.obj:
+                if node is not slot.obj or _node_mut_fp(node) != slot.mut_fp:
                     self._change_node(row, node)
                     dirty_node_rows.add(row)
                     structural = True
@@ -590,6 +597,7 @@ class IncrementalPacker:
         slot = self._node_slots[row]
         slot.obj = node
         slot.static_key = None
+        slot.mut_fp = _node_mut_fp(node)
 
     def _remove_node(self, name: str, dirty_nodes: Set[int]) -> None:
         row = self._node_rows.pop(name)
@@ -717,21 +725,7 @@ class IncrementalPacker:
             pod = self._pod_slots[i].orig
             node = self._node_slots[j].obj
             ports, attached = self._node_dyn.get(j, (_EMPTY, _EMPTY))
-            conflict = any(ports.get(prt, 0) > 1 for prt in pod.host_ports)
-            pod_drivers = {d for d, _ in pod.csi_volumes}
-            csi_ok = all(
-                len(attached.get(d, ())) <= limit
-                for d, limit in node.csi_attach_limits.items()
-                if d in pod_drivers
-            )
-            value = (
-                not node.unschedulable
-                and k8s.pod_tolerates_taints(pod, node.taints)
-                and k8s.node_matches_selector(pod, node)
-                and not conflict
-                and csi_ok
-            )
-            out.append((i, int(j), value))
+            out.append((i, int(j), _self_cell_value(pod, node, ports, attached)))
         return out
 
     def _class_row(self, i: int, n: int) -> np.ndarray:
@@ -889,12 +883,8 @@ class IncrementalPacker:
                 self._dev["cell_val"] = jnp.asarray(cell_val)
             tensors = SnapshotTensors(
                 sched_mask=None,
-                pod_class=self._upload(
-                    "pod_class", self._pod_class.astype(np.int32)
-                ),
-                node_class=self._upload(
-                    "node_class", self._node_class.astype(np.int32)
-                ),
+                pod_class=self._upload("pod_class", self._pod_class),
+                node_class=self._upload("node_class", self._node_class),
                 class_mask=self._dev["class_mask"],
                 exc_rows=self._upload("exc_rows", self._exc_rows_np),
                 pod_exc=self._upload("pod_exc", self._pod_exc_np),

@@ -249,19 +249,23 @@ def _profile_factorization(
 
     prof_mask = np.ones((max(len(pod_exemplar), 1), max(len(node_exemplar), 1)), bool)
     for pi, pod in enumerate(pod_exemplar):
-        pod_csi = _pod_csi_counts(pod)
         for nj, (node, ports, attached) in enumerate(node_exemplar):
-            if node.unschedulable:
-                prof_mask[pi, nj] = False
-            elif not k8s.pod_tolerates_taints(pod, node.taints):
-                prof_mask[pi, nj] = False
-            elif not k8s.node_matches_selector(pod, node):
-                prof_mask[pi, nj] = False
-            elif any(ports.get(p, 0) > 0 for p in pod.host_ports):
-                prof_mask[pi, nj] = False
-            elif not _csi_fits(pod_csi, attached, node.csi_attach_limits):
-                prof_mask[pi, nj] = False
+            prof_mask[pi, nj] = _class_verdict(pod, node, ports, attached)
     return pod_prof_id, node_prof_id, prof_mask
+
+
+def _class_verdict(pod: Pod, node: Node, ports: Dict, attached: Dict) -> bool:
+    """One (pod-profile, node-profile) cell: the class-structured predicate
+    chain. The single source of truth shared by the full packer's exemplar
+    loop and the incremental packer's per-cell refresh — extend HERE when a
+    new class-factorizable predicate lands, or the two paths drift."""
+    return (
+        not node.unschedulable
+        and k8s.pod_tolerates_taints(pod, node.taints)
+        and k8s.node_matches_selector(pod, node)
+        and not any(ports.get(p, 0) > 0 for p in pod.host_ports)
+        and _csi_fits(_pod_csi_counts(pod), attached, node.csi_attach_limits)
+    )
 
 
 def _class_verdict_no_ports(pod: Pod, node: Node) -> bool:
@@ -271,6 +275,20 @@ def _class_verdict_no_ports(pod: Pod, node: Node) -> bool:
         and k8s.pod_tolerates_taints(pod, node.taints)
         and k8s.node_matches_selector(pod, node)
     )
+
+
+def _self_cell_value(pod: Pod, node: Node, port_counts: Dict, attached: Dict) -> bool:
+    """Corrected verdict for a placed pod's cell on its OWN node: its own
+    port/volume contribution must not count against it. Shared by
+    _self_cell_overrides and IncrementalPacker._compute_overrides."""
+    conflict = any(port_counts.get(p, 0) > 1 for p in pod.host_ports)
+    pod_drivers = {d for d, _ in pod.csi_volumes}
+    csi_ok = all(
+        len(attached.get(d, ())) <= limit
+        for d, limit in node.csi_attach_limits.items()
+        if d in pod_drivers
+    )
+    return _class_verdict_no_ports(pod, node) and not conflict and csi_ok
 
 
 def _self_cell_overrides(
@@ -297,16 +315,9 @@ def _self_cell_overrides(
         j = node_of_pod[i]
         if j < 0 or not (pod.host_ports or pod.csi_volumes):
             continue
-        counts = port_count.get(j, {})
-        conflict = any(counts.get(p, 0) > 1 for p in pod.host_ports)
-        attached = csi_attached.get(j, {})
-        pod_drivers = {d for d, _ in pod.csi_volumes}
-        csi_ok = all(
-            len(attached.get(d, ())) <= limit
-            for d, limit in nodes[j].csi_attach_limits.items()
-            if d in pod_drivers
+        value = _self_cell_value(
+            pod, nodes[j], port_count.get(j, {}), csi_attached.get(j, {})
         )
-        value = _class_verdict_no_ports(pod, nodes[j]) and not conflict and csi_ok
         out.append((i, j, value))
     return out
 

@@ -197,6 +197,53 @@ def test_node_relist_with_new_taints_and_labels():
     w.check()
 
 
+def test_in_place_node_mutation_still_dirties_the_row():
+    """Identity diffing alone would miss a caller that mutates a listed Node
+    in place (taint/cordon) instead of replacing it; the mut-fingerprint must
+    catch the actuator-mutable fields. The real client and FakeClusterAPI
+    both replace objects, but the packer must not silently trust that."""
+    w = World()
+    w.nodes["a"] = build_test_node("a", cpu_m=4000, mem=8 * GB)
+    w.nodes["b"] = build_test_node("b", cpu_m=4000, mem=8 * GB)
+    p = build_test_pod("p", cpu_m=100, mem=128 * MB)
+    w.pods[p.key()] = (p, "")
+    tensors, meta = w.check()
+    assert np.asarray(tensors.dense_sched())[meta.pod_index[p.key()],
+                                             meta.node_index["b"]]
+    # SAME object, mutated in place — the forbidden-but-defended pattern
+    w.nodes["b"].taints.append(Taint(key="k", value="v", effect="NoSchedule"))
+    tensors, meta = w.check()
+    assert not np.asarray(tensors.dense_sched())[meta.pod_index[p.key()],
+                                                 meta.node_index["b"]]
+    w.nodes["a"].unschedulable = True
+    tensors, meta = w.check()
+    assert not np.asarray(tensors.dense_sched())[meta.pod_index[p.key()],
+                                                 meta.node_index["a"]]
+
+
+def test_fake_api_taint_cordon_replace_objects():
+    """FakeClusterAPI node writes must copy-on-write so identity diffing in
+    the incremental packer sees them (kube/api.py contract)."""
+    from autoscaler_tpu.kube.api import FakeClusterAPI
+    from autoscaler_tpu.kube.objects import Taint as T
+
+    api = FakeClusterAPI()
+    node = build_test_node("n1", cpu_m=1000, mem=1 * GB)
+    api.nodes[node.name] = node
+    api.add_taint("n1", T(key="x", value="y", effect="NoSchedule"))
+    assert api.nodes["n1"] is not node
+    assert not node.taints  # original untouched
+    before = api.nodes["n1"]
+    api.cordon_node("n1")
+    assert api.nodes["n1"] is not before
+    assert api.nodes["n1"].unschedulable and not before.unschedulable
+    # idempotent writes don't churn objects
+    same = api.nodes["n1"]
+    api.cordon_node("n1")
+    api.add_taint("n1", T(key="x", value="y", effect="NoSchedule"))
+    assert api.nodes["n1"] is same
+
+
 def test_host_ports_and_csi_across_updates():
     w = World()
     for i in range(3):

@@ -396,9 +396,14 @@ class TestPlannerAndActuator:
         from autoscaler_tpu.kube.objects import DELETION_CANDIDATE_TAINT
 
         assert any(t.key == DELETION_CANDIDATE_TAINT for t in api.nodes["n0"].taints)
-        # node becomes needed again → taint removed
-        changed2 = actuator.update_soft_deletion_taints(nodes, [])
+        # node becomes needed again → taint removed. Re-list, as the real
+        # loop does: node writes copy-on-write (kube/api.py), so the earlier
+        # listing intentionally does NOT reflect the taints just added.
+        changed2 = actuator.update_soft_deletion_taints(api.list_nodes(), [])
         assert changed2 == 3
+        assert not any(
+            t.key == DELETION_CANDIDATE_TAINT for t in api.nodes["n0"].taints
+        )
 
     def test_cleanup_leftover_taints(self):
         provider, api, snapshot, nodes, opts = self._world()
