@@ -438,7 +438,8 @@ class _RemotePricingModel(PricingModel):
 class ExternalGrpcCloudProvider(CloudProvider):
     def __init__(self, target: str, resource_limiter: Optional[ResourceLimiter] = None):
         self._channel = grpc.insecure_channel(target)
-        self._limiter = resource_limiter
+        self._host_limiter = resource_limiter   # sticky operator override
+        self._limiter: Optional[ResourceLimiter] = None  # server-derived cache
         self._groups: List[_RemoteNodeGroup] = []
         self._node_group_cache: Dict[str, str] = {}
         self._gpu_label: Optional[str] = None
@@ -460,6 +461,10 @@ class ExternalGrpcCloudProvider(CloudProvider):
         resp = self._call("NodeGroups", pb.Empty())
         self._groups = [_RemoteNodeGroup(self, spec) for spec in resp.groups]
         self._node_group_cache.clear()
+        # server-derived limits refetch next read so runtime cap changes on
+        # the provider side propagate within one loop (host-provided limits
+        # stay sticky)
+        self._limiter = None
 
     def pricing(self) -> Optional[PricingModel]:
         return _RemotePricingModel(self)
@@ -546,6 +551,8 @@ class ExternalGrpcCloudProvider(CloudProvider):
     def get_resource_limiter(self) -> ResourceLimiter:
         # explicit host-side limits win; otherwise ask the server
         # (externalgrpc analog of cloud_provider.go:127 GetResourceLimiter)
+        if self._host_limiter is not None:
+            return self._host_limiter
         if self._limiter is not None:
             return self._limiter
         try:

@@ -1,0 +1,37 @@
+"""Device-side boolean bit-packing for cheap host fetches.
+
+The north-star estimator's `scheduled` output is a [G, P] bool — 50MB at
+100k pods × 500 groups. Fetched raw over the axon tunnel it costs ~1.2s,
+an order of magnitude more than the node_count fetch; packed 8:1 on device
+it rides home in ~150ms and unpacks host-side with np.unpackbits at memory
+speed. Layout matches np.unpackbits' default big-endian bit order so the
+host side is a single library call.
+
+TPU-design note: this is the "minimize host↔device transfers" rule applied
+to the decision path — the control plane consumes booleans, so ship bits,
+not bytes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_WEIGHTS = np.array([128, 64, 32, 16, 8, 4, 2, 1], np.int32)  # MSB-first
+
+
+@jax.jit
+def pack_bool_bits(x: jax.Array) -> jax.Array:
+    """[..., P] bool → [..., ceil(P/8)] uint8 (np.unpackbits-compatible)."""
+    P = x.shape[-1]
+    pad = (-P) % 8
+    if pad:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    grouped = x.reshape(*x.shape[:-1], (P + pad) // 8, 8).astype(jnp.int32)
+    return jnp.tensordot(grouped, jnp.asarray(_WEIGHTS), axes=1).astype(jnp.uint8)
+
+
+def unpack_bool_bits(packed: np.ndarray, length: int) -> np.ndarray:
+    """Host-side inverse: [..., B] uint8 → [..., length] bool."""
+    flat = np.unpackbits(np.ascontiguousarray(packed), axis=-1)
+    return flat[..., :length].astype(bool)

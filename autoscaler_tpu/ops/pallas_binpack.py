@@ -2,21 +2,24 @@
 multi-group estimator.
 
 The XLA scan in ops/binpack.ffd_binpack_groups is HBM-bound: every pod step
-reads and rewrites the [G, R, M] usage carry (~12MB at G=500, M=1000), which
-costs ~50-80µs/step on a v5e. Here the carry lives in VMEM for a whole chunk
-of pods: the grid is (group-blocks,) and each program runs CHUNK scan steps
-against its [GB, R, M] usage block without touching HBM, so a step is pure
-VPU work (two [GB, M]-per-resource passes: compare and one-hot update).
+reads and rewrites its usage carry (~12MB at G=500, M=1000), which costs
+~50-80µs/step on a v5e. Here the carry lives in VMEM for a whole chunk of
+pods: the grid is (group-blocks,) and each program runs CHUNK scan steps
+against its [R, GB, M] FREE-capacity block without touching HBM, so a step
+is pure VPU work (one compare pass + one-hot update per resource plane).
 
-Layout notes (Mosaic constraints): the per-step streams are shaped with the
-step axis on the *sublane* dimension — requests [R, CHUNK, GB], actives and
-placements [CHUNK, GB] — and the kernel walks them in 8-step tiles (sublane
-tile size) with an unrolled inner loop, so every dynamic offset is provably
-8-aligned; lane dimensions (GB, M) are full-width. The host driver
-pre-gathers each chunk's score-sorted requests with one XLA gather and feeds
-consecutive pallas_call invocations whose usage/opened carries are donated
-(input_output_aliased), so chunk dispatch costs one HBM round-trip of the
-carry instead of one per pod.
+Layout notes (Mosaic constraints): the carry is resource-major ([R, GB, M])
+so each per-resource plane is a contiguous tile-aligned [GB sublanes × M
+lanes] block; the request stream puts the step axis on the sublane
+dimension ([R, CHUNK, GB]) and the kernel walks it in 8-step tiles with an
+unrolled inner loop, so every dynamic offset is provably 8-aligned.
+Inactive pods (mask-failed / pad) travel as +inf request rows — no separate
+active stream. Closed nodes hold free == alloc, letting one unmasked
+first-fit min implement both "first open node that fits" and "open a new
+node" (see the kernel comment). The per-chunk pallas_call carries are
+donated (input_output_aliased), so chunk dispatch costs one HBM round-trip
+of the carry instead of one per pod; resource axes nobody requests are
+dropped before the kernel (exact — see the compression comment).
 
 Semantics are bit-identical to ffd_binpack_groups (same FFD rules:
 score-descending order, first-fit in node-open order, open-on-miss,
@@ -40,13 +43,11 @@ _STEP_TILE = 8  # sublane tile: dynamic offsets must be provably 8-aligned
 
 
 def _scan_kernel(
-    req_ref,      # [R, CHUNK, GB] f32 — pre-gathered sorted pod requests
-    active_ref,   # [CHUNK, GB] i32 — pod passes the group's predicates
-    alloc_ref,    # [1, GB, R] f32
+    req_ref,      # [R, CHUNK, GB] f32 — sorted pod requests, +inf = inactive
     caps_ref,     # [1, GB] i32
-    used_in_ref,  # [GB, R, M] f32 (aliased with used_out)
+    free_in_ref,  # [R, GB, M] f32 (aliased with free_out)
     opened_in_ref,  # [1, GB] i32 (aliased with opened_out)
-    used_ref,     # [GB, R, M] f32 out
+    free_ref,     # [R, GB, M] f32 out
     opened_ref,   # [1, GB] i32 out
     placed_ref,   # [CHUNK, GB] i32 out
     *,
@@ -54,13 +55,20 @@ def _scan_kernel(
     chunk: int,
     max_nodes: int,
 ):
-    gb = used_ref.shape[0]
+    # Layout: the capacity carry is resource-MAJOR ([R, GB, M]) so each
+    # per-resource slice free_ref[r] is a contiguous, tile-aligned [GB, M]
+    # block (GB sublanes × M lanes). The earlier [GB, R, M] layout put R on
+    # the sublane axis, turning every read/update in the hot loop into a
+    # strided single-sublane RMW across all GB tiles (~8× waste) — measured
+    # 16.5s vs the XLA scan's 10.0s at the north-star shape on a real v5e.
+    # The carry holds FREE capacity (alloc - used), not usage: the fit
+    # compare then reads it directly, saving R [GB, M] subtracts per step.
+    gb = free_ref.shape[1]
     R = num_resources
     node_iota = jax.lax.broadcasted_iota(jnp.int32, (gb, max_nodes), 1)
-    alloc = [alloc_ref[0, :, r] for r in range(R)]      # R × [GB]
     caps = caps_ref[0, :]                               # [GB]
 
-    used_ref[:] = used_in_ref[:]
+    free_ref[:] = free_in_ref[:]
     opened_ref[:] = opened_in_ref[:]
 
     def tile_step(t, _):
@@ -68,37 +76,46 @@ def _scan_kernel(
         req_tiles = [
             req_ref[r, pl.ds(base, _STEP_TILE), :] for r in range(R)
         ]                                               # R × [8, GB]
-        active_tile = active_ref[pl.ds(base, _STEP_TILE), :]        # [8, GB]
         placed_rows = []
 
         for s in range(_STEP_TILE):
             opened = opened_ref[0, :]                   # [GB]
             req = [req_tiles[r][s, :] for r in range(R)]  # R × [GB]
-            active = active_tile[s, :] > 0              # [GB]
+            # inactive pods (mask-failed or pad slots) carry +inf requests:
+            # they fit nowhere and so place nothing — no separate active
+            # stream or gate needed.
+            #
+            # Closed nodes (m >= opened) hold free == alloc by construction,
+            # so the UNMASKED first-fit min doubles as the open-new-node
+            # rule: a pod that fits no open node but fits an empty template
+            # lands exactly at index `opened` (all closed nodes compare
+            # equal, the min picks the first). first > opened is impossible,
+            # and first >= caps (capped group, or template too small: the
+            # min landed past the cap or nowhere) means no placement. This
+            # folds the open-mask compare, the fits_empty chain and the
+            # can_open arithmetic into the one masked-min.
 
-            fits = node_iota < opened[:, None]          # [GB, M]
-            fits_empty = jnp.ones((gb,), jnp.bool_)
-            for r in range(R):
-                free_r = alloc[r][:, None] - used_ref[:, r, :]      # [GB, M]
-                fits &= req[r][:, None] <= free_r
-                fits_empty &= req[r] <= alloc[r]
+            fits = req[0][:, None] <= free_ref[0]       # [GB, M]
+            for r in range(1, R):
+                fits &= req[r][:, None] <= free_ref[r]
 
-            any_fit = fits.any(axis=1)                  # [GB]
             first = jnp.min(
                 jnp.where(fits, node_iota, BIG_I32), axis=1
             )                                           # [GB]
-            can_open = (~any_fit) & (opened < caps) & fits_empty
-            place = active & (any_fit | can_open)
-            target = jnp.where(any_fit, first, opened)  # [GB]
+            place = first < caps
+            target = jnp.where(place, first, -1)        # -1: no hit row
 
             # i1 [GB] -> [GB,1] reshapes are unsupported on TPU; broadcast
-            # the placement gate through f32 instead
+            # the placement gate through f32 [GB, 1] columns instead. The
+            # select (not a multiply by place) matters: inf * 0.0 = NaN
+            # would poison the carry via the hit row.
             hit = node_iota == target[:, None]                      # [GB, M]
-            place_f = place.astype(jnp.float32)
             for r in range(R):
-                add = (req[r] * place_f)[:, None]                   # [GB, 1]
-                used_ref[:, r, :] = used_ref[:, r, :] + jnp.where(hit, add, 0.0)
-            opened_ref[0, :] = opened + (place & can_open).astype(jnp.int32)
+                sub = jnp.where(place, req[r], 0.0)[:, None]        # [GB, 1]
+                free_ref[r, :, :] = free_ref[r] - jnp.where(hit, sub, 0.0)
+            opened_ref[0, :] = jnp.maximum(
+                opened, jnp.where(place, first + 1, 0)
+            )
             placed_rows.append(place.astype(jnp.int32))
 
         placed_ref[pl.ds(base, _STEP_TILE), :] = jnp.stack(placed_rows, axis=0)
@@ -111,11 +128,9 @@ def _scan_kernel(
     jax.jit, static_argnames=("chunk", "max_nodes", "group_block", "interpret")
 )
 def _run_chunk(
-    req_chunk,   # [R, CHUNK, G] f32
-    active,      # [CHUNK, G] i32
-    allocs,      # [1, G, R] f32
+    req_chunk,   # [R, CHUNK, G] f32 (+inf rows = inactive)
     caps,        # [1, G] i32
-    used,        # [G, R, M] f32
+    free,        # [R, G, M] f32
     opened,      # [1, G] i32
     chunk: int,
     max_nodes: int,
@@ -133,28 +148,26 @@ def _run_chunk(
         grid=grid,
         in_specs=[
             pl.BlockSpec((R, chunk, group_block), lambda i: (0, 0, i)),
-            pl.BlockSpec((chunk, group_block), lambda i: (0, i)),
-            pl.BlockSpec((1, group_block, R), lambda i: (0, i, 0)),
             pl.BlockSpec((1, group_block), lambda i: (0, i)),
-            pl.BlockSpec((group_block, R, max_nodes), lambda i: (i, 0, 0)),
+            pl.BlockSpec((R, group_block, max_nodes), lambda i: (0, i, 0)),
             pl.BlockSpec((1, group_block), lambda i: (0, i)),
         ],
         out_specs=[
-            pl.BlockSpec((group_block, R, max_nodes), lambda i: (i, 0, 0)),
+            pl.BlockSpec((R, group_block, max_nodes), lambda i: (0, i, 0)),
             pl.BlockSpec((1, group_block), lambda i: (0, i)),
             pl.BlockSpec((chunk, group_block), lambda i: (0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((G, R, max_nodes), jnp.float32),
+            jax.ShapeDtypeStruct((R, G, max_nodes), jnp.float32),
             jax.ShapeDtypeStruct((1, G), jnp.int32),
             jax.ShapeDtypeStruct((chunk, G), jnp.int32),
         ],
-        input_output_aliases={4: 0, 5: 1},
+        input_output_aliases={2: 0, 3: 1},
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",),
         ),
         interpret=interpret,
-    )(req_chunk, active, allocs, caps, used, opened)
+    )(req_chunk, caps, free, opened)
 
 
 @functools.partial(
@@ -174,30 +187,40 @@ def _pallas_scan_all(
 ):
     """One jit: lax.scan over pod chunks, each advancing the VMEM kernel.
     Keeping the loop on device avoids ~P/chunk host dispatch round-trips
-    (which dominate wall-clock on a tunneled TPU)."""
+    (which dominate wall-clock on a tunneled TPU). Inactive slots (mask
+    failures and pad) travel as +inf requests, so the kernel needs no
+    separate active stream. (A whole-stream pre-gather/transpose outside the
+    scan was tried and crashed the AOT compile helper at the north-star
+    shape; the per-chunk gather compiles everywhere and measures the same.)"""
     G_pad, P_pad = order.shape
     R = pod_req.shape[1]
     NC = P_pad // chunk
     order_c = order.reshape(G_pad, NC, chunk).transpose(1, 0, 2)       # [NC, G, C]
-    active_c = sorted_mask.astype(jnp.int32).reshape(G_pad, NC, chunk).transpose(1, 0, 2)
-    allocs_in = template_allocs[None, :, :]
+    active_c = sorted_mask.reshape(G_pad, NC, chunk).transpose(1, 0, 2)
+    allocs_in = template_allocs.T                                      # [R, G]
 
     def chunk_step(carry, xs):
-        used, opened = carry
+        free, opened = carry
         idx, active = xs                                   # [G, C]
-        req_chunk = jnp.transpose(pod_req[idx], (2, 1, 0))  # [R, C, G]
-        used, opened, placed = _run_chunk(
-            req_chunk, active.T, allocs_in, caps, used, opened,
+        gathered = jnp.where(
+            active[:, :, None], pod_req[idx], jnp.inf
+        )                                                  # [G, C, R]
+        req_chunk = jnp.transpose(gathered, (2, 1, 0))     # [R, C, G]
+        free, opened, placed = _run_chunk(
+            req_chunk, caps, free, opened,
             chunk=chunk, max_nodes=max_nodes, group_block=group_block,
             interpret=interpret,
         )
-        return (used, opened), placed.T                    # [G, C]
+        return (free, opened), placed.T                    # [G, C]
 
     init = (
-        jnp.zeros((G_pad, R, max_nodes), jnp.float32),
+        jnp.broadcast_to(allocs_in[:, :, None], (R, G_pad, max_nodes)).astype(
+            jnp.float32
+        ),
         jnp.zeros((1, G_pad), jnp.int32),
     )
-    (used, opened), placed = jax.lax.scan(chunk_step, init, (order_c, active_c))
+    (free, opened), placed = jax.lax.scan(chunk_step, init, (order_c, active_c))
+    used = allocs_in[:, :, None] - free
     placed_sorted = placed.transpose(1, 0, 2).reshape(G_pad, P_pad) > 0
     return used, opened, placed_sorted
 
@@ -208,7 +231,7 @@ def ffd_binpack_groups_pallas(
     template_allocs,  # [G, R]
     max_nodes: int,
     node_caps=None,   # [G] i32
-    chunk: int = 512,
+    chunk: int | None = None,   # None = auto-size against the VMEM budget
     group_block: int = 0,   # 0 = auto
     interpret: bool | None = None,
 ) -> BinpackResult:
@@ -216,22 +239,17 @@ def ffd_binpack_groups_pallas(
 
     The scan over pod chunks runs inside one jit (lax.scan), each iteration
     gathering the chunk's score-sorted requests and advancing the
-    VMEM-resident usage carry via the kernel."""
-    if chunk % _STEP_TILE != 0:
+    VMEM-resident free-capacity carry via the kernel. chunk=None picks the
+    largest chunk the VMEM budget model admits (see the calibrated estimate
+    below); an explicit chunk is honored as-is."""
+    if chunk is not None and chunk % _STEP_TILE != 0:
         raise ValueError(
             f"chunk must be a multiple of {_STEP_TILE} (sublane tile); got {chunk}"
         )
-    # VMEM budget: XLA keeps the [G_pad, R, M] usage carry resident in VMEM
-    # across the chunk scan (that residency IS the speedup), plus the chunk's
-    # request/placement streams. At the north-star shape (G_pad=512, R=6,
-    # M=1000→1024 lanes) the carry alone is ~12.6MB of the 16MB budget;
-    # chunk=1024 overflowed it on a real v5e by 728KB (observed Mosaic
-    # scoped-vmem OOM), chunk=512 fits. Callers raising chunk must leave
-    # room for carry + chunk*(R+2)*G_pad*4 bytes.
     pod_req = jnp.asarray(pod_req, jnp.float32)
     pod_masks = jnp.asarray(pod_masks)
     template_allocs = jnp.asarray(template_allocs, jnp.float32)
-    P, R = pod_req.shape
+    P, R_full = pod_req.shape
     G = pod_masks.shape[0]
     if node_caps is None:
         node_caps = jnp.full((G,), max_nodes, jnp.int32)
@@ -253,6 +271,44 @@ def ffd_binpack_groups_pallas(
     order = jnp.argsort(-scores, axis=1, stable=True)               # [G_pad, P]
     sorted_mask = jnp.take_along_axis(pod_masks, order, axis=1)
 
+    # Exact resource-axis compression (AFTER scoring, which indexes CPU/MEMORY
+    # positionally): an axis nobody requests can never gate a fit (0 <= free
+    # always) nor change the carry (usage += 0), so drop it from the kernel's
+    # per-resource loop. At the north-star workload this removes the
+    # always-zero ephemeral/tpu axes (R 6→4, ~1/3 of the VPU work). The tiny
+    # host sync is amortized over the whole scan.
+    keep = [r for r in range(R_full) if bool((pod_req[:, r] > 0).any())] or [0]
+    compressed = len(keep) < R_full
+    if compressed:
+        pod_req = pod_req[:, jnp.asarray(keep)]
+        template_allocs = template_allocs[:, jnp.asarray(keep)]
+
+    # Auto-size the chunk: longer kernel invocations amortize per-chunk
+    # dispatch and carry round-trips, bounded by VMEM. Budget model (bytes,
+    # per grid program), calibrated on a real v5e: Mosaic double-buffers the
+    # request stream and carry blocks, so scoped VMEM ≈
+    # (2·req + 2·carry + placed)·4B + ~3MB scratch. With the [R, GB, M]
+    # free-capacity carry at R=4, GB=128, M=1024: chunk=2048 overflowed by
+    # 4.04MB (est 18.9MB), chunk=1024 (est 12.1MB) compiles and runs.
+    # An explicit chunk is honored untouched; tiny worlds stay at the
+    # smallest tile-aligned chunk covering P rather than padding up.
+    if chunk is None:
+        R_k = len(keep)
+        M_lanes = max_nodes + (-max_nodes) % 128
+        chunk = 512
+        for cand in (1024,):
+            est = (
+                2 * R_k * cand * group_block      # double-buffered req stream
+                + 2 * R_k * group_block * M_lanes  # carry in/out
+                + cand * group_block              # placed out
+            ) * 4 + 3 * 1024 * 1024               # Mosaic scratch
+            if est <= 15 * 1024 * 1024:
+                chunk = cand
+        # don't scan pure padding: a P=300 world needs one 304-slot chunk,
+        # not a 1024-slot one
+        while chunk > _STEP_TILE and chunk // 2 >= P:
+            chunk //= 2
+
     # Pad the pod axis to a chunk multiple with inactive slots. The pad value
     # must be an index outside [0, P): the final scheduled scatter writes at
     # `order`, and zero-padding would send every padded (inactive, False)
@@ -273,8 +329,15 @@ def ffd_binpack_groups_pallas(
     scheduled = jnp.zeros((G_pad, P_pad), bool).at[
         garange[:, None], order
     ].set(placed_sorted)[:, :P]
+    node_used = jnp.transpose(used, (1, 2, 0))[:G]        # [G, M, R]
+    if compressed:
+        node_used = (
+            jnp.zeros((G, max_nodes, R_full), jnp.float32)
+            .at[:, :, jnp.asarray(keep)]
+            .set(node_used)
+        )
     return BinpackResult(
         node_count=opened[0, :G],
         scheduled=scheduled[:G],
-        node_used=jnp.swapaxes(used, 1, 2)[:G],
+        node_used=node_used,
     )

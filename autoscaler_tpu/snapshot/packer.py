@@ -249,22 +249,31 @@ def _profile_factorization(
 
     prof_mask = np.ones((max(len(pod_exemplar), 1), max(len(node_exemplar), 1)), bool)
     for pi, pod in enumerate(pod_exemplar):
+        pod_csi = _pod_csi_counts(pod)
         for nj, (node, ports, attached) in enumerate(node_exemplar):
-            prof_mask[pi, nj] = _class_verdict(pod, node, ports, attached)
+            prof_mask[pi, nj] = _class_verdict(pod, node, ports, attached, pod_csi)
     return pod_prof_id, node_prof_id, prof_mask
 
 
-def _class_verdict(pod: Pod, node: Node, ports: Dict, attached: Dict) -> bool:
+def _class_verdict(
+    pod: Pod, node: Node, ports: Dict, attached: Dict, pod_csi=None
+) -> bool:
     """One (pod-profile, node-profile) cell: the class-structured predicate
     chain. The single source of truth shared by the full packer's exemplar
     loop and the incremental packer's per-cell refresh — extend HERE when a
-    new class-factorizable predicate lands, or the two paths drift."""
+    new class-factorizable predicate lands, or the two paths drift.
+    pod_csi: precomputed _pod_csi_counts(pod); pass it when evaluating one
+    pod against many nodes so the dict isn't rebuilt per cell."""
     return (
         not node.unschedulable
         and k8s.pod_tolerates_taints(pod, node.taints)
         and k8s.node_matches_selector(pod, node)
         and not any(ports.get(p, 0) > 0 for p in pod.host_ports)
-        and _csi_fits(_pod_csi_counts(pod), attached, node.csi_attach_limits)
+        and _csi_fits(
+            _pod_csi_counts(pod) if pod_csi is None else pod_csi,
+            attached,
+            node.csi_attach_limits,
+        )
     )
 
 
