@@ -21,6 +21,7 @@ from autoscaler_tpu.kube.convert import (
     format_memory_quantity,
     format_timestamp,
     parse_quantity,
+    parse_timestamp,
 )
 from autoscaler_tpu.kube.objects import LabelSelector, LabelSelectorRequirement
 from autoscaler_tpu.vpa.api import (
@@ -30,7 +31,7 @@ from autoscaler_tpu.vpa.api import (
     Vpa,
 )
 from autoscaler_tpu.vpa.feeder import ContainerUsage, MetricsSource
-from autoscaler_tpu.vpa.recommender import Recommendation
+from autoscaler_tpu.vpa.recommender import Checkpoint, Recommendation
 
 VPA_PATH = "/apis/autoscaling.k8s.io/v1/verticalpodautoscalers"
 METRICS_PATH = "/apis/metrics.k8s.io/v1beta1/pods"
@@ -251,10 +252,156 @@ class VpaKubeBinding:
         try:
             self.client.merge_patch(path + "/status", body)
         except ApiError as e:
+            if e.status == 409:
+                # write conflict (another writer raced us): the status is
+                # recomputed and rewritten every pass, so losing one write is
+                # harmless — the reference logs and moves on
+                return
             if e.status not in (404, 405):
                 raise
             # CRD without the status subresource enabled: patch the resource
-            self.client.merge_patch(path, body)
+            try:
+                self.client.merge_patch(path, body)
+            except ApiError as e2:
+                if e2.status != 409:
+                    raise
+
+
+CHECKPOINT_PATH = (
+    "/apis/autoscaling.k8s.io/v1/verticalpodautoscalercheckpoints"
+)
+
+
+def _histogram_to_json(h: Dict) -> Dict:
+    return {
+        "referenceTimestamp": format_timestamp(float(h.get("ref_ts", 0.0))),
+        "bucketWeights": {str(k): v for k, v in h.get("bucket_weights", {}).items()},
+        "totalWeight": float(h.get("total_weight", 0.0)),
+    }
+
+
+def _histogram_from_json(h: Dict) -> Dict:
+    return {
+        "ref_ts": parse_timestamp(h.get("referenceTimestamp")),
+        "bucket_weights": {
+            int(k): v for k, v in (h.get("bucketWeights") or {}).items()
+        },
+        "total_weight": float(h.get("totalWeight", 0.0)),
+    }
+
+
+class VpaCheckpointStore:
+    """Histogram checkpoints as VerticalPodAutoscalerCheckpoint API objects,
+    one per (vpa, container) — the control-plane persistence the reference's
+    recommender uses so a rescheduled pod resumes warm
+    (checkpoint/checkpoint_writer.go:36,78; CRD shape from
+    apis/autoscaling.k8s.io/v1/types.go VerticalPodAutoscalerCheckpoint).
+    A server without the CRD degrades explicitly: load() returns [] and
+    save() reports 0, mirroring the binding's CRD-absent behavior."""
+
+    def __init__(self, client: KubeRestClient):
+        self.client = client
+
+    @staticmethod
+    def _name(ckpt: Checkpoint) -> str:
+        return f"{ckpt.vpa}-{ckpt.container}".lower()
+
+    def save(self, checkpoints: List[Checkpoint], now_ts: Optional[float] = None) -> int:
+        now_ts = time.time() if now_ts is None else now_ts
+        written = 0
+        for ckpt in checkpoints:
+            body = {
+                "metadata": {
+                    "name": self._name(ckpt),
+                    "namespace": ckpt.namespace,
+                },
+                "spec": {
+                    "vpaObjectName": ckpt.vpa,
+                    "containerName": ckpt.container,
+                },
+                "status": {
+                    "lastUpdateTime": format_timestamp(now_ts),
+                    "version": "v3",
+                    "cpuHistogram": _histogram_to_json(ckpt.cpu),
+                    "memoryHistogram": _histogram_to_json(ckpt.memory),
+                    "firstSampleStart": format_timestamp(ckpt.first_sample_ts),
+                    "totalSamplesCount": int(ckpt.sample_count),
+                },
+            }
+            path = (
+                f"/apis/autoscaling.k8s.io/v1/namespaces/{ckpt.namespace}"
+                f"/verticalpodautoscalercheckpoints"
+            )
+            try:
+                self.client.put(f"{path}/{self._name(ckpt)}", body)
+                written += 1
+            except ApiError as e:
+                if e.status != 404:
+                    raise
+                try:
+                    self.client.post(path, body)
+                    written += 1
+                except ApiError as e2:
+                    if e2.status == 404:
+                        return written  # CRD not installed
+                    if e2.status == 409:
+                        # create race with an overlapping recommender (rolling
+                        # update): the twin just wrote this checkpoint — fine
+                        continue
+                    raise
+        return written
+
+    def load(self) -> List[Checkpoint]:
+        out = []
+        for obj in self._list_raw():
+            meta = obj.get("metadata") or {}
+            spec = obj.get("spec") or {}
+            status = obj.get("status") or {}
+            out.append(
+                Checkpoint(
+                    vpa=spec.get("vpaObjectName", ""),
+                    container=spec.get("containerName", ""),
+                    namespace=meta.get("namespace", "default"),
+                    cpu=_histogram_from_json(status.get("cpuHistogram") or {}),
+                    memory=_histogram_from_json(
+                        status.get("memoryHistogram") or {}
+                    ),
+                    sample_count=int(status.get("totalSamplesCount", 0)),
+                    first_sample_ts=parse_timestamp(
+                        status.get("firstSampleStart")
+                    ),
+                )
+            )
+        return out
+
+    def gc(self, live: List[Checkpoint]) -> int:
+        """Delete checkpoint objects whose (namespace, vpa, container) no
+        longer exists in the model — the reference recommender's
+        MaintainCheckpoints GC pass (routines/recommender.go:160)."""
+        keep = {(c.namespace, self._name(c)) for c in live}
+        deleted = 0
+        for obj in self._list_raw():
+            meta = obj.get("metadata") or {}
+            key = (meta.get("namespace", "default"), meta.get("name", ""))
+            if key not in keep:
+                try:
+                    self.client.delete(
+                        f"/apis/autoscaling.k8s.io/v1/namespaces/{key[0]}"
+                        f"/verticalpodautoscalercheckpoints/{key[1]}"
+                    )
+                    deleted += 1
+                except ApiError as e:
+                    if e.status != 404:
+                        raise
+        return deleted
+
+    def _list_raw(self) -> List[dict]:
+        try:
+            return self.client.get(CHECKPOINT_PATH).get("items") or []
+        except ApiError as e:
+            if e.status == 404:
+                return []
+            raise
 
 
 WEBHOOK_PATH = (

@@ -9,10 +9,13 @@ server. Here one process hosts all three on one histogram model (no
 CRD-checkpoint round-trip between them), each gated by --components; the
 cadence flags keep the reference's defaults (recommender 1m, updater 1m).
 
-Checkpoints persist to a local JSON file (--checkpoint-file) rather than the
-VerticalPodAutoscalerCheckpoint CRD: same serialized histogram payload
-(histogram.py:138 mirrors checkpoint_writer.go's normalized buckets), one
-file instead of one CRD per (vpa, container).
+Checkpoints persist to the control plane as VerticalPodAutoscalerCheckpoint
+API objects by default (kube_io.VpaCheckpointStore; one per (vpa, container),
+checkpoint_writer.go:36,78), so a rescheduled recommender pod resumes warm
+within one cycle. --checkpoint-file opts into a local JSON file instead
+(same serialized histogram payload — histogram.py:138 mirrors
+checkpoint_writer.go's normalized buckets) for out-of-cluster runs;
+--no-checkpoints runs stateless.
 """
 from __future__ import annotations
 
@@ -50,6 +53,7 @@ class VpaRunner:
         cluster_api,                  # ClusterAPI: list_pods/evict_pod
         metrics_source: MetricsSource,
         checkpoint_path: str = "",
+        checkpoint_store=None,        # VpaCheckpointStore: CRD persistence
         components: tuple = ("recommender", "updater"),
         half_life_s: float = 24 * 3600.0,
         recommender: "PercentileRecommender" = None,
@@ -76,10 +80,27 @@ class VpaRunner:
         # (ns, pod) → labels from this pass's single pod LIST; the metrics
         # source joins against this instead of re-listing
         self.last_pod_labels: Dict = {}
-        if checkpoint_path and os.path.exists(checkpoint_path):
+        self.checkpoint_store = checkpoint_store
+        self._prev_live_keys = None  # gates per-pass checkpoint GC
+        if checkpoint_store is not None:
+            try:
+                ckpts = checkpoint_store.load()
+            except Exception as e:  # noqa: BLE001
+                # a transient apiserver blip at startup must not crash-loop
+                # the recommender — a cold start works (exactly the CRD-absent
+                # behavior); the histograms refill from live samples
+                log.warning("checkpoint restore failed, starting cold: %s", e)
+                ckpts = []
+            CheckpointManager(self.model).load(ckpts)
+            if ckpts:
+                log.info(
+                    "restored %d checkpoints from the control plane", len(ckpts)
+                )
+        elif checkpoint_path and os.path.exists(checkpoint_path):
             self.load_checkpoints()
 
-    # -- checkpoints (local-file CRD analog) -------------------------------
+    # -- checkpoints: control-plane CRDs (checkpoint_writer.go:36,78) or a
+    # local JSON file for out-of-cluster runs --------------------------------
     def load_checkpoints(self) -> int:
         with open(self.checkpoint_path) as f:
             raw = json.load(f)
@@ -88,13 +109,31 @@ class VpaRunner:
         log.info("restored %d checkpoints from %s", len(ckpts), self.checkpoint_path)
         return len(ckpts)
 
-    def save_checkpoints(self) -> None:
+    def save_checkpoints(self, live_vpa_keys=None) -> None:
+        ckpts = CheckpointManager(self.model).store()
+        if live_vpa_keys is not None:
+            # GC discipline (routines/recommender.go:160 MaintainCheckpoints):
+            # only checkpoints of VPAs that still exist are persisted — a
+            # restored-then-deleted VPA's series must not resurrect its own
+            # checkpoint forever.
+            ckpts = [c for c in ckpts if (c.namespace, c.vpa) in live_vpa_keys]
+        if self.checkpoint_store is not None:
+            self.checkpoint_store.save(ckpts)
+            # GC needs a second cluster-wide LIST, so it runs only when
+            # orphans can exist: at the first pass (leftovers from a
+            # predecessor) or when the live-key set shrank — not every
+            # cycle (the reference runs GC on a slow timer, not per pass)
+            live = {(c.namespace, c.vpa, c.container) for c in ckpts}
+            if self._prev_live_keys is None or (self._prev_live_keys - live):
+                self.checkpoint_store.gc(ckpts)
+            self._prev_live_keys = live
+            return
         if not self.checkpoint_path:
             return
-        ckpts = [dataclasses.asdict(c) for c in CheckpointManager(self.model).store()]
+        raw = [dataclasses.asdict(c) for c in ckpts]
         tmp = self.checkpoint_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(ckpts, f)
+            json.dump(raw, f)
         os.replace(tmp, self.checkpoint_path)  # crash-safe swap
 
     # -- one pass ----------------------------------------------------------
@@ -134,7 +173,9 @@ class VpaRunner:
                 if per_container:
                     self.binding.write_status(vpa, per_container, now_ts)
                     stats["statuses"] += 1
-            self.save_checkpoints()
+            self.save_checkpoints(
+                live_vpa_keys={(vpa.namespace, vpa.name) for vpa in vpas}
+            )
         else:
             # updater-only process: work from the status a separate
             # recommender wrote, like the reference updater reads the CRD
@@ -195,7 +236,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--scrape-interval", type=float, default=60.0,
                    help="pass cadence (reference recommender/updater: 1m)")
     p.add_argument("--checkpoint-file", default="",
-                   help="local JSON checkpoint path ('' = stateless)")
+                   help="local JSON checkpoint path; overrides the default "
+                        "VerticalPodAutoscalerCheckpoint CRD persistence "
+                        "(use for out-of-cluster runs without the CRD)")
+    p.add_argument("--no-checkpoints", action="store_true",
+                   help="run stateless: neither CRD nor file checkpoints")
     p.add_argument("--memory-half-life", type=float, default=24 * 3600.0,
                    help="histogram decay half-life seconds (default 24h)")
     p.add_argument("--recommendation-margin-fraction", type=float, default=0.15,
@@ -228,7 +273,11 @@ def main(argv=None) -> int:
     components = tuple(c.strip() for c in args.components.split(",") if c.strip())
 
     from autoscaler_tpu.kube.client import KubeClusterAPI, KubeRestClient
-    from autoscaler_tpu.vpa.kube_io import KubeMetricsSource, VpaKubeBinding
+    from autoscaler_tpu.vpa.kube_io import (
+        KubeMetricsSource,
+        VpaCheckpointStore,
+        VpaKubeBinding,
+    )
 
     if args.kube_api == "in-cluster":
         client = KubeRestClient.in_cluster(user_agent="tpu-autoscaler-vpa")
@@ -236,6 +285,14 @@ def main(argv=None) -> int:
         client = KubeRestClient(args.kube_api, user_agent="tpu-autoscaler-vpa")
     api = KubeClusterAPI(client)
     binding = VpaKubeBinding(client)
+    # default persistence is the checkpoint CRD (checkpoint_writer.go:78):
+    # a rescheduled recommender pod resumes warm from the control plane. An
+    # explicit --checkpoint-file opts into local-file persistence instead.
+    store = None
+    if args.no_checkpoints:
+        args.checkpoint_file = ""  # truly stateless: no file either
+    elif not args.checkpoint_file:
+        store = VpaCheckpointStore(client)
 
     model = ClusterStateModel(half_life_s=args.memory_half_life)
     runner = VpaRunner(
@@ -244,6 +301,7 @@ def main(argv=None) -> int:
         # labels come from run_once's own pod LIST — no second LIST per pass
         KubeMetricsSource(client, lambda: runner.last_pod_labels),
         checkpoint_path=args.checkpoint_file,
+        checkpoint_store=store,
         components=components,
         # half-life lives in the model the recommender brings
         recommender=PercentileRecommender(

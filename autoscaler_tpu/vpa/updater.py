@@ -8,12 +8,15 @@ PDB-aware eviction via pkg/updater/eviction (here: RemainingPdbTracker).
 """
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from autoscaler_tpu.core.scaledown.tracking import RemainingPdbTracker
 from autoscaler_tpu.kube.objects import Pod
 from autoscaler_tpu.vpa.recommender import ContainerKey, Recommendation
+
+log = logging.getLogger("vpa.updater")
 
 DEFAULT_DRIFT_THRESHOLD = 0.10         # updatePriorityCalculator 10%
 SIGNIFICANT_CHANGE_AFTER_S = 12 * 3600.0
@@ -163,10 +166,22 @@ class Updater:
             for cand in candidates[:budget]:
                 if pdb_tracker is not None and not pdb_tracker.can_remove_pods([cand.pod]):
                     continue
+                if evict_fn is not None:
+                    try:
+                        evict_fn(cand.pod)
+                    except Exception as e:  # noqa: BLE001
+                        # eviction races are normal control-plane weather
+                        # (429 from a PDB admission check, pod already gone):
+                        # skip THIS pod, keep the pass alive, retry next pass
+                        # — the reference updater logs and continues
+                        # (logic/updater.go:109 eviction loop). The PDB
+                        # tracker is only charged after a successful evict.
+                        # Logged so persistent non-weather failures (RBAC,
+                        # bugs) stay visible.
+                        log.warning("evicting %s failed: %s", cand.pod.key(), e)
+                        continue
                 if pdb_tracker is not None:
                     pdb_tracker.remove_pods([cand.pod])
-                if evict_fn is not None:
-                    evict_fn(cand.pod)
                 evicted.append(cand.pod)
         return evicted
 

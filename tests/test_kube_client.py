@@ -90,6 +90,8 @@ class FakeApiServer:
         self.csinodes = []
         self.daemonsets = []      # apps/v1 DaemonSet objects
         self.vpas = {}            # "ns/name" -> VPA CRD object
+        self.checkpoints = {}     # "ns/name" -> VPA checkpoint CRD object
+        self.serve_checkpoints = True  # False simulates CRD not installed
         self.deployments = {}     # "ns/name" -> apps/v1 Deployment object
         self.pod_metrics = []     # metrics.k8s.io PodMetrics items
         self.webhooks = {}        # name -> MutatingWebhookConfiguration
@@ -100,6 +102,7 @@ class FakeApiServer:
         self.writes = []          # (method, path) log
         self.reads = []           # GET path log (storage endpoints)
         self.reject_evictions = set()  # "ns/name" -> 429
+        self.status_conflicts = 0  # countdown: VPA status PATCHes 409 while >0
         self.watch_queues = []    # live watch streams get events pushed
         self.events = []          # (rv, event) log replayed on watch connect
         self.configmaps = {}
@@ -212,6 +215,14 @@ class FakeApiServer:
                         return self._send(200, {"items": outer.daemonsets})
                     if path == "/apis/autoscaling.k8s.io/v1/verticalpodautoscalers":
                         return self._send(200, {"items": list(outer.vpas.values())})
+                    if path == (
+                        "/apis/autoscaling.k8s.io/v1/verticalpodautoscalercheckpoints"
+                    ):
+                        if not outer.serve_checkpoints:
+                            return self._send(404)
+                        return self._send(
+                            200, {"items": list(outer.checkpoints.values())}
+                        )
                     if path == "/apis/metrics.k8s.io/v1beta1/pods":
                         return self._send(200, {"items": outer.pod_metrics})
                     if "/apis/apps/v1/" in path and "/deployments/" in path:
@@ -268,6 +279,16 @@ class FakeApiServer:
                         name = (body.get("metadata") or {}).get("name", "")
                         outer.webhooks[name] = body
                         return self._send(201, body)
+                    if path.endswith("/verticalpodautoscalercheckpoints"):
+                        if not outer.serve_checkpoints:
+                            return self._send(404)
+                        meta = body.get("metadata") or {}
+                        ns = path.strip("/").split("/")[4]
+                        key = f"{ns}/{meta.get('name', '')}"
+                        if key in outer.checkpoints:
+                            return self._send(409)
+                        outer.checkpoints[key] = body
+                        return self._send(201, body)
                 return self._send(404)
 
             def do_PATCH(self):
@@ -291,6 +312,9 @@ class FakeApiServer:
                         return self._send(200, node)
                     if "/verticalpodautoscalers/" in path:
                         # .../namespaces/{ns}/verticalpodautoscalers/{name}[/status]
+                        if outer.status_conflicts > 0:
+                            outer.status_conflicts -= 1
+                            return self._send(409, {"reason": "Conflict"})
                         parts = path.strip("/").split("/")
                         if parts[-1] == "status":
                             name, ns = parts[-2], parts[-4]
@@ -350,6 +374,17 @@ class FakeApiServer:
                             return self._send(404)
                         outer.webhooks[name] = body
                         return self._send(200, body)
+                    if "/verticalpodautoscalercheckpoints/" in path:
+                        # real-apiserver semantics: PUT replaces an existing
+                        # object, 404 on create (create is POST)
+                        if not outer.serve_checkpoints:
+                            return self._send(404)
+                        seg = path.strip("/").split("/")
+                        key = f"{seg[4]}/{seg[-1]}"
+                        if key not in outer.checkpoints:
+                            return self._send(404)
+                        outer.checkpoints[key] = body
+                        return self._send(200, body)
                 return self._send(404)
 
             def do_DELETE(self):
@@ -376,6 +411,11 @@ class FakeApiServer:
                             return self._send(409)
                         outer.leases.pop(name, None)
                         return self._send(200)
+                    if "/verticalpodautoscalercheckpoints/" in path:
+                        seg = path.strip("/").split("/")
+                        key = f"{seg[4]}/{seg[-1]}"
+                        existed = outer.checkpoints.pop(key, None)
+                        return self._send(200 if existed else 404)
                 return self._send(404)
 
         return Handler

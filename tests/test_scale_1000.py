@@ -19,15 +19,45 @@ import time
 
 import pytest
 
-# Wall-clock asserts can flake on loaded/shared CI workers independent of any
-# code change; they only gate when explicitly requested (hack/verify.sh sets
-# AUTOSCALER_TPU_TIMING_ASSERTS=1). Correctness asserts always run.
+# Wall-clock asserts gate only when explicitly requested (hack/verify.sh sets
+# AUTOSCALER_TPU_TIMING_ASSERTS=1, FATALLY — a loop-time regression fails
+# CI). To keep the gate meaningful on loaded/shared workers, the bound is
+# scaled by a same-run calibration probe: a fixed numpy workload whose
+# duration on the reference dev machine is known, so "worker is 3× slower
+# today" widens the bound 3× instead of flaking, while a genuine 3× loop
+# regression on a healthy worker still fails. Correctness asserts always run.
 TIMING_ASSERTS = os.environ.get("AUTOSCALER_TPU_TIMING_ASSERTS") == "1"
+_CALIBRATION_REF_S = 0.165  # the probe's duration on the reference machine
+_calibration_scale = None
+
+
+def _machine_scale() -> float:
+    """probe_duration / reference_duration, clamped to [1, 10] — never
+    tightens the bound below the reference target, never excuses more than
+    a 10×-loaded worker."""
+    global _calibration_scale
+    if _calibration_scale is None:
+        import numpy as np
+
+        a = np.random.default_rng(0).random((1024, 1024)).astype(np.float32)
+        for _ in range(2):
+            (a @ a).sum()  # warm the BLAS path
+        t0 = time.perf_counter()
+        for _ in range(8):
+            (a @ a).sum()
+        probe = time.perf_counter() - t0
+        _calibration_scale = min(10.0, max(1.0, probe / _CALIBRATION_REF_S))
+    return _calibration_scale
 
 
 def assert_loop_bound(loop_s, bound_s=30.0):
     if TIMING_ASSERTS:
-        assert loop_s < bound_s, f"loop took {loop_s:.1f}s (reference target {bound_s:.0f}s)"
+        bound = bound_s * _machine_scale()
+        assert loop_s < bound, (
+            f"loop took {loop_s:.1f}s (reference target {bound_s:.0f}s, "
+            f"calibrated bound {bound:.0f}s at machine scale "
+            f"{_machine_scale():.2f}) — a real loop-time regression"
+        )
 
 from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
 from autoscaler_tpu.config.options import AutoscalingOptions

@@ -157,6 +157,81 @@ class TestVpaRunnerOverHttp:
         )
         assert fresh.model.keys()  # restored series
 
+    def test_checkpoints_persist_to_the_control_plane(self, srv):
+        """Default persistence is the VerticalPodAutoscalerCheckpoint CRD
+        (checkpoint_writer.go:36,78): a restarted (cold) recommender resumes
+        warm from the API server within one cycle."""
+        from autoscaler_tpu.vpa.kube_io import VpaCheckpointStore
+
+        client, api, pod_labels = self._world(srv)
+        runner = VpaRunner(
+            VpaKubeBinding(client), api, KubeMetricsSource(client, pod_labels),
+            checkpoint_store=VpaCheckpointStore(client),
+        )
+        runner.run_once(now_ts=1000.0)
+        # one checkpoint object per (vpa, container), CRD-shaped
+        (key,) = srv.checkpoints
+        obj = srv.checkpoints[key]
+        assert key == "default/hamster-vpa-hamster"
+        assert obj["spec"] == {
+            "vpaObjectName": "hamster-vpa", "containerName": "hamster",
+        }
+        assert obj["status"]["cpuHistogram"]["totalWeight"] > 0
+        # one cpu + one memory sample per pod
+        first_count = obj["status"]["totalSamplesCount"]
+        assert first_count >= 3
+
+        # a rescheduled pod: brand-new process, empty model, no local state
+        cold = VpaRunner(
+            VpaKubeBinding(client), api, KubeMetricsSource(client, pod_labels),
+            checkpoint_store=VpaCheckpointStore(client),
+        )
+        assert cold.model.keys()  # histograms restored before the first pass
+        srv.pod_metrics = []      # no fresh samples this cycle
+        cold.run_once(now_ts=1060.0)
+        status = srv.vpas["default/hamster-vpa"]["status"]
+        (rec,) = status["recommendation"]["containerRecommendations"]
+        # warm start: the restored histograms alone support a recommendation
+        # at least covering the previously observed 250m usage
+        assert int(rec["target"]["cpu"].rstrip("m")) >= 250
+
+        # repeated saves replace (PUT), not duplicate
+        srv.pod_metrics = [metrics_json(f"hamster-{i}") for i in range(3)]
+        cold.run_once(now_ts=1120.0)
+        assert len(srv.checkpoints) == 1
+        assert srv.checkpoints[key]["status"]["totalSamplesCount"] > first_count
+
+    def test_checkpoint_gc_removes_orphans(self, srv):
+        from autoscaler_tpu.vpa.kube_io import VpaCheckpointStore
+
+        client, api, pod_labels = self._world(srv)
+        srv.checkpoints["default/ghost-vpa-web"] = {
+            "metadata": {"name": "ghost-vpa-web", "namespace": "default"},
+            "spec": {"vpaObjectName": "ghost-vpa", "containerName": "web"},
+            "status": {},
+        }
+        runner = VpaRunner(
+            VpaKubeBinding(client), api, KubeMetricsSource(client, pod_labels),
+            checkpoint_store=VpaCheckpointStore(client),
+        )
+        runner.run_once(now_ts=1000.0)
+        # live checkpoint written, orphan GC'd (routines/recommender.go:160)
+        assert "default/hamster-vpa-hamster" in srv.checkpoints
+        assert "default/ghost-vpa-web" not in srv.checkpoints
+
+    def test_checkpoint_crd_absent_degrades(self, srv):
+        from autoscaler_tpu.vpa.kube_io import VpaCheckpointStore
+
+        client, api, pod_labels = self._world(srv)
+        srv.serve_checkpoints = False
+        runner = VpaRunner(
+            VpaKubeBinding(client), api, KubeMetricsSource(client, pod_labels),
+            checkpoint_store=VpaCheckpointStore(client),
+        )
+        stats = runner.run_once(now_ts=1000.0)  # must not raise
+        assert stats["statuses"] == 1
+        assert not srv.checkpoints
+
     def test_updater_evicts_drifted_pods(self, srv):
         client, api, pod_labels = self._world(srv)
         runner = VpaRunner(
@@ -173,6 +248,31 @@ class TestVpaRunnerOverHttp:
             total_evicted += stats["evicted"]
         assert total_evicted > 0
         assert any("/eviction" in path for _, path in srv.writes)
+
+    def test_contention_storm_eviction_429s_and_status_409s(self, srv):
+        """Control-plane weather replay (the reference's cluster-scale e2e
+        exercises this implicitly): eviction 429 storms must skip the pod
+        and keep the pass alive; VPA status PATCH 409 conflicts must not
+        abort the pass; both recover once the storm clears."""
+        client, api, pod_labels = self._world(srv)
+        runner = VpaRunner(
+            VpaKubeBinding(client), api, KubeMetricsSource(client, pod_labels),
+        )
+        # storm: every eviction 429s, the first several status writes 409
+        srv.reject_evictions = {f"default/hamster-{i}" for i in range(3)}
+        srv.status_conflicts = 3
+        for i in range(6):
+            stats = runner.run_once(now_ts=1000.0 + i * 60.0)  # must not raise
+            assert stats["evicted"] == 0  # every eviction blocked
+        assert srv.pods  # nothing force-removed during the storm
+        # storm clears → evictions and status writes resume within one cycle
+        srv.reject_evictions = set()
+        total = 0
+        for i in range(20):
+            total += runner.run_once(now_ts=2000.0 + i * 60.0)["evicted"]
+        assert total > 0
+        status = srv.vpas["default/hamster-vpa"].get("status")
+        assert status and status["recommendation"]["containerRecommendations"]
 
     def test_updater_only_reads_status(self, srv):
         """--components updater works from the status a separate recommender
