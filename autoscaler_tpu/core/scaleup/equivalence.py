@@ -44,6 +44,7 @@ def _spec_fingerprint(pod: Pod) -> Tuple:
         (aff.node_selector_terms, aff.pod_affinity, aff.pod_anti_affinity)
         if aff
         else None,
+        pod.topology_spread,  # the spread scan gate reads run exemplars
         pod.priority,
     )
 

@@ -20,6 +20,7 @@ from autoscaler_tpu.cloudprovider.interface import CloudProvider, NodeGroup
 from autoscaler_tpu.clusterstate.registry import ClusterStateRegistry
 from autoscaler_tpu.config.options import AutoscalingOptions
 from autoscaler_tpu.core.scaleup.equivalence import build_pod_groups
+from autoscaler_tpu.snapshot.affinity import has_hard_spread
 from autoscaler_tpu.core.scaleup.resource_manager import ScaleUpResourceManager
 from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
 from autoscaler_tpu.estimator.limiter import ThresholdBasedEstimationLimiter
@@ -184,10 +185,25 @@ class ScaleUpOrchestrator:
                     skipped_groups=skipped,
                 )
 
+        # Static spread context: topology-spread estimation needs the live
+        # cluster's domain counts (the reference's PreFilter runs over the
+        # full snapshot, podtopologyspread/common.go:289). Built only when a
+        # pending pod actually carries a hard constraint — it is O(world).
+        cluster_ctx = None
+        if pods_of_node is not None and has_hard_spread(pending_pods):
+            cl_pods: List[Pod] = []
+            cl_node_of: List[int] = []
+            for j, node in enumerate(cluster_nodes):
+                for q in pods_of_node(node.name):
+                    cl_pods.append(q)
+                    cl_node_of.append(j)
+            cluster_ctx = (list(cluster_nodes), cl_pods, cl_node_of)
+
         # ONE batched device dispatch for every group's expansion option
         # (replaces the serial ComputeExpansionOption loop).
         estimates = self.estimator.estimate_many(
-            list(pending_pods), templates, headrooms, pod_groups=pod_groups
+            list(pending_pods), templates, headrooms, pod_groups=pod_groups,
+            cluster=cluster_ctx,
         )
 
         options: List[Option] = []

@@ -32,7 +32,13 @@ from autoscaler_tpu.ops.binpack import (
     ffd_binpack_groups_runs,
     ffd_binpack_groups_runs_affinity,
 )
-from autoscaler_tpu.snapshot.affinity import build_affinity_terms, has_interpod_affinity
+from autoscaler_tpu.snapshot.affinity import (
+    SpreadTermTensors,
+    build_affinity_terms,
+    build_spread_terms,
+    has_hard_spread,
+    has_interpod_affinity,
+)
 from autoscaler_tpu.snapshot.packer import compute_sched_mask, resources_row
 from autoscaler_tpu.snapshot.tensors import bucket_size
 
@@ -61,11 +67,78 @@ def template_mask(
     return mask
 
 
+def _spread_tuple(sp: SpreadTermTensors):
+    """SpreadTermTensors → the kernel's 11-array tuple (pod-axis tensors
+    transposed to [P, S] for per-step gathers)."""
+    return (
+        jnp.asarray(sp.sp_of.T),
+        jnp.asarray(sp.sp_match.T),
+        jnp.asarray(sp.node_level),
+        jnp.asarray(sp.max_skew),
+        jnp.asarray(sp.min_domains),
+        jnp.asarray(sp.has_label),
+        jnp.asarray(sp.static_count),
+        jnp.asarray(sp.min_others),
+        jnp.asarray(sp.static_min),
+        jnp.asarray(sp.static_domnum),
+        jnp.asarray(sp.force_zero),
+    )
+
+
 def _template_capacity_row(template: Node) -> np.ndarray:
     """Pack-capacity row of a template node: allocatable minus daemon
     overhead, with the pods column from the same reduced view."""
     cap = template.packing_capacity()
     return resources_row(cap, cap.pods)
+
+
+def _augment_virtual(
+    req: np.ndarray,            # [P_pad, R] packed requests (rows = row_pods)
+    row_pods: Sequence[Pod],    # pods (or run exemplars) backing the rows
+    allocs: np.ndarray,         # [G, R] template capacity rows
+    templates_list: Sequence[Node],
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Append VIRTUAL RESOURCE planes that make within-wave host-port and
+    CSI-attach accounting on scan-opened nodes EXACT (closing PREDICATES.md
+    divergences 2/3's "counts not tracked on new nodes within one wave"):
+
+    - one column per distinct host port among the pending pods — capacity 1
+      per node, request 1 for pods binding it, so two pods sharing a port
+      can never land on the same scan-opened node (the reference's NodePorts
+      filter re-runs per placement, schedulerbased.go:109-163);
+    - one column per distinct CSI driver — capacity = the template's
+      per-driver attach limit (∞ when unlimited), request = the pod's
+      volume count on that driver (NodeVolumeLimits; unique handles, the
+      shared-handle pessimism of divergence 3a is unchanged).
+
+    The usage carry then enforces both constraints with zero kernel changes
+    (the scan already handles arbitrary R), the run-fill paths stay exact
+    (per-node capacity min includes the planes), and resource-axis
+    compression drops the columns when no pod uses them. Port/CSI state vs
+    EXISTING nodes remains the static mask's job (class factorization)."""
+    ports = sorted({prt for pod in row_pods for prt in pod.host_ports})
+    drivers = sorted({d for pod in row_pods for d, _ in pod.csi_volumes})
+    V = len(ports) + len(drivers)
+    if V == 0:
+        return req, allocs
+    extra = np.zeros((req.shape[0], V), np.float32)
+    port_col = {prt: k for k, prt in enumerate(ports)}
+    drv_col = {d: len(ports) + k for k, d in enumerate(drivers)}
+    for i, pod in enumerate(row_pods):
+        for prt in pod.host_ports:
+            extra[i, port_col[prt]] = 1.0
+        for d, _handle in pod.csi_volumes:
+            extra[i, drv_col[d]] += 1.0
+    alloc_extra = np.zeros((allocs.shape[0], V), np.float32)
+    alloc_extra[:, : len(ports)] = 1.0
+    for gi, tmpl in enumerate(templates_list):
+        for d, k in drv_col.items():
+            lim = (tmpl.csi_attach_limits or {}).get(d)
+            alloc_extra[gi, k] = np.inf if lim is None else float(lim)
+    return (
+        np.concatenate([req, extra], axis=1),
+        np.concatenate([allocs, alloc_extra], axis=1),
+    )
 
 
 class BinpackingNodeEstimator:
@@ -79,18 +152,24 @@ class BinpackingNodeEstimator:
         pods: Sequence[Pod],
         template: Node,
         max_size_headroom: int = 0,
+        cluster=None,  # (nodes, pods, node_of): static spread context
     ) -> Tuple[int, List[Pod]]:
         """→ (node_count, scheduled_pods). Single-group path."""
         if not pods:
             return 0, []
         P = bucket_size(len(pods))
         req = _pack_pods(pods, P)
-        dynamic_affinity = has_interpod_affinity(pods)
-        mask = template_mask(pods, template, P, interpod=not dynamic_affinity)
+        dynamic = has_interpod_affinity(pods) or has_hard_spread(pods)
+        mask = template_mask(pods, template, P, interpod=not dynamic)
         alloc = _template_capacity_row(template)
+        req, alloc2d = _augment_virtual(req, pods, alloc[None, :], [template])
+        alloc = alloc2d[0]
         cap = self.limiter.node_cap(max_size_headroom)
-        if dynamic_affinity:
+        if dynamic:
             terms = build_affinity_terms(pods, [template], pad_pods=P, bucket_terms=True)
+            sp = build_spread_terms(
+                pods, [template], pad_pods=P, bucket_terms=True, cluster=cluster
+            )
             res = ffd_binpack_groups_affinity(
                 jnp.asarray(req),
                 jnp.asarray(mask[None, :]),
@@ -102,6 +181,7 @@ class BinpackingNodeEstimator:
                 node_level=jnp.asarray(terms.node_level),
                 has_label=jnp.asarray(terms.has_label),
                 node_caps=jnp.asarray(np.array([cap], np.int32)),
+                spread=_spread_tuple(sp),
             )
             scheduled_mask = np.asarray(res.scheduled)[0]
             count = int(np.asarray(res.node_count)[0])
@@ -124,6 +204,7 @@ class BinpackingNodeEstimator:
         templates: Dict[str, Node],
         headrooms: Optional[Dict[str, int]] = None,
         pod_groups=None,
+        cluster=None,  # (nodes, pods, node_of): static spread context
     ) -> Dict[str, Tuple[int, List[Pod]]]:
         """All node groups in one device dispatch (vmap over the group axis).
         headrooms[g] is the group's remaining size budget (max-size − target);
@@ -134,7 +215,9 @@ class BinpackingNodeEstimator:
         if not pods or not templates:
             return {g: (0, []) for g in templates}
         t0 = time.monotonic()
-        result = self._estimate_many_inner(pods, templates, headrooms, pod_groups)
+        result = self._estimate_many_inner(
+            pods, templates, headrooms, pod_groups, cluster
+        )
         elapsed = time.monotonic() - t0
         # the reference budgets max_duration_s PER GROUP (threshold_based_
         # limiter.go); the batched dispatch covers every group at once, so
@@ -156,9 +239,10 @@ class BinpackingNodeEstimator:
         templates: Dict[str, Node],
         headrooms: Optional[Dict[str, int]] = None,
         pod_groups=None,
+        cluster=None,
     ) -> Dict[str, Tuple[int, List[Pod]]]:
         names = sorted(templates)
-        dynamic_affinity = has_interpod_affinity(pods)
+        dynamic_affinity = has_interpod_affinity(pods) or has_hard_spread(pods)
         groups = pod_groups if pod_groups is not None else build_pod_groups(pods)
         if not dynamic_affinity:
             # Equivalence dedup pays when it actually compresses: scan steps
@@ -172,13 +256,13 @@ class BinpackingNodeEstimator:
             # minority of the pending set (the realistic shape). The group
             # count lower-bounds the run count (expansion only grows it), so
             # worlds that can never compress skip the term build entirely.
-            runs, group_terms, group_of_run, run_inv = self._expand_affinity_runs(
-                pods, groups, templates, names
+            runs, group_terms, group_of_run, run_inv, group_sp = (
+                self._expand_affinity_runs(pods, groups, templates, names, cluster)
             )
             if len(runs) * 2 <= len(pods):
                 return self._estimate_many_runs_affinity(
                     pods, runs, group_terms, group_of_run, run_inv,
-                    names, templates, headrooms,
+                    names, templates, headrooms, group_sp,
                 )
         P = bucket_size(len(pods))
         req = _pack_pods(pods, P)
@@ -194,6 +278,7 @@ class BinpackingNodeEstimator:
                 for g in names
             ]
         )
+        req, allocs = _augment_virtual(req, pods, allocs, [templates[g] for g in names])
         headrooms = headrooms or {}
         caps = np.array(
             [self.limiter.node_cap(headrooms.get(g, 0)) for g in names], np.int32
@@ -203,11 +288,16 @@ class BinpackingNodeEstimator:
             terms = build_affinity_terms(
                 pods, [templates[g] for g in names], pad_pods=P, bucket_terms=True
             )
+            sp = build_spread_terms(
+                pods, [templates[g] for g in names], pad_pods=P,
+                bucket_terms=True, cluster=cluster,
+            )
             res: BinpackResult = ffd_binpack_groups_affinity(
                 jnp.asarray(req),
                 jnp.asarray(masks),
                 jnp.asarray(allocs),
                 max_nodes=scan_cap,
+                spread=_spread_tuple(sp),
                 match=jnp.asarray(terms.match),
                 aff_of=jnp.asarray(terms.aff_of),
                 anti_of=jnp.asarray(terms.anti_of),
@@ -236,23 +326,35 @@ class BinpackingNodeEstimator:
         groups,
         templates: Dict[str, Node],
         names: List[str],
-    ) -> Tuple[List[Tuple[Pod, List[Pod]]], "AffinityTermTensors", np.ndarray, np.ndarray]:
-        """→ (runs, group_terms, group_of_run, run_inv): equivalence runs
-        with affinity-involved groups expanded into singletons, the term
-        tensors built ONCE over the group exemplars, each run's source-group
-        index (so the run-axis term columns are a gather, not a rebuild),
-        and the per-run involvement mask.
+        cluster=None,
+    ) -> Tuple[
+        List[Tuple[Pod, List[Pod]]], "AffinityTermTensors", np.ndarray,
+        np.ndarray, "SpreadTermTensors",
+    ]:
+        """→ (runs, group_terms, group_of_run, run_inv, group_spread):
+        equivalence runs with affinity/spread-involved groups expanded into
+        singletons, the term tensors built ONCE over the group exemplars,
+        each run's source-group index (so the run-axis term columns are a
+        gather, not a rebuild), and the per-run involvement mask.
 
         A group is involved iff its exemplar matches any term's selector or
-        holds any required (anti-)affinity term — the cases where placement
-        order changes per-term counts mid-run. Exemplars are representative
-        because the equivalence fingerprint includes labels and affinity
+        holds any required (anti-)affinity term or hard spread constraint —
+        the cases where placement order changes per-term counts mid-run.
+        Exemplars are representative because the equivalence fingerprint
+        includes labels, affinity, and topology spread
         (core/scaleup/equivalence.py _spec_fingerprint)."""
         exemplars = [g.exemplar for g in groups]
         terms = build_affinity_terms(
             exemplars, [templates[g] for g in names], bucket_terms=True
         )
-        inv = (terms.match | terms.aff_of | terms.anti_of).any(axis=0)
+        spread = build_spread_terms(
+            exemplars, [templates[g] for g in names], bucket_terms=True,
+            cluster=cluster,
+        )
+        inv = (
+            (terms.match | terms.aff_of | terms.anti_of).any(axis=0)
+            | (spread.sp_of | spread.sp_match).any(axis=0)
+        )
         runs: List[Tuple[Pod, List[Pod]]] = []
         group_of_run: List[int] = []
         for gi, grp in enumerate(groups):
@@ -263,7 +365,7 @@ class BinpackingNodeEstimator:
                 runs.append((grp.exemplar, grp.pods))
                 group_of_run.append(gi)
         group_of_run_arr = np.asarray(group_of_run, np.int64)
-        return runs, terms, group_of_run_arr, inv[group_of_run_arr]
+        return runs, terms, group_of_run_arr, inv[group_of_run_arr], spread
 
     def _estimate_many_runs_affinity(
         self,
@@ -275,6 +377,7 @@ class BinpackingNodeEstimator:
         names: List[str],
         templates: Dict[str, Node],
         headrooms: Optional[Dict[str, int]],
+        group_spread=None,
     ) -> Dict[str, Tuple[int, List[Pod]]]:
         """Run-aware affinity path: ffd_binpack_groups_runs_affinity with
         involved runs pre-expanded to singletons (count 1). Term columns are
@@ -296,6 +399,9 @@ class BinpackingNodeEstimator:
                 for g in names
             ]
         )
+        run_req, allocs = _augment_virtual(
+            run_req, run_exemplars, allocs, [templates[g] for g in names]
+        )
         headrooms = headrooms or {}
         caps = np.array(
             [self.limiter.node_cap(headrooms.get(g, 0)) for g in names], np.int32
@@ -312,6 +418,23 @@ class BinpackingNodeEstimator:
         terms_anti = to_runs(np.asarray(group_terms.anti_of))
         involved = np.zeros((U,), bool)
         involved[: len(runs)] = run_inv
+        spread_arg = None
+        if group_spread is not None:
+            S = group_spread.sp_of.shape[0]
+
+            def sp_to_runs(col_mat: np.ndarray) -> np.ndarray:
+                out = np.zeros((S, U), bool)
+                out[:, : len(runs)] = col_mat[:, group_of_run]
+                return out
+
+            import dataclasses as _dc
+
+            run_sp = _dc.replace(
+                group_spread,
+                sp_of=sp_to_runs(group_spread.sp_of),
+                sp_match=sp_to_runs(group_spread.sp_match),
+            )
+            spread_arg = _spread_tuple(run_sp)
         res = ffd_binpack_groups_runs_affinity(
             jnp.asarray(run_req),
             jnp.asarray(run_counts),
@@ -325,6 +448,7 @@ class BinpackingNodeEstimator:
             node_level=jnp.asarray(group_terms.node_level),
             has_label=jnp.asarray(group_terms.has_label),
             node_caps=jnp.asarray(caps),
+            spread=spread_arg,
         )
         counts = np.asarray(res.node_count)
         placed = np.asarray(res.placed_counts)
@@ -361,6 +485,9 @@ class BinpackingNodeEstimator:
                 _template_capacity_row(templates[g])
                 for g in names
             ]
+        )
+        run_req, allocs = _augment_virtual(
+            run_req, exemplars, allocs, [templates[g] for g in names]
         )
         headrooms = headrooms or {}
         caps = np.array(

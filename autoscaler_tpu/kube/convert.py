@@ -308,6 +308,12 @@ def pod_from_json(
             topology_key=c.get("topologyKey", ""),
             selector=_label_selector(c.get("labelSelector")),
             when_unsatisfiable=c.get("whenUnsatisfiable", "DoNotSchedule"),
+            min_domains=(
+                int(c["minDomains"]) if c.get("minDomains") is not None else None
+            ),
+            node_affinity_policy=c.get("nodeAffinityPolicy", "Honor"),
+            node_taints_policy=c.get("nodeTaintsPolicy", "Ignore"),
+            match_label_keys=tuple(c.get("matchLabelKeys") or ()),
         )
         for c in spec.get("topologySpreadConstraints") or ()
     )

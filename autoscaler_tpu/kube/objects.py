@@ -190,14 +190,27 @@ class TopologySpreadConstraint:
     """PodTopologySpread filter (the reference evaluates it via the scheduler
     framework's PodTopologySpread plugin, schedulerbased.go:129): placing the
     pod in a topology domain must keep
-    count(domain) + 1 - min(count over eligible domains) <= max_skew.
+    count(domain) + selfMatch - min(count over eligible domains) <= max_skew.
     Only when_unsatisfiable="DoNotSchedule" is a hard predicate;
-    "ScheduleAnyway" is a scoring hint and is ignored here (PREDICATES.md)."""
+    "ScheduleAnyway" is a scoring hint and is ignored here (PREDICATES.md).
+
+    min_domains: while fewer eligible domains exist, the global minimum is
+    treated as 0 (filtering.go:53 minMatchNum); None = 1 (the default).
+    node_affinity_policy / node_taints_policy: whether a node must match the
+    pod's nodeSelector/affinity (default Honor) / have its taints tolerated
+    (default Ignore) to be an eligible domain member (common.go:46
+    matchNodeInclusionPolicies).
+    match_label_keys: label keys whose values are copied from the incoming
+    pod into the selector as exact-match terms (common.go:99-107)."""
 
     max_skew: int
     topology_key: str
     selector: LabelSelector
     when_unsatisfiable: str = "DoNotSchedule"
+    min_domains: Optional[int] = None
+    node_affinity_policy: str = "Honor"
+    node_taints_policy: str = "Ignore"
+    match_label_keys: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 
 from autoscaler_tpu.kube.objects import CPU, MEMORY
 
+BIG_I32 = jnp.int32(2**30)  # "no domain yet" sentinel in spread minimums
+
 
 class BinpackResult(NamedTuple):
     node_count: jax.Array   # i32 scalar (or [G]) — template nodes opened
@@ -188,6 +190,61 @@ def _max_fit(q, free):
     return jnp.where(fits_k(cnt + 1), cnt + 1, cnt)
 
 
+def _spread_state_init(G: int, S: int, max_nodes: int):
+    return (
+        jnp.zeros((G, S, max_nodes), jnp.int32),  # spc: per-node scan counts
+        jnp.zeros((G, S), jnp.int32),             # spc_tot: group scan counts
+    )
+
+
+def _spread_gates(sp, spc, spc_tot, idx, opened, node_ids):
+    """Within-wave topology-spread gating (closes the scan half of
+    PREDICATES.md divergence 2; reference counts update per placement via
+    schedulerbased.go:109-163) → (group_ok [G], node_ok [G, M], upd [G, S]).
+
+    Group-level terms: every new node of a group shares the template's
+    domain, so its count is static_count + scan placements; the global min
+    is min(min over OTHER static domains, that count) — other domains'
+    counts cannot change during the wave — with minDomains folding to a
+    precomputed force_zero. One violated term blocks the whole group this
+    step (both open-node placement and opening).
+
+    Hostname-level terms: each opened node is a domain with its own scan
+    count; the global min is min(static domain min, min over opened nodes),
+    and minDomains compares against static domains + opened. A fresh node
+    is a 0-count domain, so opening is never blocked by a hostname term
+    (matching the reference: the candidate node's own empty domain is the
+    global minimum)."""
+    (sp_of_T, sp_match_T, nl, skew, mind, has_label, st_count,
+     min_others, st_min, st_domnum, force_zero) = sp
+    sp_o = sp_of_T[idx]                                          # [G, S]
+    sp_m = sp_match_T[idx]                                       # [G, S]
+    self_i = sp_m.astype(jnp.int32)
+    # group-level
+    cnt = st_count + spc_tot                                     # [G, S]
+    min_eff_z = jnp.where(force_zero, 0, jnp.minimum(min_others, cnt))
+    bad_z = (
+        sp_o & ~nl[None, :] & has_label
+        & (cnt + self_i - min_eff_z > skew[None, :])
+    )
+    group_ok = ~bad_z.any(axis=1)                                # [G]
+    # hostname-level
+    open_m = node_ids[None, None, :] < opened[:, None, None]     # [G, 1, M]
+    dyn_min = jnp.min(jnp.where(open_m, spc, BIG_I32), axis=2)   # [G, S]
+    domnum = st_domnum + opened[:, None]                         # [G, S]
+    min_eff_h = jnp.where(
+        mind[None, :] > domnum, 0, jnp.minimum(st_min, dyn_min)
+    )
+    bad_h = (
+        sp_o[:, :, None] & nl[None, :, None]
+        & (spc + self_i[:, :, None] - min_eff_h[:, :, None]
+           > skew[None, :, None])
+    )
+    node_ok = ~bad_h.any(axis=1)                                 # [G, M]
+    upd = sp_m & has_label   # placements on keyless templates never count
+    return group_ok, node_ok, upd
+
+
 def _affinity_node_gates(m_p, a_p, x_p, pm, pm_tot, ha, ha_tot, nl, has_label):
     """Shared dynamic-affinity gating (see ffd_binpack_groups_affinity's
     docstring for the rules) → (gate_open [G, M], new_ok [G]): which open
@@ -325,6 +382,7 @@ def ffd_binpack_groups_runs_affinity(
     node_level: jax.Array,      # [T] bool — hostname-level topology
     has_label: jax.Array,       # [G, T] bool — group template has topology label
     node_caps: jax.Array | None = None,  # [G] i32
+    spread: tuple | None = None,  # SpreadTermTensors as an 11-array tuple
 ) -> RunBinpackResult:
     """Equivalence-run FFD that coexists with dynamic inter-pod affinity —
     the ROADMAP 'run-aware affinity kernel'. Hybrid step semantics:
@@ -365,9 +423,10 @@ def ffd_binpack_groups_runs_affinity(
     aff_t = aff_of.T.astype(bool)
     anti_t = anti_of.T.astype(bool)
     nl = node_level.astype(bool)                                     # [T]
+    S = spread[2].shape[0] if spread is not None else 0  # node_level [S]
 
     def step(carry, xs):
-        used_t, opened, pm, pm_tot, ha, ha_tot = carry
+        used_t, opened, pm, pm_tot, ha, ha_tot, spc, spc_tot = carry
         idx, active = xs                  # [G] i32, [G] bool
         q = run_req[idx]                  # [G, R]
         inv = inv_u[idx]                  # [G]
@@ -400,6 +459,14 @@ def ffd_binpack_groups_runs_affinity(
             m_p, a_p, x_p, pm, pm_tot, ha, ha_tot, nl, has_label
         )
         fits_b = fits_n & gate_open
+        if spread is not None:
+            # involved runs are singletons; spread-touching runs are always
+            # involved (estimator routing), so path A never moves counts
+            sp_group_ok, sp_node_ok, sp_upd = _spread_gates(
+                spread, spc, spc_tot, idx, opened, node_ids
+            )
+            fits_b &= sp_node_ok & sp_group_ok[:, None]
+            new_ok &= sp_group_ok
         has_fit = fits_b.any(axis=1)
         first = jnp.argmax(fits_b, axis=1).astype(jnp.int32)
         can_open = (opened < caps) & fits_empty & new_ok
@@ -418,7 +485,13 @@ def ffd_binpack_groups_runs_affinity(
         ha = ha + (x_p[:, :, None] & inc).astype(jnp.int32)
         pm_tot = pm_tot + (m_p & place_b[:, None]).astype(jnp.int32)
         ha_tot = ha_tot + (x_p & place_b[:, None]).astype(jnp.int32)
-        return (used_t, opened, pm, pm_tot, ha, ha_tot), take.sum(axis=1)
+        if spread is not None:
+            spc = spc + (sp_upd[:, :, None] & inc).astype(jnp.int32)
+            spc_tot = spc_tot + (sp_upd & place_b[:, None]).astype(jnp.int32)
+        return (
+            (used_t, opened, pm, pm_tot, ha, ha_tot, spc, spc_tot),
+            take.sum(axis=1),
+        )
 
     init = (
         jnp.zeros((G, R, max_nodes), run_req.dtype),
@@ -427,6 +500,7 @@ def ffd_binpack_groups_runs_affinity(
         jnp.zeros((G, T), jnp.int32),
         jnp.zeros((G, T, max_nodes), jnp.int32),
         jnp.zeros((G, T), jnp.int32),
+        *_spread_state_init(G, S, max_nodes),
     )
     (used_t, opened, *_), placed = jax.lax.scan(
         step, init, (order.T, sorted_mask.T)
@@ -456,6 +530,7 @@ def ffd_binpack_groups_affinity(
     node_level: jax.Array,      # [T] bool — hostname-level topology
     has_label: jax.Array,       # [G, T] bool — group template has topology label
     node_caps: jax.Array | None = None,  # [G] i32
+    spread: tuple | None = None,  # SpreadTermTensors as an 11-array tuple
 ) -> BinpackResult:
     """FFD scan with *dynamic* inter-pod (anti-)affinity: pods placed during
     the scan constrain later pods, as the reference's per-placement filter
@@ -492,9 +567,10 @@ def ffd_binpack_groups_affinity(
     aff_t = aff_of.T.astype(bool)
     anti_t = anti_of.T.astype(bool)
     nl = node_level.astype(bool)                                      # [T]
+    S = spread[2].shape[0] if spread is not None else 0  # node_level [S]
 
     def step(carry, xs):
-        used_t, opened, pm, pm_tot, ha, ha_tot = carry
+        used_t, opened, pm, pm_tot, ha, ha_tot, spc, spc_tot = carry
         # used_t [G,R,M]; opened [G]; pm/ha [G,T,M] i32; *_tot [G,T] i32
         idx, active = xs                  # [G] i32, [G] bool
         req = pod_req[idx]                # [G, R]
@@ -512,6 +588,12 @@ def ffd_binpack_groups_affinity(
             m_p, a_p, x_p, pm, pm_tot, ha, ha_tot, nl, has_label
         )
         fits_n &= gate_open
+        if spread is not None:
+            sp_group_ok, sp_node_ok, sp_upd = _spread_gates(
+                spread, spc, spc_tot, idx, opened, node_ids
+            )
+            fits_n &= sp_node_ok & sp_group_ok[:, None]
+            new_ok &= sp_group_ok
 
         has_fit = fits_n.any(axis=1)
         first = jnp.argmax(fits_n, axis=1).astype(jnp.int32)
@@ -530,7 +612,10 @@ def ffd_binpack_groups_affinity(
         ha = ha + (x_p[:, :, None] & inc).astype(jnp.int32)
         pm_tot = pm_tot + (m_p & place[:, None]).astype(jnp.int32)
         ha_tot = ha_tot + (x_p & place[:, None]).astype(jnp.int32)
-        return (used_t, opened, pm, pm_tot, ha, ha_tot), place
+        if spread is not None:
+            spc = spc + (sp_upd[:, :, None] & inc).astype(jnp.int32)
+            spc_tot = spc_tot + (sp_upd & place[:, None]).astype(jnp.int32)
+        return (used_t, opened, pm, pm_tot, ha, ha_tot, spc, spc_tot), place
 
     init = (
         jnp.zeros((G, R, max_nodes), pod_req.dtype),
@@ -539,6 +624,7 @@ def ffd_binpack_groups_affinity(
         jnp.zeros((G, T), jnp.int32),
         jnp.zeros((G, T, max_nodes), jnp.int32),
         jnp.zeros((G, T), jnp.int32),
+        *_spread_state_init(G, S, max_nodes),
     )
     (used_t, opened, *_), placed = jax.lax.scan(
         step, init, (order.T, sorted_mask.T)

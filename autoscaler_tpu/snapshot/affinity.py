@@ -153,3 +153,203 @@ def has_interpod_affinity(pods: Sequence[Pod]) -> bool:
         and (p.affinity.pod_affinity or p.affinity.pod_anti_affinity)
         for p in pods
     )
+
+
+def has_hard_spread(pods: Sequence[Pod]) -> bool:
+    return any(
+        c.when_unsatisfiable == "DoNotSchedule"
+        for p in pods
+        for c in p.topology_spread
+    )
+
+
+_BIG = np.int32(2**30)
+
+
+@dataclass
+class SpreadTermTensors:
+    """Dense factorization of DoNotSchedule topology-spread constraints for
+    the within-wave scan gate (the second half of PREDICATES.md divergence
+    2): the scan carries per-term placement counts so pods placed earlier in
+    the SAME wave count toward later pods' skew, as the reference's
+    per-placement plugin re-run does (schedulerbased.go:109-163).
+
+    Topology model mirrors the affinity terms: hostname-key terms are
+    node-level (each scan-opened node is its own domain); any other key is
+    group-level (all new nodes of a group share the template's domain).
+    Static context (counts/min over the EXISTING cluster) comes from the
+    optional cluster snapshot; without it the template-only world applies
+    (counts 0 — what the static mask already assumed)."""
+
+    sp_of: np.ndarray        # [S, P] bool — pod is constrained by term s
+    sp_match: np.ndarray     # [S, P] bool — pod matches selector+ns (counts AND selfMatch)
+    node_level: np.ndarray   # [S] bool
+    max_skew: np.ndarray     # [S] i32
+    min_domains: np.ndarray  # [S] i32
+    has_label: np.ndarray    # [G, S] bool — template carries the topology key
+    static_count: np.ndarray   # [G, S] i32 — existing matching pods in the template's domain (group-level terms)
+    min_others: np.ndarray     # [G, S] i32 — min count over OTHER static domains (BIG if none)
+    static_min: np.ndarray     # [G, S] i32 — hostname: min over static domains (BIG if none)
+    static_domnum: np.ndarray  # [G, S] i32 — hostname: number of static domains
+    force_zero: np.ndarray     # [G, S] bool — group-level: minDomains unmet → min is 0
+
+    @property
+    def num_terms(self) -> int:
+        return int(self.sp_of.shape[0])
+
+
+def _spread_effective_selector(c, pod: Pod):
+    from autoscaler_tpu.kube.objects import LabelSelector
+
+    if not c.match_label_keys:
+        return c.selector
+    extra = tuple((k, pod.labels[k]) for k in c.match_label_keys if k in pod.labels)
+    if not extra:
+        return c.selector
+    merged = dict(c.selector.match_labels)
+    merged.update(extra)
+    return LabelSelector(
+        match_labels=tuple(sorted(merged.items())),
+        match_expressions=c.selector.match_expressions,
+    )
+
+
+def build_spread_terms(
+    pods: Sequence[Pod],
+    templates: Sequence[Node],
+    pad_pods: int | None = None,
+    bucket_terms: bool = False,
+    cluster: "Tuple[Sequence[Node], Sequence[Pod], Sequence[int]] | None" = None,
+) -> SpreadTermTensors:
+    """Collect distinct DoNotSchedule spread constraints over `pods` and
+    evaluate selectors once per (term, pod profile). `cluster` =
+    (nodes, pods, node_of_pod) provides the static domain counts the
+    reference's PreFilter computes over the live snapshot (common.go:289);
+    None means the template-only estimation world. Terms whose static
+    context depends on the declaring pod's own node filters (Honor
+    policies with a cluster) intern per eligibility signature, so pods with
+    different selectors/tolerations get their own static rows."""
+    from autoscaler_tpu.kube import objects as k8s
+
+    term_index: Dict[Tuple, int] = {}
+    term_list: List[Tuple] = []  # (constraint, eff_selector, ns, elig_sig_pod)
+    decls: List[Tuple[int, int]] = []
+
+    def _elig_sig(pod: Pod):
+        if cluster is None:
+            return ()
+        return (
+            tuple(sorted(pod.node_selector.items())),
+            repr(pod.affinity.node_selector_terms) if pod.affinity else "",
+            tuple(
+                (t.key, t.operator, t.value, t.effect) for t in pod.tolerations
+            ),
+        )
+
+    for i, pod in enumerate(pods):
+        for c in pod.topology_spread:
+            if c.when_unsatisfiable != "DoNotSchedule":
+                continue
+            sel = _spread_effective_selector(c, pod)
+            sig = _elig_sig(pod) if (
+                c.node_affinity_policy != "Ignore" or c.node_taints_policy == "Honor"
+            ) else ()
+            key = (
+                c.topology_key, sel, pod.namespace, c.max_skew,
+                c.min_domains or 1, c.node_affinity_policy,
+                c.node_taints_policy, sig,
+            )
+            t = term_index.get(key)
+            if t is None:
+                t = term_index[key] = len(term_list)
+                term_list.append((c, sel, pod.namespace, pod))
+            decls.append((i, t))
+
+    S = len(term_list)
+    SS = bucket_size(S, minimum=4) if bucket_terms else max(S, 1)
+    P = pad_pods if pad_pods is not None else len(pods)
+    G = len(templates)
+    out = SpreadTermTensors(
+        sp_of=np.zeros((SS, P), bool),
+        sp_match=np.zeros((SS, P), bool),
+        node_level=np.zeros((SS,), bool),
+        max_skew=np.zeros((SS,), np.int32),
+        min_domains=np.ones((SS,), np.int32),
+        has_label=np.zeros((G, SS), bool),
+        static_count=np.zeros((G, SS), np.int32),
+        min_others=np.full((G, SS), _BIG, np.int32),
+        static_min=np.full((G, SS), _BIG, np.int32),
+        static_domnum=np.zeros((G, SS), np.int32),
+        force_zero=np.zeros((G, SS), bool),
+    )
+    if S == 0:
+        return out
+
+    for i, t in decls:
+        out.sp_of[t, i] = True
+    for t, (c, sel, ns, _declarer) in enumerate(term_list):
+        out.node_level[t] = c.topology_key == HOSTNAME_KEY
+        out.max_skew[t] = c.max_skew
+        out.min_domains[t] = c.min_domains or 1
+        for p_i, pod in enumerate(pods):
+            out.sp_match[t, p_i] = pod.namespace == ns and sel.matches(pod.labels)
+        for g, tmpl in enumerate(templates):
+            out.has_label[g, t] = (
+                out.node_level[t] or c.topology_key in tmpl.labels
+            )
+
+    if cluster is None:
+        # template-only world: no static domains; minDomains>1 forces min=0
+        # for group-level terms (the new nodes' single shared domain)
+        for t, (c, *_rest) in enumerate(term_list):
+            if not out.node_level[t]:
+                out.force_zero[:, t] = (c.min_domains or 1) > 1
+        return out
+
+    cl_nodes, cl_pods, cl_node_of = cluster
+    for t, (c, sel, ns, declarer) in enumerate(term_list):
+        key = c.topology_key
+        # eligibility of existing nodes for this term, judged with the
+        # declaring pod's filters (all same-sig pods share the verdicts)
+        eligible = []
+        for n in cl_nodes:
+            ok = key in n.labels or out.node_level[t]
+            if ok and c.node_affinity_policy != "Ignore":
+                ok = k8s.node_matches_selector(declarer, n)
+            if ok and c.node_taints_policy == "Honor":
+                ok = k8s.pod_tolerates_taints(declarer, n.taints)
+            eligible.append(ok)
+        dom_of = [
+            (n.labels.get(key) if not out.node_level[t] else n.name)
+            if eligible[j]
+            else None
+            for j, n in enumerate(cl_nodes)
+        ]
+        counts: Dict[str, int] = {}
+        for j, d in enumerate(dom_of):
+            if d is not None:
+                counts.setdefault(d, 0)
+        for q, j in zip(cl_pods, cl_node_of):
+            if j < 0 or dom_of[j] is None:
+                continue
+            if (
+                q.namespace == ns
+                and q.deletion_ts is None
+                and sel.matches(q.labels)
+            ):
+                counts[dom_of[j]] += 1
+        if out.node_level[t]:
+            for g in range(G):
+                out.static_min[g, t] = min(counts.values()) if counts else _BIG
+                out.static_domnum[g, t] = len(counts)
+        else:
+            for g, tmpl in enumerate(templates):
+                dom_t = tmpl.labels.get(key)
+                others = [v for d, v in counts.items() if d != dom_t]
+                out.static_count[g, t] = counts.get(dom_t, 0) if dom_t else 0
+                out.min_others[g, t] = min(others) if others else _BIG
+                domains_num = len(counts) + (
+                    0 if dom_t in counts else (1 if dom_t is not None else 0)
+                )
+                out.force_zero[g, t] = (c.min_domains or 1) > domains_num
+    return out

@@ -25,6 +25,7 @@ import numpy as np
 
 from autoscaler_tpu.kube import objects as k8s
 from autoscaler_tpu.kube.objects import NUM_RESOURCES, Node, Pod
+from autoscaler_tpu.snapshot.affinity import _spread_effective_selector
 from autoscaler_tpu.snapshot.tensors import SnapshotTensors, bucket_size
 
 import jax.numpy as jnp
@@ -414,33 +415,84 @@ def _apply_row_rules(
         return domain_cache[key]
 
     # PodTopologySpread hard filter (reference: scheduler framework's
-    # PodTopologySpread plugin behind schedulerbased.go:129): placing pod i
-    # in domain d must keep count(d) + 1 - min(counts over domains) within
-    # max_skew. Counts are of placed pods in the pod's namespace matching
-    # the constraint selector; nodes without the topology label can never
-    # satisfy the constraint. Applied regardless of `interpod` — the dynamic
-    # affinity scan does not re-evaluate spread (see PREDICATES.md).
+    # PodTopologySpread plugin behind schedulerbased.go:129, filtering.go:339
+    # Filter): placing pod i on node n must keep
+    # count(domain(n)) + selfMatch - minMatchNum <= max_skew. Full plugin
+    # semantics: domain eligibility (a node contributes counts only if it
+    # carries ALL the pod's DoNotSchedule topology keys and passes the
+    # constraint's node inclusion policies, common.go:289 + :46),
+    # matchLabelKeys (selector extended with the pod's own label values,
+    # common.go:99), minDomains (global min treated as 0 while fewer
+    # eligible domains exist, filtering.go:53), and selfMatch (the pod only
+    # counts itself when it matches its own selector, filtering.go:367).
+    # Applied regardless of `interpod` — the dynamic affinity scan does not
+    # re-evaluate spread (see PREDICATES.md).
     for i, pod in enumerate(pods):
         if not pod.topology_spread or not view.has(i):
             continue
-        for c in pod.topology_spread:
-            if c.when_unsatisfiable != "DoNotSchedule":
-                continue  # ScheduleAnyway is a scoring hint, not a predicate
+        hard = [
+            c for c in pod.topology_spread
+            if c.when_unsatisfiable == "DoNotSchedule"
+        ]
+        if not hard:
+            continue
+        # nodeLabelsMatchSpreadConstraints: a node missing ANY of the pod's
+        # constraint keys contributes no counts for any of them
+        all_keys = {c.topology_key for c in hard}
+        has_all_keys = np.array(
+            [all(k in nodes[j].labels for k in all_keys) for j in range(N)],
+            bool,
+        )
+        affinity_ok = None  # lazy: only when some constraint Honors it
+        taints_ok = None
+        for c in hard:
+            sel = _spread_effective_selector(c, pod)
             node_dom, domains = domains_for(c.topology_key)
+            eligible = has_all_keys.copy()
+            if c.node_affinity_policy != "Ignore":  # Honor is the default
+                if affinity_ok is None:
+                    affinity_ok = np.array(
+                        [k8s.node_matches_selector(pod, n) for n in nodes], bool
+                    )
+                eligible &= affinity_ok
+            if c.node_taints_policy == "Honor":     # Ignore is the default
+                if taints_ok is None:
+                    taints_ok = np.array(
+                        [k8s.pod_tolerates_taints(pod, n.taints) for n in nodes],
+                        bool,
+                    )
+                eligible &= taints_ok
             counts = np.zeros(max(len(domains), 1), np.int64)
             for (qi, q, j) in placed:
                 if (
                     qi != i
+                    and eligible[j]
                     and node_dom[j] >= 0
                     and q.namespace == pod.namespace
-                    and c.selector.matches(q.labels)
+                    and q.deletion_ts is None  # countPodsMatchSelector skips
+                    and sel.matches(q.labels)  # terminating pods (#87621)
                 ):
                     counts[node_dom[j]] += 1
-            min_count = int(counts.min()) if len(domains) else 0
-            allowed = node_dom >= 0
-            if len(domains):
-                dom_ok = (counts + 1 - min_count) <= c.max_skew
-                allowed = allowed & dom_ok[np.clip(node_dom, 0, None)]
+            registered = sorted(
+                {int(node_dom[j]) for j in range(N) if eligible[j] and node_dom[j] >= 0}
+            )
+            if registered:
+                min_count = int(counts[registered].min())
+            else:
+                min_count = 0
+            if (c.min_domains or 1) > len(registered):
+                min_count = 0  # minDomains not yet reached → global min is 0
+            self_match = 1 if sel.matches(pod.labels) else 0
+            # Filter runs on every node: a node lacking THIS key is
+            # unschedulable; an ineligible (policy-excluded) node still gets
+            # judged, with matchNum falling back to 0 for unregistered
+            # domains (TpPairToMatchNum miss, filtering.go:374)
+            dom_counts = counts[np.clip(node_dom, 0, None)]
+            reg_mask = np.isin(node_dom, registered)
+            dom_counts = np.where(reg_mask, dom_counts, 0)
+            allowed = (node_dom >= 0) & (
+                dom_counts + self_match - min_count <= c.max_skew
+            )
             view[i] = view[i] & allowed
 
     if not interpod:

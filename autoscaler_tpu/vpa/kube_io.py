@@ -374,20 +374,24 @@ class VpaCheckpointStore:
             )
         return out
 
-    def gc(self, live: List[Checkpoint]) -> int:
-        """Delete checkpoint objects whose (namespace, vpa, container) no
-        longer exists in the model — the reference recommender's
-        MaintainCheckpoints GC pass (routines/recommender.go:160)."""
-        keep = {(c.namespace, self._name(c)) for c in live}
+    def gc(self, live_vpa_keys) -> int:
+        """Delete checkpoint objects whose VPA no longer EXISTS — keyed on
+        the live VPA set, never on the in-memory model (a cold-started
+        model after a failed restore must not wipe days of persisted
+        histograms for VPAs that are still there). Reference:
+        MaintainCheckpoints GCs by VPA existence (routines/recommender.go:160)."""
+        keep = set(live_vpa_keys)
         deleted = 0
         for obj in self._list_raw():
             meta = obj.get("metadata") or {}
-            key = (meta.get("namespace", "default"), meta.get("name", ""))
-            if key not in keep:
+            spec = obj.get("spec") or {}
+            ns = meta.get("namespace", "default")
+            if (ns, spec.get("vpaObjectName", "")) not in keep:
                 try:
                     self.client.delete(
-                        f"/apis/autoscaling.k8s.io/v1/namespaces/{key[0]}"
-                        f"/verticalpodautoscalercheckpoints/{key[1]}"
+                        f"/apis/autoscaling.k8s.io/v1/namespaces/{ns}"
+                        f"/verticalpodautoscalercheckpoints/"
+                        f"{meta.get('name', '')}"
                     )
                     deleted += 1
                 except ApiError as e:

@@ -121,12 +121,17 @@ class VpaRunner:
             self.checkpoint_store.save(ckpts)
             # GC needs a second cluster-wide LIST, so it runs only when
             # orphans can exist: at the first pass (leftovers from a
-            # predecessor) or when the live-key set shrank — not every
-            # cycle (the reference runs GC on a slow timer, not per pass)
-            live = {(c.namespace, c.vpa, c.container) for c in ckpts}
-            if self._prev_live_keys is None or (self._prev_live_keys - live):
-                self.checkpoint_store.gc(ckpts)
-            self._prev_live_keys = live
+            # predecessor) or when the live VPA set shrank — not every
+            # cycle (the reference runs GC on a slow timer, not per pass).
+            # The keep-set is the LIVE VPA LIST, never the model: a cold
+            # start after a failed restore must not wipe persisted state.
+            if live_vpa_keys is not None and (
+                self._prev_live_keys is None
+                or (self._prev_live_keys - set(live_vpa_keys))
+            ):
+                self.checkpoint_store.gc(live_vpa_keys)
+            if live_vpa_keys is not None:
+                self._prev_live_keys = set(live_vpa_keys)
             return
         if not self.checkpoint_path:
             return

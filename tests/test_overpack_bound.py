@@ -118,11 +118,11 @@ class TestCsiOverpackBound:
         p.csi_volumes = (("pd.csi.example.com", f"vol-{name}"),)
         return p
 
-    def test_binpack_wave_overpacks_up_to_batch_width(self):
-        """K pods with unique volumes binpacked onto new template nodes in
-        one wave: attach counts on scan-opened nodes are not tracked
-        (divergence 3b), so resource-fit packs all K onto node 0 despite a
-        per-node attach limit of 2. Overpack = K - LIMIT <= batch width."""
+    def test_raw_kernel_without_planes_overpacks(self):
+        """Counterfactual: the RAW resource kernel (no virtual planes) packs
+        all K unique-volume pods onto one node past its attach limit —
+        overpack = K - LIMIT, bounded by the batch width. This is the
+        behavior the estimator's virtual resource planes eliminate."""
         K_csi = 6
         pods = [self._csi_pod(f"c{i}") for i in range(K_csi)]
         template = build_test_node("tmpl", cpu_m=10_000)
@@ -143,6 +143,68 @@ class TestCsiOverpackBound:
         overpack = attachments - self.LIMIT
         assert attachments == K_csi          # all placed on the one node
         assert 0 < overpack <= K_csi         # bound holds and is realized
+
+    def test_estimator_virtual_planes_make_the_wave_exact(self):
+        """The estimator appends per-driver virtual resource planes, so one
+        wave opens ceil(K/limit) nodes instead of overpacking one — the
+        reference's per-placement NodeVolumeLimits re-run, reproduced with
+        zero kernel changes (divergence 3b CLOSED at the estimator level)."""
+        from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+
+        K_csi = 6
+        pods = [self._csi_pod(f"c{i}") for i in range(K_csi)]
+        template = build_test_node("tmpl", cpu_m=10_000)
+        template.csi_attach_limits = {"pd.csi.example.com": self.LIMIT}
+        count, scheduled = BinpackingNodeEstimator().estimate(pods, template)
+        assert len(scheduled) == K_csi
+        assert count == K_csi // self.LIMIT  # 3 nodes at limit 2, not 1
+        # multi-group path agrees
+        res = BinpackingNodeEstimator().estimate_many(
+            pods, {"g": template}, headrooms={"g": 10}
+        )
+        assert res["g"][0] == K_csi // self.LIMIT
+
+    def test_estimator_port_planes_one_per_node(self):
+        """Two pods binding the same hostPort can never share a scan-opened
+        node (NodePorts within-wave, the divergence-2 'ports' note)."""
+        from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+
+        pods = []
+        for i in range(4):
+            p = build_test_pod(f"hp{i}", cpu_m=100)
+            p.host_ports = (8080,)
+            pods.append(p)
+        template = build_test_node("tmpl", cpu_m=10_000)
+        count, scheduled = BinpackingNodeEstimator().estimate(pods, template)
+        assert len(scheduled) == 4
+        assert count == 4  # one per node despite ample cpu
+        # mixed ports: only same-port pods conflict
+        p2 = build_test_pod("hp-other", cpu_m=100)
+        p2.host_ports = (9090,)
+        count2, sched2 = BinpackingNodeEstimator().estimate(pods + [p2], template)
+        assert len(sched2) == 5
+        assert count2 == 4  # the 9090 pod shares a node with an 8080 pod
+
+    def test_runs_dedup_path_honors_planes(self):
+        """The equivalence-dedup (runs) kernel bulk-fills nodes via a
+        per-node capacity min that includes the virtual planes: a run of
+        identical hostPort pods fills exactly one pod per node."""
+        from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+        from autoscaler_tpu.kube.objects import OwnerRef
+
+        pods = []
+        for i in range(8):
+            p = build_test_pod(f"run{i}", cpu_m=100)
+            p.host_ports = (8080,)
+            p.owner_ref = OwnerRef(kind="DaemonLike", name="rs")
+            pods.append(p)
+        template = build_test_node("tmpl", cpu_m=10_000)
+        res = BinpackingNodeEstimator().estimate_many(
+            pods, {"g": template}, headrooms={"g": 20}
+        )
+        count, scheduled = res["g"]
+        assert len(scheduled) == 8
+        assert count == 8  # one per node, through the runs-collapse path
 
     def test_loop2_mask_blocks_the_full_node(self):
         """Once the wave materializes (real node, volumes attached), the

@@ -1,0 +1,340 @@
+"""Within-wave topology-spread during binpacking — parity with a serial
+oracle implementing the reference's per-placement plugin re-run.
+
+This closes the scan half of PREDICATES.md divergence 2: pods placed earlier
+in the SAME estimation wave now count toward later pods' skew, exactly as
+the reference's estimator observes through the scheduler framework
+(binpacking_estimator.go:119-141 → schedulerbased.go:109-163, PodTopologySpread
+filtering.go:339). Topology model: hostname-key terms are node-level (each
+scan-opened node its own domain); other keys are group-level (all new nodes
+share the template's domain). Static context (the existing cluster's domain
+counts, common.go:289 PreFilter) enters via the estimator's `cluster` arg.
+"""
+import numpy as np
+import pytest
+
+from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+from autoscaler_tpu.kube.objects import (
+    LabelSelector,
+    OwnerRef,
+    TopologySpreadConstraint,
+)
+from autoscaler_tpu.utils.test_utils import GB, build_test_node, build_test_pod
+
+ZONE = "topology.kubernetes.io/zone"
+HOSTNAME = "kubernetes.io/hostname"
+
+
+def spread(max_skew=1, key=ZONE, match=None, min_domains=None):
+    return TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=key,
+        selector=LabelSelector.from_dict(match or {"app": "web"}),
+        when_unsatisfiable="DoNotSchedule",
+        min_domains=min_domains,
+    )
+
+
+def web_pod(name, cpu=100, constraints=(), labels=None):
+    p = build_test_pod(name, cpu_m=cpu, labels=labels or {"app": "web"})
+    p.topology_spread = tuple(constraints)
+    return p
+
+
+# --------------------------------------------------------------------------
+# Serial oracle: sequential FFD with the full spread Filter evaluated
+# against (static cluster domains + scan-opened nodes) after every placement.
+def serial_ffd_spread(pods, template, cap, cluster=None):
+    cl_nodes, cl_pods, cl_node_of = cluster or ([], [], [])
+    order = sorted(
+        range(len(pods)),
+        key=lambda i: -(
+            (pods[i].requests.cpu_m / template.allocatable.cpu_m
+             if template.allocatable.cpu_m else 0.0)
+            + (pods[i].requests.memory / template.allocatable.memory
+               if template.allocatable.memory else 0.0)
+        ),
+    )
+    open_nodes = []  # per node: {"cpu": used, "pods": used, "counts": {sel_key: n}}
+    placed = [False] * len(pods)
+    placements = []  # (pod index, node index)
+
+    def static_counts(c, sel, pod):
+        """domain value → count over eligible existing nodes."""
+        counts = {}
+        for j, n in enumerate(cl_nodes):
+            key = n.name if c.topology_key == HOSTNAME else n.labels.get(
+                c.topology_key
+            )
+            if key is None:
+                continue
+            counts.setdefault(key, 0)
+        for q, j in zip(cl_pods, cl_node_of):
+            if j < 0:
+                continue
+            n = cl_nodes[j]
+            key = n.name if c.topology_key == HOSTNAME else n.labels.get(
+                c.topology_key
+            )
+            if key is None:
+                continue
+            if q.namespace == pod.namespace and sel.matches(q.labels):
+                counts[key] += 1
+        return counts
+
+    def filter_ok(pod, node_idx, n_open):
+        """Filter on open node node_idx, or on a fresh node (node_idx ==
+        n_open, which exists in the hypothetical snapshot when checked)."""
+        for c in pod.topology_spread:
+            if c.when_unsatisfiable != "DoNotSchedule":
+                continue
+            sel = c.selector
+            counts = static_counts(c, sel, pod)
+            if c.topology_key == HOSTNAME:
+                # each new node is a domain
+                for m in range(n_open + (1 if node_idx == n_open else 0)):
+                    counts[f"__new{m}"] = 0
+                for (pi, m) in placements:
+                    if sel.matches(pods[pi].labels):
+                        counts[f"__new{m}"] += 1
+                dom = f"__new{node_idx}"
+            else:
+                dom = template.labels.get(c.topology_key)
+                if dom is None:
+                    return False  # node lacks the key → unschedulable
+                counts.setdefault(dom, 0)
+                for (pi, _m) in placements:
+                    if sel.matches(pods[pi].labels):
+                        counts[dom] += 1
+            min_count = min(counts.values()) if counts else 0
+            if (c.min_domains or 1) > len(counts):
+                min_count = 0
+            self_match = 1 if sel.matches(pod.labels) else 0
+            if counts[dom] + self_match - min_count > c.max_skew:
+                return False
+        return True
+
+    for i in order:
+        pod = pods[i]
+        req_cpu = pod.requests.cpu_m
+        done = False
+        for m, node in enumerate(open_nodes):
+            if (
+                node["cpu"] + req_cpu <= template.allocatable.cpu_m
+                and node["pods"] + 1 <= template.allocatable.pods
+                and filter_ok(pod, m, len(open_nodes))
+            ):
+                node["cpu"] += req_cpu
+                node["pods"] += 1
+                placements.append((i, m))
+                placed[i] = True
+                done = True
+                break
+        if not done and len(open_nodes) < cap:
+            if (
+                req_cpu <= template.allocatable.cpu_m
+                and filter_ok(pod, len(open_nodes), len(open_nodes))
+            ):
+                open_nodes.append({"cpu": req_cpu, "pods": 1})
+                placements.append((i, len(open_nodes) - 1))
+                placed[i] = True
+    return len(open_nodes), placed
+
+
+def zone_template(zone="zone-a", cpu=10_000):
+    t = build_test_node(f"tmpl-{zone}", cpu_m=cpu)
+    t.labels[ZONE] = zone
+    return t
+
+
+class TestZoneSpreadWithinWave:
+    def test_other_zone_budget_caps_the_wave(self):
+        """Cluster has an empty zone-b domain; the zone-a group's wave may
+        place only maxSkew matching pods before skew vs zone-b's 0 blocks
+        the rest — the cross-zone balance the reference produces."""
+        other = build_test_node("existing-b", cpu_m=10_000)
+        other.labels[ZONE] = "zone-b"
+        cluster = ([other], [], [])
+        pods = [web_pod(f"p{i}", constraints=(spread(max_skew=1),)) for i in range(6)]
+        count, scheduled = BinpackingNodeEstimator().estimate(
+            pods, zone_template(), cluster=cluster
+        )
+        assert len(scheduled) == 1  # budget = maxSkew + min_other(0) - count(0)
+        assert count == 1
+        ref_count, ref_placed = serial_ffd_spread(
+            pods, zone_template(), 8, cluster
+        )
+        assert (count, sum(1 for _ in scheduled)) == (ref_count, sum(ref_placed))
+
+    def test_template_only_world_single_domain_never_blocks(self):
+        """With no other domains, skew against the group's own domain is
+        always count+1-count = 1: the wave is resource-limited only (the
+        reference behaves identically when the snapshot holds no other
+        eligible domain)."""
+        pods = [web_pod(f"p{i}", constraints=(spread(max_skew=1),)) for i in range(6)]
+        count, scheduled = BinpackingNodeEstimator().estimate(pods, zone_template())
+        assert len(scheduled) == 6
+
+    def test_existing_count_in_own_zone_consumes_budget(self):
+        """zone-a already has 2 matching pods, zone-b has 1: budget =
+        maxSkew(1) + min_other(1) - count_a(2) = 0 → nothing places."""
+        a = build_test_node("existing-a", cpu_m=10_000)
+        a.labels[ZONE] = "zone-a"
+        b = build_test_node("existing-b", cpu_m=10_000)
+        b.labels[ZONE] = "zone-b"
+        placed_pods = [
+            web_pod("a1"), web_pod("a2"), web_pod("b1"),
+        ]
+        cluster = ([a, b], placed_pods, [0, 0, 1])
+        pods = [web_pod(f"p{i}", constraints=(spread(max_skew=1),)) for i in range(4)]
+        count, scheduled = BinpackingNodeEstimator().estimate(
+            pods, zone_template(), cluster=cluster
+        )
+        assert scheduled == []
+        assert count == 0
+
+    def test_min_domains_forces_zero_min(self):
+        """Template-only world with minDomains=3: the single new-node domain
+        is below the threshold, min is 0, so the wave caps at maxSkew."""
+        pods = [
+            web_pod(f"p{i}", constraints=(spread(max_skew=2, min_domains=3),))
+            for i in range(6)
+        ]
+        count, scheduled = BinpackingNodeEstimator().estimate(pods, zone_template())
+        assert len(scheduled) == 2  # count+self-0 <= 2
+
+    def test_non_matching_constrained_pod_blocked_by_others(self):
+        """A pod carrying the constraint but NOT matching the selector
+        (selfMatch=0) is gated by counts alone."""
+        other = build_test_node("existing-b", cpu_m=10_000)
+        other.labels[ZONE] = "zone-b"
+        cluster = ([other], [], [])
+        # 1 matching pod fills the budget, then a non-matching constrained
+        # pod sees count(1) + 0 - min(0) = 1 <= 1 → it CAN place
+        pods = [
+            web_pod("match0", constraints=(spread(max_skew=1),)),
+            web_pod(
+                "other0",
+                constraints=(spread(max_skew=1),),
+                labels={"app": "other"},
+            ),
+        ]
+        count, scheduled = BinpackingNodeEstimator().estimate(
+            pods, zone_template(), cluster=cluster
+        )
+        assert {p.name for p in scheduled} == {"match0", "other0"}
+
+
+class TestHostnameSpreadWithinWave:
+    def test_static_zero_min_spreads_one_per_node(self):
+        """Cluster nodes with 0 matching pods pin the global min at 0, so a
+        maxSkew=1 hostname constraint forces one pod per scan-opened node."""
+        existing = [build_test_node(f"e{j}", cpu_m=10_000) for j in range(2)]
+        cluster = (existing, [], [])
+        pods = [
+            web_pod(f"p{i}", constraints=(spread(max_skew=1, key=HOSTNAME),))
+            for i in range(4)
+        ]
+        count, scheduled = BinpackingNodeEstimator().estimate(
+            pods, zone_template(), cluster=cluster
+        )
+        assert len(scheduled) == 4
+        assert count == 4  # one per node despite ample cpu
+        ref_count, ref_placed = serial_ffd_spread(
+            pods, zone_template(), 8, cluster
+        )
+        assert (count, len(scheduled)) == (ref_count, sum(ref_placed))
+
+    def test_template_only_piles_like_the_reference(self):
+        """No static domains: the first opened node is the only domain, its
+        count IS the min, skew never exceeds 1 — the sequential reference
+        piles onto node 0 too (verified by the oracle)."""
+        pods = [
+            web_pod(f"p{i}", constraints=(spread(max_skew=1, key=HOSTNAME),))
+            for i in range(4)
+        ]
+        count, scheduled = BinpackingNodeEstimator().estimate(pods, zone_template())
+        ref_count, ref_placed = serial_ffd_spread(pods, zone_template(), 8)
+        assert (count, len(scheduled)) == (ref_count, sum(ref_placed))
+        assert count == 1  # both pile — parity is the point
+
+
+class TestRunsPathParity:
+    def test_dedup_path_matches_per_pod_path(self):
+        """Spread-constrained pods force involvement (singleton runs); plain
+        pods still collapse. Both paths agree with each other and the
+        oracle."""
+        other = build_test_node("existing-b", cpu_m=10_000)
+        other.labels[ZONE] = "zone-b"
+        cluster = ([other], [], [])
+        pods = []
+        for i in range(4):
+            p = web_pod(f"s{i}", constraints=(spread(max_skew=2),))
+            p.owner_ref = OwnerRef(kind="ReplicaSet", name="web-rs")
+            pods.append(p)
+        for i in range(8):
+            p = build_test_pod(f"plain{i}", cpu_m=200, labels={"app": "db"})
+            p.owner_ref = OwnerRef(kind="ReplicaSet", name="db-rs")
+            pods.append(p)
+        est = BinpackingNodeEstimator()
+        many = est.estimate_many(
+            pods, {"g": zone_template()}, headrooms={"g": 10}, cluster=cluster
+        )
+        single = est.estimate(pods, zone_template(), cluster=cluster)
+        assert many["g"][0] == single[0]
+        assert {p.name for p in many["g"][1]} == {p.name for p in single[1]}
+        # budget: maxSkew(2) + min_b(0) - count_a(0) = 2 matching pods
+        assert sum(1 for p in many["g"][1] if p.name.startswith("s")) == 2
+        assert sum(1 for p in many["g"][1] if p.name.startswith("plain")) == 8
+
+
+class TestRandomizedOracleParity:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_random_worlds(self, seed):
+        rng = np.random.default_rng(2000 + seed)
+        template = zone_template(cpu=int(rng.integers(2000, 6000)))
+        # random static context
+        cl_nodes, cl_pods, cl_node_of = [], [], []
+        for j in range(int(rng.integers(0, 4))):
+            n = build_test_node(f"e{j}", cpu_m=8000)
+            n.labels[ZONE] = f"zone-{rng.choice(list('abc'))}"
+            cl_nodes.append(n)
+            for k in range(int(rng.integers(0, 3))):
+                q = build_test_pod(
+                    f"q{j}-{k}", cpu_m=100,
+                    labels={"app": str(rng.choice(["web", "db"]))},
+                )
+                cl_pods.append(q)
+                cl_node_of.append(j)
+        cluster = (cl_nodes, cl_pods, cl_node_of) if cl_nodes else None
+        pods = []
+        for i in range(int(rng.integers(4, 14))):
+            app = str(rng.choice(["web", "db"]))
+            cons = ()
+            if rng.random() < 0.7:
+                cons = (
+                    spread(
+                        max_skew=int(rng.integers(1, 3)),
+                        key=str(rng.choice([ZONE, HOSTNAME])),
+                        match={"app": app},
+                        min_domains=(
+                            int(rng.integers(1, 4)) if rng.random() < 0.4 else None
+                        ),
+                    ),
+                )
+            pods.append(
+                web_pod(
+                    f"p{i}",
+                    cpu=int(rng.integers(100, 1500)),
+                    constraints=cons,
+                    labels={"app": app},
+                )
+            )
+        count, scheduled = BinpackingNodeEstimator().estimate(
+            pods, template, cluster=cluster
+        )
+        ref_count, ref_placed = serial_ffd_spread(pods, template, 1000, cluster)
+        assert count == ref_count, f"seed {seed}: {count} vs oracle {ref_count}"
+        got = {p.name for p in scheduled}
+        want = {pods[i].name for i in range(len(pods)) if ref_placed[i]}
+        assert got == want, f"seed {seed}: {got ^ want}"

@@ -127,36 +127,301 @@ class TestSpreadFilter:
 
 
 def oracle_row(nodes, pods, node_of, i):
-    """Direct implementation of the filter rule for pod i (the serial
-    oracle the kernels are parity-locked against, SURVEY.md §7 #2)."""
+    """Direct implementation of the FULL plugin filter rule for pod i (the
+    serial oracle the kernels are parity-locked against, SURVEY.md §7 #2):
+    domain eligibility via nodeLabelsMatchSpreadConstraints + node inclusion
+    policies (common.go:46,289), matchLabelKeys selector extension
+    (common.go:99), minDomains (filtering.go:53), selfMatch (filtering.go:367)."""
+    from autoscaler_tpu.kube import objects as k8s
+
     pod = pods[i]
+    hard = [c for c in pod.topology_spread if c.when_unsatisfiable == "DoNotSchedule"]
     allowed = np.ones(len(nodes), bool)
-    for c in pod.topology_spread:
-        if c.when_unsatisfiable != "DoNotSchedule":
-            continue
+    all_keys = {c.topology_key for c in hard}
+    for c in hard:
+        sel_labels = dict(c.selector.match_labels)
+        for k in c.match_label_keys:
+            if k in pod.labels:
+                sel_labels[k] = pod.labels[k]
+        from autoscaler_tpu.kube.objects import LabelSelector as LS
+
+        sel = LS(
+            match_labels=tuple(sorted(sel_labels.items())),
+            match_expressions=c.selector.match_expressions,
+        )
+
+        def eligible(n):
+            if not all(k in n.labels for k in all_keys):
+                return False
+            if c.node_affinity_policy != "Ignore" and not k8s.node_matches_selector(pod, n):
+                return False
+            if c.node_taints_policy == "Honor" and not k8s.pod_tolerates_taints(pod, n.taints):
+                return False
+            return True
+
         values = {}
         for n in nodes:
-            v = n.labels.get(c.topology_key)
-            if v is not None:
-                values.setdefault(v, 0)
+            if eligible(n):
+                values.setdefault(n.labels[c.topology_key], 0)
         for q, j in zip(pods, node_of):
-            if q is pod or j < 0:
+            if q is pod or j < 0 or not eligible(nodes[j]):
                 continue
             v = nodes[j].labels.get(c.topology_key)
             if (
-                v is not None
+                v in values
                 and q.namespace == pod.namespace
-                and c.selector.matches(q.labels)
+                and q.deletion_ts is None
+                and sel.matches(q.labels)
             ):
                 values[v] += 1
         min_count = min(values.values()) if values else 0
+        if (c.min_domains or 1) > len(values):
+            min_count = 0
+        self_match = 1 if sel.matches(pod.labels) else 0
         for j, n in enumerate(nodes):
             v = n.labels.get(c.topology_key)
             if v is None:
                 allowed[j] = False
-            elif values[v] + 1 - min_count > c.max_skew:
+            elif values.get(v, 0) + self_match - min_count > c.max_skew:
                 allowed[j] = False
     return allowed
+
+
+class TestFullPluginSemantics:
+    """The details of PREDICATES.md divergence 2, now closed: minDomains,
+    node inclusion policies, matchLabelKeys, selfMatch."""
+
+    def test_min_domains_treats_min_as_zero(self):
+        # zones a=2, b=2 placed; 2 domains exist but minDomains=3 → global
+        # min is 0, so even the balanced domains fail maxSkew=1 at count 2
+        nodes, pods, node_of = zone_world((2, 2))
+        new = build_test_pod("new", cpu_m=100, labels={"app": "web"})
+        new.topology_spread = (
+            TopologySpreadConstraint(
+                max_skew=1, topology_key=ZONE,
+                selector=LabelSelector.from_dict({"app": "web"}),
+                min_domains=3,
+            ),
+        )
+        pods.append(new)
+        node_of.append(-1)
+        mask = compute_sched_mask(nodes, pods, node_of)
+        # counts+1-0 = 3 > 1 everywhere
+        assert list(mask[-1]) == [False, False]
+        np.testing.assert_array_equal(
+            mask[-1], oracle_row(nodes, pods, node_of, len(pods) - 1)
+        )
+
+    def test_min_domains_satisfied_restores_normal_min(self):
+        nodes, pods, node_of = zone_world((2, 2))
+        new = build_test_pod("new", cpu_m=100, labels={"app": "web"})
+        new.topology_spread = (
+            TopologySpreadConstraint(
+                max_skew=1, topology_key=ZONE,
+                selector=LabelSelector.from_dict({"app": "web"}),
+                min_domains=2,
+            ),
+        )
+        pods.append(new)
+        node_of.append(-1)
+        mask = compute_sched_mask(nodes, pods, node_of)
+        assert list(mask[-1]) == [True, True]  # 2+1-2 = 1 <= 1
+
+    def test_node_affinity_policy_honor_excludes_domains(self):
+        # zone-b node doesn't match the pod's nodeSelector → with the
+        # default Honor policy its domain doesn't exist for min/counts: the
+        # pod sees a single domain (a, count 1), min=1 → a allowed. The
+        # node itself is still unschedulable via the selector mask.
+        nodes, pods, node_of = zone_world((1, 0))
+        nodes[0].labels["disk"] = "ssd"
+        new = build_test_pod("new", cpu_m=100, labels={"app": "web"})
+        new.node_selector = {"disk": "ssd"}
+        new.topology_spread = (spread(max_skew=1),)
+        pods.append(new)
+        node_of.append(-1)
+        mask = compute_sched_mask(nodes, pods, node_of)
+        assert list(mask[-1]) == [True, False]
+        np.testing.assert_array_equal(
+            mask[-1],
+            oracle_row(nodes, pods, node_of, len(pods) - 1)
+            & np.array([True, False]),  # selector mask composes
+        )
+
+    def test_node_affinity_policy_ignore_keeps_domains(self):
+        # same world, policy Ignore: zone-b's empty domain counts → min=0,
+        # zone-a (count 1) now fails maxSkew=1... 1+1-0=2>1
+        nodes, pods, node_of = zone_world((1, 0))
+        nodes[0].labels["disk"] = "ssd"
+        new = build_test_pod("new", cpu_m=100, labels={"app": "web"})
+        new.node_selector = {"disk": "ssd"}
+        new.topology_spread = (
+            TopologySpreadConstraint(
+                max_skew=1, topology_key=ZONE,
+                selector=LabelSelector.from_dict({"app": "web"}),
+                node_affinity_policy="Ignore",
+            ),
+        )
+        pods.append(new)
+        node_of.append(-1)
+        mask = compute_sched_mask(nodes, pods, node_of)
+        assert list(mask[-1]) == [False, False]
+
+    def test_node_taints_policy_honor(self):
+        from autoscaler_tpu.kube.objects import Taint
+
+        nodes, pods, node_of = zone_world((1, 0))
+        nodes[1].taints.append(Taint("dedicated", "x", "NoSchedule"))
+        new = build_test_pod("new", cpu_m=100, labels={"app": "web"})
+        new.topology_spread = (
+            TopologySpreadConstraint(
+                max_skew=1, topology_key=ZONE,
+                selector=LabelSelector.from_dict({"app": "web"}),
+                node_taints_policy="Honor",
+            ),
+        )
+        pods.append(new)
+        node_of.append(-1)
+        mask = compute_sched_mask(nodes, pods, node_of)
+        # tainted zone-b excluded from domains → only a (count 1), min=1:
+        # a passes spread (taint mask blocks b independently)
+        assert mask[-1][0]
+        # default Ignore policy: b's empty domain registers, min=0 → a fails
+        new.topology_spread = (spread(max_skew=1),)
+        mask2 = compute_sched_mask(nodes, pods, node_of)
+        assert not mask2[-1][0]
+
+    def test_match_label_keys_scopes_to_own_revision(self):
+        # old-revision pods fill zone-a; a new-revision pod with
+        # matchLabelKeys=["rev"] ignores them (selector gains rev=v2)
+        nodes, pods, node_of = zone_world((0, 0))
+        for k in range(3):
+            p = build_test_pod(
+                f"old-{k}", cpu_m=100, labels={"app": "web", "rev": "v1"}
+            )
+            pods.append(p)
+            node_of.append(0)
+        new = build_test_pod(
+            "new", cpu_m=100, labels={"app": "web", "rev": "v2"}
+        )
+        new.topology_spread = (
+            TopologySpreadConstraint(
+                max_skew=1, topology_key=ZONE,
+                selector=LabelSelector.from_dict({"app": "web"}),
+                match_label_keys=("rev",),
+            ),
+        )
+        pods.append(new)
+        node_of.append(-1)
+        mask = compute_sched_mask(nodes, pods, node_of)
+        assert list(mask[-1]) == [True, True]  # v1 pods don't count
+        # without matchLabelKeys the v1 pile blocks zone-a
+        new.topology_spread = (spread(max_skew=1),)
+        mask2 = compute_sched_mask(nodes, pods, node_of)
+        assert list(mask2[-1]) == [False, True]
+
+    def test_self_match_zero_when_pod_misses_own_selector(self):
+        # a pod whose labels don't match its own constraint selector adds
+        # selfMatch=0 (filtering.go:367): balanced counts stay balanced
+        nodes, pods, node_of = zone_world((1, 1))
+        new = build_test_pod("new", cpu_m=100, labels={"app": "other"})
+        new.topology_spread = (spread(max_skew=1),)
+        pods.append(new)
+        node_of.append(-1)
+        mask = compute_sched_mask(nodes, pods, node_of)
+        assert list(mask[-1]) == [True, True]
+        np.testing.assert_array_equal(
+            mask[-1], oracle_row(nodes, pods, node_of, len(pods) - 1)
+        )
+
+    def test_terminating_pods_do_not_count(self):
+        nodes, pods, node_of = zone_world((2, 0))
+        pods[0].deletion_ts = 123.0  # one zone-a pod is terminating
+        new = build_test_pod("new", cpu_m=100, labels={"app": "web"})
+        new.topology_spread = (spread(max_skew=1),)
+        pods.append(new)
+        node_of.append(-1)
+        mask = compute_sched_mask(nodes, pods, node_of)
+        # effective counts a=1 b=0 → a fails (1+1-0=2), b ok
+        assert list(mask[-1]) == [False, True]
+
+
+class TestFullSemanticsOracleParity:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_worlds_with_all_knobs(self, seed):
+        from autoscaler_tpu.kube.objects import Taint
+
+        rng = np.random.default_rng(1000 + seed)
+        zones = [f"zone-{z}" for z in "abcd"[: rng.integers(2, 5)]]
+        nodes = []
+        for j in range(int(rng.integers(4, 10))):
+            n = build_test_node(f"n{j}", cpu_m=100_000)
+            if rng.random() < 0.85:
+                n.labels[ZONE] = str(rng.choice(zones))
+            if rng.random() < 0.3:
+                n.labels["disk"] = str(rng.choice(["ssd", "hdd"]))
+            if rng.random() < 0.25:
+                n.taints.append(Taint("dedicated", "x", "NoSchedule"))
+            nodes.append(n)
+        pods, node_of = [], []
+        apps = ["web", "db"]
+        for i in range(int(rng.integers(8, 20))):
+            app = str(rng.choice(apps))
+            labels = {"app": app, "rev": str(rng.choice(["v1", "v2"]))}
+            p = build_test_pod(f"p{i}", cpu_m=10, labels=labels)
+            if rng.random() < 0.3:
+                p.node_selector = {"disk": "ssd"}
+            if rng.random() < 0.2:
+                p.deletion_ts = 1.0
+            if rng.random() < 0.6:
+                p.topology_spread = (
+                    TopologySpreadConstraint(
+                        max_skew=int(rng.integers(1, 3)),
+                        topology_key=ZONE,
+                        selector=LabelSelector.from_dict({"app": app}),
+                        min_domains=(
+                            int(rng.integers(1, 5)) if rng.random() < 0.5 else None
+                        ),
+                        node_affinity_policy=str(
+                            rng.choice(["Honor", "Ignore"])
+                        ),
+                        node_taints_policy=str(
+                            rng.choice(["Honor", "Ignore"])
+                        ),
+                        match_label_keys=(
+                            ("rev",) if rng.random() < 0.5 else ()
+                        ),
+                    ),
+                )
+            pods.append(p)
+            node_of.append(
+                int(rng.integers(0, len(nodes))) if rng.random() < 0.6 else -1
+            )
+
+        mask = compute_sched_mask(nodes, pods, node_of)
+        fm = expand(
+            compute_factored_mask(nodes, pods, node_of), len(pods), len(nodes)
+        )
+        from autoscaler_tpu.kube import objects as k8s
+
+        for i, p in enumerate(pods):
+            if not p.topology_spread or node_of[i] >= 0:
+                continue
+            # spread oracle composes with the independent static predicates
+            static = np.array(
+                [
+                    k8s.node_matches_selector(p, n)
+                    and k8s.pod_tolerates_taints(p, n.taints)
+                    for n in nodes
+                ],
+                bool,
+            )
+            expected = oracle_row(nodes, pods, node_of, i) & static
+            np.testing.assert_array_equal(
+                mask[i], expected, err_msg=f"pod {i} dense seed {seed}"
+            )
+            np.testing.assert_array_equal(
+                fm[i], expected, err_msg=f"pod {i} factored seed {seed}"
+            )
 
 
 class TestOracleParity:

@@ -219,6 +219,31 @@ class TestVpaRunnerOverHttp:
         assert "default/hamster-vpa-hamster" in srv.checkpoints
         assert "default/ghost-vpa-web" not in srv.checkpoints
 
+    def test_cold_start_never_wipes_live_vpa_checkpoints(self, srv):
+        """GC keys on VPA existence, not model contents: a recommender that
+        failed its startup restore (empty model) must not delete persisted
+        checkpoints of VPAs that still exist."""
+        from autoscaler_tpu.vpa.kube_io import VpaCheckpointStore
+        from autoscaler_tpu.vpa.recommender import ClusterStateModel
+
+        client, api, pod_labels = self._world(srv)
+        # persisted state from a previous incarnation
+        srv.checkpoints["default/hamster-vpa-hamster"] = {
+            "metadata": {"name": "hamster-vpa-hamster", "namespace": "default"},
+            "spec": {"vpaObjectName": "hamster-vpa", "containerName": "hamster"},
+            "status": {"cpuHistogram": {"totalWeight": 5.0}},
+        }
+        runner = VpaRunner(
+            VpaKubeBinding(client), api, KubeMetricsSource(client, pod_labels),
+            checkpoint_store=VpaCheckpointStore(client),
+        )
+        # simulate the failed-restore cold start: empty model, no metrics
+        runner.model = ClusterStateModel()
+        runner.recommender.model = runner.model
+        srv.pod_metrics = []
+        runner.run_once(now_ts=1000.0)
+        assert "default/hamster-vpa-hamster" in srv.checkpoints  # survived
+
     def test_checkpoint_crd_absent_degrades(self, srv):
         from autoscaler_tpu.vpa.kube_io import VpaCheckpointStore
 
