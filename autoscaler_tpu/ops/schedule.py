@@ -23,17 +23,35 @@ class ScheduleResult(NamedTuple):
     dest: jax.Array     # [K] i32 node index, -1 when not placed
 
 
+BIG_I32 = jnp.int32(2**30)
+
+
 @jax.jit
 def greedy_schedule(
     snap: SnapshotTensors,
     pod_slots: jax.Array,  # [K] i32 pod indices to place, in priority order (-1 pad)
     hints: jax.Array,      # [K] i32 hinted node index per pod, -1 = no hint
+    spread: tuple | None = None,  # affinity.build_spread_schedule_context
 ) -> ScheduleResult:
     """Place pods onto existing nodes greedily, honoring hints. Capacity is
-    carried across placements; predicate mask comes from the snapshot."""
+    carried across placements; the static predicate mask comes from the
+    snapshot, and hard topology-spread re-counts PER PLACEMENT when the
+    spread context is provided — pods placed earlier in this wave raise
+    their domain's count for later pods, exactly as the reference's
+    hinting simulator observes through the scheduler framework
+    (hinting_simulator.go:58 → PodTopologySpread filtering.go:339). This
+    closes the last within-wave spread divergence (PREDICATES.md 2)."""
     free0 = snap.free()
+    if spread is not None:
+        (sp_of_T, sp_match_T, node_dom, sp_elig, dom_valid,
+         static_counts, skew, min_dom, domnum) = spread
+        S, D = static_counts.shape
+        delta0 = jnp.zeros((S, D), jnp.int32)
+    else:
+        delta0 = jnp.zeros((1, 1), jnp.int32)
 
-    def step(free, inp):
+    def step(carry, inp):
+        free, delta = carry
         pod_idx, hint = inp
         valid = pod_idx >= 0
         safe = jnp.maximum(pod_idx, 0)
@@ -43,13 +61,41 @@ def greedy_schedule(
             & snap.sched_row(safe)
             & snap.node_valid
         )
+        if spread is not None:
+            o = sp_of_T[safe]                               # [S]
+            m = sp_match_T[safe]                            # [S]
+            cnt = static_counts + delta                     # [S, D]
+            minv = jnp.min(jnp.where(dom_valid, cnt, BIG_I32), axis=1)
+            min_eff = jnp.where(min_dom > domnum, 0, minv)  # [S]
+            dom_safe = jnp.maximum(node_dom, 0)             # [S, N]
+            cnt_node = jnp.take_along_axis(cnt, dom_safe, axis=1)
+            reg_node = (
+                jnp.take_along_axis(dom_valid, dom_safe, axis=1)
+                & (node_dom >= 0)
+            )
+            cnt_node = jnp.where(reg_node, cnt_node, 0)
+            ok_sp = (node_dom >= 0) & (
+                cnt_node + m.astype(jnp.int32)[:, None] - min_eff[:, None]
+                <= skew[:, None]
+            )
+            ok &= ~(o[:, None] & ~ok_sp).any(axis=0)
         hint_ok = (hint >= 0) & ok[jnp.maximum(hint, 0)]
         first = jnp.argmax(ok).astype(jnp.int32)
         dest = jnp.where(hint_ok, hint, jnp.where(ok.any(), first, -1))
         place = valid & (dest >= 0)
         target = jnp.maximum(dest, 0)
         free = free.at[target].add(jnp.where(place, -req, jnp.zeros_like(req)))
-        return free, (place, jnp.where(place, dest, -1))
+        if spread is not None:
+            # counts move only for matching pods landing on nodes ELIGIBLE
+            # for the term (countPodsMatchSelector runs over eligible nodes)
+            dom_t = node_dom[:, target]                     # [S]
+            upd = (
+                m & place & (dom_t >= 0) & sp_elig[:, target]
+            ).astype(jnp.int32)
+            delta = delta.at[
+                jnp.arange(delta.shape[0]), jnp.maximum(dom_t, 0)
+            ].add(upd)
+        return (free, delta), (place, jnp.where(place, dest, -1))
 
-    _, (placed, dest) = jax.lax.scan(step, free0, (pod_slots, hints))
+    _, (placed, dest) = jax.lax.scan(step, (free0, delta0), (pod_slots, hints))
     return ScheduleResult(placed=placed, dest=dest)

@@ -64,6 +64,12 @@ class TpuSimulationServicer:
         )
 
     def TrySchedule(self, request: pb.TryScheduleRequest, context) -> pb.TryScheduleResponse:
+        """Raw greedy kernel over packed tensors. NOTE: this RPC exposes the
+        kernel WITHOUT a topology-spread context (the wire format carries
+        dense tensors, not the object model the context is derived from), so
+        within-wave spread re-counting does not apply here — remote callers
+        needing it should drive the host-side HintingSimulator instead
+        (PREDICATES.md divergence 2, RPC-surface note)."""
         import jax.numpy as jnp
 
         from autoscaler_tpu.ops.schedule import greedy_schedule

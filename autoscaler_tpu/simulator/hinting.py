@@ -65,7 +65,20 @@ class HintingSimulator:
             hinted = self.hints.get(pod.key())
             if hinted is not None and hinted in meta.node_index:
                 hint_idx[i] = meta.node_index[hinted]
-        res = greedy_schedule(tensors, jnp.asarray(slots), jnp.asarray(hint_idx))
+        # within-wave topology spread: placements in THIS wave raise their
+        # domain's count for later pods (PREDICATES.md divergence 2, closed)
+        from autoscaler_tpu.snapshot.affinity import build_spread_schedule_context
+
+        placed_pods = [p for p in meta.pods if p.node_name]
+        node_of = [meta.node_index.get(p.node_name, -1) for p in placed_pods]
+        spread_ctx = build_spread_schedule_context(
+            pods, meta.nodes, placed_pods, node_of,
+            meta.pod_index, int(tensors.pod_req.shape[0]),
+            num_node_cols=int(tensors.node_valid.shape[0]),
+        )
+        res = greedy_schedule(
+            tensors, jnp.asarray(slots), jnp.asarray(hint_idx), spread=spread_ctx
+        )
         placed = np.asarray(res.placed)
         dest = np.asarray(res.dest)
 

@@ -214,6 +214,166 @@ def _spread_effective_selector(c, pod: Pod):
     )
 
 
+def _intern_spread_terms(pods: Sequence[Pod], with_sig: bool):
+    """Shared DoNotSchedule-constraint interning for the template-world
+    builder (build_spread_terms) and the existing-nodes schedule context
+    (build_spread_schedule_context) — ONE definition of term identity:
+    (topology_key, effective selector incl. matchLabelKeys, namespace,
+    maxSkew, minDomains, inclusion policies, and — when static context is
+    judged with the declarer's filters — the eligibility signature incl.
+    the pod's full constraint-key set).
+    → (term_list [(c, sel, ns, declarer, all_keys)], decls [(pod_idx, t)])."""
+    term_index: Dict[Tuple, int] = {}
+    term_list: List[Tuple] = []
+    decls: List[Tuple[int, int]] = []
+    for i, pod in enumerate(pods):
+        all_keys = frozenset(
+            c.topology_key
+            for c in pod.topology_spread
+            if c.when_unsatisfiable == "DoNotSchedule"
+        )
+        for c in pod.topology_spread:
+            if c.when_unsatisfiable != "DoNotSchedule":
+                continue
+            sel = _spread_effective_selector(c, pod)
+            sig: Tuple = ()
+            if with_sig:
+                sig = (
+                    tuple(sorted(pod.node_selector.items())),
+                    repr(pod.affinity.node_selector_terms) if pod.affinity else "",
+                    tuple(
+                        (t.key, t.operator, t.value, t.effect)
+                        for t in pod.tolerations
+                    ),
+                    all_keys,
+                )
+            key = (
+                c.topology_key, sel, pod.namespace, c.max_skew,
+                c.min_domains or 1, c.node_affinity_policy,
+                c.node_taints_policy, sig,
+            )
+            t = term_index.get(key)
+            if t is None:
+                t = term_index[key] = len(term_list)
+                term_list.append((c, sel, pod.namespace, pod, all_keys))
+            decls.append((i, t))
+    return term_list, decls
+
+
+def _spread_node_eligible(c, all_keys, declarer: Pod, node: Node) -> bool:
+    """nodeLabelsMatchSpreadConstraints + node inclusion policies
+    (common.go:289 + :46) for one (term, node), judged with the DECLARING
+    pod's filters. A node missing ANY of the pod's constraint keys
+    (including a hostname key) contributes no counts for any of them."""
+    from autoscaler_tpu.kube import objects as k8s
+
+    if not all(k in node.labels for k in all_keys):
+        return False
+    if c.node_affinity_policy != "Ignore" and not k8s.node_matches_selector(
+        declarer, node
+    ):
+        return False
+    if c.node_taints_policy == "Honor" and not k8s.pod_tolerates_taints(
+        declarer, node.taints
+    ):
+        return False
+    return True
+
+
+def build_spread_schedule_context(
+    pending: Sequence[Pod],
+    nodes: Sequence[Node],
+    placed_pods: Sequence[Pod],
+    node_of: Sequence[int],
+    pod_index: Dict[str, int],
+    num_pod_rows: int,
+    num_node_cols: int | None = None,
+):
+    """Spread context for ops/schedule.greedy_schedule — domains over the
+    EXISTING node set (the hinting path), vs build_spread_terms' template
+    world. → 9-array tuple or None when no pending pod carries a hard
+    constraint. Same term interning (effective selector incl. matchLabelKeys,
+    namespace, policies + eligibility signature); per-term arrays:
+
+    - node_dom [S, N]: node's domain id by LABEL (Filter judges any labeled
+      node, even policy-ineligible ones — TpPairToMatchNum miss → count 0)
+    - sp_elig [S, N]: node passes the term's inclusion policies AND carries
+      all the declaring pod's constraint keys (count contribution gate)
+    - dom_valid [S, D]: domain registered by at least one eligible node
+    - static_counts [S, D]: matching placed pods on eligible nodes
+    """
+    if not has_hard_spread(pending):
+        return None
+    import numpy as _np
+
+    term_list, idx_decls = _intern_spread_terms(pending, with_sig=True)
+    decls = [(pod_index[pending[i].key()], t) for i, t in idx_decls]
+
+    S_real = len(term_list)
+    S = bucket_size(S_real, minimum=4)
+    N = len(nodes)
+    NN = max(num_node_cols if num_node_cols is not None else N, N, 1)
+    sp_of = _np.zeros((num_pod_rows, S), bool)
+    sp_match = _np.zeros((num_pod_rows, S), bool)
+    # padded node columns stay -1 (no domain) / ineligible
+    node_dom = _np.full((S, NN), -1, _np.int32)
+    sp_elig = _np.zeros((S, NN), bool)
+    skew = _np.zeros((S,), _np.int32)
+    min_dom = _np.ones((S,), _np.int32)
+    domnum = _np.zeros((S,), _np.int32)
+    doms_per_term: List[Dict[str, int]] = []
+    for t, (c, sel, ns, declarer, all_keys) in enumerate(term_list):
+        skew[t] = c.max_skew
+        min_dom[t] = c.min_domains or 1
+        dom_ids: Dict[str, int] = {}
+        for j, n in enumerate(nodes):
+            val = n.labels.get(c.topology_key)
+            if val is None:
+                continue
+            node_dom[t, j] = dom_ids.setdefault(val, len(dom_ids))
+            sp_elig[t, j] = _spread_node_eligible(c, all_keys, declarer, n)
+        doms_per_term.append(dom_ids)
+    D = bucket_size(max((len(d) for d in doms_per_term), default=1), minimum=8)
+    dom_valid = _np.zeros((S, D), bool)
+    static_counts = _np.zeros((S, D), _np.int32)
+    for t in range(S_real):
+        for j in range(N):
+            if sp_elig[t, j] and node_dom[t, j] >= 0:
+                dom_valid[t, node_dom[t, j]] = True
+        domnum[t] = int(dom_valid[t].sum())
+    for t, (c, sel, ns, _declarer, _keys) in enumerate(term_list):
+        for q, j in zip(placed_pods, node_of):
+            if (
+                j >= 0
+                and sp_elig[t, j]
+                and node_dom[t, j] >= 0
+                and q.namespace == ns
+                and q.deletion_ts is None
+                and sel.matches(q.labels)
+            ):
+                static_counts[t, node_dom[t, j]] += 1
+    for pod_row, t in decls:
+        sp_of[pod_row, t] = True
+    for t, (c, sel, ns, _declarer, _keys) in enumerate(term_list):
+        for p in pending:
+            if p.namespace == ns and sel.matches(p.labels):
+                sp_match[pod_index[p.key()], t] = True
+
+    import jax.numpy as jnp
+
+    return (
+        jnp.asarray(sp_of),
+        jnp.asarray(sp_match),
+        jnp.asarray(node_dom),
+        jnp.asarray(sp_elig),
+        jnp.asarray(dom_valid),
+        jnp.asarray(static_counts),
+        jnp.asarray(skew),
+        jnp.asarray(min_dom),
+        jnp.asarray(domnum),
+    )
+
+
 def build_spread_terms(
     pods: Sequence[Pod],
     templates: Sequence[Node],
@@ -229,41 +389,7 @@ def build_spread_terms(
     context depends on the declaring pod's own node filters (Honor
     policies with a cluster) intern per eligibility signature, so pods with
     different selectors/tolerations get their own static rows."""
-    from autoscaler_tpu.kube import objects as k8s
-
-    term_index: Dict[Tuple, int] = {}
-    term_list: List[Tuple] = []  # (constraint, eff_selector, ns, elig_sig_pod)
-    decls: List[Tuple[int, int]] = []
-
-    def _elig_sig(pod: Pod):
-        if cluster is None:
-            return ()
-        return (
-            tuple(sorted(pod.node_selector.items())),
-            repr(pod.affinity.node_selector_terms) if pod.affinity else "",
-            tuple(
-                (t.key, t.operator, t.value, t.effect) for t in pod.tolerations
-            ),
-        )
-
-    for i, pod in enumerate(pods):
-        for c in pod.topology_spread:
-            if c.when_unsatisfiable != "DoNotSchedule":
-                continue
-            sel = _spread_effective_selector(c, pod)
-            sig = _elig_sig(pod) if (
-                c.node_affinity_policy != "Ignore" or c.node_taints_policy == "Honor"
-            ) else ()
-            key = (
-                c.topology_key, sel, pod.namespace, c.max_skew,
-                c.min_domains or 1, c.node_affinity_policy,
-                c.node_taints_policy, sig,
-            )
-            t = term_index.get(key)
-            if t is None:
-                t = term_index[key] = len(term_list)
-                term_list.append((c, sel, pod.namespace, pod))
-            decls.append((i, t))
+    term_list, decls = _intern_spread_terms(pods, with_sig=cluster is not None)
 
     S = len(term_list)
     SS = bucket_size(S, minimum=4) if bucket_terms else max(S, 1)
@@ -287,7 +413,7 @@ def build_spread_terms(
 
     for i, t in decls:
         out.sp_of[t, i] = True
-    for t, (c, sel, ns, _declarer) in enumerate(term_list):
+    for t, (c, sel, ns, _declarer, _keys) in enumerate(term_list):
         out.node_level[t] = c.topology_key == HOSTNAME_KEY
         out.max_skew[t] = c.max_skew
         out.min_domains[t] = c.min_domains or 1
@@ -307,22 +433,18 @@ def build_spread_terms(
         return out
 
     cl_nodes, cl_pods, cl_node_of = cluster
-    for t, (c, sel, ns, declarer) in enumerate(term_list):
+    for t, (c, sel, ns, declarer, all_keys) in enumerate(term_list):
         key = c.topology_key
         # eligibility of existing nodes for this term, judged with the
-        # declaring pod's filters (all same-sig pods share the verdicts)
-        eligible = []
-        for n in cl_nodes:
-            ok = key in n.labels or out.node_level[t]
-            if ok and c.node_affinity_policy != "Ignore":
-                ok = k8s.node_matches_selector(declarer, n)
-            if ok and c.node_taints_policy == "Honor":
-                ok = k8s.pod_tolerates_taints(declarer, n.taints)
-            eligible.append(ok)
+        # declaring pod's filters (all same-sig pods share the verdicts) —
+        # shared rule: ALL the pod's constraint keys must be present
+        # (hostname included: domains come from the LABEL, matching the
+        # packer and the schedule context, not the node name)
+        eligible = [
+            _spread_node_eligible(c, all_keys, declarer, n) for n in cl_nodes
+        ]
         dom_of = [
-            (n.labels.get(key) if not out.node_level[t] else n.name)
-            if eligible[j]
-            else None
+            n.labels.get(key) if eligible[j] else None
             for j, n in enumerate(cl_nodes)
         ]
         counts: Dict[str, int] = {}

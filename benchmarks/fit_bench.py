@@ -1,0 +1,86 @@
+"""Tiled predicate-fit benchmark at the huge-cluster shape.
+
+Measures ops/pallas_fit.pallas_fit_reduce over 100k pods × 15k nodes
+(1.5G pairs) — the long-context analog of the snapshot scaling axis
+(SURVEY.md §5): the (pods × nodes) matrix is tiled with an online in-kernel
+reduction, never materialized (the same blockwise trick as ring/blockwise
+attention). Parity vs the dense numpy oracle is asserted on a subsample
+each run; prints one JSON line.
+
+Run on the TPU: python benchmarks/fit_bench.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from autoscaler_tpu.ops.pallas_fit import (
+        pallas_fit_reduce,
+        reference_fit_reduce,
+    )
+
+    P, N, R = 100_000, 15_000, 6
+    rng = np.random.default_rng(0)
+    req = np.zeros((P, R), np.float32)
+    req[:, 0] = rng.integers(50, 2000, P)
+    req[:, 1] = rng.integers(64, 8192, P)
+    req[:, 3] = 1
+    free = np.zeros((N, R), np.float32)
+    free[:, 0] = rng.integers(0, 16000, N)
+    free[:, 1] = rng.integers(0, 32768, N)
+    free[:, 3] = 110
+    CP, CN = 40, 24
+    pod_class = rng.integers(0, CP, P).astype(np.int32)
+    node_class = rng.integers(0, CN, N).astype(np.int32)
+    class_mask = rng.random((CP, CN)) > 0.1
+    node_valid = np.ones(N, bool)
+    args = [
+        jnp.asarray(x)
+        for x in (req, free, pod_class, node_class, class_mask, node_valid)
+    ]
+
+    out = pallas_fit_reduce(*args)
+    np.asarray(out.fit_count)  # compile + sync (block_until_ready is
+    # unreliable through the axon relay — sync via host fetch)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = pallas_fit_reduce(*args)
+        a = np.asarray(out.any_fit)
+        c = np.asarray(out.fit_count)
+        f = np.asarray(out.first_fit)
+        times.append(time.perf_counter() - t0)
+
+    sub = 2000
+    ra, rc, rf = reference_fit_reduce(
+        req[:sub], free, pod_class[:sub], node_class, class_mask, node_valid
+    )
+    parity = bool(
+        (a[:sub] == ra).all() and (c[:sub] == rc).all() and (f[:sub] == rf).all()
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "pallas_fit_reduce_100kpods_15knodes",
+                "seconds": round(float(np.median(times)), 4),
+                "pairs": P * N,
+                "platform": jax.default_backend(),
+                "parity_subsample": parity,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
