@@ -45,13 +45,28 @@ class RemovalFeasibility(NamedTuple):
     moved_counts: jax.Array  # [C] i32 — pods that found a new home
 
 
-def _place_pod_step(snap: SnapshotTensors, excluded: jax.Array):
+BIG_I32 = jnp.int32(2**30)
+
+
+def _place_pod_step(snap: SnapshotTensors, excluded: jax.Array, spread=None):
     """Shared greedy-placement scan step: place one movable pod onto the
     first allowed node (capacity + static mask + validity − excluded),
     updating the free-capacity carry. Used by both the per-candidate and the
-    joint feasibility kernels so their placement semantics cannot drift."""
+    joint feasibility kernels so their placement semantics cannot drift.
 
-    def step(free, pod_idx):
+    `spread` (affinity.build_spread_schedule_context minus static counts —
+    the counts travel in the carry, per-candidate adjusted) makes hard
+    topology-spread re-count per re-placement, the reference's findPlaceFor
+    → TrySchedulePods behavior (cluster.go:220): moved pods leave the
+    drained node's domain (the caller subtracts their static contribution)
+    and raise their destination's counts for later moved pods. The carry is
+    (free [N, R], counts [S, D])."""
+    if spread is not None:
+        (sp_of_T, sp_match_T, node_dom, sp_elig, dom_valid,
+         skew, min_dom, domnum) = spread
+
+    def step(carry, pod_idx):
+        free, counts = carry
         valid_pod = pod_idx >= 0
         safe_idx = jnp.maximum(pod_idx, 0)
         req = snap.pod_req[safe_idx]
@@ -61,13 +76,38 @@ def _place_pod_step(snap: SnapshotTensors, excluded: jax.Array):
             & snap.node_valid
             & ~excluded
         )
+        if spread is not None:
+            o = sp_of_T[safe_idx]                           # [S]
+            m = sp_match_T[safe_idx]                        # [S]
+            minv = jnp.min(jnp.where(dom_valid, counts, BIG_I32), axis=1)
+            min_eff = jnp.where(min_dom > domnum, 0, minv)  # [S]
+            dom_safe = jnp.maximum(node_dom, 0)             # [S, N]
+            cnt_node = jnp.take_along_axis(counts, dom_safe, axis=1)
+            reg_node = (
+                jnp.take_along_axis(dom_valid, dom_safe, axis=1)
+                & (node_dom >= 0)
+            )
+            cnt_node = jnp.where(reg_node, cnt_node, 0)
+            ok_sp = (node_dom >= 0) & (
+                cnt_node + m.astype(jnp.int32)[:, None] - min_eff[:, None]
+                <= skew[:, None]
+            )
+            ok &= ~(o[:, None] & ~ok_sp).any(axis=0)
         has = ok.any()
         dest = jnp.where(has, jnp.argmax(ok).astype(jnp.int32), -1)
         place = valid_pod & has
         target = jnp.maximum(dest, 0)
         free = free.at[target].add(jnp.where(place, -req, jnp.zeros_like(req)))
+        if spread is not None:
+            dom_t = node_dom[:, target]                     # [S]
+            upd = (
+                m & place & (dom_t >= 0) & sp_elig[:, target]
+            ).astype(jnp.int32)
+            counts = counts.at[
+                jnp.arange(counts.shape[0]), jnp.maximum(dom_t, 0)
+            ].add(upd)
         placed_needed = jnp.where(valid_pod, place, True)
-        return free, (jnp.where(valid_pod, dest, -1), placed_needed, place)
+        return (free, counts), (jnp.where(valid_pod, dest, -1), placed_needed, place)
 
     return step
 
@@ -85,19 +125,63 @@ def removal_feasibility(
     (respecting current free capacity and the precomputed predicate mask),
     greedily in slot order with capacity updates between placements — the
     findPlaceFor semantics (cluster.go:220)."""
-    free0 = snap.free()  # [N, R]
+    return _removal_feasibility_impl(
+        snap, candidate_nodes, pod_slots, blocked, None, None, None
+    )
 
-    def lane(j, slots, lane_blocked):
+
+@functools.partial(jax.jit, static_argnames=())
+def removal_feasibility_spread(
+    snap: SnapshotTensors,
+    candidate_nodes: jax.Array,
+    pod_slots: jax.Array,
+    blocked: jax.Array,
+    spread: tuple,          # 8-array context (no static counts)
+    static_counts: jax.Array,  # [S, D] live counts over ALL placed pods
+    cand_sub: jax.Array,       # [C, S] candidate's movable matching pods
+) -> RemovalFeasibility:
+    """removal_feasibility with within-refit topology-spread re-counting:
+    each lane starts from the live counts minus the candidate's own movable
+    matching pods (the reference removes them from the forked snapshot
+    before findPlaceFor) and carries placements' deltas."""
+    return _removal_feasibility_impl(
+        snap, candidate_nodes, pod_slots, blocked, spread, static_counts,
+        cand_sub,
+    )
+
+
+def _removal_feasibility_impl(
+    snap, candidate_nodes, pod_slots, blocked, spread, static_counts, cand_sub
+):
+    free0 = snap.free()  # [N, R]
+    if spread is not None:
+        node_dom, sp_elig, dom_valid = spread[2], spread[3], spread[4]
+
+    def lane(j, slots, lane_blocked, sub):
         exclude = jnp.arange(snap.num_nodes) == j
         # The drained node's capacity is not a destination: zero its free row.
         free_start = jnp.where(exclude[:, None], 0.0, free0)
-        _, (dests, placed_ok, placed) = jax.lax.scan(
-            _place_pod_step(snap, exclude), free_start, slots
+        if spread is not None:
+            # counts minus the candidate's movable matching pods, at the
+            # candidate's domain (only where it was eligible to count)
+            dom_j = node_dom[:, j]                           # [S]
+            gate = (dom_j >= 0) & sp_elig[:, j]
+            counts0 = static_counts.at[
+                jnp.arange(static_counts.shape[0]), jnp.maximum(dom_j, 0)
+            ].add(-jnp.where(gate, sub, 0))
+        else:
+            counts0 = jnp.zeros((1, 1), jnp.int32)
+        (_, _), (dests, placed_ok, placed) = jax.lax.scan(
+            _place_pod_step(snap, exclude, spread), (free_start, counts0), slots
         )
         feasible = placed_ok.all() & ~lane_blocked
         return feasible, dests, placed.sum().astype(jnp.int32)
 
-    return RemovalFeasibility(*jax.vmap(lane)(candidate_nodes, pod_slots, blocked))
+    if cand_sub is None:
+        cand_sub = jnp.zeros((candidate_nodes.shape[0],), jnp.int32)
+    return RemovalFeasibility(
+        *jax.vmap(lane)(candidate_nodes, pod_slots, blocked, cand_sub)
+    )
 
 
 @functools.partial(jax.jit, static_argnames=())
@@ -120,24 +204,70 @@ def joint_removal_feasibility(
     carry; a candidate that no longer fits is reported infeasible and its
     trial placements are rolled back (later candidates see the state as if
     it stayed)."""
-    free0 = snap.free()  # [N, R]
+    return _joint_impl(snap, candidate_nodes, pod_slots, excluded, None, None, None)
 
-    def cand_step(free, slots):
-        trial_free, (dests, placed_ok, placed) = jax.lax.scan(
-            _place_pod_step(snap, excluded), free, slots
+
+@functools.partial(jax.jit, static_argnames=())
+def joint_removal_feasibility_spread(
+    snap: SnapshotTensors,
+    candidate_nodes: jax.Array,
+    pod_slots: jax.Array,
+    excluded: jax.Array,
+    spread: tuple,
+    static_counts: jax.Array,  # [S, D]
+    cand_sub: jax.Array,       # [C, S]
+) -> RemovalFeasibility:
+    """joint_removal_feasibility with within-plan spread re-counting: the
+    counts carry is SHARED across candidates in pick order (as the
+    reference's sequential set re-simulation is), each candidate first
+    dropping its own movable matching pods from its domain; infeasible
+    candidates roll back both capacity and counts."""
+    return _joint_impl(
+        snap, candidate_nodes, pod_slots, excluded, spread, static_counts,
+        cand_sub,
+    )
+
+
+def _joint_impl(snap, candidate_nodes, pod_slots, excluded, spread,
+                static_counts, cand_sub):
+    free0 = snap.free()  # [N, R]
+    if spread is not None:
+        node_dom, sp_elig = spread[2], spread[3]
+
+    def cand_step(carry, xs):
+        free, counts = carry
+        slots, j, sub = xs
+        if spread is not None:
+            dom_j = node_dom[:, j]
+            gate = (dom_j >= 0) & sp_elig[:, j]
+            counts_in = counts.at[
+                jnp.arange(counts.shape[0]), jnp.maximum(dom_j, 0)
+            ].add(-jnp.where(gate, sub, 0))
+        else:
+            counts_in = counts
+        (trial_free, trial_counts), (dests, placed_ok, placed) = jax.lax.scan(
+            _place_pod_step(snap, excluded, spread), (free, counts_in), slots
         )
         feasible = placed_ok.all()
         # commit this candidate's placements only if the whole node drains
         free = jnp.where(feasible, trial_free, free)
+        counts = jnp.where(feasible, trial_counts, counts)
         moved = jnp.where(feasible, placed.sum(), 0).astype(jnp.int32)
-        return free, (feasible, jnp.where(feasible, dests, -1), moved)
+        return (free, counts), (feasible, jnp.where(feasible, dests, -1), moved)
 
     # zero the free rows of every to-be-deleted node so nothing lands there;
-    # candidate_nodes fixes the row order of pod_slots (each candidate's own
-    # row is already in `excluded`, set by the caller)
-    del candidate_nodes
+    # each candidate's own row is already in `excluded`, set by the caller
     free_start = jnp.where(excluded[:, None], 0.0, free0)
-    _, (feasible, dests, moved) = jax.lax.scan(cand_step, free_start, pod_slots)
+    if spread is not None:
+        counts_start = static_counts
+        sub_xs = cand_sub
+    else:
+        counts_start = jnp.zeros((1, 1), jnp.int32)
+        sub_xs = jnp.zeros((pod_slots.shape[0],), jnp.int32)
+    (_, _), (feasible, dests, moved) = jax.lax.scan(
+        cand_step, (free_start, counts_start),
+        (pod_slots, candidate_nodes, sub_xs),
+    )
     return RemovalFeasibility(
         feasible=feasible, destinations=dests, moved_counts=moved
     )

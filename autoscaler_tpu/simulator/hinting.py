@@ -67,15 +67,9 @@ class HintingSimulator:
                 hint_idx[i] = meta.node_index[hinted]
         # within-wave topology spread: placements in THIS wave raise their
         # domain's count for later pods (PREDICATES.md divergence 2, closed)
-        from autoscaler_tpu.snapshot.affinity import build_spread_schedule_context
+        from autoscaler_tpu.snapshot.affinity import build_spread_context_from_meta
 
-        placed_pods = [p for p in meta.pods if p.node_name]
-        node_of = [meta.node_index.get(p.node_name, -1) for p in placed_pods]
-        spread_ctx = build_spread_schedule_context(
-            pods, meta.nodes, placed_pods, node_of,
-            meta.pod_index, int(tensors.pod_req.shape[0]),
-            num_node_cols=int(tensors.node_valid.shape[0]),
-        )
+        spread_ctx = build_spread_context_from_meta(pods, meta, tensors)
         res = greedy_schedule(
             tensors, jnp.asarray(slots), jnp.asarray(hint_idx), spread=spread_ctx
         )

@@ -15,7 +15,12 @@ import numpy as np
 
 from autoscaler_tpu.kube.objects import Node, Pod, PodDisruptionBudget
 from autoscaler_tpu.ops.scaledown import empty_nodes as empty_nodes_kernel
-from autoscaler_tpu.ops.scaledown import joint_removal_feasibility, removal_feasibility
+from autoscaler_tpu.ops.scaledown import (
+    joint_removal_feasibility,
+    joint_removal_feasibility_spread,
+    removal_feasibility,
+    removal_feasibility_spread,
+)
 from autoscaler_tpu.simulator.drain import (
     BlockingPod,
     DrainabilityRules,
@@ -59,6 +64,44 @@ class UnremovableNode:
     node: Node
     reason: UnremovableReason
     blocking_pod: Optional[BlockingPod] = None
+
+
+
+def _spread_refit_context(meta, tensors, moving_pods):
+    """→ (spread8, static_counts, sp_match_np) or (None, None, None): the
+    within-refit topology-spread context. Static counts cover ALL placed
+    pods (candidates' movable pods included — the kernels subtract each
+    candidate's own contribution, matching findPlaceFor's remove-then-place
+    order, cluster.go:220)."""
+    from autoscaler_tpu.snapshot.affinity import (
+        build_spread_context_from_meta,
+        has_hard_spread,
+    )
+
+    if not has_hard_spread(moving_pods):
+        return None, None, None
+    ctx = build_spread_context_from_meta(moving_pods, meta, tensors)
+    if ctx is None:
+        return None, None, None
+    (sp_of, sp_match, node_dom, sp_elig, dom_valid,
+     static_counts, skew, min_dom, domnum) = ctx
+    spread8 = (sp_of, sp_match, node_dom, sp_elig, dom_valid,
+               skew, min_dom, domnum)
+    return spread8, static_counts, np.asarray(sp_match)
+
+
+def _cand_sub_matrix(sp_match_np, meta, pods_per_cand):
+    """[C, S] — per candidate, how many of its moving pods match each term.
+    Terminating movers are EXCLUDED: static_counts never counted them
+    (countPodsMatchSelector skips deletion-stamped pods, #87621), so
+    subtracting them would drive the domain count negative and over-admit."""
+    S = sp_match_np.shape[1]
+    out = np.zeros((len(pods_per_cand), S), np.int32)
+    for ci, pods in enumerate(pods_per_cand):
+        for p in pods:
+            if p.deletion_ts is None:
+                out[ci] += sp_match_np[meta.pod_index[p.key()]]
+    return out
 
 
 class RemovalSimulator:
@@ -130,12 +173,30 @@ class RemovalSimulator:
             if len(to_move) > S:
                 blocked[ci] = True  # too many pods to evaluate — conservative
 
-        res = removal_feasibility(
-            tensors,
-            jnp.asarray(cand_idx),
-            jnp.asarray(pod_slots),
-            jnp.asarray(blocked),
+        all_moving = [p for pods in movable_pods.values() for p in pods]
+        spread8, static_counts, sp_match_np = _spread_refit_context(
+            meta, tensors, all_moving
         )
+        if spread8 is not None:
+            pods_per_cand = [
+                movable_pods.get(name, [])[:S] for name in cand_names
+            ]
+            res = removal_feasibility_spread(
+                tensors,
+                jnp.asarray(cand_idx),
+                jnp.asarray(pod_slots),
+                jnp.asarray(blocked),
+                spread8,
+                static_counts,
+                jnp.asarray(_cand_sub_matrix(sp_match_np, meta, pods_per_cand)),
+            )
+        else:
+            res = removal_feasibility(
+                tensors,
+                jnp.asarray(cand_idx),
+                jnp.asarray(pod_slots),
+                jnp.asarray(blocked),
+            )
         feasible = np.asarray(res.feasible)
         dests = np.asarray(res.destinations)
 
@@ -216,12 +277,28 @@ class RemovalSimulator:
             for si, pod in enumerate(r.pods_to_reschedule[:S]):
                 pod_slots[ci, si] = meta.pod_index[pod.key()]
 
-        res = joint_removal_feasibility(
-            tensors,
-            jnp.asarray(cand_idx),
-            jnp.asarray(pod_slots),
-            jnp.asarray(excluded),
+        all_moving = [p for r in drains for p in r.pods_to_reschedule]
+        spread8, static_counts, sp_match_np = _spread_refit_context(
+            meta, tensors, all_moving
         )
+        if spread8 is not None:
+            pods_per_cand = [r.pods_to_reschedule[:S] for r in drains]
+            res = joint_removal_feasibility_spread(
+                tensors,
+                jnp.asarray(cand_idx),
+                jnp.asarray(pod_slots),
+                jnp.asarray(excluded),
+                spread8,
+                static_counts,
+                jnp.asarray(_cand_sub_matrix(sp_match_np, meta, pods_per_cand)),
+            )
+        else:
+            res = joint_removal_feasibility(
+                tensors,
+                jnp.asarray(cand_idx),
+                jnp.asarray(pod_slots),
+                jnp.asarray(excluded),
+            )
         feasible = np.asarray(res.feasible)
         dests = np.asarray(res.destinations)
 

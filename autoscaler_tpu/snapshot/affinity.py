@@ -280,6 +280,20 @@ def _spread_node_eligible(c, all_keys, declarer: Pod, node: Node) -> bool:
     return True
 
 
+def build_spread_context_from_meta(pending, meta, tensors):
+    """Convenience wrapper shared by the hinting simulator and the removal
+    simulator: derive (placed pods, node_of) from a SnapshotMeta and size
+    the arrays to the padded tensors — one definition so the two refit
+    surfaces cannot drift."""
+    placed = [p for p in meta.pods if p.node_name]
+    node_of = [meta.node_index.get(p.node_name, -1) for p in placed]
+    return build_spread_schedule_context(
+        pending, meta.nodes, placed, node_of,
+        meta.pod_index, int(tensors.pod_req.shape[0]),
+        num_node_cols=int(tensors.node_valid.shape[0]),
+    )
+
+
 def build_spread_schedule_context(
     pending: Sequence[Pod],
     nodes: Sequence[Node],
@@ -341,17 +355,40 @@ def build_spread_schedule_context(
             if sp_elig[t, j] and node_dom[t, j] >= 0:
                 dom_valid[t, node_dom[t, j]] = True
         domnum[t] = int(dom_valid[t].sum())
+    # profile-factorized counting: selector verdicts depend only on
+    # (namespace, labels), so evaluate once per distinct profile and
+    # accumulate with bincount — O(profiles × terms + placed), not
+    # O(placed × terms) Python-loop selector calls per reconcile pass
+    prof_index: Dict[Tuple, int] = {}
+    prof_of = _np.empty(len(placed_pods), _np.int64)
+    profiles: List[Tuple[str, Dict[str, str]]] = []
+    live = _np.empty(len(placed_pods), bool)
+    node_j = _np.asarray(
+        [j if j is not None else -1 for j in node_of], _np.int64
+    ) if placed_pods else _np.empty(0, _np.int64)
+    for qi, q in enumerate(placed_pods):
+        pkey = (q.namespace, tuple(sorted(q.labels.items())))
+        pid = prof_index.setdefault(pkey, len(prof_index))
+        prof_of[qi] = pid
+        if pid == len(profiles):
+            profiles.append((q.namespace, q.labels))
+        live[qi] = q.deletion_ts is None
     for t, (c, sel, ns, _declarer, _keys) in enumerate(term_list):
-        for q, j in zip(placed_pods, node_of):
-            if (
-                j >= 0
-                and sp_elig[t, j]
-                and node_dom[t, j] >= 0
-                and q.namespace == ns
-                and q.deletion_ts is None
-                and sel.matches(q.labels)
-            ):
-                static_counts[t, node_dom[t, j]] += 1
+        if not placed_pods:
+            continue
+        prof_match = _np.fromiter(
+            (pns == ns and sel.matches(lbls) for pns, lbls in profiles),
+            bool,
+            count=len(profiles),
+        )
+        sel_pods = prof_match[prof_of] & live & (node_j >= 0)
+        jj = node_j[sel_pods]
+        ok = sp_elig[t, jj] & (node_dom[t, jj] >= 0)
+        doms = node_dom[t, jj[ok]]
+        if doms.size:
+            static_counts[t, : doms.max() + 1] += _np.bincount(
+                doms, minlength=doms.max() + 1
+            ).astype(_np.int32)
     for pod_row, t in decls:
         sp_of[pod_row, t] = True
     for t, (c, sel, ns, _declarer, _keys) in enumerate(term_list):

@@ -180,16 +180,27 @@ def _bench_main():
     SAMPLE = 3
     sample_times = []
     for g in range(SAMPLE):
-        t0 = time.perf_counter()
-        if baseline == "cpp":
-            ref_count, ref_sched = baseline_ffd(pod_req, masks[g], allocs[g], MAX_NODES)
-        else:
-            from autoscaler_tpu.estimator.reference_impl import ffd_binpack_reference
+        best = None
+        for rep in range(3):
+            t0 = time.perf_counter()
+            if baseline == "cpp":
+                ref_count, ref_sched = baseline_ffd(
+                    pod_req, masks[g], allocs[g], MAX_NODES
+                )
+            else:
+                from autoscaler_tpu.estimator.reference_impl import (
+                    ffd_binpack_reference,
+                )
 
-            ref_count, ref_sched = ffd_binpack_reference(
-                pod_req, masks[g], allocs[g], MAX_NODES
-            )
-        sample_times.append(time.perf_counter() - t0)
+                ref_count, ref_sched = ffd_binpack_reference(
+                    pod_req, masks[g], allocs[g], MAX_NODES
+                )
+            dt = time.perf_counter() - t0
+            # best-of-3 per group: the ×G scale-up amplifies per-run timing
+            # noise ~500×, and taking the baseline's BEST case keeps
+            # vs_baseline stable run-to-run while only ever understating it
+            best = dt if best is None else min(best, dt)
+        sample_times.append(best)
         assert ref_count == int(res_counts[g]), (
             f"parity violation on group {g}: ref={ref_count} tpu={int(res_counts[g])}"
         )

@@ -148,6 +148,104 @@ class TestRemovalSimulator:
         assert to_remove[0].node.name == "n0"
         assert to_remove[0].destinations == {"default/p": "n1"}
 
+    def test_drain_refit_respects_topology_spread(self):
+        """Within-refit spread re-counting (findPlaceFor semantics,
+        cluster.go:220): the drained node's matching pods leave its domain
+        before placement, and each re-placement raises its destination's
+        count for the next mover. Two maxSkew=1 movers can NOT both land in
+        one zone — the pre-round-3 refit judged exactly that violating plan
+        feasible."""
+        from autoscaler_tpu.kube.objects import LabelSelector, TopologySpreadConstraint
+
+        ZONE = "topology.kubernetes.io/zone"
+
+        def spread_pod(name, skew=1):
+            p = build_test_pod(name, cpu_m=100, labels={"app": "web"})
+            p.topology_spread = (
+                TopologySpreadConstraint(
+                    max_skew=skew, topology_key=ZONE,
+                    selector=LabelSelector.from_dict({"app": "web"}),
+                ),
+            )
+            return p
+
+        def world(skew):
+            na = build_test_node("n-a", cpu_m=1000)
+            na.labels[ZONE] = "zone-a"
+            na2 = build_test_node("n-a2", cpu_m=2000)
+            na2.labels[ZONE] = "zone-a"
+            nb = build_test_node("n-b", cpu_m=2000)
+            nb.labels[ZONE] = "zone-b"
+            return snapshot_with(
+                [na, na2, nb],
+                [(spread_pod("m0", skew), "n-a"), (spread_pod("m1", skew), "n-a")],
+            )
+
+        # maxSkew=1: the stale static mask blocks zone-a destinations (the
+        # movers still count there pre-drain) and the dynamic carry blocks a
+        # second zone-b landing — no legal plan this loop → unremovable
+        # (conservative; the reference would split a/b). Crucially the old
+        # over-admission (both movers to zone-b, final skew 2) is gone.
+        sim = RemovalSimulator()
+        to_remove, unremovable = sim.find_nodes_to_remove(world(1), ["n-a"])
+        assert not to_remove
+        assert unremovable and unremovable[0].node.name == "n-a"
+
+        # maxSkew=2: both movers in zone-b is legal (2 vs 0 after drain) →
+        # feasible, and the destinations are skew-legal
+        to_remove2, _ = sim.find_nodes_to_remove(world(2), ["n-a"])
+        assert len(to_remove2) == 1
+        dests = set(to_remove2[0].destinations.values())
+        assert dests <= {"n-a2", "n-b"}
+        # recount the final world: no domain exceeds skew 2 against min 0
+        zone_of = {"n-a2": "a", "n-b": "b"}
+        landed = [zone_of[d] for d in to_remove2[0].destinations.values()]
+        assert abs(landed.count("a") - landed.count("b")) <= 2
+
+    def test_terminating_movers_not_subtracted_from_spread_counts(self):
+        """static_counts never count deletion-stamped pods (#87621), so the
+        per-candidate subtraction must skip them too — otherwise the domain
+        count goes negative and the refit gate over-admits."""
+        from autoscaler_tpu.kube.objects import LabelSelector, TopologySpreadConstraint
+        from autoscaler_tpu.simulator.removal import (
+            _cand_sub_matrix,
+            _spread_refit_context,
+        )
+
+        ZONE = "topology.kubernetes.io/zone"
+        na = build_test_node("n-a", cpu_m=1000)
+        na.labels[ZONE] = "zone-a"
+        nb = build_test_node("n-b", cpu_m=2000)
+        nb.labels[ZONE] = "zone-b"
+
+        def mover(name, terminating=False):
+            p = build_test_pod(name, cpu_m=100, labels={"app": "web"})
+            p.topology_spread = (
+                TopologySpreadConstraint(
+                    max_skew=1, topology_key=ZONE,
+                    selector=LabelSelector.from_dict({"app": "web"}),
+                ),
+            )
+            if terminating:
+                p.deletion_ts = 42.0
+            return p
+
+        m_term, m_live = mover("m-term", True), mover("m-live")
+        s = snapshot_with([na, nb], [(m_term, "n-a"), (m_live, "n-a")])
+        tensors, meta = s.tensors()
+        spread8, static_counts, sp_match_np = _spread_refit_context(
+            meta, tensors, [m_term, m_live]
+        )
+        assert spread8 is not None
+        import numpy as np
+
+        counts = np.asarray(static_counts)
+        assert counts.sum() == 1  # only the live mover ever counted
+        sub = _cand_sub_matrix(sp_match_np, meta, [[m_term, m_live]])
+        assert sub.sum() == 1  # the terminating mover is not subtracted
+        # net domain count after subtraction can never go negative
+        assert (counts.sum(axis=1) - sub[0]).min() >= 0
+
     def test_infeasible_removal(self):
         s = snapshot_with(
             [build_test_node("n0", cpu_m=1000), build_test_node("n1", cpu_m=600)],
