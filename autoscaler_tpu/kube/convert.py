@@ -214,24 +214,72 @@ def csinode_limits_from_json(obj: Dict[str, Any]) -> Tuple[str, Dict[str, int]]:
     return name, limits
 
 
+def pv_node_affinity_terms(pv: Dict[str, Any]) -> Tuple[k8s.LabelSelector, ...]:
+    """PV.spec.nodeAffinity.required.nodeSelectorTerms → ORed LabelSelector
+    terms (same JSON shape as pod node affinity; zonal and local PVs carry
+    these — the VolumeBinding filter's bound-PV check, which subsumes the
+    legacy VolumeZone zone-label rule).
+
+    matchFields: the only field key Kubernetes admits is metadata.name
+    (local-volume provisioners pin PVs to one node this way) — translated to
+    the packer's node-name sentinel key. Any other field key makes the term
+    unsatisfiable (conservative: a dropped constraint would over-admit and
+    strand the pod after a drain)."""
+    req = (
+        ((pv.get("spec") or {}).get("nodeAffinity") or {}).get("required") or {}
+    )
+    terms = []
+    for term in req.get("nodeSelectorTerms") or ():
+        exprs = [
+            k8s.LabelSelectorRequirement(
+                key=e.get("key", ""),
+                operator=e.get("operator", "In"),
+                values=tuple(e.get("values") or ()),
+            )
+            for e in term.get("matchExpressions") or ()
+        ]
+        for f in term.get("matchFields") or ():
+            if f.get("key") == "metadata.name":
+                exprs.append(
+                    k8s.LabelSelectorRequirement(
+                        key=k8s.NODE_NAME_FIELD_KEY,
+                        operator=f.get("operator", "In"),
+                        values=tuple(f.get("values") or ()),
+                    )
+                )
+            else:
+                # unknown field key: never-matching requirement
+                exprs.append(
+                    k8s.LabelSelectorRequirement(
+                        key=k8s.NODE_NAME_FIELD_KEY, operator="In", values=()
+                    )
+                )
+        terms.append(k8s.LabelSelector(match_expressions=tuple(exprs)))
+    return tuple(terms)
+
+
 def pvc_csi_index(
     pvcs: Sequence[Dict[str, Any]], pvs: Sequence[Dict[str, Any]]
-) -> Dict[Tuple[str, str], Tuple[str, str]]:
-    """→ {(namespace, claimName): (csi_driver, volumeHandle)} for claims bound
-    to CSI-backed PersistentVolumes.
+) -> Dict[Tuple[str, str], Tuple[Optional[str], Optional[str], Tuple]]:
+    """→ {(namespace, claimName): (csi_driver | None, volumeHandle | None,
+    pv_node_affinity_terms)} for claims bound to PersistentVolumes.
 
-    This is the PVC→driver resolution that closes PREDICATES.md divergence 3:
-    two pods sharing one RWX claim map to the SAME volumeHandle, so the
-    packer's unique-handle attach counting sees one attachment per node, not
-    two. Non-CSI PVs (hostPath, NFS, ...) resolve to nothing — they don't
-    consume CSI attach slots."""
-    pv_by_name: Dict[str, Tuple[str, str]] = {}
+    The CSI part closes PREDICATES.md divergence 3: two pods sharing one RWX
+    claim map to the SAME volumeHandle, so the packer's unique-handle attach
+    counting sees one attachment per node, not two. Non-CSI PVs (hostPath,
+    NFS, local, ...) resolve with driver=None — no attach slot — but their
+    node-affinity terms STILL constrain placement (round 3: the
+    VolumeBinding/VolumeZone rule)."""
+    pv_by_name: Dict[str, Tuple[Optional[str], Optional[str], Tuple]] = {}
     for pv in pvs:
+        name = (pv.get("metadata") or {}).get("name", "")
         csi = ((pv.get("spec") or {}).get("csi")) or {}
+        terms = pv_node_affinity_terms(pv)
         if csi.get("driver"):
-            name = (pv.get("metadata") or {}).get("name", "")
-            pv_by_name[name] = (csi["driver"], csi.get("volumeHandle", name))
-    out: Dict[Tuple[str, str], Tuple[str, str]] = {}
+            pv_by_name[name] = (csi["driver"], csi.get("volumeHandle", name), terms)
+        elif terms:
+            pv_by_name[name] = (None, None, terms)
+    out: Dict[Tuple[str, str], Tuple[Optional[str], Optional[str], Tuple]] = {}
     for pvc in pvcs:
         meta = pvc.get("metadata") or {}
         vol = (pvc.get("spec") or {}).get("volumeName") or ""
@@ -262,6 +310,7 @@ def pod_from_json(
             if port.get("hostPort"):
                 host_ports.append(int(port["hostPort"]))
     csi_volumes: List[tuple] = []
+    volume_affinity: List[tuple] = []
     pod_key = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
     for v in spec.get("volumes") or ():
         if "emptyDir" in v or "hostPath" in v:
@@ -273,14 +322,19 @@ def pod_from_json(
             csi_volumes.append((csi["driver"], f"{pod_key}/{v.get('name', '')}"))
         pvc = v.get("persistentVolumeClaim")
         if pvc and pvc.get("claimName") and pvc_resolver is not None:
-            # PVC-backed volume: resolve claim → bound PV → csi source via
-            # the caller's PV/PVC listers (pvc_csi_index). Unresolved claims
-            # (unbound, or non-CSI PVs) consume no attach slots.
+            # PVC-backed volume: resolve claim → bound PV via the caller's
+            # PV/PVC listers (pvc_csi_index). CSI sources consume attach
+            # slots; ANY bound PV's node-affinity terms constrain placement
+            # (VolumeBinding/VolumeZone). Unbound claims resolve to nothing.
             resolved = pvc_resolver(
                 meta.get("namespace", "default"), pvc["claimName"]
             )
             if resolved is not None:
-                csi_volumes.append(resolved)
+                driver, handle, pv_terms = resolved
+                if driver:
+                    csi_volumes.append((driver, handle))
+                if pv_terms:
+                    volume_affinity.append(tuple(pv_terms))
 
     owner = None
     for ref in meta.get("ownerReferences") or ():
@@ -343,6 +397,7 @@ def pod_from_json(
         node_name=spec.get("nodeName", ""),
         host_ports=tuple(host_ports),
         csi_volumes=tuple(csi_volumes),
+        volume_node_affinity=tuple(volume_affinity),
         mirror=MIRROR_ANNOTATION in annotations,
         daemonset=bool(owner and owner.kind == "DaemonSet"),
         restartable=owner is not None,

@@ -239,6 +239,11 @@ class Pod:
     # resolved to their PV's CSI source, or inline ephemeral CSI volumes
     # (NodeVolumeLimits filter input)
     csi_volumes: Tuple[Tuple[str, str], ...] = ()
+    # Per bound volume: the PV's required node-affinity terms (ORed within a
+    # volume, volumes ANDed) — zonal/local PVs pin the pod to nodes the
+    # volume can attach to (VolumeBinding/VolumeZone filter input; empty =
+    # unconstrained)
+    volume_node_affinity: Tuple[Tuple["LabelSelector", ...], ...] = ()
     mirror: bool = False          # static/mirror pod
     daemonset: bool = False
     restartable: bool = True      # has a controller that will recreate it
@@ -337,6 +342,26 @@ def pod_tolerates_taints(pod: Pod, taints: List[Taint]) -> bool:
         if taint.effect == PREFER_NO_SCHEDULE:
             continue
         if not any(tol.tolerates(taint) for tol in pod.tolerations):
+            return False
+    return True
+
+
+# Sentinel label key carrying node.name into selector matching, for PV
+# matchFields on metadata.name (the only field key Kubernetes admits there).
+NODE_NAME_FIELD_KEY = "__field.metadata.name"
+
+
+def pod_volumes_match_node(pod: Pod, node: Node) -> bool:
+    """Bound-PV node affinity (the VolumeBinding filter's check of a bound
+    claim's PV.spec.nodeAffinity, which also subsumes the legacy VolumeZone
+    zone-label rule): every volume's required terms must admit the node.
+    metadata.name matchFields are evaluated against node.name via the
+    sentinel key."""
+    if not pod.volume_node_affinity:
+        return True
+    labels = {**node.labels, NODE_NAME_FIELD_KEY: node.name}
+    for terms in pod.volume_node_affinity:
+        if terms and not any(t.matches(labels) for t in terms):
             return False
     return True
 

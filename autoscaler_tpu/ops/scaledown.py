@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from autoscaler_tpu.kube.objects import PODS
+from autoscaler_tpu.ops.schedule import spread_commit, spread_gate
 from autoscaler_tpu.snapshot.tensors import SnapshotTensors
 
 
@@ -61,10 +62,6 @@ def _place_pod_step(snap: SnapshotTensors, excluded: jax.Array, spread=None):
     drained node's domain (the caller subtracts their static contribution)
     and raise their destination's counts for later moved pods. The carry is
     (free [N, R], counts [S, D])."""
-    if spread is not None:
-        (sp_of_T, sp_match_T, node_dom, sp_elig, dom_valid,
-         skew, min_dom, domnum) = spread
-
     def step(carry, pod_idx):
         free, counts = carry
         valid_pod = pod_idx >= 0
@@ -77,35 +74,15 @@ def _place_pod_step(snap: SnapshotTensors, excluded: jax.Array, spread=None):
             & ~excluded
         )
         if spread is not None:
-            o = sp_of_T[safe_idx]                           # [S]
-            m = sp_match_T[safe_idx]                        # [S]
-            minv = jnp.min(jnp.where(dom_valid, counts, BIG_I32), axis=1)
-            min_eff = jnp.where(min_dom > domnum, 0, minv)  # [S]
-            dom_safe = jnp.maximum(node_dom, 0)             # [S, N]
-            cnt_node = jnp.take_along_axis(counts, dom_safe, axis=1)
-            reg_node = (
-                jnp.take_along_axis(dom_valid, dom_safe, axis=1)
-                & (node_dom >= 0)
-            )
-            cnt_node = jnp.where(reg_node, cnt_node, 0)
-            ok_sp = (node_dom >= 0) & (
-                cnt_node + m.astype(jnp.int32)[:, None] - min_eff[:, None]
-                <= skew[:, None]
-            )
-            ok &= ~(o[:, None] & ~ok_sp).any(axis=0)
+            node_ok, m = spread_gate(spread, counts, safe_idx)
+            ok &= node_ok
         has = ok.any()
         dest = jnp.where(has, jnp.argmax(ok).astype(jnp.int32), -1)
         place = valid_pod & has
         target = jnp.maximum(dest, 0)
         free = free.at[target].add(jnp.where(place, -req, jnp.zeros_like(req)))
         if spread is not None:
-            dom_t = node_dom[:, target]                     # [S]
-            upd = (
-                m & place & (dom_t >= 0) & sp_elig[:, target]
-            ).astype(jnp.int32)
-            counts = counts.at[
-                jnp.arange(counts.shape[0]), jnp.maximum(dom_t, 0)
-            ].add(upd)
+            counts = spread_commit(spread, counts, m, place, target)
         placed_needed = jnp.where(valid_pod, place, True)
         return (free, counts), (jnp.where(valid_pod, dest, -1), placed_needed, place)
 

@@ -26,6 +26,44 @@ class ScheduleResult(NamedTuple):
 BIG_I32 = jnp.int32(2**30)
 
 
+def spread_gate(sp8, counts, safe_idx):
+    """Shared within-wave topology-spread gate over EXISTING nodes →
+    (node_ok [N] bool, m [S] bool). sp8 = the 8-array context
+    (affinity.build_spread_schedule_context minus static counts, which
+    travel in the `counts` carry). One definition for the greedy/hinting
+    scheduler AND the scale-down refit kernels so the two surfaces cannot
+    drift (the same reason _place_pod_step itself is shared)."""
+    (sp_of_T, sp_match_T, node_dom, _sp_elig, dom_valid,
+     skew, min_dom, domnum) = sp8
+    o = sp_of_T[safe_idx]                               # [S]
+    m = sp_match_T[safe_idx]                            # [S]
+    minv = jnp.min(jnp.where(dom_valid, counts, BIG_I32), axis=1)
+    min_eff = jnp.where(min_dom > domnum, 0, minv)      # [S]
+    dom_safe = jnp.maximum(node_dom, 0)                 # [S, N]
+    cnt_node = jnp.take_along_axis(counts, dom_safe, axis=1)
+    reg_node = (
+        jnp.take_along_axis(dom_valid, dom_safe, axis=1) & (node_dom >= 0)
+    )
+    cnt_node = jnp.where(reg_node, cnt_node, 0)
+    ok_sp = (node_dom >= 0) & (
+        cnt_node + m.astype(jnp.int32)[:, None] - min_eff[:, None]
+        <= skew[:, None]
+    )
+    return ~(o[:, None] & ~ok_sp).any(axis=0), m
+
+
+def spread_commit(sp8, counts, m, place, target):
+    """Shared count update after a placement: matching pods landing on
+    nodes ELIGIBLE for the term raise that domain's count
+    (countPodsMatchSelector runs over eligible nodes)."""
+    node_dom, sp_elig = sp8[2], sp8[3]
+    dom_t = node_dom[:, target]                         # [S]
+    upd = (m & place & (dom_t >= 0) & sp_elig[:, target]).astype(jnp.int32)
+    return counts.at[
+        jnp.arange(counts.shape[0]), jnp.maximum(dom_t, 0)
+    ].add(upd)
+
+
 @jax.jit
 def greedy_schedule(
     snap: SnapshotTensors,
@@ -43,15 +81,18 @@ def greedy_schedule(
     closes the last within-wave spread divergence (PREDICATES.md 2)."""
     free0 = snap.free()
     if spread is not None:
+        # split the 9-tuple: static counts seed the carry, the rest is the
+        # shared 8-array gate context
         (sp_of_T, sp_match_T, node_dom, sp_elig, dom_valid,
          static_counts, skew, min_dom, domnum) = spread
-        S, D = static_counts.shape
-        delta0 = jnp.zeros((S, D), jnp.int32)
+        sp8 = (sp_of_T, sp_match_T, node_dom, sp_elig, dom_valid,
+               skew, min_dom, domnum)
+        counts0 = static_counts
     else:
-        delta0 = jnp.zeros((1, 1), jnp.int32)
+        counts0 = jnp.zeros((1, 1), jnp.int32)
 
     def step(carry, inp):
-        free, delta = carry
+        free, counts = carry
         pod_idx, hint = inp
         valid = pod_idx >= 0
         safe = jnp.maximum(pod_idx, 0)
@@ -62,23 +103,8 @@ def greedy_schedule(
             & snap.node_valid
         )
         if spread is not None:
-            o = sp_of_T[safe]                               # [S]
-            m = sp_match_T[safe]                            # [S]
-            cnt = static_counts + delta                     # [S, D]
-            minv = jnp.min(jnp.where(dom_valid, cnt, BIG_I32), axis=1)
-            min_eff = jnp.where(min_dom > domnum, 0, minv)  # [S]
-            dom_safe = jnp.maximum(node_dom, 0)             # [S, N]
-            cnt_node = jnp.take_along_axis(cnt, dom_safe, axis=1)
-            reg_node = (
-                jnp.take_along_axis(dom_valid, dom_safe, axis=1)
-                & (node_dom >= 0)
-            )
-            cnt_node = jnp.where(reg_node, cnt_node, 0)
-            ok_sp = (node_dom >= 0) & (
-                cnt_node + m.astype(jnp.int32)[:, None] - min_eff[:, None]
-                <= skew[:, None]
-            )
-            ok &= ~(o[:, None] & ~ok_sp).any(axis=0)
+            node_ok, m = spread_gate(sp8, counts, safe)
+            ok &= node_ok
         hint_ok = (hint >= 0) & ok[jnp.maximum(hint, 0)]
         first = jnp.argmax(ok).astype(jnp.int32)
         dest = jnp.where(hint_ok, hint, jnp.where(ok.any(), first, -1))
@@ -86,16 +112,8 @@ def greedy_schedule(
         target = jnp.maximum(dest, 0)
         free = free.at[target].add(jnp.where(place, -req, jnp.zeros_like(req)))
         if spread is not None:
-            # counts move only for matching pods landing on nodes ELIGIBLE
-            # for the term (countPodsMatchSelector runs over eligible nodes)
-            dom_t = node_dom[:, target]                     # [S]
-            upd = (
-                m & place & (dom_t >= 0) & sp_elig[:, target]
-            ).astype(jnp.int32)
-            delta = delta.at[
-                jnp.arange(delta.shape[0]), jnp.maximum(dom_t, 0)
-            ].add(upd)
-        return (free, delta), (place, jnp.where(place, dest, -1))
+            counts = spread_commit(sp8, counts, m, place, target)
+        return (free, counts), (place, jnp.where(place, dest, -1))
 
-    _, (placed, dest) = jax.lax.scan(step, (free0, delta0), (pod_slots, hints))
+    _, (placed, dest) = jax.lax.scan(step, (free0, counts0), (pod_slots, hints))
     return ScheduleResult(placed=placed, dest=dest)

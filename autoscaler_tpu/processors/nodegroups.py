@@ -139,8 +139,10 @@ def _pod_fits_template(pod: Pod, template: Node) -> bool:
         or req.tpu > alloc.tpu
     ):
         return False
-    return k8s.pod_tolerates_taints(pod, template.taints) and k8s.node_matches_selector(
-        pod, template
+    return (
+        k8s.pod_tolerates_taints(pod, template.taints)
+        and k8s.node_matches_selector(pod, template)
+        and k8s.pod_volumes_match_node(pod, template)
     )
 
 

@@ -99,6 +99,10 @@ class _PodSlot:
             for term in aff.node_selector_terms:
                 keys.update(k for k, _ in term.match_labels)
                 keys.update(r.key for r in term.match_expressions)
+        for vol_terms in pod.volume_node_affinity:
+            for term in vol_terms:
+                keys.update(k for k, _ in term.match_labels)
+                keys.update(r.key for r in term.match_expressions)
         self.sel_keys = frozenset(keys)
         self.csi_drivers = frozenset(d for d, _ in pod.csi_volumes)
 

@@ -110,7 +110,12 @@ def _node_profile_key(node: Node, relevant_keys: frozenset) -> tuple:
     labels = tuple(
         sorted((k, v) for k, v in node.labels.items() if k in relevant_keys)
     )
-    return (tuple(node.taints), labels, node.unschedulable)
+    key = (tuple(node.taints), labels, node.unschedulable)
+    if k8s.NODE_NAME_FIELD_KEY in relevant_keys:
+        # a name-pinned PV (matchFields metadata.name) makes the verdict
+        # node-identity-dependent: every node becomes its own class
+        key += (node.name,)
+    return key
 
 
 def _pod_profile_key(pod: Pod) -> tuple:
@@ -119,6 +124,7 @@ def _pod_profile_key(pod: Pod) -> tuple:
         tuple(pod.tolerations),
         tuple(sorted(pod.node_selector.items())),
         aff.node_selector_terms if aff else (),
+        pod.volume_node_affinity,
     )
 
 
@@ -202,12 +208,16 @@ def _profile_factorization(
     # drivers any pod actually mounts — only these can affect a verdict
     csi_relevant = {d for pod in pods for d, _ in pod.csi_volumes}
 
-    # label keys that can influence any pod's selector/affinity verdict
+    # label keys that can influence any pod's selector/affinity/volume verdict
     relevant: set = set()
     for pod in pods:
         relevant.update(pod.node_selector.keys())
         if pod.affinity:
             for term in pod.affinity.node_selector_terms:
+                relevant.update(k for k, _ in term.match_labels)
+                relevant.update(r.key for r in term.match_expressions)
+        for vol_terms in pod.volume_node_affinity:
+            for term in vol_terms:
                 relevant.update(k for k, _ in term.match_labels)
                 relevant.update(r.key for r in term.match_expressions)
     relevant_keys = frozenset(relevant)
@@ -269,6 +279,7 @@ def _class_verdict(
         not node.unschedulable
         and k8s.pod_tolerates_taints(pod, node.taints)
         and k8s.node_matches_selector(pod, node)
+        and k8s.pod_volumes_match_node(pod, node)
         and not any(ports.get(p, 0) > 0 for p in pod.host_ports)
         and _csi_fits(
             _pod_csi_counts(pod) if pod_csi is None else pod_csi,
@@ -284,6 +295,7 @@ def _class_verdict_no_ports(pod: Pod, node: Node) -> bool:
         not node.unschedulable
         and k8s.pod_tolerates_taints(pod, node.taints)
         and k8s.node_matches_selector(pod, node)
+        and k8s.pod_volumes_match_node(pod, node)
     )
 
 

@@ -1,0 +1,227 @@
+"""VolumeBinding/VolumeZone: a bound PV's node affinity constrains the pod.
+
+Reference: the scheduler framework's VolumeBinding filter checks a bound
+claim's PV.spec.nodeAffinity against candidate node labels (subsuming the
+legacy VolumeZone zone-label rule); CA exercises it via
+simulator/predicatechecker/schedulerbased.go:129. Previously listed as
+unmodeled in PREDICATES.md divergence 3 — closed in round 3: pvc_csi_index
+resolves ANY bound PV's required nodeSelectorTerms (zonal/local PVs,
+CSI or not) into Pod.volume_node_affinity, which the packer evaluates as a
+class-structured predicate.
+"""
+import numpy as np
+import pytest
+
+from autoscaler_tpu.kube.convert import pod_from_json, pvc_csi_index
+from autoscaler_tpu.kube.objects import (
+    LabelSelector,
+    LabelSelectorRequirement,
+    pod_volumes_match_node,
+)
+from autoscaler_tpu.snapshot.packer import compute_factored_mask, compute_sched_mask
+from autoscaler_tpu.utils.test_utils import build_test_node, build_test_pod
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def zonal_pv(name, zone, csi=True):
+    spec = {
+        "capacity": {"storage": "10Gi"},
+        "nodeAffinity": {
+            "required": {
+                "nodeSelectorTerms": [
+                    {
+                        "matchExpressions": [
+                            {"key": ZONE, "operator": "In", "values": [zone]}
+                        ]
+                    }
+                ]
+            }
+        },
+    }
+    if csi:
+        spec["csi"] = {"driver": "pd.csi.example.com", "volumeHandle": f"h-{name}"}
+    else:
+        spec["local"] = {"path": "/mnt/disks/x"}
+    return {"metadata": {"name": name}, "spec": spec}
+
+
+def pvc(name, volume, ns="default"):
+    return {
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"volumeName": volume},
+    }
+
+
+def pod_json_with_claim(claim):
+    return {
+        "metadata": {"name": "p", "namespace": "default"},
+        "spec": {
+            "containers": [{"name": "c", "resources": {"requests": {"cpu": "100m"}}}],
+            "volumes": [
+                {"name": "data", "persistentVolumeClaim": {"claimName": claim}}
+            ],
+        },
+    }
+
+
+class TestResolution:
+    def test_csi_pv_carries_affinity_and_handle(self):
+        idx = pvc_csi_index([pvc("c1", "pv1")], [zonal_pv("pv1", "zone-a")])
+        driver, handle, terms = idx[("default", "c1")]
+        assert driver == "pd.csi.example.com" and handle == "h-pv1"
+        assert terms and terms[0].matches({ZONE: "zone-a"})
+        assert not terms[0].matches({ZONE: "zone-b"})
+
+    def test_non_csi_local_pv_still_constrains(self):
+        idx = pvc_csi_index([pvc("c1", "pv1")], [zonal_pv("pv1", "zone-a", csi=False)])
+        driver, handle, terms = idx[("default", "c1")]
+        assert driver is None  # no attach slot for non-CSI volumes
+        assert terms and terms[0].matches({ZONE: "zone-a"})
+
+    def test_pod_from_json_attaches_constraint(self):
+        idx = pvc_csi_index([pvc("c1", "pv1")], [zonal_pv("pv1", "zone-a")])
+        pod = pod_from_json(
+            pod_json_with_claim("c1"), pvc_resolver=lambda ns, c: idx.get((ns, c))
+        )
+        assert pod.csi_volumes == (("pd.csi.example.com", "h-pv1"),)
+        assert len(pod.volume_node_affinity) == 1
+        node_a = build_test_node("na", cpu_m=1000)
+        node_a.labels[ZONE] = "zone-a"
+        node_b = build_test_node("nb", cpu_m=1000)
+        node_b.labels[ZONE] = "zone-b"
+        assert pod_volumes_match_node(pod, node_a)
+        assert not pod_volumes_match_node(pod, node_b)
+
+
+class TestMatchFields:
+    def _pv_with_fields(self, key, values):
+        return {
+            "metadata": {"name": "pv1"},
+            "spec": {
+                "local": {"path": "/mnt/x"},
+                "nodeAffinity": {
+                    "required": {
+                        "nodeSelectorTerms": [
+                            {"matchFields": [
+                                {"key": key, "operator": "In", "values": values}
+                            ]}
+                        ]
+                    }
+                },
+            },
+        }
+
+    def test_metadata_name_pins_to_one_node(self):
+        """Local-volume provisioners pin PVs via matchFields metadata.name —
+        evaluated against node.name, and the class factorization splits
+        per-name so identical-label nodes don't share the verdict."""
+        idx = pvc_csi_index([pvc("c1", "pv1")],
+                            [self._pv_with_fields("metadata.name", ["n-target"])])
+        pod = pod_from_json(
+            pod_json_with_claim("c1"), pvc_resolver=lambda ns, c: idx.get((ns, c))
+        )
+        target = build_test_node("n-target", cpu_m=1000)
+        other = build_test_node("n-other", cpu_m=1000)
+        # identical labels except the implicit hostname
+        other.labels = dict(target.labels)
+        other.labels["kubernetes.io/hostname"] = "n-other"
+        assert pod_volumes_match_node(pod, target)
+        assert not pod_volumes_match_node(pod, other)
+        mask = compute_sched_mask([target, other], [pod], [-1])
+        assert list(mask[0]) == [True, False]
+        from tests.test_factored_mask import expand
+
+        fm = expand(compute_factored_mask([target, other], [pod], [-1]), 1, 2)
+        np.testing.assert_array_equal(fm, mask)
+
+    def test_unknown_field_key_is_unsatisfiable(self):
+        """A field key we cannot evaluate must never silently widen the
+        constraint: the term becomes unsatisfiable (conservative — a
+        dropped constraint would over-admit and strand the pod)."""
+        idx = pvc_csi_index([pvc("c1", "pv1")],
+                            [self._pv_with_fields("spec.unknown", ["x"])])
+        pod = pod_from_json(
+            pod_json_with_claim("c1"), pvc_resolver=lambda ns, c: idx.get((ns, c))
+        )
+        assert not pod_volumes_match_node(pod, build_test_node("any", cpu_m=1000))
+
+
+class TestMask:
+    def _volume_pod(self, name, zone):
+        p = build_test_pod(name, cpu_m=100)
+        p.volume_node_affinity = (
+            (
+                LabelSelector(
+                    match_expressions=(
+                        LabelSelectorRequirement(ZONE, "In", (zone,)),
+                    )
+                ),
+            ),
+        )
+        return p
+
+    def test_mask_pins_pod_to_volume_zone(self):
+        nodes = []
+        for z in "ab":
+            n = build_test_node(f"n-{z}", cpu_m=10_000)
+            n.labels[ZONE] = f"zone-{z}"
+            nodes.append(n)
+        nodes.append(build_test_node("n-nolabel", cpu_m=10_000))
+        pod = self._volume_pod("p", "zone-a")
+        plain = build_test_pod("plain", cpu_m=100)
+        mask = compute_sched_mask(nodes, [pod, plain], [-1, -1])
+        assert list(mask[0]) == [True, False, False]
+        assert list(mask[1]) == [True, True, True]
+        # factored path agrees (the rule is class-structured)
+        from tests.test_factored_mask import expand
+
+        fm = expand(compute_factored_mask(nodes, [pod, plain], [-1, -1]), 2, 3)
+        np.testing.assert_array_equal(fm, mask)
+
+    def test_two_volumes_intersect(self):
+        p = build_test_pod("p", cpu_m=100)
+        p.volume_node_affinity = (
+            (
+                LabelSelector(
+                    match_expressions=(
+                        LabelSelectorRequirement(ZONE, "In", ("zone-a",)),
+                    )
+                ),
+            ),
+            (
+                LabelSelector(
+                    match_expressions=(
+                        LabelSelectorRequirement("disk", "In", ("ssd",)),
+                    )
+                ),
+            ),
+        )
+        n1 = build_test_node("n1", cpu_m=1000)
+        n1.labels.update({ZONE: "zone-a", "disk": "ssd"})
+        n2 = build_test_node("n2", cpu_m=1000)
+        n2.labels.update({ZONE: "zone-a", "disk": "hdd"})
+        mask = compute_sched_mask([n1, n2], [p], [-1])
+        assert list(mask[0]) == [True, False]
+
+
+class TestKubeClientRoundTrip:
+    def test_recorded_server_resolution(self):
+        from tests.test_kube_client import FakeApiServer, node_json, pod_json
+
+        from autoscaler_tpu.kube.client import KubeClusterAPI, KubeRestClient
+
+        srv = FakeApiServer()
+        try:
+            srv.nodes["n1"] = node_json("n1", labels={ZONE: "zone-a"})
+            obj = pod_json_with_claim("c1")
+            srv.pods["default/p"] = obj
+            srv.pvcs = [pvc("c1", "pv1")]
+            srv.pvs = [zonal_pv("pv1", "zone-a")]
+            api = KubeClusterAPI(KubeRestClient(srv.url))
+            (pod,) = [q for q in api.list_pods() if q.name == "p"]
+            assert pod.csi_volumes == (("pd.csi.example.com", "h-pv1"),)
+            assert pod.volume_node_affinity
+            assert pod.volume_node_affinity[0][0].matches({ZONE: "zone-a"})
+        finally:
+            srv.close()
