@@ -254,6 +254,15 @@ def pv_node_affinity_terms(pv: Dict[str, Any]) -> Tuple[k8s.LabelSelector, ...]:
                         key=k8s.NODE_NAME_FIELD_KEY, operator="In", values=()
                     )
                 )
+        if not exprs:
+            # an empty nodeSelectorTerm matches NO objects in Kubernetes; an
+            # empty LabelSelector here would match EVERYTHING — emit the
+            # never-matching sentinel instead
+            exprs.append(
+                k8s.LabelSelectorRequirement(
+                    key=k8s.NODE_NAME_FIELD_KEY, operator="In", values=()
+                )
+            )
         terms.append(k8s.LabelSelector(match_expressions=tuple(exprs)))
     return tuple(terms)
 

@@ -39,12 +39,13 @@ def build_world(rng):
         lo = int(rng.integers(0, 2))
         hi = int(rng.integers(6, 15))
         start = int(rng.integers(lo, min(hi, 5) + 1))
-        provider.add_node_group(
-            f"g{gi}", lo, hi, start,
-            build_test_node(f"g{gi}-tmpl", cpu_m=cpu_m, mem=mem_gb * GB),
-        )
+        zone = f"zone-{'abc'[gi % 3]}"
+        tmpl = build_test_node(f"g{gi}-tmpl", cpu_m=cpu_m, mem=mem_gb * GB)
+        tmpl.labels["topology.kubernetes.io/zone"] = zone
+        provider.add_node_group(f"g{gi}", lo, hi, start, tmpl)
         for i in range(start):
             node = build_test_node(f"g{gi}-{i}", cpu_m=cpu_m, mem=mem_gb * GB)
+            node.labels["topology.kubernetes.io/zone"] = zone
             provider.add_node(f"g{gi}", node)
             api.add_node(node)
     # scatter running pods over existing nodes
@@ -80,6 +81,27 @@ def build_world(rng):
             p.csi_volumes = (("pd.csi.storage.gke.io", f"vol-{j}"),)
         elif flavor < 0.25:
             p.host_ports = (9000 + j % 3,)
+        elif flavor < 0.35:
+            # hard topology spread: exercises the within-wave spread carry
+            # in the estimator, the hinting path, and the scale-down refit
+            from autoscaler_tpu.kube.objects import (
+                LabelSelector,
+                TopologySpreadConstraint,
+            )
+
+            p.topology_spread = (
+                TopologySpreadConstraint(
+                    max_skew=int(rng.integers(1, 3)),
+                    topology_key=(
+                        "topology.kubernetes.io/zone"
+                        if rng.random() < 0.7
+                        else "kubernetes.io/hostname"
+                    ),
+                    selector=LabelSelector.from_dict(
+                        {"app": p.labels["app"]}
+                    ),
+                ),
+            )
         api.add_pod(p)
     opts = AutoscalingOptions(
         min_cores_total=2 * 1000.0,     # floor: 2 cores

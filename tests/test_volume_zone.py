@@ -135,6 +135,23 @@ class TestMatchFields:
         fm = expand(compute_factored_mask([target, other], [pod], [-1]), 1, 2)
         np.testing.assert_array_equal(fm, mask)
 
+    def test_empty_term_matches_nothing(self):
+        """Kubernetes: an empty nodeSelectorTerm matches NO objects; an
+        empty LabelSelector here would match everything — the converter
+        emits the never-matching sentinel."""
+        pv = {
+            "metadata": {"name": "pv1"},
+            "spec": {
+                "local": {"path": "/x"},
+                "nodeAffinity": {"required": {"nodeSelectorTerms": [{}]}},
+            },
+        }
+        idx = pvc_csi_index([pvc("c1", "pv1")], [pv])
+        pod = pod_from_json(
+            pod_json_with_claim("c1"), pvc_resolver=lambda ns, c: idx.get((ns, c))
+        )
+        assert not pod_volumes_match_node(pod, build_test_node("any", cpu_m=1000))
+
     def test_unknown_field_key_is_unsatisfiable(self):
         """A field key we cannot evaluate must never silently widen the
         constraint: the term becomes unsatisfiable (conservative — a
