@@ -382,6 +382,7 @@ _STORAGE_PATHS = {
     "pvc": "/api/v1/persistentvolumeclaims",
     "pv": "/api/v1/persistentvolumes",
     "csinode": "/apis/storage.k8s.io/v1/csinodes",
+    "storageclass": "/apis/storage.k8s.io/v1/storageclasses",
 }
 
 
@@ -523,8 +524,20 @@ class KubeClusterAPI(ClusterAPI):
 
             def resolver(ns: str, claim: str):
                 if memo[0] is None:
+                    pvcs = self._list_storage("pvc")
+                    # storage classes matter only for UNBOUND claims (the
+                    # WaitForFirstConsumer allowedTopologies rule) — the
+                    # common all-bound steady state skips the extra LIST
+                    scs = (
+                        self._list_storage("storageclass")
+                        if any(
+                            not ((c.get("spec") or {}).get("volumeName"))
+                            for c in pvcs
+                        )
+                        else []
+                    )
                     memo[0] = convert.pvc_csi_index(
-                        self._list_storage("pvc"), self._list_storage("pv")
+                        pvcs, self._list_storage("pv"), scs
                     )
                 return memo[0].get((ns, claim))
 

@@ -267,8 +267,30 @@ def pv_node_affinity_terms(pv: Dict[str, Any]) -> Tuple[k8s.LabelSelector, ...]:
     return tuple(terms)
 
 
+def storageclass_topology_terms(sc: Dict[str, Any]) -> Tuple[k8s.LabelSelector, ...]:
+    """StorageClass.allowedTopologies → ORed LabelSelector terms (the
+    VolumeBinding filter's constraint for UNBOUND WaitForFirstConsumer
+    claims: provisioning must be possible in the candidate node's topology).
+    matchLabelExpressions admit only key+values (In semantics)."""
+    terms = []
+    for topo in sc.get("allowedTopologies") or ():
+        exprs = tuple(
+            k8s.LabelSelectorRequirement(
+                key=e.get("key", ""),
+                operator="In",
+                values=tuple(e.get("values") or ()),
+            )
+            for e in topo.get("matchLabelExpressions") or ()
+        )
+        if exprs:
+            terms.append(k8s.LabelSelector(match_expressions=exprs))
+    return tuple(terms)
+
+
 def pvc_csi_index(
-    pvcs: Sequence[Dict[str, Any]], pvs: Sequence[Dict[str, Any]]
+    pvcs: Sequence[Dict[str, Any]],
+    pvs: Sequence[Dict[str, Any]],
+    storage_classes: Sequence[Dict[str, Any]] = (),
 ) -> Dict[Tuple[str, str], Tuple[Optional[str], Optional[str], Tuple]]:
     """→ {(namespace, claimName): (csi_driver | None, volumeHandle | None,
     pv_node_affinity_terms)} for claims bound to PersistentVolumes.
@@ -288,13 +310,30 @@ def pvc_csi_index(
             pv_by_name[name] = (csi["driver"], csi.get("volumeHandle", name), terms)
         elif terms:
             pv_by_name[name] = (None, None, terms)
+    sc_terms: Dict[str, Tuple] = {}
+    for sc in storage_classes:
+        name = (sc.get("metadata") or {}).get("name", "")
+        terms = storageclass_topology_terms(sc)
+        if terms:
+            sc_terms[name] = terms
     out: Dict[Tuple[str, str], Tuple[Optional[str], Optional[str], Tuple]] = {}
     for pvc in pvcs:
         meta = pvc.get("metadata") or {}
-        vol = (pvc.get("spec") or {}).get("volumeName") or ""
+        spec = pvc.get("spec") or {}
+        vol = spec.get("volumeName") or ""
+        key = (meta.get("namespace", "default"), meta.get("name", ""))
         hit = pv_by_name.get(vol)
         if hit is not None:
-            out[(meta.get("namespace", "default"), meta.get("name", ""))] = hit
+            out[key] = hit
+        elif not vol:
+            # UNBOUND claim: the StorageClass's allowedTopologies constrain
+            # where a WaitForFirstConsumer volume could be provisioned —
+            # closing the unbound half of the VolumeBinding divergence. A
+            # class without allowedTopologies (or no class) provisions
+            # anywhere: unconstrained, no entry.
+            terms = sc_terms.get(spec.get("storageClassName") or "")
+            if terms:
+                out[key] = (None, None, terms)
     return out
 
 

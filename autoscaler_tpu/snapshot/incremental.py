@@ -252,15 +252,18 @@ class IncrementalPacker:
         dirty_node_rows: Set[int] = set()
         structural = False  # any node/assignment/placement change at all
 
-        # ---- diff nodes (stamp = liveness; no per-update seen set) ------
+        # ---- diff nodes (stamp = liveness; no per-update seen set).
+        # Removals run BEFORE additions: adding first can transiently push
+        # the slot count past the bucket capacity when churn replaces nodes
+        # at a full bucket (e.g. 8 slots, one vanished + one new = peak 9
+        # in an 8-row array — an IndexError a 55-minute chaos soak caught).
         node_rows_get = self._node_rows.get
         node_slots = self._node_slots
+        new_nodes: List[Node] = []
         for node in nodes:
             row = node_rows_get(node.name)
             if row is None:
-                row = self._add_node(node)
-                dirty_node_rows.add(row)
-                structural = True
+                new_nodes.append(node)
             else:
                 slot = node_slots[row]
                 slot.stamp = gen
@@ -268,21 +271,24 @@ class IncrementalPacker:
                     self._change_node(row, node)
                     dirty_node_rows.add(row)
                     structural = True
-        if len(self._node_rows) > N:
+        if len(self._node_rows) + len(new_nodes) > N:
             for name in [s.name for s in node_slots if s.stamp != gen]:
                 self._remove_node(name, dirty_node_rows)
                 structural = True
+        for node in new_nodes:
+            row = self._add_node(node)
+            dirty_node_rows.add(row)
+            structural = True
 
-        # ---- diff pods --------------------------------------------------
+        # ---- diff pods (same removals-before-additions discipline) ------
         pod_rows_get = self._pod_rows.get
         pod_slots = self._pod_slots
         assign_get = assigns.get
+        new_pods: List[Tuple[str, Pod]] = []
         for key, pod in pod_items:
             row = pod_rows_get(key)
             if row is None:
-                self._add_pod(key, pod, assign_get(key, ""))
-                dirty_pod_rows.add(len(pod_slots) - 1)
-                structural = True
+                new_pods.append((key, pod))
             else:
                 slot = pod_slots[row]
                 slot.stamp = gen
@@ -294,10 +300,13 @@ class IncrementalPacker:
                 if assign != slot.assign:
                     self._reassign(row, assign)
                     structural = True
-        if len(self._pod_rows) > P:
+        if len(self._pod_rows) + len(new_pods) > P:
             for key in [s.key for s in pod_slots if s.stamp != gen]:
                 self._remove_pod(key, dirty_pod_rows)
                 structural = True
+        for key, pod in new_pods:
+            dirty_pod_rows.add(self._add_pod(key, pod, assign_get(key, "")))
+            structural = True
 
         n, p = len(self._node_slots), len(self._pod_slots)
 

@@ -221,6 +221,46 @@ def test_in_place_node_mutation_still_dirties_the_row():
                                                  meta.node_index["a"]]
 
 
+def test_churn_at_full_bucket_swaps_without_overflow():
+    """Replacing members at EXACTLY the bucket capacity must not transiently
+    overflow the slot arrays: additions used to run before stale removals,
+    so 8 live + 1 new in an 8-row bucket indexed row 8 (IndexError — found
+    by the round-3 chaos-soak marathon, seeds 10106/10128). Removals now
+    run first; parity must hold throughout."""
+    w = World()
+    # exactly one bucket of nodes (bucket_size minimum is 8)
+    for i in range(8):
+        w.nodes[f"n{i}"] = build_test_node(f"n{i}", cpu_m=4000, mem=8 * GB)
+    for i in range(16):
+        p = build_test_pod(f"p{i}", cpu_m=100, mem=128 * MB)
+        w.pods[p.key()] = (p, f"n{i % 8}")
+    w.check()
+    # swap one node for a new one at constant count — peak would be 9
+    for step in range(4):
+        victim = f"n{step}" if step == 0 else f"extra{step - 1}"
+        for key, (pod, assign) in list(w.pods.items()):
+            if assign == victim:
+                w.pods[key] = (pod, "")
+        del w.nodes[victim]
+        w.nodes[f"extra{step}"] = build_test_node(
+            f"extra{step}", cpu_m=4000, mem=8 * GB
+        )
+        w.check()
+    # same discipline for pods: full pod bucket, one swapped per step
+    w2 = World()
+    w2.nodes["n0"] = build_test_node("n0", cpu_m=100_000, mem=64 * GB)
+    for i in range(8):
+        p = build_test_pod(f"q{i}", cpu_m=10, mem=16 * MB)
+        w2.pods[p.key()] = (p, "n0")
+    w2.check()
+    for step in range(4):
+        old = f"q{step}" if step == 0 else f"fresh{step - 1}"
+        del w2.pods[f"default/{old}"]
+        p = build_test_pod(f"fresh{step}", cpu_m=10, mem=16 * MB)
+        w2.pods[p.key()] = (p, "n0")
+        w2.check()
+
+
 def test_fake_api_taint_cordon_replace_objects():
     """FakeClusterAPI node writes must copy-on-write so identity diffing in
     the incremental packer sees them (kube/api.py contract)."""

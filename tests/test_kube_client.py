@@ -88,6 +88,7 @@ class FakeApiServer:
         self.pvcs = []
         self.pvs = []
         self.csinodes = []
+        self.storageclasses = []
         self.daemonsets = []      # apps/v1 DaemonSet objects
         self.vpas = {}            # "ns/name" -> VPA CRD object
         self.checkpoints = {}     # "ns/name" -> VPA checkpoint CRD object
@@ -204,6 +205,9 @@ class FakeApiServer:
                         "/api/v1/persistentvolumeclaims": outer.pvcs,
                         "/api/v1/persistentvolumes": outer.pvs,
                         "/apis/storage.k8s.io/v1/csinodes": outer.csinodes,
+                        "/apis/storage.k8s.io/v1/storageclasses": (
+                            outer.storageclasses
+                        ),
                     }
                     if path in storage_items:
                         if outer.storage_error:

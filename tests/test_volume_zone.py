@@ -242,3 +242,75 @@ class TestKubeClientRoundTrip:
             assert pod.volume_node_affinity[0][0].matches({ZONE: "zone-a"})
         finally:
             srv.close()
+
+
+class TestWaitForFirstConsumer:
+    """Unbound WFFC claims: StorageClass.allowedTopologies constrain where
+    the volume could be provisioned (the unbound half of the VolumeBinding
+    filter, closing the PREDICATES divergence-3 remainder)."""
+
+    def _sc(self, name="regional-ssd", zones=("zone-a", "zone-b")):
+        return {
+            "metadata": {"name": name},
+            "provisioner": "pd.csi.example.com",
+            "volumeBindingMode": "WaitForFirstConsumer",
+            "allowedTopologies": [
+                {
+                    "matchLabelExpressions": [
+                        {"key": ZONE, "values": list(zones)}
+                    ]
+                }
+            ],
+        }
+
+    def _unbound_pvc(self, name="c1", sc="regional-ssd"):
+        return {
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"storageClassName": sc},
+        }
+
+    def test_unbound_claim_constrained_by_allowed_topologies(self):
+        idx = pvc_csi_index([self._unbound_pvc()], [], [self._sc()])
+        driver, handle, terms = idx[("default", "c1")]
+        assert driver is None and handle is None  # nothing attached yet
+        assert terms[0].matches({ZONE: "zone-a"})
+        assert terms[0].matches({ZONE: "zone-b"})
+        assert not terms[0].matches({ZONE: "zone-c"})
+
+    def test_class_without_topologies_is_unconstrained(self):
+        sc = {"metadata": {"name": "any"}, "provisioner": "p"}
+        idx = pvc_csi_index([self._unbound_pvc(sc="any")], [], [sc])
+        assert ("default", "c1") not in idx  # provisions anywhere
+
+    def test_mask_excludes_disallowed_zone(self):
+        idx = pvc_csi_index([self._unbound_pvc()], [], [self._sc(zones=("zone-a",))])
+        pod = pod_from_json(
+            pod_json_with_claim("c1"), pvc_resolver=lambda ns, c: idx.get((ns, c))
+        )
+        assert not pod.csi_volumes  # no attach slot before binding
+        nodes = []
+        for z in "ab":
+            n = build_test_node(f"n-{z}", cpu_m=10_000)
+            n.labels[ZONE] = f"zone-{z}"
+            nodes.append(n)
+        mask = compute_sched_mask(nodes, [pod], [-1])
+        assert list(mask[0]) == [True, False]
+
+    def test_client_round_trip(self):
+        from tests.test_kube_client import FakeApiServer, node_json
+
+        from autoscaler_tpu.kube.client import KubeClusterAPI, KubeRestClient
+
+        srv = FakeApiServer()
+        try:
+            srv.nodes["n1"] = node_json("n1", labels={ZONE: "zone-a"})
+            srv.pods["default/p"] = pod_json_with_claim("c1")
+            srv.pvcs = [self._unbound_pvc()]
+            srv.storageclasses = [self._sc(zones=("zone-b",))]
+            api = KubeClusterAPI(KubeRestClient(srv.url))
+            (pod,) = [q for q in api.list_pods() if q.name == "p"]
+            assert pod.volume_node_affinity
+            assert not pod.volume_node_affinity[0][0].matches({ZONE: "zone-a"})
+            assert pod.volume_node_affinity[0][0].matches({ZONE: "zone-b"})
+        finally:
+            srv.close()
