@@ -25,7 +25,6 @@ import numpy as np
 
 from autoscaler_tpu.kube import objects as k8s
 from autoscaler_tpu.kube.objects import NUM_RESOURCES, Node, Pod
-from autoscaler_tpu.snapshot.affinity import _spread_effective_selector
 from autoscaler_tpu.snapshot.tensors import SnapshotTensors, bucket_size
 
 import jax.numpy as jnp
@@ -439,73 +438,110 @@ def _apply_row_rules(
     # counts itself when it matches its own selector, filtering.go:367).
     # Applied regardless of `interpod` — the dynamic affinity scan does not
     # re-evaluate spread (see PREDICATES.md).
-    for i, pod in enumerate(pods):
-        if not pod.topology_spread or not view.has(i):
-            continue
-        hard = [
-            c for c in pod.topology_spread
-            if c.when_unsatisfiable == "DoNotSchedule"
-        ]
-        if not hard:
-            continue
-        # nodeLabelsMatchSpreadConstraints: a node missing ANY of the pod's
-        # constraint keys contributes no counts for any of them
-        all_keys = {c.topology_key for c in hard}
-        has_all_keys = np.array(
-            [all(k in nodes[j].labels for k in all_keys) for j in range(N)],
-            bool,
+    #
+    # Cost structure: terms are interned across rows (shared helper with the
+    # scan-context builders) and placed-pod selector verdicts are evaluated
+    # once per distinct (namespace, labels) PROFILE with bincount
+    # accumulation — O(terms × (profiles + N + D) + rows), not
+    # O(rows × placed). The per-pod × per-placed loop this replaced
+    # measured 8.2M selector calls over five 55k-pod churn loops.
+    spread_rows = [
+        i
+        for i, pod in enumerate(pods)
+        if pod.topology_spread
+        and view.has(i)
+        and any(
+            c.when_unsatisfiable == "DoNotSchedule" for c in pod.topology_spread
         )
-        affinity_ok = None  # lazy: only when some constraint Honors it
-        taints_ok = None
-        for c in hard:
-            sel = _spread_effective_selector(c, pod)
+    ]
+    if spread_rows:
+        from autoscaler_tpu.snapshot.affinity import (
+            _intern_spread_terms,
+            _spread_node_eligible,
+        )
+
+        term_list, decls = _intern_spread_terms(
+            [pods[i] for i in spread_rows], with_sig=True
+        )
+        rows_of_term: Dict[int, List[int]] = {}
+        for li, t in decls:
+            rows_of_term.setdefault(t, []).append(spread_rows[li])
+
+        prof_index: Dict[tuple, int] = {}
+        profiles: List[Tuple[str, Dict[str, str]]] = []
+        K = len(placed)
+        placed_prof = np.empty(K, np.int64)
+        placed_node = np.empty(K, np.int64)
+        placed_live = np.empty(K, bool)
+        for k, (qi, q, j) in enumerate(placed):
+            pkey = (q.namespace, tuple(sorted(q.labels.items())))
+            pid = prof_index.setdefault(pkey, len(prof_index))
+            if pid == len(profiles):
+                profiles.append((q.namespace, q.labels))
+            placed_prof[k] = pid
+            placed_node[k] = j
+            placed_live[k] = q.deletion_ts is None
+
+        for t, (c, sel, ns, declarer, all_keys) in enumerate(term_list):
             node_dom, domains = domains_for(c.topology_key)
-            eligible = has_all_keys.copy()
-            if c.node_affinity_policy != "Ignore":  # Honor is the default
-                if affinity_ok is None:
-                    affinity_ok = np.array(
-                        [k8s.node_matches_selector(pod, n) for n in nodes], bool
+            D = max(len(domains), 1)
+            eligible = np.fromiter(
+                (
+                    _spread_node_eligible(c, all_keys, declarer, n)
+                    for n in nodes
+                ),
+                bool,
+                count=N,
+            )
+            counts = np.zeros(D, np.int64)
+            if K:
+                prof_match = np.fromiter(
+                    (
+                        pns == ns and sel.matches(lbls)
+                        for pns, lbls in profiles
+                    ),
+                    bool,
+                    count=len(profiles),
+                )
+                sel_mask = (
+                    prof_match[placed_prof]
+                    & placed_live
+                    & eligible[placed_node]
+                    & (node_dom[placed_node] >= 0)
+                )
+                doms = node_dom[placed_node[sel_mask]]
+                if doms.size:
+                    counts[: doms.max() + 1] += np.bincount(
+                        doms, minlength=doms.max() + 1
                     )
-                eligible &= affinity_ok
-            if c.node_taints_policy == "Honor":     # Ignore is the default
-                if taints_ok is None:
-                    taints_ok = np.array(
-                        [k8s.pod_tolerates_taints(pod, n.taints) for n in nodes],
-                        bool,
-                    )
-                eligible &= taints_ok
-            counts = np.zeros(max(len(domains), 1), np.int64)
-            for (qi, q, j) in placed:
+            reg = np.unique(node_dom[eligible & (node_dom >= 0)])
+            reg_mask = np.isin(node_dom, reg)
+            for i in rows_of_term[t]:
+                pod_i = pods[i]
+                self_sel = sel.matches(pod_i.labels)
+                counts_i = counts
+                j_i = node_of_pod[i]
                 if (
-                    qi != i
-                    and eligible[j]
-                    and node_dom[j] >= 0
-                    and q.namespace == pod.namespace
-                    and q.deletion_ts is None  # countPodsMatchSelector skips
-                    and sel.matches(q.labels)  # terminating pods (#87621)
+                    j_i >= 0
+                    and self_sel
+                    and eligible[j_i]
+                    and node_dom[j_i] >= 0
+                    and pod_i.deletion_ts is None
                 ):
-                    counts[node_dom[j]] += 1
-            registered = sorted(
-                {int(node_dom[j]) for j in range(N) if eligible[j] and node_dom[j] >= 0}
-            )
-            if registered:
-                min_count = int(counts[registered].min())
-            else:
-                min_count = 0
-            if (c.min_domains or 1) > len(registered):
-                min_count = 0  # minDomains not yet reached → global min is 0
-            self_match = 1 if sel.matches(pod.labels) else 0
-            # Filter runs on every node: a node lacking THIS key is
-            # unschedulable; an ineligible (policy-excluded) node still gets
-            # judged, with matchNum falling back to 0 for unregistered
-            # domains (TpPairToMatchNum miss, filtering.go:374)
-            dom_counts = counts[np.clip(node_dom, 0, None)]
-            reg_mask = np.isin(node_dom, registered)
-            dom_counts = np.where(reg_mask, dom_counts, 0)
-            allowed = (node_dom >= 0) & (
-                dom_counts + self_match - min_count <= c.max_skew
-            )
-            view[i] = view[i] & allowed
+                    # a placed pod never counts against its own row
+                    counts_i = counts.copy()
+                    counts_i[node_dom[j_i]] -= 1
+                min_count = int(counts_i[reg].min()) if reg.size else 0
+                if (c.min_domains or 1) > reg.size:
+                    min_count = 0  # minDomains unmet → global min is 0
+                self_match = 1 if self_sel else 0
+                dom_counts = np.where(
+                    reg_mask, counts_i[np.clip(node_dom, 0, None)], 0
+                )
+                allowed = (node_dom >= 0) & (
+                    dom_counts + self_match - min_count <= c.max_skew
+                )
+                view[i] = view[i] & allowed
 
     if not interpod:
         return

@@ -1,0 +1,135 @@
+"""End-to-end reconcile-loop benchmark: 5000 nodes / ~56k pods under churn.
+
+The artifact behind README's loop-time claim (previously an ad-hoc
+measurement): a kubemark-style world at 5× the reference's 1000-node GA
+scale (proposals/scalability_tests.md), driven through real
+StaticAutoscaler.run_once iterations with per-loop churn — pod add/remove,
+pending bursts (a slice carrying hard topology spread so the within-wave
+kernels run), node add — using the persistent incremental packer exactly as
+production wiring does. Prints one JSON line with per-loop seconds.
+
+Run: python benchmarks/churn_bench.py [--loops 12] [--nodes 5000]
+The measurement is CPU-backend end-to-end (host pack + kernels + control
+loop); the device kernels only get faster on the TPU.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+    from autoscaler_tpu.config.options import AutoscalingOptions
+    from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+    from autoscaler_tpu.kube.api import FakeClusterAPI
+    from autoscaler_tpu.kube.objects import (
+        LabelSelector,
+        OwnerRef,
+        TopologySpreadConstraint,
+    )
+    from autoscaler_tpu.utils.test_utils import GB, MB, build_test_node, build_test_pod
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--loops", type=int, default=12)
+    ap.add_argument("--nodes", type=int, default=5000)
+    ap.add_argument("--pods-per-node", type=int, default=11)
+    args = ap.parse_args()
+
+    ZONE = "topology.kubernetes.io/zone"
+    rng = np.random.default_rng(0)
+    provider = TestCloudProvider()
+    api = FakeClusterAPI()
+    N = args.nodes
+    GROUPS = 10
+    per_group = N // GROUPS
+    for gi in range(GROUPS):
+        tmpl = build_test_node(f"g{gi}-tmpl", cpu_m=8000, mem=32 * GB)
+        tmpl.labels[ZONE] = f"zone-{'abc'[gi % 3]}"
+        provider.add_node_group(f"g{gi}", 0, per_group + 50, per_group, tmpl)
+        for i in range(per_group):
+            node = build_test_node(f"g{gi}-{i}", cpu_m=8000, mem=32 * GB)
+            node.labels[ZONE] = f"zone-{'abc'[gi % 3]}"
+            provider.add_node(f"g{gi}", node)
+            api.add_node(node)
+    nodes = list(api.nodes.values())
+    pi = 0
+    for node in nodes:
+        for _ in range(args.pods_per_node):
+            p = build_test_pod(
+                f"run-{pi}", cpu_m=250, mem=1 * GB, node_name=node.name,
+                labels={"app": f"a{pi % 20}"},
+            )
+            p.owner_ref = OwnerRef(kind="ReplicaSet", name=f"rs-{pi % 20}")
+            api.add_pod(p)
+            pi += 1
+
+    opts = AutoscalingOptions(scale_down_delay_after_add_s=0.0)
+    autoscaler = StaticAutoscaler(provider, api, opts)
+
+    times = []
+    burst_id = 0
+    for loop in range(args.loops):
+        # churn: ~50 pod deletes, ~50 adds, one pending burst (some spread)
+        keys = list(api.pods)
+        for key in keys[loop * 7 :: max(1, len(keys) // 50)][:50]:
+            api.pods.pop(key, None)
+        for j in range(50):
+            name = f"churn-{loop}-{j}"
+            node = nodes[int(rng.integers(0, len(nodes)))]
+            p = build_test_pod(
+                name, cpu_m=250, mem=1 * GB, node_name=node.name,
+                labels={"app": f"a{j % 20}"},
+            )
+            p.owner_ref = OwnerRef(kind="ReplicaSet", name=f"rs-{j % 20}")
+            api.add_pod(p)
+        for j in range(30):
+            p = build_test_pod(
+                f"burst-{burst_id}", cpu_m=500, mem=2 * GB,
+                labels={"app": "burst"},
+            )
+            p.owner_ref = OwnerRef(kind="ReplicaSet", name="burst-rs")
+            if j % 3 == 0:
+                p.topology_spread = (
+                    TopologySpreadConstraint(
+                        max_skew=2, topology_key=ZONE,
+                        selector=LabelSelector.from_dict({"app": "burst"}),
+                    ),
+                )
+            api.add_pod(p)
+            burst_id += 1
+        t0 = time.perf_counter()
+        autoscaler.run_once(now_ts=1000.0 + loop * 60.0)
+        times.append(time.perf_counter() - t0)
+
+    steady = times[2:] if len(times) > 2 else times  # first loops pay jit compiles
+    print(
+        json.dumps(
+            {
+                "metric": f"reconcile_loop_{N}nodes_churn",
+                "nodes": N,
+                "pods": len(api.pods),
+                "loops": args.loops,
+                "loop_s_min": round(min(steady), 3),
+                "loop_s_median": round(float(np.median(steady)), 3),
+                "loop_s_max": round(max(steady), 3),
+                "first_loop_s": round(times[0], 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
