@@ -46,6 +46,7 @@ def _spec_fingerprint(pod: Pod) -> Tuple:
         else None,
         pod.topology_spread,  # the spread scan gate reads run exemplars
         pod.volume_node_affinity,  # bound-PV placement constraints
+        pod.rwop_handles,
         pod.priority,
     )
 

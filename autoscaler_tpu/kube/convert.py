@@ -291,7 +291,7 @@ def pvc_csi_index(
     pvcs: Sequence[Dict[str, Any]],
     pvs: Sequence[Dict[str, Any]],
     storage_classes: Sequence[Dict[str, Any]] = (),
-) -> Dict[Tuple[str, str], Tuple[Optional[str], Optional[str], Tuple]]:
+) -> Dict[Tuple[str, str], Tuple[Optional[str], Optional[str], Tuple, Optional[str]]]:
     """→ {(namespace, claimName): (csi_driver | None, volumeHandle | None,
     pv_node_affinity_terms)} for claims bound to PersistentVolumes.
 
@@ -300,7 +300,9 @@ def pvc_csi_index(
     counting sees one attachment per node, not two. Non-CSI PVs (hostPath,
     NFS, local, ...) resolve with driver=None — no attach slot — but their
     node-affinity terms STILL constrain placement (round 3: the
-    VolumeBinding/VolumeZone rule)."""
+    VolumeBinding/VolumeZone rule). The 4th element is the claim's unique id
+    when its accessModes include ReadWriteOncePod (the VolumeRestrictions
+    filter input), else None."""
     pv_by_name: Dict[str, Tuple[Optional[str], Optional[str], Tuple]] = {}
     for pv in pvs:
         name = (pv.get("metadata") or {}).get("name", "")
@@ -316,15 +318,26 @@ def pvc_csi_index(
         terms = storageclass_topology_terms(sc)
         if terms:
             sc_terms[name] = terms
-    out: Dict[Tuple[str, str], Tuple[Optional[str], Optional[str], Tuple]] = {}
+    out: Dict[
+        Tuple[str, str], Tuple[Optional[str], Optional[str], Tuple, Optional[str]]
+    ] = {}
     for pvc in pvcs:
         meta = pvc.get("metadata") or {}
         spec = pvc.get("spec") or {}
         vol = spec.get("volumeName") or ""
         key = (meta.get("namespace", "default"), meta.get("name", ""))
+        rwop = (
+            f"claim:{key[0]}/{key[1]}"
+            if "ReadWriteOncePod" in (spec.get("accessModes") or ())
+            else None
+        )
         hit = pv_by_name.get(vol)
         if hit is not None:
-            out[key] = hit
+            out[key] = hit + (rwop,)
+        elif rwop and vol:
+            # bound to a PV we did not index (no CSI, no affinity): the RWOP
+            # exclusivity still holds
+            out[key] = (None, None, (), rwop)
         elif not vol:
             # UNBOUND claim: the StorageClass's allowedTopologies constrain
             # where a WaitForFirstConsumer volume could be provisioned —
@@ -332,8 +345,8 @@ def pvc_csi_index(
             # class without allowedTopologies (or no class) provisions
             # anywhere: unconstrained, no entry.
             terms = sc_terms.get(spec.get("storageClassName") or "")
-            if terms:
-                out[key] = (None, None, terms)
+            if terms or rwop:
+                out[key] = (None, None, terms or (), rwop)
     return out
 
 
@@ -359,6 +372,7 @@ def pod_from_json(
                 host_ports.append(int(port["hostPort"]))
     csi_volumes: List[tuple] = []
     volume_affinity: List[tuple] = []
+    rwop_handles: List[str] = []
     pod_key = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
     for v in spec.get("volumes") or ():
         if "emptyDir" in v or "hostPath" in v:
@@ -378,11 +392,13 @@ def pod_from_json(
                 meta.get("namespace", "default"), pvc["claimName"]
             )
             if resolved is not None:
-                driver, handle, pv_terms = resolved
+                driver, handle, pv_terms, rwop = resolved
                 if driver:
                     csi_volumes.append((driver, handle))
                 if pv_terms:
                     volume_affinity.append(tuple(pv_terms))
+                if rwop:
+                    rwop_handles.append(rwop)
 
     owner = None
     for ref in meta.get("ownerReferences") or ():
@@ -446,6 +462,7 @@ def pod_from_json(
         host_ports=tuple(host_ports),
         csi_volumes=tuple(csi_volumes),
         volume_node_affinity=tuple(volume_affinity),
+        rwop_handles=tuple(rwop_handles),
         mirror=MIRROR_ANNOTATION in annotations,
         daemonset=bool(owner and owner.kind == "DaemonSet"),
         restartable=owner is not None,

@@ -244,6 +244,10 @@ class Pod:
     # volume can attach to (VolumeBinding/VolumeZone filter input; empty =
     # unconstrained)
     volume_node_affinity: Tuple[Tuple["LabelSelector", ...], ...] = ()
+    # Unique ids of ReadWriteOncePod claims the pod mounts: the
+    # VolumeRestrictions filter fails a pod on EVERY node while another live
+    # pod uses the same RWOP claim
+    rwop_handles: Tuple[str, ...] = ()
     mirror: bool = False          # static/mirror pod
     daemonset: bool = False
     restartable: bool = True      # has a controller that will recreate it

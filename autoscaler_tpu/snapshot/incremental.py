@@ -66,7 +66,7 @@ class _PodSlot:
     __slots__ = (
         "key", "orig", "eff", "assign", "prof_key", "class_id", "gen",
         "stamp", "has_interpod", "has_anti", "has_hard_spread", "has_portcsi",
-        "sel_keys", "csi_drivers",
+        "has_rwop", "sel_keys", "csi_drivers",
     )
 
     def __init__(self, key: str, pod: Pod, assign: str, gen: int):
@@ -94,6 +94,7 @@ class _PodSlot:
             c.when_unsatisfiable == "DoNotSchedule" for c in pod.topology_spread
         )
         self.has_portcsi = bool(pod.host_ports or pod.csi_volumes)
+        self.has_rwop = bool(pod.rwop_handles)
         keys: Set[str] = set(pod.node_selector.keys())
         if aff:
             for term in aff.node_selector_terms:
@@ -185,10 +186,12 @@ class IncrementalPacker:
         self._interpod_rows: Set[int] = set()
         self._spread_rows: Set[int] = set()
         self._anti_rows: Set[int] = set()       # rows with own anti terms
+        self._rwop_rows: Set[int] = set()       # rows mounting RWOP claims
         self._anti_match_rows: Set[int] = set()  # rows matched by placed anti
         self._anti_sig: tuple = ()
         self._exc_prev: Set[int] = set()
-        self._override_prev: List[Tuple[int, int]] = []
+        self._exc_shape_dirty = False  # exc membership moved/died this update
+        self._override_prev: Set[Tuple[int, int]] = set()
         # refcounts for the global key sets
         self._relkey_count: Dict[str, int] = {}
         self._csidrv_count: Dict[str, int] = {}
@@ -427,15 +430,42 @@ class IncrementalPacker:
             hits = self._scan_anti_matches(i for i in dirty_pod_rows if i < p)
             self._anti_match_rows -= {i for i in dirty_pod_rows if i < p}
             self._anti_match_rows |= hits
+        # RWOP conflict rows: cheap per-update recount over the (tiny) set of
+        # pods that mount RWOP claims — membership depends on OTHER pods'
+        # liveness/placement, so it cannot be a static per-slot flag. Same
+        # semantics as packer._rwop_conflict_rows: only live PLACED sharers
+        # count, a pod's own usage never blocks it, terminating pods are
+        # neither counted nor blocked.
+        rwop_conflicts: Set[int] = set()
+        if self._rwop_rows:
+            cnt: Dict[str, int] = {}
+            for i in self._rwop_rows:
+                pod = self._pod_slots[i].orig
+                if pod.deletion_ts is None and self._pod_node_of(i) >= 0:
+                    for h in set(pod.rwop_handles):
+                        cnt[h] = cnt.get(h, 0) + 1
+            if cnt:
+                for i in self._rwop_rows:
+                    pod = self._pod_slots[i].orig
+                    if pod.deletion_ts is not None:
+                        continue
+                    own = 1 if self._pod_node_of(i) >= 0 else 0
+                    if any(
+                        cnt.get(h, 0) - own >= 1
+                        for h in set(pod.rwop_handles)
+                    ):
+                        rwop_conflicts.add(i)
         exc = (
             self._interpod_rows | self._spread_rows | self._anti_match_rows
+            | rwop_conflicts
         )
         exc = {i for i in exc if i < p}
         exc_dirty = (
-            (exc or self._exc_prev)
+            (exc or self._exc_prev or self._exc_shape_dirty)
             and (structural or dirty_pod_rows or dirty_node_rows
-                 or exc != self._exc_prev)
+                 or exc != self._exc_prev or self._exc_shape_dirty)
         )
+        self._exc_shape_dirty = False
 
         # ---- overrides (sparse self-cells) ------------------------------
         overrides = self._compute_overrides()
@@ -449,7 +479,7 @@ class IncrementalPacker:
         else:
             self._update_factored(n, p, overrides, exc, bool(exc_dirty))
         self._exc_prev = exc
-        self._override_prev = [(i, j) for i, j, _ in overrides]
+        self._override_prev = {(i, j) for i, j, _ in overrides}
 
         if dirty_pod_rows:
             self._dirty_fields.update(("pod_req", "pod_valid", "pod_class"))
@@ -473,6 +503,8 @@ class IncrementalPacker:
             self._spread_rows.add(row)
         if slot.has_anti:
             self._anti_rows.add(row)
+        if slot.has_rwop:
+            self._rwop_rows.add(row)
         for k in slot.sel_keys:
             self._relkey_count[k] = self._relkey_count.get(k, 0) + 1
         for d in slot.csi_drivers:
@@ -484,6 +516,7 @@ class IncrementalPacker:
         self._spread_rows.discard(row)
         self._anti_rows.discard(row)
         self._anti_match_rows.discard(row)
+        self._rwop_rows.discard(row)
         for k in slot.sel_keys:
             c = self._relkey_count[k] - 1
             if c:
@@ -551,6 +584,18 @@ class IncrementalPacker:
         last = len(self._pod_slots) - 1
         dirty.discard(row)  # the removed pod's pending dirtiness dies with it
         self._pod_node_stale.discard(row)
+        # membership of the REMOVED row in the previous-exception/override
+        # bookkeeping dies with it — but the DISAPPEARANCE itself must still
+        # force an exception rebuild (exc_dirty would otherwise compare
+        # empty == empty while the factored pod_exc table still maps rows)
+        if row in self._exc_prev:
+            self._exc_prev.discard(row)
+            self._exc_shape_dirty = True
+        if any(i == row for (i, _j) in self._override_prev):
+            self._override_prev = {
+                (i, j) for (i, j) in self._override_prev if i != row
+            }
+            self._exc_shape_dirty = True
         if row != last:
             self._move_pod_row(last, row)
             if last in dirty:
@@ -576,7 +621,7 @@ class IncrementalPacker:
         self._pod_rows[slot.key] = dst
         for coll in (
             self._portcsi_rows, self._interpod_rows, self._spread_rows,
-            self._anti_rows, self._anti_match_rows,
+            self._anti_rows, self._anti_match_rows, self._rwop_rows,
         ):
             if src in coll:
                 coll.discard(src)
@@ -589,6 +634,18 @@ class IncrementalPacker:
         if src in self._pod_node_stale:
             self._pod_node_stale.discard(src)
             self._pod_node_stale.add(dst)
+        # previous-exception/override bookkeeping must follow the moved row,
+        # or a conflict that CLEARS in the same update as a swap-fill resets
+        # the wrong (dead) row and leaves the moved pod's mask stale — found
+        # by the RWOP incremental-parity test
+        if src in self._exc_prev:
+            self._exc_prev.discard(src)
+            self._exc_prev.add(dst)
+            self._exc_shape_dirty = True
+        if self._override_prev:
+            self._override_prev = {
+                (dst if i == src else i, j) for (i, j) in self._override_prev
+            }
         self._eff_list[dst] = self._eff_list[src]
         self._pod_req[dst] = self._pod_req[src]
         self._pod_valid[dst] = self._pod_valid[src]
@@ -651,6 +708,10 @@ class IncrementalPacker:
             self._node_dyn[dst] = self._node_dyn.pop(src)
         else:
             self._node_dyn.pop(dst, None)
+        if self._override_prev:
+            self._override_prev = {
+                (i, dst if j == src else j) for (i, j) in self._override_prev
+            }
         if self._mask is not None:
             self._mask[:, dst] = self._mask[:, src]
         # pod_node entries pointing at src must follow the move

@@ -362,16 +362,46 @@ class _RowView:
         self.arr[i if self.row_of is None else self.row_of[i]] = v
 
 
+def _rwop_conflict_rows(pods: Sequence[Pod], node_of_pod: Sequence[int]) -> set:
+    """Rows blocked by the VolumeRestrictions ReadWriteOncePod rule: a LIVE
+    pod whose RWOP claim another live PLACED pod uses fails on every node
+    (exclusive single-pod access). The claim is "in use" only once a pod
+    runs, so: pending-vs-pending sharers do not conflict statically (the
+    scheduler would admit the first — within one wave both may be judged
+    schedulable, the same one-wave conservatism as other counted
+    predicates); a placed pod's own usage never blocks its own row (it may
+    move); terminating pods neither count nor get blocked (the claim frees
+    when they finish)."""
+    placed_count: Dict[str, int] = {}
+    for i, pod in enumerate(pods):
+        if pod.rwop_handles and pod.deletion_ts is None and node_of_pod[i] >= 0:
+            for h in set(pod.rwop_handles):  # two mounts of one claim in one
+                placed_count[h] = placed_count.get(h, 0) + 1  # pod = one user
+    if not placed_count:
+        return set()
+    out = set()
+    for i, pod in enumerate(pods):
+        if not pod.rwop_handles or pod.deletion_ts is not None:
+            continue
+        own = 1 if node_of_pod[i] >= 0 else 0
+        if any(
+            placed_count.get(h, 0) - own >= 1 for h in set(pod.rwop_handles)
+        ):
+            out.add(i)
+    return out
+
+
 def _exception_pods(
     pods: Sequence[Pod], node_of_pod: Sequence[int], interpod: bool
 ) -> List[int]:
     """Pod indices whose mask rows the affinity rules below may modify: pods
     with inter-pod (anti-)affinity and pods matching a placed pod's
-    anti-affinity term (the symmetric rule). Host ports are NOT here — they
-    are class-structured (see _profile_factorization) apart from sparse
-    self-cell overrides, so a host-port DaemonSet on every node costs O(N)
-    cells, not O(N) dense rows."""
-    exc: set = set()
+    anti-affinity term (the symmetric rule), hard-spread pods, and RWOP
+    conflict rows. Host ports are NOT here — they are class-structured
+    (see _profile_factorization) apart from sparse self-cell overrides, so
+    a host-port DaemonSet on every node costs O(N) cells, not O(N) dense
+    rows."""
+    exc: set = _rwop_conflict_rows(pods, node_of_pod)
     placed_anti: List[Tuple[int, Pod, k8s.PodAffinityTerm]] = []
     for i, pod in enumerate(pods):
         if interpod and pod.affinity and (
@@ -418,6 +448,13 @@ def _apply_row_rules(
     placed = [
         (i, pods[i], node_of_pod[i]) for i in range(P) if node_of_pod[i] >= 0
     ]
+    # VolumeRestrictions (ReadWriteOncePod): a pod whose RWOP claim another
+    # live PLACED pod uses is unschedulable on EVERY node (and, if itself
+    # placed, unmovable in the refit) — the filter's exclusivity rule.
+    for i in _rwop_conflict_rows(pods, node_of_pod):
+        if view.has(i):
+            view[i] = np.zeros(N, bool)
+
     domain_cache: Dict[str, Tuple[np.ndarray, Dict[str, int]]] = {}
 
     def domains_for(key: str):

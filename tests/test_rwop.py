@@ -1,0 +1,188 @@
+"""VolumeRestrictions — ReadWriteOncePod exclusivity.
+
+Reference: the scheduler framework's VolumeRestrictions filter fails a pod
+on EVERY node while another live pod uses the same ReadWriteOncePod claim;
+CA exercises it via schedulerbased.go:129. Previously a documented
+PREDICATES.md divergence (a pending pod with an in-use RWOP claim looked
+schedulable → one spurious scale-up per loop); now a mask rule: RWOP
+conflict rows are all-False in both the dense and factored paths, shared
+with the incremental packer.
+"""
+import numpy as np
+
+from autoscaler_tpu.kube.convert import pod_from_json, pvc_csi_index
+from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+from autoscaler_tpu.snapshot.incremental import IncrementalPacker
+from autoscaler_tpu.snapshot.packer import compute_factored_mask, compute_sched_mask
+from autoscaler_tpu.utils.test_utils import build_test_node, build_test_pod
+
+
+def rwop_pod(name, handle="claim:default/data", deleting=False):
+    p = build_test_pod(name, cpu_m=100)
+    p.rwop_handles = (handle,)
+    if deleting:
+        p.deletion_ts = 9.0
+    return p
+
+
+class TestResolution:
+    def test_rwop_claim_resolves(self):
+        pvcs = [
+            {
+                "metadata": {"name": "data", "namespace": "default"},
+                "spec": {
+                    "volumeName": "pv1",
+                    "accessModes": ["ReadWriteOncePod"],
+                },
+            }
+        ]
+        pvs = [
+            {
+                "metadata": {"name": "pv1"},
+                "spec": {"csi": {"driver": "d", "volumeHandle": "h1"}},
+            }
+        ]
+        idx = pvc_csi_index(pvcs, pvs)
+        driver, handle, terms, rwop = idx[("default", "data")]
+        assert (driver, handle) == ("d", "h1")
+        assert rwop == "claim:default/data"
+        pod = pod_from_json(
+            {
+                "metadata": {"name": "p", "namespace": "default"},
+                "spec": {
+                    "containers": [{"name": "c"}],
+                    "volumes": [
+                        {
+                            "name": "v",
+                            "persistentVolumeClaim": {"claimName": "data"},
+                        }
+                    ],
+                },
+            },
+            pvc_resolver=lambda ns, c: idx.get((ns, c)),
+        )
+        assert pod.rwop_handles == ("claim:default/data",)
+
+    def test_unbound_rwop_claim_still_exclusive(self):
+        pvcs = [
+            {
+                "metadata": {"name": "data", "namespace": "default"},
+                "spec": {"accessModes": ["ReadWriteOncePod"]},
+            }
+        ]
+        idx = pvc_csi_index(pvcs, [])
+        assert idx[("default", "data")] == (None, None, (), "claim:default/data")
+
+
+class TestMask:
+    def test_in_use_claim_blocks_everywhere(self):
+        nodes = [build_test_node(f"n{j}", cpu_m=10_000) for j in range(3)]
+        owner = rwop_pod("owner")
+        pending = rwop_pod("pending")
+        plain = build_test_pod("plain", cpu_m=100)
+        mask = compute_sched_mask(nodes, [owner, pending, plain], [0, -1, -1])
+        assert not mask[1].any()   # conflict: blocked on every node
+        # the sole PLACED user is the legitimate one — movable (its own
+        # usage never blocks its own row)
+        assert mask[0].all()
+        assert mask[2].all()
+        from tests.test_factored_mask import expand
+
+        fm = expand(
+            compute_factored_mask(nodes, [owner, pending, plain], [0, -1, -1]),
+            3, 3,
+        )
+        np.testing.assert_array_equal(fm, mask)
+
+    def test_two_placed_sharers_both_blocked(self):
+        """A config violation (two running pods on one RWOP claim): both are
+        unmovable — each sees ANOTHER placed user."""
+        nodes = [build_test_node(f"n{j}", cpu_m=10_000) for j in range(2)]
+        a, b = rwop_pod("a"), rwop_pod("b")
+        mask = compute_sched_mask(nodes, [a, b], [0, 1])
+        assert not mask[0].any() and not mask[1].any()
+
+    def test_pending_pair_not_statically_blocked(self):
+        """The claim is in use only once a pod RUNS: two pending sharers are
+        both admissible statically (the scheduler admits the first; the
+        one-wave conservatism note in _rwop_conflict_rows covers the rest)."""
+        nodes = [build_test_node("n0", cpu_m=10_000)]
+        a, b = rwop_pod("a"), rwop_pod("b")
+        mask = compute_sched_mask(nodes, [a, b], [-1, -1])
+        assert mask[0].all() and mask[1].all()
+
+    def test_double_mount_of_one_claim_is_one_user(self):
+        """One pod mounting the same RWOP claim through two volume entries
+        is still a single user — it must not conflict with itself."""
+        nodes = [build_test_node("n0", cpu_m=10_000)]
+        p = build_test_pod("p", cpu_m=100)
+        p.rwop_handles = ("claim:default/data", "claim:default/data")
+        mask = compute_sched_mask(nodes, [p], [0])
+        assert mask[0].all()
+
+    def test_sole_user_unblocked(self):
+        nodes = [build_test_node("n0", cpu_m=10_000)]
+        solo = rwop_pod("solo")
+        mask = compute_sched_mask(nodes, [solo], [-1])
+        assert mask[0].all()
+
+    def test_terminating_sharer_frees_the_claim(self):
+        nodes = [build_test_node("n0", cpu_m=10_000)]
+        leaving = rwop_pod("leaving", deleting=True)
+        pending = rwop_pod("pending")
+        mask = compute_sched_mask(nodes, [leaving, pending], [0, -1])
+        assert mask[1].all()  # the claim frees when the sharer finishes
+        assert mask[0].all()  # the terminating pod is never blocked either
+
+    def test_distinct_claims_do_not_conflict(self):
+        nodes = [build_test_node("n0", cpu_m=10_000)]
+        a = rwop_pod("a", handle="claim:default/one")
+        b = rwop_pod("b", handle="claim:default/two")
+        mask = compute_sched_mask(nodes, [a, b], [0, -1])
+        assert mask[1].all()
+
+
+class TestIncrementalParity:
+    def test_conflict_appears_and_clears_across_updates(self):
+        packer = IncrementalPacker()
+        snap = ClusterSnapshot(packer=packer)
+        for j in range(2):
+            snap.add_node(build_test_node(f"n{j}", cpu_m=10_000))
+        owner = rwop_pod("owner")
+        snap.add_pod(owner, "n0")
+        pending = rwop_pod("pending")
+        snap.add_pod(pending)
+        t, meta = snap.tensors()
+        m = np.asarray(t.dense_sched())
+        assert not m[meta.pod_index["default/pending"]].any()
+        # the owner leaves → next update clears the conflict
+        snap.remove_pod("default/owner")
+        t2, meta2 = snap.tensors()
+        m2 = np.asarray(t2.dense_sched())
+        assert m2[meta2.pod_index["default/pending"], :2].all()
+        # full-pack parity
+        full = compute_sched_mask(
+            [snap.get_node("n0"), snap.get_node("n1")], [pending], [-1]
+        )
+        np.testing.assert_array_equal(m2[meta2.pod_index["default/pending"], :2],
+                                      full[0])
+
+
+class TestScaleDown:
+    def test_shared_rwop_mover_makes_drain_infeasible(self):
+        """A mover whose RWOP claim another pod uses cannot re-place
+        anywhere → the drain is correctly judged infeasible."""
+        from autoscaler_tpu.simulator.removal import RemovalSimulator
+
+        snap = ClusterSnapshot()
+        snap.add_node(build_test_node("n0", cpu_m=1000))
+        snap.add_node(build_test_node("n1", cpu_m=10_000))
+        mover = rwop_pod("mover")
+        sharer = rwop_pod("sharer")
+        snap.add_pod(mover, "n0")
+        snap.add_pod(sharer, "n1")
+        to_remove, unremovable = RemovalSimulator().find_nodes_to_remove(
+            snap, ["n0"]
+        )
+        assert not to_remove
+        assert unremovable and unremovable[0].node.name == "n0"

@@ -68,14 +68,14 @@ def pod_json_with_claim(claim):
 class TestResolution:
     def test_csi_pv_carries_affinity_and_handle(self):
         idx = pvc_csi_index([pvc("c1", "pv1")], [zonal_pv("pv1", "zone-a")])
-        driver, handle, terms = idx[("default", "c1")]
+        driver, handle, terms, _rwop = idx[("default", "c1")]
         assert driver == "pd.csi.example.com" and handle == "h-pv1"
         assert terms and terms[0].matches({ZONE: "zone-a"})
         assert not terms[0].matches({ZONE: "zone-b"})
 
     def test_non_csi_local_pv_still_constrains(self):
         idx = pvc_csi_index([pvc("c1", "pv1")], [zonal_pv("pv1", "zone-a", csi=False)])
-        driver, handle, terms = idx[("default", "c1")]
+        driver, handle, terms, _rwop = idx[("default", "c1")]
         assert driver is None  # no attach slot for non-CSI volumes
         assert terms and terms[0].matches({ZONE: "zone-a"})
 
@@ -271,7 +271,7 @@ class TestWaitForFirstConsumer:
 
     def test_unbound_claim_constrained_by_allowed_topologies(self):
         idx = pvc_csi_index([self._unbound_pvc()], [], [self._sc()])
-        driver, handle, terms = idx[("default", "c1")]
+        driver, handle, terms, _rwop = idx[("default", "c1")]
         assert driver is None and handle is None  # nothing attached yet
         assert terms[0].matches({ZONE: "zone-a"})
         assert terms[0].matches({ZONE: "zone-b"})
