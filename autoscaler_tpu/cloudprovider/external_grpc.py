@@ -13,6 +13,7 @@ integration in any language without forking the autoscaler. Here:
 """
 from __future__ import annotations
 
+import logging
 from concurrent import futures
 from typing import Dict, List, Optional, Sequence
 
@@ -34,6 +35,8 @@ from autoscaler_tpu.cloudprovider.interface import (
 from autoscaler_tpu.config.options import NodeGroupAutoscalingOptions
 from autoscaler_tpu.kube.objects import NUM_RESOURCES, Node, Pod, Resources, Taint
 from autoscaler_tpu.rpc import autoscaler_pb2 as pb
+
+logger = logging.getLogger("autoscaler_tpu")
 
 PROVIDER_SERVICE = "autoscaler_tpu.CloudProviderService"
 
@@ -379,9 +382,15 @@ class _RemoteNodeGroup(NodeGroup):
                     ),
                 ),
             )
-        except grpc.RpcError:
+        except grpc.RpcError as e:
             # reference semantics: an RPC error means "use defaults"
-            # (externalgrpc.proto:111)
+            # (externalgrpc.proto:111) — but log first, as the reference
+            # client does (klog.V(1)), so a persistently broken provider
+            # endpoint degrades visibly instead of silently
+            logger.warning(
+                "NodeGroupGetOptions(%s) failed, using defaults: %s",
+                self._spec.id, e,
+            )
             return None
         if not resp.has:
             return None
@@ -463,8 +472,9 @@ class ExternalGrpcCloudProvider(CloudProvider):
         self._node_group_cache.clear()
         # server-derived limits refetch next read so runtime cap changes on
         # the provider side propagate within one loop (host-provided limits
-        # stay sticky)
+        # stay sticky); same for the GPU label
         self._limiter = None
+        self._gpu_label = None
 
     def pricing(self) -> Optional[PricingModel]:
         return _RemotePricingModel(self)

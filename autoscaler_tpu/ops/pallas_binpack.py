@@ -3,23 +3,42 @@ multi-group estimator.
 
 The XLA scan in ops/binpack.ffd_binpack_groups is HBM-bound: every pod step
 reads and rewrites its usage carry (~12MB at G=500, M=1000), which costs
-~50-80µs/step on a v5e. Here the carry lives in VMEM for a whole chunk of
-pods: the grid is (group-blocks,) and each program runs CHUNK scan steps
-against its [R, GB, M] FREE-capacity block without touching HBM, so a step
-is pure VPU work (one compare pass + one-hot update per resource plane).
+~50-80µs/step on a v5e. Here the carry lives in VMEM for the WHOLE scan: the
+grid is (group-blocks, pod-chunks) with the chunk axis 'arbitrary' (serial),
+so each group-block's [R, GB, M] FREE-capacity carry stays resident in VMEM
+across all pod chunks and a step is pure VPU work (one compare pass + one-hot
+update per resource plane).
+
+Round-4 restructure (measured decomposition, benchmarks/pallas_profile.py +
+captures/pallas_profile_tpu_r4.json): the round-3 version spent only ~0.66s
+of its 2.7-2.9s inside the kernel (1.6µs/step) — the rest was XLA glue with
+pathological gather/scatter lowerings on TPU: argsort + take_along_axis
+(0.64s), per-chunk pod_req[idx] gathers inside a host-side lax.scan (0.16s +
+dispatch), and the final scheduled-bits scatter (0.45s). All three are gone:
+
+  * ONE stable `lax.sort` carries the per-resource request columns and an
+    original-index payload along the score sort (0.23s at 100k x 512 — 3x
+    cheaper than argsort + gathers, because TPU sorts are vectorized while
+    row gathers are not).
+  * The pod-chunk loop moved INTO the pallas grid: no per-chunk dispatch, no
+    per-chunk carry HBM round-trip, no gathers — chunks slice a pre-sorted
+    [R, P, G] stream via BlockSpec index maps.
+  * The scheduled un-sort is a second `lax.sort` keyed on the sorted
+    original-index payload (0.15s vs 0.45s for the scatter formulation).
 
 Layout notes (Mosaic constraints): the carry is resource-major ([R, GB, M])
-so each per-resource plane is a contiguous tile-aligned [GB sublanes × M
+so each per-resource plane is a contiguous tile-aligned [GB sublanes x M
 lanes] block; the request stream puts the step axis on the sublane
 dimension ([R, CHUNK, GB]) and the kernel walks it in 8-step tiles with an
 unrolled inner loop, so every dynamic offset is provably 8-aligned.
-Inactive pods (mask-failed / pad) travel as +inf request rows — no separate
-active stream. Closed nodes hold free == alloc, letting one unmasked
-first-fit min implement both "first open node that fits" and "open a new
-node" (see the kernel comment). The per-chunk pallas_call carries are
-donated (input_output_aliased), so chunk dispatch costs one HBM round-trip
-of the carry instead of one per pod; resource axes nobody requests are
-dropped before the kernel (exact — see the compression comment).
+Inactive pods (mask-failed / pad) travel as +inf request rows — the mask is
+folded into the columns BEFORE the sort (sorting permutes (key, payload)
+tuples elementwise, so where(mask, col, inf) commutes with the sort) and no
+separate active stream or mask payload exists at all. Closed nodes hold
+free == alloc, letting one unmasked first-fit min implement both "first open
+node that fits" and "open a new node" (see the kernel comment). Resource
+axes nobody requests are dropped before the kernel (exact — see the
+compression comment).
 
 Semantics are bit-identical to ffd_binpack_groups (same FFD rules:
 score-descending order, first-fit in node-open order, open-on-miss,
@@ -44,12 +63,11 @@ _STEP_TILE = 8  # sublane tile: dynamic offsets must be provably 8-aligned
 
 def _scan_kernel(
     req_ref,      # [R, CHUNK, GB] f32 — sorted pod requests, +inf = inactive
-    caps_ref,     # [1, GB] i32
-    free_in_ref,  # [R, GB, M] f32 (aliased with free_out)
-    opened_in_ref,  # [1, GB] i32 (aliased with opened_out)
-    free_ref,     # [R, GB, M] f32 out
-    opened_ref,   # [1, GB] i32 out
-    placed_ref,   # [CHUNK, GB] i32 out
+    caps_ref,     # [GB, 1] i32 (sublane-resident, matching `first`'s layout)
+    allocs_ref,   # [R, GB] f32 — template allocs (carry init at chunk 0)
+    free_ref,     # [R, GB, M] f32 out — VMEM-resident across the chunk axis
+    opened_ref,   # [1, GB] i32 out — resident likewise
+    placed_ref,   # [CHUNK, GB] i32 out — flushed per chunk
     *,
     num_resources: int,
     chunk: int,
@@ -66,10 +84,19 @@ def _scan_kernel(
     gb = free_ref.shape[1]
     R = num_resources
     node_iota = jax.lax.broadcasted_iota(jnp.int32, (gb, max_nodes), 1)
-    caps = caps_ref[0, :]                               # [GB]
+    caps = caps_ref[:, 0]                               # [GB] sublane vector
 
-    free_ref[:] = free_in_ref[:]
-    opened_ref[:] = opened_in_ref[:]
+    # The carry blocks' index maps ignore the chunk grid axis, so Mosaic
+    # keeps them VMEM-resident across chunks and writes back once per group
+    # block (the standard revisited-block reduction pattern). Initialize at
+    # the first chunk: every node (open or not) starts at free == alloc.
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        for r in range(R):
+            free_ref[r, :, :] = jnp.broadcast_to(
+                allocs_ref[r, :][:, None], (gb, max_nodes)
+            )
+        opened_ref[:] = jnp.zeros((1, gb), jnp.int32)
 
     def tile_step(t, _):
         base = t * _STEP_TILE
@@ -125,104 +152,53 @@ def _scan_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("chunk", "max_nodes", "group_block", "interpret")
+    jax.jit,
+    static_argnames=("max_nodes", "chunk", "group_block", "interpret"),
 )
-def _run_chunk(
-    req_chunk,   # [R, CHUNK, G] f32 (+inf rows = inactive)
-    caps,        # [1, G] i32
-    free,        # [R, G, M] f32
-    opened,      # [1, G] i32
-    chunk: int,
+def _pallas_scan_all(
+    stream,           # [R, P_pad, G_pad] f32 — score-sorted requests, +inf inactive
+    allocs_in,        # [R, G_pad] f32
+    caps_col,         # [G_pad, 1] i32
     max_nodes: int,
+    chunk: int,
     group_block: int,
     interpret: bool,
 ):
-    R = req_chunk.shape[0]
-    G = req_chunk.shape[2]
-    grid = (G // group_block,)
+    """One pallas_call covering the whole scan: grid (group-blocks, chunks),
+    chunk axis 'arbitrary' (serial) with the free/opened carry blocks
+    revisited — resident in VMEM across chunks, written back once per group
+    block. No host-side chunk loop, no per-chunk gathers, no carry HBM
+    round-trips. (Round 3 dispatched one pallas_call per chunk from a
+    lax.scan with a pod_req[idx] gather per chunk; the glue cost ~3× the
+    kernel itself — see the module docstring.)"""
+    R, P_pad, G_pad = stream.shape
+    NC = P_pad // chunk
     kernel = functools.partial(
         _scan_kernel, num_resources=R, chunk=chunk, max_nodes=max_nodes
     )
     return pl.pallas_call(
         kernel,
-        grid=grid,
+        grid=(G_pad // group_block, NC),
         in_specs=[
-            pl.BlockSpec((R, chunk, group_block), lambda i: (0, 0, i)),
-            pl.BlockSpec((1, group_block), lambda i: (0, i)),
-            pl.BlockSpec((R, group_block, max_nodes), lambda i: (0, i, 0)),
-            pl.BlockSpec((1, group_block), lambda i: (0, i)),
+            pl.BlockSpec((R, chunk, group_block), lambda g, c: (0, c, g)),
+            pl.BlockSpec((group_block, 1), lambda g, c: (g, 0)),
+            pl.BlockSpec((R, group_block), lambda g, c: (0, g)),
         ],
         out_specs=[
-            pl.BlockSpec((R, group_block, max_nodes), lambda i: (0, i, 0)),
-            pl.BlockSpec((1, group_block), lambda i: (0, i)),
-            pl.BlockSpec((chunk, group_block), lambda i: (0, i)),
+            pl.BlockSpec((R, group_block, max_nodes), lambda g, c: (0, g, 0)),
+            pl.BlockSpec((1, group_block), lambda g, c: (0, g)),
+            pl.BlockSpec((chunk, group_block), lambda g, c: (c, g)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((R, G, max_nodes), jnp.float32),
-            jax.ShapeDtypeStruct((1, G), jnp.int32),
-            jax.ShapeDtypeStruct((chunk, G), jnp.int32),
+            jax.ShapeDtypeStruct((R, G_pad, max_nodes), jnp.float32),
+            jax.ShapeDtypeStruct((1, G_pad), jnp.int32),
+            jax.ShapeDtypeStruct((P_pad, G_pad), jnp.int32),
         ],
-        input_output_aliases={2: 0, 3: 1},
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel",),
+            dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(req_chunk, caps, free, opened)
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("max_nodes", "chunk", "group_block", "interpret"),
-)
-def _pallas_scan_all(
-    pod_req,          # [P_pad, R] (padded with an impossible sentinel row at 0? no — padding handled by active flags)
-    order,            # [G_pad, P_pad] i32
-    sorted_mask,      # [G_pad, P_pad] bool
-    template_allocs,  # [G_pad, R]
-    caps,             # [1, G_pad] i32
-    max_nodes: int,
-    chunk: int,
-    group_block: int,
-    interpret: bool,
-):
-    """One jit: lax.scan over pod chunks, each advancing the VMEM kernel.
-    Keeping the loop on device avoids ~P/chunk host dispatch round-trips
-    (which dominate wall-clock on a tunneled TPU). Inactive slots (mask
-    failures and pad) travel as +inf requests, so the kernel needs no
-    separate active stream. (A whole-stream pre-gather/transpose outside the
-    scan was tried and crashed the AOT compile helper at the north-star
-    shape; the per-chunk gather compiles everywhere and measures the same.)"""
-    G_pad, P_pad = order.shape
-    R = pod_req.shape[1]
-    NC = P_pad // chunk
-    order_c = order.reshape(G_pad, NC, chunk).transpose(1, 0, 2)       # [NC, G, C]
-    active_c = sorted_mask.reshape(G_pad, NC, chunk).transpose(1, 0, 2)
-    allocs_in = template_allocs.T                                      # [R, G]
-
-    def chunk_step(carry, xs):
-        free, opened = carry
-        idx, active = xs                                   # [G, C]
-        gathered = jnp.where(
-            active[:, :, None], pod_req[idx], jnp.inf
-        )                                                  # [G, C, R]
-        req_chunk = jnp.transpose(gathered, (2, 1, 0))     # [R, C, G]
-        free, opened, placed = _run_chunk(
-            req_chunk, caps, free, opened,
-            chunk=chunk, max_nodes=max_nodes, group_block=group_block,
-            interpret=interpret,
-        )
-        return (free, opened), placed.T                    # [G, C]
-
-    init = (
-        jnp.broadcast_to(allocs_in[:, :, None], (R, G_pad, max_nodes)).astype(
-            jnp.float32
-        ),
-        jnp.zeros((1, G_pad), jnp.int32),
-    )
-    (free, opened), placed = jax.lax.scan(chunk_step, init, (order_c, active_c))
-    used = allocs_in[:, :, None] - free
-    placed_sorted = placed.transpose(1, 0, 2).reshape(G_pad, P_pad) > 0
-    return used, opened, placed_sorted
+    )(stream, caps_col, allocs_in)
 
 
 def ffd_binpack_groups_pallas(
@@ -237,11 +213,12 @@ def ffd_binpack_groups_pallas(
 ) -> BinpackResult:
     """Drop-in twin of ffd_binpack_groups running the scan in Pallas.
 
-    The scan over pod chunks runs inside one jit (lax.scan), each iteration
-    gathering the chunk's score-sorted requests and advancing the
-    VMEM-resident free-capacity carry via the kernel. chunk=None picks the
-    largest chunk the VMEM budget model admits (see the calibrated estimate
-    below); an explicit chunk is honored as-is."""
+    The full scan runs in ONE device dispatch: a payload-carrying stable
+    sort orders the requests per group, the pallas grid walks (group-block,
+    chunk) cells with the capacity carry VMEM-resident, and a second sort
+    restores original pod order for the scheduled bits. chunk=None picks the
+    largest chunk the VMEM budget model admits; an explicit chunk is honored
+    as-is."""
     if chunk is not None and chunk % _STEP_TILE != 0:
         raise ValueError(
             f"chunk must be a multiple of {_STEP_TILE} (sublane tile); got {chunk}"
@@ -268,8 +245,6 @@ def ffd_binpack_groups_pallas(
         caps = jnp.pad(caps, ((0, 0), (0, pad)))
 
     scores = jax.vmap(lambda alloc: ffd_scores(pod_req, alloc))(template_allocs)
-    order = jnp.argsort(-scores, axis=1, stable=True)               # [G_pad, P]
-    sorted_mask = jnp.take_along_axis(pod_masks, order, axis=1)
 
     # Exact resource-axis compression (AFTER scoring, which indexes CPU/MEMORY
     # positionally): an axis nobody requests can never gate a fit (0 <= free
@@ -282,25 +257,22 @@ def ffd_binpack_groups_pallas(
     if compressed:
         pod_req = pod_req[:, jnp.asarray(keep)]
         template_allocs = template_allocs[:, jnp.asarray(keep)]
+    R_k = len(keep)
 
-    # Auto-size the chunk: longer kernel invocations amortize per-chunk
-    # dispatch and carry round-trips, bounded by VMEM. Budget model (bytes,
-    # per grid program), calibrated on a real v5e: Mosaic double-buffers the
-    # request stream and carry blocks, so scoped VMEM ≈
-    # (2·req + 2·carry + placed)·4B + ~3MB scratch. With the [R, GB, M]
-    # free-capacity carry at R=4, GB=128, M=1024: chunk=2048 overflowed by
-    # 4.04MB (est 18.9MB), chunk=1024 (est 12.1MB) compiles and runs.
-    # An explicit chunk is honored untouched; tiny worlds stay at the
-    # smallest tile-aligned chunk covering P rather than padding up.
+    # Auto-size the chunk: bigger chunks mean fewer placed-block flushes and
+    # request-stream fetches per group block, bounded by VMEM. Budget model
+    # (bytes per grid program): Mosaic double-buffers the request stream and
+    # placed blocks; the carry is revisited (single-buffered, resident).
+    # With R=4, GB=128, M=1024, chunk=1024: 2·2MB req + 2MB carry + 2·0.5MB
+    # placed + ~3MB scratch ≈ 10MB — compiles and runs on a 16MB-VMEM v5e.
     if chunk is None:
-        R_k = len(keep)
         M_lanes = max_nodes + (-max_nodes) % 128
         chunk = 512
         for cand in (1024,):
             est = (
                 2 * R_k * cand * group_block      # double-buffered req stream
-                + 2 * R_k * group_block * M_lanes  # carry in/out
-                + cand * group_block              # placed out
+                + R_k * group_block * M_lanes      # resident carry
+                + 2 * cand * group_block          # double-buffered placed out
             ) * 4 + 3 * 1024 * 1024               # Mosaic scratch
             if est <= 15 * 1024 * 1024:
                 chunk = cand
@@ -309,26 +281,52 @@ def ffd_binpack_groups_pallas(
         while chunk > _STEP_TILE and chunk // 2 >= P:
             chunk //= 2
 
-    # Pad the pod axis to a chunk multiple with inactive slots. The pad value
-    # must be an index outside [0, P): the final scheduled scatter writes at
-    # `order`, and zero-padding would send every padded (inactive, False)
-    # slot to column 0, clobbering pod 0's real placement bit. P_pad-1 >= P
-    # here, so padded writes land in columns sliced away by [:, :P].
     P_pad = P + (-P) % chunk
-    if P_pad != P:
-        order = jnp.pad(order, ((0, 0), (0, P_pad - P)), constant_values=P_pad - 1)
-        sorted_mask = jnp.pad(sorted_mask, ((0, 0), (0, P_pad - P)))
 
-    used, opened, placed_sorted = _pallas_scan_all(
-        pod_req, order, sorted_mask, template_allocs, caps,
+    # ONE stable sort orders every group's stream by descending score and
+    # carries the request columns plus the original pod index as payloads
+    # (TPU sorts are fast and vectorized; the argsort + take_along_axis /
+    # per-chunk-gather formulation this replaces cost ~3× the kernel). The
+    # static mask folds into the columns first: where(mask, col, +inf)
+    # commutes with the sort, and an all-inf row both fits nowhere in the
+    # kernel and needs no separate active stream.
+    iota = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :], (G_pad, P))
+    cols = [
+        jnp.where(
+            pod_masks,
+            jnp.broadcast_to(pod_req[:, r][None, :], (G_pad, P)),
+            jnp.inf,
+        )
+        for r in range(R_k)
+    ]
+    sorted_ops = jax.lax.sort(
+        [-scores, iota, *cols], dimension=1, is_stable=True, num_keys=1
+    )
+    sorted_iota = sorted_ops[1]                                  # [G_pad, P]
+    pad_cols = P_pad - P
+    stream = jnp.stack(
+        [
+            jnp.pad(c, ((0, 0), (0, pad_cols)), constant_values=jnp.inf).T
+            for c in sorted_ops[2:]
+        ]
+    )                                                            # [R, P_pad, G_pad]
+
+    free, opened, placed = _pallas_scan_all(
+        stream, template_allocs.T, caps.T,
         max_nodes=max_nodes, chunk=chunk, group_block=group_block,
         interpret=interpret,
     )
 
-    garange = jnp.arange(G_pad)
-    scheduled = jnp.zeros((G_pad, P_pad), bool).at[
-        garange[:, None], order
-    ].set(placed_sorted)[:, :P]
+    # Un-sort the placement bits back to original pod order with a second
+    # sort keyed on the carried original index (3× cheaper than the
+    # equivalent scatter on TPU). Pad slots sit at sorted positions >= P and
+    # are sliced away before the un-sort.
+    _, scheduled_i = jax.lax.sort(
+        [sorted_iota, placed.T[:, :P]], dimension=1, is_stable=False, num_keys=1
+    )
+    scheduled = scheduled_i[:G] > 0
+
+    used = allocs_to_used(template_allocs, free)
     node_used = jnp.transpose(used, (1, 2, 0))[:G]        # [G, M, R]
     if compressed:
         node_used = (
@@ -338,6 +336,11 @@ def ffd_binpack_groups_pallas(
         )
     return BinpackResult(
         node_count=opened[0, :G],
-        scheduled=scheduled[:G],
+        scheduled=scheduled,
         node_used=node_used,
     )
+
+
+def allocs_to_used(template_allocs, free):
+    """used[R, G, M] = alloc - free (free of padding groups is 0-alloc)."""
+    return template_allocs.T[:, :, None] - free
