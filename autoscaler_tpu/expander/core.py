@@ -21,6 +21,7 @@ LEAST_WASTE = "least-waste"
 PRICE = "price"
 PRIORITY = "priority"
 GRPC = "grpc"
+GRPC_REF = "grpc-ref"  # reference expander.proto wire format
 
 
 @dataclass
@@ -164,6 +165,14 @@ def build_strategy(names: Sequence[str], seed: Optional[int] = None, **kwargs) -
                     "expander 'grpc' needs a target (--grpc-expander-url)"
                 )
             filters.append(GRPCFilter(kwargs["grpc_target"]))
+        elif name == GRPC_REF:
+            from autoscaler_tpu.expander.grpc_ import RefGRPCFilter
+
+            if not kwargs.get("grpc_target"):
+                raise ValueError(
+                    "expander 'grpc-ref' needs a target (--grpc-expander-url)"
+                )
+            filters.append(RefGRPCFilter(kwargs["grpc_target"]))
         else:
             raise ValueError(f"unknown expander {name!r}")
     return ChainStrategy(filters, RandomStrategy(seed))

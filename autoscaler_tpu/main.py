@@ -78,7 +78,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--new-pod-scale-up-delay", type=float, default=0.0)
     p.add_argument("--expendable-pods-priority-cutoff", type=int, default=-10)
     p.add_argument("--provider", "--cloud-provider", default="test",
-                   help="cloud provider (reference --cloud-provider)")
+                   help="cloud provider (reference --cloud-provider): test, "
+                        "gce, externalgrpc (native tensor protocol), or "
+                        "externalgrpc-ref (the reference's externalgrpc.proto "
+                        "wire format — existing provider binaries plug in "
+                        "unmodified)")
+    p.add_argument("--cloud-config", default="",
+                   help="provider config file (reference --cloud-config); "
+                        "for externalgrpc*: YAML with an `address:` key")
     p.add_argument("--address", default=":8085", help="observability HTTP bind")
     p.add_argument("--profiling", action="store_true",
                    help="expose /debug/pprof/* (main.go:518-520)")
@@ -501,9 +508,43 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 2
+    elif args.provider in ("externalgrpc", "externalgrpc-ref"):
+        # endpoint from the reference-shaped --cloud-config ({address: ...})
+        address = ""
+        if args.cloud_config:
+            import yaml
+
+            try:
+                with open(args.cloud_config) as f:
+                    cfg = yaml.safe_load(f) or {}
+            except (OSError, yaml.YAMLError) as e:
+                print(f"--cloud-config unreadable: {e}", file=sys.stderr)
+                return 2
+            address = str(cfg.get("address", "") or "") if isinstance(
+                cfg, dict
+            ) else ""
+        if not address:
+            print(
+                f"--provider={args.provider} needs --cloud-config with an "
+                "`address: host:port` entry (reference externalgrpc "
+                "README.md contract)",
+                file=sys.stderr,
+            )
+            return 2
+        if args.provider == "externalgrpc":
+            from autoscaler_tpu.cloudprovider.external_grpc import (
+                ExternalGrpcCloudProvider,
+            )
+
+            provider = ExternalGrpcCloudProvider(address)
+        else:
+            from autoscaler_tpu.rpc.refcompat import RefProtocolCloudProvider
+
+            provider = RefProtocolCloudProvider(address)
     else:
         print(
-            f"unknown cloud provider {args.provider!r} (available: test, gce)",
+            f"unknown cloud provider {args.provider!r} (available: test, "
+            "gce, externalgrpc, externalgrpc-ref)",
             file=sys.stderr,
         )
         return 2

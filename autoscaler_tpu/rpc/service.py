@@ -64,12 +64,12 @@ class TpuSimulationServicer:
         )
 
     def TrySchedule(self, request: pb.TryScheduleRequest, context) -> pb.TryScheduleResponse:
-        """Raw greedy kernel over packed tensors. NOTE: this RPC exposes the
-        kernel WITHOUT a topology-spread context (the wire format carries
-        dense tensors, not the object model the context is derived from), so
-        within-wave spread re-counting does not apply here — remote callers
-        needing it should drive the host-side HintingSimulator instead
-        (PREDICATES.md divergence 2, RPC-surface note)."""
+        """Greedy kernel over packed tensors. When the request carries a
+        SpreadContext, the kernel runs greedy_schedule's within-wave
+        topology-spread re-counting — the same path the host-side
+        HintingSimulator drives — so a remote sidecar caller no longer gets
+        the pre-round-3 batch-width overpack (closed the round-3 RPC-surface
+        note of PREDICATES.md divergence 2)."""
         import jax.numpy as jnp
 
         from autoscaler_tpu.ops.schedule import greedy_schedule
@@ -83,6 +83,24 @@ class TpuSimulationServicer:
         mask = _u8(request.sched_mask, P, N)
         slots = _i32(request.pod_slots, -1)
         hints = _i32(request.hints, -1)
+        spread = None
+        if request.HasField("spread"):
+            sp = request.spread
+            S, D = sp.num_terms, sp.num_domains
+            spread = tuple(
+                jnp.asarray(a)
+                for a in (
+                    _u8(sp.sp_of, P, S),
+                    _u8(sp.sp_match, P, S),
+                    _i32(sp.node_dom, S, N),
+                    _u8(sp.sp_elig, S, N),
+                    _u8(sp.dom_valid, S, D),
+                    _i32(sp.static_counts, S, D),
+                    _i32(sp.skew, S),
+                    _i32(sp.min_dom, S),
+                    _i32(sp.domnum, S),
+                )
+            )
         snap = SnapshotTensors(
             node_alloc=jnp.asarray(free),
             node_used=jnp.zeros((N, R), jnp.float32),
@@ -93,7 +111,9 @@ class TpuSimulationServicer:
             pod_node=jnp.full((P,), -1, jnp.int32),
             sched_mask=jnp.asarray(mask),
         )
-        res = greedy_schedule(snap, jnp.asarray(slots), jnp.asarray(hints))
+        res = greedy_schedule(
+            snap, jnp.asarray(slots), jnp.asarray(hints), spread=spread
+        )
         return pb.TryScheduleResponse(
             placed=np.asarray(res.placed, np.uint8).tobytes(),
             dest=np.asarray(res.dest, np.dtype("<i4")).tobytes(),
@@ -186,14 +206,14 @@ class TpuSimulationClient:
     def close(self) -> None:
         self._channel.close()
 
-    def _call(self, method: str, request):
+    def _call(self, method: str, request, timeout: Optional[float] = None):
         req_cls, resp_cls = _METHODS[method]
         rpc = self._channel.unary_unary(
             f"/{SERVICE_NAME}/{method}",
             request_serializer=lambda msg: msg.SerializeToString(),
             response_deserializer=resp_cls.FromString,
         )
-        return rpc(request)
+        return rpc(request, timeout=timeout)
 
     def estimate(
         self,
@@ -227,6 +247,67 @@ class TpuSimulationClient:
         )
         return counts, scheduled
 
-    def best_options(self, options: Sequence[pb.Option]) -> List[pb.Option]:
-        resp = self._call("BestOptions", pb.BestOptionsRequest(options=list(options)))
+    def try_schedule(
+        self,
+        pod_req: np.ndarray,     # [P, R]
+        node_free: np.ndarray,   # [N, R]
+        sched_mask: np.ndarray,  # [P, N]
+        pod_slots: np.ndarray,   # [K]
+        hints: np.ndarray,       # [K]
+        spread: Optional[tuple] = None,  # affinity.build_spread_schedule_context
+    ):
+        """→ (placed [K] bool, dest [K] i32). `spread` is the host-side
+        9-array context; packing it onto the wire gives the remote kernel
+        host-path within-wave spread semantics."""
+        P, R = pod_req.shape
+        N = node_free.shape[0]
+        spread_msg = None
+        if spread is not None:
+            (sp_of, sp_match, node_dom, sp_elig, dom_valid,
+             static_counts, skew, min_dom, domnum) = (
+                np.asarray(a) for a in spread
+            )
+            spread_msg = pb.SpreadContext(
+                sp_of=np.ascontiguousarray(sp_of, np.uint8).tobytes(),
+                sp_match=np.ascontiguousarray(sp_match, np.uint8).tobytes(),
+                node_dom=np.ascontiguousarray(node_dom, "<i4").tobytes(),
+                sp_elig=np.ascontiguousarray(sp_elig, np.uint8).tobytes(),
+                dom_valid=np.ascontiguousarray(dom_valid, np.uint8).tobytes(),
+                static_counts=np.ascontiguousarray(
+                    static_counts, "<i4"
+                ).tobytes(),
+                skew=np.ascontiguousarray(skew, "<i4").tobytes(),
+                min_dom=np.ascontiguousarray(min_dom, "<i4").tobytes(),
+                domnum=np.ascontiguousarray(domnum, "<i4").tobytes(),
+                num_terms=int(sp_of.shape[1]),
+                num_domains=int(dom_valid.shape[1]),
+            )
+        resp = self._call(
+            "TrySchedule",
+            pb.TryScheduleRequest(
+                pods=pb.PackedPods(
+                    requests=np.ascontiguousarray(pod_req, "<f4").tobytes(),
+                    num_pods=P,
+                    num_resources=R,
+                ),
+                node_free=np.ascontiguousarray(node_free, "<f4").tobytes(),
+                sched_mask=np.ascontiguousarray(sched_mask, np.uint8).tobytes(),
+                pod_slots=np.ascontiguousarray(pod_slots, "<i4").tobytes(),
+                hints=np.ascontiguousarray(hints, "<i4").tobytes(),
+                num_nodes=N,
+                spread=spread_msg,
+            ),
+        )
+        placed = np.frombuffer(resp.placed, np.uint8).astype(bool)
+        dest = np.frombuffer(resp.dest, "<i4")
+        return placed, dest
+
+    def best_options(
+        self, options: Sequence[pb.Option], timeout: Optional[float] = None
+    ) -> List[pb.Option]:
+        resp = self._call(
+            "BestOptions",
+            pb.BestOptionsRequest(options=list(options)),
+            timeout=timeout,
+        )
         return list(resp.best)

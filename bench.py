@@ -170,18 +170,24 @@ def _bench_main():
         except Exception as e:  # noqa: BLE001 — any kernel failure → xla path
             pallas_parity = f"pallas path error: {type(e).__name__}: {e}"
 
-    # Serial compiled baseline on a 3-group sample, scaled to G.
+    # Serial compiled baseline, sampled over >=32 groups (round-3 VERDICT:
+    # a 3-group sample scaled x500 turned a few hundred ms of host jitter
+    # into a +/-30% headline swing). Per group we keep the best of 2 reps
+    # (discards scheduler preemption spikes, only ever understates the
+    # baseline); across groups we report min/median/max and scale the
+    # MEDIAN by G (groups are iid by construction in build_workload).
     try:
         from autoscaler_tpu.native_bridge import ffd_binpack_native as baseline_ffd
 
         baseline = "cpp"
     except Exception:
         baseline = "numpy"
-    SAMPLE = 3
+    SAMPLE = min(32, G)
+    stride = max(1, G // SAMPLE)   # spread the sample across the group range
     sample_times = []
-    for g in range(SAMPLE):
+    for g in range(0, SAMPLE * stride, stride):
         best = None
-        for rep in range(3):
+        for rep in range(2):
             t0 = time.perf_counter()
             if baseline == "cpp":
                 ref_count, ref_sched = baseline_ffd(
@@ -196,9 +202,6 @@ def _bench_main():
                     pod_req, masks[g], allocs[g], MAX_NODES
                 )
             dt = time.perf_counter() - t0
-            # best-of-3 per group: the ×G scale-up amplifies per-run timing
-            # noise ~500×, and taking the baseline's BEST case keeps
-            # vs_baseline stable run-to-run while only ever understating it
             best = dt if best is None else min(best, dt)
         sample_times.append(best)
         assert ref_count == int(res_counts[g]), (
@@ -227,6 +230,16 @@ def _bench_main():
                 **({"pallas_parity": pallas_parity} if pallas_parity else {}),
                 "baseline_time_s": round(t_ref, 2),
                 "baseline_kind": baseline,
+                "baseline_sample_groups": len(sample_times),
+                "baseline_group_min_s": round(float(np.min(sample_times)), 4),
+                "baseline_group_median_s": round(
+                    float(np.median(sample_times)), 4
+                ),
+                "baseline_group_max_s": round(float(np.max(sample_times)), 4),
+                # BASELINE.json secondary metric: p50 latency of one full
+                # batched estimator dispatch (all G groups in one call);
+                # t_tpu is already the median of the headline kernel's runs
+                "p50_latency_s": round(t_tpu, 4),
             }
         )
     )

@@ -66,10 +66,6 @@ def _mk_kernel(mode: str):
                 else:
                     req = [req_tiles[r][s, :] for r in range(R)]
 
-                def bcast(x):
-                    # const path broadcasts a scalar; stream path a [GB] row
-                    return x if isinstance(x, jnp.ndarray) and x.ndim else x
-
                 if mode == "const_req":
                     fits = req[0] <= free_ref[0]
                     for r in range(1, R):
@@ -94,10 +90,9 @@ def _mk_kernel(mode: str):
                 if mode in ("full", "no_min", "const_req"):
                     hit = node_iota == jnp.where(place, first, -1)[:, None]
                     for r in range(R):
-                        if mode == "const_req":
-                            sub = jnp.where(place, req[r], 0.0)[:, None]
-                        else:
-                            sub = jnp.where(place, req[r], 0.0)[:, None]
+                        # const_req: req[r] is a scalar, so this measures the
+                        # step WITHOUT the per-step [GB]-row request extract
+                        sub = jnp.where(place, req[r], 0.0)[:, None]
                         free_ref[r, :, :] = free_ref[r] - jnp.where(hit, sub, 0.0)
                 inner = inner + first[0]
             return inner

