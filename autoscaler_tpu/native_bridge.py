@@ -52,6 +52,15 @@ def _ensure_built() -> Optional[ctypes.CDLL]:
                 ctypes.c_int32, ctypes.c_int32,
                 ctypes.POINTER(ctypes.c_uint8),
             ]
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            lib.ffd_binpack_serial_affinity.restype = ctypes.c_int32
+            lib.ffd_binpack_serial_affinity.argtypes = [
+                ctypes.POINTER(ctypes.c_float), u8p,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                u8p, u8p, u8p, u8p, u8p, u8p,
+            ]
             lib.first_fit_serial.restype = None
             lib.first_fit_serial.argtypes = [
                 ctypes.POINTER(ctypes.c_float),
@@ -106,6 +115,51 @@ def ffd_binpack_native(
     )
     if count < 0:
         raise RuntimeError("ffd_binpack_serial failed")
+    return int(count), out.astype(bool)
+
+
+def ffd_binpack_affinity_native(
+    pod_req: np.ndarray,        # [P, R] f32
+    pod_mask: np.ndarray,       # [P] bool
+    template_alloc: np.ndarray,  # [R] f32
+    max_nodes: int,
+    match: np.ndarray,          # [T, P] bool
+    aff_of: np.ndarray,         # [T, P] bool
+    anti_of: np.ndarray,        # [T, P] bool
+    node_level: np.ndarray,     # [T] bool
+    has_label: np.ndarray,      # [T] bool (this group's template)
+    cpu_axis: int = 0,
+    mem_axis: int = 1,
+) -> Tuple[int, np.ndarray]:
+    """→ (node_count, scheduled[P] bool). Same contract as
+    estimator.reference_impl.ffd_binpack_reference_affinity (parity-locked
+    in tests/test_processors_rpc_native.py); the compiled baseline the
+    affinity bench compares the TPU kernel against."""
+    lib = _ensure_built()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_build_error}")
+    req = np.ascontiguousarray(pod_req, np.float32)
+    mask = np.ascontiguousarray(pod_mask, np.uint8)
+    alloc = np.ascontiguousarray(template_alloc, np.float32)
+    P, R = req.shape
+    T = match.shape[0]
+    m = np.ascontiguousarray(match, np.uint8)
+    a = np.ascontiguousarray(aff_of, np.uint8)
+    x = np.ascontiguousarray(anti_of, np.uint8)
+    nl = np.ascontiguousarray(node_level, np.uint8)
+    hl = np.ascontiguousarray(has_label, np.uint8)
+    out = np.zeros(P, np.uint8)
+
+    def u8(arr):
+        return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+    count = lib.ffd_binpack_serial_affinity(
+        _fptr(req), u8(mask), _fptr(alloc),
+        P, R, max_nodes, cpu_axis, mem_axis, T,
+        u8(m), u8(a), u8(x), u8(nl), u8(hl), u8(out),
+    )
+    if count < 0:
+        raise RuntimeError("ffd_binpack_serial_affinity failed")
     return int(count), out.astype(bool)
 
 

@@ -252,7 +252,13 @@ def ffd_binpack_groups_pallas(
     # per-resource loop. At the north-star workload this removes the
     # always-zero ephemeral/tpu axes (R 6→4, ~1/3 of the VPU work). The tiny
     # host sync is amortized over the whole scan.
-    keep = [r for r in range(R_full) if bool((pod_req[:, r] > 0).any())] or [0]
+    # Under shard_map/jit the inputs are tracers — the host-side value peek
+    # is impossible, so keep every axis (the sharded caller pays ~R/R_k more
+    # VPU work; the single-chip dispatch path always has concrete inputs).
+    if isinstance(pod_req, jax.core.Tracer):
+        keep = list(range(R_full))
+    else:
+        keep = [r for r in range(R_full) if bool((pod_req[:, r] > 0).any())] or [0]
     compressed = len(keep) < R_full
     if compressed:
         pod_req = pod_req[:, jnp.asarray(keep)]

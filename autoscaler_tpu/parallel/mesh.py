@@ -108,13 +108,20 @@ class WhatIfResult(NamedTuple):
     best_cost: jax.Array     # [S] f32
 
 
-def _whatif_local(pod_req, pod_masks, allocs, prices, caps, *, max_nodes, group_axis):
+def _whatif_local(pod_req, pod_masks, allocs, prices, caps, *, max_nodes,
+                  group_axis, binpack_fn=None, scenario_loop=False):
     """Per-shard body: batched FFD over the local (scenario, group) block,
-    then the expander reduction with an all_gather across the group axis."""
+    then the expander reduction with an all_gather across the group axis.
+
+    ``binpack_fn`` swaps the kernel (default ffd_binpack_groups; e.g. the
+    Pallas twin). ``scenario_loop`` unrolls the scenario batch as a Python
+    loop instead of vmap — required for kernels whose pallas_call does not
+    vmap (the per-shard scenario count is small and static)."""
     S_loc = allocs.shape[0]
+    kern = binpack_fn if binpack_fn is not None else ffd_binpack_groups
 
     def per_scenario(alloc_s, price_s):
-        res = ffd_binpack_groups(pod_req, pod_masks, alloc_s, max_nodes=max_nodes, node_caps=caps)
+        res = kern(pod_req, pod_masks, alloc_s, max_nodes=max_nodes, node_caps=caps)
         valid = pod_req[:, PODS] > 0  # real pods carry a pods-count of 1
         pending = jnp.sum(valid) - jnp.sum(res.scheduled & valid[None, :], axis=1)
         cost = price_s * res.node_count.astype(jnp.float32) + UNSCHEDULED_PENALTY * pending.astype(
@@ -122,7 +129,12 @@ def _whatif_local(pod_req, pod_masks, allocs, prices, caps, *, max_nodes, group_
         )
         return res.node_count, cost
 
-    counts, costs = jax.vmap(per_scenario)(allocs, prices)  # [S_loc, G_loc]
+    if scenario_loop:
+        outs = [per_scenario(allocs[s], prices[s]) for s in range(S_loc)]
+        counts = jnp.stack([o[0] for o in outs])
+        costs = jnp.stack([o[1] for o in outs])
+    else:
+        counts, costs = jax.vmap(per_scenario)(allocs, prices)  # [S_loc, G_loc]
 
     if group_axis is None:
         all_costs = costs
@@ -144,10 +156,15 @@ def whatif_best_options(
     prices: jax.Array,       # [S, G] per-scenario per-group node price
     caps: jax.Array,         # [G] i32 per-group node caps
     max_nodes: int,
+    binpack_fn=None,
+    scenario_loop: bool = False,
 ) -> WhatIfResult:
     """Full multi-scenario scale-up evaluation, sharded over the mesh.
 
     S must divide by mesh['scenario'], G by mesh['group'] (pad upstream).
+    ``binpack_fn``/``scenario_loop``: see _whatif_local — the Pallas twin
+    runs under shard_map with binpack_fn=ffd_binpack_groups_pallas,
+    scenario_loop=True.
     """
     s_dim = mesh.shape["scenario"]
     g_dim = mesh.shape["group"]
@@ -156,7 +173,9 @@ def whatif_best_options(
     assert G % g_dim == 0, f"G={G} not divisible by group dim {g_dim}"
 
     fn = functools.partial(
-        _whatif_local, max_nodes=max_nodes, group_axis="group" if g_dim > 1 else None
+        _whatif_local, max_nodes=max_nodes,
+        group_axis="group" if g_dim > 1 else None,
+        binpack_fn=binpack_fn, scenario_loop=scenario_loop,
     )
     mapped = jax.shard_map(
         fn,
@@ -178,3 +197,137 @@ def whatif_best_options(
     )
     counts, costs, best, best_cost = mapped(pod_req, pod_masks, allocs, prices, caps)
     return WhatIfResult(counts, costs, best, best_cost)
+
+
+def sharded_affinity_estimate(
+    mesh: Mesh,
+    pod_req: jax.Array,      # [P, R]
+    pod_masks: jax.Array,    # [G, P]
+    allocs: jax.Array,       # [G, R]
+    caps: jax.Array,         # [G] i32
+    max_nodes: int,
+    match: jax.Array,        # [T, P]
+    aff_of: jax.Array,       # [T, P]
+    anti_of: jax.Array,      # [T, P]
+    node_level: jax.Array,   # [T]
+    has_label: jax.Array,    # [G, T]
+    spread: tuple | None = None,  # SpreadTermTensors 11-tuple (G-axis at 5..10)
+):
+    """Dynamic inter-pod-affinity (+hard-spread) FFD estimation sharded over
+    a 1-D ``group`` mesh: each device runs the full scan carry for its group
+    block (per-group affinity/spread state is independent across groups, so
+    the group axis shards with zero collectives — the multi-chip layout for
+    the reference's worst-case workload, FAQ.md:151-153). Term tensors and
+    the shared pod matrix replicate; [G, ·] tensors (masks, allocs, caps,
+    has_label, and the spread tuple's per-group static context, slots 5-10)
+    shard."""
+    from autoscaler_tpu.ops.binpack import ffd_binpack_groups_affinity
+
+    g_dim = mesh.shape["group"]
+    G = pod_masks.shape[0]
+    assert G % g_dim == 0, f"G={G} not divisible by group dim {g_dim}"
+
+    def body(pod_req, pod_masks, allocs, caps, match, aff_of, anti_of,
+             node_level, has_label, spread_arg):
+        return ffd_binpack_groups_affinity(
+            pod_req, pod_masks, allocs, max_nodes=max_nodes,
+            match=match, aff_of=aff_of, anti_of=anti_of,
+            node_level=node_level, has_label=has_label,
+            node_caps=caps, spread=spread_arg,
+        )
+
+    rep = P()
+    gshard = P("group")
+    spread_specs = None
+    if spread is not None:
+        spread_specs = tuple([rep] * 5 + [gshard] * 6)
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(rep, gshard, gshard, gshard, rep, rep, rep, rep, gshard,
+                  spread_specs),
+        out_specs=gshard,  # prefix: every BinpackResult leaf is [G, ...]
+        check_vma=False,
+    )
+    return mapped(pod_req, pod_masks, allocs, caps, match, aff_of, anti_of,
+                  node_level, has_label, spread)
+
+
+def sharded_scaledown_step(
+    mesh: Mesh,
+    snap,                    # SnapshotTensors (replicated pytree)
+    candidate_nodes: jax.Array,  # [C] i32 — C divisible by the mesh size
+    pod_slots: jax.Array,        # [C, S]
+    blocked: jax.Array,          # [C] bool
+    excluded: jax.Array,         # [N] bool — nodes leaving in the joint plan
+    spread: tuple | None = None,        # 8-array schedule context
+    static_counts: jax.Array | None = None,  # [S, D]
+    cand_sub: jax.Array | None = None,       # [C, S]
+):
+    """The full scale-down decision step on a 1-D ``candidate`` mesh, the
+    deployment shape for multi-chip scale-down:
+
+    1. per-candidate categorization shards over candidates (each lane refits
+       one drained node's movable pods — reference planner.go:252
+       categorizeNodes, embarrassingly parallel);
+    2. an all_gather pulls every candidate's slots back to all devices;
+    3. the sequential joint set re-validation (reference actuator.go:371
+       re-simulation) runs replicated on the gathered full set — it shares
+       one capacity carry across candidates, so it is inherently one lane.
+
+    Returns (per_candidate: RemovalFeasibility over [C], joint:
+    RemovalFeasibility over [C]) with identical values on every device.
+    """
+    from autoscaler_tpu.ops.scaledown import (
+        joint_removal_feasibility,
+        joint_removal_feasibility_spread,
+        removal_feasibility,
+        removal_feasibility_spread,
+    )
+
+    n_dev = mesh.shape["candidate"]
+    C = candidate_nodes.shape[0]
+    assert C % n_dev == 0, f"C={C} not divisible by mesh size {n_dev}"
+    # The spread trio travels together: the body branches on `spread` alone
+    # and removal_feasibility_spread requires all three.
+    opts = (spread is None, static_counts is None, cand_sub is None)
+    assert all(opts) or not any(opts), (
+        "spread, static_counts and cand_sub must be passed all-or-none"
+    )
+
+    def body(snap, cands, slots, blocked, excluded, spread_arg, counts, sub):
+        if spread_arg is not None:
+            per = removal_feasibility_spread(
+                snap, cands, slots, blocked, spread_arg, counts, sub
+            )
+        else:
+            per = removal_feasibility(snap, cands, slots, blocked)
+        gather = lambda x: jax.lax.all_gather(x, "candidate").reshape(
+            (-1,) + x.shape[1:]
+        )
+        cands_all = gather(cands)
+        slots_all = gather(slots)
+        if spread_arg is not None:
+            joint = joint_removal_feasibility_spread(
+                snap, cands_all, slots_all, excluded, spread_arg, counts,
+                gather(sub),
+            )
+        else:
+            joint = joint_removal_feasibility(snap, cands_all, slots_all, excluded)
+        return per, joint
+
+    rep = P()
+    cshard = P("candidate")
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(rep, cshard, cshard, cshard, rep,
+                  rep if spread is not None else None,
+                  rep if static_counts is not None else None,
+                  cshard if cand_sub is not None else None),
+        out_specs=(cshard, rep),  # prefixes: per-candidate leaves shard
+                                  # over [C, ...]; the joint result replicates
+        check_vma=False,
+    )
+    return mapped(snap, candidate_nodes, pod_slots, blocked, excluded,
+                  spread, static_counts, cand_sub)

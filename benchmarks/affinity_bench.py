@@ -1,0 +1,171 @@
+"""Inter-pod affinity estimator benchmark — the reference's worst pain point.
+
+The reference documents inter-pod affinity/anti-affinity as its single
+largest scalability cost (~1000x slower estimation, FAQ.md:151-153) because
+the InterPodAffinity filter plugin re-runs after every simulated placement
+(binpacking_estimator.go:119-141). This bench measures our dynamic-affinity
+FFD scan kernel (ops/binpack.ffd_binpack_groups_affinity — per-term counts
+carried through the scan, all groups in ONE device dispatch) against the
+compiled serial baseline (native/ffd_serial.cpp ffd_binpack_serial_affinity,
+parity-locked to the Python oracle in tests/test_processors_rpc_native.py).
+
+Workload (env-tunable): P pods x G groups x T affinity terms, a mix of
+hostname-level anti-affinity (replica spreading — the common production
+case), zone-level affinity (co-location), and zone-level anti-affinity.
+INVOLVED_FRAC of pods carry terms; the rest exercise the static-mask path
+the way a real pending set does.
+
+Baseline sampling mirrors bench.py's round-4 methodology: >=SAMPLE_G groups,
+best-of-2 per group, median x G, min/median/max emitted. Parity vs the C++
+baseline is checked exactly on every sampled group (node_count AND the
+scheduled vector); a mismatch prints the JSON with parity=MISMATCH and
+exits non-zero so automation can never record the ratio as valid.
+
+Run on the TPU: python benchmarks/affinity_bench.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_workload(P, G, T, seed=0, involved_frac=0.15):
+    from autoscaler_tpu.kube.objects import CPU, MEMORY, PODS
+
+    rng = np.random.default_rng(seed)
+    pod_req = np.zeros((P, 6), np.float32)
+    pod_req[:, CPU] = rng.integers(50, 2000, P)
+    pod_req[:, MEMORY] = rng.integers(64, 8192, P)
+    pod_req[:, PODS] = 1
+
+    allocs = np.zeros((G, 6), np.float32)
+    allocs[:, CPU] = rng.choice([4000, 8000, 16000, 32000], G)
+    allocs[:, MEMORY] = rng.choice([8192, 16384, 32768, 65536], G)
+    allocs[:, PODS] = 110
+
+    masks = rng.random((G, P)) > 0.05
+
+    # Term structure: each involved pod belongs to one "app" with one term.
+    # 60% hostname-level anti-affinity (replica spread), 20% zone affinity
+    # (co-locate), 20% zone anti-affinity (one per zone-domain).
+    involved = rng.random(P) < involved_frac
+    app_of = rng.integers(0, T, P)
+    match = np.zeros((T, P), bool)
+    aff_of = np.zeros((T, P), bool)
+    anti_of = np.zeros((T, P), bool)
+    node_level = np.zeros(T, bool)
+    kind = rng.random(T)
+    node_level[kind < 0.6] = True          # hostname-scoped terms
+    is_aff = (kind >= 0.6) & (kind < 0.8)  # zone affinity terms
+    for t in range(T):
+        members = involved & (app_of == t)
+        match[t, members] = True
+        if is_aff[t]:
+            aff_of[t, members] = True
+        else:
+            anti_of[t, members] = True
+    # every group's template carries both topology labels
+    has_label = np.ones((G, T), bool)
+    return pod_req, masks, allocs, match, aff_of, anti_of, node_level, has_label
+
+
+def main():
+    import jax
+
+    if os.environ.get("AFF_BENCH_PLATFORM") == "cpu":
+        # env JAX_PLATFORMS alone is not enough: the axon site hook re-pins
+        # the platform at import (same workaround as bench.py / conftest.py)
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from autoscaler_tpu.native_bridge import available, ffd_binpack_affinity_native
+    from autoscaler_tpu.ops.binpack import ffd_binpack_groups_affinity
+
+    P = int(os.environ.get("AFF_BENCH_P", 20_000))
+    G = int(os.environ.get("AFF_BENCH_G", 100))
+    T = int(os.environ.get("AFF_BENCH_T", 50))
+    M = int(os.environ.get("AFF_BENCH_M", 1000))
+    SAMPLE_G = min(int(os.environ.get("AFF_BENCH_SAMPLE_G", 32)), G)
+    reps = int(os.environ.get("AFF_BENCH_REPS", 3))
+
+    pod_req, masks, allocs, match, aff_of, anti_of, node_level, has_label = (
+        build_workload(P, G, T)
+    )
+
+    jargs = dict(
+        pod_req=jnp.asarray(pod_req),
+        pod_masks=jnp.asarray(masks),
+        template_allocs=jnp.asarray(allocs),
+        max_nodes=M,
+        match=jnp.asarray(match),
+        aff_of=jnp.asarray(aff_of),
+        anti_of=jnp.asarray(anti_of),
+        node_level=jnp.asarray(node_level),
+        has_label=jnp.asarray(has_label),
+    )
+
+    platform = jax.devices()[0].platform
+
+    out = ffd_binpack_groups_affinity(**jargs)
+    counts = np.asarray(out.node_count)  # compile + sync via host fetch
+    # (block_until_ready is unreliable through the axon relay)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = ffd_binpack_groups_affinity(**jargs)
+        counts = np.asarray(out.node_count)
+        times.append(time.perf_counter() - t0)
+    tpu_s = min(times)
+    scheds = np.asarray(out.scheduled)
+
+    if not available():
+        raise SystemExit("native baseline unavailable")
+    rng = np.random.default_rng(1)
+    sample = rng.choice(G, SAMPLE_G, replace=False)
+    per_group = []
+    parity_ok = True
+    for g in sample:
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            c, s = ffd_binpack_affinity_native(
+                pod_req, masks[g], allocs[g], M,
+                match, aff_of, anti_of, node_level, has_label[g],
+            )
+            best = min(best, time.perf_counter() - t0)
+        per_group.append(best)
+        if c != int(counts[g]) or not np.array_equal(s, scheds[g].astype(bool)):
+            parity_ok = False
+    per_group = np.array(per_group)
+    baseline_s = float(np.median(per_group)) * G
+
+    result = {
+        "metric": f"affinity_estimate_{P//1000}kp_{G}g_{T}t_{M}m",
+        "value": round(tpu_s, 4),
+        "unit": "s_per_full_dispatch",
+        "vs_baseline": round(baseline_s / tpu_s, 2),
+        "platform": platform,
+        "parity": "ok" if parity_ok else "MISMATCH",
+        "baseline_s": round(baseline_s, 2),
+        "baseline_per_group_s": {
+            "min": round(float(per_group.min()), 4),
+            "median": round(float(np.median(per_group)), 4),
+            "max": round(float(per_group.max()), 4),
+            "sampled": int(SAMPLE_G),
+        },
+        "tpu_times_s": [round(t, 4) for t in times],
+        "mean_nodes_per_group": round(float(counts.mean()), 1),
+    }
+    print(json.dumps(result))
+    if not parity_ok:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

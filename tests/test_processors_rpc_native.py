@@ -156,6 +156,39 @@ class TestNativeBridge:
         assert c1 == c2
         np.testing.assert_array_equal(s1, s2)
 
+    @pytest.mark.parametrize("seed", range(6))
+    def test_affinity_parity_vs_oracle(self, seed):
+        from autoscaler_tpu.estimator.reference_impl import (
+            ffd_binpack_reference_affinity,
+        )
+        from autoscaler_tpu.native_bridge import (
+            available,
+            ffd_binpack_affinity_native,
+        )
+
+        assert available()
+        rng = np.random.default_rng(seed)
+        P, T = 300, 5
+        req = np.zeros((P, 6), np.float32)
+        req[:, 0] = rng.integers(50, 1500, P)
+        req[:, 1] = rng.integers(64, 4096, P)
+        req[:, 5] = 1
+        alloc = np.array([4000, 8192, 0, 0, 0, 110], np.float32)
+        mask = rng.random(P) > 0.1
+        match = rng.random((T, P)) < 0.15
+        aff_of = (rng.random((T, P)) < 0.05) & match
+        anti_of = (rng.random((T, P)) < 0.05) & match
+        node_level = rng.random(T) < 0.5
+        has_label = rng.random(T) < 0.8
+        c1, s1 = ffd_binpack_affinity_native(
+            req, mask, alloc, 64, match, aff_of, anti_of, node_level, has_label
+        )
+        c2, s2 = ffd_binpack_reference_affinity(
+            req, mask, alloc, 64, match, aff_of, anti_of, node_level, has_label
+        )
+        assert c1 == c2
+        np.testing.assert_array_equal(s1, s2)
+
     def test_first_fit_native(self):
         from autoscaler_tpu.native_bridge import first_fit_native
 
