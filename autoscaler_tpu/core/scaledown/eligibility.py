@@ -109,13 +109,14 @@ class EligibilityChecker:
         from autoscaler_tpu.snapshot.packer import resources_row
 
         exclude = np.zeros(tensors.node_alloc.shape, np.float32)
+        ext = meta.extended_resources  # rows must match the widened axis
         for pod in meta.pods:
             if not pod.node_name:
                 continue
             if (skip_ds and pod.daemonset) or (skip_mirror and pod.mirror):
                 j = meta.node_index.get(pod.node_name)
                 if j is not None:
-                    exclude[j] += resources_row(pod.requests, 1.0)
+                    exclude[j] += resources_row(pod.requests, 1.0, ext)
         return exclude
 
     def _group_options(self, node: Node):

@@ -36,6 +36,7 @@ def _spec_fingerprint(pod: Pod) -> Tuple:
     return (
         pod.namespace,
         pod.requests.as_tuple(),
+        pod.requests.extended,  # named extended resources are fit dimensions
         tuple(sorted(pod.node_selector.items())),
         tuple(pod.tolerations),
         tuple(sorted(pod.labels.items())),

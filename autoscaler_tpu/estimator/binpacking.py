@@ -23,7 +23,7 @@ import numpy as np
 
 from autoscaler_tpu.core.scaleup.equivalence import build_pod_groups
 from autoscaler_tpu.estimator.limiter import ThresholdBasedEstimationLimiter
-from autoscaler_tpu.kube.objects import Node, Pod
+from autoscaler_tpu.kube.objects import NUM_RESOURCES, Node, Pod
 from autoscaler_tpu.ops.binpack import (
     BinpackResult,
     ffd_binpack,
@@ -39,15 +39,30 @@ from autoscaler_tpu.snapshot.affinity import (
     has_hard_spread,
     has_interpod_affinity,
 )
-from autoscaler_tpu.snapshot.packer import compute_sched_mask, resources_row
+from autoscaler_tpu.snapshot.packer import (
+    compute_sched_mask,
+    extended_schema,
+    resources_row,
+)
 from autoscaler_tpu.snapshot.tensors import bucket_size
 
 
-def _pack_pods(pods: Sequence[Pod], padded: int) -> np.ndarray:
-    req = np.zeros((padded, len(resources_row(pods[0].requests, 1.0)) if pods else 6), np.float32)
+def _pack_pods(
+    pods: Sequence[Pod], padded: int, ext: tuple = ()
+) -> np.ndarray:
+    req = np.zeros((padded, NUM_RESOURCES + len(ext)), np.float32)
     for i, pod in enumerate(pods):
-        req[i] = resources_row(pod.requests, 1.0)
+        req[i] = resources_row(pod.requests, 1.0, ext)
     return req
+
+
+def _estimation_schema(pods: Sequence[Pod]) -> tuple:
+    """Named extended-resource columns for one estimation dispatch: the
+    union over PENDING POD requests only (PREDICATES divergence 4 closure —
+    each device-plugin name gets its own fit dimension, matching
+    NodeResourcesFit over arbitrary resource names; template-side names no
+    pod requests can never gate a fit and must not widen the axis)."""
+    return extended_schema((p.requests for p in pods))
 
 
 def template_mask(
@@ -85,11 +100,11 @@ def _spread_tuple(sp: SpreadTermTensors):
     )
 
 
-def _template_capacity_row(template: Node) -> np.ndarray:
+def _template_capacity_row(template: Node, ext: tuple = ()) -> np.ndarray:
     """Pack-capacity row of a template node: allocatable minus daemon
     overhead, with the pods column from the same reduced view."""
     cap = template.packing_capacity()
-    return resources_row(cap, cap.pods)
+    return resources_row(cap, cap.pods, ext)
 
 
 def _augment_virtual(
@@ -158,10 +173,11 @@ class BinpackingNodeEstimator:
         if not pods:
             return 0, []
         P = bucket_size(len(pods))
-        req = _pack_pods(pods, P)
+        ext = _estimation_schema(pods)
+        req = _pack_pods(pods, P, ext)
         dynamic = has_interpod_affinity(pods) or has_hard_spread(pods)
         mask = template_mask(pods, template, P, interpod=not dynamic)
-        alloc = _template_capacity_row(template)
+        alloc = _template_capacity_row(template, ext)
         req, alloc2d = _augment_virtual(req, pods, alloc[None, :], [template])
         alloc = alloc2d[0]
         cap = self.limiter.node_cap(max_size_headroom)
@@ -265,7 +281,8 @@ class BinpackingNodeEstimator:
                     names, templates, headrooms, group_sp,
                 )
         P = bucket_size(len(pods))
-        req = _pack_pods(pods, P)
+        ext = _estimation_schema(pods)
+        req = _pack_pods(pods, P, ext)
         masks = np.stack(
             [
                 template_mask(pods, templates[g], P, interpod=not dynamic_affinity)
@@ -274,7 +291,7 @@ class BinpackingNodeEstimator:
         )
         allocs = np.stack(
             [
-                _template_capacity_row(templates[g])
+                _template_capacity_row(templates[g], ext)
                 for g in names
             ]
         )
@@ -384,7 +401,8 @@ class BinpackingNodeEstimator:
         gathered from the group-exemplar tensors via group_of_run."""
         U = bucket_size(len(runs))
         run_exemplars = [ex for ex, _ in runs]
-        run_req = _pack_pods(run_exemplars, U)
+        ext = _estimation_schema(run_exemplars)
+        run_req = _pack_pods(run_exemplars, U, ext)
         run_counts = np.zeros((U,), np.int32)
         run_counts[: len(runs)] = [len(members) for _, members in runs]
         masks = np.stack(
@@ -395,7 +413,7 @@ class BinpackingNodeEstimator:
         )
         allocs = np.stack(
             [
-                _template_capacity_row(templates[g])
+                _template_capacity_row(templates[g], ext)
                 for g in names
             ]
         )
@@ -474,7 +492,8 @@ class BinpackingNodeEstimator:
         'schedule k of this run' expands to its first k member pods."""
         U = bucket_size(len(groups))
         exemplars = [g.exemplar for g in groups]
-        run_req = _pack_pods(exemplars, U)
+        ext = _estimation_schema(exemplars)
+        run_req = _pack_pods(exemplars, U, ext)
         run_counts = np.zeros((U,), np.int32)
         run_counts[: len(groups)] = [len(g.pods) for g in groups]
         masks = np.stack(
@@ -482,7 +501,7 @@ class BinpackingNodeEstimator:
         )
         allocs = np.stack(
             [
-                _template_capacity_row(templates[g])
+                _template_capacity_row(templates[g], ext)
                 for g in names
             ]
         )

@@ -16,9 +16,9 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from autoscaler_tpu.expander.core import Option, Strategy
-from autoscaler_tpu.kube.objects import Node
+from autoscaler_tpu.kube.objects import NUM_RESOURCES, Node
 from autoscaler_tpu.parallel.mesh import make_mesh, whatif_best_options
-from autoscaler_tpu.snapshot.packer import resources_row
+from autoscaler_tpu.snapshot.packer import extended_schema, resources_row
 from autoscaler_tpu.snapshot.tensors import bucket_size
 
 import jax.numpy as jnp
@@ -68,12 +68,16 @@ class ScenarioStrategy(Strategy):
                     all_pods[p.key()] = len(pods_list)
                     pods_list.append(p)
         P = bucket_size(len(pods_list))
-        pod_req = np.zeros((P, 6), np.float32)
+        # named extended resources requested by any pending pod are fit
+        # dimensions here too (PREDICATES divergence 4 closure)
+        ext = extended_schema((p.requests for p in pods_list))
+        R = NUM_RESOURCES + len(ext)
+        pod_req = np.zeros((P, R), np.float32)
         for i, p in enumerate(pods_list):
-            pod_req[i] = resources_row(p.requests, 1.0)
+            pod_req[i] = resources_row(p.requests, 1.0, ext)
 
         masks = np.zeros((G_pad, P), bool)
-        allocs = np.zeros((S, G_pad, 6), np.float32)
+        allocs = np.zeros((S, G_pad, R), np.float32)
         prices = np.full((S, G_pad), 1e9, np.float32)  # padded groups: huge price
         caps = np.ones(G_pad, np.int32)
         rng = np.random.default_rng(self.seed)
@@ -81,7 +85,9 @@ class ScenarioStrategy(Strategy):
             for p in o.pods:
                 masks[gi, all_pods[p.key()]] = True
             template = o.node_group.template_node_info()
-            row = resources_row(template.allocatable, template.allocatable.pods)
+            row = resources_row(
+                template.allocatable, template.allocatable.pods, ext
+            )
             base = self.base_prices.get(o.node_group.id(), 1.0)
             caps[gi] = max(
                 1, min(self.max_nodes, o.node_group.max_size() - o.node_group.target_size())

@@ -78,8 +78,21 @@ def format_timestamp(ts: float) -> str:
     )
 
 
+_DEDICATED_RESOURCES = frozenset(
+    {"cpu", "memory", "ephemeral-storage", "pods", GPU_RESOURCE, TPU_RESOURCE}
+)
+
+
 def resources_from_map(m: Optional[Dict[str, Any]]) -> k8s.Resources:
     m = m or {}
+    # every key beyond the dedicated columns is a named extended resource
+    # (hugepages-*, vendor device plugins) and keeps its own identity —
+    # NodeResourcesFit scores each name separately (PREDICATES divergence 4)
+    extended = tuple(sorted(
+        (name, qty)
+        for name, v in m.items()
+        if name not in _DEDICATED_RESOURCES and (qty := parse_quantity(v)) != 0
+    ))
     return k8s.Resources(
         cpu_m=parse_cpu_millis(m.get("cpu", 0)),
         memory=parse_quantity(m.get("memory", 0)),
@@ -87,6 +100,7 @@ def resources_from_map(m: Optional[Dict[str, Any]]) -> k8s.Resources:
         gpu=parse_quantity(m.get(GPU_RESOURCE, 0)),
         tpu=parse_quantity(m.get(TPU_RESOURCE, 0)),
         pods=parse_quantity(m.get("pods", 0)),
+        extended=extended,
     )
 
 
@@ -196,6 +210,11 @@ def daemonset_from_json(obj: Dict[str, Any]) -> k8s.DaemonSet:
         node_selector=dict(tmpl_spec.get("nodeSelector") or {}),
         tolerations=tolerations,
         requests=requests,
+        # the default scheduler targets DS pods via required node affinity
+        # (kubernetes >=1.12); suitable_for evaluates these terms
+        node_selector_terms=_node_selector_terms(
+            tmpl_spec.get("affinity") or {}
+        ),
     )
 
 

@@ -54,6 +54,17 @@ class Resources:
     """A dense resource vector with named accessors.
 
     cpu is in millicores, memory/ephemeral in bytes, gpu/tpu in device counts.
+
+    ``extended`` carries arbitrary NAMED extended resources (device plugins
+    beyond the dedicated gpu/tpu columns, hugepages, vendor accelerators) as
+    a sorted ((name, qty), ...) tuple — the NodeResourcesFit plugin treats
+    every such name as its own dimension
+    (schedulerbased.go:109-163 → noderesources/fit.go), so two distinct
+    device-plugin resources on one node must never conflate. The packer
+    appends one tensor column per distinct name in the snapshot
+    (packer.extended_schema), keeping the base 6-column layout — and every
+    kernel, which is shape-generic over the resource axis — untouched when
+    no extended resources exist.
     """
 
     cpu_m: float = 0.0
@@ -62,15 +73,36 @@ class Resources:
     gpu: float = 0.0
     tpu: float = 0.0
     pods: float = 0.0
+    extended: Tuple[Tuple[str, float], ...] = ()
 
     def as_tuple(self) -> Tuple[float, ...]:
         return (self.cpu_m, self.memory, self.ephemeral, self.gpu, self.tpu, self.pods)
 
+    def extended_map(self) -> Dict[str, float]:
+        return dict(self.extended)
+
+    @staticmethod
+    def _merge_extended(a, b, sign: float) -> Tuple[Tuple[str, float], ...]:
+        if not a and not b:
+            return ()
+        m = dict(a)
+        for name, qty in b:
+            m[name] = m.get(name, 0.0) + sign * qty
+        return tuple(sorted((k, v) for k, v in m.items() if v != 0.0))
+
     def __add__(self, other: "Resources") -> "Resources":
-        return Resources(*[a + b for a, b in zip(self.as_tuple(), other.as_tuple())])
+        base = [a + b for a, b in zip(self.as_tuple(), other.as_tuple())]
+        return Resources(
+            *base,
+            extended=self._merge_extended(self.extended, other.extended, 1.0),
+        )
 
     def __sub__(self, other: "Resources") -> "Resources":
-        return Resources(*[a - b for a, b in zip(self.as_tuple(), other.as_tuple())])
+        base = [a - b for a, b in zip(self.as_tuple(), other.as_tuple())]
+        return Resources(
+            *base,
+            extended=self._merge_extended(self.extended, other.extended, -1.0),
+        )
 
     @staticmethod
     def from_tuple(t) -> "Resources":
@@ -293,7 +325,12 @@ class Node:
         """allocatable minus daemon overhead, floored at zero — what pending
         pods may actually claim on a fresh node of this shape."""
         reduced = self.allocatable - self.daemon_overhead
-        return Resources(*[max(v, 0.0) for v in reduced.as_tuple()])
+        return Resources(
+            *[max(v, 0.0) for v in reduced.as_tuple()],
+            extended=tuple(
+                (name, max(qty, 0.0)) for name, qty in reduced.extended
+            ),
+        )
 
 
 @dataclass
@@ -308,21 +345,31 @@ class DaemonSet:
     node_selector: Dict[str, str] = field(default_factory=dict)
     tolerations: List[Toleration] = field(default_factory=list)
     requests: Resources = field(default_factory=Resources)
+    # required node affinity from the DS pod template (ORed terms) — the
+    # scheduling-style DS targeting kubernetes uses since 1.12 (the default
+    # scheduler places DS pods via NodeAffinity, not the legacy controller
+    # selector), reference simulator/nodes.go:38-56
+    node_selector_terms: Tuple[LabelSelector, ...] = ()
 
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
 
     def suitable_for(self, node: "Node") -> bool:
-        """nodeSelector subset-match + taint toleration — the predicate
-        subset of the reference's per-DS scheduling simulation (documented
-        approximation; affinity-based DS targeting is not modeled). Shares
-        the scheduler predicates via a pod proxy so taint/selector
-        semantics can't drift from the filter plugins."""
+        """nodeSelector subset-match + required node affinity + taint
+        toleration — the predicate set of the reference's per-DS scheduling
+        simulation (simulator/nodes.go:56 → daemonset.GetDaemonSetPodsForNode
+        runs the full filter chain). Shares the scheduler predicates via a
+        pod proxy so selector/affinity/taint semantics can't drift from the
+        filter plugins."""
         proxy = Pod(
             name=self.name,
             namespace=self.namespace,
             node_selector=dict(self.node_selector),
             tolerations=list(self.tolerations),
+            affinity=(
+                Affinity(node_selector_terms=self.node_selector_terms)
+                if self.node_selector_terms else None
+            ),
         )
         return node_matches_selector(proxy, node) and pod_tolerates_taints(
             proxy, node.taints

@@ -57,6 +57,7 @@ from autoscaler_tpu.snapshot.packer import (
     _RowView,
     _self_cell_value,
     _term_matches_pod,
+    extended_schema,
     resources_row,
 )
 from autoscaler_tpu.snapshot.tensors import SnapshotTensors, bucket_size
@@ -164,11 +165,14 @@ class IncrementalPacker:
         self._gen = 0
         self.full_packs = 0
         self.incremental_updates = 0
+        # named extended-resource column schema (packer.extended_schema);
+        # a schema change resizes the resource axis → full rebuild
+        self._ext_schema: tuple = ()
         self._reset(8, 8)
 
     # ------------------------------------------------------------------ state
     def _reset(self, PP: int, NN: int) -> None:
-        R = NUM_RESOURCES
+        R = NUM_RESOURCES + len(self._ext_schema)
         self._PP, self._NN = PP, NN
         self._dense = (
             self._force_dense
@@ -241,9 +245,17 @@ class IncrementalPacker:
         pending; may reference an unlisted node, which packs as pending
         exactly like packer.pack does)."""
         group_of_node = group_of_node or {}
+        pod_items = list(pod_items)
         P, N = len(pod_items), len(nodes)
         PP, NN = bucket_size(P), bucket_size(N)
-        if PP > self._PP or NN > self._NN or self._profiles_bloated():
+        ext = extended_schema((p.requests for _, p in pod_items))
+        if ext != self._ext_schema:
+            # the resource axis itself changes width: every cached row is
+            # the wrong shape — rebuild from scratch under the new schema
+            self._ext_schema = ext
+            self._reset(max(PP, self._PP), max(NN, self._NN))
+            self.full_packs += 1
+        elif PP > self._PP or NN > self._NN or self._profiles_bloated():
             self._reset(max(PP, self._PP), max(NN, self._NN))
             self.full_packs += 1
         else:
@@ -360,7 +372,8 @@ class IncrementalPacker:
             slot.class_id = self._node_profile_id(slot, ports, attached)
             self._node_class[j] = slot.class_id
             self._node_alloc[j] = resources_row(
-                slot.obj.allocatable, slot.obj.allocatable.pods
+                slot.obj.allocatable, slot.obj.allocatable.pods,
+                self._ext_schema,
             )
             self._node_valid[j] = True
 
@@ -371,7 +384,7 @@ class IncrementalPacker:
             slot = self._pod_slots[i]
             slot.class_id = self._pod_profile_id(slot)
             self._pod_class[i] = slot.class_id
-            self._pod_req[i] = resources_row(slot.orig.requests, 1.0)
+            self._pod_req[i] = resources_row(slot.orig.requests, 1.0, self._ext_schema)
             self._pod_valid[i] = True
 
         # ---- group map ---------------------------------------------------
@@ -978,5 +991,6 @@ class IncrementalPacker:
             pod_index=dict(self._pod_rows),
             group_names=list(self._group_names),
             group_index=dict(self._group_index),
+            extended_resources=self._ext_schema,
         )
         return meta

@@ -41,6 +41,8 @@ class SnapshotMeta:
     pod_index: Dict[str, int] = field(default_factory=dict)
     group_names: List[str] = field(default_factory=list)
     group_index: Dict[str, int] = field(default_factory=dict)
+    # named extended resources backing tensor columns NUM_RESOURCES..R-1
+    extended_resources: Tuple[str, ...] = ()
 
     @property
     def num_nodes(self) -> int:
@@ -54,35 +56,77 @@ class SnapshotMeta:
 _MIB = float(1024 * 1024)
 
 
-def resources_row(r: k8s.Resources, pods_count: float) -> np.ndarray:
+def extended_schema(*resource_seqs) -> Tuple[str, ...]:
+    """Union of named extended-resource names across any number of
+    Resources sequences, sorted — the per-snapshot column schema appended
+    after the base NUM_RESOURCES columns (PREDICATES divergence 4: each
+    device-plugin name is its own fit dimension, noderesources/fit.go).
+
+    Callers pass POD-REQUEST sequences only: a name no pod requests can
+    never gate a fit (0 <= anything), so node-side allocatable keys
+    (attachable-volumes-*, unrequested hugepages) must not widen the axis —
+    they would cost tensor columns on every dispatch and flip the
+    incremental packer into full rebuilds whenever a node pool with new
+    allocatable names joins."""
+    names: set = set()
+    for seq in resource_seqs:
+        for r in seq:
+            if r.extended:
+                names.update(name for name, _ in r.extended)
+    return tuple(sorted(names))
+
+
+def resources_row(
+    r: k8s.Resources, pods_count: float, ext: Tuple[str, ...] = ()
+) -> np.ndarray:
     """Resources → dense f32 row. Memory/ephemeral are stored in MiB inside
     tensors (object model keeps bytes): byte counts up to tens of GiB exceed
     f32's 24-bit mantissa, and accumulated rounding could make a pod falsely
-    fit by a few KiB; MiB keeps sums exact for any realistic cluster."""
-    row = np.array(r.as_tuple(), dtype=np.float32)
+    fit by a few KiB; MiB keeps sums exact for any realistic cluster.
+    ``ext`` appends one column per named extended resource, in schema
+    order (extended_schema)."""
+    row = np.zeros(k8s.NUM_RESOURCES + len(ext), dtype=np.float32)
+    row[: k8s.NUM_RESOURCES] = r.as_tuple()
     row[k8s.MEMORY] = r.memory / _MIB
     row[k8s.EPHEMERAL] = r.ephemeral / _MIB
     row[k8s.PODS] = pods_count
+    if ext and r.extended:
+        # names outside the schema (node-side allocatable no pod requests)
+        # are simply not columns — skip them
+        em = dict(r.extended)
+        for k, name in enumerate(ext):
+            row[k8s.NUM_RESOURCES + k] = em.get(name, 0.0)
     return row
 
 
 def resources_rows(
-    items, pods_counts, out: np.ndarray
+    items, pods_counts, out: np.ndarray, ext: Tuple[str, ...] = ()
 ) -> None:
     """Vectorized twin of resources_row over a sequence: one np.array build
     + two column scalings instead of one tiny array per object — the
     per-loop hot path at 100k pods is this flatten. Invariant parity with
     resources_row (tensors store MiB, PODS column override) is pinned by
     tests/test_snapshot.py's row-equivalence test. pods_counts=None keeps
-    as_tuple()'s own pods values (the node-allocatable case)."""
+    as_tuple()'s own pods values (the node-allocatable case). The extended
+    columns fill sparsely: clusters without named extended resources pay
+    nothing, and only objects that carry them loop."""
     n = len(items)
     if n == 0:
         return
-    out[:n] = np.array([r.as_tuple() for r in items], dtype=np.float32)
+    out[:n, : k8s.NUM_RESOURCES] = np.array(
+        [r.as_tuple() for r in items], dtype=np.float32
+    )
     out[:n, k8s.MEMORY] /= _MIB
     out[:n, k8s.EPHEMERAL] /= _MIB
     if pods_counts is not None:
         out[:n, k8s.PODS] = pods_counts
+    if ext:
+        col = {name: k8s.NUM_RESOURCES + k for k, name in enumerate(ext)}
+        for i, r in enumerate(items):
+            for name, qty in r.extended:
+                c = col.get(name)  # None: node-side name outside the schema
+                if c is not None:
+                    out[i, c] = qty
 
 
 def _topology_domains(
@@ -783,7 +827,9 @@ def pack(
     PP = pad_pods if pad_pods is not None else bucket_size(P)
     NN = pad_nodes if pad_nodes is not None else bucket_size(N)
     assert PP >= P and NN >= N, "padding must not truncate"
-    R = NUM_RESOURCES
+    ext = extended_schema((p.requests for p in meta.pods))
+    meta.extended_resources = ext
+    R = NUM_RESOURCES + len(ext)
 
     if dense_mask is None:
         dense_mask = PP * NN <= DENSE_MASK_CELL_LIMIT
@@ -801,14 +847,14 @@ def pack(
         node_of_pod.append(meta.node_index.get(pod.node_name, -1) if pod.node_name else -1)
 
     # as_tuple() already carries allocatable.pods in the PODS column
-    resources_rows([n.allocatable for n in meta.nodes], None, node_alloc)
+    resources_rows([n.allocatable for n in meta.nodes], None, node_alloc, ext)
     node_valid[:N] = True
     for j, node in enumerate(meta.nodes):
         g = group_of_node.get(node.name)
         if g is not None:
             node_group[j] = meta.group_index[g]
 
-    resources_rows([p.requests for p in meta.pods], 1.0, pod_req)
+    resources_rows([p.requests for p in meta.pods], 1.0, pod_req, ext)
     pod_valid[:P] = True
     if P:
         nop = np.asarray(node_of_pod)

@@ -244,6 +244,25 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="local JSON checkpoint path; overrides the default "
                         "VerticalPodAutoscalerCheckpoint CRD persistence "
                         "(use for out-of-cluster runs without the CRD)")
+    p.add_argument("--storage", default="checkpoint",
+                   choices=("checkpoint", "prometheus"),
+                   help="warm-start source (reference recommender --storage): "
+                        "checkpoint CRDs, or a Prometheus history replay at "
+                        "startup (then live-only)")
+    p.add_argument("--prometheus-address", default="",
+                   help="Prometheus base URL for --storage=prometheus")
+    p.add_argument("--history-length", default="8d")
+    p.add_argument("--history-resolution", default="1h")
+    p.add_argument("--prometheus-query-timeout", default="5m")
+    p.add_argument("--prometheus-cadvisor-job-name", default="kubernetes-cadvisor")
+    p.add_argument("--pod-label-prefix", default="pod_label_")
+    p.add_argument("--metric-for-pod-labels",
+                   default='up{job="kube-state-metrics"}[8d]')
+    p.add_argument("--pod-namespace-label", default="kubernetes_namespace")
+    p.add_argument("--pod-name-label", default="kubernetes_pod_name")
+    p.add_argument("--container-namespace-label", default="namespace")
+    p.add_argument("--container-pod-name-label", default="pod_name")
+    p.add_argument("--container-name-label", default="name")
     p.add_argument("--no-checkpoints", action="store_true",
                    help="run stateless: neither CRD nor file checkpoints")
     p.add_argument("--memory-half-life", type=float, default=24 * 3600.0,
@@ -294,7 +313,9 @@ def main(argv=None) -> int:
     # a rescheduled recommender pod resumes warm from the control plane. An
     # explicit --checkpoint-file opts into local-file persistence instead.
     store = None
-    if args.no_checkpoints:
+    if args.no_checkpoints or args.storage == "prometheus":
+        # prometheus storage replays history at startup instead of resuming
+        # from checkpoints (the reference's --storage switch, main.go)
         args.checkpoint_file = ""  # truly stateless: no file either
     elif not args.checkpoint_file:
         store = VpaCheckpointStore(client)
@@ -323,6 +344,41 @@ def main(argv=None) -> int:
             )
         ),
     )
+
+    if args.storage == "prometheus" and "recommender" in components:
+        # Startup history replay (cluster_feeder.go InitFromHistoryProvider):
+        # list VPAs once for key matching, pull the three Prometheus queries,
+        # backfill the decaying histograms at original timestamps. A failure
+        # is fatal, matching the reference recommender (a silent cold start
+        # would hide a misconfigured --prometheus-address).
+        from autoscaler_tpu.vpa.prometheus_history import (
+            PrometheusHistoryConfig,
+            PrometheusHistorySource,
+            parse_duration_s,
+        )
+
+        if not args.prometheus_address:
+            raise SystemExit("--storage=prometheus requires --prometheus-address")
+        source = PrometheusHistorySource(PrometheusHistoryConfig(
+            address=args.prometheus_address,
+            history_length=args.history_length,
+            history_resolution=args.history_resolution,
+            query_timeout_s=parse_duration_s(args.prometheus_query_timeout),
+            pod_label_prefix=args.pod_label_prefix,
+            pod_labels_metric_name=args.metric_for_pod_labels,
+            pod_namespace_label=args.pod_namespace_label,
+            pod_name_label=args.pod_name_label,
+            ctr_namespace_label=args.container_namespace_label,
+            ctr_pod_name_label=args.container_pod_name_label,
+            ctr_name_label=args.container_name_label,
+            cadvisor_job_name=args.prometheus_cadvisor_job_name,
+        ))
+        vpas = [v for v, _ in binding.list_vpas_with_status()]
+        replayed = ClusterStateFeeder(runner.model, vpas).replay_history(source)
+        logging.getLogger("vpa").info(
+            "replayed %d historical samples from %s",
+            replayed, args.prometheus_address,
+        )
 
     admission = None
     if "admission" in components:

@@ -1,0 +1,283 @@
+"""Named extended resources as first-class fit dimensions (PREDICATES
+divergence 4 closure) and DaemonSet affinity-based targeting in template
+overhead (divergence 6 closure).
+
+Reference: NodeResourcesFit evaluates EVERY resource name in a pod's
+requests against the node's allocatable (schedulerbased.go:109-163 →
+noderesources/fit.go) — two device plugins on one node are distinct
+dimensions; and simulator/nodes.go:38-56 places DaemonSet pods via the full
+filter chain, including required node affinity (how the default scheduler
+targets DS pods since k8s 1.12)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+from autoscaler_tpu.kube.convert import daemonset_from_json, resources_from_map
+from autoscaler_tpu.kube.objects import (
+    NUM_RESOURCES,
+    DaemonSet,
+    LabelSelector,
+    Resources,
+)
+from autoscaler_tpu.snapshot.packer import extended_schema, pack, resources_row
+from autoscaler_tpu.utils.test_utils import GB, MB, build_test_node, build_test_pod
+
+FPGA = "example.com/fpga"
+NIC = "example.com/nic"
+
+
+def fpga_pod(name, fpga=1.0, cpu=100):
+    p = build_test_pod(name, cpu_m=cpu)
+    p.requests = Resources(
+        cpu_m=cpu, memory=100 * MB, pods=0, extended=((FPGA, fpga),)
+    )
+    return p
+
+
+def device_node(name, fpga=2.0, nic=0.0, cpu=8000):
+    n = build_test_node(name, cpu_m=cpu, mem=16 * GB)
+    ext = tuple(
+        x for x in ((FPGA, fpga), (NIC, nic)) if x[1] > 0
+    )
+    n.allocatable = Resources(
+        cpu_m=cpu, memory=16 * GB, pods=110, extended=ext
+    )
+    return n
+
+
+class TestResourcesArithmetic:
+    def test_add_merges_by_name(self):
+        a = Resources(cpu_m=100, extended=((FPGA, 1.0),))
+        b = Resources(cpu_m=200, extended=((FPGA, 2.0), (NIC, 1.0)))
+        s = a + b
+        assert s.cpu_m == 300
+        assert s.extended_map() == {FPGA: 3.0, NIC: 1.0}
+
+    def test_sub_drops_zeroed_names(self):
+        a = Resources(extended=((FPGA, 2.0), (NIC, 1.0)))
+        b = Resources(extended=((FPGA, 2.0),))
+        assert (a - b).extended_map() == {NIC: 1.0}
+
+    def test_convert_collects_unknown_names(self):
+        r = resources_from_map({
+            "cpu": "500m", "memory": "1Gi", "nvidia.com/gpu": "1",
+            FPGA: "2", "hugepages-2Mi": "512Mi",
+        })
+        assert r.cpu_m == 500 and r.gpu == 1
+        em = r.extended_map()
+        assert em[FPGA] == 2
+        assert em["hugepages-2Mi"] == 512 * MB
+
+
+class TestPackedSchema:
+    def test_schema_and_columns(self):
+        nodes = [device_node("n0", fpga=2, nic=4)]
+        pods = [fpga_pod("p0")]
+        tensors, meta = pack(nodes, pods)
+        # schema = pod-requested names ONLY: the node's nic allocatable
+        # widens nothing (a name no pod requests can never gate a fit)
+        assert meta.extended_resources == (FPGA,)
+        R = NUM_RESOURCES + 1
+        assert tensors.node_alloc.shape[1] == R
+        assert tensors.pod_req.shape[1] == R
+        col = NUM_RESOURCES
+        assert float(tensors.node_alloc[0, col]) == 2.0
+        assert float(tensors.pod_req[0, col]) == 1.0
+
+    def test_node_only_names_do_not_widen(self):
+        """Real cloud nodes report allocatable like attachable-volumes-*:
+        with no pod requesting them the snapshot must stay base-width."""
+        n = build_test_node("n0", cpu_m=4000)
+        n.allocatable = Resources(
+            cpu_m=4000, memory=8 * GB, pods=110,
+            extended=(("attachable-volumes-aws-ebs", 25.0),),
+        )
+        tensors, meta = pack([n], [build_test_pod("p0")])
+        assert meta.extended_resources == ()
+        assert tensors.node_alloc.shape[1] == NUM_RESOURCES
+
+    def test_no_extended_keeps_base_width(self):
+        tensors, meta = pack(
+            [build_test_node("n0")], [build_test_pod("p0")]
+        )
+        assert meta.extended_resources == ()
+        assert tensors.node_alloc.shape[1] == NUM_RESOURCES
+
+    def test_row_and_rows_agree(self):
+        r = Resources(cpu_m=100, memory=GB, extended=((NIC, 3.0),))
+        ext = (FPGA, NIC)
+        row = resources_row(r, 1.0, ext)
+        assert row.shape == (NUM_RESOURCES + 2,)
+        assert row[NUM_RESOURCES] == 0.0 and row[NUM_RESOURCES + 1] == 3.0
+
+
+class TestEstimatorDistinguishesDevices:
+    def test_fpga_capacity_bounds_packing(self):
+        """5 one-fpga pods on a 2-fpga template need 3 nodes, even though
+        cpu alone would fit all 5 on one node. The old collapse (unknown
+        names dropped) estimated 1 node — an under-provision the scheduler
+        then strands as Pending."""
+        template = device_node("tmpl", fpga=2)
+        pods = [fpga_pod(f"p{i}") for i in range(5)]
+        count, scheduled = BinpackingNodeEstimator().estimate(pods, template)
+        assert count == 3
+        assert len(scheduled) == 5
+
+    def test_two_plugin_resources_stay_distinct(self):
+        """A pod requesting nic must not consume fpga capacity: 2 fpga pods
+        + 2 nic pods on a (fpga=1, nic=8) template → fpga forces 2 nodes,
+        nic rides along."""
+        template = device_node("tmpl", fpga=1, nic=8)
+        pods = [fpga_pod("f0"), fpga_pod("f1")]
+        for i in range(2):
+            p = build_test_pod(f"n{i}", cpu_m=100)
+            p.requests = Resources(
+                cpu_m=100, memory=100 * MB, pods=0, extended=((NIC, 1.0),)
+            )
+            pods.append(p)
+        count, scheduled = BinpackingNodeEstimator().estimate(pods, template)
+        assert count == 2
+        assert len(scheduled) == 4
+
+    def test_pod_requesting_absent_resource_never_schedules(self):
+        template = build_test_node("tmpl", cpu_m=8000)
+        pods = [fpga_pod("p0")]
+        count, scheduled = BinpackingNodeEstimator().estimate(pods, template)
+        assert count == 0 and scheduled == []
+
+    def test_estimate_many_mixed_groups(self):
+        """Group A has fpga nodes, group B does not: the fpga pod fits only
+        in A; plain pods fit in either."""
+        templates = {
+            "a": device_node("tmpl-a", fpga=1),
+            "b": build_test_node("tmpl-b", cpu_m=8000),
+        }
+        pods = [fpga_pod("p0"), build_test_pod("plain", cpu_m=100)]
+        res = BinpackingNodeEstimator().estimate_many(pods, templates)
+        count_a, sched_a = res["a"]
+        count_b, sched_b = res["b"]
+        assert count_a == 1 and len(sched_a) == 2
+        assert count_b == 1 and [p.name for p in sched_b] == ["plain"]
+
+
+class TestIncrementalSchemaChange:
+    def test_new_extended_name_forces_rebuild_with_parity(self):
+        from autoscaler_tpu.snapshot.incremental import IncrementalPacker
+
+        nodes = [device_node("n0", fpga=2), build_test_node("n1", cpu_m=4000)]
+        plain = build_test_pod("plain", cpu_m=200, node_name="n1")
+        packer = IncrementalPacker()
+        t1, m1 = packer.update(
+            nodes, [(plain.key(), plain)], {plain.key(): "n1"}
+        )
+        # fpga capacity exists but no pod requests it → base schema
+        assert m1.extended_resources == ()
+        full_packs_before = packer.full_packs
+
+        nic_pod = build_test_pod("nicpod", cpu_m=100)
+        nic_pod.requests = Resources(
+            cpu_m=100, memory=50 * MB, pods=0, extended=((NIC, 1.0),)
+        )
+        items = [(plain.key(), plain), (nic_pod.key(), nic_pod)]
+        t2, m2 = packer.update(nodes, items, {plain.key(): "n1"})
+        assert m2.extended_resources == (NIC,)
+        assert packer.full_packs == full_packs_before + 1  # schema rebuild
+        # parity vs a fresh full pack on the same world
+        ref_t, ref_m = pack(nodes, [plain, nic_pod])
+        for key in (plain.key(), nic_pod.key()):
+            i, j = m2.pod_index[key], ref_m.pod_index[key]
+            np.testing.assert_array_equal(
+                np.asarray(t2.pod_req[i]), np.asarray(ref_t.pod_req[j])
+            )
+
+    def test_stable_schema_stays_incremental(self):
+        from autoscaler_tpu.snapshot.incremental import IncrementalPacker
+
+        nodes = [device_node("n0", fpga=2)]
+        pod = fpga_pod("p0")
+        packer = IncrementalPacker()
+        packer.update(nodes, [(pod.key(), pod)], {})
+        before = packer.incremental_updates
+        packer.update(nodes, [(pod.key(), pod)], {})
+        assert packer.incremental_updates == before + 1
+
+
+class TestDaemonSetAffinityTargeting:
+    def _ds_with_affinity(self, key="pool", value="gpu"):
+        return DaemonSet(
+            name="device-plugin", namespace="kube-system",
+            requests=Resources(cpu_m=300, memory=256 * MB),
+            node_selector_terms=(
+                LabelSelector.from_dict({key: value}),
+            ),
+        )
+
+    def test_suitable_only_on_matching_nodes(self):
+        ds = self._ds_with_affinity()
+        target = build_test_node("gpu-node", cpu_m=4000)
+        target.labels["pool"] = "gpu"
+        other = build_test_node("cpu-node", cpu_m=4000)
+        assert ds.suitable_for(target)
+        assert not ds.suitable_for(other)
+
+    def test_parse_from_apps_v1_json(self):
+        ds = daemonset_from_json({
+            "metadata": {"name": "nvidia-plugin", "namespace": "kube-system"},
+            "spec": {"template": {"spec": {
+                "affinity": {"nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [
+                            {"matchExpressions": [
+                                {"key": "pool", "operator": "In",
+                                 "values": ["gpu"]},
+                            ]},
+                        ],
+                    },
+                }},
+                "containers": [
+                    {"resources": {"requests": {"cpu": "300m"}}},
+                ],
+            }}},
+        })
+        assert len(ds.node_selector_terms) == 1
+        node = build_test_node("n", cpu_m=4000)
+        node.labels["pool"] = "gpu"
+        assert ds.suitable_for(node)
+        assert not ds.suitable_for(build_test_node("m", cpu_m=4000))
+
+    def test_force_ds_charges_only_affinity_matched_templates(self):
+        """--force-ds through the template provider: a DS affinity-targeting
+        pool=gpu charges the gpu group's template and not the cpu group's
+        (reference simulator/nodes.go:56 runs the full filter chain)."""
+        from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+        from autoscaler_tpu.processors.nodeinfos import (
+            MixedTemplateNodeInfoProvider,
+        )
+
+        provider = TestCloudProvider()
+        gpu_tmpl = build_test_node("gpu-tmpl", cpu_m=4000, mem=8 * GB)
+        gpu_tmpl.labels["pool"] = "gpu"
+        cpu_tmpl = build_test_node("cpu-tmpl", cpu_m=4000, mem=8 * GB)
+        provider.add_node_group("gpu", 0, 10, 1, gpu_tmpl)
+        provider.add_node_group("cpu", 0, 10, 1, cpu_tmpl)
+        gpu_node = build_test_node("gpu-0", cpu_m=4000, mem=8 * GB)
+        gpu_node.labels["pool"] = "gpu"
+        cpu_node = build_test_node("cpu-0", cpu_m=4000, mem=8 * GB)
+        provider.add_node("gpu", gpu_node)
+        provider.add_node("cpu", cpu_node)
+
+        prov = MixedTemplateNodeInfoProvider()
+        ds = self._ds_with_affinity()
+        groups = {g.id(): g for g in provider.node_groups()}
+        tmpl_gpu = prov.template_for(
+            groups["gpu"], [gpu_node], 0.0,
+            pending_daemonsets=[ds],
+        )
+        tmpl_cpu = prov.template_for(
+            groups["cpu"], [cpu_node], 0.0,
+            pending_daemonsets=[ds],
+        )
+        assert tmpl_gpu.daemon_overhead.cpu_m == pytest.approx(300)
+        assert tmpl_cpu.daemon_overhead.cpu_m == 0.0

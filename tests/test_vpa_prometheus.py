@@ -1,0 +1,247 @@
+"""Prometheus history provider: query-string parity with the reference,
+recorded-server round trip, and a recommender warm-start replay.
+
+Reference: vertical-pod-autoscaler/pkg/recommender/input/history/
+history_provider.go (GetClusterHistory :263, readResourceHistory :186,
+readLastLabels :225) and its own test expectations
+(history_provider_test.go:34-38)."""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from autoscaler_tpu.kube.objects import LabelSelector
+from autoscaler_tpu.vpa.api import Vpa
+from autoscaler_tpu.vpa.feeder import ClusterStateFeeder
+from autoscaler_tpu.vpa.prometheus_history import (
+    PrometheusHistoryConfig,
+    PrometheusHistorySource,
+    parse_duration_s,
+)
+from autoscaler_tpu.vpa.recommender import (
+    ClusterStateModel,
+    ContainerKey,
+    PercentileRecommender,
+)
+
+GB = 1024 ** 3
+
+
+class TestDurations:
+    @pytest.mark.parametrize("s,expect", [
+        ("30s", 30.0), ("5m", 300.0), ("1h", 3600.0),
+        ("8d", 8 * 86400.0), ("2w", 14 * 86400.0), ("1y", 365 * 86400.0),
+        ("250ms", 0.25),
+    ])
+    def test_prometheus_duration_grammar(self, s, expect):
+        assert parse_duration_s(s) == expect
+
+    @pytest.mark.parametrize("bad", ["", "8", "d8", "1.5h", "8dd"])
+    def test_invalid_rejected(self, bad):
+        with pytest.raises(ValueError):
+            parse_duration_s(bad)
+
+
+class TestQueryStrings:
+    """Byte-for-byte the selector structure the reference builds
+    (GetClusterHistory :263; expectations history_provider_test.go:34-38)."""
+
+    def _source(self, **kw):
+        cfg = PrometheusHistoryConfig(
+            address="http://prom:9090", history_resolution="30s", **kw
+        )
+        return PrometheusHistorySource(cfg)
+
+    def test_cpu_query_matches_reference_expectation(self):
+        assert self._source().cpu_query() == (
+            'rate(container_cpu_usage_seconds_total{job="kubernetes-cadvisor", '
+            'pod_name=~".+", name!="POD", name!=""}[30s])'
+        )
+
+    def test_memory_query_matches_reference_expectation(self):
+        assert self._source().memory_query() == (
+            'container_memory_working_set_bytes{job="kubernetes-cadvisor", '
+            'pod_name=~".+", name!="POD", name!=""}'
+        )
+
+    def test_namespaced_query(self):
+        assert self._source(namespace="kube-system").cpu_query() == (
+            'rate(container_cpu_usage_seconds_total{job="kubernetes-cadvisor", '
+            'pod_name=~".+", name!="POD", name!="", namespace="kube-system"}'
+            "[30s])"
+        )
+
+    def test_no_job_matcher_when_job_name_empty(self):
+        q = self._source(cadvisor_job_name="").cpu_query()
+        assert q.startswith(
+            'rate(container_cpu_usage_seconds_total{pod_name=~".+"'
+        )
+
+
+def _matrix(series):
+    return {
+        "status": "success",
+        "data": {"resultType": "matrix", "result": series},
+    }
+
+
+class _RecordedProm(BaseHTTPRequestHandler):
+    """A reference-shaped Prometheus /api/v1 endpoint: answers the three
+    provider queries from canned matrices and records every request."""
+
+    requests: list = []
+
+    def do_GET(self):  # noqa: N802
+        parsed = urllib.parse.urlparse(self.path)
+        params = dict(urllib.parse.parse_qsl(parsed.query))
+        type(self).requests.append((parsed.path, params))
+        query = params.get("query", "")
+        if parsed.path == "/api/v1/query_range":
+            if query.startswith("rate(container_cpu_usage_seconds_total"):
+                body = _matrix([
+                    {
+                        "metric": {"namespace": "default", "pod_name": "web-1",
+                                   "name": "main"},
+                        "values": [[i * 60.0, "0.5"] for i in range(50)],
+                    },
+                    {
+                        "metric": {"namespace": "default", "pod_name": "web-1",
+                                   "name": "main"},
+                        # second chunk for the same container: must append
+                        "values": [[(50 + i) * 60.0, "0.7"] for i in range(50)],
+                    },
+                ])
+            else:
+                body = _matrix([
+                    {
+                        "metric": {"namespace": "default", "pod_name": "web-1",
+                                   "name": "main"},
+                        "values": [[i * 60.0, str(1 * GB)] for i in range(100)],
+                    },
+                ])
+        elif parsed.path == "/api/v1/query":
+            body = _matrix([
+                {
+                    "metric": {
+                        "kubernetes_namespace": "default",
+                        "kubernetes_pod_name": "web-1",
+                        "pod_label_app": "web",
+                        "job": "kube-state-metrics",
+                    },
+                    "values": [[900.0, "1"]],
+                },
+                {
+                    # staler duplicate with different labels: must lose
+                    "metric": {
+                        "kubernetes_namespace": "default",
+                        "kubernetes_pod_name": "web-1",
+                        "pod_label_app": "stale",
+                    },
+                    "values": [[100.0, "1"]],
+                },
+            ])
+        else:
+            self.send_error(404)
+            return
+        payload = json.dumps(body).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, *a):  # silence
+        pass
+
+
+@pytest.fixture()
+def prom_server():
+    _RecordedProm.requests = []
+    srv = HTTPServer(("127.0.0.1", 0), _RecordedProm)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+class TestRecordedServer:
+    def test_round_trip_and_request_shape(self, prom_server):
+        src = PrometheusHistorySource(PrometheusHistoryConfig(
+            address=prom_server, history_length="8d", history_resolution="1h",
+        ))
+        cpu = src.cpu_series()
+        mem = src.memory_series()
+        labels = src.pod_labels()
+
+        key = ("default", "web-1", "main")
+        assert len(cpu[key]) == 100  # both chunks appended, sorted
+        assert cpu[key][0] == (0.0, 0.5)
+        assert cpu[key][-1] == (99 * 60.0, 0.7)
+        assert len(mem[key]) == 100
+        # label prefix stripped; freshest sample wins over the stale series
+        assert labels[("default", "web-1")] == {"app": "web"}
+
+        paths = [p for p, _ in _RecordedProm.requests]
+        assert paths == ["/api/v1/query_range", "/api/v1/query_range",
+                         "/api/v1/query"]
+        # range params: an 8d window at 1h step
+        _, params = _RecordedProm.requests[0]
+        assert params["step"] == "3600s"
+        assert float(params["end"]) - float(params["start"]) == pytest.approx(
+            8 * 86400.0, abs=5.0
+        )
+        # the three queries only fire once: accessors reuse the cache
+        src.cpu_series()
+        assert len(_RecordedProm.requests) == 3
+
+    def test_warm_start_replay(self, prom_server):
+        """Full warm start: recorded server → HistorySource → feeder replay →
+        the recommender produces a target with ZERO live samples (the
+        reference's InitFromHistoryProvider behavior)."""
+        src = PrometheusHistorySource(PrometheusHistoryConfig(
+            address=prom_server,
+        ))
+        model = ClusterStateModel()
+        vpa = Vpa(name="my-vpa",
+                  target_selector=LabelSelector.from_dict({"app": "web"}))
+        n = ClusterStateFeeder(model, [vpa]).replay_history(src)
+        assert n == 200  # 100 cpu + 100 memory points
+        recs = PercentileRecommender(model).recommend(now_ts=100 * 60.0)
+        rec = recs[ContainerKey("my-vpa", "main")]
+        # p90 over 50x0.5 + 50x0.7 cores ~ 0.7, +15% margin
+        assert rec.target_cpu == pytest.approx(0.7 * 1.15, rel=0.1)
+        assert rec.target_memory >= 1 * GB
+
+    def test_error_envelope_raises(self, prom_server):
+        src = PrometheusHistorySource(PrometheusHistoryConfig(
+            address=prom_server,
+        ))
+
+        def failing_open(url, timeout):
+            import io
+            import contextlib
+
+            @contextlib.contextmanager
+            def cm():
+                yield io.BytesIO(json.dumps(
+                    {"status": "error", "error": "query too long"}
+                ).encode())
+            return cm()
+
+        src._open = failing_open
+        with pytest.raises(RuntimeError, match="query too long"):
+            src.cpu_series()
+
+    def test_missing_container_label_raises(self, prom_server):
+        """A scrape config whose series lack the configured container label
+        must fail loudly (reference getContainerIDFromLabels hard-fails),
+        not silently drop all history."""
+        src = PrometheusHistorySource(PrometheusHistoryConfig(
+            address=prom_server, ctr_name_label="container_name",
+        ))
+        with pytest.raises(RuntimeError, match="container_name"):
+            src.cpu_series()
