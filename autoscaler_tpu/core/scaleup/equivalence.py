@@ -48,6 +48,7 @@ def _spec_fingerprint(pod: Pod) -> Tuple:
         pod.topology_spread,  # the spread scan gate reads run exemplars
         pod.volume_node_affinity,  # bound-PV placement constraints
         pod.rwop_handles,
+        pod.legacy_volumes,  # same-volume node conflicts are per-identity
         pod.priority,
     )
 

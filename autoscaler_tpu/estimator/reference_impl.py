@@ -20,6 +20,28 @@ import numpy as np
 from autoscaler_tpu.kube.objects import CPU, MEMORY
 
 
+def ffd_order(pod_req: np.ndarray, template_alloc: np.ndarray) -> np.ndarray:
+    """Stable score-descending pod order — the ONE FFD order spec every
+    kernel, oracle, and the C++ baseline share: f32
+    `cpu·mem_cap + mem·cpu_cap` (the division-free order-equivalent of the
+    reference's cpu/cpu_cap + mem/mem_cap, binpacking_estimator.go:164-193;
+    see ops/binpack.ffd_scores for why division is banned — TPU f32 divide
+    is not correctly rounded and flips ulp-near orders vs the host)."""
+    cpu_cap = np.float32(template_alloc[CPU])
+    mem_cap = np.float32(template_alloc[MEMORY])
+    P = pod_req.shape[0]
+    score = np.zeros(P, np.float32)
+    if cpu_cap > 0:
+        score = score + pod_req[:, CPU].astype(np.float32) * (
+            mem_cap if mem_cap > 0 else np.float32(1.0)
+        )
+    if mem_cap > 0:
+        score = score + pod_req[:, MEMORY].astype(np.float32) * (
+            cpu_cap if cpu_cap > 0 else np.float32(1.0)
+        )
+    return np.argsort(-score, kind="stable")
+
+
 def ffd_binpack_reference(
     pod_req: np.ndarray,         # [P, R]
     pod_mask: np.ndarray,        # [P] bool
@@ -28,14 +50,7 @@ def ffd_binpack_reference(
 ) -> Tuple[int, np.ndarray]:
     """Returns (node_count, scheduled[P] bool)."""
     P = pod_req.shape[0]
-    cpu_cap = template_alloc[CPU]
-    mem_cap = template_alloc[MEMORY]
-    score = np.zeros(P, np.float32)
-    if cpu_cap > 0:
-        score += pod_req[:, CPU] / cpu_cap
-    if mem_cap > 0:
-        score += pod_req[:, MEMORY] / mem_cap
-    order = np.argsort(-score, kind="stable")
+    order = ffd_order(pod_req, template_alloc)
 
     used: list = []  # per-open-node usage vectors, in open order
     scheduled = np.zeros(P, bool)
@@ -73,14 +88,7 @@ def ffd_binpack_reference_affinity(
     (binpacking_estimator.go:119-141) over the term factorization."""
     P = pod_req.shape[0]
     T = match.shape[0]
-    cpu_cap = template_alloc[CPU]
-    mem_cap = template_alloc[MEMORY]
-    score = np.zeros(P, np.float32)
-    if cpu_cap > 0:
-        score += pod_req[:, CPU] / cpu_cap
-    if mem_cap > 0:
-        score += pod_req[:, MEMORY] / mem_cap
-    order = np.argsort(-score, kind="stable")
+    order = ffd_order(pod_req, template_alloc)
 
     used: list = []
     pm = []        # per-open-node matching count per term [T]

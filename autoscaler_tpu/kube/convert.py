@@ -120,22 +120,54 @@ def _label_selector(sel: Optional[Dict[str, Any]]) -> k8s.LabelSelector:
     )
 
 
+def _node_term_selector(term: Dict[str, Any]) -> k8s.LabelSelector:
+    """One nodeSelectorTerm (matchExpressions + matchFields) → LabelSelector,
+    with Kubernetes semantics preserved: metadata.name matchFields translate
+    to the packer's node-name sentinel key, any other field key makes the
+    term unsatisfiable (conservative — dropping it would over-admit), and an
+    EMPTY term matches NO objects (an empty LabelSelector here would match
+    everything, so the never-matching sentinel is emitted instead). Shared
+    by pod/DaemonSet node affinity and PV node affinity so the field
+    handling cannot drift."""
+    exprs = [
+        k8s.LabelSelectorRequirement(
+            key=e.get("key", ""),
+            operator=e.get("operator", "In"),
+            values=tuple(e.get("values") or ()),
+        )
+        for e in term.get("matchExpressions") or ()
+    ]
+    for f in term.get("matchFields") or ():
+        if f.get("key") == "metadata.name":
+            exprs.append(
+                k8s.LabelSelectorRequirement(
+                    key=k8s.NODE_NAME_FIELD_KEY,
+                    operator=f.get("operator", "In"),
+                    values=tuple(f.get("values") or ()),
+                )
+            )
+        else:
+            exprs.append(
+                k8s.LabelSelectorRequirement(
+                    key=k8s.NODE_NAME_FIELD_KEY, operator="In", values=()
+                )
+            )
+    if not exprs:
+        exprs.append(
+            k8s.LabelSelectorRequirement(
+                key=k8s.NODE_NAME_FIELD_KEY, operator="In", values=()
+            )
+        )
+    return k8s.LabelSelector(match_expressions=tuple(exprs))
+
+
 def _node_selector_terms(affinity: Dict[str, Any]) -> Tuple[k8s.LabelSelector, ...]:
     na = (affinity.get("nodeAffinity") or {}).get(
         "requiredDuringSchedulingIgnoredDuringExecution"
     ) or {}
-    terms = []
-    for term in na.get("nodeSelectorTerms") or ():
-        exprs = tuple(
-            k8s.LabelSelectorRequirement(
-                key=e.get("key", ""),
-                operator=e.get("operator", "In"),
-                values=tuple(e.get("values") or ()),
-            )
-            for e in term.get("matchExpressions") or ()
-        )
-        terms.append(k8s.LabelSelector(match_expressions=exprs))
-    return tuple(terms)
+    return tuple(
+        _node_term_selector(term) for term in na.get("nodeSelectorTerms") or ()
+    )
 
 
 def _pod_affinity_terms(section: Optional[Dict[str, Any]]) -> Tuple[k8s.PodAffinityTerm, ...]:
@@ -239,51 +271,14 @@ def pv_node_affinity_terms(pv: Dict[str, Any]) -> Tuple[k8s.LabelSelector, ...]:
     these — the VolumeBinding filter's bound-PV check, which subsumes the
     legacy VolumeZone zone-label rule).
 
-    matchFields: the only field key Kubernetes admits is metadata.name
-    (local-volume provisioners pin PVs to one node this way) — translated to
-    the packer's node-name sentinel key. Any other field key makes the term
-    unsatisfiable (conservative: a dropped constraint would over-admit and
-    strand the pod after a drain)."""
+    matchFields / empty-term semantics live in _node_term_selector."""
     req = (
         ((pv.get("spec") or {}).get("nodeAffinity") or {}).get("required") or {}
     )
-    terms = []
-    for term in req.get("nodeSelectorTerms") or ():
-        exprs = [
-            k8s.LabelSelectorRequirement(
-                key=e.get("key", ""),
-                operator=e.get("operator", "In"),
-                values=tuple(e.get("values") or ()),
-            )
-            for e in term.get("matchExpressions") or ()
-        ]
-        for f in term.get("matchFields") or ():
-            if f.get("key") == "metadata.name":
-                exprs.append(
-                    k8s.LabelSelectorRequirement(
-                        key=k8s.NODE_NAME_FIELD_KEY,
-                        operator=f.get("operator", "In"),
-                        values=tuple(f.get("values") or ()),
-                    )
-                )
-            else:
-                # unknown field key: never-matching requirement
-                exprs.append(
-                    k8s.LabelSelectorRequirement(
-                        key=k8s.NODE_NAME_FIELD_KEY, operator="In", values=()
-                    )
-                )
-        if not exprs:
-            # an empty nodeSelectorTerm matches NO objects in Kubernetes; an
-            # empty LabelSelector here would match EVERYTHING — emit the
-            # never-matching sentinel instead
-            exprs.append(
-                k8s.LabelSelectorRequirement(
-                    key=k8s.NODE_NAME_FIELD_KEY, operator="In", values=()
-                )
-            )
-        terms.append(k8s.LabelSelector(match_expressions=tuple(exprs)))
-    return tuple(terms)
+    return tuple(
+        _node_term_selector(term)
+        for term in req.get("nodeSelectorTerms") or ()
+    )
 
 
 def storageclass_topology_terms(sc: Dict[str, Any]) -> Tuple[k8s.LabelSelector, ...]:
@@ -392,10 +387,39 @@ def pod_from_json(
     csi_volumes: List[tuple] = []
     volume_affinity: List[tuple] = []
     rwop_handles: List[str] = []
+    legacy_volumes: List[k8s.LegacyVolume] = []
     pod_key = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
     for v in spec.get("volumes") or ():
         if "emptyDir" in v or "hostPath" in v:
             local_storage = True
+        # Inline legacy in-tree sources: the VolumeRestrictions filter's
+        # same-volume node-conflict rules read these directly off
+        # pod.spec.volumes (vendored volume_restrictions.go isVolumeConflict)
+        gce = v.get("gcePersistentDisk")
+        if gce and gce.get("pdName"):
+            legacy_volumes.append(k8s.LegacyVolume(
+                kind="gce-pd", key=gce["pdName"],
+                read_only=bool(gce.get("readOnly")),
+            ))
+        ebs = v.get("awsElasticBlockStore")
+        if ebs and ebs.get("volumeID"):
+            legacy_volumes.append(k8s.LegacyVolume(
+                kind="aws-ebs", key=ebs["volumeID"],
+            ))
+        iscsi = v.get("iscsi")
+        if iscsi and iscsi.get("iqn"):
+            legacy_volumes.append(k8s.LegacyVolume(
+                kind="iscsi", key=iscsi["iqn"],
+                read_only=bool(iscsi.get("readOnly")),
+            ))
+        rbd = v.get("rbd")
+        if rbd and rbd.get("image"):
+            legacy_volumes.append(k8s.LegacyVolume(
+                kind="rbd",
+                key=f"{rbd.get('pool', 'rbd')}/{rbd['image']}",
+                read_only=bool(rbd.get("readOnly")),
+                monitors=tuple(rbd.get("monitors") or ()),
+            ))
         csi = v.get("csi")
         if csi and csi.get("driver"):
             # inline ephemeral CSI volume: unique to this pod, so its handle
@@ -482,6 +506,7 @@ def pod_from_json(
         csi_volumes=tuple(csi_volumes),
         volume_node_affinity=tuple(volume_affinity),
         rwop_handles=tuple(rwop_handles),
+        legacy_volumes=tuple(legacy_volumes),
         mirror=MIRROR_ANNOTATION in annotations,
         daemonset=bool(owner and owner.kind == "DaemonSet"),
         restartable=owner is not None,

@@ -252,6 +252,42 @@ class OwnerRef:
     controller: bool = True
 
 
+@dataclass(frozen=True)
+class LegacyVolume:
+    """An inline legacy in-tree volume source subject to the
+    VolumeRestrictions same-volume conflict rules (vendored
+    volumerestrictions/volume_restrictions.go isVolumeConflict):
+
+    - ``gce-pd``:  key = pdName;   conflict unless BOTH mounts read-only
+    - ``aws-ebs``: key = volumeID; conflict ALWAYS (access mode ignored)
+    - ``iscsi``:   key = iqn;      conflict unless both read-only
+    - ``rbd``:     key = pool/image; conflict when the two mounts' Ceph
+      monitor lists OVERLAP and not both read-only (``monitors`` carries
+      the list; disjoint monitor sets are different Ceph clusters and
+      never conflict)
+
+    PVC-backed volumes do not appear here: the filter inspects only inline
+    pod.spec.volumes sources, and PVC-bound in-tree PVs are covered by the
+    bound-PV node-affinity path instead.
+    """
+
+    kind: str                          # gce-pd | aws-ebs | iscsi | rbd
+    key: str
+    read_only: bool = False
+    monitors: Tuple[str, ...] = ()     # rbd only
+
+    def conflicts(self, other: "LegacyVolume") -> bool:
+        if self.kind != other.kind or self.key != other.key:
+            return False
+        if self.kind == "aws-ebs":
+            return True
+        if self.kind == "rbd" and not (
+            set(self.monitors) & set(other.monitors)
+        ):
+            return False
+        return not (self.read_only and other.read_only)
+
+
 @dataclass
 class Pod:
     name: str
@@ -280,6 +316,12 @@ class Pod:
     # VolumeRestrictions filter fails a pod on EVERY node while another live
     # pod uses the same RWOP claim
     rwop_handles: Tuple[str, ...] = ()
+    # Legacy in-tree volume sources (inline GCE PD / AWS EBS / iSCSI / RBD)
+    # subject to the VolumeRestrictions filter's same-volume NODE conflict
+    # rules (vendored volumerestrictions/volume_restrictions.go
+    # isVolumeConflict) — unlike RWOP this blocks only nodes where a
+    # conflicting user is placed, not every node
+    legacy_volumes: Tuple["LegacyVolume", ...] = ()
     mirror: bool = False          # static/mirror pod
     daemonset: bool = False
     restartable: bool = True      # has a controller that will recreate it
@@ -418,11 +460,14 @@ def pod_volumes_match_node(pod: Pod, node: Node) -> bool:
 
 
 def node_matches_selector(pod: Pod, node: Node) -> bool:
-    """nodeSelector + required node affinity (NodeAffinity filter plugin)."""
+    """nodeSelector + required node affinity (NodeAffinity filter plugin).
+    metadata.name matchFields are evaluated against node.name via the
+    sentinel key, matching pod_volumes_match_node."""
     for k, v in pod.node_selector.items():
         if node.labels.get(k) != v:
             return False
     if pod.affinity and pod.affinity.node_selector_terms:
-        if not any(t.matches(node.labels) for t in pod.affinity.node_selector_terms):
+        labels = {**node.labels, NODE_NAME_FIELD_KEY: node.name}
+        if not any(t.matches(labels) for t in pod.affinity.node_selector_terms):
             return False
     return True

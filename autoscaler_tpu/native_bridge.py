@@ -36,6 +36,9 @@ def _ensure_built() -> Optional[ctypes.CDLL]:
                 subprocess.run(
                     [
                         "g++", "-O3", "-march=native", "-shared", "-fPIC",
+                        # IEEE per-op rounding: the FFD score spec must be
+                        # bit-identical to numpy/XLA (no FMA contraction)
+                        "-ffp-contract=off",
                         "-std=c++17", _SRC, "-o", _LIB,
                     ],
                     check=True,

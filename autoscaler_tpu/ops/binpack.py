@@ -39,11 +39,28 @@ class BinpackResult(NamedTuple):
 
 def ffd_scores(pod_req: jax.Array, template_alloc: jax.Array) -> jax.Array:
     """[P] f32 — the reference's pod score (binpacking_estimator.go:164-193):
-    cpu/cpu_cap + mem/mem_cap against the group's template capacity."""
+    cpu/cpu_cap + mem/mem_cap against the group's template capacity,
+    rescaled by the (positive, per-group-constant) product of the caps into
+    the DIVISION-FREE order-equivalent `cpu·mem_cap + mem·cpu_cap`.
+
+    The rescale is not cosmetic: XLA lowers f32 divide on TPU to a
+    reciprocal-multiply approximation that is not correctly rounded, so the
+    literal formula orders ulp-near scores differently on TPU than IEEE
+    division does on the host — at the north-star bench shape that flipped
+    score-sort order in every sampled group and diverged 4 scheduled bits
+    vs the serial C++ baseline (round-4 capture). f32 multiply/add ARE
+    IEEE-rounded on the VPU, so this form is bit-reproducible across TPU,
+    numpy, and C++ (the C++ baseline compiles with -ffp-contract=off so no
+    FMA re-rounds the sum). Every FFD order producer — this function, the
+    numpy oracle (estimator/reference_impl.py), and native/ffd_serial.cpp —
+    computes this same spec; a zero cap drops its term and leaves the other
+    unscaled, preserving the original single-term order."""
     cpu_cap = template_alloc[CPU]
     mem_cap = template_alloc[MEMORY]
-    s_cpu = jnp.where(cpu_cap > 0, pod_req[:, CPU] / cpu_cap, 0.0)
-    s_mem = jnp.where(mem_cap > 0, pod_req[:, MEMORY] / mem_cap, 0.0)
+    c_scale = jnp.where(cpu_cap > 0, cpu_cap, 1.0)
+    m_scale = jnp.where(mem_cap > 0, mem_cap, 1.0)
+    s_cpu = jnp.where(cpu_cap > 0, pod_req[:, CPU] * m_scale, 0.0)
+    s_mem = jnp.where(mem_cap > 0, pod_req[:, MEMORY] * c_scale, 0.0)
     return s_cpu + s_mem
 
 

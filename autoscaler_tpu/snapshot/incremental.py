@@ -51,6 +51,7 @@ from autoscaler_tpu.snapshot.packer import (
     SnapshotMeta,
     _apply_row_rules,
     _class_verdict,
+    _legacy_conflict_nodes,
     _node_profile_key,
     _pod_csi_counts,
     _pod_profile_key,
@@ -67,7 +68,7 @@ class _PodSlot:
     __slots__ = (
         "key", "orig", "eff", "assign", "prof_key", "class_id", "gen",
         "stamp", "has_interpod", "has_anti", "has_hard_spread", "has_portcsi",
-        "has_rwop", "sel_keys", "csi_drivers",
+        "has_rwop", "has_legacy", "sel_keys", "csi_drivers",
     )
 
     def __init__(self, key: str, pod: Pod, assign: str, gen: int):
@@ -96,6 +97,7 @@ class _PodSlot:
         )
         self.has_portcsi = bool(pod.host_ports or pod.csi_volumes)
         self.has_rwop = bool(pod.rwop_handles)
+        self.has_legacy = bool(pod.legacy_volumes)
         keys: Set[str] = set(pod.node_selector.keys())
         if aff:
             for term in aff.node_selector_terms:
@@ -191,8 +193,11 @@ class IncrementalPacker:
         self._spread_rows: Set[int] = set()
         self._anti_rows: Set[int] = set()       # rows with own anti terms
         self._rwop_rows: Set[int] = set()       # rows mounting RWOP claims
+        self._legacy_rows: Set[int] = set()     # rows with legacy in-tree vols
         self._anti_match_rows: Set[int] = set()  # rows matched by placed anti
         self._anti_sig: tuple = ()
+        self._legacy_sig: tuple = ()
+        self._legacy_conf: Dict[int, set] = {}  # row -> blocked node rows
         self._exc_prev: Set[int] = set()
         self._exc_shape_dirty = False  # exc membership moved/died this update
         self._override_prev: Set[Tuple[int, int]] = set()
@@ -468,9 +473,38 @@ class IncrementalPacker:
                         for h in set(pod.rwop_handles)
                     ):
                         rwop_conflicts.add(i)
+        # Legacy same-volume conflict rows (VolumeRestrictions in-tree
+        # rules): recomputed over the (tiny) legacy-volume row set each
+        # update. The blocked set is NODE-level, so a sharer merely MOVING
+        # between nodes changes the veto without changing exc membership —
+        # a placement signature over the legacy users forces the exception
+        # rebuild in that case (same trick as anti_sig above).
+        legacy_conflicts: Set[int] = set()
+        legacy_conf: Dict[int, set] = {}
+        legacy_sig: tuple = ()
+        if len(self._legacy_rows) >= 2:
+            lrows = sorted(self._legacy_rows)
+            conf = _legacy_conflict_nodes(
+                [self._pod_slots[i].orig for i in lrows],
+                [self._pod_node_of(i) for i in lrows],
+            )
+            legacy_conf = {lrows[k]: v for k, v in conf.items()}
+            legacy_conflicts = set(legacy_conf)
+            legacy_sig = tuple(
+                sorted(
+                    (self._pod_slots[i].key, self._pod_slots[i].gen,
+                     self._pod_slots[i].assign)
+                    for i in lrows
+                    if self._pod_node_of(i) >= 0
+                )
+            )
+        if legacy_sig != self._legacy_sig:
+            self._legacy_sig = legacy_sig
+            self._exc_shape_dirty = True
+        self._legacy_conf = legacy_conf
         exc = (
             self._interpod_rows | self._spread_rows | self._anti_match_rows
-            | rwop_conflicts
+            | rwop_conflicts | legacy_conflicts
         )
         exc = {i for i in exc if i < p}
         exc_dirty = (
@@ -518,6 +552,8 @@ class IncrementalPacker:
             self._anti_rows.add(row)
         if slot.has_rwop:
             self._rwop_rows.add(row)
+        if slot.has_legacy:
+            self._legacy_rows.add(row)
         for k in slot.sel_keys:
             self._relkey_count[k] = self._relkey_count.get(k, 0) + 1
         for d in slot.csi_drivers:
@@ -530,6 +566,7 @@ class IncrementalPacker:
         self._anti_rows.discard(row)
         self._anti_match_rows.discard(row)
         self._rwop_rows.discard(row)
+        self._legacy_rows.discard(row)
         for k in slot.sel_keys:
             c = self._relkey_count[k] - 1
             if c:
@@ -635,6 +672,7 @@ class IncrementalPacker:
         for coll in (
             self._portcsi_rows, self._interpod_rows, self._spread_rows,
             self._anti_rows, self._anti_match_rows, self._rwop_rows,
+            self._legacy_rows,
         ):
             if src in coll:
                 coll.discard(src)
@@ -879,6 +917,7 @@ class IncrementalPacker:
                 [s.eff for s in self._pod_slots],
                 self._pod_node[:p],
                 interpod=True,
+                legacy=self._legacy_conf,
             )
             touched = True
         if touched:
@@ -911,6 +950,7 @@ class IncrementalPacker:
                     [s.eff for s in self._pod_slots],
                     self._pod_node[:p],
                     interpod=True,
+                    legacy=self._legacy_conf,
                 )
             padded = np.zeros((EE, self._NN), bool)
             padded[: rows.shape[0], :n] = rows

@@ -435,8 +435,54 @@ def _rwop_conflict_rows(pods: Sequence[Pod], node_of_pod: Sequence[int]) -> set:
     return out
 
 
+def _legacy_conflict_nodes(
+    pods: Sequence[Pod],
+    node_of_pod: Sequence[int],
+) -> Dict[int, set]:
+    """Per-row blocked-node sets from the VolumeRestrictions same-volume
+    rules (vendored volumerestrictions/volume_restrictions.go
+    isVolumeConflict): pod i cannot go on node j when a live pod PLACED on j
+    mounts a conflicting legacy in-tree volume (GCE PD / AWS EBS / iSCSI /
+    RBD — pairwise semantics in LegacyVolume.conflicts). A pod's own usage
+    never blocks its own row (it may move in the refit); terminating pods
+    neither block nor are blocked (same liveness convention as the RWOP
+    rule above). Returns {row: {blocked node index, ...}} for rows with at
+    least one blocked node — empty for clusters without legacy in-tree
+    volumes, which is the common case and costs one list scan."""
+    users: List[Tuple[int, Pod]] = [
+        (i, p)
+        for i, p in enumerate(pods)
+        if p.legacy_volumes and p.deletion_ts is None
+    ]
+    if len(users) < 2:
+        return {}
+    # bucket placed usages by (kind, key) so each pending volume only meets
+    # same-volume candidates, not every placed legacy mount
+    placed: Dict[Tuple[str, str], List[Tuple[int, int, k8s.LegacyVolume]]] = {}
+    for i, p in users:
+        j = node_of_pod[i]
+        if j >= 0:
+            for v in p.legacy_volumes:
+                placed.setdefault((v.kind, v.key), []).append((i, j, v))
+    if not placed:
+        return {}
+    out: Dict[int, set] = {}
+    for i, p in users:
+        blocked = set()
+        for v in p.legacy_volumes:
+            for qi, j, qv in placed.get((v.kind, v.key), ()):
+                if qi != i and v.conflicts(qv):
+                    blocked.add(j)
+        if blocked:
+            out[i] = blocked
+    return out
+
+
 def _exception_pods(
-    pods: Sequence[Pod], node_of_pod: Sequence[int], interpod: bool
+    pods: Sequence[Pod],
+    node_of_pod: Sequence[int],
+    interpod: bool,
+    legacy: Optional[Dict[int, set]] = None,
 ) -> List[int]:
     """Pod indices whose mask rows the affinity rules below may modify: pods
     with inter-pod (anti-)affinity and pods matching a placed pod's
@@ -446,6 +492,11 @@ def _exception_pods(
     a host-port DaemonSet on every node costs O(N) cells, not O(N) dense
     rows."""
     exc: set = _rwop_conflict_rows(pods, node_of_pod)
+    # legacy same-volume conflicts block node SUBSETS, so the row must be an
+    # exception row (class verdicts cannot carry a per-node veto)
+    exc |= set(
+        _legacy_conflict_nodes(pods, node_of_pod) if legacy is None else legacy
+    )
     placed_anti: List[Tuple[int, Pod, k8s.PodAffinityTerm]] = []
     for i, pod in enumerate(pods):
         if interpod and pod.affinity and (
@@ -481,6 +532,7 @@ def _apply_row_rules(
     pods: Sequence[Pod],
     node_of_pod: Sequence[int],
     interpod: bool,
+    legacy: Optional[Dict[int, set]] = None,
 ) -> None:
     """Apply the inter-pod (anti-)affinity rules vs placed pods to the rows
     exposed by `view`, in place. Rows not present in the view are skipped —
@@ -498,6 +550,20 @@ def _apply_row_rules(
     for i in _rwop_conflict_rows(pods, node_of_pod):
         if view.has(i):
             view[i] = np.zeros(N, bool)
+
+    # VolumeRestrictions (legacy in-tree same-volume rules): pod i is vetoed
+    # on exactly the nodes where a conflicting GCE PD / AWS EBS / iSCSI /
+    # RBD user is placed (vendored volume_restrictions.go isVolumeConflict).
+    # Callers that already ran _legacy_conflict_nodes (to pick exception
+    # rows) pass the dict through rather than recomputing it.
+    if legacy is None:
+        legacy = _legacy_conflict_nodes(pods, node_of_pod)
+    for i, blocked in legacy.items():
+        if view.has(i):
+            row = view[i]  # numpy basic slice — writes land in the mask
+            for j in blocked:
+                if j < N:
+                    row[j] = False
 
     domain_cache: Dict[str, Tuple[np.ndarray, Dict[str, int]]] = {}
 
@@ -711,7 +777,10 @@ def compute_sched_mask(
         nodes, pods, node_of_pod, port_count, csi_attached
     ):
         mask[i, j] = value
-    _apply_row_rules(_RowView(mask), nodes, pods, node_of_pod, interpod)
+    _apply_row_rules(
+        _RowView(mask), nodes, pods, node_of_pod, interpod,
+        legacy=_legacy_conflict_nodes(pods, node_of_pod),
+    )
     return mask
 
 
@@ -751,7 +820,8 @@ def compute_factored_mask(
     overrides = _self_cell_overrides(
         nodes, pods, node_of_pod, port_count, csi_attached
     )
-    exc = _exception_pods(pods, node_of_pod, interpod)
+    legacy = _legacy_conflict_nodes(pods, node_of_pod)
+    exc = _exception_pods(pods, node_of_pod, interpod, legacy=legacy)
     E = len(exc)
     exc_rows = np.zeros((max(E, 1), N), bool)
     row_of = {i: e for e, i in enumerate(exc)}
@@ -766,7 +836,8 @@ def compute_factored_mask(
         else:
             coo.append((i, j, value))
     _apply_row_rules(
-        _RowView(exc_rows, row_of), nodes, pods, node_of_pod, interpod
+        _RowView(exc_rows, row_of), nodes, pods, node_of_pod, interpod,
+        legacy=legacy,
     )
     pod_exc = np.full(P, -1, np.int32)
     for i, e in row_of.items():

@@ -39,7 +39,7 @@ from autoscaler_tpu.vpa.feeder import HistorySource
 
 log = logging.getLogger("vpa.prometheus")
 
-_DURATION_RE = re.compile(r"^(\d+)(ms|s|m|h|d|w|y)$")
+_DURATION_RE = re.compile(r"(\d+)(ms|s|m|h|d|w|y)")
 _DURATION_S = {
     "ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0,
     "d": 86400.0, "w": 7 * 86400.0, "y": 365 * 86400.0,
@@ -47,12 +47,26 @@ _DURATION_S = {
 
 
 def parse_duration_s(s: str) -> float:
-    """Prometheus duration string → seconds (subset: one unit, as the
-    reference's config values use; prommodel.ParseDuration grammar)."""
-    m = _DURATION_RE.match(s.strip())
-    if not m:
+    """Prometheus duration string → seconds, incl. compound forms like
+    ``1h30m`` / ``1d12h`` (prommodel.ParseDuration grammar: units in
+    strictly descending order, each at most once)."""
+    text = s.strip()
+    pos, total = 0, 0.0
+    last_rank = -1
+    ranks = {u: r for r, u in enumerate(("y", "w", "d", "h", "m", "s", "ms"))}
+    while pos < len(text):
+        m = _DURATION_RE.match(text, pos)
+        if not m:
+            raise ValueError(f"{s!r} is not a valid Prometheus duration")
+        rank = ranks[m.group(2)]
+        if rank <= last_rank:  # repeated or out-of-order unit
+            raise ValueError(f"{s!r} is not a valid Prometheus duration")
+        last_rank = rank
+        total += int(m.group(1)) * _DURATION_S[m.group(2)]
+        pos = m.end()
+    if pos == 0:
         raise ValueError(f"{s!r} is not a valid Prometheus duration")
-    return int(m.group(1)) * _DURATION_S[m.group(2)]
+    return total
 
 
 @dataclass
@@ -137,11 +151,14 @@ class PrometheusHistorySource(HistorySource):
     def _query_range(self, query: str) -> list:
         end = time.time()
         start = end - parse_duration_s(self.config.history_length)
+        # step as plain float seconds: Prometheus accepts that form for any
+        # resolution, while a composed duration string like "0.5s" is
+        # rejected (decimal durations are invalid duration syntax)
         step = parse_duration_s(self.config.history_resolution)
         return self._api(
             "/api/v1/query_range",
             {"query": query, "start": f"{start:.3f}", "end": f"{end:.3f}",
-             "step": f"{step:g}s"},
+             "step": f"{step:g}"},
         )
 
     def _query_instant(self, query: str) -> list:

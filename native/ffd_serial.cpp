@@ -30,11 +30,17 @@ int32_t ffd_binpack_serial(const float* pod_req, const uint8_t* pod_mask,
   const float cpu_cap = template_alloc[cpu_axis];
   const float mem_cap = template_alloc[mem_axis];
 
+  // Division-free order-equivalent of cpu/cpu_cap + mem/mem_cap (see
+  // ops/binpack.ffd_scores: TPU f32 divide is not correctly rounded, so
+  // every FFD order producer computes this same mul/add spec; the build
+  // pins -ffp-contract=off so no FMA re-rounds the sum).
+  const float c_scale = cpu_cap > 0 ? cpu_cap : 1.0f;
+  const float m_scale = mem_cap > 0 ? mem_cap : 1.0f;
   std::vector<float> score(P, 0.0f);
   for (int32_t i = 0; i < P; ++i) {
     const float* req = pod_req + (size_t)i * R;
-    if (cpu_cap > 0) score[i] += req[cpu_axis] / cpu_cap;
-    if (mem_cap > 0) score[i] += req[mem_axis] / mem_cap;
+    if (cpu_cap > 0) score[i] += req[cpu_axis] * m_scale;
+    if (mem_cap > 0) score[i] += req[mem_axis] * c_scale;
   }
   std::vector<int32_t> order(P);
   std::iota(order.begin(), order.end(), 0);
@@ -103,11 +109,17 @@ int32_t ffd_binpack_serial_affinity(
   const float cpu_cap = template_alloc[cpu_axis];
   const float mem_cap = template_alloc[mem_axis];
 
+  // Division-free order-equivalent of cpu/cpu_cap + mem/mem_cap (see
+  // ops/binpack.ffd_scores: TPU f32 divide is not correctly rounded, so
+  // every FFD order producer computes this same mul/add spec; the build
+  // pins -ffp-contract=off so no FMA re-rounds the sum).
+  const float c_scale = cpu_cap > 0 ? cpu_cap : 1.0f;
+  const float m_scale = mem_cap > 0 ? mem_cap : 1.0f;
   std::vector<float> score(P, 0.0f);
   for (int32_t i = 0; i < P; ++i) {
     const float* req = pod_req + (size_t)i * R;
-    if (cpu_cap > 0) score[i] += req[cpu_axis] / cpu_cap;
-    if (mem_cap > 0) score[i] += req[mem_axis] / mem_cap;
+    if (cpu_cap > 0) score[i] += req[cpu_axis] * m_scale;
+    if (mem_cap > 0) score[i] += req[mem_axis] * c_scale;
   }
   std::vector<int32_t> order(P);
   std::iota(order.begin(), order.end(), 0);

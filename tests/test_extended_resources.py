@@ -247,6 +247,73 @@ class TestDaemonSetAffinityTargeting:
         assert ds.suitable_for(node)
         assert not ds.suitable_for(build_test_node("m", cpu_m=4000))
 
+    def test_match_fields_pin_to_named_node(self):
+        """matchFields metadata.name must pin, not widen: a matchFields-only
+        term used to parse into an empty LabelSelector that matched EVERY
+        node, charging the DS into every template's overhead."""
+        ds = daemonset_from_json({
+            "metadata": {"name": "pinned", "namespace": "kube-system"},
+            "spec": {"template": {"spec": {
+                "affinity": {"nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [
+                            {"matchFields": [
+                                {"key": "metadata.name", "operator": "In",
+                                 "values": ["node-x"]},
+                            ]},
+                        ],
+                    },
+                }},
+                "containers": [
+                    {"resources": {"requests": {"cpu": "300m"}}},
+                ],
+            }}},
+        })
+        assert ds.suitable_for(build_test_node("node-x", cpu_m=4000))
+        assert not ds.suitable_for(build_test_node("node-y", cpu_m=4000))
+
+    def test_empty_term_matches_no_nodes(self):
+        """An empty nodeSelectorTerm matches NO objects in Kubernetes."""
+        ds = daemonset_from_json({
+            "metadata": {"name": "broken", "namespace": "kube-system"},
+            "spec": {"template": {"spec": {
+                "affinity": {"nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [{}],
+                    },
+                }},
+                "containers": [
+                    {"resources": {"requests": {"cpu": "300m"}}},
+                ],
+            }}},
+        })
+        assert not ds.suitable_for(build_test_node("any", cpu_m=4000))
+
+    def test_pod_node_affinity_match_fields(self):
+        """The same matchFields handling flows through pod parsing into
+        node_matches_selector (the packer's class predicate)."""
+        from autoscaler_tpu.kube.convert import pod_from_json
+        from autoscaler_tpu.kube.objects import node_matches_selector
+
+        pod = pod_from_json({
+            "metadata": {"name": "p", "namespace": "default"},
+            "spec": {
+                "containers": [],
+                "affinity": {"nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [
+                            {"matchFields": [
+                                {"key": "metadata.name", "operator": "In",
+                                 "values": ["node-x"]},
+                            ]},
+                        ],
+                    },
+                }},
+            },
+        })
+        assert node_matches_selector(pod, build_test_node("node-x", cpu_m=4000))
+        assert not node_matches_selector(pod, build_test_node("node-y", cpu_m=4000))
+
     def test_force_ds_charges_only_affinity_matched_templates(self):
         """--force-ds through the template provider: a DS affinity-targeting
         pool=gpu charges the gpu group's template and not the cpu group's

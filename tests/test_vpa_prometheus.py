@@ -36,11 +36,18 @@ class TestDurations:
         ("30s", 30.0), ("5m", 300.0), ("1h", 3600.0),
         ("8d", 8 * 86400.0), ("2w", 14 * 86400.0), ("1y", 365 * 86400.0),
         ("250ms", 0.25),
+        # compound durations (prommodel.ParseDuration: descending units,
+        # each at most once) — operators migrating reference configs use
+        # forms like 1d12h for --history-length
+        ("1h30m", 5400.0), ("1d12h", 36 * 3600.0),
+        ("2m30s", 150.0), ("1s500ms", 1.5),
     ])
     def test_prometheus_duration_grammar(self, s, expect):
         assert parse_duration_s(s) == expect
 
-    @pytest.mark.parametrize("bad", ["", "8", "d8", "1.5h", "8dd"])
+    @pytest.mark.parametrize(
+        "bad", ["", "8", "d8", "1.5h", "8dd", "1m1m", "30m1h", "1h 30m"]
+    )
     def test_invalid_rejected(self, bad):
         with pytest.raises(ValueError):
             parse_duration_s(bad)
@@ -188,9 +195,12 @@ class TestRecordedServer:
         paths = [p for p, _ in _RecordedProm.requests]
         assert paths == ["/api/v1/query_range", "/api/v1/query_range",
                          "/api/v1/query"]
-        # range params: an 8d window at 1h step
+        # range params: an 8d window at 1h step — sent as plain float
+        # seconds, the one form Prometheus accepts for ANY resolution
+        # (a composed "0.5s" duration string would be rejected for
+        # sub-second steps like --history-resolution=500ms)
         _, params = _RecordedProm.requests[0]
-        assert params["step"] == "3600s"
+        assert params["step"] == "3600"
         assert float(params["end"]) - float(params["start"]) == pytest.approx(
             8 * 86400.0, abs=5.0
         )
