@@ -35,3 +35,27 @@ def unpack_bool_bits(packed: np.ndarray, length: int) -> np.ndarray:
     """Host-side inverse: [..., B] uint8 → [..., length] bool."""
     flat = np.unpackbits(np.ascontiguousarray(packed), axis=-1)
     return flat[..., :length].astype(bool)
+
+
+@jax.jit
+def pack_result_blob(node_count: jax.Array, scheduled: jax.Array) -> jax.Array:
+    """Fuse an estimator result (counts [G] i32 + scheduled [G, P] bool) into
+    ONE flat uint8 buffer: [G*4 little-endian count bytes][G*ceil(P/8)
+    packed bits]. One buffer = one host fetch = one tunnel round-trip — a
+    separate counts fetch costs a full RTT (~50-150ms over a remoted
+    backend), comparable to shipping the whole bit plane."""
+    cnt_bytes = jax.lax.bitcast_convert_type(
+        node_count.astype(jnp.int32), jnp.uint8
+    )                                                    # [G, 4] LE on TPU
+    packed = pack_bool_bits(scheduled)                   # [G, B] u8
+    return jnp.concatenate([cnt_bytes.ravel(), packed.ravel()])
+
+
+def unpack_result_blob(buf: np.ndarray, G: int, P: int):
+    """Host-side inverse of pack_result_blob → (counts [G] i32 int array,
+    scheduled [G, P] bool)."""
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    counts = buf[: 4 * G].view("<i4").copy()
+    B = (P + 7) // 8
+    bits = unpack_bool_bits(buf[4 * G : 4 * G + G * B].reshape(G, B), P)
+    return counts, bits

@@ -5,32 +5,46 @@ The XLA scan in ops/binpack.ffd_binpack_groups is HBM-bound: every pod step
 reads and rewrites its usage carry (~12MB at G=500, M=1000), which costs
 ~50-80µs/step on a v5e. Here the carry lives in VMEM for the WHOLE scan: the
 grid is (group-blocks, pod-chunks) with the chunk axis 'arbitrary' (serial),
-so each group-block's [R, GB, M] FREE-capacity carry stays resident in VMEM
+so each group-block's [R, M, GB] FREE-capacity carry stays resident in VMEM
 across all pod chunks and a step is pure VPU work (one compare pass + one-hot
 update per resource plane).
 
-Round-4 restructure (measured decomposition, benchmarks/pallas_profile.py +
-captures/pallas_profile_tpu_r4.json): the round-3 version spent only ~0.66s
-of its 2.7-2.9s inside the kernel (1.6µs/step) — the rest was XLA glue with
-pathological gather/scatter lowerings on TPU: argsort + take_along_axis
-(0.64s), per-chunk pod_req[idx] gathers inside a host-side lax.scan (0.16s +
-dispatch), and the final scheduled-bits scatter (0.45s). All three are gone:
+Round-4 restructure, driven by the measured decomposition
+(benchmarks/pallas_profile.py + captures/pallas_profile_tpu_r4.json): the
+round-3 version spent only ~0.66s of its 2.7-2.9s inside the kernel
+(1.6µs/step) — the rest was XLA glue with pathological gather/scatter
+lowerings on TPU: argsort + take_along_axis (0.64s), per-chunk pod_req[idx]
+gathers inside a host-side lax.scan (0.16s + dispatch), and the final
+scheduled-bits scatter (0.45s). All three are gone, and the step itself
+halved. 2026-07-31 e2e at the north-star shape: 2.68s → 1.02s incl. the
+tunnel fetch.
 
   * ONE stable `lax.sort` carries the per-resource request columns and an
-    original-index payload along the score sort (0.23s at 100k x 512 — 3x
+    original-index payload along the score sort (~0.2s at 100k x 512 — 3x
     cheaper than argsort + gathers, because TPU sorts are vectorized while
     row gathers are not).
   * The pod-chunk loop moved INTO the pallas grid: no per-chunk dispatch, no
     per-chunk carry HBM round-trip, no gathers — chunks slice a pre-sorted
     [R, P, G] stream via BlockSpec index maps.
   * The scheduled un-sort is a second `lax.sort` keyed on the sorted
-    original-index payload (0.15s vs 0.45s for the scatter formulation).
+    original-index payload, with the placement bits as a uint8 payload
+    (sort cost tracks operand bytes; vs 0.45s for the scatter formulation).
+  * NODES-ON-SUBLANES carry ([R, M, GB]): every per-step vector (request
+    row, caps, opened, first-fit result) is a GB lane vector, so the
+    request broadcast is a free sublane-direction broadcast and the
+    first-fit min is a sublane reduction. The prior [R, GB, M] layout
+    relayouted the request row lane→sublane on EVERY step — measured as
+    half the step cost (const_req 0.685µs vs full 1.469µs/step in the
+    profile capture). Kernel total at the north-star shape: 0.74s → 0.40s.
+  * The resource-axis compression peek and the result fetch are each ONE
+    host round-trip (a per-axis .any() probe and a separate counts fetch
+    cost ~50-150ms of tunnel RTT apiece — ops/bits.pack_result_blob fuses
+    counts + bit-packed scheduled into a single buffer).
 
-Layout notes (Mosaic constraints): the carry is resource-major ([R, GB, M])
-so each per-resource plane is a contiguous tile-aligned [GB sublanes x M
-lanes] block; the request stream puts the step axis on the sublane
-dimension ([R, CHUNK, GB]) and the kernel walks it in 8-step tiles with an
-unrolled inner loop, so every dynamic offset is provably 8-aligned.
+Layout notes (Mosaic constraints): the request stream puts the step axis on
+the sublane dimension ([R, CHUNK, GB]) and the kernel walks it in 8-step
+tiles with an unrolled inner loop, so every dynamic offset is provably
+8-aligned.
 Inactive pods (mask-failed / pad) travel as +inf request rows — the mask is
 folded into the columns BEFORE the sort (sorting permutes (key, payload)
 tuples elementwise, so where(mask, col, inf) commutes with the sort) and no
@@ -63,9 +77,9 @@ _STEP_TILE = 8  # sublane tile: dynamic offsets must be provably 8-aligned
 
 def _scan_kernel(
     req_ref,      # [R, CHUNK, GB] f32 — sorted pod requests, +inf = inactive
-    caps_ref,     # [GB, 1] i32 (sublane-resident, matching `first`'s layout)
+    caps_ref,     # [1, GB] i32 (lane-resident, matching `first`'s layout)
     allocs_ref,   # [R, GB] f32 — template allocs (carry init at chunk 0)
-    free_ref,     # [R, GB, M] f32 out — VMEM-resident across the chunk axis
+    free_ref,     # [R, M, GB] f32 out — VMEM-resident across the chunk axis
     opened_ref,   # [1, GB] i32 out — resident likewise
     placed_ref,   # [CHUNK, GB] i32 out — flushed per chunk
     *,
@@ -73,18 +87,27 @@ def _scan_kernel(
     chunk: int,
     max_nodes: int,
 ):
-    # Layout: the capacity carry is resource-MAJOR ([R, GB, M]) so each
-    # per-resource slice free_ref[r] is a contiguous, tile-aligned [GB, M]
-    # block (GB sublanes × M lanes). The earlier [GB, R, M] layout put R on
-    # the sublane axis, turning every read/update in the hot loop into a
-    # strided single-sublane RMW across all GB tiles (~8× waste) — measured
-    # 16.5s vs the XLA scan's 10.0s at the north-star shape on a real v5e.
+    # Layout: the capacity carry is NODES-ON-SUBLANES ([R, M, GB]: each
+    # per-resource plane free_ref[r] is an [M sublanes × GB lanes] block)
+    # and every per-step vector — requests, caps, opened, first — is a GB
+    # LANE vector. That alignment is the round-4 step-cost fix: the prior
+    # [R, GB, M] layout extracted req[r] as a lane vector but compared it
+    # against a GB-sublane carry, forcing a cross-lane→sublane relayout of
+    # every request row on every step; the measured decomposition
+    # (captures/pallas_profile_tpu_r4.json: const_req 0.685µs vs full
+    # 1.469µs/step) showed that relayout was HALF the step. Here the
+    # request row broadcasts along sublanes (free in hardware), the
+    # first-fit min is a sublane-axis reduction (rotate tree, ~130 tile
+    # ops vs 512 tile compares — not dominant), and no relayout exists at
+    # all. (The round-3 [GB, R, M] layout was worse still: R on sublanes
+    # made every access a strided single-sublane RMW — 16.5s e2e.)
     # The carry holds FREE capacity (alloc - used), not usage: the fit
-    # compare then reads it directly, saving R [GB, M] subtracts per step.
-    gb = free_ref.shape[1]
+    # compare then reads it directly, saving R [M, GB] subtracts per step.
+    gb = free_ref.shape[2]
     R = num_resources
-    node_iota = jax.lax.broadcasted_iota(jnp.int32, (gb, max_nodes), 1)
-    caps = caps_ref[:, 0]                               # [GB] sublane vector
+    M = free_ref.shape[1]
+    node_iota = jax.lax.broadcasted_iota(jnp.int32, (M, gb), 0)
+    caps = caps_ref[0, :]                               # [GB] lane vector
 
     # The carry blocks' index maps ignore the chunk grid axis, so Mosaic
     # keeps them VMEM-resident across chunks and writes back once per group
@@ -94,7 +117,7 @@ def _scan_kernel(
     def _init():
         for r in range(R):
             free_ref[r, :, :] = jnp.broadcast_to(
-                allocs_ref[r, :][:, None], (gb, max_nodes)
+                allocs_ref[r, :][None, :], (M, gb)
             )
         opened_ref[:] = jnp.zeros((1, gb), jnp.int32)
 
@@ -107,7 +130,7 @@ def _scan_kernel(
 
         for s in range(_STEP_TILE):
             opened = opened_ref[0, :]                   # [GB]
-            req = [req_tiles[r][s, :] for r in range(R)]  # R × [GB]
+            req = [req_tiles[r][s, :] for r in range(R)]  # R × [GB] lane vecs
             # inactive pods (mask-failed or pad slots) carry +inf requests:
             # they fit nowhere and so place nothing — no separate active
             # stream or gate needed.
@@ -120,25 +143,26 @@ def _scan_kernel(
             # and first >= caps (capped group, or template too small: the
             # min landed past the cap or nowhere) means no placement. This
             # folds the open-mask compare, the fits_empty chain and the
-            # can_open arithmetic into the one masked-min.
+            # can_open arithmetic into the one masked-min. Padded node rows
+            # (M rounded up to the sublane tile) are permanently-closed
+            # nodes ABOVE every real index: the min always prefers a real
+            # row, and caps <= max_nodes gates placement past the cap.
 
-            fits = req[0][:, None] <= free_ref[0]       # [GB, M]
+            fits = req[0][None, :] <= free_ref[0]       # [M, GB]
             for r in range(1, R):
-                fits &= req[r][:, None] <= free_ref[r]
+                fits &= req[r][None, :] <= free_ref[r]
 
             first = jnp.min(
-                jnp.where(fits, node_iota, BIG_I32), axis=1
+                jnp.where(fits, node_iota, BIG_I32), axis=0
             )                                           # [GB]
             place = first < caps
             target = jnp.where(place, first, -1)        # -1: no hit row
 
-            # i1 [GB] -> [GB,1] reshapes are unsupported on TPU; broadcast
-            # the placement gate through f32 [GB, 1] columns instead. The
-            # select (not a multiply by place) matters: inf * 0.0 = NaN
+            # The select (not a multiply by place) matters: inf * 0.0 = NaN
             # would poison the carry via the hit row.
-            hit = node_iota == target[:, None]                      # [GB, M]
+            hit = node_iota == target[None, :]                      # [M, GB]
             for r in range(R):
-                sub = jnp.where(place, req[r], 0.0)[:, None]        # [GB, 1]
+                sub = jnp.where(place, req[r], 0.0)[None, :]        # [1, GB]
                 free_ref[r, :, :] = free_ref[r] - jnp.where(hit, sub, 0.0)
             opened_ref[0, :] = jnp.maximum(
                 opened, jnp.where(place, first + 1, 0)
@@ -151,18 +175,151 @@ def _scan_kernel(
     jax.lax.fori_loop(0, chunk // _STEP_TILE, tile_step, 0)
 
 
+def _swar_plan(max_vals):
+    """Greedy field-packing plan for the SWAR fast path: each resource axis
+    becomes a (plane, shift, width) field, packed first-fit-decreasing into
+    as few i32 planes as possible (<=31 bits per plane — the sign bit stays
+    clear). width = bit_length(max_val) + 1: real values use width-1 bits,
+    the top bit of each field is the GUARD bit for the borrow-free fit
+    check, and the masked-pod sentinel sets the field to exactly
+    2^(width-1) — one above any real value, so req_field <= 2^(width-1)
+    always holds and a subtraction can never borrow across fields. Returns
+    None when packing wins nothing (every axis needs its own plane)."""
+    R = len(max_vals)
+    widths = [max(int(v).bit_length(), 1) + 1 for v in max_vals]
+    order = sorted(range(R), key=lambda r: -widths[r])
+    planes = []   # list of [used_bits, [(r, shift, width), ...]]
+    for r in order:
+        w = widths[r]
+        if w > 31:
+            return None
+        for pl_ in planes:
+            if pl_[0] + w <= 31:
+                pl_[1].append((r, pl_[0], w))
+                pl_[0] += w
+                break
+        else:
+            planes.append([w, [(r, 0, w)]])
+    if len(planes) >= R:
+        return None
+    return [fields for _, fields in planes]
+
+
+def _swar_masks(plan):
+    """(guards, sentinels) per plane: guard = OR of each field's top bit;
+    sentinel = OR of each field set to 2^(width-1) (same bits — the guard
+    bit IS the sentinel value), kept separate for readability."""
+    guards = tuple(
+        sum(1 << (shift + width - 1) for _, shift, width in fields)
+        for fields in plan
+    )
+    return guards, guards
+
+
+def _swar_pack_cols(values, plan):
+    """[N, R] f32 integer-valued -> list of [N] i32 packed planes."""
+    vi = values.astype(jnp.int32)
+    return [
+        functools.reduce(
+            lambda a, b: a + b,
+            [vi[:, r] << shift for r, shift, _ in fields],
+        )
+        for fields in plan
+    ]
+
+
+def _swar_unpack_free(free_planes, plan, num_resources):
+    """[NP, M, G] i32 packed free -> [R, M, G] f32 per-resource free."""
+    outs = [None] * num_resources
+    for p, fields in enumerate(plan):
+        for r, shift, width in fields:
+            outs[r] = (
+                (free_planes[p] >> shift) & ((1 << (width - 1)) - 1)
+            ).astype(jnp.float32)
+    return jnp.stack(outs)
+
+
+def _scan_kernel_swar(
+    req_ref,      # [NP, CHUNK, GB] i32 — packed sorted requests
+    caps_ref,     # [1, GB] i32
+    allocs_ref,   # [NP, GB] i32 — packed template allocs
+    free_ref,     # [NP, M, GB] i32 out — carry, VMEM-resident
+    opened_ref,   # [1, GB] i32 out
+    placed_ref,   # [CHUNK, GB] i32 out
+    *,
+    guards: tuple,
+    chunk: int,
+    max_nodes: int,
+):
+    """SWAR twin of _scan_kernel: the R f32 capacity planes collapse into
+    NP <= ceil(31/width) i32 planes; one fit check per plane is the classic
+    guard-bit trick — z = (free | guard) - req borrows OUT of exactly the
+    fields where free < req, clearing their guard bits, and the field
+    layout (req_field <= 2^(width-1), free guard bits clear) makes a
+    cross-field borrow impossible. Same placement logic otherwise; plane
+    traffic dominates the step (profile capture: const_req ~= swar), so
+    halving the planes halves the step."""
+    gb = free_ref.shape[2]
+    NP = len(guards)
+    M = free_ref.shape[1]
+    node_iota = jax.lax.broadcasted_iota(jnp.int32, (M, gb), 0)
+    caps = caps_ref[0, :]
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        for p in range(NP):
+            free_ref[p, :, :] = jnp.broadcast_to(
+                allocs_ref[p, :][None, :], (M, gb)
+            )
+        opened_ref[:] = jnp.zeros((1, gb), jnp.int32)
+
+    def tile_step(t, _):
+        base = t * _STEP_TILE
+        req_tiles = [
+            req_ref[p, pl.ds(base, _STEP_TILE), :] for p in range(NP)
+        ]
+        placed_rows = []
+        for s in range(_STEP_TILE):
+            opened = opened_ref[0, :]
+            req = [req_tiles[p][s, :] for p in range(NP)]
+            fits = None
+            for p in range(NP):
+                g = guards[p]
+                z = (free_ref[p] | g) - req[p][None, :]
+                ok = (z & g) == g
+                fits = ok if fits is None else (fits & ok)
+            first = jnp.min(
+                jnp.where(fits, node_iota, BIG_I32), axis=0
+            )
+            place = first < caps
+            target = jnp.where(place, first, -1)
+            hit = node_iota == target[None, :]
+            for p in range(NP):
+                sub = jnp.where(place, req[p], 0)[None, :]
+                free_ref[p, :, :] = free_ref[p] - jnp.where(hit, sub, 0)
+            opened_ref[0, :] = jnp.maximum(
+                opened, jnp.where(place, first + 1, 0)
+            )
+            placed_rows.append(place.astype(jnp.int32))
+        placed_ref[pl.ds(base, _STEP_TILE), :] = jnp.stack(placed_rows, axis=0)
+        return 0
+
+    jax.lax.fori_loop(0, chunk // _STEP_TILE, tile_step, 0)
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("max_nodes", "chunk", "group_block", "interpret"),
+    static_argnames=("max_nodes", "chunk", "group_block", "interpret", "guards"),
 )
 def _pallas_scan_all(
-    stream,           # [R, P_pad, G_pad] f32 — score-sorted requests, +inf inactive
-    allocs_in,        # [R, G_pad] f32
-    caps_col,         # [G_pad, 1] i32
+    stream,           # [R, P_pad, G_pad] f32 (or [NP,...] i32 when guards set)
+    allocs_in,        # [R, G_pad] f32 (i32 packed when guards set)
+    caps_col,         # [1, G_pad] i32
     max_nodes: int,
     chunk: int,
     group_block: int,
     interpret: bool,
+    guards: tuple | None = None,
 ):
     """One pallas_call covering the whole scan: grid (group-blocks, chunks),
     chunk axis 'arbitrary' (serial) with the free/opened carry blocks
@@ -173,24 +330,35 @@ def _pallas_scan_all(
     kernel itself — see the module docstring.)"""
     R, P_pad, G_pad = stream.shape
     NC = P_pad // chunk
-    kernel = functools.partial(
-        _scan_kernel, num_resources=R, chunk=chunk, max_nodes=max_nodes
-    )
+    # nodes live on the SUBLANE axis of the carry — round up to the tile;
+    # padded rows behave as permanently-closed nodes past every real index
+    # (see the kernel comment) and are sliced away by the caller
+    M_pad = max_nodes + (-max_nodes) % _STEP_TILE
+    if guards is not None:
+        kernel = functools.partial(
+            _scan_kernel_swar, guards=guards, chunk=chunk, max_nodes=max_nodes
+        )
+        carry_dtype = jnp.int32
+    else:
+        kernel = functools.partial(
+            _scan_kernel, num_resources=R, chunk=chunk, max_nodes=max_nodes
+        )
+        carry_dtype = jnp.float32
     return pl.pallas_call(
         kernel,
         grid=(G_pad // group_block, NC),
         in_specs=[
             pl.BlockSpec((R, chunk, group_block), lambda g, c: (0, c, g)),
-            pl.BlockSpec((group_block, 1), lambda g, c: (g, 0)),
+            pl.BlockSpec((1, group_block), lambda g, c: (0, g)),
             pl.BlockSpec((R, group_block), lambda g, c: (0, g)),
         ],
         out_specs=[
-            pl.BlockSpec((R, group_block, max_nodes), lambda g, c: (0, g, 0)),
+            pl.BlockSpec((R, M_pad, group_block), lambda g, c: (0, 0, g)),
             pl.BlockSpec((1, group_block), lambda g, c: (0, g)),
             pl.BlockSpec((chunk, group_block), lambda g, c: (c, g)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((R, G_pad, max_nodes), jnp.float32),
+            jax.ShapeDtypeStruct((R, M_pad, G_pad), carry_dtype),
             jax.ShapeDtypeStruct((1, G_pad), jnp.int32),
             jax.ShapeDtypeStruct((P_pad, G_pad), jnp.int32),
         ],
@@ -255,10 +423,28 @@ def ffd_binpack_groups_pallas(
     # Under shard_map/jit the inputs are tracers — the host-side value peek
     # is impossible, so keep every axis (the sharded caller pays ~R/R_k more
     # VPU work; the single-chip dispatch path always has concrete inputs).
+    swar_plan = None
     if isinstance(pod_req, jax.core.Tracer):
         keep = list(range(R_full))
     else:
-        keep = [r for r in range(R_full) if bool((pod_req[:, r] > 0).any())] or [0]
+        # ONE fused reduce + host fetch (a per-axis bool((col > 0).any())
+        # costs a full tunnel round-trip each, ~50ms × R ≈ 0.3s measured —
+        # round-4 decomposition): axis usage for the exact compression,
+        # per-axis maxima and integrality for the SWAR packing decision
+        axis_used, req_max, alloc_max, ints_ok = jax.device_get((
+            (pod_req > 0).any(axis=0),
+            jnp.max(pod_req, axis=0, initial=0.0),
+            jnp.max(template_allocs, axis=0, initial=0.0),
+            (pod_req >= 0).all()
+            & (pod_req == jnp.floor(pod_req)).all()
+            & (template_allocs == jnp.floor(template_allocs)).all(),
+        ))
+        axis_used = np.asarray(axis_used)
+        keep = [r for r in range(R_full) if axis_used[r]] or [0]
+        if bool(ints_ok):
+            swar_plan = _swar_plan(
+                [max(float(req_max[r]), float(alloc_max[r])) for r in keep]
+            )
     compressed = len(keep) < R_full
     if compressed:
         pod_req = pod_req[:, jnp.asarray(keep)]
@@ -274,12 +460,13 @@ def ffd_binpack_groups_pallas(
     if chunk is None:
         M_lanes = max_nodes + (-max_nodes) % 128
         chunk = 512
+        n_planes = len(swar_plan) if swar_plan else R_k
         for cand in (1024,):
             est = (
-                2 * R_k * cand * group_block      # double-buffered req stream
-                + R_k * group_block * M_lanes      # resident carry
-                + 2 * cand * group_block          # double-buffered placed out
-            ) * 4 + 3 * 1024 * 1024               # Mosaic scratch
+                2 * n_planes * cand * group_block  # double-buffered req stream
+                + n_planes * group_block * M_lanes  # resident carry
+                + 2 * cand * group_block           # double-buffered placed out
+            ) * 4 + 3 * 1024 * 1024                # Mosaic scratch
             if est <= 15 * 1024 * 1024:
                 chunk = cand
         # don't scan pure padding: a P=300 world needs one 304-slot chunk,
@@ -297,43 +484,60 @@ def ffd_binpack_groups_pallas(
     # commutes with the sort, and an all-inf row both fits nowhere in the
     # kernel and needs no separate active stream.
     iota = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :], (G_pad, P))
+    pad_cols = P_pad - P
+    if swar_plan is not None:
+        # SWAR fast path (integer-valued requests/allocs, planes < axes):
+        # the R_k f32 columns collapse into packed i32 planes BEFORE the
+        # sort — the sort payload bytes, the stream, and the kernel's
+        # per-step plane traffic all shrink together. Masked pods carry the
+        # per-plane sentinel (each field at 2^(width-1): above every real
+        # value, borrow-contained) instead of +inf.
+        guards, sentinels = _swar_masks(swar_plan)
+        plane_cols = _swar_pack_cols(pod_req, swar_plan)
+        inactive = [jnp.int32(sent) for sent in sentinels]
+        allocs_in = jnp.stack(_swar_pack_cols(template_allocs, swar_plan))
+    else:
+        guards = None
+        plane_cols = [pod_req[:, r] for r in range(R_k)]
+        inactive = [jnp.inf] * R_k
+        allocs_in = template_allocs.T
     cols = [
-        jnp.where(
-            pod_masks,
-            jnp.broadcast_to(pod_req[:, r][None, :], (G_pad, P)),
-            jnp.inf,
-        )
-        for r in range(R_k)
+        jnp.where(pod_masks, jnp.broadcast_to(pc[None, :], (G_pad, P)), sent)
+        for pc, sent in zip(plane_cols, inactive)
     ]
     sorted_ops = jax.lax.sort(
         [-scores, iota, *cols], dimension=1, is_stable=True, num_keys=1
     )
     sorted_iota = sorted_ops[1]                                  # [G_pad, P]
-    pad_cols = P_pad - P
     stream = jnp.stack(
         [
-            jnp.pad(c, ((0, 0), (0, pad_cols)), constant_values=jnp.inf).T
-            for c in sorted_ops[2:]
+            jnp.pad(c, ((0, 0), (0, pad_cols)), constant_values=sent).T
+            for c, sent in zip(sorted_ops[2:], inactive)
         ]
-    )                                                            # [R, P_pad, G_pad]
+    )                                        # [NP or R, P_pad, G_pad]
 
     free, opened, placed = _pallas_scan_all(
-        stream, template_allocs.T, caps.T,
+        stream, allocs_in, caps,
         max_nodes=max_nodes, chunk=chunk, group_block=group_block,
-        interpret=interpret,
+        interpret=interpret, guards=guards,
     )
+    if swar_plan is not None:
+        free = _swar_unpack_free(free, swar_plan, R_k)
 
     # Un-sort the placement bits back to original pod order with a second
     # sort keyed on the carried original index (3× cheaper than the
     # equivalent scatter on TPU). Pad slots sit at sorted positions >= P and
     # are sliced away before the un-sort.
+    # u8 payload: the sort's cost tracks operand bytes, and the placement
+    # bit needs one byte, not four
     _, scheduled_i = jax.lax.sort(
-        [sorted_iota, placed.T[:, :P]], dimension=1, is_stable=False, num_keys=1
+        [sorted_iota, placed.T[:, :P].astype(jnp.uint8)],
+        dimension=1, is_stable=False, num_keys=1,
     )
     scheduled = scheduled_i[:G] > 0
 
     used = allocs_to_used(template_allocs, free)
-    node_used = jnp.transpose(used, (1, 2, 0))[:G]        # [G, M, R]
+    node_used = jnp.transpose(used, (2, 1, 0))[:G, :max_nodes]   # [G, M, R]
     if compressed:
         node_used = (
             jnp.zeros((G, max_nodes, R_full), jnp.float32)
@@ -348,5 +552,5 @@ def ffd_binpack_groups_pallas(
 
 
 def allocs_to_used(template_allocs, free):
-    """used[R, G, M] = alloc - free (free of padding groups is 0-alloc)."""
-    return template_allocs.T[:, :, None] - free
+    """used[R, M, G] = alloc - free (free of padding groups is 0-alloc)."""
+    return template_allocs.T[:, None, :] - free

@@ -51,6 +51,8 @@ def parse_duration_s(s: str) -> float:
     ``1h30m`` / ``1d12h`` (prommodel.ParseDuration grammar: units in
     strictly descending order, each at most once)."""
     text = s.strip()
+    if text == "0":
+        return 0.0    # prommodel special-cases the bare "0" (no unit)
     pos, total = 0, 0.0
     last_rank = -1
     ranks = {u: r for r, u in enumerate(("y", "w", "d", "h", "m", "s", "ms"))}

@@ -105,7 +105,7 @@ def _bench_main():
     jallocs = jnp.asarray(allocs)
     jcaps = jnp.asarray(caps)
 
-    from autoscaler_tpu.ops.bits import pack_bool_bits, unpack_bool_bits
+    from autoscaler_tpu.ops.bits import pack_result_blob, unpack_result_blob
 
     def run_with(binpack_fn):
         out = binpack_fn(
@@ -113,11 +113,12 @@ def _bench_main():
         )
         # Host fetch forces completion (block_until_ready does NOT reliably
         # block through the axon relay — measured 83µs "completions") and is
-        # what the control plane consumes. scheduled ships bit-packed (8:1;
-        # raw [G, P] bools cost ~1.2s of pure tunnel transfer at 100k×500).
-        counts = np.asarray(out.node_count)
-        sched = unpack_bool_bits(np.asarray(pack_bool_bits(out.scheduled)), P)
-        return counts, sched
+        # what the control plane consumes. counts + scheduled ship as ONE
+        # fused blob, bit-packed 8:1 (raw [G, P] bools cost ~1.2s of pure
+        # tunnel transfer at 100k×500, and a separate counts fetch costs a
+        # second full round-trip).
+        blob = np.asarray(pack_result_blob(out.node_count, out.scheduled))
+        return unpack_result_blob(blob, G, P)
 
     def run():
         return run_with(ffd_binpack_groups)

@@ -120,3 +120,109 @@ def test_north_star_group_padding_shape():
     )
     np.testing.assert_array_equal(np.asarray(out.node_count), np.asarray(ref.node_count))
     np.testing.assert_array_equal(np.asarray(out.scheduled), np.asarray(ref.scheduled))
+
+
+class TestSwarFastPath:
+    """The SWAR packed-plane fast path (integer-valued workloads collapse
+    the R f32 capacity planes into <=2 i32 planes with guard-bit fit
+    checks) and its f32 fallback."""
+
+    def test_plan_packs_bench_shape(self):
+        from autoscaler_tpu.ops.pallas_binpack import _swar_plan
+
+        # cpu 32000 (16b)+1, mem 65536 (17b)+1, gpu 8 (4b)+1, pods 110 (7b)+1
+        plan = _swar_plan([32000, 65536, 8, 110])
+        assert plan is not None and len(plan) == 2
+        covered = sorted(r for fields in plan for r, _, _ in fields)
+        assert covered == [0, 1, 2, 3]
+        for fields in plan:
+            assert sum(w for _, _, w in fields) <= 31
+
+    def test_plan_rejects_oversized(self):
+        from autoscaler_tpu.ops.pallas_binpack import _swar_plan
+
+        assert _swar_plan([2**31, 10]) is None          # 32-bit field
+        # two 30-bit axes: one plane each = no win
+        assert _swar_plan([2**29, 2**29]) is None
+
+    def test_pack_unpack_roundtrip(self):
+        from autoscaler_tpu.ops.pallas_binpack import (
+            _swar_pack_cols,
+            _swar_plan,
+            _swar_unpack_free,
+        )
+
+        rng = np.random.default_rng(0)
+        vals = np.stack(
+            [rng.integers(0, hi, 40) for hi in (32000, 65536, 8, 110)], axis=1
+        ).astype(np.float32)
+        plan = _swar_plan([32000, 65536, 8, 110])
+        packed = _swar_pack_cols(jnp.asarray(vals), plan)
+        planes = jnp.stack(packed)[:, :, None]           # [NP, 40, 1] as M,G
+        back = np.asarray(_swar_unpack_free(planes, plan, 4))[:, :, 0]
+        np.testing.assert_array_equal(back, vals.T)
+
+    def test_fractional_requests_fall_back_with_parity(self):
+        """Fractional MiB values cannot pack into integer fields — the f32
+        plane path must route and stay exact."""
+        req, masks, allocs = rand_case(7)
+        req[:, MEMORY] += 0.5                            # fractional
+        assert_parity(req, masks, allocs, max_nodes=16)
+
+    def test_boundary_widths_stay_exact(self):
+        """Values at the top of their fields: max request == max alloc ==
+        2^k - 1 exercises the guard-bit borrow logic at its edge."""
+        rng = np.random.default_rng(3)
+        P, G = 64, 3
+        req = np.zeros((P, 6), np.float32)
+        req[:, CPU] = rng.integers(1, 2**16, P)
+        req[:, CPU][0] = 2**16 - 1
+        req[:, MEMORY] = rng.integers(1, 2**17, P)
+        req[:, MEMORY][1] = 2**17 - 1
+        req[:, PODS] = 1.0
+        allocs = np.zeros((G, 6), np.float32)
+        allocs[:, CPU] = 2**16 - 1
+        allocs[:, MEMORY] = 2**17 - 1
+        allocs[:, PODS] = 110.0
+        masks = rng.random((G, P)) > 0.2
+        assert_parity(req, masks, allocs, max_nodes=8)
+
+    def test_gpu_axis_packs(self):
+        req, masks, allocs = rand_case(11)
+        rng = np.random.default_rng(12)
+        gpu_pods = rng.random(len(req)) < 0.3
+        req[gpu_pods, 3] = rng.integers(1, 4, int(gpu_pods.sum()))
+        allocs[:, 3] = 8.0
+        assert_parity(req, masks, allocs, max_nodes=16)
+
+
+class TestResultBlob:
+    """pack_result_blob / unpack_result_blob — the fused single-fetch
+    transport for estimator results (counts ride as little-endian bytes via
+    bitcast; the host decodes with a "<i4" view)."""
+
+    def test_roundtrip(self):
+        from autoscaler_tpu.ops.bits import pack_result_blob, unpack_result_blob
+
+        rng = np.random.default_rng(0)
+        G, P = 9, 203
+        counts = rng.integers(0, 2**20, G).astype(np.int32)
+        sched = rng.random((G, P)) > 0.4
+        blob = np.asarray(
+            pack_result_blob(jnp.asarray(counts), jnp.asarray(sched))
+        )
+        c2, s2 = unpack_result_blob(blob, G, P)
+        np.testing.assert_array_equal(c2, counts)
+        np.testing.assert_array_equal(s2, sched)
+
+    def test_byte_order_contract(self):
+        """A count of 1 must land as 01 00 00 00 (little-endian), whatever
+        backend produced the blob."""
+        from autoscaler_tpu.ops.bits import pack_result_blob
+
+        blob = np.asarray(
+            pack_result_blob(
+                jnp.asarray([1], jnp.int32), jnp.zeros((1, 8), bool)
+            )
+        )
+        np.testing.assert_array_equal(blob[:4], [1, 0, 0, 0])

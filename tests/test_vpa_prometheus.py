@@ -41,6 +41,7 @@ class TestDurations:
         # forms like 1d12h for --history-length
         ("1h30m", 5400.0), ("1d12h", 36 * 3600.0),
         ("2m30s", 150.0), ("1s500ms", 1.5),
+        ("0", 0.0),   # prommodel special-cases the bare zero
     ])
     def test_prometheus_duration_grammar(self, s, expect):
         assert parse_duration_s(s) == expect
