@@ -69,7 +69,8 @@ class ScaleUpOrchestrator:
                 limiter=ThresholdBasedEstimationLimiter(
                     max_nodes=options.max_nodes_per_scaleup,
                     max_duration_s=options.max_nodegroup_binpacking_duration_s,
-                )
+                ),
+                metrics=metrics,
             )
         self.estimator = estimator
         self.expander = expander or build_strategy(

@@ -27,6 +27,7 @@ UPDATE_STATE = "updateClusterState"
 FILTER_OUT_SCHEDULABLE = "filterOutSchedulable"
 SNAPSHOT_BUILD = "buildSnapshot"
 DEVICE_DISPATCH = "deviceDispatch"  # TPU-specific: kernel round trips
+ESTIMATE = "estimate"  # batched binpacking dispatch (threshold_based_limiter envelope)
 
 
 class _Series:
@@ -244,6 +245,11 @@ class AutoscalerMetrics:
         )
         self.pending_node_deletions = r.gauge(
             p + "pending_node_deletions", "deletions currently in flight"
+        )
+        self.estimation_over_budget_total = r.counter(
+            p + "estimation_over_budget_total",
+            "batched binpacking dispatches exceeding the per-group duration "
+            "budget x group count (--max-nodegroup-binpacking-duration)",
         )
 
     def observe_duration(self, label: str, start_ts: float) -> float:
