@@ -414,6 +414,21 @@ def ffd_binpack_groups_pallas(
 
     scores = jax.vmap(lambda alloc: ffd_scores(pod_req, alloc))(template_allocs)
 
+    # +inf allocs (documented input: unlimited CSI attach limits ride as
+    # inf-capacity virtual planes, estimator/binpacking._augment_virtual)
+    # clamp AFTER scoring to a finite always-fits stand-in: the kernel
+    # carries FREE capacity, and inf - used = inf loses the usage, making
+    # node_used reconstruct as inf - inf = NaN (the XLA scan carries used
+    # directly and stays finite). A power of two >= 2x the axis's total
+    # request keeps "always fits" exact (used <= sum <= BIG/2, so free >=
+    # BIG/2 >= any req) and integer-request arithmetic exact in f32 for
+    # the unit-count planes this input actually is.
+    axis_total = jnp.sum(pod_req, axis=0)
+    big = jnp.exp2(jnp.ceil(jnp.log2(jnp.maximum(axis_total * 2.0, 2.0**23))))
+    template_allocs = jnp.where(
+        jnp.isfinite(template_allocs), template_allocs, big[None, :]
+    )
+
     # Exact resource-axis compression (AFTER scoring, which indexes CPU/MEMORY
     # positionally): an axis nobody requests can never gate a fit (0 <= free
     # always) nor change the carry (usage += 0), so drop it from the kernel's

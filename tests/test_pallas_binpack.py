@@ -187,6 +187,19 @@ class TestSwarFastPath:
         masks = rng.random((G, P)) > 0.2
         assert_parity(req, masks, allocs, max_nodes=8)
 
+    def test_inf_alloc_routes_to_f32_path(self):
+        """+inf allocs (unlimited CSI attach limits become inf-capacity
+        virtual planes) cannot pack into integer fields — must route to
+        the f32 path, where inf free always fits, not crash the plan."""
+        req, masks, allocs = rand_case(21)
+        allocs = np.concatenate(
+            [allocs, np.full((len(allocs), 1), np.inf, np.float32)], axis=1
+        )
+        req = np.concatenate(
+            [req, np.ones((len(req), 1), np.float32)], axis=1
+        )
+        assert_parity(req, masks, allocs, max_nodes=16)
+
     def test_gpu_axis_packs(self):
         req, masks, allocs = rand_case(11)
         rng = np.random.default_rng(12)
@@ -226,16 +239,3 @@ class TestResultBlob:
             )
         )
         np.testing.assert_array_equal(blob[:4], [1, 0, 0, 0])
-
-    def test_inf_alloc_routes_to_f32_path(self):
-        """+inf allocs (unlimited CSI attach limits become inf-capacity
-        virtual planes) cannot pack into integer fields — must route to
-        the f32 path, where inf free always fits, not crash the plan."""
-        req, masks, allocs = rand_case(21)
-        allocs = np.concatenate(
-            [allocs, np.full((len(allocs), 1), np.inf, np.float32)], axis=1
-        )
-        req = np.concatenate(
-            [req, np.ones((len(req), 1), np.float32)], axis=1
-        )
-        assert_parity(req, masks, allocs, max_nodes=16)
