@@ -451,14 +451,11 @@ def ffd_binpack_groups_pallas(
             jnp.max(pod_req, axis=0, initial=0.0),
             jnp.max(template_allocs, axis=0, initial=0.0),
             (pod_req >= 0).all()
+            # non-finite requests never occur by construction, but an inf
+            # would slip past the floor() integrality check and crash
+            # _swar_plan, so guard explicitly (allocs are already finite:
+            # the clamp above replaced every inf before this probe)
             & jnp.isfinite(pod_req).all()
-            # +inf allocs are a DOCUMENTED input (unlimited CSI attach
-            # limits become inf-capacity virtual planes,
-            # estimator/binpacking.py) — non-finite values cannot pack
-            # into integer fields, so they route to the f32 path, whose
-            # inf free always fits (floor(inf) == inf would otherwise
-            # slip past the integrality check and crash _swar_plan)
-            & jnp.isfinite(template_allocs).all()
             & (pod_req == jnp.floor(pod_req)).all()
             & (template_allocs == jnp.floor(template_allocs)).all(),
         ))

@@ -187,10 +187,12 @@ class TestSwarFastPath:
         masks = rng.random((G, P)) > 0.2
         assert_parity(req, masks, allocs, max_nodes=8)
 
-    def test_inf_alloc_routes_to_f32_path(self):
+    def test_inf_alloc_clamps_into_swar_path(self):
         """+inf allocs (unlimited CSI attach limits become inf-capacity
-        virtual planes) cannot pack into integer fields — must route to
-        the f32 path, where inf free always fits, not crash the plan."""
+        virtual planes) clamp to a finite always-fits power of two before
+        the SWAR probe, so this integer-valued case packs and stays exact
+        (incl. node_used on the clamped axis) instead of crashing the
+        field planner on int(inf)."""
         req, masks, allocs = rand_case(21)
         allocs = np.concatenate(
             [allocs, np.full((len(allocs), 1), np.inf, np.float32)], axis=1

@@ -51,6 +51,8 @@ def build_world(rng):
     # scatter running pods over existing nodes
     nodes = list(api.nodes.values())
     pi = 0
+    from autoscaler_tpu.kube.objects import LegacyVolume
+
     for node in nodes:
         for _ in range(int(rng.integers(0, 4))):
             frac = rng.uniform(0.05, 0.3)
@@ -60,6 +62,14 @@ def build_world(rng):
                 mem=node.allocatable.memory * frac,
                 node_name=node.name,
             )
+            if rng.random() < 0.1:
+                # placed legacy in-tree volume users: pending sharers (below)
+                # get node-subset vetoes, drains hit conflict-blocked
+                # destinations — churns the same-volume exception machinery
+                p.legacy_volumes = (LegacyVolume(
+                    "gce-pd", f"disk-{int(rng.integers(0, 3))}",
+                    read_only=bool(rng.random() < 0.4),
+                ),)
             api.add_pod(p)
             pi += 1
     # pending burst, each pod fits at least the largest template; a slice
@@ -81,6 +91,11 @@ def build_world(rng):
             p.csi_volumes = (("pd.csi.storage.gke.io", f"vol-{j}"),)
         elif flavor < 0.25:
             p.host_ports = (9000 + j % 3,)
+        elif flavor < 0.3:
+            p.legacy_volumes = (LegacyVolume(
+                "gce-pd", f"disk-{j % 3}",
+                read_only=bool(rng.random() < 0.4),
+            ),)
         elif flavor < 0.35:
             # hard topology spread: exercises the within-wave spread carry
             # in the estimator, the hinting path, and the scale-down refit
