@@ -1,0 +1,103 @@
+"""bf16 fit-compare measurement on real TPU (ROADMAP Scale #3's open item).
+
+Measures `ops/fit.fit_matrix` at a filter-out-schedulable-scale shape
+(default 50k pods x 5k nodes = 250M pairs, dense-capable) in f32 vs the
+opt-in conservative-bf16 mode, checks the one-sided property on the run's
+actual data (bf16 may under-admit, never over-admit), and prints ONE JSON
+line so the capture can be committed and a default chosen with a measured
+rationale.
+
+The bf16 path (fit.bf16_compare_operands) rounds requests UP to the bf16
+grid and free capacity DOWN, so the compare runs at 2x VPU f32 throughput
+with a verdict that can only be stricter than f32's.
+
+Run on the TPU: python benchmarks/bf16_bench.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from autoscaler_tpu.ops import fit as fit_mod
+    from autoscaler_tpu.snapshot.tensors import SnapshotTensors, bucket_size
+
+    P = int(os.environ.get("BF16_BENCH_P", 50_000))
+    N = int(os.environ.get("BF16_BENCH_N", 5_000))
+    rng = np.random.default_rng(0)
+
+    PP, NN = bucket_size(P), bucket_size(N)
+    pod_req = np.zeros((PP, 6), np.float32)
+    pod_req[:P, 0] = rng.integers(50, 4000, P)
+    pod_req[:P, 1] = rng.integers(64, 16384, P) * (2**20 / 2**20)  # MiB
+    pod_req[:P, 5] = 1
+    node_alloc = np.zeros((NN, 6), np.float32)
+    node_alloc[:N, 0] = rng.choice([4000, 8000, 16000, 32000], N)
+    node_alloc[:N, 1] = rng.choice([8192, 16384, 32768, 65536], N)
+    node_alloc[:N, 5] = 110
+    node_used = np.zeros((NN, 6), np.float32)
+    frac = rng.uniform(0.0, 0.9, N).astype(np.float32)
+    node_used[:N] = node_alloc[:N] * frac[:, None]
+    pod_valid = np.zeros(PP, bool); pod_valid[:P] = True
+    node_valid = np.zeros(NN, bool); node_valid[:N] = True
+
+    snap = SnapshotTensors(
+        node_alloc=jnp.asarray(node_alloc),
+        node_used=jnp.asarray(node_used),
+        node_valid=jnp.asarray(node_valid),
+        node_group=jnp.zeros((NN,), jnp.int32),
+        pod_req=jnp.asarray(pod_req),
+        pod_valid=jnp.asarray(pod_valid),
+        pod_node=jnp.full((PP,), -1, jnp.int32),
+        sched_mask=jnp.ones((PP, NN), bool),
+    )
+
+    def run(precision):
+        m = fit_mod.fit_matrix(snap, precision=precision)
+        # tiny fetch forces completion through the axon relay
+        return np.asarray(m[:1, :1])
+
+    out = {}
+    for precision in ("f32", "bf16"):
+        run(precision)  # compile + warm
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            run(precision)
+            times.append(time.perf_counter() - t0)
+        out[precision] = float(np.median(times))
+
+    # one-sided property on this run's data: bf16 admits a subset of f32
+    m32 = np.asarray(fit_mod.fit_matrix(snap, precision="f32"))
+    m16 = np.asarray(fit_mod.fit_matrix(snap, precision="bf16"))
+    over_admits = int((m16 & ~m32).sum())
+    under_admits = int((m32 & ~m16).sum())
+
+    import jax as _jax
+
+    print(json.dumps({
+        "metric": "fit_matrix_bf16_vs_f32",
+        "p": P, "n": N,
+        "platform": _jax.default_backend(),
+        "f32_s": round(out["f32"], 4),
+        "bf16_s": round(out["bf16"], 4),
+        "speedup": round(out["f32"] / out["bf16"], 3),
+        "bf16_over_admits": over_admits,    # MUST be 0 (one-sided rounding)
+        "bf16_under_admits": under_admits,  # allowed, self-corrects next loop
+    }))
+    if over_admits:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
