@@ -18,6 +18,7 @@ import time
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -325,19 +326,68 @@ class BinpackingNodeEstimator:
                 pods, [templates[g] for g in names], pad_pods=P,
                 bucket_terms=True, cluster=cluster,
             )
-            res: BinpackResult = ffd_binpack_groups_affinity(
-                jnp.asarray(req),
-                jnp.asarray(masks),
-                jnp.asarray(allocs),
-                max_nodes=scan_cap,
-                spread=_spread_tuple(sp),
-                match=jnp.asarray(terms.match),
-                aff_of=jnp.asarray(terms.aff_of),
-                anti_of=jnp.asarray(terms.anti_of),
-                node_level=jnp.asarray(terms.node_level),
-                has_label=jnp.asarray(terms.has_label),
-                node_caps=jnp.asarray(caps),
-            )
+            # bucket_terms pads S to a minimum, so "no spread" means no pod
+            # DECLARES a term, not S == 0 (padded terms are inert)
+            no_spread = not bool(sp.sp_of.any())
+            # VMEM pre-check for the Pallas route: the resident carry is
+            # (R + 2·TP) [M, 128] planes + the double-buffered req/bit
+            # stream (the kernel's own budget model) — workloads past the
+            # v5e budget (very many distinct terms, huge caps, wide
+            # extended-resource axes) stay on the XLA scan rather than
+            # failing Mosaic compilation mid-estimate.
+            TP = max((terms.match.shape[0] + 31) // 32, 1)
+            R_est = req.shape[1]
+            M_lanes = scan_cap + (-scan_cap) % 128
+            vmem_est = (
+                2 * (R_est + 3 * TP) * 256 * 128
+                + (R_est + 2 * TP) * 128 * M_lanes
+                + 2 * 256 * 128
+            ) * 4 + 3 * 1024 * 1024
+            res: Optional[BinpackResult] = None
+            if (
+                no_spread
+                and vmem_est <= 15 * 1024 * 1024
+                and jax.default_backend() == "tpu"
+            ):
+                # Pallas VMEM twin for the affinity-without-spread case —
+                # the reference's documented ~1000x pain point
+                # (FAQ.md:151-153). Hard spread needs real counts
+                # (maxSkew arithmetic), which the bitset carry cannot
+                # express, so spread workloads stay on the XLA scan.
+                from autoscaler_tpu.ops.pallas_binpack_affinity import (
+                    ffd_binpack_groups_affinity_pallas,
+                )
+
+                try:
+                    res = ffd_binpack_groups_affinity_pallas(
+                        req, masks, allocs,
+                        max_nodes=scan_cap,
+                        match=terms.match,
+                        aff_of=terms.aff_of,
+                        anti_of=terms.anti_of,
+                        node_level=terms.node_level,
+                        has_label=terms.has_label,
+                        node_caps=caps,
+                    )
+                except Exception:  # noqa: BLE001 — any kernel failure
+                    logging.getLogger("estimator").warning(
+                        "pallas affinity kernel failed; falling back to the "
+                        "XLA scan", exc_info=True,
+                    )
+            if res is None:
+                res = ffd_binpack_groups_affinity(
+                    jnp.asarray(req),
+                    jnp.asarray(masks),
+                    jnp.asarray(allocs),
+                    max_nodes=scan_cap,
+                    spread=_spread_tuple(sp),
+                    match=jnp.asarray(terms.match),
+                    aff_of=jnp.asarray(terms.aff_of),
+                    anti_of=jnp.asarray(terms.anti_of),
+                    node_level=jnp.asarray(terms.node_level),
+                    has_label=jnp.asarray(terms.has_label),
+                    node_caps=jnp.asarray(caps),
+                )
         else:
             res = ffd_binpack_groups(
                 jnp.asarray(req),

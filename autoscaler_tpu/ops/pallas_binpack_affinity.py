@@ -1,0 +1,399 @@
+"""Pallas FFD scan with dynamic inter-pod (anti-)affinity — the VMEM fast
+path for the reference's single worst scalability case.
+
+The reference documents inter-pod affinity as ~1000× the cost of every other
+predicate combined (FAQ.md:151-153) because the InterPodAffinity plugin
+re-runs after every simulated placement (binpacking_estimator.go:119-141).
+The XLA scan twin (ops/binpack.ffd_binpack_groups_affinity) already turns
+that into batched domain arithmetic, but it is HBM-bound the same way the
+plain scan was (~50-80µs/step: the [G,T,M] count carries round-trip HBM on
+every step, plus per-step gathers of the pod's term rows).
+
+Key observation that makes a VMEM-resident Pallas twin fit: every affinity
+gate consumes only the ZERO/NONZERO state of the count planes —
+`dom_pm > 0`, `pm_tot == 0`, `ha_tot > 0` (ops/binpack._affinity_node_gates)
+— never the magnitudes. So the carry packs T terms as BITS, 32 per i32
+plane: `pm_bits/ha_bits [TP, M, GB]` (term t's bit set on node m ⇔ a
+matching/anti-holding pod was scan-placed there) and `pm_tot/ha_tot
+[TP, GB]` group-domain bitsets, TP = ceil(T/32). At T=64, M=1024, GB=128
+that is ~4MB — resident in VMEM for the whole scan next to the free-capacity
+carry, with the same nodes-on-sublanes layout as the plain kernel
+(ops/pallas_binpack._scan_kernel): every per-step vector is a GB lane
+vector, bit-plane ops are [M, GB] i32 elementwise, and the first-fit min is
+a sublane reduction.
+
+Gate algebra, transcribed bit-parallel from _affinity_node_gates (viol bits
+nonzero ⇒ node vetoed; `dom` blends hostname-level planes with group totals
+via the nl bitmask; `seed = m_p & ~pm_tot` is the Kubernetes self-match
+seeding rule):
+
+  dom_pm[m] = (pm_bits[m] & nl) | (pm_tot & ~nl)
+  viol_aff[m]  = a_p & (~hl | ~(dom_pm[m] | seed))
+  viol_anti[m] = x_p & dom_pm[m] & hl
+  viol_sym[m]  = m_p & dom_ha[m] & hl
+  gate_open[m] = (viol_aff | viol_anti | viol_sym) == 0
+
+  new_viol = a_p & ~( (nl & seed) | (~nl & hl & (pm_tot | seed)) )
+           | x_p & ~nl & pm_tot & hl
+           | m_p & ~nl & ha_tot & hl
+  new_ok   = new_viol == 0
+
+The open-new-node rule folds into the one first-fit min exactly like the
+plain kernel (closed nodes hold free == alloc): the per-node gate blends
+`where(m < opened, gate_open[m], new_ok)`, so the min lands on the first
+admitting open node, else on index `opened` when the pod may seed a fresh
+node. Parity is locked against ffd_binpack_groups_affinity (itself
+serial-oracle-locked) in tests/test_pallas_affinity.py.
+
+Spread-carrying workloads stay on the XLA scan: hard topology spread needs
+real COUNTS (maxSkew arithmetic), not bits — a count-plane variant is the
+natural extension but is not built yet (estimator routing sends spread to
+the XLA kernels).
+
+Reference algorithm: binpacking_estimator.go:65-141 + the InterPodAffinity
+filter semantics over scan-placed pods.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from autoscaler_tpu.ops.binpack import BinpackResult, ffd_scores
+from autoscaler_tpu.ops.pallas_binpack import BIG_I32, _STEP_TILE, allocs_to_used
+
+
+def _pack_term_bits(rows: jax.Array, TP: int) -> jax.Array:
+    """[T, N] bool → [TP, N] i32 bitsets (term t → bit t%32 of plane t//32)."""
+    T, N = rows.shape
+    pad = TP * 32 - T
+    r = jnp.pad(rows.astype(jnp.int32), ((0, pad), (0, 0)))
+    r = r.reshape(TP, 32, N)
+    weights = jnp.left_shift(
+        jnp.uint32(1), jnp.arange(32, dtype=jnp.uint32)
+    )
+    planes = jnp.sum(
+        r.astype(jnp.uint32) * weights[None, :, None], axis=1, dtype=jnp.uint32
+    )
+    return jax.lax.bitcast_convert_type(planes, jnp.int32)
+
+
+def _scan_kernel_aff(
+    req_ref,       # [R, CHUNK, GB] f32 — sorted requests, +inf = inactive
+    mbits_ref,     # [TP, CHUNK, GB] i32 — candidate pod's match bits
+    abits_ref,     # [TP, CHUNK, GB] i32 — pod's required-affinity bits
+    xbits_ref,     # [TP, CHUNK, GB] i32 — pod's anti-affinity bits
+    caps_ref,      # [1, GB] i32
+    allocs_ref,    # [R, GB] f32
+    nl_ref,        # [TP, GB] i32 — node-level (hostname) term bitmask
+    hl_ref,        # [TP, GB] i32 — group-template-has-label bitmask
+    free_ref,      # [R, M, GB] f32 out — VMEM-resident carry
+    opened_ref,    # [1, GB] i32 out
+    pm_ref,        # [TP, M, GB] i32 out — match bits per node
+    ha_ref,        # [TP, M, GB] i32 out — anti-holder bits per node
+    pmt_ref,       # [TP, GB] i32 out — match bits anywhere in the group
+    hat_ref,       # [TP, GB] i32 out — anti-holder bits anywhere
+    placed_ref,    # [CHUNK, GB] i32 out
+    *,
+    num_resources: int,
+    num_planes: int,
+    chunk: int,
+    max_nodes: int,
+):
+    gb = free_ref.shape[2]
+    R = num_resources
+    TP = num_planes
+    M = free_ref.shape[1]
+    node_iota = jax.lax.broadcasted_iota(jnp.int32, (M, gb), 0)
+    caps = caps_ref[0, :]
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        for r in range(R):
+            free_ref[r, :, :] = jnp.broadcast_to(
+                allocs_ref[r, :][None, :], (M, gb)
+            )
+        opened_ref[:] = jnp.zeros((1, gb), jnp.int32)
+        for tp in range(TP):
+            pm_ref[tp, :, :] = jnp.zeros((M, gb), jnp.int32)
+            ha_ref[tp, :, :] = jnp.zeros((M, gb), jnp.int32)
+        pmt_ref[:] = jnp.zeros((TP, gb), jnp.int32)
+        hat_ref[:] = jnp.zeros((TP, gb), jnp.int32)
+
+    def tile_step(t, _):
+        base = t * _STEP_TILE
+        req_tiles = [req_ref[r, pl.ds(base, _STEP_TILE), :] for r in range(R)]
+        m_tiles = [mbits_ref[tp, pl.ds(base, _STEP_TILE), :] for tp in range(TP)]
+        a_tiles = [abits_ref[tp, pl.ds(base, _STEP_TILE), :] for tp in range(TP)]
+        x_tiles = [xbits_ref[tp, pl.ds(base, _STEP_TILE), :] for tp in range(TP)]
+        placed_rows = []
+
+        for s in range(_STEP_TILE):
+            opened = opened_ref[0, :]                   # [GB]
+            req = [req_tiles[r][s, :] for r in range(R)]
+            m_p = [m_tiles[tp][s, :] for tp in range(TP)]   # [GB] i32 each
+            a_p = [a_tiles[tp][s, :] for tp in range(TP)]
+            x_p = [x_tiles[tp][s, :] for tp in range(TP)]
+
+            fits = req[0][None, :] <= free_ref[0]       # [M, GB] capacity
+            for r in range(1, R):
+                fits &= req[r][None, :] <= free_ref[r]
+
+            # --- bit-parallel affinity gates (module docstring algebra) ---
+            bad = None          # [M, GB] i32 — any set bit vetoes the node
+            new_viol = None     # [GB] i32 — any set bit vetoes a fresh node
+            for tp in range(TP):
+                nl = nl_ref[tp, :]                      # [GB] i32 masks
+                hl = hl_ref[tp, :]
+                pmt = pmt_ref[tp, :]
+                hat = hat_ref[tp, :]
+                seed = m_p[tp] & ~pmt
+                dom_pm = (pm_ref[tp] & nl[None, :]) | (pmt & ~nl)[None, :]
+                dom_ha = (ha_ref[tp] & nl[None, :]) | (hat & ~nl)[None, :]
+                viol = (
+                    (a_p[tp][None, :] & (~hl[None, :] | ~(dom_pm | seed[None, :])))
+                    | (x_p[tp][None, :] & dom_pm & hl[None, :])
+                    | (m_p[tp][None, :] & dom_ha & hl[None, :])
+                )
+                bad = viol if bad is None else (bad | viol)
+                nv = (
+                    (a_p[tp] & ~((nl & seed) | (~nl & hl & (pmt | seed))))
+                    | (x_p[tp] & ~nl & pmt & hl)
+                    | (m_p[tp] & ~nl & hat & hl)
+                )
+                new_viol = nv if new_viol is None else (new_viol | nv)
+
+            gate_open = bad == 0                        # [M, GB]
+            new_ok = new_viol == 0                      # [GB]
+            is_open = node_iota < opened[None, :]
+            gate = jnp.where(is_open, gate_open, new_ok[None, :])
+            fits &= gate
+
+            first = jnp.min(
+                jnp.where(fits, node_iota, BIG_I32), axis=0
+            )                                           # [GB]
+            place = first < caps
+            target = jnp.where(place, first, -1)
+
+            hit = node_iota == target[None, :]          # [M, GB]
+            for r in range(R):
+                sub = jnp.where(place, req[r], 0.0)[None, :]
+                free_ref[r, :, :] = free_ref[r] - jnp.where(hit, sub, 0.0)
+            zero = jnp.int32(0)
+            for tp in range(TP):
+                m_add = jnp.where(place, m_p[tp], zero)
+                x_add = jnp.where(place, x_p[tp], zero)
+                pm_ref[tp, :, :] = pm_ref[tp] | jnp.where(hit, m_add[None, :], zero)
+                ha_ref[tp, :, :] = ha_ref[tp] | jnp.where(hit, x_add[None, :], zero)
+                pmt_ref[tp, :] = pmt_ref[tp, :] | m_add
+                hat_ref[tp, :] = hat_ref[tp, :] | x_add
+            opened_ref[0, :] = jnp.maximum(
+                opened, jnp.where(place, first + 1, 0)
+            )
+            placed_rows.append(place.astype(jnp.int32))
+
+        placed_ref[pl.ds(base, _STEP_TILE), :] = jnp.stack(placed_rows, axis=0)
+        return 0
+
+    jax.lax.fori_loop(0, chunk // _STEP_TILE, tile_step, 0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_nodes", "chunk", "group_block", "interpret"),
+)
+def _pallas_scan_aff(
+    stream,        # [R, P_pad, G_pad] f32
+    bit_stream,    # [3*TP, P_pad, G_pad] i32 (match, aff, anti plane groups)
+    allocs_in,     # [R, G_pad] f32
+    caps_row,      # [1, G_pad] i32
+    nl_planes,     # [TP, G_pad] i32
+    hl_planes,     # [TP, G_pad] i32
+    max_nodes: int,
+    chunk: int,
+    group_block: int,
+    interpret: bool,
+):
+    R, P_pad, G_pad = stream.shape
+    TP = bit_stream.shape[0] // 3
+    NC = P_pad // chunk
+    M_pad = max_nodes + (-max_nodes) % _STEP_TILE
+    kernel = functools.partial(
+        _scan_kernel_aff,
+        num_resources=R, num_planes=TP, chunk=chunk, max_nodes=max_nodes,
+    )
+    mb, ab, xb = (
+        bit_stream[:TP], bit_stream[TP:2 * TP], bit_stream[2 * TP:]
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(G_pad // group_block, NC),
+        in_specs=[
+            pl.BlockSpec((R, chunk, group_block), lambda g, c: (0, c, g)),
+            pl.BlockSpec((TP, chunk, group_block), lambda g, c: (0, c, g)),
+            pl.BlockSpec((TP, chunk, group_block), lambda g, c: (0, c, g)),
+            pl.BlockSpec((TP, chunk, group_block), lambda g, c: (0, c, g)),
+            pl.BlockSpec((1, group_block), lambda g, c: (0, g)),
+            pl.BlockSpec((R, group_block), lambda g, c: (0, g)),
+            pl.BlockSpec((TP, group_block), lambda g, c: (0, g)),
+            pl.BlockSpec((TP, group_block), lambda g, c: (0, g)),
+        ],
+        out_specs=[
+            pl.BlockSpec((R, M_pad, group_block), lambda g, c: (0, 0, g)),
+            pl.BlockSpec((1, group_block), lambda g, c: (0, g)),
+            pl.BlockSpec((TP, M_pad, group_block), lambda g, c: (0, 0, g)),
+            pl.BlockSpec((TP, M_pad, group_block), lambda g, c: (0, 0, g)),
+            pl.BlockSpec((TP, group_block), lambda g, c: (0, g)),
+            pl.BlockSpec((TP, group_block), lambda g, c: (0, g)),
+            pl.BlockSpec((chunk, group_block), lambda g, c: (c, g)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, M_pad, G_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, G_pad), jnp.int32),
+            jax.ShapeDtypeStruct((TP, M_pad, G_pad), jnp.int32),
+            jax.ShapeDtypeStruct((TP, M_pad, G_pad), jnp.int32),
+            jax.ShapeDtypeStruct((TP, G_pad), jnp.int32),
+            jax.ShapeDtypeStruct((TP, G_pad), jnp.int32),
+            jax.ShapeDtypeStruct((P_pad, G_pad), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(stream, mb, ab, xb, caps_row, allocs_in, nl_planes, hl_planes)
+
+
+def ffd_binpack_groups_affinity_pallas(
+    pod_req,          # [P, R]
+    pod_masks,        # [G, P] bool
+    template_allocs,  # [G, R]
+    max_nodes: int,
+    match,            # [T, P] bool
+    aff_of,           # [T, P] bool
+    anti_of,          # [T, P] bool
+    node_level,       # [T] bool
+    has_label,        # [G, T] bool
+    node_caps=None,   # [G] i32
+    chunk: int | None = None,
+    group_block: int = 0,
+    interpret: bool | None = None,
+) -> BinpackResult:
+    """Drop-in twin of ffd_binpack_groups_affinity (no spread) in Pallas.
+
+    Same payload-sort / fused-grid / unsort structure as
+    ffd_binpack_groups_pallas, with three extra sorted payload plane-groups
+    carrying the pod's packed term bitsets. No SWAR/axis-compression here —
+    the affinity term state, not the resource planes, dominates the step."""
+    pod_req = jnp.asarray(pod_req, jnp.float32)
+    pod_masks = jnp.asarray(pod_masks)
+    template_allocs = jnp.asarray(template_allocs, jnp.float32)
+    match = jnp.asarray(match).astype(bool)
+    aff_of = jnp.asarray(aff_of).astype(bool)
+    anti_of = jnp.asarray(anti_of).astype(bool)
+    node_level = jnp.asarray(node_level).astype(bool)
+    has_label = jnp.asarray(has_label).astype(bool)
+    P, R = pod_req.shape
+    G = pod_masks.shape[0]
+    T = match.shape[0]
+    TP = max((T + 31) // 32, 1)
+    if node_caps is None:
+        node_caps = jnp.full((G,), max_nodes, jnp.int32)
+    caps = jnp.minimum(jnp.asarray(node_caps, jnp.int32), max_nodes)[None, :]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if group_block <= 0:
+        group_block = 128 if not interpret else 8
+    G_pad = G + (-G) % group_block
+    if G_pad != G:
+        pad = G_pad - G
+        pod_masks = jnp.pad(pod_masks, ((0, pad), (0, 0)))
+        template_allocs = jnp.pad(template_allocs, ((0, pad), (0, 0)))
+        caps = jnp.pad(caps, ((0, 0), (0, pad)))
+        has_label = jnp.pad(has_label, ((0, pad), (0, 0)))
+
+    scores = jax.vmap(lambda alloc: ffd_scores(pod_req, alloc))(template_allocs)
+
+    if chunk is None:
+        # VMEM model as the plain kernel, with the term planes added: the
+        # resident carry grows by 2·TP [M, GB] planes + the bit stream is
+        # 3·TP more double-buffered chunk planes.
+        M_lanes = max_nodes + (-max_nodes) % 128
+        chunk = 256
+        for cand in (512,):
+            est = (
+                2 * (R + 3 * TP) * cand * group_block
+                + (R + 2 * TP) * group_block * M_lanes
+                + 2 * cand * group_block
+            ) * 4 + 3 * 1024 * 1024
+            if est <= 15 * 1024 * 1024:
+                chunk = cand
+        while chunk > _STEP_TILE and chunk // 2 >= P:
+            chunk //= 2
+
+    P_pad = P + (-P) % chunk
+    pad_cols = P_pad - P
+
+    # term bitsets per pod: [TP, P] planes, sorted as i32 payloads
+    mbits = _pack_term_bits(match, TP)
+    abits = _pack_term_bits(aff_of, TP)
+    xbits = _pack_term_bits(anti_of, TP)
+    nl_plane = _pack_term_bits(node_level[:, None], TP)[:, 0]          # [TP]
+    hl_planes = _pack_term_bits(has_label.T, TP)                       # [TP, G_pad]
+    nl_planes = jnp.broadcast_to(nl_plane[:, None], (TP, G_pad))
+
+    iota = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :], (G_pad, P))
+    cols = [
+        jnp.where(
+            pod_masks,
+            jnp.broadcast_to(pod_req[:, r][None, :], (G_pad, P)),
+            jnp.inf,
+        )
+        for r in range(R)
+    ]
+    bit_cols = [
+        jnp.broadcast_to(b[None, :], (G_pad, P))
+        for planes in (mbits, abits, xbits)
+        for b in planes
+    ]
+    sorted_ops = jax.lax.sort(
+        [-scores, iota, *cols, *bit_cols],
+        dimension=1, is_stable=True, num_keys=1,
+    )
+    sorted_iota = sorted_ops[1]
+    stream = jnp.stack(
+        [
+            jnp.pad(c, ((0, 0), (0, pad_cols)), constant_values=jnp.inf).T
+            for c in sorted_ops[2:2 + R]
+        ]
+    )
+    bit_stream = jnp.stack(
+        [
+            jnp.pad(c, ((0, 0), (0, pad_cols))).T
+            for c in sorted_ops[2 + R:]
+        ]
+    )
+
+    free, opened, _pm, _ha, _pmt, _hat, placed = _pallas_scan_aff(
+        stream, bit_stream, template_allocs.T, caps,
+        nl_planes, hl_planes,
+        max_nodes=max_nodes, chunk=chunk, group_block=group_block,
+        interpret=interpret,
+    )
+
+    _, scheduled_i = jax.lax.sort(
+        [sorted_iota, placed.T[:, :P].astype(jnp.uint8)],
+        dimension=1, is_stable=False, num_keys=1,
+    )
+    scheduled = scheduled_i[:G] > 0
+
+    used = allocs_to_used(template_allocs, free)
+    node_used = jnp.transpose(used, (2, 1, 0))[:G, :max_nodes]
+    return BinpackResult(
+        node_count=opened[0, :G],
+        scheduled=scheduled,
+        node_used=node_used,
+    )

@@ -124,6 +124,43 @@ def main():
     tpu_s = min(times)
     scheds = np.asarray(out.scheduled)
 
+    # Pallas bitset-carry twin (ops/pallas_binpack_affinity): the estimator
+    # routes affinity-without-spread here on TPU. Gated on exact same-run
+    # parity with the XLA scan, same as bench.py's kernel selection; the
+    # headline is whichever VALIDATED path is faster.
+    kernel = "xla_scan"
+    pallas_s = None
+    pallas_parity = None
+    if platform == "tpu":
+        try:
+            from autoscaler_tpu.ops.pallas_binpack_affinity import (
+                ffd_binpack_groups_affinity_pallas,
+            )
+
+            pout = ffd_binpack_groups_affinity_pallas(**jargs)
+            p_counts = np.asarray(pout.node_count)
+            p_scheds = np.asarray(pout.scheduled)
+            if (p_counts == counts).all() and (p_scheds == scheds).all():
+                ptimes = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    np.asarray(
+                        ffd_binpack_groups_affinity_pallas(**jargs).node_count
+                    )
+                    ptimes.append(time.perf_counter() - t0)
+                pallas_s = min(ptimes)
+                pallas_parity = "ok"
+                if pallas_s < tpu_s:
+                    tpu_s = pallas_s
+                    kernel = "pallas"
+            else:
+                pallas_parity = (
+                    f"FAILED: {int((p_counts != counts).sum())} counts, "
+                    f"{int((p_scheds != scheds).sum())} bits — using xla_scan"
+                )
+        except Exception as e:  # noqa: BLE001 — any failure -> xla path
+            pallas_parity = f"pallas path error: {type(e).__name__}: {e}"
+
     if not available():
         raise SystemExit("native baseline unavailable")
     rng = np.random.default_rng(1)
@@ -159,6 +196,9 @@ def main():
             "max": round(float(per_group.max()), 4),
             "sampled": int(SAMPLE_G),
         },
+        "kernel": kernel,
+        **({"pallas_s": round(pallas_s, 4)} if pallas_s else {}),
+        **({"pallas_parity": pallas_parity} if pallas_parity else {}),
         "tpu_times_s": [round(t, 4) for t in times],
         "mean_nodes_per_group": round(float(counts.mean()), 1),
     }

@@ -1,0 +1,240 @@
+"""Parity tests for the Pallas dynamic-affinity FFD kernel
+(ops/pallas_binpack_affinity) against the XLA scan twin
+(ops/binpack.ffd_binpack_groups_affinity), which is itself locked to the
+serial oracle in tests/test_affinity_binpack.py — so exact agreement here
+chains to oracle parity. Runs in interpret mode on the CPU test platform;
+the real-TPU path is exercised by benchmarks/affinity_bench.py.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from autoscaler_tpu.kube.objects import CPU, MEMORY, PODS
+from autoscaler_tpu.ops.binpack import ffd_binpack_groups_affinity
+from autoscaler_tpu.ops.pallas_binpack_affinity import (
+    _pack_term_bits,
+    ffd_binpack_groups_affinity_pallas,
+)
+
+
+def rand_world(seed, P=40, G=3, T=5, max_nodes=16):
+    rng = np.random.default_rng(seed)
+    pod_req = np.zeros((P, 6), np.float32)
+    pod_req[:, CPU] = rng.integers(200, 2500, P)
+    pod_req[:, MEMORY] = rng.integers(128, 4096, P)
+    pod_req[:, PODS] = 1
+    allocs = np.zeros((G, 6), np.float32)
+    allocs[:, CPU] = rng.integers(3000, 9000, G)
+    allocs[:, MEMORY] = rng.integers(6000, 16000, G)
+    allocs[:, PODS] = 32
+    masks = rng.random((G, P)) > 0.1
+    match = rng.random((T, P)) < 0.4
+    aff_of = (rng.random((T, P)) < 0.15) & match  # realistic: self-matching
+    anti_of = (rng.random((T, P)) < 0.15) & ~aff_of
+    node_level = rng.random(T) < 0.5
+    has_label = rng.random((G, T)) < 0.8
+    caps = rng.integers(2, max_nodes, G).astype(np.int32)
+    return pod_req, masks, allocs, match, aff_of, anti_of, node_level, has_label, caps
+
+
+def assert_twin_parity(pod_req, masks, allocs, max_nodes, match, aff_of,
+                       anti_of, node_level, has_label, caps=None):
+    jcaps = None if caps is None else jnp.asarray(caps)
+    ref = ffd_binpack_groups_affinity(
+        jnp.asarray(pod_req), jnp.asarray(masks), jnp.asarray(allocs),
+        max_nodes=max_nodes,
+        match=jnp.asarray(match), aff_of=jnp.asarray(aff_of),
+        anti_of=jnp.asarray(anti_of), node_level=jnp.asarray(node_level),
+        has_label=jnp.asarray(has_label), node_caps=jcaps,
+    )
+    out = ffd_binpack_groups_affinity_pallas(
+        pod_req, masks, allocs, max_nodes=max_nodes,
+        match=match, aff_of=aff_of, anti_of=anti_of,
+        node_level=node_level, has_label=has_label, node_caps=caps,
+        interpret=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref.node_count), np.asarray(out.node_count)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref.scheduled), np.asarray(out.scheduled)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref.node_used), np.asarray(out.node_used)
+    )
+
+
+class TestPackBits:
+    def test_roundtrip_layout(self):
+        rng = np.random.default_rng(0)
+        T, N = 37, 11                     # spills into a second plane
+        rows = rng.random((T, N)) < 0.5
+        planes = np.asarray(_pack_term_bits(jnp.asarray(rows), 2))
+        for t in range(T):
+            for n in range(N):
+                bit = (planes[t // 32, n] >> (t % 32)) & 1
+                assert bool(bit) == rows[t, n]
+
+
+class TestParity:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_randomized_worlds(self, seed):
+        assert_twin_parity(*rand_world(seed)[:3], 16, *rand_world(seed)[3:])
+
+    def test_many_terms_multi_plane(self):
+        """T > 32 exercises multi-plane bitsets."""
+        w = rand_world(11, P=48, G=2, T=40)
+        assert_twin_parity(*w[:3], 12, *w[3:])
+
+    def test_anti_affinity_one_per_node(self):
+        """4 mutually anti-affine pods need 4 nodes despite resource room."""
+        P = 4
+        pod_req = np.zeros((P, 6), np.float32)
+        pod_req[:, CPU] = 500
+        pod_req[:, PODS] = 1
+        allocs = np.zeros((1, 6), np.float32)
+        allocs[0, CPU] = 4000
+        allocs[0, PODS] = 110
+        masks = np.ones((1, P), bool)
+        match = np.ones((1, P), bool)
+        aff_of = np.zeros((1, P), bool)
+        anti_of = np.ones((1, P), bool)
+        node_level = np.array([True])
+        has_label = np.ones((1, 1), bool)
+        out = ffd_binpack_groups_affinity_pallas(
+            pod_req, masks, allocs, max_nodes=8,
+            match=match, aff_of=aff_of, anti_of=anti_of,
+            node_level=node_level, has_label=has_label, interpret=True,
+        )
+        assert int(out.node_count[0]) == 4
+        assert_twin_parity(pod_req, masks, allocs, 8, match, aff_of,
+                           anti_of, node_level, has_label)
+
+    def test_affinity_colocation_with_seeding(self):
+        """Affinity-requiring pods that match their own term co-locate on
+        one node via the self-match seeding rule."""
+        P = 3
+        pod_req = np.zeros((P, 6), np.float32)
+        pod_req[:, CPU] = 500
+        pod_req[:, PODS] = 1
+        allocs = np.zeros((1, 6), np.float32)
+        allocs[0, CPU] = 4000
+        allocs[0, PODS] = 110
+        masks = np.ones((1, P), bool)
+        match = np.ones((1, P), bool)
+        aff_of = np.ones((1, P), bool)
+        anti_of = np.zeros((1, P), bool)
+        node_level = np.array([True])
+        has_label = np.ones((1, 1), bool)
+        out = ffd_binpack_groups_affinity_pallas(
+            pod_req, masks, allocs, max_nodes=8,
+            match=match, aff_of=aff_of, anti_of=anti_of,
+            node_level=node_level, has_label=has_label, interpret=True,
+        )
+        assert int(out.node_count[0]) == 1
+        assert np.asarray(out.scheduled)[0].all()
+        assert_twin_parity(pod_req, masks, allocs, 8, match, aff_of,
+                           anti_of, node_level, has_label)
+
+    def test_group_level_no_label_never_blocks(self):
+        """A template lacking the topology label: anti terms over it cannot
+        be violated, affinity terms over it cannot be satisfied."""
+        w = list(rand_world(3))
+        w[7] = np.zeros_like(w[7])  # has_label all False
+        assert_twin_parity(*w[:3], 16, *w[3:])
+
+    def test_zero_terms_degenerates_to_plain(self):
+        from autoscaler_tpu.ops.binpack import ffd_binpack_groups
+
+        rng = np.random.default_rng(9)
+        P, G = 30, 2
+        pod_req = np.zeros((P, 6), np.float32)
+        pod_req[:, CPU] = rng.integers(100, 2000, P)
+        pod_req[:, PODS] = 1
+        allocs = np.zeros((G, 6), np.float32)
+        allocs[:, CPU] = rng.integers(2000, 8000, G)
+        allocs[:, PODS] = 110
+        masks = np.ones((G, P), bool)
+        plain = ffd_binpack_groups(
+            jnp.asarray(pod_req), jnp.asarray(masks), jnp.asarray(allocs),
+            max_nodes=16,
+        )
+        out = ffd_binpack_groups_affinity_pallas(
+            pod_req, masks, allocs, max_nodes=16,
+            match=np.zeros((0, P), bool), aff_of=np.zeros((0, P), bool),
+            anti_of=np.zeros((0, P), bool), node_level=np.zeros(0, bool),
+            has_label=np.zeros((G, 0), bool), interpret=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(plain.node_count), np.asarray(out.node_count)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(plain.scheduled), np.asarray(out.scheduled)
+        )
+
+    def test_multi_chunk_carry(self):
+        """Terms and capacity carry across pod-chunk boundaries."""
+        w = rand_world(17, P=70, G=2, T=3)
+        pod_req, masks, allocs = w[:3]
+        out_small = ffd_binpack_groups_affinity_pallas(
+            pod_req, masks, allocs, max_nodes=12,
+            match=w[3], aff_of=w[4], anti_of=w[5],
+            node_level=w[6], has_label=w[7], node_caps=w[8],
+            chunk=16, interpret=True,
+        )
+        ref = ffd_binpack_groups_affinity(
+            jnp.asarray(pod_req), jnp.asarray(masks), jnp.asarray(allocs),
+            max_nodes=12,
+            match=jnp.asarray(w[3]), aff_of=jnp.asarray(w[4]),
+            anti_of=jnp.asarray(w[5]), node_level=jnp.asarray(w[6]),
+            has_label=jnp.asarray(w[7]), node_caps=jnp.asarray(w[8]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.node_count), np.asarray(out_small.node_count)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.scheduled), np.asarray(out_small.scheduled)
+        )
+
+
+class TestEstimatorRouting:
+    def test_estimate_many_routes_affinity_to_pallas_on_tpu(self, monkeypatch):
+        """On a TPU backend, estimate_many's dynamic-affinity dispatch (no
+        hard spread) takes the Pallas twin; results must equal the XLA
+        route. The backend is spoofed and the kernel pinned to interpret
+        mode so the route itself is exercised on the CPU test platform."""
+        import autoscaler_tpu.estimator.binpacking as bp
+        import autoscaler_tpu.ops.pallas_binpack_affinity as pba
+        from autoscaler_tpu.utils.test_utils import (
+            anti_affinity,
+            build_test_node,
+            build_test_pod,
+        )
+
+        pods = []
+        for i in range(8):
+            p = build_test_pod(f"p{i}", cpu_m=400, labels={"app": "web"})
+            if i < 4:
+                p.affinity = anti_affinity({"app": "web"})
+            pods.append(p)
+        tmpl = build_test_node("tmpl", cpu_m=4000)
+        est = bp.BinpackingNodeEstimator()
+        want = est.estimate_many(pods, {"g": tmpl})   # XLA route (cpu)
+
+        calls = []
+        real = pba.ffd_binpack_groups_affinity_pallas
+
+        def spy(*args, **kw):
+            calls.append(1)
+            kw["interpret"] = True      # spoofed backend, still on CPU
+            return real(*args, **kw)
+
+        monkeypatch.setattr(pba, "ffd_binpack_groups_affinity_pallas", spy)
+        monkeypatch.setattr(bp.jax, "default_backend", lambda: "tpu")
+        got = est.estimate_many(pods, {"g": tmpl})
+        assert calls, "pallas affinity route was not taken"
+        assert got.keys() == want.keys()
+        for g in want:
+            assert got[g][0] == want[g][0]
+            assert [p.name for p in got[g][1]] == [p.name for p in want[g][1]]
