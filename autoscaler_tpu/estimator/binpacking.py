@@ -329,24 +329,25 @@ class BinpackingNodeEstimator:
             # bucket_terms pads S to a minimum, so "no spread" means no pod
             # DECLARES a term, not S == 0 (padded terms are inert)
             no_spread = not bool(sp.sp_of.any())
-            # VMEM pre-check for the Pallas route: the resident carry is
-            # (R + 2·TP) [M, 128] planes + the double-buffered req/bit
-            # stream (the kernel's own budget model) — workloads past the
-            # v5e budget (very many distinct terms, huge caps, wide
-            # extended-resource axes) stay on the XLA scan rather than
-            # failing Mosaic compilation mid-estimate.
+            # VMEM pre-check for the Pallas route (shared byte model —
+            # pallas_binpack_affinity.affinity_vmem_estimate): workloads
+            # past the v5e budget (very many distinct terms, huge caps,
+            # wide extended-resource axes) stay on the XLA scan rather
+            # than failing Mosaic compilation mid-estimate. chunk=256 is
+            # the kernel auto-sizer's floor configuration.
+            from autoscaler_tpu.ops.pallas_binpack_affinity import (
+                VMEM_BUDGET,
+                affinity_vmem_estimate,
+            )
+
             TP = max((terms.match.shape[0] + 31) // 32, 1)
-            R_est = req.shape[1]
-            M_lanes = scan_cap + (-scan_cap) % 128
-            vmem_est = (
-                2 * (R_est + 3 * TP) * 256 * 128
-                + (R_est + 2 * TP) * 128 * M_lanes
-                + 2 * 256 * 128
-            ) * 4 + 3 * 1024 * 1024
+            vmem_est = affinity_vmem_estimate(
+                req.shape[1], TP, scan_cap, chunk=256
+            )
             res: Optional[BinpackResult] = None
             if (
                 no_spread
-                and vmem_est <= 15 * 1024 * 1024
+                and vmem_est <= VMEM_BUDGET
                 and jax.default_backend() == "tpu"
             ):
                 # Pallas VMEM twin for the affinity-without-spread case —
@@ -389,13 +390,35 @@ class BinpackingNodeEstimator:
                     node_caps=jnp.asarray(caps),
                 )
         else:
-            res = ffd_binpack_groups(
-                jnp.asarray(req),
-                jnp.asarray(masks),
-                jnp.asarray(allocs),
-                max_nodes=scan_cap,
-                node_caps=jnp.asarray(caps),
-            )
+            res = None
+            if jax.default_backend() == "tpu":
+                # the headline VMEM kernel IS the production dispatch for
+                # the plain (non-compressing, no-affinity) case — same
+                # fallback discipline as the affinity route. (When dedup
+                # compresses, the runs path above already collapsed P to U
+                # scan steps and the XLA runs kernel wins.)
+                from autoscaler_tpu.ops.pallas_binpack import (
+                    ffd_binpack_groups_pallas,
+                )
+
+                try:
+                    res = ffd_binpack_groups_pallas(
+                        req, masks, allocs,
+                        max_nodes=scan_cap, node_caps=caps,
+                    )
+                except Exception:  # noqa: BLE001 — any kernel failure
+                    logging.getLogger("estimator").warning(
+                        "pallas binpack kernel failed; falling back to the "
+                        "XLA scan", exc_info=True,
+                    )
+            if res is None:
+                res = ffd_binpack_groups(
+                    jnp.asarray(req),
+                    jnp.asarray(masks),
+                    jnp.asarray(allocs),
+                    max_nodes=scan_cap,
+                    node_caps=jnp.asarray(caps),
+                )
         counts = np.asarray(res.node_count)
         scheds = np.asarray(res.scheduled)
         out: Dict[str, Tuple[int, List[Pod]]] = {}

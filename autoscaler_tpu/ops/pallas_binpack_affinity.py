@@ -67,6 +67,25 @@ from autoscaler_tpu.ops.binpack import BinpackResult, ffd_scores
 from autoscaler_tpu.ops.pallas_binpack import BIG_I32, _STEP_TILE, allocs_to_used
 
 
+VMEM_BUDGET = 15 * 1024 * 1024   # v5e has 16MB; leave Mosaic headroom
+
+
+def affinity_vmem_estimate(
+    R: int, TP: int, max_nodes: int, chunk: int, group_block: int = 128
+) -> int:
+    """Byte model for one grid program of the affinity kernel — the SINGLE
+    source for both the kernel's chunk auto-sizer and the estimator's
+    routing pre-check (so the gate cannot drift from the layout): Mosaic
+    double-buffers the request + bit streams and the placed output; the
+    free carry plus the 2·TP term-bit planes are revisited (resident)."""
+    M_lanes = max_nodes + (-max_nodes) % 128
+    return (
+        2 * (R + 3 * TP) * chunk * group_block   # double-buffered streams
+        + (R + 2 * TP) * group_block * M_lanes   # resident carry planes
+        + 2 * chunk * group_block                # double-buffered placed
+    ) * 4 + 3 * 1024 * 1024                      # Mosaic scratch
+
+
 def _pack_term_bits(rows: jax.Array, TP: int) -> jax.Array:
     """[T, N] bool → [TP, N] i32 bitsets (term t → bit t%32 of plane t//32)."""
     T, N = rows.shape
@@ -288,6 +307,10 @@ def ffd_binpack_groups_affinity_pallas(
     ffd_binpack_groups_pallas, with three extra sorted payload plane-groups
     carrying the pod's packed term bitsets. No SWAR/axis-compression here —
     the affinity term state, not the resource planes, dominates the step."""
+    if chunk is not None and chunk % _STEP_TILE != 0:
+        raise ValueError(
+            f"chunk must be a multiple of {_STEP_TILE} (sublane tile); got {chunk}"
+        )
     pod_req = jnp.asarray(pod_req, jnp.float32)
     pod_masks = jnp.asarray(pod_masks)
     template_allocs = jnp.asarray(template_allocs, jnp.float32)
@@ -317,19 +340,22 @@ def ffd_binpack_groups_affinity_pallas(
 
     scores = jax.vmap(lambda alloc: ffd_scores(pod_req, alloc))(template_allocs)
 
+    # inf allocs (unlimited CSI-attach virtual planes) clamp to a finite
+    # always-fits stand-in AFTER scoring, for the same reason as the plain
+    # twin (ops/pallas_binpack): the kernel carries FREE capacity, and
+    # inf - used = inf would make node_used reconstruct as NaN.
+    axis_total = jnp.sum(pod_req, axis=0)
+    big = jnp.exp2(jnp.ceil(jnp.log2(jnp.maximum(axis_total * 2.0, 2.0**23))))
+    template_allocs = jnp.where(
+        jnp.isfinite(template_allocs), template_allocs, big[None, :]
+    )
+
     if chunk is None:
-        # VMEM model as the plain kernel, with the term planes added: the
-        # resident carry grows by 2·TP [M, GB] planes + the bit stream is
-        # 3·TP more double-buffered chunk planes.
-        M_lanes = max_nodes + (-max_nodes) % 128
         chunk = 256
         for cand in (512,):
-            est = (
-                2 * (R + 3 * TP) * cand * group_block
-                + (R + 2 * TP) * group_block * M_lanes
-                + 2 * cand * group_block
-            ) * 4 + 3 * 1024 * 1024
-            if est <= 15 * 1024 * 1024:
+            if affinity_vmem_estimate(
+                R, TP, max_nodes, cand, group_block
+            ) <= VMEM_BUDGET:
                 chunk = cand
         while chunk > _STEP_TILE and chunk // 2 >= P:
             chunk //= 2

@@ -212,6 +212,7 @@ def sharded_affinity_estimate(
     node_level: jax.Array,   # [T]
     has_label: jax.Array,    # [G, T]
     spread: tuple | None = None,  # SpreadTermTensors 11-tuple (G-axis at 5..10)
+    use_pallas: bool = False,     # route the bitset-carry Pallas twin
 ):
     """Dynamic inter-pod-affinity (+hard-spread) FFD estimation sharded over
     a 1-D ``group`` mesh: each device runs the full scan carry for its group
@@ -220,8 +221,16 @@ def sharded_affinity_estimate(
     the reference's worst-case workload, FAQ.md:151-153). Term tensors and
     the shared pod matrix replicate; [G, ·] tensors (masks, allocs, caps,
     has_label, and the spread tuple's per-group static context, slots 5-10)
-    shard."""
+    shard. ``use_pallas`` dispatches each shard's scan through the
+    bitset-carry Pallas twin (ops/pallas_binpack_affinity; spread must be
+    None — the twin carries bits, not counts)."""
     from autoscaler_tpu.ops.binpack import ffd_binpack_groups_affinity
+
+    if use_pallas:
+        assert spread is None, "the Pallas affinity twin carries no spread"
+        from autoscaler_tpu.ops.pallas_binpack_affinity import (
+            ffd_binpack_groups_affinity_pallas,
+        )
 
     g_dim = mesh.shape["group"]
     G = pod_masks.shape[0]
@@ -229,6 +238,13 @@ def sharded_affinity_estimate(
 
     def body(pod_req, pod_masks, allocs, caps, match, aff_of, anti_of,
              node_level, has_label, spread_arg):
+        if use_pallas:
+            return ffd_binpack_groups_affinity_pallas(
+                pod_req, pod_masks, allocs, max_nodes=max_nodes,
+                match=match, aff_of=aff_of, anti_of=anti_of,
+                node_level=node_level, has_label=has_label,
+                node_caps=caps,
+            )
         return ffd_binpack_groups_affinity(
             pod_req, pod_masks, allocs, max_nodes=max_nodes,
             match=match, aff_of=aff_of, anti_of=anti_of,

@@ -238,3 +238,40 @@ class TestEstimatorRouting:
         for g in want:
             assert got[g][0] == want[g][0]
             assert [p.name for p in got[g][1]] == [p.name for p in want[g][1]]
+
+
+class TestEdgeGuards:
+    def test_inf_alloc_clamps_like_plain_twin(self):
+        """Unlimited CSI-attach virtual planes (+inf allocs) must keep
+        node_used finite and exact, matching the XLA twin."""
+        w = rand_world(23, P=30, G=2, T=3)
+        pod_req, masks, allocs = [x.copy() for x in w[:3]]
+        allocs = np.concatenate(
+            [allocs, np.full((len(allocs), 1), np.inf, np.float32)], axis=1
+        )
+        pod_req = np.concatenate(
+            [pod_req, np.ones((len(pod_req), 1), np.float32)], axis=1
+        )
+        assert_twin_parity(pod_req, masks, allocs, 12, *w[3:8])
+
+    def test_bad_chunk_rejected(self):
+        w = rand_world(1)
+        with pytest.raises(ValueError, match="multiple of 8"):
+            ffd_binpack_groups_affinity_pallas(
+                *w[:3], max_nodes=8,
+                match=w[3], aff_of=w[4], anti_of=w[5],
+                node_level=w[6], has_label=w[7],
+                chunk=20, interpret=True,
+            )
+
+    def test_vmem_estimate_shared_with_estimator(self):
+        """The estimator's routing gate and the kernel's auto-sizer consume
+        the same byte model."""
+        from autoscaler_tpu.ops.pallas_binpack_affinity import (
+            VMEM_BUDGET,
+            affinity_vmem_estimate,
+        )
+
+        # the north-star affinity shape fits; a 300-term monster does not
+        assert affinity_vmem_estimate(4, 2, 1000, 512) <= VMEM_BUDGET
+        assert affinity_vmem_estimate(4, 10, 1000, 256) > VMEM_BUDGET

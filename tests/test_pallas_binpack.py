@@ -241,3 +241,41 @@ class TestResultBlob:
             )
         )
         np.testing.assert_array_equal(blob[:4], [1, 0, 0, 0])
+
+
+class TestEstimatorRouting:
+    def test_estimate_many_plain_routes_to_pallas_on_tpu(self, monkeypatch):
+        """On TPU, the plain (no-affinity, non-compressing) estimate_many
+        dispatch goes through the headline Pallas kernel; results must
+        equal the XLA route. Backend spoofed + interpret pinned so the
+        route runs on the CPU test platform."""
+        import autoscaler_tpu.estimator.binpacking as bp
+        import autoscaler_tpu.ops.pallas_binpack as pb
+        from autoscaler_tpu.utils.test_utils import (
+            build_test_node,
+            build_test_pod,
+        )
+
+        # distinct owners -> singleton groups -> no runs compression
+        pods = [
+            build_test_pod(f"p{i}", cpu_m=300 + 17 * i) for i in range(9)
+        ]
+        tmpl = build_test_node("tmpl", cpu_m=4000)
+        est = bp.BinpackingNodeEstimator()
+        want = est.estimate_many(pods, {"g": tmpl})
+
+        calls = []
+        real = pb.ffd_binpack_groups_pallas
+
+        def spy(*args, **kw):
+            calls.append(1)
+            kw["interpret"] = True
+            return real(*args, **kw)
+
+        monkeypatch.setattr(pb, "ffd_binpack_groups_pallas", spy)
+        monkeypatch.setattr(bp.jax, "default_backend", lambda: "tpu")
+        got = est.estimate_many(pods, {"g": tmpl})
+        assert calls, "pallas plain route was not taken"
+        for g in want:
+            assert got[g][0] == want[g][0]
+            assert [p.name for p in got[g][1]] == [p.name for p in want[g][1]]
