@@ -326,15 +326,17 @@ class BinpackingNodeEstimator:
                 pods, [templates[g] for g in names], pad_pods=P,
                 bucket_terms=True, cluster=cluster,
             )
-            # bucket_terms pads S to a minimum, so "no spread" means no pod
-            # DECLARES a term, not S == 0 (padded terms are inert)
-            no_spread = not bool(sp.sp_of.any())
+            # bucket_terms pads S to a minimum, so "spread in play" means a
+            # pod DECLARES a term, not S > 0 (padded terms are inert)
+            has_spread = bool(sp.sp_of.any())
+            S_bucket = int(sp.sp_of.shape[0])
             # VMEM pre-check for the Pallas route (shared byte model —
             # pallas_binpack_affinity.affinity_vmem_estimate): workloads
             # past the v5e budget (very many distinct terms, huge caps,
             # wide extended-resource axes) stay on the XLA scan rather
             # than failing Mosaic compilation mid-estimate. chunk=256 is
-            # the kernel auto-sizer's floor configuration.
+            # the kernel auto-sizer's floor configuration. The spread
+            # bitset payload holds <= 32 terms.
             from autoscaler_tpu.ops.pallas_binpack_affinity import (
                 VMEM_BUDGET,
                 affinity_vmem_estimate,
@@ -342,19 +344,18 @@ class BinpackingNodeEstimator:
 
             TP = max((terms.match.shape[0] + 31) // 32, 1)
             vmem_est = affinity_vmem_estimate(
-                req.shape[1], TP, scan_cap, chunk=256
+                req.shape[1], TP, scan_cap, chunk=256,
+                S=S_bucket if has_spread else 0,
             )
             res: Optional[BinpackResult] = None
             if (
-                no_spread
+                (not has_spread or S_bucket <= 32)
                 and vmem_est <= VMEM_BUDGET
                 and jax.default_backend() == "tpu"
             ):
-                # Pallas VMEM twin for the affinity-without-spread case —
-                # the reference's documented ~1000x pain point
-                # (FAQ.md:151-153). Hard spread needs real counts
-                # (maxSkew arithmetic), which the bitset carry cannot
-                # express, so spread workloads stay on the XLA scan.
+                # Pallas VMEM twin for the reference's documented ~1000x
+                # pain point (FAQ.md:151-153): bitset term carry for the
+                # affinity gates, count planes for hard topology spread.
                 from autoscaler_tpu.ops.pallas_binpack_affinity import (
                     ffd_binpack_groups_affinity_pallas,
                 )
@@ -369,6 +370,7 @@ class BinpackingNodeEstimator:
                         node_level=terms.node_level,
                         has_label=terms.has_label,
                         node_caps=caps,
+                        spread=_spread_tuple(sp) if has_spread else None,
                     )
                 except Exception:  # noqa: BLE001 — any kernel failure
                     logging.getLogger("estimator").warning(
@@ -391,15 +393,23 @@ class BinpackingNodeEstimator:
                 )
         else:
             res = None
-            if jax.default_backend() == "tpu":
+            from autoscaler_tpu.ops.pallas_binpack import (
+                VMEM_BUDGET,
+                ffd_binpack_groups_pallas,
+                plain_vmem_estimate,
+            )
+
+            if (
+                jax.default_backend() == "tpu"
+                and plain_vmem_estimate(req.shape[1], scan_cap, chunk=512)
+                <= VMEM_BUDGET
+            ):
                 # the headline VMEM kernel IS the production dispatch for
                 # the plain (non-compressing, no-affinity) case — same
-                # fallback discipline as the affinity route. (When dedup
-                # compresses, the runs path above already collapsed P to U
-                # scan steps and the XLA runs kernel wins.)
-                from autoscaler_tpu.ops.pallas_binpack import (
-                    ffd_binpack_groups_pallas,
-                )
+                # pre-check + fallback discipline as the affinity route.
+                # (When dedup compresses, the runs path above already
+                # collapsed P to U scan steps and the XLA runs kernel
+                # wins.)
 
                 try:
                     res = ffd_binpack_groups_pallas(

@@ -73,6 +73,37 @@ from autoscaler_tpu.ops.binpack import BinpackResult, ffd_scores
 
 BIG_I32 = np.int32(2**31 - 1)
 _STEP_TILE = 8  # sublane tile: dynamic offsets must be provably 8-aligned
+VMEM_BUDGET = 15 * 1024 * 1024   # v5e has 16MB; leave Mosaic headroom
+
+
+def plain_vmem_estimate(
+    R: int, max_nodes: int, chunk: int, group_block: int = 128
+) -> int:
+    """Byte model for one grid program of the plain scan kernel — shared by
+    the chunk auto-sizer below and the estimator's routing pre-check (a
+    failed Mosaic compile is not cached, so gating beats retry-per-loop)."""
+    M_lanes = max_nodes + (-max_nodes) % 128
+    return (
+        2 * R * chunk * group_block       # double-buffered req stream
+        + R * group_block * M_lanes       # resident carry
+        + 2 * chunk * group_block         # double-buffered placed out
+    ) * 4 + 3 * 1024 * 1024               # Mosaic scratch
+
+
+def clamp_inf_allocs(pod_req, template_allocs):
+    """Replace +inf template capacities (unlimited CSI-attach virtual
+    planes, estimator/binpacking._augment_virtual) with a finite
+    always-fits stand-in. Both Pallas twins carry FREE capacity, so an inf
+    alloc makes node_used reconstruct as inf - inf = NaN; a power of two
+    >= 2x the axis's total request keeps "always fits" exact (used <= sum
+    <= BIG/2, so free >= BIG/2 >= any request) and integer-request
+    arithmetic exact in f32 for the unit-count planes this input actually
+    is. Must run AFTER scoring (ffd_scores reads the raw caps)."""
+    axis_total = jnp.sum(pod_req, axis=0)
+    big = jnp.exp2(jnp.ceil(jnp.log2(jnp.maximum(axis_total * 2.0, 2.0**23))))
+    return jnp.where(
+        jnp.isfinite(template_allocs), template_allocs, big[None, :]
+    )
 
 
 def _scan_kernel(
@@ -414,20 +445,7 @@ def ffd_binpack_groups_pallas(
 
     scores = jax.vmap(lambda alloc: ffd_scores(pod_req, alloc))(template_allocs)
 
-    # +inf allocs (documented input: unlimited CSI attach limits ride as
-    # inf-capacity virtual planes, estimator/binpacking._augment_virtual)
-    # clamp AFTER scoring to a finite always-fits stand-in: the kernel
-    # carries FREE capacity, and inf - used = inf loses the usage, making
-    # node_used reconstruct as inf - inf = NaN (the XLA scan carries used
-    # directly and stays finite). A power of two >= 2x the axis's total
-    # request keeps "always fits" exact (used <= sum <= BIG/2, so free >=
-    # BIG/2 >= any req) and integer-request arithmetic exact in f32 for
-    # the unit-count planes this input actually is.
-    axis_total = jnp.sum(pod_req, axis=0)
-    big = jnp.exp2(jnp.ceil(jnp.log2(jnp.maximum(axis_total * 2.0, 2.0**23))))
-    template_allocs = jnp.where(
-        jnp.isfinite(template_allocs), template_allocs, big[None, :]
-    )
+    template_allocs = clamp_inf_allocs(pod_req, template_allocs)
 
     # Exact resource-axis compression (AFTER scoring, which indexes CPU/MEMORY
     # positionally): an axis nobody requests can never gate a fit (0 <= free
@@ -478,16 +496,12 @@ def ffd_binpack_groups_pallas(
     # With R=4, GB=128, M=1024, chunk=1024: 2·2MB req + 2MB carry + 2·0.5MB
     # placed + ~3MB scratch ≈ 10MB — compiles and runs on a 16MB-VMEM v5e.
     if chunk is None:
-        M_lanes = max_nodes + (-max_nodes) % 128
         chunk = 512
         n_planes = len(swar_plan) if swar_plan else R_k
         for cand in (1024,):
-            est = (
-                2 * n_planes * cand * group_block  # double-buffered req stream
-                + n_planes * group_block * M_lanes  # resident carry
-                + 2 * cand * group_block           # double-buffered placed out
-            ) * 4 + 3 * 1024 * 1024                # Mosaic scratch
-            if est <= 15 * 1024 * 1024:
+            if plain_vmem_estimate(
+                n_planes, max_nodes, cand, group_block
+            ) <= VMEM_BUDGET:
                 chunk = cand
         # don't scan pure padding: a P=300 world needs one 304-slot chunk,
         # not a 1024-slot one

@@ -45,10 +45,12 @@ admitting open node, else on index `opened` when the pod may seed a fresh
 node. Parity is locked against ffd_binpack_groups_affinity (itself
 serial-oracle-locked) in tests/test_pallas_affinity.py.
 
-Spread-carrying workloads stay on the XLA scan: hard topology spread needs
-real COUNTS (maxSkew arithmetic), not bits — a count-plane variant is the
-natural extension but is not built yet (estimator routing sends spread to
-the XLA kernels).
+Hard topology spread needs real COUNTS (maxSkew arithmetic), not bits, so
+its state rides as S <= 32 i32 COUNT planes (`spc [S, M, GB]` + group
+totals) next to the affinity bitsets, with the pod's sp_of/sp_match sets
+as two more bitset payload planes — the count-plane transcription of
+ops/binpack._spread_gates (see _scan_kernel_aff's docstring). Larger term
+sets route to the XLA scan (estimator pre-check).
 
 Reference algorithm: binpacking_estimator.go:65-141 + the InterPodAffinity
 filter semantics over scan-placed pods.
@@ -64,26 +66,32 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from autoscaler_tpu.ops.binpack import BinpackResult, ffd_scores
-from autoscaler_tpu.ops.pallas_binpack import BIG_I32, _STEP_TILE, allocs_to_used
-
-
-VMEM_BUDGET = 15 * 1024 * 1024   # v5e has 16MB; leave Mosaic headroom
+from autoscaler_tpu.ops.pallas_binpack import (
+    BIG_I32,
+    VMEM_BUDGET,
+    _STEP_TILE,
+    allocs_to_used,
+    clamp_inf_allocs,
+)
 
 
 def affinity_vmem_estimate(
-    R: int, TP: int, max_nodes: int, chunk: int, group_block: int = 128
+    R: int, TP: int, max_nodes: int, chunk: int, group_block: int = 128,
+    S: int = 0,
 ) -> int:
-    """Byte model for one grid program of the affinity kernel — the SINGLE
-    source for both the kernel's chunk auto-sizer and the estimator's
-    routing pre-check (so the gate cannot drift from the layout): Mosaic
-    double-buffers the request + bit streams and the placed output; the
-    free carry plus the 2·TP term-bit planes are revisited (resident)."""
+    """Byte model for one grid program of the affinity(+spread) kernel —
+    the SINGLE source for both the kernel's chunk auto-sizer and the
+    estimator's routing pre-check (so the gate cannot drift from the
+    layout): Mosaic double-buffers the request + bit(+spread) streams and
+    the placed output; the free carry, the 2·TP term-bit planes, and the
+    S spread count planes are revisited (resident)."""
     M_lanes = max_nodes + (-max_nodes) % 128
+    sp_stream = 2 if S else 0
     return (
-        2 * (R + 3 * TP) * chunk * group_block   # double-buffered streams
-        + (R + 2 * TP) * group_block * M_lanes   # resident carry planes
-        + 2 * chunk * group_block                # double-buffered placed
-    ) * 4 + 3 * 1024 * 1024                      # Mosaic scratch
+        2 * (R + 3 * TP + sp_stream) * chunk * group_block
+        + (R + 2 * TP + S) * group_block * M_lanes
+        + 2 * chunk * group_block
+    ) * 4 + 3 * 1024 * 1024
 
 
 def _pack_term_bits(rows: jax.Array, TP: int) -> jax.Array:
@@ -102,30 +110,50 @@ def _pack_term_bits(rows: jax.Array, TP: int) -> jax.Array:
 
 
 def _scan_kernel_aff(
-    req_ref,       # [R, CHUNK, GB] f32 — sorted requests, +inf = inactive
-    mbits_ref,     # [TP, CHUNK, GB] i32 — candidate pod's match bits
-    abits_ref,     # [TP, CHUNK, GB] i32 — pod's required-affinity bits
-    xbits_ref,     # [TP, CHUNK, GB] i32 — pod's anti-affinity bits
-    caps_ref,      # [1, GB] i32
-    allocs_ref,    # [R, GB] f32
-    nl_ref,        # [TP, GB] i32 — node-level (hostname) term bitmask
-    hl_ref,        # [TP, GB] i32 — group-template-has-label bitmask
-    free_ref,      # [R, M, GB] f32 out — VMEM-resident carry
-    opened_ref,    # [1, GB] i32 out
-    pm_ref,        # [TP, M, GB] i32 out — match bits per node
-    ha_ref,        # [TP, M, GB] i32 out — anti-holder bits per node
-    pmt_ref,       # [TP, GB] i32 out — match bits anywhere in the group
-    hat_ref,       # [TP, GB] i32 out — anti-holder bits anywhere
-    placed_ref,    # [CHUNK, GB] i32 out
-    *,
+    *refs,
     num_resources: int,
     num_planes: int,
+    num_spread: int,
     chunk: int,
     max_nodes: int,
 ):
+    """Affinity (+optional hard-spread) scan step. Refs, in in_specs order:
+
+      req [R, CHUNK, GB] f32, mbits/abits/xbits [TP, CHUNK, GB] i32,
+      (spof, spmt [1, CHUNK, GB] i32 — pod spread bitsets, S <= 32,)
+      caps [1, GB] i32, allocs [R, GB] f32, nl/hl [TP, GB] i32,
+      (spstat [8, S, GB] i32 — per-(term, group) statics in the order
+       nl_s, hl_s, skew, mind, st_count, min_others_eff, st_min,
+       st_domnum,)
+      then outputs: free [R, M, GB] f32, opened [1, GB] i32,
+      pm/ha [TP, M, GB] i32, pmt/hat [TP, GB] i32,
+      (spc [S, M, GB] i32, spct [S, GB] i32,) placed [CHUNK, GB] i32.
+
+    The spread gates are the count-plane transcription of
+    ops/binpack._spread_gates: group-level terms compare
+    st_count + scan_total against the precomputed min-over-other-domains
+    (force_zero folded into min_others_eff = 0), hostname-level terms
+    recompute the masked min over OPEN nodes' scan counts each step, and
+    minDomains folds the effective min to 0 while st_domnum + opened
+    stays below it. node_ok applies to open nodes only — a fresh node is
+    its own 0-count domain and can never violate a hostname term
+    (max_skew >= 1), matching the XLA kernel's can_open composition."""
+    R, TP, S = num_resources, num_planes, num_spread
+    it = iter(refs)
+    req_ref = next(it)
+    mbits_ref, abits_ref, xbits_ref = next(it), next(it), next(it)
+    if S:
+        spof_ref, spmt_ref = next(it), next(it)
+    caps_ref, allocs_ref, nl_ref, hl_ref = next(it), next(it), next(it), next(it)
+    if S:
+        spstat_ref = next(it)
+    free_ref, opened_ref = next(it), next(it)
+    pm_ref, ha_ref, pmt_ref, hat_ref = next(it), next(it), next(it), next(it)
+    if S:
+        spc_ref, spct_ref = next(it), next(it)
+    placed_ref = next(it)
+
     gb = free_ref.shape[2]
-    R = num_resources
-    TP = num_planes
     M = free_ref.shape[1]
     node_iota = jax.lax.broadcasted_iota(jnp.int32, (M, gb), 0)
     caps = caps_ref[0, :]
@@ -142,6 +170,10 @@ def _scan_kernel_aff(
             ha_ref[tp, :, :] = jnp.zeros((M, gb), jnp.int32)
         pmt_ref[:] = jnp.zeros((TP, gb), jnp.int32)
         hat_ref[:] = jnp.zeros((TP, gb), jnp.int32)
+        if S:
+            for sp_i in range(S):
+                spc_ref[sp_i, :, :] = jnp.zeros((M, gb), jnp.int32)
+            spct_ref[:] = jnp.zeros((S, gb), jnp.int32)
 
     def tile_step(t, _):
         base = t * _STEP_TILE
@@ -149,24 +181,27 @@ def _scan_kernel_aff(
         m_tiles = [mbits_ref[tp, pl.ds(base, _STEP_TILE), :] for tp in range(TP)]
         a_tiles = [abits_ref[tp, pl.ds(base, _STEP_TILE), :] for tp in range(TP)]
         x_tiles = [xbits_ref[tp, pl.ds(base, _STEP_TILE), :] for tp in range(TP)]
+        if S:
+            spof_tile = spof_ref[0, pl.ds(base, _STEP_TILE), :]
+            spmt_tile = spmt_ref[0, pl.ds(base, _STEP_TILE), :]
         placed_rows = []
 
-        for s in range(_STEP_TILE):
-            opened = opened_ref[0, :]                   # [GB]
-            req = [req_tiles[r][s, :] for r in range(R)]
-            m_p = [m_tiles[tp][s, :] for tp in range(TP)]   # [GB] i32 each
-            a_p = [a_tiles[tp][s, :] for tp in range(TP)]
-            x_p = [x_tiles[tp][s, :] for tp in range(TP)]
+        for st in range(_STEP_TILE):
+            opened = opened_ref[0, :]
+            req = [req_tiles[r][st, :] for r in range(R)]
+            m_p = [m_tiles[tp][st, :] for tp in range(TP)]
+            a_p = [a_tiles[tp][st, :] for tp in range(TP)]
+            x_p = [x_tiles[tp][st, :] for tp in range(TP)]
 
-            fits = req[0][None, :] <= free_ref[0]       # [M, GB] capacity
+            fits = req[0][None, :] <= free_ref[0]
             for r in range(1, R):
                 fits &= req[r][None, :] <= free_ref[r]
 
             # --- bit-parallel affinity gates (module docstring algebra) ---
-            bad = None          # [M, GB] i32 — any set bit vetoes the node
-            new_viol = None     # [GB] i32 — any set bit vetoes a fresh node
+            bad = None
+            new_viol = None
             for tp in range(TP):
-                nl = nl_ref[tp, :]                      # [GB] i32 masks
+                nl = nl_ref[tp, :]
                 hl = hl_ref[tp, :]
                 pmt = pmt_ref[tp, :]
                 hat = hat_ref[tp, :]
@@ -186,19 +221,66 @@ def _scan_kernel_aff(
                 )
                 new_viol = nv if new_viol is None else (new_viol | nv)
 
-            gate_open = bad == 0                        # [M, GB]
-            new_ok = new_viol == 0                      # [GB]
+            gate_open = bad == 0
+            new_ok = new_viol == 0
             is_open = node_iota < opened[None, :]
-            gate = jnp.where(is_open, gate_open, new_ok[None, :])
+
+            # --- count-plane spread gates (_spread_gates transcription) ---
+            if S:
+                spof = spof_tile[st, :]                 # [GB] i32 bitsets
+                spmt = spmt_tile[st, :]
+                group_ok = None                         # [GB] bool
+                node_bad = None                         # [M, GB] bool
+                upds = []                               # S × [GB] i32 0/1
+                for sp_i in range(S):
+                    one = jnp.int32(1)
+                    sp_o = ((spof >> sp_i) & one) != 0      # [GB] bool
+                    self_i = (spmt >> sp_i) & one           # [GB] i32
+                    nl_s = spstat_ref[0, sp_i, :] != 0
+                    hl_s = spstat_ref[1, sp_i, :] != 0
+                    skew = spstat_ref[2, sp_i, :]
+                    mind = spstat_ref[3, sp_i, :]
+                    st_count = spstat_ref[4, sp_i, :]
+                    min_others_eff = spstat_ref[5, sp_i, :]
+                    st_min = spstat_ref[6, sp_i, :]
+                    st_domnum = spstat_ref[7, sp_i, :]
+                    upds.append((self_i != 0) & hl_s)
+                    # group-level
+                    cnt = st_count + spct_ref[sp_i, :]
+                    min_eff_z = jnp.minimum(min_others_eff, cnt)
+                    bad_z = (
+                        sp_o & ~nl_s & hl_s
+                        & (cnt + self_i - min_eff_z > skew)
+                    )
+                    group_ok = (
+                        ~bad_z if group_ok is None else (group_ok & ~bad_z)
+                    )
+                    # hostname-level: masked min over OPEN nodes' counts
+                    dyn_min = jnp.min(
+                        jnp.where(is_open, spc_ref[sp_i], BIG_I32), axis=0
+                    )                                       # [GB]
+                    domnum = st_domnum + opened
+                    min_eff_h = jnp.where(
+                        mind > domnum, 0, jnp.minimum(st_min, dyn_min)
+                    )
+                    bad_h = (
+                        sp_o[None, :] & nl_s[None, :]
+                        & (spc_ref[sp_i] + self_i[None, :]
+                           - min_eff_h[None, :] > skew[None, :])
+                    )
+                    node_bad = bad_h if node_bad is None else (node_bad | bad_h)
+                gate = jnp.where(
+                    is_open, gate_open & ~node_bad, new_ok[None, :]
+                ) & group_ok[None, :]
+            else:
+                gate = jnp.where(is_open, gate_open, new_ok[None, :])
             fits &= gate
 
-            first = jnp.min(
-                jnp.where(fits, node_iota, BIG_I32), axis=0
-            )                                           # [GB]
+            first = jnp.min(jnp.where(fits, node_iota, BIG_I32), axis=0)
             place = first < caps
             target = jnp.where(place, first, -1)
 
-            hit = node_iota == target[None, :]          # [M, GB]
+            hit = node_iota == target[None, :]
             for r in range(R):
                 sub = jnp.where(place, req[r], 0.0)[None, :]
                 free_ref[r, :, :] = free_ref[r] - jnp.where(hit, sub, 0.0)
@@ -210,6 +292,13 @@ def _scan_kernel_aff(
                 ha_ref[tp, :, :] = ha_ref[tp] | jnp.where(hit, x_add[None, :], zero)
                 pmt_ref[tp, :] = pmt_ref[tp, :] | m_add
                 hat_ref[tp, :] = hat_ref[tp, :] | x_add
+            if S:
+                for sp_i in range(S):
+                    u = jnp.where(place & upds[sp_i], jnp.int32(1), zero)
+                    spc_ref[sp_i, :, :] = spc_ref[sp_i] + jnp.where(
+                        hit, u[None, :], zero
+                    )
+                    spct_ref[sp_i, :] = spct_ref[sp_i, :] + u
             opened_ref[0, :] = jnp.maximum(
                 opened, jnp.where(place, first + 1, 0)
             )
@@ -232,6 +321,8 @@ def _pallas_scan_aff(
     caps_row,      # [1, G_pad] i32
     nl_planes,     # [TP, G_pad] i32
     hl_planes,     # [TP, G_pad] i32
+    sp_stream,     # [2, P_pad, G_pad] i32 (sp_of, sp_match bitsets) | None
+    sp_stat,       # [8, S, G_pad] i32 statics | None
     max_nodes: int,
     chunk: int,
     group_block: int,
@@ -239,51 +330,75 @@ def _pallas_scan_aff(
 ):
     R, P_pad, G_pad = stream.shape
     TP = bit_stream.shape[0] // 3
+    S = sp_stat.shape[1] if sp_stat is not None else 0
     NC = P_pad // chunk
     M_pad = max_nodes + (-max_nodes) % _STEP_TILE
     kernel = functools.partial(
         _scan_kernel_aff,
-        num_resources=R, num_planes=TP, chunk=chunk, max_nodes=max_nodes,
+        num_resources=R, num_planes=TP, num_spread=S,
+        chunk=chunk, max_nodes=max_nodes,
     )
     mb, ab, xb = (
         bit_stream[:TP], bit_stream[TP:2 * TP], bit_stream[2 * TP:]
     )
-    return pl.pallas_call(
+    chunk_spec = lambda n: pl.BlockSpec(  # noqa: E731
+        (n, chunk, group_block), lambda g, c: (0, c, g)
+    )
+    row_spec = lambda n: pl.BlockSpec(  # noqa: E731
+        (n, group_block), lambda g, c: (0, g)
+    )
+    carry_spec = lambda n: pl.BlockSpec(  # noqa: E731
+        (n, M_pad, group_block), lambda g, c: (0, 0, g)
+    )
+    in_specs = [
+        chunk_spec(R), chunk_spec(TP), chunk_spec(TP), chunk_spec(TP),
+    ]
+    operands = [stream, mb, ab, xb]
+    if S:
+        in_specs += [chunk_spec(1), chunk_spec(1)]
+        operands += [sp_stream[:1], sp_stream[1:]]
+    in_specs += [row_spec(1), row_spec(R), row_spec(TP), row_spec(TP)]
+    operands += [caps_row, allocs_in, nl_planes, hl_planes]
+    if S:
+        in_specs.append(
+            pl.BlockSpec((8, S, group_block), lambda g, c: (0, 0, g))
+        )
+        operands.append(sp_stat)
+    out_specs = [
+        carry_spec(R), row_spec(1),
+        carry_spec(TP), carry_spec(TP), row_spec(TP), row_spec(TP),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((R, M_pad, G_pad), jnp.float32),
+        jax.ShapeDtypeStruct((1, G_pad), jnp.int32),
+        jax.ShapeDtypeStruct((TP, M_pad, G_pad), jnp.int32),
+        jax.ShapeDtypeStruct((TP, M_pad, G_pad), jnp.int32),
+        jax.ShapeDtypeStruct((TP, G_pad), jnp.int32),
+        jax.ShapeDtypeStruct((TP, G_pad), jnp.int32),
+    ]
+    if S:
+        out_specs += [carry_spec(S), row_spec(S)]
+        out_shape += [
+            jax.ShapeDtypeStruct((S, M_pad, G_pad), jnp.int32),
+            jax.ShapeDtypeStruct((S, G_pad), jnp.int32),
+        ]
+    out_specs.append(
+        pl.BlockSpec((chunk, group_block), lambda g, c: (c, g))
+    )
+    out_shape.append(jax.ShapeDtypeStruct((P_pad, G_pad), jnp.int32))
+    outs = pl.pallas_call(
         kernel,
         grid=(G_pad // group_block, NC),
-        in_specs=[
-            pl.BlockSpec((R, chunk, group_block), lambda g, c: (0, c, g)),
-            pl.BlockSpec((TP, chunk, group_block), lambda g, c: (0, c, g)),
-            pl.BlockSpec((TP, chunk, group_block), lambda g, c: (0, c, g)),
-            pl.BlockSpec((TP, chunk, group_block), lambda g, c: (0, c, g)),
-            pl.BlockSpec((1, group_block), lambda g, c: (0, g)),
-            pl.BlockSpec((R, group_block), lambda g, c: (0, g)),
-            pl.BlockSpec((TP, group_block), lambda g, c: (0, g)),
-            pl.BlockSpec((TP, group_block), lambda g, c: (0, g)),
-        ],
-        out_specs=[
-            pl.BlockSpec((R, M_pad, group_block), lambda g, c: (0, 0, g)),
-            pl.BlockSpec((1, group_block), lambda g, c: (0, g)),
-            pl.BlockSpec((TP, M_pad, group_block), lambda g, c: (0, 0, g)),
-            pl.BlockSpec((TP, M_pad, group_block), lambda g, c: (0, 0, g)),
-            pl.BlockSpec((TP, group_block), lambda g, c: (0, g)),
-            pl.BlockSpec((TP, group_block), lambda g, c: (0, g)),
-            pl.BlockSpec((chunk, group_block), lambda g, c: (c, g)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((R, M_pad, G_pad), jnp.float32),
-            jax.ShapeDtypeStruct((1, G_pad), jnp.int32),
-            jax.ShapeDtypeStruct((TP, M_pad, G_pad), jnp.int32),
-            jax.ShapeDtypeStruct((TP, M_pad, G_pad), jnp.int32),
-            jax.ShapeDtypeStruct((TP, G_pad), jnp.int32),
-            jax.ShapeDtypeStruct((TP, G_pad), jnp.int32),
-            jax.ShapeDtypeStruct((P_pad, G_pad), jnp.int32),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(stream, mb, ab, xb, caps_row, allocs_in, nl_planes, hl_planes)
+    )(*operands)
+    # (free, opened, ..., placed) — callers use free, opened, placed
+    return outs[0], outs[1], outs[-1]
 
 
 def ffd_binpack_groups_affinity_pallas(
@@ -297,16 +412,19 @@ def ffd_binpack_groups_affinity_pallas(
     node_level,       # [T] bool
     has_label,        # [G, T] bool
     node_caps=None,   # [G] i32
+    spread: tuple | None = None,  # SpreadTermTensors 11-tuple (ops/binpack)
     chunk: int | None = None,
     group_block: int = 0,
     interpret: bool | None = None,
 ) -> BinpackResult:
-    """Drop-in twin of ffd_binpack_groups_affinity (no spread) in Pallas.
+    """Drop-in twin of ffd_binpack_groups_affinity in Pallas, incl. the
+    optional hard-topology-spread gates (count-plane carry; S <= 32).
 
     Same payload-sort / fused-grid / unsort structure as
     ffd_binpack_groups_pallas, with three extra sorted payload plane-groups
-    carrying the pod's packed term bitsets. No SWAR/axis-compression here —
-    the affinity term state, not the resource planes, dominates the step."""
+    carrying the pod's packed term bitsets (plus two spread bitset planes
+    when spread terms exist). No SWAR/axis-compression here — the term
+    state, not the resource planes, dominates the step."""
     if chunk is not None and chunk % _STEP_TILE != 0:
         raise ValueError(
             f"chunk must be a multiple of {_STEP_TILE} (sublane tile); got {chunk}"
@@ -340,21 +458,14 @@ def ffd_binpack_groups_affinity_pallas(
 
     scores = jax.vmap(lambda alloc: ffd_scores(pod_req, alloc))(template_allocs)
 
-    # inf allocs (unlimited CSI-attach virtual planes) clamp to a finite
-    # always-fits stand-in AFTER scoring, for the same reason as the plain
-    # twin (ops/pallas_binpack): the kernel carries FREE capacity, and
-    # inf - used = inf would make node_used reconstruct as NaN.
-    axis_total = jnp.sum(pod_req, axis=0)
-    big = jnp.exp2(jnp.ceil(jnp.log2(jnp.maximum(axis_total * 2.0, 2.0**23))))
-    template_allocs = jnp.where(
-        jnp.isfinite(template_allocs), template_allocs, big[None, :]
-    )
+    template_allocs = clamp_inf_allocs(pod_req, template_allocs)
 
+    S_terms = spread[0].shape[1] if spread is not None else 0
     if chunk is None:
         chunk = 256
         for cand in (512,):
             if affinity_vmem_estimate(
-                R, TP, max_nodes, cand, group_block
+                R, TP, max_nodes, cand, group_block, S=S_terms
             ) <= VMEM_BUDGET:
                 chunk = cand
         while chunk > _STEP_TILE and chunk // 2 >= P:
@@ -371,6 +482,45 @@ def ffd_binpack_groups_affinity_pallas(
     hl_planes = _pack_term_bits(has_label.T, TP)                       # [TP, G_pad]
     nl_planes = jnp.broadcast_to(nl_plane[:, None], (TP, G_pad))
 
+    # optional spread state: pod bitset payloads + per-(term, group) statics
+    sp_stat = None
+    sp_of_col = sp_match_col = None
+    if spread is not None:
+        (sp_of_T, sp_match_T, sp_nl, sp_skew, sp_mind, sp_hl, sp_stc,
+         sp_mino, sp_stmin, sp_stdom, sp_fz) = spread
+        S = sp_of_T.shape[1]
+        if S > 32:
+            raise ValueError(
+                f"spread bitset payload holds at most 32 terms; got {S} "
+                "(route larger term sets to the XLA scan)"
+            )
+        sp_of_col = _pack_term_bits(jnp.asarray(sp_of_T).T.astype(bool), 1)[0]
+        sp_match_col = _pack_term_bits(
+            jnp.asarray(sp_match_T).T.astype(bool), 1
+        )[0]                                                           # [P]
+        g_extra = G_pad - jnp.asarray(sp_hl).shape[0]
+
+        def _gpad(a):
+            a = jnp.asarray(a, jnp.int32)
+            return jnp.pad(a, ((0, g_extra), (0, 0))).T               # [S, G_pad]
+
+        def _bcast(a):
+            return jnp.broadcast_to(
+                jnp.asarray(a, jnp.int32)[:, None], (S, G_pad)
+            )
+
+        # force_zero folds into the group-level min: min(0, cnt) == 0
+        mino_eff = jnp.where(
+            jnp.asarray(sp_fz, bool), 0, jnp.asarray(sp_mino, jnp.int32)
+        )
+        sp_stat = jnp.stack([
+            _bcast(jnp.asarray(sp_nl, bool).astype(jnp.int32)),
+            _gpad(jnp.asarray(sp_hl, bool).astype(jnp.int32)),
+            _bcast(sp_skew), _bcast(sp_mind),
+            _gpad(sp_stc), _gpad(mino_eff), _gpad(sp_stmin),
+            _gpad(sp_stdom),
+        ])                                                            # [8, S, G_pad]
+
     iota = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :], (G_pad, P))
     cols = [
         jnp.where(
@@ -385,6 +535,11 @@ def ffd_binpack_groups_affinity_pallas(
         for planes in (mbits, abits, xbits)
         for b in planes
     ]
+    if spread is not None:
+        bit_cols += [
+            jnp.broadcast_to(sp_of_col[None, :], (G_pad, P)),
+            jnp.broadcast_to(sp_match_col[None, :], (G_pad, P)),
+        ]
     sorted_ops = jax.lax.sort(
         [-scores, iota, *cols, *bit_cols],
         dimension=1, is_stable=True, num_keys=1,
@@ -396,16 +551,25 @@ def ffd_binpack_groups_affinity_pallas(
             for c in sorted_ops[2:2 + R]
         ]
     )
+    bit_end = 2 + R + 3 * TP
     bit_stream = jnp.stack(
         [
             jnp.pad(c, ((0, 0), (0, pad_cols))).T
-            for c in sorted_ops[2 + R:]
+            for c in sorted_ops[2 + R:bit_end]
         ]
     )
+    sp_stream = None
+    if spread is not None:
+        sp_stream = jnp.stack(
+            [
+                jnp.pad(c, ((0, 0), (0, pad_cols))).T
+                for c in sorted_ops[bit_end:]
+            ]
+        )
 
-    free, opened, _pm, _ha, _pmt, _hat, placed = _pallas_scan_aff(
+    free, opened, placed = _pallas_scan_aff(
         stream, bit_stream, template_allocs.T, caps,
-        nl_planes, hl_planes,
+        nl_planes, hl_planes, sp_stream, sp_stat,
         max_nodes=max_nodes, chunk=chunk, group_block=group_block,
         interpret=interpret,
     )

@@ -222,12 +222,11 @@ def sharded_affinity_estimate(
     the shared pod matrix replicate; [G, ·] tensors (masks, allocs, caps,
     has_label, and the spread tuple's per-group static context, slots 5-10)
     shard. ``use_pallas`` dispatches each shard's scan through the
-    bitset-carry Pallas twin (ops/pallas_binpack_affinity; spread must be
-    None — the twin carries bits, not counts)."""
+    Pallas twin (ops/pallas_binpack_affinity: bitset affinity carry +
+    count-plane spread)."""
     from autoscaler_tpu.ops.binpack import ffd_binpack_groups_affinity
 
     if use_pallas:
-        assert spread is None, "the Pallas affinity twin carries no spread"
         from autoscaler_tpu.ops.pallas_binpack_affinity import (
             ffd_binpack_groups_affinity_pallas,
         )
@@ -243,7 +242,7 @@ def sharded_affinity_estimate(
                 pod_req, pod_masks, allocs, max_nodes=max_nodes,
                 match=match, aff_of=aff_of, anti_of=anti_of,
                 node_level=node_level, has_label=has_label,
-                node_caps=caps,
+                node_caps=caps, spread=spread_arg,
             )
         return ffd_binpack_groups_affinity(
             pod_req, pod_masks, allocs, max_nodes=max_nodes,

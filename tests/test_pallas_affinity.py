@@ -275,3 +275,173 @@ class TestEdgeGuards:
         # the north-star affinity shape fits; a 300-term monster does not
         assert affinity_vmem_estimate(4, 2, 1000, 512) <= VMEM_BUDGET
         assert affinity_vmem_estimate(4, 10, 1000, 256) > VMEM_BUDGET
+
+
+class TestSpreadParity:
+    """Count-plane spread gates vs the XLA kernel (itself locked to the
+    serial spread oracle in tests/test_spread_binpack.py)."""
+
+    def _parity(self, kw, spread):
+        ref = ffd_binpack_groups_affinity(
+            jnp.asarray(kw["pod_req"]), jnp.asarray(kw["pod_masks"]),
+            jnp.asarray(kw["template_allocs"]),
+            max_nodes=kw["max_nodes"],
+            match=jnp.asarray(kw["match"]), aff_of=jnp.asarray(kw["aff_of"]),
+            anti_of=jnp.asarray(kw["anti_of"]),
+            node_level=jnp.asarray(kw["node_level"]),
+            has_label=jnp.asarray(kw["has_label"]),
+            node_caps=jnp.asarray(kw["node_caps"]), spread=spread,
+        )
+        out = ffd_binpack_groups_affinity_pallas(
+            kw["pod_req"], kw["pod_masks"], kw["template_allocs"],
+            max_nodes=kw["max_nodes"],
+            match=kw["match"], aff_of=kw["aff_of"], anti_of=kw["anti_of"],
+            node_level=kw["node_level"], has_label=kw["has_label"],
+            node_caps=kw["node_caps"], spread=spread, interpret=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.node_count), np.asarray(out.node_count)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.scheduled), np.asarray(out.scheduled)
+        )
+        return ref
+
+    def test_zone_spread_world(self):
+        from autoscaler_tpu.utils.sharded_worlds import spread_world
+
+        kw, spread = spread_world(4, 24, 12)
+        kw = dict(kw, max_nodes=12)
+        ref = self._parity(kw, spread)
+        # the gate actually bit: not everything schedules
+        assert not np.asarray(ref.scheduled).all()
+
+    def test_hostname_spread_world(self):
+        """Hostname-level constraints: each opened node is its own domain;
+        the dynamic min over open nodes gates placement."""
+        from autoscaler_tpu.estimator.binpacking import _spread_tuple
+        from autoscaler_tpu.kube.objects import (
+            LabelSelector,
+            TopologySpreadConstraint,
+        )
+        from autoscaler_tpu.snapshot.affinity import build_spread_terms
+        from autoscaler_tpu.utils.test_utils import (
+            build_test_node,
+            build_test_pod,
+        )
+
+        HOST = "kubernetes.io/hostname"
+        constraint = TopologySpreadConstraint(
+            max_skew=1, topology_key=HOST,
+            selector=LabelSelector.from_dict({"app": "web"}),
+            when_unsatisfiable="DoNotSchedule",
+        )
+        P, G, M = 12, 2, 8
+        pods = []
+        for i in range(P):
+            p = build_test_pod(f"p{i}", cpu_m=100, labels={"app": "web"})
+            p.topology_spread = (constraint,)
+            pods.append(p)
+        templates = [build_test_node(f"t{g}", cpu_m=4000) for g in range(G)]
+        sp = build_spread_terms(pods, templates, pad_pods=P, bucket_terms=True)
+        pod_req = np.zeros((P, 6), np.float32)
+        pod_req[:, 0] = 100.0
+        pod_req[:, 5] = 1.0
+        allocs = np.zeros((G, 6), np.float32)
+        allocs[:, 0] = 4000.0
+        # pods-capacity 3 forces multiple OPEN nodes; once several domains
+        # exist, the dynamic min makes the skew gate redirect placements
+        # off fuller nodes (a single open node can never violate skew=1)
+        allocs[:, 5] = 3.0
+        T = 4
+        kw = dict(
+            pod_req=pod_req, pod_masks=np.ones((G, P), bool),
+            template_allocs=allocs, max_nodes=M,
+            match=np.zeros((T, P), bool), aff_of=np.zeros((T, P), bool),
+            anti_of=np.zeros((T, P), bool), node_level=np.zeros(T, bool),
+            has_label=np.zeros((G, T), bool),
+            node_caps=np.full(G, M, np.int32),
+        )
+        ref = self._parity(kw, _spread_tuple(sp))
+        # 12 pods at 3-per-node capacity: 4 nodes, spread-balanced
+        assert int(np.asarray(ref.node_count)[0]) == 4
+
+    def test_spread_with_affinity_combined(self):
+        """Both gate families active in one scan."""
+        from autoscaler_tpu.utils.sharded_worlds import spread_world
+
+        kw, spread = spread_world(2, 20, 10)
+        kw = dict(kw, max_nodes=10)
+        rng = np.random.default_rng(5)
+        P = kw["pod_req"].shape[0]
+        T = 3
+        match = rng.random((T, P)) < 0.4
+        kw["match"] = match
+        kw["aff_of"] = (rng.random((T, P)) < 0.2) & match
+        kw["anti_of"] = (rng.random((T, P)) < 0.2) & ~kw["aff_of"]
+        kw["node_level"] = rng.random(T) < 0.5
+        kw["has_label"] = np.ones((2, T), bool)
+        self._parity(kw, spread)
+
+    def test_too_many_spread_terms_rejected(self):
+        from autoscaler_tpu.utils.sharded_worlds import spread_world
+
+        kw, spread = spread_world(2, 8, 6)
+        wide = tuple(
+            np.zeros((8, 40), bool) if i in (0, 1) else v
+            for i, v in enumerate(spread)
+        )
+        with pytest.raises(ValueError, match="at most 32"):
+            ffd_binpack_groups_affinity_pallas(
+                kw["pod_req"], kw["pod_masks"], kw["template_allocs"],
+                max_nodes=6,
+                match=kw["match"], aff_of=kw["aff_of"],
+                anti_of=kw["anti_of"], node_level=kw["node_level"],
+                has_label=kw["has_label"], spread=wide, interpret=True,
+            )
+
+
+class TestEstimatorSpreadRouting:
+    def test_spread_workload_routes_to_pallas_on_tpu(self, monkeypatch):
+        """Hard-spread pending pods now take the Pallas twin too (count
+        planes), matching the XLA route's results."""
+        import autoscaler_tpu.estimator.binpacking as bp
+        import autoscaler_tpu.ops.pallas_binpack_affinity as pba
+        from autoscaler_tpu.kube.objects import (
+            LabelSelector,
+            TopologySpreadConstraint,
+        )
+        from autoscaler_tpu.utils.test_utils import (
+            build_test_node,
+            build_test_pod,
+        )
+
+        constraint = TopologySpreadConstraint(
+            max_skew=1, topology_key="kubernetes.io/hostname",
+            selector=LabelSelector.from_dict({"app": "web"}),
+            when_unsatisfiable="DoNotSchedule",
+        )
+        pods = []
+        for i in range(10):
+            p = build_test_pod(f"p{i}", cpu_m=200, labels={"app": "web"})
+            p.topology_spread = (constraint,)
+            pods.append(p)
+        tmpl = build_test_node("tmpl", cpu_m=4000)
+        est = bp.BinpackingNodeEstimator()
+        want = est.estimate_many(pods, {"g": tmpl})
+
+        calls = []
+        real = pba.ffd_binpack_groups_affinity_pallas
+
+        def spy(*args, **kw):
+            calls.append(kw.get("spread") is not None)
+            kw["interpret"] = True
+            return real(*args, **kw)
+
+        monkeypatch.setattr(pba, "ffd_binpack_groups_affinity_pallas", spy)
+        monkeypatch.setattr(bp.jax, "default_backend", lambda: "tpu")
+        got = est.estimate_many(pods, {"g": tmpl})
+        assert calls and calls[0], "pallas spread route was not taken"
+        for g in want:
+            assert got[g][0] == want[g][0]
+            assert [p.name for p in got[g][1]] == [p.name for p in want[g][1]]
