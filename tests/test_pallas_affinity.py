@@ -445,3 +445,84 @@ class TestEstimatorSpreadRouting:
         for g in want:
             assert got[g][0] == want[g][0]
             assert [p.name for p in got[g][1]] == [p.name for p in want[g][1]]
+
+
+class TestSpreadMinDomains:
+    def test_min_domains_force_zero_fold(self):
+        """minDomains > available domains treats the global min as 0
+        (filtering.go:53). The Pallas kernel folds force_zero into
+        min_others_eff = 0 (min(0, cnt) == 0 for counts >= 0) — pin that
+        fold against the XLA kernel on a world where it changes the
+        outcome."""
+        from autoscaler_tpu.estimator.binpacking import _spread_tuple
+        from autoscaler_tpu.kube.objects import (
+            LabelSelector,
+            TopologySpreadConstraint,
+        )
+        from autoscaler_tpu.snapshot.affinity import build_spread_terms
+        from autoscaler_tpu.utils.test_utils import (
+            build_test_node,
+            build_test_pod,
+        )
+
+        ZONE = "topology.kubernetes.io/zone"
+        constraint = TopologySpreadConstraint(
+            max_skew=1, topology_key=ZONE,
+            selector=LabelSelector.from_dict({"app": "web"}),
+            when_unsatisfiable="DoNotSchedule", min_domains=3,
+        )
+        P, G, M = 8, 2, 6
+        pods = []
+        for i in range(P):
+            p = build_test_pod(f"p{i}", cpu_m=100, labels={"app": "web"})
+            p.topology_spread = (constraint,)
+            pods.append(p)
+        templates = []
+        for g in range(G):
+            t = build_test_node(f"t{g}", cpu_m=4000)
+            t.labels[ZONE] = f"zone-{g}"
+            pods_list = pods
+            templates.append(t)
+        sp = build_spread_terms(pods, templates, pad_pods=P, bucket_terms=True)
+        pod_req = np.zeros((P, 6), np.float32)
+        pod_req[:, 0] = 100.0
+        pod_req[:, 5] = 1.0
+        allocs = np.zeros((G, 6), np.float32)
+        allocs[:, 0] = 4000.0
+        allocs[:, 5] = 110.0
+        T = 4
+        kw = dict(
+            pod_req=pod_req, pod_masks=np.ones((G, P), bool),
+            template_allocs=allocs,
+            match=np.zeros((T, P), bool), aff_of=np.zeros((T, P), bool),
+            anti_of=np.zeros((T, P), bool), node_level=np.zeros(T, bool),
+            has_label=np.zeros((G, T), bool),
+            node_caps=np.full(G, M, np.int32),
+        )
+        spread = _spread_tuple(sp)
+        ref = ffd_binpack_groups_affinity(
+            jnp.asarray(kw["pod_req"]), jnp.asarray(kw["pod_masks"]),
+            jnp.asarray(kw["template_allocs"]), max_nodes=M,
+            match=jnp.asarray(kw["match"]), aff_of=jnp.asarray(kw["aff_of"]),
+            anti_of=jnp.asarray(kw["anti_of"]),
+            node_level=jnp.asarray(kw["node_level"]),
+            has_label=jnp.asarray(kw["has_label"]),
+            node_caps=jnp.asarray(kw["node_caps"]), spread=spread,
+        )
+        out = ffd_binpack_groups_affinity_pallas(
+            kw["pod_req"], kw["pod_masks"], kw["template_allocs"],
+            max_nodes=M,
+            match=kw["match"], aff_of=kw["aff_of"], anti_of=kw["anti_of"],
+            node_level=kw["node_level"], has_label=kw["has_label"],
+            node_caps=kw["node_caps"], spread=spread, interpret=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.node_count), np.asarray(out.node_count)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.scheduled), np.asarray(out.scheduled)
+        )
+        # minDomains=3 over a single-zone group: the effective min is 0,
+        # so only maxSkew pods place per group (the gate genuinely bit)
+        assert int(np.asarray(ref.node_count).max()) >= 1
+        assert not np.asarray(ref.scheduled).all()
