@@ -111,3 +111,53 @@ def test_differential_fuzz(case):
                                   err_msg=f"case {case} pallas count")
     np.testing.assert_array_equal(np.asarray(pal.scheduled), sched,
                                   err_msg=f"case {case} pallas scheduled")
+
+
+def random_terms(rng, P, G, T):
+    match = rng.random((T, P)) < rng.uniform(0.1, 0.6)
+    aff_of = (rng.random((T, P)) < 0.2) & match
+    anti_of = (rng.random((T, P)) < 0.2) & ~aff_of
+    node_level = rng.random(T) < 0.5
+    has_label = rng.random((G, T)) < rng.uniform(0.5, 1.0)
+    return match, aff_of, anti_of, node_level, has_label
+
+
+@pytest.mark.parametrize("case", range(12))
+def test_differential_fuzz_affinity_pallas(case):
+    """Randomized degenerate worlds through the XLA affinity scan vs the
+    Pallas bitset-carry twin (interpret mode) — exact agreement. The XLA
+    scan is itself oracle-locked, so this chains to the serial reference."""
+    from autoscaler_tpu.ops.binpack import ffd_binpack_groups_affinity
+    from autoscaler_tpu.ops.pallas_binpack_affinity import (
+        ffd_binpack_groups_affinity_pallas,
+    )
+
+    rng = np.random.default_rng(7000 + case)
+    P = int(rng.choice([1, 9, 40, 130]))
+    G = int(rng.choice([1, 3, 9]))
+    T = int(rng.choice([1, 5, 34]))       # incl. multi-plane bitsets
+    pod_req, masks, allocs, caps = random_world(rng, P, G)
+    match, aff_of, anti_of, node_level, has_label = random_terms(rng, P, G, T)
+    max_nodes = 24
+
+    ref = ffd_binpack_groups_affinity(
+        jnp.asarray(pod_req), jnp.asarray(masks), jnp.asarray(allocs),
+        max_nodes=max_nodes, match=jnp.asarray(match),
+        aff_of=jnp.asarray(aff_of), anti_of=jnp.asarray(anti_of),
+        node_level=jnp.asarray(node_level), has_label=jnp.asarray(has_label),
+        node_caps=jnp.asarray(caps),
+    )
+    out = ffd_binpack_groups_affinity_pallas(
+        pod_req, masks, allocs, max_nodes=max_nodes,
+        match=match, aff_of=aff_of, anti_of=anti_of,
+        node_level=node_level, has_label=has_label, node_caps=caps,
+        chunk=32, interpret=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref.node_count), np.asarray(out.node_count),
+        err_msg=f"case {case} count",
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref.scheduled), np.asarray(out.scheduled),
+        err_msg=f"case {case} scheduled",
+    )

@@ -481,7 +481,6 @@ class TestSpreadMinDomains:
         for g in range(G):
             t = build_test_node(f"t{g}", cpu_m=4000)
             t.labels[ZONE] = f"zone-{g}"
-            pods_list = pods
             templates.append(t)
         sp = build_spread_terms(pods, templates, pad_pods=P, bucket_terms=True)
         pod_req = np.zeros((P, 6), np.float32)
