@@ -97,15 +97,46 @@ class ScenarioStrategy(Strategy):
                 spot_available = rng.random() > self.preemption_prob
                 prices[s, gi] = base * (self.spot_discount if spot_available else 1.0)
 
-        res = whatif_best_options(
-            mesh,
-            jnp.asarray(pod_req),
-            jnp.asarray(masks),
-            jnp.asarray(allocs),
-            jnp.asarray(prices),
-            jnp.asarray(caps),
-            max_nodes=self.max_nodes,
-        )
+        # On TPU the per-shard scan dispatches through the Pallas VMEM
+        # kernel (the certified sharded configuration — parallel/mesh.py /
+        # dryrun_multichip); any kernel failure falls back to the XLA scan.
+        import jax
+
+        res = None
+        if jax.default_backend() == "tpu":
+            from autoscaler_tpu.ops.pallas_binpack import (
+                ffd_binpack_groups_pallas,
+            )
+
+            try:
+                res = whatif_best_options(
+                    mesh,
+                    jnp.asarray(pod_req),
+                    jnp.asarray(masks),
+                    jnp.asarray(allocs),
+                    jnp.asarray(prices),
+                    jnp.asarray(caps),
+                    max_nodes=self.max_nodes,
+                    binpack_fn=ffd_binpack_groups_pallas,
+                    scenario_loop=True,
+                )
+            except Exception:  # noqa: BLE001
+                import logging
+
+                logging.getLogger("expander").warning(
+                    "pallas what-if dispatch failed; falling back to the "
+                    "XLA scan", exc_info=True,
+                )
+        if res is None:
+            res = whatif_best_options(
+                mesh,
+                jnp.asarray(pod_req),
+                jnp.asarray(masks),
+                jnp.asarray(allocs),
+                jnp.asarray(prices),
+                jnp.asarray(caps),
+                max_nodes=self.max_nodes,
+            )
         best = np.asarray(res.best_group)
         best = best[best < G]  # drop padded winners (shouldn't happen)
         if best.size == 0:

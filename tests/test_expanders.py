@@ -420,3 +420,43 @@ class TestConfigMapPriority:
         api.add_pod(build_test_pod("p1", cpu_m=3000, mem=GB))
         a.run_once(now_ts=700.0)
         assert provider._groups["beta"].target_size() >= 1
+
+
+class TestScenarioPallasRoute:
+    def test_tpu_routes_whatif_through_pallas(self, monkeypatch):
+        """On a TPU backend the what-if dispatch uses the Pallas kernel
+        (scenario_loop under shard_map — the dryrun-certified config);
+        the winner must match the XLA route."""
+        import autoscaler_tpu.ops.pallas_binpack as pb
+
+        p = provider_with_groups()
+        opts = options_for(p)
+        strat = ScenarioStrategy(
+            base_prices={"cheap-pool": 0.5, "pricey-pool": 5.0},
+            num_scenarios=8,
+            seed=3,
+        )
+        want = strat.best_option(opts).node_group.id()
+
+        calls = []
+        real = pb.ffd_binpack_groups_pallas
+
+        def spy(*args, **kw):
+            calls.append(1)
+            return real(*args, **kw)
+
+        monkeypatch.setattr(pb, "ffd_binpack_groups_pallas", spy)
+        import jax as _jax
+
+        monkeypatch.setattr(_jax, "default_backend", lambda: "tpu",
+                            raising=True)
+        # under the spoofed backend the kernel's interpret default would
+        # pick Mosaic on CPU; the tracer path inside shard_map asks the
+        # backend too — pin interpret by wrapping
+        monkeypatch.setattr(
+            pb, "ffd_binpack_groups_pallas",
+            lambda *a, **k: spy(*a, **{**k, "interpret": True}),
+        )
+        got = strat.best_option(opts).node_group.id()
+        assert calls, "pallas what-if route was not taken"
+        assert got == want
