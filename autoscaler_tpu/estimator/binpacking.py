@@ -372,7 +372,11 @@ class BinpackingNodeEstimator:
                         node_caps=caps,
                         spread=_spread_tuple(sp) if has_spread else None,
                     )
+                    # async TPU execution: force a host fetch inside the
+                    # try so runtime kernel faults hit the fallback
+                    np.asarray(res.node_count)
                 except Exception:  # noqa: BLE001 — any kernel failure
+                    res = None
                     logging.getLogger("estimator").warning(
                         "pallas affinity kernel failed; falling back to the "
                         "XLA scan", exc_info=True,
@@ -416,7 +420,11 @@ class BinpackingNodeEstimator:
                         req, masks, allocs,
                         max_nodes=scan_cap, node_caps=caps,
                     )
+                    # async TPU execution: force a host fetch inside the
+                    # try so runtime kernel faults hit the fallback
+                    np.asarray(res.node_count)
                 except Exception:  # noqa: BLE001 — any kernel failure
+                    res = None
                     logging.getLogger("estimator").warning(
                         "pallas binpack kernel failed; falling back to the "
                         "XLA scan", exc_info=True,

@@ -102,12 +102,19 @@ class ScenarioStrategy(Strategy):
         # dryrun_multichip); any kernel failure falls back to the XLA scan.
         import jax
 
-        res = None
-        if jax.default_backend() == "tpu":
-            from autoscaler_tpu.ops.pallas_binpack import (
-                ffd_binpack_groups_pallas,
-            )
+        from autoscaler_tpu.ops.pallas_binpack import (
+            VMEM_BUDGET,
+            ffd_binpack_groups_pallas,
+            plain_vmem_estimate,
+        )
 
+        res = None
+        if (
+            jax.default_backend() == "tpu"
+            and plain_vmem_estimate(
+                pod_req.shape[1], self.max_nodes, chunk=512
+            ) <= VMEM_BUDGET
+        ):
             try:
                 res = whatif_best_options(
                     mesh,
@@ -120,9 +127,14 @@ class ScenarioStrategy(Strategy):
                     binpack_fn=ffd_binpack_groups_pallas,
                     scenario_loop=True,
                 )
+                # materialize INSIDE the try: TPU execution is async, so a
+                # runtime kernel fault surfaces at the first host fetch —
+                # outside this block it would defeat the fallback contract
+                np.asarray(res.best_group)
             except Exception:  # noqa: BLE001
                 import logging
 
+                res = None
                 logging.getLogger("expander").warning(
                     "pallas what-if dispatch failed; falling back to the "
                     "XLA scan", exc_info=True,

@@ -443,6 +443,9 @@ class TestScenarioPallasRoute:
 
         def spy(*args, **kw):
             calls.append(1)
+            # pin interpret: under the spoofed backend the kernel's default
+            # would pick Mosaic on CPU
+            kw["interpret"] = True
             return real(*args, **kw)
 
         monkeypatch.setattr(pb, "ffd_binpack_groups_pallas", spy)
@@ -450,13 +453,21 @@ class TestScenarioPallasRoute:
 
         monkeypatch.setattr(_jax, "default_backend", lambda: "tpu",
                             raising=True)
-        # under the spoofed backend the kernel's interpret default would
-        # pick Mosaic on CPU; the tracer path inside shard_map asks the
-        # backend too — pin interpret by wrapping
-        monkeypatch.setattr(
-            pb, "ffd_binpack_groups_pallas",
-            lambda *a, **k: spy(*a, **{**k, "interpret": True}),
-        )
-        got = strat.best_option(opts).node_group.id()
+        import logging
+
+        records = []
+
+        class _Grab(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        h = _Grab()
+        logging.getLogger("expander").addHandler(h)
+        try:
+            got = strat.best_option(opts).node_group.id()
+        finally:
+            logging.getLogger("expander").removeHandler(h)
         assert calls, "pallas what-if route was not taken"
+        # a silent fallback would make this test pass with a broken kernel
+        assert not records, f"pallas route fell back: {records[0].getMessage()}"
         assert got == want
