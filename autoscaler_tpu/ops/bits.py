@@ -37,18 +37,51 @@ def unpack_bool_bits(packed: np.ndarray, length: int) -> np.ndarray:
     return flat[..., :length].astype(bool)
 
 
+_count_byte_order_ok = False
+
+
+def _check_count_byte_order() -> None:
+    """One-per-process runtime proof that bitcast_convert_type(int32→uint8)
+    yields little-endian bytes on the ACTIVE backend, so unpack_result_blob's
+    '<i4' view is sound. The byte order of bitcast is backend-defined; the
+    contract test only covers CPU, so a sentinel round-trip guards the real
+    device path (advisor r4)."""
+    global _count_byte_order_ok
+    if _count_byte_order_ok:
+        return
+    sentinel = jax.lax.bitcast_convert_type(
+        jnp.asarray([0x01020304], jnp.int32), jnp.uint8
+    )
+    got = list(np.asarray(sentinel)[0])
+    if got != [0x04, 0x03, 0x02, 0x01]:
+        raise AssertionError(
+            "bitcast_convert_type(int32->uint8) is not little-endian on "
+            f"backend {jax.default_backend()!r} (sentinel bytes {got}); "
+            "unpack_result_blob's '<i4' decode would corrupt counts"
+        )
+    _count_byte_order_ok = True
+
+
 @jax.jit
+def _pack_result_blob_impl(node_count: jax.Array, scheduled: jax.Array) -> jax.Array:
+    cnt_bytes = jax.lax.bitcast_convert_type(
+        node_count.astype(jnp.int32), jnp.uint8
+    )                                                    # [G, 4] LE (checked)
+    packed = pack_bool_bits(scheduled)                   # [G, B] u8
+    return jnp.concatenate([cnt_bytes.ravel(), packed.ravel()])
+
+
 def pack_result_blob(node_count: jax.Array, scheduled: jax.Array) -> jax.Array:
     """Fuse an estimator result (counts [G] i32 + scheduled [G, P] bool) into
     ONE flat uint8 buffer: [G*4 little-endian count bytes][G*ceil(P/8)
     packed bits]. One buffer = one host fetch = one tunnel round-trip — a
     separate counts fetch costs a full RTT (~50-150ms over a remoted
-    backend), comparable to shipping the whole bit plane."""
-    cnt_bytes = jax.lax.bitcast_convert_type(
-        node_count.astype(jnp.int32), jnp.uint8
-    )                                                    # [G, 4] LE on TPU
-    packed = pack_bool_bits(scheduled)                   # [G, B] u8
-    return jnp.concatenate([cnt_bytes.ravel(), packed.ravel()])
+    backend), comparable to shipping the whole bit plane.
+
+    The first call per process proves the backend's bitcast byte order with
+    a sentinel (raises if not LE) — see _check_count_byte_order."""
+    _check_count_byte_order()
+    return _pack_result_blob_impl(node_count, scheduled)
 
 
 def unpack_result_blob(buf: np.ndarray, G: int, P: int):

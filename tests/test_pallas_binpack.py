@@ -242,6 +242,16 @@ class TestResultBlob:
         )
         np.testing.assert_array_equal(blob[:4], [1, 0, 0, 0])
 
+    def test_runtime_byte_order_sentinel(self):
+        """pack_result_blob proves the ACTIVE backend's bitcast byte order
+        once per process (advisor r4: the '<i4' host decode was only ever
+        contract-tested on CPU)."""
+        from autoscaler_tpu.ops import bits
+
+        bits._count_byte_order_ok = False
+        bits.pack_result_blob(jnp.asarray([7], jnp.int32), jnp.ones((1, 8), bool))
+        assert bits._count_byte_order_ok
+
 
 class TestEstimatorRouting:
     def test_estimate_many_plain_routes_to_pallas_on_tpu(self, monkeypatch):
