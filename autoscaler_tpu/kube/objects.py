@@ -48,6 +48,11 @@ SAFE_TO_EVICT_LOCAL_VOLUMES_ANNOTATION = (
     "cluster-autoscaler.kubernetes.io/safe-to-evict-local-volumes"
 )
 
+# Pseudo-resource namespace for the minimal DRA ResourceClaim model: a claim
+# of device class <c> becomes the counted extended resource
+# "dra.k8s.io/<c>" (Pod.resource_claims folds in at construction).
+DRA_CLAIM_PREFIX = "dra.k8s.io/"
+
 
 @dataclass(frozen=True)
 class Resources:
@@ -331,6 +336,33 @@ class Pod:
     # status.phase ("Running"/"Pending"/...); "" when unknown — consumers
     # fall back to node_name-based heuristics (balancer pod summaries)
     phase: str = ""
+    # Minimal DRA model (r4 verdict missing #2): (device class, devices)
+    # pairs the pod claims. Folded into requests.extended at construction
+    # under "dra.k8s.io/<class>", so claims are counted fit dimensions on
+    # every path (estimator, hinting, removal, RPC schema) with zero
+    # hot-path cost. Node-side capacity is declared the same way — a
+    # template/node whose driver publishes k devices of class c sets
+    # allocatable.extended ("dra.k8s.io/<c>", k). What this deliberately
+    # does NOT model (vendored dynamicresources plugin, PREDICATES
+    # divergence 4): structured parameters / CEL selectors, allocation
+    # deferral (WaitForFirstConsumer), and cross-node delegated claims —
+    # see PREDICATES.md for the rationale.
+    resource_claims: Tuple[Tuple[str, float], ...] = ()
+
+    def __post_init__(self):
+        if self.resource_claims:
+            # idempotent (dataclasses.replace re-runs __post_init__): the
+            # claim axis is SET, not added — "dra.k8s.io/" is reserved for
+            # this fold, so nothing else writes those keys
+            want: Dict[str, float] = {}
+            for cls, n in self.resource_claims:
+                k = DRA_CLAIM_PREFIX + cls
+                want[k] = want.get(k, 0.0) + float(n)
+            cur = dict(self.requests.extended)
+            cur.update(want)
+            self.requests = dataclasses.replace(
+                self.requests, extended=tuple(sorted(cur.items()))
+            )
 
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"

@@ -227,9 +227,29 @@ def sharded_affinity_estimate(
     from autoscaler_tpu.ops.binpack import ffd_binpack_groups_affinity
 
     if use_pallas:
+        from autoscaler_tpu.ops.pallas_binpack import VMEM_BUDGET
         from autoscaler_tpu.ops.pallas_binpack_affinity import (
+            affinity_vmem_estimate,
             ffd_binpack_groups_affinity_pallas,
         )
+
+        # Same VMEM byte-model gate as the estimator route (advisor r4:
+        # this is a public entry point, and a shape past the budget would
+        # die in Mosaic compilation with no recovery mid-shard_map — fail
+        # loud and early instead, naming the knob that routes around it).
+        TP = max((int(match.shape[0]) + 31) // 32, 1)
+        # the 11-tuple's slot 2 is the [S] per-term level vector (same
+        # S-derivation the kernels use: binpack.py "spread[2].shape[0]")
+        S = int(spread[2].shape[0]) if spread is not None else 0
+        est = affinity_vmem_estimate(
+            int(pod_req.shape[1]), TP, max_nodes, chunk=256, S=S
+        )
+        if est > VMEM_BUDGET or S > 32:
+            raise ValueError(
+                f"shape exceeds the Pallas VMEM gate (est={est}B "
+                f"budget={VMEM_BUDGET}B, S={S}); pass use_pallas=False to "
+                "ride the XLA scan like the estimator's fallback route"
+            )
 
     g_dim = mesh.shape["group"]
     G = pod_masks.shape[0]

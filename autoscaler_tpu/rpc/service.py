@@ -36,6 +36,23 @@ def _u8(blob: bytes, *shape: int) -> np.ndarray:
     return np.frombuffer(blob, np.uint8).reshape(shape).astype(bool)
 
 
+def _check_resource_axis(pods: "pb.PackedPods", context) -> None:
+    """Extended-resource schema contract (r4 verdict missing #1): when the
+    caller names extended columns, the resource axis must be exactly
+    base-6 + those names — a silent mismatch would let a device-plugin
+    column be read as (or shadow) a base axis and flip verdicts without
+    any error. Aborts the RPC as INVALID_ARGUMENT on violation."""
+    from autoscaler_tpu.kube import objects as k8s
+
+    ext = list(pods.extended_resources)
+    if ext and pods.num_resources != k8s.NUM_RESOURCES + len(ext):
+        context.abort(
+            grpc.StatusCode.INVALID_ARGUMENT,
+            f"num_resources={pods.num_resources} but schema is "
+            f"{k8s.NUM_RESOURCES} base + {len(ext)} extended {ext}",
+        )
+
+
 class TpuSimulationServicer:
     """Device-side implementation: each RPC is one batched kernel dispatch."""
 
@@ -44,6 +61,7 @@ class TpuSimulationServicer:
 
         from autoscaler_tpu.ops.binpack import ffd_binpack_groups
 
+        _check_resource_axis(request.pods, context)
         P = request.pods.num_pods
         R = request.pods.num_resources
         G = len(request.group_ids)
@@ -75,6 +93,7 @@ class TpuSimulationServicer:
         from autoscaler_tpu.ops.schedule import greedy_schedule
         from autoscaler_tpu.snapshot.tensors import SnapshotTensors
 
+        _check_resource_axis(request.pods, context)
         P = request.pods.num_pods
         R = request.pods.num_resources
         N = request.num_nodes
@@ -127,6 +146,7 @@ class TpuSimulationServicer:
         from autoscaler_tpu.ops.scaledown import removal_feasibility
         from autoscaler_tpu.snapshot.tensors import SnapshotTensors
 
+        _check_resource_axis(request.pods, context)
         P = request.pods.num_pods
         R = request.pods.num_resources
         N = request.num_nodes
@@ -206,6 +226,26 @@ class TpuSimulationClient:
     def close(self) -> None:
         self._channel.close()
 
+    @staticmethod
+    def _packed_pods(
+        pod_req: np.ndarray, extended_resources: Sequence[str]
+    ) -> "pb.PackedPods":
+        from autoscaler_tpu.kube import objects as k8s
+
+        P, R = pod_req.shape
+        ext = list(extended_resources)
+        if ext and R != k8s.NUM_RESOURCES + len(ext):
+            raise ValueError(
+                f"pod_req has {R} columns but schema is "
+                f"{k8s.NUM_RESOURCES} base + {len(ext)} extended {ext}"
+            )
+        return pb.PackedPods(
+            requests=np.ascontiguousarray(pod_req, "<f4").tobytes(),
+            num_pods=P,
+            num_resources=R,
+            extended_resources=ext,
+        )
+
     def _call(self, method: str, request, timeout: Optional[float] = None):
         req_cls, resp_cls = _METHODS[method]
         rpc = self._channel.unary_unary(
@@ -223,16 +263,18 @@ class TpuSimulationClient:
         group_ids: Sequence[str],
         node_caps: np.ndarray,
         max_nodes: int,
+        extended_resources: Sequence[str] = (),
     ):
+        """`extended_resources` names the pod_req/template_allocs columns
+        beyond the base 6, in packer.extended_schema order (pass
+        `packer_meta.extended_resources` straight through) — the wire
+        carries the schema so the sidecar keeps device-plugin fit
+        dimensions instead of silently dropping them."""
         P, R = pod_req.shape
         resp = self._call(
             "Estimate",
             pb.EstimateRequest(
-                pods=pb.PackedPods(
-                    requests=np.ascontiguousarray(pod_req, "<f4").tobytes(),
-                    num_pods=P,
-                    num_resources=R,
-                ),
+                pods=self._packed_pods(pod_req, extended_resources),
                 pod_masks=np.ascontiguousarray(pod_masks, np.uint8).tobytes(),
                 template_allocs=np.ascontiguousarray(template_allocs, "<f4").tobytes(),
                 group_ids=list(group_ids),
@@ -255,10 +297,12 @@ class TpuSimulationClient:
         pod_slots: np.ndarray,   # [K]
         hints: np.ndarray,       # [K]
         spread: Optional[tuple] = None,  # affinity.build_spread_schedule_context
+        extended_resources: Sequence[str] = (),
     ):
         """→ (placed [K] bool, dest [K] i32). `spread` is the host-side
         9-array context; packing it onto the wire gives the remote kernel
-        host-path within-wave spread semantics."""
+        host-path within-wave spread semantics. `extended_resources` names
+        the resource columns beyond the base 6 (see estimate)."""
         P, R = pod_req.shape
         N = node_free.shape[0]
         spread_msg = None
@@ -285,11 +329,7 @@ class TpuSimulationClient:
         resp = self._call(
             "TrySchedule",
             pb.TryScheduleRequest(
-                pods=pb.PackedPods(
-                    requests=np.ascontiguousarray(pod_req, "<f4").tobytes(),
-                    num_pods=P,
-                    num_resources=R,
-                ),
+                pods=self._packed_pods(pod_req, extended_resources),
                 node_free=np.ascontiguousarray(node_free, "<f4").tobytes(),
                 sched_mask=np.ascontiguousarray(sched_mask, np.uint8).tobytes(),
                 pod_slots=np.ascontiguousarray(pod_slots, "<i4").tobytes(),

@@ -448,7 +448,14 @@ def _legacy_conflict_nodes(
     neither block nor are blocked (same liveness convention as the RWOP
     rule above). Returns {row: {blocked node index, ...}} for rows with at
     least one blocked node — empty for clusters without legacy in-tree
-    volumes, which is the common case and costs one list scan."""
+    volumes, which is the common case and costs one list scan.
+
+    Pending-vs-pending sharers are NOT vetoed here (no node to veto yet,
+    one-wave conservatism like the RWOP rule) — the ESTIMATOR closes that
+    half with synthetic hostname-conflict terms on the dynamic kernel
+    (snapshot/affinity._volume_conflict_components, advisor r4), so two
+    pending RW sharers of one volume are never co-located on a simulated
+    new node either."""
     users: List[Tuple[int, Pod]] = [
         (i, p)
         for i, p in enumerate(pods)

@@ -1,0 +1,157 @@
+"""Affinity fast-path cliff sweep — WHERE the VMEM gate routes to the scan.
+
+The estimator routes dynamic-affinity dispatches to the Pallas VMEM kernel
+only while `pallas_binpack_affinity.affinity_vmem_estimate` fits the v5e
+budget (and S<=32 spread planes); past the gate the dispatch rides the XLA
+scan at ~50-80us/step — a documented, *observed* fallback (the estimator
+emits `estimator_kernel_route_total{route=xla_scan,reason=vmem|spread_width}`
+and a log line per r4 verdict weak #6), but one whose LOCATION was never on
+the record. This tool puts it there:
+
+1. Analytic frontier (any platform): for each (max_nodes, S) bucket, the
+   largest term count T whose byte model fits VMEM_BUDGET — the exact
+   boundary the production route uses, since the estimator and the kernel
+   auto-sizer share the same byte model.
+2. Measured bracket (TPU only): time the Pallas kernel just UNDER the
+   frontier and the XLA scan just OVER it on same-size workloads, so the
+   cost of crossing is a number, not a docstring estimate.
+
+Prints one JSON object; commit the TPU run under benchmarks/captures/.
+Mirrors the failure mode the framework must not silently reintroduce:
+reference FAQ.md:151-153 (~1000x inter-pod affinity estimation cost).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from affinity_bench import build_workload  # noqa: E402
+
+
+def analytic_frontier(R: int = 6, chunk: int = 256):
+    """Max term count T (in 32-term plane units) under VMEM_BUDGET for each
+    (max_nodes, S) bucket — the production gate's exact boundary."""
+    from autoscaler_tpu.ops.pallas_binpack import VMEM_BUDGET
+    from autoscaler_tpu.ops.pallas_binpack_affinity import (
+        affinity_vmem_estimate,
+    )
+
+    frontier = []
+    for max_nodes in (128, 256, 512, 1000, 2048, 4096):
+        for S in (0, 8, 16, 32):
+            # planes are the VMEM unit: T terms cost ceil(T/32) planes
+            tp = 0
+            while (
+                affinity_vmem_estimate(
+                    R, tp + 1, max_nodes, chunk=chunk, S=S
+                )
+                <= VMEM_BUDGET
+            ):
+                tp += 1
+                if tp >= 4096:  # unbounded at this shape
+                    break
+            frontier.append(
+                {
+                    "max_nodes": max_nodes,
+                    "spread_terms": S,
+                    "max_term_planes": tp,
+                    "max_terms": tp * 32,
+                }
+            )
+    return frontier
+
+
+def _time_kernel(fn, jargs, reps):
+    np.asarray(fn(**jargs).node_count)  # compile + sync
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(fn(**jargs).node_count)
+        times.append(time.perf_counter() - t0)
+    return float(np.min(times))
+
+
+def measured_bracket(frontier, reps=3):
+    """TPU-only: cost on each side of the cliff at max_nodes=1000, R=6.
+    Under: T = frontier terms (Pallas, parity-checked vs the scan).
+    Over: T = frontier + 32 (one plane past — the gate refuses Pallas, so
+    the same workload rides the XLA scan)."""
+    import jax
+    import jax.numpy as jnp
+
+    from autoscaler_tpu.ops.binpack import ffd_binpack_groups_affinity
+    from autoscaler_tpu.ops.pallas_binpack_affinity import (
+        ffd_binpack_groups_affinity_pallas,
+    )
+
+    M = 1000
+    row = next(
+        r for r in frontier if r["max_nodes"] == M and r["spread_terms"] == 0
+    )
+    t_under = row["max_terms"]
+    P = int(os.environ.get("CLIFF_P", 20_000))
+    G = int(os.environ.get("CLIFF_G", 32))
+    out = {"max_nodes": M, "p": P, "g": G, "t_under": t_under,
+           "t_over": t_under + 32}
+    for label, T, kernels in (
+        ("under", t_under,
+         (("pallas", ffd_binpack_groups_affinity_pallas),
+          ("xla_scan", ffd_binpack_groups_affinity))),
+        ("over", t_under + 32, (("xla_scan", ffd_binpack_groups_affinity),)),
+    ):
+        pod_req, masks, allocs, match, aff_of, anti_of, node_level, has_label = (
+            build_workload(P, G, T)
+        )
+        jargs = dict(
+            pod_req=jnp.asarray(pod_req),
+            pod_masks=jnp.asarray(masks),
+            template_allocs=jnp.asarray(allocs),
+            max_nodes=M,
+            match=jnp.asarray(match),
+            aff_of=jnp.asarray(aff_of),
+            anti_of=jnp.asarray(anti_of),
+            node_level=jnp.asarray(node_level),
+            has_label=jnp.asarray(has_label),
+        )
+        ref = None
+        for name, fn in kernels:
+            t = _time_kernel(fn, jargs, reps)
+            out[f"{label}_{name}_s"] = round(t, 4)
+            res = np.asarray(fn(**jargs).node_count)
+            if ref is None:
+                ref = res
+            elif not (ref == res).all():
+                out[f"{label}_parity"] = "MISMATCH"
+        out.setdefault(f"{label}_parity", "ok")
+    if "under_pallas_s" in out and "over_xla_scan_s" in out:
+        out["cliff_cost_ratio"] = round(
+            out["over_xla_scan_s"] / out["under_pallas_s"], 2
+        )
+    return out
+
+
+def main():
+    import jax
+
+    if os.environ.get("CLIFF_PLATFORM") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    platform = jax.devices()[0].platform
+    result = {
+        "metric": "affinity_vmem_cliff",
+        "platform": platform,
+        "chunk": 256,
+        "frontier": analytic_frontier(),
+    }
+    if platform == "tpu":
+        result["measured"] = measured_bracket(result["frontier"])
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -162,6 +162,69 @@ class TestEstimatorDistinguishesDevices:
         assert count_b == 1 and [p.name for p in sched_b] == ["plain"]
 
 
+class TestDRAClaims:
+    """Minimal DRA model (PREDICATES divergence 4): claims are counted
+    per-node resources under the reserved dra.k8s.io/ namespace."""
+
+    def test_claims_fold_into_requests(self):
+        import dataclasses
+
+        from autoscaler_tpu.kube.objects import DRA_CLAIM_PREFIX
+
+        p = build_test_pod("p0", cpu_m=100)
+        p2 = dataclasses.replace(
+            p, resource_claims=(("gpu.nvidia.com", 2.0), ("gpu.nvidia.com", 1.0))
+        )
+        assert p2.requests.extended_map() == {
+            DRA_CLAIM_PREFIX + "gpu.nvidia.com": 3.0
+        }
+
+    def test_fold_is_idempotent_under_replace(self):
+        """dataclasses.replace re-runs __post_init__; the claim axis must
+        not double (utils/tpu.py and vpa/updater.py replace pods)."""
+        import dataclasses
+
+        from autoscaler_tpu.kube.objects import DRA_CLAIM_PREFIX, Pod
+
+        p = Pod("p0", resource_claims=(("net.example/vf", 1.0),))
+        for _ in range(3):
+            p = dataclasses.replace(p, priority=p.priority + 1)
+        assert p.requests.extended_map() == {
+            DRA_CLAIM_PREFIX + "net.example/vf": 1.0
+        }
+
+    def test_claim_gates_estimate(self):
+        """4 pods claiming one class-device each on a 2-device template need
+        2 nodes; without the claim model cpu alone would fit all on one."""
+        from autoscaler_tpu.kube.objects import DRA_CLAIM_PREFIX
+
+        template = build_test_node("tmpl", cpu_m=8000, mem=16 * GB)
+        template.allocatable = Resources(
+            cpu_m=8000, memory=16 * GB, pods=110,
+            extended=((DRA_CLAIM_PREFIX + "gpu.nvidia.com", 2.0),),
+        )
+        import dataclasses
+
+        pods = [
+            dataclasses.replace(
+                build_test_pod(f"p{i}", cpu_m=100),
+                resource_claims=(("gpu.nvidia.com", 1.0),),
+            )
+            for i in range(4)
+        ]
+        count, scheduled = BinpackingNodeEstimator().estimate(pods, template)
+        assert count == 2
+        assert len(scheduled) == 4
+
+    def test_unclaimable_class_never_schedules(self):
+        template = build_test_node("tmpl", cpu_m=8000)
+        from autoscaler_tpu.kube.objects import Pod
+
+        p = Pod("p0", resource_claims=(("fpga.example", 1.0),))
+        count, scheduled = BinpackingNodeEstimator().estimate([p], template)
+        assert count == 0 and scheduled == []
+
+
 class TestIncrementalSchemaChange:
     def test_new_extended_name_forces_rebuild_with_parity(self):
         from autoscaler_tpu.snapshot.incremental import IncrementalPacker

@@ -212,6 +212,118 @@ def oracle_mask(nodes, pods, node_of_pod):
     return out
 
 
+class TestPendingPairEstimation:
+    """Pending-vs-pending conflicts in the ESTIMATOR (advisor r4): the
+    static mask stays one-wave conservative (placed users only — the test
+    above), but the binpacking estimator must not co-locate two pending RW
+    sharers on one simulated NEW node. Synthetic hostname-level conflict
+    terms ride the dynamic-affinity kernel; the reference equivalent
+    re-runs VolumeRestrictions per simulated placement."""
+
+    def test_two_pending_rw_pd_sharers_need_two_nodes(self):
+        from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+
+        template = build_test_node("tmpl", cpu_m=10_000)
+        pods = [vol_pod("a", pd()), vol_pod("b", pd())]
+        count, scheduled = BinpackingNodeEstimator().estimate(pods, template)
+        assert count == 2
+        assert len(scheduled) == 2
+
+    def test_ro_pd_sharers_still_colocate(self):
+        from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+
+        template = build_test_node("tmpl", cpu_m=10_000)
+        pods = [vol_pod("a", pd(ro=True)), vol_pod("b", pd(ro=True))]
+        count, scheduled = BinpackingNodeEstimator().estimate(pods, template)
+        assert count == 1 and len(scheduled) == 2
+
+    def test_ro_rw_mix_conflicts(self):
+        """RO+RW on one PD conflict (isVolumeConflict: unless BOTH ro)."""
+        from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+
+        template = build_test_node("tmpl", cpu_m=10_000)
+        pods = [vol_pod("a", pd(ro=True)), vol_pod("b", pd())]
+        count, _ = BinpackingNodeEstimator().estimate(pods, template)
+        assert count == 2
+
+    def test_ebs_ro_pair_still_conflicts(self):
+        from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+
+        template = build_test_node("tmpl", cpu_m=10_000)
+        pods = [
+            vol_pod("a", LegacyVolume("aws-ebs", "vol-1", read_only=True)),
+            vol_pod("b", LegacyVolume("aws-ebs", "vol-1", read_only=True)),
+        ]
+        count, _ = BinpackingNodeEstimator().estimate(pods, template)
+        assert count == 2
+
+    def test_rbd_disjoint_monitors_colocate(self):
+        from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+
+        template = build_test_node("tmpl", cpu_m=10_000)
+        pods = [
+            vol_pod("a", LegacyVolume("rbd", "pool/img", monitors=("m1",))),
+            vol_pod("b", LegacyVolume("rbd", "pool/img", monitors=("m2",))),
+        ]
+        count, _ = BinpackingNodeEstimator().estimate(pods, template)
+        assert count == 1
+
+    def test_estimate_many_pending_pair(self):
+        """The batched path routes volume-conflict worlds through the
+        dynamic kernel too (and never through exemplar run compression,
+        which would collapse same-spec sharers into one run)."""
+        from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+
+        templates = {"g": build_test_node("tmpl", cpu_m=10_000)}
+        # many identical sharers: dedup would otherwise compress them
+        pods = [vol_pod(f"p{i}", pd()) for i in range(6)]
+        res = BinpackingNodeEstimator().estimate_many(pods, templates)
+        count, sched = res["g"]
+        assert count == 6
+        assert len(sched) == 6
+
+
+    def test_controller_grouped_sharers_not_collapsed(self):
+        """THE review-caught hole: replicas of ONE controller (shared owner,
+        identical spec) mounting the same RW PD dedup into a single
+        equivalence group — exemplar-built terms would see one volume user
+        and co-locate all replicas. Conflict worlds must therefore never
+        take run compression."""
+        from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+        from autoscaler_tpu.kube.objects import OwnerRef
+
+        templates = {"g": build_test_node("tmpl", cpu_m=10_000)}
+        owner = OwnerRef(kind="ReplicaSet", name="web-abc123")
+        pods = []
+        for i in range(3):
+            p = vol_pod(f"web-{i}", pd())
+            p.owner_ref = owner
+            pods.append(p)
+        from autoscaler_tpu.core.scaleup.equivalence import build_pod_groups
+
+        assert len(build_pod_groups(pods)) == 1, "fixture must actually group"
+        res = BinpackingNodeEstimator().estimate_many(pods, templates)
+        count, sched = res["g"]
+        assert count == 3
+        assert len(sched) == 3
+
+    def test_run_compression_path_keeps_conflict(self):
+        """Sharers mixed with many dedupable plain pods take the run-aware
+        affinity path (equivalence fingerprints keep volume carriers
+        distinct, so exemplar-built conflict terms are exact): the two RW
+        sharers land on different nodes, plain pods fill around them."""
+        from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+
+        templates = {"g": build_test_node("tmpl", cpu_m=10_000)}
+        pods = [vol_pod("a", pd()), vol_pod("b", pd())] + [
+            build_test_pod(f"plain{i}", cpu_m=100) for i in range(10)
+        ]
+        res = BinpackingNodeEstimator().estimate_many(pods, templates)
+        count, sched = res["g"]
+        assert count == 2
+        assert len(sched) == 12
+
+
 class TestOracleParity:
     def test_randomized_worlds(self):
         rng = np.random.default_rng(7)
