@@ -36,11 +36,11 @@ from autoscaler_tpu.ops.binpack import (
 )
 from autoscaler_tpu.snapshot.affinity import (
     SpreadTermTensors,
-    _volume_conflict_components,
     build_affinity_terms,
     build_spread_terms,
     has_hard_spread,
     has_interpod_affinity,
+    volume_conflict_components,
 )
 from autoscaler_tpu.snapshot.packer import (
     compute_sched_mask,
@@ -183,7 +183,7 @@ class BinpackingNodeEstimator:
         P = bucket_size(len(pods))
         ext = _estimation_schema(pods)
         req = _pack_pods(pods, P, ext)
-        vol_comps = _volume_conflict_components(pods)
+        vol_comps = volume_conflict_components(pods)
         dynamic = (
             has_interpod_affinity(pods)
             or has_hard_spread(pods)
@@ -304,7 +304,7 @@ class BinpackingNodeEstimator:
         names = sorted(templates)
         # computed ONCE per dispatch and threaded through (the component
         # build is O(pods x volumes) — not worth paying twice at 100k pods)
-        vol_comps = _volume_conflict_components(pods)
+        vol_comps = volume_conflict_components(pods)
         dynamic_affinity = (
             has_interpod_affinity(pods) or has_hard_spread(pods) or bool(vol_comps)
         )

@@ -63,7 +63,7 @@ def build_affinity_terms(
     templates: Sequence[Node],
     pad_pods: int | None = None,
     bucket_terms: bool = False,
-    volume_components=None,  # precomputed _volume_conflict_components(pods);
+    volume_components=None,  # precomputed volume_conflict_components(pods);
                              # None = compute here, () = explicitly none
 ) -> AffinityTermTensors:
     """Collect the distinct required terms over `pods` and evaluate their
@@ -113,7 +113,7 @@ def build_affinity_terms(
     # node). These rows are filled by pod index below, not selector-
     # evaluated.
     vol_terms = (
-        _volume_conflict_components(pods)
+        volume_conflict_components(pods)
         if volume_components is None
         else list(volume_components)
     )
@@ -192,7 +192,7 @@ def build_affinity_terms(
     )
 
 
-def _volume_conflict_components(pods: Sequence[Pod]):
+def volume_conflict_components(pods: Sequence[Pod]):
     """Pending-vs-pending legacy same-volume conflicts as hostname-level
     conflict components (advisor r4: placed-pod vetoes alone let the
     estimator co-locate two RW sharers of one GCE PD/EBS/iSCSI/RBD volume
@@ -247,13 +247,6 @@ def _volume_conflict_components(pods: Sequence[Pod]):
             if antis:
                 out.append((members, antis))
     return out
-
-
-def has_pending_volume_conflicts(pods: Sequence[Pod]) -> bool:
-    """True when >=2 pending pods share a conflicting legacy in-tree
-    volume — the estimator must then take the dynamic (per-pod, term-
-    gated) path so build_affinity_terms' synthetic volume terms apply."""
-    return bool(_volume_conflict_components(pods))
 
 
 def has_interpod_affinity(pods: Sequence[Pod]) -> bool:

@@ -453,7 +453,7 @@ def _legacy_conflict_nodes(
     Pending-vs-pending sharers are NOT vetoed here (no node to veto yet,
     one-wave conservatism like the RWOP rule) — the ESTIMATOR closes that
     half with synthetic hostname-conflict terms on the dynamic kernel
-    (snapshot/affinity._volume_conflict_components, advisor r4), so two
+    (snapshot/affinity.volume_conflict_components, advisor r4), so two
     pending RW sharers of one volume are never co-located on a simulated
     new node either."""
     users: List[Tuple[int, Pod]] = [

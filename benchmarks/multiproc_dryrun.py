@@ -1,0 +1,172 @@
+"""Multi-process DCN-layout dryrun: 2 "hosts" x 4 devices over jax.distributed.
+
+The single-process dryrun (__graft_entry__.dryrun_multichip) certifies the
+kernel fleet under shard_map on one process's virtual devices — but the
+DCN-aware host layout (parallel/mesh.arrange_devices_for_hosts: group axis
+inside a host so the expander all_gather rides ICI, scenario axis across
+hosts over DCN) was only ever duck-type-tested (r4 verdict #7). This runs
+it for real: two OS processes, each owning 4 virtual CPU devices, joined
+via jax.distributed + Gloo, building the 2-host mesh through the SAME
+arrange_devices_for_hosts call a production fleet uses, and running the
+sharded what-if decision step with its cross-group all_gather — parity
+checked exactly against the serial reference FFD on process 0.
+
+Launcher mode (default): spawns the two workers, relays their output,
+exits 0 on parity-certified success, 2 on parity failure, 3 on an
+environmental failure (coordinator, Gloo, platform).
+
+Worker mode (--worker I --port PORT): one process of the pair.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_PROCS = 2
+PER_HOST = 4
+S, G, P_PODS, MAX_NODES = 2, 4, 192, 16
+
+
+def _worker(idx: int, port: int) -> None:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={PER_HOST}"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # axon site hook workaround
+    jax.distributed.initialize(
+        f"localhost:{port}", num_processes=N_PROCS, process_id=idx
+    )
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from autoscaler_tpu.parallel.mesh import (
+        make_multihost_mesh,
+        whatif_best_options,
+    )
+
+    devices = jax.devices()
+    assert len(devices) == N_PROCS * PER_HOST, len(devices)
+    mesh = make_multihost_mesh(devices)
+    # the layout contract: scenario axis spans hosts, group axis stays local
+    grid = np.asarray(mesh.devices)
+    assert mesh.shape == {"scenario": N_PROCS, "group": PER_HOST}, mesh.shape
+    for row in range(N_PROCS):
+        procs = {d.process_index for d in grid[row]}
+        assert len(procs) == 1, f"group axis crosses hosts: {procs}"
+
+    # identical world in every process (same seed) → valid global arrays
+    rng = np.random.default_rng(7)
+    pod_req = np.zeros((P_PODS, 6), np.float32)
+    pod_req[:, 0] = rng.integers(50, 1500, P_PODS)
+    pod_req[:, 1] = rng.integers(64, 4096, P_PODS)
+    pod_req[:, 5] = 1
+    masks = rng.random((G, P_PODS)) > 0.1
+    allocs = np.zeros((S, G, 6), np.float32)
+    allocs[..., 0] = rng.choice([4000, 8000, 16000], (S, G))
+    allocs[..., 1] = rng.choice([8192, 16384], (S, G))
+    allocs[..., 5] = 110
+    prices = rng.uniform(0.5, 3.0, (S, G)).astype(np.float32)
+    caps = np.full(G, MAX_NODES, np.int32)
+
+    def put(x, spec):
+        return jax.device_put(jnp.asarray(x), NamedSharding(mesh, spec))
+
+    res = whatif_best_options(
+        mesh,
+        put(pod_req, P(None, None)),
+        put(masks, P("group", None)),
+        put(allocs, P("scenario", "group", None)),
+        put(prices, P("scenario", "group")),
+        put(caps, P("group")),
+        max_nodes=MAX_NODES,
+    )
+    counts = multihost_utils.process_allgather(res.node_counts, tiled=True)
+    best = multihost_utils.process_allgather(res.best_group, tiled=True)
+    best_cost = multihost_utils.process_allgather(res.best_cost, tiled=True)
+
+    if idx == 0:
+        from autoscaler_tpu.estimator.reference_impl import (
+            ffd_binpack_reference_groups,
+        )
+        from autoscaler_tpu.parallel.mesh import UNSCHEDULED_PENALTY
+
+        for s in range(S):
+            ref_counts, ref_scheds = ffd_binpack_reference_groups(
+                pod_req, masks, allocs[s], max_nodes=MAX_NODES
+            )
+            ref_counts = np.minimum(ref_counts, MAX_NODES)
+            if not (counts[s] == ref_counts).all():
+                print(f"PARITY_FAIL counts scenario {s}: "
+                      f"{counts[s].tolist()} vs {ref_counts.tolist()}")
+                sys.exit(2)
+            pending = P_PODS - ref_scheds.sum(axis=1)
+            ref_cost = prices[s] * ref_counts + UNSCHEDULED_PENALTY * pending
+            if int(best[s]) != int(np.argmin(ref_cost)):
+                print(f"PARITY_FAIL best scenario {s}")
+                sys.exit(2)
+            if not np.isclose(float(best_cost[s]), float(ref_cost.min())):
+                print(f"PARITY_FAIL cost scenario {s}")
+                sys.exit(2)
+        print(json.dumps({
+            "multiproc_dryrun": "ok",
+            "processes": N_PROCS,
+            "devices_per_host": PER_HOST,
+            "mesh": f"scenario={N_PROCS} hosts (DCN) x group={PER_HOST} local (ICI)",
+            "collective": "all_gather over group (in-host) via shard_map",
+            "parity": "EXACT vs serial reference FFD",
+            "s_g_p": [S, G, P_PODS],
+        }))
+
+
+def main() -> None:
+    if "--worker" in sys.argv:
+        i = int(sys.argv[sys.argv.index("--worker") + 1])
+        port = int(sys.argv[sys.argv.index("--port") + 1])
+        _worker(i, port)
+        return
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # workers pin cpu via jax.config
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--worker", str(i), "--port", str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+        )
+        for i in range(N_PROCS)
+    ]
+    try:
+        outs = [p.communicate(timeout=420)[0] for p in procs]
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        print("multiproc dryrun TIMEOUT")
+        sys.exit(3)
+    for out in outs:
+        for line in out.splitlines():
+            print(line)
+    if any(p.returncode == 2 for p in procs):
+        sys.exit(2)                      # parity failure — loud
+    if any(p.returncode != 0 for p in procs):
+        sys.exit(3)                      # environmental
+    if not any("multiproc_dryrun" in o for o in outs):
+        sys.exit(3)
+
+
+if __name__ == "__main__":
+    main()

@@ -308,10 +308,12 @@ class TestPendingPairEstimation:
         assert len(sched) == 3
 
     def test_run_compression_path_keeps_conflict(self):
-        """Sharers mixed with many dedupable plain pods take the run-aware
-        affinity path (equivalence fingerprints keep volume carriers
-        distinct, so exemplar-built conflict terms are exact): the two RW
-        sharers land on different nodes, plain pods fill around them."""
+        """Sharers mixed with many dedupable plain pods: conflict worlds
+        are ROUTED AWAY from run compression (the vol_comps guard in
+        _estimate_many_inner — exemplar-built terms would be blind to
+        controller-grouped sharers), so the per-pod dynamic path serves
+        this world: the two RW sharers land on different nodes, plain
+        pods fill around them."""
         from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
 
         templates = {"g": build_test_node("tmpl", cpu_m=10_000)}

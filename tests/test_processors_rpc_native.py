@@ -340,12 +340,32 @@ class TestGrpcSidecar:
             text=True,
         )
         try:
+            # deadline-bounded read: a wedged child (e.g. backend init
+            # hanging) must fail the test loudly, not hang the session
+            import select
+
             port = None
-            for line in proc.stdout:
-                if line.startswith("PORT="):
-                    port = int(line.strip().split("=", 1)[1])
+            deadline = 60.0
+            import time as time_mod
+
+            t_end = time_mod.monotonic() + deadline
+            buf = ""
+            while time_mod.monotonic() < t_end and port is None:
+                ready, _, _ = select.select(
+                    [proc.stdout], [], [], min(1.0, t_end - time_mod.monotonic())
+                )
+                if not ready:
+                    continue
+                chunk = proc.stdout.readline()
+                if not chunk:
                     break
-            assert port, "sidecar subprocess never reported its port"
+                buf += chunk
+                if chunk.startswith("PORT="):
+                    port = int(chunk.strip().split("=", 1)[1])
+            assert port, (
+                f"sidecar subprocess never reported its port within "
+                f"{deadline}s; output so far: {buf!r}"
+            )
             req, masks, allocs, caps = self._widget_world()
             client = TpuSimulationClient(f"127.0.0.1:{port}")
             try:
