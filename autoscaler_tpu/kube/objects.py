@@ -11,6 +11,7 @@ tolerations, affinity, owner refs, priority, PDB linkage).
 from __future__ import annotations
 
 import dataclasses
+import threading as _threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -52,6 +53,24 @@ SAFE_TO_EVICT_LOCAL_VOLUMES_ANNOTATION = (
 # of device class <c> becomes the counted extended resource
 # "dra.k8s.io/<c>" (Pod.resource_claims folds in at construction).
 DRA_CLAIM_PREFIX = "dra.k8s.io/"
+
+# Process-global pod-profile interning (see Pod.profile_id): profile key →
+# int id, and id → (namespace, labels) for selector evaluation. Guarded by
+# a lock (the packer can be reached from RPC worker threads) and EPOCHED:
+# real clusters mint per-pod-unique labels (controller-revision-hash,
+# job-name, statefulset pod-name), so a long-lived leader would otherwise
+# grow this without bound — past the cap the registry resets and every
+# memoized id re-interns lazily (ids are compared only within an epoch).
+_POD_PROFILE_LOCK = _threading.Lock()
+_POD_PROFILE_CAP = 1 << 20
+_POD_PROFILE_EPOCH = 0
+_POD_PROFILE_IDS: Dict[tuple, int] = {}
+_POD_PROFILE_VALUES: List[Tuple[str, Dict[str, str]]] = []
+
+
+def pod_profile_value(pid: int) -> Tuple[str, Dict[str, str]]:
+    """(namespace, labels) for a Pod.profile_id() value (same epoch)."""
+    return _POD_PROFILE_VALUES[pid]
 
 
 @dataclass(frozen=True)
@@ -366,6 +385,49 @@ class Pod:
 
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
+
+    def profile_key(self) -> tuple:
+        """(namespace, sorted label items) — the selector-verdict identity
+        used by the mask/term builders' profile factorization. MEMOIZED on
+        the instance: at 165k placed pods the packer's spread/affinity
+        rules consult this ~10x per reconcile loop, and the sorted-tuple
+        build was their measured top self-cost. Safe because pod labels
+        are construction-time data in this codebase — watch updates build
+        NEW Pod objects (kube/convert.pod_from_json); nothing mutates
+        labels in place (invariant; grep `.labels[` stays node-only)."""
+        pk = self.__dict__.get("_profile_key")
+        if pk is None:
+            pk = (self.namespace, tuple(sorted(self.labels.items())))
+            self.__dict__["_profile_key"] = pk
+        return pk
+
+    def profile_id(self) -> int:
+        """Process-global integer id of profile_key(), memoized on the
+        instance — lets per-placed-pod passes work in ints (np.unique
+        remap) instead of hashing 165k label tuples per mask rebuild.
+        Ids are valid within a registry EPOCH; a capped registry resets
+        under per-pod-unique label churn (see the registry comment) and
+        stale memos lazily re-intern. Labels immutability (profile_key)
+        makes the stored dict reference safe."""
+        global _POD_PROFILE_EPOCH
+        if self.__dict__.get("_profile_epoch") == _POD_PROFILE_EPOCH:
+            return self.__dict__["_profile_id"]
+        key = self.profile_key()
+        pid = _POD_PROFILE_IDS.get(key)
+        if pid is None:
+            with _POD_PROFILE_LOCK:
+                pid = _POD_PROFILE_IDS.get(key)  # lost the race → reuse
+                if pid is None:
+                    if len(_POD_PROFILE_VALUES) >= _POD_PROFILE_CAP:
+                        _POD_PROFILE_IDS.clear()
+                        _POD_PROFILE_VALUES.clear()
+                        _POD_PROFILE_EPOCH += 1
+                    pid = len(_POD_PROFILE_VALUES)
+                    _POD_PROFILE_IDS[key] = pid
+                    _POD_PROFILE_VALUES.append((self.namespace, self.labels))
+        self.__dict__["_profile_id"] = pid
+        self.__dict__["_profile_epoch"] = _POD_PROFILE_EPOCH
+        return pid
 
     def effective_requests(self) -> Resources:
         r = self.requests

@@ -172,6 +172,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    default=10.0, help="per-group estimate budget (main.go:216)")
     p.add_argument("--node-info-cache-expire-time", type=float, default=60.0,
                    help="template NodeInfo cache TTL seconds")
+    p.add_argument("--jax-compilation-cache-dir",
+                   default="/tmp/autoscaler_tpu_xla_cache",
+                   help="persistent XLA compile cache (amortizes first-loop "
+                        "kernel compiles across restarts); empty disables")
     p.add_argument("--debugging-snapshot-enabled", type=_bool_flag, default=True,
                    help="serve /snapshotz captures")
     p.add_argument("--record-duplicated-events", type=_bool_flag, default=False,
@@ -434,6 +438,20 @@ def main(argv=None) -> int:
 
     klogx.set_verbosity(args.v)
     logging.basicConfig(level=logging.INFO)
+
+    if args.jax_compilation_cache_dir:
+        # Persistent XLA compile cache: the first reconcile loop pays
+        # ~10-40s of kernel compiles (churn_bench first_loop_s vs steady
+        # state); across process restarts — the common restart path for a
+        # leader-elected singleton — the cache turns that into a disk read.
+        # Applied before any jax import triggers backend init.
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir", args.jax_compilation_cache_dir
+        )
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
     from autoscaler_tpu.debugging import DebuggingSnapshotter

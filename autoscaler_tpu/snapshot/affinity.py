@@ -134,7 +134,7 @@ def build_affinity_terms(
     pod_prof = np.empty(len(pods), np.int64)
     profiles: List[Tuple[str, Dict[str, str]]] = []
     for i, pod in enumerate(pods):
-        key = (pod.namespace, tuple(sorted(pod.labels.items())))
+        key = pod.profile_key()
         pid = profile_index.setdefault(key, len(profile_index))
         pod_prof[i] = pid
         if pid == len(profiles):
@@ -469,7 +469,7 @@ def build_spread_schedule_context(
         [j if j is not None else -1 for j in node_of], _np.int64
     ) if placed_pods else _np.empty(0, _np.int64)
     for qi, q in enumerate(placed_pods):
-        pkey = (q.namespace, tuple(sorted(q.labels.items())))
+        pkey = q.profile_key()
         pid = prof_index.setdefault(pkey, len(prof_index))
         prof_of[qi] = pid
         if pid == len(profiles):

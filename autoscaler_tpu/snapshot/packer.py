@@ -621,20 +621,24 @@ def _apply_row_rules(
         for li, t in decls:
             rows_of_term.setdefault(t, []).append(spread_rows[li])
 
-        prof_index: Dict[tuple, int] = {}
-        profiles: List[Tuple[str, Dict[str, str]]] = []
+        # int-domain profile pass: global ids (Pod.profile_id, instance-
+        # memoized) remapped to local contiguous ids via np.unique — no
+        # per-placed-pod tuple hashing (the measured top self-cost of this
+        # function at 165k placed pods)
         K = len(placed)
-        placed_prof = np.empty(K, np.int64)
-        placed_node = np.empty(K, np.int64)
-        placed_live = np.empty(K, bool)
-        for k, (qi, q, j) in enumerate(placed):
-            pkey = (q.namespace, tuple(sorted(q.labels.items())))
-            pid = prof_index.setdefault(pkey, len(prof_index))
-            if pid == len(profiles):
-                profiles.append((q.namespace, q.labels))
-            placed_prof[k] = pid
-            placed_node[k] = j
-            placed_live[k] = q.deletion_ts is None
+        gids = np.fromiter(
+            (q.profile_id() for _, q, _ in placed), np.int64, count=K
+        )
+        placed_node = np.fromiter(
+            (j for _, _, j in placed), np.int64, count=K
+        )
+        placed_live = np.fromiter(
+            (q.deletion_ts is None for _, q, _ in placed), bool, count=K
+        )
+        uniq, placed_prof = (
+            np.unique(gids, return_inverse=True) if K else (gids, gids)
+        )
+        profiles = [k8s.pod_profile_value(int(g)) for g in uniq]
 
         for t, (c, sel, ns, declarer, all_keys) in enumerate(term_list):
             node_dom, domains = domains_for(c.topology_key)

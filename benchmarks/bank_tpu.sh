@@ -1,0 +1,31 @@
+#!/bin/bash
+# Bank every TPU capture the round needs, in value order, continue on failure.
+cd /root/repo
+LOG=/tmp/bank_tpu.log
+CAP=benchmarks/captures
+echo "=== bank start $(date -u +%FT%TZ)" >> $LOG
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  echo "--- $name $(date +%H:%M:%S)" >> $LOG
+  timeout "$tmo" "$@" > /tmp/bank_$name.out 2>> $LOG
+  local rc=$?
+  echo "rc=$rc" >> $LOG
+  tail -1 /tmp/bank_$name.out >> $LOG
+  return $rc
+}
+
+# 1+2: the north star, twice (consecutive-run robustness)
+run bench1 2400 python bench.py
+run bench2 2400 python bench.py
+# 3: the defining claim vs the reference's ~1000x pain point
+run affinity 1800 python benchmarks/affinity_bench.py
+# 4: spread+affinity through the production estimator route
+run spread 1800 python benchmarks/spread_bench.py
+# 5: bf16 fit decision data
+run bf16 1200 python benchmarks/bf16_bench.py
+# 6: the VMEM cliff, measured on both sides
+run cliff 1800 python benchmarks/cliff_sweep.py
+# 7: full reconcile loop with the TPU estimator inside
+run churn_tpu 3000 python benchmarks/churn_bench.py --platform tpu --nodes 15000 --loops 6 --xla-cache /tmp/xla_tpu_cache
+echo "=== bank done $(date -u +%FT%TZ)" >> $LOG

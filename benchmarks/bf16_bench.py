@@ -27,6 +27,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main():
     import jax
+
+    if os.environ.get("BF16_BENCH_PLATFORM") == "cpu":
+        # axon site hook re-pins at import; same workaround as bench.py
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from autoscaler_tpu.ops import fit as fit_mod

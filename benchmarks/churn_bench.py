@@ -9,8 +9,12 @@ kernels run), node add — using the persistent incremental packer exactly as
 production wiring does. Prints one JSON line with per-loop seconds.
 
 Run: python benchmarks/churn_bench.py [--loops 12] [--nodes 5000]
-The measurement is CPU-backend end-to-end (host pack + kernels + control
-loop); the device kernels only get faster on the TPU.
+Default is CPU-backend end-to-end (host pack + kernels + control loop).
+--platform tpu drives the SAME loop with the TPU estimator inside it (the
+production route: host packer -> device estimate -> actuation) and emits
+the estimator phase's function_duration distribution — the capture the r4
+verdict asked for ("the full reconcile loop has never been driven with the
+TPU estimator inside it").
 """
 from __future__ import annotations
 
@@ -22,13 +26,19 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
 
 def main():
+    # real argparse pre-pass (not a hand-rolled scan: abbreviations and a
+    # bare trailing --platform must behave like the main parser) — the
+    # platform pin has to land BEFORE any other jax use
+    pre = argparse.ArgumentParser(add_help=False)
+    pre.add_argument("--platform", choices=("cpu", "tpu"), default="cpu")
+    platform_arg = pre.parse_known_args()[0].platform
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
+    if platform_arg == "cpu":
+        # env alone is not enough: the axon site hook re-pins at import
+        jax.config.update("jax_platforms", "cpu")
     import numpy as np
 
     from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
@@ -46,7 +56,26 @@ def main():
     ap.add_argument("--loops", type=int, default=12)
     ap.add_argument("--nodes", type=int, default=5000)
     ap.add_argument("--pods-per-node", type=int, default=11)
+    ap.add_argument("--platform", choices=("cpu", "tpu"), default="cpu")
+    # pods too big for any node's FREE capacity (but fitting an empty new
+    # node) so every loop exercises the scale-up orchestrator + batched
+    # estimator — without them the burst is absorbed by existing headroom
+    # and the estimate phase never runs (r4 verdict #3 wants its
+    # distribution inside a real loop)
+    ap.add_argument("--big-burst", type=int, default=10)
+    ap.add_argument("--xla-cache", default="",
+                    help="persistent XLA compile cache dir (same knob as "
+                         "main.py --jax-compilation-cache-dir); shrinks "
+                         "first_loop_s across runs")
     args = ap.parse_args()
+    if args.xla_cache:
+        jax.config.update("jax_compilation_cache_dir", args.xla_cache)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    if args.platform == "tpu":
+        assert jax.default_backend() == "tpu", (
+            f"--platform tpu requested but backend is {jax.default_backend()}"
+        )
 
     ZONE = "topology.kubernetes.io/zone"
     rng = np.random.default_rng(0)
@@ -76,8 +105,11 @@ def main():
             api.add_pod(p)
             pi += 1
 
+    from autoscaler_tpu.metrics.metrics import AutoscalerMetrics
+
     opts = AutoscalingOptions(scale_down_delay_after_add_s=0.0)
-    autoscaler = StaticAutoscaler(provider, api, opts)
+    metrics = AutoscalerMetrics()
+    autoscaler = StaticAutoscaler(provider, api, opts, metrics=metrics)
 
     times = []
     burst_id = 0
@@ -95,10 +127,12 @@ def main():
             )
             p.owner_ref = OwnerRef(kind="ReplicaSet", name=f"rs-{j % 20}")
             api.add_pod(p)
-        for j in range(30):
+        for j in range(30 + args.big_burst):
+            big = j >= 30
             p = build_test_pod(
-                f"burst-{burst_id}", cpu_m=500, mem=2 * GB,
-                labels={"app": "burst"},
+                f"burst-{burst_id}", cpu_m=7000 if big else 500,
+                mem=4 * GB if big else 2 * GB,
+                labels={"app": "burst-big" if big else "burst"},
             )
             p.owner_ref = OwnerRef(kind="ReplicaSet", name="burst-rs")
             if j % 3 == 0:
@@ -115,10 +149,28 @@ def main():
         times.append(time.perf_counter() - t0)
 
     steady = times[2:] if len(times) > 2 else times  # first loops pay jit compiles
+    # per-phase distribution of the loop the metrics taxonomy measured —
+    # the estimator row is the device dispatch (host fetch included)
+    fd = metrics.function_duration
+    phases = {}
+    for phase in ("main", "estimate", "buildSnapshot", "scaleUp",
+                  "findUnneeded", "filterOutSchedulable"):
+        n = fd.count(function=phase)
+        if n:
+            phases[phase] = {
+                "count": n,
+                "p50_s": round(fd.quantile(0.5, function=phase), 4),
+                "max_s": round(fd.quantile(1.0, function=phase), 4),
+            }
+    routes = {
+        "/".join(f"{lk}={lv}" for lk, lv in k): int(v)
+        for k, v in metrics.estimator_kernel_route_total.values.items()
+    }
     print(
         json.dumps(
             {
                 "metric": f"reconcile_loop_{N}nodes_churn",
+                "platform": jax.default_backend(),
                 "nodes": N,
                 "pods": len(api.pods),
                 "loops": args.loops,
@@ -126,6 +178,8 @@ def main():
                 "loop_s_median": round(float(np.median(steady)), 3),
                 "loop_s_max": round(max(steady), 3),
                 "first_loop_s": round(times[0], 3),
+                "function_duration": phases,
+                **({"kernel_routes": routes} if routes else {}),
             }
         )
     )

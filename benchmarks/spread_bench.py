@@ -1,0 +1,155 @@
+"""Spread+affinity estimation through the PRODUCTION route.
+
+Where affinity_bench.py measures the kernels on synthetic tensors, this
+drives BinpackingNodeEstimator.estimate_many on real Pod/Node objects — the
+exact route a reconcile loop takes (term build → VMEM gate → Pallas
+affinity+spread kernel on TPU, XLA scan off it) — for a pending set that
+mixes hostname anti-affinity (replica spreading via inter-pod terms) with
+zone-level DoNotSchedule topology spread. This is the workload the
+reference prices at ~1000x (FAQ.md:151-153: inter-pod affinity) plus the
+PodTopologySpread plugin re-run per placement (schedulerbased.go:109-163).
+
+Two timed passes on identical input:
+  1. production routing (Pallas VMEM kernel on TPU, reason=ok),
+  2. the same dispatch with the VMEM gate forced shut (reason=vmem) so the
+     XLA scan serves it — the fallback cost, measured not estimated.
+Exact parity between the two is asserted before any number is reported.
+
+Env knobs: SPREAD_BENCH_P (20000), SPREAD_BENCH_G (16), SPREAD_BENCH_APPS
+(24), SPREAD_BENCH_PLATFORM=cpu pins the CPU backend (test/smoke only).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def build_world(P, G, apps, seed=0):
+    from autoscaler_tpu.kube.objects import (
+        LabelSelector,
+        TopologySpreadConstraint,
+    )
+    from autoscaler_tpu.utils.test_utils import (
+        GB,
+        anti_affinity,
+        build_test_node,
+        build_test_pod,
+    )
+
+    rng = np.random.default_rng(seed)
+    pods = []
+    for i in range(P):
+        app = int(rng.integers(0, apps))
+        p = build_test_pod(
+            f"p{i}",
+            cpu_m=int(rng.integers(50, 2000)),
+            mem=int(rng.integers(64, 8192)) * 1024 * 1024,
+            labels={"app": f"a{app}"},
+        )
+        r = rng.random()
+        if r < 0.10:
+            # replica spreading via inter-pod anti-affinity (hostname)
+            p.affinity = anti_affinity({"app": f"a{app}"})
+        elif r < 0.15:
+            # hard zone spread (DoNotSchedule)
+            p.topology_spread = (
+                TopologySpreadConstraint(
+                    max_skew=2,
+                    topology_key=ZONE,
+                    selector=LabelSelector.from_dict({"app": f"a{app}"}),
+                ),
+            )
+        pods.append(p)
+    templates = {}
+    for g in range(G):
+        t = build_test_node(
+            f"tmpl-{g}",
+            cpu_m=int(rng.choice([4000, 8000, 16000, 32000])),
+            mem=int(rng.choice([8, 16, 32, 64])) * GB,
+        )
+        t.labels[ZONE] = f"zone-{'abc'[g % 3]}"
+        templates[f"g{g}"] = t
+    return pods, templates
+
+
+def main():
+    import jax
+
+    if os.environ.get("SPREAD_BENCH_PLATFORM") == "cpu":
+        jax.config.update("jax_platforms", "cpu")  # axon site-hook workaround
+
+    from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+    from autoscaler_tpu.estimator.limiter import ThresholdBasedEstimationLimiter
+    from autoscaler_tpu.metrics.metrics import AutoscalerMetrics
+    from autoscaler_tpu.ops import pallas_binpack_affinity as pba
+
+    P = int(os.environ.get("SPREAD_BENCH_P", 20_000))
+    G = int(os.environ.get("SPREAD_BENCH_G", 16))
+    apps = int(os.environ.get("SPREAD_BENCH_APPS", 24))
+    reps = int(os.environ.get("SPREAD_BENCH_REPS", 3))
+    pods, templates = build_world(P, G, apps)
+    platform = jax.devices()[0].platform
+
+    def timed(metrics):
+        est = BinpackingNodeEstimator(
+            ThresholdBasedEstimationLimiter(max_nodes=1000), metrics=metrics
+        )
+        out = est.estimate_many(pods, templates)  # compile + warm
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = est.estimate_many(pods, templates)
+            times.append(time.perf_counter() - t0)
+        counts = {g: c for g, (c, _) in out.items()}
+        sched = {g: [p.name for p in s] for g, (_, s) in out.items()}
+        return float(np.min(times)), counts, sched
+
+    m1 = AutoscalerMetrics()
+    t_prod, counts1, sched1 = timed(m1)
+    routes1 = {
+        "/".join(f"{lk}={lv}" for lk, lv in k): int(v)
+        for k, v in m1.estimator_kernel_route_total.values.items()
+    }
+
+    # force the VMEM gate shut: identical dispatch rides the XLA scan
+    real_est = pba.affinity_vmem_estimate
+    pba.affinity_vmem_estimate = lambda *a, **kw: 10**12
+    try:
+        m2 = AutoscalerMetrics()
+        t_xla, counts2, sched2 = timed(m2)
+    finally:
+        pba.affinity_vmem_estimate = real_est
+
+    assert counts1 == counts2, "route parity violation (counts)"
+    assert sched1 == sched2, "route parity violation (scheduled sets)"
+
+    print(
+        json.dumps(
+            {
+                "metric": f"spread_affinity_estimate_{P // 1000}kp_{G}g",
+                "value": round(t_prod, 4),
+                "unit": "s_per_full_dispatch",
+                "platform": platform,
+                "p": P,
+                "g": G,
+                "production_route_s": round(t_prod, 4),
+                "forced_xla_scan_s": round(t_xla, 4),
+                "route_speedup": round(t_xla / t_prod, 2),
+                "routes_production": routes1,
+                "parity": "ok",
+                "total_nodes": int(sum(counts1.values())),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -121,6 +121,51 @@ def test_mask_unschedulable_node():
     assert not np.asarray(t.sched_mask)[0, 0]
 
 
+def test_pod_profile_interning():
+    """profile_key/profile_id: equal (namespace, labels) share one global
+    id; the id is instance-memoized and survives dataclasses.replace of
+    unrelated fields; pod_profile_value round-trips."""
+    import dataclasses
+
+    from autoscaler_tpu.kube.objects import pod_profile_value
+
+    a = build_test_pod("a", labels={"app": "web", "tier": "fe"})
+    b = build_test_pod("b", labels={"tier": "fe", "app": "web"})  # other order
+    c = build_test_pod("c", labels={"app": "web"})
+    assert a.profile_key() == b.profile_key()
+    assert a.profile_id() == b.profile_id()
+    assert a.profile_id() != c.profile_id()
+    ns, labels = pod_profile_value(a.profile_id())
+    assert ns == a.namespace and labels == a.labels
+    a2 = dataclasses.replace(a, priority=7)
+    assert a2.profile_id() == a.profile_id()
+
+
+def test_pod_profile_registry_epoch_reset(monkeypatch):
+    """Past the cap the registry resets (long-lived leaders see per-pod-
+    unique labels — controller-revision-hash etc. — and must not grow
+    without bound); memoized ids from the old epoch lazily re-intern and
+    pod_profile_value stays consistent."""
+    from autoscaler_tpu.kube import objects as o
+
+    monkeypatch.setattr(o, "_POD_PROFILE_CAP", 2)
+    old = build_test_pod("old", labels={"k": "old"})
+    old_id = old.profile_id()
+    # mint fresh profiles until a reset happens
+    fresh = [
+        build_test_pod(f"f{i}", labels={"rev": f"r{i}-{id(object())}"})
+        for i in range(4)
+    ]
+    for p in fresh:
+        p.profile_id()
+    # old pod's memo is from a previous epoch: re-intern, stay consistent
+    nid = old.profile_id()
+    ns, labels = o.pod_profile_value(nid)
+    assert ns == old.namespace and labels == old.labels
+    assert old.profile_id() == nid  # stable within the new epoch
+    del old_id
+
+
 class TestClusterSnapshot:
     def test_add_and_list(self):
         s = ClusterSnapshot()
