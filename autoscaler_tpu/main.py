@@ -79,7 +79,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--expendable-pods-priority-cutoff", type=int, default=-10)
     p.add_argument("--provider", "--cloud-provider", default="test",
                    help="cloud provider (reference --cloud-provider): test, "
-                        "gce, externalgrpc (native tensor protocol), or "
+                        "gce, clusterapi (MachineDeployment/MachineSet "
+                        "scaling over the management cluster's CRD API), "
+                        "externalgrpc (native tensor protocol), or "
                         "externalgrpc-ref (the reference's externalgrpc.proto "
                         "wire format — existing provider binaries plug in "
                         "unmodified)")
@@ -559,10 +561,48 @@ def main(argv=None) -> int:
             from autoscaler_tpu.rpc.refcompat import RefProtocolCloudProvider
 
             provider = RefProtocolCloudProvider(address)
+    elif args.provider == "clusterapi":
+        # the management cluster IS the cloud: scale MachineDeployments/
+        # MachineSets through the same control plane the autoscaler watches
+        # (reference cloudprovider/clusterapi; annotation-driven discovery)
+        if not (args.kube_api or args.kubeconfig):
+            print(
+                "--provider=clusterapi requires a management-cluster "
+                "binding (--kube-api or --kubeconfig)",
+                file=sys.stderr,
+            )
+            return 2
+        from autoscaler_tpu.cloudprovider.clusterapi import (
+            build_clusterapi_provider,
+        )
+        from autoscaler_tpu.kube.client import KubeRestClient
+
+        # same construction rules (incl. in-cluster + qps/burst throttling
+        # + clean kubeconfig failure) as the kube-client block below
+        if args.kubeconfig:
+            try:
+                capi_rest = KubeRestClient.from_kubeconfig(
+                    args.kubeconfig, user_agent=opts.user_agent,
+                    qps=args.kube_client_qps, burst=args.kube_client_burst,
+                )
+            except (OSError, ValueError) as e:
+                print(f"--kubeconfig {args.kubeconfig}: {e}", file=sys.stderr)
+                return 2
+        elif args.kube_api == "in-cluster":
+            capi_rest = KubeRestClient.in_cluster(
+                user_agent=opts.user_agent,
+                qps=args.kube_client_qps, burst=args.kube_client_burst,
+            )
+        else:
+            capi_rest = KubeRestClient(
+                args.kube_api, user_agent=opts.user_agent,
+                qps=args.kube_client_qps, burst=args.kube_client_burst,
+            )
+        provider = build_clusterapi_provider(capi_rest)
     else:
         print(
             f"unknown cloud provider {args.provider!r} (available: test, "
-            "gce, externalgrpc, externalgrpc-ref)",
+            "gce, externalgrpc, externalgrpc-ref, clusterapi)",
             file=sys.stderr,
         )
         return 2

@@ -1,0 +1,465 @@
+"""Cluster API provider — annotation-driven discovery, scale semantics,
+node→machine→group resolution, scale subresource wire path.
+
+Reference behaviors pinned: clusterapi_nodegroup.go (IncreaseSize,
+DeleteNodes mark+shrink with rollback, DecreaseTargetSize bounds,
+TemplateNodeInfo gated on CanScaleFromZero), clusterapi_controller.go
+(nodeGroupForNode via machine ownership), clusterapi_utils.go (annotation
+keys, capacity parsing).
+"""
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from autoscaler_tpu.cloudprovider.clusterapi import (
+    CPU_KEY,
+    GPU_COUNT_KEY,
+    LABELS_KEY,
+    MAX_PODS_KEY,
+    MEMORY_KEY,
+    TAINTS_KEY,
+    ClusterAPIProvider,
+    InMemoryCapiApi,
+    RestCapiApi,
+    delete_machine_key,
+    machine_annotation_key,
+    max_size_key,
+    min_size_key,
+)
+from autoscaler_tpu.cloudprovider.interface import InstanceState, NodeGroupError
+from autoscaler_tpu.utils.test_utils import build_test_node
+
+
+def md(name, ns="default", replicas=3, min_size=1, max_size=10, ann=None):
+    a = {min_size_key(): str(min_size), max_size_key(): str(max_size)}
+    a.update(ann or {})
+    return {
+        "kind": "MachineDeployment",
+        "metadata": {"name": name, "namespace": ns, "annotations": a},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"md": name}},
+        },
+    }
+
+
+def ms(name, ns="default", owner_md=None, replicas=3, annotated=False):
+    meta = {"name": name, "namespace": ns, "annotations": {}}
+    if owner_md:
+        meta["ownerReferences"] = [
+            {"kind": "MachineDeployment", "name": owner_md, "controller": True}
+        ]
+    if annotated:
+        meta["annotations"] = {min_size_key(): "0", max_size_key(): "5"}
+    sel = {"md": owner_md} if owner_md else {"ms": name}
+    return {
+        "kind": "MachineSet",
+        "metadata": meta,
+        "spec": {"replicas": replicas, "selector": {"matchLabels": sel}},
+    }
+
+
+def machine(name, ns="default", owner_ms=None, labels=None, provider_id=None,
+            phase="Running", deleting=False):
+    meta = {"name": name, "namespace": ns, "labels": labels or {}}
+    if owner_ms:
+        meta["ownerReferences"] = [
+            {"kind": "MachineSet", "name": owner_ms, "controller": True}
+        ]
+    if deleting:
+        meta["deletionTimestamp"] = "2026-07-31T00:00:00Z"
+    spec = {}
+    if provider_id:
+        spec["providerID"] = provider_id
+    return {
+        "kind": "Machine",
+        "metadata": meta,
+        "spec": spec,
+        "status": {"phase": phase},
+    }
+
+
+def capi_node(name, machine_ref, provider_id=""):
+    n = build_test_node(name, cpu_m=4000)
+    n.annotations[machine_annotation_key()] = machine_ref
+    n.provider_id = provider_id
+    return n
+
+
+def world():
+    """One MD-managed group (MD annotated, its MS not) + one standalone
+    annotated MS + one unmanaged MS."""
+    api = InMemoryCapiApi()
+    api.add(md("web", replicas=2))
+    api.add(ms("web-abc", owner_md="web", replicas=2))
+    api.add(ms("solo", annotated=True, replicas=1))
+    api.add(ms("plain", replicas=4))  # no annotations → not managed
+    for i in range(2):
+        api.add(machine(f"web-abc-{i}", owner_ms="web-abc",
+                        labels={"md": "web"},
+                        provider_id=f"capi:////web-{i}"))
+    api.add(machine("solo-0", owner_ms="solo", labels={"ms": "solo"},
+                    provider_id="capi:////solo-0"))
+    return api
+
+
+class TestDiscovery:
+    def test_annotated_resources_become_groups(self):
+        p = ClusterAPIProvider(world())
+        ids = sorted(g.id() for g in p.node_groups())
+        assert ids == [
+            "MachineDeployment/default/web",
+            "MachineSet/default/solo",
+        ]
+
+    def test_zero_replica_group_needs_capacity_annotations(self):
+        api = InMemoryCapiApi()
+        api.add(md("cold", replicas=0))
+        assert ClusterAPIProvider(api).node_groups() == []
+        api.add(md("warm", replicas=0, ann={CPU_KEY: "4", MEMORY_KEY: "16Gi"}))
+        p = ClusterAPIProvider(api)
+        assert [g.id() for g in p.node_groups()] == [
+            "MachineDeployment/default/warm"
+        ]
+
+    def test_sizes_and_target(self):
+        p = ClusterAPIProvider(world())
+        g = {x.id(): x for x in p.node_groups()}["MachineDeployment/default/web"]
+        assert (g.min_size(), g.max_size(), g.target_size()) == (1, 10, 2)
+
+
+class TestNodeGroupForNode:
+    def test_via_machine_annotation_md_owns(self):
+        p = ClusterAPIProvider(world())
+        node = capi_node("web-0", "default/web-abc-0")
+        g = p.node_group_for_node(node)
+        assert g is not None and g.id() == "MachineDeployment/default/web"
+
+    def test_via_provider_id_fallback(self):
+        p = ClusterAPIProvider(world())
+        node = build_test_node("solo-0", cpu_m=4000)
+        node.provider_id = "capi:////solo-0"
+        g = p.node_group_for_node(node)
+        assert g is not None and g.id() == "MachineSet/default/solo"
+
+    def test_unknown_node(self):
+        p = ClusterAPIProvider(world())
+        assert p.node_group_for_node(build_test_node("stray")) is None
+
+
+class TestScaling:
+    def test_increase_size_writes_scale(self):
+        api = world()
+        p = ClusterAPIProvider(api)
+        g = {x.id(): x for x in p.node_groups()}["MachineDeployment/default/web"]
+        g.increase_size(3)
+        assert api.get_scale("MachineDeployment", "default", "web") == 5
+        assert ("scale", "MachineDeployment", "default", "web", 5) in api.writes
+
+    def test_increase_past_max_refused(self):
+        p = ClusterAPIProvider(world())
+        g = {x.id(): x for x in p.node_groups()}["MachineDeployment/default/web"]
+        with pytest.raises(NodeGroupError, match="too large"):
+            g.increase_size(100)
+
+    def test_delete_nodes_marks_and_shrinks(self):
+        api = world()
+        p = ClusterAPIProvider(api)
+        g = {x.id(): x for x in p.node_groups()}["MachineDeployment/default/web"]
+        node = capi_node("web-0", "default/web-abc-0")
+        g.delete_nodes([node])
+        assert api.get_scale("MachineDeployment", "default", "web") == 1
+        m = api.objects[("Machine", "default", "web-abc-0")]
+        assert delete_machine_key() in m["metadata"]["annotations"]
+
+    def test_delete_foreign_node_refused(self):
+        p = ClusterAPIProvider(world())
+        g = {x.id(): x for x in p.node_groups()}["MachineDeployment/default/web"]
+        foreign = capi_node("solo-0", "default/solo-0")
+        with pytest.raises(NodeGroupError, match="doesn't belong"):
+            g.delete_nodes([foreign])
+
+    def test_delete_below_min_refused(self):
+        api = InMemoryCapiApi()
+        api.add(md("tight", replicas=1, min_size=1))
+        api.add(ms("tight-1", owner_md="tight", replicas=1))
+        api.add(machine("tight-m", owner_ms="tight-1", labels={"md": "tight"},
+                        provider_id="capi:////t0"))
+        p = ClusterAPIProvider(api)
+        g = p.node_groups()[0]
+        with pytest.raises(NodeGroupError, match="min size"):
+            g.delete_nodes([capi_node("t", "default/tight-m")])
+
+    def test_decrease_target_cannot_delete_existing(self):
+        api = world()
+        api.set_scale("MachineDeployment", "default", "web", 4)
+        p = ClusterAPIProvider(api)
+        g = {x.id(): x for x in p.node_groups()}["MachineDeployment/default/web"]
+        g.decrease_target_size(-2)  # 4 -> 2 == provisioned machines: fine
+        assert g.target_size() == 2
+        with pytest.raises(NodeGroupError, match="existing"):
+            g.decrease_target_size(-1)  # would dip below the 2 machines
+
+
+class TestInstancesAndTemplate:
+    def test_instance_states(self):
+        api = world()
+        api.add(machine("web-abc-new", owner_ms="web-abc", labels={"md": "web"},
+                        phase="Provisioning"))
+        api.add(machine("web-abc-dying", owner_ms="web-abc", labels={"md": "web"},
+                        provider_id="capi:////dying", deleting=True))
+        p = ClusterAPIProvider(api)
+        g = {x.id(): x for x in p.node_groups()}["MachineDeployment/default/web"]
+        by_id = {i.id: i.state for i in g.nodes()}
+        assert by_id["capi:////web-0"] == InstanceState.RUNNING
+        assert by_id["capi://default/web-abc-new"] == InstanceState.CREATING
+        assert by_id["capi:////dying"] == InstanceState.DELETING
+
+    def test_template_from_capacity_annotations(self):
+        api = InMemoryCapiApi()
+        api.add(md("gpu", replicas=0, ann={
+            CPU_KEY: "8", MEMORY_KEY: "32Gi", GPU_COUNT_KEY: "2",
+            MAX_PODS_KEY: "58",
+            LABELS_KEY: "pool=gpu,zone=z1",
+            TAINTS_KEY: "nvidia.com/gpu=present:NoSchedule",
+        }))
+        p = ClusterAPIProvider(api)
+        t = p.node_groups()[0].template_node_info()
+        assert t.allocatable.cpu_m == 8000
+        assert t.allocatable.memory == 32 * 1024**3
+        assert t.allocatable.gpu == 2
+        assert t.allocatable.pods == 58
+        assert t.labels["pool"] == "gpu" and t.labels["zone"] == "z1"
+        assert t.taints[0].key == "nvidia.com/gpu"
+        assert t.taints[0].effect == "NoSchedule"
+
+    def test_template_without_capacity_refused(self):
+        p = ClusterAPIProvider(world())
+        g = {x.id(): x for x in p.node_groups()}["MachineDeployment/default/web"]
+        with pytest.raises(NodeGroupError, match="scale from zero"):
+            g.template_node_info()
+
+
+class TestResilience:
+    def test_malformed_annotation_skips_one_resource(self, caplog):
+        """A typo'd max-size on ONE resource must not disable autoscaling
+        for the whole cluster (the reference logs and skips too)."""
+        import logging
+
+        api = world()
+        api.add(md("broken", ann={max_size_key(): "ten"}))
+        with caplog.at_level(logging.WARNING, logger="clusterapi"):
+            p = ClusterAPIProvider(api)
+        ids = sorted(g.id() for g in p.node_groups())
+        assert ids == [
+            "MachineDeployment/default/web",
+            "MachineSet/default/solo",
+        ]
+        assert any("broken" in r.message for r in caplog.records)
+
+    def test_delete_rollback_on_transport_failure(self):
+        """A shrink that dies in transport (not a bound check) must unmark
+        the machine — otherwise the CAPI controller reaps it on the next
+        unrelated scale-down (clusterapi_nodegroup.go:160-163)."""
+
+        class FlakyApi(InMemoryCapiApi):
+            def set_scale(self, kind, ns, name, replicas):
+                raise ConnectionError("api server hiccup")
+
+        api = FlakyApi()
+        api.add(md("web", replicas=2))
+        api.add(ms("web-abc", owner_md="web", replicas=2))
+        for i in range(2):
+            api.add(machine(f"web-abc-{i}", owner_ms="web-abc",
+                            labels={"md": "web"},
+                            provider_id=f"capi:////web-{i}"))
+        p = ClusterAPIProvider(api)
+        g = p.node_groups()[0]
+        with pytest.raises(ConnectionError):
+            g.delete_nodes([capi_node("web-0", "default/web-abc-0")])
+        m = api.objects[("Machine", "default", "web-abc-0")]
+        assert delete_machine_key() not in (
+            m["metadata"].get("annotations") or {}
+        )
+
+    def test_lookups_use_refresh_snapshot_not_per_call_lists(self):
+        """node_group_for_node for N nodes must not issue N cluster-wide
+        LISTs — lookups read the refresh-scoped memo."""
+
+        class CountingApi(InMemoryCapiApi):
+            def __init__(self):
+                super().__init__()
+                self.list_calls = 0
+
+            def list_machines(self, namespace):
+                self.list_calls += 1
+                return super().list_machines(namespace)
+
+        api = CountingApi()
+        api.add(md("web", replicas=2))
+        api.add(ms("web-abc", owner_md="web", replicas=2))
+        for i in range(2):
+            api.add(machine(f"web-abc-{i}", owner_ms="web-abc",
+                            labels={"md": "web"},
+                            provider_id=f"capi:////web-{i}"))
+        p = ClusterAPIProvider(api)
+        api.list_calls = 0
+        for i in range(10):
+            g = p.node_group_for_node(
+                capi_node(f"n{i}", f"default/web-abc-{i % 2}")
+            )
+            assert g is not None
+        assert api.list_calls <= 1  # one memo fill, not one per call
+
+
+class FakeCapiServer:
+    """Minimal CRD API server: cluster-wide lists, the scale subresource,
+    and machine merge-patches — what RestCapiApi actually speaks."""
+
+    def __init__(self, api: InMemoryCapiApi):
+        self.api = api
+        self.requests = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _route(self):
+                # /apis/cluster.x-k8s.io/v1beta1/...
+                parts = self.path.split("?")[0].strip("/").split("/")
+                outer.requests.append((self.command, self.path))
+                return parts
+
+            def do_GET(self):
+                parts = self._route()
+                plural_kind = {
+                    "machinedeployments": "MachineDeployment",
+                    "machinesets": "MachineSet",
+                    "machines": "Machine",
+                }
+                if parts[-1] in plural_kind:  # cluster or ns list
+                    kind = plural_kind[parts[-1]]
+                    ns = parts[parts.index("namespaces") + 1] \
+                        if "namespaces" in parts else None
+                    items = [
+                        o for (k, n, _), o in sorted(outer.api.objects.items())
+                        if k == kind and (ns is None or n == ns)
+                    ]
+                    self._send(200, {"items": items})
+                elif parts[-1] == "scale":
+                    kind = plural_kind[parts[-3]]
+                    ns, name = parts[parts.index("namespaces") + 1], parts[-2]
+                    self._send(200, {
+                        "kind": "Scale",
+                        "metadata": {"name": name, "namespace": ns},
+                        "spec": {"replicas": outer.api.get_scale(kind, ns, name)},
+                    })
+                else:
+                    self._send(404, {})
+
+            def do_PUT(self):
+                parts = self._route()
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length))
+                if parts[-1] == "scale":
+                    plural_kind = {
+                        "machinedeployments": "MachineDeployment",
+                        "machinesets": "MachineSet",
+                    }
+                    kind = plural_kind[parts[-3]]
+                    ns, name = parts[parts.index("namespaces") + 1], parts[-2]
+                    outer.api.set_scale(
+                        kind, ns, name, body["spec"]["replicas"]
+                    )
+                    self._send(200, body)
+                else:
+                    self._send(404, {})
+
+            def do_PATCH(self):
+                parts = self._route()
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length))
+                if parts[-2] == "machines" or parts[-3] == "machines":
+                    ns = parts[parts.index("namespaces") + 1]
+                    name = parts[-1]
+                    for key, value in (
+                        body.get("metadata", {}).get("annotations", {}) or {}
+                    ).items():
+                        outer.api.annotate_machine(ns, name, key, value)
+                    self._send(200, {})
+                else:
+                    self._send(404, {})
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+
+
+class TestRestWirePath:
+    def test_rest_api_end_to_end(self):
+        from autoscaler_tpu.kube.client import KubeRestClient
+
+        backing = world()
+        srv = FakeCapiServer(backing)
+        try:
+            rest = KubeRestClient(f"http://127.0.0.1:{srv.port}")
+            p = ClusterAPIProvider(RestCapiApi(rest))
+            ids = sorted(g.id() for g in p.node_groups())
+            assert ids == [
+                "MachineDeployment/default/web",
+                "MachineSet/default/solo",
+            ]
+            g = {x.id(): x for x in p.node_groups()}[
+                "MachineDeployment/default/web"
+            ]
+            g.increase_size(2)
+            assert backing.get_scale("MachineDeployment", "default", "web") == 4
+            # delete over the wire: scale PUT + machine PATCH
+            node = capi_node("web-0", "default/web-abc-0")
+            g.delete_nodes([node])
+            assert backing.get_scale("MachineDeployment", "default", "web") == 3
+            m = backing.objects[("Machine", "default", "web-abc-0")]
+            assert delete_machine_key() in m["metadata"]["annotations"]
+            methods = {c for c, _ in srv.requests}
+            assert {"GET", "PUT", "PATCH"} <= methods
+        finally:
+            srv.close()
+
+
+class TestControlLoopIntegration:
+    def test_scale_up_through_run_once(self):
+        """A pending pod no existing node absorbs drives run_once to
+        increase the MachineDeployment's scale — the provider inside the
+        real decision path (scale-from-zero template via capacity
+        annotations)."""
+        from autoscaler_tpu.config.options import AutoscalingOptions
+        from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+        from autoscaler_tpu.kube.api import FakeClusterAPI
+        from autoscaler_tpu.utils.test_utils import build_test_pod
+
+        api = InMemoryCapiApi()
+        api.add(md("workers", replicas=0, min_size=0, max_size=5, ann={
+            CPU_KEY: "8", MEMORY_KEY: "32Gi",
+        }))
+        provider = ClusterAPIProvider(api)
+        kube = FakeClusterAPI()
+        pod = build_test_pod("pending-1", cpu_m=2000)
+        kube.add_pod(pod)
+        opts = AutoscalingOptions()
+        autoscaler = StaticAutoscaler(provider, kube, opts)
+        autoscaler.run_once(now_ts=1000.0)
+        assert api.get_scale("MachineDeployment", "default", "workers") >= 1
