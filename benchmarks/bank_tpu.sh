@@ -1,31 +1,35 @@
 #!/bin/bash
 # Bank every TPU capture the round needs, in value order, continue on failure.
+# Outputs land in the repo tree (benchmarks/captures/) so the driver's
+# end-of-round commit preserves them even if banking happens after the
+# builder's last turn.
 cd /root/repo
 LOG=/tmp/bank_tpu.log
 CAP=benchmarks/captures
 echo "=== bank start $(date -u +%FT%TZ)" >> $LOG
 
-run() {  # run <name> <timeout_s> <cmd...>
-  local name=$1 tmo=$2; shift 2
+run() {  # run <name> <outfile> <timeout_s> <cmd...>
+  local name=$1 out=$2 tmo=$3; shift 3
   echo "--- $name $(date +%H:%M:%S)" >> $LOG
-  timeout "$tmo" "$@" > /tmp/bank_$name.out 2>> $LOG
+  timeout "$tmo" "$@" > /tmp/bank_$name.raw 2>> $LOG
   local rc=$?
   echo "rc=$rc" >> $LOG
-  tail -1 /tmp/bank_$name.out >> $LOG
+  # keep only the JSON line in the repo capture; raw stays in /tmp
+  local json
+  json=$(grep -E "^\{" /tmp/bank_$name.raw | tail -1)
+  if [ -n "$json" ]; then
+    echo "$json" > "$out"
+    echo "banked $out" >> $LOG
+  fi
+  tail -1 /tmp/bank_$name.raw >> $LOG
   return $rc
 }
 
-# 1+2: the north star, twice (consecutive-run robustness)
-run bench1 2400 python bench.py
-run bench2 2400 python bench.py
-# 3: the defining claim vs the reference's ~1000x pain point
-run affinity 1800 python benchmarks/affinity_bench.py
-# 4: spread+affinity through the production estimator route
-run spread 1800 python benchmarks/spread_bench.py
-# 5: bf16 fit decision data
-run bf16 1200 python benchmarks/bf16_bench.py
-# 6: the VMEM cliff, measured on both sides
-run cliff 1800 python benchmarks/cliff_sweep.py
-# 7: full reconcile loop with the TPU estimator inside
-run churn_tpu 3000 python benchmarks/churn_bench.py --platform tpu --nodes 15000 --loops 6 --xla-cache /tmp/xla_tpu_cache
+run bench1 $CAP/bench_tpu_r5_run1.json 2400 python bench.py
+run bench2 $CAP/bench_tpu_r5_run2.json 2400 python bench.py
+run affinity $CAP/affinity_tpu_r5.json 1800 python benchmarks/affinity_bench.py
+run spread $CAP/spread_tpu_r5.json 1800 python benchmarks/spread_bench.py
+run bf16 $CAP/bf16_tpu_r5.json 1200 python benchmarks/bf16_bench.py
+run cliff $CAP/cliff_tpu_r5.json 1800 python benchmarks/cliff_sweep.py
+run churn_tpu $CAP/churn_tpu_15k_r5.json 3000 python benchmarks/churn_bench.py --platform tpu --nodes 15000 --loops 6 --xla-cache /tmp/xla_tpu_cache
 echo "=== bank done $(date -u +%FT%TZ)" >> $LOG
