@@ -434,6 +434,9 @@ def run_loop(
 
 
 def main(argv=None) -> int:
+    from autoscaler_tpu.utils.tpu import pin_cpu_if_requested
+
+    pin_cpu_if_requested()  # axon site-hook workaround (see the helper)
     args = build_arg_parser().parse_args(argv)
     opts = options_from_args(args)
     from autoscaler_tpu.utils import klogx

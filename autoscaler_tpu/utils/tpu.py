@@ -11,11 +11,29 @@ native tpu-axis requests.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import List, Sequence
 
 from autoscaler_tpu.kube.objects import Pod
 
 LEGACY_TPU_PREFIX = "cloud-tpus.google.com/"
+
+
+def pin_cpu_if_requested() -> None:
+    """Honor a JAX_PLATFORMS=cpu request BEFORE any device use.
+
+    A site hook (the axon TPU plugin) can re-pin the platform at import,
+    overriding the env var alone — only jax.config.update sticks. Backends
+    initialize lazily, so calling this at entry-point start is early
+    enough even after jax.numpy has been imported. Shared by the process
+    entry points (vpa/main, main; benches/graft entry/conftest mirror the
+    same rule); accepts the comma-list form ('cpu,tpu' pins the leading
+    request) the exact-match copies missed."""
+    req = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if req == "cpu" or req.startswith("cpu,"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
 
 def clear_tpu_requests(pods: Sequence[Pod], strip_native: bool = False) -> List[Pod]:

@@ -292,6 +292,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    from autoscaler_tpu.utils.tpu import pin_cpu_if_requested
+
+    pin_cpu_if_requested()  # axon site-hook workaround (see the helper)
     args = build_arg_parser().parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     components = tuple(c.strip() for c in args.components.split(",") if c.strip())

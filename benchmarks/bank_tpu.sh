@@ -6,7 +6,8 @@
 cd /root/repo
 LOG=/tmp/bank_tpu.log
 CAP=benchmarks/captures
-echo "=== bank start $(date -u +%FT%TZ)" >> $LOG
+ROUND=${ROUND:-r5}
+echo "=== bank start $(date -u +%FT%TZ) round=$ROUND" >> $LOG
 
 run() {  # run <name> <outfile> <timeout_s> <cmd...>
   local name=$1 out=$2 tmo=$3; shift 3
@@ -17,19 +18,24 @@ run() {  # run <name> <outfile> <timeout_s> <cmd...>
   # keep only the JSON line in the repo capture; raw stays in /tmp
   local json
   json=$(grep -E "^\{" /tmp/bank_$name.raw | tail -1)
-  if [ -n "$json" ]; then
+  # bank only a COMPLETE run's parseable JSON — a timeout mid-print must
+  # not land a truncated line in the committed round evidence
+  if [ $rc -eq 0 ] && [ -n "$json" ] && \
+     echo "$json" | python -c "import json,sys; json.load(sys.stdin)" 2>/dev/null; then
     echo "$json" > "$out"
     echo "banked $out" >> $LOG
+  else
+    echo "NOT banked ($out): rc=$rc json_ok=$([ -n \"$json\" ] && echo maybe || echo empty)" >> $LOG
   fi
   tail -1 /tmp/bank_$name.raw >> $LOG
   return $rc
 }
 
-run bench1 $CAP/bench_tpu_r5_run1.json 2400 python bench.py
-run bench2 $CAP/bench_tpu_r5_run2.json 2400 python bench.py
-run affinity $CAP/affinity_tpu_r5.json 1800 python benchmarks/affinity_bench.py
-run spread $CAP/spread_tpu_r5.json 1800 python benchmarks/spread_bench.py
-run bf16 $CAP/bf16_tpu_r5.json 1200 python benchmarks/bf16_bench.py
-run cliff $CAP/cliff_tpu_r5.json 1800 python benchmarks/cliff_sweep.py
-run churn_tpu $CAP/churn_tpu_15k_r5.json 3000 python benchmarks/churn_bench.py --platform tpu --nodes 15000 --loops 6 --xla-cache /tmp/xla_tpu_cache
+run bench1 $CAP/bench_tpu_${ROUND}_run1.json 2400 python bench.py
+run bench2 $CAP/bench_tpu_${ROUND}_run2.json 2400 python bench.py
+run affinity $CAP/affinity_tpu_${ROUND}.json 1800 python benchmarks/affinity_bench.py
+run spread $CAP/spread_tpu_${ROUND}.json 1800 python benchmarks/spread_bench.py
+run bf16 $CAP/bf16_tpu_${ROUND}.json 1200 python benchmarks/bf16_bench.py
+run cliff $CAP/cliff_tpu_${ROUND}.json 1800 python benchmarks/cliff_sweep.py
+run churn_tpu $CAP/churn_tpu_15k_${ROUND}.json 3000 python benchmarks/churn_bench.py --platform tpu --nodes 15000 --loops 6 --xla-cache /tmp/xla_tpu_cache
 echo "=== bank done $(date -u +%FT%TZ)" >> $LOG

@@ -492,3 +492,49 @@ class TestRecommenderKnobs:
         # a 3-replica workload is untouchable at min_replicas=4
         assert runner.updater.rate_limiter.budget_for(3) == 0
         assert runner.updater.rate_limiter.budget_for(8) == 2
+
+
+class TestVpaProcessE2E:
+    """The VPA as a real OS process (python -m autoscaler_tpu.vpa.main)
+    against the recorded API server — the closest this environment gets to
+    the reference's real-cluster ginkgo e2e (e2e/v1): full argv surface,
+    process bootstrap, HTTP loop, clean exit via --max-iterations."""
+
+    def test_recommender_updater_process(self, srv, tmp_path):
+        import subprocess
+        import sys
+
+        srv.vpas["default/hamster-vpa"] = vpa_json()
+        srv.deployments["default/hamster"] = deployment_json()
+        for i in range(3):
+            srv.pods[f"default/hamster-{i}"] = pod_json(
+                f"hamster-{i}", cpu="10m", mem="32Mi", labels=LABELS
+            )
+        # usage far above requests → drift → recommendation + eviction
+        srv.pod_metrics = [
+            metrics_json(f"hamster-{i}", cpu="900m", mem="600000k")
+            for i in range(3)
+        ]
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "autoscaler_tpu.vpa.main",
+                "--kube-api", srv.url,
+                "--components", "recommender,updater",
+                "--scrape-interval", "0.1",
+                "--max-iterations", "3",
+                "--checkpoint-file", str(tmp_path / "ckpt.json"),
+            ],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        status = srv.vpas["default/hamster-vpa"].get("status") or {}
+        recs = (status.get("recommendation") or {}).get(
+            "containerRecommendations"
+        )
+        assert recs and recs[0]["containerName"] == "hamster"
+        assert int(recs[0]["target"]["cpu"].rstrip("m")) >= 900
+        evictions = [
+            p for (m, p) in srv.writes if "eviction" in p
+        ]
+        assert evictions, "drifted pods were never evicted"
+        assert (tmp_path / "ckpt.json").exists()
