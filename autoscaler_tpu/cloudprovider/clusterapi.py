@@ -85,6 +85,56 @@ _KIND_PLURAL = {
 }
 
 
+def cluster_name_label() -> str:
+    return f"{capi_group()}/cluster-name"
+
+
+class AutoDiscoverySpec:
+    """One parsed --node-group-auto-discovery entry:
+    'clusterapi:namespace=ns,clusterName=c,key=value,...' — unknown keys
+    are exact-match label requirements (clusterapi_autodiscovery.go:37)."""
+
+    def __init__(self, spec: str):
+        discoverer, sep, body = spec.partition(":")
+        if not sep or discoverer != "clusterapi":
+            raise ValueError(
+                f"spec {spec!r} should be clusterapi:key=value,key=value"
+            )
+        self.namespace = ""
+        self.cluster_name = ""
+        self.labels: Dict[str, str] = {}
+        for arg in body.split(","):
+            if not arg:
+                continue
+            k, s, v = arg.partition("=")
+            if not s:
+                raise ValueError(f"invalid key=value pair {arg!r} in {spec!r}")
+            if k == "namespace":
+                self.namespace = v
+            elif k == "clusterName":
+                self.cluster_name = v
+            else:
+                self.labels[k] = v
+
+    def allows(self, obj: dict) -> bool:
+        meta = _meta(obj)
+        if self.namespace and self.namespace != meta.get("namespace", "default"):
+            return False
+        if self.cluster_name and self.cluster_name != _cluster_name_of(obj):
+            return False
+        labels = meta.get("labels") or {}
+        return all(labels.get(k) == v for k, v in self.labels.items())
+
+
+def _cluster_name_of(obj: dict) -> str:
+    """spec.clusterName when present (v1alpha3+), else the cluster-name
+    label (clusterapi_utils.go:232 clusterNameFromResource)."""
+    name = (obj.get("spec") or {}).get("clusterName")
+    if name:
+        return str(name)
+    return (_meta(obj).get("labels") or {}).get(cluster_name_label(), "")
+
+
 class CapiApi(abc.ABC):
     """Management-cluster transport for the CAPI objects the provider
     consumes. Objects travel as raw dicts (the CRD JSON shape)."""
@@ -465,8 +515,9 @@ class ClusterAPIProvider(CloudProvider):
     that cannot scale from zero — both gates from
     newNodeGroupFromScalableResource (clusterapi_nodegroup.go:335)."""
 
-    def __init__(self, api: CapiApi):
+    def __init__(self, api: CapiApi, discovery_specs: Sequence["AutoDiscoverySpec"] = ()):
         self.api = api
+        self.discovery_specs = list(discovery_specs)
         self._groups: List[CapiNodeGroup] = []
         self._by_id: Dict[str, CapiNodeGroup] = {}
         self._owner_md: Dict[Tuple[str, str], Optional[str]] = {}
@@ -493,6 +544,10 @@ class ClusterAPIProvider(CloudProvider):
                 owner_md[(ns, meta.get("name", ""))] = _owner_of(
                     obj, "MachineDeployment"
                 )
+            if self.discovery_specs and not any(
+                spec.allows(obj) for spec in self.discovery_specs
+            ):
+                continue  # outside every autodiscovery scope
             try:
                 s = CapiScalable(self.api, obj)
                 if s.max_size - s.min_size < 1:
@@ -565,6 +620,14 @@ class ClusterAPIProvider(CloudProvider):
         return None
 
 
-def build_clusterapi_provider(rest, version: str = "v1beta1") -> ClusterAPIProvider:
-    """Provider over a live management cluster (rest = KubeRestClient)."""
-    return ClusterAPIProvider(RestCapiApi(rest, version=version))
+def build_clusterapi_provider(
+    rest,
+    version: str = "v1beta1",
+    auto_discovery: Sequence[str] = (),
+) -> ClusterAPIProvider:
+    """Provider over a live management cluster (rest = KubeRestClient).
+    ``auto_discovery``: raw --node-group-auto-discovery entries; only the
+    clusterapi: ones apply (others raise, matching the reference's
+    unsupported-discoverer error)."""
+    specs = [AutoDiscoverySpec(s) for s in auto_discovery]
+    return ClusterAPIProvider(RestCapiApi(rest, version=version), specs)

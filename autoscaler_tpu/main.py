@@ -601,7 +601,13 @@ def main(argv=None) -> int:
                 args.kube_api, user_agent=opts.user_agent,
                 qps=args.kube_client_qps, burst=args.kube_client_burst,
             )
-        provider = build_clusterapi_provider(capi_rest)
+        try:
+            provider = build_clusterapi_provider(
+                capi_rest, auto_discovery=opts.node_group_auto_discovery
+            )
+        except ValueError as e:
+            print(f"--node-group-auto-discovery: {e}", file=sys.stderr)
+            return 2
     else:
         print(
             f"unknown cloud provider {args.provider!r} (available: test, "

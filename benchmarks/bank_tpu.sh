@@ -25,7 +25,7 @@ run() {  # run <name> <outfile> <timeout_s> <cmd...>
     echo "$json" > "$out"
     echo "banked $out" >> $LOG
   else
-    echo "NOT banked ($out): rc=$rc json_ok=$([ -n \"$json\" ] && echo maybe || echo empty)" >> $LOG
+    echo "NOT banked ($out): rc=$rc json_ok=$([ -n "$json" ] && echo maybe || echo empty)" >> $LOG
   fi
   tail -1 /tmp/bank_$name.raw >> $LOG
   return $rc

@@ -44,6 +44,9 @@ def main():
     args = ap.parse_args()
     sizes = [int(s) for s in args.sizes.split(",")]
 
+    from autoscaler_tpu.utils.tpu import pin_cpu_if_requested
+
+    pin_cpu_if_requested()  # JAX_PLATFORMS=cpu convention, site-hook-proof
     import jax
     import jax.numpy as jnp
 

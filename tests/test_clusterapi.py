@@ -242,6 +242,77 @@ class TestInstancesAndTemplate:
             g.template_node_info()
 
 
+class TestAutoDiscovery:
+    """--node-group-auto-discovery=clusterapi:... filtering
+    (clusterapi_autodiscovery.go: namespace / clusterName / exact-match
+    label requirements; multiple specs OR together)."""
+
+    def _api(self):
+        from autoscaler_tpu.cloudprovider.clusterapi import cluster_name_label
+
+        api = InMemoryCapiApi()
+        a = md("web-a", ns="team-a")
+        a["metadata"]["labels"] = {cluster_name_label(): "prod"}
+        api.add(a)
+        b = md("web-b", ns="team-b")
+        b["spec"]["clusterName"] = "staging"
+        api.add(b)
+        c = md("web-c", ns="team-a")
+        c["metadata"]["labels"] = {"tier": "gpu"}
+        api.add(c)
+        return api
+
+    def test_namespace_filter(self):
+        from autoscaler_tpu.cloudprovider.clusterapi import AutoDiscoverySpec
+
+        p = ClusterAPIProvider(
+            self._api(), [AutoDiscoverySpec("clusterapi:namespace=team-a")]
+        )
+        assert sorted(g.id() for g in p.node_groups()) == [
+            "MachineDeployment/team-a/web-a",
+            "MachineDeployment/team-a/web-c",
+        ]
+
+    def test_cluster_name_filter_spec_and_label(self):
+        from autoscaler_tpu.cloudprovider.clusterapi import AutoDiscoverySpec
+
+        p = ClusterAPIProvider(
+            self._api(), [AutoDiscoverySpec("clusterapi:clusterName=prod")]
+        )
+        assert [g.id() for g in p.node_groups()] == [
+            "MachineDeployment/team-a/web-a"
+        ]
+        p = ClusterAPIProvider(
+            self._api(), [AutoDiscoverySpec("clusterapi:clusterName=staging")]
+        )
+        assert [g.id() for g in p.node_groups()] == [
+            "MachineDeployment/team-b/web-b"
+        ]
+
+    def test_label_requirement_and_or_of_specs(self):
+        from autoscaler_tpu.cloudprovider.clusterapi import AutoDiscoverySpec
+
+        p = ClusterAPIProvider(
+            self._api(),
+            [
+                AutoDiscoverySpec("clusterapi:tier=gpu"),
+                AutoDiscoverySpec("clusterapi:clusterName=staging"),
+            ],
+        )
+        assert sorted(g.id() for g in p.node_groups()) == [
+            "MachineDeployment/team-a/web-c",
+            "MachineDeployment/team-b/web-b",
+        ]
+
+    def test_bad_spec_rejected(self):
+        from autoscaler_tpu.cloudprovider.clusterapi import AutoDiscoverySpec
+
+        with pytest.raises(ValueError, match="should be clusterapi:"):
+            AutoDiscoverySpec("mig:zone=us")
+        with pytest.raises(ValueError, match="key=value"):
+            AutoDiscoverySpec("clusterapi:namespaceonly")
+
+
 class TestResilience:
     def test_malformed_annotation_skips_one_resource(self, caplog):
         """A typo'd max-size on ONE resource must not disable autoscaling
