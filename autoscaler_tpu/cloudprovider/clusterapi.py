@@ -36,6 +36,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from autoscaler_tpu.cloudprovider.interface import (
     CloudProvider,
     Instance,
+    InstanceErrorClass,
+    InstanceErrorInfo,
     InstanceState,
     NodeGroup,
     NodeGroupError,
@@ -463,19 +465,43 @@ class CapiNodeGroup(NodeGroup):
         out: List[Instance] = []
         for m in self.scalable.machines():
             meta = _meta(m)
+            status = m.get("status") or {}
             provider_id = (m.get("spec") or {}).get("providerID")
-            phase = ((m.get("status") or {}).get("phase") or "").lower()
+            phase = (status.get("phase") or "").lower()
+            failure = status.get("failureMessage") or ""
+            error_info: Optional[InstanceErrorInfo] = None
             if meta.get("deletionTimestamp") or phase == "deleting":
                 state = InstanceState.DELETING
+            elif failure or phase == "failed":
+                # A failed machine must surface InstanceErrorInfo so the
+                # core rides the fast deleteCreatedNodesWithErrors path
+                # instead of waiting out maxNodeProvisionTime (the
+                # reference's failed-machine marker id,
+                # clusterapi_controller.go findMachine failure handling;
+                # same contract as the gce/external_grpc providers here).
+                state = InstanceState.CREATING
+                error_info = InstanceErrorInfo(
+                    error_class=InstanceErrorClass.OTHER,
+                    error_code=status.get("failureReason") or "MachineFailed",
+                    error_message=failure or f"machine phase {phase}",
+                )
             elif provider_id and phase in ("running", "provisioned", ""):
                 state = InstanceState.RUNNING
             else:
                 state = InstanceState.CREATING
             out.append(
                 Instance(
-                    id=provider_id
-                    or f"capi://{meta.get('namespace', 'default')}/{meta['name']}",
+                    # the capi:// id is STABLE for a failed machine even if
+                    # a providerID later appears: deletion by id must find
+                    # the same machine the error was reported against
+                    id=(
+                        f"capi://{meta.get('namespace', 'default')}/{meta['name']}"
+                        if error_info is not None
+                        else provider_id
+                        or f"capi://{meta.get('namespace', 'default')}/{meta['name']}"
+                    ),
                     state=state,
+                    error_info=error_info,
                 )
             )
         return out
@@ -588,6 +614,15 @@ class ClusterAPIProvider(CloudProvider):
             for m in self._machines(ns):
                 if _meta(m)["name"] == name:
                     return m
+        # capi://ns/name ids (unregistered or FAILED machines reported by
+        # CapiNodeGroup.nodes) resolve directly — the core deletes errored
+        # instances by the id the provider reported them under
+        for pid in (node.provider_id, node.name):
+            if pid and pid.startswith("capi://") and "/" in pid[7:]:
+                ns, name = pid[7:].split("/", 1)
+                for m in self._machines(ns):
+                    if _meta(m)["name"] == name:
+                        return m
         if node.provider_id:
             for ns in sorted({g.scalable.namespace for g in self._groups}):
                 for m in self._machines(ns):

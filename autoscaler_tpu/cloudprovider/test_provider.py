@@ -218,6 +218,23 @@ class TestCloudProvider(CloudProvider):
     def add_instance(self, group_name: str, instance: Instance) -> None:
         self._instances[group_name].append(instance)
 
+    def attach_node(self, group_name: str, node: Node) -> None:
+        """Map a Node object to an EXISTING cloud instance of the group —
+        the registration step of a boot cycle (loadgen's kubelet analog).
+        Unlike add_node, no new instance is minted."""
+        if group_name not in self._groups:
+            raise NodeGroupError(f"unknown group {group_name}")
+        self._node_to_group[node.name] = group_name
+
+    def remove_instance(self, group_name: str, instance_id: str) -> None:
+        """Drop one cloud instance by id — the out-of-band reap seam
+        (loadgen resize-down); no scale-down callback fires."""
+        instances = self._instances.get(group_name, [])
+        for i, inst in enumerate(instances):
+            if inst.id == instance_id:
+                del instances[i]
+                return
+
     def _on_scale_up(self, group: str, delta: int) -> None:
         self.scale_up_calls.append((group, delta))
         if self.on_scale_up:

@@ -72,6 +72,10 @@ class AutoscalingOptions:
     # external gRPC expander target (reference --grpc-expander-url) for the
     # "grpc" entry of the expander chain
     grpc_expander_url: str = ""
+    # seed for the expander chain's random fallback (tie-breaks and the
+    # "random" strategy). None = entropy, the reference behavior; scenario
+    # replay (loadgen) pins it so the same world makes the same choice.
+    expander_random_seed: Optional[int] = None
     max_nodes_per_scaleup: int = 1000             # main.go:215
     max_nodegroup_binpacking_duration_s: float = 10.0  # main.go:216
     node_info_cache_expire_time_s: float = 60.0  # template NodeInfo TTL

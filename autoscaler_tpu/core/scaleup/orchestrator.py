@@ -75,10 +75,15 @@ class ScaleUpOrchestrator:
         self.estimator = estimator
         self.expander = expander or build_strategy(
             [n.strip() for n in options.expander.split(",") if n.strip()],
+            seed=options.expander_random_seed,
             priorities=options.expander_priorities,
             priorities_path=options.priority_config_file or None,
             priorities_fetch=priorities_fetch,
             grpc_target=options.grpc_expander_url or None,
+            # the price filter scores against the provider's pricing model
+            # (expander/price/price.go); absent model → build_strategy
+            # rejects the 'price' entry loudly
+            pricing=provider.pricing(),
         )
         self.resource_manager = ScaleUpResourceManager(provider.get_resource_limiter())
         self.balancing_processor = balancing_processor

@@ -196,6 +196,13 @@ class BinpackingNodeEstimator:
         req, alloc2d = _augment_virtual(req, pods, alloc[None, :], [template])
         alloc = alloc2d[0]
         cap = self.limiter.node_cap(max_size_headroom)
+        # route observability covers BOTH entry points (ADVICE r5): the
+        # single-template path always rides the XLA scans today, so the
+        # metric records that — if this path ever grows a Pallas twin the
+        # reasons split the same way _estimate_many_inner's do
+        self._note_route(
+            "xla_scan" if dynamic else "xla_single", "single_template"
+        )
         if dynamic:
             terms = build_affinity_terms(
                 pods, [template], pad_pods=P, bucket_terms=True,

@@ -131,6 +131,10 @@ def build_strategy(names: Sequence[str], seed: Optional[int] = None, **kwargs) -
         elif name == PRICE:
             from autoscaler_tpu.expander.price import PriceFilter
 
+            if kwargs.get("pricing") is None:
+                raise ValueError(
+                    "expander 'price' needs a provider pricing model"
+                )
             filters.append(PriceFilter(kwargs["pricing"]))
         elif name == PRIORITY:
             if kwargs.get("priorities_fetch"):

@@ -69,8 +69,22 @@ _POD_PROFILE_VALUES: List[Tuple[str, Dict[str, str]]] = []
 
 
 def pod_profile_value(pid: int) -> Tuple[str, Dict[str, str]]:
-    """(namespace, labels) for a Pod.profile_id() value (same epoch)."""
-    return _POD_PROFILE_VALUES[pid]
+    """(namespace, labels) for a Pod.profile_id() value (same epoch).
+    Locked: an unlocked read could catch the registry mid-reset and return
+    the WRONG profile for a stale id (or IndexError on the cleared list);
+    raising IndexError under the lock is the consistent signal callers
+    (packer epoch-retry loop) handle."""
+    with _POD_PROFILE_LOCK:
+        return _POD_PROFILE_VALUES[pid]
+
+
+def pod_profile_epoch() -> int:
+    """Current interning epoch, read under the lock. Consumers doing a
+    multi-id pass (packer row rules) snapshot this before and after: a
+    change means ids from two epochs may coexist in their batch and the
+    pass must be rebuilt (see packer._apply_row_rules)."""
+    with _POD_PROFILE_LOCK:
+        return _POD_PROFILE_EPOCH
 
 
 @dataclass(frozen=True)
@@ -413,20 +427,23 @@ class Pod:
         if self.__dict__.get("_profile_epoch") == _POD_PROFILE_EPOCH:
             return self.__dict__["_profile_id"]
         key = self.profile_key()
-        pid = _POD_PROFILE_IDS.get(key)
-        if pid is None:
-            with _POD_PROFILE_LOCK:
-                pid = _POD_PROFILE_IDS.get(key)  # lost the race → reuse
-                if pid is None:
-                    if len(_POD_PROFILE_VALUES) >= _POD_PROFILE_CAP:
-                        _POD_PROFILE_IDS.clear()
-                        _POD_PROFILE_VALUES.clear()
-                        _POD_PROFILE_EPOCH += 1
-                    pid = len(_POD_PROFILE_VALUES)
-                    _POD_PROFILE_IDS[key] = pid
-                    _POD_PROFILE_VALUES.append((self.namespace, self.labels))
+        # the (epoch, id) pair is read/minted ATOMICALLY under the lock: an
+        # unlocked dict probe here could pair an old-epoch id with the NEW
+        # epoch (reset between probe and epoch read), memoizing a stale id
+        # that collides with a distinct profile after the reset
+        with _POD_PROFILE_LOCK:
+            pid = _POD_PROFILE_IDS.get(key)
+            if pid is None:
+                if len(_POD_PROFILE_VALUES) >= _POD_PROFILE_CAP:
+                    _POD_PROFILE_IDS.clear()
+                    _POD_PROFILE_VALUES.clear()
+                    _POD_PROFILE_EPOCH += 1
+                pid = len(_POD_PROFILE_VALUES)
+                _POD_PROFILE_IDS[key] = pid
+                _POD_PROFILE_VALUES.append((self.namespace, self.labels))
+            epoch = _POD_PROFILE_EPOCH
         self.__dict__["_profile_id"] = pid
-        self.__dict__["_profile_epoch"] = _POD_PROFILE_EPOCH
+        self.__dict__["_profile_epoch"] = epoch
         return pid
 
     def effective_requests(self) -> Resources:

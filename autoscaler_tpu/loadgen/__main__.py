@@ -1,0 +1,5 @@
+import sys
+
+from autoscaler_tpu.loadgen.cli import main
+
+sys.exit(main())

@@ -1,0 +1,106 @@
+"""loadgen CLI.
+
+    python -m autoscaler_tpu.loadgen run benchmarks/scenarios/burst_small.json
+    python -m autoscaler_tpu.loadgen run spec.json --report out.json --trace trace.json
+    python -m autoscaler_tpu.loadgen replay trace.json
+    python -m autoscaler_tpu.loadgen validate spec.json
+
+``run`` executes a scenario and prints the score report (one JSON object)
+to stdout; ``--log`` additionally writes the full per-tick decision log.
+``--trace`` captures the resolved event timeline; ``replay`` re-executes a
+captured trace (generators already expanded) against the same spec and must
+reproduce the decision log byte-for-byte.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from autoscaler_tpu.loadgen.spec import ScenarioSpec, SpecError
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m autoscaler_tpu.loadgen", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    run = sub.add_parser("run", help="execute a scenario spec")
+    run.add_argument("scenario", help="path to a scenario JSON file")
+    run.add_argument("--report", default="", help="write the score report here "
+                     "(default: stdout only)")
+    run.add_argument("--log", default="", help="write the per-tick decision log")
+    run.add_argument("--trace", default="", help="write the resolved event trace")
+    run.add_argument("--seed", type=int, default=None,
+                     help="override the spec's seed")
+    run.add_argument("--real-sleep", action="store_true",
+                     help="actually sleep injected provider latency")
+
+    rep = sub.add_parser("replay", help="re-execute a captured trace")
+    rep.add_argument("trace", help="path to a trace JSON file (from run --trace)")
+    rep.add_argument("--report", default="")
+    rep.add_argument("--log", default="")
+
+    val = sub.add_parser("validate", help="parse + round-trip a scenario spec")
+    val.add_argument("scenario")
+    return p
+
+
+def _write(path: str, doc) -> None:
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def _run(spec: ScenarioSpec, report_path: str, log_path: str,
+         trace_path: str = "", real_sleep: bool = False) -> int:
+    from autoscaler_tpu.loadgen.driver import run_scenario
+    from autoscaler_tpu.loadgen.score import build_report
+
+    result = run_scenario(spec, real_sleep=real_sleep)
+    report = build_report(result)
+    print(json.dumps(report, indent=2, sort_keys=True))
+    if report_path:
+        _write(report_path, report)
+    if log_path:
+        _write(log_path, result.decision_log())
+    if trace_path:
+        _write(trace_path, {"spec": spec.to_dict(), "events": result.trace})
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    try:
+        if args.command == "run":
+            spec = ScenarioSpec.load(args.scenario)
+            if args.seed is not None:
+                spec.seed = args.seed
+            return _run(spec, args.report, args.log, args.trace,
+                        real_sleep=args.real_sleep)
+        if args.command == "replay":
+            with open(args.trace) as f:
+                doc = json.load(f)
+            spec = ScenarioSpec.from_dict(doc["spec"])
+            # the trace IS the timeline: generators were already expanded
+            # when it was captured, so replay them as explicit events
+            spec.workloads = []
+            from autoscaler_tpu.loadgen.spec import _load_event
+
+            spec.events = [_load_event(e) for e in doc["events"]]
+            return _run(spec, args.report, args.log)
+        if args.command == "validate":
+            spec = ScenarioSpec.load(args.scenario)
+            roundtrip = ScenarioSpec.from_json(spec.to_json())
+            assert roundtrip == spec, "round-trip mismatch"
+            print(f"ok: {spec.name} ({spec.ticks} ticks, "
+                  f"{len(spec.node_groups)} groups, {len(spec.events)} events, "
+                  f"{len(spec.workloads)} workloads, {len(spec.faults)} faults)")
+            return 0
+    except (SpecError, FileNotFoundError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    return 2

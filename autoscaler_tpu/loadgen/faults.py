@@ -1,0 +1,136 @@
+"""Fault injection at the cloudprovider / kube-API boundary.
+
+Wraps a ``TestCloudProvider`` (and the driver's eviction path) so scripted
+failures exercise the SAME recovery machinery production hits: a rejected
+IncreaseSize lands in ``ScaleUpOrchestrator``'s except-branch →
+``register_failed_scale_up`` → ``ExponentialBackoff``; an instance created
+with ``InstanceErrorInfo`` rides ``instances_with_errors`` →
+``deleteCreatedNodesWithErrors``; a stuck-CREATING instance ages through
+``unregistered`` → ``long_unregistered`` → provision-timeout backoff.
+
+The injector is tick-clocked and RNG-seeded by the driver: the SAME
+scenario + seed trips the SAME faults on the SAME calls, which is what
+makes a recorded fault run replayable.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from autoscaler_tpu.cloudprovider.interface import (
+    InstanceErrorClass,
+    InstanceErrorInfo,
+)
+from autoscaler_tpu.loadgen.spec import FaultSpec
+
+import numpy as np
+
+
+class InjectedCloudError(Exception):
+    """The cloud said no (scripted)."""
+
+
+class FaultInjector:
+    """Holds the active FaultSpecs; consulted by the provider callbacks and
+    the driver's cloud materializer. ``tick`` is advanced by the driver."""
+
+    def __init__(self, faults: List[FaultSpec], seed: int, real_sleep: bool = False):
+        self._static = list(faults)
+        self._armed: List[FaultSpec] = []   # armed mid-run via fault events
+        self._rng = np.random.default_rng((seed, 104729))
+        self.tick = 0
+        self.real_sleep = real_sleep
+        self.injected: Dict[str, int] = {}   # fault kind → times it fired
+        self.injected_latency_s = 0.0
+
+    # -- driver wiring -------------------------------------------------------
+    def arm(self, fault: FaultSpec, at_tick: int) -> None:
+        """A ``fault`` event: the spec's window is relative to the event."""
+        import dataclasses
+
+        self._armed.append(
+            dataclasses.replace(
+                fault,
+                start_tick=at_tick + fault.start_tick,
+                end_tick=(
+                    None if fault.end_tick is None else at_tick + fault.end_tick
+                ),
+            )
+        )
+
+    def clear(self) -> None:
+        self._armed.clear()
+        self._static = []
+
+    def _active(self, kind: str, group: str) -> Optional[FaultSpec]:
+        for f in self._static + self._armed:
+            if f.kind != kind or not f.active(self.tick):
+                continue
+            # group-scoped faults fire ONLY on calls attributed to that
+            # group; group-less calls (refresh, unresolved nodes) are
+            # reachable by global faults alone
+            if f.group and f.group != group:
+                continue
+            if f.probability >= 1.0 or self._rng.random() < f.probability:
+                return f
+        return None
+
+    def _note(self, kind: str) -> None:
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+
+    # -- injection points ----------------------------------------------------
+    def on_refresh(self) -> None:
+        self._latency("")
+        f = self._active("refresh_error", "")
+        if f is not None:
+            self._note("refresh_error")
+            raise InjectedCloudError(f.message)
+
+    def on_scale_up(self, group: str, delta: int) -> None:
+        """TestCloudProvider's on_scale_up seam: raising rejects the resize
+        before the target advances (test_provider.py:81-86)."""
+        self._latency(group)
+        f = self._active("scale_up_error", group)
+        if f is not None:
+            self._note("scale_up_error")
+            raise InjectedCloudError(f"{f.message} (group {group}, delta {delta})")
+
+    def instance_fate(self, group: str) -> tuple:
+        """(error_info, stuck) for one instance the cloud is about to
+        create. error_info ≠ None models the clusterapi failed-machine /
+        GCE instance-error surface; stuck=True models an instance that
+        never registers a Node."""
+        f = self._active("instance_error", group)
+        if f is not None:
+            self._note("instance_error")
+            return (
+                InstanceErrorInfo(
+                    error_class=InstanceErrorClass[f.error_class],
+                    error_code="loadgen",
+                    error_message=f.message,
+                ),
+                False,
+            )
+        f = self._active("stuck_creating", group)
+        if f is not None:
+            self._note("stuck_creating")
+            return None, True
+        return None, False
+
+    def on_evict(self, pod_key: str, group: str = "") -> bool:
+        """True → reject this eviction (PDB/API-flake analog); ``group`` is
+        the node group of the pod's node so group-scoped faults only stall
+        their own group's drains."""
+        f = self._active("eviction_error", group)
+        if f is not None:
+            self._note("eviction_error")
+            return True
+        return False
+
+    def _latency(self, group: str) -> None:
+        f = self._active("provider_latency", group)
+        if f is not None and f.latency_s > 0:
+            self._note("provider_latency")
+            self.injected_latency_s += f.latency_s
+            if self.real_sleep:
+                time.sleep(f.latency_s)

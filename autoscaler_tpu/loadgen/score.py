@@ -1,0 +1,115 @@
+"""Scorer: a RunResult → one JSON report, benchmarks/captures-compatible.
+
+The report answers the questions a policy PR must improve on:
+
+- ``pending_pod_latency_s`` — arrival→bind latency percentiles (p50/p90/
+  p99/max) in scenario seconds, plus how many pods never bound;
+- ``nodes`` — peak/final provisioned vs a capacity lower bound
+  (ceil(total requested cpu / biggest node cpu)) — the overprovisioning
+  headline, same spirit as KIS-S's utilization-vs-SLO frontier;
+- ``decisions`` — scale-up/scale-down/backoff/error counts over the run;
+- ``tick_wall_s`` — per-tick wall time of the REAL loop (p50/max), the
+  number the churn bench tracks at scale;
+- ``kernel_routes`` / ``function_duration`` — the same observability the
+  production loop exports, so scenario runs slot into existing dashboards.
+
+Like every artifact under benchmarks/captures/, the report is a flat JSON
+object with a ``metric`` name and a ``platform`` field.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List
+
+from autoscaler_tpu.loadgen.driver import RunResult
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def build_report(result: RunResult) -> Dict[str, Any]:
+    import jax
+
+    spec = result.spec
+    interval = spec.tick_interval_s
+    latencies = sorted(
+        (bound - arrival) * interval
+        for arrival, bound in result.pod_latency.values()
+        if bound is not None
+    )
+    unbound = sum(1 for _, b in result.pod_latency.values() if b is None)
+    walls = sorted(r.wall_s for r in result.records)
+    scale_up_nodes = sum(d for r in result.records for _, d in r.scale_ups)
+    scale_up_events = sum(1 for r in result.records if r.scale_ups)
+    scale_down_nodes = sum(len(r.scale_downs) for r in result.records)
+    backoff_ticks = sum(1 for r in result.records if r.backed_off)
+    error_ticks = sum(1 for r in result.records if r.errors)
+    # capacity lower bound: total requested cpu over the run, packed into
+    # the largest node shape with nothing wasted. Unreachable in general
+    # (bursts decay, shapes fragment) but a stable denominator across
+    # policies on the SAME scenario.
+    optimal_nodes = (
+        int(math.ceil(result.total_requested_cpu_m / result.group_cpu_m))
+        if result.group_cpu_m > 0
+        else 0
+    )
+    fd = result.metrics.function_duration
+    phases = {}
+    for phase in ("main", "estimate", "scaleUp", "findUnneeded",
+                  "filterOutSchedulable", "buildSnapshot"):
+        n = fd.count(function=phase)
+        if n:
+            phases[phase] = {
+                "count": n,
+                "p50_s": round(fd.quantile(0.5, function=phase), 4),
+                "max_s": round(fd.quantile(1.0, function=phase), 4),
+            }
+    routes = {
+        "/".join(f"{lk}={lv}" for lk, lv in k): int(v)
+        for k, v in result.metrics.estimator_kernel_route_total.values.items()
+    }
+    report: Dict[str, Any] = {
+        "metric": f"loadgen_scenario_{spec.name}",
+        "platform": jax.default_backend(),
+        "scenario": spec.name,
+        "seed": spec.seed,
+        "ticks": spec.ticks,
+        "tick_interval_s": interval,
+        "pods_arrived": len(result.pod_latency),
+        "pending_pod_latency_s": {
+            "p50": round(_percentile(latencies, 0.50), 3),
+            "p90": round(_percentile(latencies, 0.90), 3),
+            "p99": round(_percentile(latencies, 0.99), 3),
+            "max": round(_percentile(latencies, 1.0), 3),
+            "bound": len(latencies),
+            "never_bound": unbound,
+        },
+        "nodes": {
+            "initial": sum(g.initial_size for g in spec.node_groups),
+            "peak": result.peak_nodes,
+            "final": result.final_nodes,
+            "optimal_lower_bound": optimal_nodes,
+        },
+        "decisions": {
+            "scale_up_events": scale_up_events,
+            "scale_up_nodes": scale_up_nodes,
+            "scale_down_nodes": scale_down_nodes,
+            "ticks_with_backoff": backoff_ticks,
+            "ticks_with_errors": error_ticks,
+        },
+        "tick_wall_s": {
+            "p50": round(_percentile(walls, 0.5), 4),
+            "max": round(_percentile(walls, 1.0), 4),
+            "total": round(sum(walls), 3),
+        },
+        "injected_faults": result.injected_faults,
+    }
+    if phases:
+        report["function_duration"] = phases
+    if routes:
+        report["kernel_routes"] = routes
+    return report

@@ -1,0 +1,271 @@
+"""Scenario spec: the declarative description of one control-loop drill.
+
+A scenario is (cluster shape, timed events, synthetic workloads, faults,
+autoscaler knobs). Everything is a plain dataclass with an exact JSON
+round-trip — ``ScenarioSpec.from_dict(spec.to_dict()) == spec`` — so canned
+scenarios live under ``benchmarks/scenarios/`` as reviewable JSON and
+captured traces replay byte-for-byte.
+
+Event kinds (``Event.kind``):
+
+- ``pod_burst``      — ``count`` pending pods arrive (cpu_m/mem_mb/labels/
+                       spread_zone_skew for a DoNotSchedule zone constraint)
+- ``pod_complete``   — up to ``count`` running pods whose name starts with
+                       ``prefix`` terminate (completions / scale-in of the
+                       workload itself)
+- ``node_flap``      — ``count`` ready nodes of ``group`` go NotReady for
+                       ``duration_ticks`` ticks, then recover
+- ``resize``         — the group's cloud target is set out-of-band (an
+                       operator or another controller resizing the MIG)
+- ``fault``          — arm a FaultSpec mid-run (``fault`` payload); the
+                       fault's own ``start_tick`` is relative to the event
+- ``clear_faults``   — disarm every active fault
+
+Faults (``FaultSpec.kind``) target the provider/kube boundary:
+
+- ``scale_up_error``  — increase_size raises (cloud rejects the resize);
+                        drives the orchestrator's register_failed_scale_up
+                        → ExponentialBackoff path
+- ``instance_error``  — created instances surface InstanceErrorInfo (the
+                        clusterapi failed-machine / GCE instance-error
+                        path) → deleteCreatedNodesWithErrors
+- ``stuck_creating``  — created instances never register (no Node object)
+                        → provision-timeout → failed-scale-up backoff
+- ``provider_latency``— refresh()/nodes() report ``latency_s`` of injected
+                        latency per call (recorded; optionally slept)
+- ``refresh_error``   — provider.refresh() raises → loop-level error path
+- ``eviction_error``  — evictions rejected (PDB analog) with ``probability``
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+MB = 1024 * 1024
+
+EVENT_KINDS = (
+    "pod_burst",
+    "pod_complete",
+    "node_flap",
+    "resize",
+    "fault",
+    "clear_faults",
+)
+FAULT_KINDS = (
+    "scale_up_error",
+    "instance_error",
+    "stuck_creating",
+    "provider_latency",
+    "refresh_error",
+    "eviction_error",
+)
+WORKLOAD_KINDS = ("steady", "diurnal", "spike", "drain_heavy")
+
+
+class SpecError(ValueError):
+    """A scenario document that doesn't describe a runnable scenario."""
+
+
+@dataclass
+class NodeGroupSpec:
+    """One scalable set of identical nodes in the scripted cloud."""
+
+    name: str
+    min_size: int = 0
+    max_size: int = 10
+    initial_size: int = 1
+    cpu_m: float = 4000.0
+    mem_mb: float = 16384.0
+    pods: float = 110.0
+    zone: str = ""            # sets topology.kubernetes.io/zone when nonempty
+    labels: Dict[str, str] = field(default_factory=dict)
+    price_per_hour: float = 1.0
+    # ticks between the cloud accepting a resize and the Node registering
+    # ready (the boot cycle the upcoming-node logic reasons about)
+    provision_ticks: int = 1
+
+
+@dataclass
+class FaultSpec:
+    kind: str = "scale_up_error"
+    # which node group the fault hits; "" = all groups
+    group: str = ""
+    # fraction of eligible calls that fail, decided by the scenario RNG
+    probability: float = 1.0
+    start_tick: int = 0
+    # inclusive-exclusive window; None = until cleared / end of run
+    end_tick: Optional[int] = None
+    latency_s: float = 0.0          # provider_latency
+    error_class: str = "OTHER"      # instance_error: OUT_OF_RESOURCES|QUOTA_EXCEEDED|OTHER
+    message: str = "injected fault"
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise SpecError(f"unknown fault kind {self.kind!r} (one of {FAULT_KINDS})")
+        if not 0.0 <= self.probability <= 1.0:
+            raise SpecError(f"fault probability {self.probability} outside [0, 1]")
+
+    def active(self, tick: int) -> bool:
+        if tick < self.start_tick:
+            return False
+        return self.end_tick is None or tick < self.end_tick
+
+
+@dataclass
+class Event:
+    at_tick: int
+    kind: str
+    group: str = ""                 # node_flap / resize target
+    count: int = 0                  # pods / nodes / resize target size
+    cpu_m: float = 500.0            # pod_burst request
+    mem_mb: float = 512.0
+    labels: Dict[str, str] = field(default_factory=dict)
+    prefix: str = ""                # pod_complete name filter
+    duration_ticks: int = 1         # node_flap outage length
+    # pod_burst: when > 0, pods carry a DoNotSchedule zone-spread
+    # constraint with this max_skew (exercises the within-wave kernels)
+    spread_zone_skew: int = 0
+    fault: Optional[FaultSpec] = None
+
+    def __post_init__(self):
+        if self.kind not in EVENT_KINDS:
+            raise SpecError(f"unknown event kind {self.kind!r} (one of {EVENT_KINDS})")
+        if self.at_tick < 0:
+            raise SpecError(f"event at_tick {self.at_tick} is negative")
+        if self.kind == "fault" and self.fault is None:
+            raise SpecError("fault event without a fault payload")
+
+
+@dataclass
+class WorkloadSpec:
+    """A synthetic generator expanded into pod_burst/pod_complete events by
+    ``loadgen.workloads`` before the run starts (so a recorded trace holds
+    only concrete events)."""
+
+    kind: str = "steady"
+    # average pending-pod arrivals per tick (peak rate for diurnal/spike)
+    rate: float = 5.0
+    cpu_m: float = 500.0
+    mem_mb: float = 512.0
+    start_tick: int = 0
+    end_tick: Optional[int] = None
+    period_ticks: int = 48          # diurnal: one day; spike: inter-burst gap
+    # fraction of arrived pods completing per tick (drain_heavy churns hard)
+    completion_rate: float = 0.0
+    spread_zone_skew: int = 0
+    labels: Dict[str, str] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.kind not in WORKLOAD_KINDS:
+            raise SpecError(
+                f"unknown workload kind {self.kind!r} (one of {WORKLOAD_KINDS})"
+            )
+        if self.rate < 0:
+            raise SpecError(f"workload rate {self.rate} is negative")
+
+
+@dataclass
+class ScenarioSpec:
+    name: str
+    seed: int = 0
+    ticks: int = 20
+    tick_interval_s: float = 10.0
+    node_groups: List[NodeGroupSpec] = field(default_factory=list)
+    events: List[Event] = field(default_factory=list)
+    workloads: List[WorkloadSpec] = field(default_factory=list)
+    faults: List[FaultSpec] = field(default_factory=list)
+    # AutoscalingOptions overrides (pythonized field name → value); the
+    # driver starts from scenario-friendly defaults (no cooldowns, short
+    # unneeded time) and applies these on top
+    options: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.ticks <= 0:
+            raise SpecError(f"ticks must be positive, got {self.ticks}")
+        if self.tick_interval_s <= 0:
+            raise SpecError(
+                f"tick_interval_s must be positive, got {self.tick_interval_s}"
+            )
+        if not self.node_groups:
+            raise SpecError("scenario needs at least one node group")
+        names = [g.name for g in self.node_groups]
+        if len(set(names)) != len(names):
+            raise SpecError(f"duplicate node group names in {names}")
+        late = [e.at_tick for e in self.events if e.at_tick >= self.ticks]
+        if late:
+            raise SpecError(
+                f"events at ticks {late} never fire: the run ends at tick "
+                f"{self.ticks - 1} (raise `ticks` or move the events)"
+            )
+
+    # -- JSON round-trip -----------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return _strip(dataclasses.asdict(self))
+
+    @classmethod
+    def from_dict(cls, doc: Dict[str, Any]) -> "ScenarioSpec":
+        if not isinstance(doc, dict):
+            raise SpecError(f"scenario document must be an object, got {type(doc)}")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(doc) - known
+        if unknown:
+            raise SpecError(f"unknown scenario fields {sorted(unknown)}")
+        kw = dict(doc)
+        kw["node_groups"] = [
+            _load(NodeGroupSpec, g) for g in doc.get("node_groups", [])
+        ]
+        kw["events"] = [_load_event(e) for e in doc.get("events", [])]
+        kw["workloads"] = [_load(WorkloadSpec, w) for w in doc.get("workloads", [])]
+        kw["faults"] = [_load(FaultSpec, f) for f in doc.get("faults", [])]
+        return cls(**kw)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True) + "\n"
+
+    @classmethod
+    def from_json(cls, text: str) -> "ScenarioSpec":
+        return cls.from_dict(json.loads(text))
+
+    @classmethod
+    def load(cls, path: str) -> "ScenarioSpec":
+        with open(path) as f:
+            return cls.from_json(f.read())
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+
+def _strip(value):
+    """Drop default-y noise (None, empty containers) so canned JSON stays
+    reviewable; from_dict fills the defaults back, keeping the round-trip
+    exact for any spec built from JSON."""
+    if isinstance(value, dict):
+        return {
+            k: _strip(v)
+            for k, v in value.items()
+            if v is not None and v != {} and v != []
+        }
+    if isinstance(value, list):
+        return [_strip(v) for v in value]
+    return value
+
+
+def _load(cls, doc: Dict[str, Any]):
+    if not isinstance(doc, dict):
+        raise SpecError(f"{cls.__name__} entry must be an object, got {type(doc)}")
+    known = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(doc) - known
+    if unknown:
+        raise SpecError(f"unknown {cls.__name__} fields {sorted(unknown)}")
+    return cls(**doc)
+
+
+def _load_event(doc: Dict[str, Any]) -> Event:
+    doc = dict(doc)
+    fault = doc.pop("fault", None)
+    if fault is not None:
+        doc["fault"] = _load(FaultSpec, fault)
+    return _load(Event, doc)

@@ -196,8 +196,9 @@ class AutoscalerMetrics:
         # fast path was NOT taken, why (r4 verdict weak #6: a workload past
         # the VMEM byte-model gate silently rode the ~50x-slower XLA scan;
         # the cliff must be observable). labels: route=pallas_affinity|
-        # pallas|xla_scan|xla_runs, reason=ok|vmem|spread_width|not_tpu|
-        # kernel_fault|dedup
+        # pallas|xla_scan|xla_runs|xla_single, reason=ok|vmem|spread_width|
+        # not_tpu|kernel_fault|dedup|single_template (the last from the
+        # single-template estimate() entry point)
         self.estimator_kernel_route_total = r.counter(
             p + "estimator_kernel_route_total",
             "estimator dispatches by kernel route and fallback reason",

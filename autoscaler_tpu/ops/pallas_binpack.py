@@ -69,6 +69,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.6 names the TPU compiler-params struct TPUCompilerParams; the
+# rename to CompilerParams landed alongside jax.shard_map's promotion
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 from autoscaler_tpu.ops.binpack import BinpackResult, ffd_scores
 
 BIG_I32 = np.int32(2**31 - 1)
@@ -393,7 +397,7 @@ def _pallas_scan_all(
             jax.ShapeDtypeStruct((1, G_pad), jnp.int32),
             jax.ShapeDtypeStruct((P_pad, G_pad), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -65,6 +65,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.6 names the TPU compiler-params struct TPUCompilerParams; the
+# rename to CompilerParams landed alongside jax.shard_map's promotion
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 from autoscaler_tpu.ops.binpack import BinpackResult, ffd_scores
 from autoscaler_tpu.ops.pallas_binpack import (
     BIG_I32,
@@ -392,7 +396,7 @@ def _pallas_scan_aff(
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

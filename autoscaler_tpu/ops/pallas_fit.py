@@ -31,6 +31,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.6 names the TPU compiler-params struct TPUCompilerParams; the
+# rename to CompilerParams landed alongside jax.shard_map's promotion
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 BIG_I32 = np.int32(2**31 - 1)
 
 
@@ -169,7 +173,7 @@ def pallas_fit_reduce(
             jax.ShapeDtypeStruct((P_pad, 1), jnp.int32),
             jax.ShapeDtypeStruct((P_pad, 1), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -31,6 +31,25 @@ from autoscaler_tpu.ops.binpack import ffd_binpack_groups
 UNSCHEDULED_PENALTY = 1.0e6  # cost per pod left pending, dominates node price
 
 
+def _shard_map(fn, *, mesh, in_specs, out_specs):
+    """Version-portable shard_map: jax >= 0.6 exposes jax.shard_map (with
+    check_vma); earlier releases carry it in jax.experimental.shard_map
+    (with check_rep). Replication checking stays off either way — the
+    expander argmin deliberately returns replicated values from gathered
+    shards."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
 def factor_mesh(n: int) -> tuple[int, int]:
     """Split n devices into (scenario, group) dims, group dim = largest
     divisor <= sqrt(n) so both axes get parallelism when possible."""
@@ -177,7 +196,7 @@ def whatif_best_options(
         group_axis="group" if g_dim > 1 else None,
         binpack_fn=binpack_fn, scenario_loop=scenario_loop,
     )
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         fn,
         mesh=mesh,
         in_specs=(
@@ -193,7 +212,6 @@ def whatif_best_options(
             P("scenario"),            # best group (global index)
             P("scenario"),            # best cost
         ),
-        check_vma=False,
     )
     counts, costs, best, best_cost = mapped(pod_req, pod_masks, allocs, prices, caps)
     return WhatIfResult(counts, costs, best, best_cost)
@@ -225,6 +243,14 @@ def sharded_affinity_estimate(
     Pallas twin (ops/pallas_binpack_affinity: bitset affinity carry +
     count-plane spread)."""
     from autoscaler_tpu.ops.binpack import ffd_binpack_groups_affinity
+
+    # Inert spread tuples gate as S=0, like the estimator route's
+    # sp_of.any() check (advisor r5: bucket_terms pads S to a minimum, so a
+    # padded-but-undeclared tuple must not trip the S>32 / VMEM gate — the
+    # terms can't affect placement). Dropped before dispatch so both kernel
+    # routes skip the dead spread carry entirely.
+    if spread is not None and not np.asarray(spread[0]).any():
+        spread = None
 
     if use_pallas:
         from autoscaler_tpu.ops.pallas_binpack import VMEM_BUDGET
@@ -276,13 +302,12 @@ def sharded_affinity_estimate(
     spread_specs = None
     if spread is not None:
         spread_specs = tuple([rep] * 5 + [gshard] * 6)
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         body,
         mesh=mesh,
         in_specs=(rep, gshard, gshard, gshard, rep, rep, rep, rep, gshard,
                   spread_specs),
         out_specs=gshard,  # prefix: every BinpackResult leaf is [G, ...]
-        check_vma=False,
     )
     return mapped(pod_req, pod_masks, allocs, caps, match, aff_of, anti_of,
                   node_level, has_label, spread)
@@ -353,7 +378,7 @@ def sharded_scaledown_step(
 
     rep = P()
     cshard = P("candidate")
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         body,
         mesh=mesh,
         in_specs=(rep, cshard, cshard, cshard, rep,
@@ -362,7 +387,6 @@ def sharded_scaledown_step(
                   cshard if cand_sub is not None else None),
         out_specs=(cshard, rep),  # prefixes: per-candidate leaves shard
                                   # over [C, ...]; the joint result replicates
-        check_vma=False,
     )
     return mapped(snap, candidate_nodes, pod_slots, blocked, excluded,
                   spread, static_counts, cand_sub)

@@ -626,19 +626,44 @@ def _apply_row_rules(
         # per-placed-pod tuple hashing (the measured top self-cost of this
         # function at 165k placed pods)
         K = len(placed)
-        gids = np.fromiter(
-            (q.profile_id() for _, q, _ in placed), np.int64, count=K
-        )
         placed_node = np.fromiter(
             (j for _, _, j in placed), np.int64, count=K
         )
         placed_live = np.fromiter(
             (q.deletion_ts is None for _, q, _ in placed), bool, count=K
         )
-        uniq, placed_prof = (
-            np.unique(gids, return_inverse=True) if K else (gids, gids)
-        )
-        profiles = [k8s.pod_profile_value(int(g)) for g in uniq]
+        # ids are only comparable within one registry EPOCH, and the capped
+        # registry can reset mid-pass (RPC worker threads intern too): snap
+        # the epoch, build, and rebuild if it moved — ids from two epochs in
+        # one np.unique remap would collide distinct profiles. Persistent
+        # churn (reset every attempt) falls back to local tuple-key
+        # interning, which needs no global registry at all.
+        for _attempt in range(4):
+            epoch0 = k8s.pod_profile_epoch()
+            gids = np.fromiter(
+                (q.profile_id() for _, q, _ in placed), np.int64, count=K
+            )
+            uniq, placed_prof = (
+                np.unique(gids, return_inverse=True) if K else (gids, gids)
+            )
+            try:
+                profiles = [k8s.pod_profile_value(int(g)) for g in uniq]
+            except IndexError:  # registry cleared under us
+                continue
+            if k8s.pod_profile_epoch() == epoch0:
+                break
+        else:
+            local_ids: Dict[tuple, int] = {}
+            profiles = []
+            placed_prof = np.empty(K, np.int64)
+            for i, (_, q, _) in enumerate(placed):
+                pk = q.profile_key()
+                lid = local_ids.get(pk)
+                if lid is None:
+                    lid = len(profiles)
+                    local_ids[pk] = lid
+                    profiles.append((q.namespace, q.labels))
+                placed_prof[i] = lid
 
         for t, (c, sel, ns, declarer, all_keys) in enumerate(term_list):
             node_dom, domains = domains_for(c.topology_key)

@@ -534,3 +534,65 @@ class TestControlLoopIntegration:
         autoscaler = StaticAutoscaler(provider, kube, opts)
         autoscaler.run_once(now_ts=1000.0)
         assert api.get_scale("MachineDeployment", "default", "workers") >= 1
+
+
+class TestFailedMachines:
+    """ADVICE r5 — status.failureMessage / Failed phase must surface as
+    InstanceErrorInfo on a stable capi:// id so the core's fast
+    deleteCreatedNodesWithErrors + failed-scale-up path reacts, instead of
+    waiting out maxNodeProvisionTime."""
+
+    def _world_with_failed(self, failure_message="quota exhausted", phase="Failed"):
+        api = InMemoryCapiApi()
+        api.add(md("web", replicas=3))
+        api.add(ms("web-abc", owner_md="web", replicas=3))
+        for i in range(2):
+            api.add(machine(f"web-abc-{i}", owner_ms="web-abc",
+                            labels={"md": "web"},
+                            provider_id=f"capi:////web-{i}"))
+        failed = machine("web-abc-2", owner_ms="web-abc",
+                         labels={"md": "web"}, phase=phase)
+        if failure_message:
+            failed["status"]["failureMessage"] = failure_message
+        api.add(failed)
+        p = ClusterAPIProvider(api)
+        (group,) = p.node_groups()
+        return api, p, group
+
+    def test_failure_message_surfaces_error_info(self):
+        from autoscaler_tpu.cloudprovider.interface import InstanceErrorClass
+
+        _, _, group = self._world_with_failed()
+        errored = [i for i in group.nodes() if i.error_info is not None]
+        assert len(errored) == 1
+        inst = errored[0]
+        assert inst.id == "capi://default/web-abc-2"
+        assert inst.state == InstanceState.CREATING
+        assert inst.error_info.error_class == InstanceErrorClass.OTHER
+        assert "quota exhausted" in inst.error_info.error_message
+
+    def test_failed_phase_without_message_still_errors(self):
+        _, _, group = self._world_with_failed(failure_message="")
+        errored = [i for i in group.nodes() if i.error_info is not None]
+        assert len(errored) == 1
+        assert "failed" in errored[0].error_info.error_message
+
+    def test_healthy_machines_carry_no_error_info(self):
+        _, _, group = self._world_with_failed()
+        healthy = [i for i in group.nodes() if i.error_info is None]
+        assert len(healthy) == 2
+        assert all(i.state == InstanceState.RUNNING for i in healthy)
+
+    def test_errored_instance_deletable_by_capi_id(self):
+        """The core deletes errored instances as Node(name=id,
+        provider_id=id) — the capi:// id must resolve back to the machine
+        (static_autoscaler._delete_created_nodes_with_errors)."""
+        from autoscaler_tpu.kube.objects import Node
+
+        api, _, group = self._world_with_failed()
+        (inst,) = [i for i in group.nodes() if i.error_info is not None]
+        group.delete_nodes([Node(name=inst.id, provider_id=inst.id)])
+        assert group.target_size() == 2
+        m = [x for x in api.list_machines("default")
+             if x["metadata"]["name"] == "web-abc-2"][0]
+        assert delete_machine_key() in m["metadata"].get("annotations", {})

@@ -1,0 +1,281 @@
+"""Scenario engine (autoscaler_tpu/loadgen): spec round-trip, deterministic
+replay, synthetic workloads, fault injection driving real backoff, and the
+score report contract the acceptance criteria pin."""
+import copy
+import json
+
+import pytest
+
+from autoscaler_tpu.loadgen.driver import ScenarioDriver, run_scenario
+from autoscaler_tpu.loadgen.score import build_report
+from autoscaler_tpu.loadgen.spec import (
+    Event,
+    FaultSpec,
+    NodeGroupSpec,
+    ScenarioSpec,
+    SpecError,
+    WorkloadSpec,
+)
+from autoscaler_tpu.loadgen.workloads import expand_workloads
+
+
+def small_spec(**kw):
+    base = dict(
+        name="t",
+        seed=9,
+        ticks=6,
+        node_groups=[
+            NodeGroupSpec(name="g", min_size=0, max_size=10, initial_size=1)
+        ],
+        events=[
+            Event(at_tick=1, kind="pod_burst", count=8, cpu_m=1500.0,
+                  mem_mb=1024.0, prefix="burst")
+        ],
+    )
+    base.update(kw)
+    return ScenarioSpec(**base)
+
+
+def stripped_log(result):
+    # to_dict() already excludes wall_s — the log IS the replay artifact
+    return json.dumps(result.decision_log(), sort_keys=True)
+
+
+class TestSpecRoundTrip:
+    def test_json_round_trip_exact(self):
+        spec = small_spec(
+            workloads=[WorkloadSpec(kind="diurnal", rate=4.0, period_ticks=8)],
+            faults=[FaultSpec(kind="stuck_creating", group="g", start_tick=2)],
+            options={"max_nodes_total": 50},
+        )
+        again = ScenarioSpec.from_json(spec.to_json())
+        assert again == spec
+        # and a second serialization is byte-identical
+        assert again.to_json() == spec.to_json()
+
+    def test_unknown_fields_rejected(self):
+        doc = small_spec().to_dict()
+        doc["surprise"] = 1
+        with pytest.raises(SpecError, match="surprise"):
+            ScenarioSpec.from_dict(doc)
+
+    def test_bad_event_kind_rejected(self):
+        with pytest.raises(SpecError, match="unknown event kind"):
+            Event(at_tick=0, kind="meteor")
+
+    def test_fault_event_needs_payload(self):
+        with pytest.raises(SpecError, match="fault event without"):
+            Event(at_tick=0, kind="fault")
+
+    def test_duplicate_groups_rejected(self):
+        with pytest.raises(SpecError, match="duplicate"):
+            small_spec(
+                node_groups=[
+                    NodeGroupSpec(name="g"), NodeGroupSpec(name="g"),
+                ]
+            )
+
+    def test_canned_scenarios_parse(self):
+        for name in ("burst_small", "diurnal_medium", "fault_backoff",
+                     "drain_heavy"):
+            spec = ScenarioSpec.load(f"benchmarks/scenarios/{name}.json")
+            assert ScenarioSpec.from_json(spec.to_json()) == spec
+
+
+class TestWorkloadExpansion:
+    def test_deterministic_per_seed(self):
+        spec = small_spec(
+            events=[],
+            workloads=[WorkloadSpec(kind="spike", rate=2.0, period_ticks=3)],
+        )
+        a = expand_workloads(spec)
+        b = expand_workloads(copy.deepcopy(spec))
+        assert a == b
+        spec.seed += 1
+        assert expand_workloads(spec) != a
+
+    def test_all_kinds_produce_events(self):
+        for kind in ("steady", "diurnal", "spike", "drain_heavy"):
+            spec = small_spec(
+                ticks=12,
+                events=[],
+                workloads=[
+                    WorkloadSpec(kind=kind, rate=6.0, period_ticks=6,
+                                 completion_rate=0.3)
+                ],
+            )
+            evs = expand_workloads(spec)
+            assert any(e.kind == "pod_burst" for e in evs), kind
+            assert all(0 <= e.at_tick < spec.ticks for e in evs)
+
+
+class TestDeterministicReplay:
+    def test_same_seed_identical_decision_log(self):
+        spec = small_spec(
+            workloads=[
+                WorkloadSpec(kind="steady", rate=2.0, completion_rate=0.2)
+            ]
+        )
+        a = run_scenario(spec)
+        b = run_scenario(ScenarioSpec.from_json(spec.to_json()))
+        assert stripped_log(a) == stripped_log(b)
+
+    def test_trace_replay_reproduces_log(self):
+        spec = small_spec(
+            workloads=[WorkloadSpec(kind="steady", rate=2.0)]
+        )
+        original = run_scenario(spec)
+        # replay: the recorded trace becomes the explicit event list
+        from autoscaler_tpu.loadgen.spec import _load_event
+
+        replay_spec = ScenarioSpec.from_json(spec.to_json())
+        replay_spec.workloads = []
+        replay_spec.events = [_load_event(e) for e in original.trace]
+        replayed = run_scenario(replay_spec)
+        assert stripped_log(original) == stripped_log(replayed)
+
+
+class TestBurstScenario:
+    def test_burst_scales_up_and_binds(self):
+        spec = small_spec()
+        result = run_scenario(spec)
+        ups = [u for r in result.records for u in r.scale_ups]
+        assert ups and all(g == "g" for g, _ in ups)
+        assert result.peak_nodes > 1
+        report = build_report(result)
+        assert report["decisions"]["scale_up_nodes"] >= 3
+        # every burst pod eventually bound, with measured latency fields
+        lat = report["pending_pod_latency_s"]
+        assert lat["never_bound"] == 0 and lat["bound"] == 8
+        assert lat["max"] >= lat["p50"] >= 0
+        assert report["tick_wall_s"]["total"] > 0
+
+    def test_completion_frees_capacity_for_scale_down(self):
+        spec = small_spec(
+            ticks=12,
+            events=[
+                Event(at_tick=1, kind="pod_burst", count=8, cpu_m=1500.0,
+                      mem_mb=1024.0, prefix="burst"),
+                Event(at_tick=5, kind="pod_complete", count=8, prefix="burst"),
+            ],
+        )
+        result = run_scenario(spec)
+        downs = [n for r in result.records for n in r.scale_downs]
+        assert downs, "emptied nodes must be scaled down"
+        assert result.final_nodes < result.peak_nodes
+
+
+class TestFaultScenarios:
+    def test_scale_up_error_drives_backoff(self):
+        spec = small_spec(
+            ticks=8,
+            faults=[
+                FaultSpec(kind="scale_up_error", group="g", start_tick=0,
+                          end_tick=4)
+            ],
+        )
+        result = run_scenario(spec)
+        assert result.injected_faults.get("scale_up_error", 0) >= 1
+        backoff_ticks = [r.tick for r in result.records if "g" in r.backed_off]
+        assert backoff_ticks, "rejected IncreaseSize must back the group off"
+        errors = [e for r in result.records for e in r.errors]
+        assert any("injected fault" in e for e in errors)
+
+    def test_instance_error_retries_after_cleanup(self):
+        spec = small_spec(
+            ticks=10,
+            faults=[
+                FaultSpec(kind="instance_error", group="g", start_tick=0,
+                          end_tick=2)
+            ],
+        )
+        result = run_scenario(spec)
+        assert result.injected_faults.get("instance_error", 0) >= 1
+        # errored instances are deleted and the scale-up retried once the
+        # fault window closes: capacity eventually lands
+        assert result.peak_nodes > 1
+        assert result.records[-1].pending_after == 0
+
+    def test_stuck_creating_times_out_into_backoff(self):
+        spec = small_spec(
+            ticks=10,
+            faults=[FaultSpec(kind="stuck_creating", group="g", start_tick=0)],
+            options={"max_node_provision_time_s": 20.0},
+        )
+        result = run_scenario(spec)
+        assert result.injected_faults.get("stuck_creating", 0) >= 1
+        assert any("g" in r.backed_off for r in result.records), (
+            "provision timeout must trigger failed-scale-up backoff"
+        )
+
+    def test_canned_fault_scenario_backs_off_and_recovers(self):
+        spec = ScenarioSpec.load("benchmarks/scenarios/fault_backoff.json")
+        spec.ticks = 14  # enough to cover both fault windows + recovery
+        result = run_scenario(spec)
+        assert result.injected_faults.get("scale_up_error", 0) >= 1
+        assert result.injected_faults.get("instance_error", 0) >= 1
+        assert any(r.backed_off for r in result.records)
+        assert result.peak_nodes > 2  # capacity lands once faults clear
+
+
+class TestNodeFlap:
+    def test_flapped_nodes_recover(self):
+        spec = small_spec(
+            ticks=8,
+            events=[
+                Event(at_tick=2, kind="node_flap", group="g", count=1,
+                      duration_ticks=2)
+            ],
+            node_groups=[
+                NodeGroupSpec(name="g", min_size=3, max_size=10,
+                              initial_size=3)
+            ],
+        )
+        result = run_scenario(spec)
+        ready = [r.nodes_ready for r in result.records]
+        assert min(ready[2:4]) <= 2, "flap must take a node unready"
+        assert ready[-1] >= 3, "flapped node must recover"
+
+
+class TestReportShape:
+    def test_report_has_acceptance_fields(self):
+        result = run_scenario(small_spec())
+        report = build_report(result)
+        for key in ("metric", "platform", "pending_pod_latency_s",
+                    "decisions", "tick_wall_s", "nodes"):
+            assert key in report
+        json.dumps(report)  # must be serializable as-is
+
+
+class TestReviewRegressions:
+    def test_out_of_range_event_rejected(self):
+        with pytest.raises(SpecError, match="never fire"):
+            small_spec(
+                ticks=4,
+                events=[Event(at_tick=9, kind="pod_burst", count=1)],
+            )
+
+    def test_decision_log_excludes_wall_time(self):
+        result = run_scenario(small_spec())
+        assert all("wall_s" not in entry for entry in result.decision_log())
+        # wall time still reaches the report
+        assert build_report(result)["tick_wall_s"]["total"] > 0
+
+    def test_refresh_error_fault_fires(self):
+        spec = small_spec(
+            faults=[FaultSpec(kind="refresh_error", start_tick=2, end_tick=4)],
+        )
+        result = run_scenario(spec)
+        assert result.injected_faults.get("refresh_error", 0) >= 1
+        errors = [e for r in result.records for e in r.errors]
+        assert any("provider refresh failed" in e for e in errors)
+
+    def test_eviction_fault_scoped_to_group(self):
+        from autoscaler_tpu.loadgen.faults import FaultInjector
+
+        inj = FaultInjector(
+            [FaultSpec(kind="eviction_error", group="g2")], seed=0
+        )
+        assert inj.on_evict("ns/p", "g2") is True
+        assert inj.on_evict("ns/p", "g1") is False
+        assert inj.on_evict("ns/p", "") is False

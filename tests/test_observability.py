@@ -519,3 +519,41 @@ class TestEstimationEnvelope:
     def test_within_budget_counter_stays_zero(self):
         m = self._run(max_duration_s=300.0)
         assert m.estimation_over_budget_total.get() == 0
+
+
+class TestEstimatorRouteMetric:
+    """ADVICE r5 — kernel-route observability must cover BOTH estimator
+    entry points: the single-template estimate() path records a route just
+    like the batched estimate_many dispatch."""
+
+    def test_single_template_plain_route_recorded(self):
+        from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+
+        m = AutoscalerMetrics(MetricsRegistry())
+        est = BinpackingNodeEstimator(metrics=m)
+        count, scheduled = est.estimate(
+            [build_test_pod(f"p{i}", cpu_m=600) for i in range(4)],
+            build_test_node("tmpl", cpu_m=1000, mem=2 * GB),
+        )
+        assert count > 0 and scheduled
+        assert m.estimator_kernel_route_total.get(
+            route="xla_single", reason="single_template"
+        ) == 1
+
+    def test_single_template_dynamic_route_recorded(self):
+        from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+        from autoscaler_tpu.utils.test_utils import anti_affinity
+
+        m = AutoscalerMetrics(MetricsRegistry())
+        est = BinpackingNodeEstimator(metrics=m)
+        pods = [
+            build_test_pod(
+                f"p{i}", cpu_m=600, labels={"app": "web"},
+                affinity=anti_affinity({"app": "web"}),
+            )
+            for i in range(3)
+        ]
+        est.estimate(pods, build_test_node("tmpl", cpu_m=1000, mem=2 * GB))
+        assert m.estimator_kernel_route_total.get(
+            route="xla_scan", reason="single_template"
+        ) == 1

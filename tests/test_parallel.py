@@ -363,3 +363,88 @@ class TestShardedKernelFleet:
                 jnp.asarray(blocked), jnp.asarray(excluded),
                 spread=((),) * 8,
             )
+
+
+class TestInertSpreadGate:
+    def test_sharded_affinity_inert_spread_rides_pallas_gate(self):
+        """ADVICE r5 — a padded-but-undeclared spread tuple (no pod sets
+        sp_of) must gate as S=0 like the estimator route, not hard-fail the
+        S>32 check: inert terms cannot affect placement. Results must match
+        the spread-free dispatch bit-for-bit on both kernel routes."""
+        from autoscaler_tpu.parallel.mesh import sharded_affinity_estimate
+        from autoscaler_tpu.utils.sharded_worlds import affinity_world
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(jax.devices()[:8]), ("group",))
+        G, P_, T, M = 8, 96, 4, 24
+        w = affinity_world(G, P_, T, M, seed=1)
+        S = 40  # past the 32-term Pallas payload, but every term inert
+        inert = (
+            np.zeros((P_, S), bool),          # sp_of.T — nothing declared
+            np.zeros((P_, S), bool),          # sp_match.T
+            np.zeros((S,), bool),             # node_level
+            np.zeros((S,), np.int32),         # max_skew
+            np.zeros((S,), np.int32),         # min_domains
+            np.zeros((G, S), bool),           # has_label
+            np.zeros((G, S), np.int32),       # static_count
+            np.zeros((G, S), np.int32),       # min_others
+            np.zeros((G, S), np.int32),       # static_min
+            np.zeros((G, S), np.int32),       # static_domnum
+            np.zeros((G, S), bool),           # force_zero
+        )
+        args = (
+            mesh, jnp.asarray(w["pod_req"]), jnp.asarray(w["pod_masks"]),
+            jnp.asarray(w["template_allocs"]), jnp.asarray(w["node_caps"]), M,
+            jnp.asarray(w["match"]), jnp.asarray(w["aff_of"]),
+            jnp.asarray(w["anti_of"]), jnp.asarray(w["node_level"]),
+            jnp.asarray(w["has_label"]),
+        )
+        for use_pallas in (False, True):
+            base = sharded_affinity_estimate(*args, use_pallas=use_pallas)
+            got = sharded_affinity_estimate(
+                *args, spread=tuple(jnp.asarray(a) for a in inert),
+                use_pallas=use_pallas,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got[0]), np.asarray(base[0]),
+                err_msg=f"use_pallas={use_pallas}",
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got[1]), np.asarray(base[1]),
+                err_msg=f"use_pallas={use_pallas}",
+            )
+
+    def test_sharded_affinity_declared_wide_spread_still_rejected(self):
+        """A DECLARED >32-term spread tuple keeps failing the Pallas gate
+        loudly (the payload really can't carry it)."""
+        from autoscaler_tpu.parallel.mesh import sharded_affinity_estimate
+        from autoscaler_tpu.utils.sharded_worlds import affinity_world
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(jax.devices()[:8]), ("group",))
+        G, P_, T, M = 8, 96, 4, 24
+        w = affinity_world(G, P_, T, M, seed=1)
+        S = 40
+        declared = [np.zeros((P_, S), bool) for _ in range(2)]
+        declared[0][0, 0] = True  # one pod declares one term
+        spread = (
+            jnp.asarray(declared[0]), jnp.asarray(declared[1]),
+            jnp.asarray(np.zeros((S,), bool)),
+            jnp.asarray(np.zeros((S,), np.int32)),
+            jnp.asarray(np.zeros((S,), np.int32)),
+            jnp.asarray(np.zeros((G, S), bool)),
+            jnp.asarray(np.zeros((G, S), np.int32)),
+            jnp.asarray(np.zeros((G, S), np.int32)),
+            jnp.asarray(np.zeros((G, S), np.int32)),
+            jnp.asarray(np.zeros((G, S), np.int32)),
+            jnp.asarray(np.zeros((G, S), bool)),
+        )
+        with pytest.raises(ValueError, match="VMEM gate"):
+            sharded_affinity_estimate(
+                mesh, jnp.asarray(w["pod_req"]), jnp.asarray(w["pod_masks"]),
+                jnp.asarray(w["template_allocs"]),
+                jnp.asarray(w["node_caps"]), M,
+                jnp.asarray(w["match"]), jnp.asarray(w["aff_of"]),
+                jnp.asarray(w["anti_of"]), jnp.asarray(w["node_level"]),
+                jnp.asarray(w["has_label"]), spread=spread, use_pallas=True,
+            )

@@ -458,3 +458,93 @@ class TestOracleParity:
             expected = oracle_row(nodes, pods, node_of, i)
             np.testing.assert_array_equal(mask[i], expected, err_msg=f"pod {i} dense")
             np.testing.assert_array_equal(fm[i], expected, err_msg=f"pod {i} factored")
+
+
+class TestProfileEpochAtomicity:
+    """ADVICE r5 medium — a capped profile registry resetting MID-PASS must
+    not collide distinct profiles in the row rules: profile_id() returns the
+    (epoch, id) pair atomically, pod_profile_value reads under the lock, and
+    the packer snapshots the epoch, rebuilding (or falling back to tuple
+    interning) when it moved."""
+
+    def _world(self, n_profiles=12):
+        nodes, pods, node_of = [], [], []
+        for z in "ab":
+            node = build_test_node(f"n-{z}", cpu_m=100_000)
+            node.labels[ZONE] = f"zone-{z}"
+            nodes.append(node)
+        # distinct per-pod label profiles (the churn shape that trips the
+        # cap) placed alternately across zones
+        for i in range(n_profiles):
+            p = build_test_pod(
+                f"placed-{i}", cpu_m=10,
+                labels={"app": "web", "pod-hash": f"h{i}"},
+            )
+            pods.append(p)
+            node_of.append(i % 2)
+        new = build_test_pod("new", cpu_m=10, labels={"app": "web"})
+        new.topology_spread = (spread(max_skew=1, match={"app": "web"}),)
+        pods.append(new)
+        node_of.append(-1)
+        return nodes, pods, node_of
+
+    def test_mask_correct_under_tiny_cap(self, monkeypatch):
+        """Force a registry reset every few interns: every profile_id() pass
+        over the placed pods spans several epochs. The row rules must still
+        count all 12 placed matchers (6 per zone, balanced → both zones
+        admit the new pod; a collision under-counts or mismatches)."""
+        import autoscaler_tpu.kube.objects as k8s
+
+        nodes, pods, node_of = self._world()
+        expected = compute_sched_mask(nodes, pods, node_of)[-1]
+        monkeypatch.setattr(k8s, "_POD_PROFILE_CAP", 3)
+        # fresh instances so nothing rides the per-instance memo
+        nodes2, pods2, node_of2 = self._world()
+        got = compute_sched_mask(nodes2, pods2, node_of2)[-1]
+        np.testing.assert_array_equal(got, expected)
+
+    def test_concurrent_churn_does_not_corrupt_pass(self, monkeypatch):
+        """A writer thread interning unique profiles (the RPC-worker shape)
+        while the packer pass runs: with the tiny cap the registry resets
+        continuously, and every pass must still produce the oracle mask."""
+        import threading
+
+        import autoscaler_tpu.kube.objects as k8s
+
+        nodes, pods, node_of = self._world()
+        expected = compute_sched_mask(nodes, pods, node_of)[-1]
+        monkeypatch.setattr(k8s, "_POD_PROFILE_CAP", 4)
+        stop = threading.Event()
+
+        def churn():
+            i = 0
+            while not stop.is_set():
+                build_test_pod(
+                    f"churn-{i}", labels={"job": f"j{i}"}
+                ).profile_id()
+                i += 1
+
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        try:
+            for trial in range(8):
+                nodes2, pods2, node_of2 = self._world()
+                got = compute_sched_mask(nodes2, pods2, node_of2)[-1]
+                np.testing.assert_array_equal(
+                    got, expected, err_msg=f"trial {trial}"
+                )
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+    def test_profile_value_epoch_api(self):
+        from autoscaler_tpu.kube.objects import (
+            pod_profile_epoch,
+            pod_profile_value,
+        )
+
+        p = build_test_pod("api-check", labels={"app": "x"})
+        pid = p.profile_id()
+        ns, labels = pod_profile_value(pid)
+        assert ns == p.namespace and labels == p.labels
+        assert isinstance(pod_profile_epoch(), int)
