@@ -45,10 +45,16 @@ class ExponentialBackoff:
         self._entries.pop(group_id, None)
 
     def remove_stale(self, now_ts: float) -> None:
+        """Drop entries that are both idle past the reset timeout AND no
+        longer backing anything off. The second condition matters when an
+        operator configures reset_timeout below the backoff duration:
+        an entry can be 'stale' by idle time while its until_ts is still in
+        the future, and deleting it would lift an active backoff early."""
         stale = [
             g
             for g, e in self._entries.items()
             if now_ts - e.last_failure_ts > self.reset_timeout_s
+            and now_ts >= e.until_ts
         ]
         for g in stale:
             del self._entries[g]

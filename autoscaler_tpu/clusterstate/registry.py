@@ -175,6 +175,11 @@ class ClusterStateRegistry:
     def update_nodes(self, nodes: Sequence[Node], now_ts: float) -> None:
         self._nodes = list(nodes)
         self._last_update_ts = now_ts
+        # drop backoff entries idle past the reset timeout: they can never
+        # influence is_backed_off again (a new failure restarts at the
+        # initial duration), so keeping them only grows the map without
+        # bound across group churn on long-lived processes
+        self.backoff.remove_stale(now_ts)
         self._update_unregistered(now_ts)
         self._recalculate_readiness(now_ts)
         # acceptable ranges feed the scale-request fulfillment check, then

@@ -35,6 +35,13 @@ class ClusterStatus:
     total_registered: int
     groups: List[GroupStatus] = field(default_factory=list)
     cluster_name: str = ""  # --cluster-name, shown in the header when set
+    # kernel-ladder rungs whose circuit breaker is open/half-open: the
+    # autoscaler is still deciding, on a lower rung (degraded mode)
+    degraded_rungs: List[str] = field(default_factory=list)
+
+    @property
+    def degraded(self) -> bool:
+        return bool(self.degraded_rungs)
 
     def render(self) -> str:
         name = f" [{self.cluster_name}]" if self.cluster_name else ""
@@ -43,6 +50,11 @@ class ClusterStatus:
             f"Cluster-wide: Health: {self.cluster_health} "
             f"(ready={self.total_ready} registered={self.total_registered})",
         ]
+        if self.degraded_rungs:
+            lines.append(
+                "Degraded: kernel ladder rungs tripped: "
+                + ",".join(self.degraded_rungs)
+            )
         for g in self.groups:
             lines.append(
                 f"  NodeGroup {g.group_id}: Health: {g.health} "
@@ -54,7 +66,10 @@ class ClusterStatus:
 
 
 def build_status(
-    csr: ClusterStateRegistry, now_ts: float, cluster_name: str = ""
+    csr: ClusterStateRegistry,
+    now_ts: float,
+    cluster_name: str = "",
+    degraded_rungs=(),
 ) -> ClusterStatus:
     total = csr.total_readiness()
     status = ClusterStatus(
@@ -63,6 +78,7 @@ def build_status(
         total_ready=total.ready,
         total_registered=total.registered,
         cluster_name=cluster_name,
+        degraded_rungs=list(degraded_rungs),
     )
     for group in csr.provider.node_groups():
         gid = group.id()

@@ -50,6 +50,25 @@ class AutoscalingOptions:
     scan_interval_s: float = 10.0
     max_inactivity_s: float = 600.0               # health-check auto-restart
     max_failing_time_s: float = 900.0
+    # crash-only loop: run_loop catches per-iteration exceptions and keeps
+    # going; after this many CONSECUTIVE run_once failures it hard-exits
+    # (abnormally, so a supervisor restarts the process). 0 = never — the
+    # HealthCheck max_failing_time deadline remains the restart authority.
+    max_consecutive_run_once_failures: int = 0
+    # watchdog soft deadline for one run_once tick: exceeded → all-thread
+    # stack dump via utils/pprof (evidence before the liveness probe kills
+    # a wedged process). 0 = auto: max(4 x scan_interval, 60s).
+    run_once_soft_deadline_s: float = 0.0
+    # default deadline for sidecar RPCs that don't carry their own timeout
+    # (rpc/service.TpuSimulationClient): a wedged sidecar must fail the
+    # call, not hang run_once forever
+    rpc_default_deadline_s: float = 30.0
+    # estimator kernel-ladder circuit breakers (utils/circuit.py wrapped
+    # around each rung — Pallas / XLA scan / native FFD / python oracle):
+    # consecutive failures to trip a rung OPEN, and the cooldown before a
+    # half-open probe re-tests it
+    kernel_breaker_failure_threshold: int = 3
+    kernel_breaker_cooldown_s: float = 120.0
 
     # -- cluster-wide resource limits (main.go:113-118) ----------------------
     max_nodes_total: int = 0                      # 0 = unlimited

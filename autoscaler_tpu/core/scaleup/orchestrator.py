@@ -65,12 +65,19 @@ class ScaleUpOrchestrator:
         self.options = options
         self.csr = csr
         if estimator is None:
+            from autoscaler_tpu.estimator.ladder import KernelLadder
+
             estimator = BinpackingNodeEstimator(
                 limiter=ThresholdBasedEstimationLimiter(
                     max_nodes=options.max_nodes_per_scaleup,
                     max_duration_s=options.max_nodegroup_binpacking_duration_s,
                 ),
                 metrics=metrics,
+                # circuit-broken degradation ladder around the kernel rungs
+                ladder=KernelLadder(
+                    failure_threshold=options.kernel_breaker_failure_threshold,
+                    cooldown_s=options.kernel_breaker_cooldown_s,
+                ),
             )
         self.estimator = estimator
         self.expander = expander or build_strategy(
@@ -80,6 +87,7 @@ class ScaleUpOrchestrator:
             priorities_path=options.priority_config_file or None,
             priorities_fetch=priorities_fetch,
             grpc_target=options.grpc_expander_url or None,
+            rpc_deadline_s=options.rpc_default_deadline_s,
             # the price filter scores against the provider's pricing model
             # (expander/price/price.go); absent model → build_strategy
             # rejects the 'price' entry loudly

@@ -126,6 +126,11 @@ class StaticAutoscaler:
 
         m = self.metrics
         start = _time.monotonic()
+        # advance the kernel ladder's breaker clock on loop time (simulated
+        # time under loadgen — what makes breaker cooldowns replayable)
+        ladder = self.kernel_ladder()
+        if ladder is not None:
+            ladder.tick(now_ts)
         try:
             result = self._run_once_inner(now_ts)
         finally:
@@ -143,7 +148,8 @@ class StaticAutoscaler:
                         self.options.status_config_map_name,
                         {
                             "status": build_status(
-                                self.csr, now_ts, self.options.cluster_name
+                                self.csr, now_ts, self.options.cluster_name,
+                                degraded_rungs=self.degraded_rungs(),
                             ).render()
                         },
                     )
@@ -439,6 +445,19 @@ class StaticAutoscaler:
         return result
 
     # -- helpers -------------------------------------------------------------
+    def kernel_ladder(self):
+        """The estimator's circuit-broken kernel ladder, when wired (the
+        default orchestrator always wires one; a custom estimator may not)."""
+        est = getattr(self.scale_up_orchestrator, "estimator", None)
+        return getattr(est, "ladder", None)
+
+    def degraded_rungs(self) -> List[str]:
+        """Kernel rungs whose breaker is not closed. Nonempty = degraded
+        mode: decisions still flow, on a lower (slower) rung — surfaced on
+        /health-check, /status, and the status ConfigMap."""
+        ladder = self.kernel_ladder()
+        return ladder.degraded() if ladder is not None else []
+
     def _group_has_accelerator(self, group_id: Optional[str]) -> bool:
         if not group_id:
             return False

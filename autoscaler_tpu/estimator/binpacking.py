@@ -23,8 +23,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from autoscaler_tpu.core.scaleup.equivalence import build_pod_groups
+from autoscaler_tpu.estimator.ladder import (
+    HOST_LEVEL_SKIP_REASONS,
+    RUNG_NATIVE,
+    RUNG_PALLAS,
+    RUNG_PYTHON,
+    RUNG_XLA,
+    KernelLadder,
+)
 from autoscaler_tpu.estimator.limiter import ThresholdBasedEstimationLimiter
-from autoscaler_tpu.kube.objects import NUM_RESOURCES, Node, Pod
+from autoscaler_tpu.kube.objects import CPU, MEMORY, NUM_RESOURCES, Node, Pod
 from autoscaler_tpu.metrics import metrics as metrics_mod
 from autoscaler_tpu.ops.binpack import (
     BinpackResult,
@@ -66,6 +74,43 @@ def _estimation_schema(pods: Sequence[Pod]) -> tuple:
     NodeResourcesFit over arbitrary resource names; template-side names no
     pod requests can never gate a fit and must not widen the axis)."""
     return extended_schema((p.requests for p in pods))
+
+
+def _dedup_skip():
+    """Pallas pseudo-gate for the run-compressed paths: the recorded skip
+    reason is 'dedup' (routing), but the third element marks whether the
+    rung is ALSO host-level unexercisable — on a CPU-only host a half-open
+    pallas probe landing on a dedup dispatch must still resolve the
+    breaker closed (pallas can never fault here), while on a TPU it is
+    released unresolved (pallas may still fault on per-pod dispatches)."""
+    return ("dedup", "", jax.default_backend() != "tpu")
+
+
+def _build_group_arrays(
+    pods: Sequence[Pod],
+    names: Sequence[str],
+    templates: Dict[str, Node],
+    interpod: bool,
+    pad: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """→ (req [P,R], masks [G,P], allocs [G,R]) — the ONE packed-array
+    build shared by the device dispatch path and the host-rung fallbacks,
+    so the packing schema (extended columns, virtual port/CSI planes, mask
+    semantics) cannot diverge between rungs. ``pad`` bucket-pads the pod
+    axis for the device kernels; host rungs use the exact pod count."""
+    P = pad if pad is not None else len(pods)
+    ext = _estimation_schema(pods)
+    req = _pack_pods(pods, P, ext)
+    masks = np.stack(
+        [template_mask(pods, templates[g], P, interpod=interpod) for g in names]
+    )
+    allocs = np.stack(
+        [_template_capacity_row(templates[g], ext) for g in names]
+    )
+    req, allocs = _augment_virtual(
+        req, pods, allocs, [templates[g] for g in names]
+    )
+    return req, masks, allocs
 
 
 def template_mask(
@@ -166,9 +211,12 @@ class BinpackingNodeEstimator:
         self,
         limiter: Optional[ThresholdBasedEstimationLimiter] = None,
         metrics=None,    # AutoscalerMetrics; None = no recording
+        ladder: Optional[KernelLadder] = None,  # circuit-broken rung state
     ):
         self.limiter = limiter or ThresholdBasedEstimationLimiter()
         self.metrics = metrics
+        self.ladder = ladder or KernelLadder()
+        self.ladder.bind_metrics(metrics)
 
     def estimate(
         self,
@@ -197,12 +245,11 @@ class BinpackingNodeEstimator:
         alloc = alloc2d[0]
         cap = self.limiter.node_cap(max_size_headroom)
         # route observability covers BOTH entry points (ADVICE r5): the
-        # single-template path always rides the XLA scans today, so the
-        # metric records that — if this path ever grows a Pallas twin the
-        # reasons split the same way _estimate_many_inner's do
-        self._note_route(
-            "xla_scan" if dynamic else "xla_single", "single_template"
-        )
+        # single-template path rides the XLA scans when healthy (no Pallas
+        # twin exists for it), and the same degradation ladder — native
+        # serial FFD, then the pure-Python oracle — when the XLA rung is
+        # broken. All rungs share the FFD order spec, so the answer is
+        # rung-independent.
         if dynamic:
             terms = build_affinity_terms(
                 pods, [template], pad_pods=P, bucket_terms=True,
@@ -211,31 +258,71 @@ class BinpackingNodeEstimator:
             sp = build_spread_terms(
                 pods, [template], pad_pods=P, bucket_terms=True, cluster=cluster
             )
-            res = ffd_binpack_groups_affinity(
-                jnp.asarray(req),
-                jnp.asarray(mask[None, :]),
-                jnp.asarray(alloc[None, :]),
-                max_nodes=bucket_size(cap, minimum=8),
-                match=jnp.asarray(terms.match),
-                aff_of=jnp.asarray(terms.aff_of),
-                anti_of=jnp.asarray(terms.anti_of),
-                node_level=jnp.asarray(terms.node_level),
-                has_label=jnp.asarray(terms.has_label),
-                node_caps=jnp.asarray(np.array([cap], np.int32)),
-                spread=_spread_tuple(sp),
-            )
-            scheduled_mask = np.asarray(res.scheduled)[0]
-            count = int(np.asarray(res.node_count)[0])
+            has_spread = bool(sp.sp_of.any())
+
+            def xla_fn():
+                res = ffd_binpack_groups_affinity(
+                    jnp.asarray(req),
+                    jnp.asarray(mask[None, :]),
+                    jnp.asarray(alloc[None, :]),
+                    max_nodes=bucket_size(cap, minimum=8),
+                    match=jnp.asarray(terms.match),
+                    aff_of=jnp.asarray(terms.aff_of),
+                    anti_of=jnp.asarray(terms.anti_of),
+                    node_level=jnp.asarray(terms.node_level),
+                    has_label=jnp.asarray(terms.has_label),
+                    node_caps=jnp.asarray(np.array([cap], np.int32)),
+                    spread=_spread_tuple(sp),
+                )
+                return (
+                    int(np.asarray(res.node_count)[0]),
+                    np.asarray(res.scheduled)[0],
+                )
+
+            def host_fn(native: bool):
+                def fn():
+                    return self._host_one_affinity(
+                        req, mask, alloc, cap, terms, group_index=0,
+                        native=native,
+                    )
+                return fn
+
+            steps = [
+                (RUNG_XLA, "xla_scan", None, xla_fn),
+                (RUNG_NATIVE, "native",
+                 self._host_gate(spread_active=has_spread, need_native=True),
+                 host_fn(True)),
+                (RUNG_PYTHON, "python_ref",
+                 self._host_gate(spread_active=has_spread), host_fn(False)),
+            ]
         else:
-            r = ffd_binpack(
-                jnp.asarray(req),
-                jnp.asarray(mask),
-                jnp.asarray(alloc),
-                max_nodes=bucket_size(cap, minimum=8),
-                node_cap=jnp.int32(cap),
-            )
-            scheduled_mask = np.asarray(r.scheduled)
-            count = int(r.node_count)
+            def xla_fn():
+                r = ffd_binpack(
+                    jnp.asarray(req),
+                    jnp.asarray(mask),
+                    jnp.asarray(alloc),
+                    max_nodes=bucket_size(cap, minimum=8),
+                    node_cap=jnp.int32(cap),
+                )
+                return int(np.asarray(r.node_count)), np.asarray(r.scheduled)
+
+            def host_fn(native: bool):
+                def fn():
+                    return self._host_one_plain(
+                        req, mask, alloc, cap, native=native
+                    )
+                return fn
+
+            steps = [
+                (RUNG_XLA, "xla_single", None, xla_fn),
+                (RUNG_NATIVE, "native",
+                 self._host_gate(need_native=True), host_fn(True)),
+                (RUNG_PYTHON, "python_ref", None, host_fn(False)),
+            ]
+        count, scheduled_mask = self._walk_ladder(
+            steps, initial_reason="single_template",
+            forced=(steps[0][1], xla_fn),
+        )
         scheduled = [p for i, p in enumerate(pods) if scheduled_mask[i]]
         return count, scheduled
 
@@ -294,7 +381,7 @@ class BinpackingNodeEstimator:
             self.metrics.estimator_kernel_route_total.inc(
                 route=route, reason=reason
             )
-        if reason in ("vmem", "spread_width", "kernel_fault"):
+        if reason in ("vmem", "spread_width", "kernel_fault", "device_lost"):
             logging.getLogger("estimator").info(
                 "estimator dispatch fell back to %s (%s)%s",
                 route, reason, f": {detail}" if detail else "",
@@ -316,13 +403,31 @@ class BinpackingNodeEstimator:
             has_interpod_affinity(pods) or has_hard_spread(pods) or bool(vol_comps)
         )
         groups = pod_groups if pod_groups is not None else build_pod_groups(pods)
+        headrooms = headrooms or {}
+        caps = np.array(
+            [self.limiter.node_cap(headrooms.get(g, 0)) for g in names], np.int32
+        )
         if not dynamic_affinity:
             # Equivalence dedup pays when it actually compresses: scan steps
             # drop from P to U (one per unique pod type), the big win at the
-            # 100k-pending-pods scale where U is in the hundreds.
+            # 100k-pending-pods scale where U is in the hundreds. The runs
+            # kernels are XLA-only; when that rung is broken the ladder
+            # descends to the per-pod host rungs (dedup matters for scan
+            # step count, not host-loop correctness).
             if len(groups) * 2 <= len(pods):
-                self._note_route("xla_runs", "dedup")
-                return self._estimate_many_runs(pods, groups, names, templates, headrooms)
+                return self._walk_ladder([
+                    (RUNG_PALLAS, "pallas", _dedup_skip, None),
+                    (RUNG_XLA, "xla_runs", None,
+                     lambda: self._estimate_many_runs(
+                         pods, groups, names, templates, headrooms)),
+                    (RUNG_NATIVE, "native",
+                     self._host_gate(need_native=True),
+                     lambda: self._host_groups_plain(
+                         pods, names, templates, caps, native=True)),
+                    (RUNG_PYTHON, "python_ref", None,
+                     lambda: self._host_groups_plain(
+                         pods, names, templates, caps, native=False)),
+                ])
         elif not vol_comps and len(groups) * 2 <= len(pods):
             # vol_comps forces the per-pod path below: run compression
             # builds terms from group EXEMPLARS, and a controller-grouped
@@ -339,32 +444,46 @@ class BinpackingNodeEstimator:
                 self._expand_affinity_runs(pods, groups, templates, names, cluster)
             )
             if len(runs) * 2 <= len(pods):
-                self._note_route("xla_runs", "dedup")
-                return self._estimate_many_runs_affinity(
-                    pods, runs, group_terms, group_of_run, run_inv,
-                    names, templates, headrooms, group_sp,
-                )
+                has_spread = bool(group_sp.sp_of.any())
+
+                def runs_aff_fn():
+                    return self._estimate_many_runs_affinity(
+                        pods, runs, group_terms, group_of_run, run_inv,
+                        names, templates, headrooms, group_sp,
+                    )
+
+                return self._walk_ladder([
+                    (RUNG_PALLAS, "pallas", _dedup_skip, None),
+                    (RUNG_XLA, "xla_runs", None, runs_aff_fn),
+                    (RUNG_NATIVE, "native",
+                     self._host_gate(spread_active=has_spread, need_native=True),
+                     lambda: self._host_groups_affinity(
+                         pods, names, templates, caps, native=True)),
+                    (RUNG_PYTHON, "python_ref",
+                     self._host_gate(spread_active=has_spread),
+                     lambda: self._host_groups_affinity(
+                         pods, names, templates, caps, native=False)),
+                ], forced=("xla_runs", runs_aff_fn))
         P = bucket_size(len(pods))
-        ext = _estimation_schema(pods)
-        req = _pack_pods(pods, P, ext)
-        masks = np.stack(
-            [
-                template_mask(pods, templates[g], P, interpod=not dynamic_affinity)
-                for g in names
-            ]
-        )
-        allocs = np.stack(
-            [
-                _template_capacity_row(templates[g], ext)
-                for g in names
-            ]
-        )
-        req, allocs = _augment_virtual(req, pods, allocs, [templates[g] for g in names])
-        headrooms = headrooms or {}
-        caps = np.array(
-            [self.limiter.node_cap(headrooms.get(g, 0)) for g in names], np.int32
+        req, masks, allocs = _build_group_arrays(
+            pods, names, templates, interpod=not dynamic_affinity, pad=P
         )
         scan_cap = bucket_size(int(caps.max()), minimum=8)
+
+        def assemble(res: BinpackResult) -> Dict[str, Tuple[int, List[Pod]]]:
+            # host fetch INSIDE the serving rung's try (np.asarray): async
+            # device execution means runtime kernel faults only surface on
+            # fetch, and they must land on the ladder, not the caller
+            counts = np.asarray(res.node_count)
+            scheds = np.asarray(res.scheduled)
+            return {
+                g: (
+                    int(counts[gi]),
+                    [p for i, p in enumerate(pods) if scheds[gi, i]],
+                )
+                for gi, g in enumerate(names)
+            }
+
         if dynamic_affinity:
             terms = build_affinity_terms(
                 pods, [templates[g] for g in names], pad_pods=P,
@@ -378,7 +497,7 @@ class BinpackingNodeEstimator:
             # pod DECLARES a term, not S > 0 (padded terms are inert)
             has_spread = bool(sp.sp_of.any())
             S_bucket = int(sp.sp_of.shape[0])
-            # VMEM pre-check for the Pallas route (shared byte model —
+            # VMEM pre-check for the Pallas rung (shared byte model —
             # pallas_binpack_affinity.affinity_vmem_estimate): workloads
             # past the v5e budget (very many distinct terms, huge caps,
             # wide extended-resource axes) stay on the XLA scan rather
@@ -388,6 +507,7 @@ class BinpackingNodeEstimator:
             from autoscaler_tpu.ops.pallas_binpack_affinity import (
                 VMEM_BUDGET,
                 affinity_vmem_estimate,
+                ffd_binpack_groups_affinity_pallas,
             )
 
             TP = max((terms.match.shape[0] + 31) // 32, 1)
@@ -395,57 +515,42 @@ class BinpackingNodeEstimator:
                 req.shape[1], TP, scan_cap, chunk=256,
                 S=S_bucket if has_spread else 0,
             )
-            res: Optional[BinpackResult] = None
             spread_ok = not has_spread or S_bucket <= 32
             vmem_ok = vmem_est <= VMEM_BUDGET
-            on_tpu = jax.default_backend() == "tpu"
-            fallback_reason = (
-                "not_tpu" if not on_tpu
-                else "spread_width" if not spread_ok
-                else "vmem" if not vmem_ok
-                else "kernel_fault"  # only reachable via the except below
+            gate_detail = (
+                f"T={int(terms.match.shape[0])} planes={TP} "
+                f"S={S_bucket if has_spread else 0} cap={scan_cap} "
+                f"R={req.shape[1]} vmem_est={vmem_est}B "
+                f"budget={VMEM_BUDGET}B"
             )
-            if spread_ok and vmem_ok and on_tpu:
+
+            def pallas_gate():
+                if jax.default_backend() != "tpu":
+                    return ("not_tpu", gate_detail)
+                if not spread_ok:
+                    return ("spread_width", gate_detail)
+                if not vmem_ok:
+                    return ("vmem", gate_detail)
+                return None
+
+            def pallas_fn():
                 # Pallas VMEM twin for the reference's documented ~1000x
                 # pain point (FAQ.md:151-153): bitset term carry for the
                 # affinity gates, count planes for hard topology spread.
-                from autoscaler_tpu.ops.pallas_binpack_affinity import (
-                    ffd_binpack_groups_affinity_pallas,
-                )
+                return assemble(ffd_binpack_groups_affinity_pallas(
+                    req, masks, allocs,
+                    max_nodes=scan_cap,
+                    match=terms.match,
+                    aff_of=terms.aff_of,
+                    anti_of=terms.anti_of,
+                    node_level=terms.node_level,
+                    has_label=terms.has_label,
+                    node_caps=caps,
+                    spread=_spread_tuple(sp) if has_spread else None,
+                ))
 
-                try:
-                    res = ffd_binpack_groups_affinity_pallas(
-                        req, masks, allocs,
-                        max_nodes=scan_cap,
-                        match=terms.match,
-                        aff_of=terms.aff_of,
-                        anti_of=terms.anti_of,
-                        node_level=terms.node_level,
-                        has_label=terms.has_label,
-                        node_caps=caps,
-                        spread=_spread_tuple(sp) if has_spread else None,
-                    )
-                    # async TPU execution: force a host fetch inside the
-                    # try so runtime kernel faults hit the fallback
-                    np.asarray(res.node_count)
-                    self._note_route("pallas_affinity", "ok")
-                except Exception:  # noqa: BLE001 — any kernel failure
-                    res = None
-                    logging.getLogger("estimator").warning(
-                        "pallas affinity kernel failed; falling back to the "
-                        "XLA scan", exc_info=True,
-                    )
-            if res is None:
-                self._note_route(
-                    "xla_scan", fallback_reason,
-                    detail=(
-                        f"T={int(terms.match.shape[0])} planes={TP} "
-                        f"S={S_bucket if has_spread else 0} cap={scan_cap} "
-                        f"R={req.shape[1]} vmem_est={vmem_est}B "
-                        f"budget={VMEM_BUDGET}B"
-                    ),
-                )
-                res = ffd_binpack_groups_affinity(
+            def xla_aff_fn():
+                return assemble(ffd_binpack_groups_affinity(
                     jnp.asarray(req),
                     jnp.asarray(masks),
                     jnp.asarray(allocs),
@@ -457,9 +562,23 @@ class BinpackingNodeEstimator:
                     node_level=jnp.asarray(terms.node_level),
                     has_label=jnp.asarray(terms.has_label),
                     node_caps=jnp.asarray(caps),
-                )
+                ))
+
+            return self._walk_ladder([
+                (RUNG_PALLAS, "pallas_affinity", pallas_gate, pallas_fn),
+                (RUNG_XLA, "xla_scan", None, xla_aff_fn),
+                (RUNG_NATIVE, "native",
+                 self._host_gate(spread_active=has_spread, need_native=True),
+                 lambda: self._host_affinity_from_arrays(
+                     pods, names, req, masks, allocs, caps, terms,
+                     native=True)),
+                (RUNG_PYTHON, "python_ref",
+                 self._host_gate(spread_active=has_spread),
+                 lambda: self._host_affinity_from_arrays(
+                     pods, names, req, masks, allocs, caps, terms,
+                     native=False)),
+            ], forced=("xla_scan", xla_aff_fn))
         else:
-            res = None
             from autoscaler_tpu.ops.pallas_binpack import (
                 VMEM_BUDGET,
                 ffd_binpack_groups_pallas,
@@ -467,56 +586,267 @@ class BinpackingNodeEstimator:
             )
 
             plain_vmem = plain_vmem_estimate(req.shape[1], scan_cap, chunk=512)
-            on_tpu = jax.default_backend() == "tpu"
-            fallback_reason = (
-                "not_tpu" if not on_tpu
-                else "vmem" if plain_vmem > VMEM_BUDGET
-                else "kernel_fault"
+            gate_detail = (
+                f"cap={scan_cap} R={req.shape[1]} "
+                f"vmem_est={plain_vmem}B budget={VMEM_BUDGET}B"
             )
-            if on_tpu and plain_vmem <= VMEM_BUDGET:
+
+            def pallas_gate():
+                if jax.default_backend() != "tpu":
+                    return ("not_tpu", gate_detail)
+                if plain_vmem > VMEM_BUDGET:
+                    return ("vmem", gate_detail)
+                return None
+
+            def pallas_fn():
                 # the headline VMEM kernel IS the production dispatch for
                 # the plain (non-compressing, no-affinity) case — same
                 # pre-check + fallback discipline as the affinity route.
                 # (When dedup compresses, the runs path above already
                 # collapsed P to U scan steps and the XLA runs kernel
                 # wins.)
+                return assemble(ffd_binpack_groups_pallas(
+                    req, masks, allocs,
+                    max_nodes=scan_cap, node_caps=caps,
+                ))
 
-                try:
-                    res = ffd_binpack_groups_pallas(
-                        req, masks, allocs,
-                        max_nodes=scan_cap, node_caps=caps,
-                    )
-                    # async TPU execution: force a host fetch inside the
-                    # try so runtime kernel faults hit the fallback
-                    np.asarray(res.node_count)
-                    self._note_route("pallas", "ok")
-                except Exception:  # noqa: BLE001 — any kernel failure
-                    res = None
-                    logging.getLogger("estimator").warning(
-                        "pallas binpack kernel failed; falling back to the "
-                        "XLA scan", exc_info=True,
-                    )
-            if res is None:
-                self._note_route(
-                    "xla_scan", fallback_reason,
-                    detail=(
-                        f"cap={scan_cap} R={req.shape[1]} "
-                        f"vmem_est={plain_vmem}B budget={VMEM_BUDGET}B"
-                    ),
-                )
-                res = ffd_binpack_groups(
+            def xla_plain_fn():
+                return assemble(ffd_binpack_groups(
                     jnp.asarray(req),
                     jnp.asarray(masks),
                     jnp.asarray(allocs),
                     max_nodes=scan_cap,
                     node_caps=jnp.asarray(caps),
+                ))
+
+            return self._walk_ladder([
+                (RUNG_PALLAS, "pallas", pallas_gate, pallas_fn),
+                (RUNG_XLA, "xla_scan", None, xla_plain_fn),
+                (RUNG_NATIVE, "native", self._host_gate(need_native=True),
+                 lambda: self._host_plain_from_arrays(
+                     pods, names, req, masks, allocs, caps, native=True)),
+                (RUNG_PYTHON, "python_ref", None,
+                 lambda: self._host_plain_from_arrays(
+                     pods, names, req, masks, allocs, caps, native=False)),
+            ], forced=("xla_scan", xla_plain_fn))
+
+    # -- degradation ladder (utils/circuit.py + estimator/ladder.py) ---------
+    def _walk_ladder(self, steps, initial_reason: str = "ok", forced=None):
+        """Walk one dispatch down the kernel ladder.
+
+        ``steps`` is an ordered list of ``(rung, route_label, gate, fn)``:
+        ``gate()`` returns None when the rung can serve this dispatch, else
+        ``(reason, detail)`` — an environmental skip that leaves the rung's
+        breaker closed; ``fn()`` computes the result (raising records a
+        breaker failure). A rung whose breaker is OPEN is skipped outright —
+        no re-attempt, no re-paid compile/dispatch latency — until its
+        cooldown admits a half-open probe. The serving rung's route metric
+        carries the most recent skip/failure reason, so pallas→xla→native
+        transitions are visible per dispatch.
+
+        ``forced`` = (label, fn) runs when every rung was skipped or failed
+        (e.g. a topology-spread dispatch, which no host rung supports, with
+        the device rungs broken): the breaker is bypassed — keep deciding —
+        and exceptions propagate to the crash-only control loop."""
+        log = logging.getLogger("estimator")
+        reason, detail = initial_reason, ""
+        for rung, label, gate, fn in steps:
+            engaged = self.ladder.begin(rung)
+            if engaged == "breaker_open":
+                reason, detail = "breaker_open", f"{rung} rung breaker open"
+                continue
+            if engaged is not None:  # an injected device-fault kind
+                log.warning(
+                    "%s kernel rung failed (injected %s); descending the "
+                    "ladder", rung, engaged,
                 )
-        counts = np.asarray(res.node_count)
-        scheds = np.asarray(res.scheduled)
+                reason, detail = engaged, f"injected {engaged} on {rung} rung"
+                continue
+            try:
+                skip = gate() if gate is not None else None
+            except Exception:  # noqa: BLE001 — a raising gate counts as a
+                # rung failure: the begin() above MUST be resolved, or a
+                # held half-open probe slot would leak and wedge the rung
+                self.ladder.record_failure(rung)
+                log.warning(
+                    "%s rung availability gate raised; descending the "
+                    "ladder", rung, exc_info=True,
+                )
+                reason, detail = "kernel_fault", f"{rung} gate raised"
+                continue
+            if skip is None and fn is None:
+                skip = (
+                    "unsupported", f"{rung} rung has no twin for this dispatch"
+                )
+            if skip is not None:
+                # a gate may append an explicit host-level flag (third
+                # element) when the recorded reason is dispatch-level
+                # routing but the rung is ALSO host-level unexercisable —
+                # e.g. the dedup pseudo-gate on a CPU-only host
+                host_level = (
+                    skip[2] if len(skip) > 2
+                    else skip[0] in HOST_LEVEL_SKIP_REASONS
+                )
+                reason, detail = skip[0], skip[1]
+                if host_level:
+                    # static for this process: a probe landing here closes
+                    # the breaker (the rung can never fault on this host)
+                    self.ladder.record_unavailable(rung)
+                else:
+                    # dispatch-level routing: release a held probe slot
+                    # unresolved — closing a tripped rung off a dispatch
+                    # that never exercised it would re-pay
+                    # failure_threshold faults on the next eligible one
+                    self.ladder.record_skipped_dispatch(rung)
+                continue
+            try:
+                out = fn()
+            except Exception:  # noqa: BLE001 — any kernel failure descends
+                self.ladder.record_failure(rung)
+                log.warning(
+                    "%s kernel rung failed; descending the ladder",
+                    rung, exc_info=True,
+                )
+                reason, detail = "kernel_fault", f"{rung} kernel raised"
+                continue
+            self.ladder.record_success(rung)
+            self._note_route(label, reason, detail)
+            return out
+        if forced is not None:
+            label, fn = forced
+            log.error(
+                "every kernel rung skipped or failed (last: %s); forcing the "
+                "%s dispatch despite its breaker", reason, label,
+            )
+            out = fn()
+            self._note_route(label, "forced", detail)
+            return out
+        from autoscaler_tpu.utils.errors import AutoscalerError, ErrorType
+
+        raise AutoscalerError(
+            ErrorType.INTERNAL,
+            f"no kernel rung could serve the dispatch (last: {reason})",
+        )
+
+    @staticmethod
+    def _host_gate(spread_active: bool = False, need_native: bool = False):
+        """Availability gate for the host rungs. Topology-spread counting
+        has no host twin (see PREDICATES.md): spread dispatches bottom out
+        at the XLA rung. The affinity term factorization (incl. synthetic
+        volume-conflict terms) IS supported on both host rungs."""
+        def gate():
+            if spread_active:
+                return (
+                    "spread_unsupported",
+                    "host rungs lack topology-spread counting",
+                )
+            if need_native:
+                from autoscaler_tpu import native_bridge
+
+                if not native_bridge.available():
+                    return (
+                        "native_unavailable", str(native_bridge.build_error())
+                    )
+            return None
+
+        return gate
+
+    def _host_plain_from_arrays(
+        self, pods, names, req, masks, allocs, caps, native: bool
+    ) -> Dict[str, Tuple[int, List[Pod]]]:
+        """Host rungs, plain family: serial FFD per group over the SAME
+        packed arrays the device kernels see. All rungs share the one FFD
+        order spec (reference_impl.ffd_order), so the answer is
+        rung-independent — parity-locked in tests/test_processors_rpc_native."""
         out: Dict[str, Tuple[int, List[Pod]]] = {}
         for gi, g in enumerate(names):
-            out[g] = (int(counts[gi]), [p for i, p in enumerate(pods) if scheds[gi, i]])
+            count, sched = self._host_one_plain(
+                req, masks[gi], allocs[gi], int(caps[gi]), native
+            )
+            out[g] = (count, [p for i, p in enumerate(pods) if sched[i]])
         return out
+
+    def _host_affinity_from_arrays(
+        self, pods, names, req, masks, allocs, caps, terms, native: bool
+    ) -> Dict[str, Tuple[int, List[Pod]]]:
+        """Host rungs, affinity family (term factorization; spread gated
+        upstream by _host_gate)."""
+        out: Dict[str, Tuple[int, List[Pod]]] = {}
+        for gi, g in enumerate(names):
+            count, sched = self._host_one_affinity(
+                req, masks[gi], allocs[gi], int(caps[gi]), terms,
+                group_index=gi, native=native,
+            )
+            out[g] = (count, [p for i, p in enumerate(pods) if sched[i]])
+        return out
+
+    def _host_groups_plain(
+        self, pods, names, templates, caps, native: bool
+    ) -> Dict[str, Tuple[int, List[Pod]]]:
+        """Per-pod array build for the host rungs when the dispatch had
+        chosen run compression (an XLA-only optimization): built lazily so
+        the healthy path never pays the P-sized packing twice."""
+        req, masks, allocs = _build_group_arrays(
+            pods, names, templates, interpod=True
+        )
+        return self._host_plain_from_arrays(
+            pods, names, req, masks, allocs, caps, native
+        )
+
+    def _host_groups_affinity(
+        self, pods, names, templates, caps, native: bool
+    ) -> Dict[str, Tuple[int, List[Pod]]]:
+        req, masks, allocs = _build_group_arrays(
+            pods, names, templates, interpod=False
+        )
+        terms = build_affinity_terms(
+            pods, [templates[g] for g in names], pad_pods=len(pods),
+            volume_components=(),  # the runs-affinity path excludes conflicts
+        )
+        return self._host_affinity_from_arrays(
+            pods, names, req, masks, allocs, caps, terms, native
+        )
+
+    def _host_one_plain(self, req, mask, alloc, cap, native: bool):
+        """Single-template host fallback → (count, scheduled mask)."""
+        if native:
+            from autoscaler_tpu.native_bridge import ffd_binpack_native
+
+            count, sched = ffd_binpack_native(
+                req, mask, alloc, int(cap), cpu_axis=CPU, mem_axis=MEMORY
+            )
+        else:
+            from autoscaler_tpu.estimator.reference_impl import (
+                ffd_binpack_reference,
+            )
+
+            count, sched = ffd_binpack_reference(req, mask, alloc, int(cap))
+        return int(count), sched
+
+    def _host_one_affinity(
+        self, req, mask, alloc, cap, terms, group_index: int, native: bool
+    ):
+        m = np.asarray(terms.match)
+        a = np.asarray(terms.aff_of)
+        x = np.asarray(terms.anti_of)
+        nl = np.asarray(terms.node_level)
+        hl = np.asarray(terms.has_label)[group_index]
+        if native:
+            from autoscaler_tpu.native_bridge import ffd_binpack_affinity_native
+
+            count, sched = ffd_binpack_affinity_native(
+                req, mask, alloc, int(cap), m, a, x, nl, hl,
+                cpu_axis=CPU, mem_axis=MEMORY,
+            )
+        else:
+            from autoscaler_tpu.estimator.reference_impl import (
+                ffd_binpack_reference_affinity,
+            )
+
+            count, sched = ffd_binpack_reference_affinity(
+                req, mask, alloc, int(cap), m, a, x, nl, hl
+            )
+        return int(count), sched
 
     @staticmethod
     def _expand_affinity_runs(

@@ -168,7 +168,10 @@ def build_strategy(names: Sequence[str], seed: Optional[int] = None, **kwargs) -
                 raise ValueError(
                     "expander 'grpc' needs a target (--grpc-expander-url)"
                 )
-            filters.append(GRPCFilter(kwargs["grpc_target"]))
+            filters.append(GRPCFilter(
+                kwargs["grpc_target"],
+                default_deadline_s=kwargs.get("rpc_deadline_s"),
+            ))
         elif name == GRPC_REF:
             from autoscaler_tpu.expander.grpc_ import RefGRPCFilter
 

@@ -14,10 +14,31 @@ from autoscaler_tpu.expander.core import Filter, Option
 
 
 class GRPCFilter(Filter):
-    def __init__(self, target: str, timeout_s: float = 5.0):
+    def __init__(
+        self,
+        target: str,
+        timeout_s: Optional[float] = None,
+        default_deadline_s: Optional[float] = None,
+    ):
         from autoscaler_tpu.rpc.service import TpuSimulationClient
 
-        self.client = TpuSimulationClient(target)
+        # default_deadline_s (AutoscalingOptions.rpc_default_deadline_s /
+        # --rpc-default-deadline) seeds the client's default so every RPC
+        # on it carries a deadline. The expander decision itself stays
+        # bounded by an additional hard 5s per-send cap (the historical
+        # behavior; best_options fails open to the local filters);
+        # lowering the flag below 5s tightens it, raising it does not
+        # widen it. Worst case per tick is 2x the cap: the client's single
+        # reconnect-and-resend on UNAVAILABLE pays the deadline once more.
+        self.client = TpuSimulationClient(
+            target, default_timeout_s=default_deadline_s
+        )
+        if timeout_s is None:
+            timeout_s = (
+                min(default_deadline_s, 5.0)
+                if default_deadline_s is not None
+                else 5.0
+            )
         self.timeout_s = timeout_s
 
     def best_options(self, options: List[Option]) -> List[Option]:

@@ -23,7 +23,7 @@ import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from autoscaler_tpu.kube import convert
-from autoscaler_tpu.utils.http import json_request
+from autoscaler_tpu.utils.http import RetryPolicy, json_request
 from autoscaler_tpu.kube.api import ClusterAPI, EvictionError
 from autoscaler_tpu.kube.objects import Node, Pod, PodDisruptionBudget, Taint
 
@@ -79,12 +79,19 @@ class KubeRestClient:
         user_agent: str = "tpu-autoscaler",
         qps: float = 0.0,
         burst: int = 10,
+        get_retries: int = 2,
     ):
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout_s = timeout_s
         self.user_agent = user_agent
         self._limiter = _TokenBucket(qps, burst)
+        # transient-failure retries for idempotent GETs only (LISTs, object
+        # reads): 429/5xx honoring Retry-After, plus transport errors, with
+        # jittered bounded backoff (utils/http.RetryPolicy). Writes never
+        # retry at this layer — the caller cannot know whether the server
+        # applied the mutation. 0 disables.
+        self.get_retries = max(int(get_retries), 0)
         if self.base_url.startswith("https"):
             ctx = ssl.create_default_context(cafile=ca_file)
             if not verify:
@@ -101,6 +108,7 @@ class KubeRestClient:
         user_agent: str = "tpu-autoscaler",
         qps: float = 0.0,
         burst: int = 10,
+        get_retries: int = 2,
     ) -> "KubeRestClient":
         """Minimal kubeconfig loader (--kubeconfig): current-context (or the
         named one) → cluster server + CA + bearer token / client cert.
@@ -198,6 +206,7 @@ class KubeRestClient:
                 server, token=token or None, ca_file=ca_file,
                 verify=not cluster.get("insecure-skip-tls-verify", False),
                 user_agent=user_agent, qps=qps, burst=burst,
+                get_retries=get_retries,
             )
             cert = materialize(
                 "client-certificate-data", "client-certificate", ".crt"
@@ -215,7 +224,8 @@ class KubeRestClient:
 
     @staticmethod
     def in_cluster(
-        user_agent: str = "tpu-autoscaler", qps: float = 0.0, burst: int = 10
+        user_agent: str = "tpu-autoscaler", qps: float = 0.0, burst: int = 10,
+        get_retries: int = 2,
     ) -> "KubeRestClient":
         """Service-account config, like rest.InClusterConfig."""
         import os
@@ -227,6 +237,7 @@ class KubeRestClient:
         return KubeRestClient(
             f"https://{host}:{port}", token=token, ca_file=SA_CA_PATH,
             user_agent=user_agent, qps=qps, burst=burst,
+            get_retries=get_retries,
         )
 
     def _request(
@@ -244,6 +255,11 @@ class KubeRestClient:
             headers["Content-Type"] = content_type
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
+        # retrying boundary for idempotent reads only; watch streams have
+        # their own relist loop (WatchCache) and writes must not re-send
+        retry = None
+        if method == "GET" and not stream and self.get_retries > 0:
+            retry = RetryPolicy(attempts=self.get_retries + 1)
         return json_request(
             self.base_url + path,
             method=method,
@@ -253,6 +269,7 @@ class KubeRestClient:
             context=self._ctx,
             on_error=ApiError,
             stream=stream,
+            retry=retry,
         )
 
     def get(self, path: str) -> dict:

@@ -34,7 +34,7 @@ from autoscaler_tpu.cloudprovider.interface import Instance, InstanceState
 from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
 from autoscaler_tpu.config.options import AutoscalingOptions
 from autoscaler_tpu.core.scaledown.actuator import ScaleDownActuator
-from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from autoscaler_tpu.core.static_autoscaler import RunOnceResult, StaticAutoscaler
 from autoscaler_tpu.kube.api import EvictionError, FakeClusterAPI
 from autoscaler_tpu.kube.objects import (
     LabelSelector,
@@ -100,6 +100,9 @@ class TickRecord:
     evicted: List[str] = field(default_factory=list)
     backed_off: List[str] = field(default_factory=list)
     errors: List[str] = field(default_factory=list)
+    # kernel-ladder rungs with a tripped breaker after this tick (sorted):
+    # nonempty = degraded mode, decisions flowing on a lower rung
+    degraded: List[str] = field(default_factory=list)
     unneeded: int = 0
     nodes_ready: int = 0
     nodes_total: int = 0
@@ -149,10 +152,15 @@ class _FaultyCloudProvider(TestCloudProvider):
 
 
 class _FaultyClusterAPI(FakeClusterAPI):
-    """FakeClusterAPI whose evictions consult the fault injector."""
+    """FakeClusterAPI whose evictions and (inside run_once) listings consult
+    the fault injector."""
 
     injector: Optional[FaultInjector] = None      # seated by the driver
     group_of_node = staticmethod(lambda name: "")  # seated by the driver
+    # kube_api_error only fires on calls made by the loop under test, not
+    # on the driver's own bookkeeping reads — the driver toggles this
+    # around run_once
+    in_run_once: bool = False
 
     def evict_pod(self, pod: Pod) -> None:
         if self.injector is not None and self.injector.on_evict(
@@ -160,6 +168,11 @@ class _FaultyClusterAPI(FakeClusterAPI):
         ):
             raise EvictionError(f"eviction of {pod.key()} injected-rejected")
         super().evict_pod(pod)
+
+    def list_nodes(self):
+        if self.injector is not None and self.in_run_once:
+            self.injector.on_kube_api("list_nodes")
+        return super().list_nodes()
 
 
 class ScenarioDriver:
@@ -221,6 +234,13 @@ class ScenarioDriver:
             clock=clock.time,
             sleep=clock.sleep,
         )
+        # arm the estimator ladder's fault hook: kernel_fault/device_lost
+        # fire at the rung-dispatch seam, tripping the REAL circuit
+        # breakers (whose cooldown runs on the driver's simulated clock —
+        # run_once ticks the ladder with now_ts, keeping replays exact)
+        ladder = self.autoscaler.kernel_ladder()
+        if ladder is not None:
+            ladder.fault_hook = self.injector.on_kernel_dispatch
         self._scheduler = HintingSimulator()
         # resolved timeline: explicit events + expanded workloads, stably
         # ordered; this IS the trace a replay executes verbatim
@@ -446,7 +466,24 @@ class ScenarioDriver:
                 1 for p in self.api.list_pods() if not p.node_name
             )
             t0 = time.perf_counter()
-            result = self.autoscaler.run_once(now_ts=now)
+            self.api.in_run_once = True
+            try:
+                result = self.autoscaler.run_once(now_ts=now)
+            except Exception as e:  # noqa: BLE001 — crash-only analog:
+                # main.run_loop catches per-iteration crashes; the driver
+                # does the same so kube_api_error scenarios certify that
+                # the loop survives (the tick records the typed error)
+                from autoscaler_tpu.utils.errors import to_autoscaler_error
+
+                err = to_autoscaler_error(e)
+                result = RunOnceResult(
+                    # a crashed tick established nothing about the cluster:
+                    # report unhealthy, not the dataclass default
+                    cluster_healthy=False,
+                    errors=[f"run_once crashed ({err.error_type.value}): {err}"],
+                )
+            finally:
+                self.api.in_run_once = False
             wall = time.perf_counter() - t0
             self._materialize_cloud(tick)
             bound = self._bind_pods(tick)
@@ -463,6 +500,7 @@ class ScenarioDriver:
                 bound_pods=bound,
                 cluster_healthy=result.cluster_healthy,
                 errors=sorted(result.errors),
+                degraded=sorted(self.autoscaler.degraded_rungs()),
                 backed_off=sorted(
                     g.id()
                     for g in self.provider.node_groups()

@@ -1,4 +1,4 @@
-"""Fault injection at the cloudprovider / kube-API boundary.
+"""Fault injection at the cloudprovider / kube-API / device-kernel boundary.
 
 Wraps a ``TestCloudProvider`` (and the driver's eviction path) so scripted
 failures exercise the SAME recovery machinery production hits: a rejected
@@ -7,6 +7,13 @@ IncreaseSize lands in ``ScaleUpOrchestrator``'s except-branch →
 with ``InstanceErrorInfo`` rides ``instances_with_errors`` →
 ``deleteCreatedNodesWithErrors``; a stuck-CREATING instance ages through
 ``unregistered`` → ``long_unregistered`` → provision-timeout backoff.
+
+Device/API faults extend the same discipline to the resilience layer:
+``on_kernel_dispatch`` is installed as the estimator ladder's
+``fault_hook`` (estimator/ladder.KernelLadder), so ``kernel_fault`` /
+``device_lost`` trip the per-rung circuit breakers exactly as a real
+Mosaic compile fault or device loss would; ``on_kube_api`` raises inside
+``run_once``'s cluster listing, exercising the crash-only control loop.
 
 The injector is tick-clocked and RNG-seeded by the driver: the SAME
 scenario + seed trips the SAME faults on the SAME calls, which is what
@@ -126,6 +133,33 @@ class FaultInjector:
             self._note("eviction_error")
             return True
         return False
+
+    def on_kernel_dispatch(self, rung: str) -> Optional[str]:
+        """Estimator-ladder fault hook: returns the fault kind when a
+        scripted device fault is armed for ``rung``, else None. Only the
+        device rungs (pallas/xla) can fault — the host rungs are the
+        degradation target and always survive."""
+        if rung not in ("pallas", "xla"):
+            return None
+        for kind in ("device_lost", "kernel_fault"):
+            for f in self._static + self._armed:
+                if f.kind != kind or not f.active(self.tick):
+                    continue
+                if kind == "kernel_fault" and f.rung and f.rung != rung:
+                    continue
+                if f.probability >= 1.0 or self._rng.random() < f.probability:
+                    self._note(kind)
+                    return kind
+        return None
+
+    def on_kube_api(self, op: str) -> None:
+        """Cluster-API seam (the listing inside run_once): raising here is
+        the apiserver 5xx / connection-reset analog, which the crash-only
+        loop must absorb."""
+        f = self._active("kube_api_error", "")
+        if f is not None:
+            self._note("kube_api_error")
+            raise InjectedCloudError(f"{f.message} ({op})")
 
     def _latency(self, group: str) -> None:
         f = self._active("provider_latency", group)

@@ -35,6 +35,19 @@ Faults (``FaultSpec.kind``) target the provider/kube boundary:
                         latency per call (recorded; optionally slept)
 - ``refresh_error``   — provider.refresh() raises → loop-level error path
 - ``eviction_error``  — evictions rejected (PDB analog) with ``probability``
+
+Device / API faults (this is what certifies the degradation ladder and the
+crash-only loop — see ARCHITECTURE.md "Resilience"):
+
+- ``kernel_fault``    — the estimator kernel rung named by ``rung``
+                        (``pallas``/``xla``; "" = both device rungs) fails
+                        at dispatch → circuit breaker trips → decisions
+                        flow on the native/python rungs
+- ``device_lost``     — both device rungs fail (jax device-loss analog);
+                        ``rung`` is ignored
+- ``kube_api_error``  — the cluster-API listing inside run_once raises →
+                        exercises the crash-only loop (the tick records an
+                        error; the process keeps looping)
 """
 from __future__ import annotations
 
@@ -60,7 +73,12 @@ FAULT_KINDS = (
     "provider_latency",
     "refresh_error",
     "eviction_error",
+    "kernel_fault",
+    "device_lost",
+    "kube_api_error",
 )
+# estimator rungs a kernel_fault may target ("" = every device rung)
+KERNEL_FAULT_RUNGS = ("", "pallas", "xla")
 WORKLOAD_KINDS = ("steady", "diurnal", "spike", "drain_heavy")
 
 
@@ -99,6 +117,8 @@ class FaultSpec:
     end_tick: Optional[int] = None
     latency_s: float = 0.0          # provider_latency
     error_class: str = "OTHER"      # instance_error: OUT_OF_RESOURCES|QUOTA_EXCEEDED|OTHER
+    # kernel_fault: which estimator rung fails ("" = both device rungs)
+    rung: str = ""
     message: str = "injected fault"
 
     def __post_init__(self):
@@ -106,6 +126,23 @@ class FaultSpec:
             raise SpecError(f"unknown fault kind {self.kind!r} (one of {FAULT_KINDS})")
         if not 0.0 <= self.probability <= 1.0:
             raise SpecError(f"fault probability {self.probability} outside [0, 1]")
+        if self.rung and self.kind != "kernel_fault":
+            raise SpecError(
+                f"fault field 'rung' only applies to kernel_fault, not {self.kind!r}"
+            )
+        if self.group and self.kind in (
+            "kernel_fault", "device_lost", "kube_api_error"
+        ):
+            # these faults hit process-wide seams (the kernel ladder, the
+            # cluster listing) — a group scope would be silently ignored
+            # (or, for kube_api_error, silently disable the fault)
+            raise SpecError(
+                f"fault kind {self.kind!r} is not group-scoped; drop 'group'"
+            )
+        if self.kind == "kernel_fault" and self.rung not in KERNEL_FAULT_RUNGS:
+            raise SpecError(
+                f"kernel_fault rung {self.rung!r} (one of {KERNEL_FAULT_RUNGS})"
+            )
 
     def active(self, tick: int) -> bool:
         if tick < self.start_tick:

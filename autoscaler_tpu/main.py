@@ -95,6 +95,29 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    type=float, default=600.0)
     p.add_argument("--health-check-max-failing-time", "--max-failing-time",
                    type=float, default=900.0)
+    p.add_argument("--max-consecutive-run-once-failures", type=int, default=0,
+                   help="crash-only loop: hard-exit (abnormally, for the "
+                        "supervisor to restart) after N consecutive "
+                        "run_once failures; 0 = never, rely on the "
+                        "health-check failing deadline")
+    p.add_argument("--run-once-soft-deadline", type=float, default=0.0,
+                   help="watchdog soft deadline per loop tick in seconds: "
+                        "exceeded -> all-thread stack dump to stderr; "
+                        "0 = auto (max of 4x scan interval and 60s)")
+    p.add_argument("--rpc-default-deadline", type=float, default=30.0,
+                   help="default deadline for sidecar RPCs without an "
+                        "explicit timeout, so a wedged sidecar fails the "
+                        "call instead of hanging the loop")
+    p.add_argument("--kernel-breaker-failure-threshold", type=int, default=3,
+                   help="consecutive failures tripping an estimator kernel "
+                        "rung's circuit breaker open")
+    p.add_argument("--kernel-breaker-cooldown", type=float, default=120.0,
+                   help="seconds a tripped kernel rung stays open before a "
+                        "half-open probe re-tests it")
+    p.add_argument("--kube-client-get-retries", type=int, default=2,
+                   help="transient-failure retries for idempotent control-"
+                        "plane GETs (429/5xx honoring Retry-After, "
+                        "transport errors); 0 disables")
     p.add_argument("--max-iterations", type=int, default=0,
                    help="stop after N loops (0 = forever); for testing")
     p.add_argument("--initial-node-group-backoff-duration", type=float, default=300.0)
@@ -234,6 +257,13 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         cloud_provider=args.provider,
         max_inactivity_s=args.health_check_max_inactivity,
         max_failing_time_s=args.health_check_max_failing_time,
+        max_consecutive_run_once_failures=(
+            args.max_consecutive_run_once_failures
+        ),
+        run_once_soft_deadline_s=args.run_once_soft_deadline,
+        rpc_default_deadline_s=args.rpc_default_deadline,
+        kernel_breaker_failure_threshold=args.kernel_breaker_failure_threshold,
+        kernel_breaker_cooldown_s=args.kernel_breaker_cooldown,
         initial_node_group_backoff_duration_s=args.initial_node_group_backoff_duration,
         max_node_group_backoff_duration_s=args.max_node_group_backoff_duration,
         node_group_backoff_reset_timeout_s=args.node_group_backoff_reset_timeout,
@@ -326,6 +356,15 @@ class ObservabilityServer:
                     self._send(200, autoscaler.metrics.registry.expose())
                 elif self.path == "/health-check":
                     ok, msg = autoscaler.health_check.healthy()
+                    # degraded (kernel rungs tripped, decisions flowing on a
+                    # lower rung) is visible but NOT unhealthy: restarting
+                    # the process would not heal a faulting device, and the
+                    # whole point of the ladder is staying alive through it
+                    degraded = getattr(
+                        autoscaler, "degraded_rungs", lambda: []
+                    )()
+                    if ok and degraded:
+                        msg = f"{msg} (degraded: {','.join(degraded)})"
                     self._send(200 if ok else 500, msg)
                 elif self.path == "/snapshotz":
                     if autoscaler.debugger is None:
@@ -346,6 +385,7 @@ class ObservabilityServer:
                         build_status(
                             autoscaler.csr, time.time(),
                             autoscaler.options.cluster_name,
+                            degraded_rungs=autoscaler.degraded_rungs(),
                         ).render(),
                     )
                 elif self.path.startswith("/debug/pprof"):
@@ -414,15 +454,69 @@ def run_loop(
     scan_interval_s: float,
     max_iterations: int = 0,
     still_leader=None,
+    max_consecutive_failures: int = 0,
+    watchdog=None,
 ) -> bool:
-    """The steady loop (main.go:471-489). still_leader: optional callback
-    consulted between iterations under leader election — returning False
-    stops the loop so the process can exit and be restarted as a follower
-    (main.go:568 OnStoppedLeading)."""
+    """The steady, CRASH-ONLY loop (main.go:471-489).
+
+    One uncaught exception must not kill the process: each iteration's
+    failure is caught, typed via utils/errors.to_autoscaler_error (the
+    original traceback rides ``__cause__``), counted, and the loop keeps
+    going — the HealthCheck failing deadline (no successful run_once for
+    max-failing-time) remains the restart authority, and
+    ``max_consecutive_failures`` (--max-consecutive-run-once-failures)
+    adds an optional fast hard exit, returning False so main() exits
+    abnormally for the supervisor. ``watchdog`` (utils/pprof.LoopWatchdog)
+    is armed around each tick: a tick that overruns its soft deadline gets
+    an all-thread stack dump before the liveness probe acts.
+
+    still_leader: optional callback consulted between iterations under
+    leader election — returning False stops the loop so the process can
+    exit and be restarted as a follower (main.go:568 OnStoppedLeading)."""
+    from autoscaler_tpu.utils.errors import to_autoscaler_error
+
+    log = logging.getLogger("run_loop")
     iterations = 0
+    consecutive_failures = 0
     while True:
         loop_start = time.monotonic()
-        autoscaler.run_once(now_ts=time.time())
+        if watchdog is not None:
+            watchdog.arm()
+        try:
+            autoscaler.run_once(now_ts=time.time())
+            consecutive_failures = 0
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:  # noqa: BLE001 — crash-only: log, count, go on
+            err = to_autoscaler_error(e)
+            consecutive_failures += 1
+            log.error(
+                "run_once crashed (%s, consecutive failure %d): %s",
+                err.error_type.value, consecutive_failures, err,
+                exc_info=err,
+            )
+            # activity (not success): the inactivity deadline stays quiet,
+            # the failing deadline keeps ticking toward a probe restart
+            health = getattr(autoscaler, "health_check", None)
+            if health is not None:
+                health.update_last_activity()
+            metrics = getattr(autoscaler, "metrics", None)
+            if metrics is not None:
+                metrics.errors_total.inc(type=err.error_type.value)
+            if (
+                max_consecutive_failures
+                and consecutive_failures >= max_consecutive_failures
+            ):
+                print(
+                    f"run_once failed {consecutive_failures} times in a row "
+                    "(--max-consecutive-run-once-failures); exiting for "
+                    "supervisor restart",
+                    file=sys.stderr,
+                )
+                return False
+        finally:
+            if watchdog is not None:
+                watchdog.disarm()
         iterations += 1
         if max_iterations and iterations >= max_iterations:
             return True
@@ -587,6 +681,7 @@ def main(argv=None) -> int:
                 capi_rest = KubeRestClient.from_kubeconfig(
                     args.kubeconfig, user_agent=opts.user_agent,
                     qps=args.kube_client_qps, burst=args.kube_client_burst,
+                    get_retries=args.kube_client_get_retries,
                 )
             except (OSError, ValueError) as e:
                 print(f"--kubeconfig {args.kubeconfig}: {e}", file=sys.stderr)
@@ -595,11 +690,13 @@ def main(argv=None) -> int:
             capi_rest = KubeRestClient.in_cluster(
                 user_agent=opts.user_agent,
                 qps=args.kube_client_qps, burst=args.kube_client_burst,
+                get_retries=args.kube_client_get_retries,
             )
         else:
             capi_rest = KubeRestClient(
                 args.kube_api, user_agent=opts.user_agent,
                 qps=args.kube_client_qps, burst=args.kube_client_burst,
+                get_retries=args.kube_client_get_retries,
             )
         try:
             provider = build_clusterapi_provider(
@@ -638,6 +735,7 @@ def main(argv=None) -> int:
                 client = KubeRestClient.from_kubeconfig(
                     args.kubeconfig, user_agent=opts.user_agent,
                     qps=args.kube_client_qps, burst=args.kube_client_burst,
+                    get_retries=args.kube_client_get_retries,
                 )
             except (OSError, ValueError) as e:
                 print(f"--kubeconfig {args.kubeconfig}: {e}", file=sys.stderr)
@@ -646,11 +744,13 @@ def main(argv=None) -> int:
             client = KubeRestClient.in_cluster(
                 user_agent=opts.user_agent,
                 qps=args.kube_client_qps, burst=args.kube_client_burst,
+                get_retries=args.kube_client_get_retries,
             )
         else:
             client = KubeRestClient(
                 args.kube_api, user_agent=opts.user_agent,
                 qps=args.kube_client_qps, burst=args.kube_client_burst,
+                get_retries=args.kube_client_get_retries,
             )
         api = KubeClusterAPI(
             client, watch=True,
@@ -674,6 +774,12 @@ def main(argv=None) -> int:
     server = ObservabilityServer(autoscaler, args.address, profiling=args.profiling)
     port = server.start()
     print(f"tpu-autoscaler: observability on :{port}, scan interval {opts.scan_interval_s}s")
+    from autoscaler_tpu.utils.pprof import LoopWatchdog
+
+    soft_deadline = opts.run_once_soft_deadline_s or max(
+        4 * opts.scan_interval_s, 60.0
+    )
+    watchdog = LoopWatchdog(soft_deadline)
     try:
         if args.leader_elect:
             from autoscaler_tpu.kube.client import KubeLease
@@ -690,6 +796,10 @@ def main(argv=None) -> int:
                 outcome["clean"] = run_loop(
                     autoscaler, opts.scan_interval_s, args.max_iterations,
                     still_leader=still_leader,
+                    max_consecutive_failures=(
+                        opts.max_consecutive_run_once_failures
+                    ),
+                    watchdog=watchdog,
                 )
 
             elector.run(lead)
@@ -698,10 +808,18 @@ def main(argv=None) -> int:
                 # (main.go:568 OnStoppedLeading is a Fatalf)
                 return 1
         else:
-            run_loop(autoscaler, opts.scan_interval_s, args.max_iterations)
+            clean = run_loop(
+                autoscaler, opts.scan_interval_s, args.max_iterations,
+                max_consecutive_failures=opts.max_consecutive_run_once_failures,
+                watchdog=watchdog,
+            )
+            if not clean:
+                # abnormal exit so supervisors restart the replica
+                return 1
     except KeyboardInterrupt:
         pass
     finally:
+        watchdog.stop()
         server.stop()
         close = getattr(api, "close", None)
         if close is not None:  # stop KubeClusterAPI watch threads

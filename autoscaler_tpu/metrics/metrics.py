@@ -196,12 +196,30 @@ class AutoscalerMetrics:
         # fast path was NOT taken, why (r4 verdict weak #6: a workload past
         # the VMEM byte-model gate silently rode the ~50x-slower XLA scan;
         # the cliff must be observable). labels: route=pallas_affinity|
-        # pallas|xla_scan|xla_runs|xla_single, reason=ok|vmem|spread_width|
-        # not_tpu|kernel_fault|dedup|single_template (the last from the
-        # single-template estimate() entry point)
+        # pallas|xla_scan|xla_runs|xla_single|native|python_ref,
+        # reason=ok|vmem|spread_width|not_tpu|kernel_fault|device_lost|
+        # breaker_open|dedup|single_template (the last from the
+        # single-template estimate() entry point). native/python_ref routes
+        # mean the degradation ladder descended past the device rungs.
         self.estimator_kernel_route_total = r.counter(
             p + "estimator_kernel_route_total",
             "estimator dispatches by kernel route and fallback reason",
+        )
+        # -- degradation-ladder observability (utils/circuit + estimator/
+        # ladder): which rung each dispatch engaged and how it resolved
+        # (outcome=ok|fault|unavailable|skipped), the breaker state per rung
+        # (0 closed, 1 half-open, 2 open), and every breaker transition.
+        self.estimator_kernel_rung_attempts_total = r.counter(
+            p + "estimator_kernel_rung_attempts_total",
+            "kernel-ladder rung engagements by outcome",
+        )
+        self.estimator_kernel_breaker_state = r.gauge(
+            p + "estimator_kernel_breaker_state",
+            "kernel-rung circuit breaker state (0 closed, 1 half-open, 2 open)",
+        )
+        self.estimator_breaker_transitions_total = r.counter(
+            p + "estimator_breaker_transitions_total",
+            "kernel-rung circuit breaker state transitions",
         )
         # -- remaining reference catalog (metrics.go:112-358) -----------------
         self.max_nodes_count = r.gauge(p + "max_nodes_count", "configured node cap")

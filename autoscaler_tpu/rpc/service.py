@@ -218,13 +218,31 @@ def serve(address: str = "127.0.0.1:0", max_workers: int = 4):
 
 
 class TpuSimulationClient:
-    """Host-side stub."""
+    """Host-side stub.
 
-    def __init__(self, target: str):
+    ``default_timeout_s`` is the deadline applied when a call site passes
+    none (plumbed from ``AutoscalingOptions.rpc_default_deadline_s``): a
+    wedged sidecar must fail the RPC — feeding the crash-only control
+    loop — rather than hang ``run_once`` forever. On UNAVAILABLE (sidecar
+    restarting, connection torn down) the client rebuilds its channel and
+    retries ONCE: every RPC here is a pure function of its request, so a
+    single bounded re-send is safe, and exactly one keeps a dead sidecar
+    from doubling every loop's latency."""
+
+    def __init__(self, target: str, default_timeout_s: Optional[float] = None):
+        self._target = target
+        self.default_timeout_s = default_timeout_s
         self._channel = grpc.insecure_channel(target)
 
     def close(self) -> None:
         self._channel.close()
+
+    def _reconnect(self) -> None:
+        try:
+            self._channel.close()
+        except Exception:  # noqa: BLE001 — a dead channel may refuse close
+            pass
+        self._channel = grpc.insecure_channel(self._target)
 
     @staticmethod
     def _packed_pods(
@@ -248,12 +266,25 @@ class TpuSimulationClient:
 
     def _call(self, method: str, request, timeout: Optional[float] = None):
         req_cls, resp_cls = _METHODS[method]
-        rpc = self._channel.unary_unary(
-            f"/{SERVICE_NAME}/{method}",
-            request_serializer=lambda msg: msg.SerializeToString(),
-            response_deserializer=resp_cls.FromString,
-        )
-        return rpc(request, timeout=timeout)
+        if timeout is None:
+            timeout = self.default_timeout_s
+
+        def send():
+            rpc = self._channel.unary_unary(
+                f"/{SERVICE_NAME}/{method}",
+                request_serializer=lambda msg: msg.SerializeToString(),
+                response_deserializer=resp_cls.FromString,
+            )
+            return rpc(request, timeout=timeout)
+
+        try:
+            return send()
+        except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            if code != grpc.StatusCode.UNAVAILABLE:
+                raise
+            self._reconnect()
+            return send()
 
     def estimate(
         self,

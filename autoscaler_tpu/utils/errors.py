@@ -29,10 +29,19 @@ class AutoscalerError(Exception):
         return self.error_type in (ErrorType.TRANSIENT, ErrorType.API_CALL)
 
     def prefixed(self, prefix: str) -> "AutoscalerError":
-        return AutoscalerError(self.error_type, f"{prefix}{self}")
+        # chain the original so logging the wrapper (exc_info) still shows
+        # the real traceback — the crash-only loop relies on this
+        new = AutoscalerError(self.error_type, f"{prefix}{self}")
+        new.__cause__ = self
+        return new
 
 
 def to_autoscaler_error(err: Exception) -> AutoscalerError:
+    """Wrap any exception as a typed AutoscalerError, preserving the
+    original as ``__cause__`` so the crash-only control loop's logs keep
+    the real traceback instead of a stringified tail."""
     if isinstance(err, AutoscalerError):
         return err
-    return AutoscalerError(ErrorType.INTERNAL, str(err))
+    wrapped = AutoscalerError(ErrorType.INTERNAL, str(err) or type(err).__name__)
+    wrapped.__cause__ = err
+    return wrapped

@@ -9,10 +9,52 @@ timeout, non-JSON 2xx body) pass status 0.
 from __future__ import annotations
 
 import json
+import random
 import ssl
+import time
 import urllib.error
 import urllib.request
+from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional
+
+
+@dataclass
+class RetryPolicy:
+    """Bounded, jittered exponential retry for IDEMPOTENT requests.
+
+    Only transient outcomes retry: transport-level failures (DNS, refused,
+    timeout) and HTTP 429/5xx. A 429/503 ``Retry-After`` header (seconds
+    form) is honored, capped at ``max_sleep_s``. Jitter (0.5-1.0x) keeps a
+    fleet of restarted control loops from synchronizing their retries
+    against a recovering API server. Non-idempotent writes must NOT pass a
+    policy — the caller cannot know whether the server applied the mutation.
+    """
+
+    attempts: int = 3                 # total tries, including the first
+    base_sleep_s: float = 0.25
+    max_sleep_s: float = 5.0
+    sleep: Callable[[float], None] = field(default=time.sleep, repr=False)
+    rng: Callable[[], float] = field(default=random.random, repr=False)
+
+    def backoff_s(self, attempt: int, retry_after_s: Optional[float]) -> float:
+        """Delay before retry number ``attempt`` (1-based)."""
+        if retry_after_s is not None:
+            return min(max(retry_after_s, 0.0), self.max_sleep_s)
+        exp = min(self.base_sleep_s * (2 ** (attempt - 1)), self.max_sleep_s)
+        return exp * (0.5 + 0.5 * self.rng())
+
+
+def _retry_after_seconds(headers) -> Optional[float]:
+    try:
+        value = headers.get("Retry-After") if headers is not None else None
+    except AttributeError:
+        return None
+    if not value:
+        return None
+    try:
+        return float(value)
+    except ValueError:
+        return None  # HTTP-date form: fall back to exponential pacing
 
 
 def json_request(
@@ -26,9 +68,12 @@ def json_request(
         f"HTTP {s}: {d}"
     ),
     stream: bool = False,
+    retry: Optional[RetryPolicy] = None,
 ):
     """One JSON request. Returns the decoded dict ({} on empty body), or the
-    raw response object when stream=True (caller closes it)."""
+    raw response object when stream=True (caller closes it). ``retry``
+    (idempotent callers only) retries transient failures — 429/5xx honoring
+    Retry-After, plus transport errors — with jittered bounded backoff."""
     data = json.dumps(body).encode() if body is not None else None
     req = urllib.request.Request(url, data=data, method=method)
     req.add_header("Accept", "application/json")
@@ -38,14 +83,44 @@ def json_request(
         req.add_header("Content-Type", "application/json")
     for k, v in (headers or {}).items():
         req.add_header(k, v)
-    try:
-        resp = urllib.request.urlopen(req, timeout=timeout_s, context=context)
-    except urllib.error.HTTPError as e:
-        raise on_error(e.code, e.read().decode(errors="replace")[:512]) from None
-    except urllib.error.URLError as e:
-        raise on_error(0, str(e.reason)) from None
-    except OSError as e:  # bare socket timeouts etc.
-        raise on_error(0, str(e)) from None
+    attempts = retry.attempts if retry is not None else 1
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=timeout_s, context=context
+            )
+            break
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:512]
+            transient = e.code == 429 or e.code >= 500
+            if retry is not None and transient and attempt < attempts:
+                retry.sleep(
+                    retry.backoff_s(attempt, _retry_after_seconds(e.headers))
+                )
+                continue
+            raise on_error(e.code, detail) from None
+        except urllib.error.URLError as e:
+            # full socket timeouts are NOT retried: each one already
+            # consumed timeout_s, so re-sending would stall a control-loop
+            # tick for attempts x timeout_s — past the watchdog's soft
+            # deadline — for a server that is wedged, not flaking. Only
+            # fast transport errors (refused, DNS, reset) retry.
+            timed_out = isinstance(e.reason, TimeoutError)
+            if retry is not None and attempt < attempts and not timed_out:
+                retry.sleep(retry.backoff_s(attempt, None))
+                continue
+            raise on_error(0, str(e.reason)) from None
+        except OSError as e:  # bare socket errors
+            if (
+                retry is not None
+                and attempt < attempts
+                and not isinstance(e, TimeoutError)
+            ):
+                retry.sleep(retry.backoff_s(attempt, None))
+                continue
+            raise on_error(0, str(e)) from None
     if stream:
         return resp
     payload = resp.read()

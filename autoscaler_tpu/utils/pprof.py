@@ -117,6 +117,74 @@ def thread_dump() -> str:
     return "\n".join(out) + "\n"
 
 
+class LoopWatchdog:
+    """Soft-deadline watchdog for the control loop's ticks.
+
+    ``arm()`` before each ``run_once``, ``disarm()`` after. If a tick is
+    still running when the soft deadline lapses, the watchdog thread dumps
+    every live thread's stack (``thread_dump``) exactly once for that tick
+    — so a wedged iteration (device hang, stuck HTTP read, deadlock)
+    leaves evidence of WHERE it was stuck before the liveness probe's
+    max-inactivity deadline has the process killed and restarted.
+
+    The watchdog never unwedges anything itself (crash-only discipline:
+    recovery is the supervisor's restart); it only observes.
+    """
+
+    def __init__(self, soft_deadline_s: float, emit=None):
+        import sys as _sys
+
+        self.soft_deadline_s = soft_deadline_s
+        self._emit = emit or (lambda text: print(text, file=_sys.stderr))
+        self._cond = threading.Condition()
+        self._deadline: float = 0.0   # 0 = disarmed
+        self._fired = False
+        self.fired_count = 0          # observability for tests/metrics
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._run, name="loop-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def arm(self) -> None:
+        with self._cond:
+            self._deadline = time.monotonic() + self.soft_deadline_s
+            self._fired = False
+            self._cond.notify()
+
+    def disarm(self) -> None:
+        with self._cond:
+            self._deadline = 0.0
+            self._cond.notify()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                if self._stopped:
+                    return
+                if self._deadline == 0.0 or self._fired:
+                    self._cond.wait()
+                    continue
+                wait = self._deadline - time.monotonic()
+                if wait > 0:
+                    self._cond.wait(timeout=wait)
+                    continue
+                self._fired = True
+                self.fired_count += 1
+                deadline_s = self.soft_deadline_s
+            # dump OUTSIDE the lock: thread_dump walks every frame and must
+            # not block arm/disarm from the control loop
+            self._emit(
+                f"watchdog: run_once exceeded its {deadline_s:.0f}s soft "
+                f"deadline; all-thread stack dump:\n{thread_dump()}"
+            )
+
+
 PPROF_INDEX = """\
 /debug/pprof/ — profiling index (Go net/http/pprof analog)
   /debug/pprof/profile?seconds=N   collapsed-stack wall profile (default 5s)

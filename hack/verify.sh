@@ -25,6 +25,11 @@ assert available(), f'native build failed: {build_error()}'
 print('native ok')
 "
 
+echo "== loadgen scenario validation (specs must parse + round-trip) =="
+for scenario in benchmarks/scenarios/*.json; do
+    python -m autoscaler_tpu.loadgen validate "$scenario"
+done
+
 echo "== unit tests (8-device virtual CPU mesh) =="
 python -m pytest tests/ -q -x
 

@@ -77,7 +77,8 @@ class TestSpecRoundTrip:
 
     def test_canned_scenarios_parse(self):
         for name in ("burst_small", "diurnal_medium", "fault_backoff",
-                     "drain_heavy"):
+                     "drain_heavy", "kernel_fault_ladder",
+                     "device_lost_ladder"):
             spec = ScenarioSpec.load(f"benchmarks/scenarios/{name}.json")
             assert ScenarioSpec.from_json(spec.to_json()) == spec
 
