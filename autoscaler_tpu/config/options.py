@@ -70,6 +70,22 @@ class AutoscalingOptions:
     kernel_breaker_failure_threshold: int = 3
     kernel_breaker_cooldown_s: float = 120.0
 
+    # -- tick tracing (autoscaler_tpu/trace) ---------------------------------
+    # gates /tracez, like debugging_snapshot_enabled gates /snapshotz; the
+    # tracer itself always runs (bounded memory, negligible overhead) so
+    # the flight recorder has history the moment the endpoint is enabled
+    tracing_enabled: bool = True
+    # flight recorder: how many recent tick traces the in-memory ring keeps
+    trace_ring_size: int = 64
+    # always-on slow-tick dump: a tick whose WALL time exceeds this gets its
+    # full span tree logged and the trace pinned in the ring (survives ring
+    # eviction). 0 disables.
+    trace_slow_tick_threshold_s: float = 2.0
+    # when set, each tick captures a jax profiler session into
+    # <dir>/tick_<id> — device timeline keyed by the same tick id as the
+    # host trace (--jax-profiler-dir; debug tool, off by default)
+    jax_profiler_dir: str = ""
+
     # -- cluster-wide resource limits (main.go:113-118) ----------------------
     max_nodes_total: int = 0                      # 0 = unlimited
     min_cores_total: float = 0.0

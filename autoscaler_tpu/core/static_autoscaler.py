@@ -31,6 +31,7 @@ from autoscaler_tpu.metrics import metrics as metrics_mod
 from autoscaler_tpu.metrics.healthcheck import HealthCheck
 from autoscaler_tpu.simulator.removal import UnremovableReason
 from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+from autoscaler_tpu import trace
 from autoscaler_tpu.utils import klogx
 
 
@@ -62,6 +63,7 @@ class StaticAutoscaler:
         health_check: Optional[HealthCheck] = None,
         debugger=None,
         processors=None,
+        tracer: Optional[trace.Tracer] = None,
     ):
         from autoscaler_tpu.processors.pipeline import default_processors
 
@@ -106,6 +108,15 @@ class StaticAutoscaler:
             self.options.max_inactivity_s, self.options.max_failing_time_s
         )
         self.debugger = debugger
+        # one trace (span tree) per run_once, kept in a bounded flight
+        # recorder served by /tracez; the loadgen driver passes its own
+        # tracer (injected deterministic clock) so replays export
+        # byte-identical traces
+        self.tracer = tracer or trace.Tracer(
+            metrics=self.metrics,
+            recorder=trace.FlightRecorder(capacity=self.options.trace_ring_size),
+            slow_tick_threshold_s=self.options.trace_slow_tick_threshold_s,
+        )
         self.last_scale_up_ts: Optional[float] = None
         self.last_scale_down_delete_ts: Optional[float] = None
         self.last_scale_down_fail_ts: Optional[float] = None
@@ -119,21 +130,53 @@ class StaticAutoscaler:
 
     # -- one reconcile iteration (reference :288) ----------------------------
     def run_once(self, now_ts: float) -> RunOnceResult:
-        """Instrumented wrapper: per-phase durations, counters, liveness, and
-        the on-demand debugging capture (reference metrics.go:399 +
-        static_autoscaler.go:334,380,540,626,661)."""
-        import time as _time
-
-        m = self.metrics
-        start = _time.monotonic()
+        """Instrumented wrapper: the tick's span tree (whose span durations
+        feed the per-phase duration metrics through one choke point),
+        counters, liveness, and the on-demand debugging capture (reference
+        metrics.go:399 + static_autoscaler.go:334,380,540,626,661)."""
         # advance the kernel ladder's breaker clock on loop time (simulated
         # time under loadgen — what makes breaker cooldowns replayable)
         ladder = self.kernel_ladder()
         if ladder is not None:
             ladder.tick(now_ts)
+        with self.tracer.tick(metrics_mod.MAIN, now_ts=now_ts) as root:
+            result = self._run_once_traced(now_ts, root)
+            root.set_attrs(
+                pending=result.pending_pods,
+                healthy=result.cluster_healthy,
+                errors=len(result.errors),
+            )
+            return result
+
+    def _run_once_traced(self, now_ts: float, root) -> RunOnceResult:
+        m = self.metrics
+        # optional device-timeline capture keyed by the host trace's tick id
+        # (--jax-profiler-dir): the profiler session directory and the
+        # flight-recorder trace share the id, so "why was tick 8124 slow"
+        # has both the host span tree and the device profile
+        profiling = False
+        tick_id = int(root.attrs.get("trace_id", 0))
+        if self.options.jax_profiler_dir:
+            from autoscaler_tpu.trace.device import start_profiler_session
+
+            profiling = start_profiler_session(
+                self.options.jax_profiler_dir, tick_id
+            )
         try:
-            result = self._run_once_inner(now_ts)
+            if profiling:
+                # mark the tick as one profiler "step": profiler UIs group
+                # the captured device activity per tick
+                from autoscaler_tpu.trace.device import step_annotation
+
+                with step_annotation("run_once", tick_id):
+                    result = self._run_once_inner(now_ts)
+            else:
+                result = self._run_once_inner(now_ts)
         finally:
+            if profiling:
+                from autoscaler_tpu.trace.device import stop_profiler_session
+
+                stop_profiler_session()
             # status ConfigMap write mirrors the reference's defer
             # (static_autoscaler.go:387-393 + clusterstate.go:701): it must
             # run on EVERY exit path — unhealthy-cluster and error returns
@@ -153,9 +196,12 @@ class StaticAutoscaler:
                             ).render()
                         },
                     )
+                    trace.add_event("status.configmap_write")
                 except Exception:
                     pass  # best-effort observability, never loop-fatal
-        m.observe_duration(metrics_mod.MAIN, start)
+        # last_activity per activity label (metrics.go UpdateLastTime): the
+        # main label every loop; scaleUp/scaleDown in their branches below
+        m.last_activity.set(now_ts, activity=metrics_mod.MAIN)
         m.unschedulable_pods_count.set(result.pending_pods)
         m.unneeded_nodes_count.set(result.unneeded_nodes)
         m.node_groups_count.set(len(self.provider.node_groups()))
@@ -239,14 +285,17 @@ class StaticAutoscaler:
             self._initialized = True
 
         # 1. observe the world (:304) and refresh cloud caches (:333)
-        try:
-            self.provider.refresh()
-        except Exception as e:
-            result.errors.append(f"provider refresh failed: {e}")
-            return result
-        all_nodes = self.api.list_nodes()
-        all_pods = self.api.list_pods()
-        pdbs = self.api.list_pdbs()
+        with trace.span(metrics_mod.POLL) as sp:
+            try:
+                self.provider.refresh()
+            except Exception as e:
+                sp.set_attrs(error="refresh_failed")
+                result.errors.append(f"provider refresh failed: {e}")
+                return result
+            all_nodes = self.api.list_nodes()
+            all_pods = self.api.list_pods()
+            pdbs = self.api.list_pdbs()
+            sp.set_attrs(nodes=len(all_nodes), pods=len(all_pods))
 
         # actionable-cluster gate (reference processors/actionablecluster)
         if not self.processors.actionable_cluster.should_autoscale(all_nodes, now_ts):
@@ -271,10 +320,11 @@ class StaticAutoscaler:
 
         # 2. cluster state accounting (:376); nodes mid-deletion count in the
         # `deleted` readiness bucket, not as ready capacity
-        self.csr.register_deleted_nodes(
-            self.scale_down_planner.deletion_tracker.in_flight_names()
-        )
-        self.csr.update_nodes(all_nodes, now_ts)
+        with trace.span(metrics_mod.UPDATE_STATE):
+            self.csr.register_deleted_nodes(
+                self.scale_down_planner.deletion_tracker.in_flight_names()
+            )
+            self.csr.update_nodes(all_nodes, now_ts)
         result.cluster_healthy = self.csr.is_cluster_healthy()
         if not result.cluster_healthy:
             result.errors.append("cluster unhealthy: too many unready nodes")
@@ -285,63 +335,64 @@ class StaticAutoscaler:
         self._delete_created_nodes_with_errors()
 
         # 4. build the snapshot (:250-354)
-        import time as _time
+        with trace.span(metrics_mod.SNAPSHOT_BUILD) as sp_snap:
+            snapshot = ClusterSnapshot(packer=self._packer)
+            scheduled, pending = self._split_pods(all_pods)
+            for node in all_nodes:
+                snapshot.add_node(node)
+            for pod in scheduled:
+                if snapshot.get_node(pod.node_name) is not None:
+                    snapshot.add_pod(pod, pod.node_name)
+            for pod in pending:
+                snapshot.add_pod(pod)
 
-        t_snap = _time.monotonic()
-        snapshot = ClusterSnapshot(packer=self._packer)
-        scheduled, pending = self._split_pods(all_pods)
-        for node in all_nodes:
-            snapshot.add_node(node)
-        for pod in scheduled:
-            if snapshot.get_node(pod.node_name) is not None:
-                snapshot.add_pod(pod, pod.node_name)
-        for pod in pending:
-            snapshot.add_pod(pod)
+            # legacy TPU-request sanitizer (:459-466, utils/tpu/tpu.go:57)
+            from autoscaler_tpu.utils.tpu import clear_tpu_requests
 
-        # legacy TPU-request sanitizer (:459-466, utils/tpu/tpu.go:57)
-        from autoscaler_tpu.utils.tpu import clear_tpu_requests
+            pending = clear_tpu_requests(pending)
 
-        pending = clear_tpu_requests(pending)
-
-        # expendable filter (:471) + young-pod filter (:832)
-        pending = [
-            p
-            for p in pending
-            if p.priority >= self.options.expendable_pods_priority_cutoff
-        ]
-        if self.options.new_pod_scale_up_delay_s > 0:
+            # expendable filter (:471) + young-pod filter (:832)
             pending = [
                 p
                 for p in pending
-                if now_ts - p.creation_ts >= self.options.new_pod_scale_up_delay_s
+                if p.priority >= self.options.expendable_pods_priority_cutoff
             ]
+            if self.options.new_pod_scale_up_delay_s > 0:
+                pending = [
+                    p
+                    for p in pending
+                    if now_ts - p.creation_ts >= self.options.new_pod_scale_up_delay_s
+                ]
 
-        # pending-DaemonSet charge shared by upcoming-node injection and the
-        # scale-up templates (--force-ds): lazily fetched at most once per
-        # loop — idle iterations (nothing pending, nothing upcoming) issue
-        # no LIST at all
-        ds_memo: List = []
+            # pending-DaemonSet charge shared by upcoming-node injection and
+            # the scale-up templates (--force-ds): lazily fetched at most
+            # once per loop — idle iterations (nothing pending, nothing
+            # upcoming) issue no LIST at all
+            ds_memo: List = []
 
-        def pending_ds():
-            if not self.options.force_daemonsets:
-                return ()
-            if not ds_memo:
-                ds_memo.append(self.api.list_daemonsets())
-            return ds_memo[0]
+            def pending_ds():
+                if not self.options.force_daemonsets:
+                    return ()
+                if not ds_memo:
+                    ds_memo.append(self.api.list_daemonsets())
+                return ds_memo[0]
 
-        # upcoming (requested-not-yet-registered) nodes join the simulation as
-        # virtual template nodes (:484-519)
-        upcoming_names = self._inject_upcoming_nodes(
-            snapshot, now_ts, pending_ds
-        )
-
-        self.metrics.observe_duration(metrics_mod.SNAPSHOT_BUILD, t_snap)
+            # upcoming (requested-not-yet-registered) nodes join the
+            # simulation as virtual template nodes (:484-519)
+            upcoming_names = self._inject_upcoming_nodes(
+                snapshot, now_ts, pending_ds
+            )
+            sp_snap.set_attrs(
+                scheduled=len(scheduled), pending=len(pending),
+                upcoming=len(upcoming_names),
+            )
 
         # 5. filter-out-schedulable (:528) — device-packed onto a fork
-        t_filter = _time.monotonic()
-        snapshot.fork()
-        pending, filtered = self.pod_list_processor.process(snapshot, pending)
-        snapshot.revert()
+        with trace.span(metrics_mod.FILTER_OUT_SCHEDULABLE) as sp_filter:
+            snapshot.fork()
+            pending, filtered = self.pod_list_processor.process(snapshot, pending)
+            snapshot.revert()
+            sp_filter.set_attrs(absorbed=len(filtered), still_pending=len(pending))
         # quota-bounded per-pod verbosity (static_autoscaler.go:528 area +
         # utils/klogx defaults: 20 lines, 1000 at -v>=5)
         pod_quota = klogx.pods_logging_quota()
@@ -350,24 +401,28 @@ class StaticAutoscaler:
         klogx.v(4).over(pod_quota).info(
             "%d other unschedulable pods not logged", -pod_quota.left
         )
-        self.metrics.observe_duration(metrics_mod.FILTER_OUT_SCHEDULABLE, t_filter)
         result.filtered_schedulable = len(filtered)
         result.pending_pods = len(pending)
 
         # 6. scale-up (:560-580)
         if pending:
-            t_up = _time.monotonic()
-            up = self.scale_up_orchestrator.scale_up(
-                pending, all_nodes, now_ts,
-                # new nodes boot the group's daemonsets: their observed
-                # overhead on the template's source node is charged against
-                # template capacity (simulator/nodes.go:38)
-                pods_of_node=snapshot.pods_on_node,
-                # --force-ds additionally charges suitable-but-not-yet-
-                # running DaemonSets (simulator/nodes.go:56)
-                pending_daemonsets=pending_ds(),
-            )
-            self.metrics.observe_duration(metrics_mod.SCALE_UP, t_up)
+            with trace.span(metrics_mod.SCALE_UP) as sp_up:
+                up = self.scale_up_orchestrator.scale_up(
+                    pending, all_nodes, now_ts,
+                    # new nodes boot the group's daemonsets: their observed
+                    # overhead on the template's source node is charged
+                    # against template capacity (simulator/nodes.go:38)
+                    pods_of_node=snapshot.pods_on_node,
+                    # --force-ds additionally charges suitable-but-not-yet-
+                    # running DaemonSets (simulator/nodes.go:56)
+                    pending_daemonsets=pending_ds(),
+                )
+                sp_up.set_attrs(
+                    scaled_up=up.scaled_up,
+                    group=up.chosen_group or "",
+                    new_nodes=up.new_nodes,
+                )
+            self.metrics.last_activity.set(now_ts, activity=metrics_mod.SCALE_UP)
             result.scale_up = up
             self.processors.scale_up_status.process(up)
             if up.scaled_up:
@@ -383,61 +438,78 @@ class StaticAutoscaler:
                 self.provider, self.metrics
             )
         if self.options.scale_down_enabled:
-            t_unneeded = _time.monotonic()
-            candidates = self.processors.scale_down_candidates_sorting.sort(
-                self.processors.scale_down_node.get_scale_down_candidates(
-                    self._scale_down_candidates(all_nodes, upcoming_names), all_nodes
-                )
-            )
-            self.scale_down_planner.update_cluster_state(
-                snapshot, candidates, pdbs, now_ts
-            )
-            self.metrics.observe_duration(metrics_mod.FIND_UNNEEDED, t_unneeded)
-            result.unneeded_nodes = len(self.scale_down_planner.unneeded_names())
-            self.processors.notify_scale_down_candidates(
-                self.scale_down_planner.unneeded_names()
-            )
-            in_cooldown = self._scale_down_in_cooldown(now_ts)
-            result.scale_down_in_cooldown = in_cooldown
-            if not in_cooldown:
-                plan = self.scale_down_planner.nodes_to_delete(snapshot, now_ts)
-                if plan.empty or plan.drain:
-                    down = self.scale_down_actuator.start_deletion(plan, now_ts)
-                    result.scale_down = down
-                    if down.deleted_empty or down.deleted_drain:
-                        self.last_scale_down_delete_ts = now_ts
-                        # per-node registration widens the group's acceptable
-                        # range while the cloud deletion is in flight
-                        # (clusterstate.go RegisterScaleDown)
-                        deleted = set(down.deleted_empty + down.deleted_drain)
-                        registered_any = False
-                        for r in plan.empty + plan.drain:
-                            if r.node.name in deleted:
-                                g = self.provider.node_group_for_node(r.node)
-                                self.csr.register_scale_down(
-                                    now_ts, g.id() if g else "", r.node.name
-                                )
-                                registered_any = True
-                        if not registered_any:
-                            self.csr.register_scale_down(now_ts)
-                        # destinations of the deleted nodes' simulated pods
-                        # restart their unneeded clocks (simulator/tracker.go)
-                        for name in down.deleted_empty + down.deleted_drain:
-                            self.scale_down_planner.node_deleted(name, now_ts)
-                        gpu_deleted = sum(
-                            1
-                            for r in plan.empty + plan.drain
-                            if r.node.name in deleted
-                            and (r.node.allocatable.gpu > 0 or r.node.allocatable.tpu > 0)
+            with trace.span(metrics_mod.SCALE_DOWN) as sp_down:
+                with trace.span(metrics_mod.FIND_UNNEEDED):
+                    candidates = self.processors.scale_down_candidates_sorting.sort(
+                        self.processors.scale_down_node.get_scale_down_candidates(
+                            self._scale_down_candidates(all_nodes, upcoming_names),
+                            all_nodes,
                         )
-                        if gpu_deleted:
-                            self.metrics.scaled_down_gpu_nodes_total.inc(gpu_deleted)
-                    if down.failed:
-                        self.last_scale_down_fail_ts = now_ts
-            # keep soft taints in sync either way (:676)
-            self.scale_down_actuator.update_soft_deletion_taints(
-                self.api.list_nodes(), self.scale_down_planner.unneeded_names()
-            )
+                    )
+                    self.scale_down_planner.update_cluster_state(
+                        snapshot, candidates, pdbs, now_ts
+                    )
+                self.metrics.last_activity.set(
+                    now_ts, activity=metrics_mod.SCALE_DOWN
+                )
+                result.unneeded_nodes = len(self.scale_down_planner.unneeded_names())
+                self.processors.notify_scale_down_candidates(
+                    self.scale_down_planner.unneeded_names()
+                )
+                in_cooldown = self._scale_down_in_cooldown(now_ts)
+                result.scale_down_in_cooldown = in_cooldown
+                sp_down.set_attrs(
+                    unneeded=result.unneeded_nodes, in_cooldown=in_cooldown
+                )
+                if not in_cooldown:
+                    plan = self.scale_down_planner.nodes_to_delete(snapshot, now_ts)
+                    if plan.empty or plan.drain:
+                        down = self.scale_down_actuator.start_deletion(plan, now_ts)
+                        result.scale_down = down
+                        sp_down.set_attrs(
+                            deleted_empty=len(down.deleted_empty),
+                            deleted_drain=len(down.deleted_drain),
+                        )
+                        if down.deleted_empty or down.deleted_drain:
+                            self.last_scale_down_delete_ts = now_ts
+                            # per-node registration widens the group's
+                            # acceptable range while the cloud deletion is in
+                            # flight (clusterstate.go RegisterScaleDown)
+                            deleted = set(down.deleted_empty + down.deleted_drain)
+                            registered_any = False
+                            for r in plan.empty + plan.drain:
+                                if r.node.name in deleted:
+                                    g = self.provider.node_group_for_node(r.node)
+                                    self.csr.register_scale_down(
+                                        now_ts, g.id() if g else "", r.node.name
+                                    )
+                                    registered_any = True
+                            if not registered_any:
+                                self.csr.register_scale_down(now_ts)
+                            # destinations of the deleted nodes' simulated
+                            # pods restart their unneeded clocks
+                            # (simulator/tracker.go)
+                            for name in down.deleted_empty + down.deleted_drain:
+                                self.scale_down_planner.node_deleted(name, now_ts)
+                            gpu_deleted = sum(
+                                1
+                                for r in plan.empty + plan.drain
+                                if r.node.name in deleted
+                                and (
+                                    r.node.allocatable.gpu > 0
+                                    or r.node.allocatable.tpu > 0
+                                )
+                            )
+                            if gpu_deleted:
+                                self.metrics.scaled_down_gpu_nodes_total.inc(
+                                    gpu_deleted
+                                )
+                        if down.failed:
+                            self.last_scale_down_fail_ts = now_ts
+                # keep soft taints in sync either way (:676)
+                self.scale_down_actuator.update_soft_deletion_taints(
+                    self.api.list_nodes(), self.scale_down_planner.unneeded_names()
+                )
         if self.debugger is not None and self.debugger.is_data_collection_allowed():
             self.debugger.capture(
                 self, snapshot, pending, result, filtered_pods=filtered

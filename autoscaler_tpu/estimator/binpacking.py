@@ -56,6 +56,8 @@ from autoscaler_tpu.snapshot.packer import (
     resources_row,
 )
 from autoscaler_tpu.snapshot.tensors import bucket_size
+from autoscaler_tpu import trace
+from autoscaler_tpu.trace.device import device_annotation
 
 
 def _pack_pods(
@@ -217,6 +219,11 @@ class BinpackingNodeEstimator:
         self.metrics = metrics
         self.ladder = ladder or KernelLadder()
         self.ladder.bind_metrics(metrics)
+        # per-route dispatch wall-time stats for the compile-vs-execute
+        # split span attributes: first dispatch of a route pays trace+
+        # compile; the warm median approximates pure execute, and their
+        # difference approximates compile. {route: {"first": s, "warm": []}}
+        self._route_walls: Dict[str, Dict[str, object]] = {}
 
     def estimate(
         self,
@@ -228,6 +235,19 @@ class BinpackingNodeEstimator:
         """→ (node_count, scheduled_pods). Single-group path."""
         if not pods:
             return 0, []
+        with trace.span(
+            metrics_mod.ESTIMATE, metrics=self.metrics,
+            single_template=True, pods=len(pods),
+        ):
+            return self._estimate_inner(pods, template, max_size_headroom, cluster)
+
+    def _estimate_inner(
+        self,
+        pods: Sequence[Pod],
+        template: Node,
+        max_size_headroom: int,
+        cluster,
+    ) -> Tuple[int, List[Pod]]:
         P = bucket_size(len(pods))
         ext = _estimation_schema(pods)
         req = _pack_pods(pods, P, ext)
@@ -343,9 +363,17 @@ class BinpackingNodeEstimator:
         if not pods or not templates:
             return {g: (0, []) for g in templates}
         t0 = time.monotonic()
-        result = self._estimate_many_inner(
-            pods, templates, headrooms, pod_groups, cluster
-        )
+        # the span IS the duration record: its wall time feeds
+        # function_duration{function="estimate"} through the one choke
+        # point (trace → AutoscalerMetrics.observe_duration_value), in a
+        # trace or detached
+        with trace.span(
+            metrics_mod.ESTIMATE, metrics=self.metrics,
+            pods=len(pods), groups=len(templates),
+        ):
+            result = self._estimate_many_inner(
+                pods, templates, headrooms, pod_groups, cluster
+            )
         elapsed = time.monotonic() - t0
         # the reference budgets max_duration_s PER GROUP (threshold_based_
         # limiter.go); the batched dispatch covers every group at once, so
@@ -354,15 +382,14 @@ class BinpackingNodeEstimator:
         # an abort — the dispatch already ran.
         budget = self.limiter.max_duration_s * len(templates)
         over = self.limiter.max_duration_s > 0 and elapsed > budget
-        if self.metrics is not None:
+        if self.metrics is not None and over:
             # the reference's per-group duration limiter becomes an
             # observable envelope here: the dispatch duration lands in the
-            # function-duration taxonomy (function="estimate") and overruns
-            # tick a counter operators can alert on (VERDICT r3 weak #8 —
-            # the budget must be measured, not advisory)
-            self.metrics.observe_duration(metrics_mod.ESTIMATE, t0)
-            if over:
-                self.metrics.estimation_over_budget_total.inc()
+            # function-duration taxonomy (function="estimate", via the
+            # span above) and overruns tick a counter operators can alert
+            # on (VERDICT r3 weak #8 — the budget must be measured, not
+            # advisory)
+            self.metrics.estimation_over_budget_total.inc()
         if over:
             logging.getLogger("estimator").warning(
                 "binpacking dispatch took %.2fs for %d groups — over the "
@@ -647,78 +674,97 @@ class BinpackingNodeEstimator:
         ``forced`` = (label, fn) runs when every rung was skipped or failed
         (e.g. a topology-spread dispatch, which no host rung supports, with
         the device rungs broken): the breaker is bypassed — keep deciding —
-        and exceptions propagate to the crash-only control loop."""
+        and exceptions propagate to the crash-only control loop.
+
+        Every rung engagement is one ``deviceDispatch`` span (attributes:
+        rung, outcome, reason), so a ladder walk shows up in the tick trace
+        as siblings under the ``estimate`` span — pallas fault → xla ok is
+        readable straight off /tracez."""
         log = logging.getLogger("estimator")
         reason, detail = initial_reason, ""
         for rung, label, gate, fn in steps:
-            engaged = self.ladder.begin(rung)
-            if engaged == "breaker_open":
-                reason, detail = "breaker_open", f"{rung} rung breaker open"
-                continue
-            if engaged is not None:  # an injected device-fault kind
-                log.warning(
-                    "%s kernel rung failed (injected %s); descending the "
-                    "ladder", rung, engaged,
-                )
-                reason, detail = engaged, f"injected {engaged} on {rung} rung"
-                continue
-            try:
-                skip = gate() if gate is not None else None
-            except Exception:  # noqa: BLE001 — a raising gate counts as a
-                # rung failure: the begin() above MUST be resolved, or a
-                # held half-open probe slot would leak and wedge the rung
-                self.ladder.record_failure(rung)
-                log.warning(
-                    "%s rung availability gate raised; descending the "
-                    "ladder", rung, exc_info=True,
-                )
-                reason, detail = "kernel_fault", f"{rung} gate raised"
-                continue
-            if skip is None and fn is None:
-                skip = (
-                    "unsupported", f"{rung} rung has no twin for this dispatch"
-                )
-            if skip is not None:
-                # a gate may append an explicit host-level flag (third
-                # element) when the recorded reason is dispatch-level
-                # routing but the rung is ALSO host-level unexercisable —
-                # e.g. the dedup pseudo-gate on a CPU-only host
-                host_level = (
-                    skip[2] if len(skip) > 2
-                    else skip[0] in HOST_LEVEL_SKIP_REASONS
-                )
-                reason, detail = skip[0], skip[1]
-                if host_level:
-                    # static for this process: a probe landing here closes
-                    # the breaker (the rung can never fault on this host)
-                    self.ladder.record_unavailable(rung)
-                else:
-                    # dispatch-level routing: release a held probe slot
-                    # unresolved — closing a tripped rung off a dispatch
-                    # that never exercised it would re-pay
-                    # failure_threshold faults on the next eligible one
-                    self.ladder.record_skipped_dispatch(rung)
-                continue
-            try:
-                out = fn()
-            except Exception:  # noqa: BLE001 — any kernel failure descends
-                self.ladder.record_failure(rung)
-                log.warning(
-                    "%s kernel rung failed; descending the ladder",
-                    rung, exc_info=True,
-                )
-                reason, detail = "kernel_fault", f"{rung} kernel raised"
-                continue
-            self.ladder.record_success(rung)
-            self._note_route(label, reason, detail)
-            return out
+            with trace.span(
+                metrics_mod.DEVICE_DISPATCH, metrics=self.metrics, rung=rung
+            ) as sp:
+                engaged = self.ladder.begin(rung)
+                if engaged == "breaker_open":
+                    reason, detail = "breaker_open", f"{rung} rung breaker open"
+                    sp.set_attrs(outcome="skipped", reason="breaker_open")
+                    continue
+                if engaged is not None:  # an injected device-fault kind
+                    log.warning(
+                        "%s kernel rung failed (injected %s); descending the "
+                        "ladder", rung, engaged,
+                    )
+                    reason, detail = engaged, f"injected {engaged} on {rung} rung"
+                    sp.set_attrs(outcome="fault", reason=engaged)
+                    continue
+                try:
+                    skip = gate() if gate is not None else None
+                except Exception:  # noqa: BLE001 — a raising gate counts as a
+                    # rung failure: the begin() above MUST be resolved, or a
+                    # held half-open probe slot would leak and wedge the rung
+                    self.ladder.record_failure(rung)
+                    log.warning(
+                        "%s rung availability gate raised; descending the "
+                        "ladder", rung, exc_info=True,
+                    )
+                    reason, detail = "kernel_fault", f"{rung} gate raised"
+                    sp.set_attrs(outcome="fault", reason="gate_raised")
+                    continue
+                if skip is None and fn is None:
+                    skip = (
+                        "unsupported", f"{rung} rung has no twin for this dispatch"
+                    )
+                if skip is not None:
+                    # a gate may append an explicit host-level flag (third
+                    # element) when the recorded reason is dispatch-level
+                    # routing but the rung is ALSO host-level unexercisable —
+                    # e.g. the dedup pseudo-gate on a CPU-only host
+                    host_level = (
+                        skip[2] if len(skip) > 2
+                        else skip[0] in HOST_LEVEL_SKIP_REASONS
+                    )
+                    reason, detail = skip[0], skip[1]
+                    if host_level:
+                        # static for this process: a probe landing here closes
+                        # the breaker (the rung can never fault on this host)
+                        self.ladder.record_unavailable(rung)
+                    else:
+                        # dispatch-level routing: release a held probe slot
+                        # unresolved — closing a tripped rung off a dispatch
+                        # that never exercised it would re-pay
+                        # failure_threshold faults on the next eligible one
+                        self.ladder.record_skipped_dispatch(rung)
+                    sp.set_attrs(outcome="unavailable", reason=reason)
+                    continue
+                try:
+                    out = self._dispatch(label, fn, sp)
+                except Exception:  # noqa: BLE001 — any kernel failure descends
+                    self.ladder.record_failure(rung)
+                    log.warning(
+                        "%s kernel rung failed; descending the ladder",
+                        rung, exc_info=True,
+                    )
+                    reason, detail = "kernel_fault", f"{rung} kernel raised"
+                    sp.set_attrs(outcome="fault", reason="kernel_raised")
+                    continue
+                self.ladder.record_success(rung)
+                sp.set_attrs(outcome="ok", route=label, fallback_reason=reason)
+                self._note_route(label, reason, detail)
+                return out
         if forced is not None:
             label, fn = forced
             log.error(
                 "every kernel rung skipped or failed (last: %s); forcing the "
                 "%s dispatch despite its breaker", reason, label,
             )
-            out = fn()
+            with trace.span(
+                metrics_mod.DEVICE_DISPATCH, metrics=self.metrics,
+                rung="forced", route=label,
+            ) as sp:
+                out = self._dispatch(label, fn, sp)
+                sp.set_attrs(outcome="ok")
             self._note_route(label, "forced", detail)
             return out
         from autoscaler_tpu.utils.errors import AutoscalerError, ErrorType
@@ -727,6 +773,40 @@ class BinpackingNodeEstimator:
             ErrorType.INTERNAL,
             f"no kernel rung could serve the dispatch (last: {reason})",
         )
+
+    def _dispatch(self, label: str, fn, sp):
+        """Run one rung's kernel under a device-profiler annotation (the
+        host span's name becomes visible on a captured jax.profiler
+        timeline — no-op off jax) and record the per-route compile-vs-
+        execute wall split as span attributes.
+
+        The split is estimated, not measured: the first dispatch of a route
+        pays trace+compile+execute, warm dispatches pay execute only, so
+        ``compile_est_s = first_wall − median(warm walls)``. ``cold`` is
+        deterministic (pure call-sequence); the wall-derived attributes go
+        through set_wall_attrs, which drops them on deterministic (replay)
+        tracers so trace exports stay byte-stable."""
+        t0 = time.monotonic()
+        with device_annotation(f"autoscaler/estimator/{label}"):
+            out = fn()
+        wall = time.monotonic() - t0
+        stats = self._route_walls.setdefault(label, {"first": None, "warm": []})
+        if stats["first"] is None:
+            stats["first"] = wall
+            sp.set_attrs(cold=True)
+            trace.set_wall_attrs(dispatch_s=round(wall, 6))
+        else:
+            warm: List[float] = stats["warm"]  # type: ignore[assignment]
+            warm.append(wall)
+            del warm[:-64]  # bounded: enough samples for a stable median
+            median = sorted(warm)[len(warm) // 2]
+            sp.set_attrs(cold=False)
+            trace.set_wall_attrs(
+                dispatch_s=round(wall, 6),
+                execute_est_s=round(median, 6),
+                compile_est_s=round(max(float(stats["first"]) - median, 0.0), 6),
+            )
+        return out
 
     @staticmethod
     def _host_gate(spread_active: bool = False, need_native: bool = False):

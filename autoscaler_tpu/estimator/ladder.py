@@ -35,6 +35,7 @@ import logging
 import threading
 from typing import Callable, Dict, List, Optional
 
+from autoscaler_tpu import trace
 from autoscaler_tpu.utils.circuit import BreakerState, CircuitBreaker
 
 RUNG_PALLAS = "pallas"
@@ -112,6 +113,13 @@ class KernelLadder:
                     rung=rung, from_state=old.value, to_state=new.value
                 )
                 m.estimator_kernel_breaker_state.set(_STATE_VALUE[new], rung=rung)
+            # stamp the transition on the tick trace (no-op outside one):
+            # a breaker trip is exactly the kind of mid-tick state change
+            # the flight recorder exists to correlate
+            trace.add_event(
+                "breaker.transition",
+                rung=rung, from_state=old.value, to_state=new.value,
+            )
             logger.warning(
                 "estimator kernel rung %r breaker: %s -> %s",
                 rung, old.value, new.value,

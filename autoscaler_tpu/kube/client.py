@@ -22,7 +22,9 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
+from autoscaler_tpu import trace
 from autoscaler_tpu.kube import convert
+from autoscaler_tpu.metrics import metrics as metrics_mod
 from autoscaler_tpu.utils.http import RetryPolicy, json_request
 from autoscaler_tpu.kube.api import ClusterAPI, EvictionError
 from autoscaler_tpu.kube.objects import Node, Pod, PodDisruptionBudget, Taint
@@ -260,17 +262,26 @@ class KubeRestClient:
         retry = None
         if method == "GET" and not stream and self.get_retries > 0:
             retry = RetryPolicy(attempts=self.get_retries + 1)
-        return json_request(
-            self.base_url + path,
-            method=method,
-            body=body,
-            headers=headers,
-            timeout_s=timeout_s or self.timeout_s,
-            context=self._ctx,
-            on_error=ApiError,
-            stream=stream,
-            retry=retry,
-        )
+        # one span per control-plane request, retries included — on the
+        # tick trace a kube GET retry storm is visibly attributed to the
+        # phase that issued it (watch-thread requests run outside a tick
+        # and trace as no-ops). The resource path is a span attribute, not
+        # a metric label: trace attrs are unbounded-cardinality-safe.
+        with trace.span(
+            metrics_mod.KUBE_REQUEST, path=path.split("?", 1)[0],
+            method=method, stream=stream,
+        ):
+            return json_request(
+                self.base_url + path,
+                method=method,
+                body=body,
+                headers=headers,
+                timeout_s=timeout_s or self.timeout_s,
+                context=self._ctx,
+                on_error=ApiError,
+                stream=stream,
+                retry=retry,
+            )
 
     def get(self, path: str) -> dict:
         return self._request("GET", path)

@@ -34,6 +34,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                      "(default: stdout only)")
     run.add_argument("--log", default="", help="write the per-tick decision log")
     run.add_argument("--trace", default="", help="write the resolved event trace")
+    run.add_argument("--chrome-trace", default="",
+                     help="write the run's tick span trees as a Chrome-"
+                          "trace/Perfetto JSON (deterministic: two runs of "
+                          "the same spec are byte-identical)")
     run.add_argument("--seed", type=int, default=None,
                      help="override the spec's seed")
     run.add_argument("--real-sleep", action="store_true",
@@ -43,6 +47,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     rep.add_argument("trace", help="path to a trace JSON file (from run --trace)")
     rep.add_argument("--report", default="")
     rep.add_argument("--log", default="")
+    rep.add_argument("--chrome-trace", default="")
 
     val = sub.add_parser("validate", help="parse + round-trip a scenario spec")
     val.add_argument("scenario")
@@ -56,7 +61,8 @@ def _write(path: str, doc) -> None:
 
 
 def _run(spec: ScenarioSpec, report_path: str, log_path: str,
-         trace_path: str = "", real_sleep: bool = False) -> int:
+         trace_path: str = "", real_sleep: bool = False,
+         chrome_trace_path: str = "") -> int:
     from autoscaler_tpu.loadgen.driver import run_scenario
     from autoscaler_tpu.loadgen.score import build_report
 
@@ -69,6 +75,11 @@ def _run(spec: ScenarioSpec, report_path: str, log_path: str,
         _write(log_path, result.decision_log())
     if trace_path:
         _write(trace_path, {"spec": spec.to_dict(), "events": result.trace})
+    if chrome_trace_path and result.recorder is not None:
+        # already byte-stable JSON (sorted keys, deterministic timeline):
+        # written verbatim so two runs diff clean
+        with open(chrome_trace_path, "w") as f:
+            f.write(result.recorder.chrome() or "")
     return 0
 
 
@@ -80,7 +91,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.seed is not None:
                 spec.seed = args.seed
             return _run(spec, args.report, args.log, args.trace,
-                        real_sleep=args.real_sleep)
+                        real_sleep=args.real_sleep,
+                        chrome_trace_path=args.chrome_trace)
         if args.command == "replay":
             with open(args.trace) as f:
                 doc = json.load(f)
@@ -91,7 +103,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             from autoscaler_tpu.loadgen.spec import _load_event
 
             spec.events = [_load_event(e) for e in doc["events"]]
-            return _run(spec, args.report, args.log)
+            return _run(spec, args.report, args.log,
+                        chrome_trace_path=args.chrome_trace)
         if args.command == "validate":
             spec = ScenarioSpec.load(args.scenario)
             roundtrip = ScenarioSpec.from_json(spec.to_json())

@@ -56,6 +56,7 @@ from autoscaler_tpu.loadgen.workloads import expand_workloads
 from autoscaler_tpu.metrics.metrics import AutoscalerMetrics
 from autoscaler_tpu.simulator.hinting import HintingSimulator
 from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+from autoscaler_tpu.trace import FlightRecorder, Tracer
 
 ZONE_KEY = "topology.kubernetes.io/zone"
 BASE_TS = 1_000_000.0
@@ -85,6 +86,21 @@ class _SimClock:
 
     def sleep(self, seconds: float) -> None:
         self.t += max(seconds, 0.0)
+
+
+class _TraceClock:
+    """Deterministic timeline clock for the tracer: advances exactly 1ms
+    per reading. Two replays of the same scenario make the same span/event
+    sequence, hence the same clock readings, hence byte-identical trace
+    exports — while spans still nest with visible (synthetic) extent in
+    Perfetto instead of collapsing to zero width on the sim clock."""
+
+    def __init__(self) -> None:
+        self.readings = 0
+
+    def __call__(self) -> float:
+        self.readings += 1
+        return self.readings * 1e-3
 
 
 @dataclass
@@ -133,6 +149,9 @@ class RunResult:
     final_nodes: int
     total_requested_cpu_m: float = 0.0
     group_cpu_m: float = 0.0
+    # flight recorder holding every tick's span tree (deterministic
+    # timeline): recorder.chrome() is the byte-stable Perfetto export
+    recorder: Optional[FlightRecorder] = None
 
     def decision_log(self) -> List[Dict[str, Any]]:
         return [r.to_dict() for r in self.records]
@@ -220,8 +239,19 @@ class ScenarioDriver:
         )
         gd.max_node_provision_time_s = self.options.max_node_provision_time_s
         self.metrics = AutoscalerMetrics()
+        # deterministic tracer: synthetic timeline clock (byte-identical
+        # exports across replays — set_wall_attrs drops wall attributes),
+        # ring sized to hold EVERY tick so the export covers the whole run,
+        # slow-tick pinning off (wall-time-driven, hence not replayable)
+        self.tracer = Tracer(
+            clock=_TraceClock(),
+            metrics=self.metrics,
+            recorder=FlightRecorder(capacity=max(spec.ticks, 1)),
+            slow_tick_threshold_s=0.0,
+        )
         self.autoscaler = StaticAutoscaler(
-            self.provider, self.api, self.options, metrics=self.metrics
+            self.provider, self.api, self.options, metrics=self.metrics,
+            tracer=self.tracer,
         )
         # re-seat the actuator on a simulated clock (same tracker wiring as
         # the ctor): eviction retry pacing must not wall-block fault runs
@@ -465,6 +495,12 @@ class ScenarioDriver:
             pending_before = sum(
                 1 for p in self.api.list_pods() if not p.node_name
             )
+            # tag this tick's trace with scenario coordinates: the span
+            # tree carries sim-time, so a /tracez trace from a replay can
+            # be lined up against the decision log by (scenario, tick)
+            self.tracer.set_context(
+                scenario=spec.name, tick=tick, sim_ts=now
+            )
             t0 = time.perf_counter()
             self.api.in_run_once = True
             try:
@@ -539,6 +575,7 @@ class ScenarioDriver:
             final_nodes=len(self.api.nodes),
             total_requested_cpu_m=self.total_requested_cpu_m,
             group_cpu_m=max(group_cpu.values()) if group_cpu else 0.0,
+            recorder=self.tracer.recorder,
         )
 
 

@@ -57,17 +57,25 @@ def build_report(result: RunResult) -> Dict[str, Any]:
         if result.group_cpu_m > 0
         else 0
     )
+    # per-phase latency breakdown over EVERY span name the run produced
+    # (the trace/metrics shared vocabulary: main, buildSnapshot, estimate,
+    # deviceDispatch, scaleDown, ... — traces and this table can't disagree
+    # because both come from the same observe_duration_value choke point)
     fd = result.metrics.function_duration
     phases = {}
-    for phase in ("main", "estimate", "scaleUp", "findUnneeded",
-                  "filterOutSchedulable", "buildSnapshot"):
-        n = fd.count(function=phase)
-        if n:
-            phases[phase] = {
-                "count": n,
-                "p50_s": round(fd.quantile(0.5, function=phase), 4),
-                "max_s": round(fd.quantile(1.0, function=phase), 4),
-            }
+    for key, state in sorted(fd.states.items()):
+        labels = dict(key)
+        phase = labels.get("function", "")
+        if not phase or not state.count:
+            continue
+        phases[phase] = {
+            "count": state.count,
+            "p50_s": round(fd.quantile(0.5, **labels), 4),
+            "p99_s": round(fd.quantile(0.99, **labels), 4),
+            # lifetime maximum, not the window's: the one pathological tick
+            # a long run exists to surface must survive window eviction
+            "max_s": round(state.maximum, 4),
+        }
     routes = {
         "/".join(f"{lk}={lv}" for lk, lv in k): int(v)
         for k, v in result.metrics.estimator_kernel_route_total.values.items()

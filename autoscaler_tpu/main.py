@@ -203,6 +203,20 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "kernel compiles across restarts); empty disables")
     p.add_argument("--debugging-snapshot-enabled", type=_bool_flag, default=True,
                    help="serve /snapshotz captures")
+    p.add_argument("--tracing-enabled", type=_bool_flag, default=True,
+                   help="serve /tracez (flight-recorder span trees; the "
+                        "tracer itself always runs, bounded)")
+    p.add_argument("--trace-ring-size", type=int, default=64,
+                   help="how many recent tick traces the in-memory flight "
+                        "recorder keeps")
+    p.add_argument("--trace-slow-tick-threshold", type=float, default=2.0,
+                   help="ticks slower than this (wall seconds) get their "
+                        "full span tree logged and the trace pinned in the "
+                        "flight recorder; 0 disables")
+    p.add_argument("--jax-profiler-dir", default="",
+                   help="capture a jax profiler session per tick into "
+                        "<dir>/tick_<id> — device timeline keyed by the "
+                        "same tick id as the host trace (debug tool)")
     p.add_argument("--record-duplicated-events", type=_bool_flag, default=False,
                    help="post every event instead of suppressing repeats "
                         "within the correlator window")
@@ -312,6 +326,10 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         ),
         node_info_cache_expire_time_s=args.node_info_cache_expire_time,
         debugging_snapshot_enabled=args.debugging_snapshot_enabled,
+        tracing_enabled=args.tracing_enabled,
+        trace_ring_size=args.trace_ring_size,
+        trace_slow_tick_threshold_s=args.trace_slow_tick_threshold,
+        jax_profiler_dir=args.jax_profiler_dir,
         force_daemonsets=args.force_ds,
     )
     opts.node_group_defaults.scale_down_unneeded_time_s = args.scale_down_unneeded_time
@@ -377,6 +395,50 @@ class ObservabilityServer:
                         payload or json.dumps({"status": "armed for next loop"}),
                         "application/json",
                     )
+                elif self.path.startswith("/tracez"):
+                    # flight recorder (autoscaler_tpu/trace): gated like
+                    # /snapshotz — the tracer always records, the endpoint
+                    # is the opt-out
+                    tracer = getattr(autoscaler, "tracer", None)
+                    enabled = getattr(
+                        autoscaler.options, "tracing_enabled", True
+                    )
+                    if tracer is None or tracer.recorder is None or not enabled:
+                        self._send(404, "tracing disabled (--tracing-enabled)")
+                        return
+                    from urllib.parse import parse_qs, urlparse
+
+                    url = urlparse(self.path)
+                    if url.path.rstrip("/") not in ("", "/tracez"):
+                        self._send(404, "not found")
+                        return
+                    q = parse_qs(url.query)
+                    fmt = q.get("format", [""])[0]
+                    raw_id = q.get("id", [None])[0]
+                    trace_id = None
+                    if raw_id is not None:
+                        try:
+                            trace_id = int(raw_id)
+                        except ValueError:
+                            self._send(400, f"bad trace id {raw_id!r}")
+                            return
+                    rec = tracer.recorder
+                    if fmt == "chrome":
+                        body = rec.chrome(trace_id)
+                        if body is None:
+                            self._send(404, f"no trace {trace_id}")
+                            return
+                        self._send(200, body, "application/json")
+                    elif fmt:
+                        self._send(400, f"unknown format {fmt!r}")
+                    elif trace_id is not None:
+                        body = rec.detail_json(trace_id)
+                        if body is None:
+                            self._send(404, f"no trace {trace_id}")
+                            return
+                        self._send(200, body, "application/json")
+                    else:
+                        self._send(200, rec.list_json(), "application/json")
                 elif self.path == "/status":
                     from autoscaler_tpu.clusterstate.status import build_status
 

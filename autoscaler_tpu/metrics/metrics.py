@@ -11,11 +11,14 @@ from __future__ import annotations
 import math
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
 
-# FunctionLabel taxonomy (metrics.go:94-107)
+# FunctionLabel taxonomy (metrics.go:94-107). These double as SPAN NAMES in
+# autoscaler_tpu/trace: one vocabulary for metrics and traces, and span
+# durations feed function_duration_seconds through observe_duration_value,
+# so the two surfaces can never disagree on what a phase is called.
 MAIN = "main"
 POLL = "poll"
 RECONFIGURE = "reconfigure"
@@ -28,14 +31,22 @@ FILTER_OUT_SCHEDULABLE = "filterOutSchedulable"
 SNAPSHOT_BUILD = "buildSnapshot"
 DEVICE_DISPATCH = "deviceDispatch"  # TPU-specific: kernel round trips
 ESTIMATE = "estimate"  # batched binpacking dispatch (threshold_based_limiter envelope)
+KUBE_REQUEST = "kubeRequest"  # one control-plane HTTP request (incl. retries)
+RPC_CALL = "rpcCall"  # one sidecar RPC (incl. the single reconnect-resend)
 
 
 class _Series:
+    """``_lock`` serializes label-key insertion against the /metrics
+    renderer: the exposition runs on HTTP server threads while the control
+    loop observes, and the first observation of a new label key resizes
+    the dict a concurrent ``expose()`` would be iterating."""
+
     def __init__(self, name: str, help_: str, kind: str):
         self.name = name
         self.help = help_
         self.kind = kind
         self.values: Dict[Tuple[Tuple[str, str], ...], float] = defaultdict(float)
+        self._lock = threading.Lock()
 
     def _key(self, labels: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
         return tuple(sorted((labels or {}).items()))
@@ -43,7 +54,8 @@ class _Series:
 
 class Counter(_Series):
     def inc(self, value: float = 1.0, **labels: str) -> None:
-        self.values[self._key(labels)] += value
+        with self._lock:
+            self.values[self._key(labels)] += value
 
     def get(self, **labels: str) -> float:
         return self.values.get(self._key(labels), 0.0)
@@ -51,10 +63,15 @@ class Counter(_Series):
 
 class Gauge(_Series):
     def set(self, value: float, **labels: str) -> None:
-        self.values[self._key(labels)] = value
+        with self._lock:
+            self.values[self._key(labels)] = value
 
     def get(self, **labels: str) -> float:
         return self.values.get(self._key(labels), 0.0)
+
+
+# sliding-window size for summary quantiles
+WINDOW = 512
 
 
 @dataclass
@@ -62,13 +79,29 @@ class _SummaryState:
     count: int = 0
     total: float = 0.0
     maximum: float = 0.0
-    recent: List[float] = field(default_factory=list)  # sliding window for quantiles
+    # deque(maxlen=...): O(1) eviction once the window fills — the previous
+    # list + pop(0) was O(n) per observe at steady state
+    recent: "deque[float]" = field(
+        default_factory=lambda: deque(maxlen=WINDOW)
+    )
+
+
+def _quantile_of(sorted_data: List[float], q: float) -> float:
+    if not sorted_data:
+        return 0.0
+    idx = min(int(q * len(sorted_data)), len(sorted_data) - 1)
+    return sorted_data[idx]
 
 
 class Summary(_Series):
-    """Duration summary with approximate quantiles over a sliding window."""
+    """Duration summary with approximate quantiles over a sliding window.
 
-    WINDOW = 512
+    The series lock additionally covers the window deque: the control loop
+    observes while HTTP server threads render /metrics, and iterating a
+    deque mid-append raises ``deque mutated during iteration`` (the old
+    list + pop(0) merely returned a torn read)."""
+
+    WINDOW = WINDOW
 
     def __init__(self, name: str, help_: str):
         super().__init__(name, help_, "summary")
@@ -77,21 +110,29 @@ class Summary(_Series):
         )
 
     def observe(self, value: float, **labels: str) -> None:
-        s = self.states[self._key(labels)]
-        s.count += 1
-        s.total += value
-        s.maximum = max(s.maximum, value)
-        s.recent.append(value)
-        if len(s.recent) > self.WINDOW:
-            s.recent.pop(0)
+        with self._lock:
+            s = self.states[self._key(labels)]
+            s.count += 1
+            s.total += value
+            s.maximum = max(s.maximum, value)
+            s.recent.append(value)  # maxlen evicts the oldest
 
     def quantile(self, q: float, **labels: str) -> float:
-        s = self.states.get(self._key(labels))
-        if not s or not s.recent:
-            return 0.0
-        data = sorted(s.recent)
-        idx = min(int(q * len(data)), len(data) - 1)
-        return data[idx]
+        with self._lock:
+            s = self.states.get(self._key(labels))
+            if not s or not s.recent:
+                return 0.0
+            data = sorted(s.recent)
+        return _quantile_of(data, q)
+
+    def snapshot(self) -> List[Tuple[Tuple[Tuple[str, str], ...], int, float, List[float]]]:
+        """(label key, count, total, sorted window) rows — one consistent
+        read for renderers, taken under the series lock."""
+        with self._lock:
+            return [
+                (key, s.count, s.total, sorted(s.recent))
+                for key, s in self.states.items()
+            ]
 
     def count(self, **labels: str) -> int:
         s = self.states.get(self._key(labels))
@@ -122,30 +163,49 @@ class MetricsRegistry:
             return self._metrics[name]  # type: ignore[return-value]
 
     def expose(self) -> str:
-        """Prometheus text exposition format."""
+        """Prometheus text exposition format. Each series is snapshotted
+        under its own lock before rendering — a concurrent first-observation
+        of a new label key must not resize a dict mid-iteration."""
         lines: List[str] = []
         with self._lock:
-            for m in self._metrics.values():
-                lines.append(f"# HELP {m.name} {m.help}")
-                lines.append(f"# TYPE {m.name} {m.kind if m.kind != 'summary' else 'summary'}")
-                if isinstance(m, Summary):
-                    for key, s in m.states.items():
-                        lbl = _fmt_labels(dict(key))
-                        lines.append(f"{m.name}_count{lbl} {s.count}")
-                        lines.append(f"{m.name}_sum{lbl} {s.total:.9g}")
-                        for q in (0.5, 0.9, 0.99):
-                            ql = _fmt_labels({**dict(key), "quantile": str(q)})
-                            lines.append(f"{m.name}{ql} {m.quantile(q, **dict(key)):.9g}")
-                else:
-                    for key, v in m.values.items():
-                        lines.append(f"{m.name}{_fmt_labels(dict(key))} {v:.9g}")
+            series = list(self._metrics.values())
+        for m in series:
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind if m.kind != 'summary' else 'summary'}")
+            if isinstance(m, Summary):
+                for key, count, total, data in m.snapshot():
+                    lbl = _fmt_labels(dict(key))
+                    lines.append(f"{m.name}_count{lbl} {count}")
+                    lines.append(f"{m.name}_sum{lbl} {total:.9g}")
+                    for q in (0.5, 0.9, 0.99):
+                        ql = _fmt_labels({**dict(key), "quantile": str(q)})
+                        lines.append(f"{m.name}{ql} {_quantile_of(data, q):.9g}")
+            else:
+                with m._lock:
+                    items = list(m.values.items())
+                for key, v in items:
+                    lines.append(f"{m.name}{_fmt_labels(dict(key))} {v:.9g}")
         return "\n".join(lines) + "\n"
+
+
+def _escape_label_value(value: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double-quote
+    and newline must be escaped or the exposition line is corrupted (a pod
+    name with a quote would truncate the label set mid-line)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
 
 
 def _fmt_labels(labels: Dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in sorted(labels.items())
+    )
     return "{" + inner + "}"
 
 
@@ -281,12 +341,18 @@ class AutoscalerMetrics:
             "budget x group count (--max-nodegroup-binpacking-duration)",
         )
 
-    def observe_duration(self, label: str, start_ts: float) -> float:
-        """UpdateDurationFromStart analog (metrics.go:399)."""
-        elapsed = time.monotonic() - start_ts
+    def observe_duration_value(self, label: str, elapsed: float) -> float:
+        """THE duration choke point: every span end (autoscaler_tpu/trace)
+        and every legacy observe_duration call records through here, so the
+        trace vocabulary and the function_duration series can never
+        disagree on names or counts."""
         self.function_duration.observe(elapsed, function=label)
         self.function_duration_quantile.observe(elapsed, function=label)
         return elapsed
+
+    def observe_duration(self, label: str, start_ts: float) -> float:
+        """UpdateDurationFromStart analog (metrics.go:399)."""
+        return self.observe_duration_value(label, time.monotonic() - start_ts)
 
 
 _default = AutoscalerMetrics()

@@ -19,6 +19,8 @@ import numpy as np
 
 import grpc
 
+from autoscaler_tpu import trace
+from autoscaler_tpu.metrics import metrics as metrics_mod
 from autoscaler_tpu.rpc import autoscaler_pb2 as pb
 
 SERVICE_NAME = "autoscaler_tpu.TpuSimulation"
@@ -277,14 +279,22 @@ class TpuSimulationClient:
             )
             return rpc(request, timeout=timeout)
 
-        try:
-            return send()
-        except grpc.RpcError as e:
-            code = e.code() if hasattr(e, "code") else None
-            if code != grpc.StatusCode.UNAVAILABLE:
-                raise
-            self._reconnect()
-            return send()
+        # one span per sidecar RPC — the reconnect-and-resend is an event
+        # INSIDE it, so a tick slowed by a sidecar restart shows one long
+        # rpcCall span with a reconnect marker, not two mystery gaps
+        with trace.span(
+            metrics_mod.RPC_CALL, method=method,
+            deadline_s=timeout if timeout is not None else 0.0,
+        ):
+            try:
+                return send()
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if code != grpc.StatusCode.UNAVAILABLE:
+                    raise
+                trace.add_event("rpc.reconnect", method=method)
+                self._reconnect()
+                return send()
 
     def estimate(
         self,

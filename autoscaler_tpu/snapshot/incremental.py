@@ -45,6 +45,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from autoscaler_tpu import trace
 from autoscaler_tpu.kube.objects import NUM_RESOURCES, Node, Pod
 from autoscaler_tpu.snapshot.packer import (
     DENSE_MASK_CELL_LIMIT,
@@ -260,9 +261,13 @@ class IncrementalPacker:
             self._ext_schema = ext
             self._reset(max(PP, self._PP), max(NN, self._NN))
             self.full_packs += 1
+            # on the tick trace a full re-pack is THE classic "why was this
+            # tick slow" answer — stamp it with its cause
+            trace.add_event("snapshot.full_repack", reason="schema_change")
         elif PP > self._PP or NN > self._NN or self._profiles_bloated():
             self._reset(max(PP, self._PP), max(NN, self._NN))
             self.full_packs += 1
+            trace.add_event("snapshot.full_repack", reason="capacity_growth")
         else:
             self.incremental_updates += 1
         self._gen += 1

@@ -1,0 +1,32 @@
+"""Tick tracing: span trees, flight recorder, and device-timing correlation.
+
+Dependency-free (stdlib only) so every layer can import it. See tracer.py
+for the design contract (injectable clock ⇒ byte-identical loadgen replays;
+span durations feed ``function_duration_seconds`` through one choke point).
+"""
+from autoscaler_tpu.trace.recorder import FlightRecorder, chrome_trace_doc
+from autoscaler_tpu.trace.tracer import (
+    NOOP_SPAN,
+    Span,
+    TickTrace,
+    Tracer,
+    add_event,
+    current_span,
+    set_attrs,
+    set_wall_attrs,
+    span,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "NOOP_SPAN",
+    "Span",
+    "TickTrace",
+    "Tracer",
+    "add_event",
+    "chrome_trace_doc",
+    "current_span",
+    "set_attrs",
+    "set_wall_attrs",
+    "span",
+]

@@ -1,0 +1,107 @@
+"""Device-timing correlation: optional jax.profiler hooks.
+
+Host spans tell you a kernel dispatch took 80ms; they cannot tell you
+whether the device spent it compiling, executing, or idle behind a
+transfer. These helpers bridge the host trace to the device timeline
+(the Podracer argument — arxiv 2104.06272 — that host/device correlation
+is what makes TPU pipeline stalls debuggable):
+
+- :func:`device_annotation` wraps a dispatch in
+  ``jax.profiler.TraceAnnotation`` so the host span's name shows up on the
+  device timeline when a profiler session is active (no-op when jax or the
+  profiler is unavailable — this module must never make tracing a jax
+  dependency);
+- :func:`start_profiler_session` / :func:`stop_profiler_session` capture a
+  full ``jax.profiler`` trace into ``<dir>/tick_<id>`` so a device profile
+  is keyed by the same tick id as the host trace in the flight recorder
+  (the ``--jax-profiler-dir`` flag).
+
+Kept separate from tracer.py so the core tracing package stays
+dependency-free.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from contextlib import nullcontext
+from typing import Any, Optional
+
+logger = logging.getLogger("trace")
+
+# independent failure domains: a broken IMPORT disables everything, but a
+# failed SESSION start (unwritable dir, another profiler already active)
+# disables sessions only — annotations keep working
+_profiler_broken = False   # jax.profiler itself unusable: warn once, no-op
+_sessions_broken = False   # start_trace failed once: sessions off
+
+
+def _profiler() -> Optional[Any]:
+    global _profiler_broken
+    if _profiler_broken:
+        return None
+    try:
+        import jax.profiler as prof
+
+        return prof
+    except Exception:  # noqa: BLE001 — no jax / broken backend: trace without it
+        _profiler_broken = True
+        logger.warning("jax.profiler unavailable; device annotations disabled")
+        return None
+
+
+def device_annotation(name: str):
+    """Context manager tagging device activity with ``name`` — visible in a
+    captured profiler session (Perfetto/TensorBoard). No-op off jax."""
+    prof = _profiler()
+    if prof is None:
+        return nullcontext()
+    try:
+        return prof.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001
+        return nullcontext()
+
+
+def step_annotation(name: str, step: int):
+    """StepTraceAnnotation variant: marks one tick as a "step" so profiler
+    UIs group per-tick device activity. No-op off jax."""
+    prof = _profiler()
+    if prof is None:
+        return nullcontext()
+    try:
+        return prof.StepTraceAnnotation(name, step_num=step)
+    except Exception:  # noqa: BLE001
+        return nullcontext()
+
+
+def start_profiler_session(base_dir: str, tick_id: int) -> bool:
+    """Begin a jax profiler capture keyed by tick id. Returns True when a
+    session actually started (the caller must stop it)."""
+    global _sessions_broken
+    if _sessions_broken:
+        return False
+    prof = _profiler()
+    if prof is None:
+        return False
+    path = os.path.join(base_dir, f"tick_{tick_id:06d}")
+    try:
+        prof.start_trace(path)
+        return True
+    except Exception:  # noqa: BLE001 — an already-active or unsupported
+        # profiler must not take down the control loop; annotations keep
+        # working (only sessions are disabled)
+        _sessions_broken = True
+        logger.warning(
+            "jax profiler session failed to start (dir=%s); disabling "
+            "per-tick sessions", path, exc_info=True,
+        )
+        return False
+
+
+def stop_profiler_session() -> None:
+    prof = _profiler()
+    if prof is None:
+        return
+    try:
+        prof.stop_trace()
+    except Exception:  # noqa: BLE001
+        logger.warning("jax profiler session failed to stop", exc_info=True)

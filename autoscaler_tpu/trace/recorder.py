@@ -1,0 +1,177 @@
+"""Flight recorder: a bounded in-memory ring of the last N tick traces.
+
+Served by `/tracez` (main.ObservabilityServer): a JSON summary list,
+`?id=` full span-tree detail, and `?format=chrome` Chrome-trace/Perfetto
+export. Slow ticks are *pinned* — they survive ring eviction in a second
+bounded slot, so the one 9-second tick from last night is still there when
+an operator looks, even after thousands of healthy ticks rolled the ring.
+
+The Chrome export is deterministic by construction: stable span ordering
+(insertion order inside monotonically-numbered traces), timeline-clock
+timestamps only, `sort_keys` JSON — two loadgen replays of the same
+scenario diff clean (hack/verify.sh gates on exactly that).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional
+
+from autoscaler_tpu.trace.tracer import TickTrace
+
+
+class FlightRecorder:
+    """Thread-safe ring of TickTraces + a bounded pinned set."""
+
+    def __init__(self, capacity: int = 64, pinned_capacity: int = 16):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(int(capacity), 1))
+        self._pinned: "OrderedDict[int, TickTrace]" = OrderedDict()
+        self._pinned_capacity = max(int(pinned_capacity), 1)
+
+    def add(self, trace: TickTrace, pin: bool = False) -> None:
+        with self._lock:
+            self._ring.append(trace)
+            if pin:
+                self.pin_locked(trace)
+
+    def pin_locked(self, trace: TickTrace) -> None:
+        trace.pinned = True
+        self._pinned[trace.trace_id] = trace
+        while len(self._pinned) > self._pinned_capacity:
+            _, evicted = self._pinned.popitem(last=False)
+            evicted.pinned = False
+
+    def pin(self, trace_id: int) -> bool:
+        with self._lock:
+            trace = self._find(trace_id)
+            if trace is None:
+                return False
+            self.pin_locked(trace)
+            return True
+
+    def _find(self, trace_id: int) -> Optional[TickTrace]:
+        if trace_id in self._pinned:
+            return self._pinned[trace_id]
+        for t in self._ring:
+            if t.trace_id == trace_id:
+                return t
+        return None
+
+    def traces(self) -> List[TickTrace]:
+        """Ring ∪ pinned, deduped, ordered by trace id."""
+        with self._lock:
+            by_id: Dict[int, TickTrace] = {t.trace_id: t for t in self._ring}
+            by_id.update(self._pinned)
+            return [by_id[k] for k in sorted(by_id)]
+
+    def get(self, trace_id: int) -> Optional[TickTrace]:
+        with self._lock:
+            return self._find(trace_id)
+
+    def summaries(self) -> List[Dict[str, Any]]:
+        return [t.summary() for t in self.traces()]
+
+    # -- exports --------------------------------------------------------------
+    def list_json(self) -> str:
+        return _stable_json({"traces": self.summaries()})
+
+    def detail_json(self, trace_id: int) -> Optional[str]:
+        trace = self.get(trace_id)
+        return _stable_json(trace.to_dict()) if trace is not None else None
+
+    def chrome(self, trace_id: Optional[int] = None) -> Optional[str]:
+        """Chrome-trace ("Trace Event Format") JSON that loads in Perfetto /
+        chrome://tracing. One process track per tick (pid = trace id), spans
+        as complete ("X") events, span events as instants ("i")."""
+        if trace_id is not None:
+            trace = self.get(trace_id)
+            if trace is None:
+                return None
+            traces = [trace]
+        else:
+            traces = self.traces()
+        return _stable_json(chrome_trace_doc(traces))
+
+
+def chrome_trace_doc(traces: List[TickTrace]) -> Dict[str, Any]:
+    """Convert TickTraces to one Trace-Event-Format document. Timestamps
+    are timeline-clock microseconds relative to the first exported root —
+    deterministic whenever the clock is."""
+    events: List[Dict[str, Any]] = []
+    base = None
+    for t in traces:
+        if t.root is not None:
+            base = t.root.start
+            break
+    base = base or 0.0
+
+    def us(ts: float) -> int:
+        return int(round((ts - base) * 1e6))
+
+    for t in traces:
+        pid = t.trace_id
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": f"tick {t.trace_id}"},
+            }
+        )
+        for sp in t.spans:
+            end = sp.end if sp.end is not None else sp.start
+            events.append(
+                {
+                    "name": sp.name,
+                    "cat": "autoscaler",
+                    "ph": "X",
+                    "pid": pid,
+                    "tid": 0,
+                    "ts": us(sp.start),
+                    "dur": max(us(end) - us(sp.start), 0),
+                    "args": {
+                        "span_id": sp.span_id,
+                        "parent_id": sp.parent_id,
+                        **_jsonable(sp.attrs),
+                    },
+                }
+            )
+            for ev in sp.events:
+                events.append(
+                    {
+                        "name": ev["name"],
+                        "cat": "autoscaler",
+                        "ph": "i",
+                        "s": "t",
+                        "pid": pid,
+                        "tid": 0,
+                        "ts": us(ev.get("ts", sp.start)),
+                        "args": {
+                            "span_id": sp.span_id,
+                            **_jsonable(ev.get("attrs", {})),
+                        },
+                    }
+                )
+    return {"displayTimeUnit": "ms", "traceEvents": events}
+
+
+def _jsonable(attrs: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in attrs.items():
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            out[k] = v
+        else:
+            out[k] = str(v)
+    return out
+
+
+def _stable_json(doc: Any) -> str:
+    # default=str: an exotic attribute value must degrade to its repr, not
+    # take down the /tracez handler
+    return (
+        json.dumps(doc, sort_keys=True, separators=(",", ":"), default=str)
+        + "\n"
+    )

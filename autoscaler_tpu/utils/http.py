@@ -44,6 +44,15 @@ class RetryPolicy:
         return exp * (0.5 + 0.5 * self.rng())
 
 
+def _trace_retry(attempt: int, **attrs) -> None:
+    """Stamp a retry on the enclosing request's trace span (no-op outside a
+    tick trace): a backoff storm must be attributable to the phase that
+    issued the request, not just a counter somewhere."""
+    from autoscaler_tpu import trace
+
+    trace.add_event("http.retry", attempt=attempt, **attrs)
+
+
 def _retry_after_seconds(headers) -> Optional[float]:
     try:
         value = headers.get("Retry-After") if headers is not None else None
@@ -96,6 +105,7 @@ def json_request(
             detail = e.read().decode(errors="replace")[:512]
             transient = e.code == 429 or e.code >= 500
             if retry is not None and transient and attempt < attempts:
+                _trace_retry(attempt, status=e.code)
                 retry.sleep(
                     retry.backoff_s(attempt, _retry_after_seconds(e.headers))
                 )
@@ -109,6 +119,7 @@ def json_request(
             # fast transport errors (refused, DNS, reset) retry.
             timed_out = isinstance(e.reason, TimeoutError)
             if retry is not None and attempt < attempts and not timed_out:
+                _trace_retry(attempt, error=type(e.reason).__name__)
                 retry.sleep(retry.backoff_s(attempt, None))
                 continue
             raise on_error(0, str(e.reason)) from None
@@ -118,6 +129,7 @@ def json_request(
                 and attempt < attempts
                 and not isinstance(e, TimeoutError)
             ):
+                _trace_retry(attempt, error=type(e).__name__)
                 retry.sleep(retry.backoff_s(attempt, None))
                 continue
             raise on_error(0, str(e)) from None

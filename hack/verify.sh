@@ -30,6 +30,29 @@ for scenario in benchmarks/scenarios/*.json; do
     python -m autoscaler_tpu.loadgen validate "$scenario"
 done
 
+echo "== trace-schema determinism check (two replays must export byte-identical Chrome traces) =="
+trace_tmp=$(mktemp -d)
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/kernel_fault_ladder.json \
+    --chrome-trace "$trace_tmp/a.json" >/dev/null
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/kernel_fault_ladder.json \
+    --chrome-trace "$trace_tmp/b.json" >/dev/null
+if ! diff -q "$trace_tmp/a.json" "$trace_tmp/b.json" >/dev/null; then
+    echo "ERROR: trace export is nondeterministic across identical replays:" >&2
+    diff "$trace_tmp/a.json" "$trace_tmp/b.json" | head -20 >&2
+    exit 1
+fi
+python - "$trace_tmp/a.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+events = doc["traceEvents"]
+assert events, "empty chrome trace"
+names = {e["name"] for e in events}
+for required in ("main", "estimate", "deviceDispatch", "buildSnapshot"):
+    assert required in names, f"trace schema missing {required!r} spans"
+print(f"trace determinism ok ({len(events)} events)")
+EOF
+rm -rf "$trace_tmp"
+
 echo "== unit tests (8-device virtual CPU mesh) =="
 python -m pytest tests/ -q -x
 

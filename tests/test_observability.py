@@ -56,6 +56,85 @@ class TestMetrics:
         assert elapsed >= 0
         assert m.function_duration.count(function="main") == 1
 
+    def test_label_value_escaping(self):
+        """Prometheus text-format regression: `"` `\\` and newline in label
+        values must be escaped per the spec or the exposition corrupts."""
+        r = MetricsRegistry()
+        c = r.counter("esc_total")
+        c.inc(1, pod='say "hi"', path="a\\b", msg="line1\nline2")
+        text = r.expose()
+        line = next(l for l in text.splitlines() if l.startswith("esc_total{"))
+        assert 'pod="say \\"hi\\""' in line
+        assert 'path="a\\\\b"' in line
+        assert 'msg="line1\\nline2"' in line
+        # exactly one physical line: the raw newline must not split it
+        assert sum(1 for l in text.splitlines() if "esc_total{" in l) == 1
+
+    def test_summary_window_is_bounded_deque(self):
+        from collections import deque
+
+        from autoscaler_tpu.metrics.metrics import Summary
+
+        s = MetricsRegistry().summary("win_seconds")
+        for i in range(Summary.WINDOW + 100):
+            s.observe(float(i))
+        state = s.states[()]
+        assert isinstance(state.recent, deque)
+        assert len(state.recent) == Summary.WINDOW
+        # oldest 100 evicted: the window holds the most recent values
+        assert state.recent[0] == 100.0
+        assert state.count == Summary.WINDOW + 100  # count is lifetime
+        assert s.quantile(1.0) == float(Summary.WINDOW + 99)
+
+    def test_summary_observe_races_expose(self):
+        """The /metrics scrape path (expose → quantile → sorted(recent))
+        runs on server threads while the loop observes; iterating a deque
+        mid-append raises 'deque mutated during iteration' without the
+        window lock."""
+        import threading
+
+        r = MetricsRegistry()
+        s = r.summary("race_seconds")
+        from autoscaler_tpu.metrics.metrics import Summary
+
+        for i in range(Summary.WINDOW):  # full window: appends now evict
+            s.observe(float(i))
+        stop = threading.Event()
+        errors = []
+
+        # counters/summaries gaining NEW label keys mid-scrape resize the
+        # series dicts the renderer iterates — also covered by the locks.
+        # Key space bounded (a scrape renders every key, so unbounded
+        # growth would make the test quadratic, not the code racy).
+        c = r.counter("race_total")
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                s.observe(float(i), shard=str(i % 7))
+                c.inc(1, key=f"k{i % 101}")
+                i += 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            for _ in range(200):
+                text = r.expose()
+                assert "race_seconds_count" in text
+        except Exception as e:  # noqa: BLE001 — the race under test
+            errors.append(e)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert not errors
+
+    def test_observe_duration_value_choke_point(self):
+        m = AutoscalerMetrics(MetricsRegistry())
+        m.observe_duration_value("scaleUp", 0.25)
+        assert m.function_duration.count(function="scaleUp") == 1
+        assert m.function_duration.quantile(0.5, function="scaleUp") == 0.25
+        assert m.function_duration_quantile.count(function="scaleUp") == 1
+
 
 class TestHealthCheck:
     def test_inactivity(self):
@@ -118,6 +197,31 @@ class TestStatusAndDebugging:
         data = json.loads(payload)
         assert data["node_count"] == 1
         assert data["templates"][0]["group"] == "g"
+
+    def test_last_activity_updated_per_activity(self):
+        """The last_activity gauge is wired per activity label from
+        run_once: main every loop, scaleUp/scaleDown when their branches
+        run (it used to be registered but never updated on scale-down)."""
+        a = make_autoscaler(
+            [
+                build_test_pod("blocker", cpu_m=800, node_name="g-0"),
+                build_test_pod("p", cpu_m=900, mem=1 * GB),
+            ]
+        )
+        a.run_once(now_ts=123.0)
+        m = a.metrics
+        assert m.last_activity.get(activity="main") == 123.0
+        assert m.last_activity.get(activity="scaleUp") == 123.0
+        assert m.last_activity.get(activity="scaleDown") == 123.0
+
+    def test_last_activity_scale_down_disabled(self):
+        a = make_autoscaler(scale_down_enabled=False)
+        a.run_once(now_ts=5.0)
+        m = a.metrics
+        assert m.last_activity.get(activity="main") == 5.0
+        # no pending pods, scale-down off: neither branch stamped
+        assert m.last_activity.get(activity="scaleUp") == 0.0
+        assert m.last_activity.get(activity="scaleDown") == 0.0
 
     def test_debugging_tensor_dump(self, tmp_path):
         import numpy as np
@@ -477,6 +581,77 @@ class TestDebuggingCouldSchedule:
         assert "default/huge" not in data["unscheduled_pods_can_be_scheduled"]
         assert "default/huge" in data["pending_pods"]
         assert "default/huge" not in data["pending_pods_fitting_free_capacity"]
+
+
+class TestDebuggingSnapshotterConcurrency:
+    """ISSUE 3 satellite: /snapshotz requests race capture() mid-tick (the
+    HTTP handler runs on server threads while the loop captures), and the
+    payload must be stable for a zero-node snapshot."""
+
+    def test_request_and_get_race_capture(self):
+        import threading
+
+        from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+        from autoscaler_tpu.utils.test_utils import build_test_node
+
+        a = make_autoscaler()
+        snap = ClusterSnapshot()
+        snap.add_node(build_test_node("n0", cpu_m=1000, mem=2 * GB))
+        from autoscaler_tpu.core.static_autoscaler import RunOnceResult
+
+        result = RunOnceResult()
+        stop = threading.Event()
+        errors = []
+
+        def hammer():
+            # the /snapshotz handler's exact sequence: request() then get()
+            while not stop.is_set():
+                try:
+                    a.debugger.request()
+                    payload = a.debugger.get()
+                    if payload is not None:
+                        json.loads(payload)
+                except Exception as e:  # noqa: BLE001 — fail the test below
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(50):
+                a.debugger.capture(a, snap, [], result)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        assert not errors
+        # armed by the hammer threads: one more capture must produce a
+        # coherent payload
+        a.debugger.request()
+        a.debugger.capture(a, snap, [], result)
+        data = json.loads(a.debugger.get())
+        assert data["node_count"] == 1
+
+    def test_zero_node_snapshot_payload_stable(self):
+        from autoscaler_tpu.core.static_autoscaler import RunOnceResult
+        from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+
+        a = make_autoscaler()
+        empty = ClusterSnapshot()
+        a.debugger.request()
+        a.debugger.capture(a, empty, [], RunOnceResult())
+        data = json.loads(a.debugger.get())
+        assert data["node_count"] == 0
+        assert data["nodes"] == []
+        assert data["pending_pods"] == []
+        # schema stays intact (tensor_shapes always an object)
+        assert "mask" in data["tensor_shapes"]
+        # a second zero-node capture yields the same stable payload shape
+        a.debugger.request()
+        a.debugger.capture(a, empty, [], RunOnceResult())
+        again = json.loads(a.debugger.get())
+        assert set(again) == set(data)
 
 
 class TestEstimationEnvelope:
