@@ -1,0 +1,25 @@
+"""graftlint — AST invariant checker for the autoscaler's contracts.
+
+Dependency-free (stdlib ``ast``/``tokenize`` only). The engine parses each
+file once and dispatches to every rule; findings are suppressed inline with
+``# graftlint: disable=RULE — reason`` or grandfathered in
+``hack/lint-baseline.json``. ``hack/verify.sh`` runs it as a fatal gate.
+
+See ``RULES.md`` (this directory) for the rule catalog and etiquette.
+"""
+from autoscaler_tpu.analysis.engine import (
+    Finding,
+    check_source,
+    scan_file,
+    scan_paths,
+)
+from autoscaler_tpu.analysis.rules import ALL_RULES, RULE_CATALOG
+
+__all__ = [
+    "ALL_RULES",
+    "Finding",
+    "RULE_CATALOG",
+    "check_source",
+    "scan_file",
+    "scan_paths",
+]

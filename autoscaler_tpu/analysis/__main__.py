@@ -1,0 +1,6 @@
+"""``python -m autoscaler_tpu.analysis`` entry point."""
+import sys
+
+from autoscaler_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,186 @@
+"""graftlint CLI.
+
+Usage::
+
+    python -m autoscaler_tpu.analysis [paths...]
+        [--baseline FILE] [--no-baseline] [--update-baseline] [--list-rules]
+
+Default paths: ``autoscaler_tpu`` under the current directory. The baseline
+defaults to ``hack/lint-baseline.json`` discovered by walking up from the
+current directory (``--no-baseline`` disables, ``--baseline`` overrides).
+Exit status: 0 clean, 1 findings or stale baseline entries, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from autoscaler_tpu.analysis import baseline as baseline_mod
+from autoscaler_tpu.analysis.engine import (
+    display_path,
+    iter_python_files,
+    scan_paths,
+)
+from autoscaler_tpu.analysis.rules import RULE_CATALOG
+
+BASELINE_RELPATH = Path("hack") / "lint-baseline.json"
+
+
+def scan_scope(paths: List[str], files: List[str]):
+    """→ predicate over baseline display paths: is this entry inside what
+    THIS run scanned? Directory arguments contribute a subtree prefix (so
+    an entry for a since-DELETED file under a scanned directory still
+    counts as in scope and is correctly reported stale); file arguments
+    contribute themselves. Entries outside the scope are neither judged
+    stale nor struck by --update-baseline."""
+    scanned_files = {display_path(f) for f in files}
+    prefixes = [
+        # display_path needs a file-shaped path: derive the directory's
+        # display prefix from a probe filename inside it
+        display_path((Path(p) / "_.py").as_posix())[: -len("_.py")]
+        for p in paths
+        if Path(p).is_dir()
+    ]
+
+    def in_scope(display: str) -> bool:
+        return display in scanned_files or any(
+            display.startswith(pre) for pre in prefixes
+        )
+
+    return in_scope
+
+
+def discover_baseline(start: Optional[Path] = None) -> Optional[Path]:
+    here = (start or Path.cwd()).resolve()
+    for candidate in (here, *here.parents):
+        p = candidate / BASELINE_RELPATH
+        if p.is_file():
+            return p
+    return None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description=(
+            "AST invariant checker: determinism (GL001), span taxonomy "
+            "(GL002), ladder bypass (GL003), lock discipline (GL004), "
+            "error boundaries (GL005), jit purity (GL006). See "
+            "autoscaler_tpu/analysis/RULES.md."
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories to scan (default: ./autoscaler_tpu)",
+    )
+    parser.add_argument(
+        "--baseline",
+        metavar="FILE",
+        help="baseline JSON (default: nearest hack/lint-baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, ignoring any baseline",
+    )
+    parser.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline from the current findings and exit 0",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, title in sorted(RULE_CATALOG.items()):
+            print(f"{rule_id}  {title}")
+        return 0
+
+    if args.no_baseline and args.update_baseline:
+        print(
+            "graftlint: --no-baseline and --update-baseline are "
+            "contradictory (ignore the ledger vs rewrite it)",
+            file=sys.stderr,
+        )
+        return 2
+
+    paths = args.paths or ["autoscaler_tpu"]
+    missing = [p for p in paths if not Path(p).exists()]
+    if missing:
+        print(f"graftlint: no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+    files = iter_python_files(paths)
+    if not files:
+        print("graftlint: no python files under given paths", file=sys.stderr)
+        return 2
+    findings = scan_paths(paths)
+
+    baseline_path: Optional[Path] = None
+    if not args.no_baseline:
+        if args.baseline:
+            baseline_path = Path(args.baseline)
+            if not args.update_baseline and not baseline_path.is_file():
+                # a typo'd --baseline must not silently degrade to "no
+                # baseline" and report every grandfathered finding as new
+                print(
+                    f"graftlint: baseline file not found: {baseline_path}",
+                    file=sys.stderr,
+                )
+                return 2
+        else:
+            baseline_path = discover_baseline()
+
+    if args.update_baseline:
+        if baseline_path is None:
+            baseline_path = Path.cwd() / BASELINE_RELPATH
+        baseline_path.parent.mkdir(parents=True, exist_ok=True)
+        preserve = {}
+        if baseline_path.is_file():
+            in_scope = scan_scope(paths, files)
+            preserve = {
+                fp: c
+                for fp, c in baseline_mod.load(str(baseline_path)).items()
+                if not in_scope(fp[0])
+            }
+        entries = baseline_mod.save(str(baseline_path), findings, preserve)
+        print(
+            f"graftlint: baseline rewritten: {entries} entr"
+            f"{'y' if entries == 1 else 'ies'} "
+            f"({len(findings)} finding(s)) -> {baseline_path}",
+            file=sys.stderr,
+        )
+        return 0
+
+    baselined = {}
+    if baseline_path is not None and baseline_path.is_file():
+        baselined = baseline_mod.load(str(baseline_path))
+        # staleness is only judged inside this run's scan scope: a partial
+        # scan (one file, one subtree) must not read the unscanned
+        # remainder of the ledger as "findings that no longer exist" —
+        # but an entry for a deleted file UNDER a scanned directory is in
+        # scope and correctly reads as stale
+        in_scope = scan_scope(paths, files)
+        baselined = {fp: c for fp, c in baselined.items() if in_scope(fp[0])}
+    new, stale = baseline_mod.diff(findings, baselined)
+
+    for f in new:
+        print(f.render())
+    for s in stale:
+        print(f"stale baseline entry: {s}")
+    grandfathered = len(findings) - len(new)
+    status = (
+        f"graftlint: {len(files)} file(s), {len(new)} finding(s), "
+        f"{grandfathered} baselined, {len(stale)} stale baseline entr"
+        f"{'y' if len(stale) == 1 else 'ies'}"
+    )
+    print(status, file=sys.stderr)
+    return 1 if new or stale else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,290 @@
+"""graftlint engine: one parse per file, many checkers.
+
+The autoscaler's headline guarantees are *invariants*, not behaviors a unit
+test can pin: byte-identical scenario replay requires every time/randomness
+source in the run_once path to flow through an injected seam; "traces and
+metrics cannot disagree" requires every span name to be a FunctionLabel;
+the degradation ladder only protects the loop if nothing dispatches a
+kernel around it. One careless ``time.time()`` silently voids those
+contracts until a flaky CI diff catches it. This package polices them
+mechanically, at the AST level, with zero third-party dependencies.
+
+Architecture:
+
+- :class:`FileModel` is built ONCE per file (one ``ast.parse``, one
+  ``tokenize`` pass for suppression pragmas, one import-alias map) and
+  handed to every rule — single parse, many checkers.
+- Rules live in :mod:`autoscaler_tpu.analysis.rules`; each is a small
+  class with a ``check(model) -> list[Finding]`` method. Rules scope
+  themselves to module subsets via :meth:`FileModel.in_module` (paths
+  relative to the ``autoscaler_tpu`` package root).
+- Findings are suppressed inline with
+  ``# graftlint: disable=RULE[,RULE] — reason`` on the offending line or
+  on a comment-only line directly above it. A pragma without a reason is
+  itself a finding (GL000) — suppressions are part of the audit surface.
+- Grandfathered findings live in a checked-in baseline
+  (``hack/lint-baseline.json``, see :mod:`autoscaler_tpu.analysis.baseline`);
+  the CLI exits nonzero on any non-baselined finding AND on stale baseline
+  entries, so the debt ledger can only shrink.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+PACKAGE_DIR_NAME = "autoscaler_tpu"
+
+# `# graftlint: disable=GL001,GL004 — reason` (reason separator: any dash
+# family or a colon; the reason itself is mandatory — enforced as GL000)
+PRAGMA_RE = re.compile(
+    r"graftlint:\s*disable=([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+    r"(?:\s*(?:[—–:-]|--)\s*(?P<reason>\S.*))?"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation. ``fingerprint`` (path, rule, message — no line
+    number) keys the baseline, so mere line drift doesn't churn it."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    @property
+    def fingerprint(self) -> Tuple[str, str, str]:
+        return (self.path, self.rule, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def sort_key(self) -> Tuple[str, int, str, str]:
+        return (self.path, self.line, self.rule, self.message)
+
+
+def display_path(path: str) -> str:
+    """Normalize a filesystem path to the stable form findings report:
+    ``autoscaler_tpu/<...>`` when the file sits under an ``autoscaler_tpu``
+    directory (invocation-directory independent — the baseline relies on
+    this), the given path (posixified) otherwise."""
+    parts = Path(path).as_posix().split("/")
+    for i in range(len(parts) - 1, -1, -1):
+        if parts[i] == PACKAGE_DIR_NAME and i < len(parts) - 1:
+            return "/".join(parts[i:])
+    return Path(path).as_posix()
+
+
+def module_path(path: str) -> Optional[str]:
+    """Path relative to the ``autoscaler_tpu`` package root (``core/x.py``),
+    or None for files outside the package. Rules scope on this."""
+    disp = display_path(path)
+    prefix = PACKAGE_DIR_NAME + "/"
+    return disp[len(prefix):] if disp.startswith(prefix) else None
+
+
+def _import_map(tree: ast.AST) -> Dict[str, str]:
+    """local name -> fully qualified dotted origin, e.g.
+    ``{"np": "numpy", "mono": "time.monotonic", "trace": "autoscaler_tpu.trace"}``.
+    Used to resolve call chains regardless of aliasing."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    out[alias.asname] = alias.name
+                else:
+                    head = alias.name.split(".")[0]
+                    out[head] = head
+        elif isinstance(node, ast.ImportFrom):
+            base = ("." * node.level) + (node.module or "")
+            for alias in node.names:
+                local = alias.asname or alias.name
+                out[local] = f"{base}.{alias.name}" if base else alias.name
+    return out
+
+
+class FileModel:
+    """Everything the rules need about one file, computed once."""
+
+    def __init__(self, path: str, source: str):
+        self.path = display_path(path)
+        self.module = module_path(path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self.imports = _import_map(self.tree)
+
+    def in_module(self, *prefixes: str) -> bool:
+        """Is this file inside any of the given package-relative scopes?
+        A prefix ending in ``/`` matches a directory subtree; otherwise an
+        exact module file."""
+        if self.module is None:
+            return False
+        return any(
+            self.module.startswith(p) if p.endswith("/") else self.module == p
+            for p in prefixes
+        )
+
+    def dotted(self, node: ast.AST, resolve: bool = True) -> Optional[str]:
+        """Dotted name of a Name/Attribute chain; with ``resolve`` the
+        leading segment is mapped through this file's imports
+        (``np.random.default_rng`` → ``numpy.random.default_rng``). None
+        for non-name expressions (calls on call results, subscripts, …)."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        if resolve:
+            parts[0] = self.imports.get(parts[0], parts[0])
+        return ".".join(parts)
+
+    def qualname(self, node: ast.AST) -> Optional[str]:
+        return self.dotted(node, resolve=True)
+
+    def is_imported(self, node: ast.AST) -> bool:
+        """True when the chain's head name was bound by an import in this
+        file — distinguishes the module ``time`` from a local/parameter
+        that happens to be named ``time`` (the injected-seam shape)."""
+        head = self.dotted(node, resolve=False)
+        return head is not None and head.split(".")[0] in self.imports
+
+    def finding(self, node: ast.AST, rule: str, message: str) -> Finding:
+        return Finding(
+            path=self.path,
+            line=getattr(node, "lineno", 1),
+            rule=rule,
+            message=message,
+        )
+
+
+def parse_pragmas(
+    source: str, path: str
+) -> Tuple[Dict[int, Set[str]], List[Finding]]:
+    """Scan COMMENT tokens (never string literals) for suppression pragmas.
+    Returns {line: {rules}} plus GL000 findings for pragmas missing the
+    mandatory reason."""
+    pragmas: Dict[int, Set[str]] = {}
+    findings: List[Finding] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        comments = [
+            (tok.start[0], tok.string)
+            for tok in tokens
+            if tok.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, IndentationError):
+        return pragmas, findings
+    for line, text in comments:
+        m = PRAGMA_RE.search(text)
+        if m is None:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        pragmas.setdefault(line, set()).update(rules)
+        if not m.group("reason"):
+            findings.append(
+                Finding(
+                    path=display_path(path),
+                    line=line,
+                    rule="GL000",
+                    message=(
+                        "suppression pragma missing its reason "
+                        "(`# graftlint: disable=RULE — why this is safe`)"
+                    ),
+                )
+            )
+    return pragmas, findings
+
+
+def _suppressed(
+    finding: Finding, pragmas: Dict[int, Set[str]], lines: List[str]
+) -> bool:
+    """A pragma suppresses findings on its own line, or — when it sits on a
+    comment-only line — on the line directly below (for statements too long
+    to carry an inline comment)."""
+    same = pragmas.get(finding.line)
+    if same and finding.rule in same:
+        return True
+    above = pragmas.get(finding.line - 1)
+    if above and finding.rule in above:
+        idx = finding.line - 2  # 0-based index of the pragma line
+        if 0 <= idx < len(lines) and lines[idx].lstrip().startswith("#"):
+            return True
+    return False
+
+
+def check_source(
+    source: str, path: str, rules: Optional[Sequence] = None
+) -> List[Finding]:
+    """Run every rule over one file's source. ``path`` drives rule scoping
+    (it need not exist on disk — fixture tests pass virtual
+    ``autoscaler_tpu/...`` paths)."""
+    if rules is None:
+        from autoscaler_tpu.analysis.rules import ALL_RULES
+
+        rules = ALL_RULES
+    pragmas, findings = parse_pragmas(source, path)
+    try:
+        model = FileModel(path, source)
+    except (SyntaxError, ValueError) as e:
+        # ValueError: ast.parse refuses NUL bytes — one corrupt file must
+        # degrade to a finding, not abort the whole scan
+        return [
+            Finding(
+                path=display_path(path),
+                line=getattr(e, "lineno", None) or 1,
+                rule="GL000",
+                message=(
+                    f"file does not parse: {getattr(e, 'msg', None) or e}"
+                ),
+            )
+        ]
+    for rule in rules:
+        findings.extend(rule.check(model))
+    # GL000 (pragma hygiene / parse failure) is deliberately unsuppressible:
+    # a reasonless pragma that lists GL000 alongside the rule it silences
+    # must not be able to waive the mandatory-reason contract it violates
+    findings = [
+        f
+        for f in findings
+        if f.rule == "GL000" or not _suppressed(f, pragmas, model.lines)
+    ]
+    return sorted(findings, key=Finding.sort_key)
+
+
+def scan_file(path: str, rules: Optional[Sequence] = None) -> List[Finding]:
+    source = Path(path).read_text(encoding="utf-8")
+    return check_source(source, path, rules)
+
+
+def iter_python_files(paths: Iterable[str]) -> List[str]:
+    """Expand files/directories to a sorted, deduped .py file list
+    (``__pycache__`` excluded) — deterministic scan order."""
+    out: Set[str] = set()
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            for f in path.rglob("*.py"):
+                if "__pycache__" not in f.parts:
+                    out.add(f.as_posix())
+        elif path.suffix == ".py":
+            out.add(path.as_posix())
+    return sorted(out)
+
+
+def scan_paths(
+    paths: Iterable[str], rules: Optional[Sequence] = None
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in iter_python_files(paths):
+        findings.extend(scan_file(f, rules))
+    return sorted(findings, key=Finding.sort_key)

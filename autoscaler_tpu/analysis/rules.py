@@ -1,0 +1,558 @@
+"""graftlint rules GL001–GL006 — each derived from an invariant the
+codebase already claims. See RULES.md (same directory) for the catalog,
+rationale, and suppression etiquette.
+
+Every rule is a small class: ``rule_id``, ``title``, and
+``check(model: FileModel) -> list[Finding]``. Rules walk the one shared
+AST; nothing here imports beyond the stdlib.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set
+
+from autoscaler_tpu.analysis.engine import FileModel, Finding
+
+# -- shared helpers -----------------------------------------------------------
+
+
+def _terminal_name(func: ast.AST) -> Optional[str]:
+    """Last segment of a call target: ``a.b.c(...)`` → ``c``, ``f(...)`` → ``f``."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _enclosing_functions(tree: ast.AST) -> Dict[ast.AST, str]:
+    """node -> dotted INNERMOST enclosing scope (``Class.method``), for
+    stable finding messages that survive line drift."""
+    out: Dict[ast.AST, str] = {}
+
+    def walk(node: ast.AST, stack: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if stack:
+                out[child] = ".".join(stack)
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                walk(child, stack + [child.name])
+            else:
+                walk(child, stack)
+
+    walk(tree, [])
+    return out
+
+
+# -- GL001: wall clock / ambient randomness in the replay path ----------------
+
+REPLAY_SCOPES = (
+    "core/",
+    "estimator/",
+    "loadgen/",
+    "trace/",
+    "snapshot/",
+    "clusterstate/",
+    "expander/",
+    "debugging.py",
+)
+
+# fully qualified (import-alias-resolved) callables that read ambient
+# wall-clock or entropy. `time.perf_counter` is deliberately absent: it is
+# the sanctioned wall-measurement clock (tracer wall_s, metrics), never a
+# timeline input. A bare *reference* (e.g. `clock: Callable = time.monotonic`
+# as an injectable parameter default) is not a Call and never flags — that
+# IS the sanctioned seam shape.
+_GL001_BANNED = {
+    "time.time",
+    "time.time_ns",
+    "time.monotonic",
+    "time.monotonic_ns",
+    "time.sleep",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.datetime.today",
+    "datetime.date.today",
+    "os.urandom",
+    "uuid.uuid1",
+    "uuid.uuid4",
+}
+# random.Random(seed) builds an *injectable* generator — allowed; every
+# module-level `random.*` function rides the shared ambient state — banned.
+_RANDOM_OK = {"Random"}
+# numpy: seeded construction allowed, legacy ambient-state functions banned.
+_NP_RANDOM_OK = {"default_rng", "Generator", "SeedSequence", "MT19937", "PCG64", "Philox"}
+
+
+class WallClockInReplayPath:
+    rule_id = "GL001"
+    title = "wall-clock or ambient randomness in a replay-reachable module"
+
+    def check(self, model: FileModel) -> List[Finding]:
+        if not model.in_module(*REPLAY_SCOPES):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(model.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = model.qualname(node.func)
+            if q is None:
+                continue
+            # only chains whose head was actually IMPORTED: a parameter
+            # named `random`/`time` is an injected seam, not the module
+            if not model.is_imported(node.func):
+                continue
+            bad = None
+            if q in _GL001_BANNED:
+                bad = q
+            elif q.startswith("random.") and q.split(".")[1] not in _RANDOM_OK:
+                bad = q
+            elif (
+                q.startswith("numpy.random.")
+                and q.split(".")[2] not in _NP_RANDOM_OK
+            ):
+                bad = q
+            if bad is not None:
+                out.append(
+                    model.finding(
+                        node,
+                        self.rule_id,
+                        f"{bad}() in a replay-reachable module breaks "
+                        "byte-identical scenario replay; take a clock/rng "
+                        "through an injected parameter or trace.timeline_now()",
+                    )
+                )
+        return out
+
+
+# -- GL002: span names must come from the FunctionLabel taxonomy --------------
+
+_TAXONOMY_FILE = Path(__file__).resolve().parent.parent / "metrics" / "metrics.py"
+_SPAN_CALLEES = {"span", "start_span", "tick"}
+
+
+def function_label_taxonomy() -> Set[str]:
+    """The FunctionLabel vocabulary: module-level UPPERCASE string constants
+    of metrics/metrics.py, extracted by AST (never imported/executed) so the
+    linter stays runnable anywhere the package source is."""
+    try:
+        tree = ast.parse(_TAXONOMY_FILE.read_text(encoding="utf-8"))
+    except (OSError, SyntaxError):
+        return set()
+    labels: Set[str] = set()
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not all(
+            isinstance(t, ast.Name) and t.id.isupper() for t in node.targets
+        ):
+            continue
+        if isinstance(node.value, ast.Constant) and isinstance(node.value.value, str):
+            labels.add(node.value.value)
+    return labels
+
+
+class SpanNameTaxonomy:
+    rule_id = "GL002"
+    title = "span name literal outside the FunctionLabel taxonomy"
+
+    def __init__(self) -> None:
+        self._taxonomy: Optional[Set[str]] = None
+
+    @property
+    def taxonomy(self) -> Set[str]:
+        if self._taxonomy is None:
+            self._taxonomy = function_label_taxonomy()
+        return self._taxonomy
+
+    def check(self, model: FileModel) -> List[Finding]:
+        if not self.taxonomy:
+            return []  # taxonomy source unavailable: cannot judge
+        out: List[Finding] = []
+        for node in ast.walk(model.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            term = _terminal_name(node.func)
+            if term not in _SPAN_CALLEES:
+                continue
+            # only tracer receivers: `trace.span`, `self.tracer.tick`, or a
+            # name imported from the trace package — re.Match.span("group")
+            # and friends must not flag
+            q = model.qualname(node.func) or ""
+            if "trace" not in q.lower():
+                continue
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+                continue  # taxonomy constants arrive as attributes, not literals
+            if first.value not in self.taxonomy:
+                out.append(
+                    model.finding(
+                        node,
+                        self.rule_id,
+                        f'span name "{first.value}" is not a FunctionLabel '
+                        "(metrics/metrics.py); traces and "
+                        "function_duration_seconds share ONE vocabulary — "
+                        "add the label there or reuse an existing one",
+                    )
+                )
+        return out
+
+
+# -- GL003: kernel dispatch must go through the estimator ladder --------------
+
+
+class LadderBypass:
+    rule_id = "GL003"
+    title = "kernel dispatch outside the estimator degradation ladder"
+
+    def check(self, model: FileModel) -> List[Finding]:
+        if model.module is None:
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(model.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            term = _terminal_name(node.func)
+            if term is None:
+                continue
+            if term == "pallas_call" and not model.in_module("ops/"):
+                out.append(
+                    model.finding(
+                        node,
+                        self.rule_id,
+                        "pallas_call outside ops/ — kernels are defined in "
+                        "ops/ and dispatched only through "
+                        "estimator/binpacking._walk_ladder",
+                    )
+                )
+            elif term.startswith("ffd_binpack") and not model.in_module(
+                "ops/", "estimator/", "native_bridge.py"
+            ):
+                out.append(
+                    model.finding(
+                        node,
+                        self.rule_id,
+                        f"direct kernel dispatch {term}() bypasses the "
+                        "circuit-broken ladder "
+                        "(estimator/binpacking._walk_ladder); a rung fault "
+                        "here would crash the caller instead of degrading",
+                    )
+                )
+        return out
+
+
+# -- GL004: lock discipline in threaded modules -------------------------------
+
+THREADED_SCOPES = ("metrics/", "trace/recorder.py", "utils/circuit.py", "kube/client.py")
+
+
+def _is_lock_attr(name: str) -> bool:
+    return name.startswith("_") and name.endswith("lock")
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self._x`` → ``_x`` (the attribute written), unwrapping subscripts:
+    ``self._items[k] = v`` writes through ``_items``."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class LockDiscipline:
+    rule_id = "GL004"
+    title = "write to guarded state outside the instance lock"
+
+    def check(self, model: FileModel) -> List[Finding]:
+        if not model.in_module(*THREADED_SCOPES):
+            return []
+        out: List[Finding] = []
+        for cls in ast.walk(model.tree):
+            if isinstance(cls, ast.ClassDef):
+                out.extend(self._check_class(model, cls))
+        return out
+
+    @staticmethod
+    def _own_scope_nodes(cls: ast.ClassDef) -> List[ast.AST]:
+        """All nodes of the class EXCLUDING nested ClassDef subtrees — a
+        nested helper class's ``self._lock`` belongs to the nested class
+        and must not make the enclosing class lock-guarded."""
+        out: List[ast.AST] = []
+        stack: List[ast.AST] = list(cls.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.ClassDef):
+                continue
+            out.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def _check_class(self, model: FileModel, cls: ast.ClassDef) -> List[Finding]:
+        lock_attrs = {
+            attr
+            for node in self._own_scope_nodes(cls)
+            if isinstance(node, (ast.Assign, ast.AnnAssign))
+            for tgt in (node.targets if isinstance(node, ast.Assign) else [node.target])
+            if (attr := _self_attr(tgt)) is not None and _is_lock_attr(attr)
+        }
+        if not lock_attrs:
+            return []
+        out: List[Finding] = []
+        lock_name = sorted(lock_attrs)[0]
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # __init__/__new__ run before the object is shared; *_locked is
+            # the documented caller-holds-the-lock convention
+            if fn.name in ("__init__", "__new__") or fn.name.endswith("_locked"):
+                continue
+            self._walk_fn(model, cls, fn, fn, lock_attrs, lock_name, False, out)
+        return out
+
+    def _walk_fn(
+        self,
+        model: FileModel,
+        cls: ast.ClassDef,
+        fn: ast.AST,
+        node: ast.AST,
+        lock_attrs: Set[str],
+        lock_name: str,
+        locked: bool,
+        out: List[Finding],
+    ) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                continue  # a nested class is its own guarded world
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                # a nested def under `with self._lock:` runs LATER, when the
+                # lock is no longer held — reset, don't inherit
+                self._walk_fn(
+                    model, cls, fn, child, lock_attrs, lock_name, False, out
+                )
+                continue
+            child_locked = locked
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                for item in child.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr is not None and _is_lock_attr(attr):
+                        child_locked = True
+            if not child_locked:
+                targets: List[ast.AST] = []
+                if isinstance(child, ast.Assign):
+                    targets = list(child.targets)
+                elif isinstance(child, ast.AugAssign):
+                    targets = [child.target]
+                elif isinstance(child, ast.AnnAssign):
+                    # a bare `self._x: int` declares, it does not write
+                    if child.value is not None:
+                        targets = [child.target]
+                elif isinstance(child, ast.Delete):
+                    targets = list(child.targets)
+                for tgt in targets:
+                    attr = _self_attr(tgt)
+                    if attr and attr.startswith("_") and not _is_lock_attr(attr):
+                        out.append(
+                            model.finding(
+                                child,
+                                self.rule_id,
+                                f"{cls.name}.{getattr(fn, 'name', '<lambda>')} "
+                                f"writes self.{attr} outside `with "
+                                f"self.{lock_name}:` — guarded state in a "
+                                "threaded module moves only under the lock "
+                                "(or from a *_locked helper)",
+                            )
+                        )
+            self._walk_fn(
+                model, cls, fn, child, lock_attrs, lock_name, child_locked, out
+            )
+
+
+# -- GL005: except-Exception boundaries in the run_once path ------------------
+
+RUN_ONCE_SCOPES = ("core/", "main.py")
+_ROUTERS = {"to_autoscaler_error", "prefixed"}
+
+
+class ErrorBoundary:
+    rule_id = "GL005"
+    title = "except Exception swallowed without typing or re-raise"
+
+    def check(self, model: FileModel) -> List[Finding]:
+        if not model.in_module(*RUN_ONCE_SCOPES):
+            return []
+        owners = _enclosing_functions(model.tree)
+        out: List[Finding] = []
+        for node in ast.walk(model.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._catches_exception(node.type):
+                continue
+            if self._routes(node):
+                continue
+            where = owners.get(node, "<module>")
+            out.append(
+                model.finding(
+                    node,
+                    self.rule_id,
+                    f"except Exception in {where} neither re-raises nor "
+                    "routes through to_autoscaler_error/prefixed — untyped "
+                    "swallows hide crash-only loop failures from "
+                    "errors_total and the health check",
+                )
+            )
+        return out
+
+    @staticmethod
+    def _catches_exception(type_node: Optional[ast.AST]) -> bool:
+        names = []
+        if type_node is None:
+            return True  # bare except is the same hazard
+        if isinstance(type_node, ast.Tuple):
+            names = [t.id for t in type_node.elts if isinstance(t, ast.Name)]
+        elif isinstance(type_node, ast.Name):
+            names = [type_node.id]
+        return "Exception" in names or "BaseException" in names
+
+    @staticmethod
+    def _routes(handler: ast.ExceptHandler) -> bool:
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                term = _terminal_name(node.func)
+                if term in _ROUTERS:
+                    return True
+        return False
+
+
+# -- GL006: purity of jit/vmap/pallas-reached functions -----------------------
+
+_JIT_WRAPPERS = {"jit", "vmap", "pmap", "pallas_call", "shard_map"}
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical", "log"}
+
+
+class JitPurity:
+    rule_id = "GL006"
+    title = "host side effect inside a jit/vmap/pallas-reached function"
+
+    def check(self, model: FileModel) -> List[Finding]:
+        defs = self._local_defs(model.tree)
+        roots = self._jit_roots(model)
+        # within-file transitive closure: a jitted fn calling a local helper
+        # taints the helper too (cross-module reach is out of scope; RULES.md)
+        reached: Set[str] = set()
+        work = [r for r in roots if r in defs]
+        while work:
+            name = work.pop()
+            if name in reached:
+                continue
+            reached.add(name)
+            for node in ast.walk(defs[name]):
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                    callee = node.func.id
+                    if callee in defs and callee not in reached:
+                        work.append(callee)
+        out: List[Finding] = []
+        for name in sorted(reached):
+            fn = defs[name]
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                why = self._banned(model, node)
+                if why is not None:
+                    out.append(
+                        model.finding(
+                            node,
+                            self.rule_id,
+                            f"{why} inside {name}(), which is reached from a "
+                            "jit/vmap/pallas_call site — traced functions "
+                            "run under transformation where host side "
+                            "effects silently vanish or fire at trace time",
+                        )
+                    )
+        return out
+
+    @staticmethod
+    def _local_defs(tree: ast.AST) -> Dict[str, ast.AST]:
+        return {
+            n.name: n
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+
+    def _jit_roots(self, model: FileModel) -> Set[str]:
+        roots: Set[str] = set()
+        for node in ast.walk(model.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self._is_jit_expr(model, dec):
+                        roots.add(node.name)
+            elif isinstance(node, ast.Call) and self._is_jit_name(model, node.func):
+                # jax.jit(fn) / vmap(fn) / pallas_call(kernel, ...): the
+                # first Name argument is the traced function
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Name):
+                        roots.add(arg.id)
+        return roots
+
+    def _is_jit_expr(self, model: FileModel, node: ast.AST) -> bool:
+        """Decorator forms: @jax.jit, @jit, @partial(jax.jit, ...)."""
+        if self._is_jit_name(model, node):
+            return True
+        if isinstance(node, ast.Call):
+            term = _terminal_name(node.func)
+            if term == "partial" and node.args:
+                return self._is_jit_name(model, node.args[0])
+            return self._is_jit_name(model, node.func)
+        return False
+
+    @staticmethod
+    def _is_jit_name(model: FileModel, node: ast.AST) -> bool:
+        term = _terminal_name(node)
+        if term not in _JIT_WRAPPERS:
+            return False
+        q = model.qualname(node) or term
+        head = q.split(".")[0]
+        return head in ("jax", "pl", "jit", "vmap", "pmap") or "jax" in q or term in (
+            "pallas_call",
+            "shard_map",
+        )
+
+    @staticmethod
+    def _banned(model: FileModel, call: ast.Call) -> Optional[str]:
+        term = _terminal_name(call.func)
+        if term is None:
+            return None
+        if isinstance(call.func, ast.Name) and term == "print":
+            return "print()"
+        q = model.qualname(call.func) or term
+        parts = q.split(".")
+        if "metrics" in parts:
+            return f"metrics write {q}()"
+        if parts[0] == "trace" or "autoscaler_tpu.trace" in q:
+            return f"tracer call {q}()"
+        if (
+            parts[0] in ("logging", "logger", "log", "klogx")
+            and parts[-1] in _LOG_METHODS
+        ):
+            return f"logging call {q}()"
+        return None
+
+
+ALL_RULES: Sequence = (
+    WallClockInReplayPath(),
+    SpanNameTaxonomy(),
+    LadderBypass(),
+    LockDiscipline(),
+    ErrorBoundary(),
+    JitPurity(),
+)
+
+RULE_CATALOG = {r.rule_id: r.title for r in ALL_RULES}

@@ -12,7 +12,6 @@ loop.
 """
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -37,6 +36,7 @@ from autoscaler_tpu.simulator.removal import (
 )
 from autoscaler_tpu.simulator.tracker import UsageTracker
 from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+from autoscaler_tpu import trace
 
 
 @dataclass
@@ -119,11 +119,14 @@ class ScaleDownPlanner:
         if self._adaptive_candidate_limit is not None:
             non_empty = non_empty[: self._adaptive_candidate_limit]
 
-        sim_start = time.monotonic()
+        # timeline clock (graftlint GL001): the AIMD clamp below FEEDS BACK
+        # into next tick's candidate width, so a wall-clock measurement here
+        # would make replayed decision logs diverge on a slow host
+        sim_start = trace.timeline_now()
         to_remove, not_removable = self.simulator.find_nodes_to_remove(
             snapshot, non_empty, pdbs
         )
-        sim_s = time.monotonic() - sim_start
+        sim_s = trace.timeline_now() - sim_start
         budget = self.options.scale_down_simulation_timeout_s
         if budget > 0:
             if non_empty and sim_s > budget and len(non_empty) > 1:

@@ -512,7 +512,8 @@ class StaticAutoscaler:
                 )
         if self.debugger is not None and self.debugger.is_data_collection_allowed():
             self.debugger.capture(
-                self, snapshot, pending, result, filtered_pods=filtered
+                self, snapshot, pending, result, filtered_pods=filtered,
+                now=now_ts,
             )
         return result
 

@@ -32,11 +32,15 @@ class DebuggingSnapshotter:
             return self._requested
 
     def capture(
-        self, autoscaler, snapshot, pending_pods, result, filtered_pods=()
+        self, autoscaler, snapshot, pending_pods, result, filtered_pods=(),
+        now: Optional[float] = None,
     ) -> None:
         """Called at the end of a loop iteration when armed. filtered_pods:
         the pods filter-out-schedulable absorbed this loop — the reference's
-        'unscheduled pods that could be scheduled' population."""
+        'unscheduled pods that could be scheduled' population. ``now`` is
+        the capture timestamp (run_once passes its tick's now_ts, keeping
+        replayed snapshots deterministic); wall time is only the fallback
+        for bare invocations."""
         with self._lock:
             if not self._requested:
                 return
@@ -72,8 +76,10 @@ class DebuggingSnapshotter:
                     i = meta.pod_index.get(p.key())
                     if i is not None and any_fit[i]:
                         lost_packing_race.append(p.key())
+            if now is None:
+                now = time.time()  # graftlint: disable=GL001 — operator-artifact fallback; replay-reachable callers inject now
             self._payload = {
-                "captured_at": time.time(),
+                "captured_at": now,
                 "node_count": len(nodes),
                 "pod_count": len(snapshot.pods()),
                 "pending_pods": [p.key() for p in pending_pods],

@@ -345,6 +345,7 @@ class WatchCache:
         self._synced = threading.Event()
 
     def start(self) -> None:
+        # graftlint: disable=GL004 — start() runs once, before the cache is shared with reader threads; _thread is never read concurrently
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 

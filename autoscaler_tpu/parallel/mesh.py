@@ -284,12 +284,14 @@ def sharded_affinity_estimate(
     def body(pod_req, pod_masks, allocs, caps, match, aff_of, anti_of,
              node_level, has_label, spread_arg):
         if use_pallas:
+            # graftlint: disable=GL003 — shard_map body: per-shard dispatch inside an SPMD program; the caller-side ladder can't wrap individual shards
             return ffd_binpack_groups_affinity_pallas(
                 pod_req, pod_masks, allocs, max_nodes=max_nodes,
                 match=match, aff_of=aff_of, anti_of=anti_of,
                 node_level=node_level, has_label=has_label,
                 node_caps=caps, spread=spread_arg,
             )
+        # graftlint: disable=GL003 — shard_map body: per-shard dispatch inside an SPMD program; the caller-side ladder can't wrap individual shards
         return ffd_binpack_groups_affinity(
             pod_req, pod_masks, allocs, max_nodes=max_nodes,
             match=match, aff_of=aff_of, anti_of=anti_of,

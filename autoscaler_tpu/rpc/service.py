@@ -71,6 +71,7 @@ class TpuSimulationServicer:
         masks = _u8(request.pod_masks, G, P)
         allocs = _f32(request.template_allocs, G, R)
         caps = _i32(request.node_caps, G)
+        # graftlint: disable=GL003 — sidecar server side: the ladder lives in the CLIENT process (TpuSimulationClient's caller); a fault here surfaces as an RPC error the client's ladder absorbs
         res = ffd_binpack_groups(
             jnp.asarray(pod_req),
             jnp.asarray(masks),

@@ -15,6 +15,7 @@ from autoscaler_tpu.trace.tracer import (
     set_attrs,
     set_wall_attrs,
     span,
+    timeline_now,
 )
 
 __all__ = [
@@ -29,4 +30,5 @@ __all__ = [
     "set_attrs",
     "set_wall_attrs",
     "span",
+    "timeline_now",
 ]

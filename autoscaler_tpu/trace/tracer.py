@@ -218,6 +218,19 @@ def set_wall_attrs(**attrs: Any) -> None:
     sp.set_attrs(**attrs)
 
 
+def timeline_now() -> float:
+    """THE whitelisted clock seam for replay-reachable duration pairs
+    (graftlint GL001): inside a trace, the active tracer's timeline clock —
+    which the loadgen driver replaces with a synthetic counter, so replayed
+    elapsed-time measurements (and anything branching on them, like the
+    estimator's over-budget warning) are byte-identical across runs.
+    Outside any trace it degrades to the process monotonic clock."""
+    active = _ACTIVE.get()
+    if active is not None:
+        return active[0].clock()
+    return time.monotonic()  # graftlint: disable=GL001 — the seam's own fallback: no trace means no injected clock to defer to
+
+
 def _feed_metrics(metrics: Any, label: str, elapsed: float) -> None:
     """THE metrics choke point: every span duration and every legacy
     ``observe_duration`` call land in ``function_duration_seconds`` through

@@ -63,9 +63,10 @@ class CircuitBreaker:
         with self._lock:
             return self._consecutive_failures
 
-    def _transition(self, new: BreakerState) -> None:
-        # lock held by caller; the callback runs under it too — callbacks
-        # are metric/log writes and must not call back into the breaker
+    def _transition_locked(self, new: BreakerState) -> None:
+        # *_locked suffix = caller holds self._lock (the graftlint GL004
+        # convention); the callback runs under it too — callbacks are
+        # metric/log writes and must not call back into the breaker
         old = self._state
         if old is new:
             return
@@ -83,7 +84,7 @@ class CircuitBreaker:
             if self._state is BreakerState.OPEN:
                 if now - self._opened_ts < self.cooldown_s:
                     return False
-                self._transition(BreakerState.HALF_OPEN)
+                self._transition_locked(BreakerState.HALF_OPEN)
                 self._probe_in_flight = True
                 return True
             # HALF_OPEN
@@ -97,7 +98,7 @@ class CircuitBreaker:
             if self._state is BreakerState.HALF_OPEN:
                 self._probe_in_flight = False
                 self._consecutive_failures = 0
-                self._transition(BreakerState.CLOSED)
+                self._transition_locked(BreakerState.CLOSED)
             elif self._state is BreakerState.CLOSED:
                 self._consecutive_failures = 0
             # success reported while OPEN is a stale caller (admitted before
@@ -115,7 +116,7 @@ class CircuitBreaker:
             if self._state is BreakerState.HALF_OPEN:
                 self._probe_in_flight = False
                 self._consecutive_failures = 0
-                self._transition(BreakerState.CLOSED)
+                self._transition_locked(BreakerState.CLOSED)
 
     def release_probe(self, now: float) -> None:
         """The admitted half-open prober could not engage the resource for
@@ -131,11 +132,11 @@ class CircuitBreaker:
             if self._state is BreakerState.HALF_OPEN:
                 self._probe_in_flight = False
                 self._opened_ts = now
-                self._transition(BreakerState.OPEN)
+                self._transition_locked(BreakerState.OPEN)
             elif self._state is BreakerState.CLOSED:
                 self._consecutive_failures += 1
                 if self._consecutive_failures >= self.failure_threshold:
                     self._opened_ts = now
-                    self._transition(BreakerState.OPEN)
+                    self._transition_locked(BreakerState.OPEN)
             # failures reported while OPEN are stale: re-extending the
             # window on them would starve the half-open probe

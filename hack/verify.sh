@@ -8,6 +8,14 @@ cd "$(dirname "$0")/.."
 echo "== python syntax/compile check =="
 python -m compileall -q autoscaler_tpu bench.py __graft_entry__.py
 
+echo "== graftlint (AST invariant gate: determinism, taxonomy, ladder, locks, boundaries, jit purity) =="
+# Fatal. Exits nonzero on any finding not grandfathered in
+# hack/lint-baseline.json AND on stale baseline entries (a baselined
+# finding that no longer exists must be struck via --update-baseline, so
+# the debt ledger can only shrink). Rule catalog:
+# autoscaler_tpu/analysis/RULES.md
+python -m autoscaler_tpu.analysis autoscaler_tpu/
+
 echo "== proto freshness check =="
 tmp=$(mktemp -d)
 protoc --python_out="$tmp" --proto_path=autoscaler_tpu/rpc/protos \

@@ -1,0 +1,694 @@
+"""graftlint (autoscaler_tpu/analysis): per-rule positive/negative fixtures,
+pragma suppression, baseline round-trip + stale ratchet, CLI contract, and
+the self-check that the repo (with its shipped baseline) and the analysis
+package itself scan clean.
+
+Fixture paths are *virtual* — ``check_source`` scopes rules on the path
+string, no file need exist — except for the CLI/baseline tests, which
+build a real miniature ``autoscaler_tpu/`` tree in tmp_path.
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from autoscaler_tpu.analysis import baseline as baseline_mod
+from autoscaler_tpu.analysis import check_source, scan_paths
+from autoscaler_tpu.analysis.cli import main as cli_main
+from autoscaler_tpu.analysis.engine import display_path, module_path
+from autoscaler_tpu.analysis.rules import function_label_taxonomy
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def findings(source: str, path: str):
+    return check_source(textwrap.dedent(source), path)
+
+
+def rules_of(found):
+    return [f.rule for f in found]
+
+
+# -- engine plumbing ----------------------------------------------------------
+
+
+def test_path_normalization():
+    assert (
+        display_path("/tmp/x/autoscaler_tpu/loadgen/driver.py")
+        == "autoscaler_tpu/loadgen/driver.py"
+    )
+    assert module_path("/tmp/x/autoscaler_tpu/core/a.py") == "core/a.py"
+    assert module_path("/tmp/elsewhere/tool.py") is None
+
+
+def test_taxonomy_extracted_without_import():
+    tax = function_label_taxonomy()
+    assert {"main", "estimate", "deviceDispatch", "kubeRequest"} <= tax
+
+
+# -- GL001 wall clock / randomness -------------------------------------------
+
+
+def test_gl001_flags_wall_clock_in_replay_scope():
+    found = findings(
+        """
+        import time
+
+        def tick():
+            return time.time()
+        """,
+        "autoscaler_tpu/loadgen/fixture.py",
+    )
+    assert rules_of(found) == ["GL001"]
+    assert "time.time" in found[0].message
+
+
+def test_gl001_resolves_import_aliases():
+    found = findings(
+        """
+        import time as t
+        from time import monotonic as mono
+
+        def f():
+            return t.sleep(1) or mono()
+        """,
+        "autoscaler_tpu/core/fixture.py",
+    )
+    assert rules_of(found) == ["GL001", "GL001"]
+
+
+def test_gl001_flags_ambient_randomness_allows_seeded():
+    found = findings(
+        """
+        import random
+        import numpy as np
+
+        def bad():
+            return random.random() + np.random.rand()
+
+        def good(seed):
+            return random.Random(seed).random() + np.random.default_rng(seed).random()
+        """,
+        "autoscaler_tpu/expander/fixture.py",
+    )
+    assert rules_of(found) == ["GL001", "GL001"]
+
+
+def test_gl001_injected_default_reference_is_the_seam():
+    found = findings(
+        """
+        import time
+        from typing import Callable
+
+        def run(clock: Callable[[], float] = time.monotonic) -> float:
+            return clock()
+        """,
+        "autoscaler_tpu/core/fixture.py",
+    )
+    assert found == []
+
+
+def test_gl001_parameter_shadowing_module_name_is_a_seam():
+    # an injected rng/clock PARAMETER named `random`/`time` is the
+    # sanctioned seam shape, not the ambient module
+    found = findings(
+        """
+        def pick(random, time):
+            time.sleep(0)
+            return random.choice([1, 2])
+        """,
+        "autoscaler_tpu/core/fixture.py",
+    )
+    assert found == []
+
+
+def test_gl001_out_of_scope_module_not_flagged():
+    found = findings(
+        """
+        import time
+
+        def f():
+            return time.time()
+        """,
+        "autoscaler_tpu/kube/fixture.py",  # not a replay-reachable scope
+    )
+    assert found == []
+
+
+# -- GL002 span-name taxonomy -------------------------------------------------
+
+
+def test_gl002_flags_non_taxonomy_span_literal():
+    found = findings(
+        """
+        from autoscaler_tpu import trace
+
+        def f():
+            with trace.span("totallyNewPhase"):
+                pass
+        """,
+        "autoscaler_tpu/core/fixture.py",
+    )
+    assert rules_of(found) == ["GL002"]
+    assert "totallyNewPhase" in found[0].message
+
+
+def test_gl002_taxonomy_literal_and_constant_ok():
+    found = findings(
+        """
+        from autoscaler_tpu import trace
+        from autoscaler_tpu.metrics import metrics as metrics_mod
+
+        def f(tracer):
+            with trace.span("estimate"):
+                pass
+            with tracer.tick(metrics_mod.MAIN):
+                pass
+        """,
+        "autoscaler_tpu/core/fixture.py",
+    )
+    assert found == []
+
+
+def test_gl002_regex_match_span_not_flagged():
+    found = findings(
+        """
+        import re
+
+        def f(m: "re.Match"):
+            return m.span("group")
+        """,
+        "autoscaler_tpu/core/fixture.py",
+    )
+    assert found == []
+
+
+# -- GL003 ladder bypass ------------------------------------------------------
+
+_DISPATCH_SRC = """
+    from autoscaler_tpu.ops.binpack import ffd_binpack
+
+    def f(req, mask, alloc):
+        return ffd_binpack(req, mask, alloc, max_nodes=8)
+    """
+
+
+def test_gl003_flags_dispatch_outside_ladder_modules():
+    found = findings(_DISPATCH_SRC, "autoscaler_tpu/core/fixture.py")
+    assert rules_of(found) == ["GL003"]
+    assert "_walk_ladder" in found[0].message
+
+
+def test_gl003_estimator_and_ops_allowed():
+    assert findings(_DISPATCH_SRC, "autoscaler_tpu/estimator/fixture.py") == []
+    assert findings(_DISPATCH_SRC, "autoscaler_tpu/ops/fixture.py") == []
+
+
+def test_gl003_pallas_call_only_in_ops():
+    src = """
+        import jax.experimental.pallas as pl
+
+        def f(kernel, x):
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """
+    assert rules_of(findings(src, "autoscaler_tpu/estimator/fixture.py")) == [
+        "GL003"
+    ]
+    assert findings(src, "autoscaler_tpu/ops/fixture.py") == []
+
+
+# -- GL004 lock discipline ----------------------------------------------------
+
+
+def test_gl004_flags_unlocked_write():
+    found = findings(
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def put(self, x):
+                self._items = [x]
+        """,
+        "autoscaler_tpu/metrics/fixture.py",
+    )
+    assert rules_of(found) == ["GL004"]
+    assert "Box.put" in found[0].message
+
+
+def test_gl004_locked_write_init_and_locked_suffix_ok():
+    found = findings(
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def put(self, x):
+                with self._lock:
+                    self._items.append(x)
+                    self._count = len(self._items)
+
+            def _reset_locked(self):
+                self._items = []
+        """,
+        "autoscaler_tpu/metrics/fixture.py",
+    )
+    assert found == []
+
+
+def test_gl004_nested_def_does_not_inherit_lock():
+    # a closure defined under `with self._lock:` runs LATER, lock released
+    found = findings(
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def deferred(self):
+                with self._lock:
+                    def later():
+                        self._n = 1
+                    return later
+        """,
+        "autoscaler_tpu/utils/circuit.py",
+    )
+    assert rules_of(found) == ["GL004"]
+
+
+def test_gl004_nested_class_lock_does_not_leak_to_enclosing():
+    found = findings(
+        """
+        import threading
+
+        class Outer:
+            class Inner:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self):
+                    self._n += 1
+
+            def set(self, v):
+                self._v = v
+        """,
+        "autoscaler_tpu/metrics/fixture.py",
+    )
+    # Outer has no lock -> Outer.set is fine; Inner.bump IS flagged
+    assert [(f.rule, "Inner.bump" in f.message) for f in found] == [
+        ("GL004", True)
+    ]
+
+
+def test_gl004_bare_annotation_is_not_a_write():
+    found = findings(
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def declare(self):
+                self._x: int
+        """,
+        "autoscaler_tpu/metrics/fixture.py",
+    )
+    assert found == []
+
+
+def test_gl004_class_without_lock_not_checked():
+    found = findings(
+        """
+        class Free:
+            def put(self, x):
+                self._items = [x]
+        """,
+        "autoscaler_tpu/metrics/fixture.py",
+    )
+    assert found == []
+
+
+# -- GL005 error boundary -----------------------------------------------------
+
+
+def test_gl005_flags_swallowed_exception_in_core():
+    found = findings(
+        """
+        def run_once():
+            try:
+                work()
+            except Exception:
+                pass
+        """,
+        "autoscaler_tpu/core/fixture.py",
+    )
+    assert rules_of(found) == ["GL005"]
+    assert "run_once" in found[0].message
+
+
+def test_gl005_routed_or_reraised_ok_and_scope_limited():
+    src = """
+        from autoscaler_tpu.utils.errors import to_autoscaler_error
+
+        def a():
+            try:
+                work()
+            except Exception as e:
+                err = to_autoscaler_error(e)
+                log(err)
+
+        def b():
+            try:
+                work()
+            except Exception:
+                raise
+        """
+    assert findings(src, "autoscaler_tpu/core/fixture.py") == []
+    swallow = """
+        def f():
+            try:
+                work()
+            except Exception:
+                pass
+        """
+    # estimator/ has its own contract (the ladder records failures); GL005
+    # polices only the run_once path
+    assert findings(swallow, "autoscaler_tpu/estimator/fixture.py") == []
+
+
+# -- GL006 jit purity ---------------------------------------------------------
+
+
+def test_gl006_flags_print_under_partial_jit_decorator():
+    found = findings(
+        """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("n",))
+        def kernel(x, n):
+            print(x)
+            return x * n
+        """,
+        "autoscaler_tpu/ops/fixture.py",
+    )
+    assert rules_of(found) == ["GL006"]
+    assert "print()" in found[0].message
+
+
+def test_gl006_transitive_local_helper_and_metrics():
+    found = findings(
+        """
+        import jax
+
+        def helper(m, x):
+            m.metrics.dispatches.inc()
+            return x
+
+        def outer(m, x):
+            return jax.jit(traced)(x)
+
+        def traced(x):
+            return helper(None, x)
+        """,
+        "autoscaler_tpu/ops/fixture.py",
+    )
+    assert rules_of(found) == ["GL006"]
+    assert "metrics" in found[0].message
+
+
+def test_gl006_host_side_effects_outside_jit_ok():
+    found = findings(
+        """
+        import jax
+
+        @jax.jit
+        def kernel(x):
+            return x + 1
+
+        def host(m, x):
+            print("dispatching")
+            m.metrics.dispatches.inc()
+            return kernel(x)
+        """,
+        "autoscaler_tpu/ops/fixture.py",
+    )
+    assert found == []
+
+
+# -- suppression pragmas ------------------------------------------------------
+
+
+def test_pragma_with_reason_suppresses():
+    found = findings(
+        """
+        import time
+
+        def f():
+            return time.time()  # graftlint: disable=GL001 — fixture: injected upstream
+        """,
+        "autoscaler_tpu/loadgen/fixture.py",
+    )
+    assert found == []
+
+
+def test_pragma_on_preceding_comment_line_suppresses():
+    found = findings(
+        """
+        import time
+
+        def f():
+            # graftlint: disable=GL001 — fixture: injected upstream
+            return time.time()
+        """,
+        "autoscaler_tpu/loadgen/fixture.py",
+    )
+    assert found == []
+
+
+def test_pragma_without_reason_is_gl000():
+    found = findings(
+        """
+        import time
+
+        def f():
+            return time.time()  # graftlint: disable=GL001
+        """,
+        "autoscaler_tpu/loadgen/fixture.py",
+    )
+    assert rules_of(found) == ["GL000"]  # GL001 suppressed, hygiene flagged
+
+
+def test_gl000_is_unsuppressible():
+    # disable=GL000,GL001 with no reason must not waive the very contract
+    # it violates: GL001 is suppressed, the hygiene finding survives
+    found = findings(
+        """
+        import time
+
+        def f():
+            return time.time()  # graftlint: disable=GL000,GL001
+        """,
+        "autoscaler_tpu/loadgen/fixture.py",
+    )
+    assert rules_of(found) == ["GL000"]
+
+
+def test_pragma_for_other_rule_does_not_suppress():
+    found = findings(
+        """
+        import time
+
+        def f():
+            return time.time()  # graftlint: disable=GL004 — wrong rule
+        """,
+        "autoscaler_tpu/loadgen/fixture.py",
+    )
+    assert rules_of(found) == ["GL001"]
+
+
+# -- baseline round-trip + ratchet -------------------------------------------
+
+_VIOLATION = "import time\n\n\ndef f():\n    return time.time()\n"
+
+
+def _mini_repo(tmp_path: Path) -> Path:
+    pkg = tmp_path / "autoscaler_tpu" / "loadgen"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(_VIOLATION)
+    (pkg / "clean.py").write_text("def ok():\n    return 1\n")
+    return tmp_path
+
+
+def test_baseline_round_trip_and_stale_ratchet(tmp_path):
+    root = _mini_repo(tmp_path)
+    scan_dir = str(root / "autoscaler_tpu")
+    bl = root / "hack" / "lint-baseline.json"
+
+    # no baseline: the violation fails the run
+    assert cli_main([scan_dir, "--no-baseline"]) == 1
+    # grandfather it
+    assert cli_main([scan_dir, "--baseline", str(bl), "--update-baseline"]) == 0
+    doc = json.loads(bl.read_text())
+    assert [e["rule"] for e in doc["findings"]] == ["GL001"]
+    # baselined: clean
+    assert cli_main([scan_dir, "--baseline", str(bl)]) == 0
+    # a SECOND violation of the same fingerprint exceeds the count: fails
+    (root / "autoscaler_tpu" / "loadgen" / "bad.py").write_text(
+        _VIOLATION + "\n\ndef g():\n    return time.time()\n"
+    )
+    assert cli_main([scan_dir, "--baseline", str(bl)]) == 1
+    # fixing the violation entirely makes the entry STALE: also fails
+    (root / "autoscaler_tpu" / "loadgen" / "bad.py").write_text(
+        "def fixed():\n    return 0\n"
+    )
+    assert cli_main([scan_dir, "--baseline", str(bl)]) == 1
+    # striking it restores green
+    assert cli_main([scan_dir, "--baseline", str(bl), "--update-baseline"]) == 0
+    assert cli_main([scan_dir, "--baseline", str(bl)]) == 0
+    assert json.loads(bl.read_text())["findings"] == []
+
+
+def test_partial_scan_neither_reports_nor_strikes_unscanned_stale(tmp_path):
+    """A one-file scan must not read the rest of the ledger as stale, and a
+    one-file --update-baseline must not strike the unscanned entries."""
+    root = _mini_repo(tmp_path)
+    (root / "autoscaler_tpu" / "loadgen" / "bad2.py").write_text(_VIOLATION)
+    scan_dir = str(root / "autoscaler_tpu")
+    bl = root / "hack" / "lint-baseline.json"
+    assert cli_main([scan_dir, "--baseline", str(bl), "--update-baseline"]) == 0
+    assert len(json.loads(bl.read_text())["findings"]) == 2
+    one_file = str(root / "autoscaler_tpu" / "loadgen" / "bad2.py")
+    # partial scan: bad.py's entry is out of scope, not stale
+    assert cli_main([one_file, "--baseline", str(bl)]) == 0
+    # fix bad2 only; partial update strikes ITS entry, preserves bad.py's
+    Path(one_file).write_text("def fixed():\n    return 0\n")
+    assert cli_main([one_file, "--baseline", str(bl), "--update-baseline"]) == 0
+    kept = json.loads(bl.read_text())["findings"]
+    assert [e["path"] for e in kept] == ["autoscaler_tpu/loadgen/bad.py"]
+    assert cli_main([scan_dir, "--baseline", str(bl)]) == 0
+
+
+def test_deleted_file_under_scanned_dir_reads_stale(tmp_path):
+    """The ratchet must survive file deletion: an entry for a file that no
+    longer exists under a scanned directory is stale, not invisible."""
+    root = _mini_repo(tmp_path)
+    scan_dir = str(root / "autoscaler_tpu")
+    bl = root / "hack" / "lint-baseline.json"
+    assert cli_main([scan_dir, "--baseline", str(bl), "--update-baseline"]) == 0
+    (root / "autoscaler_tpu" / "loadgen" / "bad.py").unlink()
+    assert cli_main([scan_dir, "--baseline", str(bl)]) == 1  # stale
+    assert cli_main([scan_dir, "--baseline", str(bl), "--update-baseline"]) == 0
+    assert json.loads(bl.read_text())["findings"] == []
+    assert cli_main([scan_dir, "--baseline", str(bl)]) == 0
+
+
+def test_explicit_missing_baseline_is_usage_error(tmp_path):
+    root = _mini_repo(tmp_path)
+    rc = cli_main(
+        [str(root / "autoscaler_tpu"), "--baseline", str(root / "typo.json")]
+    )
+    assert rc == 2
+
+
+def test_repo_partial_scan_single_file_passes(monkeypatch):
+    # pre-commit-style invocation: one clean file + the shipped full-repo
+    # baseline must not surface the unscanned ledger as stale
+    monkeypatch.chdir(REPO)
+    assert cli_main(["autoscaler_tpu/loadgen/faults.py"]) == 0
+
+
+def test_baseline_diff_excess_surfaces_newest_lines():
+    f1 = check_source(_VIOLATION, "autoscaler_tpu/loadgen/bad.py")
+    assert len(f1) == 1
+    base = {f1[0].fingerprint: 1}
+    two = check_source(
+        _VIOLATION + "\n\ndef g():\n    return time.time()\n",
+        "autoscaler_tpu/loadgen/bad.py",
+    )
+    new, stale = baseline_mod.diff(two, base)
+    assert len(new) == 1 and new[0].line > f1[0].line
+    assert stale == []
+
+
+# -- repo self-checks + CLI contract -----------------------------------------
+
+
+def test_analysis_package_scans_clean_over_itself():
+    assert scan_paths([str(REPO / "autoscaler_tpu" / "analysis")]) == []
+
+
+def test_repo_scans_clean_with_shipped_baseline(monkeypatch):
+    monkeypatch.chdir(REPO)
+    assert cli_main(["autoscaler_tpu"]) == 0
+
+
+def test_findings_render_and_sort_deterministically():
+    found = findings(
+        """
+        import time
+
+        def b():
+            return time.sleep(1)
+
+        def a():
+            return time.time()
+        """,
+        "autoscaler_tpu/loadgen/fixture.py",
+    )
+    assert [f.line for f in found] == sorted(f.line for f in found)
+    rendered = found[0].render()
+    assert rendered.startswith("autoscaler_tpu/loadgen/fixture.py:")
+    assert ": GL001 " in rendered
+
+
+def test_cli_module_entry_point_seeded_violation(tmp_path):
+    """The real `python -m autoscaler_tpu.analysis` contract: nonzero +
+    path:line: RULE output on a seeded violation, 0 on a clean tree."""
+    root = _mini_repo(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, "-m", "autoscaler_tpu.analysis", "--no-baseline",
+         str(root / "autoscaler_tpu")],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert proc.returncode == 1
+    assert "autoscaler_tpu/loadgen/bad.py:5: GL001" in proc.stdout
+    proc2 = subprocess.run(
+        [sys.executable, "-m", "autoscaler_tpu.analysis", "--no-baseline",
+         str(root / "autoscaler_tpu" / "loadgen" / "clean.py")],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert proc2.returncode == 0
+
+
+def test_cli_missing_path_is_usage_error(tmp_path):
+    assert cli_main([str(tmp_path / "nope")]) == 2
+
+
+def test_cli_contradictory_baseline_flags_are_usage_error(tmp_path):
+    root = _mini_repo(tmp_path)
+    rc = cli_main(
+        [str(root / "autoscaler_tpu"), "--no-baseline", "--update-baseline"]
+    )
+    assert rc == 2
+
+
+def test_nul_byte_file_degrades_to_parse_finding():
+    found = check_source("\x00bad", "autoscaler_tpu/core/corrupt.py")
+    assert rules_of(found) == ["GL000"]
+    assert "does not parse" in found[0].message
