@@ -9,16 +9,27 @@ See ``RULES.md`` (this directory) for the rule catalog and etiquette.
 """
 from autoscaler_tpu.analysis.engine import (
     Finding,
+    ScanStats,
+    analyze_paths,
+    analyze_sources,
     check_source,
     scan_file,
     scan_paths,
 )
-from autoscaler_tpu.analysis.rules import ALL_RULES, RULE_CATALOG
+from autoscaler_tpu.analysis.rules import (
+    ALL_PROGRAM_RULES,
+    ALL_RULES,
+    RULE_CATALOG,
+)
 
 __all__ = [
+    "ALL_PROGRAM_RULES",
     "ALL_RULES",
     "Finding",
     "RULE_CATALOG",
+    "ScanStats",
+    "analyze_paths",
+    "analyze_sources",
     "check_source",
     "scan_file",
     "scan_paths",

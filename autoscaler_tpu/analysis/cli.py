@@ -4,28 +4,45 @@ Usage::
 
     python -m autoscaler_tpu.analysis [paths...]
         [--baseline FILE] [--no-baseline] [--update-baseline] [--list-rules]
+        [--format {text,json,github}]
 
 Default paths: ``autoscaler_tpu`` under the current directory. The baseline
 defaults to ``hack/lint-baseline.json`` discovered by walking up from the
 current directory (``--no-baseline`` disables, ``--baseline`` overrides).
-Exit status: 0 clean, 1 findings or stale baseline entries, 2 usage error.
+
+Output formats: ``text`` (findings to stdout, per-rule summary table to
+stderr), ``json`` (one machine-readable document on stdout — byte-stable
+across runs, ``hack/verify.sh`` diffs two consecutive runs), ``github``
+(workflow-annotation ``::error``/``::warning`` lines).
+
+Exit status: 0 clean; 1 findings or stale baseline entries; 2 usage error
+OR internal analyzer error (a crash in the analyzer itself must be
+distinguishable from "the tree has findings" — CI treats 1 as a ratchet
+failure and 2 as a broken gate).
 """
 from __future__ import annotations
 
 import argparse
+import json
 import sys
+import traceback
 from pathlib import Path
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from autoscaler_tpu.analysis import baseline as baseline_mod
 from autoscaler_tpu.analysis.engine import (
+    Finding,
+    ScanStats,
+    analyze_sources,
     display_path,
     iter_python_files,
-    scan_paths,
+    package_scan_complete,
 )
 from autoscaler_tpu.analysis.rules import RULE_CATALOG
 
 BASELINE_RELPATH = Path("hack") / "lint-baseline.json"
+
+JSON_VERSION = 1
 
 
 def scan_scope(paths: List[str], files: List[str]):
@@ -61,14 +78,70 @@ def discover_baseline(start: Optional[Path] = None) -> Optional[Path]:
     return None
 
 
-def main(argv: Optional[List[str]] = None) -> int:
+def _rule_summary(
+    stats: ScanStats, new: List[Finding]
+) -> Dict[str, Dict[str, int]]:
+    """Per-rule {findings, suppressed, baselined} rows, every catalog rule
+    present (stable table shape) plus GL000 and any unknown rule seen."""
+    new_by_rule: Dict[str, int] = {}
+    for f in new:
+        new_by_rule[f.rule] = new_by_rule.get(f.rule, 0) + 1
+    # ScanStats.note counted every kept finding, so the per-rule totals
+    # are already in findings_by_rule — baselined = total - new
+    total_by_rule = stats.findings_by_rule
+    rules = sorted(
+        {"GL000", *RULE_CATALOG, *stats.findings_by_rule, *stats.suppressed_by_rule}
+    )
+    return {
+        rule: {
+            "findings": new_by_rule.get(rule, 0),
+            "suppressed": stats.suppressed_by_rule.get(rule, 0),
+            "baselined": total_by_rule.get(rule, 0) - new_by_rule.get(rule, 0),
+        }
+        for rule in rules
+    }
+
+
+def _print_summary_table(summary: Dict[str, Dict[str, int]], stale: int) -> None:
+    """The CI-log drift table: one look shows which rule is ratcheting."""
+    print("rule   findings  suppressed  baselined", file=sys.stderr)
+    for rule, row in summary.items():
+        print(
+            f"{rule:<6} {row['findings']:>8}  {row['suppressed']:>10}  "
+            f"{row['baselined']:>9}",
+            file=sys.stderr,
+        )
+    if stale:
+        print(f"stale baseline entries: {stale}", file=sys.stderr)
+
+
+def _emit_json(doc: dict) -> None:
+    """Byte-stable document: sorted keys, pre-sorted arrays, one trailing
+    newline — two runs over the same tree must diff empty."""
+    sys.stdout.write(
+        json.dumps(doc, sort_keys=True, indent=2, ensure_ascii=False) + "\n"
+    )
+
+
+def _emit_github(new: List[Finding], stale: List[str]) -> None:
+    for f in new:
+        print(
+            f"::error file={f.path},line={f.line},title=graftlint {f.rule}"
+            f"::{f.message}"
+        )
+    for s in stale:
+        print(f"::warning title=graftlint stale baseline::{s}")
+
+
+def _run(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="graftlint",
         description=(
             "AST invariant checker: determinism (GL001), span taxonomy "
             "(GL002), ladder bypass (GL003), lock discipline (GL004), "
-            "error boundaries (GL005), jit purity (GL006). See "
-            "autoscaler_tpu/analysis/RULES.md."
+            "error boundaries (GL005), jit purity (GL006), kernel "
+            "shape/tiling contracts (GL007), lock ordering (GL008), "
+            "flag wiring (GL009). See autoscaler_tpu/analysis/RULES.md."
         ),
     )
     parser.add_argument(
@@ -94,6 +167,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
     )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json", "github"),
+        default="text",
+        help="output format (json is byte-stable across identical runs)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -118,7 +197,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not files:
         print("graftlint: no python files under given paths", file=sys.stderr)
         return 2
-    findings = scan_paths(paths)
+    # one read per file: `files` is already walked for the empty-check, so
+    # feed the sources straight to the scan pipeline instead of re-walking
+    sources = {f: Path(f).read_text(encoding="utf-8") for f in files}
+    findings, stats = analyze_sources(
+        sources, scan_complete=package_scan_complete(files)
+    )
 
     baseline_path: Optional[Path] = None
     if not args.no_baseline:
@@ -167,11 +251,34 @@ def main(argv: Optional[List[str]] = None) -> int:
         in_scope = scan_scope(paths, files)
         baselined = {fp: c for fp, c in baselined.items() if in_scope(fp[0])}
     new, stale = baseline_mod.diff(findings, baselined)
+    summary = _rule_summary(stats, new)
 
-    for f in new:
-        print(f.render())
-    for s in stale:
-        print(f"stale baseline entry: {s}")
+    if args.format == "json":
+        _emit_json(
+            {
+                "version": JSON_VERSION,
+                "files": len(files),
+                "findings": [
+                    {
+                        "path": f.path,
+                        "line": f.line,
+                        "rule": f.rule,
+                        "message": f.message,
+                    }
+                    for f in new
+                ],
+                "stale": stale,
+                "summary": summary,
+            }
+        )
+    elif args.format == "github":
+        _emit_github(new, stale)
+    else:
+        for f in new:
+            print(f.render())
+        for s in stale:
+            print(f"stale baseline entry: {s}")
+        _print_summary_table(summary, len(stale))
     grandfathered = len(findings) - len(new)
     status = (
         f"graftlint: {len(files)} file(s), {len(new)} finding(s), "
@@ -180,6 +287,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     print(status, file=sys.stderr)
     return 1 if new or stale else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Exit-code contract wrapper: findings are 1, a crash in the analyzer
+    itself is 2 — CI must be able to tell a failed ratchet from a broken
+    gate."""
+    try:
+        return _run(argv)
+    except Exception:  # noqa: BLE001 — the boundary IS the contract here
+        print("graftlint: internal analyzer error:", file=sys.stderr)
+        traceback.print_exc()
+        return 2
 
 
 if __name__ == "__main__":

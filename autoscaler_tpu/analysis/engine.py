@@ -14,10 +14,15 @@ Architecture:
 - :class:`FileModel` is built ONCE per file (one ``ast.parse``, one
   ``tokenize`` pass for suppression pragmas, one import-alias map) and
   handed to every rule — single parse, many checkers.
-- Rules live in :mod:`autoscaler_tpu.analysis.rules`; each is a small
-  class with a ``check(model) -> list[Finding]`` method. Rules scope
+- Per-file rules live in :mod:`autoscaler_tpu.analysis.rules`; each is a
+  small class with a ``check(model) -> list[Finding]`` method. Rules scope
   themselves to module subsets via :meth:`FileModel.in_module` (paths
   relative to the ``autoscaler_tpu`` package root).
+- Whole-program rules (``check_program(graph) -> list[Finding]``) run
+  after every file is parsed, over the cross-module call graph
+  (:mod:`autoscaler_tpu.analysis.callgraph`) built from the same models —
+  jit purity's true transitive reach (GL006), kernel contracts (GL007),
+  lock ordering (GL008), flag wiring (GL009).
 - Findings are suppressed inline with
   ``# graftlint: disable=RULE[,RULE] — reason`` on the offending line or
   on a comment-only line directly above it. A pragma without a reason is
@@ -33,7 +38,7 @@ import ast
 import io
 import re
 import tokenize
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
@@ -45,6 +50,33 @@ PRAGMA_RE = re.compile(
     r"graftlint:\s*disable=([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
     r"(?:\s*(?:[—–:-]|--)\s*(?P<reason>\S.*))?"
 )
+
+
+def terminal_name(func: ast.AST) -> Optional[str]:
+    """Last segment of a call target: ``a.b.c(...)`` → ``c``, ``f(...)`` → ``f``."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """``self._x`` → ``_x`` (the attribute written), unwrapping subscripts:
+    ``self._items[k] = v`` writes through ``_items``."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def is_lock_attr(name: str) -> bool:
+    return name.startswith("_") and name.endswith("lock")
 
 
 @dataclass(frozen=True)
@@ -222,48 +254,112 @@ def _suppressed(
     return False
 
 
-def check_source(
-    source: str, path: str, rules: Optional[Sequence] = None
-) -> List[Finding]:
-    """Run every rule over one file's source. ``path`` drives rule scoping
-    (it need not exist on disk — fixture tests pass virtual
-    ``autoscaler_tpu/...`` paths)."""
-    if rules is None:
-        from autoscaler_tpu.analysis.rules import ALL_RULES
+@dataclass
+class ScanStats:
+    """Per-rule accounting for the CI summary table: how many findings each
+    rule RAISED (pre-baseline), and how many were pragma-suppressed. The
+    baselined split is layered on by the CLI (it owns the ledger)."""
 
-        rules = ALL_RULES
-    pragmas, findings = parse_pragmas(source, path)
-    try:
-        model = FileModel(path, source)
-    except (SyntaxError, ValueError) as e:
-        # ValueError: ast.parse refuses NUL bytes — one corrupt file must
-        # degrade to a finding, not abort the whole scan
-        return [
-            Finding(
-                path=display_path(path),
-                line=getattr(e, "lineno", None) or 1,
-                rule="GL000",
-                message=(
-                    f"file does not parse: {getattr(e, 'msg', None) or e}"
-                ),
+    files: int = 0
+    findings_by_rule: Dict[str, int] = field(default_factory=dict)
+    suppressed_by_rule: Dict[str, int] = field(default_factory=dict)
+
+    def note(self, rule: str, suppressed: bool) -> None:
+        bucket = self.suppressed_by_rule if suppressed else self.findings_by_rule
+        bucket[rule] = bucket.get(rule, 0) + 1
+
+
+def analyze_sources(
+    sources: Dict[str, str],
+    rules: Optional[Sequence] = None,
+    program_rules: Optional[Sequence] = None,
+    scan_complete: bool = True,
+) -> Tuple[List[Finding], ScanStats]:
+    """The one scan pipeline: parse every file once, run the per-file rules,
+    build the whole-program call graph, run the program rules, then apply
+    suppression pragmas (per finding, against the file it landed in).
+    Paths drive rule scoping and need not exist on disk — fixture tests pass
+    virtual ``autoscaler_tpu/...`` paths."""
+    if program_rules is None:
+        # an explicit per-file `rules` subset means "only these": program
+        # rules then run only when asked for, preserving the pre-whole-
+        # program scoping of these entry points
+        if rules is not None:
+            program_rules = ()
+        else:
+            from autoscaler_tpu.analysis import rules as rules_mod
+
+            program_rules = rules_mod.ALL_PROGRAM_RULES
+    if rules is None:
+        from autoscaler_tpu.analysis import rules as rules_mod
+
+        rules = rules_mod.ALL_RULES
+
+    stats = ScanStats(files=len(sources))
+    findings: List[Finding] = []
+    models: List[FileModel] = []
+    by_path: Dict[str, Tuple[Dict[int, Set[str]], List[str]]] = {}
+    for path in sorted(sources):
+        source = sources[path]
+        pragmas, pragma_findings = parse_pragmas(source, path)
+        findings.extend(pragma_findings)
+        try:
+            model = FileModel(path, source)
+        except (SyntaxError, ValueError) as e:
+            # ValueError: ast.parse refuses NUL bytes — one corrupt file must
+            # degrade to a finding, not abort the whole scan
+            findings.append(
+                Finding(
+                    path=display_path(path),
+                    line=getattr(e, "lineno", None) or 1,
+                    rule="GL000",
+                    message=(
+                        f"file does not parse: {getattr(e, 'msg', None) or e}"
+                    ),
+                )
             )
-        ]
-    for rule in rules:
-        findings.extend(rule.check(model))
+            continue
+        by_path[model.path] = (pragmas, model.lines)
+        models.append(model)
+        for rule in rules:
+            findings.extend(rule.check(model))
+
+    if models and program_rules:
+        from autoscaler_tpu.analysis.callgraph import CallGraph
+
+        graph = CallGraph(models)
+        # whole-package-quantified rules (GL009) silence themselves on a
+        # partial disk scan: "never read anywhere" cannot be proven when
+        # the readers may live outside the scanned subtree
+        graph.scan_complete = scan_complete
+        for prule in program_rules:
+            findings.extend(prule.check_program(graph))
+
     # GL000 (pragma hygiene / parse failure) is deliberately unsuppressible:
     # a reasonless pragma that lists GL000 alongside the rule it silences
     # must not be able to waive the mandatory-reason contract it violates
-    findings = [
-        f
-        for f in findings
-        if f.rule == "GL000" or not _suppressed(f, pragmas, model.lines)
-    ]
-    return sorted(findings, key=Finding.sort_key)
+    kept: List[Finding] = []
+    for f in findings:
+        pragmas, lines = by_path.get(f.path, ({}, []))
+        suppressed = f.rule != "GL000" and _suppressed(f, pragmas, lines)
+        stats.note(f.rule, suppressed)
+        if not suppressed:
+            kept.append(f)
+    return sorted(kept, key=Finding.sort_key), stats
 
 
-def scan_file(path: str, rules: Optional[Sequence] = None) -> List[Finding]:
-    source = Path(path).read_text(encoding="utf-8")
-    return check_source(source, path, rules)
+def check_source(
+    source: str,
+    path: str,
+    rules: Optional[Sequence] = None,
+    program_rules: Optional[Sequence] = None,
+) -> List[Finding]:
+    """Run every rule (per-file AND whole-program, over a one-file program)
+    against one source. Kept as the fixture-test entry point."""
+    findings, _ = analyze_sources(
+        {path: source}, rules=rules, program_rules=program_rules
+    )
+    return findings
 
 
 def iter_python_files(paths: Iterable[str]) -> List[str]:
@@ -281,10 +377,49 @@ def iter_python_files(paths: Iterable[str]) -> List[str]:
     return sorted(out)
 
 
-def scan_paths(
-    paths: Iterable[str], rules: Optional[Sequence] = None
+def package_scan_complete(files: Iterable[str]) -> bool:
+    """Does the scanned file set cover every .py of each on-disk package
+    root it touches? Whole-package rules (GL009) need this: a subtree scan
+    cannot prove an option is 'never read anywhere in the package'."""
+    scanned = {Path(f).as_posix() for f in files}
+    roots: Set[str] = set()
+    for f in scanned:
+        mod = module_path(f)
+        if mod is not None and f.endswith(mod):
+            roots.add(f[: -len(mod)])
+    for root in roots:
+        for disk in Path(root).rglob("*.py"):
+            if "__pycache__" not in disk.parts and disk.as_posix() not in scanned:
+                return False
+    return True
+
+
+def analyze_paths(
+    paths: Iterable[str],
+    rules: Optional[Sequence] = None,
+    program_rules: Optional[Sequence] = None,
+) -> Tuple[List[Finding], ScanStats]:
+    files = iter_python_files(paths)
+    sources = {f: Path(f).read_text(encoding="utf-8") for f in files}
+    return analyze_sources(
+        sources,
+        rules=rules,
+        program_rules=program_rules,
+        scan_complete=package_scan_complete(files),
+    )
+
+
+def scan_file(
+    path: str,
+    rules: Optional[Sequence] = None,
+    program_rules: Optional[Sequence] = None,
 ) -> List[Finding]:
-    findings: List[Finding] = []
-    for f in iter_python_files(paths):
-        findings.extend(scan_file(f, rules))
-    return sorted(findings, key=Finding.sort_key)
+    return analyze_paths([path], rules=rules, program_rules=program_rules)[0]
+
+
+def scan_paths(
+    paths: Iterable[str],
+    rules: Optional[Sequence] = None,
+    program_rules: Optional[Sequence] = None,
+) -> List[Finding]:
+    return analyze_paths(paths, rules=rules, program_rules=program_rules)[0]

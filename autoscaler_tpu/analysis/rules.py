@@ -1,10 +1,14 @@
-"""graftlint rules GL001–GL006 — each derived from an invariant the
+"""graftlint rules GL001–GL009 — each derived from an invariant the
 codebase already claims. See RULES.md (same directory) for the catalog,
 rationale, and suppression etiquette.
 
-Every rule is a small class: ``rule_id``, ``title``, and
-``check(model: FileModel) -> list[Finding]``. Rules walk the one shared
-AST; nothing here imports beyond the stdlib.
+Per-file rules (GL001–GL005) are small classes with ``rule_id``, ``title``
+and ``check(model: FileModel) -> list[Finding]``; they walk the one shared
+AST. Whole-program rules (GL006–GL009) implement
+``check_program(graph: CallGraph) -> list[Finding]`` instead and see every
+file at once — GL006 jit purity lives here, the kernel contract checker
+(GL007), lock-order analysis (GL008) and flag wiring (GL009) live in their
+own modules. Nothing here imports beyond the stdlib.
 """
 from __future__ import annotations
 
@@ -12,18 +16,19 @@ import ast
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Set
 
-from autoscaler_tpu.analysis.engine import FileModel, Finding
+from autoscaler_tpu.analysis.callgraph import MODULE_NODE, CallGraph
+from autoscaler_tpu.analysis.contracts import KernelContractChecker
+from autoscaler_tpu.analysis.engine import (
+    FileModel,
+    Finding,
+    is_lock_attr as _is_lock_attr,
+    self_attr as _self_attr,
+    terminal_name as _terminal_name,
+)
+from autoscaler_tpu.analysis.flags import FlagWiringChecker
+from autoscaler_tpu.analysis.lockgraph import LockOrderChecker
 
 # -- shared helpers -----------------------------------------------------------
-
-
-def _terminal_name(func: ast.AST) -> Optional[str]:
-    """Last segment of a call target: ``a.b.c(...)`` → ``c``, ``f(...)`` → ``f``."""
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    if isinstance(func, ast.Name):
-        return func.id
-    return None
 
 
 def _enclosing_functions(tree: ast.AST) -> Dict[ast.AST, str]:
@@ -248,23 +253,6 @@ class LadderBypass:
 THREADED_SCOPES = ("metrics/", "trace/recorder.py", "utils/circuit.py", "kube/client.py")
 
 
-def _is_lock_attr(name: str) -> bool:
-    return name.startswith("_") and name.endswith("lock")
-
-
-def _self_attr(node: ast.AST) -> Optional[str]:
-    """``self._x`` → ``_x`` (the attribute written), unwrapping subscripts:
-    ``self._items[k] = v`` writes through ``_items``."""
-    while isinstance(node, ast.Subscript):
-        node = node.value
-    if (
-        isinstance(node, ast.Attribute)
-        and isinstance(node.value, ast.Name)
-        and node.value.id == "self"
-    ):
-        return node.attr
-    return None
-
 
 class LockDiscipline:
     rule_id = "GL004"
@@ -439,36 +427,33 @@ _LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical", "l
 
 
 class JitPurity:
+    """Whole-program GL006: roots are every jit/vmap/pallas-wrapped
+    definition anywhere; reachability is the TRUE transitive closure over
+    the cross-module call graph (import-alias resolved), so a jitted
+    function in ``ops/`` calling a helper imported from ``snapshot/``
+    taints that helper too — the per-file version this replaces stopped at
+    the module boundary (the old "known limit" in RULES.md)."""
+
     rule_id = "GL006"
     title = "host side effect inside a jit/vmap/pallas-reached function"
 
-    def check(self, model: FileModel) -> List[Finding]:
-        defs = self._local_defs(model.tree)
-        roots = self._jit_roots(model)
-        # within-file transitive closure: a jitted fn calling a local helper
-        # taints the helper too (cross-module reach is out of scope; RULES.md)
-        reached: Set[str] = set()
-        work = [r for r in roots if r in defs]
-        while work:
-            name = work.pop()
-            if name in reached:
-                continue
-            reached.add(name)
-            for node in ast.walk(defs[name]):
-                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
-                    callee = node.func.id
-                    if callee in defs and callee not in reached:
-                        work.append(callee)
+    def check_program(self, graph: CallGraph) -> List[Finding]:
+        roots: Set[str] = set()
+        for model in graph.models:
+            roots |= self._jit_roots(graph, model)
         out: List[Finding] = []
-        for name in sorted(reached):
-            fn = defs[name]
-            for node in ast.walk(fn):
+        for fq in sorted(graph.reachable(roots)):
+            info = graph.defs[fq]
+            if info.local == MODULE_NODE:
+                continue
+            name = info.local.split(".")[-1]
+            for node in self._own_region(info.node):
                 if not isinstance(node, ast.Call):
                     continue
-                why = self._banned(model, node)
+                why = self._banned(info.model, node)
                 if why is not None:
                     out.append(
-                        model.finding(
+                        info.model.finding(
                             node,
                             self.rule_id,
                             f"{why} inside {name}(), which is reached from a "
@@ -480,27 +465,51 @@ class JitPurity:
         return out
 
     @staticmethod
-    def _local_defs(tree: ast.AST) -> Dict[str, ast.AST]:
-        return {
-            n.name: n
-            for n in ast.walk(tree)
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-        }
-
-    def _jit_roots(self, model: FileModel) -> Set[str]:
-        roots: Set[str] = set()
-        for node in ast.walk(model.tree):
+    def _own_region(fn: ast.AST):
+        """The def's body EXCLUDING nested defs (those are their own graph
+        nodes, reached via containment — walking them here would double-
+        report every finding)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                for dec in node.decorator_list:
-                    if self._is_jit_expr(model, dec):
-                        roots.add(node.name)
-            elif isinstance(node, ast.Call) and self._is_jit_name(model, node.func):
-                # jax.jit(fn) / vmap(fn) / pallas_call(kernel, ...): the
-                # first Name argument is the traced function
-                for arg in node.args[:1]:
-                    if isinstance(arg, ast.Name):
-                        roots.add(arg.id)
-        return roots
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _jit_roots(self, graph: CallGraph, model: FileModel) -> Set[str]:
+        from autoscaler_tpu.analysis.callgraph import dotted_module
+
+        dm = dotted_module(model)
+        roots: Set[str] = set()
+
+        def walk(node: ast.AST, stack: List[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if any(
+                        self._is_jit_expr(model, dec)
+                        for dec in child.decorator_list
+                    ):
+                        roots.add(f"{dm}." + ".".join(stack + [child.name]))
+                    walk(child, stack + [child.name])
+                elif isinstance(child, ast.ClassDef):
+                    walk(child, stack + [child.name])
+                else:
+                    if isinstance(child, ast.Call) and self._is_jit_name(
+                        model, child.func
+                    ):
+                        # jax.jit(fn) / vmap(fn) / pallas_call(kernel, ...):
+                        # the first Name argument is the traced function
+                        for arg in child.args[:1]:
+                            if isinstance(arg, ast.Name):
+                                fq = graph.resolve(model, arg)
+                                if fq is not None:
+                                    roots.add(fq)
+                    walk(child, stack)
+
+        if dm is not None:
+            walk(model.tree, [])
+        return {r for r in roots if r in graph.defs}
 
     def _is_jit_expr(self, model: FileModel, node: ast.AST) -> bool:
         """Decorator forms: @jax.jit, @jit, @partial(jax.jit, ...)."""
@@ -546,13 +555,23 @@ class JitPurity:
         return None
 
 
+# per-file rules: one FileModel in, findings out
 ALL_RULES: Sequence = (
     WallClockInReplayPath(),
     SpanNameTaxonomy(),
     LadderBypass(),
     LockDiscipline(),
     ErrorBoundary(),
-    JitPurity(),
 )
 
-RULE_CATALOG = {r.rule_id: r.title for r in ALL_RULES}
+# whole-program rules: the cross-module CallGraph in, findings out
+ALL_PROGRAM_RULES: Sequence = (
+    JitPurity(),
+    KernelContractChecker(),
+    LockOrderChecker(),
+    FlagWiringChecker(),
+)
+
+RULE_CATALOG = {
+    r.rule_id: r.title for r in (*ALL_RULES, *ALL_PROGRAM_RULES)
+}

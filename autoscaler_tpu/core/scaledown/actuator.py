@@ -375,12 +375,22 @@ class ScaleDownActuator:
         self, all_nodes: Sequence[Node], unneeded_names: Sequence[str]
     ) -> int:
         """Keep DeletionCandidate (PreferNoSchedule) taints in sync with the
-        current unneeded set, bounded by the bulk budget."""
+        current unneeded set, bounded by the bulk count budget AND the time
+        budget (reference softtaint.go:77 — each taint is one API round
+        trip, and a slow control plane must not let this housekeeping eat
+        the whole tick). The clock is the tracer's timeline seam, so the
+        budget check replays deterministically under loadgen."""
+        from autoscaler_tpu import trace
+
         budget = self.options.max_bulk_soft_taint_count
+        time_budget = self.options.max_bulk_soft_taint_time_s
+        t0 = trace.timeline_now()
         changed = 0
         unneeded = set(unneeded_names)
         for node in all_nodes:
             if changed >= budget:
+                break
+            if time_budget > 0 and trace.timeline_now() - t0 > time_budget:
                 break
             has = any(t.key == DELETION_CANDIDATE_TAINT for t in node.taints)
             if node.name in unneeded and not has:

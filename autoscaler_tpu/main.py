@@ -132,6 +132,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--ignore-daemonsets-utilization", action="store_true")
     p.add_argument("--ignore-taint", action="append", default=[],
                    help="startup taint key ignored in templates (repeatable)")
+    p.add_argument("--balancing-label", action="append", default=[],
+                   help="compare node groups for similarity using ONLY "
+                        "these label values (reference --balancing-label; "
+                        "repeatable; overrides the resource comparator)")
     p.add_argument("--balancing-ignore-label", action="append", default=[],
                    help="extra label excluded from group similarity (repeatable)")
     p.add_argument("--node-group-auto-discovery", action="append", default=[],
@@ -286,6 +290,7 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         cordon_node_before_terminating=args.cordon_node_before_terminating,
         ignore_daemonsets_utilization=args.ignore_daemonsets_utilization,
         ignored_taints=list(args.ignore_taint),
+        balancing_label_keys=list(args.balancing_label),
         balancing_extra_ignored_labels=list(args.balancing_ignore_label),
         node_group_auto_discovery=list(args.node_group_auto_discovery),
         cluster_name=args.cluster_name,
@@ -627,11 +632,11 @@ def main(argv=None) -> int:
               "(--kube-api or --kubeconfig)", file=sys.stderr)
         return 2
 
-    if args.provider == "test":
+    if opts.cloud_provider == "test":
         from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
 
         provider = TestCloudProvider()
-    elif args.provider == "gce":
+    elif opts.cloud_provider == "gce":
         from autoscaler_tpu.cloudprovider.gce import build_gce_provider
         from autoscaler_tpu.cloudprovider.gce_rest import (
             DEFAULT_BASE_URL,
@@ -687,7 +692,7 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 2
-    elif args.provider in ("externalgrpc", "externalgrpc-ref"):
+    elif opts.cloud_provider in ("externalgrpc", "externalgrpc-ref"):
         # endpoint from the reference-shaped --cloud-config ({address: ...})
         address = ""
         if args.cloud_config:
@@ -704,13 +709,13 @@ def main(argv=None) -> int:
             ) else ""
         if not address:
             print(
-                f"--provider={args.provider} needs --cloud-config with an "
+                f"--provider={opts.cloud_provider} needs --cloud-config with an "
                 "`address: host:port` entry (reference externalgrpc "
                 "README.md contract)",
                 file=sys.stderr,
             )
             return 2
-        if args.provider == "externalgrpc":
+        if opts.cloud_provider == "externalgrpc":
             from autoscaler_tpu.cloudprovider.external_grpc import (
                 ExternalGrpcCloudProvider,
             )
@@ -720,7 +725,7 @@ def main(argv=None) -> int:
             from autoscaler_tpu.rpc.refcompat import RefProtocolCloudProvider
 
             provider = RefProtocolCloudProvider(address)
-    elif args.provider == "clusterapi":
+    elif opts.cloud_provider == "clusterapi":
         # the management cluster IS the cloud: scale MachineDeployments/
         # MachineSets through the same control plane the autoscaler watches
         # (reference cloudprovider/clusterapi; annotation-driven discovery)
@@ -769,7 +774,7 @@ def main(argv=None) -> int:
             return 2
     else:
         print(
-            f"unknown cloud provider {args.provider!r} (available: test, "
+            f"unknown cloud provider {opts.cloud_provider!r} (available: test, "
             "gce, externalgrpc, externalgrpc-ref, clusterapi)",
             file=sys.stderr,
         )

@@ -30,6 +30,71 @@ from autoscaler_tpu.kube.objects import CPU, MEMORY
 
 BIG_I32 = jnp.int32(2**30)  # "no domain yet" sentinel in spread minimums
 
+# Machine-readable kernel contracts (graftlint GL007, analysis/contracts.py):
+# AST-extracted, never imported. The XLA scans have no pallas grid to prove,
+# but the dim-symbol ties and dtypes are checked at every dispatch site, and
+# shared operand names must agree with the Pallas twins on rank and dtype.
+# (The run-compressed kernels rename the pod axis P to the run axis U.)
+KERNEL_CONTRACTS = {
+    "ffd_binpack": {
+        "args": {
+            "pod_req": {"dims": ["P", "R"], "dtype": "f32"},
+            "pod_mask": {"dims": ["P"], "dtype": "bool"},
+            "template_alloc": {"dims": ["R"], "dtype": "f32"},
+        },
+        "static": {"max_nodes": {"min": 1}},
+    },
+    "ffd_binpack_groups": {
+        "args": {
+            "pod_req": {"dims": ["P", "R"], "dtype": "f32"},
+            "pod_masks": {"dims": ["G", "P"], "dtype": "bool"},
+            "template_allocs": {"dims": ["G", "R"], "dtype": "f32"},
+            "node_caps": {"dims": ["G"], "dtype": "i32"},
+        },
+        "static": {"max_nodes": {"min": 1}},
+    },
+    "ffd_binpack_groups_runs": {
+        "args": {
+            "run_req": {"dims": ["U", "R"], "dtype": "f32"},
+            "run_counts": {"dims": ["U"], "dtype": "i32"},
+            "run_masks": {"dims": ["G", "U"], "dtype": "bool"},
+            "template_allocs": {"dims": ["G", "R"], "dtype": "f32"},
+            "node_caps": {"dims": ["G"], "dtype": "i32"},
+        },
+        "static": {"max_nodes": {"min": 1}},
+    },
+    "ffd_binpack_groups_runs_affinity": {
+        "args": {
+            "run_req": {"dims": ["U", "R"], "dtype": "f32"},
+            "run_counts": {"dims": ["U"], "dtype": "i32"},
+            "run_masks": {"dims": ["G", "U"], "dtype": "bool"},
+            "template_allocs": {"dims": ["G", "R"], "dtype": "f32"},
+            "involved": {"dims": ["U"], "dtype": "bool"},
+            "match": {"dims": ["T", "U"], "dtype": "bool"},
+            "aff_of": {"dims": ["T", "U"], "dtype": "bool"},
+            "anti_of": {"dims": ["T", "U"], "dtype": "bool"},
+            "node_level": {"dims": ["T"], "dtype": "bool"},
+            "has_label": {"dims": ["G", "T"], "dtype": "bool"},
+            "node_caps": {"dims": ["G"], "dtype": "i32"},
+        },
+        "static": {"max_nodes": {"min": 1}},
+    },
+    "ffd_binpack_groups_affinity": {
+        "args": {
+            "pod_req": {"dims": ["P", "R"], "dtype": "f32"},
+            "pod_masks": {"dims": ["G", "P"], "dtype": "bool"},
+            "template_allocs": {"dims": ["G", "R"], "dtype": "f32"},
+            "match": {"dims": ["T", "P"], "dtype": "bool"},
+            "aff_of": {"dims": ["T", "P"], "dtype": "bool"},
+            "anti_of": {"dims": ["T", "P"], "dtype": "bool"},
+            "node_level": {"dims": ["T"], "dtype": "bool"},
+            "has_label": {"dims": ["G", "T"], "dtype": "bool"},
+            "node_caps": {"dims": ["G"], "dtype": "i32"},
+        },
+        "static": {"max_nodes": {"min": 1}},
+    },
+}
+
 
 class BinpackResult(NamedTuple):
     node_count: jax.Array   # i32 scalar (or [G]) — template nodes opened

@@ -79,6 +79,33 @@ BIG_I32 = np.int32(2**31 - 1)
 _STEP_TILE = 8  # sublane tile: dynamic offsets must be provably 8-aligned
 VMEM_BUDGET = 15 * 1024 * 1024   # v5e has 16MB; leave Mosaic headroom
 
+# Machine-readable kernel contract (graftlint GL007, analysis/contracts.py):
+# AST-extracted, never imported. The lint proves the declared `grid` tiles
+# exactly under the `pad` witnesses, that every `static` alignment has a
+# matching runtime guard, and checks dims/statics at every dispatch site.
+KERNEL_CONTRACTS = {
+    "ffd_binpack_groups_pallas": {
+        "args": {
+            "pod_req": {"dims": ["P", "R"], "dtype": "f32"},
+            "pod_masks": {"dims": ["G", "P"], "dtype": "bool"},
+            "template_allocs": {"dims": ["G", "R"], "dtype": "f32"},
+            "node_caps": {"dims": ["G"], "dtype": "i32"},
+        },
+        "static": {
+            "chunk": {"multiple_of": "_STEP_TILE", "min": 8, "optional": True},
+            "max_nodes": {"min": 1},
+        },
+        "pad": {
+            "P_pad": ["P", "chunk"],
+            "G_pad": ["G", "group_block"],
+            "M_pad": ["max_nodes", "_STEP_TILE"],
+        },
+        "grid": ["G_pad // group_block", "P_pad // chunk"],
+        "pad_value": "+inf request rows (inactive pods sort last, fit nowhere)",
+        "vmem": "plain_vmem_estimate",
+    },
+}
+
 
 def plain_vmem_estimate(
     R: int, max_nodes: int, chunk: int, group_block: int = 128

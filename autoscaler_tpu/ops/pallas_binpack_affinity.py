@@ -79,6 +79,39 @@ from autoscaler_tpu.ops.pallas_binpack import (
 )
 
 
+# Machine-readable kernel contract (graftlint GL007, analysis/contracts.py).
+# Shared operand names (pod_req, pod_masks, ...) must agree with the plain
+# twin's contract on rank and dtype — the checker enforces it, so an
+# f32→i32 repack drift between the twins is a lint failure.
+KERNEL_CONTRACTS = {
+    "ffd_binpack_groups_affinity_pallas": {
+        "args": {
+            "pod_req": {"dims": ["P", "R"], "dtype": "f32"},
+            "pod_masks": {"dims": ["G", "P"], "dtype": "bool"},
+            "template_allocs": {"dims": ["G", "R"], "dtype": "f32"},
+            "match": {"dims": ["T", "P"], "dtype": "bool"},
+            "aff_of": {"dims": ["T", "P"], "dtype": "bool"},
+            "anti_of": {"dims": ["T", "P"], "dtype": "bool"},
+            "node_level": {"dims": ["T"], "dtype": "bool"},
+            "has_label": {"dims": ["G", "T"], "dtype": "bool"},
+            "node_caps": {"dims": ["G"], "dtype": "i32"},
+        },
+        "static": {
+            "chunk": {"multiple_of": "_STEP_TILE", "min": 8, "optional": True},
+            "max_nodes": {"min": 1},
+        },
+        "pad": {
+            "P_pad": ["P", "chunk"],
+            "G_pad": ["G", "group_block"],
+            "M_pad": ["max_nodes", "_STEP_TILE"],
+        },
+        "grid": ["G_pad // group_block", "P_pad // chunk"],
+        "pad_value": "+inf request rows; sentinel term bitsets on pad slots",
+        "vmem": "affinity_vmem_estimate",
+    },
+}
+
+
 def affinity_vmem_estimate(
     R: int, TP: int, max_nodes: int, chunk: int, group_block: int = 128,
     S: int = 0,

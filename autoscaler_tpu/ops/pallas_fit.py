@@ -37,6 +37,37 @@ _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerPar
 
 BIG_I32 = np.int32(2**31 - 1)
 
+# Machine-readable kernel contract (graftlint GL007, analysis/contracts.py):
+# AST-extracted, never imported. Dim symbols tie across operands at every
+# dispatch site; `static` constraints are mirrored by the runtime guards in
+# the entry; `pad` rules must be witnessed by the exact-padding idiom; the
+# `grid` must tile exactly under those pad facts.
+KERNEL_CONTRACTS = {
+    "pallas_fit_reduce": {
+        "args": {
+            "pod_req": {"dims": ["P", "R"], "dtype": "f32"},
+            "free": {"dims": ["N", "R"], "dtype": "f32"},
+            "pod_class": {"dims": ["P"], "dtype": "i32"},
+            "node_class": {"dims": ["N"], "dtype": "i32"},
+            "class_mask": {"dims": ["CP", "CN"], "dtype": "bool"},
+            "node_valid": {"dims": ["N"], "dtype": "bool"},
+        },
+        "static": {
+            "tp": {"multiple_of": 8, "min": 8},
+            "tn": {"multiple_of": 128, "min": 128},
+        },
+        "pad": {
+            "P_pad": ["P", "tp"],
+            "N_pad": ["N", "tn"],
+            "R_pad": ["R", 8],
+            "CP_pad": ["CP", 8],
+            "CN_pad": ["CN", 128],
+        },
+        "grid": ["P_pad // tp", "N_pad // tn"],
+        "pad_value": "+inf request row (padded pods fit nowhere); zero free",
+    },
+}
+
 
 class FitReduction(NamedTuple):
     any_fit: jax.Array    # [P] bool
@@ -121,9 +152,21 @@ def pallas_fit_reduce(
     interpret: bool | None = None,  # None = interpret off-TPU (CPU tests)
 ) -> FitReduction:
     """Blockwise-tiled fit over (P x N) without materializing the matrix."""
+    # tile divisibility guards (GL007 contract): P_pad // tp and
+    # N_pad // tn must tile exactly, and Mosaic needs the sublane/lane
+    # alignment — a bad explicit tile must fail loudly at trace time, not
+    # silently drop the tail tile of the grid
+    if tp <= 0 or tp % 8 != 0:
+        raise ValueError(f"tp must be a positive multiple of 8 (sublane tile); got {tp}")
+    if tn <= 0 or tn % 128 != 0:
+        raise ValueError(f"tn must be a positive multiple of 128 (lane tile); got {tn}")
     P, R = pod_req.shape
     N = free.shape[0]
-    R_pad = 8
+    # the resource axis pads to the sublane tile DYNAMICALLY: the fixed
+    # R_pad = 8 this replaces rejected any world with more than 8 resource
+    # axes (6 builtin + extended-resource/virtual planes overflow that at
+    # scale) — the .at[:, :R] scatter clamped to 8 columns and raised
+    R_pad = R + (-R) % 8
     P_pad = P + (-P) % tp
     N_pad = N + (-N) % tn
     CP, CN = class_mask.shape

@@ -42,9 +42,18 @@ def _within(a: float, b: float, max_ratio: float) -> bool:
 class BalancingNodeGroupSetProcessor:
     ratios: NodeGroupDifferenceRatios = field(default_factory=NodeGroupDifferenceRatios)
     ignored_labels: set = field(default_factory=lambda: set(DEFAULT_IGNORED_LABELS))
+    # non-empty -> the reference's --balancing-label mode: similarity is
+    # decided by these label values ALONE (CreateLabelNodeInfoComparator,
+    # compare_nodegroups.go:54) — resource/remaining-label comparisons are
+    # skipped entirely, per the flag's documented contract
+    label_keys: List[str] = field(default_factory=list)
 
     def is_similar(self, a: Node, b: Node) -> bool:
         """compare_nodegroups.go:84 IsCloudProviderNodeInfoSimilar."""
+        if self.label_keys:
+            return all(
+                a.labels.get(k) == b.labels.get(k) for k in self.label_keys
+            )
         if not _within(
             a.allocatable.cpu_m, b.allocatable.cpu_m,
             self.ratios.max_allocatable_difference_ratio,

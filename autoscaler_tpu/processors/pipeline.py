@@ -306,6 +306,7 @@ def default_processors(options=None) -> AutoscalingProcessors:
             ratios=options.node_group_difference_ratios,
             ignored_labels=set(DEFAULT_IGNORED_LABELS)
             | set(options.balancing_extra_ignored_labels),
+            label_keys=list(options.balancing_label_keys),
         )
         procs.template_node_info_provider = MixedTemplateNodeInfoProvider(
             ttl_s=options.node_info_cache_expire_time_s,

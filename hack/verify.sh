@@ -8,13 +8,28 @@ cd "$(dirname "$0")/.."
 echo "== python syntax/compile check =="
 python -m compileall -q autoscaler_tpu bench.py __graft_entry__.py
 
-echo "== graftlint (AST invariant gate: determinism, taxonomy, ladder, locks, boundaries, jit purity) =="
+echo "== graftlint (AST invariant gate: determinism, taxonomy, ladder, locks, boundaries, jit purity, kernel contracts, lock order, flag wiring) =="
 # Fatal. Exits nonzero on any finding not grandfathered in
 # hack/lint-baseline.json AND on stale baseline entries (a baselined
 # finding that no longer exists must be struck via --update-baseline, so
-# the debt ledger can only shrink). Rule catalog:
-# autoscaler_tpu/analysis/RULES.md
+# the debt ledger can only shrink). The text run prints the per-rule
+# findings/suppressions/baseline summary table so CI logs show ratchet
+# drift at a glance. Rule catalog: autoscaler_tpu/analysis/RULES.md
 python -m autoscaler_tpu.analysis autoscaler_tpu/
+
+echo "== graftlint determinism (two runs must emit byte-identical JSON) =="
+# The analyzer polices replay determinism; it must hold itself to the same
+# bar — finding order stable regardless of dict/set iteration.
+lint_tmp=$(mktemp -d)
+python -m autoscaler_tpu.analysis --format=json autoscaler_tpu/ > "$lint_tmp/a.json"
+python -m autoscaler_tpu.analysis --format=json autoscaler_tpu/ > "$lint_tmp/b.json"
+if ! diff -q "$lint_tmp/a.json" "$lint_tmp/b.json" >/dev/null; then
+    echo "ERROR: graftlint JSON output is nondeterministic across identical runs:" >&2
+    diff "$lint_tmp/a.json" "$lint_tmp/b.json" | head -20 >&2
+    exit 1
+fi
+echo "graftlint determinism ok"
+rm -rf "$lint_tmp"
 
 echo "== proto freshness check =="
 tmp=$(mktemp -d)

@@ -1,15 +1,18 @@
 """graftlint (autoscaler_tpu/analysis): per-rule positive/negative fixtures,
-pragma suppression, baseline round-trip + stale ratchet, CLI contract, and
-the self-check that the repo (with its shipped baseline) and the analysis
-package itself scan clean.
+pragma suppression, baseline round-trip + stale ratchet, whole-program
+rules (cross-module GL006 reach, GL007 kernel contracts, GL008 lock order,
+GL009 flag wiring), CLI contract (formats, exit codes, summary table,
+byte-stable JSON), and the self-check that the repo (with its shipped
+baseline) and the analysis package itself scan clean.
 
-Fixture paths are *virtual* — ``check_source`` scopes rules on the path
-string, no file need exist — except for the CLI/baseline tests, which
-build a real miniature ``autoscaler_tpu/`` tree in tmp_path.
+Fixture paths are *virtual* — ``check_source``/``analyze_sources`` scope
+rules on the path string, no file need exist — except for the CLI/baseline
+tests, which build a real miniature ``autoscaler_tpu/`` tree in tmp_path.
 """
 from __future__ import annotations
 
 import json
+import re
 import subprocess
 import sys
 import textwrap
@@ -18,7 +21,7 @@ from pathlib import Path
 import pytest
 
 from autoscaler_tpu.analysis import baseline as baseline_mod
-from autoscaler_tpu.analysis import check_source, scan_paths
+from autoscaler_tpu.analysis import analyze_sources, check_source, scan_paths
 from autoscaler_tpu.analysis.cli import main as cli_main
 from autoscaler_tpu.analysis.engine import display_path, module_path
 from autoscaler_tpu.analysis.rules import function_label_taxonomy
@@ -28,6 +31,13 @@ REPO = Path(__file__).resolve().parent.parent
 
 def findings(source: str, path: str):
     return check_source(textwrap.dedent(source), path)
+
+
+def multi_findings(sources: dict):
+    found, _ = analyze_sources(
+        {p: textwrap.dedent(s) for p, s in sources.items()}
+    )
+    return found
 
 
 def rules_of(found):
@@ -451,6 +461,818 @@ def test_gl006_host_side_effects_outside_jit_ok():
     assert found == []
 
 
+def test_gl006_cross_module_transitive_reach():
+    """The whole-program upgrade: a jitted function in ops/ calling a
+    helper imported from ANOTHER module taints that helper too — the old
+    per-file rule stopped at the module boundary."""
+    found = multi_findings({
+        "autoscaler_tpu/ops/kernel.py": """
+            import jax
+            from autoscaler_tpu.snapshot.helpers import leaky
+
+            @jax.jit
+            def kernel(x):
+                return leaky(x)
+            """,
+        "autoscaler_tpu/snapshot/helpers.py": """
+            def leaky(x):
+                print(x)
+                return x
+            """,
+    })
+    assert rules_of(found) == ["GL006"]
+    assert found[0].path == "autoscaler_tpu/snapshot/helpers.py"
+    assert "print()" in found[0].message
+
+
+def test_gl006_relative_import_in_package_init_resolves():
+    """A level-1 relative import inside a package __init__.py anchors on
+    the package ITSELF (`from .helpers import leaky` in snapshot/__init__
+    is snapshot.helpers.leaky) — resolving one level too high drops the
+    edge and GL006 goes blind."""
+    found = multi_findings({
+        "autoscaler_tpu/snapshot/__init__.py": """
+            import jax
+            from .helpers import leaky
+
+            @jax.jit
+            def reexported_kernel(x):
+                return leaky(x)
+            """,
+        "autoscaler_tpu/snapshot/helpers.py": """
+            def leaky(x):
+                print(x)
+                return x
+            """,
+    })
+    assert rules_of(found) == ["GL006"]
+    assert found[0].path == "autoscaler_tpu/snapshot/helpers.py"
+
+
+def test_explicit_rules_subset_skips_program_rules():
+    """scan entry points with an explicit per-file `rules` subset must not
+    silently run the whole-program rules too (pre-whole-program API
+    scoping): program rules run only by default or when asked for."""
+    from autoscaler_tpu.analysis import rules as rules_mod
+
+    sources = {
+        "autoscaler_tpu/ops/kernel.py": textwrap.dedent("""
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                print(x)
+                return x
+            """),
+    }
+    scoped, _ = analyze_sources(sources, rules=[rules_mod.WallClockInReplayPath()])
+    assert scoped == []
+    default, _ = analyze_sources(sources)
+    assert rules_of(default) == ["GL006"]
+    explicit, _ = analyze_sources(
+        sources, rules=(), program_rules=[rules_mod.JitPurity()]
+    )
+    assert rules_of(explicit) == ["GL006"]
+
+
+def test_gl006_cross_module_respects_import_aliases():
+    found = multi_findings({
+        "autoscaler_tpu/ops/kernel.py": """
+            import jax
+            from autoscaler_tpu.snapshot.helpers import leaky as quiet
+
+            def outer(x):
+                return jax.jit(traced)(x)
+
+            def traced(x):
+                return quiet(x)
+            """,
+        "autoscaler_tpu/snapshot/helpers.py": """
+            def leaky(x):
+                print(x)
+                return x
+            """,
+    })
+    assert rules_of(found) == ["GL006"]
+
+
+def test_gl006_unreached_cross_module_helper_not_flagged():
+    found = multi_findings({
+        "autoscaler_tpu/ops/kernel.py": """
+            import jax
+            from autoscaler_tpu.snapshot.helpers import leaky
+
+            @jax.jit
+            def kernel(x):
+                return x + 1
+
+            def host(x):
+                return leaky(kernel(x))
+            """,
+        "autoscaler_tpu/snapshot/helpers.py": """
+            def leaky(x):
+                print(x)
+                return x
+            """,
+    })
+    assert found == []
+
+
+# -- GL007 kernel contracts ---------------------------------------------------
+
+_KERNEL_MODULE = """
+    import jax
+    from jax.experimental import pallas as pl
+
+    _STEP_TILE = 8
+
+    KERNEL_CONTRACTS = {
+        "my_kernel": {
+            "args": {
+                "pod_req": {"dims": ["P", "R"], "dtype": "f32"},
+                "pod_masks": {"dims": ["G", "P"], "dtype": "bool"},
+            },
+            "static": {"chunk": {"multiple_of": "_STEP_TILE", "min": 8}},
+            "pad": {"P_pad": ["P", "chunk"]},
+            "grid": ["P_pad // chunk"],
+        },
+    }
+
+
+    def my_kernel(pod_req, pod_masks, chunk, max_nodes=8):
+        if chunk % _STEP_TILE != 0:
+            raise ValueError("chunk must be a multiple of the tile")
+        P = pod_req.shape[0]
+        P_pad = P + (-P) % chunk
+        return pl.pallas_call(
+            _body,
+            grid=(P_pad // chunk,),
+        )(pod_req)
+
+
+    def _body(ref):
+        pass
+    """
+
+
+def test_gl007_seeded_chunk_violation_with_dispatch_trace():
+    """The acceptance-criteria case: chunk=12 against _STEP_TILE=8 caught
+    at lint time, message carries the dispatch-site→kernel trace."""
+    found = multi_findings({
+        "autoscaler_tpu/ops/mykernel.py": _KERNEL_MODULE,
+        "autoscaler_tpu/estimator/dispatch.py": """
+            from autoscaler_tpu.ops.mykernel import my_kernel
+
+            def estimate(req, masks):
+                return my_kernel(req, masks, chunk=12)
+            """,
+    })
+    assert rules_of(found) == ["GL007"]
+    f = found[0]
+    assert f.path == "autoscaler_tpu/estimator/dispatch.py"
+    assert "chunk=12" in f.message
+    assert "autoscaler_tpu.estimator.dispatch.estimate" in f.message
+    assert "my_kernel" in f.message
+    assert "_STEP_TILE(=8)" in f.message
+
+
+def test_gl007_aligned_dispatch_clean():
+    found = multi_findings({
+        "autoscaler_tpu/ops/mykernel.py": _KERNEL_MODULE,
+        "autoscaler_tpu/estimator/dispatch.py": """
+            from autoscaler_tpu.ops.mykernel import my_kernel
+
+            def estimate(req, masks):
+                return my_kernel(req, masks, chunk=16)
+            """,
+    })
+    assert found == []
+
+
+def test_gl007_rank_and_symbol_conflicts_from_shape_inference():
+    found = multi_findings({
+        "autoscaler_tpu/ops/mykernel.py": _KERNEL_MODULE,
+        "autoscaler_tpu/estimator/dispatch.py": """
+            import numpy as np
+            from autoscaler_tpu.ops.mykernel import my_kernel
+
+            def bad_rank():
+                req = np.zeros((100,))
+                masks = np.zeros((4, 100))
+                return my_kernel(req, masks, chunk=8)
+
+            def bad_symbol():
+                req = np.zeros((100, 6))
+                masks = np.zeros((4, 101))
+                return my_kernel(req, masks, chunk=8)
+
+            def fine():
+                req = np.zeros((100, 6))
+                masks = np.zeros((4, 100))
+                return my_kernel(req, masks, chunk=8)
+            """,
+    })
+    assert rules_of(found) == ["GL007", "GL007"]
+    assert "rank 1" in found[0].message
+    assert "dim symbol P" in found[1].message
+
+
+def test_gl007_shape_env_is_flow_conservative():
+    """Rebinding a dispatch operand (after the call, or path-dependently)
+    must not produce findings: ShapeEnv only acts on single, dominating
+    bindings — the fatal gate cannot afford flow-insensitive false
+    positives."""
+    found = multi_findings({
+        "autoscaler_tpu/ops/mykernel.py": _KERNEL_MODULE,
+        "autoscaler_tpu/estimator/dispatch.py": """
+            import numpy as np
+            from autoscaler_tpu.ops.mykernel import my_kernel
+
+            def rebound_after_call(masks):
+                req = np.zeros((100, 6))
+                out = my_kernel(req, masks, chunk=8)
+                req = req[0]
+                return out, req
+
+            def branch_dependent(small, masks):
+                if small:
+                    req = np.zeros((3,))
+                else:
+                    req = np.zeros((100, 6))
+                return my_kernel(req, masks, chunk=8)
+
+            def param_shadow(req, masks):
+                if req is None:
+                    req = np.zeros((5,))
+                return my_kernel(req, masks, chunk=8)
+
+            def bound_after_call_only(req, masks):
+                out = my_kernel(req, masks, chunk=8)
+                req = np.zeros((7,))
+                return out, req
+            """,
+    })
+    assert found == []
+
+
+def test_gl007_grid_via_local_variable():
+    """`grid = (...)` then `pallas_call(..., grid=grid)` (the ops/pallas_fit
+    idiom) must still be matched against the declared grid — and drift
+    between the two must be caught, not silently skipped."""
+    var_grid = _KERNEL_MODULE.replace(
+        "        return pl.pallas_call(\n"
+        "            _body,\n"
+        "            grid=(P_pad // chunk,),\n"
+        "        )(pod_req)",
+        "        grid = (P_pad // chunk,)\n"
+        "        return pl.pallas_call(\n"
+        "            _body,\n"
+        "            grid=grid,\n"
+        "        )(pod_req)",
+    )
+    assert "grid = (P_pad // chunk,)" in var_grid  # replacement applied
+    clean = multi_findings({"autoscaler_tpu/ops/mykernel.py": var_grid})
+    assert clean == []
+    drifted = multi_findings({
+        "autoscaler_tpu/ops/mykernel.py": var_grid.replace(
+            '"grid": ["P_pad // chunk"],',
+            '"grid": ["P_pad // chunk", "N_pad // chunk"],',
+        ),
+    })
+    assert "GL007" in rules_of(drifted)
+    assert any("no pallas_call in the module uses it" in f.message
+               for f in drifted)
+
+
+def test_gl007_pad_witness_symbolic_divisor_mismatch():
+    """Contract divisor `chunk` vs idiom divisor `other` where neither
+    resolves to a module constant is drift, not agreement (None == None
+    must not excuse the mismatch)."""
+    drifted = _KERNEL_MODULE.replace(
+        "def my_kernel(pod_req, pod_masks, chunk, max_nodes=8):",
+        "def my_kernel(pod_req, pod_masks, chunk, other=8, max_nodes=8):",
+    ).replace(
+        "P_pad = P + (-P) % chunk", "P_pad = P + (-P) % other"
+    )
+    found = multi_findings({"autoscaler_tpu/ops/mykernel.py": drifted})
+    assert "GL007" in rules_of(found)
+    assert any("witnessing idiom" in f.message for f in found)
+
+
+def test_gl007_step_slice_and_axis_stack_are_unknown_not_wrong():
+    """`x[::2]` halves the axis and `np.stack(..., axis=1)` transposes the
+    dims — both must infer as unknown rather than produce a provably
+    wrong shape that fails the fatal gate on correct dispatch code."""
+    found = multi_findings({
+        "autoscaler_tpu/ops/mykernel.py": _KERNEL_MODULE,
+        "autoscaler_tpu/estimator/dispatch.py": """
+            import numpy as np
+            from autoscaler_tpu.ops.mykernel import my_kernel
+
+            def step_slice(masks):
+                big = np.zeros((100, 6))
+                req = big[::2]
+                m = np.zeros((4, 50))
+                return my_kernel(req, m, chunk=8)
+
+            def axis_stack():
+                a = np.zeros((6,))
+                req = np.stack([a, a, a], axis=1)
+                m = np.zeros((4, 6))
+                return my_kernel(req, m, chunk=8)
+
+            def multi_arg_arange():
+                req = np.zeros((100, 6))
+                m = np.stack([np.arange(1, 101), np.arange(1, 101)])
+                return my_kernel(req, m, chunk=8)
+            """,
+    })
+    assert found == []
+
+
+def test_gl007_guard_on_wrong_divisor_is_not_a_witness():
+    """A raise-guard on `chunk % 2` does not witness a `multiple_of:
+    _STEP_TILE` (=8) declaration — the guard must check the contract's
+    own tile."""
+    wrong = _KERNEL_MODULE.replace(
+        "if chunk % _STEP_TILE != 0:", "if chunk % 2 != 0:"
+    )
+    found = multi_findings({"autoscaler_tpu/ops/mykernel.py": wrong})
+    assert "GL007" in rules_of(found)
+    assert any("no runtime guard" in f.message for f in found)
+
+
+def test_gl006_nested_def_does_not_shadow_imported_name():
+    """A function-LOCAL nested def is out of scope at other call sites:
+    a bare call must resolve to the imported name, not the same-spelled
+    nested def (both directions: no false positive on a pure import, no
+    false negative on a leaky one)."""
+    factory = """
+        import jax
+        from autoscaler_tpu.snapshot.helpers import {NAME}
+
+        def factory():
+            def {NAME}(x):
+                {BODY}
+                return x
+            return {NAME}
+
+        @jax.jit
+        def kernel(x):
+            return {NAME}(x)
+        """
+    # imported helper pure, nested def leaky: clean
+    clean = multi_findings({
+        "autoscaler_tpu/ops/kernel.py": textwrap.dedent(factory).format(
+            NAME="quiet", BODY="print(x)"
+        ),
+        "autoscaler_tpu/snapshot/helpers.py": """
+            def quiet(x):
+                return x
+            """,
+    })
+    assert clean == []
+    # imported helper leaky, nested def pure: flagged
+    leaky = multi_findings({
+        "autoscaler_tpu/ops/kernel.py": textwrap.dedent(factory).format(
+            NAME="leaky", BODY="pass"
+        ),
+        "autoscaler_tpu/snapshot/helpers.py": """
+            def leaky(x):
+                print(x)
+                return x
+            """,
+    })
+    assert rules_of(leaky) == ["GL006"]
+    assert leaky[0].path == "autoscaler_tpu/snapshot/helpers.py"
+
+
+def test_gl006_bare_call_resolves_to_function_not_method():
+    """A bare `helper(x)` call can never reach `Cls.helper`; resolution
+    must land on the module-level function even when a method shares the
+    bare name (and sorts first)."""
+    found = multi_findings({
+        "autoscaler_tpu/ops/kernel.py": """
+            import jax
+
+            class B:
+                def helper(self):
+                    return 1
+
+            def helper(x):
+                print(x)
+                return x
+
+            @jax.jit
+            def kernel(x):
+                return helper(x)
+            """,
+    })
+    assert rules_of(found) == ["GL006"]
+
+
+def test_gl007_ellipsis_subscript_is_unknown_not_wrong():
+    """`arr[..., 0]` must infer as unknown (no finding), not as a rank-0
+    shape that would trip a false rank-mismatch in the fatal gate."""
+    found = multi_findings({
+        "autoscaler_tpu/ops/mykernel.py": _KERNEL_MODULE,
+        "autoscaler_tpu/estimator/dispatch.py": """
+            import numpy as np
+            from autoscaler_tpu.ops.mykernel import my_kernel
+
+            def ellipsis_view(masks):
+                cube = np.zeros((100, 6, 3))
+                req = cube[..., 0]
+                return my_kernel(req, masks, chunk=8)
+            """,
+    })
+    assert found == []
+
+
+def test_gl007_unwitnessed_pad_and_inexact_grid():
+    broken = _KERNEL_MODULE.replace(
+        "P_pad = P + (-P) % chunk", "P_pad = P"
+    )
+    found = multi_findings({"autoscaler_tpu/ops/mykernel.py": broken})
+    msgs = " | ".join(f.message for f in found)
+    assert rules_of(found) == ["GL007", "GL007"]
+    assert "witnessing idiom" in msgs
+    assert "not provably exact" in msgs
+
+
+def test_gl007_missing_runtime_guard():
+    unguarded = _KERNEL_MODULE.replace(
+        '        if chunk % _STEP_TILE != 0:\n'
+        '            raise ValueError("chunk must be a multiple of the tile")\n',
+        "",
+    )
+    found = multi_findings({"autoscaler_tpu/ops/mykernel.py": unguarded})
+    assert rules_of(found) == ["GL007"]
+    assert "no runtime guard" in found[0].message
+
+
+def test_gl007_contract_for_unknown_function():
+    found = multi_findings({
+        "autoscaler_tpu/ops/ghost.py": """
+            KERNEL_CONTRACTS = {"nonexistent": {"args": {}}}
+            """,
+    })
+    assert rules_of(found) == ["GL007"]
+    assert "no such module-level function" in found[0].message
+
+
+def test_gl007_twin_contracts_must_agree_on_rank_and_dtype():
+    twin = """
+        KERNEL_CONTRACTS = {
+            "twin_kernel": {
+                "args": {"pod_req": {"dims": ["P"], "dtype": "i32"}},
+            },
+        }
+
+        def twin_kernel(pod_req):
+            return pod_req
+        """
+    base = """
+        KERNEL_CONTRACTS = {
+            "base_kernel": {
+                "args": {"pod_req": {"dims": ["P", "R"], "dtype": "f32"}},
+            },
+        }
+
+        def base_kernel(pod_req):
+            return pod_req
+        """
+    found = multi_findings({
+        "autoscaler_tpu/ops/a_base.py": base,
+        "autoscaler_tpu/ops/b_twin.py": twin,
+    })
+    assert rules_of(found) == ["GL007"]
+    assert "twin kernels must agree" in found[0].message
+
+
+def test_gl007_real_ops_contracts_scan_clean_and_nonvacuous():
+    """The shipped ops/ contracts hold over the real estimator dispatch
+    path (no findings), and the extraction is non-vacuous (contracts exist
+    for the Pallas kernels)."""
+    from autoscaler_tpu.analysis.contracts import load_module_contracts
+
+    contracts, consts = load_module_contracts(
+        str(REPO / "autoscaler_tpu" / "ops" / "pallas_binpack.py")
+    )
+    assert "ffd_binpack_groups_pallas" in contracts
+    assert consts["_STEP_TILE"] == 8
+    assert scan_paths([str(REPO / "autoscaler_tpu" / "ops")]) == []
+
+
+# -- GL008 lock order ---------------------------------------------------------
+
+
+def test_gl008_cross_file_cycle_detected():
+    found = multi_findings({
+        "autoscaler_tpu/trace/recorder.py": """
+            import threading
+
+            class Recorder:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.breaker = None
+
+                def record(self):
+                    with self._lock:
+                        self.breaker.trip_breaker()
+
+                def pin_trace(self):
+                    with self._lock:
+                        pass
+            """,
+        "autoscaler_tpu/utils/circuit.py": """
+            import threading
+
+            class Breaker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.recorder = None
+
+                def trip_breaker(self):
+                    with self._lock:
+                        pass
+
+                def note(self):
+                    with self._lock:
+                        self.recorder.pin_trace()
+            """,
+    })
+    assert rules_of(found) == ["GL008"]
+    assert "lock-order cycle" in found[0].message
+    assert "Recorder._lock" in found[0].message
+    assert "Breaker._lock" in found[0].message
+
+
+def test_gl008_one_directional_edges_are_fine():
+    found = multi_findings({
+        "autoscaler_tpu/utils/circuit.py": """
+            import threading
+
+            class Breaker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.metrics = None
+
+                def trip_breaker(self):
+                    with self._lock:
+                        self.metrics.observe_transition(1)
+            """,
+        "autoscaler_tpu/metrics/series.py": """
+            import threading
+
+            class Series:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def observe_transition(self, v):
+                    with self._lock:
+                        pass
+            """,
+    })
+    assert found == []
+
+
+def test_gl008_self_deadlock_on_plain_lock_not_rlock():
+    src = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.{LOCK}()
+
+            def outer_op(self):
+                with self._lock:
+                    self.inner_op()
+
+            def inner_op(self):
+                with self._lock:
+                    pass
+        """
+    plain = multi_findings(
+        {"autoscaler_tpu/metrics/box.py": src.replace("{LOCK}", "Lock")}
+    )
+    assert rules_of(plain) == ["GL008"]
+    reentrant = multi_findings(
+        {"autoscaler_tpu/metrics/box.py": src.replace("{LOCK}", "RLock")}
+    )
+    assert reentrant == []
+
+
+def test_gl008_nested_class_owns_its_lock():
+    """A nested class's `self._*lock` binding belongs to the nested class,
+    not the outer one — flat ast.walk attribution would fabricate cycles
+    through locks the outer class never holds."""
+    from autoscaler_tpu.analysis.engine import FileModel
+    from autoscaler_tpu.analysis.lockgraph import _class_locks
+
+    model = FileModel("autoscaler_tpu/metrics/nested.py", textwrap.dedent("""
+        import threading
+
+        class Outer:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            class Inner:
+                def __init__(self):
+                    self._cachelock = threading.RLock()
+        """))
+    outer = model.tree.body[1]
+    locks = _class_locks(model, outer)
+    assert set(locks) == {"_lock"}
+    inner = outer.body[1]
+    assert set(_class_locks(model, inner)) == {"_cachelock"}
+
+
+def test_gl008_directly_nested_same_plain_lock():
+    """`with self._lock:` nested directly inside `with self._lock:` on a
+    plain Lock is a guaranteed self-deadlock — caught without any call
+    mediation; the RLock form is fine."""
+    src = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.{LOCK}()
+
+            def doubled_op(self):
+                with self._lock:
+                    x = 1
+                    with self._lock:
+                        pass
+        """
+    plain = multi_findings(
+        {"autoscaler_tpu/metrics/box.py": src.replace("{LOCK}", "Lock")}
+    )
+    assert rules_of(plain) == ["GL008"]
+    assert "re-enters" in plain[0].message
+    reentrant = multi_findings(
+        {"autoscaler_tpu/metrics/box.py": src.replace("{LOCK}", "RLock")}
+    )
+    assert reentrant == []
+
+
+def test_gl008_transitive_acquisition_through_unlocked_helper():
+    """A.f holds the lock and calls B.helper, which (without a lock of its
+    own) calls back into A.locked_op — the cycle closes transitively."""
+    found = multi_findings({
+        "autoscaler_tpu/metrics/a.py": """
+            import threading
+
+            class Alpha:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.beta = None
+
+                def step_one(self):
+                    with self._lock:
+                        self.beta.relay_call()
+
+                def step_two(self):
+                    with self._lock:
+                        pass
+            """,
+        "autoscaler_tpu/metrics/b.py": """
+            import threading
+
+            class Beta:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.alpha = None
+
+                def relay_call(self):
+                    with self._lock:
+                        pass
+
+                def other_path(self):
+                    with self._lock:
+                        self.alpha.step_two()
+            """,
+    })
+    assert rules_of(found) == ["GL008"]
+
+
+# -- GL009 flag wiring --------------------------------------------------------
+
+
+def test_gl009_orphan_option_field():
+    found = multi_findings({
+        "autoscaler_tpu/config/options.py": """
+            from dataclasses import dataclass
+
+            @dataclass
+            class AutoscalingOptions:
+                scan_interval_s: float = 10.0
+                dead_knob: int = 0
+            """,
+        "autoscaler_tpu/core/loop.py": """
+            def run(opts):
+                return opts.scan_interval_s
+            """,
+    })
+    assert rules_of(found) == ["GL009"]
+    assert "dead_knob" in found[0].message
+
+
+def test_gl009_orphan_cli_flag():
+    found = multi_findings({
+        "autoscaler_tpu/main.py": """
+            import argparse
+
+            def build():
+                p = argparse.ArgumentParser()
+                p.add_argument("--scan-interval", type=float, default=10.0)
+                p.add_argument("--ghost-flag", type=int, default=0)
+                return p
+
+            def main():
+                args = build().parse_args()
+                return args.scan_interval
+            """,
+    })
+    assert rules_of(found) == ["GL009"]
+    assert "--ghost-flag" in found[0].message
+    assert "args.ghost_flag" in found[0].message
+
+
+def test_gl009_getattr_read_counts_as_wired():
+    found = multi_findings({
+        "autoscaler_tpu/main.py": """
+            import argparse
+
+            def build():
+                p = argparse.ArgumentParser()
+                p.add_argument("--dyn-flag", type=int, default=0)
+                return p
+
+            def main():
+                args = build().parse_args()
+                return getattr(args, "dyn_flag")
+            """,
+    })
+    assert found == []
+
+
+def test_gl009_silent_on_partial_disk_scan():
+    """Scanning only config/ (readers live elsewhere on disk) must not
+    flag live options as orphans: 'never read anywhere in the package'
+    cannot be proven by a subtree scan, so GL009 silences itself."""
+    found = scan_paths([str(REPO / "autoscaler_tpu" / "config")])
+    assert [f for f in found if f.rule == "GL009"] == []
+
+
+def test_gl008_multi_item_with_orders_like_nested():
+    """`with self._a, self._b:` acquires left to right — the inter-item
+    ordering edge must be recorded just like the nested form, so the
+    classic fwd/rev two-lock deadlock is caught."""
+    found = multi_findings({
+        "autoscaler_tpu/metrics/pair.py": """
+            import threading
+
+            class Pair:
+                def __init__(self):
+                    self._alock = threading.Lock()
+                    self._block = threading.Lock()
+
+                def fwd(self):
+                    with self._alock, self._block:
+                        pass
+
+                def rev(self):
+                    with self._block, self._alock:
+                        pass
+            """,
+    })
+    assert rules_of(found) == ["GL008"]
+    assert "lock-order cycle" in found[0].message
+
+
+def test_gl008_witness_messages_carry_no_line_numbers():
+    """The baseline fingerprints on (path, rule, message): GL008 witness
+    text names files but not lines, so grandfathered cycles don't churn
+    on unrelated line drift."""
+    found = multi_findings({
+        "autoscaler_tpu/metrics/box.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def doubled_op(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+            """,
+    })
+    assert rules_of(found) == ["GL008"]
+    assert re.search(r"\.py:\d", found[0].message) is None
+
+
 # -- suppression pragmas ------------------------------------------------------
 
 
@@ -692,3 +1514,69 @@ def test_nul_byte_file_degrades_to_parse_finding():
     found = check_source("\x00bad", "autoscaler_tpu/core/corrupt.py")
     assert rules_of(found) == ["GL000"]
     assert "does not parse" in found[0].message
+
+
+# -- CLI formats, exit codes, summary table -----------------------------------
+
+
+def test_cli_json_format_structure_and_exit_code(tmp_path, capsys):
+    root = _mini_repo(tmp_path)
+    rc = cli_main(
+        [str(root / "autoscaler_tpu"), "--no-baseline", "--format=json"]
+    )
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == 1
+    assert doc["files"] == 2
+    assert [f["rule"] for f in doc["findings"]] == ["GL001"]
+    assert doc["findings"][0]["path"] == "autoscaler_tpu/loadgen/bad.py"
+    assert doc["stale"] == []
+    assert doc["summary"]["GL001"]["findings"] == 1
+    assert set(doc["summary"]) >= {"GL000", "GL001", "GL007", "GL008", "GL009"}
+
+
+def test_cli_json_output_byte_identical_across_runs(tmp_path, capsys):
+    """The determinism gate hack/verify.sh enforces: two identical runs
+    must produce byte-identical JSON, independent of dict/set iteration."""
+    root = _mini_repo(tmp_path)
+    args = [str(root / "autoscaler_tpu"), "--no-baseline", "--format=json"]
+    cli_main(args)
+    first = capsys.readouterr().out
+    cli_main(args)
+    second = capsys.readouterr().out
+    assert first == second
+    json.loads(first)  # and it parses
+
+
+def test_cli_github_format_annotation_lines(tmp_path, capsys):
+    root = _mini_repo(tmp_path)
+    rc = cli_main(
+        [str(root / "autoscaler_tpu"), "--no-baseline", "--format=github"]
+    )
+    assert rc == 1
+    out = capsys.readouterr().out.splitlines()
+    assert out[0].startswith(
+        "::error file=autoscaler_tpu/loadgen/bad.py,line=5,title=graftlint GL001::"
+    )
+
+
+def test_cli_text_format_prints_summary_table(tmp_path, capsys):
+    root = _mini_repo(tmp_path)
+    cli_main([str(root / "autoscaler_tpu"), "--no-baseline"])
+    err = capsys.readouterr().err
+    assert "rule   findings  suppressed  baselined" in err
+    assert "GL001" in err and "GL009" in err
+
+
+def test_cli_internal_analyzer_error_exits_2(tmp_path, monkeypatch):
+    """Findings are 1, a crash in the analyzer itself is 2 — CI must be
+    able to tell a failed ratchet from a broken gate."""
+    from autoscaler_tpu.analysis import cli as cli_mod
+
+    root = _mini_repo(tmp_path)
+
+    def boom(sources, **kwargs):
+        raise RuntimeError("synthetic analyzer crash")
+
+    monkeypatch.setattr(cli_mod, "analyze_sources", boom)
+    assert cli_main([str(root / "autoscaler_tpu"), "--no-baseline"]) == 2

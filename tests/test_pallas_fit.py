@@ -42,6 +42,44 @@ def test_parity_vs_dense(P, N):
     np.testing.assert_array_equal(np.asarray(res.first_fit), ref_first)
 
 
+def test_wide_resource_axis_beyond_sublane_tile():
+    """Regression (GL007 contract pass): R_pad was hard-coded to 8, so a
+    world with more than 8 resource axes — 6 builtin + extended-resource /
+    virtual host-port/CSI planes — crashed the tiled path. The axis now
+    pads dynamically; verdicts must match the dense oracle."""
+    rng = np.random.default_rng(7)
+    P, N, R = 40, 50, 11
+    pod_req = rng.integers(0, 50, (P, R)).astype(np.float32)
+    free = rng.integers(0, 200, (N, R)).astype(np.float32)
+    pod_class = rng.integers(0, 3, P).astype(np.int32)
+    node_class = rng.integers(0, 2, N).astype(np.int32)
+    class_mask = rng.random((3, 2)) > 0.2
+    node_valid = np.ones(N, bool)
+    case = (pod_req, free, pod_class, node_class, class_mask, node_valid)
+    ref_any, ref_count, ref_first = reference_fit_reduce(*case)
+    res = pallas_fit_reduce(*(jnp.asarray(x) for x in case), tp=8, tn=128)
+    np.testing.assert_array_equal(np.asarray(res.any_fit), ref_any)
+    np.testing.assert_array_equal(np.asarray(res.fit_count), ref_count)
+    np.testing.assert_array_equal(np.asarray(res.first_fit), ref_first)
+
+
+@pytest.mark.parametrize(
+    "tp,tn,msg",
+    [
+        (12, 128, "tp must be a positive multiple of 8"),
+        (0, 128, "tp must be a positive multiple of 8"),
+        (64, 100, "tn must be a positive multiple of 128"),
+        (64, 0, "tn must be a positive multiple of 128"),
+    ],
+)
+def test_tile_divisibility_guards(tp, tn, msg):
+    """Regression (GL007 contract pass): a misaligned explicit tile must
+    fail loudly at trace time, not silently drop the grid's tail tile."""
+    case = build_case(16, 16, seed=3)
+    with pytest.raises(ValueError, match=msg):
+        pallas_fit_reduce(*(jnp.asarray(x) for x in case), tp=tp, tn=tn)
+
+
 def test_invalid_classes_never_fit():
     case = list(build_case(32, 32, seed=1))
     case[2] = np.full(32, -1, np.int32)  # all pods classless

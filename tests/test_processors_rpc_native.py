@@ -44,6 +44,34 @@ class TestBalancingProcessor:
         c = build_test_node("c", labels={"disk": "ssd"})
         assert not proc.is_similar(a, c)
 
+    def test_balancing_label_keys_mode(self):
+        """--balancing-label (GL009 wiring): with label_keys set, similarity
+        is decided by those label values ALONE — shape differences and
+        other labels are ignored (CreateLabelNodeInfoComparator)."""
+        proc = BalancingNodeGroupSetProcessor(label_keys=["pool"])
+        small = build_test_node(
+            "small", cpu_m=4000, mem=8 * GB, labels={"pool": "x", "disk": "ssd"}
+        )
+        huge = build_test_node(
+            "huge", cpu_m=64000, mem=512 * GB, labels={"pool": "x"}
+        )
+        other = build_test_node(
+            "other", cpu_m=4000, mem=8 * GB, labels={"pool": "y"}
+        )
+        unlabeled = build_test_node("unlabeled", cpu_m=4000, mem=8 * GB)
+        assert proc.is_similar(small, huge)        # same pool: similar
+        assert not proc.is_similar(small, other)   # different pool
+        assert not proc.is_similar(small, unlabeled)
+        assert proc.is_similar(unlabeled, build_test_node("u2", cpu_m=1))
+
+    def test_options_wire_balancing_label_keys(self):
+        from autoscaler_tpu.config.options import AutoscalingOptions
+        from autoscaler_tpu.processors.pipeline import default_processors
+
+        opts = AutoscalingOptions(balancing_label_keys=["pool"])
+        procs = default_processors(opts)
+        assert procs.node_group_set.label_keys == ["pool"]
+
     def test_balance_evens_targets(self):
         p, gs, templates = self._groups()
         proc = BalancingNodeGroupSetProcessor()

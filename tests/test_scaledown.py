@@ -503,6 +503,31 @@ class TestPlannerAndActuator:
             t.key == DELETION_CANDIDATE_TAINT for t in api.nodes["n0"].taints
         )
 
+    def test_soft_taints_time_budget(self, monkeypatch):
+        """--max-bulk-soft-taint-time (GL009 wiring): each taint is an API
+        round trip; a slow control plane must stop the bulk pass when the
+        time budget runs out, not only at the count budget."""
+        from autoscaler_tpu import trace
+
+        provider, api, snapshot, nodes, opts = self._world()
+        planner = ScaleDownPlanner(provider, opts)
+        planner.update_cluster_state(snapshot, nodes, [], now_ts=0.0)
+        actuator = ScaleDownActuator(provider, opts, api, planner.deletion_tracker)
+        opts.max_bulk_soft_taint_count = 10
+        opts.max_bulk_soft_taint_time_s = 2.0
+        ticks = iter(range(100))
+
+        def clock():
+            return float(next(ticks)) * 1.5  # 0.0, 1.5, 3.0, ...
+
+        monkeypatch.setattr(trace, "timeline_now", clock)
+        # budget check at 1.5s passes once, 3.0s exceeds 2.0s -> exactly one
+        # taint lands despite three unneeded nodes and count budget 10
+        changed = actuator.update_soft_deletion_taints(
+            nodes, planner.unneeded_names()
+        )
+        assert changed == 1
+
     def test_cleanup_leftover_taints(self):
         provider, api, snapshot, nodes, opts = self._world()
         from autoscaler_tpu.kube.api import to_be_deleted_taint
