@@ -57,6 +57,7 @@ REPLAY_SCOPES = (
     "core/",
     "estimator/",
     "loadgen/",
+    "perf/",
     "trace/",
     "snapshot/",
     "clusterstate/",
@@ -250,7 +251,13 @@ class LadderBypass:
 
 # -- GL004: lock discipline in threaded modules -------------------------------
 
-THREADED_SCOPES = ("metrics/", "trace/recorder.py", "utils/circuit.py", "kube/client.py")
+THREADED_SCOPES = (
+    "metrics/",
+    "perf/",
+    "trace/recorder.py",
+    "utils/circuit.py",
+    "kube/client.py",
+)
 
 
 

@@ -86,6 +86,19 @@ class AutoscalingOptions:
     # host trace (--jax-profiler-dir; debug tool, off by default)
     jax_profiler_dir: str = ""
 
+    # -- perf observatory (autoscaler_tpu/perf) ------------------------------
+    # gates /perfz, like tracing_enabled gates /tracez; the observatory
+    # itself always runs (bounded ring, negligible overhead) so the ring
+    # has history the moment the endpoint is enabled
+    perf_enabled: bool = True
+    # capture the XLA cost model (lowered.compile().cost_analysis() /
+    # memory_analysis()) per new (kernel route, shape signature): one extra
+    # AOT lower+compile per new signature, process-cached. Loadgen turns
+    # this on (replayable — cost figures are pure functions of shapes).
+    perf_cost_model: bool = False
+    # how many recent per-tick perf records the in-memory ring keeps
+    perf_ring_size: int = 64
+
     # -- cluster-wide resource limits (main.go:113-118) ----------------------
     max_nodes_total: int = 0                      # 0 = unlimited
     min_cores_total: float = 0.0

@@ -58,6 +58,7 @@ class ScaleUpOrchestrator:
         binpacking_limiter=None,
         metrics=None,
         priorities_fetch=None,
+        observatory=None,  # perf.PerfObservatory, threaded to the estimator
     ):
         from autoscaler_tpu.expander.core import build_strategy
 
@@ -78,6 +79,7 @@ class ScaleUpOrchestrator:
                     failure_threshold=options.kernel_breaker_failure_threshold,
                     cooldown_s=options.kernel_breaker_cooldown_s,
                 ),
+                observatory=observatory,
             )
         self.estimator = estimator
         self.expander = expander or build_strategy(

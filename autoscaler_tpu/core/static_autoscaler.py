@@ -64,7 +64,9 @@ class StaticAutoscaler:
         debugger=None,
         processors=None,
         tracer: Optional[trace.Tracer] = None,
+        observatory=None,
     ):
+        from autoscaler_tpu.perf import PerfObservatory
         from autoscaler_tpu.processors.pipeline import default_processors
 
         self.provider = provider
@@ -73,10 +75,25 @@ class StaticAutoscaler:
         self.processors = processors or default_processors(self.options)
         self.csr = csr or ClusterStateRegistry(provider, self.options)
         self.metrics = metrics or metrics_mod.AutoscalerMetrics()
+        # perf observatory (autoscaler_tpu/perf): per-route compile
+        # telemetry, the XLA cost ledger, and device-residency accounting.
+        # One per autoscaler — the loadgen driver's replays never share
+        # mutable perf state with a prior run. Served by /perfz.
+        self.observatory = observatory or PerfObservatory(
+            metrics=self.metrics,
+            cost_model=self.options.perf_cost_model,
+            ring_capacity=self.options.perf_ring_size,
+        )
+        # floor for perf tick ids: normally the trace id, but a re-entrant
+        # tick (tracer degrades to a child span — no trace_id attr) must
+        # still get a strictly increasing id or the ledger's monotonicity
+        # gate trips on a pile of tick-0 records
+        self._next_perf_tick = 0
         self.scale_up_orchestrator = scale_up_orchestrator or ScaleUpOrchestrator(
             provider,
             self.options,
             self.csr,
+            observatory=self.observatory,
             balancing_processor=self.processors.node_group_set,
             template_provider=self.processors.template_node_info_provider,
             node_group_list_processor=self.processors.node_group_list,
@@ -140,7 +157,31 @@ class StaticAutoscaler:
         if ladder is not None:
             ladder.tick(now_ts)
         with self.tracer.tick(metrics_mod.MAIN, now_ts=now_ts) as root:
-            result = self._run_once_traced(now_ts, root)
+            # open this tick's perf record: dispatches recorded between
+            # begin_tick and end_tick are stamped with this tick id — the
+            # trace id when the tracer issued one (/perfz and /tracez line
+            # up by construction), else the monotonic floor (re-entrant
+            # ticks have no trace_id and must not all collapse to 0)
+            raw_id = root.attrs.get("trace_id")
+            tick_id = max(
+                int(raw_id) if raw_id is not None else 0,
+                self._next_perf_tick,
+            )
+            self._next_perf_tick = tick_id + 1
+            self.observatory.begin_tick(tick_id, now_ts)
+            try:
+                result = self._run_once_traced(now_ts, root)
+            finally:
+                # finalize even when the tick crashed (the crash-only loop
+                # catches outside): the ledger stays gap-free, and the
+                # residency snapshot reflects whatever the tick left live
+                with trace.span(metrics_mod.PERF_RECORD):
+                    from autoscaler_tpu.perf import POOL_SNAPSHOT
+
+                    self.observatory.residency.set(
+                        POOL_SNAPSHOT, "packer", self._packer.device_bytes()
+                    )
+                    self.observatory.end_tick()
             root.set_attrs(
                 pending=result.pending_pods,
                 healthy=result.cluster_healthy,

@@ -41,6 +41,8 @@ from autoscaler_tpu.ops.binpack import (
     ffd_binpack_groups_runs,
     ffd_binpack_groups_runs_affinity,
 )
+from autoscaler_tpu.ops.telemetry import kernel_observer
+from autoscaler_tpu.perf import PerfObservatory
 from autoscaler_tpu.snapshot.affinity import (
     SpreadTermTensors,
     build_affinity_terms,
@@ -213,16 +215,19 @@ class BinpackingNodeEstimator:
         limiter: Optional[ThresholdBasedEstimationLimiter] = None,
         metrics=None,    # AutoscalerMetrics; None = no recording
         ladder: Optional[KernelLadder] = None,  # circuit-broken rung state
+        observatory=None,  # perf.PerfObservatory; None = no perf telemetry
     ):
         self.limiter = limiter or ThresholdBasedEstimationLimiter()
         self.metrics = metrics
         self.ladder = ladder or KernelLadder()
         self.ladder.bind_metrics(metrics)
-        # per-route dispatch wall-time stats for the compile-vs-execute
-        # split span attributes: first dispatch of a route pays trace+
-        # compile; the warm median approximates pure execute, and their
-        # difference approximates compile. {route: {"first": s, "warm": []}}
-        self._route_walls: Dict[str, Dict[str, object]] = {}
+        # perf observatory (autoscaler_tpu/perf): per-(route, shape
+        # signature) compile telemetry, the XLA cost ledger, and operand
+        # residency. It owns the compile-vs-execute span attribution —
+        # there is exactly ONE implementation of the cold/warm-median
+        # split. Standalone estimators get a private metrics-less one;
+        # StaticAutoscaler threads in its own (ringed, /perfz-served).
+        self.observatory = observatory or PerfObservatory(metrics=metrics)
 
     def estimate(
         self,
@@ -779,38 +784,31 @@ class BinpackingNodeEstimator:
     def _dispatch(self, label: str, fn, sp):
         """Run one rung's kernel under a device-profiler annotation (the
         host span's name becomes visible on a captured jax.profiler
-        timeline — no-op off jax) and record the per-route compile-vs-
-        execute wall split as span attributes.
+        timeline — no-op off jax) and hand the dispatch to the perf
+        observatory, which records the compile-vs-execute split per
+        (route, shape signature) as span attributes.
 
-        The split is estimated, not measured: the first dispatch of a route
-        pays trace+compile+execute, warm dispatches pay execute only, so
-        ``compile_est_s = first_wall − median(warm walls)``. ``cold`` is
-        deterministic (pure call-sequence); the wall-derived attributes go
-        through set_wall_attrs, which drops them on deterministic (replay)
-        tracers so trace exports stay byte-stable. Durations come from
-        trace.timeline_now() — the tracer's injectable clock — rather than
-        the wall directly (graftlint GL001), so even the measurement itself
-        replays byte-identically."""
+        The split is estimated, not measured: the first dispatch of a
+        signature pays trace+compile+execute, warm dispatches pay execute
+        only, so ``compile_est_s = first_wall − median(warm walls)``. The
+        kernel-entry observer seam (ops/telemetry.kernel_observer) hands
+        the observatory the concrete call — shapes, statics, operand
+        bytes — without any call-site rewrite. The attrs land as PLAIN
+        span attrs: the wall comes from trace.timeline_now() — the
+        tracer's injectable clock, not the wall directly (graftlint
+        GL001) — and every derived figure is a pure function of shapes,
+        so under loadgen they replay byte-identically (the acceptance
+        surface for replayed traces)."""
+        obs = self.observatory
+        # a prior rung that faulted after its kernel entry was observed
+        # must not leak its call onto this rung's record
+        obs.clear_pending()
         t0 = trace.timeline_now()
-        with device_annotation(f"autoscaler/estimator/{label}"):
-            out = fn()
+        with kernel_observer(obs.note_kernel):
+            with device_annotation(f"autoscaler/estimator/{label}"):
+                out = fn()
         wall = trace.timeline_now() - t0
-        stats = self._route_walls.setdefault(label, {"first": None, "warm": []})
-        if stats["first"] is None:
-            stats["first"] = wall
-            sp.set_attrs(cold=True)
-            trace.set_wall_attrs(dispatch_s=round(wall, 6))
-        else:
-            warm: List[float] = stats["warm"]  # type: ignore[assignment]
-            warm.append(wall)
-            del warm[:-64]  # bounded: enough samples for a stable median
-            median = sorted(warm)[len(warm) // 2]
-            sp.set_attrs(cold=False)
-            trace.set_wall_attrs(
-                dispatch_s=round(wall, 6),
-                execute_est_s=round(median, 6),
-                compile_est_s=round(max(float(stats["first"]) - median, 0.0), 6),
-            )
+        obs.on_dispatch(label, wall, span=sp)
         return out
 
     @staticmethod

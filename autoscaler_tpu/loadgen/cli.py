@@ -38,6 +38,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                      help="write the run's tick span trees as a Chrome-"
                           "trace/Perfetto JSON (deterministic: two runs of "
                           "the same spec are byte-identical)")
+    run.add_argument("--perf-ledger", default="",
+                     help="write the run's per-tick perf records (compile "
+                          "telemetry, cost model, residency) as JSONL "
+                          "(deterministic: two runs of the same spec are "
+                          "byte-identical; bench.py --perf-ledger validates)")
     run.add_argument("--seed", type=int, default=None,
                      help="override the spec's seed")
     run.add_argument("--real-sleep", action="store_true",
@@ -48,6 +53,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     rep.add_argument("--report", default="")
     rep.add_argument("--log", default="")
     rep.add_argument("--chrome-trace", default="")
+    rep.add_argument("--perf-ledger", default="")
 
     val = sub.add_parser("validate", help="parse + round-trip a scenario spec")
     val.add_argument("scenario")
@@ -62,7 +68,7 @@ def _write(path: str, doc) -> None:
 
 def _run(spec: ScenarioSpec, report_path: str, log_path: str,
          trace_path: str = "", real_sleep: bool = False,
-         chrome_trace_path: str = "") -> int:
+         chrome_trace_path: str = "", perf_ledger_path: str = "") -> int:
     from autoscaler_tpu.loadgen.driver import run_scenario
     from autoscaler_tpu.loadgen.score import build_report
 
@@ -80,6 +86,11 @@ def _run(spec: ScenarioSpec, report_path: str, log_path: str,
         # written verbatim so two runs diff clean
         with open(chrome_trace_path, "w") as f:
             f.write(result.recorder.chrome() or "")
+    if perf_ledger_path:
+        # one sorted-key JSON line per tick — the byte-stable perf ledger
+        # (hack/verify.sh diffs two replays; bench.py --perf-ledger gates)
+        with open(perf_ledger_path, "w") as f:
+            f.write(result.perf_ledger_lines())
     return 0
 
 
@@ -92,7 +103,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 spec.seed = args.seed
             return _run(spec, args.report, args.log, args.trace,
                         real_sleep=args.real_sleep,
-                        chrome_trace_path=args.chrome_trace)
+                        chrome_trace_path=args.chrome_trace,
+                        perf_ledger_path=args.perf_ledger)
         if args.command == "replay":
             with open(args.trace) as f:
                 doc = json.load(f)
@@ -104,7 +116,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
             spec.events = [_load_event(e) for e in doc["events"]]
             return _run(spec, args.report, args.log,
-                        chrome_trace_path=args.chrome_trace)
+                        chrome_trace_path=args.chrome_trace,
+                        perf_ledger_path=args.perf_ledger)
         if args.command == "validate":
             spec = ScenarioSpec.load(args.scenario)
             roundtrip = ScenarioSpec.from_json(spec.to_json())

@@ -152,9 +152,18 @@ class RunResult:
     # flight recorder holding every tick's span tree (deterministic
     # timeline): recorder.chrome() is the byte-stable Perfetto export
     recorder: Optional[FlightRecorder] = None
+    # per-tick perf records (autoscaler_tpu/perf observatory ring, sized to
+    # the run): every value is timeline-clock or pure-function-of-shapes,
+    # so two replays serialize to byte-identical JSONL ledgers
+    perf_records: List[Dict[str, Any]] = field(default_factory=list)
 
     def decision_log(self) -> List[Dict[str, Any]]:
         return [r.to_dict() for r in self.records]
+
+    def perf_ledger_lines(self) -> str:
+        from autoscaler_tpu.perf import record_line
+
+        return "".join(record_line(rec) for rec in self.perf_records)
 
 
 class _FaultyCloudProvider(TestCloudProvider):
@@ -219,6 +228,11 @@ class ScenarioDriver:
         # to the scenario seed (unseeded, two runs of the same world can
         # pick different groups when least-waste scores tie exactly)
         opts_kw["expander_random_seed"] = spec.seed
+        # perf observatory: cost model ON (its figures are pure functions
+        # of shapes — replayable), ring sized to hold EVERY tick so the
+        # perf JSONL ledger covers the whole run
+        opts_kw["perf_cost_model"] = True
+        opts_kw["perf_ring_size"] = max(spec.ticks, 1)
         # two ticks of unneeded time by default: long enough that freshly
         # booted (still empty) capacity isn't reaped before the scheduler
         # analog binds pods, short enough that drain scenarios converge
@@ -576,6 +590,7 @@ class ScenarioDriver:
             total_requested_cpu_m=self.total_requested_cpu_m,
             group_cpu_m=max(group_cpu.values()) if group_cpu else 0.0,
             recorder=self.tracer.recorder,
+            perf_records=self.autoscaler.observatory.records(),
         )
 
 

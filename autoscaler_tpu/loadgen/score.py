@@ -120,4 +120,51 @@ def build_report(result: RunResult) -> Dict[str, Any]:
         report["function_duration"] = phases
     if routes:
         report["kernel_routes"] = routes
+    perf = _perf_section(result)
+    if perf:
+        report["perf"] = perf
     return report
+
+
+def _perf_section(result: RunResult) -> Dict[str, Any]:
+    """Perf-ledger columns alongside the per-span report: per kernel route
+    the compile/execute wall split, dispatch/compile counts and the last
+    utilization sample (ledger.summarize), plus resident-bytes p50/p99/peak
+    per residency pool over the run's tick records."""
+    if not result.perf_records:
+        return {}
+    from autoscaler_tpu.perf import summarize
+
+    agg = summarize(result.perf_records)
+    routes: Dict[str, Any] = {}
+    for route, r in agg["routes"].items():
+        row = {
+            "dispatches": r["dispatches"],
+            "compiles": r["compiles"],
+            "compile_s": r["compile_s"],
+            "execute_s": r["execute_s"],
+            "signatures": r["signatures"],
+        }
+        if "utilization" in r:
+            row["utilization"] = r["utilization"]
+        routes[route] = row
+    pools: Dict[str, Any] = {}
+    series: Dict[str, List[int]] = {}
+    for rec in result.perf_records:
+        for pool, nbytes in rec.get("resident_bytes", {}).items():
+            series.setdefault(pool, []).append(int(nbytes))
+    # peak comes from summarize — one aggregation to agree with bench.py's
+    # ledger report; only the percentiles need the raw series
+    peaks = agg.get("resident_bytes_peak", {})
+    for pool in sorted(series):
+        vals = sorted(series[pool])
+        pools[pool] = {
+            "p50": _percentile(vals, 0.50),
+            "p99": _percentile(vals, 0.99),
+            "peak": peaks.get(pool, vals[-1]),
+        }
+    return {
+        "ticks": agg["ticks"],
+        "routes": routes,
+        "resident_bytes": pools,
+    }

@@ -221,6 +221,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="capture a jax profiler session per tick into "
                         "<dir>/tick_<id> — device timeline keyed by the "
                         "same tick id as the host trace (debug tool)")
+    p.add_argument("--perf-enabled", type=_bool_flag, default=True,
+                   help="serve /perfz (per-tick perf records: compile "
+                        "telemetry, cost model, residency; the observatory "
+                        "itself always runs, bounded)")
+    p.add_argument("--perf-cost-model", type=_bool_flag, default=False,
+                   help="capture the XLA cost model per new (kernel route, "
+                        "shape signature) — one extra AOT compile per new "
+                        "signature, process-cached")
+    p.add_argument("--perf-ring-size", type=int, default=64,
+                   help="how many recent per-tick perf records the "
+                        "in-memory ring keeps")
     p.add_argument("--record-duplicated-events", type=_bool_flag, default=False,
                    help="post every event instead of suppressing repeats "
                         "within the correlator window")
@@ -335,6 +346,9 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         trace_ring_size=args.trace_ring_size,
         trace_slow_tick_threshold_s=args.trace_slow_tick_threshold,
         jax_profiler_dir=args.jax_profiler_dir,
+        perf_enabled=args.perf_enabled,
+        perf_cost_model=args.perf_cost_model,
+        perf_ring_size=args.perf_ring_size,
         force_daemonsets=args.force_ds,
     )
     opts.node_group_defaults.scale_down_unneeded_time_s = args.scale_down_unneeded_time
@@ -444,6 +458,40 @@ class ObservabilityServer:
                         self._send(200, body, "application/json")
                     else:
                         self._send(200, rec.list_json(), "application/json")
+                elif self.path.startswith("/perfz"):
+                    # perf observatory (autoscaler_tpu/perf): gated like
+                    # /tracez — the observatory always records, the
+                    # endpoint is the opt-out
+                    obs = getattr(autoscaler, "observatory", None)
+                    enabled = getattr(
+                        autoscaler.options, "perf_enabled", True
+                    )
+                    if obs is None or not enabled:
+                        self._send(
+                            404, "perf observatory disabled (--perf-enabled)"
+                        )
+                        return
+                    from urllib.parse import parse_qs, urlparse
+
+                    url = urlparse(self.path)
+                    if url.path.rstrip("/") not in ("", "/perfz"):
+                        self._send(404, "not found")
+                        return
+                    q = parse_qs(url.query)
+                    raw_tick = q.get("tick", [None])[0]
+                    if raw_tick is not None:
+                        try:
+                            tick = int(raw_tick)
+                        except ValueError:
+                            self._send(400, f"bad tick {raw_tick!r}")
+                            return
+                        body = obs.detail_json(tick)
+                        if body is None:
+                            self._send(404, f"no perf record for tick {tick}")
+                            return
+                        self._send(200, body, "application/json")
+                    else:
+                        self._send(200, obs.list_json(), "application/json")
                 elif self.path == "/status":
                     from autoscaler_tpu.clusterstate.status import build_status
 

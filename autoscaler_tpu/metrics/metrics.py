@@ -8,6 +8,7 @@ every RunOnce phase, RegisterAll :361. Series names keep the reference's
 """
 from __future__ import annotations
 
+import bisect
 import math
 import threading
 import time
@@ -33,6 +34,20 @@ DEVICE_DISPATCH = "deviceDispatch"  # TPU-specific: kernel round trips
 ESTIMATE = "estimate"  # batched binpacking dispatch (threshold_based_limiter envelope)
 KUBE_REQUEST = "kubeRequest"  # one control-plane HTTP request (incl. retries)
 RPC_CALL = "rpcCall"  # one sidecar RPC (incl. the single reconnect-resend)
+PERF_RECORD = "perfRecord"  # per-tick perf-ledger assembly (autoscaler_tpu/perf)
+
+# function_duration_seconds bucket ladder. The reference's histogram starts
+# at 0.01s (metrics.go:209-218) — every sub-millisecond device dispatch
+# piles into the bottom bucket. Extended DOWN to 1e-4 s so warm kernel
+# dispatches (tens to hundreds of microseconds) resolve; pinned by
+# tests (a silent ladder change would corrupt dashboard history).
+DURATION_BUCKETS = (
+    1e-4, 2.5e-4, 5e-4,
+    1e-3, 2.5e-3, 5e-3,
+    1e-2, 2.5e-2, 5e-2,
+    0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
 
 
 class _Series:
@@ -109,13 +124,20 @@ class Summary(_Series):
             _SummaryState
         )
 
+    def _observe_locked(self, key, value: float) -> _SummaryState:
+        """The one observation bookkeeping path (caller holds the lock):
+        Histogram layers its bucket counters on top of exactly this, so a
+        change to the window/max/total semantics reaches both kinds."""
+        s = self.states[key]
+        s.count += 1
+        s.total += value
+        s.maximum = max(s.maximum, value)
+        s.recent.append(value)  # maxlen evicts the oldest
+        return s
+
     def observe(self, value: float, **labels: str) -> None:
         with self._lock:
-            s = self.states[self._key(labels)]
-            s.count += 1
-            s.total += value
-            s.maximum = max(s.maximum, value)
-            s.recent.append(value)  # maxlen evicts the oldest
+            self._observe_locked(self._key(labels), value)
 
     def quantile(self, q: float, **labels: str) -> float:
         with self._lock:
@@ -137,6 +159,61 @@ class Summary(_Series):
     def count(self, **labels: str) -> int:
         s = self.states.get(self._key(labels))
         return s.count if s else 0
+
+
+class Histogram(Summary):
+    """A Summary that ALSO exposes a Prometheus histogram: cumulative
+    ``_bucket{le=...}`` counters over a fixed bucket ladder, plus the
+    Summary's window quantiles for Python-side consumers (the scorer's
+    p50/p99 columns read ``quantile()``/``states`` and must keep working).
+
+    Bucket counts are lifetime cumulative (never windowed) — the one
+    pathological observation a long run exists to surface must survive
+    window eviction, same rationale as ``_SummaryState.maximum``."""
+
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        buckets: Tuple[float, ...] = DURATION_BUCKETS,
+    ):
+        super().__init__(name, help_)
+        self.kind = "histogram"
+        self.buckets: Tuple[float, ...] = tuple(sorted(buckets))
+        self._bucket_counts: Dict[Tuple[Tuple[str, str], ...], List[int]] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        with self._lock:
+            key = self._key(labels)
+            self._observe_locked(key, value)
+            counts = self._bucket_counts.get(key)
+            if counts is None:
+                counts = self._bucket_counts[key] = [0] * len(self.buckets)
+            # cumulative le-semantics: one observation ticks EVERY bucket
+            # whose upper bound admits it (bisect, then suffix increment)
+            for i in range(bisect.bisect_left(self.buckets, value), len(counts)):
+                counts[i] += 1
+
+    def bucket_counts(self, **labels: str) -> List[int]:
+        with self._lock:
+            return list(self._bucket_counts.get(self._key(labels), ()))
+
+    def bucket_rows(
+        self,
+    ) -> List[Tuple[Tuple[Tuple[str, str], ...], List[int], int, float]]:
+        """(label key, cumulative bucket counts, count, sum) rows — one
+        consistent read for the exposition renderer, under the series
+        lock."""
+        with self._lock:
+            return [
+                (
+                    key,
+                    list(self._bucket_counts.get(key, [0] * len(self.buckets))),
+                    s.count,
+                    s.total,
+                )
+                for key, s in self.states.items()
+            ]
 
 
 class MetricsRegistry:
@@ -162,6 +239,17 @@ class MetricsRegistry:
                 self._metrics[name] = Summary(name, help_)
             return self._metrics[name]  # type: ignore[return-value]
 
+    def histogram(
+        self,
+        name: str,
+        help_: str = "",
+        buckets: Tuple[float, ...] = DURATION_BUCKETS,
+    ) -> Histogram:
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = Histogram(name, help_, buckets)
+            return self._metrics[name]  # type: ignore[return-value]
+
     def expose(self) -> str:
         """Prometheus text exposition format. Each series is snapshotted
         under its own lock before rendering — a concurrent first-observation
@@ -172,7 +260,20 @@ class MetricsRegistry:
         for m in series:
             lines.append(f"# HELP {m.name} {m.help}")
             lines.append(f"# TYPE {m.name} {m.kind if m.kind != 'summary' else 'summary'}")
-            if isinstance(m, Summary):
+            if isinstance(m, Histogram):
+                # Prometheus histogram exposition: cumulative le-buckets
+                # (incl. the mandatory +Inf == _count), then sum and count
+                for key, counts, count, total in m.bucket_rows():
+                    base = dict(key)
+                    for bound, c in zip(m.buckets, counts):
+                        bl = _fmt_labels({**base, "le": f"{bound:g}"})
+                        lines.append(f"{m.name}_bucket{bl} {c}")
+                    inf = _fmt_labels({**base, "le": "+Inf"})
+                    lines.append(f"{m.name}_bucket{inf} {count}")
+                    lbl = _fmt_labels(base)
+                    lines.append(f"{m.name}_sum{lbl} {total:.9g}")
+                    lines.append(f"{m.name}_count{lbl} {count}")
+            elif isinstance(m, Summary):
                 for key, count, total, data in m.snapshot():
                     lbl = _fmt_labels(dict(key))
                     lines.append(f"{m.name}_count{lbl} {count}")
@@ -239,7 +340,10 @@ class AutoscalerMetrics:
             p + "cluster_safe_to_autoscale", "health gate"
         )
         self.last_activity = r.gauge(p + "last_activity", "ts of last loop by activity")
-        self.function_duration = r.summary(
+        # histogram (bucket ladder down to 1e-4 s — sub-millisecond device
+        # dispatches resolve instead of piling into the bottom bucket) that
+        # still answers the Summary quantile API for Python-side consumers
+        self.function_duration = r.histogram(
             p + "function_duration_seconds", "per-step durations"
         )
         # the reference registers the durations twice — a histogram and a
@@ -334,6 +438,33 @@ class AutoscalerMetrics:
         )
         self.pending_node_deletions = r.gauge(
             p + "pending_node_deletions", "deletions currently in flight"
+        )
+        # -- perf observatory (autoscaler_tpu/perf): compile telemetry, the
+        # XLA cost model, and device-buffer residency. Series share the
+        # trace/metric taxonomy discipline: route label values are the
+        # estimator's kernel-route vocabulary, pool label values are the
+        # residency-ledger pools (snapshot | kernel_operands |
+        # scenario_batches).
+        self.kernel_compile_seconds = r.histogram(
+            p + "kernel_compile_seconds",
+            "cold kernel dispatch wall (trace+compile+execute) by route",
+        )
+        self.kernel_execute_seconds = r.histogram(
+            p + "kernel_execute_seconds",
+            "warm kernel dispatch wall by route",
+        )
+        self.kernel_compile_cache_total = r.counter(
+            p + "kernel_compile_cache_total",
+            "kernel dispatches by route and compile-cache outcome (hit|miss)",
+        )
+        self.kernel_model_utilization = r.gauge(
+            p + "kernel_model_utilization",
+            "achieved model-FLOP/s over nominal peak per route (last warm "
+            "dispatch)",
+        )
+        self.device_resident_bytes = r.gauge(
+            p + "device_resident_bytes",
+            "live device buffer bytes by residency pool",
         )
         self.estimation_over_budget_total = r.counter(
             p + "estimation_over_budget_total",

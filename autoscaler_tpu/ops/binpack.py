@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from autoscaler_tpu.kube.objects import CPU, MEMORY
+from autoscaler_tpu.ops.telemetry import observed
 
 BIG_I32 = jnp.int32(2**30)  # "no domain yet" sentinel in spread minimums
 
@@ -129,6 +130,7 @@ def ffd_scores(pod_req: jax.Array, template_alloc: jax.Array) -> jax.Array:
     return s_cpu + s_mem
 
 
+@observed
 @functools.partial(jax.jit, static_argnames=("max_nodes",))
 def ffd_binpack(
     pod_req: jax.Array,        # [P, R]
@@ -182,6 +184,7 @@ def ffd_binpack(
     return BinpackResult(node_count=opened, scheduled=scheduled, node_used=used)
 
 
+@observed
 @functools.partial(jax.jit, static_argnames=("max_nodes",))
 def ffd_binpack_groups(
     pod_req: jax.Array,         # [P, R] shared pending-pod matrix
@@ -360,6 +363,7 @@ class RunBinpackResult(NamedTuple):
     node_used: jax.Array      # [G, max_nodes, R]
 
 
+@observed
 @functools.partial(jax.jit, static_argnames=("max_nodes",))
 def ffd_binpack_groups_runs(
     run_req: jax.Array,         # [U, R] unique pod-requirement rows
@@ -450,6 +454,7 @@ def ffd_binpack_groups_runs(
     )
 
 
+@observed
 @functools.partial(jax.jit, static_argnames=("max_nodes",))
 def ffd_binpack_groups_runs_affinity(
     run_req: jax.Array,         # [U, R] unique pod-requirement rows
@@ -600,6 +605,7 @@ def ffd_binpack_groups_runs_affinity(
     )
 
 
+@observed
 @functools.partial(jax.jit, static_argnames=("max_nodes",))
 def ffd_binpack_groups_affinity(
     pod_req: jax.Array,         # [P, R] shared pending-pod matrix

@@ -73,6 +73,7 @@ from jax.experimental.pallas import tpu as pltpu
 # rename to CompilerParams landed alongside jax.shard_map's promotion
 _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
+from autoscaler_tpu.ops.telemetry import observed
 from autoscaler_tpu.ops.binpack import BinpackResult, ffd_scores
 
 BIG_I32 = np.int32(2**31 - 1)
@@ -431,6 +432,7 @@ def _pallas_scan_all(
     )(stream, caps_col, allocs_in)
 
 
+@observed
 def ffd_binpack_groups_pallas(
     pod_req,          # [P, R]
     pod_masks,        # [G, P] bool

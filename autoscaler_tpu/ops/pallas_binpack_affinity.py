@@ -69,6 +69,7 @@ from jax.experimental.pallas import tpu as pltpu
 # rename to CompilerParams landed alongside jax.shard_map's promotion
 _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
+from autoscaler_tpu.ops.telemetry import observed
 from autoscaler_tpu.ops.binpack import BinpackResult, ffd_scores
 from autoscaler_tpu.ops.pallas_binpack import (
     BIG_I32,
@@ -438,6 +439,7 @@ def _pallas_scan_aff(
     return outs[0], outs[1], outs[-1]
 
 
+@observed
 def ffd_binpack_groups_affinity_pallas(
     pod_req,          # [P, R]
     pod_masks,        # [G, P] bool

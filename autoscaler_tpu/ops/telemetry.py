@@ -1,0 +1,64 @@
+"""Kernel dispatch-boundary observation seam.
+
+The perf observatory (autoscaler_tpu/perf) needs the concrete call —
+kernel function, arrays, statics — of every device dispatch to derive
+shape signatures, operand footprints, and the XLA cost model. The
+estimator must NOT rewrite its kernel call sites to thread that through:
+graftlint GL007 proves kernel contracts at every *syntactic* dispatch
+site, so ``ffd_binpack_groups(...)`` has to stay a direct call.
+
+Instead, each ``ops/`` kernel entry is wrapped with :func:`observed`
+(outside the jit boundary — the wrapper is host Python, never traced),
+and the estimator installs an ambient observer around each ladder rung
+via :func:`kernel_observer`. The observer is a contextvar, not a module
+global: concurrently running autoscalers (the loadgen driver inside a
+test process, an rpc sidecar thread) each see only their own
+observatory, and the seam is free when nobody is observing.
+
+Dependency-free: stdlib only.
+"""
+from __future__ import annotations
+
+import contextvars
+import functools
+from contextlib import contextmanager
+from typing import Any, Callable, Iterator, Optional
+
+# the ambient observer for THIS context: called (fn, args, kwargs) just
+# before every observed kernel entry runs; fn is the outermost compiled
+# callable (jit-wrapped entries expose .lower for AOT cost capture)
+_OBSERVER: contextvars.ContextVar[
+    Optional[Callable[[Any, tuple, dict], None]]
+] = contextvars.ContextVar("autoscaler_tpu_kernel_observer", default=None)
+
+
+@contextmanager
+def kernel_observer(
+    observer: Optional[Callable[[Any, tuple, dict], None]],
+) -> Iterator[None]:
+    """Install ``observer`` as the ambient kernel observer for the dynamic
+    extent of the block (None = explicitly nothing, shadowing any outer
+    observer). The estimator wraps each ladder-rung dispatch in this."""
+    token = _OBSERVER.set(observer)
+    try:
+        yield
+    finally:
+        _OBSERVER.reset(token)
+
+
+def observed(fn: Any) -> Any:
+    """Wrap a kernel entry point so the ambient observer (when installed)
+    sees every call's (fn, args, kwargs) before dispatch. The wrapper runs
+    on the host outside any jit trace; with no observer installed it costs
+    one contextvar read. The wrapped entry is exposed as ``__wrapped__``
+    (functools.wraps), so AOT surfaces like ``.lower`` remain reachable on
+    ``fn`` itself — the observer receives the *compiled* callable."""
+
+    @functools.wraps(fn)
+    def wrapper(*args: Any, **kwargs: Any) -> Any:
+        observer = _OBSERVER.get()
+        if observer is not None:
+            observer(fn, args, kwargs)
+        return fn(*args, **kwargs)
+
+    return wrapper

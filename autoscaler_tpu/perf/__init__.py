@@ -1,0 +1,55 @@
+"""Performance observatory: compile telemetry, the XLA cost ledger,
+device-residency accounting, and per-tick perf records.
+
+Layered on the PR-3 trace taxonomy and the same determinism contract: every
+duration the observatory records is measured on ``trace.timeline_now()``
+(the tracer's injectable clock), and every cost figure is a pure function
+of kernel shapes — so two loadgen replays of the same scenario produce
+byte-identical perf JSONL ledgers (hack/verify.sh gates on exactly that).
+
+Dependency-free at import time (stdlib only): jax is reached lazily and
+guarded inside costmodel.py, the same discipline as trace/device.py.
+"""
+from autoscaler_tpu.perf.costmodel import (
+    analyze_cost,
+    default_peak_flops,
+    operand_bytes,
+    shape_signature,
+)
+from autoscaler_tpu.perf.ledger import (
+    SCHEMA,
+    dump_jsonl,
+    load_jsonl,
+    record_line,
+    stable_json,
+    summarize,
+    validate_records,
+)
+from autoscaler_tpu.perf.observatory import PerfObservatory
+from autoscaler_tpu.perf.residency import (
+    POOL_KERNEL_OPERANDS,
+    POOL_SCENARIO_BATCHES,
+    POOL_SNAPSHOT,
+    ResidencyLedger,
+    array_bytes,
+)
+
+__all__ = [
+    "POOL_KERNEL_OPERANDS",
+    "POOL_SCENARIO_BATCHES",
+    "POOL_SNAPSHOT",
+    "PerfObservatory",
+    "ResidencyLedger",
+    "SCHEMA",
+    "analyze_cost",
+    "array_bytes",
+    "default_peak_flops",
+    "dump_jsonl",
+    "load_jsonl",
+    "operand_bytes",
+    "record_line",
+    "shape_signature",
+    "stable_json",
+    "summarize",
+    "validate_records",
+]

@@ -1,0 +1,162 @@
+"""XLA cost ledger: shape signatures, operand accounting, and guarded
+``lowered.compile().cost_analysis()`` / ``memory_analysis()`` capture.
+
+The cost model answers "what SHOULD this dispatch have cost": model FLOPs
+and bytes-accessed per compiled (route, shape signature), captured once via
+the jax AOT API and cached process-wide — the figures are a pure function
+of (kernel, shapes, backend), so the cache can never serve a stale answer
+and two replays read identical numbers. Everything jax-touching is guarded
+for jax 0.4.x CPU (the SCALE-Sim lesson: a cost model you cannot capture on
+the host you develop on never gets validated at all).
+
+Dependency-free at import time; jax is imported lazily inside functions,
+the trace/device.py discipline.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from autoscaler_tpu.perf.residency import array_bytes
+
+logger = logging.getLogger("perf")
+
+# Nominal peak-FLOP/s denominators for the achieved-vs-model utilization
+# figure, by jax backend. These are COMPARABILITY constants, not hardware
+# truth: utilization is meaningful as a ratio tracked across runs of the
+# same backend (bench.py regresses it), not as an absolute efficiency
+# claim. TPU: v5e peak (bf16); CPU: a nominal desktop-class figure.
+NOMINAL_PEAK_FLOPS: Dict[str, float] = {
+    "tpu": 1.97e14,
+    "gpu": 1.0e13,
+    "cpu": 1.0e11,
+}
+_FALLBACK_PEAK_FLOPS = 1.0e11
+
+
+def default_peak_flops() -> float:
+    """Nominal peak for the active jax backend (guarded: no jax → the CPU
+    figure, keeping the observatory dependency-free)."""
+    try:
+        import jax
+
+        return NOMINAL_PEAK_FLOPS.get(
+            jax.default_backend(), _FALLBACK_PEAK_FLOPS
+        )
+    except Exception:  # noqa: BLE001 — no jax: nominal CPU denominator
+        return _FALLBACK_PEAK_FLOPS
+
+
+def _leaves(obj: Any):
+    """Flatten nested tuples/lists (the kernels' spread-term tuples) into
+    leaf values, preserving order."""
+    if isinstance(obj, (tuple, list)):
+        for item in obj:
+            yield from _leaves(item)
+    else:
+        yield obj
+
+
+def _leaf_sig(leaf: Any) -> str:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        dims = "x".join(str(int(d)) for d in shape)
+        return f"{dims or 'scalar'}:{dtype}"
+    if leaf is None:
+        return "-"
+    if isinstance(leaf, (bool, int, float, str)):
+        return repr(leaf)
+    return type(leaf).__name__
+
+
+def shape_signature(args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> str:
+    """Deterministic compact signature of one kernel call: array leaves as
+    ``dims:dtype``, statics by repr, kwargs sorted by name. Two calls share
+    a signature iff XLA would serve them from the same compiled executable
+    (shapes + dtypes + static args)."""
+    parts = [_leaf_sig(leaf) for leaf in _leaves(args)]
+    for name in sorted(kwargs):
+        vals = ",".join(_leaf_sig(leaf) for leaf in _leaves(kwargs[name]))
+        parts.append(f"{name}={vals}")
+    return ";".join(parts)
+
+
+def operand_bytes(args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> int:
+    """Total bytes of array operands in one kernel call (host or device —
+    the dispatch uploads what is not already resident). Delegates to
+    ``residency.array_bytes`` — the one byte model every pool shares —
+    so the per-dispatch figure and the ``kernel_operands`` pool can never
+    disagree."""
+    return array_bytes(list(args)) + array_bytes(kwargs)
+
+
+# Process-wide cost cache keyed (kernel name, shape signature): the figures
+# are pure functions of shapes/backend, so sharing across observatories is
+# safe and spares repeated AOT compiles (a pytest process replays the same
+# scenarios many times).
+_COST_CACHE: Dict[Tuple[str, str], Optional[Dict[str, float]]] = {}
+_COST_CACHE_LOCK = threading.Lock()
+
+
+def analyze_cost(
+    fn: Any, args: Tuple[Any, ...], kwargs: Dict[str, Any], sig: str = ""
+) -> Optional[Dict[str, float]]:
+    """Model cost of one compiled route via the jax AOT API: FLOPs and
+    bytes-accessed from ``cost_analysis()``, peak temp/argument/output
+    bytes from ``memory_analysis()``. Returns None when the kernel has no
+    AOT surface (plain-python pallas entries) or the backend cannot answer
+    (guarded — jax 0.4.x CPU answers both, hardware variance absorbed).
+
+    Results are cached process-wide by (kernel name, signature); a capture
+    failure is cached too, so a backend that cannot answer is asked once.
+    """
+    name = getattr(fn, "__name__", type(fn).__name__)
+    key = (name, sig or shape_signature(args, kwargs))
+    with _COST_CACHE_LOCK:
+        if key in _COST_CACHE:
+            return _COST_CACHE[key]
+    lower = getattr(fn, "lower", None)
+    rec: Optional[Dict[str, float]] = None
+    if lower is not None:
+        try:
+            compiled = lower(*args, **kwargs).compile()
+            rec = _extract(compiled)
+        except Exception:  # noqa: BLE001 — cost capture is best-effort by
+            # contract: an unanswerable backend must not fail the dispatch
+            logger.warning(
+                "cost analysis unavailable for %s", name, exc_info=True
+            )
+            rec = None
+    with _COST_CACHE_LOCK:
+        _COST_CACHE[key] = rec
+    return rec
+
+
+def _extract(compiled: Any) -> Optional[Dict[str, float]]:
+    rec: Dict[str, float] = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            if "flops" in ca:
+                rec["flops"] = float(ca["flops"])
+            if "bytes accessed" in ca:
+                rec["bytes_accessed"] = float(ca["bytes accessed"])
+    except Exception:  # noqa: BLE001 — per-backend capability probe
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            arg = int(getattr(ma, "argument_size_in_bytes", 0) or 0)
+            out = int(getattr(ma, "output_size_in_bytes", 0) or 0)
+            temp = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+            rec["argument_bytes"] = arg
+            rec["output_bytes"] = out
+            rec["temp_bytes"] = temp
+            rec["peak_bytes"] = arg + out + temp
+    except Exception:  # noqa: BLE001 — per-backend capability probe
+        pass
+    return rec or None

@@ -12,6 +12,7 @@ grpc_tools codegen needed; messages come from protoc --python_out).
 """
 from __future__ import annotations
 
+import contextlib
 from concurrent import futures
 from typing import List, Optional, Sequence
 
@@ -56,7 +57,33 @@ def _check_resource_axis(pods: "pb.PackedPods", context) -> None:
 
 
 class TpuSimulationServicer:
-    """Device-side implementation: each RPC is one batched kernel dispatch."""
+    """Device-side implementation: each RPC is one batched kernel dispatch.
+
+    ``residency`` (a perf.ResidencyLedger, optional) accounts each method's
+    unpacked what-if batch tensors in the ``scenario_batches`` pool — the
+    sidecar's contribution to device_resident_bytes."""
+
+    def __init__(self, residency=None):
+        self.residency = residency
+
+    @contextlib.contextmanager
+    def _account(self, method: str, *arrays):
+        """Account the unpacked batch tensors as resident for the duration
+        of the dispatch, released when the RPC returns — the what-if batch
+        is garbage once the response is serialized, and leaving it seated
+        would report dead tensors as live until the next call."""
+        if self.residency is None:
+            yield
+            return
+        from autoscaler_tpu.perf import POOL_SCENARIO_BATCHES, array_bytes
+
+        self.residency.set(
+            POOL_SCENARIO_BATCHES, method, array_bytes(list(arrays))
+        )
+        try:
+            yield
+        finally:
+            self.residency.drop(POOL_SCENARIO_BATCHES, method)
 
     def Estimate(self, request: pb.EstimateRequest, context) -> pb.EstimateResponse:
         import jax.numpy as jnp
@@ -71,18 +98,19 @@ class TpuSimulationServicer:
         masks = _u8(request.pod_masks, G, P)
         allocs = _f32(request.template_allocs, G, R)
         caps = _i32(request.node_caps, G)
-        # graftlint: disable=GL003 — sidecar server side: the ladder lives in the CLIENT process (TpuSimulationClient's caller); a fault here surfaces as an RPC error the client's ladder absorbs
-        res = ffd_binpack_groups(
-            jnp.asarray(pod_req),
-            jnp.asarray(masks),
-            jnp.asarray(allocs),
-            max_nodes=int(request.max_nodes),
-            node_caps=jnp.asarray(caps),
-        )
-        return pb.EstimateResponse(
-            node_counts=np.asarray(res.node_count, np.dtype("<i4")).tobytes(),
-            scheduled=np.asarray(res.scheduled, np.uint8).tobytes(),
-        )
+        with self._account("Estimate", pod_req, masks, allocs, caps):
+            # graftlint: disable=GL003 — sidecar server side: the ladder lives in the CLIENT process (TpuSimulationClient's caller); a fault here surfaces as an RPC error the client's ladder absorbs
+            res = ffd_binpack_groups(
+                jnp.asarray(pod_req),
+                jnp.asarray(masks),
+                jnp.asarray(allocs),
+                max_nodes=int(request.max_nodes),
+                node_caps=jnp.asarray(caps),
+            )
+            return pb.EstimateResponse(
+                node_counts=np.asarray(res.node_count, np.dtype("<i4")).tobytes(),
+                scheduled=np.asarray(res.scheduled, np.uint8).tobytes(),
+            )
 
     def TrySchedule(self, request: pb.TryScheduleRequest, context) -> pb.TryScheduleResponse:
         """Greedy kernel over packed tensors. When the request carries a
@@ -105,41 +133,42 @@ class TpuSimulationServicer:
         mask = _u8(request.sched_mask, P, N)
         slots = _i32(request.pod_slots, -1)
         hints = _i32(request.hints, -1)
-        spread = None
-        if request.HasField("spread"):
-            sp = request.spread
-            S, D = sp.num_terms, sp.num_domains
-            spread = tuple(
-                jnp.asarray(a)
-                for a in (
-                    _u8(sp.sp_of, P, S),
-                    _u8(sp.sp_match, P, S),
-                    _i32(sp.node_dom, S, N),
-                    _u8(sp.sp_elig, S, N),
-                    _u8(sp.dom_valid, S, D),
-                    _i32(sp.static_counts, S, D),
-                    _i32(sp.skew, S),
-                    _i32(sp.min_dom, S),
-                    _i32(sp.domnum, S),
+        with self._account("TrySchedule", pod_req, free, mask, slots, hints):
+            spread = None
+            if request.HasField("spread"):
+                sp = request.spread
+                S, D = sp.num_terms, sp.num_domains
+                spread = tuple(
+                    jnp.asarray(a)
+                    for a in (
+                        _u8(sp.sp_of, P, S),
+                        _u8(sp.sp_match, P, S),
+                        _i32(sp.node_dom, S, N),
+                        _u8(sp.sp_elig, S, N),
+                        _u8(sp.dom_valid, S, D),
+                        _i32(sp.static_counts, S, D),
+                        _i32(sp.skew, S),
+                        _i32(sp.min_dom, S),
+                        _i32(sp.domnum, S),
+                    )
                 )
+            snap = SnapshotTensors(
+                node_alloc=jnp.asarray(free),
+                node_used=jnp.zeros((N, R), jnp.float32),
+                node_valid=jnp.ones((N,), bool),
+                node_group=jnp.full((N,), -1, jnp.int32),
+                pod_req=jnp.asarray(pod_req),
+                pod_valid=jnp.ones((P,), bool),
+                pod_node=jnp.full((P,), -1, jnp.int32),
+                sched_mask=jnp.asarray(mask),
             )
-        snap = SnapshotTensors(
-            node_alloc=jnp.asarray(free),
-            node_used=jnp.zeros((N, R), jnp.float32),
-            node_valid=jnp.ones((N,), bool),
-            node_group=jnp.full((N,), -1, jnp.int32),
-            pod_req=jnp.asarray(pod_req),
-            pod_valid=jnp.ones((P,), bool),
-            pod_node=jnp.full((P,), -1, jnp.int32),
-            sched_mask=jnp.asarray(mask),
-        )
-        res = greedy_schedule(
-            snap, jnp.asarray(slots), jnp.asarray(hints), spread=spread
-        )
-        return pb.TryScheduleResponse(
-            placed=np.asarray(res.placed, np.uint8).tobytes(),
-            dest=np.asarray(res.dest, np.dtype("<i4")).tobytes(),
-        )
+            res = greedy_schedule(
+                snap, jnp.asarray(slots), jnp.asarray(hints), spread=spread
+            )
+            return pb.TryScheduleResponse(
+                placed=np.asarray(res.placed, np.uint8).tobytes(),
+                dest=np.asarray(res.dest, np.dtype("<i4")).tobytes(),
+            )
 
     def FindNodesToRemove(
         self, request: pb.FindNodesToRemoveRequest, context
@@ -161,23 +190,27 @@ class TpuSimulationServicer:
         cands = _i32(request.candidate_nodes, -1)
         slots = _i32(request.pod_slots, len(cands), S)
         blocked = _u8(request.blocked, len(cands))
-        snap = SnapshotTensors(
-            node_alloc=jnp.asarray(alloc),
-            node_used=jnp.asarray(used),
-            node_valid=jnp.ones((N,), bool),
-            node_group=jnp.full((N,), -1, jnp.int32),
-            pod_req=jnp.asarray(pod_req),
-            pod_valid=jnp.ones((P,), bool),
-            pod_node=jnp.full((P,), -1, jnp.int32),
-            sched_mask=jnp.asarray(mask),
-        )
-        res = removal_feasibility(
-            snap, jnp.asarray(cands), jnp.asarray(slots), jnp.asarray(blocked)
-        )
-        return pb.FindNodesToRemoveResponse(
-            feasible=np.asarray(res.feasible, np.uint8).tobytes(),
-            destinations=np.asarray(res.destinations, np.dtype("<i4")).tobytes(),
-        )
+        with self._account(
+            "FindNodesToRemove", pod_req, alloc, used, mask, cands, slots,
+            blocked,
+        ):
+            snap = SnapshotTensors(
+                node_alloc=jnp.asarray(alloc),
+                node_used=jnp.asarray(used),
+                node_valid=jnp.ones((N,), bool),
+                node_group=jnp.full((N,), -1, jnp.int32),
+                pod_req=jnp.asarray(pod_req),
+                pod_valid=jnp.ones((P,), bool),
+                pod_node=jnp.full((P,), -1, jnp.int32),
+                sched_mask=jnp.asarray(mask),
+            )
+            res = removal_feasibility(
+                snap, jnp.asarray(cands), jnp.asarray(slots), jnp.asarray(blocked)
+            )
+            return pb.FindNodesToRemoveResponse(
+                feasible=np.asarray(res.feasible, np.uint8).tobytes(),
+                destinations=np.asarray(res.destinations, np.dtype("<i4")).tobytes(),
+            )
 
     def BestOptions(self, request: pb.BestOptionsRequest, context) -> pb.BestOptionsResponse:
         """Least-waste-style reduction over the option list (the expander
@@ -211,10 +244,12 @@ def _generic_handler(servicer: TpuSimulationServicer) -> grpc.GenericRpcHandler:
     return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
 
 
-def serve(address: str = "127.0.0.1:0", max_workers: int = 4):
+def serve(address: str = "127.0.0.1:0", max_workers: int = 4, residency=None):
     """→ (server, bound_port). The sidecar process entrypoint."""
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
-    server.add_generic_rpc_handlers((_generic_handler(TpuSimulationServicer()),))
+    server.add_generic_rpc_handlers(
+        (_generic_handler(TpuSimulationServicer(residency=residency)),)
+    )
     port = server.add_insecure_port(address)
     server.start()
     return server, port

@@ -971,6 +971,16 @@ class IncrementalPacker:
             self._cells = cells
             self._dirty_fields.add("cells")
 
+    def device_bytes(self) -> int:
+        """Total bytes of the packer's persistent device tensors — the perf
+        residency ledger's ``snapshot`` pool (run_once stamps it per tick).
+        Delegates to ``perf.array_bytes``, the one byte model every
+        residency pool shares; a pure function of the packed world's
+        shapes, so the figure replays byte-identically under loadgen."""
+        from autoscaler_tpu.perf import array_bytes
+
+        return array_bytes(list(self._dev.values()))
+
     # ------------------------------------------------------------- assembly
     def _upload(self, name: str, arr: np.ndarray) -> object:
         if name in self._dirty_fields or name not in self._dev:

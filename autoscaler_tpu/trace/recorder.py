@@ -112,13 +112,25 @@ def chrome_trace_doc(traces: List[TickTrace]) -> Dict[str, Any]:
 
     for t in traces:
         pid = t.trace_id
+        # "M"-phase metadata names the tracks: Perfetto shows
+        # "autoscaler/tick N" process rows and an "autoscaler/tick" thread
+        # lane instead of raw pid/tid integers
         events.append(
             {
                 "name": "process_name",
                 "ph": "M",
                 "pid": pid,
                 "tid": 0,
-                "args": {"name": f"tick {t.trace_id}"},
+                "args": {"name": f"autoscaler/tick {t.trace_id}"},
+            }
+        )
+        events.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": "autoscaler/tick"},
             }
         )
         for sp in t.spans:

@@ -406,7 +406,43 @@ def _probe_backend(timeout_s: int = 150) -> str | None:
     return None
 
 
+def _perf_ledger_main(path: str) -> int:
+    """``bench.py --perf-ledger <ledger.jsonl>``: validate a perf JSONL
+    ledger (schema, tick monotonicity, compile-cache coherence — a
+    ``cache: miss`` for an already-seen (route, shape signature) is a
+    compile-on-steady-state-tick regression) and print the per-route
+    compile-vs-execute report. Exit 0 = valid, 1 = regression/schema
+    errors, 2 = unreadable ledger. hack/verify.sh gates on this."""
+    from autoscaler_tpu.perf import load_jsonl, summarize, validate_records
+
+    try:
+        records = load_jsonl(path)
+    except (OSError, ValueError) as e:
+        print(json.dumps({"metric": "perf_ledger", "error": str(e)}))
+        return 2
+    errors = validate_records(records)
+    report = {
+        "metric": "perf_ledger",
+        "ledger": os.path.basename(path),
+        "valid": not errors,
+        # bounded: a corrupted ledger must not flood CI logs
+        "errors": errors[:20],
+        "errors_total": len(errors),
+        # summarize only what validated: aggregating a malformed ledger
+        # would crash on the very shapes validation just rejected
+        **(summarize(records) if not errors else {}),
+    }
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 1 if errors else 0
+
+
 def main():
+    if "--perf-ledger" in sys.argv:
+        idx = sys.argv.index("--perf-ledger")
+        if idx + 1 >= len(sys.argv):
+            print("usage: bench.py --perf-ledger <ledger.jsonl>", file=sys.stderr)
+            sys.exit(2)
+        sys.exit(_perf_ledger_main(sys.argv[idx + 1]))
     if os.environ.get(_CHILD_ENV) == "1":
         _bench_main()
         return

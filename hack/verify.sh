@@ -53,15 +53,20 @@ for scenario in benchmarks/scenarios/*.json; do
     python -m autoscaler_tpu.loadgen validate "$scenario"
 done
 
-echo "== trace-schema determinism check (two replays must export byte-identical Chrome traces) =="
+echo "== trace + perf-ledger determinism check (two replays must export byte-identical Chrome traces AND perf JSONL ledgers) =="
 trace_tmp=$(mktemp -d)
 python -m autoscaler_tpu.loadgen run benchmarks/scenarios/kernel_fault_ladder.json \
-    --chrome-trace "$trace_tmp/a.json" >/dev/null
+    --chrome-trace "$trace_tmp/a.json" --perf-ledger "$trace_tmp/a.perf.jsonl" >/dev/null
 python -m autoscaler_tpu.loadgen run benchmarks/scenarios/kernel_fault_ladder.json \
-    --chrome-trace "$trace_tmp/b.json" >/dev/null
+    --chrome-trace "$trace_tmp/b.json" --perf-ledger "$trace_tmp/b.perf.jsonl" >/dev/null
 if ! diff -q "$trace_tmp/a.json" "$trace_tmp/b.json" >/dev/null; then
     echo "ERROR: trace export is nondeterministic across identical replays:" >&2
     diff "$trace_tmp/a.json" "$trace_tmp/b.json" | head -20 >&2
+    exit 1
+fi
+if ! diff -q "$trace_tmp/a.perf.jsonl" "$trace_tmp/b.perf.jsonl" >/dev/null; then
+    echo "ERROR: perf JSONL ledger is nondeterministic across identical replays:" >&2
+    diff "$trace_tmp/a.perf.jsonl" "$trace_tmp/b.perf.jsonl" | head -20 >&2
     exit 1
 fi
 python - "$trace_tmp/a.json" <<'EOF'
@@ -70,10 +75,40 @@ doc = json.load(open(sys.argv[1]))
 events = doc["traceEvents"]
 assert events, "empty chrome trace"
 names = {e["name"] for e in events}
-for required in ("main", "estimate", "deviceDispatch", "buildSnapshot"):
+for required in ("main", "estimate", "deviceDispatch", "buildSnapshot", "perfRecord"):
     assert required in names, f"trace schema missing {required!r} spans"
-print(f"trace determinism ok ({len(events)} events)")
+# Perfetto track metadata: every tick process carries naming "M" events
+pids = {e["pid"] for e in events if e["ph"] == "X"}
+for meta in ("process_name", "thread_name"):
+    named = {e["pid"] for e in events if e["ph"] == "M" and e["name"] == meta}
+    assert pids <= named, f"ticks missing {meta} metadata: {sorted(pids - named)}"
+# perf acceptance surface: every served deviceDispatch span carries compile
+# telemetry; warm ones carry the compile/execute split; cost-model attrs
+# appear on the costed (device-kernel) routes
+dd = [e for e in events
+      if e["name"] == "deviceDispatch" and e["ph"] == "X"
+      and e["args"].get("outcome") == "ok"]
+assert dd, "no served deviceDispatch spans in the replay"
+for e in dd:
+    a = e["args"]
+    assert "cache" in a and "dispatch_s" in a, f"span missing compile telemetry: {a}"
+warm = [e for e in dd if e["args"].get("cache") == "hit"]
+assert warm, "replay produced no warm dispatches"
+for e in warm:
+    a = e["args"]
+    assert "compile_est_s" in a and "execute_est_s" in a, \
+        f"warm dispatch missing compile/execute split: {a}"
+assert any("model_flops" in e["args"] for e in dd), \
+    "no cost-model attrs on any deviceDispatch span"
+print(f"trace determinism ok ({len(events)} events, {len(dd)} served dispatches)")
 EOF
+
+echo "== perf-ledger schema + steady-state-compile regression gate =="
+# validates the JSONL schema, tick monotonicity, and compile-cache
+# coherence (a cache miss for an already-seen (route, shape signature) is
+# a compile-on-steady-state-tick regression)
+python bench.py --perf-ledger "$trace_tmp/a.perf.jsonl" >/dev/null
+echo "perf ledger ok"
 rm -rf "$trace_tmp"
 
 echo "== unit tests (8-device virtual CPU mesh) =="

@@ -1,0 +1,689 @@
+"""Perf-observatory tests (autoscaler_tpu/perf): compile telemetry, the
+XLA cost ledger, residency accounting, the per-tick ledger schema +
+regression gate, /perfz, and the loadgen byte-determinism acceptance."""
+import json
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from autoscaler_tpu import trace
+from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from autoscaler_tpu.kube.api import FakeClusterAPI
+from autoscaler_tpu.main import ObservabilityServer
+from autoscaler_tpu.metrics.metrics import (
+    DURATION_BUCKETS,
+    AutoscalerMetrics,
+    MetricsRegistry,
+    PERF_RECORD,
+)
+from autoscaler_tpu.perf import (
+    POOL_KERNEL_OPERANDS,
+    POOL_SNAPSHOT,
+    PerfObservatory,
+    ResidencyLedger,
+    SCHEMA,
+    analyze_cost,
+    array_bytes,
+    default_peak_flops,
+    operand_bytes,
+    record_line,
+    shape_signature,
+    summarize,
+    validate_records,
+)
+from autoscaler_tpu.utils.test_utils import GB, build_test_node, build_test_pod
+
+
+# ---------------------------------------------------------------- helpers
+class _FakeSpan:
+    def __init__(self):
+        self.attrs = {}
+
+    def set_attrs(self, **kw):
+        self.attrs.update(kw)
+
+
+def _dispatch_once(obs, fn, args, route="xla_scan", wall=0.01, span=None):
+    obs.clear_pending()
+    obs.note_kernel(fn, args, {})
+    obs.on_dispatch(route, wall, span=span)
+
+
+def make_autoscaler(pods=(), **opt_kw):
+    provider = TestCloudProvider()
+    api = FakeClusterAPI()
+    provider.add_node_group(
+        "g", 0, 10, 1, build_test_node("t", cpu_m=1000, mem=2 * GB)
+    )
+    node = build_test_node("g-0", cpu_m=1000, mem=2 * GB)
+    provider.add_node("g", node)
+    api.add_node(node)
+    for p in pods:
+        api.add_pod(p)
+    return StaticAutoscaler(provider, api, AutoscalingOptions(**opt_kw))
+
+
+@pytest.fixture(scope="module")
+def ladder_replays():
+    """The acceptance workload: the canned kernel-fault scenario run twice."""
+    from autoscaler_tpu.loadgen.driver import run_scenario
+    from autoscaler_tpu.loadgen.spec import ScenarioSpec
+
+    path = "benchmarks/scenarios/kernel_fault_ladder.json"
+    r1 = run_scenario(ScenarioSpec.load(path))
+    r2 = run_scenario(ScenarioSpec.load(path))
+    return r1, r2
+
+
+# ------------------------------------------------- duration bucket ladder
+class TestDurationBuckets:
+    def test_bucket_boundaries_pinned(self):
+        """The ladder is dashboard history: a silent change corrupts every
+        recorded series. Extends DOWN to 1e-4 s so sub-millisecond device
+        dispatches resolve instead of piling into the bottom bucket."""
+        assert DURATION_BUCKETS == (
+            1e-4, 2.5e-4, 5e-4,
+            1e-3, 2.5e-3, 5e-3,
+            1e-2, 2.5e-2, 5e-2,
+            0.1, 0.25, 0.5,
+            1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+        )
+        assert DURATION_BUCKETS[0] == 1e-4
+
+    def test_sub_ms_dispatches_resolve(self):
+        r = MetricsRegistry()
+        h = r.histogram("d", "")
+        h.observe(2e-4, function="deviceDispatch")
+        h.observe(3e-3, function="deviceDispatch")
+        counts = h.bucket_counts(function="deviceDispatch")
+        # 2e-4 lands at le=2.5e-4 (index 1), NOT the bottom bucket
+        assert counts[0] == 0 and counts[1] == 1
+        # cumulative le-semantics: both observations admitted at le=5e-3
+        assert counts[DURATION_BUCKETS.index(5e-3)] == 2
+
+    def test_histogram_exposition_and_quantile_api(self):
+        r = MetricsRegistry()
+        h = r.histogram("cluster_autoscaler_function_duration_seconds", "x")
+        for v in (5e-5, 2e-4, 0.02, 4.0):
+            h.observe(v, function="estimate")
+        text = r.expose()
+        assert (
+            'function_duration_seconds_bucket{function="estimate",le="0.0001"} 1'
+            in text
+        )
+        assert (
+            'function_duration_seconds_bucket{function="estimate",le="+Inf"} 4'
+            in text
+        )
+        assert "# TYPE cluster_autoscaler_function_duration_seconds histogram" in text
+        assert 'function_duration_seconds_count{function="estimate"} 4' in text
+        # the Summary quantile surface (scorer p50/p99 columns) still works
+        assert h.quantile(0.5, function="estimate") == 0.02
+        assert h.count(function="estimate") == 4
+
+    def test_autoscaler_function_duration_is_histogram(self):
+        m = AutoscalerMetrics()
+        m.observe_duration_value("estimate", 3e-4)
+        assert m.function_duration.bucket_counts(function="estimate")[2] == 1
+        assert m.function_duration.kind == "histogram"
+
+
+# --------------------------------------------------------------- costmodel
+class TestCostModel:
+    def test_shape_signature_deterministic_and_kwargs_sorted(self):
+        a = np.zeros((8, 6), np.float32)
+        b = np.zeros((1, 8), bool)
+        s1 = shape_signature((a, b), {"max_nodes": 16, "caps": a})
+        s2 = shape_signature((a, b), {"caps": a, "max_nodes": 16})
+        assert s1 == s2
+        assert "8x6:float32" in s1 and "max_nodes=16" in s1
+
+    def test_signature_distinguishes_shapes_and_statics(self):
+        a = np.zeros((8, 6), np.float32)
+        base = shape_signature((a,), {"max_nodes": 16})
+        assert base != shape_signature((a,), {"max_nodes": 32})
+        assert base != shape_signature(
+            (np.zeros((16, 6), np.float32),), {"max_nodes": 16}
+        )
+
+    def test_operand_bytes_counts_nested_leaves(self):
+        a = np.zeros((4, 4), np.float32)   # 64 B
+        b = np.zeros((8,), np.int32)       # 32 B
+        assert operand_bytes((a, (b, b)), {"k": a, "s": 3}) == 64 + 32 + 32 + 64
+
+    def test_analyze_cost_answers_on_cpu(self):
+        @jax.jit
+        def mm(x, y):
+            return x @ y
+
+        x = jnp.ones((32, 32), jnp.float32)
+        cost = analyze_cost(mm, (x, x), {})
+        assert cost is not None
+        assert cost.get("flops", 0) > 0
+        assert cost.get("peak_bytes", 0) > 0
+
+    def test_analyze_cost_caches_failures(self):
+        calls = []
+
+        class NoLower:
+            __name__ = "no_lower_kernel"
+
+        assert analyze_cost(NoLower(), (), {}, sig="s") is None
+
+        class Raises:
+            __name__ = "raising_kernel_perc"
+
+            def lower(self, *a, **k):
+                calls.append(1)
+                raise RuntimeError("backend cannot answer")
+
+        r = Raises()
+        assert analyze_cost(r, (), {}, sig="t") is None
+        assert analyze_cost(r, (), {}, sig="t") is None
+        assert len(calls) == 1  # the failure is cached — asked exactly once
+
+    def test_default_peak_flops_positive(self):
+        assert default_peak_flops() > 0
+
+
+# --------------------------------------------------------------- residency
+class TestResidency:
+    def test_set_drop_and_pool_sums(self):
+        led = ResidencyLedger()
+        led.set("snapshot", "packer", 720)
+        led.set("snapshot", "extra", 80)
+        led.set("kernel_operands", "dispatch", 228)
+        assert led.pool_bytes("snapshot") == 800
+        led.drop("snapshot", "extra")
+        assert led.snapshot() == {"kernel_operands": 228, "snapshot": 720}
+
+    def test_gauge_feed(self):
+        m = AutoscalerMetrics()
+        led = ResidencyLedger(metrics=m)
+        led.set(POOL_SNAPSHOT, "packer", 1024)
+        assert m.device_resident_bytes.get(pool=POOL_SNAPSHOT) == 1024.0
+        led.drop(POOL_SNAPSHOT, "packer")
+        assert m.device_resident_bytes.get(pool=POOL_SNAPSHOT) == 0.0
+
+    def test_array_bytes_nested(self):
+        a = np.zeros((4,), np.float32)
+        assert array_bytes([a, {"x": a}, (a,)]) == 48
+        assert array_bytes(None) == 0
+
+    def test_rpc_servicer_accounts_scenario_batches(self):
+        from autoscaler_tpu.perf import POOL_SCENARIO_BATCHES
+        from autoscaler_tpu.rpc.service import TpuSimulationServicer
+
+        led = ResidencyLedger()
+        servicer = TpuSimulationServicer(residency=led)
+        with servicer._account(
+            "Estimate",
+            np.zeros((8, 6), np.float32),   # 192 B
+            np.zeros((2, 8), np.uint8),     # 16 B
+        ):
+            assert led.pool_bytes(POOL_SCENARIO_BATCHES) == 208
+        # released when the RPC returns: the batch is garbage once the
+        # response is serialized, and must not read as live after it
+        assert led.pool_bytes(POOL_SCENARIO_BATCHES) == 0
+        assert POOL_SCENARIO_BATCHES not in led.snapshot()
+        # a residency-less servicer (the default) stays inert
+        with TpuSimulationServicer()._account("Estimate", np.zeros((4,))):
+            pass
+
+
+# ------------------------------------------------------------------ ledger
+def _tick_rec(tick, dispatches=()):
+    return {
+        "schema": SCHEMA,
+        "tick": tick,
+        "now_ts": 1000.0 + tick,
+        "dispatches": list(dispatches),
+        "resident_bytes": {"snapshot": 720},
+    }
+
+
+def _disp(route="xla_scan", sig="8x6:f32", cache="hit", s=0.001):
+    return {
+        "route": route,
+        "sig": sig,
+        "cache": cache,
+        "cold": cache == "miss",
+        "dispatch_s": s,
+        "operand_bytes": 128,
+    }
+
+
+class TestLedger:
+    def test_valid_ledger_passes(self):
+        recs = [
+            _tick_rec(0, [_disp(cache="miss")]),
+            _tick_rec(1, [_disp(cache="hit")]),
+        ]
+        assert validate_records(recs) == []
+
+    def test_schema_and_monotonicity_errors(self):
+        bad = [_tick_rec(3), {**_tick_rec(3), "schema": "nope"}]
+        errors = validate_records(bad)
+        assert any("not increasing" in e for e in errors)
+        assert any("schema" in e for e in errors)
+
+    def test_steady_state_compile_regression_detected(self):
+        recs = [
+            _tick_rec(0, [_disp(cache="miss")]),
+            _tick_rec(1, [_disp(cache="hit")]),
+            _tick_rec(2, [_disp(cache="miss")]),  # the executable was lost
+        ]
+        errors = validate_records(recs)
+        assert len(errors) == 1
+        assert "compile-on-steady-state-tick" in errors[0]
+
+    def test_truncated_ledger_hits_without_miss_are_legal(self):
+        # a ring-evicted prefix can hide the original miss — hits alone
+        # must validate (the gate is truncation-safe)
+        recs = [_tick_rec(5, [_disp(cache="hit")])]
+        assert validate_records(recs) == []
+
+    def test_distinct_signatures_may_each_miss(self):
+        recs = [
+            _tick_rec(0, [_disp(sig="a", cache="miss")]),
+            _tick_rec(1, [_disp(sig="b", cache="miss")]),
+        ]
+        assert validate_records(recs) == []
+
+    def test_cold_cache_disagreement_flagged(self):
+        d = _disp(cache="miss")
+        d["cold"] = False
+        errors = validate_records([_tick_rec(0, [d])])
+        assert any("disagrees" in e for e in errors)
+
+    def test_record_line_byte_stable(self):
+        rec = _tick_rec(0, [_disp()])
+        assert record_line(rec) == record_line(json.loads(record_line(rec)))
+
+    def test_summarize_per_route_split(self):
+        recs = [
+            _tick_rec(0, [_disp(cache="miss", s=0.5)]),
+            _tick_rec(1, [_disp(cache="hit", s=0.001),
+                          _disp(route="native", sig="", cache="miss", s=0.002)]),
+        ]
+        agg = summarize(recs)
+        assert agg["ticks"] == 2
+        xs = agg["routes"]["xla_scan"]
+        assert xs["compiles"] == 1 and xs["dispatches"] == 2
+        assert xs["compile_s"] == 0.5 and xs["execute_s"] == 0.001
+        assert agg["resident_bytes_peak"]["snapshot"] == 720
+
+
+# ------------------------------------------------------------- observatory
+class TestObservatory:
+    def _fn(self):
+        def kernel(*a, **k):
+            return None
+
+        kernel.__name__ = "fake_kernel"
+        return kernel
+
+    def test_cold_then_warm_split_and_span_attrs(self):
+        m = AutoscalerMetrics()
+        obs = PerfObservatory(metrics=m)
+        obs.begin_tick(0, 1000.0)
+        fn = self._fn()
+        args = (np.zeros((8, 6), np.float32),)
+        cold_span = _FakeSpan()
+        _dispatch_once(obs, fn, args, wall=0.5, span=cold_span)
+        assert cold_span.attrs["cache"] == "miss" and cold_span.attrs["cold"]
+        assert cold_span.attrs["shape_sig"] == "8x6:float32"
+        assert cold_span.attrs["operand_bytes"] == 192
+        warm_span = _FakeSpan()
+        _dispatch_once(obs, fn, args, wall=0.01, span=warm_span)
+        assert warm_span.attrs["cache"] == "hit"
+        assert warm_span.attrs["execute_est_s"] == 0.01
+        assert warm_span.attrs["compile_est_s"] == pytest.approx(0.49)
+        rec = obs.end_tick()
+        assert [d["cache"] for d in rec["dispatches"]] == ["miss", "hit"]
+        assert rec["resident_bytes"][POOL_KERNEL_OPERANDS] == 192
+        assert m.kernel_compile_cache_total.get(
+            route="xla_scan", outcome="miss"
+        ) == 1
+        assert m.kernel_compile_cache_total.get(
+            route="xla_scan", outcome="hit"
+        ) == 1
+        assert m.kernel_compile_seconds.count(route="xla_scan") == 1
+        assert m.kernel_execute_seconds.count(route="xla_scan") == 1
+
+    def test_cold_is_per_signature_not_per_route(self):
+        obs = PerfObservatory()
+        obs.begin_tick(0, 0.0)
+        fn = self._fn()
+        _dispatch_once(obs, fn, (np.zeros((8, 6), np.float32),))
+        _dispatch_once(obs, fn, (np.zeros((16, 6), np.float32),))
+        rec = obs.end_tick()
+        assert [d["cache"] for d in rec["dispatches"]] == ["miss", "miss"]
+
+    def test_stale_pending_cannot_leak_across_rungs(self):
+        obs = PerfObservatory()
+        obs.begin_tick(0, 0.0)
+        # a rung observed its kernel entry then faulted: on_dispatch never
+        # ran. The next rung (host — no observed entry) must not inherit it.
+        obs.note_kernel(self._fn(), (np.zeros((8, 6), np.float32),), {})
+        obs.clear_pending()
+        obs.on_dispatch("native", 0.001)
+        rec = obs.end_tick()
+        assert rec["dispatches"][0]["sig"] == ""
+        assert rec["dispatches"][0]["operand_bytes"] == 0
+        # the faulted rung's operand bytes were released with the parked
+        # call — a host-served tick must not report a dead dispatch's
+        # arrays as resident
+        assert POOL_KERNEL_OPERANDS not in rec["resident_bytes"]
+
+    def test_clear_pending_preserves_served_dispatch_residency(self):
+        # clear_pending before a FOLLOWING estimate() call must not release
+        # the operands of the dispatch that already served this tick
+        obs = PerfObservatory()
+        obs.begin_tick(0, 0.0)
+        _dispatch_once(obs, self._fn(), (np.zeros((8, 6), np.float32),))
+        obs.clear_pending()  # next estimate's rung walk starts
+        rec = obs.end_tick()
+        assert rec["resident_bytes"][POOL_KERNEL_OPERANDS] == 192
+
+    def test_ring_bounded_and_queries(self):
+        obs = PerfObservatory(ring_capacity=2)
+        for i in range(4):
+            obs.begin_tick(i, float(i))
+            obs.end_tick()
+        assert [r["tick"] for r in obs.records()] == [2, 3]
+        listing = json.loads(obs.list_json())
+        assert listing["schema"] == SCHEMA
+        assert [t["tick"] for t in listing["ticks"]] == [2, 3]
+        assert json.loads(obs.detail_json(3))["tick"] == 3
+        assert obs.detail_json(0) is None
+
+    def test_idle_tick_does_not_inherit_operand_bytes(self):
+        # the kernel_operands pool accounts the in-flight dispatch; a tick
+        # with no dispatch must not report the last tick's operands as
+        # live (end_tick releases the slot after snapshotting)
+        obs = PerfObservatory()
+        obs.begin_tick(0, 0.0)
+        _dispatch_once(obs, self._fn(), (np.zeros((8, 6), np.float32),))
+        rec0 = obs.end_tick()
+        assert rec0["resident_bytes"][POOL_KERNEL_OPERANDS] == 192
+        obs.begin_tick(1, 1.0)
+        rec1 = obs.end_tick()
+        assert POOL_KERNEL_OPERANDS not in rec1["resident_bytes"]
+
+    def test_end_tick_without_begin_is_noop(self):
+        obs = PerfObservatory()
+        assert obs.end_tick() is None
+        assert obs.last_record() is None
+
+    def test_dispatch_outside_tick_still_feeds_stats(self):
+        obs = PerfObservatory()
+        _dispatch_once(obs, self._fn(), (np.zeros((2,), np.float32),))
+        assert obs.records() == []  # no open tick — nothing ringed
+        obs.begin_tick(0, 0.0)
+        _dispatch_once(obs, self._fn(), (np.zeros((2,), np.float32),))
+        rec = obs.end_tick()
+        # the pre-tick dispatch was that signature's cold one
+        assert rec["dispatches"][0]["cache"] == "hit"
+
+
+# ---------------------------------------------- run_once + estimator wiring
+class TestRunOnceIntegration:
+    def test_tick_record_per_run_once_with_dispatches(self):
+        pods = [
+            build_test_pod(f"p{i}", cpu_m=600, mem=GB) for i in range(4)
+        ]
+        a = make_autoscaler(pods=pods, perf_cost_model=True)
+        a.run_once(now_ts=0.0)
+        rec = a.observatory.last_record()
+        assert rec is not None and rec["schema"] == SCHEMA
+        assert rec["dispatches"], "scale-up tick recorded no dispatches"
+        d = rec["dispatches"][0]
+        assert d["cache"] == "miss" and d["sig"]
+        assert d.get("cost", {}).get("flops", 0) > 0
+        assert rec["resident_bytes"][POOL_SNAPSHOT] > 0
+        # the estimator's deviceDispatch span carries the telemetry attrs
+        spans = [
+            s
+            for t in a.tracer.recorder.traces()
+            for s in t.spans
+            if s.name == "deviceDispatch" and s.attrs.get("outcome") == "ok"
+        ]
+        assert spans and all("cache" in s.attrs for s in spans)
+        assert any("model_flops" in s.attrs for s in spans)
+
+    def test_crashed_tick_still_closes_its_record(self, monkeypatch):
+        a = make_autoscaler()
+        monkeypatch.setattr(
+            a, "_run_once_traced",
+            lambda *ar, **kw: (_ for _ in ()).throw(RuntimeError("boom")),
+        )
+        with pytest.raises(RuntimeError):
+            a.run_once(now_ts=0.0)
+        assert a.observatory.last_record() is not None
+
+    def test_perf_record_span_in_tick_tree(self):
+        a = make_autoscaler()
+        a.run_once(now_ts=0.0)
+        names = {s.name for t in a.tracer.recorder.traces() for s in t.spans}
+        assert PERF_RECORD in names
+
+
+# ----------------------------------------------------------------- /perfz
+class TestPerfzEndpoint:
+    def _get(self, port, path):
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, r.read().decode()
+
+    def test_perfz_list_and_detail(self):
+        a = make_autoscaler()
+        a.run_once(now_ts=0.0)
+        a.run_once(now_ts=10.0)
+        server = ObservabilityServer(a, "127.0.0.1:0")
+        port = server.start()
+        try:
+            code, body = self._get(port, "/perfz")
+            assert code == 200
+            listing = json.loads(body)
+            assert listing["schema"] == SCHEMA and len(listing["ticks"]) == 2
+            tick = listing["ticks"][-1]["tick"]
+            code, body = self._get(port, f"/perfz?tick={tick}")
+            assert code == 200 and json.loads(body)["tick"] == tick
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(port, "/perfz?tick=99999")
+            assert ei.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(port, "/perfz?tick=bogus")
+            assert ei.value.code == 400
+        finally:
+            server.stop()
+
+    def test_perfz_gated_like_tracez(self):
+        a = make_autoscaler(perf_enabled=False)
+        a.run_once(now_ts=0.0)
+        server = ObservabilityServer(a, "127.0.0.1:0")
+        port = server.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(port, "/perfz")
+            assert ei.value.code == 404
+        finally:
+            server.stop()
+
+
+class TestConcurrentRingEviction:
+    """Satellite: /tracez and /perfz racing a writer that overflows both
+    rings — every response must be well-formed JSON, never a torn trace."""
+
+    def test_endpoints_race_ring_overflow(self):
+        a = make_autoscaler(trace_ring_size=2, perf_ring_size=2)
+        a.run_once(now_ts=0.0)  # warm compile so writer iterations are fast
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                # the cheap tick analog: tracer ring + perf ring both roll
+                with a.tracer.tick("main", now_ts=float(i)):
+                    a.observatory.begin_tick(i, float(i))
+                    with trace.span("estimate"):
+                        pass
+                a.observatory.end_tick()
+
+        server = ObservabilityServer(a, "127.0.0.1:0")
+        port = server.start()
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            for _ in range(60):
+                for path in ("/tracez", "/perfz", "/tracez?format=chrome"):
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}"
+                    ) as r:
+                        body = r.read().decode()
+                    try:
+                        json.loads(body)
+                    except json.JSONDecodeError as e:  # pragma: no cover
+                        errors.append(f"{path}: torn response: {e}")
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            server.stop()
+        assert not errors
+
+
+# -------------------------------------------------- chrome track metadata
+class TestChromeMetadata:
+    def test_metadata_events_name_tracks(self):
+        from autoscaler_tpu.trace.recorder import chrome_trace_doc
+
+        tracer = trace.Tracer(recorder=trace.FlightRecorder(capacity=4))
+        for i in range(2):
+            with tracer.tick("main", now_ts=float(i)):
+                with trace.span("estimate"):
+                    pass
+        doc = chrome_trace_doc(tracer.recorder.traces())
+        meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        pids = {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+        proc = {e["pid"]: e for e in meta if e["name"] == "process_name"}
+        thr = {e["pid"]: e for e in meta if e["name"] == "thread_name"}
+        assert set(proc) == pids and set(thr) == pids
+        for pid in pids:
+            assert proc[pid]["args"]["name"] == f"autoscaler/tick {pid}"
+            assert thr[pid]["args"]["name"] == "autoscaler/tick"
+
+
+# ------------------------------------- loadgen determinism + scorer + CLI
+class TestLoadgenPerfDeterminism:
+    def test_two_replays_write_byte_identical_perf_ledgers(
+        self, ladder_replays
+    ):
+        r1, r2 = ladder_replays
+        l1, l2 = r1.perf_ledger_lines(), r2.perf_ledger_lines()
+        assert l1 and l1 == l2
+        records = [json.loads(line) for line in l1.splitlines()]
+        assert validate_records(records) == []
+        assert len(records) == r1.spec.ticks
+
+    def test_replayed_dispatch_spans_carry_perf_attrs(self, ladder_replays):
+        """Acceptance: each served deviceDispatch span in the replayed
+        trace carries the compile/execute split and cost-model attrs for
+        its route."""
+        r1, _ = ladder_replays
+        served = [
+            s
+            for t in r1.recorder.traces()
+            for s in t.spans
+            if s.name == "deviceDispatch" and s.attrs.get("outcome") == "ok"
+        ]
+        assert served
+        for s in served:
+            assert "cache" in s.attrs and "dispatch_s" in s.attrs
+        warm = [s for s in served if s.attrs.get("cache") == "hit"]
+        assert warm
+        for s in warm:
+            assert "compile_est_s" in s.attrs and "execute_est_s" in s.attrs
+        assert any("model_flops" in s.attrs for s in served)
+
+    def test_scorer_perf_columns(self, ladder_replays):
+        from autoscaler_tpu.loadgen.score import build_report
+
+        r1, _ = ladder_replays
+        report = build_report(r1)
+        perf = report["perf"]
+        assert perf["ticks"] == r1.spec.ticks
+        route = next(iter(perf["routes"].values()))
+        for col in ("dispatches", "compiles", "compile_s", "execute_s"):
+            assert col in route
+        pool = next(iter(perf["resident_bytes"].values()))
+        assert set(pool) == {"p50", "p99", "peak"}
+
+    def test_bench_perf_ledger_gate(self, ladder_replays, tmp_path):
+        r1, _ = ladder_replays
+        good = tmp_path / "good.jsonl"
+        good.write_text(r1.perf_ledger_lines())
+        proc = subprocess.run(
+            [sys.executable, "bench.py", "--perf-ledger", str(good)],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout)
+        assert report["valid"] and report["routes"]
+        # seed a steady-state compile regression: replay the first miss
+        records = [json.loads(line) for line in good.read_text().splitlines()]
+        first_miss = next(
+            d for r in records for d in r["dispatches"] if d["cache"] == "miss"
+        )
+        records[-1]["dispatches"].append(dict(first_miss))
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text("".join(record_line(r) for r in records))
+        proc = subprocess.run(
+            [sys.executable, "bench.py", "--perf-ledger", str(bad)],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 1
+        assert "compile-on-steady-state-tick" in proc.stdout
+        # unreadable ledger → exit 2
+        proc = subprocess.run(
+            [sys.executable, "bench.py", "--perf-ledger",
+             str(tmp_path / "missing.jsonl")],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 2
+        # malformed-but-parseable ledger → the bounded JSON error report
+        # and exit 1, never a traceback
+        mangled = tmp_path / "mangled.jsonl"
+        mangled.write_text("[1,2,3]\n" + json.dumps({"schema": SCHEMA}) + "\n")
+        proc = subprocess.run(
+            [sys.executable, "bench.py", "--perf-ledger", str(mangled)],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout)
+        assert not report["valid"] and report["errors_total"] > 0
+
+    def test_cli_perf_ledger_flag(self, tmp_path):
+        from autoscaler_tpu.loadgen.cli import main as loadgen_main
+
+        out = tmp_path / "ledger.jsonl"
+        rc = loadgen_main([
+            "run", "benchmarks/scenarios/burst_small.json",
+            "--perf-ledger", str(out),
+        ])
+        assert rc == 0
+        records = [
+            json.loads(line) for line in out.read_text().splitlines()
+        ]
+        assert records and validate_records(records) == []
