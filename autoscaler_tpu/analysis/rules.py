@@ -56,6 +56,7 @@ def _enclosing_functions(tree: ast.AST) -> Dict[ast.AST, str]:
 REPLAY_SCOPES = (
     "core/",
     "estimator/",
+    "explain/",
     "loadgen/",
     "perf/",
     "trace/",
@@ -252,6 +253,7 @@ class LadderBypass:
 # -- GL004: lock discipline in threaded modules -------------------------------
 
 THREADED_SCOPES = (
+    "explain/",
     "metrics/",
     "perf/",
     "trace/recorder.py",

@@ -38,6 +38,10 @@ class ClusterStatus:
     # kernel-ladder rungs whose circuit breaker is open/half-open: the
     # autoscaler is still deciding, on a lower rung (degraded mode)
     degraded_rungs: List[str] = field(default_factory=list)
+    # last scale-up decision summary (explain.DecisionExplainer
+    # last_decision_summary): chosen group, winning expander score, top
+    # rejection reasons — the "why" next to the "what" the groups show
+    last_decision: Dict = field(default_factory=dict)
 
     @property
     def degraded(self) -> bool:
@@ -55,6 +59,16 @@ class ClusterStatus:
                 "Degraded: kernel ladder rungs tripped: "
                 + ",".join(self.degraded_rungs)
             )
+        if self.last_decision:
+            d = self.last_decision
+            chosen = d.get("chosen") or "none"
+            score = d.get("score")
+            score_s = f" score={score}" if score is not None else ""
+            top = ",".join(d.get("top_rejections", ())) or "none"
+            lines.append(
+                f"LastDecision (tick {d.get('tick')}): chosen={chosen}"
+                f"{score_s} topRejections={top}"
+            )
         for g in self.groups:
             lines.append(
                 f"  NodeGroup {g.group_id}: Health: {g.health} "
@@ -70,6 +84,7 @@ def build_status(
     now_ts: float,
     cluster_name: str = "",
     degraded_rungs=(),
+    last_decision=None,
 ) -> ClusterStatus:
     total = csr.total_readiness()
     status = ClusterStatus(
@@ -79,6 +94,7 @@ def build_status(
         total_registered=total.registered,
         cluster_name=cluster_name,
         degraded_rungs=list(degraded_rungs),
+        last_decision=dict(last_decision or {}),
     )
     for group in csr.provider.node_groups():
         gid = group.id()

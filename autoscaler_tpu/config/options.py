@@ -99,6 +99,14 @@ class AutoscalingOptions:
     # how many recent per-tick perf records the in-memory ring keeps
     perf_ring_size: int = 64
 
+    # -- decision provenance (autoscaler_tpu/explain) -------------------------
+    # gates /explainz, like perf_enabled gates /perfz; the explainer itself
+    # always assembles records (bounded ring, negligible overhead) so the
+    # ring has history the moment the endpoint is enabled
+    explain_enabled: bool = True
+    # how many recent per-tick decision records the in-memory ring keeps
+    explain_ring_size: int = 64
+
     # -- cluster-wide resource limits (main.go:113-118) ----------------------
     max_nodes_total: int = 0                      # 0 = unlimited
     min_cores_total: float = 0.0

@@ -13,6 +13,7 @@ back into the (host-side, cloud-API) actuation boundary.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
@@ -20,6 +21,7 @@ from autoscaler_tpu.cloudprovider.interface import CloudProvider, NodeGroup
 from autoscaler_tpu.clusterstate.registry import ClusterStateRegistry
 from autoscaler_tpu.config.options import AutoscalingOptions
 from autoscaler_tpu.core.scaleup.equivalence import build_pod_groups
+from autoscaler_tpu.explain.reasons import SkipReason
 from autoscaler_tpu.snapshot.affinity import has_hard_spread
 from autoscaler_tpu.core.scaleup.resource_manager import ScaleUpResourceManager
 from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
@@ -36,11 +38,26 @@ class ScaleUpResult:
     chosen_group: Optional[str] = None
     new_nodes: int = 0
     extra_scale_ups: List[tuple] = field(default_factory=list)  # balancing
+    # the ACTUAL executed (group, delta) list, first entry included: with
+    # balancing the chosen group can receive zero nodes (balance_scale_up
+    # grows the smallest similar group), so deriving the plan from
+    # chosen_group + extra_scale_ups misattributes nodes — consumers that
+    # record the plan (decision ledger, loadgen log) read this
+    executed: List[tuple] = field(default_factory=list)
     pods_triggered: List[Pod] = field(default_factory=list)
     pods_remain_unschedulable: List[Pod] = field(default_factory=list)
-    skipped_groups: Dict[str, str] = field(default_factory=dict)
+    # closed SkipReason enum (explain/reasons.py), promoted from free-text
+    # strings: the decision ledger and the scaleup_skipped_groups_total
+    # gauge need a finite vocabulary (CA parity: skipped_scale_events_count)
+    skipped_groups: Dict[str, SkipReason] = field(default_factory=dict)
     options_considered: int = 0
     error: Optional[str] = None
+    # decision provenance (autoscaler_tpu/explain): the expander's full
+    # scoring table (ALL candidates, not just the winner), the winning
+    # score, and the estimator's constraint attribution for this pass
+    expander_table: List[dict] = field(default_factory=list)
+    chosen_score: Optional[float] = None
+    estimator_explain: Dict = field(default_factory=dict)
 
 
 class ScaleUpOrchestrator:
@@ -145,7 +162,7 @@ class ScaleUpOrchestrator:
         viable: Dict[str, NodeGroup] = {}
         templates: Dict[str, Node] = {}
         headrooms: Dict[str, int] = {}
-        skipped: Dict[str, str] = {}
+        skipped: Dict[str, SkipReason] = {}
         for group in all_groups:
             gid = group.id()
             # NAP candidates go through the same gate: they are healthy by
@@ -153,11 +170,11 @@ class ScaleUpOrchestrator:
             # registered under their deterministic id backs them off too,
             # preventing a per-loop retry storm against the cloud API.
             if not self.csr.is_node_group_safe_to_scale_up(gid, now_ts):
-                skipped[gid] = "unhealthy or backed off"
+                skipped[gid] = SkipReason.NOT_SAFE
                 continue
             headroom = group.max_size() - group.target_size()
             if headroom <= 0:
-                skipped[gid] = "max size reached"
+                skipped[gid] = SkipReason.MAX_SIZE_REACHED
                 continue
             template: Optional[Node] = None
             if self.template_provider is not None:
@@ -170,10 +187,16 @@ class ScaleUpOrchestrator:
                 try:
                     template = group.template_node_info()
                 except Exception as e:  # no template → skip (orchestrator.go:157)
-                    skipped[gid] = f"no template: {e}"
+                    # the closed enum cannot carry the exception text the
+                    # old free-form string did — log it so the diagnostic
+                    # detail behind a persistent no_template skip survives
+                    logging.getLogger("scaleup").info(
+                        "node group %s skipped: no template (%s)", gid, e
+                    )
+                    skipped[gid] = SkipReason.NO_TEMPLATE
                     continue
             if template is None:
-                skipped[gid] = "no template"
+                skipped[gid] = SkipReason.NO_TEMPLATE
                 continue
             viable[gid] = group
             templates[gid] = template
@@ -221,6 +244,11 @@ class ScaleUpOrchestrator:
             list(pending_pods), templates, headrooms, pod_groups=pod_groups,
             cluster=cluster_ctx,
         )
+        # constraint attribution for this pass (estimator/binpacking
+        # _finish_explain): per-group rejection-reason histograms + each
+        # pod's dominant reason, carried on the result so run_once can
+        # assemble the tick's DecisionRecord without re-reaching in
+        explain = dict(getattr(self.estimator, "last_explain", None) or {})
 
         options: List[Option] = []
         for gid, (count, scheduled) in estimates.items():
@@ -232,12 +260,20 @@ class ScaleUpOrchestrator:
             return ScaleUpResult(
                 pods_remain_unschedulable=list(pending_pods),
                 skipped_groups=skipped,
+                estimator_explain=explain,
             )
 
         best = self.expander.best_option(options)
+        # the expander's scoring table (ChainStrategy publishes it per
+        # call; strategies without one leave the provenance fields empty)
+        expander_table = list(getattr(self.expander, "last_table", ()) or ())
+        chosen_score = getattr(self.expander, "last_score", None)
         if best is None:
             return ScaleUpResult(
-                pods_remain_unschedulable=list(pending_pods), skipped_groups=skipped
+                pods_remain_unschedulable=list(pending_pods),
+                skipped_groups=skipped,
+                estimator_explain=explain,
+                expander_table=expander_table,
             )
 
         # Cap: group headroom, cluster node total, cluster resource limits
@@ -255,6 +291,9 @@ class ScaleUpOrchestrator:
                 pods_remain_unschedulable=list(pending_pods),
                 skipped_groups=skipped,
                 options_considered=len(options),
+                estimator_explain=explain,
+                expander_table=expander_table,
+                chosen_score=chosen_score,
             )
 
         # Balance across similar groups (orchestrator.go:277-318) when enabled.
@@ -287,8 +326,18 @@ class ScaleUpOrchestrator:
                 self.csr.register_failed_scale_up(group.id(), str(e), now_ts)
                 return ScaleUpResult(
                     error=f"scale-up of {group.id()} failed: {e}",
+                    # provenance: the expander DID choose (the cloud then
+                    # refused) — the decision record names the winner, the
+                    # executed prefix, and every pod left pending, so a
+                    # failed tick still explains itself
+                    chosen_group=best.node_group.id(),
+                    executed=list(executed),
+                    pods_remain_unschedulable=list(pending_pods),
                     skipped_groups=skipped,
                     options_considered=len(options),
+                    estimator_explain=explain,
+                    expander_table=expander_table,
+                    chosen_score=chosen_score,
                 )
 
         helped = {p.key() for p in best.pods}
@@ -297,12 +346,16 @@ class ScaleUpOrchestrator:
             chosen_group=best.node_group.id(),
             new_nodes=sum(d for _, d in executed),
             extra_scale_ups=executed[1:],
+            executed=list(executed),
             pods_triggered=best.pods,
             pods_remain_unschedulable=[
                 p for p in pending_pods if p.key() not in helped
             ],
             skipped_groups=skipped,
             options_considered=len(options),
+            estimator_explain=explain,
+            expander_table=expander_table,
+            chosen_score=chosen_score,
         )
 
     # -- min-size enforcement (reference orchestrator.go:348) ----------------

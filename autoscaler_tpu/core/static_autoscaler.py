@@ -25,6 +25,12 @@ from autoscaler_tpu.core.podlistprocessor import FilterOutSchedulablePodListProc
 from autoscaler_tpu.core.scaledown.actuator import ActuationResult, ScaleDownActuator
 from autoscaler_tpu.core.scaledown.planner import ScaleDownPlanner
 from autoscaler_tpu.core.scaleup.orchestrator import ScaleUpOrchestrator, ScaleUpResult
+from autoscaler_tpu.explain.reasons import (
+    REASON_NAMES,
+    REASON_NOT_CHOSEN,
+    REASON_NO_VIABLE_GROUP,
+    SkipReason,
+)
 from autoscaler_tpu.kube.api import ClusterAPI
 from autoscaler_tpu.kube.objects import Node, Pod, Resources
 from autoscaler_tpu.metrics import metrics as metrics_mod
@@ -83,6 +89,16 @@ class StaticAutoscaler:
             metrics=self.metrics,
             cost_model=self.options.perf_cost_model,
             ring_capacity=self.options.perf_ring_size,
+        )
+        # decision explainer (autoscaler_tpu/explain): per-tick
+        # DecisionRecords — constraint attribution, expander scoring table,
+        # skip/backoff/breaker state, plan + scale-down reasons. One per
+        # autoscaler, same lifecycle as the perf observatory; served by
+        # /explainz, appended to the loadgen decision ledger.
+        from autoscaler_tpu.explain import DecisionExplainer
+
+        self.explainer = DecisionExplainer(
+            ring_capacity=self.options.explain_ring_size
         )
         # floor for perf tick ids: normally the trace id, but a re-entrant
         # tick (tracer degrades to a child span — no trace_id attr) must
@@ -169,11 +185,14 @@ class StaticAutoscaler:
             )
             self._next_perf_tick = tick_id + 1
             self.observatory.begin_tick(tick_id, now_ts)
+            # the decision record shares the perf record's tick id, so
+            # /explainz, /perfz and /tracez line up by construction
+            self.explainer.begin_tick(tick_id, now_ts)
             try:
                 result = self._run_once_traced(now_ts, root)
             finally:
                 # finalize even when the tick crashed (the crash-only loop
-                # catches outside): the ledger stays gap-free, and the
+                # catches outside): the ledgers stay gap-free, and the
                 # residency snapshot reflects whatever the tick left live
                 with trace.span(metrics_mod.PERF_RECORD):
                     from autoscaler_tpu.perf import POOL_SNAPSHOT
@@ -182,6 +201,11 @@ class StaticAutoscaler:
                         POOL_SNAPSHOT, "packer", self._packer.device_bytes()
                     )
                     self.observatory.end_tick()
+                # a crashed tick leaves a PARTIAL decision record — the
+                # sections noted before the crash are exactly the
+                # decisions that were made
+                with trace.span(metrics_mod.EXPLAIN_RECORD):
+                    self.explainer.end_tick()
             root.set_attrs(
                 pending=result.pending_pods,
                 healthy=result.cluster_healthy,
@@ -234,6 +258,10 @@ class StaticAutoscaler:
                             "status": build_status(
                                 self.csr, now_ts, self.options.cluster_name,
                                 degraded_rungs=self.degraded_rungs(),
+                                # most recent COMPLETED record (this tick's
+                                # is still open here — it closes in
+                                # run_once's finally, after this write)
+                                last_decision=self.explainer.last_decision_summary(),
                             ).render()
                         },
                     )
@@ -301,6 +329,15 @@ class StaticAutoscaler:
             by_reason[u.reason.value] = by_reason.get(u.reason.value, 0) + 1
         for reason, count in by_reason.items():
             m.unremovable_nodes_count.set(count, reason=reason)
+        # scale-up skip accounting mirrors the scale-down gauge above:
+        # every closed SkipReason reset each loop so a reason that stops
+        # occurring reports 0 (CA parity: skipped_scale_events_count)
+        skip_counts: Dict[str, int] = {r.value: 0 for r in SkipReason}
+        if result.scale_up is not None:
+            for skip in result.scale_up.skipped_groups.values():
+                skip_counts[skip.value] += 1
+        for reason, count in skip_counts.items():
+            m.scaleup_skipped_groups_total.set(count, reason=reason)
         if result.removed_unregistered:
             m.old_unregistered_nodes_removed_count.inc(result.removed_unregistered)
         tracker = self.scale_down_planner.deletion_tracker
@@ -445,6 +482,26 @@ class StaticAutoscaler:
         result.filtered_schedulable = len(filtered)
         result.pending_pods = len(pending)
 
+        # decision provenance: the tick's pending split and the breaker/
+        # backoff state every later section is conditioned on
+        self.explainer.note(
+            "pending",
+            {
+                "arrived": len(pending) + len(filtered),
+                "filtered_schedulable": len(filtered),
+                "pending": len(pending),
+            },
+        )
+        self.explainer.note("degraded_rungs", sorted(self.degraded_rungs()))
+        self.explainer.note(
+            "backoff",
+            sorted(
+                g.id()
+                for g in self.provider.node_groups()
+                if self.csr.backoff.is_backed_off(g.id(), now_ts)
+            ),
+        )
+
         # 6. scale-up (:560-580)
         if pending:
             with trace.span(metrics_mod.SCALE_UP) as sp_up:
@@ -458,10 +515,13 @@ class StaticAutoscaler:
                     # running DaemonSets (simulator/nodes.go:56)
                     pending_daemonsets=pending_ds(),
                 )
+                self._note_scale_up_explain(up)
                 sp_up.set_attrs(
                     scaled_up=up.scaled_up,
                     group=up.chosen_group or "",
                     new_nodes=up.new_nodes,
+                    skipped_groups=len(up.skipped_groups),
+                    remain_unschedulable=len(up.pods_remain_unschedulable),
                 )
             self.metrics.last_activity.set(now_ts, activity=metrics_mod.SCALE_UP)
             result.scale_up = up
@@ -551,6 +611,29 @@ class StaticAutoscaler:
                 self.scale_down_actuator.update_soft_deletion_taints(
                     self.api.list_nodes(), self.scale_down_planner.unneeded_names()
                 )
+                # decision provenance: what scale-down spared and why
+                unremovable: Dict[str, int] = {}
+                for u in self.scale_down_planner.last_unremovable():
+                    unremovable[u.reason.value] = (
+                        unremovable.get(u.reason.value, 0) + 1
+                    )
+                down = result.scale_down
+                self.explainer.note(
+                    "scale_down",
+                    {
+                        "unneeded": sorted(
+                            self.scale_down_planner.unneeded_names()
+                        ),
+                        "unremovable": {
+                            k: unremovable[k] for k in sorted(unremovable)
+                        },
+                        "in_cooldown": in_cooldown,
+                        "deleted": sorted(
+                            (down.deleted_empty + down.deleted_drain)
+                            if down is not None else []
+                        ),
+                    },
+                )
         if self.debugger is not None and self.debugger.is_data_collection_allowed():
             self.debugger.capture(
                 self, snapshot, pending, result, filtered_pods=filtered,
@@ -559,6 +642,55 @@ class StaticAutoscaler:
         return result
 
     # -- helpers -------------------------------------------------------------
+    def _note_scale_up_explain(self, up: ScaleUpResult) -> None:
+        """Assemble the scale-up sections of this tick's DecisionRecord
+        from the orchestrator result: the estimator's constraint
+        attribution, the expander's full scoring table, the closed skip
+        reasons, the executed plan, and one reason per pod that stayed
+        pending (a pod the estimator could place SOMEWHERE but the chosen
+        option did not cover reads 'not_chosen'; a pod that never reached
+        estimation reads 'no_viable_group')."""
+        ex = self.explainer
+        explain = up.estimator_explain or {}
+        ex.note("estimator", {"groups": explain.get("groups", {})})
+        ex.note(
+            "expander",
+            {
+                "options": list(up.expander_table),
+                "chosen": up.chosen_group or "",
+                "score": up.chosen_score,
+            },
+        )
+        ex.note(
+            "skipped_groups",
+            {g: r.value for g, r in sorted(up.skipped_groups.items())},
+        )
+        # the orchestrator's actual executed list, not a reconstruction
+        # from chosen_group (balancing can hand the chosen group zero
+        # nodes while a similar group scales)
+        executed = sorted([g, int(d)] for g, d in up.executed if d > 0)
+        ex.note(
+            "scale_up",
+            {
+                "executed": executed,
+                "error": up.error,
+                "remain_unschedulable": len(up.pods_remain_unschedulable),
+                "pods_triggered": sorted(p.key() for p in up.pods_triggered),
+            },
+        )
+        pod_reasons = explain.get("pod_reasons", {})
+        pods_doc = {}
+        for p in up.pods_remain_unschedulable:
+            reason = pod_reasons.get(p.key())
+            if reason is None:
+                reason = REASON_NO_VIABLE_GROUP
+            elif reason == REASON_NAMES[0]:
+                # schedulable somewhere, but the winning option (or a
+                # failed/capped execution) did not cover this pod
+                reason = REASON_NOT_CHOSEN
+            pods_doc[p.key()] = reason
+        ex.note("pods", pods_doc)
+
     def kernel_ladder(self):
         """The estimator's circuit-broken kernel ladder, when wired (the
         default orchestrator always wires one; a custom estimator may not)."""

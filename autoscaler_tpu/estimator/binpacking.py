@@ -22,6 +22,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from autoscaler_tpu.core.scaleup.equivalence import build_pod_groups
+from autoscaler_tpu.explain.reasons import (
+    REASON_NAMES,
+    reason_histogram,
+    reason_name,
+)
 from autoscaler_tpu.estimator.ladder import (
     HOST_LEVEL_SKIP_REASONS,
     RUNG_NATIVE,
@@ -35,6 +40,8 @@ from autoscaler_tpu.kube.objects import CPU, MEMORY, NUM_RESOURCES, Node, Pod
 from autoscaler_tpu.metrics import metrics as metrics_mod
 from autoscaler_tpu.ops.binpack import (
     BinpackResult,
+    attribute_unschedulable,
+    attribution_summary,
     ffd_binpack,
     ffd_binpack_groups,
     ffd_binpack_groups_affinity,
@@ -228,6 +235,15 @@ class BinpackingNodeEstimator:
         # split. Standalone estimators get a private metrics-less one;
         # StaticAutoscaler threads in its own (ringed, /perfz-served).
         self.observatory = observatory or PerfObservatory(metrics=metrics)
+        # decision provenance (autoscaler_tpu/explain): the last dispatch's
+        # constraint attribution — per-group rejection-reason histograms and
+        # each pod's dominant reason — consumed by the orchestrator/run_once
+        # DecisionRecord. The array-building sites park their packed
+        # operands in _explain_scratch; _finish_explain turns the serving
+        # dispatch's operands + verdict into reason codes (rung-independent:
+        # attribution is a pure function of the packed arrays).
+        self.last_explain: Dict = {"groups": {}, "pod_reasons": {}}
+        self._explain_scratch: Optional[Dict] = None
 
     def estimate(
         self,
@@ -242,8 +258,12 @@ class BinpackingNodeEstimator:
         with trace.span(
             metrics_mod.ESTIMATE, metrics=self.metrics,
             single_template=True, pods=len(pods),
-        ):
-            return self._estimate_inner(pods, template, max_size_headroom, cluster)
+        ) as sp:
+            count, scheduled = self._estimate_inner(
+                pods, template, max_size_headroom, cluster
+            )
+            self._finish_explain(pods, {"template": (count, scheduled)}, span=sp)
+            return count, scheduled
 
     def _estimate_inner(
         self,
@@ -267,6 +287,11 @@ class BinpackingNodeEstimator:
         alloc = _template_capacity_row(template, ext)
         req, alloc2d = _augment_virtual(req, pods, alloc[None, :], [template])
         alloc = alloc2d[0]
+        self._explain_scratch = {
+            "kind": "pods", "names": ["template"], "req": req,
+            "masks": mask[None, :], "allocs": alloc[None, :],
+            "involved": np.zeros((P,), bool),
+        }
         cap = self.limiter.node_cap(max_size_headroom)
         # route observability covers BOTH entry points (ADVICE r5): the
         # single-template path rides the XLA scans when healthy (no Pallas
@@ -283,6 +308,10 @@ class BinpackingNodeEstimator:
                 pods, [template], pad_pods=P, bucket_terms=True, cluster=cluster
             )
             has_spread = bool(sp.sp_of.any())
+            self._explain_scratch["involved"] = np.asarray(
+                (terms.match | terms.aff_of | terms.anti_of).any(axis=0)
+                | (sp.sp_of | sp.sp_match).any(axis=0)
+            )
 
             def xla_fn():
                 res = ffd_binpack_groups_affinity(
@@ -365,6 +394,8 @@ class BinpackingNodeEstimator:
         capped host-side, as GetCappedNewNodeCount does — orchestrator.go:536).
         """
         if not pods or not templates:
+            self._explain_scratch = None
+            self.last_explain = {"groups": {}, "pod_reasons": {}}
             return {g: (0, []) for g in templates}
         # timeline clock, not the wall (graftlint GL001): under the loadgen
         # driver's synthetic clock the elapsed value — and the over-budget
@@ -377,10 +408,14 @@ class BinpackingNodeEstimator:
         with trace.span(
             metrics_mod.ESTIMATE, metrics=self.metrics,
             pods=len(pods), groups=len(templates),
-        ):
+        ) as sp_est:
             result = self._estimate_many_inner(
                 pods, templates, headrooms, pod_groups, cluster
             )
+            # constraint attribution rides the estimate span: the reasons
+            # are part of the estimation verdict, and the span attrs make
+            # "what dominated the rejections" readable straight off /tracez
+            self._finish_explain(pods, result, span=sp_est)
         elapsed = trace.timeline_now() - t0
         # the reference budgets max_duration_s PER GROUP (threshold_based_
         # limiter.go); the batched dispatch covers every group at once, so
@@ -502,6 +537,12 @@ class BinpackingNodeEstimator:
         req, masks, allocs = _build_group_arrays(
             pods, names, templates, interpod=not dynamic_affinity, pad=P
         )
+        # attribution operands for this dispatch (the dynamic branch below
+        # widens `involved` once the term tensors exist)
+        self._explain_scratch = {
+            "kind": "pods", "names": names, "req": req, "masks": masks,
+            "allocs": allocs, "involved": np.zeros((P,), bool),
+        }
         scan_cap = bucket_size(int(caps.max()), minimum=8)
 
         def assemble(res: BinpackResult) -> Dict[str, Tuple[int, List[Pod]]]:
@@ -530,6 +571,10 @@ class BinpackingNodeEstimator:
             # bucket_terms pads S to a minimum, so "spread in play" means a
             # pod DECLARES a term, not S > 0 (padded terms are inert)
             has_spread = bool(sp.sp_of.any())
+            self._explain_scratch["involved"] = np.asarray(
+                (terms.match | terms.aff_of | terms.anti_of).any(axis=0)
+                | (sp.sp_of | sp.sp_match).any(axis=0)
+            )
             S_bucket = int(sp.sp_of.shape[0])
             # VMEM pre-check for the Pallas rung (shared byte model —
             # pallas_binpack_affinity.affinity_vmem_estimate): workloads
@@ -811,6 +856,132 @@ class BinpackingNodeEstimator:
         obs.on_dispatch(label, wall, span=sp)
         return out
 
+    # -- decision provenance (autoscaler_tpu/explain) -------------------------
+    def _attribution(self, req, masks, allocs, scheduled, involved, weights):
+        """(hist [G, NUM_REASONS], dominant [P]) as numpy — the device
+        reduction first, the serial oracle twin on any device failure
+        (attribution is observability: it must never take down a decision
+        the ladder already salvaged)."""
+        try:
+            reasons = attribute_unschedulable(
+                jnp.asarray(req), jnp.asarray(masks), jnp.asarray(allocs),
+                jnp.asarray(scheduled), jnp.asarray(involved),
+            )
+            hist, dom = attribution_summary(reasons, jnp.asarray(weights))
+            return np.asarray(hist), np.asarray(dom)
+        except Exception:  # noqa: BLE001 — degrade to the host twin, keep deciding
+            logging.getLogger("estimator").warning(
+                "attribution kernel failed; using the serial oracle twin",
+                exc_info=True,
+            )
+            from autoscaler_tpu.estimator.reference_impl import (
+                attribute_unschedulable_reference,
+            )
+            from autoscaler_tpu.explain.reasons import NUM_REASONS
+
+            reasons = attribute_unschedulable_reference(
+                np.asarray(req), np.asarray(masks), np.asarray(allocs),
+                np.asarray(scheduled), np.asarray(involved),
+            )
+            hist = np.stack(
+                [
+                    np.sum(np.where(reasons == code, weights, 0), axis=1)
+                    for code in range(NUM_REASONS)
+                ],
+                axis=1,
+            )
+            return hist, reasons.min(axis=0)
+
+    def _finish_explain(self, pods, result, span=None) -> None:
+        """Turn the serving dispatch's parked operands + verdict into
+        ``last_explain``: per-group fit counts with rejection-reason
+        histograms, and each pod's dominant reason (the closest it came to
+        scheduling anywhere). Rung-independent — the packed arrays are the
+        same whichever rung served — and a pure function of them, so the
+        DecisionRecord built from this replays byte-identically."""
+        scratch, self._explain_scratch = self._explain_scratch, None
+        if scratch is None or not pods:
+            self.last_explain = {"groups": {}, "pod_reasons": {}}
+            return
+        names = scratch["names"]
+        if scratch["kind"] == "runs":
+            hist, pod_reasons = self._explain_runs(scratch, result)
+        else:
+            hist, pod_reasons = self._explain_pods(scratch, result, pods)
+        groups: Dict[str, Dict] = {}
+        for gi, g in enumerate(names):
+            count, sched = result.get(g, (0, []))
+            groups[g] = {
+                "fit_nodes": int(count),
+                "scheduled": len(sched),
+                "reasons": reason_histogram(hist[gi]),
+            }
+        self.last_explain = {"groups": groups, "pod_reasons": pod_reasons}
+        if span is not None:
+            totals: Dict[str, int] = {}
+            for verdict in groups.values():
+                for rname, count in verdict["reasons"].items():
+                    totals[rname] = totals.get(rname, 0) + count
+            if totals:
+                top = sorted(totals.items(), key=lambda kv: (-kv[1], kv[0]))[0]
+                span.set_attrs(
+                    explain_top_rejection=f"{top[0]}={top[1]}",
+                    explain_rejections=sum(totals.values()),
+                )
+
+    def _explain_pods(self, scratch, result, pods):
+        """Per-pod attribution: verdict matrix rebuilt from the result's
+        scheduled lists by object identity (the lists hold the caller's Pod
+        objects); pad rows carry zero weight so they never pollute the
+        histograms."""
+        req, masks, allocs = scratch["req"], scratch["masks"], scratch["allocs"]
+        P_pad, G = req.shape[0], masks.shape[0]
+        idx_of = {id(p): i for i, p in enumerate(pods)}
+        scheduled = np.zeros((G, P_pad), bool)
+        for gi, g in enumerate(scratch["names"]):
+            for p in result.get(g, (0, []))[1]:
+                i = idx_of.get(id(p))
+                if i is not None:
+                    scheduled[gi, i] = True
+        weights = np.zeros((G, P_pad), np.int32)
+        weights[:, : len(pods)] = 1
+        hist, dom = self._attribution(
+            req, masks, allocs, scheduled, scratch["involved"], weights
+        )
+        pod_reasons = {
+            p.key(): reason_name(int(dom[i])) for i, p in enumerate(pods)
+        }
+        return hist, pod_reasons
+
+    def _explain_runs(self, scratch, result):
+        """Run-compressed attribution: a run counts as scheduled when every
+        member placed; histogram weights are the UNPLACED member counts, so
+        'memory=40' means forty pods, not one run of forty. Every member
+        inherits the run's dominant reason (members are interchangeable by
+        the equivalence-group construction)."""
+        req, masks, allocs = scratch["req"], scratch["masks"], scratch["allocs"]
+        counts = np.asarray(scratch["counts"], np.int64)
+        members = scratch["members"]
+        U_pad, G = req.shape[0], masks.shape[0]
+        run_of = {id(p): u for u, mem in enumerate(members) for p in mem}
+        placed = np.zeros((G, U_pad), np.int64)
+        for gi, g in enumerate(scratch["names"]):
+            for p in result.get(g, (0, []))[1]:
+                u = run_of.get(id(p))
+                if u is not None:
+                    placed[gi, u] += 1
+        scheduled = placed >= counts[None, :]   # pad slots: 0 >= 0 → inert
+        weights = np.maximum(counts[None, :] - placed, 0).astype(np.int32)
+        hist, dom = self._attribution(
+            req, masks, allocs, scheduled, scratch["involved"], weights
+        )
+        pod_reasons: Dict[str, str] = {}
+        for u, mem in enumerate(members):
+            rname = reason_name(int(dom[u]))
+            for p in mem:
+                pod_reasons[p.key()] = rname
+        return hist, pod_reasons
+
     @staticmethod
     def _host_gate(spread_active: bool = False, need_native: bool = False):
         """Availability gate for the host rungs. Topology-spread counting
@@ -872,6 +1043,10 @@ class BinpackingNodeEstimator:
         req, masks, allocs = _build_group_arrays(
             pods, names, templates, interpod=True
         )
+        self._explain_scratch = {
+            "kind": "pods", "names": list(names), "req": req, "masks": masks,
+            "allocs": allocs, "involved": np.zeros((len(pods),), bool),
+        }
         return self._host_plain_from_arrays(
             pods, names, req, masks, allocs, caps, native
         )
@@ -886,6 +1061,13 @@ class BinpackingNodeEstimator:
             pods, [templates[g] for g in names], pad_pods=len(pods),
             volume_components=(),  # the runs-affinity path excludes conflicts
         )
+        self._explain_scratch = {
+            "kind": "pods", "names": list(names), "req": req, "masks": masks,
+            "allocs": allocs,
+            "involved": np.asarray(
+                (terms.match | terms.aff_of | terms.anti_of).any(axis=0)
+            ),
+        }
         return self._host_affinity_from_arrays(
             pods, names, req, masks, allocs, caps, terms, native
         )
@@ -1020,6 +1202,14 @@ class BinpackingNodeEstimator:
             [self.limiter.node_cap(headrooms.get(g, 0)) for g in names], np.int32
         )
         T = group_terms.match.shape[0]
+        involved_full = np.zeros((U,), bool)
+        involved_full[: len(runs)] = run_inv
+        self._explain_scratch = {
+            "kind": "runs", "names": names, "req": run_req, "masks": masks,
+            "allocs": allocs, "counts": run_counts,
+            "members": [members for _, members in runs],
+            "involved": involved_full,
+        }
 
         def to_runs(col_mat: np.ndarray) -> np.ndarray:
             out = np.zeros((T, U), bool)
@@ -1029,8 +1219,7 @@ class BinpackingNodeEstimator:
         terms_match = to_runs(np.asarray(group_terms.match))
         terms_aff = to_runs(np.asarray(group_terms.aff_of))
         terms_anti = to_runs(np.asarray(group_terms.anti_of))
-        involved = np.zeros((U,), bool)
-        involved[: len(runs)] = run_inv
+        involved = involved_full  # one build feeds the kernel AND attribution
         spread_arg = None
         if group_spread is not None:
             S = group_spread.sp_of.shape[0]
@@ -1107,6 +1296,12 @@ class BinpackingNodeEstimator:
         caps = np.array(
             [self.limiter.node_cap(headrooms.get(g, 0)) for g in names], np.int32
         )
+        self._explain_scratch = {
+            "kind": "runs", "names": names, "req": run_req, "masks": masks,
+            "allocs": allocs, "counts": run_counts,
+            "members": [g.pods for g in groups],
+            "involved": np.zeros((U,), bool),
+        }
         res = ffd_binpack_groups_runs(
             jnp.asarray(run_req),
             jnp.asarray(run_counts),

@@ -161,6 +161,64 @@ def ffd_binpack_reference_affinity(
     return len(used), scheduled
 
 
+def attribute_unschedulable_reference(
+    pod_req: np.ndarray,          # [P, R]
+    pod_masks: np.ndarray,        # [G, P]
+    template_allocs: np.ndarray,  # [G, R]
+    scheduled: np.ndarray,        # [G, P] bool — the binpack verdict
+    involved: np.ndarray,         # [P] bool — pod touches any dynamic term
+) -> np.ndarray:
+    """[G, P] i32 — the serial oracle twin of
+    ops/binpack.attribute_unschedulable: plain Python loops over the same
+    priority chain (mask → cpu → memory → pod-slot → other resource →
+    affinity/spread → node cap), against which the kernel's reason codes
+    are parity-locked on randomized shapes (tests/test_explain.py)."""
+    from autoscaler_tpu.explain.reasons import (
+        REASON_AFFINITY_SPREAD,
+        REASON_CPU,
+        REASON_MEMORY,
+        REASON_NODE_CAP,
+        REASON_NONE,
+        REASON_POD_SLOT,
+        REASON_RESOURCE,
+        REASON_TOPOLOGY,
+    )
+    from autoscaler_tpu.kube.objects import CPU as CPU_AX
+    from autoscaler_tpu.kube.objects import MEMORY as MEM_AX
+    from autoscaler_tpu.kube.objects import PODS as PODS_AX
+
+    G, P = pod_masks.shape
+    R = pod_req.shape[1]
+    out = np.zeros((G, P), np.int32)
+    for g in range(G):
+        alloc = template_allocs[g]
+        for p in range(P):
+            if scheduled[g, p]:
+                out[g, p] = REASON_NONE
+                continue
+            if not pod_masks[g, p]:
+                out[g, p] = REASON_TOPOLOGY
+                continue
+            req = pod_req[p]
+            if req[CPU_AX] > alloc[CPU_AX]:
+                out[g, p] = REASON_CPU
+            elif req[MEM_AX] > alloc[MEM_AX]:
+                out[g, p] = REASON_MEMORY
+            elif R > PODS_AX and req[PODS_AX] > alloc[PODS_AX]:
+                out[g, p] = REASON_POD_SLOT
+            elif any(
+                req[r] > alloc[r]
+                for r in range(R)
+                if r not in (CPU_AX, MEM_AX, PODS_AX)
+            ):
+                out[g, p] = REASON_RESOURCE
+            elif involved[p]:
+                out[g, p] = REASON_AFFINITY_SPREAD
+            else:
+                out[g, p] = REASON_NODE_CAP
+    return out
+
+
 def ffd_binpack_reference_groups(
     pod_req: np.ndarray,          # [P, R]
     pod_masks: np.ndarray,        # [G, P]

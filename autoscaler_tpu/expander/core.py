@@ -39,9 +39,27 @@ class Option:
 
 
 class Filter:
-    """Narrows the option list; chained before the final strategy."""
+    """Narrows the option list; chained before the final strategy.
+
+    An optional class attribute ``name`` labels the filter in the
+    decision-provenance scoring table (filters without one are labeled by
+    class name); ``scores`` optionally exposes the per-option figure
+    ``best_options`` ranks by (None = the filter has no scalar score —
+    e.g. priority tiers). Score polarity is the filter's own (most-pods:
+    higher wins; waste and price: lower wins) — the table records, it does
+    not re-rank. Scoring filters also implement ``best_options_from_scores``
+    so ChainStrategy never computes a figure twice per decision (price/
+    least-waste scoring is O(pods) per option)."""
 
     def best_options(self, options: List[Option]) -> List[Option]:
+        raise NotImplementedError
+
+    def scores(self, options: List[Option]) -> Optional[List[float]]:
+        return None
+
+    def best_options_from_scores(
+        self, options: List[Option], scores: List[float]
+    ) -> List[Option]:
         raise NotImplementedError
 
 
@@ -65,23 +83,38 @@ class RandomStrategy(Strategy):
 class MostPodsFilter(Filter):
     """reference expander/mostpods/ — maximize pods helped."""
 
+    name = MOST_PODS
+
     def best_options(self, options: List[Option]) -> List[Option]:
         if not options:
             return []
-        best = max(len(o.pods) for o in options)
-        return [o for o in options if len(o.pods) == best]
+        return self.best_options_from_scores(options, self.scores(options))
+
+    def scores(self, options: List[Option]) -> Optional[List[float]]:
+        return [float(len(o.pods)) for o in options]
+
+    def best_options_from_scores(self, options, scores):
+        best = max(scores)
+        return [o for s, o in zip(scores, options) if s == best]
 
 
 class LeastWasteFilter(Filter):
     """reference expander/waste/ — minimize wasted cpu+mem fraction of the
     added capacity."""
 
+    name = LEAST_WASTE
+
     def best_options(self, options: List[Option]) -> List[Option]:
         if not options:
             return []
-        scored = [(self._wasted_fraction(o), o) for o in options]
-        best = min(s for s, _ in scored)
-        return [o for s, o in scored if s <= best + 1e-9]
+        return self.best_options_from_scores(options, self.scores(options))
+
+    def scores(self, options: List[Option]) -> Optional[List[float]]:
+        return [self._wasted_fraction(o) for o in options]
+
+    def best_options_from_scores(self, options, scores):
+        best = min(scores)
+        return [o for s, o in zip(scores, options) if s <= best + 1e-9]
 
     @staticmethod
     def _wasted_fraction(option: Option) -> float:
@@ -100,21 +133,66 @@ class LeastWasteFilter(Filter):
 
 class ChainStrategy(Strategy):
     """reference expander/factory/chain.go:25 — filters in order, fallback
-    strategy decides among survivors."""
+    strategy decides among survivors.
+
+    Decision provenance: every ``best_option`` call rebuilds
+    ``last_table`` — one row per CANDIDATE option (not just the winner)
+    with each scoring filter's figure and, for the losers, which filter
+    eliminated them — plus ``last_winner``/``last_score`` (the winner's
+    figure from the last filter that scored it). The orchestrator copies
+    these onto ScaleUpResult, run_once notes them into the tick's
+    DecisionRecord, and the ledger cross-checks that every executed
+    scale-up carries its recorded winning score."""
 
     def __init__(self, filters: Sequence[Filter], fallback: Strategy):
         self.filters = list(filters)
         self.fallback = fallback
+        self.last_table: List[dict] = []
+        self.last_winner: Optional[str] = None
+        self.last_score: Optional[float] = None
 
     def best_option(self, options: List[Option]) -> Optional[Option]:
+        rows = {
+            id(o): {
+                "group": o.node_group.id(),
+                "node_count": int(o.node_count),
+                "pods": len(o.pods),
+                "scores": {},
+                "eliminated_by": None,
+            }
+            for o in options
+        }
+        win_scores: Dict[int, float] = {}   # id(option) → last scored figure
+
+        def publish(winner: Optional[Option]) -> Optional[Option]:
+            self.last_table = sorted(rows.values(), key=lambda r: r["group"])
+            self.last_winner = winner.node_group.id() if winner else None
+            self.last_score = win_scores.get(id(winner)) if winner else None
+            return winner
+
         survivors = list(options)
         for f in self.filters:
-            filtered = f.best_options(survivors)
+            fname = getattr(f, "name", None) or type(f).__name__
+            scores = f.scores(survivors) if survivors else None
+            if scores is not None:
+                for o, s in zip(survivors, scores):
+                    rows[id(o)]["scores"][fname] = round(float(s), 6)
+                    win_scores[id(o)] = round(float(s), 6)
+                # reuse the figures just recorded — scoring can be
+                # O(pods) per option (price, least-waste)
+                filtered = f.best_options_from_scores(survivors, scores)
+            else:
+                filtered = f.best_options(survivors)
+            if filtered:
+                kept = {id(o) for o in filtered}
+                for o in survivors:
+                    if id(o) not in kept:
+                        rows[id(o)]["eliminated_by"] = fname
             if len(filtered) == 1:
-                return filtered[0]
+                return publish(filtered[0])
             if filtered:
                 survivors = filtered
-        return self.fallback.best_option(survivors)
+        return publish(self.fallback.best_option(survivors))
 
 
 def build_strategy(names: Sequence[str], seed: Optional[int] = None, **kwargs) -> Strategy:

@@ -22,16 +22,23 @@ UNFITNESS_CEIL = 2.0
 
 
 class PriceFilter(Filter):
+    name = "price"
+
     def __init__(self, pricing: PricingModel, preferred_cpu_m: float = 8000.0):
         self.pricing = pricing
         self.preferred_cpu_m = preferred_cpu_m
 
+    def scores(self, options: List[Option]):
+        return [self._score(o) for o in options]
+
     def best_options(self, options: List[Option]) -> List[Option]:
         if not options:
             return []
-        scored = [(self._score(o), o) for o in options]
-        best = min(s for s, _ in scored)
-        return [o for s, o in scored if s <= best * (1 + 1e-9)]
+        return self.best_options_from_scores(options, self.scores(options))
+
+    def best_options_from_scores(self, options, scores):
+        best = min(scores)
+        return [o for s, o in zip(scores, options) if s <= best * (1 + 1e-9)]
 
     def _score(self, option: Option) -> float:
         template = option.node_group.template_node_info()

@@ -43,6 +43,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                           "telemetry, cost model, residency) as JSONL "
                           "(deterministic: two runs of the same spec are "
                           "byte-identical; bench.py --perf-ledger validates)")
+    run.add_argument("--explain-ledger", default="",
+                     help="write the run's per-tick decision records "
+                          "(constraint attribution, expander scoring "
+                          "table, skip reasons) as JSONL (deterministic: "
+                          "two runs of the same spec are byte-identical; "
+                          "bench.py --explain-ledger validates)")
     run.add_argument("--seed", type=int, default=None,
                      help="override the spec's seed")
     run.add_argument("--real-sleep", action="store_true",
@@ -54,6 +60,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     rep.add_argument("--log", default="")
     rep.add_argument("--chrome-trace", default="")
     rep.add_argument("--perf-ledger", default="")
+    rep.add_argument("--explain-ledger", default="")
 
     val = sub.add_parser("validate", help="parse + round-trip a scenario spec")
     val.add_argument("scenario")
@@ -68,7 +75,8 @@ def _write(path: str, doc) -> None:
 
 def _run(spec: ScenarioSpec, report_path: str, log_path: str,
          trace_path: str = "", real_sleep: bool = False,
-         chrome_trace_path: str = "", perf_ledger_path: str = "") -> int:
+         chrome_trace_path: str = "", perf_ledger_path: str = "",
+         explain_ledger_path: str = "") -> int:
     from autoscaler_tpu.loadgen.driver import run_scenario
     from autoscaler_tpu.loadgen.score import build_report
 
@@ -91,6 +99,11 @@ def _run(spec: ScenarioSpec, report_path: str, log_path: str,
         # (hack/verify.sh diffs two replays; bench.py --perf-ledger gates)
         with open(perf_ledger_path, "w") as f:
             f.write(result.perf_ledger_lines())
+    if explain_ledger_path:
+        # the byte-stable decision ledger (hack/verify.sh diffs two
+        # replays; bench.py --explain-ledger gates)
+        with open(explain_ledger_path, "w") as f:
+            f.write(result.explain_ledger_lines())
     return 0
 
 
@@ -104,7 +117,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return _run(spec, args.report, args.log, args.trace,
                         real_sleep=args.real_sleep,
                         chrome_trace_path=args.chrome_trace,
-                        perf_ledger_path=args.perf_ledger)
+                        perf_ledger_path=args.perf_ledger,
+                        explain_ledger_path=args.explain_ledger)
         if args.command == "replay":
             with open(args.trace) as f:
                 doc = json.load(f)
@@ -117,7 +131,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             spec.events = [_load_event(e) for e in doc["events"]]
             return _run(spec, args.report, args.log,
                         chrome_trace_path=args.chrome_trace,
-                        perf_ledger_path=args.perf_ledger)
+                        perf_ledger_path=args.perf_ledger,
+                        explain_ledger_path=args.explain_ledger)
         if args.command == "validate":
             spec = ScenarioSpec.load(args.scenario)
             roundtrip = ScenarioSpec.from_json(spec.to_json())

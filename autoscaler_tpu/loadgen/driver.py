@@ -156,6 +156,10 @@ class RunResult:
     # the run): every value is timeline-clock or pure-function-of-shapes,
     # so two replays serialize to byte-identical JSONL ledgers
     perf_records: List[Dict[str, Any]] = field(default_factory=list)
+    # per-tick decision records (autoscaler_tpu/explain ring, sized to the
+    # run): pure functions of the tick's decisions and the closed reason
+    # vocabularies — byte-identical across replays, same contract
+    explain_records: List[Dict[str, Any]] = field(default_factory=list)
 
     def decision_log(self) -> List[Dict[str, Any]]:
         return [r.to_dict() for r in self.records]
@@ -165,11 +169,18 @@ class RunResult:
 
         return "".join(record_line(rec) for rec in self.perf_records)
 
+    def explain_ledger_lines(self) -> str:
+        from autoscaler_tpu.explain import record_line
+
+        return "".join(record_line(rec) for rec in self.explain_records)
+
 
 class _FaultyCloudProvider(TestCloudProvider):
     """TestCloudProvider whose refresh() consults the fault injector —
     refresh_error / provider_latency faults land on the loop's provider
-    refresh exactly where a real cloud outage would."""
+    refresh exactly where a real cloud outage would — and whose groups'
+    template_node_info consults it too (template_error faults land on the
+    orchestrator's template fetch → SkipReason.NO_TEMPLATE)."""
 
     injector: Optional[FaultInjector] = None  # seated by the driver
 
@@ -177,6 +188,18 @@ class _FaultyCloudProvider(TestCloudProvider):
         if self.injector is not None:
             self.injector.on_refresh()
         super().refresh()
+
+    def add_node_group(self, name, *args, **kwargs):
+        group = super().add_node_group(name, *args, **kwargs)
+        orig = group.template_node_info
+
+        def faulty_template_node_info():
+            if self.injector is not None:
+                self.injector.on_template(name)
+            return orig()
+
+        group.template_node_info = faulty_template_node_info
+        return group
 
 
 class _FaultyClusterAPI(FakeClusterAPI):
@@ -233,6 +256,9 @@ class ScenarioDriver:
         # perf JSONL ledger covers the whole run
         opts_kw["perf_cost_model"] = True
         opts_kw["perf_ring_size"] = max(spec.ticks, 1)
+        # decision explainer: ring sized to hold EVERY tick so the explain
+        # JSONL ledger covers the whole run
+        opts_kw["explain_ring_size"] = max(spec.ticks, 1)
         # two ticks of unneeded time by default: long enough that freshly
         # booted (still empty) capacity isn't reaped before the scheduler
         # analog binds pods, short enough that drain scenarios converge
@@ -559,12 +585,11 @@ class ScenarioDriver:
                 wall_s=wall,
             )
             if result.scale_up is not None and result.scale_up.scaled_up:
-                ups = [
-                    (result.scale_up.chosen_group, result.scale_up.new_nodes
-                     - sum(d for _, d in result.scale_up.extra_scale_ups))
-                ]
-                ups += list(result.scale_up.extra_scale_ups)
-                rec.scale_ups = sorted((g, int(d)) for g, d in ups if d > 0)
+                # the orchestrator's actual executed list (balancing can
+                # hand the chosen group zero nodes)
+                rec.scale_ups = sorted(
+                    (g, int(d)) for g, d in result.scale_up.executed if d > 0
+                )
             if result.scale_up is not None and result.scale_up.error:
                 rec.errors = sorted(rec.errors + [result.scale_up.error])
             if result.scale_down is not None:
@@ -591,6 +616,7 @@ class ScenarioDriver:
             group_cpu_m=max(group_cpu.values()) if group_cpu else 0.0,
             recorder=self.tracer.recorder,
             perf_records=self.autoscaler.observatory.records(),
+            explain_records=self.autoscaler.explainer.records(),
         )
 
 

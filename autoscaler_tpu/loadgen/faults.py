@@ -152,6 +152,16 @@ class FaultInjector:
                     return kind
         return None
 
+    def on_template(self, group: str) -> None:
+        """Template seam (TestNodeGroup.template_node_info, wrapped by the
+        driver): raising models a cloud that cannot describe the group's
+        machine shape — the orchestrator must skip the group with
+        SkipReason.NO_TEMPLATE, never crash the loop."""
+        f = self._active("template_error", group)
+        if f is not None:
+            self._note("template_error")
+            raise InjectedCloudError(f"{f.message} (group {group})")
+
     def on_kube_api(self, op: str) -> None:
         """Cluster-API seam (the listing inside run_once): raising here is
         the apiserver 5xx / connection-reset analog, which the crash-only

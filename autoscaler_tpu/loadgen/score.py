@@ -123,6 +123,9 @@ def build_report(result: RunResult) -> Dict[str, Any]:
     perf = _perf_section(result)
     if perf:
         report["perf"] = perf
+    explain = _explain_section(result)
+    if explain:
+        report["explain"] = explain
     return report
 
 
@@ -167,4 +170,23 @@ def _perf_section(result: RunResult) -> Dict[str, Any]:
         "ticks": agg["ticks"],
         "routes": routes,
         "resident_bytes": pools,
+    }
+
+
+def _explain_section(result: RunResult) -> Dict[str, Any]:
+    """Decision-provenance columns (autoscaler_tpu/explain ledger.summarize):
+    rejection-reason histograms (per-pod dominant and per-group estimator
+    verdicts), expander win counts per group, and the closed skip-reason
+    counts — the run's "why" next to the "what" of the decisions table."""
+    if not result.explain_records:
+        return {}
+    from autoscaler_tpu.explain import summarize
+
+    agg = summarize(result.explain_records)
+    return {
+        "ticks": agg["ticks"],
+        "pod_reasons": agg["pod_reasons"],
+        "group_reasons": agg["group_reasons"],
+        "expander_wins": agg["expander_wins"],
+        "skip_reasons": agg["skip_reasons"],
     }

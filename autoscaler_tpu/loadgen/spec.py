@@ -76,6 +76,9 @@ FAULT_KINDS = (
     "kernel_fault",
     "device_lost",
     "kube_api_error",
+    # template_node_info raises for the targeted group — the orchestrator
+    # skips it with SkipReason.NO_TEMPLATE (decision-provenance scenarios)
+    "template_error",
 )
 # estimator rungs a kernel_fault may target ("" = every device rung)
 KERNEL_FAULT_RUNGS = ("", "pallas", "xla")

@@ -232,6 +232,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--perf-ring-size", type=int, default=64,
                    help="how many recent per-tick perf records the "
                         "in-memory ring keeps")
+    p.add_argument("--explain-enabled", type=_bool_flag, default=True,
+                   help="serve /explainz (per-tick decision records: "
+                        "constraint attribution, expander scoring table, "
+                        "skip/backoff state; the explainer itself always "
+                        "runs, bounded)")
+    p.add_argument("--explain-ring-size", type=int, default=64,
+                   help="how many recent per-tick decision records the "
+                        "in-memory ring keeps")
     p.add_argument("--record-duplicated-events", type=_bool_flag, default=False,
                    help="post every event instead of suppressing repeats "
                         "within the correlator window")
@@ -349,6 +357,8 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         perf_enabled=args.perf_enabled,
         perf_cost_model=args.perf_cost_model,
         perf_ring_size=args.perf_ring_size,
+        explain_enabled=args.explain_enabled,
+        explain_ring_size=args.explain_ring_size,
         force_daemonsets=args.force_ds,
     )
     opts.node_group_defaults.scale_down_unneeded_time_s = args.scale_down_unneeded_time
@@ -361,7 +371,8 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
 
 class ObservabilityServer:
     """/metrics, /health-check, /snapshotz, /status (main.go:508-523),
-    plus /debug/pprof/* when profiling is enabled (main.go:518-520)."""
+    /tracez, /perfz, /explainz, plus /debug/pprof/* when profiling is
+    enabled (main.go:518-520)."""
 
     def __init__(self, autoscaler, address: str = ":8085", profiling: bool = False):
         host, _, port = address.rpartition(":")
@@ -492,15 +503,64 @@ class ObservabilityServer:
                         self._send(200, body, "application/json")
                     else:
                         self._send(200, obs.list_json(), "application/json")
+                elif self.path.startswith("/explainz"):
+                    # decision explainer (autoscaler_tpu/explain): gated
+                    # like /perfz — the explainer always records, the
+                    # endpoint is the opt-out
+                    explainer = getattr(autoscaler, "explainer", None)
+                    enabled = getattr(
+                        autoscaler.options, "explain_enabled", True
+                    )
+                    if explainer is None or not enabled:
+                        self._send(
+                            404, "decision explainer disabled (--explain-enabled)"
+                        )
+                        return
+                    from urllib.parse import parse_qs, urlparse
+
+                    url = urlparse(self.path)
+                    if url.path.rstrip("/") not in ("", "/explainz"):
+                        self._send(404, "not found")
+                        return
+                    q = parse_qs(url.query)
+                    raw_tick = q.get("tick", [None])[0]
+                    pod = q.get("pod", [None])[0]
+                    group = q.get("group", [None])[0]
+                    if raw_tick is not None:
+                        try:
+                            tick = int(raw_tick)
+                        except ValueError:
+                            self._send(400, f"bad tick {raw_tick!r}")
+                            return
+                        body = explainer.detail_json(tick)
+                        if body is None:
+                            self._send(
+                                404, f"no decision record for tick {tick}"
+                            )
+                            return
+                        self._send(200, body, "application/json")
+                    elif pod is not None:
+                        self._send(200, explainer.pod_json(pod), "application/json")
+                    elif group is not None:
+                        self._send(
+                            200, explainer.group_json(group), "application/json"
+                        )
+                    else:
+                        self._send(200, explainer.list_json(), "application/json")
                 elif self.path == "/status":
                     from autoscaler_tpu.clusterstate.status import build_status
 
+                    explainer = getattr(autoscaler, "explainer", None)
                     self._send(
                         200,
                         build_status(
                             autoscaler.csr, time.time(),
                             autoscaler.options.cluster_name,
                             degraded_rungs=autoscaler.degraded_rungs(),
+                            last_decision=(
+                                explainer.last_decision_summary()
+                                if explainer is not None else None
+                            ),
                         ).render(),
                     )
                 elif self.path.startswith("/debug/pprof"):

@@ -35,6 +35,7 @@ ESTIMATE = "estimate"  # batched binpacking dispatch (threshold_based_limiter en
 KUBE_REQUEST = "kubeRequest"  # one control-plane HTTP request (incl. retries)
 RPC_CALL = "rpcCall"  # one sidecar RPC (incl. the single reconnect-resend)
 PERF_RECORD = "perfRecord"  # per-tick perf-ledger assembly (autoscaler_tpu/perf)
+EXPLAIN_RECORD = "explainRecord"  # per-tick decision-record assembly (autoscaler_tpu/explain)
 
 # function_duration_seconds bucket ladder. The reference's histogram starts
 # at 0.01s (metrics.go:209-218) — every sub-millisecond device dispatch
@@ -428,6 +429,14 @@ class AutoscalerMetrics:
         self.skipped_scale_events_count = r.counter(
             p + "skipped_scale_events_count",
             "scale events skipped (labels direction, reason)",
+        )
+        # node groups excluded from THIS loop's estimation, by closed
+        # SkipReason (explain/reasons.py; CA parity skipped_scale_events_
+        # count). A gauge reset every loop — like unremovable_nodes_count —
+        # so a reason that stops occurring reports 0, not its last value.
+        self.scaleup_skipped_groups_total = r.gauge(
+            p + "scaleup_skipped_groups_total",
+            "node groups skipped by this loop's scale-up, by reason",
         )
         self.nap_enabled = r.gauge(p + "nap_enabled", "node autoprovisioning on")
         self.created_node_groups_total = r.counter(

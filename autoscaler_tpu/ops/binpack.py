@@ -26,7 +26,18 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from autoscaler_tpu.kube.objects import CPU, MEMORY
+from autoscaler_tpu.explain.reasons import (
+    NUM_REASONS,
+    REASON_AFFINITY_SPREAD,
+    REASON_CPU,
+    REASON_MEMORY,
+    REASON_NODE_CAP,
+    REASON_NONE,
+    REASON_POD_SLOT,
+    REASON_RESOURCE,
+    REASON_TOPOLOGY,
+)
+from autoscaler_tpu.kube.objects import CPU, MEMORY, PODS
 from autoscaler_tpu.ops.telemetry import observed
 
 BIG_I32 = jnp.int32(2**30)  # "no domain yet" sentinel in spread minimums
@@ -93,6 +104,15 @@ KERNEL_CONTRACTS = {
             "node_caps": {"dims": ["G"], "dtype": "i32"},
         },
         "static": {"max_nodes": {"min": 1}},
+    },
+    "attribute_unschedulable": {
+        "args": {
+            "pod_req": {"dims": ["P", "R"], "dtype": "f32"},
+            "pod_masks": {"dims": ["G", "P"], "dtype": "bool"},
+            "template_allocs": {"dims": ["G", "R"], "dtype": "f32"},
+            "scheduled": {"dims": ["G", "P"], "dtype": "bool"},
+            "involved": {"dims": ["P"], "dtype": "bool"},
+        },
     },
 }
 
@@ -726,3 +746,94 @@ def ffd_binpack_groups_affinity(
         scheduled=scheduled,
         node_used=jnp.swapaxes(used_t, 1, 2),
     )
+
+
+# -- constraint attribution (decision provenance, autoscaler_tpu/explain) -----
+#
+# The fit reductions above compute per-constraint violation masks and then
+# throw them away; these kernels keep them. Reason codes and their ordering
+# come from explain/reasons.py — the ONE closed vocabulary the kernels, the
+# serial oracle twin (estimator/reference_impl.attribute_unschedulable_
+# reference) and the decision ledger share.
+
+
+def _reason_codes_one(
+    pod_req: jax.Array,   # [P, R]
+    mask: jax.Array,      # [P] bool
+    alloc: jax.Array,     # [R]
+    scheduled: jax.Array,  # [P] bool
+    involved: jax.Array,  # [P] bool — pod touches any affinity/spread term
+) -> jax.Array:
+    """[P] i32 — one group's reason per pod. Priority chain mirrors the
+    reference's filter order (mask predicates → NodeResourcesFit per axis →
+    dynamic affinity/spread → capacity): the FIRST violated constraint in
+    that order is the recorded reason, built bottom-up with `where` so the
+    highest-priority violation wins."""
+    over = pod_req > alloc[None, :]                               # [P, R]
+    R = pod_req.shape[1]
+    base = jnp.where(
+        involved,
+        jnp.int32(REASON_AFFINITY_SPREAD),
+        jnp.int32(REASON_NODE_CAP),
+    )
+    other_axes = [r for r in range(R) if r not in (CPU, MEMORY, PODS)]
+    if other_axes:
+        other_v = over[:, jnp.asarray(other_axes)].any(axis=1)
+        base = jnp.where(other_v, REASON_RESOURCE, base)
+    if R > PODS:
+        base = jnp.where(over[:, PODS], REASON_POD_SLOT, base)
+    base = jnp.where(over[:, MEMORY], REASON_MEMORY, base)
+    base = jnp.where(over[:, CPU], REASON_CPU, base)
+    base = jnp.where(~mask, REASON_TOPOLOGY, base)
+    return jnp.where(scheduled, REASON_NONE, base).astype(jnp.int32)
+
+
+@observed
+@jax.jit
+def attribute_unschedulable(
+    pod_req: jax.Array,          # [P, R] shared pending-pod matrix
+    pod_masks: jax.Array,        # [G, P] per-group schedulability
+    template_allocs: jax.Array,  # [G, R]
+    scheduled: jax.Array,        # [G, P] bool — the binpack verdict
+    involved: jax.Array,         # [P] bool — pod touches any dynamic term
+) -> jax.Array:
+    """[G, P] i32 — machine-readable reason per (pod, node-group) pair the
+    binpack left unschedulable, mirroring CA's PredicateError reasons: the
+    vmap'd reduction over the violated-constraint mask the fit family
+    otherwise discards. A pod the scan placed is REASON_NONE; an unplaced
+    pod that passed the mask and fits an empty template was blocked either
+    by the dynamic affinity/spread gates (when it holds any term) or by the
+    group's node headroom. Pure function of its operands — identical on
+    every ladder rung, byte-identical across replays."""
+    return jax.vmap(
+        lambda mask, alloc, sched: _reason_codes_one(
+            pod_req, mask, alloc, sched, involved
+        )
+    )(pod_masks, template_allocs, scheduled)
+
+
+@jax.jit
+def attribution_summary(
+    reasons: jax.Array,   # [G, P] i32 from attribute_unschedulable
+    weights: jax.Array,   # [G, P] i32 — pods behind each slot (1, or the
+                          # run's unplaced member count on the runs paths)
+) -> tuple:
+    """Device-side aggregation so the host never fetches the [G, P] reason
+    matrix at 100k-pod scale: per-group reason histograms (weighted) and
+    each pod's dominant reason — the MIN code across groups, i.e. the
+    closest the pod came to scheduling anywhere (reasons.py orders codes by
+    severity for exactly this reduction). The histogram is NUM_REASONS
+    masked sums, never a [G, P, NUM_REASONS] one-hot (that intermediate is
+    ~1.6GB at the north-star shape)."""
+    hist = jnp.stack(
+        [
+            jnp.sum(
+                jnp.where(reasons == code, weights, 0),
+                axis=1, dtype=jnp.int32,
+            )
+            for code in range(NUM_REASONS)
+        ],
+        axis=1,
+    )                                                             # [G, NR]
+    dominant = jnp.min(reasons, axis=0).astype(jnp.int32)         # [P]
+    return hist, dominant

@@ -121,5 +121,49 @@ def first_fit_node(snap: SnapshotTensors) -> jax.Array:
     return jnp.where(fits.any(axis=1), idx, -1)
 
 
+def fit_reason_matrix(snap: SnapshotTensors) -> jax.Array:
+    """[P, N] i32 — WHY pod i does not fit node j right now, as a reason
+    code from explain/reasons.py (REASON_NONE where it fits): the
+    per-constraint violation mask `fit_matrix` reduces away, kept. Same
+    priority chain as the estimator's template attribution
+    (ops/binpack.attribute_unschedulable), so "why is this pod pending"
+    and "why would a new node not help" speak one vocabulary. Refuses
+    factored-mask worlds past the dense-cell limit, like fit_matrix."""
+    from autoscaler_tpu.ops.binpack import _reason_codes_one
+
+    if _factored_too_big(snap):
+        raise ValueError(
+            f"fit_reason_matrix would materialize "
+            f"{snap.num_pods * snap.num_nodes} cells from a factored-mask "
+            "snapshot; attribute against group templates instead "
+            "(ops.binpack.attribute_unschedulable)"
+        )
+    free = snap.free()                                           # [N, R]
+    mask = (
+        snap.dense_sched()
+        & snap.pod_valid[:, None]
+        & snap.node_valid[None, :]
+    )                                                            # [P, N]
+    involved = jnp.zeros((snap.pod_req.shape[0],), bool)
+
+    def one(free_n, mask_n):
+        fits = jnp.all(snap.pod_req <= free_n[None, :], axis=1) & mask_n
+        return _reason_codes_one(snap.pod_req, mask_n, free_n, fits, involved)
+
+    return jax.vmap(one, in_axes=(0, 0), out_axes=1)(free, mask.T)
+
+
+def pending_fit_reasons(snap: SnapshotTensors) -> jax.Array:
+    """[P] i32 — each pod's dominant no-fit reason against the CURRENT
+    cluster: the MIN code over nodes (reasons.py orders codes by severity,
+    nearest-to-schedulable first). REASON_NONE means some node fits now;
+    a world with no valid nodes attributes everything to the predicate
+    mask (there is no node to measure resources against)."""
+    from autoscaler_tpu.explain.reasons import REASON_TOPOLOGY
+
+    codes = fit_reason_matrix(snap)
+    return jnp.min(codes, axis=1, initial=REASON_TOPOLOGY).astype(jnp.int32)
+
+
 fit_matrix_jit = jax.jit(fit_matrix, static_argnames="precision")
 fits_any_node_jit = jax.jit(fits_any_node)

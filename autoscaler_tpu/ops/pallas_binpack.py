@@ -442,7 +442,8 @@ def ffd_binpack_groups_pallas(
     chunk: int | None = None,   # None = auto-size against the VMEM budget
     group_block: int = 0,   # 0 = auto
     interpret: bool | None = None,
-) -> BinpackResult:
+    attribution: bool = False,
+):
     """Drop-in twin of ffd_binpack_groups running the scan in Pallas.
 
     The full scan runs in ONE device dispatch: a payload-carrying stable
@@ -450,7 +451,14 @@ def ffd_binpack_groups_pallas(
     chunk) cells with the capacity carry VMEM-resident, and a second sort
     restores original pod order for the scheduled bits. chunk=None picks the
     largest chunk the VMEM budget model admits; an explicit chunk is honored
-    as-is."""
+    as-is.
+
+    attribution=True returns ``(BinpackResult, reasons [G, P] i32)``: the
+    per-(pod, group) rejection reason codes (explain/reasons.py) derived
+    from the same operands by ops/binpack.attribute_unschedulable — the
+    violated-constraint reduction is bandwidth-trivial next to the scan, so
+    it rides the XLA path even when the FFD scan itself ran in Mosaic; one
+    kernel family, one reason vocabulary."""
     if chunk is not None and chunk % _STEP_TILE != 0:
         raise ValueError(
             f"chunk must be a multiple of {_STEP_TILE} (sublane tile); got {chunk}"
@@ -458,6 +466,13 @@ def ffd_binpack_groups_pallas(
     pod_req = jnp.asarray(pod_req, jnp.float32)
     pod_masks = jnp.asarray(pod_masks)
     template_allocs = jnp.asarray(template_allocs, jnp.float32)
+    # originals for the optional attribution output: the scan below pads
+    # the group axis, clamps +inf allocs and may compress resource axes —
+    # attribution must see the caller's semantics (+inf alloc = over-
+    # admission impossible, so the raw allocs are exactly right)
+    attr_operands = (
+        (pod_req, pod_masks, template_allocs) if attribution else None
+    )
     P, R_full = pod_req.shape
     G = pod_masks.shape[0]
     if node_caps is None:
@@ -611,11 +626,21 @@ def ffd_binpack_groups_pallas(
             .at[:, :, jnp.asarray(keep)]
             .set(node_used)
         )
-    return BinpackResult(
+    result = BinpackResult(
         node_count=opened[0, :G],
         scheduled=scheduled,
         node_used=node_used,
     )
+    if attr_operands is None:
+        return result
+    from autoscaler_tpu.ops.binpack import attribute_unschedulable
+
+    a_req, a_masks, a_allocs = attr_operands
+    reasons = attribute_unschedulable(
+        a_req, a_masks, a_allocs, scheduled,
+        jnp.zeros((P,), bool),  # the plain family has no dynamic terms
+    )
+    return result, reasons
 
 
 def allocs_to_used(template_allocs, free):

@@ -455,7 +455,8 @@ def ffd_binpack_groups_affinity_pallas(
     chunk: int | None = None,
     group_block: int = 0,
     interpret: bool | None = None,
-) -> BinpackResult:
+    attribution: bool = False,
+):
     """Drop-in twin of ffd_binpack_groups_affinity in Pallas, incl. the
     optional hard-topology-spread gates (count-plane carry; S <= 32).
 
@@ -463,7 +464,14 @@ def ffd_binpack_groups_affinity_pallas(
     ffd_binpack_groups_pallas, with three extra sorted payload plane-groups
     carrying the pod's packed term bitsets (plus two spread bitset planes
     when spread terms exist). No SWAR/axis-compression here — the term
-    state, not the resource planes, dominates the step."""
+    state, not the resource planes, dominates the step.
+
+    attribution=True returns ``(BinpackResult, reasons [G, P] i32)``: per-
+    (pod, group) rejection reason codes (explain/reasons.py) from
+    ops/binpack.attribute_unschedulable over the same operands, with the
+    involvement mask derived from the term tensors — a pod matching or
+    holding any (anti-)affinity or spread term attributes its leftover
+    unschedulability to the dynamic gates, not the node cap."""
     if chunk is not None and chunk % _STEP_TILE != 0:
         raise ValueError(
             f"chunk must be a multiple of {_STEP_TILE} (sublane tile); got {chunk}"
@@ -474,6 +482,9 @@ def ffd_binpack_groups_affinity_pallas(
     match = jnp.asarray(match).astype(bool)
     aff_of = jnp.asarray(aff_of).astype(bool)
     anti_of = jnp.asarray(anti_of).astype(bool)
+    attr_operands = (
+        (pod_req, pod_masks, template_allocs) if attribution else None
+    )
     node_level = jnp.asarray(node_level).astype(bool)
     has_label = jnp.asarray(has_label).astype(bool)
     P, R = pod_req.shape
@@ -621,8 +632,20 @@ def ffd_binpack_groups_affinity_pallas(
 
     used = allocs_to_used(template_allocs, free)
     node_used = jnp.transpose(used, (2, 1, 0))[:G, :max_nodes]
-    return BinpackResult(
+    result = BinpackResult(
         node_count=opened[0, :G],
         scheduled=scheduled,
         node_used=node_used,
     )
+    if attr_operands is None:
+        return result
+    from autoscaler_tpu.ops.binpack import attribute_unschedulable
+
+    a_req, a_masks, a_allocs = attr_operands
+    involved = (match | aff_of | anti_of).any(axis=0)
+    if spread is not None:
+        involved = involved | (sp_of_col > 0) | (sp_match_col > 0)
+    reasons = attribute_unschedulable(
+        a_req, a_masks, a_allocs, scheduled, involved
+    )
+    return result, reasons

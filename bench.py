@@ -436,6 +436,35 @@ def _perf_ledger_main(path: str) -> int:
     return 1 if errors else 0
 
 
+def _explain_ledger_main(path: str) -> int:
+    """``bench.py --explain-ledger <ledger.jsonl>``: validate a decision
+    JSONL ledger (schema, tick monotonicity, closed reason vocabularies,
+    and the provenance cross-checks — every executed scale-up carries its
+    recorded winning score, every still-pending pod carries a reason) and
+    print the aggregated reason/win report. Exit 0 = valid, 1 = schema or
+    provenance errors, 2 = unreadable ledger. hack/verify.sh gates on
+    this."""
+    from autoscaler_tpu.explain import load_jsonl, summarize, validate_records
+
+    try:
+        records = load_jsonl(path)
+    except (OSError, ValueError) as e:
+        print(json.dumps({"metric": "explain_ledger", "error": str(e)}))
+        return 2
+    errors = validate_records(records)
+    report = {
+        "metric": "explain_ledger",
+        "ledger": os.path.basename(path),
+        "valid": not errors,
+        # bounded: a corrupted ledger must not flood CI logs
+        "errors": errors[:20],
+        "errors_total": len(errors),
+        **(summarize(records) if not errors else {}),
+    }
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 1 if errors else 0
+
+
 def main():
     if "--perf-ledger" in sys.argv:
         idx = sys.argv.index("--perf-ledger")
@@ -443,6 +472,13 @@ def main():
             print("usage: bench.py --perf-ledger <ledger.jsonl>", file=sys.stderr)
             sys.exit(2)
         sys.exit(_perf_ledger_main(sys.argv[idx + 1]))
+    if "--explain-ledger" in sys.argv:
+        idx = sys.argv.index("--explain-ledger")
+        if idx + 1 >= len(sys.argv):
+            print("usage: bench.py --explain-ledger <ledger.jsonl>",
+                  file=sys.stderr)
+            sys.exit(2)
+        sys.exit(_explain_ledger_main(sys.argv[idx + 1]))
     if os.environ.get(_CHILD_ENV) == "1":
         _bench_main()
         return

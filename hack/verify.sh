@@ -111,6 +111,33 @@ python bench.py --perf-ledger "$trace_tmp/a.perf.jsonl" >/dev/null
 echo "perf ledger ok"
 rm -rf "$trace_tmp"
 
+echo "== decision-ledger determinism + provenance gate (two replays must write byte-identical explain JSONL) =="
+explain_tmp=$(mktemp -d)
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/skip_reasons.json \
+    --explain-ledger "$explain_tmp/a.explain.jsonl" >/dev/null
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/skip_reasons.json \
+    --explain-ledger "$explain_tmp/b.explain.jsonl" >/dev/null
+if ! diff -q "$explain_tmp/a.explain.jsonl" "$explain_tmp/b.explain.jsonl" >/dev/null; then
+    echo "ERROR: decision ledger is nondeterministic across identical replays:" >&2
+    diff "$explain_tmp/a.explain.jsonl" "$explain_tmp/b.explain.jsonl" | head -20 >&2
+    exit 1
+fi
+# schema + provenance cross-checks (every executed scale-up has its
+# recorded winning score; every still-pending pod has a closed-vocabulary
+# reason) and the every-SkipReason coverage the scenario exists for
+python bench.py --explain-ledger "$explain_tmp/a.explain.jsonl" > "$explain_tmp/report.json"
+python - "$explain_tmp/report.json" <<'EOF'
+import json, sys
+report = json.load(open(sys.argv[1]))
+assert report["valid"], report["errors"]
+skips = report["skip_reasons"]
+for reason in ("unhealthy_or_backed_off", "max_size_reached", "no_template"):
+    assert skips.get(reason, 0) > 0, f"scenario never exercised SkipReason {reason!r}: {skips}"
+assert report["expander_wins"], "no expander wins recorded"
+print(f"decision ledger ok ({report['ticks']} ticks, skips={skips})")
+EOF
+rm -rf "$explain_tmp"
+
 echo "== unit tests (8-device virtual CPU mesh) =="
 python -m pytest tests/ -q -x
 

@@ -257,7 +257,9 @@ class TestOrchestrator:
         pods = [build_test_pod("p", cpu_m=500)]
         result = orch.scale_up(pods, nodes, now_ts=10.0)
         assert not result.scaled_up
-        assert "backed off" in result.skipped_groups["small"]
+        from autoscaler_tpu.explain.reasons import SkipReason
+
+        assert result.skipped_groups["small"] is SkipReason.NOT_SAFE
 
     def test_max_size_respected(self):
         provider = make_provider([("g", 0, 3, 1, 1000, 2 * GB)])
