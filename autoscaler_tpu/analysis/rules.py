@@ -57,6 +57,7 @@ REPLAY_SCOPES = (
     "core/",
     "estimator/",
     "explain/",
+    "fleet/",
     "loadgen/",
     "perf/",
     "trace/",
@@ -254,6 +255,7 @@ class LadderBypass:
 
 THREADED_SCOPES = (
     "explain/",
+    "fleet/",
     "metrics/",
     "perf/",
     "trace/recorder.py",

@@ -12,6 +12,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from autoscaler_tpu.fleet.buckets import DEFAULT_BUCKETS as _DEFAULT_FLEET_BUCKETS
+
 
 @dataclass
 class NodeGroupAutoscalingOptions:
@@ -106,6 +108,22 @@ class AutoscalingOptions:
     explain_enabled: bool = True
     # how many recent per-tick decision records the in-memory ring keeps
     explain_ring_size: int = 64
+
+    # -- fleet serving (autoscaler_tpu/fleet) --------------------------------
+    # how long the coalescer waits after the first queued request before
+    # dispatching the batch — the latency/coalescing trade (ms because the
+    # useful range is single-digit milliseconds)
+    fleet_coalesce_window_ms: float = 5.0
+    # comma-separated PxGxR power-of-two shape buckets requests pad into;
+    # the closed compile-cache key set of the service. The default ladder
+    # lives with the safety argument in fleet/buckets.py — ONE source.
+    fleet_shape_buckets: str = _DEFAULT_FLEET_BUCKETS
+    # compile every configured bucket at startup so the first real request
+    # never compiles (ladder-rung pre-warm, ROADMAP item 5)
+    fleet_prewarm: bool = True
+    # scenario slots per coalesced batch (the kernel's leading S axis);
+    # overflow chunks into further batches in the same window
+    fleet_batch_scenarios: int = 8
 
     # -- cluster-wide resource limits (main.go:113-118) ----------------------
     max_nodes_total: int = 0                      # 0 = unlimited

@@ -219,6 +219,37 @@ def attribute_unschedulable_reference(
     return out
 
 
+def scenario_binpack_reference(
+    scen_req: np.ndarray,     # [S, P, R] per-scenario pod matrices
+    scen_masks: np.ndarray,   # [S, G, P]
+    scen_allocs: np.ndarray,  # [S, G, R]
+    max_nodes: int,
+    scen_caps: np.ndarray | None = None,  # [S, G] i32
+):
+    """Serial per-scenario oracle twin of ops/binpack.ffd_binpack_scenarios
+    (the fleet batched entry): plain Python loops over scenarios and groups,
+    each through the ONE shared FFD order spec. This is also the fleet
+    coalescer's degraded rung — a faulted batched dispatch falls back here,
+    and because every rung shares the order spec the per-tenant verdicts are
+    identical (batch isolation: a device fault costs latency, never a
+    co-batched tenant's answer). → (counts [S, G] i32, scheduled [S, G, P])."""
+    S, P, R = scen_req.shape
+    G = scen_masks.shape[1]
+    counts = np.zeros((S, G), np.int32)
+    scheds = np.zeros((S, G, P), bool)
+    for s in range(S):
+        for g in range(G):
+            cap = max_nodes if scen_caps is None else int(
+                min(scen_caps[s, g], max_nodes)
+            )
+            c, sched = ffd_binpack_reference(
+                scen_req[s], scen_masks[s, g], scen_allocs[s, g], cap
+            )
+            counts[s, g] = c
+            scheds[s, g] = sched
+    return counts, scheds
+
+
 def ffd_binpack_reference_groups(
     pod_req: np.ndarray,          # [P, R]
     pod_masks: np.ndarray,        # [G, P]

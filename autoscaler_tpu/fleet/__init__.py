@@ -1,0 +1,55 @@
+"""Fleet serving: a coalescing multi-tenant batched-estimator service.
+
+Many autoscalers (tenants) post independent scale-up questions; the fleet
+service pads them into power-of-two shape buckets, coalesces same-bucket
+requests inside a window, answers one scenario-sharded mesh dispatch per
+batch, and demuxes per-tenant verdicts that are BYTE-IDENTICAL to solo
+dispatches of the same operands — the "one TPU slice serving a fleet of
+autoscalers" story (ROADMAP item 1 / BASELINE config 5), certified by the
+loadgen fleet driver and tests/test_fleet.py.
+
+Layers: fleet/buckets.py (shape buckets + exact-pad safety argument),
+fleet/coalescer.py (admission queue, batching, circuit-broken dispatch,
+demux, pre-warm), rpc/service.py BatchEstimate (the wire surface).
+"""
+from autoscaler_tpu.fleet.buckets import (
+    DEFAULT_BUCKETS,
+    BucketError,
+    BucketSpec,
+    adhoc_bucket,
+    format_buckets,
+    pad_operands,
+    padding_waste,
+    parse_buckets,
+    pow2ceil,
+    select_bucket,
+)
+from autoscaler_tpu.fleet.coalescer import (
+    ROUTE_BATCHED,
+    ROUTE_ORACLE,
+    FleetAnswer,
+    FleetCoalescer,
+    FleetError,
+    FleetRequest,
+    FleetTicket,
+)
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "ROUTE_BATCHED",
+    "ROUTE_ORACLE",
+    "BucketError",
+    "BucketSpec",
+    "FleetAnswer",
+    "FleetCoalescer",
+    "FleetError",
+    "FleetRequest",
+    "FleetTicket",
+    "adhoc_bucket",
+    "format_buckets",
+    "pad_operands",
+    "padding_waste",
+    "parse_buckets",
+    "pow2ceil",
+    "select_bucket",
+]

@@ -1,0 +1,511 @@
+"""The coalescing multi-tenant estimator service.
+
+Many independent estimate/what-if requests → one sharded mesh dispatch.
+The pipeline (ARCHITECTURE.md "Fleet serving"):
+
+    admission → bucket → dispatch → demux
+
+1. **Admission**: ``submit()`` parks a request (lock-disciplined queue,
+   graftlint GL004) and returns a ticket. The RPC path runs a window
+   thread that flushes the queue every coalescing window; deterministic
+   drivers (loadgen, tests) call ``flush()`` themselves — batch formation
+   is a pure function of submission order, which is what makes fleet
+   decision ledgers byte-identical across replays.
+2. **Bucketing**: each request is exact-padded to the smallest configured
+   power-of-two (P, G, R) bucket (fleet/buckets.py carries the safety
+   argument), same-bucket requests are chunked into batches of
+   ``batch_scenarios`` scenario slots, and empty slots pad with zero
+   worlds — one compiled kernel shape per bucket, pre-warmable.
+3. **Dispatch**: one ``ffd_binpack_scenarios`` mesh dispatch per batch
+   (parallel/mesh.fleet_batch_estimate), walked down a circuit-broken
+   two-rung ladder — the batched device kernel, then the serial
+   per-scenario oracle twin (estimator/reference_impl). Every rung shares
+   the one FFD order spec, so a faulted batch degrades with IDENTICAL
+   per-tenant verdicts: batch isolation means a device fault costs the
+   batch latency, never a co-batched tenant's answer.
+4. **Demux**: tenant ``s``'s answer is the ``[:G, :P]`` slice of scenario
+   ``s`` — plus what-if cost ranking when the request carried prices.
+
+Time is injected (``clock``/``sleep`` parameter defaults — the GL001
+sanctioned seam; ``tick(now)`` feeds the breaker cooldowns) so fault
+scenarios replay byte-for-byte on the loadgen driver's simulated clock.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from autoscaler_tpu import trace
+from autoscaler_tpu.estimator.ladder import RUNG_PYTHON, RUNG_XLA, KernelLadder
+from autoscaler_tpu.fleet.buckets import (
+    DEFAULT_BUCKETS,
+    BucketSpec,
+    adhoc_bucket,
+    pad_operands,
+    padding_waste,
+    parse_buckets,
+    select_bucket,
+)
+from autoscaler_tpu.metrics import metrics as metrics_mod
+
+# route labels on the estimator_kernel_route vocabulary pattern: which lane
+# served a coalesced batch (perf-observatory records key on these)
+ROUTE_BATCHED = "fleet_batched"
+ROUTE_ORACLE = "fleet_oracle"
+
+
+class FleetError(RuntimeError):
+    """No rung could serve a coalesced batch."""
+
+
+@dataclass
+class FleetRequest:
+    """One tenant's estimate question, in packed-tensor form (the same
+    operand set rpc Estimate carries, plus identity and optional what-if
+    prices)."""
+
+    tenant_id: str
+    pod_req: np.ndarray          # [P, R] f32
+    pod_masks: np.ndarray        # [G, P] bool
+    template_allocs: np.ndarray  # [G, R] f32
+    node_caps: np.ndarray        # [G] i32
+    max_nodes: int
+    prices: Optional[np.ndarray] = None  # [G] f32 — present = what-if ranking
+
+    def shape(self) -> Tuple[int, int, int]:
+        P, R = self.pod_req.shape
+        return P, self.pod_masks.shape[0], R
+
+
+@dataclass
+class FleetAnswer:
+    """One tenant's demuxed verdict plus batch provenance (observability
+    fields — everything above ``bucket`` is byte-compared against solo)."""
+
+    node_counts: np.ndarray      # [G] i32
+    scheduled: np.ndarray        # [G, P] bool
+    bucket: str = ""
+    batch_size: int = 0          # co-batched real requests
+    padding_waste: float = 0.0   # padded-cell fraction of the batch
+    route: str = ROUTE_BATCHED   # which ladder rung served the batch
+    best_group: int = -1         # what-if: argmin cost (prices present)
+    best_cost: float = 0.0
+
+
+class FleetTicket:
+    """The demux hand-back: admission returns immediately, the answer
+    arrives when the request's batch dispatches."""
+
+    def __init__(self) -> None:
+        self._done = threading.Event()
+        self._answer: Optional[FleetAnswer] = None
+        self._error: Optional[BaseException] = None
+        # wall stamps (time.perf_counter — the sanctioned measurement
+        # clock, never a replay artifact): admission and resolution, so a
+        # caller can derive its true service latency even when its batch
+        # dispatched before other buckets in the same flush
+        self.submitted_wall: float = 0.0
+        self.resolved_wall: float = 0.0
+
+    def resolve(self, answer: FleetAnswer) -> None:
+        self._answer = answer
+        self.resolved_wall = time.perf_counter()
+        self._done.set()
+
+    def fail(self, error: BaseException) -> None:
+        self._error = error
+        self.resolved_wall = time.perf_counter()
+        self._done.set()
+
+    def result(self, timeout: Optional[float] = None) -> FleetAnswer:
+        if not self._done.wait(timeout):
+            raise TimeoutError("fleet answer not ready within the deadline")
+        if self._error is not None:
+            raise self._error
+        assert self._answer is not None
+        return self._answer
+
+
+class FleetCoalescer:
+    """One coalescer per serving process. ``mesh`` is the device mesh the
+    batched dispatches shard over (None = single-device). ``ladder`` is a
+    KernelLadder whose ``xla``/``python`` breakers guard the two fleet
+    rungs; loadgen installs its fault hook there. ``observatory`` (a
+    perf.PerfObservatory) sees every batch dispatch, which is where the
+    per-bucket compile cache hit/miss telemetry comes from — each bucket is
+    one (route, shape-signature) key."""
+
+    def __init__(
+        self,
+        buckets: str = DEFAULT_BUCKETS,
+        window_s: float = 0.005,
+        batch_scenarios: int = 8,
+        mesh: Any = None,
+        metrics: Any = None,
+        observatory: Any = None,
+        ladder: Optional[KernelLadder] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> None:
+        if batch_scenarios < 1:
+            raise ValueError(f"batch_scenarios must be >= 1, got {batch_scenarios}")
+        self.buckets: List[BucketSpec] = parse_buckets(buckets)
+        self.window_s = float(window_s)
+        self.batch_scenarios = int(batch_scenarios)
+        self.mesh = mesh
+        self.metrics = metrics
+        self.observatory = observatory
+        self.ladder = ladder or KernelLadder()
+        self.ladder.bind_metrics(metrics)
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: List[Tuple[FleetRequest, FleetTicket]] = []
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._prewarmed: List[str] = []
+        self._configured = frozenset(self.buckets)
+
+    # -- wiring ---------------------------------------------------------------
+    @classmethod
+    def from_options(cls, options, **kwargs) -> "FleetCoalescer":
+        """Build (and pre-warm, per ``fleet_prewarm``) a coalescer from
+        AutoscalingOptions — the --fleet-* flag surface."""
+        co = cls(
+            buckets=options.fleet_shape_buckets,
+            window_s=options.fleet_coalesce_window_ms / 1000.0,
+            batch_scenarios=options.fleet_batch_scenarios,
+            **kwargs,
+        )
+        if options.fleet_prewarm:
+            co.prewarm()
+        return co
+
+    def tick(self, now: float) -> None:
+        """Advance the ladder clock (wall in production, simulated under
+        loadgen — breaker cooldowns replay byte-for-byte)."""
+        self.ladder.tick(now)
+
+    def degraded(self) -> List[str]:
+        return self.ladder.degraded()
+
+    def prewarmed(self) -> List[str]:
+        with self._lock:
+            return list(self._prewarmed)
+
+    # -- admission ------------------------------------------------------------
+    def submit(self, request: FleetRequest) -> FleetTicket:
+        """Park one request for the next coalesced dispatch. The queue is
+        the only cross-thread state; tickets are resolved outside the lock."""
+        ticket = FleetTicket()
+        ticket.submitted_wall = time.perf_counter()
+        with self._lock:
+            self._pending.append((request, ticket))
+            if self.metrics is not None:
+                # published under the queue lock so a concurrent flush()
+                # can't interleave its set(0) with a stale depth — the
+                # gauge and the queue move together (metric series take
+                # their own inner lock; the order is always queue → series)
+                self.metrics.fleet_queue_depth.set(float(len(self._pending)))
+            self._cond.notify()
+        return ticket
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # -- the coalescing window (RPC path) -------------------------------------
+    def start(self) -> None:
+        """Run the window thread: whenever the queue is non-empty, wait one
+        coalescing window (letting co-tenant requests pile in), then flush.
+        A thread that died (it should not — the loop absorbs flush errors)
+        is revived, not treated as running."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._running = True
+            self._thread = threading.Thread(
+                target=self._window_loop, name="fleet-coalescer", daemon=True
+            )
+            thread = self._thread
+        thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._running = False
+            thread = self._thread
+            self._thread = None
+            self._cond.notify_all()
+        if thread is not None:
+            thread.join(timeout=5.0)
+        self.flush()  # drain stragglers so no ticket hangs
+
+    def _window_loop(self) -> None:
+        import logging
+
+        while True:
+            with self._lock:
+                if not self._running:
+                    return
+                if not self._pending:
+                    self._cond.wait(timeout=0.1)
+                    continue
+            self._sleep(self.window_s)
+            try:
+                self.flush()
+            except Exception:  # noqa: BLE001 — the window thread IS the
+                # service: an escaping flush error (per-batch errors already
+                # resolve their tickets) must not kill it, or every later
+                # request hangs until deadline for the process lifetime
+                logging.getLogger("fleet").exception(
+                    "fleet window flush failed; the loop continues"
+                )
+
+    # -- bucket + dispatch + demux --------------------------------------------
+    def flush(self) -> int:
+        """Dispatch everything pending; returns the request count served.
+        Deterministic: batches form per bucket in submission order, buckets
+        dispatch in sorted key order — replaying the same submission
+        sequence forms the same batches."""
+        with self._lock:
+            drained = self._pending
+            self._pending = []
+            if self.metrics is not None:
+                self.metrics.fleet_queue_depth.set(0.0)
+        if not drained:
+            return 0
+        by_bucket: Dict[BucketSpec, List[Tuple[FleetRequest, FleetTicket]]] = {}
+        for req, ticket in drained:
+            P, G, R = req.shape()
+            bucket = select_bucket(self.buckets, P, G, R) or adhoc_bucket(P, G, R)
+            by_bucket.setdefault(bucket, []).append((req, ticket))
+        for bucket in sorted(by_bucket, key=lambda b: b.key):
+            entries = by_bucket[bucket]
+            for i in range(0, len(entries), self.batch_scenarios):
+                self._dispatch_batch(bucket, entries[i : i + self.batch_scenarios])
+        return len(drained)
+
+    def _batch_slots(self, bucket: BucketSpec, n: int) -> int:
+        """Scenario slots for one batch. Configured buckets always dispatch
+        the full ``batch_scenarios`` so each holds ONE compiled shape
+        (pre-warmable, cache-coherent). Ad-hoc buckets are one-off by
+        definition — never pre-warmed, compile-on-arrival — so padding them
+        to the full width would multiply the kernel work for nothing;
+        they get the pow2 envelope of the actual request count."""
+        from autoscaler_tpu.fleet.buckets import pow2ceil
+
+        if bucket in self._configured:
+            return self.batch_scenarios
+        return min(pow2ceil(max(n, 1)), self.batch_scenarios)
+
+    def _batch_operands(
+        self,
+        bucket: BucketSpec,
+        entries: Sequence[Tuple[FleetRequest, FleetTicket]],
+        S: int,
+    ):
+        scen_req = np.zeros((S, bucket.pods, bucket.resources), np.float32)
+        scen_masks = np.zeros((S, bucket.groups, bucket.pods), bool)
+        scen_allocs = np.zeros((S, bucket.groups, bucket.resources), np.float32)
+        scen_caps = np.zeros((S, bucket.groups), np.int32)
+        for s, (req, _) in enumerate(entries):
+            # the tenant's own node budget becomes a dynamic cap (min with
+            # its declared caps) so the shared static carry (= bucket P)
+            # reproduces the solo max_nodes semantics exactly
+            caps = np.minimum(
+                req.node_caps.astype(np.int64), int(req.max_nodes)
+            ).astype(np.int32)
+            r, m, a, c = pad_operands(
+                bucket, req.pod_req, req.pod_masks, req.template_allocs, caps
+            )
+            scen_req[s], scen_masks[s], scen_allocs[s], scen_caps[s] = r, m, a, c
+        return scen_req, scen_masks, scen_allocs, scen_caps
+
+    def _dispatch_batch(
+        self, bucket: BucketSpec, entries: Sequence[Tuple[FleetRequest, FleetTicket]]
+    ) -> None:
+        try:
+            slots = self._batch_slots(bucket, len(entries))
+            scen_req, scen_masks, scen_allocs, scen_caps = self._batch_operands(
+                bucket, entries, slots
+            )
+            waste = padding_waste(
+                bucket, [req.shape() for req, _ in entries], slots
+            )
+            if self.metrics is not None:
+                self.metrics.fleet_batch_size.observe(
+                    float(len(entries)), bucket=bucket.key
+                )
+                self.metrics.fleet_padding_waste_ratio.observe(
+                    waste, bucket=bucket.key
+                )
+                for req, _ in entries:
+                    self.metrics.fleet_requests_total.inc(
+                        bucket=bucket.key, tenant=req.tenant_id
+                    )
+            counts, scheduled, route = self._walk_ladder(
+                bucket, scen_req, scen_masks, scen_allocs, scen_caps,
+                batch=len(entries),
+            )
+        except Exception as e:  # noqa: BLE001 — whatever failed (operand
+            # build, every rung), the batch's tickets must still resolve:
+            # the RPC handlers are blocked on them, and an unresolved
+            # ticket is a hang-until-deadline. The typed error rides each
+            # ticket out.
+            err = FleetError(f"no fleet rung served bucket {bucket.key}: {e}")
+            err.__cause__ = e
+            for _, ticket in entries:
+                ticket.fail(err)
+            return
+        if self.metrics is not None:
+            self.metrics.fleet_batches_total.inc(bucket=bucket.key, route=route)
+        for s, (req, ticket) in enumerate(entries):
+            ticket.resolve(
+                self._demux(req, counts[s], scheduled[s], bucket, len(entries),
+                            waste, route)
+            )
+
+    def _walk_ladder(
+        self, bucket, scen_req, scen_masks, scen_allocs, scen_caps, batch: int
+    ):
+        """Two-rung fleet ladder: the batched mesh kernel (``xla`` breaker),
+        then the serial oracle twin (``python`` breaker). Same protocol as
+        the estimator's walk — begin/record per rung, one fleetDispatch
+        span per engagement — shrunk to the two routes a coalesced batch
+        has."""
+        from autoscaler_tpu.parallel.mesh import fleet_batch_estimate
+
+        # advance the breaker clock from the injected clock on EVERY walk:
+        # the RPC serving path has no run_once to tick the ladder, and a
+        # tripped batched rung must recover once cooldown_s of (wall or
+        # simulated) time elapses — loadgen injects its sim clock here, so
+        # trip→degrade→recover replays byte-for-byte
+        self.ladder.tick(self._clock())
+
+        M = bucket.pods  # static carry: a pod can open at most one node
+
+        def batched():
+            return fleet_batch_estimate(
+                self.mesh, scen_req, scen_masks, scen_allocs, scen_caps, M
+            )
+
+        def oracle():
+            from autoscaler_tpu.estimator.reference_impl import (
+                scenario_binpack_reference,
+            )
+
+            return scenario_binpack_reference(
+                scen_req, scen_masks, scen_allocs, M, scen_caps
+            )
+
+        last = None
+        for rung, route, fn in (
+            (RUNG_XLA, ROUTE_BATCHED, batched),
+            (RUNG_PYTHON, ROUTE_ORACLE, oracle),
+        ):
+            with trace.span(
+                metrics_mod.FLEET_DISPATCH, metrics=self.metrics,
+                rung=rung, bucket=bucket.key, batch=batch,
+            ) as sp:
+                engaged = self.ladder.begin(rung)
+                if engaged == "breaker_open":
+                    sp.set_attrs(outcome="skipped", reason="breaker_open")
+                    last = FleetError(f"{rung} rung breaker open")
+                    continue
+                if engaged is not None:  # injected device-fault kind
+                    sp.set_attrs(outcome="fault", reason=engaged)
+                    last = FleetError(f"injected {engaged} on {rung} rung")
+                    continue
+                try:
+                    counts, scheduled = self._observed_dispatch(route, fn, sp)
+                except Exception as e:  # noqa: BLE001 — any rung failure descends
+                    self.ladder.record_failure(rung)
+                    sp.set_attrs(outcome="fault", reason="kernel_raised")
+                    last = e
+                    continue
+                self.ladder.record_success(rung)
+                sp.set_attrs(outcome="ok", route=route)
+                return counts, scheduled, route
+        raise last if last is not None else FleetError("no fleet rungs configured")
+
+    def _observed_dispatch(self, route: str, fn, sp):
+        """Run one rung under the perf observatory (when attached): the
+        batched rung's kernel entry is @observed, so the observatory sees
+        the concrete call — per-bucket shape signature, operand bytes,
+        compile-cache verdict — exactly as estimator dispatches do."""
+        obs = self.observatory
+        if obs is None:
+            return fn()
+        from autoscaler_tpu.ops.telemetry import kernel_observer
+
+        obs.clear_pending()
+        t0 = trace.timeline_now()
+        with kernel_observer(obs.note_kernel):
+            out = fn()
+        obs.on_dispatch(route, trace.timeline_now() - t0, span=sp)
+        return out
+
+    @staticmethod
+    def _demux(
+        req: FleetRequest, counts, scheduled, bucket: BucketSpec,
+        batch: int, waste: float, route: str,
+    ) -> FleetAnswer:
+        P, G, R = req.shape()
+        node_counts = np.asarray(counts[:G], np.int32).copy()
+        sched = np.asarray(scheduled[:G, :P], bool).copy()
+        answer = FleetAnswer(
+            node_counts=node_counts,
+            scheduled=sched,
+            bucket=bucket.key,
+            batch_size=batch,
+            padding_waste=round(float(waste), 6),
+            route=route,
+        )
+        if req.prices is not None and G > 0:
+            # the what-if reduction of parallel/mesh.whatif_best_options,
+            # host-side over the demuxed slice: price·count plus the
+            # unscheduled penalty per group
+            from autoscaler_tpu.parallel.mesh import UNSCHEDULED_PENALTY
+
+            pending = P - sched.sum(axis=1)
+            cost = (
+                np.asarray(req.prices, np.float64) * node_counts.astype(np.float64)
+                + UNSCHEDULED_PENALTY * pending.astype(np.float64)
+            )
+            answer.best_group = int(np.argmin(cost))
+            answer.best_cost = float(cost[answer.best_group])
+        return answer
+
+    # -- pre-warm -------------------------------------------------------------
+    def prewarm(self) -> List[str]:
+        """Ladder-rung pre-warm: push one all-zero batch through every
+        configured bucket so each (route, shape signature) compiles at
+        startup — the first real request is a compile-cache hit (the perf
+        observatory's per-bucket hit/miss series proves it). Returns the
+        bucket keys warmed."""
+        warmed: List[str] = []
+        with trace.span(
+            metrics_mod.FLEET_PREWARM, metrics=self.metrics,
+            buckets=len(self.buckets),
+        ):
+            for bucket in self.buckets:
+                S = self.batch_scenarios
+                self._walk_ladder(
+                    bucket,
+                    np.zeros((S, bucket.pods, bucket.resources), np.float32),
+                    np.zeros((S, bucket.groups, bucket.pods), bool),
+                    np.zeros((S, bucket.groups, bucket.resources), np.float32),
+                    np.zeros((S, bucket.groups), np.int32),
+                    batch=0,
+                )
+                warmed.append(bucket.key)
+        with self._lock:
+            self._prewarmed = warmed
+        if self.metrics is not None:
+            self.metrics.fleet_prewarmed_buckets.set(float(len(warmed)))
+        return warmed

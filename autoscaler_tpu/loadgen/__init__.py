@@ -17,6 +17,9 @@ Layers (ARCHITECTURE.md "Scenario engine"):
                   API (error classes, probability, latency, stuck-CREATING)
 - ``driver``    — the tick loop: apply events → run_once → materialize the
                   cloud → bind pods (kubelet+scheduler analog) → record
+- ``fleetdrive``— the fleet drill: K tenants through the coalescing
+                  estimator service, every answer byte-certified against a
+                  solo dispatch (scenarios with a ``fleet`` section)
 - ``score``     — report: pending-pod latency percentiles, provisioned vs
                   optimal, decision counts, per-tick wall time
 - ``cli``       — ``python -m autoscaler_tpu.loadgen run <scenario.json>``
@@ -30,17 +33,21 @@ from autoscaler_tpu.loadgen.driver import ScenarioDriver, run_scenario
 from autoscaler_tpu.loadgen.spec import (
     Event,
     FaultSpec,
+    FleetSpec,
     NodeGroupSpec,
     ScenarioSpec,
+    TenantSpec,
     WorkloadSpec,
 )
 
 __all__ = [
     "Event",
     "FaultSpec",
+    "FleetSpec",
     "NodeGroupSpec",
     "ScenarioDriver",
     "ScenarioSpec",
+    "TenantSpec",
     "WorkloadSpec",
     "run_scenario",
 ]

@@ -1,0 +1,350 @@
+"""Fleet scenario driver: K synthetic tenants through the coalescing
+estimator service, with the solo-parity fairness certificate.
+
+Each tick is one coalescing round:
+
+1. every tenant generates this round's estimate request from the scenario
+   RNG (keyed (seed, tenant index, round) — replays generate identical
+   request streams) and submits it to the REAL FleetCoalescer;
+2. the queue flushes: bucketing, batching, one sharded mesh dispatch per
+   batch, demux — with the fault injector armed on the fleet ladder's
+   rung seam, so ``kernel_fault``/``device_lost`` scenarios degrade the
+   batched rung exactly as a real device fault would;
+3. every demuxed answer is byte-compared against a SOLO dispatch of the
+   same operands (parallel/mesh.fleet_solo_estimate) — the certificate
+   that coalescing, padding, and batching change nothing a tenant can
+   observe, even in rounds where the batch degraded to the oracle rung;
+4. the round's decision record (per-tenant verdict digests, buckets,
+   routes, parity bits) and perf record (the observatory's dispatch
+   telemetry) are appended — both byte-identical across replays
+   (hack/verify.sh diffs them).
+
+Determinism: request content comes only from the seeded RNG; batch
+formation is submission order (tenant order); walls live in the score
+report, never the ledgers.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.loadgen.driver import BASE_TS, _TraceClock
+from autoscaler_tpu.loadgen.faults import FaultInjector
+from autoscaler_tpu.loadgen.spec import ScenarioSpec, SpecError, TenantSpec
+from autoscaler_tpu.metrics import metrics as metrics_mod
+from autoscaler_tpu.metrics.metrics import AutoscalerMetrics
+from autoscaler_tpu.trace import FlightRecorder, Tracer
+
+# fleet decision-ledger schema (sorted-key JSONL, one line per round)
+FLEET_SCHEMA = "autoscaler_tpu.fleet.round/1"
+
+
+@dataclass
+class FleetTenantVerdict:
+    """One tenant's answer in one round — the decision-ledger row. The
+    verdict digest (sha256 over counts+scheduled bytes) is the compact
+    byte-equality witness; ``match_solo`` is the certificate bit."""
+
+    tenant: str
+    bucket: str
+    batch_size: int
+    padding_waste: float
+    route: str
+    node_counts: List[int]
+    scheduled_pods: int
+    verdict_sha256: str
+    match_solo: bool
+    best_group: int = -1
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclass
+class FleetRoundRecord:
+    tick: int
+    now_ts: float
+    tenants: List[FleetTenantVerdict] = field(default_factory=list)
+    degraded: List[str] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)
+    wall_s: float = 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Ledger row: wall time stays OUT (same rule as TickRecord — the
+        log is the byte-for-byte replay artifact)."""
+        return {
+            "schema": FLEET_SCHEMA,
+            "tick": self.tick,
+            "now_ts": self.now_ts,
+            "tenants": [t.to_dict() for t in self.tenants],
+            "degraded": self.degraded,
+            "errors": self.errors,
+        }
+
+
+@dataclass
+class FleetRunResult:
+    spec: ScenarioSpec
+    records: List[FleetRoundRecord]
+    metrics: AutoscalerMetrics
+    injected_faults: Dict[str, int]
+    recorder: Optional[FlightRecorder] = None
+    perf_records: List[Dict[str, Any]] = field(default_factory=list)
+    # per-ROUND service wall (submit → last ticket resolved) — report-only
+    request_walls: List[float] = field(default_factory=list)
+    # per-tenant submit→resolve walls off the ticket stamps (a tenant whose
+    # batch dispatched first resolved earlier than the round wall) —
+    # report-only, never in a ledger
+    tenant_latency: Dict[str, List[float]] = field(default_factory=dict)
+    prewarmed: List[str] = field(default_factory=list)
+
+    def decision_log(self) -> List[Dict[str, Any]]:
+        return [r.to_dict() for r in self.records]
+
+    def decision_ledger_lines(self) -> str:
+        from autoscaler_tpu.perf import record_line
+
+        return "".join(record_line(rec) for rec in self.decision_log())
+
+    def perf_ledger_lines(self) -> str:
+        from autoscaler_tpu.perf import record_line
+
+        return "".join(record_line(rec) for rec in self.perf_records)
+
+    def all_match(self) -> bool:
+        """The fairness certificate over the whole run: every answered
+        request matched solo, at least one request WAS answered, and no
+        round recorded a failed batch — a run where every dispatch errored
+        out certifies nothing and must not read as a pass."""
+        verdicts = [t for r in self.records for t in r.tenants]
+        return (
+            bool(verdicts)
+            and all(t.match_solo for t in verdicts)
+            and not any(r.errors for r in self.records)
+        )
+
+
+def _tenant_request(spec: ScenarioSpec, t_index: int, tenant: TenantSpec,
+                    tick: int):
+    """One round's request content for one tenant — a pure function of
+    (seed, tenant index, round)."""
+    from autoscaler_tpu.fleet import FleetRequest
+    from autoscaler_tpu.kube.objects import CPU, MEMORY, NUM_RESOURCES, PODS
+
+    rng = np.random.default_rng((spec.seed, t_index, tick, 7919))
+    P, G, R = tenant.pods, tenant.groups, NUM_RESOURCES
+    req = np.zeros((P, R), np.float32)
+    req[:, CPU] = rng.integers(
+        1, max(int(tenant.cpu_m), 2), P
+    ).astype(np.float32)
+    req[:, MEMORY] = rng.integers(
+        1, max(int(tenant.mem_mb), 2), P
+    ).astype(np.float32) * 1024.0
+    req[:, PODS] = 1.0
+    masks = rng.random((G, P)) > 0.2
+    allocs = np.zeros((G, R), np.float32)
+    allocs[:, CPU] = rng.integers(
+        int(tenant.cpu_m), int(tenant.cpu_m) * 8 + 2, G
+    ).astype(np.float32)
+    allocs[:, MEMORY] = rng.integers(
+        int(tenant.mem_mb), int(tenant.mem_mb) * 8 + 2, G
+    ).astype(np.float32) * 1024.0
+    allocs[:, PODS] = rng.integers(4, 110, G).astype(np.float32)
+    caps = rng.integers(1, max(tenant.max_nodes, 2), G).astype(np.int32)
+    prices = (
+        rng.random(G).astype(np.float32) + np.float32(0.1)
+        if tenant.whatif else None
+    )
+    return FleetRequest(
+        tenant_id=tenant.name,
+        pod_req=req,
+        pod_masks=masks,
+        template_allocs=allocs,
+        node_caps=caps,
+        max_nodes=tenant.max_nodes,
+        prices=prices,
+    )
+
+
+class FleetScenarioDriver:
+    def __init__(self, spec: ScenarioSpec):
+        if spec.fleet is None:
+            raise SpecError("not a fleet scenario (no `fleet` section)")
+        self.spec = spec
+        self.injector = FaultInjector(spec.faults, spec.seed)
+        try:
+            opts_kw = dict(spec.options)
+            # ring sizes cover the whole run so the ledgers are complete,
+            # and the cost model is ON (pure function of shapes: replayable)
+            opts_kw.setdefault("perf_cost_model", True)
+            # +1: the prewarm sweep is its own tick (-1) and must survive
+            # the ring so the ledger shows the cold compiles
+            opts_kw.setdefault("perf_ring_size", spec.ticks + 1)
+            self.options = AutoscalingOptions(**opts_kw)
+        except TypeError as e:
+            raise SpecError(f"bad scenario options: {e}") from None
+        self.metrics = AutoscalerMetrics()
+        self.tracer = Tracer(
+            clock=_TraceClock(),
+            metrics=self.metrics,
+            recorder=FlightRecorder(capacity=spec.ticks + 1),
+            slow_tick_threshold_s=0.0,
+        )
+        from autoscaler_tpu.fleet import FleetCoalescer
+        from autoscaler_tpu.parallel.mesh import make_mesh
+        from autoscaler_tpu.perf import PerfObservatory
+
+        self.observatory = PerfObservatory(
+            metrics=self.metrics,
+            cost_model=self.options.perf_cost_model,
+            ring_capacity=self.options.perf_ring_size,
+        )
+        from autoscaler_tpu.estimator.ladder import KernelLadder
+
+        # the coalescer reads its injected clock on every ladder walk; the
+        # driver advances this per round, so breaker cooldowns run on
+        # simulated time and trip→degrade→recover replays byte-for-byte
+        self._sim_now = BASE_TS - spec.tick_interval_s
+        self.coalescer = FleetCoalescer(
+            buckets=self.options.fleet_shape_buckets,
+            window_s=self.options.fleet_coalesce_window_ms / 1000.0,
+            batch_scenarios=self.options.fleet_batch_scenarios,
+            mesh=make_mesh(),
+            metrics=self.metrics,
+            observatory=self.observatory,
+            clock=lambda: self._sim_now,
+            # breaker knobs ride the same options as the estimator ladder
+            ladder=KernelLadder(
+                failure_threshold=self.options.kernel_breaker_failure_threshold,
+                cooldown_s=self.options.kernel_breaker_cooldown_s,
+            ),
+        )
+        # the fault seam: scripted kernel_fault/device_lost fire at the
+        # fleet ladder's rung dispatch, exactly like the estimator's
+        self.coalescer.ladder.fault_hook = self.injector.on_kernel_dispatch
+        self.prewarmed: List[str] = []
+
+    def run(self) -> FleetRunResult:
+        spec = self.spec
+        fleet = spec.fleet
+        records: List[FleetRoundRecord] = []
+        walls: List[float] = []
+        tenant_latency: Dict[str, List[float]] = {}
+        by_tick: Dict[int, list] = {}
+        for ev in spec.events:
+            by_tick.setdefault(ev.at_tick, []).append(ev)
+        if self.options.fleet_prewarm:
+            # inside a traced tick so the prewarm's dispatch walls ride the
+            # deterministic timeline clock (byte-identical perf ledger)
+            self.observatory.begin_tick(-1, BASE_TS - spec.tick_interval_s)
+            self.tracer.set_context(scenario=spec.name, phase="prewarm")
+            with self.tracer.tick(metrics_mod.MAIN):
+                self.prewarmed = self.coalescer.prewarm()
+            self.observatory.end_tick()
+        for tick in range(spec.ticks):
+            self.injector.tick = tick
+            now = BASE_TS + tick * spec.tick_interval_s
+            self._sim_now = now
+            for ev in by_tick.get(tick, ()):
+                if ev.kind == "fault":
+                    self.injector.arm(ev.fault, tick)
+                elif ev.kind == "clear_faults":
+                    self.injector.clear()
+                else:
+                    raise SpecError(
+                        f"fleet scenarios support fault/clear_faults "
+                        f"events only, got {ev.kind!r}"
+                    )
+            rec = FleetRoundRecord(tick=tick, now_ts=now)
+            self.observatory.begin_tick(tick, now)
+            self.tracer.set_context(scenario=spec.name, tick=tick, sim_ts=now)
+            requests = [
+                _tenant_request(spec, ti, tenant, tick)
+                for ti, tenant in enumerate(fleet.tenants)
+            ]
+            answered = []
+            with self.tracer.tick(metrics_mod.MAIN):
+                # the timed window covers ONLY the fleet service's work —
+                # admission, coalesced dispatch, demux — so the report's
+                # latency columns measure the service, not the driver's
+                # request generation or the certification dispatches below
+                t0 = time.perf_counter()
+                tickets = [self.coalescer.submit(r) for r in requests]
+                self.coalescer.flush()
+                for req, ticket in zip(requests, tickets):
+                    try:
+                        answered.append((req, ticket.result(timeout=0.0)))
+                    except Exception as e:  # noqa: BLE001 — a failed batch
+                        # is a recorded error, not a crashed run (crash-only
+                        # discipline, same as the tick driver)
+                        rec.errors.append(f"{req.tenant_id}: {e}")
+                    # per-tenant service latency off the ticket stamps: a
+                    # tenant whose bucket dispatched first resolved before
+                    # later buckets in the same flush
+                    tenant_latency.setdefault(req.tenant_id, []).append(
+                        ticket.resolved_wall - ticket.submitted_wall
+                    )
+                rec.wall_s = time.perf_counter() - t0
+            walls.append(rec.wall_s)
+            # the fairness certificate (solo dispatches) runs OUTSIDE the
+            # timed window and outside the perf tick
+            self.observatory.end_tick()
+            for req, answer in answered:
+                rec.tenants.append(self._certify(req, answer))
+            rec.errors.sort()
+            rec.degraded = sorted(self.coalescer.degraded())
+            records.append(rec)
+        return FleetRunResult(
+            spec=spec,
+            records=records,
+            metrics=self.metrics,
+            injected_faults=dict(self.injector.injected),
+            recorder=self.tracer.recorder,
+            perf_records=self.observatory.records(),
+            request_walls=walls,
+            tenant_latency=tenant_latency,
+            prewarmed=list(self.prewarmed),
+        )
+
+    @staticmethod
+    def _certify(req, answer) -> FleetTenantVerdict:
+        """The fairness certificate for one answer: byte-compare against a
+        solo dispatch of the SAME operands (caps clamped by the tenant's
+        own max_nodes on both sides — the semantics the bucket carry
+        reproduces)."""
+        from autoscaler_tpu.parallel.mesh import fleet_solo_estimate
+
+        solo_counts, solo_sched = fleet_solo_estimate(
+            req.pod_req, req.pod_masks, req.template_allocs,
+            req.node_caps, req.max_nodes,
+        )
+        fleet_bytes = (
+            np.ascontiguousarray(answer.node_counts, "<i4").tobytes()
+            + np.ascontiguousarray(answer.scheduled, np.uint8).tobytes()
+        )
+        solo_bytes = (
+            np.ascontiguousarray(solo_counts, "<i4").tobytes()
+            + np.ascontiguousarray(solo_sched, np.uint8).tobytes()
+        )
+        return FleetTenantVerdict(
+            tenant=req.tenant_id,
+            bucket=answer.bucket,
+            batch_size=answer.batch_size,
+            padding_waste=answer.padding_waste,
+            route=answer.route,
+            node_counts=[int(c) for c in answer.node_counts],
+            scheduled_pods=int(np.asarray(answer.scheduled).sum()),
+            verdict_sha256=hashlib.sha256(fleet_bytes).hexdigest(),
+            match_solo=fleet_bytes == solo_bytes,
+            best_group=answer.best_group,
+        )
+
+
+def run_fleet_scenario(spec: ScenarioSpec) -> FleetRunResult:
+    return FleetScenarioDriver(spec).run()

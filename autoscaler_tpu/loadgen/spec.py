@@ -207,6 +207,52 @@ class WorkloadSpec:
 
 
 @dataclass
+class TenantSpec:
+    """One synthetic tenant of a fleet scenario: an autoscaler posting
+    estimate questions of a fixed (pods, groups) shape. Request CONTENT is
+    drawn per round from the scenario RNG keyed (seed, tenant index,
+    round), so two replays generate identical request streams."""
+
+    name: str
+    pods: int = 16
+    groups: int = 4
+    max_nodes: int = 32
+    cpu_m: float = 500.0         # request magnitude scale
+    mem_mb: float = 512.0
+    whatif: bool = False         # attach per-group prices → what-if ranking
+
+    def __post_init__(self):
+        if self.pods <= 0 or self.groups <= 0:
+            raise SpecError(
+                f"tenant {self.name!r} needs positive pods/groups, got "
+                f"{self.pods}/{self.groups}"
+            )
+        if self.max_nodes <= 0:
+            raise SpecError(
+                f"tenant {self.name!r} max_nodes must be positive"
+            )
+
+
+@dataclass
+class FleetSpec:
+    """The fleet-serving drill (ISSUE 8): ``ticks`` coalescing rounds, each
+    tenant posting one estimate request per round; the driver certifies
+    every fleet answer byte-identical to a solo dispatch of the same
+    operands (loadgen/fleetdrive.py). Faults ride the scenario's normal
+    fault list — a ``kernel_fault`` on the ``xla`` rung hits the fleet
+    ladder's batched rung."""
+
+    tenants: List[TenantSpec] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.tenants:
+            raise SpecError("fleet scenario needs at least one tenant")
+        names = [t.name for t in self.tenants]
+        if len(set(names)) != len(names):
+            raise SpecError(f"duplicate tenant names in {names}")
+
+
+@dataclass
 class ScenarioSpec:
     name: str
     seed: int = 0
@@ -220,6 +266,10 @@ class ScenarioSpec:
     # driver starts from scenario-friendly defaults (no cooldowns, short
     # unneeded time) and applies these on top
     options: Dict[str, Any] = field(default_factory=dict)
+    # fleet-serving drill: when set, the scenario drives the coalescing
+    # estimator service instead of the control loop (ticks = coalescing
+    # rounds; node_groups/workloads are unused and may be empty)
+    fleet: Optional[FleetSpec] = None
 
     def __post_init__(self):
         if self.ticks <= 0:
@@ -228,7 +278,13 @@ class ScenarioSpec:
             raise SpecError(
                 f"tick_interval_s must be positive, got {self.tick_interval_s}"
             )
-        if not self.node_groups:
+        if self.fleet is not None:
+            if self.workloads:
+                raise SpecError(
+                    "fleet scenarios drive the estimator service, not the "
+                    "control loop — drop `workloads`"
+                )
+        elif not self.node_groups:
             raise SpecError("scenario needs at least one node group")
         names = [g.name for g in self.node_groups]
         if len(set(names)) != len(names):
@@ -259,6 +315,15 @@ class ScenarioSpec:
         kw["events"] = [_load_event(e) for e in doc.get("events", [])]
         kw["workloads"] = [_load(WorkloadSpec, w) for w in doc.get("workloads", [])]
         kw["faults"] = [_load(FaultSpec, f) for f in doc.get("faults", [])]
+        fleet = doc.get("fleet")
+        if fleet is not None:
+            if not isinstance(fleet, dict):
+                raise SpecError(
+                    f"fleet section must be an object, got {type(fleet)}"
+                )
+            kw["fleet"] = FleetSpec(
+                tenants=[_load(TenantSpec, t) for t in fleet.get("tenants", [])]
+            )
         return cls(**kw)
 
     def to_json(self) -> str:

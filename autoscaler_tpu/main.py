@@ -25,6 +25,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.fleet.buckets import DEFAULT_BUCKETS as _FLEET_DEFAULT_BUCKETS
 
 
 def _bool_flag(s: str) -> bool:
@@ -240,6 +241,20 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--explain-ring-size", type=int, default=64,
                    help="how many recent per-tick decision records the "
                         "in-memory ring keeps")
+    p.add_argument("--fleet-coalesce-window-ms", type=float, default=5.0,
+                   help="fleet serving: how long the coalescer waits after "
+                        "the first queued estimate request before "
+                        "dispatching the batch (autoscaler_tpu/fleet)")
+    p.add_argument("--fleet-shape-buckets", default=_FLEET_DEFAULT_BUCKETS,
+                   help="fleet serving: comma-separated PxGxR power-of-two "
+                        "shape buckets requests pad into — the closed "
+                        "compile-cache key set of the service")
+    p.add_argument("--fleet-prewarm", type=_bool_flag, default=True,
+                   help="fleet serving: compile every configured bucket at "
+                        "startup so the first real request never compiles")
+    p.add_argument("--fleet-batch-scenarios", type=int, default=8,
+                   help="fleet serving: scenario slots per coalesced batch "
+                        "(the batched kernel's leading axis)")
     p.add_argument("--record-duplicated-events", type=_bool_flag, default=False,
                    help="post every event instead of suppressing repeats "
                         "within the correlator window")
@@ -359,6 +374,10 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         perf_ring_size=args.perf_ring_size,
         explain_enabled=args.explain_enabled,
         explain_ring_size=args.explain_ring_size,
+        fleet_coalesce_window_ms=args.fleet_coalesce_window_ms,
+        fleet_shape_buckets=args.fleet_shape_buckets,
+        fleet_prewarm=args.fleet_prewarm,
+        fleet_batch_scenarios=args.fleet_batch_scenarios,
         force_daemonsets=args.force_ds,
     )
     opts.node_group_defaults.scale_down_unneeded_time_s = args.scale_down_unneeded_time

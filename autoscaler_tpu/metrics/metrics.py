@@ -36,6 +36,8 @@ KUBE_REQUEST = "kubeRequest"  # one control-plane HTTP request (incl. retries)
 RPC_CALL = "rpcCall"  # one sidecar RPC (incl. the single reconnect-resend)
 PERF_RECORD = "perfRecord"  # per-tick perf-ledger assembly (autoscaler_tpu/perf)
 EXPLAIN_RECORD = "explainRecord"  # per-tick decision-record assembly (autoscaler_tpu/explain)
+FLEET_DISPATCH = "fleetDispatch"  # one coalesced multi-tenant batch dispatch (autoscaler_tpu/fleet)
+FLEET_PREWARM = "fleetPrewarm"  # startup bucket pre-warm sweep (autoscaler_tpu/fleet)
 
 # function_duration_seconds bucket ladder. The reference's histogram starts
 # at 0.01s (metrics.go:209-218) — every sub-millisecond device dispatch
@@ -479,6 +481,37 @@ class AutoscalerMetrics:
             p + "estimation_over_budget_total",
             "batched binpacking dispatches exceeding the per-group duration "
             "budget x group count (--max-nodegroup-binpacking-duration)",
+        )
+        # -- fleet serving (autoscaler_tpu/fleet): the coalescing multi-
+        # tenant estimator service. Batch-size and padding-waste ladders are
+        # fleet-shaped, not duration-shaped; per-bucket compile cache
+        # hit/miss rides kernel_compile_cache_total via the observatory
+        # (each bucket is one (route, shape-signature) key).
+        self.fleet_queue_depth = r.gauge(
+            p + "fleet_queue_depth",
+            "estimate requests waiting in the coalescing window",
+        )
+        self.fleet_batch_size = r.histogram(
+            p + "fleet_batch_size",
+            "real (non-padding) requests per coalesced batch, by bucket",
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0),
+        )
+        self.fleet_padding_waste_ratio = r.histogram(
+            p + "fleet_padding_waste_ratio",
+            "padded-cell fraction of each coalesced batch, by bucket",
+            buckets=(0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99),
+        )
+        self.fleet_requests_total = r.counter(
+            p + "fleet_requests_total",
+            "admitted fleet estimate requests by bucket and tenant",
+        )
+        self.fleet_batches_total = r.counter(
+            p + "fleet_batches_total",
+            "coalesced batch dispatches by bucket and serving route",
+        )
+        self.fleet_prewarmed_buckets = r.gauge(
+            p + "fleet_prewarmed_buckets",
+            "shape buckets pre-warmed at startup",
         )
 
     def observe_duration_value(self, label: str, elapsed: float) -> float:

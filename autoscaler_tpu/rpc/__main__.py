@@ -1,0 +1,63 @@
+"""Sidecar launcher: ``python -m autoscaler_tpu.rpc``.
+
+The deploy manifests used to inline ``python -c "...serve(...)..."`` with
+no flag surface, which left every --fleet-* knob parsed by the host
+process but unreachable by the sidecar that actually serves BatchEstimate.
+This entrypoint closes that gap: it parses the sidecar-relevant flags,
+folds them into AutoscalingOptions, and hands them to ``serve()`` — so
+``--fleet-shape-buckets``/``--fleet-coalesce-window-ms``/
+``--fleet-batch-scenarios`` configure the coalescer and ``--fleet-prewarm``
+compiles every bucket before the port is announced.
+"""
+from __future__ import annotations
+
+import argparse
+import threading
+
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.fleet.buckets import DEFAULT_BUCKETS
+from autoscaler_tpu.main import _bool_flag
+from autoscaler_tpu.rpc.service import serve
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m autoscaler_tpu.rpc", description=__doc__,
+    )
+    p.add_argument("--address", default="127.0.0.1:9090",
+                   help="host:port to bind (port 0 picks a free one)")
+    p.add_argument("--max-workers", type=int, default=8,
+                   help="gRPC handler threads; the coalescing window only "
+                        "pays off when concurrent tenants can be admitted")
+    # the --fleet-* surface, same spellings/defaults as the host process
+    # (main.build_arg_parser) so one flag vocabulary configures both sides
+    p.add_argument("--fleet-coalesce-window-ms", type=float, default=5.0)
+    p.add_argument("--fleet-shape-buckets", default=DEFAULT_BUCKETS)
+    p.add_argument("--fleet-prewarm", type=_bool_flag, default=True)
+    p.add_argument("--fleet-batch-scenarios", type=int, default=8)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    options = AutoscalingOptions(
+        fleet_coalesce_window_ms=args.fleet_coalesce_window_ms,
+        fleet_shape_buckets=args.fleet_shape_buckets,
+        fleet_prewarm=args.fleet_prewarm,
+        fleet_batch_scenarios=args.fleet_batch_scenarios,
+    )
+    server, port = serve(
+        args.address, max_workers=args.max_workers, options=options
+    )
+    print(f"tpu-autoscaler sidecar serving on port {port} "
+          f"(buckets={options.fleet_shape_buckets}, "
+          f"prewarm={options.fleet_prewarm})", flush=True)
+    try:
+        threading.Event().wait()  # serve until the pod is torn down
+    except KeyboardInterrupt:
+        server.stop(grace=2.0)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -23,6 +23,7 @@ import grpc
 from autoscaler_tpu import trace
 from autoscaler_tpu.metrics import metrics as metrics_mod
 from autoscaler_tpu.rpc import autoscaler_pb2 as pb
+from autoscaler_tpu.rpc import fleet_pb2 as fleet_pb
 
 SERVICE_NAME = "autoscaler_tpu.TpuSimulation"
 
@@ -37,6 +38,57 @@ def _i32(blob: bytes, *shape: int) -> np.ndarray:
 
 def _u8(blob: bytes, *shape: int) -> np.ndarray:
     return np.frombuffer(blob, np.uint8).reshape(shape).astype(bool)
+
+
+def _checked_blob(
+    blob: bytes, dtype, shape: tuple, name: str, context
+) -> np.ndarray:
+    """Decode one operand blob with its axes VALIDATED: a blob whose byte
+    count disagrees with the declared axes aborts the RPC as
+    INVALID_ARGUMENT with the one consistent message shape — previously
+    each servicer method re-decoded raw and a mismatched axis surfaced as
+    an opaque numpy reshape error deep in the handler."""
+    want = int(np.prod(shape)) * np.dtype(dtype).itemsize
+    if len(blob) != want:
+        context.abort(
+            grpc.StatusCode.INVALID_ARGUMENT,
+            f"operand axis mismatch: {name} carries {len(blob)} bytes but "
+            f"the declared axes {tuple(int(d) for d in shape)} require {want}",
+        )
+    return (
+        np.frombuffer(blob, np.dtype(dtype)).reshape(shape).copy()
+    )
+
+
+def _decode_estimate_operands(request, context):
+    """THE checked decode path shared by Estimate and BatchEstimate (the
+    two RPCs carrying the estimator operand set): resource-axis schema
+    check, then every blob validated against the declared (P, G, R) axes.
+    → (pod_req [P,R] f32, masks [G,P] bool, allocs [G,R] f32, caps [G]
+    i32)."""
+    _check_resource_axis(request.pods, context)
+    P = request.pods.num_pods
+    R = request.pods.num_resources
+    G = len(request.group_ids)
+    if P < 0 or R <= 0 or G <= 0:
+        context.abort(
+            grpc.StatusCode.INVALID_ARGUMENT,
+            f"operand axis mismatch: P={P}, R={R}, G={G} do not describe "
+            "an estimable request (need R > 0 and at least one group)",
+        )
+    pod_req = _checked_blob(
+        request.pods.requests, "<f4", (P, R), "pods.requests", context
+    )
+    masks = _checked_blob(
+        request.pod_masks, np.uint8, (G, P), "pod_masks", context
+    ).astype(bool)
+    allocs = _checked_blob(
+        request.template_allocs, "<f4", (G, R), "template_allocs", context
+    )
+    caps = _checked_blob(
+        request.node_caps, "<i4", (G,), "node_caps", context
+    )
+    return pod_req, masks, allocs, caps
 
 
 def _check_resource_axis(pods: "pb.PackedPods", context) -> None:
@@ -61,10 +113,29 @@ class TpuSimulationServicer:
 
     ``residency`` (a perf.ResidencyLedger, optional) accounts each method's
     unpacked what-if batch tensors in the ``scenario_batches`` pool — the
-    sidecar's contribution to device_resident_bytes."""
+    sidecar's contribution to device_resident_bytes.
 
-    def __init__(self, residency=None):
+    ``fleet`` (a fleet.FleetCoalescer, optional) backs the BatchEstimate
+    coalescing surface; absent, the first BatchEstimate builds a default
+    coalescer (default buckets, pre-warm off) so the RPC works out of the
+    box — deploy sites pass FleetCoalescer.from_options for the
+    --fleet-* knobs."""
+
+    def __init__(self, residency=None, fleet=None):
+        import threading
+
         self.residency = residency
+        self.fleet = fleet
+        self._fleet_lock = threading.Lock()
+
+    def _ensure_fleet(self):
+        with self._fleet_lock:
+            if self.fleet is None:
+                from autoscaler_tpu.fleet import FleetCoalescer
+
+                self.fleet = FleetCoalescer()
+            self.fleet.start()
+            return self.fleet
 
     @contextlib.contextmanager
     def _account(self, method: str, *arrays):
@@ -90,14 +161,7 @@ class TpuSimulationServicer:
 
         from autoscaler_tpu.ops.binpack import ffd_binpack_groups
 
-        _check_resource_axis(request.pods, context)
-        P = request.pods.num_pods
-        R = request.pods.num_resources
-        G = len(request.group_ids)
-        pod_req = _f32(request.pods.requests, P, R)
-        masks = _u8(request.pod_masks, G, P)
-        allocs = _f32(request.template_allocs, G, R)
-        caps = _i32(request.node_caps, G)
+        pod_req, masks, allocs, caps = _decode_estimate_operands(request, context)
         with self._account("Estimate", pod_req, masks, allocs, caps):
             # graftlint: disable=GL003 — sidecar server side: the ladder lives in the CLIENT process (TpuSimulationClient's caller); a fault here surfaces as an RPC error the client's ladder absorbs
             res = ffd_binpack_groups(
@@ -110,6 +174,73 @@ class TpuSimulationServicer:
             return pb.EstimateResponse(
                 node_counts=np.asarray(res.node_count, np.dtype("<i4")).tobytes(),
                 scheduled=np.asarray(res.scheduled, np.uint8).tobytes(),
+            )
+
+    def BatchEstimate(
+        self, request: "fleet_pb.BatchEstimateRequest", context
+    ) -> "fleet_pb.BatchEstimateResponse":
+        """The fleet serving surface: park the tenant's request in the
+        coalescer's admission queue and block until its batch dispatches —
+        N concurrent tenants pay ONE sharded mesh dispatch per shape
+        bucket per window instead of N. Operands ride the SAME checked
+        decode path as Estimate, so an axis mismatch fails identically on
+        both routes."""
+        pod_req, masks, allocs, caps = _decode_estimate_operands(request, context)
+        G = len(request.group_ids)
+        prices = None
+        if request.prices:
+            prices = _checked_blob(
+                request.prices, "<f4", (G,), "prices", context
+            )
+        from autoscaler_tpu.fleet import FleetRequest
+
+        fleet = self._ensure_fleet()
+        with self._account("BatchEstimate", pod_req, masks, allocs, caps):
+            ticket = fleet.submit(
+                FleetRequest(
+                    tenant_id=request.tenant_id or "anonymous",
+                    pod_req=pod_req,
+                    pod_masks=masks,
+                    template_allocs=allocs,
+                    node_caps=caps,
+                    max_nodes=int(request.max_nodes),
+                    prices=prices,
+                )
+            )
+            # the coalescing window plus dispatch must finish inside the
+            # caller's deadline — never block PAST it (gRPC has already
+            # cancelled the RPC by then, and an over-wait pins an executor
+            # worker). With no deadline set, bound the wait anyway: window
+            # plus a dispatch allowance, so a wedged dispatcher fails the
+            # RPC instead of hanging the handler.
+            remaining = context.time_remaining()
+            timeout = (
+                remaining if remaining is not None
+                else fleet.window_s + 30.0
+            )
+            try:
+                answer = ticket.result(timeout=timeout)
+            except TimeoutError:
+                context.abort(
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                    "fleet batch did not dispatch within the deadline",
+                )
+            except Exception as e:  # noqa: BLE001 — every fleet rung failed;
+                # surface the typed ladder error to the caller
+                context.abort(grpc.StatusCode.INTERNAL, f"fleet dispatch failed: {e}")
+            return fleet_pb.BatchEstimateResponse(
+                node_counts=np.ascontiguousarray(
+                    answer.node_counts, "<i4"
+                ).tobytes(),
+                scheduled=np.ascontiguousarray(
+                    answer.scheduled, np.uint8
+                ).tobytes(),
+                bucket=answer.bucket,
+                batch_size=answer.batch_size,
+                padding_waste=answer.padding_waste,
+                route=answer.route,
+                best_group=answer.best_group,
+                best_cost=answer.best_cost,
             )
 
     def TrySchedule(self, request: pb.TryScheduleRequest, context) -> pb.TryScheduleResponse:
@@ -227,6 +358,9 @@ class TpuSimulationServicer:
 
 _METHODS = {
     "Estimate": (pb.EstimateRequest, pb.EstimateResponse),
+    "BatchEstimate": (
+        fleet_pb.BatchEstimateRequest, fleet_pb.BatchEstimateResponse
+    ),
     "TrySchedule": (pb.TryScheduleRequest, pb.TryScheduleResponse),
     "FindNodesToRemove": (pb.FindNodesToRemoveRequest, pb.FindNodesToRemoveResponse),
     "BestOptions": (pb.BestOptionsRequest, pb.BestOptionsResponse),
@@ -244,11 +378,27 @@ def _generic_handler(servicer: TpuSimulationServicer) -> grpc.GenericRpcHandler:
     return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
 
 
-def serve(address: str = "127.0.0.1:0", max_workers: int = 4, residency=None):
-    """→ (server, bound_port). The sidecar process entrypoint."""
+def serve(
+    address: str = "127.0.0.1:0",
+    max_workers: int = 4,
+    residency=None,
+    fleet=None,
+    options=None,
+):
+    """→ (server, bound_port). The sidecar process entrypoint. ``fleet``
+    (a fleet.FleetCoalescer) backs BatchEstimate; when absent and
+    ``options`` (an AutoscalingOptions) is given, one is built from the
+    --fleet-* surface via FleetCoalescer.from_options — buckets, window,
+    batch width, and pre-warm all take effect (``python -m
+    autoscaler_tpu.rpc`` is the flag-parsing launcher). The coalescing
+    window only pays off when max_workers admits concurrent tenants."""
+    if fleet is None and options is not None:
+        from autoscaler_tpu.fleet import FleetCoalescer
+
+        fleet = FleetCoalescer.from_options(options)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers(
-        (_generic_handler(TpuSimulationServicer(residency=residency)),)
+        (_generic_handler(TpuSimulationServicer(residency=residency, fleet=fleet)),)
     )
     port = server.add_insecure_port(address)
     server.start()
@@ -365,6 +515,61 @@ class TpuSimulationClient:
             np.frombuffer(resp.scheduled, np.uint8).reshape(G, -1).astype(bool)
         )
         return counts, scheduled
+
+    def batch_estimate(
+        self,
+        pod_req: np.ndarray,
+        pod_masks: np.ndarray,
+        template_allocs: np.ndarray,
+        group_ids: Sequence[str],
+        node_caps: np.ndarray,
+        max_nodes: int,
+        tenant_id: str = "",
+        prices: Optional[np.ndarray] = None,  # [G] — present = what-if rank
+        extended_resources: Sequence[str] = (),
+        timeout: Optional[float] = None,
+    ):
+        """The fleet path of estimate(): same operands and same return
+        shape (counts [G], scheduled [G, P]) plus a provenance dict
+        (bucket, batch_size, padding_waste, route, best_group, best_cost).
+        The sidecar coalesces concurrent tenants into one sharded mesh
+        dispatch per shape bucket; the answer is byte-identical to the
+        solo route. The deadline must cover the coalescing window
+        (--fleet-coalesce-window-ms) on top of the dispatch."""
+        P, R = pod_req.shape
+        G = len(group_ids)
+        resp = self._call(
+            "BatchEstimate",
+            fleet_pb.BatchEstimateRequest(
+                pods=self._packed_pods(pod_req, extended_resources),
+                pod_masks=np.ascontiguousarray(pod_masks, np.uint8).tobytes(),
+                template_allocs=np.ascontiguousarray(
+                    template_allocs, "<f4"
+                ).tobytes(),
+                group_ids=list(group_ids),
+                node_caps=np.ascontiguousarray(node_caps, "<i4").tobytes(),
+                max_nodes=max_nodes,
+                tenant_id=tenant_id,
+                prices=(
+                    b"" if prices is None
+                    else np.ascontiguousarray(prices, "<f4").tobytes()
+                ),
+            ),
+            timeout=timeout,
+        )
+        counts = np.frombuffer(resp.node_counts, "<i4")
+        scheduled = (
+            np.frombuffer(resp.scheduled, np.uint8).reshape(G, -1).astype(bool)
+        )
+        meta = {
+            "bucket": resp.bucket,
+            "batch_size": int(resp.batch_size),
+            "padding_waste": float(resp.padding_waste),
+            "route": resp.route,
+            "best_group": int(resp.best_group),
+            "best_cost": float(resp.best_cost),
+        }
+        return counts, scheduled, meta
 
     def try_schedule(
         self,

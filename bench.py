@@ -465,7 +465,94 @@ def _explain_ledger_main(path: str) -> int:
     return 1 if errors else 0
 
 
+def _fleet_bench_main(tenants: int = 8) -> int:
+    """``bench.py --fleet [K]``: the BASELINE config-5 mode — K simulated
+    tenants through the coalescing fleet path vs. K sequential per-tenant
+    dispatches, CPU-mesh sized. Reports throughput both ways; the gate is
+    batched >= 2x sequential at >= 4 tenants (ISSUE 8 acceptance). Exit
+    0 = gate met, 1 = missed, 2 = setup failure. hack/verify.sh runs it."""
+    import statistics
+
+    import numpy as np
+
+    from autoscaler_tpu.fleet import FleetCoalescer, FleetRequest
+    from autoscaler_tpu.parallel.mesh import fleet_solo_estimate, make_mesh
+
+    if tenants < 1:
+        print(json.dumps({"metric": "fleet_bench", "error": "tenants < 1"}))
+        return 2
+    # bucket-exact shapes (R=8 fills the bucket's resource axis) so batched
+    # and sequential pay identical per-tenant arithmetic and the measured
+    # difference is dispatch amortization — the thing coalescing exists to
+    # buy: N tenants, one kernel launch instead of N
+    P, G, R, K = 32, 8, 8, tenants
+    rng = np.random.default_rng(42)
+    requests = [
+        FleetRequest(
+            tenant_id=f"bench-{t}",
+            pod_req=rng.integers(1, 100, (P, R)).astype(np.float32),
+            pod_masks=rng.random((G, P)) > 0.2,
+            template_allocs=rng.integers(100, 500, (G, R)).astype(np.float32),
+            node_caps=rng.integers(1, 16, G).astype(np.int32),
+            max_nodes=P,
+        )
+        for t in range(K)
+    ]
+    co = FleetCoalescer(
+        buckets=f"{P}x{G}x{R}", batch_scenarios=max(K, 1), mesh=make_mesh()
+    )
+    co.prewarm()
+
+    def run_batched() -> float:
+        t0 = time.perf_counter()
+        tickets = [co.submit(r) for r in requests]
+        co.flush()
+        for tk in tickets:
+            tk.result(timeout=0.0)
+        return time.perf_counter() - t0
+
+    def run_sequential() -> float:
+        t0 = time.perf_counter()
+        for r in requests:
+            fleet_solo_estimate(
+                r.pod_req, r.pod_masks, r.template_allocs, r.node_caps,
+                r.max_nodes,
+            )
+        return time.perf_counter() - t0
+
+    run_sequential()  # warm the solo kernel's compile cache
+    run_batched()
+    reps = 15
+    seq = statistics.median(run_sequential() for _ in range(reps))
+    bat = statistics.median(run_batched() for _ in range(reps))
+    seq_tput = K / seq if seq > 0 else 0.0
+    bat_tput = K / bat if bat > 0 else 0.0
+    speedup = bat_tput / seq_tput if seq_tput > 0 else 0.0
+    gate = K >= 4 and speedup >= 2.0
+    import jax
+
+    print(json.dumps({
+        "metric": "fleet_batched_vs_sequential",
+        "platform": jax.default_backend(),
+        "tenants": K,
+        "shape": {"pods": P, "groups": G, "resources": R},
+        "sequential_req_per_s": round(seq_tput, 1),
+        "batched_req_per_s": round(bat_tput, 1),
+        "sequential_round_s": round(seq, 5),
+        "batched_round_s": round(bat, 5),
+        "speedup": round(speedup, 2),
+        "unit": "tenant-requests/sec",
+        "gate_2x_at_4_tenants": gate,
+    }, indent=2, sort_keys=True))
+    return 0 if gate else 1
+
+
 def main():
+    if "--fleet" in sys.argv:
+        idx = sys.argv.index("--fleet")
+        arg = sys.argv[idx + 1] if idx + 1 < len(sys.argv) else ""
+        tenants = int(arg) if arg.isdigit() else 8
+        sys.exit(_fleet_bench_main(tenants))
     if "--perf-ledger" in sys.argv:
         idx = sys.argv.index("--perf-ledger")
         if idx + 1 >= len(sys.argv):

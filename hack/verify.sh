@@ -138,6 +138,50 @@ print(f"decision ledger ok ({report['ticks']} ticks, skips={skips})")
 EOF
 rm -rf "$explain_tmp"
 
+echo "== fleet serving determinism + fairness gate (two replays must write byte-identical fleet decision + perf ledgers; every tenant answer byte-identical to solo) =="
+fleet_tmp=$(mktemp -d)
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/fleet_tenants.json \
+    --log "$fleet_tmp/a.fleet.jsonl" --perf-ledger "$fleet_tmp/a.perf.jsonl" \
+    > "$fleet_tmp/a.report.json"
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/fleet_tenants.json \
+    --log "$fleet_tmp/b.fleet.jsonl" --perf-ledger "$fleet_tmp/b.perf.jsonl" >/dev/null
+if ! diff -q "$fleet_tmp/a.fleet.jsonl" "$fleet_tmp/b.fleet.jsonl" >/dev/null; then
+    echo "ERROR: fleet decision ledger is nondeterministic across identical replays:" >&2
+    diff "$fleet_tmp/a.fleet.jsonl" "$fleet_tmp/b.fleet.jsonl" | head -20 >&2
+    exit 1
+fi
+if ! diff -q "$fleet_tmp/a.perf.jsonl" "$fleet_tmp/b.perf.jsonl" >/dev/null; then
+    echo "ERROR: fleet perf ledger is nondeterministic across identical replays:" >&2
+    diff "$fleet_tmp/a.perf.jsonl" "$fleet_tmp/b.perf.jsonl" | head -20 >&2
+    exit 1
+fi
+python bench.py --perf-ledger "$fleet_tmp/a.perf.jsonl" >/dev/null
+python - "$fleet_tmp/a.fleet.jsonl" "$fleet_tmp/a.report.json" <<'EOF'
+import json, sys
+rounds = [json.loads(l) for l in open(sys.argv[1])]
+assert rounds, "empty fleet decision ledger"
+for r in rounds:
+    assert r["schema"] == "autoscaler_tpu.fleet.round/1", r["schema"]
+    for t in r["tenants"]:
+        assert t["match_solo"], (
+            f"tenant {t['tenant']} fleet answer diverged from solo in round "
+            f"{r['tick']} (route {t['route']})"
+        )
+routes = {t["route"] for r in rounds for t in r["tenants"]}
+# the canned scenario injects a batched-rung fault: both rungs must have
+# served, and parity held on BOTH (batch isolation through degradation)
+assert routes == {"fleet_batched", "fleet_oracle"}, routes
+report = json.load(open(sys.argv[2]))
+assert report["parity"]["certified"], report["parity"]
+assert report["fleet"]["prewarmed_buckets"], "no buckets pre-warmed"
+print(f"fleet fairness ok ({len(rounds)} rounds, routes={sorted(routes)})")
+EOF
+rm -rf "$fleet_tmp"
+
+echo "== fleet batched-throughput gate (batched >= 2x sequential at >= 4 tenants) =="
+python bench.py --fleet 8 >/dev/null
+echo "fleet bench gate ok"
+
 echo "== unit tests (8-device virtual CPU mesh) =="
 python -m pytest tests/ -q -x
 
