@@ -25,7 +25,9 @@ from autoscaler_tpu.analysis.rules import (
 __all__ = [
     "ALL_PROGRAM_RULES",
     "ALL_RULES",
+    "DeterminismSanitizer",
     "Finding",
+    "LintCache",
     "RULE_CATALOG",
     "ScanStats",
     "analyze_paths",
@@ -33,4 +35,24 @@ __all__ = [
     "check_source",
     "scan_file",
     "scan_paths",
+    "source_sites",
 ]
+
+
+def __getattr__(name):
+    # lazy: the sanitizer patches stdlib modules on install and the cache
+    # hashes the package sources on construction — neither belongs in the
+    # import path of a plain scan
+    if name == "DeterminismSanitizer":
+        from autoscaler_tpu.analysis.sanitizer import DeterminismSanitizer
+
+        return DeterminismSanitizer
+    if name == "LintCache":
+        from autoscaler_tpu.analysis.cache import LintCache
+
+        return LintCache
+    if name == "source_sites":
+        from autoscaler_tpu.analysis.dataflow import source_sites
+
+        return source_sites
+    raise AttributeError(name)

@@ -141,7 +141,9 @@ def _run(argv: Optional[List[str]] = None) -> int:
             "(GL002), ladder bypass (GL003), lock discipline (GL004), "
             "error boundaries (GL005), jit purity (GL006), kernel "
             "shape/tiling contracts (GL007), lock ordering (GL008), "
-            "flag wiring (GL009). See autoscaler_tpu/analysis/RULES.md."
+            "flag wiring (GL009), taint-flow determinism (GL010), "
+            "thread escape (GL011), surface gating (GL012). "
+            "See autoscaler_tpu/analysis/RULES.md."
         ),
     )
     parser.add_argument(
@@ -173,6 +175,20 @@ def _run(argv: Optional[List[str]] = None) -> int:
         default="text",
         help="output format (json is byte-stable across identical runs)",
     )
+    parser.add_argument(
+        "--cache",
+        action="store_true",
+        help="use the incremental finding cache (.graftlint-cache/): "
+        "per-file findings keyed by content hash, whole-program findings "
+        "keyed by the tree hash; findings are byte-identical with and "
+        "without it (hack/verify.sh diffs both)",
+    )
+    parser.add_argument(
+        "--cache-dir",
+        metavar="DIR",
+        default=".graftlint-cache",
+        help="cache directory for --cache (default: ./.graftlint-cache)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -200,8 +216,13 @@ def _run(argv: Optional[List[str]] = None) -> int:
     # one read per file: `files` is already walked for the empty-check, so
     # feed the sources straight to the scan pipeline instead of re-walking
     sources = {f: Path(f).read_text(encoding="utf-8") for f in files}
+    cache = None
+    if args.cache:
+        from autoscaler_tpu.analysis.cache import LintCache
+
+        cache = LintCache(args.cache_dir)
     findings, stats = analyze_sources(
-        sources, scan_complete=package_scan_complete(files)
+        sources, scan_complete=package_scan_complete(files), cache=cache
     )
 
     baseline_path: Optional[Path] = None

@@ -237,21 +237,33 @@ def parse_pragmas(
     return pragmas, findings
 
 
-def _suppressed(
-    finding: Finding, pragmas: Dict[int, Set[str]], lines: List[str]
+def suppressed_at(
+    line: int,
+    rules: Set[str],
+    pragmas: Dict[int, Set[str]],
+    lines: List[str],
 ) -> bool:
-    """A pragma suppresses findings on its own line, or — when it sits on a
-    comment-only line — on the line directly below (for statements too long
-    to carry an inline comment)."""
-    same = pragmas.get(finding.line)
-    if same and finding.rule in same:
+    """THE pragma-application semantics — one implementation shared by the
+    engine's finding suppression, GL010's source declassification
+    (dataflow.py), and the runtime sanitizer: any of ``rules`` present on
+    the line itself, or on a COMMENT-ONLY line directly above (for
+    statements too long to carry an inline comment; a pragma trailing
+    unrelated code must not leak downward)."""
+    same = pragmas.get(line)
+    if same and same & rules:
         return True
-    above = pragmas.get(finding.line - 1)
-    if above and finding.rule in above:
-        idx = finding.line - 2  # 0-based index of the pragma line
+    above = pragmas.get(line - 1)
+    if above and above & rules:
+        idx = line - 2  # 0-based index of the pragma line
         if 0 <= idx < len(lines) and lines[idx].lstrip().startswith("#"):
             return True
     return False
+
+
+def _suppressed(
+    finding: Finding, pragmas: Dict[int, Set[str]], lines: List[str]
+) -> bool:
+    return suppressed_at(finding.line, {finding.rule}, pragmas, lines)
 
 
 @dataclass
@@ -269,17 +281,46 @@ class ScanStats:
         bucket[rule] = bucket.get(rule, 0) + 1
 
 
+def _apply_suppression(
+    findings: List[Finding],
+    by_path: Dict[str, Tuple[Dict[int, Set[str]], List[str]]],
+    stats: ScanStats,
+) -> Tuple[List[Finding], ScanStats]:
+    # GL000 (pragma hygiene / parse failure) is deliberately unsuppressible:
+    # a reasonless pragma that lists GL000 alongside the rule it silences
+    # must not be able to waive the mandatory-reason contract it violates
+    kept: List[Finding] = []
+    for f in findings:
+        pragmas, lines = by_path.get(f.path, ({}, []))
+        suppressed = f.rule != "GL000" and _suppressed(f, pragmas, lines)
+        stats.note(f.rule, suppressed)
+        if not suppressed:
+            kept.append(f)
+    return sorted(kept, key=Finding.sort_key), stats
+
+
 def analyze_sources(
     sources: Dict[str, str],
     rules: Optional[Sequence] = None,
     program_rules: Optional[Sequence] = None,
     scan_complete: bool = True,
+    cache=None,
 ) -> Tuple[List[Finding], ScanStats]:
     """The one scan pipeline: parse every file once, run the per-file rules,
     build the whole-program call graph, run the program rules, then apply
     suppression pragmas (per finding, against the file it landed in).
     Paths drive rule scoping and need not exist on disk — fixture tests pass
-    virtual ``autoscaler_tpu/...`` paths."""
+    virtual ``autoscaler_tpu/...`` paths.
+
+    ``cache`` (an ``analysis.cache.LintCache``) stores RAW findings keyed
+    by content hash — per file for the per-file rules, per scanned tree
+    for the whole-program rules — so an unchanged tree re-lints without
+    parsing and a one-file edit re-runs only that file plus the cross-file
+    passes. Suppression/sorting run identically on cached and fresh
+    findings (byte-identical output, verified by hack/verify.sh). The
+    cache only applies to the canonical full-rule scan: an explicit
+    ``rules``/``program_rules`` subset bypasses it."""
+    use_cache = cache is not None and rules is None and program_rules is None
     if program_rules is None:
         # an explicit per-file `rules` subset means "only these": program
         # rules then run only when asked for, preserving the pre-whole-
@@ -299,30 +340,71 @@ def analyze_sources(
     findings: List[Finding] = []
     models: List[FileModel] = []
     by_path: Dict[str, Tuple[Dict[int, Set[str]], List[str]]] = {}
+
+    file_keys: Dict[str, str] = {}
+    per_file_cached: Dict[str, Optional[List[Finding]]] = {}
+    program_key = None
+    if use_cache:
+        for path in sorted(sources):
+            file_keys[path] = cache.file_key(display_path(path), sources[path])
+        program_key = cache.program_key(
+            [(display_path(p), k) for p, k in file_keys.items()], scan_complete
+        )
+        per_file_cached = {p: cache.get(k) for p, k in file_keys.items()}
+        program_cached = cache.get(program_key)
+        if program_cached is not None and all(
+            v is not None for v in per_file_cached.values()
+        ):
+            # full-tree hit: no parse at all — pragmas (tokenize only) are
+            # still read fresh so suppression always reflects the sources
+            for path in sorted(sources):
+                source = sources[path]
+                pragmas, pragma_findings = parse_pragmas(source, path)
+                findings.extend(pragma_findings)
+                by_path[display_path(path)] = (pragmas, source.splitlines())
+                findings.extend(per_file_cached[path])
+            findings.extend(program_cached)
+            return _apply_suppression(findings, by_path, stats)
+
     for path in sorted(sources):
         source = sources[path]
         pragmas, pragma_findings = parse_pragmas(source, path)
         findings.extend(pragma_findings)
+        cached = per_file_cached.get(path)
         try:
             model = FileModel(path, source)
         except (SyntaxError, ValueError) as e:
             # ValueError: ast.parse refuses NUL bytes — one corrupt file must
             # degrade to a finding, not abort the whole scan
-            findings.append(
-                Finding(
-                    path=display_path(path),
-                    line=getattr(e, "lineno", None) or 1,
-                    rule="GL000",
-                    message=(
-                        f"file does not parse: {getattr(e, 'msg', None) or e}"
-                    ),
-                )
+            if cached is not None:
+                findings.extend(cached)
+                continue
+            parse_finding = Finding(
+                path=display_path(path),
+                line=getattr(e, "lineno", None) or 1,
+                rule="GL000",
+                message=(
+                    f"file does not parse: {getattr(e, 'msg', None) or e}"
+                ),
             )
+            findings.append(parse_finding)
+            if use_cache:
+                cache.put(file_keys[path], [parse_finding])
             continue
         by_path[model.path] = (pragmas, model.lines)
+        # share the tokenize result with the dataflow pass (GL010 pragma
+        # declassification) — one tokenize per file per scan
+        model.pragma_lines = pragmas
         models.append(model)
+        if cached is not None:
+            findings.extend(cached)
+            continue
+        file_findings: List[Finding] = []
         for rule in rules:
-            findings.extend(rule.check(model))
+            file_findings.extend(rule.check(model))
+        findings.extend(file_findings)
+        if use_cache:
+            cache.put(file_keys[path], file_findings)
 
     if models and program_rules:
         from autoscaler_tpu.analysis.callgraph import CallGraph
@@ -332,20 +414,16 @@ def analyze_sources(
         # partial disk scan: "never read anywhere" cannot be proven when
         # the readers may live outside the scanned subtree
         graph.scan_complete = scan_complete
+        program_findings: List[Finding] = []
         for prule in program_rules:
-            findings.extend(prule.check_program(graph))
+            program_findings.extend(prule.check_program(graph))
+        findings.extend(program_findings)
+        if use_cache and program_key is not None:
+            cache.put(program_key, program_findings)
+    elif use_cache and program_key is not None:
+        cache.put(program_key, [])
 
-    # GL000 (pragma hygiene / parse failure) is deliberately unsuppressible:
-    # a reasonless pragma that lists GL000 alongside the rule it silences
-    # must not be able to waive the mandatory-reason contract it violates
-    kept: List[Finding] = []
-    for f in findings:
-        pragmas, lines = by_path.get(f.path, ({}, []))
-        suppressed = f.rule != "GL000" and _suppressed(f, pragmas, lines)
-        stats.note(f.rule, suppressed)
-        if not suppressed:
-            kept.append(f)
-    return sorted(kept, key=Finding.sort_key), stats
+    return _apply_suppression(findings, by_path, stats)
 
 
 def check_source(

@@ -1,14 +1,16 @@
-"""graftlint rules GL001–GL009 — each derived from an invariant the
+"""graftlint rules GL001–GL012 — each derived from an invariant the
 codebase already claims. See RULES.md (same directory) for the catalog,
 rationale, and suppression etiquette.
 
 Per-file rules (GL001–GL005) are small classes with ``rule_id``, ``title``
 and ``check(model: FileModel) -> list[Finding]``; they walk the one shared
-AST. Whole-program rules (GL006–GL009) implement
+AST. Whole-program rules (GL006–GL012) implement
 ``check_program(graph: CallGraph) -> list[Finding]`` instead and see every
-file at once — GL006 jit purity lives here, the kernel contract checker
-(GL007), lock-order analysis (GL008) and flag wiring (GL009) live in their
-own modules. Nothing here imports beyond the stdlib.
+file at once — GL006 jit purity lives here; the kernel contract checker
+(GL007), lock-order analysis (GL008), flag wiring (GL009), taint-flow
+determinism + surface gating (GL010/GL012, ``dataflow.py``) and
+thread-escape analysis (GL011, ``escape.py``) live in their own modules.
+Nothing here imports beyond the stdlib.
 """
 from __future__ import annotations
 
@@ -18,12 +20,23 @@ from typing import Dict, List, Optional, Sequence, Set
 
 from autoscaler_tpu.analysis.callgraph import MODULE_NODE, CallGraph
 from autoscaler_tpu.analysis.contracts import KernelContractChecker
+from autoscaler_tpu.analysis.dataflow import (
+    ENV_READ,
+    REPLAY_SCOPES,
+    SurfaceGatingChecker,
+    TaintFlowChecker,
+    classify_source_call,
+)
 from autoscaler_tpu.analysis.engine import (
     FileModel,
     Finding,
     is_lock_attr as _is_lock_attr,
     self_attr as _self_attr,
     terminal_name as _terminal_name,
+)
+from autoscaler_tpu.analysis.escape import (
+    GL004_THREADED_SCOPES as THREADED_SCOPES,
+    ThreadEscapeChecker,
 )
 from autoscaler_tpu.analysis.flags import FlagWiringChecker
 from autoscaler_tpu.analysis.lockgraph import LockOrderChecker
@@ -53,45 +66,15 @@ def _enclosing_functions(tree: ast.AST) -> Dict[ast.AST, str]:
 
 # -- GL001: wall clock / ambient randomness in the replay path ----------------
 
-REPLAY_SCOPES = (
-    "core/",
-    "estimator/",
-    "explain/",
-    "fleet/",
-    "loadgen/",
-    "perf/",
-    "trace/",
-    "snapshot/",
-    "clusterstate/",
-    "expander/",
-    "debugging.py",
-)
-
-# fully qualified (import-alias-resolved) callables that read ambient
-# wall-clock or entropy. `time.perf_counter` is deliberately absent: it is
-# the sanctioned wall-measurement clock (tracer wall_s, metrics), never a
+# The banned-call tables (and REPLAY_SCOPES) live in dataflow.py and are
+# imported above: GL001's syntactic check, GL010's taint sources, and the
+# runtime sanitizer's patch set all judge the same calls — static analysis
+# can never drift below what the sanitizer actually traps.
+# `time.perf_counter` is deliberately absent from the tables: it is the
+# sanctioned wall-measurement clock (tracer wall_s, metrics), never a
 # timeline input. A bare *reference* (e.g. `clock: Callable = time.monotonic`
 # as an injectable parameter default) is not a Call and never flags — that
 # IS the sanctioned seam shape.
-_GL001_BANNED = {
-    "time.time",
-    "time.time_ns",
-    "time.monotonic",
-    "time.monotonic_ns",
-    "time.sleep",
-    "datetime.datetime.now",
-    "datetime.datetime.utcnow",
-    "datetime.datetime.today",
-    "datetime.date.today",
-    "os.urandom",
-    "uuid.uuid1",
-    "uuid.uuid4",
-}
-# random.Random(seed) builds an *injectable* generator — allowed; every
-# module-level `random.*` function rides the shared ambient state — banned.
-_RANDOM_OK = {"Random"}
-# numpy: seeded construction allowed, legacy ambient-state functions banned.
-_NP_RANDOM_OK = {"default_rng", "Generator", "SeedSequence", "MT19937", "PCG64", "Philox"}
 
 
 class WallClockInReplayPath:
@@ -112,22 +95,26 @@ class WallClockInReplayPath:
             # named `random`/`time` is an injected seam, not the module
             if not model.is_imported(node.func):
                 continue
-            bad = None
-            if q in _GL001_BANNED:
-                bad = q
-            elif q.startswith("random.") and q.split(".")[1] not in _RANDOM_OK:
-                bad = q
-            elif (
-                q.startswith("numpy.random.")
-                and q.split(".")[2] not in _NP_RANDOM_OK
-            ):
-                bad = q
-            if bad is not None:
+            # the ONE source classifier — shared with GL010's taint
+            # sources and the runtime sanitizer's patch set
+            kind = classify_source_call(q)
+            if kind == ENV_READ:
                 out.append(
                     model.finding(
                         node,
                         self.rule_id,
-                        f"{bad}() in a replay-reachable module breaks "
+                        f"{q}() in a replay-reachable module breaks "
+                        "byte-identical scenario replay; read the "
+                        "environment at startup (config/options) and pass "
+                        "the value in as a parameter",
+                    )
+                )
+            elif kind is not None:
+                out.append(
+                    model.finding(
+                        node,
+                        self.rule_id,
+                        f"{q}() in a replay-reachable module breaks "
                         "byte-identical scenario replay; take a clock/rng "
                         "through an injected parameter or trace.timeline_now()",
                     )
@@ -252,17 +239,9 @@ class LadderBypass:
 
 
 # -- GL004: lock discipline in threaded modules -------------------------------
-
-THREADED_SCOPES = (
-    "explain/",
-    "fleet/",
-    "metrics/",
-    "perf/",
-    "trace/recorder.py",
-    "utils/circuit.py",
-    "kube/client.py",
-)
-
+# THREADED_SCOPES is imported from escape.py (GL004_THREADED_SCOPES): GL011's
+# read-side escape analysis covers the same table, so the two halves of the
+# lock contract can never drift apart.
 
 
 class LockDiscipline:
@@ -581,6 +560,9 @@ ALL_PROGRAM_RULES: Sequence = (
     KernelContractChecker(),
     LockOrderChecker(),
     FlagWiringChecker(),
+    TaintFlowChecker(),
+    ThreadEscapeChecker(),
+    SurfaceGatingChecker(),
 )
 
 RULE_CATALOG = {

@@ -114,7 +114,10 @@ class DebuggingSnapshotter:
 
     def get(self) -> Optional[str]:
         with self._lock:
-            return json.dumps(self._payload, indent=2) if self._payload else None
+            return (
+                json.dumps(self._payload, indent=2, sort_keys=True)
+                if self._payload else None
+            )
 
     @staticmethod
     def dump_tensors(snapshot, path: str) -> List[str]:

@@ -379,7 +379,11 @@ class WatchCache:
         while not self._stop.is_set():
             try:
                 self._relist()
-                for event in self.client.watch(self.path, self._resource_version):
+                with self._lock:
+                    # _relist wrote it under the lock; reading it bare here
+                    # is the GL011 escape shape — hold the lock on both sides
+                    resource_version = self._resource_version
+                for event in self.client.watch(self.path, resource_version):
                     if self._stop.is_set():
                         return
                     obj = event.get("object") or {}

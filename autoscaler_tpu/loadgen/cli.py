@@ -53,6 +53,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                      help="override the spec's seed")
     run.add_argument("--real-sleep", action="store_true",
                      help="actually sleep injected provider latency")
+    run.add_argument("--sanitize", action="store_true",
+                     help="run under the determinism sanitizer "
+                          "(analysis/sanitizer.py): trap ambient "
+                          "wall-clock/rng/environment reads inside "
+                          "replay-scoped frames and exit 1 on any event "
+                          "(hack/verify.sh drives this)")
 
     rep = sub.add_parser("replay", help="re-execute a captured trace")
     rep.add_argument("trace", help="path to a trace JSON file (from run --trace)")
@@ -61,6 +67,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
     rep.add_argument("--chrome-trace", default="")
     rep.add_argument("--perf-ledger", default="")
     rep.add_argument("--explain-ledger", default="")
+    rep.add_argument("--sanitize", action="store_true",
+                     help="run under the determinism sanitizer (see run)")
 
     val = sub.add_parser("validate", help="parse + round-trip a scenario spec")
     val.add_argument("scenario")
@@ -152,6 +160,26 @@ def _run_fleet(spec: ScenarioSpec, report_path: str, log_path: str,
     return 0 if result.all_match() else 1
 
 
+def _sanitized(run_fn) -> int:
+    """Execute ``run_fn`` under the runtime determinism sanitizer: any
+    ambient wall-clock/rng/environment read trapped inside a replay-scoped
+    frame fails the run with the attributed ``file:line`` report — the
+    dynamic half of the GL010 contract (hack/verify.sh gates on it)."""
+    from autoscaler_tpu.analysis.sanitizer import DeterminismSanitizer
+
+    with DeterminismSanitizer() as san:
+        rc = run_fn()
+    if san.events:
+        print(
+            "determinism sanitizer: ambient reads inside replay-scoped "
+            "frames (each would make the replay unreproducible):",
+            file=sys.stderr,
+        )
+        print(san.report(), file=sys.stderr)
+        return 1
+    return rc
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_arg_parser().parse_args(argv)
     try:
@@ -159,11 +187,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             spec = ScenarioSpec.load(args.scenario)
             if args.seed is not None:
                 spec.seed = args.seed
-            return _run(spec, args.report, args.log, args.trace,
-                        real_sleep=args.real_sleep,
-                        chrome_trace_path=args.chrome_trace,
-                        perf_ledger_path=args.perf_ledger,
-                        explain_ledger_path=args.explain_ledger)
+            go = lambda: _run(spec, args.report, args.log, args.trace,
+                              real_sleep=args.real_sleep,
+                              chrome_trace_path=args.chrome_trace,
+                              perf_ledger_path=args.perf_ledger,
+                              explain_ledger_path=args.explain_ledger)
+            return _sanitized(go) if args.sanitize else go()
         if args.command == "replay":
             with open(args.trace) as f:
                 doc = json.load(f)
@@ -174,10 +203,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             from autoscaler_tpu.loadgen.spec import _load_event
 
             spec.events = [_load_event(e) for e in doc["events"]]
-            return _run(spec, args.report, args.log,
-                        chrome_trace_path=args.chrome_trace,
-                        perf_ledger_path=args.perf_ledger,
-                        explain_ledger_path=args.explain_ledger)
+            go = lambda: _run(spec, args.report, args.log,
+                              chrome_trace_path=args.chrome_trace,
+                              perf_ledger_path=args.perf_ledger,
+                              explain_ledger_path=args.explain_ledger)
+            return _sanitized(go) if args.sanitize else go()
         if args.command == "validate":
             spec = ScenarioSpec.load(args.scenario)
             roundtrip = ScenarioSpec.from_json(spec.to_json())

@@ -163,6 +163,11 @@ def summarize(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     dispatch/compile counts, cold (compile) wall vs warm (execute) wall,
     the last utilization sample, and the resident-bytes peak per pool."""
     routes: Dict[str, Dict[str, Any]] = {}
+    # signature sets accumulate OUTSIDE the summary containers (graftlint
+    # GL010): only their order-insensitive count enters the serialized
+    # summary, so a raw set can never leak its hash-seed-dependent
+    # iteration order into a byte-diffed report
+    sigs: Dict[str, Set[str]] = {}
     peaks: Dict[str, int] = {}
     ticks = 0
     for rec in records:
@@ -170,18 +175,18 @@ def summarize(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         for pool, nbytes in rec.get("resident_bytes", {}).items():
             peaks[pool] = max(peaks.get(pool, 0), int(nbytes))
         for d in rec.get("dispatches", ()):
+            route = d.get("route", "?")
             r = routes.setdefault(
-                d.get("route", "?"),
+                route,
                 {
                     "dispatches": 0,
                     "compiles": 0,
                     "compile_s": 0.0,
                     "execute_s": 0.0,
-                    "signatures": set(),
                 },
             )
             r["dispatches"] += 1
-            r["signatures"].add(d.get("sig", ""))
+            sigs.setdefault(route, set()).add(d.get("sig", ""))
             if d.get("cache") == "miss":
                 r["compiles"] += 1
                 r["compile_s"] += float(d.get("dispatch_s", 0.0))
@@ -189,8 +194,8 @@ def summarize(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
                 r["execute_s"] += float(d.get("dispatch_s", 0.0))
             if "utilization" in d:
                 r["utilization"] = d["utilization"]
-    for r in routes.values():
-        r["signatures"] = len(r["signatures"])
+    for route, r in routes.items():
+        r["signatures"] = len(sigs.get(route, ()))
         r["compile_s"] = round(r["compile_s"], 6)
         r["execute_s"] = round(r["execute_s"], 6)
     return {

@@ -8,27 +8,37 @@ cd "$(dirname "$0")/.."
 echo "== python syntax/compile check =="
 python -m compileall -q autoscaler_tpu bench.py __graft_entry__.py
 
-echo "== graftlint (AST invariant gate: determinism, taxonomy, ladder, locks, boundaries, jit purity, kernel contracts, lock order, flag wiring) =="
+echo "== graftlint (AST invariant gate: determinism, taxonomy, ladder, locks, boundaries, jit purity, kernel contracts, lock order, flag wiring, taint flow, thread escape, surface gating) =="
 # Fatal. Exits nonzero on any finding not grandfathered in
 # hack/lint-baseline.json AND on stale baseline entries (a baselined
 # finding that no longer exists must be struck via --update-baseline, so
 # the debt ledger can only shrink). The text run prints the per-rule
-# findings/suppressions/baseline summary table so CI logs show ratchet
-# drift at a glance. Rule catalog: autoscaler_tpu/analysis/RULES.md
+# findings/suppressions/baseline summary table (GL000–GL012) so CI logs
+# show ratchet drift at a glance. The self-scan must stay CLEAN under the
+# dataflow rules — GL010 findings are fixed at the source, never
+# baselined. Rule catalog: autoscaler_tpu/analysis/RULES.md
 python -m autoscaler_tpu.analysis autoscaler_tpu/
 
-echo "== graftlint determinism (two runs must emit byte-identical JSON) =="
+echo "== graftlint determinism + incremental cache parity (three runs must emit byte-identical JSON) =="
 # The analyzer polices replay determinism; it must hold itself to the same
-# bar — finding order stable regardless of dict/set iteration.
+# bar — finding order stable regardless of dict/set iteration — and the
+# --cache path (per-file + whole-program finding cache keyed by content
+# hash) must reproduce the uncached document byte-for-byte, cold and warm.
 lint_tmp=$(mktemp -d)
 python -m autoscaler_tpu.analysis --format=json autoscaler_tpu/ > "$lint_tmp/a.json"
-python -m autoscaler_tpu.analysis --format=json autoscaler_tpu/ > "$lint_tmp/b.json"
+python -m autoscaler_tpu.analysis --format=json --cache --cache-dir "$lint_tmp/cache" autoscaler_tpu/ > "$lint_tmp/b.json"
+python -m autoscaler_tpu.analysis --format=json --cache --cache-dir "$lint_tmp/cache" autoscaler_tpu/ > "$lint_tmp/c.json"
 if ! diff -q "$lint_tmp/a.json" "$lint_tmp/b.json" >/dev/null; then
-    echo "ERROR: graftlint JSON output is nondeterministic across identical runs:" >&2
+    echo "ERROR: graftlint cold --cache output differs from the uncached run:" >&2
     diff "$lint_tmp/a.json" "$lint_tmp/b.json" | head -20 >&2
     exit 1
 fi
-echo "graftlint determinism ok"
+if ! diff -q "$lint_tmp/a.json" "$lint_tmp/c.json" >/dev/null; then
+    echo "ERROR: graftlint warm --cache output differs from the uncached run:" >&2
+    diff "$lint_tmp/a.json" "$lint_tmp/c.json" | head -20 >&2
+    exit 1
+fi
+echo "graftlint determinism + cache parity ok"
 rm -rf "$lint_tmp"
 
 echo "== proto freshness check =="
@@ -102,6 +112,15 @@ assert any("model_flops" in e["args"] for e in dd), \
     "no cost-model attrs on any deviceDispatch span"
 print(f"trace determinism ok ({len(events)} events, {len(dd)} served dispatches)")
 EOF
+
+echo "== runtime determinism sanitizer (replay must trap zero ambient reads) =="
+# the dynamic half of the GL010 contract: the same canned scenario replays
+# under analysis/sanitizer.py (patched clock/rng/env sources, direct-caller
+# frame attribution) and fails on ANY trapped read in a replay-scoped
+# frame — what static resolution might miss cannot fire unnoticed either
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/kernel_fault_ladder.json \
+    --sanitize >/dev/null
+echo "runtime sanitizer ok"
 
 echo "== perf-ledger schema + steady-state-compile regression gate =="
 # validates the JSONL schema, tick monotonicity, and compile-cache

@@ -20,3 +20,25 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+import pytest
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _determinism_sanitizer():
+    """Opt-in runtime determinism monitoring for a whole pytest session:
+    AUTOSCALER_TPU_SANITIZE=1 installs the analysis/sanitizer.py patches,
+    and any ambient wall-clock/rng/environment read trapped inside a
+    replay-scoped frame fails the session teardown with the attributed
+    file:line report (the pytest half of the hack/verify.sh gate)."""
+    if not os.environ.get("AUTOSCALER_TPU_SANITIZE"):
+        yield None
+        return
+    from autoscaler_tpu.analysis.sanitizer import DeterminismSanitizer
+
+    with DeterminismSanitizer() as san:
+        yield san
+    assert not san.events, (
+        "determinism sanitizer trapped ambient reads in replay-scoped "
+        "frames:\n" + san.report()
+    )
+

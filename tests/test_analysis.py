@@ -1580,3 +1580,861 @@ def test_cli_internal_analyzer_error_exits_2(tmp_path, monkeypatch):
 
     monkeypatch.setattr(cli_mod, "analyze_sources", boom)
     assert cli_main([str(root / "autoscaler_tpu"), "--no-baseline"]) == 2
+
+
+# -- GL010 taint-flow determinism ---------------------------------------------
+
+
+def test_gl010_taint_through_assignment_and_container_to_ledger_sink():
+    """The acceptance-criteria shape: a wall-clock value assigned, boxed
+    in a dict, and handed to the record_line choke point — reported with
+    the full source -> sink witness path."""
+    found = findings(
+        """
+        import time
+
+        def emit(ledger):
+            now = time.time()
+            rec = {"ts": now}
+            ledger.write(record_line(rec))
+        """,
+        "autoscaler_tpu/perf/fixture.py",
+    )
+    assert rules_of(found) == ["GL001", "GL010"]
+    taint = found[1]
+    assert taint.line == 7  # the SINK line, not the source line
+    assert "wall-clock at autoscaler_tpu/perf/fixture.py:5" in taint.message
+    assert "record_line() ledger write" in taint.message
+    assert " -> " in taint.message  # the rendered taint path
+
+
+def test_gl010_interprocedural_return_hop_across_modules():
+    """Taint crosses a module boundary through a helper's return; the
+    finding lands at the sink with the call hop witnessed."""
+    found = multi_findings({
+        "autoscaler_tpu/perf/helper.py": """
+            import time
+
+            def stamp():
+                return time.time()
+            """,
+        "autoscaler_tpu/perf/writer.py": """
+            from autoscaler_tpu.perf.helper import stamp
+
+            def emit():
+                rec = {"t": stamp()}
+                return record_line(rec)
+            """,
+    })
+    gl010 = [f for f in found if f.rule == "GL010"]
+    assert [f.path for f in gl010] == ["autoscaler_tpu/perf/writer.py"]
+    msg = gl010[0].message
+    assert "wall-clock at autoscaler_tpu/perf/helper.py:5" in msg
+    assert "return of stamp()" in msg          # the interprocedural hop
+    assert "record_line() ledger write" in msg
+
+
+def test_gl010_interprocedural_param_to_sink_flags_the_caller():
+    """A def that forwards its parameter into record_line is a sink for
+    its callers: passing time.time() at the call site is the violation,
+    and the message names the callee's internal sink."""
+    found = multi_findings({
+        "autoscaler_tpu/perf/sinkmod.py": """
+            def emit(clock_value):
+                return record_line({"t": clock_value})
+            """,
+        "autoscaler_tpu/perf/caller.py": """
+            import time
+            from autoscaler_tpu.perf.sinkmod import emit
+
+            def tick():
+                return emit(time.time())
+            """,
+    })
+    gl010 = [f for f in found if f.rule == "GL010"]
+    assert [f.path for f in gl010] == ["autoscaler_tpu/perf/caller.py"]
+    assert "emit(arg 0)" in gl010[0].message
+    assert "record_line() ledger write" in gl010[0].message
+
+
+def test_gl010_set_iteration_order_flags_sorted_declassifies():
+    """list() over a set realizes hash-seed-dependent order into a ledger
+    line; sorted() is the sanctioned order-insensitive consumption."""
+    found = findings(
+        """
+        def emit(ledger):
+            groups = {"b", "a"}
+            names = list(groups)
+            ledger.write(record_line({"groups": names}))
+
+        def emit_ok(ledger):
+            groups = {"b", "a"}
+            names = sorted(groups)
+            ledger.write(record_line({"groups": names}))
+        """,
+        "autoscaler_tpu/fleet/fixture.py",
+    )
+    assert rules_of(found) == ["GL010"]
+    assert "set-iteration-order" in found[0].message
+
+
+def test_gl010_declassifiers_timeline_now_and_injected_param():
+    """The two sanctioned seams: trace.timeline_now() (replaced by the
+    loadgen synthetic counter) and a value arriving through an injected
+    parameter (unresolvable by design — never guessed at)."""
+    found = findings(
+        """
+        from autoscaler_tpu import trace
+
+        def emit():
+            return record_line({"t": trace.timeline_now()})
+
+        def emit2(clock):
+            return record_line({"t": clock()})
+        """,
+        "autoscaler_tpu/perf/fixture.py",
+    )
+    assert found == []
+
+
+def test_gl010_pragma_on_source_line_declassifies():
+    found = findings(
+        """
+        import time
+
+        def emit():
+            now = time.time()  # graftlint: disable=GL001,GL010 — fixture: value is replay-stable by contract
+            return record_line({"t": now})
+        """,
+        "autoscaler_tpu/perf/fixture.py",
+    )
+    assert found == []
+
+
+def test_gl010_raw_set_in_producer_return_flags_sorted_clean():
+    """The in-tree class this rule landed on (perf/ledger.py summarize):
+    a raw set inside a serialization producer's return is order-unstable;
+    sorted()/len() consumption is clean."""
+    found = findings(
+        """
+        def summarize(records):
+            sigs = set()
+            for r in records:
+                sigs.add(r)
+            return {"sigs": sigs}
+
+        def summarize_ok(records):
+            sigs = set()
+            for r in records:
+                sigs.add(r)
+            return {"sigs": sorted(sigs), "n": len(sigs)}
+        """,
+        "autoscaler_tpu/perf/fixture.py",
+    )
+    assert rules_of(found) == ["GL010"]
+    assert "raw set" in found[0].message
+    assert "summarize()" in found[0].message
+
+
+def test_gl010_fstring_realizes_set_order():
+    found = findings(
+        """
+        def emit():
+            groups = {"b", "a"}
+            return record_line({"label": f"groups={groups}"})
+        """,
+        "autoscaler_tpu/explain/fixture.py",
+    )
+    assert rules_of(found) == ["GL010"]
+    assert "set-iteration-order" in found[0].message
+
+
+def test_gl010_out_of_scope_module_not_flagged():
+    found = findings(
+        """
+        import time
+
+        def emit(ledger):
+            ledger.write(record_line({"t": time.time()}))
+        """,
+        "autoscaler_tpu/kube/fixture.py",  # not a replay scope
+    )
+    assert found == []
+
+
+def test_gl010_branch_taint_survives_set_typeness_does_not():
+    """May/must polarity: taint on ONE branch still reaches the sink
+    (real flow), but a value that is a set on only one branch is never
+    order-flagged (must-intersect — no guessing)."""
+    found = findings(
+        """
+        import time
+
+        def one_branch_taint(flag):
+            t = 0.0
+            if flag:
+                t = time.time()
+            return record_line({"t": t})
+
+        def one_branch_set(ledger, flag):
+            if flag:
+                xs = {1, 2}
+            else:
+                xs = [1, 2]
+            return record_line({"xs": list(xs)})
+        """,
+        "autoscaler_tpu/perf/fixture.py",
+    )
+    assert rules_of(found) == ["GL001", "GL010"]
+    assert "wall-clock" in found[1].message
+
+
+# -- GL011 thread escape ------------------------------------------------------
+
+_ESCAPE_SRC = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def put(self, x):
+            with self._lock:
+                self._items = [x]
+
+        def peek(self):
+            {PEEK}
+"""
+
+
+def test_gl011_unlocked_read_with_locked_write_elsewhere():
+    src = _ESCAPE_SRC.replace("{PEEK}", "return self._items")
+    found = findings(src, "autoscaler_tpu/fleet/fixture.py")
+    assert rules_of(found) == ["GL011"]
+    msg = found[0].message
+    # both witnessing access paths are named
+    assert "Box.peek" in msg and "Box.put" in msg
+    assert "under the lock" in msg
+
+
+def test_gl011_dual_locking_is_clean():
+    src = _ESCAPE_SRC.replace(
+        "{PEEK}", "with self._lock:\n                return self._items"
+    )
+    assert findings(src, "autoscaler_tpu/fleet/fixture.py") == []
+
+
+def test_gl011_confined_to_one_method_is_clean():
+    found = findings(
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                self._n += 1
+                return self._n
+        """,
+        "autoscaler_tpu/perf/fixture.py",
+    )
+    # GL004 still owns the unlocked-write half; no GL011 (confined)
+    assert "GL011" not in rules_of(found)
+
+
+def test_gl011_init_only_write_is_immutable_after_publication():
+    found = findings(
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._capacity = 16
+
+            def a(self):
+                return self._capacity
+
+            def b(self):
+                return self._capacity + 1
+        """,
+        "autoscaler_tpu/fleet/fixture.py",
+    )
+    assert found == []
+
+
+def test_gl011_private_helper_called_under_lock_inherits_protection():
+    found = findings(
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def put(self, x):
+                with self._lock:
+                    self._items = [x]
+                    self._compact()
+
+            def get(self):
+                with self._lock:
+                    return self._find()
+
+            def _compact(self):
+                self._items = list(self._items)
+
+            def _find(self):
+                return self._items
+        """,
+        "autoscaler_tpu/fleet/fixture.py",
+    )
+    # _compact/_find are called ONLY from locked regions: no escape (the
+    # GL004 write check skips *_locked only, so _compact's write is its
+    # finding to make — scope GL011 here)
+    assert "GL011" not in rules_of(found)
+
+
+def test_gl011_public_method_never_inherits_lock():
+    found = findings(
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def put(self, x):
+                with self._lock:
+                    self._items = [x]
+                    self.refresh()
+
+            def refresh(self):
+                return self._items
+        """,
+        "autoscaler_tpu/fleet/fixture.py",
+    )
+    assert "GL011" in rules_of(found)
+
+
+# -- GL012 surface gating + serialization choke -------------------------------
+
+
+def test_gl012_ungated_endpoint_flags_gated_clean():
+    ungated = findings(
+        """
+        class Handler:
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._send(200, "ok")
+                elif self.path.startswith("/tracez"):
+                    self._send(200, self.tracer.list_json())
+        """,
+        "autoscaler_tpu/main.py",
+    )
+    assert rules_of(ungated) == ["GL012"]
+    assert "'/tracez'" in ungated[0].message
+    assert "tracing_enabled" in ungated[0].message
+    gated = findings(
+        """
+        class Handler:
+            def do_GET(self):
+                if self.path.startswith("/tracez"):
+                    if not self.options.tracing_enabled:
+                        self._send(404, "disabled")
+                        return
+                    self._send(200, self.tracer.list_json())
+        """,
+        "autoscaler_tpu/main.py",
+    )
+    assert gated == []
+
+
+def test_gl012_unknown_endpoint_must_be_registered():
+    found = findings(
+        """
+        class Handler:
+            def do_GET(self):
+                if self.path == "/newz":
+                    self._send(200, "hi")
+        """,
+        "autoscaler_tpu/main.py",
+    )
+    assert rules_of(found) == ["GL012"]
+    assert "not a known surface" in found[0].message
+
+
+def test_gl012_adhoc_json_dumps_needs_sort_keys():
+    found = findings(
+        """
+        import json
+
+        def dump(doc):
+            return json.dumps(doc, indent=2)
+
+        def dump_ok(doc):
+            return json.dumps(doc, indent=2, sort_keys=True)
+        """,
+        "autoscaler_tpu/loadgen/fixture.py",
+    )
+    assert rules_of(found) == ["GL012"]
+    assert "sort_keys=True" in found[0].message
+    # out of replay scope: not this rule's business
+    assert findings(
+        """
+        import json
+
+        def dump(doc):
+            return json.dumps(doc)
+        """,
+        "autoscaler_tpu/vpa/fixture.py",
+    ) == []
+
+
+# -- seeded-violation CLI exit codes for the new rules ------------------------
+
+
+def _seeded_repo(tmp_path: Path) -> Path:
+    pkg = tmp_path / "autoscaler_tpu"
+    (pkg / "perf").mkdir(parents=True)
+    (pkg / "fleet").mkdir()
+    (pkg / "perf" / "taint.py").write_text(textwrap.dedent("""
+        import time
+
+        def emit(ledger):
+            now = time.time()
+            ledger.write(record_line({"t": now}))
+        """))
+    (pkg / "fleet" / "escape.py").write_text(textwrap.dedent("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def put(self, x):
+                with self._lock:
+                    self._items = [x]
+
+            def peek(self):
+                return self._items
+        """))
+    (pkg / "main.py").write_text(textwrap.dedent("""
+        class Handler:
+            def do_GET(self):
+                if self.path.startswith("/tracez"):
+                    self._send(200, "trace")
+        """))
+    return tmp_path
+
+
+def test_cli_seeded_violations_for_new_rules_exit_1(tmp_path, capsys):
+    root = _seeded_repo(tmp_path)
+    rc = cli_main(
+        [str(root / "autoscaler_tpu"), "--no-baseline", "--format=json"]
+    )
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    rules = {f["rule"] for f in doc["findings"]}
+    assert {"GL010", "GL011", "GL012"} <= rules
+    assert {"GL010", "GL011", "GL012"} <= set(doc["summary"])
+
+
+def test_cli_github_format_renders_taint_path(tmp_path, capsys):
+    root = _seeded_repo(tmp_path)
+    rc = cli_main(
+        [str(root / "autoscaler_tpu"), "--no-baseline", "--format=github"]
+    )
+    assert rc == 1
+    out = capsys.readouterr().out
+    gl010 = [l for l in out.splitlines() if "graftlint GL010" in l]
+    assert gl010, out
+    # the annotation carries the rendered source -> sink path
+    assert "wall-clock at autoscaler_tpu/perf/taint.py" in gl010[0]
+    assert " -> " in gl010[0]
+
+
+# -- incremental cache --------------------------------------------------------
+
+
+def test_cache_byte_identical_and_invalidation(tmp_path, capsys):
+    """--cache must reproduce the uncached JSON byte-for-byte (cold AND
+    warm), and a content change must invalidate the stale entries."""
+    root = _seeded_repo(tmp_path)
+    scan = str(root / "autoscaler_tpu")
+    cache_dir = str(tmp_path / ".graftlint-cache")
+
+    cli_main([scan, "--no-baseline", "--format=json"])
+    uncached = capsys.readouterr().out
+    cli_main([scan, "--no-baseline", "--format=json",
+              "--cache", "--cache-dir", cache_dir])
+    cold = capsys.readouterr().out
+    cli_main([scan, "--no-baseline", "--format=json",
+              "--cache", "--cache-dir", cache_dir])
+    warm = capsys.readouterr().out
+    assert uncached == cold == warm
+    # entries live under a per-salt generation directory (stale
+    # generations are pruned on analyzer change)
+    assert Path(cache_dir).is_dir() and list(Path(cache_dir).glob("*/*.json"))
+
+    # fix the taint violation: the cached findings must not resurrect it
+    (root / "autoscaler_tpu" / "perf" / "taint.py").write_text(
+        "def emit(ledger, now):\n"
+        "    ledger.write(record_line({\"t\": now}))\n"
+    )
+    cli_main([scan, "--no-baseline", "--format=json"])
+    fresh = capsys.readouterr().out
+    cli_main([scan, "--no-baseline", "--format=json",
+              "--cache", "--cache-dir", cache_dir])
+    cached = capsys.readouterr().out
+    assert fresh == cached
+    assert "GL010" not in {
+        f["rule"] for f in json.loads(fresh)["findings"]
+    }
+
+
+def test_cache_bypassed_for_explicit_rule_subsets(tmp_path):
+    """analyze_sources with an explicit rules list must ignore the cache
+    entirely — only the canonical full-rule scan is cacheable."""
+    from autoscaler_tpu.analysis import rules as rules_mod
+    from autoscaler_tpu.analysis.cache import LintCache
+
+    cache = LintCache(str(tmp_path / "c"))
+    sources = {"autoscaler_tpu/loadgen/bad.py": _VIOLATION}
+    found, _ = analyze_sources(
+        sources, rules=[rules_mod.WallClockInReplayPath()], cache=cache
+    )
+    assert rules_of(found) == ["GL001"]
+    assert not (tmp_path / "c").exists()  # nothing written
+
+
+def test_no_baseline_entries_for_dataflow_rules():
+    """Acceptance: GL010–GL012 findings were fixed, never baselined. Zero
+    ledger entries for them — combined with
+    test_repo_scans_clean_with_shipped_baseline (which fails on any
+    non-baselined finding), this proves the repo self-scan is clean under
+    the dataflow rules without paying a second full-tree scan here."""
+    baseline = json.loads((REPO / "hack" / "lint-baseline.json").read_text())
+    assert not [
+        e for e in baseline["findings"]
+        if e["rule"] in ("GL010", "GL011", "GL012")
+    ]
+
+
+def test_gl010_bound_method_call_param_mapping():
+    """`self.meth(a, b)` passes its receiver implicitly: summary param
+    indices must shift by one at bound call sites — a tainted arg that
+    never sinks must not flag, the one that sinks must."""
+    found = findings(
+        """
+        import time
+
+        class W:
+            def f(self, a, b):
+                return record_line({"a": a})
+
+            def good(self):
+                return self.f(0.0, time.time())
+
+            def bad(self):
+                return self.f(time.time(), 0.0)
+        """,
+        "autoscaler_tpu/perf/fixture.py",
+    )
+    gl010 = [f for f in found if f.rule == "GL010"]
+    assert [f.line for f in gl010] == [12]  # only bad()'s call site
+    assert "f(arg 0)" in gl010[0].message
+
+
+def test_gl011_mutator_call_counts_as_write():
+    """`self._items.append(x)` writes through the field (GL004 cannot see
+    method-call mutation — GL011 must): locked-append writer + bare
+    reader is the canonical escape."""
+    found = findings(
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def put(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def peek(self):
+                return self._items[0]
+        """,
+        "autoscaler_tpu/fleet/fixture.py",
+    )
+    assert rules_of(found) == ["GL011"]
+    assert "Box.peek" in found[0].message and "Box.put" in found[0].message
+
+
+def test_gl010_pragma_above_must_be_comment_only_and_no_shadowing():
+    """Dataflow pragma semantics match engine._suppressed: a GL010 pragma
+    on a comment-only line above declassifies even when the source line
+    carries a different rule's pragma; a pragma trailing unrelated CODE
+    on the line above does not leak downward."""
+    declassified = findings(
+        """
+        import time
+
+        def emit():
+            # graftlint: disable=GL010 — fixture: value is replay-stable by contract
+            now = time.time()  # graftlint: disable=GL001 — fixture: sanctioned seam
+            return record_line({"t": now})
+        """,
+        "autoscaler_tpu/perf/fixture.py",
+    )
+    assert declassified == []
+    leaking = findings(
+        """
+        import time
+
+        def emit():
+            x = 1  # graftlint: disable=GL010 — fixture: pragma trails unrelated code
+            now = time.time()  # graftlint: disable=GL001 — fixture: sanctioned seam
+            return record_line({"t": now})
+        """,
+        "autoscaler_tpu/perf/fixture.py",
+    )
+    assert "GL010" in rules_of(leaking)  # the code-line pragma must not leak
+
+
+def test_gl012_compound_path_test_checks_every_endpoint():
+    found = findings(
+        """
+        class Handler:
+            def do_GET(self):
+                if self.path in ("/health-check", "/perfz"):
+                    self._send(200, "ok")
+        """,
+        "autoscaler_tpu/main.py",
+    )
+    assert rules_of(found) == ["GL012"]
+    assert "'/perfz'" in found[0].message and "perf_enabled" in found[0].message
+
+
+def test_gl012_path_boundary_not_bare_prefix():
+    """'/statusz' must not inherit '/status''s ungated standing; a real
+    sub-path ('/debug/pprof/heap' under the gated '/debug/pprof') still
+    maps to its parent's gate."""
+    found = findings(
+        """
+        class Handler:
+            def do_GET(self):
+                if self.path == "/statusz":
+                    self._send(200, "zz")
+        """,
+        "autoscaler_tpu/main.py",
+    )
+    assert rules_of(found) == ["GL012"]
+    assert "not a known surface" in found[0].message
+    gated_subpath = findings(
+        """
+        class Handler:
+            def do_GET(self):
+                if self.path == "/debug/pprof/heap":
+                    if not profiling:
+                        self._send(404, "off")
+                        return
+                    self._send(200, "heap")
+        """,
+        "autoscaler_tpu/main.py",
+    )
+    assert gated_subpath == []
+
+
+def test_gl010_comprehension_targets_do_not_leak():
+    """Comprehension variables neither clobber an outer clean binding
+    (false positive) nor erase an outer tainted one (false negative)."""
+    clean_outer = findings(
+        """
+        def emit():
+            n = 0
+            total = sum(n for n in {"a", "b"})
+            return record_line({"n": n, "total": total})
+        """,
+        "autoscaler_tpu/perf/fixture.py",
+    )
+    assert clean_outer == []
+    tainted_outer = findings(
+        """
+        import time
+
+        def emit(items):
+            x = time.time()
+            ys = [x for x in items]
+            return record_line({"t": x})
+        """,
+        "autoscaler_tpu/perf/fixture.py",
+    )
+    assert "GL010" in rules_of(tainted_outer)
+
+
+def test_gl010_value_exposing_reductions_keep_taint_len_does_not():
+    """max/min/sum expose the element values — max() of wall-clock stamps
+    IS the wall-clock; len() is a pure count and stays clean."""
+    exposed = findings(
+        """
+        import time
+
+        def emit():
+            ts = [time.time()]
+            return record_line({"m": max(ts)})
+        """,
+        "autoscaler_tpu/perf/fixture.py",
+    )
+    assert "GL010" in rules_of(exposed)
+    counted = findings(
+        """
+        import time
+
+        def emit():
+            ts = [time.time()]
+            return record_line({"n": len(ts)})
+        """,
+        "autoscaler_tpu/perf/fixture.py",
+    )
+    assert "GL010" not in rules_of(counted)
+
+
+def test_gl010_keyword_argument_flows_into_param_sink():
+    found = multi_findings({
+        "autoscaler_tpu/perf/sinkmod2.py": """
+            def emit(v):
+                return record_line({"t": v})
+            """,
+        "autoscaler_tpu/perf/caller2.py": """
+            import time
+            from autoscaler_tpu.perf.sinkmod2 import emit
+
+            def tick():
+                return emit(v=time.time())
+            """,
+    })
+    gl010 = [f for f in found if f.rule == "GL010"]
+    assert [f.path for f in gl010] == ["autoscaler_tpu/perf/caller2.py"]
+
+
+def test_gl010_for_loop_set_source_is_scope_gated():
+    """for-over-set outside replay scopes is not a source — equivalent
+    spellings (loop vs comprehension vs list()) get equivalent verdicts."""
+    found = findings(
+        """
+        def collect():
+            out = []
+            for x in {"a", "b"}:
+                out.append(x)
+            return out
+        """,
+        "autoscaler_tpu/kube/fixture.py",
+    )
+    assert found == []
+
+
+def test_gl010_self_receiver_is_a_bound_method_not_a_container():
+    """`self.update(...)` must resolve through the method's summary: no
+    container-absorption false positive on `self`, and a method NAMED
+    like a container mutator still gets its param->sink applied."""
+    no_fp = findings(
+        """
+        import time
+
+        class W:
+            def tick(self):
+                self.update(time.time())
+                return record_line({"n": self._count})
+
+            def update(self, t):
+                self._count = 1
+        """,
+        "autoscaler_tpu/perf/fixture.py",
+    )
+    assert "GL010" not in rules_of(no_fp)
+    no_fn = findings(
+        """
+        import time
+
+        class W:
+            def tick(self):
+                return self.update(time.time())
+
+            def update(self, rec):
+                return record_line({"r": rec})
+        """,
+        "autoscaler_tpu/perf/fixture.py",
+    )
+    assert "GL010" in rules_of(no_fn)
+
+
+def test_gl001_env_read_has_env_specific_guidance():
+    found = findings(
+        """
+        import os
+
+        def probe():
+            return os.getenv("X")
+        """,
+        "autoscaler_tpu/perf/fixture.py",
+    )
+    assert rules_of(found) == ["GL001"]
+    assert "startup" in found[0].message  # not the clock/rng seam advice
+
+
+def test_gl010_for_loop_pragma_declassifies_order_not_element_taint():
+    """A GL010 pragma above `for t in s:` sanctions the iteration ORDER;
+    wall-clock taint carried by the set's elements still flows."""
+    found = multi_findings({
+        "autoscaler_tpu/perf/src3.py": """
+            import time
+
+            def stamps():
+                return {time.time()}
+            """,
+        "autoscaler_tpu/perf/wr3.py": """
+            from autoscaler_tpu.perf.src3 import stamps
+
+            def emit():
+                s = stamps()
+                # graftlint: disable=GL010 — fixture: iteration order sanctioned
+                for t in s:
+                    record_line({"t": t})
+            """,
+    })
+    gl010 = [f for f in found if f.rule == "GL010"]
+    assert gl010 and all("wall-clock" in f.message for f in gl010), gl010
+
+
+def test_gl010_ordering_builtins_scope_gated_like_siblings():
+    """list()/tuple() over a set outside replay scopes is not a source —
+    consistent with the for-loop/comprehension/f-string spellings."""
+    found = findings(
+        """
+        def expand():
+            xs = {1, 2}
+            return list(xs)
+        """,
+        "autoscaler_tpu/kube/fixture.py",
+    )
+    assert found == []
+
+
+def test_cache_prunes_stale_generations(tmp_path):
+    from autoscaler_tpu.analysis.cache import LintCache
+
+    stale = tmp_path / "deadbeef00000000"
+    stale.mkdir()
+    (stale / "x.json").write_text("{}")
+    c = LintCache(str(tmp_path))
+    c.put(c.file_key("a.py", "x = 1\n"), [])
+    assert not stale.exists()
+    assert (tmp_path / c.salt[:16]).is_dir()

@@ -321,7 +321,39 @@ class TestLedger:
         xs = agg["routes"]["xla_scan"]
         assert xs["compiles"] == 1 and xs["dispatches"] == 2
         assert xs["compile_s"] == 0.5 and xs["execute_s"] == 0.001
+        assert xs["signatures"] == 1  # both xla_scan dispatches share one sig
         assert agg["resident_bytes_peak"]["snapshot"] == 720
+
+    def test_summarize_byte_stable_across_hash_seeds(self):
+        """GL010 regression lock: the signature sets summarize accumulates
+        must never leak iteration order into the serialized summary —
+        the JSON must be byte-identical under different PYTHONHASHSEEDs
+        (set iteration order over strings varies per process)."""
+        import os
+        from pathlib import Path
+
+        prog = (
+            "import json\n"
+            "from autoscaler_tpu.perf.ledger import summarize\n"
+            "recs = [{'tick': t, 'resident_bytes': {},\n"
+            "         'dispatches': [\n"
+            "             {'route': 'xla_scan', 'sig': f'sig{i}',\n"
+            "              'cache': 'hit', 'dispatch_s': 0.001}\n"
+            "             for i in range(12)]}\n"
+            "        for t in range(3)]\n"
+            "print(json.dumps(summarize(recs), sort_keys=True))\n"
+        )
+        outs = set()
+        for seed in ("0", "1", "4242"):
+            env = dict(os.environ, PYTHONHASHSEED=seed, JAX_PLATFORMS="cpu")
+            proc = subprocess.run(
+                [sys.executable, "-c", prog],
+                capture_output=True, text=True, env=env,
+                cwd=str(Path(__file__).resolve().parent.parent),
+            )
+            assert proc.returncode == 0, proc.stderr
+            outs.add(proc.stdout)
+        assert len(outs) == 1, f"summary bytes vary with hash seed: {outs}"
 
 
 # ------------------------------------------------------------- observatory
