@@ -62,6 +62,7 @@ GL004_THREADED_SCOPES = (
     "fleet/",
     "metrics/",
     "perf/",
+    "snapshot/arena.py",
     "trace/recorder.py",
     "utils/circuit.py",
     "kube/client.py",

@@ -12,7 +12,10 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from autoscaler_tpu.fleet.buckets import DEFAULT_BUCKETS as _DEFAULT_FLEET_BUCKETS
+from autoscaler_tpu.fleet.buckets import (
+    DEFAULT_ARENA_BUCKETS as _DEFAULT_ARENA_BUCKETS,
+    DEFAULT_BUCKETS as _DEFAULT_FLEET_BUCKETS,
+)
 
 
 @dataclass
@@ -108,6 +111,21 @@ class AutoscalingOptions:
     explain_enabled: bool = True
     # how many recent per-tick decision records the in-memory ring keeps
     explain_ring_size: int = 64
+
+    # -- resident device arena (autoscaler_tpu/snapshot/arena) ---------------
+    # keep the packed snapshot tensors device-resident across ticks and ship
+    # only delta scatters for dirtied rows (ROADMAP item 2); off = the cold
+    # per-field re-upload path
+    arena_enabled: bool = False
+    # comma-separated PxNxR power-of-two prewarm buckets for the arena's
+    # apply-kernel ladder (same grammar as the fleet buckets; R is a cap).
+    # The default ladder lives with fleet/buckets.py — ONE source.
+    arena_buckets: str = _DEFAULT_ARENA_BUCKETS
+    # persistent XLA compilation cache directory ("" = disabled): together
+    # with the arena prewarm this makes the first real tick compile-free
+    # across process restarts (ROADMAP item 5); main.py applies it before
+    # backend init, deploy/ mounts a volume for it
+    compile_cache_dir: str = ""
 
     # -- fleet serving (autoscaler_tpu/fleet) --------------------------------
     # how long the coalescer waits after the first queued request before

@@ -76,6 +76,7 @@ class ScaleUpOrchestrator:
         metrics=None,
         priorities_fetch=None,
         observatory=None,  # perf.PerfObservatory, threaded to the estimator
+        operand_arena=None,  # snapshot/arena.OperandArena, ditto
     ):
         from autoscaler_tpu.expander.core import build_strategy
 
@@ -97,6 +98,7 @@ class ScaleUpOrchestrator:
                     cooldown_s=options.kernel_breaker_cooldown_s,
                 ),
                 observatory=observatory,
+                operand_arena=operand_arena,
             )
         self.estimator = estimator
         self.expander = expander or build_strategy(

@@ -105,11 +105,21 @@ class StaticAutoscaler:
         # still get a strictly increasing id or the ledger's monotonicity
         # gate trips on a pile of tick-0 records
         self._next_perf_tick = 0
+        # content-addressed resident operand cache (snapshot/arena): the
+        # estimator's dispatch arrays (requests/masks/allocs) are byte-
+        # identical tick over tick in steady state — a hit re-dispatches
+        # against the resident device handles instead of re-uploading
+        self._operand_arena = None
+        if self.options.arena_enabled:
+            from autoscaler_tpu.snapshot.arena import OperandArena
+
+            self._operand_arena = OperandArena()
         self.scale_up_orchestrator = scale_up_orchestrator or ScaleUpOrchestrator(
             provider,
             self.options,
             self.csr,
             observatory=self.observatory,
+            operand_arena=self._operand_arena,
             balancing_processor=self.processors.node_group_set,
             template_provider=self.processors.template_node_info_provider,
             node_group_list_processor=self.processors.node_group_list,
@@ -156,10 +166,31 @@ class StaticAutoscaler:
         self._initialized = False
         # Packed tensors persist across loops: each loop's fresh snapshot
         # shares this packer, so tensors() costs O(listing delta), not
-        # O(world) — the DeltaClusterSnapshot intent (delta.go:26-42)
+        # O(world) — the DeltaClusterSnapshot intent (delta.go:26-42).
+        # With --arena-enabled the tensors additionally stay DEVICE-
+        # resident: the packer emits delta programs (row scatters) against
+        # a double-buffered donated arena instead of re-uploading dense
+        # tensors, and a startup bucket-ladder prewarm plus the persistent
+        # compile cache make the first real tick compile-free (ROADMAP
+        # items 2 + 5).
         from autoscaler_tpu.snapshot.incremental import IncrementalPacker
 
-        self._packer = IncrementalPacker()
+        self._arena = None
+        if self.options.arena_enabled:
+            from autoscaler_tpu.kube.objects import NUM_RESOURCES
+            from autoscaler_tpu.snapshot.arena import DeviceArena
+
+            self._arena = DeviceArena(
+                buckets=self.options.arena_buckets,
+                observatory=self.observatory,
+                metrics=self.metrics,
+                # the tracer's timeline clock (synthetic under loadgen) so
+                # prewarm walls — recorded before any tick trace exists —
+                # replay byte-identically like every other perf figure
+                clock=self.tracer.clock,
+            )
+            self._arena.prewarm(R=NUM_RESOURCES)
+        self._packer = IncrementalPacker(arena=self._arena)
 
     # -- one reconcile iteration (reference :288) ----------------------------
     def run_once(self, now_ts: float) -> RunOnceResult:
@@ -194,12 +225,29 @@ class StaticAutoscaler:
                 # finalize even when the tick crashed (the crash-only loop
                 # catches outside): the ledgers stay gap-free, and the
                 # residency snapshot reflects whatever the tick left live
-                with trace.span(metrics_mod.PERF_RECORD):
-                    from autoscaler_tpu.perf import POOL_SNAPSHOT
+                with trace.span(metrics_mod.PERF_RECORD) as sp_perf:
+                    from autoscaler_tpu.perf import POOL_ARENA, POOL_SNAPSHOT
 
                     self.observatory.residency.set(
                         POOL_SNAPSHOT, "packer", self._packer.device_bytes()
                     )
+                    if self._arena is not None:
+                        self.observatory.residency.set(
+                            POOL_ARENA, "snapshot", self._arena.device_bytes()
+                        )
+                        if self._operand_arena is not None:
+                            self.observatory.residency.set(
+                                POOL_ARENA, "operands",
+                                self._operand_arena.device_bytes(),
+                            )
+                        stats = self._arena.take_stats()
+                        self.observatory.note_arena(stats)
+                        sp_perf.set_attrs(
+                            arena_delta_rows=stats.get("delta_rows", 0),
+                            arena_full_uploads=stats.get("full_uploads", 0),
+                            arena_promotions=stats.get("promotions", 0),
+                            arena_rollbacks=stats.get("rollbacks", 0),
+                        )
                     self.observatory.end_tick()
                 # a crashed tick leaves a PARTIAL decision record — the
                 # sections noted before the crash are exactly the

@@ -140,21 +140,23 @@ def template_mask(
     return mask
 
 
-def _spread_tuple(sp: SpreadTermTensors):
+def _spread_tuple(sp: SpreadTermTensors, conv=jnp.asarray):
     """SpreadTermTensors → the kernel's 11-array tuple (pod-axis tensors
-    transposed to [P, S] for per-step gathers)."""
+    transposed to [P, S] for per-step gathers). ``conv`` is the device-
+    residence function — OperandArena.resident when the estimator has an
+    operand arena, so unchanged spread terms stay device-resident."""
     return (
-        jnp.asarray(sp.sp_of.T),
-        jnp.asarray(sp.sp_match.T),
-        jnp.asarray(sp.node_level),
-        jnp.asarray(sp.max_skew),
-        jnp.asarray(sp.min_domains),
-        jnp.asarray(sp.has_label),
-        jnp.asarray(sp.static_count),
-        jnp.asarray(sp.min_others),
-        jnp.asarray(sp.static_min),
-        jnp.asarray(sp.static_domnum),
-        jnp.asarray(sp.force_zero),
+        conv(np.ascontiguousarray(sp.sp_of.T)),
+        conv(np.ascontiguousarray(sp.sp_match.T)),
+        conv(sp.node_level),
+        conv(sp.max_skew),
+        conv(sp.min_domains),
+        conv(sp.has_label),
+        conv(sp.static_count),
+        conv(sp.min_others),
+        conv(sp.static_min),
+        conv(sp.static_domnum),
+        conv(sp.force_zero),
     )
 
 
@@ -223,11 +225,17 @@ class BinpackingNodeEstimator:
         metrics=None,    # AutoscalerMetrics; None = no recording
         ladder: Optional[KernelLadder] = None,  # circuit-broken rung state
         observatory=None,  # perf.PerfObservatory; None = no perf telemetry
+        operand_arena=None,  # snapshot/arena.OperandArena; None = cold uploads
     ):
         self.limiter = limiter or ThresholdBasedEstimationLimiter()
         self.metrics = metrics
         self.ladder = ladder or KernelLadder()
         self.ladder.bind_metrics(metrics)
+        # content-addressed resident operand cache (--arena-enabled): the
+        # packed dispatch arrays are byte-identical tick over tick in
+        # steady state, and a hit hands back the RESIDENT device array
+        # instead of re-paying the host→device transfer
+        self.operand_arena = operand_arena
         # perf observatory (autoscaler_tpu/perf): per-(route, shape
         # signature) compile telemetry, the XLA cost ledger, and operand
         # residency. It owns the compile-vs-execute span attribution —
@@ -315,17 +323,17 @@ class BinpackingNodeEstimator:
 
             def xla_fn():
                 res = ffd_binpack_groups_affinity(
-                    jnp.asarray(req),
-                    jnp.asarray(mask[None, :]),
-                    jnp.asarray(alloc[None, :]),
+                    self._dev(req),
+                    self._dev(mask[None, :]),
+                    self._dev(alloc[None, :]),
                     max_nodes=bucket_size(cap, minimum=8),
-                    match=jnp.asarray(terms.match),
-                    aff_of=jnp.asarray(terms.aff_of),
-                    anti_of=jnp.asarray(terms.anti_of),
-                    node_level=jnp.asarray(terms.node_level),
-                    has_label=jnp.asarray(terms.has_label),
-                    node_caps=jnp.asarray(np.array([cap], np.int32)),
-                    spread=_spread_tuple(sp),
+                    match=self._dev(terms.match),
+                    aff_of=self._dev(terms.aff_of),
+                    anti_of=self._dev(terms.anti_of),
+                    node_level=self._dev(terms.node_level),
+                    has_label=self._dev(terms.has_label),
+                    node_caps=self._dev(np.array([cap], np.int32)),
+                    spread=_spread_tuple(sp, conv=self._dev),
                 )
                 return (
                     int(np.asarray(res.node_count)[0]),
@@ -351,9 +359,9 @@ class BinpackingNodeEstimator:
         else:
             def xla_fn():
                 r = ffd_binpack(
-                    jnp.asarray(req),
-                    jnp.asarray(mask),
-                    jnp.asarray(alloc),
+                    self._dev(req),
+                    self._dev(mask),
+                    self._dev(alloc),
                     max_nodes=bucket_size(cap, minimum=8),
                     node_cap=jnp.int32(cap),
                 )
@@ -409,9 +417,21 @@ class BinpackingNodeEstimator:
             metrics_mod.ESTIMATE, metrics=self.metrics,
             pods=len(pods), groups=len(templates),
         ) as sp_est:
+            oa_before = (
+                self.operand_arena.stats()
+                if self.operand_arena is not None else None
+            )
             result = self._estimate_many_inner(
                 pods, templates, headrooms, pod_groups, cluster
             )
+            if oa_before is not None:
+                # resident-operand reuse rides the estimate span: a
+                # steady-state dispatch shows hits == operands, misses == 0
+                oa_after = self.operand_arena.stats()
+                sp_est.set_attrs(
+                    operand_hits=oa_after["hits"] - oa_before["hits"],
+                    operand_misses=oa_after["misses"] - oa_before["misses"],
+                )
             # constraint attribution rides the estimate span: the reasons
             # are part of the estimation verdict, and the span attrs make
             # "what dominated the rejections" readable straight off /tracez
@@ -439,6 +459,14 @@ class BinpackingNodeEstimator:
                 elapsed, len(templates), budget,
             )
         return result
+
+    def _dev(self, arr) -> jax.Array:
+        """Device residence for one packed operand array: the operand
+        arena when attached (content-keyed steady-state reuse), else a
+        plain upload."""
+        if self.operand_arena is not None:
+            return self.operand_arena.resident(arr)
+        return jnp.asarray(arr)
 
     def _note_route(self, route: str, reason: str, detail: str = "") -> None:
         """Record which kernel served a dispatch (metric always; one log
@@ -630,17 +658,17 @@ class BinpackingNodeEstimator:
 
             def xla_aff_fn():
                 return assemble(ffd_binpack_groups_affinity(
-                    jnp.asarray(req),
-                    jnp.asarray(masks),
-                    jnp.asarray(allocs),
+                    self._dev(req),
+                    self._dev(masks),
+                    self._dev(allocs),
                     max_nodes=scan_cap,
-                    spread=_spread_tuple(sp),
-                    match=jnp.asarray(terms.match),
-                    aff_of=jnp.asarray(terms.aff_of),
-                    anti_of=jnp.asarray(terms.anti_of),
-                    node_level=jnp.asarray(terms.node_level),
-                    has_label=jnp.asarray(terms.has_label),
-                    node_caps=jnp.asarray(caps),
+                    spread=_spread_tuple(sp, conv=self._dev),
+                    match=self._dev(terms.match),
+                    aff_of=self._dev(terms.aff_of),
+                    anti_of=self._dev(terms.anti_of),
+                    node_level=self._dev(terms.node_level),
+                    has_label=self._dev(terms.has_label),
+                    node_caps=self._dev(caps),
                 ))
 
             return self._walk_ladder([
@@ -691,11 +719,11 @@ class BinpackingNodeEstimator:
 
             def xla_plain_fn():
                 return assemble(ffd_binpack_groups(
-                    jnp.asarray(req),
-                    jnp.asarray(masks),
-                    jnp.asarray(allocs),
+                    self._dev(req),
+                    self._dev(masks),
+                    self._dev(allocs),
                     max_nodes=scan_cap,
-                    node_caps=jnp.asarray(caps),
+                    node_caps=self._dev(caps),
                 ))
 
             return self._walk_ladder([
@@ -1236,20 +1264,20 @@ class BinpackingNodeEstimator:
                 sp_of=sp_to_runs(group_spread.sp_of),
                 sp_match=sp_to_runs(group_spread.sp_match),
             )
-            spread_arg = _spread_tuple(run_sp)
+            spread_arg = _spread_tuple(run_sp, conv=self._dev)
         res = ffd_binpack_groups_runs_affinity(
-            jnp.asarray(run_req),
-            jnp.asarray(run_counts),
-            jnp.asarray(masks),
-            jnp.asarray(allocs),
+            self._dev(run_req),
+            self._dev(run_counts),
+            self._dev(masks),
+            self._dev(allocs),
             max_nodes=bucket_size(int(caps.max()), minimum=8),
-            involved=jnp.asarray(involved),
-            match=jnp.asarray(terms_match),
-            aff_of=jnp.asarray(terms_aff),
-            anti_of=jnp.asarray(terms_anti),
-            node_level=jnp.asarray(group_terms.node_level),
-            has_label=jnp.asarray(group_terms.has_label),
-            node_caps=jnp.asarray(caps),
+            involved=self._dev(involved),
+            match=self._dev(terms_match),
+            aff_of=self._dev(terms_aff),
+            anti_of=self._dev(terms_anti),
+            node_level=self._dev(group_terms.node_level),
+            has_label=self._dev(group_terms.has_label),
+            node_caps=self._dev(caps),
             spread=spread_arg,
         )
         counts = np.asarray(res.node_count)
@@ -1303,12 +1331,12 @@ class BinpackingNodeEstimator:
             "involved": np.zeros((U,), bool),
         }
         res = ffd_binpack_groups_runs(
-            jnp.asarray(run_req),
-            jnp.asarray(run_counts),
-            jnp.asarray(masks),
-            jnp.asarray(allocs),
+            self._dev(run_req),
+            self._dev(run_counts),
+            self._dev(masks),
+            self._dev(allocs),
             max_nodes=bucket_size(int(caps.max()), minimum=8),
-            node_caps=jnp.asarray(caps),
+            node_caps=self._dev(caps),
         )
         counts = np.asarray(res.node_count)
         placed = np.asarray(res.placed_counts)

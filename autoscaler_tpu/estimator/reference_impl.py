@@ -264,3 +264,28 @@ def ffd_binpack_reference_groups(
         counts.append(c)
         scheds.append(s)
     return np.array(counts), np.stack(scheds)
+
+
+def apply_row_deltas_reference(
+    buf: np.ndarray,      # [N, ...] resident buffer (any dtype/rank)
+    idx: np.ndarray,      # [K] i32 indices; out-of-range entries are padding
+    payload: np.ndarray,  # rows [K, ...] (axis=0) or columns [..., K] (axis=1)
+    axis: int = 0,
+) -> np.ndarray:
+    """Serial oracle twin of the ops/arena_apply scatter family: apply one
+    (index, payload) delta batch to a host copy of the buffer. Out-of-range
+    indices (the pow-8-ladder padding entries, index == buf.shape[axis])
+    are dropped, matching the kernels' ``mode="drop"`` semantics; real
+    indices are unique by the packer's construction, so ordering cannot
+    matter. Parity with the donated device kernels is pinned in
+    tests/test_arena.py on randomized shapes and dtypes."""
+    if axis not in (0, 1):
+        raise ValueError(f"unsupported scatter axis {axis}")
+    out = np.array(buf, copy=True)
+    idx = np.asarray(idx, np.int64)
+    ok = (idx >= 0) & (idx < buf.shape[axis])
+    if axis == 0:
+        out[idx[ok]] = np.asarray(payload)[ok]
+    else:
+        out[:, idx[ok]] = np.asarray(payload)[:, ok]
+    return out

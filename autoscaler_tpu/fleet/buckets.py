@@ -33,6 +33,12 @@ import numpy as np
 # deploy sites size their own via --fleet-shape-buckets
 DEFAULT_BUCKETS = "64x8x8,256x16x16"
 
+# the resident-arena prewarm ladder (snapshot/arena.py), same PxGxR grammar
+# read as (pods, nodes, resources-cap); lives HERE so config/options.py can
+# import the default without pulling jax (ONE source, like DEFAULT_BUCKETS).
+# Deploy sites size their own via --arena-buckets.
+DEFAULT_ARENA_BUCKETS = "64x16x8,1024x256x8"
+
 
 class BucketError(ValueError):
     """A bucket spec string that doesn't describe a usable ladder."""

@@ -51,6 +51,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                           "bench.py --explain-ledger validates)")
     run.add_argument("--seed", type=int, default=None,
                      help="override the spec's seed")
+    run.add_argument("--set", action="append", default=[], dest="overrides",
+                     metavar="KEY=VALUE",
+                     help="override one AutoscalingOptions field of the "
+                          "spec (repeatable; VALUE parses as JSON, else "
+                          "string) — e.g. --set arena_enabled=false runs "
+                          "the same scenario on the cold-repack path for "
+                          "the arena parity gate")
     run.add_argument("--real-sleep", action="store_true",
                      help="actually sleep injected provider latency")
     run.add_argument("--sanitize", action="store_true",
@@ -187,6 +194,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             spec = ScenarioSpec.load(args.scenario)
             if args.seed is not None:
                 spec.seed = args.seed
+            for item in args.overrides:
+                key, sep, raw = item.partition("=")
+                if not sep or not key:
+                    raise SpecError(f"--set wants KEY=VALUE, got {item!r}")
+                try:
+                    value = json.loads(raw)
+                except json.JSONDecodeError:
+                    value = raw
+                # merged into the spec's options overrides: the driver
+                # validates field names when it builds AutoscalingOptions
+                spec.options[key] = value
             go = lambda: _run(spec, args.report, args.log, args.trace,
                               real_sleep=args.real_sleep,
                               chrome_trace_path=args.chrome_trace,

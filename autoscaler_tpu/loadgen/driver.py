@@ -311,6 +311,12 @@ class ScenarioDriver:
         ladder = self.autoscaler.kernel_ladder()
         if ladder is not None:
             ladder.fault_hook = self.injector.on_kernel_dispatch
+        # arm the resident arena's fault hook the same way: arena_fault
+        # fails a delta apply at the double-buffer seam (rollback +
+        # next-tick reseed), replayed byte-identically on the sim clock
+        arena = getattr(self.autoscaler, "_arena", None)
+        if arena is not None:
+            arena.fault_hook = self.injector.on_arena_apply
         self._scheduler = HintingSimulator()
         # resolved timeline: explicit events + expanded workloads, stably
         # ordered; this IS the trace a replay executes verbatim

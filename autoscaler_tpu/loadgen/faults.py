@@ -152,6 +152,18 @@ class FaultInjector:
                     return kind
         return None
 
+    def on_arena_apply(self) -> Optional[str]:
+        """Resident-arena fault hook (snapshot/arena.DeviceArena
+        fault_hook): a truthy return fails THIS tick's delta apply — the
+        arena rolls back (live generation intact, tick served from a cold
+        upload) and reseeds next tick. Certifies the double-buffer
+        rollback path end-to-end under byte-identical replay."""
+        f = self._active("arena_fault", "")
+        if f is not None:
+            self._note("arena_fault")
+            return "arena_fault"
+        return None
+
     def on_template(self, group: str) -> None:
         """Template seam (TestNodeGroup.template_node_info, wrapped by the
         driver): raising models a cloud that cannot describe the group's

@@ -48,6 +48,11 @@ crash-only loop — see ARCHITECTURE.md "Resilience"):
 - ``kube_api_error``  — the cluster-API listing inside run_once raises →
                         exercises the crash-only loop (the tick records an
                         error; the process keeps looping)
+- ``arena_fault``     — the resident device arena's delta apply fails →
+                        the faulted tick serves from a cold upload (the
+                        live arena is never corrupted) and the arena
+                        reseeds next tick (double-buffer rollback; only
+                        fires when the scenario enables ``arena_enabled``)
 """
 from __future__ import annotations
 
@@ -79,6 +84,9 @@ FAULT_KINDS = (
     # template_node_info raises for the targeted group — the orchestrator
     # skips it with SkipReason.NO_TEMPLATE (decision-provenance scenarios)
     "template_error",
+    # the resident arena's delta apply fails → cold-upload fallback +
+    # next-tick reseed (double-buffer rollback certification)
+    "arena_fault",
 )
 # estimator rungs a kernel_fault may target ("" = every device rung)
 KERNEL_FAULT_RUNGS = ("", "pallas", "xla")
@@ -134,7 +142,7 @@ class FaultSpec:
                 f"fault field 'rung' only applies to kernel_fault, not {self.kind!r}"
             )
         if self.group and self.kind in (
-            "kernel_fault", "device_lost", "kube_api_error"
+            "kernel_fault", "device_lost", "kube_api_error", "arena_fault"
         ):
             # these faults hit process-wide seams (the kernel ladder, the
             # cluster listing) — a group scope would be silently ignored

@@ -25,7 +25,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from autoscaler_tpu.config.options import AutoscalingOptions
-from autoscaler_tpu.fleet.buckets import DEFAULT_BUCKETS as _FLEET_DEFAULT_BUCKETS
+from autoscaler_tpu.fleet.buckets import (
+    DEFAULT_ARENA_BUCKETS as _ARENA_DEFAULT_BUCKETS,
+    DEFAULT_BUCKETS as _FLEET_DEFAULT_BUCKETS,
+)
 
 
 def _bool_flag(s: str) -> bool:
@@ -202,10 +205,22 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    default=10.0, help="per-group estimate budget (main.go:216)")
     p.add_argument("--node-info-cache-expire-time", type=float, default=60.0,
                    help="template NodeInfo cache TTL seconds")
-    p.add_argument("--jax-compilation-cache-dir",
+    p.add_argument("--compile-cache-dir", "--jax-compilation-cache-dir",
+                   dest="compile_cache_dir",
                    default="/tmp/autoscaler_tpu_xla_cache",
                    help="persistent XLA compile cache (amortizes first-loop "
-                        "kernel compiles across restarts); empty disables")
+                        "kernel compiles across restarts; with the arena "
+                        "prewarm, makes the first real tick compile-free); "
+                        "empty disables")
+    p.add_argument("--arena-enabled", type=_bool_flag, default=False,
+                   help="resident device arena: keep packed snapshot "
+                        "tensors on-device across ticks and ship only "
+                        "delta scatters for dirtied rows "
+                        "(snapshot/arena.py, ROADMAP item 2)")
+    p.add_argument("--arena-buckets", default=_ARENA_DEFAULT_BUCKETS,
+                   help="comma-separated PxNxR power-of-two prewarm "
+                        "buckets for the arena apply-kernel ladder (same "
+                        "grammar as --fleet-shape-buckets; R is a cap)")
     p.add_argument("--debugging-snapshot-enabled", type=_bool_flag, default=True,
                    help="serve /snapshotz captures")
     p.add_argument("--tracing-enabled", type=_bool_flag, default=True,
@@ -378,6 +393,9 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         fleet_shape_buckets=args.fleet_shape_buckets,
         fleet_prewarm=args.fleet_prewarm,
         fleet_batch_scenarios=args.fleet_batch_scenarios,
+        arena_enabled=args.arena_enabled,
+        arena_buckets=args.arena_buckets,
+        compile_cache_dir=args.compile_cache_dir,
         force_daemonsets=args.force_ds,
     )
     opts.node_group_defaults.scale_down_unneeded_time_s = args.scale_down_unneeded_time
@@ -732,17 +750,17 @@ def main(argv=None) -> int:
     klogx.set_verbosity(args.v)
     logging.basicConfig(level=logging.INFO)
 
-    if args.jax_compilation_cache_dir:
+    if opts.compile_cache_dir:
         # Persistent XLA compile cache: the first reconcile loop pays
         # ~10-40s of kernel compiles (churn_bench first_loop_s vs steady
         # state); across process restarts — the common restart path for a
-        # leader-elected singleton — the cache turns that into a disk read.
-        # Applied before any jax import triggers backend init.
+        # leader-elected singleton — the cache turns that into a disk read,
+        # and paired with the arena's bucket-ladder prewarm the first real
+        # tick never compiles at all. Applied before any jax import
+        # triggers backend init.
         import jax
 
-        jax.config.update(
-            "jax_compilation_cache_dir", args.jax_compilation_cache_dir
-        )
+        jax.config.update("jax_compilation_cache_dir", opts.compile_cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 

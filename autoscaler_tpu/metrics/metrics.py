@@ -477,6 +477,19 @@ class AutoscalerMetrics:
             p + "device_resident_bytes",
             "live device buffer bytes by residency pool",
         )
+        # -- resident device arena (autoscaler_tpu/snapshot/arena): delta
+        # uploads vs full re-seeds. Steady state is delta_rows trickling
+        # and full_uploads FLAT — a climbing full-upload counter without
+        # bucket promotions is the flatten-per-tick tax coming back.
+        self.arena_delta_rows_total = r.counter(
+            p + "arena_delta_rows_total",
+            "snapshot rows shipped to the device as delta scatters",
+        )
+        self.arena_full_uploads_total = r.counter(
+            p + "arena_full_uploads_total",
+            "full tensor re-seeds of the device arena (init, bucket "
+            "promotion, schema change, fault rollback)",
+        )
         self.estimation_over_budget_total = r.counter(
             p + "estimation_over_budget_total",
             "batched binpacking dispatches exceeding the per-group duration "

@@ -1,0 +1,95 @@
+"""Delta scatter-apply kernels for the resident device arena.
+
+The arena (snapshot/arena.py) keeps the packed snapshot tensors
+device-resident across reconcile ticks; the host ships only (row-index,
+payload) batches for the rows the incremental packer dirtied. These
+kernels apply one such batch to one resident buffer.
+
+Donation (`donate_argnums=0`) is the point: the input buffer's device
+memory is reused for the output, so a steady-state tick performs an
+in-place row scatter — no fresh O(world) allocation, no host→device
+re-transfer of the untouched rows (the pjit donation pattern of
+SNIPPETS.md [1], applied to control-plane state instead of optimizer
+state). On backends without donation support (CPU) XLA falls back to a
+device-side copy; semantics are identical either way, which is what the
+oracle twin (estimator/reference_impl.apply_row_deltas_reference) pins.
+
+Index padding contract: delta batches are padded up to a power-of-EIGHT
+K ladder (8, 64, 512, … — arena.delta_bucket; a small closed set of
+traced shapes, the compile-cache key discipline of fleet/buckets.py
+applied to the delta axis). Padding entries carry index == buffer
+length, which is out of bounds and dropped by the scatter
+(`mode="drop"`); real indices are UNIQUE and sorted (the packer emits
+them from sets), so scatter-set determinism never depends on
+duplicate-resolution order.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Machine-readable kernel contracts (graftlint GL007, analysis/contracts.py):
+# AST-extracted, never imported. Operand names are arena_* on purpose — the
+# buffers are dtype-polymorphic (f32 rows, bool masks, i32 vectors), so no
+# dtype is declared for them and the names must not collide with the
+# binpack family's typed operands. AK is the padded delta-batch axis (a
+# power-of-eight ladder rung); out-of-range indices (== AN) are padding
+# and drop.
+KERNEL_CONTRACTS = {
+    "arena_scatter_rows": {
+        "args": {
+            "arena_buf": {"dims": ["AN", "AR"]},
+            "arena_idx": {"dims": ["AK"], "dtype": "i32"},
+            "arena_rows": {"dims": ["AK", "AR"]},
+        },
+        "notes": "row scatter on axis 0; idx unique, padding idx == AN drops",
+    },
+    "arena_scatter_vec": {
+        "args": {
+            "arena_buf1": {"dims": ["AN"]},
+            "arena_idx": {"dims": ["AK"], "dtype": "i32"},
+            "arena_vals": {"dims": ["AK"]},
+        },
+        "notes": "element scatter on a rank-1 buffer; same index contract",
+    },
+    "arena_scatter_cols": {
+        "args": {
+            "arena_mat": {"dims": ["AP", "AN"]},
+            "arena_idx": {"dims": ["AK"], "dtype": "i32"},
+            "arena_cols": {"dims": ["AP", "AK"]},
+        },
+        "notes": "column scatter on axis 1 (mask node-column refresh)",
+    },
+}
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def arena_scatter_rows(
+    arena_buf: jax.Array,   # [AN, AR] resident buffer (donated)
+    arena_idx: jax.Array,   # [AK] i32 unique row indices; AN = padding
+    arena_rows: jax.Array,  # [AK, AR] replacement rows
+) -> jax.Array:
+    arena_idx = jnp.asarray(arena_idx, jnp.int32)
+    return arena_buf.at[arena_idx].set(arena_rows, mode="drop")
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def arena_scatter_vec(
+    arena_buf1: jax.Array,  # [AN] resident rank-1 buffer (donated)
+    arena_idx: jax.Array,   # [AK] i32 unique indices; AN = padding
+    arena_vals: jax.Array,  # [AK] replacement elements
+) -> jax.Array:
+    arena_idx = jnp.asarray(arena_idx, jnp.int32)
+    return arena_buf1.at[arena_idx].set(arena_vals, mode="drop")
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def arena_scatter_cols(
+    arena_mat: jax.Array,   # [AP, AN] resident matrix (donated)
+    arena_idx: jax.Array,   # [AK] i32 unique column indices; AN = padding
+    arena_cols: jax.Array,  # [AP, AK] replacement columns
+) -> jax.Array:
+    arena_idx = jnp.asarray(arena_idx, jnp.int32)
+    return arena_mat.at[:, arena_idx].set(arena_cols, mode="drop")

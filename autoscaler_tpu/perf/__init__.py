@@ -27,6 +27,7 @@ from autoscaler_tpu.perf.ledger import (
 )
 from autoscaler_tpu.perf.observatory import PerfObservatory
 from autoscaler_tpu.perf.residency import (
+    POOL_ARENA,
     POOL_KERNEL_OPERANDS,
     POOL_SCENARIO_BATCHES,
     POOL_SNAPSHOT,
@@ -35,6 +36,7 @@ from autoscaler_tpu.perf.residency import (
 )
 
 __all__ = [
+    "POOL_ARENA",
     "POOL_KERNEL_OPERANDS",
     "POOL_SCENARIO_BATCHES",
     "POOL_SNAPSHOT",

@@ -115,12 +115,49 @@ def _check_dispatch(
     seen.add(key)
 
 
+_ARENA_KEYS = (
+    "applies", "delta_rows", "delta_bytes", "full_uploads", "promotions",
+    "rollbacks", "aux_uploads",
+)
+
+
+def _check_arena(
+    i: int, arena: Any, first_arena: bool, errors: List[str]
+) -> None:
+    """The resident-arena steady-state gate: a tick record's ``arena``
+    section may only report full uploads when the tick also reports a
+    bucket promotion or a fault rollback — an unexplained full upload is
+    the flatten-per-tick tax regressing. Truncation-safe like the
+    compile-cache check: the FIRST arena record a ledger carries may be
+    the init seed (its miss/upload predates nothing)."""
+    where = f"record {i} arena"
+    if not isinstance(arena, dict):
+        errors.append(f"{where}: not an object")
+        return
+    for k, v in arena.items():
+        if k not in _ARENA_KEYS:
+            errors.append(f"{where}: unknown key {k!r}")
+        elif not isinstance(v, int) or v < 0:
+            errors.append(f"{where}: {k} must be a non-negative int")
+    fulls = arena.get("full_uploads", 0)
+    if (
+        isinstance(fulls, int) and fulls > 0 and not first_arena
+        and not arena.get("promotions", 0) and not arena.get("rollbacks", 0)
+    ):
+        errors.append(
+            f"{where}: full-upload-on-steady-state-tick regression — "
+            f"{fulls} full uploads with no bucket promotion or rollback"
+        )
+
+
 def validate_records(records: Iterable[Any]) -> List[str]:
     """Validate a perf ledger; returns a list of error strings (empty =
-    valid). Checks the tick-record schema, tick monotonicity, and
-    compile-cache coherence across the whole ledger."""
+    valid). Checks the tick-record schema, tick monotonicity,
+    compile-cache coherence, and resident-arena upload coherence across
+    the whole ledger."""
     errors: List[str] = []
     seen: Set[Tuple[str, str]] = set()
+    arena_seen = False
     last_tick = None
     for i, rec in enumerate(records):
         if not isinstance(rec, dict):
@@ -149,6 +186,10 @@ def validate_records(records: Iterable[Any]) -> List[str]:
             errors.append(
                 f"record {i}: resident_bytes must map pools to byte counts"
             )
+        arena = rec.get("arena")
+        if arena is not None:
+            _check_arena(i, arena, not arena_seen, errors)
+            arena_seen = True
         dispatches = rec.get("dispatches")
         if not isinstance(dispatches, list):
             errors.append(f"record {i}: dispatches must be a list")
@@ -169,11 +210,14 @@ def summarize(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     # iteration order into a byte-diffed report
     sigs: Dict[str, Set[str]] = {}
     peaks: Dict[str, int] = {}
+    arena_totals: Dict[str, int] = {}
     ticks = 0
     for rec in records:
         ticks += 1
         for pool, nbytes in rec.get("resident_bytes", {}).items():
             peaks[pool] = max(peaks.get(pool, 0), int(nbytes))
+        for k, v in rec.get("arena", {}).items():
+            arena_totals[k] = arena_totals.get(k, 0) + int(v)
         for d in rec.get("dispatches", ()):
             route = d.get("route", "?")
             r = routes.setdefault(
@@ -202,4 +246,9 @@ def summarize(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         "ticks": ticks,
         "routes": {k: routes[k] for k in sorted(routes)},
         "resident_bytes_peak": {k: peaks[k] for k in sorted(peaks)},
+        **(
+            {"arena": {k: arena_totals[k] for k in sorted(arena_totals)}}
+            if arena_totals
+            else {}
+        ),
     }

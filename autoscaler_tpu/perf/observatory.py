@@ -218,6 +218,26 @@ class PerfObservatory:
                 attrs["model_peak_bytes"] = cost["peak_bytes"]
         span.set_attrs(**attrs)
 
+    def note_arena(self, stats: Dict[str, int]) -> None:
+        """Stamp the resident arena's per-tick counters (delta rows,
+        full uploads, promotions, rollbacks — snapshot/arena.take_stats)
+        into the open tick record. Values are pure functions of the
+        world's mutation stream, so they replay byte-identically; a tick
+        with no arena activity records nothing. Summed if called twice
+        (a tick may flush stats around a crash boundary)."""
+        clean = {k: int(v) for k, v in sorted(stats.items())}
+        if not any(clean.values()):
+            return
+        with self._lock:
+            if self._tick is None:
+                return
+            prev = self._tick.get("arena")
+            if prev is None:
+                self._tick["arena"] = clean
+            else:
+                for k, v in clean.items():
+                    prev[k] = prev.get(k, 0) + v
+
     # -- tick lifecycle (StaticAutoscaler.run_once) --------------------------
     def begin_tick(self, tick_id: int, now_ts: float) -> None:
         with self._lock:

@@ -18,6 +18,10 @@ from typing import Any, Dict
 POOL_SNAPSHOT = "snapshot"
 POOL_KERNEL_OPERANDS = "kernel_operands"
 POOL_SCENARIO_BATCHES = "scenario_batches"
+# the resident device arena (snapshot/arena.py): BOTH double-buffer
+# generations plus the factored-mask aux pool, and the estimator's
+# content-addressed operand cache
+POOL_ARENA = "arena"
 
 
 class ResidencyLedger:

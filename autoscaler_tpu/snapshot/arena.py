@@ -1,0 +1,574 @@
+"""Resident device arena: the packed snapshot tensors stay on-device
+across reconcile ticks, and the host ships *delta programs* — (row-index,
+payload) scatter batches for the rows the incremental packer dirtied —
+instead of re-uploading dense tensors every loop (ROADMAP item 2: kill
+the flatten-per-tick tax).
+
+Three pieces:
+
+- ``DeltaProgram`` — what one ``IncrementalPacker.update()`` changed, as
+  scatter ops (unique sorted indices, power-of-two padded payloads) plus
+  the small shape-flexible aux fields (factored-mask factors) and the
+  full host arrays (seed fodder for init / bucket promotion / fault
+  recovery).
+- ``DeviceArena`` — double-buffered resident buffers with a donated
+  jitted apply (ops/arena_apply.py). Deltas are applied to the *lagging*
+  generation (which is one tick behind and carries the previous tick's
+  deltas as a pending replay), then the generations swap — so a tick
+  that faults mid-apply corrupts only the lagging side and the live
+  arena keeps serving; the packer falls back to a cold upload for the
+  faulted tick and the arena reseeds on the next one (rollback).
+- ``OperandArena`` — a content-addressed device cache for estimator
+  dispatch operands, so an unchanged pending-pod set re-dispatches
+  against resident handles instead of re-running ``jnp.asarray`` on
+  host-packed arrays every tick.
+
+Compile-cost discipline (ROADMAP item 5, shared with fleet/buckets.py):
+delta batches pad their index axis up to a small closed power-of-eight
+ladder and the arena shapes come from power-of-two (P, N, R) buckets, so
+the steady-state jit-cache key set is bounded and ``prewarm()`` can touch
+every key at startup — the first real tick never compiles an apply.
+
+Buffer-liveness contract (donation makes this a HARD rule on TPU): the
+arrays served by one ``apply()`` stay valid until the SECOND subsequent
+apply — that apply donates the generation backing them, and XLA reuses
+(invalidates) the memory. Consumers must therefore never hold served
+tensors across packer updates. Every in-repo consumer routes through
+``ClusterSnapshot.tensors()``, which is safe by construction: its cache
+only serves tensors while the snapshot version is unchanged, and an
+unchanged version means no packer update — hence no apply — happened
+since they were built (the fork→revert path keeps a pre-fork cache only
+when NO in-fork materialization — and so no in-fork apply — occurred).
+A new consumer that stashes tensors across ticks must copy what it
+keeps.
+
+Threading: the control loop applies while ``/metrics``/``/perfz`` HTTP
+threads read byte counters — every mutation of arena state happens under
+the instance lock (graftlint GL004 polices this module); replays under
+the loadgen driver are byte-identical (GL001 — walls come from
+``trace.timeline_now()``).
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+import sys
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from autoscaler_tpu import trace
+from autoscaler_tpu.fleet.buckets import (
+    DEFAULT_ARENA_BUCKETS,
+    BucketError,
+    BucketSpec,
+    parse_buckets,
+)
+from autoscaler_tpu.ops.arena_apply import (
+    arena_scatter_cols,
+    arena_scatter_rows,
+    arena_scatter_vec,
+)
+
+# the delta-axis ladder: power-of-eight so a long-lived process holds at
+# most a handful of traced apply shapes per buffer signature
+_K_BASE = 8
+
+# copy-on-write twins of the donated apply kernels (jit of the same
+# bodies WITHOUT donate_argnums): dispatched when the arena is not the
+# target buffer's sole owner — donating out from under a still-held
+# SnapshotTensors would delete its arrays (see _scatter_fn_locked)
+_UNDONATED = {
+    fn: None for fn in (
+        arena_scatter_rows, arena_scatter_vec, arena_scatter_cols,
+    )
+}
+
+
+def _undonated(fn):
+    import jax
+
+    twin = _UNDONATED.get(fn)
+    if twin is None:
+        twin = _UNDONATED[fn] = jax.jit(fn.__wrapped__)
+    return twin
+
+
+class ArenaError(RuntimeError):
+    """A delta apply failed; the live generation is intact (rollback)."""
+
+
+def parse_arena_buckets(spec: str) -> List[BucketSpec]:
+    """``--arena-buckets`` parser: the fleet PxGxR grammar re-read as
+    (pods, nodes, resources) — same power-of-two validation, same
+    exact-pad safety rules (padding rows are masked invalid)."""
+    try:
+        return parse_buckets(spec)
+    except BucketError as e:
+        raise BucketError(f"--arena-buckets: {e}") from None
+
+
+def delta_bucket(k: int) -> int:
+    """Smallest rung of the power-of-eight delta ladder >= max(k, 1)."""
+    size = _K_BASE
+    while size < k:
+        size *= _K_BASE
+    return size
+
+
+def delta_ladder(axis: int) -> List[int]:
+    """Every delta-bucket rung an axis of this length can produce."""
+    out = [_K_BASE]
+    while out[-1] < axis:
+        out.append(out[-1] * _K_BASE)
+    return out
+
+
+@dataclass
+class DeltaOp:
+    """One scatter batch: replace ``idx`` rows (axis 0) or columns
+    (axis 1) of ``field`` with ``payload``. ``idx`` is unique and sorted
+    (emitted from sets), un-padded; the arena pads to the delta ladder."""
+
+    field: str
+    axis: int
+    idx: np.ndarray
+    payload: np.ndarray
+
+
+@dataclass
+class DeltaProgram:
+    """Everything one packer update changed. ``host`` always carries the
+    full host arrays of every managed field — the seed source for init,
+    bucket promotion, and post-fault reseeds; on a steady tick it is
+    only referenced, never transferred."""
+
+    ops: List[DeltaOp] = field(default_factory=list)
+    aux: Dict[str, np.ndarray] = field(default_factory=dict)
+    host: Dict[str, np.ndarray] = field(default_factory=dict)
+    reseed: bool = False          # packer did a full rebuild (promotion)
+    reseed_reason: str = ""       # capacity_growth | schema_change
+
+    def delta_rows(self) -> int:
+        return sum(int(op.idx.size) for op in self.ops)
+
+
+def _zero_stats() -> Dict[str, int]:
+    return {
+        "applies": 0,
+        "delta_rows": 0,
+        "delta_bytes": 0,
+        "full_uploads": 0,
+        "promotions": 0,
+        "rollbacks": 0,
+        "aux_uploads": 0,
+    }
+
+
+class DeviceArena:
+    """Double-buffered resident snapshot buffers with donated delta apply.
+
+    ``apply()`` is called by the incremental packer from the control loop;
+    byte counters are read by HTTP threads. ``fault_hook`` (set once by
+    the loadgen driver, like the kernel ladder's) lets scenarios script an
+    apply fault to certify the rollback path."""
+
+    def __init__(
+        self,
+        buckets: str = DEFAULT_ARENA_BUCKETS,
+        observatory: Any = None,
+        metrics: Any = None,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        self._lock = threading.Lock()
+        self.buckets = parse_arena_buckets(buckets)
+        self.observatory = observatory
+        self.metrics = metrics
+        # injected clock seam (GL001): the autoscaler passes its tracer's
+        # timeline clock so PREWARM walls (which run outside any tick
+        # trace, where trace.timeline_now() would fall back to the wall)
+        # are measured on the same replayable timeline as apply walls
+        self._clock = clock or trace.timeline_now
+        # loadgen seam: returns a truthy fault kind to fail this apply
+        # (written only here and by the driver at arm time; the control
+        # loop is the only reader)
+        self.fault_hook: Optional[Callable[[], Optional[str]]] = None
+        self._bufs: List[Dict[str, Any]] = [{}, {}]
+        self._live = 0
+        self._need_seed = [True, True]
+        self._pending: List[DeltaOp] = []
+        # aux fields (factored-mask factors) are shape-flexible and small:
+        # ONE generation-independent copy, replaced wholesale when dirty
+        self._aux: Dict[str, Any] = {}
+        self._stats = _zero_stats()
+        self._seeded_once = False
+        self._coverage_warned: set = set()
+
+    # -- apply ---------------------------------------------------------------
+    def apply(self, program: DeltaProgram) -> Dict[str, Any]:
+        """Apply one tick's delta program; returns the live buffer dict
+        (managed fields + aux). Raises ArenaError on a faulted apply —
+        the live generation is untouched and the caller serves the tick
+        from a cold upload instead."""
+        with self._lock:
+            return self._apply_locked(program)
+
+    def _apply_locked(self, program: DeltaProgram) -> Dict[str, Any]:
+        self._stats["applies"] += 1
+        if program.reseed:
+            # the packer rebuilt from scratch (bucket promotion / schema
+            # change): every resident shape is wrong — both generations
+            # reseed, and the ledger records WHY the full upload happened
+            self._need_seed = [True, True]
+            self._pending = []
+            self._stats["promotions"] += 1
+        target = 1 - self._live
+        idle = (
+            not self._need_seed[target]
+            and not self._pending
+            and not program.ops
+            and not program.aux
+        )
+        if idle:
+            # nothing changed anywhere: serve the live generation as-is
+            # (same buffer objects — the zero-cost steady-state tick)
+            return self._live_view_locked()
+        hook = self.fault_hook
+        seeded = False
+        try:
+            if hook is not None:
+                kind = hook()
+                if kind:
+                    # mark the target corrupted BEFORE raising: the next
+                    # apply must reseed it rather than trust its contents
+                    self._need_seed[target] = True
+                    raise ArenaError(f"injected arena fault: {kind}")
+            if self._need_seed[target]:
+                if not program.reseed and self._seeded_once:
+                    # not a packer-forced promotion: this seed is the
+                    # recovery from a prior faulted apply — the ledger
+                    # pairs its full uploads with a rollback count
+                    self._stats["rollbacks"] += 1
+                self._seed_locked(target, program)
+                seeded = True
+            else:
+                self._scatter_locked(target, self._pending + program.ops)
+            for name, arr in program.aux.items():
+                self._aux[name] = jnp.asarray(arr)
+                self._stats["aux_uploads"] += 1
+                self._stats["delta_bytes"] += int(arr.nbytes)
+        except ArenaError:
+            self._stats["rollbacks"] += 1
+            raise
+        except Exception as e:  # noqa: BLE001 — any apply failure rolls back
+            self._need_seed[target] = True
+            self._stats["rollbacks"] += 1
+            raise ArenaError(f"arena apply failed: {e}") from e
+        self._live = target
+        # a seed leaves BOTH generations current — nothing pends; a scatter
+        # leaves the new lagging side one tick behind, owing these ops
+        self._pending = [] if seeded else list(program.ops)
+        rows = 0 if seeded else program.delta_rows()
+        self._stats["delta_rows"] += rows
+        self._feed_metrics_locked(rows)
+        return self._live_view_locked()
+
+    def _seed_locked(self, target: int, program: DeltaProgram) -> None:
+        """Full host→device upload of every managed field into ``target``,
+        then a device-side clone into the other generation so the next
+        steady tick scatters instead of re-seeding (a clone is not a
+        full upload: no host transfer happens)."""
+        bufs = {}
+        m = self.metrics
+        for name, arr in program.host.items():
+            # copy=True, NOT asarray: on CPU backends jnp.asarray may
+            # zero-copy alias the packer's host array, and the packer
+            # mutates those IN PLACE on later updates — an aliased seed
+            # would silently track the host and break fault isolation
+            bufs[name] = jnp.array(arr, copy=True)
+            self._stats["full_uploads"] += 1
+            self._stats["delta_bytes"] += int(arr.nbytes)
+            if m is not None:
+                m.arena_full_uploads_total.inc()
+        self._bufs[target] = bufs
+        other = 1 - target
+        self._bufs[other] = {
+            name: jnp.array(buf, copy=True) for name, buf in bufs.items()
+        }
+        self._need_seed = [False, False]
+        self._pending = []
+        if not self._seeded_once:
+            self._seeded_once = True
+        trace.add_event(
+            "arena.seed",
+            fields=len(bufs),
+            reason=program.reseed_reason or "init",
+        )
+        self._check_prewarm_coverage_locked(bufs)
+
+    def _check_prewarm_coverage_locked(self, bufs: Dict[str, Any]) -> None:
+        """Warn when the seeded world shape has no matching prewarm
+        bucket: the 'first real tick never compiles' contract only holds
+        for shapes in the --arena-buckets ladder, and a silent miss would
+        bring the compile stall back with no signal (the real PP/NN come
+        from the packer's pow2 bucketing, the real R from the extended
+        schema — neither is forced to match the configured ladder)."""
+        pod_req = bufs.get("pod_req")
+        node_alloc = bufs.get("node_alloc")
+        if pod_req is None or node_alloc is None:
+            return
+        PP, R = pod_req.shape
+        NN = node_alloc.shape[0]
+        covered = any(
+            b.pods == PP and b.groups == NN and R <= b.resources
+            for b in self.buckets
+        )
+        if not covered and (PP, NN, R) not in self._coverage_warned:
+            self._coverage_warned.add((PP, NN, R))
+            trace.add_event("arena.prewarm_miss", P=PP, N=NN, R=R)
+            logging.getLogger("arena").warning(
+                "arena world shape (P=%d, N=%d, R=%d) matches no "
+                "--arena-buckets entry (%s): the first delta tick at this "
+                "shape will compile its apply kernels — add a %dx%dx%d "
+                "bucket to keep the steady state compile-free",
+                PP, NN, R,
+                ",".join(b.key for b in self.buckets),
+                PP, NN, max(R, 8),
+            )
+
+    def _scatter_fn_locked(self, buf, donated_fn):
+        """Pick the donated or the copy-on-write apply for ONE buffer.
+
+        Donation is only legal when the arena is the buffer's sole
+        python owner: SnapshotTensors served from this generation two
+        applies ago may still be alive in a caller, and donating the
+        buffer out from under them deletes their arrays ("buffer has
+        been deleted or donated" on next use — every backend enforces
+        this, not just TPU). Sole ownership is exactly refcount 4 here:
+        the generation dict, _scatter_locked's local, this parameter,
+        and getrefcount's own argument. Any extra holder → fall back to
+        the undonated twin (XLA copy-on-write: correct, device-side
+        copy, still zero host transfer). The choice never changes
+        values, so replays stay byte-identical."""
+        if sys.getrefcount(buf) <= 4:
+            return donated_fn
+        return _undonated(donated_fn)
+
+    def _scatter_locked(self, target: int, ops: Sequence[DeltaOp]) -> None:
+        bufs = self._bufs[target]
+        for op in ops:
+            buf = bufs[op.field]
+            axis_len = buf.shape[op.axis]
+            K = delta_bucket(int(op.idx.size))
+            idx = np.full((K,), axis_len, np.int32)
+            idx[: op.idx.size] = op.idx
+            if op.axis == 0:
+                if buf.ndim == 1:
+                    vals = np.zeros((K,), op.payload.dtype)
+                    vals[: op.idx.size] = op.payload
+                    bufs[op.field] = self._dispatch_locked(
+                        "arena_vec",
+                        self._scatter_fn_locked(buf, arena_scatter_vec),
+                        buf, jnp.asarray(idx), jnp.asarray(vals),
+                    )
+                else:
+                    rows = np.zeros((K,) + buf.shape[1:], op.payload.dtype)
+                    rows[: op.idx.size] = op.payload
+                    bufs[op.field] = self._dispatch_locked(
+                        "arena_rows",
+                        self._scatter_fn_locked(buf, arena_scatter_rows),
+                        buf, jnp.asarray(idx), jnp.asarray(rows),
+                    )
+            else:
+                cols = np.zeros(buf.shape[:1] + (K,), op.payload.dtype)
+                cols[:, : op.idx.size] = op.payload
+                bufs[op.field] = self._dispatch_locked(
+                    "arena_cols",
+                    self._scatter_fn_locked(buf, arena_scatter_cols),
+                    buf, jnp.asarray(idx), jnp.asarray(cols),
+                )
+            self._stats["delta_bytes"] += int(op.payload.nbytes)
+
+    def _dispatch_locked(self, route: str, fn, *args):
+        """One donated apply, measured on the timeline clock and handed to
+        the perf observatory — arena applies share the compile-telemetry
+        ledger with the estimator kernels, so 'zero steady-state compiles'
+        provably covers the arena too."""
+        obs = self.observatory
+        t0 = self._clock()
+        if obs is not None:
+            obs.note_kernel(fn, args, {})
+        out = fn(*args)
+        wall = self._clock() - t0
+        if obs is not None:
+            obs.on_dispatch(route, wall)
+        return out
+
+    def _live_view_locked(self) -> Dict[str, Any]:
+        view = dict(self._bufs[self._live])
+        view.update(self._aux)
+        return view
+
+    # -- queries -------------------------------------------------------------
+    def live(self) -> Dict[str, Any]:
+        with self._lock:
+            return self._live_view_locked()
+
+    def device_bytes(self) -> int:
+        """Both generations plus the aux pool — the ``arena`` residency
+        pool (perf.residency), a pure function of world shapes."""
+        from autoscaler_tpu.perf import array_bytes
+
+        with self._lock:
+            return array_bytes(
+                [list(self._bufs[0].values()), list(self._bufs[1].values()),
+                 list(self._aux.values())]
+            )
+
+    def take_stats(self) -> Dict[str, int]:
+        """This tick's counters, reset on read (run_once stamps them into
+        the perf tick record as the ``arena`` section)."""
+        with self._lock:
+            stats, self._stats = self._stats, _zero_stats()
+            return stats
+
+    def _feed_metrics_locked(self, rows: int) -> None:
+        m = self.metrics
+        if m is None:
+            return
+        if rows:
+            m.arena_delta_rows_total.inc(rows)
+
+    # -- prewarm -------------------------------------------------------------
+    def prewarm(self, R: int, dense: Optional[bool] = None) -> int:
+        """Compile the apply-kernel ladder for every configured bucket so
+        the first real tick's scatters are jit-cache hits. ``R`` is the
+        world's real resource width (the bucket's R is only a cap);
+        ``dense`` gates the [P, N] mask shapes (None = both forms).
+        Returns the number of kernel invocations issued."""
+        with self._lock:
+            return self._prewarm_locked(R, dense)
+
+    def _prewarm_locked(self, R: int, dense: Optional[bool]) -> int:
+        calls = 0
+        for bucket in self.buckets:
+            P, N = bucket.pods, bucket.groups
+            r = min(R, bucket.resources)
+            specs: List[Tuple[Tuple[int, ...], Any, int]] = [
+                ((N, r), np.float32, N),    # node_alloc / node_used rows
+                ((P, r), np.float32, P),    # pod_req rows
+                ((N,), np.bool_, N),        # node_valid
+                ((N,), np.int32, N),        # node_group / node_class
+                ((P,), np.bool_, P),        # pod_valid
+                ((P,), np.int32, P),        # pod_node / pod_class
+            ]
+            for shape, dtype, axis_len in specs:
+                for K in delta_ladder(axis_len):
+                    # routed through the observatory so the prewarm walk
+                    # registers every (route, signature) as first-seen:
+                    # the first REAL tick's scatters then record as
+                    # cache HITS — the ledger-provable "first real tick
+                    # never compiles" contract. BOTH variants warm: the
+                    # donated apply (steady state) and its copy-on-write
+                    # twin (fires when a caller still holds served
+                    # tensors from this generation).
+                    kern = (
+                        arena_scatter_vec if len(shape) == 1
+                        else arena_scatter_rows
+                    )
+                    for fn in (kern, _undonated(kern)):
+                        buf = jnp.zeros(shape, dtype)
+                        idx = jnp.full((K,), axis_len, jnp.int32)
+                        payload = (
+                            jnp.zeros((K,), dtype) if len(shape) == 1
+                            else jnp.zeros((K,) + shape[1:], dtype)
+                        )
+                        self._dispatch_locked(
+                            "arena_vec" if len(shape) == 1 else "arena_rows",
+                            fn, buf, idx, payload,
+                        )
+                        calls += 1
+            if dense is not False:
+                for K in delta_ladder(P):
+                    for fn in (
+                        arena_scatter_rows, _undonated(arena_scatter_rows)
+                    ):
+                        self._dispatch_locked(
+                            "arena_rows", fn,
+                            jnp.zeros((P, N), np.bool_),
+                            jnp.full((K,), P, jnp.int32),
+                            jnp.zeros((K, N), np.bool_),
+                        )
+                        calls += 1
+                for K in delta_ladder(N):
+                    for fn in (
+                        arena_scatter_cols, _undonated(arena_scatter_cols)
+                    ):
+                        self._dispatch_locked(
+                            "arena_cols", fn,
+                            jnp.zeros((P, N), np.bool_),
+                            jnp.full((K,), N, jnp.int32),
+                            jnp.zeros((P, K), np.bool_),
+                        )
+                        calls += 1
+        trace.add_event("arena.prewarm", calls=calls, buckets=len(self.buckets))
+        return calls
+
+
+class OperandArena:
+    """Content-addressed device residence for estimator dispatch operands.
+
+    The estimator packs pending pods and group templates into host numpy
+    arrays every dispatch; in steady state those arrays are byte-identical
+    tick over tick, and re-running ``jnp.asarray`` re-pays the host→device
+    transfer each time. This cache keys on (shape, dtype, content digest)
+    and hands back the resident device array on a hit. Bounded LRU; the
+    digest is a pure function of array bytes, so hit/miss patterns replay
+    byte-identically under loadgen."""
+
+    def __init__(self, max_entries: int = 128):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self._max = max(int(max_entries), 1)
+        self._hits = 0
+        self._misses = 0
+
+    def resident(self, arr: Any) -> Any:
+        arr = np.asarray(arr)
+        key = (
+            arr.shape,
+            arr.dtype.str,
+            hashlib.blake2b(arr.tobytes(), digest_size=16).digest(),
+        )
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                self._hits += 1
+                return hit
+            self._misses += 1
+        dev = jnp.asarray(arr)
+        with self._lock:
+            self._entries[key] = dev
+            while len(self._entries) > self._max:
+                self._entries.popitem(last=False)
+        return dev
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "hits": self._hits,
+                "misses": self._misses,
+                "entries": len(self._entries),
+            }
+
+    def device_bytes(self) -> int:
+        from autoscaler_tpu.perf import array_bytes
+
+        with self._lock:
+            return array_bytes(list(self._entries.values()))

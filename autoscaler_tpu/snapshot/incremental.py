@@ -163,11 +163,21 @@ class IncrementalPacker:
     re-flatten. Not thread-safe — the control loop is the only caller.
     """
 
-    def __init__(self, dense_mask: Optional[bool] = None):
+    def __init__(self, dense_mask: Optional[bool] = None, arena=None):
         self._force_dense = dense_mask
         self._gen = 0
         self.full_packs = 0
         self.incremental_updates = 0
+        # resident device arena (snapshot/arena.DeviceArena): when attached,
+        # _assemble emits a delta program (row scatters for the dirtied
+        # rows) instead of re-uploading dense tensors; None = cold path
+        self._arena = arena
+        self._arena_reseed = True          # next program must full-seed
+        self._arena_reseed_reason = "init"
+        # a faulted apply may have dropped that tick's aux uploads on the
+        # floor — resend every aux field until an apply SUCCEEDS, or the
+        # arena would serve stale factored-mask factors forever
+        self._arena_resend_aux = False
         # named extended-resource column schema (packer.extended_schema);
         # a schema change resizes the resource axis → full rebuild
         self._ext_schema: tuple = ()
@@ -236,6 +246,18 @@ class IncrementalPacker:
         self._exc_rows_np = np.zeros((1, NN), bool)
         self._pod_exc_np = np.full((PP,), -1, np.int32)
         self._cells: List[Tuple[int, int, bool]] = []
+        # row-level dirt for the arena's delta programs (supersets of the
+        # field-level _dirty_fields; cleared every _assemble)
+        self._d_pod_rows: Set[int] = set()     # pod_req/pod_valid/pod_class
+        self._d_pod_node: Set[int] = set()     # pod_node entries
+        self._d_node_rows: Set[int] = set()    # node_alloc/valid/class/group
+        self._d_node_group_all = False         # group-map remap: all rows
+        self._mask_rows_d: Set[int] = set()    # dense mask row refreshes
+        self._mask_cols_d: Set[int] = set()    # dense mask column refreshes
+        self._mask_bulk = False                # dense mask bulk rebuild
+        # shadow of the last node_used the device saw: the recompute is a
+        # full vectorized rebuild, so changed rows come from a diff
+        self._node_used_shadow = np.zeros((NN, R), np.float32)
 
     # ------------------------------------------------------------- public API
     def update(
@@ -261,12 +283,19 @@ class IncrementalPacker:
             self._ext_schema = ext
             self._reset(max(PP, self._PP), max(NN, self._NN))
             self.full_packs += 1
+            # a full re-pack invalidates every resident arena shape: the
+            # delta program becomes a reseed (bucket promotion / schema
+            # change is the ONE sanctioned full re-upload)
+            self._arena_reseed = True
+            self._arena_reseed_reason = "schema_change"
             # on the tick trace a full re-pack is THE classic "why was this
             # tick slow" answer — stamp it with its cause
             trace.add_event("snapshot.full_repack", reason="schema_change")
         elif PP > self._PP or NN > self._NN or self._profiles_bloated():
             self._reset(max(PP, self._PP), max(NN, self._NN))
             self.full_packs += 1
+            self._arena_reseed = True
+            self._arena_reseed_reason = "capacity_growth"
             trace.add_event("snapshot.full_repack", reason="capacity_growth")
         else:
             self.incremental_updates += 1
@@ -399,6 +428,7 @@ class IncrementalPacker:
 
         # ---- group map ---------------------------------------------------
         if group_of_node != self._group_map:
+            self._d_node_group_all = True
             self._group_map = dict(group_of_node)
             self._group_index = {}
             self._group_names = []
@@ -424,6 +454,7 @@ class IncrementalPacker:
             for i in self._pod_node_stale:
                 if i < p:
                     self._pod_node[i] = self._pod_node_of(i)
+                    self._d_pod_node.add(i)
             self._pod_node_stale.clear()
             self._dirty_fields.add("pod_node")
         if structural or dirty_pod_rows:
@@ -539,6 +570,10 @@ class IncrementalPacker:
             self._dirty_fields.update(
                 ("node_alloc", "node_valid", "node_class")
             )
+        # row-level dirt for the arena's delta program (in-bounds rows only;
+        # removal/move sites recorded their swap-fill rows already)
+        self._d_pod_rows.update(i for i in dirty_pod_rows if i < self._PP)
+        self._d_node_rows.update(j for j in dirty_node_rows if j < self._NN)
 
         return self._assemble(), self._build_meta()
 
@@ -668,7 +703,10 @@ class IncrementalPacker:
             # the swap-fill rewrote host rows in place — the device copy is
             # stale even though no row is "dirty" in the profile sense
             self._dirty_fields.add("sched_mask")
+            self._mask_rows_d.update((row, last))
         self._dirty_fields.update(("pod_valid", "pod_class", "pod_node", "pod_req"))
+        self._d_pod_rows.update((row, last))
+        self._d_pod_node.update((row, last))
 
     def _move_pod_row(self, src: int, dst: int) -> None:
         slot = self._pod_slots[src]
@@ -707,8 +745,11 @@ class IncrementalPacker:
         self._pod_valid[dst] = self._pod_valid[src]
         self._pod_node[dst] = self._pod_node[src]
         self._pod_class[dst] = self._pod_class[src]
+        self._d_pod_rows.add(dst)
+        self._d_pod_node.add(dst)
         if self._mask is not None:
             self._mask[dst, :] = self._mask[src, :]
+            self._mask_rows_d.add(dst)
 
     def _add_node(self, node: Node) -> int:
         row = len(self._node_slots)
@@ -747,9 +788,11 @@ class IncrementalPacker:
         if self._mask is not None:
             self._mask[:, last] = False
             self._dirty_fields.add("sched_mask")  # column swap-fill happened
+            self._mask_cols_d.update((row, last))
         self._dirty_fields.update(
             ("node_valid", "node_class", "node_alloc", "node_used", "node_group")
         )
+        self._d_node_rows.update((row, last))
 
     def _move_node_row(self, src: int, dst: int) -> None:
         slot = self._node_slots[src]
@@ -770,6 +813,8 @@ class IncrementalPacker:
             }
         if self._mask is not None:
             self._mask[:, dst] = self._mask[:, src]
+            self._mask_cols_d.add(dst)
+        self._d_node_rows.add(dst)
         # pod_node entries pointing at src must follow the move
         for i in self._assign_index.get(slot.name, ()):
             self._pod_node_stale.add(i)
@@ -885,15 +930,18 @@ class IncrementalPacker:
                 :, self._node_class[:n]
             ]
             touched = True
+            self._mask_bulk = True
         else:
             for j in live_nodes:
                 mask[:p, j] = self._class_mask[
                     self._pod_class[:p], self._node_class[j]
                 ]
                 touched = True
+                self._mask_cols_d.add(j)
             for i in reset_rows:
                 mask[i, :n] = self._class_row(i, n)
                 touched = True
+                self._mask_rows_d.add(i)
         # cells leaving their special state reset to pure class values
         new_over = {(i, j) for i, j, _ in overrides}
         for i, j in self._override_prev:
@@ -902,10 +950,12 @@ class IncrementalPacker:
                     self._pod_class[i], self._node_class[j]
                 ]
                 touched = True
+                self._mask_rows_d.add(i)
         for i, j, value in overrides:
             if mask[i, j] != value:
                 mask[i, j] = value
                 touched = True
+                self._mask_rows_d.add(i)
         if exc_dirty and exc:
             own_over = {i: (j, v) for i, j, v in overrides}
             for i in exc:
@@ -925,6 +975,7 @@ class IncrementalPacker:
                 legacy=self._legacy_conf,
             )
             touched = True
+            self._mask_rows_d.update(exc)
         if touched:
             self._dirty_fields.add("sched_mask")
 
@@ -981,6 +1032,204 @@ class IncrementalPacker:
 
         return array_bytes(list(self._dev.values()))
 
+    # ----------------------------------------------------- arena delta path
+    def attach_arena(self, arena) -> None:
+        """Adopt a resident device arena: subsequent ``_assemble`` calls
+        emit delta programs against it instead of re-uploading tensors.
+        The first program after attach is a full seed."""
+        self._arena = arena
+        self._arena_reseed = True
+        self._arena_reseed_reason = "init"
+        self._arena_resend_aux = False
+
+    @property
+    def arena(self):
+        return self._arena
+
+    def _clear_delta_tracking(self) -> None:
+        self._d_pod_rows.clear()
+        self._d_pod_node.clear()
+        self._d_node_rows.clear()
+        self._d_node_group_all = False
+        self._mask_rows_d.clear()
+        self._mask_cols_d.clear()
+        self._mask_bulk = False
+
+    def _aux_arrays(self, all_fields: bool) -> Dict[str, np.ndarray]:
+        """Factored-mask factors: shape-flexible, small, re-uploaded
+        wholesale when dirty (the arena keeps one generation-independent
+        copy). Empty in dense mode."""
+        out: Dict[str, np.ndarray] = {}
+        if self._dense:
+            return out
+        dirty = self._dirty_fields
+        if all_fields or "class_mask" in dirty:
+            CP = max(len(self._pod_exemplar), 1)
+            CN = max(len(self._node_exemplar), 1)
+            CPP, CNN = bucket_size(CP, minimum=8), bucket_size(CN, minimum=8)
+            padded = np.zeros((CPP, CNN), bool)
+            padded[: self._class_mask.shape[0], : self._class_mask.shape[1]] = (
+                self._class_mask
+            )
+            out["class_mask"] = padded
+        if all_fields or "exc_rows" in dirty:
+            out["exc_rows"] = self._exc_rows_np
+        if all_fields or "pod_exc" in dirty:
+            out["pod_exc"] = self._pod_exc_np
+        if all_fields or "cells" in dirty:
+            K = len(self._cells)
+            KK = bucket_size(K, minimum=1)
+            cell_pod = np.full((KK,), -1, np.int32)
+            cell_node = np.zeros((KK,), np.int32)
+            cell_val = np.zeros((KK,), bool)
+            for k, (i, j, v) in enumerate(self._cells):
+                cell_pod[k], cell_node[k], cell_val[k] = i, j, v
+            out["cell_pod"] = cell_pod
+            out["cell_node"] = cell_node
+            out["cell_val"] = cell_val
+        return out
+
+    def _assemble_arena(self) -> SnapshotTensors:
+        """Emit this update's delta program and serve tensors from the
+        arena's live generation. On an apply fault the live arena is
+        intact but one tick behind — this tick serves from a cold upload
+        (correct, just unamortized) and the arena reseeds next update."""
+        from autoscaler_tpu.snapshot.arena import ArenaError, DeltaOp, DeltaProgram
+
+        n, p = len(self._node_slots), len(self._pod_slots)
+        host: Dict[str, np.ndarray] = dict(
+            node_alloc=self._node_alloc,
+            node_used=self._node_used,
+            node_valid=self._node_valid,
+            node_group=self._node_group,
+            pod_req=self._pod_req,
+            pod_valid=self._pod_valid,
+            pod_node=self._pod_node,
+        )
+        if self._dense:
+            host["sched_mask"] = self._mask
+        else:
+            host["pod_class"] = self._pod_class
+            host["node_class"] = self._node_class
+        reseed = self._arena_reseed
+        program = DeltaProgram(
+            host=host, reseed=reseed,
+            reseed_reason=self._arena_reseed_reason,
+        )
+        if reseed:
+            self._node_used_shadow = self._node_used.copy()
+        else:
+            ops = program.ops
+
+            def rows_op(fname: str, arr: np.ndarray, idx_set) -> None:
+                idx = np.asarray(
+                    sorted(i for i in idx_set if 0 <= i < arr.shape[0]),
+                    np.int32,
+                )
+                if idx.size:
+                    ops.append(DeltaOp(fname, 0, idx, arr[idx]))
+
+            if self._d_pod_rows:
+                rows_op("pod_req", self._pod_req, self._d_pod_rows)
+                rows_op("pod_valid", self._pod_valid, self._d_pod_rows)
+                if not self._dense:
+                    rows_op("pod_class", self._pod_class, self._d_pod_rows)
+            if self._d_pod_node:
+                rows_op("pod_node", self._pod_node, self._d_pod_node)
+            if self._d_node_rows:
+                rows_op("node_alloc", self._node_alloc, self._d_node_rows)
+                rows_op("node_valid", self._node_valid, self._d_node_rows)
+                if not self._dense:
+                    rows_op("node_class", self._node_class, self._d_node_rows)
+            group_rows = set(self._d_node_rows)
+            if self._d_node_group_all:
+                group_rows.update(range(n))
+            if group_rows:
+                rows_op("node_group", self._node_group, group_rows)
+            if "node_used" in self._dirty_fields:
+                changed = np.flatnonzero(
+                    (self._node_used != self._node_used_shadow).any(axis=1)
+                )
+                if changed.size:
+                    ops.append(DeltaOp(
+                        "node_used", 0, changed.astype(np.int32),
+                        self._node_used[changed],
+                    ))
+                    self._node_used_shadow[changed] = self._node_used[changed]
+            if self._dense and (
+                self._mask_bulk or self._mask_rows_d or self._mask_cols_d
+            ):
+                mrows = set(self._mask_rows_d)
+                if self._mask_bulk:
+                    # a bulk rebuild rewrote every live row: still a row
+                    # scatter (K rides the pow-8 ladder up to the bucket),
+                    # never a "full upload" — the ledger reserves that
+                    # word for reshape-forced re-seeds
+                    mrows.update(range(p))
+                rows_op("sched_mask", self._mask, mrows)
+                cols = np.asarray(
+                    sorted(
+                        j for j in self._mask_cols_d
+                        if 0 <= j < self._mask.shape[1]
+                    ),
+                    np.int32,
+                )
+                if cols.size:
+                    ops.append(DeltaOp(
+                        "sched_mask", 1, cols, self._mask[:, cols]
+                    ))
+        program.aux = self._aux_arrays(
+            all_fields=reseed or self._arena_resend_aux
+        )
+        try:
+            bufs = self._arena.apply(program)
+            self._arena_resend_aux = False
+        except ArenaError:
+            # rollback: the live generation is intact but stale — serve
+            # THIS tick from a cold upload so decisions stay correct, and
+            # let the arena reseed on the next update. The next program
+            # must also resend EVERY aux field: this tick's aux dirt is
+            # cleared below, but the arena never received the uploads —
+            # without the resend it would serve stale factored-mask
+            # factors after recovery.
+            self._arena_resend_aux = True
+            trace.add_event("arena.rollback", reason="apply_failed")
+            cold = dict(host)
+            cold.update(self._aux_arrays(all_fields=True))
+            # copy=True: a zero-copy asarray could alias the live host
+            # arrays, and this tick's served tensors must not mutate
+            # retroactively when the next update writes rows in place
+            bufs = {
+                name: jnp.array(arr, copy=True) for name, arr in cold.items()
+            }
+        self._arena_reseed = False
+        self._arena_reseed_reason = ""
+        self._dirty_fields.clear()
+        self._clear_delta_tracking()
+        common = dict(
+            node_alloc=bufs["node_alloc"],
+            node_used=bufs["node_used"],
+            node_valid=bufs["node_valid"],
+            node_group=bufs["node_group"],
+            pod_req=bufs["pod_req"],
+            pod_valid=bufs["pod_valid"],
+            pod_node=bufs["pod_node"],
+        )
+        if self._dense:
+            return SnapshotTensors(sched_mask=bufs["sched_mask"], **common)
+        return SnapshotTensors(
+            sched_mask=None,
+            pod_class=bufs["pod_class"],
+            node_class=bufs["node_class"],
+            class_mask=bufs["class_mask"],
+            exc_rows=bufs["exc_rows"],
+            pod_exc=bufs["pod_exc"],
+            cell_pod=bufs["cell_pod"],
+            cell_node=bufs["cell_node"],
+            cell_val=bufs["cell_val"],
+            **common,
+        )
+
     # ------------------------------------------------------------- assembly
     def _upload(self, name: str, arr: np.ndarray) -> object:
         if name in self._dirty_fields or name not in self._dev:
@@ -988,6 +1237,9 @@ class IncrementalPacker:
         return self._dev[name]
 
     def _assemble(self) -> SnapshotTensors:
+        if self._arena is not None:
+            return self._assemble_arena()
+        self._clear_delta_tracking()
         common = dict(
             node_alloc=self._upload("node_alloc", self._node_alloc),
             node_used=self._upload("node_used", self._node_used),

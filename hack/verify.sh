@@ -201,6 +201,54 @@ echo "== fleet batched-throughput gate (batched >= 2x sequential at >= 4 tenants
 python bench.py --fleet 8 >/dev/null
 echo "fleet bench gate ok"
 
+echo "== resident-arena determinism + parity gate (churn double-replay byte-identical; arena decisions byte-identical to cold-repack; ledger proves no steady-state compile or unexplained full upload) =="
+arena_tmp=$(mktemp -d)
+# churn-heavy canned scenario: add/remove/reassign storms crossing a
+# bucket boundary, plus an injected arena_fault (double-buffer rollback)
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/arena_churn.json \
+    --perf-ledger "$arena_tmp/a.perf.jsonl" --explain-ledger "$arena_tmp/a.explain.jsonl" >/dev/null
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/arena_churn.json \
+    --perf-ledger "$arena_tmp/b.perf.jsonl" --explain-ledger "$arena_tmp/b.explain.jsonl" >/dev/null
+if ! diff -q "$arena_tmp/a.perf.jsonl" "$arena_tmp/b.perf.jsonl" >/dev/null; then
+    echo "ERROR: arena perf ledger is nondeterministic across identical replays:" >&2
+    diff "$arena_tmp/a.perf.jsonl" "$arena_tmp/b.perf.jsonl" | head -20 >&2
+    exit 1
+fi
+if ! diff -q "$arena_tmp/a.explain.jsonl" "$arena_tmp/b.explain.jsonl" >/dev/null; then
+    echo "ERROR: arena decision ledger is nondeterministic across identical replays:" >&2
+    diff "$arena_tmp/a.explain.jsonl" "$arena_tmp/b.explain.jsonl" | head -20 >&2
+    exit 1
+fi
+# the SAME scenario on the cold-repack path: decisions must be
+# byte-identical — the arena changes how tensors reach the device,
+# never what the autoscaler decides
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/arena_churn.json \
+    --set arena_enabled=false --explain-ledger "$arena_tmp/c.explain.jsonl" >/dev/null
+if ! diff -q "$arena_tmp/a.explain.jsonl" "$arena_tmp/c.explain.jsonl" >/dev/null; then
+    echo "ERROR: arena-path decisions diverge from the cold-repack path:" >&2
+    diff "$arena_tmp/a.explain.jsonl" "$arena_tmp/c.explain.jsonl" | head -20 >&2
+    exit 1
+fi
+# ledger gates: compile-cache coherence (no steady-state compile) and
+# arena upload coherence (full uploads only with a promotion/rollback),
+# plus proof the scenario actually exercised both paths
+python bench.py --perf-ledger "$arena_tmp/a.perf.jsonl" > "$arena_tmp/report.json"
+python - "$arena_tmp/report.json" <<'EOF'
+import json, sys
+report = json.load(open(sys.argv[1]))
+assert report["valid"], report["errors"]
+arena = report.get("arena") or {}
+assert arena.get("delta_rows", 0) > 0, f"no delta scatters recorded: {arena}"
+assert arena.get("promotions", 0) > 0, f"scenario never crossed a bucket boundary: {arena}"
+assert arena.get("rollbacks", 0) > 0, f"scenario never exercised the fault rollback: {arena}"
+print(f"arena churn ledger ok ({report['ticks']} ticks, arena={arena})")
+EOF
+rm -rf "$arena_tmp"
+
+echo "== resident-arena steady-state gate (20k-pod CPU config: e2e <= 1.15x device, zero steady-state compiles/full uploads) =="
+python bench.py --arena >/dev/null
+echo "arena bench gate ok"
+
 echo "== unit tests (8-device virtual CPU mesh) =="
 python -m pytest tests/ -q -x
 

@@ -1,0 +1,619 @@
+"""Resident device arena (snapshot/arena.py + ops/arena_apply.py).
+
+Coverage map:
+- scatter-apply kernels vs the serial oracle twin (randomized shapes,
+  dtypes, padding indices) — the KERNEL_CONTRACTS parity discipline;
+- delta-bucket ladder + bucket-spec parsing;
+- arena-backed IncrementalPacker parity with the cold packer across
+  randomized churn (dense and factored mask forms), including bucket
+  promotions, fork/revert swap-fill + same-tick re-adds, idle-tick buffer
+  reuse, fault rollback and recovery reseed;
+- prewarm → first real tick's applies are compile-cache hits;
+- perf-ledger arena section validation (full-upload coherence gate);
+- the estimator's content-addressed operand arena;
+- run_once integration: arena-enabled decisions byte-equal to cold-path
+  decisions, residency pool + ledger section stamped;
+- loadgen double-run byte-identity with the arena enabled.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from autoscaler_tpu.estimator.reference_impl import apply_row_deltas_reference
+from autoscaler_tpu.kube.api import FakeClusterAPI
+from autoscaler_tpu.kube.objects import NUM_RESOURCES
+from autoscaler_tpu.ops.arena_apply import (
+    arena_scatter_cols,
+    arena_scatter_rows,
+    arena_scatter_vec,
+)
+from autoscaler_tpu.perf import PerfObservatory, validate_records
+from autoscaler_tpu.snapshot.arena import (
+    DeviceArena,
+    OperandArena,
+    delta_bucket,
+    delta_ladder,
+    parse_arena_buckets,
+)
+from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+from autoscaler_tpu.snapshot.incremental import IncrementalPacker
+from autoscaler_tpu.utils.test_utils import GB, MB, build_test_node, build_test_pod
+from autoscaler_tpu.fleet.buckets import BucketError
+
+SMALL_BUCKETS = "16x8x8"  # tiny prewarm ladder for fast tests
+
+
+# -- buckets / ladder ---------------------------------------------------------
+
+def test_parse_arena_buckets():
+    buckets = parse_arena_buckets("64x16x8,1024x256x8")
+    assert [(b.pods, b.groups, b.resources) for b in buckets] == [
+        (64, 16, 8), (1024, 256, 8)
+    ]
+    with pytest.raises(BucketError):
+        parse_arena_buckets("63x16x8")  # not a power of two
+    with pytest.raises(BucketError):
+        parse_arena_buckets("")
+
+
+def test_delta_bucket_ladder():
+    assert delta_bucket(1) == 8
+    assert delta_bucket(8) == 8
+    assert delta_bucket(9) == 64
+    assert delta_bucket(64) == 64
+    assert delta_bucket(65) == 512
+    assert delta_ladder(8) == [8]
+    assert delta_ladder(9) == [8, 64]
+    assert delta_ladder(512) == [8, 64, 512]
+
+
+# -- scatter kernels vs oracle twin ------------------------------------------
+
+@pytest.mark.parametrize("dtype", [np.float32, np.bool_, np.int32])
+def test_scatter_rows_matches_oracle(dtype):
+    rng = np.random.default_rng(3)
+    buf = (rng.random((24, 5)) * 10).astype(dtype)
+    idx_real = np.array([0, 3, 17, 23], np.int32)
+    payload_real = (rng.random((4, 5)) * 10).astype(dtype)
+    K = delta_bucket(idx_real.size)
+    idx = np.full((K,), buf.shape[0], np.int32)
+    idx[: idx_real.size] = idx_real
+    payload = np.zeros((K, 5), dtype)
+    payload[: idx_real.size] = payload_real
+    out = np.asarray(arena_scatter_rows(
+        jnp.asarray(buf), jnp.asarray(idx), jnp.asarray(payload)
+    ))
+    ref = apply_row_deltas_reference(buf, idx, payload, axis=0)
+    np.testing.assert_array_equal(out, ref)
+    # padding indices dropped: untouched rows keep their values
+    untouched = sorted(set(range(24)) - set(idx_real.tolist()))
+    np.testing.assert_array_equal(out[untouched], buf[untouched])
+
+
+def test_scatter_vec_and_cols_match_oracle():
+    rng = np.random.default_rng(4)
+    vec = rng.integers(-5, 5, 16).astype(np.int32)
+    idx = np.full((8,), 16, np.int32)
+    idx[:3] = [1, 7, 15]
+    vals = np.zeros((8,), np.int32)
+    vals[:3] = [41, 42, 43]
+    out = np.asarray(arena_scatter_vec(
+        jnp.asarray(vec), jnp.asarray(idx), jnp.asarray(vals)
+    ))
+    np.testing.assert_array_equal(
+        out, apply_row_deltas_reference(vec, idx, vals, axis=0)
+    )
+    mat = rng.random((6, 16)).astype(np.float32)
+    cols = np.zeros((6, 8), np.float32)
+    cols[:, :3] = rng.random((6, 3)).astype(np.float32)
+    out2 = np.asarray(arena_scatter_cols(
+        jnp.asarray(mat), jnp.asarray(idx), jnp.asarray(cols)
+    ))
+    np.testing.assert_array_equal(
+        out2, apply_row_deltas_reference(mat, idx, cols, axis=1)
+    )
+
+
+def test_oracle_rejects_bad_axis():
+    with pytest.raises(ValueError):
+        apply_row_deltas_reference(
+            np.zeros((4, 4)), np.zeros(2, np.int32), np.zeros((4, 4, 2)), axis=2
+        )
+
+
+# -- arena-backed packer parity ----------------------------------------------
+
+def _update(packer, nodes, pods):
+    return packer.update(
+        list(nodes.values()),
+        [(k, p) for k, (p, a) in pods.items()],
+        {k: a for k, (p, a) in pods.items()},
+    )
+
+
+def _assert_tensor_parity(ta, tb):
+    for f in (
+        "node_alloc", "node_used", "node_valid", "node_group",
+        "pod_req", "pod_valid", "pod_node",
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ta, f)), np.asarray(getattr(tb, f)), err_msg=f
+        )
+    np.testing.assert_array_equal(
+        np.asarray(ta.dense_sched()), np.asarray(tb.dense_sched()),
+        err_msg="sched mask",
+    )
+
+
+@pytest.mark.parametrize("dense", [True, False])
+def test_randomized_churn_parity(dense):
+    """Arena-served tensors byte-equal the cold packer's across 40 random
+    mutation steps (adds/removes/reassigns/respecs of pods and nodes),
+    including the bucket promotions the growth forces."""
+    rng = np.random.default_rng(7)
+    arena = DeviceArena(buckets=SMALL_BUCKETS)
+    pa = IncrementalPacker(dense_mask=dense, arena=arena)
+    pc = IncrementalPacker(dense_mask=dense)
+    nodes, pods = {}, {}
+    for step in range(40):
+        op = rng.integers(0, 6)
+        if op == 0 or not nodes:
+            name = f"n{step}"
+            nodes[name] = build_test_node(name, cpu_m=4000, mem=8 * GB)
+        elif op == 1 and len(nodes) > 1:
+            nodes.pop(rng.choice(list(nodes)))
+        elif op == 2 or not pods:
+            p = build_test_pod(
+                f"p{step}", cpu_m=int(rng.integers(50, 500)), mem=128 * MB
+            )
+            pods[p.key()] = (
+                p, rng.choice(list(nodes)) if rng.random() < 0.7 else ""
+            )
+        elif op == 3 and pods:
+            pods.pop(rng.choice(list(pods)))
+        elif op == 4 and pods:
+            k = rng.choice(list(pods))
+            pods[k] = (pods[k][0], rng.choice(list(nodes)))
+        else:
+            p = build_test_pod(f"p{step}r", cpu_m=77, mem=64 * MB)
+            pods[p.key()] = (p, "")
+        ta, ma = _update(pa, nodes, pods)
+        tb, mb = _update(pc, nodes, pods)
+        assert ma.pod_index == mb.pod_index
+        assert ma.node_index == mb.node_index
+        _assert_tensor_parity(ta, tb)
+    stats = arena.take_stats()
+    assert stats["delta_rows"] > 0          # deltas actually flowed
+    assert stats["promotions"] >= 1         # growth crossed a bucket
+
+
+def test_idle_tick_reuses_buffers():
+    arena = DeviceArena(buckets=SMALL_BUCKETS)
+    pa = IncrementalPacker(arena=arena)
+    nodes = {f"n{i}": build_test_node(f"n{i}", cpu_m=4000) for i in range(3)}
+    pods = {}
+    for i in range(6):
+        p = build_test_pod(f"p{i}", cpu_m=100)
+        pods[p.key()] = (p, f"n{i % 3}")
+    t1, _ = _update(pa, nodes, pods)
+    arena.take_stats()
+    t2, _ = _update(pa, nodes, pods)
+    stats = arena.take_stats()
+    assert stats["delta_rows"] == 0 and stats["full_uploads"] == 0
+    # unchanged world → the SAME device buffer objects (zero-cost tick)
+    assert t2.pod_req is t1.pod_req
+    assert t2.sched_mask is t1.sched_mask
+
+
+def test_bucket_promotion_is_the_only_full_upload():
+    arena = DeviceArena(buckets=SMALL_BUCKETS)
+    pa = IncrementalPacker(arena=arena)
+    pc = IncrementalPacker()
+    nodes = {f"n{i}": build_test_node(f"n{i}", cpu_m=4000) for i in range(3)}
+    pods = {}
+    for i in range(6):
+        p = build_test_pod(f"p{i}", cpu_m=100)
+        pods[p.key()] = (p, f"n{i % 3}")
+    _update(pa, nodes, pods)
+    _update(pc, nodes, pods)
+    arena.take_stats()
+    # within-bucket drift: rows change, no full upload
+    p = build_test_pod("p0", cpu_m=333)
+    pods[p.key()] = (p, "n0")
+    ta, _ = _update(pa, nodes, pods)
+    tb, _ = _update(pc, nodes, pods)
+    _assert_tensor_parity(ta, tb)
+    stats = arena.take_stats()
+    assert stats["full_uploads"] == 0 and stats["delta_rows"] > 0
+    # growth past the pod bucket (8) → promotion pays the one full upload
+    for i in range(6, 12):
+        p = build_test_pod(f"p{i}", cpu_m=100)
+        pods[p.key()] = (p, f"n{i % 3}")
+    ta, _ = _update(pa, nodes, pods)
+    tb, _ = _update(pc, nodes, pods)
+    _assert_tensor_parity(ta, tb)
+    stats = arena.take_stats()
+    assert stats["promotions"] == 1 and stats["full_uploads"] > 0
+
+
+def test_fault_rollback_serves_cold_then_reseeds():
+    arena = DeviceArena(buckets=SMALL_BUCKETS)
+    pa = IncrementalPacker(arena=arena)
+    pc = IncrementalPacker()
+    nodes = {f"n{i}": build_test_node(f"n{i}", cpu_m=4000) for i in range(3)}
+    pods = {}
+    for i in range(6):
+        p = build_test_pod(f"p{i}", cpu_m=100)
+        pods[p.key()] = (p, f"n{i % 3}")
+    t_live, _ = _update(pa, nodes, pods)
+    _update(pc, nodes, pods)
+    live_req = np.asarray(t_live.pod_req).copy()
+    arena.take_stats()
+    # the faulted tick: apply fails → the tick is served from a cold
+    # upload (correct), the LIVE arena generation is never corrupted
+    arena.fault_hook = lambda: "arena_fault"
+    p = build_test_pod("px", cpu_m=250)
+    pods[p.key()] = (p, "n0")
+    ta, _ = _update(pa, nodes, pods)
+    tb, _ = _update(pc, nodes, pods)
+    _assert_tensor_parity(ta, tb)
+    stats = arena.take_stats()
+    assert stats["rollbacks"] == 1 and stats["full_uploads"] == 0
+    np.testing.assert_array_equal(
+        np.asarray(arena.live()["pod_req"]), live_req,
+        err_msg="live generation must be untouched by the faulted apply",
+    )
+    # recovery: next update reseeds (full upload justified by rollback)
+    arena.fault_hook = None
+    p2 = build_test_pod("py", cpu_m=300)
+    pods[p2.key()] = (p2, "n1")
+    ta, _ = _update(pa, nodes, pods)
+    tb, _ = _update(pc, nodes, pods)
+    _assert_tensor_parity(ta, tb)
+    stats = arena.take_stats()
+    assert stats["full_uploads"] > 0 and stats["rollbacks"] == 1
+    assert stats["promotions"] == 0
+    # and steady state resumes
+    pods.pop("default/px")
+    ta, _ = _update(pa, nodes, pods)
+    tb, _ = _update(pc, nodes, pods)
+    _assert_tensor_parity(ta, tb)
+    stats = arena.take_stats()
+    assert stats["full_uploads"] == 0 and stats["delta_rows"] > 0
+
+
+def test_fault_on_aux_dirty_tick_resends_factored_factors():
+    """Review regression: a fault on a tick that dirtied the FACTORED
+    aux fields (class_mask/exc/cells) must not leave the arena serving
+    stale factors after recovery — the faulted tick's aux uploads never
+    reached the arena, so the next successful apply must resend them."""
+    arena = DeviceArena(buckets=SMALL_BUCKETS)
+    pa = IncrementalPacker(dense_mask=False, arena=arena)
+    pc = IncrementalPacker(dense_mask=False)
+    nodes = {f"n{i}": build_test_node(f"n{i}", cpu_m=4000) for i in range(3)}
+    pods = {}
+    for i in range(6):
+        p = build_test_pod(f"p{i}", cpu_m=100)
+        pods[p.key()] = (p, f"n{i % 3}")
+    _update(pa, nodes, pods)
+    _update(pc, nodes, pods)
+    # the faulted tick introduces a NEW POD CLASS (tolerations → fresh
+    # profile key → class_mask growth = aux dirt) — exactly the upload
+    # the fault drops on the floor
+    from autoscaler_tpu.kube.objects import Toleration
+
+    arena.fault_hook = lambda: "arena_fault"
+    special = build_test_pod("special", cpu_m=100)
+    special.tolerations = [Toleration(key="gpu", operator="Exists")]
+    pods[special.key()] = (special, "")
+    ta, _ = _update(pa, nodes, pods)
+    tb, _ = _update(pc, nodes, pods)
+    _assert_tensor_parity(ta, tb)            # faulted tick serves cold
+    arena.fault_hook = None
+    # recovery tick: a plain row change — aux must ALSO be resent
+    p = build_test_pod("p0", cpu_m=555)
+    pods[p.key()] = (p, "n0")
+    ta, _ = _update(pa, nodes, pods)
+    tb, _ = _update(pc, nodes, pods)
+    _assert_tensor_parity(ta, tb)
+    # and the arena's live view (not the cold fallback) carries the new
+    # class verdicts on the following steady tick too
+    p = build_test_pod("p1", cpu_m=444)
+    pods[p.key()] = (p, "n1")
+    ta, _ = _update(pa, nodes, pods)
+    tb, _ = _update(pc, nodes, pods)
+    _assert_tensor_parity(ta, tb)
+
+
+def test_swapfill_move_with_same_tick_readd():
+    """Satellite regression: a fork removes a pod (swap-fill moves the
+    last row into its slot) and the SAME tick re-adds the removed key as
+    a fresh object; delta bookkeeping must follow the moved rows or the
+    arena serves a stale mask row. Mirrors the fork→filter→revert flow
+    run_once drives every tick. The arena-backed packer must stay
+    byte-equal to the plain incremental packer (identical slot
+    bookkeeping), and both semantically equal to a fresh full pack."""
+    snap = ClusterSnapshot(
+        packer=IncrementalPacker(arena=DeviceArena(buckets="8x8x8"))
+    )
+    plain = ClusterSnapshot(packer=IncrementalPacker())
+    cold = ClusterSnapshot()
+
+    def check():
+        ta, ma = snap.tensors()
+        tp, mp = plain.tensors()
+        tc, mc = cold.tensors()
+        assert ma.pod_index == mp.pod_index     # same slot bookkeeping
+        _assert_tensor_parity(ta, tp)           # arena == incremental, byte
+        # semantic parity vs the fresh pack (row ORDER may differ after a
+        # swap-fill — compare per pod key / node name)
+        da, dc = np.asarray(ta.dense_sched()), np.asarray(tc.dense_sched())
+        for key, ia in ma.pod_index.items():
+            ic = mc.pod_index[key]
+            np.testing.assert_array_equal(
+                np.asarray(ta.pod_req)[ia], np.asarray(tc.pod_req)[ic],
+                err_msg=key,
+            )
+            na = np.asarray(ta.pod_node)[ia]
+            nc = np.asarray(tc.pod_node)[ic]
+            assert (ma.nodes[na].name if na >= 0 else None) == (
+                mc.nodes[nc].name if nc >= 0 else None
+            ), key
+            for name, ja in ma.node_index.items():
+                assert da[ia, ja] == dc[ic, mc.node_index[name]], (key, name)
+
+    for s in (snap, plain, cold):
+        for i in range(3):
+            s.add_node(build_test_node(f"n{i}", cpu_m=4000))
+        for i in range(8):  # full 8-row bucket: removals MUST swap-fill
+            s.add_pod(build_test_pod(f"p{i}", cpu_m=100), f"n{i % 3}")
+    check()
+    for s in (snap, plain, cold):
+        s.fork()
+        s.remove_pod("default/p2")          # p7 swap-fills into p2's row
+        s.tensors()                          # materialize mid-fork
+        s.add_pod(
+            build_test_pod("p2", cpu_m=999), "n1"
+        )                                    # same key, NEW object + assign
+        s.schedule_pod("default/p5", "n0")   # interleaved reassign
+    check()
+    for s in (snap, plain, cold):
+        s.revert()
+    check()
+
+
+# -- prewarm + observatory ----------------------------------------------------
+
+def test_prewarm_makes_first_tick_applies_cache_hits():
+    obs = PerfObservatory()
+    # bucket sized to the world below (PP=8, NN=8): prewarm only covers
+    # configured bucket shapes — operators size buckets to their world,
+    # exactly as bench.py --arena and deploy/ do
+    arena = DeviceArena(buckets="8x8x8", observatory=obs)
+    calls = arena.prewarm(R=NUM_RESOURCES)
+    assert calls > 0
+    packer = IncrementalPacker(arena=arena)
+    nodes = {f"n{i}": build_test_node(f"n{i}", cpu_m=4000) for i in range(3)}
+    pods = {}
+    for i in range(6):
+        p = build_test_pod(f"p{i}", cpu_m=100)
+        pods[p.key()] = (p, f"n{i % 3}")
+    obs.begin_tick(0, 0.0)
+    _update(packer, nodes, pods)             # seed (no scatter dispatch)
+    obs.end_tick()
+    p = build_test_pod("p0", cpu_m=500)
+    pods[p.key()] = (p, "n0")
+    obs.begin_tick(1, 1.0)
+    _update(packer, nodes, pods)             # first real delta tick
+    rec = obs.end_tick()
+    arena_dispatches = [
+        d for d in rec["dispatches"] if d["route"].startswith("arena_")
+    ]
+    assert arena_dispatches, "delta tick dispatched no arena scatters"
+    assert all(d["cache"] == "hit" for d in arena_dispatches), (
+        "prewarm must have registered every apply signature: "
+        f"{arena_dispatches}"
+    )
+
+
+# -- perf-ledger arena section ------------------------------------------------
+
+def _tick_rec(tick, arena=None):
+    rec = {
+        "schema": "autoscaler_tpu.perf.tick/1",
+        "tick": tick,
+        "now_ts": float(tick),
+        "dispatches": [],
+        "resident_bytes": {},
+    }
+    if arena is not None:
+        rec["arena"] = arena
+    return rec
+
+
+def test_ledger_arena_validation():
+    # init seed on the first arena record: allowed
+    ok = [
+        _tick_rec(0, {"full_uploads": 8, "promotions": 1, "delta_rows": 0}),
+        _tick_rec(1, {"full_uploads": 0, "delta_rows": 5}),
+        _tick_rec(2, {"full_uploads": 8, "promotions": 1, "delta_rows": 0}),
+        _tick_rec(3, {"full_uploads": 8, "rollbacks": 1, "delta_rows": 2}),
+    ]
+    assert validate_records(ok) == []
+    # an unexplained full upload on a steady-state tick is a regression
+    bad = [
+        _tick_rec(0, {"full_uploads": 8, "promotions": 1}),
+        _tick_rec(1, {"full_uploads": 8, "delta_rows": 3}),
+    ]
+    errors = validate_records(bad)
+    assert any("full-upload-on-steady-state-tick" in e for e in errors)
+    # malformed sections are schema errors
+    assert validate_records([_tick_rec(0, {"full_uploads": -1})])
+    assert validate_records([_tick_rec(0, {"bogus_key": 1})])
+
+
+def test_arena_stats_reach_tick_record_and_summary():
+    obs = PerfObservatory()
+    obs.begin_tick(5, 5.0)
+    obs.note_arena({"delta_rows": 7, "full_uploads": 0})
+    obs.note_arena({"delta_rows": 3, "full_uploads": 0})
+    rec = obs.end_tick()
+    assert rec["arena"] == {"delta_rows": 10, "full_uploads": 0}
+    from autoscaler_tpu.perf import summarize
+
+    summary = summarize([rec])
+    assert summary["arena"]["delta_rows"] == 10
+    # all-zero stats record nothing (idle ticks stay arena-free)
+    obs.begin_tick(6, 6.0)
+    obs.note_arena({"delta_rows": 0, "full_uploads": 0})
+    rec = obs.end_tick()
+    assert "arena" not in rec
+
+
+# -- operand arena ------------------------------------------------------------
+
+def test_operand_arena_content_keyed_residence():
+    oa = OperandArena(max_entries=4)
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    d1 = oa.resident(a)
+    d2 = oa.resident(a.copy())               # equal content → SAME buffer
+    assert d1 is d2
+    assert oa.stats() == {"hits": 1, "misses": 1, "entries": 1}
+    b = a + 1
+    d3 = oa.resident(b)                      # different content → miss
+    assert d3 is not d1
+    # same bytes, different shape → distinct keys
+    oa.resident(a.reshape(4, 3))
+    assert oa.stats()["entries"] == 3
+    # LRU bound holds
+    for i in range(6):
+        oa.resident(np.full((2, 2), i, np.float32))
+    assert oa.stats()["entries"] <= 4
+    assert oa.device_bytes() > 0
+
+
+def test_estimator_reuses_resident_operands():
+    from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+
+    oa = OperandArena()
+    est = BinpackingNodeEstimator(operand_arena=oa)
+    pods = [build_test_pod(f"p{i}", cpu_m=900, mem=1 * GB) for i in range(5)]
+    tmpl = build_test_node("tmpl", cpu_m=4000, mem=8 * GB)
+    r1 = est.estimate_many(pods, {"g": tmpl})
+    first = oa.stats()
+    assert first["misses"] > 0
+    r2 = est.estimate_many(pods, {"g": tmpl})
+    second = oa.stats()
+    assert second["misses"] == first["misses"], "steady re-estimate re-uploaded"
+    assert second["hits"] > first["hits"]
+    assert r1["g"][0] == r2["g"][0]
+    assert [p.key() for p in r1["g"][1]] == [p.key() for p in r2["g"][1]]
+
+
+# -- run_once integration -----------------------------------------------------
+
+def _build_autoscaler(arena_enabled: bool):
+    provider = TestCloudProvider()
+    api = FakeClusterAPI()
+    provider.add_node_group(
+        "g", 0, 10, 1, build_test_node("g-tmpl", cpu_m=2000, mem=4 * GB)
+    )
+    node = build_test_node("g-0", cpu_m=2000, mem=4 * GB)
+    provider.add_node("g", node)
+    api.add_node(node)
+    for i in range(5):
+        api.add_pod(build_test_pod(f"p{i}", cpu_m=900, mem=1 * GB))
+    opts = AutoscalingOptions(
+        expander="least-waste",
+        expander_random_seed=1,
+        arena_enabled=arena_enabled,
+        arena_buckets=SMALL_BUCKETS,
+    )
+    return StaticAutoscaler(provider, api, opts)
+
+
+def test_run_once_arena_decisions_match_cold_path():
+    a_arena = _build_autoscaler(arena_enabled=True)
+    a_cold = _build_autoscaler(arena_enabled=False)
+    for now in (100.0, 110.0, 120.0):
+        ra = a_arena.run_once(now_ts=now)
+        rc = a_cold.run_once(now_ts=now)
+        assert ra.pending_pods == rc.pending_pods
+        assert ra.filtered_schedulable == rc.filtered_schedulable
+        if rc.scale_up is None:
+            assert ra.scale_up is None
+        else:
+            assert ra.scale_up.scaled_up == rc.scale_up.scaled_up
+            assert ra.scale_up.chosen_group == rc.scale_up.chosen_group
+            assert ra.scale_up.new_nodes == rc.scale_up.new_nodes
+    # the arena run stamped its residency pool and ledger section
+    rec = a_arena.observatory.last_record()
+    assert rec["resident_bytes"].get("arena", 0) > 0
+    assert a_arena._arena is not None
+    assert a_cold._arena is None
+
+
+def test_run_once_arena_ledger_validates():
+    auto = _build_autoscaler(arena_enabled=True)
+    for now in (100.0, 110.0, 120.0, 130.0):
+        auto.run_once(now_ts=now)
+    records = auto.observatory.records()
+    assert validate_records(records) == []
+    assert any("arena" in r for r in records)
+
+
+# -- loadgen byte-identity ----------------------------------------------------
+
+def _mini_spec():
+    from autoscaler_tpu.loadgen.spec import ScenarioSpec
+
+    return ScenarioSpec.from_dict({
+        "name": "arena_mini",
+        "seed": 5,
+        "ticks": 6,
+        "node_groups": [{
+            "name": "pool", "min_size": 0, "max_size": 8,
+            "initial_size": 2, "cpu_m": 4000.0, "mem_mb": 16384.0,
+            "provision_ticks": 1,
+        }],
+        "workloads": [{
+            "kind": "steady", "rate": 2.0, "cpu_m": 1200.0,
+            "mem_mb": 1024.0, "completion_rate": 0.25,
+        }],
+        "events": [
+            {"at_tick": 3, "kind": "fault",
+             "fault": {"kind": "arena_fault", "end_tick": 1}},
+            {"at_tick": 4, "kind": "clear_faults"},
+        ],
+        "options": {"arena_enabled": True, "arena_buckets": SMALL_BUCKETS},
+    })
+
+
+def test_loadgen_arena_double_run_byte_identical():
+    from autoscaler_tpu.loadgen.driver import run_scenario
+
+    r1 = run_scenario(_mini_spec())
+    r2 = run_scenario(_mini_spec())
+    assert r1.perf_ledger_lines() == r2.perf_ledger_lines()
+    assert r1.decision_log() == r2.decision_log()
+    # the injected arena fault actually fired and rolled back
+    assert r1.injected_faults.get("arena_fault", 0) >= 1
+    recs = [json.loads(l) for l in r1.perf_ledger_lines().splitlines()]
+    assert validate_records(recs) == []
+    assert sum(r.get("arena", {}).get("rollbacks", 0) for r in recs) >= 1
+
+
+def test_loadgen_arena_decisions_match_cold_path():
+    from autoscaler_tpu.loadgen.driver import run_scenario
+
+    spec_cold = _mini_spec()
+    spec_cold.options["arena_enabled"] = False
+    r_arena = run_scenario(_mini_spec())
+    r_cold = run_scenario(spec_cold)
+    assert r_arena.decision_log() == r_cold.decision_log()

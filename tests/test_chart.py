@@ -15,15 +15,36 @@ def load_values():
 
 
 def render(text, values, namespace="kube-system"):
-    """Minimal {{ .Values.x.y }} / {{ .Release.Namespace }} renderer — the
-    chart deliberately sticks to plain substitutions so it stays testable
-    without a helm binary."""
+    """Minimal {{ .Values.x.y }} / {{ .Release.Namespace }} renderer plus
+    whole-line ``{{- if .Values.x }} … {{- end }}`` guards — the chart
+    deliberately sticks to these two forms so it stays testable without a
+    helm binary."""
 
     def lookup(path):
         cur = values
         for part in path.split(".")[2:]:
             cur = cur[part]
         return cur
+
+    # line-based conditional blocks: include the body iff every enclosing
+    # guard's value is truthy (helm truthiness for our value types:
+    # empty string / false / 0 / None are falsy)
+    out_lines = []
+    stack = []
+    for line in text.splitlines():
+        m_if = re.match(r"^\s*\{\{-?\s*if\s+(\.Values\.[\w.]+)\s*-?\}\}\s*$", line)
+        m_end = re.match(r"^\s*\{\{-?\s*end\s*-?\}\}\s*$", line)
+        if m_if:
+            stack.append(bool(lookup(m_if.group(1))))
+            continue
+        if m_end:
+            assert stack, "unbalanced {{- end }}"
+            stack.pop()
+            continue
+        if all(stack):
+            out_lines.append(line)
+    assert not stack, "unclosed {{- if }}"
+    text = "\n".join(out_lines) + "\n"
 
     def sub(m):
         expr = m.group(1).strip()
@@ -91,3 +112,18 @@ def test_rbac_covers_loop_needs():
         ("poddisruptionbudgets", "list"),
     ]:
         assert need in granted, need
+
+
+def test_empty_compile_cache_dir_renders_valid_deployment():
+    """arena.compileCacheDir: \"\" (cache disabled) must drop the flag,
+    the volumeMount, AND the volume — a bare `mountPath:` is an invalid
+    manifest the API server rejects."""
+    values = load_values()
+    values["arena"]["compileCacheDir"] = ""
+    out = render((CHART / "templates" / "deployment.yaml").read_text(), values)
+    dep = yaml.safe_load(out)
+    spec = dep["spec"]["template"]["spec"]
+    control = spec["containers"][0]
+    assert not any("--compile-cache-dir" in a for a in control["args"])
+    assert "volumeMounts" not in control
+    assert "volumes" not in spec

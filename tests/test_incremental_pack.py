@@ -585,3 +585,54 @@ def test_autoscaler_shares_packer_across_loops():
     assert provider._groups["g"].target_size() == 2  # scale-up still works
     assert a._packer.full_packs == packs_after_first  # loop 2 was a delta
     assert a._packer.incremental_updates > 0
+
+
+def test_swapfill_interleaved_with_same_update_readd():
+    """ISSUE 11 satellite regression: removals swap-fill rows while the
+    SAME update re-adds a previously-removed key as a new object and a
+    fresh key claims a freed slot — the delta-program emitter
+    (snapshot/arena.py) depends on this slot bookkeeping staying stable,
+    so it is pinned here against the full-pack oracle."""
+    w = World()
+    for i in range(3):
+        w.nodes[f"n{i}"] = build_test_node(f"n{i}", cpu_m=4000, mem=8 * GB)
+    for i in range(8):  # full 8-row bucket: any removal must swap-fill
+        p = build_test_pod(f"p{i}", cpu_m=100, mem=128 * MB)
+        w.pods[p.key()] = (p, f"n{i % 3}")
+    w.check()
+    # one update: drop p2 (p7 swap-fills into its row) and p5, re-add p2
+    # as a NEW object with a new assignment, and a fresh key p8 claims a
+    # freed slot — all in the same listing diff
+    w.pods.pop("default/p2")
+    w.pods.pop("default/p5")
+    p2 = build_test_pod("p2", cpu_m=999, mem=256 * MB)
+    w.pods[p2.key()] = (p2, "n1")
+    p8 = build_test_pod("p8", cpu_m=250, mem=64 * MB)
+    w.pods[p8.key()] = (p8, "")
+    w.check()
+    # and the NEXT update moves the re-added key again (remove a low row,
+    # forcing another swap-fill of the re-added pod's row)
+    w.pods.pop("default/p0")
+    w.check()
+
+
+def test_removed_key_readded_across_updates_lands_clean():
+    """Remove → (swap-fill) → re-add of the same key one update later:
+    the re-added pod must get a fresh, fully-derived row (requests, mask,
+    assignment), not the stale slot state its key used to own."""
+    w = World()
+    for i in range(2):
+        w.nodes[f"n{i}"] = build_test_node(f"n{i}", cpu_m=4000, mem=8 * GB)
+    for i in range(8):
+        p = build_test_pod(f"p{i}", cpu_m=100, mem=128 * MB)
+        w.pods[p.key()] = (p, f"n{i % 2}")
+    w.check()
+    removed = w.pods.pop("default/p3")
+    w.check()  # p7 swap-filled into p3's row
+    # same key returns with DIFFERENT spec and placement
+    p3 = build_test_pod("p3", cpu_m=777, mem=512 * MB)
+    w.pods[p3.key()] = (p3, "n1")
+    w.check()
+    # and a reassign of the swap-filled pod in the same world still lands
+    w.pods["default/p7"] = (w.pods["default/p7"][0], "n0")
+    w.check()
