@@ -103,6 +103,7 @@ REPLAY_SCOPES = (
     "estimator/",
     "explain/",
     "fleet/",
+    "gym/",
     "loadgen/",
     "perf/",
     "trace/",

@@ -60,6 +60,7 @@ from autoscaler_tpu.analysis.engine import (
 GL004_THREADED_SCOPES = (
     "explain/",
     "fleet/",
+    "gym/",
     "metrics/",
     "perf/",
     "snapshot/arena.py",

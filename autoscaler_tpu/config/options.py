@@ -9,13 +9,23 @@ the NodeGroupConfigProcessor pattern (processors/nodegroupconfig/).
 """
 from __future__ import annotations
 
+import dataclasses
+import functools
+import typing
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from autoscaler_tpu.fleet.buckets import (
     DEFAULT_ARENA_BUCKETS as _DEFAULT_ARENA_BUCKETS,
     DEFAULT_BUCKETS as _DEFAULT_FLEET_BUCKETS,
 )
+
+
+class OptionsError(ValueError):
+    """An AutoscalingOptions override that doesn't describe a real knob:
+    unknown field name, or a value whose type can't mean what the field
+    means. Raised BEFORE construction so the offending key is named —
+    loadgen --set and the gym PolicySpec seam both route through this."""
 
 
 @dataclass
@@ -142,6 +152,21 @@ class AutoscalingOptions:
     # scenario slots per coalesced batch (the kernel's leading S axis);
     # overflow chunks into further batches in the same window
     fleet_batch_scenarios: int = 8
+
+    # -- policy gym (autoscaler_tpu/gym) -------------------------------------
+    # concurrent candidate rollouts per tuning stage: the population axis
+    # of the gym tuner. Rollouts share one fleet coalescer, so estimator
+    # calls from parallel rollouts batch into shared mesh dispatches
+    # (Podracer-style: the population rides the scenario axis).
+    gym_rollout_workers: int = 4
+    # objective weights for the scorer's deterministic scalar, as
+    # "slo=1,cost=6,churn=0.5" ("" = the scorer's defaults). One number:
+    # the gym's reward and the human-facing report read the same section.
+    gym_objective_weights: str = ""
+    # route gym rollout estimator dispatches through the shared fleet
+    # coalescer (off = every rollout pays its own solo dispatches; the
+    # score is certified identical either way)
+    gym_fleet_coalesce: bool = True
 
     # -- cluster-wide resource limits (main.go:113-118) ----------------------
     max_nodes_total: int = 0                      # 0 = unlimited
@@ -278,3 +303,73 @@ class AutoscalingOptions:
         NodeGroupConfigProcessor / NodeGroup.GetOptions path,
         reference cloud_provider.go:230)."""
         return self.node_group_overrides.get(group_name, self.node_group_defaults)
+
+
+@functools.lru_cache(maxsize=1)
+def _field_types() -> Dict[str, Any]:
+    """Resolved (PEP 563) annotation per AutoscalingOptions field."""
+    hints = typing.get_type_hints(AutoscalingOptions)
+    return {f.name: hints[f.name] for f in dataclasses.fields(AutoscalingOptions)}
+
+
+def _type_ok(expected: Any, value: Any) -> bool:
+    """Conservative runtime check of one override value against a field
+    annotation. bool is NOT an int/float here (JSON true leaking into a
+    numeric knob is exactly the silent corruption this exists to catch);
+    ints promote to float fields, matching what JSON round-trips produce."""
+    origin = typing.get_origin(expected)
+    if origin is typing.Union:  # Optional[X] and friends
+        return any(_type_ok(arg, value) for arg in typing.get_args(expected))
+    if expected is type(None):
+        return value is None
+    if origin in (dict, Dict):
+        return isinstance(value, dict)
+    if origin in (list, List):
+        return isinstance(value, list)
+    if origin in (tuple,):
+        return isinstance(value, (list, tuple))
+    if expected is bool:
+        return isinstance(value, bool)
+    if expected is int:
+        return isinstance(value, int) and not isinstance(value, bool)
+    if expected is float:
+        return isinstance(value, (int, float)) and not isinstance(value, bool)
+    if expected is str:
+        return isinstance(value, str)
+    if isinstance(expected, type):
+        return isinstance(value, expected)
+    return True  # unparameterized/exotic annotation: don't guess
+
+
+def validate_overrides(overrides: Dict[str, Any]) -> None:
+    """Validate a {field name → value} override set against the
+    AutoscalingOptions schema BEFORE construction. An unknown key or a
+    type-mismatched value raises :class:`OptionsError` naming the offending
+    key — dataclasses accept any value silently, so without this gate a
+    typo'd ``--set scale_down_unneded_time_s=0`` or a string where a float
+    belongs would corrupt a run instead of exiting 2."""
+    fields = _field_types()
+    for key in sorted(overrides):
+        if key not in fields:
+            known = ", ".join(sorted(fields)[:6])
+            raise OptionsError(
+                f"unknown AutoscalingOptions key {key!r} "
+                f"(fields are e.g. {known}, ...)"
+            )
+        expected = fields[key]
+        value = overrides[key]
+        if not _type_ok(expected, value):
+            raise OptionsError(
+                f"AutoscalingOptions key {key!r} wants "
+                f"{_render_type(expected)}, got "
+                f"{type(value).__name__} ({value!r})"
+            )
+
+
+def _render_type(expected: Any) -> str:
+    origin = typing.get_origin(expected)
+    if origin is typing.Union:
+        return " | ".join(_render_type(a) for a in typing.get_args(expected))
+    if origin is not None:
+        return getattr(origin, "__name__", str(origin))
+    return getattr(expected, "__name__", str(expected))

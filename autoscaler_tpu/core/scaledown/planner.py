@@ -155,7 +155,13 @@ class ScaleDownPlanner:
         unremovable.extend(not_removable)
         self._last_unremovable = unremovable
 
-        unneeded_nodes = [snapshot.get_node(n) for n in empty_names]
+        # sorted(): empty_names is a SET, and this list's order becomes the
+        # UnneededNodes insertion order, which is the order nodes_to_delete
+        # walks when it crops to max_empty_bulk_delete — iterating the set
+        # raw let PYTHONHASHSEED pick WHICH empty nodes die (caught by the
+        # gym tuning ledger's cross-process byte-diff; the runtime
+        # sanitizer can't see it because no ambient source fires)
+        unneeded_nodes = [snapshot.get_node(n) for n in sorted(empty_names)]
         unneeded_nodes += [r.node for r in to_remove]
         self.unneeded.update([n for n in unneeded_nodes if n is not None], now_ts)
         self._empty_names = empty_names

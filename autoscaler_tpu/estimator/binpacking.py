@@ -226,8 +226,17 @@ class BinpackingNodeEstimator:
         ladder: Optional[KernelLadder] = None,  # circuit-broken rung state
         observatory=None,  # perf.PerfObservatory; None = no perf telemetry
         operand_arena=None,  # snapshot/arena.OperandArena; None = cold uploads
+        fleet_client=None,  # gym.FleetEstimatorClient; None = solo dispatch
     ):
         self.limiter = limiter or ThresholdBasedEstimationLimiter()
+        # fleet-coalesced dispatch seam (autoscaler_tpu/gym): when seated,
+        # plain (no dynamic-affinity) estimate_many dispatches submit
+        # their packed operands to a SHARED fleet coalescer and block for
+        # the demuxed answer — concurrent rollouts of the policy gym batch
+        # their estimator calls into shared mesh dispatches. Answers are
+        # certified batch-invariant (the PR-8 fairness property), so
+        # seating a client changes amortization, never a decision's value.
+        self.fleet_client = fleet_client
         self.metrics = metrics
         self.ladder = ladder or KernelLadder()
         self.ladder.bind_metrics(metrics)
@@ -504,6 +513,17 @@ class BinpackingNodeEstimator:
         caps = np.array(
             [self.limiter.node_cap(headrooms.get(g, 0)) for g in names], np.int32
         )
+        if self.fleet_client is not None and not dynamic_affinity:
+            # fleet-coalesced lane (policy-gym rollouts): the plain packed
+            # operands ride the shared coalescer's admission queue instead
+            # of this estimator's own ladder. Run compression is skipped —
+            # the batched kernel has no runs twin — which trades scan steps
+            # for cross-rollout batching; per-group verdicts are identical
+            # (all rungs and the batched kernel share the one FFD order
+            # spec). Any failure falls back to the solo walk below.
+            out = self._fleet_estimate(pods, names, templates, caps)
+            if out is not None:
+                return out
         if not dynamic_affinity:
             # Equivalence dedup pays when it actually compresses: scan steps
             # drop from P to U (one per unique pod type), the big win at the
@@ -736,6 +756,53 @@ class BinpackingNodeEstimator:
                  lambda: self._host_plain_from_arrays(
                      pods, names, req, masks, allocs, caps, native=False)),
             ], forced=("xla_scan", xla_plain_fn))
+
+    # -- fleet-coalesced dispatch (autoscaler_tpu/gym rollouts) ---------------
+    def _fleet_estimate(
+        self, pods, names, templates, caps
+    ) -> Optional[Dict[str, Tuple[int, List[Pod]]]]:
+        """One plain batched estimate through the shared fleet coalescer:
+        submit the packed operands as a FleetRequest, block for the
+        demuxed answer. Returns None on ANY failure (coalescer stopped,
+        deadline, fleet rungs exhausted) so the caller's solo ladder keeps
+        deciding — the coalescer is an amortization, never a dependency."""
+        P = bucket_size(len(pods))
+        try:
+            req, masks, allocs = _build_group_arrays(
+                pods, names, templates, interpod=True, pad=P
+            )
+            self._explain_scratch = {
+                "kind": "pods", "names": list(names), "req": req,
+                "masks": masks, "allocs": allocs,
+                "involved": np.zeros((P,), bool),
+            }
+            max_nodes = int(caps.max()) if len(caps) else 0
+            with trace.span(
+                metrics_mod.FLEET_DISPATCH, metrics=self.metrics,
+                rung="coalesced", pods=len(pods), groups=len(names),
+            ) as sp:
+                counts, scheduled = self.fleet_client.estimate_groups(
+                    req, masks, allocs, caps, max_nodes
+                )
+                sp.set_attrs(outcome="ok", route="fleet_coalesced")
+        except Exception:  # noqa: BLE001 — degrade to the solo ladder,
+            # keep deciding (same posture as every other rung failure)
+            logging.getLogger("estimator").warning(
+                "fleet-coalesced estimate failed; falling back to the "
+                "solo kernel ladder", exc_info=True,
+            )
+            self._explain_scratch = None
+            return None
+        self._note_route("fleet_coalesced", "ok")
+        counts = np.asarray(counts)
+        scheduled = np.asarray(scheduled)
+        return {
+            g: (
+                int(counts[gi]),
+                [p for i, p in enumerate(pods) if scheduled[gi, i]],
+            )
+            for gi, g in enumerate(names)
+        }
 
     # -- degradation ladder (utils/circuit.py + estimator/ladder.py) ---------
     def _walk_ladder(self, steps, initial_reason: str = "ok", forced=None):

@@ -1,0 +1,5 @@
+import sys
+
+from autoscaler_tpu.gym.cli import main
+
+sys.exit(main())

@@ -105,10 +105,17 @@ def _run(spec: ScenarioSpec, report_path: str, log_path: str,
         return _run_fleet(spec, report_path, log_path, trace_path,
                           chrome_trace_path, perf_ledger_path)
     from autoscaler_tpu.loadgen.driver import run_scenario
-    from autoscaler_tpu.loadgen.score import build_report
+    from autoscaler_tpu.loadgen.score import ObjectiveWeights, build_report
 
     result = run_scenario(spec, real_sleep=real_sleep)
-    report = build_report(result)
+    # the objective weights ride the same override seam as every other
+    # option (--set gym_objective_weights=cost=20): a report scored with
+    # different weights than the tuning ledger would break the "humans
+    # and the gym read the same number" contract
+    weights = ObjectiveWeights.parse(
+        spec.options.get("gym_objective_weights", "")
+    )
+    report = build_report(result, weights=weights)
     print(json.dumps(report, indent=2, sort_keys=True))
     if report_path:
         _write(report_path, report)
@@ -227,7 +234,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                               explain_ledger_path=args.explain_ledger)
             return _sanitized(go) if args.sanitize else go()
         if args.command == "validate":
-            spec = ScenarioSpec.load(args.scenario)
+            with open(args.scenario) as f:
+                doc = json.load(f)
+            from autoscaler_tpu.loadgen.suite import SuiteSpec, is_suite_doc
+
+            if is_suite_doc(doc):
+                # a gym tuning suite (benchmarks/scenarios/gym_suite.json):
+                # every member scenario must parse + round-trip like any
+                # canned spec
+                suite = SuiteSpec.from_dict(doc)
+                roundtrip = SuiteSpec.from_dict(suite.to_dict())
+                assert roundtrip.to_dict() == suite.to_dict(), \
+                    "suite round-trip mismatch"
+                print(f"ok: suite {suite.name} "
+                      f"({len(suite.scenarios)} scenarios: "
+                      f"{', '.join(suite.scenario_names())})")
+                return 0
+            spec = ScenarioSpec.from_dict(doc)
             roundtrip = ScenarioSpec.from_json(spec.to_json())
             assert roundtrip == spec, "round-trip mismatch"
             fleet_note = (

@@ -26,13 +26,14 @@ tests/test_loadgen.py.
 from __future__ import annotations
 
 import dataclasses
+import math
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from autoscaler_tpu.cloudprovider.interface import Instance, InstanceState
 from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
-from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.config.options import AutoscalingOptions, OptionsError
 from autoscaler_tpu.core.scaledown.actuator import ScaleDownActuator
 from autoscaler_tpu.core.static_autoscaler import RunOnceResult, StaticAutoscaler
 from autoscaler_tpu.kube.api import EvictionError, FakeClusterAPI
@@ -123,6 +124,12 @@ class TickRecord:
     nodes_ready: int = 0
     nodes_total: int = 0
     bound_pods: int = 0
+    # capacity lower bound for the pods alive at tick end — ceil(live
+    # requested cpu / biggest node cpu). The scorer's objective section
+    # charges over-provisioning against this (score.build_objective), and
+    # the gym's per-step reward reads the same number, so it rides the
+    # decision log (pure function of the world state — byte-stable).
+    demand_nodes: int = 0
     cluster_healthy: bool = True
     wall_s: float = 0.0
 
@@ -245,6 +252,11 @@ class ScenarioDriver:
         self._flapped: Dict[str, int] = {}   # node name → recovery tick
         self.pod_latency: Dict[str, Tuple[int, Optional[int]]] = {}
         self.total_requested_cpu_m = 0.0
+        # the objective's capacity denominator (score.build_objective):
+        # biggest node shape in the scripted cloud
+        self._max_group_cpu = max(
+            (g.cpu_m for g in spec.node_groups), default=0.0
+        )
         self._build_world()
         opts_kw = dict(_DRIVER_DEFAULTS)
         # expander tie-breaks must replay: pin the chain's random fallback
@@ -265,8 +277,15 @@ class ScenarioDriver:
         opts_kw["scale_down_unneeded_time_s"] = 2 * spec.tick_interval_s
         opts_kw.update(spec.options)
         try:
+            # schema-checked BEFORE construction: an unknown key or a
+            # type-mismatched value exits with the offending key named
+            # (dataclasses would silently accept any value) — the contract
+            # `loadgen run --set` and the gym PolicySpec seam rely on
+            from autoscaler_tpu.config.options import validate_overrides
+
+            validate_overrides(spec.options)
             self.options = AutoscalingOptions(**opts_kw)
-        except TypeError as e:
+        except (OptionsError, TypeError) as e:
             raise SpecError(f"bad scenario options: {e}") from None
         # the planner gates on the per-group defaults, not the flat fields
         # (NodeGroupConfigProcessor pattern) — mirror main.py:287's sync so
@@ -525,105 +544,125 @@ class ScenarioDriver:
         return len(assignments)
 
     # -- the loop -------------------------------------------------------------
-    def run(self) -> RunResult:
-        spec = self.spec
-        records: List[TickRecord] = []
-        peak_nodes = len(self.api.nodes)
-        by_tick: Dict[int, List[Event]] = {}
+    # run() is the one-shot entry; begin()/tick_once()/finish() are the
+    # SAME loop exposed tick-at-a-time for the policy gym's step() API
+    # (autoscaler_tpu/gym/env.py) — the env drives the identical code path,
+    # which is what makes rollout-vs-direct decision parity structural.
+    def begin(self) -> None:
+        """Arm the tick loop: resolve the per-tick event index and the
+        running aggregates run()/finish() maintain."""
+        self._records: List[TickRecord] = []
+        self._peak_nodes = len(self.api.nodes)
+        self._by_tick: Dict[int, List[Event]] = {}
         for ev in self.timeline:
-            by_tick.setdefault(ev.at_tick, []).append(ev)
-        for tick in range(spec.ticks):
-            self.injector.tick = tick
-            now = BASE_TS + tick * spec.tick_interval_s
-            self._recover_flaps(tick)
-            for ev in by_tick.get(tick, ()):
-                self._apply_event(ev, tick)
-            pending_before = sum(
-                1 for p in self.api.list_pods() if not p.node_name
-            )
-            # tag this tick's trace with scenario coordinates: the span
-            # tree carries sim-time, so a /tracez trace from a replay can
-            # be lined up against the decision log by (scenario, tick)
-            self.tracer.set_context(
-                scenario=spec.name, tick=tick, sim_ts=now
-            )
-            t0 = time.perf_counter()
-            self.api.in_run_once = True
-            try:
-                result = self.autoscaler.run_once(now_ts=now)
-            except Exception as e:  # noqa: BLE001 — crash-only analog:
-                # main.run_loop catches per-iteration crashes; the driver
-                # does the same so kube_api_error scenarios certify that
-                # the loop survives (the tick records the typed error)
-                from autoscaler_tpu.utils.errors import to_autoscaler_error
+            self._by_tick.setdefault(ev.at_tick, []).append(ev)
 
-                err = to_autoscaler_error(e)
-                result = RunOnceResult(
-                    # a crashed tick established nothing about the cluster:
-                    # report unhealthy, not the dataclass default
-                    cluster_healthy=False,
-                    errors=[f"run_once crashed ({err.error_type.value}): {err}"],
-                )
-            finally:
-                self.api.in_run_once = False
-            wall = time.perf_counter() - t0
-            self._materialize_cloud(tick)
-            bound = self._bind_pods(tick)
-            rec = TickRecord(
-                tick=tick,
-                now_ts=now,
-                pending_before=pending_before,
-                pending_after=sum(
-                    1 for p in self.api.list_pods() if not p.node_name
-                ),
-                unneeded=result.unneeded_nodes,
-                nodes_ready=sum(1 for n in self.api.list_nodes() if n.ready),
-                nodes_total=len(self.api.nodes),
-                bound_pods=bound,
-                cluster_healthy=result.cluster_healthy,
-                errors=sorted(result.errors),
-                degraded=sorted(self.autoscaler.degraded_rungs()),
-                backed_off=sorted(
-                    g.id()
-                    for g in self.provider.node_groups()
-                    if self.autoscaler.csr.backoff.is_backed_off(g.id(), now)
-                ),
-                wall_s=wall,
+    def tick_once(self, tick: int) -> TickRecord:
+        """One scan interval: events → run_once → cloud/kubelet analog →
+        scheduler analog → decision-log record."""
+        spec = self.spec
+        self.injector.tick = tick
+        now = BASE_TS + tick * spec.tick_interval_s
+        self._recover_flaps(tick)
+        for ev in self._by_tick.get(tick, ()):
+            self._apply_event(ev, tick)
+        pending_before = sum(
+            1 for p in self.api.list_pods() if not p.node_name
+        )
+        # tag this tick's trace with scenario coordinates: the span
+        # tree carries sim-time, so a /tracez trace from a replay can
+        # be lined up against the decision log by (scenario, tick)
+        self.tracer.set_context(
+            scenario=spec.name, tick=tick, sim_ts=now
+        )
+        t0 = time.perf_counter()
+        self.api.in_run_once = True
+        try:
+            result = self.autoscaler.run_once(now_ts=now)
+        except Exception as e:  # noqa: BLE001 — crash-only analog:
+            # main.run_loop catches per-iteration crashes; the driver
+            # does the same so kube_api_error scenarios certify that
+            # the loop survives (the tick records the typed error)
+            from autoscaler_tpu.utils.errors import to_autoscaler_error
+
+            err = to_autoscaler_error(e)
+            result = RunOnceResult(
+                # a crashed tick established nothing about the cluster:
+                # report unhealthy, not the dataclass default
+                cluster_healthy=False,
+                errors=[f"run_once crashed ({err.error_type.value}): {err}"],
             )
-            if result.scale_up is not None and result.scale_up.scaled_up:
-                # the orchestrator's actual executed list (balancing can
-                # hand the chosen group zero nodes)
-                rec.scale_ups = sorted(
-                    (g, int(d)) for g, d in result.scale_up.executed if d > 0
-                )
-            if result.scale_up is not None and result.scale_up.error:
-                rec.errors = sorted(rec.errors + [result.scale_up.error])
-            if result.scale_down is not None:
-                rec.scale_downs = sorted(
-                    result.scale_down.deleted_empty
-                    + result.scale_down.deleted_drain
-                )
-                rec.evicted = sorted(result.scale_down.evicted_pods)
-            records.append(rec)
-            peak_nodes = max(peak_nodes, len(self.api.nodes))
-        group_cpu = {
-            g.name: g.cpu_m for g in spec.node_groups
-        }
+        finally:
+            self.api.in_run_once = False
+        wall = time.perf_counter() - t0
+        self._materialize_cloud(tick)
+        bound = self._bind_pods(tick)
+        live_cpu = sum(p.requests.cpu_m for p in self.api.list_pods())
+        rec = TickRecord(
+            tick=tick,
+            now_ts=now,
+            pending_before=pending_before,
+            pending_after=sum(
+                1 for p in self.api.list_pods() if not p.node_name
+            ),
+            unneeded=result.unneeded_nodes,
+            nodes_ready=sum(1 for n in self.api.list_nodes() if n.ready),
+            nodes_total=len(self.api.nodes),
+            bound_pods=bound,
+            demand_nodes=(
+                int(math.ceil(live_cpu / self._max_group_cpu))
+                if self._max_group_cpu > 0 else 0
+            ),
+            cluster_healthy=result.cluster_healthy,
+            errors=sorted(result.errors),
+            degraded=sorted(self.autoscaler.degraded_rungs()),
+            backed_off=sorted(
+                g.id()
+                for g in self.provider.node_groups()
+                if self.autoscaler.csr.backoff.is_backed_off(g.id(), now)
+            ),
+            wall_s=wall,
+        )
+        if result.scale_up is not None and result.scale_up.scaled_up:
+            # the orchestrator's actual executed list (balancing can
+            # hand the chosen group zero nodes)
+            rec.scale_ups = sorted(
+                (g, int(d)) for g, d in result.scale_up.executed if d > 0
+            )
+        if result.scale_up is not None and result.scale_up.error:
+            rec.errors = sorted(rec.errors + [result.scale_up.error])
+        if result.scale_down is not None:
+            rec.scale_downs = sorted(
+                result.scale_down.deleted_empty
+                + result.scale_down.deleted_drain
+            )
+            rec.evicted = sorted(result.scale_down.evicted_pods)
+        self._records.append(rec)
+        self._peak_nodes = max(self._peak_nodes, len(self.api.nodes))
+        return rec
+
+    def finish(self) -> RunResult:
         return RunResult(
-            spec=spec,
-            records=records,
+            spec=self.spec,
+            records=self._records,
             trace=[_event_dict(e) for e in self.timeline],
             metrics=self.metrics,
             pod_latency=dict(self.pod_latency),
             injected_faults=dict(self.injector.injected),
-            peak_nodes=peak_nodes,
+            peak_nodes=self._peak_nodes,
             final_nodes=len(self.api.nodes),
             total_requested_cpu_m=self.total_requested_cpu_m,
-            group_cpu_m=max(group_cpu.values()) if group_cpu else 0.0,
+            group_cpu_m=self._max_group_cpu,
             recorder=self.tracer.recorder,
             perf_records=self.autoscaler.observatory.records(),
             explain_records=self.autoscaler.explainer.records(),
         )
+
+    def run(self) -> RunResult:
+        self.begin()
+        for tick in range(self.spec.ticks):
+            self.tick_once(tick)
+        return self.finish()
 
 
 def _event_dict(ev: Event) -> Dict[str, Any]:

@@ -18,10 +18,12 @@ object with a ``metric`` name and a ``platform`` field.
 """
 from __future__ import annotations
 
+import dataclasses
 import math
-from typing import Any, Dict, List
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
 
-from autoscaler_tpu.loadgen.driver import RunResult
+from autoscaler_tpu.loadgen.driver import RunResult, TickRecord
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -31,7 +33,98 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[idx]
 
 
-def build_report(result: RunResult) -> Dict[str, Any]:
+@dataclass(frozen=True)
+class ObjectiveWeights:
+    """Weights of the scorer's one deterministic scalar — the number the
+    policy gym minimizes and the report prints, so humans and the tuner
+    read the SAME objective (ISSUE 12). Units: w_slo per pending-pod-tick,
+    w_cost per over-provisioned node-hour, w_churn per node added/removed."""
+
+    w_slo: float = 1.0
+    w_cost: float = 8.0
+    w_churn: float = 0.25
+
+    @classmethod
+    def parse(cls, text: str) -> "ObjectiveWeights":
+        """``"slo=1,cost=8,churn=0.25"`` (any subset; "" = defaults)."""
+        kw: Dict[str, float] = {}
+        for part in str(text or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, sep, raw = part.partition("=")
+            field = f"w_{key.strip()}"
+            if not sep or field not in {f.name for f in dataclasses.fields(cls)}:
+                raise ValueError(
+                    f"objective weights want slo=/cost=/churn= entries, "
+                    f"got {part!r}"
+                )
+            kw[field] = float(raw)
+        return cls(**kw)
+
+    def to_dict(self) -> Dict[str, float]:
+        return {"slo": self.w_slo, "cost": self.w_cost, "churn": self.w_churn}
+
+
+DEFAULT_WEIGHTS = ObjectiveWeights()
+
+
+def tick_objective(
+    rec: TickRecord, tick_interval_s: float,
+    weights: ObjectiveWeights = DEFAULT_WEIGHTS,
+) -> float:
+    """One tick's objective contribution — the gym env's per-step cost
+    (reward = its negation). Summing this over a run's records equals
+    build_objective's weighted total up to float association, so per-step
+    rewards and the report's scalar can never tell different stories."""
+    over = max(rec.nodes_total - rec.demand_nodes, 0)
+    churn = sum(d for _, d in rec.scale_ups) + len(rec.scale_downs)
+    return (
+        weights.w_slo * rec.pending_after
+        + weights.w_cost * over * tick_interval_s / 3600.0
+        + weights.w_churn * churn
+    )
+
+
+def build_objective(
+    records: List[TickRecord], tick_interval_s: float,
+    weights: ObjectiveWeights = DEFAULT_WEIGHTS,
+) -> Dict[str, Any]:
+    """The deterministic scalar a policy answers for, decomposed:
+
+    - ``pending_pod_ticks`` — Σ pods still pending after each tick (every
+      tick a pod waits is SLO pain, the KIS-S latency axis);
+    - ``over_provisioned_node_hours`` — Σ max(nodes − demand bound, 0)
+      node-hours, demand being each tick's ceil(live cpu / biggest node)
+      (TickRecord.demand_nodes — the cost axis);
+    - ``scale_churn`` — nodes added + removed over the run (thrash);
+    - ``weighted_total`` = w_slo·slo + w_cost·cost + w_churn·churn.
+
+    Pure function of the decision log → byte-identical across replays."""
+    pending_ticks = sum(r.pending_after for r in records)
+    over_hours = sum(
+        max(r.nodes_total - r.demand_nodes, 0) for r in records
+    ) * tick_interval_s / 3600.0
+    churn = sum(
+        sum(d for _, d in r.scale_ups) + len(r.scale_downs) for r in records
+    )
+    total = (
+        weights.w_slo * pending_ticks
+        + weights.w_cost * over_hours
+        + weights.w_churn * churn
+    )
+    return {
+        "pending_pod_ticks": int(pending_ticks),
+        "over_provisioned_node_hours": round(over_hours, 6),
+        "scale_churn": int(churn),
+        "weights": weights.to_dict(),
+        "weighted_total": round(total, 6),
+    }
+
+
+def build_report(
+    result: RunResult, weights: Optional[ObjectiveWeights] = None
+) -> Dict[str, Any]:
     import jax
 
     spec = result.spec
@@ -114,6 +207,12 @@ def build_report(result: RunResult) -> Dict[str, Any]:
             "max": round(_percentile(walls, 1.0), 4),
             "total": round(sum(walls), 3),
         },
+        # THE number a policy answers for (and the gym minimizes): one
+        # deterministic scalar over the decision log, decomposed so the
+        # SLO/cost/churn trade is readable
+        "objective": build_objective(
+            result.records, interval, weights or DEFAULT_WEIGHTS
+        ),
         "injected_faults": result.injected_faults,
     }
     if phases:

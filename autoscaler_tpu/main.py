@@ -270,6 +270,18 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--fleet-batch-scenarios", type=int, default=8,
                    help="fleet serving: scenario slots per coalesced batch "
                         "(the batched kernel's leading axis)")
+    p.add_argument("--gym-rollout-workers", type=int, default=4,
+                   help="policy gym: concurrent candidate rollouts per "
+                        "tuning stage (autoscaler_tpu/gym)")
+    p.add_argument("--gym-objective-weights", default="",
+                   help='policy gym: objective weights as '
+                        '"slo=1,cost=8,churn=0.25" (empty = scorer '
+                        "defaults); humans and the tuner read the same "
+                        "scalar")
+    p.add_argument("--gym-fleet-coalesce", type=_bool_flag, default=True,
+                   help="policy gym: route rollout estimator dispatches "
+                        "through the shared fleet coalescer (scores are "
+                        "identical either way)")
     p.add_argument("--record-duplicated-events", type=_bool_flag, default=False,
                    help="post every event instead of suppressing repeats "
                         "within the correlator window")
@@ -396,6 +408,9 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         arena_enabled=args.arena_enabled,
         arena_buckets=args.arena_buckets,
         compile_cache_dir=args.compile_cache_dir,
+        gym_rollout_workers=args.gym_rollout_workers,
+        gym_objective_weights=args.gym_objective_weights,
+        gym_fleet_coalesce=args.gym_fleet_coalesce,
         force_daemonsets=args.force_ds,
     )
     opts.node_group_defaults.scale_down_unneeded_time_s = args.scale_down_unneeded_time

@@ -38,6 +38,8 @@ PERF_RECORD = "perfRecord"  # per-tick perf-ledger assembly (autoscaler_tpu/perf
 EXPLAIN_RECORD = "explainRecord"  # per-tick decision-record assembly (autoscaler_tpu/explain)
 FLEET_DISPATCH = "fleetDispatch"  # one coalesced multi-tenant batch dispatch (autoscaler_tpu/fleet)
 FLEET_PREWARM = "fleetPrewarm"  # startup bucket pre-warm sweep (autoscaler_tpu/fleet)
+GYM_ROLLOUT = "gymRollout"  # one policy-gym candidate episode (autoscaler_tpu/gym)
+GYM_GENERATION = "gymGeneration"  # one tuner generation: sample + evaluate + prune (autoscaler_tpu/gym)
 
 # function_duration_seconds bucket ladder. The reference's histogram starts
 # at 0.01s (metrics.go:209-218) — every sub-millisecond device dispatch
@@ -525,6 +527,24 @@ class AutoscalerMetrics:
         self.fleet_prewarmed_buckets = r.gauge(
             p + "fleet_prewarmed_buckets",
             "shape buckets pre-warmed at startup",
+        )
+        # -- policy gym (autoscaler_tpu/gym): the tuning workload. Rollout
+        # and generation spans ride the shared FunctionLabel taxonomy
+        # (gymRollout / gymGeneration); these series carry the search's
+        # own progress.
+        self.gym_rollouts_total = r.counter(
+            p + "gym_rollouts_total",
+            "policy-gym candidate episodes completed, by scenario",
+        )
+        self.gym_generation_best_score = r.gauge(
+            p + "gym_generation_best_score",
+            "best-so-far candidate score (reward; non-decreasing by "
+            "elitism) after each tuner generation",
+        )
+        self.gym_candidates_pruned_total = r.counter(
+            p + "gym_candidates_pruned_total",
+            "candidates eliminated by successive halving before the full "
+            "suite",
         )
 
     def observe_duration_value(self, label: str, elapsed: float) -> float:

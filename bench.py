@@ -675,6 +675,35 @@ def _explain_ledger_main(path: str) -> int:
     return 1 if errors else 0
 
 
+def _gym_ledger_main(path: str) -> int:
+    """``bench.py --gym-ledger <ledger.jsonl>``: validate a tuning JSONL
+    ledger (schema, generation monotonicity, candidate/score shapes, the
+    gen-0 all-defaults baseline, and the improvement invariant —
+    best-so-far score never decreases) and print the aggregated report
+    (winner, trajectory, improvement over the baseline — the number
+    hack/verify.sh gates on). Exit 0 = valid, 1 = schema/invariant
+    errors, 2 = unreadable ledger."""
+    from autoscaler_tpu.gym import load_jsonl, summarize, validate_records
+
+    try:
+        records = load_jsonl(path)
+    except (OSError, ValueError) as e:
+        print(json.dumps({"metric": "gym_ledger", "error": str(e)}))
+        return 2
+    errors = validate_records(records)
+    report = {
+        "metric": "gym_ledger",
+        "ledger": os.path.basename(path),
+        "valid": not errors,
+        # bounded: a corrupted ledger must not flood CI logs
+        "errors": errors[:20],
+        "errors_total": len(errors),
+        **(summarize(records) if not errors else {}),
+    }
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 1 if errors else 0
+
+
 def _fleet_bench_main(tenants: int = 8) -> int:
     """``bench.py --fleet [K]``: the BASELINE config-5 mode — K simulated
     tenants through the coalescing fleet path vs. K sequential per-tenant
@@ -781,6 +810,13 @@ def main():
                   file=sys.stderr)
             sys.exit(2)
         sys.exit(_explain_ledger_main(sys.argv[idx + 1]))
+    if "--gym-ledger" in sys.argv:
+        idx = sys.argv.index("--gym-ledger")
+        if idx + 1 >= len(sys.argv):
+            print("usage: bench.py --gym-ledger <ledger.jsonl>",
+                  file=sys.stderr)
+            sys.exit(2)
+        sys.exit(_gym_ledger_main(sys.argv[idx + 1]))
     if os.environ.get(_CHILD_ENV) == "1":
         _bench_main()
         return

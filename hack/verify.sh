@@ -249,6 +249,42 @@ echo "== resident-arena steady-state gate (20k-pod CPU config: e2e <= 1.15x devi
 python bench.py --arena >/dev/null
 echo "arena bench gate ok"
 
+echo "== policy-gym tuning gate (double tune byte-identical; best score non-decreasing; winner strictly beats the all-defaults policy) =="
+gym_tmp=$(mktemp -d)
+# 2 generations x 4 candidates over the canned suite (diurnal + spike +
+# drain-heavy + kernel-fault, shared seeds): ALL randomness rides the
+# seeded PolicyRng and rollouts are loadgen-deterministic, so two tunes —
+# including their concurrent fleet-coalesced rollouts — must write
+# byte-identical tuning ledgers
+python -m autoscaler_tpu.gym tune benchmarks/scenarios/gym_suite.json \
+    --generations 2 --population 4 --seed 12 --ledger "$gym_tmp/a.jsonl" >/dev/null
+python -m autoscaler_tpu.gym tune benchmarks/scenarios/gym_suite.json \
+    --generations 2 --population 4 --seed 12 --ledger "$gym_tmp/b.jsonl" >/dev/null
+if ! diff -q "$gym_tmp/a.jsonl" "$gym_tmp/b.jsonl" >/dev/null; then
+    echo "ERROR: tuning ledger is nondeterministic across identical tunes:" >&2
+    diff "$gym_tmp/a.jsonl" "$gym_tmp/b.jsonl" | head -20 >&2
+    exit 1
+fi
+# schema + generation monotonicity + the improvement invariant
+# (best_so_far never decreases), then the acceptance gate: the tuned
+# winner strictly beats the gen-0 all-defaults baseline on the suite's
+# weighted objective
+python bench.py --gym-ledger "$gym_tmp/a.jsonl" > "$gym_tmp/report.json"
+python - "$gym_tmp/report.json" <<'EOF'
+import json, sys
+report = json.load(open(sys.argv[1]))
+assert report["valid"], report["errors"]
+assert report["beats_baseline"], (
+    f"tuned winner {report['winner']['total']} does not beat the "
+    f"all-defaults baseline {report['baseline_total']}"
+)
+traj = report["best_trajectory"]
+assert traj == sorted(traj), f"best-of-generation decreased: {traj}"
+print(f"gym tune ok ({report['generations']} generations, "
+      f"{report['rollouts']} rollouts, improvement {report['improvement']})")
+EOF
+rm -rf "$gym_tmp"
+
 echo "== unit tests (8-device virtual CPU mesh) =="
 python -m pytest tests/ -q -x
 

@@ -1,0 +1,443 @@
+"""Policy gym (autoscaler_tpu/gym): env determinism + decision parity,
+PolicySpec bounds, tuner byte-identity and the improvement invariant,
+ledger validation exit codes, fleet-coalesced score parity, CLI e2e."""
+import json
+import time
+
+import pytest
+
+from autoscaler_tpu.gym import (
+    BASELINE_ID,
+    DEFAULT_POLICY,
+    KNOB_SPACE,
+    GymError,
+    PolicyError,
+    PolicyGymEnv,
+    PolicySpec,
+    SuiteSpec,
+    is_suite_doc,
+    load_jsonl,
+    record_line,
+    summarize,
+    validate_records,
+)
+from autoscaler_tpu.gym.tune import (
+    PolicyRng,
+    TuneConfig,
+    _window_sleep,
+    tune_suite,
+)
+from autoscaler_tpu.loadgen.spec import (
+    Event,
+    NodeGroupSpec,
+    ScenarioSpec,
+    SpecError,
+    WorkloadSpec,
+)
+
+
+def tiny_spec(name="gymtest", seed=5, **kw):
+    base = dict(
+        name=name,
+        seed=seed,
+        ticks=8,
+        tick_interval_s=10.0,
+        node_groups=[
+            NodeGroupSpec(name="g", min_size=0, max_size=10, initial_size=2),
+        ],
+        events=[
+            Event(at_tick=1, kind="pod_burst", count=6, cpu_m=1500.0,
+                  mem_mb=1024.0, prefix="burst"),
+            Event(at_tick=4, kind="pod_complete", count=4, prefix="burst"),
+        ],
+    )
+    base.update(kw)
+    return ScenarioSpec(**base)
+
+
+def tiny_suite(**kw):
+    return SuiteSpec(name="tiny", scenarios=[
+        tiny_spec(),
+        tiny_spec(name="gymtest2", seed=6, workloads=[
+            WorkloadSpec(kind="spike", rate=5.0, period_ticks=4,
+                         completion_rate=0.5),
+        ], events=[]),
+    ], **kw)
+
+
+class TestPolicySpec:
+    def test_bounds_rejected_loudly(self):
+        with pytest.raises(PolicyError, match="scale_down_utilization_threshold"):
+            PolicySpec(scale_down_utilization_threshold=2.0)
+        with pytest.raises(PolicyError, match="never clamp"):
+            PolicySpec(kernel_breaker_cooldown_s=-5.0)
+        with pytest.raises(PolicyError, match="expander"):
+            PolicySpec(expander="cheapest")
+        with pytest.raises(PolicyError, match="integer"):
+            PolicySpec(kernel_breaker_failure_threshold=2.5)
+
+    def test_unknown_knob_rejected(self):
+        with pytest.raises(PolicyError, match="no_such"):
+            PolicySpec.from_dict({"no_such": 1})
+
+    def test_round_trip_and_overrides(self):
+        pol = PolicySpec(expander="most-pods", scale_down_unneeded_time_s=30.0,
+                         kernel_breaker_failure_threshold=2)
+        assert PolicySpec.from_dict(pol.to_dict()) == pol
+        ov = pol.to_overrides()
+        assert ov["expander"] == "most-pods"
+        assert isinstance(ov["kernel_breaker_failure_threshold"], int)
+        # the override dict passes the AutoscalingOptions schema gate
+        from autoscaler_tpu.config.options import validate_overrides
+
+        validate_overrides(ov)
+
+    def test_every_knob_matches_an_options_field(self):
+        from autoscaler_tpu.config.options import validate_overrides
+
+        full = PolicySpec(**{
+            k.name: (k.choices[0] if k.kind == "choice"
+                     else (int(k.lo) if k.kind == "int" else float(k.lo)))
+            for k in KNOB_SPACE
+        })
+        validate_overrides(full.to_overrides())
+
+    def test_renderers(self):
+        pol = PolicySpec(expander="price", scale_down_unneeded_time_s=117.6293)
+        assert "--expander=price" in pol.render_flags()
+        # full precision survives rendering (a rounded flag would name a
+        # policy nobody evaluated)
+        assert "117.6293" in pol.render_flags()
+        assert "--set expander=price" in pol.render_set_args()
+        yaml = pol.render_values_yaml()
+        assert yaml.startswith("autoscaling:")
+        assert "scaleDownUnneededTime: 117.6293" in yaml
+        assert DEFAULT_POLICY.render_flags() == ""
+
+
+class TestEnv:
+    def test_step_before_reset_raises(self):
+        with pytest.raises(GymError, match="reset"):
+            PolicyGymEnv(tiny_spec()).step()
+
+    def test_reset_step_deterministic(self):
+        def episode():
+            env = PolicyGymEnv(tiny_spec())
+            obs = [env.reset(seed=5)]
+            rewards = []
+            done = False
+            while not done:
+                o, r, done, _ = env.step()
+                obs.append(o)
+                rewards.append(r)
+            return obs, rewards
+
+        a_obs, a_rewards = episode()
+        b_obs, b_rewards = episode()
+        assert a_obs == b_obs
+        assert a_rewards == b_rewards
+        assert len(a_rewards) == tiny_spec().ticks
+
+    def test_rollout_matches_direct_driver_for_identity_policy(self):
+        from autoscaler_tpu.loadgen.driver import run_scenario
+
+        rollout = PolicyGymEnv(tiny_spec()).rollout()
+        direct = run_scenario(tiny_spec())
+        assert rollout.decision_log == direct.decision_log()
+
+    def test_step_rewards_sum_to_objective(self):
+        rollout = PolicyGymEnv(tiny_spec()).rollout()
+        assert sum(rollout.step_rewards) == pytest.approx(
+            -rollout.objective, abs=1e-5
+        )
+        assert rollout.score == pytest.approx(-rollout.objective, abs=1e-5)
+
+    def test_policy_changes_decisions(self):
+        # a policy that forbids scale-down entirely must change the log
+        lazy = PolicySpec(scale_down_unneeded_time_s=3600.0,
+                          scale_down_delay_after_add_s=3600.0)
+        a = PolicyGymEnv(tiny_spec()).rollout()
+        b = PolicyGymEnv(tiny_spec()).rollout(policy=lazy)
+        assert a.decision_log != b.decision_log
+
+    def test_step_past_done_raises(self):
+        env = PolicyGymEnv(tiny_spec())
+        env.reset()
+        done = False
+        while not done:
+            _, _, done, _ = env.step()
+        with pytest.raises(GymError, match="done"):
+            env.step()
+        # the episode stayed exactly spec.ticks long
+        assert len(env._driver.finish().records) == tiny_spec().ticks
+
+    def test_mid_episode_policy_change_rejected(self):
+        env = PolicyGymEnv(tiny_spec())
+        env.reset()
+        env.step()
+        with pytest.raises(PolicyError, match="mid-episode"):
+            env.step(PolicySpec(expander="most-pods"))
+
+    def test_first_step_action_rebinds(self):
+        pol = PolicySpec(scale_down_unneeded_time_s=3600.0,
+                         scale_down_delay_after_add_s=3600.0)
+        env = PolicyGymEnv(tiny_spec())
+        env.reset()
+        env.step(pol)            # tick 0: rebind through the options seam
+        done = False
+        while not done:
+            _, _, done, _ = env.step()
+        direct = PolicyGymEnv(tiny_spec()).rollout(policy=pol)
+        assert env._driver.finish().decision_log() == direct.decision_log
+
+    def test_fleet_scenario_rejected(self):
+        doc = tiny_spec().to_dict()
+        doc.pop("node_groups")
+        doc["fleet"] = {"tenants": [{"name": "t0"}]}
+        with pytest.raises(GymError, match="fleet"):
+            PolicyGymEnv(ScenarioSpec.from_dict(doc))
+
+
+class TestFleetCoalescedRollouts:
+    def test_fleet_vs_solo_score_parity(self):
+        from autoscaler_tpu.fleet.coalescer import FleetCoalescer
+
+        spec = tiny_spec()
+        solo = PolicyGymEnv(spec).rollout()
+        co = FleetCoalescer(window_s=0.002, clock=time.perf_counter,
+                            sleep=_window_sleep)
+        co.start()
+        try:
+            fleet = PolicyGymEnv(spec, coalescer=co).rollout()
+        finally:
+            co.stop()
+        assert fleet.objective == solo.objective
+        assert fleet.score == solo.score
+        # no dynamic affinity in this world: decisions match byte-for-byte
+        assert fleet.decision_log == solo.decision_log
+
+    def test_stopped_coalescer_falls_back_to_solo(self):
+        from autoscaler_tpu.fleet.coalescer import FleetCoalescer
+
+        spec = tiny_spec()
+        co = FleetCoalescer(window_s=0.002, clock=time.perf_counter,
+                            sleep=_window_sleep)
+        # never started: tickets would hang, so give the env a tiny
+        # timeout — every dispatch must degrade to the solo ladder and the
+        # rollout still matches the solo answer
+        env = PolicyGymEnv(spec, coalescer=co, rollout_timeout_s=0.05)
+        fleet = env.rollout()
+        solo = PolicyGymEnv(spec).rollout()
+        assert fleet.objective == solo.objective
+
+
+class TestTuner:
+    def test_double_tune_byte_identical(self):
+        suite = tiny_suite()
+        cfg = TuneConfig(generations=2, population=3, seed=3, workers=3)
+        a = tune_suite(suite, cfg)
+        b = tune_suite(suite, cfg)
+        assert a.ledger_lines() == b.ledger_lines()
+        assert validate_records(a.records) == []
+
+    def test_solo_and_worker_count_invariance(self):
+        suite = tiny_suite()
+        base = tune_suite(
+            suite, TuneConfig(generations=1, population=3, seed=3, workers=3)
+        )
+        solo = tune_suite(
+            suite, TuneConfig(generations=1, population=3, seed=3, workers=1,
+                              fleet_coalesce=False)
+        )
+        # candidate scores are identical; only the recorded lane flag and
+        # per-run wall time may differ
+        strip = lambda recs: [
+            {k: v for k, v in r.items() if k != "fleet_coalesced"}
+            for r in recs
+        ]
+        assert strip(base.records) == strip(solo.records)
+
+    def test_baseline_present_and_invariant(self):
+        result = tune_suite(
+            tiny_suite(),
+            TuneConfig(generations=2, population=3, seed=3, workers=2),
+        )
+        gen0 = result.records[0]
+        ids = [c["id"] for c in gen0["candidates"]]
+        assert BASELINE_ID in ids
+        bests = [r["best_so_far"]["total"] for r in result.records]
+        assert bests == sorted(bests)
+        assert result.best_total >= result.baseline_total
+
+    def test_policy_rng_deterministic(self):
+        a, b = PolicyRng(7), PolicyRng(7)
+        seq_a = [a.uniform(0, 1), a.gauss(0, 1), a.choice(("x", "y", "z")),
+                 a.coin(0.5)]
+        seq_b = [b.uniform(0, 1), b.gauss(0, 1), b.choice(("x", "y", "z")),
+                 b.coin(0.5)]
+        assert seq_a == seq_b
+        assert PolicyRng(8).uniform(0, 1) != a.uniform(0, 1)
+
+
+class TestLedger:
+    def _tune(self):
+        return tune_suite(
+            tiny_suite(),
+            TuneConfig(generations=2, population=3, seed=3, workers=3),
+        )
+
+    def test_validate_clean_and_summarize(self, tmp_path):
+        result = self._tune()
+        path = tmp_path / "tune.jsonl"
+        path.write_text(result.ledger_lines())
+        records = load_jsonl(str(path))
+        assert validate_records(records) == []
+        agg = summarize(records)
+        assert agg["generations"] == 2
+        assert agg["baseline_total"] == result.baseline_total
+        assert agg["winner"]["total"] == result.best_total
+        assert "beats_baseline" in agg
+
+    def test_validation_catches_corruption(self):
+        result = self._tune()
+        records = [json.loads(record_line(r)) for r in result.records]
+        # decreasing best_so_far = improvement invariant violation
+        records[-1]["best_so_far"]["total"] = records[0]["best_so_far"]["total"] - 99
+        assert any("improvement invariant" in e
+                   for e in validate_records(records))
+        # missing baseline
+        records2 = [json.loads(record_line(r)) for r in result.records]
+        records2[0]["candidates"] = [
+            c for c in records2[0]["candidates"] if c["id"] != BASELINE_ID
+        ]
+        assert any(BASELINE_ID in e for e in validate_records(records2))
+        # a truncated ledger (records < declared generations) is invalid:
+        # its mid-tune best would masquerade as the winner
+        truncated = [json.loads(record_line(result.records[0]))]
+        assert any("truncated" in e for e in validate_records(truncated))
+        # wrong schema
+        records3 = [json.loads(record_line(r)) for r in result.records]
+        records3[0]["schema"] = "nope/9"
+        assert any("schema" in e for e in validate_records(records3))
+        # out-of-space policy
+        records4 = [json.loads(record_line(r)) for r in result.records]
+        records4[0]["candidates"][0]["policy"] = {"surprise_knob": 1}
+        assert any("knob" in e for e in validate_records(records4))
+
+    def test_bench_exit_codes(self, tmp_path, capsys):
+        import bench
+
+        result = self._tune()
+        good = tmp_path / "good.jsonl"
+        good.write_text(result.ledger_lines())
+        assert bench._gym_ledger_main(str(good)) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["valid"] and report["metric"] == "gym_ledger"
+
+        bad = tmp_path / "bad.jsonl"
+        records = [json.loads(record_line(r)) for r in result.records]
+        records[0]["generation"] = 7
+        bad.write_text("".join(record_line(r) for r in records))
+        assert bench._gym_ledger_main(str(bad)) == 1
+        capsys.readouterr()
+
+        assert bench._gym_ledger_main(str(tmp_path / "missing.jsonl")) == 2
+        capsys.readouterr()
+
+
+class TestSuiteSpec:
+    def test_round_trip_and_validation(self):
+        suite = tiny_suite()
+        again = SuiteSpec.from_dict(suite.to_dict())
+        assert again.to_dict() == suite.to_dict()
+        with pytest.raises(SpecError, match="at least one"):
+            SuiteSpec(name="empty", scenarios=[])
+        with pytest.raises(SpecError, match="duplicate"):
+            SuiteSpec(name="dup", scenarios=[tiny_spec(), tiny_spec()])
+
+    def test_is_suite_doc(self):
+        assert is_suite_doc(tiny_suite().to_dict())
+        assert not is_suite_doc(tiny_spec().to_dict())
+
+    def test_canned_suite_parses(self):
+        suite = SuiteSpec.load("benchmarks/scenarios/gym_suite.json")
+        names = suite.scenario_names()
+        assert len(names) == 4
+        # the ISSUE's coverage: diurnal + spike + drain-heavy + kernel-fault
+        kinds = {w.kind for s in suite.scenarios for w in s.workloads}
+        assert {"diurnal", "spike", "drain_heavy", "steady"} <= kinds
+        assert any(
+            e.fault is not None and e.fault.kind == "kernel_fault"
+            for s in suite.scenarios for e in s.events
+        )
+
+    def test_loadgen_validate_accepts_suite(self, capsys):
+        from autoscaler_tpu.loadgen.cli import main as loadgen_main
+
+        rc = loadgen_main(["validate", "benchmarks/scenarios/gym_suite.json"])
+        assert rc == 0
+        assert "suite gym_suite" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+class TestCliEndToEnd:
+    def test_tune_validate_apply_cycle(self, tmp_path, capsys):
+        from autoscaler_tpu.gym.cli import main as gym_main
+
+        suite_path = tmp_path / "suite.json"
+        tiny_suite().save(str(suite_path))
+        ledger = tmp_path / "tune.jsonl"
+        rc = gym_main([
+            "tune", str(suite_path), "--generations", "2", "--population",
+            "3", "--seed", "3", "--ledger", str(ledger),
+        ])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["metric"] == "gym_tune_tiny"
+        assert report["winner"]["total"] >= report["baseline_total"]
+
+        assert gym_main(["validate", str(ledger)]) == 0
+        capsys.readouterr()
+
+        assert gym_main(["apply", str(ledger)]) == 0
+        out = capsys.readouterr().out
+        assert "values.yaml fragment" in out
+
+        # replay must reproduce the ledger byte-for-byte
+        assert gym_main(["replay", str(suite_path), "--ledger",
+                         str(ledger)]) == 0
+        assert "byte-identical" in capsys.readouterr().out
+
+        # a mismatched suite is a usage error (exit 2 BEFORE burning a
+        # tune), never a false determinism violation
+        other = tmp_path / "other.json"
+        renamed = tiny_suite()
+        renamed.name = "other"
+        renamed.save(str(other))
+        assert gym_main(["replay", str(other), "--ledger", str(ledger)]) == 2
+        assert "does not match" in capsys.readouterr().err
+
+    def test_replay_preserves_high_precision_weights(self, tmp_path, capsys):
+        # the recorded weights must reach the re-tune VERBATIM: a %g-style
+        # string round-trip would replay a tune nobody ran and report a
+        # false divergence
+        from autoscaler_tpu.gym.cli import main as gym_main
+
+        suite_path = tmp_path / "suite.json"
+        tiny_suite().save(str(suite_path))
+        ledger = tmp_path / "tune.jsonl"
+        assert gym_main([
+            "tune", str(suite_path), "--generations", "1", "--population",
+            "2", "--seed", "4", "--weights", "cost=0.0123456789",
+            "--ledger", str(ledger),
+        ]) == 0
+        capsys.readouterr()
+        assert gym_main(["replay", str(suite_path), "--ledger",
+                         str(ledger)]) == 0
+        assert "byte-identical" in capsys.readouterr().out
+
+    def test_missing_suite_exits_2(self, capsys):
+        from autoscaler_tpu.gym.cli import main as gym_main
+
+        assert gym_main(["tune", "/nonexistent/suite.json"]) == 2
+        assert "error:" in capsys.readouterr().err

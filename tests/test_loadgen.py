@@ -280,3 +280,135 @@ class TestReviewRegressions:
         assert inj.on_evict("ns/p", "g2") is True
         assert inj.on_evict("ns/p", "g1") is False
         assert inj.on_evict("ns/p", "") is False
+
+
+class TestSetOverrideHardening:
+    """`--set` / scenario `options` schema gate (ISSUE 12 satellite): an
+    unknown AutoscalingOptions key or a type-mismatched value must exit 2
+    with the offending key NAMED — dataclasses alone would accept both
+    silently."""
+
+    def test_unknown_key_exits_2_naming_key(self, tmp_path, capsys):
+        from autoscaler_tpu.loadgen.cli import main as loadgen_main
+
+        path = tmp_path / "s.json"
+        small_spec().save(str(path))
+        rc = loadgen_main(["run", str(path), "--set", "scale_down_unneded_time_s=0"])
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert "scale_down_unneded_time_s" in err
+        assert "unknown AutoscalingOptions key" in err
+
+    def test_type_mismatch_exits_2_naming_key(self, tmp_path, capsys):
+        from autoscaler_tpu.loadgen.cli import main as loadgen_main
+
+        path = tmp_path / "s.json"
+        small_spec().save(str(path))
+        # an unquoted string where a float belongs (JSON parse falls back
+        # to str) must be rejected, not silently seated on the dataclass
+        rc = loadgen_main(
+            ["run", str(path), "--set", "scale_down_unneeded_time_s=fast"]
+        )
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert "scale_down_unneeded_time_s" in err
+        assert "float" in err
+
+    def test_bool_is_not_a_number(self):
+        from autoscaler_tpu.config.options import OptionsError, validate_overrides
+
+        with pytest.raises(OptionsError, match="kernel_breaker_cooldown_s"):
+            validate_overrides({"kernel_breaker_cooldown_s": True})
+
+    def test_valid_overrides_pass(self):
+        from autoscaler_tpu.config.options import validate_overrides
+
+        validate_overrides({
+            "arena_enabled": False,
+            "expander": "least-waste",
+            "scale_down_unneeded_time_s": 30,   # int promotes to float
+            "expander_random_seed": None,       # Optional[int]
+            "kernel_breaker_failure_threshold": 2,
+        })
+
+    def test_spec_options_validated_at_driver_build(self):
+        with pytest.raises(SpecError, match="no_such_knob"):
+            ScenarioDriver(small_spec(options={"no_such_knob": 1}))
+
+
+class TestObjectiveSection:
+    """The scorer's deterministic objective (ISSUE 12 satellite): one
+    scalar humans and the gym read, decomposed and reproducible from a
+    canned decision-log fixture."""
+
+    def _records(self):
+        from autoscaler_tpu.loadgen.driver import TickRecord
+
+        return [
+            TickRecord(tick=0, now_ts=0.0, pending_after=3, nodes_total=4,
+                       demand_nodes=2, scale_ups=[("g", 2)]),
+            TickRecord(tick=1, now_ts=10.0, pending_after=0, nodes_total=6,
+                       demand_nodes=6, scale_downs=["g-1"]),
+            TickRecord(tick=2, now_ts=20.0, pending_after=1, nodes_total=5,
+                       demand_nodes=2),
+        ]
+
+    def test_components_on_fixture(self):
+        from autoscaler_tpu.loadgen.score import ObjectiveWeights, build_objective
+
+        weights = ObjectiveWeights(w_slo=2.0, w_cost=10.0, w_churn=1.0)
+        obj = build_objective(self._records(), 10.0, weights)
+        assert obj["pending_pod_ticks"] == 4          # 3 + 0 + 1
+        # over-provision: (4-2) + max(6-6,0) + (5-2) = 5 node-ticks @ 10s
+        assert obj["over_provisioned_node_hours"] == pytest.approx(5 * 10 / 3600, abs=1e-6)
+        assert obj["scale_churn"] == 3                # 2 up + 1 down
+        expected = 2.0 * 4 + 10.0 * (5 * 10 / 3600) + 1.0 * 3
+        assert obj["weighted_total"] == pytest.approx(expected, abs=1e-5)
+        assert obj["weights"] == {"slo": 2.0, "cost": 10.0, "churn": 1.0}
+
+    def test_tick_objective_sums_to_total(self):
+        from autoscaler_tpu.loadgen.score import (
+            ObjectiveWeights,
+            build_objective,
+            tick_objective,
+        )
+
+        weights = ObjectiveWeights(w_slo=1.5, w_cost=7.0, w_churn=0.5)
+        records = self._records()
+        total = build_objective(records, 10.0, weights)["weighted_total"]
+        stepped = sum(tick_objective(r, 10.0, weights) for r in records)
+        assert stepped == pytest.approx(total, abs=1e-5)
+
+    def test_report_carries_objective(self):
+        result = run_scenario(small_spec())
+        report = build_report(result)
+        obj = report["objective"]
+        for key in ("pending_pod_ticks", "over_provisioned_node_hours",
+                    "scale_churn", "weights", "weighted_total"):
+            assert key in obj
+        # demand_nodes rides the decision log (the objective's denominator)
+        assert all("demand_nodes" in entry for entry in result.decision_log())
+
+    def test_weights_parse(self):
+        from autoscaler_tpu.loadgen.score import ObjectiveWeights
+
+        w = ObjectiveWeights.parse("slo=2,cost=4.5")
+        assert (w.w_slo, w.w_cost, w.w_churn) == (2.0, 4.5, 0.25)
+        assert ObjectiveWeights.parse("") == ObjectiveWeights()
+        with pytest.raises(ValueError, match="latency"):
+            ObjectiveWeights.parse("latency=3")
+
+    def test_report_weights_ride_the_set_seam(self, tmp_path, capsys):
+        # --set gym_objective_weights=... must reach the report's objective
+        # section: a report scored with different weights than the tuning
+        # ledger would break the one-number contract
+        from autoscaler_tpu.loadgen.cli import main as loadgen_main
+
+        path = tmp_path / "s.json"
+        small_spec().save(str(path))
+        rc = loadgen_main(
+            ["run", str(path), "--set", "gym_objective_weights=cost=20"]
+        )
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["objective"]["weights"]["cost"] == 20.0
