@@ -106,6 +106,7 @@ REPLAY_SCOPES = (
     "gym/",
     "loadgen/",
     "perf/",
+    "slo/",
     "trace/",
     "snapshot/",
     "clusterstate/",
@@ -1020,6 +1021,7 @@ GATED_ENDPOINTS = {
     "/tracez": "tracing_enabled",
     "/perfz": "perf_enabled",
     "/explainz": "explain_enabled",
+    "/sloz": "slo_enabled",
     "/snapshotz": "debugger",
     "/debug/pprof": "profiling",
 }

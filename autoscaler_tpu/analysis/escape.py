@@ -63,6 +63,7 @@ GL004_THREADED_SCOPES = (
     "gym/",
     "metrics/",
     "perf/",
+    "slo/",
     "snapshot/arena.py",
     "trace/recorder.py",
     "utils/circuit.py",

@@ -152,6 +152,20 @@ class AutoscalingOptions:
     # scenario slots per coalesced batch (the kernel's leading S axis);
     # overflow chunks into further batches in the same window
     fleet_batch_scenarios: int = 8
+    # tenant-label cardinality bound on the per-tenant fleet SLI series
+    # (fleet_queue_wait/service/e2e_seconds, fleet_requests_total): the
+    # first N distinct tenants keep their own label, later arrivals
+    # aggregate into "__overflow__" so a misbehaving fleet cannot explode
+    # /metrics exposition. 0 = unbounded (trusted closed fleets only).
+    fleet_max_tenant_labels: int = 64
+
+    # -- SLO engine (autoscaler_tpu/slo) -------------------------------------
+    # gates /sloz, like perf_enabled gates /perfz; the engine itself always
+    # runs (bounded ring, negligible overhead) so burn-rate history exists
+    # the moment the endpoint is enabled. The window-record ring shares
+    # explain_ring_size (the SLO windows are computed per tick, the same
+    # cadence as the decision records the pending-pod SLI reads).
+    slo_enabled: bool = True
 
     # -- policy gym (autoscaler_tpu/gym) -------------------------------------
     # concurrent candidate rollouts per tuning stage: the population axis

@@ -100,6 +100,24 @@ class StaticAutoscaler:
         self.explainer = DecisionExplainer(
             ring_capacity=self.options.explain_ring_size
         )
+        # SLO engine (autoscaler_tpu/slo): declarative targets over the
+        # request-lifecycle SLIs — tick duration (the main span's timeline
+        # extent), pending-pod latency (tracked from the explainer's
+        # per-tick still-pending set), and the fleet serving objective.
+        # One window record per tick, served by /sloz; the ring shares the
+        # explain cadence/size since the pending-pod SLI reads its records.
+        from autoscaler_tpu.slo import SloEngine, control_loop_slos
+
+        self.slo = SloEngine(
+            # the control-loop catalog only (tick duration, pending-pod
+            # latency): this process runs no fleet coalescer, and an
+            # objective that can never receive events would report a
+            # permanently healthy fleet — the fleet_e2e spec lives with
+            # the processes that serve fleet traffic
+            specs=control_loop_slos(),
+            metrics=self.metrics,
+            ring_capacity=self.options.explain_ring_size,
+        )
         # floor for perf tick ids: normally the trace id, but a re-entrant
         # tick (tracer degrades to a child span — no trace_id attr) must
         # still get a strictly increasing id or the ledger's monotonicity
@@ -219,6 +237,10 @@ class StaticAutoscaler:
             # the decision record shares the perf record's tick id, so
             # /explainz, /perfz and /tracez line up by construction
             self.explainer.begin_tick(tick_id, now_ts)
+            # the tick-duration SLI measures on the timeline seam: the
+            # loadgen driver's synthetic clock makes the measured duration
+            # (and every burn rate derived from it) replay byte-identically
+            slo_t0 = trace.timeline_now()
             try:
                 result = self._run_once_traced(now_ts, root)
             finally:
@@ -253,7 +275,20 @@ class StaticAutoscaler:
                 # sections noted before the crash are exactly the
                 # decisions that were made
                 with trace.span(metrics_mod.EXPLAIN_RECORD):
-                    self.explainer.end_tick()
+                    explain_rec = self.explainer.end_tick()
+                # SLO window: judge this tick's SLIs and compute burn
+                # rates — crash paths included, so a crashing loop still
+                # burns budget instead of going silent
+                with trace.span(metrics_mod.SLO_WINDOW):
+                    from autoscaler_tpu.slo import SLI_TICK_DURATION
+
+                    self.slo.observe(
+                        SLI_TICK_DURATION,
+                        trace.timeline_now() - slo_t0,
+                        now=now_ts,
+                    )
+                    self.slo.observe_explain(explain_rec)
+                    self.slo.tick(now_ts, tick_id)
             root.set_attrs(
                 pending=result.pending_pods,
                 healthy=result.cluster_healthy,

@@ -25,6 +25,7 @@ from autoscaler_tpu.fleet.buckets import (
     select_bucket,
 )
 from autoscaler_tpu.fleet.coalescer import (
+    OVERFLOW_TENANT,
     ROUTE_BATCHED,
     ROUTE_ORACLE,
     FleetAnswer,
@@ -36,6 +37,7 @@ from autoscaler_tpu.fleet.coalescer import (
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "OVERFLOW_TENANT",
     "ROUTE_BATCHED",
     "ROUTE_ORACLE",
     "BucketError",

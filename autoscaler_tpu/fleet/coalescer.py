@@ -57,6 +57,11 @@ from autoscaler_tpu.metrics import metrics as metrics_mod
 ROUTE_BATCHED = "fleet_batched"
 ROUTE_ORACLE = "fleet_oracle"
 
+# the aggregate tenant label past --fleet-max-tenant-labels: a misbehaving
+# fleet (or an abusive tenant-id generator) collapses into ONE series
+# instead of exploding /metrics exposition
+OVERFLOW_TENANT = "__overflow__"
+
 
 class FleetError(RuntimeError):
     """No rung could serve a coalesced batch."""
@@ -75,6 +80,11 @@ class FleetRequest:
     node_caps: np.ndarray        # [G] i32
     max_nodes: int
     prices: Optional[np.ndarray] = None  # [G] f32 — present = what-if ranking
+    # origin trace context ("<trace_id>:<span_id>", trace.current_context):
+    # the RPC path decodes it from the wire, programmatic submitters inside
+    # a traced tick get it captured automatically at submit() — it parents
+    # the shared fleetDispatch span's links and the SLI exemplars
+    trace_context: str = ""
 
     def shape(self) -> Tuple[int, int, int]:
         P, R = self.pod_req.shape
@@ -105,11 +115,33 @@ class FleetTicket:
         self._answer: Optional[FleetAnswer] = None
         self._error: Optional[BaseException] = None
         # wall stamps (time.perf_counter — the sanctioned measurement
-        # clock, never a replay artifact): admission and resolution, so a
-        # caller can derive its true service latency even when its batch
-        # dispatched before other buckets in the same flush
+        # clock, never a replay artifact): admission, dispatch, and
+        # resolution, so a caller can split its true service latency into
+        # queue wait vs service even when its batch dispatched before
+        # other buckets in the same flush
         self.submitted_wall: float = 0.0
+        self.dispatched_wall: float = 0.0
         self.resolved_wall: float = 0.0
+        # lifecycle stamps on the submitter's timeline clock (captured at
+        # submit via trace.timeline_clock) — DETERMINISTIC under the
+        # loadgen drivers' synthetic clocks, so the queue/service
+        # decomposition can ride ledgers and SLO windows byte-stably:
+        # submit → admit (queued) → dispatch (batch walk begins) → demux
+        # (this ticket's slice cut) → resolve (answer/error visible).
+        # ONE clock serves all five stamps even when dispatch happens on
+        # the (untraced) window thread — mixing the submitter's timeline
+        # with the bare-monotonic fallback would make the deltas garbage.
+        self.t_submit: float = 0.0
+        self.t_admit: float = 0.0
+        self.t_dispatch: float = 0.0
+        self.t_demux: float = 0.0
+        self.t_resolve: float = 0.0
+        # the captured stamp clock (seated by submit(); the coalescer's
+        # injected clock when the submitter ran outside any trace)
+        self.stamp_clock: Callable[[], float] = time.monotonic
+        # origin trace context (copied from the request at submit) — the
+        # span-link + exemplar identity of this ticket
+        self.trace_context: str = ""
 
     def resolve(self, answer: FleetAnswer) -> None:
         self._answer = answer
@@ -150,6 +182,8 @@ class FleetCoalescer:
         ladder: Optional[KernelLadder] = None,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
+        slo: Any = None,
+        max_tenant_labels: int = 64,
     ) -> None:
         if batch_scenarios < 1:
             raise ValueError(f"batch_scenarios must be >= 1, got {batch_scenarios}")
@@ -159,6 +193,14 @@ class FleetCoalescer:
         self.mesh = mesh
         self.metrics = metrics
         self.observatory = observatory
+        # slo (an slo.SloEngine, optional): every resolved/failed ticket
+        # feeds one fleet_e2e SLI event on its timeline stamps
+        self.slo = slo
+        # tenant label cardinality bound for the per-tenant metric series
+        # (--fleet-max-tenant-labels): the first N distinct tenants keep
+        # their own label, the rest aggregate into OVERFLOW_TENANT.
+        # 0 = unbounded (trusted closed fleets only).
+        self.max_tenant_labels = int(max_tenant_labels)
         self.ladder = ladder or KernelLadder()
         self.ladder.bind_metrics(metrics)
         self._clock = clock
@@ -170,6 +212,9 @@ class FleetCoalescer:
         self._running = False
         self._prewarmed: List[str] = []
         self._configured = frozenset(self.buckets)
+        # tenant id → metric label, insertion-ordered admission (GL004:
+        # written only under the queue lock)
+        self._tenant_labels: Dict[str, str] = {}
 
     # -- wiring ---------------------------------------------------------------
     @classmethod
@@ -180,6 +225,7 @@ class FleetCoalescer:
             buckets=options.fleet_shape_buckets,
             window_s=options.fleet_coalesce_window_ms / 1000.0,
             batch_scenarios=options.fleet_batch_scenarios,
+            max_tenant_labels=options.fleet_max_tenant_labels,
             **kwargs,
         )
         if options.fleet_prewarm:
@@ -201,11 +247,30 @@ class FleetCoalescer:
     # -- admission ------------------------------------------------------------
     def submit(self, request: FleetRequest) -> FleetTicket:
         """Park one request for the next coalesced dispatch. The queue is
-        the only cross-thread state; tickets are resolved outside the lock."""
+        the only cross-thread state; tickets are resolved outside the lock.
+
+        Trace-context capture: a request that arrived without an explicit
+        origin context (the RPC path decodes one from the wire) inherits
+        the ambient one — a submitter inside a traced tick (loadgen fleet
+        driver, gym rollouts) gets its span linked from the shared
+        fleetDispatch span for free."""
         ticket = FleetTicket()
+        if not request.trace_context:
+            ctx = trace.current_context()
+            if ctx is not None:
+                request.trace_context = ctx
+        ticket.trace_context = request.trace_context
+        # capture the submitter's clock domain ONCE: every later stamp —
+        # including those taken on the window thread, which has no active
+        # trace — reads this same clock, so the queue/service deltas are
+        # real durations in one domain (synthetic under loadgen, the
+        # serving tracer's wall clock on the RPC path)
+        ticket.stamp_clock = trace.timeline_clock() or self._clock
+        ticket.t_submit = ticket.stamp_clock()
         ticket.submitted_wall = time.perf_counter()
         with self._lock:
             self._pending.append((request, ticket))
+            self._tenant_label_locked(request.tenant_id)
             if self.metrics is not None:
                 # published under the queue lock so a concurrent flush()
                 # can't interleave its set(0) with a stale depth — the
@@ -213,7 +278,32 @@ class FleetCoalescer:
                 # their own inner lock; the order is always queue → series)
                 self.metrics.fleet_queue_depth.set(float(len(self._pending)))
             self._cond.notify()
+        ticket.t_admit = ticket.stamp_clock()
         return ticket
+
+    def _tenant_label_locked(self, tenant_id: str) -> str:
+        """The cardinality bound (caller holds the queue lock): the first
+        ``max_tenant_labels`` distinct tenants keep their own metric label;
+        later arrivals aggregate into OVERFLOW_TENANT. First-come admission
+        is deterministic under replay (submission order IS the ledger
+        order). Overflow tenants are NOT memoized — once the admission set
+        is full it stays full, so membership answers every later lookup
+        and recording each abusive tenant id would grow this dict without
+        bound (the exact attack the label bound exists to stop)."""
+        label = self._tenant_labels.get(tenant_id)
+        if label is not None:
+            return label
+        if (
+            self.max_tenant_labels > 0
+            and len(self._tenant_labels) >= self.max_tenant_labels
+        ):
+            return OVERFLOW_TENANT
+        self._tenant_labels[tenant_id] = tenant_id
+        return tenant_id
+
+    def tenant_label(self, tenant_id: str) -> str:
+        with self._lock:
+            return self._tenant_label_locked(tenant_id)
 
     def queue_depth(self) -> int:
         with self._lock:
@@ -346,11 +436,22 @@ class FleetCoalescer:
                 )
                 for req, _ in entries:
                     self.metrics.fleet_requests_total.inc(
-                        bucket=bucket.key, tenant=req.tenant_id
+                        bucket=bucket.key,
+                        tenant=self.tenant_label(req.tenant_id),
                     )
+            # the dispatch moment is shared by the batch (one walk serves
+            # them all) but each ticket stamps it from its OWN captured
+            # clock: bucket-wait = t_dispatch − t_admit per ticket
+            dispatch_wall = time.perf_counter()
+            for _, ticket in entries:
+                ticket.t_dispatch = ticket.stamp_clock()
+                ticket.dispatched_wall = dispatch_wall
             counts, scheduled, route = self._walk_ladder(
                 bucket, scen_req, scen_masks, scen_allocs, scen_caps,
                 batch=len(entries),
+                # one batch, many traces: the shared fleetDispatch span
+                # links every co-batched ticket's origin context
+                links=[t.trace_context for _, t in entries if t.trace_context],
             )
         except Exception as e:  # noqa: BLE001 — whatever failed (operand
             # build, every rung), the batch's tickets must still resolve:
@@ -360,24 +461,80 @@ class FleetCoalescer:
             err = FleetError(f"no fleet rung served bucket {bucket.key}: {e}")
             err.__cause__ = e
             for _, ticket in entries:
+                ticket.t_resolve = ticket.stamp_clock()
+                if self.slo is not None:
+                    # a failed batch is bad budget regardless of latency;
+                    # the event timestamp rides the coalescer's injected
+                    # clock (the burn windows' time base), not the
+                    # timeline stamps (the latency measurement)
+                    from autoscaler_tpu.slo import SLI_FLEET_E2E
+
+                    self.slo.observe_event(
+                        SLI_FLEET_E2E, bad=True, now=self._clock()
+                    )
                 ticket.fail(err)
             return
         if self.metrics is not None:
             self.metrics.fleet_batches_total.inc(bucket=bucket.key, route=route)
         for s, (req, ticket) in enumerate(entries):
-            ticket.resolve(
-                self._demux(req, counts[s], scheduled[s], bucket, len(entries),
-                            waste, route)
+            answer = self._demux(
+                req, counts[s], scheduled[s], bucket, len(entries), waste,
+                route,
             )
+            ticket.t_demux = ticket.stamp_clock()
+            # resolve is stamped BEFORE the event fires so a caller
+            # unblocked by result() always reads a complete stamp set
+            ticket.t_resolve = ticket.stamp_clock()
+            self._observe_lifecycle(req, ticket, bucket)
+            ticket.resolve(answer)
+
+    def _observe_lifecycle(
+        self, req: FleetRequest, ticket: FleetTicket, bucket: BucketSpec
+    ) -> None:
+        """Per-ticket request-lifecycle SLIs on the timeline stamps:
+        queue wait (submit→dispatch: admission + coalescing window + bucket
+        queue), service (dispatch→resolve: batched kernel + demux), and
+        end-to-end — per-tenant histograms with OpenMetrics exemplars
+        naming the origin trace, plus one fleet_e2e SLO event."""
+        queue_wait = max(ticket.t_dispatch - ticket.t_submit, 0.0)
+        service = max(ticket.t_resolve - ticket.t_dispatch, 0.0)
+        e2e = max(ticket.t_resolve - ticket.t_submit, 0.0)
+        if self.metrics is not None:
+            tenant = self.tenant_label(req.tenant_id)
+            parsed = trace.parse_context(ticket.trace_context)
+            rows = (
+                (self.metrics.fleet_queue_wait_seconds, queue_wait),
+                (self.metrics.fleet_service_seconds, service),
+                (self.metrics.fleet_e2e_seconds, e2e),
+            )
+            for series, value in rows:
+                if parsed is None:
+                    series.observe(value, tenant=tenant, bucket=bucket.key)
+                else:
+                    series.observe_with_exemplar(
+                        value, str(parsed[0]), tenant=tenant,
+                        bucket=bucket.key,
+                    )
+        if self.slo is not None:
+            # latency judged from the timeline stamps; the event timestamp
+            # rides the coalescer's injected clock — the same time base
+            # the engine's burn windows (and the breaker cooldowns) use,
+            # simulated under loadgen so the ledger replays byte-for-byte
+            from autoscaler_tpu.slo import SLI_FLEET_E2E
+
+            self.slo.observe(SLI_FLEET_E2E, e2e, now=self._clock())
 
     def _walk_ladder(
-        self, bucket, scen_req, scen_masks, scen_allocs, scen_caps, batch: int
+        self, bucket, scen_req, scen_masks, scen_allocs, scen_caps,
+        batch: int, links: Sequence[str] = (),
     ):
         """Two-rung fleet ladder: the batched mesh kernel (``xla`` breaker),
         then the serial oracle twin (``python`` breaker). Same protocol as
         the estimator's walk — begin/record per rung, one fleetDispatch
         span per engagement — shrunk to the two routes a coalesced batch
-        has."""
+        has. ``links`` carries the co-batched tickets' origin trace
+        contexts (one batch, many traces): /tracez joins the tree from
+        either side."""
         from autoscaler_tpu.parallel.mesh import fleet_batch_estimate
 
         # advance the breaker clock from the injected clock on EVERY walk:
@@ -408,9 +565,14 @@ class FleetCoalescer:
             (RUNG_XLA, ROUTE_BATCHED, batched),
             (RUNG_PYTHON, ROUTE_ORACLE, oracle),
         ):
+            span_attrs = dict(rung=rung, bucket=bucket.key, batch=batch)
+            if links:
+                # span links, comma-joined "<trace>:<span>" contexts in
+                # submission order — deterministic under replay
+                span_attrs["links"] = ",".join(links)
             with trace.span(
                 metrics_mod.FLEET_DISPATCH, metrics=self.metrics,
-                rung=rung, bucket=bucket.key, batch=batch,
+                **span_attrs,
             ) as sp:
                 engaged = self.ladder.begin(rung)
                 if engaged == "breaker_open":

@@ -49,6 +49,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                           "table, skip reasons) as JSONL (deterministic: "
                           "two runs of the same spec are byte-identical; "
                           "bench.py --explain-ledger validates)")
+    run.add_argument("--slo-ledger", default="",
+                     help="write the run's per-tick SLO window records "
+                          "(multi-window burn rates over the request-"
+                          "lifecycle SLIs) as JSONL (deterministic: two "
+                          "runs of the same spec are byte-identical; "
+                          "bench.py --slo-ledger validates)")
     run.add_argument("--seed", type=int, default=None,
                      help="override the spec's seed")
     run.add_argument("--set", action="append", default=[], dest="overrides",
@@ -74,6 +80,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     rep.add_argument("--chrome-trace", default="")
     rep.add_argument("--perf-ledger", default="")
     rep.add_argument("--explain-ledger", default="")
+    rep.add_argument("--slo-ledger", default="")
     rep.add_argument("--sanitize", action="store_true",
                      help="run under the determinism sanitizer (see run)")
 
@@ -91,7 +98,7 @@ def _write(path: str, doc) -> None:
 def _run(spec: ScenarioSpec, report_path: str, log_path: str,
          trace_path: str = "", real_sleep: bool = False,
          chrome_trace_path: str = "", perf_ledger_path: str = "",
-         explain_ledger_path: str = "") -> int:
+         explain_ledger_path: str = "", slo_ledger_path: str = "") -> int:
     if spec.fleet is not None:
         if explain_ledger_path:
             # fail loudly: the fleet drill produces no run_once decision
@@ -103,7 +110,8 @@ def _run(spec: ScenarioSpec, report_path: str, log_path: str,
                 "ledger is written by --log"
             )
         return _run_fleet(spec, report_path, log_path, trace_path,
-                          chrome_trace_path, perf_ledger_path)
+                          chrome_trace_path, perf_ledger_path,
+                          slo_ledger_path)
     from autoscaler_tpu.loadgen.driver import run_scenario
     from autoscaler_tpu.loadgen.score import ObjectiveWeights, build_report
 
@@ -138,12 +146,17 @@ def _run(spec: ScenarioSpec, report_path: str, log_path: str,
         # replays; bench.py --explain-ledger gates)
         with open(explain_ledger_path, "w") as f:
             f.write(result.explain_ledger_lines())
+    if slo_ledger_path:
+        # the byte-stable SLO window ledger (hack/verify.sh diffs two
+        # replays; bench.py --slo-ledger validates the burn arithmetic)
+        with open(slo_ledger_path, "w") as f:
+            f.write(result.slo_ledger_lines())
     return 0
 
 
 def _run_fleet(spec: ScenarioSpec, report_path: str, log_path: str,
                trace_path: str = "", chrome_trace_path: str = "",
-               perf_ledger_path: str = "") -> int:
+               perf_ledger_path: str = "", slo_ledger_path: str = "") -> int:
     """Fleet scenarios drive the coalescing estimator service; the decision
     log IS the fleet decision ledger (per-round verdict digests + parity
     bits — what hack/verify.sh byte-diffs across replays)."""
@@ -171,6 +184,9 @@ def _run_fleet(spec: ScenarioSpec, report_path: str, log_path: str,
     if perf_ledger_path:
         with open(perf_ledger_path, "w") as f:
             f.write(result.perf_ledger_lines())
+    if slo_ledger_path:
+        with open(slo_ledger_path, "w") as f:
+            f.write(result.slo_ledger_lines())
     return 0 if result.all_match() else 1
 
 
@@ -216,7 +232,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                               real_sleep=args.real_sleep,
                               chrome_trace_path=args.chrome_trace,
                               perf_ledger_path=args.perf_ledger,
-                              explain_ledger_path=args.explain_ledger)
+                              explain_ledger_path=args.explain_ledger,
+                              slo_ledger_path=args.slo_ledger)
             return _sanitized(go) if args.sanitize else go()
         if args.command == "replay":
             with open(args.trace) as f:
@@ -231,7 +248,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             go = lambda: _run(spec, args.report, args.log,
                               chrome_trace_path=args.chrome_trace,
                               perf_ledger_path=args.perf_ledger,
-                              explain_ledger_path=args.explain_ledger)
+                              explain_ledger_path=args.explain_ledger,
+                              slo_ledger_path=args.slo_ledger)
             return _sanitized(go) if args.sanitize else go()
         if args.command == "validate":
             with open(args.scenario) as f:

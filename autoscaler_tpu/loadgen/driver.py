@@ -167,6 +167,10 @@ class RunResult:
     # run): pure functions of the tick's decisions and the closed reason
     # vocabularies — byte-identical across replays, same contract
     explain_records: List[Dict[str, Any]] = field(default_factory=list)
+    # per-tick SLO window records (autoscaler_tpu/slo ring, sized to the
+    # run): SLI events on the timeline seam, burn rates as plain ratios —
+    # byte-identical across replays, same contract
+    slo_records: List[Dict[str, Any]] = field(default_factory=list)
 
     def decision_log(self) -> List[Dict[str, Any]]:
         return [r.to_dict() for r in self.records]
@@ -180,6 +184,11 @@ class RunResult:
         from autoscaler_tpu.explain import record_line
 
         return "".join(record_line(rec) for rec in self.explain_records)
+
+    def slo_ledger_lines(self) -> str:
+        from autoscaler_tpu.slo import record_line
+
+        return "".join(record_line(rec) for rec in self.slo_records)
 
 
 class _FaultyCloudProvider(TestCloudProvider):
@@ -656,6 +665,7 @@ class ScenarioDriver:
             recorder=self.tracer.recorder,
             perf_records=self.autoscaler.observatory.records(),
             explain_records=self.autoscaler.explainer.records(),
+            slo_records=self.autoscaler.slo.records(),
         )
 
     def run(self) -> RunResult:

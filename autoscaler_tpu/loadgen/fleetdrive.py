@@ -29,10 +29,11 @@ import dataclasses
 import hashlib
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from autoscaler_tpu import trace
 from autoscaler_tpu.config.options import AutoscalingOptions
 from autoscaler_tpu.loadgen.driver import BASE_TS, _TraceClock
 from autoscaler_tpu.loadgen.faults import FaultInjector
@@ -98,11 +99,19 @@ class FleetRunResult:
     perf_records: List[Dict[str, Any]] = field(default_factory=list)
     # per-ROUND service wall (submit → last ticket resolved) — report-only
     request_walls: List[float] = field(default_factory=list)
-    # per-tenant submit→resolve walls off the ticket stamps (a tenant whose
-    # batch dispatched first resolved earlier than the round wall) —
-    # report-only, never in a ledger
-    tenant_latency: Dict[str, List[float]] = field(default_factory=dict)
+    # per-tenant lifecycle walls off the ticket stamps, decomposed
+    # (queue_wait, service, e2e) per answer: queue wait = submit→dispatch
+    # (admission + coalescing window + bucket queue), service =
+    # dispatch→resolve (batched kernel + demux). Report-only, never in a
+    # ledger — the deterministic twin rides the timeline stamps into the
+    # SLO ledger instead.
+    tenant_latency: Dict[str, List[Tuple[float, float, float]]] = field(
+        default_factory=dict
+    )
     prewarmed: List[str] = field(default_factory=list)
+    # per-round SLO window records (the fleet_e2e objective on the ticket
+    # timeline stamps) — byte-identical across replays
+    slo_records: List[Dict[str, Any]] = field(default_factory=list)
 
     def decision_log(self) -> List[Dict[str, Any]]:
         return [r.to_dict() for r in self.records]
@@ -116,6 +125,11 @@ class FleetRunResult:
         from autoscaler_tpu.perf import record_line
 
         return "".join(record_line(rec) for rec in self.perf_records)
+
+    def slo_ledger_lines(self) -> str:
+        from autoscaler_tpu.slo import record_line
+
+        return "".join(record_line(rec) for rec in self.slo_records)
 
     def all_match(self) -> bool:
         """The fairness certificate over the whole run: every answered
@@ -207,6 +221,17 @@ class FleetScenarioDriver:
         )
         from autoscaler_tpu.estimator.ladder import KernelLadder
 
+        # the SLO engine judges every resolved ticket's e2e latency (on
+        # the ticket's timeline stamps) and computes one window record per
+        # round — the autoscaler_tpu.slo.window/1 ledger, byte-identical
+        # across replays like the fleet decision ledger
+        from autoscaler_tpu.slo import SloEngine, fleet_slos
+
+        self.slo = SloEngine(
+            specs=fleet_slos(),
+            ring_capacity=spec.ticks + 1,
+            metrics=self.metrics,
+        )
         # the coalescer reads its injected clock on every ladder walk; the
         # driver advances this per round, so breaker cooldowns run on
         # simulated time and trip→degrade→recover replays byte-for-byte
@@ -219,6 +244,8 @@ class FleetScenarioDriver:
             metrics=self.metrics,
             observatory=self.observatory,
             clock=lambda: self._sim_now,
+            slo=self.slo,
+            max_tenant_labels=self.options.fleet_max_tenant_labels,
             # breaker knobs ride the same options as the estimator ladder
             ladder=KernelLadder(
                 failure_threshold=self.options.kernel_breaker_failure_threshold,
@@ -235,7 +262,7 @@ class FleetScenarioDriver:
         fleet = spec.fleet
         records: List[FleetRoundRecord] = []
         walls: List[float] = []
-        tenant_latency: Dict[str, List[float]] = {}
+        tenant_latency: Dict[str, List[Tuple[float, float, float]]] = {}
         by_tick: Dict[int, list] = {}
         for ev in spec.events:
             by_tick.setdefault(ev.at_tick, []).append(ev)
@@ -275,7 +302,17 @@ class FleetScenarioDriver:
                 # latency columns measure the service, not the driver's
                 # request generation or the certification dispatches below
                 t0 = time.perf_counter()
-                tickets = [self.coalescer.submit(r) for r in requests]
+                # one fleetSubmit span per tenant: each ticket's origin
+                # context is its OWN span, so the shared fleetDispatch
+                # span's links genuinely enumerate the co-batched tickets
+                # (one batch, many origins — the RPC path gets the same
+                # shape from each client's rpcCall span)
+                tickets = []
+                for r in requests:
+                    with trace.span(
+                        metrics_mod.FLEET_SUBMIT, tenant=r.tenant_id
+                    ):
+                        tickets.append(self.coalescer.submit(r))
                 self.coalescer.flush()
                 for req, ticket in zip(requests, tickets):
                     try:
@@ -284,13 +321,27 @@ class FleetScenarioDriver:
                         # is a recorded error, not a crashed run (crash-only
                         # discipline, same as the tick driver)
                         rec.errors.append(f"{req.tenant_id}: {e}")
-                    # per-tenant service latency off the ticket stamps: a
-                    # tenant whose bucket dispatched first resolved before
-                    # later buckets in the same flush
+                    # per-tenant lifecycle latency off the ticket stamps,
+                    # split queue-wait/service: a tenant whose bucket
+                    # dispatched first in the flush both waited less AND
+                    # resolved earlier, and the split shows which
+                    e2e = ticket.resolved_wall - ticket.submitted_wall
+                    queue_wait = (
+                        ticket.dispatched_wall - ticket.submitted_wall
+                        if ticket.dispatched_wall else e2e
+                    )
+                    service = (
+                        ticket.resolved_wall - ticket.dispatched_wall
+                        if ticket.dispatched_wall else 0.0
+                    )
                     tenant_latency.setdefault(req.tenant_id, []).append(
-                        ticket.resolved_wall - ticket.submitted_wall
+                        (queue_wait, service, e2e)
                     )
                 rec.wall_s = time.perf_counter() - t0
+                # the round's SLO window rides the traced tick: the engine
+                # consumed this round's ticket events (timeline stamps),
+                # one window record per round on the sim clock
+                self.slo.tick(now, tick)
             walls.append(rec.wall_s)
             # the fairness certificate (solo dispatches) runs OUTSIDE the
             # timed window and outside the perf tick
@@ -310,6 +361,7 @@ class FleetScenarioDriver:
             request_walls=walls,
             tenant_latency=tenant_latency,
             prewarmed=list(self.prewarmed),
+            slo_records=self.slo.records(),
         )
 
     @staticmethod

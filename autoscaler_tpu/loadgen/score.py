@@ -225,6 +225,9 @@ def build_report(
     explain = _explain_section(result)
     if explain:
         report["explain"] = explain
+    slo = _slo_section(result)
+    if slo:
+        report["slo"] = slo
     return report
 
 
@@ -294,15 +297,25 @@ def build_fleet_report(result) -> Dict[str, Any]:
             mismatches.append(v.tenant)
     walls = sorted(result.request_walls)
     waste_sorted = sorted(waste)
-    # per-tenant service latency from the ticket stamps (submit → resolve):
-    # a tenant whose bucket dispatched first in the flush resolved earlier,
-    # so the columns genuinely differ per tenant
+    # per-tenant lifecycle latency from the ticket stamps, decomposed:
+    # queue wait (submit→dispatch: admission + coalescing window + bucket
+    # queue) and service (dispatch→resolve: batched kernel + demux) next
+    # to the e2e columns — a tenant whose bucket dispatched first in the
+    # flush both waited less AND resolved earlier, and the split shows
+    # which side a regression lives on
     per_tenant: Dict[str, Dict[str, float]] = {}
     for tenant in sorted(result.tenant_latency):
-        tw = sorted(result.tenant_latency[tenant])
+        samples = result.tenant_latency[tenant]
+        qw = sorted(s[0] for s in samples)
+        sv = sorted(s[1] for s in samples)
+        e2e = sorted(s[2] for s in samples)
         per_tenant[tenant] = {
-            "p50_s": round(_percentile(tw, 0.50), 5),
-            "p99_s": round(_percentile(tw, 0.99), 5),
+            "queue_wait_p50_s": round(_percentile(qw, 0.50), 5),
+            "queue_wait_p99_s": round(_percentile(qw, 0.99), 5),
+            "service_p50_s": round(_percentile(sv, 0.50), 5),
+            "service_p99_s": round(_percentile(sv, 0.99), 5),
+            "p50_s": round(_percentile(e2e, 0.50), 5),
+            "p99_s": round(_percentile(e2e, 0.99), 5),
         }
     report: Dict[str, Any] = {
         "metric": f"loadgen_fleet_{spec.name}",
@@ -339,7 +352,22 @@ def build_fleet_report(result) -> Dict[str, Any]:
     perf = _perf_section(result)
     if perf:
         report["perf"] = perf
+    slo = _slo_section(result)
+    if slo:
+        report["slo"] = slo
     return report
+
+
+def _slo_section(result) -> Dict[str, Any]:
+    """SLO columns (autoscaler_tpu/slo ledger.summarize): final event
+    totals, worst multi-window burn per objective, alerting ticks — the
+    run's error-budget story next to its latency percentiles."""
+    records = getattr(result, "slo_records", None)
+    if not records:
+        return {}
+    from autoscaler_tpu.slo import summarize
+
+    return summarize(records)
 
 
 def _explain_section(result: RunResult) -> Dict[str, Any]:

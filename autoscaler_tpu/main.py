@@ -270,6 +270,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--fleet-batch-scenarios", type=int, default=8,
                    help="fleet serving: scenario slots per coalesced batch "
                         "(the batched kernel's leading axis)")
+    p.add_argument("--fleet-max-tenant-labels", type=int, default=64,
+                   help="fleet serving: distinct tenant labels admitted on "
+                        "the per-tenant SLI metric series before later "
+                        "tenants aggregate into __overflow__ (cardinality "
+                        "guard for /metrics; 0 = unbounded)")
+    p.add_argument("--slo-enabled", type=_bool_flag, default=True,
+                   help="serve /sloz (per-SLO multi-window burn rates and "
+                        "window history; the SLO engine itself always "
+                        "runs, bounded)")
     p.add_argument("--gym-rollout-workers", type=int, default=4,
                    help="policy gym: concurrent candidate rollouts per "
                         "tuning stage (autoscaler_tpu/gym)")
@@ -405,6 +414,8 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         fleet_shape_buckets=args.fleet_shape_buckets,
         fleet_prewarm=args.fleet_prewarm,
         fleet_batch_scenarios=args.fleet_batch_scenarios,
+        fleet_max_tenant_labels=args.fleet_max_tenant_labels,
+        slo_enabled=args.slo_enabled,
         arena_enabled=args.arena_enabled,
         arena_buckets=args.arena_buckets,
         compile_cache_dir=args.compile_cache_dir,
@@ -453,7 +464,22 @@ class ObservabilityServer:
 
             def do_GET(self):
                 if self.path == "/metrics":
-                    self._send(200, autoscaler.metrics.registry.expose())
+                    # content negotiation: exemplars (trace-id suffixes on
+                    # histogram buckets) are only legal in the OpenMetrics
+                    # dialect — a classic 0.0.4 scraper gets the plain
+                    # exposition, an OpenMetrics-aware one (Prometheus with
+                    # exemplar storage) opts in via Accept
+                    om_type = "application/openmetrics-text"
+                    if om_type in (self.headers.get("Accept") or ""):
+                        self._send(
+                            200,
+                            autoscaler.metrics.registry.expose(
+                                openmetrics=True
+                            ),
+                            f"{om_type}; version=1.0.0; charset=utf-8",
+                        )
+                    else:
+                        self._send(200, autoscaler.metrics.registry.expose())
                 elif self.path == "/health-check":
                     ok, msg = autoscaler.health_check.healthy()
                     # degraded (kernel rungs tripped, decisions flowing on a
@@ -599,6 +625,39 @@ class ObservabilityServer:
                         )
                     else:
                         self._send(200, explainer.list_json(), "application/json")
+                elif self.path.startswith("/sloz"):
+                    # SLO burn-rate engine (autoscaler_tpu/slo): gated like
+                    # /perfz — the engine always computes windows, the
+                    # endpoint is the opt-out
+                    engine = getattr(autoscaler, "slo", None)
+                    enabled = getattr(
+                        autoscaler.options, "slo_enabled", True
+                    )
+                    if engine is None or not enabled:
+                        self._send(
+                            404, "SLO engine disabled (--slo-enabled)"
+                        )
+                        return
+                    from urllib.parse import parse_qs, urlparse
+
+                    url = urlparse(self.path)
+                    if url.path.rstrip("/") not in ("", "/sloz"):
+                        self._send(404, "not found")
+                        return
+                    q = parse_qs(url.query)
+                    slo_name = q.get("slo", [None])[0]
+                    if slo_name is not None:
+                        body = engine.detail_json(slo_name)
+                        if body is None:
+                            self._send(
+                                400,
+                                f"unknown SLO {slo_name!r} (declared: "
+                                f"{', '.join(engine.spec_names())})",
+                            )
+                            return
+                        self._send(200, body, "application/json")
+                    else:
+                        self._send(200, engine.list_json(), "application/json")
                 elif self.path == "/status":
                     from autoscaler_tpu.clusterstate.status import build_status
 

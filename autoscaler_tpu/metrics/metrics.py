@@ -37,7 +37,10 @@ RPC_CALL = "rpcCall"  # one sidecar RPC (incl. the single reconnect-resend)
 PERF_RECORD = "perfRecord"  # per-tick perf-ledger assembly (autoscaler_tpu/perf)
 EXPLAIN_RECORD = "explainRecord"  # per-tick decision-record assembly (autoscaler_tpu/explain)
 FLEET_DISPATCH = "fleetDispatch"  # one coalesced multi-tenant batch dispatch (autoscaler_tpu/fleet)
+FLEET_SUBMIT = "fleetSubmit"  # one tenant's admission into the coalescing queue (per-ticket origin span)
 FLEET_PREWARM = "fleetPrewarm"  # startup bucket pre-warm sweep (autoscaler_tpu/fleet)
+RPC_SERVE = "rpcServe"  # sidecar-side serving span per RPC; adopts the caller's trace context (rpc/service)
+SLO_WINDOW = "sloWindow"  # per-tick SLO burn-rate window computation (autoscaler_tpu/slo)
 GYM_ROLLOUT = "gymRollout"  # one policy-gym candidate episode (autoscaler_tpu/gym)
 GYM_GENERATION = "gymGeneration"  # one tuner generation: sample + evaluate + prune (autoscaler_tpu/gym)
 
@@ -186,18 +189,48 @@ class Histogram(Summary):
         self.kind = "histogram"
         self.buckets: Tuple[float, ...] = tuple(sorted(buckets))
         self._bucket_counts: Dict[Tuple[Tuple[str, str], ...], List[int]] = {}
+        # OpenMetrics exemplars: per (label key, bucket index) the LAST
+        # exemplified observation — (trace_id, value). Bucket index -1 is
+        # the +Inf bucket. Rendered by expose() as
+        # `..._bucket{le="x"} N # {trace_id="t"} v` so a tail latency in
+        # /metrics links straight to its tick trace in the flight recorder.
+        self._exemplars: Dict[
+            Tuple[Tuple[Tuple[str, str], ...], int], Tuple[str, float]
+        ] = {}
+
+    def _observe_bucketed_locked(self, key, value: float) -> int:
+        """Shared bucket bookkeeping (caller holds the lock); returns the
+        index of the smallest bucket admitting the value (-1 = +Inf)."""
+        self._observe_locked(key, value)
+        counts = self._bucket_counts.get(key)
+        if counts is None:
+            counts = self._bucket_counts[key] = [0] * len(self.buckets)
+        # cumulative le-semantics: one observation ticks EVERY bucket
+        # whose upper bound admits it (bisect, then suffix increment)
+        first = bisect.bisect_left(self.buckets, value)
+        for i in range(first, len(counts)):
+            counts[i] += 1
+        return first if first < len(self.buckets) else -1
 
     def observe(self, value: float, **labels: str) -> None:
         with self._lock:
+            self._observe_bucketed_locked(self._key(labels), value)
+
+    def observe_with_exemplar(
+        self, value: float, trace_id: str, **labels: str
+    ) -> None:
+        """Observe and seat an exemplar on the admitting bucket: the
+        observation's trace id rides the exposition so an operator can jump
+        from a tail bucket to the exact request's span tree."""
+        with self._lock:
             key = self._key(labels)
-            self._observe_locked(key, value)
-            counts = self._bucket_counts.get(key)
-            if counts is None:
-                counts = self._bucket_counts[key] = [0] * len(self.buckets)
-            # cumulative le-semantics: one observation ticks EVERY bucket
-            # whose upper bound admits it (bisect, then suffix increment)
-            for i in range(bisect.bisect_left(self.buckets, value), len(counts)):
-                counts[i] += 1
+            idx = self._observe_bucketed_locked(key, value)
+            self._exemplars[(key, idx)] = (str(trace_id), float(value))
+
+    def exemplar(self, bucket_index: int, **labels: str):
+        """(trace_id, value) seated on one bucket (-1 = +Inf), or None."""
+        with self._lock:
+            return self._exemplars.get((self._key(labels), bucket_index))
 
     def bucket_counts(self, **labels: str) -> List[int]:
         with self._lock:
@@ -219,6 +252,13 @@ class Histogram(Summary):
                 )
                 for key, s in self.states.items()
             ]
+
+    def exemplar_rows(
+        self,
+    ) -> Dict[Tuple[Tuple[Tuple[str, str], ...], int], Tuple[str, float]]:
+        """Snapshot of the seated exemplars, for the exposition renderer."""
+        with self._lock:
+            return dict(self._exemplars)
 
 
 class MetricsRegistry:
@@ -255,26 +295,58 @@ class MetricsRegistry:
                 self._metrics[name] = Histogram(name, help_, buckets)
             return self._metrics[name]  # type: ignore[return-value]
 
-    def expose(self) -> str:
+    def expose(self, openmetrics: bool = False) -> str:
         """Prometheus text exposition format. Each series is snapshotted
         under its own lock before rendering — a concurrent first-observation
-        of a new label key must not resize a dict mid-iteration."""
+        of a new label key must not resize a dict mid-iteration.
+
+        ``openmetrics`` renders the OpenMetrics dialect: exemplar suffixes
+        on histogram buckets (`# {trace_id="..."} v`) plus the mandatory
+        `# EOF` terminator. Exemplars are ONLY legal there — the classic
+        0.0.4 text parser treats the first ``#`` after a sample value as a
+        parse error, so the default exposition must stay exemplar-free or
+        one exemplified observation would take down every scrape."""
         lines: List[str] = []
         with self._lock:
             series = list(self._metrics.values())
         for m in series:
-            lines.append(f"# HELP {m.name} {m.help}")
-            lines.append(f"# TYPE {m.name} {m.kind if m.kind != 'summary' else 'summary'}")
+            family = m.name
+            sample_name = m.name
+            if openmetrics and m.kind == "counter":
+                # OpenMetrics counter naming: samples are `<family>_total`,
+                # and the TYPE/HELP lines name the FAMILY. Our registry
+                # names counters by their sample name (`..._total`), so the
+                # family is the name with the suffix stripped; the few
+                # counters not ending in `_total` keep their name as the
+                # family and gain the suffix on the sample — either way a
+                # strict OM parser (Prometheus's openmetrics textparse)
+                # accepts the scrape instead of rejecting every metric.
+                if family.endswith("_total"):
+                    family = family[: -len("_total")]
+                else:
+                    sample_name = family + "_total"
+            lines.append(f"# HELP {family} {m.help}")
+            lines.append(f"# TYPE {family} {m.kind if m.kind != 'summary' else 'summary'}")
             if isinstance(m, Histogram):
                 # Prometheus histogram exposition: cumulative le-buckets
-                # (incl. the mandatory +Inf == _count), then sum and count
+                # (incl. the mandatory +Inf == _count), then sum and count.
+                # Buckets with a seated exemplar append the OpenMetrics
+                # `# {trace_id="..."} value` suffix — tail observations
+                # link to their tick trace in the flight recorder.
+                exemplars = m.exemplar_rows() if openmetrics else {}
                 for key, counts, count, total in m.bucket_rows():
                     base = dict(key)
-                    for bound, c in zip(m.buckets, counts):
+                    for i, (bound, c) in enumerate(zip(m.buckets, counts)):
                         bl = _fmt_labels({**base, "le": f"{bound:g}"})
-                        lines.append(f"{m.name}_bucket{bl} {c}")
+                        lines.append(
+                            f"{m.name}_bucket{bl} {c}"
+                            + _fmt_exemplar(exemplars.get((key, i)))
+                        )
                     inf = _fmt_labels({**base, "le": "+Inf"})
-                    lines.append(f"{m.name}_bucket{inf} {count}")
+                    lines.append(
+                        f"{m.name}_bucket{inf} {count}"
+                        + _fmt_exemplar(exemplars.get((key, -1)))
+                    )
                     lbl = _fmt_labels(base)
                     lines.append(f"{m.name}_sum{lbl} {total:.9g}")
                     lines.append(f"{m.name}_count{lbl} {count}")
@@ -290,7 +362,11 @@ class MetricsRegistry:
                 with m._lock:
                     items = list(m.values.items())
                 for key, v in items:
-                    lines.append(f"{m.name}{_fmt_labels(dict(key))} {v:.9g}")
+                    lines.append(
+                        f"{sample_name}{_fmt_labels(dict(key))} {v:.9g}"
+                    )
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
 
@@ -304,6 +380,14 @@ def _escape_label_value(value: str) -> str:
         .replace('"', '\\"')
         .replace("\n", "\\n")
     )
+
+
+def _fmt_exemplar(ex: Optional[Tuple[str, float]]) -> str:
+    """OpenMetrics exemplar suffix for one bucket line ("" when none)."""
+    if ex is None:
+        return ""
+    trace_id, value = ex
+    return f' # {{trace_id="{_escape_label_value(trace_id)}"}} {value:.9g}'
 
 
 def _fmt_labels(labels: Dict[str, str]) -> str:
@@ -527,6 +611,40 @@ class AutoscalerMetrics:
         self.fleet_prewarmed_buckets = r.gauge(
             p + "fleet_prewarmed_buckets",
             "shape buckets pre-warmed at startup",
+        )
+        # -- fleet request-lifecycle SLIs (autoscaler_tpu/fleet + slo): the
+        # per-ticket queue/service decomposition on the tracer timeline
+        # seam. tenant label cardinality is bounded by the coalescer
+        # (--fleet-max-tenant-labels → __overflow__); tail buckets carry
+        # OpenMetrics exemplars pairing the observation to its trace id.
+        self.fleet_queue_wait_seconds = r.histogram(
+            p + "fleet_queue_wait_seconds",
+            "fleet ticket admission→dispatch wait (coalescing window + "
+            "bucket queue) by tenant and bucket",
+        )
+        self.fleet_service_seconds = r.histogram(
+            p + "fleet_service_seconds",
+            "fleet ticket dispatch→resolve service time (batched kernel + "
+            "demux) by tenant and bucket",
+        )
+        self.fleet_e2e_seconds = r.histogram(
+            p + "fleet_e2e_seconds",
+            "fleet ticket submit→resolve end-to-end latency by tenant and "
+            "bucket",
+        )
+        # -- SLO engine (autoscaler_tpu/slo): declarative targets over the
+        # request-lifecycle SLIs, multi-window burn rates on the timeline
+        # clock. Served in detail by /sloz; these series are the alerting
+        # surface.
+        self.slo_events_total = r.counter(
+            p + "slo_events_total",
+            "SLI events judged against their SLO threshold, by slo and "
+            "verdict (good|bad)",
+        )
+        self.slo_burn_rate = r.gauge(
+            p + "slo_burn_rate",
+            "error-budget burn rate per SLO and window (1.0 = burning "
+            "exactly the budget; page on sustained multi-window burn)",
         )
         # -- policy gym (autoscaler_tpu/gym): the tuning workload. Rollout
         # and generation spans ride the shared FunctionLabel taxonomy

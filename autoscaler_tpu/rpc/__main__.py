@@ -35,6 +35,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--fleet-shape-buckets", default=DEFAULT_BUCKETS)
     p.add_argument("--fleet-prewarm", type=_bool_flag, default=True)
     p.add_argument("--fleet-batch-scenarios", type=int, default=8)
+    p.add_argument("--fleet-max-tenant-labels", type=int, default=64)
     return p
 
 
@@ -45,6 +46,7 @@ def main(argv=None) -> int:
         fleet_shape_buckets=args.fleet_shape_buckets,
         fleet_prewarm=args.fleet_prewarm,
         fleet_batch_scenarios=args.fleet_batch_scenarios,
+        fleet_max_tenant_labels=args.fleet_max_tenant_labels,
     )
     server, port = serve(
         args.address, max_workers=args.max_workers, options=options

@@ -29,6 +29,7 @@ _REQUEST_FIELDS = (
     ("max_nodes", 6, _F.TYPE_INT32, None),
     ("tenant_id", 7, _F.TYPE_STRING, None),
     ("prices", 8, _F.TYPE_BYTES, None),
+    ("trace_context", 9, _F.TYPE_STRING, None),
 )
 _RESPONSE_FIELDS = (
     ("node_counts", 1, _F.TYPE_BYTES, None),

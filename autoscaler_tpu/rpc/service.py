@@ -27,6 +27,27 @@ from autoscaler_tpu.rpc import fleet_pb2 as fleet_pb
 
 SERVICE_NAME = "autoscaler_tpu.TpuSimulation"
 
+# gRPC metadata key carrying the caller's trace context
+# ("<trace_id>:<span_id>", trace.current_context): the sidecar adopts it as
+# the parent of its serving span so the two processes' span trees join
+# under ONE trace id. The fleet proto additionally carries it as a first-
+# class field (BatchEstimateRequest.trace_context) for programmatic
+# clients that bypass gRPC.
+TRACE_METADATA_KEY = "x-autoscaler-trace-context"
+
+
+def _metadata_context(context) -> str:
+    """Extract the caller's trace context from gRPC invocation metadata
+    (best-effort: propagation must never fail a request)."""
+    try:
+        md = context.invocation_metadata()
+    except Exception:  # noqa: BLE001 — fake/partial test contexts
+        return ""
+    for key, value in md or ():
+        if key == TRACE_METADATA_KEY:
+            return str(value)
+    return ""
+
 
 def _f32(blob: bytes, *shape: int) -> np.ndarray:
     return np.frombuffer(blob, np.dtype("<f4")).reshape(shape).copy()
@@ -119,13 +140,24 @@ class TpuSimulationServicer:
     coalescing surface; absent, the first BatchEstimate builds a default
     coalescer (default buckets, pre-warm off) so the RPC works out of the
     box — deploy sites pass FleetCoalescer.from_options for the
-    --fleet-* knobs."""
+    --fleet-* knobs.
 
-    def __init__(self, residency=None, fleet=None):
+    ``tracer`` (a trace.Tracer, optional): the sidecar-side flight
+    recorder. Each Estimate/BatchEstimate opens one ``rpcServe`` serving
+    trace that ADOPTS the caller's propagated trace context (gRPC metadata
+    / the fleet proto's trace_context field) — client and sidecar spans
+    for one request share one trace id, so /tracez on either process joins
+    the tree. Absent, a bounded default is created (always-on, like the
+    host-side tracer)."""
+
+    def __init__(self, residency=None, fleet=None, tracer=None):
         import threading
 
         self.residency = residency
         self.fleet = fleet
+        if tracer is None:
+            tracer = trace.Tracer(recorder=trace.FlightRecorder(capacity=64))
+        self.tracer = tracer
         self._fleet_lock = threading.Lock()
 
     def _ensure_fleet(self):
@@ -162,7 +194,11 @@ class TpuSimulationServicer:
         from autoscaler_tpu.ops.binpack import ffd_binpack_groups
 
         pod_req, masks, allocs, caps = _decode_estimate_operands(request, context)
-        with self._account("Estimate", pod_req, masks, allocs, caps):
+        with self.tracer.tick(
+            metrics_mod.RPC_SERVE,
+            parent_context=_metadata_context(context),
+            method="Estimate",
+        ), self._account("Estimate", pod_req, masks, allocs, caps):
             # graftlint: disable=GL003 — sidecar server side: the ladder lives in the CLIENT process (TpuSimulationClient's caller); a fault here surfaces as an RPC error the client's ladder absorbs
             res = ffd_binpack_groups(
                 jnp.asarray(pod_req),
@@ -195,7 +231,16 @@ class TpuSimulationServicer:
         from autoscaler_tpu.fleet import FleetRequest
 
         fleet = self._ensure_fleet()
-        with self._account("BatchEstimate", pod_req, masks, allocs, caps):
+        # the proto field wins (programmatic clients), gRPC metadata is the
+        # fallback (the stub stamps both); the ticket carries it into the
+        # shared fleetDispatch span's links
+        ctx = request.trace_context or _metadata_context(context)
+        with self.tracer.tick(
+            metrics_mod.RPC_SERVE,
+            parent_context=ctx,
+            method="BatchEstimate",
+            tenant=request.tenant_id or "anonymous",
+        ), self._account("BatchEstimate", pod_req, masks, allocs, caps):
             ticket = fleet.submit(
                 FleetRequest(
                     tenant_id=request.tenant_id or "anonymous",
@@ -205,6 +250,7 @@ class TpuSimulationServicer:
                     node_caps=caps,
                     max_nodes=int(request.max_nodes),
                     prices=prices,
+                    trace_context=ctx,
                 )
             )
             # the coalescing window plus dispatch must finish inside the
@@ -384,6 +430,8 @@ def serve(
     residency=None,
     fleet=None,
     options=None,
+    tracer=None,
+    slo=None,
 ):
     """→ (server, bound_port). The sidecar process entrypoint. ``fleet``
     (a fleet.FleetCoalescer) backs BatchEstimate; when absent and
@@ -395,10 +443,19 @@ def serve(
     if fleet is None and options is not None:
         from autoscaler_tpu.fleet import FleetCoalescer
 
-        fleet = FleetCoalescer.from_options(options)
+        # ``slo`` (an slo.SloEngine built on fleet_slos()) rides into the
+        # coalescer so every served ticket feeds the fleet_e2e objective —
+        # the sidecar-side half of fleet mission control
+        fleet = FleetCoalescer.from_options(options, slo=slo)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers(
-        (_generic_handler(TpuSimulationServicer(residency=residency, fleet=fleet)),)
+        (
+            _generic_handler(
+                TpuSimulationServicer(
+                    residency=residency, fleet=fleet, tracer=tracer
+                )
+            ),
+        )
     )
     port = server.add_insecure_port(address)
     server.start()
@@ -457,14 +514,6 @@ class TpuSimulationClient:
         if timeout is None:
             timeout = self.default_timeout_s
 
-        def send():
-            rpc = self._channel.unary_unary(
-                f"/{SERVICE_NAME}/{method}",
-                request_serializer=lambda msg: msg.SerializeToString(),
-                response_deserializer=resp_cls.FromString,
-            )
-            return rpc(request, timeout=timeout)
-
         # one span per sidecar RPC — the reconnect-and-resend is an event
         # INSIDE it, so a tick slowed by a sidecar restart shows one long
         # rpcCall span with a reconnect marker, not two mystery gaps
@@ -472,6 +521,32 @@ class TpuSimulationClient:
             metrics_mod.RPC_CALL, method=method,
             deadline_s=timeout if timeout is not None else 0.0,
         ):
+            # cross-process propagation: THE rpcCall span is the remote
+            # parent — stamped into gRPC metadata on every method, and
+            # into the fleet proto's trace_context field when the message
+            # carries one (BatchEstimate), so the sidecar's serving span
+            # adopts this exact span and the trees join under one id
+            ctx = trace.current_context()
+            metadata = ((TRACE_METADATA_KEY, ctx),) if ctx else None
+            if (
+                ctx
+                and hasattr(request, "trace_context")
+                and not request.trace_context
+            ):
+                request.trace_context = ctx
+
+            def send():
+                rpc = self._channel.unary_unary(
+                    f"/{SERVICE_NAME}/{method}",
+                    request_serializer=lambda msg: msg.SerializeToString(),
+                    response_deserializer=resp_cls.FromString,
+                )
+                if metadata is None:
+                    # no active trace: keep the bare call shape (duck-typed
+                    # channels in tests need not accept the kwarg)
+                    return rpc(request, timeout=timeout)
+                return rpc(request, timeout=timeout, metadata=metadata)
+
             try:
                 return send()
             except grpc.RpcError as e:

@@ -11,10 +11,13 @@ from autoscaler_tpu.trace.tracer import (
     TickTrace,
     Tracer,
     add_event,
+    current_context,
     current_span,
+    parse_context,
     set_attrs,
     set_wall_attrs,
     span,
+    timeline_clock,
     timeline_now,
 )
 
@@ -26,9 +29,12 @@ __all__ = [
     "Tracer",
     "add_event",
     "chrome_trace_doc",
+    "current_context",
     "current_span",
+    "parse_context",
     "set_attrs",
     "set_wall_attrs",
     "span",
+    "timeline_clock",
     "timeline_now",
 ]

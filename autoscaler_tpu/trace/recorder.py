@@ -54,17 +54,26 @@ class FlightRecorder:
     def _find(self, trace_id: int) -> Optional[TickTrace]:
         if trace_id in self._pinned:
             return self._pinned[trace_id]
-        for t in self._ring:
+        # most recent match: serving tracers ADOPT caller trace ids
+        # (rpc/service.py), so several recorded traces can legitimately
+        # share one id — one per served RPC of the same client tick
+        for t in reversed(self._ring):
             if t.trace_id == trace_id:
                 return t
         return None
 
     def traces(self) -> List[TickTrace]:
-        """Ring ∪ pinned, deduped, ordered by trace id."""
+        """Ring ∪ pinned, ordered by trace id (insertion order within an
+        id). Distinct traces sharing an id are all kept — a serving-side
+        recorder holds one adopted trace per served RPC, and collapsing
+        them would hide all but the last request of a client tick."""
         with self._lock:
-            by_id: Dict[int, TickTrace] = {t.trace_id: t for t in self._ring}
-            by_id.update(self._pinned)
-            return [by_id[k] for k in sorted(by_id)]
+            out = list(self._ring)
+            ring_ids = {id(t) for t in out}
+            for t in self._pinned.values():
+                if id(t) not in ring_ids:
+                    out.append(t)
+            return sorted(out, key=lambda t: t.trace_id)
 
     def get(self, trace_id: int) -> Optional[TickTrace]:
         with self._lock:

@@ -218,6 +218,47 @@ def set_wall_attrs(**attrs: Any) -> None:
     sp.set_attrs(**attrs)
 
 
+def current_context() -> Optional[str]:
+    """The propagable identity of the active span: ``"<trace_id>:<span_id>"``,
+    or None outside a trace. This is what the rpc client stamps into gRPC
+    metadata (and the fleet proto's ``trace_context`` field) so the sidecar
+    can adopt the caller's trace as the parent of its serving span — the
+    cross-process analog of the ambient contextvar."""
+    active = _ACTIVE.get()
+    if active is None:
+        return None
+    _tracer, trace_, sp = active
+    return f"{trace_.trace_id}:{sp.span_id}"
+
+
+def parse_context(ctx: Optional[str]) -> Optional[Tuple[int, int]]:
+    """``"<trace_id>:<span_id>"`` → (trace_id, span_id), or None for
+    anything that is not a well-formed context (absent, foreign, corrupt —
+    propagation is best-effort observability and must never fail a
+    request)."""
+    if not ctx or not isinstance(ctx, str):
+        return None
+    tid, sep, sid = ctx.partition(":")
+    if not sep:
+        return None
+    try:
+        return int(tid), int(sid)
+    except ValueError:
+        return None
+
+
+def timeline_clock() -> Optional[Callable[[], float]]:
+    """The active tracer's timeline clock itself, or None outside a trace.
+    For state whose lifecycle CROSSES threads (a fleet ticket submitted
+    inside a traced tick but resolved on the coalescer's window thread):
+    capture the clock at the traced end and stamp every later lifecycle
+    point from it, so all stamps share one clock domain — mixing a
+    synthetic timeline reading with the bare-monotonic fallback of
+    :func:`timeline_now` would make their differences garbage."""
+    active = _ACTIVE.get()
+    return active[0].clock if active is not None else None
+
+
 def timeline_now() -> float:
     """THE whitelisted clock seam for replay-reachable duration pairs
     (graftlint GL001): inside a trace, the active tracer's timeline clock —
@@ -357,20 +398,50 @@ class Tracer:
 
     # -- the per-tick entry point --------------------------------------------
     @contextmanager
-    def tick(self, name: str, **attrs: Any) -> Iterator[Span]:
+    def tick(
+        self,
+        name: str,
+        parent_context: Optional[str] = None,
+        **attrs: Any,
+    ) -> Iterator[Span]:
         """Open the root span of one tick. On exit — error paths included —
         the trace is finalized, fed to the flight recorder, and (when the
         tick's wall time exceeds ``slow_tick_threshold_s``) its full span
-        tree is logged and the trace pinned in the ring."""
+        tree is logged and the trace pinned in the ring.
+
+        ``parent_context`` (a :func:`current_context` string from another
+        process) makes this a *serving* trace: it ADOPTS the caller's trace
+        id — client and sidecar spans for one request share one trace id,
+        so /tracez on either side joins the tree — and the root span
+        records ``parent_trace_id``/``parent_span_id`` naming the exact
+        remote parent span. A malformed context degrades to a normal local
+        trace (propagation is best-effort observability)."""
         if _ACTIVE.get() is not None:
             # re-entrant tick (an autoscaler driven inside another traced
             # component): degrade to a plain child span
             with span(name, **attrs) as sp:
                 yield sp
             return
-        with self._seq_lock:
-            trace_id = self._seq
-            self._seq += 1
+        adopted = parse_context(parent_context)
+        if adopted is None:
+            with self._seq_lock:
+                trace_id = self._seq
+                self._seq += 1
+        else:
+            trace_id = adopted[0]
+            # keep locally-minted ids out of the adopted space: a serving
+            # tracer that has adopted id N must never hand id N to an
+            # unrelated context-less request, or /tracez drill-down would
+            # conflate the two. (Two *clients* whose own counters collide
+            # can still share an id on the serving side — the listing
+            # disambiguates by the parent/tenant attrs on each root.)
+            with self._seq_lock:
+                self._seq = max(self._seq, trace_id + 1)
+            attrs = {
+                **attrs,
+                "parent_trace_id": adopted[0],
+                "parent_span_id": adopted[1],
+            }
         trace_ = TickTrace(trace_id=trace_id)
         merged = {**self._context_attrs, **attrs, "trace_id": trace_id}
         self._context_attrs = {}  # consumed: one set_context, one tick

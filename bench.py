@@ -675,6 +675,36 @@ def _explain_ledger_main(path: str) -> int:
     return 1 if errors else 0
 
 
+def _slo_ledger_main(path: str) -> int:
+    """``bench.py --slo-ledger <ledger.jsonl>``: validate an SLO window
+    JSONL ledger (schema, window monotonicity — ticks strictly increase,
+    now_ts never goes backwards, lifetime event counters never decrease —
+    and the burn-rate arithmetic cross-check: error_rate == bad/total and
+    burn_rate == error_rate/(1 − target) in every window, with the
+    alerting bit agreeing with the multiwindow predicate) and print the
+    aggregated per-SLO report. Exit 0 = valid, 1 = schema/arithmetic
+    errors, 2 = unreadable ledger. hack/verify.sh gates on this."""
+    from autoscaler_tpu.slo import load_jsonl, summarize, validate_records
+
+    try:
+        records = load_jsonl(path)
+    except (OSError, ValueError) as e:
+        print(json.dumps({"metric": "slo_ledger", "error": str(e)}))
+        return 2
+    errors = validate_records(records)
+    report = {
+        "metric": "slo_ledger",
+        "ledger": os.path.basename(path),
+        "valid": not errors,
+        # bounded: a corrupted ledger must not flood CI logs
+        "errors": errors[:20],
+        "errors_total": len(errors),
+        **(summarize(records) if not errors else {}),
+    }
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 1 if errors else 0
+
+
 def _gym_ledger_main(path: str) -> int:
     """``bench.py --gym-ledger <ledger.jsonl>``: validate a tuning JSONL
     ledger (schema, generation monotonicity, candidate/score shapes, the
@@ -810,6 +840,13 @@ def main():
                   file=sys.stderr)
             sys.exit(2)
         sys.exit(_explain_ledger_main(sys.argv[idx + 1]))
+    if "--slo-ledger" in sys.argv:
+        idx = sys.argv.index("--slo-ledger")
+        if idx + 1 >= len(sys.argv):
+            print("usage: bench.py --slo-ledger <ledger.jsonl>",
+                  file=sys.stderr)
+            sys.exit(2)
+        sys.exit(_slo_ledger_main(sys.argv[idx + 1]))
     if "--gym-ledger" in sys.argv:
         idx = sys.argv.index("--gym-ledger")
         if idx + 1 >= len(sys.argv):

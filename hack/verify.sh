@@ -197,6 +197,80 @@ print(f"fleet fairness ok ({len(rounds)} rounds, routes={sorted(routes)})")
 EOF
 rm -rf "$fleet_tmp"
 
+echo "== SLO mission-control gate (double replay byte-identical SLO ledgers; serving spans carry client parent context; exemplar trace ids resolve in the flight recorder) =="
+slo_tmp=$(mktemp -d)
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/fleet_tenants.json \
+    --slo-ledger "$slo_tmp/a.slo.jsonl" >/dev/null
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/fleet_tenants.json \
+    --slo-ledger "$slo_tmp/b.slo.jsonl" >/dev/null
+if ! diff -q "$slo_tmp/a.slo.jsonl" "$slo_tmp/b.slo.jsonl" >/dev/null; then
+    echo "ERROR: SLO window ledger is nondeterministic across identical replays:" >&2
+    diff "$slo_tmp/a.slo.jsonl" "$slo_tmp/b.slo.jsonl" | head -20 >&2
+    exit 1
+fi
+python - <<'EOF'
+import json, re
+import numpy as np
+from autoscaler_tpu import trace
+from autoscaler_tpu.fleet import FleetCoalescer
+from autoscaler_tpu.loadgen.fleetdrive import run_fleet_scenario
+from autoscaler_tpu.loadgen.spec import ScenarioSpec
+from autoscaler_tpu.rpc.service import TpuSimulationClient, serve
+from autoscaler_tpu.slo import SLI_FLEET_E2E, validate_records
+
+# (1) cross-process propagation: every served BatchEstimate span adopts
+# its client's trace id and names the exact rpcCall parent span
+side_tracer = trace.Tracer(recorder=trace.FlightRecorder(capacity=16))
+co = FleetCoalescer(buckets="16x4x8", window_s=0.002, batch_scenarios=4)
+server, port = serve(fleet=co, tracer=side_tracer)
+client = TpuSimulationClient(f"127.0.0.1:{port}", default_timeout_s=30.0)
+rng = np.random.default_rng(0)
+client_tracer = trace.Tracer(recorder=trace.FlightRecorder(capacity=4))
+with client_tracer.tick("main"):
+    for _ in range(2):
+        client.batch_estimate(
+            rng.integers(1, 100, (9, 6)).astype(np.float32),
+            rng.random((3, 9)) > 0.2,
+            rng.integers(100, 500, (3, 6)).astype(np.float32),
+            ["g0", "g1", "g2"],
+            rng.integers(1, 16, 3).astype(np.int32),
+            max_nodes=16, tenant_id="verify",
+        )
+client.close(); server.stop(0); co.stop()
+client_trace = client_tracer.recorder.traces()[-1]
+rpc_span_ids = {s.span_id for s in client_trace.spans if s.name == "rpcCall"}
+served = [t for t in side_tracer.recorder.traces()
+          if t.root.attrs.get("method") == "BatchEstimate"]
+assert len(served) == 2, f"expected 2 served BatchEstimate traces, got {len(served)}"
+for t in served:
+    assert t.trace_id == client_trace.trace_id, \
+        f"served span lost its client trace id: {t.trace_id} != {client_trace.trace_id}"
+    assert t.root.attrs.get("parent_span_id") in rpc_span_ids, \
+        f"served span missing its client parent context: {t.root.attrs}"
+
+# (2) in-process fleet replay: SLO ledger validates, the fleet objective
+# saw every answer, and every /metrics exemplar trace id resolves in the
+# run's flight recorder
+spec = ScenarioSpec.load("benchmarks/scenarios/fleet_tenants.json")
+result = run_fleet_scenario(spec)
+assert result.all_match(), "fleet parity broke under the SLO drill"
+recs = result.slo_records
+assert validate_records(recs) == [], validate_records(recs)[:5]
+answers = sum(len(r.tenants) for r in result.records)
+assert recs[-1]["slos"][SLI_FLEET_E2E]["events_total"] == answers, \
+    "fleet_e2e SLI did not see every answered ticket"
+expo = result.metrics.registry.expose(openmetrics=True)
+ex_ids = {int(x) for x in re.findall(r'# \{trace_id="(\d+)"\}', expo)}
+trace_ids = {t.trace_id for t in result.recorder.traces()}
+assert ex_ids, "no exemplars in the exposition"
+assert ex_ids <= trace_ids, f"unresolvable exemplar trace ids: {sorted(ex_ids - trace_ids)}"
+print(f"slo mission control ok ({len(recs)} window records, "
+      f"{answers} fleet answers, {len(ex_ids)} exemplar ids resolve)")
+EOF
+python bench.py --slo-ledger "$slo_tmp/a.slo.jsonl" >/dev/null
+echo "slo ledger ok"
+rm -rf "$slo_tmp"
+
 echo "== fleet batched-throughput gate (batched >= 2x sequential at >= 4 tenants) =="
 python bench.py --fleet 8 >/dev/null
 echo "fleet bench gate ok"

@@ -1,0 +1,863 @@
+"""Fleet mission control (autoscaler_tpu/slo + cross-process tracing):
+trace-context propagation, per-ticket lifecycle SLIs, the SLO burn-rate
+engine, the window ledger, /sloz, and the loadgen byte-determinism
+acceptance."""
+import json
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from autoscaler_tpu import trace
+from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from autoscaler_tpu.fleet import (
+    OVERFLOW_TENANT,
+    FleetCoalescer,
+    FleetRequest,
+)
+from autoscaler_tpu.kube.api import FakeClusterAPI
+from autoscaler_tpu.main import ObservabilityServer
+from autoscaler_tpu.metrics.metrics import AutoscalerMetrics
+from autoscaler_tpu.slo import (
+    SCHEMA,
+    SLI_FLEET_E2E,
+    SLI_PENDING_POD,
+    SLI_TICK_DURATION,
+    SloEngine,
+    SloError,
+    SloSpec,
+    default_slos,
+    fleet_slos,
+    record_line,
+    summarize,
+    validate_records,
+)
+from autoscaler_tpu.utils.test_utils import GB, build_test_node, build_test_pod
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def make_autoscaler(pods=(), **opt_kw):
+    provider = TestCloudProvider()
+    api = FakeClusterAPI()
+    provider.add_node_group(
+        "g", 0, 10, 1, build_test_node("t", cpu_m=1000, mem=2 * GB)
+    )
+    node = build_test_node("g-0", cpu_m=1000, mem=2 * GB)
+    provider.add_node("g", node)
+    api.add_node(node)
+    for p in pods:
+        api.add_pod(p)
+    return StaticAutoscaler(provider, api, AutoscalingOptions(**opt_kw))
+
+
+def _spec(**kw):
+    base = dict(
+        name="s", description="d", target=0.9, threshold_s=1.0,
+        windows_s=(10.0, 100.0),
+    )
+    base.update(kw)
+    return SloSpec(**base)
+
+
+# -------------------------------------------------------- trace context
+class TestTraceContext:
+    def test_current_context_and_parse_round_trip(self):
+        assert trace.current_context() is None
+        t = trace.Tracer()
+        with t.tick("main"):
+            ctx = trace.current_context()
+            assert trace.parse_context(ctx) == (0, 0)
+            with trace.span("estimate"):
+                assert trace.parse_context(trace.current_context()) == (0, 1)
+
+    def test_parse_rejects_garbage(self):
+        for bad in (None, "", "7", "a:b", "1:2:3x", 12):
+            assert trace.parse_context(bad) is None
+        assert trace.parse_context("12:3") == (12, 3)
+
+    def test_tick_adopts_parent_context(self):
+        t = trace.Tracer(recorder=trace.FlightRecorder(capacity=4))
+        with t.tick("main", parent_context="7:3"):
+            pass
+        rec = t.recorder.traces()[-1]
+        assert rec.trace_id == 7
+        assert rec.root.attrs["parent_trace_id"] == 7
+        assert rec.root.attrs["parent_span_id"] == 3
+        # malformed context degrades to a local trace, no parent attrs —
+        # and the local sequence has been advanced PAST the adopted id so
+        # a context-less request can never collide with an adopted trace
+        with t.tick("main", parent_context="nope"):
+            pass
+        rec = t.recorder.get(8)
+        assert rec is not None
+        assert "parent_trace_id" not in rec.root.attrs
+
+    def test_openmetrics_counter_family_naming(self):
+        """OM counters: TYPE/HELP name the FAMILY (sample name minus
+        `_total`); counters not ending in `_total` gain the suffix on the
+        sample — either way a strict OM parser accepts the scrape."""
+        from autoscaler_tpu.metrics.metrics import MetricsRegistry
+
+        r = MetricsRegistry()
+        r.counter("x_events_total", "h").inc(k="v")
+        r.counter("x_removed_count", "h").inc()
+        om = r.expose(openmetrics=True)
+        assert "# TYPE x_events counter" in om
+        assert 'x_events_total{k="v"} 1' in om
+        assert "# TYPE x_removed_count counter" in om
+        assert "x_removed_count_total 1" in om
+        # the classic dialect is untouched
+        classic = r.expose()
+        assert "# TYPE x_events_total counter" in classic
+        assert "x_removed_count 1" in classic
+
+    def test_recorder_keeps_adopted_id_collisions(self):
+        """A serving recorder holds one adopted trace per served RPC —
+        several can share one (client) trace id and ALL must be listed."""
+        t = trace.Tracer(recorder=trace.FlightRecorder(capacity=8))
+        for method in ("Estimate", "BatchEstimate"):
+            with t.tick("main", parent_context="5:1", method=method):
+                pass
+        traces = t.recorder.traces()
+        assert [tr.trace_id for tr in traces] == [5, 5]
+        assert [tr.root.attrs["method"] for tr in traces] == [
+            "Estimate", "BatchEstimate",
+        ]
+        # detail lookup resolves to the most recent match
+        found = t.recorder.get(5)
+        assert found is not None
+        assert found.root.attrs["method"] == "BatchEstimate"
+
+
+# ------------------------------------------------------------- SloSpec
+class TestSloSpec:
+    def test_default_catalogs(self):
+        from autoscaler_tpu.slo import control_loop_slos
+
+        names = {s.name for s in default_slos()}
+        assert names == {SLI_FLEET_E2E, SLI_TICK_DURATION, SLI_PENDING_POD}
+        assert {s.name for s in fleet_slos()} == {SLI_FLEET_E2E}
+        # the control loop runs no coalescer: its catalog must not declare
+        # an objective that can never receive events
+        assert {s.name for s in control_loop_slos()} == {
+            SLI_TICK_DURATION, SLI_PENDING_POD,
+        }
+        for s in default_slos():
+            s.validate()
+
+    @pytest.mark.parametrize("kw", [
+        dict(target=1.0), dict(target=0.0), dict(threshold_s=0.0),
+        dict(windows_s=()), dict(windows_s=(0.0,)), dict(burn_alert=0.0),
+        dict(name=""),
+    ])
+    def test_rejects_bad_specs(self, kw):
+        with pytest.raises(SloError):
+            _spec(**kw).validate()
+
+    def test_engine_rejects_duplicates_and_empty(self):
+        with pytest.raises(ValueError):
+            SloEngine(specs=[_spec(), _spec()])
+        with pytest.raises(ValueError):
+            SloEngine(specs=[])
+
+
+# ------------------------------------------------------------ SloEngine
+class TestSloEngine:
+    def test_burn_rate_arithmetic(self):
+        e = SloEngine(specs=[_spec()])
+        for i in range(8):
+            e.observe("s", 0.5, now=float(i))       # good
+        e.observe("s", 2.0, now=8.0)                # bad
+        e.observe("s", 3.0, now=9.0)                # bad
+        rec = e.tick(9.0, 0)
+        w = rec["slos"]["s"]["windows"]["100"]
+        assert w["total"] == 10 and w["bad"] == 2
+        assert w["error_rate"] == pytest.approx(0.2)
+        # burn = error_rate / (1 - target) = 0.2 / 0.1 = 2.0
+        assert w["burn_rate"] == pytest.approx(2.0)
+        assert validate_records([rec]) == []
+
+    def test_window_filtering_ages_events_out(self):
+        e = SloEngine(specs=[_spec(windows_s=(10.0, 1000.0))])
+        e.observe("s", 9.0, now=0.0)   # bad, old
+        e.observe("s", 0.1, now=99.0)  # good, recent
+        rec = e.tick(100.0, 0)
+        short = rec["slos"]["s"]["windows"]["10"]
+        long_ = rec["slos"]["s"]["windows"]["1000"]
+        assert (short["total"], short["bad"]) == (1, 0)
+        assert (long_["total"], long_["bad"]) == (2, 1)
+        # lifetime counters are never windowed
+        assert rec["slos"]["s"]["events_total"] == 2
+        assert rec["slos"]["s"]["events_bad"] == 1
+
+    def test_alerting_needs_every_window_burning(self):
+        e = SloEngine(specs=[_spec(windows_s=(10.0, 1000.0), burn_alert=5.0)])
+        # one bad event at now=99: short window sees only it (burn 10),
+        # long window sees it diluted below the alert factor
+        for i in range(50):
+            e.observe("s", 0.1, now=float(i))
+        e.observe("s", 9.0, now=99.0)
+        rec = e.tick(100.0, 0)
+        slo = rec["slos"]["s"]
+        assert slo["windows"]["10"]["burn_rate"] >= 5.0
+        assert slo["windows"]["1000"]["burn_rate"] < 5.0
+        assert slo["alerting"] is False
+        # saturate both windows → alert
+        e2 = SloEngine(specs=[_spec(windows_s=(10.0, 1000.0), burn_alert=5.0)])
+        for i in range(10):
+            e2.observe("s", 9.0, now=90.0 + i)
+        rec2 = e2.tick(100.0, 0)
+        assert rec2["slos"]["s"]["alerting"] is True
+        assert validate_records([rec2]) == []
+
+    def test_empty_window_never_alerts(self):
+        e = SloEngine(specs=[_spec(burn_alert=0.001)])
+        rec = e.tick(0.0, 0)
+        assert rec["slos"]["s"]["alerting"] is False
+
+    def test_unknown_slo_dropped_and_failures_are_bad(self):
+        e = SloEngine(specs=[_spec()])
+        e.observe("nope", 1.0, now=0.0)     # silently dropped
+        e.observe_event("s", bad=True, now=0.0)
+        rec = e.tick(0.0, 0)
+        assert rec["slos"]["s"]["events_bad"] == 1
+
+    def test_metrics_published(self):
+        m = AutoscalerMetrics()
+        e = SloEngine(specs=[_spec()], metrics=m)
+        e.observe("s", 0.5, now=0.0)
+        e.observe("s", 5.0, now=1.0)
+        e.tick(1.0, 0)
+        assert m.slo_events_total.get(slo="s", verdict="good") == 1.0
+        assert m.slo_events_total.get(slo="s", verdict="bad") == 1.0
+        assert m.slo_burn_rate.get(slo="s", window="10") == pytest.approx(5.0)
+
+    def test_ring_bounded(self):
+        e = SloEngine(specs=[_spec()], ring_capacity=2)
+        for i in range(5):
+            e.tick(float(i), i)
+        recs = e.records()
+        assert [r["tick"] for r in recs] == [3, 4]
+        assert e.last_record()["tick"] == 4
+
+
+class TestPendingPodSli:
+    def _engine(self, threshold=30.0):
+        return SloEngine(specs=[
+            SloSpec(name=SLI_PENDING_POD, description="d", target=0.5,
+                    threshold_s=threshold, windows_s=(1000.0,)),
+        ])
+
+    def _explain(self, now, pods):
+        return {"now_ts": now, "pods": {k: "cpu" for k in pods}}
+
+    def test_pod_resolving_inside_threshold_is_good(self):
+        e = self._engine()
+        e.observe_explain(self._explain(0.0, ["p1"]))
+        e.observe_explain(self._explain(10.0, []))
+        rec = e.tick(10.0, 0)
+        slo = rec["slos"][SLI_PENDING_POD]
+        assert (slo["events_total"], slo["events_bad"]) == (1, 0)
+
+    def test_overstayer_charged_once_and_not_again_on_resolve(self):
+        e = self._engine(threshold=15.0)
+        e.observe_explain(self._explain(0.0, ["p1"]))
+        e.observe_explain(self._explain(20.0, ["p1"]))   # overstay → bad
+        e.observe_explain(self._explain(30.0, ["p1"]))   # still: no re-charge
+        e.observe_explain(self._explain(40.0, []))       # resolve: no event
+        rec = e.tick(40.0, 0)
+        slo = rec["slos"][SLI_PENDING_POD]
+        assert (slo["events_total"], slo["events_bad"]) == (1, 1)
+
+    def test_malformed_record_ignored(self):
+        e = self._engine()
+        e.observe_explain(None)
+        e.observe_explain({"pods": {"p": "cpu"}})   # no now_ts
+        # no pods AND no pending split: a crashed tick — established nothing
+        e.observe_explain({"now_ts": 1.0})
+        assert e.tick(1.0, 0)["slos"][SLI_PENDING_POD]["events_total"] == 0
+
+    def test_cleared_pending_set_resolves_tracked_pods(self):
+        """A healthy tick with ZERO pending pods notes the 'pending' split
+        but no per-pod section — the tracker must read that as the empty
+        set and resolve its pods NOW, not freeze until the next pending
+        episode (which charged false bad events with inflated durations)."""
+        e = self._engine(threshold=60.0)
+        e.observe_explain(self._explain(0.0, ["p1"]))
+        # pod scheduled: pending cleared — record carries the split only
+        e.observe_explain({"now_ts": 30.0, "pending": {"pending": 0}})
+        rec = e.tick(30.0, 0)
+        slo = rec["slos"][SLI_PENDING_POD]
+        assert (slo["events_total"], slo["events_bad"]) == (1, 0)
+        # a much later pending episode must NOT resurrect p1
+        e.observe_explain(self._explain(300.0, ["p2"]))
+        e.observe_explain({"now_ts": 310.0, "pending": {"pending": 0}})
+        slo = e.tick(310.0, 1)["slos"][SLI_PENDING_POD]
+        assert (slo["events_total"], slo["events_bad"]) == (2, 0)
+
+    def test_crashed_tick_does_not_resolve_tracked_pods(self):
+        """Crash-shaped records must leave the tracker untouched — the pod
+        is still pending as far as anyone knows: no sections at all (crash
+        before the pending note), AND a pending split still reporting
+        pending pods with no per-pod section (crash between the pending
+        note and the scale-up explain — falsely resolving here would reset
+        the pending clock every crash of a crash-looping tick, the exact
+        outage where budget must keep burning)."""
+        e = self._engine(threshold=60.0)
+        e.observe_explain(self._explain(0.0, ["p1"]))
+        e.observe_explain({"now_ts": 10.0})   # crash before the split
+        e.observe_explain(
+            {"now_ts": 15.0, "pending": {"pending": 1}}   # crash after it
+        )
+        e.observe_explain(self._explain(20.0, ["p1"]))   # still tracked
+        e.observe_explain({"now_ts": 30.0, "pending": {"pending": 0}})
+        slo = e.tick(30.0, 0)["slos"][SLI_PENDING_POD]
+        assert (slo["events_total"], slo["events_bad"]) == (1, 0)
+
+    def test_crash_loop_still_burns_budget(self):
+        """A pod pending through repeated crash-shaped ticks accumulates
+        pending time and is charged its bad event on the first healthy
+        overstaying tick."""
+        e = self._engine(threshold=15.0)
+        e.observe_explain(self._explain(0.0, ["p1"]))
+        for t in (10.0, 20.0, 30.0):
+            e.observe_explain({"now_ts": t, "pending": {"pending": 1}})
+        e.observe_explain(self._explain(40.0, ["p1"]))   # healthy, overstayed
+        slo = e.tick(40.0, 0)["slos"][SLI_PENDING_POD]
+        assert (slo["events_total"], slo["events_bad"]) == (1, 1)
+
+
+# ------------------------------------------------------------ the ledger
+def _valid_records():
+    e = SloEngine(specs=[_spec()])
+    e.observe("s", 0.1, now=0.0)
+    e.observe("s", 2.0, now=1.0)
+    r0 = e.tick(1.0, 0)
+    e.observe("s", 0.1, now=2.0)
+    r1 = e.tick(2.0, 1)
+    return [r0, r1]
+
+
+class TestLedger:
+    def test_valid_ledger_and_summary(self):
+        recs = _valid_records()
+        assert validate_records(recs) == []
+        agg = summarize(recs)
+        assert agg["ticks"] == 2
+        assert agg["slos"]["s"]["events_total"] == 3
+        assert agg["slos"]["s"]["worst_burn_rate"]["10"] == pytest.approx(5.0)
+
+    def test_tight_budget_tolerance(self):
+        """A target-0.9999 SLO's burn is the error rate amplified 10_000x,
+        so the validator's tolerance must scale with 1/budget — a correct
+        engine record must not fail the arithmetic cross-check on the
+        9-digit rounding of error_rate."""
+        spec = SloSpec(name="tight", description="d", target=0.9999,
+                       threshold_s=1.0, windows_s=(10_000.0,))
+        e = SloEngine(specs=[spec])
+        for i in range(8191):
+            e.observe("tight", 0.1, now=float(i % 100))
+        e.observe("tight", 9.0, now=99.0)
+        rec = e.tick(100.0, 0)
+        assert validate_records([rec]) == [], validate_records([rec])
+
+    def test_record_line_is_sorted_strict_json(self):
+        line = record_line(_valid_records()[0])
+        doc = json.loads(line)
+        assert doc["schema"] == SCHEMA
+        assert line == json.dumps(
+            doc, sort_keys=True, separators=(",", ":")
+        ) + "\n"
+
+    @pytest.mark.parametrize("mutate,needle", [
+        (lambda r: r[0].update(schema="bogus"), "schema"),
+        (lambda r: r[1].update(tick=0), "not increasing"),
+        (lambda r: r[1].update(now_ts=0.0), "went backwards"),
+        (lambda r: r[0]["slos"]["s"]["windows"]["10"].update(
+            error_rate=0.9), "error_rate"),
+        (lambda r: r[0]["slos"]["s"]["windows"]["10"].update(
+            burn_rate=0.123), "burn_rate"),
+        (lambda r: r[0]["slos"]["s"].update(alerting=True), "alerting"),
+        (lambda r: r[0]["slos"]["s"].update(target=1.5), "target"),
+        (lambda r: r[1]["slos"]["s"].update(events_total=0), "decreased"),
+        (lambda r: r[0]["slos"]["s"]["windows"]["10"].update(
+            bad=99), "exceeds"),
+        (lambda r: r[0].update(slos={}), "non-empty"),
+    ])
+    def test_corruptions_caught(self, mutate, needle):
+        recs = _valid_records()
+        mutate(recs)
+        errors = validate_records(recs)
+        assert errors and any(needle in e for e in errors), errors
+
+
+class TestBenchGate:
+    def _run(self, path):
+        return subprocess.run(
+            [sys.executable, str(REPO / "bench.py"), "--slo-ledger", str(path)],
+            capture_output=True, text=True, timeout=300, cwd=str(REPO),
+        )
+
+    def test_exit_code_contract(self, tmp_path):
+        good = tmp_path / "good.jsonl"
+        good.write_text("".join(record_line(r) for r in _valid_records()))
+        proc = self._run(good)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout)
+        assert report["valid"] and report["slos"]["s"]["events_total"] == 3
+
+        bad = tmp_path / "bad.jsonl"
+        recs = _valid_records()
+        recs[0]["slos"]["s"]["windows"]["10"]["burn_rate"] = 99.0
+        bad.write_text("".join(record_line(r) for r in recs))
+        proc = self._run(bad)
+        assert proc.returncode == 1
+        assert not json.loads(proc.stdout)["valid"]
+
+        proc = self._run(tmp_path / "missing.jsonl")
+        assert proc.returncode == 2
+
+
+# ----------------------------------------- fleet ticket lifecycle + SLIs
+class TestTicketLifecycle:
+    def _req(self, rng, tenant="t", P=8, G=3):
+        return FleetRequest(
+            tenant_id=tenant,
+            pod_req=rng.integers(0, 100, (P, 6)).astype(np.float32),
+            pod_masks=rng.random((G, P)) > 0.3,
+            template_allocs=rng.integers(50, 400, (G, 6)).astype(np.float32),
+            node_caps=rng.integers(1, 8, G).astype(np.int32),
+            max_nodes=16,
+        )
+
+    def test_stamps_ordered_and_metrics_move(self):
+        rng = np.random.default_rng(5)
+        m = AutoscalerMetrics()
+        co = FleetCoalescer(buckets="16x4x8", metrics=m)
+        tracer = trace.Tracer(recorder=trace.FlightRecorder(capacity=2))
+        with tracer.tick("main"):
+            tk = co.submit(self._req(rng))
+            co.flush()
+        tk.result(1.0)
+        assert 0.0 < tk.t_submit <= tk.t_admit <= tk.t_dispatch
+        assert tk.t_dispatch <= tk.t_demux <= tk.t_resolve
+        assert tk.trace_context and trace.parse_context(tk.trace_context)
+        assert m.fleet_queue_wait_seconds.count(
+            tenant="t", bucket="16x4x8"
+        ) == 1
+        assert m.fleet_service_seconds.count(tenant="t", bucket="16x4x8") == 1
+        assert m.fleet_e2e_seconds.count(tenant="t", bucket="16x4x8") == 1
+        # exemplar on some bucket carries the origin trace id — in the
+        # OpenMetrics dialect ONLY: the classic 0.0.4 exposition must stay
+        # exemplar-free (a '#' after a sample value is a parse error that
+        # would take down every scrape of a classic Prometheus)
+        expo = m.registry.expose(openmetrics=True)
+        assert '# {trace_id="0"}' in expo
+        assert expo.endswith("# EOF\n")
+        classic = m.registry.expose()
+        assert "# {trace_id=" not in classic
+        assert "# EOF" not in classic
+
+    def test_window_thread_stamps_share_submitter_clock_domain(self):
+        """A ticket submitted inside a synthetic-clock trace but dispatched
+        by the (untraced) window thread must stamp EVERY lifecycle point
+        from the submitter's captured clock — mixing the synthetic timeline
+        with the bare monotonic fallback recorded system-uptime-sized
+        garbage as queue_wait/e2e."""
+        from autoscaler_tpu.loadgen.driver import _TraceClock
+
+        rng = np.random.default_rng(21)
+        m = AutoscalerMetrics()
+        co = FleetCoalescer(buckets="16x4x8", window_s=0.002, metrics=m)
+        co.start()
+        try:
+            tracer = trace.Tracer(
+                clock=_TraceClock(),
+                recorder=trace.FlightRecorder(capacity=2),
+            )
+            with tracer.tick("main"):
+                tk = co.submit(self._req(rng))
+            tk.result(10.0)
+        finally:
+            co.stop()
+        # synthetic clock: 1ms per reading — every stamp lives near zero,
+        # and the deltas are a handful of milliseconds, not system uptime
+        assert tk.t_submit <= tk.t_dispatch <= tk.t_resolve
+        assert tk.t_resolve < 1.0, (tk.t_submit, tk.t_dispatch, tk.t_resolve)
+        e2e = max(tk.t_resolve - tk.t_submit, 0.0)
+        assert e2e < 1.0
+
+    def test_slo_fed_per_resolved_ticket_and_failed_batch(self, monkeypatch):
+        rng = np.random.default_rng(6)
+        engine = SloEngine(specs=fleet_slos())
+        co = FleetCoalescer(buckets="16x4x8", slo=engine)
+        co.submit(self._req(rng))
+        co.flush()
+        assert engine.tick(0.0, 0)["slos"][SLI_FLEET_E2E]["events_total"] == 1
+        # every rung failing charges one BAD event per ticket
+        monkeypatch.setattr(
+            co, "_walk_ladder",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")),
+        )
+        tk = co.submit(self._req(rng))
+        co.flush()
+        with pytest.raises(Exception):
+            tk.result(1.0)
+        slo = engine.tick(1.0, 1)["slos"][SLI_FLEET_E2E]
+        assert (slo["events_total"], slo["events_bad"]) == (2, 1)
+
+    def test_tenant_label_cardinality_bound(self):
+        rng = np.random.default_rng(7)
+        m = AutoscalerMetrics()
+        co = FleetCoalescer(buckets="16x4x8", metrics=m, max_tenant_labels=2)
+        for name in ("a", "b", "noisy-1", "noisy-2"):
+            co.submit(self._req(rng, tenant=name))
+        co.flush()
+        assert co.tenant_label("a") == "a"
+        assert co.tenant_label("b") == "b"
+        assert co.tenant_label("noisy-1") == OVERFLOW_TENANT
+        assert co.tenant_label("never-seen") == OVERFLOW_TENANT
+        assert m.fleet_e2e_seconds.count(
+            tenant=OVERFLOW_TENANT, bucket="16x4x8"
+        ) == 2
+        # overflow tenants are NOT memoized — the guard itself must stay
+        # bounded under an abusive tenant-id generator
+        for i in range(100):
+            assert co.tenant_label(f"abuse-{i}") == OVERFLOW_TENANT
+        assert len(co._tenant_labels) == 2
+        # 0 = unbounded
+        co2 = FleetCoalescer(buckets="16x4x8", max_tenant_labels=0)
+        for i in range(100):
+            assert co2.tenant_label(f"t{i}") == f"t{i}"
+
+    def test_dispatch_span_links_every_cobatched_ticket(self):
+        rng = np.random.default_rng(8)
+        co = FleetCoalescer(buckets="16x4x8", batch_scenarios=4)
+        tracer = trace.Tracer(recorder=trace.FlightRecorder(capacity=2))
+        contexts = []
+        with tracer.tick("main"):
+            for name in ("a", "b"):
+                with trace.span("fleetSubmit", tenant=name):
+                    tk = co.submit(self._req(rng, tenant=name))
+                    contexts.append(tk.trace_context)
+            co.flush()
+        assert len(set(contexts)) == 2
+        dispatch = [
+            s for t in tracer.recorder.traces() for s in t.spans
+            if s.name == "fleetDispatch" and s.attrs.get("outcome") == "ok"
+        ]
+        assert dispatch
+        assert dispatch[-1].attrs["links"] == ",".join(contexts)
+
+
+# ------------------------------------------------------- RPC propagation
+@pytest.fixture()
+def rpc_pair():
+    pytest.importorskip("grpc")
+    from autoscaler_tpu.rpc.service import TpuSimulationClient, serve
+
+    side_tracer = trace.Tracer(recorder=trace.FlightRecorder(capacity=16))
+    co = FleetCoalescer(buckets="16x4x8", window_s=0.002, batch_scenarios=4)
+    server, port = serve(fleet=co, tracer=side_tracer)
+    client = TpuSimulationClient(f"127.0.0.1:{port}", default_timeout_s=30.0)
+    yield client, side_tracer
+    client.close()
+    server.stop(0)
+    co.stop()
+
+
+def test_rpc_serving_spans_share_client_trace_id(rpc_pair):
+    """The cross-process acceptance: client and sidecar spans for the same
+    request share ONE trace id, and each serving root names the exact
+    rpcCall parent span."""
+    client, side_tracer = rpc_pair
+    rng = np.random.default_rng(9)
+    req = rng.integers(1, 100, (9, 6)).astype(np.float32)
+    masks = rng.random((3, 9)) > 0.2
+    allocs = rng.integers(100, 500, (3, 6)).astype(np.float32)
+    caps = rng.integers(1, 16, 3).astype(np.int32)
+    gids = ["g0", "g1", "g2"]
+    client_tracer = trace.Tracer(recorder=trace.FlightRecorder(capacity=4))
+    with client_tracer.tick("main"):
+        client.estimate(req, masks, allocs, gids, caps, max_nodes=16)
+        client.batch_estimate(
+            req, masks, allocs, gids, caps, max_nodes=16, tenant_id="alpha",
+        )
+    client_trace = client_tracer.recorder.traces()[-1]
+    rpc_span_ids = {
+        s.span_id for s in client_trace.spans if s.name == "rpcCall"
+    }
+    served = side_tracer.recorder.traces()
+    assert len(served) == 2
+    assert {t.root.attrs["method"] for t in served} == {
+        "Estimate", "BatchEstimate",
+    }
+    for t in served:
+        assert t.trace_id == client_trace.trace_id
+        assert t.root.attrs["parent_trace_id"] == client_trace.trace_id
+        assert t.root.attrs["parent_span_id"] in rpc_span_ids
+
+
+def test_rpc_without_client_trace_serves_local_trace(rpc_pair):
+    client, side_tracer = rpc_pair
+    rng = np.random.default_rng(10)
+    req = rng.integers(1, 100, (6, 6)).astype(np.float32)
+    client.estimate(
+        req, rng.random((2, 6)) > 0.2,
+        rng.integers(100, 500, (2, 6)).astype(np.float32),
+        ["g0", "g1"], rng.integers(1, 16, 2).astype(np.int32), max_nodes=8,
+    )
+    served = side_tracer.recorder.traces()[-1]
+    assert "parent_trace_id" not in served.root.attrs
+
+
+def test_fleet_proto_carries_trace_context():
+    from autoscaler_tpu.rpc import fleet_pb2
+
+    fields = {f.name for f in fleet_pb2.BatchEstimateRequest.DESCRIPTOR.fields}
+    assert "trace_context" in fields
+    msg = fleet_pb2.BatchEstimateRequest(trace_context="4:2")
+    assert fleet_pb2.BatchEstimateRequest.FromString(
+        msg.SerializeToString()
+    ).trace_context == "4:2"
+
+
+# ----------------------------------------------------- run_once + /sloz
+class TestRunOnceIntegration:
+    def test_window_record_per_tick_with_tick_duration_events(self):
+        pods = [build_test_pod(f"p{i}", cpu_m=600, mem=GB) for i in range(3)]
+        a = make_autoscaler(pods=pods)
+        a.run_once(now_ts=0.0)
+        a.run_once(now_ts=10.0)
+        recs = a.slo.records()
+        assert len(recs) == 2
+        assert validate_records(recs) == []
+        last = recs[-1]
+        assert last["slos"][SLI_TICK_DURATION]["events_total"] == 2
+        # the window record shares the perf/trace tick id
+        assert last["tick"] == a.observatory.last_record()["tick"]
+
+    def test_pending_pods_feed_pending_sli(self):
+        # an unschedulable pod (too big for any group) stays pending long
+        # enough to overstay the 60s threshold → one bad event
+        pods = [build_test_pod("giant", cpu_m=50_000, mem=GB)]
+        a = make_autoscaler(pods=pods)
+        for i in range(9):
+            a.run_once(now_ts=float(i * 10))
+        slo = a.slo.records()[-1]["slos"][SLI_PENDING_POD]
+        assert slo["events_total"] >= 1
+        assert slo["events_bad"] >= 1
+
+    def test_crashed_tick_still_writes_window_record(self, monkeypatch):
+        a = make_autoscaler()
+        monkeypatch.setattr(
+            a, "_run_once_traced",
+            lambda *ar, **kw: (_ for _ in ()).throw(RuntimeError("boom")),
+        )
+        with pytest.raises(RuntimeError):
+            a.run_once(now_ts=0.0)
+        assert len(a.slo.records()) == 1
+
+
+class TestSlozEndpoint:
+    def _get(self, port, path):
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, r.read().decode()
+
+    def test_list_and_drilldown(self):
+        a = make_autoscaler()
+        a.run_once(now_ts=0.0)
+        server = ObservabilityServer(a, "127.0.0.1:0")
+        port = server.start()
+        try:
+            code, body = self._get(port, "/sloz")
+            assert code == 200
+            listing = json.loads(body)
+            assert listing["schema"] == SCHEMA
+            # the control-loop catalog only — no permanently-silent fleet
+            # objective on a process that serves no fleet traffic
+            assert set(listing["slos"]) == {
+                SLI_TICK_DURATION, SLI_PENDING_POD,
+            }
+            code, body = self._get(port, f"/sloz?slo={SLI_TICK_DURATION}")
+            assert code == 200
+            detail = json.loads(body)
+            assert detail["slo"] == SLI_TICK_DURATION
+            assert len(detail["history"]) == 1
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(port, "/sloz?slo=bogus")
+            assert ei.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(port, "/sloz/extra")
+            assert ei.value.code == 404
+        finally:
+            server.stop()
+
+    def test_metrics_content_negotiation(self):
+        """/metrics serves the classic (exemplar-free) exposition by
+        default and the OpenMetrics dialect — exemplars + # EOF — only
+        when the scraper's Accept header asks for it."""
+        a = make_autoscaler()
+        a.run_once(now_ts=0.0)
+        # seat an exemplar on a fleet histogram
+        a.metrics.fleet_e2e_seconds.observe_with_exemplar(
+            0.02, "7", tenant="t", bucket="16x4x8"
+        )
+        server = ObservabilityServer(a, "127.0.0.1:0")
+        port = server.start()
+        try:
+            code, body = self._get(port, "/metrics")
+            assert code == 200
+            assert "# {trace_id=" not in body and "# EOF" not in body
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/metrics",
+                headers={"Accept": "application/openmetrics-text"},
+            )
+            with urllib.request.urlopen(req) as r:
+                assert "openmetrics-text" in r.headers["Content-Type"]
+                om = r.read().decode()
+            assert '# {trace_id="7"}' in om
+            assert om.endswith("# EOF\n")
+        finally:
+            server.stop()
+
+    def test_gated_behind_slo_enabled(self):
+        a = make_autoscaler(slo_enabled=False)
+        a.run_once(now_ts=0.0)
+        server = ObservabilityServer(a, "127.0.0.1:0")
+        port = server.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(port, "/sloz")
+            assert ei.value.code == 404
+        finally:
+            server.stop()
+
+    def test_sloz_race_ring_eviction(self):
+        """The /tracez+/perfz race-suite shape: /sloz racing a writer that
+        churns the window ring — every response well-formed JSON, never a
+        torn record."""
+        a = make_autoscaler()
+        a.run_once(now_ts=0.0)
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                a.slo.observe(SLI_TICK_DURATION, 0.01 * (i % 3), now=float(i))
+                a.slo.tick(float(i), i)
+
+        server = ObservabilityServer(a, "127.0.0.1:0")
+        port = server.start()
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            for _ in range(60):
+                for path in ("/sloz", f"/sloz?slo={SLI_TICK_DURATION}"):
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}"
+                    ) as r:
+                        body = r.read().decode()
+                    try:
+                        json.loads(body)
+                    except json.JSONDecodeError as e:  # pragma: no cover
+                        errors.append(f"{path}: torn response: {e}")
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            server.stop()
+        assert not errors
+
+
+# ------------------------------------------- loadgen byte-determinism
+def _fleet_spec_doc():
+    return {
+        "name": "slo_fleet", "seed": 2, "ticks": 3,
+        "fleet": {"tenants": [
+            {"name": "a", "pods": 6, "groups": 2, "max_nodes": 8},
+            {"name": "b", "pods": 12, "groups": 4, "max_nodes": 8,
+             "whatif": True},
+        ]},
+        "options": {"fleet_shape_buckets": "16x4x8",
+                    "fleet_batch_scenarios": 4, "fleet_prewarm": False,
+                    "perf_cost_model": False},
+    }
+
+
+def test_fleet_slo_ledger_replays_byte_identically():
+    from autoscaler_tpu.loadgen.fleetdrive import run_fleet_scenario
+    from autoscaler_tpu.loadgen.spec import ScenarioSpec
+
+    r1 = run_fleet_scenario(ScenarioSpec.from_dict(_fleet_spec_doc()))
+    r2 = run_fleet_scenario(ScenarioSpec.from_dict(_fleet_spec_doc()))
+    assert r1.all_match()
+    lines = r1.slo_ledger_lines()
+    assert lines and lines == r2.slo_ledger_lines()
+    recs = [json.loads(line) for line in lines.splitlines()]
+    assert validate_records(recs) == []
+    # every round's answers feed the fleet objective
+    assert recs[-1]["slos"][SLI_FLEET_E2E]["events_total"] == 6
+
+
+def test_fleet_report_gains_split_columns_and_slo_section():
+    from autoscaler_tpu.loadgen.fleetdrive import run_fleet_scenario
+    from autoscaler_tpu.loadgen.score import build_fleet_report
+    from autoscaler_tpu.loadgen.spec import ScenarioSpec
+
+    result = run_fleet_scenario(ScenarioSpec.from_dict(_fleet_spec_doc()))
+    report = build_fleet_report(result)
+    for tenant, row in report["fleet"]["per_tenant_latency_s"].items():
+        assert {
+            "queue_wait_p50_s", "queue_wait_p99_s", "service_p50_s",
+            "service_p99_s", "p50_s", "p99_s",
+        } <= set(row), (tenant, row)
+        # the split decomposes the e2e figure
+        assert row["queue_wait_p99_s"] <= row["p99_s"]
+        assert row["service_p99_s"] <= row["p99_s"]
+    assert report["slo"]["slos"][SLI_FLEET_E2E]["events_total"] == 6
+    # exemplar trace ids resolve in the run's flight recorder
+    expo = result.metrics.registry.expose(openmetrics=True)
+    trace_ids = {t.trace_id for t in result.recorder.traces()}
+    import re
+
+    ex_ids = {
+        int(x) for x in re.findall(r'# \{trace_id="(\d+)"\}', expo)
+    }
+    assert ex_ids and ex_ids <= trace_ids
+
+
+def test_tick_driver_writes_slo_ledger(tmp_path):
+    """The control-loop scenario path: --slo-ledger on a tiny run writes a
+    schema-valid, replay-stable ledger."""
+    from autoscaler_tpu.loadgen.driver import run_scenario
+    from autoscaler_tpu.loadgen.spec import ScenarioSpec
+
+    doc = {
+        "name": "slo_ticks", "seed": 3, "ticks": 4, "tick_interval_s": 10.0,
+        "node_groups": [
+            {"name": "g", "cpu_m": 4000, "mem_mb": 16384, "max_size": 6,
+             "initial_size": 1},
+        ],
+        "events": [
+            {"at_tick": 0, "kind": "pod_burst", "count": 6, "cpu_m": 500,
+             "mem_mb": 256},
+        ],
+    }
+    r1 = run_scenario(ScenarioSpec.from_dict(doc))
+    r2 = run_scenario(ScenarioSpec.from_dict(doc))
+    lines = r1.slo_ledger_lines()
+    assert lines == r2.slo_ledger_lines()
+    recs = [json.loads(line) for line in lines.splitlines()]
+    assert len(recs) == 4
+    assert validate_records(recs) == []
+    assert recs[-1]["slos"][SLI_TICK_DURATION]["events_total"] == 4
