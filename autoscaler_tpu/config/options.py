@@ -158,6 +158,32 @@ class AutoscalingOptions:
     # aggregate into "__overflow__" so a misbehaving fleet cannot explode
     # /metrics exposition. 0 = unbounded (trusted closed fleets only).
     fleet_max_tenant_labels: int = 64
+    # -- fleet overload armor (fleet/admission.py) ---------------------------
+    # admission bound on the coalescing queue: submits past this depth are
+    # shed typed (FleetOverloadError → RESOURCE_EXHAUSTED + retry-after)
+    # instead of queueing unboundedly. 0 = unbounded (the pre-armor
+    # behavior; trusted closed fleets only).
+    fleet_max_queue_depth: int = 0
+    # per-tenant token-bucket quota: sustained requests/second each tenant
+    # may submit (0 = no quotas) and the bucket's burst capacity (0 =
+    # max(qps, 1)). Over-quota submits shed typed with the seconds-until-
+    # next-token as the retry-after hint.
+    fleet_tenant_qps: float = 0.0
+    fleet_tenant_burst: float = 0.0
+    # sidecar drain: how long server.stop() waits for in-flight RPCs after
+    # the drain sequence stopped admission and flushed the coalescer
+    # (SIGTERM → UNAVAILABLE+drain detail → flush → stop(grace))
+    fleet_drain_grace_s: float = 5.0
+    # client failover (rpc/service.TpuSimulationClient): the sidecar
+    # endpoint list (--rpc-address, repeatable). More than one endpoint
+    # arms failover — the client advances on UNAVAILABLE/drain with
+    # jittered bounded backoff, budgeted inside the caller's deadline.
+    rpc_addresses: List[str] = field(default_factory=list)
+    # client hedging: hedge idempotent Estimate/BatchEstimate against the
+    # next endpoint when the primary hasn't answered after a p99-derived
+    # delay (first answer wins, loser cancelled; never past the caller's
+    # deadline). Off by default — hedging doubles worst-case load.
+    rpc_hedge: bool = False
 
     # -- SLO engine (autoscaler_tpu/slo) -------------------------------------
     # gates /sloz, like perf_enabled gates /perfz; the engine itself always

@@ -109,6 +109,10 @@ class ScaleUpOrchestrator:
             priorities_fetch=priorities_fetch,
             grpc_target=options.grpc_expander_url or None,
             rpc_deadline_s=options.rpc_default_deadline_s,
+            # sidecar failover endpoints + hedging for the expander's
+            # client (--rpc-address / --rpc-hedge)
+            rpc_failover_targets=options.rpc_addresses,
+            rpc_hedge=options.rpc_hedge,
             # the price filter scores against the provider's pricing model
             # (expander/price/price.go); absent model → build_strategy
             # rejects the 'price' entry loudly

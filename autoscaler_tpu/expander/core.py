@@ -249,6 +249,8 @@ def build_strategy(names: Sequence[str], seed: Optional[int] = None, **kwargs) -
             filters.append(GRPCFilter(
                 kwargs["grpc_target"],
                 default_deadline_s=kwargs.get("rpc_deadline_s"),
+                failover_targets=kwargs.get("rpc_failover_targets"),
+                hedge=bool(kwargs.get("rpc_hedge")),
             ))
         elif name == GRPC_REF:
             from autoscaler_tpu.expander.grpc_ import RefGRPCFilter

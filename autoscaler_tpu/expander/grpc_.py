@@ -19,6 +19,8 @@ class GRPCFilter(Filter):
         target: str,
         timeout_s: Optional[float] = None,
         default_deadline_s: Optional[float] = None,
+        failover_targets: Optional[List[str]] = None,
+        hedge: bool = False,
     ):
         from autoscaler_tpu.rpc.service import TpuSimulationClient
 
@@ -30,8 +32,17 @@ class GRPCFilter(Filter):
         # lowering the flag below 5s tightens it, raising it does not
         # widen it. Worst case per tick is 2x the cap: the client's single
         # reconnect-and-resend on UNAVAILABLE pays the deadline once more.
+        #
+        # failover_targets (AutoscalingOptions.rpc_addresses /
+        # --rpc-address, repeatable) are additional endpoints serving the
+        # same surface: the client fails over on UNAVAILABLE/drain with
+        # jittered bounded backoff, and hedge=True (--rpc-hedge) hedges
+        # idempotent calls against the next endpoint.
+        targets = [target] + [
+            t for t in (failover_targets or []) if t and t != target
+        ]
         self.client = TpuSimulationClient(
-            target, default_timeout_s=default_deadline_s
+            targets, default_timeout_s=default_deadline_s, hedge=hedge
         )
         if timeout_s is None:
             timeout_s = (

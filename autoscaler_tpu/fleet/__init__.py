@@ -24,29 +24,64 @@ from autoscaler_tpu.fleet.buckets import (
     pow2ceil,
     select_bucket,
 )
+from autoscaler_tpu.fleet.admission import AdmissionController, TokenBucket
 from autoscaler_tpu.fleet.coalescer import (
     OVERFLOW_TENANT,
     ROUTE_BATCHED,
     ROUTE_ORACLE,
     FleetAnswer,
     FleetCoalescer,
-    FleetError,
     FleetRequest,
     FleetTicket,
 )
+from autoscaler_tpu.fleet.errors import (
+    ADMIT_OK,
+    SHED_DEADLINE,
+    SHED_DRAINING,
+    SHED_OUTCOMES,
+    SHED_QUEUE_FULL,
+    SHED_QUOTA,
+    TICKET_ABANDONED,
+    TICKET_EXPIRED,
+    TICKET_FAILED,
+    TICKET_OUTCOMES,
+    TICKET_RESOLVED,
+    FleetAdmissionError,
+    FleetDeadlineError,
+    FleetDrainError,
+    FleetError,
+    FleetOverloadError,
+)
 
 __all__ = [
+    "ADMIT_OK",
     "DEFAULT_BUCKETS",
     "OVERFLOW_TENANT",
     "ROUTE_BATCHED",
     "ROUTE_ORACLE",
+    "SHED_DEADLINE",
+    "SHED_DRAINING",
+    "SHED_OUTCOMES",
+    "SHED_QUEUE_FULL",
+    "SHED_QUOTA",
+    "TICKET_ABANDONED",
+    "TICKET_EXPIRED",
+    "TICKET_FAILED",
+    "TICKET_OUTCOMES",
+    "TICKET_RESOLVED",
+    "AdmissionController",
     "BucketError",
     "BucketSpec",
+    "FleetAdmissionError",
     "FleetAnswer",
     "FleetCoalescer",
+    "FleetDeadlineError",
+    "FleetDrainError",
     "FleetError",
+    "FleetOverloadError",
     "FleetRequest",
     "FleetTicket",
+    "TokenBucket",
     "adhoc_bucket",
     "format_buckets",
     "pad_operands",

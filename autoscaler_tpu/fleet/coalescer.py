@@ -41,6 +41,7 @@ import numpy as np
 
 from autoscaler_tpu import trace
 from autoscaler_tpu.estimator.ladder import RUNG_PYTHON, RUNG_XLA, KernelLadder
+from autoscaler_tpu.fleet.admission import AdmissionController, partition_expired
 from autoscaler_tpu.fleet.buckets import (
     DEFAULT_BUCKETS,
     BucketSpec,
@@ -49,6 +50,19 @@ from autoscaler_tpu.fleet.buckets import (
     padding_waste,
     parse_buckets,
     select_bucket,
+)
+from autoscaler_tpu.fleet.errors import (
+    SHED_DEADLINE,
+    SHED_DRAINING,
+    SHED_QUEUE_FULL,
+    TICKET_ABANDONED,
+    TICKET_EXPIRED,
+    TICKET_FAILED,
+    TICKET_RESOLVED,
+    FleetDeadlineError,
+    FleetDrainError,
+    FleetError,
+    FleetOverloadError,
 )
 from autoscaler_tpu.metrics import metrics as metrics_mod
 
@@ -61,10 +75,6 @@ ROUTE_ORACLE = "fleet_oracle"
 # fleet (or an abusive tenant-id generator) collapses into ONE series
 # instead of exploding /metrics exposition
 OVERFLOW_TENANT = "__overflow__"
-
-
-class FleetError(RuntimeError):
-    """No rung could serve a coalesced batch."""
 
 
 @dataclass
@@ -85,6 +95,12 @@ class FleetRequest:
     # a traced tick get it captured automatically at submit() — it parents
     # the shared fleetDispatch span's links and the SLI exemplars
     trace_context: str = ""
+    # remaining deadline budget in seconds at submission (the RPC path
+    # passes gRPC's context.time_remaining(), driver paths pass the
+    # request's own budget; None = no deadline). The coalescer converts it
+    # to an absolute instant on ITS injected clock, so expiry shedding is
+    # deterministic under the loadgen sim clock.
+    deadline_s: Optional[float] = None
 
     def shape(self) -> Tuple[int, int, int]:
         P, R = self.pod_req.shape
@@ -142,24 +158,66 @@ class FleetTicket:
         # origin trace context (copied from the request at submit) — the
         # span-link + exemplar identity of this ticket
         self.trace_context: str = ""
+        # absolute expiry instant on the COALESCER's injected clock (seated
+        # by submit from FleetRequest.deadline_s; None = no deadline) —
+        # flush/_dispatch_batch shed past-deadline tickets typed instead of
+        # spending batch slots on answers nobody is waiting for
+        self.deadline_ts: Optional[float] = None
+        # abandonment: result(timeout) raising TimeoutError marks the
+        # caller DEPARTED. A late resolve still completes the ticket (a
+        # polling retry must never hang) but its lifecycle is counted
+        # `abandoned`, not stamped into SLIs/exemplars as a fake good event
+        self._state_lock = threading.Lock()
+        self._abandoned = False
 
-    def resolve(self, answer: FleetAnswer) -> None:
-        self._answer = answer
-        self.resolved_wall = time.perf_counter()
-        self._done.set()
+    @property
+    def abandoned(self) -> bool:
+        with self._state_lock:
+            return self._abandoned
 
-    def fail(self, error: BaseException) -> None:
-        self._error = error
-        self.resolved_wall = time.perf_counter()
-        self._done.set()
+    def done(self) -> bool:
+        """True once the ticket reached a terminal state (answer, typed
+        failure, or typed shed) — the zero-hung-tickets audit reads this."""
+        return self._done.is_set()
+
+    def resolve(self, answer: FleetAnswer) -> bool:
+        """Deliver the answer. Returns True when the caller was still
+        waiting (lifecycle SLIs may be stamped), False when the ticket was
+        abandoned — taken under the state lock so a ``result`` timing out
+        concurrently cannot be half-counted on both sides."""
+        with self._state_lock:
+            abandoned = self._abandoned
+            self._answer = answer
+            self.resolved_wall = time.perf_counter()
+            self._done.set()
+        return not abandoned
+
+    def fail(self, error: BaseException) -> bool:
+        with self._state_lock:
+            abandoned = self._abandoned
+            self._error = error
+            self.resolved_wall = time.perf_counter()
+            self._done.set()
+        return not abandoned
 
     def result(self, timeout: Optional[float] = None) -> FleetAnswer:
         if not self._done.wait(timeout):
-            raise TimeoutError("fleet answer not ready within the deadline")
-        if self._error is not None:
-            raise self._error
-        assert self._answer is not None
-        return self._answer
+            # atomic vs a concurrent resolve(): only a ticket that is
+            # STILL unresolved is marked abandoned — if the answer landed
+            # between the wait and here, the caller can still read it on
+            # a retry and the lifecycle observation stays honest
+            with self._state_lock:
+                if not self._done.is_set():
+                    self._abandoned = True
+                    raise TimeoutError(
+                        "fleet answer not ready within the deadline"
+                    )
+        with self._state_lock:
+            error, answer = self._error, self._answer
+        if error is not None:
+            raise error
+        assert answer is not None
+        return answer
 
 
 class FleetCoalescer:
@@ -184,6 +242,10 @@ class FleetCoalescer:
         sleep: Callable[[float], None] = time.sleep,
         slo: Any = None,
         max_tenant_labels: int = 64,
+        max_queue_depth: int = 0,
+        tenant_qps: float = 0.0,
+        tenant_burst: float = 0.0,
+        latency_hook: Optional[Callable[[str], float]] = None,
     ) -> None:
         if batch_scenarios < 1:
             raise ValueError(f"batch_scenarios must be >= 1, got {batch_scenarios}")
@@ -210,11 +272,32 @@ class FleetCoalescer:
         self._pending: List[Tuple[FleetRequest, FleetTicket]] = []
         self._thread: Optional[threading.Thread] = None
         self._running = False
+        # drain bit (GL004: flipped only under the queue lock): True from
+        # the moment stop() begins until a start() re-arms — a submit that
+        # loses the race against a drain gets the typed FleetDrainError,
+        # never a ticket that nothing will ever flush
+        self._draining = False
         self._prewarmed: List[str] = []
         self._configured = frozenset(self.buckets)
         # tenant id → metric label, insertion-ordered admission (GL004:
         # written only under the queue lock)
         self._tenant_labels: Dict[str, str] = {}
+        # deadline-aware admission: queue-depth bound + per-tenant token
+        # buckets on the injected clock (fleet/admission.py; all state
+        # mutated under the queue lock). Defaults keep both gates off.
+        self.admission = AdmissionController(
+            max_queue_depth=max_queue_depth,
+            tenant_qps=tenant_qps,
+            tenant_burst=tenant_burst,
+            window_s=self.window_s,
+            # same bound AND same semantics as the metric-label guard:
+            # 0 = unbounded (every tenant gets its own quota bucket)
+            max_tenants=self.max_tenant_labels,
+        )
+        # chaos seam (loadgen rpc_slow): tenant_id → extra service seconds
+        # folded into the demux/resolve timeline stamps — simulated RPC
+        # slowness that reaches the SLIs/SLO deterministically
+        self.latency_hook = latency_hook
 
     # -- wiring ---------------------------------------------------------------
     @classmethod
@@ -226,6 +309,9 @@ class FleetCoalescer:
             window_s=options.fleet_coalesce_window_ms / 1000.0,
             batch_scenarios=options.fleet_batch_scenarios,
             max_tenant_labels=options.fleet_max_tenant_labels,
+            max_queue_depth=options.fleet_max_queue_depth,
+            tenant_qps=options.fleet_tenant_qps,
+            tenant_burst=options.fleet_tenant_burst,
             **kwargs,
         )
         if options.fleet_prewarm:
@@ -249,6 +335,13 @@ class FleetCoalescer:
         """Park one request for the next coalesced dispatch. The queue is
         the only cross-thread state; tickets are resolved outside the lock.
 
+        Admission is deadline-aware and typed: a draining coalescer raises
+        :class:`FleetDrainError` (fail over, don't wait), a full queue or
+        an over-quota tenant raises :class:`FleetOverloadError` carrying
+        ``retry_after_s``, and a request whose deadline budget is already
+        spent raises :class:`FleetDeadlineError` — a caller NEVER gets a
+        ticket that nothing will resolve.
+
         Trace-context capture: a request that arrived without an explicit
         origin context (the RPC path decodes one from the wire) inherits
         the ambient one — a submitter inside a traced tick (loadgen fleet
@@ -268,18 +361,67 @@ class FleetCoalescer:
         ticket.stamp_clock = trace.timeline_clock() or self._clock
         ticket.t_submit = ticket.stamp_clock()
         ticket.submitted_wall = time.perf_counter()
+        now = self._clock()
+        if request.deadline_s is not None:
+            ticket.deadline_ts = now + max(float(request.deadline_s), 0.0)
         with self._lock:
-            self._pending.append((request, ticket))
-            self._tenant_label_locked(request.tenant_id)
-            if self.metrics is not None:
-                # published under the queue lock so a concurrent flush()
-                # can't interleave its set(0) with a stale depth — the
-                # gauge and the queue move together (metric series take
-                # their own inner lock; the order is always queue → series)
-                self.metrics.fleet_queue_depth.set(float(len(self._pending)))
-            self._cond.notify()
+            if ticket.deadline_ts is not None and now >= ticket.deadline_ts:
+                # a dead-on-arrival budget: shed typed BEFORE the
+                # drain/depth/quota gates — a request nobody can answer in
+                # time must not burn a quota token or count twice in the
+                # admission tallies
+                verdict = self.admission.admit_expired()
+            else:
+                verdict = self.admission.admit(
+                    request.tenant_id, len(self._pending), now,
+                    draining=self._draining,
+                )
+            tenant = self._tenant_label_locked(request.tenant_id)
+            if verdict.admitted:
+                self._pending.append((request, ticket))
+                if self.metrics is not None:
+                    # published under the queue lock so a concurrent
+                    # flush() can't interleave its set(0) with a stale
+                    # depth — the gauge and the queue move together
+                    # (metric series take their own inner lock; the order
+                    # is always queue → series)
+                    self.metrics.fleet_queue_depth.set(
+                        float(len(self._pending))
+                    )
+                self._cond.notify()
+        if self.metrics is not None:
+            self.metrics.fleet_admission_total.inc(
+                outcome=verdict.outcome, tenant=tenant
+            )
+        if not verdict.admitted:
+            raise self._shed_error(verdict, request.tenant_id)
         ticket.t_admit = ticket.stamp_clock()
         return ticket
+
+    @staticmethod
+    def _shed_error(verdict, tenant_id: str) -> Exception:
+        """Admission verdict → the typed rejection the RPC layer maps to
+        a gRPC status (errors.py documents the mapping)."""
+        if verdict.outcome == SHED_DRAINING:
+            return FleetDrainError(
+                "fleet coalescer draining: sidecar shutting down, fail "
+                "over to another endpoint"
+            )
+        if verdict.outcome == SHED_DEADLINE:
+            return FleetDeadlineError(
+                f"tenant {tenant_id} request deadline already expired at "
+                "admission"
+            )
+        detail = (
+            "coalescing queue full"
+            if verdict.outcome == SHED_QUEUE_FULL
+            else f"tenant {tenant_id} over quota"
+        )
+        return FleetOverloadError(
+            f"{detail}; retry after {verdict.retry_after_s:.3f}s",
+            retry_after_s=verdict.retry_after_s,
+            outcome=verdict.outcome,
+        )
 
     def _tenant_label_locked(self, tenant_id: str) -> str:
         """The cardinality bound (caller holds the queue lock): the first
@@ -305,28 +447,64 @@ class FleetCoalescer:
         with self._lock:
             return self._tenant_label_locked(tenant_id)
 
+    def admission_snapshot(self) -> Dict[str, int]:
+        """Lifetime admission-outcome tallies, read under the queue lock
+        (the controller itself is lock-free by contract)."""
+        with self._lock:
+            return self.admission.snapshot()
+
     def queue_depth(self) -> int:
         with self._lock:
             return len(self._pending)
 
     # -- the coalescing window (RPC path) -------------------------------------
     def start(self) -> None:
-        """Run the window thread: whenever the queue is non-empty, wait one
-        coalescing window (letting co-tenant requests pile in), then flush.
-        A thread that died (it should not — the loop absorbs flush errors)
-        is revived, not treated as running."""
+        """EXPLICIT start: re-arms a drained coalescer (the one way out of
+        the drain state) and runs the window thread. Per-request revival
+        paths must use :meth:`ensure_running` instead — it refuses to
+        un-drain."""
         with self._lock:
+            self._draining = False
+            if self.metrics is not None:
+                self.metrics.fleet_draining.set(0.0)
+        self.ensure_running()
+
+    def ensure_running(self) -> bool:
+        """Run the window thread UNLESS draining (atomic with the drain
+        bit): whenever the queue is non-empty it waits one coalescing
+        window (letting co-tenant requests pile in), then flushes. A
+        thread that died (it should not — the loop absorbs flush errors)
+        is revived, not treated as running. Returns False while draining —
+        a racing RPC must NOT resurrect a stopping coalescer (its submit
+        gets the typed drain rejection instead)."""
+        with self._lock:
+            if self._draining:
+                return False
             if self._thread is not None and self._thread.is_alive():
-                return
+                return True
             self._running = True
             self._thread = threading.Thread(
                 target=self._window_loop, name="fleet-coalescer", daemon=True
             )
             thread = self._thread
         thread.start()
+        return True
+
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
 
     def stop(self) -> None:
+        """The drain sequence: (1) flip the drain bit under the queue lock
+        — from this instant every submit, including one racing this very
+        call, gets the typed FleetDrainError instead of a ticket nothing
+        will flush; (2) stop and join the window thread; (3) flush every
+        in-flight ticket so the queue empties with answers, not hangs."""
         with self._lock:
+            self._draining = True
+            if self.metrics is not None:
+                # order queue-state → series, same as the depth gauge rule
+                self.metrics.fleet_draining.set(1.0)
             self._running = False
             thread = self._thread
             self._thread = None
@@ -357,16 +535,30 @@ class FleetCoalescer:
                 )
 
     # -- bucket + dispatch + demux --------------------------------------------
-    def flush(self) -> int:
-        """Dispatch everything pending; returns the request count served.
+    def flush(self, limit: Optional[int] = None) -> int:
+        """Dispatch pending requests; returns the request count served.
         Deterministic: batches form per bucket in submission order, buckets
         dispatch in sorted key order — replaying the same submission
-        sequence forms the same batches."""
+        sequence forms the same batches.
+
+        Expired tickets are shed FIRST, typed (FleetDeadlineError), before
+        they consume batch slots — shedding runs on the injected clock so
+        it replays byte-identically. ``limit`` bounds how many live
+        requests this flush serves (submission order; the rest stay
+        queued) — the overload bench uses it to model a service slower
+        than its arrival rate; production flushes pass None."""
+        now = self._clock()
         with self._lock:
-            drained = self._pending
-            self._pending = []
+            live, expired = partition_expired(self._pending, now)
+            if limit is not None and limit < len(live):
+                drained, rest = live[:limit], live[limit:]
+            else:
+                drained, rest = live, []
+            self._pending = rest
             if self.metrics is not None:
-                self.metrics.fleet_queue_depth.set(0.0)
+                self.metrics.fleet_queue_depth.set(float(len(rest)))
+        for req, ticket in expired:
+            self._shed_expired(req, ticket, now)
         if not drained:
             return 0
         by_bucket: Dict[BucketSpec, List[Tuple[FleetRequest, FleetTicket]]] = {}
@@ -416,9 +608,49 @@ class FleetCoalescer:
             scen_req[s], scen_masks[s], scen_allocs[s], scen_caps[s] = r, m, a, c
         return scen_req, scen_masks, scen_allocs, scen_caps
 
+    def _shed_expired(self, req: FleetRequest, ticket: FleetTicket,
+                      now: float) -> None:
+        """Fail one past-deadline ticket typed (DEADLINE_EXCEEDED — never a
+        silent hang) and charge the bad-budget event on the injected clock
+        so the shed replays byte-identically. Queue expiry is a TICKET
+        outcome, not an admission verdict — the ticket was already counted
+        `admitted`, so only fleet_ticket_outcomes_total moves here (an
+        admission_total row too would make the verdicts stop summing to
+        submits)."""
+        ticket.t_resolve = ticket.stamp_clock()
+        if self.slo is not None:
+            from autoscaler_tpu.slo import SLI_FLEET_E2E
+
+            self.slo.observe_event(SLI_FLEET_E2E, bad=True, now=now)
+        delivered = ticket.fail(
+            FleetDeadlineError(
+                "fleet ticket deadline expired before its batch dispatched"
+            )
+        )
+        self._count_outcome(
+            TICKET_EXPIRED if delivered else TICKET_ABANDONED,
+            req.tenant_id,
+        )
+
+    def _count_outcome(self, outcome: str, tenant_id: str) -> None:
+        if self.metrics is not None:
+            self.metrics.fleet_ticket_outcomes_total.inc(
+                outcome=outcome, tenant=self.tenant_label(tenant_id)
+            )
+
     def _dispatch_batch(
         self, bucket: BucketSpec, entries: Sequence[Tuple[FleetRequest, FleetTicket]]
     ) -> None:
+        # second expiry gate (the first runs in flush): on the RPC path
+        # the clock advances between flush partition and dispatch, and a
+        # ticket that died waiting for earlier buckets in this same flush
+        # must not consume a batch slot either
+        now = self._clock()
+        entries, expired = partition_expired(entries, now)
+        for req, ticket in expired:
+            self._shed_expired(req, ticket, now)
+        if not entries:
+            return
         try:
             slots = self._batch_slots(bucket, len(entries))
             scen_req, scen_masks, scen_allocs, scen_caps = self._batch_operands(
@@ -460,7 +692,7 @@ class FleetCoalescer:
             # ticket out.
             err = FleetError(f"no fleet rung served bucket {bucket.key}: {e}")
             err.__cause__ = e
-            for _, ticket in entries:
+            for req, ticket in entries:
                 ticket.t_resolve = ticket.stamp_clock()
                 if self.slo is not None:
                     # a failed batch is bad budget regardless of latency;
@@ -472,7 +704,11 @@ class FleetCoalescer:
                     self.slo.observe_event(
                         SLI_FLEET_E2E, bad=True, now=self._clock()
                     )
-                ticket.fail(err)
+                delivered = ticket.fail(err)
+                self._count_outcome(
+                    TICKET_FAILED if delivered else TICKET_ABANDONED,
+                    req.tenant_id,
+                )
             return
         if self.metrics is not None:
             self.metrics.fleet_batches_total.inc(bucket=bucket.key, route=route)
@@ -481,12 +717,26 @@ class FleetCoalescer:
                 req, counts[s], scheduled[s], bucket, len(entries), waste,
                 route,
             )
-            ticket.t_demux = ticket.stamp_clock()
+            # chaos seam: injected rpc_slow latency lands in the timeline
+            # stamps (deterministic under the sim clock) so slow service
+            # reaches the SLIs/SLO exactly as real slowness would
+            extra = (
+                self.latency_hook(req.tenant_id)
+                if self.latency_hook is not None else 0.0
+            )
+            ticket.t_demux = ticket.stamp_clock() + extra
             # resolve is stamped BEFORE the event fires so a caller
             # unblocked by result() always reads a complete stamp set
-            ticket.t_resolve = ticket.stamp_clock()
-            self._observe_lifecycle(req, ticket, bucket)
-            ticket.resolve(answer)
+            ticket.t_resolve = ticket.stamp_clock() + extra
+            delivered = ticket.resolve(answer)
+            if delivered:
+                # lifecycle SLIs fire only for a caller that was still
+                # there — an abandoned ticket's late answer must not stamp
+                # exemplars/SLO good events for a departed caller
+                self._observe_lifecycle(req, ticket, bucket)
+                self._count_outcome(TICKET_RESOLVED, req.tenant_id)
+            else:
+                self._count_outcome(TICKET_ABANDONED, req.tenant_id)
 
     def _observe_lifecycle(
         self, req: FleetRequest, ticket: FleetTicket, bucket: BucketSpec

@@ -152,6 +152,31 @@ class FaultInjector:
                     return kind
         return None
 
+    def on_fleet_submit(self) -> Optional[str]:
+        """Process-level fleet chaos seam (loadgen/fleetdrive.py consults
+        it before every tenant submit): an active ``sidecar_crash`` /
+        ``sidecar_partition`` makes THIS submit fail typed-unavailable —
+        the client-side view of a dead endpoint — instead of reaching the
+        coalescer. Returns the fault kind or None."""
+        for kind in ("sidecar_crash", "sidecar_partition"):
+            f = self._active(kind, "")
+            if f is not None:
+                self._note(kind)
+                return kind
+        return None
+
+    def on_rpc_dispatch(self, tenant: str) -> float:
+        """``rpc_slow`` seam (the coalescer's latency_hook): sim-clock
+        seconds of injected service latency folded into this ticket's
+        demux/resolve stamps. Deterministic: consulted in demux order,
+        which is submission order."""
+        f = self._active("rpc_slow", "")
+        if f is not None and f.latency_s > 0:
+            self._note("rpc_slow")
+            self.injected_latency_s += f.latency_s
+            return f.latency_s
+        return 0.0
+
     def on_arena_apply(self) -> Optional[str]:
         """Resident-arena fault hook (snapshot/arena.DeviceArena
         fault_hook): a truthy return fails THIS tick's delta apply — the

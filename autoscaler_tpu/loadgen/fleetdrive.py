@@ -35,6 +35,8 @@ import numpy as np
 
 from autoscaler_tpu import trace
 from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.fleet import FleetAdmissionError
+from autoscaler_tpu.slo import SLI_FLEET_E2E
 from autoscaler_tpu.loadgen.driver import BASE_TS, _TraceClock
 from autoscaler_tpu.loadgen.faults import FaultInjector
 from autoscaler_tpu.loadgen.spec import ScenarioSpec, SpecError, TenantSpec
@@ -42,8 +44,11 @@ from autoscaler_tpu.metrics import metrics as metrics_mod
 from autoscaler_tpu.metrics.metrics import AutoscalerMetrics
 from autoscaler_tpu.trace import FlightRecorder, Tracer
 
-# fleet decision-ledger schema (sorted-key JSONL, one line per round)
-FLEET_SCHEMA = "autoscaler_tpu.fleet.round/1"
+# fleet decision-ledger schema (sorted-key JSONL, one line per round).
+# /2 added the overload-armor fields: per-round `shed` rows (typed
+# admission/chaos rejections with retry-after) and the `outcomes` tally
+# (the zero-hung-tickets audit's per-round ledger witness).
+FLEET_SCHEMA = "autoscaler_tpu.fleet.round/2"
 
 
 @dataclass
@@ -74,6 +79,17 @@ class FleetRoundRecord:
     tenants: List[FleetTenantVerdict] = field(default_factory=list)
     degraded: List[str] = field(default_factory=list)
     errors: List[str] = field(default_factory=list)
+    # typed sheds this round, in submission order: admission/chaos
+    # rejections at submit (queue full / quota / drain / sidecar outage,
+    # counted under outcomes["shed"]) followed by post-admission sheds
+    # (queue expiry / drain race, counted under outcomes["expired"]) —
+    # so len(shed) == outcomes["shed"] + outcomes["expired"]
+    shed: List[Dict[str, Any]] = field(default_factory=list)
+    # terminal-outcome tally for every request posted this round; the
+    # accounting identity the chaos gate asserts is
+    #   posted = resolved + shed + expired + failed + unresolved
+    # and `unresolved` MUST be 0 (the zero-hung-tickets audit)
+    outcomes: Dict[str, int] = field(default_factory=dict)
     wall_s: float = 0.0
 
     def to_dict(self) -> Dict[str, Any]:
@@ -86,6 +102,8 @@ class FleetRoundRecord:
             "tenants": [t.to_dict() for t in self.tenants],
             "degraded": self.degraded,
             "errors": self.errors,
+            "shed": self.shed,
+            "outcomes": {k: self.outcomes[k] for k in sorted(self.outcomes)},
         }
 
 
@@ -112,6 +130,12 @@ class FleetRunResult:
     # per-round SLO window records (the fleet_e2e objective on the ticket
     # timeline stamps) — byte-identical across replays
     slo_records: List[Dict[str, Any]] = field(default_factory=list)
+    # tickets that reached NO terminal state by end of their round — the
+    # zero-hung-tickets acceptance gate asserts this stays 0
+    unresolved: int = 0
+    # lifetime admission tallies from the coalescer's controller
+    # (admitted / shed_* by reason), read once at run end
+    admission: Dict[str, int] = field(default_factory=dict)
 
     def decision_log(self) -> List[Dict[str, Any]]:
         return [r.to_dict() for r in self.records]
@@ -145,13 +169,14 @@ class FleetRunResult:
 
 
 def _tenant_request(spec: ScenarioSpec, t_index: int, tenant: TenantSpec,
-                    tick: int):
+                    tick: int, copy: int = 0):
     """One round's request content for one tenant — a pure function of
-    (seed, tenant index, round)."""
+    (seed, tenant index, round, copy). ``copy`` distinguishes a storm
+    tenant's same-round submissions (requests_per_round > 1)."""
     from autoscaler_tpu.fleet import FleetRequest
     from autoscaler_tpu.kube.objects import CPU, MEMORY, NUM_RESOURCES, PODS
 
-    rng = np.random.default_rng((spec.seed, t_index, tick, 7919))
+    rng = np.random.default_rng((spec.seed, t_index, tick, copy, 7919))
     P, G, R = tenant.pods, tenant.groups, NUM_RESOURCES
     req = np.zeros((P, R), np.float32)
     req[:, CPU] = rng.integers(
@@ -183,6 +208,7 @@ def _tenant_request(spec: ScenarioSpec, t_index: int, tenant: TenantSpec,
         node_caps=caps,
         max_nodes=tenant.max_nodes,
         prices=prices,
+        deadline_s=tenant.deadline_s if tenant.deadline_s > 0 else None,
     )
 
 
@@ -246,6 +272,15 @@ class FleetScenarioDriver:
             clock=lambda: self._sim_now,
             slo=self.slo,
             max_tenant_labels=self.options.fleet_max_tenant_labels,
+            # overload armor: queue bound + per-tenant quotas on the SAME
+            # injected sim clock, so admission sheds (and their
+            # retry-after hints) replay byte-identically
+            max_queue_depth=self.options.fleet_max_queue_depth,
+            tenant_qps=self.options.fleet_tenant_qps,
+            tenant_burst=self.options.fleet_tenant_burst,
+            # chaos seam: rpc_slow folds sim-clock latency into the
+            # ticket service stamps at demux
+            latency_hook=self.injector.on_rpc_dispatch,
             # breaker knobs ride the same options as the estimator ladder
             ladder=KernelLadder(
                 failure_threshold=self.options.kernel_breaker_failure_threshold,
@@ -256,6 +291,7 @@ class FleetScenarioDriver:
         # fleet ladder's rung dispatch, exactly like the estimator's
         self.coalescer.ladder.fault_hook = self.injector.on_kernel_dispatch
         self.prewarmed: List[str] = []
+        self._unresolved = 0
 
     def run(self) -> FleetRunResult:
         spec = self.spec
@@ -292,34 +328,91 @@ class FleetScenarioDriver:
             self.observatory.begin_tick(tick, now)
             self.tracer.set_context(scenario=spec.name, tick=tick, sim_ts=now)
             requests = [
-                _tenant_request(spec, ti, tenant, tick)
+                _tenant_request(spec, ti, tenant, tick, copy)
                 for ti, tenant in enumerate(fleet.tenants)
+                for copy in range(tenant.requests_per_round)
             ]
             answered = []
+            outcomes = {
+                "resolved": 0, "failed": 0, "expired": 0, "shed": 0,
+                "unresolved": 0,
+            }
             with self.tracer.tick(metrics_mod.MAIN):
                 # the timed window covers ONLY the fleet service's work —
                 # admission, coalesced dispatch, demux — so the report's
                 # latency columns measure the service, not the driver's
                 # request generation or the certification dispatches below
                 t0 = time.perf_counter()
-                # one fleetSubmit span per tenant: each ticket's origin
-                # context is its OWN span, so the shared fleetDispatch
-                # span's links genuinely enumerate the co-batched tickets
-                # (one batch, many origins — the RPC path gets the same
-                # shape from each client's rpcCall span)
-                tickets = []
+                # one fleetSubmit span per tenant request: each ticket's
+                # origin context is its OWN span, so the shared
+                # fleetDispatch span's links genuinely enumerate the
+                # co-batched tickets (one batch, many origins — the RPC
+                # path gets the same shape from each client's rpcCall span)
+                submitted = []
                 for r in requests:
-                    with trace.span(
-                        metrics_mod.FLEET_SUBMIT, tenant=r.tenant_id
-                    ):
-                        tickets.append(self.coalescer.submit(r))
+                    # process-level chaos seam: an active sidecar_crash /
+                    # sidecar_partition makes the submit fail typed
+                    # unavailable — the client saw a dead endpoint. That
+                    # IS bad budget (no answer, no backpressure hint), so
+                    # the burn alert fires during the outage.
+                    kind = self.injector.on_fleet_submit()
+                    if kind is not None:
+                        rec.shed.append({
+                            "tenant": r.tenant_id,
+                            "reason": kind,
+                            "error": "FleetUnavailableError",
+                            "retry_after_s": 0.0,
+                        })
+                        outcomes["shed"] += 1
+                        self.slo.observe_event(SLI_FLEET_E2E, bad=True,
+                                               now=now)
+                        continue
+                    try:
+                        with trace.span(
+                            metrics_mod.FLEET_SUBMIT, tenant=r.tenant_id
+                        ):
+                            submitted.append((r, self.coalescer.submit(r)))
+                    except FleetAdmissionError as e:
+                        # typed backpressure (queue full / quota /
+                        # deadline-at-admission): the system working as
+                        # designed — recorded with its retry hint, NOT
+                        # charged against the SLO (the client was told
+                        # exactly how to behave)
+                        rec.shed.append({
+                            "tenant": r.tenant_id,
+                            "reason": e.outcome,
+                            "error": type(e).__name__,
+                            "retry_after_s": round(e.retry_after_s, 6),
+                        })
+                        outcomes["shed"] += 1
                 self.coalescer.flush()
-                for req, ticket in zip(requests, tickets):
+                for req, ticket in submitted:
                     try:
                         answered.append((req, ticket.result(timeout=0.0)))
+                        outcomes["resolved"] += 1
+                    except TimeoutError:
+                        # a ticket the flush did not terminate: the hang
+                        # the overload armor exists to eliminate — counted
+                        # so the acceptance gate can assert ZERO
+                        outcomes["unresolved"] += 1
+                        rec.errors.append(
+                            f"{req.tenant_id}: ticket hung past flush"
+                        )
+                        continue
+                    except FleetAdmissionError as e:
+                        # shed after admission (deadline expired in queue,
+                        # drain raced the round) — typed, with provenance
+                        rec.shed.append({
+                            "tenant": req.tenant_id,
+                            "reason": e.outcome,
+                            "error": type(e).__name__,
+                            "retry_after_s": round(e.retry_after_s, 6),
+                        })
+                        outcomes["expired"] += 1
                     except Exception as e:  # noqa: BLE001 — a failed batch
                         # is a recorded error, not a crashed run (crash-only
                         # discipline, same as the tick driver)
+                        outcomes["failed"] += 1
                         rec.errors.append(f"{req.tenant_id}: {e}")
                     # per-tenant lifecycle latency off the ticket stamps,
                     # split queue-wait/service: a tenant whose bucket
@@ -342,6 +435,8 @@ class FleetScenarioDriver:
                 # consumed this round's ticket events (timeline stamps),
                 # one window record per round on the sim clock
                 self.slo.tick(now, tick)
+            rec.outcomes = outcomes
+            self._unresolved += outcomes["unresolved"]
             walls.append(rec.wall_s)
             # the fairness certificate (solo dispatches) runs OUTSIDE the
             # timed window and outside the perf tick
@@ -362,6 +457,8 @@ class FleetScenarioDriver:
             tenant_latency=tenant_latency,
             prewarmed=list(self.prewarmed),
             slo_records=self.slo.records(),
+            unresolved=self._unresolved,
+            admission=self.coalescer.admission_snapshot(),
         )
 
     @staticmethod

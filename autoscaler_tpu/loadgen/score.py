@@ -349,6 +349,24 @@ def build_fleet_report(result) -> Dict[str, Any]:
         "error_rounds": sum(1 for r in result.records if r.errors),
         "injected_faults": result.injected_faults,
     }
+    # overload-armor columns: typed sheds by reason, terminal-outcome
+    # tallies, and the zero-hung-tickets audit (hack/verify.sh's chaos
+    # gate asserts unresolved == 0 and every shed row is typed)
+    shed_by_reason: Dict[str, int] = {}
+    outcome_totals: Dict[str, int] = {}
+    for r in result.records:
+        for row in r.shed:
+            shed_by_reason[row["reason"]] = (
+                shed_by_reason.get(row["reason"], 0) + 1
+            )
+        for key in sorted(r.outcomes):
+            outcome_totals[key] = outcome_totals.get(key, 0) + r.outcomes[key]
+    report["overload"] = {
+        "shed_by_reason": dict(sorted(shed_by_reason.items())),
+        "outcomes": dict(sorted(outcome_totals.items())),
+        "admission": dict(sorted(getattr(result, "admission", {}).items())),
+        "unresolved": int(getattr(result, "unresolved", 0)),
+    }
     perf = _perf_section(result)
     if perf:
         report["perf"] = perf

@@ -87,6 +87,21 @@ FAULT_KINDS = (
     # the resident arena's delta apply fails → cold-upload fallback +
     # next-tick reseed (double-buffer rollback certification)
     "arena_fault",
+    # -- process-level fleet chaos (ISSUE 14): driven through the fleet
+    # driver's submit/dispatch seams so outage → shed → recovery replays
+    # byte-identically on the sim clock --
+    # the sidecar process is dead: every submit in the window fails typed
+    # unavailable (the client-side view of a crashed endpoint); the SLO
+    # burn alert must fire during the outage and clear after recovery
+    "sidecar_crash",
+    # the network to the sidecar is gone: same shed shape as a crash but
+    # a distinct kind, so scenarios can separate process death from
+    # partition in the ledger
+    "sidecar_partition",
+    # RPC service is slow: latency_s of sim-clock latency folded into each
+    # ticket's service stamps — slow answers reach the SLIs/SLO exactly
+    # as real slowness would
+    "rpc_slow",
 )
 # estimator rungs a kernel_fault may target ("" = every device rung)
 KERNEL_FAULT_RUNGS = ("", "pallas", "xla")
@@ -142,7 +157,8 @@ class FaultSpec:
                 f"fault field 'rung' only applies to kernel_fault, not {self.kind!r}"
             )
         if self.group and self.kind in (
-            "kernel_fault", "device_lost", "kube_api_error", "arena_fault"
+            "kernel_fault", "device_lost", "kube_api_error", "arena_fault",
+            "sidecar_crash", "sidecar_partition", "rpc_slow",
         ):
             # these faults hit process-wide seams (the kernel ladder, the
             # cluster listing) — a group scope would be silently ignored
@@ -228,6 +244,13 @@ class TenantSpec:
     cpu_m: float = 500.0         # request magnitude scale
     mem_mb: float = 512.0
     whatif: bool = False         # attach per-group prices → what-if ranking
+    # storm intensity: how many requests this tenant posts per round (>1
+    # models a tenant over its --fleet-tenant-qps quota — the overload
+    # scenarios' admission-shed driver; content stays RNG-keyed per copy)
+    requests_per_round: int = 1
+    # per-request deadline budget in seconds carried into the ticket
+    # (0 = no deadline): the coalescer sheds queue-expired tickets typed
+    deadline_s: float = 0.0
 
     def __post_init__(self):
         if self.pods <= 0 or self.groups <= 0:
@@ -238,6 +261,14 @@ class TenantSpec:
         if self.max_nodes <= 0:
             raise SpecError(
                 f"tenant {self.name!r} max_nodes must be positive"
+            )
+        if self.requests_per_round < 1:
+            raise SpecError(
+                f"tenant {self.name!r} requests_per_round must be >= 1"
+            )
+        if self.deadline_s < 0:
+            raise SpecError(
+                f"tenant {self.name!r} deadline_s must be >= 0"
             )
 
 

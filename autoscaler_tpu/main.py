@@ -108,6 +108,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="watchdog soft deadline per loop tick in seconds: "
                         "exceeded -> all-thread stack dump to stderr; "
                         "0 = auto (max of 4x scan interval and 60s)")
+    p.add_argument("--rpc-address", action="append", default=[],
+                   help="sidecar gRPC endpoint(s) for embedders that build "
+                        "a TpuSimulationClient (repeat, or comma-separate, "
+                        "for failover: the client fails over on "
+                        "UNAVAILABLE/drain with jittered bounded backoff)")
+    p.add_argument("--rpc-hedge", type=_bool_flag, default=False,
+                   help="hedge idempotent Estimate/BatchEstimate against "
+                        "the next --rpc-address endpoint after a "
+                        "p99-derived delay (first answer wins, loser "
+                        "cancelled; never past the caller's deadline)")
     p.add_argument("--rpc-default-deadline", type=float, default=30.0,
                    help="default deadline for sidecar RPCs without an "
                         "explicit timeout, so a wedged sidecar fails the "
@@ -275,6 +285,21 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "the per-tenant SLI metric series before later "
                         "tenants aggregate into __overflow__ (cardinality "
                         "guard for /metrics; 0 = unbounded)")
+    p.add_argument("--fleet-max-queue-depth", type=int, default=0,
+                   help="fleet overload armor: shed submits typed "
+                        "(RESOURCE_EXHAUSTED + retry-after) past this "
+                        "coalescing-queue depth; 0 = unbounded")
+    p.add_argument("--fleet-tenant-qps", type=float, default=0.0,
+                   help="fleet overload armor: per-tenant token-bucket "
+                        "quota in requests/second (0 = no quotas); "
+                        "over-quota submits shed typed with retry-after")
+    p.add_argument("--fleet-tenant-burst", type=float, default=0.0,
+                   help="fleet overload armor: token-bucket burst "
+                        "capacity (0 = max(qps, 1))")
+    p.add_argument("--fleet-drain-grace-s", type=float, default=5.0,
+                   help="sidecar drain: grace server.stop() allows "
+                        "in-flight RPCs after admission closed and the "
+                        "coalescer flushed (SIGTERM/preStop path)")
     p.add_argument("--slo-enabled", type=_bool_flag, default=True,
                    help="serve /sloz (per-SLO multi-window burn rates and "
                         "window history; the SLO engine itself always "
@@ -415,6 +440,12 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         fleet_prewarm=args.fleet_prewarm,
         fleet_batch_scenarios=args.fleet_batch_scenarios,
         fleet_max_tenant_labels=args.fleet_max_tenant_labels,
+        fleet_max_queue_depth=args.fleet_max_queue_depth,
+        fleet_tenant_qps=args.fleet_tenant_qps,
+        fleet_tenant_burst=args.fleet_tenant_burst,
+        fleet_drain_grace_s=args.fleet_drain_grace_s,
+        rpc_addresses=list(args.rpc_address),
+        rpc_hedge=args.rpc_hedge,
         slo_enabled=args.slo_enabled,
         arena_enabled=args.arena_enabled,
         arena_buckets=args.arena_buckets,

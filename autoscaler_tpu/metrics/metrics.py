@@ -612,6 +612,26 @@ class AutoscalerMetrics:
             p + "fleet_prewarmed_buckets",
             "shape buckets pre-warmed at startup",
         )
+        # -- fleet overload armor (autoscaler_tpu/fleet/admission): the
+        # deadline-aware admission gate and per-ticket terminal outcomes.
+        # Outcome vocabularies are closed (fleet/errors.py); tenant labels
+        # ride the same cardinality bound as the SLI series.
+        self.fleet_admission_total = r.counter(
+            p + "fleet_admission_total",
+            "fleet admission verdicts by outcome (admitted|shed_queue_full"
+            "|shed_quota|shed_draining|shed_deadline) and tenant",
+        )
+        self.fleet_ticket_outcomes_total = r.counter(
+            p + "fleet_ticket_outcomes_total",
+            "terminal fleet ticket outcomes (resolved|failed|expired|"
+            "abandoned) by tenant — every admitted ticket ends in exactly "
+            "one; `abandoned` means the caller departed before the answer",
+        )
+        self.fleet_draining = r.gauge(
+            p + "fleet_draining",
+            "1 while the fleet coalescer is draining (admission closed, "
+            "readiness bit down, in-flight buckets flushing)",
+        )
         # -- fleet request-lifecycle SLIs (autoscaler_tpu/fleet + slo): the
         # per-ticket queue/service decomposition on the tracer timeline
         # seam. tenant label cardinality is bounded by the coalescer

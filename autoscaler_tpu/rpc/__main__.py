@@ -6,18 +6,38 @@ process but unreachable by the sidecar that actually serves BatchEstimate.
 This entrypoint closes that gap: it parses the sidecar-relevant flags,
 folds them into AutoscalingOptions, and hands them to ``serve()`` — so
 ``--fleet-shape-buckets``/``--fleet-coalesce-window-ms``/
-``--fleet-batch-scenarios`` configure the coalescer and ``--fleet-prewarm``
-compiles every bucket before the port is announced.
+``--fleet-batch-scenarios`` configure the coalescer, ``--fleet-prewarm``
+compiles every bucket before the port is announced, and the overload
+armor (``--fleet-max-queue-depth``/``--fleet-tenant-qps``/
+``--fleet-tenant-burst``) guards admission.
+
+Graceful drain (the ARCHITECTURE.md "Fleet overload & drain" lifecycle):
+
+    SIGTERM (or preStop GET /drain)
+      → readiness bit down (/healthz 503; the chart's readinessProbe
+        pulls the endpoint out of rotation)
+      → stop admitting (every RPC refuses UNAVAILABLE + drain detail;
+        clients fail over to another endpoint immediately)
+      → flush in-flight coalescer buckets (every admitted ticket
+        resolves or fails typed — zero hangs)
+      → server.stop(--fleet-drain-grace-s) and exit 0.
 """
 from __future__ import annotations
 
 import argparse
+import signal
 import threading
 
 from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.fleet import FleetCoalescer
 from autoscaler_tpu.fleet.buckets import DEFAULT_BUCKETS
 from autoscaler_tpu.main import _bool_flag
-from autoscaler_tpu.rpc.service import serve
+from autoscaler_tpu.rpc.service import (
+    DrainState,
+    drain_server,
+    serve,
+    start_health_server,
+)
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -36,6 +56,28 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--fleet-prewarm", type=_bool_flag, default=True)
     p.add_argument("--fleet-batch-scenarios", type=int, default=8)
     p.add_argument("--fleet-max-tenant-labels", type=int, default=64)
+    # overload armor + drain (fleet/admission.py, service.drain_server)
+    p.add_argument("--fleet-max-queue-depth", type=int, default=0,
+                   help="shed submits typed (RESOURCE_EXHAUSTED + "
+                        "retry-after) past this queue depth; 0 = unbounded")
+    p.add_argument("--fleet-tenant-qps", type=float, default=0.0,
+                   help="per-tenant token-bucket quota, requests/second; "
+                        "0 = no quotas")
+    p.add_argument("--fleet-tenant-burst", type=float, default=0.0,
+                   help="token-bucket burst capacity; 0 = max(qps, 1)")
+    p.add_argument("--fleet-drain-grace-s", type=float, default=5.0,
+                   help="how long server.stop() waits for in-flight RPCs "
+                        "after the drain sequence flushed the coalescer")
+    p.add_argument("--health-port", type=int, default=8081,
+                   help="HTTP readiness surface: GET /healthz (200 ready, "
+                        "503 draining — the chart's readinessProbe) and "
+                        "GET /drain (preStop: begin draining). "
+                        "0 disables, -1 binds an ephemeral port")
+    p.add_argument("--health-host", default="0.0.0.0",
+                   help="bind address for the readiness surface; the "
+                        "default answers the kubelet's pod-IP httpGet "
+                        "probes (127.0.0.1 would make readinessProbe and "
+                        "preStop fail in-cluster)")
     return p
 
 
@@ -47,17 +89,47 @@ def main(argv=None) -> int:
         fleet_prewarm=args.fleet_prewarm,
         fleet_batch_scenarios=args.fleet_batch_scenarios,
         fleet_max_tenant_labels=args.fleet_max_tenant_labels,
+        fleet_max_queue_depth=args.fleet_max_queue_depth,
+        fleet_tenant_qps=args.fleet_tenant_qps,
+        fleet_tenant_burst=args.fleet_tenant_burst,
+        fleet_drain_grace_s=args.fleet_drain_grace_s,
     )
+    drain = DrainState()
+    fleet = FleetCoalescer.from_options(options)
     server, port = serve(
-        args.address, max_workers=args.max_workers, options=options
+        args.address, max_workers=args.max_workers, fleet=fleet, drain=drain
     )
+    health_port = 0
+    httpd = None
+    if args.health_port != 0:
+        httpd, health_port = start_health_server(
+            drain, port=max(args.health_port, 0), host=args.health_host
+        )
     print(f"tpu-autoscaler sidecar serving on port {port} "
           f"(buckets={options.fleet_shape_buckets}, "
-          f"prewarm={options.fleet_prewarm})", flush=True)
-    try:
-        threading.Event().wait()  # serve until the pod is torn down
-    except KeyboardInterrupt:
-        server.stop(grace=2.0)
+          f"prewarm={options.fleet_prewarm}, "
+          f"max_queue_depth={options.fleet_max_queue_depth}, "
+          f"tenant_qps={options.fleet_tenant_qps}, "
+          f"health_port={health_port})", flush=True)
+
+    # SIGTERM (kubelet pod termination) and SIGINT both enter the drain
+    # sequence; the handler only sets an event — the actual drain runs on
+    # the main thread so signal-context restrictions never bite
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    print("sidecar drain: readiness down, admission closed, flushing "
+          "in-flight buckets", flush=True)
+    drain_server(
+        server, fleet=fleet, drain=drain, grace_s=options.fleet_drain_grace_s
+    )
+    if httpd is not None:
+        # the health server answers 503 throughout the drain (so the
+        # readinessProbe sees the bit) and shuts down only once the gRPC
+        # port is closed
+        httpd.shutdown()
+    print("sidecar drained cleanly", flush=True)
     return 0
 
 

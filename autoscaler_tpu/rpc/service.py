@@ -13,8 +13,11 @@ grpc_tools codegen needed; messages come from protoc --python_out).
 from __future__ import annotations
 
 import contextlib
+import random
+import threading
+import time
 from concurrent import futures
-from typing import List, Optional, Sequence
+from typing import Any, Callable, List, Optional, Sequence, Union
 
 import numpy as np
 
@@ -34,6 +37,80 @@ SERVICE_NAME = "autoscaler_tpu.TpuSimulation"
 # class field (BatchEstimateRequest.trace_context) for programmatic
 # clients that bypass gRPC.
 TRACE_METADATA_KEY = "x-autoscaler-trace-context"
+
+# trailing-metadata key carrying the server's pacing hint on
+# RESOURCE_EXHAUSTED (seconds, decimal string) — the gRPC analog of the
+# HTTP Retry-After header utils/http.RetryPolicy already honors
+RETRY_AFTER_METADATA_KEY = "retry-after-s"
+
+# the drain detail prefix on UNAVAILABLE: the client failover path keys on
+# it (a draining sidecar means "go elsewhere NOW", not "backoff and retry
+# here"), and hack/verify.sh's live-drain gate asserts it surfaces
+DRAIN_DETAIL = "draining: sidecar shutting down"
+
+
+class DrainState:
+    """The sidecar's readiness bit. ``begin_drain()`` flips it exactly
+    once; RPC handlers consult :meth:`ready` to stop admitting (UNAVAILABLE
+    + drain detail) and the health endpoint serves it as
+    readinessProbe/preStop state (deploy/chart wires /healthz + /drain)."""
+
+    def __init__(self) -> None:
+        self._draining = threading.Event()
+
+    def ready(self) -> bool:
+        return not self._draining.is_set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def begin_drain(self) -> None:
+        self._draining.set()
+
+
+def start_health_server(drain: DrainState, port: int = 0, host: str = "127.0.0.1"):
+    """Serve the sidecar's readiness surface on a daemon thread:
+
+    - ``GET /healthz`` — 200 ``ok`` while ready, 503 ``draining`` after
+      drain begins (the chart's readinessProbe);
+    - ``GET/POST /drain`` — flips the drain bit and returns 200 (the
+      chart's preStop hook, so admission closes BEFORE SIGTERM lands).
+
+    → (httpd, bound_port). Callers shut it down with httpd.shutdown()."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def _respond(self, code: int, body: bytes) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            if self.path == "/healthz":
+                if drain.ready():
+                    self._respond(200, b"ok\n")
+                else:
+                    self._respond(503, b"draining\n")
+            elif self.path == "/drain":
+                drain.begin_drain()
+                self._respond(200, b"draining\n")
+            else:
+                self._respond(404, b"not found\n")
+
+        do_POST = do_GET  # noqa: N815 — preStop httpGet vs kubectl POST
+
+        def log_message(self, *args):  # silence per-probe stderr noise
+            pass
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(
+        target=httpd.serve_forever, name="sidecar-healthz", daemon=True
+    )
+    thread.start()
+    return httpd, httpd.server_address[1]
 
 
 def _metadata_context(context) -> str:
@@ -150,15 +227,43 @@ class TpuSimulationServicer:
     the tree. Absent, a bounded default is created (always-on, like the
     host-side tracer)."""
 
-    def __init__(self, residency=None, fleet=None, tracer=None):
-        import threading
-
+    def __init__(self, residency=None, fleet=None, tracer=None, drain=None):
         self.residency = residency
         self.fleet = fleet
         if tracer is None:
             tracer = trace.Tracer(recorder=trace.FlightRecorder(capacity=64))
         self.tracer = tracer
+        # drain (a DrainState, optional): once begin_drain() fires, every
+        # RPC is refused UNAVAILABLE + DRAIN_DETAIL before touching the
+        # coalescer — new work goes elsewhere while in-flight buckets flush
+        self.drain = drain
         self._fleet_lock = threading.Lock()
+
+    def _check_admitting(self, context) -> None:
+        if self.drain is not None and self.drain.draining:
+            context.abort(grpc.StatusCode.UNAVAILABLE, DRAIN_DETAIL)
+
+    @staticmethod
+    def _abort_admission(context, e) -> None:
+        """Typed fleet shed → gRPC status (the mapping fleet/errors.py
+        documents): drain → UNAVAILABLE + drain detail (fail over), queue
+        expiry → DEADLINE_EXCEEDED (do NOT resend), overload →
+        RESOURCE_EXHAUSTED with the retry-after hint in trailing metadata
+        AND the detail text."""
+        from autoscaler_tpu.fleet import FleetDeadlineError, FleetDrainError
+
+        if isinstance(e, FleetDrainError):
+            context.abort(grpc.StatusCode.UNAVAILABLE, f"{DRAIN_DETAIL}: {e}")
+        if isinstance(e, FleetDeadlineError):
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+        retry_after = float(getattr(e, "retry_after_s", 0.0))
+        context.set_trailing_metadata(
+            ((RETRY_AFTER_METADATA_KEY, f"{retry_after:.6f}"),)
+        )
+        context.abort(
+            grpc.StatusCode.RESOURCE_EXHAUSTED,
+            f"fleet overload ({getattr(e, 'outcome', 'shed')}): {e}",
+        )
 
     def _ensure_fleet(self):
         with self._fleet_lock:
@@ -166,7 +271,10 @@ class TpuSimulationServicer:
                 from autoscaler_tpu.fleet import FleetCoalescer
 
                 self.fleet = FleetCoalescer()
-            self.fleet.start()
+            # ensure_running, NOT start: a request racing the drain must
+            # never re-arm a stopping coalescer (its submit raises the
+            # typed FleetDrainError instead, mapped to UNAVAILABLE+detail)
+            self.fleet.ensure_running()
             return self.fleet
 
     @contextlib.contextmanager
@@ -193,6 +301,7 @@ class TpuSimulationServicer:
 
         from autoscaler_tpu.ops.binpack import ffd_binpack_groups
 
+        self._check_admitting(context)
         pod_req, masks, allocs, caps = _decode_estimate_operands(request, context)
         with self.tracer.tick(
             metrics_mod.RPC_SERVE,
@@ -221,6 +330,7 @@ class TpuSimulationServicer:
         bucket per window instead of N. Operands ride the SAME checked
         decode path as Estimate, so an axis mismatch fails identically on
         both routes."""
+        self._check_admitting(context)
         pod_req, masks, allocs, caps = _decode_estimate_operands(request, context)
         G = len(request.group_ids)
         prices = None
@@ -228,38 +338,50 @@ class TpuSimulationServicer:
             prices = _checked_blob(
                 request.prices, "<f4", (G,), "prices", context
             )
-        from autoscaler_tpu.fleet import FleetRequest
+        from autoscaler_tpu.fleet import (
+            FleetAdmissionError,
+            FleetDeadlineError,
+            FleetDrainError,
+            FleetOverloadError,
+            FleetRequest,
+        )
 
         fleet = self._ensure_fleet()
         # the proto field wins (programmatic clients), gRPC metadata is the
         # fallback (the stub stamps both); the ticket carries it into the
         # shared fleetDispatch span's links
         ctx = request.trace_context or _metadata_context(context)
+        # the caller's remaining deadline budget rides into the ticket so
+        # the coalescer can shed it typed if it expires in the queue
+        remaining = context.time_remaining()
         with self.tracer.tick(
             metrics_mod.RPC_SERVE,
             parent_context=ctx,
             method="BatchEstimate",
             tenant=request.tenant_id or "anonymous",
         ), self._account("BatchEstimate", pod_req, masks, allocs, caps):
-            ticket = fleet.submit(
-                FleetRequest(
-                    tenant_id=request.tenant_id or "anonymous",
-                    pod_req=pod_req,
-                    pod_masks=masks,
-                    template_allocs=allocs,
-                    node_caps=caps,
-                    max_nodes=int(request.max_nodes),
-                    prices=prices,
-                    trace_context=ctx,
+            try:
+                ticket = fleet.submit(
+                    FleetRequest(
+                        tenant_id=request.tenant_id or "anonymous",
+                        pod_req=pod_req,
+                        pod_masks=masks,
+                        template_allocs=allocs,
+                        node_caps=caps,
+                        max_nodes=int(request.max_nodes),
+                        prices=prices,
+                        trace_context=ctx,
+                        deadline_s=remaining,
+                    )
                 )
-            )
+            except FleetAdmissionError as e:
+                self._abort_admission(context, e)
             # the coalescing window plus dispatch must finish inside the
             # caller's deadline — never block PAST it (gRPC has already
             # cancelled the RPC by then, and an over-wait pins an executor
             # worker). With no deadline set, bound the wait anyway: window
             # plus a dispatch allowance, so a wedged dispatcher fails the
             # RPC instead of hanging the handler.
-            remaining = context.time_remaining()
             timeout = (
                 remaining if remaining is not None
                 else fleet.window_s + 30.0
@@ -271,6 +393,10 @@ class TpuSimulationServicer:
                     grpc.StatusCode.DEADLINE_EXCEEDED,
                     "fleet batch did not dispatch within the deadline",
                 )
+            except (FleetOverloadError, FleetDrainError, FleetDeadlineError) as e:
+                # a ticket shed AFTER admission (queue expiry, drain flush)
+                # surfaces with the same typed status as an admission shed
+                self._abort_admission(context, e)
             except Exception as e:  # noqa: BLE001 — every fleet rung failed;
                 # surface the typed ladder error to the caller
                 context.abort(grpc.StatusCode.INTERNAL, f"fleet dispatch failed: {e}")
@@ -301,6 +427,7 @@ class TpuSimulationServicer:
         from autoscaler_tpu.ops.schedule import greedy_schedule
         from autoscaler_tpu.snapshot.tensors import SnapshotTensors
 
+        self._check_admitting(context)
         _check_resource_axis(request.pods, context)
         P = request.pods.num_pods
         R = request.pods.num_resources
@@ -355,6 +482,7 @@ class TpuSimulationServicer:
         from autoscaler_tpu.ops.scaledown import removal_feasibility
         from autoscaler_tpu.snapshot.tensors import SnapshotTensors
 
+        self._check_admitting(context)
         _check_resource_axis(request.pods, context)
         P = request.pods.num_pods
         R = request.pods.num_resources
@@ -393,6 +521,7 @@ class TpuSimulationServicer:
         """Least-waste-style reduction over the option list (the expander
         gRPC seam; host embeddings can point the reference's own
         --grpc-expander-url at this)."""
+        self._check_admitting(context)
         if not request.options:
             return pb.BestOptionsResponse()
         scored = sorted(
@@ -432,14 +561,19 @@ def serve(
     options=None,
     tracer=None,
     slo=None,
+    drain=None,
 ):
     """→ (server, bound_port). The sidecar process entrypoint. ``fleet``
     (a fleet.FleetCoalescer) backs BatchEstimate; when absent and
     ``options`` (an AutoscalingOptions) is given, one is built from the
     --fleet-* surface via FleetCoalescer.from_options — buckets, window,
-    batch width, and pre-warm all take effect (``python -m
-    autoscaler_tpu.rpc`` is the flag-parsing launcher). The coalescing
-    window only pays off when max_workers admits concurrent tenants."""
+    batch width, pre-warm, and the overload-armor knobs (queue depth,
+    tenant quotas) all take effect (``python -m autoscaler_tpu.rpc`` is
+    the flag-parsing launcher). ``drain`` (a DrainState) makes the server
+    drainable: once its bit flips, every RPC refuses UNAVAILABLE +
+    DRAIN_DETAIL while drain_server() flushes in-flight work. The
+    coalescing window only pays off when max_workers admits concurrent
+    tenants."""
     if fleet is None and options is not None:
         from autoscaler_tpu.fleet import FleetCoalescer
 
@@ -452,7 +586,8 @@ def serve(
         (
             _generic_handler(
                 TpuSimulationServicer(
-                    residency=residency, fleet=fleet, tracer=tracer
+                    residency=residency, fleet=fleet, tracer=tracer,
+                    drain=drain,
                 )
             ),
         )
@@ -462,32 +597,191 @@ def serve(
     return server, port
 
 
+def drain_server(server, fleet=None, drain=None, grace_s: float = 5.0) -> None:
+    """The graceful drain sequence (SIGTERM / preStop path, in order):
+
+    1. flip the drain bit — readiness goes 503, new RPCs refuse
+       UNAVAILABLE + DRAIN_DETAIL (clients fail over immediately);
+    2. stop the coalescer — its own drain bit sheds racing submits typed
+       while the final flush answers every in-flight bucket;
+    3. ``server.stop(grace_s)`` — in-flight handlers finish inside the
+       grace, then the port closes.
+
+    Idempotent: a second call finds everything already stopped."""
+    if drain is not None:
+        drain.begin_drain()
+    if fleet is not None:
+        fleet.stop()
+    server.stop(grace=grace_s).wait(timeout=grace_s + 1.0)
+
+
 class TpuSimulationClient:
-    """Host-side stub.
+    """Host-side stub with endpoint failover, typed-status retry scoping,
+    and optional hedging.
+
+    ``target`` names one endpoint or several (comma-separated string or a
+    sequence — the --rpc-address surface): on UNAVAILABLE the client fails
+    over to the next endpoint with jittered bounded backoff (RetryPolicy
+    semantics; a drain-detail UNAVAILABLE skips the backoff — the server
+    just said "go elsewhere NOW"). The resend scope is a closed matrix:
+
+    - UNAVAILABLE        → reconnect/fail over and resend, bounded
+      (every RPC here is a pure function of its request);
+    - RESOURCE_EXHAUSTED → honor the server's retry-after trailing
+      metadata, at most once, never past the caller's deadline — a blind
+      resend is exactly the extra load a shedding server cannot absorb;
+    - DEADLINE_EXCEEDED  → NEVER resent: retrying a timed-out estimate
+      doubles load exactly when the server is drowning;
+    - anything else      → raised as-is.
 
     ``default_timeout_s`` is the deadline applied when a call site passes
-    none (plumbed from ``AutoscalingOptions.rpc_default_deadline_s``): a
-    wedged sidecar must fail the RPC — feeding the crash-only control
-    loop — rather than hang ``run_once`` forever. On UNAVAILABLE (sidecar
-    restarting, connection torn down) the client rebuilds its channel and
-    retries ONCE: every RPC here is a pure function of its request, so a
-    single bounded re-send is safe, and exactly one keeps a dead sidecar
-    from doubling every loop's latency."""
+    none (plumbed from ``AutoscalingOptions.rpc_default_deadline_s``); the
+    whole retry/failover/hedge budget lives INSIDE it — the client never
+    spends past the caller's deadline.
 
-    def __init__(self, target: str, default_timeout_s: Optional[float] = None):
-        self._target = target
+    ``hedge=True`` additionally hedges the idempotent Estimate /
+    BatchEstimate: when the primary hasn't answered after a p99-derived
+    delay (learned from this client's own recent latencies), a second
+    attempt fires at the next endpoint; first answer wins, the loser is
+    cancelled. Off by default — hedging doubles worst-case load.
+
+    ``clock``/``sleep``/``rng`` are injectable for tests; production
+    callers take the wall defaults (the client is NOT on the replay path —
+    loadgen drives the coalescer in-process)."""
+
+    # the hedgeable subset: pure estimate reads (TrySchedule and friends
+    # are pure too, but hedging is only worth its load cost on the two
+    # fleet-facing hot calls)
+    HEDGED_METHODS = ("Estimate", "BatchEstimate")
+    # floor used until enough latency samples exist to derive a p99
+    HEDGE_MIN_DELAY_S = 0.05
+
+    def __init__(
+        self,
+        target: Union[str, Sequence[str]],
+        default_timeout_s: Optional[float] = None,
+        hedge: bool = False,
+        failover_base_sleep_s: float = 0.05,
+        failover_max_sleep_s: float = 1.0,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        rng: Callable[[], float] = random.random,
+    ):
+        raw = [target] if isinstance(target, str) else list(target)
+        # every element may itself be comma-separated (--rpc-address
+        # accepts both "repeat the flag" and "comma-join" forms, and the
+        # repeated form must not smuggle an unsplit "a:1,b:2" into
+        # grpc.insecure_channel as one bogus endpoint)
+        targets = [
+            piece.strip()
+            for entry in raw
+            for piece in str(entry).split(",")
+            if piece.strip()
+        ]
+        if not targets:
+            raise ValueError("TpuSimulationClient needs at least one endpoint")
+        self._targets = targets
+        self._active = 0
         self.default_timeout_s = default_timeout_s
-        self._channel = grpc.insecure_channel(target)
+        self.hedge = hedge
+        self._clock = clock
+        self._sleep = sleep
+        from autoscaler_tpu.utils.http import RetryPolicy
+
+        # the failover pacing: same jittered-bounded-exponential semantics
+        # as the kube/GCE REST boundary, one attempt per endpoint plus one
+        self._backoff = RetryPolicy(
+            attempts=len(targets) + 1,
+            base_sleep_s=failover_base_sleep_s,
+            max_sleep_s=failover_max_sleep_s,
+            sleep=sleep,
+            rng=rng,
+        )
+        # recent per-method success latencies (bounded) — the hedge-delay
+        # derivation input
+        from collections import deque
+
+        self._latency = {m: deque(maxlen=64) for m in self.HEDGED_METHODS}
+        # guards the mutable connection state (_active, _channel,
+        # _retired): hedging reads it from worker context while a
+        # failover rewrites it
+        self._conn_lock = threading.Lock()
+        # channels replaced by a failover are RETIRED, not closed: another
+        # thread may have an RPC in flight on one, and closing it would
+        # turn that call into CANCELLED "Channel closed!" instead of its
+        # real status. The graveyard is bounded; close() empties it.
+        self._retired: List[Any] = []
+        # long-lived per-target channels for hedge legs: the hedge fires
+        # exactly when latency matters, so it must not pay TCP+HTTP/2
+        # setup per call
+        self._hedge_channels: dict = {}
+        self._channel = grpc.insecure_channel(self._targets[0])
+
+    @property
+    def _target(self) -> str:
+        with self._conn_lock:
+            return self._targets[self._active]
+
+    def _hedge_channel_for(self, target: str):
+        with self._conn_lock:
+            channel = self._hedge_channels.get(target)
+            if channel is None:
+                channel = grpc.insecure_channel(target)
+                self._hedge_channels[target] = channel
+            return channel
 
     def close(self) -> None:
-        self._channel.close()
+        with self._conn_lock:
+            channels = [self._channel] + self._retired
+            channels += list(self._hedge_channels.values())
+            self._hedge_channels = {}
+            self._retired = []
+        for channel in channels:
+            try:
+                channel.close()
+            except Exception:  # noqa: BLE001 — a dead channel may refuse
+                pass
 
     def _reconnect(self) -> None:
-        try:
-            self._channel.close()
-        except Exception:  # noqa: BLE001 — a dead channel may refuse close
-            pass
-        self._channel = grpc.insecure_channel(self._target)
+        with self._conn_lock:
+            target = self._targets[self._active]
+        fresh = grpc.insecure_channel(target)
+        doomed = []
+        with self._conn_lock:
+            self._retired.append(self._channel)
+            self._channel = fresh
+            # bound the graveyard: anything this deep has no live callers
+            while len(self._retired) > 4:
+                doomed.append(self._retired.pop(0))
+        for channel in doomed:
+            try:
+                channel.close()
+            except Exception:  # noqa: BLE001 — a dead channel may refuse
+                pass
+
+    def _failover(self) -> None:
+        """Advance to the next endpoint (wraps; a single-endpoint client
+        reconnects in place — the historical behavior) and rebuild the
+        channel."""
+        with self._conn_lock:
+            self._active = (self._active + 1) % len(self._targets)
+        self._reconnect()
+
+    def _note_latency(self, method: str, seconds: float) -> None:
+        samples = self._latency.get(method)
+        if samples is not None:
+            samples.append(seconds)
+
+    def _hedge_delay(self, method: str) -> float:
+        """The p99 of this client's own recent successes for ``method`` —
+        hedging earlier than that fires on healthy tail latency; later
+        wastes the win. Falls back to a floor until enough samples exist."""
+        samples = self._latency.get(method)
+        if not samples or len(samples) < 5:
+            return self.HEDGE_MIN_DELAY_S
+        ordered = sorted(samples)
+        idx = max(0, int(0.99 * len(ordered)) - 1)
+        return max(ordered[idx], self.HEDGE_MIN_DELAY_S)
 
     @staticmethod
     def _packed_pods(
@@ -509,14 +803,46 @@ class TpuSimulationClient:
             extended_resources=ext,
         )
 
+    @staticmethod
+    def _retry_after_from(error) -> Optional[float]:
+        """The server's pacing hint from RESOURCE_EXHAUSTED trailing
+        metadata (RETRY_AFTER_METADATA_KEY, seconds)."""
+        try:
+            trailing = error.trailing_metadata() or ()
+        except Exception:  # noqa: BLE001 — duck-typed test errors
+            return None
+        for key, value in trailing:
+            if key == RETRY_AFTER_METADATA_KEY:
+                try:
+                    return max(float(value), 0.0)
+                except (TypeError, ValueError):
+                    return None
+        return None
+
+    @staticmethod
+    def _is_drain(error) -> bool:
+        try:
+            return str(error.details() or "").startswith(DRAIN_DETAIL)
+        except Exception:  # noqa: BLE001 — duck-typed test errors
+            return False
+
     def _call(self, method: str, request, timeout: Optional[float] = None):
         req_cls, resp_cls = _METHODS[method]
         if timeout is None:
             timeout = self.default_timeout_s
+        # the whole retry/failover/hedge budget lives inside the caller's
+        # deadline: every resend's timeout is the REMAINING budget, and a
+        # backoff that would outlive it raises instead of sleeping
+        deadline_ts = self._clock() + timeout if timeout is not None else None
 
-        # one span per sidecar RPC — the reconnect-and-resend is an event
-        # INSIDE it, so a tick slowed by a sidecar restart shows one long
-        # rpcCall span with a reconnect marker, not two mystery gaps
+        def remaining() -> Optional[float]:
+            if deadline_ts is None:
+                return None
+            return deadline_ts - self._clock()
+
+        # one span per sidecar RPC — failovers and retry-after waits are
+        # events INSIDE it, so a tick slowed by a sidecar restart shows one
+        # long rpcCall span with failover markers, not mystery gaps
         with trace.span(
             metrics_mod.RPC_CALL, method=method,
             deadline_s=timeout if timeout is not None else 0.0,
@@ -535,7 +861,7 @@ class TpuSimulationClient:
             ):
                 request.trace_context = ctx
 
-            def send():
+            def send(budget: Optional[float]):
                 rpc = self._channel.unary_unary(
                     f"/{SERVICE_NAME}/{method}",
                     request_serializer=lambda msg: msg.SerializeToString(),
@@ -544,18 +870,156 @@ class TpuSimulationClient:
                 if metadata is None:
                     # no active trace: keep the bare call shape (duck-typed
                     # channels in tests need not accept the kwarg)
-                    return rpc(request, timeout=timeout)
-                return rpc(request, timeout=timeout, metadata=metadata)
+                    return rpc(request, timeout=budget)
+                return rpc(request, timeout=budget, metadata=metadata)
 
-            try:
-                return send()
-            except grpc.RpcError as e:
-                code = e.code() if hasattr(e, "code") else None
-                if code != grpc.StatusCode.UNAVAILABLE:
+            max_attempts = max(2, len(self._targets) + 1)
+            quota_retried = False
+            attempt = 0
+            while True:
+                attempt += 1
+                # first attempt gets the caller's full deadline; every
+                # resend runs on what's LEFT of it
+                budget = timeout if attempt == 1 else remaining()
+                try:
+                    if (
+                        self.hedge
+                        and method in self.HEDGED_METHODS
+                        and len(self._targets) > 1
+                    ):
+                        return self._hedged_send(
+                            method, request, budget, metadata, resp_cls
+                        )
+                    t0 = self._clock()
+                    resp = send(budget)
+                    self._note_latency(method, self._clock() - t0)
+                    return resp
+                except grpc.RpcError as e:
+                    code = e.code() if hasattr(e, "code") else None
+                    if (
+                        code is grpc.StatusCode.UNAVAILABLE
+                        and attempt < max_attempts
+                    ):
+                        # failover: a drain detail skips the backoff (the
+                        # server said "go elsewhere NOW"); plain
+                        # unavailability pays the jittered bounded pause
+                        pause = (
+                            0.0 if self._is_drain(e)
+                            else self._backoff.backoff_s(attempt, None)
+                        )
+                        rem = remaining()
+                        if rem is not None and pause >= rem:
+                            raise
+                        trace.add_event(
+                            "rpc.failover", method=method, attempt=attempt,
+                            drain=self._is_drain(e),
+                        )
+                        if pause > 0.0:
+                            self._sleep(pause)
+                        self._failover()
+                        continue
+                    if (
+                        code is grpc.StatusCode.RESOURCE_EXHAUSTED
+                        and not quota_retried
+                    ):
+                        retry_after = self._retry_after_from(e)
+                        rem = remaining()
+                        if retry_after is not None and (
+                            rem is None or retry_after < rem
+                        ):
+                            quota_retried = True
+                            trace.add_event(
+                                "rpc.retry_after", method=method,
+                                retry_after_s=retry_after,
+                            )
+                            if retry_after > 0.0:
+                                self._sleep(retry_after)
+                            continue
+                    # DEADLINE_EXCEEDED and everything else: NEVER resent
                     raise
-                trace.add_event("rpc.reconnect", method=method)
-                self._reconnect()
-                return send()
+
+    def _hedged_send(self, method, request, budget, metadata, resp_cls):
+        """Hedge one idempotent call: primary now, secondary at the next
+        endpoint after the p99-derived delay; first answer wins, the loser
+        is cancelled. Both legs share the caller's remaining budget."""
+
+        def future_on(channel, leg_budget):
+            rpc = channel.unary_unary(
+                f"/{SERVICE_NAME}/{method}",
+                request_serializer=lambda msg: msg.SerializeToString(),
+                response_deserializer=resp_cls.FromString,
+            )
+            if metadata is None:
+                return rpc.future(request, timeout=leg_budget)
+            return rpc.future(request, timeout=leg_budget, metadata=metadata)
+
+        t0 = self._clock()
+        deadline_ts = t0 + budget if budget is not None else None
+        with self._conn_lock:
+            channel = self._channel
+            hedge_target = self._targets[
+                (self._active + 1) % len(self._targets)
+            ]
+        primary = future_on(channel, budget)
+        fired = threading.Event()
+        primary.add_done_callback(lambda _f: fired.set())
+        delay = self._hedge_delay(method)
+        if budget is not None:
+            delay = min(delay, max(budget, 0.0))
+        legs = [primary]
+        if not fired.wait(timeout=delay):
+            rem = (
+                deadline_ts - self._clock() if deadline_ts is not None
+                else None
+            )
+            if rem is None or rem > 0:
+                trace.add_event(
+                    "rpc.hedge", method=method, target=hedge_target,
+                    delay_s=round(delay, 6),
+                )
+                # long-lived cached channel: no connection setup on the
+                # latency-critical hedge leg
+                hedge = future_on(
+                    self._hedge_channel_for(hedge_target), rem
+                )
+                hedge.add_done_callback(lambda _f: fired.set())
+                legs.append(hedge)
+        try:
+            pending = list(legs)
+            last_error: Optional[BaseException] = None
+            while pending:
+                fired.clear()
+                for leg in list(pending):
+                    if not leg.done():
+                        continue
+                    pending.remove(leg)
+                    try:
+                        result = leg.result()
+                    except Exception as e:  # noqa: BLE001 — grpc future errs
+                        last_error = e
+                        continue
+                    for loser in pending:
+                        loser.cancel()
+                    self._note_latency(method, self._clock() - t0)
+                    return result
+                if pending and not fired.wait(
+                    timeout=(
+                        deadline_ts - self._clock() + 0.1
+                        if deadline_ts is not None else None
+                    )
+                ):
+                    for leg in pending:
+                        leg.cancel()
+                    break
+            if last_error is not None:
+                raise last_error
+            raise TimeoutError(
+                f"hedged {method} exhausted its deadline budget"
+            )
+        finally:
+            for leg in legs:
+                if not leg.done():
+                    leg.cancel()
 
     def estimate(
         self,

@@ -816,12 +816,135 @@ def _fleet_bench_main(tenants: int = 8) -> int:
     return 0 if gate else 1
 
 
+def _fleet_overload_bench_main() -> int:
+    """``bench.py --fleet-overload``: the admission-control contrast gate
+    (ISSUE 14 acceptance). A sim-clock overload drill — offered load 3x
+    service capacity — run three ways:
+
+    - unloaded (offered == capacity): the baseline p99 e2e;
+    - admission ON (queue bound = capacity): admitted-request p99 e2e must
+      stay within 2x the unloaded figure while the shed rate absorbs the
+      excess, and the queue stays bounded;
+    - admission OFF: queue depth and e2e grow monotonically — the failure
+      mode the armor exists to prevent.
+
+    Everything runs on an injected clock (ticket e2e = sim-clock stamps;
+    service modeled as ``flush(limit=capacity)`` per round), so the gate
+    is deterministic — no wall-clock flake. Exit 0 = gate met, 1 =
+    missed."""
+    import numpy as np
+
+    from autoscaler_tpu.fleet import (
+        FleetCoalescer,
+        FleetOverloadError,
+        FleetRequest,
+    )
+    from autoscaler_tpu.parallel.mesh import make_mesh
+
+    ROUNDS, OFFERED, CAPACITY, ROUND_S = 20, 24, 8, 1.0
+    P, G, R = 12, 3, 6
+
+    def request(round_: int, i: int, deadline: float) -> "FleetRequest":
+        rng = np.random.default_rng((97, round_, i))
+        return FleetRequest(
+            tenant_id=f"t{i % 6}",
+            pod_req=rng.integers(1, 80, (P, R)).astype(np.float32),
+            pod_masks=rng.random((G, P)) > 0.25,
+            template_allocs=rng.integers(80, 400, (G, R)).astype(np.float32),
+            node_caps=rng.integers(1, 10, G).astype(np.int32),
+            max_nodes=P,
+            deadline_s=deadline or None,
+        )
+
+    def run(offered: int, max_queue_depth: int, deadline: float):
+        sim = {"t": 0.0}
+        co = FleetCoalescer(
+            buckets="16x4x8", batch_scenarios=8, mesh=make_mesh(),
+            clock=lambda: sim["t"], max_queue_depth=max_queue_depth,
+        )
+        tickets, shed, depths = [], 0, []
+        for round_ in range(ROUNDS):
+            for i in range(offered):
+                try:
+                    tickets.append(co.submit(request(round_, i, deadline)))
+                except FleetOverloadError:
+                    shed += 1
+            depths.append(co.queue_depth())
+            sim["t"] += ROUND_S
+            co.flush(limit=CAPACITY)
+        # drain the tail so EVERY ticket terminates (the zero-hang
+        # discipline holds even for the unarmored baseline)
+        while co.queue_depth():
+            sim["t"] += ROUND_S
+            co.flush(limit=CAPACITY)
+        e2e, expired = [], 0
+        for tk in tickets:
+            try:
+                tk.result(timeout=0.0)
+                e2e.append(tk.t_resolve - tk.t_submit)
+            except Exception:  # noqa: BLE001 — typed deadline sheds
+                expired += 1
+        assert all(tk.done() for tk in tickets), "hung tickets in bench"
+        e2e.sort()
+        p99 = e2e[max(0, int(0.99 * len(e2e)) - 1)] if e2e else 0.0
+        return {
+            "served": len(e2e),
+            "shed": shed,
+            "expired": expired,
+            "p99_e2e_s": round(p99, 4),
+            "queue_depths": depths,
+        }
+
+    unloaded = run(CAPACITY, 0, 0.0)
+    armored = run(OFFERED, CAPACITY, 4.0)
+    baseline = run(OFFERED, 0, 0.0)
+    depths = baseline["queue_depths"]
+    baseline_monotonic = all(b > a for a, b in zip(depths, depths[1:]))
+    armored_bounded = max(armored["queue_depths"]) <= CAPACITY
+    excess = (OFFERED - CAPACITY) * ROUNDS
+    gate = (
+        armored["p99_e2e_s"] <= 2.0 * unloaded["p99_e2e_s"] + 1e-9
+        and armored["shed"] + armored["expired"] >= excess * 0.5
+        and armored_bounded
+        and baseline_monotonic
+        and baseline["p99_e2e_s"] > 2.0 * unloaded["p99_e2e_s"]
+    )
+    import jax
+
+    print(json.dumps({
+        "metric": "fleet_overload_contrast",
+        "platform": jax.default_backend(),
+        "rounds": ROUNDS,
+        "offered_per_round": OFFERED,
+        "capacity_per_round": CAPACITY,
+        "unloaded_p99_e2e_s": unloaded["p99_e2e_s"],
+        "admission_on": {
+            "p99_e2e_s": armored["p99_e2e_s"],
+            "served": armored["served"],
+            "shed": armored["shed"],
+            "expired": armored["expired"],
+            "max_queue_depth_seen": max(armored["queue_depths"]),
+        },
+        "admission_off": {
+            "p99_e2e_s": baseline["p99_e2e_s"],
+            "served": baseline["served"],
+            "queue_depth_monotonic": baseline_monotonic,
+            "final_queue_depth": depths[-1],
+        },
+        "unit": "sim-clock seconds",
+        "gate_p99_within_2x_and_contrast": gate,
+    }, indent=2, sort_keys=True))
+    return 0 if gate else 1
+
+
 def main():
     if "--arena" in sys.argv:
         idx = sys.argv.index("--arena")
         arg = sys.argv[idx + 1] if idx + 1 < len(sys.argv) else ""
         pods = int(arg) if arg.isdigit() else 20_000
         sys.exit(_arena_bench_main(pods))
+    if "--fleet-overload" in sys.argv:
+        sys.exit(_fleet_overload_bench_main())
     if "--fleet" in sys.argv:
         idx = sys.argv.index("--fleet")
         arg = sys.argv[idx + 1] if idx + 1 < len(sys.argv) else ""

@@ -180,7 +180,7 @@ import json, sys
 rounds = [json.loads(l) for l in open(sys.argv[1])]
 assert rounds, "empty fleet decision ledger"
 for r in rounds:
-    assert r["schema"] == "autoscaler_tpu.fleet.round/1", r["schema"]
+    assert r["schema"] == "autoscaler_tpu.fleet.round/2", r["schema"]
     for t in r["tenants"]:
         assert t["match_solo"], (
             f"tenant {t['tenant']} fleet answer diverged from solo in round "
@@ -274,6 +274,141 @@ rm -rf "$slo_tmp"
 echo "== fleet batched-throughput gate (batched >= 2x sequential at >= 4 tenants) =="
 python bench.py --fleet 8 >/dev/null
 echo "fleet bench gate ok"
+
+echo "== fleet overload chaos gate (double-replay fleet_overload.json: byte-identical fleet+SLO+perf ledgers; typed sheds with retry-after; burn alert fires during the outage and clears; zero hung tickets) =="
+chaos_tmp=$(mktemp -d)
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/fleet_overload.json \
+    --log "$chaos_tmp/a.fleet.jsonl" --slo-ledger "$chaos_tmp/a.slo.jsonl" \
+    --perf-ledger "$chaos_tmp/a.perf.jsonl" > "$chaos_tmp/a.report.json"
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/fleet_overload.json \
+    --log "$chaos_tmp/b.fleet.jsonl" --slo-ledger "$chaos_tmp/b.slo.jsonl" \
+    --perf-ledger "$chaos_tmp/b.perf.jsonl" >/dev/null
+for ledger in fleet slo perf; do
+    if ! diff -q "$chaos_tmp/a.$ledger.jsonl" "$chaos_tmp/b.$ledger.jsonl" >/dev/null; then
+        echo "ERROR: $ledger ledger is nondeterministic across chaos replays:" >&2
+        diff "$chaos_tmp/a.$ledger.jsonl" "$chaos_tmp/b.$ledger.jsonl" | head -20 >&2
+        exit 1
+    fi
+done
+python bench.py --slo-ledger "$chaos_tmp/a.slo.jsonl" >/dev/null
+python - "$chaos_tmp/a.fleet.jsonl" "$chaos_tmp/a.slo.jsonl" "$chaos_tmp/a.report.json" <<'EOF'
+import json, sys
+SHED_REASONS = {"shed_queue_full", "shed_quota", "shed_draining",
+                "shed_deadline", "sidecar_crash", "sidecar_partition"}
+rounds = [json.loads(l) for l in open(sys.argv[1])]
+assert rounds, "empty fleet decision ledger"
+sheds = [row for r in rounds for row in r["shed"]]
+assert sheds, "overload scenario shed nothing — the storm never hit a gate"
+for row in sheds:
+    assert row["reason"] in SHED_REASONS, f"untyped shed reason: {row}"
+    assert row["error"], f"shed row without a typed error class: {row}"
+    if row["reason"] in ("shed_queue_full", "shed_quota"):
+        assert row["retry_after_s"] > 0, f"overload shed without retry-after: {row}"
+reasons = {row["reason"] for row in sheds}
+assert "shed_quota" in reasons, f"tenant storm never hit its quota: {reasons}"
+assert "sidecar_crash" in reasons, f"outage never shed unavailable: {reasons}"
+# zero hung tickets, every round: resolved + failed + expired + shed
+# accounts for every posted request
+for r in rounds:
+    assert r["outcomes"]["unresolved"] == 0, f"hung tickets in round {r['tick']}"
+    posted = len(r["tenants"]) + len(r["shed"]) + r["outcomes"]["failed"]
+    accounted = (r["outcomes"]["resolved"] + r["outcomes"]["shed"]
+                 + r["outcomes"]["expired"] + r["outcomes"]["failed"])
+    assert r["outcomes"]["resolved"] == len(r["tenants"]), r["outcomes"]
+    assert posted == accounted, f"ticket leak in round {r['tick']}: {r['outcomes']}"
+for r in rounds:
+    for t in r["tenants"]:
+        assert t["match_solo"], f"parity broke under overload: {t['tenant']}"
+# SLO: the burn alert fired during the injected outage and cleared by run end
+slo = [json.loads(l) for l in open(sys.argv[2])]
+alerting = [rec["tick"] for rec in slo if rec["slos"]["fleet_e2e"]["alerting"]]
+assert alerting, "burn alert never fired during the sidecar outage"
+assert any(8 <= t <= 15 for t in alerting), f"alert missed the outage window: {alerting[:5]}"
+assert not slo[-1]["slos"]["fleet_e2e"]["alerting"], "burn alert never cleared after recovery"
+report = json.load(open(sys.argv[3]))
+assert report["overload"]["unresolved"] == 0, report["overload"]
+assert report["injected_faults"].get("rpc_slow", 0) > 0, report["injected_faults"]
+print(f"chaos ledger ok ({len(rounds)} rounds, {len(sheds)} typed sheds, "
+      f"alert ticks {alerting[0]}..{alerting[-1]} cleared by {slo[-1]['tick']})")
+EOF
+rm -rf "$chaos_tmp"
+
+echo "== live sidecar SIGTERM drain gate (readiness flips, admission refuses with drain detail, in-flight tickets resolve, clean exit) =="
+python - <<'EOF'
+import re, signal, subprocess, sys, threading, urllib.error, urllib.request
+import numpy as np
+import grpc
+from autoscaler_tpu.rpc.service import DRAIN_DETAIL, TpuSimulationClient
+
+# stderr joins the stdout pipe so a failure can never leave an orphan
+# holding this gate's output pipe open (tail would wait forever)
+proc = subprocess.Popen(
+    [sys.executable, "-m", "autoscaler_tpu.rpc", "--address", "127.0.0.1:0",
+     "--health-port", "-1", "--fleet-prewarm", "false",
+     "--fleet-shape-buckets", "16x4x8", "--fleet-coalesce-window-ms", "20",
+     "--fleet-drain-grace-s", "5.0"],
+    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+try:
+    line = proc.stdout.readline()
+    port = int(re.search(r"serving on port (\d+)", line).group(1))
+    health = int(re.search(r"health_port=(\d+)", line).group(1))
+    assert urllib.request.urlopen(
+        f"http://127.0.0.1:{health}/healthz", timeout=10).status == 200
+
+    client = TpuSimulationClient(f"127.0.0.1:{port}", default_timeout_s=30.0)
+    rng = np.random.default_rng(0)
+    world = lambda: (rng.integers(1, 100, (9, 6)).astype(np.float32),
+                     rng.random((3, 9)) > 0.2,
+                     rng.integers(100, 500, (3, 6)).astype(np.float32),
+                     ["g0", "g1", "g2"], rng.integers(1, 16, 3).astype(np.int32))
+    outcomes = []
+    def call():
+        try:
+            client.batch_estimate(*world(), max_nodes=16, tenant_id="drain")
+            outcomes.append("answered")
+        except grpc.RpcError as e:
+            outcomes.append(f"typed:{e.code().name}")
+
+    # in-flight requests ride the 20ms coalescing window while the drain fires
+    threads = [threading.Thread(target=call) for _ in range(4)]
+    for t in threads: t.start()
+    # preStop analog first (readiness down + admission closed), then SIGTERM
+    urllib.request.urlopen(f"http://127.0.0.1:{health}/drain", timeout=10)
+    try:
+        urllib.request.urlopen(f"http://127.0.0.1:{health}/healthz", timeout=10)
+        raise SystemExit("readiness did not flip on drain")
+    except urllib.error.HTTPError as e:
+        assert e.code == 503, e.code
+    # a FRESH probe client (its own channel — the shared client's threads
+    # are mid-failover) must see the typed drain refusal
+    probe = TpuSimulationClient(f"127.0.0.1:{port}", default_timeout_s=10.0,
+                                failover_base_sleep_s=0.001)
+    try:
+        probe.estimate(*world(), max_nodes=16)
+        raise SystemExit("draining sidecar served a new request")
+    except grpc.RpcError as e:
+        assert e.code() == grpc.StatusCode.UNAVAILABLE, e.code()
+        assert DRAIN_DETAIL in (e.details() or ""), e.details()
+    probe.close()
+    proc.send_signal(signal.SIGTERM)
+    for t in threads:
+        t.join(timeout=30.0)
+        assert not t.is_alive(), "a client call hung through the drain"
+    assert len(outcomes) == 4 and all(
+        o == "answered" or o.startswith("typed:") for o in outcomes
+    ), outcomes
+    client.close()
+    rc = proc.wait(timeout=20)
+    assert rc == 0, f"sidecar exited {rc}"
+    print(f"live drain ok (in-flight outcomes: {sorted(outcomes)})")
+finally:
+    if proc.poll() is None:
+        proc.kill()
+EOF
+
+echo "== fleet overload-contrast bench gate (admission on: p99 within 2x unloaded while shed absorbs excess; off: queue+e2e grow monotonically) =="
+python bench.py --fleet-overload >/dev/null
+echo "overload bench gate ok"
 
 echo "== resident-arena determinism + parity gate (churn double-replay byte-identical; arena decisions byte-identical to cold-repack; ledger proves no steady-state compile or unexplained full upload) =="
 arena_tmp=$(mktemp -d)

@@ -114,6 +114,51 @@ def test_rbac_covers_loop_needs():
         assert need in granted, need
 
 
+def test_sidecar_drain_wiring():
+    """Fleet overload armor (ISSUE 14): the sidecar must pass the armor
+    flags, expose the health port, probe readiness on /healthz, and wire
+    preStop to /drain — the drain bit IS the readiness signal."""
+    values = load_values()
+    out = render((CHART / "templates" / "deployment.yaml").read_text(), values)
+    dep = yaml.safe_load(out)
+    containers = dep["spec"]["template"]["spec"]["containers"]
+    sidecar = next(c for c in containers if c["name"] == "tpu-sidecar")
+    cmd = sidecar["command"]
+    for flag, value in [
+        ("--fleet-max-queue-depth", str(values["fleet"]["maxQueueDepth"])),
+        ("--fleet-tenant-qps", str(values["fleet"]["tenantQps"])),
+        ("--fleet-tenant-burst", str(values["fleet"]["tenantBurst"])),
+        ("--fleet-drain-grace-s", str(values["fleet"]["drainGraceS"])),
+        ("--health-port", str(values["sidecar"]["healthPort"])),
+    ]:
+        assert flag in cmd, f"sidecar missing {flag}"
+        assert cmd[cmd.index(flag) + 1] == value
+    health_port = values["sidecar"]["healthPort"]
+    probe = sidecar["readinessProbe"]["httpGet"]
+    assert probe["path"] == "/healthz" and probe["port"] == health_port
+    pre_stop = sidecar["lifecycle"]["preStop"]["httpGet"]
+    assert pre_stop["path"] == "/drain" and pre_stop["port"] == health_port
+    ports = {p["containerPort"] for p in sidecar["ports"]}
+    assert health_port in ports
+
+
+def test_sidecar_flags_exist_in_launcher_cli():
+    """Every flag the chart passes to the sidecar must exist in the
+    launcher's parser (the sidecar analog of the control-plane flag
+    check) — a chart flag the launcher doesn't parse crashes the pod."""
+    values = load_values()
+    out = render((CHART / "templates" / "deployment.yaml").read_text(), values)
+    dep = yaml.safe_load(out)
+    containers = dep["spec"]["template"]["spec"]["containers"]
+    sidecar = next(c for c in containers if c["name"] == "tpu-sidecar")
+    launcher = (
+        CHART.parent.parent.parent / "autoscaler_tpu" / "rpc" / "__main__.py"
+    ).read_text()
+    for arg in sidecar["command"]:
+        if arg.startswith("--"):
+            assert f'"{arg}"' in launcher, f"sidecar passes unknown flag {arg}"
+
+
 def test_empty_compile_cache_dir_renders_valid_deployment():
     """arena.compileCacheDir: \"\" (cache disabled) must drop the flag,
     the volumeMount, AND the volume — a bare `mountPath:` is an invalid

@@ -1,0 +1,939 @@
+"""Fleet overload armor (ISSUE 14): deadline-aware admission control,
+typed shedding, graceful drain, client failover/hedging, ticket
+abandonment, and the process-level chaos fault kinds.
+
+The headline contracts:
+
+- every rejection is TYPED and priced (FleetOverloadError + retry-after,
+  FleetDrainError, FleetDeadlineError) — no caller ever hangs to its
+  deadline on a queue that will not serve it;
+- the client's resend scope is a closed status matrix — UNAVAILABLE fails
+  over (bounded), RESOURCE_EXHAUSTED honors retry-after at most once,
+  DEADLINE_EXCEEDED is NEVER resent;
+- abandonment is honest — a late answer for a departed caller counts
+  `abandoned`, never a fake good SLI event.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.fleet import (
+    ROUTE_BATCHED,
+    SHED_DEADLINE,
+    SHED_DRAINING,
+    SHED_QUEUE_FULL,
+    SHED_QUOTA,
+    TICKET_ABANDONED,
+    TICKET_EXPIRED,
+    TICKET_RESOLVED,
+    AdmissionController,
+    FleetCoalescer,
+    FleetDeadlineError,
+    FleetDrainError,
+    FleetOverloadError,
+    FleetRequest,
+    TokenBucket,
+)
+from autoscaler_tpu.metrics.metrics import AutoscalerMetrics
+
+
+def _request(rng, tenant, P=8, G=3, deadline_s=None):
+    return FleetRequest(
+        tenant_id=tenant,
+        pod_req=rng.integers(1, 60, (P, 6)).astype(np.float32),
+        pod_masks=rng.random((G, P)) > 0.3,
+        template_allocs=rng.integers(50, 300, (G, 6)).astype(np.float32),
+        node_caps=rng.integers(1, 8, G).astype(np.int32),
+        max_nodes=P,
+        deadline_s=deadline_s,
+    )
+
+
+# -- token bucket + admission controller --------------------------------------
+
+
+class TestTokenBucket:
+    def test_burst_then_refill(self):
+        b = TokenBucket(rate=2.0, burst=3.0)
+        assert [b.try_take(0.0) for _ in range(3)] == [0.0, 0.0, 0.0]
+        wait = b.try_take(0.0)
+        assert wait == pytest.approx(0.5)  # 1 token / 2 per s
+        # after the advertised wait the next token IS there
+        assert b.try_take(wait) == 0.0
+
+    def test_refill_caps_at_burst(self):
+        b = TokenBucket(rate=1.0, burst=2.0)
+        b.try_take(0.0)
+        b.try_take(0.0)
+        assert b.try_take(100.0) == 0.0  # long idle refills to burst=2...
+        assert b.try_take(100.0) == 0.0
+        assert b.try_take(100.0) > 0.0   # ...not to 100
+
+    def test_rejects_nonpositive_rate(self):
+        with pytest.raises(ValueError):
+            TokenBucket(rate=0.0, burst=1.0)
+
+    def test_out_of_order_stamps_never_rewind_refill(self):
+        """Review regression: two racing submits can present swapped
+        timestamps; the bucket must not rewind _last and re-credit the
+        interval (a quota leak under exactly the concurrency quotas
+        police)."""
+        b = TokenBucket(rate=1.0, burst=1.0)
+        assert b.try_take(10.0) == 0.0   # drains the bucket at t=10
+        assert b.try_take(9.0) > 0.0     # late stamp: no refill, no rewind
+        # t=10.5: only 0.5s elapsed since t=10 — a rewound clock would
+        # have credited 1.5s and handed out a full token here
+        assert b.try_take(10.5) == pytest.approx(0.5)
+
+
+class TestAdmissionController:
+    def test_verdict_precedence_drain_depth_quota(self):
+        ctl = AdmissionController(
+            max_queue_depth=1, tenant_qps=1.0, tenant_burst=1.0,
+            window_s=0.01,
+        )
+        assert ctl.admit("t", 0, 0.0, draining=True).outcome == SHED_DRAINING
+        assert ctl.admit("t", 1, 0.0).outcome == SHED_QUEUE_FULL
+        assert ctl.admit("t", 0, 0.0).outcome == "admitted"
+        verdict = ctl.admit("t", 0, 0.0)
+        assert verdict.outcome == SHED_QUOTA
+        assert verdict.retry_after_s == pytest.approx(1.0)
+
+    def test_overflow_tenants_share_one_bucket(self):
+        ctl = AdmissionController(tenant_qps=1.0, tenant_burst=1.0,
+                                  max_tenants=1)
+        assert ctl.admit("a", 0, 0.0).admitted        # own bucket
+        assert ctl.admit("b", 0, 0.0).admitted        # overflow bucket
+        # c shares b's overflow bucket: already drained
+        assert ctl.admit("c", 0, 0.0).outcome == SHED_QUOTA
+
+    def test_tallies_are_lifetime(self):
+        ctl = AdmissionController(max_queue_depth=1)
+        ctl.admit("t", 0, 0.0)
+        ctl.admit("t", 5, 0.0)
+        assert ctl.snapshot() == {"admitted": 1, SHED_QUEUE_FULL: 1}
+
+
+# -- coalescer admission ------------------------------------------------------
+
+
+class TestCoalescerAdmission:
+    def test_queue_full_typed_with_retry_after(self):
+        rng = np.random.default_rng(0)
+        m = AutoscalerMetrics()
+        co = FleetCoalescer(buckets="16x4x8", batch_scenarios=4,
+                            max_queue_depth=2, metrics=m)
+        co.submit(_request(rng, "a"))
+        co.submit(_request(rng, "a"))
+        with pytest.raises(FleetOverloadError) as exc:
+            co.submit(_request(rng, "a"))
+        assert exc.value.outcome == SHED_QUEUE_FULL
+        assert exc.value.retry_after_s > 0
+        assert m.fleet_admission_total.get(
+            outcome=SHED_QUEUE_FULL, tenant="a"
+        ) == 1.0
+        co.flush()
+
+    def test_quota_typed_and_refills_on_injected_clock(self):
+        rng = np.random.default_rng(1)
+        clk = {"t": 0.0}
+        co = FleetCoalescer(buckets="16x4x8", batch_scenarios=4,
+                            tenant_qps=1.0, tenant_burst=2.0,
+                            clock=lambda: clk["t"])
+        co.submit(_request(rng, "b"))
+        co.submit(_request(rng, "b"))
+        with pytest.raises(FleetOverloadError) as exc:
+            co.submit(_request(rng, "b"))
+        assert exc.value.outcome == SHED_QUOTA
+        assert exc.value.retry_after_s == pytest.approx(1.0)
+        clk["t"] = 1.0  # one token refilled — purely on the injected clock
+        tk = co.submit(_request(rng, "b"))
+        co.flush()
+        assert tk.result(0.0).route == ROUTE_BATCHED
+
+    def test_dead_on_arrival_deadline_sheds_typed(self):
+        rng = np.random.default_rng(2)
+        co = FleetCoalescer(buckets="16x4x8", clock=lambda: 5.0)
+        with pytest.raises(FleetDeadlineError):
+            co.submit(_request(rng, "c", deadline_s=0.0))
+        assert co.queue_depth() == 0
+
+    def test_flush_sheds_expired_before_batch_slots(self):
+        """A ticket whose deadline passed while queued must fail typed and
+        must NOT consume a batch slot (the live batch stays correct)."""
+        from autoscaler_tpu.slo import SLI_FLEET_E2E, SloEngine, fleet_slos
+
+        rng = np.random.default_rng(3)
+        clk = {"t": 0.0}
+        m = AutoscalerMetrics()
+        slo = SloEngine(specs=fleet_slos())
+        co = FleetCoalescer(buckets="16x4x8", batch_scenarios=4,
+                            clock=lambda: clk["t"], metrics=m, slo=slo)
+        doomed = co.submit(_request(rng, "d", deadline_s=1.0))
+        live = co.submit(_request(rng, "d"))
+        clk["t"] = 2.0
+        assert co.flush() == 1  # only the live request entered a batch
+        with pytest.raises(FleetDeadlineError):
+            doomed.result(0.0)
+        assert live.result(0.0).route == ROUTE_BATCHED
+        assert m.fleet_ticket_outcomes_total.get(
+            outcome=TICKET_EXPIRED, tenant="d"
+        ) == 1.0
+        # queue expiry is a TICKET outcome, not an admission verdict: the
+        # ticket was already counted `admitted`, so admission verdicts
+        # still sum to submits
+        assert m.fleet_admission_total.get(
+            outcome=SHED_DEADLINE, tenant="d"
+        ) == 0.0
+        assert m.fleet_admission_total.get(
+            outcome="admitted", tenant="d"
+        ) == 2.0
+        # the shed charged a bad budget event (and the live answer, whose
+        # sim-clock e2e of 2.0s crossed the 1s threshold, charged its own)
+        rec = slo.tick(2.0, 0)
+        assert rec["slos"][SLI_FLEET_E2E]["events_total"] == 2
+        assert rec["slos"][SLI_FLEET_E2E]["events_bad"] == 2
+
+    def test_flush_limit_leaves_rest_queued_in_order(self):
+        rng = np.random.default_rng(4)
+        co = FleetCoalescer(buckets="16x4x8", batch_scenarios=8)
+        tickets = [co.submit(_request(rng, f"t{i}")) for i in range(5)]
+        assert co.flush(limit=3) == 3
+        assert co.queue_depth() == 2
+        assert all(t.done() for t in tickets[:3])
+        assert not any(t.done() for t in tickets[3:])
+        assert co.flush() == 2
+        assert all(t.done() for t in tickets)
+
+    def test_dead_on_arrival_burns_no_quota_and_tallies_once(self):
+        """Review regression: a DOA deadline must be shed BEFORE the quota
+        gate — it must not consume a token or double-count in the
+        admission tallies."""
+        rng = np.random.default_rng(20)
+        co = FleetCoalescer(buckets="16x4x8", tenant_qps=1.0,
+                            tenant_burst=1.0, clock=lambda: 5.0)
+        with pytest.raises(FleetDeadlineError):
+            co.submit(_request(rng, "doa", deadline_s=0.0))
+        assert co.admission_snapshot() == {SHED_DEADLINE: 1}
+        # the tenant's single burst token is still there
+        tk = co.submit(_request(rng, "doa"))
+        co.flush()
+        assert tk.result(0.0).route == ROUTE_BATCHED
+        assert co.admission_snapshot() == {SHED_DEADLINE: 1, "admitted": 1}
+
+    def test_zero_max_tenant_labels_keeps_per_tenant_quotas(self):
+        """Review regression: max_tenant_labels=0 is documented as
+        UNBOUNDED — it must not collapse every tenant into one shared
+        quota bucket."""
+        co = FleetCoalescer(buckets="16x4x8", tenant_qps=1.0,
+                            tenant_burst=1.0, max_tenant_labels=0,
+                            clock=lambda: 0.0)
+        rng = np.random.default_rng(21)
+        co.submit(_request(rng, "t1"))  # takes t1's only token
+        # t2 has its OWN bucket: must still be admitted
+        co.submit(_request(rng, "t2"))
+        co.flush()
+        assert co.admission_snapshot() == {"admitted": 2}
+
+    def test_from_options_reads_armor_knobs(self):
+        opts = AutoscalingOptions(
+            fleet_shape_buckets="16x4x8",
+            fleet_prewarm=False,
+            fleet_max_queue_depth=7,
+            fleet_tenant_qps=2.5,
+            fleet_tenant_burst=5.0,
+        )
+        co = FleetCoalescer.from_options(opts)
+        assert co.admission.max_queue_depth == 7
+        assert co.admission.tenant_qps == 2.5
+        assert co.admission.tenant_burst == 5.0
+
+
+# -- drain --------------------------------------------------------------------
+
+
+class TestDrain:
+    def test_submit_after_stop_gets_typed_drain_rejection(self):
+        rng = np.random.default_rng(5)
+        co = FleetCoalescer(buckets="16x4x8")
+        co.stop()
+        with pytest.raises(FleetDrainError):
+            co.submit(_request(rng, "z"))
+        co.start()  # explicit restart re-arms
+        tk = co.submit(_request(rng, "z"))
+        co.stop()   # stop flushes stragglers
+        assert tk.result(0.0).route == ROUTE_BATCHED
+
+    def test_ensure_running_refuses_to_undrain(self):
+        """Review regression: the RPC path's per-request revive
+        (ensure_running) must never re-arm a draining coalescer — only an
+        explicit start() exits the drain state."""
+        rng = np.random.default_rng(22)
+        co = FleetCoalescer(buckets="16x4x8")
+        assert co.ensure_running() is True
+        co.stop()
+        assert co.ensure_running() is False
+        assert co.draining()
+        with pytest.raises(FleetDrainError):
+            co.submit(_request(rng, "x"))
+        co.start()  # explicit restart re-arms
+        assert co.ensure_running() is True
+        tk = co.submit(_request(rng, "x"))
+        co.stop()
+        assert tk.result(0.0).route == ROUTE_BATCHED
+
+    def test_stop_racing_submits_no_hangs(self):
+        """The satellite contract: every submit racing stop() either gets
+        a ticket that terminates (the pre-drain flush serves it) or the
+        typed FleetDrainError — NEVER a ticket that hangs to deadline."""
+        rng = np.random.default_rng(6)
+        co = FleetCoalescer(buckets="16x4x8", batch_scenarios=4,
+                            window_s=0.001)
+        co.start()
+        barrier = threading.Barrier(9)
+        results = []
+        lock = threading.Lock()
+
+        def submitter(i):
+            req = _request(np.random.default_rng(100 + i), f"r{i}")
+            barrier.wait()
+            try:
+                tk = co.submit(req)
+            except FleetDrainError:
+                with lock:
+                    results.append("drained")
+                return
+            try:
+                tk.result(timeout=10.0)
+                with lock:
+                    results.append("resolved")
+            except Exception as e:  # noqa: BLE001 — typed failures OK
+                with lock:
+                    results.append(type(e).__name__)
+
+        threads = [
+            threading.Thread(target=submitter, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        co.stop()
+        for t in threads:
+            t.join(timeout=15.0)
+            assert not t.is_alive(), "a submitter hung through the drain"
+        assert len(results) == 8
+        assert set(results) <= {"resolved", "drained"}, results
+
+    def test_breaker_half_open_probe_during_drain(self):
+        """A tripped batched rung whose cooldown elapses mid-drain: the
+        final flush's half-open probe must run (closing the breaker on
+        success) while racing submits shed typed — no wedge, no hang."""
+        from autoscaler_tpu.estimator.ladder import KernelLadder
+
+        rng = np.random.default_rng(7)
+        clk = {"t": 0.0}
+        co = FleetCoalescer(
+            buckets="16x4x8", batch_scenarios=4,
+            clock=lambda: clk["t"],
+            ladder=KernelLadder(failure_threshold=1, cooldown_s=5.0),
+        )
+        co.ladder.fault_hook = lambda rung: (
+            "kernel_fault" if rung == "xla" else None
+        )
+        tk = co.submit(_request(rng, "p"))
+        co.flush()
+        tk.result(0.0)
+        assert "xla" in co.degraded()
+        co.ladder.fault_hook = None
+        clk["t"] = 6.0  # past cooldown: next walk is the half-open probe
+        probe_tk = co.submit(_request(rng, "p"))
+        shed = []
+
+        def racer():
+            try:
+                co.submit(_request(np.random.default_rng(8), "q"))
+            except FleetDrainError:
+                shed.append(True)
+
+        t = threading.Thread(target=racer)
+        co.stop()  # drain: sheds the racer (if it lost), flushes probe_tk
+        t.start()
+        t.join(timeout=10.0)
+        answer = probe_tk.result(timeout=0.0)
+        assert answer.route == ROUTE_BATCHED  # the probe ran and succeeded
+        assert co.degraded() == []            # breaker closed by the probe
+
+
+# -- abandonment --------------------------------------------------------------
+
+
+class TestAbandonment:
+    def test_late_resolve_counts_abandoned_not_good(self):
+        rng = np.random.default_rng(9)
+        m = AutoscalerMetrics()
+        co = FleetCoalescer(buckets="16x4x8", metrics=m)
+        tk = co.submit(_request(rng, "gone"))
+        with pytest.raises(TimeoutError):
+            tk.result(timeout=0.0)  # the caller departs
+        assert tk.abandoned
+        sli_before = m.fleet_e2e_seconds.count(tenant="gone", bucket="16x4x8")
+        co.flush()  # the batch still dispatches; the answer arrives late
+        assert tk.done()
+        assert m.fleet_ticket_outcomes_total.get(
+            outcome=TICKET_ABANDONED, tenant="gone"
+        ) == 1.0
+        assert m.fleet_ticket_outcomes_total.get(
+            outcome=TICKET_RESOLVED, tenant="gone"
+        ) == 0.0
+        # no SLI histogram row was stamped for the departed caller
+        assert m.fleet_e2e_seconds.count(
+            tenant="gone", bucket="16x4x8"
+        ) == sli_before
+
+    def test_result_after_resolution_is_not_abandonment(self):
+        rng = np.random.default_rng(10)
+        m = AutoscalerMetrics()
+        co = FleetCoalescer(buckets="16x4x8", metrics=m)
+        tk = co.submit(_request(rng, "here"))
+        co.flush()
+        assert tk.result(timeout=0.0).route == ROUTE_BATCHED
+        assert not tk.abandoned
+        assert m.fleet_ticket_outcomes_total.get(
+            outcome=TICKET_RESOLVED, tenant="here"
+        ) == 1.0
+
+
+# -- client resend matrix / failover / hedging --------------------------------
+
+
+class _FakeRpcError(Exception):
+    """Duck-typed grpc.RpcError carrying code/details/trailing metadata."""
+
+    def __init__(self, code, details="", trailing=()):
+        self._code = code
+        self._details = details
+        self._trailing = tuple(trailing)
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return self._details
+
+    def trailing_metadata(self):
+        return self._trailing
+
+
+class _ScriptedChannel:
+    """unary_unary channel whose call raises/returns per a script list."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = 0
+
+    def unary_unary(self, *a, **k):
+        def call(request, timeout=None, metadata=None):
+            self.calls += 1
+            action = self.script.pop(0) if self.script else "ok"
+            if isinstance(action, Exception):
+                raise action
+            return action
+
+        return call
+
+    def close(self):
+        pass
+
+
+def _matrix_client(script):
+    import grpc
+
+    from autoscaler_tpu.rpc.service import TpuSimulationClient
+
+    # grpc.RpcError must be the raised type for the client's except clause
+    class Err(_FakeRpcError, grpc.RpcError):
+        pass
+
+    client = TpuSimulationClient(
+        "127.0.0.1:1", default_timeout_s=5.0,
+        sleep=lambda s: None,  # no real backoff sleeps in tests
+    )
+    channel = _ScriptedChannel(script)
+    client._channel = channel
+    client._reconnect = lambda: None  # keep the scripted channel seated
+    return client, channel, Err
+
+
+class TestClientResendMatrix:
+    def test_unavailable_resends_bounded(self):
+        import grpc
+
+        client, channel, Err = _matrix_client([])
+        channel.script = [
+            Err(grpc.StatusCode.UNAVAILABLE, "conn reset"), "answer",
+        ]
+        assert client._call("BestOptions", object()) == "answer"
+        assert channel.calls == 2
+
+    def test_deadline_exceeded_never_resends(self):
+        import grpc
+
+        client, channel, Err = _matrix_client([])
+        channel.script = [
+            Err(grpc.StatusCode.DEADLINE_EXCEEDED, "too slow"), "answer",
+        ]
+        with pytest.raises(grpc.RpcError):
+            client._call("BestOptions", object())
+        assert channel.calls == 1, (
+            "retrying a timed-out call doubles load exactly when the "
+            "server is drowning"
+        )
+
+    def test_resource_exhausted_without_hint_never_resends(self):
+        import grpc
+
+        client, channel, Err = _matrix_client([])
+        channel.script = [
+            Err(grpc.StatusCode.RESOURCE_EXHAUSTED, "shed"), "answer",
+        ]
+        with pytest.raises(grpc.RpcError):
+            client._call("BestOptions", object())
+        assert channel.calls == 1
+
+    def test_resource_exhausted_honors_retry_after_once(self):
+        import grpc
+
+        slept = []
+        from autoscaler_tpu.rpc.service import (
+            RETRY_AFTER_METADATA_KEY,
+            TpuSimulationClient,
+        )
+
+        class Err(_FakeRpcError, grpc.RpcError):
+            pass
+
+        client = TpuSimulationClient(
+            "127.0.0.1:1", default_timeout_s=5.0, sleep=slept.append,
+        )
+        shed = Err(grpc.StatusCode.RESOURCE_EXHAUSTED, "shed",
+                   trailing=((RETRY_AFTER_METADATA_KEY, "0.25"),))
+        channel = _ScriptedChannel([shed, "answer"])
+        client._channel = channel
+        client._reconnect = lambda: None
+        assert client._call("BestOptions", object()) == "answer"
+        assert channel.calls == 2
+        assert slept == [0.25]
+        # and at most ONCE: two sheds in a row surface the error
+        channel.script = [shed, shed, "answer"]
+        channel.calls = 0
+        with pytest.raises(grpc.RpcError):
+            client._call("BestOptions", object())
+        assert channel.calls == 2
+
+    def test_retry_after_beyond_deadline_budget_raises(self):
+        import grpc
+
+        from autoscaler_tpu.rpc.service import (
+            RETRY_AFTER_METADATA_KEY,
+            TpuSimulationClient,
+        )
+
+        class Err(_FakeRpcError, grpc.RpcError):
+            pass
+
+        client = TpuSimulationClient(
+            "127.0.0.1:1", default_timeout_s=0.1,
+            sleep=lambda s: pytest.fail("slept past the deadline"),
+        )
+        shed = Err(grpc.StatusCode.RESOURCE_EXHAUSTED, "shed",
+                   trailing=((RETRY_AFTER_METADATA_KEY, "60"),))
+        client._channel = _ScriptedChannel([shed, "answer"])
+        client._reconnect = lambda: None
+        with pytest.raises(grpc.RpcError):
+            client._call("BestOptions", object())
+
+    def test_invalid_argument_never_resends(self):
+        import grpc
+
+        client, channel, Err = _matrix_client([])
+        channel.script = [
+            Err(grpc.StatusCode.INVALID_ARGUMENT, "bad axes"), "answer",
+        ]
+        with pytest.raises(grpc.RpcError):
+            client._call("BestOptions", object())
+        assert channel.calls == 1
+
+
+class TestClientFailover:
+    def test_multi_endpoint_parsing(self):
+        from autoscaler_tpu.rpc.service import TpuSimulationClient
+
+        c = TpuSimulationClient("a:1, b:2,c:3")
+        assert c._targets == ["a:1", "b:2", "c:3"]
+        c2 = TpuSimulationClient(["x:1", "y:2"])
+        assert c2._targets == ["x:1", "y:2"]
+        # review regression: a comma-joined element inside a LIST (the
+        # --rpc-address append path) must split too — an unsplit
+        # "a:1,b:2" is one bogus gRPC target and silent non-failover
+        c3 = TpuSimulationClient(["a:1,b:2", "c:3"])
+        assert c3._targets == ["a:1", "b:2", "c:3"]
+        with pytest.raises(ValueError):
+            TpuSimulationClient("")
+
+    def test_fails_over_to_live_endpoint(self):
+        """Endpoint 1 is dead; the client must serve the call from
+        endpoint 2 inside one _call."""
+        pytest.importorskip("grpc")
+        from autoscaler_tpu.rpc.service import TpuSimulationClient, serve
+
+        co = FleetCoalescer(buckets="16x4x8", window_s=0.002,
+                            batch_scenarios=4)
+        server, port = serve(fleet=co)
+        client = TpuSimulationClient(
+            ["127.0.0.1:1", f"127.0.0.1:{port}"], default_timeout_s=30.0,
+            failover_base_sleep_s=0.001,
+        )
+        try:
+            rng = np.random.default_rng(11)
+            counts, sched = client.estimate(
+                rng.integers(1, 100, (9, 6)).astype(np.float32),
+                rng.random((3, 9)) > 0.2,
+                rng.integers(100, 500, (3, 6)).astype(np.float32),
+                ["g0", "g1", "g2"],
+                rng.integers(1, 16, 3).astype(np.int32),
+                max_nodes=16,
+            )
+            assert counts.shape == (3,)
+            assert client._target == f"127.0.0.1:{port}"
+        finally:
+            client.close()
+            server.stop(0)
+            co.stop()
+
+    def test_drain_unavailable_fails_over_without_backoff(self):
+        """A drain-detail UNAVAILABLE means 'go elsewhere NOW' — the
+        failover must not pay the backoff pause."""
+        import grpc
+
+        from autoscaler_tpu.rpc.service import DRAIN_DETAIL
+
+        client, channel, Err = _matrix_client([])
+        slept = []
+        client._sleep = slept.append
+        channel.script = [
+            Err(grpc.StatusCode.UNAVAILABLE, f"{DRAIN_DETAIL}: bye"),
+            "answer",
+        ]
+        assert client._call("BestOptions", object()) == "answer"
+        assert slept == []
+
+
+class _FakeFuture:
+    def __init__(self, result=None, error=None, ready=True):
+        self._result = result
+        self._error = error
+        self._ready = ready
+        self.cancelled = False
+
+    def done(self):
+        return self._ready
+
+    def add_done_callback(self, cb):
+        if self._ready:
+            cb(self)
+
+    def result(self):
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def cancel(self):
+        self.cancelled = True
+        self._ready = True
+
+
+class TestClientHedging:
+    def test_hedge_fires_after_delay_and_cancels_loser(self, monkeypatch):
+        """Primary never answers: after the hedge delay the secondary
+        endpoint serves the call and the primary leg is cancelled."""
+        from autoscaler_tpu.rpc import service as service_mod
+        from autoscaler_tpu.rpc.service import TpuSimulationClient
+
+        client = TpuSimulationClient(
+            ["primary:1", "secondary:2"], default_timeout_s=5.0, hedge=True,
+        )
+        primary_future = _FakeFuture(ready=False)
+        hedge_future = _FakeFuture(result="hedged-answer")
+
+        class FutureChannel:
+            def __init__(self, fut):
+                self.fut = fut
+
+            def unary_unary(self, *a, **k):
+                class RPC:
+                    def __init__(self, fut):
+                        self.fut = fut
+
+                    def future(self, request, timeout=None, metadata=None):
+                        return self.fut
+
+                return RPC(self.fut)
+
+            def close(self):
+                pass
+
+        client._channel = FutureChannel(primary_future)
+        monkeypatch.setattr(
+            service_mod.grpc, "insecure_channel",
+            lambda target: FutureChannel(hedge_future),
+        )
+        client.HEDGE_MIN_DELAY_S = 0.01
+
+        class FakeResp:
+            @staticmethod
+            def FromString(data):  # noqa: N802 — protobuf API shape
+                return data
+
+        result = client._hedged_send(
+            "Estimate", object(), 5.0, None, FakeResp
+        )
+        assert result == "hedged-answer"
+        assert primary_future.cancelled
+
+    def test_hedge_disabled_for_single_endpoint(self):
+        from autoscaler_tpu.rpc.service import TpuSimulationClient
+
+        client = TpuSimulationClient("only:1", default_timeout_s=1.0,
+                                     hedge=True, sleep=lambda s: None)
+        channel = _ScriptedChannel(["answer"])
+        client._channel = channel
+        # single endpoint: the hedged path is skipped entirely
+        assert client._call("Estimate", object()) == "answer"
+        assert channel.calls == 1
+
+    def test_hedge_delay_derives_from_p99(self):
+        from autoscaler_tpu.rpc.service import TpuSimulationClient
+
+        client = TpuSimulationClient(["a:1", "b:2"])
+        assert client._hedge_delay("Estimate") == client.HEDGE_MIN_DELAY_S
+        for v in [0.01] * 99 + [0.9]:
+            client._note_latency("Estimate", v)
+        # 64-sample window keeps the tail; p99 reflects the slow sample
+        assert client._hedge_delay("Estimate") >= 0.01
+
+
+# -- RPC surface: typed statuses end to end -----------------------------------
+
+
+@pytest.fixture()
+def quota_server():
+    pytest.importorskip("grpc")
+    from autoscaler_tpu.rpc.service import TpuSimulationClient, serve
+
+    co = FleetCoalescer(buckets="16x4x8", window_s=0.002, batch_scenarios=4,
+                        tenant_qps=0.001, tenant_burst=1.0)
+    server, port = serve(fleet=co)
+    client = TpuSimulationClient(f"127.0.0.1:{port}", default_timeout_s=10.0)
+    yield client
+    client.close()
+    server.stop(0)
+    co.stop()
+
+
+def _world(rng, P=9, G=3):
+    return (
+        rng.integers(1, 100, (P, 6)).astype(np.float32),
+        rng.random((G, P)) > 0.2,
+        rng.integers(100, 500, (G, 6)).astype(np.float32),
+        [f"g{i}" for i in range(G)],
+        rng.integers(1, 16, G).astype(np.int32),
+    )
+
+
+def test_rpc_overload_surfaces_resource_exhausted_with_retry_after(
+    quota_server,
+):
+    import grpc
+
+    from autoscaler_tpu.rpc.service import RETRY_AFTER_METADATA_KEY
+
+    rng = np.random.default_rng(12)
+    req, masks, allocs, gids, caps = _world(rng)
+    # burst=1: the first request is admitted and served...
+    quota_server.batch_estimate(req, masks, allocs, gids, caps,
+                                max_nodes=16, tenant_id="q")
+    # ...the second sheds: qps=0.001 puts retry-after (~1000s) far past
+    # the 10s deadline, so the client must NOT wait — it raises typed
+    with pytest.raises(grpc.RpcError) as exc:
+        quota_server.batch_estimate(req, masks, allocs, gids, caps,
+                                    max_nodes=16, tenant_id="q")
+    assert exc.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+    assert "fleet overload" in exc.value.details()
+    trailing = dict(exc.value.trailing_metadata() or ())
+    assert float(trailing[RETRY_AFTER_METADATA_KEY]) > 1.0
+
+
+def test_rpc_drain_refuses_unavailable_with_detail():
+    pytest.importorskip("grpc")
+    import grpc
+
+    from autoscaler_tpu.rpc.service import (
+        DRAIN_DETAIL,
+        DrainState,
+        TpuSimulationClient,
+        serve,
+    )
+
+    co = FleetCoalescer(buckets="16x4x8", window_s=0.002, batch_scenarios=4)
+    drain = DrainState()
+    server, port = serve(fleet=co, drain=drain)
+    client = TpuSimulationClient(f"127.0.0.1:{port}", default_timeout_s=5.0,
+                                 failover_base_sleep_s=0.001)
+    try:
+        rng = np.random.default_rng(13)
+        req, masks, allocs, gids, caps = _world(rng)
+        client.estimate(req, masks, allocs, gids, caps, max_nodes=16)
+        drain.begin_drain()
+        assert not drain.ready()
+        with pytest.raises(grpc.RpcError) as exc:
+            client.estimate(req, masks, allocs, gids, caps, max_nodes=16)
+        assert exc.value.code() == grpc.StatusCode.UNAVAILABLE
+        assert DRAIN_DETAIL in exc.value.details()
+    finally:
+        client.close()
+        server.stop(0)
+        co.stop()
+
+
+def test_health_server_readiness_flips_on_drain():
+    import urllib.error
+    import urllib.request
+
+    from autoscaler_tpu.rpc.service import DrainState, start_health_server
+
+    drain = DrainState()
+    httpd, port = start_health_server(drain, port=0)
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz"
+        ).read()
+        assert body == b"ok\n"
+        # preStop: GET /drain flips the bit
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/drain")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz")
+        assert exc.value.code == 503
+        assert drain.draining
+    finally:
+        httpd.shutdown()
+
+
+# -- chaos fault kinds + the overload scenario driver -------------------------
+
+
+def test_new_fault_kinds_roundtrip_and_validate():
+    from autoscaler_tpu.loadgen.spec import FaultSpec, ScenarioSpec, SpecError
+
+    for kind in ("sidecar_crash", "sidecar_partition", "rpc_slow"):
+        f = FaultSpec(kind=kind, start_tick=0, end_tick=3)
+        assert f.active(0) and not f.active(3)
+        with pytest.raises(SpecError):
+            FaultSpec(kind=kind, group="g1")  # process-wide, not group-scoped
+    with pytest.raises(SpecError):
+        from autoscaler_tpu.loadgen.spec import TenantSpec
+
+        TenantSpec(name="bad", requests_per_round=0)
+    spec = ScenarioSpec.from_dict({
+        "name": "chaos", "seed": 1, "ticks": 4,
+        "fleet": {"tenants": [
+            {"name": "s", "pods": 6, "groups": 2, "max_nodes": 8,
+             "requests_per_round": 3, "deadline_s": 10.0},
+        ]},
+        "events": [
+            {"at_tick": 1, "kind": "fault",
+             "fault": {"kind": "sidecar_crash", "end_tick": 1}},
+        ],
+    })
+    assert ScenarioSpec.from_json(spec.to_json()) == spec
+    assert spec.fleet.tenants[0].requests_per_round == 3
+
+
+def test_fleet_driver_overload_chaos_smoke():
+    """Storm + crash window through the real driver: quota sheds typed
+    with retry-after, the outage sheds unavailable, zero unresolved
+    tickets, and the SLO saw the outage as bad budget."""
+    from autoscaler_tpu.loadgen.fleetdrive import run_fleet_scenario
+    from autoscaler_tpu.loadgen.spec import ScenarioSpec
+    from autoscaler_tpu.slo import SLI_FLEET_E2E
+
+    spec = ScenarioSpec.from_dict({
+        "name": "overload_smoke", "seed": 2, "ticks": 4,
+        "tick_interval_s": 10.0,
+        "fleet": {"tenants": [
+            {"name": "calm", "pods": 6, "groups": 2, "max_nodes": 8},
+            {"name": "storm", "pods": 6, "groups": 2, "max_nodes": 8,
+             "requests_per_round": 4},
+        ]},
+        "events": [
+            {"at_tick": 2, "kind": "fault",
+             "fault": {"kind": "sidecar_crash", "end_tick": 1}},
+        ],
+        "options": {
+            "fleet_shape_buckets": "16x4x8", "fleet_prewarm": False,
+            "fleet_batch_scenarios": 8, "perf_cost_model": False,
+            "fleet_tenant_qps": 0.2, "fleet_tenant_burst": 2.0,
+        },
+    })
+    result = run_fleet_scenario(spec)
+    assert result.unresolved == 0
+    sheds = [row for r in result.records for row in r.shed]
+    reasons = {row["reason"] for row in sheds}
+    assert "shed_quota" in reasons
+    assert "sidecar_crash" in reasons
+    for row in sheds:
+        assert row["error"], "untyped shed row"
+        if row["reason"] == "shed_quota":
+            assert row["retry_after_s"] > 0
+    # the outage round shed EVERY submission and resolved none
+    outage = result.records[2]
+    assert outage.outcomes["resolved"] == 0
+    assert outage.outcomes["shed"] == 5
+    # answered requests still certify against solo
+    assert all(t.match_solo for r in result.records for t in r.tenants)
+    # SLO: the crash charged bad events; totals balance the ledger
+    final = result.slo_records[-1]["slos"][SLI_FLEET_E2E]
+    assert final["events_bad"] >= 5
+    # double replay stays byte-identical with chaos + quotas armed
+    again = run_fleet_scenario(ScenarioSpec.from_dict(spec.to_dict()))
+    assert again.decision_ledger_lines() == result.decision_ledger_lines()
+    assert again.slo_ledger_lines() == result.slo_ledger_lines()
+
+
+def test_rpc_slow_latency_reaches_slis_deterministically():
+    from autoscaler_tpu.loadgen.fleetdrive import run_fleet_scenario
+    from autoscaler_tpu.loadgen.spec import ScenarioSpec
+    from autoscaler_tpu.slo import SLI_FLEET_E2E
+
+    spec = ScenarioSpec.from_dict({
+        "name": "rpc_slow_smoke", "seed": 3, "ticks": 3,
+        "fleet": {"tenants": [
+            {"name": "a", "pods": 6, "groups": 2, "max_nodes": 8},
+        ]},
+        "events": [
+            {"at_tick": 1, "kind": "fault",
+             "fault": {"kind": "rpc_slow", "latency_s": 2.5, "end_tick": 1}},
+        ],
+        "options": {"fleet_shape_buckets": "16x4x8", "fleet_prewarm": False,
+                    "perf_cost_model": False},
+    })
+    result = run_fleet_scenario(spec)
+    assert result.injected_faults.get("rpc_slow") == 1
+    # the slow round's e2e crossed the 1s fleet_e2e threshold → bad event
+    # the slow round's e2e rode the DETERMINISTIC timeline stamps into the
+    # SLO: one bad event (2.5s > the 1s fleet_e2e threshold), two good
+    final = result.slo_records[-1]["slos"][SLI_FLEET_E2E]
+    assert final["events_bad"] == 1
+    assert final["events_total"] == 3
