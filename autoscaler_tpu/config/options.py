@@ -170,6 +170,12 @@ class AutoscalingOptions:
     # next-token as the retry-after hint.
     fleet_tenant_qps: float = 0.0
     fleet_tenant_burst: float = 0.0
+    # tenant quota tiers (fleet/tiers.py), JSON: tier name → {qps, burst,
+    # queue_share, default_deadline_s, shed_priority, tenants}; must
+    # include a "default" catch-all tier. Supersedes the global
+    # fleet_tenant_qps with per-TIER budgets, queue-share slices, tier
+    # default deadlines, and tier-priority flush/shed ordering. "" = off.
+    fleet_tenant_tiers: str = ""
     # sidecar drain: how long server.stop() waits for in-flight RPCs after
     # the drain sequence stopped admission and flushed the coalescer
     # (SIGTERM → UNAVAILABLE+drain detail → flush → stop(grace))

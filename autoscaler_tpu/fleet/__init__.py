@@ -25,6 +25,14 @@ from autoscaler_tpu.fleet.buckets import (
     select_bucket,
 )
 from autoscaler_tpu.fleet.admission import AdmissionController, TokenBucket
+from autoscaler_tpu.fleet.balance import EndpointBalancer, EndpointHealth
+from autoscaler_tpu.fleet.tiers import (
+    DEFAULT_TIER,
+    TierError,
+    TierPolicy,
+    TierSpec,
+    parse_tiers,
+)
 from autoscaler_tpu.fleet.coalescer import (
     OVERFLOW_TENANT,
     ROUTE_BATCHED,
@@ -72,6 +80,13 @@ __all__ = [
     "AdmissionController",
     "BucketError",
     "BucketSpec",
+    "DEFAULT_TIER",
+    "EndpointBalancer",
+    "EndpointHealth",
+    "TierError",
+    "TierPolicy",
+    "TierSpec",
+    "parse_tiers",
     "FleetAdmissionError",
     "FleetAnswer",
     "FleetCoalescer",

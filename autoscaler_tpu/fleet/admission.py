@@ -35,6 +35,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
+from autoscaler_tpu.fleet.tiers import TierPolicy, TierSpec
 from autoscaler_tpu.fleet.errors import (
     ADMIT_OK,
     SHED_DEADLINE,
@@ -105,10 +106,13 @@ class TokenBucket:
 @dataclass(frozen=True)
 class AdmissionVerdict:
     """One submit's fate: the closed outcome label plus the retry hint
-    (0.0 for admitted/draining — drain has no useful retry-here time)."""
+    (0.0 for admitted/draining — drain has no useful retry-here time).
+    ``tier`` names the judged tenant's quota tier ("" when tiers are off)
+    — the metric/ledger label."""
 
     outcome: str
     retry_after_s: float = 0.0
+    tier: str = ""
 
     @property
     def admitted(self) -> bool:
@@ -124,7 +128,14 @@ class AdmissionController:
 
     ``max_queue_depth`` 0 disables the depth gate; ``tenant_qps`` 0
     disables quotas (both default off so embedders opt in via the
-    --fleet-* surface)."""
+    --fleet-* surface).
+
+    ``tiers`` (a fleet.tiers.TierPolicy, optional) supersedes the global
+    per-tenant quota with per-TIER budgets: one shared token bucket per
+    tier (tier.qps/burst; 0 = the tier is unmetered) plus a queue-share
+    slice of ``max_queue_depth`` — a storming bronze tier fills its slice
+    and sheds while gold's slice stays open, which is how "low tiers shed
+    first under queue pressure" holds at admission time."""
 
     def __init__(
         self,
@@ -133,6 +144,7 @@ class AdmissionController:
         tenant_burst: float = 0.0,
         window_s: float = 0.005,
         max_tenants: int = 64,
+        tiers: Optional[TierPolicy] = None,
     ) -> None:
         self.max_queue_depth = int(max_queue_depth)
         self.tenant_qps = float(tenant_qps)
@@ -141,7 +153,11 @@ class AdmissionController:
         )
         self.window_s = float(window_s)
         self.max_tenants = int(max_tenants)
+        self.tiers = tiers
         self._buckets: Dict[str, TokenBucket] = {}
+        # one shared bucket per TIER (tiers mode): the tier's tenants draw
+        # from one budget, which is the whole point of a tier
+        self._tier_buckets: Dict[str, TokenBucket] = {}
         # lifetime admission tallies by outcome (report/debug surface —
         # the per-series truth lives in fleet_admission_total)
         self.tallies: Dict[str, int] = {}
@@ -162,34 +178,67 @@ class AdmissionController:
         )
         return bucket
 
+    def tier_for(self, tenant_id: str) -> Optional[TierSpec]:
+        return self.tiers.tier_for(tenant_id) if self.tiers else None
+
+    def _tier_bucket(self, tier: TierSpec) -> TokenBucket:
+        bucket = self._tier_buckets.get(tier.name)
+        if bucket is None:
+            bucket = self._tier_buckets[tier.name] = TokenBucket(
+                tier.qps, tier.burst if tier.burst > 0 else max(tier.qps, 1.0)
+            )
+        return bucket
+
     def admit(
         self, tenant_id: str, queue_depth: int, now: float,
-        draining: bool = False,
+        draining: bool = False, tier_depth: int = 0,
     ) -> AdmissionVerdict:
         """Judge one submit (caller holds the queue lock). Order matters
         and is part of the contract: drain first (an over-quota tenant
         hitting a draining sidecar must hear "go elsewhere", not "slow
-        down"), then queue depth (global protection beats per-tenant
-        fairness), then quota."""
+        down"), then queue depth — global bound, then the tier's
+        queue-share slice (``tier_depth`` = this tier's queued count) —
+        then quota (the tier's shared bucket when tiers are configured,
+        else the global per-tenant bucket)."""
+        tier = self.tier_for(tenant_id)
+        label = tier.name if tier is not None else ""
         if draining:
-            return self._tally(AdmissionVerdict(SHED_DRAINING))
-        if self.max_queue_depth > 0 and queue_depth >= self.max_queue_depth:
-            # the queue will not shrink before the next flush window at
-            # the earliest — that is the honest retry hint
-            return self._tally(
-                AdmissionVerdict(SHED_QUEUE_FULL, max(self.window_s, 1e-3))
-            )
-        if self.tenant_qps > 0:
+            return self._tally(AdmissionVerdict(SHED_DRAINING, tier=label))
+        if self.max_queue_depth > 0:
+            if queue_depth >= self.max_queue_depth:
+                # the queue will not shrink before the next flush window
+                # at the earliest — that is the honest retry hint
+                return self._tally(AdmissionVerdict(
+                    SHED_QUEUE_FULL, max(self.window_s, 1e-3), tier=label,
+                ))
+            if tier is not None and tier.queue_share < 1.0:
+                share = max(1, int(tier.queue_share * self.max_queue_depth))
+                if tier_depth >= share:
+                    return self._tally(AdmissionVerdict(
+                        SHED_QUEUE_FULL, max(self.window_s, 1e-3),
+                        tier=label,
+                    ))
+        if tier is not None:
+            if tier.qps > 0:
+                wait = self._tier_bucket(tier).try_take(now)
+                if wait > 0.0:
+                    return self._tally(
+                        AdmissionVerdict(SHED_QUOTA, wait, tier=label)
+                    )
+        elif self.tenant_qps > 0:
             wait = self._bucket_for(tenant_id).try_take(now)
             if wait > 0.0:
                 return self._tally(AdmissionVerdict(SHED_QUOTA, wait))
-        return self._tally(AdmissionVerdict(ADMIT_OK))
+        return self._tally(AdmissionVerdict(ADMIT_OK, tier=label))
 
-    def admit_expired(self) -> AdmissionVerdict:
+    def admit_expired(self, tenant_id: str = "") -> AdmissionVerdict:
         """A request whose deadline budget was already spent at submit:
         shed typed (DEADLINE_EXCEEDED) — queueing it would burn a batch
         slot on an answer nobody can receive in time."""
-        return self._tally(AdmissionVerdict(SHED_DEADLINE))
+        tier = self.tier_for(tenant_id)
+        return self._tally(AdmissionVerdict(
+            SHED_DEADLINE, tier=tier.name if tier is not None else "",
+        ))
 
     def _tally(self, verdict: AdmissionVerdict) -> AdmissionVerdict:
         self.tallies[verdict.outcome] = self.tallies.get(verdict.outcome, 0) + 1

@@ -51,6 +51,7 @@ from autoscaler_tpu.fleet.buckets import (
     parse_buckets,
     select_bucket,
 )
+from autoscaler_tpu.fleet.tiers import parse_tiers
 from autoscaler_tpu.fleet.errors import (
     SHED_DEADLINE,
     SHED_DRAINING,
@@ -158,6 +159,9 @@ class FleetTicket:
         # origin trace context (copied from the request at submit) — the
         # span-link + exemplar identity of this ticket
         self.trace_context: str = ""
+        # quota tier of the submitting tenant ("" when tiers are off) —
+        # the tier label on the lifecycle SLI series and ledger rows
+        self.tier: str = ""
         # absolute expiry instant on the COALESCER's injected clock (seated
         # by submit from FleetRequest.deadline_s; None = no deadline) —
         # flush/_dispatch_batch shed past-deadline tickets typed instead of
@@ -245,6 +249,7 @@ class FleetCoalescer:
         max_queue_depth: int = 0,
         tenant_qps: float = 0.0,
         tenant_burst: float = 0.0,
+        tenant_tiers: str = "",
         latency_hook: Optional[Callable[[str], float]] = None,
     ) -> None:
         if batch_scenarios < 1:
@@ -282,6 +287,14 @@ class FleetCoalescer:
         # tenant id → metric label, insertion-ordered admission (GL004:
         # written only under the queue lock)
         self._tenant_labels: Dict[str, str] = {}
+        # tenant quota tiers (--fleet-tenant-tiers JSON → TierPolicy;
+        # None = tiers off, the global per-tenant quota stands). Tiers
+        # supersede tenant_qps: per-tier shared buckets, queue-share
+        # slices, default deadlines, and tier-priority flush ordering.
+        self.tiers = parse_tiers(tenant_tiers)
+        # per-tier queued counts (GL004: mutated only under the queue
+        # lock, always in step with _pending) — the queue-share input
+        self._tier_pending: Dict[str, int] = {}
         # deadline-aware admission: queue-depth bound + per-tenant token
         # buckets on the injected clock (fleet/admission.py; all state
         # mutated under the queue lock). Defaults keep both gates off.
@@ -293,6 +306,7 @@ class FleetCoalescer:
             # same bound AND same semantics as the metric-label guard:
             # 0 = unbounded (every tenant gets its own quota bucket)
             max_tenants=self.max_tenant_labels,
+            tiers=self.tiers,
         )
         # chaos seam (loadgen rpc_slow): tenant_id → extra service seconds
         # folded into the demux/resolve timeline stamps — simulated RPC
@@ -312,6 +326,7 @@ class FleetCoalescer:
             max_queue_depth=options.fleet_max_queue_depth,
             tenant_qps=options.fleet_tenant_qps,
             tenant_burst=options.fleet_tenant_burst,
+            tenant_tiers=options.fleet_tenant_tiers,
             **kwargs,
         )
         if options.fleet_prewarm:
@@ -361,6 +376,16 @@ class FleetCoalescer:
         ticket.stamp_clock = trace.timeline_clock() or self._clock
         ticket.t_submit = ticket.stamp_clock()
         ticket.submitted_wall = time.perf_counter()
+        tier = (
+            self.tiers.tier_for(request.tenant_id)
+            if self.tiers is not None else None
+        )
+        if tier is not None:
+            ticket.tier = tier.name
+            if request.deadline_s is None and tier.default_deadline_s > 0:
+                # the tier's latency contract binds even clients that
+                # submitted without a budget of their own
+                request.deadline_s = tier.default_deadline_s
         now = self._clock()
         if request.deadline_s is not None:
             ticket.deadline_ts = now + max(float(request.deadline_s), 0.0)
@@ -370,15 +395,23 @@ class FleetCoalescer:
                 # drain/depth/quota gates — a request nobody can answer in
                 # time must not burn a quota token or count twice in the
                 # admission tallies
-                verdict = self.admission.admit_expired()
+                verdict = self.admission.admit_expired(request.tenant_id)
             else:
                 verdict = self.admission.admit(
                     request.tenant_id, len(self._pending), now,
                     draining=self._draining,
+                    tier_depth=(
+                        self._tier_pending.get(tier.name, 0)
+                        if tier is not None else 0
+                    ),
                 )
             tenant = self._tenant_label_locked(request.tenant_id)
             if verdict.admitted:
                 self._pending.append((request, ticket))
+                if tier is not None:
+                    self._tier_pending[tier.name] = (
+                        self._tier_pending.get(tier.name, 0) + 1
+                    )
                 if self.metrics is not None:
                     # published under the queue lock so a concurrent
                     # flush() can't interleave its set(0) with a stale
@@ -390,9 +423,13 @@ class FleetCoalescer:
                     )
                 self._cond.notify()
         if self.metrics is not None:
-            self.metrics.fleet_admission_total.inc(
-                outcome=verdict.outcome, tenant=tenant
-            )
+            # the tier label only exists when a tier policy is configured
+            # (tier names are a closed small set — the cardinality bound
+            # stands); tierless deployments keep the PR-14 series shape
+            labels = dict(outcome=verdict.outcome, tenant=tenant)
+            if self.tiers is not None:
+                labels["tier"] = verdict.tier
+            self.metrics.fleet_admission_total.inc(**labels)
         if not verdict.admitted:
             raise self._shed_error(verdict, request.tenant_id)
         ticket.t_admit = ticket.stamp_clock()
@@ -446,6 +483,13 @@ class FleetCoalescer:
     def tenant_label(self, tenant_id: str) -> str:
         with self._lock:
             return self._tenant_label_locked(tenant_id)
+
+    def tier_name(self, tenant_id: str) -> str:
+        """The tenant's quota tier ("" when tiers are off) — ledger rows
+        and reports key sheds on it."""
+        if self.tiers is None:
+            return ""
+        return self.tiers.tier_for(tenant_id).name
 
     def admission_snapshot(self) -> Dict[str, int]:
         """Lifetime admission-outcome tallies, read under the queue lock
@@ -546,15 +590,36 @@ class FleetCoalescer:
         it replays byte-identically. ``limit`` bounds how many live
         requests this flush serves (submission order; the rest stay
         queued) — the overload bench uses it to model a service slower
-        than its arrival rate; production flushes pass None."""
+        than its arrival rate; production flushes pass None.
+
+        With tiers configured the live queue is served in
+        (tier shed_priority, submission order): gold dispatches first and
+        under bounded capacity the bronze tail is what stays queued (and
+        eventually expires) — "shed order under queue pressure prefers low
+        tiers". The sort key is a pure function of submission order plus
+        the static tier table, so replays stay byte-identical."""
         now = self._clock()
         with self._lock:
             live, expired = partition_expired(self._pending, now)
+            if self.tiers is not None and len(live) > 1:
+                # sorted() is stable: within a tier, submission order holds
+                live = sorted(
+                    live,
+                    key=lambda rt: self.tiers.tier_for(
+                        rt[0].tenant_id
+                    ).shed_priority,
+                )
             if limit is not None and limit < len(live):
                 drained, rest = live[:limit], live[limit:]
             else:
                 drained, rest = live, []
             self._pending = rest
+            if self.tiers is not None:
+                counts: Dict[str, int] = {}
+                for req, _ in rest:
+                    name = self.tiers.tier_for(req.tenant_id).name
+                    counts[name] = counts.get(name, 0) + 1
+                self._tier_pending = counts
             if self.metrics is not None:
                 self.metrics.fleet_queue_depth.set(float(len(rest)))
         for req, ticket in expired:
@@ -752,6 +817,9 @@ class FleetCoalescer:
         if self.metrics is not None:
             tenant = self.tenant_label(req.tenant_id)
             parsed = trace.parse_context(ticket.trace_context)
+            # quota-tier label only when a policy is configured (closed
+            # small vocabulary — the SLI cardinality bound stands)
+            extra = {"tier": ticket.tier} if self.tiers is not None else {}
             rows = (
                 (self.metrics.fleet_queue_wait_seconds, queue_wait),
                 (self.metrics.fleet_service_seconds, service),
@@ -759,11 +827,13 @@ class FleetCoalescer:
             )
             for series, value in rows:
                 if parsed is None:
-                    series.observe(value, tenant=tenant, bucket=bucket.key)
+                    series.observe(
+                        value, tenant=tenant, bucket=bucket.key, **extra
+                    )
                 else:
                     series.observe_with_exemplar(
                         value, str(parsed[0]), tenant=tenant,
-                        bucket=bucket.key,
+                        bucket=bucket.key, **extra,
                     )
         if self.slo is not None:
             # latency judged from the timeline stamps; the event timestamp

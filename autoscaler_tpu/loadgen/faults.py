@@ -85,6 +85,24 @@ class FaultInjector:
     def _note(self, kind: str) -> None:
         self.injected[kind] = self.injected.get(kind, 0) + 1
 
+    def _first_firing(self, kinds, match=None) -> Optional[str]:
+        """First fault of ``kinds`` (kind-major over static+armed, the
+        shared iteration order every injection point uses) that is active
+        this tick, passes ``match``, and wins its probability draw. The
+        draw order is part of the byte-identical-replay contract: one rng
+        draw per matching sub-1.0-probability fault, in this exact
+        sequence."""
+        for kind in kinds:
+            for f in self._static + self._armed:
+                if f.kind != kind or not f.active(self.tick):
+                    continue
+                if match is not None and not match(f):
+                    continue
+                if f.probability >= 1.0 or self._rng.random() < f.probability:
+                    self._note(kind)
+                    return kind
+        return None
+
     # -- injection points ----------------------------------------------------
     def on_refresh(self) -> None:
         self._latency("")
@@ -141,16 +159,12 @@ class FaultInjector:
         degradation target and always survive."""
         if rung not in ("pallas", "xla"):
             return None
-        for kind in ("device_lost", "kernel_fault"):
-            for f in self._static + self._armed:
-                if f.kind != kind or not f.active(self.tick):
-                    continue
-                if kind == "kernel_fault" and f.rung and f.rung != rung:
-                    continue
-                if f.probability >= 1.0 or self._rng.random() < f.probability:
-                    self._note(kind)
-                    return kind
-        return None
+        return self._first_firing(
+            ("device_lost", "kernel_fault"),
+            match=lambda f: (
+                f.kind != "kernel_fault" or not f.rung or f.rung == rung
+            ),
+        )
 
     def on_fleet_submit(self) -> Optional[str]:
         """Process-level fleet chaos seam (loadgen/fleetdrive.py consults
@@ -164,6 +178,22 @@ class FaultInjector:
                 self._note(kind)
                 return kind
         return None
+
+    def on_replica(self, replica: int) -> Optional[str]:
+        """Multi-replica fleet chaos seam (the fleet driver's router
+        consults it per routing attempt): is replica ``replica`` down
+        RIGHT NOW? ``replica_restart`` downs its target for the whole
+        active window (a rolling pod kill); ``endpoint_flap`` downs it
+        per-consultation with the fault's ``probability`` on the seeded
+        RNG (a flapping endpoint). Returns the fault kind or None.
+
+        Consultation order is the router's deterministic attempt order,
+        so the RNG stream — and therefore every flap verdict — replays
+        byte-identically."""
+        return self._first_firing(
+            ("replica_restart", "endpoint_flap"),
+            match=lambda f: f.replica == replica,
+        )
 
     def on_rpc_dispatch(self, tenant: str) -> float:
         """``rpc_slow`` seam (the coalescer's latency_hook): sim-clock

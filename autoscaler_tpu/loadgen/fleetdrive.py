@@ -48,7 +48,16 @@ from autoscaler_tpu.trace import FlightRecorder, Tracer
 # /2 added the overload-armor fields: per-round `shed` rows (typed
 # admission/chaos rejections with retry-after) and the `outcomes` tally
 # (the zero-hung-tickets audit's per-round ledger witness).
-FLEET_SCHEMA = "autoscaler_tpu.fleet.round/2"
+# /3 added the fleet-HA columns: per-verdict `endpoint` (the balancer's
+# replica choice — the endpoint-choice column hack/verify.sh byte-diffs
+# across replays) + `failovers`, and the quota `tier` on verdict and shed
+# rows.
+FLEET_SCHEMA = "autoscaler_tpu.fleet.round/3"
+
+# deterministic synthetic per-route service latency fed into the balancer
+# EWMA on a successful route (seconds; health differentiation comes from
+# the error inputs — failures and streaks — not latency spread)
+ROUTE_LATENCY_S = 0.004
 
 
 @dataclass
@@ -67,6 +76,12 @@ class FleetTenantVerdict:
     verdict_sha256: str
     match_solo: bool
     best_group: int = -1
+    # fleet HA (/3): which replica endpoint the balancer routed this
+    # request to, how many dead replicas it failed over past first, and
+    # the tenant's quota tier ("" when tiers are off)
+    endpoint: str = ""
+    failovers: int = 0
+    tier: str = ""
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -278,6 +293,10 @@ class FleetScenarioDriver:
             max_queue_depth=self.options.fleet_max_queue_depth,
             tenant_qps=self.options.fleet_tenant_qps,
             tenant_burst=self.options.fleet_tenant_burst,
+            # tenant quota tiers (fleet/tiers.py): per-tier buckets,
+            # queue-share slices, default deadlines, and tier-priority
+            # shed order — all judged on the same injected sim clock
+            tenant_tiers=self.options.fleet_tenant_tiers,
             # chaos seam: rpc_slow folds sim-clock latency into the
             # ticket service stamps at demux
             latency_hook=self.injector.on_rpc_dispatch,
@@ -292,6 +311,57 @@ class FleetScenarioDriver:
         self.coalescer.ladder.fault_hook = self.injector.on_kernel_dispatch
         self.prewarmed: List[str] = []
         self._unresolved = 0
+        # -- fleet HA (ISSUE 15): the serving side modeled as N replica
+        # endpoints behind the health-weighted balancer. Every request is
+        # routed to a balancer-picked replica first (replica_restart /
+        # endpoint_flap faults down individual replicas); the chosen
+        # endpoint rides the decision ledger. Clock is the sim clock and
+        # the rng is scenario-seeded, so the pick sequence — and the
+        # ledger's endpoint-choice column — replays byte-identically.
+        from autoscaler_tpu.fleet.balance import EndpointBalancer
+
+        self.replicas = [f"replica-{i}" for i in range(spec.fleet.replicas)]
+        bal_rng = np.random.default_rng((spec.seed, 3571))
+        self.balancer = EndpointBalancer(
+            self.replicas,
+            clock=lambda: self._sim_now,
+            rng=lambda: float(bal_rng.random()),
+            # cooldown in sim seconds: a killed replica earns one probe
+            # per elapsed tick interval once its restart window passes
+            eject_cooldown_s=spec.tick_interval_s,
+        )
+
+    def _route(self) -> Tuple[str, int, Optional[str]]:
+        """The client model: route one request to a live replica via the
+        health-weighted balancer — pick, consult the replica's fault
+        state, fail over (excluding endpoints already tried) up to the
+        replica count. → (endpoint, failovers, outage_kind): a successful
+        route returns its endpoint (outage_kind None); a full outage
+        returns ("", tried, kind).
+
+        Every consultation and every pick is one deterministic step on
+        the seeded seams, so two replays route every request identically
+        — the balancer-determinism certificate."""
+        tried: List[str] = []
+        outage_kind: Optional[str] = None
+        for _ in range(len(self.replicas)):
+            endpoint = self.balancer.pick(exclude=tried)
+            if endpoint is None:
+                break
+            kind = self.injector.on_replica(self.replicas.index(endpoint))
+            if kind is None:
+                self.balancer.record_success(endpoint, ROUTE_LATENCY_S)
+                self.metrics.fleet_endpoint_picks_total.inc(
+                    endpoint=endpoint, outcome="ok"
+                )
+                return endpoint, len(tried), None
+            outage_kind = kind
+            self.balancer.record_failure(endpoint, unavailable=True)
+            self.metrics.fleet_endpoint_picks_total.inc(
+                endpoint=endpoint, outcome=kind
+            )
+            tried.append(endpoint)
+        return "", len(tried), outage_kind or "replica_restart"
 
     def run(self) -> FleetRunResult:
         spec = self.spec
@@ -349,7 +419,9 @@ class FleetScenarioDriver:
                 # co-batched tickets (one batch, many origins — the RPC
                 # path gets the same shape from each client's rpcCall span)
                 submitted = []
+                routes: Dict[int, Tuple[str, int]] = {}
                 for r in requests:
+                    tier = self.coalescer.tier_name(r.tenant_id)
                     # process-level chaos seam: an active sidecar_crash /
                     # sidecar_partition makes the submit fail typed
                     # unavailable — the client saw a dead endpoint. That
@@ -362,6 +434,24 @@ class FleetScenarioDriver:
                             "reason": kind,
                             "error": "FleetUnavailableError",
                             "retry_after_s": 0.0,
+                            "tier": tier,
+                        })
+                        outcomes["shed"] += 1
+                        self.slo.observe_event(SLI_FLEET_E2E, bad=True,
+                                               now=now)
+                        continue
+                    # fleet HA: route to a balancer-picked live replica
+                    # first; a rolling restart fails over, a FULL outage
+                    # (every replica down) sheds unavailable — with >= 2
+                    # replicas a single restart must be a non-event
+                    endpoint, failovers, outage = self._route()
+                    if outage is not None:
+                        rec.shed.append({
+                            "tenant": r.tenant_id,
+                            "reason": outage,
+                            "error": "FleetUnavailableError",
+                            "retry_after_s": 0.0,
+                            "tier": tier,
                         })
                         outcomes["shed"] += 1
                         self.slo.observe_event(SLI_FLEET_E2E, bad=True,
@@ -371,7 +461,9 @@ class FleetScenarioDriver:
                         with trace.span(
                             metrics_mod.FLEET_SUBMIT, tenant=r.tenant_id
                         ):
-                            submitted.append((r, self.coalescer.submit(r)))
+                            ticket = self.coalescer.submit(r)
+                        routes[id(ticket)] = (endpoint, failovers)
+                        submitted.append((r, ticket))
                     except FleetAdmissionError as e:
                         # typed backpressure (queue full / quota /
                         # deadline-at-admission): the system working as
@@ -383,12 +475,15 @@ class FleetScenarioDriver:
                             "reason": e.outcome,
                             "error": type(e).__name__,
                             "retry_after_s": round(e.retry_after_s, 6),
+                            "tier": tier,
                         })
                         outcomes["shed"] += 1
                 self.coalescer.flush()
                 for req, ticket in submitted:
                     try:
-                        answered.append((req, ticket.result(timeout=0.0)))
+                        answered.append(
+                            (req, ticket, ticket.result(timeout=0.0))
+                        )
                         outcomes["resolved"] += 1
                     except TimeoutError:
                         # a ticket the flush did not terminate: the hang
@@ -407,6 +502,7 @@ class FleetScenarioDriver:
                             "reason": e.outcome,
                             "error": type(e).__name__,
                             "retry_after_s": round(e.retry_after_s, 6),
+                            "tier": self.coalescer.tier_name(req.tenant_id),
                         })
                         outcomes["expired"] += 1
                     except Exception as e:  # noqa: BLE001 — a failed batch
@@ -441,8 +537,12 @@ class FleetScenarioDriver:
             # the fairness certificate (solo dispatches) runs OUTSIDE the
             # timed window and outside the perf tick
             self.observatory.end_tick()
-            for req, answer in answered:
-                rec.tenants.append(self._certify(req, answer))
+            for req, ticket, answer in answered:
+                endpoint, failovers = routes.get(id(ticket), ("", 0))
+                rec.tenants.append(self._certify(
+                    req, answer, endpoint=endpoint, failovers=failovers,
+                    tier=self.coalescer.tier_name(req.tenant_id),
+                ))
             rec.errors.sort()
             rec.degraded = sorted(self.coalescer.degraded())
             records.append(rec)
@@ -462,11 +562,14 @@ class FleetScenarioDriver:
         )
 
     @staticmethod
-    def _certify(req, answer) -> FleetTenantVerdict:
+    def _certify(
+        req, answer, endpoint: str = "", failovers: int = 0, tier: str = "",
+    ) -> FleetTenantVerdict:
         """The fairness certificate for one answer: byte-compare against a
         solo dispatch of the SAME operands (caps clamped by the tenant's
         own max_nodes on both sides — the semantics the bucket carry
-        reproduces)."""
+        reproduces). ``endpoint``/``failovers``/``tier`` are the HA
+        provenance columns the balancer-determinism gate byte-diffs."""
         from autoscaler_tpu.parallel.mesh import fleet_solo_estimate
 
         solo_counts, solo_sched = fleet_solo_estimate(
@@ -492,6 +595,9 @@ class FleetScenarioDriver:
             verdict_sha256=hashlib.sha256(fleet_bytes).hexdigest(),
             match_solo=fleet_bytes == solo_bytes,
             best_group=answer.best_group,
+            endpoint=endpoint,
+            failovers=failovers,
+            tier=tier,
         )
 
 

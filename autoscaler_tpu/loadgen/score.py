@@ -367,6 +367,28 @@ def build_fleet_report(result) -> Dict[str, Any]:
         "admission": dict(sorted(getattr(result, "admission", {}).items())),
         "unresolved": int(getattr(result, "unresolved", 0)),
     }
+    # fleet-HA columns: where the balancer actually sent traffic, how
+    # often it had to fail over past a dead replica, and the typed sheds
+    # broken out by quota tier (the "bronze sheds first, gold stays in
+    # SLO" evidence hack/verify.sh's rolling-restart gate reads)
+    endpoint_counts: Dict[str, int] = {}
+    failovers_total = 0
+    sheds_by_tier: Dict[str, int] = {}
+    for r in result.records:
+        for v in r.tenants:
+            if v.endpoint:
+                endpoint_counts[v.endpoint] = (
+                    endpoint_counts.get(v.endpoint, 0) + 1
+                )
+            failovers_total += v.failovers
+        for row in r.shed:
+            tier = row.get("tier", "")
+            sheds_by_tier[tier] = sheds_by_tier.get(tier, 0) + 1
+    report["ha"] = {
+        "endpoint_requests": dict(sorted(endpoint_counts.items())),
+        "failovers_total": failovers_total,
+        "sheds_by_tier": dict(sorted(sheds_by_tier.items())),
+    }
     perf = _perf_section(result)
     if perf:
         report["perf"] = perf

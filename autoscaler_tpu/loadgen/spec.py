@@ -102,6 +102,18 @@ FAULT_KINDS = (
     # ticket's service stamps — slow answers reach the SLIs/SLO exactly
     # as real slowness would
     "rpc_slow",
+    # -- multi-replica fleet chaos (ISSUE 15): consumed by the fleet
+    # driver's replica router (balancer-picked endpoint per request) so
+    # rolling restarts and flapping endpoints certify the health-weighted
+    # rebalancing byte-identically on the sim clock --
+    # replica `replica` is DOWN for the window (a rolling restart / pod
+    # kill): requests routed there fail unavailable and the balancer
+    # fails over; with >= 2 replicas a restart must be a non-event
+    "replica_restart",
+    # replica `replica` flaps: each consultation is down with
+    # `probability` (seeded RNG) — the flapping-endpoint case the
+    # health-weighted picker exists to starve of first-attempt traffic
+    "endpoint_flap",
 )
 # estimator rungs a kernel_fault may target ("" = every device rung)
 KERNEL_FAULT_RUNGS = ("", "pallas", "xla")
@@ -145,6 +157,9 @@ class FaultSpec:
     error_class: str = "OTHER"      # instance_error: OUT_OF_RESOURCES|QUOTA_EXCEEDED|OTHER
     # kernel_fault: which estimator rung fails ("" = both device rungs)
     rung: str = ""
+    # replica_restart / endpoint_flap: which fleet replica index the fault
+    # targets (required >= 0 for those kinds; -1 = not a replica fault)
+    replica: int = -1
     message: str = "injected fault"
 
     def __post_init__(self):
@@ -156,9 +171,21 @@ class FaultSpec:
             raise SpecError(
                 f"fault field 'rung' only applies to kernel_fault, not {self.kind!r}"
             )
+        if self.kind in ("replica_restart", "endpoint_flap"):
+            if self.replica < 0:
+                raise SpecError(
+                    f"fault kind {self.kind!r} needs a target `replica` "
+                    "index >= 0 (which endpoint restarts/flaps)"
+                )
+        elif self.replica != -1:
+            raise SpecError(
+                "fault field 'replica' only applies to "
+                f"replica_restart/endpoint_flap, not {self.kind!r}"
+            )
         if self.group and self.kind in (
             "kernel_fault", "device_lost", "kube_api_error", "arena_fault",
             "sidecar_crash", "sidecar_partition", "rpc_slow",
+            "replica_restart", "endpoint_flap",
         ):
             # these faults hit process-wide seams (the kernel ladder, the
             # cluster listing) — a group scope would be silently ignored
@@ -279,9 +306,16 @@ class FleetSpec:
     every fleet answer byte-identical to a solo dispatch of the same
     operands (loadgen/fleetdrive.py). Faults ride the scenario's normal
     fault list — a ``kernel_fault`` on the ``xla`` rung hits the fleet
-    ladder's batched rung."""
+    ladder's batched rung.
+
+    ``replicas`` models the serving side as N sidecar endpoints behind
+    the health-weighted balancer (ISSUE 15): each request is routed to a
+    balancer-picked replica first, ``replica_restart``/``endpoint_flap``
+    faults take individual replicas down, and the chosen endpoint rides
+    the decision ledger so rebalancing replays byte-identically."""
 
     tenants: List[TenantSpec] = field(default_factory=list)
+    replicas: int = 1
 
     def __post_init__(self):
         if not self.tenants:
@@ -289,6 +323,10 @@ class FleetSpec:
         names = [t.name for t in self.tenants]
         if len(set(names)) != len(names):
             raise SpecError(f"duplicate tenant names in {names}")
+        if self.replicas < 1:
+            raise SpecError(
+                f"fleet replicas must be >= 1, got {self.replicas}"
+            )
 
 
 @dataclass
@@ -334,6 +372,34 @@ class ScenarioSpec:
                 f"events at ticks {late} never fire: the run ends at tick "
                 f"{self.ticks - 1} (raise `ticks` or move the events)"
             )
+        # replica faults must name a replica that exists — an out-of-range
+        # index would be silently inert and let a chaos gate pass without
+        # ever exercising failover (the same fail-loudly stance every
+        # other misapplied fault field gets)
+        replica_faults = [
+            f for f in self.faults
+            if f.kind in ("replica_restart", "endpoint_flap")
+        ] + [
+            e.fault for e in self.events
+            if e.fault is not None
+            and e.fault.kind in ("replica_restart", "endpoint_flap")
+        ]
+        if replica_faults:
+            if self.fleet is None:
+                raise SpecError(
+                    "replica_restart/endpoint_flap faults need a `fleet` "
+                    "section (they target fleet replicas)"
+                )
+            bad = sorted({
+                f.replica for f in replica_faults
+                if f.replica >= self.fleet.replicas
+            })
+            if bad:
+                raise SpecError(
+                    f"replica fault targets {bad} are out of range: the "
+                    f"fleet has {self.fleet.replicas} replicas "
+                    f"(indices 0..{self.fleet.replicas - 1})"
+                )
 
     # -- JSON round-trip -----------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:
@@ -360,8 +426,14 @@ class ScenarioSpec:
                 raise SpecError(
                     f"fleet section must be an object, got {type(fleet)}"
                 )
+            unknown_fleet = set(fleet) - {"tenants", "replicas"}
+            if unknown_fleet:
+                raise SpecError(
+                    f"unknown fleet fields {sorted(unknown_fleet)}"
+                )
             kw["fleet"] = FleetSpec(
-                tenants=[_load(TenantSpec, t) for t in fleet.get("tenants", [])]
+                tenants=[_load(TenantSpec, t) for t in fleet.get("tenants", [])],
+                replicas=int(fleet.get("replicas", 1)),
             )
         return cls(**kw)
 

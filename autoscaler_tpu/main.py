@@ -296,6 +296,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--fleet-tenant-burst", type=float, default=0.0,
                    help="fleet overload armor: token-bucket burst "
                         "capacity (0 = max(qps, 1))")
+    p.add_argument("--fleet-tenant-tiers", default="",
+                   help="tenant quota tiers, JSON tier name -> {qps, "
+                        "burst, queue_share, default_deadline_s, "
+                        "shed_priority, tenants} incl. a 'default' "
+                        "catch-all; supersedes --fleet-tenant-qps with "
+                        "per-tier budgets and tier-priority shed order")
     p.add_argument("--fleet-drain-grace-s", type=float, default=5.0,
                    help="sidecar drain: grace server.stop() allows "
                         "in-flight RPCs after admission closed and the "
@@ -443,6 +449,7 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         fleet_max_queue_depth=args.fleet_max_queue_depth,
         fleet_tenant_qps=args.fleet_tenant_qps,
         fleet_tenant_burst=args.fleet_tenant_burst,
+        fleet_tenant_tiers=args.fleet_tenant_tiers,
         fleet_drain_grace_s=args.fleet_drain_grace_s,
         rpc_addresses=list(args.rpc_address),
         rpc_hedge=args.rpc_hedge,

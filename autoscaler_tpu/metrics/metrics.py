@@ -619,7 +619,10 @@ class AutoscalerMetrics:
         self.fleet_admission_total = r.counter(
             p + "fleet_admission_total",
             "fleet admission verdicts by outcome (admitted|shed_queue_full"
-            "|shed_quota|shed_draining|shed_deadline) and tenant",
+            "|shed_quota|shed_draining|shed_deadline) and tenant; carries "
+            "a quota-tier label when --fleet-tenant-tiers is configured "
+            "(tier names are a closed small set — inside the cardinality "
+            "bound)",
         )
         self.fleet_ticket_outcomes_total = r.counter(
             p + "fleet_ticket_outcomes_total",
@@ -632,11 +635,22 @@ class AutoscalerMetrics:
             "1 while the fleet coalescer is draining (admission closed, "
             "readiness bit down, in-flight buckets flushing)",
         )
+        self.fleet_endpoint_picks_total = r.counter(
+            p + "fleet_endpoint_picks_total",
+            "health-weighted balancer routing attempts by endpoint and "
+            "outcome (ok|replica_restart|endpoint_flap) — the fleet-HA "
+            "rebalancing evidence (a restarting replica's ok count must "
+            "flatline while its peers absorb the traffic)",
+        )
         # -- fleet request-lifecycle SLIs (autoscaler_tpu/fleet + slo): the
         # per-ticket queue/service decomposition on the tracer timeline
         # seam. tenant label cardinality is bounded by the coalescer
         # (--fleet-max-tenant-labels → __overflow__); tail buckets carry
         # OpenMetrics exemplars pairing the observation to its trace id.
+        # With --fleet-tenant-tiers configured each series additionally
+        # carries the quota-tier label (closed small vocabulary — the
+        # cardinality bound stands): per-tier latency IS the tier SLO
+        # surface.
         self.fleet_queue_wait_seconds = r.histogram(
             p + "fleet_queue_wait_seconds",
             "fleet ticket admission→dispatch wait (coalescing window + "

@@ -65,6 +65,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "0 = no quotas")
     p.add_argument("--fleet-tenant-burst", type=float, default=0.0,
                    help="token-bucket burst capacity; 0 = max(qps, 1)")
+    p.add_argument("--fleet-tenant-tiers", default="",
+                   help="tenant quota tiers, JSON tier name -> {qps, "
+                        "burst, queue_share, default_deadline_s, "
+                        "shed_priority, tenants} incl. a 'default' "
+                        "catch-all; supersedes --fleet-tenant-qps")
     p.add_argument("--fleet-drain-grace-s", type=float, default=5.0,
                    help="how long server.stop() waits for in-flight RPCs "
                         "after the drain sequence flushed the coalescer")
@@ -92,6 +97,7 @@ def main(argv=None) -> int:
         fleet_max_queue_depth=args.fleet_max_queue_depth,
         fleet_tenant_qps=args.fleet_tenant_qps,
         fleet_tenant_burst=args.fleet_tenant_burst,
+        fleet_tenant_tiers=args.fleet_tenant_tiers,
         fleet_drain_grace_s=args.fleet_drain_grace_s,
     )
     drain = DrainState()

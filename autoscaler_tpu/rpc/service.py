@@ -616,20 +616,30 @@ def drain_server(server, fleet=None, drain=None, grace_s: float = 5.0) -> None:
 
 
 class TpuSimulationClient:
-    """Host-side stub with endpoint failover, typed-status retry scoping,
-    and optional hedging.
+    """Host-side stub with health-weighted endpoint balancing, typed-status
+    retry scoping, and optional hedging.
 
     ``target`` names one endpoint or several (comma-separated string or a
-    sequence — the --rpc-address surface): on UNAVAILABLE the client fails
-    over to the next endpoint with jittered bounded backoff (RetryPolicy
-    semantics; a drain-detail UNAVAILABLE skips the backoff — the server
-    just said "go elsewhere NOW"). The resend scope is a closed matrix:
+    sequence — the --rpc-address surface). With several, every endpoint's
+    health is scored continuously (fleet/balance.EndpointBalancer: EWMA
+    latency, windowed error rate, consecutive-UNAVAILABLE streak,
+    drain-observed bit) and BOTH first attempts and failover/hedge targets
+    come from a power-of-two-choices pick over those scores with
+    breaker-style outlier ejection — a flapping replica stops eating
+    first-attempt traffic after a few failures instead of keeping its
+    static rotation slot. On UNAVAILABLE the client fails over to a picked
+    healthy endpoint with jittered bounded backoff (RetryPolicy semantics;
+    a drain-detail UNAVAILABLE skips the backoff — the server just said
+    "go elsewhere NOW"). The resend scope is a closed matrix:
 
     - UNAVAILABLE        → reconnect/fail over and resend, bounded
       (every RPC here is a pure function of its request);
     - RESOURCE_EXHAUSTED → honor the server's retry-after trailing
       metadata, at most once, never past the caller's deadline — a blind
-      resend is exactly the extra load a shedding server cannot absorb;
+      resend is exactly the extra load a shedding server cannot absorb.
+      The honored sleep carries bounded jitter from the injected rng seam:
+      co-shed tenants must NOT all retry at the same instant (a
+      synchronized herd straight back into admission);
     - DEADLINE_EXCEEDED  → NEVER resent: retrying a timed-out estimate
       doubles load exactly when the server is drowning;
     - anything else      → raised as-is.
@@ -642,8 +652,12 @@ class TpuSimulationClient:
     ``hedge=True`` additionally hedges the idempotent Estimate /
     BatchEstimate: when the primary hasn't answered after a p99-derived
     delay (learned from this client's own recent latencies), a second
-    attempt fires at the next endpoint; first answer wins, the loser is
-    cancelled. Off by default — hedging doubles worst-case load.
+    attempt fires at a balancer-picked HEALTHY endpoint; first answer
+    wins, the loser is cancelled. An endpoint that is ejected, draining,
+    or mid-UNAVAILABLE-streak is never hedged at — a hedge fired at a
+    draining sidecar burns deadline budget for a guaranteed UNAVAILABLE —
+    and when no healthy alternative exists the hedge is skipped entirely.
+    Off by default — hedging doubles worst-case load.
 
     ``clock``/``sleep``/``rng`` are injectable for tests; production
     callers take the wall defaults (the client is NOT on the replay path —
@@ -655,6 +669,10 @@ class TpuSimulationClient:
     HEDGED_METHODS = ("Estimate", "BatchEstimate")
     # floor used until enough latency samples exist to derive a p99
     HEDGE_MIN_DELAY_S = 0.05
+    # bounded jitter fraction on the honored retry-after sleep: the pause
+    # lands in [hint, hint * (1 + this)] so co-shed tenants desynchronize
+    # instead of herding back into admission at the same instant
+    RETRY_AFTER_JITTER = 0.25
 
     def __init__(
         self,
@@ -680,12 +698,26 @@ class TpuSimulationClient:
         ]
         if not targets:
             raise ValueError("TpuSimulationClient needs at least one endpoint")
+        # dedupe preserving order: the PR-14 static rotation tolerated a
+        # repeated --rpc-address (it just revisited the endpoint), and a
+        # duplicate must keep being a config wrinkle, not a startup crash
+        # (EndpointBalancer rejects duplicates — one health record per
+        # endpoint)
+        seen: set = set()
+        targets = [t for t in targets if not (t in seen or seen.add(t))]
         self._targets = targets
         self._active = 0
         self.default_timeout_s = default_timeout_s
         self.hedge = hedge
         self._clock = clock
         self._sleep = sleep
+        self._rng = rng
+        from autoscaler_tpu.fleet.balance import EndpointBalancer
+
+        # per-endpoint health scorer + P2C picker (ARCHITECTURE.md "Fleet
+        # HA"): first attempts, failover targets, and hedge legs all come
+        # from its picks; every call outcome feeds it back
+        self._balancer = EndpointBalancer(targets, clock=clock, rng=rng)
         from autoscaler_tpu.utils.http import RetryPolicy
 
         # the failover pacing: same jittered-bounded-exponential semantics
@@ -706,35 +738,51 @@ class TpuSimulationClient:
         # _retired): hedging reads it from worker context while a
         # failover rewrites it
         self._conn_lock = threading.Lock()
-        # channels replaced by a failover are RETIRED, not closed: another
-        # thread may have an RPC in flight on one, and closing it would
-        # turn that call into CANCELLED "Channel closed!" instead of its
-        # real status. The graveyard is bounded; close() empties it.
+        # channels replaced by an explicit _reconnect are RETIRED, not
+        # closed: another thread may have an RPC in flight on one, and
+        # closing it would turn that call into CANCELLED "Channel closed!"
+        # instead of its real status. The graveyard is bounded; close()
+        # empties it.
         self._retired: List[Any] = []
-        # long-lived per-target channels for hedge legs: the hedge fires
-        # exactly when latency matters, so it must not pay TCP+HTTP/2
-        # setup per call
-        self._hedge_channels: dict = {}
-        self._channel = grpc.insecure_channel(self._targets[0])
+        # ONE long-lived channel per target, shared by first attempts,
+        # failovers, and hedge legs. Failover SWITCHES channels instead of
+        # rebuilding them: gRPC channels self-heal when their endpoint
+        # returns, and rebuilding per failing thread made a thundering
+        # failover overflow the retire graveyard and close channels with
+        # live callers (their in-flight calls died CANCELLED instead of
+        # failing over — caught by the two-sidecar SIGKILL drill).
+        self._channels: dict = {}
+        self._channel = self._channel_for(self._targets[0])
 
     @property
     def _target(self) -> str:
         with self._conn_lock:
             return self._targets[self._active]
 
-    def _hedge_channel_for(self, target: str):
+    def endpoint_health(self) -> dict:
+        """Per-endpoint scorer snapshot (score, EWMA, error rate, streak,
+        drain bit, breaker state) — the observability surface the
+        two-sidecar drill asserts rebalancing on."""
+        return self._balancer.snapshot()
+
+    def _channel_for(self, target: str):
+        """The per-target channel cache (first attempts, failovers, and
+        hedge legs all draw from it): one long-lived channel per endpoint,
+        created lazily, never torn down by routine failover — no
+        connection setup on a latency-critical leg, no close racing a
+        live caller."""
         with self._conn_lock:
-            channel = self._hedge_channels.get(target)
+            channel = self._channels.get(target)
             if channel is None:
                 channel = grpc.insecure_channel(target)
-                self._hedge_channels[target] = channel
+                self._channels[target] = channel
             return channel
 
     def close(self) -> None:
         with self._conn_lock:
             channels = [self._channel] + self._retired
-            channels += list(self._hedge_channels.values())
-            self._hedge_channels = {}
+            channels += list(self._channels.values())
+            self._channels = {}
             self._retired = []
         for channel in channels:
             try:
@@ -743,6 +791,9 @@ class TpuSimulationClient:
                 pass
 
     def _reconnect(self) -> None:
+        """Rebuild the ACTIVE target's channel (the single-endpoint
+        reconnect-in-place path; multi-endpoint failover switches cached
+        channels instead and never calls this)."""
         with self._conn_lock:
             target = self._targets[self._active]
         fresh = grpc.insecure_channel(target)
@@ -750,6 +801,7 @@ class TpuSimulationClient:
         with self._conn_lock:
             self._retired.append(self._channel)
             self._channel = fresh
+            self._channels[target] = fresh
             # bound the graveyard: anything this deep has no live callers
             while len(self._retired) > 4:
                 doomed.append(self._retired.pop(0))
@@ -759,13 +811,51 @@ class TpuSimulationClient:
             except Exception:  # noqa: BLE001 — a dead channel may refuse
                 pass
 
-    def _failover(self) -> None:
-        """Advance to the next endpoint (wraps; a single-endpoint client
-        reconnects in place — the historical behavior) and rebuild the
-        channel."""
+    def _switch_to(self, target: str) -> None:
+        """Make ``target`` the active endpoint on its cached (self-
+        healing) channel — the failover/rebalance move. No channel is
+        rebuilt or closed, so the threads still blocked on the previous
+        endpoint keep their in-flight calls and surface REAL statuses."""
+        channel = self._channel_for(target)
         with self._conn_lock:
-            self._active = (self._active + 1) % len(self._targets)
-        self._reconnect()
+            self._active = self._targets.index(target)
+            self._channel = channel
+
+    def _failover(self, failed: Optional[str] = None) -> None:
+        """Move off ``failed`` (default: the current endpoint) to a
+        balancer-picked alternative on its cached channel. A
+        single-endpoint client (no alternative exists) reconnects in
+        place — the historical behavior. When the pick lands on the
+        ALREADY-active endpoint (a racing thread failed over first) this
+        is a no-op: rebuilding the healthy channel per failing thread
+        would churn the retire graveyard into closing channels that still
+        have live callers (their calls would die CANCELLED instead of
+        surfacing real statuses)."""
+        with self._conn_lock:
+            current = self._targets[self._active]
+        nxt = self._balancer.pick(exclude=(failed or current,))
+        if nxt is None:
+            self._reconnect()
+            return
+        if nxt != current:
+            self._switch_to(nxt)
+
+    def _ensure_primary(self) -> str:
+        """Health-weighted FIRST-attempt selection (the static-rotation
+        replacement): ask the balancer for today's best endpoint and
+        switch channels only when it differs from the active one.
+        Single-endpoint clients skip the pick entirely — there is nothing
+        to balance, and the seated channel (tests seat scripted ones)
+        must stay untouched. Returns the active target."""
+        if len(self._targets) == 1:
+            return self._targets[0]
+        target = self._balancer.pick()
+        with self._conn_lock:
+            current = self._targets[self._active]
+        if target is not None and target != current:
+            self._switch_to(target)
+            return target
+        return current
 
     def _note_latency(self, method: str, seconds: float) -> None:
         samples = self._latency.get(method)
@@ -861,8 +951,22 @@ class TpuSimulationClient:
             ):
                 request.trace_context = ctx
 
-            def send(budget: Optional[float]):
-                rpc = self._channel.unary_unary(
+            def send(send_target: str, budget: Optional[float]):
+                # the channel must be THIS attempt's target, not the
+                # shared active channel: a concurrent thread's failover
+                # can rewrite self._channel between the pick and the
+                # send, and then the balancer would charge this call's
+                # outcome to an endpoint it never talked to (ejecting a
+                # healthy survivor on a dead replica's UNAVAILABLE).
+                # Single-endpoint clients keep the seated channel — there
+                # is no attribution to get wrong, and tests seat scripted
+                # channels there.
+                if len(self._targets) == 1:
+                    with self._conn_lock:
+                        channel = self._channel
+                else:
+                    channel = self._channel_for(send_target)
+                rpc = channel.unary_unary(
                     f"/{SERVICE_NAME}/{method}",
                     request_serializer=lambda msg: msg.SerializeToString(),
                     response_deserializer=resp_cls.FromString,
@@ -875,27 +979,69 @@ class TpuSimulationClient:
 
             max_attempts = max(2, len(self._targets) + 1)
             quota_retried = False
+            hedging = (
+                self.hedge
+                and method in self.HEDGED_METHODS
+                and len(self._targets) > 1
+            )
             attempt = 0
             while True:
                 attempt += 1
                 # first attempt gets the caller's full deadline; every
-                # resend runs on what's LEFT of it
+                # resend runs on what's LEFT of it. The first attempt's
+                # TARGET is a balancer pick (health-weighted P2C, not a
+                # static rotation slot); resends run on whatever endpoint
+                # the failover picked.
+                if attempt == 1:
+                    target = self._ensure_primary()
+                else:
+                    with self._conn_lock:
+                        target = self._targets[self._active]
                 budget = timeout if attempt == 1 else remaining()
                 try:
-                    if (
-                        self.hedge
-                        and method in self.HEDGED_METHODS
-                        and len(self._targets) > 1
-                    ):
+                    if hedging:
                         return self._hedged_send(
-                            method, request, budget, metadata, resp_cls
+                            method, request, budget, metadata, resp_cls,
+                            target,
                         )
                     t0 = self._clock()
-                    resp = send(budget)
+                    resp = send(target, budget)
                     self._note_latency(method, self._clock() - t0)
+                    self._balancer.record_success(
+                        target, self._clock() - t0
+                    )
                     return resp
                 except grpc.RpcError as e:
                     code = e.code() if hasattr(e, "code") else None
+                    # hedged sends did their own per-leg health accounting
+                    # (and the re-raised error may be the HEDGE leg's, not
+                    # the primary's) — recording it here again would
+                    # double-charge the primary or charge it with a status
+                    # another endpoint returned
+                    if code is grpc.StatusCode.UNAVAILABLE:
+                        # health feedback even when out of attempts: the
+                        # NEXT call's pick must know this endpoint failed
+                        if not hedging:
+                            self._balancer.record_failure(
+                                target, unavailable=True,
+                                drain=self._is_drain(e),
+                            )
+                    elif code is grpc.StatusCode.DEADLINE_EXCEEDED:
+                        # a slowness signal (error rate + EWMA pressure),
+                        # NOT an outage signal — no UNAVAILABLE streak
+                        if not hedging:
+                            self._balancer.record_failure(
+                                target, unavailable=False
+                            )
+                    else:
+                        # every OTHER status (RESOURCE_EXHAUSTED shed,
+                        # INVALID_ARGUMENT, INTERNAL, ...) was still an
+                        # ANSWER: the endpoint is alive. This must reach
+                        # the balancer — a half-open probe whose outcome
+                        # is never recorded holds the single-flight slot
+                        # forever and wedges the endpoint out of rotation
+                        if not hedging:
+                            self._balancer.record_response(target)
                     if (
                         code is grpc.StatusCode.UNAVAILABLE
                         and attempt < max_attempts
@@ -916,7 +1062,7 @@ class TpuSimulationClient:
                         )
                         if pause > 0.0:
                             self._sleep(pause)
-                        self._failover()
+                        self._failover(failed=target)
                         continue
                     if (
                         code is grpc.StatusCode.RESOURCE_EXHAUSTED
@@ -924,24 +1070,57 @@ class TpuSimulationClient:
                     ):
                         retry_after = self._retry_after_from(e)
                         rem = remaining()
-                        if retry_after is not None and (
-                            rem is None or retry_after < rem
-                        ):
-                            quota_retried = True
-                            trace.add_event(
-                                "rpc.retry_after", method=method,
-                                retry_after_s=retry_after,
+                        if retry_after is not None:
+                            # bounded jitter on the honored hint: every
+                            # co-shed tenant got the SAME retry-after, and
+                            # sleeping it exactly marches the whole herd
+                            # back into admission at one instant. The rng
+                            # rides the injected seam so replays with a
+                            # seeded rng stay byte-stable. Whether the
+                            # retry happens at all is decided by the
+                            # UNJITTERED hint; the jitter then expands
+                            # only into HALF the headroom past it, so the
+                            # resend always keeps some budget — sleeping
+                            # to exactly the deadline would doom the
+                            # retry to DEADLINE_EXCEEDED, losing a call
+                            # the unjittered sleep would have saved.
+                            pause = retry_after * (
+                                1.0 + self.RETRY_AFTER_JITTER * self._rng()
                             )
-                            if retry_after > 0.0:
-                                self._sleep(retry_after)
-                            continue
+                            if rem is None or retry_after < rem:
+                                if rem is not None:
+                                    pause = min(
+                                        pause,
+                                        retry_after
+                                        + 0.5 * (rem - retry_after),
+                                    )
+                                quota_retried = True
+                                trace.add_event(
+                                    "rpc.retry_after", method=method,
+                                    retry_after_s=retry_after,
+                                )
+                                if pause > 0.0:
+                                    self._sleep(pause)
+                                continue
                     # DEADLINE_EXCEEDED and everything else: NEVER resent
                     raise
 
-    def _hedged_send(self, method, request, budget, metadata, resp_cls):
-        """Hedge one idempotent call: primary now, secondary at the next
-        endpoint after the p99-derived delay; first answer wins, the loser
-        is cancelled. Both legs share the caller's remaining budget."""
+    def _hedged_send(
+        self, method, request, budget, metadata, resp_cls,
+        primary_target: Optional[str] = None,
+    ):
+        """Hedge one idempotent call: primary now, a second leg at a
+        balancer-picked HEALTHY endpoint after the p99-derived delay;
+        first answer wins, the loser is cancelled. Both legs share the
+        caller's remaining budget.
+
+        The hedge target is chosen at FIRE time, not call time (health can
+        change during the delay), via ``EndpointBalancer.pick_hedge``: an
+        ejected, draining, or UNAVAILABLE-streaking endpoint is never
+        hedged at — a hedge into a known-bad replica spends deadline
+        budget on a guaranteed failure — and when no healthy alternative
+        exists the hedge is skipped (the primary leg keeps the whole
+        budget)."""
 
         def future_on(channel, leg_budget):
             rpc = channel.unary_unary(
@@ -955,24 +1134,37 @@ class TpuSimulationClient:
 
         t0 = self._clock()
         deadline_ts = t0 + budget if budget is not None else None
-        with self._conn_lock:
-            channel = self._channel
-            hedge_target = self._targets[
-                (self._active + 1) % len(self._targets)
-            ]
+        if primary_target is None:
+            # direct invocation (tests seat a scripted self._channel):
+            # primary is whatever is active right now
+            with self._conn_lock:
+                channel = self._channel
+                primary_target = self._targets[self._active]
+        else:
+            # _call named the target — the leg must ride THAT target's
+            # cached channel, not the shared active one a concurrent
+            # failover may have rewritten (outcome attribution feeds the
+            # balancer; see _call.send)
+            channel = self._channel_for(primary_target)
         primary = future_on(channel, budget)
         fired = threading.Event()
         primary.add_done_callback(lambda _f: fired.set())
         delay = self._hedge_delay(method)
         if budget is not None:
             delay = min(delay, max(budget, 0.0))
-        legs = [primary]
+        # each leg carries its own start instant: the balancer's latency
+        # sample must be the LEG's service time, not time-since-t0 — a
+        # winning hedge measured from t0 would charge the healthy rescuer
+        # with the hedge delay plus the slow primary's elapsed time,
+        # drifting the picker TOWARD the degraded endpoint
+        legs = [(primary, primary_target, t0)]
         if not fired.wait(timeout=delay):
             rem = (
                 deadline_ts - self._clock() if deadline_ts is not None
                 else None
             )
-            if rem is None or rem > 0:
+            hedge_target = self._balancer.pick_hedge(primary_target)
+            if (rem is None or rem > 0) and hedge_target is not None:
                 trace.add_event(
                     "rpc.hedge", method=method, target=hedge_target,
                     delay_s=round(delay, 6),
@@ -980,27 +1172,55 @@ class TpuSimulationClient:
                 # long-lived cached channel: no connection setup on the
                 # latency-critical hedge leg
                 hedge = future_on(
-                    self._hedge_channel_for(hedge_target), rem
+                    self._channel_for(hedge_target), rem
                 )
                 hedge.add_done_callback(lambda _f: fired.set())
-                legs.append(hedge)
+                legs.append((hedge, hedge_target, self._clock()))
         try:
             pending = list(legs)
             last_error: Optional[BaseException] = None
             while pending:
                 fired.clear()
-                for leg in list(pending):
+                for entry in list(pending):
+                    leg, leg_target, leg_start = entry
                     if not leg.done():
                         continue
-                    pending.remove(leg)
+                    pending.remove(entry)
                     try:
                         result = leg.result()
                     except Exception as e:  # noqa: BLE001 — grpc future errs
+                        code = e.code() if hasattr(e, "code") else None
+                        if code is grpc.StatusCode.UNAVAILABLE:
+                            self._balancer.record_failure(
+                                leg_target, unavailable=True,
+                                drain=self._is_drain(e),
+                            )
+                        elif code is grpc.StatusCode.DEADLINE_EXCEEDED:
+                            # same slowness-not-outage semantics as the
+                            # unhedged path, attributed to the leg that
+                            # actually timed out
+                            self._balancer.record_failure(
+                                leg_target, unavailable=False
+                            )
+                        else:
+                            # any other status is still an ANSWER (see
+                            # _call): resolve a held probe, clear streak
+                            self._balancer.record_response(leg_target)
                         last_error = e
                         continue
-                    for loser in pending:
+                    for loser, loser_target, _start in pending:
                         loser.cancel()
+                        # a cancelled leg never reaches an outcome: if its
+                        # pick was a half-open probe, return the slot —
+                        # nothing else ever will
+                        self._balancer.release(loser_target)
+                    # caller-perceived latency (feeds the hedge-delay p99)
+                    # runs from t0; the ENDPOINT's sample runs from its
+                    # own leg start
                     self._note_latency(method, self._clock() - t0)
+                    self._balancer.record_success(
+                        leg_target, self._clock() - leg_start
+                    )
                     return result
                 if pending and not fired.wait(
                     timeout=(
@@ -1008,8 +1228,9 @@ class TpuSimulationClient:
                         if deadline_ts is not None else None
                     )
                 ):
-                    for leg in pending:
+                    for leg, leg_target, _start in pending:
                         leg.cancel()
+                        self._balancer.release(leg_target)
                     break
             if last_error is not None:
                 raise last_error
@@ -1017,9 +1238,10 @@ class TpuSimulationClient:
                 f"hedged {method} exhausted its deadline budget"
             )
         finally:
-            for leg in legs:
+            for leg, leg_target, _start in legs:
                 if not leg.done():
                     leg.cancel()
+                    self._balancer.release(leg_target)
 
     def estimate(
         self,

@@ -937,7 +937,113 @@ def _fleet_overload_bench_main() -> int:
     return 0 if gate else 1
 
 
+def _fleet_ha_bench_main() -> int:
+    """``bench.py --fleet-ha``: the balanced-vs-static routing contrast
+    gate (ISSUE 15 acceptance). Three replica endpoints, one flapping
+    (down on alternating windows, slow when up); the same deterministic
+    request stream runs two client models:
+
+    - **balanced** — fleet/balance.EndpointBalancer picks (health-weighted
+      P2C + breaker ejection): after a few failures the flapper is starved
+      of first attempts, so the tail stops paying its failover/slow cost;
+    - **static** — the PR-14 rotation (round-robin first attempts): 1/3 of
+      first attempts keep landing on the flapper forever.
+
+    Everything runs on an injected sim clock and a seeded rng — no wall
+    time, no flake. The gate: balanced p99 strictly beats static p99 AND
+    balanced deadline-misses (the shed analog) <= static. Exit 0 = gate
+    met, 1 = missed."""
+    import numpy as np
+
+    from autoscaler_tpu.fleet.balance import EndpointBalancer
+
+    ENDPOINTS = ["replica-a", "replica-b", "replica-c"]
+    FLAKY = "replica-c"
+    N = 4000
+    HEALTHY_S = 0.010        # healthy endpoint service time
+    FLAKY_UP_S = 0.250       # the flapper is SLOW even when it answers
+    FAILOVER_PAUSE_S = 0.050  # per failed attempt (connect fail + backoff)
+    DEADLINE_S = 0.200       # per-request budget; over = a lost request
+    FLAP_PERIOD = 50         # requests per up/down half-window
+
+    def flap_down(k: int) -> bool:
+        return (k // FLAP_PERIOD) % 2 == 0
+
+    def run(policy: str):
+        sim = {"t": 0.0}
+        rng = np.random.default_rng(1234)
+        bal = EndpointBalancer(
+            ENDPOINTS, clock=lambda: sim["t"],
+            rng=lambda: float(rng.random()), eject_cooldown_s=10.0,
+        )
+        latencies, misses, first_to_flaky = [], 0, 0
+        for k in range(N):
+            cost, served = 0.0, False
+            tried = []
+            for attempt in range(len(ENDPOINTS)):
+                if policy == "balanced":
+                    ep = bal.pick(exclude=tried)
+                    if ep is None:
+                        break
+                else:
+                    ep = ENDPOINTS[(k + attempt) % len(ENDPOINTS)]
+                if attempt == 0 and ep == FLAKY:
+                    first_to_flaky += 1
+                if ep == FLAKY and flap_down(k):
+                    cost += FAILOVER_PAUSE_S
+                    if policy == "balanced":
+                        bal.record_failure(ep, unavailable=True)
+                    tried.append(ep)
+                    continue
+                cost += FLAKY_UP_S if ep == FLAKY else HEALTHY_S
+                if policy == "balanced":
+                    bal.record_success(
+                        ep, FLAKY_UP_S if ep == FLAKY else HEALTHY_S
+                    )
+                served = True
+                break
+            sim["t"] += cost
+            if not served or cost > DEADLINE_S:
+                misses += 1
+            if served:
+                latencies.append(cost)
+        latencies.sort()
+        p99 = (
+            latencies[max(0, int(0.99 * len(latencies)) - 1)]
+            if latencies else float("inf")
+        )
+        p50 = latencies[len(latencies) // 2] if latencies else float("inf")
+        return {
+            "p50_s": round(p50, 4),
+            "p99_s": round(p99, 4),
+            "deadline_misses": misses,
+            "first_attempts_to_flapper": first_to_flaky,
+        }
+
+    balanced = run("balanced")
+    static = run("static")
+    gate = (
+        balanced["p99_s"] < static["p99_s"]
+        and balanced["deadline_misses"] <= static["deadline_misses"]
+        and balanced["first_attempts_to_flapper"]
+        < static["first_attempts_to_flapper"]
+    )
+    print(json.dumps({
+        "metric": "fleet_ha_balanced_vs_static",
+        "requests": N,
+        "endpoints": len(ENDPOINTS),
+        "deadline_s": DEADLINE_S,
+        "balanced": balanced,
+        "static": static,
+        "unit": "sim-clock seconds",
+        "gate_balanced_beats_static_p99_and_sheds": gate,
+    }, indent=2, sort_keys=True))
+    return 0 if gate else 1
+
+
 def main():
+    if "--fleet-ha" in sys.argv:
+        sys.exit(_fleet_ha_bench_main())
     if "--arena" in sys.argv:
         idx = sys.argv.index("--arena")
         arg = sys.argv[idx + 1] if idx + 1 < len(sys.argv) else ""

@@ -180,7 +180,7 @@ import json, sys
 rounds = [json.loads(l) for l in open(sys.argv[1])]
 assert rounds, "empty fleet decision ledger"
 for r in rounds:
-    assert r["schema"] == "autoscaler_tpu.fleet.round/2", r["schema"]
+    assert r["schema"] == "autoscaler_tpu.fleet.round/3", r["schema"]
     for t in r["tenants"]:
         assert t["match_solo"], (
             f"tenant {t['tenant']} fleet answer diverged from solo in round "
@@ -332,6 +332,159 @@ print(f"chaos ledger ok ({len(rounds)} rounds, {len(sheds)} typed sheds, "
       f"alert ticks {alerting[0]}..{alerting[-1]} cleared by {slo[-1]['tick']})")
 EOF
 rm -rf "$chaos_tmp"
+
+echo "== fleet HA rolling-restart gate (double replay byte-identical fleet+SLO ledgers incl. the endpoint-choice column; gold tier never sheds and stays inside SLO while bronze sheds first; downed replicas serve nothing) =="
+ha_tmp=$(mktemp -d)
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/fleet_rolling_restart.json \
+    --log "$ha_tmp/a.fleet.jsonl" --slo-ledger "$ha_tmp/a.slo.jsonl" > "$ha_tmp/a.report.json"
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/fleet_rolling_restart.json \
+    --log "$ha_tmp/b.fleet.jsonl" --slo-ledger "$ha_tmp/b.slo.jsonl" >/dev/null
+for ledger in fleet slo; do
+    if ! diff -q "$ha_tmp/a.$ledger.jsonl" "$ha_tmp/b.$ledger.jsonl" >/dev/null; then
+        echo "ERROR: $ledger ledger is nondeterministic across rolling-restart replays:" >&2
+        diff "$ha_tmp/a.$ledger.jsonl" "$ha_tmp/b.$ledger.jsonl" | head -20 >&2
+        exit 1
+    fi
+done
+python bench.py --slo-ledger "$ha_tmp/a.slo.jsonl" >/dev/null
+python - "$ha_tmp/a.fleet.jsonl" "$ha_tmp/a.slo.jsonl" "$ha_tmp/a.report.json" <<'EOF'
+import json, sys
+rounds = [json.loads(l) for l in open(sys.argv[1])]
+assert rounds, "empty fleet decision ledger"
+GOLD = {"gold-a", "gold-b"}
+# (1) gold tier: never shed, answered every round, parity intact — the
+# "gold stays inside SLO while bronze sheds first" half of the gate
+gold_sheds = [s for r in rounds for s in r["shed"] if s["tenant"] in GOLD]
+assert not gold_sheds, f"gold-tier requests were shed: {gold_sheds[:3]}"
+for r in rounds:
+    answered = {t["tenant"] for t in r["tenants"]}
+    assert GOLD <= answered, f"round {r['tick']} lost gold answers: {answered}"
+    assert r["outcomes"]["unresolved"] == 0, f"hung tickets in round {r['tick']}"
+    for t in r["tenants"]:
+        assert t["match_solo"], f"parity broke: {t['tenant']} round {r['tick']}"
+# (2) bronze/default shed first AND by both tier gates (shared bucket
+# quota + queue-share slice)
+sheds = [s for r in rounds for s in r["shed"]]
+assert sheds, "the storm never hit a tier gate"
+tiers = {s["tier"] for s in sheds}
+assert tiers and "gold" not in tiers, tiers
+reasons = {s["reason"] for s in sheds}
+assert "shed_quota" in reasons and "shed_queue_full" in reasons, reasons
+# (3) the endpoint-choice column: every answer names its replica, the
+# fleet spread across >= 2 replicas, and a restarting replica served
+# NOTHING during its kill window (the client rebalanced)
+endpoints = {t["endpoint"] for r in rounds for t in r["tenants"]}
+assert len(endpoints) >= 2 and "" not in endpoints, endpoints
+WINDOWS = {"replica-0": range(5, 9), "replica-1": range(11, 15),
+           "replica-2": range(16, 20)}
+for rep, win in WINDOWS.items():
+    hits = [(r["tick"], t["tenant"]) for r in rounds if r["tick"] in win
+            for t in r["tenants"] if t["endpoint"] == rep]
+    assert not hits, f"{rep} served during its restart window: {hits[:5]}"
+# (4) the fleet_e2e burn alert stays quiet: rolling restarts with a
+# rebalancing client are a non-event, not an SLO incident
+slo = [json.loads(l) for l in open(sys.argv[2])]
+assert not slo[-1]["slos"]["fleet_e2e"]["alerting"], "alert stuck at run end"
+report = json.load(open(sys.argv[3]))
+assert report["overload"]["unresolved"] == 0, report["overload"]
+assert report["parity"]["certified"], report["parity"]
+assert report["ha"]["endpoint_requests"], report["ha"]
+print(f"fleet HA rolling restart ok ({len(rounds)} rounds, "
+      f"{len(sheds)} low-tier sheds, endpoints={sorted(endpoints)})")
+EOF
+rm -rf "$ha_tmp"
+
+echo "== fleet HA balanced-vs-static bench gate (balanced routing strictly beats the static list on p99 and sheds under replica flap) =="
+python bench.py --fleet-ha >/dev/null
+echo "fleet-ha bench gate ok"
+
+echo "== live two-sidecar rolling-restart drill (SIGKILL one replica mid-storm: the client rebalances, zero in-deadline requests lost beyond typed sheds) =="
+python - <<'EOF'
+import re, signal, subprocess, sys, threading, time
+import numpy as np
+import grpc
+from autoscaler_tpu.rpc.service import TpuSimulationClient
+
+TIERS = ('{"gold": {"qps": 50, "burst": 100, "queue_share": 0.75, '
+         '"shed_priority": 0, "tenants": ["drill-gold"]}, '
+         '"default": {"qps": 50, "burst": 100, "queue_share": 0.5, '
+         '"shed_priority": 10}}')
+
+def start_sidecar():
+    # stderr joins stdout so a crash can never orphan the output pipe
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "autoscaler_tpu.rpc", "--address",
+         "127.0.0.1:0", "--health-port", "0", "--fleet-prewarm", "false",
+         "--fleet-shape-buckets", "16x4x8", "--fleet-coalesce-window-ms",
+         "5", "--fleet-tenant-tiers", TIERS],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    line = proc.stdout.readline()
+    port = int(re.search(r"serving on port (\d+)", line).group(1))
+    return proc, port
+
+proc_a, port_a = start_sidecar()
+proc_b, port_b = start_sidecar()
+try:
+    client = TpuSimulationClient(
+        [f"127.0.0.1:{port_a}", f"127.0.0.1:{port_b}"],
+        default_timeout_s=30.0, failover_base_sleep_s=0.001)
+    rng = np.random.default_rng(7)
+    def world():
+        return (rng.integers(1, 100, (9, 6)).astype(np.float32),
+                rng.random((3, 9)) > 0.2,
+                rng.integers(100, 500, (3, 6)).astype(np.float32),
+                ["g0", "g1", "g2"], rng.integers(1, 16, 3).astype(np.int32))
+    worlds = [world() for _ in range(24)]
+    outcomes = []
+    lock = threading.Lock()
+    def storm(i):
+        try:
+            client.batch_estimate(*worlds[i], max_nodes=16,
+                                  tenant_id="drill-gold")
+            with lock: outcomes.append("answered")
+        except grpc.RpcError as e:
+            with lock: outcomes.append(f"typed:{e.code().name}")
+    threads = [threading.Thread(target=storm, args=(i,)) for i in range(24)]
+    for i, t in enumerate(threads):
+        t.start()
+        if i == 8:
+            proc_a.kill()  # SIGKILL mid-storm: no drain, no goodbye
+        time.sleep(0.005)
+    for t in threads:
+        t.join(timeout=60.0)
+        assert not t.is_alive(), "a storm call hung through the replica kill"
+    # zero in-deadline requests lost beyond the typed shed budget: every
+    # call either answered (failover absorbed the kill) or surfaced a
+    # TYPED status — never a hang, never an untyped loss. With 30s
+    # deadlines and a live peer, quota off, everything must answer.
+    assert len(outcomes) == 24, outcomes
+    lost = [o for o in outcomes if o != "answered"]
+    assert not lost, f"in-deadline requests lost beyond typed sheds: {lost}"
+    # the client REBALANCED: the killed endpoint's health shows the
+    # UNAVAILABLE streak / ejection, the survivor stays clean and took
+    # the traffic
+    health = client.endpoint_health()
+    dead, live = health[f"127.0.0.1:{port_a}"], health[f"127.0.0.1:{port_b}"]
+    assert dead["consecutive_unavailable"] > 0 or dead["breaker"] != "closed", dead
+    assert live["breaker"] == "closed" and live["consecutive_unavailable"] == 0, live
+    # and new first attempts now route to the survivor, not the corpse
+    post = []
+    for i in range(4):
+        counts_, _s, _m = client.batch_estimate(*world(), max_nodes=16,
+                                                tenant_id="drill-gold")
+        post.append(counts_.shape)
+    assert all(s == (3,) for s in post), post
+    client.close()
+    rc_b = proc_b.poll()
+    assert rc_b is None, f"survivor sidecar died mid-drill: {rc_b}"
+    print(f"two-sidecar drill ok (24/24 answered through a SIGKILL; "
+          f"dead endpoint health: streak={dead['consecutive_unavailable']}, "
+          f"breaker={dead['breaker']})")
+finally:
+    for p in (proc_a, proc_b):
+        if p.poll() is None:
+            p.kill()
+EOF
 
 echo "== live sidecar SIGTERM drain gate (readiness flips, admission refuses with drain detail, in-flight tickets resolve, clean exit) =="
 python - <<'EOF'

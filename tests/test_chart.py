@@ -15,10 +15,13 @@ def load_values():
 
 
 def render(text, values, namespace="kube-system"):
-    """Minimal {{ .Values.x.y }} / {{ .Release.Namespace }} renderer plus
-    whole-line ``{{- if .Values.x }} … {{- end }}`` guards — the chart
-    deliberately sticks to these two forms so it stays testable without a
-    helm binary."""
+    """Minimal helm renderer: ``{{ .Values.x.y }}`` / ``{{ $.Values.x.y }}``
+    / ``{{ .Release.Namespace }}`` substitution, whole-line
+    ``{{- if .Values.x }} … {{- end }}`` guards, and whole-line
+    ``{{- range $i := until (int .Values.x) }} … {{- end }}`` loops with
+    ``{{ $i }}`` in the body (the fleet-HA per-replica endpoint wiring) —
+    the chart deliberately sticks to these forms so it stays testable
+    without a helm binary."""
 
     def lookup(path):
         cur = values
@@ -26,35 +29,71 @@ def render(text, values, namespace="kube-system"):
             cur = cur[part]
         return cur
 
-    # line-based conditional blocks: include the body iff every enclosing
-    # guard's value is truthy (helm truthiness for our value types:
-    # empty string / false / 0 / None are falsy)
-    out_lines = []
-    stack = []
-    for line in text.splitlines():
-        m_if = re.match(r"^\s*\{\{-?\s*if\s+(\.Values\.[\w.]+)\s*-?\}\}\s*$", line)
-        m_end = re.match(r"^\s*\{\{-?\s*end\s*-?\}\}\s*$", line)
-        if m_if:
-            stack.append(bool(lookup(m_if.group(1))))
-            continue
-        if m_end:
-            assert stack, "unbalanced {{- end }}"
-            stack.pop()
-            continue
-        if all(stack):
-            out_lines.append(line)
-    assert not stack, "unclosed {{- if }}"
-    text = "\n".join(out_lines) + "\n"
+    IF_RE = re.compile(r"^\s*\{\{-?\s*if\s+(\.Values\.[\w.]+)\s*-?\}\}\s*$")
+    RANGE_RE = re.compile(
+        r"^\s*\{\{-?\s*range\s+\$(\w+)\s*:=\s*until\s+"
+        r"\(int\s+(\.Values\.[\w.]+)\)\s*-?\}\}\s*$"
+    )
+    END_RE = re.compile(r"^\s*\{\{-?\s*end\s*-?\}\}\s*$")
 
-    def sub(m):
-        expr = m.group(1).strip()
-        if expr == ".Release.Namespace":
-            return namespace
-        if expr.startswith(".Values."):
-            return str(lookup(expr))
-        raise AssertionError(f"unsupported template expr {expr!r}")
+    def parse(lines, i):
+        """→ (block nodes, index past the closing end, end-seen). Nodes:
+        ("line", text) | ("if", path, body) | ("range", var, path, body)."""
+        nodes = []
+        while i < len(lines):
+            line = lines[i]
+            if END_RE.match(line):
+                return nodes, i + 1, True
+            m = IF_RE.match(line)
+            if m:
+                body, i, closed = parse(lines, i + 1)
+                assert closed, "unclosed {{- if }}"
+                nodes.append(("if", m.group(1), body))
+                continue
+            m = RANGE_RE.match(line)
+            if m:
+                body, i, closed = parse(lines, i + 1)
+                assert closed, "unclosed {{- range }}"
+                nodes.append(("range", m.group(1), m.group(2), body))
+                continue
+            nodes.append(("line", line))
+            i += 1
+        return nodes, i, False
 
-    return re.sub(r"\{\{([^}]+)\}\}", sub, text)
+    def sub_line(line, env):
+        def sub(m):
+            expr = m.group(1).strip()
+            if expr in (".Release.Namespace", "$.Release.Namespace"):
+                return namespace
+            if expr.startswith(".Values.") or expr.startswith("$.Values."):
+                return str(lookup(expr.lstrip("$")))
+            if expr.startswith("$") and expr[1:] in env:
+                return str(env[expr[1:]])
+            raise AssertionError(f"unsupported template expr {expr!r}")
+
+        return re.sub(r"\{\{([^}]+)\}\}", sub, line)
+
+    out = []
+
+    def emit(nodes, env):
+        for node in nodes:
+            if node[0] == "line":
+                out.append(sub_line(node[1], env))
+            elif node[0] == "if":
+                # helm truthiness for our value types: empty string /
+                # false / 0 / None are falsy
+                if lookup(node[1]):
+                    emit(node[2], env)
+            else:
+                _, var, path, body = node
+                for k in range(int(lookup(path))):
+                    emit(body, {**env, var: k})
+
+    lines = text.splitlines()
+    nodes, _, closed = parse(lines, 0)
+    assert not closed, "unbalanced {{- end }}"
+    emit(nodes, {})
+    return "\n".join(out) + "\n"
 
 
 def test_chart_and_values_parse():
@@ -157,6 +196,61 @@ def test_sidecar_flags_exist_in_launcher_cli():
     for arg in sidecar["command"]:
         if arg.startswith("--"):
             assert f'"{arg}"' in launcher, f"sidecar passes unknown flag {arg}"
+
+
+def test_fleet_ha_replica_and_tier_wiring():
+    """Fleet HA (ISSUE 15): `sidecar.replicas` must drive BOTH the
+    replica StatefulSet's size and the control plane's --rpc-address
+    failover list (in-pod endpoint + one stable DNS name per replica),
+    and `fleet.tenantTiers` must reach EVERY sidecar launcher as
+    --fleet-tenant-tiers with JSON that actually parses."""
+    import json
+
+    values = load_values()
+    values["sidecar"]["replicas"] = 3
+    out = render((CHART / "templates" / "deployment.yaml").read_text(), values)
+    dep = yaml.safe_load(out)
+    control = dep["spec"]["template"]["spec"]["containers"][0]
+    addrs = [a.split("=", 1)[1] for a in control["args"]
+             if a.startswith("--rpc-address=")]
+    assert addrs[0] == values["sidecar"]["grpcAddress"]
+    assert addrs[1:] == [
+        f"tpu-autoscaler-sidecar-{i}.tpu-autoscaler-sidecar."
+        f"kube-system.svc:9090"
+        for i in range(3)
+    ]
+    assert any(a.startswith("--rpc-hedge=") for a in control["args"])
+    # the replica pool: StatefulSet sized by the same value, headless
+    # Service for the per-replica DNS the address list enumerates
+    ha = render(
+        (CHART / "templates" / "sidecar-fleet.yaml").read_text(), values
+    )
+    sts, svc = list(yaml.safe_load_all(ha))
+    assert sts["kind"] == "StatefulSet" and sts["spec"]["replicas"] == 3
+    assert sts["spec"]["serviceName"] == "tpu-autoscaler-sidecar"
+    # k8s headless marker is the literal string "None" (YAML null would
+    # mean "allocate a ClusterIP")
+    assert svc["kind"] == "Service" and svc["spec"]["clusterIP"] == "None"
+    # tenant tiers reach both launchers, and the JSON is real JSON with
+    # the mandatory default tier
+    for sidecar in (
+        dep["spec"]["template"]["spec"]["containers"][1],
+        sts["spec"]["template"]["spec"]["containers"][0],
+    ):
+        cmd = sidecar["command"]
+        assert "--fleet-tenant-tiers" in cmd, sidecar["name"]
+        tiers = json.loads(cmd[cmd.index("--fleet-tenant-tiers") + 1])
+        assert "default" in tiers
+        # readiness/drain wiring on the replicas too
+        assert sidecar["readinessProbe"]["httpGet"]["path"] == "/healthz"
+        assert sidecar["lifecycle"]["preStop"]["httpGet"]["path"] == "/drain"
+    # every StatefulSet launcher flag exists in the launcher CLI
+    launcher = (
+        CHART.parent.parent.parent / "autoscaler_tpu" / "rpc" / "__main__.py"
+    ).read_text()
+    for arg in sts["spec"]["template"]["spec"]["containers"][0]["command"]:
+        if arg.startswith("--"):
+            assert f'"{arg}"' in launcher, f"replica passes unknown flag {arg}"
 
 
 def test_empty_compile_cache_dir_renders_valid_deployment():

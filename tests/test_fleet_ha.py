@@ -1,0 +1,905 @@
+"""Fleet HA (ISSUE 15): health-weighted multi-sidecar balancing, tenant
+quota tiers, and rolling-restart chaos certification.
+
+The headline contracts:
+
+- the endpoint picker is a pure function of its injected clock + rng
+  stream and the outcome sequence — two replays route every request
+  identically (the ledger's endpoint-choice column byte-matches);
+- a flapping/restarting replica is starved of first-attempt traffic
+  (penalty scores, then breaker ejection) and earns it back through a
+  single-flight half-open probe after cooldown;
+- quota tiers are typed and ordered: per-tier shared buckets, queue-share
+  slices, tier default deadlines, and bronze-sheds-before-gold service
+  order under bounded capacity;
+- the hedge leg never fires at an endpoint known to be draining, ejected,
+  or mid-UNAVAILABLE-streak — no hedge beats a doomed hedge.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from autoscaler_tpu.fleet import (
+    EndpointBalancer,
+    FleetCoalescer,
+    FleetOverloadError,
+    FleetRequest,
+    TierError,
+    parse_tiers,
+)
+from autoscaler_tpu.fleet.admission import AdmissionController
+from autoscaler_tpu.fleet.errors import SHED_QUEUE_FULL, SHED_QUOTA
+from autoscaler_tpu.metrics.metrics import AutoscalerMetrics
+from autoscaler_tpu.utils.circuit import BreakerState
+
+
+def _request(rng, tenant, P=8, G=3, deadline_s=None):
+    return FleetRequest(
+        tenant_id=tenant,
+        pod_req=rng.integers(1, 60, (P, 6)).astype(np.float32),
+        pod_masks=rng.random((G, P)) > 0.3,
+        template_allocs=rng.integers(50, 300, (G, 6)).astype(np.float32),
+        node_caps=rng.integers(1, 8, G).astype(np.int32),
+        max_nodes=P,
+        deadline_s=deadline_s,
+    )
+
+
+def _seeded_balancer(endpoints, seed=7, **kw):
+    gen = np.random.default_rng(seed)
+    sim = {"t": 0.0}
+    bal = EndpointBalancer(
+        endpoints, clock=lambda: sim["t"],
+        rng=lambda: float(gen.random()), **kw,
+    )
+    return bal, sim
+
+
+# -- endpoint balancer --------------------------------------------------------
+
+
+class TestEndpointBalancer:
+    def test_rejects_empty_and_duplicate_endpoints(self):
+        with pytest.raises(ValueError):
+            EndpointBalancer([])
+        with pytest.raises(ValueError):
+            EndpointBalancer(["a", "a"])
+
+    def test_pick_sequence_is_deterministic_on_the_seeded_seam(self):
+        """Same rng stream + same outcome sequence → same picks. This is
+        the property that makes the fleet ledger's endpoint-choice column
+        replay byte-identically."""
+
+        def run():
+            bal, _ = _seeded_balancer(["a", "b", "c"], seed=42)
+            picks = []
+            for i in range(40):
+                p = bal.pick()
+                picks.append(p)
+                if p == "b" and i % 3 == 0:
+                    bal.record_failure(p)
+                else:
+                    bal.record_success(p, 0.01)
+            return picks
+
+        assert run() == run()
+
+    def test_healthy_fleet_spreads_picks(self):
+        """All-tied scores must not herd onto one index (the tie keeps
+        the uniform first draw)."""
+        bal, _ = _seeded_balancer(["a", "b", "c"], seed=3)
+        counts = {}
+        for _ in range(300):
+            p = bal.pick()
+            counts[p] = counts.get(p, 0) + 1
+            bal.record_success(p, 0.01)
+        assert set(counts) == {"a", "b", "c"}
+        assert min(counts.values()) > 40, counts
+
+    def test_failures_starve_an_endpoint_of_first_attempts(self):
+        bal, _ = _seeded_balancer(["a", "b", "c"], seed=5)
+        for ep in ("a", "b", "c"):
+            bal.record_success(ep, 0.01)
+        bal.record_failure("c", unavailable=True)
+        picks = []
+        for _ in range(60):
+            p = bal.pick()
+            picks.append(p)
+            bal.record_success(p, 0.01)
+        # P2C with a 0.5s penalty on c: c loses every pair it is drawn in
+        assert "c" not in picks
+
+    def test_ejection_and_single_probe_recovery(self):
+        bal, sim = _seeded_balancer(
+            ["a", "b"], seed=9, eject_failure_threshold=3,
+            eject_cooldown_s=10.0,
+        )
+        for _ in range(3):
+            bal.record_failure("b", unavailable=True)
+        assert bal.snapshot()["b"]["breaker"] == "open"
+        # while open and inside cooldown: never picked (a exists)
+        for _ in range(20):
+            assert bal.pick() == "a"
+        # cooldown elapses: the NEXT pick is b's half-open probe (a probe
+        # that had to out-score a healthy peer would never run), and the
+        # single-flight slot keeps further picks off b while it is out
+        sim["t"] = 11.0
+        assert bal.pick() == "b"
+        for _ in range(10):
+            assert bal.pick() == "a"  # probe slot held: no stampede
+        # probe success closes the breaker and clears the streak
+        bal.record_success("b", 0.01)
+        snap = bal.snapshot()["b"]
+        assert snap["breaker"] == "closed"
+        assert snap["consecutive_unavailable"] == 0
+
+    def test_probe_failure_reopens_without_stampede(self):
+        bal, sim = _seeded_balancer(
+            ["a", "b"], seed=11, eject_failure_threshold=2,
+            eject_cooldown_s=5.0,
+        )
+        bal.record_failure("b")
+        bal.record_failure("b")
+        sim["t"] = 6.0
+        # the cooled-down endpoint probes immediately; failing the probe
+        # re-opens a FULL window
+        assert bal.pick() == "b"
+        bal.record_failure("b")
+        assert bal.snapshot()["b"]["breaker"] == "open"
+        # inside the NEW cooldown window b is refused again
+        sim["t"] = 7.0
+        for _ in range(20):
+            assert bal.pick() == "a"
+
+    def test_all_ejected_still_picks_least_bad(self):
+        bal, _ = _seeded_balancer(["a", "b"], seed=2,
+                                  eject_failure_threshold=1,
+                                  eject_cooldown_s=100.0)
+        bal.record_failure("a")
+        bal.record_failure("b")
+        bal.record_failure("b")
+        # everything open + inside cooldown: the call still has to go
+        # somewhere — least-bad by score (a has the shorter streak)
+        assert bal.pick() == "a"
+
+    def test_exclude_exhaustion_returns_none(self):
+        bal, _ = _seeded_balancer(["a", "b"])
+        assert bal.pick(exclude=("a", "b")) is None
+
+    def test_pick_hedge_skips_unhealthy(self):
+        bal, _ = _seeded_balancer(["p", "s1", "s2"], seed=4)
+        for ep in ("p", "s1", "s2"):
+            bal.record_success(ep, 0.01)
+        bal.record_drain("s1")
+        for _ in range(20):
+            assert bal.pick_hedge("p") == "s2"
+        # streaking UNAVAILABLE disqualifies too
+        bal.record_failure("s2", unavailable=True)
+        assert bal.pick_hedge("p") is None
+
+    def test_success_clears_drain_bit(self):
+        bal, _ = _seeded_balancer(["a", "b"])
+        bal.record_drain("b")
+        assert not bal.healthy("b")
+        bal.record_success("b", 0.01)
+        assert bal.healthy("b")
+        assert bal.snapshot()["b"]["drain_observed"] is False
+
+    def test_deadline_failure_is_not_an_unavailable_streak(self):
+        bal, _ = _seeded_balancer(["a", "b"])
+        bal.record_failure("a", unavailable=False)
+        snap = bal.snapshot()["a"]
+        assert snap["consecutive_unavailable"] == 0
+        assert snap["error_rate"] > 0
+
+
+# -- tenant quota tiers -------------------------------------------------------
+
+
+GOLD_BRONZE = (
+    '{"gold": {"qps": 10, "burst": 20, "queue_share": 0.75, '
+    '"default_deadline_s": 30, "shed_priority": 0, '
+    '"tenants": ["g1", "g2"]}, '
+    '"default": {"qps": 0.5, "burst": 1, "queue_share": 0.25, '
+    '"default_deadline_s": 5, "shed_priority": 10}}'
+)
+
+
+class TestTierPolicy:
+    def test_parse_and_resolve(self):
+        policy = parse_tiers(GOLD_BRONZE)
+        assert policy.names() == ("default", "gold")
+        assert policy.tier_for("g1").name == "gold"
+        assert policy.tier_for("anyone-else").name == "default"
+        assert policy.tier_for("g2").default_deadline_s == 30.0
+        assert parse_tiers("") is None
+        assert parse_tiers("   ") is None
+
+    def test_rejections(self):
+        with pytest.raises(TierError):
+            parse_tiers("{not json")
+        with pytest.raises(TierError):
+            parse_tiers('{"gold": {"qps": 1}}')  # no default catch-all
+        with pytest.raises(TierError):
+            parse_tiers('{"default": {"tenants": ["pinned"]}}')
+        with pytest.raises(TierError):
+            parse_tiers(
+                '{"a": {"tenants": ["t"]}, "b": {"tenants": ["t"]}, '
+                '"default": {}}'
+            )  # tenant pinned twice
+        with pytest.raises(TierError):
+            parse_tiers('{"default": {"queue_share": 0.0}}')
+        with pytest.raises(TierError):
+            parse_tiers('{"default": {"queue_share": 1.5}}')
+        with pytest.raises(TierError):
+            parse_tiers('{"default": {"qpz": 3}}')  # typo'd field
+        with pytest.raises(TierError):
+            parse_tiers('{"default": {"shed_priority": -1}}')
+
+    def test_tier_bucket_is_shared_across_the_tiers_tenants(self):
+        """One budget per TIER: two gold tenants drain the same bucket."""
+        ctl = AdmissionController(
+            tiers=parse_tiers(
+                '{"gold": {"qps": 1.0, "burst": 2, "tenants": ["g1", "g2"]},'
+                ' "default": {}}'
+            )
+        )
+        assert ctl.admit("g1", 0, 0.0).admitted
+        assert ctl.admit("g2", 0, 0.0).admitted
+        verdict = ctl.admit("g1", 0, 0.0)
+        assert verdict.outcome == SHED_QUOTA
+        assert verdict.tier == "gold"
+        assert verdict.retry_after_s > 0
+
+    def test_unmetered_tier_never_quota_sheds(self):
+        ctl = AdmissionController(
+            tiers=parse_tiers('{"default": {"qps": 0}}')
+        )
+        for _ in range(50):
+            assert ctl.admit("t", 0, 0.0).admitted
+
+    def test_queue_share_sheds_low_tier_while_gold_slice_stays_open(self):
+        ctl = AdmissionController(
+            max_queue_depth=4,
+            tiers=parse_tiers(
+                '{"gold": {"queue_share": 1.0, "shed_priority": 0, '
+                '"tenants": ["g"]}, '
+                '"default": {"queue_share": 0.25, "shed_priority": 10}}'
+            ),
+        )
+        # bronze slice = max(1, int(0.25 * 4)) = 1: second bronze sheds
+        assert ctl.admit("b", 0, 0.0, tier_depth=0).admitted
+        verdict = ctl.admit("b", 1, 0.0, tier_depth=1)
+        assert verdict.outcome == SHED_QUEUE_FULL
+        assert verdict.tier == "default"
+        # gold still admits at the same global depth
+        assert ctl.admit("g", 1, 0.0, tier_depth=0).admitted
+        # the GLOBAL bound still rules everyone
+        assert ctl.admit("g", 4, 0.0, tier_depth=0).outcome == SHED_QUEUE_FULL
+
+    def test_tiers_supersede_global_tenant_qps(self):
+        ctl = AdmissionController(
+            tenant_qps=0.0001,  # would shed almost everything
+            tiers=parse_tiers('{"default": {"qps": 100, "burst": 100}}'),
+        )
+        for _ in range(20):
+            assert ctl.admit("t", 0, 0.0).admitted
+
+
+class TestCoalescerTiers:
+    def _co(self, tiers=GOLD_BRONZE, **kw):
+        sim = {"t": 0.0}
+        kw.setdefault("clock", lambda: sim["t"])
+        co = FleetCoalescer(
+            buckets="16x4x8", window_s=0.002, batch_scenarios=8,
+            tenant_tiers=tiers, **kw,
+        )
+        return co, sim
+
+    def test_tier_default_deadline_binds_lazy_clients(self):
+        co, sim = self._co()
+        sim["t"] = 100.0
+        rng = np.random.default_rng(0)
+        ticket = co.submit(_request(rng, "g1"))  # gold: 30s default
+        assert ticket.tier == "gold"
+        assert ticket.deadline_ts == pytest.approx(130.0)
+        # an explicit deadline wins over the tier default
+        ticket2 = co.submit(_request(rng, "g1", deadline_s=2.0))
+        assert ticket2.deadline_ts == pytest.approx(102.0)
+        co.flush()
+
+    def test_tier_labels_on_admission_and_sli_series(self):
+        m = AutoscalerMetrics()
+        co, _ = self._co(metrics=m)
+        rng = np.random.default_rng(1)
+        t = co.submit(_request(rng, "g1"))
+        co.flush()
+        t.result(timeout=0.0)
+        assert m.fleet_admission_total.get(
+            outcome="admitted", tenant="g1", tier="gold"
+        ) == 1
+        assert m.fleet_e2e_seconds.count(
+            tenant="g1", bucket="16x4x8", tier="gold"
+        ) == 1
+        assert m.fleet_queue_wait_seconds.count(
+            tenant="g1", bucket="16x4x8", tier="gold"
+        ) == 1
+        # bronze storm past its shared bucket: the shed carries its tier
+        with pytest.raises(FleetOverloadError):
+            for _ in range(5):
+                co.submit(_request(rng, "noname"))
+        assert m.fleet_admission_total.get(
+            outcome="shed_quota", tenant="noname", tier="default"
+        ) >= 1
+
+    def test_flush_serves_gold_before_bronze_under_bounded_capacity(self):
+        """The tier shed order: bronze submitted FIRST, gold second —
+        bounded service (flush limit 1) must still serve gold and leave
+        the bronze tail queued."""
+        co, _ = self._co(
+            tiers='{"gold": {"shed_priority": 0, "tenants": ["g"]}, '
+                  '"default": {"shed_priority": 10}}'
+        )
+        rng = np.random.default_rng(2)
+        bronze = co.submit(_request(rng, "b"))
+        gold = co.submit(_request(rng, "g"))
+        served = co.flush(limit=1)
+        assert served == 1
+        assert gold.done() and not bronze.done()
+        assert co.queue_depth() == 1
+        co.flush()
+        assert bronze.done()
+
+    def test_without_tiers_submission_order_is_preserved(self):
+        co, _ = self._co(tiers="")
+        rng = np.random.default_rng(3)
+        first = co.submit(_request(rng, "a"))
+        second = co.submit(_request(rng, "b"))
+        co.flush(limit=1)
+        assert first.done() and not second.done()
+        co.flush()
+
+    def test_from_options_wires_tenant_tiers(self):
+        from autoscaler_tpu.config.options import AutoscalingOptions
+
+        co = FleetCoalescer.from_options(AutoscalingOptions(
+            fleet_prewarm=False, fleet_tenant_tiers=GOLD_BRONZE,
+        ))
+        assert co.tiers is not None
+        assert co.tier_name("g1") == "gold"
+        assert co.tier_name("stranger") == "default"
+        co2 = FleetCoalescer.from_options(
+            AutoscalingOptions(fleet_prewarm=False)
+        )
+        assert co2.tiers is None and co2.tier_name("x") == ""
+
+    def test_drain_racing_abandoned_ticket_stamps_no_sli(self):
+        """Satellite: a late winner (the caller departed — e.g. its hedge
+        leg answered elsewhere) resolved by the DRAIN flush must count
+        `abandoned`, never stamp lifecycle SLIs for a ghost."""
+        m = AutoscalerMetrics()
+        co, _ = self._co(metrics=m)
+        rng = np.random.default_rng(4)
+        ticket = co.submit(_request(rng, "g1"))
+        with pytest.raises(TimeoutError):
+            ticket.result(timeout=0.0)  # departed before the answer
+        before = m.fleet_e2e_seconds.count(
+            tenant="g1", bucket="16x4x8", tier="gold"
+        )
+        co.stop()  # the drain path's final flush resolves it late
+        assert ticket.done()
+        assert m.fleet_e2e_seconds.count(
+            tenant="g1", bucket="16x4x8", tier="gold"
+        ) == before
+        assert m.fleet_ticket_outcomes_total.get(
+            outcome="abandoned", tenant="g1"
+        ) == 1
+
+
+# -- client hedge-leg health --------------------------------------------------
+
+
+class _FakeFuture:
+    def __init__(self, result=None, ready=True):
+        self._result = result
+        self._ready = ready
+        self.cancelled = False
+
+    def done(self):
+        return self._ready
+
+    def add_done_callback(self, cb):
+        if self._ready:
+            cb(self)
+
+    def result(self):
+        return self._result
+
+    def cancel(self):
+        self.cancelled = True
+        self._ready = True
+
+
+class _FutureChannel:
+    def __init__(self, fut):
+        self.fut = fut
+
+    def unary_unary(self, *a, **k):
+        fut = self.fut
+
+        class RPC:
+            def future(self, request, timeout=None, metadata=None):
+                return fut
+
+        return RPC()
+
+    def close(self):
+        pass
+
+
+class _Resp:
+    @staticmethod
+    def FromString(data):  # noqa: N802 — protobuf API shape
+        return data
+
+
+class TestHedgeHealthGating:
+    def test_hedge_skips_drain_observed_endpoint(self, monkeypatch):
+        """Satellite bugfix: the hedge leg must consult failover/drain
+        state — a hedge fired at a draining sidecar burns deadline budget
+        for a guaranteed UNAVAILABLE. With the only alternative drained,
+        NO hedge channel may be built; the primary keeps the budget."""
+        from autoscaler_tpu.rpc import service as service_mod
+        from autoscaler_tpu.rpc.service import TpuSimulationClient
+
+        client = TpuSimulationClient(
+            ["primary:1", "secondary:2"], default_timeout_s=0.2, hedge=True,
+        )
+        client.HEDGE_MIN_DELAY_S = 0.01
+        client._balancer.record_drain("secondary:2")
+        client._channel = _FutureChannel(_FakeFuture(ready=False))
+        monkeypatch.setattr(
+            service_mod.grpc, "insecure_channel",
+            lambda target: pytest.fail(
+                f"hedge channel built toward drained {target}"
+            ),
+        )
+        with pytest.raises(TimeoutError):
+            client._hedged_send("Estimate", object(), 0.05, None, _Resp)
+
+    def test_hedge_targets_a_healthy_endpoint_not_the_next_in_list(
+        self, monkeypatch
+    ):
+        """The hedge target is a balancer pick, not `next index`: with
+        the list-adjacent endpoint drained, the hedge must land on the
+        healthy one further down."""
+        from autoscaler_tpu.rpc import service as service_mod
+        from autoscaler_tpu.rpc.service import TpuSimulationClient
+
+        client = TpuSimulationClient(
+            ["p:1", "s1:2", "s2:3"], default_timeout_s=5.0, hedge=True,
+        )
+        client.HEDGE_MIN_DELAY_S = 0.01
+        for ep in ("p:1", "s1:2", "s2:3"):
+            client._balancer.record_success(ep, 0.01)
+        client._balancer.record_drain("s1:2")  # the next-in-list endpoint
+        client._channel = _FutureChannel(_FakeFuture(ready=False))
+        built = []
+        monkeypatch.setattr(
+            service_mod.grpc, "insecure_channel",
+            lambda target: built.append(target)
+            or _FutureChannel(_FakeFuture(result="hedged")),
+        )
+        result = client._hedged_send("Estimate", object(), 5.0, None, _Resp)
+        assert result == "hedged"
+        assert built == ["s2:3"]
+
+
+# -- replica chaos through the fleet driver -----------------------------------
+
+
+def _rolling_spec(seed=6):
+    from autoscaler_tpu.loadgen.spec import ScenarioSpec
+
+    return ScenarioSpec.from_dict({
+        "name": "ha_smoke", "seed": seed, "ticks": 6,
+        "tick_interval_s": 10.0,
+        "fleet": {
+            "replicas": 3,
+            "tenants": [
+                {"name": "g1", "pods": 6, "groups": 2, "max_nodes": 8},
+                {"name": "b1", "pods": 6, "groups": 2, "max_nodes": 8,
+                 "requests_per_round": 3},
+            ],
+        },
+        "events": [
+            {"at_tick": 1, "kind": "fault",
+             "fault": {"kind": "replica_restart", "replica": 0,
+                       "end_tick": 2}},
+            {"at_tick": 3, "kind": "fault",
+             "fault": {"kind": "endpoint_flap", "replica": 2,
+                       "probability": 0.7, "end_tick": 2}},
+        ],
+        "options": {
+            "fleet_shape_buckets": "16x4x8", "fleet_prewarm": False,
+            "fleet_batch_scenarios": 8, "perf_cost_model": False,
+            "fleet_max_queue_depth": 8,
+            "fleet_tenant_tiers": (
+                '{"gold": {"qps": 5, "burst": 10, "queue_share": 0.75, '
+                '"shed_priority": 0, "tenants": ["g1"]}, '
+                '"default": {"qps": 0.1, "burst": 1, "queue_share": 0.5, '
+                '"shed_priority": 10}}'
+            ),
+        },
+    })
+
+
+def test_new_replica_fault_kinds_roundtrip_and_validate():
+    from autoscaler_tpu.loadgen.spec import FaultSpec, ScenarioSpec, SpecError
+
+    spec = _rolling_spec()
+    assert ScenarioSpec.from_json(spec.to_json()) == spec
+    assert spec.fleet.replicas == 3
+    with pytest.raises(SpecError):
+        FaultSpec(kind="replica_restart")  # replica target required
+    with pytest.raises(SpecError):
+        FaultSpec(kind="endpoint_flap")
+    with pytest.raises(SpecError):
+        FaultSpec(kind="kernel_fault", replica=1)  # wrong kind
+    with pytest.raises(SpecError):
+        FaultSpec(kind="replica_restart", replica=0, group="g")
+    with pytest.raises(SpecError):
+        ScenarioSpec.from_dict({
+            "name": "x", "fleet": {"replicas": 0, "tenants": [
+                {"name": "t"}]},
+        })
+
+
+def test_driver_routes_around_a_restarting_replica():
+    """Rolling restart with 3 replicas: the kill window loses NOTHING —
+    every request reroutes, replica-0 serves zero requests while down,
+    gold never sheds, and the endpoint column is complete."""
+    from autoscaler_tpu.loadgen.fleetdrive import run_fleet_scenario
+
+    result = run_fleet_scenario(_rolling_spec())
+    assert result.unresolved == 0
+    assert result.injected_faults.get("replica_restart", 0) > 0
+    # restart window = ticks 1..2: replica-0 must serve nothing there,
+    # yet every round answers its full admitted set
+    for rec in result.records:
+        for t in rec.tenants:
+            assert t.endpoint.startswith("replica-"), t
+            if rec.tick in (1, 2):
+                assert t.endpoint != "replica-0", rec.tick
+    # no outage sheds: only tier backpressure (bronze quota) appears
+    reasons = {row["reason"] for r in result.records for row in r.shed}
+    assert "replica_restart" not in reasons
+    assert reasons <= {"shed_quota", "shed_queue_full"}, reasons
+    # gold always answered, never shed
+    gold_sheds = [row for r in result.records for row in r.shed
+                  if row["tenant"] == "g1"]
+    assert not gold_sheds
+    for rec in result.records:
+        assert "g1" in {t.tenant for t in rec.tenants}
+    # tier provenance on rows
+    assert all(
+        t.tier in ("gold", "default")
+        for r in result.records for t in r.tenants
+    )
+
+
+def test_endpoint_choice_column_replays_byte_identically():
+    """Satellite: balancer determinism — two replays of the same spec
+    produce byte-identical fleet ledgers INCLUDING the endpoint-choice
+    column, and the per-verdict endpoint sequences match exactly."""
+    from autoscaler_tpu.loadgen.fleetdrive import run_fleet_scenario
+    from autoscaler_tpu.loadgen.spec import ScenarioSpec
+
+    spec = _rolling_spec()
+    a = run_fleet_scenario(spec)
+    b = run_fleet_scenario(ScenarioSpec.from_dict(spec.to_dict()))
+    assert a.decision_ledger_lines() == b.decision_ledger_lines()
+    assert a.slo_ledger_lines() == b.slo_ledger_lines()
+    col_a = [(r.tick, t.tenant, t.endpoint, t.failovers)
+             for r in a.records for t in r.tenants]
+    col_b = [(r.tick, t.tenant, t.endpoint, t.failovers)
+             for r in b.records for t in r.tenants]
+    assert col_a == col_b
+    assert len({e for _, _, e, _ in col_a}) >= 2  # genuinely multi-replica
+
+
+def test_full_outage_sheds_typed_and_burns_budget():
+    """Every replica down at once: submits shed unavailable (typed), the
+    SLO charges bad budget, and recovery restores service."""
+    from autoscaler_tpu.loadgen.fleetdrive import run_fleet_scenario
+    from autoscaler_tpu.loadgen.spec import ScenarioSpec
+    from autoscaler_tpu.slo import SLI_FLEET_E2E
+
+    spec = ScenarioSpec.from_dict({
+        "name": "outage", "seed": 8, "ticks": 4, "tick_interval_s": 10.0,
+        "fleet": {"replicas": 2, "tenants": [
+            {"name": "t", "pods": 6, "groups": 2, "max_nodes": 8},
+        ]},
+        "events": [
+            {"at_tick": 1, "kind": "fault",
+             "fault": {"kind": "replica_restart", "replica": 0,
+                       "end_tick": 1}},
+            {"at_tick": 1, "kind": "fault",
+             "fault": {"kind": "replica_restart", "replica": 1,
+                       "end_tick": 1}},
+        ],
+        "options": {"fleet_shape_buckets": "16x4x8", "fleet_prewarm": False,
+                    "perf_cost_model": False},
+    })
+    result = run_fleet_scenario(spec)
+    outage = result.records[1]
+    assert outage.outcomes["resolved"] == 0
+    assert outage.outcomes["shed"] == 1
+    assert outage.shed[0]["reason"] == "replica_restart"
+    assert outage.shed[0]["error"] == "FleetUnavailableError"
+    final = result.slo_records[-1]["slos"][SLI_FLEET_E2E]
+    assert final["events_bad"] >= 1
+    # recovery: the rounds after the outage answer again
+    assert result.records[2].outcomes["resolved"] == 1
+    assert result.unresolved == 0
+
+
+def test_ha_report_section():
+    from autoscaler_tpu.loadgen.fleetdrive import run_fleet_scenario
+    from autoscaler_tpu.loadgen.score import build_fleet_report
+
+    report = build_fleet_report(run_fleet_scenario(_rolling_spec()))
+    ha = report["ha"]
+    assert sum(ha["endpoint_requests"].values()) == report["answers"]
+    assert set(ha["endpoint_requests"]) <= {
+        "replica-0", "replica-1", "replica-2"
+    }
+    assert ha["sheds_by_tier"].get("default", 0) > 0
+    assert "gold" not in ha["sheds_by_tier"]
+
+
+def test_fleet_ha_bench_gate():
+    """bench.py --fleet-ha: the balanced-vs-static contrast is a pure
+    sim-clock computation and its gate must hold (exit 0)."""
+    import io
+    import json
+    from contextlib import redirect_stdout
+
+    import bench
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bench._fleet_ha_bench_main()
+    report = json.loads(buf.getvalue())
+    assert rc == 0, report
+    assert report["balanced"]["p99_s"] < report["static"]["p99_s"]
+    assert (report["balanced"]["deadline_misses"]
+            <= report["static"]["deadline_misses"])
+
+
+# -- review-hardening regressions ---------------------------------------------
+
+
+class _RecordingChannel:
+    """unary_unary channel that counts calls and returns a canned answer."""
+
+    def __init__(self, answer="ok"):
+        self.calls = 0
+        self.answer = answer
+
+    def unary_unary(self, *a, **k):
+        def call(request, timeout=None, metadata=None):
+            self.calls += 1
+            return self.answer
+
+        return call
+
+    def close(self):
+        pass
+
+
+def test_duplicate_endpoints_are_deduped_not_a_crash():
+    """A repeated --rpc-address was harmless under the PR-14 static
+    rotation (failover just revisited the endpoint); the balancer's
+    one-health-record-per-endpoint rule must not turn that config wrinkle
+    into a startup crash."""
+    from autoscaler_tpu.rpc.service import TpuSimulationClient
+
+    client = TpuSimulationClient(
+        ["a:1", "a:1", "b:2", "a:1,b:2"], default_timeout_s=1.0,
+    )
+    assert client._targets == ["a:1", "b:2"]
+    assert client._balancer.endpoints == ["a:1", "b:2"]
+    client.close()
+
+
+def test_replica_fault_out_of_range_is_rejected():
+    """An out-of-range replica index would be silently inert — the chaos
+    gate would 'pass' without ever exercising failover. Fail loudly like
+    every other misapplied fault field."""
+    from autoscaler_tpu.loadgen.spec import ScenarioSpec, SpecError
+
+    base = {
+        "name": "oob", "seed": 1, "ticks": 4, "tick_interval_s": 10.0,
+        "fleet": {
+            "replicas": 3,
+            "tenants": [
+                {"name": "t", "pods": 4, "groups": 2, "max_nodes": 8},
+            ],
+        },
+    }
+    with pytest.raises(SpecError, match="out of range"):
+        ScenarioSpec.from_dict({
+            **base,
+            "events": [
+                {"at_tick": 1, "kind": "fault",
+                 "fault": {"kind": "replica_restart", "replica": 3}},
+            ],
+        })
+    with pytest.raises(SpecError, match="out of range"):
+        ScenarioSpec.from_dict({
+            **base,
+            "faults": [{"kind": "endpoint_flap", "replica": 7,
+                        "probability": 0.5}],
+        })
+    # and a replica fault in a fleet-less scenario targets nothing at all
+    with pytest.raises(SpecError, match="fleet"):
+        ScenarioSpec.from_dict({
+            "name": "no-fleet", "seed": 1, "ticks": 4,
+            "tick_interval_s": 10.0,
+            "node_groups": [{"name": "g", "cpu_m": 4000, "mem_mb": 16384,
+                             "max_size": 8}],
+            "faults": [{"kind": "replica_restart", "replica": 0}],
+        })
+
+
+def test_call_does_not_double_record_hedged_failures(monkeypatch):
+    """_hedged_send does its own per-leg health accounting and the error
+    it re-raises may be the HEDGE leg's — _call recording it again would
+    double-charge the primary's streak (tripping the breaker early) or
+    charge the primary with a status another endpoint returned."""
+    import grpc
+
+    from autoscaler_tpu.rpc.service import TpuSimulationClient
+
+    class Err(_FakeRpcErrorHA, grpc.RpcError):
+        pass
+
+    client = TpuSimulationClient(
+        ["p:1", "s:2"], default_timeout_s=1.0, hedge=True,
+        sleep=lambda s: None,
+    )
+    monkeypatch.setattr(
+        client, "_hedged_send",
+        lambda *a, **k: (_ for _ in ()).throw(
+            Err(grpc.StatusCode.UNAVAILABLE)
+        ),
+    )
+    with pytest.raises(grpc.RpcError):
+        client._call("Estimate", object())
+    # the (stubbed) hedged path recorded nothing, so nothing may appear:
+    # _call must not add its own charges on the hedged path
+    for ep, h in client.endpoint_health().items():
+        assert h["consecutive_unavailable"] == 0, (ep, h)
+        assert h["breaker"] == "closed", (ep, h)
+    client.close()
+
+
+def test_send_rides_the_attempts_target_channel(monkeypatch):
+    """The channel used by send() must be the ATTEMPT'S target, not the
+    shared active channel: a concurrent failover rewriting self._channel
+    between the pick and the send would feed the balancer an outcome from
+    an endpoint this call never talked to."""
+    from autoscaler_tpu.rpc.service import TpuSimulationClient
+
+    client = TpuSimulationClient(["a:1", "b:2"], default_timeout_s=1.0)
+    chan_a, chan_b = _RecordingChannel(), _RecordingChannel()
+    client._channels = {"a:1": chan_a, "b:2": chan_b}
+    # simulate the race: the pick already resolved to b:2, but a racing
+    # thread rewrote the SHARED channel back to a:1 before the send
+    monkeypatch.setattr(client, "_ensure_primary", lambda: "b:2")
+    client._channel = chan_a
+    resp = client._call("Estimate", object())
+    assert resp == "ok"
+    assert (chan_a.calls, chan_b.calls) == (0, 1)
+    # and the success accrued to b:2 (the endpoint actually used), not to
+    # the endpoint the stale shared channel pointed at
+    health = client.endpoint_health()
+    assert health["b:2"]["ewma_latency_s"] > 0.0
+    assert health["a:1"]["ewma_latency_s"] == 0.0
+    client.close()
+
+
+class _FakeRpcErrorHA(Exception):
+    """Duck-typed grpc.RpcError carrying code/details/trailing metadata."""
+
+    def __init__(self, code, details="", trailing=()):
+        self._code = code
+        self._details = details
+        self._trailing = tuple(trailing)
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return self._details
+
+    def trailing_metadata(self):
+        return self._trailing
+
+
+def test_non_outage_response_resolves_a_half_open_probe():
+    """A probe that comes back RESOURCE_EXHAUSTED (or any other
+    non-outage status) proves the endpoint is ALIVE — it must resolve
+    the half-open probe instead of holding the single-flight slot
+    forever and wedging the endpoint out of rotation."""
+    bal, sim = _seeded_balancer(
+        ["a", "b"], seed=13, eject_failure_threshold=2, eject_cooldown_s=5.0,
+    )
+    bal.record_failure("b")
+    bal.record_failure("b")
+    assert bal.snapshot()["b"]["breaker"] == "open"
+    sim["t"] = 6.0
+    assert bal.pick() == "b"  # the half-open probe
+    bal.record_response("b")
+    snap = bal.snapshot()["b"]
+    assert snap["breaker"] == "closed"
+    assert snap["consecutive_unavailable"] == 0
+
+
+def test_released_probe_slot_can_probe_again():
+    """A pick whose call never reaches an outcome (hedge leg cancelled)
+    must RETURN the probe slot: no outcome will ever arrive, and a held
+    slot permanently ejects the endpoint."""
+    bal, sim = _seeded_balancer(
+        ["a", "b"], seed=17, eject_failure_threshold=2, eject_cooldown_s=5.0,
+    )
+    bal.record_failure("b")
+    bal.record_failure("b")
+    sim["t"] = 6.0
+    assert bal.pick() == "b"  # probe slot now held
+    for _ in range(10):
+        assert bal.pick() == "a"  # single-flight: no second probe
+    bal.release("b")
+    assert bal.pick() == "b"  # the returned slot admits a fresh probe
+
+
+def test_client_resource_exhausted_probe_does_not_wedge():
+    """End-to-end through _call: a half-open probe answered with a
+    terminal RESOURCE_EXHAUSTED (no retry-after) must close the breaker,
+    not wedge the endpoint HALF_OPEN forever."""
+    import grpc
+
+    from autoscaler_tpu.rpc.service import TpuSimulationClient
+
+    class Err(_FakeRpcErrorHA, grpc.RpcError):
+        pass
+
+    class ShedChannel:
+        def unary_unary(self, *a, **k):
+            def call(request, timeout=None, metadata=None):
+                raise Err(grpc.StatusCode.RESOURCE_EXHAUSTED)
+
+            return call
+
+        def close(self):
+            pass
+
+    sim = {"t": 0.0}
+    client = TpuSimulationClient(
+        ["a:1", "b:2"], default_timeout_s=100.0,
+        clock=lambda: sim["t"], sleep=lambda s: None,
+    )
+    client._channels = {"a:1": ShedChannel(), "b:2": ShedChannel()}
+    for _ in range(3):
+        client._balancer.record_failure("b:2")
+    assert client.endpoint_health()["b:2"]["breaker"] == "open"
+    sim["t"] = 10.0  # past the ejection cooldown: next pick probes b:2
+    with pytest.raises(grpc.RpcError):
+        client._call("Estimate", object())
+    snap = client.endpoint_health()["b:2"]
+    assert snap["breaker"] == "closed", snap
+    assert snap["consecutive_unavailable"] == 0
+    client.close()

@@ -515,8 +515,10 @@ class TestClientResendMatrix:
         class Err(_FakeRpcError, grpc.RpcError):
             pass
 
+        # rng pinned to 0 on the injected seam → zero jitter, exact sleep
         client = TpuSimulationClient(
             "127.0.0.1:1", default_timeout_s=5.0, sleep=slept.append,
+            rng=lambda: 0.0,
         )
         shed = Err(grpc.StatusCode.RESOURCE_EXHAUSTED, "shed",
                    trailing=((RETRY_AFTER_METADATA_KEY, "0.25"),))
@@ -532,6 +534,46 @@ class TestClientResendMatrix:
         with pytest.raises(grpc.RpcError):
             client._call("BestOptions", object())
         assert channel.calls == 2
+
+    def test_retry_after_sleep_carries_bounded_jitter(self):
+        """Co-shed tenants all receive the SAME retry-after hint; an
+        unjittered sleep marches the whole herd back into admission at one
+        instant. The honored pause must land in [hint, hint*(1+jitter)],
+        driven by the injected rng seam so seeded replays stay
+        byte-stable."""
+        import grpc
+
+        from autoscaler_tpu.rpc.service import (
+            RETRY_AFTER_METADATA_KEY,
+            TpuSimulationClient,
+        )
+
+        class Err(_FakeRpcError, grpc.RpcError):
+            pass
+
+        shed = Err(grpc.StatusCode.RESOURCE_EXHAUSTED, "shed",
+                   trailing=((RETRY_AFTER_METADATA_KEY, "2.0"),))
+
+        def run(rng_value):
+            slept = []
+            client = TpuSimulationClient(
+                "127.0.0.1:1", default_timeout_s=60.0, sleep=slept.append,
+                rng=lambda: rng_value,
+            )
+            client._channel = _ScriptedChannel([shed, "answer"])
+            client._reconnect = lambda: None
+            assert client._call("BestOptions", object()) == "answer"
+            return slept
+
+        jitter = TpuSimulationClient.RETRY_AFTER_JITTER
+        assert run(0.0) == [2.0]                      # floor: the hint itself
+        assert run(0.999) == [pytest.approx(2.0 * (1 + jitter * 0.999))]
+        # bounded: never below the hint, never past hint * (1 + jitter)
+        for v in (0.1, 0.5, 0.9):
+            (pause,) = run(v)
+            assert 2.0 <= pause <= 2.0 * (1 + jitter)
+        # deterministic on the seam: same rng stream, same pause
+        assert run(0.37) == run(0.37)
 
     def test_retry_after_beyond_deadline_budget_raises(self):
         import grpc
