@@ -111,6 +111,7 @@ REPLAY_SCOPES = (
     "snapshot/",
     "clusterstate/",
     "expander/",
+    "preempt/",
     "debugging.py",
 )
 

@@ -64,6 +64,7 @@ GL004_THREADED_SCOPES = (
     "metrics/",
     "perf/",
     "slo/",
+    "preempt/",
     "snapshot/arena.py",
     "trace/recorder.py",
     "utils/circuit.py",

@@ -137,6 +137,19 @@ class AutoscalingOptions:
     # backend init, deploy/ mounts a volume for it
     compile_cache_dir: str = ""
 
+    # -- preemption engine (autoscaler_tpu/preempt) --------------------------
+    # run the priority-aware eviction-packing pass each tick (ops/preempt.py
+    # via the estimator ladder): pending pods that fit the EXISTING cluster
+    # only by displacing strictly-lower-priority residents get planned
+    # evictions, ledgered with provenance (preempted_by). Off = today's
+    # decisions, byte for byte (hack/verify.sh preemption gate).
+    preemption_enabled: bool = False
+    # expander churn penalty: each eviction a scale-up option leaves
+    # standing (its evictor not covered by the option's pods) costs this
+    # much score. 0 = churn-blind ranking (the filter disengages entirely);
+    # tuned by the gym's preemption suite under storm load.
+    preemption_churn_weight: float = 0.0
+
     # -- fleet serving (autoscaler_tpu/fleet) --------------------------------
     # how long the coalescer waits after the first queued request before
     # dispatching the batch — the latency/coalescing trade (ms because the

@@ -29,7 +29,12 @@ class FilterOutSchedulablePodListProcessor:
         pods see the consumed capacity."""
         if not pending:
             return [], []
-        ordered = sorted(pending, key=lambda p: -p.priority)
+        # stable total order: priority alone leaves equal-priority pods in
+        # caller-list order, and the caller assembles that list from an API
+        # listing whose order is not a replay invariant — the pod key breaks
+        # ties deterministically so hinted packing (and therefore which pods
+        # trigger scale-up) is a pure function of the pod SET
+        ordered = sorted(pending, key=lambda p: (-p.priority, p.key()))
         scheduled, _ = self.hinting.try_schedule_pods(snapshot, ordered, commit=True)
         scheduled_keys = {p.key() for p in scheduled}
         still_pending = [p for p in pending if p.key() not in scheduled_keys]

@@ -50,6 +50,10 @@ def _spec_fingerprint(pod: Pod) -> Tuple:
         pod.rwop_handles,
         pod.legacy_volumes,  # same-volume node conflicts are per-identity
         pod.priority,
+        # Never-policy pods pack differently under preemption (they may not
+        # evict), so they must not share an exemplar with default-policy
+        # twins of the same priority
+        pod.preemption_policy,
     )
 
 

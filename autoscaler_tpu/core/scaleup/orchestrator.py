@@ -118,6 +118,23 @@ class ScaleUpOrchestrator:
             # rejects the 'price' entry loudly
             pricing=provider.pricing(),
         )
+        # eviction-churn penalty column (--preemption-churn-weight): leads
+        # the chain so churn-heavy options are pruned before the tie-break
+        # filters; run_once rebinds it to each tick's PreemptionPlan via the
+        # scale_up preemption_churn seam. Weight 0 (default) builds nothing
+        # — the option table stays byte-identical to pre-preemption ledgers.
+        self.churn_filter = None
+        if options.preemption_churn_weight > 0:
+            from autoscaler_tpu.expander.core import (
+                ChainStrategy,
+                PreemptionChurnFilter,
+            )
+
+            self.churn_filter = PreemptionChurnFilter(
+                options.preemption_churn_weight
+            )
+            if isinstance(self.expander, ChainStrategy):
+                self.expander.filters.insert(0, self.churn_filter)
         self.resource_manager = ScaleUpResourceManager(provider.get_resource_limiter())
         self.balancing_processor = balancing_processor
         # TemplateNodeInfoProvider (processors/nodeinfos.py): prefer a
@@ -138,9 +155,16 @@ class ScaleUpOrchestrator:
         now_ts: float,
         pods_of_node=None,
         pending_daemonsets=(),
+        preemption_churn=None,
     ) -> ScaleUpResult:
         if not pending_pods:
             return ScaleUpResult()
+        # rebind the churn column to this tick's preemption plan (a
+        # callable: covered pod keys → evictions left standing); None —
+        # preemption off or nothing planned — disengages the filter so the
+        # scoring table carries no churn column at all
+        if self.churn_filter is not None:
+            self.churn_filter.churn_of = preemption_churn
 
         # Re-read the limiter every pass: providers may fetch it remotely
         # (external gRPC) and a limiter captured once at construction would

@@ -26,12 +26,14 @@ from autoscaler_tpu.core.scaledown.actuator import ActuationResult, ScaleDownAct
 from autoscaler_tpu.core.scaledown.planner import ScaleDownPlanner
 from autoscaler_tpu.core.scaleup.orchestrator import ScaleUpOrchestrator, ScaleUpResult
 from autoscaler_tpu.explain.reasons import (
+    EVICTION_PREEMPTED_BY,
+    REASON_EXPENDABLE_BELOW_CUTOFF,
     REASON_NAMES,
     REASON_NOT_CHOSEN,
     REASON_NO_VIABLE_GROUP,
     SkipReason,
 )
-from autoscaler_tpu.kube.api import ClusterAPI
+from autoscaler_tpu.kube.api import ClusterAPI, EvictionError
 from autoscaler_tpu.kube.objects import Node, Pod, Resources
 from autoscaler_tpu.metrics import metrics as metrics_mod
 from autoscaler_tpu.metrics.healthcheck import HealthCheck
@@ -51,6 +53,13 @@ class RunOnceResult:
     filtered_schedulable: int = 0
     unneeded_nodes: int = 0
     removed_unregistered: int = 0
+    # pending pods dropped below --expendable-pods-priority-cutoff this tick
+    pending_expendable: int = 0
+    # preemption engine (--preemption-enabled): pending pods the eviction-
+    # packing pass admitted onto the existing cluster, and the victims it
+    # actually evicted (sorted pod keys — ledger/driver consumers)
+    preempt_admitted: int = 0
+    preempted_pods: List[str] = field(default_factory=list)
     errors: List[str] = field(default_factory=list)
 
 
@@ -153,6 +162,21 @@ class StaticAutoscaler:
                 else None
             ),
         )
+        # preemption engine (--preemption-enabled): plans priority-aware
+        # evictions against each tick's snapshot through the estimator's
+        # kernel ladder (autoscaler_tpu/preempt). Built only when enabled
+        # AND the orchestrator exposes its estimator — a custom
+        # orchestrator without one silently gets no engine (decisions then
+        # match preemption-off byte-for-byte).
+        self.preempt_engine = None
+        if self.options.preemption_enabled:
+            est = getattr(self.scale_up_orchestrator, "estimator", None)
+            if est is not None:
+                from autoscaler_tpu.preempt import PreemptionEngine
+
+                self.preempt_engine = PreemptionEngine(
+                    est, metrics=self.metrics
+                )
         self.scale_down_planner = scale_down_planner or ScaleDownPlanner(
             provider, self.options, set_processor=self.processors.scale_down_set
         )
@@ -512,12 +536,15 @@ class StaticAutoscaler:
 
             pending = clear_tpu_requests(pending)
 
-            # expendable filter (:471) + young-pod filter (:832)
-            pending = [
-                p
-                for p in pending
-                if p.priority >= self.options.expendable_pods_priority_cutoff
-            ]
+            # expendable filter (:471) + young-pod filter (:832). Dropped
+            # pods are counted and ledgered (expendable_below_cutoff), not
+            # silently vanished: a pod parked below the cutoff forever is a
+            # config decision operators must be able to see on /explainz.
+            cutoff = self.options.expendable_pods_priority_cutoff
+            expendable = [p for p in pending if p.priority < cutoff]
+            pending = [p for p in pending if p.priority >= cutoff]
+            if expendable:
+                self.metrics.pending_expendable_total.inc(len(expendable))
             if self.options.new_pod_scale_up_delay_s > 0:
                 pending = [
                     p
@@ -567,14 +594,25 @@ class StaticAutoscaler:
 
         # decision provenance: the tick's pending split and the breaker/
         # backoff state every later section is conditioned on
+        result.pending_expendable = len(expendable)
         self.explainer.note(
             "pending",
             {
                 "arrived": len(pending) + len(filtered),
                 "filtered_schedulable": len(filtered),
                 "pending": len(pending),
+                "expendable": len(expendable),
             },
         )
+        # dropped-pod provenance: the expendable verdicts are the tick's
+        # baseline pods section; _note_scale_up_explain merges the
+        # scale-up pass's reasons on top (no scale-up this tick — nothing
+        # pending — still leaves these visible)
+        expendable_doc = {
+            p.key(): REASON_EXPENDABLE_BELOW_CUTOFF for p in expendable
+        }
+        if expendable_doc:
+            self.explainer.note("pods", dict(expendable_doc))
         self.explainer.note("degraded_rungs", sorted(self.degraded_rungs()))
         self.explainer.note(
             "backoff",
@@ -584,6 +622,34 @@ class StaticAutoscaler:
                 if self.csr.backoff.is_backed_off(g.id(), now_ts)
             ),
         )
+
+        # 5b. preemption planning (--preemption-enabled): which pending pods
+        # the EXISTING cluster could admit by displacing strictly-lower-
+        # priority residents (autoscaler_tpu/preempt via ops/preempt.py).
+        # Planned before scale-up so the expander can penalize options that
+        # leave evictions standing; actuated after it so pods whose
+        # capacity is already coming evict nobody.
+        preempt_plan = None
+        preempt_doc = None
+        if self.preempt_engine is not None and pending:
+            preempt_plan = self.preempt_engine.plan(
+                snapshot, eligible={p.key() for p in pending}
+            )
+            preempt_doc = {
+                "route": preempt_plan.route,
+                "admitted": preempt_plan.admitted,
+                "evictions": [
+                    {
+                        "pod": victim,
+                        "reason": EVICTION_PREEMPTED_BY,
+                        "by": preempt_plan.victims[victim],
+                        "node": preempt_plan.victim_pods[victim].node_name,
+                    }
+                    for victim in sorted(preempt_plan.victims)
+                ],
+            }
+            self.explainer.note("preemption", dict(preempt_doc))
+            result.preempt_admitted = len(preempt_plan.admitted)
 
         # 6. scale-up (:560-580)
         if pending:
@@ -597,8 +663,14 @@ class StaticAutoscaler:
                     # --force-ds additionally charges suitable-but-not-yet-
                     # running DaemonSets (simulator/nodes.go:56)
                     pending_daemonsets=pending_ds(),
+                    # eviction-churn score column (expander/core.py): how
+                    # many planned evictions an option leaves standing
+                    preemption_churn=(
+                        preempt_plan.churn if preempt_plan is not None
+                        else None
+                    ),
                 )
-                self._note_scale_up_explain(up)
+                self._note_scale_up_explain(up, base_pods=expendable_doc)
                 sp_up.set_attrs(
                     scaled_up=up.scaled_up,
                     group=up.chosen_group or "",
@@ -614,6 +686,41 @@ class StaticAutoscaler:
         min_size_ups = self.scale_up_orchestrator.scale_up_to_node_group_min_size(now_ts)
         if min_size_ups:
             self.last_scale_up_ts = now_ts
+
+        # 6b. actuate planned evictions — only for admitted pods whose
+        # capacity is NOT already coming from this tick's scale-up
+        # (pods_triggered): preemption bridges the gap for the rest.
+        # Victims evicted in sorted order (replay determinism); a typed
+        # eviction failure is recorded and the loop continues — the victim
+        # stays resident and next tick replans.
+        if preempt_plan is not None and preempt_plan.victims:
+            covered = set()
+            if result.scale_up is not None:
+                covered = {
+                    p.key() for p in result.scale_up.pods_triggered
+                }
+            evicted: List[str] = []
+            for victim in sorted(preempt_plan.victims):
+                if preempt_plan.victims[victim] in covered:
+                    continue
+                try:
+                    self.api.evict_pod(preempt_plan.victim_pods[victim])
+                except EvictionError as e:
+                    result.errors.append(
+                        f"preemption eviction of {victim} failed: {e}"
+                    )
+                else:
+                    evicted.append(victim)
+            if evicted:
+                self.metrics.preempted_pods_total.inc(len(evicted))
+                self.metrics.evicted_pods_total.inc(len(evicted))
+                self.metrics.last_activity.set(
+                    now_ts, activity=metrics_mod.PREEMPT_PLAN
+                )
+            result.preempted_pods = evicted
+            preempt_doc = dict(preempt_doc)
+            preempt_doc["evicted"] = evicted
+            self.explainer.note("preemption", preempt_doc)
 
         # 7. scale-down branch (:582-691)
         if self.options.node_autoprovisioning_enabled:
@@ -725,14 +832,18 @@ class StaticAutoscaler:
         return result
 
     # -- helpers -------------------------------------------------------------
-    def _note_scale_up_explain(self, up: ScaleUpResult) -> None:
+    def _note_scale_up_explain(
+        self, up: ScaleUpResult, base_pods: Optional[Dict[str, str]] = None
+    ) -> None:
         """Assemble the scale-up sections of this tick's DecisionRecord
         from the orchestrator result: the estimator's constraint
         attribution, the expander's full scoring table, the closed skip
         reasons, the executed plan, and one reason per pod that stayed
         pending (a pod the estimator could place SOMEWHERE but the chosen
         option did not cover reads 'not_chosen'; a pod that never reached
-        estimation reads 'no_viable_group')."""
+        estimation reads 'no_viable_group'). ``base_pods`` carries verdicts
+        settled before scale-up (expendable_below_cutoff) that the pods
+        section must keep."""
         ex = self.explainer
         explain = up.estimator_explain or {}
         ex.note("estimator", {"groups": explain.get("groups", {})})
@@ -762,7 +873,7 @@ class StaticAutoscaler:
             },
         )
         pod_reasons = explain.get("pod_reasons", {})
-        pods_doc = {}
+        pods_doc = dict(base_pods or {})
         for p in up.pods_remain_unschedulable:
             reason = pod_reasons.get(p.key())
             if reason is None:

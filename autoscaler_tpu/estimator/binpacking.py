@@ -48,6 +48,7 @@ from autoscaler_tpu.ops.binpack import (
     ffd_binpack_groups_runs,
     ffd_binpack_groups_runs_affinity,
 )
+from autoscaler_tpu.ops.preempt import ffd_binpack_preempt
 from autoscaler_tpu.ops.telemetry import kernel_observer
 from autoscaler_tpu.perf import PerfObservatory
 from autoscaler_tpu.snapshot.affinity import (
@@ -492,6 +493,100 @@ class BinpackingNodeEstimator:
                 "estimator dispatch fell back to %s (%s)%s",
                 route, reason, f": {detail}" if detail else "",
             )
+
+    def estimate_preemption(
+        self,
+        tensors,
+        pod_evictable: np.ndarray,
+        pod_valid: Optional[np.ndarray] = None,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, str]:
+        """Priority-aware eviction packing of a snapshot's pending pods onto
+        its EXISTING nodes (ops/preempt.ffd_binpack_preempt) →
+        (scheduled [P] bool, placed_node [P] i32, victim_of [P] i32, route).
+
+        ``tensors`` is a SnapshotTensors carrying the preemption channels
+        (pod_priority/pod_preempt — snapshot/packer.py); ``pod_evictable``
+        is the host-side victim-eligibility mask (preempt/policy.py). The
+        dispatch walks the same degradation ladder as the fit estimates:
+        no Pallas twin exists (the kernel is sized for control-loop
+        shapes, not fleet tiles — ops/preempt.py docstring), so the pallas
+        rung takes the documented automatic ``unsupported`` skip and the
+        XLA scan serves when healthy, with the numpy oracle
+        (reference_impl.ffd_binpack_preempt_reference) as the host twin.
+        The serving route label is returned so the explain ledger can
+        carry kernel provenance per eviction decision.
+
+        ``pod_valid`` optionally overrides the snapshot's validity rows —
+        the engine masks out pending rows the control loop already settled
+        elsewhere (expendable drops, filter-out-schedulable absorptions)
+        without repacking the snapshot."""
+        from autoscaler_tpu.estimator.reference_impl import (
+            ffd_binpack_preempt_reference,
+        )
+
+        prio = tensors.pod_priority
+        preempt = tensors.pod_preempt
+        if prio is None or preempt is None:
+            # snapshot packed without the channels (pre-upgrade caller):
+            # priority-flat world, nothing may evict — the kernel then
+            # reduces to "already-resident pods stay, pending pods direct-fit"
+            P = tensors.num_pods
+            prio = jnp.zeros((P,), jnp.int32)
+            preempt = jnp.zeros((P,), bool)
+        sched = tensors.dense_sched()
+        evictable = np.asarray(pod_evictable, bool)
+        if pod_valid is None:
+            valid = tensors.pod_valid
+        else:
+            valid = self._dev(np.asarray(pod_valid, bool))
+        served = {}
+
+        def mark(label, fn):
+            def run():
+                out = fn()
+                served["route"] = label
+                return out
+            return run
+
+        def xla_fn():
+            res = ffd_binpack_preempt(
+                tensors.pod_req, valid, tensors.pod_node,
+                prio, preempt, self._dev(evictable),
+                tensors.node_alloc, tensors.node_used, tensors.node_valid,
+                sched,
+            )
+            return (
+                np.asarray(res.scheduled),
+                np.asarray(res.placed_node),
+                np.asarray(res.victim_of),
+            )
+
+        def python_fn():
+            return ffd_binpack_preempt_reference(
+                np.asarray(tensors.pod_req), np.asarray(valid),
+                np.asarray(tensors.pod_node), np.asarray(prio),
+                np.asarray(preempt), evictable,
+                np.asarray(tensors.node_alloc), np.asarray(tensors.node_used),
+                np.asarray(tensors.node_valid), np.asarray(sched),
+            )
+
+        with trace.span(
+            metrics_mod.PREEMPT_PLAN, metrics=self.metrics,
+            pods=tensors.num_pods, nodes=tensors.num_nodes,
+        ):
+            scheduled, placed, victim_of = self._walk_ladder(
+                [
+                    (RUNG_PALLAS, "pallas_preempt", None, None),
+                    (RUNG_XLA, "xla_preempt", None,
+                     mark("xla_preempt", xla_fn)),
+                    (RUNG_PYTHON, "python_preempt_ref", None,
+                     mark("python_preempt_ref", python_fn)),
+                ],
+                initial_reason="preempt",
+                forced=("python_preempt_ref",
+                        mark("python_preempt_ref", python_fn)),
+            )
+        return scheduled, placed, victim_of, served.get("route", "unknown")
 
     def _estimate_many_inner(
         self,

@@ -24,11 +24,17 @@ import json
 from typing import Any, Dict, Iterable, List
 
 from autoscaler_tpu.explain.reasons import (
+    EVICTION_REASONS,
     LEDGER_POD_REASONS,
+    REASON_EXPENDABLE_BELOW_CUTOFF,
     SKIP_REASON_VALUES,
 )
 
-SCHEMA = "autoscaler_tpu.explain.decision/1"
+# /2: the preemption section (admitted pending pods + eviction rows, every
+# row naming its evictor) and the expendable_below_cutoff pod reason —
+# formerly-silent drops now carry ledger lines outside the
+# remain_unschedulable count
+SCHEMA = "autoscaler_tpu.explain.decision/2"
 
 
 def stable_json(doc: Any) -> str:
@@ -121,11 +127,57 @@ def _check_pods(i: int, rec: Dict[str, Any], errors: List[str]) -> None:
             )
     up = rec.get("scale_up")
     if isinstance(up, dict) and isinstance(up.get("remain_unschedulable"), int):
-        if len(pods) != up["remain_unschedulable"]:
+        # expendable drops never reach scale-up, so they carry reasons
+        # WITHOUT counting against the remain_unschedulable cross-check
+        explained = sum(
+            1
+            for reason in pods.values()
+            if reason != REASON_EXPENDABLE_BELOW_CUTOFF
+        )
+        if explained != up["remain_unschedulable"]:
             errors.append(
                 f"{where}: {up['remain_unschedulable']} pods remained "
-                f"unschedulable but {len(pods)} carry reasons — an "
+                f"unschedulable but {explained} carry reasons — an "
                 "unexplained pending pod means attribution dropped it"
+            )
+
+
+def _check_preemption(i: int, rec: Dict[str, Any], errors: List[str]) -> None:
+    """The eviction ⇒ named-evictor invariant: every eviction row carries a
+    closed-vocabulary reason, a victim key, and the evictor that displaced
+    it (the acceptance surface of the preemption ledger)."""
+    where = f"record {i}"
+    pre = rec.get("preemption")
+    if pre is None:
+        return
+    if not isinstance(pre, dict):
+        errors.append(f"{where}: preemption section must be an object")
+        return
+    admitted = pre.get("admitted", [])
+    if not isinstance(admitted, list) or any(
+        not isinstance(k, str) for k in admitted
+    ):
+        errors.append(f"{where}: preemption.admitted must list pod keys")
+    evictions = pre.get("evictions", [])
+    if not isinstance(evictions, list):
+        errors.append(f"{where}: preemption.evictions must be a list")
+        return
+    for j, row in enumerate(evictions):
+        at = f"{where} eviction {j}"
+        if not isinstance(row, dict):
+            errors.append(f"{at}: not an object")
+            continue
+        if not isinstance(row.get("pod"), str) or not row.get("pod"):
+            errors.append(f"{at}: missing victim pod key")
+        if row.get("reason") not in EVICTION_REASONS:
+            errors.append(
+                f"{at}: reason {row.get('reason')!r} outside the closed "
+                "eviction vocabulary"
+            )
+        if not isinstance(row.get("by"), str) or not row.get("by"):
+            errors.append(
+                f"{at}: eviction of {row.get('pod')!r} does not name its "
+                "evictor"
             )
 
 
@@ -166,6 +218,7 @@ def validate_records(records: Iterable[Any]) -> List[str]:
                     )
         _check_pods(i, rec, errors)
         _check_expander(i, rec, errors)
+        _check_preemption(i, rec, errors)
     return errors
 
 
@@ -178,6 +231,8 @@ def summarize(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     wins: Dict[str, int] = {}
     skips: Dict[str, int] = {}
     scale_up_nodes = 0
+    evictions = 0
+    preempt_admitted = 0
     ticks = 0
     for rec in records:
         ticks += 1
@@ -195,6 +250,9 @@ def summarize(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
             skips[reason] = skips.get(reason, 0) + 1
         up = rec.get("scale_up", {})
         scale_up_nodes += sum(int(d) for _, d in up.get("executed", ()))
+        pre = rec.get("preemption", {})
+        evictions += len(pre.get("evictions", ()))
+        preempt_admitted += len(pre.get("admitted", ()))
     return {
         "ticks": ticks,
         "pod_reasons": {k: pod_reasons[k] for k in sorted(pod_reasons)},
@@ -202,4 +260,6 @@ def summarize(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         "expander_wins": {k: wins[k] for k in sorted(wins)},
         "skip_reasons": {k: skips[k] for k in sorted(skips)},
         "scale_up_nodes": scale_up_nodes,
+        "evictions": evictions,
+        "preempt_admitted": preempt_admitted,
     }

@@ -61,12 +61,28 @@ REASON_NAMES = (
 # or no group was viable at all) — host-assigned, never kernel codes
 REASON_NOT_CHOSEN = "not_chosen"
 REASON_NO_VIABLE_GROUP = "no_viable_group"
+# a pending pod dropped by --expendable-pods-priority-cutoff before it
+# reached estimation (static_autoscaler.go:471 parity) — formerly a silent
+# disappearance, now a ledgered verdict with its own metric
+# (pending_expendable_total)
+REASON_EXPENDABLE_BELOW_CUTOFF = "expendable_below_cutoff"
 
 #: every string the decision ledger's per-pod reason map may carry
 LEDGER_POD_REASONS = frozenset(REASON_NAMES[1:]) | {
     REASON_NOT_CHOSEN,
     REASON_NO_VIABLE_GROUP,
+    REASON_EXPENDABLE_BELOW_CUTOFF,
 }
+
+# -- eviction provenance (preemption-engine vocabulary) -----------------------
+# Every evicted pod's ledger row carries one of these AND names its evictor
+# (the ``by`` field) — an eviction without provenance is the failure mode
+# the preemption ledger section exists to prevent. Closed like every other
+# ledger vocabulary: byte-identical replays need a finite alphabet.
+EVICTION_PREEMPTED_BY = "preempted_by"
+
+#: every string a preemption eviction row's ``reason`` field may carry
+EVICTION_REASONS = frozenset({EVICTION_PREEMPTED_BY})
 
 
 def reason_name(code: int) -> str:

@@ -76,6 +76,15 @@ KNOB_SPACE: Tuple[Knob, ...] = (
         baseline=3, flag="--kernel-breaker-failure-threshold",
         values_key="kernelBreakerFailureThreshold",
     ),
+    # expander churn penalty per planned eviction an option leaves
+    # uncovered (0 = churn-blind); only bites when the scenario enables
+    # preemption_enabled — on priority-flat scenarios the filter
+    # disengages and any value scores identically
+    Knob(
+        "preemption_churn_weight", "float", lo=0.0, hi=100.0,
+        baseline=0.0, flag="--preemption-churn-weight",
+        values_key="preemptionChurnWeight",
+    ),
 )
 
 KNOBS: Dict[str, Knob] = {k.name: k for k in KNOB_SPACE}
@@ -117,6 +126,7 @@ class PolicySpec:
     scale_down_delay_after_add_s: Optional[float] = None
     kernel_breaker_cooldown_s: Optional[float] = None
     kernel_breaker_failure_threshold: Optional[int] = None
+    preemption_churn_weight: Optional[float] = None
 
     def __post_init__(self):
         self.validate()

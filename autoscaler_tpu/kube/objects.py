@@ -339,6 +339,10 @@ class Pod:
     topology_spread: Tuple["TopologySpreadConstraint", ...] = ()
     owner_ref: Optional[OwnerRef] = None
     priority: int = 0
+    # spec.preemptionPolicy: "" (= PreemptLowerPriority, the API default) or
+    # "Never" — a Never pod keeps its priority for ordering/expendable
+    # semantics but may not evict anyone (preempt/policy.py)
+    preemption_policy: str = ""
     node_name: str = ""          # "" = unscheduled/pending
     host_ports: Tuple[int, ...] = ()
     # (csi driver, volume handle) pairs the pod mounts — PVC-backed volumes

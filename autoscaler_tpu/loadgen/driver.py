@@ -131,6 +131,15 @@ class TickRecord:
     # decision log (pure function of the world state — byte-stable).
     demand_nodes: int = 0
     cluster_healthy: bool = True
+    # preemption engine (ISSUE 16): pending pods the eviction-packing pass
+    # admitted onto existing capacity, pods it actually evicted (sorted;
+    # every one names its evictor in the explain ledger), pending pods
+    # dropped below the expendable cutoff, and bound pods a spot_reclaim
+    # fault re-pended this tick
+    preempt_admitted: int = 0
+    preempted: List[str] = field(default_factory=list)
+    pending_expendable: int = 0
+    reclaimed: int = 0
     wall_s: float = 0.0
 
     def to_dict(self) -> Dict[str, Any]:
@@ -414,6 +423,8 @@ class ScenarioDriver:
                 labels={"app": prefix, **ev.labels},
                 owner_ref=OwnerRef(kind="ReplicaSet", name=f"{prefix}-rs"),
                 creation_ts=BASE_TS + tick * self.spec.tick_interval_s,
+                priority=ev.priority,
+                preemption_policy=ev.preemption_policy,
             )
             if ev.spread_zone_skew > 0:
                 pod.topology_spread = (
@@ -460,6 +471,25 @@ class ScenarioDriver:
                 if node is not None:
                     self.api.nodes[name] = dataclasses.replace(node, ready=True)
                 del self._flapped[name]
+
+    def _spot_reclaim(self, f, tick: int) -> int:
+        """The cloud reclaimed spot capacity out from under low-priority
+        work: bound pods with priority < the fault's cutoff on the target
+        group's nodes ("" = every group) re-enter the pending queue. The
+        pods' latency clocks restart — the reclaim undid the bind — and the
+        sorted iteration keeps the re-pend set a pure function of state."""
+        group_of = self.provider.group_of_node_map()
+        n = 0
+        for key in sorted(self.api.pods):
+            pod = self.api.pods[key]
+            if not pod.node_name or pod.priority >= f.priority_cutoff:
+                continue
+            if f.group and group_of.get(pod.node_name, "") != f.group:
+                continue
+            self.api.pods[key] = dataclasses.replace(pod, node_name="")
+            self.pod_latency[key] = (tick, None)
+            n += 1
+        return n
 
     def _resize(self, ev: Event) -> None:
         for group in self.provider.node_groups():
@@ -575,6 +605,9 @@ class ScenarioDriver:
         self._recover_flaps(tick)
         for ev in self._by_tick.get(tick, ()):
             self._apply_event(ev, tick)
+        reclaimed = 0
+        for f in self.injector.on_spot_reclaim():
+            reclaimed += self._spot_reclaim(f, tick)
         pending_before = sum(
             1 for p in self.api.list_pods() if not p.node_name
         )
@@ -630,6 +663,10 @@ class ScenarioDriver:
                 for g in self.provider.node_groups()
                 if self.autoscaler.csr.backoff.is_backed_off(g.id(), now)
             ),
+            preempt_admitted=result.preempt_admitted,
+            preempted=sorted(result.preempted_pods),
+            pending_expendable=result.pending_expendable,
+            reclaimed=reclaimed,
             wall_s=wall,
         )
         if result.scale_up is not None and result.scale_up.scaled_up:

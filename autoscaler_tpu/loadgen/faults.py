@@ -207,6 +207,23 @@ class FaultInjector:
             return f.latency_s
         return 0.0
 
+    def on_spot_reclaim(self) -> List[FaultSpec]:
+        """spot_reclaim seam (the driver consults it once per tick, after
+        events apply): each spot_reclaim fault whose window STARTS this
+        tick and wins its probability draw fires exactly once — the
+        driver re-pends bound pods with priority < ``priority_cutoff`` on
+        the target group's nodes. One-shot-per-window keeps a reclaim a
+        discrete cloud event rather than a per-tick bleed, and the single
+        draw per firing window is part of the replay contract."""
+        fired: List[FaultSpec] = []
+        for f in self._static + self._armed:
+            if f.kind != "spot_reclaim" or self.tick != f.start_tick:
+                continue
+            if f.probability >= 1.0 or self._rng.random() < f.probability:
+                self._note("spot_reclaim")
+                fired.append(f)
+        return fired
+
     def on_arena_apply(self) -> Optional[str]:
         """Resident-arena fault hook (snapshot/arena.DeviceArena
         fault_hook): a truthy return fails THIS tick's delta apply — the

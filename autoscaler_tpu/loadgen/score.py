@@ -225,10 +225,35 @@ def build_report(
     explain = _explain_section(result)
     if explain:
         report["explain"] = explain
+    evictions = _evictions_section(result)
+    if evictions:
+        report["evictions"] = evictions
     slo = _slo_section(result)
     if slo:
         report["slo"] = slo
     return report
+
+
+def _evictions_section(result: RunResult) -> Dict[str, Any]:
+    """Preemption columns (ISSUE 16): what the eviction-packing engine
+    admitted onto existing capacity, how many pods it actually evicted,
+    the expendable-cutoff drops, and spot_reclaim re-pends. Zero-suppressed
+    so priority-flat scenarios keep their existing reports byte-for-byte."""
+    admitted = sum(r.preempt_admitted for r in result.records)
+    preempted = sum(len(r.preempted) for r in result.records)
+    expendable = sum(r.pending_expendable for r in result.records)
+    reclaimed = sum(r.reclaimed for r in result.records)
+    if not (admitted or preempted or expendable or reclaimed):
+        return {}
+    return {
+        "preempt_admitted": admitted,
+        "preempted_pods": preempted,
+        "ticks_with_evictions": sum(
+            1 for r in result.records if r.preempted
+        ),
+        "pending_expendable": expendable,
+        "spot_reclaimed": reclaimed,
+    }
 
 
 def _perf_section(result: RunResult) -> Dict[str, Any]:

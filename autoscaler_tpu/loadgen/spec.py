@@ -35,6 +35,10 @@ Faults (``FaultSpec.kind``) target the provider/kube boundary:
                         latency per call (recorded; optionally slept)
 - ``refresh_error``   — provider.refresh() raises → loop-level error path
 - ``eviction_error``  — evictions rejected (PDB analog) with ``probability``
+- ``spot_reclaim``    — bound pods with priority < ``priority_cutoff`` on
+                        the target group's nodes are re-pended (the cloud
+                        reclaiming spot capacity out from under low-priority
+                        work); drives the preemption-engine drills
 
 Device / API faults (this is what certifies the degradation ladder and the
 crash-only loop — see ARCHITECTURE.md "Resilience"):
@@ -114,6 +118,12 @@ FAULT_KINDS = (
     # `probability` (seeded RNG) — the flapping-endpoint case the
     # health-weighted picker exists to starve of first-attempt traffic
     "endpoint_flap",
+    # -- preemption chaos (ISSUE 16): the cloud reclaims spot capacity —
+    # bound pods with priority < `priority_cutoff` on the target group's
+    # nodes ("" = every group) are re-pended group-wide at the window
+    # start, refilling the pending queue with exactly the low-priority
+    # work the preemption engine and churn-aware expander must re-place
+    "spot_reclaim",
 )
 # estimator rungs a kernel_fault may target ("" = every device rung)
 KERNEL_FAULT_RUNGS = ("", "pallas", "xla")
@@ -160,6 +170,10 @@ class FaultSpec:
     # replica_restart / endpoint_flap: which fleet replica index the fault
     # targets (required >= 0 for those kinds; -1 = not a replica fault)
     replica: int = -1
+    # spot_reclaim: bound pods with priority strictly below this are
+    # re-pended (0 with the default pod priority of 0 reclaims nothing —
+    # a reclaim scenario must set it)
+    priority_cutoff: int = 0
     message: str = "injected fault"
 
     def __post_init__(self):
@@ -197,6 +211,16 @@ class FaultSpec:
             raise SpecError(
                 f"kernel_fault rung {self.rung!r} (one of {KERNEL_FAULT_RUNGS})"
             )
+        if self.priority_cutoff != 0 and self.kind != "spot_reclaim":
+            raise SpecError(
+                "fault field 'priority_cutoff' only applies to "
+                f"spot_reclaim, not {self.kind!r}"
+            )
+        if self.kind == "spot_reclaim" and self.priority_cutoff <= 0:
+            raise SpecError(
+                "spot_reclaim needs priority_cutoff > 0 (bound pods with "
+                "priority below it are re-pended; 0 reclaims nothing)"
+            )
 
     def active(self, tick: int) -> bool:
         if tick < self.start_tick:
@@ -218,6 +242,12 @@ class Event:
     # pod_burst: when > 0, pods carry a DoNotSchedule zone-spread
     # constraint with this max_skew (exercises the within-wave kernels)
     spread_zone_skew: int = 0
+    # pod_burst: PriorityClass value the pods carry (feeds the expendable
+    # cutoff, FOS ordering and the preemption engine's priority channel)
+    priority: int = 0
+    # pod_burst: "Never" pins preemptionPolicy=Never (the pods wait for
+    # capacity instead of evicting); "" = default policy (may preempt)
+    preemption_policy: str = ""
     fault: Optional[FaultSpec] = None
 
     def __post_init__(self):
@@ -227,6 +257,11 @@ class Event:
             raise SpecError(f"event at_tick {self.at_tick} is negative")
         if self.kind == "fault" and self.fault is None:
             raise SpecError("fault event without a fault payload")
+        if self.preemption_policy not in ("", "Never"):
+            raise SpecError(
+                f"unknown preemption_policy {self.preemption_policy!r} "
+                "(one of '', 'Never')"
+            )
 
 
 @dataclass
@@ -246,6 +281,11 @@ class WorkloadSpec:
     # fraction of arrived pods completing per tick (drain_heavy churns hard)
     completion_rate: float = 0.0
     spread_zone_skew: int = 0
+    # PriorityClass value every pod of this workload carries, and whether
+    # those pods may preempt ("" = default policy; "Never" = wait-only) —
+    # threaded verbatim into the expanded pod_burst events
+    priority: int = 0
+    preemption_policy: str = ""
     labels: Dict[str, str] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -255,6 +295,11 @@ class WorkloadSpec:
             )
         if self.rate < 0:
             raise SpecError(f"workload rate {self.rate} is negative")
+        if self.preemption_policy not in ("", "Never"):
+            raise SpecError(
+                f"unknown preemption_policy {self.preemption_policy!r} "
+                "(one of '', 'Never')"
+            )
 
 
 @dataclass

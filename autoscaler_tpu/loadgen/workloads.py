@@ -60,6 +60,8 @@ def _expand_one(
                     labels={"workload": prefix, **wl.labels},
                     prefix=prefix,
                     spread_zone_skew=wl.spread_zone_skew,
+                    priority=wl.priority,
+                    preemption_policy=wl.preemption_policy,
                 )
             )
             arrived += n

@@ -231,6 +231,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="comma-separated PxNxR power-of-two prewarm "
                         "buckets for the arena apply-kernel ladder (same "
                         "grammar as --fleet-shape-buckets; R is a cap)")
+    p.add_argument("--preemption-enabled", type=_bool_flag, default=False,
+                   help="priority-aware eviction packing each tick "
+                        "(autoscaler_tpu/preempt via ops/preempt.py): plan "
+                        "and actuate evictions of strictly-lower-priority "
+                        "residents for pending pods no node fits outright; "
+                        "off reproduces today's decisions byte-for-byte")
+    p.add_argument("--preemption-churn-weight", type=float, default=0.0,
+                   help="expander score penalty per eviction a scale-up "
+                        "option leaves standing (0 = churn-blind ranking)")
     p.add_argument("--debugging-snapshot-enabled", type=_bool_flag, default=True,
                    help="serve /snapshotz captures")
     p.add_argument("--tracing-enabled", type=_bool_flag, default=True,
@@ -456,6 +465,8 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         slo_enabled=args.slo_enabled,
         arena_enabled=args.arena_enabled,
         arena_buckets=args.arena_buckets,
+        preemption_enabled=args.preemption_enabled,
+        preemption_churn_weight=args.preemption_churn_weight,
         compile_cache_dir=args.compile_cache_dir,
         gym_rollout_workers=args.gym_rollout_workers,
         gym_objective_weights=args.gym_objective_weights,

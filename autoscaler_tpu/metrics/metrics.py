@@ -41,6 +41,7 @@ FLEET_SUBMIT = "fleetSubmit"  # one tenant's admission into the coalescing queue
 FLEET_PREWARM = "fleetPrewarm"  # startup bucket pre-warm sweep (autoscaler_tpu/fleet)
 RPC_SERVE = "rpcServe"  # sidecar-side serving span per RPC; adopts the caller's trace context (rpc/service)
 SLO_WINDOW = "sloWindow"  # per-tick SLO burn-rate window computation (autoscaler_tpu/slo)
+PREEMPT_PLAN = "preemptPlan"  # per-tick eviction-packing pass (autoscaler_tpu/preempt)
 GYM_ROLLOUT = "gymRollout"  # one policy-gym candidate episode (autoscaler_tpu/gym)
 GYM_GENERATION = "gymGeneration"  # one tuner generation: sample + evaluate + prune (autoscaler_tpu/gym)
 
@@ -575,6 +576,25 @@ class AutoscalerMetrics:
             p + "arena_full_uploads_total",
             "full tensor re-seeds of the device arena (init, bucket "
             "promotion, schema change, fault rollback)",
+        )
+        # -- preemption engine (autoscaler_tpu/preempt) -----------------------
+        # pending pods silently dropped by the expendable cutoff used to
+        # vanish without a trace (static_autoscaler.go:471 parity); now
+        # counted AND ledgered (reason expendable_below_cutoff)
+        self.pending_expendable_total = r.counter(
+            p + "pending_expendable_total",
+            "pending pods dropped below --expendable-pods-priority-cutoff",
+        )
+        # evictions the CURRENT plan would perform — a per-tick gauge (like
+        # unneeded_nodes_count), distinct from evicted_pods_total which
+        # counts actuated evictions
+        self.preemption_planned_evictions = r.gauge(
+            p + "preemption_planned_evictions",
+            "evictions planned by this tick's preemption pass",
+        )
+        self.preempted_pods_total = r.counter(
+            p + "preempted_pods_total",
+            "pods actually evicted by the preemption engine",
         )
         self.estimation_over_budget_total = r.counter(
             p + "estimation_over_budget_total",

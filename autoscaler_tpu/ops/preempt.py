@@ -1,0 +1,168 @@
+"""Priority-aware eviction packing — the `ffd_binpack_preempt` family.
+
+Reference semantics: the CA itself never evicts for priority — preemption
+lives in the scheduler (pkg/scheduler/framework/preemption) — but its
+PriorityClass/preemptionPolicy model is the contract this kernel mirrors:
+a pending pod whose preemptionPolicy is not Never may displace strictly-
+lower-priority running pods when no node fits it outright, and victims are
+chosen to minimize preemption cost. Here the whole pass is one lax.scan
+over the pending pods against the EXISTING node set (not template nodes —
+scale-up still owns capacity growth; this kernel answers "what could be
+admitted onto the cluster as-is, and at what eviction cost").
+
+Victim selection is a closed greedy spec shared bit-for-bit with the
+serial numpy oracle (estimator/reference_impl.ffd_binpack_preempt_reference):
+per candidate node, victims are taken in global (priority asc, pod row asc)
+order until the pod fits — the minimal such prefix — and the node is chosen
+by lexicographic (victim count, aggregate victim priority, node row). This
+is the "fewest evictions, then lowest aggregate priority" cost order; like
+the scheduler's own heuristic it approximates minimum-cost eviction (exact
+minimality is a knapsack) but does so identically on every rung.
+
+Each scan step materializes a [P, N, R] cumulative-free tensor, so the
+pass is O(P²·N·R) — sized for control-loop worlds (the padded snapshot
+buckets), not the 100k-pod fleet shapes; PREDICATES.md records the caveat.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from autoscaler_tpu.ops.binpack import ffd_scores
+from autoscaler_tpu.ops.telemetry import observed
+
+# "worse than any real cost" sentinel in the node-selection tie-break chain
+_COST_INF = jnp.int32(2**30)
+
+# Machine-readable kernel contracts (graftlint GL007, analysis/contracts.py).
+# The P axis carries ALL pods — pending (pod_node < 0), resident, padding —
+# so victim rows and evictor rows index one shared space; aggregate victim
+# priority is summed in i32 (|priority|·P must stay under 2^31, true for
+# any real PriorityClass world).
+KERNEL_CONTRACTS = {
+    "ffd_binpack_preempt": {
+        "args": {
+            "pod_req": {"dims": ["P", "R"], "dtype": "f32"},
+            "pod_valid": {"dims": ["P"], "dtype": "bool"},
+            "pod_node": {"dims": ["P"], "dtype": "i32"},
+            "pod_priority": {"dims": ["P"], "dtype": "i32"},
+            "pod_can_preempt": {"dims": ["P"], "dtype": "bool"},
+            "pod_evictable": {"dims": ["P"], "dtype": "bool"},
+            "node_alloc": {"dims": ["N", "R"], "dtype": "f32"},
+            "node_used": {"dims": ["N", "R"], "dtype": "f32"},
+            "node_valid": {"dims": ["N"], "dtype": "bool"},
+            "sched_mask": {"dims": ["P", "N"], "dtype": "bool"},
+        },
+        "notes": "O(P^2*N*R) scan; no Pallas twin (control-loop shapes only)",
+    },
+}
+
+
+class PreemptResult(NamedTuple):
+    scheduled: jax.Array    # [P] bool — pending pod admitted (direct or evicting)
+    placed_node: jax.Array  # [P] i32 — node row it landed on, -1 otherwise
+    victim_of: jax.Array    # [P] i32 — evictor's pod row, -1 = not evicted
+
+
+@observed
+@jax.jit
+def ffd_binpack_preempt(
+    pod_req: jax.Array,         # [P, R] — ALL pods (pending + resident)
+    pod_valid: jax.Array,       # [P] bool
+    pod_node: jax.Array,        # [P] i32 — resident's node row, -1 pending
+    pod_priority: jax.Array,    # [P] i32
+    pod_can_preempt: jax.Array,  # [P] bool — pending: policy != Never
+    pod_evictable: jax.Array,    # [P] bool — resident: may be a victim
+    node_alloc: jax.Array,      # [N, R] f32
+    node_used: jax.Array,       # [N, R] f32 — includes residents' requests
+    node_valid: jax.Array,      # [N] bool
+    sched_mask: jax.Array,      # [P, N] bool — non-resource predicates
+) -> PreemptResult:
+    """Pack pending pods onto the existing nodes in (priority desc, FFD
+    score desc, pod row asc) order; a pod that fits nowhere directly may
+    evict strictly-lower-priority residents per the victim spec above.
+    Pods admitted this pass occupy capacity but are never victims."""
+    P = pod_req.shape[0]
+    N = node_alloc.shape[0]
+
+    # packing order: priority desc, then the ONE FFD score spec against the
+    # elementwise-max valid allocatable row (heterogeneous nodes have no
+    # single template; any fixed positive weights give a deterministic
+    # order and max is exact in f32), then pod row asc (stable argsorts)
+    cap_row = jnp.max(jnp.where(node_valid[:, None], node_alloc, 0.0), axis=0)
+    score = ffd_scores(pod_req, cap_row)
+    sorder = jnp.argsort(-score, stable=True)
+    order = sorder[jnp.argsort(-pod_priority[sorder], stable=True)]
+    # global victim order: priority asc, pod row asc
+    vorder = jnp.argsort(pod_priority, stable=True)
+    prio_sorted = pod_priority[vorder]
+    req_sorted = pod_req[vorder]
+    vnode_sorted = pod_node[vorder]
+    evict_sorted = pod_evictable[vorder]
+    node_ids = jnp.arange(N)
+    positions = jnp.arange(P)
+
+    def step(carry, i):
+        used, alive, scheduled, placed, victim_of = carry
+        req = pod_req[i]
+        ok = sched_mask[i] & node_valid                             # [N]
+        free = node_alloc - used                                    # [N, R]
+        fits = ok & jnp.all(req[None, :] <= free, axis=1)           # [N]
+        has_direct = fits.any()
+        direct_n = jnp.argmax(fits)                                 # lowest row
+
+        # victim candidacy in sorted space, restricted per node
+        cand = alive[vorder] & evict_sorted & (prio_sorted < pod_priority[i])
+        onnode = (vnode_sorted[:, None] == node_ids[None, :]) & cand[:, None]
+        contrib = jnp.where(onnode[:, :, None], req_sorted[:, None, :], 0.0)
+        cumfree = jnp.cumsum(contrib, axis=0)                       # [P, N, R]
+        cap_ok = ok & jnp.all(req[None, :] <= node_alloc, axis=1)   # [N]
+        fit_k = cap_ok[None, :] & jnp.all(
+            req[None, None, :] <= free[None, :, :] + cumfree, axis=2
+        )                                                           # [P, N]
+        feasible = fit_k.any(axis=0)                                # [N]
+        k_min = jnp.argmax(fit_k, axis=0)                           # [N]
+        vict = onnode & (positions[:, None] <= k_min[None, :])      # [P, N]
+        nvict = vict.sum(axis=0).astype(jnp.int32)                  # [N]
+        aggprio = jnp.sum(
+            jnp.where(vict, prio_sorted[:, None], 0), axis=0
+        ).astype(jnp.int32)                                         # [N]
+        # lexicographic (victim count, aggregate priority, node row) argmin
+        key1 = jnp.where(feasible, nvict, _COST_INF)
+        t2 = feasible & (nvict == key1.min())
+        key2 = jnp.where(t2, aggprio, _COST_INF)
+        t3 = t2 & (aggprio == key2.min())
+        best_n = jnp.argmax(t3).astype(jnp.int32)
+
+        is_pend = pod_valid[i] & (pod_node[i] < 0)
+        do_direct = is_pend & has_direct
+        do_preempt = (
+            is_pend & ~has_direct & pod_can_preempt[i] & feasible.any()
+        )
+        place = do_direct | do_preempt
+        target = jnp.where(do_direct, direct_n, best_n).astype(jnp.int32)
+        vict_orig = (
+            jnp.zeros((P,), bool).at[vorder].set(vict[:, best_n]) & do_preempt
+        )
+        freed = jnp.sum(jnp.where(vict_orig[:, None], pod_req, 0.0), axis=0)
+        delta = jnp.where(place, req, 0.0) - jnp.where(do_preempt, freed, 0.0)
+        used = used.at[target].add(delta)
+        alive = alive & ~vict_orig
+        victim_of = jnp.where(vict_orig, i.astype(jnp.int32), victim_of)
+        scheduled = scheduled.at[i].set(place)
+        placed = placed.at[i].set(jnp.where(place, target, jnp.int32(-1)))
+        return (used, alive, scheduled, placed, victim_of), None
+
+    init = (
+        node_used,
+        pod_valid & (pod_node >= 0),       # residents alive at entry
+        jnp.zeros((P,), bool),
+        jnp.full((P,), -1, jnp.int32),
+        jnp.full((P,), -1, jnp.int32),
+    )
+    (_, _, scheduled, placed, victim_of), _ = jax.lax.scan(step, init, order)
+    return PreemptResult(
+        scheduled=scheduled, placed_node=placed, victim_of=victim_of
+    )

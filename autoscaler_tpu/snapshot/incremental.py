@@ -233,6 +233,8 @@ class IncrementalPacker:
         self._pod_req = np.zeros((PP, R), np.float32)
         self._pod_valid = np.zeros((PP,), bool)
         self._pod_node = np.full((PP,), -1, np.int32)
+        self._pod_priority = np.zeros((PP,), np.int32)
+        self._pod_preempt = np.zeros((PP,), bool)
         # int32 natively: _assemble hands these straight to _upload, and a
         # per-loop astype would be an O(world) copy even on idle loops
         self._pod_class = np.full((PP,), -1, np.int32)
@@ -425,6 +427,8 @@ class IncrementalPacker:
             self._pod_class[i] = slot.class_id
             self._pod_req[i] = resources_row(slot.orig.requests, 1.0, self._ext_schema)
             self._pod_valid[i] = True
+            self._pod_priority[i] = slot.orig.priority
+            self._pod_preempt[i] = slot.orig.preemption_policy != "Never"
 
         # ---- group map ---------------------------------------------------
         if group_of_node != self._group_map:
@@ -565,7 +569,10 @@ class IncrementalPacker:
         self._override_prev = {(i, j) for i, j, _ in overrides}
 
         if dirty_pod_rows:
-            self._dirty_fields.update(("pod_req", "pod_valid", "pod_class"))
+            self._dirty_fields.update(
+                ("pod_req", "pod_valid", "pod_class",
+                 "pod_priority", "pod_preempt")
+            )
         if dirty_node_rows:
             self._dirty_fields.update(
                 ("node_alloc", "node_valid", "node_class")
@@ -698,13 +705,18 @@ class IncrementalPacker:
         self._pod_class[last] = -1
         self._pod_node[last] = -1
         self._pod_req[last] = 0.0
+        self._pod_priority[last] = 0
+        self._pod_preempt[last] = False
         if self._mask is not None:
             self._mask[last, :] = False
             # the swap-fill rewrote host rows in place — the device copy is
             # stale even though no row is "dirty" in the profile sense
             self._dirty_fields.add("sched_mask")
             self._mask_rows_d.update((row, last))
-        self._dirty_fields.update(("pod_valid", "pod_class", "pod_node", "pod_req"))
+        self._dirty_fields.update(
+            ("pod_valid", "pod_class", "pod_node", "pod_req",
+             "pod_priority", "pod_preempt")
+        )
         self._d_pod_rows.update((row, last))
         self._d_pod_node.update((row, last))
 
@@ -745,6 +757,8 @@ class IncrementalPacker:
         self._pod_valid[dst] = self._pod_valid[src]
         self._pod_node[dst] = self._pod_node[src]
         self._pod_class[dst] = self._pod_class[src]
+        self._pod_priority[dst] = self._pod_priority[src]
+        self._pod_preempt[dst] = self._pod_preempt[src]
         self._d_pod_rows.add(dst)
         self._d_pod_node.add(dst)
         if self._mask is not None:
@@ -1105,6 +1119,8 @@ class IncrementalPacker:
             pod_req=self._pod_req,
             pod_valid=self._pod_valid,
             pod_node=self._pod_node,
+            pod_priority=self._pod_priority,
+            pod_preempt=self._pod_preempt,
         )
         if self._dense:
             host["sched_mask"] = self._mask
@@ -1132,6 +1148,8 @@ class IncrementalPacker:
             if self._d_pod_rows:
                 rows_op("pod_req", self._pod_req, self._d_pod_rows)
                 rows_op("pod_valid", self._pod_valid, self._d_pod_rows)
+                rows_op("pod_priority", self._pod_priority, self._d_pod_rows)
+                rows_op("pod_preempt", self._pod_preempt, self._d_pod_rows)
                 if not self._dense:
                     rows_op("pod_class", self._pod_class, self._d_pod_rows)
             if self._d_pod_node:
@@ -1214,6 +1232,8 @@ class IncrementalPacker:
             pod_req=bufs["pod_req"],
             pod_valid=bufs["pod_valid"],
             pod_node=bufs["pod_node"],
+            pod_priority=bufs["pod_priority"],
+            pod_preempt=bufs["pod_preempt"],
         )
         if self._dense:
             return SnapshotTensors(sched_mask=bufs["sched_mask"], **common)
@@ -1248,6 +1268,8 @@ class IncrementalPacker:
             pod_req=self._upload("pod_req", self._pod_req),
             pod_valid=self._upload("pod_valid", self._pod_valid),
             pod_node=self._upload("pod_node", self._pod_node),
+            pod_priority=self._upload("pod_priority", self._pod_priority),
+            pod_preempt=self._upload("pod_preempt", self._pod_preempt),
         )
         if self._dense:
             tensors = SnapshotTensors(

@@ -948,6 +948,8 @@ def pack(
     pod_req = np.zeros((PP, R), np.float32)
     pod_valid = np.zeros((PP,), bool)
     pod_node = np.full((PP,), -1, np.int32)
+    pod_priority = np.zeros((PP,), np.int32)
+    pod_preempt = np.zeros((PP,), bool)
 
     node_of_pod = []
     for i, pod in enumerate(meta.pods):
@@ -964,6 +966,8 @@ def pack(
     resources_rows([p.requests for p in meta.pods], 1.0, pod_req, ext)
     pod_valid[:P] = True
     if P:
+        pod_priority[:P] = [p.priority for p in meta.pods]
+        pod_preempt[:P] = [p.preemption_policy != "Never" for p in meta.pods]
         nop = np.asarray(node_of_pod)
         pod_node[:P] = nop
         placed = nop >= 0
@@ -978,6 +982,8 @@ def pack(
         pod_req=jnp.asarray(pod_req),
         pod_valid=jnp.asarray(pod_valid),
         pod_node=jnp.asarray(pod_node),
+        pod_priority=jnp.asarray(pod_priority),
+        pod_preempt=jnp.asarray(pod_preempt),
     )
     if dense_mask:
         sched_mask = np.zeros((PP, NN), bool)

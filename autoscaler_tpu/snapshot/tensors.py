@@ -77,6 +77,14 @@ class SnapshotTensors:
     pod_valid: jax.Array
     pod_node: jax.Array
     sched_mask: Optional[jax.Array] = None
+    # Preemption channels (ops/preempt.py consumes both; None on snapshots
+    # packed before the channels existed or by callers that skip them):
+    # - pod_priority: [P] i32 — spec.priority (0 on padding rows)
+    # - pod_preempt:  [P] bool — True unless preemptionPolicy=Never; for a
+    #   pending pod this gates "may evict", for a resident pod it is part
+    #   of the victim-eligibility mask (preempt/policy.py)
+    pod_priority: Optional[jax.Array] = None
+    pod_preempt: Optional[jax.Array] = None
     pod_class: Optional[jax.Array] = None
     node_class: Optional[jax.Array] = None
     class_mask: Optional[jax.Array] = None

@@ -1041,9 +1041,147 @@ def _fleet_ha_bench_main() -> int:
     return 0 if gate else 1
 
 
+def _preempt_bench_main(trials: int = 24) -> int:
+    """``bench.py --preempt [T]``: preemption contrast gate (ISSUE 16).
+
+    T randomized storm worlds (full-ish clusters of low-priority residents
+    plus a high-priority pending wave) through the eviction-capable packer
+    twice: preemption-AWARE (priority channels live) vs priority-BLIND
+    (flat priorities — nothing may evict, the pre-PR packing semantics).
+    Gates:
+
+    - oracle agreement: the device kernel's full decision triple —
+      admissions, placements AND the eviction set with each victim's
+      evictor — matches the serial numpy oracle on every world;
+    - dominance: aware admits >= blind on every world and strictly more
+      in aggregate (the storm shapes guarantee eviction helps);
+    - throughput envelope: steady-state aware dispatch stays within 25x
+      the blind dispatch median (same kernel, same shapes — the priority
+      channels must not blow up the scan) and under 2s absolute.
+
+    Exit 0 = gates met, 1 = missed, 2 = setup failure. hack/verify.sh
+    runs it with a small T."""
+    import jax
+
+    from autoscaler_tpu.estimator.reference_impl import (
+        ffd_binpack_preempt_reference,
+    )
+    from autoscaler_tpu.ops.preempt import ffd_binpack_preempt
+
+    rng = np.random.default_rng(1601)
+    P, N, R = 96, 12, 2
+    aware_admits, blind_admits, evictions = [], [], 0
+    aware_walls, blind_walls = [], []
+    mismatches = []
+    for t in range(trials):
+        node_alloc = np.zeros((N, R), np.float32)
+        node_alloc[:, 0] = rng.choice([4000.0, 8000.0], size=N)
+        node_alloc[:, 1] = 16384.0
+        node_valid = np.ones((N,), bool)
+        pod_req = np.zeros((P, R), np.float32)
+        pod_valid = np.zeros((P,), bool)
+        pod_node = np.full((P,), -1, np.int32)
+        pod_prio = np.zeros((P,), np.int32)
+        can_preempt = np.zeros((P,), bool)
+        evictable = np.zeros((P,), bool)
+        node_used = np.zeros((N, R), np.float32)
+        # residents: low-priority filler packed ~85% full round-robin
+        i = 0
+        for n in range(N):
+            while node_used[n, 0] < 0.85 * node_alloc[n, 0] and i < P - 24:
+                req = np.array(
+                    [float(rng.integers(300, 1200)),
+                     float(rng.integers(256, 1024))], np.float32,
+                )
+                if node_used[n, 0] + req[0] > node_alloc[n, 0]:
+                    break
+                pod_req[i] = req
+                pod_valid[i] = True
+                pod_node[i] = n
+                pod_prio[i] = int(rng.integers(0, 20))
+                evictable[i] = rng.random() > 0.1
+                node_used[n] += req
+                i += 1
+        # pending wave: high-priority, a few pinned preemptionPolicy=Never
+        n_pending = 24
+        for j in range(i, i + n_pending):
+            pod_req[j] = (
+                float(rng.integers(800, 2500)),
+                float(rng.integers(512, 2048)),
+            )
+            pod_valid[j] = True
+            pod_prio[j] = int(rng.integers(50, 200))
+            can_preempt[j] = rng.random() > 0.2
+        sched_mask = np.ones((P, N), bool)
+        flat_prio = np.zeros((P,), np.int32)
+        no_preempt = np.zeros((P,), bool)
+
+        def dispatch(prio, preempt):
+            t0 = time.perf_counter()
+            out = ffd_binpack_preempt(
+                pod_req, pod_valid, pod_node, prio, preempt, evictable,
+                node_alloc, node_used, node_valid, sched_mask,
+            )
+            res = tuple(np.asarray(x) for x in out)
+            return res, time.perf_counter() - t0
+
+        (a_sched, a_place, a_vict), a_wall = dispatch(pod_prio, can_preempt)
+        (b_sched, _b_place, b_vict), b_wall = dispatch(flat_prio, no_preempt)
+        if t > 0:  # skip the compile tick in the envelope
+            aware_walls.append(a_wall)
+            blind_walls.append(b_wall)
+        r_sched, r_place, r_vict = ffd_binpack_preempt_reference(
+            pod_req, pod_valid, pod_node, pod_prio, can_preempt, evictable,
+            node_alloc, node_used, node_valid, sched_mask,
+        )
+        if not (
+            np.array_equal(a_sched, r_sched)
+            and np.array_equal(a_place, r_place)
+            and np.array_equal(a_vict, r_vict)
+        ):
+            mismatches.append(t)
+        pending = pod_valid & (pod_node < 0)
+        aware_admits.append(int(np.sum(a_sched & pending)))
+        blind_admits.append(int(np.sum(b_sched & pending)))
+        evictions += int(np.sum(a_vict >= 0))
+        if int(np.sum(b_vict >= 0)) != 0:
+            mismatches.append(("blind-evicted", t))
+
+    med = lambda xs: sorted(xs)[len(xs) // 2] if xs else 0.0
+    aware_med, blind_med = med(aware_walls), med(blind_walls)
+    dominated = all(a >= b for a, b in zip(aware_admits, blind_admits))
+    gained = sum(aware_admits) > sum(blind_admits)
+    envelope_ok = aware_med <= max(25.0 * blind_med, 1e-4) and aware_med < 2.0
+    ok = not mismatches and dominated and gained and evictions > 0 and envelope_ok
+    report = {
+        "metric": "preempt_bench",
+        "platform": jax.default_backend(),
+        "trials": trials,
+        "pods": P,
+        "nodes": N,
+        "oracle_agreement": not mismatches,
+        "mismatched_trials": mismatches[:10],
+        "aware_admitted": sum(aware_admits),
+        "blind_admitted": sum(blind_admits),
+        "evictions": evictions,
+        "dominates_blind": dominated,
+        "strictly_gains": gained,
+        "aware_dispatch_median_s": round(aware_med, 5),
+        "blind_dispatch_median_s": round(blind_med, 5),
+        "envelope_ok": envelope_ok,
+        "gates_met": ok,
+    }
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 0 if ok else 1
+
+
 def main():
     if "--fleet-ha" in sys.argv:
         sys.exit(_fleet_ha_bench_main())
+    if "--preempt" in sys.argv:
+        idx = sys.argv.index("--preempt")
+        arg = sys.argv[idx + 1] if idx + 1 < len(sys.argv) else ""
+        sys.exit(_preempt_bench_main(int(arg) if arg.isdigit() else 24))
     if "--arena" in sys.argv:
         idx = sys.argv.index("--arena")
         arg = sys.argv[idx + 1] if idx + 1 < len(sys.argv) else ""

@@ -611,6 +611,73 @@ echo "== resident-arena steady-state gate (20k-pod CPU config: e2e <= 1.15x devi
 python bench.py --arena >/dev/null
 echo "arena bench gate ok"
 
+echo "== preemption gate (storm double-replay byte-identical; every eviction row names its evictor; disabled flag reproduces the preemption-less decisions byte-for-byte) =="
+preempt_tmp=$(mktemp -d)
+# priority storm on a capped pool: high-priority waves can only land by
+# evicting low-priority residents — the engine plans, the ledger names
+# every victim's evictor, and two replays must byte-match
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/preemption_storm.json \
+    --log "$preempt_tmp/a.log.json" --explain-ledger "$preempt_tmp/a.explain.jsonl" >/dev/null
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/preemption_storm.json \
+    --log "$preempt_tmp/b.log.json" --explain-ledger "$preempt_tmp/b.explain.jsonl" >/dev/null
+if ! diff -q "$preempt_tmp/a.explain.jsonl" "$preempt_tmp/b.explain.jsonl" >/dev/null; then
+    echo "ERROR: preemption decision ledger is nondeterministic across identical replays:" >&2
+    diff "$preempt_tmp/a.explain.jsonl" "$preempt_tmp/b.explain.jsonl" | head -20 >&2
+    exit 1
+fi
+if ! diff -q "$preempt_tmp/a.log.json" "$preempt_tmp/b.log.json" >/dev/null; then
+    echo "ERROR: preemption decision log is nondeterministic across identical replays:" >&2
+    exit 1
+fi
+# schema /2 validation (closed eviction vocabulary, every row names its
+# evictor) plus proof the storm actually planned and actuated evictions
+python bench.py --explain-ledger "$preempt_tmp/a.explain.jsonl" > "$preempt_tmp/report.json"
+python - "$preempt_tmp/report.json" "$preempt_tmp/a.explain.jsonl" "$preempt_tmp/a.log.json" <<'EOF'
+import json, sys
+report = json.load(open(sys.argv[1]))
+assert report["valid"], report["errors"]
+assert report.get("evictions", 0) > 0, "storm planned no evictions"
+rows = 0
+for line in open(sys.argv[2]):
+    rec = json.loads(line)
+    for row in (rec.get("preemption") or {}).get("evictions", []):
+        assert row.get("by"), f"eviction row without an evictor: {row}"
+        assert row.get("reason") == "preempted_by", row
+        rows += 1
+log = json.load(open(sys.argv[3]))
+actuated = sum(len(r["preempted"]) for r in log)
+assert actuated > 0, "storm actuated no evictions"
+print(f"preemption storm ok ({rows} eviction rows, {actuated} actuated, "
+      f"all name their evictor)")
+EOF
+# the SAME scenario with the feature flag off must reproduce the
+# decisions of a spec that never mentions preemption — byte-for-byte
+# (the engine, the schema section and the churn filter all disengage)
+python - "$preempt_tmp/stripped.json" <<'EOF'
+import json, sys
+doc = json.load(open("benchmarks/scenarios/preemption_storm.json"))
+doc["options"].pop("preemption_enabled", None)
+doc["options"].pop("preemption_churn_weight", None)
+json.dump(doc, open(sys.argv[1], "w"), indent=2)
+EOF
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/preemption_storm.json \
+    --set preemption_enabled=false \
+    --log "$preempt_tmp/off.log.json" --explain-ledger "$preempt_tmp/off.explain.jsonl" >/dev/null
+python -m autoscaler_tpu.loadgen run "$preempt_tmp/stripped.json" \
+    --log "$preempt_tmp/base.log.json" --explain-ledger "$preempt_tmp/base.explain.jsonl" >/dev/null
+if ! diff -q "$preempt_tmp/off.explain.jsonl" "$preempt_tmp/base.explain.jsonl" >/dev/null \
+   || ! diff -q "$preempt_tmp/off.log.json" "$preempt_tmp/base.log.json" >/dev/null; then
+    echo "ERROR: preemption_enabled=false diverges from the preemption-less baseline:" >&2
+    diff "$preempt_tmp/off.explain.jsonl" "$preempt_tmp/base.explain.jsonl" | head -20 >&2
+    exit 1
+fi
+rm -rf "$preempt_tmp"
+echo "preemption disabled-path parity ok"
+
+echo "== preemption contrast bench gate (aware admits strictly more than priority-blind; kernel-vs-oracle eviction sets agree on every world) =="
+python bench.py --preempt 8 >/dev/null
+echo "preempt bench gate ok"
+
 echo "== policy-gym tuning gate (double tune byte-identical; best score non-decreasing; winner strictly beats the all-defaults policy) =="
 gym_tmp=$(mktemp -d)
 # 2 generations x 4 candidates over the canned suite (diurnal + spike +

@@ -93,7 +93,11 @@ def test_every_ops_kernel_entry_declares_a_contract():
     """The ~8 dispatchable kernel entries all carry contracts — a new entry
     without one is invisible to GL007."""
     bp, _ = load_module_contracts(str(OPS / "binpack.py"))
-    names = set(bp) | set(PB_CONTRACTS) | set(PA_CONTRACTS) | set(PF_CONTRACTS)
+    pr, _ = load_module_contracts(str(OPS / "preempt.py"))
+    names = (
+        set(bp) | set(pr) | set(PB_CONTRACTS) | set(PA_CONTRACTS)
+        | set(PF_CONTRACTS)
+    )
     assert {
         "ffd_binpack",
         "ffd_binpack_groups",
@@ -103,6 +107,7 @@ def test_every_ops_kernel_entry_declares_a_contract():
         "ffd_binpack_groups_pallas",
         "ffd_binpack_groups_affinity_pallas",
         "pallas_fit_reduce",
+        "ffd_binpack_preempt",
     } <= names
 
 

@@ -362,10 +362,15 @@ class TestSuiteSpec:
     def test_canned_suite_parses(self):
         suite = SuiteSpec.load("benchmarks/scenarios/gym_suite.json")
         names = suite.scenario_names()
-        assert len(names) == 4
-        # the ISSUE's coverage: diurnal + spike + drain-heavy + kernel-fault
+        assert len(names) == 5
+        # coverage: diurnal + spike + drain-heavy + kernel-fault + a
+        # preemption storm (priority-carrying bursts under churn tuning)
         kinds = {w.kind for s in suite.scenarios for w in s.workloads}
         assert {"diurnal", "spike", "drain_heavy", "steady"} <= kinds
+        assert any(
+            e.priority > 0
+            for s in suite.scenarios for e in s.events
+        )
         assert any(
             e.fault is not None and e.fault.kind == "kernel_fault"
             for s in suite.scenarios for e in s.events

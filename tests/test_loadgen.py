@@ -78,9 +78,26 @@ class TestSpecRoundTrip:
     def test_canned_scenarios_parse(self):
         for name in ("burst_small", "diurnal_medium", "fault_backoff",
                      "drain_heavy", "kernel_fault_ladder",
-                     "device_lost_ladder"):
+                     "device_lost_ladder", "preemption_storm",
+                     "priority_inversion", "spot_reclaim"):
             spec = ScenarioSpec.load(f"benchmarks/scenarios/{name}.json")
             assert ScenarioSpec.from_json(spec.to_json()) == spec
+
+    def test_preemption_policy_vocab_closed(self):
+        with pytest.raises(SpecError, match="preemption_policy"):
+            Event(at_tick=0, kind="pod_burst", count=1,
+                  preemption_policy="PreemptLowerPriority")
+        with pytest.raises(SpecError, match="preemption_policy"):
+            WorkloadSpec(kind="steady", rate=1.0,
+                         preemption_policy="sometimes")
+
+    def test_spot_reclaim_needs_priority_cutoff(self):
+        with pytest.raises(SpecError, match="priority_cutoff"):
+            FaultSpec(kind="spot_reclaim", group="g", start_tick=2)
+        # and the field is scoped to spot_reclaim alone
+        with pytest.raises(SpecError, match="priority_cutoff"):
+            FaultSpec(kind="stuck_creating", group="g", start_tick=2,
+                      priority_cutoff=10)
 
 
 class TestWorkloadExpansion:

@@ -1,0 +1,357 @@
+"""Preemption engine tests (ISSUE 16): the eviction-capable packer
+(ops/preempt.ffd_binpack_preempt) against crafted worlds and the serial
+numpy oracle, the victim-eligibility policy, and the host engine's
+row→key plan mapping. The randomized kernel-vs-oracle parity lock is the
+slow suite at the bottom (same discipline as tests/test_kernels.py)."""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
+from autoscaler_tpu.estimator.reference_impl import (
+    ffd_binpack_preempt_reference,
+)
+from autoscaler_tpu.ops.preempt import ffd_binpack_preempt
+from autoscaler_tpu.preempt import PreemptionEngine, PreemptionPlan
+from autoscaler_tpu.preempt.policy import (
+    can_preempt,
+    evictable_mask,
+    victim_eligible,
+)
+from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
+from autoscaler_tpu.utils.test_utils import GB, MB, build_test_node, build_test_pod
+
+R = 2
+
+
+def _world(n_pods, n_nodes, node_cpu=4000.0, node_mem=16384.0):
+    """Empty operand set: callers fill rows."""
+    return dict(
+        pod_req=np.zeros((n_pods, R), np.float32),
+        pod_valid=np.zeros((n_pods,), bool),
+        pod_node=np.full((n_pods,), -1, np.int32),
+        pod_priority=np.zeros((n_pods,), np.int32),
+        pod_can_preempt=np.zeros((n_pods,), bool),
+        pod_evictable=np.zeros((n_pods,), bool),
+        node_alloc=np.tile(
+            np.array([node_cpu, node_mem], np.float32), (n_nodes, 1)
+        ),
+        node_used=np.zeros((n_nodes, R), np.float32),
+        node_valid=np.ones((n_nodes,), bool),
+        sched_mask=np.ones((n_pods, n_nodes), bool),
+    )
+
+
+def _resident(w, i, node, cpu, mem, prio, evictable=True):
+    w["pod_req"][i] = (cpu, mem)
+    w["pod_valid"][i] = True
+    w["pod_node"][i] = node
+    w["pod_priority"][i] = prio
+    w["pod_evictable"][i] = evictable
+    w["node_used"][node] += w["pod_req"][i]
+
+
+def _pending(w, i, cpu, mem, prio, preempt=True):
+    w["pod_req"][i] = (cpu, mem)
+    w["pod_valid"][i] = True
+    w["pod_priority"][i] = prio
+    w["pod_can_preempt"][i] = preempt
+
+
+def _run(w):
+    out = ffd_binpack_preempt(**w)
+    return tuple(np.asarray(x) for x in out)
+
+
+def _oracle(w):
+    return ffd_binpack_preempt_reference(
+        w["pod_req"], w["pod_valid"], w["pod_node"], w["pod_priority"],
+        w["pod_can_preempt"], w["pod_evictable"], w["node_alloc"],
+        w["node_used"], w["node_valid"], w["sched_mask"],
+    )
+
+
+# -- crafted kernel worlds ----------------------------------------------------
+
+
+class TestPreemptKernel:
+    def test_zero_eviction_world_direct_fits(self):
+        """Free capacity → every pending pod lands directly, nobody is
+        evicted (the disabled-semantics baseline)."""
+        w = _world(3, 2)
+        _pending(w, 0, 1000, 1024, 100)
+        _pending(w, 1, 1000, 1024, 50)
+        sched, placed, victim = _run(w)
+        assert sched[0] and sched[1]
+        assert (victim == -1).all()
+
+    def test_higher_priority_evicts_lower(self):
+        """A full node: the high-priority pending pod evicts the
+        low-priority resident and takes its place."""
+        w = _world(2, 1)
+        _resident(w, 0, 0, 4000, 1024, prio=5)
+        _pending(w, 1, 4000, 1024, prio=100)
+        sched, placed, victim = _run(w)
+        assert sched[1] and placed[1] == 0
+        assert victim[0] == 1        # resident evicted, names its evictor
+        assert not sched[0]
+
+    def test_never_policy_waits(self):
+        """preemptionPolicy=Never: the pod may not evict even when eviction
+        would fit it — it stays unscheduled on a full cluster."""
+        w = _world(2, 1)
+        _resident(w, 0, 0, 4000, 1024, prio=5)
+        _pending(w, 1, 4000, 1024, prio=100, preempt=False)
+        sched, _placed, victim = _run(w)
+        assert not sched[1]
+        assert (victim == -1).all()
+
+    def test_never_policy_still_takes_direct_fit(self):
+        w = _world(1, 1)
+        _pending(w, 0, 1000, 1024, prio=100, preempt=False)
+        sched, placed, _victim = _run(w)
+        assert sched[0] and placed[0] == 0
+
+    def test_equal_priority_is_not_a_victim(self):
+        """Only STRICTLY lower priority residents are evictable."""
+        w = _world(2, 1)
+        _resident(w, 0, 0, 4000, 1024, prio=100)
+        _pending(w, 1, 4000, 1024, prio=100)
+        sched, _placed, victim = _run(w)
+        assert not sched[1] and (victim == -1).all()
+
+    def test_ineligible_resident_never_evicted(self):
+        """The host eligibility mask (mirror/daemonset/terminating) vetoes
+        victimhood regardless of priority."""
+        w = _world(2, 1)
+        _resident(w, 0, 0, 4000, 1024, prio=5, evictable=False)
+        _pending(w, 1, 4000, 1024, prio=100)
+        sched, _placed, victim = _run(w)
+        assert not sched[1] and (victim == -1).all()
+
+    def test_minimal_victim_prefix(self):
+        """Evicting ONE resident frees enough — the second (higher-prio)
+        resident survives: victims are the minimal prefix of the global
+        priority-asc order."""
+        w = _world(3, 1)
+        _resident(w, 0, 0, 2000, 1024, prio=5)
+        _resident(w, 1, 0, 2000, 1024, prio=10)
+        _pending(w, 2, 2000, 1024, prio=100)
+        sched, placed, victim = _run(w)
+        assert sched[2] and placed[2] == 0
+        assert victim[0] == 2        # lowest priority goes first
+        assert victim[1] == -1
+
+    def test_node_choice_minimizes_evictions(self):
+        """Two candidate nodes: one fits after a single eviction, the
+        other needs two — the packer picks the single-eviction node."""
+        w = _world(4, 2)
+        _resident(w, 0, 0, 4000, 1024, prio=5)        # node 0: one victim
+        _resident(w, 1, 1, 2000, 1024, prio=5)        # node 1: two victims
+        _resident(w, 2, 1, 2000, 1024, prio=6)
+        _pending(w, 3, 4000, 1024, prio=100)
+        sched, placed, victim = _run(w)
+        assert sched[3] and placed[3] == 0
+        assert victim[0] == 3
+        assert victim[1] == -1 and victim[2] == -1
+
+    def test_admitted_pods_occupy_capacity(self):
+        """The first admitted pod consumes the freed space; the second
+        pending pod cannot double-book it."""
+        w = _world(3, 1)
+        _resident(w, 0, 0, 4000, 1024, prio=5)
+        _pending(w, 1, 4000, 1024, prio=100)
+        _pending(w, 2, 4000, 1024, prio=90)
+        sched, _placed, victim = _run(w)
+        assert sched[1] and not sched[2]
+        assert victim[0] == 1
+
+    def test_priority_order_beats_arrival_order(self):
+        """Pending pods pack in priority order: the later, higher-priority
+        row wins the one free slot."""
+        w = _world(2, 1)
+        _pending(w, 0, 4000, 1024, prio=10)
+        _pending(w, 1, 4000, 1024, prio=200)
+        sched, _placed, _victim = _run(w)
+        assert sched[1] and not sched[0]
+
+    def test_sched_mask_vetoes_preemption_target(self):
+        """A node the pod's predicates reject is no eviction target."""
+        w = _world(2, 1)
+        _resident(w, 0, 0, 4000, 1024, prio=5)
+        _pending(w, 1, 4000, 1024, prio=100)
+        w["sched_mask"][1, 0] = False
+        sched, _placed, victim = _run(w)
+        assert not sched[1] and (victim == -1).all()
+
+    def test_crafted_worlds_match_oracle(self):
+        """Every crafted world above is also an oracle parity case."""
+        worlds = []
+        w = _world(3, 1)
+        _resident(w, 0, 0, 2000, 1024, prio=5)
+        _resident(w, 1, 0, 2000, 1024, prio=10)
+        _pending(w, 2, 2000, 1024, prio=100)
+        worlds.append(w)
+        w = _world(4, 2)
+        _resident(w, 0, 0, 4000, 1024, prio=5)
+        _resident(w, 1, 1, 2000, 1024, prio=5)
+        _resident(w, 2, 1, 2000, 1024, prio=6)
+        _pending(w, 3, 4000, 1024, prio=100)
+        worlds.append(w)
+        for w in worlds:
+            k = _run(w)
+            o = _oracle(w)
+            for got, want in zip(k, o):
+                np.testing.assert_array_equal(got, want)
+
+
+# -- victim-eligibility policy ------------------------------------------------
+
+
+class TestPolicy:
+    def test_can_preempt_default_yes_never_no(self):
+        pod = build_test_pod("p")
+        assert can_preempt(pod)
+        assert not can_preempt(
+            dataclasses.replace(pod, preemption_policy="Never")
+        )
+
+    def test_victim_eligibility(self):
+        pod = build_test_pod("p", node_name="n0")
+        assert victim_eligible(pod)
+        assert not victim_eligible(dataclasses.replace(pod, mirror=True))
+        assert not victim_eligible(dataclasses.replace(pod, daemonset=True))
+        assert not victim_eligible(
+            dataclasses.replace(pod, restartable=False)
+        )
+        assert not victim_eligible(
+            dataclasses.replace(pod, deletion_ts=123.0)
+        )
+
+    def test_evictable_mask_alignment_and_padding(self):
+        pods = [
+            build_test_pod("a", node_name="n0"),
+            dataclasses.replace(
+                build_test_pod("b", node_name="n0"), mirror=True
+            ),
+        ]
+        mask = evictable_mask(pods, padded=4)
+        assert mask.shape == (4,)
+        assert mask[0] and not mask[1]
+        assert not mask[2] and not mask[3]   # padding rows are never victims
+
+
+# -- the host engine ----------------------------------------------------------
+
+
+def _snapshot(nodes, bound, pending):
+    snap = ClusterSnapshot()
+    for n in nodes:
+        snap.add_node(n)
+    for pod in bound:
+        snap.add_pod(pod, pod.node_name)
+    for pod in pending:
+        snap.add_pod(pod)
+    return snap
+
+
+class TestEngine:
+    def test_plan_maps_rows_to_keys(self):
+        node = build_test_node("n0", cpu_m=4000, mem=16 * GB)
+        low = build_test_pod(
+            "low", cpu_m=4000, mem=1 * GB, node_name="n0", priority=5
+        )
+        high = build_test_pod("high", cpu_m=4000, mem=1 * GB, priority=100)
+        engine = PreemptionEngine(BinpackingNodeEstimator())
+        plan = engine.plan(_snapshot([node], [low], [high]))
+        assert plan.admitted == [high.key()]
+        assert plan.placements[high.key()] == "n0"
+        assert plan.victims == {low.key(): high.key()}
+        assert plan.victim_pods[low.key()].name == "low"
+        assert plan.route in ("xla_preempt", "python_preempt_ref")
+        assert plan.eviction_count == 1
+        assert plan.evictions_by_pod() == {high.key(): [low.key()]}
+
+    def test_eligible_masks_out_settled_pending(self):
+        """Pending pods the loop already settled (expendable drops, FOS)
+        don't compete for admission; residents are unaffected."""
+        node = build_test_node("n0", cpu_m=4000, mem=16 * GB)
+        low = build_test_pod(
+            "low", cpu_m=4000, mem=1 * GB, node_name="n0", priority=5
+        )
+        high = build_test_pod("high", cpu_m=4000, mem=1 * GB, priority=100)
+        engine = PreemptionEngine(BinpackingNodeEstimator())
+        plan = engine.plan(_snapshot([node], [low], [high]), eligible=set())
+        assert plan.admitted == [] and plan.victims == {}
+
+    def test_priority_flat_snapshot_evicts_nothing(self):
+        """All-default-priority worlds (every pre-preemption scenario)
+        plan zero evictions — the engine is inert without priorities."""
+        node = build_test_node("n0", cpu_m=4000, mem=16 * GB)
+        bound = build_test_pod(
+            "bound", cpu_m=4000, mem=1 * GB, node_name="n0"
+        )
+        pend = build_test_pod("pend", cpu_m=4000, mem=1 * GB)
+        engine = PreemptionEngine(BinpackingNodeEstimator())
+        plan = engine.plan(_snapshot([node], [bound], [pend]))
+        assert plan.victims == {} and plan.admitted == []
+
+    def test_churn_counts_uncovered_evictors(self):
+        plan = PreemptionPlan(
+            victims={"v1": "e1", "v2": "e1", "v3": "e2"},
+        )
+        assert plan.churn(covered=set()) == 3
+        assert plan.churn(covered={"e1"}) == 1
+        assert plan.churn(covered={"e1", "e2"}) == 0
+
+
+# -- randomized kernel-vs-oracle parity (slow) --------------------------------
+
+
+def _random_world(rng):
+    P = int(rng.integers(4, 48))
+    N = int(rng.integers(1, 8))
+    w = _world(P, N, node_cpu=float(rng.choice([4000.0, 8000.0])))
+    i = 0
+    # residents: fill nodes to random depth with random priorities
+    for n in range(N):
+        budget = w["node_alloc"][n, 0] * rng.uniform(0.3, 1.0)
+        while i < P - 2 and w["node_used"][n, 0] < budget:
+            cpu = float(rng.integers(100, 2000))
+            if w["node_used"][n, 0] + cpu > w["node_alloc"][n, 0]:
+                break
+            _resident(
+                w, i, n, cpu, float(rng.integers(64, 1024)),
+                prio=int(rng.integers(0, 50)),
+                evictable=bool(rng.random() > 0.2),
+            )
+            i += 1
+    # pending: random priorities straddling the resident range, some Never
+    for j in range(i, int(min(i + rng.integers(1, 12), P))):
+        _pending(
+            w, j, float(rng.integers(200, 4000)),
+            float(rng.integers(128, 2048)),
+            prio=int(rng.integers(0, 120)),
+            preempt=bool(rng.random() > 0.25),
+        )
+    # random predicate vetoes
+    w["sched_mask"] &= rng.random((P, N)) > 0.1
+    return w
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("case", range(30))
+def test_kernel_matches_oracle_randomized(case):
+    """The full decision triple — admissions, placements, eviction sets
+    with each victim's evictor — agrees with the serial oracle on
+    randomized worlds (priorities, Never-policy pods, ineligible victims,
+    predicate vetoes, zero-eviction worlds included)."""
+    rng = np.random.default_rng((1600, case))
+    w = _random_world(rng)
+    kernel = _run(w)
+    oracle = _oracle(w)
+    for got, want in zip(kernel, oracle):
+        np.testing.assert_array_equal(got, want)

@@ -159,6 +159,58 @@ class TestEquivalence:
         sizes = sorted(len(g.pods) for g in groups)
         assert sizes == [1, 1, 5]
 
+    def test_distinct_priority_splits_groups(self):
+        from autoscaler_tpu.kube.objects import OwnerRef
+
+        # identical spec + owner, but different priorities: a sampled
+        # estimate for one must not be reused for the other — priority
+        # changes what the preemption route may evict to admit the pod
+        pods = [
+            build_test_pod(f"p{i}", priority=(i % 2) * 100) for i in range(6)
+        ]
+        for p in pods:
+            p.owner_ref = OwnerRef(kind="ReplicaSet", name="rs-1")
+        groups = build_pod_groups(pods)
+        assert sorted(len(g.pods) for g in groups) == [3, 3]
+
+    def test_distinct_preemption_policy_splits_groups(self):
+        from autoscaler_tpu.kube.objects import OwnerRef
+
+        pods = [build_test_pod(f"p{i}", priority=50) for i in range(4)]
+        for p in pods:
+            p.owner_ref = OwnerRef(kind="ReplicaSet", name="rs-1")
+        pods[0].preemption_policy = "Never"
+        pods[1].preemption_policy = "Never"
+        groups = build_pod_groups(pods)
+        assert sorted(len(g.pods) for g in groups) == [2, 2]
+
+    def test_grouping_randomized_priority_partition(self):
+        """Randomized: pods sharing owner+spec group together IFF they also
+        share (priority, preemption_policy) — the fingerprint partitions
+        exactly on those fields."""
+        import random
+
+        from autoscaler_tpu.kube.objects import OwnerRef
+
+        rng = random.Random(1602)
+        for _ in range(10):
+            pods = []
+            for i in range(rng.randint(4, 20)):
+                p = build_test_pod(
+                    f"p{i}", priority=rng.choice([0, 0, 10, 100])
+                )
+                p.owner_ref = OwnerRef(kind="ReplicaSet", name="rs-1")
+                p.preemption_policy = rng.choice(["", "", "Never"])
+                pods.append(p)
+            groups = build_pod_groups(pods)
+            want = {
+                (p.priority, p.preemption_policy) for p in pods
+            }
+            assert len(groups) == len(want)
+            for g in groups:
+                keys = {(p.priority, p.preemption_policy) for p in g.pods}
+                assert len(keys) == 1
+
 
 class TestResourceManager:
     def test_limits(self):

@@ -86,6 +86,37 @@ class TestPodListProcessor:
         assert [p.name for p in filtered] == ["high"]
         assert [p.name for p in still] == ["low"]
 
+    def test_equal_priority_tiebreak_is_order_independent(self):
+        """Regression: equal-priority pods used to be packed in caller-list
+        order, so the API listing's (non-replayed) order decided which pod
+        got the last slot. The pod-key secondary sort makes the outcome a
+        pure function of the pod SET."""
+        import random
+
+        pods = [
+            build_test_pod(f"p{i}", cpu_m=400, priority=7) for i in range(6)
+        ]
+        outcomes = set()
+        for seed in range(8):
+            s = ClusterSnapshot()
+            s.add_node(build_test_node("n0", cpu_m=900))  # two slots
+            shuffled = list(pods)
+            random.Random(seed).shuffle(shuffled)
+            for p in shuffled:
+                s.add_pod(p)
+            still, filtered = FilterOutSchedulablePodListProcessor().process(
+                s, shuffled
+            )
+            outcomes.add(
+                (
+                    tuple(sorted(p.name for p in filtered)),
+                    tuple(sorted(p.name for p in still)),
+                )
+            )
+        assert len(outcomes) == 1
+        (filtered_names, still_names), = outcomes
+        assert len(filtered_names) == 2 and len(still_names) == 4
+
 
 def build_world(groups, nodes_per_group, pods=(), **opt_kw):
     provider = TestCloudProvider()
