@@ -104,6 +104,7 @@ REPLAY_SCOPES = (
     "explain/",
     "fleet/",
     "gym/",
+    "journal/",
     "loadgen/",
     "perf/",
     "slo/",
@@ -1023,6 +1024,7 @@ GATED_ENDPOINTS = {
     "/perfz": "perf_enabled",
     "/explainz": "explain_enabled",
     "/sloz": "slo_enabled",
+    "/journalz": "journal_enabled",
     "/snapshotz": "debugger",
     "/debug/pprof": "profiling",
 }

@@ -61,6 +61,7 @@ GL004_THREADED_SCOPES = (
     "explain/",
     "fleet/",
     "gym/",
+    "journal/",
     "metrics/",
     "perf/",
     "slo/",

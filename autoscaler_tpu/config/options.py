@@ -122,6 +122,27 @@ class AutoscalingOptions:
     # how many recent per-tick decision records the in-memory ring keeps
     explain_ring_size: int = 64
 
+    # -- flight journal (autoscaler_tpu/journal) -----------------------------
+    # gates /journalz, like explain_enabled gates /explainz; the recorder
+    # itself always runs (bounded ring of keyframe+delta state records,
+    # negligible overhead) so time-travel history exists the moment the
+    # endpoint is enabled
+    journal_enabled: bool = True
+    # how many recent per-tick state records the in-memory ring keeps
+    journal_ring_size: int = 64
+    # write a full keyframe every K ticks even without a packer reseed or
+    # shape change: bounds how many deltas a reconstruction replays and how
+    # much history a ring eviction can strand behind a lost keyframe
+    journal_keyframe_interval: int = 16
+    # every N ticks, reconstruct the newest journaled tick and bit-compare
+    # it (plus its fit-kernel verdicts) against the live packer state —
+    # drift becomes a metric + trace event instead of a silently wrong
+    # forensic answer. 0 disables the probe.
+    journal_probe_interval: int = 0
+    # append the journal (the same strict record_line bytes as the ring) to
+    # this JSONL file for post-mortem reconstruct/diff/replay ("" = off)
+    journal_path: str = ""
+
     # -- resident device arena (autoscaler_tpu/snapshot/arena) ---------------
     # keep the packed snapshot tensors device-resident across ticks and ship
     # only delta scatters for dirtied rows (ROADMAP item 2); off = the cold

@@ -233,6 +233,24 @@ class StaticAutoscaler:
             )
             self._arena.prewarm(R=NUM_RESOURCES)
         self._packer = IncrementalPacker(arena=self._arena)
+        # flight journal (autoscaler_tpu/journal): the black-box recorder.
+        # Always on (bounded ring) — journal_enabled gates /journalz only,
+        # journal_path additionally appends to disk. The packer sink
+        # captures each tick's FIRST materialization (the decision-input
+        # state: ClusterSnapshot caches tensors per version and revert()
+        # restores the fork-time version, so that first materialization is
+        # exactly what the estimator/expander/preemption pass read);
+        # record_tick then pins it to the tick's decision record by hash.
+        from autoscaler_tpu.journal import JournalRecorder
+
+        self.journal = JournalRecorder(
+            ring_capacity=self.options.journal_ring_size,
+            keyframe_interval=self.options.journal_keyframe_interval,
+            path=self.options.journal_path,
+            options_doc=dataclasses.asdict(self.options),
+            metrics=self.metrics,
+        )
+        self._packer.journal_sink = self.journal.observe_update
 
     # -- one reconcile iteration (reference :288) ----------------------------
     def run_once(self, now_ts: float) -> RunOnceResult:
@@ -261,6 +279,9 @@ class StaticAutoscaler:
             # the decision record shares the perf record's tick id, so
             # /explainz, /perfz and /tracez line up by construction
             self.explainer.begin_tick(tick_id, now_ts)
+            # the journal line shares it too: /journalz drills down into
+            # the same tick the other rings describe
+            self.journal.begin_tick(tick_id)
             # the tick-duration SLI measures on the timeline seam: the
             # loadgen driver's synthetic clock makes the measured duration
             # (and every burn rate derived from it) replay byte-identically
@@ -300,6 +321,24 @@ class StaticAutoscaler:
                 # decisions that were made
                 with trace.span(metrics_mod.EXPLAIN_RECORD):
                     explain_rec = self.explainer.end_tick()
+                # journal the tick's state AFTER the decision record closes:
+                # the journal line carries the explain line's hash, pinning
+                # state history to decision history byte-for-byte
+                with trace.span(metrics_mod.JOURNAL_RECORD):
+                    self.journal.record_tick(explain_rec)
+                    probe_every = self.options.journal_probe_interval
+                    if probe_every > 0 and tick_id % probe_every == 0:
+                        verdict = self.journal.probe()
+                        if verdict.get("drift"):
+                            # a silently wrong forensic answer becomes an
+                            # alarm: counted, and stamped on the tick trace
+                            self.metrics.journal_probe_drift_total.inc()
+                            trace.add_event(
+                                "journal.probe_drift",
+                                tick=int(verdict.get("tick", -1)),
+                                fields=",".join(verdict.get("fields", ())),
+                                fit_drift=bool(verdict.get("fit_drift")),
+                            )
                 # SLO window: judge this tick's SLIs and compute burn
                 # rates — crash paths included, so a crashing loop still
                 # burns budget instead of going silent
@@ -635,6 +674,12 @@ class StaticAutoscaler:
             preempt_plan = self.preempt_engine.plan(
                 snapshot, eligible={p.key() for p in pending}
             )
+            # journal the eligible set: `journal replay` re-runs this exact
+            # pass on reconstructed state, and eligibility is a function of
+            # Pod objects the state tensors do not carry
+            self.journal.note(
+                "preempt_eligible", sorted(p.key() for p in pending)
+            )
             preempt_doc = {
                 "route": preempt_plan.route,
                 "admitted": preempt_plan.admitted,
@@ -700,6 +745,7 @@ class StaticAutoscaler:
                     p.key() for p in result.scale_up.pods_triggered
                 }
             evicted: List[str] = []
+            evict_failed: List[str] = []
             for victim in sorted(preempt_plan.victims):
                 if preempt_plan.victims[victim] in covered:
                     continue
@@ -709,6 +755,7 @@ class StaticAutoscaler:
                     result.errors.append(
                         f"preemption eviction of {victim} failed: {e}"
                     )
+                    evict_failed.append(victim)
                 else:
                     evicted.append(victim)
             if evicted:
@@ -721,6 +768,12 @@ class StaticAutoscaler:
             preempt_doc = dict(preempt_doc)
             preempt_doc["evicted"] = evicted
             self.explainer.note("preemption", preempt_doc)
+            # journal the actuation context: the evicted list is victims
+            # minus scale-up-covered evictors minus API failures — the
+            # coverage set and the failures are environment/decision state
+            # `journal replay` cannot re-derive from tensors alone
+            self.journal.note("preempt_covered", sorted(covered))
+            self.journal.note("preempt_evict_failed", evict_failed)
 
         # 7. scale-down branch (:582-691)
         if self.options.node_autoprovisioning_enabled:

@@ -55,6 +55,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                           "lifecycle SLIs) as JSONL (deterministic: two "
                           "runs of the same spec are byte-identical; "
                           "bench.py --slo-ledger validates)")
+    run.add_argument("--journal", default="",
+                     help="write the run's flight journal — per-tick "
+                          "keyframe/delta state records — as JSONL "
+                          "(deterministic: two runs of the same spec are "
+                          "byte-identical; python -m autoscaler_tpu.journal "
+                          "reconstructs/diffs/replays it, bench.py "
+                          "--journal-ledger validates)")
     run.add_argument("--seed", type=int, default=None,
                      help="override the spec's seed")
     run.add_argument("--set", action="append", default=[], dest="overrides",
@@ -81,6 +88,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     rep.add_argument("--perf-ledger", default="")
     rep.add_argument("--explain-ledger", default="")
     rep.add_argument("--slo-ledger", default="")
+    rep.add_argument("--journal", default="")
     rep.add_argument("--sanitize", action="store_true",
                      help="run under the determinism sanitizer (see run)")
 
@@ -98,8 +106,16 @@ def _write(path: str, doc) -> None:
 def _run(spec: ScenarioSpec, report_path: str, log_path: str,
          trace_path: str = "", real_sleep: bool = False,
          chrome_trace_path: str = "", perf_ledger_path: str = "",
-         explain_ledger_path: str = "", slo_ledger_path: str = "") -> int:
+         explain_ledger_path: str = "", slo_ledger_path: str = "",
+         journal_path: str = "") -> int:
     if spec.fleet is not None:
+        if journal_path:
+            # same loud failure as --explain-ledger: fleet drills run no
+            # control loop, so there is no packed state to journal
+            raise SpecError(
+                "--journal is not supported for fleet scenarios (no "
+                "control-loop state records)"
+            )
         if explain_ledger_path:
             # fail loudly: the fleet drill produces no run_once decision
             # records, and exiting 0 without the requested file would
@@ -151,6 +167,11 @@ def _run(spec: ScenarioSpec, report_path: str, log_path: str,
         # replays; bench.py --slo-ledger validates the burn arithmetic)
         with open(slo_ledger_path, "w") as f:
             f.write(result.slo_ledger_lines())
+    if journal_path:
+        # the byte-stable flight journal (hack/verify.sh diffs two replays
+        # then replays every tick against the decision ledger)
+        with open(journal_path, "w") as f:
+            f.write(result.journal_ledger_lines())
     return 0
 
 
@@ -233,7 +254,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                               chrome_trace_path=args.chrome_trace,
                               perf_ledger_path=args.perf_ledger,
                               explain_ledger_path=args.explain_ledger,
-                              slo_ledger_path=args.slo_ledger)
+                              slo_ledger_path=args.slo_ledger,
+                              journal_path=args.journal)
             return _sanitized(go) if args.sanitize else go()
         if args.command == "replay":
             with open(args.trace) as f:
@@ -249,7 +271,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                               chrome_trace_path=args.chrome_trace,
                               perf_ledger_path=args.perf_ledger,
                               explain_ledger_path=args.explain_ledger,
-                              slo_ledger_path=args.slo_ledger)
+                              slo_ledger_path=args.slo_ledger,
+                              journal_path=args.journal)
             return _sanitized(go) if args.sanitize else go()
         if args.command == "validate":
             with open(args.scenario) as f:

@@ -180,6 +180,11 @@ class RunResult:
     # run): SLI events on the timeline seam, burn rates as plain ratios —
     # byte-identical across replays, same contract
     slo_records: List[Dict[str, Any]] = field(default_factory=list)
+    # per-tick flight-journal records (autoscaler_tpu/journal ring, sized
+    # to the run): keyframe+delta state history, every value a pure
+    # function of the tick's packed state — byte-identical across replays,
+    # same contract
+    journal_records: List[Dict[str, Any]] = field(default_factory=list)
 
     def decision_log(self) -> List[Dict[str, Any]]:
         return [r.to_dict() for r in self.records]
@@ -198,6 +203,11 @@ class RunResult:
         from autoscaler_tpu.slo import record_line
 
         return "".join(record_line(rec) for rec in self.slo_records)
+
+    def journal_ledger_lines(self) -> str:
+        from autoscaler_tpu.journal import record_line
+
+        return "".join(record_line(rec) for rec in self.journal_records)
 
 
 class _FaultyCloudProvider(TestCloudProvider):
@@ -289,6 +299,9 @@ class ScenarioDriver:
         # decision explainer: ring sized to hold EVERY tick so the explain
         # JSONL ledger covers the whole run
         opts_kw["explain_ring_size"] = max(spec.ticks, 1)
+        # flight journal: same sizing, so the journal keeps every tick's
+        # state record and the journal JSONL covers the whole run
+        opts_kw["journal_ring_size"] = max(spec.ticks, 1)
         # two ticks of unneeded time by default: long enough that freshly
         # booted (still empty) capacity isn't reaped before the scheduler
         # analog binds pods, short enough that drain scenarios converge
@@ -703,6 +716,7 @@ class ScenarioDriver:
             perf_records=self.autoscaler.observatory.records(),
             explain_records=self.autoscaler.explainer.records(),
             slo_records=self.autoscaler.slo.records(),
+            journal_records=self.autoscaler.journal.records(),
         )
 
     def run(self) -> RunResult:

@@ -231,6 +231,9 @@ def build_report(
     slo = _slo_section(result)
     if slo:
         report["slo"] = slo
+    journal = _journal_section(result)
+    if journal:
+        report["journal"] = journal
     return report
 
 
@@ -431,6 +434,19 @@ def _slo_section(result) -> Dict[str, Any]:
     if not records:
         return {}
     from autoscaler_tpu.slo import summarize
+
+    return summarize(records)
+
+
+def _journal_section(result: RunResult) -> Dict[str, Any]:
+    """Flight-journal columns (autoscaler_tpu/journal ledger.summarize):
+    how the run's state history encoded — keyframe/delta split, promotion
+    reasons, delta-op volume and payload bytes. Zero-suppressed like the
+    other observability sections."""
+    records = getattr(result, "journal_records", None)
+    if not records:
+        return {}
+    from autoscaler_tpu.journal import summarize
 
     return summarize(records)
 

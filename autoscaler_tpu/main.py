@@ -275,6 +275,26 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--explain-ring-size", type=int, default=64,
                    help="how many recent per-tick decision records the "
                         "in-memory ring keeps")
+    p.add_argument("--journal-enabled", type=_bool_flag, default=True,
+                   help="serve /journalz (per-tick keyframe/delta state "
+                        "records — the black-box flight journal; the "
+                        "recorder itself always runs, bounded)")
+    p.add_argument("--journal-ring-size", type=int, default=64,
+                   help="how many recent per-tick state records the "
+                        "in-memory journal ring keeps")
+    p.add_argument("--journal-keyframe-interval", type=int, default=16,
+                   help="write a full journal keyframe every K ticks even "
+                        "without a packer reseed or shape change")
+    p.add_argument("--journal-probe-interval", type=int, default=0,
+                   help="every N ticks, reconstruct the newest journaled "
+                        "tick and bit-compare it (and its fit verdicts) "
+                        "against the live packer state; drift becomes a "
+                        "metric + trace event (0 = off)")
+    p.add_argument("--journal-path", default="",
+                   help="append the flight journal as JSONL to this file "
+                        "for post-mortem reconstruct/diff/replay "
+                        "(python -m autoscaler_tpu.journal; empty = "
+                        "in-memory ring only)")
     p.add_argument("--fleet-coalesce-window-ms", type=float, default=5.0,
                    help="fleet serving: how long the coalescer waits after "
                         "the first queued estimate request before "
@@ -450,6 +470,11 @@ def options_from_args(args: argparse.Namespace) -> AutoscalingOptions:
         perf_ring_size=args.perf_ring_size,
         explain_enabled=args.explain_enabled,
         explain_ring_size=args.explain_ring_size,
+        journal_enabled=args.journal_enabled,
+        journal_ring_size=args.journal_ring_size,
+        journal_keyframe_interval=args.journal_keyframe_interval,
+        journal_probe_interval=args.journal_probe_interval,
+        journal_path=args.journal_path,
         fleet_coalesce_window_ms=args.fleet_coalesce_window_ms,
         fleet_shape_buckets=args.fleet_shape_buckets,
         fleet_prewarm=args.fleet_prewarm,
@@ -707,6 +732,60 @@ class ObservabilityServer:
                         self._send(200, body, "application/json")
                     else:
                         self._send(200, engine.list_json(), "application/json")
+                elif self.path.startswith("/journalz"):
+                    # flight journal (autoscaler_tpu/journal): gated like
+                    # /explainz — the recorder always journals, the
+                    # endpoint is the opt-out. ?tick= drills into one
+                    # record, ?diff=a,b renders the semantic state diff
+                    # between two reconstructed ticks
+                    journal = getattr(autoscaler, "journal", None)
+                    enabled = getattr(
+                        autoscaler.options, "journal_enabled", True
+                    )
+                    if journal is None or not enabled:
+                        self._send(
+                            404, "flight journal disabled (--journal-enabled)"
+                        )
+                        return
+                    from urllib.parse import parse_qs, urlparse
+
+                    url = urlparse(self.path)
+                    if url.path.rstrip("/") not in ("", "/journalz"):
+                        self._send(404, "not found")
+                        return
+                    q = parse_qs(url.query)
+                    tick_raw = q.get("tick", [None])[0]
+                    diff_raw = q.get("diff", [None])[0]
+                    if tick_raw is not None:
+                        try:
+                            tick = int(tick_raw)
+                        except ValueError:
+                            self._send(400, f"bad tick {tick_raw!r}")
+                            return
+                        body = journal.detail_json(tick)
+                        if body is None:
+                            self._send(
+                                404, f"no journal record for tick {tick}"
+                            )
+                            return
+                        self._send(200, body, "application/json")
+                    elif diff_raw is not None:
+                        try:
+                            tick_a, tick_b = (
+                                int(t) for t in diff_raw.split(",")
+                            )
+                        except ValueError:
+                            self._send(
+                                400, f"bad diff {diff_raw!r} (want a,b)"
+                            )
+                            return
+                        self._send(
+                            200,
+                            journal.diff_json(tick_a, tick_b),
+                            "application/json",
+                        )
+                    else:
+                        self._send(200, journal.list_json(), "application/json")
                 elif self.path == "/status":
                     from autoscaler_tpu.clusterstate.status import build_status
 

@@ -36,6 +36,7 @@ KUBE_REQUEST = "kubeRequest"  # one control-plane HTTP request (incl. retries)
 RPC_CALL = "rpcCall"  # one sidecar RPC (incl. the single reconnect-resend)
 PERF_RECORD = "perfRecord"  # per-tick perf-ledger assembly (autoscaler_tpu/perf)
 EXPLAIN_RECORD = "explainRecord"  # per-tick decision-record assembly (autoscaler_tpu/explain)
+JOURNAL_RECORD = "journalRecord"  # per-tick state-journal assembly (autoscaler_tpu/journal)
 FLEET_DISPATCH = "fleetDispatch"  # one coalesced multi-tenant batch dispatch (autoscaler_tpu/fleet)
 FLEET_SUBMIT = "fleetSubmit"  # one tenant's admission into the coalescing queue (per-ticket origin span)
 FLEET_PREWARM = "fleetPrewarm"  # startup bucket pre-warm sweep (autoscaler_tpu/fleet)
@@ -576,6 +577,25 @@ class AutoscalerMetrics:
             p + "arena_full_uploads_total",
             "full tensor re-seeds of the device arena (init, bucket "
             "promotion, schema change, fault rollback)",
+        )
+        # -- flight journal (autoscaler_tpu/journal): the black-box state
+        # recorder. records/keyframes count journal volume; probe_drift is
+        # the alarm — a reconstructed tick that does not bit-match the live
+        # packer state (or flips a fit verdict) is a codec, shadow, or
+        # arena bug surfacing, never an acceptable steady state
+        self.journal_records_total = r.counter(
+            p + "journal_records_total",
+            "flight-journal records appended (keyframes + deltas)",
+        )
+        self.journal_keyframes_total = r.counter(
+            p + "journal_keyframes_total",
+            "full keyframes journaled (init, packer reseed, shape/options "
+            "change, every-K interval)",
+        )
+        self.journal_probe_drift_total = r.counter(
+            p + "journal_probe_drift_total",
+            "divergence-probe failures: reconstructed state or its fit "
+            "verdicts not bit-identical to the live packer",
         )
         # -- preemption engine (autoscaler_tpu/preempt) -----------------------
         # pending pods silently dropped by the expendable cutoff used to

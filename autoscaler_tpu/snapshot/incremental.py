@@ -174,6 +174,14 @@ class IncrementalPacker:
         self._arena = arena
         self._arena_reseed = True          # next program must full-seed
         self._arena_reseed_reason = "init"
+        # flight-journal seam (autoscaler_tpu/journal): when attached, every
+        # update() hands its (tensors, meta) to the sink — the recorder
+        # keeps the tick's FIRST materialization (the decision-input state)
+        # and journals it. last_repack_reason is the sticky twin of
+        # _arena_reseed_reason (which _assemble_arena consumes): the journal
+        # reads it after the fact to stamp keyframe promotions.
+        self.journal_sink = None
+        self.last_repack_reason = "init"
         # a faulted apply may have dropped that tick's aux uploads on the
         # floor — resend every aux field until an apply SUCCEEDS, or the
         # arena would serve stale factored-mask factors forever
@@ -290,6 +298,7 @@ class IncrementalPacker:
             # change is the ONE sanctioned full re-upload)
             self._arena_reseed = True
             self._arena_reseed_reason = "schema_change"
+            self.last_repack_reason = "schema_change"
             # on the tick trace a full re-pack is THE classic "why was this
             # tick slow" answer — stamp it with its cause
             trace.add_event("snapshot.full_repack", reason="schema_change")
@@ -298,6 +307,7 @@ class IncrementalPacker:
             self.full_packs += 1
             self._arena_reseed = True
             self._arena_reseed_reason = "capacity_growth"
+            self.last_repack_reason = "capacity_growth"
             trace.add_event("snapshot.full_repack", reason="capacity_growth")
         else:
             self.incremental_updates += 1
@@ -582,7 +592,10 @@ class IncrementalPacker:
         self._d_pod_rows.update(i for i in dirty_pod_rows if i < self._PP)
         self._d_node_rows.update(j for j in dirty_node_rows if j < self._NN)
 
-        return self._assemble(), self._build_meta()
+        tensors, meta = self._assemble(), self._build_meta()
+        if self.journal_sink is not None:
+            self.journal_sink(tensors, meta, self)
+        return tensors, meta
 
     # --------------------------------------------------------- slot plumbing
     def _pod_node_of(self, i: int) -> int:

@@ -734,6 +734,174 @@ def _gym_ledger_main(path: str) -> int:
     return 1 if errors else 0
 
 
+def _journal_ledger_main(path: str) -> int:
+    """``bench.py --journal-ledger <journal.jsonl>``: validate a flight
+    journal (schema, strict tick monotonicity, keyframe-first ordering,
+    closed keyframe-reason vocabulary, fingerprint/hash presence) and
+    prove every journaled tick reconstructs — a keyframe+delta chain that
+    validates but cannot be replayed into state is exactly the corruption
+    the typed reader errors exist to catch. Exit 0 = valid, 1 = schema or
+    reconstruction errors, 2 = unreadable journal. hack/verify.sh gates
+    on this."""
+    from autoscaler_tpu.journal import (
+        JournalError,
+        JournalReader,
+        load_jsonl,
+        summarize,
+        validate_records,
+    )
+
+    try:
+        records = load_jsonl(path)
+    except (OSError, ValueError) as e:
+        print(json.dumps({"metric": "journal_ledger", "error": str(e)}))
+        return 2
+    errors = validate_records(records)
+    reconstructed = 0
+    if not errors:
+        try:
+            reader = JournalReader(records)
+            for tick in reader.ticks():
+                reader.reconstruct(tick)
+                reconstructed += 1
+        except JournalError as e:
+            errors = [f"{type(e).__name__}: {e}"]
+    report = {
+        "metric": "journal_ledger",
+        "ledger": os.path.basename(path),
+        "valid": not errors,
+        # bounded: a corrupted journal must not flood CI logs
+        "errors": errors[:20],
+        "errors_total": len(errors),
+        "reconstructed": reconstructed,
+        **(summarize(records) if not errors else {}),
+    }
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 1 if errors else 0
+
+
+def _last_tpu_cache_path(repo_dir: str) -> str | None:
+    """Resolve the persisted TPU capture for reading: the current home
+    (``benchmarks/out/``) first, then the legacy repo-root location older
+    rounds wrote to. Returns None when neither exists."""
+    for cand in (
+        os.path.join(repo_dir, "benchmarks", "out", "bench_last_tpu.json"),
+        os.path.join(repo_dir, "bench_last_tpu.json"),
+    ):
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
+# committed benchmark trajectory: BENCH_r*.json at the repo root, one per
+# recorded round, each carrying the round's parsed headline record (and,
+# on CPU-fallback rounds, the last real TPU capture nested inside it)
+_TREND_GLOB = "BENCH_r*.json"
+# a live capture within 10% of the committed trajectory is noise; below
+# that it is a throughput regression the gate fails on
+_TREND_TOLERANCE = 0.9
+
+
+def _trend_points(repo_dir: str):
+    """Yield (round_n, record) benchmark points from every committed
+    BENCH_r*.json — the round's own parsed record plus any nested
+    last_tpu_capture (a stale-but-real TPU number a CPU fallback round
+    carried forward). Unreadable rounds are skipped: the gate judges the
+    trajectory that exists, it does not fail on archive rot."""
+    import glob
+
+    for p in sorted(glob.glob(os.path.join(repo_dir, _TREND_GLOB))):
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        n = doc.get("n")
+        parsed = doc.get("parsed")
+        if not isinstance(n, int) or not isinstance(parsed, dict):
+            continue
+        if isinstance(parsed.get("value"), (int, float)):
+            yield n, parsed
+        cap = parsed.get("last_tpu_capture")
+        if isinstance(cap, dict) and isinstance(cap.get("value"), (int, float)):
+            yield n, cap
+
+
+def _trend_main() -> int:
+    """``bench.py --trend``: gate the live TPU capture against the
+    committed BENCH_r*.json trajectory. For each (metric, platform)
+    config the newest committed round wins; a live capture below
+    ``_TREND_TOLERANCE`` of that committed value is a throughput
+    regression and fails the gate. No live capture (CPU-only host that
+    never ran the TPU bench) exits 0 — the gate judges regressions, it
+    does not demand a TPU. Exit 0 = on-trend or no evidence, 1 =
+    regression, 2 = unreadable live capture."""
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    cache = _last_tpu_cache_path(repo_dir)
+    if cache is None:
+        print(json.dumps({
+            "metric": "bench_trend",
+            "status": "no live capture — nothing to gate",
+        }, indent=2, sort_keys=True))
+        return 0
+    try:
+        with open(cache) as f:
+            live = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(json.dumps({"metric": "bench_trend", "error": str(e)}))
+        return 2
+    if not isinstance(live, dict) or not isinstance(
+        live.get("value"), (int, float)
+    ):
+        print(json.dumps({
+            "metric": "bench_trend",
+            "error": f"live capture {os.path.basename(cache)} has no value",
+        }))
+        return 2
+
+    # newest committed round wins per (metric, platform) config
+    committed = {}
+    for n, rec in _trend_points(repo_dir):
+        key = (rec.get("metric"), rec.get("platform"))
+        prev = committed.get(key)
+        if prev is None or n >= prev[0]:
+            committed[key] = (n, rec["value"])
+    key = (live.get("metric"), live.get("platform"))
+    baseline = committed.get(key)
+    report = {
+        "metric": "bench_trend",
+        "live_metric": live.get("metric"),
+        "live_platform": live.get("platform"),
+        "live_value": live["value"],
+        "rounds": len(committed),
+    }
+    if baseline is None:
+        # a brand-new config has no trajectory yet — it becomes one when
+        # its round is committed
+        report["status"] = "no committed round matches this config"
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0
+    floor = baseline[1] * _TREND_TOLERANCE
+    report.update({
+        "committed_round": baseline[0],
+        "committed_value": baseline[1],
+        "floor": floor,
+        "ok": live["value"] >= floor,
+    })
+    print(json.dumps(report, indent=2, sort_keys=True))
+    if live["value"] < floor:
+        print(
+            f"bench trend: {live.get('metric')} regressed to "
+            f"{live['value']:.1f} < {floor:.1f} "
+            f"(90% of round {baseline[0]}'s {baseline[1]:.1f})",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def _fleet_bench_main(tenants: int = 8) -> int:
     """``bench.py --fleet [K]``: the BASELINE config-5 mode — K simulated
     tenants through the coalescing fleet path vs. K sequential per-tenant
@@ -1221,6 +1389,15 @@ def main():
                   file=sys.stderr)
             sys.exit(2)
         sys.exit(_gym_ledger_main(sys.argv[idx + 1]))
+    if "--journal-ledger" in sys.argv:
+        idx = sys.argv.index("--journal-ledger")
+        if idx + 1 >= len(sys.argv):
+            print("usage: bench.py --journal-ledger <journal.jsonl>",
+                  file=sys.stderr)
+            sys.exit(2)
+        sys.exit(_journal_ledger_main(sys.argv[idx + 1]))
+    if "--trend" in sys.argv:
+        sys.exit(_trend_main())
     if os.environ.get(_CHILD_ENV) == "1":
         _bench_main()
         return
@@ -1295,15 +1472,19 @@ def main():
             # wedged tunnel degrades the round's evidence instead of
             # erasing it. The headline value/vs_baseline stay the honest
             # numbers of THIS run's platform.
-            cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "bench_last_tpu.json")
+            repo_dir = os.path.dirname(os.path.abspath(__file__))
+            # captures live under benchmarks/out/ (gitignored); reads fall
+            # back to the legacy repo-root file older rounds left behind
+            out_dir = os.path.join(repo_dir, "benchmarks", "out")
+            cache = os.path.join(out_dir, "bench_last_tpu.json")
             if result.get("platform") == "tpu":
                 try:
+                    os.makedirs(out_dir, exist_ok=True)
                     with open(cache, "w") as f:
                         json.dump({**result, "captured_at": time.time()}, f)
                 except OSError:
                     pass
-            elif os.path.exists(cache):
+            elif (cache := _last_tpu_cache_path(repo_dir)) is not None:
                 try:
                     with open(cache) as f:
                         cap = json.load(f)

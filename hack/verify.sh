@@ -678,6 +678,50 @@ echo "== preemption contrast bench gate (aware admits strictly more than priorit
 python bench.py --preempt 8 >/dev/null
 echo "preempt bench gate ok"
 
+echo "== flight-journal gate (storm double-journal byte-identical; every tick reconstructs and replays byte-for-byte against the decision ledger; keyframe promotions exercised) =="
+journal_tmp=$(mktemp -d)
+# the storm drives schema-change reseeds (new pools appear) on top of the
+# every-K interval policy, so the journal must exercise keyframe
+# promotion beyond the tick-0 init frame — and two identical replays must
+# write byte-identical journals (the determinism contract /journalz and
+# post-mortem replay both lean on)
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/preemption_storm.json \
+    --explain-ledger "$journal_tmp/a.explain.jsonl" \
+    --journal "$journal_tmp/a.journal.jsonl" >/dev/null
+python -m autoscaler_tpu.loadgen run benchmarks/scenarios/preemption_storm.json \
+    --explain-ledger "$journal_tmp/b.explain.jsonl" \
+    --journal "$journal_tmp/b.journal.jsonl" >/dev/null
+if ! diff -q "$journal_tmp/a.journal.jsonl" "$journal_tmp/b.journal.jsonl" >/dev/null; then
+    echo "ERROR: flight journal is nondeterministic across identical replays:" >&2
+    diff "$journal_tmp/a.journal.jsonl" "$journal_tmp/b.journal.jsonl" | head -20 >&2
+    exit 1
+fi
+# schema /1 validation plus proof every journaled tick reconstructs into
+# state (keyframe + delta chains all apply cleanly)
+python bench.py --journal-ledger "$journal_tmp/a.journal.jsonl" > "$journal_tmp/report.json"
+python - "$journal_tmp/report.json" <<'EOF'
+import json, sys
+report = json.load(open(sys.argv[1]))
+assert report["valid"], report["errors"]
+assert report["reconstructed"] == report["ticks"], report
+reasons = report["keyframe_reasons"]
+promoted = sum(v for k, v in reasons.items() if k != "init")
+assert promoted > 0, f"no keyframe promotion beyond init exercised: {reasons}"
+print(f"journal ok ({report['ticks']} ticks, {report['keyframes']} keyframes, "
+      f"reasons={reasons})")
+EOF
+# time-travel replay: reconstruct EVERY tick's decision-input state and
+# re-execute the preemption decision path on it — each re-derived ledger
+# section must byte-match the recorded explain line (exit 1 = divergence)
+python -m autoscaler_tpu.journal replay "$journal_tmp/a.journal.jsonl" \
+    --explain-ledger "$journal_tmp/a.explain.jsonl"
+rm -rf "$journal_tmp"
+echo "flight-journal replay parity ok"
+
+echo "== bench trend gate (live TPU capture must stay within 10% of the committed BENCH_r* trajectory) =="
+python bench.py --trend >/dev/null
+echo "bench trend gate ok"
+
 echo "== policy-gym tuning gate (double tune byte-identical; best score non-decreasing; winner strictly beats the all-defaults policy) =="
 gym_tmp=$(mktemp -d)
 # 2 generations x 4 candidates over the canned suite (diurnal + spike +

@@ -1,0 +1,580 @@
+"""Flight-journal tests (autoscaler_tpu/journal): the byte-exact delta
+codec, keyframe promotion policy, time-travel reconstruction parity
+against a keyframe-only ground truth, double-replay byte identity, the
+typed corruption matrix (truncation, missing keyframe, tick disorder,
+schema drift — always a typed error, never a wrong reconstruction),
+live-vs-replay divergence probes, /journalz (gating, drill-down, diff,
+ring-eviction race), the CLI, and the bench gates (--journal-ledger,
+--trend)."""
+import json
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from autoscaler_tpu.cloudprovider.test_provider import TestCloudProvider
+from autoscaler_tpu.config.options import AutoscalingOptions
+from autoscaler_tpu.core.static_autoscaler import StaticAutoscaler
+from autoscaler_tpu.journal import (
+    KEYFRAME_REASONS,
+    JournalReader,
+    MissingKeyframeError,
+    OutOfOrderTickError,
+    SCHEMA,
+    SchemaDriftError,
+    TruncatedJournalError,
+    record_line,
+    summarize,
+    validate_records,
+)
+from autoscaler_tpu.journal.codec import (
+    apply_ops,
+    changed_rows,
+    decode_array,
+    delta_ops,
+    encode_array,
+)
+from autoscaler_tpu.journal.replay import replay_journal
+from autoscaler_tpu.kube.api import FakeClusterAPI
+from autoscaler_tpu.main import ObservabilityServer
+from autoscaler_tpu.utils.test_utils import GB, build_test_node, build_test_pod
+
+STORM = "benchmarks/scenarios/preemption_storm.json"
+
+
+# ---------------------------------------------------------------- helpers
+def make_autoscaler(pods=(), **opt_kw):
+    provider = TestCloudProvider()
+    api = FakeClusterAPI()
+    provider.add_node_group(
+        "g", 0, 10, 1, build_test_node("t", cpu_m=1000, mem=2 * GB)
+    )
+    node = build_test_node("g-0", cpu_m=1000, mem=2 * GB)
+    provider.add_node("g", node)
+    api.add_node(node)
+    for p in pods:
+        api.add_pod(p)
+    return StaticAutoscaler(provider, api, AutoscalingOptions(**opt_kw))
+
+
+@pytest.fixture(scope="module")
+def storm_replays():
+    """The acceptance workload: the preemption storm journaled twice with
+    the default keyframe policy, plus a keyframe-every-tick ground-truth
+    run for reconstruction parity."""
+    from autoscaler_tpu.loadgen.driver import run_scenario
+    from autoscaler_tpu.loadgen.spec import ScenarioSpec
+
+    r1 = run_scenario(ScenarioSpec.load(STORM))
+    r2 = run_scenario(ScenarioSpec.load(STORM))
+    truth_spec = ScenarioSpec.load(STORM)
+    truth_spec.options["journal_keyframe_interval"] = 1
+    rt = run_scenario(truth_spec)
+    return r1, r2, rt
+
+
+# ------------------------------------------------------------------ codec
+class TestDeltaCodec:
+    def test_row_comparison_is_byte_exact(self):
+        """-0.0 == 0.0 and NaN != NaN under value comparison — the codec
+        must diff raw bytes or reconstruction is not bit-exact."""
+        a = np.array([[0.0, 1.0], [np.nan, 2.0]], dtype=np.float32)
+        b = np.array([[-0.0, 1.0], [np.nan, 2.0]], dtype=np.float32)
+        assert changed_rows(a, b).tolist() == [0]  # -0.0 differs in bits
+        # same NaN bits: unchanged
+        assert changed_rows(a, a.copy()).tolist() == []
+
+    def test_encode_decode_roundtrip_preserves_bits(self):
+        for arr in (
+            np.array([np.nan, -0.0, np.inf], dtype=np.float64),
+            np.arange(6, dtype=np.int32).reshape(2, 3),
+            np.array(7, dtype=np.int64),  # 0-d scalar field
+            np.zeros((0, 4), dtype=np.float32),  # empty axis
+        ):
+            out = decode_array(encode_array(arr))
+            assert out.dtype == arr.dtype and out.shape == arr.shape
+            assert out.tobytes() == arr.tobytes()
+
+    def test_delta_ops_scatter_roundtrip(self):
+        base = {"x": np.arange(12, dtype=np.float32).reshape(4, 3),
+                "n": np.array(3, dtype=np.int32)}
+        new = {"x": base["x"].copy(), "n": np.array(4, dtype=np.int32)}
+        new["x"][2] = [9.0, 9.0, 9.0]
+        ops = delta_ops(base, new)
+        fields = {k: v.copy() for k, v in base.items()}
+        apply_ops(fields, ops)
+        for k in new:
+            assert fields[k].tobytes() == new[k].tobytes()
+        # only the touched row and the scalar travel, not the full tensors
+        assert {op["field"] for op in ops} == {"x", "n"}
+
+    def test_apply_ops_rejects_drift(self):
+        base = {"x": np.zeros((2, 2), dtype=np.float32)}
+        with pytest.raises((KeyError, ValueError)):
+            apply_ops(base, [{"field": "ghost", "axis": 0, "idx": 0,
+                              "payload": encode_array(np.zeros(2))}])
+
+    def test_changed_rows_rejects_shape_drift(self):
+        with pytest.raises(ValueError):
+            changed_rows(np.zeros((2, 2)), np.zeros((3, 2)))
+
+
+# -------------------------------------------------- keyframe policy
+class TestKeyframePolicy:
+    def test_reason_vocabulary_closed(self):
+        assert "init" in KEYFRAME_REASONS
+        assert "interval" in KEYFRAME_REASONS
+        assert "reseed:capacity_growth" in KEYFRAME_REASONS
+
+    def test_storm_promotes_beyond_init(self, storm_replays):
+        """The storm grows new pools (schema-change reseeds) and runs past
+        the default interval — both promotion paths must fire."""
+        r1, _, _ = storm_replays
+        records = r1.journal_records
+        assert records[0]["kind"] == "keyframe"
+        assert records[0]["reason"] == "init"
+        reasons = [r["reason"] for r in records if r["kind"] == "keyframe"]
+        assert set(reasons) <= KEYFRAME_REASONS
+        assert len(reasons) > 1, "no promotion beyond the init frame"
+        assert any(r != "init" for r in reasons)
+        assert any(r["kind"] == "delta" for r in records)
+
+    def test_keyframe_every_tick_override(self, storm_replays):
+        _, _, rt = storm_replays
+        assert all(r["kind"] == "keyframe" for r in rt.journal_records)
+
+
+# --------------------------------------------- reconstruction parity
+class TestReconstructionParity:
+    def test_two_replays_write_byte_identical_journals(self, storm_replays):
+        r1, r2, _ = storm_replays
+        l1, l2 = r1.journal_ledger_lines(), r2.journal_ledger_lines()
+        assert l1 and l1 == l2
+        records = [json.loads(line) for line in l1.splitlines()]
+        assert validate_records(records) == []
+
+    def test_every_tick_reconstructs_bit_exact(self, storm_replays):
+        """Keyframe+delta chains must reproduce the keyframe-only ground
+        truth bit-for-bit at EVERY tick — fields, name tables, ext."""
+        r1, _, rt = storm_replays
+        reader = JournalReader(r1.journal_records)
+        truth = {r["tick"]: r for r in rt.journal_records}
+        assert reader.ticks() == sorted(truth)
+        for tick in reader.ticks():
+            state = reader.reconstruct(tick)
+            want = truth[tick]["state"]
+            want_fields = {
+                k: decode_array(doc) for k, doc in want["fields"].items()
+            }
+            assert set(state.fields) == set(want_fields), tick
+            for k, arr in want_fields.items():
+                got = state.fields[k]
+                assert got.dtype == arr.dtype and got.shape == arr.shape
+                assert got.tobytes() == arr.tobytes(), (tick, k)
+            assert state.names == want["names"], tick
+            assert list(state.ext) == list(want["ext"]), tick
+
+    def test_reconstructed_tensors_and_evictable(self, storm_replays):
+        r1, _, _ = storm_replays
+        reader = JournalReader(r1.journal_records)
+        state = reader.reconstruct(reader.ticks()[-1])
+        t = state.tensors()
+        # tensors are capacity-padded; name tables cover the live rows
+        assert t.num_pods == state.fields["pod_req"].shape[0]
+        assert 0 < len(state.names["pods"]) <= t.num_pods
+        assert 0 < len(state.names["nodes"]) <= t.num_nodes
+        ev = state.evictable()
+        assert ev.shape == (t.num_pods,)
+        # pod_evictable is journaled state, not a SnapshotTensors field
+        assert "pod_evictable" in state.fields
+        assert not hasattr(t, "pod_evictable")
+
+    def test_summarize_counts(self, storm_replays):
+        r1, _, _ = storm_replays
+        agg = summarize(r1.journal_records)
+        assert agg["ticks"] == r1.spec.ticks
+        assert agg["keyframes"] + agg["deltas"] == agg["ticks"]
+        assert agg["keyframe_reasons"]["init"] == 1
+
+
+# ------------------------------------------------- corruption matrix
+class TestCorruptionMatrix:
+    """A damaged journal must raise its typed error — never return a
+    wrong reconstruction."""
+
+    def test_truncated_file(self, storm_replays, tmp_path):
+        r1, _, _ = storm_replays
+        text = r1.journal_ledger_lines()
+        cut = tmp_path / "cut.jsonl"
+        cut.write_text(text[: len(text) // 2])  # mid-line cut
+        with pytest.raises(TruncatedJournalError):
+            JournalReader.from_path(str(cut))
+
+    def test_missing_keyframe(self, storm_replays):
+        r1, _, _ = storm_replays
+        deltas = [r for r in r1.journal_records if r["kind"] == "delta"]
+        reader = JournalReader(deltas)
+        with pytest.raises(MissingKeyframeError):
+            reader.reconstruct(deltas[0]["tick"])
+        # a never-journaled tick is the same typed refusal
+        with pytest.raises(MissingKeyframeError):
+            JournalReader(r1.journal_records).reconstruct(99999)
+
+    def test_out_of_order_ticks(self, storm_replays):
+        r1, _, _ = storm_replays
+        records = [dict(r) for r in r1.journal_records]
+        records[1], records[2] = records[2], records[1]
+        with pytest.raises(OutOfOrderTickError):
+            JournalReader(records)
+        assert any(
+            "not increasing" in e or "monotonic" in e or "order" in e
+            for e in validate_records(records)
+        ) or validate_records(records)
+
+    def test_schema_drift(self, storm_replays):
+        r1, _, _ = storm_replays
+        records = [json.loads(record_line(r)) for r in r1.journal_records]
+        bad = [dict(records[0], schema="autoscaler_tpu.journal.tick/999")]
+        with pytest.raises(SchemaDriftError):
+            JournalReader(bad + records[1:])
+        # an undecodable delta payload must refuse, not scatter garbage
+        corrupt = [json.loads(record_line(r)) for r in records]
+        victim = next(r for r in corrupt if r["kind"] == "delta"
+                      and r["state"]["ops"])
+        victim["state"]["ops"][0]["field"] = "no_such_field"
+        reader = JournalReader(corrupt)
+        with pytest.raises(SchemaDriftError):
+            reader.reconstruct(victim["tick"])
+        # ticks before the corruption still reconstruct
+        first = corrupt[0]["tick"]
+        assert reader.reconstruct(first).tick == first
+
+    def test_validate_records_flags_corruption(self, storm_replays):
+        r1, _, _ = storm_replays
+        records = [dict(r) for r in r1.journal_records]
+        assert validate_records(records) == []
+        records[0] = dict(records[0], schema="nope")
+        assert validate_records(records)
+
+
+# ------------------------------------------------ replay + divergence
+class TestReplayDivergence:
+    def _ledger(self, result):
+        lines = result.explain_ledger_lines().splitlines(keepends=True)
+        return [json.loads(l) for l in lines], lines
+
+    def test_replay_reproduces_every_tick(self, storm_replays):
+        r1, _, _ = storm_replays
+        records, lines = self._ledger(r1)
+        results = replay_journal(
+            JournalReader(r1.journal_records), records, lines
+        )
+        assert len(results) == r1.spec.ticks
+        assert all(not r["divergence"] for r in results), [
+            r for r in results if r["divergence"]
+        ][:2]
+        assert sum(1 for r in results if r["replayed"]) > 0
+
+    def test_tampered_ledger_diverges(self, storm_replays):
+        """Dropping one recorded eviction row must surface BOTH probes:
+        the line-hash pin and the re-derived decision comparison."""
+        r1, _, _ = storm_replays
+        records, lines = self._ledger(r1)
+        idx = next(
+            i for i, r in enumerate(records)
+            if (r.get("preemption") or {}).get("evictions")
+        )
+        records[idx]["preemption"]["evictions"] = []
+        from autoscaler_tpu.explain import record_line as explain_line
+
+        lines[idx] = explain_line(records[idx])
+        results = replay_journal(
+            JournalReader(r1.journal_records), records, lines
+        )
+        bad = next(r for r in results if r["tick"] == records[idx]["tick"])
+        assert bad["divergence"]
+        joined = " ".join(bad["divergence"])
+        assert "hash" in joined
+        assert "diverged" in joined
+
+    def test_probe_reports_no_drift_live(self):
+        pods = [build_test_pod("p", cpu_m=600, mem=GB)]
+        a = make_autoscaler(pods=pods)
+        a.run_once(now_ts=0.0)
+        a.run_once(now_ts=10.0)
+        verdict = a.journal.probe()
+        assert verdict["checked"] and not verdict["drift"]
+        assert verdict["fit_drift"] is False
+
+    def test_in_loop_probe_interval_counts_clean(self):
+        pods = [build_test_pod("p", cpu_m=600, mem=GB)]
+        a = make_autoscaler(pods=pods, journal_probe_interval=1)
+        for i in range(3):
+            a.run_once(now_ts=float(i) * 10.0)
+        assert a.metrics.journal_records_total.get() == 3
+        assert a.metrics.journal_probe_drift_total.get() == 0
+
+
+# ----------------------------------------------------------- /journalz
+class TestJournalzEndpoint:
+    def _get(self, port, path):
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, r.read().decode()
+
+    def test_list_detail_diff(self):
+        pods = [build_test_pod("p", cpu_m=600, mem=GB)]
+        a = make_autoscaler(pods=pods)
+        a.run_once(now_ts=0.0)
+        a.run_once(now_ts=10.0)
+        server = ObservabilityServer(a, "127.0.0.1:0")
+        port = server.start()
+        try:
+            code, body = self._get(port, "/journalz")
+            listing = json.loads(body)
+            assert code == 200 and listing["schema"] == SCHEMA
+            ticks = [t["tick"] for t in listing["ticks"]]
+            assert len(ticks) == 2
+            code, body = self._get(port, f"/journalz?tick={ticks[-1]}")
+            doc = json.loads(body)
+            assert code == 200 and doc["tick"] == ticks[-1]
+            code, body = self._get(
+                port, f"/journalz?diff={ticks[0]},{ticks[-1]}"
+            )
+            assert code == 200 and "pods_added" in json.loads(body)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(port, "/journalz?tick=99999")
+            assert ei.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(port, "/journalz?tick=bogus")
+            assert ei.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(port, "/journalz?diff=bogus")
+            assert ei.value.code == 400
+        finally:
+            server.stop()
+
+    def test_gated_like_explainz(self):
+        a = make_autoscaler(journal_enabled=False)
+        a.run_once(now_ts=0.0)
+        server = ObservabilityServer(a, "127.0.0.1:0")
+        port = server.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(port, "/journalz")
+            assert ei.value.code == 404
+        finally:
+            server.stop()
+
+    def test_concurrent_ring_eviction_race(self):
+        """Satellite: /journalz racing a writer that overflows the 2-deep
+        ring — every response must be well-formed JSON, never a torn
+        record or a half-applied delta chain."""
+        pods = [build_test_pod("p", cpu_m=600, mem=GB)]
+        a = make_autoscaler(pods=pods, journal_ring_size=2)
+        a.run_once(now_ts=0.0)  # warm compile so writer iterations are fast
+        server = ObservabilityServer(a, "127.0.0.1:0")
+        port = server.start()
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            t = 10.0
+            while not stop.is_set():
+                a.run_once(now_ts=t)
+                t += 10.0
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    code, body = self._get(port, "/journalz")
+                    listing = json.loads(body)
+                    ticks = [t["tick"] for t in listing["ticks"]]
+                    for t in ticks:
+                        self._get(port, f"/journalz?tick={t}")
+                    if len(ticks) == 2:
+                        self._get(
+                            port, f"/journalz?diff={ticks[0]},{ticks[1]}"
+                        )
+                except urllib.error.HTTPError as e:
+                    # a tick evicted between list and drill-down is a 404,
+                    # not an error; a diff across an evicted keyframe is a
+                    # clean 404 too — torn state would be a 500
+                    if e.code not in (404,):
+                        errors.append(e)
+                except Exception as e:  # noqa: BLE001 — collected for assert
+                    errors.append(e)
+
+        threads = [threading.Thread(target=writer)] + [
+            threading.Thread(target=reader) for _ in range(2)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            import time
+
+            time.sleep(1.5)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            server.stop()
+        assert not errors, errors[:3]
+
+
+# ------------------------------------------------------- CLI + gates
+class TestJournalCli:
+    @pytest.fixture()
+    def journaled_run(self, storm_replays, tmp_path):
+        r1, _, _ = storm_replays
+        journal = tmp_path / "journal.jsonl"
+        ledger = tmp_path / "explain.jsonl"
+        journal.write_text(r1.journal_ledger_lines())
+        ledger.write_text(r1.explain_ledger_lines())
+        return journal, ledger
+
+    def _run(self, *argv):
+        return subprocess.run(
+            [sys.executable, "-m", "autoscaler_tpu.journal", *argv],
+            capture_output=True, text=True,
+        )
+
+    def test_reconstruct_and_diff(self, journaled_run):
+        journal, _ = journaled_run
+        proc = self._run("reconstruct", str(journal))
+        assert proc.returncode == 0, proc.stderr
+        assert "pod_req" in proc.stdout
+        ticks = JournalReader.from_path(str(journal)).ticks()
+        proc = self._run("diff", str(journal), str(ticks[0]),
+                         str(ticks[-1]))
+        assert proc.returncode == 0, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["ticks"] == [ticks[0], ticks[-1]]
+        assert "capacity_drift" in doc
+
+    def test_replay_clean_and_diverged(self, journaled_run, tmp_path):
+        journal, ledger = journaled_run
+        proc = self._run("replay", str(journal),
+                         "--explain-ledger", str(ledger))
+        assert proc.returncode == 0, proc.stderr
+        verdict = json.loads(proc.stdout.splitlines()[-1])
+        assert verdict["diverged"] == 0
+        assert verdict["replayed"] > 0
+        # flip one byte of one ledger line: exit 1 + DIVERGED on stderr
+        lines = ledger.read_text().splitlines(keepends=True)
+        lines[-1] = lines[-1].replace('"tick"', '"tick_"', 1)
+        bad = tmp_path / "tampered.jsonl"
+        bad.write_text("".join(lines))
+        proc = self._run("replay", str(journal),
+                         "--explain-ledger", str(bad))
+        assert proc.returncode == 1
+        assert "DIVERGED" in proc.stderr
+
+    def test_loadgen_journal_flag(self, tmp_path):
+        from autoscaler_tpu.loadgen.cli import main as loadgen_main
+
+        out = tmp_path / "journal.jsonl"
+        rc = loadgen_main([
+            "run", "benchmarks/scenarios/burst_small.json",
+            "--journal", str(out),
+        ])
+        assert rc == 0
+        records = [json.loads(l) for l in out.read_text().splitlines()]
+        assert records and validate_records(records) == []
+
+    def test_bench_journal_ledger_gate(self, journaled_run, tmp_path):
+        journal, _ = journaled_run
+        proc = subprocess.run(
+            [sys.executable, "bench.py", "--journal-ledger", str(journal)],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout)
+        assert report["valid"]
+        assert report["reconstructed"] == report["ticks"]
+        # keyframe-less journal → validation errors, exit 1
+        records = [json.loads(l) for l in
+                   journal.read_text().splitlines()]
+        bad = tmp_path / "headless.jsonl"
+        bad.write_text("".join(record_line(r) for r in records
+                               if r["kind"] == "delta"))
+        proc = subprocess.run(
+            [sys.executable, "bench.py", "--journal-ledger", str(bad)],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 1
+        # unreadable journal → exit 2
+        proc = subprocess.run(
+            [sys.executable, "bench.py", "--journal-ledger",
+             str(tmp_path / "missing.jsonl")],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 2
+
+
+class TestBenchTrendGate:
+    """--trend satellite: the committed BENCH_r*.json trajectory is the
+    floor; newest round wins per config; no live capture = no gate."""
+
+    @pytest.fixture()
+    def trend_repo(self, tmp_path, monkeypatch):
+        bench = pytest.importorskip("bench")
+        monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+            "n": 1, "cmd": "", "rc": 0, "tail": "",
+            "parsed": {"metric": "m", "platform": "tpu", "value": 50.0},
+        }))
+        # newest round carries the TPU number nested in a CPU fallback —
+        # it must supersede round 1's direct capture for ("m", "tpu")
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+            "n": 2, "cmd": "", "rc": 0, "tail": "",
+            "parsed": {
+                "metric": "other", "platform": "cpu", "value": 1.0,
+                "last_tpu_capture": {
+                    "metric": "m", "platform": "tpu", "value": 100.0,
+                },
+            },
+        }))
+        out = tmp_path / "benchmarks" / "out"
+        out.mkdir(parents=True)
+        return bench, out / "bench_last_tpu.json"
+
+    def _capture(self, path, value, metric="m"):
+        path.write_text(json.dumps(
+            {"metric": metric, "platform": "tpu", "value": value}
+        ))
+
+    def test_on_trend_passes(self, trend_repo, capsys):
+        bench, cap = trend_repo
+        self._capture(cap, 95.0)  # >= 90% of the newest round's 100
+        assert bench._trend_main() == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["committed_round"] == 2
+        assert report["committed_value"] == 100.0
+
+    def test_regression_fails(self, trend_repo, capsys):
+        bench, cap = trend_repo
+        self._capture(cap, 80.0)  # < 90% floor
+        assert bench._trend_main() == 1
+
+    def test_unknown_config_and_no_capture_pass(self, trend_repo, capsys):
+        bench, cap = trend_repo
+        self._capture(cap, 1.0, metric="brand_new")
+        assert bench._trend_main() == 0
+        cap.unlink()
+        assert bench._trend_main() == 0
+        assert "no live capture" in capsys.readouterr().out
+
+    def test_legacy_root_capture_still_read(self, trend_repo, tmp_path,
+                                            capsys):
+        bench, cap = trend_repo
+        legacy = tmp_path / "bench_last_tpu.json"
+        legacy.write_text(json.dumps(
+            {"metric": "m", "platform": "tpu", "value": 95.0}
+        ))
+        assert bench._trend_main() == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["live_value"] == 95.0
