@@ -37,20 +37,26 @@ import json
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from autoscaler_tpu.analysis.engine import Finding
+from autoscaler_tpu.analysis.engine import ENGINE_VERSION, Finding
 
-_SCHEMA = 1
+_SCHEMA = 2  # v2: findings carry an optional flow (taint witness steps)
 
 
 def _analysis_salt() -> str:
-    """Digest of the analysis package's own sources: any analyzer edit
-    invalidates every entry."""
+    """Digest of the analysis package's own sources PLUS the explicit
+    engine version and the registered rule table (ids + titles): any
+    analyzer edit, engine version bump, or rule addition/removal/retitle
+    invalidates every entry — no manual flush can be forgotten."""
     h = hashlib.sha256()
-    h.update(f"graftlint-cache/{_SCHEMA}".encode())
+    h.update(f"graftlint-cache/{_SCHEMA}/engine/{ENGINE_VERSION}".encode())
     pkg = Path(__file__).resolve().parent
     for f in sorted(pkg.glob("*.py")):
         h.update(f.name.encode())
         h.update(f.read_bytes())
+    from autoscaler_tpu.analysis.rules import RULE_CATALOG
+
+    for rule_id in sorted(RULE_CATALOG):
+        h.update(f"{rule_id}\0{RULE_CATALOG[rule_id]}\0".encode())
     return h.hexdigest()
 
 
@@ -122,6 +128,9 @@ class LintCache:
                 Finding(
                     path=e["path"], line=int(e["line"]),
                     rule=e["rule"], message=e["message"],
+                    flow=tuple(
+                        (s[0], int(s[1]), s[2]) for s in e.get("flow", ())
+                    ),
                 )
                 for e in doc["findings"]
             ]
@@ -137,6 +146,7 @@ class LintCache:
                     {
                         "path": f.path, "line": f.line,
                         "rule": f.rule, "message": f.message,
+                        **({"flow": [list(s) for s in f.flow]} if f.flow else {}),
                     }
                     for f in findings
                 ]

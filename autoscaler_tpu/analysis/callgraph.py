@@ -17,8 +17,16 @@ The graph is deliberately modest — and deterministic:
 - Edges come from ``Call`` sites, resolved through each file's import-alias
   map (``from autoscaler_tpu.ops.binpack import ffd_binpack as f`` still
   resolves), relative imports included. ``self.meth()`` resolves to the
-  enclosing class's own method. Anything else (attribute chains through
-  instances, call results, dynamic dispatch) resolves to None — the graph
+  enclosing class's own method. Beyond that, three *instance-typed* forms
+  resolve (added for the GL013–GL015 interprocedural rules):
+  ``Cls(...)`` edges to ``Cls.__init__`` (class names resolve through the
+  same import map, so ``planner.ScaleDownPlanner(...)`` works through a
+  module alias); ``self._attr.meth()`` resolves when the class assigns
+  ``self._attr = Cls(...)`` with exactly ONE class over the whole class
+  body (conflicting assignments drop the attribute — never guess); and
+  ``var.meth()`` resolves within one function when that function assigns
+  ``var = Cls(...)`` unambiguously. Anything else (call results, dynamic
+  dispatch, reassigned receivers) still resolves to None — the graph
   under-approximates, it never guesses.
 - A nested ``def`` is linked from its parent by a *containment* edge: when
   the parent is reached, the nested body is considered reached too (it runs
@@ -114,8 +122,14 @@ class CallGraph:
         self._by_name: Dict[str, Dict[str, List[str]]] = {}
         self._module_of: Dict[str, str] = {}  # dotted module -> model path
         self._sites: Dict[str, List[CallSite]] = {}
+        self.classes: Dict[str, str] = {}  # class fq -> defining model path
+        # class fq -> attr name -> class fq of the instance stored there
+        # (None = conflicting assignments: resolution must not guess)
+        self._attr_types: Dict[str, Dict[str, Optional[str]]] = {}
         for model in self.models:
             self._index(model)
+        for model in self.models:
+            self._collect_attr_types(model)
         for model in self.models:
             self._link(model)
         for info in self.defs.values():
@@ -145,6 +159,9 @@ class CallGraph:
                     register(f"{dm}.{local}", child, local, cls)
                     walk(child, stack + [child.name], cls)
                 elif isinstance(child, ast.ClassDef):
+                    self.classes[f"{dm}." + ".".join(stack + [child.name])] = (
+                        model.path
+                    )
                     walk(child, stack + [child.name], child.name)
                 else:
                     walk(child, stack, cls)
@@ -153,29 +170,100 @@ class CallGraph:
         for name_map in names.values():
             name_map.sort()
 
+    def _collect_attr_types(self, model: FileModel) -> None:
+        """``self._attr = Cls(...)`` anywhere in a class body types the
+        attribute — but only if every such assignment across the whole
+        class agrees on ONE resolvable class (else the attr is dropped)."""
+        dm = dotted_module(model)
+        if dm is None:
+            return
+
+        def walk(node: ast.AST, stack: List[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk(child, stack + [child.name])
+                    continue
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    walk(child, stack)
+                    continue
+                if stack and isinstance(child, ast.Assign):
+                    for tgt in child.targets:
+                        if (
+                            isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                        ):
+                            cls_fq = f"{dm}." + ".".join(stack)
+                            attrs = self._attr_types.setdefault(cls_fq, {})
+                            typed = (
+                                self.resolve_class(model, child.value.func)
+                                if isinstance(child.value, ast.Call)
+                                else None
+                            )
+                            if tgt.attr in attrs and attrs[tgt.attr] != typed:
+                                attrs[tgt.attr] = None  # conflict: never guess
+                            else:
+                                attrs[tgt.attr] = typed
+                walk(child, stack)
+
+        walk(model.tree, [])
+
+    def _local_instance_types(self, model: FileModel, fn: ast.AST) -> Dict[str, str]:
+        """var -> class fq for ``var = Cls(...)`` assignments in ONE
+        function's own body (nested defs excluded — they rebind their own
+        scope). A variable assigned twice with disagreeing (or unresolvable)
+        classes is dropped."""
+        out: Dict[str, Optional[str]] = {}
+        stack = list(getattr(fn, "body", []))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    typed = (
+                        self.resolve_class(model, node.value.func)
+                        if isinstance(node.value, ast.Call)
+                        else None
+                    )
+                    if tgt.id in out and out[tgt.id] != typed:
+                        out[tgt.id] = None
+                    else:
+                        out[tgt.id] = typed
+            stack.extend(ast.iter_child_nodes(node))
+        return {k: v for k, v in out.items() if v is not None}
+
     def _link(self, model: FileModel) -> None:
         dm = dotted_module(model)
         if dm is None:
             return
 
         def walk(
-            node: ast.AST, stack: List[str], cls: Optional[str], owner_fq: str
+            node: ast.AST,
+            stack: List[str],
+            cls: Optional[str],
+            owner_fq: str,
+            local_types: Dict[str, str],
         ) -> None:
             """Attribute every Call to its innermost enclosing definition
             (``owner_fq``); record containment for nested defs."""
             for child in ast.iter_child_nodes(node):
                 if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     child_fq = f"{dm}." + ".".join(stack + [child.name])
+                    child_types = self._local_instance_types(model, child)
                     if child_fq in self.defs:
                         self.defs[owner_fq].contains.append(child_fq)
-                        walk(child, stack + [child.name], cls, child_fq)
+                        walk(child, stack + [child.name], cls, child_fq, child_types)
                     else:
-                        walk(child, stack + [child.name], cls, owner_fq)
+                        walk(child, stack + [child.name], cls, owner_fq, child_types)
                 elif isinstance(child, ast.ClassDef):
-                    walk(child, stack + [child.name], child.name, owner_fq)
+                    walk(child, stack + [child.name], child.name, owner_fq, {})
                 else:
                     if isinstance(child, ast.Call):
-                        target = self.resolve(model, child.func, cls)
+                        target = self.resolve(
+                            model, child.func, cls, local_types=local_types
+                        )
                         if target is not None:
                             self.defs[owner_fq].callees.append(target)
                             self._sites.setdefault(target, []).append(
@@ -183,29 +271,84 @@ class CallGraph:
                                     model=model, call=child, caller_fq=owner_fq
                                 )
                             )
-                    walk(child, stack, cls, owner_fq)
+                    walk(child, stack, cls, owner_fq, local_types)
 
-        walk(model.tree, [], None, f"{dm}.{MODULE_NODE}")
+        walk(model.tree, [], None, f"{dm}.{MODULE_NODE}", {})
 
     # -- queries --------------------------------------------------------------
 
+    def resolve_class(self, model: FileModel, node: ast.AST) -> Optional[str]:
+        """Resolve a Name/Attribute naming a class to its class fq — local
+        classes, imported classes, and module-alias chains
+        (``planner.ScaleDownPlanner``) all resolve; None otherwise."""
+        dm = dotted_module(model)
+        if dm is None:
+            return None
+        if isinstance(node, ast.Name):
+            fq = f"{dm}.{node.id}"
+            if fq in self.classes:
+                return fq
+            origin = model.imports.get(node.id)
+            if origin is not None:
+                fq = resolve_relative(dm, origin, is_package=_is_package(model))
+                return fq if fq in self.classes else None
+            return None
+        if isinstance(node, ast.Attribute):
+            dotted = model.dotted(node, resolve=True)
+            if dotted is None:
+                return None
+            fq = resolve_relative(dm, dotted, is_package=_is_package(model))
+            return fq if fq in self.classes else None
+        return None
+
+    def method_on(self, class_fq: Optional[str], meth: str) -> Optional[str]:
+        """``Cls.meth`` if that method is a known definition."""
+        if class_fq is None:
+            return None
+        fq = f"{class_fq}.{meth}"
+        return fq if fq in self.defs else None
+
     def resolve(
-        self, model: FileModel, func: ast.AST, enclosing_class: Optional[str] = None
+        self,
+        model: FileModel,
+        func: ast.AST,
+        enclosing_class: Optional[str] = None,
+        local_types: Optional[Dict[str, str]] = None,
     ) -> Optional[str]:
-        """Resolve a call target expression to a definition fq, or None."""
+        """Resolve a call target expression to a definition fq, or None.
+        ``local_types`` (var -> class fq, from ``_local_instance_types``)
+        enables ``var.meth()`` resolution inside one function."""
         dm = dotted_module(model)
         if dm is None:
             return None
         names = self._by_name.get(dm, {})
-        # self.meth() -> the enclosing class's own method
         if (
             enclosing_class is not None
             and isinstance(func, ast.Attribute)
             and isinstance(func.value, ast.Name)
             and func.value.id == "self"
         ):
+            # self.meth() -> the enclosing class's own method
             fq = f"{dm}.{enclosing_class}.{func.attr}"
             return fq if fq in self.defs else None
+        if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            # var.meth() through a function-local `var = Cls(...)` binding
+            if local_types is not None and func.value.id in local_types:
+                hit = self.method_on(local_types[func.value.id], func.attr)
+                if hit is not None:
+                    return hit
+        if (
+            enclosing_class is not None
+            and isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Attribute)
+            and isinstance(func.value.value, ast.Name)
+            and func.value.value.id == "self"
+        ):
+            # self._attr.meth() through the class's typed attributes
+            attrs = self._attr_types.get(f"{dm}.{enclosing_class}", {})
+            hit = self.method_on(attrs.get(func.value.attr), func.attr)
+            if hit is not None:
+                return hit
         if isinstance(func, ast.Name):
             # same-module MODULE-LEVEL definition by bare name, before
             # imported names. Class methods and function-local nested defs
@@ -222,8 +365,10 @@ class CallGraph:
             origin = model.imports.get(func.id)
             if origin is not None:
                 fq = resolve_relative(dm, origin, is_package=_is_package(model))
-                return fq if fq in self.defs else None
-            return None
+                if fq in self.defs:
+                    return fq
+            # Cls(...) -> Cls.__init__ (constructor edge)
+            return self.method_on(self.resolve_class(model, func), "__init__")
         if isinstance(func, ast.Attribute):
             dotted = model.dotted(func, resolve=True)
             if dotted is None:
@@ -231,7 +376,8 @@ class CallGraph:
             fq = resolve_relative(dm, dotted, is_package=_is_package(model))
             if fq in self.defs:
                 return fq
-            return None
+            # mod.Cls(...) -> Cls.__init__ through a module alias
+            return self.method_on(self.resolve_class(model, func), "__init__")
         return None
 
     def reachable(self, roots: Iterable[str]) -> Set[str]:

@@ -4,7 +4,7 @@ Usage::
 
     python -m autoscaler_tpu.analysis [paths...]
         [--baseline FILE] [--no-baseline] [--update-baseline] [--list-rules]
-        [--format {text,json,github}]
+        [--format {text,json,github,sarif}] [--jobs N]
 
 Default paths: ``autoscaler_tpu`` under the current directory. The baseline
 defaults to ``hack/lint-baseline.json`` discovered by walking up from the
@@ -13,7 +13,12 @@ current directory (``--no-baseline`` disables, ``--baseline`` overrides).
 Output formats: ``text`` (findings to stdout, per-rule summary table to
 stderr), ``json`` (one machine-readable document on stdout — byte-stable
 across runs, ``hack/verify.sh`` diffs two consecutive runs), ``github``
-(workflow-annotation ``::error``/``::warning`` lines).
+(workflow-annotation ``::error``/``::warning`` lines), ``sarif``
+(SARIF 2.1.0 with taint paths as codeFlows — see ``sarif.py``).
+
+``--jobs N`` fans the per-file rules out over N worker processes
+(whole-program passes stay in the parent); output is byte-identical to a
+serial run.
 
 Exit status: 0 clean; 1 findings or stale baseline entries; 2 usage error
 OR internal analyzer error (a crash in the analyzer itself must be
@@ -142,7 +147,9 @@ def _run(argv: Optional[List[str]] = None) -> int:
             "error boundaries (GL005), jit purity (GL006), kernel "
             "shape/tiling contracts (GL007), lock ordering (GL008), "
             "flag wiring (GL009), taint-flow determinism (GL010), "
-            "thread escape (GL011), surface gating (GL012). "
+            "thread escape (GL011), surface gating (GL012), "
+            "interprocedural determinism taint (GL013), host-sync leaks "
+            "(GL014), recompile hazards (GL015). "
             "See autoscaler_tpu/analysis/RULES.md."
         ),
     )
@@ -171,9 +178,18 @@ def _run(argv: Optional[List[str]] = None) -> int:
     )
     parser.add_argument(
         "--format",
-        choices=("text", "json", "github"),
+        choices=("text", "json", "github", "sarif"),
         default="text",
-        help="output format (json is byte-stable across identical runs)",
+        help="output format (json and sarif are byte-stable across "
+        "identical runs; sarif carries taint paths as codeFlows)",
+    )
+    parser.add_argument(
+        "--jobs",
+        type=int,
+        default=1,
+        metavar="N",
+        help="fan per-file rules out over N worker processes (output is "
+        "byte-identical to a serial run; whole-program passes stay serial)",
     )
     parser.add_argument(
         "--cache",
@@ -221,8 +237,14 @@ def _run(argv: Optional[List[str]] = None) -> int:
         from autoscaler_tpu.analysis.cache import LintCache
 
         cache = LintCache(args.cache_dir)
+    if args.jobs < 1:
+        print("graftlint: --jobs must be >= 1", file=sys.stderr)
+        return 2
     findings, stats = analyze_sources(
-        sources, scan_complete=package_scan_complete(files), cache=cache
+        sources,
+        scan_complete=package_scan_complete(files),
+        cache=cache,
+        jobs=args.jobs,
     )
 
     baseline_path: Optional[Path] = None
@@ -292,6 +314,10 @@ def _run(argv: Optional[List[str]] = None) -> int:
                 "summary": summary,
             }
         )
+    elif args.format == "sarif":
+        from autoscaler_tpu.analysis.sarif import to_sarif
+
+        _emit_json(to_sarif(new, stale))
     elif args.format == "github":
         _emit_github(new, stale)
     else:

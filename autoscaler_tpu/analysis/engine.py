@@ -44,6 +44,11 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 PACKAGE_DIR_NAME = "autoscaler_tpu"
 
+# Bumped whenever finding semantics or the cached-finding schema change in a
+# way the source digest alone would not capture (the cache salts its keys
+# with BOTH this and a digest of the analysis sources + rule table).
+ENGINE_VERSION = 2
+
 # `# graftlint: disable=GL001,GL004 — reason` (reason separator: any dash
 # family or a colon; the reason itself is mandatory — enforced as GL000)
 PRAGMA_RE = re.compile(
@@ -79,15 +84,24 @@ def is_lock_attr(name: str) -> bool:
     return name.startswith("_") and name.endswith("lock")
 
 
+# one hop of a taint witness path: (display path, line, human note).
+# Interprocedural rules (GL013) attach these so machine formats (SARIF
+# codeFlows) can render the full source→sink walk; text output folds the
+# same steps into the message.
+FlowStep = Tuple[str, int, str]
+
+
 @dataclass(frozen=True)
 class Finding:
     """One rule violation. ``fingerprint`` (path, rule, message — no line
-    number) keys the baseline, so mere line drift doesn't churn it."""
+    number) keys the baseline, so mere line drift doesn't churn it;
+    ``flow`` is presentation-only and deliberately excluded."""
 
     path: str
     line: int
     rule: str
     message: str
+    flow: Tuple[FlowStep, ...] = ()
 
     @property
     def fingerprint(self) -> Tuple[str, str, str]:
@@ -190,12 +204,19 @@ class FileModel:
         head = self.dotted(node, resolve=False)
         return head is not None and head.split(".")[0] in self.imports
 
-    def finding(self, node: ast.AST, rule: str, message: str) -> Finding:
+    def finding(
+        self,
+        node: ast.AST,
+        rule: str,
+        message: str,
+        flow: Sequence[FlowStep] = (),
+    ) -> Finding:
         return Finding(
             path=self.path,
             line=getattr(node, "lineno", 1),
             rule=rule,
             message=message,
+            flow=tuple(flow),
         )
 
 
@@ -299,12 +320,31 @@ def _apply_suppression(
     return sorted(kept, key=Finding.sort_key), stats
 
 
+def _scan_file_worker(item: Tuple[str, str]):
+    """Multiprocessing worker: parse one file and run the canonical
+    per-file rule set. Returns ``(path, findings)`` or ``(path, None)`` on
+    a parse failure (the parent re-derives the parse finding — same source,
+    same error — so worker and serial scans are byte-identical)."""
+    path, source = item
+    from autoscaler_tpu.analysis import rules as rules_mod
+
+    try:
+        model = FileModel(path, source)
+    except (SyntaxError, ValueError):
+        return (path, None)
+    found: List[Finding] = []
+    for rule in rules_mod.ALL_RULES:
+        found.extend(rule.check(model))
+    return (path, found)
+
+
 def analyze_sources(
     sources: Dict[str, str],
     rules: Optional[Sequence] = None,
     program_rules: Optional[Sequence] = None,
     scan_complete: bool = True,
     cache=None,
+    jobs: int = 1,
 ) -> Tuple[List[Finding], ScanStats]:
     """The one scan pipeline: parse every file once, run the per-file rules,
     build the whole-program call graph, run the program rules, then apply
@@ -319,8 +359,16 @@ def analyze_sources(
     passes. Suppression/sorting run identically on cached and fresh
     findings (byte-identical output, verified by hack/verify.sh). The
     cache only applies to the canonical full-rule scan: an explicit
-    ``rules``/``program_rules`` subset bypasses it."""
+    ``rules``/``program_rules`` subset bypasses it.
+
+    ``jobs`` > 1 fans the per-file rules out over a fork-based process pool
+    while the parent parses the models the whole-program passes need — the
+    two phases overlap, and results are folded back in sorted path order so
+    output stays byte-identical to a serial run. Parallelism applies only
+    to the canonical rule set (like the cache) and degrades silently to
+    serial where fork is unavailable."""
     use_cache = cache is not None and rules is None and program_rules is None
+    canonical_rules = rules is None
     if program_rules is None:
         # an explicit per-file `rules` subset means "only these": program
         # rules then run only when asked for, preserving the pre-whole-
@@ -366,6 +414,29 @@ def analyze_sources(
             findings.extend(program_cached)
             return _apply_suppression(findings, by_path, stats)
 
+    # fan the per-file rules out BEFORE the parent's own parse loop: the
+    # pool chews on rule execution while the parent builds the models the
+    # whole-program passes need anyway, then results fold back in path order
+    pool = None
+    pending = None
+    if jobs > 1 and canonical_rules:
+        fan_out = [
+            p for p in sorted(sources) if per_file_cached.get(p) is None
+        ]
+        if len(fan_out) > 1:
+            try:
+                import multiprocessing
+
+                ctx = multiprocessing.get_context("fork")
+                pool = ctx.Pool(processes=min(jobs, len(fan_out)))
+                pending = pool.map_async(
+                    _scan_file_worker, [(p, sources[p]) for p in fan_out]
+                )
+            except (ImportError, OSError, ValueError):
+                pool = None
+                pending = None
+
+    deferred: List[Tuple[str, str]] = []  # (path, file_key) awaiting pool
     for path in sorted(sources):
         source = sources[path]
         pragmas, pragma_findings = parse_pragmas(source, path)
@@ -399,12 +470,32 @@ def analyze_sources(
         if cached is not None:
             findings.extend(cached)
             continue
+        if pending is not None:
+            deferred.append((path, file_keys.get(path, "")))
+            continue
         file_findings: List[Finding] = []
         for rule in rules:
             file_findings.extend(rule.check(model))
         findings.extend(file_findings)
         if use_cache:
             cache.put(file_keys[path], file_findings)
+
+    if pending is not None:
+        by_worker = dict(pending.get())
+        pool.close()
+        pool.join()
+        for path, fkey in deferred:
+            file_findings = by_worker.get(path)
+            if file_findings is None:
+                # worker saw a parse failure the parent did not (should be
+                # impossible — same bytes); degrade to a serial re-run
+                file_findings = []
+                model = FileModel(path, sources[path])
+                for rule in rules:
+                    file_findings.extend(rule.check(model))
+            findings.extend(file_findings)
+            if use_cache:
+                cache.put(fkey, file_findings)
 
     if models and program_rules:
         from autoscaler_tpu.analysis.callgraph import CallGraph
@@ -476,6 +567,7 @@ def analyze_paths(
     paths: Iterable[str],
     rules: Optional[Sequence] = None,
     program_rules: Optional[Sequence] = None,
+    jobs: int = 1,
 ) -> Tuple[List[Finding], ScanStats]:
     files = iter_python_files(paths)
     sources = {f: Path(f).read_text(encoding="utf-8") for f in files}
@@ -484,6 +576,7 @@ def analyze_paths(
         rules=rules,
         program_rules=program_rules,
         scan_complete=package_scan_complete(files),
+        jobs=jobs,
     )
 
 

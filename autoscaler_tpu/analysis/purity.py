@@ -1,0 +1,651 @@
+"""GL014/GL015 — device hot-path purity for the tick/estimator/arena path.
+
+The decision loop's latency story assumes ``run_once`` stays async with
+respect to the device: kernels are dispatched, futures of device values
+flow through the estimator, and nothing forces a host round-trip until
+the perf/telemetry seam explicitly reads results out. One stray
+``.item()`` (or ``float()`` of a jax scalar, or ``np.asarray`` of a
+device buffer) inserts a blocking transfer in the middle of the tick —
+invisible in unit tests, a latency cliff under load. Separately, a
+``@jax.jit`` body that branches on a tracer-derived value or loops a
+shape-dependent number of Python iterations retraces per distinct
+value/shape, silently turning the compile-once kernels into a recompile
+treadmill.
+
+**GL014 — host-sync leak.** Roots are every ``run_once`` definition; the
+reachable set is the true transitive closure over the call graph
+(instance-typed edges included). Inside that set, within REPLAY/ARENA
+scopes plus ``ops/`` and outside the telemetry seams (``perf/``,
+``metrics/``, ``trace/``), these force a sync and are flagged:
+``.item()``, ``.block_until_ready()``, ``jax.device_get``, and
+``float()``/``int()``/``np.asarray()``/``np.array()`` applied to a value
+the local pass can prove is device-derived (built by a ``jax.*``/
+``jnp.*`` call or flowing from one). Findings carry the ``run_once``
+call chain as flow steps — the fix is usually "move the read behind the
+perf seam", and the chain shows where.
+
+**GL015 — recompile hazard.** Within ``ops/`` and ``estimator/``, every
+jit root (``@jax.jit``/``@partial(jax.jit, ...)`` decorations and
+``jax.jit(fn)``/``pallas_call(kernel)`` call forms — the same detection
+GL006 uses) is scanned in its own region for: (a) Python ``if``/``while``
+on a tracer-derived value (non-static parameters and ``jnp.*`` results;
+``.shape``/``.ndim``/``.dtype`` projections and ``is None`` checks are
+static under tracing and exempt), (b) ``for ... in range(...)`` over a
+non-static parameter or a parameter's shape (the loop unrolls per
+value/shape — use a padded bound or ``lax.fori_loop``), and (c) at every
+resolved call site of a jitted def, an unhashable ``list``/``dict``/
+``set`` literal passed to a declared static parameter
+(``static_argnames``/``static_argnums`` are extracted from the
+decoration). :func:`certify_kernels` cross-checks KERNEL_CONTRACTS: a
+contract-listed kernel is *certified* when no GL015 hazard exists in any
+definition reachable from its entry point (pallas kernels reached as
+jit-wrapper first arguments included) — hack/verify.sh and the test
+suite hold every listed kernel to that bar.
+
+Both rules under-approximate: unknown values are assumed host-side and
+static; only provable syncs and hazards are reported.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from autoscaler_tpu.analysis.callgraph import (
+    MODULE_NODE,
+    CallGraph,
+    DefInfo,
+    dotted_module,
+)
+from autoscaler_tpu.analysis.contracts import extract_contracts
+from autoscaler_tpu.analysis.dataflow import in_replay_scope
+from autoscaler_tpu.analysis.engine import (
+    FileModel,
+    Finding,
+    FlowStep,
+    terminal_name,
+)
+
+HOT_ROOT = "run_once"
+# the sanctioned host-read seams: telemetry modules read device values out
+# by design, at tick boundaries, not inside the decision path
+TELEMETRY_SEAMS = ("perf/", "metrics/", "trace/")
+# GL015's blast radius: the jitted device code lives here
+JIT_SCOPES = ("ops/", "estimator/")
+
+_JIT_WRAPPERS = {"jit", "vmap", "pmap", "pallas_call", "shard_map"}
+_SHAPE_PROJECTIONS = {"shape", "ndim", "dtype", "size"}
+_SYNC_METHODS = {"item", "block_until_ready"}
+_HOST_COERCIONS = {"float", "int", "bool"}
+_NP_MATERIALIZERS = {"asarray", "array"}
+
+
+def _own_region(fn: ast.AST):
+    """The def's body excluding nested defs (their own graph nodes)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_jax_qual(q: Optional[str]) -> bool:
+    return q is not None and (q == "jax" or q.startswith(("jax.", "jax_")))
+
+
+def _is_jit_name(model: FileModel, node: ast.AST) -> bool:
+    # same shape as GL006's detection (rules.py) — duplicated because
+    # rules.py imports this module
+    term = terminal_name(node)
+    if term not in _JIT_WRAPPERS:
+        return False
+    q = model.qualname(node) or term
+    head = q.split(".")[0]
+    return (
+        head in ("jax", "pl", "jit", "vmap", "pmap")
+        or "jax" in q
+        or term in ("pallas_call", "shard_map")
+    )
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return []
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+def _static_names(call: ast.Call, params: Sequence[str]) -> Set[str]:
+    """static_argnames/static_argnums keywords of a jit(...) call, mapped
+    to parameter names."""
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg not in ("static_argnames", "static_argnums"):
+            continue
+        v = kw.value
+        elts = (
+            list(v.elts) if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        )
+        for e in elts:
+            if not isinstance(e, ast.Constant):
+                continue
+            if kw.arg == "static_argnames" and isinstance(e.value, str):
+                out.add(e.value)
+            elif (
+                kw.arg == "static_argnums"
+                and isinstance(e.value, int)
+                and not isinstance(e.value, bool)
+                and 0 <= e.value < len(params)
+            ):
+                out.add(params[e.value])
+    return out
+
+
+def _jit_roots(graph: CallGraph, model: FileModel) -> Dict[str, Set[str]]:
+    """fq -> static parameter names, for every jit-rooted def this module
+    declares: decorator forms (``@jax.jit``, ``@partial(jax.jit, ...)``)
+    and call forms (``jax.jit(fn, ...)``, ``pallas_call(kernel, ...)``)."""
+    dm = dotted_module(model)
+    roots: Dict[str, Set[str]] = {}
+    if dm is None:
+        return roots
+
+    def note(fq: str, statics: Set[str]) -> None:
+        if fq in graph.defs:
+            roots[fq] = roots.get(fq, set()) | statics
+
+    def jit_decoration(dec: ast.AST, params: Sequence[str]) -> Optional[Set[str]]:
+        if _is_jit_name(model, dec):
+            return set()
+        if isinstance(dec, ast.Call):
+            term = terminal_name(dec.func)
+            if term == "partial" and dec.args and _is_jit_name(
+                model, dec.args[0]
+            ):
+                return _static_names(dec, params)
+            if _is_jit_name(model, dec.func):
+                return _static_names(dec, params)
+        return None
+
+    def walk(node: ast.AST, stack: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                params = _param_names(child)
+                for dec in child.decorator_list:
+                    statics = jit_decoration(dec, params)
+                    if statics is not None:
+                        note(f"{dm}." + ".".join(stack + [child.name]), statics)
+                walk(child, stack + [child.name])
+            elif isinstance(child, ast.ClassDef):
+                walk(child, stack + [child.name])
+            else:
+                if isinstance(child, ast.Call) and _is_jit_name(
+                    model, child.func
+                ):
+                    for arg in child.args[:1]:
+                        if isinstance(arg, ast.Name):
+                            fq = graph.resolve(model, arg)
+                            if fq is not None:
+                                target = graph.defs.get(fq)
+                                params = (
+                                    _param_names(target.node)
+                                    if target is not None
+                                    else []
+                                )
+                                note(fq, _static_names(child, params))
+                walk(child, stack)
+
+    walk(model.tree, [])
+    return roots
+
+
+# -- GL014: host-sync leaks on the run_once hot path --------------------------
+
+
+class HostSyncChecker:
+    """GL014 — a device value must not be forced to host inside the
+    run_once-reachable decision path outside the telemetry seams."""
+
+    rule_id = "GL014"
+    title = "host-device sync on the run_once hot path"
+
+    def check_program(self, graph: CallGraph) -> List[Finding]:
+        roots = sorted(
+            fq
+            for fq, info in graph.defs.items()
+            if info.local.split(".")[-1] == HOT_ROOT
+        )
+        if not roots:
+            return []
+        # BFS with parent pointers: each finding renders its call chain
+        parent: Dict[str, Optional[str]] = {r: None for r in roots}
+        order: List[str] = list(roots)
+        i = 0
+        while i < len(order):
+            fq = order[i]
+            i += 1
+            info = graph.defs[fq]
+            for nxt in sorted(set(info.callees) | set(info.contains)):
+                if nxt in graph.defs and nxt not in parent:
+                    parent[nxt] = fq
+                    order.append(nxt)
+        out: List[Finding] = []
+        for fq in sorted(parent):
+            info = graph.defs[fq]
+            if info.local == MODULE_NODE:
+                continue
+            m = info.model
+            if not (in_replay_scope(m) or m.in_module("ops/")):
+                continue
+            if m.in_module(*TELEMETRY_SEAMS):
+                continue
+            out.extend(self._scan_def(graph, fq, info, parent))
+        return sorted(out, key=Finding.sort_key)
+
+    # -- per-def scan ---------------------------------------------------------
+
+    def _chain(self, fq: str, parent: Dict[str, Optional[str]]) -> List[str]:
+        chain = [fq]
+        while parent.get(chain[0]) is not None:
+            chain.insert(0, parent[chain[0]])
+        return chain
+
+    def _scan_def(
+        self,
+        graph: CallGraph,
+        fq: str,
+        info: DefInfo,
+        parent: Dict[str, Optional[str]],
+    ) -> List[Finding]:
+        model = info.model
+        device = self._device_names(model, info.node)
+        out: List[Finding] = []
+        for node in _own_region(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            why = self._sync_reason(model, node, device)
+            if why is None:
+                continue
+            chain = self._chain(fq, parent)
+            flow: List[FlowStep] = [
+                (
+                    d.model.path,
+                    getattr(d.node, "lineno", 1),
+                    f"{d.local.split('.')[-1]}()",
+                )
+                for d in (graph.defs[hop] for hop in chain)
+            ]
+            flow.append((model.path, node.lineno, why))
+            rendered = " -> ".join(c.split(".")[-1] for c in chain)
+            out.append(
+                model.finding(
+                    node,
+                    self.rule_id,
+                    f"{why} inside {info.local.split('.')[-1]}(), reached "
+                    f"from run_once ({rendered}) — device values must stay "
+                    "on device in the decision path; read them out behind "
+                    "the perf/telemetry seam instead",
+                    flow=flow,
+                )
+            )
+        return out
+
+    def _sync_reason(
+        self, model: FileModel, call: ast.Call, device: Set[str]
+    ) -> Optional[str]:
+        func = call.func
+        term = terminal_name(func)
+        if isinstance(func, ast.Attribute):
+            if term == "item" and not call.args and not call.keywords:
+                return ".item() host-device sync"
+            if term == "block_until_ready":
+                return ".block_until_ready() host-device sync"
+            q = model.qualname(func)
+            if q == "jax.device_get" and model.is_imported(func):
+                return "jax.device_get() host-device sync"
+            if (
+                term in _NP_MATERIALIZERS
+                and q is not None
+                and q.startswith("numpy.")
+                and call.args
+                and self._device_expr(model, call.args[0], device)
+            ):
+                return f"np.{term}() of a device value"
+        elif isinstance(func, ast.Name):
+            if term == "device_get" and model.is_imported(func):
+                return "jax.device_get() host-device sync"
+            if (
+                term in _HOST_COERCIONS
+                and call.args
+                and self._device_expr(model, call.args[0], device)
+            ):
+                return f"{term}() of a device value forces a sync"
+        return None
+
+    def _device_names(self, model: FileModel, fn: ast.AST) -> Set[str]:
+        """Names provably bound to device values in this def's own region
+        (forward pass, source order)."""
+        device: Set[str] = set()
+        assigns = sorted(
+            (
+                n
+                for n in _own_region(fn)
+                if isinstance(n, ast.Assign)
+            ),
+            key=lambda n: (n.lineno, n.col_offset),
+        )
+        for node in assigns:
+            is_dev = self._device_expr(model, node.value, device)
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    if is_dev:
+                        device.add(tgt.id)
+                    else:
+                        device.discard(tgt.id)  # rebinding kills
+        return device
+
+    def _device_expr(
+        self, model: FileModel, expr: ast.AST, device: Set[str]
+    ) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in device
+        if isinstance(expr, ast.Call):
+            if _is_jax_qual(model.qualname(expr.func)) and model.is_imported(
+                expr.func
+            ):
+                return True
+            # x.sum() of a device value is still a device value
+            if isinstance(expr.func, ast.Attribute):
+                return self._device_expr(model, expr.func.value, device)
+            return False
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _SHAPE_PROJECTIONS:
+                return False  # static under tracing, host-side ints
+            return self._device_expr(model, expr.value, device)
+        if isinstance(expr, ast.Subscript):
+            return self._device_expr(model, expr.value, device)
+        if isinstance(expr, ast.BinOp):
+            return self._device_expr(
+                model, expr.left, device
+            ) or self._device_expr(model, expr.right, device)
+        if isinstance(expr, ast.UnaryOp):
+            return self._device_expr(model, expr.operand, device)
+        return False
+
+
+# -- GL015: recompile hazards in jitted bodies --------------------------------
+
+
+class RecompileHazardChecker:
+    """GL015 — a jitted body must not retrace per value/shape, and static
+    arguments must be hashable at every dispatch site."""
+
+    rule_id = "GL015"
+    title = "recompile hazard inside a jitted body"
+
+    def __init__(self):
+        # def fq -> its body hazards (certify_kernels reads this after
+        # check_program; call-site findings are deliberately not included —
+        # they belong to the dispatching caller, not the kernel)
+        self.hazards_by_def: Dict[str, List[Finding]] = {}
+
+    def check_program(self, graph: CallGraph) -> List[Finding]:
+        self.hazards_by_def = {}
+        out: List[Finding] = []
+        all_roots: Dict[str, Set[str]] = {}
+        for model in graph.models:
+            if not model.in_module(*JIT_SCOPES):
+                continue
+            for fq, statics in _jit_roots(graph, model).items():
+                all_roots[fq] = all_roots.get(fq, set()) | statics
+        for fq in sorted(all_roots):
+            info = graph.defs[fq]
+            found = self._check_body(info, all_roots[fq])
+            if found:
+                self.hazards_by_def[fq] = found
+            out.extend(found)
+        out.extend(self._check_static_sites(graph, all_roots))
+        return sorted(out, key=Finding.sort_key)
+
+    # -- body hazards ---------------------------------------------------------
+
+    def _check_body(self, info: DefInfo, statics: Set[str]) -> List[Finding]:
+        model = info.model
+        fn = info.node
+        name = info.local.split(".")[-1]
+        tracers = {
+            p
+            for p in _param_names(fn)
+            if p not in statics and p not in ("self", "cls")
+        }
+        # names bound from jax/jnp results are tracer-derived too
+        for node in _own_region(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                if _is_jax_qual(model.qualname(node.value.func)):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            tracers.add(tgt.id)
+        out: List[Finding] = []
+        for node in _own_region(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                use = self._tracer_use(model, node.test, tracers)
+                if use is not None:
+                    out.append(
+                        model.finding(
+                            node,
+                            self.rule_id,
+                            f"Python {type(node).__name__.lower()} on "
+                            f"tracer-derived value {use} inside jitted "
+                            f"{name}() — every distinct value retraces; "
+                            "use jnp.where/lax.cond, or declare the "
+                            "parameter in static_argnames",
+                        )
+                    )
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                hazard = self._loop_hazard(model, node.iter, tracers)
+                if hazard is not None:
+                    out.append(
+                        model.finding(
+                            node,
+                            self.rule_id,
+                            f"shape-dependent Python loop over {hazard} "
+                            f"inside jitted {name}() — the loop unrolls "
+                            "per value/shape and retriggers tracing; loop "
+                            "to a padded static bound or use "
+                            "lax.fori_loop",
+                        )
+                    )
+        return out
+
+    def _tracer_use(
+        self, model: FileModel, expr: ast.AST, tracers: Set[str]
+    ) -> Optional[str]:
+        """Does a tracer flow into this test as a VALUE (shape/dtype
+        projections and identity-vs-None checks are trace-static)?"""
+        if isinstance(expr, ast.Name):
+            return f"{expr.id!r}" if expr.id in tracers else None
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _SHAPE_PROJECTIONS:
+                return None
+            return self._tracer_use(model, expr.value, tracers)
+        if isinstance(expr, ast.Subscript):
+            # x.shape[0] stays static; x[0] of a tracer is a tracer
+            return self._tracer_use(model, expr.value, tracers)
+        if isinstance(expr, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops):
+                return None  # `x is None` retraces once per arity, by design
+            for part in (expr.left, *expr.comparators):
+                use = self._tracer_use(model, part, tracers)
+                if use is not None:
+                    return use
+            return None
+        if isinstance(expr, ast.BoolOp):
+            for part in expr.values:
+                use = self._tracer_use(model, part, tracers)
+                if use is not None:
+                    return use
+            return None
+        if isinstance(expr, (ast.BinOp, ast.UnaryOp)):
+            parts = (
+                (expr.left, expr.right)
+                if isinstance(expr, ast.BinOp)
+                else (expr.operand,)
+            )
+            for part in parts:
+                use = self._tracer_use(model, part, tracers)
+                if use is not None:
+                    return use
+            return None
+        if isinstance(expr, ast.Call):
+            q = model.qualname(expr.func)
+            if _is_jax_qual(q) and model.is_imported(expr.func):
+                return f"{q}(...) result"
+            if isinstance(expr.func, ast.Attribute):
+                # x.sum() of a tracer is a tracer; helper(x) is NOT
+                # assumed one — the helper may branch on static metadata
+                # only, and this rule proves hazards, it never guesses
+                return self._tracer_use(model, expr.func.value, tracers)
+            return None
+        return None
+
+    def _loop_hazard(
+        self, model: FileModel, it: ast.AST, tracers: Set[str]
+    ) -> Optional[str]:
+        """``range(n)``/``range(x.shape[0])`` with n a non-static tracer
+        parameter (or its shape) unrolls per call."""
+        if not (
+            isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Name)
+            and it.func.id == "range"
+        ):
+            return None
+        for arg in it.args:
+            for node in ast.walk(arg):
+                if isinstance(node, ast.Name) and node.id in tracers:
+                    # range(x.shape[0]) is shape-dependent; range(x) is
+                    # value-dependent — both retrace, name them distinctly
+                    parent_is_shape = any(
+                        isinstance(p, ast.Attribute)
+                        and p.attr in _SHAPE_PROJECTIONS
+                        for p in ast.walk(arg)
+                    )
+                    what = (
+                        f"non-static parameter {node.id!r}'s shape"
+                        if parent_is_shape
+                        else f"non-static parameter {node.id!r}"
+                    )
+                    return what
+        return None
+
+    # -- dispatch-site static hashability -------------------------------------
+
+    _UNHASHABLE = {
+        ast.List: "list",
+        ast.Dict: "dict",
+        ast.Set: "set",
+        ast.ListComp: "list",
+        ast.DictComp: "dict",
+        ast.SetComp: "set",
+    }
+
+    def _check_static_sites(
+        self, graph: CallGraph, roots: Dict[str, Set[str]]
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        for fq in sorted(roots):
+            statics = roots[fq]
+            if not statics:
+                continue
+            info = graph.defs[fq]
+            params = _param_names(info.node)
+            name = info.local.split(".")[-1]
+            for missing in sorted(statics - set(params)):
+                out.append(
+                    info.model.finding(
+                        info.node,
+                        self.rule_id,
+                        f"static_argnames names {missing!r} which is not a "
+                        f"parameter of jitted {name}() — the jit decoration "
+                        "and the signature have drifted",
+                    )
+                )
+            for site in graph.call_sites(fq):
+                bound: Dict[str, ast.AST] = {}
+                offset = 0
+                if params[:1] == ["self"]:
+                    offset = 1
+                for i, arg in enumerate(site.call.args):
+                    if i + offset < len(params):
+                        bound[params[i + offset]] = arg
+                for kw in site.call.keywords:
+                    if kw.arg is not None:
+                        bound[kw.arg] = kw.value
+                for p in sorted(statics & set(bound)):
+                    kind = self._UNHASHABLE.get(type(bound[p]))
+                    if kind is not None:
+                        out.append(
+                            site.model.finding(
+                                site.call,
+                                self.rule_id,
+                                f"unhashable {kind} literal passed to "
+                                f"static parameter {p!r} of jitted "
+                                f"{name}() — jit static args key the "
+                                "compile cache and must be hashable; pass "
+                                "a tuple",
+                            )
+                        )
+        return out
+
+
+# -- KERNEL_CONTRACTS cross-check ---------------------------------------------
+
+
+def certify_kernels(
+    graph: CallGraph,
+) -> Dict[str, Tuple[str, List[Finding]]]:
+    """For every KERNEL_CONTRACTS-listed kernel entry: ``certified`` when
+    no GL015 hazard exists in any definition reachable from it (pallas
+    kernels referenced as jit-wrapper first arguments included),
+    ``hazardous`` with the violating findings otherwise, ``unknown`` when
+    the contracted name has no definition (GL007 reports that case)."""
+    checker = RecompileHazardChecker()
+    checker.check_program(graph)
+    out: Dict[str, Tuple[str, List[Finding]]] = {}
+    for model in graph.models:
+        if not (model.module and model.module.startswith("ops/")):
+            continue
+        contracts, _ = extract_contracts(model)
+        if not contracts:
+            continue
+        dm = dotted_module(model)
+        for fn_name in sorted(contracts):
+            fq = f"{dm}.{fn_name}"
+            if fq not in graph.defs:
+                out[fn_name] = ("unknown", [])
+                continue
+            reach = set(graph.reachable([fq]))
+            # pallas_call(kernel)/jax.jit(fn) first-arg references inside
+            # the reachable set dispatch those defs too
+            for d in sorted(reach):
+                info = graph.defs[d]
+                for node in _own_region(info.node):
+                    if isinstance(node, ast.Call) and _is_jit_name(
+                        info.model, node.func
+                    ):
+                        for arg in node.args[:1]:
+                            if isinstance(arg, ast.Name):
+                                target = graph.resolve(info.model, arg)
+                                if target is not None and target not in reach:
+                                    reach |= graph.reachable([target])
+            hazards = [
+                f
+                for d in sorted(reach)
+                for f in checker.hazards_by_def.get(d, [])
+            ]
+            out[fn_name] = (
+                ("certified", []) if not hazards else ("hazardous", hazards)
+            )
+    return out

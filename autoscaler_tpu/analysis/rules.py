@@ -1,15 +1,17 @@
-"""graftlint rules GL001–GL012 — each derived from an invariant the
+"""graftlint rules GL001–GL015 — each derived from an invariant the
 codebase already claims. See RULES.md (same directory) for the catalog,
 rationale, and suppression etiquette.
 
 Per-file rules (GL001–GL005) are small classes with ``rule_id``, ``title``
 and ``check(model: FileModel) -> list[Finding]``; they walk the one shared
-AST. Whole-program rules (GL006–GL012) implement
+AST. Whole-program rules (GL006–GL015) implement
 ``check_program(graph: CallGraph) -> list[Finding]`` instead and see every
 file at once — GL006 jit purity lives here; the kernel contract checker
 (GL007), lock-order analysis (GL008), flag wiring (GL009), taint-flow
-determinism + surface gating (GL010/GL012, ``dataflow.py``) and
-thread-escape analysis (GL011, ``escape.py``) live in their own modules.
+determinism + surface gating (GL010/GL012, ``dataflow.py``),
+thread-escape analysis (GL011, ``escape.py``), the interprocedural
+determinism-taint engine (GL013, ``taint.py``) and the device hot-path
+purity rules (GL014/GL015, ``purity.py``) live in their own modules.
 Nothing here imports beyond the stdlib.
 """
 from __future__ import annotations
@@ -40,6 +42,11 @@ from autoscaler_tpu.analysis.escape import (
 )
 from autoscaler_tpu.analysis.flags import FlagWiringChecker
 from autoscaler_tpu.analysis.lockgraph import LockOrderChecker
+from autoscaler_tpu.analysis.purity import (
+    HostSyncChecker,
+    RecompileHazardChecker,
+)
+from autoscaler_tpu.analysis.taint import DeterminismTaintChecker
 
 # -- shared helpers -----------------------------------------------------------
 
@@ -563,6 +570,9 @@ ALL_PROGRAM_RULES: Sequence = (
     TaintFlowChecker(),
     ThreadEscapeChecker(),
     SurfaceGatingChecker(),
+    DeterminismTaintChecker(),
+    HostSyncChecker(),
+    RecompileHazardChecker(),
 )
 
 RULE_CATALOG = {

@@ -19,6 +19,7 @@ NodeDeletionTracker stays the cross-loop source of truth either way.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -42,6 +43,7 @@ from autoscaler_tpu.kube.objects import (
     Pod,
 )
 from autoscaler_tpu.simulator.removal import NodeToRemove
+from autoscaler_tpu.utils.errors import to_autoscaler_error
 
 
 @dataclass
@@ -190,7 +192,9 @@ class NodeDeletionBatcher:
                     group.delete_nodes(nodes)
                     err = None
                 except Exception as e:
-                    err = str(e)
+                    # typed wrapping: str() is preserved for non-empty
+                    # messages, and an empty one gains the exception class
+                    err = str(to_autoscaler_error(e))
             if self.on_result is not None:
                 for node in nodes:
                     self.on_result(node, gid, err)
@@ -250,13 +254,24 @@ class ScaleDownActuator:
             landed server-side before its call raised must still be undone."""
             try:
                 self.api.remove_taint(name, TO_BE_DELETED_TAINT)
-            except Exception:
-                pass
+            except Exception as e:
+                # best-effort by design, but the swallow must not be
+                # silent: a node left tainted is invisible to schedulers
+                # until the next loop re-reconciles it
+                logging.getLogger("scaledown").debug(
+                    "rollback: taint removal on %s failed: %s",
+                    name,
+                    to_autoscaler_error(e),
+                )
             if self.options.cordon_node_before_terminating:
                 try:
                     self.api.uncordon_node(name)
-                except Exception:
-                    pass
+                except Exception as e:
+                    logging.getLogger("scaledown").debug(
+                        "rollback: uncordon of %s failed: %s",
+                        name,
+                        to_autoscaler_error(e),
+                    )
 
         # 1. taint everything up front, atomically-ish (actuator.go:95,111);
         # roll back taints on nodes we end up not deleting.
@@ -266,7 +281,11 @@ class ScaleDownActuator:
                 if self.options.cordon_node_before_terminating:
                     self.api.cordon_node(r.node.name)
             except Exception as e:
-                result.failed[r.node.name] = f"taint failed: {e}"
+                # typed wrapping keeps str() identical for non-empty
+                # messages, so the result map reads the same downstream
+                result.failed[r.node.name] = (
+                    f"taint failed: {to_autoscaler_error(e)}"
+                )
                 rollback_node(r.node.name)
         empty = [r for r in empty if r.node.name not in result.failed]
         drain = [r for r in drain if r.node.name not in result.failed]
@@ -343,11 +362,14 @@ class ScaleDownActuator:
             try:
                 fn(r, group)
             except Exception as e:
+                # one typed rendering feeds both the tracker and the
+                # result map so they can never disagree about the cause
+                msg = str(to_autoscaler_error(e))
                 self.tracker.end_deletion(
-                    group.id(), r.node.name, ok=False, error=str(e), ts=now_ts
+                    group.id(), r.node.name, ok=False, error=msg, ts=now_ts
                 )
                 with result_lock:
-                    result.failed[r.node.name] = str(e)
+                    result.failed[r.node.name] = msg
                 rollback_node(r.node.name)
 
         # 2. fan the wave out on a bounded worker pool (the goroutine analog).

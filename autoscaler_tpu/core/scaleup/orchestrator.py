@@ -28,6 +28,7 @@ from autoscaler_tpu.estimator.binpacking import BinpackingNodeEstimator
 from autoscaler_tpu.estimator.limiter import ThresholdBasedEstimationLimiter
 from autoscaler_tpu.expander.core import Option, Strategy
 from autoscaler_tpu.kube.objects import Node, Pod
+from autoscaler_tpu.utils.errors import to_autoscaler_error
 
 
 @dataclass
@@ -218,10 +219,14 @@ class ScaleUpOrchestrator:
                     template = group.template_node_info()
                 except Exception as e:  # no template → skip (orchestrator.go:157)
                     # the closed enum cannot carry the exception text the
-                    # old free-form string did — log it so the diagnostic
-                    # detail behind a persistent no_template skip survives
+                    # old free-form string did — log it (typed, so the
+                    # error class survives alongside the message) and keep
+                    # the diagnostic detail behind a persistent
+                    # no_template skip
                     logging.getLogger("scaleup").info(
-                        "node group %s skipped: no template (%s)", gid, e
+                        "node group %s skipped: no template (%s)",
+                        gid,
+                        to_autoscaler_error(e),
                     )
                     skipped[gid] = SkipReason.NO_TEMPLATE
                     continue
@@ -353,9 +358,12 @@ class ScaleUpOrchestrator:
                 self.csr.register_or_update_scale_up(group.id(), delta, now_ts)
                 executed.append((group.id(), delta))
             except Exception as e:
-                self.csr.register_failed_scale_up(group.id(), str(e), now_ts)
+                # typed wrapping preserves str(e) for non-empty messages,
+                # so the decision record and CSR backoff text are unchanged
+                err = to_autoscaler_error(e)
+                self.csr.register_failed_scale_up(group.id(), str(err), now_ts)
                 return ScaleUpResult(
-                    error=f"scale-up of {group.id()} failed: {e}",
+                    error=f"scale-up of {group.id()} failed: {err}",
                     # provenance: the expander DID choose (the cloud then
                     # refused) — the decision record names the winner, the
                     # executed prefix, and every pod left pending, so a
@@ -402,5 +410,7 @@ class ScaleUpOrchestrator:
                     self.csr.register_or_update_scale_up(group.id(), delta, now_ts)
                     executed.append((group.id(), delta))
                 except Exception as e:
-                    self.csr.register_failed_scale_up(group.id(), str(e), now_ts)
+                    self.csr.register_failed_scale_up(
+                        group.id(), str(to_autoscaler_error(e)), now_ts
+                    )
         return executed

@@ -8,12 +8,12 @@ cd "$(dirname "$0")/.."
 echo "== python syntax/compile check =="
 python -m compileall -q autoscaler_tpu bench.py __graft_entry__.py
 
-echo "== graftlint (AST invariant gate: determinism, taxonomy, ladder, locks, boundaries, jit purity, kernel contracts, lock order, flag wiring, taint flow, thread escape, surface gating) =="
+echo "== graftlint (AST invariant gate: determinism, taxonomy, ladder, locks, boundaries, jit purity, kernel contracts, lock order, flag wiring, taint flow, thread escape, surface gating, interprocedural taint, host-sync leaks, recompile hazards) =="
 # Fatal. Exits nonzero on any finding not grandfathered in
 # hack/lint-baseline.json AND on stale baseline entries (a baselined
 # finding that no longer exists must be struck via --update-baseline, so
 # the debt ledger can only shrink). The text run prints the per-rule
-# findings/suppressions/baseline summary table (GL000–GL012) so CI logs
+# findings/suppressions/baseline summary table (GL000–GL015) so CI logs
 # show ratchet drift at a glance. The self-scan must stay CLEAN under the
 # dataflow rules — GL010 findings are fixed at the source, never
 # baselined. Rule catalog: autoscaler_tpu/analysis/RULES.md
@@ -39,6 +39,62 @@ if ! diff -q "$lint_tmp/a.json" "$lint_tmp/c.json" >/dev/null; then
     exit 1
 fi
 echo "graftlint determinism + cache parity ok"
+
+echo "== graftlint-v2 gate (--jobs fan-out parity, analysis/ self-scan, baseline freshness, SARIF emission, KERNEL_CONTRACTS purity certification) =="
+# the --jobs fork pool must reproduce the serial document byte-for-byte
+# (per-file rules fan out, fold-back is deferred to sorted path order)
+python -m autoscaler_tpu.analysis --format=json --jobs 4 autoscaler_tpu/ > "$lint_tmp/jobs.json"
+if ! diff -q "$lint_tmp/a.json" "$lint_tmp/jobs.json" >/dev/null; then
+    echo "ERROR: graftlint --jobs output differs from the serial run:" >&2
+    diff "$lint_tmp/a.json" "$lint_tmp/jobs.json" | head -20 >&2
+    exit 1
+fi
+# the analyzer's own package must scan clean with NO baseline and NO
+# pragmas doing load-bearing work — the tool that polices the tree cannot
+# carry debt of its own
+python -m autoscaler_tpu.analysis --no-baseline autoscaler_tpu/analysis/
+# baseline freshness: the debt ledger may hold no entry the scan no
+# longer reproduces (the main gate already fails on staleness; this
+# asserts the machine-readable document agrees)
+python - "$lint_tmp/a.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert not doc["stale"], f"stale baseline entries: {doc['stale'][:3]}"
+assert not doc["findings"], f"unbaselined findings: {doc['findings'][:3]}"
+print(f"baseline fresh ({doc['files']} files)")
+EOF
+# SARIF 2.1.0 emission: exit 0 on the clean tree, document parses, every
+# registered rule is listed, taint codeFlows shape is intact
+python -m autoscaler_tpu.analysis --format=sarif autoscaler_tpu/ > "$lint_tmp/scan.sarif"
+python - "$lint_tmp/scan.sarif" <<'EOF'
+import json, sys
+from autoscaler_tpu.analysis.rules import RULE_CATALOG
+doc = json.load(open(sys.argv[1]))
+assert doc["version"] == "2.1.0", doc["version"]
+driver = doc["runs"][0]["tool"]["driver"]
+assert driver["name"] == "graftlint"
+ids = {r["id"] for r in driver["rules"]}
+missing = set(RULE_CATALOG) - ids
+assert not missing, f"rules absent from SARIF metadata: {sorted(missing)}"
+print(f"sarif ok ({len(ids)} rules, {len(doc['runs'][0]['results'])} results)")
+EOF
+# GL015 cross-check: every kernel a KERNEL_CONTRACTS table names must be
+# statically certified recompile-hazard-free over its transitive reach —
+# hazardous AND unknown verdicts both fail (a contract the analyzer
+# cannot resolve is a contract it cannot stand behind)
+python - <<'EOF'
+from pathlib import Path
+from autoscaler_tpu.analysis.callgraph import CallGraph
+from autoscaler_tpu.analysis.engine import FileModel, iter_python_files
+from autoscaler_tpu.analysis.purity import certify_kernels
+models = [FileModel(f, Path(f).read_text(encoding="utf-8"))
+          for f in iter_python_files(["autoscaler_tpu"])]
+verdicts = certify_kernels(CallGraph(models))
+assert verdicts, "no KERNEL_CONTRACTS kernels found — vacuous certification"
+bad = {k: v for k, v in verdicts.items() if v[0] != "certified"}
+assert not bad, f"uncertified kernels: {bad}"
+print(f"kernel purity certification ok ({len(verdicts)} kernels certified)")
+EOF
 rm -rf "$lint_tmp"
 
 echo "== proto freshness check =="

@@ -1,0 +1,11 @@
+# corpus-path: autoscaler_tpu/journal/helper.py
+# corpus-rules: GL013
+#
+# Producer half of the cross-module case: the unordered walk is realized
+# HERE, but the sink lives in writer.py — the finding must carry hops in
+# both files.
+
+
+def collect_names(snapshot):
+    empty = {n.name for n in snapshot.nodes if not n.pods}
+    return [name for name in empty]

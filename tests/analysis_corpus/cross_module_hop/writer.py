@@ -1,0 +1,11 @@
+# corpus-path: autoscaler_tpu/journal/writer.py
+#
+# Sink half: taint enters through collect_names()'s return value — a
+# file-local pass cannot see this; only the interprocedural summary can.
+from autoscaler_tpu.journal.helper import collect_names
+from autoscaler_tpu.journal.ledger import record_line
+
+
+def journal_snapshot(snapshot):
+    names = collect_names(snapshot)
+    record_line({"kind": "snapshot", "names": names})  # gl-expect: GL013

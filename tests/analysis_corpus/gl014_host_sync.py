@@ -1,0 +1,17 @@
+# corpus-path: autoscaler_tpu/core/gl014_host_sync.py
+# corpus-rules: GL014
+#
+# A host-device sync on the replay hot path: .item() inside a helper
+# reached from run_once() stalls the device pipeline every iteration.
+# The finding's flow must render the run_once -> helper call chain.
+import jax.numpy as jnp
+
+
+def run_once(state):
+    score = _score(state)
+    return score
+
+
+def _score(state):
+    total = jnp.sum(state.load)
+    return total.item()  # gl-expect: GL014

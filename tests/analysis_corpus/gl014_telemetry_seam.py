@@ -1,0 +1,17 @@
+# corpus-path: autoscaler_tpu/perf/gl014_telemetry_seam.py
+# corpus-rules: GL014
+#
+# The negative twin of gl014_host_sync.py: the same .item() sync, but the
+# module lives under perf/ — a telemetry seam, where host readback is the
+# whole point. GL014 must stay silent.
+import jax.numpy as jnp
+
+
+def run_once(state):
+    score = _score(state)
+    return score
+
+
+def _score(state):
+    total = jnp.sum(state.load)
+    return total.item()

@@ -1,0 +1,32 @@
+# corpus-path: autoscaler_tpu/ops/gl015_static_ok.py
+# corpus-rules: GL015
+#
+# The negative twin: branching on a static_argnames parameter is
+# trace-time constant folding, a tracer comparison routed through
+# jnp.where stays on-device, and a literal-bound Python loop unrolls
+# identically on every trace. None of these retrace per value — GL015
+# must stay silent.
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames="mode")
+def scale(x, mode):
+    if mode == "double":
+        return x * 2
+    return x
+
+
+@jax.jit
+def clamp_score(x):
+    return jnp.where(x > 0, x, -x)
+
+
+@jax.jit
+def triple_sum(x):
+    total = jnp.zeros(())
+    for _ in range(3):
+        total = total + jnp.sum(x)
+    return total

@@ -1,0 +1,23 @@
+# corpus-path: autoscaler_tpu/ops/gl015_tracer_branch.py
+# corpus-rules: GL015
+#
+# Recompile hazards inside a jitted body: Python `if` on a tracer and a
+# Python loop whose trip count is a tracer both force a retrace per
+# distinct value — silent compile storms on the dispatch hot path.
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clamp_score(x):
+    if x > 0:  # gl-expect: GL015
+        return x
+    return -x
+
+
+@jax.jit
+def accumulate(x, n):
+    total = jnp.zeros(())
+    for _ in range(n):  # gl-expect: GL015
+        total = total + x
+    return total

@@ -1,0 +1,14 @@
+# corpus-path: autoscaler_tpu/journal/pr12_hash_order.py
+# corpus-rules: GL013
+#
+# The PR-12 regression: a set comprehension's iteration order (seeded by
+# PYTHONHASHSEED) flowed straight into a schema'd JSONL ledger line, so
+# two replays of the same trace diverged byte-for-byte. GL013 must name
+# the full walk: set built -> realization -> ledger sink.
+from autoscaler_tpu.journal.ledger import record_line
+
+
+def journal_empty_nodes(snapshot):
+    empty = {n.name for n in snapshot.nodes if not n.pods}
+    names = [name for name in empty]
+    record_line({"kind": "empty_nodes", "names": names})  # gl-expect: GL013

@@ -1,0 +1,13 @@
+# corpus-path: autoscaler_tpu/journal/pr12_sorted_twin.py
+# corpus-rules: GL013 GL010
+#
+# The sanitized twin of pr12_hash_order.py: sorted() pins the realization
+# order, so the same walk is deterministic and no rule may fire. This is
+# the sanitizer half of the PR-12 acceptance pair.
+from autoscaler_tpu.journal.ledger import record_line
+
+
+def journal_empty_nodes(snapshot):
+    empty = {n.name for n in snapshot.nodes if not n.pods}
+    names = sorted(empty)
+    record_line({"kind": "empty_nodes", "names": names})

@@ -1,0 +1,12 @@
+# corpus-path: autoscaler_tpu/journal/pragma_no_reason.py
+# corpus-rules: GL000 GL010 GL013
+#
+# A pragma WITHOUT a reason is itself a finding: GL000 fires (and is
+# unsuppressible), so a bare waiver can never silently stick.
+from autoscaler_tpu.journal.ledger import record_line
+
+
+def journal_tags(snapshot):
+    tags = {t for n in snapshot.nodes for t in n.tags}
+    listed = [t for t in tags]
+    record_line({"tags": listed})  # graftlint: disable=GL010,GL013  # gl-expect: GL000

@@ -1,0 +1,12 @@
+# corpus-path: autoscaler_tpu/journal/pragma_with_reason.py
+# corpus-rules: GL000 GL010 GL013
+#
+# The sanctioned escape hatch: a pragma WITH a reason suppresses the
+# taint findings on its line, and the reason makes the waiver auditable.
+from autoscaler_tpu.journal.ledger import record_line
+
+
+def journal_tags(snapshot):
+    tags = {t for n in snapshot.nodes for t in n.tags}
+    listed = [t for t in tags]
+    record_line({"tags": listed})  # graftlint: disable=GL010,GL013 — tag order is consumed as a set downstream
