@@ -4,7 +4,7 @@ Usage::
 
     python -m autoscaler_tpu.analysis [paths...]
         [--baseline FILE] [--no-baseline] [--update-baseline] [--list-rules]
-        [--format {text,json,github,sarif}] [--jobs N]
+        [--explain RULE] [--format {text,json,github,sarif}] [--jobs N]
 
 Default paths: ``autoscaler_tpu`` under the current directory. The baseline
 defaults to ``hack/lint-baseline.json`` discovered by walking up from the
@@ -13,8 +13,14 @@ current directory (``--no-baseline`` disables, ``--baseline`` overrides).
 Output formats: ``text`` (findings to stdout, per-rule summary table to
 stderr), ``json`` (one machine-readable document on stdout — byte-stable
 across runs, ``hack/verify.sh`` diffs two consecutive runs), ``github``
-(workflow-annotation ``::error``/``::warning`` lines), ``sarif``
-(SARIF 2.1.0 with taint paths as codeFlows — see ``sarif.py``).
+(workflow-annotation ``::error``/``::warning`` lines; findings carrying a
+witness path — GL016 leak paths, taint flows — get one ``::notice`` per
+step so the annotated PR shows the whole walk), ``sarif`` (SARIF 2.1.0
+with witness paths as codeFlows — see ``sarif.py``).
+
+``--explain RULE`` prints the rule's full RULES.md section (the same
+document SARIF rule metadata is assembled from) and exits — the
+from-the-terminal answer to "what is GL016 and why did it fire".
 
 ``--jobs N`` fans the per-file rules out over N worker processes
 (whole-program passes stay in the parent); output is byte-identical to a
@@ -134,8 +140,51 @@ def _emit_github(new: List[Finding], stale: List[str]) -> None:
             f"::error file={f.path},line={f.line},title=graftlint {f.rule}"
             f"::{f.message}"
         )
+        # witness walk (GL016 leak paths, GL010/13 taint flows): one
+        # ::notice per step, so the annotated PR shows the whole path
+        # from acquire to the exit that leaks it, not just the endpoint
+        for step, (path, line, note) in enumerate(f.flow, 1):
+            print(
+                f"::notice file={path},line={line},"
+                f"title=graftlint {f.rule} path {step}/{len(f.flow)}"
+                f"::{note}"
+            )
     for s in stale:
         print(f"::warning title=graftlint stale baseline::{s}")
+
+
+def _explain(rule_id: str) -> int:
+    """Print RULE's full RULES.md section (heading to next ``## `` or
+    EOF). Exit 0 on success, 2 when the rule has no section — a typo'd id
+    must not silently print nothing and read as documented."""
+    md = Path(__file__).resolve().parent / "RULES.md"
+    try:
+        lines = md.read_text(encoding="utf-8").splitlines()
+    except OSError as e:
+        print(f"graftlint: cannot read {md}: {e}", file=sys.stderr)
+        return 2
+    want = rule_id.upper()
+    out: List[str] = []
+    in_section = False
+    for line in lines:
+        if line.startswith("## "):
+            if in_section:
+                break
+            in_section = line.startswith(f"## {want} ")
+        if in_section:
+            out.append(line)
+    if not out:
+        known = ", ".join(sorted(RULE_CATALOG))
+        print(
+            f"graftlint: no RULES.md section for {rule_id!r} "
+            f"(known rules: {known})",
+            file=sys.stderr,
+        )
+        return 2
+    while out and not out[-1].strip():
+        out.pop()
+    print("\n".join(out))
+    return 0
 
 
 def _run(argv: Optional[List[str]] = None) -> int:
@@ -149,7 +198,8 @@ def _run(argv: Optional[List[str]] = None) -> int:
             "flag wiring (GL009), taint-flow determinism (GL010), "
             "thread escape (GL011), surface gating (GL012), "
             "interprocedural determinism taint (GL013), host-sync leaks "
-            "(GL014), recompile hazards (GL015). "
+            "(GL014), recompile hazards (GL015), obligation typestate "
+            "(GL016), ledger-schema drift (GL017). "
             "See autoscaler_tpu/analysis/RULES.md."
         ),
     )
@@ -175,6 +225,11 @@ def _run(argv: Optional[List[str]] = None) -> int:
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    parser.add_argument(
+        "--explain",
+        metavar="RULE",
+        help="print RULE's full RULES.md section and exit",
     )
     parser.add_argument(
         "--format",
@@ -211,6 +266,9 @@ def _run(argv: Optional[List[str]] = None) -> int:
         for rule_id, title in sorted(RULE_CATALOG.items()):
             print(f"{rule_id}  {title}")
         return 0
+
+    if args.explain:
+        return _explain(args.explain)
 
     if args.no_baseline and args.update_baseline:
         print(

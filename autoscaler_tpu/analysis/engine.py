@@ -47,7 +47,7 @@ PACKAGE_DIR_NAME = "autoscaler_tpu"
 # Bumped whenever finding semantics or the cached-finding schema change in a
 # way the source digest alone would not capture (the cache salts its keys
 # with BOTH this and a digest of the analysis sources + rule table).
-ENGINE_VERSION = 2
+ENGINE_VERSION = 3
 
 # `# graftlint: disable=GL001,GL004 — reason` (reason separator: any dash
 # family or a colon; the reason itself is mandatory — enforced as GL000)

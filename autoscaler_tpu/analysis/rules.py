@@ -42,6 +42,8 @@ from autoscaler_tpu.analysis.escape import (
 )
 from autoscaler_tpu.analysis.flags import FlagWiringChecker
 from autoscaler_tpu.analysis.lockgraph import LockOrderChecker
+from autoscaler_tpu.analysis.obligations import ObligationChecker
+from autoscaler_tpu.analysis.schema import SchemaChecker
 from autoscaler_tpu.analysis.purity import (
     HostSyncChecker,
     RecompileHazardChecker,
@@ -573,6 +575,8 @@ ALL_PROGRAM_RULES: Sequence = (
     DeterminismTaintChecker(),
     HostSyncChecker(),
     RecompileHazardChecker(),
+    ObligationChecker(),
+    SchemaChecker(),
 )
 
 RULE_CATALOG = {

@@ -41,6 +41,7 @@ from autoscaler_tpu.simulator.removal import UnremovableReason
 from autoscaler_tpu.snapshot.cluster_snapshot import ClusterSnapshot
 from autoscaler_tpu import trace
 from autoscaler_tpu.utils import klogx
+from autoscaler_tpu.utils.errors import to_autoscaler_error
 
 
 @dataclass
@@ -412,8 +413,14 @@ class StaticAutoscaler:
                         },
                     )
                     trace.add_event("status.configmap_write")
-                except Exception:
-                    pass  # best-effort observability, never loop-fatal
+                except Exception as e:
+                    # best-effort observability, never loop-fatal — but the
+                    # failure is typed, counted, and on the tick's trace
+                    err = to_autoscaler_error(e)
+                    m.errors_total.inc(type=err.error_type.value)
+                    trace.add_event(
+                        "status.configmap_write_failed", error=str(err)
+                    )
         # last_activity per activity label (metrics.go UpdateLastTime): the
         # main label every loop; scaleUp/scaleDown in their branches below
         m.last_activity.set(now_ts, activity=metrics_mod.MAIN)
@@ -513,8 +520,11 @@ class StaticAutoscaler:
             try:
                 self.provider.refresh()
             except Exception as e:
+                # typed routing; errors_total accounting rides the
+                # result.errors loop at the end of _run_once_traced
+                err = to_autoscaler_error(e)
                 sp.set_attrs(error="refresh_failed")
-                result.errors.append(f"provider refresh failed: {e}")
+                result.errors.append(f"provider refresh failed: {err}")
                 return result
             all_nodes = self.api.list_nodes()
             all_pods = self.api.list_pods()
@@ -958,7 +968,9 @@ class StaticAutoscaler:
             if g.id() == group_id:
                 try:
                     tmpl = g.template_node_info()
-                except Exception:
+                except Exception as e:
+                    err = to_autoscaler_error(e)
+                    self.metrics.errors_total.inc(type=err.error_type.value)
                     return False
                 return tmpl.allocatable.gpu > 0 or tmpl.allocatable.tpu > 0
         return False
@@ -1005,7 +1017,9 @@ class StaticAutoscaler:
             if template is None:
                 try:
                     template = group.template_node_info()
-                except Exception:
+                except Exception as e:
+                    err = to_autoscaler_error(e)
+                    self.metrics.errors_total.inc(type=err.error_type.value)
                     continue
             if template is None:
                 continue
@@ -1072,8 +1086,9 @@ class StaticAutoscaler:
             try:
                 group.delete_nodes(stuck)
                 removed += len(stuck)
-            except Exception:
-                pass
+            except Exception as e:
+                err = to_autoscaler_error(e)
+                self.metrics.errors_total.inc(type=err.error_type.value)
         return removed
 
     def _delete_created_nodes_with_errors(self) -> None:
@@ -1089,8 +1104,13 @@ class StaticAutoscaler:
                 group.delete_nodes(
                     [Node(name=i.id, provider_id=i.id) for i in instances]
                 )
-            except Exception:
+            except Exception as e:
+                err = to_autoscaler_error(e)
+                self.metrics.errors_total.inc(type=err.error_type.value)
                 try:
                     group.decrease_target_size(len(instances))
-                except Exception:
-                    pass
+                except Exception as e2:
+                    err2 = to_autoscaler_error(e2)
+                    self.metrics.errors_total.inc(
+                        type=err2.error_type.value
+                    )

@@ -36,6 +36,24 @@ from autoscaler_tpu.explain.reasons import (
 # remain_unschedulable count
 SCHEMA = "autoscaler_tpu.explain.decision/2"
 
+# the machine-readable field contract (graftlint GL017): change the
+# field set → update this AND bump the version tag above. The producer
+# (DecisionExplainer) attaches sections dynamically, so required stays
+# minimal and every attachable section is declared optional.
+SCHEMA_FIELDS = {
+    SCHEMA: {
+        "required": ("tick", "now_ts"),
+        "optional": (
+            "skipped_groups",
+            "pods",
+            "scale_up",
+            "expander",
+            "preemption",
+            "estimator",
+        ),
+    },
+}
+
 
 def stable_json(doc: Any) -> str:
     """Byte-stable one-line JSON (sorted keys, tight separators; exotic
@@ -216,6 +234,13 @@ def validate_records(records: Iterable[Any]) -> List[str]:
                         f"record {i}: group {gid!r} skip reason {reason!r} "
                         "outside the closed SkipReason vocabulary"
                     )
+        est = rec.get("estimator")
+        if est is not None and (
+            not isinstance(est, dict) or not isinstance(est.get("groups"), dict)
+        ):
+            errors.append(
+                f"record {i}: estimator section must carry a groups object"
+            )
         _check_pods(i, rec, errors)
         _check_expander(i, rec, errors)
         _check_preemption(i, rec, errors)

@@ -42,6 +42,11 @@ from autoscaler_tpu.fleet.coalescer import (
     FleetRequest,
     FleetTicket,
 )
+from autoscaler_tpu.fleet.ledger import (
+    FLEET_SCHEMA,
+    summarize as summarize_fleet_ledger,
+    validate_records as validate_fleet_records,
+)
 from autoscaler_tpu.fleet.errors import (
     ADMIT_OK,
     SHED_DEADLINE,
@@ -83,6 +88,7 @@ __all__ = [
     "DEFAULT_TIER",
     "EndpointBalancer",
     "EndpointHealth",
+    "FLEET_SCHEMA",
     "TierError",
     "TierPolicy",
     "TierSpec",
@@ -104,4 +110,6 @@ __all__ = [
     "parse_buckets",
     "pow2ceil",
     "select_bucket",
+    "summarize_fleet_ledger",
+    "validate_fleet_records",
 ]

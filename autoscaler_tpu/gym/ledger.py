@@ -22,6 +22,28 @@ from autoscaler_tpu.gym.policy import PolicyError, PolicySpec
 
 SCHEMA = "autoscaler_tpu.gym.generation/1"
 
+# the machine-readable field contract (graftlint GL017): change the
+# field set → update this AND bump the version tag above
+SCHEMA_FIELDS = {
+    SCHEMA: {
+        "required": (
+            "suite",
+            "generation",
+            "generations",
+            "seed",
+            "population",
+            "weights",
+            "scenarios",
+            "fleet_coalesced",
+            "candidates",
+            "pruned",
+            "best",
+            "best_so_far",
+        ),
+        "optional": (),
+    },
+}
+
 # the reserved candidate id of the all-defaults control: evaluated on the
 # FULL suite in generation 0, never pruned — the improvement gate's
 # denominator
@@ -140,6 +162,10 @@ def validate_records(records: Iterable[Any]) -> List[str]:
                 f"(expected {prev_gen + 1})"
             )
         prev_gen = gen if isinstance(gen, int) else prev_gen + 1
+        if not isinstance(rec.get("suite"), str) or not rec.get("suite"):
+            errors.append(f"record {i}: missing suite name")
+        if not isinstance(rec.get("fleet_coalesced"), bool):
+            errors.append(f"record {i}: fleet_coalesced must be a bool")
         scen = rec.get("scenarios")
         if not isinstance(scen, list) or not scen:
             errors.append(f"record {i}: scenarios must be a non-empty list")
@@ -161,6 +187,19 @@ def validate_records(records: Iterable[Any]) -> List[str]:
             continue
         for j, cand in enumerate(cands):
             _check_candidate(i, j, cand, list(scen), errors)
+        pruned = rec.get("pruned")
+        eliminated = sum(
+            1
+            for c in cands
+            if isinstance(c, dict) and c.get("eliminated_after") is not None
+        )
+        if not isinstance(pruned, int) or pruned < 0:
+            errors.append(f"record {i}: pruned must be a non-negative int")
+        elif pruned != eliminated:
+            errors.append(
+                f"record {i}: pruned={pruned} disagrees with the "
+                f"{eliminated} candidates carrying eliminated_after"
+            )
         if i == 0 and not any(
             isinstance(c, dict) and c.get("id") == BASELINE_ID for c in cands
         ):

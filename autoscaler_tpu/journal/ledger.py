@@ -31,6 +31,23 @@ from typing import Any, Dict, Iterable, List
 # the explain-line hash that pins each state line to its decision line
 SCHEMA = "autoscaler_tpu.journal.tick/1"
 
+# the machine-readable field contract (graftlint GL017): change the
+# field set → update this AND bump the version tag above
+SCHEMA_FIELDS = {
+    SCHEMA: {
+        "required": (
+            "tick",
+            "kind",
+            "options_fp",
+            "explain_sha256",
+            "ids",
+            "ctx",
+            "state",
+        ),
+        "optional": ("reason", "options"),
+    },
+}
+
 # closed keyframe-promotion vocabulary: why a full keyframe was written
 # instead of a delta (reseed:* mirrors the packer's full-repack reasons)
 KEYFRAME_REASONS = frozenset({
@@ -168,6 +185,13 @@ def validate_records(records: Iterable[Any]) -> List[str]:
         fp = rec.get("options_fp")
         if not isinstance(fp, str) or not fp:
             errors.append(f"record {i}: missing options fingerprint")
+        if not isinstance(rec.get("ctx"), dict):
+            errors.append(f"record {i}: ctx must be an object")
+        if kind == "keyframe" and not isinstance(rec.get("options"), dict):
+            errors.append(
+                f"record {i}: keyframe must carry its options document "
+                "(the reconstruction anchor)"
+            )
         if not isinstance(rec.get("explain_sha256"), str):
             errors.append(f"record {i}: missing explain-line hash")
         ids = rec.get("ids")

@@ -44,15 +44,10 @@ from autoscaler_tpu.metrics import metrics as metrics_mod
 from autoscaler_tpu.metrics.metrics import AutoscalerMetrics
 from autoscaler_tpu.trace import FlightRecorder, Tracer
 
-# fleet decision-ledger schema (sorted-key JSONL, one line per round).
-# /2 added the overload-armor fields: per-round `shed` rows (typed
-# admission/chaos rejections with retry-after) and the `outcomes` tally
-# (the zero-hung-tickets audit's per-round ledger witness).
-# /3 added the fleet-HA columns: per-verdict `endpoint` (the balancer's
-# replica choice — the endpoint-choice column hack/verify.sh byte-diffs
-# across replays) + `failovers`, and the quota `tier` on verdict and shed
-# rows.
-FLEET_SCHEMA = "autoscaler_tpu.fleet.round/3"
+# the fleet decision-ledger schema tag is single-sourced in
+# fleet/ledger.py beside its SCHEMA_FIELDS manifest and validate_records
+# twin (graftlint GL017 enforces the producer/validator/manifest diff)
+from autoscaler_tpu.fleet.ledger import FLEET_SCHEMA
 
 # deterministic synthetic per-route service latency fed into the balancer
 # EWMA on a successful route (seconds; health differentiation comes from

@@ -23,6 +23,16 @@ from typing import Any, Dict, Iterable, List, Set, Tuple
 
 SCHEMA = "autoscaler_tpu.perf.tick/1"
 
+# the machine-readable field contract (graftlint GL017 diffs every
+# producer, validate_records, and summarize against it): change the
+# field set → update this AND bump the version tag above
+SCHEMA_FIELDS = {
+    SCHEMA: {
+        "required": ("tick", "now_ts", "dispatches", "resident_bytes"),
+        "optional": ("arena",),
+    },
+}
+
 _DISPATCH_NUMERIC_OPTIONAL = (
     "execute_est_s",
     "compile_est_s",

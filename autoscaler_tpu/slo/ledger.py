@@ -34,6 +34,15 @@ from autoscaler_tpu.perf.ledger import (  # noqa: F401 — re-exported API
 
 SCHEMA = "autoscaler_tpu.slo.window/1"
 
+# the machine-readable field contract (graftlint GL017): change the
+# field set → update this AND bump the version tag above
+SCHEMA_FIELDS = {
+    SCHEMA: {
+        "required": ("tick", "now_ts", "slos"),
+        "optional": (),
+    },
+}
+
 _TOL = 1e-6
 
 

@@ -4,7 +4,12 @@ Dependency-free (stdlib only) so every layer can import it. See tracer.py
 for the design contract (injectable clock ⇒ byte-identical loadgen replays;
 span durations feed ``function_duration_seconds`` through one choke point).
 """
-from autoscaler_tpu.trace.recorder import FlightRecorder, chrome_trace_doc
+from autoscaler_tpu.trace.recorder import (
+    CHROME_SCHEMA,
+    FlightRecorder,
+    chrome_trace_doc,
+    validate_chrome_doc,
+)
 from autoscaler_tpu.trace.tracer import (
     NOOP_SPAN,
     Span,
@@ -22,6 +27,7 @@ from autoscaler_tpu.trace.tracer import (
 )
 
 __all__ = [
+    "CHROME_SCHEMA",
     "FlightRecorder",
     "NOOP_SPAN",
     "Span",
@@ -37,4 +43,5 @@ __all__ = [
     "span",
     "timeline_clock",
     "timeline_now",
+    "validate_chrome_doc",
 ]

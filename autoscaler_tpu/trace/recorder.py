@@ -20,6 +20,61 @@ from typing import Any, Dict, List, Optional
 
 from autoscaler_tpu.trace.tracer import TickTrace
 
+# /1: the Trace-Event-Format export envelope — ms display unit plus the
+# flat event list (complete "X" spans, instant "i" events, metadata "M"
+# track names). Consumers outside this repo (Perfetto, chrome://tracing)
+# ignore the schema key; hack/verify.sh byte-diffs two replays' exports.
+CHROME_SCHEMA = "autoscaler_tpu.trace.chrome/1"
+
+# the machine-readable field contract (graftlint GL017): change the
+# field set → update this AND bump the version tag above
+SCHEMA_FIELDS = {
+    CHROME_SCHEMA: {
+        "required": ("displayTimeUnit", "traceEvents"),
+        "optional": (),
+    },
+}
+
+
+def validate_chrome_doc(doc: Any) -> List[str]:
+    """Validate a chrome-trace export document; returns error strings
+    (empty = valid). The machine-checked twin of ``chrome_trace_doc``:
+    envelope shape plus the per-event invariants Perfetto relies on
+    (every event carries name/ph/pid/tid; complete events carry
+    non-negative ts/dur)."""
+    errors: List[str] = []
+    if not isinstance(doc, dict):
+        return ["document: not an object"]
+    if doc.get("schema") != CHROME_SCHEMA:
+        errors.append(f"document: schema {doc.get('schema')!r} != {CHROME_SCHEMA!r}")
+    if doc.get("displayTimeUnit") != "ms":
+        errors.append("document: displayTimeUnit must be 'ms'")
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return errors + ["document: traceEvents must be a list"]
+    for j, ev in enumerate(events):
+        where = f"event {j}"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev.get("name"):
+            errors.append(f"{where}: missing/empty name")
+        ph = ev.get("ph")
+        if ph not in ("X", "i", "M"):
+            errors.append(f"{where}: ph {ph!r} outside X|i|M")
+        if not isinstance(ev.get("pid"), int) or not isinstance(
+            ev.get("tid"), int
+        ):
+            errors.append(f"{where}: pid/tid must be ints")
+        if ph == "X" and (
+            not isinstance(ev.get("ts"), int)
+            or not isinstance(ev.get("dur"), int)
+            or ev["ts"] < 0
+            or ev["dur"] < 0
+        ):
+            errors.append(f"{where}: complete event needs ts/dur >= 0 µs")
+    return errors
+
 
 class FlightRecorder:
     """Thread-safe ring of TickTraces + a bounded pinned set."""
@@ -176,7 +231,11 @@ def chrome_trace_doc(traces: List[TickTrace]) -> Dict[str, Any]:
                         },
                     }
                 )
-    return {"displayTimeUnit": "ms", "traceEvents": events}
+    return {
+        "schema": CHROME_SCHEMA,
+        "displayTimeUnit": "ms",
+        "traceEvents": events,
+    }
 
 
 def _jsonable(attrs: Dict[str, Any]) -> Dict[str, Any]:

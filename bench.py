@@ -734,6 +734,38 @@ def _gym_ledger_main(path: str) -> int:
     return 1 if errors else 0
 
 
+def _fleet_ledger_main(path: str) -> int:
+    """``bench.py --fleet-ledger <ledger.jsonl>``: validate a fleet
+    round JSONL ledger (schema, round monotonicity, tenant shares and
+    outcome accounting — admitted + shed splits must reconcile with the
+    totals) and print the aggregated report. Exit 0 = valid, 1 =
+    schema/accounting errors, 2 = unreadable ledger. hack/verify.sh
+    gates on this."""
+    from autoscaler_tpu.fleet import (
+        summarize_fleet_ledger,
+        validate_fleet_records,
+    )
+    from autoscaler_tpu.fleet.ledger import load_jsonl
+
+    try:
+        records = load_jsonl(path)
+    except (OSError, ValueError) as e:
+        print(json.dumps({"metric": "fleet_ledger", "error": str(e)}))
+        return 2
+    errors = validate_fleet_records(records)
+    report = {
+        "metric": "fleet_ledger",
+        "ledger": os.path.basename(path),
+        "valid": not errors,
+        # bounded: a corrupted ledger must not flood CI logs
+        "errors": errors[:20],
+        "errors_total": len(errors),
+        **(summarize_fleet_ledger(records) if not errors else {}),
+    }
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 1 if errors else 0
+
+
 def _journal_ledger_main(path: str) -> int:
     """``bench.py --journal-ledger <journal.jsonl>``: validate a flight
     journal (schema, strict tick monotonicity, keyframe-first ordering,
@@ -1396,6 +1428,13 @@ def main():
                   file=sys.stderr)
             sys.exit(2)
         sys.exit(_journal_ledger_main(sys.argv[idx + 1]))
+    if "--fleet-ledger" in sys.argv:
+        idx = sys.argv.index("--fleet-ledger")
+        if idx + 1 >= len(sys.argv):
+            print("usage: bench.py --fleet-ledger <ledger.jsonl>",
+                  file=sys.stderr)
+            sys.exit(2)
+        sys.exit(_fleet_ledger_main(sys.argv[idx + 1]))
     if "--trend" in sys.argv:
         sys.exit(_trend_main())
     if os.environ.get(_CHILD_ENV) == "1":

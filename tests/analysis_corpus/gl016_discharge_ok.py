@@ -1,0 +1,87 @@
+# corpus-path: autoscaler_tpu/fixture/gl016_discharge_ok.py
+# corpus-rules: GL016
+"""GL016 negatives: every sanctioned discharge shape scans clean.
+
+- try/finally: the CFG duplicates the finally suite onto every exit
+  kind, so `abandon` in finally releases on the exception path too;
+- context manager: a `with` consuming the acquire binds no tracked
+  value — the manager's __exit__ is the witness;
+- helper summary: `self._finish()` releases the open tick record on
+  every path of its own body, so calling it in finally discharges the
+  caller interprocedurally;
+- None-kill: the `if t is None: return` branch kills the obligation on
+  the None arm, and the live arm resolves;
+- escapes: returning the ticket or parking it on `self` transfers the
+  obligation to whoever holds it now.
+"""
+
+
+class FleetCoalescer:
+    def submit(self, req):
+        return object()
+
+
+class PerfObservatory:
+    def begin_tick(self, tick):
+        return None
+
+    def end_tick(self):
+        return None
+
+
+class Tracer:
+    def span(self, label):
+        return object()
+
+
+def _validate(req):
+    if not req:
+        raise ValueError("empty request")
+
+
+class Driver:
+    def __init__(self):
+        self._pending = None
+        self._tracer = Tracer()
+        self._obs = PerfObservatory()
+
+    def finally_release(self, req):
+        c = FleetCoalescer()
+        t = c.submit(req)
+        try:
+            _validate(req)
+            t.resolve(None)
+        finally:
+            t.abandon()
+
+    def context_manager(self, req):
+        with self._tracer.span("tick"):
+            _validate(req)
+
+    def helper_summary(self, req):
+        self._obs.begin_tick(0)
+        try:
+            _validate(req)
+        finally:
+            self._finish()
+
+    def _finish(self):
+        self._obs.end_tick()
+
+    def none_kill(self, req):
+        c = FleetCoalescer()
+        t = c.submit(req)
+        if t is None:
+            return None
+        t.resolve(None)
+        return None
+
+    def escape_by_return(self, req):
+        c = FleetCoalescer()
+        t = c.submit(req)
+        return t
+
+    def escape_by_store(self, req):
+        c = FleetCoalescer()
+        t = c.submit(req)
+        self._pending = t

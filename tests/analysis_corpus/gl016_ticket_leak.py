@@ -1,0 +1,24 @@
+# corpus-path: autoscaler_tpu/fixture/gl016_ticket_leak.py
+# corpus-rules: GL016
+"""GL016 positive: a coalescer ticket that can reach the exception exit
+unresolved. `_validate` provably raises (explicit unguarded raise), so
+the call between submit and resolve carries a live exception edge — the
+normal path discharges via resolve, the exception path leaks."""
+
+
+class FleetCoalescer:
+    def submit(self, req):
+        return object()
+
+
+def _validate(req):
+    if not req:
+        raise ValueError("empty request")
+
+
+class Driver:
+    def run(self, req):
+        c = FleetCoalescer()
+        t = c.submit(req)  # gl-expect: GL016
+        _validate(req)
+        t.resolve(None)

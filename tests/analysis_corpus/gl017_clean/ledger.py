@@ -1,0 +1,43 @@
+# corpus-path: autoscaler_tpu/fixture_clean/ledger.py
+# corpus-rules: GL017
+"""GL017 negative: manifest, producer, validator, and summarizer all
+agree — the whole case scans clean. Includes a stable_json view (exempt
+from the manifest) and a summarizer reading only declared fields."""
+
+import json
+
+SCHEMA = "autoscaler_tpu.fixture_clean.row/1"
+
+SCHEMA_FIELDS = {
+    SCHEMA: {
+        "required": ("tick", "value"),
+        "optional": ("note",),
+    },
+}
+
+
+def stable_json(doc):
+    return json.dumps(doc, sort_keys=True, separators=(",", ":"))
+
+
+def validate_records(records):
+    errors = []
+    for i, rec in enumerate(records):
+        if rec.get("schema") != SCHEMA:
+            errors.append(f"record {i}: bad schema")
+        if not isinstance(rec.get("tick"), int):
+            errors.append(f"record {i}: tick must be an int")
+        if rec.get("value") is None:
+            errors.append(f"record {i}: missing value")
+        if "note" in rec and not isinstance(rec["note"], str):
+            errors.append(f"record {i}: note must be a string")
+    return errors
+
+
+def summarize(records):
+    ticks = 0
+    total = 0
+    for rec in records:
+        ticks += 1
+        total += rec.get("value", 0)
+    return {"ticks": ticks, "total": total}

@@ -1,0 +1,19 @@
+# corpus-path: autoscaler_tpu/fixture_clean/producer.py
+# corpus-rules: GL017
+
+from autoscaler_tpu.fixture_clean.ledger import SCHEMA, stable_json
+
+
+def make_record(tick, value):
+    rec = {
+        "schema": SCHEMA,
+        "tick": tick,
+        "value": value,
+    }
+    rec["note"] = "steady"
+    return rec
+
+
+def serve_view(summary):
+    # a serving view, not a ledger record: consumed only by stable_json
+    return stable_json({"schema": SCHEMA, "summary": summary})

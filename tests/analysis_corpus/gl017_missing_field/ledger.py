@@ -1,0 +1,27 @@
+# corpus-path: autoscaler_tpu/fixture_missing/ledger.py
+# corpus-rules: GL017
+"""GL017 positive (missing field): the manifest requires `value` but the
+producer never emits it — two findings, one at the producer (this
+producer misses a required field) and one at the tag (NO producer emits
+it at all)."""
+
+SCHEMA = "autoscaler_tpu.fixture_missing.row/1"  # gl-expect: GL017
+
+SCHEMA_FIELDS = {
+    SCHEMA: {
+        "required": ("tick", "value"),
+        "optional": (),
+    },
+}
+
+
+def validate_records(records):
+    errors = []
+    for i, rec in enumerate(records):
+        if rec.get("schema") != SCHEMA:
+            errors.append(f"record {i}: bad schema")
+        if not isinstance(rec.get("tick"), int):
+            errors.append(f"record {i}: tick must be an int")
+        if rec.get("value") is None:
+            errors.append(f"record {i}: missing value")
+    return errors

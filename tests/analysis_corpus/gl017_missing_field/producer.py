@@ -1,0 +1,11 @@
+# corpus-path: autoscaler_tpu/fixture_missing/producer.py
+# corpus-rules: GL017
+
+from autoscaler_tpu.fixture_missing.ledger import SCHEMA
+
+
+def make_record(tick):
+    return {  # gl-expect: GL017
+        "schema": SCHEMA,
+        "tick": tick,
+    }

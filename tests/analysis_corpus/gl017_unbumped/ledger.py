@@ -1,0 +1,27 @@
+# corpus-path: autoscaler_tpu/fixture_unbumped/ledger.py
+# corpus-rules: GL017
+"""GL017 positive (unbumped version change): the producer grew a field
+the manifest never declared — the exact drift a version bump must
+accompany. The validator matches the manifest, so the one finding is the
+producer's undeclared field."""
+
+SCHEMA = "autoscaler_tpu.fixture_unbumped.row/1"
+
+SCHEMA_FIELDS = {
+    SCHEMA: {
+        "required": ("tick", "value"),
+        "optional": (),
+    },
+}
+
+
+def validate_records(records):
+    errors = []
+    for i, rec in enumerate(records):
+        if rec.get("schema") != SCHEMA:
+            errors.append(f"record {i}: bad schema")
+        if not isinstance(rec.get("tick"), int):
+            errors.append(f"record {i}: tick must be an int")
+        if rec.get("value") is None:
+            errors.append(f"record {i}: missing value")
+    return errors

@@ -1,0 +1,13 @@
+# corpus-path: autoscaler_tpu/fixture_unbumped/producer.py
+# corpus-rules: GL017
+
+from autoscaler_tpu.fixture_unbumped.ledger import SCHEMA
+
+
+def make_record(tick, value):
+    return {  # gl-expect: GL017
+        "schema": SCHEMA,
+        "tick": tick,
+        "value": value,
+        "extra": 1,
+    }

@@ -1,0 +1,24 @@
+# corpus-path: autoscaler_tpu/fixture_unchecked/ledger.py
+# corpus-rules: GL017
+"""GL017 positive (unchecked field): `value` is declared and produced
+but the validator never reads it — producer drift on that field would
+pass validation silently. One finding, anchored at the validator."""
+
+SCHEMA = "autoscaler_tpu.fixture_unchecked.row/1"
+
+SCHEMA_FIELDS = {
+    SCHEMA: {
+        "required": ("tick", "value"),
+        "optional": (),
+    },
+}
+
+
+def validate_records(records):  # gl-expect: GL017
+    errors = []
+    for i, rec in enumerate(records):
+        if rec.get("schema") != SCHEMA:
+            errors.append(f"record {i}: bad schema")
+        if not isinstance(rec.get("tick"), int):
+            errors.append(f"record {i}: tick must be an int")
+    return errors

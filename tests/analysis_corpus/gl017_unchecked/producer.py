@@ -1,0 +1,12 @@
+# corpus-path: autoscaler_tpu/fixture_unchecked/producer.py
+# corpus-rules: GL017
+
+from autoscaler_tpu.fixture_unchecked.ledger import SCHEMA
+
+
+def make_record(tick, value):
+    return {
+        "schema": SCHEMA,
+        "tick": tick,
+        "value": value,
+    }

@@ -1,0 +1,298 @@
+"""Unit tests for the graftlint v3 intra-procedural CFG builder.
+
+Each test parses one small function, builds its graph with
+``build_cfg``, and asserts structural properties: the edges that must
+exist (branch/back/exception), the edges that must NOT exist (no false
+edge out of ``while True``), and the finally-duplication lowering that
+makes path-sensitive must-release analysis exact.
+"""
+from __future__ import annotations
+
+import ast
+import textwrap
+
+from autoscaler_tpu.analysis.cfg import (
+    ENTRY,
+    EXIT,
+    RAISES,
+    build_cfg,
+    stmt_can_raise,
+)
+
+
+def _cfg(src: str):
+    tree = ast.parse(textwrap.dedent(src).lstrip("\n"))
+    func = tree.body[0]
+    assert isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef))
+    return build_cfg(func)
+
+
+def _node_at(cfg, line: int):
+    hits = [n for n in cfg.nodes if n.stmt is not None and n.line == line]
+    assert hits, f"no statement node at line {line}"
+    return hits[0]
+
+
+def _kinds_out(cfg, idx: int):
+    return sorted(e.kind for e in cfg.succ.get(idx, []))
+
+
+def _reaches(cfg, src: int, dst: int) -> bool:
+    seen = set()
+    work = [src]
+    while work:
+        n = work.pop()
+        if n == dst:
+            return True
+        if n in seen:
+            continue
+        seen.add(n)
+        work.extend(e.dst for e in cfg.succ.get(n, []))
+    return False
+
+
+def test_if_else_branches_rejoin():
+    cfg = _cfg(
+        """
+        def f(x):
+            if x:
+                a = 1
+            else:
+                a = 2
+            return a
+        """
+    )
+    test = _node_at(cfg, 2)
+    assert _kinds_out(cfg, test.idx) == ["false", "true"]
+    then = _node_at(cfg, 3)
+    other = _node_at(cfg, 5)
+    ret = _node_at(cfg, 6)
+    # both arms flow into the join statement, which returns
+    assert {e.src for e in cfg.pred[ret.idx]} == {then.idx, other.idx}
+    assert any(e.dst == EXIT for e in cfg.succ[ret.idx])
+
+
+def test_if_without_else_gets_fallthrough_false_edge():
+    cfg = _cfg(
+        """
+        def f(x):
+            if x:
+                a = 1
+            return x
+        """
+    )
+    test = _node_at(cfg, 2)
+    ret = _node_at(cfg, 4)
+    kinds = {(e.kind, e.dst) for e in cfg.succ[test.idx]}
+    assert ("false", ret.idx) in kinds
+
+
+def test_while_loop_has_back_edge_and_false_exit():
+    cfg = _cfg(
+        """
+        def f(n):
+            while n:
+                n -= 1
+            return n
+        """
+    )
+    head = _node_at(cfg, 2)
+    body = _node_at(cfg, 3)
+    assert any(e.dst == head.idx and e.kind == "back" for e in cfg.succ[body.idx])
+    assert any(e.kind == "false" for e in cfg.succ[head.idx])
+
+
+def test_while_true_has_no_false_exit():
+    cfg = _cfg(
+        """
+        def f(q):
+            while True:
+                item = q.pop()
+                if item is None:
+                    break
+            return 1
+        """
+    )
+    head = _node_at(cfg, 2)
+    assert not any(e.kind == "false" for e in cfg.succ[head.idx])
+    # break is still a real path to the return
+    brk = _node_at(cfg, 5)
+    ret = _node_at(cfg, 6)
+    assert _reaches(cfg, brk.idx, ret.idx)
+
+
+def test_except_dispatch_routes_to_handler_and_propagates_unmatched():
+    cfg = _cfg(
+        """
+        def f(x):
+            try:
+                g(x)
+            except ValueError:
+                return None
+            return x
+        """
+    )
+    call = _node_at(cfg, 3)
+    # the call's exc edge targets the synthetic dispatch node
+    exc = [e for e in cfg.succ[call.idx] if e.kind == "exc"]
+    assert len(exc) == 1
+    dispatch = cfg.nodes[exc[0].dst]
+    assert dispatch.label == "except-dispatch"
+    out = {(e.kind, cfg.nodes[e.dst].label) for e in cfg.succ[dispatch.idx]}
+    # one matched-handler edge, plus propagation for non-ValueError
+    assert ("except", "handler") in out
+    assert any(e.kind == "exc" and e.dst == RAISES for e in cfg.succ[dispatch.idx])
+
+
+def test_catch_all_except_does_not_propagate():
+    cfg = _cfg(
+        """
+        def f(x):
+            try:
+                g(x)
+            except Exception:
+                pass
+            return x
+        """
+    )
+    call = _node_at(cfg, 3)
+    (exc,) = [e for e in cfg.succ[call.idx] if e.kind == "exc"]
+    assert not any(
+        e.kind == "exc" and e.dst == RAISES for e in cfg.succ[exc.dst]
+    )
+    assert not _reaches(cfg, call.idx, RAISES)
+
+
+def test_finally_duplicated_per_exit_kind():
+    cfg = _cfg(
+        """
+        def f(x):
+            try:
+                g(x)
+                return 1
+            finally:
+                release(x)
+        """
+    )
+    # one finally copy for the return exit, one for the exception exit —
+    # the release statement appears once per pending exit kind
+    releases = [n for n in cfg.nodes if n.stmt is not None and n.line == 6]
+    assert len(releases) == 2
+    # every copy eventually leaves the function, and each exit node is
+    # fed by exactly one of the copies
+    assert any(_reaches(cfg, n.idx, EXIT) for n in releases)
+    assert any(_reaches(cfg, n.idx, RAISES) for n in releases)
+    # the return cannot bypass the finally suite
+    ret = _node_at(cfg, 4)
+    (out,) = cfg.succ[ret.idx]
+    assert cfg.nodes[out.dst].label == "finally"
+
+
+def test_break_through_finally_reaches_loop_exit_via_copy():
+    cfg = _cfg(
+        """
+        def f(items):
+            for it in items:
+                try:
+                    if it:
+                        break
+                finally:
+                    note(it)
+            return 1
+        """
+    )
+    brk = _node_at(cfg, 5)
+    ret = _node_at(cfg, 8)
+    # the break exits the loop, but only through a finally copy
+    (out,) = cfg.succ[brk.idx]
+    assert cfg.nodes[out.dst].label == "finally"
+    assert _reaches(cfg, brk.idx, ret.idx)
+
+
+def test_raise_only_exits_via_exception_edge():
+    cfg = _cfg(
+        """
+        def f():
+            raise ValueError("boom")
+        """
+    )
+    r = _node_at(cfg, 2)
+    assert [(e.kind, e.dst) for e in cfg.succ[r.idx]] == [("exc", RAISES)]
+    assert not _reaches(cfg, ENTRY, EXIT)
+
+
+def test_with_body_is_linear_and_context_call_may_raise():
+    cfg = _cfg(
+        """
+        def f(tr):
+            with tr.span("tick"):
+                work()
+            return 1
+        """
+    )
+    w = _node_at(cfg, 2)
+    body = _node_at(cfg, 3)
+    assert any(e.dst == body.idx and e.kind == "next" for e in cfg.succ[w.idx])
+    assert any(e.kind == "exc" for e in cfg.succ[w.idx])
+
+
+def test_try_else_runs_only_on_clean_body_and_escapes_handlers():
+    cfg = _cfg(
+        """
+        def f(x):
+            try:
+                g(x)
+            except ValueError:
+                return 0
+            else:
+                h(x)
+            return 1
+        """
+    )
+    els = _node_at(cfg, 7)
+    # else's own exception is NOT dispatched to this try's handlers
+    exc = [e for e in cfg.succ[els.idx] if e.kind == "exc"]
+    assert exc and exc[0].dst == RAISES
+    # and the else block is NOT reachable from the handler
+    handler = [n for n in cfg.nodes if n.label == "handler"][0]
+    assert not _reaches(cfg, handler.idx, els.idx)
+
+
+def test_deterministic_rebuild():
+    src = """
+        def f(x):
+            try:
+                for i in x:
+                    if i:
+                        continue
+                    g(i)
+            finally:
+                done()
+            return x
+        """
+    a, b = _cfg(src), _cfg(src)
+    assert [(n.idx, n.label, n.line) for n in a.nodes] == [
+        (n.idx, n.label, n.line) for n in b.nodes
+    ]
+    assert a.edges == b.edges
+
+
+def test_stmt_can_raise_classification():
+    mod = ast.parse(
+        textwrap.dedent(
+            """
+            x = 1
+            y = g()
+            assert x
+            raise ValueError
+            def nested():
+                boom()
+            """
+        )
+    )
+    assign, call, asrt, rais, nested = mod.body
+    assert not stmt_can_raise(assign)
+    assert stmt_can_raise(call)
+    assert stmt_can_raise(asrt)
+    assert stmt_can_raise(rais)
+    assert not stmt_can_raise(nested)  # defining doesn't run the body

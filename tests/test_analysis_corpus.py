@@ -1,4 +1,4 @@
-"""Corpus-driven tests for the whole-program rules (GL013/GL014/GL015).
+"""Corpus-driven tests for the whole-program rules (GL013–GL017).
 
 One parametrized test walks ``tests/analysis_corpus/``: every top-level
 ``.py`` file is a standalone case, every subdirectory a multi-file case.
@@ -141,7 +141,7 @@ def test_sarif_rule_docs_cover_every_new_rule():
             encoding="utf-8"
         )
     )
-    for rid in ("GL013", "GL014", "GL015"):
+    for rid in ("GL013", "GL014", "GL015", "GL016", "GL017"):
         title, prose = docs[rid]
         assert title and prose, f"{rid} missing RULES.md documentation"
 

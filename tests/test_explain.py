@@ -601,6 +601,18 @@ class TestLedgerValidation:
         errs = validate_records([rec])
         assert any("missing from the expander scoring table" in e for e in errs)
 
+    def test_estimator_section_shape_enforced(self):
+        """Regression (graftlint GL017): the estimator section is
+        declared in SCHEMA_FIELDS but the validator never read it — a
+        malformed estimator document passed validation silently."""
+        errs = validate_records(
+            [self._record(0, estimator={"groups": "nope"})]
+        )
+        assert any("estimator" in e for e in errs)
+        assert validate_records(
+            [self._record(0, estimator={"groups": {}})]
+        ) == []
+
     def test_unexplained_pending_pod_flagged(self):
         rec = self._record(
             0,

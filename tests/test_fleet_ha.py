@@ -469,6 +469,29 @@ class TestHedgeHealthGating:
         with pytest.raises(TimeoutError):
             client._hedged_send("Estimate", object(), 0.05, None, _Resp)
 
+    def test_exhausted_budget_never_takes_a_hedge_pick(self, monkeypatch):
+        """Regression (graftlint GL016): pick_hedge may hand out a
+        half-open probe slot, and a pick taken with the deadline budget
+        already burned can never reach an outcome — the slot would leak
+        until restart. The budget check must come BEFORE the pick."""
+        from autoscaler_tpu.rpc.service import TpuSimulationClient
+
+        client = TpuSimulationClient(
+            ["p:1", "s:2"], default_timeout_s=0.2, hedge=True,
+        )
+        client.HEDGE_MIN_DELAY_S = 0.0
+        for ep in ("p:1", "s:2"):
+            client._balancer.record_success(ep, 0.01)
+        monkeypatch.setattr(
+            client._balancer, "pick_hedge",
+            lambda *a, **k: pytest.fail(
+                "pick_hedge taken with the budget already exhausted"
+            ),
+        )
+        client._channel = _FutureChannel(_FakeFuture(ready=False))
+        with pytest.raises(TimeoutError):
+            client._hedged_send("Estimate", object(), 0.0, None, _Resp)
+
     def test_hedge_targets_a_healthy_endpoint_not_the_next_in_list(
         self, monkeypatch
     ):
@@ -677,6 +700,39 @@ def test_fleet_ha_bench_gate():
     assert report["balanced"]["p99_s"] < report["static"]["p99_s"]
     assert (report["balanced"]["deadline_misses"]
             <= report["static"]["deadline_misses"])
+
+
+def test_fleet_ledger_validator_and_bench_gate(tmp_path):
+    """The fleet round ledger now has a validator twin (GL017): a real
+    run's ledger validates clean through `bench.py --fleet-ledger`, and
+    a hung ticket — the deadline-deadlock bug class — fails it."""
+    import io
+    import json
+    from contextlib import redirect_stdout
+
+    import bench
+    from autoscaler_tpu.fleet import validate_fleet_records
+    from autoscaler_tpu.loadgen.fleetdrive import run_fleet_scenario
+
+    result = run_fleet_scenario(_rolling_spec())
+    records = result.decision_log()
+    assert validate_fleet_records(records) == []
+    path = tmp_path / "fleet.jsonl"
+    path.write_text(result.decision_ledger_lines())
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bench._fleet_ledger_main(str(path))
+    report = json.loads(buf.getvalue())
+    assert rc == 0 and report["valid"], report
+    assert report["rounds"] == len(records)
+    assert report["outcomes"].get("unresolved", 0) == 0
+    # a hung ticket must never validate clean
+    bad = [dict(r) for r in records]
+    bad[0] = dict(bad[0], outcomes=dict(bad[0]["outcomes"], unresolved=1))
+    assert any("unresolved" in e for e in validate_fleet_records(bad))
+    # ...and an unreadable ledger is exit 2, not a crash
+    with redirect_stdout(io.StringIO()):
+        assert bench._fleet_ledger_main(str(tmp_path / "absent.jsonl")) == 2
 
 
 # -- review-hardening regressions ---------------------------------------------

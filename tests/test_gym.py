@@ -324,6 +324,32 @@ class TestLedger:
         records4[0]["candidates"][0]["policy"] = {"surprise_knob": 1}
         assert any("knob" in e for e in validate_records(records4))
 
+    def test_validation_covers_every_declared_field(self):
+        """Regression (graftlint GL017): suite, fleet_coalesced and
+        pruned are declared in SCHEMA_FIELDS but the validator never read
+        them — drift on any of them passed validation silently."""
+        result = self._tune()
+
+        def fresh():
+            return [json.loads(record_line(r)) for r in result.records]
+
+        records = fresh()
+        records[0]["suite"] = ""
+        assert any("suite" in e for e in validate_records(records))
+        records = fresh()
+        records[0]["fleet_coalesced"] = "yes"
+        assert any(
+            "fleet_coalesced" in e for e in validate_records(records)
+        )
+        records = fresh()
+        records[0]["pruned"] = -1
+        assert any("pruned" in e for e in validate_records(records))
+        # pruned must AGREE with the eliminated_after annotations, not
+        # merely be a well-typed int
+        records = fresh()
+        records[-1]["pruned"] = records[-1]["pruned"] + 1
+        assert any("disagrees" in e for e in validate_records(records))
+
     def test_bench_exit_codes(self, tmp_path, capsys):
         import bench
 

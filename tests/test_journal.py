@@ -260,6 +260,22 @@ class TestCorruptionMatrix:
         records[0] = dict(records[0], schema="nope")
         assert validate_records(records)
 
+    def test_validator_covers_ctx_and_keyframe_options(self, storm_replays):
+        """Regression (graftlint GL017): ctx and the keyframe options
+        document are declared in SCHEMA_FIELDS but the validator never
+        read them — a journal missing its reconstruction anchor passed
+        validation silently."""
+        r1, _, _ = storm_replays
+        records = [dict(r) for r in r1.journal_records]
+        records[0] = dict(records[0], ctx=[])
+        assert any("ctx" in e for e in validate_records(records))
+        records = [dict(r) for r in r1.journal_records]
+        kf = next(i for i, r in enumerate(records) if r["kind"] == "keyframe")
+        records[kf] = {
+            k: v for k, v in records[kf].items() if k != "options"
+        }
+        assert any("options" in e for e in validate_records(records))
+
 
 # ------------------------------------------------ replay + divergence
 class TestReplayDivergence:

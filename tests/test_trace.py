@@ -198,6 +198,37 @@ class TestFlightRecorder:
         assert complete[0]["dur"] == 1_000_000  # 1s in µs
         assert rec.chrome(123) is None  # unknown id
 
+    def test_chrome_doc_carries_schema_and_validates(self):
+        """The chrome export is a ledger document like the others: it
+        carries its schema tag and has a validator twin (GL017)."""
+        rec = trace.FlightRecorder()
+        rec.add(self._trace(0))
+        doc = json.loads(rec.chrome())
+        assert doc["schema"] == trace.CHROME_SCHEMA
+        assert trace.validate_chrome_doc(doc) == []
+
+    def test_chrome_validator_flags_drift(self):
+        rec = trace.FlightRecorder()
+        rec.add(self._trace(0))
+        good = json.loads(rec.chrome())
+
+        bad = dict(good, schema="nope/9")
+        assert any("schema" in e for e in trace.validate_chrome_doc(bad))
+        bad = dict(good, traceEvents="not a list")
+        assert any(
+            "traceEvents" in e for e in trace.validate_chrome_doc(bad)
+        )
+        events = [dict(e) for e in good["traceEvents"]]
+        events[0]["ph"] = "Z"
+        assert any(
+            "ph" in e
+            for e in trace.validate_chrome_doc(dict(good, traceEvents=events))
+        )
+        events = [dict(e) for e in good["traceEvents"]]
+        x = next(e for e in events if e["ph"] == "X")
+        x["dur"] = -1
+        assert trace.validate_chrome_doc(dict(good, traceEvents=events))
+
     def test_slow_tick_pinned_and_dumped(self, caplog):
         import logging
 
